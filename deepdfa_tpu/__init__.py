@@ -1,0 +1,23 @@
+"""deepdfa_tpu: a TPU-native vulnerability-detection framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of the DeepDFA
+reproduction package (ICSE'24, "Dataflow Analysis-Inspired Deep Learning for
+Efficient Vulnerability Detection"): abstract-dataflow GGNN models over C/C++
+control-flow graphs, combined transformer+graph classifiers, and the full
+host-side preprocessing pipeline (CPG extraction, reaching definitions,
+abstract dataflow features).
+
+Layering (bottom-up):
+  core/      paths, typed config, PRNG discipline, registry
+  graphs/    static-shape padded GraphBatch pytree + bucketed batching + storage
+  frontend/  host-side C -> CPG -> dataflow features pipeline
+  nn/        Flax modules (GGNN message passing, pooling, embeddings)
+  models/    DeepDFA classifier, combined transformer+graph models
+  parallel/  mesh / sharding / collectives / ring attention
+  train/     jit-compiled train loops, samplers, metrics, checkpoints
+  eval/      statement-level eval, coverage analysis, profiling
+  data/      dataset readers, synthetic corpus generator
+  cli/       command-line entry points mirroring the reference pipeline
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+from deepdfa_tpu.core import config, paths, prng
+from deepdfa_tpu.core.config import (
+    BatchConfig,
+    Config,
+    DataConfig,
+    FeatureSpec,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+
+__all__ = [
+    "config",
+    "paths",
+    "prng",
+    "Config",
+    "DataConfig",
+    "ModelConfig",
+    "TrainConfig",
+    "OptimConfig",
+    "MeshConfig",
+    "BatchConfig",
+    "FeatureSpec",
+]

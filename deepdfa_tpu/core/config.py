@@ -1,0 +1,254 @@
+"""One typed configuration system for the whole framework.
+
+Replaces the reference's three config mechanisms (LightningCLI+jsonargparse
+YAML stacks in DDFA/code_gnn/main_cli.py:69-99, argparse in
+LineVul/linevul/linevul_main.py:422-524 and CodeT5/configs.py) with nested
+dataclasses, dotted-path CLI overrides, and JSON round-tripping.
+
+The reference's string-encoded feature selection
+(`_ABS_DATAFLOW_<subkeys>_all_limitall_<N>_limitsubkeys_<M>`, parsed by
+DDFA/sastvd/helpers/datasets.py:560-585) is kept as `FeatureSpec`, the
+dataset-artifact naming convention, but exposed as typed fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+ALL_SUBKEYS = ("api", "datatype", "literal", "operator")
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    """Which abstract-dataflow subkeys feed the model and vocab limits.
+
+    input_dim per subkey table = limit_all + 2: index 0 = "node is not a
+    definition", 1 = UNKNOWN hash, 2.. = the limit_all most frequent train
+    hashes (reference: DDFA/sastvd/scripts/dbize_absdf.py:35-42 and
+    DDFA/sastvd/linevd/datamodule.py:87-96).
+    """
+
+    subkeys: tuple[str, ...] = ALL_SUBKEYS
+    limit_all: int | None = 1000  # None = unlimited (reference parse_limits)
+    limit_subkeys: int | None = 1000
+
+    @property
+    def input_dim(self) -> int:
+        if self.limit_all is None:
+            raise ValueError(
+                "input_dim is undefined for an unlimited vocab (limit_all=None); "
+                "size the embedding from the built vocab instead"
+            )
+        return self.limit_all + 2
+
+    @property
+    def name(self) -> str:
+        sk = "_".join(sorted(self.subkeys))
+        return (
+            f"_ABS_DATAFLOW_{sk}_all_limitall_{self.limit_all}"
+            f"_limitsubkeys_{self.limit_subkeys}"
+        )
+
+    @classmethod
+    def parse(cls, feat: str) -> "FeatureSpec":
+        """Parse a reference-style feature string."""
+        subkeys = tuple(k for k in ALL_SUBKEYS if k in feat) or ALL_SUBKEYS
+
+        def _limit(key: str, default: int) -> int | None:
+            if key not in feat:
+                return default
+            start = feat.find(key) + len(key) + 1
+            end = feat.find("_", start)
+            tok = feat[start:] if end == -1 else feat[start:end]
+            return None if tok == "None" else int(tok)
+
+        return cls(
+            subkeys=subkeys,
+            limit_all=_limit("limitall", 1000),
+            limit_subkeys=_limit("limitsubkeys", 1000),
+        )
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """GGNN architecture (reference defaults: DDFA/configs/config_ggnn.yaml)."""
+
+    hidden_dim: int = 32
+    n_steps: int = 5
+    num_output_layers: int = 3
+    concat_all_absdf: bool = True
+    label_style: str = "graph"  # graph | node
+    encoder_mode: bool = False
+    # TPU-specific knobs (no reference equivalent):
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"  # bfloat16 for large models
+    use_pallas: bool = False  # pallas message-passing kernel vs pure-XLA
+
+
+@dataclass(frozen=True)
+class BatchConfig:
+    """Static-shape batching budgets (replaces dgl.batch dynamic shapes)."""
+
+    graphs_per_batch: int = 256
+    max_nodes_per_graph: int = 512
+    node_budget: int = 16384  # padded node count per shard
+    edge_budget: int = 65536  # padded edge count per shard (incl. self loops)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "bigvul"
+    feat: FeatureSpec = field(default_factory=FeatureSpec)
+    split: str = "fixed"  # fixed | random | fixed+random seed schemes
+    seed: int = 0
+    sample_mode: bool = False
+    undersample: bool = True  # epoch-wise 1:1 undersampling of negatives
+    batch: BatchConfig = field(default_factory=BatchConfig)
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Reference: Adam lr 1e-3 wd 1e-2 (DDFA/configs/config_default.yaml:43-47)."""
+
+    name: str = "adamw"
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-2
+    warmup_frac: float = 0.0
+    grad_clip_norm: float = 0.0  # 0 = off
+    b1: float = 0.9
+    b2: float = 0.999
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axis sizes of 1 collapse; -1 = all remaining."""
+
+    dp: int = -1  # data parallel (graph batches / example batches)
+    tp: int = 1  # tensor parallel (transformer heads / mlp)
+    sp: int = 1  # sequence parallel (ring attention)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    max_epochs: int = 25
+    eval_every_epochs: int = 1
+    checkpoint_every_epochs: int = 25
+    monitor: str = "val_loss"  # checkpoint-selection metric
+    monitor_mode: str = "min"
+    seed: int = 1
+    pos_weight: float | None = None  # None = derived from train labels
+    log_every_steps: int = 50
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+@dataclass(frozen=True)
+class Config:
+    run_name: str = "default"
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+
+# ---------------------------------------------------------------------------
+# serialization + CLI overrides
+
+
+def _to_dict(cfg: Any) -> Any:
+    if dataclasses.is_dataclass(cfg):
+        return {f.name: _to_dict(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}
+    if isinstance(cfg, tuple):
+        return list(cfg)
+    return cfg
+
+
+def to_json(cfg: Config, path: str | Path | None = None) -> str:
+    s = json.dumps(_to_dict(cfg), indent=2)
+    if path is not None:
+        Path(path).write_text(s)
+    return s
+
+
+_NESTED = {
+    "data": DataConfig,
+    "model": ModelConfig,
+    "train": TrainConfig,
+    "optim": OptimConfig,
+    "mesh": MeshConfig,
+    "batch": BatchConfig,
+    "feat": FeatureSpec,
+}
+
+
+def from_dict(d: dict[str, Any]) -> Config:
+    def resolve(cls, dd, prefix=""):
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(dd) - known
+        if unknown:
+            raise KeyError(
+                f"unknown config key(s): {sorted(prefix + k for k in unknown)}"
+            )
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in dd:
+                continue
+            v = dd[f.name]
+            if f.name in _NESTED and isinstance(v, dict):
+                v = resolve(_NESTED[f.name], v, prefix=f"{prefix}{f.name}.")
+            elif isinstance(v, list):
+                v = tuple(v)
+            kwargs[f.name] = v
+        return cls(**kwargs)
+
+    return resolve(Config, d)
+
+
+def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
+    """Apply `a.b.c=value` dotted overrides (values parsed as JSON or str)."""
+    d = _to_dict(cfg)
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override must be key=value, got {ov!r}")
+        key, _, raw = ov.partition("=")
+        try:
+            val = json.loads(raw)
+        except json.JSONDecodeError:
+            val = raw
+        node = d
+        parts = key.split(".")
+        for p in parts[:-1]:
+            if not isinstance(node, dict) or p not in node:
+                raise KeyError(f"unknown config key: {key}")
+            node = node[p]
+        if not isinstance(node, dict) or parts[-1] not in node:
+            raise KeyError(f"unknown config key: {key}")
+        old = node[parts[-1]]
+        if (
+            old is not None
+            and val is not None
+            and isinstance(val, bool) != isinstance(old, bool)
+        ):
+            raise TypeError(
+                f"override {key}={raw!r}: expected {type(old).__name__}, "
+                f"got {type(val).__name__}"
+            )
+        if old is not None and val is not None and not isinstance(val, type(old)):
+            # bool is an int subclass: require exact match there; allow
+            # int -> float widening
+            if isinstance(old, float) and isinstance(val, int) and not isinstance(val, bool):
+                val = float(val)
+            else:
+                raise TypeError(
+                    f"override {key}={raw!r}: expected {type(old).__name__}, "
+                    f"got {type(val).__name__}"
+                )
+        node[parts[-1]] = val
+    return from_dict(d)
+
+
+def load(path: str | Path) -> Config:
+    return from_dict(json.loads(Path(path).read_text()))

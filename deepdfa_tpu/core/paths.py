@@ -1,0 +1,56 @@
+"""Storage layout and path helpers.
+
+Replaces the reference's path zoo (DDFA/sastvd/__init__.py:42-88:
+storage_dir/external_dir/processed_dir/cache_dir + SINGSTORAGE env redirect)
+with one rooted, env-overridable layout:
+
+    <root>/
+      raw/<dataset>/        immutable inputs (csv, source files)
+      cpg/<dataset>/        extracted CPG-lite json shards
+      processed/<dataset>/  feature tables, vocab files, graph shards
+      cache/<dataset>/      recomputable caches
+      runs/<run-name>/      checkpoints, logs, metrics
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_ENV_VAR = "DEEPDFA_TPU_STORAGE"
+
+
+def storage_root() -> Path:
+    """Root of all on-disk artifacts. Override with DEEPDFA_TPU_STORAGE."""
+    root = os.environ.get(_ENV_VAR)
+    if root:
+        return Path(root)
+    return Path(__file__).resolve().parents[2] / "storage"
+
+
+def _sub(kind: str, dataset: str | None = None) -> Path:
+    p = storage_root() / kind
+    if dataset is not None:
+        p = p / dataset
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def raw_dir(dataset: str | None = None) -> Path:
+    return _sub("raw", dataset)
+
+
+def cpg_dir(dataset: str | None = None) -> Path:
+    return _sub("cpg", dataset)
+
+
+def processed_dir(dataset: str | None = None) -> Path:
+    return _sub("processed", dataset)
+
+
+def cache_dir(dataset: str | None = None) -> Path:
+    return _sub("cache", dataset)
+
+
+def runs_dir(run_name: str | None = None) -> Path:
+    return _sub("runs", run_name)

@@ -1,0 +1,34 @@
+"""PRNG discipline: one root key per run, split-by-name, never reused.
+
+Replaces the reference's global seeding (DDFA/code_gnn/globals.py:14-33
+seed_all + dgl.seed in main_cli.py) with explicit functional JAX keys.
+Host-side (numpy) randomness for sampling/shuffling derives from the same
+integer seed so runs are reproducible end to end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import numpy as np
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def fold_name(key: jax.Array, name: str) -> jax.Array:
+    """Derive a named subkey deterministically from a string tag."""
+    h = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
+    return jax.random.fold_in(key, h)
+
+
+def host_rng(seed: int, name: str = "") -> np.random.Generator:
+    h = int.from_bytes(hashlib.sha256(f"{seed}:{name}".encode()).digest()[:8], "little")
+    return np.random.default_rng(h)
+
+
+def hashstr(s: str) -> int:
+    """Stable 8-byte string hash for vocab bucketing and artifact naming."""
+    return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "little")

@@ -1,0 +1,33 @@
+"""Test harness: force an 8-virtual-device CPU platform before JAX imports.
+
+This is the standard JAX trick for exercising multi-chip sharding without
+hardware (fills the reference's "multi-node without a cluster" gap noted in
+SURVEY.md §4): every test sees jax.device_count() == 8 on CPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

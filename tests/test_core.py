@@ -1,0 +1,60 @@
+import json
+
+from deepdfa_tpu.core import Config, FeatureSpec, config, prng
+
+
+def test_feature_spec_roundtrip():
+    fs = FeatureSpec(limit_all=1000, limit_subkeys=1000)
+    assert fs.input_dim == 1002
+    name = fs.name
+    parsed = FeatureSpec.parse(name)
+    assert parsed.limit_all == 1000
+    assert parsed.limit_subkeys == 1000
+    assert set(parsed.subkeys) == {"api", "datatype", "literal", "operator"}
+
+
+def test_feature_spec_parse_reference_string():
+    # the exact feat string from the reference config
+    # (DDFA/configs/config_bigvul.yaml:3)
+    feat = "_ABS_DATAFLOW_datatype_all_limitall_1000_limitsubkeys_1000"
+    fs = FeatureSpec.parse(feat)
+    assert fs.subkeys == ("datatype",)
+    assert fs.limit_all == 1000
+    assert fs.input_dim == 1002
+
+
+def test_config_json_roundtrip(tmp_path):
+    cfg = Config()
+    p = tmp_path / "cfg.json"
+    config.to_json(cfg, p)
+    cfg2 = config.load(p)
+    assert cfg2 == cfg
+
+
+def test_config_overrides():
+    cfg = Config()
+    cfg2 = config.apply_overrides(
+        cfg, ["model.hidden_dim=64", "train.optim.learning_rate=0.01", "run_name=x"]
+    )
+    assert cfg2.model.hidden_dim == 64
+    assert cfg2.train.optim.learning_rate == 0.01
+    assert cfg2.run_name == "x"
+    # unknown keys are rejected
+    try:
+        config.apply_overrides(cfg, ["model.nope=1"])
+        raise AssertionError("should have raised")
+    except KeyError:
+        pass
+
+
+def test_prng_determinism():
+    import jax
+
+    k1 = prng.fold_name(prng.root_key(0), "train")
+    k2 = prng.fold_name(prng.root_key(0), "train")
+    k3 = prng.fold_name(prng.root_key(0), "eval")
+    assert (jax.random.key_data(k1) == jax.random.key_data(k2)).all()
+    assert not (jax.random.key_data(k1) == jax.random.key_data(k3)).all()
+    g = prng.host_rng(0, "sampler")
+    g2 = prng.host_rng(0, "sampler")
+    assert g.integers(0, 1 << 30) == g2.integers(0, 1 << 30)
