@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import typing
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
@@ -35,6 +36,10 @@ class FeatureSpec:
     subkeys: tuple[str, ...] = ALL_SUBKEYS
     limit_all: int | None = 1000  # None = unlimited (reference parse_limits)
     limit_subkeys: int | None = 1000
+
+    def __post_init__(self):
+        # canonical order so equal artifact names imply equal specs
+        object.__setattr__(self, "subkeys", tuple(sorted(set(self.subkeys))))
 
     @property
     def input_dim(self) -> int:
@@ -173,15 +178,12 @@ def to_json(cfg: Config, path: str | Path | None = None) -> str:
     return s
 
 
-_NESTED = {
-    "data": DataConfig,
-    "model": ModelConfig,
-    "train": TrainConfig,
-    "optim": OptimConfig,
-    "mesh": MeshConfig,
-    "batch": BatchConfig,
-    "feat": FeatureSpec,
-}
+def _nested_dataclass(cls: type, field_name: str) -> type | None:
+    """Resolve a field's dataclass type from annotations (handles the
+    string annotations produced by `from __future__ import annotations`)."""
+    hints = typing.get_type_hints(cls)
+    t = hints.get(field_name)
+    return t if dataclasses.is_dataclass(t) else None
 
 
 def from_dict(d: dict[str, Any]) -> Config:
@@ -197,8 +199,9 @@ def from_dict(d: dict[str, Any]) -> Config:
             if f.name not in dd:
                 continue
             v = dd[f.name]
-            if f.name in _NESTED and isinstance(v, dict):
-                v = resolve(_NESTED[f.name], v, prefix=f"{prefix}{f.name}.")
+            nested = _nested_dataclass(cls, f.name)
+            if nested is not None and isinstance(v, dict):
+                v = resolve(nested, v, prefix=f"{prefix}{f.name}.")
             elif isinstance(v, list):
                 v = tuple(v)
             kwargs[f.name] = v
@@ -216,8 +219,10 @@ def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
         key, _, raw = ov.partition("=")
         try:
             val = json.loads(raw)
+            parsed_json = True
         except json.JSONDecodeError:
             val = raw
+            parsed_json = False
         node = d
         parts = key.split(".")
         for p in parts[:-1]:
@@ -227,6 +232,21 @@ def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
         if not isinstance(node, dict) or parts[-1] not in node:
             raise KeyError(f"unknown config key: {key}")
         old = node[parts[-1]]
+        if isinstance(old, dict):
+            if not isinstance(val, dict):
+                raise TypeError(
+                    f"override {key}={raw!r}: {key} is a config section; "
+                    f"override its fields individually or pass a JSON object"
+                )
+            # merge into the section instead of replacing it wholesale,
+            # so unspecified sibling fields keep their configured values
+            node[parts[-1]] = {**old, **val}
+            continue
+        if old is None and not parsed_json:
+            raise TypeError(
+                f"override {key}={raw!r} is not valid JSON; quote strings "
+                f'explicitly (e.g. {key}=\'"text"\')'
+            )
         if (
             old is not None
             and val is not None

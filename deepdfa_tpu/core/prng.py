@@ -4,22 +4,32 @@ Replaces the reference's global seeding (DDFA/code_gnn/globals.py:14-33
 seed_all + dgl.seed in main_cli.py) with explicit functional JAX keys.
 Host-side (numpy) randomness for sampling/shuffling derives from the same
 integer seed so runs are reproducible end to end.
+
+jax is imported lazily so host-only flows (config parsing, preprocessing)
+don't pay the accelerator-runtime import.
 """
 
 from __future__ import annotations
 
 import hashlib
+from typing import TYPE_CHECKING
 
-import jax
 import numpy as np
 
+if TYPE_CHECKING:  # pragma: no cover
+    import jax
 
-def root_key(seed: int) -> jax.Array:
+
+def root_key(seed: int) -> "jax.Array":
+    import jax
+
     return jax.random.key(seed)
 
 
-def fold_name(key: jax.Array, name: str) -> jax.Array:
+def fold_name(key: "jax.Array", name: str) -> "jax.Array":
     """Derive a named subkey deterministically from a string tag."""
+    import jax
+
     h = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
     return jax.random.fold_in(key, h)
 

@@ -1,0 +1,23 @@
+from deepdfa_tpu.graphs.batch import (
+    NUM_SUBKEY_FEATS,
+    BudgetExceeded,
+    GraphBatch,
+    GraphSpec,
+    bucket_batches,
+    pack,
+    pack_shards,
+)
+from deepdfa_tpu.graphs.store import GraphStore, load_shard, save_shard
+
+__all__ = [
+    "NUM_SUBKEY_FEATS",
+    "BudgetExceeded",
+    "GraphBatch",
+    "GraphSpec",
+    "bucket_batches",
+    "pack",
+    "pack_shards",
+    "GraphStore",
+    "load_shard",
+    "save_shard",
+]

@@ -1,0 +1,226 @@
+"""Static-shape padded graph batches — the TPU replacement for `dgl.batch`.
+
+The reference batches variable-size CFGs dynamically with DGL
+(DDFA/sastvd/linevd/datamodule.py GraphDataLoader -> dgl.batch, backed by
+DGL's C++/CUDA kernels). XLA wants static shapes, so a batch here is a fixed
+budget of graphs/nodes/edges with padding masks:
+
+- `node_graph` maps every node slot to its graph segment; padding slots map
+  to segment `num_graphs` (one dummy segment sliced off after pooling).
+- padded edge slots carry (0, 0) endpoints and a False mask; message
+  passing multiplies messages by the mask so they contribute zeros.
+- self-loop edges are added for every real node, matching the reference's
+  graph construction (DDFA/sastvd/scripts/dbize_graphs.py:25 add_self_loop).
+
+All arrays are numpy on the host and become device arrays when a batch is
+put on the mesh; the pytree is jit/pjit-transparent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence
+
+import jax
+import numpy as np
+
+NUM_SUBKEY_FEATS = 4  # api, datatype, literal, operator
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphSpec:
+    """One host-side graph: ragged arrays, pre-batching."""
+
+    graph_id: int
+    node_feats: np.ndarray  # [n, NUM_SUBKEY_FEATS] int32 vocab indices
+    node_vuln: np.ndarray  # [n] int32 per-statement vulnerability label
+    edge_src: np.ndarray  # [e] int32 (CFG edges, no self loops)
+    edge_dst: np.ndarray  # [e] int32
+    label: float  # graph-level label (max over node_vuln in reference)
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.node_feats.shape[0])
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.edge_src.shape[0])
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GraphBatch:
+    """Fixed-budget batched graphs (padded; device-ready pytree)."""
+
+    node_feats: jax.Array  # [N, K] int32
+    node_vuln: jax.Array  # [N] int32
+    node_graph: jax.Array  # [N] int32 segment ids; padding -> num_graphs
+    node_mask: jax.Array  # [N] bool
+    edge_src: jax.Array  # [E] int32
+    edge_dst: jax.Array  # [E] int32
+    edge_mask: jax.Array  # [E] bool
+    graph_label: jax.Array  # [G] float32
+    graph_mask: jax.Array  # [G] bool
+    graph_ids: jax.Array  # [G] int32 original example ids (-1 padding)
+    num_graphs: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def node_budget(self) -> int:
+        return self.node_feats.shape[0]
+
+    @property
+    def edge_budget(self) -> int:
+        return self.edge_src.shape[0]
+
+
+class BudgetExceeded(ValueError):
+    pass
+
+
+def pack(
+    graphs: Sequence[GraphSpec],
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    add_self_loops: bool = True,
+) -> GraphBatch:
+    """Pack host graphs into one padded batch (numpy arrays).
+
+    Raises BudgetExceeded when the graphs do not fit; callers either bucket
+    by size or drop oversized examples before packing.
+    """
+    if len(graphs) > num_graphs:
+        raise BudgetExceeded(f"{len(graphs)} graphs > budget {num_graphs}")
+    n_tot = sum(g.num_nodes for g in graphs)
+    e_tot = sum(g.num_edges for g in graphs) + (n_tot if add_self_loops else 0)
+    if n_tot > node_budget:
+        raise BudgetExceeded(f"{n_tot} nodes > budget {node_budget}")
+    if e_tot > edge_budget:
+        raise BudgetExceeded(f"{e_tot} edges > budget {edge_budget}")
+
+    node_feats = np.zeros((node_budget, NUM_SUBKEY_FEATS), np.int32)
+    node_vuln = np.zeros((node_budget,), np.int32)
+    node_graph = np.full((node_budget,), num_graphs, np.int32)
+    node_mask = np.zeros((node_budget,), bool)
+    edge_src = np.zeros((edge_budget,), np.int32)
+    edge_dst = np.zeros((edge_budget,), np.int32)
+    edge_mask = np.zeros((edge_budget,), bool)
+    graph_label = np.zeros((num_graphs,), np.float32)
+    graph_mask = np.zeros((num_graphs,), bool)
+    graph_ids = np.full((num_graphs,), -1, np.int32)
+
+    n_off = 0
+    e_off = 0
+    for gi, g in enumerate(graphs):
+        n, e = g.num_nodes, g.num_edges
+        node_feats[n_off : n_off + n] = g.node_feats
+        node_vuln[n_off : n_off + n] = g.node_vuln
+        node_graph[n_off : n_off + n] = gi
+        node_mask[n_off : n_off + n] = True
+        edge_src[e_off : e_off + e] = g.edge_src + n_off
+        edge_dst[e_off : e_off + e] = g.edge_dst + n_off
+        edge_mask[e_off : e_off + e] = True
+        e_off += e
+        if add_self_loops:
+            loop = np.arange(n_off, n_off + n, dtype=np.int32)
+            edge_src[e_off : e_off + n] = loop
+            edge_dst[e_off : e_off + n] = loop
+            edge_mask[e_off : e_off + n] = True
+            e_off += n
+        graph_label[gi] = g.label
+        graph_mask[gi] = True
+        graph_ids[gi] = g.graph_id
+        n_off += n
+
+    return GraphBatch(
+        node_feats=node_feats,
+        node_vuln=node_vuln,
+        node_graph=node_graph,
+        node_mask=node_mask,
+        edge_src=edge_src,
+        edge_dst=edge_dst,
+        edge_mask=edge_mask,
+        graph_label=graph_label,
+        graph_mask=graph_mask,
+        graph_ids=graph_ids,
+        num_graphs=num_graphs,
+    )
+
+
+def pack_shards(
+    graphs: Sequence[GraphSpec],
+    num_shards: int,
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    add_self_loops: bool = True,
+) -> GraphBatch:
+    """Pack into `num_shards` equal static-shape shards, stacked on axis 0.
+
+    The leading axis is the data-parallel axis: shard i holds whole graphs,
+    so segment reductions never cross shard boundaries and XLA only inserts
+    collectives for the gradient all-reduce. Graphs are dealt round-robin by
+    descending node count (greedy balance).
+    """
+    per_shard: list[list[GraphSpec]] = [[] for _ in range(num_shards)]
+    loads = np.zeros(num_shards, np.int64)
+    counts = np.zeros(num_shards, np.int64)
+    for g in sorted(graphs, key=lambda g: -g.num_nodes):
+        order = np.argsort(loads, kind="stable")
+        placed = False
+        for s in order:
+            if counts[s] < num_graphs:
+                per_shard[int(s)].append(g)
+                loads[int(s)] += g.num_nodes
+                counts[int(s)] += 1
+                placed = True
+                break
+        if not placed:
+            raise BudgetExceeded(
+                f"{len(graphs)} graphs > {num_shards} shards x {num_graphs}"
+            )
+    shards = [
+        pack(sg, num_graphs, node_budget, edge_budget, add_self_loops)
+        for sg in per_shard
+    ]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
+    return dataclasses.replace(stacked, num_graphs=num_graphs)
+
+
+def bucket_batches(
+    graphs: Iterable[GraphSpec],
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    drop_oversized: bool = True,
+    add_self_loops: bool = True,
+) -> Iterable[GraphBatch]:
+    """Greedy first-fit packing of a graph stream into fixed-budget batches.
+
+    One (num_graphs, node_budget, edge_budget) signature means one XLA
+    compilation for the whole stream.
+    """
+    cur: list[GraphSpec] = []
+    n_used = 0
+    e_used = 0
+    for g in graphs:
+        e_need = g.num_edges + (g.num_nodes if add_self_loops else 0)
+        if g.num_nodes > node_budget or e_need > edge_budget:
+            if drop_oversized:
+                continue
+            raise BudgetExceeded(
+                f"graph {g.graph_id}: {g.num_nodes} nodes / {e_need} edges "
+                f"exceed budgets ({node_budget}/{edge_budget})"
+            )
+        if (
+            len(cur) == num_graphs
+            or n_used + g.num_nodes > node_budget
+            or e_used + e_need > edge_budget
+        ):
+            yield pack(cur, num_graphs, node_budget, edge_budget, add_self_loops)
+            cur, n_used, e_used = [], 0, 0
+        cur.append(g)
+        n_used += g.num_nodes
+        e_used += e_need
+    if cur:
+        yield pack(cur, num_graphs, node_budget, edge_budget, add_self_loops)

@@ -1,0 +1,102 @@
+"""On-disk graph storage: flat npz shards replacing DGL's `graphs.bin`.
+
+The reference serializes every CFG into one DGL binary file
+(DDFA/sastvd/scripts/dbize_graphs.py:20-33, loaded via
+DDFA/sastvd/linevd/graphmogrifier.py:51-56). Here each dataset split is a
+set of npz shards holding ragged graphs in concatenated form with offset
+tables — memory-mappable, language-neutral, trivially shardable across
+preprocessing workers.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS, GraphSpec
+
+_VERSION = 1
+
+
+def save_shard(path: str | Path, graphs: Sequence[GraphSpec]) -> None:
+    node_counts = np.array([g.num_nodes for g in graphs], np.int64)
+    edge_counts = np.array([g.num_edges for g in graphs], np.int64)
+    np.savez_compressed(
+        path,
+        version=np.int64(_VERSION),
+        graph_ids=np.array([g.graph_id for g in graphs], np.int64),
+        labels=np.array([g.label for g in graphs], np.float32),
+        node_offsets=np.concatenate([[0], np.cumsum(node_counts)]),
+        edge_offsets=np.concatenate([[0], np.cumsum(edge_counts)]),
+        node_feats=(
+            np.concatenate([g.node_feats for g in graphs])
+            if graphs
+            else np.zeros((0, NUM_SUBKEY_FEATS), np.int32)
+        ),
+        node_vuln=(
+            np.concatenate([g.node_vuln for g in graphs])
+            if graphs
+            else np.zeros((0,), np.int32)
+        ),
+        edge_src=(
+            np.concatenate([g.edge_src for g in graphs])
+            if graphs
+            else np.zeros((0,), np.int32)
+        ),
+        edge_dst=(
+            np.concatenate([g.edge_dst for g in graphs])
+            if graphs
+            else np.zeros((0,), np.int32)
+        ),
+    )
+
+
+def load_shard(path: str | Path) -> list[GraphSpec]:
+    with np.load(path) as z:
+        if int(z["version"]) != _VERSION:
+            raise ValueError(f"unsupported shard version {z['version']} at {path}")
+        no, eo = z["node_offsets"], z["edge_offsets"]
+        out = []
+        for i in range(len(z["graph_ids"])):
+            out.append(
+                GraphSpec(
+                    graph_id=int(z["graph_ids"][i]),
+                    node_feats=z["node_feats"][no[i] : no[i + 1]].astype(np.int32),
+                    node_vuln=z["node_vuln"][no[i] : no[i + 1]].astype(np.int32),
+                    edge_src=z["edge_src"][eo[i] : eo[i + 1]].astype(np.int32),
+                    edge_dst=z["edge_dst"][eo[i] : eo[i + 1]].astype(np.int32),
+                    label=float(z["labels"][i]),
+                )
+            )
+        return out
+
+
+class GraphStore:
+    """A directory of npz shards addressable by graph_id."""
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+
+    def shard_paths(self) -> list[Path]:
+        return sorted(self.directory.glob("graphs-*.npz"))
+
+    def write(self, graphs: Sequence[GraphSpec], shard_size: int = 4096) -> int:
+        existing = len(self.shard_paths())
+        n = 0
+        for i in range(0, len(graphs), shard_size):
+            save_shard(
+                self.directory / f"graphs-{existing + n:05d}.npz",
+                graphs[i : i + shard_size],
+            )
+            n += 1
+        return n
+
+    def iter_graphs(self) -> Iterator[GraphSpec]:
+        for p in self.shard_paths():
+            yield from load_shard(p)
+
+    def load_all(self) -> dict[int, GraphSpec]:
+        return {g.graph_id: g for g in self.iter_graphs()}

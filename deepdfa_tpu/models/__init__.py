@@ -1,0 +1,3 @@
+from deepdfa_tpu.models.deepdfa import DeepDFA
+
+__all__ = ["DeepDFA"]

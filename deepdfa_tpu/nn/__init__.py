@@ -1,0 +1,20 @@
+from deepdfa_tpu.nn.embedding import SUBKEY_ORDER, AbstractDataflowEmbedding
+from deepdfa_tpu.nn.gnn import (
+    GatedGraphConv,
+    GlobalAttentionPooling,
+    GRUCell,
+    segment_softmax,
+    segment_sum,
+)
+from deepdfa_tpu.nn.mlp import OutputHead
+
+__all__ = [
+    "SUBKEY_ORDER",
+    "AbstractDataflowEmbedding",
+    "GatedGraphConv",
+    "GlobalAttentionPooling",
+    "GRUCell",
+    "segment_softmax",
+    "segment_sum",
+    "OutputHead",
+]

@@ -1,0 +1,52 @@
+"""Abstract-dataflow embedding tables.
+
+Each CFG node carries up to four vocab indices — one per abstract-dataflow
+subkey (api, datatype, literal, operator). Index scheme (reference:
+DDFA/sastvd/scripts/dbize_absdf.py:35-42): 0 = node is not a definition,
+1 = UNKNOWN hash, 2.. = train-split hash buckets; table size = limit_all + 2.
+
+`concat_all` mirrors the reference's `concat_all_absdf=True` flagship config
+(DDFA/code_gnn/models/flow_gnn/ggnn.py:47-52): one table per subkey,
+embeddings concatenated to 4 * hidden_dim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+SUBKEY_ORDER = ("api", "datatype", "literal", "operator")
+
+
+class AbstractDataflowEmbedding(nn.Module):
+    input_dim: int  # vocab size per table (limit_all + 2)
+    embedding_dim: int  # per-table width (reference hidden_dim = 32)
+    concat_all: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def out_dim(self) -> int:
+        return self.embedding_dim * (len(SUBKEY_ORDER) if self.concat_all else 1)
+
+    @nn.compact
+    def __call__(self, node_feats: jax.Array) -> jax.Array:
+        """node_feats: [N, 4] int32 -> [N, out_dim] embeddings."""
+        if self.concat_all:
+            outs = []
+            for i, name in enumerate(SUBKEY_ORDER):
+                emb = nn.Embed(
+                    self.input_dim,
+                    self.embedding_dim,
+                    name=f"embed_{name}",
+                    param_dtype=self.param_dtype,
+                )
+                outs.append(emb(node_feats[:, i]))
+            return jnp.concatenate(outs, axis=-1)
+        emb = nn.Embed(
+            self.input_dim,
+            self.embedding_dim,
+            name="embed",
+            param_dtype=self.param_dtype,
+        )
+        return emb(node_feats[:, 0])

@@ -1,0 +1,32 @@
+"""Output head: stacked Dense+ReLU ending in a single logit.
+
+Mirrors the reference head (DDFA/code_gnn/models/flow_gnn/ggnn.py:70-80):
+num_output_layers Linear layers with ReLU between, hidden width equal to
+the input width, final layer size 1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class OutputHead(nn.Module):
+    num_layers: int
+    out_features: int = 1
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        width = x.shape[-1]
+        for i in range(self.num_layers):
+            last = i == self.num_layers - 1
+            x = nn.Dense(
+                self.out_features if last else width,
+                name=f"dense_{i}",
+                param_dtype=self.param_dtype,
+            )(x)
+            if not last:
+                x = jax.nn.relu(x)
+        return x

@@ -1,0 +1,17 @@
+from deepdfa_tpu.parallel.mesh import (
+    AXES,
+    dp_sharding,
+    make_mesh,
+    put_dp,
+    put_replicated,
+    replicated,
+)
+
+__all__ = [
+    "AXES",
+    "dp_sharding",
+    "make_mesh",
+    "put_dp",
+    "put_replicated",
+    "replicated",
+]

@@ -1,0 +1,60 @@
+"""Checkpointing: orbax pytrees + best-metric selection.
+
+Replaces Lightning's ModelCheckpoint/PeriodicModelCheckpoint
+(DDFA/configs/config_default.yaml:23-29, DDFA/code_gnn/periodic_checkpoint.py)
+and the manual torch.save best-F1 scheme (LineVul/linevul/linevul_main.py:
+225-251). Best selection is recorded in a json manifest instead of being
+parsed back out of filenames (reference main_cli.py:175-183).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | Path, monitor: str = "val_loss", mode: str = "min"):
+        self.directory = Path(directory).resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.monitor = monitor
+        self.mode = mode
+        self._ckpt = ocp.StandardCheckpointer()
+        self._manifest_path = self.directory / "manifest.json"
+        self._manifest: dict[str, Any] = {"best": None, "last": None, "history": []}
+        if self._manifest_path.exists():
+            self._manifest = json.loads(self._manifest_path.read_text())
+
+    def _is_better(self, value: float) -> bool:
+        best = self._manifest["best"]
+        if best is None:
+            return True
+        prev = best["metrics"][self.monitor]
+        return value < prev if self.mode == "min" else value > prev
+
+    def save(self, tag: str, state: Any, metrics: dict[str, float], step: int) -> bool:
+        """Save under `tag`; update best/last pointers. Returns is_best."""
+        path = self.directory / tag
+        self._ckpt.save(path, state, force=True)
+        entry = {"tag": tag, "step": step, "metrics": metrics}
+        self._manifest["history"].append(entry)
+        self._manifest["last"] = entry
+        is_best = self.monitor in metrics and self._is_better(metrics[self.monitor])
+        if is_best:
+            best_path = self.directory / "best"
+            self._ckpt.save(best_path, state, force=True)
+            self._manifest["best"] = entry
+        self._manifest_path.write_text(json.dumps(self._manifest, indent=2))
+        return is_best
+
+    def restore(self, tag: str, target: Any) -> Any:
+        """Restore into the structure of `target` (an abstract or concrete
+        pytree of the same shape)."""
+        return self._ckpt.restore(self.directory / tag, target=target)
+
+    def best_metrics(self) -> dict[str, float] | None:
+        best = self._manifest["best"]
+        return None if best is None else dict(best["metrics"])

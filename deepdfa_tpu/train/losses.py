@@ -1,0 +1,67 @@
+"""Losses and label extraction for graph batches.
+
+Reference semantics (DDFA/code_gnn/models/base_module.py):
+- label styles (get_label, base_module.py:83-95): "graph" = max over the
+  batch-graph's node _VULN labels; "node" = per-node labels.
+- loss = BCEWithLogitsLoss with optional pos_weight
+  (base_module.py:74, datamodule.py:98-108 positive_weight).
+
+All reductions are masked means over the valid (non-padding) slots so the
+padded static shapes never bias the loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.nn.gnn import segment_max
+
+
+def graph_labels(batch: GraphBatch) -> jax.Array:
+    """Graph-level labels: max of node vuln per graph (padding-safe)."""
+    vuln = jnp.where(batch.node_mask, batch.node_vuln, 0)
+    per_graph = segment_max(vuln, batch.node_graph, batch.num_graphs + 1)[
+        : batch.num_graphs
+    ]
+    return jnp.maximum(per_graph, 0).astype(jnp.float32)
+
+
+def node_labels(batch: GraphBatch) -> jax.Array:
+    return batch.node_vuln.astype(jnp.float32)
+
+
+def bce_with_logits(
+    logits: jax.Array,
+    labels: jax.Array,
+    mask: jax.Array,
+    pos_weight: float | jax.Array = 1.0,
+) -> jax.Array:
+    """Masked mean binary cross-entropy on logits, torch-compatible.
+
+    loss_i = -[pos_weight * y_i * log sigmoid(x_i) + (1-y_i) * log sigmoid(-x_i)]
+    """
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    per = -(pos_weight * labels * log_p + (1.0 - labels) * log_not_p)
+    mask = mask.astype(per.dtype)
+    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def classifier_loss(
+    logits: jax.Array,
+    batch: GraphBatch,
+    label_style: str = "graph",
+    pos_weight: float | jax.Array = 1.0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (loss, labels, mask) for the configured label style."""
+    if label_style == "graph":
+        labels = graph_labels(batch)
+        mask = batch.graph_mask
+    elif label_style == "node":
+        labels = node_labels(batch)
+        mask = batch.node_mask
+    else:
+        raise ValueError(f"unsupported label_style: {label_style}")
+    return bce_with_logits(logits, labels, mask, pos_weight), labels, mask

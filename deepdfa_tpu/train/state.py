@@ -1,0 +1,67 @@
+"""Train state + optimizer construction.
+
+Reference optimizer: Adam lr 1e-3, weight_decay 1e-2
+(DDFA/configs/config_default.yaml:43-47 — torch Adam's weight_decay is L2
+into the gradient; optax.adamw's decoupled decay is the idiomatic JAX
+equivalent and trains at least as well). The transformer paths use AdamW
+with linear warmup + clip (LineVul/linevul/linevul_main.py:150-162), which
+maps to the same factory with warmup_frac/grad_clip_norm set.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+from flax import struct
+
+from deepdfa_tpu.core.config import OptimConfig
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+
+
+def make_optimizer(cfg: OptimConfig, total_steps: int | None = None) -> optax.GradientTransformation:
+    if cfg.warmup_frac > 0.0:
+        if not total_steps:
+            raise ValueError("warmup_frac requires total_steps")
+        warmup = max(1, int(total_steps * cfg.warmup_frac))
+        schedule = optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, cfg.learning_rate, warmup),
+                optax.linear_schedule(
+                    cfg.learning_rate, 0.0, max(1, total_steps - warmup)
+                ),
+            ],
+            boundaries=[warmup],
+        )
+    else:
+        schedule = cfg.learning_rate
+
+    parts = []
+    if cfg.grad_clip_norm > 0.0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.name == "adamw":
+        parts.append(
+            optax.adamw(
+                schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay
+            )
+        )
+    elif cfg.name == "adam":
+        parts.append(optax.adam(schedule, b1=cfg.b1, b2=cfg.b2))
+    elif cfg.name == "sgd":
+        parts.append(optax.sgd(schedule))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name}")
+    return optax.chain(*parts)

@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+from deepdfa_tpu.graphs import (
+    BudgetExceeded,
+    GraphSpec,
+    GraphStore,
+    bucket_batches,
+    pack,
+    pack_shards,
+)
+
+
+def make_graph(rng, gid, n, e, label=0.0):
+    return GraphSpec(
+        graph_id=gid,
+        node_feats=rng.integers(0, 100, (n, 4)).astype(np.int32),
+        node_vuln=rng.integers(0, 2, (n,)).astype(np.int32),
+        edge_src=rng.integers(0, n, (e,)).astype(np.int32),
+        edge_dst=rng.integers(0, n, (e,)).astype(np.int32),
+        label=label,
+    )
+
+
+def test_pack_shapes_and_masks(rng):
+    gs = [make_graph(rng, i, 5 + i, 8, label=float(i % 2)) for i in range(3)]
+    b = pack(gs, num_graphs=4, node_budget=32, edge_budget=64)
+    assert b.node_feats.shape == (32, 4)
+    assert b.edge_src.shape == (64,)
+    assert b.graph_label.shape == (4,)
+    n_tot = sum(g.num_nodes for g in gs)
+    e_tot = sum(g.num_edges for g in gs) + n_tot  # self loops
+    assert b.node_mask.sum() == n_tot
+    assert b.edge_mask.sum() == e_tot
+    assert b.graph_mask.tolist() == [True, True, True, False]
+    # padding nodes map to the dummy segment
+    assert (np.asarray(b.node_graph)[n_tot:] == 4).all()
+    # per-node segment ids count each graph's nodes
+    for i, g in enumerate(gs):
+        assert (np.asarray(b.node_graph) == i).sum() == g.num_nodes
+    # self loops present: last e_tot section has src == dst
+    src, dst, em = map(np.asarray, (b.edge_src, b.edge_dst, b.edge_mask))
+    loops = (src == dst) & em
+    assert loops.sum() >= n_tot
+
+
+def test_pack_budget_errors(rng):
+    gs = [make_graph(rng, 0, 100, 10)]
+    with pytest.raises(BudgetExceeded):
+        pack(gs, num_graphs=1, node_budget=50, edge_budget=500)
+    with pytest.raises(BudgetExceeded):
+        pack(gs, num_graphs=1, node_budget=500, edge_budget=50)
+
+
+def test_bucket_batches_covers_all(rng):
+    gs = [make_graph(rng, i, int(rng.integers(3, 40)), 10) for i in range(50)]
+    batches = list(
+        bucket_batches(gs, num_graphs=8, node_budget=128, edge_budget=512)
+    )
+    ids = [i for b in batches for i in np.asarray(b.graph_ids).tolist() if i >= 0]
+    assert sorted(ids) == list(range(50))
+    for b in batches:
+        assert b.node_feats.shape == (128, 4)
+
+
+def test_bucket_batches_drops_oversized(rng):
+    gs = [make_graph(rng, 0, 1000, 10), make_graph(rng, 1, 5, 4)]
+    batches = list(
+        bucket_batches(gs, num_graphs=4, node_budget=64, edge_budget=256)
+    )
+    ids = [i for b in batches for i in np.asarray(b.graph_ids).tolist() if i >= 0]
+    assert ids == [1]
+    with pytest.raises(BudgetExceeded):
+        list(
+            bucket_batches(
+                gs, num_graphs=4, node_budget=64, edge_budget=256,
+                drop_oversized=False,
+            )
+        )
+
+
+def test_pack_shards_stacks_and_balances(rng):
+    gs = [make_graph(rng, i, int(rng.integers(3, 30)), 8) for i in range(16)]
+    b = pack_shards(gs, num_shards=4, num_graphs=8, node_budget=128, edge_budget=512)
+    assert b.node_feats.shape == (4, 128, 4)
+    assert b.graph_label.shape == (4, 8)
+    ids = np.asarray(b.graph_ids)
+    assert sorted(i for i in ids.flatten().tolist() if i >= 0) == list(range(16))
+    # edges in each shard index into that shard's local node space
+    assert np.asarray(b.edge_src).max() < 128
+
+
+def test_store_roundtrip(tmp_path, rng):
+    gs = [make_graph(rng, i, int(rng.integers(1, 20)), 6, float(i % 2)) for i in range(25)]
+    store = GraphStore(tmp_path / "graphs")
+    nshards = store.write(gs, shard_size=10)
+    assert nshards == 3
+    back = store.load_all()
+    assert set(back) == set(range(25))
+    for g in gs:
+        g2 = back[g.graph_id]
+        np.testing.assert_array_equal(g.node_feats, g2.node_feats)
+        np.testing.assert_array_equal(g.edge_src, g2.edge_src)
+        assert g.label == g2.label
+
+
+def test_batch_is_pytree(rng):
+    import jax
+
+    gs = [make_graph(rng, i, 5, 5) for i in range(2)]
+    b = pack(gs, num_graphs=2, node_budget=16, edge_budget=32)
+    leaves = jax.tree.leaves(b)
+    assert len(leaves) == 10
+    # static field survives tree.map
+    b2 = jax.tree.map(lambda x: x, b)
+    assert b2.num_graphs == 2

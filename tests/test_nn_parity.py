@@ -1,0 +1,154 @@
+"""Numerical parity of the JAX GGNN against the reference semantics.
+
+DGL's GatedGraphConv is: per step, a_v = sum_{(u,v)} W h_u followed by
+h_v = torch.nn.GRUCell(a_v, h_v); GlobalAttentionPooling is a per-graph
+softmax of gate_nn(h) times h (SURVEY.md §2.1 GGNN model row). DGL itself is
+not installable here, so the oracle below implements exactly those equations
+with torch (whose GRUCell is the one DGL calls), on unpadded graphs, and we
+check the padded static-shape JAX path reproduces it to float32 tolerance.
+"""
+
+import numpy as np
+import torch
+
+from deepdfa_tpu.graphs import GraphSpec, pack
+from deepdfa_tpu.nn import GatedGraphConv, GlobalAttentionPooling, GRUCell
+
+
+def torch_ggc_reference(h0, src, dst, W, b, gru: torch.nn.GRUCell, n_steps):
+    """DGL GatedGraphConv semantics on one unpadded graph."""
+    h = h0.clone()
+    n = h.shape[0]
+    for _ in range(n_steps):
+        m = h @ W.T + b
+        a = torch.zeros_like(h)
+        a.index_add_(0, dst, m[src])
+        h = gru(a, h)
+    return h
+
+
+def test_grucell_matches_torch(rng):
+    import jax
+
+    d = 16
+    cell = GRUCell(d)
+    x = rng.standard_normal((7, d)).astype(np.float32)
+    h = rng.standard_normal((7, d)).astype(np.float32)
+    params = cell.init(jax.random.key(0), x, h)
+
+    tcell = torch.nn.GRUCell(d, d)
+    # copy flax params into torch: flax kernel [in, 3D] -> torch weight [3D, in]
+    with torch.no_grad():
+        tcell.weight_ih.copy_(
+            torch.tensor(np.asarray(params["params"]["input_proj"]["kernel"]).T)
+        )
+        tcell.weight_hh.copy_(
+            torch.tensor(np.asarray(params["params"]["hidden_proj"]["kernel"]).T)
+        )
+        tcell.bias_ih.copy_(
+            torch.tensor(np.asarray(params["params"]["input_proj"]["bias"]))
+        )
+        tcell.bias_hh.copy_(
+            torch.tensor(np.asarray(params["params"]["hidden_proj"]["bias"]))
+        )
+        want = tcell(torch.tensor(x), torch.tensor(h)).numpy()
+    got = np.asarray(cell.apply(params, x, h))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_gated_graph_conv_matches_reference(rng):
+    import jax
+
+    d, n_steps = 8, 5
+    graphs = []
+    for gid in range(3):
+        n = int(rng.integers(3, 12))
+        e = int(rng.integers(2, 3 * n))
+        graphs.append(
+            GraphSpec(
+                graph_id=gid,
+                node_feats=rng.integers(0, 5, (n, 4)).astype(np.int32),
+                node_vuln=np.zeros((n,), np.int32),
+                edge_src=rng.integers(0, n, (e,)).astype(np.int32),
+                edge_dst=rng.integers(0, n, (e,)).astype(np.int32),
+                label=0.0,
+            )
+        )
+    batch = pack(graphs, num_graphs=4, node_budget=64, edge_budget=256)
+
+    feats = rng.standard_normal((64, d)).astype(np.float32)
+    conv = GatedGraphConv(out_features=d, n_steps=n_steps)
+    params = conv.init(jax.random.key(1), batch, feats)
+    got = np.asarray(conv.apply(params, batch, feats))
+
+    p = params["params"]
+    W = torch.tensor(np.asarray(p["etype_0"]["kernel"]).T)
+    b = torch.tensor(np.asarray(p["etype_0"]["bias"]))
+    gru = torch.nn.GRUCell(d, d)
+    with torch.no_grad():
+        gru.weight_ih.copy_(torch.tensor(np.asarray(p["GRUCell_0"]["input_proj"]["kernel"]).T))
+        gru.weight_hh.copy_(torch.tensor(np.asarray(p["GRUCell_0"]["hidden_proj"]["kernel"]).T))
+        gru.bias_ih.copy_(torch.tensor(np.asarray(p["GRUCell_0"]["input_proj"]["bias"])))
+        gru.bias_hh.copy_(torch.tensor(np.asarray(p["GRUCell_0"]["hidden_proj"]["bias"])))
+
+        # run the oracle per graph on unpadded arrays WITH self loops,
+        # mirroring the reference's add_self_loop at graph build time
+        off = 0
+        for g in graphs:
+            n = g.num_nodes
+            src = np.concatenate([g.edge_src, np.arange(n)])
+            dst = np.concatenate([g.edge_dst, np.arange(n)])
+            want = torch_ggc_reference(
+                torch.tensor(feats[off : off + n]),
+                torch.tensor(src),
+                torch.tensor(dst),
+                W,
+                b,
+                gru,
+                n_steps,
+            ).numpy()
+            np.testing.assert_allclose(
+                got[off : off + n], want, rtol=2e-4, atol=2e-5
+            )
+            off += n
+
+
+def test_attention_pooling_matches_reference(rng):
+    import jax
+
+    d = 8
+    graphs = []
+    for gid in range(3):
+        n = int(rng.integers(2, 10))
+        graphs.append(
+            GraphSpec(
+                graph_id=gid,
+                node_feats=np.zeros((n, 4), np.int32),
+                node_vuln=np.zeros((n,), np.int32),
+                edge_src=np.zeros((0,), np.int32),
+                edge_dst=np.zeros((0,), np.int32),
+                label=0.0,
+            )
+        )
+    batch = pack(graphs, num_graphs=4, node_budget=32, edge_budget=64)
+    feats = rng.standard_normal((32, d)).astype(np.float32)
+
+    pool = GlobalAttentionPooling()
+    params = pool.init(jax.random.key(2), batch, feats)
+    got = np.asarray(pool.apply(params, batch, feats))
+    assert got.shape == (4, d)
+
+    W = np.asarray(params["params"]["gate_nn"]["kernel"])
+    b = np.asarray(params["params"]["gate_nn"]["bias"])
+    off = 0
+    for gi, g in enumerate(graphs):
+        n = g.num_nodes
+        f = feats[off : off + n]
+        gate = f @ W + b
+        attn = np.exp(gate - gate.max())
+        attn = attn / attn.sum()
+        want = (attn * f).sum(axis=0)
+        np.testing.assert_allclose(got[gi], want, rtol=1e-5, atol=1e-6)
+        off += n
+    # padded graph slot pools to zero
+    np.testing.assert_allclose(got[3], 0.0, atol=1e-6)
