@@ -1,0 +1,25 @@
+from deepdfa_tpu.data.diffs import diff_lines, vulnerable_lines
+from deepdfa_tpu.data.pipeline import (
+    Example,
+    ExtractedGraph,
+    build_dataset,
+    extract_corpus,
+    extract_graph,
+    to_graph_spec,
+)
+from deepdfa_tpu.data.synthetic import SynthExample, generate, split_ids, to_examples
+
+__all__ = [
+    "diff_lines",
+    "vulnerable_lines",
+    "Example",
+    "ExtractedGraph",
+    "build_dataset",
+    "extract_corpus",
+    "extract_graph",
+    "to_graph_spec",
+    "SynthExample",
+    "generate",
+    "split_ids",
+    "to_examples",
+]

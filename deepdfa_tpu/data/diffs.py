@@ -1,0 +1,57 @@
+"""Changed-line labeling from before/after function pairs.
+
+The reference shells out to `git diff --no-index` per example and parses
+hunk headers (DDFA/sastvd/helpers/git.py:12-165) to get added/removed line
+numbers; statement labels are then "removed lines + lines data/control
+dependent on added lines" (evaluate.py:194-236). Here the diff is computed
+in-process with difflib (same line-level semantics, no subprocess per
+example), and the dependency closure runs on the CPG built by our frontend.
+"""
+
+from __future__ import annotations
+
+import difflib
+
+
+def diff_lines(before: str, after: str) -> tuple[set[int], set[int]]:
+    """(removed_lines_in_before, added_lines_in_after), 1-based."""
+    b = before.splitlines()
+    a = after.splitlines()
+    removed: set[int] = set()
+    added: set[int] = set()
+    sm = difflib.SequenceMatcher(a=b, b=a, autojunk=False)
+    for tag, i1, i2, j1, j2 in sm.get_opcodes():
+        if tag in ("replace", "delete"):
+            removed.update(range(i1 + 1, i2 + 1))
+        if tag in ("replace", "insert"):
+            added.update(range(j1 + 1, j2 + 1))
+    return removed, added
+
+
+def guarded_lines(before: str, after: str) -> set[int]:
+    """Before-lines immediately following a pure insertion point.
+
+    When a fix only *adds* lines (e.g. inserting a null/bounds check), the
+    vulnerable statement is the one the insertion guards — the first
+    before-line after the insertion point. This is the cheap first-order
+    version of the reference's 'lines dependent on added lines' closure
+    (evaluate.py:194-236); the full CPG-based dependency closure is in
+    eval/statements.py.
+    """
+    b = before.splitlines()
+    a = after.splitlines()
+    sm = difflib.SequenceMatcher(a=b, b=a, autojunk=False)
+    out: set[int] = set()
+    for tag, i1, i2, j1, j2 in sm.get_opcodes():
+        if tag == "insert" and i1 < len(b):
+            out.add(i1 + 1)
+    return out
+
+
+def vulnerable_lines(before: str, after: str) -> set[int]:
+    """Line labels for the *before* version: removed/changed lines plus
+    lines guarded by pure insertions."""
+    removed, added = diff_lines(before, after)
+    if removed:
+        return removed
+    return guarded_lines(before, after)

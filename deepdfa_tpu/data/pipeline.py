@@ -1,0 +1,187 @@
+"""End-to-end preprocessing: C source -> model-ready GraphSpec.
+
+Mirrors the reference pipeline stages (DDFA/scripts/preprocess.sh):
+  prepare (clean + line labels) -> getgraphs (CPG extraction) ->
+  dbize (node/edge tables) -> abstract_dataflow (stage 1+2) ->
+  dbize_absdf (vocab indexing)
+but runs hermetically on the built-in frontend, in-process, with
+multiprocessing fan-out for corpus-scale extraction.
+
+The model graph is the reference's: CPG nodes that carry a line number and
+participate in CFG edges, reindexed densely (feature_extraction,
+DDFA/sastvd/linevd/utils.py:28-76 with graph_type="cfg"); per-node vuln
+labels come from changed-line sets (dbize.py:35-50); self-loops are added
+at batch time (dbize_graphs.py:25).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from multiprocessing import Pool
+from typing import Callable, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.frontend import (
+    absdf,
+    parser as cparser,
+)
+from deepdfa_tpu.frontend.cpg import CFG, Cpg
+from deepdfa_tpu.frontend.vocab import AbsDfVocab, Fields, build_vocabs
+from deepdfa_tpu.graphs.batch import GraphSpec
+from deepdfa_tpu.nn.embedding import SUBKEY_ORDER
+
+
+@dataclasses.dataclass
+class ExtractedGraph:
+    """Host-side intermediate: one function's model graph + features."""
+
+    graph_id: int
+    node_lines: np.ndarray  # [n] int32 source line per node
+    edge_src: np.ndarray  # [e] int32 (CFG, no self loops)
+    edge_dst: np.ndarray
+    def_fields: dict[int, Fields]  # dense node idx -> stage-1 fields
+    label: float  # function-level label
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.node_lines.shape[0])
+
+
+def extract_graph(
+    code: str,
+    graph_id: int,
+    vuln_lines: set[int] | None = None,
+    label: float | None = None,
+) -> ExtractedGraph | None:
+    """Parse one function and build its model graph. None on failure or
+    empty CFG (reference behavior: failures are skipped and logged,
+    getgraphs.py:57-59)."""
+    try:
+        cpg = cparser.parse_function(code)
+    except ValueError:
+        return None
+
+    keep = [
+        nid
+        for nid in cpg.cfg_nodes()
+        if cpg.nodes[nid].line is not None
+    ]
+    if not keep:
+        return None
+    dense = {nid: i for i, nid in enumerate(keep)}
+    keep_set = set(keep)
+
+    node_lines = np.array([cpg.nodes[nid].line for nid in keep], np.int32)
+    src, dst = [], []
+    for s, d, t in cpg.edges:
+        if t == CFG and s in keep_set and d in keep_set:
+            src.append(dense[s])
+            dst.append(dense[d])
+    def_fields: dict[int, Fields] = {}
+    for nid in keep:
+        if absdf.is_decl(cpg, nid):
+            fields = absdf.decl_features(cpg, nid)
+            if fields:
+                def_fields[dense[nid]] = fields
+
+    if label is None:
+        label = (
+            1.0
+            if vuln_lines and any(int(l) in vuln_lines for l in node_lines)
+            else 0.0
+        )
+    return ExtractedGraph(
+        graph_id=graph_id,
+        node_lines=node_lines,
+        edge_src=np.array(src, np.int32),
+        edge_dst=np.array(dst, np.int32),
+        def_fields=def_fields,
+        label=float(label),
+    )
+
+
+def to_graph_spec(
+    eg: ExtractedGraph,
+    vocabs: Mapping[str, AbsDfVocab],
+    vuln_lines: set[int] | None = None,
+) -> GraphSpec:
+    """Encode features through the vocab and emit the batchable GraphSpec."""
+    n = eg.num_nodes
+    feats = np.zeros((n, len(SUBKEY_ORDER)), np.int32)
+    for i in range(n):
+        fields = eg.def_fields.get(i)
+        for j, sk in enumerate(SUBKEY_ORDER):
+            feats[i, j] = vocabs[sk].encode(fields)
+    if vuln_lines:
+        vuln = np.array(
+            [1 if int(l) in vuln_lines else 0 for l in eg.node_lines], np.int32
+        )
+    else:
+        vuln = np.zeros((n,), np.int32)
+        if eg.label > 0:
+            vuln[:] = 0  # graph label carried separately
+    return GraphSpec(
+        graph_id=eg.graph_id,
+        node_feats=feats,
+        node_vuln=vuln,
+        edge_src=eg.edge_src,
+        edge_dst=eg.edge_dst,
+        label=eg.label,
+    )
+
+
+@dataclasses.dataclass
+class Example:
+    """One dataset row (reference schema: id, code, vul label, changed lines)."""
+
+    id: int
+    code: str
+    label: float | None = None
+    vuln_lines: frozenset[int] = frozenset()
+
+
+def _extract_one(ex: Example) -> ExtractedGraph | None:
+    return extract_graph(
+        ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label
+    )
+
+
+def extract_corpus(
+    examples: Sequence[Example], workers: int = 0
+) -> list[ExtractedGraph]:
+    """Stage getgraphs+absdf-stage-1 over a corpus (mp fan-out like the
+    reference's dfmp, sastvd/__init__.py:198-244)."""
+    if workers and workers > 1:
+        with Pool(workers) as pool:
+            out = pool.map(_extract_one, examples, chunksize=64)
+    else:
+        out = [_extract_one(ex) for ex in examples]
+    return [g for g in out if g is not None]
+
+
+def build_dataset(
+    examples: Sequence[Example],
+    train_ids: Iterable[int],
+    limit_all: int | None = 1000,
+    limit_subkeys: int | None = 1000,
+    workers: int = 0,
+) -> tuple[list[GraphSpec], dict[str, AbsDfVocab]]:
+    """Full pipeline: extract, build train-split vocabs, encode everything."""
+    graphs = extract_corpus(examples, workers=workers)
+    train = set(train_ids)
+    train_fields = [
+        f
+        for g in graphs
+        if g.graph_id in train
+        for f in g.def_fields.values()
+    ]
+    vocabs = build_vocabs(
+        train_fields, SUBKEY_ORDER, limit_all=limit_all, limit_subkeys=limit_subkeys
+    )
+    by_id = {ex.id: ex for ex in examples}
+    specs = [
+        to_graph_spec(g, vocabs, set(by_id[g.graph_id].vuln_lines) or None)
+        for g in graphs
+    ]
+    return specs, vocabs

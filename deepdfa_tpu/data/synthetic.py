@@ -1,0 +1,168 @@
+"""Synthetic Big-Vul-style corpus generator.
+
+The real Big-Vul/MSR CSV (188k C/C++ functions, ~45GB with artifacts) is an
+external download; this generator produces structurally similar
+(function, fixed-function, changed-lines, label) rows so every pipeline
+stage — parsing, CFG, reaching defs, abstract-dataflow vocab, batching,
+training — runs hermetically at any scale. Vulnerable variants inject the
+classic C bug families the datasets are built around (unbounded string
+copy, missing bounds/null checks, off-by-one, integer-size truncation);
+the "fix" is the patched form, so diff labels mark the buggy lines exactly
+like the reference's git-diff labeling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from deepdfa_tpu.data.diffs import vulnerable_lines
+from deepdfa_tpu.data.pipeline import Example
+
+_TYPES = ["int", "unsigned int", "size_t", "long", "char", "short"]
+_APIS = ["malloc", "free", "memcpy", "memset", "strlen", "strcpy", "strncpy",
+         "snprintf", "read", "write", "calloc", "realloc"]
+
+
+@dataclasses.dataclass
+class SynthExample:
+    id: int
+    before: str
+    after: str
+    label: int
+    vuln_lines: frozenset[int]
+
+
+def _body_lines(rng: np.random.Generator, n_stmts: int, vulnerable: bool):
+    """Returns (before_lines, after_lines). Lines are function-body lines."""
+    before: list[str] = []
+    after: list[str] = []
+
+    def both(s):
+        before.append(s)
+        after.append(s)
+
+    both("    char buf[64];")
+    both("    int i = 0;")
+    both("    int total = 0;")
+    both(f"    {_TYPES[int(rng.integers(0, len(_TYPES)))]} tmp = 0;")
+
+    # Every bug family plants at least one *definition* statement with a
+    # distinctive abstract-dataflow feature combination (api/datatype/
+    # literal/operator) — DeepDFA's features only live on definition nodes,
+    # which is exactly how the real datasets' vulnerable functions are
+    # recognized (paper §4.1).
+    bug = int(rng.integers(0, 4)) if vulnerable else -1
+    if bug == 0:
+        # unbounded copy: length taken but never clamped
+        before.append("    total = strlen(src) + len;")
+        before.append("    strcpy(buf, src);")
+        after.append("    total = strlen(src);")
+        after.append("    strncpy(buf, src, sizeof(buf) - 1);")
+        after.append("    buf[sizeof(buf) - 1] = 0;")
+    elif bug == 1:
+        # missing bounds check on memcpy with sizeof-scaled length
+        before.append("    tmp = len * sizeof(char);")
+        before.append("    memcpy(buf, src, len);")
+        after.append("    if (len > (int)sizeof(buf)) {")
+        after.append("        len = (int)sizeof(buf);")
+        after.append("    }")
+        after.append("    memcpy(buf, src, len);")
+    elif bug == 2:
+        # off-by-one: index runs to len + 1
+        before.append("    i = len + 1;")
+        before.append("    total += src[i];")
+        after.append("    i = len - 1;")
+        after.append("    if (i >= 0) {")
+        after.append("        total += src[i];")
+        after.append("    }")
+    elif bug == 3:
+        # unchecked malloc deref
+        before.append("    char *p = malloc(len);")
+        before.append("    p[0] = 1;")
+        after.append("    char *p = malloc(len);")
+        after.append("    if (!p) {")
+        after.append("        return -1;")
+        after.append("    }")
+        after.append("    p[0] = 1;")
+        both("    free(p);")
+    # benign filler statements
+    for _ in range(n_stmts):
+        k = int(rng.integers(0, 6))
+        if k == 0:
+            both(f"    tmp = tmp + {int(rng.integers(1, 100))};")
+        elif k == 1:
+            both(f"    total += i * {int(rng.integers(2, 9))};")
+        elif k == 2:
+            both("    if (total > tmp) {")
+            both(f"        tmp = total - {int(rng.integers(1, 10))};")
+            both("    }")
+        elif k == 3:
+            both(f"    while (i < {int(rng.integers(4, 32))}) {{")
+            both("        i++;")
+            both("    }")
+        elif k == 4:
+            api = _APIS[int(rng.integers(0, len(_APIS)))]
+            both(f"    total ^= (int){api}(buf);" if api == "strlen"
+                 else f"    memset(buf, 0, sizeof(buf));")
+        else:
+            both(f"    tmp ^= total >> {int(rng.integers(1, 5))};")
+    both("    return total;")
+    return before, after
+
+
+def generate(
+    n: int,
+    vuln_rate: float = 0.06,
+    seed: int = 0,
+    min_stmts: int = 2,
+    max_stmts: int = 12,
+) -> list[SynthExample]:
+    """Generate `n` examples with the dataset's ~6% positive rate."""
+    rng = np.random.default_rng(seed)
+    out: list[SynthExample] = []
+    for gid in range(n):
+        vulnerable = bool(rng.random() < vuln_rate)
+        n_stmts = int(rng.integers(min_stmts, max_stmts + 1))
+        bl, al = _body_lines(rng, n_stmts, vulnerable)
+        fname = f"fn_{gid}"
+        sig = f"int {fname}(char *src, int len)"
+        before = sig + " {\n" + "\n".join(bl) + "\n}\n"
+        after = sig + " {\n" + "\n".join(al) + "\n}\n"
+        lines = frozenset(vulnerable_lines(before, after)) if vulnerable else frozenset()
+        out.append(
+            SynthExample(
+                id=gid,
+                before=before,
+                after=after,
+                label=int(vulnerable),
+                vuln_lines=lines,
+            )
+        )
+    return out
+
+
+def to_examples(synth: list[SynthExample]) -> list[Example]:
+    return [
+        Example(
+            id=s.id, code=s.before, label=float(s.label), vuln_lines=s.vuln_lines
+        )
+        for s in synth
+    ]
+
+
+def split_ids(
+    n: int, seed: int = 0, train: float = 0.8, val: float = 0.1
+) -> tuple[list[int], list[int], list[int]]:
+    """Random disjoint train/val/test id splits (reference keeps fixed
+    splits in csv; synthetic data splits by seeded permutation)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_train = int(n * train)
+    n_val = int(n * val)
+    return (
+        perm[:n_train].tolist(),
+        perm[n_train : n_train + n_val].tolist(),
+        perm[n_train + n_val :].tolist(),
+    )

@@ -1,0 +1,27 @@
+from deepdfa_tpu.frontend.absdf import (
+    decl_features,
+    graph_features,
+    is_decl,
+    node_hash,
+)
+from deepdfa_tpu.frontend.cpg import Cpg, Node
+from deepdfa_tpu.frontend.parser import ParseError, parse_function
+from deepdfa_tpu.frontend.reaching import Definition, ReachingDefinitions
+from deepdfa_tpu.frontend.vocab import AbsDfVocab, build_vocab, build_vocabs, encode_nodes
+
+__all__ = [
+    "Cpg",
+    "Node",
+    "ParseError",
+    "parse_function",
+    "Definition",
+    "ReachingDefinitions",
+    "decl_features",
+    "graph_features",
+    "is_decl",
+    "node_hash",
+    "AbsDfVocab",
+    "build_vocab",
+    "build_vocabs",
+    "encode_nodes",
+]

@@ -96,6 +96,12 @@ class GatedGraphConv(nn.Module):
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
+        if self.n_etypes != 1:
+            # GraphBatch carries no per-edge type ids yet; silently mixing
+            # all types through every transform would be wrong
+            raise NotImplementedError(
+                "n_etypes > 1 requires edge-type ids on GraphBatch"
+            )
         n = feat.shape[0]
         if feat.shape[-1] > self.out_features:
             raise ValueError(

@@ -1,6 +1,11 @@
 from deepdfa_tpu.train.checkpoint import CheckpointManager
 from deepdfa_tpu.train.loop import GraphTrainer
-from deepdfa_tpu.train.losses import bce_with_logits, classifier_loss, graph_labels
+from deepdfa_tpu.train.losses import (
+    bce_elements,
+    bce_with_logits,
+    classifier_loss,
+    graph_labels,
+)
 from deepdfa_tpu.train.metrics import BinaryClassificationMetrics, classification_report
 from deepdfa_tpu.train.sampler import oversample_epoch, positive_weight, undersample_epoch
 from deepdfa_tpu.train.state import TrainState, make_optimizer
@@ -8,6 +13,7 @@ from deepdfa_tpu.train.state import TrainState, make_optimizer
 __all__ = [
     "CheckpointManager",
     "GraphTrainer",
+    "bce_elements",
     "bce_with_logits",
     "classifier_loss",
     "graph_labels",

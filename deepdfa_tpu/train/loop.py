@@ -3,15 +3,18 @@
 Replaces the reference's Lightning trainer stack
 (DDFA/code_gnn/main_cli.py fit/test, base_module.py train/val/test steps):
 
-- one jit-compiled `train_step` (params, opt_state donated) per static batch
+- one jit-compiled `train_step` (train state donated) per static batch
   signature; the bucketed batcher guarantees a single signature per run.
 - data parallelism is shard_map over the `dp` mesh axis: each device gets a
-  whole-graph shard (leading axis from `pack_shards`), computes local loss
-  and grads, and `psum`s them — the XLA-native equivalent of DDP gradient
-  all-reduce. With a 1-device mesh the same code path compiles to no
-  collectives, so single-chip and multi-chip share one implementation.
-- metrics stream into host-side accumulators; best checkpoint is selected
-  on the monitored metric like the reference's val_loss checkpointing.
+  whole-graph shard (leading axis from `pack_shards`), computes local
+  masked loss *sums* and gradient-of-sum, and `psum`s sums and counts —
+  the global mean is exact even when shards carry unequal graph counts
+  (unlike mean-of-shard-means). With a 1-device mesh the same code path
+  compiles to no collectives, so single-chip and multi-chip share one
+  implementation.
+- metrics stream into host-side accumulators; eval loss is computed on
+  device from logits (identical semantics to the training objective) and
+  accumulated as an exact masked mean across batches.
 """
 
 from __future__ import annotations
@@ -36,11 +39,13 @@ from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.graphs.batch import GraphBatch
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.checkpoint import CheckpointManager
-from deepdfa_tpu.train.losses import classifier_loss
+from deepdfa_tpu.train.losses import bce_elements, classifier_loss, graph_labels, node_labels
 from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
 from deepdfa_tpu.train.state import TrainState, make_optimizer
 
 logger = logging.getLogger(__name__)
+
+_ALL_AXES = ("dp", "tp", "sp")
 
 
 def _squeeze_batch(batch: GraphBatch) -> GraphBatch:
@@ -61,12 +66,14 @@ class GraphTrainer:
         model,
         cfg: Config,
         mesh: Mesh | None = None,
-        pos_weight: float = 1.0,
+        pos_weight: float | None = None,
         total_steps: int | None = None,
     ):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.train.mesh)
+        if pos_weight is None:
+            pos_weight = cfg.train.pos_weight if cfg.train.pos_weight is not None else 1.0
         self.pos_weight = float(pos_weight)
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         self.label_style = getattr(model, "label_style", "graph")
@@ -81,12 +88,26 @@ class GraphTrainer:
         state = TrainState.create(params, self.tx)
         return jax.device_put(state, NamedSharding(self.mesh, P()))
 
-    def _local_loss(self, params, batch: GraphBatch):
-        logits = self.model.apply(params, batch)
-        loss, labels, mask = classifier_loss(
-            logits, batch, self.label_style, self.pos_weight
+    def make_checkpoints(self, directory) -> CheckpointManager:
+        """CheckpointManager wired to the configured monitor metric."""
+        return CheckpointManager(
+            directory,
+            monitor=self.cfg.train.monitor,
+            mode=self.cfg.train.monitor_mode,
         )
-        return loss, (logits, labels, mask)
+
+    def _labels_mask(self, batch: GraphBatch):
+        if self.label_style == "graph":
+            return graph_labels(batch), batch.graph_mask
+        return node_labels(batch), batch.node_mask
+
+    def _local_loss_sum(self, params, batch: GraphBatch):
+        """Masked SUM of per-example losses + valid count (exact-mean dp)."""
+        logits = self.model.apply(params, batch)
+        labels, mask = self._labels_mask(batch)
+        per = bce_elements(logits, labels, self.pos_weight)
+        m = mask.astype(per.dtype)
+        return (per * m).sum(), m.sum()
 
     def _build_steps(self) -> None:
         mesh = self.mesh
@@ -100,16 +121,23 @@ class GraphTrainer:
         )
         def _sharded_grads(params, batch):
             local = _squeeze_batch(batch)
-            (loss, _), grads = jax.value_and_grad(self._local_loss, has_aux=True)(
-                params, local
-            )
-            grads = jax.lax.pmean(grads, "dp")
-            grads = jax.lax.pmean(grads, "tp")
-            grads = jax.lax.pmean(grads, "sp")
-            loss = jax.lax.pmean(loss, ("dp", "tp", "sp"))
-            return loss, grads
 
-        @jax.jit
+            def loss_sum_fn(p):
+                s, c = self._local_loss_sum(p, local)
+                return s, c
+
+            (loss_sum, count), grads = jax.value_and_grad(
+                loss_sum_fn, has_aux=True
+            )(params)
+            loss_sum = jax.lax.psum(loss_sum, _ALL_AXES)
+            count = jax.lax.psum(count, _ALL_AXES)
+            denom = jax.numpy.maximum(count, 1.0)
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, _ALL_AXES) / denom, grads
+            )
+            return loss_sum / denom, grads
+
+        @partial(jax.jit, donate_argnums=0)
         def train_step(state: TrainState, batch: GraphBatch):
             loss, grads = _sharded_grads(state.params, batch)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
@@ -123,14 +151,16 @@ class GraphTrainer:
             shard_map,
             mesh=mesh,
             in_specs=(P(), P(("dp",))),
-            out_specs=(P("dp"), P("dp"), P("dp")),
+            out_specs=(P("dp"), P("dp"), P("dp"), P("dp")),
             check_vma=False,
         )
         def _sharded_eval(params, batch):
             local = _squeeze_batch(batch)
-            _, (logits, labels, mask) = self._local_loss(params, local)
+            logits = self.model.apply(params, local)
+            labels, mask = self._labels_mask(local)
+            per = bce_elements(logits, labels, self.pos_weight)
             probs = jax.nn.sigmoid(logits)
-            return probs[None], labels[None], mask[None]
+            return probs[None], labels[None], mask[None], per[None]
 
         @jax.jit
         def eval_step(params, batch: GraphBatch):
@@ -146,21 +176,18 @@ class GraphTrainer:
     ) -> tuple[dict[str, float], BinaryClassificationMetrics]:
         params = getattr(state_or_params, "params", state_or_params)
         m = BinaryClassificationMetrics()
-        losses = []
+        loss_sum = 0.0
+        count = 0.0
         for batch in batches:
-            probs, labels, mask = self.eval_step(params, batch)
-            probs, labels, mask = jax.device_get((probs, labels, mask))
+            probs, labels, mask, per = jax.device_get(
+                self.eval_step(params, batch)
+            )
             m.update(probs, labels, mask)
             valid = np.asarray(mask, bool)
-            p = np.clip(np.asarray(probs, np.float64), 1e-7, 1 - 1e-7)
-            y = np.asarray(labels, np.float64)
-            per = -(
-                self.pos_weight * y * np.log(p) + (1 - y) * np.log1p(-p)
-            )
-            if valid.any():
-                losses.append(per[valid].mean())
+            loss_sum += float(np.asarray(per, np.float64)[valid].sum())
+            count += float(valid.sum())
         metrics = m.compute()
-        metrics["loss"] = float(np.mean(losses)) if losses else float("nan")
+        metrics["loss"] = loss_sum / count if count else float("nan")
         return metrics, m
 
     def fit(
@@ -172,13 +199,18 @@ class GraphTrainer:
         max_epochs: int | None = None,
         log_fn: Callable[[dict], None] | None = None,
     ) -> TrainState:
-        max_epochs = max_epochs or self.cfg.train.max_epochs
+        tcfg = self.cfg.train
+        max_epochs = max_epochs or tcfg.max_epochs
+        step = int(jax.device_get(state.step))
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
             losses = []
             for batch in train_batches(epoch):
                 state, loss = self.train_step(state, batch)
                 losses.append(loss)
+                step += 1
+                if log_fn is not None and step % max(1, tcfg.log_every_steps) == 0:
+                    log_fn({"step": step, "loss": float(jax.device_get(loss))})
             train_loss = float(np.mean(jax.device_get(losses))) if losses else float("nan")
             record = {
                 "epoch": epoch,
@@ -186,18 +218,26 @@ class GraphTrainer:
                 "epoch_seconds": time.perf_counter() - t0,
             }
             if val_batches is not None and (
-                (epoch + 1) % self.cfg.train.eval_every_epochs == 0
+                (epoch + 1) % tcfg.eval_every_epochs == 0
                 or epoch == max_epochs - 1
             ):
                 val_metrics, _ = self.evaluate(state, val_batches())
                 record.update({f"val_{k}": v for k, v in val_metrics.items()})
-                if checkpoints is not None:
-                    checkpoints.save(
-                        f"epoch-{epoch:04d}",
-                        jax.device_get(state.params),
-                        {k: float(v) for k, v in record.items() if k != "epoch"},
-                        step=int(jax.device_get(state.step)),
-                    )
+            if checkpoints is not None and (
+                any(k.startswith("val_") for k in record)
+                or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
+                or epoch == max_epochs - 1
+            ):
+                checkpoints.save(
+                    f"epoch-{epoch:04d}",
+                    jax.device_get(state.params),
+                    {
+                        k: float(v)
+                        for k, v in record.items()
+                        if k != "epoch" and isinstance(v, (int, float))
+                    },
+                    step=step,
+                )
             logger.info("epoch %d: %s", epoch, record)
             if log_fn is not None:
                 log_fn(record)

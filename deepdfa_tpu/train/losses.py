@@ -20,16 +20,33 @@ from deepdfa_tpu.nn.gnn import segment_max
 
 
 def graph_labels(batch: GraphBatch) -> jax.Array:
-    """Graph-level labels: max of node vuln per graph (padding-safe)."""
+    """Graph-level labels: max of node vuln per graph (padding-safe), OR'd
+    with the stored graph_label so graph-only-labeled datasets (e.g. Devign:
+    no per-statement annotations) are not silently negated."""
     vuln = jnp.where(batch.node_mask, batch.node_vuln, 0)
     per_graph = segment_max(vuln, batch.node_graph, batch.num_graphs + 1)[
         : batch.num_graphs
     ]
-    return jnp.maximum(per_graph, 0).astype(jnp.float32)
+    derived = jnp.maximum(per_graph, 0).astype(jnp.float32)
+    return jnp.maximum(derived, batch.graph_label)
 
 
 def node_labels(batch: GraphBatch) -> jax.Array:
     return batch.node_vuln.astype(jnp.float32)
+
+
+def bce_elements(
+    logits: jax.Array,
+    labels: jax.Array,
+    pos_weight: float | jax.Array = 1.0,
+) -> jax.Array:
+    """Per-element binary cross-entropy on logits, torch-compatible.
+
+    loss_i = -[pos_weight * y_i * log sigmoid(x_i) + (1-y_i) * log sigmoid(-x_i)]
+    """
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    return -(pos_weight * labels * log_p + (1.0 - labels) * log_not_p)
 
 
 def bce_with_logits(
@@ -38,13 +55,8 @@ def bce_with_logits(
     mask: jax.Array,
     pos_weight: float | jax.Array = 1.0,
 ) -> jax.Array:
-    """Masked mean binary cross-entropy on logits, torch-compatible.
-
-    loss_i = -[pos_weight * y_i * log sigmoid(x_i) + (1-y_i) * log sigmoid(-x_i)]
-    """
-    log_p = jax.nn.log_sigmoid(logits)
-    log_not_p = jax.nn.log_sigmoid(-logits)
-    per = -(pos_weight * labels * log_p + (1.0 - labels) * log_not_p)
+    """Masked mean binary cross-entropy on logits."""
+    per = bce_elements(logits, labels, pos_weight)
     mask = mask.astype(per.dtype)
     return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 

@@ -1,0 +1,291 @@
+"""Frontend tests: parser -> CPG -> CFG -> reaching defs -> features."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.frontend import (
+    ReachingDefinitions,
+    build_vocabs,
+    decl_features,
+    encode_nodes,
+    graph_features,
+    is_decl,
+    parse_function,
+)
+from deepdfa_tpu.frontend.cpg import CFG
+from deepdfa_tpu.frontend.tokens import tokenize
+
+SIMPLE = """
+int add(int a, int b) {
+    int sum = a + b;
+    return sum;
+}
+"""
+
+BRANCHY = """
+int f(int n, char *buf) {
+    int i = 0;
+    int total = 0;
+    while (i < n) {
+        if (buf[i] == 'x') {
+            total += 1;
+        } else {
+            total -= 1;
+        }
+        i++;
+    }
+    return total;
+}
+"""
+
+VULNY = """
+void copy(char *dst, const char *src, int len) {
+    char tmp[64];
+    int n = strlen(src);
+    if (n > len) {
+        n = len;
+    }
+    memcpy(tmp, src, n);
+    strcpy(dst, tmp);
+}
+"""
+
+
+def test_tokenizer_basics():
+    toks = tokenize('int x = 0xFF + 1.5e-3; /* c */ char *s = "a\\"b"; // y\n')
+    texts = [t.text for t in toks if t.kind != "eof"]
+    assert "0xFF" in texts and "1.5e-3" in texts
+    assert '"a\\"b"' in texts
+    assert "/*" not in " ".join(texts)
+    # line numbers survive comments
+    code = "int a;\n/* multi\nline */\nint b;"
+    toks = tokenize(code)
+    b_tok = [t for t in toks if t.text == "b"][0]
+    assert b_tok.line == 4
+
+
+def test_parse_simple_function():
+    cpg = parse_function(SIMPLE)
+    assert cpg.method_name == "add"
+    labels = {n.label for n in cpg.nodes}
+    assert {"METHOD", "METHOD_RETURN", "METHOD_PARAMETER_IN", "LOCAL",
+            "IDENTIFIER", "CALL", "RETURN"} <= labels
+    # the assignment call exists with joern name, and its first ARGUMENT is sum
+    assigns = [n for n in cpg.nodes if n.name == "<operator>.assignment"]
+    assert len(assigns) == 1
+    args = cpg.arguments(assigns[0].id)
+    assert cpg.nodes[args[0]].code == "sum"
+    assert cpg.nodes[args[0]].type_full_name == "int"
+    # CFG connects METHOD ... METHOD_RETURN
+    cfg_nodes = cpg.cfg_nodes()
+    assert cpg.method_id in cfg_nodes
+    assert cpg.method_return_id in cfg_nodes
+
+
+def _cfg_reachable(cpg):
+    seen = set()
+    stack = [cpg.method_id]
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(cpg.successors(n, CFG))
+    return seen
+
+
+def test_cfg_branch_join():
+    cpg = parse_function(BRANCHY)
+    reach = _cfg_reachable(cpg)
+    assert cpg.method_return_id in reach
+    # while loop: the condition node has a back edge (is its own ancestor)
+    rd = ReachingDefinitions(cpg)
+    in_sets = rd.solve()
+    # defs of i: "i = 0" and "i++" — at the loop condition both reach
+    less_than = [
+        n.id for n in cpg.nodes if n.name == "<operator>.lessThan"
+    ]
+    assert less_than
+    vars_at_cond = {d.var for d in in_sets[less_than[0]]}
+    assert "i" in vars_at_cond
+    codes = {d.code for d in in_sets[less_than[0]] if d.var == "i"}
+    assert codes == {"i = 0", "i++"}
+
+
+def test_reaching_defs_kill():
+    cpg = parse_function(
+        """
+int g(int a) {
+    int x = 1;
+    x = 2;
+    return x;
+}
+"""
+    )
+    rd = ReachingDefinitions(cpg)
+    assert len(rd.domain) == 2
+    in_sets = rd.solve()
+    ret = [n.id for n in cpg.nodes if n.label == "RETURN"][0]
+    reaching = {d.code for d in in_sets[ret]}
+    # x = 1 is killed by x = 2 before the return
+    assert reaching == {"x = 2"}
+
+
+def test_reaching_defs_branches_merge():
+    cpg = parse_function(
+        """
+int h(int a) {
+    int x = 1;
+    if (a) {
+        x = 2;
+    }
+    return x;
+}
+"""
+    )
+    rd = ReachingDefinitions(cpg)
+    in_sets = rd.solve()
+    ret = [n.id for n in cpg.nodes if n.label == "RETURN"][0]
+    reaching = {d.code for d in in_sets[ret] if d.var == "x"}
+    assert reaching == {"x = 1", "x = 2"}
+
+
+def test_is_decl_and_datatype():
+    cpg = parse_function(VULNY)
+    decls = [n.id for n in cpg.nodes if is_decl(cpg, n.id)]
+    # n = strlen(src); n = len; (tmp decl has no initializer)
+    codes = {cpg.nodes[d].code for d in decls}
+    assert "n = strlen(src)" in codes
+    assert "n = len" in codes
+    feats = {cpg.nodes[d].code: decl_features(cpg, d) for d in decls}
+    f1 = feats["n = strlen(src)"]
+    assert ("datatype", "int") in f1
+    assert ("api", "strlen") in f1
+    f2 = feats["n = len"]
+    assert ("datatype", "int") in f2
+
+
+def test_datatype_recursion_through_accessors():
+    cpg = parse_function(
+        """
+void t(struct foo *p, int i) {
+    int arr[10];
+    p->x = 1;
+    arr[i] = 2;
+    *p = 3;
+}
+"""
+    )
+    decls = {
+        cpg.nodes[n.id].code: n.id for n in cpg.nodes if is_decl(cpg, n.id)
+    }
+    f = dict((k, dict(decl_features(cpg, v))) for k, v in decls.items())
+    assert f["p->x = 1"]["datatype"] == "struct foo*"
+    assert f["arr[i] = 2"]["datatype"] == "int[]"
+    assert f["*p = 3"]["datatype"] == "struct foo*"
+
+
+def test_inc_dec_are_defs():
+    cpg = parse_function("void u(int k) { k++; --k; }")
+    rd = ReachingDefinitions(cpg)
+    assert {d.var for d in rd.domain} == {"k"}
+    assert len(rd.domain) == 2
+
+
+def test_features_hash_and_vocab_indexing():
+    cpgs = [parse_function(VULNY), parse_function(BRANCHY), parse_function(SIMPLE)]
+    per_graph = [
+        {nid: decl_features(c, nid) for nid in (n.id for n in c.nodes) if is_decl(c, nid)}
+        for c in cpgs
+    ]
+    train_fields = [f for g in per_graph for f in g.values()]
+    vocabs = build_vocabs(train_fields, limit_all=10, limit_subkeys=10)
+    assert set(vocabs) == {"api", "datatype", "literal", "operator"}
+    v = vocabs["datatype"]
+    assert v.input_dim == 12
+    # every train hash encodes to >= 2 (known) since vocab covers all
+    for fields in train_fields:
+        idx = v.encode(fields)
+        assert idx == 0 or idx >= 2
+    # unseen hash -> UNKNOWN (index 1)
+    weird = [("datatype", "quux_t***")]
+    assert v.encode(weird) == 1
+    # not a def -> 0
+    assert v.encode(None) == 0
+    # roundtrip
+    v2 = type(v).from_json(v.to_json())
+    assert v2.encode(weird) == 1
+    assert v2.hash_index == v.hash_index
+
+    # encode_nodes builds the [n, 4] matrix aligned with node id order
+    cpg = cpgs[0]
+    ids = [n.id for n in cpg.nodes]
+    mat = encode_nodes(vocabs, per_graph[0], ids)
+    assert mat.shape == (len(ids), 4)
+    def_rows = [i for i, nid in enumerate(ids) if nid in per_graph[0]]
+    assert (mat[def_rows] > 0).any()
+    non_def = [i for i, nid in enumerate(ids) if nid not in per_graph[0]]
+    assert (mat[non_def] == 0).all()
+
+
+def test_unknown_statement_recovery():
+    cpg = parse_function(
+        """
+int weird(int a) {
+    int x = 1;
+    __asm__ volatile("nop" ::: );
+    return x;
+}
+"""
+    )
+    # parse succeeded and the function is intact around the weird line
+    assert cpg.method_name == "weird"
+    rd = ReachingDefinitions(cpg)
+    assert {d.var for d in rd.domain} == {"x"}
+
+
+def test_switch_and_goto():
+    cpg = parse_function(
+        """
+int s(int a) {
+    int r = 0;
+    switch (a) {
+    case 1:
+        r = 1;
+        break;
+    case 2:
+        r = 2;
+    default:
+        r = 3;
+    }
+    if (r == 3) goto out;
+    r = 4;
+out:
+    return r;
+}
+"""
+    )
+    rd = ReachingDefinitions(cpg)
+    in_sets = rd.solve()
+    ret = [n.id for n in cpg.nodes if n.label == "RETURN"][0]
+    reaching = {d.code for d in in_sets[ret] if d.var == "r"}
+    # r=0 killed on all paths through the switch (default catches all),
+    # r=1 / r=2 / r=3 / r=4 can reach the label
+    assert "r = 4" in reaching
+    assert "r = 1" in reaching
+    assert "r = 3" in reaching
+    # r = 2 falls through to default which kills it
+    assert "r = 2" not in reaching
+
+
+def test_stage2_hash_matches_reference_format():
+    cpg = parse_function(VULNY)
+    hashes = graph_features(cpg)
+    assert hashes
+    for h in hashes.values():
+        d = json.loads(h)
+        assert set(d) == {"api", "datatype", "literal", "operator"}
+        for v in d.values():
+            assert v == sorted(v)

@@ -106,14 +106,61 @@ def test_dp_matches_single_device(dataset):
         s8, loss8 = t8.train_step(s8, b8)
         s1, loss1 = t1.train_step(s1, b1)
 
-    # mean-of-shard-means != global mean when shards have unequal graph
-    # counts; shards here are equal (64/8), so losses and grads agree.
     np.testing.assert_allclose(
         float(jax.device_get(loss8)), float(jax.device_get(loss1)), rtol=2e-4
     )
     chex.assert_trees_all_close(
         jax.device_get(s8.params), jax.device_get(s1.params), rtol=5e-4, atol=1e-6
     )
+
+
+def test_dp_unequal_shards_match_single_device(dataset):
+    """Exact sum/count psum: global mean is right even when shard graph
+    counts differ (65 graphs over 8 shards)."""
+    import jax
+
+    cfg = config_mod.apply_overrides(
+        Config(), ["model.hidden_dim=8", "train.optim.name=sgd"]
+    )
+    model = DeepDFA.from_config(cfg.model, input_dim=32)
+    uneven = dataset + [dataset[0]]  # 65 graphs
+    t8 = GraphTrainer(model, cfg, mesh=make_mesh(MeshConfig(dp=8)))
+    t1 = GraphTrainer(model, cfg, mesh=make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1]))
+    b8 = pack_shards(uneven, 8, num_graphs=9, node_budget=160, edge_budget=640)
+    b1 = pack_shards(uneven, 1, num_graphs=65, node_budget=1280, edge_budget=5120)
+    s8 = t8.init_state(b8, seed=0)
+    s1 = t1.init_state(b1, seed=0)
+    s8, loss8 = t8.train_step(s8, b8)
+    s1, loss1 = t1.train_step(s1, b1)
+    np.testing.assert_allclose(
+        float(jax.device_get(loss8)), float(jax.device_get(loss1)), rtol=2e-4
+    )
+    chex = pytest.importorskip("chex")
+    chex.assert_trees_all_close(
+        jax.device_get(s8.params), jax.device_get(s1.params), rtol=5e-4, atol=1e-6
+    )
+
+
+def test_graph_label_fallback_when_no_node_labels(rng):
+    """Graph-only-labeled dataset (node_vuln all zero, label=1) trains as
+    positive via the stored graph_label."""
+    import jax
+
+    from deepdfa_tpu.graphs import pack
+    from deepdfa_tpu.train import graph_labels
+
+    g = GraphSpec(
+        graph_id=0,
+        node_feats=rng.integers(0, 10, (6, 4)).astype(np.int32),
+        node_vuln=np.zeros((6,), np.int32),
+        edge_src=np.array([0, 1], np.int32),
+        edge_dst=np.array([1, 2], np.int32),
+        label=1.0,
+    )
+    b = pack([g], num_graphs=2, node_budget=16, edge_budget=64)
+    labels = np.asarray(graph_labels(b))
+    assert labels[0] == 1.0
+    assert labels[1] == 0.0
 
 
 def test_undersampler_balance():
