@@ -107,12 +107,10 @@ def to_graph_spec(
     vuln_lines: set[int] | None = None,
 ) -> GraphSpec:
     """Encode features through the vocab and emit the batchable GraphSpec."""
+    from deepdfa_tpu.frontend.vocab import encode_nodes
+
     n = eg.num_nodes
-    feats = np.zeros((n, len(SUBKEY_ORDER)), np.int32)
-    for i in range(n):
-        fields = eg.def_fields.get(i)
-        for j, sk in enumerate(SUBKEY_ORDER):
-            feats[i, j] = vocabs[sk].encode(fields)
+    feats = encode_nodes(vocabs, eg.def_fields, range(n), SUBKEY_ORDER)
     if vuln_lines:
         vuln = np.array(
             [1 if int(l) in vuln_lines else 0 for l in eg.node_lines], np.int32

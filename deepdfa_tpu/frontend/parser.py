@@ -119,8 +119,9 @@ class _Goto(_Stmt):
 
 
 class _Label(_Stmt):
-    def __init__(self, name: str):
+    def __init__(self, name: str, line: int | None = None):
         self.name = name
+        self.line = line
 
 
 # ---------------------------------------------------------------------------
@@ -542,7 +543,7 @@ class Parser:
         if t.kind == "id" and self.peek(1).text == ":" and self.peek(2).text != ":":
             self.eat()
             self.eat(":")
-            return _Seq([_Label(t.text), self.parse_statement()])
+            return _Seq([_Label(t.text, t.line), self.parse_statement()])
         if self._at_type_start():
             return self._parse_declaration()
         # expression statement
@@ -971,7 +972,7 @@ class _CfgBuilder:
             # a label is a CFG join point; materialize as a no-op node
             node = self.cpg.add_node(
                 "JUMP_TARGET", name=s.name, code=f"{s.name}:",
-                line=None,
+                line=s.line,
             )
             self.labels[s.name] = node
             for nid in self.frontier:

@@ -142,7 +142,7 @@ def tokenize(code: str) -> list[Token]:
             while j < n and code[j] != c:
                 if code[j] == "\\":
                     j += 1
-                if code[j] == "\n":
+                if j < n and code[j] == "\n":
                     line += 1
                 j += 1
             j = min(j + 1, n)

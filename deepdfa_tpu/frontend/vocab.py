@@ -64,11 +64,14 @@ class AbsDfVocab:
     known_values: tuple[str, ...]  # top train values (freq order)
     hash_index: dict[str, int]  # all-hash -> rank (0-based)
 
+    def __post_init__(self):
+        self._known_set = frozenset(self.known_values)
+
     def encode(self, fields: Fields | None) -> int:
         """Embedding index for one node (0 not-def / 1 unknown / 2+rank)."""
         if fields is None:
             return NOT_A_DEF
-        h = _node_all_hash(fields, self.subkey, set(self.known_values))
+        h = _node_all_hash(fields, self.subkey, self._known_set)
         if h is None:
             return NOT_A_DEF
         rank = self.hash_index.get(h)

@@ -200,7 +200,7 @@ class GraphTrainer:
         log_fn: Callable[[dict], None] | None = None,
     ) -> TrainState:
         tcfg = self.cfg.train
-        max_epochs = max_epochs or tcfg.max_epochs
+        max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         step = int(jax.device_get(state.step))
         for epoch in range(max_epochs):
             t0 = time.perf_counter()

@@ -1,0 +1,26 @@
+"""The driver contract: entry() compiles single-chip, dryrun_multichip runs."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+
+def test_entry_jits():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    out = np.asarray(jax.device_get(out))
+    assert out.shape == (32,)
+    assert np.isfinite(out).all()
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)  # asserts internally
