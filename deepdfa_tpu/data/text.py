@@ -1,0 +1,125 @@
+"""Text(+graph) batches for the combined transformer models.
+
+The collator implements the index-join bridge (reference:
+flowgnn_dataset.get_indices + keep_idx row-dropping,
+DDFA/sastvd/linevd/dataset.py:63-76, linevul_main.py:194-197) with static
+shapes: text row i aligns with graph slot i; rows with no extracted graph
+get `has_graph=False` and a zeroed graph embedding instead of being
+dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+
+from deepdfa_tpu.graphs.batch import GraphSpec, pack
+from deepdfa_tpu.graphs.batch import GraphBatch
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TextBatch:
+    input_ids: jax.Array  # [B, T] int32
+    labels: jax.Array  # [B] int32
+    row_mask: jax.Array  # [B] bool (False = padding row)
+    has_graph: jax.Array  # [B] bool
+    graphs: GraphBatch  # num_graphs == B, graph i <-> text row i
+
+
+_EMPTY = GraphSpec(
+    graph_id=-1,
+    node_feats=np.zeros((1, 4), np.int32),
+    node_vuln=np.zeros((1,), np.int32),
+    edge_src=np.zeros((0,), np.int32),
+    edge_dst=np.zeros((0,), np.int32),
+    label=0.0,
+)
+
+
+def collate(
+    token_ids: np.ndarray,  # [n, T]
+    labels: Sequence[int],
+    example_ids: Sequence[int],
+    graphs_by_id: Mapping[int, GraphSpec],
+    batch_rows: int,
+    node_budget: int,
+    edge_budget: int,
+) -> TextBatch:
+    """Build one static-shape TextBatch (n <= batch_rows)."""
+    n = len(labels)
+    if n > batch_rows:
+        raise ValueError(f"{n} rows > batch_rows {batch_rows}")
+    T = token_ids.shape[1]
+    ids = np.ones((batch_rows, T), np.int32)  # pad_token_id = 1
+    ids[:n] = token_ids
+    lab = np.zeros((batch_rows,), np.int32)
+    lab[:n] = np.asarray(labels, np.int32)
+    row_mask = np.zeros((batch_rows,), bool)
+    row_mask[:n] = True
+    has_graph = np.zeros((batch_rows,), bool)
+    specs: list[GraphSpec] = []
+    # aggregate budgets across the whole batch: rows whose graph doesn't
+    # fit (individually OR cumulatively) degrade to has_graph=False — the
+    # reference's row-dropping (keep_idx) analog, never a crash
+    n_used = batch_rows  # every row holds >= the 1-node _EMPTY placeholder
+    e_used = batch_rows  # + its self loop
+    for i in range(batch_rows):
+        if i < n and example_ids[i] in graphs_by_id:
+            g = graphs_by_id[example_ids[i]]
+            dn = g.num_nodes - _EMPTY.num_nodes
+            de = (g.num_edges + g.num_nodes) - (
+                _EMPTY.num_edges + _EMPTY.num_nodes
+            )
+            if n_used + dn <= node_budget and e_used + de <= edge_budget:
+                specs.append(g)
+                has_graph[i] = True
+                n_used += dn
+                e_used += de
+                continue
+        specs.append(_EMPTY)
+    gb = pack(specs, batch_rows, node_budget, edge_budget)
+    return TextBatch(
+        input_ids=ids,
+        labels=lab,
+        row_mask=row_mask,
+        has_graph=has_graph,
+        graphs=gb,
+    )
+
+
+def collate_shards(
+    token_ids: np.ndarray,
+    labels: Sequence[int],
+    example_ids: Sequence[int],
+    graphs_by_id: Mapping[int, GraphSpec],
+    num_shards: int,
+    rows_per_shard: int,
+    node_budget: int,
+    edge_budget: int,
+) -> TextBatch:
+    """Shard rows round-robin and stack shard batches on a leading dp axis."""
+    n = len(labels)
+    if n > num_shards * rows_per_shard:
+        raise ValueError(
+            f"{n} rows > {num_shards} x {rows_per_shard}"
+        )
+    shards = []
+    for s in range(num_shards):
+        sel = list(range(s, n, num_shards))[:rows_per_shard]
+        shards.append(
+            collate(
+                token_ids[sel],
+                [labels[i] for i in sel],
+                [example_ids[i] for i in sel],
+                graphs_by_id,
+                rows_per_shard,
+                node_budget,
+                edge_budget,
+            )
+        )
+    stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
+    return stacked

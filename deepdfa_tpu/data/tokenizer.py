@@ -1,0 +1,158 @@
+"""Tokenizers for the transformer path.
+
+Two implementations behind one interface:
+
+- `BpeTokenizer`: GPT-2/RoBERTa byte-level BPE, loading standard
+  vocab.json + merges.txt files from disk (the format of codebert-base and
+  the reference's bundled assets, LineVul/linevul/bpe_tokenizer/). No
+  network access needed — point it at local files.
+- `HashTokenizer`: dependency-free deterministic fallback that buckets
+  whitespace/punctuation-split tokens by hash. Used for hermetic tests and
+  synthetic corpora where a pretrained vocab is meaningless.
+
+Both produce fixed-length right-padded id arrays with <s>/</s> framing,
+the shape contract of the reference's convert_examples_to_features
+(LineVul/linevul/linevul_main.py:120-131).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from functools import lru_cache
+from pathlib import Path
+
+import numpy as np
+
+
+class Tokenizer:
+    cls_id: int
+    sep_id: int
+    pad_id: int
+    vocab_size: int
+
+    def encode(self, text: str, max_length: int = 512) -> np.ndarray:
+        raise NotImplementedError
+
+    def batch_encode(self, texts, max_length: int = 512) -> np.ndarray:
+        return np.stack([self.encode(t, max_length) for t in texts])
+
+
+class HashTokenizer(Tokenizer):
+    """Deterministic hash-bucket tokenizer (tests / synthetic corpora)."""
+
+    _WORD = re.compile(r"[A-Za-z_][A-Za-z0-9_]*|\d+|\S")
+
+    def __init__(self, vocab_size: int = 4096):
+        assert vocab_size > 8
+        self.vocab_size = vocab_size
+        self.cls_id, self.sep_id, self.pad_id, self.unk_id = 0, 2, 1, 3
+        self._first = 4
+
+    def encode(self, text: str, max_length: int = 512) -> np.ndarray:
+        import hashlib
+
+        toks = self._WORD.findall(text)
+        ids = [self.cls_id]
+        for t in toks[: max_length - 2]:
+            h = int.from_bytes(
+                hashlib.blake2s(t.encode(), digest_size=4).digest(), "little"
+            )
+            ids.append(self._first + h % (self.vocab_size - self._first))
+        ids.append(self.sep_id)
+        out = np.full((max_length,), self.pad_id, np.int32)
+        out[: len(ids)] = ids[:max_length]
+        return out
+
+
+@lru_cache()
+def _bytes_to_unicode() -> dict[int, str]:
+    """GPT-2 byte<->unicode table (standard byte-level BPE alphabet)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+try:  # exact GPT-2 pretokenizer needs unicode classes (\p{L}, \p{N})
+    import regex as _regex
+
+    _GPT2_PAT = _regex.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+    )
+except ImportError:  # pragma: no cover - ascii fallback
+    _GPT2_PAT = re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?\d+| ?[^\sA-Za-z\d]+|\s+(?!\S)|\s+"
+    )
+
+
+class BpeTokenizer(Tokenizer):
+    """GPT-2-style byte-level BPE from vocab.json + merges.txt."""
+
+    _PAT = _GPT2_PAT
+
+    def __init__(self, vocab_file: str | Path, merges_file: str | Path,
+                 cls_token="<s>", sep_token="</s>", pad_token="<pad>",
+                 unk_token="<unk>"):
+        self.vocab: dict[str, int] = json.loads(Path(vocab_file).read_text())
+        merges = Path(merges_file).read_text().splitlines()
+        merges = [m for m in merges if m and not m.startswith("#version")]
+        self.bpe_ranks = {tuple(m.split()): i for i, m in enumerate(merges)}
+        self.byte_encoder = _bytes_to_unicode()
+        self.vocab_size = len(self.vocab)
+        self.cls_id = self.vocab[cls_token]
+        self.sep_id = self.vocab[sep_token]
+        self.pad_id = self.vocab[pad_token]
+        self.unk_id = self.vocab.get(unk_token, 3)
+        self._cache: dict[str, list[str]] = {}
+
+    def _bpe(self, token: str) -> list[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = list(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, 1 << 60))
+            if best not in self.bpe_ranks:
+                break
+            first, second = best
+            new_word: list[str] = []
+            i = 0
+            while i < len(word):
+                if (
+                    i < len(word) - 1
+                    and word[i] == first
+                    and word[i + 1] == second
+                ):
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = new_word
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str, max_length: int = 512) -> np.ndarray:
+        ids = [self.cls_id]
+        for chunk in self._PAT.findall(text):
+            mapped = "".join(self.byte_encoder[b] for b in chunk.encode("utf-8"))
+            for piece in self._bpe(mapped):
+                ids.append(self.vocab.get(piece, self.unk_id))
+                if len(ids) >= max_length - 1:
+                    break
+            if len(ids) >= max_length - 1:
+                break
+        ids.append(self.sep_id)
+        out = np.full((max_length,), self.pad_id, np.int32)
+        out[: len(ids)] = ids
+        return out

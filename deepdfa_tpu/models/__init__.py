@@ -1,3 +1,12 @@
+from deepdfa_tpu.models import combined, transformer
+from deepdfa_tpu.models.combined import CombinedConfig
 from deepdfa_tpu.models.deepdfa import DeepDFA
+from deepdfa_tpu.models.transformer import TransformerConfig
 
-__all__ = ["DeepDFA"]
+__all__ = [
+    "DeepDFA",
+    "combined",
+    "transformer",
+    "CombinedConfig",
+    "TransformerConfig",
+]

@@ -1,0 +1,155 @@
+"""Combined transformer+graph classifiers (the DeepDFA+LineVul family).
+
+Re-design of the reference combined models:
+- LineVul/linevul/linevul_model.py:15-69 — RobertaClassificationHead over
+  [CLS-token hidden ‖ pooled graph embedding] with dropout/tanh, 2-way
+  softmax; the GGNN runs in encoder_mode and its out_dim widens the head.
+- the index-join bridge (DDFA/sastvd/linevd/dataset.py:63-76 get_indices):
+  the reference drops transformer rows whose graph is missing; with XLA
+  static shapes we instead carry a per-row `has_graph` mask, zero the
+  missing graph embeddings, and keep every row in the loss (the reference
+  skips those examples entirely — both treat the text signal as primary
+  and the graph as additive).
+
+Functional style matching models/transformer.py: explicit param pytrees,
+shard_map-compatible (tp/sp axes thread through to the encoder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.models import transformer as tfm
+from deepdfa_tpu.models.deepdfa import DeepDFA
+from deepdfa_tpu.parallel.megatron import region_end
+
+
+@dataclasses.dataclass(frozen=True)
+class CombinedConfig:
+    encoder: tfm.TransformerConfig
+    graph_hidden_dim: int = 32
+    graph_n_steps: int = 5
+    graph_input_dim: int = 1002
+    num_classes: int = 2
+    head_dropout: float = 0.1
+    use_graph: bool = True
+
+    @property
+    def graph_out_dim(self) -> int:
+        return 8 * self.graph_hidden_dim  # concat_all_absdf encoder out_dim
+
+
+def make_graph_encoder(cfg: CombinedConfig) -> DeepDFA:
+    return DeepDFA(
+        input_dim=cfg.graph_input_dim,
+        hidden_dim=cfg.graph_hidden_dim,
+        n_steps=cfg.graph_n_steps,
+        num_output_layers=0,
+        concat_all_absdf=True,
+        label_style="graph",
+        encoder_mode=True,
+    )
+
+
+def init_params(cfg: CombinedConfig, key: jax.Array) -> dict:
+    k_enc, k_graph, k_head = jax.random.split(key, 3)
+    D = cfg.encoder.hidden_size
+    in_dim = D + (cfg.graph_out_dim if cfg.use_graph else 0)
+    std = 0.02
+    params = {
+        "encoder": tfm.init_params(cfg.encoder, k_enc),
+        "head": {
+            "dense_w": jax.random.normal(k_head, (in_dim, D)) * std,
+            "dense_b": jnp.zeros((D,)),
+            "out_w": jax.random.normal(
+                jax.random.fold_in(k_head, 1), (D, cfg.num_classes)
+            )
+            * std,
+            "out_b": jnp.zeros((cfg.num_classes,)),
+        },
+    }
+    if cfg.use_graph:
+        graph_enc = make_graph_encoder(cfg)
+        dummy = GraphBatch(
+            node_feats=jnp.zeros((8, 4), jnp.int32),
+            node_vuln=jnp.zeros((8,), jnp.int32),
+            node_graph=jnp.zeros((8,), jnp.int32),
+            node_mask=jnp.ones((8,), bool),
+            edge_src=jnp.zeros((8,), jnp.int32),
+            edge_dst=jnp.zeros((8,), jnp.int32),
+            edge_mask=jnp.ones((8,), bool),
+            graph_label=jnp.zeros((2,)),
+            graph_mask=jnp.ones((2,), bool),
+            graph_ids=jnp.zeros((2,), jnp.int32),
+            num_graphs=2,
+        )
+        params["graph"] = graph_enc.init(k_graph, dummy)
+    return params
+
+
+def head_logits(
+    cfg: CombinedConfig,
+    head: dict,
+    cls_vec: jax.Array,
+    graph_vec: jax.Array | None,
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """RobertaClassificationHead: dropout -> dense -> tanh -> dropout -> out."""
+    x = cls_vec
+    if graph_vec is not None:
+        x = jnp.concatenate([x, graph_vec.astype(x.dtype)], axis=-1)
+    k1 = k2 = None
+    if dropout_key is not None:
+        k1, k2 = jax.random.split(dropout_key)
+    x = tfm._dropout(x, cfg.head_dropout, k1)
+    x = jnp.tanh(x @ head["dense_w"] + head["dense_b"])
+    x = tfm._dropout(x, cfg.head_dropout, k2)
+    return x @ head["out_w"] + head["out_b"]
+
+
+def forward(
+    cfg: CombinedConfig,
+    params: dict,
+    input_ids: jax.Array,
+    graph_batch: GraphBatch | None = None,
+    has_graph: jax.Array | None = None,
+    dropout_key: jax.Array | None = None,
+    sp_axis: str | None = None,
+    tp_axis: str | None = None,
+    position_offset: int = 0,
+) -> jax.Array:
+    """[B, T] ids (+ aligned GraphBatch of B graphs) -> [B, num_classes]."""
+    k_enc = k_head = None
+    if dropout_key is not None:
+        k_enc, k_head = jax.random.split(dropout_key)
+    hidden = tfm.encode(
+        cfg.encoder,
+        params["encoder"],
+        input_ids,
+        dropout_key=k_enc,
+        sp_axis=sp_axis,
+        tp_axis=tp_axis,
+        position_offset=position_offset,
+    )
+    cls_vec = hidden[:, 0, :]
+    if sp_axis is not None:
+        # [CLS] lives on the first sp shard; broadcast with psum-forward /
+        # identity-backward (region_end) — a raw psum would transpose to
+        # another psum and multiply the encoder cotangent by sp (the CE
+        # loss is computed once per sp member)
+        idx = jax.lax.axis_index(sp_axis)
+        cls_vec = region_end(
+            jnp.where(idx == 0, cls_vec, jnp.zeros_like(cls_vec)), sp_axis
+        )
+
+    graph_vec = None
+    if cfg.use_graph and graph_batch is not None:
+        graph_enc = make_graph_encoder(cfg)
+        graph_vec = graph_enc.apply(params["graph"], graph_batch)  # [B, 8H]
+        if has_graph is not None:
+            graph_vec = graph_vec * has_graph[:, None].astype(graph_vec.dtype)
+    return head_logits(cfg, params["head"], cls_vec, graph_vec, k_head)

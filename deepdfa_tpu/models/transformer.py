@@ -1,0 +1,331 @@
+"""RoBERTa-family transformer encoder, written TPU-first.
+
+This is the framework's replacement for the reference's HF torch encoders
+(LineVul's RobertaForSequenceClassification, linevul_model.py:26-69;
+UniXcoder; CodeT5's encoder stack). Design choices:
+
+- parameters are an explicit pytree of arrays (no module framework in the
+  forward path): `lax.scan` over stacked layer weights gives one compiled
+  layer body regardless of depth, and manual-parallelism shard_map code can
+  address the head/ffn axes directly.
+- tensor parallelism is Megatron-style: attention heads and the FFN hidden
+  dimension are sharded over the `tp` mesh axis; inside shard_map each
+  device computes its local heads/columns and one psum per residual branch
+  restores the full activation.
+- sequence parallelism: the token axis shards over `sp`; attention runs
+  the exact ring algorithm (parallel/ring_attention.py); everything else
+  is token-local so no other collective is needed.
+- weights import from a HF torch `roberta` state_dict via
+  `params_from_hf_torch` for pretrained initialization (codebert etc.).
+
+HF-compatible numerics: GELU (tanh approximation NOT used — HF roberta
+uses erf gelu), post-layer-norm residual blocks, learned positions with
+RoBERTa's pad-offset position ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.parallel.megatron import region_end, region_start
+from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50265
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 514
+    type_vocab_size: int = 1
+    pad_token_id: int = 1
+    layer_norm_eps: float = 1e-5
+    dropout_rate: float = 0.1
+    dtype: str = "float32"  # activation dtype (bfloat16 for big runs)
+    remat: bool = True  # rematerialize layer activations in backward
+    # (HBM is the bottleneck: without remat, a 12-layer/512-token/bs-32
+    # backward stacks ~18GB of attention+FFN temps and exceeds one v5e)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "TransformerConfig":
+        base = dict(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            intermediate_size=128, max_position_embeddings=66,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
+    """Random-init parameter pytree (HF-style truncated-normal 0.02)."""
+    k = iter(jax.random.split(key, 16))
+    std = 0.02
+    D, H, Dh, F, L = (
+        cfg.hidden_size,
+        cfg.num_heads,
+        cfg.head_dim,
+        cfg.intermediate_size,
+        cfg.num_layers,
+    )
+
+    def norm(key, shape):
+        return (jax.random.normal(key, shape) * std).astype(jnp.float32)
+
+    def zeros(shape):
+        return jnp.zeros(shape, jnp.float32)
+
+    def ones(shape):
+        return jnp.ones(shape, jnp.float32)
+
+    emb = {
+        "word": norm(next(k), (cfg.vocab_size, D)),
+        "position": norm(next(k), (cfg.max_position_embeddings, D)),
+        "token_type": norm(next(k), (cfg.type_vocab_size, D)),
+        "ln_scale": ones((D,)),
+        "ln_bias": zeros((D,)),
+    }
+    layers = {
+        "wq": norm(next(k), (L, D, H, Dh)),
+        "bq": zeros((L, H, Dh)),
+        "wk": norm(next(k), (L, D, H, Dh)),
+        "bk": zeros((L, H, Dh)),
+        "wv": norm(next(k), (L, D, H, Dh)),
+        "bv": zeros((L, H, Dh)),
+        "wo": norm(next(k), (L, H, Dh, D)),
+        "bo": zeros((L, D)),
+        "ln1_scale": ones((L, D)),
+        "ln1_bias": zeros((L, D)),
+        "w1": norm(next(k), (L, D, F)),
+        "b1": zeros((L, F)),
+        "w2": norm(next(k), (L, F, D)),
+        "b2": zeros((L, D)),
+        "ln2_scale": ones((L, D)),
+        "ln2_bias": zeros((L, D)),
+    }
+    pooler = {"w": norm(next(k), (D, D)), "b": zeros((D,))}
+    return {"embeddings": emb, "layers": layers, "pooler": pooler}
+
+
+def _layer_norm(x, scale, bias, eps):
+    """LayerNorm in float32 regardless of activation dtype (bf16-safe)."""
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    out = (x - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    return (out + bias.astype(jnp.float32)).astype(dt)
+
+
+def _dropout(x, rate, key):
+    if key is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+def embed(
+    cfg: TransformerConfig,
+    params: dict,
+    input_ids: jax.Array,
+    position_offset: int = 0,
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """Token+position+type embeddings. `position_offset` is the number of
+    tokens on earlier sp shards (sequence-parallel position ids)."""
+    e = params["embeddings"]
+    # roberta position ids: pad_token_id + 1 + running index of non-pad...
+    # HF actually uses cumulative non-pad positions; fine-tuning on fixed
+    # right-padded batches makes simple offsets equivalent
+    mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+    pos = (jnp.cumsum(mask, axis=-1) + position_offset) * mask + cfg.pad_token_id
+    x = (
+        e["word"][input_ids]
+        + e["position"][pos]
+        + e["token_type"][jnp.zeros_like(input_ids)]
+    )
+    x = _layer_norm(x, e["ln_scale"], e["ln_bias"], cfg.layer_norm_eps)
+    x = _dropout(x, cfg.dropout_rate, dropout_key)
+    return x.astype(jnp.dtype(cfg.dtype))
+
+
+def encoder_layer(
+    cfg: TransformerConfig,
+    lp: dict,
+    x: jax.Array,
+    attn_mask: jax.Array,
+    dropout_key: jax.Array | None = None,
+    sp_axis: str | None = None,
+    tp_axis: str | None = None,
+):
+    """One post-LN transformer layer (HF roberta semantics).
+
+    x: [B, T, D]; attn_mask: [B, T] bool. Inside shard_map, `tp_axis`
+    means lp holds this device's head/ffn shard and activations are
+    full-width after each psum; `sp_axis` means T is the local sequence
+    chunk and ring attention rotates k/v.
+    """
+    k1 = k2 = k3 = None
+    if dropout_key is not None:
+        k1, k2, k3 = jax.random.split(dropout_key, 3)
+        if tp_axis is not None:
+            # attention-probs dropout acts on tp-local heads: decorrelate
+            # masks across head shards (k1/k2 act on replicated activations
+            # and MUST stay identical across tp members)
+            k3 = jax.random.fold_in(k3, jax.lax.axis_index(tp_axis))
+
+    # params stay float32 (optimizer precision); compute in activation dtype
+    dt = x.dtype
+    lp = jax.tree.map(lambda a: a.astype(dt), lp)
+
+    # attention: a Megatron parallel region when heads are tp-sharded
+    x_in = region_start(x, tp_axis) if tp_axis is not None else x
+    q = jnp.einsum("btd,dhk->bhtk", x_in, lp["wq"]) + lp["bq"][:, None, :]
+    k = jnp.einsum("btd,dhk->bhtk", x_in, lp["wk"]) + lp["bk"][:, None, :]
+    v = jnp.einsum("btd,dhk->bhtk", x_in, lp["wv"]) + lp["bv"][:, None, :]
+
+    if sp_axis is not None:
+        ctx = ring_attention(
+            q, k, v, attn_mask, axis_name=sp_axis,
+            dropout_rate=cfg.dropout_rate, dropout_key=k3,
+        )
+    else:
+        ctx = full_attention(
+            q, k, v, attn_mask, dropout_rate=cfg.dropout_rate, dropout_key=k3
+        )
+
+    out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"])
+    if tp_axis is not None:
+        out = region_end(out, tp_axis)
+    out = out + lp["bo"]
+    out = _dropout(out, cfg.dropout_rate, k1)
+    x = _layer_norm(x + out, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
+
+    # FFN: the second Megatron region; b1 shards along F with w1's columns
+    h_in = region_start(x, tp_axis) if tp_axis is not None else x
+    h = jnp.einsum("btd,df->btf", h_in, lp["w1"]) + lp["b1"]
+    h = jax.nn.gelu(h, approximate=False)
+    h = jnp.einsum("btf,fd->btd", h, lp["w2"])
+    if tp_axis is not None:
+        h = region_end(h, tp_axis)
+    h = h + lp["b2"]
+    h = _dropout(h, cfg.dropout_rate, k2)
+    x = _layer_norm(x + h, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
+    return x
+
+
+def encode(
+    cfg: TransformerConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attn_mask: jax.Array | None = None,
+    dropout_key: jax.Array | None = None,
+    sp_axis: str | None = None,
+    tp_axis: str | None = None,
+    position_offset: int = 0,
+) -> jax.Array:
+    """Full encoder: [B, T] ids -> [B, T, D] hidden states."""
+    if attn_mask is None:
+        attn_mask = input_ids != cfg.pad_token_id
+    x = embed(cfg, params, input_ids, position_offset, dropout_key)
+
+    layers = params["layers"]
+    n_layers = layers["wq"].shape[0]
+
+    if dropout_key is None:
+        def layer_fn(x, lp):
+            return encoder_layer(
+                cfg, lp, x, attn_mask, None, sp_axis=sp_axis, tp_axis=tp_axis
+            )
+    else:
+        def layer_fn(x, inputs):
+            lp, key = inputs
+            return encoder_layer(
+                cfg, lp, x, attn_mask, key, sp_axis=sp_axis, tp_axis=tp_axis
+            )
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    xs = layers if dropout_key is None else (layers, jax.random.split(dropout_key, n_layers))
+    x, _ = jax.lax.scan(lambda x, inp: (layer_fn(x, inp), None), x, xs)
+    return x
+
+
+def cls_pool(cfg: TransformerConfig, params: dict, hidden: jax.Array) -> jax.Array:
+    """[CLS] (position 0) through the tanh pooler -> [B, D]."""
+    cls = hidden[:, 0, :]
+    p = params["pooler"]
+    return jnp.tanh(cls @ p["w"] + p["b"])
+
+
+# ---------------------------------------------------------------------------
+# HF weight import
+
+
+def params_from_hf_torch(cfg: TransformerConfig, state_dict) -> dict:
+    """Convert a HF torch `RobertaModel` state_dict (prefix 'roberta.' or
+    none) into this module's parameter pytree. Tested against
+    transformers' FlaxRobertaModel numerics (tests/test_transformer.py)."""
+
+    def get(name):
+        for prefix in ("", "roberta."):
+            k = prefix + name
+            if k in state_dict:
+                return np.asarray(state_dict[k].detach().cpu().numpy())
+        raise KeyError(name)
+
+    D, H, Dh, L = cfg.hidden_size, cfg.num_heads, cfg.head_dim, cfg.num_layers
+    emb = {
+        "word": get("embeddings.word_embeddings.weight"),
+        "position": get("embeddings.position_embeddings.weight"),
+        "token_type": get("embeddings.token_type_embeddings.weight"),
+        "ln_scale": get("embeddings.LayerNorm.weight"),
+        "ln_bias": get("embeddings.LayerNorm.bias"),
+    }
+
+    def layer(i, name):
+        return get(f"encoder.layer.{i}.{name}")
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    layers = {
+        # torch Linear weight [out, in] -> transpose -> reshape heads
+        "wq": stack(lambda i: layer(i, "attention.self.query.weight").T.reshape(D, H, Dh)),
+        "bq": stack(lambda i: layer(i, "attention.self.query.bias").reshape(H, Dh)),
+        "wk": stack(lambda i: layer(i, "attention.self.key.weight").T.reshape(D, H, Dh)),
+        "bk": stack(lambda i: layer(i, "attention.self.key.bias").reshape(H, Dh)),
+        "wv": stack(lambda i: layer(i, "attention.self.value.weight").T.reshape(D, H, Dh)),
+        "bv": stack(lambda i: layer(i, "attention.self.value.bias").reshape(H, Dh)),
+        "wo": stack(lambda i: layer(i, "attention.output.dense.weight").T.reshape(H, Dh, D)),
+        "bo": stack(lambda i: layer(i, "attention.output.dense.bias")),
+        "ln1_scale": stack(lambda i: layer(i, "attention.output.LayerNorm.weight")),
+        "ln1_bias": stack(lambda i: layer(i, "attention.output.LayerNorm.bias")),
+        "w1": stack(lambda i: layer(i, "intermediate.dense.weight").T),
+        "b1": stack(lambda i: layer(i, "intermediate.dense.bias")),
+        "w2": stack(lambda i: layer(i, "output.dense.weight").T),
+        "b2": stack(lambda i: layer(i, "output.dense.bias")),
+        "ln2_scale": stack(lambda i: layer(i, "output.LayerNorm.weight")),
+        "ln2_bias": stack(lambda i: layer(i, "output.LayerNorm.bias")),
+    }
+    try:
+        pooler = {"w": get("pooler.dense.weight").T, "b": get("pooler.dense.bias")}
+    except KeyError:
+        pooler = {
+            "w": np.zeros((D, D), np.float32),
+            "b": np.zeros((D,), np.float32),
+        }
+    tree = {"embeddings": emb, "layers": layers, "pooler": pooler}
+    return jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), tree)

@@ -1,0 +1,57 @@
+"""Megatron-style tensor-parallel region boundaries.
+
+Inside a shard_map with replicated activations and tp-sharded weights, a
+parallel region (attention QKV..out-proj, or FFN up..down) computes partial
+sums that must be all-reduced forward, while the *backward* pass needs the
+mirrored treatment so every parameter gradient comes out either
+local-shard-true (sharded weights) or replicated-true (everything else):
+
+  region_start (Megatron "f"): identity forward, psum backward —
+      the region's input cotangent is partial per tp shard and must sum.
+  region_end   (Megatron "g"): psum forward, identity backward —
+      the full-activation cotangent arriving from above is already
+      replicated-true on every shard.
+
+With both in place, no per-parameter gradient psum over tp is needed at
+all; only the data axes (dp, sp) reduce explicitly. See Shoeybi et al.
+2019 §3 — this is the standard TPU recipe (scaling-book) expressed as two
+custom_vjp ops usable inside shard_map.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def region_start(x, axis_name: str):
+    return x
+
+
+def _rs_fwd(x, axis_name):
+    return x, None
+
+
+def _rs_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+region_start.defvjp(_rs_fwd, _rs_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def region_end(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def _re_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _re_bwd(axis_name, _, g):
+    return (g,)
+
+
+region_end.defvjp(_re_fwd, _re_bwd)

@@ -1,0 +1,109 @@
+"""Ring attention: exact sequence-parallel attention over the `sp` mesh axis.
+
+The reference caps sequences at 512 tokens and has no sequence parallelism
+(SURVEY.md §5.7); this framework makes long-context first-class. Queries
+stay resident per device; key/value blocks rotate around the ring via
+`ppermute` over ICI while a numerically-stable blockwise softmax
+accumulates output (the log-sum-exp streaming trick), so attention over a
+sequence of length S sharded across P devices needs O(S/P) memory per chip
+and never materializes the full S x S score matrix.
+
+Works inside `shard_map` with the sequence axis sharded on `sp`. With
+sp=1 it degenerates to one local block — the same code path single- and
+multi-chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, kv_mask, scale, dropout_rate=0.0, dropout_key=None):
+    """One block's scores + stable-softmax partials.
+
+    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; kv_mask: [B, Tk] bool.
+    Returns (numer [B,H,Tq,D], denom [B,H,Tq], runmax [B,H,Tq]).
+
+    Attention-probs dropout (HF attention_probs_dropout_prob) drops terms
+    from the numerator only: dropout(softmax(s)) @ v == (dropout-masked p
+    @ v) / (undropped sum p), since dropout's 1/keep scaling commutes with
+    the normalization — this keeps the streaming form exact.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    neg = jnp.finfo(s.dtype).min
+    s = jnp.where(kv_mask[:, None, None, :], s, neg)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    p_v = p
+    if dropout_key is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    numer = jnp.einsum("bhqk,bhkd->bhqd", p_v, v)
+    denom = jnp.sum(p, axis=-1)
+    return numer, denom, m
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array,
+    axis_name: str = "sp",
+    dropout_rate: float = 0.0,
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """Exact attention with k/v rotating around the `axis_name` ring.
+
+    Shapes (per device, inside shard_map): q,k,v [B, H, T_local, D],
+    kv_mask [B, T_local] (False = padding). Returns [B, H, T_local, D].
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    n_dev = jax.lax.psum(1, axis_name)
+    if dropout_key is not None:
+        # independent masks per (device, rotation step)
+        dropout_key = jax.random.fold_in(
+            dropout_key, jax.lax.axis_index(axis_name)
+        )
+
+    def block_key(i):
+        return (
+            None if dropout_key is None else jax.random.fold_in(dropout_key, i)
+        )
+
+    numer, denom, m = _block_attn(
+        q, k, v, kv_mask, scale, dropout_rate, block_key(0)
+    )
+
+    def body(i, carry):
+        numer, denom, m, k, v, kv_mask = carry
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        kv_mask = jax.lax.ppermute(kv_mask, axis_name, perm)
+        bn, bd, bm = _block_attn(
+            q, k, v, kv_mask, scale, dropout_rate, block_key(i)
+        )
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(bm - new_m)
+        numer = numer * alpha[..., None] + bn * beta[..., None]
+        denom = denom * alpha + bd * beta
+        return numer, denom, new_m, k, v, kv_mask
+
+    numer, denom, m, *_ = jax.lax.fori_loop(
+        1, n_dev, body, (numer, denom, m, k, v, kv_mask)
+    )
+    denom = jnp.maximum(denom, jnp.finfo(denom.dtype).tiny)
+    return numer / denom[..., None]
+
+
+def full_attention(q, k, v, kv_mask, dropout_rate: float = 0.0, dropout_key=None):
+    """Reference single-device attention (for parity tests)."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    numer, denom, _ = _block_attn(
+        q, k, v, kv_mask, scale, dropout_rate, dropout_key
+    )
+    denom = jnp.maximum(denom, jnp.finfo(denom.dtype).tiny)
+    return numer / denom[..., None]

@@ -1,0 +1,90 @@
+"""dp x tp x sp combined training must match single-device numerics."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.data.text import collate_shards
+from deepdfa_tpu.data.tokenizer import HashTokenizer
+from deepdfa_tpu.models import combined as cmb
+from deepdfa_tpu.models.transformer import TransformerConfig
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+
+def _setup():
+    n = 16
+    synth = generate(n, vuln_rate=0.4, seed=9)
+    specs, _ = build_dataset(to_examples(synth), train_ids=range(n), limit_all=50, limit_subkeys=50)
+    by_id = {s.graph_id: s for s in specs}
+    tok = HashTokenizer(vocab_size=256)
+    token_ids = tok.batch_encode([s.before for s in synth], max_length=32)
+    labels = [s.label for s in synth]
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(
+            vocab_size=256, dropout_rate=0.0, max_position_embeddings=40
+        ),
+        graph_hidden_dim=8,
+        graph_input_dim=52,
+        head_dropout=0.0,
+    )
+    cfg = config_mod.apply_overrides(
+        Config(), ["train.optim.name=sgd", "train.optim.learning_rate=0.05"]
+    )
+    return token_ids, labels, by_id, mcfg, cfg, n
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    dict(dp=2, tp=2, sp=2),
+    dict(dp=1, tp=4, sp=2),
+    dict(dp=8, tp=1, sp=1),
+    dict(dp=1, tp=1, sp=8),
+])
+def test_parallel_matches_single(mesh_cfg):
+    import jax
+
+    token_ids, labels, by_id, mcfg, cfg, n = _setup()
+
+    mesh_p = make_mesh(MeshConfig(**mesh_cfg))
+    mesh_1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+
+    tp_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_p)
+    s_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_1)
+
+    dp = mesh_cfg["dp"]
+    batch_p = collate_shards(
+        token_ids, labels, list(range(n)), by_id,
+        num_shards=dp, rows_per_shard=n // dp,
+        node_budget=1024, edge_budget=4096,
+    )
+    batch_1 = collate_shards(
+        token_ids, labels, list(range(n)), by_id,
+        num_shards=1, rows_per_shard=n,
+        node_budget=1024, edge_budget=4096,
+    )
+
+    sp_state = tp_trainer.init_state(seed=0)
+    s_state = s_trainer.init_state(seed=0)
+
+    key = jax.random.key(123)
+    for _ in range(2):
+        sp_state, loss_p = tp_trainer.train_step(sp_state, batch_p, key)
+        s_state, loss_1 = s_trainer.train_step(s_state, batch_1, key)
+
+    np.testing.assert_allclose(
+        float(jax.device_get(loss_p)), float(jax.device_get(loss_1)), rtol=5e-4
+    )
+    chex = pytest.importorskip("chex")
+    chex.assert_trees_all_close(
+        jax.device_get(sp_state.params),
+        jax.device_get(s_state.params),
+        rtol=2e-3,
+        atol=1e-5,
+    )
+
+    # eval parity
+    mp, _ = tp_trainer.evaluate(sp_state, [batch_p])
+    m1, _ = s_trainer.evaluate(s_state, [batch_1])
+    np.testing.assert_allclose(mp["loss"], m1["loss"], rtol=1e-3)
+    assert mp["f1"] == m1["f1"]

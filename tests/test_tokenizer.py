@@ -1,0 +1,59 @@
+"""Tokenizer tests: hash fallback invariants + BPE vs HF oracle."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+
+_REF_BPE = Path("/root/reference/LineVul/linevul/bpe_tokenizer")
+
+
+def test_hash_tokenizer_contract():
+    tok = HashTokenizer(vocab_size=256)
+    ids = tok.encode("int main(void) { return 0; }", max_length=16)
+    assert ids.shape == (16,)
+    assert ids[0] == tok.cls_id
+    assert tok.sep_id in ids
+    assert (ids < 256).all()
+    # deterministic
+    np.testing.assert_array_equal(
+        ids, tok.encode("int main(void) { return 0; }", max_length=16)
+    )
+    # padding fills the tail
+    assert (ids[np.argmax(ids == tok.sep_id) + 1 :] == tok.pad_id).all()
+
+
+def test_hash_tokenizer_truncation():
+    tok = HashTokenizer(vocab_size=256)
+    long = "x = 1; " * 500
+    ids = tok.encode(long, max_length=32)
+    assert ids.shape == (32,)
+    assert ids[-1] == tok.sep_id or tok.sep_id in ids
+
+
+@pytest.mark.skipif(not _REF_BPE.exists(), reason="no local BPE assets")
+def test_bpe_matches_hf_tokenizer():
+    from transformers import RobertaTokenizerFast
+
+    hf = RobertaTokenizerFast(
+        vocab_file=str(_REF_BPE / "bpe_tokenizer-vocab.json"),
+        merges_file=str(_REF_BPE / "bpe_tokenizer-merges.txt"),
+    )
+    tok = BpeTokenizer(
+        _REF_BPE / "bpe_tokenizer-vocab.json",
+        _REF_BPE / "bpe_tokenizer-merges.txt",
+    )
+    samples = [
+        "int main(void) { return 0; }",
+        "static void copy(char *dst, const char *src) { strcpy(dst, src); }",
+        'printf("hello %d\\n", x);',
+        "for (i = 0; i < n; i++) total += a[i];",
+    ]
+    for s in samples:
+        want = hf(s, max_length=64, padding="max_length", truncation=True)[
+            "input_ids"
+        ]
+        got = tok.encode(s, max_length=64)
+        assert got.tolist() == want, s
