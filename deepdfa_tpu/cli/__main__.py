@@ -1,0 +1,3 @@
+from deepdfa_tpu.cli.main import main
+
+main()

@@ -1,0 +1,281 @@
+"""Command-line interface mirroring the reference pipeline stages.
+
+Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
++ LineVul/CodeT5 argparse zoos):
+
+  prepare   read + clean a dataset csv/json, compute line labels, splits
+  extract   frontend pipeline: CPG -> features -> vocab -> graph shards
+  train     DeepDFA GGNN training (fit + best checkpoint)
+  test      evaluation with metrics report + optional profiling
+  coverage  abstract-dataflow vocab coverage audit (--analyze_dataset)
+  bench     the headline throughput benchmark
+
+Config comes from --config (json) plus dotted key=value overrides, e.g.
+  python -m deepdfa_tpu.cli train data.batch.graphs_per_batch=128
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import sys
+from pathlib import Path
+
+from deepdfa_tpu.core import Config, config as config_mod, paths
+
+
+def _load_config(args) -> Config:
+    cfg = config_mod.load(args.config) if args.config else Config()
+    return config_mod.apply_overrides(cfg, args.overrides)
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default=None, help="json config file")
+    p.add_argument(
+        "overrides", nargs="*", default=[], help="dotted key=value overrides"
+    )
+
+
+def cmd_prepare(args) -> None:
+    from deepdfa_tpu.data import readers, synthetic
+
+    cfg = _load_config(args)
+    ds = cfg.data.dataset
+    out_dir = paths.processed_dir(ds)
+    if args.source == "synthetic":
+        synth = synthetic.generate(args.n_examples, seed=cfg.data.seed)
+        examples = synthetic.to_examples(synth)
+    elif args.source.endswith(".json"):
+        examples = readers.read_devign(args.source, sample=args.sample)
+    else:
+        examples = readers.read_bigvul(args.source, sample=args.sample)
+    if args.splits:
+        splits = readers.read_splits_csv(args.splits)
+    else:
+        splits = readers.random_splits(
+            [e.id for e in examples], seed=cfg.data.seed
+        )
+    with (out_dir / "examples.pkl").open("wb") as f:
+        pickle.dump(examples, f)
+    (out_dir / "splits.json").write_text(
+        json.dumps({str(k): v for k, v in splits.items()})
+    )
+    print(f"prepared {len(examples)} examples -> {out_dir}")
+
+
+def cmd_extract(args) -> None:
+    from deepdfa_tpu.data.pipeline import build_dataset
+    from deepdfa_tpu.graphs import GraphStore
+
+    cfg = _load_config(args)
+    ds = cfg.data.dataset
+    out_dir = paths.processed_dir(ds)
+    with (out_dir / "examples.pkl").open("rb") as f:
+        examples = pickle.load(f)
+    splits = json.loads((out_dir / "splits.json").read_text())
+    train_ids = [int(k) for k, v in splits.items() if v == "train"]
+    specs, vocabs = build_dataset(
+        examples,
+        train_ids=train_ids,
+        limit_all=cfg.data.feat.limit_all,
+        limit_subkeys=cfg.data.feat.limit_subkeys,
+        workers=args.workers,
+    )
+    store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
+    store.write(specs)
+    (out_dir / f"vocab{cfg.data.feat.name}.json").write_text(
+        json.dumps({k: v.to_json() for k, v in vocabs.items()})
+    )
+    print(
+        f"extracted {len(specs)}/{len(examples)} graphs -> {store.directory}"
+    )
+
+
+def _load_graph_splits(cfg: Config):
+    from deepdfa_tpu.graphs import GraphStore
+
+    ds = cfg.data.dataset
+    out_dir = paths.processed_dir(ds)
+    splits = json.loads((out_dir / "splits.json").read_text())
+    store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
+    by_id = store.load_all()
+    out = {"train": [], "val": [], "test": []}
+    for gid, spec in by_id.items():
+        s = splits.get(str(gid))
+        if s in out:
+            out[s].append(spec)
+    return out
+
+
+def _epoch_batches(cfg: Config, specs, mesh, shuffle_epoch=None):
+    import numpy as np
+
+    from deepdfa_tpu.graphs import pack_shards
+    from deepdfa_tpu.train import undersample_epoch
+
+    dp = mesh.shape.get("dp", 1)
+    bcfg = cfg.data.batch
+    bs = max(dp, (cfg.data.batch.graphs_per_batch // dp) * dp)
+    if shuffle_epoch is not None and cfg.data.undersample:
+        labels = np.array([s.label for s in specs])
+        idx = undersample_epoch(labels, shuffle_epoch, seed=cfg.data.seed)
+        sel = [specs[i] for i in idx]
+    else:
+        sel = list(specs)
+    out = []
+    for k in range(0, len(sel), bs):
+        chunk = sel[k : k + bs]
+        out.append(
+            pack_shards(
+                chunk,
+                num_shards=dp,
+                num_graphs=bs // dp,
+                node_budget=bcfg.node_budget,
+                edge_budget=bcfg.edge_budget,
+            )
+        )
+    return out
+
+
+def cmd_train(args) -> None:
+    import jax
+
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train import GraphTrainer, positive_weight
+
+    cfg = _load_config(args)
+    split_specs = _load_graph_splits(cfg)
+    run_dir = paths.runs_dir(cfg.run_name)
+    config_mod.to_json(cfg, run_dir / "config.json")
+
+    mesh = make_mesh(cfg.train.mesh)
+    model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
+    import numpy as np
+
+    pw = None
+    if cfg.train.pos_weight is None and not cfg.data.undersample:
+        pw = positive_weight(np.array([s.label for s in split_specs["train"]]))
+    trainer = GraphTrainer(model, cfg, mesh=mesh, pos_weight=pw)
+
+    batches0 = _epoch_batches(cfg, split_specs["train"], mesh, shuffle_epoch=0)
+    state = trainer.init_state(batches0[0])
+    ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
+
+    log_path = run_dir / "train_log.jsonl"
+
+    def log_fn(rec):
+        with log_path.open("a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    state = trainer.fit(
+        state,
+        lambda epoch: _epoch_batches(cfg, split_specs["train"], mesh, epoch),
+        val_batches=lambda: _epoch_batches(cfg, split_specs["val"], mesh),
+        checkpoints=ckpts,
+        log_fn=log_fn,
+    )
+    print("best:", ckpts.best_metrics())
+
+
+def cmd_test(args) -> None:
+    import jax
+
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train import GraphTrainer, classification_report
+
+    cfg = _load_config(args)
+    split_specs = _load_graph_splits(cfg)
+    run_dir = paths.runs_dir(cfg.run_name)
+    mesh = make_mesh(cfg.train.mesh)
+    model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+
+    batches = _epoch_batches(cfg, split_specs[args.split], mesh)
+    state = trainer.init_state(batches[0])
+    ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
+    params = ckpts.restore(args.checkpoint, jax.device_get(state.params))
+
+    metrics, m = trainer.evaluate(params, batches)
+    print(classification_report(m))
+    print(json.dumps(metrics, indent=2))
+    (run_dir / f"test_metrics_{args.split}.json").write_text(json.dumps(metrics))
+
+    if args.profile:
+        from deepdfa_tpu.eval import profile_model
+
+        def fwd(p, b):
+            return model.apply(p, b)
+
+        import dataclasses as _dc
+
+        from deepdfa_tpu.train.loop import _squeeze_batch
+
+        local = _squeeze_batch(batches[0])
+        rec = profile_model(
+            fwd,
+            (params, local),
+            examples_per_call=int(jax.device_get(local.graph_mask).sum()),
+            out_path=run_dir / "profiledata.jsonl",
+        )
+        print(json.dumps(rec, indent=2))
+
+
+def cmd_coverage(args) -> None:
+    from deepdfa_tpu.eval import coverage_report
+
+    cfg = _load_config(args)
+    split_specs = _load_graph_splits(cfg)
+    print(json.dumps(coverage_report(split_specs), indent=2))
+
+
+def cmd_bench(args) -> None:
+    import bench
+
+    bench.main()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="deepdfa_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("prepare")
+    p.add_argument("--source", required=True, help="csv/json path or 'synthetic'")
+    p.add_argument("--splits", default=None, help="optional splits csv")
+    p.add_argument("--sample", type=int, default=None)
+    p.add_argument("--n-examples", type=int, default=2000)
+    _add_common(p)
+    p.set_defaults(fn=cmd_prepare)
+
+    p = sub.add_parser("extract")
+    p.add_argument("--workers", type=int, default=0)
+    _add_common(p)
+    p.set_defaults(fn=cmd_extract)
+
+    p = sub.add_parser("train")
+    _add_common(p)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("test")
+    p.add_argument("--checkpoint", default="best")
+    p.add_argument("--split", default="test")
+    p.add_argument("--profile", action="store_true")
+    _add_common(p)
+    p.set_defaults(fn=cmd_test)
+
+    p = sub.add_parser("coverage")
+    _add_common(p)
+    p.set_defaults(fn=cmd_coverage)
+
+    p = sub.add_parser("bench")
+    _add_common(p)
+    p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+from deepdfa_tpu.eval.coverage import CoverageStats, coverage, coverage_report
+from deepdfa_tpu.eval.profiling import (
+    ProfileWriter,
+    aggregate_report,
+    compiled_cost,
+    profile_model,
+    time_fn,
+)
+from deepdfa_tpu.eval.statements import (
+    RankedExample,
+    effort_at_recall,
+    ifa,
+    recall_at_effort,
+    statement_report,
+    top_k_accuracy,
+)
+
+__all__ = [
+    "CoverageStats",
+    "coverage",
+    "coverage_report",
+    "ProfileWriter",
+    "aggregate_report",
+    "compiled_cost",
+    "profile_model",
+    "time_fn",
+    "RankedExample",
+    "effort_at_recall",
+    "ifa",
+    "recall_at_effort",
+    "statement_report",
+    "top_k_accuracy",
+]

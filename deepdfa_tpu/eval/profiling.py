@@ -1,0 +1,114 @@
+"""FLOPs + latency profiling of compiled models.
+
+The TPU-native replacement for the reference's DeepSpeed FlopsProfiler +
+torch.cuda.Event harness (DDFA/code_gnn/models/base_module.py:238-323,
+profiledata.jsonl/timedata.jsonl, aggregated by scripts/report_profiling.py
+into the paper's Table 5):
+
+- FLOPs come from XLA's compiled-HLO cost analysis (exact for the compiled
+  program, no module-hook estimation),
+- latency from wall-clock around block_until_ready after warmup,
+- records append to jsonl files with the same role as the reference's, and
+  `aggregate_report` reproduces the GFLOPs / ms-per-example summary.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def compiled_cost(fn, *args) -> dict:
+    """Compile `fn(*args)` and return XLA cost analysis (flops, bytes)."""
+    import jax
+
+    lowered = jax.jit(fn).lower(*args)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "cost_analysis": {
+            k: v for k, v in cost.items() if isinstance(v, (int, float))
+        },
+    }
+
+
+def time_fn(fn, *args, warmup: int = 3, iters: int = 20) -> dict:
+    """Steady-state wall-clock stats (seconds) for jitted `fn(*args)`."""
+    import jax
+
+    jfn = jax.jit(fn)
+    for _ in range(warmup):
+        out = jfn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t = np.array(times)
+    return {
+        "mean_s": float(t.mean()),
+        "p50_s": float(np.percentile(t, 50)),
+        "p95_s": float(np.percentile(t, 95)),
+        "iters": iters,
+    }
+
+
+class ProfileWriter:
+    """Append profiling records to a jsonl file (reference: profiledata
+    .jsonl / timedata.jsonl)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def write(self, record: dict) -> None:
+        with self.path.open("a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+def profile_model(fn, args, examples_per_call: int, out_path=None) -> dict:
+    """One-stop profile: FLOPs + latency, normalized per example."""
+    cost = compiled_cost(fn, *args)
+    timing = time_fn(fn, *args)
+    record = {
+        "examples_per_call": examples_per_call,
+        "gflops_per_call": cost["flops"] / 1e9,
+        "gflops_per_example": cost["flops"] / 1e9 / examples_per_call,
+        "ms_per_call": timing["mean_s"] * 1e3,
+        "ms_per_example": timing["mean_s"] * 1e3 / examples_per_call,
+        "p95_ms_per_call": timing["p95_s"] * 1e3,
+        "bytes_accessed": cost["bytes_accessed"],
+    }
+    if out_path is not None:
+        ProfileWriter(out_path).write(record)
+    return record
+
+
+def aggregate_report(jsonl_path: str | Path) -> dict:
+    """Aggregate a profile jsonl into the Table-5-style summary."""
+    records = [
+        json.loads(line)
+        for line in Path(jsonl_path).read_text().splitlines()
+        if line.strip()
+    ]
+    if not records:
+        return {}
+    n = sum(r["examples_per_call"] for r in records)
+    return {
+        "records": len(records),
+        "total_examples": n,
+        "total_gflops": sum(r["gflops_per_call"] for r in records),
+        "avg_gflops_per_example": float(
+            np.mean([r["gflops_per_example"] for r in records])
+        ),
+        "avg_ms_per_example": float(
+            np.mean([r["ms_per_example"] for r in records])
+        ),
+    }

@@ -60,8 +60,10 @@ def init_params(cfg: CombinedConfig, key: jax.Array) -> dict:
     D = cfg.encoder.hidden_size
     in_dim = D + (cfg.graph_out_dim if cfg.use_graph else 0)
     std = 0.02
+    enc = tfm.init_params(cfg.encoder, k_enc)
+    enc.pop("pooler", None)  # unused by this head; keep it out of adamw
     params = {
-        "encoder": tfm.init_params(cfg.encoder, k_enc),
+        "encoder": enc,
         "head": {
             "dense_w": jax.random.normal(k_head, (in_dim, D)) * std,
             "dense_b": jnp.zeros((D,)),
@@ -147,7 +149,13 @@ def forward(
         )
 
     graph_vec = None
-    if cfg.use_graph and graph_batch is not None:
+    if cfg.use_graph:
+        if graph_batch is None:
+            raise ValueError(
+                "CombinedConfig.use_graph=True requires a graph_batch "
+                "(text-only ablations: set use_graph=False, which sizes "
+                "the head without the graph block)"
+            )
         graph_enc = make_graph_encoder(cfg)
         graph_vec = graph_enc.apply(params["graph"], graph_batch)  # [B, 8H]
         if has_graph is not None:

@@ -238,6 +238,12 @@ def encode(
     """Full encoder: [B, T] ids -> [B, T, D] hidden states."""
     if attn_mask is None:
         attn_mask = input_ids != cfg.pad_token_id
+    if dropout_key is not None and sp_axis is not None:
+        # every sp shard holds different tokens: decorrelate the embed /
+        # residual dropout masks across shards
+        dropout_key = jax.random.fold_in(
+            dropout_key, jax.lax.axis_index(sp_axis)
+        )
     x = embed(cfg, params, input_ids, position_offset, dropout_key)
 
     layers = params["layers"]
@@ -268,6 +274,25 @@ def cls_pool(cfg: TransformerConfig, params: dict, hidden: jax.Array) -> jax.Arr
     cls = hidden[:, 0, :]
     p = params["pooler"]
     return jnp.tanh(cls @ p["w"] + p["b"])
+
+
+def tp_layer_specs():
+    """PartitionSpecs for the stacked layer params under Megatron tensor
+    parallelism: attention heads (axis 2 of [L,D,H,Dh]) and the FFN hidden
+    axis shard over "tp"; everything else replicated. Lives next to
+    init_params so layout changes update exactly one table."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wq": P(None, None, "tp", None), "bq": P(None, "tp", None),
+        "wk": P(None, None, "tp", None), "bk": P(None, "tp", None),
+        "wv": P(None, None, "tp", None), "bv": P(None, "tp", None),
+        "wo": P(None, "tp", None, None), "bo": P(None, None),
+        "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+        "w1": P(None, None, "tp"), "b1": P(None, "tp"),
+        "w2": P(None, "tp", None), "b2": P(None, None),
+        "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -39,6 +39,9 @@ class CheckpointManager:
         """Save under `tag`; update best/last pointers. Returns is_best."""
         path = self.directory / tag
         self._ckpt.save(path, state, force=True)
+        # synchronous semantics: orbax saves are async by default and the
+        # pending commit futures crash at interpreter shutdown otherwise
+        self._ckpt.wait_until_finished()
         entry = {"tag": tag, "step": step, "metrics": metrics}
         self._manifest["history"].append(entry)
         self._manifest["last"] = entry
@@ -46,6 +49,7 @@ class CheckpointManager:
         if is_best:
             best_path = self.directory / "best"
             self._ckpt.save(best_path, state, force=True)
+            self._ckpt.wait_until_finished()
             self._manifest["best"] = entry
         self._manifest_path.write_text(json.dumps(self._manifest, indent=2))
         return is_best

@@ -36,8 +36,6 @@ from deepdfa_tpu.train.state import TrainState, make_optimizer
 
 logger = logging.getLogger(__name__)
 
-_ALL_AXES = ("dp", "tp", "sp")
-
 
 def _graph_batch_struct(num_graphs: int):
     """A GraphBatch-shaped pytree (dummy leaves) for spec construction.
@@ -69,22 +67,6 @@ def _squeeze_batch(batch: TextBatch) -> TextBatch:
         has_graph=batch.has_graph[0],
         graphs=GraphBatch(**garr, num_graphs=g.num_graphs),
     )
-
-
-def _tp_layer_specs() -> dict:
-    """PartitionSpecs for the stacked encoder layers: attention heads and
-    the FFN hidden axis shard over tp (Megatron layout); everything else
-    replicated."""
-    return {
-        "wq": P(None, None, "tp", None), "bq": P(None, "tp", None),
-        "wk": P(None, None, "tp", None), "bk": P(None, "tp", None),
-        "wv": P(None, None, "tp", None), "bv": P(None, "tp", None),
-        "wo": P(None, "tp", None, None), "bo": P(None, None),
-        "ln1_scale": P(None, None), "ln1_bias": P(None, None),
-        "w1": P(None, None, "tp"), "b1": P(None, "tp"),
-        "w2": P(None, "tp", None), "b2": P(None, None),
-        "ln2_scale": P(None, None), "ln2_bias": P(None, None),
-    }
 
 
 class CombinedTrainer:
@@ -123,12 +105,18 @@ class CombinedTrainer:
         def rep(tree):
             return jax.tree.map(lambda _: P(), tree)
 
-        example = cmb.init_params(self.model_cfg, jax.random.key(0))
+        # structure only — eval_shape avoids materializing a throwaway init
+        example = jax.eval_shape(
+            lambda: cmb.init_params(self.model_cfg, jax.random.key(0))
+        )
         specs = {
             "encoder": {
                 "embeddings": rep(example["encoder"]["embeddings"]),
-                "layers": _tp_layer_specs() if self.tp else rep(example["encoder"]["layers"]),
-                "pooler": rep(example["encoder"]["pooler"]),
+                "layers": (
+                    cmb.tfm.tp_layer_specs()
+                    if self.tp
+                    else rep(example["encoder"]["layers"])
+                ),
             },
             "head": rep(example["head"]),
         }
@@ -162,16 +150,16 @@ class CombinedTrainer:
         params = cmb.init_params(self.model_cfg, jax.random.key(seed))
         params = jax.device_put(params, self.param_shardings)
         opt_state = self.tx.init(params)
-        import jax.numpy as _jnp
-
         return TrainState(
-            params=params, opt_state=opt_state, step=_jnp.zeros((), _jnp.int32)
+            params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
         )
 
     def load_encoder(self, state: TrainState, encoder_params) -> TrainState:
         """Swap in pretrained encoder weights (e.g. from params_from_hf_torch)."""
         params = dict(jax.device_get(state.params))
-        params["encoder"] = jax.device_get(encoder_params)
+        enc = dict(jax.device_get(encoder_params))
+        enc.pop("pooler", None)  # combined head never uses it
+        params["encoder"] = enc
         params = jax.device_put(params, self.param_shardings)
         return TrainState(
             params=params, opt_state=self.tx.init(params), step=state.step

@@ -1,0 +1,101 @@
+"""Dataset reader tests against reference-shaped inputs."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from deepdfa_tpu.data import readers
+
+
+def _bigvul_csv(tmp_path, rows):
+    df = pd.DataFrame(rows)
+    p = tmp_path / "MSR_data_cleaned.csv"
+    df.to_csv(p, index=True)
+    return p
+
+
+GOOD_VULN = (
+    "int f(char *s) {\n"
+    "    char buf[8];\n"
+    "    int n = strlen(s);\n"
+    "    strcpy(buf, s);\n"
+    "    n += 1;\n"
+    "    return n;\n"
+    "}"
+)
+GOOD_FIXED = (
+    "int f(char *s) {\n"
+    "    char buf[8];\n"
+    "    int n = strlen(s);\n"
+    "    strncpy(buf, s, 7);\n"
+    "    n += 1;\n"
+    "    return n;\n"
+    "}"
+)
+
+
+def test_read_bigvul_filters(tmp_path):
+    rows = [
+        # clean negative
+        {"func_before": "int a(void) { return 1; }", "func_after": "int a(void) { return 1; }", "vul": 0},
+        # good vulnerable example
+        {"func_before": GOOD_VULN, "func_after": GOOD_FIXED, "vul": 1},
+        # vulnerable but no change -> dropped
+        {"func_before": GOOD_VULN, "func_after": GOOD_VULN, "vul": 1},
+        # vulnerable but truncated (no closing brace) -> dropped
+        {"func_before": "int b(void) { return 1;", "func_after": "int b(void) { return 2;", "vul": 1},
+        # vulnerable but too short -> dropped
+        {"func_before": "int c(void)\n{\nreturn 1;\n}", "func_after": "int c(void)\n{\nreturn 2;\n}", "vul": 1},
+    ]
+    p = _bigvul_csv(tmp_path, rows)
+    exs = readers.read_bigvul(p)
+    by_id = {e.id: e for e in exs}
+    assert 0 in by_id and by_id[0].label == 0.0
+    assert 1 in by_id and by_id[1].label == 1.0
+    assert by_id[1].vuln_lines == frozenset({4})  # the strcpy line
+    assert 2 not in by_id and 3 not in by_id and 4 not in by_id
+    # comments are stripped
+    assert "/*" not in by_id[1].code
+
+
+def test_read_devign(tmp_path):
+    p = tmp_path / "function.json"
+    p.write_text(
+        json.dumps(
+            [
+                {"func": "int x(void) { return 0; } // c", "target": 0},
+                {"func": "int y(int a) { return a; }", "target": 1},
+            ]
+        )
+    )
+    exs = readers.read_devign(p)
+    assert len(exs) == 2
+    assert exs[1].label == 1.0
+    assert exs[1].vuln_lines == frozenset()
+    assert "//" not in exs[0].code
+
+
+def test_splits_roundtrip(tmp_path):
+    df = pd.DataFrame({"id": [0, 1, 2, 3], "split": ["train", "valid", "test", "train"]})
+    p = tmp_path / "splits.csv"
+    df.to_csv(p, index=False)
+    m = readers.read_splits_csv(p)
+    assert m == {0: "train", 1: "val", 2: "test", 3: "train"}
+
+    rs = readers.random_splits(range(100), seed=0)
+    counts = {s: sum(1 for v in rs.values() if v == s) for s in ("train", "val", "test")}
+    assert counts["train"] == 80 and counts["val"] == 10 and counts["test"] == 10
+    assert readers.random_splits(range(100), seed=0) == rs
+
+
+def test_partition_disjoint():
+    from deepdfa_tpu.data.pipeline import Example
+
+    exs = [Example(id=i, code="", label=0.0) for i in range(10)]
+    splits = readers.random_splits(range(10), seed=1)
+    parts = readers.partition(exs, splits)
+    all_ids = [e.id for part in parts.values() for e in part]
+    assert sorted(all_ids) == list(range(10))
+    assert len(set(all_ids)) == 10

@@ -97,19 +97,7 @@ def test_ring_attention_matches_full(rng):
 
 
 def _layer_specs():
-    from jax.sharding import PartitionSpec as P
-
-    # head axis (index 2 of [L,D,H,Dh]) and ffn axis shard over tp
-    return {
-        "wq": P(None, None, "tp", None), "bq": P(None, "tp", None),
-        "wk": P(None, None, "tp", None), "bk": P(None, "tp", None),
-        "wv": P(None, None, "tp", None), "bv": P(None, "tp", None),
-        "wo": P(None, "tp", None, None), "bo": P(None, None),
-        "ln1_scale": P(None, None), "ln1_bias": P(None, None),
-        "w1": P(None, None, "tp"), "b1": P(None, "tp"),
-        "w2": P(None, "tp", None), "b2": P(None, None),
-        "ln2_scale": P(None, None), "ln2_bias": P(None, None),
-    }
+    return tfm.tp_layer_specs()
 
 
 def test_tp_encoder_matches_single(rng):
