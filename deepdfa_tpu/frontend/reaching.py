@@ -92,7 +92,56 @@ class ReachingDefinitions:
             return set()
         return {d for d in definitions if d.var == v and d.node != n}
 
-    def solve(self) -> dict[int, set[Definition]]:
+    def solve(self, backend: str = "auto") -> dict[int, set[Definition]]:
+        """Worklist to fixpoint; returns IN sets per CFG node.
+
+        backend: "python" (the executable spec below), "native" (the C++
+        bitset solver, deepdfa_tpu/native), or "auto" (native when built).
+        """
+        if backend != "python":
+            from deepdfa_tpu import native
+
+            if native.available():
+                return self._solve_native()
+            if backend == "native":
+                raise RuntimeError(
+                    "native backend requested but libdeepdfa_native is "
+                    "unavailable (no toolchain?); build with "
+                    "`python -m deepdfa_tpu.native.build`"
+                )
+        return self._solve_python()
+
+    def _solve_native(self) -> dict[int, set[Definition]]:
+        import numpy as np
+
+        from deepdfa_tpu.native import rd_solve_native
+
+        nodes = self.cfg_nodes
+        dense = {n: i for i, n in enumerate(nodes)}
+        var_ids: dict[str, int] = {}
+        def_var = np.full(len(nodes), -1, np.int32)
+        for n in nodes:
+            v = self._var[n]
+            if v is not None:
+                def_var[dense[n]] = var_ids.setdefault(v, len(var_ids))
+        src, dst = [], []
+        for n in nodes:
+            for s in self.cpg.successors(n, CFG):
+                if s in dense:
+                    src.append(dense[n])
+                    dst.append(dense[s])
+        raw = rd_solve_native(
+            len(nodes), np.array(src, np.int32), np.array(dst, np.int32), def_var
+        )
+        by_node = {
+            d.node: d for s in self.gen_set.values() for d in s
+        }
+        return {
+            nodes[i]: {by_node[nodes[j]] for j in sites}
+            for i, sites in raw.items()
+        }
+
+    def _solve_python(self) -> dict[int, set[Definition]]:
         """Worklist to fixpoint; returns IN sets per CFG node."""
         out: dict[int, set[Definition]] = {n: set() for n in self.cfg_nodes}
         in_: dict[int, set[Definition]] = {n: set() for n in self.cfg_nodes}

@@ -76,7 +76,30 @@ def strip_comments(code: str) -> str:
     return "".join(out)
 
 
-def tokenize(code: str) -> list[Token]:
+def tokenize(code: str, backend: str = "auto") -> list[Token]:
+    """Tokenize C source.
+
+    backend "auto" routes pure-ASCII input through the native C++ lexer
+    when built (bit-identical on ASCII, enforced by tests/test_native.py;
+    native Tokens carry col=0). Non-ASCII input always takes the Python
+    path, whose unicode identifier handling the native lexer does not
+    replicate. "python" forces this implementation.
+    """
+    if backend != "python" and code.isascii():
+        try:
+            from deepdfa_tpu import native
+
+            if native.available():
+                toks = native.lex_c_native(code)
+                toks.append(Token("eof", "", toks[-1].line if toks else 1, 0))
+                return toks
+        except Exception:
+            if backend == "native":
+                raise
+    return _tokenize_python(code)
+
+
+def _tokenize_python(code: str) -> list[Token]:
     code = strip_comments(code)
     toks: list[Token] = []
     line, col = 1, 1

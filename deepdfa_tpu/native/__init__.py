@@ -1,0 +1,142 @@
+"""ctypes bindings for the native host-side kernels.
+
+Loads (building on demand if a toolchain exists) libdeepdfa_native.so and
+exposes:
+  rd_solve_native(...)  — bitset worklist reaching definitions
+  lex_c_native(code)    — C tokenizer returning frontend Token objects
+  available()           — whether the native path can be used
+
+Every binding has a pure-Python equivalent (frontend/reaching.py,
+frontend/tokens.py) that remains the executable spec; parity is enforced
+by tests/test_native.py. Production routing: ReachingDefinitions.solve()
+and frontend.tokens.tokenize() dispatch here automatically (the lexer
+only for pure-ASCII input — its fast path is byte-based and does not
+replicate the Python lexer's unicode identifier handling).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+from pathlib import Path
+
+import numpy as np
+
+_LIB_PATH = Path(__file__).resolve().parent / "libdeepdfa_native.so"
+
+
+@functools.lru_cache()
+def _lib():
+    if not _LIB_PATH.exists():
+        from deepdfa_tpu.native.build import build
+
+        build()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.rd_solve.restype = ctypes.c_int64
+    lib.rd_solve.argtypes = [
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.lex_c.restype = ctypes.c_int64
+    lib.lex_c.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    return lib
+
+
+@functools.lru_cache()
+def available() -> bool:
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def rd_solve_native(
+    n_nodes: int,
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    def_var: np.ndarray,
+) -> dict[int, set[int]]:
+    """IN sets per node as {node: set(def_node_ids)}.
+
+    def_var: [n_nodes] int32, the variable id defined at each node (-1 if
+    the node defines nothing)."""
+    lib = _lib()
+    edge_src = np.ascontiguousarray(edge_src, np.int32)
+    edge_dst = np.ascontiguousarray(edge_dst, np.int32)
+    def_var = np.ascontiguousarray(def_var, np.int32)
+    site_nodes = np.flatnonzero(def_var >= 0)
+    n_words = max(1, (len(site_nodes) + 63) // 64)
+    out = np.zeros((n_nodes, n_words), np.uint64)
+    n_sites = lib.rd_solve(
+        n_nodes,
+        len(edge_src),
+        _ptr(edge_src, ctypes.c_int32),
+        _ptr(edge_dst, ctypes.c_int32),
+        _ptr(def_var, ctypes.c_int32),
+        _ptr(out, ctypes.c_uint64),
+    )
+    if n_sites < 0:
+        raise RuntimeError("rd_solve failed")
+    assert n_sites == len(site_nodes)
+    result: dict[int, set[int]] = {}
+    for n in range(n_nodes):
+        bits = out[n]
+        sites: set[int] = set()
+        for w in range(n_words):
+            word = int(bits[w])
+            while word:
+                b = word & -word
+                sites.add(int(site_nodes[w * 64 + b.bit_length() - 1]))
+                word ^= b
+        result[n] = sites
+    return result
+
+
+_KINDS = ["id", "kw", "num", "str", "char", "op"]
+
+
+def lex_c_native(code: str):
+    """Tokenize with the native lexer; returns frontend Token objects
+    (without the trailing EOF token)."""
+    from deepdfa_tpu.frontend.tokens import Token
+
+    lib = _lib()
+    raw = code.encode("utf-8", errors="replace")
+    max_tokens = max(64, len(raw) + 1)
+    kinds = np.zeros(max_tokens, np.int32)
+    starts = np.zeros(max_tokens, np.int64)
+    ends = np.zeros(max_tokens, np.int64)
+    lines = np.zeros(max_tokens, np.int32)
+    n = lib.lex_c(
+        raw,
+        len(raw),
+        max_tokens,
+        _ptr(kinds, ctypes.c_int32),
+        _ptr(starts, ctypes.c_int64),
+        _ptr(ends, ctypes.c_int64),
+        _ptr(lines, ctypes.c_int32),
+    )
+    if n < 0:
+        raise RuntimeError("lex_c: token budget exceeded")
+    toks = []
+    for i in range(n):
+        text = raw[starts[i] : ends[i]].decode("utf-8", errors="replace")
+        toks.append(Token(_KINDS[kinds[i]], text, int(lines[i]), 0))
+    return toks

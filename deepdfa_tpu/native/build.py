@@ -1,0 +1,44 @@
+"""Build the native library: g++ -O2 -shared -fPIC.
+
+Usage: python -m deepdfa_tpu.native.build
+The library lands next to this file as libdeepdfa_native.so; the ctypes
+loader (deepdfa_tpu.native) builds it on demand when missing.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+SRC = _DIR / "src" / "native.cpp"
+LIB = _DIR / "libdeepdfa_native.so"
+
+
+def build(force: bool = False) -> Path:
+    if LIB.exists() and not force:
+        if LIB.stat().st_mtime >= SRC.stat().st_mtime:
+            return LIB
+    # atomic: concurrent on-demand builds (multiprocessing workers) must
+    # never dlopen a partially written library
+    import os
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "-o", tmp, str(SRC),
+        ]
+        subprocess.run(cmd, check=True)
+        os.replace(tmp, LIB)
+    finally:
+        Path(tmp).unlink(missing_ok=True)
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(f"built {path}")

@@ -1,0 +1,85 @@
+"""Joern export import: reference-format JSON -> Cpg -> downstream parity."""
+
+import json
+
+import pytest
+
+from deepdfa_tpu.frontend import ReachingDefinitions, decl_features, is_decl
+from deepdfa_tpu.frontend.joern_io import load_joern_cpg
+
+
+@pytest.fixture()
+def joern_files(tmp_path):
+    """Hand-built export for: int f(int a) { int x = a + 1; return x; }
+    in joern's node/edge schema."""
+    nodes = [
+        {"id": 1000100, "_label": "METHOD", "name": "f", "code": "f",
+         "lineNumber": 1, "order": 1},
+        {"id": 1000101, "_label": "METHOD_PARAMETER_IN", "name": "a",
+         "code": "int a", "lineNumber": 1, "order": 1, "typeFullName": "int"},
+        {"id": 1000102, "_label": "LOCAL", "name": "x", "code": "int x",
+         "lineNumber": 2, "order": 1, "typeFullName": "int"},
+        {"id": 1000103, "_label": "CALL", "name": "<operator>.assignment",
+         "code": "x = a + 1", "lineNumber": 2, "order": 1},
+        {"id": 1000104, "_label": "IDENTIFIER", "name": "x", "code": "x",
+         "lineNumber": 2, "order": 1, "typeFullName": "int"},
+        {"id": 1000105, "_label": "CALL", "name": "<operator>.addition",
+         "code": "a + 1", "lineNumber": 2, "order": 2},
+        {"id": 1000106, "_label": "IDENTIFIER", "name": "a", "code": "a",
+         "lineNumber": 2, "order": 1, "typeFullName": "int"},
+        {"id": 1000107, "_label": "LITERAL", "name": "", "code": "1",
+         "lineNumber": 2, "order": 2},
+        {"id": 1000108, "_label": "RETURN", "name": "return",
+         "code": "return x;", "lineNumber": 3, "order": 2},
+        {"id": 1000109, "_label": "IDENTIFIER", "name": "x", "code": "x",
+         "lineNumber": 3, "order": 1, "typeFullName": "int"},
+        {"id": 1000110, "_label": "METHOD_RETURN", "name": "RET",
+         "code": "RET", "lineNumber": 1, "order": 3},
+        {"id": 1000111, "_label": "COMMENT", "name": "", "code": "// junk",
+         "lineNumber": 1, "order": 0},
+    ]
+    # [innode, outnode, etype, dataflow] — outnode is the source
+    edges = [
+        [1000103, 1000100, "AST", ""],
+        [1000104, 1000103, "AST", ""], [1000104, 1000103, "ARGUMENT", ""],
+        [1000105, 1000103, "AST", ""], [1000105, 1000103, "ARGUMENT", ""],
+        [1000106, 1000105, "AST", ""], [1000106, 1000105, "ARGUMENT", ""],
+        [1000107, 1000105, "AST", ""], [1000107, 1000105, "ARGUMENT", ""],
+        [1000108, 1000100, "AST", ""],
+        [1000109, 1000108, "AST", ""], [1000109, 1000108, "ARGUMENT", ""],
+        # CFG: METHOD -> assignment -> return -> METHOD_RETURN
+        [1000103, 1000100, "CFG", ""],
+        [1000108, 1000103, "CFG", ""],
+        [1000110, 1000108, "CFG", ""],
+        # filtered edge types
+        [1000103, 1000100, "CONTAINS", ""],
+        [1000108, 1000100, "DOMINATE", ""],
+    ]
+    p = tmp_path / "1.c"
+    (tmp_path / "1.c.nodes.json").write_text(json.dumps(nodes))
+    (tmp_path / "1.c.edges.json").write_text(json.dumps(edges))
+    return p
+
+
+def test_load_and_analyze(joern_files):
+    cpg = load_joern_cpg(joern_files)
+    assert cpg.method_name == "f"
+    labels = [n.label for n in cpg.nodes]
+    assert "COMMENT" not in labels
+    # filtered edges are gone
+    assert all(t not in ("CONTAINS", "DOMINATE") for _, _, t in cpg.edges)
+
+    # reaching definitions over the imported CFG
+    rd = ReachingDefinitions(cpg)
+    assert {d.code for d in rd.domain} == {"x = a + 1"}
+    in_sets = rd.solve()
+    ret = next(n.id for n in cpg.nodes if n.label == "RETURN")
+    assert {d.code for d in in_sets[ret]} == {"x = a + 1"}
+
+    # abstract-dataflow features from the imported AST
+    decls = [n.id for n in cpg.nodes if is_decl(cpg, n.id)]
+    assert len(decls) == 1
+    fields = dict(decl_features(cpg, decls[0]))
+    assert fields["datatype"] == "int"
+    assert fields["literal"] == "1"
+    assert fields["operator"] == "addition"
