@@ -64,8 +64,34 @@ def cmd_prepare(args) -> None:
     print(f"prepared {len(examples)} examples -> {out_dir}")
 
 
+def cmd_extract_vocab(args) -> None:
+    """Build the shared train-split vocabularies (run once before sharded
+    extraction; single-process `extract` does this implicitly)."""
+    from deepdfa_tpu.data.pipeline import build_corpus_vocabs
+
+    cfg = _load_config(args)
+    out_dir = paths.processed_dir(cfg.data.dataset)
+    with (out_dir / "examples.pkl").open("rb") as f:
+        examples = pickle.load(f)
+    splits = json.loads((out_dir / "splits.json").read_text())
+    train_ids = [int(k) for k, v in splits.items() if v == "train"]
+    vocabs = build_corpus_vocabs(
+        examples,
+        train_ids=train_ids,
+        limit_all=cfg.data.feat.limit_all,
+        limit_subkeys=cfg.data.feat.limit_subkeys,
+        workers=args.workers,
+    )
+    vocab_path = out_dir / f"vocab{cfg.data.feat.name}.json"
+    vocab_path.write_text(
+        json.dumps({k: v.to_json() for k, v in vocabs.items()})
+    )
+    print(f"built vocabularies -> {vocab_path}")
+
+
 def cmd_extract(args) -> None:
-    from deepdfa_tpu.data.pipeline import build_dataset
+    from deepdfa_tpu.data.pipeline import build_dataset, encode_corpus
+    from deepdfa_tpu.frontend.vocab import AbsDfVocab
     from deepdfa_tpu.graphs import GraphStore
 
     cfg = _load_config(args)
@@ -75,6 +101,33 @@ def cmd_extract(args) -> None:
         examples = pickle.load(f)
     splits = json.loads((out_dir / "splits.json").read_text())
     train_ids = [int(k) for k, v in splits.items() if v == "train"]
+    vocab_path = out_dir / f"vocab{cfg.data.feat.name}.json"
+    store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
+
+    if args.num_shards > 1:
+        # cluster fan-out (the reference's SLURM job-array sharding,
+        # getgraphs.py:135-156). Every job must encode against the SAME
+        # vocabularies, so they are built up front by `extract-vocab`.
+        if not vocab_path.exists():
+            raise SystemExit(
+                f"sharded extract requires {vocab_path}; run "
+                f"`deepdfa_tpu extract-vocab` first"
+            )
+        vocabs = {
+            k: AbsDfVocab.from_json(v)
+            for k, v in json.loads(vocab_path.read_text()).items()
+        }
+        shard_examples = [
+            e for i, e in enumerate(examples) if i % args.num_shards == args.shard
+        ]
+        specs = encode_corpus(shard_examples, vocabs, workers=args.workers)
+        store.write(specs, tag=f"shard{args.shard:04d}")
+        print(
+            f"extracted shard {args.shard}/{args.num_shards}: "
+            f"{len(specs)}/{len(shard_examples)} graphs -> {store.directory}"
+        )
+        return
+
     specs, vocabs = build_dataset(
         examples,
         train_ids=train_ids,
@@ -82,9 +135,8 @@ def cmd_extract(args) -> None:
         limit_subkeys=cfg.data.feat.limit_subkeys,
         workers=args.workers,
     )
-    store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
     store.write(specs)
-    (out_dir / f"vocab{cfg.data.feat.name}.json").write_text(
+    vocab_path.write_text(
         json.dumps({k: v.to_json() for k, v in vocabs.items()})
     )
     print(
@@ -223,6 +275,122 @@ def cmd_test(args) -> None:
         print(json.dumps(rec, indent=2))
 
 
+def cmd_train_combined(args) -> None:
+    """DeepDFA+LineVul-style combined training over prepared artifacts."""
+    import numpy as np
+
+    from deepdfa_tpu.data.text import collate_shards
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig, params_from_hf_torch
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train import undersample_epoch
+    from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+    cfg = _load_config(args)
+    ds = cfg.data.dataset
+    out_dir = paths.processed_dir(ds)
+    run_dir = paths.runs_dir(cfg.run_name)
+    with (out_dir / "examples.pkl").open("rb") as f:
+        examples = pickle.load(f)
+    splits = json.loads((out_dir / "splits.json").read_text())
+
+    if args.tokenizer:
+        tok_dir = Path(args.tokenizer)
+        vocab = next(tok_dir.glob("*vocab.json"))
+        merges = next(tok_dir.glob("*merges.txt"))
+        tok = BpeTokenizer(vocab, merges)
+    else:
+        tok = HashTokenizer(vocab_size=4096)
+
+    if args.encoder == "codebert-base":
+        enc_cfg = TransformerConfig(dtype="bfloat16")
+    else:
+        enc_cfg = TransformerConfig.tiny(vocab_size=tok.vocab_size)
+    mcfg = cmb.CombinedConfig(
+        encoder=enc_cfg,
+        graph_hidden_dim=cfg.model.hidden_dim,
+        graph_input_dim=cfg.data.feat.input_dim,
+        use_graph=not args.no_graph,
+    )
+
+    from deepdfa_tpu.graphs import GraphStore
+
+    graphs_by_id = (
+        {}
+        if args.no_graph
+        else GraphStore(out_dir / f"graphs{cfg.data.feat.name}").load_all()
+    )
+
+    by_id = {e.id: e for e in examples}
+    # only the splits that are actually batched get tokenized (BPE is the
+    # slow host path; the test split is not touched by training)
+    used_ids = {
+        int(k) for k, v in splits.items() if v in ("train", "val") and int(k) in by_id
+    }
+    token_ids = {}
+    labels = {}
+    for e in examples:
+        if e.id not in used_ids:
+            continue
+        token_ids[e.id] = tok.encode(e.code, max_length=args.max_length)
+        labels[e.id] = int(e.label or 0)
+
+    mesh = make_mesh(cfg.train.mesh)
+    dp = mesh.shape.get("dp", 1)
+    rows_per_shard = max(1, 16 // dp)
+    bs = dp * rows_per_shard
+    trainer = CombinedTrainer(cfg, mcfg, mesh=mesh)
+
+    def split_ids_for(name):
+        return [int(k) for k, v in splits.items() if v == name and int(k) in by_id]
+
+    def batches(ids):
+        out = []
+        for k in range(0, len(ids), bs):
+            sel = ids[k : k + bs]
+            out.append(
+                collate_shards(
+                    np.stack([token_ids[i] for i in sel]),
+                    [labels[i] for i in sel],
+                    sel,
+                    graphs_by_id,
+                    num_shards=dp,
+                    rows_per_shard=rows_per_shard,
+                    node_budget=cfg.data.batch.node_budget,
+                    edge_budget=cfg.data.batch.edge_budget,
+                )
+            )
+        return out
+
+    train_ids = split_ids_for("train")
+    train_labels = np.array([labels[i] for i in train_ids])
+
+    def epoch_batches(epoch):
+        if cfg.data.undersample:
+            idx = undersample_epoch(train_labels, epoch, seed=cfg.data.seed)
+            ids = [train_ids[i] for i in idx]
+        else:
+            ids = train_ids
+        return batches(ids)
+
+    state = trainer.init_state()
+    if args.pretrained:
+        import torch
+
+        sd = torch.load(args.pretrained, map_location="cpu")
+        state = trainer.load_encoder(state, params_from_hf_torch(enc_cfg, sd))
+
+    ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
+    state = trainer.fit(
+        state,
+        epoch_batches,
+        val_batches=lambda: batches(split_ids_for("val")),
+        checkpoints=ckpts,
+    )
+    print("best:", ckpts.best_metrics())
+
+
 def cmd_coverage(args) -> None:
     from deepdfa_tpu.eval import coverage_report
 
@@ -251,8 +419,26 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("extract")
     p.add_argument("--workers", type=int, default=0)
+    p.add_argument("--shard", type=int, default=0, help="job-array shard id")
+    p.add_argument("--num-shards", type=int, default=1)
     _add_common(p)
     p.set_defaults(fn=cmd_extract)
+
+    p = sub.add_parser("extract-vocab")
+    p.add_argument("--workers", type=int, default=0)
+    _add_common(p)
+    p.set_defaults(fn=cmd_extract_vocab)
+
+    p = sub.add_parser("train-combined")
+    p.add_argument("--encoder", default="tiny", help="tiny | codebert-base")
+    p.add_argument("--pretrained", default=None,
+                   help="path to a torch state_dict for the encoder")
+    p.add_argument("--tokenizer", default=None,
+                   help="dir with vocab.json+merges.txt (default: hash tokenizer)")
+    p.add_argument("--max-length", type=int, default=512)
+    p.add_argument("--no-graph", action="store_true")
+    _add_common(p)
+    p.set_defaults(fn=cmd_train_combined)
 
     p = sub.add_parser("train")
     _add_common(p)

@@ -158,6 +158,41 @@ def extract_corpus(
     return [g for g in out if g is not None]
 
 
+def build_corpus_vocabs(
+    examples: Sequence[Example],
+    train_ids: Iterable[int],
+    limit_all: int | None = 1000,
+    limit_subkeys: int | None = 1000,
+    workers: int = 0,
+) -> dict[str, AbsDfVocab]:
+    """Stage 1+2 over the TRAIN split only -> the shared vocabularies.
+
+    This is the reference's abstract_dataflow stage ordering: the vocab is
+    a corpus-level artifact built once before per-graph encoding, so
+    sharded extraction jobs all encode against identical vocabularies."""
+    train = set(train_ids)
+    train_examples = [ex for ex in examples if ex.id in train]
+    graphs = extract_corpus(train_examples, workers=workers)
+    train_fields = [f for g in graphs for f in g.def_fields.values()]
+    return build_vocabs(
+        train_fields, SUBKEY_ORDER, limit_all=limit_all, limit_subkeys=limit_subkeys
+    )
+
+
+def encode_corpus(
+    examples: Sequence[Example],
+    vocabs: Mapping[str, AbsDfVocab],
+    workers: int = 0,
+) -> list[GraphSpec]:
+    """Extract + encode a corpus slice against pre-built vocabularies."""
+    graphs = extract_corpus(examples, workers=workers)
+    by_id = {ex.id: ex for ex in examples}
+    return [
+        to_graph_spec(g, vocabs, set(by_id[g.graph_id].vuln_lines) or None)
+        for g in graphs
+    ]
+
+
 def build_dataset(
     examples: Sequence[Example],
     train_ids: Iterable[int],
@@ -165,7 +200,8 @@ def build_dataset(
     limit_subkeys: int | None = 1000,
     workers: int = 0,
 ) -> tuple[list[GraphSpec], dict[str, AbsDfVocab]]:
-    """Full pipeline: extract, build train-split vocabs, encode everything."""
+    """Full single-process pipeline: extract, build train-split vocabs,
+    encode everything."""
     graphs = extract_corpus(examples, workers=workers)
     train = set(train_ids)
     train_fields = [

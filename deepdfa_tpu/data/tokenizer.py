@@ -34,6 +34,14 @@ class Tokenizer:
     def encode(self, text: str, max_length: int = 512) -> np.ndarray:
         raise NotImplementedError
 
+    def encode_with_lines(
+        self, text: str, max_length: int = 512
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(ids, line_of_token) — 1-based source line per token, 0 for
+        specials/padding. Powers line-level localization
+        (eval/localize.aggregate_line_scores)."""
+        raise NotImplementedError
+
     def batch_encode(self, texts, max_length: int = 512) -> np.ndarray:
         return np.stack([self.encode(t, max_length) for t in texts])
 
@@ -50,19 +58,32 @@ class HashTokenizer(Tokenizer):
         self._first = 4
 
     def encode(self, text: str, max_length: int = 512) -> np.ndarray:
+        return self.encode_with_lines(text, max_length)[0]
+
+    def encode_with_lines(self, text: str, max_length: int = 512):
         import hashlib
 
-        toks = self._WORD.findall(text)
         ids = [self.cls_id]
-        for t in toks[: max_length - 2]:
-            h = int.from_bytes(
-                hashlib.blake2s(t.encode(), digest_size=4).digest(), "little"
-            )
-            ids.append(self._first + h % (self.vocab_size - self._first))
+        lines = [0]
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for m in self._WORD.finditer(line):
+                if len(ids) >= max_length - 1:
+                    break
+                h = int.from_bytes(
+                    hashlib.blake2s(m.group().encode(), digest_size=4).digest(),
+                    "little",
+                )
+                ids.append(self._first + h % (self.vocab_size - self._first))
+                lines.append(lineno)
+            if len(ids) >= max_length - 1:
+                break
         ids.append(self.sep_id)
+        lines.append(0)
         out = np.full((max_length,), self.pad_id, np.int32)
         out[: len(ids)] = ids[:max_length]
-        return out
+        out_lines = np.zeros((max_length,), np.int32)
+        out_lines[: len(lines)] = lines[:max_length]
+        return out, out_lines
 
 
 @lru_cache()
@@ -156,3 +177,28 @@ class BpeTokenizer(Tokenizer):
         out = np.full((max_length,), self.pad_id, np.int32)
         out[: len(ids)] = ids
         return out
+
+    def encode_with_lines(self, text: str, max_length: int = 512):
+        ids = [self.cls_id]
+        lines = [0]
+        pos = 0
+        line = 1
+        for m in self._PAT.finditer(text):
+            chunk = m.group()
+            line += text.count("\n", pos, m.start())
+            pos = m.start()
+            mapped = "".join(self.byte_encoder[b] for b in chunk.encode("utf-8"))
+            for piece in self._bpe(mapped):
+                if len(ids) >= max_length - 1:
+                    break
+                ids.append(self.vocab.get(piece, self.unk_id))
+                lines.append(line)
+            if len(ids) >= max_length - 1:
+                break
+        ids.append(self.sep_id)
+        lines.append(0)
+        out = np.full((max_length,), self.pad_id, np.int32)
+        out[: len(ids)] = ids
+        out_lines = np.zeros((max_length,), np.int32)
+        out_lines[: len(lines)] = lines
+        return out, out_lines

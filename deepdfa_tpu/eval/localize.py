@@ -1,0 +1,103 @@
+"""Line-level vulnerability localization scoring for transformer models.
+
+The reference's UniXcoder evaluation ranks lines by explanation scores
+computed from the fine-tuned model (LineVul/unixcoder/linevul_main.py:
+955-1398): attention aggregation plus captum gradient methods (Saliency,
+InputXGradient/DeepLift-style). TPU-native equivalents:
+
+- `attention_token_scores`: attention mass received by each token from
+  [CLS], averaged over heads and layers (the linevul attention method);
+- `saliency_token_scores`: |d logit_vuln / d embedding . embedding|
+  per token (gradient x input — the first-order common core of the captum
+  family);
+- `aggregate_line_scores`: token scores -> per-line scores through the
+  tokenizer's token->line map (max aggregation like the reference).
+
+Outputs feed eval/statements.py (top-k, IFA, effort metrics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models import transformer as tfm
+
+
+def attention_token_scores(
+    cfg: tfm.TransformerConfig, params: dict, input_ids: jax.Array
+) -> np.ndarray:
+    """[B, T] attention-from-CLS scores averaged over layers and heads."""
+    mask = input_ids != cfg.pad_token_id
+    x = tfm.embed(cfg, params, input_ids)
+    layers = params["layers"]
+    n_layers = layers["wq"].shape[0]
+    acc = jnp.zeros(input_ids.shape, jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    for i in range(n_layers):
+        lp = jax.tree.map(lambda a: a[i], layers)
+        q = jnp.einsum("btd,dhk->bhtk", x, lp["wq"]) + lp["bq"][:, None, :]
+        k = jnp.einsum("btd,dhk->bhtk", x, lp["wk"]) + lp["bk"][:, None, :]
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        s = jnp.where(mask[:, None, None, :], s, neg)
+        p = jax.nn.softmax(s, axis=-1)
+        acc = acc + p[:, :, 0, :].mean(axis=1)  # CLS row, head-averaged
+        x = tfm.encoder_layer(cfg, lp, x, mask, None)
+    return np.asarray(acc / n_layers)
+
+
+def combined_saliency_scores(
+    model_cfg, params, input_ids, graph_batch=None, has_graph=None
+) -> np.ndarray:
+    """Gradient-x-input token scores for the combined classifier's
+    vulnerable-class logit."""
+    from deepdfa_tpu.models import combined as cmb
+
+    ecfg = model_cfg.encoder
+    word = params["encoder"]["embeddings"]["word"]
+    rows = word[input_ids]
+
+    def fn(rows):
+        # patched embed: replace the word-gather with the provided rows
+        e = params["encoder"]["embeddings"]
+        mask = (input_ids != ecfg.pad_token_id).astype(jnp.int32)
+        pos = jnp.cumsum(mask, axis=-1) * mask + ecfg.pad_token_id
+        x = rows + e["position"][pos] + e["token_type"][jnp.zeros_like(input_ids)]
+        x = tfm._layer_norm(x, e["ln_scale"], e["ln_bias"], ecfg.layer_norm_eps)
+        x = x.astype(jnp.dtype(ecfg.dtype))
+        attn_mask = input_ids != ecfg.pad_token_id
+        layers = params["encoder"]["layers"]
+        x, _ = jax.lax.scan(
+            lambda x, lp: (tfm.encoder_layer(ecfg, lp, x, attn_mask, None), None),
+            x,
+            layers,
+        )
+        cls_vec = x[:, 0, :]
+        gvec = None
+        if model_cfg.use_graph and graph_batch is not None:
+            enc = cmb.make_graph_encoder(model_cfg)
+            gvec = enc.apply(params["graph"], graph_batch)
+            if has_graph is not None:
+                gvec = gvec * has_graph[:, None].astype(gvec.dtype)
+        logits = cmb.head_logits(model_cfg, params["head"], cls_vec, gvec)
+        return logits[:, 1].sum()
+
+    grads = jax.grad(fn)(rows)
+    return np.asarray(jnp.linalg.norm(grads * rows, axis=-1))
+
+
+def aggregate_line_scores(
+    token_scores: np.ndarray,
+    token_lines: np.ndarray,
+    n_lines: int,
+    reduce: str = "max",
+) -> np.ndarray:
+    """[T] token scores + [T] 1-based line ids (0 = no line) -> [n_lines]."""
+    out = np.zeros((n_lines,), np.float64)
+    for s, ln in zip(np.asarray(token_scores), np.asarray(token_lines)):
+        if 1 <= ln <= n_lines:
+            i = int(ln) - 1
+            out[i] = max(out[i], float(s)) if reduce == "max" else out[i] + float(s)
+    return out

@@ -1,0 +1,131 @@
+"""Persistent Joern session driver (optional external backend).
+
+The reference drives a long-lived interactive Joern JVM over a pexpect pty
+with per-worker workspaces (DDFA/sastvd/helpers/joern_session.py:35-121);
+this driver provides the same capability on plain subprocess pipes:
+
+    with JoernSession(worker_id=3) as s:
+        s.import_code("/path/to/file.c")
+        s.run_command('cpg.method.name.l')
+        s.export_cpg_json("/path/to/file.c")   # -> .nodes.json/.edges.json
+
+Export output is the format frontend/joern_io.py imports, so Joern-exact
+CPGs flow into the same pipeline as the built-in frontend. The session is
+only usable where a `joern` binary exists (it is an external JVM tool,
+exactly as in the reference); `available()` reports that.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+
+_MARKER = "===DEEPDFA_DONE==="
+
+# scala snippet exporting nodes/edges json for the currently loaded cpg,
+# mirroring the reference export surface (get_func_graph.sc): all nodes
+# with their property map, all edges as [inNode, outNode, label] rows.
+_EXPORT_TEMPLATE = r"""
+{{
+  import java.io.PrintWriter
+  val nodes = cpg.all.map {{ n =>
+    val m = scala.collection.mutable.Map[String, Any]("id" -> n.id, "_label" -> n.label)
+    n.propertiesMap.forEach {{ (k, v) => m(k) = v }}
+    m
+  }}.l
+  def esc(s: String) = s.replace("\\", "\\\\").replace("\"", "\\\"").replace("\n", "\\n").replace("\r", "")
+  def jval(v: Any): String = v match {{
+    case i: java.lang.Integer => i.toString
+    case l: java.lang.Long => l.toString
+    case s: String => "\"" + esc(s) + "\""
+    case other => "\"" + esc(String.valueOf(other)) + "\""
+  }}
+  val nodesJson = nodes.map {{ m =>
+    "{{" + m.map {{ case (k, v) =>
+      val key = if (k == "LINE_NUMBER") "lineNumber" else if (k == "TYPE_FULL_NAME") "typeFullName"
+        else if (k == "NAME") "name" else if (k == "CODE") "code" else if (k == "ORDER") "order" else k
+      "\"" + key + "\": " + jval(v)
+    }}.mkString(", ") + "}}"
+  }}.mkString("[", ",\n", "]")
+  new PrintWriter("{nodes_out}") {{ write(nodesJson); close }}
+  val edgesJson = cpg.graph.edges().map {{ e =>
+    "[" + e.inNode.id + ", " + e.outNode.id + ", \"" + e.label + "\", \"\"]"
+  }}.l.mkString("[", ",\n", "]")
+  new PrintWriter("{edges_out}") {{ write(edgesJson); close }}
+}}
+"""
+
+
+def available() -> bool:
+    return shutil.which("joern") is not None
+
+
+class JoernSession:
+    def __init__(self, worker_id: int = 0, timeout: float = 300.0):
+        if not available():
+            raise RuntimeError("joern binary not on PATH")
+        self.timeout = timeout
+        self.workspace = Path(tempfile.mkdtemp(prefix=f"joern-ws-{worker_id}-"))
+        self.proc = subprocess.Popen(
+            ["joern", "--nocolors"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=self.workspace,
+            text=True,
+            bufsize=1,
+        )
+        self._drain_until_ready()
+
+    # -- protocol ------------------------------------------------------------
+
+    def _drain_until_ready(self) -> None:
+        self.run_command("1 + 1")
+
+    def run_command(self, cmd: str) -> str:
+        """Send one command; collect output up to the marker echo."""
+        assert self.proc.stdin is not None and self.proc.stdout is not None
+        self.proc.stdin.write(cmd + "\n")
+        self.proc.stdin.write(f'println("{_MARKER}")\n')
+        self.proc.stdin.flush()
+        lines: list[str] = []
+        while True:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError("joern session terminated unexpectedly")
+            if _MARKER in line and "println" not in line:
+                break
+            lines.append(line)
+        return "".join(lines)
+
+    # -- operations ----------------------------------------------------------
+
+    def import_code(self, path: str | Path) -> str:
+        return self.run_command(f'importCode("{path}")')
+
+    def export_cpg_json(self, source_path: str | Path) -> tuple[Path, Path]:
+        """Export the loaded CPG next to `source_path` in the reference's
+        .nodes.json/.edges.json layout (loadable by joern_io)."""
+        nodes_out = str(source_path) + ".nodes.json"
+        edges_out = str(source_path) + ".edges.json"
+        script = _EXPORT_TEMPLATE.format(nodes_out=nodes_out, edges_out=edges_out)
+        self.run_command(script)
+        return Path(nodes_out), Path(edges_out)
+
+    def close(self) -> None:
+        try:
+            if self.proc.stdin is not None:
+                self.proc.stdin.write(":exit\n")
+                self.proc.stdin.flush()
+            self.proc.wait(timeout=10)
+        except Exception:
+            self.proc.kill()
+        shutil.rmtree(self.workspace, ignore_errors=True)
+
+    def __enter__(self) -> "JoernSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -85,17 +85,24 @@ def tokenize(code: str, backend: str = "auto") -> list[Token]:
     path, whose unicode identifier handling the native lexer does not
     replicate. "python" forces this implementation.
     """
-    if backend != "python" and code.isascii():
-        try:
+    if backend != "python":
+        is_ascii = code.isascii()
+        if backend == "native" and not is_ascii:
+            raise ValueError(
+                "native lexer only supports ASCII input; use backend='auto'"
+            )
+        if is_ascii:
             from deepdfa_tpu import native
 
             if native.available():
                 toks = native.lex_c_native(code)
                 toks.append(Token("eof", "", toks[-1].line if toks else 1, 0))
                 return toks
-        except Exception:
             if backend == "native":
-                raise
+                raise RuntimeError(
+                    "native backend requested but libdeepdfa_native is "
+                    "unavailable; build with `python -m deepdfa_tpu.native.build`"
+                )
     return _tokenize_python(code)
 
 

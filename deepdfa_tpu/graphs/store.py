@@ -83,12 +83,21 @@ class GraphStore:
     def shard_paths(self) -> list[Path]:
         return sorted(self.directory.glob("graphs-*.npz"))
 
-    def write(self, graphs: Sequence[GraphSpec], shard_size: int = 4096) -> int:
-        existing = len(self.shard_paths())
+    def write(
+        self,
+        graphs: Sequence[GraphSpec],
+        shard_size: int = 4096,
+        tag: str | None = None,
+    ) -> int:
+        """Write npz shards. Concurrent writer jobs MUST pass distinct
+        `tag`s (e.g. the job-array shard id): untagged numbering counts
+        existing files at start time and would collide across processes."""
+        prefix = f"graphs-{tag}-" if tag else "graphs-"
+        existing = len(list(self.directory.glob(f"{prefix}*.npz")))
         n = 0
         for i in range(0, len(graphs), shard_size):
             save_shard(
-                self.directory / f"graphs-{existing + n:05d}.npz",
+                self.directory / f"{prefix}{existing + n:05d}.npz",
                 graphs[i : i + shard_size],
             )
             n += 1

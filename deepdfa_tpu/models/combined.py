@@ -44,15 +44,42 @@ class CombinedConfig:
 
 
 def make_graph_encoder(cfg: CombinedConfig) -> DeepDFA:
-    return DeepDFA(
-        input_dim=cfg.graph_input_dim,
-        hidden_dim=cfg.graph_hidden_dim,
-        n_steps=cfg.graph_n_steps,
+    return make_graph_encoder_for(
+        cfg.graph_input_dim, cfg.graph_hidden_dim, cfg.graph_n_steps
+    )[0]
+
+
+def _dummy_graph_batch() -> GraphBatch:
+    return GraphBatch(
+        node_feats=jnp.zeros((8, 4), jnp.int32),
+        node_vuln=jnp.zeros((8,), jnp.int32),
+        node_graph=jnp.zeros((8,), jnp.int32),
+        node_mask=jnp.ones((8,), bool),
+        edge_src=jnp.zeros((8,), jnp.int32),
+        edge_dst=jnp.zeros((8,), jnp.int32),
+        edge_mask=jnp.ones((8,), bool),
+        graph_label=jnp.zeros((2,)),
+        graph_mask=jnp.ones((2,), bool),
+        graph_ids=jnp.zeros((2,), jnp.int32),
+        num_graphs=2,
+    )
+
+
+def make_graph_encoder_for(
+    graph_input_dim: int, graph_hidden_dim: int, n_steps: int = 5
+) -> tuple[DeepDFA, GraphBatch]:
+    """(encoder-mode GGNN, init dummy batch) — shared by all combined
+    heads (RoBERTa-style and the T5 DefectModel)."""
+    enc = DeepDFA(
+        input_dim=graph_input_dim,
+        hidden_dim=graph_hidden_dim,
+        n_steps=n_steps,
         num_output_layers=0,
         concat_all_absdf=True,
         label_style="graph",
         encoder_mode=True,
     )
+    return enc, _dummy_graph_batch()
 
 
 def init_params(cfg: CombinedConfig, key: jax.Array) -> dict:
@@ -76,20 +103,7 @@ def init_params(cfg: CombinedConfig, key: jax.Array) -> dict:
     }
     if cfg.use_graph:
         graph_enc = make_graph_encoder(cfg)
-        dummy = GraphBatch(
-            node_feats=jnp.zeros((8, 4), jnp.int32),
-            node_vuln=jnp.zeros((8,), jnp.int32),
-            node_graph=jnp.zeros((8,), jnp.int32),
-            node_mask=jnp.ones((8,), bool),
-            edge_src=jnp.zeros((8,), jnp.int32),
-            edge_dst=jnp.zeros((8,), jnp.int32),
-            edge_mask=jnp.ones((8,), bool),
-            graph_label=jnp.zeros((2,)),
-            graph_mask=jnp.ones((2,), bool),
-            graph_ids=jnp.zeros((2,), jnp.int32),
-            num_graphs=2,
-        )
-        params["graph"] = graph_enc.init(k_graph, dummy)
+        params["graph"] = graph_enc.init(k_graph, _dummy_graph_batch())
     return params
 
 

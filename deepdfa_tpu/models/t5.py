@@ -1,0 +1,289 @@
+"""T5-family encoder + defect-classification head (the CodeT5 path).
+
+Re-design of the reference's CodeT5 DefectModel (CodeT5/models.py:125-192:
+T5 encoder, eos-token pooling, Linear(hidden [+ graph out_dim], 2)) in the
+same explicit-pytree style as models/transformer.py.
+
+T5 architectural specifics implemented here (and verified against HF
+FlaxT5EncoderModel in tests/test_t5.py):
+- RMS layer norm (no mean subtraction, no bias), pre-LN residual blocks,
+- bias-free linear projections, NO 1/sqrt(d) attention scaling,
+- bucketed relative position bias (bidirectional) computed once in the
+  first block and shared by all layers,
+- final RMS norm after the last block.
+
+Tensor parallelism: heads / FFN shard over `tp` exactly like the RoBERTa
+encoder, with the relative-bias head axis sharded too; the Megatron region
+ops provide the gradient bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.parallel.megatron import region_end, region_start
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32100
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    ffn_size: int = 3072
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    dropout_rate: float = 0.1
+    eos_token_id: int = 2
+    pad_token_id: int = 0
+    dtype: str = "float32"
+    remat: bool = True
+
+    @classmethod
+    def tiny(cls, **kw) -> "T5Config":
+        base = dict(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            head_dim=16, ffn_size=128,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+def init_params(cfg: T5Config, key: jax.Array) -> dict:
+    k = iter(jax.random.split(key, 12))
+    D, H, Dh, F, L = (
+        cfg.hidden_size, cfg.num_heads, cfg.head_dim, cfg.ffn_size,
+        cfg.num_layers,
+    )
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape) * scale).astype(jnp.float32)
+
+    return {
+        "word": norm(next(k), (cfg.vocab_size, D), 1.0),
+        "rel_bias": norm(next(k), (cfg.rel_buckets, H), 0.1),
+        "layers": {
+            "wq": norm(next(k), (L, D, H, Dh), (D * Dh) ** -0.5),
+            "wk": norm(next(k), (L, D, H, Dh), D**-0.5),
+            "wv": norm(next(k), (L, D, H, Dh), D**-0.5),
+            "wo": norm(next(k), (L, H, Dh, D), (H * Dh) ** -0.5),
+            "ln1": jnp.ones((L, D)),
+            "wi": norm(next(k), (L, D, F), D**-0.5),
+            "wo_ffn": norm(next(k), (L, F, D), F**-0.5),
+            "ln2": jnp.ones((L, D)),
+        },
+        "final_ln": jnp.ones((D,)),
+    }
+
+
+def _rms_norm(x, scale, eps):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    var = (x * x).mean(-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(dt)
+
+
+def relative_position_buckets(
+    q_pos: jax.Array, k_pos: jax.Array, num_buckets: int, max_distance: int
+) -> jax.Array:
+    """T5 bidirectional relative-position bucketing ([Tq, Tk] int32)."""
+    rel = k_pos[None, :] - q_pos[:, None]
+    nb = num_buckets // 2
+    out = jnp.where(rel > 0, nb, 0)
+    n = jnp.abs(rel)
+    max_exact = nb // 2
+    is_small = n < max_exact
+    log_ratio = jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+    log_denom = np.log(max_distance / max_exact)
+    large = max_exact + (log_ratio / log_denom * (nb - max_exact)).astype(jnp.int32)
+    large = jnp.minimum(large, nb - 1)
+    return out + jnp.where(is_small, n, large)
+
+
+def _attention(q, k, v, mask, bias):
+    """T5 attention: NO 1/sqrt(d) scaling; additive position bias."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) + bias[None]
+    neg = jnp.finfo(s.dtype).min
+    s = jnp.where(mask[:, None, None, :], s, neg)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def encode(
+    cfg: T5Config,
+    params: dict,
+    input_ids: jax.Array,
+    attn_mask: jax.Array | None = None,
+    dropout_key: jax.Array | None = None,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    """[B, T] -> [B, T, D] final hidden states (post final-RMSNorm)."""
+    if attn_mask is None:
+        attn_mask = input_ids != cfg.pad_token_id
+    dt = jnp.dtype(cfg.dtype)
+    x = params["word"][input_ids].astype(dt)
+
+    T = input_ids.shape[1]
+    pos = jnp.arange(T)
+    buckets = relative_position_buckets(
+        pos, pos, cfg.rel_buckets, cfg.rel_max_distance
+    )
+    # [Tq, Tk, H] -> [H, Tq, Tk]; head axis shards over tp with the layers
+    bias = params["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
+
+    def layer(x, lp):
+        h_in = _rms_norm(x, lp["ln1"], cfg.layer_norm_eps)
+        h_in = region_start(h_in, tp_axis) if tp_axis is not None else h_in
+        q = jnp.einsum("btd,dhk->bhtk", h_in, lp["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bhtk", h_in, lp["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bhtk", h_in, lp["wv"].astype(dt))
+        ctx = _attention(q, k, v, attn_mask, bias)
+        out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
+        if tp_axis is not None:
+            out = region_end(out, tp_axis)
+        x = x + out
+
+        h2 = _rms_norm(x, lp["ln2"], cfg.layer_norm_eps)
+        h2 = region_start(h2, tp_axis) if tp_axis is not None else h2
+        h2 = jax.nn.relu(jnp.einsum("btd,df->btf", h2, lp["wi"].astype(dt)))
+        h2 = jnp.einsum("btf,fd->btd", h2, lp["wo_ffn"].astype(dt))
+        if tp_axis is not None:
+            h2 = region_end(h2, tp_axis)
+        return x + h2
+
+    fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(lambda x, lp: (fn(x, lp), None), x, params["layers"])
+    return _rms_norm(x, params["final_ln"], cfg.layer_norm_eps)
+
+
+def eos_pool(cfg: T5Config, hidden: jax.Array, input_ids: jax.Array) -> jax.Array:
+    """Hidden state at the LAST eos token per row (reference DefectModel
+    get_t5_vec, CodeT5/models.py:138-152)."""
+    is_eos = input_ids == cfg.eos_token_id
+    T = input_ids.shape[1]
+    # index of last eos (rows without eos fall back to the last position)
+    idx = jnp.where(
+        is_eos.any(axis=1),
+        T - 1 - jnp.argmax(is_eos[:, ::-1], axis=1),
+        T - 1,
+    )
+    return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# HF weight import
+
+
+def params_from_hf_torch(cfg: T5Config, state_dict) -> dict:
+    """Convert a HF torch T5EncoderModel/T5Model state_dict."""
+
+    def get(name):
+        for prefix in ("", "encoder.", "transformer."):
+            k = prefix + name
+            if k in state_dict:
+                return np.asarray(state_dict[k].detach().cpu().numpy())
+        raise KeyError(name)
+
+    D, H, Dh, L = cfg.hidden_size, cfg.num_heads, cfg.head_dim, cfg.num_layers
+
+    def blk(i, name):
+        return get(f"block.{i}.layer.{name}")
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    try:
+        word = get("shared.weight")
+    except KeyError:
+        word = get("embed_tokens.weight")
+    params = {
+        "word": word,
+        "rel_bias": get(
+            "block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ),
+        "layers": {
+            "wq": stack(lambda i: blk(i, "0.SelfAttention.q.weight").T.reshape(D, H, Dh)),
+            "wk": stack(lambda i: blk(i, "0.SelfAttention.k.weight").T.reshape(D, H, Dh)),
+            "wv": stack(lambda i: blk(i, "0.SelfAttention.v.weight").T.reshape(D, H, Dh)),
+            "wo": stack(lambda i: blk(i, "0.SelfAttention.o.weight").T.reshape(H, Dh, D)),
+            "ln1": stack(lambda i: blk(i, "0.layer_norm.weight")),
+            "wi": stack(lambda i: blk(i, "1.DenseReluDense.wi.weight").T),
+            "wo_ffn": stack(lambda i: blk(i, "1.DenseReluDense.wo.weight").T),
+            "ln2": stack(lambda i: blk(i, "1.layer_norm.weight")),
+        },
+        "final_ln": get("final_layer_norm.weight"),
+    }
+    return jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), params)
+
+
+# ---------------------------------------------------------------------------
+# defect classifier
+
+
+@dataclasses.dataclass(frozen=True)
+class DefectConfig:
+    encoder: T5Config
+    graph_hidden_dim: int = 32
+    graph_input_dim: int = 1002
+    num_classes: int = 2
+    use_graph: bool = True
+
+    @property
+    def graph_out_dim(self) -> int:
+        return 8 * self.graph_hidden_dim
+
+
+def init_defect_params(cfg: DefectConfig, key: jax.Array) -> dict:
+    from deepdfa_tpu.models.combined import make_graph_encoder_for
+
+    k_enc, k_graph, k_head = jax.random.split(key, 3)
+    D = cfg.encoder.hidden_size
+    in_dim = D + (cfg.graph_out_dim if cfg.use_graph else 0)
+    params = {
+        "encoder": init_params(cfg.encoder, k_enc),
+        "head": {
+            "w": jax.random.normal(k_head, (in_dim, cfg.num_classes)) * 0.02,
+            "b": jnp.zeros((cfg.num_classes,)),
+        },
+    }
+    if cfg.use_graph:
+        graph_enc, dummy = make_graph_encoder_for(
+            cfg.graph_input_dim, cfg.graph_hidden_dim
+        )
+        params["graph"] = graph_enc.init(k_graph, dummy)
+    return params
+
+
+def defect_forward(
+    cfg: DefectConfig,
+    params: dict,
+    input_ids: jax.Array,
+    graph_batch=None,
+    has_graph: jax.Array | None = None,
+    dropout_key: jax.Array | None = None,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    from deepdfa_tpu.models.combined import make_graph_encoder_for
+
+    hidden = encode(
+        cfg.encoder, params["encoder"], input_ids,
+        dropout_key=dropout_key, tp_axis=tp_axis,
+    )
+    vec = eos_pool(cfg.encoder, hidden, input_ids)
+    if cfg.use_graph:
+        if graph_batch is None:
+            raise ValueError("use_graph=True requires a graph_batch")
+        graph_enc, _ = make_graph_encoder_for(
+            cfg.graph_input_dim, cfg.graph_hidden_dim
+        )
+        gvec = graph_enc.apply(params["graph"], graph_batch)
+        if has_graph is not None:
+            gvec = gvec * has_graph[:, None].astype(gvec.dtype)
+        vec = jnp.concatenate([vec, gvec.astype(vec.dtype)], axis=-1)
+    return vec @ params["head"]["w"] + params["head"]["b"]

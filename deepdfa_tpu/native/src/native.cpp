@@ -220,12 +220,31 @@ int64_t lex_c(const char* code, int64_t len, int64_t max_tokens,
       i = (i + 1 < len) ? i + 2 : len;
       continue;
     }
-    // preprocessor: skip continued line
+    // preprocessor: skip continued line. The Python spec strips comments
+    // BEFORE seeing the '#', so a /* ... */ opening on the directive line
+    // swallows its newlines and the skip must too.
     if (c == '#') {
       while (i < len && code[i] != '\n') {
         if (code[i] == '\\' && i + 1 < len && code[i + 1] == '\n') {
           i += 2;
           ++line;
+        } else if (code[i] == '/' && i + 1 < len && code[i + 1] == '*') {
+          // comment inside the directive: if it spans a newline, the
+          // directive ends there (python strips comments first, so the
+          // first newline inside the comment terminates the # line)
+          bool had_newline = false;
+          i += 2;
+          while (i + 1 < len && !(code[i] == '*' && code[i + 1] == '/')) {
+            if (code[i] == '\n') {
+              ++line;
+              had_newline = true;
+            }
+            ++i;
+          }
+          i = (i + 1 < len) ? i + 2 : len;
+          if (had_newline) break;
+        } else if (code[i] == '/' && i + 1 < len && code[i + 1] == '/') {
+          break;  // line comment ends the directive at the newline
         } else {
           ++i;
         }

@@ -93,6 +93,7 @@ class GatedGraphConv(nn.Module):
     n_steps: int
     n_etypes: int = 1
     param_dtype: jnp.dtype = jnp.float32
+    use_pallas: bool = False  # fused gather+scatter kernel (nn/pallas_ops)
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
@@ -123,8 +124,15 @@ class GatedGraphConv(nn.Module):
             a = jnp.zeros((n, self.out_features), feat.dtype)
             for linear in linears:
                 m = linear(h)  # [N, D] on the MXU
-                msg = m[batch.edge_src] * edge_w  # masked gather
-                a = a + segment_sum(msg, batch.edge_dst, n)
+                if self.use_pallas:
+                    from deepdfa_tpu.nn.pallas_ops import pallas_edge_scatter
+
+                    a = a + pallas_edge_scatter(
+                        m, batch.edge_src, batch.edge_dst, batch.edge_mask
+                    )
+                else:
+                    msg = m[batch.edge_src] * edge_w  # masked gather
+                    a = a + segment_sum(msg, batch.edge_dst, n)
             h = gru(a, h)
         return h
 

@@ -1,0 +1,116 @@
+"""Pallas TPU kernel for GGNN message passing (gather + segment scatter).
+
+The GGNN hot loop is, per step: msg = (W h)[edge_src]; a = scatter-add of
+msg into edge_dst (DGL's C++/CUDA update_all in the reference,
+SURVEY.md §2.4). XLA lowers the gather + segment_sum as separate HBM
+passes over an [E, D] intermediate; this kernel fuses them — transformed
+node states and the accumulator live in VMEM, edges stream through in
+blocks, and no [E, D] message tensor ever exists.
+
+Padding contract: callers remap masked edge slots to a dummy row at index
+N (the kernel operates on [N+1, D] arrays whose last row is zero), so no
+per-edge masking is needed in the inner loop.
+
+VMEM budget: (N+1) * D * 4B * 2 (input + accumulator); with the default
+node budget 16384 and D=128 that is ~16MB, so the pallas path is gated on
+fitting half of VMEM and falls back to jax.ops.segment_sum otherwise —
+same numerics either way (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+EDGE_BLOCK = 2048
+
+
+def _scatter_kernel(src_ref, dst_ref, m_ref, out_ref):
+    """One edge block: out[dst[e]] += m[src[e]] sequentially.
+
+    Grid steps run sequentially on a TPU core, so accumulating into the
+    same full-array output block across steps is safe (revisiting
+    pattern); the first step zeroes the accumulator.
+    """
+    import jax.experimental.pallas as pl
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    def body(e, _):
+        s = src_ref[e]
+        d = dst_ref[e]
+        row = m_ref[pl.ds(s, 1), :]
+        out_ref[pl.ds(d, 1), :] += row
+        return 0
+
+    jax.lax.fori_loop(0, src_ref.shape[0], body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_edge_scatter(
+    m: jax.Array,  # [N, D] transformed node states
+    edge_src: jax.Array,  # [E] int32
+    edge_dst: jax.Array,  # [E] int32
+    edge_mask: jax.Array,  # [E] bool
+    interpret: bool = False,
+) -> jax.Array:
+    """a[v] = sum_{(u,v) in E} m[u]; returns [N, D]."""
+    import jax.experimental.pallas as pl
+
+    n, d = m.shape
+    e = edge_src.shape[0]
+    # dummy zero rows from index n absorb masked edges; row count padded to
+    # the float32 sublane tile (8) so VMEM blocks are aligned
+    n_rows = ((n + 1 + 7) // 8) * 8
+    m_pad = jnp.concatenate(
+        [m, jnp.zeros((n_rows - n, d), m.dtype)], axis=0
+    )
+    src = jnp.where(edge_mask, edge_src, n).astype(jnp.int32)
+    dst = jnp.where(edge_mask, edge_dst, n).astype(jnp.int32)
+    # pad edges to a block multiple (extra slots hit the dummy row)
+    e_pad = ((e + EDGE_BLOCK - 1) // EDGE_BLOCK) * EDGE_BLOCK
+    if e_pad != e:
+        pad = jnp.full((e_pad - e,), n, jnp.int32)
+        src = jnp.concatenate([src, pad])
+        dst = jnp.concatenate([dst, pad])
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (e_pad // EDGE_BLOCK,)
+    # edge indices go to SMEM (scalar reads); node states/accumulator in VMEM
+    idx_spec = (
+        pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,), memory_space=pltpu.SMEM)
+        if not interpret
+        else pl.BlockSpec((EDGE_BLOCK,), lambda i: (i,))
+    )
+    out = pl.pallas_call(
+        _scatter_kernel,
+        grid=grid,
+        in_specs=[
+            idx_spec,
+            idx_spec,
+            pl.BlockSpec((n_rows, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_rows, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_rows, d), m.dtype),
+        interpret=interpret,
+    )(src, dst, m_pad)
+    return out[:n]
+
+
+def edge_scatter_reference(m, edge_src, edge_dst, edge_mask):
+    """The XLA fallback / executable spec."""
+    w = edge_mask.astype(m.dtype)[:, None]
+    return jax.ops.segment_sum(
+        m[edge_src] * w, edge_dst, num_segments=m.shape[0]
+    )
+
+
+def fits_vmem(n: int, d: int, dtype_bytes: int = 4, budget: int = 8 * 2**20) -> bool:
+    return (n + 1) * d * dtype_bytes * 2 <= budget

@@ -1,0 +1,62 @@
+"""Differentiable set operations for bitvector dataflow propagation.
+
+JAX port of the reference's experimental "meet operator" toolkit
+(DDFA/code_gnn/models/clipper.py:6-77): union of soft bitvectors used by
+the bitvector-propagation GGNN variant, where each node state is a
+(0..1)-valued membership vector and message aggregation is set union
+rather than sum.
+
+  simple_union(a, b) = a + b - a*b   (probabilistic OR)
+  relu_union(a, b)   = 1 - relu(1 - (a + b))  (= min(a + b, 1), piecewise
+                       linear; reference test_smoothness semantics)
+
+`segment_union` is the GraphBatch aggregation counterpart of the
+reference's DGL mailbox UDF (dgl_union_factory): a fold of the chosen
+union over each destination node's incoming messages.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def simple_union(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a + b - a * b
+
+
+def relu_union(a: jax.Array, b: jax.Array) -> jax.Array:
+    return 1.0 - jax.nn.relu(1.0 - (a + b))
+
+
+def segment_union(
+    messages: jax.Array,
+    init: jax.Array,
+    segment_ids: jax.Array,
+    mask: jax.Array,
+    union_type: str = "simple",
+) -> jax.Array:
+    """Fold a union over each segment's messages.
+
+    messages: [E, D] soft bitvectors; init: [N, D] starting state per
+    node; segment_ids: [E] destination node per message; mask: [E].
+
+    simple union is associative-and-commutative over products:
+    U_i x_i = 1 - prod_i (1 - x_i), so it reduces with one segment
+    product. relu_union (= clipped sum) reduces with a clipped
+    segment-sum. Both match a sequential fold of the pairwise op.
+    """
+    n = init.shape[0]
+    m = mask.astype(messages.dtype)[:, None]
+    if union_type == "simple":
+        # fold into log-space-free closed form: 1 - (1-init) * prod(1-msg)
+        one_minus = 1.0 - messages * m  # masked slots contribute 1
+        log_terms = jnp.log(jnp.clip(one_minus, 1e-30, 1.0))
+        prod = jnp.exp(
+            jax.ops.segment_sum(log_terms, segment_ids, num_segments=n)
+        )
+        return 1.0 - (1.0 - init) * prod
+    if union_type == "relu":
+        s = jax.ops.segment_sum(messages * m, segment_ids, num_segments=n)
+        return 1.0 - jax.nn.relu(1.0 - (init + s))
+    raise ValueError(f"unknown union_type {union_type}")

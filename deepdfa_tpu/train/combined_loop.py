@@ -99,6 +99,15 @@ class CombinedTrainer:
         self._build_specs()
         self._build_steps()
 
+    def make_checkpoints(self, directory):
+        from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+        return CheckpointManager(
+            directory,
+            monitor=self.cfg.train.monitor,
+            mode=self.cfg.train.monitor_mode,
+        )
+
     # -- sharding layout -----------------------------------------------------
 
     def _build_specs(self) -> None:

@@ -1,0 +1,135 @@
+"""Aux component tests: set-union ops, IVDetect tokenise, localization."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.frontend.tokenise import tokenise, tokenise_lines
+from deepdfa_tpu.nn.setops import relu_union, segment_union, simple_union
+
+
+def test_union_semantics(rng):
+    import jax.numpy as jnp
+
+    a = jnp.array([0.0, 0.0, 1.0, 1.0, 0.3])
+    b = jnp.array([0.0, 1.0, 0.0, 1.0, 0.4])
+    np.testing.assert_allclose(simple_union(a, b), [0, 1, 1, 1, 0.58])
+    np.testing.assert_allclose(relu_union(a, b), [0, 1, 1, 1, 0.7])
+    # relu union == min(a+b, 1) (reference test_smoothness algebra)
+    x = rng.uniform(-2, 2, 50).astype(np.float32)
+    y = rng.uniform(-2, 2, 50).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(relu_union(jnp.array(x), jnp.array(y))),
+        np.minimum(x + y, 1.0),
+        rtol=1e-6,
+    )
+
+
+def test_segment_union_matches_fold(rng):
+    import jax.numpy as jnp
+
+    n, e, d = 4, 10, 6
+    init = rng.uniform(0, 1, (n, d)).astype(np.float32)
+    msgs = rng.uniform(0, 1, (e, d)).astype(np.float32)
+    seg = rng.integers(0, n, (e,))
+    mask = rng.random(e) > 0.3
+    for union_type, op in [("simple", simple_union), ("relu", relu_union)]:
+        got = np.asarray(
+            segment_union(
+                jnp.array(msgs), jnp.array(init), jnp.array(seg),
+                jnp.array(mask), union_type,
+            )
+        )
+        want = init.copy()
+        for i in range(e):
+            if mask[i]:
+                want[seg[i]] = np.asarray(
+                    op(jnp.array(want[seg[i]]), jnp.array(msgs[i]))
+                )
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tokenise_ivdetect():
+    # the reference docstring example
+    out = tokenise("FooBar fooBar foo bar_blub23/x~y'z")
+    assert "Foo" in out and "Bar" in out
+    assert "foo" in out and "blub23" in out
+    # single chars dropped
+    assert " x" not in f" {out} "
+    lines = tokenise_lines("line1a line1b\nline2a asdf\nf f f f f\na")
+    assert len(lines) == 2  # single-char-only lines vanish
+
+
+def test_localization_end_to_end(rng):
+    """Saliency + attention scores flow through line aggregation into the
+    statement metrics."""
+    import jax
+
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.eval.localize import (
+        aggregate_line_scores,
+        attention_token_scores,
+        combined_saliency_scores,
+    )
+    from deepdfa_tpu.eval.statements import RankedExample, statement_report
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    code = "int f(int a) {\n  int x = a;\n  strcpy(b, c);\n  return x;\n}"
+    tok = HashTokenizer(vocab_size=256)
+    ids, tok_lines = tok.encode_with_lines(code, max_length=32)
+    ids = ids[None]
+
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(vocab_size=256, dropout_rate=0.0),
+        graph_hidden_dim=8,
+        graph_input_dim=52,
+        head_dropout=0.0,
+        use_graph=False,
+    )
+    params = cmb.init_params(mcfg, jax.random.key(0))
+
+    att = attention_token_scores(mcfg.encoder, params["encoder"], ids)
+    assert att.shape == ids.shape
+    assert np.isfinite(att).all()
+
+    sal = combined_saliency_scores(mcfg, params, ids)
+    assert sal.shape == ids.shape
+    assert np.isfinite(sal).all()
+    assert sal.max() > 0
+
+    n_lines = 5
+    line_scores = aggregate_line_scores(sal[0], tok_lines, n_lines)
+    assert line_scores.shape == (n_lines,)
+    flagged = np.zeros(n_lines, bool)
+    flagged[2] = True  # the strcpy line
+    rep = statement_report([RankedExample(line_scores, flagged)])
+    assert 0 <= rep["top_10_acc"] <= 1
+
+
+def test_tokenizer_line_maps():
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+
+    tok = HashTokenizer(vocab_size=256)
+    ids, lines = tok.encode_with_lines("aa bb\ncc\n\ndd", max_length=16)
+    # specials have line 0; tokens map to 1,1,2,4
+    toks = [int(l) for l, i in zip(lines, ids) if l > 0]
+    assert toks == [1, 1, 2, 4]
+
+
+def test_bpe_line_map_matches_reference_assets():
+    from pathlib import Path
+
+    ref = Path("/root/reference/LineVul/linevul/bpe_tokenizer")
+    if not ref.exists():
+        pytest.skip("no local BPE assets")
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer
+
+    tok = BpeTokenizer(
+        ref / "bpe_tokenizer-vocab.json", ref / "bpe_tokenizer-merges.txt"
+    )
+    code = "int f() {\n  return g(x);\n}"
+    ids, lines = tok.encode_with_lines(code, max_length=32)
+    ids2 = tok.encode(code, max_length=32)
+    np.testing.assert_array_equal(ids, ids2)
+    body = [int(l) for l in lines if l > 0]
+    assert min(body) == 1 and max(body) == 3

@@ -74,6 +74,12 @@ def test_lexer_parity_edge_cases(native):
         "/* multi\nline */ int z; // tail",
         'a <<= 2; b >>= 1; c ...',
         '"unterminated',
+        # comments embedded in preprocessor directives (the python spec
+        # strips comments before the '#' skip sees them)
+        "#define A /* multi\nline */ int q;",
+        "#define B /* inline */ junk\nint r;",
+        "#define C // tail comment\nint s;",
+        "#define D \\\n  cont /* x\ny */ int t;",
     ]
     for code in cases:
         py = [(t.kind, t.text, t.line) for t in tokenize(code, backend="python") if t.kind != "eof"]

@@ -1,0 +1,92 @@
+"""T5 encoder parity vs HF + DefectModel behavior."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.models import t5 as t5m
+
+
+def test_matches_hf_t5_encoder(rng):
+    torch = pytest.importorskip("torch")
+    from transformers import T5Config as HFT5Config, T5EncoderModel
+
+    hf_cfg = HFT5Config(
+        vocab_size=256,
+        d_model=64,
+        num_layers=2,
+        num_heads=4,
+        d_kv=16,
+        d_ff=128,
+        relative_attention_num_buckets=32,
+        relative_attention_max_distance=128,
+        dropout_rate=0.0,
+        feed_forward_proj="relu",
+    )
+    tm = T5EncoderModel(hf_cfg).eval()
+
+    cfg = t5m.T5Config.tiny(dropout_rate=0.0, remat=False)
+    params = t5m.params_from_hf_torch(cfg, tm.state_dict())
+
+    ids = rng.integers(3, 256, (2, 20))
+    ids[:, -4:] = 0  # pad
+    ids[:, -5] = 2  # eos
+    mask = (ids != 0).astype(np.int64)
+
+    with torch.no_grad():
+        want = tm(
+            input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)
+        ).last_hidden_state.numpy()
+    got = np.asarray(t5m.encode(cfg, params, ids.astype(np.int32)))
+    # compare non-pad positions (HF computes pad rows too but they are
+    # masked downstream)
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(got[valid], want[valid], rtol=2e-4, atol=2e-4)
+
+
+def test_eos_pool_picks_last_eos():
+    import jax.numpy as jnp
+
+    cfg = t5m.T5Config.tiny()
+    hidden = jnp.arange(2 * 6 * 4, dtype=jnp.float32).reshape(2, 6, 4)
+    ids = np.zeros((2, 6), np.int32)
+    ids[0, 2] = 2
+    ids[0, 4] = 2  # last eos at 4
+    # row 1 has no eos -> falls back to last position
+    out = np.asarray(t5m.eos_pool(cfg, hidden, ids))
+    np.testing.assert_array_equal(out[0], np.asarray(hidden[0, 4]))
+    np.testing.assert_array_equal(out[1], np.asarray(hidden[1, 5]))
+
+
+def test_defect_forward_with_graphs(rng):
+    import jax
+
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs import pack
+
+    cfg = t5m.DefectConfig(
+        encoder=t5m.T5Config.tiny(dropout_rate=0.0, remat=False),
+        graph_hidden_dim=8,
+        graph_input_dim=52,
+    )
+    params = t5m.init_defect_params(cfg, jax.random.key(0))
+    n = 4
+    synth = generate(n, vuln_rate=0.5, seed=3)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(n), limit_all=50, limit_subkeys=50
+    )
+    gb = pack(specs[:n], n, 1024, 4096)
+    ids = rng.integers(3, 256, (n, 16)).astype(np.int32)
+    ids[:, -1] = 2
+    logits = t5m.defect_forward(
+        cfg, params, ids, graph_batch=gb, has_graph=np.ones((n,), bool)
+    )
+    assert logits.shape == (n, 2)
+    assert np.isfinite(np.asarray(logits)).all()
+    # graph zeroing changes the logits
+    logits2 = t5m.defect_forward(
+        cfg, params, ids, graph_batch=gb, has_graph=np.zeros((n,), bool)
+    )
+    assert not np.allclose(np.asarray(logits), np.asarray(logits2))
+    # text-only config must error clearly without a graph
+    with pytest.raises(ValueError):
+        t5m.defect_forward(cfg, params, ids)
