@@ -195,7 +195,7 @@ def cmd_train(args) -> None:
 
     from deepdfa_tpu.models import DeepDFA
     from deepdfa_tpu.parallel import make_mesh
-    from deepdfa_tpu.train import GraphTrainer, positive_weight
+    from deepdfa_tpu.train import GraphTrainer, RunLogger, positive_weight
 
     cfg = _load_config(args)
     split_specs = _load_graph_splits(cfg)
@@ -215,19 +215,14 @@ def cmd_train(args) -> None:
     state = trainer.init_state(batches0[0])
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
 
-    log_path = run_dir / "train_log.jsonl"
-
-    def log_fn(rec):
-        with log_path.open("a") as f:
-            f.write(json.dumps(rec) + "\n")
-
-    state = trainer.fit(
-        state,
-        lambda epoch: _epoch_batches(cfg, split_specs["train"], mesh, epoch),
-        val_batches=lambda: _epoch_batches(cfg, split_specs["val"], mesh),
-        checkpoints=ckpts,
-        log_fn=log_fn,
-    )
+    with RunLogger(run_dir) as run_log:
+        state = trainer.fit(
+            state,
+            lambda epoch: _epoch_batches(cfg, split_specs["train"], mesh, epoch),
+            val_batches=lambda: _epoch_batches(cfg, split_specs["val"], mesh),
+            checkpoints=ckpts,
+            log_fn=run_log.log,
+        )
     print("best:", ckpts.best_metrics())
 
 

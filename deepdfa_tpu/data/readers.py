@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Iterable
+from typing import Iterable, Sequence
 
 import numpy as np
 import pandas as pd
@@ -50,20 +50,24 @@ def _keep_vulnerable(before: str, after: str) -> bool:
     return True
 
 
+def _read_with_ids(csv_path: str | Path, columns: tuple[str, ...]) -> pd.DataFrame:
+    """Read selected Big-Vul csv columns with the row index normalized to
+    an `id` column (pandas surfaces the unnamed index as 'Unnamed: 0')."""
+    df = pd.read_csv(
+        csv_path, usecols=lambda c: c in ("Unnamed: 0",) + columns
+    )
+    if "Unnamed: 0" in df.columns:
+        return df.rename(columns={"Unnamed: 0": "id"})
+    return df.reset_index().rename(columns={"index": "id"})
+
+
 def read_bigvul(
     csv_path: str | Path,
     sample: int | None = None,
 ) -> list[Example]:
     """MSR_data_cleaned.csv schema: func_before/func_after/vul columns,
     row index as example id."""
-    df = pd.read_csv(
-        csv_path,
-        usecols=lambda c: c in ("Unnamed: 0", "func_before", "func_after", "vul"),
-    )
-    if "Unnamed: 0" in df.columns:
-        df = df.rename(columns={"Unnamed: 0": "id"})
-    else:
-        df = df.reset_index().rename(columns={"index": "id"})
+    df = _read_with_ids(csv_path, ("func_before", "func_after", "vul"))
     if sample:
         df = df.head(sample)
     out: list[Example] = []
@@ -109,6 +113,36 @@ def read_splits_csv(path: str | Path) -> dict[int, str]:
         s = str(getattr(row, split_col)).lower()
         mapping[int(getattr(row, id_col))] = rename.get(s, s)
     return mapping
+
+
+def cross_project_splits(
+    csv_path: str | Path,
+    test_projects: Sequence[str] | None = None,
+    holdout_frac: float = 0.2,
+    seed: int = 0,
+) -> dict[int, str]:
+    """Project-disjoint splits for cross-project generalization evaluation
+    (reference paper Table 7: train on some projects, test on unseen ones).
+
+    Reads the `project` column of the Big-Vul csv. Either pass explicit
+    test_projects, or a seeded holdout_frac of projects becomes test and
+    the rest splits train/val 90/10 by example."""
+    df = _read_with_ids(csv_path, ("project",))
+    projects = sorted(df["project"].dropna().unique().tolist())
+    rng = np.random.default_rng(seed)
+    if test_projects is None:
+        n_test = max(1, int(len(projects) * holdout_frac))
+        test_projects = [
+            projects[i] for i in rng.permutation(len(projects))[:n_test]
+        ]
+    test_set = set(test_projects)
+    out: dict[int, str] = {}
+    for row in df.itertuples(index=False):
+        if row.project in test_set:
+            out[int(row.id)] = "test"
+        else:
+            out[int(row.id)] = "train" if rng.random() < 0.9 else "val"
+    return out
 
 
 def random_splits(

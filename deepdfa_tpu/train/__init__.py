@@ -1,5 +1,13 @@
 from deepdfa_tpu.train.checkpoint import CheckpointManager
+from deepdfa_tpu.train.logging import RunLogger
 from deepdfa_tpu.train.loop import GraphTrainer
+from deepdfa_tpu.train.transfer import (
+    freeze_mask,
+    frozen_optimizer,
+    graph_encoder_subset,
+    load_graph_encoder,
+)
+from deepdfa_tpu.train.tuning import SearchSpace, Tuner, grid_search, random_search
 from deepdfa_tpu.train.losses import (
     bce_elements,
     bce_with_logits,
@@ -12,7 +20,16 @@ from deepdfa_tpu.train.state import TrainState, make_optimizer
 
 __all__ = [
     "CheckpointManager",
+    "RunLogger",
     "GraphTrainer",
+    "freeze_mask",
+    "frozen_optimizer",
+    "graph_encoder_subset",
+    "load_graph_encoder",
+    "SearchSpace",
+    "Tuner",
+    "grid_search",
+    "random_search",
     "bce_elements",
     "bce_with_logits",
     "classifier_loss",

@@ -1,0 +1,53 @@
+"""Run logging: jsonl always, TensorBoard when available.
+
+The reference logs through a registered TensorBoardLogger
+(DDFA/code_gnn/my_tb.py, config_default.yaml:4-13) plus persistent file
+logs hard-linked into the run dir (main_cli.py:123-165). Here every run
+writes `train_log.jsonl` unconditionally (machine-readable, append-only)
+and mirrors scalar records into TensorBoard event files when a writer
+implementation is importable (torch's is in the image).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+
+class RunLogger:
+    def __init__(self, run_dir: str | Path, tensorboard: bool = True):
+        self.run_dir = Path(run_dir)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        self.jsonl_path = self.run_dir / "train_log.jsonl"
+        self._tb = None
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=str(self.run_dir / "tb"))
+            except Exception:
+                self._tb = None
+
+    @property
+    def has_tensorboard(self) -> bool:
+        return self._tb is not None
+
+    def log(self, record: dict) -> None:
+        with self.jsonl_path.open("a") as f:
+            f.write(json.dumps(record) + "\n")
+        if self._tb is not None:
+            step = int(record.get("step", record.get("epoch", 0)))
+            for k, v in record.items():
+                if isinstance(v, (int, float)) and k not in ("step", "epoch"):
+                    self._tb.add_scalar(k, float(v), global_step=step)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+            self._tb.close()
+
+    def __enter__(self) -> "RunLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,67 @@
+"""Encoder transfer: load + freeze a pretrained DeepDFA graph encoder.
+
+Reference workflow (--freeze_graph, DDFA/code_gnn/main_cli.py:136-145 and
+the combined training recipe): train the GGNN alone first, then load its
+weights minus the output/pooling layers into the combined model and freeze
+them while the transformer fine-tunes.
+
+JAX equivalents here:
+- `graph_encoder_subset`: strip a trained DeepDFA param tree down to the
+  encoder part (embeddings + GGNN; pooling/head dropped),
+- `load_graph_encoder`: splice it into a combined model's "graph" subtree,
+- `freeze_mask` + `frozen_optimizer`: optax.masked so frozen leaves get
+  zero updates while everything else trains normally.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+
+
+def graph_encoder_subset(deepdfa_params: Any, keep_pooling: bool = True) -> dict:
+    """Keep embeddings + ggnn (+ optionally pooling — the combined model's
+    encoder_mode uses attention pooling, so it transfers too); drop the
+    classification head (reference drops output/pooling layers)."""
+    p = deepdfa_params["params"] if "params" in deepdfa_params else deepdfa_params
+    keep = {"embedding", "ggnn"} | ({"pooling"} if keep_pooling else set())
+    sub = {k: v for k, v in p.items() if k in keep}
+    missing = keep - set(sub)
+    if missing:
+        raise KeyError(f"graph encoder params missing {sorted(missing)}")
+    return {"params": sub}
+
+
+def load_graph_encoder(
+    combined_params: dict, deepdfa_params: Any, keep_pooling: bool = True
+) -> dict:
+    """Return combined params with the graph subtree replaced by the
+    pretrained encoder weights."""
+    sub = graph_encoder_subset(deepdfa_params, keep_pooling)
+    out = dict(combined_params)
+    graph = dict(out["graph"]["params"] if "params" in out["graph"] else out["graph"])
+    graph.update(sub["params"])
+    out["graph"] = {"params": graph}
+    return out
+
+
+def freeze_mask(params: dict, frozen_top_keys: tuple[str, ...] = ("graph",)) -> Any:
+    """Boolean pytree: True = trainable, False = frozen."""
+    return {
+        k: jax.tree.map(lambda _: k not in frozen_top_keys, v)
+        for k, v in params.items()
+    }
+
+
+def frozen_optimizer(
+    tx: optax.GradientTransformation, params: dict,
+    frozen_top_keys: tuple[str, ...] = ("graph",),
+) -> optax.GradientTransformation:
+    """Wrap an optimizer so frozen subtrees receive zero updates."""
+    mask = freeze_mask(params, frozen_top_keys)
+    return optax.chain(
+        optax.masked(tx, mask),
+        optax.masked(optax.set_to_zero(), jax.tree.map(lambda t: not t, mask)),
+    )

@@ -1,0 +1,129 @@
+"""Encoder transfer/freezing, hyperparameter search, run logging."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.train.tuning import SearchSpace, Tuner, grid_search, random_search
+
+
+def test_search_space_and_grid():
+    space = SearchSpace(choices={"model.hidden_dim": [8, 16]})
+    trials = list(grid_search(space))
+    assert trials == [["model.hidden_dim=8"], ["model.hidden_dim=16"]]
+    space2 = SearchSpace(
+        choices={"a": [1]}, ranges={"lr": (1e-5, 1e-2, True)}
+    )
+    samples = list(random_search(space2, 5, seed=0))
+    assert len(samples) == 5
+    for s in samples:
+        lr = float(s[1].split("=")[1])
+        assert 1e-5 <= lr <= 1e-2
+    # deterministic per seed
+    assert samples == list(random_search(space2, 5, seed=0))
+
+
+def test_tuner_ledger_and_best(tmp_path):
+    tuner = Tuner(tmp_path / "ledger.jsonl", monitor="val_f1")
+
+    def train_fn(overrides, report):
+        report({"epoch": 0, "loss": 1.0})
+        h = float(overrides[0].split("=")[1])
+        return {"val_f1": h / 100.0}
+
+    best = tuner.run(grid_search(SearchSpace(choices={"h": [10, 50, 30]})), train_fn)
+    assert best["metric"] == 0.5
+    assert best["overrides"] == ["h=50"]
+    lines = [json.loads(l) for l in (tmp_path / "ledger.jsonl").read_text().splitlines()]
+    assert len(lines) == 3
+    assert lines[1]["is_best"]
+
+
+def test_graph_encoder_transfer_and_freeze():
+    import jax
+
+    from deepdfa_tpu.graphs import GraphSpec, pack
+    from deepdfa_tpu.models import DeepDFA, combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.train.transfer import (
+        frozen_optimizer,
+        graph_encoder_subset,
+        load_graph_encoder,
+    )
+    import optax
+
+    rng = np.random.default_rng(0)
+    # a "trained" standalone DeepDFA
+    model = DeepDFA(input_dim=52, hidden_dim=8)
+    g = GraphSpec(
+        0,
+        rng.integers(0, 52, (5, 4)).astype(np.int32),
+        np.zeros((5,), np.int32),
+        np.array([0, 1], np.int32),
+        np.array([1, 2], np.int32),
+        1.0,
+    )
+    batch = pack([g], 2, 16, 64)
+    dd_params = model.init(jax.random.key(0), batch)
+
+    sub = graph_encoder_subset(dd_params)
+    assert set(sub["params"]) == {"embedding", "ggnn", "pooling"}
+
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(vocab_size=64),
+        graph_hidden_dim=8,
+        graph_input_dim=52,
+    )
+    params = cmb.init_params(mcfg, jax.random.key(1))
+    loaded = load_graph_encoder(params, dd_params)
+    chex = pytest.importorskip("chex")
+    chex.assert_trees_all_close(
+        loaded["graph"]["params"]["ggnn"], dd_params["params"]["ggnn"]
+    )
+
+    # frozen optimizer: graph subtree gets zero updates
+    tx = frozen_optimizer(optax.sgd(0.1), loaded, frozen_top_keys=("graph",))
+    opt_state = tx.init(loaded)
+    grads = jax.tree.map(lambda x: jax.numpy.ones_like(x), loaded)
+    updates, _ = tx.update(grads, opt_state, loaded)
+    graph_updates = jax.tree.leaves(updates["graph"])
+    assert all(float(jax.numpy.abs(u).max()) == 0.0 for u in graph_updates)
+    head_updates = jax.tree.leaves(updates["head"])
+    assert any(float(jax.numpy.abs(u).max()) > 0.0 for u in head_updates)
+
+
+def test_run_logger(tmp_path):
+    from deepdfa_tpu.train.logging import RunLogger
+
+    with RunLogger(tmp_path / "run", tensorboard=True) as lg:
+        lg.log({"epoch": 0, "train_loss": 1.5, "note": "x"})
+        lg.log({"epoch": 1, "train_loss": 1.0})
+    lines = (tmp_path / "run" / "train_log.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+    if lg.has_tensorboard:
+        assert list((tmp_path / "run" / "tb").glob("events*"))
+
+
+def test_cross_project_splits(tmp_path):
+    import pandas as pd
+
+    from deepdfa_tpu.data.readers import cross_project_splits
+
+    df = pd.DataFrame(
+        {"project": ["chrome"] * 10 + ["linux"] * 10 + ["ffmpeg"] * 10}
+    )
+    p = tmp_path / "msr.csv"
+    df.to_csv(p, index=True)
+    splits = cross_project_splits(p, test_projects=["linux"])
+    assert all(splits[i] == "test" for i in range(10, 20))
+    assert all(splits[i] in ("train", "val") for i in range(10))
+    # project-disjointness: no train/val ids share a project with test
+    splits2 = cross_project_splits(p, holdout_frac=0.34, seed=1)
+    test_ids = {i for i, s in splits2.items() if s == "test"}
+    test_projects = {df.iloc[i]["project"] for i in test_ids}
+    other_projects = {
+        df.iloc[i]["project"] for i, s in splits2.items() if s != "test"
+    }
+    assert not (test_projects & other_projects)
