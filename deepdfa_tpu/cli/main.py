@@ -50,8 +50,36 @@ def cmd_prepare(args) -> None:
         examples = readers.read_devign(args.source, sample=args.sample)
     else:
         examples = readers.read_bigvul(args.source, sample=args.sample)
+    if args.dep_closure:
+        # reference statement labeling: changed lines PLUS lines data/
+        # control dependent on them (evaluate.py:194-236 dep-add closure)
+        from deepdfa_tpu.frontend import parse_function
+        from deepdfa_tpu.frontend.deps import dependent_lines
+
+        import dataclasses as _dc
+
+        enriched = []
+        for e in examples:
+            if e.vuln_lines:
+                try:
+                    cpg = parse_function(e.code)
+                    extra = dependent_lines(cpg, set(e.vuln_lines))
+                    e = _dc.replace(
+                        e, vuln_lines=frozenset(set(e.vuln_lines) | extra)
+                    )
+                except ValueError:
+                    pass
+            enriched.append(e)
+        examples = enriched
+
     if args.splits:
         splits = readers.read_splits_csv(args.splits)
+    elif args.cross_project:
+        if args.source == "synthetic" or args.source.endswith(".json"):
+            raise SystemExit(
+                "--cross-project requires a Big-Vul csv with a `project` column"
+            )
+        splits = readers.cross_project_splits(args.source, seed=cfg.data.seed)
     else:
         splits = readers.random_splits(
             [e.id for e in examples], seed=cfg.data.seed
@@ -245,10 +273,59 @@ def cmd_test(args) -> None:
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
     params = ckpts.restore(args.checkpoint, jax.device_get(state.params))
 
-    metrics, m = trainer.evaluate(params, batches)
+    import csv as _csv
+
+    import numpy as np
+
+    # single eval pass feeds metrics, the PR curve, and the export rows
+    m = None
+    rows = []
+    from deepdfa_tpu.train import BinaryClassificationMetrics
+
+    m = BinaryClassificationMetrics()
+    loss_sum = 0.0
+    count = 0.0
+    for batch in batches:
+        probs, labels, mask, per = jax.device_get(
+            trainer.eval_step(params, batch)
+        )
+        m.update(probs, labels, mask)
+        valid = np.asarray(mask, bool)
+        loss_sum += float(np.asarray(per, np.float64)[valid].sum())
+        count += float(valid.sum())
+        ids = np.asarray(batch.graph_ids).reshape(-1)
+        for gid, p, y, v in zip(
+            ids,
+            np.asarray(probs).reshape(-1),
+            np.asarray(labels).reshape(-1),
+            valid.reshape(-1),
+        ):
+            if v and gid >= 0:
+                rows.append((int(gid), float(p), int(y)))
+    metrics = m.compute()
+    metrics["loss"] = loss_sum / count if count else float("nan")
     print(classification_report(m))
     print(json.dumps(metrics, indent=2))
     (run_dir / f"test_metrics_{args.split}.json").write_text(json.dumps(metrics))
+
+    # PR curve artifact (reference: pr.csv / pr_binned.csv)
+    curve = m.pr_curve()
+    with (run_dir / f"pr_{args.split}.csv").open("w", newline="") as f:
+        w = _csv.writer(f)
+        w.writerow(["threshold", "precision", "recall"])
+        for t, p, r in zip(
+            curve["thresholds"], curve["precision"], curve["recall"]
+        ):
+            w.writerow([f"{t:.4f}", f"{p:.6f}", f"{r:.6f}"])
+
+    if args.export:
+        # per-example prediction dump (reference eval_export,
+        # LineVul/unixcoder/linevul_main.py:742-830)
+        with (run_dir / f"predictions_{args.split}.csv").open("w", newline="") as f:
+            w = _csv.writer(f)
+            w.writerow(["id", "prob", "label"])
+            w.writerows(sorted(rows))
+        print(f"exported {len(rows)} predictions")
 
     if args.profile:
         from deepdfa_tpu.eval import profile_model
@@ -400,13 +477,36 @@ def cmd_bench(args) -> None:
     bench.main()
 
 
+def _apply_platform_override() -> None:
+    """DEEPDFA_TPU_PLATFORM=cpu[:N] forces the JAX platform (e.g. run the
+    pipeline on a host whose accelerator tunnel is down, or test multi-chip
+    code on N virtual CPU devices). Must run before any backend use; works
+    even where a sitecustomize pins JAX_PLATFORMS."""
+    import os
+
+    spec = os.environ.get("DEEPDFA_TPU_PLATFORM")
+    if not spec:
+        return
+    platform, _, n = spec.partition(":")
+    import jax
+
+    if n:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    jax.config.update("jax_platforms", platform)
+
+
 def main(argv=None) -> None:
+    _apply_platform_override()
     parser = argparse.ArgumentParser(prog="deepdfa_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("prepare")
     p.add_argument("--source", required=True, help="csv/json path or 'synthetic'")
     p.add_argument("--splits", default=None, help="optional splits csv")
+    p.add_argument("--cross-project", action="store_true",
+                   help="project-disjoint splits from the csv's project column")
+    p.add_argument("--dep-closure", action="store_true",
+                   help="expand line labels with data/control dependents")
     p.add_argument("--sample", type=int, default=None)
     p.add_argument("--n-examples", type=int, default=2000)
     _add_common(p)
@@ -443,6 +543,8 @@ def main(argv=None) -> None:
     p.add_argument("--checkpoint", default="best")
     p.add_argument("--split", default="test")
     p.add_argument("--profile", action="store_true")
+    p.add_argument("--export", action="store_true",
+                   help="write per-example predictions csv")
     _add_common(p)
     p.set_defaults(fn=cmd_test)
 

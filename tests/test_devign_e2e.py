@@ -1,0 +1,81 @@
+"""Devign-style (graph-label-only) dataset end-to-end + long-context sp."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data import build_dataset
+from deepdfa_tpu.data.readers import read_devign
+from deepdfa_tpu.graphs import pack_shards
+from deepdfa_tpu.models import DeepDFA
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train import GraphTrainer
+
+
+def test_devign_reader_to_training(tmp_path, rng):
+    """Graph-level labels only (no line annotations) must flow through the
+    stored graph_label into the loss."""
+    from deepdfa_tpu.data.synthetic import generate
+
+    synth = generate(80, vuln_rate=0.4, seed=6)
+    rows = [{"func": s.before, "target": s.label} for s in synth]
+    p = tmp_path / "function.json"
+    p.write_text(json.dumps(rows))
+
+    examples = read_devign(p)
+    assert all(e.vuln_lines == frozenset() for e in examples)
+    specs, _ = build_dataset(examples, train_ids=range(80), limit_all=100,
+                             limit_subkeys=100)
+    # no node labels anywhere, but graph labels survive
+    assert all(s.node_vuln.sum() == 0 for s in specs)
+    assert any(s.label == 1.0 for s in specs)
+
+    cfg = config_mod.apply_overrides(
+        Config(),
+        ["model.hidden_dim=8", "train.max_epochs=60",
+         "train.optim.learning_rate=0.01"],
+    )
+    mesh = make_mesh(MeshConfig(dp=8))
+    model = DeepDFA.from_config(cfg.model, input_dim=102)
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    batch = pack_shards(specs, 8, 10, 2048, 8192)
+    state = trainer.init_state(batch)
+    state = trainer.fit(state, lambda e: [batch])
+    metrics, _ = trainer.evaluate(state, [batch])
+    # learnable via stored graph labels alone
+    assert metrics["f1"] > 0.8, metrics
+
+
+def test_ring_attention_long_context(rng):
+    """sp=8 over a 512-token sequence: exact vs full attention."""
+    import jax
+    from functools import partial
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    b, h, t, d = 1, 2, 512, 16
+    q = rng.standard_normal((b, h, t, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, t, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, t, d)).astype(np.float32)
+    mask = np.ones((b, t), bool)
+    mask[:, -37:] = False
+
+    want = np.asarray(full_attention(q, k, v, mask))
+    mesh = Mesh(np.array(jax.devices()), ("sp",))
+    ring = shard_map(
+        partial(ring_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, None, "sp", None),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(ring)(q, k, v, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)
