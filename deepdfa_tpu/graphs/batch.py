@@ -6,9 +6,12 @@ DGL's C++/CUDA kernels). XLA wants static shapes, so a batch here is a fixed
 budget of graphs/nodes/edges with padding masks:
 
 - `node_graph` maps every node slot to its graph segment; padding slots map
-  to segment `num_graphs` (one dummy segment sliced off after pooling).
-- padded edge slots carry (0, 0) endpoints and a False mask; message
-  passing multiplies messages by the mask so they contribute zeros.
+  to segment `num_graphs` (one dummy segment sliced off after pooling) —
+  non-decreasing by construction.
+- edge arrays are sorted by destination; padded edge slots carry the
+  maximum node index (node_budget - 1) with a False mask so `edge_dst`
+  stays non-decreasing end to end (segment ops use the
+  indices_are_sorted fast path; messages are masked to zero).
 - self-loop edges are added for every real node, matching the reference's
   graph construction (DDFA/sastvd/scripts/dbize_graphs.py:25 add_self_loop).
 
@@ -50,7 +53,11 @@ class GraphSpec:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class GraphBatch:
-    """Fixed-budget batched graphs (padded; device-ready pytree)."""
+    """Fixed-budget batched graphs (padded; device-ready pytree).
+
+    Invariant (maintained by `pack`, REQUIRED by consumers): `edge_dst` is
+    non-decreasing, with padded slots carrying the maximum node index —
+    message passing uses the indices_are_sorted segment fast path."""
 
     node_feats: jax.Array  # [N, K] int32
     node_vuln: jax.Array  # [N] int32
@@ -117,20 +124,29 @@ def pack(
         node_vuln[n_off : n_off + n] = g.node_vuln
         node_graph[n_off : n_off + n] = gi
         node_mask[n_off : n_off + n] = True
-        edge_src[e_off : e_off + e] = g.edge_src + n_off
-        edge_dst[e_off : e_off + e] = g.edge_dst + n_off
-        edge_mask[e_off : e_off + e] = True
-        e_off += e
+        # graph edges + self loops, sorted by destination: graphs occupy
+        # increasing node ranges, so per-graph sorting makes the whole
+        # batch dst-sorted and segment reductions can use the
+        # indices_are_sorted fast path
+        g_src = g.edge_src + n_off
+        g_dst = g.edge_dst + n_off
         if add_self_loops:
             loop = np.arange(n_off, n_off + n, dtype=np.int32)
-            edge_src[e_off : e_off + n] = loop
-            edge_dst[e_off : e_off + n] = loop
-            edge_mask[e_off : e_off + n] = True
-            e_off += n
+            g_src = np.concatenate([g_src, loop])
+            g_dst = np.concatenate([g_dst, loop])
+        order = np.argsort(g_dst, kind="stable")
+        ne = len(order)
+        edge_src[e_off : e_off + ne] = g_src[order]
+        edge_dst[e_off : e_off + ne] = g_dst[order]
+        edge_mask[e_off : e_off + ne] = True
+        e_off += ne
         graph_label[gi] = g.label
         graph_mask[gi] = True
         graph_ids[gi] = g.graph_id
         n_off += n
+    # padded edge slots carry the largest segment id so dst stays sorted
+    edge_src[e_off:] = max(node_budget - 1, 0)
+    edge_dst[e_off:] = max(node_budget - 1, 0)
 
     return GraphBatch(
         node_feats=node_feats,

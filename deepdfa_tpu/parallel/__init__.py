@@ -1,17 +1,25 @@
+from deepdfa_tpu.parallel.megatron import region_end, region_start
 from deepdfa_tpu.parallel.mesh import (
     AXES,
     dp_sharding,
     make_mesh,
+    maybe_init_distributed,
     put_dp,
     put_replicated,
     replicated,
 )
+from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
 
 __all__ = [
     "AXES",
     "dp_sharding",
     "make_mesh",
+    "maybe_init_distributed",
     "put_dp",
     "put_replicated",
     "replicated",
+    "region_end",
+    "region_start",
+    "full_attention",
+    "ring_attention",
 ]

@@ -24,7 +24,43 @@ from deepdfa_tpu.core.config import MeshConfig
 AXES = ("dp", "tp", "sp")
 
 
+def maybe_init_distributed() -> bool:
+    """Initialize multi-host JAX when launched under a multi-process
+    runtime (TPU pods / DCN-connected slices).
+
+    Uses jax.distributed.initialize(), which auto-discovers coordinator,
+    process count, and process id from the TPU metadata or the standard
+    env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID). After this, jax.devices() spans every host and the
+    same mesh/shard_map code scales across DCN — the multi-host analog of
+    the reference's torch.distributed NCCL init (run_defect.py:143-147).
+
+    No-ops (returns False) in single-process settings.
+    """
+    import os
+
+    global _DISTRIBUTED_INITIALIZED
+    if _DISTRIBUTED_INITIALIZED:
+        return True
+    if not (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
+    ):
+        return False
+    jax.distributed.initialize()
+    _DISTRIBUTED_INITIALIZED = True
+    return True
+
+
+_DISTRIBUTED_INITIALIZED = False
+
+
 def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    if devices is None:
+        # multi-host runtimes must initialize before jax.devices() so the
+        # mesh spans every host's chips (no-op in single-process settings)
+        maybe_init_distributed()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     sizes = dict(dp=cfg.dp if cfg else -1, tp=cfg.tp if cfg else 1, sp=cfg.sp if cfg else 1)

@@ -24,9 +24,9 @@ def graph_labels(batch: GraphBatch) -> jax.Array:
     with the stored graph_label so graph-only-labeled datasets (e.g. Devign:
     no per-statement annotations) are not silently negated."""
     vuln = jnp.where(batch.node_mask, batch.node_vuln, 0)
-    per_graph = segment_max(vuln, batch.node_graph, batch.num_graphs + 1)[
-        : batch.num_graphs
-    ]
+    per_graph = segment_max(
+        vuln, batch.node_graph, batch.num_graphs + 1, indices_are_sorted=True
+    )[: batch.num_graphs]
     derived = jnp.maximum(per_graph, 0).astype(jnp.float32)
     return jnp.maximum(derived, batch.graph_label)
 

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Big-Vul preprocessing (the reference's preprocess.sh pipeline):
+#   prepare -> extract-vocab -> extract (optionally sharded over a cluster)
+# Usage: preprocess_bigvul.sh /path/to/MSR_data_cleaned.csv [num_shards]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CSV="${1:?usage: preprocess_bigvul.sh MSR_data_cleaned.csv [num_shards]}"
+NUM_SHARDS="${2:-1}"
+
+python -m deepdfa_tpu.cli prepare --source "$CSV" --dep-closure
+python -m deepdfa_tpu.cli extract-vocab --workers "$(nproc)"
+
+if [ "$NUM_SHARDS" -gt 1 ]; then
+  # job-array style: run each shard (under SLURM, replace the loop with
+  # --shard "$SLURM_ARRAY_TASK_ID")
+  for s in $(seq 0 $((NUM_SHARDS - 1))); do
+    python -m deepdfa_tpu.cli extract --workers "$(nproc)" \
+        --shard "$s" --num-shards "$NUM_SHARDS"
+  done
+else
+  python -m deepdfa_tpu.cli extract --workers "$(nproc)"
+fi

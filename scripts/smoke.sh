@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# End-to-end smoke run on a synthetic corpus — the framework's analog of
+# the reference's sample-mode path (README "On sample data"): every
+# pipeline stage on small data, no downloads, minutes not hours.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export DEEPDFA_TPU_STORAGE="${DEEPDFA_TPU_STORAGE:-$(mktemp -d)/storage}"
+echo "storage: $DEEPDFA_TPU_STORAGE"
+
+OVERRIDES=(data.feat.limit_all=200 data.feat.limit_subkeys=200)
+
+python -m deepdfa_tpu.cli prepare --source synthetic --n-examples 600
+python -m deepdfa_tpu.cli extract --workers 4 "${OVERRIDES[@]}"
+python -m deepdfa_tpu.cli coverage "${OVERRIDES[@]}"
+python -m deepdfa_tpu.cli train run_name=smoke "${OVERRIDES[@]}" \
+    model.hidden_dim=16 train.max_epochs=60 \
+    train.optim.learning_rate=0.01 data.batch.graphs_per_batch=32
+# argparse quirk: flags must precede the positional override list
+python -m deepdfa_tpu.cli test --export run_name=smoke "${OVERRIDES[@]}" \
+    model.hidden_dim=16 data.batch.graphs_per_batch=32
+echo "smoke OK"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# DeepDFA flagship training (reference train.sh: config_bigvul+config_ggnn)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m deepdfa_tpu.cli train --config configs/bigvul_deepdfa.json "$@"
+python -m deepdfa_tpu.cli test --config configs/bigvul_deepdfa.json --profile "$@"

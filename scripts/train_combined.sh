@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# DeepDFA+LineVul-style combined training (reference msr_train_combined.sh)
+# Usage: train_combined.sh [--tokenizer DIR] [--pretrained pytorch_model.bin]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python -m deepdfa_tpu.cli train-combined \
+    --config configs/bigvul_combined.json --encoder codebert-base "$@"
