@@ -111,25 +111,32 @@ class ReachingDefinitions:
                 )
         return self._solve_python()
 
-    def _solve_native(self) -> dict[int, set[Definition]]:
-        import numpy as np
-
-        from deepdfa_tpu.native import rd_solve_native
-
+    def dense_cfg(self) -> tuple[list[int], dict[int, int], list[int], list[int]]:
+        """(nodes, node->dense index, edge src, edge dst) over the CFG —
+        the shared dense view used by the native solver and by training
+        label builders (nn/bitprop.rd_bit_problem)."""
         nodes = self.cfg_nodes
         dense = {n: i for i, n in enumerate(nodes)}
-        var_ids: dict[str, int] = {}
-        def_var = np.full(len(nodes), -1, np.int32)
-        for n in nodes:
-            v = self._var[n]
-            if v is not None:
-                def_var[dense[n]] = var_ids.setdefault(v, len(var_ids))
         src, dst = [], []
         for n in nodes:
             for s in self.cpg.successors(n, CFG):
                 if s in dense:
                     src.append(dense[n])
                     dst.append(dense[s])
+        return nodes, dense, src, dst
+
+    def _solve_native(self) -> dict[int, set[Definition]]:
+        import numpy as np
+
+        from deepdfa_tpu.native import rd_solve_native
+
+        nodes, dense, src, dst = self.dense_cfg()
+        var_ids: dict[str, int] = {}
+        def_var = np.full(len(nodes), -1, np.int32)
+        for n in nodes:
+            v = self._var[n]
+            if v is not None:
+                def_var[dense[n]] = var_ids.setdefault(v, len(var_ids))
         raw = rd_solve_native(
             len(nodes), np.array(src, np.int32), np.array(dst, np.int32), def_var
         )

@@ -11,11 +11,14 @@ Step (mirroring OUT = gen U (IN - kill) with IN = U over preds of OUT):
     in_v   = segment_union of out_u over incoming edges (nn/setops.py)
     out_v  = union(gen_v, in_v * (1 - kill_v))
 
-Iterated n_steps times from out = gen; with n_steps >= the CFG diameter
-and hard 0/1 gen/kill this EQUALS the worklist solver's fixpoint — tested
-against frontend/reaching.py — while staying differentiable for learned
-gen/kill parameterizations (learned_gate=True blends a learned per-node
-gate into gen/kill, the research knob the reference was reaching for).
+Iterated n_steps times from out = gen; with hard 0/1 gen/kill and
+n_steps >= n_nodes + 1 this EQUALS the worklist solver's fixpoint (a
+definition may need to travel the longest def-clear simple path, which
+can exceed the CFG diameter, and the returned IN lags OUT by one
+iteration — hence the +1). Tested against frontend/reaching.py; stays
+differentiable for learned gen/kill parameterizations (learned_gate=True
+blends a learned per-node gate into kill, the research knob the
+reference was reaching for).
 """
 
 from __future__ import annotations
@@ -25,10 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from deepdfa_tpu.frontend.cpg import CFG, Cpg
+from deepdfa_tpu.frontend.cpg import Cpg
 from deepdfa_tpu.frontend.reaching import ReachingDefinitions
-from deepdfa_tpu.graphs.batch import GraphBatch
-from deepdfa_tpu.nn.setops import segment_union
+from deepdfa_tpu.nn.setops import relu_union, segment_union, simple_union
 
 
 def rd_bit_problem(cpg: Cpg, max_defs: int):
@@ -39,8 +41,7 @@ def rd_bit_problem(cpg: Cpg, max_defs: int):
     definition site in node order.
     """
     rd = ReachingDefinitions(cpg)
-    nodes = rd.cfg_nodes
-    dense = {n: i for i, n in enumerate(nodes)}
+    nodes, dense, src, dst = rd.dense_cfg()
     sites = [n for n in nodes if rd.gen_set[n]]
     if not sites or len(sites) > max_defs:
         return None
@@ -62,22 +63,18 @@ def rd_bit_problem(cpg: Cpg, max_defs: int):
             if var_of_site[s] == d.var and s != n:
                 kill[dense[n], site_idx[s]] = 1.0
 
-    src, dst = [], []
-    for n in nodes:
-        for s in cpg.successors(n, CFG):
-            if s in dense:
-                src.append(dense[n])
-                dst.append(dense[s])
-
     in_sets = rd.solve()
     labels_in = np.zeros((n_nodes, max_defs), np.float32)
     for n, defs in in_sets.items():
         for d in defs:
             labels_in[dense[n], site_idx[d.node]] = 1.0
-    out_sets = rd.solve_out()
+    # OUT derives from IN in one pass (no second fixpoint solve)
     labels_out = np.zeros((n_nodes, max_defs), np.float32)
-    for n, defs in out_sets.items():
-        for d in defs:
+    for n in nodes:
+        out_defs = set(rd.gen(n)) | (
+            in_sets[n] - rd.kill(n, in_sets[n])
+        )
+        for d in out_defs:
             labels_out[dense[n], site_idx[d.node]] = 1.0
     return {
         "gen": gen,
@@ -130,8 +127,6 @@ class BitvectorPropagation(nn.Module):
                 self.union_type,
             )
             survived = in_ * (1.0 - kill)
-            if self.union_type == "simple":
-                out = gen + survived - gen * survived
-            else:
-                out = 1.0 - jax.nn.relu(1.0 - (gen + survived))
+            union = simple_union if self.union_type == "simple" else relu_union
+            out = union(gen, survived)
         return in_, out
