@@ -347,6 +347,37 @@ def cmd_test(args) -> None:
         print(json.dumps(rec, indent=2))
 
 
+def _combined_setup(args, cfg):
+    """Tokenizer + encoder config + CombinedConfig shared by
+    train-combined and localize — these must match byte-for-byte for
+    checkpoint restore, so they are built in exactly one place."""
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    if args.tokenizer:
+        tok_dir = Path(args.tokenizer)
+        tok = BpeTokenizer(
+            next(tok_dir.glob("*vocab.json")), next(tok_dir.glob("*merges.txt"))
+        )
+    else:
+        tok = HashTokenizer(vocab_size=4096)
+    if args.encoder == "codebert-base":
+        enc_cfg = TransformerConfig(dtype="bfloat16")
+    else:
+        enc_cfg = TransformerConfig.tiny(
+            vocab_size=tok.vocab_size,
+            max_position_embeddings=args.max_length + 4,
+        )
+    mcfg = cmb.CombinedConfig(
+        encoder=enc_cfg,
+        graph_hidden_dim=cfg.model.hidden_dim,
+        graph_input_dim=cfg.data.feat.input_dim,
+        use_graph=not getattr(args, "no_graph", False),
+    )
+    return tok, enc_cfg, mcfg
+
+
 def cmd_train_combined(args) -> None:
     """DeepDFA+LineVul-style combined training over prepared artifacts."""
     import numpy as np
@@ -367,24 +398,7 @@ def cmd_train_combined(args) -> None:
         examples = pickle.load(f)
     splits = json.loads((out_dir / "splits.json").read_text())
 
-    if args.tokenizer:
-        tok_dir = Path(args.tokenizer)
-        vocab = next(tok_dir.glob("*vocab.json"))
-        merges = next(tok_dir.glob("*merges.txt"))
-        tok = BpeTokenizer(vocab, merges)
-    else:
-        tok = HashTokenizer(vocab_size=4096)
-
-    if args.encoder == "codebert-base":
-        enc_cfg = TransformerConfig(dtype="bfloat16")
-    else:
-        enc_cfg = TransformerConfig.tiny(vocab_size=tok.vocab_size)
-    mcfg = cmb.CombinedConfig(
-        encoder=enc_cfg,
-        graph_hidden_dim=cfg.model.hidden_dim,
-        graph_input_dim=cfg.data.feat.input_dim,
-        use_graph=not args.no_graph,
-    )
+    tok, enc_cfg, mcfg = _combined_setup(args, cfg)
 
     from deepdfa_tpu.graphs import GraphStore
 
@@ -461,6 +475,89 @@ def cmd_train_combined(args) -> None:
         checkpoints=ckpts,
     )
     print("best:", ckpts.best_metrics())
+
+
+def cmd_localize(args) -> None:
+    """Line-level localization evaluation over a trained combined model:
+    saliency (or attention) token scores -> per-line ranking -> top-k /
+    IFA / effort metrics against the labeled vulnerable lines."""
+    import jax
+    import numpy as np
+
+    from deepdfa_tpu.data.text import collate
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+    from deepdfa_tpu.eval.localize import (
+        aggregate_line_scores,
+        attention_token_scores,
+        combined_saliency_scores,
+    )
+    from deepdfa_tpu.eval.statements import RankedExample, statement_report
+    from deepdfa_tpu.graphs import GraphStore
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+    cfg = _load_config(args)
+    out_dir = paths.processed_dir(cfg.data.dataset)
+    run_dir = paths.runs_dir(cfg.run_name)
+    with (out_dir / "examples.pkl").open("rb") as f:
+        examples = pickle.load(f)
+    splits = json.loads((out_dir / "splits.json").read_text())
+
+    tok, enc_cfg, mcfg = _combined_setup(args, cfg)
+    trainer = CombinedTrainer(cfg, mcfg, mesh=make_mesh(cfg.train.mesh))
+    state = trainer.init_state()
+    ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
+    params = ckpts.restore(args.checkpoint, jax.device_get(state.params))
+
+    graphs_by_id = (
+        {}
+        if not mcfg.use_graph
+        else GraphStore(out_dir / f"graphs{cfg.data.feat.name}").load_all()
+    )
+
+    targets = [
+        e
+        for e in examples
+        if splits.get(str(e.id)) == args.split and e.vuln_lines
+    ]
+    if args.limit:
+        targets = targets[: args.limit]
+    ranked = []
+    for e in targets:
+        ids, tok_lines = tok.encode_with_lines(e.code, max_length=args.max_length)
+        b = collate(
+            ids[None], [int(e.label or 0)], [e.id], graphs_by_id,
+            batch_rows=1,
+            node_budget=cfg.data.batch.node_budget,
+            edge_budget=cfg.data.batch.edge_budget,
+        )
+        if args.method == "attention":
+            scores = attention_token_scores(
+                mcfg.encoder, params["encoder"], b.input_ids
+            )
+        else:
+            scores = combined_saliency_scores(
+                mcfg, params, b.input_ids,
+                b.graphs if mcfg.use_graph else None,
+                b.has_graph if mcfg.use_graph else None,
+            )
+        n_lines = len(e.code.splitlines())
+        line_scores = aggregate_line_scores(scores[0], tok_lines, n_lines)
+        flagged = np.zeros(n_lines, bool)
+        for ln in e.vuln_lines:
+            if 1 <= ln <= n_lines:
+                flagged[ln - 1] = True
+        ranked.append(RankedExample(line_scores, flagged))
+
+    report = statement_report(ranked)
+    report["n_examples"] = len(ranked)
+    report["method"] = args.method
+    print(json.dumps(report, indent=2))
+    (run_dir / f"localize_{args.split}_{args.method}.json").write_text(
+        json.dumps(report)
+    )
 
 
 def cmd_coverage(args) -> None:
@@ -547,6 +644,19 @@ def main(argv=None) -> None:
                    help="write per-example predictions csv")
     _add_common(p)
     p.set_defaults(fn=cmd_test)
+
+    p = sub.add_parser("localize")
+    p.add_argument("--no-graph", action="store_true")
+    p.add_argument("--method", default="saliency",
+                   choices=["saliency", "attention"])
+    p.add_argument("--checkpoint", default="best")
+    p.add_argument("--split", default="test")
+    p.add_argument("--encoder", default="tiny")
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--max-length", type=int, default=512)
+    p.add_argument("--limit", type=int, default=None)
+    _add_common(p)
+    p.set_defaults(fn=cmd_localize)
 
     p = sub.add_parser("coverage")
     _add_common(p)

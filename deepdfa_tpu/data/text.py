@@ -48,13 +48,18 @@ def collate(
     batch_rows: int,
     node_budget: int,
     edge_budget: int,
+    pad_id: int = 1,
 ) -> TextBatch:
-    """Build one static-shape TextBatch (n <= batch_rows)."""
+    """Build one static-shape TextBatch (n <= batch_rows).
+
+    pad_id must match the encoder's pad convention (RoBERTa family: 1,
+    T5 family: 0) — padding rows are filled with it and the encoders
+    derive their attention masks from it."""
     n = len(labels)
     if n > batch_rows:
         raise ValueError(f"{n} rows > batch_rows {batch_rows}")
     T = token_ids.shape[1]
-    ids = np.ones((batch_rows, T), np.int32)  # pad_token_id = 1
+    ids = np.full((batch_rows, T), pad_id, np.int32)
     ids[:n] = token_ids
     lab = np.zeros((batch_rows,), np.int32)
     lab[:n] = np.asarray(labels, np.int32)
@@ -100,6 +105,7 @@ def collate_shards(
     rows_per_shard: int,
     node_budget: int,
     edge_budget: int,
+    pad_id: int = 1,
 ) -> TextBatch:
     """Shard rows round-robin and stack shard batches on a leading dp axis."""
     n = len(labels)
@@ -119,6 +125,7 @@ def collate_shards(
                 rows_per_shard,
                 node_budget,
                 edge_budget,
+                pad_id=pad_id,
             )
         )
     stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)

@@ -47,14 +47,21 @@ class Tokenizer:
 
 
 class HashTokenizer(Tokenizer):
-    """Deterministic hash-bucket tokenizer (tests / synthetic corpora)."""
+    """Deterministic hash-bucket tokenizer (tests / synthetic corpora).
+
+    Default special ids follow the RoBERTa frame (cls 0 / pad 1 / sep 2);
+    pass t5_frame=True for the T5 convention (pad 0 / sep==eos 2) so the
+    encoder's pad-derived attention mask and eos pooling line up."""
 
     _WORD = re.compile(r"[A-Za-z_][A-Za-z0-9_]*|\d+|\S")
 
-    def __init__(self, vocab_size: int = 4096):
+    def __init__(self, vocab_size: int = 4096, t5_frame: bool = False):
         assert vocab_size > 8
         self.vocab_size = vocab_size
-        self.cls_id, self.sep_id, self.pad_id, self.unk_id = 0, 2, 1, 3
+        if t5_frame:
+            self.pad_id, self.cls_id, self.sep_id, self.unk_id = 0, 1, 2, 3
+        else:
+            self.cls_id, self.sep_id, self.pad_id, self.unk_id = 0, 2, 1, 3
         self._first = 4
 
     def encode(self, text: str, max_length: int = 512) -> np.ndarray:

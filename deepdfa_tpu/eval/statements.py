@@ -60,6 +60,8 @@ def effort_at_recall(
 ) -> float:
     """Fraction of all statements inspected (global ranking) to reach
     `recall_frac` of all true vulnerable statements (Effort@20%Recall)."""
+    if not examples:
+        return 0.0
     scores = np.concatenate([np.asarray(e.scores) for e in examples])
     flags = np.concatenate([np.asarray(e.flagged) for e in examples])
     if not flags.any():
@@ -76,6 +78,8 @@ def recall_at_effort(
 ) -> float:
     """Recall of true statements within the top `effort_frac` of the
     global statement ranking (Recall@1%LOC)."""
+    if not examples:
+        return 0.0
     scores = np.concatenate([np.asarray(e.scores) for e in examples])
     flags = np.concatenate([np.asarray(e.flagged) for e in examples])
     if not flags.any():

@@ -124,10 +124,16 @@ def encode(
     tp_axis: str | None = None,
 ) -> jax.Array:
     """[B, T] -> [B, T, D] final hidden states (post final-RMSNorm)."""
+    from deepdfa_tpu.models.transformer import _dropout
+
     if attn_mask is None:
         attn_mask = input_ids != cfg.pad_token_id
     dt = jnp.dtype(cfg.dtype)
     x = params["word"][input_ids].astype(dt)
+    k_embed = k_layers = k_final = None
+    if dropout_key is not None and cfg.dropout_rate > 0.0:
+        k_embed, k_layers, k_final = jax.random.split(dropout_key, 3)
+    x = _dropout(x, cfg.dropout_rate, k_embed)
 
     T = input_ids.shape[1]
     pos = jnp.arange(T)
@@ -137,7 +143,11 @@ def encode(
     # [Tq, Tk, H] -> [H, Tq, Tk]; head axis shards over tp with the layers
     bias = params["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
 
-    def layer(x, lp):
+    def layer(x, inputs):
+        lp, key = inputs
+        k1 = k2 = None
+        if key is not None:
+            k1, k2 = jax.random.split(key)
         h_in = _rms_norm(x, lp["ln1"], cfg.layer_norm_eps)
         h_in = region_start(h_in, tp_axis) if tp_axis is not None else h_in
         q = jnp.einsum("btd,dhk->bhtk", h_in, lp["wq"].astype(dt))
@@ -147,7 +157,7 @@ def encode(
         out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
         if tp_axis is not None:
             out = region_end(out, tp_axis)
-        x = x + out
+        x = x + _dropout(out, cfg.dropout_rate, k1)
 
         h2 = _rms_norm(x, lp["ln2"], cfg.layer_norm_eps)
         h2 = region_start(h2, tp_axis) if tp_axis is not None else h2
@@ -155,11 +165,23 @@ def encode(
         h2 = jnp.einsum("btf,fd->btd", h2, lp["wo_ffn"].astype(dt))
         if tp_axis is not None:
             h2 = region_end(h2, tp_axis)
-        return x + h2
+        return x + _dropout(h2, cfg.dropout_rate, k2)
 
     fn = jax.checkpoint(layer) if cfg.remat else layer
-    x, _ = jax.lax.scan(lambda x, lp: (fn(x, lp), None), x, params["layers"])
-    return _rms_norm(x, params["final_ln"], cfg.layer_norm_eps)
+    n_layers = params["layers"]["wq"].shape[0]
+    keys = (
+        jax.random.split(k_layers, n_layers) if k_layers is not None else None
+    )
+    if keys is None:
+        x, _ = jax.lax.scan(
+            lambda x, lp: (fn(x, (lp, None)), None), x, params["layers"]
+        )
+    else:
+        x, _ = jax.lax.scan(
+            lambda x, inp: (fn(x, inp), None), x, (params["layers"], keys)
+        )
+    x = _rms_norm(x, params["final_ln"], cfg.layer_norm_eps)
+    return _dropout(x, cfg.dropout_rate, k_final)
 
 
 def eos_pool(cfg: T5Config, hidden: jax.Array, input_ids: jax.Array) -> jax.Array:
@@ -174,6 +196,23 @@ def eos_pool(cfg: T5Config, hidden: jax.Array, input_ids: jax.Array) -> jax.Arra
         T - 1,
     )
     return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)[:, 0, :]
+
+
+def tp_layer_specs():
+    """Megatron PartitionSpecs for the stacked T5 layer params (heads and
+    FFN hidden shard over "tp"; norms replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wq": P(None, None, "tp", None),
+        "wk": P(None, None, "tp", None),
+        "wv": P(None, None, "tp", None),
+        "wo": P(None, "tp", None, None),
+        "ln1": P(None, None),
+        "wi": P(None, None, "tp"),
+        "wo_ffn": P(None, "tp", None),
+        "ln2": P(None, None),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -86,15 +86,25 @@ class CombinedTrainer:
     def __init__(
         self,
         cfg: Config,
-        model_cfg: cmb.CombinedConfig,
+        model_cfg,
         mesh: Mesh | None = None,
         total_steps: int | None = None,
     ):
+        """model_cfg: cmb.CombinedConfig (RoBERTa-family, LineVul/UniXcoder
+        style) or t5.DefectConfig (CodeT5 style, eos pooling)."""
+        from deepdfa_tpu.models import t5 as t5m
+
         self.cfg = cfg
         self.model_cfg = model_cfg
+        self.is_t5 = isinstance(model_cfg, t5m.DefectConfig)
         self.mesh = mesh if mesh is not None else make_mesh(cfg.train.mesh)
         self.tp = self.mesh.shape.get("tp", 1) > 1
         self.sp = self.mesh.shape.get("sp", 1) > 1
+        if self.is_t5 and self.sp:
+            raise NotImplementedError(
+                "sequence parallelism is not wired for the T5 encoder "
+                "(relative position bias needs per-shard bias blocks)"
+            )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         self._build_specs()
         self._build_steps()
@@ -110,25 +120,37 @@ class CombinedTrainer:
 
     # -- sharding layout -----------------------------------------------------
 
+    def _init_params_fn(self):
+        from deepdfa_tpu.models import t5 as t5m
+
+        return t5m.init_defect_params if self.is_t5 else cmb.init_params
+
     def _build_specs(self) -> None:
+        from deepdfa_tpu.models import t5 as t5m
+
         def rep(tree):
             return jax.tree.map(lambda _: P(), tree)
 
         # structure only — eval_shape avoids materializing a throwaway init
+        init_fn = self._init_params_fn()
         example = jax.eval_shape(
-            lambda: cmb.init_params(self.model_cfg, jax.random.key(0))
+            lambda: init_fn(self.model_cfg, jax.random.key(0))
         )
-        specs = {
-            "encoder": {
+        if self.is_t5:
+            enc_specs = rep(example["encoder"])
+            if self.tp:
+                enc_specs["layers"] = t5m.tp_layer_specs()
+                enc_specs["rel_bias"] = P(None, "tp")
+        else:
+            enc_specs = {
                 "embeddings": rep(example["encoder"]["embeddings"]),
                 "layers": (
                     cmb.tfm.tp_layer_specs()
                     if self.tp
                     else rep(example["encoder"]["layers"])
                 ),
-            },
-            "head": rep(example["head"]),
-        }
+            }
+        specs = {"encoder": enc_specs, "head": rep(example["head"])}
         if "graph" in example:
             specs["graph"] = rep(example["graph"])
         self.param_specs = specs
@@ -156,7 +178,7 @@ class CombinedTrainer:
 
     def init_state(self, seed: int | None = None) -> TrainState:
         seed = self.cfg.train.seed if seed is None else seed
-        params = cmb.init_params(self.model_cfg, jax.random.key(seed))
+        params = self._init_params_fn()(self.model_cfg, jax.random.key(seed))
         params = jax.device_put(params, self.param_shardings)
         opt_state = self.tx.init(params)
         return TrainState(
@@ -177,8 +199,20 @@ class CombinedTrainer:
     # -- compiled steps ------------------------------------------------------
 
     def _forward(self, params, local: TextBatch, key):
-        sp_axis = "sp" if self.sp else None
         tp_axis = "tp" if self.tp else None
+        if self.is_t5:
+            from deepdfa_tpu.models import t5 as t5m
+
+            return t5m.defect_forward(
+                self.model_cfg,
+                params,
+                local.input_ids,
+                graph_batch=local.graphs,
+                has_graph=local.has_graph,
+                dropout_key=key,
+                tp_axis=tp_axis,
+            )
+        sp_axis = "sp" if self.sp else None
         offset = (
             jax.lax.axis_index("sp") * local.input_ids.shape[1] if self.sp else 0
         )

@@ -1,0 +1,67 @@
+"""CombinedTrainer over the T5 defect model: dp x tp parity + learning."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.data.text import collate_shards
+from deepdfa_tpu.data.tokenizer import HashTokenizer
+from deepdfa_tpu.models import t5 as t5m
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+
+def _setup(n=16):
+    synth = generate(n, vuln_rate=0.4, seed=13)
+    specs, _ = build_dataset(to_examples(synth), train_ids=range(n), limit_all=50, limit_subkeys=50)
+    by_id = {s.graph_id: s for s in specs}
+    tok = HashTokenizer(vocab_size=256, t5_frame=True)
+    token_ids = tok.batch_encode([s.before for s in synth], max_length=32)
+    labels = [s.label for s in synth]
+    mcfg = t5m.DefectConfig(
+        encoder=t5m.T5Config.tiny(dropout_rate=0.0, remat=False),
+        graph_hidden_dim=8,
+        graph_input_dim=52,
+    )
+    cfg = config_mod.apply_overrides(
+        Config(), ["train.optim.name=sgd", "train.optim.learning_rate=0.05"]
+    )
+    return token_ids, labels, by_id, mcfg, cfg, n
+
+
+def test_t5_dp_tp_matches_single():
+    import jax
+
+    token_ids, labels, by_id, mcfg, cfg, n = _setup()
+    mesh_p = make_mesh(MeshConfig(dp=2, tp=2, sp=1), devices=jax.devices()[:4])
+    mesh_1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    tp_tr = CombinedTrainer(cfg, mcfg, mesh=mesh_p)
+    s_tr = CombinedTrainer(cfg, mcfg, mesh=mesh_1)
+    bp = collate_shards(token_ids, labels, list(range(n)), by_id, 2, 8, 1024, 4096, pad_id=0)
+    b1 = collate_shards(token_ids, labels, list(range(n)), by_id, 1, 16, 1024, 4096, pad_id=0)
+    sp = tp_tr.init_state(seed=0)
+    s1 = s_tr.init_state(seed=0)
+    key = jax.random.key(7)
+    for _ in range(2):
+        sp, loss_p = tp_tr.train_step(sp, bp, key)
+        s1, loss_1 = s_tr.train_step(s1, b1, key)
+    np.testing.assert_allclose(
+        float(jax.device_get(loss_p)), float(jax.device_get(loss_1)), rtol=5e-4
+    )
+    chex = pytest.importorskip("chex")
+    chex.assert_trees_all_close(
+        jax.device_get(sp.params), jax.device_get(s1.params), rtol=2e-3, atol=1e-5
+    )
+    mp, _ = tp_tr.evaluate(sp, [bp])
+    m1, _ = s_tr.evaluate(s1, [b1])
+    np.testing.assert_allclose(mp["loss"], m1["loss"], rtol=1e-3)
+
+
+def test_t5_sp_rejected():
+    import jax
+
+    token_ids, labels, by_id, mcfg, cfg, n = _setup()
+    mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    with pytest.raises(NotImplementedError):
+        CombinedTrainer(cfg, mcfg, mesh=mesh)
