@@ -159,11 +159,18 @@ class Parser:
         t = self.peek()
         if t.kind == "kw" and t.text in TYPE_KEYWORDS:
             return True
-        # `Foo * bar` / `Foo bar` typedef heuristic: id followed by id, or by
-        # one-or-more '*' then id
+        # `Foo * bar` / `Foo bar` / `a::b::Foo* bar` typedef heuristic:
+        # (possibly qualified) id, optional template args, then stars/refs,
+        # then an id followed by a declarator-ish token
         if t.kind == "id":
             k = 1
-            while self.peek(k).text == "*":
+            while self.peek(k).text == "::" and self.peek(k + 1).kind == "id":
+                k += 2
+            if self.peek(k).text == "<":
+                k2 = self._match_angle(k)
+                if k2 is not None:
+                    k = k2
+            while self.peek(k).text in ("*", "&"):
                 k += 1
             nxt = self.peek(k)
             if nxt.kind == "id" and k > 0:
@@ -172,9 +179,87 @@ class Parser:
                     return True
         return False
 
+    def _match_angle(self, k: int) -> int | None:
+        """If peek(k) is '<' opening a plausible template argument list,
+        return the offset just past the matching '>'; else None."""
+        if self.peek(k).text != "<":
+            return None
+        depth = 0
+        limit = k + 64
+        while k < limit:
+            t = self.peek(k)
+            if t.kind == "eof" or t.text in (";", "{", "}"):
+                return None
+            if t.text == "<":
+                depth += 1
+            elif t.text == ">":
+                depth -= 1
+                if depth == 0:
+                    return k + 1
+            elif t.text == ">>":
+                depth -= 2
+                if depth <= 0:
+                    return k + 1
+            k += 1
+        return None
+
+    @staticmethod
+    def _join_type_tokens(toks: list[str]) -> str:
+        """Join type tokens, spacing word-word boundaries (unsigned long)."""
+        out = ""
+        prev_word = False
+        for t in toks:
+            word = bool(t) and (t[0].isalpha() or t[0] == "_")
+            if out and prev_word and word:
+                out += " "
+            out += t
+            prev_word = word
+        return out
+
+    def _eat_angle_args(self) -> str:
+        """Consume a balanced <...> run; returns its text incl. brackets.
+        A terminal '>>' closes two levels and contributes its second '>'."""
+        depth = 0
+        toks: list[str] = []
+        while True:
+            t = self.eat()
+            toks.append(t.text)
+            if t.text == "<":
+                depth += 1
+            elif t.text == ">":
+                depth -= 1
+                if depth == 0:
+                    break
+            elif t.text == ">>":
+                depth -= 2
+                if depth <= 0:
+                    break
+            if t.kind == "eof":
+                break
+        return self._join_type_tokens(toks)
+
+    def _eat_qualified_name(self) -> str:
+        """id(::id)* with optional trailing template args -> one name."""
+        name = self.eat().text
+        while self.at("::") and self.peek(1).kind == "id":
+            self.eat()
+            name += "::" + self.eat().text
+        if self._match_angle(0) is not None:
+            name += self._eat_angle_args()
+        return name
+
+    _QUALIFIERS = frozenset(
+        ("const", "volatile", "static", "register", "auto", "extern",
+         "inline", "restrict", "typedef")
+    )
+
     def _parse_type(self) -> str:
         """Consume type specifier tokens; return canonical type string."""
         parts: list[str] = []
+
+        def saw_base() -> bool:
+            return any(p not in self._QUALIFIERS for p in parts)
+
         while True:
             t = self.peek()
             if t.kind == "kw" and t.text in TYPE_KEYWORDS:
@@ -198,24 +283,32 @@ class Parser:
                     continue
                 parts.append(self.eat().text)
                 continue
-            if t.kind == "id" and not parts:
-                parts.append(self.eat().text)
+            if t.kind == "id" and not saw_base():
+                # don't eat the declarator NAME as a base type: plain id
+                # directly followed by a declarator terminator is the
+                # variable of an implicit-int decl (`static x = 1;`)
+                if self.peek(1).text in ("=", ";", ",", ")", "["):
+                    break
+                parts.append(self._eat_qualified_name())
                 continue
             break
-        base = " ".join(p for p in parts if p not in ("const", "volatile",
-                                                      "static", "register",
-                                                      "auto", "extern",
-                                                      "inline", "restrict",
-                                                      "typedef"))
+        base = " ".join(p for p in parts if p not in self._QUALIFIERS)
         return base or "ANY"
 
     def _parse_declarator(self, base: str) -> tuple[str | None, str]:
-        """Parse `* name [dims]` -> (name, full type string)."""
+        """Parse `*|& name [dims]` -> (name, full type string)."""
         stars = 0
-        while self.at("*") or (self.peek().kind == "kw" and self.peek().text in ("const", "restrict", "volatile")):
+        while (
+            self.at("*")
+            or self.at("&")
+            or (
+                self.peek().kind == "kw"
+                and self.peek().text in ("const", "restrict", "volatile")
+            )
+        ):
             if self.at("*"):
                 stars += 1
-            self.eat()
+            self.eat()  # '&' references keep the base type, like joern
         name = None
         if self.peek().kind == "id":
             name = self.eat().text
@@ -371,6 +464,8 @@ class Parser:
             return self._call(
                 C.SIZEOF, f"sizeof {self._code(operand)}", t.line, [operand]
             )
+        if t.kind == "id" and self._at_new_delete():
+            return self._parse_new_delete()
         if self._looks_like_cast():
             lp = self.eat("(")
             base = self._parse_type()
@@ -386,6 +481,67 @@ class Parser:
             code = f"({ty}) {self._code(operand)}"
             return self._call(C.CAST, code, lp.line, [tref, operand])
         return self._parse_postfix()
+
+    def _at_new_delete(self) -> bool:
+        """Is this C++ operator new/delete (vs. 'new' as a plain C
+        identifier, legal and common in old C code)?"""
+        t = self.peek()
+        if t.kind != "id" or t.text not in ("new", "delete"):
+            return False
+        nxt = self.peek(1)
+        if t.text == "delete":
+            # delete[] p / delete p — but not `delete(x)` C calls or
+            # `delete->field` / `delete = x` identifier uses
+            return (nxt.text == "[" and self.peek(2).text == "]") or (
+                nxt.kind == "id"
+            )
+        # new <type-ish>: a type keyword, or an id that heads a type
+        if nxt.kind == "kw" and nxt.text in TYPE_KEYWORDS:
+            return True
+        if nxt.kind == "id":
+            after = self.peek(2)
+            return after.text in ("(", "[", ";", ")", ",", "*", "::", "<")
+        return False
+
+    def _parse_new_delete(self) -> int:
+        """C++ new/delete as joern-style operator calls."""
+        t = self.eat()  # 'new' | 'delete'
+        if t.text == "delete":
+            arr = ""
+            if self.at("[") and self.peek(1).text == "]":
+                self.eat()
+                self.eat()
+                arr = "[]"
+            operand = self._parse_unary()
+            code = f"delete{arr} {self._code(operand)}"
+            return self._call("<operator>.delete", code, t.line, [operand])
+        # new Type, new Type(args), new Type[n]
+        base = self._parse_type()
+        stars = 0
+        while self.at("*"):
+            self.eat()
+            stars += 1
+        ty = base + "*" * stars
+        tref = self._node("TYPE_REF", code=ty, line=t.line, type_full_name=ty)
+        args = [tref]
+        code = f"new {ty}"
+        if self.at("("):
+            self.eat("(")
+            while not self.at(")") and not self.at_eof():
+                args.append(self._parse_assign())
+                if self.at(","):
+                    self.eat()
+            if self.at(")"):
+                self.eat(")")
+            code += "(...)"
+        elif self.at("["):
+            self.eat("[")
+            size = self.parse_expression()
+            if self.at("]"):
+                self.eat("]")
+            args.append(size)
+            code = f"new {ty}[{self._code(size)}]"
+        return self._call("<operator>.new", code, t.line, args)
 
     def _parse_postfix(self) -> int:
         node = self._parse_primary()
@@ -432,13 +588,30 @@ class Parser:
             else:
                 return node
 
+    _CXX_CASTS = ("static_cast", "dynamic_cast", "reinterpret_cast", "const_cast")
+
     def _parse_primary(self) -> int:
         t = self.peek()
         if t.kind == "id":
+            if t.text in self._CXX_CASTS and self._match_angle(1) is not None:
+                # static_cast<T>(expr) -> joern-style cast call
+                self.eat()
+                angle = self._eat_angle_args()
+                ty = angle[1:-1]  # strip the outer <>
+                self.eat("(")
+                operand = self.parse_expression()
+                self.eat(")")
+                tref = self._node("TYPE_REF", code=ty, line=t.line, type_full_name=ty)
+                code = f"{t.text}<{ty}>({self._code(operand)})"
+                return self._call(C.CAST, code, t.line, [tref, operand])
+            name = t.text
             self.eat()
-            ty = self.scope.lookup(t.text) or "ANY"
+            while self.at("::") and self.peek(1).kind == "id":
+                self.eat()
+                name += "::" + self.eat().text
+            ty = self.scope.lookup(name) or "ANY"
             return self._node(
-                "IDENTIFIER", name=t.text, code=t.text, line=t.line, type_full_name=ty
+                "IDENTIFIER", name=name, code=name, line=t.line, type_full_name=ty
             )
         if t.kind == "num":
             self.eat()
@@ -697,17 +870,45 @@ class Parser:
     # -- function ------------------------------------------------------------
 
     def parse_function(self) -> C.Cpg:
-        """Parse `ret_type name(params) { body }` (leading qualifiers ok)."""
+        """Parse `ret_type name(params) { body }` — C and the common C++
+        method shapes (template preamble, qualified Foo::bar names,
+        reference parameters)."""
+        # optional template preamble: template <typename T, ...>
+        if self.peek().kind == "id" and self.peek().text == "template":
+            self.eat()
+            end = self._match_angle(0)
+            if end is not None:
+                for _ in range(end):
+                    self.eat()
         # signature
         sig_start = self.peek()
         base = self._parse_type()
         stars = 0
-        while self.at("*"):
+        while self.at("*") or self.at("&"):
+            if self.at("*"):
+                stars += 1
             self.eat()
-            stars += 1
-        if self.peek().kind != "id":
+        if self.at("(") and base not in ("", "ANY"):
+            # constructor: `Foo::Foo(...)` — the "return type" IS the name
+            fname = base
+            base = "void"
+        elif self.at("::") and self.peek(1).text == "~":
+            # destructor: `Foo::~Foo(...)`
+            self.eat()
+            self.eat()
+            fname = base + "::~" + (self.eat().text if self.peek().kind == "id" else "")
+            base = "void"
+        elif self.peek().kind != "id":
             raise ParseError(f"expected function name, got {self.peek()!r}")
-        fname = self.eat().text
+        else:
+            fname = self.eat().text
+            while self.at("::") and self.peek(1).kind in ("id", "op"):
+                self.eat()
+                if self.at("~"):  # destructor
+                    self.eat()
+                    fname += "::~" + self.eat().text
+                else:
+                    fname += "::" + self.eat().text
         self.cpg = C.Cpg(fname)
         ret_type = base + "*" * stars
         method = self.cpg.add_node(

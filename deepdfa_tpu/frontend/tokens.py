@@ -25,6 +25,7 @@ KEYWORDS = {
 # longest-first so maximal munch works
 OPERATORS = [
     "<<=", ">>=", "...",
+    "::",
     "->", "++", "--", "<<", ">>", "<=", ">=", "==", "!=", "&&", "||",
     "+=", "-=", "*=", "/=", "%=", "&=", "^=", "|=",
     "+", "-", "*", "/", "%", "=", "<", ">", "!", "~", "&", "|", "^",

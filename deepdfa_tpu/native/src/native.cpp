@@ -171,7 +171,7 @@ static bool is_keyword(const char* s, int64_t len) {
 
 // three-char then two-char then one-char operators (maximal munch)
 static const char* kOps3[] = {"<<=", ">>=", "...", nullptr};
-static const char* kOps2[] = {"->", "++", "--", "<<", ">>", "<=", ">=",
+static const char* kOps2[] = {"::", "->", "++", "--", "<<", ">>", "<=", ">=",
                               "==", "!=", "&&", "||", "+=", "-=", "*=",
                               "/=", "%=", "&=", "^=", "|=", nullptr};
 static const char kOps1[] = "+-*/%=<>!~&|^?:.,;()[]{}";

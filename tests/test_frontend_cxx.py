@@ -1,0 +1,68 @@
+"""C++ tolerance: the Big-Vul corpus is ~half C++ (Chromium etc.)."""
+
+import pytest
+
+from deepdfa_tpu.data import extract_graph
+from deepdfa_tpu.frontend import ReachingDefinitions, decl_features, is_decl, parse_function
+
+CASES = {
+    "qualified_method": "int Foo::bar(const std::string& name, int x) {\n  int n = name.size();\n  return n + x;\n}",
+    "reference_params": "void f(std::vector<int>& v, int& out) {\n  out = v.size();\n}",
+    "new_delete": "int g(int n) {\n  char* p = new char[n];\n  p[0] = 1;\n  delete[] p;\n  return 0;\n}",
+    "template_fn": "template <typename T>\nT max3(T a, T b) {\n  T m = a > b ? a : b;\n  return m;\n}",
+    "namespaced_types": "static base::Value* j(const base::DictionaryValue* dict) {\n  base::Value* out = NULL;\n  dict->Get(\"key\", &out);\n  return out;\n}",
+    "cxx_casts": "int k(void* p) {\n  int v = static_cast<int>(reinterpret_cast<long>(p));\n  return v;\n}",
+    "qualified_call": "int m() {\n  int v = std::max(1, 2);\n  return v;\n}",
+    "try_catch": "int h() {\n  try {\n    int x = risky();\n    return x;\n  } catch (const std::exception& e) {\n    return -1;\n  }\n}",
+}
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_cxx_extracts_with_defs(name):
+    code = CASES[name]
+    eg = extract_graph(code, 0)
+    assert eg is not None, name
+    assert eg.num_nodes > 3
+    assert eg.def_fields, name  # at least one definition node with features
+    # reaching defs terminates on the full CPG
+    rd = ReachingDefinitions(parse_function(code))
+    rd.solve()
+
+
+def test_cxx_feature_semantics():
+    cpg = parse_function(CASES["new_delete"])
+    decls = {
+        cpg.nodes[n.id].code: dict(decl_features(cpg, n.id))
+        for n in cpg.nodes
+        if is_decl(cpg, n.id)
+    }
+    assert decls["p = new char[n]"]["operator"] == "new"
+    assert decls["p = new char[n]"]["datatype"] == "char*"
+
+    cpg2 = parse_function(CASES["namespaced_types"])
+    decls2 = {
+        cpg2.nodes[n.id].code: dict(decl_features(cpg2, n.id))
+        for n in cpg2.nodes
+        if is_decl(cpg2, n.id)
+    }
+    assert decls2["out = NULL"]["datatype"] == "base::Value*"
+
+    cpg3 = parse_function(CASES["qualified_call"])
+    decls3 = {
+        cpg3.nodes[n.id].code: dict(decl_features(cpg3, n.id))
+        for n in cpg3.nodes
+        if is_decl(cpg3, n.id)
+    }
+    assert decls3["v = std::max(1, 2)"]["api"] == "std::max"
+
+    cpg4 = parse_function(CASES["cxx_casts"])
+    decls4 = {
+        cpg4.nodes[n.id].code: dict(decl_features(cpg4, n.id))
+        for n in cpg4.nodes
+        if is_decl(cpg4, n.id)
+    }
+    assert decls4["v = static_cast<int>(reinterpret_cast<long>(p))"]["operator"] == "cast"
+
+
+def test_method_name_qualified():
+    assert parse_function(CASES["qualified_method"]).method_name == "Foo::bar"
