@@ -132,27 +132,40 @@ def cmd_extract(args) -> None:
     vocab_path = out_dir / f"vocab{cfg.data.feat.name}.json"
     store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
 
-    if args.num_shards > 1:
-        # cluster fan-out (the reference's SLURM job-array sharding,
-        # getgraphs.py:135-156). Every job must encode against the SAME
-        # vocabularies, so they are built up front by `extract-vocab`.
+    # fixed vocabularies: either another dataset's (--vocab-from, the
+    # DbgBench / unseen-project cross-dataset workflow) or this dataset's
+    # own pre-built ones (sharded extraction). Both compose with
+    # --num-shards; shard jobs write tagged npz files.
+    fixed_vocab_src = None
+    if args.vocab_from:
+        fixed_vocab_src = Path(args.vocab_from)
+    elif args.num_shards > 1:
         if not vocab_path.exists():
             raise SystemExit(
                 f"sharded extract requires {vocab_path}; run "
                 f"`deepdfa_tpu extract-vocab` first"
             )
+        fixed_vocab_src = vocab_path
+
+    if fixed_vocab_src is not None:
         vocabs = {
             k: AbsDfVocab.from_json(v)
-            for k, v in json.loads(vocab_path.read_text()).items()
+            for k, v in json.loads(fixed_vocab_src.read_text()).items()
         }
-        shard_examples = [
-            e for i, e in enumerate(examples) if i % args.num_shards == args.shard
+        sel = [
+            e
+            for i, e in enumerate(examples)
+            if i % args.num_shards == args.shard
         ]
-        specs = encode_corpus(shard_examples, vocabs, workers=args.workers)
-        store.write(specs, tag=f"shard{args.shard:04d}")
+        specs = encode_corpus(sel, vocabs, workers=args.workers)
+        tag = f"shard{args.shard:04d}" if args.num_shards > 1 else None
+        store.write(specs, tag=tag)
+        if fixed_vocab_src != vocab_path:
+            vocab_path.write_text(fixed_vocab_src.read_text())
         print(
             f"extracted shard {args.shard}/{args.num_shards}: "
-            f"{len(specs)}/{len(shard_examples)} graphs -> {store.directory}"
+            f"{len(specs)}/{len(sel)} graphs (vocab: {fixed_vocab_src}) "
+            f"-> {store.directory}"
         )
         return
 
@@ -613,6 +626,9 @@ def main(argv=None) -> None:
     p.add_argument("--workers", type=int, default=0)
     p.add_argument("--shard", type=int, default=0, help="job-array shard id")
     p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--vocab-from", default=None,
+                   help="encode with another dataset's vocab json "
+                        "(cross-dataset / DbgBench-style evaluation)")
     _add_common(p)
     p.set_defaults(fn=cmd_extract)
 

@@ -157,6 +157,8 @@ class Parser:
 
     def _at_type_start(self) -> bool:
         t = self.peek()
+        if t.kind == "id" and self._at_new_delete():
+            return False  # `delete p;` / `new T` statements are expressions
         if t.kind == "kw" and t.text in TYPE_KEYWORDS:
             return True
         # `Foo * bar` / `Foo bar` / `a::b::Foo* bar` typedef heuristic:
@@ -179,6 +181,13 @@ class Parser:
                     return True
         return False
 
+    # tokens that cannot occur in a template argument list: their presence
+    # means the '<' was a comparison (e.g. `a < b && c > d;`)
+    _NOT_TEMPLATE = frozenset(
+        ("&&", "||", "==", "!=", "<=", ">=", "!", "+", "-", "/", "%", "?",
+         "=", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<=", ">>=")
+    )
+
     def _match_angle(self, k: int) -> int | None:
         """If peek(k) is '<' opening a plausible template argument list,
         return the offset just past the matching '>'; else None."""
@@ -188,7 +197,12 @@ class Parser:
         limit = k + 64
         while k < limit:
             t = self.peek(k)
-            if t.kind == "eof" or t.text in (";", "{", "}"):
+            if (
+                t.kind == "eof"
+                or t.kind in ("str", "char")
+                or t.text in (";", "{", "}")
+                or t.text in self._NOT_TEMPLATE
+            ):
                 return None
             if t.text == "<":
                 depth += 1
@@ -217,26 +231,12 @@ class Parser:
         return out
 
     def _eat_angle_args(self) -> str:
-        """Consume a balanced <...> run; returns its text incl. brackets.
-        A terminal '>>' closes two levels and contributes its second '>'."""
-        depth = 0
-        toks: list[str] = []
-        while True:
-            t = self.eat()
-            toks.append(t.text)
-            if t.text == "<":
-                depth += 1
-            elif t.text == ">":
-                depth -= 1
-                if depth == 0:
-                    break
-            elif t.text == ">>":
-                depth -= 2
-                if depth <= 0:
-                    break
-            if t.kind == "eof":
-                break
-        return self._join_type_tokens(toks)
+        """Consume a balanced <...> run (pre-validated by _match_angle);
+        returns its text incl. brackets."""
+        end = self._match_angle(0)
+        if end is None:
+            return ""
+        return self._join_type_tokens([self.eat().text for _ in range(end)])
 
     def _eat_qualified_name(self) -> str:
         """id(::id)* with optional trailing template args -> one name."""
@@ -253,8 +253,12 @@ class Parser:
          "inline", "restrict", "typedef")
     )
 
-    def _parse_type(self) -> str:
-        """Consume type specifier tokens; return canonical type string."""
+    def _parse_type(self, in_params: bool = False) -> str:
+        """Consume type specifier tokens; return canonical type string.
+
+        in_params: parameter lists have no initializers, so a bare id
+        before ','/')' IS the type (`void f(Foo)`), whereas in statement
+        position it is the declarator name (`static x = 1;`)."""
         parts: list[str] = []
 
         def saw_base() -> bool:
@@ -287,7 +291,7 @@ class Parser:
                 # don't eat the declarator NAME as a base type: plain id
                 # directly followed by a declarator terminator is the
                 # variable of an implicit-int decl (`static x = 1;`)
-                if self.peek(1).text in ("=", ";", ",", ")", "["):
+                if not in_params and self.peek(1).text in ("=", ";", ",", ")", "["):
                     break
                 parts.append(self._eat_qualified_name())
                 continue
@@ -515,8 +519,13 @@ class Parser:
             operand = self._parse_unary()
             code = f"delete{arr} {self._code(operand)}"
             return self._call("<operator>.delete", code, t.line, [operand])
-        # new Type, new Type(args), new Type[n]
-        base = self._parse_type()
+        # new Type, new Type(args), new Type[n] — class-name types are
+        # consumed as qualified names (the statement-position terminator
+        # guard in _parse_type would refuse `Obj` before ';'/'[')
+        if self.peek().kind == "id":
+            base = self._eat_qualified_name()
+        else:
+            base = self._parse_type(in_params=True)
         stars = 0
         while self.at("*"):
             self.eat()
@@ -909,6 +918,19 @@ class Parser:
                     fname += "::~" + self.eat().text
                 else:
                     fname += "::" + self.eat().text
+            if fname.split("::")[-1] == "operator":
+                # operator overloads: operator== / operator[] / operator()
+                if self.at("(") and self.peek(1).text == ")":
+                    self.eat()
+                    self.eat()
+                    fname += "()"
+                elif self.at("[") and self.peek(1).text == "]":
+                    self.eat()
+                    self.eat()
+                    fname += "[]"
+                else:
+                    while self.peek().kind == "op" and not self.at("("):
+                        fname += self.eat().text
         self.cpg = C.Cpg(fname)
         ret_type = base + "*" * stars
         method = self.cpg.add_node(
@@ -927,7 +949,7 @@ class Parser:
                 self.eat()
                 break
             param_start = self.i
-            pbase = self._parse_type()
+            pbase = self._parse_type(in_params=True)
             pname, pfull = self._parse_declarator(pbase)
             if pname is None and self.i == param_start or not (
                 self.at(",") or self.at(")")

@@ -1,0 +1,69 @@
+"""Statement-level (node-labeled) GGNN training end-to-end.
+
+The reference's LineVD-style configuration (label_style='node',
+base_module get_label) trains per-statement vulnerability classifiers; the
+node probabilities then feed the statement-level localization metrics.
+"""
+
+import numpy as np
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.eval.statements import RankedExample, statement_report
+from deepdfa_tpu.graphs import pack_shards
+from deepdfa_tpu.models import DeepDFA
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train import GraphTrainer
+
+
+def test_node_level_training_and_localization():
+    import jax
+
+    n = 200
+    synth = generate(n, vuln_rate=0.3, seed=21)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(n), limit_all=150, limit_subkeys=150
+    )
+    # node labels exist on positives
+    assert any(s.node_vuln.sum() > 0 for s in specs)
+
+    cfg = config_mod.apply_overrides(
+        Config(),
+        [
+            "model.hidden_dim=8",
+            "model.label_style=\"node\"",
+            "train.max_epochs=80",
+            "train.optim.learning_rate=0.005",
+            # node-level positives are rare: weight them up instead of
+            # graph-level undersampling (reference node resampling's role)
+            "train.pos_weight=20.0",
+        ],
+    )
+    mesh = make_mesh(MeshConfig(dp=8))
+    model = DeepDFA.from_config(cfg.model, input_dim=152)
+    assert model.label_style == "node"
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    assert trainer.pos_weight == 20.0
+
+    batch = pack_shards(specs, 8, 25, 4096, 16384)
+    state = trainer.init_state(batch)
+    state = trainer.fit(state, lambda e: [batch])
+    metrics, _ = trainer.evaluate(state, [batch])
+    # per-statement signal is learnable on the synthetic bug patterns
+    assert metrics["recall"] > 0.6, metrics
+    assert metrics["f1"] > 0.35, metrics  # statement-level F1 runs far below function-level (paper Table 6)
+
+    # node probabilities -> statement localization metrics
+    probs, labels, mask, _ = jax.device_get(trainer.eval_step(state.params, batch))
+    probs, labels, mask = (np.asarray(x) for x in (probs, labels, mask))
+    node_graph = np.asarray(batch.node_graph)
+    ranked = []
+    for shard in range(probs.shape[0]):
+        for g in range(batch.num_graphs):
+            sel = (node_graph[shard] == g) & mask[shard].astype(bool)
+            if sel.sum() and labels[shard][sel].sum() > 0:
+                ranked.append(
+                    RankedExample(probs[shard][sel], labels[shard][sel] >= 0.5)
+                )
+    rep = statement_report(ranked)
+    assert rep["top_10_acc"] > 0.8, rep
