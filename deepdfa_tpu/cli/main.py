@@ -361,20 +361,40 @@ def cmd_test(args) -> None:
 
 
 def _combined_setup(args, cfg):
-    """Tokenizer + encoder config + CombinedConfig shared by
-    train-combined and localize — these must match byte-for-byte for
-    checkpoint restore, so they are built in exactly one place."""
+    """Tokenizer + encoder config + model config shared by train-combined
+    and localize — these must match byte-for-byte for checkpoint restore,
+    so they are built in exactly one place.
+
+    --arch roberta (default) builds the LineVul/UniXcoder-style
+    CombinedConfig; --arch t5 builds the CodeT5-style DefectConfig (eos
+    pooling, T5 pad/eos frame)."""
     from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
     from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models import t5 as t5m
     from deepdfa_tpu.models.transformer import TransformerConfig
 
+    arch = getattr(args, "arch", "roberta")
     if args.tokenizer:
         tok_dir = Path(args.tokenizer)
         tok = BpeTokenizer(
             next(tok_dir.glob("*vocab.json")), next(tok_dir.glob("*merges.txt"))
         )
     else:
-        tok = HashTokenizer(vocab_size=4096)
+        tok = HashTokenizer(vocab_size=4096, t5_frame=(arch == "t5"))
+
+    use_graph = not getattr(args, "no_graph", False)
+    if arch == "t5":
+        if args.encoder == "codet5-base":
+            enc_cfg = t5m.T5Config(dtype="bfloat16")
+        else:
+            enc_cfg = t5m.T5Config.tiny(vocab_size=tok.vocab_size)
+        mcfg = t5m.DefectConfig(
+            encoder=enc_cfg,
+            graph_hidden_dim=cfg.model.hidden_dim,
+            graph_input_dim=cfg.data.feat.input_dim,
+            use_graph=use_graph,
+        )
+        return tok, enc_cfg, mcfg
     if args.encoder == "codebert-base":
         enc_cfg = TransformerConfig(dtype="bfloat16")
     else:
@@ -386,7 +406,7 @@ def _combined_setup(args, cfg):
         encoder=enc_cfg,
         graph_hidden_dim=cfg.model.hidden_dim,
         graph_input_dim=cfg.data.feat.input_dim,
-        use_graph=not getattr(args, "no_graph", False),
+        use_graph=use_graph,
     )
     return tok, enc_cfg, mcfg
 
@@ -458,6 +478,7 @@ def cmd_train_combined(args) -> None:
                     rows_per_shard=rows_per_shard,
                     node_budget=cfg.data.batch.node_budget,
                     edge_budget=cfg.data.batch.edge_budget,
+                    pad_id=tok.pad_id,
                 )
             )
         return out
@@ -478,7 +499,13 @@ def cmd_train_combined(args) -> None:
         import torch
 
         sd = torch.load(args.pretrained, map_location="cpu")
-        state = trainer.load_encoder(state, params_from_hf_torch(enc_cfg, sd))
+        if getattr(args, "arch", "roberta") == "t5":
+            from deepdfa_tpu.models import t5 as t5m
+
+            enc_params = t5m.params_from_hf_torch(enc_cfg, sd)
+        else:
+            enc_params = params_from_hf_torch(enc_cfg, sd)
+        state = trainer.load_encoder(state, enc_params)
 
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
     state = trainer.fit(
@@ -638,7 +665,10 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_extract_vocab)
 
     p = sub.add_parser("train-combined")
-    p.add_argument("--encoder", default="tiny", help="tiny | codebert-base")
+    p.add_argument("--arch", default="roberta", choices=["roberta", "t5"],
+                   help="roberta (LineVul/UniXcoder style) | t5 (CodeT5 style)")
+    p.add_argument("--encoder", default="tiny",
+                   help="tiny | codebert-base | codet5-base")
     p.add_argument("--pretrained", default=None,
                    help="path to a torch state_dict for the encoder")
     p.add_argument("--tokenizer", default=None,
