@@ -374,6 +374,21 @@ def _combined_setup(args, cfg):
     from deepdfa_tpu.models.transformer import TransformerConfig
 
     arch = getattr(args, "arch", "roberta")
+    valid_encoders = {
+        "roberta": ("tiny", "codebert-base"),
+        "t5": ("tiny", "codet5-base"),
+    }[arch]
+    if args.encoder not in valid_encoders:
+        raise SystemExit(
+            f"--encoder {args.encoder} is not valid for --arch {arch} "
+            f"(choose from {valid_encoders})"
+        )
+    if arch == "t5" and args.tokenizer:
+        raise SystemExit(
+            "--arch t5 supports only the built-in hash tokenizer for now: "
+            "BPE vocab.json assets use the RoBERTa special-id layout, which "
+            "conflicts with T5's pad=0/eos=2 attention-mask convention"
+        )
     if args.tokenizer:
         tok_dir = Path(args.tokenizer)
         tok = BpeTokenizer(
@@ -394,7 +409,7 @@ def _combined_setup(args, cfg):
             graph_input_dim=cfg.data.feat.input_dim,
             use_graph=use_graph,
         )
-        return tok, enc_cfg, mcfg
+        return tok, enc_cfg, mcfg, t5m.params_from_hf_torch
     if args.encoder == "codebert-base":
         enc_cfg = TransformerConfig(dtype="bfloat16")
     else:
@@ -408,7 +423,9 @@ def _combined_setup(args, cfg):
         graph_input_dim=cfg.data.feat.input_dim,
         use_graph=use_graph,
     )
-    return tok, enc_cfg, mcfg
+    from deepdfa_tpu.models.transformer import params_from_hf_torch as _rb_import
+
+    return tok, enc_cfg, mcfg, _rb_import
 
 
 def cmd_train_combined(args) -> None:
@@ -418,7 +435,7 @@ def cmd_train_combined(args) -> None:
     from deepdfa_tpu.data.text import collate_shards
     from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
     from deepdfa_tpu.models import combined as cmb
-    from deepdfa_tpu.models.transformer import TransformerConfig, params_from_hf_torch
+    from deepdfa_tpu.models.transformer import TransformerConfig
     from deepdfa_tpu.parallel import make_mesh
     from deepdfa_tpu.train import undersample_epoch
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
@@ -431,7 +448,7 @@ def cmd_train_combined(args) -> None:
         examples = pickle.load(f)
     splits = json.loads((out_dir / "splits.json").read_text())
 
-    tok, enc_cfg, mcfg = _combined_setup(args, cfg)
+    tok, enc_cfg, mcfg, enc_import = _combined_setup(args, cfg)
 
     from deepdfa_tpu.graphs import GraphStore
 
@@ -499,13 +516,7 @@ def cmd_train_combined(args) -> None:
         import torch
 
         sd = torch.load(args.pretrained, map_location="cpu")
-        if getattr(args, "arch", "roberta") == "t5":
-            from deepdfa_tpu.models import t5 as t5m
-
-            enc_params = t5m.params_from_hf_torch(enc_cfg, sd)
-        else:
-            enc_params = params_from_hf_torch(enc_cfg, sd)
-        state = trainer.load_encoder(state, enc_params)
+        state = trainer.load_encoder(state, enc_import(enc_cfg, sd))
 
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
     state = trainer.fit(
@@ -545,7 +556,7 @@ def cmd_localize(args) -> None:
         examples = pickle.load(f)
     splits = json.loads((out_dir / "splits.json").read_text())
 
-    tok, enc_cfg, mcfg = _combined_setup(args, cfg)
+    tok, enc_cfg, mcfg, enc_import = _combined_setup(args, cfg)
     trainer = CombinedTrainer(cfg, mcfg, mesh=make_mesh(cfg.train.mesh))
     state = trainer.init_state()
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
@@ -692,6 +703,9 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_test)
 
     p = sub.add_parser("localize")
+    p.add_argument("--arch", default="roberta", choices=["roberta"],
+                   help="t5 localization is not implemented (saliency/"
+                        "attention scoring is roberta-shaped)")
     p.add_argument("--no-graph", action="store_true")
     p.add_argument("--method", default="saliency",
                    choices=["saliency", "attention"])
