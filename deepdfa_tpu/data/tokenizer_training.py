@@ -1,0 +1,40 @@
+"""Byte-level BPE tokenizer training on a code corpus.
+
+The reference trains Salesforce-style BPE vocabularies with the
+`tokenizers` library (CodeT5/tokenizer/*.py); this produces the same
+vocab.json + merges.txt artifacts, which `data.tokenizer.BpeTokenizer`
+(and HF tokenizers) load directly. Special tokens follow the RoBERTa
+frame the combined models expect.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+SPECIAL_TOKENS = ["<s>", "<pad>", "</s>", "<unk>", "<mask>"]
+
+
+def train_bpe(
+    corpus: Iterable[str],
+    out_dir: str | Path,
+    vocab_size: int = 32000,
+    min_frequency: int = 2,
+    prefix: str = "bpe_tokenizer",
+) -> tuple[Path, Path]:
+    """Train byte-level BPE over in-memory code strings; writes
+    `<prefix>-vocab.json` + `<prefix>-merges.txt` into out_dir and returns
+    their paths."""
+    from tokenizers import ByteLevelBPETokenizer
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    tok = ByteLevelBPETokenizer()
+    tok.train_from_iterator(
+        corpus,
+        vocab_size=vocab_size,
+        min_frequency=min_frequency,
+        special_tokens=SPECIAL_TOKENS,
+    )
+    tok.save_model(str(out_dir), prefix)
+    return out_dir / f"{prefix}-vocab.json", out_dir / f"{prefix}-merges.txt"

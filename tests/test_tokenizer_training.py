@@ -1,0 +1,33 @@
+"""Train a BPE vocab on the synthetic corpus and round-trip through our
+BpeTokenizer (and the HF fast tokenizer as oracle)."""
+
+import pytest
+
+from deepdfa_tpu.data.synthetic import generate
+from deepdfa_tpu.data.tokenizer import BpeTokenizer
+from deepdfa_tpu.data.tokenizer_training import train_bpe
+
+
+def test_train_and_load(tmp_path):
+    pytest.importorskip("tokenizers")
+    synth = generate(120, vuln_rate=0.3, seed=4)
+    vocab, merges = train_bpe(
+        (s.before for s in synth), tmp_path, vocab_size=600, min_frequency=1
+    )
+    assert vocab.exists() and merges.exists()
+
+    tok = BpeTokenizer(vocab, merges)
+    ids = tok.encode("int f(char *src, int len) { strcpy(buf, src); }", 64)
+    assert ids[0] == tok.cls_id
+    assert tok.sep_id in ids
+    assert (ids >= 0).all() and (ids < tok.vocab_size).all()
+
+    # oracle: HF fast tokenizer over the same trained files
+    pytest.importorskip("transformers")
+    from transformers import RobertaTokenizerFast
+
+    hf = RobertaTokenizerFast(vocab_file=str(vocab), merges_file=str(merges))
+    sample = "for (i = 0; i < len; i++) total += src[i];"
+    want = hf(sample, max_length=64, padding="max_length", truncation=True)["input_ids"]
+    got = tok.encode(sample, 64)
+    assert got.tolist() == want
