@@ -140,9 +140,22 @@ class Example:
 
 
 def _extract_one(ex: Example) -> ExtractedGraph | None:
-    return extract_graph(
-        ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label
-    )
+    try:
+        return extract_graph(
+            ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label
+        )
+    except Exception:
+        # corpus-scale resilience: one pathological function must never
+        # kill a 188k-example run (the reference skips and logs failures,
+        # getgraphs.py:57-59); extract_graph handles parse errors itself,
+        # this guards against anything unexpected deeper in the pipeline
+        import logging
+        import traceback
+
+        logging.getLogger(__name__).warning(
+            "extraction failed for example %s:\n%s", ex.id, traceback.format_exc()
+        )
+        return None
 
 
 def extract_corpus(
