@@ -476,7 +476,9 @@ def cmd_train_combined(args) -> None:
     dp = mesh.shape.get("dp", 1)
     rows_per_shard = max(1, 16 // dp)
     bs = dp * rows_per_shard
-    trainer = CombinedTrainer(cfg, mcfg, mesh=mesh)
+    trainer = CombinedTrainer(
+        cfg, mcfg, mesh=mesh, freeze_graph=args.freeze_graph
+    )
 
     def split_ids_for(name):
         return [int(k) for k, v in splits.items() if v == name and int(k) in by_id]
@@ -512,6 +514,31 @@ def cmd_train_combined(args) -> None:
         return batches(ids)
 
     state = trainer.init_state()
+    if args.graph_checkpoint:
+        # reference combined recipe: GGNN pretrained standalone, then its
+        # encoder weights load (and optionally freeze) under the head
+        import jax as _jax
+
+        from deepdfa_tpu.models import DeepDFA
+        from deepdfa_tpu.train import CheckpointManager
+        from deepdfa_tpu.train.loop import _squeeze_batch as _sq
+        from deepdfa_tpu.graphs import pack_shards
+
+        dd_model = DeepDFA.from_config(
+            cfg.model, input_dim=cfg.data.feat.input_dim
+        )
+        dummy = pack_shards(
+            list(graphs_by_id.values())[:1] or [], 1, 1, 64, 256
+        )
+        dd_params = dd_model.init(_jax.random.key(0), _sq(dummy))
+        ckpt_dir = Path(args.graph_checkpoint)
+        if not ckpt_dir.exists():
+            ckpt_dir = paths.runs_dir(args.graph_checkpoint) / "checkpoints"
+        mgr = CheckpointManager(ckpt_dir)
+        dd_params = mgr.restore("best", _jax.device_get(dd_params))
+        state = trainer.load_graph_encoder_params(state, dd_params)
+        print(f"loaded graph encoder from {ckpt_dir}"
+              + (" (frozen)" if args.freeze_graph else ""))
     if args.pretrained:
         import torch
 
@@ -686,6 +713,12 @@ def main(argv=None) -> None:
                    help="dir with vocab.json+merges.txt (default: hash tokenizer)")
     p.add_argument("--max-length", type=int, default=512)
     p.add_argument("--no-graph", action="store_true")
+    p.add_argument("--graph-checkpoint", default=None,
+                   help="run name or checkpoints dir of a pretrained "
+                        "standalone DeepDFA to load into the graph branch")
+    p.add_argument("--freeze-graph", action="store_true",
+                   help="freeze the loaded graph encoder (reference "
+                        "--freeze_graph)")
     _add_common(p)
     p.set_defaults(fn=cmd_train_combined)
 

@@ -89,6 +89,7 @@ class CombinedTrainer:
         model_cfg,
         mesh: Mesh | None = None,
         total_steps: int | None = None,
+        freeze_graph: bool = False,
     ):
         """model_cfg: cmb.CombinedConfig (RoBERTa-family, LineVul/UniXcoder
         style) or t5.DefectConfig (CodeT5 style, eos pooling)."""
@@ -106,6 +107,12 @@ class CombinedTrainer:
                 "(relative position bias needs per-shard bias blocks)"
             )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
+        if freeze_graph:
+            # reference --freeze_graph: the pretrained GGNN stays fixed
+            # while the transformer fine-tunes (main_cli.py:136-145)
+            from deepdfa_tpu.train.transfer import frozen_optimizer
+
+            self.tx = frozen_optimizer(self.tx, frozen_top_keys=("graph",))
         self._build_specs()
         self._build_steps()
 
@@ -183,6 +190,22 @@ class CombinedTrainer:
         opt_state = self.tx.init(params)
         return TrainState(
             params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
+        )
+
+    def load_graph_encoder_params(
+        self, state: TrainState, deepdfa_params
+    ) -> TrainState:
+        """Splice a pretrained standalone DeepDFA's encoder weights into
+        the combined model's graph subtree (pairs with freeze_graph=True
+        for the reference --freeze_graph recipe)."""
+        from deepdfa_tpu.train.transfer import load_graph_encoder
+
+        params = load_graph_encoder(
+            dict(jax.device_get(state.params)), jax.device_get(deepdfa_params)
+        )
+        params = jax.device_put(params, self.param_shardings)
+        return TrainState(
+            params=params, opt_state=self.tx.init(params), step=state.step
         )
 
     def load_encoder(self, state: TrainState, encoder_params) -> TrainState:

@@ -56,12 +56,24 @@ def freeze_mask(params: dict, frozen_top_keys: tuple[str, ...] = ("graph",)) -> 
 
 
 def frozen_optimizer(
-    tx: optax.GradientTransformation, params: dict,
+    tx: optax.GradientTransformation,
+    params: dict | None = None,
     frozen_top_keys: tuple[str, ...] = ("graph",),
 ) -> optax.GradientTransformation:
-    """Wrap an optimizer so frozen subtrees receive zero updates."""
-    mask = freeze_mask(params, frozen_top_keys)
+    """Wrap an optimizer so frozen subtrees receive zero updates.
+
+    With params=None the masks are callables resolved at tx.init time, so
+    the wrapper can be installed before parameters exist (trainer
+    construction)."""
+    if params is not None:
+        mask = freeze_mask(params, frozen_top_keys)
+        inv = jax.tree.map(lambda t: not t, mask)
+    else:
+        mask = lambda p: freeze_mask(p, frozen_top_keys)  # noqa: E731
+        inv = lambda p: jax.tree.map(  # noqa: E731
+            lambda t: not t, freeze_mask(p, frozen_top_keys)
+        )
     return optax.chain(
         optax.masked(tx, mask),
-        optax.masked(optax.set_to_zero(), jax.tree.map(lambda t: not t, mask)),
+        optax.masked(optax.set_to_zero(), inv),
     )

@@ -83,15 +83,19 @@ def test_graph_encoder_transfer_and_freeze():
         loaded["graph"]["params"]["ggnn"], dd_params["params"]["ggnn"]
     )
 
-    # frozen optimizer: graph subtree gets zero updates
-    tx = frozen_optimizer(optax.sgd(0.1), loaded, frozen_top_keys=("graph",))
-    opt_state = tx.init(loaded)
-    grads = jax.tree.map(lambda x: jax.numpy.ones_like(x), loaded)
-    updates, _ = tx.update(grads, opt_state, loaded)
-    graph_updates = jax.tree.leaves(updates["graph"])
-    assert all(float(jax.numpy.abs(u).max()) == 0.0 for u in graph_updates)
-    head_updates = jax.tree.leaves(updates["head"])
-    assert any(float(jax.numpy.abs(u).max()) > 0.0 for u in head_updates)
+    # frozen optimizer: graph subtree gets zero updates — both the
+    # params-now form and the callable-mask (params-at-init-time) form
+    for tx in (
+        frozen_optimizer(optax.sgd(0.1), loaded, frozen_top_keys=("graph",)),
+        frozen_optimizer(optax.sgd(0.1), frozen_top_keys=("graph",)),
+    ):
+        opt_state = tx.init(loaded)
+        grads = jax.tree.map(lambda x: jax.numpy.ones_like(x), loaded)
+        updates, _ = tx.update(grads, opt_state, loaded)
+        graph_updates = jax.tree.leaves(updates["graph"])
+        assert all(float(jax.numpy.abs(u).max()) == 0.0 for u in graph_updates)
+        head_updates = jax.tree.leaves(updates["head"])
+        assert any(float(jax.numpy.abs(u).max()) > 0.0 for u in head_updates)
 
 
 def test_run_logger(tmp_path):
