@@ -289,3 +289,57 @@ def test_stage2_hash_matches_reference_format():
         assert set(d) == {"api", "datatype", "literal", "operator"}
         for v in d.values():
             assert v == sorted(v)
+
+
+def test_stage2_hash_golden_values():
+    """GOLDEN: the exact stage-2 hash strings for a frozen fixture.
+
+    The abstract-dataflow feature definition silently determines model F1
+    (SURVEY.md §7 hard part 4) — any change to decl detection, datatype
+    recursion, subkey collection, or hash serialization must show up here
+    as a conscious golden update, never an accident."""
+    cpg = parse_function(VULNY)
+    by_code = {
+        cpg.nodes[nid].code: h for nid, h in graph_features(cpg).items()
+    }
+    assert by_code == {
+        "n = strlen(src)": (
+            '{"api": ["strlen"], "datatype": ["int"], "literal": [], '
+            '"operator": []}'
+        ),
+        "n = len": (
+            '{"api": [], "datatype": ["int"], "literal": [], "operator": []}'
+        ),
+    }
+
+
+def test_stage2_hash_golden_values_cxx():
+    """GOLDEN: C++ fixture (operator/new/literal/qualified-datatype mix)."""
+    code = (
+        "int f(base::List* items, int len) {\n"
+        "  base::Value* out = NULL;\n"
+        "  char* p = new char[16];\n"
+        "  int k = len * 2 + items->size();\n"
+        "  return k;\n"
+        "}"
+    )
+    cpg = parse_function(code)
+    by_code = {
+        cpg.nodes[nid].code: h for nid, h in graph_features(cpg).items()
+    }
+    assert by_code == {
+        "out = NULL": (
+            '{"api": [], "datatype": ["base::Value*"], "literal": [], '
+            '"operator": []}'
+        ),
+        "p = new char[16]": (
+            '{"api": [], "datatype": ["char*"], "literal": ["16"], '
+            '"operator": ["new"]}'
+        ),
+        # the method-call receiver chain is absorbed into the api name
+        # (items->size), so no indirectFieldAccess operator appears
+        "k = len * 2 + items->size()": (
+            '{"api": ["items->size"], "datatype": ["int"], '
+            '"literal": ["2"], "operator": ["addition", "multiplication"]}'
+        ),
+    }
