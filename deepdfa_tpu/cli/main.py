@@ -657,17 +657,9 @@ def _apply_platform_override() -> None:
     pipeline on a host whose accelerator tunnel is down, or test multi-chip
     code on N virtual CPU devices). Must run before any backend use; works
     even where a sitecustomize pins JAX_PLATFORMS."""
-    import os
+    from deepdfa_tpu.core.backend import apply_platform_override
 
-    spec = os.environ.get("DEEPDFA_TPU_PLATFORM")
-    if not spec:
-        return
-    platform, _, n = spec.partition(":")
-    import jax
-
-    if n:
-        jax.config.update("jax_num_cpu_devices", int(n))
-    jax.config.update("jax_platforms", platform)
+    apply_platform_override()
 
 
 def main(argv=None) -> None:

@@ -1,4 +1,4 @@
-from deepdfa_tpu.core import config, paths, prng
+from deepdfa_tpu.core import backend, config, paths, prng
 from deepdfa_tpu.core.config import (
     BatchConfig,
     Config,
@@ -11,6 +11,7 @@ from deepdfa_tpu.core.config import (
 )
 
 __all__ = [
+    "backend",
     "config",
     "paths",
     "prng",

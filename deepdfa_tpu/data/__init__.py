@@ -7,7 +7,13 @@ from deepdfa_tpu.data.pipeline import (
     extract_graph,
     to_graph_spec,
 )
-from deepdfa_tpu.data.synthetic import SynthExample, generate, split_ids, to_examples
+from deepdfa_tpu.data.synthetic import (
+    SynthExample,
+    bigvul_stmt_sizes,
+    generate,
+    split_ids,
+    to_examples,
+)
 
 __all__ = [
     "diff_lines",
@@ -19,6 +25,7 @@ __all__ = [
     "extract_graph",
     "to_graph_spec",
     "SynthExample",
+    "bigvul_stmt_sizes",
     "generate",
     "split_ids",
     "to_examples",
