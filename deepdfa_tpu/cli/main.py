@@ -201,32 +201,43 @@ def _load_graph_splits(cfg: Config):
     return out
 
 
-def _epoch_batches(cfg: Config, specs, mesh, shuffle_epoch=None):
+def _epoch_batches(cfg: Config, specs, mesh, shuffle_epoch=None, phase="train"):
+    """Budget-aware dp-sharded batches for one pass over `specs`.
+
+    phase="train": over-budget graphs are dropped (and counted loudly);
+    phase="eval": they get dedicated pow2-budget overflow batches so
+    every example is scored (reference evaluates every graph by shrinking
+    test batches, DDFA/sastvd/linevd/datamodule.py:135-141).
+    """
     import numpy as np
 
-    from deepdfa_tpu.graphs import pack_shards
+    from deepdfa_tpu.graphs import shard_bucket_batches
     from deepdfa_tpu.train import undersample_epoch
 
     dp = mesh.shape.get("dp", 1)
     bcfg = cfg.data.batch
-    bs = max(dp, (cfg.data.batch.graphs_per_batch // dp) * dp)
     if shuffle_epoch is not None and cfg.data.undersample:
         labels = np.array([s.label for s in specs])
         idx = undersample_epoch(labels, shuffle_epoch, seed=cfg.data.seed)
         sel = [specs[i] for i in idx]
     else:
         sel = list(specs)
-    out = []
-    for k in range(0, len(sel), bs):
-        chunk = sel[k : k + bs]
-        out.append(
-            pack_shards(
-                chunk,
-                num_shards=dp,
-                num_graphs=bs // dp,
-                node_budget=bcfg.node_budget,
-                edge_budget=bcfg.edge_budget,
-            )
+    stats: dict = {}
+    out = list(
+        shard_bucket_batches(
+            sel,
+            num_shards=dp,
+            num_graphs=max(1, bcfg.graphs_per_batch // dp),
+            node_budget=bcfg.node_budget,
+            edge_budget=bcfg.edge_budget,
+            oversized="drop" if phase == "train" else "singleton",
+            stats=stats,
+        )
+    )
+    if stats.get("dropped"):
+        print(
+            f"[batch] dropped {stats['dropped']}/{len(sel)} over-budget "
+            f"graphs (training only; eval scores every example)"
         )
     return out
 
@@ -260,7 +271,9 @@ def cmd_train(args) -> None:
         state = trainer.fit(
             state,
             lambda epoch: _epoch_batches(cfg, split_specs["train"], mesh, epoch),
-            val_batches=lambda: _epoch_batches(cfg, split_specs["val"], mesh),
+            val_batches=lambda: _epoch_batches(
+                cfg, split_specs["val"], mesh, phase="eval"
+            ),
             checkpoints=ckpts,
             log_fn=run_log.log,
         )
@@ -281,7 +294,7 @@ def cmd_test(args) -> None:
     model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
     trainer = GraphTrainer(model, cfg, mesh=mesh)
 
-    batches = _epoch_batches(cfg, split_specs[args.split], mesh)
+    batches = _epoch_batches(cfg, split_specs[args.split], mesh, phase="eval")
     state = trainer.init_state(batches[0])
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
     params = ckpts.restore(args.checkpoint, jax.device_get(state.params))
@@ -527,9 +540,10 @@ def cmd_train_combined(args) -> None:
         dd_model = DeepDFA.from_config(
             cfg.model, input_dim=cfg.data.feat.input_dim
         )
-        dummy = pack_shards(
-            list(graphs_by_id.values())[:1] or [], 1, 1, 64, 256
-        )
+        # init needs shapes only, never graph content — an empty pack always
+        # fits, whereas packing an arbitrary real graph raises BudgetExceeded
+        # whenever it exceeds the tiny dummy budgets
+        dummy = pack_shards([], 1, 1, 64, 256)
         dd_params = dd_model.init(_jax.random.key(0), _sq(dummy))
         ckpt_dir = Path(args.graph_checkpoint)
         if not ckpt_dir.exists():

@@ -20,6 +20,7 @@ selected subkeys (to_hash :285-295).
 from __future__ import annotations
 
 import json
+import logging
 import re
 from typing import Iterable
 
@@ -69,55 +70,75 @@ def clean_datatype(dt: str) -> str:
     return dt.strip()
 
 
-def _recurse_datatype(cpg: Cpg, v: int) -> tuple[int, str] | None:
+def _recurse_datatype(cpg: Cpg, v: int) -> tuple[int, str]:
+    """Unhandled shapes RAISE (NotImplementedError / KeyError), exactly like
+    the reference (abstract_dataflow_full.py:67-107) — the exception aborts
+    decl_features, so the node keeps only fields collected before it."""
     attr = cpg.nodes[v]
     if attr.label == "IDENTIFIER":
         return v, attr.type_full_name
     if attr.label == "CALL" and attr.name in _DATATYPE_ARG_IDX:
         args = {cpg.nodes[a].order: a for a in cpg.successors(v, "ARGUMENT")}
-        want = _DATATYPE_ARG_IDX[attr.name]
-        if want not in args:
-            return None
-        arg = args[want]
+        arg = args[_DATATYPE_ARG_IDX[attr.name]]  # KeyError when absent
         arg_attr = cpg.nodes[arg]
         if arg_attr.label == "IDENTIFIER":
             return arg, arg_attr.type_full_name
         if arg_attr.label == "CALL":
             return _recurse_datatype(cpg, arg)
-    return None
+        raise NotImplementedError(
+            f"recurse_datatype index could not handle {arg} {arg_attr}"
+        )
+    raise NotImplementedError(f"recurse_datatype var could not handle {v} {attr}")
 
 
-def _raw_datatype(cpg: Cpg, decl: int) -> tuple[int, str] | None:
+def _raw_datatype(cpg: Cpg, decl: int) -> tuple[int, str]:
     attr = cpg.nodes[decl]
     if attr.label == "LOCAL":
         return decl, attr.type_full_name
     if attr.label == "CALL" and attr.name in _ASSIGNMENT_TYPES | {"<operator>.cast"}:
         args = {cpg.nodes[a].order: a for a in cpg.successors(decl, "ARGUMENT")}
-        if 1 not in args:
-            return None
-        return _recurse_datatype(cpg, args[1])
-    return None
+        return _recurse_datatype(cpg, args[1])  # KeyError when no 1st arg
+    raise NotImplementedError(f"get_raw_datatype did not handle {decl} {attr}")
 
 
 def decl_features(cpg: Cpg, nid: int) -> list[tuple[str, str]]:
-    """(subkey, value) fields for one definition node."""
+    """(subkey, value) fields for one definition node.
+
+    Mirrors the reference's grab_declfeats error contract
+    (abstract_dataflow_full.py:127-166): any failure — most commonly an
+    unhandled LHS shape inside the datatype recursion — aborts collection
+    and returns only the fields gathered so far (usually none, since
+    datatype comes first). Nodes whose recursion fails therefore get NO
+    hash, keeping the feature vocabulary aligned with the reference's.
+    """
     fields: list[tuple[str, str]] = []
-    ret = _raw_datatype(cpg, nid)
-    if ret is not None:
-        _, dt = ret
-        if dt is not None:
-            fields.append(("datatype", clean_datatype(dt)))
-    for d in cpg.ast_descendants(nid, skip_labels=("METHOD",)):
-        n = cpg.nodes[d]
-        if n.label == "LITERAL":
-            fields.append(("literal", n.code))
-        elif n.label == "CALL":
-            m = re.match(r"<operators?>\.(.*)", n.name)
-            if m:
-                if m.group(1) not in ("indirection",):
-                    fields.append(("operator", m.group(1)))
-            else:
-                fields.append(("api", n.name))
+    try:
+        ret = _raw_datatype(cpg, nid)
+        if ret is not None:
+            _, dt = ret
+            if dt is not None:
+                fields.append(("datatype", clean_datatype(dt)))
+        for d in cpg.ast_descendants(nid, skip_labels=("METHOD",)):
+            n = cpg.nodes[d]
+            if n.label == "LITERAL":
+                fields.append(("literal", n.code))
+            elif n.label == "CALL":
+                # reference matches '<operator>\.' only: legacy
+                # '<operators>.x' names classify as api, not operator
+                m = re.match(r"<operator>\.(.*)", n.name)
+                if m:
+                    if m.group(1) not in ("indirection",):
+                        fields.append(("operator", m.group(1)))
+                else:
+                    fields.append(("api", n.name))
+    except Exception:
+        # the reference logs and keeps the partial fields ("node error" +
+        # traceback, :163-166); debug level so corpus runs aren't flooded —
+        # expected failures are NotImplementedError/KeyError from the
+        # datatype recursion above
+        logging.getLogger(__name__).debug(
+            "decl_features aborted for node %s", nid, exc_info=True
+        )
     return fields
 
 

@@ -6,6 +6,7 @@ from deepdfa_tpu.graphs.batch import (
     bucket_batches,
     pack,
     pack_shards,
+    shard_bucket_batches,
 )
 from deepdfa_tpu.graphs.store import GraphStore, load_shard, save_shard
 
@@ -17,6 +18,7 @@ __all__ = [
     "bucket_batches",
     "pack",
     "pack_shards",
+    "shard_bucket_batches",
     "GraphStore",
     "load_shard",
     "save_shard",

@@ -163,6 +163,21 @@ def pack(
     )
 
 
+def _stack_shards(
+    per_shard: Sequence[Sequence[GraphSpec]],
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    add_self_loops: bool = True,
+) -> GraphBatch:
+    shards = [
+        pack(sg, num_graphs, node_budget, edge_budget, add_self_loops)
+        for sg in per_shard
+    ]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
+    return dataclasses.replace(stacked, num_graphs=num_graphs)
+
+
 def pack_shards(
     graphs: Sequence[GraphSpec],
     num_shards: int,
@@ -195,12 +210,127 @@ def pack_shards(
             raise BudgetExceeded(
                 f"{len(graphs)} graphs > {num_shards} shards x {num_graphs}"
             )
-    shards = [
-        pack(sg, num_graphs, node_budget, edge_budget, add_self_loops)
-        for sg in per_shard
-    ]
-    stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
-    return dataclasses.replace(stacked, num_graphs=num_graphs)
+    return _stack_shards(
+        per_shard, num_graphs, node_budget, edge_budget, add_self_loops
+    )
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(0, int(x) - 1).bit_length() if x > 1 else 1
+
+
+def shard_bucket_batches(
+    graphs: Iterable[GraphSpec],
+    num_shards: int,
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    add_self_loops: bool = True,
+    oversized: str = "drop",
+    stats: dict | None = None,
+) -> Iterable[GraphBatch]:
+    """Greedy budget-aware packing into dp-sharded fixed-budget batches.
+
+    Unlike count-only chunking + pack_shards, a new batch starts whenever
+    the incoming graph fits no shard of the current one — heavy-tail
+    corpora never raise BudgetExceeded mid-stream.
+
+    `oversized` controls graphs exceeding the per-shard budgets outright:
+    - "drop": skip them — training semantics; count reported via `stats`
+      (reference analog: the reference tolerates skipping only in training,
+      DDFA/sastvd/linevd/datamodule.py evaluates every graph by shrinking
+      test batches to 16).
+    - "raise": BudgetExceeded.
+    - "singleton": emit dedicated trailing batches whose budgets are the
+      graph's needs rounded up to powers of two — eval semantics: EVERY
+      example is scored, and pow2 rounding bounds the extra XLA
+      compilations to O(log max_size) signatures. Graphs sharing a rounded
+      signature ride the dp axis together (one per shard).
+
+    `stats` (optional dict) receives: "batches", "dropped" (only under
+    "drop"), "oversized", "overflow_signatures".
+    """
+    if oversized not in ("drop", "raise", "singleton"):
+        raise ValueError(f"oversized={oversized!r}")
+    if stats is None:
+        stats = {}
+    stats.update(batches=0, dropped=0, oversized=0, overflow_signatures=0)
+
+    overflow: dict[tuple[int, int], list[GraphSpec]] = {}
+    per_shard: list[list[GraphSpec]] = [[] for _ in range(num_shards)]
+    counts = np.zeros(num_shards, np.int64)
+    n_used = np.zeros(num_shards, np.int64)
+    e_used = np.zeros(num_shards, np.int64)
+
+    def flush():
+        nonlocal per_shard, counts, n_used, e_used
+        if counts.sum():
+            stats["batches"] += 1
+            batch = _stack_shards(
+                per_shard, num_graphs, node_budget, edge_budget,
+                add_self_loops,
+            )
+            per_shard = [[] for _ in range(num_shards)]
+            counts = np.zeros(num_shards, np.int64)
+            n_used = np.zeros(num_shards, np.int64)
+            e_used = np.zeros(num_shards, np.int64)
+            return batch
+        return None
+
+    for g in graphs:
+        e_need = g.num_edges + (g.num_nodes if add_self_loops else 0)
+        if g.num_nodes > node_budget or e_need > edge_budget:
+            stats["oversized"] += 1
+            if oversized == "raise":
+                raise BudgetExceeded(
+                    f"graph {g.graph_id}: {g.num_nodes} nodes / {e_need} "
+                    f"edges exceed budgets ({node_budget}/{edge_budget})"
+                )
+            if oversized == "drop":
+                stats["dropped"] += 1
+                continue
+            sig = (_pow2_ceil(g.num_nodes), _pow2_ceil(e_need))
+            overflow.setdefault(sig, []).append(g)
+            continue
+        # least-loaded shard (by nodes) with room in every budget
+        order = np.argsort(n_used, kind="stable")
+        placed = False
+        for s in order:
+            s = int(s)
+            if (
+                counts[s] < num_graphs
+                and n_used[s] + g.num_nodes <= node_budget
+                and e_used[s] + e_need <= edge_budget
+            ):
+                per_shard[s].append(g)
+                counts[s] += 1
+                n_used[s] += g.num_nodes
+                e_used[s] += e_need
+                placed = True
+                break
+        if not placed:
+            batch = flush()
+            if batch is not None:
+                yield batch
+            per_shard[0].append(g)
+            counts[0] += 1
+            n_used[0] += g.num_nodes
+            e_used[0] += e_need
+    batch = flush()
+    if batch is not None:
+        yield batch
+
+    stats["overflow_signatures"] = len(overflow)
+    for (nb, eb), gs in sorted(overflow.items()):
+        for k in range(0, len(gs), num_shards):
+            stats["batches"] += 1
+            yield _stack_shards(
+                [
+                    gs[k + s : k + s + 1] if k + s < len(gs) else []
+                    for s in range(num_shards)
+                ],
+                1, nb, eb, add_self_loops,
+            )
 
 
 def bucket_batches(
@@ -210,12 +340,18 @@ def bucket_batches(
     edge_budget: int,
     drop_oversized: bool = True,
     add_self_loops: bool = True,
+    stats: dict | None = None,
 ) -> Iterable[GraphBatch]:
     """Greedy first-fit packing of a graph stream into fixed-budget batches.
 
     One (num_graphs, node_budget, edge_budget) signature means one XLA
-    compilation for the whole stream.
+    compilation for the whole stream. Dropping is training-only semantics;
+    eval paths use `shard_bucket_batches(..., oversized="singleton")` so
+    every example is scored. `stats` receives the "dropped" count.
     """
+    if stats is None:
+        stats = {}
+    stats.setdefault("dropped", 0)
     cur: list[GraphSpec] = []
     n_used = 0
     e_used = 0
@@ -223,6 +359,7 @@ def bucket_batches(
         e_need = g.num_edges + (g.num_nodes if add_self_loops else 0)
         if g.num_nodes > node_budget or e_need > edge_budget:
             if drop_oversized:
+                stats["dropped"] += 1
                 continue
             raise BudgetExceeded(
                 f"graph {g.graph_id}: {g.num_nodes} nodes / {e_need} edges "

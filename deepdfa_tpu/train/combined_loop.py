@@ -390,17 +390,24 @@ class CombinedTrainer:
             if val_batches is not None:
                 val_metrics, _ = self.evaluate(state, val_batches())
                 record.update({f"val_{k}": v for k, v in val_metrics.items()})
-                if checkpoints is not None:
-                    checkpoints.save(
-                        f"epoch-{epoch:04d}",
-                        jax.device_get(state.params),
-                        {
-                            k: float(v)
-                            for k, v in record.items()
-                            if isinstance(v, (int, float)) and k != "epoch"
-                        },
-                        step=step,
-                    )
+            # mirror GraphTrainer.fit: without a val split, still persist on
+            # the periodic cadence and on the final epoch, so a val-less run
+            # never trains to completion and saves nothing
+            if checkpoints is not None and (
+                any(k.startswith("val_") for k in record)
+                or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
+                or epoch == max_epochs - 1
+            ):
+                checkpoints.save(
+                    f"epoch-{epoch:04d}",
+                    jax.device_get(state.params),
+                    {
+                        k: float(v)
+                        for k, v in record.items()
+                        if isinstance(v, (int, float)) and k != "epoch"
+                    },
+                    step=step,
+                )
             logger.info("epoch %d: %s", epoch, record)
             if log_fn is not None:
                 log_fn(record)

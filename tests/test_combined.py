@@ -125,3 +125,23 @@ def test_combined_trains_on_synthetic(corpus):
         state, batches(np.array(test_ids), drop_remainder=False)
     )
     assert metrics["f1"] > 0.9, metrics
+
+
+def test_combined_fit_without_val_still_checkpoints(corpus, tmp_path):
+    """A run with no validation split must still persist weights (periodic +
+    final-epoch fallback, mirroring GraphTrainer.fit)."""
+    synth, token_ids, labels, by_id, train_ids, _ = corpus
+    cfg = config_mod.apply_overrides(Config(), ["train.max_epochs=1"])
+    mesh = make_mesh(MeshConfig(dp=8))
+    trainer = CombinedTrainer(cfg, _model_cfg(), mesh=mesh, total_steps=2)
+    b = collate_shards(
+        token_ids[:16], [labels[i] for i in range(16)], list(range(16)),
+        by_id, num_shards=8, rows_per_shard=2, node_budget=512,
+        edge_budget=2048,
+    )
+    ckpts = trainer.make_checkpoints(tmp_path / "ckpts")
+    state = trainer.init_state()
+    trainer.fit(state, lambda epoch: [b], val_batches=None, checkpoints=ckpts)
+    assert ckpts._manifest["last"] is not None, (
+        "no checkpoint saved for a val-less run"
+    )

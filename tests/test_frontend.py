@@ -313,6 +313,25 @@ def test_stage2_hash_golden_values():
     }
 
 
+def test_datatype_recursion_failure_drops_all_fields():
+    """Reference error contract (abstract_dataflow_full.py:127-166): when
+    the LHS datatype recursion hits an unhandled shape it raises, aborting
+    field collection — the node gets NO hash even though it has literal /
+    api descendants. Nodes with resolvable LHS are unaffected."""
+    code = (
+        "int f(int *a, int x) {\n"
+        "  *(g(a)) = x + 1;\n"
+        "  int y = x;\n"
+        "  return y;\n"
+        "}"
+    )
+    cpg = parse_function(code)
+    by_code = {
+        cpg.nodes[nid].code: h for nid, h in graph_features(cpg).items()
+    }
+    assert set(by_code) == {"y = x"}
+
+
 def test_stage2_hash_golden_values_cxx():
     """GOLDEN: C++ fixture (operator/new/literal/qualified-datatype mix)."""
     code = (

@@ -79,6 +79,65 @@ def test_bucket_batches_drops_oversized(rng):
         )
 
 
+def test_shard_bucket_batches_covers_all_heavy_tail(rng):
+    """Eval semantics: with oversized='singleton' EVERY graph is scored,
+    including ones over the per-shard budgets; overflow batches use pow2
+    budgets so extra XLA signatures stay bounded."""
+    from deepdfa_tpu.graphs import shard_bucket_batches
+
+    gs = [make_graph(rng, i, int(rng.integers(3, 50)), 10) for i in range(40)]
+    gs.append(make_graph(rng, 40, 300, 60))  # > node_budget
+    gs.append(make_graph(rng, 41, 10, 600))  # > edge_budget
+    gs.append(make_graph(rng, 42, 310, 60))  # same pow2 signature as 40
+    stats: dict = {}
+    batches = list(
+        shard_bucket_batches(
+            gs, num_shards=4, num_graphs=8, node_budget=128, edge_budget=512,
+            oversized="singleton", stats=stats,
+        )
+    )
+    ids = [
+        i for b in batches for i in np.asarray(b.graph_ids).flatten().tolist()
+        if i >= 0
+    ]
+    assert sorted(ids) == list(range(43))
+    assert stats["oversized"] == 3
+    assert stats["dropped"] == 0
+    # 40 and 42 round to the same (512-node) signature -> share one batch
+    assert stats["overflow_signatures"] == 2
+    for b in batches:
+        nb = b.node_feats.shape[-2]
+        assert nb == 128 or (nb & (nb - 1)) == 0  # base or pow2 overflow
+        # budgets respected per shard
+        for s in range(b.node_mask.shape[0]):
+            assert np.asarray(b.node_mask[s]).sum() <= nb
+
+
+def test_shard_bucket_batches_drop_and_raise(rng):
+    from deepdfa_tpu.graphs import shard_bucket_batches
+
+    gs = [make_graph(rng, 0, 300, 10), make_graph(rng, 1, 5, 4)]
+    stats: dict = {}
+    batches = list(
+        shard_bucket_batches(
+            gs, num_shards=2, num_graphs=4, node_budget=64, edge_budget=256,
+            oversized="drop", stats=stats,
+        )
+    )
+    ids = [
+        i for b in batches for i in np.asarray(b.graph_ids).flatten().tolist()
+        if i >= 0
+    ]
+    assert ids == [1] and stats["dropped"] == 1
+    with pytest.raises(BudgetExceeded):
+        list(
+            shard_bucket_batches(
+                gs, num_shards=2, num_graphs=4, node_budget=64,
+                edge_budget=256, oversized="raise",
+            )
+        )
+
+
 def test_pack_shards_stacks_and_balances(rng):
     gs = [make_graph(rng, i, int(rng.integers(3, 30)), 8) for i in range(16)]
     b = pack_shards(gs, num_shards=4, num_graphs=8, node_budget=128, edge_budget=512)

@@ -75,6 +75,48 @@ def test_train_learns_synthetic_signal(dataset):
     assert metrics["loss"] < 0.3, metrics
 
 
+def test_eval_covers_every_graph_including_over_budget(dataset):
+    """VERDICT round-1 item: eval must never silently drop examples. A graph
+    over the per-shard budgets rides a pow2 overflow batch and is scored."""
+    from deepdfa_tpu.graphs import shard_bucket_batches
+
+    rng = np.random.default_rng(3)
+    big_n = 600  # > node_budget=256
+    feats = rng.integers(2, 20, (big_n, 4)).astype(np.int32)
+    feats[0, 0] = 7
+    big = GraphSpec(
+        graph_id=999,
+        node_feats=feats,
+        node_vuln=np.zeros((big_n,), np.int32),
+        edge_src=np.arange(big_n - 1, dtype=np.int32),
+        edge_dst=np.arange(1, big_n, dtype=np.int32),
+        label=1.0,
+    )
+    graphs = list(dataset) + [big]
+    cfg = config_mod.apply_overrides(Config(), ["model.hidden_dim=8"])
+    mesh = make_mesh(MeshConfig(dp=8), devices=None)
+    model = DeepDFA.from_config(cfg.model, input_dim=32)
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+
+    stats: dict = {}
+    batches = list(
+        shard_bucket_batches(
+            graphs, num_shards=8, num_graphs=8, node_budget=256,
+            edge_budget=1024, oversized="singleton", stats=stats,
+        )
+    )
+    assert stats["oversized"] == 1
+    ids = [
+        i for b in batches for i in np.asarray(b.graph_ids).flatten().tolist()
+        if i >= 0
+    ]
+    assert sorted(ids) == sorted(g.graph_id for g in graphs)
+    state = trainer.init_state(batches[0])
+    metrics, m = trainer.evaluate(state, batches)
+    assert m.count == len(graphs), (m.count, len(graphs))
+    assert np.isfinite(metrics["loss"])
+
+
 def test_dp_matches_single_device(dataset):
     """Grad psum over 8 shards must reproduce the 1-shard result."""
     import jax
