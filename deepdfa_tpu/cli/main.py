@@ -157,7 +157,9 @@ def cmd_extract(args) -> None:
             for i, e in enumerate(examples)
             if i % args.num_shards == args.shard
         ]
-        specs = encode_corpus(sel, vocabs, workers=args.workers)
+        specs = encode_corpus(
+            sel, vocabs, workers=args.workers, max_defs=cfg.data.feat.max_defs
+        )
         tag = f"shard{args.shard:04d}" if args.num_shards > 1 else None
         store.write(specs, tag=tag)
         if fixed_vocab_src != vocab_path:
@@ -175,6 +177,7 @@ def cmd_extract(args) -> None:
         limit_all=cfg.data.feat.limit_all,
         limit_subkeys=cfg.data.feat.limit_subkeys,
         workers=args.workers,
+        max_defs=cfg.data.feat.max_defs,
     )
     store.write(specs)
     vocab_path.write_text(
@@ -569,6 +572,29 @@ def cmd_train_combined(args) -> None:
     print("best:", ckpts.best_metrics())
 
 
+def cmd_codebleu(args) -> None:
+    """Score a generation hypothesis file against reference files
+    (reference CLI: CodeT5/evaluator/CodeBLEU/calc_code_bleu.py:66-81)."""
+    from deepdfa_tpu.eval.codebleu import get_codebleu
+
+    refs_per_file = [
+        [line.strip() for line in Path(f).read_text().splitlines()]
+        for f in args.refs
+    ]
+    hyps = [line.strip() for line in Path(args.hyp).read_text().splitlines()]
+    for rr in refs_per_file:
+        if len(rr) != len(hyps):
+            raise SystemExit("refs and hyp must have equal line counts")
+    references = [
+        [rr[i] for rr in refs_per_file] for i in range(len(hyps))
+    ]
+    out = get_codebleu(
+        references, hyps, lang=args.lang,
+        params=tuple(float(x) for x in args.params.split(",")),
+    )
+    print(json.dumps(out, indent=2))
+
+
 def cmd_localize(args) -> None:
     """Line-level localization evaluation over a trained combined model:
     saliency (or attention) token scores -> per-line ranking -> top-k /
@@ -760,6 +786,15 @@ def main(argv=None) -> None:
     p = sub.add_parser("coverage")
     _add_common(p)
     p.set_defaults(fn=cmd_coverage)
+
+    p = sub.add_parser("codebleu")
+    p.add_argument("--refs", nargs="+", required=True,
+                   help="reference files (one example per line)")
+    p.add_argument("--hyp", required=True, help="hypothesis file")
+    p.add_argument("--lang", default="c", choices=["c", "cpp"])
+    p.add_argument("--params", default="0.25,0.25,0.25,0.25",
+                   help="alpha,beta,gamma,theta component weights")
+    p.set_defaults(fn=cmd_codebleu)
 
     p = sub.add_parser("bench")
     _add_common(p)

@@ -36,6 +36,9 @@ class FeatureSpec:
     subkeys: tuple[str, ...] = ALL_SUBKEYS
     limit_all: int | None = 1000  # None = unlimited (reference parse_limits)
     limit_subkeys: int | None = 1000
+    #: attach reaching-definitions bit labels of this width at extraction
+    #: (required for the dataflow_solution_{in,out} label styles)
+    max_defs: int | None = None
 
     def __post_init__(self):
         # canonical order so equal artifact names imply equal specs
@@ -53,10 +56,14 @@ class FeatureSpec:
     @property
     def name(self) -> str:
         sk = "_".join(sorted(self.subkeys))
-        return (
+        base = (
             f"_ABS_DATAFLOW_{sk}_all_limitall_{self.limit_all}"
             f"_limitsubkeys_{self.limit_subkeys}"
         )
+        # artifact names must distinguish bit-labeled stores from plain ones
+        if self.max_defs is not None:
+            base += f"_maxdefs_{self.max_defs}"
+        return base
 
     @classmethod
     def parse(cls, feat: str) -> "FeatureSpec":
@@ -75,6 +82,7 @@ class FeatureSpec:
             subkeys=subkeys,
             limit_all=_limit("limitall", 1000),
             limit_subkeys=_limit("limitsubkeys", 1000),
+            max_defs=_limit("maxdefs", None),
         )
 
 
@@ -86,7 +94,9 @@ class ModelConfig:
     n_steps: int = 5
     num_output_layers: int = 3
     concat_all_absdf: bool = True
-    label_style: str = "graph"  # graph | node
+    # graph | node | dataflow_solution_in | dataflow_solution_out
+    # (dataflow styles need data.feat.max_defs set at extraction)
+    label_style: str = "graph"
     encoder_mode: bool = False
     # TPU-specific knobs (no reference equivalent):
     param_dtype: str = "float32"

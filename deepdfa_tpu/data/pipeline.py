@@ -17,6 +17,7 @@ at batch time (dbize_graphs.py:25).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from multiprocessing import Pool
 from typing import Callable, Iterable, Mapping, Sequence
 
@@ -42,6 +43,9 @@ class ExtractedGraph:
     edge_dst: np.ndarray
     def_fields: dict[int, Fields]  # dense node idx -> stage-1 fields
     label: float  # function-level label
+    #: optional reaching-definitions bit labels ([n, max_defs] float32 each:
+    #: gen/kill/in/out) for the dataflow_solution_{in,out} label styles
+    bits: dict[str, np.ndarray] | None = None
 
     @property
     def num_nodes(self) -> int:
@@ -53,6 +57,7 @@ def extract_graph(
     graph_id: int,
     vuln_lines: set[int] | None = None,
     label: float | None = None,
+    max_defs: int | None = None,
 ) -> ExtractedGraph | None:
     """Parse one function and build its model graph. None on failure or
     empty CFG (reference behavior: failures are skipped and logged,
@@ -85,6 +90,28 @@ def extract_graph(
             if fields:
                 def_fields[dense[nid]] = fields
 
+    bits = None
+    if max_defs is not None:
+        # reaching-definitions supervision over the FULL CFG, remapped onto
+        # the kept (line-bearing) nodes; graphs with zero definition sites
+        # get all-zero arrays so the corpus stays fixed-width
+        from deepdfa_tpu.nn.bitprop import rd_bit_problem
+
+        prob = rd_bit_problem(cpg, max_defs, clip=True)
+        n_keep = len(keep)
+        bits = {
+            k: np.zeros((n_keep, max_defs), np.float32)
+            for k in ("gen", "kill", "labels_in", "labels_out")
+        }
+        if prob is not None:
+            full_dense = {nid: i for i, nid in enumerate(prob["nodes"])}
+            rows = np.array(
+                [full_dense.get(nid, -1) for nid in keep], np.int64
+            )
+            ok = rows >= 0
+            for k in bits:
+                bits[k][ok] = prob[k][rows[ok]]
+
     if label is None:
         label = (
             1.0
@@ -98,6 +125,7 @@ def extract_graph(
         edge_dst=np.array(dst, np.int32),
         def_fields=def_fields,
         label=float(label),
+        bits=bits,
     )
 
 
@@ -119,6 +147,14 @@ def to_graph_spec(
         vuln = np.zeros((n,), np.int32)
         if eg.label > 0:
             vuln[:] = 0  # graph label carried separately
+    bit_kw = {}
+    if eg.bits is not None:
+        bit_kw = dict(
+            node_gen=eg.bits["gen"],
+            node_kill=eg.bits["kill"],
+            node_bits_in=eg.bits["labels_in"],
+            node_bits_out=eg.bits["labels_out"],
+        )
     return GraphSpec(
         graph_id=eg.graph_id,
         node_feats=feats,
@@ -126,6 +162,7 @@ def to_graph_spec(
         edge_src=eg.edge_src,
         edge_dst=eg.edge_dst,
         label=eg.label,
+        **bit_kw,
     )
 
 
@@ -139,10 +176,11 @@ class Example:
     vuln_lines: frozenset[int] = frozenset()
 
 
-def _extract_one(ex: Example) -> ExtractedGraph | None:
+def _extract_one(ex: Example, max_defs: int | None = None) -> ExtractedGraph | None:
     try:
         return extract_graph(
-            ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label
+            ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label,
+            max_defs=max_defs,
         )
     except Exception:
         # corpus-scale resilience: one pathological function must never
@@ -159,15 +197,17 @@ def _extract_one(ex: Example) -> ExtractedGraph | None:
 
 
 def extract_corpus(
-    examples: Sequence[Example], workers: int = 0
+    examples: Sequence[Example], workers: int = 0,
+    max_defs: int | None = None,
 ) -> list[ExtractedGraph]:
     """Stage getgraphs+absdf-stage-1 over a corpus (mp fan-out like the
     reference's dfmp, sastvd/__init__.py:198-244)."""
+    fn = partial(_extract_one, max_defs=max_defs)
     if workers and workers > 1:
         with Pool(workers) as pool:
-            out = pool.map(_extract_one, examples, chunksize=64)
+            out = pool.map(fn, examples, chunksize=64)
     else:
-        out = [_extract_one(ex) for ex in examples]
+        out = [fn(ex) for ex in examples]
     return [g for g in out if g is not None]
 
 
@@ -196,9 +236,10 @@ def encode_corpus(
     examples: Sequence[Example],
     vocabs: Mapping[str, AbsDfVocab],
     workers: int = 0,
+    max_defs: int | None = None,
 ) -> list[GraphSpec]:
     """Extract + encode a corpus slice against pre-built vocabularies."""
-    graphs = extract_corpus(examples, workers=workers)
+    graphs = extract_corpus(examples, workers=workers, max_defs=max_defs)
     by_id = {ex.id: ex for ex in examples}
     return [
         to_graph_spec(g, vocabs, set(by_id[g.graph_id].vuln_lines) or None)
@@ -212,10 +253,12 @@ def build_dataset(
     limit_all: int | None = 1000,
     limit_subkeys: int | None = 1000,
     workers: int = 0,
+    max_defs: int | None = None,
 ) -> tuple[list[GraphSpec], dict[str, AbsDfVocab]]:
     """Full single-process pipeline: extract, build train-split vocabs,
-    encode everything."""
-    graphs = extract_corpus(examples, workers=workers)
+    encode everything. `max_defs` attaches reaching-definitions bit labels
+    of that width for the dataflow_solution_{in,out} label styles."""
+    graphs = extract_corpus(examples, workers=workers, max_defs=max_defs)
     train = set(train_ids)
     train_fields = [
         f

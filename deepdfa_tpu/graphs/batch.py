@@ -32,7 +32,14 @@ NUM_SUBKEY_FEATS = 4  # api, datatype, literal, operator
 
 @dataclasses.dataclass(frozen=True)
 class GraphSpec:
-    """One host-side graph: ragged arrays, pre-batching."""
+    """One host-side graph: ragged arrays, pre-batching.
+
+    The optional bit-label block carries reaching-definitions supervision
+    for the `dataflow_solution_{in,out}` label styles (reference
+    base_module.py:83-95): per-node gen/kill bitvectors plus the exact
+    solver's IN/OUT fixpoint, all [n, B] float32 with a corpus-wide B.
+    Either all four are present or none.
+    """
 
     graph_id: int
     node_feats: np.ndarray  # [n, NUM_SUBKEY_FEATS] int32 vocab indices
@@ -40,6 +47,10 @@ class GraphSpec:
     edge_src: np.ndarray  # [e] int32 (CFG edges, no self loops)
     edge_dst: np.ndarray  # [e] int32
     label: float  # graph-level label (max over node_vuln in reference)
+    node_gen: np.ndarray | None = None  # [n, B] float32
+    node_kill: np.ndarray | None = None  # [n, B]
+    node_bits_in: np.ndarray | None = None  # [n, B] solver IN fixpoint
+    node_bits_out: np.ndarray | None = None  # [n, B] solver OUT fixpoint
 
     @property
     def num_nodes(self) -> int:
@@ -70,6 +81,12 @@ class GraphBatch:
     graph_mask: jax.Array  # [G] bool
     graph_ids: jax.Array  # [G] int32 original example ids (-1 padding)
     num_graphs: int = dataclasses.field(metadata=dict(static=True))
+    # optional bit-label block ([N, B] each, or all None) for the
+    # dataflow_solution_{in,out} label styles
+    node_gen: jax.Array | None = None
+    node_kill: jax.Array | None = None
+    node_bits_in: jax.Array | None = None
+    node_bits_out: jax.Array | None = None
 
     @property
     def node_budget(self) -> int:
@@ -84,17 +101,42 @@ class BudgetExceeded(ValueError):
     pass
 
 
+_BIT_FIELDS = ("node_gen", "node_kill", "node_bits_in", "node_bits_out")
+
+
+def bit_width(graphs: Sequence[GraphSpec]) -> int | None:
+    """Corpus-wide bit-label width B, or None when graphs carry no bits.
+
+    Raises ValueError on mixed presence or inconsistent widths — a batch
+    must be homogeneous for static shapes.
+    """
+    widths = set()
+    for g in graphs:
+        present = [getattr(g, f) is not None for f in _BIT_FIELDS]
+        if any(present) != all(present):
+            raise ValueError(f"graph {g.graph_id}: partial bit-label block")
+        widths.add(g.node_gen.shape[1] if g.node_gen is not None else None)
+    if not widths or widths == {None}:
+        return None
+    if None in widths or len(widths) > 1:
+        raise ValueError(f"inconsistent bit-label widths: {widths}")
+    return widths.pop()
+
+
 def pack(
     graphs: Sequence[GraphSpec],
     num_graphs: int,
     node_budget: int,
     edge_budget: int,
     add_self_loops: bool = True,
+    bits: int | None = None,
 ) -> GraphBatch:
     """Pack host graphs into one padded batch (numpy arrays).
 
     Raises BudgetExceeded when the graphs do not fit; callers either bucket
-    by size or drop oversized examples before packing.
+    by size or drop oversized examples before packing. `bits` forces the
+    bit-label width (so empty shards match sibling shards); by default it
+    is inferred from the graphs.
     """
     if len(graphs) > num_graphs:
         raise BudgetExceeded(f"{len(graphs)} graphs > budget {num_graphs}")
@@ -105,6 +147,17 @@ def pack(
     if e_tot > edge_budget:
         raise BudgetExceeded(f"{e_tot} edges > budget {edge_budget}")
 
+    if bits is None:
+        bits = bit_width(graphs)
+    elif graphs and bit_width(graphs) not in (None, bits):
+        raise ValueError(
+            f"bits={bits} does not match graphs' width {bit_width(graphs)}"
+        )
+    bit_arrays = (
+        {f: np.zeros((node_budget, bits), np.float32) for f in _BIT_FIELDS}
+        if bits is not None
+        else {f: None for f in _BIT_FIELDS}
+    )
     node_feats = np.zeros((node_budget, NUM_SUBKEY_FEATS), np.int32)
     node_vuln = np.zeros((node_budget,), np.int32)
     node_graph = np.full((node_budget,), num_graphs, np.int32)
@@ -124,6 +177,9 @@ def pack(
         node_vuln[n_off : n_off + n] = g.node_vuln
         node_graph[n_off : n_off + n] = gi
         node_mask[n_off : n_off + n] = True
+        if bits is not None and g.node_gen is not None:
+            for f in _BIT_FIELDS:
+                bit_arrays[f][n_off : n_off + n] = getattr(g, f)
         # graph edges + self loops, sorted by destination: graphs occupy
         # increasing node ranges, so per-graph sorting makes the whole
         # batch dst-sorted and segment reductions can use the
@@ -160,6 +216,7 @@ def pack(
         graph_mask=graph_mask,
         graph_ids=graph_ids,
         num_graphs=num_graphs,
+        **bit_arrays,
     )
 
 
@@ -170,8 +227,11 @@ def _stack_shards(
     edge_budget: int,
     add_self_loops: bool = True,
 ) -> GraphBatch:
+    # bit width decided over ALL shards so empty shards still produce
+    # matching zero arrays (a pytree-structure mismatch would break stack)
+    bits = bit_width([g for sg in per_shard for g in sg])
     shards = [
-        pack(sg, num_graphs, node_budget, edge_budget, add_self_loops)
+        pack(sg, num_graphs, node_budget, edge_budget, add_self_loops, bits)
         for sg in per_shard
     ]
     stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)

@@ -15,7 +15,12 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
-from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS, GraphSpec
+from deepdfa_tpu.graphs.batch import (
+    _BIT_FIELDS,
+    NUM_SUBKEY_FEATS,
+    GraphSpec,
+    bit_width,
+)
 
 _VERSION = 1
 
@@ -23,9 +28,17 @@ _VERSION = 1
 def save_shard(path: str | Path, graphs: Sequence[GraphSpec]) -> None:
     node_counts = np.array([g.num_nodes for g in graphs], np.int64)
     edge_counts = np.array([g.num_edges for g in graphs], np.int64)
+    bits = bit_width(graphs)
+    bit_arrays = {}
+    if bits is not None:
+        for f in _BIT_FIELDS:
+            bit_arrays[f] = np.concatenate(
+                [getattr(g, f) for g in graphs]
+            ).astype(np.float32)
     np.savez_compressed(
         path,
         version=np.int64(_VERSION),
+        **bit_arrays,
         graph_ids=np.array([g.graph_id for g in graphs], np.int64),
         labels=np.array([g.label for g in graphs], np.float32),
         node_offsets=np.concatenate([[0], np.cumsum(node_counts)]),
@@ -58,8 +71,17 @@ def load_shard(path: str | Path) -> list[GraphSpec]:
         if int(z["version"]) != _VERSION:
             raise ValueError(f"unsupported shard version {z['version']} at {path}")
         no, eo = z["node_offsets"], z["edge_offsets"]
+        has_bits = _BIT_FIELDS[0] in z
         out = []
         for i in range(len(z["graph_ids"])):
+            bit_kw = (
+                {
+                    f: z[f][no[i] : no[i + 1]].astype(np.float32)
+                    for f in _BIT_FIELDS
+                }
+                if has_bits
+                else {}
+            )
             out.append(
                 GraphSpec(
                     graph_id=int(z["graph_ids"][i]),
@@ -68,6 +90,7 @@ def load_shard(path: str | Path) -> list[GraphSpec]:
                     edge_src=z["edge_src"][eo[i] : eo[i + 1]].astype(np.int32),
                     edge_dst=z["edge_dst"][eo[i] : eo[i + 1]].astype(np.int32),
                     label=float(z["labels"][i]),
+                    **bit_kw,
                 )
             )
         return out

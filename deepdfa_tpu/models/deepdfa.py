@@ -37,7 +37,10 @@ class DeepDFA(nn.Module):
     n_steps: int = 5
     num_output_layers: int = 3
     concat_all_absdf: bool = True
-    label_style: str = "graph"  # graph | node
+    # graph | node | dataflow_solution_in | dataflow_solution_out
+    # (the dataflow styles supervise per-node reaching-definitions
+    # bitvectors, reference base_module.py:83-95)
+    label_style: str = "graph"
     encoder_mode: bool = False
     param_dtype: jnp.dtype = jnp.float32
 
@@ -82,6 +85,45 @@ class DeepDFA(nn.Module):
         )(batch, feat_embed)
 
         out = jnp.concatenate([ggnn_out, feat_embed], axis=-1)
+
+        if self.label_style.startswith("dataflow_solution"):
+            # bitvector supervision: the head sees the GGNN features plus
+            # the gen/kill problem inputs and a differentiable n_steps
+            # reaching-definitions propagation (nn/bitprop.py) with a
+            # learned kill gate — the network only has to learn residual
+            # corrections to an almost-exact prior
+            from deepdfa_tpu.nn.bitprop import BitvectorPropagation
+
+            if batch.node_gen is None:
+                raise ValueError(
+                    f"label_style={self.label_style} needs bit labels; "
+                    "extract the corpus with max_defs set"
+                )
+            bp_in, bp_out = BitvectorPropagation(
+                n_steps=self.n_steps,
+                union_type="relu",
+                learned_gate=True,
+                name="bitprop",
+            )(
+                batch.node_gen,
+                batch.node_kill,
+                batch.edge_src,
+                batch.edge_dst,
+                batch.edge_mask,
+                node_feats=feat_embed,
+            )
+            out = jnp.concatenate(
+                [out, batch.node_gen, batch.node_kill, bp_in, bp_out],
+                axis=-1,
+            )
+            if self.encoder_mode:
+                return out
+            return OutputHead(
+                num_layers=self.num_output_layers,
+                out_features=batch.node_gen.shape[-1],
+                param_dtype=self.param_dtype,
+                name="head",
+            )(out)
 
         if self.label_style == "graph":
             out = GlobalAttentionPooling(

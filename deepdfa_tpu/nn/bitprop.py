@@ -33,18 +33,22 @@ from deepdfa_tpu.frontend.reaching import ReachingDefinitions
 from deepdfa_tpu.nn.setops import relu_union, segment_union, simple_union
 
 
-def rd_bit_problem(cpg: Cpg, max_defs: int):
+def rd_bit_problem(cpg: Cpg, max_defs: int, clip: bool = False):
     """Host-side: CFG arrays + gen/kill bit matrices + exact IN/OUT labels.
 
-    Returns None when the graph has no definitions or more than max_defs.
-    Dense node order follows cfg_nodes(); bit d corresponds to the d-th
-    definition site in node order.
+    Returns None when the graph has no definitions, or (unless `clip`) more
+    than max_defs of them; with clip=True only the first max_defs
+    definition sites (in node order) carry bits — corpus-label semantics,
+    where every graph must produce fixed-width arrays. Dense node order
+    follows cfg_nodes(); bit d corresponds to the d-th definition site in
+    node order; the returned dict includes that node order under "nodes".
     """
     rd = ReachingDefinitions(cpg)
     nodes, dense, src, dst = rd.dense_cfg()
     sites = [n for n in nodes if rd.gen_set[n]]
-    if not sites or len(sites) > max_defs:
+    if not sites or (len(sites) > max_defs and not clip):
         return None
+    sites = sites[:max_defs]
     site_idx = {n: i for i, n in enumerate(sites)}
 
     n_nodes = len(nodes)
@@ -58,8 +62,9 @@ def rd_bit_problem(cpg: Cpg, max_defs: int):
         if not rd.gen_set[n]:
             continue
         (d,) = rd.gen_set[n]
-        gen[dense[n], site_idx[n]] = 1.0
-        for s in sites:
+        if n in site_idx:  # clipped sites own no bit...
+            gen[dense[n], site_idx[n]] = 1.0
+        for s in sites:  # ...but still kill tracked sites of their var
             if var_of_site[s] == d.var and s != n:
                 kill[dense[n], site_idx[s]] = 1.0
 
@@ -67,7 +72,8 @@ def rd_bit_problem(cpg: Cpg, max_defs: int):
     labels_in = np.zeros((n_nodes, max_defs), np.float32)
     for n, defs in in_sets.items():
         for d in defs:
-            labels_in[dense[n], site_idx[d.node]] = 1.0
+            if d.node in site_idx:
+                labels_in[dense[n], site_idx[d.node]] = 1.0
     # OUT derives from IN in one pass (no second fixpoint solve)
     labels_out = np.zeros((n_nodes, max_defs), np.float32)
     for n in nodes:
@@ -75,7 +81,8 @@ def rd_bit_problem(cpg: Cpg, max_defs: int):
             in_sets[n] - rd.kill(n, in_sets[n])
         )
         for d in out_defs:
-            labels_out[dense[n], site_idx[d.node]] = 1.0
+            if d.node in site_idx:
+                labels_out[dense[n], site_idx[d.node]] = 1.0
     return {
         "gen": gen,
         "kill": kill,
@@ -84,6 +91,7 @@ def rd_bit_problem(cpg: Cpg, max_defs: int):
         "labels_in": labels_in,
         "labels_out": labels_out,
         "n_nodes": n_nodes,
+        "nodes": nodes,
     }
 
 

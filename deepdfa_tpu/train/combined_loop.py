@@ -56,7 +56,7 @@ def _squeeze_batch(batch: TextBatch) -> TextBatch:
 
     g = batch.graphs
     garr = {
-        f.name: getattr(g, f.name)[0]
+        f.name: (v[0] if (v := getattr(g, f.name)) is not None else None)
         for f in dataclasses.fields(g)
         if f.name != "num_graphs"
     }

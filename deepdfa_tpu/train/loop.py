@@ -39,7 +39,13 @@ from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.graphs.batch import GraphBatch
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.checkpoint import CheckpointManager
-from deepdfa_tpu.train.losses import bce_elements, classifier_loss, graph_labels, node_labels
+from deepdfa_tpu.train.losses import (
+    bce_elements,
+    classifier_loss,
+    dataflow_labels,
+    graph_labels,
+    node_labels,
+)
 from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
 from deepdfa_tpu.train.state import TrainState, make_optimizer
 
@@ -51,7 +57,7 @@ _ALL_AXES = ("dp", "tp", "sp")
 def _squeeze_batch(batch: GraphBatch) -> GraphBatch:
     """Drop the unit leading (shard) axis inside shard_map."""
     arrays = {
-        f.name: getattr(batch, f.name)[0]
+        f.name: (v[0] if (v := getattr(batch, f.name)) is not None else None)
         for f in dataclasses.fields(batch)
         if f.name != "num_graphs"
     }
@@ -77,6 +83,10 @@ class GraphTrainer:
         self.pos_weight = float(pos_weight)
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         self.label_style = getattr(model, "label_style", "graph")
+        if self.label_style not in (
+            "graph", "node", "dataflow_solution_in", "dataflow_solution_out"
+        ):
+            raise ValueError(f"unsupported label_style: {self.label_style}")
         self._build_steps()
 
     # -- construction -------------------------------------------------------
@@ -99,6 +109,8 @@ class GraphTrainer:
     def _labels_mask(self, batch: GraphBatch):
         if self.label_style == "graph":
             return graph_labels(batch), batch.graph_mask
+        if self.label_style.startswith("dataflow_solution"):
+            return dataflow_labels(batch, self.label_style)
         return node_labels(batch), batch.node_mask
 
     def _local_loss_sum(self, params, batch: GraphBatch):

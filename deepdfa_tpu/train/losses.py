@@ -35,6 +35,25 @@ def node_labels(batch: GraphBatch) -> jax.Array:
     return batch.node_vuln.astype(jnp.float32)
 
 
+def dataflow_labels(batch: GraphBatch, style: str) -> tuple[jax.Array, jax.Array]:
+    """(labels, mask), both [N, B]: the exact reaching-definitions IN/OUT
+    fixpoint bits (reference base_module.py:83-95 dataflow_solution_*);
+    the node mask broadcasts over the bit axis."""
+    if style == "dataflow_solution_in":
+        bits = batch.node_bits_in
+    elif style == "dataflow_solution_out":
+        bits = batch.node_bits_out
+    else:
+        raise ValueError(f"unsupported dataflow label_style: {style}")
+    if bits is None:
+        raise ValueError(
+            f"label_style={style} requires bit labels on the batch "
+            "(extract with max_defs set)"
+        )
+    mask = jnp.broadcast_to(batch.node_mask[:, None], bits.shape)
+    return bits, mask
+
+
 def bce_elements(
     logits: jax.Array,
     labels: jax.Array,
@@ -74,6 +93,8 @@ def classifier_loss(
     elif label_style == "node":
         labels = node_labels(batch)
         mask = batch.node_mask
+    elif label_style in ("dataflow_solution_in", "dataflow_solution_out"):
+        labels, mask = dataflow_labels(batch, label_style)
     else:
         raise ValueError(f"unsupported label_style: {label_style}")
     return bce_with_logits(logits, labels, mask, pos_weight), labels, mask

@@ -1,0 +1,128 @@
+"""End-to-end `dataflow_solution_{in,out}` label styles.
+
+The reference's experimental supervision mode (DDFA/code_gnn/models/
+base_module.py:83-95): instead of vulnerability labels, the GGNN is
+supervised to predict the exact reaching-definitions solution as per-node
+bitvectors. Here the labels come from the worklist solver
+(frontend/reaching.py) via nn/bitprop.rd_bit_problem, flow through
+extraction -> GraphStore -> packing -> GraphTrainer with static [N, B]
+shapes, and the model mixes differentiable bitvector propagation
+(nn/bitprop.py) into its features.
+"""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.graphs import (
+    GraphStore,
+    pack,
+    pack_shards,
+    shard_bucket_batches,
+)
+from deepdfa_tpu.models import DeepDFA
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train import GraphTrainer
+
+MAX_DEFS = 16
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    synth = generate(48, vuln_rate=0.25, seed=11)
+    specs, vocabs = build_dataset(
+        to_examples(synth),
+        train_ids=range(48),
+        limit_all=64,
+        limit_subkeys=64,
+        max_defs=MAX_DEFS,
+    )
+    return specs
+
+
+def test_specs_carry_bit_labels(corpus):
+    assert corpus
+    for s in corpus:
+        assert s.node_gen is not None and s.node_gen.shape == (
+            s.num_nodes, MAX_DEFS,
+        )
+        assert s.node_kill.shape == (s.num_nodes, MAX_DEFS)
+        assert s.node_bits_in.shape == (s.num_nodes, MAX_DEFS)
+        assert s.node_bits_out.shape == (s.num_nodes, MAX_DEFS)
+        # OUT ⊇ gen, and bits are 0/1
+        assert set(np.unique(s.node_bits_out)) <= {0.0, 1.0}
+        assert np.all(s.node_bits_out >= s.node_gen)
+
+
+def test_store_roundtrips_bits(tmp_path, corpus):
+    store = GraphStore(tmp_path / "g")
+    store.write(corpus)
+    back = store.load_all()
+    for s in corpus:
+        r = back[s.graph_id]
+        np.testing.assert_array_equal(r.node_gen, s.node_gen)
+        np.testing.assert_array_equal(r.node_kill, s.node_kill)
+        np.testing.assert_array_equal(r.node_bits_in, s.node_bits_in)
+        np.testing.assert_array_equal(r.node_bits_out, s.node_bits_out)
+
+
+def test_pack_carries_bits(corpus):
+    b = pack(corpus[:4], num_graphs=4, node_budget=256, edge_budget=1024)
+    assert b.node_gen.shape == (256, MAX_DEFS)
+    n0 = corpus[0].num_nodes
+    np.testing.assert_array_equal(
+        np.asarray(b.node_bits_in)[:n0], corpus[0].node_bits_in
+    )
+    # padding rows are zero
+    total = sum(g.num_nodes for g in corpus[:4])
+    assert np.asarray(b.node_gen)[total:].sum() == 0
+
+
+def test_pack_rejects_mixed_bit_presence(corpus):
+    import dataclasses
+
+    from deepdfa_tpu.graphs.batch import GraphSpec  # noqa: F401
+
+    stripped = dataclasses.replace(
+        corpus[0], node_gen=None, node_kill=None, node_bits_in=None,
+        node_bits_out=None,
+    )
+    with pytest.raises(ValueError):
+        pack(
+            [stripped, corpus[1]], num_graphs=2, node_budget=256,
+            edge_budget=1024,
+        )
+
+
+@pytest.mark.parametrize("style", ["dataflow_solution_in", "dataflow_solution_out"])
+def test_dataflow_style_trains_and_beats_random(corpus, style):
+    """VERDICT round-1 item 4: the style must train end to end to finite
+    loss and beat chance. Bit labels are highly structured (OUT ⊇ gen), so
+    the bar is masked-bit accuracy well above the all-zeros/chance rate
+    AND improvement over the untrained model."""
+    cfg = config_mod.apply_overrides(
+        Config(),
+        [
+            "model.hidden_dim=8",
+            f"model.label_style={style}",
+            "train.max_epochs=8",
+            "train.optim.learning_rate=0.01",
+        ],
+    )
+    mesh = make_mesh(MeshConfig(dp=8))
+    model = DeepDFA.from_config(cfg.model, input_dim=66)
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    batches = list(
+        shard_bucket_batches(
+            corpus, num_shards=8, num_graphs=8, node_budget=256,
+            edge_budget=1024, oversized="raise",
+        )
+    )
+    state = trainer.init_state(batches[0])
+    m0, _ = trainer.evaluate(state, batches)
+    state = trainer.fit(state, lambda epoch: batches)
+    m1, _ = trainer.evaluate(state, batches)
+    assert np.isfinite(m1["loss"]), m1
+    assert m1["loss"] < m0["loss"] * 0.7, (m0["loss"], m1["loss"])
+    assert m1["f1"] > 0.5, m1  # all-zeros predictor scores f1 = 0
