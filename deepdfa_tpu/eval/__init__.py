@@ -1,3 +1,4 @@
+from deepdfa_tpu.eval.codebleu import get_codebleu
 from deepdfa_tpu.eval.coverage import CoverageStats, coverage, coverage_report
 from deepdfa_tpu.eval.profiling import (
     ProfileWriter,
@@ -16,6 +17,7 @@ from deepdfa_tpu.eval.statements import (
 )
 
 __all__ = [
+    "get_codebleu",
     "CoverageStats",
     "coverage",
     "coverage_report",

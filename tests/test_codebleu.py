@@ -1,0 +1,158 @@
+"""CodeBLEU evaluator (eval/codebleu.py).
+
+Golden values for the BLEU core come from the published doctest examples
+the reference ships inside its NLTK-derived bleu.py (corpus_bleu ==
+0.5920..., brevity-penalty edge cases) — an independent oracle for this
+from-the-formula implementation.
+"""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.eval.codebleu import (
+    KEYWORDS,
+    corpus_bleu,
+    corpus_dataflow_match,
+    corpus_syntax_match,
+    get_codebleu,
+    weighted_corpus_bleu,
+)
+
+HYP1 = (
+    "It is a guide to action which ensures that the military always obeys "
+    "the commands of the party"
+).split()
+REF1A = (
+    "It is a guide to action that ensures that the military will forever "
+    "heed Party commands"
+).split()
+REF1B = (
+    "It is the guiding principle which guarantees the military forces "
+    "always being under the command of the Party"
+).split()
+REF1C = (
+    "It is the practical guide for the army always to heed the directions "
+    "of the party"
+).split()
+HYP2 = "he read the book because he was interested in world history".split()
+REF2A = "he was interested in world history because he read the book".split()
+
+
+def test_corpus_bleu_reference_doctest_value():
+    score = corpus_bleu([[REF1A, REF1B, REF1C], [REF2A]], [HYP1, HYP2])
+    assert abs(score - 0.5920) < 5e-4, score
+
+
+def test_sentence_bleu_average_doctest_value():
+    s1 = corpus_bleu([[REF1A, REF1B, REF1C]], [HYP1])
+    s2 = corpus_bleu([[REF2A]], [HYP2])
+    assert abs((s1 + s2) / 2 - 0.6223) < 5e-4, (s1, s2)
+
+
+def test_perfect_match_scores_one():
+    assert corpus_bleu([[HYP1]], [HYP1]) == pytest.approx(1.0)
+    w = weighted_corpus_bleu([[HYP1]], [HYP1], KEYWORDS["c"])
+    assert w == pytest.approx(1.0)
+
+
+def test_weighted_favors_keyword_agreement():
+    """Two candidates with one wrong token each: getting the KEYWORD wrong
+    must cost more than getting an identifier wrong."""
+    # wrong tokens sit at mirror positions (1 and 3 of 5) so the
+    # unweighted n>=2 orders break identically; only the weighted unigram
+    # order distinguishes the candidates
+    ref = ["a if b x c".split()]
+    good_kw = "a if b z c".split()  # identifier wrong
+    bad_kw = "a while b x c".split()  # keyword wrong
+    w_good = weighted_corpus_bleu([ref], [good_kw], KEYWORDS["c"])
+    w_bad = weighted_corpus_bleu([ref], [bad_kw], KEYWORDS["c"])
+    assert w_good > w_bad
+
+
+CODE_REF = """int f(int a, int b) {
+  int s = a + b;
+  if (s > 10) {
+    s = s - 1;
+  }
+  return s;
+}"""
+
+CODE_RENAMED = """int f(int p, int q) {
+  int t = p + q;
+  if (t > 10) {
+    t = t - 1;
+  }
+  return t;
+}"""
+
+CODE_DIFFERENT = """int f(int a, int b) {
+  int s = 0;
+  while (s < b) {
+    s = s + a;
+  }
+  return s;
+}"""
+
+
+def test_syntax_match_identical_is_one():
+    assert corpus_syntax_match([[CODE_REF]], [CODE_REF]) == pytest.approx(1.0)
+
+
+def test_syntax_match_renamed_is_one_different_is_less():
+    """tree-sitter sexps carry node types only, so alpha-renaming preserves
+    the syntax score while a structurally different body lowers it."""
+    renamed = corpus_syntax_match([[CODE_REF]], [CODE_RENAMED])
+    different = corpus_syntax_match([[CODE_REF]], [CODE_DIFFERENT])
+    assert renamed == pytest.approx(1.0)
+    assert different < 1.0
+
+
+def test_dataflow_match_invariant_to_renaming():
+    assert corpus_dataflow_match(
+        [[CODE_REF]], [CODE_RENAMED]
+    ) == pytest.approx(1.0)
+    assert corpus_dataflow_match(
+        [[CODE_REF]], [CODE_DIFFERENT]
+    ) < 1.0
+
+
+def test_dataflow_degenerates_to_zero_with_warning(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        score = corpus_dataflow_match([["not c at all ]]]"]], ["x"])
+    assert score == 0.0
+    assert "degenerates" in caplog.text
+
+
+def test_statement_snippets_parse_via_wrapper():
+    ref = "int x = a + 1;\nreturn x;"
+    assert corpus_syntax_match([[ref]], [ref]) == pytest.approx(1.0)
+
+
+def test_get_codebleu_composite():
+    out = get_codebleu(
+        [CODE_REF, CODE_REF], [CODE_RENAMED, CODE_DIFFERENT], lang="c"
+    )
+    assert set(out) == {
+        "ngram_match", "weighted_ngram_match", "syntax_match",
+        "dataflow_match", "codebleu",
+    }
+    expected = 0.25 * sum(
+        out[k]
+        for k in (
+            "ngram_match", "weighted_ngram_match", "syntax_match",
+            "dataflow_match",
+        )
+    )
+    assert out["codebleu"] == pytest.approx(expected)
+    assert 0.0 < out["codebleu"] <= 1.0
+    # the renamed candidate scores strictly better than the different one
+    solo_renamed = get_codebleu([CODE_REF], [CODE_RENAMED], lang="c")
+    solo_diff = get_codebleu([CODE_REF], [CODE_DIFFERENT], lang="c")
+    assert solo_renamed["codebleu"] > solo_diff["codebleu"]
+
+
+def test_unsupported_language_raises():
+    with pytest.raises(ValueError):
+        corpus_syntax_match([["x"]], ["x"], lang="java")
