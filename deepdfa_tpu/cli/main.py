@@ -572,6 +572,139 @@ def cmd_train_combined(args) -> None:
     print("best:", ckpts.best_metrics())
 
 
+def cmd_train_gen(args) -> None:
+    """Seq2seq generation training (reference: CodeT5/run_gen.py main()).
+
+    Reads task files in the reference formats (data/gen_data.py), trains
+    the T5 seq2seq stack with dp sharding, evaluates dev ppl (+BLEU/EM
+    with --do-eval-bleu), keeps best-ppl / best-bleu checkpoints, and with
+    --do-test writes test_best-ppl.output / .gold prediction files
+    (run_gen.py:eval_bleu_epoch file layout)."""
+    import numpy as np
+
+    from deepdfa_tpu.data import gen_data
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models import t5_gen as genm
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train.gen_loop import GenTrainer
+
+    cfg = _load_config(args)
+    run_dir = paths.runs_dir(cfg.run_name)
+    reader = gen_data.READERS[args.task]
+
+    if args.tokenizer == "bpe":
+        tok = BpeTokenizer(args.vocab_file, args.merges_file)
+    else:
+        tok = HashTokenizer(vocab_size=args.vocab_size, t5_frame=True)
+
+    enc_kw = dict(
+        vocab_size=getattr(tok, "vocab_size", args.vocab_size),
+        pad_token_id=tok.pad_id,
+        eos_token_id=tok.sep_id,
+    )
+    if args.tiny:
+        enc_cfg = t5m.T5Config.tiny(**enc_kw)
+    else:
+        enc_cfg = t5m.T5Config(**enc_kw)
+    gcfg = genm.GenConfig(
+        encoder=enc_cfg,
+        max_target_length=args.max_target_length,
+        beam_size=args.beam_size,
+    )
+
+    def load(filename):
+        ex = reader(filename, args.data_num)
+        # task prefix, reference convert_examples_to_features
+        # (_utils.py:24-29): "<task>: <source>" for the t5 family
+        src = tok.batch_encode(
+            [f"{args.task}: {e.source}" for e in ex],
+            max_length=args.max_source_length,
+        )
+        tgt = tok.batch_encode(
+            [e.target for e in ex], max_length=args.max_target_length
+        )
+        return ex, src.astype(np.int32), tgt.astype(np.int32)
+
+    mesh = make_mesh(cfg.train.mesh)
+    dp = mesh.shape.get("dp", 1)
+    rows = max(1, args.batch_size // dp)
+    trainer = GenTrainer(cfg, gcfg, mesh=mesh)
+    state = trainer.init_state()
+    if args.pretrained:
+        import torch
+
+        sd = torch.load(args.pretrained, map_location="cpu")
+        state = trainer.load_params(
+            state, genm.gen_params_from_hf_torch(gcfg, sd)
+        )
+
+    if args.train_file:
+        _, train_src, train_tgt = load(args.train_file)
+        dev = load(args.dev_file) if args.dev_file else None
+
+        def train_batches(epoch):
+            return gen_data.batches_of(
+                train_src, train_tgt, dp, rows, pad_id=tok.pad_id,
+                shuffle_seed=cfg.train.seed + epoch,
+            )
+
+        val_batches = None
+        val_decode = None
+        if dev is not None:
+            dev_batches = gen_data.batches_of(
+                dev[1], dev[2], dp, rows, pad_id=tok.pad_id
+            )
+            val_batches = lambda: dev_batches  # noqa: E731
+            if args.do_eval_bleu:
+                refs = genm.trim_at_eos(dev[2], tok.sep_id, tok.pad_id)
+                val_decode = (dev[1], refs)
+        ckpts = trainer.make_checkpoints(run_dir / "checkpoints-gen")
+        bleu_ckpts = (
+            trainer.make_checkpoints(
+                run_dir / "checkpoints-gen-bleu",
+                monitor="val_bleu_em", mode="max",
+            )
+            if args.do_eval_bleu
+            else None
+        )
+        state = trainer.fit(
+            state,
+            train_batches,
+            val_batches=val_batches,
+            val_decode=val_decode,
+            checkpoints=ckpts,
+            bleu_checkpoints=bleu_ckpts,
+            patience=args.patience,
+        )
+        print("best:", ckpts.best_metrics())
+
+    if args.test_file:
+        ex, test_src, test_tgt = load(args.test_file)
+        # decode from the saved best-ppl params, not the (possibly
+        # early-stopped, degraded) final state — run_gen.py reloads
+        # checkpoint-best-ppl before test decoding
+        best_dir = run_dir / "checkpoints-gen" / "best"
+        if best_dir.exists():
+            import jax as _jax
+
+            mgr = trainer.make_checkpoints(run_dir / "checkpoints-gen")
+            params = mgr.restore("best", _jax.device_get(state.params))
+            state = trainer.load_params(state, params)
+        refs = genm.trim_at_eos(test_tgt, tok.sep_id, tok.pad_id)
+        scores = trainer.eval_bleu_em(state, test_src, refs, return_preds=True)
+        preds = scores.pop("preds")
+        res_dir = run_dir / "results"
+        res_dir.mkdir(parents=True, exist_ok=True)
+        with (res_dir / "test_best-ppl.output").open("w") as f_out, (
+            res_dir / "test_best-ppl.gold"
+        ).open("w") as f_gold:
+            for e, p, r in zip(ex, preds, refs):
+                f_out.write(f"{e.idx}\t{' '.join(map(str, p))}\n")
+                f_gold.write(f"{e.idx}\t{' '.join(map(str, r))}\n")
+        print(json.dumps({"test_em": scores["em"], "test_bleu": scores["bleu"]}))
+
+
 def cmd_codebleu(args) -> None:
     """Score a generation hypothesis file against reference files
     (reference CLI: CodeT5/evaluator/CodeBLEU/calc_code_bleu.py:66-81)."""
@@ -786,6 +919,31 @@ def main(argv=None) -> None:
     p = sub.add_parser("coverage")
     _add_common(p)
     p.set_defaults(fn=cmd_coverage)
+
+    p = sub.add_parser("train-gen")
+    p.add_argument("--task", choices=sorted(
+        ("summarize", "translate", "refine", "concode", "defect")
+    ), required=True)
+    p.add_argument("--train-file", default=None)
+    p.add_argument("--dev-file", default=None)
+    p.add_argument("--test-file", default=None)
+    p.add_argument("--data-num", type=int, default=-1)
+    p.add_argument("--max-source-length", type=int, default=256)
+    p.add_argument("--max-target-length", type=int, default=128)
+    p.add_argument("--beam-size", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--patience", type=int, default=2)
+    p.add_argument("--do-eval-bleu", action="store_true")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny T5 config (tests/smoke)")
+    p.add_argument("--tokenizer", choices=("hash", "bpe"), default="hash")
+    p.add_argument("--vocab-size", type=int, default=4096)
+    p.add_argument("--vocab-file", default=None)
+    p.add_argument("--merges-file", default=None)
+    p.add_argument("--pretrained", default=None,
+                   help="HF torch T5ForConditionalGeneration state_dict")
+    _add_common(p)
+    p.set_defaults(fn=cmd_train_gen)
 
     p = sub.add_parser("codebleu")
     p.add_argument("--refs", nargs="+", required=True,

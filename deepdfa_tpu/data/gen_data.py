@@ -1,0 +1,227 @@
+"""Readers + batching for the generation / clone tasks (CodeT5 family).
+
+Format-parity ports of the reference's example readers
+(CodeT5/_utils.py:168-310) so existing task files drop in unchanged:
+
+- summarize: jsonl with code_tokens/docstring_tokens (+optional idx)
+- translate / refine: "src_file,trg_file" paired line files
+- concode: jsonl with nl/code
+- defect-as-generation: jsonl with code/target (target rendered as the
+  strings "true"/"false", _utils.py:convert_examples_to_features)
+- clone: tab-separated url pairs + sibling data.jsonl id->func map
+
+Batches are static-shape [B, S]/[B, T] int arrays with a row mask; the
+shard variant stacks a leading dp axis exactly like data/text.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GenExample:
+    idx: int | str
+    source: str
+    target: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CloneExample:
+    source: str
+    target: str
+    label: int
+    url1: str
+    url2: str
+
+
+def _collapse_ws(s: str) -> str:
+    return " ".join(s.split())
+
+
+def read_summarize_examples(filename: str, data_num: int = -1) -> list[GenExample]:
+    examples = []
+    with open(filename, encoding="utf-8") as f:
+        for idx, line in enumerate(f):
+            js = json.loads(line.strip())
+            code = _collapse_ws(" ".join(js["code_tokens"]).replace("\n", " "))
+            nl = _collapse_ws(" ".join(js["docstring_tokens"]).replace("\n", ""))
+            examples.append(GenExample(idx=js.get("idx", idx), source=code, target=nl))
+            if idx + 1 == data_num:
+                break
+    return examples
+
+
+def _read_paired(filename: str, data_num: int) -> list[GenExample]:
+    src_file, trg_file = filename.split(",")
+    examples = []
+    with open(src_file) as f1, open(trg_file) as f2:
+        for idx, (line1, line2) in enumerate(zip(f1, f2)):
+            examples.append(
+                GenExample(idx=idx, source=line1.strip(), target=line2.strip())
+            )
+            if idx + 1 == data_num:
+                break
+    return examples
+
+
+def read_translate_examples(filename: str, data_num: int = -1) -> list[GenExample]:
+    return _read_paired(filename, data_num)
+
+
+def read_refine_examples(filename: str, data_num: int = -1) -> list[GenExample]:
+    return _read_paired(filename, data_num)
+
+
+def read_concode_examples(filename: str, data_num: int = -1) -> list[GenExample]:
+    examples = []
+    with open(filename) as f:
+        for idx, line in enumerate(f):
+            js = json.loads(line)
+            examples.append(
+                GenExample(idx=idx, source=js["nl"].strip(), target=js["code"].strip())
+            )
+            if idx + 1 == data_num:
+                break
+    return examples
+
+
+def read_defect_gen_examples(filename: str, data_num: int = -1) -> list[GenExample]:
+    """Defect detection as generation: target is 'true'/'false'
+    (_utils.py:260-279 + convert_examples_to_features label rendering)."""
+    examples = []
+    with open(filename, encoding="utf-8") as f:
+        for idx, line in enumerate(f):
+            js = json.loads(line.strip())
+            target = {0: "false", 1: "true"}[int(js["target"])]
+            examples.append(
+                GenExample(
+                    idx=js.get("idx", idx),
+                    source=_collapse_ws(js["code"]),
+                    target=target,
+                )
+            )
+            if idx + 1 == data_num:
+                break
+    return examples
+
+
+def read_clone_examples(filename: str, data_num: int = -1) -> list[CloneExample]:
+    """Tab-separated 'url1\turl2\tlabel' rows; code bodies come from the
+    sibling data.jsonl (reference read_clone_examples, _utils.py:281-310)."""
+    data_jsonl = os.path.join(os.path.dirname(filename), "data.jsonl")
+    url_to_code = {}
+    with open(data_jsonl) as f:
+        for line in f:
+            js = json.loads(line.strip())
+            url_to_code[str(js["idx"])] = _collapse_ws(js["func"])
+
+    data = []
+    with open(filename) as f:
+        for line in f:
+            url1, url2, label = line.strip().split("\t")
+            if url1 not in url_to_code or url2 not in url_to_code:
+                continue
+            data.append(
+                CloneExample(
+                    source=url_to_code[url1],
+                    target=url_to_code[url2],
+                    label=0 if label == "0" else 1,
+                    url1=url1,
+                    url2=url2,
+                )
+            )
+            if len(data) == data_num:
+                break
+    return data
+
+
+READERS = {
+    "summarize": read_summarize_examples,
+    "translate": read_translate_examples,
+    "refine": read_refine_examples,
+    "concode": read_concode_examples,
+    "defect": read_defect_gen_examples,
+}
+
+
+# ---------------------------------------------------------------------------
+# batching
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GenBatch:
+    source_ids: jax.Array  # [B, S] int32 (or [dp, B, S] sharded)
+    target_ids: jax.Array  # [B, T] int32
+    row_mask: jax.Array  # [B] bool
+
+
+def collate_gen(
+    source_ids: np.ndarray,
+    target_ids: np.ndarray,
+    batch_rows: int,
+    pad_id: int = 0,
+) -> GenBatch:
+    n = source_ids.shape[0]
+    if n > batch_rows:
+        raise ValueError(f"{n} rows > batch_rows {batch_rows}")
+    src = np.full((batch_rows, source_ids.shape[1]), pad_id, np.int32)
+    tgt = np.full((batch_rows, target_ids.shape[1]), pad_id, np.int32)
+    src[:n] = source_ids
+    tgt[:n] = target_ids
+    mask = np.zeros((batch_rows,), bool)
+    mask[:n] = True
+    return GenBatch(source_ids=src, target_ids=tgt, row_mask=mask)
+
+
+def collate_gen_shards(
+    source_ids: np.ndarray,
+    target_ids: np.ndarray,
+    num_shards: int,
+    rows_per_shard: int,
+    pad_id: int = 0,
+) -> GenBatch:
+    """Round-robin rows onto a leading dp axis (cf. data/text.py:99)."""
+    n = source_ids.shape[0]
+    if n > num_shards * rows_per_shard:
+        raise ValueError(f"{n} rows > {num_shards} x {rows_per_shard}")
+    shards = []
+    for s in range(num_shards):
+        sel = list(range(s, n, num_shards))[:rows_per_shard]
+        shards.append(
+            collate_gen(source_ids[sel], target_ids[sel], rows_per_shard, pad_id)
+        )
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
+
+
+def batches_of(
+    source_ids: np.ndarray,
+    target_ids: np.ndarray,
+    num_shards: int,
+    rows_per_shard: int,
+    pad_id: int = 0,
+    shuffle_seed: int | None = None,
+) -> list[GenBatch]:
+    """Full epoch as a list of sharded GenBatches (last batch padded)."""
+    n = source_ids.shape[0]
+    order = np.arange(n)
+    if shuffle_seed is not None:
+        np.random.default_rng(shuffle_seed).shuffle(order)
+    per = num_shards * rows_per_shard
+    out = []
+    for i in range(0, n, per):
+        sel = order[i : i + per]
+        out.append(
+            collate_gen_shards(
+                source_ids[sel], target_ids[sel], num_shards, rows_per_shard,
+                pad_id,
+            )
+        )
+    return out

@@ -90,13 +90,27 @@ def _rms_norm(x, scale, eps):
 
 
 def relative_position_buckets(
-    q_pos: jax.Array, k_pos: jax.Array, num_buckets: int, max_distance: int
+    q_pos: jax.Array,
+    k_pos: jax.Array,
+    num_buckets: int,
+    max_distance: int,
+    bidirectional: bool = True,
 ) -> jax.Array:
-    """T5 bidirectional relative-position bucketing ([Tq, Tk] int32)."""
+    """T5 relative-position bucketing ([Tq, Tk] int32).
+
+    bidirectional=True is the encoder scheme (half the buckets for each
+    direction); bidirectional=False is the decoder scheme (all buckets
+    cover the non-positive "attend to the past" offsets).
+    """
     rel = k_pos[None, :] - q_pos[:, None]
-    nb = num_buckets // 2
-    out = jnp.where(rel > 0, nb, 0)
-    n = jnp.abs(rel)
+    if bidirectional:
+        nb = num_buckets // 2
+        out = jnp.where(rel > 0, nb, 0)
+        n = jnp.abs(rel)
+    else:
+        nb = num_buckets
+        out = jnp.zeros_like(rel)
+        n = jnp.maximum(-rel, 0)
     max_exact = nb // 2
     is_small = n < max_exact
     log_ratio = jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)

@@ -1,0 +1,499 @@
+"""T5 decoder stack + seq2seq generation (the CodeT5 run_gen path).
+
+Role parity with the reference's generation models
+(CodeT5/models.py:build_or_load_gen_model — T5ForConditionalGeneration for
+model_type t5/codet5 — and the Seq2Seq/Beam classes, CodeT5/models.py:195-360)
+used by run_gen.py / run_multi_gen.py, re-designed TPU-first:
+
+- The decoder is the same explicit-pytree, scan-over-layers style as the
+  encoder in models/t5.py: RMS norms, bias-free projections, no 1/sqrt(d)
+  attention scaling, unidirectional relative-position bias shared across
+  layers, cross-attention without position bias, LM head tied to the
+  shared embedding with the d_model**-0.5 rescale (HF tie semantics).
+- Teacher forcing shifts targets right with the pad id as the decoder
+  start token (HF T5 _shift_right); the loss masks pad positions (the
+  reference feeds unmasked labels to HF, which also scores pads — we mask
+  them, which only removes the degenerate predict-pad term).
+- Decoding is jit-compiled beam search with a static-shape KV cache under
+  `lax.while_loop` (exits early when every beam is finished — the
+  compiler-friendly analog of HF generate(num_beams, early_stopping)).
+  The reference's Python-loop Beam class (models.py:300-360) keeps
+  dynamic hypothesis lists; on TPU we keep [B, K, T] tensors and freeze
+  finished beams on the pad token instead. Final ranking applies a
+  length penalty (HF GenerationConfig.length_penalty, default 1.0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models.t5 import (
+    T5Config,
+    _rms_norm,
+    encode,
+    init_params,
+    relative_position_buckets,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenConfig:
+    encoder: T5Config
+    num_decoder_layers: int | None = None  # default: same as encoder
+    max_target_length: int = 128
+    beam_size: int = 5
+    length_penalty: float = 1.0
+
+    @property
+    def n_dec_layers(self) -> int:
+        if self.num_decoder_layers is None:
+            return self.encoder.num_layers
+        return self.num_decoder_layers
+
+
+# ---------------------------------------------------------------------------
+# params
+
+
+def init_gen_params(cfg: GenConfig, key: jax.Array) -> dict:
+    """{"encoder": ..., "decoder": ...}; the LM head is the tied shared
+    embedding (params["encoder"]["word"])."""
+    ecfg = cfg.encoder
+    k_enc, k_dec = jax.random.split(key)
+    k = iter(jax.random.split(k_dec, 16))
+    D, H, Dh, F, L = (
+        ecfg.hidden_size, ecfg.num_heads, ecfg.head_dim, ecfg.ffn_size,
+        cfg.n_dec_layers,
+    )
+
+    def norm(key, shape, scale):
+        return (jax.random.normal(key, shape) * scale).astype(jnp.float32)
+
+    return {
+        "encoder": init_params(ecfg, k_enc),
+        "decoder": {
+            "rel_bias": norm(next(k), (ecfg.rel_buckets, H), 0.1),
+            "layers": {
+                "wq": norm(next(k), (L, D, H, Dh), (D * Dh) ** -0.5),
+                "wk": norm(next(k), (L, D, H, Dh), D**-0.5),
+                "wv": norm(next(k), (L, D, H, Dh), D**-0.5),
+                "wo": norm(next(k), (L, H, Dh, D), (H * Dh) ** -0.5),
+                "ln1": jnp.ones((L, D)),
+                "cq": norm(next(k), (L, D, H, Dh), (D * Dh) ** -0.5),
+                "ck": norm(next(k), (L, D, H, Dh), D**-0.5),
+                "cv": norm(next(k), (L, D, H, Dh), D**-0.5),
+                "co": norm(next(k), (L, H, Dh, D), (H * Dh) ** -0.5),
+                "lnc": jnp.ones((L, D)),
+                "wi": norm(next(k), (L, D, F), D**-0.5),
+                "wo_ffn": norm(next(k), (L, F, D), F**-0.5),
+                "ln2": jnp.ones((L, D)),
+            },
+            "final_ln": jnp.ones((D,)),
+        },
+    }
+
+
+def gen_params_from_hf_torch(cfg: GenConfig, state_dict) -> dict:
+    """Convert a HF torch T5ForConditionalGeneration state_dict."""
+    from deepdfa_tpu.models.t5 import params_from_hf_torch
+
+    ecfg = cfg.encoder
+
+    def get(name):
+        return np.asarray(state_dict[name].detach().cpu().numpy())
+
+    D, H, Dh, L = ecfg.hidden_size, ecfg.num_heads, ecfg.head_dim, cfg.n_dec_layers
+
+    def blk(i, name):
+        return get(f"decoder.block.{i}.layer.{name}")
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    enc_sd = {
+        k[len("encoder."):]: v
+        for k, v in state_dict.items()
+        if k.startswith("encoder.")
+    }
+    enc_sd["shared.weight"] = state_dict["shared.weight"]
+    decoder: dict = {
+        "rel_bias": get(
+            "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ),
+        "layers": {
+            "wq": stack(lambda i: blk(i, "0.SelfAttention.q.weight").T.reshape(D, H, Dh)),
+            "wk": stack(lambda i: blk(i, "0.SelfAttention.k.weight").T.reshape(D, H, Dh)),
+            "wv": stack(lambda i: blk(i, "0.SelfAttention.v.weight").T.reshape(D, H, Dh)),
+            "wo": stack(lambda i: blk(i, "0.SelfAttention.o.weight").T.reshape(H, Dh, D)),
+            "ln1": stack(lambda i: blk(i, "0.layer_norm.weight")),
+            "cq": stack(lambda i: blk(i, "1.EncDecAttention.q.weight").T.reshape(D, H, Dh)),
+            "ck": stack(lambda i: blk(i, "1.EncDecAttention.k.weight").T.reshape(D, H, Dh)),
+            "cv": stack(lambda i: blk(i, "1.EncDecAttention.v.weight").T.reshape(D, H, Dh)),
+            "co": stack(lambda i: blk(i, "1.EncDecAttention.o.weight").T.reshape(H, Dh, D)),
+            "lnc": stack(lambda i: blk(i, "1.layer_norm.weight")),
+            "wi": stack(lambda i: blk(i, "2.DenseReluDense.wi.weight").T),
+            "wo_ffn": stack(lambda i: blk(i, "2.DenseReluDense.wo.weight").T),
+            "ln2": stack(lambda i: blk(i, "2.layer_norm.weight")),
+        },
+        "final_ln": get("decoder.final_layer_norm.weight"),
+    }
+    # untied LM head (tie_word_embeddings=False checkpoints): keep the
+    # trained projection instead of silently falling back to the shared
+    # embedding — the tied path also rescales by d_model**-0.5, which is
+    # wrong for untied weights (HF skips the rescale exactly then)
+    if "lm_head.weight" in state_dict:
+        head = get("lm_head.weight")
+        if not np.array_equal(head, get("shared.weight")):
+            decoder["lm_head"] = head
+    return {
+        "encoder": params_from_hf_torch(ecfg, enc_sd),
+        "decoder": jax.tree.map(
+            lambda a: jnp.asarray(a, jnp.float32), decoder
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# teacher-forced decoding (training / ppl)
+
+
+def shift_right(cfg: T5Config, target_ids: jax.Array) -> jax.Array:
+    """HF T5 _shift_right: decoder inputs = [pad] + target[:-1]."""
+    return jnp.concatenate(
+        [
+            jnp.full_like(target_ids[:, :1], cfg.pad_token_id),
+            target_ids[:, :-1],
+        ],
+        axis=1,
+    )
+
+
+def _lm_logits(ecfg: T5Config, params: dict, x: jax.Array, eq: str) -> jax.Array:
+    """Project decoder states to vocab logits: untied lm_head when the
+    checkpoint has one, else the tied embedding with the HF d_model**-0.5
+    rescale (applied only in the tied case, matching HF)."""
+    head = params["decoder"].get("lm_head")
+    if head is None:
+        x = x * (ecfg.hidden_size**-0.5)
+        head = params["encoder"]["word"]
+    return jnp.einsum(eq, x, head.astype(x.dtype))
+
+
+def _attend(q, k, v, mask, bias):
+    """mask [B, Tq, Tk] boolean; bias [H, Tq, Tk] or None."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if bias is not None:
+        s = s + bias[None]
+    s = jnp.where(mask[:, None], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def decode_train(
+    cfg: GenConfig,
+    params: dict,
+    dec_input_ids: jax.Array,
+    dec_mask: jax.Array,
+    enc_hidden: jax.Array,
+    enc_mask: jax.Array,
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """[B, T] decoder inputs -> [B, T, V] LM logits (teacher-forced)."""
+    from deepdfa_tpu.models.transformer import _dropout
+
+    ecfg = cfg.encoder
+    dt = jnp.dtype(ecfg.dtype)
+    word = params["encoder"]["word"]
+    dp = params["decoder"]
+    x = word[dec_input_ids].astype(dt)
+    k_embed = k_layers = k_final = None
+    if dropout_key is not None and ecfg.dropout_rate > 0.0:
+        k_embed, k_layers, k_final = jax.random.split(dropout_key, 3)
+    x = _dropout(x, ecfg.dropout_rate, k_embed)
+
+    T = dec_input_ids.shape[1]
+    pos = jnp.arange(T)
+    buckets = relative_position_buckets(
+        pos, pos, ecfg.rel_buckets, ecfg.rel_max_distance, bidirectional=False
+    )
+    bias = dp["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    self_mask = causal[None] & dec_mask[:, None, :].astype(bool)
+    cross_mask = jnp.broadcast_to(
+        enc_mask[:, None, :].astype(bool), (x.shape[0], T, enc_mask.shape[1])
+    )
+    enc_h = enc_hidden.astype(dt)
+
+    def layer(x, inputs):
+        lp, key = inputs
+        k1 = k2 = k3 = None
+        if key is not None:
+            k1, k2, k3 = jax.random.split(key, 3)
+        h = _rms_norm(x, lp["ln1"], ecfg.layer_norm_eps)
+        q = jnp.einsum("btd,dhk->bhtk", h, lp["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bhtk", h, lp["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bhtk", h, lp["wv"].astype(dt))
+        ctx = _attend(q, k, v, self_mask, bias)
+        out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
+        x = x + _dropout(out, ecfg.dropout_rate, k1)
+
+        h = _rms_norm(x, lp["lnc"], ecfg.layer_norm_eps)
+        q = jnp.einsum("btd,dhk->bhtk", h, lp["cq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bhsk", enc_h, lp["ck"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bhsk", enc_h, lp["cv"].astype(dt))
+        ctx = _attend(q, k, v, cross_mask, None)
+        out = jnp.einsum("bhtk,hkd->btd", ctx, lp["co"].astype(dt))
+        x = x + _dropout(out, ecfg.dropout_rate, k2)
+
+        h = _rms_norm(x, lp["ln2"], ecfg.layer_norm_eps)
+        h = jax.nn.relu(jnp.einsum("btd,df->btf", h, lp["wi"].astype(dt)))
+        h = jnp.einsum("btf,fd->btd", h, lp["wo_ffn"].astype(dt))
+        return x + _dropout(h, ecfg.dropout_rate, k3)
+
+    fn = jax.checkpoint(layer) if ecfg.remat else layer
+    n_layers = dp["layers"]["wq"].shape[0]
+    keys = jax.random.split(k_layers, n_layers) if k_layers is not None else None
+    if keys is None:
+        x, _ = jax.lax.scan(
+            lambda x, lp: (fn(x, (lp, None)), None), x, dp["layers"]
+        )
+    else:
+        x, _ = jax.lax.scan(lambda x, inp: (fn(x, inp), None), x, (dp["layers"], keys))
+    x = _rms_norm(x, dp["final_ln"], ecfg.layer_norm_eps)
+    x = _dropout(x, ecfg.dropout_rate, k_final)
+    return _lm_logits(ecfg, params, x, "btd,vd->btv")
+
+
+def seq2seq_logits(
+    cfg: GenConfig,
+    params: dict,
+    source_ids: jax.Array,
+    target_ids: jax.Array,
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """Full teacher-forced pass: encode source, decode shifted targets."""
+    ecfg = cfg.encoder
+    k_enc = k_dec = None
+    if dropout_key is not None:
+        k_enc, k_dec = jax.random.split(dropout_key)
+    enc_mask = source_ids != ecfg.pad_token_id
+    enc_hidden = encode(ecfg, params["encoder"], source_ids, dropout_key=k_enc)
+    dec_in = shift_right(ecfg, target_ids)
+    dec_mask = jnp.ones_like(dec_in, bool)  # start token attends; pads masked in loss
+    return decode_train(
+        cfg, params, dec_in, dec_mask, enc_hidden, enc_mask, dropout_key=k_dec
+    )
+
+
+def seq2seq_loss(
+    cfg: GenConfig,
+    params: dict,
+    source_ids: jax.Array,
+    target_ids: jax.Array,
+    dropout_key: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(mean CE over non-pad target tokens, token count)."""
+    logits = seq2seq_logits(cfg, params, source_ids, target_ids, dropout_key)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tok_lp = jnp.take_along_axis(logp, target_ids[..., None], axis=-1)[..., 0]
+    mask = (target_ids != cfg.encoder.pad_token_id).astype(jnp.float32)
+    n_tok = jnp.maximum(mask.sum(), 1.0)
+    return -(tok_lp * mask).sum() / n_tok, n_tok
+
+
+# ---------------------------------------------------------------------------
+# incremental decoding with KV cache + beam search
+
+
+def _precompute_cross_kv(cfg: GenConfig, params: dict, enc_hidden: jax.Array):
+    """Cross-attention K/V once per sequence: ([L, B, H, S, Dh], same)."""
+    dt = jnp.dtype(cfg.encoder.dtype)
+    lp = params["decoder"]["layers"]
+    enc_h = enc_hidden.astype(dt)
+    ck = jnp.einsum("bsd,ldhk->lbhsk", enc_h, lp["ck"].astype(dt))
+    cv = jnp.einsum("bsd,ldhk->lbhsk", enc_h, lp["cv"].astype(dt))
+    return ck, cv
+
+
+def _decode_step(
+    cfg: GenConfig,
+    params: dict,
+    tokens: jax.Array,  # [N] current input token per row
+    t: jax.Array,  # scalar: position being written (0-based)
+    cache_k: jax.Array,  # [L, N, H, Tmax, Dh]
+    cache_v: jax.Array,
+    cross_k: jax.Array,  # [L, N, H, S, Dh]
+    cross_v: jax.Array,
+    enc_mask: jax.Array,  # [N, S]
+):
+    """One cached decoder step -> ([N, V] logits, updated caches)."""
+    ecfg = cfg.encoder
+    dt = jnp.dtype(ecfg.dtype)
+    word = params["encoder"]["word"]
+    dp = params["decoder"]
+    Tmax = cache_k.shape[3]
+
+    x = word[tokens].astype(dt)  # [N, D]
+    k_pos = jnp.arange(Tmax)
+    buckets = relative_position_buckets(
+        t[None], k_pos, ecfg.rel_buckets, ecfg.rel_max_distance,
+        bidirectional=False,
+    )  # [1, Tmax]
+    bias = dp["rel_bias"][buckets[0]].astype(dt).T  # [H, Tmax]
+    self_mask = k_pos <= t  # [Tmax]
+    cross_mask = enc_mask.astype(bool)  # [N, S]
+
+    def layer(x, inputs):
+        lp, ck_l, cv_l, k_cache, v_cache = inputs
+        h = _rms_norm(x, lp["ln1"], ecfg.layer_norm_eps)
+        q = jnp.einsum("nd,dhk->nhk", h, lp["wq"].astype(dt))
+        k_new = jnp.einsum("nd,dhk->nhk", h, lp["wk"].astype(dt))
+        v_new = jnp.einsum("nd,dhk->nhk", h, lp["wv"].astype(dt))
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k_new[:, :, None], t, axis=2
+        )
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v_new[:, :, None], t, axis=2
+        )
+        s = jnp.einsum("nhk,nhtk->nht", q, k_cache) + bias[None]
+        s = jnp.where(self_mask[None, None], s, jnp.finfo(s.dtype).min)
+        ctx = jnp.einsum(
+            "nht,nhtk->nhk", jax.nn.softmax(s, axis=-1), v_cache
+        )
+        out = jnp.einsum("nhk,hkd->nd", ctx, lp["wo"].astype(dt))
+        x = x + out
+
+        h = _rms_norm(x, lp["lnc"], ecfg.layer_norm_eps)
+        q = jnp.einsum("nd,dhk->nhk", h, lp["cq"].astype(dt))
+        s = jnp.einsum("nhk,nhsk->nhs", q, ck_l)
+        s = jnp.where(cross_mask[:, None], s, jnp.finfo(s.dtype).min)
+        ctx = jnp.einsum("nhs,nhsk->nhk", jax.nn.softmax(s, axis=-1), cv_l)
+        out = jnp.einsum("nhk,hkd->nd", ctx, lp["co"].astype(dt))
+        x = x + out
+
+        h = _rms_norm(x, lp["ln2"], ecfg.layer_norm_eps)
+        h = jax.nn.relu(jnp.einsum("nd,df->nf", h, lp["wi"].astype(dt)))
+        h = jnp.einsum("nf,fd->nd", h, lp["wo_ffn"].astype(dt))
+        return x + h, (k_cache, v_cache)
+
+    x, (cache_k, cache_v) = jax.lax.scan(
+        layer, x, (dp["layers"], cross_k, cross_v, cache_k, cache_v)
+    )
+    x = _rms_norm(x, dp["final_ln"], ecfg.layer_norm_eps)
+    logits = _lm_logits(ecfg, params, x, "nd,vd->nv")
+    return logits.astype(jnp.float32), cache_k, cache_v
+
+
+def beam_search(
+    cfg: GenConfig,
+    params: dict,
+    source_ids: jax.Array,
+    beam_size: int | None = None,
+    max_length: int | None = None,
+) -> jax.Array:
+    """Beam-search decode: [B, S] source ids -> [B, max_length] token ids.
+
+    Jit-friendly: static shapes throughout; a lax.while_loop exits as soon
+    as every beam of every example has emitted EOS (the analog of HF
+    generate(..., early_stopping=True)). Finished beams continue on the
+    pad token with frozen scores. Final ranking divides each finished
+    beam's log-prob by length**length_penalty.
+    """
+    ecfg = cfg.encoder
+    K = beam_size or cfg.beam_size
+    Tmax = max_length or cfg.max_target_length
+    B, S = source_ids.shape
+    L = cfg.n_dec_layers
+    H, Dh = ecfg.num_heads, ecfg.head_dim
+    pad, eos = ecfg.pad_token_id, ecfg.eos_token_id
+    V = ecfg.vocab_size
+    NEG = jnp.float32(-1e9)
+
+    enc_mask = source_ids != pad
+    enc_hidden = encode(ecfg, params["encoder"], source_ids)
+    # expand to beams: [B, ...] -> [B*K, ...] (beam-major inner axis)
+    enc_hidden_b = jnp.repeat(enc_hidden, K, axis=0)
+    enc_mask_b = jnp.repeat(enc_mask, K, axis=0)
+    cross_k, cross_v = _precompute_cross_kv(cfg, params, enc_hidden_b)
+
+    N = B * K
+    seqs0 = jnp.full((B, K, Tmax), pad, jnp.int32)
+    # only beam 0 is live at step 0 so topk doesn't pick K duplicates
+    scores0 = jnp.tile(
+        jnp.concatenate([jnp.zeros((1,)), jnp.full((K - 1,), NEG)])[None],
+        (B, 1),
+    ).astype(jnp.float32)
+    done0 = jnp.zeros((B, K), bool)
+    tokens0 = jnp.full((N,), pad, jnp.int32)  # decoder start token
+    cache_k0 = jnp.zeros((L, N, H, Tmax, Dh), jnp.dtype(ecfg.dtype))
+    cache_v0 = jnp.zeros_like(cache_k0)
+
+    def cond(state):
+        t, _, _, done, _, _, _ = state
+        return (t < Tmax) & ~done.all()
+
+    def body(state):
+        t, seqs, scores, done, tokens, cache_k, cache_v = state
+        logits, cache_k, cache_v = _decode_step(
+            cfg, params, tokens, t, cache_k, cache_v, cross_k, cross_v,
+            enc_mask_b,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, K, V)
+        pad_only = jnp.full((V,), NEG).at[pad].set(0.0)
+        logp = jnp.where(done[..., None], pad_only[None, None], logp)
+        cand = scores[..., None] + logp
+        flat = cand.reshape(B, K * V)
+        new_scores, flat_idx = jax.lax.top_k(flat, K)
+        origin = flat_idx // V
+        tok = (flat_idx % V).astype(jnp.int32)
+
+        seqs = jnp.take_along_axis(seqs, origin[..., None], axis=1)
+        seqs = jax.lax.dynamic_update_slice_in_dim(
+            seqs, tok[..., None], t, axis=2
+        )
+        done = jnp.take_along_axis(done, origin, axis=1)
+        done = done | (tok == eos)
+        row = (jnp.arange(B)[:, None] * K + origin).reshape(-1)
+        cache_k = cache_k[:, row]
+        cache_v = cache_v[:, row]
+        return t + 1, seqs, new_scores, done, tok.reshape(-1), cache_k, cache_v
+
+    t, seqs, scores, done, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), seqs0, scores0, done0, tokens0, cache_k0, cache_v0),
+    )
+
+    # length-penalized final ranking; unfinished beams rank below finished
+    lengths = (seqs != pad).sum(-1).astype(jnp.float32)
+    norm = jnp.maximum(lengths, 1.0) ** cfg.length_penalty
+    final = scores / norm + jnp.where(done, 0.0, NEG)
+    # if nothing finished (hit Tmax), fall back to raw normalized scores
+    final = jnp.where(done.any(-1, keepdims=True), final, scores / norm)
+    best = jnp.argmax(final, axis=1)
+    return jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0]
+
+
+def greedy_decode(
+    cfg: GenConfig, params: dict, source_ids: jax.Array,
+    max_length: int | None = None,
+) -> jax.Array:
+    """Greedy = beam search with K=1 (shares the cached step path)."""
+    return beam_search(cfg, params, source_ids, beam_size=1, max_length=max_length)
+
+
+def trim_at_eos(ids: np.ndarray, eos_id: int, pad_id: int = 0) -> list[list[int]]:
+    """Host-side: cut each row at its first EOS, drop pads."""
+    out = []
+    for row in np.asarray(ids):
+        toks = []
+        for t in row.tolist():
+            if t == eos_id:
+                break
+            if t != pad_id:
+                toks.append(t)
+        out.append(toks)
+    return out
