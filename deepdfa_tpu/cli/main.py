@@ -27,7 +27,9 @@ from deepdfa_tpu.core import Config, config as config_mod, paths
 
 def _load_config(args) -> Config:
     cfg = config_mod.load(args.config) if args.config else Config()
-    return config_mod.apply_overrides(cfg, args.overrides)
+    cfg = config_mod.apply_overrides(cfg, args.overrides)
+    config_mod.apply_sanitizers(cfg)
+    return cfg
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -43,13 +45,29 @@ def cmd_prepare(args) -> None:
     cfg = _load_config(args)
     ds = cfg.data.dataset
     out_dir = paths.processed_dir(ds)
-    if args.source == "synthetic":
+    fmt = args.format
+    if fmt == "auto":
+        if args.source == "synthetic":
+            fmt = "synthetic"
+        elif args.source.endswith(".json"):
+            fmt = "devign"
+        else:
+            fmt = "bigvul"
+    if fmt == "synthetic":
         synth = synthetic.generate(args.n_examples, seed=cfg.data.seed)
         examples = synthetic.to_examples(synth)
-    elif args.source.endswith(".json"):
+    elif fmt == "devign":
         examples = readers.read_devign(args.source, sample=args.sample)
+    elif fmt == "dbgbench":
+        examples = readers.read_dbgbench(args.source, sample=args.sample)
     else:
         examples = readers.read_bigvul(args.source, sample=args.sample)
+    if args.mutated_jsonl:
+        # mutated subdatasets replace each example's code via id join
+        # (reference datasets.py:104-126); "_flip" variants use `source`
+        examples = readers.read_mutated(
+            args.mutated_jsonl, examples, flip=args.mutated_flip
+        )
     if args.dep_closure:
         # reference statement labeling: changed lines PLUS lines data/
         # control dependent on them (evaluate.py:194-236 dep-add closure)
@@ -705,6 +723,107 @@ def cmd_train_gen(args) -> None:
         print(json.dumps({"test_em": scores["em"], "test_bleu": scores["bleu"]}))
 
 
+def cmd_train_clone(args) -> None:
+    """Pairwise clone-detection training (reference: CodeT5/run_clone.py).
+
+    Reads the reference clone format (pair index file + sibling
+    data.jsonl), encodes each code of the pair, trains CloneTrainer with
+    best-F1 checkpointing, and reports test P/R/F1."""
+    import numpy as np
+
+    from deepdfa_tpu.data import gen_data
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models import t5_gen as genm
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train.clone_loop import CloneTrainer, clone_batches_of
+
+    cfg = _load_config(args)
+    run_dir = paths.runs_dir(cfg.run_name)
+    if args.tokenizer == "bpe":
+        tok = BpeTokenizer(args.vocab_file, args.merges_file)
+    else:
+        tok = HashTokenizer(vocab_size=args.vocab_size, t5_frame=True)
+
+    enc_kw = dict(
+        vocab_size=getattr(tok, "vocab_size", args.vocab_size),
+        pad_token_id=tok.pad_id,
+        eos_token_id=tok.sep_id,
+    )
+    enc_cfg = (
+        t5m.T5Config.tiny(**enc_kw) if args.tiny else t5m.T5Config(**enc_kw)
+    )
+    ccfg = genm.CloneConfig(encoder=enc_cfg)
+
+    def load(filename):
+        ex = gen_data.read_clone_examples(filename, args.data_num)
+        a = tok.batch_encode(
+            [f"clone: {e.source}" for e in ex], max_length=args.max_source_length
+        )
+        b = tok.batch_encode(
+            [f"clone: {e.target}" for e in ex], max_length=args.max_source_length
+        )
+        pairs = np.stack([a, b], axis=1).astype(np.int32)
+        return ex, pairs, np.array([e.label for e in ex], np.int32)
+
+    mesh = make_mesh(cfg.train.mesh)
+    dp = mesh.shape.get("dp", 1)
+    rows = max(1, args.batch_size // dp)
+    trainer = CloneTrainer(cfg, ccfg, mesh=mesh)
+    state = trainer.init_state()
+    if args.pretrained:
+        import torch
+
+        sd = torch.load(args.pretrained, map_location="cpu")
+        state = trainer.load_seq2seq(
+            state,
+            genm.gen_params_from_hf_torch(
+                genm.GenConfig(encoder=enc_cfg), sd
+            ),
+        )
+
+    if args.train_file:
+        _, train_pairs, train_labels = load(args.train_file)
+
+        def train_batches(epoch):
+            return clone_batches_of(
+                train_pairs, train_labels, dp, rows, pad_id=tok.pad_id,
+                shuffle_seed=cfg.train.seed + epoch,
+            )
+
+        val_batches = None
+        if args.dev_file:
+            _, dev_pairs, dev_labels = load(args.dev_file)
+            dev = clone_batches_of(
+                dev_pairs, dev_labels, dp, rows, pad_id=tok.pad_id
+            )
+            val_batches = lambda: dev  # noqa: E731
+        ckpts = trainer.make_checkpoints(run_dir / "checkpoints-clone")
+        state = trainer.fit(
+            state,
+            train_batches,
+            val_batches=val_batches,
+            checkpoints=ckpts,
+            patience=args.patience,
+        )
+        print("best:", ckpts.best_metrics())
+
+    if args.test_file:
+        _, test_pairs, test_labels = load(args.test_file)
+        best_dir = run_dir / "checkpoints-clone" / "best"
+        if best_dir.exists():
+            import jax as _jax
+
+            mgr = trainer.make_checkpoints(run_dir / "checkpoints-clone")
+            params = mgr.restore("best", _jax.device_get(state.params))
+            state = trainer.load_params(state, params)
+        test = clone_batches_of(
+            test_pairs, test_labels, dp, rows, pad_id=tok.pad_id
+        )
+        metrics, _ = trainer.evaluate(state, test)
+        print(json.dumps({f"test_{k}": v for k, v in metrics.items()}))
+
+
 def cmd_codebleu(args) -> None:
     """Score a generation hypothesis file against reference files
     (reference CLI: CodeT5/evaluator/CodeBLEU/calc_code_bleu.py:66-81)."""
@@ -736,16 +855,12 @@ def cmd_localize(args) -> None:
     import numpy as np
 
     from deepdfa_tpu.data.text import collate
-    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
     from deepdfa_tpu.eval.localize import (
         aggregate_line_scores,
-        attention_token_scores,
-        combined_saliency_scores,
+        token_scores,
     )
     from deepdfa_tpu.eval.statements import RankedExample, statement_report
     from deepdfa_tpu.graphs import GraphStore
-    from deepdfa_tpu.models import combined as cmb
-    from deepdfa_tpu.models.transformer import TransformerConfig
     from deepdfa_tpu.parallel import make_mesh
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
@@ -783,17 +898,13 @@ def cmd_localize(args) -> None:
             batch_rows=1,
             node_budget=cfg.data.batch.node_budget,
             edge_budget=cfg.data.batch.edge_budget,
+            pad_id=tok.pad_id,
         )
-        if args.method == "attention":
-            scores = attention_token_scores(
-                mcfg.encoder, params["encoder"], b.input_ids
-            )
-        else:
-            scores = combined_saliency_scores(
-                mcfg, params, b.input_ids,
-                b.graphs if mcfg.use_graph else None,
-                b.has_graph if mcfg.use_graph else None,
-            )
+        scores = token_scores(
+            args.method, args.arch, mcfg, params, b.input_ids,
+            b.graphs if mcfg.use_graph else None,
+            b.has_graph if mcfg.use_graph else None,
+        )
         n_lines = len(e.code.splitlines())
         line_scores = aggregate_line_scores(scores[0], tok_lines, n_lines)
         flagged = np.zeros(n_lines, bool)
@@ -849,6 +960,13 @@ def main(argv=None) -> None:
                    help="expand line labels with data/control dependents")
     p.add_argument("--sample", type=int, default=None)
     p.add_argument("--n-examples", type=int, default=2000)
+    p.add_argument("--format", default="auto",
+                   choices=("auto", "bigvul", "devign", "dbgbench", "synthetic"),
+                   help="source format (auto: by file extension)")
+    p.add_argument("--mutated-jsonl", default=None,
+                   help="mutated-variant jsonl to join onto the base dataset")
+    p.add_argument("--mutated-flip", action="store_true",
+                   help="use the jsonl 'source' field (the *_flip variants)")
     _add_common(p)
     p.set_defaults(fn=cmd_prepare)
 
@@ -901,12 +1019,15 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_test)
 
     p = sub.add_parser("localize")
-    p.add_argument("--arch", default="roberta", choices=["roberta"],
-                   help="t5 localization is not implemented (saliency/"
-                        "attention scoring is roberta-shaped)")
+    p.add_argument("--arch", default="roberta", choices=["roberta", "t5"],
+                   help="combined architecture the checkpoint was trained "
+                        "with (attention method is roberta-only)")
     p.add_argument("--no-graph", action="store_true")
-    p.add_argument("--method", default="saliency",
-                   choices=["saliency", "attention"])
+    p.add_argument(
+        "--method", default="saliency",
+        choices=["attention", "saliency", "input_x_gradient", "lig",
+                 "deeplift", "deeplift_shap", "gradient_shap"],
+    )
     p.add_argument("--checkpoint", default="best")
     p.add_argument("--split", default="test")
     p.add_argument("--encoder", default="tiny")
@@ -944,6 +1065,24 @@ def main(argv=None) -> None:
                    help="HF torch T5ForConditionalGeneration state_dict")
     _add_common(p)
     p.set_defaults(fn=cmd_train_gen)
+
+    p = sub.add_parser("train-clone")
+    p.add_argument("--train-file", default=None)
+    p.add_argument("--dev-file", default=None)
+    p.add_argument("--test-file", default=None)
+    p.add_argument("--data-num", type=int, default=-1)
+    p.add_argument("--max-source-length", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--patience", type=int, default=2)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--tokenizer", choices=("hash", "bpe"), default="hash")
+    p.add_argument("--vocab-size", type=int, default=4096)
+    p.add_argument("--vocab-file", default=None)
+    p.add_argument("--merges-file", default=None)
+    p.add_argument("--pretrained", default=None,
+                   help="HF torch T5ForConditionalGeneration state_dict")
+    _add_common(p)
+    p.set_defaults(fn=cmd_train_clone)
 
     p = sub.add_parser("codebleu")
     p.add_argument("--refs", nargs="+", required=True,

@@ -157,6 +157,11 @@ class TrainConfig:
     seed: int = 1
     pos_weight: float | None = None  # None = derived from train labels
     log_every_steps: int = 50
+    # sanitizer mode (reference runs Lightning detect_anomaly: true,
+    # DDFA/configs/config_default.yaml:40): fail fast on NaN/inf in any
+    # jitted computation + enable jax's internal invariant checks
+    debug_nans: bool = False
+    enable_checks: bool = False
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
@@ -282,3 +287,19 @@ def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
 
 def load(path: str | Path) -> Config:
     return from_dict(json.loads(Path(path).read_text()))
+
+
+def apply_sanitizers(cfg: Config) -> None:
+    """Enable jax's NaN/invariant sanitizers per train config.
+
+    The TPU-native analog of the reference's autograd anomaly mode
+    (Lightning `detect_anomaly: true`, DDFA/configs/config_default.yaml:40):
+    `train.debug_nans=true` makes any NaN/inf produced under jit raise
+    immediately with the offending primitive; `train.enable_checks=true`
+    turns on jax's internal invariant checking."""
+    import jax
+
+    if cfg.train.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+    if cfg.train.enable_checks:
+        jax.config.update("jax_enable_checks", True)

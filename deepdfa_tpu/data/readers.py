@@ -101,6 +101,52 @@ def read_devign(json_path: str | Path, sample: int | None = None) -> list[Exampl
     ]
 
 
+def read_mutated(
+    jsonl_path: str | Path,
+    base_examples: Sequence[Example],
+    flip: bool = False,
+) -> list[Example]:
+    """Mutated Big-Vul variants (reference datasets.py:104-126 mutated()):
+    jsonl rows {"idx": <base id>, "source": ..., "target": ...} inner-join
+    the base dataset on id; the mutated code replaces `before` (the
+    `target` field, or `source` for the "_flip" subdatasets) while labels
+    and line annotations carry over from the base example."""
+    by_id = {e.id: e for e in base_examples}
+    key = "source" if flip else "target"
+    out: list[Example] = []
+    with open(jsonl_path, encoding="utf-8") as f:
+        for line in f:
+            row = json.loads(line)
+            base = by_id.get(int(row["idx"]))
+            if base is None:
+                continue  # inner join: only examples with mutated code
+            import dataclasses as _dc
+
+            out.append(_dc.replace(base, code=_clean_func(row[key])))
+    return out
+
+
+def read_dbgbench(csv_path: str | Path, sample: int | None = None) -> list[Example]:
+    """DbgBench real-bug eval corpus (reference paper Table 8; unixcoder
+    linevul_main.py:142-145: func column is `code`, label derives from the
+    source filename column `c` — buggy unless it contains "patched")."""
+    df = pd.read_csv(csv_path)
+    if sample:
+        df = df.head(sample)
+    out: list[Example] = []
+    for i, row in enumerate(df.itertuples(index=False)):
+        label = float("patched" not in str(row.c))
+        out.append(
+            Example(
+                id=int(getattr(row, "id", i)),
+                code=_clean_func(row.code),
+                label=label,
+                vuln_lines=frozenset(),
+            )
+        )
+    return out
+
+
 def read_splits_csv(path: str | Path) -> dict[int, str]:
     """splits csv: columns (id/idx, split) with split in train/val/test
     (the reference's linevul_splits.csv / bigvul_rand_splits.csv shape)."""

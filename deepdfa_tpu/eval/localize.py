@@ -2,27 +2,51 @@
 
 The reference's UniXcoder evaluation ranks lines by explanation scores
 computed from the fine-tuned model (LineVul/unixcoder/linevul_main.py:
-955-1398): attention aggregation plus captum gradient methods (Saliency,
-InputXGradient/DeepLift-style). TPU-native equivalents:
+955-1398) with captum: attention, LayerIntegratedGradients ("lig"),
+Saliency, DeepLift, DeepLiftShap, GradientShap. TPU-native equivalents of
+the whole family, as jax.grad over an embedding-injected forward:
 
-- `attention_token_scores`: attention mass received by each token from
-  [CLS], averaged over heads and layers (the linevul attention method);
-- `saliency_token_scores`: |d logit_vuln / d embedding . embedding|
-  per token (gradient x input — the first-order common core of the captum
-  family);
-- `aggregate_line_scores`: token scores -> per-line scores through the
-  tokenizer's token->line map (max aggregation like the reference).
+- `attention`: attention mass received by each token from [CLS],
+  averaged over heads and layers (roberta-family only);
+- `saliency`: |d logit_vuln / d embedding| (captum Saliency);
+- `input_x_gradient`: gradient x embedding (first-order common core);
+- `lig`: integrated gradients along the straight path from a reference
+  embedding (pad everywhere, cls/sep kept — create_ref_input_ids,
+  linevul_main.py:932-945) with an m-step Riemann midpoint sum;
+- `deeplift`: the rescale-rule first-order form grad(x) * (x - baseline)
+  with a zero baseline (the reference's baselines, :1055);
+- `deeplift_shap` / `gradient_shap`: the same attributions averaged over
+  a small set of noisy baselines / noisy path samples (captum's sampling
+  semantics with the reference's zero-baseline choice).
 
-Outputs feed eval/statements.py (top-k, IFA, effort metrics).
+Every gradient method is summarized captum-tutorial style: sum over the
+embedding dim, normalized by the L2 norm of the summed vector.
+
+Both combined architectures are supported: the RoBERTa-family combined
+classifier (models/combined.py) and the CodeT5-style DefectConfig
+(models/t5.py, eos pooling). Outputs feed eval/statements.py (top-k,
+IFA, effort metrics).
 """
 
 from __future__ import annotations
+
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deepdfa_tpu.models import transformer as tfm
+
+GRADIENT_METHODS = (
+    "saliency",
+    "input_x_gradient",
+    "lig",
+    "deeplift",
+    "deeplift_shap",
+    "gradient_shap",
+)
+METHODS = ("attention",) + GRADIENT_METHODS
 
 
 def attention_token_scores(
@@ -48,11 +72,12 @@ def attention_token_scores(
     return np.asarray(acc / n_layers)
 
 
-def combined_saliency_scores(
-    model_cfg, params, input_ids, graph_batch=None, has_graph=None
-) -> np.ndarray:
-    """Gradient-x-input token scores for the combined classifier's
-    vulnerable-class logit."""
+# ---------------------------------------------------------------------------
+# embedding-injected forwards (the jax.grad hook per architecture)
+
+
+def _roberta_forward(model_cfg, params, input_ids, graph_batch, has_graph):
+    """(fn(rows) -> scalar vuln-logit sum, rows [B, T, D])."""
     from deepdfa_tpu.models import combined as cmb
 
     ecfg = model_cfg.encoder
@@ -84,6 +109,144 @@ def combined_saliency_scores(
         logits = cmb.head_logits(model_cfg, params["head"], cls_vec, gvec)
         return logits[:, 1].sum()
 
+    return fn, rows
+
+
+def _t5_forward(model_cfg, params, input_ids, graph_batch, has_graph):
+    """Same contract for the CodeT5-style DefectConfig (eos pooling) —
+    delegates to the training forward via its inputs_embeds hook so the
+    attribution target can never drift from what was trained."""
+    from deepdfa_tpu.models import t5 as t5m
+
+    rows = params["encoder"]["word"][input_ids]
+
+    def fn(rows):
+        logits = t5m.defect_forward(
+            model_cfg, params, input_ids,
+            graph_batch=graph_batch if model_cfg.use_graph else None,
+            has_graph=has_graph,
+            inputs_embeds=rows,
+        )
+        return logits[:, 1].sum()
+
+    return fn, rows
+
+
+def _forward_builder(arch: str) -> Callable:
+    return {"roberta": _roberta_forward, "t5": _t5_forward}[arch]
+
+
+# ---------------------------------------------------------------------------
+# attribution methods
+
+
+def _summarize(attr: jax.Array) -> np.ndarray:
+    """captum-tutorial summarization: sum over the embedding dim, L2
+    normalized per example (summarize_attributions role)."""
+    s = attr.sum(axis=-1)
+    norm = jnp.linalg.norm(s, axis=-1, keepdims=True)
+    return np.asarray(s / jnp.maximum(norm, 1e-12))
+
+
+def _lig_baseline_rows(word, input_ids, pad_id, cls_id, sep_id):
+    """Reference create_ref_input_ids: pad everywhere, cls/sep preserved."""
+    ref_ids = jnp.where(
+        (input_ids == cls_id) | (input_ids == sep_id), input_ids, pad_id
+    )
+    return word[ref_ids]
+
+
+def token_scores(
+    method: str,
+    arch: str,
+    model_cfg,
+    params,
+    input_ids,
+    graph_batch=None,
+    has_graph=None,
+    *,
+    n_steps: int = 20,
+    n_samples: int = 8,
+    seed: int = 0,
+) -> np.ndarray:
+    """[B, T] token attribution scores for the vulnerable-class logit."""
+    if method == "attention":
+        if arch != "roberta":
+            raise ValueError(
+                "the attention method reads RoBERTa-shaped encoder layers; "
+                "use a gradient method for --arch t5"
+            )
+        return attention_token_scores(
+            model_cfg.encoder, params["encoder"], input_ids
+        )
+    if method not in GRADIENT_METHODS:
+        raise ValueError(f"unknown method {method!r} (choose from {METHODS})")
+
+    fn, rows = _forward_builder(arch)(
+        model_cfg, params, input_ids, graph_batch, has_graph
+    )
+    # jit the gradient: the path methods evaluate it n_steps/n_samples
+    # times at identical shapes — compile once, replay the rest
+    grad = jax.jit(jax.grad(fn))
+
+    if method == "saliency":
+        return _summarize(jnp.abs(grad(rows)))
+    if method == "input_x_gradient":
+        return _summarize(grad(rows) * rows)
+
+    ecfg = model_cfg.encoder
+    if arch == "roberta":
+        word = params["encoder"]["embeddings"]["word"]
+        cls_id, sep_id = 0, 2  # RoBERTa frame
+    else:
+        word = params["encoder"]["word"]
+        cls_id, sep_id = ecfg.eos_token_id, ecfg.eos_token_id
+
+    if method == "lig":
+        base = _lig_baseline_rows(
+            word, input_ids, ecfg.pad_token_id, cls_id, sep_id
+        )
+        delta = rows - base
+        # Riemann midpoint sum along the straight path
+        acc = jnp.zeros_like(rows)
+        for k in range(n_steps):
+            alpha = (k + 0.5) / n_steps
+            acc = acc + grad(base + alpha * delta)
+        return _summarize(delta * acc / n_steps)
+
+    if method == "deeplift":
+        # one-step rescale approximation: grad at the input/baseline
+        # midpoint times the delta (zero baseline, reference :1055)
+        base = jnp.zeros_like(rows)
+        return _summarize(grad((rows + base) / 2) * (rows - base))
+
+    key = jax.random.key(seed)
+    if method == "deeplift_shap":
+        # rescale-rule attributions averaged over noisy zero-mean baselines
+        acc = jnp.zeros_like(rows)
+        for k in jax.random.split(key, n_samples):
+            base = 0.01 * jax.random.normal(k, rows.shape, rows.dtype)
+            acc = acc + grad((rows + base) / 2) * (rows - base)
+        return _summarize(acc / n_samples)
+
+    # gradient_shap: expectation of grad at noisy interpolation points
+    acc = jnp.zeros_like(rows)
+    for k in jax.random.split(key, n_samples):
+        k1, k2 = jax.random.split(k)
+        alpha = jax.random.uniform(k1)
+        noisy = rows + 0.01 * jax.random.normal(k2, rows.shape, rows.dtype)
+        acc = acc + grad(alpha * noisy)  # zero baseline
+    return _summarize((acc / n_samples) * rows)
+
+
+def combined_saliency_scores(
+    model_cfg, params, input_ids, graph_batch=None, has_graph=None
+) -> np.ndarray:
+    """Gradient-x-input token scores (kept for backward compatibility;
+    the general entry point is token_scores)."""
+    fn, rows = _roberta_forward(
+        model_cfg, params, input_ids, graph_batch, has_graph
+    )
     grads = jax.grad(fn)(rows)
     return np.asarray(jnp.linalg.norm(grads * rows, axis=-1))
 
@@ -94,10 +257,21 @@ def aggregate_line_scores(
     n_lines: int,
     reduce: str = "max",
 ) -> np.ndarray:
-    """[T] token scores + [T] 1-based line ids (0 = no line) -> [n_lines]."""
-    out = np.zeros((n_lines,), np.float64)
+    """[T] token scores + [T] 1-based line ids (0 = no line) -> [n_lines].
+
+    Attribution scores may be SIGNED (lig/deeplift/...): lines are
+    max- or sum-reduced over their own tokens only (no zero clamp), and
+    lines with no tokens rank strictly below every tokenized line — the
+    reference scores only tokenized lines at all (get_all_lines_score)."""
+    out = np.full((n_lines,), -np.inf)
     for s, ln in zip(np.asarray(token_scores), np.asarray(token_lines)):
         if 1 <= ln <= n_lines:
             i = int(ln) - 1
-            out[i] = max(out[i], float(s)) if reduce == "max" else out[i] + float(s)
+            if reduce == "max":
+                out[i] = max(out[i], float(s))
+            else:
+                out[i] = float(s) if np.isinf(out[i]) else out[i] + float(s)
+    present = np.isfinite(out)
+    floor = (out[present].min() - 1.0) if present.any() else 0.0
+    out[~present] = floor
     return out

@@ -136,26 +136,67 @@ def encode(
     attn_mask: jax.Array | None = None,
     dropout_key: jax.Array | None = None,
     tp_axis: str | None = None,
+    inputs_embeds: jax.Array | None = None,
+    sp_axis: str | None = None,
 ) -> jax.Array:
-    """[B, T] -> [B, T, D] final hidden states (post final-RMSNorm)."""
+    """[B, T] -> [B, T, D] final hidden states (post final-RMSNorm).
+
+    inputs_embeds replaces the word-embedding gather (HF convention) —
+    the hook the gradient-attribution localizers differentiate through.
+
+    sp_axis: sequence parallelism — T is the LOCAL block length, attention
+    runs as ring attention over the mesh axis with per-rotation-step
+    relative-position bias blocks computed from global positions (the
+    "per-shard relative-bias blocks" the roberta path gets for free from
+    absolute positions)."""
     from deepdfa_tpu.models.transformer import _dropout
 
     if attn_mask is None:
         attn_mask = input_ids != cfg.pad_token_id
     dt = jnp.dtype(cfg.dtype)
-    x = params["word"][input_ids].astype(dt)
+    if inputs_embeds is None:
+        x = params["word"][input_ids].astype(dt)
+    else:
+        x = inputs_embeds.astype(dt)
     k_embed = k_layers = k_final = None
     if dropout_key is not None and cfg.dropout_rate > 0.0:
         k_embed, k_layers, k_final = jax.random.split(dropout_key, 3)
     x = _dropout(x, cfg.dropout_rate, k_embed)
 
     T = input_ids.shape[1]
-    pos = jnp.arange(T)
-    buckets = relative_position_buckets(
-        pos, pos, cfg.rel_buckets, cfg.rel_max_distance
-    )
-    # [Tq, Tk, H] -> [H, Tq, Tk]; head axis shards over tp with the layers
-    bias = params["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
+    if sp_axis is None:
+        pos = jnp.arange(T)
+        buckets = relative_position_buckets(
+            pos, pos, cfg.rel_buckets, cfg.rel_max_distance
+        )
+        # [Tq, Tk, H] -> [H, Tq, Tk]; head axis shards over tp with layers
+        bias = params["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
+        bias_fn = None
+    else:
+        from deepdfa_tpu.parallel.ring_attention import ring_attention
+
+        bias = None
+        sp_idx = jax.lax.axis_index(sp_axis)
+        n_sp = jax.lax.psum(1, sp_axis)  # static inside shard_map
+        q_pos = sp_idx * T + jnp.arange(T)
+
+        def _step_bias(step):
+            # the block arriving at rotation `step` originated on shard
+            # (sp_idx - step) mod n_sp; its global k positions follow
+            origin = jnp.mod(sp_idx - step, n_sp)
+            k_pos = origin * T + jnp.arange(T)
+            b = relative_position_buckets(
+                q_pos, k_pos, cfg.rel_buckets, cfg.rel_max_distance
+            )
+            return params["rel_bias"][b].astype(dt).transpose(2, 0, 1)
+
+        # the blocks depend on the rotation step, not the layer: compute
+        # the n_sp of them ONCE ([n_sp, H, T, T]) so the layer scan inside
+        # ring attention only indexes, never re-gathers
+        all_bias = jnp.stack([_step_bias(s) for s in range(n_sp)])
+
+        def bias_fn(step):
+            return all_bias[step]
 
     def layer(x, inputs):
         lp, key = inputs
@@ -167,7 +208,13 @@ def encode(
         q = jnp.einsum("btd,dhk->bhtk", h_in, lp["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bhtk", h_in, lp["wk"].astype(dt))
         v = jnp.einsum("btd,dhk->bhtk", h_in, lp["wv"].astype(dt))
-        ctx = _attention(q, k, v, attn_mask, bias)
+        if sp_axis is not None:
+            ctx = ring_attention(
+                q, k, v, attn_mask, axis_name=sp_axis, scale=1.0,
+                bias_fn=bias_fn,
+            )
+        else:
+            ctx = _attention(q, k, v, attn_mask, bias)
         out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
         if tp_axis is not None:
             out = region_end(out, tp_axis)
@@ -210,6 +257,31 @@ def eos_pool(cfg: T5Config, hidden: jax.Array, input_ids: jax.Array) -> jax.Arra
         T - 1,
     )
     return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)[:, 0, :]
+
+
+def eos_pool_sp(
+    cfg: T5Config, hidden: jax.Array, input_ids: jax.Array, sp_axis: str
+) -> jax.Array:
+    """eos_pool when the sequence is sharded over `sp_axis`: the last eos
+    may live on any shard, so shards agree on its global position via
+    pmax, the owner contributes the vector, and a psum-forward /
+    identity-backward broadcast (region_end, cf. models/combined.py CLS
+    pooling) replicates it without double-counting gradients."""
+    from deepdfa_tpu.parallel.megatron import region_end
+
+    T = input_ids.shape[1]
+    idx = jax.lax.axis_index(sp_axis)
+    n_sp = jax.lax.psum(1, sp_axis)
+    is_eos = input_ids == cfg.eos_token_id
+    local_last = T - 1 - jnp.argmax(is_eos[:, ::-1], axis=1)
+    local_global = jnp.where(is_eos.any(axis=1), idx * T + local_last, -1)
+    global_pos = jax.lax.pmax(local_global, sp_axis)
+    global_pos = jnp.where(global_pos < 0, n_sp * T - 1, global_pos)
+    owner = (global_pos // T) == idx
+    local_off = jnp.clip(global_pos - idx * T, 0, T - 1)
+    vec = jnp.take_along_axis(hidden, local_off[:, None, None], axis=1)[:, 0]
+    vec = jnp.where(owner[:, None], vec, jnp.zeros_like(vec))
+    return region_end(vec, sp_axis)
 
 
 def tp_layer_specs():
@@ -321,14 +393,20 @@ def defect_forward(
     has_graph: jax.Array | None = None,
     dropout_key: jax.Array | None = None,
     tp_axis: str | None = None,
+    sp_axis: str | None = None,
+    inputs_embeds: jax.Array | None = None,
 ) -> jax.Array:
     from deepdfa_tpu.models.combined import make_graph_encoder_for
 
     hidden = encode(
         cfg.encoder, params["encoder"], input_ids,
-        dropout_key=dropout_key, tp_axis=tp_axis,
+        dropout_key=dropout_key, tp_axis=tp_axis, sp_axis=sp_axis,
+        inputs_embeds=inputs_embeds,
     )
-    vec = eos_pool(cfg.encoder, hidden, input_ids)
+    if sp_axis is not None:
+        vec = eos_pool_sp(cfg.encoder, hidden, input_ids, sp_axis)
+    else:
+        vec = eos_pool(cfg.encoder, hidden, input_ids)
     if cfg.use_graph:
         if graph_batch is None:
             raise ValueError("use_graph=True requires a graph_batch")

@@ -201,8 +201,13 @@ def decode_train(
     enc_hidden: jax.Array,
     enc_mask: jax.Array,
     dropout_key: jax.Array | None = None,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """[B, T] decoder inputs -> [B, T, V] LM logits (teacher-forced)."""
+    """[B, T] decoder inputs -> [B, T, V] LM logits (teacher-forced).
+
+    return_hidden=True yields the [B, T, D] post-final-norm decoder states
+    instead (the HF decoder_hidden_states[-1] the CloneModel pools,
+    CodeT5/models.py:72-84)."""
     from deepdfa_tpu.models.transformer import _dropout
 
     ecfg = cfg.encoder
@@ -265,6 +270,8 @@ def decode_train(
         x, _ = jax.lax.scan(lambda x, inp: (fn(x, inp), None), x, (dp["layers"], keys))
     x = _rms_norm(x, dp["final_ln"], ecfg.layer_norm_eps)
     x = _dropout(x, ecfg.dropout_rate, k_final)
+    if return_hidden:
+        return x
     return _lm_logits(ecfg, params, x, "btd,vd->btv")
 
 
@@ -483,6 +490,81 @@ def greedy_decode(
 ) -> jax.Array:
     """Greedy = beam search with K=1 (shares the cached step path)."""
     return beam_search(cfg, params, source_ids, beam_size=1, max_length=max_length)
+
+
+# ---------------------------------------------------------------------------
+# clone detection (CodeT5/models.py:64-123 CloneModel / run_clone.py)
+
+
+@dataclasses.dataclass(frozen=True)
+class CloneConfig:
+    """Pairwise code-clone classifier over the T5 seq2seq stack.
+
+    The reference runs each code of the pair through the full
+    encoder-decoder with labels=source_ids, pools the LAST-eos decoder
+    hidden state (get_t5_vec, models.py:72-84), then classifies the
+    concatenated pair vector with RobertaClassificationHead
+    (Linear(2D->D) -> tanh -> Linear(D->2), models.py:48-62)."""
+
+    encoder: T5Config
+    num_classes: int = 2
+
+
+def init_clone_params(cfg: CloneConfig, key: jax.Array) -> dict:
+    k_s2s, k_dense, k_out = jax.random.split(key, 3)
+    D = cfg.encoder.hidden_size
+    return {
+        "seq2seq": init_gen_params(GenConfig(encoder=cfg.encoder), k_s2s),
+        "head": {
+            "dense_w": jax.random.normal(k_dense, (2 * D, D)) * 0.02,
+            "dense_b": jnp.zeros((D,)),
+            "out_w": jax.random.normal(k_out, (D, cfg.num_classes)) * 0.02,
+            "out_b": jnp.zeros((cfg.num_classes,)),
+        },
+    }
+
+
+def clone_vec(
+    cfg: CloneConfig,
+    params: dict,
+    source_ids: jax.Array,  # [N, T] (each code of each pair is a row)
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """[N, D] last-eos decoder hidden per code (get_t5_vec role)."""
+    from deepdfa_tpu.models.t5 import eos_pool
+
+    ecfg = cfg.encoder
+    gcfg = GenConfig(encoder=ecfg)
+    k_enc = k_dec = None
+    if dropout_key is not None:
+        k_enc, k_dec = jax.random.split(dropout_key)
+    mask = source_ids != ecfg.pad_token_id
+    enc_hidden = encode(
+        ecfg, params["seq2seq"]["encoder"], source_ids, dropout_key=k_enc
+    )
+    dec_in = shift_right(ecfg, source_ids)
+    hidden = decode_train(
+        gcfg, params["seq2seq"], dec_in, mask, enc_hidden, mask,
+        dropout_key=k_dec, return_hidden=True,
+    )
+    return eos_pool(ecfg, hidden, source_ids)
+
+
+def clone_forward(
+    cfg: CloneConfig,
+    params: dict,
+    pair_ids: jax.Array,  # [B, 2, T]
+    dropout_key: jax.Array | None = None,
+) -> jax.Array:
+    """[B, num_classes] logits over code pairs."""
+    B, two, T = pair_ids.shape
+    vec = clone_vec(
+        cfg, params, pair_ids.reshape(B * two, T), dropout_key=dropout_key
+    )
+    x = vec.reshape(B, -1)  # [B, 2D] (models.py:57 reshape)
+    h = params["head"]
+    x = jnp.tanh(x @ h["dense_w"] + h["dense_b"])
+    return x @ h["out_w"] + h["out_b"]
 
 
 def trim_at_eos(ids: np.ndarray, eos_id: int, pad_id: int = 0) -> list[list[int]]:

@@ -19,10 +19,13 @@ import jax
 import jax.numpy as jnp
 
 
-def _block_attn(q, k, v, kv_mask, scale, dropout_rate=0.0, dropout_key=None):
+def _block_attn(
+    q, k, v, kv_mask, scale, dropout_rate=0.0, dropout_key=None, bias=None
+):
     """One block's scores + stable-softmax partials.
 
-    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; kv_mask: [B, Tk] bool.
+    q: [B, H, Tq, D]; k,v: [B, H, Tk, D]; kv_mask: [B, Tk] bool;
+    bias: optional additive [H, Tq, Tk] (T5 relative-position bias).
     Returns (numer [B,H,Tq,D], denom [B,H,Tq], runmax [B,H,Tq]).
 
     Attention-probs dropout (HF attention_probs_dropout_prob) drops terms
@@ -31,6 +34,8 @@ def _block_attn(q, k, v, kv_mask, scale, dropout_rate=0.0, dropout_key=None):
     the normalization — this keeps the streaming form exact.
     """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias[None]
     neg = jnp.finfo(s.dtype).min
     s = jnp.where(kv_mask[:, None, None, :], s, neg)
     m = jnp.max(s, axis=-1)
@@ -53,13 +58,22 @@ def ring_attention(
     axis_name: str = "sp",
     dropout_rate: float = 0.0,
     dropout_key: jax.Array | None = None,
+    scale: float | None = None,
+    bias_fn=None,
 ) -> jax.Array:
     """Exact attention with k/v rotating around the `axis_name` ring.
 
     Shapes (per device, inside shard_map): q,k,v [B, H, T_local, D],
     kv_mask [B, T_local] (False = padding). Returns [B, H, T_local, D].
+
+    scale: score multiplier (default 1/sqrt(D); T5 passes 1.0).
+    bias_fn: optional rotation-step -> [H, T_local, T_local] additive
+    bias for the block whose k/v arrived at that step (T5's relative
+    position bias, computed per block from global positions — the step
+    index is traced, so the callback must be built from jnp ops).
     """
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     n_dev = jax.lax.psum(1, axis_name)
     if dropout_key is not None:
         # independent masks per (device, rotation step)
@@ -72,8 +86,11 @@ def ring_attention(
             None if dropout_key is None else jax.random.fold_in(dropout_key, i)
         )
 
+    def block_bias(i):
+        return None if bias_fn is None else bias_fn(i)
+
     numer, denom, m = _block_attn(
-        q, k, v, kv_mask, scale, dropout_rate, block_key(0)
+        q, k, v, kv_mask, scale, dropout_rate, block_key(0), block_bias(0)
     )
 
     def body(i, carry):
@@ -83,7 +100,7 @@ def ring_attention(
         v = jax.lax.ppermute(v, axis_name, perm)
         kv_mask = jax.lax.ppermute(kv_mask, axis_name, perm)
         bn, bd, bm = _block_attn(
-            q, k, v, kv_mask, scale, dropout_rate, block_key(i)
+            q, k, v, kv_mask, scale, dropout_rate, block_key(i), block_bias(i)
         )
         new_m = jnp.maximum(m, bm)
         alpha = jnp.exp(m - new_m)
@@ -99,11 +116,15 @@ def ring_attention(
     return numer / denom[..., None]
 
 
-def full_attention(q, k, v, kv_mask, dropout_rate: float = 0.0, dropout_key=None):
+def full_attention(
+    q, k, v, kv_mask, dropout_rate: float = 0.0, dropout_key=None,
+    scale: float | None = None, bias=None,
+):
     """Reference single-device attention (for parity tests)."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     numer, denom, _ = _block_attn(
-        q, k, v, kv_mask, scale, dropout_rate, dropout_key
+        q, k, v, kv_mask, scale, dropout_rate, dropout_key, bias
     )
     denom = jnp.maximum(denom, jnp.finfo(denom.dtype).tiny)
     return numer / denom[..., None]

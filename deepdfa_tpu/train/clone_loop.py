@@ -1,0 +1,298 @@
+"""Trainer for pairwise clone detection (the run_clone path).
+
+Role parity with CodeT5/run_clone.py: cross-entropy over 2 classes,
+per-epoch dev F1, best-F1 checkpointing, early stopping on F1 patience
+(run_clone.py mirrors run_defect.py:398-405). dp sharding is the same
+exact-sum shard_map pattern as the other trainers (1-device == N-device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from deepdfa_tpu.core.config import Config
+from deepdfa_tpu.models import t5_gen as gen
+from deepdfa_tpu.parallel.mesh import make_mesh
+from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
+from deepdfa_tpu.train.state import TrainState, make_optimizer
+
+logger = logging.getLogger(__name__)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CloneBatch:
+    pair_ids: jax.Array  # [B, 2, T] int32 (or [dp, B, 2, T] sharded)
+    labels: jax.Array  # [B] int32
+    row_mask: jax.Array  # [B] bool
+
+
+def collate_clone_shards(
+    pair_ids: np.ndarray,
+    labels: Sequence[int],
+    num_shards: int,
+    rows_per_shard: int,
+    pad_id: int = 0,
+) -> CloneBatch:
+    n = pair_ids.shape[0]
+    if n > num_shards * rows_per_shard:
+        raise ValueError(f"{n} rows > {num_shards} x {rows_per_shard}")
+    shards = []
+    for s in range(num_shards):
+        sel = list(range(s, n, num_shards))[:rows_per_shard]
+        ids = np.full(
+            (rows_per_shard,) + pair_ids.shape[1:], pad_id, np.int32
+        )
+        lab = np.zeros((rows_per_shard,), np.int32)
+        mask = np.zeros((rows_per_shard,), bool)
+        ids[: len(sel)] = pair_ids[sel]
+        lab[: len(sel)] = np.asarray(labels)[sel]
+        mask[: len(sel)] = True
+        shards.append(CloneBatch(pair_ids=ids, labels=lab, row_mask=mask))
+    return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
+
+
+def clone_batches_of(
+    pair_ids: np.ndarray,
+    labels: Sequence[int],
+    num_shards: int,
+    rows_per_shard: int,
+    pad_id: int = 0,
+    shuffle_seed: int | None = None,
+) -> list[CloneBatch]:
+    n = pair_ids.shape[0]
+    order = np.arange(n)
+    if shuffle_seed is not None:
+        np.random.default_rng(shuffle_seed).shuffle(order)
+    labels = np.asarray(labels)
+    per = num_shards * rows_per_shard
+    return [
+        collate_clone_shards(
+            pair_ids[order[i : i + per]],
+            labels[order[i : i + per]],
+            num_shards,
+            rows_per_shard,
+            pad_id,
+        )
+        for i in range(0, n, per)
+    ]
+
+
+class CloneTrainer:
+    """dp trainer for CloneConfig pairwise classifiers."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        clone_cfg: gen.CloneConfig,
+        mesh: Mesh | None = None,
+        total_steps: int | None = None,
+    ):
+        self.cfg = cfg
+        self.clone_cfg = clone_cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.train.mesh)
+        self.tx = make_optimizer(cfg.train.optim, total_steps)
+        self._param_sharding = NamedSharding(self.mesh, P())
+        self._build_steps()
+
+    def make_checkpoints(self, directory, monitor="val_f1", mode="max"):
+        from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+        return CheckpointManager(directory, monitor=monitor, mode=mode)
+
+    def init_state(self, seed: int | None = None) -> TrainState:
+        seed = self.cfg.train.seed if seed is None else seed
+        params = gen.init_clone_params(self.clone_cfg, jax.random.key(seed))
+        params = jax.device_put(params, self._param_sharding)
+        return TrainState.create(params, self.tx)
+
+    def load_params(self, state: TrainState, params) -> TrainState:
+        params = jax.device_put(jax.device_get(params), self._param_sharding)
+        return TrainState(
+            params=params, opt_state=self.tx.init(params), step=state.step
+        )
+
+    def load_seq2seq(self, state: TrainState, gen_params) -> TrainState:
+        """Warm-start encoder-decoder from a generation checkpoint (or
+        gen_params_from_hf_torch output)."""
+        params = dict(jax.device_get(state.params))
+        s2s = dict(jax.device_get(gen_params))
+        s2s["decoder"] = dict(s2s["decoder"])
+        # the clone path never uses the LM head
+        s2s["decoder"].pop("lm_head", None)
+        params["seq2seq"] = s2s
+        params = jax.device_put(params, self._param_sharding)
+        return TrainState(
+            params=params, opt_state=self.tx.init(params), step=state.step
+        )
+
+    def _build_steps(self) -> None:
+        mesh = self.mesh
+        ccfg = self.clone_cfg
+        batch_specs = CloneBatch(
+            pair_ids=P(("dp",)), labels=P(("dp",)), row_mask=P(("dp",))
+        )
+        param_specs = jax.tree.map(lambda _: P(), jax.eval_shape(
+            lambda: gen.init_clone_params(ccfg, jax.random.key(0))
+        ))
+
+        def _loss_sum(params, local: CloneBatch, key):
+            logits = gen.clone_forward(
+                ccfg, params, local.pair_ids, dropout_key=key
+            )
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits, local.labels
+            )
+            m = local.row_mask.astype(per.dtype)
+            return (per * m).sum(), (m.sum(), logits)
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(param_specs, batch_specs, P()),
+            out_specs=(P(), param_specs),
+            check_vma=False,
+        )
+        def _sharded_grads(params, batch, key):
+            local = jax.tree.map(lambda x: x[0], batch)
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            count = local.row_mask.sum().astype(jnp.float32)
+            count_g = jnp.maximum(jax.lax.psum(count, "dp"), 1.0)
+
+            def fn(p):
+                return _loss_sum(p, local, key)[0] / count_g
+
+            loss_local, grads = jax.value_and_grad(fn)(params)
+            loss = jax.lax.psum(loss_local, "dp")
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, "dp"), grads)
+            return loss, grads
+
+        @partial(jax.jit, donate_argnums=0)
+        def train_step(state: TrainState, batch: CloneBatch, key):
+            loss, grads = _sharded_grads(state.params, batch, key)
+            updates, opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(
+                    params=params, opt_state=opt_state, step=state.step + 1
+                ),
+                loss,
+            )
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(param_specs, batch_specs),
+            out_specs=(P(("dp",)),) * 4,
+            check_vma=False,
+        )
+        def _sharded_eval(params, batch):
+            local = jax.tree.map(lambda x: x[0], batch)
+            logits = gen.clone_forward(ccfg, params, local.pair_ids)
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits, local.labels
+            )
+            probs = jax.nn.softmax(logits)[:, 1]
+            return probs[None], local.labels[None], local.row_mask[None], per[None]
+
+        @jax.jit
+        def eval_step(params, batch: CloneBatch):
+            return _sharded_eval(params, batch)
+
+        self.train_step = train_step
+        self.eval_step = eval_step
+
+    def evaluate(self, state_or_params, batches: Iterable[CloneBatch]):
+        params = getattr(state_or_params, "params", state_or_params)
+        m = BinaryClassificationMetrics()
+        loss_sum = count = 0.0
+        for batch in batches:
+            probs, labels, mask, per = jax.device_get(
+                self.eval_step(params, batch)
+            )
+            m.update(probs, labels, mask)
+            valid = np.asarray(mask, bool)
+            loss_sum += float(np.asarray(per, np.float64)[valid].sum())
+            count += float(valid.sum())
+        metrics = m.compute()
+        metrics["loss"] = loss_sum / count if count else float("nan")
+        return metrics, m
+
+    def fit(
+        self,
+        state: TrainState,
+        train_batches: Callable[[int], Iterable[CloneBatch]],
+        val_batches: Callable[[], Iterable[CloneBatch]] | None = None,
+        checkpoints=None,
+        max_epochs: int | None = None,
+        patience: int | None = None,
+        log_fn: Callable[[dict], None] | None = None,
+        seed: int = 0,
+    ) -> TrainState:
+        tcfg = self.cfg.train
+        max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
+        root = jax.random.key(seed)
+        step = int(jax.device_get(state.step))
+        best_f1, not_inc = -1.0, 0
+        for epoch in range(max_epochs):
+            t0 = time.perf_counter()
+            losses = []
+            for batch in train_batches(epoch):
+                key = jax.random.fold_in(root, step)
+                state, loss = self.train_step(state, batch, key)
+                losses.append(loss)
+                step += 1
+            record = {
+                "epoch": epoch,
+                "train_loss": float(np.mean(jax.device_get(losses)))
+                if losses
+                else float("nan"),
+                "epoch_seconds": time.perf_counter() - t0,
+            }
+            if val_batches is not None:
+                metrics, _ = self.evaluate(state, val_batches())
+                record.update({f"val_{k}": v for k, v in metrics.items()})
+                f1 = metrics.get("f1", 0.0)
+                if f1 > best_f1:
+                    best_f1, not_inc = f1, 0
+                else:
+                    not_inc += 1
+            if checkpoints is not None and (
+                any(k.startswith("val_") for k in record)
+                or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
+                or epoch == max_epochs - 1
+            ):
+                checkpoints.save(
+                    f"epoch-{epoch:04d}",
+                    jax.device_get(state.params),
+                    {
+                        k: float(v)
+                        for k, v in record.items()
+                        if isinstance(v, (int, float)) and k != "epoch"
+                    },
+                    step=step,
+                )
+            logger.info("epoch %d: %s", epoch, record)
+            if log_fn is not None:
+                log_fn(record)
+            if patience and not_inc > patience:
+                logger.info("early stop: F1 stagnant for %d epochs", not_inc)
+                break
+        return state

@@ -101,11 +101,6 @@ class CombinedTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(cfg.train.mesh)
         self.tp = self.mesh.shape.get("tp", 1) > 1
         self.sp = self.mesh.shape.get("sp", 1) > 1
-        if self.is_t5 and self.sp:
-            raise NotImplementedError(
-                "sequence parallelism is not wired for the T5 encoder "
-                "(relative position bias needs per-shard bias blocks)"
-            )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         if freeze_graph:
             # reference --freeze_graph: the pretrained GGNN stays fixed
@@ -234,6 +229,7 @@ class CombinedTrainer:
                 has_graph=local.has_graph,
                 dropout_key=key,
                 tp_axis=tp_axis,
+                sp_axis="sp" if self.sp else None,
             )
         sp_axis = "sp" if self.sp else None
         offset = (

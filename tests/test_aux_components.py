@@ -133,3 +133,63 @@ def test_bpe_line_map_matches_reference_assets():
     np.testing.assert_array_equal(ids, ids2)
     body = [int(l) for l in lines if l > 0]
     assert min(body) == 1 and max(body) == 3
+
+
+def test_explanation_method_family(rng):
+    """All gradient methods produce finite, non-degenerate token scores on
+    both combined architectures (reference reasoning_method family,
+    unixcoder/linevul_main.py:513-516)."""
+    import jax
+    import pytest as _pytest
+
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.eval.localize import GRADIENT_METHODS, token_scores
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    code = "int f(int a) {\n  int x = a;\n  strcpy(b, c);\n  return x;\n}"
+
+    # roberta-combined (no graph)
+    tok = HashTokenizer(vocab_size=256)
+    ids = tok.encode(code, max_length=24)[None]
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(vocab_size=256, dropout_rate=0.0),
+        graph_hidden_dim=8, graph_input_dim=52, head_dropout=0.0,
+        use_graph=False,
+    )
+    params = cmb.init_params(mcfg, jax.random.key(0))
+    for method in GRADIENT_METHODS:
+        s = token_scores(method, "roberta", mcfg, params, ids, n_steps=4,
+                         n_samples=2)
+        assert s.shape == ids.shape, method
+        assert np.isfinite(s).all(), method
+        assert np.abs(s).max() > 0, method
+
+    # t5-defect (eos pooling), attention must be rejected
+    tok5 = HashTokenizer(vocab_size=256, t5_frame=True)
+    ids5 = tok5.encode(code, max_length=24)[None]
+    dcfg = t5m.DefectConfig(
+        encoder=t5m.T5Config.tiny(dropout_rate=0.0, remat=False),
+        use_graph=False,
+    )
+    dparams = t5m.init_defect_params(dcfg, jax.random.key(1))
+    for method in ("saliency", "lig", "deeplift"):
+        s = token_scores(method, "t5", dcfg, dparams, ids5, n_steps=4)
+        assert s.shape == ids5.shape and np.isfinite(s).all(), method
+    with _pytest.raises(ValueError):
+        token_scores("attention", "t5", dcfg, dparams, ids5)
+
+
+def test_aggregate_line_scores_signed():
+    """Signed attributions must keep their ordering: no zero clamp, and
+    token-less lines rank strictly last."""
+    from deepdfa_tpu.eval.localize import aggregate_line_scores
+
+    scores = np.array([-0.5, -0.1, 0.3, -0.9])
+    lines = np.array([1, 1, 2, 3])
+    out = aggregate_line_scores(scores, lines, n_lines=4)
+    assert out[0] == -0.1  # max of the signed values, not clamped to 0
+    assert out[1] == 0.3
+    assert out[2] == -0.9
+    assert out[3] < out[2]  # no tokens -> below every tokenized line

@@ -1,0 +1,130 @@
+"""Clone-detection path: model parity shape, trainer overfit, readers."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig
+from deepdfa_tpu.core.config import apply_overrides
+from deepdfa_tpu.models import t5 as t5m
+from deepdfa_tpu.models import t5_gen as gen
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train.clone_loop import (
+    CloneTrainer,
+    clone_batches_of,
+)
+
+EOS, PAD = 2, 0
+
+
+def test_clone_vec_matches_hf(rng):
+    """clone_vec == HF decoder_hidden_states[-1] pooled at last eos
+    (reference get_t5_vec, CodeT5/models.py:72-84)."""
+    torch = pytest.importorskip("torch")
+    from transformers import T5Config as HFT5Config, T5ForConditionalGeneration
+
+    hf_cfg = HFT5Config(
+        vocab_size=256, d_model=64, num_layers=2, num_decoder_layers=2,
+        num_heads=4, d_kv=16, d_ff=128, dropout_rate=0.0,
+        feed_forward_proj="relu", decoder_start_token_id=0,
+        eos_token_id=2, pad_token_id=0,
+    )
+    tm = T5ForConditionalGeneration(hf_cfg).eval()
+    ccfg = gen.CloneConfig(
+        encoder=t5m.T5Config.tiny(dropout_rate=0.0, remat=False)
+    )
+    params = gen.init_clone_params(ccfg, __import__("jax").random.key(0))
+    params["seq2seq"] = gen.gen_params_from_hf_torch(
+        gen.GenConfig(encoder=ccfg.encoder), tm.state_dict()
+    )
+
+    ids = rng.integers(3, 256, (2, 10))
+    ids[:, -3:] = 0
+    ids[:, -4] = 2
+    ids = ids.astype(np.int32)
+    mask = torch.tensor((ids != 0).astype(np.int64))
+    with torch.no_grad():
+        out = tm(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=mask,
+            labels=torch.tensor(ids, dtype=torch.long),
+            decoder_attention_mask=mask,
+            output_hidden_states=True,
+        )
+        hidden = out.decoder_hidden_states[-1].numpy()
+    eos_pos = 6  # last eos index per construction
+    want = hidden[:, eos_pos, :]
+    got = np.asarray(gen.clone_vec(ccfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.fixture(scope="module")
+def clone_task():
+    """Pairs are clones iff their (random) token bags are identical."""
+    rng = np.random.default_rng(1)
+    n, T = 32, 8
+    pairs = np.zeros((n, 2, T), np.int32)
+    labels = np.zeros((n,), np.int32)
+    for i in range(n):
+        a = rng.integers(4, 20, T - 2)
+        if i % 2 == 0:
+            b = a.copy()
+            labels[i] = 1
+        else:
+            b = rng.integers(4, 20, T - 2)
+        pairs[i, 0, : T - 2] = a
+        pairs[i, 1, : T - 2] = b
+        pairs[i, :, T - 2] = EOS
+    return pairs, labels
+
+
+def test_clone_trainer_overfits(clone_task):
+    import jax
+
+    pairs, labels = clone_task
+    cfg = apply_overrides(
+        Config(),
+        ["train.optim.name=adamw", "train.optim.learning_rate=0.005",
+         "train.optim.warmup_frac=0.0"],
+    )
+    ccfg = gen.CloneConfig(
+        encoder=t5m.T5Config.tiny(vocab_size=32, remat=False, dropout_rate=0.0)
+    )
+    mesh = make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    trainer = CloneTrainer(cfg, ccfg, mesh=mesh)
+    state = trainer.init_state(seed=0)
+    batches = clone_batches_of(pairs, labels, num_shards=2, rows_per_shard=16)
+    m0, _ = trainer.evaluate(state, batches)
+    for step in range(50):
+        state, loss = trainer.train_step(state, batches[0], jax.random.key(step))
+    m1, _ = trainer.evaluate(state, batches)
+    assert np.isfinite(m1["loss"])
+    assert m1["loss"] < m0["loss"]
+    assert m1["f1"] > 0.9, m1
+
+
+def test_clone_fit_checkpoints(tmp_path, clone_task):
+    import jax
+
+    pairs, labels = clone_task
+    cfg = apply_overrides(Config(), ["train.optim.warmup_frac=0.0"])
+    ccfg = gen.CloneConfig(
+        encoder=t5m.T5Config.tiny(vocab_size=32, remat=False, dropout_rate=0.0)
+    )
+    mesh = make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    trainer = CloneTrainer(cfg, ccfg, mesh=mesh)
+    state = trainer.init_state(seed=0)
+    batches = clone_batches_of(pairs, labels, num_shards=2, rows_per_shard=16)
+    ckpt = trainer.make_checkpoints(tmp_path / "clone")
+    seen = []
+    trainer.fit(
+        state,
+        lambda _e: batches,
+        val_batches=lambda: batches,
+        checkpoints=ckpt,
+        max_epochs=2,
+        patience=5,
+        log_fn=seen.append,
+    )
+    assert len(seen) == 2
+    assert all("val_f1" in r for r in seen)
+    assert ckpt.best_metrics() is not None

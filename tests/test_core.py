@@ -58,3 +58,26 @@ def test_prng_determinism():
     g = prng.host_rng(0, "sampler")
     g2 = prng.host_rng(0, "sampler")
     assert g.integers(0, 1 << 30) == g2.integers(0, 1 << 30)
+
+
+def test_apply_sanitizers_debug_nans():
+    """train.debug_nans=true -> NaN under jit raises (the detect_anomaly
+    analog, config_default.yaml:40)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from deepdfa_tpu.core import Config, config as config_mod
+
+    cfg = config_mod.apply_overrides(Config(), ["train.debug_nans=true"])
+    assert cfg.train.debug_nans is True
+    config_mod.apply_sanitizers(cfg)
+    try:
+        with _pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(-1.0).block_until_ready()
+    finally:
+        jax.config.update("jax_debug_nans", False)
+
+    # off by default: no raise
+    config_mod.apply_sanitizers(Config())
+    assert bool(jnp.isnan(jax.jit(lambda x: jnp.log(x))(-1.0)))

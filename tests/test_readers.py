@@ -99,3 +99,74 @@ def test_partition_disjoint():
     all_ids = [e.id for part in parts.values() for e in part]
     assert sorted(all_ids) == list(range(10))
     assert len(set(all_ids)) == 10
+
+
+def test_read_mutated_join(tmp_path):
+    from deepdfa_tpu.data.pipeline import Example
+
+    base = [
+        Example(id=0, code="int a(void) { return 1; }", label=0.0),
+        Example(id=5, code="int b(void) { return 2; }", label=1.0,
+                vuln_lines=frozenset({1})),
+    ]
+    p = tmp_path / "c_mutated.jsonl"
+    rows = [
+        {"idx": 5, "source": "int b_src(void) { return 9; }",
+         "target": "int b_tgt(void) { return 9; }"},
+        {"idx": 99, "source": "x", "target": "y"},  # not in base -> dropped
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+
+    out = readers.read_mutated(p, base)
+    assert len(out) == 1  # inner join
+    assert out[0].id == 5 and "b_tgt" in out[0].code
+    assert out[0].label == 1.0 and out[0].vuln_lines == frozenset({1})
+
+    flipped = readers.read_mutated(p, base, flip=True)
+    assert "b_src" in flipped[0].code
+
+
+def test_read_dbgbench(tmp_path):
+    df = pd.DataFrame(
+        {
+            "code": ["int f() { return 1; }", "int f() { return 2; }"],
+            "c": ["find-1234-buggy.c", "find-1234-patched.c"],
+        }
+    )
+    p = tmp_path / "dbgbench_data_code.csv"
+    df.to_csv(p, index=False)
+    exs = readers.read_dbgbench(p)
+    assert [e.label for e in exs] == [1.0, 0.0]
+    assert len({e.id for e in exs}) == 2
+
+
+def test_mutated_corpus_end_to_end(tmp_path):
+    """Mutated-variant flow (reference datasets.py:104-126): base corpus ->
+    mutated jsonl join -> features -> eval batches. The cross-dataset
+    contract is that mutated examples keep base ids/labels so reference
+    vocab + splits apply unchanged."""
+    from deepdfa_tpu.data import build_dataset
+    from deepdfa_tpu.data.synthetic import generate, to_examples
+    from deepdfa_tpu.graphs import bucket_batches
+
+    base = to_examples(generate(20, vuln_rate=0.3, seed=3))
+    # mutation: rename a variable everywhere (code changes, labels persist)
+    rows = [
+        {"idx": e.id, "source": e.code,
+         "target": e.code.replace("v0", "mut_v0")}
+        for e in base
+        if e.id % 2 == 0
+    ]
+    p = tmp_path / "c_mutated.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+
+    mutated = readers.read_mutated(p, base)
+    assert len(mutated) == len(rows)
+    specs, vocab = build_dataset(
+        mutated, train_ids=[e.id for e in mutated], limit_all=100,
+        limit_subkeys=100,
+    )
+    assert len(specs) == len(mutated)
+    batches = list(bucket_batches(specs, 8, 1024, 4096, drop_oversized=False))
+    total = sum(int(b.graph_mask.sum()) for b in batches)
+    assert total == len(specs)
