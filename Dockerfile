@@ -1,0 +1,30 @@
+# Container image for deepdfa_tpu — role parity with the reference's
+# Dockerfile (conda env + PYTHONPATH setup for DDFA/LineVul/CodeT5).
+#
+# The TPU runtime ships in the `jax[tpu]` extra; on GKE/GCE TPU VMs the
+# libtpu driver comes from the host image, so the container only needs the
+# Python stack. CPU-only usage (preprocessing fan-out, CI) works with
+# plain `jax`.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        git g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY deepdfa_tpu ./deepdfa_tpu
+COPY configs ./configs
+COPY scripts ./scripts
+
+# TPU hosts: pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir \
+        jax flax optax orbax-checkpoint chex einops numpy pandas pytest \
+    && pip install --no-cache-dir -e . --no-deps
+
+# artifact storage mounts here (DEEPDFA_TPU_STORAGE redirect)
+ENV DEEPDFA_TPU_STORAGE=/storage
+VOLUME /storage
+
+ENTRYPOINT ["python", "-m", "deepdfa_tpu.cli"]
+CMD ["--help"]

@@ -271,6 +271,12 @@ def cmd_train(args) -> None:
     from deepdfa_tpu.train import GraphTrainer, RunLogger, positive_weight
 
     cfg = _load_config(args)
+    # under an NNI experiment, trial parameters override the config and
+    # val metrics stream back (reference main_cli.py:110-120, :184)
+    from deepdfa_tpu.train import nni_bridge
+
+    if nni_bridge.active():
+        cfg = config_mod.apply_overrides(cfg, nni_bridge.nni_overrides())
     split_specs = _load_graph_splits(cfg)
     run_dir = paths.runs_dir(cfg.run_name)
     config_mod.to_json(cfg, run_dir / "config.json")
@@ -296,9 +302,14 @@ def cmd_train(args) -> None:
                 cfg, split_specs["val"], mesh, phase="eval"
             ),
             checkpoints=ckpts,
-            log_fn=run_log.log,
+            log_fn=nni_bridge.intermediate_log_fn(
+                cfg.train.monitor, run_log.log
+            ),
         )
-    print("best:", ckpts.best_metrics())
+    best = ckpts.best_metrics()
+    if best and cfg.train.monitor in best:
+        nni_bridge.report_final(best[cfg.train.monitor])
+    print("best:", best)
 
 
 def cmd_test(args) -> None:
@@ -824,6 +835,40 @@ def cmd_train_clone(args) -> None:
         print(json.dumps({f"test_{k}": v for k, v in metrics.items()}))
 
 
+def cmd_run_exp(args) -> None:
+    """Experiment-matrix runner (reference: CodeT5/sh/run_exp.py).
+
+    Either --matrix <json> (explicit runs) or --tasks/--seeds (built-in
+    per-task defaults); executes each run as a CLI subprocess and writes
+    per-run logs + summary.jsonl under <runs>/experiments/<tag>."""
+    from deepdfa_tpu.train.experiments import (
+        expand_matrix,
+        load_matrix,
+        run_matrix,
+    )
+
+    if args.matrix:
+        runs = load_matrix(args.matrix)
+        if args.override:
+            # apply shared overrides to explicit matrix runs too
+            from deepdfa_tpu.train.experiments import Run
+
+            runs = [
+                Run(r.name, r.cmd, r.args + tuple(args.override)) for r in runs
+            ]
+    elif args.tasks:
+        runs = expand_matrix(
+            args.tasks,
+            seeds=args.seeds,
+            extra_args=args.extra_arg,
+            overrides=args.override,
+        )
+    else:
+        raise SystemExit("pass --matrix or --tasks")
+    out_dir = paths.runs_dir("experiments") / args.tag
+    run_matrix(runs, out_dir, dry_run=args.dry_run)
+
+
 def cmd_codebleu(args) -> None:
     """Score a generation hypothesis file against reference files
     (reference CLI: CodeT5/evaluator/CodeBLEU/calc_code_bleu.py:66-81)."""
@@ -1065,6 +1110,22 @@ def main(argv=None) -> None:
                    help="HF torch T5ForConditionalGeneration state_dict")
     _add_common(p)
     p.set_defaults(fn=cmd_train_gen)
+
+    # no _add_common here: positional overrides would be swallowed by the
+    # nargs='*' flags — per-run config overrides go through --override
+    p = sub.add_parser("run-exp")
+    p.add_argument("--matrix", default=None, help="json run-matrix spec")
+    p.add_argument("--tasks", nargs="*", default=None,
+                   help="built-in task names (deepdfa/combined/summarize/...)")
+    p.add_argument("--seeds", nargs="*", type=int, default=[0])
+    p.add_argument("--extra-arg", action="append", default=[],
+                   help="extra CLI flag passed to every run (repeatable)")
+    p.add_argument("--override", action="append", default=[],
+                   help="dotted key=value config override for every run "
+                        "(repeatable)")
+    p.add_argument("--tag", default="default")
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_run_exp)
 
     p = sub.add_parser("train-clone")
     p.add_argument("--train-file", default=None)

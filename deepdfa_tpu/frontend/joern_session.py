@@ -17,9 +17,11 @@ exactly as in the reference); `available()` reports that.
 
 from __future__ import annotations
 
+import queue
 import shutil
 import subprocess
 import tempfile
+import threading
 from pathlib import Path
 
 _MARKER = "===DEEPDFA_DONE==="
@@ -62,14 +64,26 @@ def available() -> bool:
     return shutil.which("joern") is not None
 
 
+class JoernTimeout(RuntimeError):
+    """The JVM stopped responding within the per-command timeout."""
+
+
 class JoernSession:
-    def __init__(self, worker_id: int = 0, timeout: float = 300.0):
-        if not available():
+    def __init__(
+        self, worker_id: int = 0, timeout: float = 300.0, binary: str = "joern"
+    ):
+        """timeout: per-command bound — a hung JVM raises JoernTimeout
+        instead of blocking the worker forever (the reference's pexpect
+        driver has the same per-expect timeout, joern_session.py:87-102).
+        binary: override for tests (a marker-echoing stub stands in for
+        the real JVM to exercise the protocol)."""
+        if binary == "joern" and not available():
             raise RuntimeError("joern binary not on PATH")
         self.timeout = timeout
         self.workspace = Path(tempfile.mkdtemp(prefix=f"joern-ws-{worker_id}-"))
+        argv = [binary, "--nocolors"] if binary == "joern" else [binary]
         self.proc = subprocess.Popen(
-            ["joern", "--nocolors"],
+            argv,
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -77,23 +91,51 @@ class JoernSession:
             text=True,
             bufsize=1,
         )
+        # reader thread: readline on a pipe cannot be interrupted, so all
+        # reads flow through a queue that run_command polls with a deadline
+        self._lines: queue.Queue[str | None] = queue.Queue()
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
         self._drain_until_ready()
 
     # -- protocol ------------------------------------------------------------
 
+    def _pump(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            self._lines.put(line)
+        self._lines.put(None)  # EOF sentinel
+
     def _drain_until_ready(self) -> None:
         self.run_command("1 + 1")
 
-    def run_command(self, cmd: str) -> str:
-        """Send one command; collect output up to the marker echo."""
-        assert self.proc.stdin is not None and self.proc.stdout is not None
+    def run_command(self, cmd: str, timeout: float | None = None) -> str:
+        """Send one command; collect output up to the marker echo.
+
+        Raises JoernTimeout when the whole exchange exceeds the bound (the
+        session is killed — a wedged JVM is not reusable)."""
+        import time
+
+        assert self.proc.stdin is not None
+        deadline = time.monotonic() + (timeout or self.timeout)
         self.proc.stdin.write(cmd + "\n")
         self.proc.stdin.write(f'println("{_MARKER}")\n')
         self.proc.stdin.flush()
         lines: list[str] = []
         while True:
-            line = self.proc.stdout.readline()
-            if not line:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+                raise JoernTimeout(
+                    f"joern command exceeded {timeout or self.timeout:.0f}s: "
+                    f"{cmd[:120]!r}"
+                )
+            try:
+                line = self._lines.get(timeout=min(remaining, 1.0))
+            except queue.Empty:
+                continue
+            if line is None:
                 raise RuntimeError("joern session terminated unexpectedly")
             if _MARKER in line and "println" not in line:
                 break

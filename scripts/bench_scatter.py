@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Decision benchmark for the GGNN message-passing scatter (SURVEY §2.4).
+
+Measures every implementation strategy for `a[v] = sum_{(u,v)} (W h)[u]`
+at the flagship shape (node_budget 16384, edge_budget 65536, D=128) on
+the current jax platform and prints one JSON line per strategy:
+
+- xla_sorted:   gather + segment_sum(indices_are_sorted=True) — the
+                production path in nn/gnn.py
+- xla_unsorted: same without the sorted hint
+- xla_bf16:     sorted path with bfloat16 messages
+- cumsum:       dst-sorted run-sum via cumsum + boundary differences
+                (the "CSR row-run accumulation" candidate)
+- pallas:       the fused VMEM kernel in nn/pallas_ops.py (TPU only)
+
+Run on the real chip to settle VERDICT item 9:
+    python scripts/bench_scatter.py            # default backend
+    DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_scatter.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def make_inputs(n=16384, e=65536, d=128, avg_deg=2.0, seed=0):
+    """Dst-sorted edges with a realistic CFG degree profile + padding tail."""
+    rng = np.random.default_rng(seed)
+    n_real_edges = int(min(e * 0.9, n * avg_deg))
+    dst = np.sort(rng.integers(0, n - 1, n_real_edges)).astype(np.int32)
+    src = rng.integers(0, n - 1, n_real_edges).astype(np.int32)
+    edge_src = np.full((e,), n - 1, np.int32)
+    edge_dst = np.full((e,), n - 1, np.int32)
+    edge_src[:n_real_edges] = src
+    edge_dst[:n_real_edges] = dst
+    edge_mask = np.zeros((e,), bool)
+    edge_mask[:n_real_edges] = True
+    m = rng.standard_normal((n, d)).astype(np.float32)
+    return m, edge_src, edge_dst, edge_mask
+
+
+def xla_scatter(m, edge_src, edge_dst, edge_mask, *, sorted_hint, dtype=None):
+    import jax
+
+    if dtype is not None:
+        m = m.astype(dtype)
+    w = edge_mask.astype(m.dtype)[:, None]
+    out = jax.ops.segment_sum(
+        m[edge_src] * w,
+        edge_dst,
+        num_segments=m.shape[0],
+        indices_are_sorted=sorted_hint,
+    )
+    return out.astype(np.float32)
+
+
+def cumsum_scatter(m, edge_src, edge_dst, edge_mask, starts, ends):
+    """Run-sum over the dst-sorted edge list: csum boundary differences.
+
+    starts/ends are per-node [N] edge-range boundaries (precomputable per
+    batch on the host, like the dst sort itself)."""
+    import jax.numpy as jnp
+
+    w = edge_mask.astype(m.dtype)[:, None]
+    msg = m[edge_src] * w
+    csum = jnp.concatenate(
+        [jnp.zeros((1, m.shape[1]), m.dtype), jnp.cumsum(msg, axis=0)]
+    )
+    return csum[ends] - csum[starts]
+
+
+def boundaries(edge_dst, n):
+    starts = np.searchsorted(edge_dst, np.arange(n), side="left")
+    ends = np.searchsorted(edge_dst, np.arange(n), side="right")
+    return starts.astype(np.int32), ends.astype(np.int32)
+
+
+def bench(fn, args, reps=20):
+    import jax
+
+    f = jax.jit(fn)
+    out = jax.block_until_ready(f(*args))  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1e3, np.asarray(out)
+
+
+def main():
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    apply_platform_override()
+    import jax
+
+    m, src, dst, mask = make_inputs()
+    n = m.shape[0]
+    starts, ends = boundaries(dst, n)
+    platform = jax.devices()[0].platform
+    want = None
+
+    strategies = {
+        "xla_sorted": (
+            functools.partial(xla_scatter, sorted_hint=True), (m, src, dst, mask)
+        ),
+        "xla_unsorted": (
+            functools.partial(xla_scatter, sorted_hint=False), (m, src, dst, mask)
+        ),
+        "xla_bf16": (
+            functools.partial(
+                xla_scatter, sorted_hint=True, dtype=np.dtype("bfloat16")
+            ),
+            (m, src, dst, mask),
+        ),
+        "cumsum": (cumsum_scatter, (m, src, dst, mask, starts, ends)),
+    }
+    if platform != "cpu":
+        from deepdfa_tpu.nn.pallas_ops import pallas_edge_scatter
+
+        strategies["pallas"] = (pallas_edge_scatter, (m, src, dst, mask))
+
+    results = {}
+    for name, (fn, args) in strategies.items():
+        try:
+            ms, out = bench(fn, args)
+        except Exception as exc:  # noqa: BLE001 - report, don't die
+            print(json.dumps({"strategy": name, "error": str(exc)[:300]}))
+            continue
+        if want is None:
+            want = out
+        # bf16 accumulates in lower precision; everything else must agree
+        tol = 0.05 if "bf16" in name else 1e-3
+        max_err = float(np.abs(out - want).max() / (np.abs(want).max() + 1e-9))
+        if max_err < tol:
+            # only numerically-correct strategies compete for "best"
+            results[name] = ms
+        print(
+            json.dumps(
+                {
+                    "strategy": name,
+                    "ms": round(ms, 3),
+                    "platform": platform,
+                    "rel_err_vs_first": round(max_err, 6),
+                    "ok": max_err < tol,
+                }
+            )
+        )
+    if results:
+        best = min(results, key=results.get)
+        print(json.dumps({"best": best, "ms": round(results[best], 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+#SBATCH --job-name=deepdfa-train
+#SBATCH --cpus-per-task=8
+#SBATCH --mem=32G
+#SBATCH --time=12:00:00
+#SBATCH --output=logs/train_%j.out
+# Single-node training job — the role of the reference's scripts/sbatch.sh
+# wrapper around train.sh. On a TPU pod slice, launch one task per host
+# (e.g. --ntasks-per-node=1 over the slice's hosts); parallel/mesh.py's
+# multi-host init picks up the JAX distributed environment automatically.
+#
+# Usage: sbatch scripts/sbatch_train.sh <cli-subcommand> [args...]
+#   e.g. sbatch scripts/sbatch_train.sh train train.max_epochs=25
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m deepdfa_tpu.cli "$@"

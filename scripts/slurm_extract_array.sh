@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+#SBATCH --job-name=deepdfa-extract
+#SBATCH --array=0-99%10
+#SBATCH --cpus-per-task=4
+#SBATCH --mem=8G
+#SBATCH --time=04:00:00
+#SBATCH --output=logs/extract_%a.out
+# Sharded corpus extraction as a SLURM job array — the role of the
+# reference's run_getgraphs.sh (#SBATCH --array=0-99%10 driving
+# getgraphs.py --job_array_number, DDFA/scripts/run_getgraphs.sh).
+# Each array task owns one shard of the corpus; shards write disjoint
+# tagged artifact files, so no coordination is needed. Run
+#   python -m deepdfa_tpu.cli extract-vocab   (once, before the array)
+# then submit this, then any training job.
+#
+# Usage: sbatch [--array=0-(N-1)] scripts/slurm_extract_array.sh [overrides...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NUM_SHARDS="${NUM_SHARDS:-$((SLURM_ARRAY_TASK_MAX + 1))}"
+export DEEPDFA_TPU_PLATFORM="${DEEPDFA_TPU_PLATFORM:-cpu}"
+
+python -m deepdfa_tpu.cli extract \
+    --workers "${SLURM_CPUS_PER_TASK:-4}" \
+    --shard "${SLURM_ARRAY_TASK_ID}" \
+    --num-shards "${NUM_SHARDS}" \
+    "$@"

@@ -1,0 +1,57 @@
+"""Experiment-matrix runner (run_exp.py role)."""
+
+import json
+
+from deepdfa_tpu.train.experiments import (
+    Run,
+    expand_matrix,
+    load_matrix,
+    parse_result,
+    run_matrix,
+)
+
+
+def test_expand_matrix_tags_and_seeds():
+    runs = expand_matrix(["deepdfa", "clone"], seeds=[0, 1],
+                         overrides=["train.max_epochs=1"])
+    assert len(runs) == 4
+    names = [r.name for r in runs]
+    assert "deepdfa_seed1" in names and "clone_seed0" in names
+    r = runs[0]
+    assert r.cmd == "train"
+    assert "train.seed=0" in r.args
+    assert f"run_name={r.name}" in r.args
+    assert "train.max_epochs=1" in r.args
+
+
+def test_parse_result_variants():
+    assert parse_result('x\n{"f1": 0.5}\n') == {"f1": 0.5}
+    assert parse_result("best: {'val_f1': 0.9}\n") == {"val_f1": 0.9}
+    assert parse_result("no json here") is None
+    # last JSON line wins
+    out = parse_result('{"a": 1}\n{"b": 2}')
+    assert out == {"b": 2}
+
+
+def test_load_and_run_matrix(tmp_path):
+    spec = [{"name": "r1", "cmd": "doesnotmatter", "args": ["--x"]}]
+    p = tmp_path / "matrix.json"
+    p.write_text(json.dumps(spec))
+    runs = load_matrix(p)
+    assert runs == [Run(name="r1", cmd="doesnotmatter", args=("--x",))]
+
+    # dry-run never spawns subprocesses
+    summaries = run_matrix(runs, tmp_path / "out", dry_run=True)
+    assert summaries == [{"name": "r1", "dry_run": True}]
+
+
+def test_run_matrix_executes_and_summarizes(tmp_path, monkeypatch):
+    """A real (tiny) subprocess run: use the cli's own --help-free path by
+    running a trivial matrix against `python -c`-style failure and assert
+    rc + log capture (no training in unit tests)."""
+    runs = [Run(name="bad", cmd="definitely-not-a-command", args=())]
+    summaries = run_matrix(runs, tmp_path / "out")
+    assert summaries[0]["rc"] != 0
+    assert (tmp_path / "out" / "bad.log").exists()
+    lines = (tmp_path / "out" / "summary.jsonl").read_text().splitlines()
+    assert json.loads(lines[0])["name"] == "bad"

@@ -1,0 +1,63 @@
+"""NNI protocol bridge: no-op without runtime, full protocol with a stub."""
+
+import sys
+import types
+
+from deepdfa_tpu.train import nni_bridge
+
+
+def test_inactive_without_platform(monkeypatch):
+    monkeypatch.delenv("NNI_PLATFORM", raising=False)
+    assert not nni_bridge.active()
+    assert nni_bridge.get_next_parameters() == {}
+    # reports are silent no-ops
+    nni_bridge.report_intermediate(0.5)
+    nni_bridge.report_final(0.9)
+
+
+def test_bridge_with_stubbed_nni(monkeypatch):
+    calls = {"intermediate": [], "final": []}
+    stub = types.ModuleType("nni")
+    stub.get_next_parameter = lambda: {
+        "train.optim.learning_rate": 0.01,
+        "model.hidden_dim": 16,
+    }
+    stub.report_intermediate_result = calls["intermediate"].append
+    stub.report_final_result = calls["final"].append
+    monkeypatch.setitem(sys.modules, "nni", stub)
+    monkeypatch.setenv("NNI_PLATFORM", "local")
+
+    assert nni_bridge.active()
+    ov = sorted(nni_bridge.nni_overrides())
+    assert ov == ["model.hidden_dim=16", "train.optim.learning_rate=0.01"]
+
+    # overrides round-trip through the typed config
+    from deepdfa_tpu.core import Config, config as config_mod
+
+    cfg = config_mod.apply_overrides(Config(), ov)
+    assert cfg.model.hidden_dim == 16
+    assert cfg.train.optim.learning_rate == 0.01
+
+    log_fn = nni_bridge.intermediate_log_fn("val_loss")
+    log_fn({"epoch": 0, "val_loss": 0.7})
+    log_fn({"epoch": 1})  # no monitor key -> no report
+    nni_bridge.report_final(0.42)
+    assert calls["intermediate"] == [0.7]
+    assert calls["final"] == [0.42]
+
+
+def test_bool_and_none_params_roundtrip(monkeypatch):
+    import sys
+    import types
+
+    stub = types.ModuleType("nni")
+    stub.get_next_parameter = lambda: {"train.debug_nans": True}
+    monkeypatch.setitem(sys.modules, "nni", stub)
+    monkeypatch.setenv("NNI_PLATFORM", "local")
+    ov = nni_bridge.nni_overrides()
+    assert ov == ["train.debug_nans=true"]
+
+    from deepdfa_tpu.core import Config, config as config_mod
+
+    cfg = config_mod.apply_overrides(Config(), ov)
+    assert cfg.train.debug_nans is True
