@@ -904,7 +904,11 @@ def cmd_localize(args) -> None:
         aggregate_line_scores,
         token_scores,
     )
-    from deepdfa_tpu.eval.statements import RankedExample, statement_report
+    from deepdfa_tpu.eval.statements import (
+        RankedExample,
+        per_example_ifa,
+        statement_report,
+    )
     from deepdfa_tpu.graphs import GraphStore
     from deepdfa_tpu.parallel import make_mesh
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
@@ -964,6 +968,13 @@ def cmd_localize(args) -> None:
     print(json.dumps(report, indent=2))
     (run_dir / f"localize_{args.split}_{args.method}.json").write_text(
         json.dumps(report)
+    )
+    # per-example IFA dump (reference ifa_records/ifa_<method>.txt,
+    # unixcoder/linevul_main.py:700)
+    ifa_dir = run_dir / "ifa_records"
+    ifa_dir.mkdir(parents=True, exist_ok=True)
+    (ifa_dir / f"ifa_{args.method}.txt").write_text(
+        "\n".join(str(v) for v in per_example_ifa(ranked)) + "\n"
     )
 
 

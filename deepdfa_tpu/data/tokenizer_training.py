@@ -38,3 +38,38 @@ def train_bpe(
     )
     tok.save_model(str(out_dir), prefix)
     return out_dir / f"{prefix}-vocab.json", out_dir / f"{prefix}-merges.txt"
+
+
+WORD_LEVEL_SPECIALS = ["[UNK]", "[CLS]", "[SEP]", "[PAD]", "[MASK]"]
+
+
+def train_word_level(
+    corpus: Iterable[str],
+    out_path: str | Path,
+    vocab_size: int = 50000,
+    min_frequency: int = 1,
+) -> Path:
+    """Train a whitespace word-level tokenizer; writes one tokenizer.json.
+
+    Asset parity with the reference's
+    LineVul/linevul/word_level_tokenizer/wordlevel.json (HF `tokenizers`
+    WordLevel model, Whitespace pre-tokenizer, BERT-style special tokens
+    [UNK]/[CLS]/[SEP]/[PAD]/[MASK] at ids 0-4) — used by LineVul's
+    `--use_word_level_tokenizer` path."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.trainers import WordLevelTrainer
+
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    tok = Tokenizer(WordLevel(unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    trainer = WordLevelTrainer(
+        vocab_size=vocab_size,
+        min_frequency=min_frequency,
+        special_tokens=WORD_LEVEL_SPECIALS,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(str(out_path))
+    return out_path

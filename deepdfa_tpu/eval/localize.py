@@ -182,6 +182,10 @@ def token_scores(
     if method not in GRADIENT_METHODS:
         raise ValueError(f"unknown method {method!r} (choose from {METHODS})")
 
+    # checkpoint restores hand back numpy leaves; the jitted grad traces
+    # through fancy indexing on them, which numpy rejects — normalize once
+    params = jax.tree.map(jnp.asarray, params)
+    input_ids = jnp.asarray(input_ids)
     fn, rows = _forward_builder(arch)(
         model_cfg, params, input_ids, graph_batch, has_graph
     )

@@ -42,16 +42,23 @@ def top_k_accuracy(examples: list[RankedExample], k: int = 10) -> float:
     return hits / total if total else 0.0
 
 
-def ifa(examples: list[RankedExample]) -> float:
-    """Mean Initial False Alarm: false positives ranked above the first
-    true positive (per positive example)."""
+def per_example_ifa(examples: list[RankedExample]) -> list[int]:
+    """Per-positive-example Initial False Alarm values (clean lines ranked
+    above the first truly vulnerable one) — the rows of the reference's
+    ifa_records/ifa_<method>.txt dumps."""
     vals = []
     for ex in examples:
         if not ex.flagged.any():
             continue
         order = ex.ranking()
-        first = int(np.argmax(ex.flagged[order]))
-        vals.append(first)
+        vals.append(int(np.argmax(ex.flagged[order])))
+    return vals
+
+
+def ifa(examples: list[RankedExample]) -> float:
+    """Mean Initial False Alarm: false positives ranked above the first
+    true positive (per positive example)."""
+    vals = per_example_ifa(examples)
     return float(np.mean(vals)) if vals else 0.0
 
 

@@ -126,3 +126,18 @@ def test_profiling_cost_and_report(tmp_path):
     agg = aggregate_report(tmp_path / "p.jsonl")
     assert agg["total_examples"] == 64
     assert agg["total_gflops"] > 0
+
+
+def test_per_example_ifa_matches_mean():
+    import numpy as np
+
+    from deepdfa_tpu.eval.statements import RankedExample, ifa, per_example_ifa
+
+    exs = [
+        RankedExample(np.array([3.0, 2.0, 1.0]), np.array([False, True, False])),
+        RankedExample(np.array([1.0, 5.0]), np.array([False, True])),
+        RankedExample(np.array([1.0]), np.array([False])),  # no positives
+    ]
+    vals = per_example_ifa(exs)
+    assert vals == [1, 0]
+    assert ifa(exs) == 0.5

@@ -31,3 +31,29 @@ def test_train_and_load(tmp_path):
     want = hf(sample, max_length=64, padding="max_length", truncation=True)["input_ids"]
     got = tok.encode(sample, 64)
     assert got.tolist() == want
+
+
+def test_train_word_level_matches_reference_layout(tmp_path):
+    """Word-level asset parity: WordLevel model, Whitespace pre-tokenizer,
+    BERT specials at ids 0-4 (LineVul word_level_tokenizer/wordlevel.json)."""
+    import json
+
+    from deepdfa_tpu.data.tokenizer_training import train_word_level
+
+    corpus = ["int main ( ) { return 0 ; }", "void f ( int a ) { a ++ ; }"]
+    path = train_word_level(corpus, tmp_path / "wordlevel.json")
+    d = json.loads(path.read_text())
+    assert d["model"]["type"] == "WordLevel"
+    assert d["pre_tokenizer"]["type"] == "Whitespace"
+    vocab = d["model"]["vocab"]
+    assert [vocab[t] for t in ("[UNK]", "[CLS]", "[SEP]", "[PAD]", "[MASK]")] == [
+        0, 1, 2, 3, 4,
+    ]
+    assert "return" in vocab and "int" in vocab
+
+    # loadable by the HF runtime
+    from tokenizers import Tokenizer
+
+    tok = Tokenizer.from_file(str(path))
+    ids = tok.encode("int main ( )").ids
+    assert all(i > 4 for i in ids)

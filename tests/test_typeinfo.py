@@ -1,0 +1,92 @@
+"""Type registry: typedef chains + struct member leaf expansion
+(get_type.sc:4-52 role)."""
+
+from deepdfa_tpu.frontend.typeinfo import TypeRegistry
+
+SRC = """
+typedef unsigned long size_t;
+typedef size_t my_size;
+
+struct Point { int x; int y; };
+typedef struct Point PointT;
+
+struct Inner { char *name; size_t len; };
+struct Outer {
+    struct Inner first;
+    struct Point p;
+    double weight;
+    struct Outer *next;
+};
+
+typedef struct { int fd; } Handle;
+enum Color { RED, GREEN };
+"""
+
+
+def _reg():
+    return TypeRegistry.from_source(SRC)
+
+
+def test_alias_chain_resolution():
+    reg = _reg()
+    assert reg.resolve_alias("size_t") == "unsigned long"
+    assert reg.resolve_alias("my_size") == "unsigned long"  # two hops
+    assert reg.resolve_alias("PointT") == "Point"
+    assert reg.resolve_alias("unknown_t") == "unknown_t"
+
+
+def test_alias_cycle_is_safe():
+    reg = _reg()
+    reg.aliases["a"] = "b"
+    reg.aliases["b"] = "a"
+    assert reg.resolve_alias("a") in ("a", "b")
+
+
+def test_struct_members_recorded():
+    reg = _reg()
+    assert "Point" in reg.structs
+    assert reg.structs["Point"].member_types == ["int", "int"]
+    assert "Inner" in reg.structs
+    assert "Outer" in reg.structs
+
+
+def test_member_leaf_types_recursive():
+    reg = _reg()
+    leaves = reg.member_leaf_types("Outer")
+    # Inner -> {char, unsigned long}; Point -> {int}; weight -> double;
+    # the recursive Outer* pointer must not loop
+    assert "char" in leaves
+    assert "int" in leaves
+    assert "double" in leaves
+    assert "unsigned long" in leaves
+    assert "Outer" not in leaves
+
+
+def test_external_and_memberless_leaves():
+    reg = _reg()
+    # unknown type = external leaf, returned as-is
+    assert reg.member_leaf_types("FILE") == ["FILE"]
+    # enum = memberless internal leaf
+    assert reg.member_leaf_types("Color") == ["Color"]
+    # anonymous-struct typedef resolves through the generated tag
+    leaves = reg.member_leaf_types("Handle")
+    assert leaves == ["int"]
+
+
+def test_garbage_input_yields_empty_registry():
+    reg = TypeRegistry.from_source("@#$ not C at all {{{")
+    assert reg.aliases == {} and reg.structs == {}
+    assert reg.resolve_alias("x") == "x"
+
+
+def test_function_pointer_typedef_not_poisoning():
+    reg = TypeRegistry.from_source(
+        "typedef int (*cmp)(int a, int b);\n"
+        "typedef void fn(char c);\n"
+        "typedef unsigned int uint;\n"
+    )
+    # function/function-pointer typedefs are skipped, never mis-keyed by a
+    # parameter name
+    assert "b" not in reg.aliases and "c" not in reg.aliases
+    assert "cmp" not in reg.aliases and "fn" not in reg.aliases
+    assert reg.resolve_alias("uint") == "unsigned int"
