@@ -120,23 +120,30 @@ def run_measurement(platform: str) -> dict:
     # warmup / compile
     jax.block_until_ready(forward(params, batches[0]))
 
-    # steady-state: loop the batch stream several times
-    n_graphs_done = 0
-    t0 = time.perf_counter()
-    out = None
+    # steady-state: each rep is one timed pass over the whole batch
+    # stream. The headline is the MEDIAN window — comparable to the
+    # baseline's average-latency figure while robust to the transient
+    # host-side stalls the shared tunnel injects (which a single
+    # all-reps window folds into the denominator); best and mean are
+    # recorded alongside
+    n_per_pass = sum(int(np.asarray(b.graph_mask).sum()) for b in batches)
+    rates = []
     for _ in range(reps):
+        t0 = time.perf_counter()
+        out = None
         for b in batches:
             out = forward(params, b)
-            n_graphs_done += int(np.asarray(b.graph_mask).sum())
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+        jax.block_until_ready(out)
+        rates.append(n_per_pass / (time.perf_counter() - t0))
 
-    value = n_graphs_done / dt
+    value = float(np.median(rates))
     return {
         "metric": "deepdfa_infer_graphs_per_sec",
         "value": round(value, 1),
         "unit": "graphs/s",
         "vs_baseline": round(value / BASELINE_GRAPHS_PER_SEC, 2),
+        "best_graphs_per_sec": round(max(rates), 1),
+        "mean_graphs_per_sec": round(float(np.mean(rates)), 1),
         "platform": jax.devices()[0].platform,
         "dtype": dtype,
         "n_examples": n_examples,

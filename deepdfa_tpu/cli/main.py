@@ -28,8 +28,16 @@ from deepdfa_tpu.core import Config, config as config_mod, paths
 def _load_config(args) -> Config:
     cfg = config_mod.load(args.config) if args.config else Config()
     cfg = config_mod.apply_overrides(cfg, args.overrides)
+    config_mod.validate(cfg)
     config_mod.apply_sanitizers(cfg)
     return cfg
+
+
+def _graphs_dirname(cfg: Config) -> str:
+    """Graph-store directory for the configured feat x gtype; the flagship
+    cfg gtype keeps the historical name so existing artifacts stay valid."""
+    suffix = "" if cfg.data.gtype == "cfg" else f"_gtype_{cfg.data.gtype}"
+    return f"graphs{cfg.data.feat.name}{suffix}"
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -135,6 +143,18 @@ def cmd_extract_vocab(args) -> None:
     print(f"built vocabularies -> {vocab_path}")
 
 
+def _write_missing_ids(store_dir, examples, specs, tag=None):
+    """Record ids the frontend could not turn into graphs (the role of the
+    reference's LineVul/linevul/missing_ids.txt manifest: downstream
+    combined training masks these rows via the index-join bridge). Lives
+    inside the graph-store directory so each feat x gtype store keeps its
+    own manifest (the failure set differs by gtype)."""
+    got = {s.graph_id for s in specs}
+    missing = sorted(e.id for e in examples if e.id not in got)
+    name = f"missing_ids-{tag}.txt" if tag else "missing_ids.txt"
+    (store_dir / name).write_text("".join(f"{i}\n" for i in missing))
+
+
 def cmd_extract(args) -> None:
     from deepdfa_tpu.data.pipeline import build_dataset, encode_corpus
     from deepdfa_tpu.frontend.vocab import AbsDfVocab
@@ -148,7 +168,7 @@ def cmd_extract(args) -> None:
     splits = json.loads((out_dir / "splits.json").read_text())
     train_ids = [int(k) for k, v in splits.items() if v == "train"]
     vocab_path = out_dir / f"vocab{cfg.data.feat.name}.json"
-    store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
+    store = GraphStore(out_dir / _graphs_dirname(cfg))
 
     # fixed vocabularies: either another dataset's (--vocab-from, the
     # DbgBench / unseen-project cross-dataset workflow) or this dataset's
@@ -176,10 +196,12 @@ def cmd_extract(args) -> None:
             if i % args.num_shards == args.shard
         ]
         specs = encode_corpus(
-            sel, vocabs, workers=args.workers, max_defs=cfg.data.feat.max_defs
+            sel, vocabs, workers=args.workers,
+            max_defs=cfg.data.feat.max_defs, gtype=cfg.data.gtype,
         )
         tag = f"shard{args.shard:04d}" if args.num_shards > 1 else None
         store.write(specs, tag=tag)
+        _write_missing_ids(store.directory, sel, specs, tag=tag)
         if fixed_vocab_src != vocab_path:
             vocab_path.write_text(fixed_vocab_src.read_text())
         print(
@@ -196,8 +218,10 @@ def cmd_extract(args) -> None:
         limit_subkeys=cfg.data.feat.limit_subkeys,
         workers=args.workers,
         max_defs=cfg.data.feat.max_defs,
+        gtype=cfg.data.gtype,
     )
     store.write(specs)
+    _write_missing_ids(store.directory, examples, specs)
     vocab_path.write_text(
         json.dumps({k: v.to_json() for k, v in vocabs.items()})
     )
@@ -212,8 +236,16 @@ def _load_graph_splits(cfg: Config):
     ds = cfg.data.dataset
     out_dir = paths.processed_dir(ds)
     splits = json.loads((out_dir / "splits.json").read_text())
-    store = GraphStore(out_dir / f"graphs{cfg.data.feat.name}")
+    store = GraphStore(out_dir / _graphs_dirname(cfg))
     by_id = store.load_all()
+    if not by_id:
+        # an absent store silently yields empty splits and an opaque crash
+        # downstream; feat-name mismatches (e.g. limit_subkeys differing
+        # between extract and train) are the common cause
+        raise SystemExit(
+            f"no graphs in {store.directory} — run `extract` with the "
+            "same data.feat.* / data.gtype settings as this command"
+        )
     out = {"train": [], "val": [], "test": []}
     for gid, spec in by_id.items():
         s = splits.get(str(gid))
@@ -473,6 +505,16 @@ def _combined_setup(args, cfg):
     return tok, enc_cfg, mcfg, _rb_import
 
 
+def _require_cfg_gtype(cfg: Config, what: str) -> None:
+    """The combined transformer+graph flows carry single-relation CFG
+    graphs (as do the reference's combined models); typed cfg+dep stores
+    are a graph-only experiment surface. Fail at startup, not mid-run."""
+    if cfg.data.gtype != "cfg":
+        raise SystemExit(
+            f"{what} supports data.gtype=cfg only (got {cfg.data.gtype!r})"
+        )
+
+
 def cmd_train_combined(args) -> None:
     """DeepDFA+LineVul-style combined training over prepared artifacts."""
     import numpy as np
@@ -486,6 +528,7 @@ def cmd_train_combined(args) -> None:
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
     cfg = _load_config(args)
+    _require_cfg_gtype(cfg, "train-combined")
     ds = cfg.data.dataset
     out_dir = paths.processed_dir(ds)
     run_dir = paths.runs_dir(cfg.run_name)
@@ -500,7 +543,7 @@ def cmd_train_combined(args) -> None:
     graphs_by_id = (
         {}
         if args.no_graph
-        else GraphStore(out_dir / f"graphs{cfg.data.feat.name}").load_all()
+        else GraphStore(out_dir / _graphs_dirname(cfg)).load_all()
     )
 
     by_id = {e.id: e for e in examples}
@@ -914,6 +957,7 @@ def cmd_localize(args) -> None:
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
     cfg = _load_config(args)
+    _require_cfg_gtype(cfg, "localize")
     out_dir = paths.processed_dir(cfg.data.dataset)
     run_dir = paths.runs_dir(cfg.run_name)
     with (out_dir / "examples.pkl").open("rb") as f:
@@ -929,7 +973,7 @@ def cmd_localize(args) -> None:
     graphs_by_id = (
         {}
         if not mcfg.use_graph
-        else GraphStore(out_dir / f"graphs{cfg.data.feat.name}").load_all()
+        else GraphStore(out_dir / _graphs_dirname(cfg)).load_all()
     )
 
     targets = [

@@ -92,6 +92,9 @@ class ModelConfig:
 
     hidden_dim: int = 32
     n_steps: int = 5
+    # edge-relation count for the GGNN (dgl.nn.GatedGraphConv n_etypes);
+    # >1 needs typed-edge graphs (pipeline gtype="cfg+dep")
+    n_etypes: int = 1
     num_output_layers: int = 3
     concat_all_absdf: bool = True
     # graph | node | dataflow_solution_in | dataflow_solution_out
@@ -101,7 +104,6 @@ class ModelConfig:
     # TPU-specific knobs (no reference equivalent):
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # bfloat16 for large models
-    use_pallas: bool = False  # pallas message-passing kernel vs pure-XLA
 
 
 @dataclass(frozen=True)
@@ -118,6 +120,10 @@ class BatchConfig:
 class DataConfig:
     dataset: str = "bigvul"
     feat: FeatureSpec = field(default_factory=FeatureSpec)
+    # edge-relation set (reference gtype axis, config_bigvul.yaml): "cfg"
+    # (flagship) or "cfg+dep" (typed cfg/data-dep/control-dep edges for an
+    # n_etypes=3 GGNN; set model.n_etypes=3 to match)
+    gtype: str = "cfg"
     split: str = "fixed"  # fixed | random | fixed+random seed schemes
     seed: int = 0
     sample_mode: bool = False
@@ -201,10 +207,25 @@ def _nested_dataclass(cls: type, field_name: str) -> type | None:
     return t if dataclasses.is_dataclass(t) else None
 
 
+#: keys that existed in older saved configs and were since removed;
+#: tolerated (dropped with a warning) so old run dirs stay loadable
+_REMOVED_KEYS = {"model.use_pallas"}
+
+
 def from_dict(d: dict[str, Any]) -> Config:
     def resolve(cls, dd, prefix=""):
         known = {f.name for f in dataclasses.fields(cls)}
         unknown = set(dd) - known
+        removed = {k for k in unknown if prefix + k in _REMOVED_KEYS}
+        if removed:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring removed config key(s): %s",
+                sorted(prefix + k for k in removed),
+            )
+            unknown -= removed
+            dd = {k: v for k, v in dd.items() if k not in removed}
         if unknown:
             raise KeyError(
                 f"unknown config key(s): {sorted(prefix + k for k in unknown)}"
@@ -287,6 +308,28 @@ def apply_overrides(cfg: Config, overrides: list[str]) -> Config:
 
 def load(path: str | Path) -> Config:
     return from_dict(json.loads(Path(path).read_text()))
+
+
+#: relation count each gtype produces (pipeline.extract_graph)
+GTYPE_ETYPES = {"cfg": 1, "cfg+dep": 3}
+
+
+def validate(cfg: Config) -> None:
+    """Cross-field consistency checks (raise early, not mid-train).
+
+    The one cross-cutting invariant today: the GGNN's relation count must
+    match the edge-relation set the frontend extracted — a typed store fed
+    to a single-relation model (or vice versa) would silently mis-route
+    messages (the model also guards at batch level; this catches it at
+    config load)."""
+    want = GTYPE_ETYPES.get(cfg.data.gtype)
+    if want is None:
+        raise ValueError(f"unknown data.gtype {cfg.data.gtype!r}")
+    if cfg.model.n_etypes != want:
+        raise ValueError(
+            f"model.n_etypes={cfg.model.n_etypes} does not match "
+            f"data.gtype={cfg.data.gtype!r} (needs n_etypes={want})"
+        )
 
 
 def apply_sanitizers(cfg: Config) -> None:

@@ -46,6 +46,9 @@ class ExtractedGraph:
     #: optional reaching-definitions bit labels ([n, max_defs] float32 each:
     #: gen/kill/in/out) for the dataflow_solution_{in,out} label styles
     bits: dict[str, np.ndarray] | None = None
+    #: per-edge relation ids (gtype="cfg+dep": 0=cfg, 1=data-dependence,
+    #: 2=control-dependence); None for single-type cfg graphs
+    edge_type: np.ndarray | None = None
 
     @property
     def num_nodes(self) -> int:
@@ -58,10 +61,22 @@ def extract_graph(
     vuln_lines: set[int] | None = None,
     label: float | None = None,
     max_defs: int | None = None,
+    gtype: str = "cfg",
 ) -> ExtractedGraph | None:
     """Parse one function and build its model graph. None on failure or
     empty CFG (reference behavior: failures are skipped and logged,
-    getgraphs.py:57-59)."""
+    getgraphs.py:57-59).
+
+    gtype selects the edge relations (the reference's gtype/rdg experiment
+    axis, DDFA/sastvd/helpers/joern.py:419-441):
+    - "cfg" (flagship): control-flow edges, single relation
+    - "cfg+dep": cfg (type 0) + data-dependence (1) + control-dependence
+      (2) as typed edges for an n_etypes=3 GGNN
+    """
+    from deepdfa_tpu.core.config import GTYPE_ETYPES
+
+    if gtype not in GTYPE_ETYPES:
+        raise ValueError(f"gtype={gtype!r}")
     try:
         cpg = cparser.parse_function(code)
     except ValueError:
@@ -78,11 +93,26 @@ def extract_graph(
     keep_set = set(keep)
 
     node_lines = np.array([cpg.nodes[nid].line for nid in keep], np.int32)
-    src, dst = [], []
+    src, dst, typ = [], [], []
     for s, d, t in cpg.edges:
         if t == CFG and s in keep_set and d in keep_set:
             src.append(dense[s])
             dst.append(dense[d])
+            typ.append(0)
+    edge_type = None
+    if gtype == "cfg+dep":
+        from deepdfa_tpu.frontend import deps as deps_mod
+
+        for tid, pairs in (
+            (1, deps_mod.data_dependences(cpg)),
+            (2, deps_mod.control_dependences(cpg)),
+        ):
+            for s, d in sorted(pairs):
+                if s in keep_set and d in keep_set:
+                    src.append(dense[s])
+                    dst.append(dense[d])
+                    typ.append(tid)
+        edge_type = np.array(typ, np.int32)
     def_fields: dict[int, Fields] = {}
     for nid in keep:
         if absdf.is_decl(cpg, nid):
@@ -126,6 +156,7 @@ def extract_graph(
         def_fields=def_fields,
         label=float(label),
         bits=bits,
+        edge_type=edge_type,
     )
 
 
@@ -162,6 +193,7 @@ def to_graph_spec(
         edge_src=eg.edge_src,
         edge_dst=eg.edge_dst,
         label=eg.label,
+        edge_type=eg.edge_type,
         **bit_kw,
     )
 
@@ -176,11 +208,13 @@ class Example:
     vuln_lines: frozenset[int] = frozenset()
 
 
-def _extract_one(ex: Example, max_defs: int | None = None) -> ExtractedGraph | None:
+def _extract_one(
+    ex: Example, max_defs: int | None = None, gtype: str = "cfg"
+) -> ExtractedGraph | None:
     try:
         return extract_graph(
             ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label,
-            max_defs=max_defs,
+            max_defs=max_defs, gtype=gtype,
         )
     except Exception:
         # corpus-scale resilience: one pathological function must never
@@ -198,11 +232,11 @@ def _extract_one(ex: Example, max_defs: int | None = None) -> ExtractedGraph | N
 
 def extract_corpus(
     examples: Sequence[Example], workers: int = 0,
-    max_defs: int | None = None,
+    max_defs: int | None = None, gtype: str = "cfg",
 ) -> list[ExtractedGraph]:
     """Stage getgraphs+absdf-stage-1 over a corpus (mp fan-out like the
     reference's dfmp, sastvd/__init__.py:198-244)."""
-    fn = partial(_extract_one, max_defs=max_defs)
+    fn = partial(_extract_one, max_defs=max_defs, gtype=gtype)
     if workers and workers > 1:
         with Pool(workers) as pool:
             out = pool.map(fn, examples, chunksize=64)
@@ -237,9 +271,12 @@ def encode_corpus(
     vocabs: Mapping[str, AbsDfVocab],
     workers: int = 0,
     max_defs: int | None = None,
+    gtype: str = "cfg",
 ) -> list[GraphSpec]:
     """Extract + encode a corpus slice against pre-built vocabularies."""
-    graphs = extract_corpus(examples, workers=workers, max_defs=max_defs)
+    graphs = extract_corpus(
+        examples, workers=workers, max_defs=max_defs, gtype=gtype
+    )
     by_id = {ex.id: ex for ex in examples}
     return [
         to_graph_spec(g, vocabs, set(by_id[g.graph_id].vuln_lines) or None)
@@ -254,11 +291,15 @@ def build_dataset(
     limit_subkeys: int | None = 1000,
     workers: int = 0,
     max_defs: int | None = None,
+    gtype: str = "cfg",
 ) -> tuple[list[GraphSpec], dict[str, AbsDfVocab]]:
     """Full single-process pipeline: extract, build train-split vocabs,
     encode everything. `max_defs` attaches reaching-definitions bit labels
-    of that width for the dataflow_solution_{in,out} label styles."""
-    graphs = extract_corpus(examples, workers=workers, max_defs=max_defs)
+    of that width for the dataflow_solution_{in,out} label styles;
+    `gtype` selects the edge-relation set (see extract_graph)."""
+    graphs = extract_corpus(
+        examples, workers=workers, max_defs=max_defs, gtype=gtype
+    )
     train = set(train_ids)
     train_fields = [
         f

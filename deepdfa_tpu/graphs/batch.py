@@ -51,6 +51,9 @@ class GraphSpec:
     node_kill: np.ndarray | None = None  # [n, B]
     node_bits_in: np.ndarray | None = None  # [n, B] solver IN fixpoint
     node_bits_out: np.ndarray | None = None  # [n, B] solver OUT fixpoint
+    #: per-edge relation ids for n_etypes > 1 message passing (the role of
+    #: DGL GatedGraphConv's `etypes` argument); None = single-type graph
+    edge_type: np.ndarray | None = None  # [e] int32 in [0, n_etypes)
 
     @property
     def num_nodes(self) -> int:
@@ -87,6 +90,8 @@ class GraphBatch:
     node_kill: jax.Array | None = None
     node_bits_in: jax.Array | None = None
     node_bits_out: jax.Array | None = None
+    # optional per-edge relation ids (padding/self-loop slots carry 0)
+    edge_type: jax.Array | None = None
 
     @property
     def node_budget(self) -> int:
@@ -123,6 +128,15 @@ def bit_width(graphs: Sequence[GraphSpec]) -> int | None:
     return widths.pop()
 
 
+def edge_typed(graphs: Sequence[GraphSpec]) -> bool:
+    """Whether the graphs carry per-edge type ids; raises on a mix (a batch
+    must be homogeneous for static pytree structure)."""
+    present = {g.edge_type is not None for g in graphs}
+    if present == {True, False}:
+        raise ValueError("mixed edge_type presence across graphs")
+    return present == {True}
+
+
 def pack(
     graphs: Sequence[GraphSpec],
     num_graphs: int,
@@ -130,13 +144,16 @@ def pack(
     edge_budget: int,
     add_self_loops: bool = True,
     bits: int | None = None,
+    etypes: bool | None = None,
 ) -> GraphBatch:
     """Pack host graphs into one padded batch (numpy arrays).
 
     Raises BudgetExceeded when the graphs do not fit; callers either bucket
     by size or drop oversized examples before packing. `bits` forces the
     bit-label width (so empty shards match sibling shards); by default it
-    is inferred from the graphs.
+    is inferred from the graphs. `etypes` likewise forces presence of the
+    per-edge type array; self-loop and padding slots carry type 0 (the
+    reference's dbize_graphs adds untyped self-loops the same way).
     """
     if len(graphs) > num_graphs:
         raise BudgetExceeded(f"{len(graphs)} graphs > budget {num_graphs}")
@@ -153,6 +170,12 @@ def pack(
         raise ValueError(
             f"bits={bits} does not match graphs' width {bit_width(graphs)}"
         )
+    if etypes is None:
+        etypes = edge_typed(graphs) if graphs else False
+    elif graphs and edge_typed(graphs) != etypes:
+        raise ValueError(
+            f"etypes={etypes} does not match graphs' edge_type presence"
+        )
     bit_arrays = (
         {f: np.zeros((node_budget, bits), np.float32) for f in _BIT_FIELDS}
         if bits is not None
@@ -165,6 +188,7 @@ def pack(
     edge_src = np.zeros((edge_budget,), np.int32)
     edge_dst = np.zeros((edge_budget,), np.int32)
     edge_mask = np.zeros((edge_budget,), bool)
+    edge_type = np.zeros((edge_budget,), np.int32) if etypes else None
     graph_label = np.zeros((num_graphs,), np.float32)
     graph_mask = np.zeros((num_graphs,), bool)
     graph_ids = np.full((num_graphs,), -1, np.int32)
@@ -186,15 +210,23 @@ def pack(
         # indices_are_sorted fast path
         g_src = g.edge_src + n_off
         g_dst = g.edge_dst + n_off
+        g_type = (
+            g.edge_type
+            if g.edge_type is not None
+            else np.zeros((e,), np.int32)
+        )
         if add_self_loops:
             loop = np.arange(n_off, n_off + n, dtype=np.int32)
             g_src = np.concatenate([g_src, loop])
             g_dst = np.concatenate([g_dst, loop])
+            g_type = np.concatenate([g_type, np.zeros((n,), np.int32)])
         order = np.argsort(g_dst, kind="stable")
         ne = len(order)
         edge_src[e_off : e_off + ne] = g_src[order]
         edge_dst[e_off : e_off + ne] = g_dst[order]
         edge_mask[e_off : e_off + ne] = True
+        if edge_type is not None:
+            edge_type[e_off : e_off + ne] = g_type[order]
         e_off += ne
         graph_label[gi] = g.label
         graph_mask[gi] = True
@@ -216,6 +248,7 @@ def pack(
         graph_mask=graph_mask,
         graph_ids=graph_ids,
         num_graphs=num_graphs,
+        edge_type=edge_type,
         **bit_arrays,
     )
 
@@ -227,11 +260,17 @@ def _stack_shards(
     edge_budget: int,
     add_self_loops: bool = True,
 ) -> GraphBatch:
-    # bit width decided over ALL shards so empty shards still produce
-    # matching zero arrays (a pytree-structure mismatch would break stack)
-    bits = bit_width([g for sg in per_shard for g in sg])
+    # bit width / etype presence decided over ALL shards so empty shards
+    # still produce matching zero arrays (a pytree-structure mismatch
+    # would break stack)
+    flat = [g for sg in per_shard for g in sg]
+    bits = bit_width(flat)
+    etypes = edge_typed(flat) if flat else False
     shards = [
-        pack(sg, num_graphs, node_budget, edge_budget, add_self_loops, bits)
+        pack(
+            sg, num_graphs, node_budget, edge_budget, add_self_loops, bits,
+            etypes,
+        )
         for sg in per_shard
     ]
     stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)

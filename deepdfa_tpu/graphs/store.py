@@ -20,6 +20,7 @@ from deepdfa_tpu.graphs.batch import (
     NUM_SUBKEY_FEATS,
     GraphSpec,
     bit_width,
+    edge_typed,
 )
 
 _VERSION = 1
@@ -35,6 +36,10 @@ def save_shard(path: str | Path, graphs: Sequence[GraphSpec]) -> None:
             bit_arrays[f] = np.concatenate(
                 [getattr(g, f) for g in graphs]
             ).astype(np.float32)
+    if graphs and edge_typed(graphs):
+        bit_arrays["edge_type"] = np.concatenate(
+            [g.edge_type for g in graphs]
+        ).astype(np.int32)
     np.savez_compressed(
         path,
         version=np.int64(_VERSION),
@@ -72,6 +77,7 @@ def load_shard(path: str | Path) -> list[GraphSpec]:
             raise ValueError(f"unsupported shard version {z['version']} at {path}")
         no, eo = z["node_offsets"], z["edge_offsets"]
         has_bits = _BIT_FIELDS[0] in z
+        has_etypes = "edge_type" in z
         out = []
         for i in range(len(z["graph_ids"])):
             bit_kw = (
@@ -82,6 +88,10 @@ def load_shard(path: str | Path) -> list[GraphSpec]:
                 if has_bits
                 else {}
             )
+            if has_etypes:
+                bit_kw["edge_type"] = z["edge_type"][eo[i] : eo[i + 1]].astype(
+                    np.int32
+                )
             out.append(
                 GraphSpec(
                     graph_id=int(z["graph_ids"][i]),

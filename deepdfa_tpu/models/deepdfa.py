@@ -35,6 +35,7 @@ class DeepDFA(nn.Module):
     input_dim: int  # vocab size per subkey table (limit_all + 2)
     hidden_dim: int = 32
     n_steps: int = 5
+    n_etypes: int = 1
     num_output_layers: int = 3
     concat_all_absdf: bool = True
     # graph | node | dataflow_solution_in | dataflow_solution_out
@@ -50,6 +51,7 @@ class DeepDFA(nn.Module):
             input_dim=input_dim,
             hidden_dim=cfg.hidden_dim,
             n_steps=cfg.n_steps,
+            n_etypes=cfg.n_etypes,
             num_output_layers=cfg.num_output_layers,
             concat_all_absdf=cfg.concat_all_absdf,
             label_style=cfg.label_style,
@@ -80,6 +82,7 @@ class DeepDFA(nn.Module):
         ggnn_out = GatedGraphConv(
             out_features=width,
             n_steps=self.n_steps,
+            n_etypes=self.n_etypes,
             param_dtype=self.param_dtype,
             name="ggnn",
         )(batch, feat_embed)
@@ -99,6 +102,11 @@ class DeepDFA(nn.Module):
                     f"label_style={self.label_style} needs bit labels; "
                     "extract the corpus with max_defs set"
                 )
+            # reaching definitions is a CFG fixpoint: on typed graphs the
+            # propagation rides only the type-0 (cfg) edges
+            edge_mask = batch.edge_mask
+            if batch.edge_type is not None:
+                edge_mask = edge_mask & (batch.edge_type == 0)
             bp_in, bp_out = BitvectorPropagation(
                 n_steps=self.n_steps,
                 union_type="relu",
@@ -109,7 +117,7 @@ class DeepDFA(nn.Module):
                 batch.node_kill,
                 batch.edge_src,
                 batch.edge_dst,
-                batch.edge_mask,
+                edge_mask,
                 node_feats=feat_embed,
             )
             out = jnp.concatenate(

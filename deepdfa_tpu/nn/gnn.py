@@ -114,15 +114,23 @@ class GatedGraphConv(nn.Module):
     n_steps: int
     n_etypes: int = 1
     param_dtype: jnp.dtype = jnp.float32
-    use_pallas: bool = False  # fused gather+scatter kernel (nn/pallas_ops)
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
-        if self.n_etypes != 1:
-            # GraphBatch carries no per-edge type ids yet; silently mixing
-            # all types through every transform would be wrong
-            raise NotImplementedError(
-                "n_etypes > 1 requires edge-type ids on GraphBatch"
+        if self.n_etypes != 1 and batch.edge_type is None:
+            # silently mixing all relations through every transform would
+            # be wrong; pack GraphSpecs carrying edge_type arrays
+            raise ValueError(
+                f"n_etypes={self.n_etypes} needs edge-type ids on the "
+                "batch (GraphSpec.edge_type)"
+            )
+        if self.n_etypes == 1 and batch.edge_type is not None:
+            # the mirror-image config error: a typed store (gtype=cfg+dep)
+            # fed to a single-relation model would sum dependence edges
+            # through the cfg transform with no signal anything is off
+            raise ValueError(
+                "batch carries edge-type ids but n_etypes=1; set "
+                "model.n_etypes to the relation count (cfg+dep: 3)"
             )
         n = feat.shape[0]
         if feat.shape[-1] > self.out_features:
@@ -143,21 +151,25 @@ class GatedGraphConv(nn.Module):
         h = feat
         for _ in range(self.n_steps):
             a = jnp.zeros((n, self.out_features), feat.dtype)
-            for linear in linears:
-                m = linear(h)  # [N, D] on the MXU
-                if self.use_pallas:
-                    from deepdfa_tpu.nn.pallas_ops import pallas_edge_scatter
-
-                    a = a + pallas_edge_scatter(
-                        m, batch.edge_src, batch.edge_dst, batch.edge_mask
-                    )
+            for i, linear in enumerate(linears):
+                if self.n_etypes == 1:
+                    w = edge_w
                 else:
-                    msg = m[batch.edge_src] * edge_w  # masked gather
-                    # the batcher emits dst-sorted edges (padding carries
-                    # the max segment id), enabling the sorted fast path
-                    a = a + segment_sum(
-                        msg, batch.edge_dst, n, indices_are_sorted=True
-                    )
+                    # relation-restricted messages: each type's transform
+                    # sees only its own edges (DGL GatedGraphConv etypes
+                    # semantics), as one extra mask on the same fast path
+                    w = edge_w * (batch.edge_type == i).astype(feat.dtype)[
+                        :, None
+                    ]
+                m = linear(h)  # [N, D] on the MXU
+                msg = m[batch.edge_src] * w  # masked gather
+                # the batcher emits dst-sorted edges (padding carries
+                # the max segment id), enabling the sorted fast path —
+                # measured 12.6x faster than a fused Pallas VMEM kernel
+                # at the flagship shape (scripts/bench_scatter.py)
+                a = a + segment_sum(
+                    msg, batch.edge_dst, n, indices_are_sorted=True
+                )
             h = gru(a, h)
         return h
 

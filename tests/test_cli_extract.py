@@ -1,0 +1,81 @@
+"""CLI prepare/extract round-trip: artifacts, missing-ids manifest, gtype."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    return tmp_path
+
+
+def test_prepare_extract_writes_missing_ids(storage):
+    from deepdfa_tpu.cli.main import main
+    from deepdfa_tpu.core import paths
+
+    main(["prepare", "--source", "synthetic", "--n-examples", "24"])
+    out_dir = paths.processed_dir("bigvul")
+    # poison one example so extraction fails for it
+    import pickle
+
+    with (out_dir / "examples.pkl").open("rb") as f:
+        examples = pickle.load(f)
+    import dataclasses
+
+    examples[3] = dataclasses.replace(examples[3], code="%%% not C at all")
+    with (out_dir / "examples.pkl").open("wb") as f:
+        pickle.dump(examples, f)
+
+    main(["extract", "data.feat.limit_all=64", "data.feat.limit_subkeys=64"])
+    stores = [p for p in out_dir.iterdir() if p.is_dir()]
+    assert len(stores) == 1
+    manifest = stores[0] / "missing_ids.txt"
+    assert manifest.exists()
+    missing = [int(x) for x in manifest.read_text().split()]
+    assert examples[3].id in missing
+
+
+def test_extract_cfg_dep_gtype_separate_store(storage):
+    from deepdfa_tpu.cli.main import main
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.graphs import GraphStore
+
+    main(["prepare", "--source", "synthetic", "--n-examples", "12"])
+    main([
+        "extract", "data.feat.limit_all=64", "data.feat.limit_subkeys=64",
+        "data.gtype=cfg+dep", "model.n_etypes=3",
+    ])
+    out_dir = paths.processed_dir("bigvul")
+    dirs = [p.name for p in out_dir.iterdir() if p.is_dir()]
+    typed_dirs = [d for d in dirs if d.endswith("_gtype_cfg+dep")]
+    assert typed_dirs, dirs
+    specs = GraphStore(out_dir / typed_dirs[0]).load_all()
+    assert specs and all(s.edge_type is not None for s in specs.values())
+    assert any(
+        set(np.asarray(s.edge_type).tolist()) - {0} for s in specs.values()
+    )
+
+
+def test_gtype_n_etypes_mismatch_rejected(storage):
+    from deepdfa_tpu.cli.main import main
+
+    with pytest.raises(ValueError, match="n_etypes"):
+        main(["prepare", "--source", "synthetic", "--n-examples", "4",
+              "data.gtype=cfg+dep"])
+
+
+def test_combined_rejects_typed_gtype(storage):
+    from deepdfa_tpu.cli.main import main
+
+    with pytest.raises(SystemExit, match="gtype=cfg only"):
+        main(["train-combined", "data.gtype=cfg+dep", "model.n_etypes=3"])
+
+
+def test_removed_config_key_tolerated():
+    from deepdfa_tpu.core import config as config_mod
+
+    cfg = config_mod.from_dict({"model": {"use_pallas": False, "hidden_dim": 16}})
+    assert cfg.model.hidden_dim == 16
+    with pytest.raises(KeyError, match="unknown config key"):
+        config_mod.from_dict({"model": {"definitely_not_a_key": 1}})

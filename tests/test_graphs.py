@@ -173,3 +173,62 @@ def test_batch_is_pytree(rng):
     # static field survives tree.map
     b2 = jax.tree.map(lambda x: x, b)
     assert b2.num_graphs == 2
+
+
+def make_typed_graph(rng, gid, n, e, n_etypes=3, label=0.0):
+    g = make_graph(rng, gid, n, e, label=label)
+    import dataclasses
+
+    return dataclasses.replace(
+        g, edge_type=rng.integers(0, n_etypes, (e,)).astype(np.int32)
+    )
+
+
+def test_pack_edge_types_follow_dst_sort(rng):
+    gs = [make_typed_graph(rng, i, 6, 10) for i in range(2)]
+    b = pack(gs, num_graphs=2, node_budget=16, edge_budget=48)
+    assert b.edge_type is not None and b.edge_type.shape == (48,)
+    # per-edge (src, dst, type) multisets survive packing for each graph
+    for gi, g in enumerate(gs):
+        off = sum(x.num_nodes for x in gs[:gi])
+        want = sorted(
+            zip(g.edge_src + off, g.edge_dst + off, g.edge_type)
+        )
+        rows = [
+            (int(s), int(d), int(t))
+            for s, d, t, m, seg in zip(
+                b.edge_src, b.edge_dst, b.edge_type, b.edge_mask,
+                b.node_graph[b.edge_dst],
+            )
+            if m and seg == gi and int(s) != int(d)
+        ]
+        # self loops (src == dst, type 0) were added on top; drop
+        # same-node real edges from `want` too for a fair comparison
+        want = [(int(s), int(d), int(t)) for s, d, t in want if s != d]
+        assert sorted(rows) == sorted(want)
+    # self-loop and padding slots carry type 0
+    assert (np.asarray(b.edge_type)[~np.asarray(b.edge_mask)] == 0).all()
+
+
+def test_pack_mixed_edge_type_presence_raises(rng):
+    gs = [make_graph(rng, 0, 4, 6), make_typed_graph(rng, 1, 4, 6)]
+    with pytest.raises(ValueError, match="mixed edge_type"):
+        pack(gs, num_graphs=2, node_budget=16, edge_budget=32)
+
+
+def test_pack_shards_edge_types_uniform_structure(rng):
+    # an empty shard still gets an edge_type array when siblings have one
+    gs = [make_typed_graph(rng, i, 4, 6) for i in range(2)]
+    b = pack_shards(gs, num_shards=4, num_graphs=1, node_budget=8,
+                    edge_budget=16)
+    assert b.edge_type is not None and b.edge_type.shape == (4, 16)
+
+
+def test_store_roundtrip_edge_types(tmp_path, rng):
+    gs = [make_typed_graph(rng, i, 5, 8) for i in range(3)]
+    store = GraphStore(tmp_path / "s")
+    store.write(gs)
+    back = store.load_all()
+    assert set(back) == {0, 1, 2}
+    for g in gs:
+        np.testing.assert_array_equal(back[g.graph_id].edge_type, g.edge_type)

@@ -152,3 +152,85 @@ def test_attention_pooling_matches_reference(rng):
         off += n
     # padded graph slot pools to zero
     np.testing.assert_allclose(got[3], 0.0, atol=1e-6)
+
+
+def test_gated_graph_conv_multi_etype_relation_masking(rng):
+    """n_etypes > 1: each relation's transform sees only its own edges.
+
+    Oracle-free checks against the single-type conv (whose own parity is
+    pinned above): (a) a typed graph whose edges are ALL type 0 must equal
+    the n_etypes=1 conv sharing the etype_0/GRU params; (b) edges all of
+    type 1 must equal the single-type conv run with etype_1's transform.
+    DGL API role: dgl.nn.GatedGraphConv(..., n_etypes) + etypes argument.
+    """
+    import dataclasses as dc
+
+    import jax
+
+    d, n_steps, n, e = 8, 3, 10, 20
+    base = GraphSpec(
+        graph_id=0,
+        node_feats=rng.integers(0, 5, (n, 4)).astype(np.int32),
+        node_vuln=np.zeros((n,), np.int32),
+        edge_src=rng.integers(0, n, (e,)).astype(np.int32),
+        edge_dst=rng.integers(0, n, (e,)).astype(np.int32),
+        label=0.0,
+    )
+    feats = rng.standard_normal((16, d)).astype(np.float32)
+    conv3 = GatedGraphConv(out_features=d, n_steps=n_steps, n_etypes=3)
+    conv1 = GatedGraphConv(out_features=d, n_steps=n_steps)
+
+    def run3(etype_value):
+        g = dc.replace(
+            base, edge_type=np.full((e,), etype_value, np.int32)
+        )
+        batch = pack([g], num_graphs=1, node_budget=16, edge_budget=48)
+        params = conv3.init(jax.random.key(7), batch, feats)
+        return params, np.asarray(conv3.apply(params, batch, feats))
+
+    batch1 = pack([base], num_graphs=1, node_budget=16, edge_budget=48)
+
+    params, got0 = run3(0)
+    p = params["params"]
+    params1 = {"params": {"etype_0": p["etype_0"], "GRUCell_0": p["GRUCell_0"]}}
+    want0 = np.asarray(conv1.apply(params1, batch1, feats))
+    np.testing.assert_allclose(got0, want0, rtol=1e-5, atol=1e-6)
+
+    # all-type-1 edges: only the etype_1 transform fires on real edges...
+    params, got1 = run3(1)
+    p = params["params"]
+    # ...but self-loops (added at pack time) are type 0, so the oracle is
+    # a 2-type conv with the same params minus the never-used etype_2
+    conv2 = GatedGraphConv(out_features=d, n_steps=n_steps, n_etypes=2)
+    params2 = {
+        "params": {
+            "etype_0": p["etype_0"],
+            "etype_1": p["etype_1"],
+            "GRUCell_0": p["GRUCell_0"],
+        }
+    }
+    g1 = dc.replace(base, edge_type=np.full((e,), 1, np.int32))
+    b1 = pack([g1], num_graphs=1, node_budget=16, edge_budget=48)
+    want1 = np.asarray(conv2.apply(params2, b1, feats))
+    np.testing.assert_allclose(got1, want1, rtol=1e-5, atol=1e-6)
+    # and it differs from the all-type-0 run (the transforms are distinct)
+    assert np.abs(got1 - got0).max() > 1e-4
+
+
+def test_gated_graph_conv_multi_etype_needs_ids(rng):
+    import jax
+    import pytest
+
+    conv = GatedGraphConv(out_features=4, n_steps=2, n_etypes=2)
+    g = GraphSpec(
+        graph_id=0,
+        node_feats=np.zeros((4, 4), np.int32),
+        node_vuln=np.zeros((4,), np.int32),
+        edge_src=np.array([0, 1], np.int32),
+        edge_dst=np.array([1, 2], np.int32),
+        label=0.0,
+    )
+    batch = pack([g], num_graphs=1, node_budget=8, edge_budget=16)
+    feats = np.zeros((8, 4), np.float32)
+    with pytest.raises(ValueError, match="edge-type ids"):
+        conv.init(jax.random.key(0), batch, feats)
