@@ -375,23 +375,33 @@ def cmd_test(args) -> None:
     m = BinaryClassificationMetrics()
     loss_sum = 0.0
     count = 0.0
-    for batch in batches:
-        probs, labels, mask, per = jax.device_get(
-            trainer.eval_step(params, batch)
-        )
-        m.update(probs, labels, mask)
-        valid = np.asarray(mask, bool)
-        loss_sum += float(np.asarray(per, np.float64)[valid].sum())
-        count += float(valid.sum())
-        ids = np.asarray(batch.graph_ids).reshape(-1)
-        for gid, p, y, v in zip(
-            ids,
-            np.asarray(probs).reshape(-1),
-            np.asarray(labels).reshape(-1),
-            valid.reshape(-1),
-        ):
-            if v and gid >= 0:
-                rows.append((int(gid), float(p), int(y)))
+    import contextlib
+
+    trace_ctx = contextlib.nullcontext()
+    if args.xprof_dir:
+        # on-device op timeline for TensorBoard's profile plugin (the
+        # deep-dive analog of the reference's CUDA-event timing)
+        from deepdfa_tpu.eval import xprof_trace
+
+        trace_ctx = xprof_trace(args.xprof_dir)
+    with trace_ctx:
+        for batch in batches:
+            probs, labels, mask, per = jax.device_get(
+                trainer.eval_step(params, batch)
+            )
+            m.update(probs, labels, mask)
+            valid = np.asarray(mask, bool)
+            loss_sum += float(np.asarray(per, np.float64)[valid].sum())
+            count += float(valid.sum())
+            ids = np.asarray(batch.graph_ids).reshape(-1)
+            for gid, p, y, v in zip(
+                ids,
+                np.asarray(probs).reshape(-1),
+                np.asarray(labels).reshape(-1),
+                valid.reshape(-1),
+            ):
+                if v and gid >= 0:
+                    rows.append((int(gid), float(p), int(y)))
     metrics = m.compute()
     metrics["loss"] = loss_sum / count if count else float("nan")
     print(classification_report(m))
@@ -1113,6 +1123,9 @@ def main(argv=None) -> None:
     p.add_argument("--checkpoint", default="best")
     p.add_argument("--split", default="test")
     p.add_argument("--profile", action="store_true")
+    p.add_argument("--xprof-dir", default=None,
+                   help="dump a jax.profiler device trace of the eval "
+                        "pass here (TensorBoard profile plugin)")
     p.add_argument("--export", action="store_true",
                    help="write per-example predictions csv")
     _add_common(p)

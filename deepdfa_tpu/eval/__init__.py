@@ -6,6 +6,7 @@ from deepdfa_tpu.eval.profiling import (
     compiled_cost,
     profile_model,
     time_fn,
+    xprof_trace,
 )
 from deepdfa_tpu.eval.statements import (
     RankedExample,
@@ -26,6 +27,7 @@ __all__ = [
     "compiled_cost",
     "profile_model",
     "time_fn",
+    "xprof_trace",
     "RankedExample",
     "effort_at_recall",
     "ifa",

@@ -14,6 +14,7 @@ into the paper's Table 5):
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import json
 import time
 from pathlib import Path
@@ -112,3 +113,23 @@ def aggregate_report(jsonl_path: str | Path) -> dict:
             np.mean([r["ms_per_example"] for r in records])
         ),
     }
+
+
+@_contextlib.contextmanager
+def xprof_trace(log_dir: str | Path):
+    """jax.profiler trace context: dumps a TensorBoard/xprof-viewable
+    device trace (compute + infeed timeline) under `log_dir`.
+
+    The deep-dive complement to time_fn's wall-clock numbers — the
+    TPU-native analog of the reference's paired torch.cuda.Event
+    instrumentation (base_module.py:246-281): where the reference stamps
+    events around each test step, XLA's profiler records every executed
+    op on-device; view with TensorBoard's profile plugin."""
+    import jax
+
+    Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

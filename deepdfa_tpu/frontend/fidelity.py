@@ -65,6 +65,27 @@ def _jaccard(a: set, b: set) -> float:
     return len(a & b) / len(a | b)
 
 
+def _rd_in_lines(cpg: Cpg) -> dict[int, set[int]]:
+    """Line-keyed reaching-definitions IN sets: statement line -> the set
+    of definition LINES reaching it (the hermetic solver runs on whatever
+    CPG it is given, so comparing two CPGs through this isolates graph
+    divergence from solver divergence)."""
+    from deepdfa_tpu.frontend.reaching import ReachingDefinitions
+
+    rd = ReachingDefinitions(cpg)
+    by_line: dict[int, set[int]] = {}
+    for nid, defs in rd.solve().items():
+        line = cpg.nodes[nid].line
+        if line is None:
+            continue
+        by_line.setdefault(int(line), set()).update(
+            int(cpg.nodes[d.node].line)
+            for d in defs
+            if cpg.nodes[d.node].line is not None
+        )
+    return by_line
+
+
 def compare_cpgs(ours: Cpg, theirs: Cpg) -> dict:
     """Agreement metrics between two CPGs of the same function."""
     lines_a, lines_b = _cfg_lines(ours), _cfg_lines(theirs)
@@ -77,6 +98,14 @@ def compare_cpgs(ours: Cpg, theirs: Cpg) -> dict:
     hash_match = sum(
         1 for ln in common_def_lines if defs_a[ln] == defs_b[ln]
     )
+    rd_a, rd_b = _rd_in_lines(ours), _rd_in_lines(theirs)
+    rd_lines = set(rd_a) | set(rd_b)
+    rd_in_jaccard = (
+        sum(_jaccard(rd_a.get(ln, set()), rd_b.get(ln, set())) for ln in rd_lines)
+        / len(rd_lines)
+        if rd_lines
+        else 1.0
+    )
     return {
         "stmt_line_jaccard": round(_jaccard(lines_a, lines_b), 4),
         "cfg_edge_jaccard": round(_jaccard(edges_a, edges_b), 4),
@@ -88,6 +117,7 @@ def compare_cpgs(ours: Cpg, theirs: Cpg) -> dict:
         )
         if common_def_lines
         else 1.0,
+        "rd_in_jaccard": round(rd_in_jaccard, 4),
         "n_stmt_lines": (len(lines_a), len(lines_b)),
         "n_cfg_edges": (len(edges_a), len(edges_b)),
         "n_def_lines": (len(defs_a), len(defs_b)),
@@ -103,7 +133,7 @@ def agreement_report(pairs: Iterable[tuple[str, Cpg, Cpg]]) -> dict:
         m = compare_cpgs(ours, theirs)
         per_example[name] = m
         for k in ("stmt_line_jaccard", "cfg_edge_jaccard",
-                  "def_line_jaccard", "hash_agreement"):
+                  "def_line_jaccard", "hash_agreement", "rd_in_jaccard"):
             sums[k] = sums.get(k, 0.0) + m[k]
         n += 1
     report = {

@@ -81,3 +81,37 @@ def load_joern_cpg(path_prefix: str | Path) -> Cpg:
             continue  # endpoint filtered out or synthetic id
         cpg.add_edge(src, dst, etype)
     return cpg
+
+
+def load_joern_dataflow(path: str | Path) -> dict[str, dict[str, dict[int, frozenset[int]]]]:
+    """Parse a `<file>.dataflow.json` reaching-definitions export.
+
+    Produced by JoernSession.export_dataflow_json (role of the reference's
+    get_dataflow_output.sc cache files, consumed via
+    datasets.get_dataflow_output). Shape:
+    {method fullName: {"in"|"out": {node id: frozenset(definition idx)}}}.
+    """
+    import re
+
+    def node_id(key: str) -> int:
+        # bare integer ids normally; tolerate joern-version drift where a
+        # node's toString leaks through ("Call[label=CALL; id=42]")
+        try:
+            return int(key)
+        except ValueError:
+            m = re.search(r"id=(\d+)", key)
+            if m:
+                return int(m.group(1))
+            raise ValueError(f"unparseable dataflow node key {key!r}")
+
+    raw = json.loads(Path(path).read_text())
+    out: dict[str, dict[str, dict[int, frozenset[int]]]] = {}
+    for method, sol in raw.items():
+        out[method] = {
+            kind: {
+                node_id(nid): frozenset(int(d) for d in defs)
+                for nid, defs in sol.get(kind, {}).items()
+            }
+            for kind in ("in", "out")
+        }
+    return out

@@ -60,6 +60,34 @@ _EXPORT_TEMPLATE = r"""
 """
 
 
+# scala snippet exporting Joern's own reaching-definitions fixpoint per
+# method (role of the reference's get_func_graph.sc / get_dataflow_output.sc
+# solution export): {method fullName: {"in": {nodeId: [defIdx..]},
+# "out": {...}}} where defIdx numbers the solver's definition domain.
+_DATAFLOW_TEMPLATE = r"""
+{{
+  import java.io.PrintWriter
+  import io.joern.dataflowengineoss.passes.reachingdef.{{DataFlowSolver, ReachingDefProblem}}
+  def escDf(s: String) = s.replace("\\", "\\\\").replace("\"", "\\\"").replace("\n", "\\n").replace("\r", "")
+  def nodeKey(node: Any): String = node match {{
+    case n: io.shiftleft.codepropertygraph.generated.nodes.StoredNode => n.id.toString
+    case other => escDf(String.valueOf(other))
+  }}
+  def setJson(m: scala.collection.Map[_, _]): String = m.map {{ case (node, defs) =>
+    val ids = defs.asInstanceOf[scala.collection.Set[_]].map(String.valueOf(_)).toSeq.sorted
+    "\"" + nodeKey(node) + "\": [" + ids.mkString(", ") + "]"
+  }}.mkString("{{", ", ", "}}")
+  val entries = cpg.method.l.map {{ m =>
+    val problem = ReachingDefProblem.create(m)
+    val solution = new DataFlowSolver().calculateMopSolutionForwards(problem)
+    "\"" + escDf(m.fullName) + "\": {{\"in\": " + setJson(solution.in) +
+      ", \"out\": " + setJson(solution.out) + "}}"
+  }}
+  new PrintWriter("{out}") {{ write(entries.mkString("{{", ", ", "}}")); close }}
+}}
+"""
+
+
 def available() -> bool:
     return shutil.which("joern") is not None
 
@@ -155,6 +183,42 @@ class JoernSession:
         script = _EXPORT_TEMPLATE.format(nodes_out=nodes_out, edges_out=edges_out)
         self.run_command(script)
         return Path(nodes_out), Path(edges_out)
+
+    def export_dataflow_json(self, source_path: str | Path) -> Path:
+        """Export Joern's reaching-definitions solution for the loaded CPG
+        to `<source>.dataflow.json` (role of the reference's
+        get_dataflow_output.sc; loadable by joern_io.load_joern_dataflow)."""
+        out = str(source_path) + ".dataflow.json"
+        self.run_command(_DATAFLOW_TEMPLATE.format(out=out))
+        return Path(out)
+
+    def export_cpg_bin(self, source_path: str | Path) -> Path:
+        """Copy the loaded project's binary CPG next to `source_path` as
+        `<source>.cpg.bin` (the reference exports the same artifact for
+        re-import without re-parsing, get_func_graph.sc cpg.bin role).
+
+        Joern names workspace projects after the imported file, so the
+        project matching `source_path` is preferred; when absent (layout
+        differences across joern versions) the most recently written
+        cpg.bin — the project just imported — is used."""
+        name = Path(source_path).name
+        exact = self.workspace / "workspace" / name / "cpg.bin"
+        if exact.exists():
+            src = exact
+        else:
+            candidates = sorted(
+                self.workspace.rglob("cpg.bin"),
+                key=lambda p: p.stat().st_mtime,
+            )
+            if not candidates:
+                raise RuntimeError(
+                    f"no cpg.bin found under workspace {self.workspace}; "
+                    "import a file first"
+                )
+            src = candidates[-1]
+        dest = Path(str(source_path) + ".cpg.bin")
+        shutil.copyfile(src, dest)
+        return dest
 
     def close(self) -> None:
         try:

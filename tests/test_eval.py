@@ -141,3 +141,15 @@ def test_per_example_ifa_matches_mean():
     vals = per_example_ifa(exs)
     assert vals == [1, 0]
     assert ifa(exs) == 0.5
+
+
+def test_xprof_trace_writes_device_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.eval import xprof_trace
+
+    with xprof_trace(tmp_path / "xprof"):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    dumped = list((tmp_path / "xprof").rglob("*.xplane.pb"))
+    assert dumped, list((tmp_path / "xprof").rglob("*"))

@@ -53,6 +53,25 @@ def test_agreement_report_aggregates(tmp_path):
     assert report["n_examples"] == len(BUILDERS)
     assert set(report["mean"]) == {
         "stmt_line_jaccard", "cfg_edge_jaccard", "def_line_jaccard",
-        "hash_agreement",
+        "hash_agreement", "rd_in_jaccard",
     }
     assert json.dumps(report)  # serializable
+
+
+def test_rd_in_jaccard_detects_edge_divergence():
+    """The reaching-defs agreement metric is 1.0 on identical CPGs and
+    drops when a CFG edge changes the flow of a definition."""
+    from deepdfa_tpu.frontend.cpg import CFG
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    cpg = parse_function(SOURCES["if_else"])
+    assert compare_cpgs(cpg, cpg)["rd_in_jaccard"] == 1.0
+
+    import copy
+
+    mutated = copy.deepcopy(cpg)
+    # sever the control flow entirely: no definition reaches anything, so
+    # the line-keyed IN sets must diverge from the intact CPG's
+    mutated.edges[:] = [e for e in mutated.edges if e[2] != CFG]
+    m = compare_cpgs(cpg, mutated)
+    assert m["rd_in_jaccard"] < 1.0

@@ -83,3 +83,33 @@ def test_load_and_analyze(joern_files):
     assert fields["datatype"] == "int"
     assert fields["literal"] == "1"
     assert fields["operator"] == "addition"
+
+
+def test_load_joern_dataflow_roundtrip(tmp_path):
+    import json
+
+    from deepdfa_tpu.frontend.joern_io import load_joern_dataflow
+
+    payload = {
+        "f": {"in": {"7": [0, 2], "9": []}, "out": {"7": [1]}},
+        "g": {"in": {}, "out": {}},
+    }
+    p = tmp_path / "x.c.dataflow.json"
+    p.write_text(json.dumps(payload))
+    sol = load_joern_dataflow(p)
+    assert sol["f"]["in"][7] == frozenset({0, 2})
+    assert sol["f"]["in"][9] == frozenset()
+    assert sol["f"]["out"][7] == frozenset({1})
+    assert sol["g"] == {"in": {}, "out": {}}
+
+
+def test_load_joern_dataflow_tolerates_tostring_keys(tmp_path):
+    import json
+
+    from deepdfa_tpu.frontend.joern_io import load_joern_dataflow
+
+    p = tmp_path / "y.c.dataflow.json"
+    p.write_text(json.dumps(
+        {"f": {"in": {"Call[label=CALL; id=42]": [1]}, "out": {}}}
+    ))
+    assert load_joern_dataflow(p)["f"]["in"][42] == frozenset({1})

@@ -97,3 +97,53 @@ def test_real_joern_export(tmp_path):
         assert nodes.exists() and edges.exists()
         cpg = load_joern_cpg(src)
         assert cpg.cfg_nodes()
+
+
+def test_export_dataflow_sends_solver_script(tmp_path):
+    """Protocol-level: the dataflow export issues one command that writes
+    the expected output path via Joern's reaching-def solver API."""
+    s = JoernSession(binary=_stub(tmp_path, ECHO_STUB), timeout=60)
+    try:
+        out = s.export_dataflow_json(tmp_path / "f.c")
+        assert str(out).endswith("f.c.dataflow.json")
+    finally:
+        s.close()
+
+
+def test_export_cpg_bin_copies_workspace_artifact(tmp_path):
+    s = JoernSession(binary=_stub(tmp_path, ECHO_STUB), timeout=60)
+    try:
+        proj = s.workspace / "workspace" / "f.c"
+        proj.mkdir(parents=True)
+        (proj / "cpg.bin").write_bytes(b"\x00CPGB")
+        dest = s.export_cpg_bin(tmp_path / "f.c")
+        assert dest.read_bytes() == b"\x00CPGB"
+    finally:
+        s.close()
+
+
+def test_export_cpg_bin_without_import_raises(tmp_path):
+    s = JoernSession(binary=_stub(tmp_path, ECHO_STUB), timeout=60)
+    try:
+        with pytest.raises(RuntimeError, match="cpg.bin"):
+            s.export_cpg_bin(tmp_path / "f.c")
+    finally:
+        s.close()
+
+
+def test_export_cpg_bin_prefers_matching_project(tmp_path):
+    import time
+
+    s = JoernSession(binary=_stub(tmp_path, ECHO_STUB), timeout=60)
+    try:
+        for name in ("a.c", "z.c"):
+            proj = s.workspace / "workspace" / name
+            proj.mkdir(parents=True)
+            (proj / "cpg.bin").write_bytes(name.encode())
+            time.sleep(0.01)
+        # z.c written last (newest mtime, lexicographically greatest) but
+        # the export must pick the project matching the requested source
+        dest = s.export_cpg_bin(tmp_path / "a.c")
+        assert dest.read_bytes() == b"a.c"
+    finally:
+        s.close()
