@@ -65,10 +65,12 @@ def _build_workload(n_examples: int):
 
 def run_measurement(platform: str) -> dict:
     """The actual benchmark; runs in the child process."""
-    if platform == "cpu":
-        from deepdfa_tpu.core.backend import force_cpu
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
 
+    if platform == "cpu":
         force_cpu()
+    enable_compile_cache()  # reuse executables across runs; makes the
+    # measurement robust to the remote compile service's slow phases
     import jax
     import numpy as np
 

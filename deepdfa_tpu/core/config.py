@@ -151,6 +151,8 @@ class MeshConfig:
     dp: int = -1  # data parallel (graph batches / example batches)
     tp: int = 1  # tensor parallel (transformer heads / mlp)
     sp: int = 1  # sequence parallel (ring attention)
+    pp: int = 1  # pipeline parallel (encoder layer stages, GPipe schedule)
+    ep: int = 1  # expert parallel (MoE experts, all_to_all dispatch)
 
 
 @dataclass(frozen=True)

@@ -8,6 +8,17 @@ from deepdfa_tpu.parallel.mesh import (
     put_replicated,
     replicated,
 )
+from deepdfa_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    moe_ffn_ep,
+)
+from deepdfa_tpu.parallel.pipeline import (
+    merge_stages,
+    pipeline_encode,
+    split_stages,
+)
 from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
 
 __all__ = [
@@ -22,4 +33,11 @@ __all__ = [
     "region_start",
     "full_attention",
     "ring_attention",
+    "MoEConfig",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_ffn_ep",
+    "merge_stages",
+    "pipeline_encode",
+    "split_stages",
 ]

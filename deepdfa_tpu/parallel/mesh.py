@@ -11,6 +11,10 @@ Axis conventions (any can be size 1 and collapse away):
   dp — data parallel: batches of whole graphs / examples
   tp — tensor parallel: transformer heads / MLP shards
   sp — sequence parallel: ring attention over sequence chunks
+  pp — pipeline parallel: encoder layer stages (GPipe microbatch schedule,
+       parallel/pipeline.py; activations ride ppermute between stages)
+  ep — expert parallel: MoE experts (parallel/moe.py; experts shard over
+       ep, tokens stay replicated, one psum assembles the outputs)
 """
 
 from __future__ import annotations
@@ -21,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepdfa_tpu.core.config import MeshConfig
 
-AXES = ("dp", "tp", "sp")
+AXES = ("dp", "tp", "sp", "pp", "ep")
 
 
 def maybe_init_distributed() -> bool:
@@ -63,7 +67,13 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
         maybe_init_distributed()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    sizes = dict(dp=cfg.dp if cfg else -1, tp=cfg.tp if cfg else 1, sp=cfg.sp if cfg else 1)
+    sizes = dict(
+        dp=cfg.dp if cfg else -1,
+        tp=cfg.tp if cfg else 1,
+        sp=cfg.sp if cfg else 1,
+        pp=getattr(cfg, "pp", 1) if cfg else 1,
+        ep=getattr(cfg, "ep", 1) if cfg else 1,
+    )
     free = [ax for ax, s in sizes.items() if s == -1]
     fixed = int(np.prod([s for s in sizes.values() if s != -1]))
     if n % fixed != 0:

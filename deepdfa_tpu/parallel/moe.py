@@ -1,0 +1,154 @@
+"""Mixture-of-experts FFN with expert parallelism over the `ep` mesh axis.
+
+No reference counterpart (SURVEY §2.5: the reference has no TP/PP/SP/EP) —
+this is TPU-first scale headroom: swapping an encoder's dense FFN for a
+sparse expert layer multiplies parameters without multiplying per-token
+FLOPs, and the experts shard across devices.
+
+Static-shape formulation (the Mesh-TensorFlow / Switch style — XLA needs
+fixed shapes, so routing is expressed as dense dispatch/combine tensors
+bounded by a per-expert capacity):
+
+- router: logits [N, E] -> top-k experts per token, softmax-renormalized
+  gate weights over the chosen k;
+- capacity C = ceil(k * N / E * capacity_factor); within one expert,
+  tokens claim slots in arrival order (cumsum over the token axis) and
+  overflow tokens are DROPPED for that expert (gate contributes 0 — the
+  residual path carries them, standard Switch behavior);
+- dispatch [N, E, C] one-hot gathers expert inputs as one einsum on the
+  MXU; combine = dispatch * gate scatters expert outputs back.
+
+Expert parallelism: experts shard over `ep` (each device holds E/ep
+expert FFNs); tokens stay replicated across `ep` (the batch is already
+dp-sharded), every device routes+computes only its local experts, and one
+`psum` assembles the output — expert disjointness makes the sum exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    intermediate_size: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> dict:
+    kr, k1, k2 = jax.random.split(key, 3)
+    d, f, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    std = 0.02
+    return {
+        "router": jax.random.normal(kr, (d, e)) * std,
+        "w1": jax.random.normal(k1, (e, d, f)) * std,
+        "b1": jnp.zeros((e, f)),
+        "w2": jax.random.normal(k2, (e, f, d)) * std,
+        "b2": jnp.zeros((e, d)),
+    }
+
+
+def capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    return max(1, math.ceil(cfg.top_k * n_tokens / cfg.num_experts
+                            * cfg.capacity_factor))
+
+
+def _route(cfg: MoEConfig, router_w: jax.Array, x: jax.Array, cap: int):
+    """dispatch [N, E, C] {0,1}, combine [N, E, C] float, aux loss."""
+    n = x.shape[0]
+    e = cfg.num_experts
+    logits = x @ router_w  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, top_idx = jax.lax.top_k(logits, cfg.top_k)  # [N, k]
+    # mask of chosen experts per token, and gates renormalized over them
+    chosen = jax.nn.one_hot(top_idx, e, dtype=x.dtype).sum(1)  # [N, E]
+    gates = probs * chosen
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    # slot assignment per expert: arrival-order position among its tokens
+    position = jnp.cumsum(chosen, axis=0) * chosen - chosen  # [N, E] 0-based
+    keep = chosen * (position < cap)
+    slot = jax.nn.one_hot(position.astype(jnp.int32), cap, dtype=x.dtype)
+    dispatch = keep[:, :, None] * slot  # [N, E, C]
+    combine = dispatch * gates[:, :, None]
+    # switch-style load-balancing auxiliary loss: fraction of tokens per
+    # expert x mean router prob per expert, scaled by E
+    frac = chosen.mean(0)
+    aux = e * jnp.sum(frac * probs.mean(0))
+    return dispatch, combine, aux
+
+
+def _expert_compute(w1, b1, w2, b2, dispatch, combine, x):
+    """Gather -> per-expert FFN -> scatter, for any expert block size."""
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, w1) + b1[:, None, :]
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    return jnp.einsum("ecd,nec->nd", expert_out, combine)
+
+
+def moe_ffn(cfg: MoEConfig, params: dict, x: jax.Array,
+            cap: int | None = None):
+    """Dense-math MoE forward on one device. x: [N, D] -> ([N, D], aux)."""
+    if cap is None:
+        cap = capacity(cfg, x.shape[0])
+    dispatch, combine, aux = _route(cfg, params["router"], x, cap)
+    out = _expert_compute(
+        params["w1"], params["b1"], params["w2"], params["b2"],
+        dispatch, combine, x,
+    )
+    return out, aux
+
+
+def moe_ffn_ep(cfg: MoEConfig, params: dict, x: jax.Array, mesh,
+               ep_axis: str = "ep"):
+    """Expert-parallel MoE: experts shard over `ep_axis`, tokens stay
+    replicated, outputs psum — numerically identical to moe_ffn (the
+    routing is computed identically everywhere; each device keeps only
+    its expert block's contribution). x: [N, D] -> ([N, D], aux)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[ep_axis]
+    if cfg.num_experts % n_dev:
+        raise ValueError(
+            f"{cfg.num_experts} experts not divisible by ep={n_dev}"
+        )
+    cap = capacity(cfg, x.shape[0])
+    e_local = cfg.num_experts // n_dev
+
+    def body(pr, x_rep):
+        rank = jax.lax.axis_index(ep_axis)
+        # full routing (cheap: one [N,D]x[D,E] matmul) so slot positions
+        # and gates are computed identically on every device
+        dispatch, combine, aux = _route(cfg, pr["router"], x_rep, cap)
+        lo = rank * e_local
+        disp_l = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, 1)
+        comb_l = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
+        out = _expert_compute(
+            pr["w1"], pr["b1"], pr["w2"], pr["b2"], disp_l, comb_l, x_rep
+        )
+        return jax.lax.psum(out, ep_axis), aux
+
+    specs = {
+        "router": P(),
+        "w1": P(ep_axis), "b1": P(ep_axis),
+        "w2": P(ep_axis), "b2": P(ep_axis),
+    }
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=({k: specs[k] for k in params}, P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(params, x)

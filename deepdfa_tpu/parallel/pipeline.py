@@ -1,0 +1,178 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `pp` mesh axis.
+
+No reference counterpart (the reference is single-GPU; SURVEY §2.5) — this
+is TPU-first scale headroom for encoders too deep for one chip's HBM. The
+design follows the classic GPipe schedule expressed the XLA way:
+
+- the encoder's stacked layer parameters [L, ...] reshape to [P, L/P, ...]
+  and shard their leading (stage) axis over `pp` — each device holds a
+  contiguous block of layers;
+- a `lax.scan` runs M + P - 1 ticks; each tick every stage runs its layer
+  block on the microbatch currently resident and hands the activation to
+  the next stage with a single `ppermute` hop (neighbor traffic on the
+  ICI ring, never an all-to-all);
+- stage 0 feeds a fresh microbatch per tick (embedding lives there
+  logically; physically every stage computes the embed and a `where`
+  keeps stage 0's — a few flops traded for branch-free code XLA can
+  pipeline); the last stage collects finished microbatches, and one
+  `psum` at the end replicates the output across stages;
+- backward needs no hand-written schedule: `ppermute` transposes to the
+  reverse permutation, so autodiff yields the mirrored backward pipeline,
+  and `jax.checkpoint` around the stage body keeps only per-stage
+  activations live (the GPipe rematerialization strategy).
+
+The bubble fraction is (P-1)/(M+P-1): pick microbatches >= 4x stages.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def split_stages(layers: dict, n_stages: int) -> dict:
+    """Reshape stacked layer params [L, ...] -> [P, L/P, ...]."""
+    def reshape(x):
+        n_layers = x.shape[0]
+        if n_layers % n_stages:
+            raise ValueError(
+                f"{n_layers} layers not divisible by {n_stages} stages"
+            )
+        return x.reshape(n_stages, n_layers // n_stages, *x.shape[1:])
+
+    return jax.tree.map(reshape, layers)
+
+
+def merge_stages(staged: dict) -> dict:
+    """Inverse of split_stages: [P, L/P, ...] -> [L, ...]."""
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]), staged
+    )
+
+
+def pipeline_encode(
+    cfg,
+    params: dict,
+    input_ids: jax.Array,
+    mesh,
+    microbatches: int = 4,
+    attn_mask: jax.Array | None = None,
+    dropout_key: jax.Array | None = None,
+    pp_axis: str = "pp",
+):
+    """RoBERTa-family encoder forward, layer-pipelined over `pp_axis`.
+
+    Same contract as models.transformer.encode ([B, T] ids -> [B, T, D]),
+    numerically identical to the single-device path (parity-tested).
+    `params` is the standard (unstaged) param tree; staging happens here.
+    The batch must divide by `microbatches`.
+    """
+    from deepdfa_tpu.models.transformer import embed, encoder_layer
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_stages = mesh.shape[pp_axis]
+    if attn_mask is None:
+        attn_mask = input_ids != cfg.pad_token_id
+
+    b_total, seq = input_ids.shape
+    m = microbatches
+    if b_total % m:
+        raise ValueError(f"batch {b_total} not divisible by {m} microbatches")
+    mb_ids = input_ids.reshape(m, b_total // m, seq)
+    mb_mask = attn_mask.reshape(m, b_total // m, seq)
+
+    staged_layers = split_stages(params["layers"], n_stages)
+    rest = {k: v for k, v in params.items() if k != "layers"}
+
+    def body(staged_local, rest_p, ids, mask, key):
+        stage = jax.lax.axis_index(pp_axis)
+        layers_local = jax.tree.map(lambda x: x[0], staged_local)
+        n_local = jax.tree.leaves(layers_local)[0].shape[0]
+
+        def run_stage(x, mask_m, stage_key):
+            def layer_fn(h, inp):
+                lp, k = inp
+                return encoder_layer(cfg, lp, h, mask_m, k), None
+
+            keys = (
+                jax.random.split(stage_key, n_local)
+                if stage_key is not None
+                else jnp.zeros((n_local, 2), jnp.uint32)
+            )
+            if dropout_key is None:
+                def layer_fn(h, inp):  # noqa: F811 - no-dropout variant
+                    lp, _ = inp
+                    return encoder_layer(cfg, lp, h, mask_m, None), None
+
+            fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+            x, _ = jax.lax.scan(fn, x, (layers_local, keys))
+            return x
+
+        steps = m + n_stages - 1
+        d = cfg.hidden_size
+        dt = jnp.dtype(cfg.dtype)  # embed/layers emit the activation dtype
+        state0 = jnp.zeros((b_total // m, seq, d), dt)
+        out0 = jnp.zeros((m, b_total // m, seq, d), dt)
+
+        def step(carry, t):
+            state, outputs = carry
+            # microbatch index resident at this stage this tick
+            mi = jnp.clip(t - stage, 0, m - 1)
+            ti = jnp.clip(t, 0, m - 1)
+            ids_t = jax.lax.dynamic_index_in_dim(ids, ti, keepdims=False)
+            # stage 0's tick input is a fresh embed; later stages take the
+            # activation handed over by ppermute last tick
+            ekey = (
+                jax.random.fold_in(key, ti) if key is not None else None
+            )
+            x0 = embed(cfg, rest_p, ids_t, 0, ekey)
+            xin = jnp.where(stage == 0, x0, state)
+            mask_m = jax.lax.dynamic_index_in_dim(mask, mi, keepdims=False)
+            # decorrelate dropout across microbatches AND stages (each
+            # stage holds different global layers; an identical key would
+            # draw identical masks on every stage)
+            skey = (
+                jax.random.fold_in(
+                    jax.random.fold_in(jax.random.fold_in(key, 7919), mi),
+                    stage,
+                )
+                if key is not None
+                else None
+            )
+            out = run_stage(xin, mask_m, skey)
+            widx = t - (n_stages - 1)
+            write = (stage == n_stages - 1) & (widx >= 0)
+            wi = jnp.clip(widx, 0, m - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, wi, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, out, prev), wi, 0
+            )
+            nxt = jax.lax.ppermute(
+                out, pp_axis,
+                perm=[(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return (nxt, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            step, (state0, out0), jnp.arange(steps)
+        )
+        # only the last stage wrote real values; psum replicates them
+        return jax.lax.psum(outputs, pp_axis)
+
+    hidden = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(pp_axis), staged_layers),
+            jax.tree.map(lambda _: P(), rest),
+            P(), P(), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(staged_layers, rest, mb_ids, mb_mask, dropout_key)
+    return hidden.reshape(b_total, seq, -1)
