@@ -137,20 +137,53 @@ def forward(
     sp_axis: str | None = None,
     tp_axis: str | None = None,
     position_offset: int = 0,
+    pp_axis: str | None = None,
+    pp_stages: int = 1,
+    pp_microbatches: int = 4,
 ) -> jax.Array:
-    """[B, T] ids (+ aligned GraphBatch of B graphs) -> [B, num_classes]."""
+    """[B, T] ids (+ aligned GraphBatch of B graphs) -> [B, num_classes].
+
+    With `pp_axis` set (inside shard_map, layer params stage-sharded over
+    that axis, sp off) the encoder runs the GPipe microbatch schedule;
+    the broadcast uses region_end because this forward's caller computes
+    a loss copy on every stage (parallel/pipeline.py docstring)."""
     k_enc = k_head = None
     if dropout_key is not None:
         k_enc, k_head = jax.random.split(dropout_key)
-    hidden = tfm.encode(
-        cfg.encoder,
-        params["encoder"],
-        input_ids,
-        dropout_key=k_enc,
-        sp_axis=sp_axis,
-        tp_axis=tp_axis,
-        position_offset=position_offset,
-    )
+    if pp_axis is not None:
+        if sp_axis is not None:
+            raise ValueError("pp and sp cannot both shard the encoder")
+        if position_offset != 0:
+            raise ValueError(
+                "position_offset is an sp-shard contract; the pipeline "
+                "path embeds full sequences (offset must be 0)"
+            )
+        from deepdfa_tpu.parallel.pipeline import pipeline_stage_forward
+
+        enc = params["encoder"]
+        hidden = pipeline_stage_forward(
+            cfg.encoder,
+            enc["layers"],
+            {k: v for k, v in enc.items() if k != "layers"},
+            input_ids,
+            input_ids != cfg.encoder.pad_token_id,
+            k_enc,
+            pp_microbatches,
+            pp_stages,
+            pp_axis,
+            broadcast="region_end",
+            tp_axis=tp_axis,
+        )
+    else:
+        hidden = tfm.encode(
+            cfg.encoder,
+            params["encoder"],
+            input_ids,
+            dropout_key=k_enc,
+            sp_axis=sp_axis,
+            tp_axis=tp_axis,
+            position_offset=position_offset,
+        )
     cls_vec = hidden[:, 0, :]
     if sp_axis is not None:
         # [CLS] lives on the first sp shard; broadcast with psum-forward /

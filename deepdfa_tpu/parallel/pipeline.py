@@ -51,6 +51,127 @@ def merge_stages(staged: dict) -> dict:
     )
 
 
+def pipeline_stage_forward(
+    cfg,
+    layers_local: dict,
+    rest_p: dict,
+    input_ids: jax.Array,
+    attn_mask: jax.Array,
+    dropout_key,
+    microbatches: int,
+    n_stages: int,
+    pp_axis: str = "pp",
+    broadcast: str = "psum",
+    tp_axis: str | None = None,
+):
+    """The GPipe schedule, running INSIDE shard_map on one stage.
+
+    layers_local: this stage's layer block [L/P, ...]; rest_p: replicated
+    non-layer params; input_ids/attn_mask: the full local batch [B, T]
+    (replicated across `pp_axis`). Returns hidden [B, T, D] replicated
+    across stages.
+
+    `broadcast` picks how the last stage's outputs reach every stage:
+    - "psum": plain psum — correct when the LOSS is computed outside the
+      shard_map (the cotangent enters once);
+    - "region_end": psum-forward / identity-backward (megatron region op)
+      — required when every stage computes its own loss copy inside the
+      same shard_map (a raw psum would transpose to psum and multiply
+      encoder cotangents by the stage count; same trap as the sp [CLS]
+      broadcast, docs/DESIGN.md section 4).
+    """
+    from deepdfa_tpu.models.transformer import embed, encoder_layer
+
+    b_total, seq = input_ids.shape
+    m = microbatches
+    if b_total % m:
+        raise ValueError(f"batch {b_total} not divisible by {m} microbatches")
+    ids = input_ids.reshape(m, b_total // m, seq)
+    mask = attn_mask.reshape(m, b_total // m, seq)
+
+    stage = jax.lax.axis_index(pp_axis)
+    n_local = jax.tree.leaves(layers_local)[0].shape[0]
+
+    def run_stage(x, mask_m, stage_key):
+        def layer_fn(h, inp):
+            lp, k = inp
+            return encoder_layer(cfg, lp, h, mask_m, k, tp_axis=tp_axis), None
+
+        keys = (
+            jax.random.split(stage_key, n_local)
+            if stage_key is not None
+            else jnp.zeros((n_local, 2), jnp.uint32)
+        )
+        if dropout_key is None:
+            def layer_fn(h, inp):  # noqa: F811 - no-dropout variant
+                lp, _ = inp
+                return (
+                    encoder_layer(cfg, lp, h, mask_m, None, tp_axis=tp_axis),
+                    None,
+                )
+
+        fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+        x, _ = jax.lax.scan(fn, x, (layers_local, keys))
+        return x
+
+    steps = m + n_stages - 1
+    d = cfg.hidden_size
+    dt = jnp.dtype(cfg.dtype)  # embed/layers emit the activation dtype
+    state0 = jnp.zeros((b_total // m, seq, d), dt)
+    out0 = jnp.zeros((m, b_total // m, seq, d), dt)
+
+    def step(carry, t):
+        state, outputs = carry
+        # microbatch index resident at this stage this tick
+        mi = jnp.clip(t - stage, 0, m - 1)
+        ti = jnp.clip(t, 0, m - 1)
+        ids_t = jax.lax.dynamic_index_in_dim(ids, ti, keepdims=False)
+        # stage 0's tick input is a fresh embed; later stages take the
+        # activation handed over by ppermute last tick
+        ekey = jax.random.fold_in(dropout_key, ti) if dropout_key is not None else None
+        x0 = embed(cfg, rest_p, ids_t, 0, ekey)
+        xin = jnp.where(stage == 0, x0, state)
+        mask_m = jax.lax.dynamic_index_in_dim(mask, mi, keepdims=False)
+        # decorrelate dropout across microbatches AND stages (each
+        # stage holds different global layers; an identical key would
+        # draw identical masks on every stage)
+        skey = (
+            jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.fold_in(dropout_key, 7919), mi
+                ),
+                stage,
+            )
+            if dropout_key is not None
+            else None
+        )
+        out = run_stage(xin, mask_m, skey)
+        widx = t - (n_stages - 1)
+        write = (stage == n_stages - 1) & (widx >= 0)
+        wi = jnp.clip(widx, 0, m - 1)
+        prev = jax.lax.dynamic_index_in_dim(outputs, wi, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, out, prev), wi, 0
+        )
+        nxt = jax.lax.ppermute(
+            out, pp_axis,
+            perm=[(i, (i + 1) % n_stages) for i in range(n_stages)],
+        )
+        return (nxt, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(step, (state0, out0), jnp.arange(steps))
+    # only the last stage wrote real values; the broadcast replicates them
+    if broadcast == "psum":
+        outputs = jax.lax.psum(outputs, pp_axis)
+    elif broadcast == "region_end":
+        from deepdfa_tpu.parallel.megatron import region_end
+
+        outputs = region_end(outputs, pp_axis)
+    else:
+        raise ValueError(f"broadcast={broadcast!r}")
+    return outputs.reshape(b_total, seq, -1)
+
+
 def pipeline_encode(
     cfg,
     params: dict,
@@ -68,8 +189,6 @@ def pipeline_encode(
     `params` is the standard (unstaged) param tree; staging happens here.
     The batch must divide by `microbatches`.
     """
-    from deepdfa_tpu.models.transformer import embed, encoder_layer
-
     try:
         from jax import shard_map
     except ImportError:  # older jax
@@ -79,92 +198,17 @@ def pipeline_encode(
     if attn_mask is None:
         attn_mask = input_ids != cfg.pad_token_id
 
-    b_total, seq = input_ids.shape
-    m = microbatches
-    if b_total % m:
-        raise ValueError(f"batch {b_total} not divisible by {m} microbatches")
-    mb_ids = input_ids.reshape(m, b_total // m, seq)
-    mb_mask = attn_mask.reshape(m, b_total // m, seq)
-
     staged_layers = split_stages(params["layers"], n_stages)
     rest = {k: v for k, v in params.items() if k != "layers"}
 
     def body(staged_local, rest_p, ids, mask, key):
-        stage = jax.lax.axis_index(pp_axis)
         layers_local = jax.tree.map(lambda x: x[0], staged_local)
-        n_local = jax.tree.leaves(layers_local)[0].shape[0]
-
-        def run_stage(x, mask_m, stage_key):
-            def layer_fn(h, inp):
-                lp, k = inp
-                return encoder_layer(cfg, lp, h, mask_m, k), None
-
-            keys = (
-                jax.random.split(stage_key, n_local)
-                if stage_key is not None
-                else jnp.zeros((n_local, 2), jnp.uint32)
-            )
-            if dropout_key is None:
-                def layer_fn(h, inp):  # noqa: F811 - no-dropout variant
-                    lp, _ = inp
-                    return encoder_layer(cfg, lp, h, mask_m, None), None
-
-            fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
-            x, _ = jax.lax.scan(fn, x, (layers_local, keys))
-            return x
-
-        steps = m + n_stages - 1
-        d = cfg.hidden_size
-        dt = jnp.dtype(cfg.dtype)  # embed/layers emit the activation dtype
-        state0 = jnp.zeros((b_total // m, seq, d), dt)
-        out0 = jnp.zeros((m, b_total // m, seq, d), dt)
-
-        def step(carry, t):
-            state, outputs = carry
-            # microbatch index resident at this stage this tick
-            mi = jnp.clip(t - stage, 0, m - 1)
-            ti = jnp.clip(t, 0, m - 1)
-            ids_t = jax.lax.dynamic_index_in_dim(ids, ti, keepdims=False)
-            # stage 0's tick input is a fresh embed; later stages take the
-            # activation handed over by ppermute last tick
-            ekey = (
-                jax.random.fold_in(key, ti) if key is not None else None
-            )
-            x0 = embed(cfg, rest_p, ids_t, 0, ekey)
-            xin = jnp.where(stage == 0, x0, state)
-            mask_m = jax.lax.dynamic_index_in_dim(mask, mi, keepdims=False)
-            # decorrelate dropout across microbatches AND stages (each
-            # stage holds different global layers; an identical key would
-            # draw identical masks on every stage)
-            skey = (
-                jax.random.fold_in(
-                    jax.random.fold_in(jax.random.fold_in(key, 7919), mi),
-                    stage,
-                )
-                if key is not None
-                else None
-            )
-            out = run_stage(xin, mask_m, skey)
-            widx = t - (n_stages - 1)
-            write = (stage == n_stages - 1) & (widx >= 0)
-            wi = jnp.clip(widx, 0, m - 1)
-            prev = jax.lax.dynamic_index_in_dim(outputs, wi, keepdims=False)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(write, out, prev), wi, 0
-            )
-            nxt = jax.lax.ppermute(
-                out, pp_axis,
-                perm=[(i, (i + 1) % n_stages) for i in range(n_stages)],
-            )
-            return (nxt, outputs), None
-
-        (_, outputs), _ = jax.lax.scan(
-            step, (state0, out0), jnp.arange(steps)
+        return pipeline_stage_forward(
+            cfg, layers_local, rest_p, ids, mask, key,
+            microbatches, n_stages, pp_axis, broadcast="psum",
         )
-        # only the last stage wrote real values; psum replicates them
-        return jax.lax.psum(outputs, pp_axis)
 
-    hidden = shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -174,5 +218,4 @@ def pipeline_encode(
         ),
         out_specs=P(),
         check_vma=False,
-    )(staged_layers, rest, mb_ids, mb_mask, dropout_key)
-    return hidden.reshape(b_total, seq, -1)
+    )(staged_layers, rest, input_ids, attn_mask, dropout_key)

@@ -78,8 +78,14 @@ class CombinedTrainer:
       replicated-true grads — no tp reduction at all;
     - sp: encoder compute is token-partial -> psum over sp; the head and
       graph encoder run identically on every sp member (replicated-true);
-    - dp: every grad sums over dp.
-    Loss normalization uses the dp-global valid-row count only (tp/sp
+    - dp: every grad sums over dp;
+    - pp (RoBERTa arch, sp off): stage-sharded layer grads are local-true
+      (each stage's layers exist only on its device — no pp reduction);
+      the region_end output broadcast means exactly one stage's loss copy
+      back-propagates through the pipeline, so embedding cotangents land
+      on stage 0 and zeros elsewhere -> embeddings psum over pp; head and
+      graph compute replicated-true per stage -> no pp reduction.
+    Loss normalization uses the dp-global valid-row count only (tp/sp/pp
     members process the same rows, so their counts are not re-added).
     """
 
@@ -90,6 +96,7 @@ class CombinedTrainer:
         mesh: Mesh | None = None,
         total_steps: int | None = None,
         freeze_graph: bool = False,
+        pp_microbatches: int = 4,
     ):
         """model_cfg: cmb.CombinedConfig (RoBERTa-family, LineVul/UniXcoder
         style) or t5.DefectConfig (CodeT5 style, eos pooling)."""
@@ -101,6 +108,19 @@ class CombinedTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(cfg.train.mesh)
         self.tp = self.mesh.shape.get("tp", 1) > 1
         self.sp = self.mesh.shape.get("sp", 1) > 1
+        self.pp_size = self.mesh.shape.get("pp", 1)
+        self.pp = self.pp_size > 1
+        self.pp_microbatches = pp_microbatches
+        if self.pp and (self.is_t5 or self.sp):
+            raise NotImplementedError(
+                "pipeline parallelism supports the RoBERTa combined arch "
+                "with sp=1 (pp shards the layer stack; sp shards tokens)"
+            )
+        if self.pp and model_cfg.encoder.num_layers % self.pp_size:
+            raise ValueError(
+                f"{model_cfg.encoder.num_layers} encoder layers not "
+                f"divisible by pp={self.pp_size} stages"
+            )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         if freeze_graph:
             # reference --freeze_graph: the pretrained GGNN stays fixed
@@ -144,13 +164,21 @@ class CombinedTrainer:
                 enc_specs["layers"] = t5m.tp_layer_specs()
                 enc_specs["rel_bias"] = P(None, "tp")
         else:
+            layer_specs = (
+                cmb.tfm.tp_layer_specs()
+                if self.tp
+                else rep(example["encoder"]["layers"])
+            )
+            if self.pp:
+                # the stacked layer axis (leading) shards across stages
+                layer_specs = jax.tree.map(
+                    lambda s: P("pp", *tuple(s)[1:]) if len(s) else P("pp"),
+                    layer_specs,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
             enc_specs = {
                 "embeddings": rep(example["encoder"]["embeddings"]),
-                "layers": (
-                    cmb.tfm.tp_layer_specs()
-                    if self.tp
-                    else rep(example["encoder"]["layers"])
-                ),
+                "layers": layer_specs,
             }
         specs = {"encoder": enc_specs, "head": rep(example["head"])}
         if "graph" in example:
@@ -160,7 +188,9 @@ class CombinedTrainer:
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P),
         )
-        # grad reduction axes per top-level group (see class docstring)
+        # grad reduction axes per top-level group (see class docstring);
+        # under pp the encoder group is split inline in _steps_for
+        # (stage-sharded layers local-true, embeddings psum over pp)
         self._grad_axes = {
             "encoder": ("dp", "sp"),
             "head": ("dp",),
@@ -245,6 +275,9 @@ class CombinedTrainer:
             sp_axis=sp_axis,
             tp_axis=tp_axis,
             position_offset=offset,
+            pp_axis="pp" if self.pp else None,
+            pp_stages=self.pp_size,
+            pp_microbatches=self.pp_microbatches,
         )
 
     def _loss_sum(self, params, local: TextBatch, key):
@@ -272,6 +305,7 @@ class CombinedTrainer:
             return self._step_cache[num_graphs]
         mesh = self.mesh
         grad_axes = self._grad_axes
+        pp = self.pp
         batch_specs = self._batch_specs(num_graphs)
 
         @partial(
@@ -295,13 +329,22 @@ class CombinedTrainer:
 
             loss_local, grads = jax.value_and_grad(fn)(params)
             loss = jax.lax.psum(loss_local, "dp")
-            grads = {
-                group: jax.tree.map(
-                    lambda g: jax.lax.psum(g, grad_axes[group]), sub
-                )
-                for group, sub in grads.items()
-            }
-            return loss, grads
+
+            def reduce(sub, axes):
+                return jax.tree.map(lambda g: jax.lax.psum(g, axes), sub)
+
+            out = {}
+            for group, sub in grads.items():
+                if group == "encoder" and pp:
+                    # pp splits the encoder: stage-sharded layers are
+                    # local-true, embeddings carry stage-0-only cotangents
+                    out[group] = {
+                        "layers": reduce(sub["layers"], ("dp",)),
+                        "embeddings": reduce(sub["embeddings"], ("dp", "pp")),
+                    }
+                else:
+                    out[group] = reduce(sub, grad_axes[group])
+            return loss, out
 
         @partial(jax.jit, donate_argnums=0)
         def train_step(state: TrainState, batch: TextBatch, key):

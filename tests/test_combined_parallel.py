@@ -40,6 +40,8 @@ def _setup():
     dict(dp=1, tp=4, sp=2),
     dict(dp=8, tp=1, sp=1),
     dict(dp=1, tp=1, sp=8),
+    dict(dp=4, pp=2),
+    dict(dp=2, tp=2, pp=2),
 ])
 def test_parallel_matches_single(mesh_cfg):
     import jax
