@@ -313,7 +313,7 @@ def load(path: str | Path) -> Config:
 
 
 #: relation count each gtype produces (pipeline.extract_graph)
-GTYPE_ETYPES = {"cfg": 1, "cfg+dep": 3}
+GTYPE_ETYPES = {"cfg": 1, "pdg": 1, "cfg+dep": 3}
 
 
 def validate(cfg: Config) -> None:

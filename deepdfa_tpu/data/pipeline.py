@@ -70,6 +70,8 @@ def extract_graph(
     gtype selects the edge relations (the reference's gtype/rdg experiment
     axis, DDFA/sastvd/helpers/joern.py:419-441):
     - "cfg" (flagship): control-flow edges, single relation
+    - "pdg": program-dependence graph — data + control dependences merged
+      into ONE relation (the reference's rdg("pdg") reduction)
     - "cfg+dep": cfg (type 0) + data-dependence (1) + control-dependence
       (2) as typed edges for an n_etypes=3 GGNN
     """
@@ -94,15 +96,18 @@ def extract_graph(
 
     node_lines = np.array([cpg.nodes[nid].line for nid in keep], np.int32)
     src, dst, typ = [], [], []
-    for s, d, t in cpg.edges:
-        if t == CFG and s in keep_set and d in keep_set:
-            src.append(dense[s])
-            dst.append(dense[d])
-            typ.append(0)
+    if gtype != "pdg":
+        for s, d, t in cpg.edges:
+            if t == CFG and s in keep_set and d in keep_set:
+                src.append(dense[s])
+                dst.append(dense[d])
+                typ.append(0)
     edge_type = None
-    if gtype == "cfg+dep":
+    if gtype in ("pdg", "cfg+dep"):
         from deepdfa_tpu.frontend import deps as deps_mod
 
+        # pdg merges both dependence kinds into one relation; cfg+dep
+        # keeps them typed alongside cfg
         for tid, pairs in (
             (1, deps_mod.data_dependences(cpg)),
             (2, deps_mod.control_dependences(cpg)),
@@ -111,8 +116,9 @@ def extract_graph(
                 if s in keep_set and d in keep_set:
                     src.append(dense[s])
                     dst.append(dense[d])
-                    typ.append(tid)
-        edge_type = np.array(typ, np.int32)
+                    typ.append(tid if gtype == "cfg+dep" else 0)
+        if gtype == "cfg+dep":
+            edge_type = np.array(typ, np.int32)
     def_fields: dict[int, Fields] = {}
     for nid in keep:
         if absdf.is_decl(cpg, nid):

@@ -37,10 +37,28 @@ class CombinedConfig:
     num_classes: int = 2
     head_dropout: float = 0.1
     use_graph: bool = True
+    # optional sparse expert adapter on the [CLS] path (residual MoE block
+    # before the head): capacity without per-row FLOPs, and the expert
+    # dimension shards over the ep mesh axis (parallel/moe.py). 0 = off
+    # (the flagship reference-parity configuration).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_aux_weight: float = 0.01
 
     @property
     def graph_out_dim(self) -> int:
         return 8 * self.graph_hidden_dim  # concat_all_absdf encoder out_dim
+
+    @property
+    def moe_cfg(self):
+        from deepdfa_tpu.parallel.moe import MoEConfig
+
+        return MoEConfig(
+            hidden_size=self.encoder.hidden_size,
+            intermediate_size=self.encoder.intermediate_size,
+            num_experts=self.moe_experts,
+            top_k=self.moe_top_k,
+        )
 
 
 def make_graph_encoder(cfg: CombinedConfig) -> DeepDFA:
@@ -104,6 +122,12 @@ def init_params(cfg: CombinedConfig, key: jax.Array) -> dict:
     if cfg.use_graph:
         graph_enc = make_graph_encoder(cfg)
         params["graph"] = graph_enc.init(k_graph, _dummy_graph_batch())
+    if cfg.moe_experts:
+        from deepdfa_tpu.parallel.moe import init_moe_params
+
+        params["moe"] = init_moe_params(
+            cfg.moe_cfg, jax.random.fold_in(k_head, 2)
+        )
     return params
 
 
@@ -140,13 +164,20 @@ def forward(
     pp_axis: str | None = None,
     pp_stages: int = 1,
     pp_microbatches: int = 4,
+    ep_axis: str | None = None,
+    ep_size: int = 1,
+    with_aux: bool = False,
 ) -> jax.Array:
     """[B, T] ids (+ aligned GraphBatch of B graphs) -> [B, num_classes].
 
     With `pp_axis` set (inside shard_map, layer params stage-sharded over
     that axis, sp off) the encoder runs the GPipe microbatch schedule;
     the broadcast uses region_end because this forward's caller computes
-    a loss copy on every stage (parallel/pipeline.py docstring)."""
+    a loss copy on every stage (parallel/pipeline.py docstring). With
+    cfg.moe_experts > 0 the [CLS] vector passes through a residual MoE
+    adapter (expert-parallel over `ep_axis` when set). `with_aux=True`
+    returns (logits, aux_loss) — the MoE load-balancing term the trainer
+    adds to the objective (0.0 when no MoE)."""
     k_enc = k_head = None
     if dropout_key is not None:
         k_enc, k_head = jax.random.split(dropout_key)
@@ -184,6 +215,7 @@ def forward(
             tp_axis=tp_axis,
             position_offset=position_offset,
         )
+    aux = jnp.zeros((), jnp.float32)
     cls_vec = hidden[:, 0, :]
     if sp_axis is not None:
         # [CLS] lives on the first sp shard; broadcast with psum-forward /
@@ -194,6 +226,18 @@ def forward(
         cls_vec = region_end(
             jnp.where(idx == 0, cls_vec, jnp.zeros_like(cls_vec)), sp_axis
         )
+
+    if cfg.moe_experts:
+        from deepdfa_tpu.parallel.moe import moe_ffn, moe_stage_forward
+
+        if ep_axis is not None:
+            moe_out, aux = moe_stage_forward(
+                cfg.moe_cfg, params["moe"], cls_vec, ep_size, ep_axis,
+                broadcast="region_end",
+            )
+        else:
+            moe_out, aux = moe_ffn(cfg.moe_cfg, params["moe"], cls_vec)
+        cls_vec = cls_vec + moe_out  # residual: dropped tokens pass through
 
     graph_vec = None
     if cfg.use_graph:
@@ -207,4 +251,7 @@ def forward(
         graph_vec = graph_enc.apply(params["graph"], graph_batch)  # [B, 8H]
         if has_graph is not None:
             graph_vec = graph_vec * has_graph[:, None].astype(graph_vec.dtype)
-    return head_logits(cfg, params["head"], cls_vec, graph_vec, k_head)
+    logits = head_logits(cfg, params["head"], cls_vec, graph_vec, k_head)
+    if with_aux:
+        return logits, aux
+    return logits

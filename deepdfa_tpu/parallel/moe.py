@@ -108,6 +108,79 @@ def moe_ffn(cfg: MoEConfig, params: dict, x: jax.Array,
     return out, aux
 
 
+def moe_stage_forward(
+    cfg: MoEConfig,
+    local_params: dict,
+    x: jax.Array,
+    n_dev: int,
+    ep_axis: str = "ep",
+    broadcast: str = "psum",
+):
+    """One device's share of the expert-parallel MoE, INSIDE shard_map.
+
+    local_params holds this device's expert block ([E/ep, ...]) plus the
+    replicated router; x is the full local token batch (replicated across
+    `ep_axis`). Routing is computed identically on every device (one
+    [N,D]x[D,E] matmul — cheap), each device evaluates only its expert
+    slice, and the output broadcast assembles the disjoint contributions:
+    "psum" when the loss lives outside the shard_map, "region_end"
+    (psum-forward/identity-backward) when every rank computes its own
+    loss copy inside it (see parallel/pipeline.py for the same trap).
+    """
+    rank = jax.lax.axis_index(ep_axis)
+    e_local = cfg.num_experts // n_dev
+    cap = capacity(cfg, x.shape[0])
+    if broadcast == "region_end":
+        # Megatron f/g pairing: under per-rank loss copies each rank's
+        # backward only carries its own expert slice's contribution to
+        # d(loss)/dx, so the x entering the region must psum its
+        # cotangent over ep (identity forward). Without this the
+        # encoder upstream receives a per-rank partial gradient that no
+        # dp/sp reduction ever fixes.
+        from deepdfa_tpu.parallel.megatron import region_start
+
+        x = region_start(x, ep_axis)
+    dispatch, combine, aux = _route(cfg, local_params["router"], x, cap)
+    lo = rank * e_local
+    disp_l = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, 1)
+    comb_l = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
+    out = _expert_compute(
+        local_params["w1"], local_params["b1"],
+        local_params["w2"], local_params["b2"],
+        disp_l, comb_l, x,
+    )
+    if broadcast == "psum":
+        out = jax.lax.psum(out, ep_axis)
+    elif broadcast == "region_end":
+        from deepdfa_tpu.parallel.megatron import region_end
+
+        out = region_end(out, ep_axis)
+        # router grad bookkeeping under per-rank loss copies: the main
+        # path's router cotangent is PARTIAL per rank (each rank only
+        # differentiates through its own expert block), so the trainer
+        # psums the router over ep — but the aux term's router cotangent
+        # is full on every rank and would double-count. Routing aux
+        # through a rank-0 region_end keeps every rank's loss copy
+        # identical (psum forward) while exactly one cotangent flows
+        # back (identity backward), making the ep psum exact for both.
+        aux = region_end(
+            jnp.where(rank == 0, aux, jnp.zeros_like(aux)), ep_axis
+        )
+    else:
+        raise ValueError(f"broadcast={broadcast!r}")
+    return out, aux
+
+
+def moe_param_specs(ep_axis: str = "ep") -> dict:
+    """PartitionSpecs for an MoE param tree: experts shard their leading
+    axis over `ep_axis`, the router replicates."""
+    return {
+        "router": P(),
+        "w1": P(ep_axis), "b1": P(ep_axis),
+        "w2": P(ep_axis), "b2": P(ep_axis),
+    }
+
+
 def moe_ffn_ep(cfg: MoEConfig, params: dict, x: jax.Array, mesh,
                ep_axis: str = "ep"):
     """Expert-parallel MoE: experts shard over `ep_axis`, tokens stay
@@ -124,27 +197,13 @@ def moe_ffn_ep(cfg: MoEConfig, params: dict, x: jax.Array, mesh,
         raise ValueError(
             f"{cfg.num_experts} experts not divisible by ep={n_dev}"
         )
-    cap = capacity(cfg, x.shape[0])
-    e_local = cfg.num_experts // n_dev
 
     def body(pr, x_rep):
-        rank = jax.lax.axis_index(ep_axis)
-        # full routing (cheap: one [N,D]x[D,E] matmul) so slot positions
-        # and gates are computed identically on every device
-        dispatch, combine, aux = _route(cfg, pr["router"], x_rep, cap)
-        lo = rank * e_local
-        disp_l = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, 1)
-        comb_l = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
-        out = _expert_compute(
-            pr["w1"], pr["b1"], pr["w2"], pr["b2"], disp_l, comb_l, x_rep
+        return moe_stage_forward(
+            cfg, pr, x_rep, n_dev, ep_axis, broadcast="psum"
         )
-        return jax.lax.psum(out, ep_axis), aux
 
-    specs = {
-        "router": P(),
-        "w1": P(ep_axis), "b1": P(ep_axis),
-        "w2": P(ep_axis), "b2": P(ep_axis),
-    }
+    specs = moe_param_specs(ep_axis)
     return shard_map(
         body,
         mesh=mesh,

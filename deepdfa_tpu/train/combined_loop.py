@@ -121,6 +121,19 @@ class CombinedTrainer:
                 f"{model_cfg.encoder.num_layers} encoder layers not "
                 f"divisible by pp={self.pp_size} stages"
             )
+        self.ep_size = self.mesh.shape.get("ep", 1)
+        self.moe = bool(getattr(model_cfg, "moe_experts", 0))
+        self.ep = self.ep_size > 1
+        if self.ep and not self.moe:
+            raise ValueError(
+                "an ep>1 mesh needs an MoE block to shard "
+                "(set model moe_experts)"
+            )
+        if self.moe and model_cfg.moe_experts % self.ep_size:
+            raise ValueError(
+                f"{model_cfg.moe_experts} experts not divisible by "
+                f"ep={self.ep_size}"
+            )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         if freeze_graph:
             # reference --freeze_graph: the pretrained GGNN stays fixed
@@ -183,6 +196,12 @@ class CombinedTrainer:
         specs = {"encoder": enc_specs, "head": rep(example["head"])}
         if "graph" in example:
             specs["graph"] = rep(example["graph"])
+        if "moe" in example:
+            from deepdfa_tpu.parallel.moe import moe_param_specs
+
+            specs["moe"] = (
+                moe_param_specs() if self.ep else rep(example["moe"])
+            )
         self.param_specs = specs
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
@@ -195,6 +214,9 @@ class CombinedTrainer:
             "encoder": ("dp", "sp"),
             "head": ("dp",),
             "graph": ("dp",),
+            # moe: router replicated-true across ep, expert blocks
+            # ep-sharded local-true -> dp reduction only (class docstring)
+            "moe": ("dp",),
         }
 
     def _batch_specs(self, num_graphs: int) -> TextBatch:
@@ -247,11 +269,12 @@ class CombinedTrainer:
     # -- compiled steps ------------------------------------------------------
 
     def _forward(self, params, local: TextBatch, key):
+        """(logits, moe_aux) — aux is 0.0 for architectures without MoE."""
         tp_axis = "tp" if self.tp else None
         if self.is_t5:
             from deepdfa_tpu.models import t5 as t5m
 
-            return t5m.defect_forward(
+            logits = t5m.defect_forward(
                 self.model_cfg,
                 params,
                 local.input_ids,
@@ -261,6 +284,7 @@ class CombinedTrainer:
                 tp_axis=tp_axis,
                 sp_axis="sp" if self.sp else None,
             )
+            return logits, jnp.zeros((), jnp.float32)
         sp_axis = "sp" if self.sp else None
         offset = (
             jax.lax.axis_index("sp") * local.input_ids.shape[1] if self.sp else 0
@@ -278,15 +302,24 @@ class CombinedTrainer:
             pp_axis="pp" if self.pp else None,
             pp_stages=self.pp_size,
             pp_microbatches=self.pp_microbatches,
+            ep_axis="ep" if self.ep else None,
+            ep_size=self.ep_size,
+            with_aux=True,
         )
 
     def _loss_sum(self, params, local: TextBatch, key):
-        logits = self._forward(params, local, key)
+        logits, aux = self._forward(params, local, key)
         per = optax.softmax_cross_entropy_with_integer_labels(
             logits, local.labels
         )
         m = local.row_mask.astype(per.dtype)
-        return (per * m).sum(), (m.sum(), logits)
+        loss = (per * m).sum()
+        if self.moe:
+            # load-balancing term scales with the row count so the
+            # per-example normalization downstream leaves its weight
+            # constant across batch sizes
+            loss = loss + self.model_cfg.moe_aux_weight * aux * m.sum()
+        return loss, (m.sum(), logits)
 
     def _build_steps(self) -> None:
         self._step_cache: dict[int, tuple] = {}
@@ -306,6 +339,7 @@ class CombinedTrainer:
         mesh = self.mesh
         grad_axes = self._grad_axes
         pp = self.pp
+        ep = self.ep
         batch_specs = self._batch_specs(num_graphs)
 
         @partial(
@@ -342,6 +376,19 @@ class CombinedTrainer:
                         "layers": reduce(sub["layers"], ("dp",)),
                         "embeddings": reduce(sub["embeddings"], ("dp", "pp")),
                     }
+                elif group == "moe" and ep:
+                    # ep splits the moe block: expert slices are
+                    # local-true; router grads are per-rank partial on the
+                    # main path and rank-0-only on the aux path (the
+                    # region_end in moe_stage_forward) -> ep psum is exact
+                    out[group] = {
+                        "router": reduce(sub["router"], ("dp", "ep")),
+                        **{
+                            k: reduce(v, ("dp",))
+                            for k, v in sub.items()
+                            if k != "router"
+                        },
+                    }
                 else:
                     out[group] = reduce(sub, grad_axes[group])
             return loss, out
@@ -365,7 +412,7 @@ class CombinedTrainer:
         )
         def _sharded_eval(params, batch):
             local = _squeeze_batch(batch)
-            logits = self._forward(params, local, None)
+            logits, _ = self._forward(params, local, None)
             per = optax.softmax_cross_entropy_with_integer_labels(
                 logits, local.labels
             )

@@ -156,3 +156,31 @@ def test_end_to_end_training_cfg_dep_n_etypes():
         state, loss = trainer.train_step(state, batch)
         losses.append(float(jax.device_get(loss)))
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_pdg_gtype_single_relation():
+    """gtype="pdg": dependence edges merged into one untyped relation
+    (reference rdg("pdg"), joern.py:419-441)."""
+    code = """
+int f(int a) {
+  int x = a + 1;
+  int y = 0;
+  if (x > 2) {
+    y = x * 3;
+  }
+  return y;
+}
+"""
+    from deepdfa_tpu.data.pipeline import extract_graph
+
+    pdg = extract_graph(code, 0, gtype="pdg")
+    typed = extract_graph(code, 0, gtype="cfg+dep")
+    assert pdg.edge_type is None  # single relation
+    # pdg edge set == the dependence (type 1/2) edges of cfg+dep
+    dep_edges = {
+        (int(s), int(d))
+        for s, d, t in zip(typed.edge_src, typed.edge_dst, typed.edge_type)
+        if t != 0
+    }
+    got = set(zip(pdg.edge_src.tolist(), pdg.edge_dst.tolist()))
+    assert got == dep_edges and got
