@@ -654,26 +654,16 @@ def cmd_train_combined(args) -> None:
     print("best:", ckpts.best_metrics())
 
 
-def cmd_train_gen(args) -> None:
-    """Seq2seq generation training (reference: CodeT5/run_gen.py main()).
-
-    Reads task files in the reference formats (data/gen_data.py), trains
-    the T5 seq2seq stack with dp sharding, evaluates dev ppl (+BLEU/EM
-    with --do-eval-bleu), keeps best-ppl / best-bleu checkpoints, and with
-    --do-test writes test_best-ppl.output / .gold prediction files
-    (run_gen.py:eval_bleu_epoch file layout)."""
-    import numpy as np
-
-    from deepdfa_tpu.data import gen_data
+def _gen_setup(args, cfg):
+    """Shared train-gen / train-multi-gen preamble: tokenizer selection,
+    GenConfig (tiny or full T5), mesh-sharded GenTrainer, and a fresh or
+    --pretrained-initialized state. Returns (tok, gcfg, trainer, state,
+    dp, rows)."""
     from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
     from deepdfa_tpu.models import t5 as t5m
     from deepdfa_tpu.models import t5_gen as genm
     from deepdfa_tpu.parallel import make_mesh
     from deepdfa_tpu.train.gen_loop import GenTrainer
-
-    cfg = _load_config(args)
-    run_dir = paths.runs_dir(cfg.run_name)
-    reader = gen_data.READERS[args.task]
 
     if args.tokenizer == "bpe":
         tok = BpeTokenizer(args.vocab_file, args.merges_file)
@@ -685,29 +675,14 @@ def cmd_train_gen(args) -> None:
         pad_token_id=tok.pad_id,
         eos_token_id=tok.sep_id,
     )
-    if args.tiny:
-        enc_cfg = t5m.T5Config.tiny(**enc_kw)
-    else:
-        enc_cfg = t5m.T5Config(**enc_kw)
+    enc_cfg = (
+        t5m.T5Config.tiny(**enc_kw) if args.tiny else t5m.T5Config(**enc_kw)
+    )
     gcfg = genm.GenConfig(
         encoder=enc_cfg,
         max_target_length=args.max_target_length,
         beam_size=args.beam_size,
     )
-
-    def load(filename):
-        ex = reader(filename, args.data_num)
-        # task prefix, reference convert_examples_to_features
-        # (_utils.py:24-29): "<task>: <source>" for the t5 family
-        src = tok.batch_encode(
-            [f"{args.task}: {e.source}" for e in ex],
-            max_length=args.max_source_length,
-        )
-        tgt = tok.batch_encode(
-            [e.target for e in ex], max_length=args.max_target_length
-        )
-        return ex, src.astype(np.int32), tgt.astype(np.int32)
-
     mesh = make_mesh(cfg.train.mesh)
     dp = mesh.shape.get("dp", 1)
     rows = max(1, args.batch_size // dp)
@@ -720,6 +695,47 @@ def cmd_train_gen(args) -> None:
         state = trainer.load_params(
             state, genm.gen_params_from_hf_torch(gcfg, sd)
         )
+    return tok, gcfg, trainer, state, dp, rows
+
+
+def _gen_encode_file(args, tok, task_name, filename, max_target_length=None):
+    """Read one task file and encode with the reference's task prefix
+    ("<family>: <source>", _utils.py:24-29). Returns (examples, src, tgt)."""
+    import numpy as np
+
+    from deepdfa_tpu.data import gen_data
+
+    family = task_name.split("_")[0]
+    reader = gen_data.READERS.get(family, gen_data.READERS["summarize"])
+    ex = reader(filename, args.data_num)
+    src = tok.batch_encode(
+        [f"{family}: {e.source}" for e in ex],
+        max_length=args.max_source_length,
+    )
+    tgt = tok.batch_encode(
+        [e.target for e in ex],
+        max_length=max_target_length or args.max_target_length,
+    )
+    return ex, src.astype(np.int32), tgt.astype(np.int32)
+
+
+def cmd_train_gen(args) -> None:
+    """Seq2seq generation training (reference: CodeT5/run_gen.py main()).
+
+    Reads task files in the reference formats (data/gen_data.py), trains
+    the T5 seq2seq stack with dp sharding, evaluates dev ppl (+BLEU/EM
+    with --do-eval-bleu), keeps best-ppl / best-bleu checkpoints, and with
+    --do-test writes test_best-ppl.output / .gold prediction files
+    (run_gen.py:eval_bleu_epoch file layout)."""
+    from deepdfa_tpu.data import gen_data
+    from deepdfa_tpu.models import t5_gen as genm
+
+    cfg = _load_config(args)
+    run_dir = paths.runs_dir(cfg.run_name)
+    tok, gcfg, trainer, state, dp, rows = _gen_setup(args, cfg)
+
+    def load(filename):
+        return _gen_encode_file(args, tok, args.task, filename)
 
     if args.train_file:
         _, train_src, train_tgt = load(args.train_file)
@@ -785,6 +801,86 @@ def cmd_train_gen(args) -> None:
                 f_out.write(f"{e.idx}\t{' '.join(map(str, p))}\n")
                 f_gold.write(f"{e.idx}\t{' '.join(map(str, r))}\n")
         print(json.dumps({"test_em": scores["em"], "test_bleu": scores["bleu"]}))
+
+
+def cmd_train_multi_gen(args) -> None:
+    """Multi-task generation training (reference: CodeT5/run_multi_gen.py).
+
+    --task-spec name=train_file[:dev_file], repeatable. The name's
+    "<family>_<subtask>" prefix selects the reader, the per-family
+    early-stop patience (run_multi_gen.py:253-266), and the per-family
+    target length (:52-67). One model/tokenizer is shared by every task;
+    each step samples a task with size^0.7-tempered probability."""
+    from deepdfa_tpu.data import gen_data
+    from deepdfa_tpu.models import t5_gen as genm
+    from deepdfa_tpu.train.multi_gen import (
+        GenTask,
+        fit_multi,
+        task_target_length,
+    )
+
+    cfg = _load_config(args)
+    run_dir = paths.runs_dir(cfg.run_name)
+
+    specs: list[tuple[str, str, str | None]] = []
+    for spec in args.task_spec:
+        name, _, files = spec.partition("=")
+        if not files:
+            raise SystemExit(f"--task-spec {spec!r}: expected name=train[:dev]")
+        train_file, _, dev_file = files.partition(":")
+        specs.append((name, train_file, dev_file or None))
+
+    tok, gcfg, trainer, state, dp, rows = _gen_setup(args, cfg)
+
+    def load(name, filename):
+        _, src, tgt = _gen_encode_file(
+            args, tok, name, filename,
+            max_target_length=min(
+                args.max_target_length, task_target_length(name)
+            ),
+        )
+        return src, tgt
+
+    tasks = []
+    for name, train_file, dev_file in specs:
+        src, tgt = load(name, train_file)
+
+        def factory(epoch, _src=src, _tgt=tgt):
+            return gen_data.batches_of(
+                _src, _tgt, dp, rows, pad_id=tok.pad_id,
+                shuffle_seed=cfg.train.seed + epoch,
+            )
+
+        val_batches = val_decode = None
+        if dev_file:
+            dsrc, dtgt = load(name, dev_file)
+            dev = gen_data.batches_of(dsrc, dtgt, dp, rows, pad_id=tok.pad_id)
+            val_batches = lambda _dev=dev: _dev  # noqa: E731
+            if args.do_eval_bleu:
+                val_decode = (
+                    dsrc, genm.trim_at_eos(dtgt, tok.sep_id, tok.pad_id)
+                )
+        tasks.append(
+            GenTask(
+                name, factory, size=src.shape[0],
+                val_batches=val_batches, val_decode=val_decode,
+            )
+        )
+
+    def checkpoints(task_name, monitor, mode):
+        return trainer.make_checkpoints(
+            run_dir / f"checkpoints-multi-{task_name}",
+            monitor=monitor, mode=mode,
+        )
+
+    state, summary = fit_multi(
+        trainer, state, tasks,
+        max_steps=args.max_steps,
+        eval_every=args.eval_every,
+        checkpoints=checkpoints,
+        seed=cfg.train.seed,
+    )
+    print(json.dumps({"tasks": summary}, default=float))
 
 
 def cmd_train_clone(args) -> None:
@@ -1178,6 +1274,30 @@ def main(argv=None) -> None:
                    help="HF torch T5ForConditionalGeneration state_dict")
     _add_common(p)
     p.set_defaults(fn=cmd_train_gen)
+
+    p = sub.add_parser("train-multi-gen")
+    p.add_argument("--task-spec", action="append", required=True,
+                   help="name=train_file[:dev_file]; name's <family>_* "
+                        "prefix picks reader/patience/target-length "
+                        "(repeatable)")
+    p.add_argument("--max-steps", type=int, default=1000)
+    p.add_argument("--eval-every", type=int, default=None)
+    p.add_argument("--data-num", type=int, default=-1)
+    p.add_argument("--max-source-length", type=int, default=256)
+    p.add_argument("--max-target-length", type=int, default=128)
+    p.add_argument("--beam-size", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--do-eval-bleu", action="store_true")
+    p.add_argument("--tiny", action="store_true",
+                   help="tiny T5 config (tests/smoke)")
+    p.add_argument("--tokenizer", choices=("hash", "bpe"), default="hash")
+    p.add_argument("--vocab-size", type=int, default=4096)
+    p.add_argument("--vocab-file", default=None)
+    p.add_argument("--merges-file", default=None)
+    p.add_argument("--pretrained", default=None,
+                   help="HF torch T5ForConditionalGeneration state_dict")
+    _add_common(p)
+    p.set_defaults(fn=cmd_train_multi_gen)
 
     # no _add_common here: positional overrides would be swallowed by the
     # nargs='*' flags — per-run config overrides go through --override

@@ -1,0 +1,256 @@
+"""Multi-task seq2seq training — the run_multi_gen role.
+
+Reference semantics (CodeT5/run_multi_gen.py):
+- ONE model trains across several generation tasks; every step samples a
+  task with probability proportional to |task|^0.7 (the size-tempered
+  mixture, run_multi_gen.py:270-273) and takes one batch from that
+  task's cycled stream (:226,:280-291).
+- Per-task patience comes from a task-family table (summarize 2,
+  translate 5, refine 5, concode 3, defect 2 — :253-266).
+- At every eval interval each live task computes dev perplexity (and
+  optionally BLEU/EM); a task early-stops when BOTH its ppl counter and
+  its bleu counter exceed its patience (same dual-counter rule as
+  run_gen.py:398-405, here per task). When sampling keeps landing on
+  stopped tasks (>50 consecutive draws) the whole run ends (:279-287).
+
+TPU-first differences from the reference:
+- The compiled dp-sharded train/eval steps of one `GenTrainer` are
+  shared by all tasks; tasks with different (batch, source, target)
+  shapes simply hit distinct jit signatures, each compiled once. No
+  per-task model copies, no host-side scatter.
+- The reference cycles each task through `itertools.cycle(DataLoader)`,
+  which freezes the first epoch's shuffle order for the rest of the
+  run; here each pass re-invokes the task's batch factory with a fresh
+  epoch index, so shuffling stays honest.
+- Task sampling uses a seeded `np.random.Generator` on the host — the
+  schedule is reproducible and independent of device PRNG.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Iterable, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from deepdfa_tpu.data.gen_data import GenBatch
+from deepdfa_tpu.train.gen_loop import GenTrainer
+from deepdfa_tpu.train.state import TrainState
+
+logger = logging.getLogger(__name__)
+
+#: per-task-family early-stop patience (run_multi_gen.py:253-266)
+TASK_PATIENCE = {
+    "summarize": 2,
+    "translate": 5,
+    "refine": 5,
+    "concode": 3,
+    "defect": 2,
+}
+
+#: consecutive draws of stopped tasks before the whole run ends (:285)
+_STOP_DRAWS = 50
+
+
+def task_target_length(name: str, default: int = 128) -> int:
+    """Per-task-family decode length (run_multi_gen.py:52-67); task
+    names follow the reference's "<family>_<subtask>" convention."""
+    family = name.split("_")[0]
+    sub = name.split("_")[-1]
+    return {
+        "summarize": 128,
+        "translate": 256,
+        "refine": 120 if sub == "small" else 240,
+        "concode": 150,
+        "defect": 3,
+    }.get(family, default)
+
+
+@dataclasses.dataclass
+class GenTask:
+    """One task in the mixture.
+
+    train_batches(epoch) yields that pass's GenBatch stream (re-invoked
+    with an incremented epoch each time the stream is exhausted);
+    `size` is the example count driving the mixture weight.
+    """
+
+    name: str
+    train_batches: Callable[[int], Iterable[GenBatch]]
+    size: int
+    val_batches: Callable[[], Iterable[GenBatch]] | None = None
+    val_decode: tuple[np.ndarray, Sequence[Sequence[int]]] | None = None
+    patience: int | None = None  # default: TASK_PATIENCE by name prefix
+
+    def resolved_patience(self) -> int:
+        if self.patience is not None:
+            return self.patience
+        return TASK_PATIENCE.get(self.name.split("_")[0], 2)
+
+
+def mixture_probs(sizes: Sequence[int], alpha: float = 0.7) -> np.ndarray:
+    """Size-tempered task mixture: normalize, raise to alpha, renormalize
+    (run_multi_gen.py:270-273)."""
+    p = np.asarray(sizes, np.float64)
+    p = p / p.sum()
+    p = p**alpha
+    return p / p.sum()
+
+
+def _cycled(task: GenTask) -> Iterator[GenBatch]:
+    epoch = 0
+    while True:
+        it = iter(task.train_batches(epoch))
+        got = False
+        for batch in it:
+            got = True
+            yield batch
+        if not got:
+            raise ValueError(f"task {task.name!r} produced no batches")
+        epoch += 1
+
+
+@dataclasses.dataclass
+class _TaskBook:
+    """Per-task early-stop bookkeeping."""
+
+    best_ppl: float = float("inf")
+    best_bleu_em: float = -1.0
+    not_ppl_dec: int = 0
+    not_bleu_inc: float = 0  # stays inf when bleu eval is off
+    stopped: bool = False
+    stopped_at: int | None = None
+
+
+def fit_multi(
+    trainer: GenTrainer,
+    state: TrainState,
+    tasks: Sequence[GenTask],
+    max_steps: int,
+    eval_every: int | None = None,
+    checkpoints: Callable[[str, str, str], object] | None = None,
+    seed: int = 0,
+    log_fn: Callable[[dict], None] | None = None,
+) -> tuple[TrainState, dict[str, dict]]:
+    """Train one model over the task mixture; returns (state, summary).
+
+    checkpoints(task_name, monitor, mode) -> a CheckpointManager-like
+    object; called lazily per task for best-ppl (and best-bleu when the
+    task evaluates BLEU) checkpoints. eval_every defaults to one eval
+    per ~mixture epoch (total batches across tasks).
+    """
+    assert tasks, "need at least one task"
+    names = [t.name for t in tasks]
+    assert len(set(names)) == len(names), f"duplicate task names: {names}"
+    probs = mixture_probs([t.size for t in tasks])
+    streams = {t.name: _cycled(t) for t in tasks}
+    books = {t.name: _TaskBook() for t in tasks}
+    for t in tasks:
+        if t.val_decode is None:
+            books[t.name].not_bleu_inc = float("inf")
+    ppl_ckpt: dict[str, object] = {}
+    bleu_ckpt: dict[str, object] = {}
+    if eval_every is None:
+        eval_every = max(1, sum(max(1, t.size) for t in tasks) // 8)
+
+    rng = np.random.default_rng(seed)
+    root = jax.random.key(seed)
+    step = int(jax.device_get(state.step))
+    t0 = time.perf_counter()
+    losses: list = []
+    skip_draws = 0
+    while step < max_steps:
+        task = tasks[int(rng.choice(len(tasks), p=probs))]
+        book = books[task.name]
+        if book.stopped:
+            skip_draws += 1
+            if skip_draws > _STOP_DRAWS:
+                logger.info("all tasks early-stopped at step %d", step)
+                break
+            continue
+        skip_draws = 0
+
+        batch = next(streams[task.name])
+        state, loss = trainer.train_step(
+            state, batch, jax.random.fold_in(root, step)
+        )
+        losses.append(loss)
+        step += 1
+
+        if step % eval_every and step < max_steps:
+            continue
+
+        record: dict = {
+            "step": step,
+            "train_loss": float(np.mean(jax.device_get(losses))),
+            "window_seconds": time.perf_counter() - t0,
+        }
+        losses, t0 = [], time.perf_counter()
+        for t in tasks:
+            b = books[t.name]
+            if b.stopped or t.val_batches is None:
+                continue
+            ppl = trainer.eval_ppl(state, t.val_batches())
+            record[f"{t.name}/val_ppl"] = ppl
+            if ppl < b.best_ppl:
+                b.best_ppl, b.not_ppl_dec = ppl, 0
+                if checkpoints is not None:
+                    mgr = ppl_ckpt.setdefault(
+                        t.name, checkpoints(t.name, "val_ppl", "min")
+                    )
+                    mgr.save(
+                        f"step-{step:07d}", jax.device_get(state.params),
+                        {"val_ppl": ppl}, step=step,
+                    )
+            else:
+                b.not_ppl_dec += 1
+            if t.val_decode is not None:
+                src, refs = t.val_decode
+                scores = trainer.eval_bleu_em(state, src, refs)
+                record[f"{t.name}/val_bleu_em"] = scores["bleu_em"]
+                if scores["bleu_em"] > b.best_bleu_em:
+                    b.best_bleu_em, b.not_bleu_inc = scores["bleu_em"], 0
+                    if checkpoints is not None:
+                        mgr = bleu_ckpt.setdefault(
+                            t.name,
+                            checkpoints(t.name + "-bleu", "val_bleu_em", "max"),
+                        )
+                        mgr.save(
+                            f"step-{step:07d}", jax.device_get(state.params),
+                            {"val_bleu_em": scores["bleu_em"]}, step=step,
+                        )
+                else:
+                    b.not_bleu_inc += 1
+            patience = t.resolved_patience()
+            if (
+                patience
+                and b.not_ppl_dec > patience
+                and b.not_bleu_inc > patience
+            ):
+                b.stopped, b.stopped_at = True, step
+                logger.info(
+                    "task %s early-stopped at step %d "
+                    "(ppl counter %d, bleu counter %s)",
+                    t.name, step, b.not_ppl_dec, b.not_bleu_inc,
+                )
+        logger.info("step %d: %s", step, record)
+        if log_fn is not None:
+            log_fn(record)
+        if all(
+            books[t.name].stopped for t in tasks if t.val_batches is not None
+        ) and any(t.val_batches is not None for t in tasks):
+            logger.info("every evaluated task early-stopped; ending run")
+            break
+
+    summary = {
+        name: {
+            "best_ppl": None if np.isinf(b.best_ppl) else b.best_ppl,
+            "best_bleu_em": None if b.best_bleu_em < 0 else b.best_bleu_em,
+            "stopped_at": b.stopped_at,
+        }
+        for name, b in books.items()
+    }
+    return state, summary

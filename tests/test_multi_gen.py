@@ -1,0 +1,129 @@
+"""Multi-task generation training (run_multi_gen role, train/multi_gen.py).
+
+One GenTrainer state trains over a two-task mixture (copy + reverse);
+both tasks' dev perplexity must improve, the mixture must visit both
+tasks, and the per-task dual-counter early stop must end the run.
+"""
+
+import numpy as np
+
+from deepdfa_tpu.core import Config, MeshConfig
+from deepdfa_tpu.core.config import apply_overrides
+from deepdfa_tpu.data import gen_data
+from deepdfa_tpu.models import t5 as t5m
+from deepdfa_tpu.models import t5_gen as gen
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train.gen_loop import GenTrainer
+from deepdfa_tpu.train.multi_gen import (
+    GenTask,
+    TASK_PATIENCE,
+    fit_multi,
+    mixture_probs,
+)
+
+EOS, PAD = 2, 0
+
+
+def _task(rng, n, reverse, src_len=10, tgt_len=8):
+    src = np.zeros((n, src_len), np.int32)
+    tgt = np.zeros((n, tgt_len), np.int32)
+    for i in range(n):
+        L = rng.integers(3, tgt_len - 1)
+        toks = rng.integers(3, 20, L)
+        src[i, :L] = toks
+        src[i, L] = EOS
+        out = toks[::-1] if reverse else toks
+        tgt[i, :L] = out
+        tgt[i, L] = EOS
+    return src, tgt
+
+
+def test_mixture_probs_tempering():
+    p = mixture_probs([100, 1])
+    # alpha=0.7 tempering lifts the small task above its raw share
+    assert p[1] > 1 / 101
+    assert abs(p.sum() - 1.0) < 1e-12
+    assert p[0] > p[1]
+
+
+def test_patience_table_matches_reference():
+    # run_multi_gen.py:253-266
+    assert TASK_PATIENCE == {
+        "summarize": 2, "translate": 5, "refine": 5, "concode": 3,
+        "defect": 2,
+    }
+    assert GenTask("summarize_python", lambda e: [], 1).resolved_patience() == 2
+    assert GenTask("translate_java-cs", lambda e: [], 1).resolved_patience() == 5
+    assert GenTask("unknown", lambda e: [], 1, patience=7).resolved_patience() == 7
+
+
+def test_two_task_mixture_trains_and_early_stops():
+    import jax
+
+    rng = np.random.default_rng(0)
+    copy_src, copy_tgt = _task(rng, 24, reverse=False)
+    rev_src, rev_tgt = _task(rng, 12, reverse=True)
+    cfg = apply_overrides(
+        Config(),
+        ["train.optim.name=adamw", "train.optim.learning_rate=0.01",
+         "train.optim.warmup_frac=0.0"],
+    )
+    gcfg = gen.GenConfig(
+        encoder=t5m.T5Config.tiny(vocab_size=32, remat=False, dropout_rate=0.0),
+        max_target_length=8,
+        beam_size=2,
+    )
+    mesh = make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    trainer = GenTrainer(cfg, gcfg, mesh=mesh)
+    state = trainer.init_state(seed=0)
+
+    def batches(src, tgt):
+        def factory(epoch):
+            return gen_data.batches_of(
+                src, tgt, num_shards=2, rows_per_shard=12,
+                shuffle_seed=epoch,
+            )
+
+        return factory
+
+    visits: list[str] = []
+
+    def spying(factory, name):
+        def wrapped(epoch):
+            visits.append(name)
+            return factory(epoch)
+
+        return wrapped
+
+    copy_val = gen_data.batches_of(copy_src, copy_tgt, 2, 12)
+    rev_val = gen_data.batches_of(rev_src, rev_tgt, 2, 6)
+    tasks = [
+        GenTask(
+            "copy", spying(batches(copy_src, copy_tgt), "copy"), size=24,
+            val_batches=lambda: copy_val, patience=1,
+        ),
+        GenTask(
+            "reverse", spying(batches(rev_src, rev_tgt), "reverse"), size=12,
+            val_batches=lambda: rev_val, patience=1,
+        ),
+    ]
+    ppl0 = {
+        "copy": trainer.eval_ppl(state, copy_val),
+        "reverse": trainer.eval_ppl(state, rev_val),
+    }
+    records: list[dict] = []
+    state, summary = fit_multi(
+        trainer, state, tasks, max_steps=400, eval_every=25, seed=0,
+        log_fn=records.append,
+    )
+    # both tasks were sampled (24:12 sizes -> both have real mass)
+    assert set(visits) == {"copy", "reverse"}
+    # both improved on their own dev sets from one shared model
+    for name in ("copy", "reverse"):
+        assert summary[name]["best_ppl"] < ppl0[name] / 2, (
+            name, summary[name], ppl0[name],
+        )
+    # with patience=1 on an overfittable task the dual-counter stop fires
+    # well before max_steps (400 draws), ending the whole run
+    assert all(s["stopped_at"] is not None for s in summary.values()), summary
+    assert records and records[-1]["step"] < 400
