@@ -69,7 +69,15 @@ def read_bigvul(
     row index as example id."""
     df = _read_with_ids(csv_path, ("func_before", "func_after", "vul"))
     if sample:
-        df = df.head(sample)
+        # stratified sample-mode corpus (sample_MSR_data.py:6-16: equal
+        # seeded draws per class — head() on a ~6%-vul dataset would
+        # yield almost no positives)
+        per_class = max(1, sample // 2)
+        parts = [
+            g.sample(min(per_class, len(g)), random_state=0)
+            for _, g in df.groupby(df.vul != 0)
+        ]
+        df = pd.concat(parts)
     out: list[Example] = []
     for row in df.itertuples(index=False):
         before = _clean_func(row.func_before)

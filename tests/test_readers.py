@@ -60,6 +60,33 @@ def test_read_bigvul_filters(tmp_path):
     assert "/*" not in by_id[1].code
 
 
+def test_read_bigvul_sample_stratified(tmp_path):
+    """sample=N draws ~N/2 seeded rows PER CLASS (sample_MSR_data.py:6-16)
+    — a head() cut of a ~6%-vul corpus would contain almost no positives."""
+    rows = [
+        {"func_before": f"int f{i}(void)\n{{\nint x = {i};\nreturn x;\n}}",
+         "func_after": f"int f{i}(void)\n{{\nint x = {i};\nreturn x;\n}}",
+         "vul": 0}
+        for i in range(40)
+    ]
+    # positives at the TAIL so head() would miss them entirely
+    rows += [
+        {"func_before": GOOD_VULN.replace("n += 1", f"n += {i}"),
+         "func_after": GOOD_FIXED.replace("n += 1", f"n += {i}"),
+         "vul": 1}
+        for i in range(10)
+    ]
+    p = _bigvul_csv(tmp_path, rows)
+    exs = readers.read_bigvul(p, sample=8)
+    labels = [e.label for e in exs]
+    assert len(exs) == 8
+    assert labels.count(1.0) == 4 and labels.count(0.0) == 4
+    # seeded: same draw every time
+    assert [e.id for e in readers.read_bigvul(p, sample=8)] == [
+        e.id for e in exs
+    ]
+
+
 def test_read_devign(tmp_path):
     p = tmp_path / "function.json"
     p.write_text(
