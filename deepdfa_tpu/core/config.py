@@ -95,6 +95,10 @@ class ModelConfig:
     # edge-relation count for the GGNN (dgl.nn.GatedGraphConv n_etypes);
     # >1 needs typed-edge graphs (pipeline gtype="cfg+dep")
     n_etypes: int = 1
+    # lax.scan the shared-weight GGNN steps instead of unrolling — a
+    # smaller compiled program for compile-time-constrained environments
+    # (numerics pinned to the unrolled form; see nn/gnn.py docstring)
+    scan_steps: bool = False
     num_output_layers: int = 3
     concat_all_absdf: bool = True
     # graph | node | dataflow_solution_in | dataflow_solution_out

@@ -36,6 +36,7 @@ class DeepDFA(nn.Module):
     hidden_dim: int = 32
     n_steps: int = 5
     n_etypes: int = 1
+    scan_steps: bool = False
     num_output_layers: int = 3
     concat_all_absdf: bool = True
     # graph | node | dataflow_solution_in | dataflow_solution_out
@@ -52,6 +53,7 @@ class DeepDFA(nn.Module):
             hidden_dim=cfg.hidden_dim,
             n_steps=cfg.n_steps,
             n_etypes=cfg.n_etypes,
+            scan_steps=cfg.scan_steps,
             num_output_layers=cfg.num_output_layers,
             concat_all_absdf=cfg.concat_all_absdf,
             label_style=cfg.label_style,
@@ -83,6 +85,7 @@ class DeepDFA(nn.Module):
             out_features=width,
             n_steps=self.n_steps,
             n_etypes=self.n_etypes,
+            scan_steps=self.scan_steps,
             param_dtype=self.param_dtype,
             name="ggnn",
         )(batch, feat_embed)

@@ -106,14 +106,26 @@ class GatedGraphConv(nn.Module):
 
     Per step: a_v = sum_{(u,v) in E} W h_u ; h_v = GRU(a_v, h_v).
     Input features narrower than `out_features` are zero-padded, matching
-    DGL's GatedGraphConv. Steps are unrolled under jit (n_steps is 5 in the
-    reference config) so XLA pipelines the gather/matmul chain.
+    DGL's GatedGraphConv.
+
+    Step weights are shared (DGL semantics), so the loop can compile two
+    ways: unrolled (default — XLA pipelines the gather/matmul chain) or
+    `scan_steps=True`, which runs step 1 eagerly (binding the params in
+    this scope) and lax.scan's the rest — a knob for compile-time-
+    constrained environments (the remote TPU compile service wedged on
+    the unrolled flagship train step; measured on CPU the scan trims
+    the train-step StableHLO 156->135 KiB, so program size is a minor
+    factor there, but the loop form is the one structural lever the
+    model has). Same param tree either way; numerics equal to float32
+    fusion tolerance (tests/test_nn_parity.py pins scan == unroll on
+    forward and grads).
     """
 
     out_features: int
     n_steps: int
     n_etypes: int = 1
     param_dtype: jnp.dtype = jnp.float32
+    scan_steps: bool = False
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
@@ -148,8 +160,7 @@ class GatedGraphConv(nn.Module):
         edge_w = batch.edge_mask.astype(feat.dtype)[:, None]
         gru = GRUCell(self.out_features, param_dtype=self.param_dtype)
 
-        h = feat
-        for _ in range(self.n_steps):
+        def step(h):
             a = jnp.zeros((n, self.out_features), feat.dtype)
             for i, linear in enumerate(linears):
                 if self.n_etypes == 1:
@@ -170,7 +181,19 @@ class GatedGraphConv(nn.Module):
                 a = a + segment_sum(
                     msg, batch.edge_dst, n, indices_are_sorted=True
                 )
-            h = gru(a, h)
+            return gru(a, h)
+
+        if self.n_steps == 0:
+            return feat
+        h = step(feat)  # eager first step also binds every param
+        if self.scan_steps and self.n_steps > 1:
+            h, _ = jax.lax.scan(
+                lambda c, _: (step(c), None), h, None,
+                length=self.n_steps - 1,
+            )
+        else:
+            for _ in range(self.n_steps - 1):
+                h = step(h)
         return h
 
 

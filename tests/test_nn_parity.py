@@ -113,6 +113,53 @@ def test_gated_graph_conv_matches_reference(rng):
             off += n
 
 
+def test_gated_graph_conv_scan_matches_unroll(rng):
+    """scan_steps=True is the same function: identical param structure
+    (step 1 runs eagerly in the outer scope) and matching forward/
+    gradients to float32 fusion tolerance — only the compiled program
+    shrinks."""
+    import jax
+    import jax.numpy as jnp
+
+    d = 8
+    graphs = []
+    for gid in range(3):
+        n = int(rng.integers(3, 12))
+        e = int(rng.integers(2, 3 * n))
+        graphs.append(
+            GraphSpec(
+                graph_id=gid,
+                node_feats=rng.integers(0, 5, (n, 4)).astype(np.int32),
+                node_vuln=np.zeros((n,), np.int32),
+                edge_src=rng.integers(0, n, (e,)).astype(np.int32),
+                edge_dst=rng.integers(0, n, (e,)).astype(np.int32),
+                label=0.0,
+            )
+        )
+    batch = pack(graphs, num_graphs=4, node_budget=64, edge_budget=256)
+    feats = rng.standard_normal((64, d)).astype(np.float32)
+
+    unroll = GatedGraphConv(out_features=d, n_steps=5)
+    scan = GatedGraphConv(out_features=d, n_steps=5, scan_steps=True)
+    params = unroll.init(jax.random.key(1), batch, feats)
+    # same param tree is valid for both forms
+    out_u = np.asarray(unroll.apply(params, batch, feats))
+    out_s = np.asarray(scan.apply(params, batch, feats))
+    np.testing.assert_allclose(out_u, out_s, rtol=1e-4, atol=1e-6)
+
+    def loss(fn, p):
+        return jnp.sum(fn.apply(p, batch, feats) ** 2)
+
+    g_u = jax.grad(lambda p: loss(unroll, p))(params)
+    g_s = jax.grad(lambda p: loss(scan, p))(params)
+    for ku, ks in zip(
+        jax.tree.leaves(g_u), jax.tree.leaves(g_s), strict=True
+    ):
+        np.testing.assert_allclose(
+            np.asarray(ku), np.asarray(ks), rtol=1e-4, atol=1e-6
+        )
+
+
 def test_attention_pooling_matches_reference(rng):
     import jax
 
