@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Inference-time + FLOPs profiling (reference
+# LineVul/linevul/scripts/eval_{profiling,inferencetime}_*.sh; the _cpu
+# variants are DEEPDFA_TPU_PLATFORM=cpu here — one knob instead of
+# duplicated scripts).
+# Usage: eval_profiling.sh [--config ...] [overrides]
+#        DEEPDFA_TPU_PLATFORM=cpu eval_profiling.sh   # CPU variant
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m deepdfa_tpu.cli test --profile "$@"
+python bench.py

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Cross-project generalization flow (reference
+# LineVul/linevul/scripts/cross_project_train_{linevul,combined}.sh +
+# cross_project_eval_*.sh; paper Table 7): project-disjoint splits ->
+# preprocess -> train -> test. The project column of the Big-Vul csv
+# drives the split (readers.cross_project_splits).
+# Usage: train_crossproject.sh MSR_data_cleaned.csv [seed] [extra cli args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CSV="${1:?usage: train_crossproject.sh MSR_data_cleaned.csv [seed]}"
+SEED="${2:-0}"
+shift $(( $# >= 2 ? 2 : 1 ))
+
+python -m deepdfa_tpu.cli prepare --source "$CSV" --cross-project \
+    --dep-closure data.seed="$SEED" "$@"
+python -m deepdfa_tpu.cli extract-vocab --workers "$(nproc)" "$@"
+python -m deepdfa_tpu.cli extract --workers "$(nproc)" "$@"
+python -m deepdfa_tpu.cli train --config configs/bigvul_deepdfa.json \
+    train.seed="$SEED" "$@"
+python -m deepdfa_tpu.cli test --config configs/bigvul_deepdfa.json --export "$@"
