@@ -50,6 +50,10 @@ class TransformerConfig:
     layer_norm_eps: float = 1e-5
     dropout_rate: float = 0.1
     dtype: str = "float32"  # activation dtype (bfloat16 for big runs)
+    # sequence-parallel attention scheme: "ring" (k/v rotate over ICI,
+    # O(S/P) memory) or "ulysses" (two all-to-alls shard heads — usually
+    # faster at moderate S; needs heads % sp == 0). Both exact.
+    sp_variant: str = "ring"
     remat: bool = True  # rematerialize layer activations in backward
     # (HBM is the bottleneck: without remat, a 12-layer/512-token/bs-32
     # backward stacks ~18GB of attention+FFN temps and exceeds one v5e)
@@ -195,7 +199,14 @@ def encoder_layer(
     k = jnp.einsum("btd,dhk->bhtk", x_in, lp["wk"]) + lp["bk"][:, None, :]
     v = jnp.einsum("btd,dhk->bhtk", x_in, lp["wv"]) + lp["bv"][:, None, :]
 
-    if sp_axis is not None:
+    if sp_axis is not None and cfg.sp_variant == "ulysses":
+        from deepdfa_tpu.parallel.ulysses import ulysses_attention
+
+        ctx = ulysses_attention(
+            q, k, v, attn_mask, axis_name=sp_axis,
+            dropout_rate=cfg.dropout_rate, dropout_key=k3,
+        )
+    elif sp_axis is not None:
         ctx = ring_attention(
             q, k, v, attn_mask, axis_name=sp_axis,
             dropout_rate=cfg.dropout_rate, dropout_key=k3,

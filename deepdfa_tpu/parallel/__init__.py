@@ -20,6 +20,7 @@ from deepdfa_tpu.parallel.pipeline import (
     split_stages,
 )
 from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
+from deepdfa_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "AXES",
@@ -33,6 +34,7 @@ __all__ = [
     "region_start",
     "full_attention",
     "ring_attention",
+    "ulysses_attention",
     "MoEConfig",
     "init_moe_params",
     "moe_ffn",

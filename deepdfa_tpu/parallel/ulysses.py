@@ -1,0 +1,80 @@
+"""Ulysses-style sequence parallelism: all-to-all head sharding, exact.
+
+The second of the two canonical sequence-parallel attention schemes (the
+task's "ring attention or all-to-all" pair; see parallel/ring_attention.py
+for the first). Where the ring keeps queries resident and ROTATES k/v
+blocks P-1 times over ICI, Ulysses REDISTRIBUTES once: an all-to-all
+converts the layout from (all heads, local sequence chunk) to (local head
+slice, full sequence), plain full attention runs locally, and a second
+all-to-all restores the sequence-sharded layout. Exact — no approximation;
+both schemes compute identical attention.
+
+Trade-off (the reason both exist): the ring moves k/v (2 tensors) P-1
+times but needs P sequential steps whose latency hides only if each block
+is compute-heavy; Ulysses moves q/k/v + output once each as two balanced
+all-to-alls, which XLA lowers to single ICI collectives — typically the
+faster choice at moderate sequence lengths, while the ring wins when the
+head count is too small to split or sequence blocks are huge (all-to-all
+materializes the full-S axis per device: O(S) memory vs the ring's
+O(S/P)). Requires heads % sp == 0 (after any tp head-sharding).
+
+No reference counterpart (SURVEY §5.7: the reference caps sequences at
+512 tokens with no sequence parallelism) — this is TPU-first long-context
+capability, selected per encoder via TransformerConfig.sp_variant.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from deepdfa_tpu.parallel.ring_attention import full_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array,
+    axis_name: str = "sp",
+    dropout_rate: float = 0.0,
+    dropout_key: jax.Array | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact attention via two all-to-alls over `axis_name`.
+
+    Shapes (per device, inside shard_map): q,k,v [B, H, T_local, D] with
+    the sequence sharded over the axis; kv_mask [B, T_local] (False =
+    padding). Returns [B, H, T_local, D], same layout as ring_attention.
+    """
+    n_dev = jax.lax.psum(1, axis_name)
+    h = q.shape[1]
+    if h % n_dev:
+        raise ValueError(
+            f"{h} attention heads not divisible by sequence-parallel "
+            f"size {n_dev} (ulysses shards heads; use sp_variant='ring')"
+        )
+
+    def to_heads(x):  # [B, H, T_local, D] -> [B, H/P, S, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
+    # the full-sequence padding mask, assembled from the shards
+    mask_full = jax.lax.all_gather(
+        kv_mask, axis_name, axis=1, tiled=True
+    )  # [B, S]
+    if dropout_key is not None:
+        # heads are disjoint across devices after the all-to-all, so
+        # per-device masks are independent by construction
+        dropout_key = jax.random.fold_in(
+            dropout_key, jax.lax.axis_index(axis_name)
+        )
+    ctx = full_attention(
+        qg, kg, vg, mask_full,
+        dropout_rate=dropout_rate, dropout_key=dropout_key, scale=scale,
+    )
+    # [B, H/P, S, D] -> [B, H, T_local, D]
+    return jax.lax.all_to_all(
+        ctx, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )

@@ -35,18 +35,26 @@ def _setup():
     return token_ids, labels, by_id, mcfg, cfg, n
 
 
-@pytest.mark.parametrize("mesh_cfg", [
-    dict(dp=2, tp=2, sp=2),
-    dict(dp=1, tp=4, sp=2),
-    dict(dp=8, tp=1, sp=1),
-    dict(dp=1, tp=1, sp=8),
-    dict(dp=4, pp=2),
-    dict(dp=2, tp=2, pp=2),
+@pytest.mark.parametrize("mesh_cfg,sp_variant", [
+    (dict(dp=2, tp=2, sp=2), "ring"),
+    (dict(dp=1, tp=4, sp=2), "ring"),
+    (dict(dp=8, tp=1, sp=1), "ring"),
+    (dict(dp=1, tp=1, sp=8), "ring"),
+    (dict(dp=2, tp=2, sp=2), "ulysses"),
+    (dict(dp=2, tp=1, sp=4), "ulysses"),
+    (dict(dp=4, pp=2), "ring"),
+    (dict(dp=2, tp=2, pp=2), "ring"),
 ])
-def test_parallel_matches_single(mesh_cfg):
+def test_parallel_matches_single(mesh_cfg, sp_variant):
+    import dataclasses as dc
+
     import jax
 
     token_ids, labels, by_id, mcfg, cfg, n = _setup()
+    if sp_variant != "ring":
+        mcfg = dc.replace(
+            mcfg, encoder=dc.replace(mcfg.encoder, sp_variant=sp_variant)
+        )
 
     mesh_p = make_mesh(MeshConfig(**mesh_cfg))
     mesh_1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])

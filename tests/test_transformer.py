@@ -96,6 +96,80 @@ def test_ring_attention_matches_full(rng):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)
 
 
+def test_ulysses_attention_matches_full(rng):
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepdfa_tpu.parallel.ring_attention import full_attention
+    from deepdfa_tpu.parallel.ulysses import ulysses_attention
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    b, h, t, d = 2, 4, 32, 16
+    q = rng.standard_normal((b, h, t, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, t, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, t, d)).astype(np.float32)
+    mask = np.ones((b, t), bool)
+    mask[:, -5:] = False
+
+    want = np.asarray(full_attention(q, k, v, mask))
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    uly = shard_map(
+        partial(ulysses_attention, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3 + (P(None, "sp"),),
+        out_specs=P(None, None, "sp", None),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(uly)(q, k, v, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)
+
+
+def test_ulysses_encoder_matches_single(rng):
+    """sp_variant='ulysses' through the whole encoder == single device
+    (the same contract test_sp_encoder_matches_single pins for the ring)."""
+    import dataclasses as dc
+
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    cfg = tfm.TransformerConfig.tiny(dropout_rate=0.0, sp_variant="ulysses")
+    params = tfm.init_params(cfg, jax.random.key(1))
+    t = 32
+    ids = _random_ids(rng, 2, t, cfg.vocab_size, pad_tail=6)
+
+    want = np.asarray(tfm.encode(cfg, params, ids))
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(), params), P(None, "sp")),
+        out_specs=P(None, "sp", None),
+        check_vma=False,
+    )
+    def sp_encode(params, ids):
+        offset = jax.lax.axis_index("sp") * ids.shape[1]
+        mask = ids != cfg.pad_token_id
+        return tfm.encode(
+            cfg, params, ids, attn_mask=mask, sp_axis="sp",
+            position_offset=offset,
+        )
+
+    got = np.asarray(jax.jit(sp_encode)(params, ids))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-4)
+
+
 def _layer_specs():
     return tfm.tp_layer_specs()
 
