@@ -497,12 +497,14 @@ def _combined_setup(args, cfg):
             use_graph=use_graph,
         )
         return tok, enc_cfg, mcfg, t5m.params_from_hf_torch
+    sp_variant = getattr(args, "sp_variant", "ring")
     if args.encoder == "codebert-base":
-        enc_cfg = TransformerConfig(dtype="bfloat16")
+        enc_cfg = TransformerConfig(dtype="bfloat16", sp_variant=sp_variant)
     else:
         enc_cfg = TransformerConfig.tiny(
             vocab_size=tok.vocab_size,
             max_position_embeddings=args.max_length + 4,
+            sp_variant=sp_variant,
         )
     mcfg = cmb.CombinedConfig(
         encoder=enc_cfg,
@@ -1201,6 +1203,9 @@ def main(argv=None) -> None:
     p.add_argument("--tokenizer", default=None,
                    help="dir with vocab.json+merges.txt (default: hash tokenizer)")
     p.add_argument("--max-length", type=int, default=512)
+    p.add_argument("--sp-variant", default="ring", choices=["ring", "ulysses"],
+                   help="sequence-parallel attention scheme on sp>1 "
+                        "meshes (roberta arch; t5 uses ring)")
     p.add_argument("--no-graph", action="store_true")
     p.add_argument("--graph-checkpoint", default=None,
                    help="run name or checkpoints dir of a pretrained "
