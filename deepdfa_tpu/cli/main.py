@@ -115,6 +115,24 @@ def cmd_prepare(args) -> None:
     (out_dir / "splits.json").write_text(
         json.dumps({str(k): v for k, v in splits.items()})
     )
+    if args.export_codet5:
+        # per-split defect jsonl {"idx","code","target"} — the UniXcoder
+        # CodeT5-export hook (unixcoder/linevul_main.py:1400-1424), i.e.
+        # the corpus in the format data/gen_data.py's defect reader and
+        # CodeT5/_utils.py:260-279 consume
+        c5_dir = out_dir / "codet5"
+        c5_dir.mkdir(parents=True, exist_ok=True)
+        names = {"train": "train", "val": "valid", "test": "test"}
+        counts = {}
+        for split, fname in names.items():
+            rows = [e for e in examples if splits.get(e.id) == split]
+            with (c5_dir / f"{fname}.jsonl").open("w") as f:
+                for e in rows:
+                    f.write(json.dumps({
+                        "idx": e.id, "code": e.code, "target": int(e.label),
+                    }) + "\n")
+            counts[fname] = len(rows)
+        print(f"codet5 export -> {c5_dir}: {counts}")
     print(f"prepared {len(examples)} examples -> {out_dir}")
 
 
@@ -1175,6 +1193,9 @@ def main(argv=None) -> None:
                    help="mutated-variant jsonl to join onto the base dataset")
     p.add_argument("--mutated-flip", action="store_true",
                    help="use the jsonl 'source' field (the *_flip variants)")
+    p.add_argument("--export-codet5", action="store_true",
+                   help="also write per-split CodeT5 defect jsonl "
+                        "(idx/code/target — the unixcoder export hook)")
     _add_common(p)
     p.set_defaults(fn=cmd_prepare)
 

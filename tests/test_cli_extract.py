@@ -72,6 +72,32 @@ def test_combined_rejects_typed_gtype(storage):
         main(["train-combined", "data.gtype=cfg+dep", "model.n_etypes=3"])
 
 
+def test_prepare_export_codet5(storage):
+    """--export-codet5 writes per-split defect jsonl that the CodeT5
+    defect reader round-trips (the unixcoder export hook,
+    unixcoder/linevul_main.py:1400-1424)."""
+    import json
+
+    from deepdfa_tpu.cli.main import main
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.data.gen_data import read_defect_gen_examples
+
+    main(["prepare", "--source", "synthetic", "--n-examples", "20",
+          "--export-codet5"])
+    c5 = paths.processed_dir("bigvul") / "codet5"
+    counts = {}
+    for fname in ("train", "valid", "test"):
+        p = c5 / f"{fname}.jsonl"
+        assert p.exists()
+        rows = [json.loads(line) for line in p.open()]
+        counts[fname] = len(rows)
+        assert all(set(r) == {"idx", "code", "target"} for r in rows)
+    assert sum(counts.values()) == 20 and counts["train"] > 0
+    ex = read_defect_gen_examples(c5 / "train.jsonl")
+    assert len(ex) == counts["train"]
+    assert all(e.target in ("true", "false") for e in ex)
+
+
 def test_removed_config_key_tolerated():
     from deepdfa_tpu.core import config as config_mod
 
