@@ -63,6 +63,28 @@ def test_train_gen_cli(task_files, tmp_path):
     assert best.exists()
 
 
+def test_train_gen_cli_test_outputs(task_files, tmp_path):
+    """--test-file decodes from the saved best-ppl params and writes the
+    run_gen.py output/gold prediction files plus a BLEU/EM json line."""
+    out = _run(
+        ["train-gen", "--task", "summarize",
+         "--train-file", task_files["a.train"],
+         "--dev-file", task_files["a.dev"],
+         "--test-file", task_files["a.dev"],
+         "--beam-size", "2",
+         *TINY, "run_name=cli-gen-test", "train.max_epochs=2"],
+        tmp_path, timeout=600,
+    )
+    scores = json.loads(out.strip().splitlines()[-1])
+    assert {"test_em", "test_bleu"} <= set(scores)
+    res = tmp_path / "runs" / "cli-gen-test" / "results"
+    hyp = (res / "test_best-ppl.output").read_text().strip().splitlines()
+    gold = (res / "test_best-ppl.gold").read_text().strip().splitlines()
+    assert len(hyp) == len(gold) == 8  # a.dev has 8 examples
+    # reference file shape: "<idx>\t<space-separated tokens>"
+    assert all("\t" in line for line in hyp + gold)
+
+
 def test_train_multi_gen_cli(task_files, tmp_path):
     out = _run(
         ["train-multi-gen",
