@@ -726,7 +726,15 @@ def _gen_encode_file(args, tok, task_name, filename, max_target_length=None):
     from deepdfa_tpu.data import gen_data
 
     family = task_name.split("_")[0]
-    reader = gen_data.READERS.get(family, gen_data.READERS["summarize"])
+    if family not in gen_data.READERS:
+        # the reference only accepts known families (run_gen/run_multi_gen
+        # task tables); a silent summarize fallback would train a typo'd
+        # --task-spec with the wrong reader/patience/target-length
+        raise SystemExit(
+            f"unknown task family {family!r} (task {task_name!r}); "
+            f"known: {sorted(gen_data.READERS)}"
+        )
+    reader = gen_data.READERS[family]
     ex = reader(filename, args.data_num)
     src = tok.batch_encode(
         [f"{family}: {e.source}" for e in ex],
@@ -847,6 +855,13 @@ def cmd_train_multi_gen(args) -> None:
         name, _, files = spec.partition("=")
         if not files:
             raise SystemExit(f"--task-spec {spec!r}: expected name=train[:dev]")
+        if name.split("_")[0] not in gen_data.READERS:
+            # fail before any model/backend setup: the reference only
+            # accepts known task families (run_multi_gen.py task tables)
+            raise SystemExit(
+                f"--task-spec {spec!r}: unknown task family "
+                f"{name.split('_')[0]!r}; known: {sorted(gen_data.READERS)}"
+            )
         train_file, _, dev_file = files.partition(":")
         specs.append((name, train_file, dev_file or None))
 

@@ -23,6 +23,11 @@ import sys
 
 _PROBE_SRC = """
 import jax, jax.numpy as jnp
+try:
+    from deepdfa_tpu.core.backend import enable_compile_cache
+    enable_compile_cache()
+except Exception:
+    pass  # probe must work even outside the repo checkout
 x = jnp.ones((128, 128), jnp.bfloat16)
 jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
 print("PLATFORM:" + jax.devices()[0].platform, flush=True)

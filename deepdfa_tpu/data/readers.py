@@ -77,7 +77,10 @@ def read_bigvul(
             g.sample(min(per_class, len(g)), random_state=0)
             for _, g in df.groupby(df.vul != 0)
         ]
-        df = pd.concat(parts)
+        # original row order, not class-0-first: order-sensitive
+        # downstream consumers (seeded random splits over row order)
+        # must see a stable corpus for the same flags
+        df = pd.concat(parts).sort_index()
     out: list[Example] = []
     for row in df.itertuples(index=False):
         before = _clean_func(row.func_before)

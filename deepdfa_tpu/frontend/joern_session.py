@@ -199,21 +199,28 @@ class JoernSession:
 
         Joern names workspace projects after the imported file, so the
         project matching `source_path` is preferred; when absent (layout
-        differences across joern versions) the most recently written
-        cpg.bin — the project just imported — is used."""
+        differences across joern versions) the fallback search is
+        restricted to project directories whose name contains the imported
+        file's name — a most-recent-anywhere pick could silently copy a
+        stale or wrong project's CPG when the session has imported
+        several files."""
         name = Path(source_path).name
         exact = self.workspace / "workspace" / name / "cpg.bin"
         if exact.exists():
             src = exact
         else:
             candidates = sorted(
-                self.workspace.rglob("cpg.bin"),
+                (
+                    p
+                    for p in self.workspace.rglob("cpg.bin")
+                    if name in p.parent.name
+                ),
                 key=lambda p: p.stat().st_mtime,
             )
             if not candidates:
                 raise RuntimeError(
-                    f"no cpg.bin found under workspace {self.workspace}; "
-                    "import a file first"
+                    f"no cpg.bin for project {name!r} under workspace "
+                    f"{self.workspace}; import the file first"
                 )
             src = candidates[-1]
         dest = Path(str(source_path) + ".cpg.bin")

@@ -186,7 +186,15 @@ def moe_ffn_ep(cfg: MoEConfig, params: dict, x: jax.Array, mesh,
     """Expert-parallel MoE: experts shard over `ep_axis`, tokens stay
     replicated, outputs psum — numerically identical to moe_ffn (the
     routing is computed identically everywhere; each device keeps only
-    its expert block's contribution). x: [N, D] -> ([N, D], aux)."""
+    its expert block's contribution). x: [N, D] -> ([N, D], aux).
+
+    Replication contract: x is declared with in_specs P(), i.e. the FULL
+    token batch is replicated across every mesh axis including dp. This
+    is only safe as the standalone parity/dry-run path it serves; inside
+    a dp-sharded training step it would silently compute the global
+    batch on every device — callers embedding MoE in their own shard_map
+    must use moe_stage_forward on their per-shard tokens instead (as
+    CombinedTrainer does). Asserted below."""
     try:
         from jax import shard_map
     except ImportError:  # older jax
@@ -196,6 +204,16 @@ def moe_ffn_ep(cfg: MoEConfig, params: dict, x: jax.Array, mesh,
     if cfg.num_experts % n_dev:
         raise ValueError(
             f"{cfg.num_experts} experts not divisible by ep={n_dev}"
+        )
+    oversized = {
+        ax: n for ax, n in mesh.shape.items() if ax != ep_axis and n > 1
+    }
+    if oversized:
+        raise ValueError(
+            f"moe_ffn_ep replicates the full token batch over every mesh "
+            f"axis; axes {oversized} would silently recompute the global "
+            "batch per device — embed moe_stage_forward in your own "
+            "shard_map instead"
         )
 
     def body(pr, x_rep):
