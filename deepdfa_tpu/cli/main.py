@@ -1171,6 +1171,22 @@ def cmd_coverage(args) -> None:
     print(json.dumps(coverage_report(split_specs), indent=2))
 
 
+def cmd_ivdetect(args) -> None:
+    """Per-line IVDetect feature dump (reference: evaluate.py:19-191
+    feature_extraction, cached per file under ivdetect_feat_ext/)."""
+    from pathlib import Path
+
+    from deepdfa_tpu.eval.ivdetect import dump_features
+
+    for src in args.sources:
+        src = Path(src)
+        out = Path(args.out_dir) if args.out_dir else src.parent
+        out.mkdir(parents=True, exist_ok=True)
+        dest = out / f"{src.stem}.ivdetect.json"
+        dump_features(src.read_text(), dest)
+        print(dest)
+
+
 def cmd_bench(args) -> None:
     import bench
 
@@ -1291,6 +1307,15 @@ def main(argv=None) -> None:
     _add_common(p)
     p.set_defaults(fn=cmd_coverage)
 
+    p = sub.add_parser(
+        "ivdetect",
+        help="dump per-line IVDetect features (subseq/ast/nametypes/"
+        "data/control) for C files",
+    )
+    p.add_argument("sources", nargs="+", help="C/C++ source files")
+    p.add_argument("--out-dir", default=None)
+    p.set_defaults(fn=cmd_ivdetect)
+
     p = sub.add_parser("train-gen")
     p.add_argument("--task", choices=sorted(
         ("summarize", "translate", "refine", "concode", "defect")
@@ -1378,7 +1403,7 @@ def main(argv=None) -> None:
     p.add_argument("--refs", nargs="+", required=True,
                    help="reference files (one example per line)")
     p.add_argument("--hyp", required=True, help="hypothesis file")
-    p.add_argument("--lang", default="c", choices=["c", "cpp"])
+    p.add_argument("--lang", default="c", choices=["c", "cpp", "python"])
     p.add_argument("--params", default="0.25,0.25,0.25,0.25",
                    help="alpha,beta,gamma,theta component weights")
     p.set_defaults(fn=cmd_codebleu)

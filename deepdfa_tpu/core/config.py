@@ -174,6 +174,11 @@ class TrainConfig:
     # jitted computation + enable jax's internal invariant checks
     debug_nans: bool = False
     enable_checks: bool = False
+    # async input pipeline: batches assembled + device_put by a background
+    # thread this many steps ahead of the training step (the reference
+    # overlaps input work via DataLoader workers, datamodule.py:110-141);
+    # 0 disables and iterates inline
+    prefetch_batches: int = 2
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

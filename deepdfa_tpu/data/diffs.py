@@ -4,28 +4,109 @@ The reference shells out to `git diff --no-index` per example and parses
 hunk headers (DDFA/sastvd/helpers/git.py:12-165) to get added/removed line
 numbers; statement labels are then "removed lines + lines data/control
 dependent on added lines" (evaluate.py:194-236). Here the diff is computed
-in-process with difflib (same line-level semantics, no subprocess per
-example), and the dependency closure runs on the CPG built by our frontend.
+in-process (no subprocess per example) with the same Myers algorithm git
+uses, so hunk boundaries — and therefore vuln-line labels — match git's on
+ambiguous inputs where difflib's Ratcliff-Obershelp picks a different
+minimal edit (e.g. adjacent-line swaps). Pinned against real
+`git diff --no-index` output in tests/goldens/diff_labels.json.
 """
 
 from __future__ import annotations
 
-import difflib
+
+def _myers(
+    a: list[str], b: list[str], insert_at: set[int] | None = None
+) -> tuple[set[int], set[int]]:
+    """Greedy O(ND) Myers diff; (removed 0-based idx in a, added in b).
+    When `insert_at` is given, it collects the 0-based a-positions where
+    insertions land (for guarded_lines).
+
+    Tie-breaking follows the classic formulation git's xdiff uses: extend
+    the further-reaching path, preferring a deletion when paths tie —
+    which is what makes an adjacent swap come out as -first/+later like
+    git, not -later/+first like difflib.
+    """
+    if insert_at is None:
+        insert_at = set()
+    n, m = len(a), len(b)
+    if n == 0 or m == 0:
+        if m:
+            insert_at.add(0)
+        return set(range(n)), set(range(m))
+    v: dict[int, int] = {1: 0}
+    trace: list[dict[int, int]] = []
+    final_d = -1
+    for d in range(n + m + 1):
+        trace.append(dict(v))
+        for k in range(-d, d + 1, 2):
+            if k == -d or (k != d and v.get(k - 1, -1) < v.get(k + 1, -1)):
+                x = v.get(k + 1, 0)  # down: insert b line
+            else:
+                x = v.get(k - 1, 0) + 1  # right: delete a line
+            y = x - k
+            while x < n and y < m and a[x] == b[y]:
+                x += 1
+                y += 1
+            v[k] = x
+            if x >= n and y >= m:
+                final_d = d
+                break
+        if final_d >= 0:
+            break
+    removed: set[int] = set()
+    added: set[int] = set()
+    x, y = n, m
+    for d in range(final_d, 0, -1):
+        pv = trace[d]
+        k = x - y
+        if k == -d or (k != d and pv.get(k - 1, -1) < pv.get(k + 1, -1)):
+            prev_k = k + 1
+        else:
+            prev_k = k - 1
+        prev_x = pv.get(prev_k, 0)
+        prev_y = prev_x - prev_k
+        # rewind the snake back to the single edit step
+        while x > prev_x and y > prev_y and x > 0 and y > 0 and a[x - 1] == b[y - 1]:
+            x -= 1
+            y -= 1
+        if x == prev_x:
+            added.add(prev_y)  # insertion of b[prev_y], at a-position prev_x
+            insert_at.add(prev_x)
+        else:
+            removed.add(prev_x)  # deletion of a[prev_x]
+        x, y = prev_x, prev_y
+    return removed, added
+
+
+def _slide_up(changed: set[int], lines: list[str]) -> set[int]:
+    """git-xdiff-style compaction: a run of changed lines that is free to
+    slide (the line just above the run equals the run's last line) is
+    reported at its UPPERMOST position — e.g. deleting one of three
+    identical `step();` lines marks the first, as git does."""
+    out: set[int] = set()
+    runs: list[list[int]] = []
+    for i in sorted(changed):
+        if runs and i == runs[-1][-1] + 1:
+            runs[-1].append(i)
+        else:
+            runs.append([i])
+    for run in runs:
+        start, end = run[0], run[-1]
+        while start > 0 and (start - 1) not in changed and lines[start - 1] == lines[end]:
+            start -= 1
+            end -= 1
+        out.update(range(start, end + 1))
+    return out
 
 
 def diff_lines(before: str, after: str) -> tuple[set[int], set[int]]:
     """(removed_lines_in_before, added_lines_in_after), 1-based."""
     b = before.splitlines()
     a = after.splitlines()
-    removed: set[int] = set()
-    added: set[int] = set()
-    sm = difflib.SequenceMatcher(a=b, b=a, autojunk=False)
-    for tag, i1, i2, j1, j2 in sm.get_opcodes():
-        if tag in ("replace", "delete"):
-            removed.update(range(i1 + 1, i2 + 1))
-        if tag in ("replace", "insert"):
-            added.update(range(j1 + 1, j2 + 1))
-    return removed, added
+    removed, added = _myers(b, a)
+    removed = _slide_up(removed, b)
+    added = _slide_up(added, a)
+    return {i + 1 for i in removed}, {j + 1 for j in added}
 
 
 def guarded_lines(before: str, after: str) -> set[int]:
@@ -40,12 +121,15 @@ def guarded_lines(before: str, after: str) -> set[int]:
     """
     b = before.splitlines()
     a = after.splitlines()
-    sm = difflib.SequenceMatcher(a=b, b=a, autojunk=False)
-    out: set[int] = set()
-    for tag, i1, i2, j1, j2 in sm.get_opcodes():
-        if tag == "insert" and i1 < len(b):
-            out.add(i1 + 1)
-    return out
+    insert_at: set[int] = set()
+    removed, _ = _myers(b, a, insert_at)
+    # PURE insertions only: an insertion adjacent to a removed line is the
+    # insert half of a replacement, whose label is the removed line itself
+    return {
+        pos + 1
+        for pos in insert_at
+        if pos < len(b) and pos not in removed and (pos - 1) not in removed
+    }
 
 
 def vulnerable_lines(before: str, after: str) -> set[int]:

@@ -17,8 +17,11 @@ Role parity with the reference evaluator
   otherwise — weighted_ngram_match.py:modified_recall, calc_code_bleu.py:41-42).
 - syntax match: fraction of reference AST subtrees (as s-expressions of
   node labels) found in the candidate AST (syntax_match.py:49-74). The
-  reference uses tree-sitter grammars; this repo's hermetic C/C++ frontend
-  (frontend/parser.py) provides the AST, so `lang` must be "c" or "cpp".
+  reference uses tree-sitter grammars; here the AST comes from this
+  repo's hermetic C/C++ frontend (lang "c"/"cpp") or the python stdlib
+  `ast` module (lang "python"); other reference languages (java/js/go/
+  php/ruby/c_sharp) are descoped — no tree-sitter grammars under zero
+  egress (docs/PARITY.md).
 - dataflow match: fraction of the reference's normalized def-use triples
   (var_i, relation, [var_j...]) found in the candidate
   (dataflow_match.py:28-66, variable names alpha-renamed in order of
@@ -243,22 +246,141 @@ def _subtree_sexps(cpg) -> list[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# python structural backends (stdlib ast replaces tree-sitter's grammar;
+# reference: CodeT5/evaluator/CodeBLEU/parser/DFG.py DFG_python)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_py(code: str):
+    import ast
+    import textwrap
+
+    for candidate in (code, textwrap.dedent(code)):
+        try:
+            return ast.parse(candidate)
+        except SyntaxError:
+            continue
+    return None
+
+
+def _py_sexps(tree) -> list[str]:
+    """S-expressions of python AST node type names for every node with
+    children (same shape as the tree-sitter sexps used on C)."""
+    import ast
+
+    out: list[str] = []
+
+    def sexp(node) -> str:
+        kids = list(ast.iter_child_nodes(node))
+        label = type(node).__name__
+        if not kids:
+            return f"({label})"
+        return f"({label} " + " ".join(sexp(k) for k in kids) + ")"
+
+    def walk(node, is_root=False):
+        kids = list(ast.iter_child_nodes(node))
+        if kids or is_root:
+            out.append(sexp(node))
+        for k in kids:
+            walk(k)
+
+    walk(tree, is_root=True)
+    return out
+
+
+def _py_dataflow_triples(tree) -> list[tuple[str, str, tuple[str, ...]]]:
+    """Def-use triples from a python AST, in source order:
+
+    - assignment/aug-assignment/for-target/with-as/arg: ("x",
+      "computedFrom", (rhs names...))
+    - a Load of a name with a prior definition: ("x", "comesFrom", ("x",))
+
+    Same triple vocabulary as the C extractor above and the reference DFG
+    functions; like the reference's DFG_python it is a linear (source
+    -order) approximation, not a full-CFG solution.
+    """
+    import ast
+
+    triples: list[tuple[str, str, tuple[str, ...]]] = []
+    defined: set[str] = set()
+
+    def names_in(node) -> tuple[str, ...]:
+        return tuple(
+            sorted(
+                {
+                    n.id
+                    for n in ast.walk(node)
+                    if isinstance(n, ast.Name)
+                }
+            )
+        )
+
+    def define(target, rhs: tuple[str, ...]):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                triples.append((n.id, "computedFrom", rhs))
+                defined.add(n.id)
+
+    def visit(node):
+        if isinstance(node, ast.Assign):
+            visit_children(node.value)
+            rhs = names_in(node.value)
+            for t in node.targets:
+                define(t, rhs)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                visit_children(node.value)
+                define(node.target, names_in(node.value))
+            return
+        if isinstance(node, ast.For):
+            visit_children(node.iter)
+            define(node.target, names_in(node.iter))
+            for b in node.body + node.orelse:
+                visit(b)
+            return
+        if isinstance(node, ast.withitem) and node.optional_vars is not None:
+            visit_children(node.context_expr)
+            define(node.optional_vars, names_in(node.context_expr))
+            return
+        if isinstance(node, ast.arg):
+            defined.add(node.arg)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in defined:
+                triples.append((node.id, "comesFrom", (node.id,)))
+            return
+        visit_children(node)
+
+    def visit_children(node):
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit_children(tree)
+    return triples
+
+
 def corpus_syntax_match(
     list_of_references: Sequence[Sequence[str]],
     candidates: Sequence[str],
     lang: str = "c",
 ) -> float:
     _check_lang(lang)
+    parse, sexps = (
+        (_parse_py, _py_sexps) if lang == "python" else (_parse, _subtree_sexps)
+    )
     match = 0
     total = 0
     for references, cand in zip(list_of_references, candidates):
-        cand_cpg = _parse(cand)
-        cand_sexps = _subtree_sexps(cand_cpg) if cand_cpg else []
+        cand_cpg = parse(cand)
+        cand_sexps = sexps(cand_cpg) if cand_cpg else []
         for ref in references:
-            ref_cpg = _parse(ref)
+            ref_cpg = parse(ref)
             if ref_cpg is None:
                 continue
-            ref_sexps = _subtree_sexps(ref_cpg)
+            ref_sexps = sexps(ref_cpg)
             match += sum(1 for s in ref_sexps if s in cand_sexps)
             total += len(ref_sexps)
     if total == 0:
@@ -337,20 +459,25 @@ def corpus_dataflow_match(
     lang: str = "c",
 ) -> float:
     _check_lang(lang)
+    parse, triples_fn = (
+        (_parse_py, _py_dataflow_triples)
+        if lang == "python"
+        else (_parse, _dataflow_triples)
+    )
     match = 0
     total = 0
     for references, cand in zip(list_of_references, candidates):
-        cand_cpg = _parse(cand)
+        cand_cpg = parse(cand)
         cand_dfg = (
-            _normalize_dataflow(_dataflow_triples(cand_cpg))
+            _normalize_dataflow(triples_fn(cand_cpg))
             if cand_cpg
             else []
         )
         for ref in references:
-            ref_cpg = _parse(ref)
+            ref_cpg = parse(ref)
             if ref_cpg is None:
                 continue
-            ref_dfg = _normalize_dataflow(_dataflow_triples(ref_cpg))
+            ref_dfg = _normalize_dataflow(triples_fn(ref_cpg))
             if not ref_dfg:
                 continue
             remaining = list(cand_dfg)
@@ -374,11 +501,13 @@ def corpus_dataflow_match(
 
 
 def _check_lang(lang: str) -> None:
-    if lang not in ("c", "cpp"):
+    if lang not in ("c", "cpp", "python"):
         raise ValueError(
-            f"lang={lang!r}: structural matches need the hermetic C/C++ "
-            "frontend; supported langs are 'c' and 'cpp' (the reference "
-            "covers java/js/... via tree-sitter grammars unavailable here)"
+            f"lang={lang!r}: structural matches need a parser; supported "
+            "langs are 'c'/'cpp' (hermetic C/C++ frontend) and 'python' "
+            "(stdlib ast). The reference covers java/js/... via "
+            "tree-sitter grammars unavailable here (zero egress); those "
+            "langs are descoped — see docs/PARITY.md."
         )
 
 

@@ -144,6 +144,71 @@ def agreement_report(pairs: Iterable[tuple[str, Cpg, Cpg]]) -> dict:
     return report
 
 
+def cpg_line_spec(cpg: Cpg) -> dict:
+    """Line-level CPG spec: the exact signals the fidelity metrics read.
+
+    {stmt_lines, cfg_edges (src_line,dst_line pairs), def_lines} — the
+    compact ground-truth format used by the committed fidelity corpus
+    (tests/fidelity_corpus/expected.json). Hash agreement needs full CPG
+    structure and stays on the builder fixtures (tests/joern_fixtures.py).
+    """
+    return {
+        "stmt_lines": sorted(_cfg_lines(cpg)),
+        "cfg_edges": sorted(list(e) for e in _cfg_line_edges(cpg)),
+        "def_lines": sorted(_def_hashes_by_line(cpg)),
+    }
+
+
+def compare_to_spec(cpg: Cpg, spec: dict) -> dict:
+    """Agreement metrics between a CPG and a hand-specified line spec."""
+    lines_a = _cfg_lines(cpg)
+    edges_a = _cfg_line_edges(cpg)
+    defs_a = set(_def_hashes_by_line(cpg))
+    lines_b = set(spec["stmt_lines"])
+    edges_b = {tuple(e) for e in spec["cfg_edges"]}
+    defs_b = set(spec["def_lines"])
+    return {
+        "stmt_line_jaccard": round(_jaccard(lines_a, lines_b), 4),
+        "cfg_edge_jaccard": round(_jaccard(edges_a, edges_b), 4),
+        "def_line_jaccard": round(_jaccard(defs_a, defs_b), 4),
+        "n_stmt_lines": (len(lines_a), len(lines_b)),
+        "n_cfg_edges": (len(edges_a), len(edges_b)),
+        "n_def_lines": (len(defs_a), len(defs_b)),
+    }
+
+
+def corpus_report(corpus_dir, expected_path=None) -> dict:
+    """Fidelity report over a committed corpus directory.
+
+    corpus_dir holds one function per .c/.cc file; expected_path (default
+    <corpus_dir>/expected.json) maps file stem -> line spec. Aggregates
+    the same jaccards as agreement_report.
+    """
+    from pathlib import Path
+
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    corpus_dir = Path(corpus_dir)
+    expected_path = Path(expected_path or corpus_dir / "expected.json")
+    expected = json.loads(expected_path.read_text())
+    per_example = {}
+    sums: dict[str, float] = {}
+    for path in sorted(corpus_dir.glob("*.c*")):
+        name = path.stem
+        if name not in expected:
+            continue
+        m = compare_to_spec(parse_function(path.read_text()), expected[name])
+        per_example[name] = m
+        for k in ("stmt_line_jaccard", "cfg_edge_jaccard", "def_line_jaccard"):
+            sums[k] = sums.get(k, 0.0) + m[k]
+    n = len(per_example)
+    return {
+        "n_examples": n,
+        "mean": {k: round(v / n, 4) for k, v in sums.items()} if n else {},
+        "per_example": per_example,
+    }
+
+
 def fidelity_against_joern(
     sources: dict[str, str],
     joern_prefixes: dict[str, str] | None = None,
@@ -184,11 +249,24 @@ def main(argv=None) -> None:  # pragma: no cover - thin CLI shim
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("sources", nargs="+", help="C files to compare")
+    ap.add_argument("sources", nargs="*", help="C files to compare")
+    ap.add_argument(
+        "--corpus", default=None,
+        help="corpus dir with *.c/*.cc + expected.json line specs "
+        "(e.g. tests/fidelity_corpus)",
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
     from pathlib import Path
+
+    if args.corpus:
+        report = corpus_report(args.corpus)
+        text = json.dumps(report, indent=2)
+        print(text)
+        if args.out:
+            Path(args.out).write_text(text)
+        return
 
     from deepdfa_tpu.frontend import joern_session
 

@@ -95,8 +95,9 @@ class _For(_Stmt):
 
 
 class _Switch(_Stmt):
-    def __init__(self, cond: _Expr, cases: list[tuple[bool, _Stmt]], has_default: bool):
-        # cases: (is_default, body) in source order
+    #: cases: (is_default, label_code e.g. "case 0"/"default", line, body),
+    #: in source order
+    def __init__(self, cond: _Expr, cases: list[tuple[bool, str, int | None, _Stmt]], has_default: bool):
         self.cond, self.cases, self.has_default = cond, cases, has_default
 
 
@@ -106,11 +107,13 @@ class _Return(_Stmt):
 
 
 class _Break(_Stmt):
-    pass
+    def __init__(self, line: int | None = None):
+        self.line = line
 
 
 class _Continue(_Stmt):
-    pass
+    def __init__(self, line: int | None = None):
+        self.line = line
 
 
 class _Goto(_Stmt):
@@ -129,7 +132,12 @@ class _Label(_Stmt):
 
 class Parser:
     def __init__(self, code: str):
-        self.toks = tokenize(code)
+        from deepdfa_tpu.frontend.preproc import evaluate_conditionals
+
+        # resolve #if/#ifdef regions + expand file-local object macros
+        # BEFORE lexing (shared pre-pass, so the native and python lexers
+        # stay bit-identical); line structure is preserved
+        self.toks = tokenize(evaluate_conditionals(code))
         self.i = 0
         self.cpg: C.Cpg | None = None
         self.scope = _Scope()
@@ -708,18 +716,21 @@ class Parser:
                 self.eat()
                 if self.at(";"):
                     self.eat()
-                return _Break()
+                return _Break(t.line)
             if t.text == "continue":
                 self.eat()
                 if self.at(";"):
                     self.eat()
-                return _Continue()
+                return _Continue(t.line)
             if t.text == "goto":
                 self.eat()
                 label = self.eat().text
                 if self.at(";"):
                     self.eat()
-                node = self._node("UNKNOWN", name="goto", code=f"goto {label}", line=t.line)
+                node = self._node(
+                    "CONTROL_STRUCTURE", name="goto",
+                    code=f"goto {label};", line=t.line,
+                )
                 return _Goto(label, node)
         # label: `name:` followed by statement
         if t.kind == "id" and self.peek(1).text == ":" and self.peek(2).text != ":":
@@ -808,29 +819,34 @@ class Parser:
         self.eat("switch")
         cond = self._parse_paren_expr()
         self.eat("{")
-        cases: list[tuple[bool, _Stmt]] = []
+        cases: list[tuple[bool, str, int | None, _Stmt]] = []
         has_default = False
         cur: list[_Stmt] | None = None
         cur_is_default = False
+        cur_label, cur_line = "", None
         while not self.at("}") and not self.at_eof():
             if self.at("case"):
                 if cur is not None:
-                    cases.append((cur_is_default, _Seq(cur)))
-                self.eat("case")
+                    cases.append((cur_is_default, cur_label, cur_line, _Seq(cur)))
+                kw = self.eat("case")
                 # consume the constant expression up to ':'
+                const_toks = []
                 while not self.at(":") and not self.at_eof():
-                    self.eat()
+                    const_toks.append(self.eat().text)
                 self.eat(":")
                 cur = []
                 cur_is_default = False
+                cur_label = "case " + " ".join(const_toks)
+                cur_line = kw.line
                 continue
             if self.at("default"):
                 if cur is not None:
-                    cases.append((cur_is_default, _Seq(cur)))
-                self.eat("default")
+                    cases.append((cur_is_default, cur_label, cur_line, _Seq(cur)))
+                kw = self.eat("default")
                 self.eat(":")
                 cur = []
                 cur_is_default = True
+                cur_label, cur_line = "default", kw.line
                 has_default = True
                 continue
             stmt = self.parse_statement()
@@ -838,7 +854,7 @@ class Parser:
                 cur = []
             cur.append(stmt)
         if cur is not None:
-            cases.append((cur_is_default, _Seq(cur)))
+            cases.append((cur_is_default, cur_label, cur_line, _Seq(cur)))
         if self.at("}"):
             self.eat()
         return _Switch(cond, cases, has_default)
@@ -862,7 +878,13 @@ class Parser:
                     "IDENTIFIER", name=name, code=name, line=start.line,
                     type_full_name=full,
                 )
-                rhs = self._parse_assign()
+                # brace initializer: Joern models `T a[] = {..}` as an
+                # assignment whose RHS is <operator>.arrayInitializer, so
+                # the declaration still yields a definition node
+                if self.at("{"):
+                    rhs = self._parse_brace_init(start.line)
+                else:
+                    rhs = self._parse_assign()
                 code = f"{name} = {self._code(rhs)}"
                 call = self._call(
                     C.OP_NAMES["="], code, start.line, [ident, rhs]
@@ -875,6 +897,26 @@ class Parser:
         if expect_semicolon and self.at(";"):
             self.eat()
         return _Seq(stmts)
+
+    def _parse_brace_init(self, line: int | None) -> int:
+        """`{ e1, e2, {..}, ... }` -> <operator>.arrayInitializer CALL
+        whose arguments are the element expressions (nested braces
+        recurse). Designators (`[0] = x`, `.f = y`) parse via the normal
+        assignment expression path."""
+        self.eat("{")
+        args: list[int] = []
+        while not self.at("}") and not self.at_eof():
+            if self.at("{"):
+                args.append(self._parse_brace_init(line))
+            else:
+                args.append(self._parse_assign())
+            if self.at(","):
+                self.eat()
+        if self.at("}"):
+            self.eat()
+        return self._call(
+            "<operator>.arrayInitializer", "{...}", line, args
+        )
 
     # -- function ------------------------------------------------------------
 
@@ -1057,6 +1099,26 @@ class _CfgBuilder:
     def _first_of(self, top: int) -> int:
         return self._postorder(top)[0]
 
+    def _loop_back_to_body(
+        self, marker: int, entry_frontier: list[int], conts: list[int]
+    ) -> None:
+        """Close a condition-less loop: find the body's first CFG node
+        (the dst of the first CFG edge out of the entry frontier added
+        after `marker`) and wire the current frontier plus deferred
+        continues back to it."""
+        first_body = None
+        for src, dst, t in self.cpg.edges[marker:]:
+            if t == C.CFG and src in entry_frontier:
+                first_body = dst
+                break
+        if first_body is None:
+            return
+        for nid in self.frontier:
+            self.cpg.add_edge(nid, first_body, C.CFG)
+        for nid in conts:
+            self.cpg.add_edge(nid, first_body, C.CFG)
+        self.frontier = []
+
     # -- statements --
 
     def stmt(self, s: _Stmt) -> None:
@@ -1078,15 +1140,17 @@ class _CfgBuilder:
                 self.frontier = then_f + cond_f
         elif isinstance(s, _While):
             if s.cond.top is None:
-                # while(1)-style: loop forever; breaks exit
+                # condition-less loop (parse recovery): loop forever;
+                # body end and continues wire back to the body's first
+                # node, only breaks exit
                 self.break_stack.append([])
+                marker = len(self.cpg.edges)
                 entry_frontier = list(self.frontier)
                 self.continue_stack.append(("defer", []))
                 self.stmt(s.body)
-                # body end loops to its own start: approximate by joining
-                # body frontier to entry targets
+                _, conts = self.continue_stack.pop()
+                self._loop_back_to_body(marker, entry_frontier, conts)
                 self.frontier = self.break_stack.pop()
-                self.continue_stack.pop()
                 return
             cond_first = self._first_of(s.cond.top)
             self.emit_expr(s.cond.top)
@@ -1140,7 +1204,8 @@ class _CfgBuilder:
             self.continue_stack.append(
                 ("node", update_first) if update_first is not None else ("defer", [])
             )
-            body_frontier_save = list(self.frontier)
+            marker = len(self.cpg.edges)
+            entry_frontier = list(self.frontier)
             self.stmt(s.body)
             # body end -> update -> cond
             if s.update is not None and s.update.top is not None:
@@ -1149,17 +1214,32 @@ class _CfgBuilder:
                 for nid in self.frontier:
                     self.cpg.add_edge(nid, cond_first, C.CFG)
                 self.frontier = [cond_top] + self.break_stack.pop()
+                self.continue_stack.pop()
             else:
-                # no condition: infinite loop, only breaks exit
+                # for(;;): body end (after any update) loops back to the
+                # body's first node; deferred continues join it; only
+                # breaks exit
+                _, conts = self.continue_stack.pop()
+                if not isinstance(conts, list):
+                    conts = []
+                self._loop_back_to_body(marker, entry_frontier, conts)
                 self.frontier = self.break_stack.pop()
-            self.continue_stack.pop()
         elif isinstance(s, _Switch):
             self.emit_expr(s.cond.top)
             cond_f = list(self.frontier)
             self.break_stack.append([])
             fallthrough: list[int] = []
-            for is_default, body in s.cases:
-                self.frontier = cond_f + fallthrough
+            for is_default, label_code, line, body in s.cases:
+                # Joern emits a JUMP_TARGET per case/default label, in
+                # the CFG: dispatch edges go switch-cond -> jump target,
+                # and fallthrough runs prev body -> next jump target
+                jt = self.cpg.add_node(
+                    "JUMP_TARGET", name=label_code,
+                    code=f"{label_code}:", line=line,
+                )
+                for nid in cond_f + fallthrough:
+                    self.cpg.add_edge(nid, jt, C.CFG)
+                self.frontier = [jt]
                 self.stmt(body)
                 fallthrough = self.frontier
             exits = self.break_stack.pop() + fallthrough
@@ -1174,17 +1254,29 @@ class _CfgBuilder:
             self.cpg.add_edge(s.node, self.cpg.method_return_id, C.CFG)
             self.frontier = []
         elif isinstance(s, _Break):
+            # Joern keeps break in the CFG as a CONTROL_STRUCTURE node
+            node = self.cpg.add_node(
+                "CONTROL_STRUCTURE", name="break", code="break;",
+                line=s.line,
+            )
+            for nid in self.frontier:
+                self.cpg.add_edge(nid, node, C.CFG)
             if self.break_stack:
-                self.break_stack[-1].extend(self.frontier)
+                self.break_stack[-1].append(node)
             self.frontier = []
         elif isinstance(s, _Continue):
+            node = self.cpg.add_node(
+                "CONTROL_STRUCTURE", name="continue", code="continue;",
+                line=s.line,
+            )
+            for nid in self.frontier:
+                self.cpg.add_edge(nid, node, C.CFG)
             if self.continue_stack:
                 kind, target = self.continue_stack[-1]
                 if kind == "node":
-                    for nid in self.frontier:
-                        self.cpg.add_edge(nid, target, C.CFG)
+                    self.cpg.add_edge(node, target, C.CFG)
                 else:
-                    target.extend(self.frontier)
+                    target.append(node)
             self.frontier = []
         elif isinstance(s, _Goto):
             for nid in self.frontier:

@@ -1,0 +1,175 @@
+"""Preprocessor conditional evaluation + object-like macro expansion.
+
+The reference's Joern frontend preprocesses each function text with an
+empty predefined-macro table before parsing (Eclipse-CDT semantics under
+get_func_graph.sc's importCode); a hermetic frontend that skips directive
+LINES but keeps every branch BODY (the round-2 behavior, tokens.py) sees
+`#ifdef`/`#else` functions with both branches live — a CPG shape a real
+preprocessor can never produce. This pass applies standard C-preprocessor
+semantics to the conditional directives only:
+
+- `#if` / `#elif` constant expressions are evaluated with unknown
+  identifiers as 0 (ISO C 6.10.1p4), `defined(X)` / `defined X` resolved
+  against the file-local `#define` table;
+- `#ifdef` / `#ifndef` test that table;
+- inactive branch lines are blanked (newlines kept, so line numbers in
+  the CPG still match the original source);
+- object-like `#define NAME <literal-or-id>` bodies are expanded in
+  active code (token-boundary, outside string/char literals), matching
+  what the reference's parser sees after real preprocessing. Unknown
+  function-like macros are left intact — they parse as plain calls, the
+  same recovery CDT applies when a macro definition is unavailable.
+
+Expressions this mini-evaluator cannot decide default to ACTIVE (keep the
+code visible) rather than dropping code on a guess.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DIRECTIVE_RE = re.compile(r"^\s*#\s*(\w+)(.*)$", re.DOTALL)
+_DEFINE_RE = re.compile(r"^\s*(\w+)(\([^)]*\))?\s*(.*?)\s*$", re.DOTALL)
+_DEFINED_RE = re.compile(r"\bdefined\s*(?:\(\s*(\w+)\s*\)|(\w+))")
+_ID_RE = re.compile(r"\b[A-Za-z_]\w*\b")
+_SIMPLE_BODY_RE = re.compile(
+    r"^(?:\d[\w.]*|0[xX][0-9a-fA-F]+[uUlL]*|'(?:\\.|[^'])*'|\"(?:\\.|[^\"])*\"|[A-Za-z_]\w*|\([^()]*\))$"
+)
+_ALLOWED_EVAL = re.compile(r"^[\d\s()+\-*/%<>=!&|^~]*$")
+
+
+def _eval_expr(expr: str, defines: dict[str, str]) -> bool | None:
+    """Evaluate a #if/#elif constant expression; None = undecidable."""
+    expr = _DEFINED_RE.sub(
+        lambda m: "1" if (m.group(1) or m.group(2)) in defines else "0", expr
+    )
+    # substitute known object-like macros (one round is enough for the
+    # config-flag style expressions these corpora contain), then ISO
+    # semantics: remaining identifiers evaluate to 0
+    expr = _ID_RE.sub(lambda m: defines.get(m.group(0), "0"), expr)
+    expr = _ID_RE.sub("0", expr)
+    # integer suffixes confuse eval; drop them
+    expr = re.sub(r"(\d)[uUlL]+", r"\1", expr)
+    expr = expr.replace("&&", " and ").replace("||", " or ")
+    expr = re.sub(r"!(?!=)", " not ", expr)
+    if not _ALLOWED_EVAL.match(expr.replace("and", "").replace("or", "").replace("not", "")):
+        return None
+    import warnings
+
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # e.g. "0(1)" SyntaxWarning
+            return bool(eval(expr, {"__builtins__": {}}, {}))  # noqa: S307
+    except Exception:
+        return None
+
+
+def _expand_macros(line: str, defines: dict[str, str]) -> str:
+    """Expand object-like macros outside string/char literals."""
+    if not defines:
+        return line
+    out: list[str] = []
+    i, n = 0, len(line)
+    while i < n:
+        c = line[i]
+        if c in "\"'":
+            j = i + 1
+            while j < n and line[j] != c:
+                j += 2 if line[j] == "\\" else 1
+            j = min(j + 1, n)
+            out.append(line[i:j])
+            i = j
+            continue
+        m = _ID_RE.match(line, i)
+        if m:
+            out.append(defines.get(m.group(0), m.group(0)))
+            i = m.end()
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def evaluate_conditionals(code: str) -> str:
+    """Resolve #if/#ifdef/#else/#endif regions; blank inactive lines.
+
+    Line count and the content of active lines' positions are preserved,
+    so downstream line numbers match the original source.
+    """
+    # splice continued directive lines (backslash-newline) logically but
+    # keep physical structure by tracking how many lines each consumed
+    lines = code.split("\n")
+    out: list[str] = []
+    defines: dict[str, str] = {}
+    # stack of (this_branch_active, any_branch_taken, parent_active)
+    stack: list[list[bool]] = []
+
+    def active() -> bool:
+        return all(fr[0] for fr in stack)
+
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        stripped = line.lstrip()
+        if stripped.startswith("#"):
+            # gather continuation lines
+            full = line
+            span = 1
+            while full.rstrip().endswith("\\") and i + span < len(lines):
+                full = full.rstrip()[:-1] + lines[i + span]
+                span += 1
+            m = _DIRECTIVE_RE.match(full.strip())
+            name = m.group(1) if m else ""
+            rest = (m.group(2) if m else "").strip()
+            parent = active()
+            if name == "ifdef":
+                cond = rest.split()[0] in defines if rest.split() else False
+                stack.append([parent and cond, cond, parent])
+            elif name == "ifndef":
+                cond = rest.split()[0] not in defines if rest.split() else True
+                stack.append([parent and cond, cond, parent])
+            elif name == "if":
+                v = _eval_expr(rest, defines)
+                cond = True if v is None else v
+                stack.append([parent and cond, cond, parent])
+            elif name == "elif" and stack:
+                fr = stack[-1]
+                if fr[1]:
+                    fr[0] = False
+                else:
+                    v = _eval_expr(rest, defines)
+                    cond = True if v is None else v
+                    fr[0] = fr[2] and cond
+                    fr[1] = cond
+            elif name == "else" and stack:
+                fr = stack[-1]
+                fr[0] = fr[2] and not fr[1]
+                fr[1] = True
+            elif name == "endif" and stack:
+                stack.pop()
+            elif name == "define" and parent:
+                dm = _DEFINE_RE.match(rest)
+                if dm and not dm.group(2):  # object-like only
+                    body = dm.group(3)
+                    if body and _SIMPLE_BODY_RE.match(body):
+                        defines[dm.group(1)] = body
+                    else:
+                        defines.setdefault(dm.group(1), "")
+                elif dm:
+                    defines.setdefault(dm.group(1), "")
+            elif name == "undef" and parent:
+                defines.pop(rest.split()[0] if rest.split() else "", None)
+            # directive lines themselves are blanked (the lexer would
+            # skip them anyway; blanking keeps native/python identical)
+            for k in range(span):
+                out.append("")
+            i += span
+            continue
+        if active():
+            out.append(
+                _expand_macros(line, {k: v for k, v in defines.items() if v})
+            )
+        else:
+            out.append("")
+        i += 1
+    return "\n".join(out)

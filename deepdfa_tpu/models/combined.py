@@ -182,12 +182,10 @@ def forward(
     if dropout_key is not None:
         k_enc, k_head = jax.random.split(dropout_key)
     if pp_axis is not None:
-        if sp_axis is not None:
-            raise ValueError("pp and sp cannot both shard the encoder")
         if position_offset != 0:
             raise ValueError(
-                "position_offset is an sp-shard contract; the pipeline "
-                "path embeds full sequences (offset must be 0)"
+                "position_offset is computed inside the pipeline from "
+                "sp_axis; callers must pass 0 on the pp path"
             )
         from deepdfa_tpu.parallel.pipeline import pipeline_stage_forward
 
@@ -204,6 +202,7 @@ def forward(
             pp_axis,
             broadcast="region_end",
             tp_axis=tp_axis,
+            sp_axis=sp_axis,
         )
     else:
         hidden = tfm.encode(

@@ -129,6 +129,98 @@ def _attention(q, k, v, mask, bias):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def encoder_rel_bias(
+    cfg: T5Config,
+    rel_bias_param: jax.Array,
+    T: int,
+    dt,
+    sp_axis: str | None = None,
+):
+    """(bias, bias_fn) for the encoder's shared relative-position bias.
+
+    Without sp: one [H, T, T] bias from global positions, bias_fn None.
+    With sp: T is the LOCAL block; per-rotation-step bias blocks are
+    precomputed from global positions ([n_sp, H, T, T]) so ring
+    attention's scan only indexes, never re-gathers.
+    """
+    if sp_axis is None:
+        pos = jnp.arange(T)
+        buckets = relative_position_buckets(
+            pos, pos, cfg.rel_buckets, cfg.rel_max_distance
+        )
+        # [Tq, Tk, H] -> [H, Tq, Tk]; head axis shards over tp with layers
+        return rel_bias_param[buckets].astype(dt).transpose(2, 0, 1), None
+
+    sp_idx = jax.lax.axis_index(sp_axis)
+    n_sp = jax.lax.psum(1, sp_axis)  # static inside shard_map
+    q_pos = sp_idx * T + jnp.arange(T)
+
+    def _step_bias(step):
+        # the block arriving at rotation `step` originated on shard
+        # (sp_idx - step) mod n_sp; its global k positions follow
+        origin = jnp.mod(sp_idx - step, n_sp)
+        k_pos = origin * T + jnp.arange(T)
+        b = relative_position_buckets(
+            q_pos, k_pos, cfg.rel_buckets, cfg.rel_max_distance
+        )
+        return rel_bias_param[b].astype(dt).transpose(2, 0, 1)
+
+    all_bias = jnp.stack([_step_bias(s) for s in range(n_sp)])
+
+    def bias_fn(step):
+        return all_bias[step]
+
+    return None, bias_fn
+
+
+def encoder_layer(
+    cfg: T5Config,
+    lp: dict,
+    x: jax.Array,
+    attn_mask: jax.Array,
+    key,
+    bias,
+    bias_fn,
+    tp_axis: str | None = None,
+    sp_axis: str | None = None,
+) -> jax.Array:
+    """One pre-RMSNorm T5 encoder layer (HF t5 semantics); shared by the
+    stacked-scan encoder below and the GPipe pipeline
+    (parallel/pipeline.py t5_pipeline_stage_forward)."""
+    dt = x.dtype
+    k1 = k2 = None
+    if key is not None:
+        k1, k2 = jax.random.split(key)
+    h_in = _rms_norm(x, lp["ln1"], cfg.layer_norm_eps)
+    h_in = region_start(h_in, tp_axis) if tp_axis is not None else h_in
+    q = jnp.einsum("btd,dhk->bhtk", h_in, lp["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bhtk", h_in, lp["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bhtk", h_in, lp["wv"].astype(dt))
+    if sp_axis is not None:
+        from deepdfa_tpu.parallel.ring_attention import ring_attention
+
+        ctx = ring_attention(
+            q, k, v, attn_mask, axis_name=sp_axis, scale=1.0,
+            bias_fn=bias_fn,
+        )
+    else:
+        ctx = _attention(q, k, v, attn_mask, bias)
+    out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
+    if tp_axis is not None:
+        out = region_end(out, tp_axis)
+    from deepdfa_tpu.models.transformer import _dropout
+
+    x = x + _dropout(out, cfg.dropout_rate, k1)
+
+    h2 = _rms_norm(x, lp["ln2"], cfg.layer_norm_eps)
+    h2 = region_start(h2, tp_axis) if tp_axis is not None else h2
+    h2 = jax.nn.relu(jnp.einsum("btd,df->btf", h2, lp["wi"].astype(dt)))
+    h2 = jnp.einsum("btf,fd->btd", h2, lp["wo_ffn"].astype(dt))
+    if tp_axis is not None:
+        h2 = region_end(h2, tp_axis)
+    return x + _dropout(h2, cfg.dropout_rate, k2)
+
+
 def encode(
     cfg: T5Config,
     params: dict,
@@ -163,70 +255,16 @@ def encode(
         k_embed, k_layers, k_final = jax.random.split(dropout_key, 3)
     x = _dropout(x, cfg.dropout_rate, k_embed)
 
-    T = input_ids.shape[1]
-    if sp_axis is None:
-        pos = jnp.arange(T)
-        buckets = relative_position_buckets(
-            pos, pos, cfg.rel_buckets, cfg.rel_max_distance
-        )
-        # [Tq, Tk, H] -> [H, Tq, Tk]; head axis shards over tp with layers
-        bias = params["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
-        bias_fn = None
-    else:
-        from deepdfa_tpu.parallel.ring_attention import ring_attention
-
-        bias = None
-        sp_idx = jax.lax.axis_index(sp_axis)
-        n_sp = jax.lax.psum(1, sp_axis)  # static inside shard_map
-        q_pos = sp_idx * T + jnp.arange(T)
-
-        def _step_bias(step):
-            # the block arriving at rotation `step` originated on shard
-            # (sp_idx - step) mod n_sp; its global k positions follow
-            origin = jnp.mod(sp_idx - step, n_sp)
-            k_pos = origin * T + jnp.arange(T)
-            b = relative_position_buckets(
-                q_pos, k_pos, cfg.rel_buckets, cfg.rel_max_distance
-            )
-            return params["rel_bias"][b].astype(dt).transpose(2, 0, 1)
-
-        # the blocks depend on the rotation step, not the layer: compute
-        # the n_sp of them ONCE ([n_sp, H, T, T]) so the layer scan inside
-        # ring attention only indexes, never re-gathers
-        all_bias = jnp.stack([_step_bias(s) for s in range(n_sp)])
-
-        def bias_fn(step):
-            return all_bias[step]
+    bias, bias_fn = encoder_rel_bias(
+        cfg, params["rel_bias"], input_ids.shape[1], dt, sp_axis
+    )
 
     def layer(x, inputs):
         lp, key = inputs
-        k1 = k2 = None
-        if key is not None:
-            k1, k2 = jax.random.split(key)
-        h_in = _rms_norm(x, lp["ln1"], cfg.layer_norm_eps)
-        h_in = region_start(h_in, tp_axis) if tp_axis is not None else h_in
-        q = jnp.einsum("btd,dhk->bhtk", h_in, lp["wq"].astype(dt))
-        k = jnp.einsum("btd,dhk->bhtk", h_in, lp["wk"].astype(dt))
-        v = jnp.einsum("btd,dhk->bhtk", h_in, lp["wv"].astype(dt))
-        if sp_axis is not None:
-            ctx = ring_attention(
-                q, k, v, attn_mask, axis_name=sp_axis, scale=1.0,
-                bias_fn=bias_fn,
-            )
-        else:
-            ctx = _attention(q, k, v, attn_mask, bias)
-        out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
-        if tp_axis is not None:
-            out = region_end(out, tp_axis)
-        x = x + _dropout(out, cfg.dropout_rate, k1)
-
-        h2 = _rms_norm(x, lp["ln2"], cfg.layer_norm_eps)
-        h2 = region_start(h2, tp_axis) if tp_axis is not None else h2
-        h2 = jax.nn.relu(jnp.einsum("btd,df->btf", h2, lp["wi"].astype(dt)))
-        h2 = jnp.einsum("btf,fd->btd", h2, lp["wo_ffn"].astype(dt))
-        if tp_axis is not None:
-            h2 = region_end(h2, tp_axis)
-        return x + _dropout(h2, cfg.dropout_rate, k2)
+        return encoder_layer(
+            cfg, lp, x, attn_mask, key, bias, bias_fn,
+            tp_axis=tp_axis, sp_axis=sp_axis,
+        )
 
     fn = jax.checkpoint(layer) if cfg.remat else layer
     n_layers = params["layers"]["wq"].shape[0]
@@ -395,14 +433,46 @@ def defect_forward(
     tp_axis: str | None = None,
     sp_axis: str | None = None,
     inputs_embeds: jax.Array | None = None,
+    pp_axis: str | None = None,
+    pp_stages: int = 1,
+    pp_microbatches: int = 4,
 ) -> jax.Array:
+    """With `pp_axis` set (inside shard_map, encoder layers stage-sharded
+    over that axis) the encoder runs the GPipe microbatch schedule with a
+    region_end broadcast (the trainer computes a loss copy per stage;
+    parallel/pipeline.py docstring); composes with sp_axis (local
+    sequence chunks, ring attention inside the stage body)."""
     from deepdfa_tpu.models.combined import make_graph_encoder_for
 
-    hidden = encode(
-        cfg.encoder, params["encoder"], input_ids,
-        dropout_key=dropout_key, tp_axis=tp_axis, sp_axis=sp_axis,
-        inputs_embeds=inputs_embeds,
-    )
+    if pp_axis is not None:
+        if inputs_embeds is not None:
+            raise ValueError(
+                "inputs_embeds (attribution hook) is a single-device "
+                "contract; the pipeline path embeds internally"
+            )
+        from deepdfa_tpu.parallel.pipeline import t5_pipeline_stage_forward
+
+        enc = params["encoder"]
+        hidden = t5_pipeline_stage_forward(
+            cfg.encoder,
+            enc["layers"],
+            {k: v for k, v in enc.items() if k != "layers"},
+            input_ids,
+            input_ids != cfg.encoder.pad_token_id,
+            dropout_key,
+            pp_microbatches,
+            pp_stages,
+            pp_axis,
+            broadcast="region_end",
+            tp_axis=tp_axis,
+            sp_axis=sp_axis,
+        )
+    else:
+        hidden = encode(
+            cfg.encoder, params["encoder"], input_ids,
+            dropout_key=dropout_key, tp_axis=tp_axis, sp_axis=sp_axis,
+            inputs_embeds=inputs_embeds,
+        )
     if sp_axis is not None:
         vec = eos_pool_sp(cfg.encoder, hidden, input_ids, sp_axis)
     else:

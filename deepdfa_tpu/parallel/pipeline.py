@@ -21,6 +21,16 @@ design follows the classic GPipe schedule expressed the XLA way:
   and `jax.checkpoint` around the stage body keeps only per-stage
   activations live (the GPipe rematerialization strategy).
 
+The schedule itself (`_gpipe_schedule`) is architecture-agnostic — it
+takes embed/layer-block callbacks. `pipeline_stage_forward` wires the
+RoBERTa-family encoder (absolute positions; composes with sp by embedding
+each sequence shard at its global offset and running ring/Ulysses
+attention inside the stage body), `t5_pipeline_stage_forward` the T5
+encoder (shared relative-position bias computed on every stage — the
+bias table is replicated and cheap — with per-rotation bias blocks under
+sp). pp x sp composes because the sp collectives inside a tick are
+orthogonal to the pp ppermute between ticks.
+
 The bubble fraction is (P-1)/(M+P-1): pick microbatches >= 4x stages.
 """
 
@@ -51,25 +61,26 @@ def merge_stages(staged: dict) -> dict:
     )
 
 
-def pipeline_stage_forward(
-    cfg,
-    layers_local: dict,
-    rest_p: dict,
-    input_ids: jax.Array,
-    attn_mask: jax.Array,
-    dropout_key,
+def _gpipe_schedule(
+    ids: jax.Array,
+    mask: jax.Array,
+    embed_fn,
+    block_fn,
     microbatches: int,
     n_stages: int,
-    pp_axis: str = "pp",
-    broadcast: str = "psum",
-    tp_axis: str | None = None,
+    pp_axis: str,
+    hidden_size: int,
+    dtype,
+    broadcast: str,
 ):
-    """The GPipe schedule, running INSIDE shard_map on one stage.
+    """The arch-agnostic GPipe scan, running INSIDE shard_map on one stage.
 
-    layers_local: this stage's layer block [L/P, ...]; rest_p: replicated
-    non-layer params; input_ids/attn_mask: the full local batch [B, T]
-    (replicated across `pp_axis`). Returns hidden [B, T, D] replicated
-    across stages.
+    ids/mask: full local batch [B, T] (replicated across `pp_axis`).
+    embed_fn(ids_t, microbatch_index) -> [B/M, T, D]: input embedding
+    (every stage computes it; a `where` keeps stage 0's).
+    block_fn(x, mask_m, microbatch_index, stage_index) -> x: this stage's
+    layer block.
+    Returns hidden [B, T, D] replicated across stages.
 
     `broadcast` picks how the last stage's outputs reach every stage:
     - "psum": plain psum — correct when the LOSS is computed outside the
@@ -80,72 +91,31 @@ def pipeline_stage_forward(
       encoder cotangents by the stage count; same trap as the sp [CLS]
       broadcast, docs/DESIGN.md section 4).
     """
-    from deepdfa_tpu.models.transformer import embed, encoder_layer
-
-    b_total, seq = input_ids.shape
+    b_total, seq = ids.shape
     m = microbatches
     if b_total % m:
         raise ValueError(f"batch {b_total} not divisible by {m} microbatches")
-    ids = input_ids.reshape(m, b_total // m, seq)
-    mask = attn_mask.reshape(m, b_total // m, seq)
+    ids_m = ids.reshape(m, b_total // m, seq)
+    mask_m_all = mask.reshape(m, b_total // m, seq)
 
     stage = jax.lax.axis_index(pp_axis)
-    n_local = jax.tree.leaves(layers_local)[0].shape[0]
-
-    def run_stage(x, mask_m, stage_key):
-        def layer_fn(h, inp):
-            lp, k = inp
-            return encoder_layer(cfg, lp, h, mask_m, k, tp_axis=tp_axis), None
-
-        keys = (
-            jax.random.split(stage_key, n_local)
-            if stage_key is not None
-            else jnp.zeros((n_local, 2), jnp.uint32)
-        )
-        if dropout_key is None:
-            def layer_fn(h, inp):  # noqa: F811 - no-dropout variant
-                lp, _ = inp
-                return (
-                    encoder_layer(cfg, lp, h, mask_m, None, tp_axis=tp_axis),
-                    None,
-                )
-
-        fn = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
-        x, _ = jax.lax.scan(fn, x, (layers_local, keys))
-        return x
-
     steps = m + n_stages - 1
-    d = cfg.hidden_size
-    dt = jnp.dtype(cfg.dtype)  # embed/layers emit the activation dtype
-    state0 = jnp.zeros((b_total // m, seq, d), dt)
-    out0 = jnp.zeros((m, b_total // m, seq, d), dt)
+    dt = jnp.dtype(dtype)
+    state0 = jnp.zeros((b_total // m, seq, hidden_size), dt)
+    out0 = jnp.zeros((m, b_total // m, seq, hidden_size), dt)
 
     def step(carry, t):
         state, outputs = carry
         # microbatch index resident at this stage this tick
         mi = jnp.clip(t - stage, 0, m - 1)
         ti = jnp.clip(t, 0, m - 1)
-        ids_t = jax.lax.dynamic_index_in_dim(ids, ti, keepdims=False)
+        ids_t = jax.lax.dynamic_index_in_dim(ids_m, ti, keepdims=False)
         # stage 0's tick input is a fresh embed; later stages take the
         # activation handed over by ppermute last tick
-        ekey = jax.random.fold_in(dropout_key, ti) if dropout_key is not None else None
-        x0 = embed(cfg, rest_p, ids_t, 0, ekey)
+        x0 = embed_fn(ids_t, ti)
         xin = jnp.where(stage == 0, x0, state)
-        mask_m = jax.lax.dynamic_index_in_dim(mask, mi, keepdims=False)
-        # decorrelate dropout across microbatches AND stages (each
-        # stage holds different global layers; an identical key would
-        # draw identical masks on every stage)
-        skey = (
-            jax.random.fold_in(
-                jax.random.fold_in(
-                    jax.random.fold_in(dropout_key, 7919), mi
-                ),
-                stage,
-            )
-            if dropout_key is not None
-            else None
-        )
-        out = run_stage(xin, mask_m, skey)
+        mask_m = jax.lax.dynamic_index_in_dim(mask_m_all, mi, keepdims=False)
+        out = block_fn(xin, mask_m, mi, stage)
         widx = t - (n_stages - 1)
         write = (stage == n_stages - 1) & (widx >= 0)
         wi = jnp.clip(widx, 0, m - 1)
@@ -170,6 +140,168 @@ def pipeline_stage_forward(
     else:
         raise ValueError(f"broadcast={broadcast!r}")
     return outputs.reshape(b_total, seq, -1)
+
+
+def _stage_block_fn(layers_local: dict, dropout_key, remat: bool, layer_call):
+    """The per-stage layer-block runner shared by both encoder families:
+    microbatch/stage dropout-key decorrelation (each stage holds
+    different global layers; an identical key would draw identical masks
+    on every stage), per-layer key split, optional remat, lax.scan over
+    this stage's layer block. layer_call(lp, x, mask_m, key) -> x."""
+    n_local = jax.tree.leaves(layers_local)[0].shape[0]
+
+    def block_fn(x, mask_m, mi, stage):
+        skey = (
+            jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.fold_in(dropout_key, 7919), mi
+                ),
+                stage,
+            )
+            if dropout_key is not None
+            else None
+        )
+        keys = (
+            jax.random.split(skey, n_local)
+            if skey is not None
+            else jnp.zeros((n_local, 2), jnp.uint32)
+        )
+
+        def layer_fn(h, inp):
+            lp, k = inp
+            return (
+                layer_call(
+                    lp, h, mask_m, k if dropout_key is not None else None
+                ),
+                None,
+            )
+
+        fn = jax.checkpoint(layer_fn) if remat else layer_fn
+        x, _ = jax.lax.scan(fn, x, (layers_local, keys))
+        return x
+
+    return block_fn
+
+
+def pipeline_stage_forward(
+    cfg,
+    layers_local: dict,
+    rest_p: dict,
+    input_ids: jax.Array,
+    attn_mask: jax.Array,
+    dropout_key,
+    microbatches: int,
+    n_stages: int,
+    pp_axis: str = "pp",
+    broadcast: str = "psum",
+    tp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
+    """RoBERTa-family GPipe stage forward (INSIDE shard_map).
+
+    layers_local: this stage's layer block [L/P, ...]; rest_p: replicated
+    non-layer params; input_ids/attn_mask: the full local batch [B, T]
+    (replicated across `pp_axis`; with `sp_axis`, T is the LOCAL sequence
+    chunk — embedding applies the shard's global position offset and the
+    layer blocks run ring/Ulysses attention over `sp_axis`).
+    Returns hidden [B, T, D] replicated across stages.
+    """
+    from deepdfa_tpu.models.transformer import embed, encoder_layer
+
+    position_offset = 0
+    if sp_axis is not None:
+        position_offset = jax.lax.axis_index(sp_axis) * input_ids.shape[1]
+        if dropout_key is not None:
+            # every sp shard holds different tokens: decorrelate masks
+            dropout_key = jax.random.fold_in(
+                dropout_key, jax.lax.axis_index(sp_axis)
+            )
+
+    def embed_fn(ids_t, ti):
+        ekey = (
+            jax.random.fold_in(dropout_key, ti)
+            if dropout_key is not None
+            else None
+        )
+        return embed(cfg, rest_p, ids_t, position_offset, ekey)
+
+    block_fn = _stage_block_fn(
+        layers_local, dropout_key, cfg.remat,
+        lambda lp, h, mask_m, k: encoder_layer(
+            cfg, lp, h, mask_m, k, sp_axis=sp_axis, tp_axis=tp_axis
+        ),
+    )
+    return _gpipe_schedule(
+        input_ids, attn_mask, embed_fn, block_fn, microbatches, n_stages,
+        pp_axis, cfg.hidden_size, cfg.dtype, broadcast,
+    )
+
+
+def t5_pipeline_stage_forward(
+    cfg,
+    layers_local: dict,
+    rest_p: dict,
+    input_ids: jax.Array,
+    attn_mask: jax.Array,
+    dropout_key,
+    microbatches: int,
+    n_stages: int,
+    pp_axis: str = "pp",
+    broadcast: str = "psum",
+    tp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
+    """T5 encoder GPipe stage forward (INSIDE shard_map).
+
+    Same contract as models.t5.encode ([B, T] -> [B, T, D] post
+    final-RMSNorm): layers_local is this stage's [L/P, ...] block; rest_p
+    holds the replicated word/rel_bias/final_ln params. The shared
+    relative-position bias is computed on every stage (the bias table is
+    tiny and replicated; its gradient is a per-stage partial that the
+    trainer psums over pp). With `sp_axis`, T is the local chunk and
+    per-rotation-step bias blocks feed ring attention.
+    """
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models.transformer import _dropout
+
+    dt = jnp.dtype(cfg.dtype)
+    if dropout_key is not None and sp_axis is not None:
+        dropout_key = jax.random.fold_in(
+            dropout_key, jax.lax.axis_index(sp_axis)
+        )
+    bias, bias_fn = t5m.encoder_rel_bias(
+        cfg, rest_p["rel_bias"], input_ids.shape[1], dt, sp_axis
+    )
+
+    def embed_fn(ids_t, ti):
+        x = rest_p["word"][ids_t].astype(dt)
+        ekey = (
+            jax.random.fold_in(dropout_key, ti)
+            if dropout_key is not None and cfg.dropout_rate > 0.0
+            else None
+        )
+        return _dropout(x, cfg.dropout_rate, ekey)
+
+    block_fn = _stage_block_fn(
+        layers_local, dropout_key, cfg.remat,
+        lambda lp, h, mask_m, k: t5m.encoder_layer(
+            cfg, lp, h, mask_m, k, bias, bias_fn,
+            tp_axis=tp_axis, sp_axis=sp_axis,
+        ),
+    )
+    hidden = _gpipe_schedule(
+        input_ids, attn_mask, embed_fn, block_fn, microbatches, n_stages,
+        pp_axis, cfg.hidden_size, cfg.dtype, broadcast,
+    )
+    # final RMSNorm + dropout run replicated on the broadcast output
+    # (replicated-true across pp: identical cotangents on every stage)
+    hidden = t5m._rms_norm(hidden, rest_p["final_ln"], cfg.layer_norm_eps)
+    k_final = (
+        jax.random.fold_in(dropout_key, 104729)
+        if dropout_key is not None and cfg.dropout_rate > 0.0
+        else None
+    )
+    return _dropout(hidden, cfg.dropout_rate, k_final)
 
 
 def pipeline_encode(
@@ -204,6 +336,50 @@ def pipeline_encode(
     def body(staged_local, rest_p, ids, mask, key):
         layers_local = jax.tree.map(lambda x: x[0], staged_local)
         return pipeline_stage_forward(
+            cfg, layers_local, rest_p, ids, mask, key,
+            microbatches, n_stages, pp_axis, broadcast="psum",
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(pp_axis), staged_layers),
+            jax.tree.map(lambda _: P(), rest),
+            P(), P(), P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(staged_layers, rest, input_ids, attn_mask, dropout_key)
+
+
+def t5_pipeline_encode(
+    cfg,
+    params: dict,
+    input_ids: jax.Array,
+    mesh,
+    microbatches: int = 4,
+    attn_mask: jax.Array | None = None,
+    dropout_key: jax.Array | None = None,
+    pp_axis: str = "pp",
+):
+    """T5 encoder forward, layer-pipelined over `pp_axis` (same contract
+    as models.t5.encode; parity-tested against it)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    n_stages = mesh.shape[pp_axis]
+    if attn_mask is None:
+        attn_mask = input_ids != cfg.pad_token_id
+
+    staged_layers = split_stages(params["layers"], n_stages)
+    rest = {k: v for k, v in params.items() if k != "layers"}
+
+    def body(staged_local, rest_p, ids, mask, key):
+        layers_local = jax.tree.map(lambda x: x[0], staged_local)
+        return t5_pipeline_stage_forward(
             cfg, layers_local, rest_p, ids, mask, key,
             microbatches, n_stages, pp_axis, broadcast="psum",
         )

@@ -111,11 +111,6 @@ class CombinedTrainer:
         self.pp_size = self.mesh.shape.get("pp", 1)
         self.pp = self.pp_size > 1
         self.pp_microbatches = pp_microbatches
-        if self.pp and (self.is_t5 or self.sp):
-            raise NotImplementedError(
-                "pipeline parallelism supports the RoBERTa combined arch "
-                "with sp=1 (pp shards the layer stack; sp shards tokens)"
-            )
         if self.pp and model_cfg.encoder.num_layers % self.pp_size:
             raise ValueError(
                 f"{model_cfg.encoder.num_layers} encoder layers not "
@@ -171,11 +166,21 @@ class CombinedTrainer:
         example = jax.eval_shape(
             lambda: init_fn(self.model_cfg, jax.random.key(0))
         )
+        def stage_shard(layer_specs):
+            # the stacked layer axis (leading) shards across pp stages
+            return jax.tree.map(
+                lambda s: P("pp", *tuple(s)[1:]) if len(s) else P("pp"),
+                layer_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
         if self.is_t5:
             enc_specs = rep(example["encoder"])
             if self.tp:
                 enc_specs["layers"] = t5m.tp_layer_specs()
                 enc_specs["rel_bias"] = P(None, "tp")
+            if self.pp:
+                enc_specs["layers"] = stage_shard(enc_specs["layers"])
         else:
             layer_specs = (
                 cmb.tfm.tp_layer_specs()
@@ -183,12 +188,7 @@ class CombinedTrainer:
                 else rep(example["encoder"]["layers"])
             )
             if self.pp:
-                # the stacked layer axis (leading) shards across stages
-                layer_specs = jax.tree.map(
-                    lambda s: P("pp", *tuple(s)[1:]) if len(s) else P("pp"),
-                    layer_specs,
-                    is_leaf=lambda x: isinstance(x, P),
-                )
+                layer_specs = stage_shard(layer_specs)
             enc_specs = {
                 "embeddings": rep(example["encoder"]["embeddings"]),
                 "layers": layer_specs,
@@ -283,11 +283,17 @@ class CombinedTrainer:
                 dropout_key=key,
                 tp_axis=tp_axis,
                 sp_axis="sp" if self.sp else None,
+                pp_axis="pp" if self.pp else None,
+                pp_stages=self.pp_size,
+                pp_microbatches=self.pp_microbatches,
             )
             return logits, jnp.zeros((), jnp.float32)
         sp_axis = "sp" if self.sp else None
+        # the pipeline path derives the sp position offset internally
         offset = (
-            jax.lax.axis_index("sp") * local.input_ids.shape[1] if self.sp else 0
+            jax.lax.axis_index("sp") * local.input_ids.shape[1]
+            if self.sp and not self.pp
+            else 0
         )
         return cmb.forward(
             self.model_cfg,
@@ -371,10 +377,21 @@ class CombinedTrainer:
             for group, sub in grads.items():
                 if group == "encoder" and pp:
                     # pp splits the encoder: stage-sharded layers are
-                    # local-true, embeddings carry stage-0-only cotangents
+                    # local-true over pp (still summed over dp/sp); the
+                    # replicated non-layer params need a pp psum — word/
+                    # position embeddings carry stage-0-only cotangents,
+                    # the T5 rel_bias carries per-stage partials from each
+                    # stage's layer block. T5's final_ln runs replicated
+                    # on the broadcast output (identical cotangents per
+                    # stage: replicated-true, no pp psum).
                     out[group] = {
-                        "layers": reduce(sub["layers"], ("dp",)),
-                        "embeddings": reduce(sub["embeddings"], ("dp", "pp")),
+                        k: reduce(
+                            v,
+                            ("dp", "sp")
+                            if k in ("layers", "final_ln")
+                            else ("dp", "sp", "pp"),
+                        )
+                        for k, v in sub.items()
                     }
                 elif group == "moe" and ep:
                     # ep splits the moe block: expert slices are
@@ -456,14 +473,29 @@ class CombinedTrainer:
         log_fn: Callable[[dict], None] | None = None,
         seed: int = 0,
     ) -> TrainState:
+        from deepdfa_tpu.data.prefetch import prefetch
+
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         root = jax.random.key(seed)
         step = int(jax.device_get(state.step))
+
+        def place(batch: TextBatch) -> TextBatch:
+            # sharded H2D copy in the producer thread, with the exact
+            # specs the shard_map consumes (sp-sharded input_ids included)
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._batch_specs(batch.graphs.num_graphs),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.device_put(batch, shardings)
+
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
             losses = []
-            for i, batch in enumerate(train_batches(epoch)):
+            for i, batch in enumerate(
+                prefetch(train_batches(epoch), tcfg.prefetch_batches, place)
+            ):
                 key = jax.random.fold_in(root, step)
                 state, loss = self.train_step(state, batch, key)
                 losses.append(loss)

@@ -211,13 +211,18 @@ class GraphTrainer:
         max_epochs: int | None = None,
         log_fn: Callable[[dict], None] | None = None,
     ) -> TrainState:
+        from deepdfa_tpu.data.prefetch import device_placer, prefetch
+
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         step = int(jax.device_get(state.step))
+        placer = device_placer(self.mesh)
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
             losses = []
-            for batch in train_batches(epoch):
+            for batch in prefetch(
+                train_batches(epoch), tcfg.prefetch_batches, placer
+            ):
                 state, loss = self.train_step(state, batch)
                 losses.append(loss)
                 step += 1

@@ -5,21 +5,16 @@ Reference baseline: 25 epochs over the Big-Vul train split (bs 256) in
 9 minutes on an RTX 3090 (paper Table 5) — with the undersampled epoch at
 ~20k graphs that is roughly 925 graphs/s of training throughput.
 
-This measures the same flagship configuration (input_dim 1002, hidden 32,
-n_steps 5) over Big-Vul-tail CFG sizes, full train_step (forward +
-backward + AdamW update), and prints one JSON line with the median
-steady-state window (best/mean alongside, same methodology as bench.py).
+Thin wrapper over bench.run_train_measurement (the same measurement the
+driver captures into BENCH_r{N}.json as train_* fields): flagship config
+(input_dim 1002, hidden 32, n_steps 5), Big-Vul-tail CFG sizes, full
+train_step (forward + backward + AdamW), median steady-state window,
+MFU from XLA cost analysis. scan_steps defaults on for TPU (the round-2
+unrolled train compile wedged the remote compile service; lax.scan keeps
+the program small) — DEEPDFA_BENCH_SCAN_STEPS=0 opts out.
 
-    python scripts/bench_train.py
+    python scripts/bench_train.py                      # default backend
     DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_train.py
-
-Status note (2026-07-29, axon-tunnel v5e): the *inference* benchmark
-(bench.py) compiles and runs fine on the chip, but this train-step
-compile (5 unrolled GGNN steps + backward + AdamW at node_budget 16384 /
-edge_budget 65536) wedged the remote compile service twice at >20 min;
-the script is validated end to end on CPU (93 graphs/s at 128 examples).
-Re-run on the chip when the compile service recovers, or shrink budgets
-via DEEPDFA_BENCH_EXAMPLES to reduce the compiled program.
 """
 
 from __future__ import annotations
@@ -27,14 +22,8 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-# 25 epochs x ~20k undersampled graphs / 540 s (paper Table 5)
-BASELINE_TRAIN_GRAPHS_PER_SEC = 25 * 20_000 / 540.0
 
 
 def main() -> None:
@@ -43,62 +32,24 @@ def main() -> None:
     apply_platform_override()
     import jax
 
-    from deepdfa_tpu.core import Config
-    from deepdfa_tpu.data import (
-        bigvul_stmt_sizes,
-        build_dataset,
-        generate,
-        to_examples,
-    )
-    from deepdfa_tpu.graphs import shard_bucket_batches
-    from deepdfa_tpu.models import DeepDFA
-    from deepdfa_tpu.train import GraphTrainer
+    import bench
 
-    n_examples = int(os.environ.get("DEEPDFA_BENCH_EXAMPLES", 512))
-    reps = int(os.environ.get("DEEPDFA_BENCH_REPS", 8))
-    sizes = bigvul_stmt_sizes(n_examples, seed=7)
-    synth = generate(n_examples, vuln_rate=0.06, seed=7, stmt_sizes=sizes)
-    specs, _ = build_dataset(
-        to_examples(synth), train_ids=range(n_examples), limit_all=1000,
-        limit_subkeys=1000,
-    )
-    # single-shard dp batches (the 1-device path of the exact-sum
-    # shard_map trainer); budgets as in bench.py so nothing is dropped
-    batches = list(
-        shard_bucket_batches(specs, 1, 256, 16384, 65536, oversized="raise")
-    )
-
-    cfg = Config()
-    model = DeepDFA.from_config(cfg.model, input_dim=1002)
-    trainer = GraphTrainer(model, cfg)
-    state = trainer.init_state(batches[0])
-
-    # compile + warmup
-    state, _ = trainer.train_step(state, batches[0])
-    jax.block_until_ready(state.params)
-
-    n_per_pass = sum(int(np.asarray(b.graph_mask).sum()) for b in batches)
-    rates = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        loss = None
-        for b in batches:
-            state, loss = trainer.train_step(state, b)
-        jax.block_until_ready(loss)
-        rates.append(n_per_pass / (time.perf_counter() - t0))
-
-    value = float(np.median(rates))
+    platform = jax.devices()[0].platform
+    result = bench.run_train_measurement(platform)
+    # same fields the driver merges, without the train_ prefix for
+    # standalone readability
     print(
         json.dumps(
             {
                 "metric": "deepdfa_train_graphs_per_sec",
-                "value": round(value, 1),
+                "value": result["train_graphs_per_sec"],
                 "unit": "graphs/s",
-                "vs_baseline": round(value / BASELINE_TRAIN_GRAPHS_PER_SEC, 2),
-                "best_graphs_per_sec": round(max(rates), 1),
-                "mean_graphs_per_sec": round(float(np.mean(rates)), 1),
-                "platform": jax.devices()[0].platform,
-                "n_examples": n_examples,
+                "vs_baseline": result["train_vs_baseline"],
+                **{
+                    k.removeprefix("train_"): v
+                    for k, v in result.items()
+                    if k.startswith("train_")
+                },
             }
         ),
         flush=True,

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Generate tests/goldens/diff_labels.json from real `git diff --no-index`.
+
+The reference computes changed-line labels by shelling out to git per
+example (DDFA/sastvd/helpers/git.py:12-76: `git diff --no-index
+--no-prefix -U<huge>` parsed into +/- line positions); the framework
+computes them in-process (data/diffs.py). Hunk boundaries can differ
+between git's Myers diff and difflib's Ratcliff-Obershelp on ambiguous
+inputs, silently shifting vuln-line labels — so the expected
+added/removed sets here come from the real git binary and are committed
+as goldens (VERDICT r2 item 8).
+
+For each before/after fixture pair this records:
+- removed_before: 1-based line numbers of '-' lines, in BEFORE numbering
+- added_after:    1-based line numbers of '+' lines, in AFTER numbering
+- combined_removed/combined_added: positions in the full-context unified
+  diff body — the reference's own coordinate system (git.py md_lines),
+  used by its combined before/after views (git.py allfunc)
+
+Run from the repo root:  python scripts/make_diff_goldens.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+PAIRS: dict[str, tuple[str, str]] = {
+    "insert_only_guard": (
+        "int f(int *p) {\n  int v = *p;\n  return v + 1;\n}\n",
+        "int f(int *p) {\n  if (!p)\n    return 0;\n  int v = *p;\n  return v + 1;\n}\n",
+    ),
+    "replace_line": (
+        "int g(int n) {\n  int r = n * 2;\n  return r;\n}\n",
+        "int g(int n) {\n  int r = n << 1;\n  return r;\n}\n",
+    ),
+    "delete_only": (
+        "void h(char *s) {\n  log(s);\n  debug_dump(s);\n  free(s);\n}\n",
+        "void h(char *s) {\n  log(s);\n  free(s);\n}\n",
+    ),
+    "move_block": (
+        "int m(int a, int b) {\n  int x = a + 1;\n  int y = b + 2;\n  check(x);\n  return x + y;\n}\n",
+        "int m(int a, int b) {\n  int y = b + 2;\n  int x = a + 1;\n  check(x);\n  return x + y;\n}\n",
+    ),
+    "whitespace_churn": (
+        "int w(int n) {\n  int s=0;\n  for(int i=0;i<n;i++) s+=i;\n  return s;\n}\n",
+        "int w(int n) {\n  int s = 0;\n  for (int i = 0; i < n; i++)\n    s += i;\n  return s;\n}\n",
+    ),
+    "duplicate_lines_ambiguous": (
+        "void d(void) {\n  step();\n  step();\n  step();\n  done();\n}\n",
+        "void d(void) {\n  step();\n  step();\n  done();\n}\n",
+    ),
+    "replace_and_insert": (
+        "int ri(char *buf, int n) {\n  memcpy(dst, buf, n);\n  return n;\n}\n",
+        "int ri(char *buf, int n) {\n  if (n > CAP)\n    n = CAP;\n  memcpy(dst, buf, (size_t)n);\n  return n;\n}\n",
+    ),
+    "change_at_start": (
+        "int cs(int a) {\n  return a;\n}\n",
+        "long cs(int a) {\n  return a;\n}\n",
+    ),
+    "change_at_end": (
+        "int ce(int a) {\n  use(a);\n  return a;\n}\n",
+        "int ce(int a) {\n  use(a);\n  return a + 1;\n}\n",
+    ),
+    "append_tail": (
+        "void at(void) {\n  one();\n}\n",
+        "void at(void) {\n  one();\n  two();\n}\n",
+    ),
+    "no_trailing_newline": (
+        "int nt(void) {\n  return 1;\n}",
+        "int nt(void) {\n  return 2;\n}",
+    ),
+    "multi_hunk_spread": (
+        "int mh(int a) {\n  int x = a;\n  keep1();\n  keep2();\n  keep3();\n  int y = x;\n  return y;\n}\n",
+        "int mh(int a) {\n  int x = a + 1;\n  keep1();\n  keep2();\n  keep3();\n  int y = x - 1;\n  return y;\n}\n",
+    ),
+    "blank_line_insert": (
+        "void bl(void) {\n  a();\n  b();\n}\n",
+        "void bl(void) {\n  a();\n\n  b();\n}\n",
+    ),
+    "indent_shift_block": (
+        "int ind(int c) {\n  run();\n  run2();\n  return c;\n}\n",
+        "int ind(int c) {\n  if (c) {\n    run();\n    run2();\n  }\n  return c;\n}\n",
+    ),
+}
+
+
+def git_diff_body(before: str, after: str) -> str:
+    """Full-context unified diff body via real git (reference gitdiff)."""
+    with tempfile.TemporaryDirectory() as d:
+        old, new = Path(d) / "old", Path(d) / "new"
+        old.write_text(before)
+        new.write_text(after)
+        ctx = len(before.splitlines()) + len(after.splitlines())
+        res = subprocess.run(
+            [
+                "git", "diff", "--no-index", "--no-prefix", f"-U{ctx}",
+                str(old), str(new),
+            ],
+            capture_output=True, text=True,
+        )
+    # rc 1 = differences found; 0 = identical
+    lines = res.stdout.splitlines()
+    # strip the file header (diff/index/---/+++) and the @@ hunk header
+    body_start = next(
+        (i + 1 for i, l in enumerate(lines) if l.startswith("@@")), len(lines)
+    )
+    return "\n".join(lines[body_start:])
+
+
+def classify(body: str) -> dict:
+    removed_before, added_after = [], []
+    combined_removed, combined_added = [], []
+    b_line = a_line = 0
+    for pos, raw in enumerate(body.splitlines(), start=1):
+        tag = raw[:1]
+        if tag == "-":
+            b_line += 1
+            removed_before.append(b_line)
+            combined_removed.append(pos)
+        elif tag == "+":
+            a_line += 1
+            added_after.append(a_line)
+            combined_added.append(pos)
+        elif tag == "\\":  # "\ No newline at end of file"
+            continue
+        else:
+            b_line += 1
+            a_line += 1
+    return {
+        "removed_before": removed_before,
+        "added_after": added_after,
+        "combined_removed": combined_removed,
+        "combined_added": combined_added,
+    }
+
+
+def main() -> None:
+    out = {
+        "_meta": {
+            "generator": "scripts/make_diff_goldens.py",
+            "git_version": subprocess.run(
+                ["git", "--version"], capture_output=True, text=True
+            ).stdout.strip(),
+            "command": "git diff --no-index --no-prefix -U<len(before)+len(after)>",
+        }
+    }
+    for name, (before, after) in PAIRS.items():
+        body = git_diff_body(before, after)
+        rec = {"before": before, "after": after, "diff_body": body}
+        rec.update(classify(body))
+        out[name] = rec
+    dest = REPO / "tests" / "goldens" / "diff_labels.json"
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    dest.write_text(json.dumps(out, indent=1))
+    print(f"wrote {dest} ({len(PAIRS)} pairs)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+int corner_sum(int g[4][4]) {
+  int acc = 0;
+  acc = acc + g[0][0];
+  acc = acc + g[3][3];
+  return acc;
+}

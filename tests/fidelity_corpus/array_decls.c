@@ -1,0 +1,7 @@
+int histo8(int *v, int n) {
+  int bins[8] = {0};
+  for (int i = 0; i < n; i++) {
+    bins[v[i] & 7]++;
+  }
+  return bins[0];
+}

@@ -1,0 +1,5 @@
+unsigned long mix_bits(void *p, int n) {
+  unsigned long base = (unsigned long)p;
+  unsigned char lo = (unsigned char)(n & 0xff);
+  return base ^ (unsigned long)lo;
+}

@@ -1,0 +1,7 @@
+int fold(int a, int b) {
+  a += b;
+  a <<= 2;
+  a |= b & 7;
+  a %= 97;
+  return a;
+}

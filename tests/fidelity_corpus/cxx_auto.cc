@@ -1,0 +1,6 @@
+int first_key(std::map<int, int> &m) {
+  auto it = m.begin();
+  if (it == m.end())
+    return -1;
+  return it->first;
+}

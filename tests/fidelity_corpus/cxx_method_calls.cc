@@ -1,0 +1,8 @@
+int drain_queue(Queue &q) {
+  int n = 0;
+  while (!q.empty()) {
+    q.pop();
+    n++;
+  }
+  return n;
+}

@@ -1,0 +1,7 @@
+int roundtrip(int n) {
+  int *buf = new int[n];
+  buf[0] = n;
+  int head = buf[0];
+  delete[] buf;
+  return head;
+}

@@ -1,0 +1,6 @@
+int read_limit(void) {
+  int lim = config::get_limit();
+  if (lim < 0)
+    lim = defaults::LIMIT;
+  return lim;
+}

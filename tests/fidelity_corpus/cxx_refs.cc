@@ -1,0 +1,6 @@
+int bump(int &counter, const int &step) {
+  counter = counter + step;
+  if (counter > 100)
+    counter = 0;
+  return counter;
+}

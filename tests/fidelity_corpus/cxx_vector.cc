@@ -1,0 +1,7 @@
+int sum_vec(std::vector<int> &v) {
+  int total = 0;
+  for (size_t i = 0; i < v.size(); i++) {
+    total += v[i];
+  }
+  return total;
+}

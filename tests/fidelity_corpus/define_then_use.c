@@ -1,0 +1,7 @@
+#define MAXLEN 128
+int bounded_len(char *s) {
+  int n = strnlen(s, MAXLEN);
+  if (n == MAXLEN)
+    n = n - 1;
+  return n;
+}

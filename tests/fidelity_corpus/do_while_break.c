@@ -1,0 +1,10 @@
+int pump(int n) {
+  int got = 0;
+  do {
+    int r = fill(n);
+    if (r < 0)
+      break;
+    got += r;
+  } while (got < n);
+  return got;
+}

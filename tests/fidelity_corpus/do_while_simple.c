@@ -1,0 +1,8 @@
+int drain(int n) {
+  int total = 0;
+  do {
+    total += step(n);
+    n = n - 1;
+  } while (n > 0);
+  return total;
+}

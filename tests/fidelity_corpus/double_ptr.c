@@ -1,0 +1,8 @@
+int take_head(char **list, char **out) {
+  char *head = list[0];
+  if (!head)
+    return -1;
+  *out = head;
+  list[0] = 0;
+  return 0;
+}

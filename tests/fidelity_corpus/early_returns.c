@@ -1,0 +1,10 @@
+int parse_hdr(char *p, int len) {
+  if (!p)
+    return -1;
+  if (len < 4)
+    return -2;
+  int ver = p[0];
+  if (ver != 2)
+    return -3;
+  return ver;
+}

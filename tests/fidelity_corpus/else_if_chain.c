@@ -1,0 +1,13 @@
+int bucket(int v) {
+  int b = 0;
+  if (v < 10) {
+    b = 1;
+  } else if (v < 100) {
+    b = 2;
+  } else if (v < 1000) {
+    b = 3;
+  } else {
+    b = 4;
+  }
+  return b;
+}

@@ -1,0 +1,11 @@
+int scan(int *buf, int n) {
+  int hits = 0;
+  for (int i = 0; i < n; i++) {
+    if (buf[i] == 0)
+      continue;
+    if (buf[i] < 0)
+      break;
+    hits++;
+  }
+  return hits;
+}

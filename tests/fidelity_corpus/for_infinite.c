@@ -1,0 +1,9 @@
+int wait_ready(int dev) {
+  int spins = 0;
+  for (;;) {
+    spins++;
+    if (poll_dev(dev))
+      break;
+  }
+  return spins;
+}

@@ -1,0 +1,5 @@
+int apply2(int (*fn)(int), int x) {
+  int once = fn(x);
+  int twice = fn(once);
+  return twice;
+}

@@ -1,0 +1,8 @@
+int retry_send(int fd, int n) {
+  int tries = 0;
+again:
+  tries = tries + 1;
+  if (send(fd, n) < 0 && tries < 3)
+    goto again;
+  return tries;
+}

@@ -1,0 +1,15 @@
+int init_pair(int **a, int **b) {
+  int rc = -1;
+  *a = malloc(4);
+  if (!*a)
+    goto out;
+  *b = malloc(4);
+  if (!*b)
+    goto free_a;
+  rc = 0;
+  goto out;
+free_a:
+  free(*a);
+out:
+  return rc;
+}

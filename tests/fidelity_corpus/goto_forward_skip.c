@@ -1,0 +1,9 @@
+int check(int v) {
+  int st = 0;
+  if (v < 0)
+    goto done;
+  st = normalize(v);
+  st = st + 1;
+done:
+  return st;
+}

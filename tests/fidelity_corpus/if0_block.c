@@ -1,0 +1,10 @@
+int checksum(char *p, int n) {
+  int sum = 0;
+#if 0
+  sum = legacy_sum(p, n);
+#endif
+  for (int i = 0; i < n; i++) {
+    sum += p[i];
+  }
+  return sum;
+}

@@ -1,0 +1,8 @@
+int open_dev(char *path) {
+  int flags = 0;
+#ifdef O_CLOEXEC
+  flags = flags | O_CLOEXEC;
+#endif
+  int fd = open(path, flags);
+  return fd;
+}

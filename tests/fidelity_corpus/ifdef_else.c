@@ -1,0 +1,9 @@
+int page_size(void) {
+  int sz = 0;
+#ifdef SMALL_PAGES
+  sz = 4096;
+#else
+  sz = 65536;
+#endif
+  return sz;
+}

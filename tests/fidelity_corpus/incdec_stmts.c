@@ -1,0 +1,8 @@
+int turnstile(int people) {
+  int count = 0;
+  count++;
+  ++count;
+  people--;
+  count -= people;
+  return count;
+}

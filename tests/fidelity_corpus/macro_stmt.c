@@ -1,0 +1,6 @@
+int guarded_read(int fd, char *buf, int n) {
+  CHECK_FD(fd);
+  int got = read(fd, buf, n);
+  LOG_DEBUG("read bytes", got);
+  return got;
+}

@@ -1,0 +1,8 @@
+int submit(struct req *r) {
+  int rc = enqueue(r->ring,
+                   r->payload,
+                   r->len);
+  if (rc < 0)
+    rc = retry_enqueue(r);
+  return rc;
+}

@@ -1,0 +1,9 @@
+int matsum(int **m, int r, int c) {
+  int total = 0;
+  for (int i = 0; i < r; i++) {
+    for (int j = 0; j < c; j++) {
+      total += m[i][j];
+    }
+  }
+  return total;
+}

@@ -1,0 +1,13 @@
+int find_pair(int *a, int n, int want) {
+  int i = 0;
+  while (i < n) {
+    int j = i + 1;
+    while (j < n) {
+      if (a[i] + a[j] == want)
+        return i;
+      j = j + 1;
+    }
+    i = i + 1;
+  }
+  return -1;
+}

@@ -1,0 +1,6 @@
+int swap_max(int *a, int *b) {
+  int *hi = *a > *b ? a : b;
+  int tmp = *hi;
+  *hi = *a + *b;
+  return tmp;
+}

@@ -1,0 +1,12 @@
+int session_close(struct sess *s) {
+  int rc = 0;
+  if (s->buf) {
+    free(s->buf);
+    s->buf = 0;
+  }
+  if (s->fd >= 0) {
+    rc = close(s->fd);
+    s->fd = -1;
+  }
+  return rc;
+}

@@ -1,0 +1,8 @@
+int table_get(struct tbl *t, int idx) {
+  if (idx < 0 || idx >= t->n)
+    return 0;
+  int v = t->rows[idx];
+  if (v < 0)
+    v = 0;
+  return v;
+}

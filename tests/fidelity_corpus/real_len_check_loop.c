@@ -1,0 +1,14 @@
+int parse_opts(unsigned char *p, int len) {
+  int off = 0;
+  int seen = 0;
+  while (off + 2 <= len) {
+    int t = p[off];
+    int l = p[off + 1];
+    if (off + 2 + l > len)
+      return -1;
+    if (t == 9)
+      seen = seen + 1;
+    off = off + 2 + l;
+  }
+  return seen;
+}

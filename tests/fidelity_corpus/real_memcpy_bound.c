@@ -1,0 +1,8 @@
+int copy_name(char *dst, int cap, const char *src) {
+  int n = strlen(src);
+  if (n >= cap)
+    n = cap - 1;
+  memcpy(dst, src, n);
+  dst[n] = 0;
+  return n;
+}

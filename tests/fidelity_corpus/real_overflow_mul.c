@@ -1,0 +1,11 @@
+int alloc_frames(struct dev *d, int count, int size) {
+  if (count <= 0 || size <= 0)
+    return -1;
+  if (count > INT_MAX / size)
+    return -1;
+  d->frames = malloc(count * size);
+  if (!d->frames)
+    return -2;
+  d->nframes = count;
+  return 0;
+}

@@ -1,0 +1,9 @@
+void conn_put(struct conn *c) {
+  if (!c)
+    return;
+  c->refs = c->refs - 1;
+  if (c->refs == 0) {
+    close_sock(c->fd);
+    free(c);
+  }
+}

@@ -1,0 +1,9 @@
+int rb_push(struct ring *r, int v) {
+  int next = (r->head + 1) % r->cap;
+  if (next == r->tail)
+    return -1;
+  r->data[r->head] = v;
+  r->head = next;
+  r->count = r->count + 1;
+  return 0;
+}

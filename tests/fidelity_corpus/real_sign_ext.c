@@ -1,0 +1,9 @@
+int read_be16(const unsigned char *p, int *out) {
+  int hi = p[0];
+  int lo = p[1];
+  int v = (hi << 8) | lo;
+  if (v > 32767)
+    v = v - 65536;
+  *out = v;
+  return 0;
+}

@@ -1,0 +1,20 @@
+int proto_step(struct pstate *ps, int ev) {
+  int next = ps->state;
+  switch (ps->state) {
+  case 0:
+    if (ev == 1)
+      next = 1;
+    break;
+  case 1:
+    if (ev == 2)
+      next = 2;
+    else if (ev == 0)
+      next = 0;
+    break;
+  case 2:
+    next = 0;
+    break;
+  }
+  ps->state = next;
+  return next;
+}

@@ -1,0 +1,10 @@
+int split_csv(char *line, char **cols, int max) {
+  int n = 0;
+  char *tok = strtok(line, ",");
+  while (tok && n < max) {
+    cols[n] = tok;
+    n = n + 1;
+    tok = strtok(0, ",");
+  }
+  return n;
+}

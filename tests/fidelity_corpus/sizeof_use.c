@@ -1,0 +1,8 @@
+int alloc_table(struct entry **out, int n) {
+  int bytes = n * sizeof(struct entry);
+  *out = malloc(bytes);
+  if (!*out)
+    return -1;
+  memset(*out, 0, bytes);
+  return bytes;
+}

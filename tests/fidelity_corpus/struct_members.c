@@ -1,0 +1,7 @@
+int conn_cost(struct conn *c) {
+  int rtt = c->peer->rtt;
+  int depth = c->queue.depth;
+  if (rtt > 100)
+    depth = depth * 2;
+  return rtt + depth;
+}

@@ -1,0 +1,15 @@
+int classify(int x) {
+  int kind = 0;
+  switch (x) {
+  case 0:
+    kind = 1;
+    break;
+  case 1:
+    kind = 2;
+    break;
+  default:
+    kind = 3;
+    break;
+  }
+  return kind;
+}

@@ -1,0 +1,15 @@
+int accumulate(int x) {
+  int acc = 0;
+  switch (x) {
+  case 2:
+    acc += 2;
+  case 1:
+    acc += 1;
+  case 0:
+    acc += 10;
+    break;
+  default:
+    acc = -1;
+  }
+  return acc;
+}

@@ -1,0 +1,15 @@
+int route(int op, int flag) {
+  int out = 0;
+  switch (op) {
+  case 1:
+    if (flag) {
+      out = 10;
+    } else {
+      out = 20;
+    }
+    break;
+  default:
+    out = 30;
+  }
+  return out;
+}

@@ -1,0 +1,12 @@
+int pick(int mode, int a, int b) {
+  int r = a;
+  switch (mode) {
+  case 4:
+    r = b;
+    break;
+  case 7:
+    r = a + b;
+    break;
+  }
+  return r;
+}

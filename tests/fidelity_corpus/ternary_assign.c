@@ -1,0 +1,5 @@
+int clampv(int x, int lo, int hi) {
+  int y = x < lo ? lo : x;
+  int z = y > hi ? hi : y;
+  return z;
+}

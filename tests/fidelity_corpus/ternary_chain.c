@@ -1,0 +1,5 @@
+int sign3(int x) {
+  int s = x > 0 ? 1 : x < 0 ? -1 : 0;
+  log_value(s);
+  return s;
+}

@@ -1,0 +1,3 @@
+int absval(int x) {
+  return x < 0 ? -x : x;
+}

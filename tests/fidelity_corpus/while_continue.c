@@ -1,0 +1,11 @@
+int count_odd(int *v, int n) {
+  int c = 0;
+  int i = 0;
+  while (i < n) {
+    i = i + 1;
+    if (v[i - 1] % 2 == 0)
+      continue;
+    c = c + 1;
+  }
+  return c;
+}

@@ -118,6 +118,30 @@ SOURCES = {
         "  return s;\n"
         "}\n"
     ),
+    "switch_break": (
+        "int sw(int x) {\n"
+        "  int r = 0;\n"
+        "  switch (x) {\n"
+        "  case 0:\n"
+        "    r = 1;\n"
+        "    break;\n"
+        "  default:\n"
+        "    r = 2;\n"
+        "  }\n"
+        "  return r;\n"
+        "}\n"
+    ),
+    "loop_continue": (
+        "int lc(int n) {\n"
+        "  int s = 0;\n"
+        "  for (int i = 0; i < n; i++) {\n"
+        "    if (n % 2)\n"
+        "      continue;\n"
+        "    s = s + i;\n"
+        "  }\n"
+        "  return s;\n"
+        "}\n"
+    ),
 }
 
 
@@ -199,8 +223,74 @@ def build_while_call(tmp_path):
     return b.write(tmp_path, "while_call")
 
 
+def build_switch_break(tmp_path):
+    """Joern emits JUMP_TARGET nodes per case/default label and keeps
+    break statements in the CFG as CONTROL_STRUCTURE nodes; the dispatch
+    edges run switch-cond -> each jump target."""
+    b = JoernExportBuilder("sw")
+    b.local("r", "int", 2)
+    asg0 = b.assign("r", "int", [b.literal("0", 2)], 2, "r = 0")
+    swcond = b.identifier("x", "int", 3)
+    b.ast(b.method, swcond)
+    jt0 = b.node("JUMP_TARGET", name="case 0", code="case 0:", line=4)
+    asg1 = b.assign("r", "int", [b.literal("1", 5)], 5, "r = 1")
+    brk = b.node("CONTROL_STRUCTURE", name="break", code="break;", line=6)
+    jt1 = b.node("JUMP_TARGET", name="default", code="default:", line=7)
+    asg2 = b.assign("r", "int", [b.literal("2", 8)], 8, "r = 2")
+    retv = b.identifier("r", "int", 10)
+    ret = b.call("RETURN", "return r;", 10, [retv])
+    for n in b.nodes:
+        if n["id"] == ret:
+            n["_label"] = "RETURN"
+            n["name"] = "return"
+    b.cfg(b.method, asg0, swcond, jt0, asg1, brk, ret, b.ret)
+    b.cfg(swcond, jt1, asg2, ret)
+    return b.write(tmp_path, "switch_break")
+
+
+def build_loop_continue(tmp_path):
+    """continue stays in Joern's CFG as a CONTROL_STRUCTURE node wired
+    to the for-loop's update expression."""
+    b = JoernExportBuilder("lc")
+    b.local("s", "int", 2)
+    asg_s = b.assign("s", "int", [b.literal("0", 2)], 2, "s = 0")
+    b.local("i", "int", 3)
+    asg_i = b.assign("i", "int", [b.literal("0", 3)], 3, "i = 0")
+    cond = b.call(
+        "<operator>.lessThan", "i < n", 3,
+        [b.identifier("i", "int", 3, 1), b.identifier("n", "int", 3, 2)],
+    )
+    ifc = b.call(
+        "<operator>.modulo", "n % 2", 4,
+        [b.identifier("n", "int", 4, 1), b.literal("2", 4, 2)],
+    )
+    cont = b.node("CONTROL_STRUCTURE", name="continue", code="continue;",
+                  line=5)
+    add = b.subcall(
+        "<operator>.addition", "s + i", 6,
+        [b.identifier("s", "int", 6, 1), b.identifier("i", "int", 6, 2)],
+    )
+    asg_b = b.assign("s", "int", [add], 6, "s = s + i")
+    inc = b.call(
+        "<operator>.postIncrement", "i++", 3,
+        [b.identifier("i", "int", 3, 1)],
+    )
+    retv = b.identifier("s", "int", 8)
+    ret = b.call("RETURN", "return s;", 8, [retv])
+    for n in b.nodes:
+        if n["id"] == ret:
+            n["_label"] = "RETURN"
+            n["name"] = "return"
+    b.cfg(b.method, asg_s, asg_i, cond, ifc, cont, inc, cond)
+    b.cfg(ifc, asg_b, inc)
+    b.cfg(cond, ret, b.ret)
+    return b.write(tmp_path, "loop_continue")
+
+
 BUILDERS = {
     "assign_return": build_assign_return,
     "if_else": build_if_else,
     "while_call": build_while_call,
+    "switch_break": build_switch_break,
+    "loop_continue": build_loop_continue,
 }

@@ -6,6 +6,10 @@ import pytest
 from deepdfa_tpu.frontend.tokenise import tokenise, tokenise_lines
 from deepdfa_tpu.nn.setops import relu_union, segment_union, simple_union
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def test_union_semantics(rng):
     import jax.numpy as jnp

@@ -3,6 +3,10 @@
 import numpy as np
 import pytest
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def storage(tmp_path, monkeypatch):

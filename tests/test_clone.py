@@ -13,6 +13,10 @@ from deepdfa_tpu.train.clone_loop import (
     clone_batches_of,
 )
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 EOS, PAD = 2, 0
 
 

@@ -156,3 +156,71 @@ def test_get_codebleu_composite():
 def test_unsupported_language_raises():
     with pytest.raises(ValueError):
         corpus_syntax_match([["x"]], ["x"], lang="java")
+
+
+# ---------------------------------------------------------------------------
+# python language backend (stdlib ast; reference parser/DFG.py DFG_python)
+
+
+PY_REF = "def add(a, b):\n    total = a + b\n    return total\n"
+PY_SAME_RENAMED = "def add(x, y):\n    result = x + y\n    return result\n"
+PY_DIFFERENT = "def mul(a, b):\n    if a > b:\n        return a * b\n    return 0\n"
+
+
+def test_python_syntax_match_identical_is_one():
+    from deepdfa_tpu.eval.codebleu import corpus_syntax_match
+
+    assert corpus_syntax_match([[PY_REF]], [PY_REF], lang="python") == 1.0
+
+
+def test_python_syntax_match_ranks_structure():
+    from deepdfa_tpu.eval.codebleu import corpus_syntax_match
+
+    renamed = corpus_syntax_match([[PY_REF]], [PY_SAME_RENAMED], lang="python")
+    different = corpus_syntax_match([[PY_REF]], [PY_DIFFERENT], lang="python")
+    assert renamed == 1.0  # sexps carry node types only
+    assert different < renamed
+
+
+def test_python_dataflow_invariant_to_alpha_renaming():
+    from deepdfa_tpu.eval.codebleu import corpus_dataflow_match
+
+    assert (
+        corpus_dataflow_match([[PY_REF]], [PY_SAME_RENAMED], lang="python")
+        == 1.0
+    )
+    assert (
+        corpus_dataflow_match([[PY_REF]], [PY_DIFFERENT], lang="python") < 1.0
+    )
+
+
+def test_python_composite_and_keywords():
+    from deepdfa_tpu.eval.codebleu import get_codebleu
+
+    res = get_codebleu([PY_REF], [PY_SAME_RENAMED], lang="python")
+    assert 0.0 < res["codebleu"] < 1.0
+    assert res["syntax_match"] == 1.0
+    perfect = get_codebleu([PY_REF], [PY_REF], lang="python")
+    assert perfect["codebleu"] == 1.0
+
+
+def test_python_dataflow_triples_cover_defs_and_uses():
+    from deepdfa_tpu.eval.codebleu import _parse_py, _py_dataflow_triples
+
+    tree = _parse_py(
+        "n = base\nfor i in items:\n    n += i\nprint(n)\n"
+    )
+    triples = _py_dataflow_triples(tree)
+    rels = {(t[0], t[1]) for t in triples}
+    assert ("n", "computedFrom") in rels
+    assert ("i", "computedFrom") in rels  # for-target
+    assert ("n", "comesFrom") in rels  # the print(n) use
+
+
+def test_unsupported_lang_still_raises():
+    import pytest
+
+    from deepdfa_tpu.eval.codebleu import get_codebleu
+
+    with pytest.raises(ValueError, match="descoped"):
+        get_codebleu(["int x;"], ["int x;"], lang="java")

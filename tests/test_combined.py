@@ -12,6 +12,10 @@ from deepdfa_tpu.models.transformer import TransformerConfig
 from deepdfa_tpu.parallel import make_mesh
 from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def corpus():

@@ -1,5 +1,7 @@
 """dp x tp x sp combined training must match single-device numerics."""
 
+import math
+
 import numpy as np
 import pytest
 
@@ -11,6 +13,10 @@ from deepdfa_tpu.models import combined as cmb
 from deepdfa_tpu.models.transformer import TransformerConfig
 from deepdfa_tpu.parallel import make_mesh
 from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
 
 
 def _setup():
@@ -44,6 +50,11 @@ def _setup():
     (dict(dp=2, tp=1, sp=4), "ulysses"),
     (dict(dp=4, pp=2), "ring"),
     (dict(dp=2, tp=2, pp=2), "ring"),
+    # pp x sp compositions (the guard removed in round 3): ring attention
+    # inside the GPipe stage body, sp-offset embedding in the pipeline
+    (dict(dp=1, sp=2, pp=2), "ring"),
+    (dict(dp=1, tp=2, sp=2, pp=2), "ring"),
+    (dict(dp=1, sp=2, pp=2), "ulysses"),
 ])
 def test_parallel_matches_single(mesh_cfg, sp_variant):
     import dataclasses as dc
@@ -56,7 +67,8 @@ def test_parallel_matches_single(mesh_cfg, sp_variant):
             mcfg, encoder=dc.replace(mcfg.encoder, sp_variant=sp_variant)
         )
 
-    mesh_p = make_mesh(MeshConfig(**mesh_cfg))
+    n_dev = math.prod(mesh_cfg.values())
+    mesh_p = make_mesh(MeshConfig(**mesh_cfg), devices=jax.devices()[:n_dev])
     mesh_1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
 
     tp_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_p)
@@ -138,10 +150,16 @@ def test_t5_encode_sp_matches_dense(rng):
 @pytest.mark.parametrize("mesh_cfg", [
     dict(dp=2, tp=2, sp=2),
     dict(dp=1, tp=1, sp=8),
+    # pp compositions (round-3: the t5+pp guard removed): GPipe over the
+    # T5 encoder stack, rel-bias computed per stage, alone and with sp
+    dict(dp=2, pp=2),
+    dict(dp=1, tp=2, pp=2),
+    dict(dp=1, sp=2, pp=2),
+    dict(dp=1, tp=2, sp=2, pp=2),
 ])
 def test_t5_parallel_matches_single(mesh_cfg):
-    """T5 combined training on dp x tp x sp == single device (the sp path
-    previously raised NotImplementedError)."""
+    """T5 combined training on dp x tp x sp x pp == single device (the
+    t5-pp and sp-pp paths previously raised NotImplementedError)."""
     import jax
 
     from deepdfa_tpu.models import t5 as t5m
@@ -168,7 +186,8 @@ def test_t5_parallel_matches_single(mesh_cfg):
         Config(), ["train.optim.name=sgd", "train.optim.learning_rate=0.05"]
     )
 
-    mesh_p = make_mesh(MeshConfig(**mesh_cfg))
+    n_dev = math.prod(mesh_cfg.values())
+    mesh_p = make_mesh(MeshConfig(**mesh_cfg), devices=jax.devices()[:n_dev])
     mesh_1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
     p_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_p)
     s_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_1)
@@ -285,7 +304,8 @@ def test_moe_combined_matches_single(mesh_cfg):
     token_ids, labels, by_id, mcfg, cfg, n = _setup()
     mcfg = dc.replace(mcfg, moe_experts=4, moe_top_k=2)
 
-    mesh_p = make_mesh(MeshConfig(**mesh_cfg))
+    n_dev = math.prod(mesh_cfg.values())
+    mesh_p = make_mesh(MeshConfig(**mesh_cfg), devices=jax.devices()[:n_dev])
     mesh_1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
     p_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_p)
     s_trainer = CombinedTrainer(cfg, mcfg, mesh=mesh_1)

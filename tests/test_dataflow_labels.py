@@ -95,6 +95,7 @@ def test_pack_rejects_mixed_bit_presence(corpus):
         )
 
 
+@pytest.mark.slow  # e2e training: slow lane
 @pytest.mark.parametrize("style", ["dataflow_solution_in", "dataflow_solution_out"])
 def test_dataflow_style_trains_and_beats_random(corpus, style):
     """VERDICT round-1 item 4: the style must train end to end to finite

@@ -13,6 +13,10 @@ from deepdfa_tpu.models import DeepDFA
 from deepdfa_tpu.parallel import make_mesh
 from deepdfa_tpu.train import GraphTrainer
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def test_devign_reader_to_training(tmp_path, rng):
     """Graph-level labels only (no line annotations) must flow through the

@@ -1,5 +1,7 @@
 """Experiment-matrix runner (run_exp.py role)."""
 
+import pytest
+
 import json
 
 from deepdfa_tpu.train.experiments import (
@@ -9,6 +11,10 @@ from deepdfa_tpu.train.experiments import (
     parse_result,
     run_matrix,
 )
+
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
 
 
 def test_expand_matrix_tags_and_seeds():

@@ -11,6 +11,10 @@ from deepdfa_tpu.models import t5_gen as gen
 from deepdfa_tpu.parallel import make_mesh
 from deepdfa_tpu.train.gen_loop import GenTrainer
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 EOS, PAD = 2, 0
 
 

@@ -1,0 +1,98 @@
+"""IVDetect per-line feature dump (eval/ivdetect.py) vs the reference's
+feature_extraction semantics (DDFA/sastvd/helpers/evaluate.py:19-191).
+
+Note the IVDetect tokeniser (frontend/tokenise.py, a cited port) drops
+single-character subtokens — expectations below use multi-char names.
+"""
+
+import json
+
+from deepdfa_tpu.eval.ivdetect import (
+    dump_features,
+    feature_extraction_code,
+)
+
+CODE = """int scale(int nval, int kval) {
+  int acc = 0;
+  int step = kval + 1;
+  if (nval > 10) {
+    acc = nval * step;
+  }
+  return acc;
+}
+"""
+
+
+def rows_by_line(code=CODE):
+    rows, pdg = feature_extraction_code(code)
+    return {r.line: r for r in rows}, pdg
+
+
+def test_every_statement_line_has_a_row():
+    rows, _ = rows_by_line()
+    # line 6 is a lone closing brace: no nodes, no row (as in the
+    # reference, whose nodes df has nothing there either)
+    assert {2, 3, 4, 5, 7} <= set(rows)
+    assert 6 not in rows
+
+
+def test_subseq_is_tokenised_line_code_with_decl_type_prefix():
+    rows, _ = rows_by_line()
+    toks = rows[3].subseq.split()
+    # longest code on line 3 is "step = kval + 1"; declared type prefixes
+    assert toks[0] == "int"
+    assert "step" in toks and "kval" in toks
+    assert "=" not in rows[3].subseq  # tokenisation strips punctuation
+
+
+def test_nametypes_pairs_types_with_identifiers():
+    rows, _ = rows_by_line()
+    toks = rows[2].nametypes.split()
+    assert "int" in toks and "acc" in toks
+
+
+def test_intra_line_ast_is_rooted_and_indexed():
+    rows, _ = rows_by_line()
+    parents, children, codes = rows[5].ast
+    n = len(codes)
+    assert len(parents) == len(children) > 0
+    assert all(0 <= i < n for i in parents + children)
+    # re-rooting: every non-zero node is reachable as a child
+    assert set(range(1, n)) <= set(children)
+
+
+def test_data_context_follows_reaching_defs():
+    rows, _ = rows_by_line()
+    # step (line 3) flows into line 5's assignment; line 5 flows into the
+    # return on line 7. Symmetrized, line 5's data context has both.
+    assert 3 in rows[5].data
+    assert 7 in rows[5].data
+    assert 5 in rows[3].data  # undirected view
+
+
+def test_control_context_ties_branch_body_to_condition():
+    rows, _ = rows_by_line()
+    assert 4 in rows[5].control  # line 5 is control-dependent on the if
+    assert 5 in rows[4].control  # symmetrized
+
+
+def test_pdg_edges_are_line_level_and_consistent():
+    rows, (src, dst) = rows_by_line()
+    assert len(src) == len(dst) > 0
+    lines = set(rows)
+    assert set(src) <= lines and set(dst) <= lines
+
+
+def test_dump_features_json_roundtrip(tmp_path):
+    out = tmp_path / "feat.json"
+    dump_features(CODE, out)
+    rec = json.loads(out.read_text())
+    assert {"lines", "pdg_edges"} <= set(rec)
+    assert [row["line"] for row in rec["lines"]] == sorted(
+        row["line"] for row in rec["lines"]
+    )
+    assert all(
+        {"line", "subseq", "ast", "nametypes", "data", "control"}
+        <= set(row)
+        for row in rec["lines"]
+    )

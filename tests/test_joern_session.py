@@ -17,6 +17,10 @@ import pytest
 from deepdfa_tpu.frontend import joern_session
 from deepdfa_tpu.frontend.joern_session import JoernSession, JoernTimeout
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def _stub(tmp_path, body: str) -> str:
     """A marker-echoing stand-in for the joern REPL."""

@@ -5,6 +5,8 @@ both tasks' dev perplexity must improve, the mixture must visit both
 tasks, and the per-task dual-counter early stop must end the run.
 """
 
+import pytest
+
 import numpy as np
 
 from deepdfa_tpu.core import Config, MeshConfig
@@ -20,6 +22,10 @@ from deepdfa_tpu.train.multi_gen import (
     fit_multi,
     mixture_probs,
 )
+
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
 
 EOS, PAD = 2, 0
 

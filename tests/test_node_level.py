@@ -14,6 +14,11 @@ from deepdfa_tpu.graphs import pack_shards
 from deepdfa_tpu.models import DeepDFA
 from deepdfa_tpu.parallel import make_mesh
 from deepdfa_tpu.train import GraphTrainer
+import pytest
+
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
 
 
 def test_node_level_training_and_localization():

@@ -6,6 +6,8 @@ but it goes further: the model must actually learn to separate the injected
 vulnerability patterns.
 """
 
+import pytest
+
 import numpy as np
 
 from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
@@ -52,6 +54,7 @@ def test_pipeline_extracts_most_graphs():
     assert any((s.node_feats > 0).any() for s in specs)
 
 
+@pytest.mark.slow  # e2e training: slow lane
 def test_end_to_end_training_beats_chance():
     n = 400
     synth = generate(n, vuln_rate=0.25, seed=3)
@@ -133,6 +136,7 @@ int f(int a) {
     }
 
 
+@pytest.mark.slow  # e2e training: slow lane
 def test_end_to_end_training_cfg_dep_n_etypes():
     """The typed-edge pipeline trains end to end with an n_etypes=3 GGNN."""
     import jax

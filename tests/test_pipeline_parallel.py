@@ -19,6 +19,10 @@ from deepdfa_tpu.parallel.pipeline import (
     split_stages,
 )
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def setup():
@@ -106,3 +110,61 @@ def test_pipeline_dropout_runs_and_differs_across_stages(setup):
     )
     assert np.isfinite(np.asarray(noisy)).all()
     assert np.abs(np.asarray(noisy) - np.asarray(clean)).max() > 1e-4
+
+
+# ---------------------------------------------------------------------------
+# T5 pipeline (round 3: pp x t5 composition)
+
+
+@pytest.fixture(scope="module")
+def t5_setup():
+    from deepdfa_tpu.models import t5 as t5m
+
+    cfg = t5m.T5Config.tiny(vocab_size=64, dropout_rate=0.0, remat=False)
+    params = t5m.init_params(cfg, jax.random.key(2))
+    ids = np.array(
+        jax.random.randint(jax.random.key(3), (8, 12), 5, 60), np.int32
+    )
+    ids[:, -2:] = cfg.pad_token_id
+    return cfg, params, jnp.asarray(ids)
+
+
+@pytest.mark.parametrize("pp,microbatches", [(2, 4), (2, 2)])
+def test_t5_pipeline_matches_single_device(t5_setup, pp, microbatches):
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.parallel.pipeline import t5_pipeline_encode
+
+    cfg, params, ids = t5_setup
+    mesh = make_mesh(MeshConfig(dp=1, pp=pp), devices=jax.devices()[:pp])
+    want = np.asarray(t5m.encode(cfg, params, ids))
+    got = np.asarray(
+        jax.jit(
+            lambda p, x: t5_pipeline_encode(
+                cfg, p, x, mesh, microbatches=microbatches
+            )
+        )(params, ids)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_t5_pipeline_gradients_match(t5_setup):
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.parallel.pipeline import t5_pipeline_encode
+
+    cfg, params, ids = t5_setup
+    mesh = make_mesh(MeshConfig(dp=1, pp=2), devices=jax.devices()[:2])
+
+    def loss_single(p):
+        h = t5m.encode(cfg, p, ids)
+        return jnp.sum(h[:, 0, :] ** 2)
+
+    def loss_pp(p):
+        h = t5_pipeline_encode(cfg, p, ids, mesh, microbatches=4)
+        return jnp.sum(h[:, 0, :] ** 2)
+
+    g1 = jax.jit(jax.grad(loss_single))(params)
+    g2 = jax.jit(jax.grad(loss_pp))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+        )

@@ -1,0 +1,114 @@
+"""Preprocessor conditional evaluation (frontend/preproc.py).
+
+The reference's Joern sees function text after real preprocessing with an
+empty predefined-macro table; these tests pin the ISO-C conditional
+semantics (unknown id = 0, defined(), file-local #define table) and the
+line-structure guarantee the CPG's line numbers depend on.
+"""
+
+from deepdfa_tpu.frontend.preproc import evaluate_conditionals
+
+
+def lines(code):
+    return evaluate_conditionals(code).split("\n")
+
+
+def test_line_count_always_preserved():
+    code = "a\n#if 0\nb\n#else\nc\n#endif\nd\n"
+    out = evaluate_conditionals(code)
+    assert len(out.split("\n")) == len(code.split("\n"))
+
+
+def test_if0_drops_then_keeps_else():
+    out = lines("#if 0\nX;\n#else\nY;\n#endif\n")
+    assert out[1] == "" and out[3] == "Y;"
+
+
+def test_if1_keeps_then_drops_else():
+    out = lines("#if 1\nX;\n#else\nY;\n#endif\n")
+    assert out[1] == "X;" and out[3] == ""
+
+
+def test_ifdef_unknown_macro_is_inactive():
+    out = lines("#ifdef NOPE\nX;\n#else\nY;\n#endif\n")
+    assert out[1] == "" and out[3] == "Y;"
+
+
+def test_ifndef_unknown_macro_is_active():
+    out = lines("#ifndef NOPE\nX;\n#endif\n")
+    assert out[1] == "X;"
+
+
+def test_define_makes_ifdef_active():
+    out = lines("#define HAVE_FOO\n#ifdef HAVE_FOO\nX;\n#endif\n")
+    assert out[2] == "X;"
+
+
+def test_undef_deactivates():
+    out = lines(
+        "#define A\n#undef A\n#ifdef A\nX;\n#endif\n"
+    )
+    assert out[3] == ""
+
+
+def test_unknown_identifier_evaluates_to_zero():
+    # ISO C 6.10.1p4: remaining identifiers become 0
+    out = lines("#if CONFIG_THING\nX;\n#endif\n")
+    assert out[1] == ""
+
+
+def test_defined_operator():
+    out = lines(
+        "#define W 1\n#if defined(W) && !defined(Z)\nX;\n#endif\n"
+    )
+    assert out[2] == "X;"
+
+
+def test_elif_chain_takes_first_true():
+    code = "#if 0\na;\n#elif 1\nb;\n#elif 1\nc;\n#else\nd;\n#endif\n"
+    out = lines(code)
+    assert out[1] == "" and out[3] == "b;" and out[5] == "" and out[7] == ""
+
+
+def test_nested_conditionals():
+    code = (
+        "#if 1\n"
+        "a;\n"
+        "#if 0\n"
+        "b;\n"
+        "#endif\n"
+        "c;\n"
+        "#endif\n"
+    )
+    out = lines(code)
+    assert out[1] == "a;" and out[3] == "" and out[5] == "c;"
+
+
+def test_object_macro_expansion_outside_strings():
+    code = '#define N 16\nint a[N];\nchar *s = "N";\n'
+    out = lines(code)
+    assert out[1] == "int a[16];"
+    assert out[2] == 'char *s = "N";'
+
+
+def test_function_like_macros_not_expanded():
+    code = "#define SQ(x) ((x)*(x))\nint y = SQ(3);\n"
+    out = lines(code)
+    assert out[1] == "int y = SQ(3);"
+
+
+def test_undecidable_expression_stays_active():
+    out = lines("#if FOO(1)\nX;\n#endif\n")
+    assert out[1] == "X;"
+
+
+def test_macro_value_drives_if():
+    out = lines("#define LEVEL 2\n#if LEVEL > 1\nX;\n#endif\n")
+    assert out[2] == "X;"
+
+
+def test_continued_directive_lines_blanked():
+    code = "#define LONG \\\n  1\n#if LONG\nX;\n#endif\n"
+    out = lines(code)
+    assert out[0] == "" and out[1] == ""
+    assert out[3] == "X;"

@@ -5,6 +5,10 @@ import pytest
 
 from deepdfa_tpu.models import t5 as t5m
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def test_matches_hf_t5_encoder(rng):
     torch = pytest.importorskip("torch")

@@ -6,6 +6,10 @@ import pytest
 from deepdfa_tpu.models import t5 as t5m
 from deepdfa_tpu.models import t5_gen as gen
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def _tiny_pair():
     torch = pytest.importorskip("torch")

@@ -11,6 +11,10 @@ from deepdfa_tpu.models import t5 as t5m
 from deepdfa_tpu.parallel import make_mesh
 from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def _setup(n=16):
     synth = generate(n, vuln_rate=0.4, seed=13)

@@ -14,6 +14,10 @@ from deepdfa_tpu.train import (
     undersample_epoch,
 )
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def synthetic_dataset(rng, n_graphs=64, vocab=20):
     """Graphs whose label = presence of feature token 7 on any node."""

@@ -8,6 +8,10 @@ import pytest
 from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
 from deepdfa_tpu.train.tuning import SearchSpace, Tuner, grid_search, random_search
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def test_search_space_and_grid():
     space = SearchSpace(choices={"model.hidden_dim": [8, 16]})

@@ -7,6 +7,10 @@ import pytest
 
 from deepdfa_tpu.models import transformer as tfm
 
+# heavy compiles / subprocesses: excluded from the default fast lane
+# (pyproject addopts); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
 
 def _random_ids(rng, b, t, vocab, pad_id=1, pad_tail=3):
     ids = rng.integers(5, vocab, (b, t))
