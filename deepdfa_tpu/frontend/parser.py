@@ -127,6 +127,24 @@ class _Label(_Stmt):
         self.line = line
 
 
+class _Try(_Stmt):
+    #: handlers: (catch_node_id, body) per catch clause
+    def __init__(self, body: _Stmt, handlers: list[tuple[int, _Stmt]]):
+        self.body, self.handlers = body, handlers
+
+
+class _Throw(_Stmt):
+    def __init__(self, node: int):
+        self.node = node
+
+
+class _RangeFor(_Stmt):
+    #: C++ range-for: `for (decl : expr) body`; expr_top is the per-
+    #: iteration assignment call at the for line
+    def __init__(self, expr: _Expr, body: _Stmt):
+        self.expr, self.body = expr, body
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -294,6 +312,21 @@ class Parser:
                                 break
                     continue
                 parts.append(self.eat().text)
+                continue
+            if t.kind == "id" and t.text == "decltype" and self.peek(1).text == "(":
+                # C++ decltype(expr) as a type atom: keep the token text,
+                # skip the parenthesized expression
+                self.eat()
+                depth = 0
+                while not self.at_eof():
+                    tt = self.eat()
+                    if tt.text == "(":
+                        depth += 1
+                    elif tt.text == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                parts.append("decltype(...)")
                 continue
             if t.kind == "id" and not saw_base():
                 # don't eat the declarator NAME as a base type: plain id
@@ -732,6 +765,28 @@ class Parser:
                     code=f"goto {label};", line=t.line,
                 )
                 return _Goto(label, node)
+        # C++ statement keywords are plain identifiers to the C lexer
+        if t.kind == "id" and t.text == "try" and self.peek(1).text == "{":
+            return self._parse_try()
+        if t.kind == "id" and t.text == "throw":
+            self.eat()
+            if not self.at(";"):
+                expr = self.parse_expression()
+            else:
+                expr = None
+            if self.at(";"):
+                self.eat()
+            node = self._node(
+                "CONTROL_STRUCTURE", name="throw",
+                code="throw"
+                + (f" {self._code(expr)};" if expr is not None else ";"),
+                line=t.line,
+            )
+            if expr is not None:
+                self.cpg.add_edge(node, expr, C.AST)
+                self.cpg.add_edge(node, expr, C.ARGUMENT)
+                self.cpg.nodes[expr].order = 1
+            return _Throw(node)
         # label: `name:` followed by statement
         if t.kind == "id" and self.peek(1).text == ":" and self.peek(2).text != ":":
             self.eat()
@@ -744,6 +799,38 @@ class Parser:
         if self.at(";"):
             self.eat()
         return _Expr(expr)
+
+    def _parse_try(self) -> _Stmt:
+        """`try { body } catch (param) { handler }...` — Joern keeps try/
+        catch as CONTROL_STRUCTURE nodes; at line level the handlers are
+        alternative paths entered via a `catch` node at the clause line."""
+        self.eat()  # 'try'
+        body = self._parse_block()
+        handlers: list[tuple[int, _Stmt]] = []
+        while self.peek().kind == "id" and self.peek().text == "catch":
+            kw = self.eat()
+            param_code = ""
+            if self.at("("):
+                depth = 0
+                toks = []
+                while not self.at_eof():
+                    tok = self.eat()
+                    if tok.text == "(":
+                        depth += 1
+                        if depth == 1:
+                            continue
+                    if tok.text == ")":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    toks.append(tok.text)
+                param_code = " ".join(toks)
+            node = self._node(
+                "CONTROL_STRUCTURE", name="catch",
+                code=f"catch ({param_code})", line=kw.line,
+            )
+            handlers.append((node, self.parse_statement()))
+        return _Try(body, handlers)
 
     def _parse_block(self) -> _Stmt:
         self.eat("{")
@@ -790,10 +877,66 @@ class Parser:
             self.eat()
         return _DoWhile(body, cond)
 
+    def _at_range_for(self) -> bool:
+        """After `for (` — does a ':' appear before the first ';' at
+        depth 0 (C++ range-for)? `::` qualifiers don't count."""
+        depth = 0
+        quest = 0  # pending ternary '?'s — their ':' is not a range-for
+        k = 0
+        while True:
+            t = self.peek(k)
+            if t.kind == "eof" or t.text in (";", "{"):
+                return False
+            if t.text in ("(", "["):
+                depth += 1
+            elif t.text in (")", "]"):
+                if depth == 0:
+                    return False
+                depth -= 1
+            elif t.text == "?" and depth == 0:
+                quest += 1
+            elif t.text == ":" and depth == 0:
+                if quest:
+                    quest -= 1
+                else:
+                    return True
+            k += 1
+
+    def _parse_range_for(self) -> _Stmt:
+        """`for (T x : expr) body` — per-iteration assignment at the for
+        line (Joern's iterator desugaring yields an `<operator>.
+        assignment` there), body loops back to it."""
+        start = self.peek()
+        base = self._parse_type()
+        name, full = self._parse_declarator(base)
+        if name is None:
+            raise ParseError("range-for declarator")
+        self.scope.vars[name] = full
+        self._node(
+            "LOCAL", name=name, code=f"{full} {name}", line=start.line,
+            type_full_name=full,
+        )
+        ident = self._node(
+            "IDENTIFIER", name=name, code=name, line=start.line,
+            type_full_name=full,
+        )
+        self.eat(":")
+        rng = self.parse_expression()
+        call = self._call(
+            C.OP_NAMES["="], f"{name} = *({self._code(rng)})", start.line,
+            [ident, rng],
+        )
+        self.eat(")")
+        body = self.parse_statement()
+        self.scope = self.scope.parent
+        return _RangeFor(_Expr(call), body)
+
     def _parse_for(self) -> _Stmt:
         self.eat("for")
         self.eat("(")
         self.scope = _Scope(self.scope)
+        if self._at_range_for():
+            return self._parse_range_for()
         init: _Stmt | None = None
         if not self.at(";"):
             if self._at_type_start():
@@ -1278,6 +1421,39 @@ class _CfgBuilder:
                 else:
                     target.append(node)
             self.frontier = []
+        elif isinstance(s, _Try):
+            # handlers are alternative paths: entered from the try entry
+            # (any body statement may throw; the line-level simplification
+            # branches at entry and at body exit) via the catch node
+            entry_f = list(self.frontier)
+            self.stmt(s.body)
+            body_exits = list(self.frontier)
+            all_exits = list(body_exits)
+            for catch_node, handler in s.handlers:
+                # dedup: an empty try body makes entry_f == body_exits
+                for nid in dict.fromkeys(entry_f + body_exits):
+                    self.cpg.add_edge(nid, catch_node, C.CFG)
+                self.frontier = [catch_node]
+                self.stmt(handler)
+                all_exits.extend(self.frontier)
+            self.frontier = all_exits
+        elif isinstance(s, _Throw):
+            # throw leaves the function (line level): no fall-through
+            for nid in self.frontier:
+                self.cpg.add_edge(nid, s.node, C.CFG)
+            self.cpg.add_edge(s.node, self.cpg.method_return_id, C.CFG)
+            self.frontier = []
+        elif isinstance(s, _RangeFor):
+            expr_first = self._first_of(s.expr.top)
+            self.emit_expr(s.expr.top)
+            expr_top = self.frontier[0]
+            self.break_stack.append([])
+            self.continue_stack.append(("node", expr_first))
+            self.stmt(s.body)
+            for nid in self.frontier:
+                self.cpg.add_edge(nid, expr_first, C.CFG)
+            self.frontier = [expr_top] + self.break_stack.pop()
+            self.continue_stack.pop()
         elif isinstance(s, _Goto):
             for nid in self.frontier:
                 self.cpg.add_edge(nid, s.node, C.CFG)

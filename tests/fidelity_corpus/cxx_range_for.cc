@@ -1,0 +1,7 @@
+int sum_all(std::vector<int> &v) {
+  int total = 0;
+  for (int x : v) {
+    total += x;
+  }
+  return total;
+}

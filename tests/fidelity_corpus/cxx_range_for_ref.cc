@@ -1,0 +1,8 @@
+int max_len(std::vector<std::string> &names) {
+  int best = 0;
+  for (const auto &nm : names) {
+    if ((int)nm.size() > best)
+      best = nm.size();
+  }
+  return best;
+}

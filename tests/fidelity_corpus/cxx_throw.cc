@@ -1,0 +1,5 @@
+int checked_div(int a, int b) {
+  if (b == 0)
+    throw std::runtime_error("div0");
+  return a / b;
+}

@@ -1,0 +1,11 @@
+int parse_num(const char *s) {
+  int v = 0;
+  try {
+    v = std::stoi(s);
+    v = v * 2;
+  } catch (const std::exception &e) {
+    log_err(e);
+    v = -1;
+  }
+  return v;
+}

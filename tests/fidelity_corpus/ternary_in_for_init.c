@@ -1,0 +1,7 @@
+int tsum(int n, int flag) {
+  int acc = 0;
+  for (int i = flag ? 1 : 0; i < n; i++) {
+    acc += i;
+  }
+  return acc;
+}
