@@ -45,6 +45,8 @@ class DeepDFA(nn.Module):
     label_style: str = "graph"
     encoder_mode: bool = False
     param_dtype: jnp.dtype = jnp.float32
+    #: mesh axis for edge-sharded message passing (parallel/graph_shard.py)
+    edge_axis: str | None = None
 
     @classmethod
     def from_config(cls, cfg: ModelConfig, input_dim: int, **overrides) -> "DeepDFA":
@@ -87,6 +89,7 @@ class DeepDFA(nn.Module):
             n_etypes=self.n_etypes,
             scan_steps=self.scan_steps,
             param_dtype=self.param_dtype,
+            axis_name=self.edge_axis,
             name="ggnn",
         )(batch, feat_embed)
 

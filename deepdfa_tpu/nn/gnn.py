@@ -126,6 +126,12 @@ class GatedGraphConv(nn.Module):
     n_etypes: int = 1
     param_dtype: jnp.dtype = jnp.float32
     scan_steps: bool = False
+    #: graph-dimension sharding (SURVEY §2.5b): inside shard_map with the
+    #: batch's EDGE arrays sharded over this mesh axis (nodes replicated),
+    #: each device segment-sums its local edges' messages and one psum
+    #: makes the aggregate exact — shards the O(E·D) gather/scatter work
+    #: for graph batches whose edges exceed one chip. No param change.
+    axis_name: str | None = None
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
@@ -181,6 +187,11 @@ class GatedGraphConv(nn.Module):
                 a = a + segment_sum(
                     msg, batch.edge_dst, n, indices_are_sorted=True
                 )
+            if self.axis_name is not None:
+                # exact cross-shard aggregate (each shard summed only its
+                # own edge slice; contiguous slices of the dst-sorted
+                # edge list stay sorted, so the fast path above holds)
+                a = jax.lax.psum(a, self.axis_name)
             return gru(a, h)
 
         if self.n_steps == 0:

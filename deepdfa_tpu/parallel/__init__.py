@@ -1,3 +1,7 @@
+from deepdfa_tpu.parallel.graph_shard import (
+    edge_batch_specs,
+    edge_sharded_apply,
+)
 from deepdfa_tpu.parallel.megatron import region_end, region_start
 from deepdfa_tpu.parallel.mesh import (
     AXES,
@@ -38,6 +42,8 @@ __all__ = [
     "MoEConfig",
     "init_moe_params",
     "moe_ffn",
+    "edge_batch_specs",
+    "edge_sharded_apply",
     "moe_ffn_ep",
     "merge_stages",
     "pipeline_encode",

@@ -1,0 +1,10 @@
+"""Version-compat shims for jax APIs the framework uses everywhere."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map"]

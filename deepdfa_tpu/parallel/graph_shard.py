@@ -1,0 +1,89 @@
+"""Graph-dimension sharding: edge-sharded GGNN message passing.
+
+The long-context analog for GRAPHS (SURVEY §2.5b): where sequence
+parallelism shards a transformer's token axis, this shards a graph
+batch's EDGE axis over a mesh axis — for mega-batches (or single huge
+CFGs) whose edge arrays exceed one chip. Node states replicate; each
+device gathers/scatters only its contiguous edge slice and one `psum`
+per propagation step makes the aggregate exact (nn/gnn.py
+GatedGraphConv.axis_name). Contiguous slices of the batcher's dst-sorted
+edge list stay sorted, so the indices_are_sorted segment fast path holds
+per shard.
+
+The reference has no counterpart (DGL batches whole graphs on one GPU,
+dropping test batch size to fit — datamodule.py:135-141); this is
+TPU-first headroom in the same sense as ring attention.
+
+Cost model: shards the O(E·D) edge work and edge storage; the O(N·D)
+node transform and GRU stay replicated. Wins when E >> N (dense CFG
+mega-batches); for ordinary batches prefer dp over whole graphs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.parallel.compat import shard_map
+
+#: the GraphBatch fields carried per edge
+EDGE_FIELDS = ("edge_src", "edge_dst", "edge_mask", "edge_type")
+
+
+def edge_batch_specs(batch: GraphBatch, axis: str = "dp") -> GraphBatch:
+    """A GraphBatch-shaped pytree of PartitionSpecs: edge arrays shard
+    their leading axis over `axis`, everything else replicates."""
+    fields = {}
+    for f in dataclasses.fields(GraphBatch):
+        if f.name == "num_graphs":
+            continue
+        v = getattr(batch, f.name)
+        if v is None:
+            fields[f.name] = None
+        elif f.name in EDGE_FIELDS:
+            fields[f.name] = P(axis)
+        else:
+            fields[f.name] = P()
+    return GraphBatch(**fields, num_graphs=batch.num_graphs)
+
+
+def edge_sharded_apply(
+    model, params, batch: GraphBatch, mesh, axis: str = "dp"
+):
+    """Run `model.apply(params, batch)` with message passing edge-sharded
+    over `axis`. Numerically equal to the unsharded apply (same params —
+    the axis knob adds no parameters); the axis size must divide the
+    edge budget.
+
+    Only the GGNN propagation is axis-aware; the dataflow_solution_*
+    label styles run a separate bitvector-propagation fixpoint over the
+    raw edge arrays with no cross-shard reduction, so they are rejected
+    here rather than silently computing on half the edges.
+    """
+    if getattr(model, "label_style", "graph").startswith("dataflow_solution"):
+        raise ValueError(
+            "edge_sharded_apply supports graph/node label styles only: "
+            "BitvectorPropagation has no cross-shard reduction and would "
+            "silently run on each shard's edge slice"
+        )
+    n_shards = mesh.shape[axis]
+    if batch.edge_src.shape[0] % n_shards:
+        raise ValueError(
+            f"edge budget {batch.edge_src.shape[0]} not divisible by "
+            f"{n_shards} shards on axis {axis!r}"
+        )
+    sharded_model = model.clone(edge_axis=axis)
+
+    def body(p, local: GraphBatch):
+        return sharded_model.apply(p, local)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), edge_batch_specs(batch, axis)),
+        out_specs=P(),
+        check_vma=False,
+    )(params, batch)

@@ -195,10 +195,7 @@ def moe_ffn_ep(cfg: MoEConfig, params: dict, x: jax.Array, mesh,
     batch on every device — callers embedding MoE in their own shard_map
     must use moe_stage_forward on their per-shard tokens instead (as
     CombinedTrainer does). Asserted below."""
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from deepdfa_tpu.parallel.compat import shard_map
 
     n_dev = mesh.shape[ep_axis]
     if cfg.num_experts % n_dev:

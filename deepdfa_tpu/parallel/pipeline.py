@@ -321,10 +321,7 @@ def pipeline_encode(
     `params` is the standard (unstaged) param tree; staging happens here.
     The batch must divide by `microbatches`.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from deepdfa_tpu.parallel.compat import shard_map
 
     n_stages = mesh.shape[pp_axis]
     if attn_mask is None:
@@ -365,10 +362,7 @@ def t5_pipeline_encode(
 ):
     """T5 encoder forward, layer-pipelined over `pp_axis` (same contract
     as models.t5.encode; parity-tested against it)."""
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from deepdfa_tpu.parallel.compat import shard_map
 
     n_stages = mesh.shape[pp_axis]
     if attn_mask is None:

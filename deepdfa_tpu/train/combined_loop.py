@@ -22,10 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deepdfa_tpu.parallel.compat import shard_map
 
 from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.data.text import TextBatch

@@ -30,13 +30,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 stable API
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.parallel.compat import shard_map
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.checkpoint import CheckpointManager
 from deepdfa_tpu.train.losses import (
