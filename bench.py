@@ -72,20 +72,10 @@ def _mfu_fields(flops_per_example: float, graphs_per_sec: float,
 
 
 def _build_workload(n_examples: int):
-    from deepdfa_tpu.data import (
-        bigvul_stmt_sizes,
-        build_dataset,
-        generate,
-        to_examples,
-    )
+    from deepdfa_tpu.data import flagship_corpus
     from deepdfa_tpu.graphs import bucket_batches
 
-    sizes = bigvul_stmt_sizes(n_examples, seed=7)
-    synth = generate(n_examples, vuln_rate=0.06, seed=7, stmt_sizes=sizes)
-    specs, _ = build_dataset(
-        to_examples(synth), train_ids=range(n_examples), limit_all=1000,
-        limit_subkeys=1000,
-    )
+    specs = flagship_corpus(n_examples)
     # one static batch signature; budgets sized so even the clipped p100
     # graph (~500 stmts -> ~1k nodes) fits and nothing is dropped
     num_graphs, node_budget, edge_budget = 256, 16384, 65536
@@ -228,12 +218,7 @@ def run_train_measurement(platform: str) -> dict:
     import numpy as np
 
     from deepdfa_tpu.core import Config
-    from deepdfa_tpu.data import (
-        bigvul_stmt_sizes,
-        build_dataset,
-        generate,
-        to_examples,
-    )
+    from deepdfa_tpu.data import flagship_corpus
     from deepdfa_tpu.eval.profiling import compiled_cost
     from deepdfa_tpu.graphs import shard_bucket_batches
     from deepdfa_tpu.models import DeepDFA
@@ -248,12 +233,7 @@ def run_train_measurement(platform: str) -> dict:
     scan_env = os.environ.get("DEEPDFA_BENCH_SCAN_STEPS", "auto")
     scan = platform != "cpu" if scan_env == "auto" else scan_env == "1"
 
-    sizes = bigvul_stmt_sizes(n_examples, seed=7)
-    synth = generate(n_examples, vuln_rate=0.06, seed=7, stmt_sizes=sizes)
-    specs, _ = build_dataset(
-        to_examples(synth), train_ids=range(n_examples), limit_all=1000,
-        limit_subkeys=1000,
-    )
+    specs = flagship_corpus(n_examples)
     batches = list(
         shard_bucket_batches(specs, 1, 256, 16384, 65536, oversized="raise")
     )

@@ -10,6 +10,7 @@ from deepdfa_tpu.data.pipeline import (
 from deepdfa_tpu.data.synthetic import (
     SynthExample,
     bigvul_stmt_sizes,
+    flagship_corpus,
     generate,
     split_ids,
     to_examples,
@@ -26,6 +27,7 @@ __all__ = [
     "to_graph_spec",
     "SynthExample",
     "bigvul_stmt_sizes",
+    "flagship_corpus",
     "generate",
     "split_ids",
     "to_examples",

@@ -195,3 +195,29 @@ def split_ids(
         perm[n_train : n_train + n_val].tolist(),
         perm[n_train + n_val :].tolist(),
     )
+
+
+def flagship_corpus(
+    n_examples: int,
+    seed: int = 7,
+    vuln_rate: float = 0.06,
+    limit_all: int = 1000,
+    workers: int = 0,
+):
+    """GraphSpecs for the flagship benchmark workload: Big-Vul-tail CFG
+    sizes through the FULL frontend pipeline at the flagship feature
+    limits (limit_all 1000 -> input_dim 1002). The single definition
+    shared by bench.py, scripts/bench_prefetch.py, and anything else
+    that claims to measure "the flagship workload" — so the corpus can
+    never silently diverge between benchmarks."""
+    from deepdfa_tpu.data.pipeline import build_dataset
+
+    sizes = bigvul_stmt_sizes(n_examples, seed=seed)
+    synth = generate(
+        n_examples, vuln_rate=vuln_rate, seed=seed, stmt_sizes=sizes
+    )
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(n_examples),
+        limit_all=limit_all, limit_subkeys=limit_all, workers=workers,
+    )
+    return specs

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Measure the async-input-pipeline overlap win (VERDICT r2 item 6).
+
+Times GraphTrainer.fit epochs over the same pre-built GraphSpec corpus
+with train.prefetch_batches=0 (inline assembly) vs the default 2
+(background thread + sharded device_put), same seed — numerics are
+bit-identical either way (tests/test_prefetch.py), so the only delta is
+wall-clock. Batch ASSEMBLY (bucketing/padding) runs per epoch inside the
+train_batches callable, exactly as the CLI trainer does.
+
+On the 1-core CPU build box, compute and assembly contend for the same
+core, so the measured win is a LOWER bound; on TPU the device computes
+while the host assembles, which is where the overlap pays.
+
+    DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_prefetch.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-examples", type=int, default=2000)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    apply_platform_override()
+    import jax
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.data import flagship_corpus
+    from deepdfa_tpu.data.prefetch import device_placer
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.train import GraphTrainer
+
+    n = args.n_examples
+    specs = flagship_corpus(n)
+
+    def train_batches(_epoch):
+        # per-epoch assembly, as in the CLI trainer (this is the host
+        # work the prefetch thread overlaps with device compute)
+        return shard_bucket_batches(
+            specs, 1, 256, 16384, 65536, oversized="raise"
+        )
+
+    results = {}
+    for depth in (0, 2):
+        cfg = config_mod.apply_overrides(
+            Config(),
+            [
+                f"train.prefetch_batches={depth}",
+                f"train.max_epochs={args.epochs}",
+            ],
+        )
+        model = DeepDFA.from_config(cfg.model, input_dim=1002)
+        trainer = GraphTrainer(model, cfg)
+        state = trainer.init_state(next(iter(train_batches(0))))
+        # compile outside the timed window — with the SAME committed
+        # sharding the fit loop's device_placer uses, or the first timed
+        # step would recompile inside both windows
+        warm = device_placer(trainer.mesh)(next(iter(train_batches(0))))
+        state, _ = trainer.train_step(state, warm)
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        state = trainer.fit(state, train_batches)
+        jax.block_until_ready(state.params)
+        results[f"prefetch_{depth}"] = round(time.perf_counter() - t0, 2)
+
+    off, on = results["prefetch_0"], results["prefetch_2"]
+    record = {
+        "metric": "prefetch_overlap_speedup",
+        "value": round(off / on, 3) if on else None,
+        "unit": "x (fit wall-clock, prefetch off/on)",
+        "seconds_prefetch_off": off,
+        "seconds_prefetch_on": on,
+        "platform": jax.devices()[0].platform,
+        "n_examples": n,
+        "epochs": args.epochs,
+        "note": "1-core CPU hosts understate the win (assembly and "
+        "compute share the core); on TPU the host assembles while the "
+        "device computes",
+    }
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
