@@ -117,6 +117,7 @@ class DeepDFA(nn.Module):
                 n_steps=self.n_steps,
                 union_type="relu",
                 learned_gate=True,
+                axis_name=self.edge_axis,
                 name="bitprop",
             )(
                 batch.node_gen,

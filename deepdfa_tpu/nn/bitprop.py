@@ -106,6 +106,15 @@ class BitvectorPropagation(nn.Module):
     n_steps: int
     union_type: str = "simple"  # simple | relu (nn/setops.py)
     learned_gate: bool = False
+    #: graph-dimension sharding (parallel/graph_shard.py): with edge
+    #: arrays sharded over this mesh axis, each device's segment union
+    #: covers only its local edges; the cross-shard combine is the union
+    #: monoid REDUCED VIA PSUM IN TRANSFORMED SPACE — relu union is a
+    #: clipped sum (clip after psum of the >=0 partials is exact: any
+    #: local clip implies the global sum exceeds 1), simple union
+    #: reduces over log(1-x) (the same trick segment_union itself uses,
+    #: nn/setops.py). One collective, no [P, N, B] gather.
+    axis_name: str | None = None
 
     @nn.compact
     def __call__(
@@ -123,6 +132,8 @@ class BitvectorPropagation(nn.Module):
             gate = nn.sigmoid(nn.Dense(1, name="kill_gate")(gate_in))
             kill = kill * gate
 
+        union = simple_union if self.union_type == "simple" else relu_union
+
         out = gen
         in_ = jnp.zeros_like(gen)
         for _ in range(self.n_steps):
@@ -134,7 +145,21 @@ class BitvectorPropagation(nn.Module):
                 edge_mask,
                 self.union_type,
             )
+            if self.axis_name is not None:
+                if self.union_type == "relu":
+                    # clipped sum: un-clip is impossible, but a local
+                    # clip implies the global sum >= 1, so clipping the
+                    # psum of the clipped partials is still exact
+                    in_ = 1.0 - jax.nn.relu(
+                        1.0 - jax.lax.psum(in_, self.axis_name)
+                    )
+                else:
+                    # simple union over shards = 1 - prod(1 - partial),
+                    # reduced in log space (setops.py's own trick)
+                    log_keep = jnp.log(jnp.clip(1.0 - in_, 1e-30, 1.0))
+                    in_ = 1.0 - jnp.exp(
+                        jax.lax.psum(log_keep, self.axis_name)
+                    )
             survived = in_ * (1.0 - kill)
-            union = simple_union if self.union_type == "simple" else relu_union
             out = union(gen, survived)
         return in_, out

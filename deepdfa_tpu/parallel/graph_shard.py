@@ -56,19 +56,11 @@ def edge_sharded_apply(
     """Run `model.apply(params, batch)` with message passing edge-sharded
     over `axis`. Numerically equal to the unsharded apply (same params —
     the axis knob adds no parameters); the axis size must divide the
-    edge budget.
-
-    Only the GGNN propagation is axis-aware; the dataflow_solution_*
-    label styles run a separate bitvector-propagation fixpoint over the
-    raw edge arrays with no cross-shard reduction, so they are rejected
-    here rather than silently computing on half the edges.
+    edge budget. Both propagation paths are axis-aware: the GGNN
+    aggregates with a per-step psum (nn/gnn.py), the bitvector
+    reaching-definitions fixpoint with a cross-shard union fold
+    (nn/bitprop.py — union is the monoid there, not addition).
     """
-    if getattr(model, "label_style", "graph").startswith("dataflow_solution"):
-        raise ValueError(
-            "edge_sharded_apply supports graph/node label styles only: "
-            "BitvectorPropagation has no cross-shard reduction and would "
-            "silently run on each shard's edge slice"
-        )
     n_shards = mesh.shape[axis]
     if batch.edge_src.shape[0] % n_shards:
         raise ValueError(

@@ -80,12 +80,65 @@ def test_plain_params_drive_the_sharded_model(setup):
     assert sharded.n_steps == model.n_steps
 
 
-def test_dataflow_label_styles_rejected(setup):
-    """BitvectorPropagation has no cross-shard reduction; silently
-    running it on a shard's edge slice produced wrong node states
-    (review finding) — must be rejected loudly."""
-    model, params, batch = setup
+@pytest.fixture(scope="module")
+def dataflow_setup():
+    """dataflow_solution model + bit-labeled batch (exercises the
+    bitvector fixpoint's cross-shard union, nn/bitprop.py)."""
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+
+    synth = generate(10, vuln_rate=0.3, seed=5)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(10), limit_all=32,
+        limit_subkeys=32, max_defs=8,
+    )
+    batch = pack(
+        specs, num_graphs=len(specs), node_budget=512, edge_budget=1024
+    )
+    model = DeepDFA.from_config(
+        config_mod.apply_overrides(
+            Config(), ["model.label_style=dataflow_solution_in"]
+        ).model,
+        input_dim=34, hidden_dim=8,
+    )
+    params = model.init(jax.random.key(1), batch)
+    return model, params, batch
+
+
+def test_dataflow_label_style_parity(dataflow_setup):
+    """The bitvector reaching-definitions fixpoint is also axis-aware
+    (per-shard partial IN sets combine through the union monoid, psum'd
+    in transformed space) — edge-sharded apply must equal the unsharded
+    one for the dataflow_solution label styles too (an earlier version
+    silently ran on each shard's edge slice; review repro: 0.219 max
+    error)."""
+    model, params, batch = dataflow_setup
     mesh = make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
-    df_model = model.clone(label_style="dataflow_solution_in")
-    with pytest.raises(ValueError, match="graph/node label styles"):
-        edge_sharded_apply(df_model, params, batch, mesh)
+    want = np.asarray(model.apply(params, batch))
+    got = np.asarray(
+        jax.jit(
+            lambda p, b: edge_sharded_apply(model, p, b, mesh)
+        )(params, batch)
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+
+def test_dataflow_label_style_gradient_parity(dataflow_setup):
+    """The dataflow styles exist to be TRAINED (learned_gate): gradients
+    through the clip + transformed-space psum must match the unsharded
+    backward."""
+    model, params, batch = dataflow_setup
+    mesh = make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+
+    def loss_single(p):
+        return jnp.sum(model.apply(p, batch) ** 2)
+
+    def loss_sharded(p):
+        return jnp.sum(edge_sharded_apply(model, p, batch, mesh) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_single))(params)
+    g2 = jax.jit(jax.grad(loss_sharded))(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        # the log/exp + psum reassociation perturbs the last float bits
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-6
+        )
