@@ -33,6 +33,24 @@ def _load_config(args) -> Config:
     return cfg
 
 
+def _load_run_config(args) -> Config:
+    """Config for commands that operate on an EXISTING run (test,
+    localize): the run's saved config.json is the base, so model/data
+    dims always match the checkpoint being restored (train saves it,
+    cmd_train:332 — the reference gets this via re-passing the same
+    stacked yamls, main_cli.py); explicit --config or CLI overrides
+    still apply on top."""
+    cfg = _load_config(args)
+    if args.config is None:
+        saved = paths.runs_dir(cfg.run_name) / "config.json"
+        if saved.exists():
+            cfg = config_mod.load(saved)
+            cfg = config_mod.apply_overrides(cfg, args.overrides)
+            config_mod.validate(cfg)
+            config_mod.apply_sanitizers(cfg)
+    return cfg
+
+
 def _graphs_dirname(cfg: Config) -> str:
     """Graph-store directory for the configured feat x gtype; the flagship
     cfg gtype keeps the historical name so existing artifacts stay valid."""
@@ -369,7 +387,7 @@ def cmd_test(args) -> None:
     from deepdfa_tpu.parallel import make_mesh
     from deepdfa_tpu.train import GraphTrainer, classification_report
 
-    cfg = _load_config(args)
+    cfg = _load_run_config(args)
     split_specs = _load_graph_splits(cfg)
     run_dir = paths.runs_dir(cfg.run_name)
     mesh = make_mesh(cfg.train.mesh)
@@ -562,6 +580,9 @@ def cmd_train_combined(args) -> None:
     ds = cfg.data.dataset
     out_dir = paths.processed_dir(ds)
     run_dir = paths.runs_dir(cfg.run_name)
+    # run-config manifest, as cmd_train writes: localize/test restore
+    # the checkpoint with the dims it was trained with (_load_run_config)
+    config_mod.to_json(cfg, run_dir / "config.json")
     with (out_dir / "examples.pkl").open("rb") as f:
         examples = pickle.load(f)
     splits = json.loads((out_dir / "splits.json").read_text())
@@ -1097,7 +1118,7 @@ def cmd_localize(args) -> None:
     from deepdfa_tpu.parallel import make_mesh
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
-    cfg = _load_config(args)
+    cfg = _load_run_config(args)
     _require_cfg_gtype(cfg, "localize")
     out_dir = paths.processed_dir(cfg.data.dataset)
     run_dir = paths.runs_dir(cfg.run_name)

@@ -109,3 +109,28 @@ def test_removed_config_key_tolerated():
     assert cfg.model.hidden_dim == 16
     with pytest.raises(KeyError, match="unknown config key"):
         config_mod.from_dict({"model": {"definitely_not_a_key": 1}})
+
+
+def test_test_command_restores_run_config(storage):
+    """`test run_name=X` must rebuild the model from the RUN's saved
+    config.json (train writes it), not CLI defaults — a run trained with
+    non-default dims previously crashed with a param shape error
+    (found by a corpus-scale pipeline drive in round 3)."""
+    from deepdfa_tpu.cli.main import main
+
+    main(["prepare", "--source", "synthetic", "--n-examples", "24"])
+    main(["extract", "data.feat.limit_all=64", "data.feat.limit_subkeys=64"])
+    main([
+        "train", "run_name=cfg_roundtrip", "train.max_epochs=1",
+        "model.hidden_dim=16", "data.feat.limit_all=64",
+        "data.feat.limit_subkeys=64",
+    ])
+    # no model/data overrides here: the saved run config must supply them
+    main(["test", "run_name=cfg_roundtrip"])
+    # and explicit overrides still win over the saved config: forcing a
+    # different width must reach the model and fail at checkpoint
+    # restore with a SHAPE error (not be silently ignored)
+    import flax.errors
+
+    with pytest.raises(flax.errors.ScopeParamShapeError):
+        main(["test", "run_name=cfg_roundtrip", "model.hidden_dim=8"])
