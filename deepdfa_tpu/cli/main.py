@@ -356,9 +356,14 @@ def cmd_train(args) -> None:
     pw = None
     if cfg.train.pos_weight is None and not cfg.data.undersample:
         pw = positive_weight(np.array([s.label for s in split_specs["train"]]))
-    trainer = GraphTrainer(model, cfg, mesh=mesh, pos_weight=pw)
-
+    # epoch-0 batches double as the warmup-schedule step estimate (the
+    # undersampled epoch size; warmup_frac needs total_steps at
+    # optimizer construction, train/state.py:make_optimizer)
     batches0 = _epoch_batches(cfg, split_specs["train"], mesh, shuffle_epoch=0)
+    trainer = GraphTrainer(
+        model, cfg, mesh=mesh, pos_weight=pw,
+        total_steps=len(batches0) * max(1, cfg.train.max_epochs),
+    )
     state = trainer.init_state(batches0[0])
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
 
@@ -615,12 +620,27 @@ def cmd_train_combined(args) -> None:
     dp = mesh.shape.get("dp", 1)
     rows_per_shard = max(1, 16 // dp)
     bs = dp * rows_per_shard
-    trainer = CombinedTrainer(
-        cfg, mcfg, mesh=mesh, freeze_graph=args.freeze_graph
-    )
 
     def split_ids_for(name):
         return [int(k) for k, v in splits.items() if v == name and int(k) in by_id]
+
+    # the 20%-linear-warmup AdamW schedule (reference linevul_main.py:
+    # 150-162) needs the total step count at optimizer construction —
+    # sized to the UNDERSAMPLED epoch when undersampling is on, or the
+    # schedule would be stretched past the steps the run ever takes
+    train_ids = split_ids_for("train")
+    train_labels = np.array([labels[i] for i in train_ids])
+    if cfg.data.undersample and len(train_ids):
+        epoch_rows = len(
+            undersample_epoch(train_labels, 0, seed=cfg.data.seed)
+        )
+    else:
+        epoch_rows = len(train_ids)
+    steps_per_epoch = max(1, -(-epoch_rows // bs))
+    trainer = CombinedTrainer(
+        cfg, mcfg, mesh=mesh, freeze_graph=args.freeze_graph,
+        total_steps=steps_per_epoch * max(1, cfg.train.max_epochs),
+    )
 
     def batches(ids):
         out = []
@@ -640,9 +660,6 @@ def cmd_train_combined(args) -> None:
                 )
             )
         return out
-
-    train_ids = split_ids_for("train")
-    train_labels = np.array([labels[i] for i in train_ids])
 
     def epoch_batches(epoch):
         if cfg.data.undersample:
@@ -695,11 +712,12 @@ def cmd_train_combined(args) -> None:
     print("best:", ckpts.best_metrics())
 
 
-def _gen_setup(args, cfg):
+def _gen_setup(args, cfg, total_steps=None):
     """Shared train-gen / train-multi-gen preamble: tokenizer selection,
     GenConfig (tiny or full T5), mesh-sharded GenTrainer, and a fresh or
-    --pretrained-initialized state. Returns (tok, gcfg, trainer, state,
-    dp, rows)."""
+    --pretrained-initialized state. total_steps feeds the warmup/decay
+    schedule when train.optim.warmup_frac > 0. Returns (tok, gcfg,
+    trainer, state, dp, rows)."""
     from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
     from deepdfa_tpu.models import t5 as t5m
     from deepdfa_tpu.models import t5_gen as genm
@@ -727,7 +745,7 @@ def _gen_setup(args, cfg):
     mesh = make_mesh(cfg.train.mesh)
     dp = mesh.shape.get("dp", 1)
     rows = max(1, args.batch_size // dp)
-    trainer = GenTrainer(cfg, gcfg, mesh=mesh)
+    trainer = GenTrainer(cfg, gcfg, mesh=mesh, total_steps=total_steps)
     state = trainer.init_state()
     if args.pretrained:
         import torch
@@ -781,7 +799,17 @@ def cmd_train_gen(args) -> None:
 
     cfg = _load_config(args)
     run_dir = paths.runs_dir(cfg.run_name)
-    tok, gcfg, trainer, state, dp, rows = _gen_setup(args, cfg)
+    total_steps = None
+    if args.train_file:
+        # reader-only pass (no tokenizer yet): the warmup/decay schedule
+        # needs the real step count at optimizer construction
+        family = args.task.split("_")[0]
+        n_train = len(gen_data.READERS[family](args.train_file, args.data_num))
+        steps_per_epoch = max(1, -(-n_train // max(1, args.batch_size)))
+        total_steps = steps_per_epoch * max(1, cfg.train.max_epochs)
+    tok, gcfg, trainer, state, dp, rows = _gen_setup(
+        args, cfg, total_steps=total_steps
+    )
 
     def load(filename):
         return _gen_encode_file(args, tok, args.task, filename)
@@ -886,7 +914,9 @@ def cmd_train_multi_gen(args) -> None:
         train_file, _, dev_file = files.partition(":")
         specs.append((name, train_file, dev_file or None))
 
-    tok, gcfg, trainer, state, dp, rows = _gen_setup(args, cfg)
+    tok, gcfg, trainer, state, dp, rows = _gen_setup(
+        args, cfg, total_steps=max(1, args.max_steps)
+    )
 
     def load(name, filename):
         _, src, tgt = _gen_encode_file(
@@ -985,7 +1015,12 @@ def cmd_train_clone(args) -> None:
     mesh = make_mesh(cfg.train.mesh)
     dp = mesh.shape.get("dp", 1)
     rows = max(1, args.batch_size // dp)
-    trainer = CloneTrainer(cfg, ccfg, mesh=mesh)
+    total_steps = None
+    if args.train_file:
+        n_train = len(gen_data.read_clone_examples(args.train_file, args.data_num))
+        steps_per_epoch = max(1, -(-n_train // max(1, args.batch_size)))
+        total_steps = steps_per_epoch * max(1, cfg.train.max_epochs)
+    trainer = CloneTrainer(cfg, ccfg, mesh=mesh, total_steps=total_steps)
     state = trainer.init_state()
     if args.pretrained:
         import torch
@@ -1127,7 +1162,11 @@ def cmd_localize(args) -> None:
     splits = json.loads((out_dir / "splits.json").read_text())
 
     tok, enc_cfg, mcfg, enc_import = _combined_setup(args, cfg)
-    trainer = CombinedTrainer(cfg, mcfg, mesh=make_mesh(cfg.train.mesh))
+    # eval-only path: the optimizer is never stepped, but the trainer
+    # constructs it — total_steps=1 satisfies a warmup schedule config
+    trainer = CombinedTrainer(
+        cfg, mcfg, mesh=make_mesh(cfg.train.mesh), total_steps=1
+    )
     state = trainer.init_state()
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
     params = ckpts.restore(args.checkpoint, jax.device_get(state.params))

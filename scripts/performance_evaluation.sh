@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Headline reproduction driver (reference scripts/performance_evaluation.sh:
+# train DeepDFA, then the transformer baseline, then DeepDFA+combined).
+#
+# Hermetic by default: prepares + extracts a synthetic Big-Vul-style corpus
+# first so the script runs end to end with zero downloads; point
+# PREPARE_SOURCE at MSR_data_cleaned.csv for the real dataset.
+#
+#   PREPARE_SOURCE=synthetic N_EXAMPLES=2000 bash scripts/performance_evaluation.sh
+#   PREPARE_SOURCE=/data/MSR_data_cleaned.csv bash scripts/performance_evaluation.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PREPARE_SOURCE="${PREPARE_SOURCE:-synthetic}"
+N_EXAMPLES="${N_EXAMPLES:-2000}"
+SEED="${SEED:-1}"
+
+prepare_args=(--source "$PREPARE_SOURCE")
+if [ "$PREPARE_SOURCE" = "synthetic" ]; then
+    prepare_args+=(--n-examples "$N_EXAMPLES")
+fi
+python -m deepdfa_tpu.cli prepare "${prepare_args[@]}" --dep-closure
+python -m deepdfa_tpu.cli extract
+
+# 1) DeepDFA (reference DDFA/scripts/train.sh, seed_everything 1)
+bash scripts/train_bigvul.sh "train.seed=$SEED" "run_name=perf_deepdfa_s$SEED"
+
+# 2) transformer baseline + 3) DeepDFA+combined (reference
+#    msr_train_linevul.sh / msr_train_combined.sh; one command each here —
+#    --no-graph drops the graph branch for the pure-transformer baseline)
+python -m deepdfa_tpu.cli train-combined --no-graph \
+    "train.seed=$SEED" "run_name=perf_linevul_s$SEED" "$@"
+bash scripts/train_combined.sh "train.seed=$SEED" "run_name=perf_combined_s$SEED" "$@"

@@ -38,3 +38,31 @@ def devices():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+def run_cli(storage, *argv, expect_rc=0, expect_err=None, timeout=600):
+    """Drive the real CLI in a SUBPROCESS (argv + env surface; also, the
+    accumulated in-process XLA state of many trainings inside one pytest
+    process has produced spurious fatal aborts on this box — fresh
+    processes never reproduce them). Shared by the CLI test files."""
+    import pathlib
+    import subprocess
+    import sys
+
+    env = dict(
+        os.environ,
+        DEEPDFA_TPU_STORAGE=str(storage),
+        DEEPDFA_TPU_PLATFORM="cpu",
+    )
+    res = subprocess.run(
+        [sys.executable, "-m", "deepdfa_tpu.cli", *argv],
+        capture_output=True, text=True, env=env, timeout=timeout,
+        cwd=str(pathlib.Path(__file__).parents[1]),
+    )
+    if expect_rc == 0:
+        assert res.returncode == 0, res.stderr[-2000:]
+    else:
+        assert res.returncode != 0, res.stdout[-500:]
+    if expect_err is not None:
+        assert expect_err in res.stderr, res.stderr[-2000:]
+    return res

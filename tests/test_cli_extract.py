@@ -111,26 +111,42 @@ def test_removed_config_key_tolerated():
         config_mod.from_dict({"model": {"definitely_not_a_key": 1}})
 
 
+def _cli(storage, *argv, expect_rc=0, expect_err=None):
+    from tests.conftest import run_cli
+
+    return run_cli(storage, *argv, expect_rc=expect_rc, expect_err=expect_err)
+
+
 def test_test_command_restores_run_config(storage):
     """`test run_name=X` must rebuild the model from the RUN's saved
     config.json (train writes it), not CLI defaults — a run trained with
     non-default dims previously crashed with a param shape error
     (found by a corpus-scale pipeline drive in round 3)."""
-    from deepdfa_tpu.cli.main import main
-
-    main(["prepare", "--source", "synthetic", "--n-examples", "24"])
-    main(["extract", "data.feat.limit_all=64", "data.feat.limit_subkeys=64"])
-    main([
-        "train", "run_name=cfg_roundtrip", "train.max_epochs=1",
-        "model.hidden_dim=16", "data.feat.limit_all=64",
-        "data.feat.limit_subkeys=64",
-    ])
+    _cli(storage, "prepare", "--source", "synthetic", "--n-examples", "24")
+    _cli(storage, "extract", "data.feat.limit_all=64",
+         "data.feat.limit_subkeys=64")
+    _cli(storage, "train", "run_name=cfg_roundtrip", "train.max_epochs=1",
+         "model.hidden_dim=16", "data.feat.limit_all=64",
+         "data.feat.limit_subkeys=64")
     # no model/data overrides here: the saved run config must supply them
-    main(["test", "run_name=cfg_roundtrip"])
+    _cli(storage, "test", "run_name=cfg_roundtrip")
     # and explicit overrides still win over the saved config: forcing a
     # different width must reach the model and fail at checkpoint
     # restore with a SHAPE error (not be silently ignored)
-    import flax.errors
+    _cli(storage, "test", "run_name=cfg_roundtrip", "model.hidden_dim=8",
+         expect_rc=1, expect_err="ScopeParamShapeError")
 
-    with pytest.raises(flax.errors.ScopeParamShapeError):
-        main(["test", "run_name=cfg_roundtrip", "model.hidden_dim=8"])
+
+def test_train_combined_with_warmup_schedule(storage):
+    """The flagship combined config uses 20%-linear-warmup AdamW
+    (configs/bigvul_combined.json, reference linevul_main.py:150-162);
+    cmd_train_combined must derive total_steps for the schedule — it
+    previously crashed with 'warmup_frac requires total_steps' (found by
+    driving scripts/performance_evaluation.sh)."""
+    _cli(storage, "prepare", "--source", "synthetic", "--n-examples", "24")
+    _cli(storage, "extract", "data.feat.limit_all=64",
+         "data.feat.limit_subkeys=64")
+    _cli(storage, "train-combined", "--max-length", "48",
+         "run_name=warmup_check", "train.max_epochs=1",
+         "train.optim.warmup_frac=0.2",
+         "data.feat.limit_all=64", "data.feat.limit_subkeys=64")

@@ -37,15 +37,9 @@ def task_files(tmp_path_factory):
 
 
 def _run(args, storage, timeout=420):
-    env = dict(os.environ)
-    env["DEEPDFA_TPU_PLATFORM"] = "cpu"
-    env["DEEPDFA_TPU_STORAGE"] = str(storage)
-    res = subprocess.run(
-        [sys.executable, "-m", "deepdfa_tpu.cli", *args],
-        capture_output=True, text=True, timeout=timeout, env=env,
-    )
-    assert res.returncode == 0, res.stderr[-2000:]
-    return res.stdout
+    from tests.conftest import run_cli
+
+    return run_cli(storage, *args, timeout=timeout).stdout
 
 
 TINY = [
