@@ -526,11 +526,14 @@ def _combined_setup(args, cfg):
         tok = HashTokenizer(vocab_size=4096, t5_frame=(arch == "t5"))
 
     use_graph = not getattr(args, "no_graph", False)
+    sp_variant = getattr(args, "sp_variant", "ring")
     if arch == "t5":
         if args.encoder == "codet5-base":
-            enc_cfg = t5m.T5Config(dtype="bfloat16")
+            enc_cfg = t5m.T5Config(dtype="bfloat16", sp_variant=sp_variant)
         else:
-            enc_cfg = t5m.T5Config.tiny(vocab_size=tok.vocab_size)
+            enc_cfg = t5m.T5Config.tiny(
+                vocab_size=tok.vocab_size, sp_variant=sp_variant
+            )
         mcfg = t5m.DefectConfig(
             encoder=enc_cfg,
             graph_hidden_dim=cfg.model.hidden_dim,
@@ -538,7 +541,6 @@ def _combined_setup(args, cfg):
             use_graph=use_graph,
         )
         return tok, enc_cfg, mcfg, t5m.params_from_hf_torch
-    sp_variant = getattr(args, "sp_variant", "ring")
     if args.encoder == "codebert-base":
         enc_cfg = TransformerConfig(dtype="bfloat16", sp_variant=sp_variant)
     else:
@@ -1317,7 +1319,8 @@ def main(argv=None) -> None:
     p.add_argument("--max-length", type=int, default=512)
     p.add_argument("--sp-variant", default="ring", choices=["ring", "ulysses"],
                    help="sequence-parallel attention scheme on sp>1 "
-                        "meshes (roberta arch; t5 uses ring)")
+                        "meshes (both archs: ring k/v rotation or "
+                        "ulysses all-to-all head sharding)")
     p.add_argument("--no-graph", action="store_true")
     p.add_argument("--graph-checkpoint", default=None,
                    help="run name or checkpoints dir of a pretrained "

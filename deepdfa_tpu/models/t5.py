@@ -44,6 +44,10 @@ class T5Config:
     pad_token_id: int = 0
     dtype: str = "float32"
     remat: bool = True
+    #: sequence-parallel attention scheme under sp>1 meshes: "ring"
+    #: (k/v rotation, per-step relative-bias blocks) or "ulysses"
+    #: (all-to-all head sharding, head-sliced global bias)
+    sp_variant: str = "ring"
 
     @classmethod
     def tiny(cls, **kw) -> "T5Config":
@@ -139,9 +143,16 @@ def encoder_rel_bias(
     """(bias, bias_fn) for the encoder's shared relative-position bias.
 
     Without sp: one [H, T, T] bias from global positions, bias_fn None.
-    With sp: T is the LOCAL block; per-rotation-step bias blocks are
-    precomputed from global positions ([n_sp, H, T, T]) so ring
-    attention's scan only indexes, never re-gathers.
+    With sp (T = the LOCAL block length), the form follows
+    cfg.sp_variant:
+    - "ring": per-rotation-step bias blocks precomputed from global
+      positions ([n_sp, H, T, T]) so ring attention's scan only indexes,
+      never re-gathers — returned via bias_fn;
+    - "ulysses": after the all-to-all each device attends the FULL
+      sequence with a head slice, so the bias is the [H/n_sp, S, S]
+      head-slice of the global bias (S = n_sp * T; the full [H, S, S]
+      is built then sliced — same O(S^2) footprint class as the
+      attention scores themselves) — returned via bias.
     """
     if sp_axis is None:
         pos = jnp.arange(T)
@@ -153,6 +164,28 @@ def encoder_rel_bias(
 
     sp_idx = jax.lax.axis_index(sp_axis)
     n_sp = jax.lax.psum(1, sp_axis)  # static inside shard_map
+
+    if cfg.sp_variant == "ulysses":
+        h = rel_bias_param.shape[1]
+        if h % n_sp:
+            raise ValueError(
+                f"{h} rel-bias heads not divisible by sp={n_sp} "
+                "(ulysses shards heads; use sp_variant='ring')"
+            )
+        h_local = h // n_sp
+        # slice the TINY param table's head axis first, so only the
+        # [S, S, H/P] local bias ever materializes (not the full
+        # [H, S, S] — 1/P the footprint on the memory-bound path)
+        param_local = jax.lax.dynamic_slice_in_dim(
+            rel_bias_param, sp_idx * h_local, h_local, axis=1
+        )
+        S = n_sp * T
+        pos = jnp.arange(S)
+        buckets = relative_position_buckets(
+            pos, pos, cfg.rel_buckets, cfg.rel_max_distance
+        )
+        return param_local[buckets].astype(dt).transpose(2, 0, 1), None
+
     q_pos = sp_idx * T + jnp.arange(T)
 
     def _step_bias(step):
@@ -196,7 +229,13 @@ def encoder_layer(
     q = jnp.einsum("btd,dhk->bhtk", h_in, lp["wq"].astype(dt))
     k = jnp.einsum("btd,dhk->bhtk", h_in, lp["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bhtk", h_in, lp["wv"].astype(dt))
-    if sp_axis is not None:
+    if sp_axis is not None and cfg.sp_variant == "ulysses":
+        from deepdfa_tpu.parallel.ulysses import ulysses_attention
+
+        ctx = ulysses_attention(
+            q, k, v, attn_mask, axis_name=sp_axis, scale=1.0, bias=bias
+        )
+    elif sp_axis is not None:
         from deepdfa_tpu.parallel.ring_attention import ring_attention
 
         ctx = ring_attention(
@@ -236,11 +275,13 @@ def encode(
     inputs_embeds replaces the word-embedding gather (HF convention) —
     the hook the gradient-attribution localizers differentiate through.
 
-    sp_axis: sequence parallelism — T is the LOCAL block length, attention
-    runs as ring attention over the mesh axis with per-rotation-step
-    relative-position bias blocks computed from global positions (the
-    "per-shard relative-bias blocks" the roberta path gets for free from
-    absolute positions)."""
+    sp_axis: sequence parallelism — T is the LOCAL block length; the
+    scheme follows cfg.sp_variant: "ring" rotates k/v with
+    per-rotation-step relative-position bias blocks computed from global
+    positions (the "per-shard relative-bias blocks" the roberta path
+    gets for free from absolute positions), "ulysses" all-to-alls into
+    full-sequence attention over a head slice with the head-sliced
+    global bias (encoder_rel_bias)."""
     from deepdfa_tpu.models.transformer import _dropout
 
     if attn_mask is None:

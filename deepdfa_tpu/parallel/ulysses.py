@@ -39,12 +39,18 @@ def ulysses_attention(
     dropout_rate: float = 0.0,
     dropout_key: jax.Array | None = None,
     scale: float | None = None,
+    bias: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention via two all-to-alls over `axis_name`.
 
     Shapes (per device, inside shard_map): q,k,v [B, H, T_local, D] with
     the sequence sharded over the axis; kv_mask [B, T_local] (False =
-    padding). Returns [B, H, T_local, D], same layout as ring_attention.
+    padding). `bias` is an additive score bias for THIS DEVICE's head
+    slice over the full sequence ([H/P, S, S], broadcast over batch) —
+    the all-to-all gives rank r heads [r*H/P, (r+1)*H/P), so callers
+    slice their global bias the same way (T5's relative position bias,
+    models/t5.py encoder_rel_bias). Returns [B, H, T_local, D], same
+    layout as ring_attention.
     """
     n_dev = jax.lax.psum(1, axis_name)
     h = q.shape[1]
@@ -73,6 +79,7 @@ def ulysses_attention(
     ctx = full_attention(
         qg, kg, vg, mask_full,
         dropout_rate=dropout_rate, dropout_key=dropout_key, scale=scale,
+        bias=bias,
     )
     # [B, H/P, S, D] -> [B, H, T_local, D]
     return jax.lax.all_to_all(

@@ -147,17 +147,54 @@ def test_t5_encode_sp_matches_dense(rng):
     np.testing.assert_allclose(got[valid], want[valid], rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("mesh_cfg", [
-    dict(dp=2, tp=2, sp=2),
-    dict(dp=1, tp=1, sp=8),
+def test_t5_encode_ulysses_matches_dense(rng):
+    """Ulysses T5 encode (all-to-all head sharding, head-sliced global
+    relative bias) must equal the dense single-device encode — the t5
+    sp_variant previously supported ring only."""
+    import jax
+    from functools import partial
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.parallel.compat import shard_map
+
+    cfg = t5m.T5Config.tiny(
+        vocab_size=128, dropout_rate=0.0, remat=False, sp_variant="ulysses"
+    )
+    params = t5m.init_params(cfg, jax.random.key(0))
+    ids = rng.integers(3, 128, (2, 64)).astype(np.int32)
+    ids[:, -5:] = 0
+    ids[:, -6] = 2
+
+    want = np.asarray(t5m.encode(cfg, params, ids))
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))  # 4 heads -> sp<=4
+    sp_encode = shard_map(
+        partial(t5m.encode, cfg, params, sp_axis="sp"),
+        mesh=mesh,
+        in_specs=P(None, "sp"),
+        out_specs=P(None, "sp", None),
+        check_vma=False,
+    )
+    got = np.asarray(jax.jit(sp_encode)(ids))
+    valid = ids != 0
+    np.testing.assert_allclose(got[valid], want[valid], rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("mesh_cfg,sp_variant", [
+    (dict(dp=2, tp=2, sp=2), "ring"),
+    (dict(dp=1, tp=1, sp=8), "ring"),
     # pp compositions (round-3: the t5+pp guard removed): GPipe over the
     # T5 encoder stack, rel-bias computed per stage, alone and with sp
-    dict(dp=2, pp=2),
-    dict(dp=1, tp=2, pp=2),
-    dict(dp=1, sp=2, pp=2),
-    dict(dp=1, tp=2, sp=2, pp=2),
+    (dict(dp=2, pp=2), "ring"),
+    (dict(dp=1, tp=2, pp=2), "ring"),
+    (dict(dp=1, sp=2, pp=2), "ring"),
+    (dict(dp=1, tp=2, sp=2, pp=2), "ring"),
+    # round-3: t5 ulysses (head-sliced global rel bias), alone + with pp
+    (dict(dp=2, tp=1, sp=2), "ulysses"),
+    (dict(dp=1, sp=2, pp=2), "ulysses"),
 ])
-def test_t5_parallel_matches_single(mesh_cfg):
+def test_t5_parallel_matches_single(mesh_cfg, sp_variant):
     """T5 combined training on dp x tp x sp x pp == single device (the
     t5-pp and sp-pp paths previously raised NotImplementedError)."""
     import jax
@@ -177,7 +214,8 @@ def test_t5_parallel_matches_single(mesh_cfg):
     labels = [s.label for s in synth]
     mcfg = t5m.DefectConfig(
         encoder=t5m.T5Config.tiny(
-            vocab_size=256, dropout_rate=0.0, remat=False
+            vocab_size=256, dropout_rate=0.0, remat=False,
+            sp_variant=sp_variant,
         ),
         graph_hidden_dim=8,
         graph_input_dim=52,
