@@ -23,6 +23,7 @@ import sys
 from pathlib import Path
 
 from deepdfa_tpu.core import Config, config as config_mod, paths
+from deepdfa_tpu.data.diffs import split_lines
 
 
 def _load_config(args) -> Config:
@@ -397,7 +398,10 @@ def cmd_test(args) -> None:
     run_dir = paths.runs_dir(cfg.run_name)
     mesh = make_mesh(cfg.train.mesh)
     model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
-    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    # eval-only: the optimizer is never stepped, but GraphTrainer still
+    # constructs it — total_steps=1 satisfies a restored warmup-schedule
+    # config (train.optim.warmup_frac>0) exactly as cmd_localize does
+    trainer = GraphTrainer(model, cfg, mesh=mesh, total_steps=1)
 
     batches = _epoch_batches(cfg, split_specs[args.split], mesh, phase="eval")
     state = trainer.init_state(batches[0])
@@ -801,7 +805,10 @@ def cmd_train_gen(args) -> None:
 
     cfg = _load_config(args)
     run_dir = paths.runs_dir(cfg.run_name)
-    total_steps = None
+    # eval-only invocations (no --train-file) never step the optimizer;
+    # total_steps=1 keeps a warmup-schedule config constructible (the
+    # same eval-path contract as cmd_test / cmd_localize)
+    total_steps = 1
     if args.train_file:
         # reader-only pass (no tokenizer yet): the warmup/decay schedule
         # needs the real step count at optimizer construction
@@ -1017,7 +1024,10 @@ def cmd_train_clone(args) -> None:
     mesh = make_mesh(cfg.train.mesh)
     dp = mesh.shape.get("dp", 1)
     rows = max(1, args.batch_size // dp)
-    total_steps = None
+    # eval-only invocations never step the optimizer; total_steps=1 keeps
+    # a warmup-schedule config constructible (eval-path contract, as in
+    # cmd_test / cmd_localize / cmd_gen)
+    total_steps = 1
     if args.train_file:
         n_train = len(gen_data.read_clone_examples(args.train_file, args.data_num))
         steps_per_epoch = max(1, -(-n_train // max(1, args.batch_size)))
@@ -1201,7 +1211,8 @@ def cmd_localize(args) -> None:
             b.graphs if mcfg.use_graph else None,
             b.has_graph if mcfg.use_graph else None,
         )
-        n_lines = len(e.code.splitlines())
+        # \n-only numbering: must agree with e.vuln_lines' coordinates
+        n_lines = len(split_lines(e.code))
         line_scores = aggregate_line_scores(scores[0], tok_lines, n_lines)
         flagged = np.zeros(n_lines, bool)
         for ln in e.vuln_lines:

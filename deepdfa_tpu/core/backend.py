@@ -125,11 +125,23 @@ def apply_platform_override() -> str | None:
     entry points: run the pipeline on a host whose accelerator tunnel is
     down, or exercise multi-chip code on N virtual CPU devices. Returns the
     forced platform, or None when the knob is unset.
+
+    Plain ``cpu`` (no ``:N``) pins the device count to 1 rather than
+    inheriting whatever ``--xla_force_host_platform_device_count`` happens
+    to sit in XLA_FLAGS: an inherited 8-virtual-device platform on a small
+    host makes ``MeshConfig.dp=-1`` build an 8-way mesh whose in-process
+    CPU collectives can starve past XLA's 40s rendezvous termination and
+    SIGABRT the process (round-3 red test). Multi-device CPU runs are an
+    explicit opt-in via ``cpu:N``. The reference trains regardless of the
+    visible-device count (LineVul/linevul/linevul_main.py:165-166); plain
+    ``cpu`` now matches that determinism.
     """
     spec = os.environ.get("DEEPDFA_TPU_PLATFORM")
     if not spec:
         return None
     platform, _, n = spec.partition(":")
+    if not n and platform == "cpu":
+        n = "1"
     set_platform(platform, int(n) if n else None)
     return platform
 

@@ -23,7 +23,7 @@ from typing import Iterable, Sequence
 import numpy as np
 import pandas as pd
 
-from deepdfa_tpu.data.diffs import diff_lines, vulnerable_lines
+from deepdfa_tpu.data.diffs import labeled_diff, split_lines
 from deepdfa_tpu.data.pipeline import Example
 from deepdfa_tpu.frontend.tokens import strip_comments
 
@@ -32,8 +32,9 @@ def _clean_func(code: str) -> str:
     return strip_comments(str(code))
 
 
-def _keep_vulnerable(before: str, after: str) -> bool:
-    removed, added = diff_lines(before, after)
+def _keep_vulnerable(
+    before: str, removed: set[int], added: set[int]
+) -> bool:
     if not removed and not added:
         return False  # vulnerable but no change recorded
     tail = before.strip()[-1:] if before.strip() else ""
@@ -41,11 +42,13 @@ def _keep_vulnerable(before: str, after: str) -> bool:
         return False
     if before.strip()[-2:] == ");":
         return False
-    n_lines = max(len(before.splitlines()), 1)
+    # line counts use the same \n-only numbering as the diff labels
+    n_before = len(split_lines(before))
+    n_lines = max(n_before, 1)
     mod_prop = (len(removed) + len(added)) / n_lines
     if mod_prop >= 0.7:
         return False
-    if len(before.splitlines()) <= 5:
+    if n_before <= 5:
         return False
     return True
 
@@ -86,9 +89,14 @@ def read_bigvul(
         before = _clean_func(row.func_before)
         after = _clean_func(row.func_after)
         vul = int(row.vul)
-        if vul and not _keep_vulnerable(before, after):
-            continue
-        lines = frozenset(vulnerable_lines(before, after)) if vul else frozenset()
+        if vul:
+            # one xdiff pass serves the vuln filters AND the labels
+            removed, added, guards = labeled_diff(before, after)
+            if not _keep_vulnerable(before, removed, added):
+                continue
+            lines = frozenset(removed if removed else guards)
+        else:
+            lines = frozenset()
         out.append(
             Example(id=int(row.id), code=before, label=float(vul), vuln_lines=lines)
         )

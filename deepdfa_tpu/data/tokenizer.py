@@ -24,6 +24,8 @@ from pathlib import Path
 
 import numpy as np
 
+from deepdfa_tpu.data.diffs import split_lines
+
 
 class Tokenizer:
     cls_id: int
@@ -72,7 +74,8 @@ class HashTokenizer(Tokenizer):
 
         ids = [self.cls_id]
         lines = [0]
-        for lineno, line in enumerate(text.splitlines(), start=1):
+        # \n-only numbering, matching the diff-label / CPG coordinates
+        for lineno, line in enumerate(split_lines(text), start=1):
             for m in self._WORD.finditer(line):
                 if len(ids) >= max_length - 1:
                     break

@@ -24,7 +24,7 @@ def tokenise(s: str) -> str:
 
 def tokenise_lines(s: str) -> list[str]:
     out = []
-    for line in s.splitlines():
+    for line in s.split("\n"):  # \n-only, like every line consumer here
         tok = tokenise(line)
         if tok:
             out.append(tok)

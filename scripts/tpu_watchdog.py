@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""TPU-window watchdog: poll backend health all session; bench the moment
+a healthy window appears.
+
+Round 1-3 each made ONE bench attempt at round end and kept losing the
+tunnel lottery (see docs/ROUND3_NOTES.md; BENCH_r03.json records
+``probe: backend probe timed out after 300s``). This watchdog inverts
+the protocol: run it in the background for the whole build session,
+cheaply probing the default (tunnel) backend every POLL_INTERVAL with a
+bounded subprocess; the first healthy window triggers the full bench
+suite (bench.py inference+train, scripts/bench_combined.py 125M-model
+MFU) and commits ``BENCH_TPU_<utc-timestamp>.json`` plus the poll log.
+
+Every poll — healthy or not — is appended to ``docs/tpu_poll_log.jsonl``
+so a round that never sees a healthy window still produces a committed,
+timestamped record proving the tunnel was down the whole time (the
+VERDICT r3 "done" criterion).
+
+Invocation (backgrounded for the session, from the repo root):
+
+    nohup python scripts/tpu_watchdog.py >> docs/tpu_watchdog.out 2>&1 &
+
+Environment knobs:
+    DEEPDFA_WATCHDOG_INTERVAL   seconds between poll starts (default 600)
+    DEEPDFA_WATCHDOG_DEADLINE   total seconds to keep polling (default 39600)
+    DEEPDFA_WATCHDOG_PROBE_TIMEOUT  per-probe bound (default 240)
+    DEEPDFA_WATCHDOG_ONESHOT    "1": poll once, bench if healthy, exit
+
+The probe subprocess inherits the default environment (no JAX_PLATFORMS /
+DEEPDFA_TPU_PLATFORM overrides, PYTHONPATH untouched) so it resolves the
+same backend the driver's own bench invocation would.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+POLL_INTERVAL = float(os.environ.get("DEEPDFA_WATCHDOG_INTERVAL", 600))
+DEADLINE = float(os.environ.get("DEEPDFA_WATCHDOG_DEADLINE", 39600))
+PROBE_TIMEOUT = float(os.environ.get("DEEPDFA_WATCHDOG_PROBE_TIMEOUT", 240))
+LOG_PATH = os.path.join(REPO, "docs", "tpu_poll_log.jsonl")
+
+
+def utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def log_poll(record: dict) -> None:
+    os.makedirs(os.path.dirname(LOG_PATH), exist_ok=True)
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record), flush=True)
+
+
+def probe() -> tuple[bool, str, float]:
+    """One bounded health probe of the DEFAULT backend; (ok, detail, secs)."""
+    from deepdfa_tpu.core.backend import probe_default_backend
+
+    t0 = time.time()
+    ok, detail = probe_default_backend(PROBE_TIMEOUT, use_cache=False)
+    return ok, detail, time.time() - t0
+
+
+def last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None
+
+
+def run_bench_suite(platform: str) -> dict:
+    """Fire the full bench suite against the healthy backend; return the
+    combined record. Each piece is bounded so one wedge cannot eat the
+    window for the others."""
+    record: dict = {
+        "captured_at": utcnow(),
+        "probe_platform": platform,
+        "watchdog": True,
+    }
+
+    env = dict(os.environ)
+    env.pop("DEEPDFA_TPU_PLATFORM", None)  # bench must see the default backend
+    env["DEEPDFA_BENCH_TOTAL_BUDGET"] = env.get(
+        "DEEPDFA_BENCH_TOTAL_BUDGET", "2400"
+    )
+
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=2700, env=env, cwd=REPO,
+        )
+        main_rec = last_json_line(res.stdout)
+        if main_rec is not None:
+            record["bench"] = main_rec
+        else:
+            record["bench_error"] = (res.stderr or res.stdout)[-500:]
+    except subprocess.TimeoutExpired:
+        record["bench_error"] = "bench.py exceeded 2700s"
+
+    combined_out = os.path.join(REPO, "docs", "bench_combined_tpu.json")
+    try:
+        res = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_combined.py"),
+                "--out", combined_out,
+            ],
+            capture_output=True, text=True, timeout=2400, env=env, cwd=REPO,
+        )
+        if res.returncode == 0 and os.path.exists(combined_out):
+            with open(combined_out) as f:
+                record["bench_combined"] = json.load(f)
+        else:
+            record["bench_combined_error"] = (res.stderr or res.stdout)[-500:]
+    except subprocess.TimeoutExpired:
+        record["bench_combined_error"] = "bench_combined.py exceeded 2400s"
+    return record
+
+
+def commit_artifacts(paths: list[str], message: str) -> None:
+    try:
+        subprocess.run(["git", "add", *paths], cwd=REPO, check=True)
+        subprocess.run(
+            ["git", "commit", "-m", message, "--", *paths],
+            cwd=REPO, check=True, capture_output=True, text=True,
+        )
+    except subprocess.CalledProcessError as e:
+        print(f"watchdog commit failed: {e.stderr or e}", file=sys.stderr)
+
+
+def main() -> None:
+    oneshot = os.environ.get("DEEPDFA_WATCHDOG_ONESHOT") == "1"
+    t_end = time.time() + DEADLINE
+    print(
+        f"tpu_watchdog: interval={POLL_INTERVAL:.0f}s "
+        f"probe_timeout={PROBE_TIMEOUT:.0f}s "
+        f"deadline={DEADLINE / 3600:.1f}h",
+        flush=True,
+    )
+    while True:
+        t0 = time.time()
+        ok, detail, elapsed = probe()
+        healthy = ok and detail not in ("cpu", "unknown")
+        log_poll(
+            {
+                "ts": utcnow(),
+                "ok": ok,
+                "platform_or_error": detail,
+                "probe_seconds": round(elapsed, 1),
+                "healthy_accelerator": healthy,
+            }
+        )
+        if healthy:
+            stamp = utcnow().replace(":", "").replace("-", "")
+            out = os.path.join(REPO, f"BENCH_TPU_{stamp}.json")
+            record = run_bench_suite(detail)
+            with open(out, "w") as f:
+                json.dump(record, f, indent=1)
+            log_poll(
+                {
+                    "ts": utcnow(),
+                    "event": "bench_captured",
+                    "artifact": os.path.basename(out),
+                    "value": record.get("bench", {}).get("value"),
+                    "platform": record.get("bench", {}).get("platform"),
+                }
+            )
+            commit_artifacts(
+                [out, LOG_PATH, os.path.join(REPO, "docs")],
+                "Capture TPU bench from watchdog healthy-window "
+                f"({os.path.basename(out)})",
+            )
+            if record.get("bench", {}).get("platform") == "tpu":
+                print("tpu_watchdog: TPU record captured; exiting", flush=True)
+                return
+        if oneshot or time.time() > t_end:
+            return
+        time.sleep(max(0.0, POLL_INTERVAL - (time.time() - t0)))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,11 @@ def run_cli(storage, *argv, expect_rc=0, expect_err=None, timeout=600):
     import subprocess
     import sys
 
+    # Plain "cpu" is normalized to ONE device by apply_platform_override,
+    # so the 8-device XLA_FLAGS this pytest process exports (above) cannot
+    # leak an 8-way in-process-collective mesh into CLI subprocesses on a
+    # 1-core host (round-3 red test: SIGABRT in XLA's CPU rendezvous).
+    # Multi-device CLI subprocess tests opt in with cpu:N explicitly.
     env = dict(
         os.environ,
         DEEPDFA_TPU_STORAGE=str(storage),

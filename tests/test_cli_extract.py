@@ -125,9 +125,13 @@ def test_test_command_restores_run_config(storage):
     _cli(storage, "prepare", "--source", "synthetic", "--n-examples", "24")
     _cli(storage, "extract", "data.feat.limit_all=64",
          "data.feat.limit_subkeys=64")
+    # warmup_frac in the saved config also regression-tests cmd_test's
+    # eval-only optimizer construction (total_steps=1): a run trained with
+    # a warmup schedule previously crashed `test` with
+    # "warmup_frac requires total_steps"
     _cli(storage, "train", "run_name=cfg_roundtrip", "train.max_epochs=1",
-         "model.hidden_dim=16", "data.feat.limit_all=64",
-         "data.feat.limit_subkeys=64")
+         "model.hidden_dim=16", "train.optim.warmup_frac=0.2",
+         "data.feat.limit_all=64", "data.feat.limit_subkeys=64")
     # no model/data overrides here: the saved run config must supply them
     _cli(storage, "test", "run_name=cfg_roundtrip")
     # and explicit overrides still win over the saved config: forcing a
@@ -150,3 +154,35 @@ def test_train_combined_with_warmup_schedule(storage):
          "run_name=warmup_check", "train.max_epochs=1",
          "train.optim.warmup_frac=0.2",
          "data.feat.limit_all=64", "data.feat.limit_subkeys=64")
+
+
+def test_cli_subprocess_normalizes_inherited_device_flags():
+    """Regression for the round-3 red test: this pytest process exports
+    ``--xla_force_host_platform_device_count=8`` into its environment, and
+    CLI subprocesses inherit it. Plain ``DEEPDFA_TPU_PLATFORM=cpu`` must
+    normalize the device count to 1 — otherwise ``MeshConfig.dp=-1`` builds
+    an 8-way mesh whose in-process CPU collectives starve past XLA's 40s
+    rendezvous termination on a 1-core host and SIGABRT the trainer
+    (xla rendezvous.cc "Expected 8 threads to join... only 2 arrived").
+    ``cpu:N`` stays the explicit multi-device opt-in."""
+    import os
+    import subprocess
+    import sys
+
+    src = (
+        "from deepdfa_tpu.core.backend import apply_platform_override\n"
+        "apply_platform_override()\n"
+        "import jax\n"
+        "print('NDEV:' + str(len(jax.devices())))\n"
+    )
+    assert "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    )  # the hazard this test exists for must actually be present
+    for spec, want in [("cpu", 1), ("cpu:8", 8)]:
+        env = dict(os.environ, DEEPDFA_TPU_PLATFORM=spec)
+        res = subprocess.run(
+            [sys.executable, "-c", src], capture_output=True, text=True,
+            env=env, timeout=300,
+        )
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert f"NDEV:{want}" in res.stdout, (spec, res.stdout, res.stderr[-500:])

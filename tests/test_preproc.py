@@ -112,3 +112,76 @@ def test_continued_directive_lines_blanked():
     out = lines(code)
     assert out[0] == "" and out[1] == ""
     assert out[3] == "X;"
+
+
+def test_directive_inside_block_comment_is_text():
+    """A `#if` inside /* */ is not a directive (phase 3 removes comments
+    before phase 4 executes directives, ISO C 5.1.1.2) — previously it
+    pushed a conditional frame with no #endif and blanked all remaining
+    code (ADVICE r3)."""
+    code = "/*\n#if FOO\n*/\nint x = 1;\n"
+    out = lines(code)
+    assert out[3] == "int x = 1;"
+
+
+def test_directive_after_comment_close_still_directive():
+    code = "/* c\n*/ #if 0\nX;\n#endif\nY;\n"
+    out = lines(code)
+    assert out[2] == ""  # #if 0 took effect
+    assert out[4] == "Y;"
+
+
+def test_comment_stripped_from_directive_body():
+    code = "#define N 16 /* width */\nint a[N];\n"
+    assert lines(code)[1] == "int a[16];"
+
+
+def test_exponentiation_rejected_not_evaluated():
+    """`**` is not C; eval()ing it would compute an astronomically large
+    integer on hostile corpora (ADVICE r3). Undecidable -> active."""
+    code = "#if 9**9**9**9\nX;\n#endif\n"
+    assert lines(code)[1] == "X;"
+
+
+def test_valueless_macro_removed_from_token_stream():
+    """`#define UNUSED` annotation macros vanish under a real
+    preprocessor; leaving them in diverges the CPG (ADVICE r3)."""
+    code = "#define UNUSED\nUNUSED int x;\n"
+    assert lines(code)[1] == " int x;"
+
+
+def test_complex_body_macro_left_intact_but_defined():
+    code = "#define GUARD if (p) return\n#ifdef GUARD\nGUARD;\n#endif\n"
+    assert lines(code)[2] == "GUARD;"
+
+
+def test_hostile_shift_and_power_bounded():
+    """The evaluator must never materialize astronomical integers: `**`
+    is not C (tokenizes as * *, a parse error) and shift counts/magnitudes
+    are capped. All undecidable -> branch stays active."""
+    for expr in ("9**9**9**9", "1<<1000000000", "1<<(1<<40)",
+                 "0xffffffffffffffff * 0xffffffffffffffff * 0xffffffffffffffff"):
+        out = lines(f"#if {expr}\nX;\n#endif\n")
+        assert out[1] == "X;", expr
+
+
+def test_cond_parser_c_semantics():
+    code = (
+        "#if (3/2 == 1) && (7%3 == 1) && (-7/2 == -3) && (1 ? 2 : 0) "
+        "&& (0x10 == 16) && (010 == 8) && (1 << 4 == 16) && !0 && (~0 != 0)\n"
+        "X;\n#endif\n"
+    )
+    assert lines(code)[1] == "X;"
+
+
+def test_unselected_arm_errors_do_not_poison():
+    """Real preprocessors accept `0 && 1/0` and ternaries whose
+    UNselected arm is erroneous (code-review r4): only the evaluated
+    operand's failure may make the directive undecidable."""
+    assert lines("#if 0 && 1/0\nX;\n#endif\n")[1] == ""  # decidably false
+    assert lines("#if 1 || 1/0\nX;\n#endif\n")[1] == "X;"
+    assert lines("#if FOO ? 100/FOO : 0\nX;\n#endif\n")[1] == ""  # FOO=0
+    assert lines("#if 1 ? 1 : 1/0\nX;\n#endif\n")[1] == "X;"
+    # but an error in the EVALUATED position stays undecidable -> active
+    assert lines("#if 1/0\nX;\n#endif\n")[1] == "X;"
+    assert lines("#if (1/0) || 1\nX;\n#endif\n")[1] == "X;"
