@@ -1477,7 +1477,8 @@ def main(argv=None) -> None:
     p.add_argument("--refs", nargs="+", required=True,
                    help="reference files (one example per line)")
     p.add_argument("--hyp", required=True, help="hypothesis file")
-    p.add_argument("--lang", default="c", choices=["c", "cpp", "python"])
+    p.add_argument("--lang", default="c",
+                   choices=["c", "cpp", "java", "python"])
     p.add_argument("--params", default="0.25,0.25,0.25,0.25",
                    help="alpha,beta,gamma,theta component weights")
     p.set_defaults(fn=cmd_codebleu)

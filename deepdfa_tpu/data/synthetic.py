@@ -32,6 +32,11 @@ class SynthExample:
     after: str
     label: int
     vuln_lines: frozenset[int]
+    #: corpus-v2 provenance: bug-family name ("" = plain filler negative,
+    #: "lookalike:<fam>" = benign twin), and whether the label was flipped
+    #: by injected label noise
+    family: str = ""
+    noisy: bool = False
 
 
 def _body_lines(rng: np.random.Generator, n_stmts: int, vulnerable: bool):
@@ -167,6 +172,222 @@ def generate(
                 after=after,
                 label=int(vulnerable),
                 vuln_lines=lines,
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# corpus v2 (VERDICT r3 item 4): a synthetic task that CANNOT be solved by
+# counting tokens/features.
+#
+# The round-3 corpus was suspiciously easy (test precision 1.000): every
+# bug family's buggy form contained feature buckets its fixed form lacked,
+# so a bag-of-subkeys classifier separates it linearly. v2 closes that in
+# three ways:
+#   - ORDER families: the vulnerable and fixed forms contain the SAME
+#     statement multiset — only the order differs (guard dominates the use
+#     in the fixed form; follows it in the buggy one). Identical subkey
+#     histograms, distinguishable only through control/data flow — the
+#     dynamics of paper Table 3 (DeepDFA wins via dataflow, not tokens).
+#   - BENIGN LOOKALIKES: a configurable share of negatives embed the FIXED
+#     form of a random family, so "contains memcpy/clamp/null-check tokens"
+#     stops predicting the label for the additive families too.
+#   - LABEL NOISE + randomized family placement among filler, killing
+#     position heuristics and perfect separability.
+# The trivial-baseline control lives in eval/trivial_baseline.py; the
+# committed evidence is docs/convergence_run.json (scripts/train_flagship.py
+# --corpus v2) where the GGNN must beat that control by a clear margin.
+
+_CLAMP_GUARD = [
+    "    if (len > (int)sizeof(buf)) {",
+    "        len = (int)sizeof(buf);",
+    "    }",
+]
+
+
+def _fam_clamp_order(v: bool) -> list[str]:
+    use = ["    memcpy(buf, src, len);"]
+    return use + _CLAMP_GUARD if v else _CLAMP_GUARD + use
+
+
+def _fam_null_check_order(v: bool) -> list[str]:
+    alloc = ["    char *p = malloc(len + 1);"]
+    guard = ["    if (!p) {", "        return -1;", "    }"]
+    use = ["    p[0] = 1;"]
+    tail = ["    free(p);"]
+    return alloc + (use + guard if v else guard + use) + tail
+
+
+def _fam_use_after_free(v: bool) -> list[str]:
+    alloc = ["    char *q = malloc(16);", "    if (!q) {",
+             "        return -1;", "    }", "    q[0] = 2;"]
+    use = ["    total += q[0];"]
+    fr = ["    free(q);"]
+    return alloc + (fr + use if v else use + fr)
+
+
+def _fam_index_clamp_order(v: bool) -> list[str]:
+    setl = ["    i = len;"]
+    guard = ["    if (i >= (int)sizeof(buf)) {",
+             "        i = (int)sizeof(buf) - 1;", "    }"]
+    use = ["    total += buf[i];"]
+    return setl + (use + guard if v else guard + use)
+
+
+def _fam_unbounded_copy(v: bool) -> list[str]:
+    if v:
+        return ["    total = strlen(src) + len;", "    strcpy(buf, src);"]
+    return ["    total = strlen(src);",
+            "    strncpy(buf, src, sizeof(buf) - 1);",
+            "    buf[sizeof(buf) - 1] = 0;"]
+
+
+def _fam_missing_bounds(v: bool) -> list[str]:
+    if v:
+        return ["    tmp = len * sizeof(char);", "    memcpy(buf, src, len);"]
+    return _CLAMP_GUARD + ["    memcpy(buf, src, len);"]
+
+
+def _fam_off_by_one(v: bool) -> list[str]:
+    if v:
+        return ["    i = len + 1;", "    total += src[i];"]
+    return ["    i = len - 1;", "    if (i >= 0) {",
+            "        total += src[i];", "    }"]
+
+
+def _fam_truncation(v: bool) -> list[str]:
+    # integer-size truncation before an allocation-sized write
+    if v:
+        return ["    short n = (short)(len * 2);",
+                "    char *w = malloc(n);",
+                "    if (!w) {", "        return -1;", "    }",
+                "    memset(w, 0, len * 2);", "    free(w);"]
+    return ["    long n = (long)len * 2;",
+            "    char *w = malloc(n);",
+            "    if (!w) {", "        return -1;", "    }",
+            "    memset(w, 0, n);", "    free(w);"]
+
+
+#: order-sensitive families share the exact statement multiset between the
+#: two forms; additive families differ in content but their fixed forms
+#: also appear as benign lookalikes
+V2_FAMILIES: dict[str, object] = {
+    "clamp_order": _fam_clamp_order,
+    "null_check_order": _fam_null_check_order,
+    "use_after_free": _fam_use_after_free,
+    "index_clamp_order": _fam_index_clamp_order,
+    "unbounded_copy": _fam_unbounded_copy,
+    "missing_bounds": _fam_missing_bounds,
+    "off_by_one": _fam_off_by_one,
+    "truncation": _fam_truncation,
+}
+
+#: safe API usages sprinkled into ANY example so raw API presence
+#: (strcpy/memcpy/malloc/free) carries no label signal
+_SAFE_FILLER = [
+    ['    strcpy(buf, "ok");'],
+    ["    memcpy(buf, src, sizeof(buf));"],
+    ["    char *r = malloc(8);", "    if (r) {", "        r[0] = 1;",
+     "        free(r);", "    }"],
+    ["    total ^= (int)strlen(buf);"],
+]
+
+
+def _v2_filler_block(rng: np.random.Generator) -> list[str]:
+    k = int(rng.integers(0, 8))
+    if k == 0:
+        return [f"    tmp = tmp + {int(rng.integers(1, 100))};"]
+    if k == 1:
+        return [f"    total += i * {int(rng.integers(2, 9))};"]
+    if k == 2:
+        return ["    if (total > tmp) {",
+                f"        tmp = total - {int(rng.integers(1, 10))};", "    }"]
+    if k == 3:
+        return [f"    while (i < {int(rng.integers(4, 32))}) {{",
+                "        i++;", "    }"]
+    if k == 4:
+        return [f"    tmp ^= total >> {int(rng.integers(1, 5))};"]
+    if k == 5:
+        return ["    memset(buf, 0, sizeof(buf));"]
+    return list(_SAFE_FILLER[int(rng.integers(0, len(_SAFE_FILLER)))])
+
+
+def generate_v2(
+    n: int,
+    vuln_rate: float = 0.06,
+    seed: int = 0,
+    min_stmts: int = 2,
+    max_stmts: int = 12,
+    stmt_sizes: np.ndarray | None = None,
+    lookalike_rate: float = 0.5,
+    label_noise: float = 0.0,
+    families: list[str] | None = None,
+) -> list[SynthExample]:
+    """Corpus v2: order families + benign lookalikes + label noise.
+
+    `families` restricts the bug families drawn (default all); the
+    holdout-family generalization split is built by the caller from the
+    per-example `family` field."""
+    if stmt_sizes is not None and len(stmt_sizes) < n:
+        raise ValueError(f"stmt_sizes has {len(stmt_sizes)} entries, need {n}")
+    fam_names = list(families or V2_FAMILIES)
+    rng = np.random.default_rng(seed)
+    noise_rng = np.random.default_rng(seed + 101)
+    out: list[SynthExample] = []
+    for gid in range(n):
+        vulnerable = bool(rng.random() < vuln_rate)
+        if stmt_sizes is not None:
+            n_stmts = int(stmt_sizes[gid])
+        else:
+            n_stmts = int(rng.integers(min_stmts, max_stmts + 1))
+
+        decls = [
+            "    char buf[64];",
+            "    int i = 0;",
+            "    int total = 0;",
+            f"    {_TYPES[int(rng.integers(0, len(_TYPES)))]} tmp = 0;",
+        ]
+        blocks = [_v2_filler_block(rng) for _ in range(n_stmts)]
+        family = ""
+        fam_before: list[str] | None = None
+        fam_after: list[str] | None = None
+        if vulnerable:
+            family = fam_names[int(rng.integers(0, len(fam_names)))]
+            fam_fn = V2_FAMILIES[family]
+            fam_before, fam_after = fam_fn(True), fam_fn(False)
+        elif rng.random() < lookalike_rate:
+            # benign twin: the FIXED form of a random family, unchanged
+            fam = fam_names[int(rng.integers(0, len(fam_names)))]
+            family = f"lookalike:{fam}"
+            fam_before = fam_after = V2_FAMILIES[fam](False)
+        pos = int(rng.integers(0, len(blocks) + 1))
+        if fam_before is not None:
+            blocks_before = blocks[:pos] + [fam_before] + blocks[pos:]
+            blocks_after = blocks[:pos] + [fam_after] + blocks[pos:]
+        else:
+            blocks_before = blocks_after = blocks
+
+        def _assemble(bls):
+            body = [line for b in bls for line in b]
+            sig = f"int fn_{gid}(char *src, int len)"
+            return sig + " {\n" + "\n".join(decls + body) + "\n    return total;\n}\n"
+
+        before = _assemble(blocks_before)
+        after = _assemble(blocks_after)
+        label = int(vulnerable)
+        lines = (
+            frozenset(vulnerable_lines(before, after)) if vulnerable else frozenset()
+        )
+        noisy = bool(label_noise and noise_rng.random() < label_noise)
+        if noisy:
+            label = 1 - label
+            if label == 0:
+                lines = frozenset()  # a "benign" label carries no line labels
+        out.append(
+            SynthExample(
+                id=gid, before=before, after=after, label=label,
+                vuln_lines=lines, family=family, noisy=noisy,
             )
         )
     return out

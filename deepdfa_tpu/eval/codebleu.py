@@ -18,10 +18,12 @@ Role parity with the reference evaluator
 - syntax match: fraction of reference AST subtrees (as s-expressions of
   node labels) found in the candidate AST (syntax_match.py:49-74). The
   reference uses tree-sitter grammars; here the AST comes from this
-  repo's hermetic C/C++ frontend (lang "c"/"cpp") or the python stdlib
-  `ast` module (lang "python"); other reference languages (java/js/go/
-  php/ruby/c_sharp) are descoped — no tree-sitter grammars under zero
-  egress (docs/PARITY.md).
+  repo's hermetic C-family frontend (lang "c"/"cpp"/"java" — Java
+  method signatures and bodies parse through the same recursive-descent
+  parser, which is what the CONCODE generation task emits) or the
+  python stdlib `ast` module (lang "python"); the remaining reference
+  languages (js/go/php/ruby/c_sharp) are descoped — no tree-sitter
+  grammars under zero egress (docs/PARITY.md).
 - dataflow match: fraction of the reference's normalized def-use triples
   (var_i, relation, [var_j...]) found in the candidate
   (dataflow_match.py:28-66, variable names alpha-renamed in order of
@@ -501,13 +503,15 @@ def corpus_dataflow_match(
 
 
 def _check_lang(lang: str) -> None:
-    if lang not in ("c", "cpp", "python"):
+    if lang not in ("c", "cpp", "java", "python"):
         raise ValueError(
             f"lang={lang!r}: structural matches need a parser; supported "
-            "langs are 'c'/'cpp' (hermetic C/C++ frontend) and 'python' "
-            "(stdlib ast). The reference covers java/js/... via "
-            "tree-sitter grammars unavailable here (zero egress); those "
-            "langs are descoped — see docs/PARITY.md."
+            "langs are 'c'/'cpp'/'java' (hermetic frontend — Java method "
+            "signatures/bodies are parsed by the same C-family parser, "
+            "the CONCODE task generates single methods) and 'python' "
+            "(stdlib ast). The reference covers js/go/php/ruby/c_sharp "
+            "via tree-sitter grammars unavailable here (zero egress); "
+            "those langs are descoped — see docs/PARITY.md."
         )
 
 
@@ -523,6 +527,7 @@ def get_codebleu(
     reference variants per hypothesis. Returns all four components plus
     the weighted composite under "codebleu".
     """
+    _check_lang(lang)  # before KEYWORDS[lang] can KeyError on e.g. "go"
     refs: list[list[str]] = [
         [r] if isinstance(r, str) else list(r) for r in references
     ]

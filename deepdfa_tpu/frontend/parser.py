@@ -1063,13 +1063,43 @@ class Parser:
 
     # -- function ------------------------------------------------------------
 
+    #: Java method modifiers (id tokens, not C keywords) tolerated ahead
+    #: of the return type so CONCODE-style generated methods parse
+    #: (eval/codebleu.py lang="java"); `static`/`final` style C/C++
+    #: qualifiers are handled by _parse_type itself
+    _JAVA_MODIFIERS = frozenset(
+        ("public", "private", "protected", "abstract", "synchronized",
+         "native", "strictfp", "transient", "final")
+    )
+
     def parse_function(self) -> C.Cpg:
-        """Parse `ret_type name(params) { body }` — C and the common C++
+        """Parse `ret_type name(params) { body }` — C, the common C++
         method shapes (template preamble, qualified Foo::bar names,
-        reference parameters)."""
+        reference parameters), and Java method signatures (modifiers,
+        `<T>` type-parameter lists, `throws` clauses)."""
+        while (
+            self.peek().kind == "id"
+            and self.peek().text in self._JAVA_MODIFIERS
+            and self.peek(1).kind in ("id", "kw")
+        ):
+            self.eat()
         # optional template preamble: template <typename T, ...>
         if self.peek().kind == "id" and self.peek().text == "template":
             self.eat()
+            end = self._match_angle(0)
+            if end is not None:
+                for _ in range(end):
+                    self.eat()
+        # Java generic method type parameters: `<T> T first(List<T> xs)`;
+        # a `static` directly before `<` would otherwise be consumed by
+        # _parse_type after the angle group it belongs in front of
+        if (
+            self.peek().kind == "kw"
+            and self.peek().text in ("static", "inline")
+            and self.peek(1).text == "<"
+        ):
+            self.eat()
+        if self.at("<"):
             end = self._match_angle(0)
             if end is not None:
                 for _ in range(end):
@@ -1096,6 +1126,16 @@ class Parser:
             raise ParseError(f"expected function name, got {self.peek()!r}")
         else:
             fname = self.eat().text
+            # attribute-macro recovery: real-world signatures carry
+            # unknown annotation macros (`IMATH_HOSTDEVICE inline T
+            # name(`, `static __always_inline __u32 name(`) that
+            # _parse_type consumed as the base type, leaving the TYPE in
+            # fname's slot. Everything up to the identifier directly
+            # before '(' is type/attribute soup; keep shifting — the
+            # same recovery CDT applies to unexpanded macros.
+            while self.peek().kind == "id" and not self.at("("):
+                base = fname if base in ("", "ANY") else base + " " + fname
+                fname = self.eat().text
             while self.at("::") and self.peek(1).kind in ("id", "op"):
                 self.eat()
                 if self.at("~"):  # destructor
@@ -1171,8 +1211,38 @@ class Parser:
                 self.eat()
         if self.at(")"):
             self.eat(")")
-        # tolerate `const`/etc between ) and {
-        while self.peek().kind == "kw" and not self.at("{"):
+        # tolerate everything between ) and the body: C++ `const`,
+        # `noexcept(...)`, `override`, Java `throws A, B` — none of it
+        # shapes the CFG. A constructor member-initializer list needs its
+        # own balanced skip first: `: x_(1), y_{v}` contains brace groups
+        # that must not be mistaken for the function body.
+        while (
+            not self.at("{") and not self.at(";") and not self.at(":")
+            and not self.at_eof()
+        ):
+            self.eat()
+        if self.at(":"):
+            self.eat()
+            while not self.at_eof():
+                while self.peek().kind == "id" or self.at("::"):
+                    self.eat()
+                if self.at("(") or self.at("{"):
+                    open_t = self.peek().text
+                    close_t = ")" if open_t == "(" else "}"
+                    depth = 0
+                    while not self.at_eof():
+                        t = self.eat()
+                        if t.text == open_t:
+                            depth += 1
+                        elif t.text == close_t:
+                            depth -= 1
+                            if depth == 0:
+                                break
+                if self.at(","):
+                    self.eat()
+                    continue
+                break
+        while not self.at("{") and not self.at(";") and not self.at_eof():
             self.eat()
         body = self._parse_block() if self.at("{") else _Seq([])
         mret = self.cpg.add_node(

@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Frontend robustness on real C the builder did not write (VERDICT r3 #5).
+
+Harvests function definitions from third-party C sources present on this
+box — BoringSSL's crypto tree (vendored under the tensorflow wheel),
+CPython/Tcl/Tk build sources, and static-inline bodies in /usr/include —
+and pushes every one through the full hermetic pipeline:
+
+  preproc -> lexer -> parser -> CPG invariants -> reaching-defs fixpoint
+  (python spec + C++ bitset solver agreement) -> abstract-dataflow
+  features -> extract_graph
+
+Per function it records: parser crash, CPG invariant violations (edge
+endpoints in range, CFG lines within the source, entry-reachability),
+solver termination + python/native agreement, absdf feature extraction
+outcome, and end-to-end extract_graph success. The reference's analog is
+Joern run on code its authors never saw (joern_session.py tests on
+bundled X42.c; the Big-Vul corpus itself); the hermetic frontend must
+hold up the same way.
+
+Writes docs/fidelity_robustness_report.json; floors are pinned in
+tests/test_fidelity_robustness_corpus.py (which re-harvests a fixed
+sample live and skips when the source trees are absent).
+
+    python scripts/fidelity_robustness.py --target 500
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: source roots, in priority order (first match wins per pattern)
+HARVEST_GLOBS = [
+    # BoringSSL crypto: real handwritten C, heavy pointer/loop/goto use
+    "/root/.cache/uv/archive-v0/*/tensorflow/include/external/boringssl/src/crypto/**/*.c",
+    # CPython/Tcl/Tk build + module sources
+    "/mnt/sandboxing/model_tools_env/v1/python/build/**/*.c",
+    "/mnt/sandboxing/model_tools_env/v1/python/install/lib/tcl8.6/*.c",
+    "/mnt/sandboxing/model_tools_env/v1/python/install/lib/tk8.6/*.c",
+    "/mnt/sandboxing/model_tools_env/v1/python/install/lib/python3.11/distutils/tests/xxmodule.c",
+    # glibc / kernel headers: static inline bodies
+    "/usr/include/**/*.h",
+]
+
+_FUNC_HEAD = re.compile(
+    r"^(?:static\s+|inline\s+|extern\s+|const\s+|unsigned\s+|struct\s+\w+\s*\*?\s*|"
+    r"[A-Za-z_]\w*[\s\*]+)+[A-Za-z_]\w*\s*\([^;{}]*\)\s*\{"
+)
+_SKIP_HEAD = re.compile(r"^\s*(typedef|struct|enum|union|#|//|/\*|\})")
+
+
+def extract_functions(
+    text: str, min_lines: int = 3, max_lines: int = 300, cap: int = 40
+) -> list[str]:
+    """Brace-matching scan for top-level function definitions. Heuristic
+    on purpose: sloppy extraction only makes the robustness corpus
+    nastier, which is the point."""
+    out: list[str] = []
+    lines = text.split("\n")
+    i, n = 0, len(lines)
+    depth = 0
+    while i < n and len(out) < cap:
+        line = lines[i]
+        if depth == 0 and not _SKIP_HEAD.match(line):
+            # join up to 4 physical lines to find `head(args) {`
+            probe = line
+            span = 1
+            while span < 4 and "{" not in probe and ";" not in probe and i + span < n:
+                probe = probe + " " + lines[i + span].strip()
+                span += 1
+            if _FUNC_HEAD.match(probe.strip()) and "=" not in probe.split("(")[0]:
+                d = 0
+                j = i
+                body: list[str] = []
+                while j < n:
+                    body.append(lines[j])
+                    d += lines[j].count("{") - lines[j].count("}")
+                    j += 1
+                    if d <= 0 and "{" in "".join(body):
+                        break
+                if d <= 0 and min_lines <= len(body) <= max_lines:
+                    out.append("\n".join(body) + "\n")
+                i = j
+                depth = 0
+                continue
+        depth += line.count("{") - line.count("}")
+        i += 1
+    return out
+
+
+def harvest(target: int, per_file_cap: int = 40) -> list[tuple[str, str]]:
+    """[(source_path, function_text)], up to `target` functions,
+    round-robin across the glob roots so no single tree (boringssl is
+    large enough to fill any target alone) crowds out the others."""
+    per_root: list[list[tuple[str, str]]] = []
+    seen_files: set[str] = set()
+    for pattern in HARVEST_GLOBS:
+        bucket: list[tuple[str, str]] = []
+        for path in sorted(glob.glob(pattern, recursive=True)):
+            real = os.path.realpath(path)
+            if real in seen_files:
+                continue
+            seen_files.add(real)
+            try:
+                text = open(path, errors="replace").read()
+            except OSError:
+                continue
+            for fn in extract_functions(text, cap=per_file_cap):
+                bucket.append((path, fn))
+            if len(bucket) >= target:  # no root needs more than target
+                break
+        per_root.append(bucket)
+    out: list[tuple[str, str]] = []
+    i = 0
+    while len(out) < target and any(per_root):
+        took = False
+        for bucket in per_root:
+            if i < len(bucket):
+                out.append(bucket[i])
+                took = True
+                if len(out) >= target:
+                    break
+        if not took:
+            break
+        i += 1
+    return out
+
+
+def check_one(code: str, audit: dict) -> None:
+    from deepdfa_tpu.data.diffs import split_lines
+    from deepdfa_tpu.data.pipeline import extract_graph
+    from deepdfa_tpu.frontend import ReachingDefinitions, parse_function
+    from deepdfa_tpu.frontend.absdf import graph_features
+    from deepdfa_tpu.frontend.cpg import CFG
+
+    audit["n"] += 1
+    try:
+        cpg = parse_function(code)
+    except Exception as e:  # noqa: BLE001 — crash accounting is the point
+        audit["parse_crash"] += 1
+        audit.setdefault("crash_samples", []).append(
+            f"{type(e).__name__}: {e}"[:160]
+        )
+        return
+    n_lines = len(split_lines(code))
+
+    # CPG invariants (cpg.nodes is a list indexed by node id)
+    ok = True
+    n_nodes = len(cpg.nodes)
+    for s, d, _t in cpg.edges:
+        if not (0 <= s < n_nodes and 0 <= d < n_nodes):
+            ok = False
+    cfg_nodes = cpg.cfg_nodes()
+    for nid in cfg_nodes:
+        ln = cpg.node(nid).line
+        if ln is not None and not (1 <= int(ln) <= n_lines):
+            ok = False
+    if not ok:
+        audit["invariant_violation"] += 1
+        return
+    # entry-reachability over CFG edges
+    if cfg_nodes:
+        adj: dict[int, list[int]] = {}
+        for s, d, t in cpg.edges:
+            if t == CFG:
+                adj.setdefault(s, []).append(d)
+        roots = [nid for nid in cfg_nodes if cpg.node(nid).label == "METHOD"]
+        frontier = list(roots or cfg_nodes[:1])
+        seen = set(frontier)
+        while frontier:
+            x = frontier.pop()
+            for y in adj.get(x, ()):
+                if y not in seen:
+                    seen.add(y)
+                    frontier.append(y)
+        reach = len(seen & set(cfg_nodes)) / len(cfg_nodes)
+        audit["reach_sum"] += reach
+        audit["reach_n"] += 1
+
+    # reaching-defs: python spec must terminate; native must agree
+    if len(cfg_nodes) <= 3000:
+        try:
+            rd = ReachingDefinitions(cpg)
+            ins_py = rd.solve(backend="python")
+            audit["solver_ok"] += 1
+            from deepdfa_tpu import native
+
+            if native.available():
+                ins_nat = rd.solve(backend="native")
+                if ins_py == ins_nat:
+                    audit["native_agree"] += 1
+                else:
+                    audit["native_disagree"] += 1
+        except Exception as e:  # noqa: BLE001
+            audit["solver_crash"] += 1
+            audit.setdefault("solver_samples", []).append(
+                f"{type(e).__name__}: {e}"[:160]
+            )
+
+    # absdf features: the reference RAISES on unhandled datatype shapes
+    # (abstract_dataflow_full.py) and the pipeline skips-and-logs; both
+    # outcomes are acceptable, a crash elsewhere is not
+    try:
+        graph_features(cpg)
+        audit["absdf_ok"] += 1
+    except Exception:  # noqa: BLE001 — spec-mirroring raise = skip class
+        audit["absdf_raise"] += 1
+
+    # end-to-end pipeline entry (None = reference skip-and-log behavior)
+    try:
+        g = extract_graph(code, graph_id=0)
+        audit["extract_ok" if g is not None else "extract_skip"] += 1
+    except Exception as e:  # noqa: BLE001
+        audit["extract_crash"] += 1
+        audit.setdefault("extract_samples", []).append(
+            f"{type(e).__name__}: {e}"[:160]
+        )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--target", type=int, default=500)
+    ap.add_argument("--out", default="docs/fidelity_robustness_report.json")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    funcs = harvest(args.target)
+    by_root: dict[str, int] = {}
+    for path, _ in funcs:
+        root = (
+            "boringssl" if "boringssl" in path
+            else "usr_include" if path.startswith("/usr/include")
+            else "python_build"
+        )
+        by_root[root] = by_root.get(root, 0) + 1
+
+    audit: dict = {
+        k: 0
+        for k in (
+            "n", "parse_crash", "invariant_violation", "solver_ok",
+            "solver_crash", "native_agree", "native_disagree", "absdf_ok",
+            "absdf_raise", "extract_ok", "extract_skip", "extract_crash",
+        )
+    }
+    audit["reach_sum"] = 0.0
+    audit["reach_n"] = 0
+    for _path, fn in funcs:
+        check_one(fn, audit)
+
+    n = max(audit["n"], 1)
+    report = {
+        "harvested": len(funcs),
+        "sources": by_root,
+        "elapsed_seconds": round(time.time() - t0, 1),
+        "parse_crash_rate": round(audit["parse_crash"] / n, 4),
+        "invariant_violation_rate": round(audit["invariant_violation"] / n, 4),
+        "mean_entry_reachability": round(
+            audit["reach_sum"] / max(audit["reach_n"], 1), 4
+        ),
+        "solver_termination": {
+            "ok": audit["solver_ok"], "crash": audit["solver_crash"],
+        },
+        "native_solver_agreement": {
+            "agree": audit["native_agree"],
+            "disagree": audit["native_disagree"],
+        },
+        "absdf": {"ok": audit["absdf_ok"], "spec_raise": audit["absdf_raise"]},
+        "extract_graph": {
+            "ok": audit["extract_ok"], "skip": audit["extract_skip"],
+            "crash": audit["extract_crash"],
+        },
+        "samples": {
+            k: audit.get(k, [])[:5]
+            for k in ("crash_samples", "solver_samples", "extract_samples")
+        },
+        "method": "scripts/fidelity_robustness.py harvesting third-party C "
+        "(BoringSSL crypto, CPython/Tcl build sources, /usr/include "
+        "static inlines) through preproc->parse->invariants->reaching-defs"
+        "(py+native)->absdf->extract_graph",
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: report[k] for k in (
+        "harvested", "sources", "parse_crash_rate",
+        "invariant_violation_rate", "mean_entry_reachability",
+        "native_solver_agreement", "extract_graph",
+    )}, indent=1))
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

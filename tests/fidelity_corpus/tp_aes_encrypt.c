@@ -1,0 +1,9 @@
+void AES_encrypt(const uint8_t *in, uint8_t *out, const AES_KEY *key) {
+  if (hwaes_capable()) {
+    aes_hw_encrypt(in, out, key);
+  } else if (vpaes_capable()) {
+    vpaes_encrypt(in, out, key);
+  } else {
+    aes_nohw_encrypt(in, out, key);
+  }
+}

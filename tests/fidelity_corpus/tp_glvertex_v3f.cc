@@ -1,0 +1,5 @@
+inline void
+glVertex (const IMATH_INTERNAL_NAMESPACE::V3f& v)
+{
+    glVertex3f (v.x, v.y, v.z);
+}

@@ -155,7 +155,7 @@ def test_get_codebleu_composite():
 
 def test_unsupported_language_raises():
     with pytest.raises(ValueError):
-        corpus_syntax_match([["x"]], ["x"], lang="java")
+        corpus_syntax_match([["x"]], ["x"], lang="js")
 
 
 # ---------------------------------------------------------------------------
@@ -223,4 +223,79 @@ def test_unsupported_lang_still_raises():
     from deepdfa_tpu.eval.codebleu import get_codebleu
 
     with pytest.raises(ValueError, match="descoped"):
-        get_codebleu(["int x;"], ["int x;"], lang="java")
+        get_codebleu(["x = 1"], ["x = 1"], lang="go")
+
+
+JAVA_REF = """public int sumPositive(int[] xs) {
+  int total = 0;
+  for (int i = 0; i < xs.length; i++) {
+    if (xs[i] > 0) {
+      total += xs[i];
+    }
+  }
+  return total;
+}"""
+
+JAVA_RESTRUCTURED = """public int sumPositive(int[] xs) {
+  int total = 0;
+  int i = 0;
+  while (i < xs.length) {
+    total += Math.max(xs[i], 0);
+    i++;
+  }
+  return total;
+}"""
+
+
+def test_java_syntax_match_identical_is_one():
+    from deepdfa_tpu.eval.codebleu import corpus_syntax_match, get_codebleu
+
+    assert corpus_syntax_match([[JAVA_REF]], [JAVA_REF], lang="java") == 1.0
+    perfect = get_codebleu([JAVA_REF], [JAVA_REF], lang="java")
+    assert perfect["codebleu"] == 1.0
+
+
+def test_java_syntax_match_ranks_structure():
+    """A structurally different (while vs for) but semantically close
+    candidate must score strictly between 0 and the identical one, and
+    above an unrelated snippet — the ordering the AST term exists for."""
+    from deepdfa_tpu.eval.codebleu import corpus_syntax_match
+
+    close = corpus_syntax_match([[JAVA_REF]], [JAVA_RESTRUCTURED], lang="java")
+    far = corpus_syntax_match(
+        [[JAVA_REF]],
+        ["public int noop(int[] xs) { int total = 0; return total; }"],
+        lang="java",
+    )
+    assert 0.0 < far < close < 1.0
+
+
+def test_java_signatures_parse_modifiers_generics_throws():
+    """CONCODE-style method shapes: modifiers before non-keyword return
+    types, generic type-parameter lists, throws clauses, enhanced for,
+    instanceof — all must produce a CPG (UNKNOWN-node recovery ok,
+    parser crash not)."""
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    shapes = [
+        "public String name() throws IOException { return this.n; }",
+        "public static <T> T first(List<T> xs) { return xs.get(0); }",
+        "protected synchronized void add(int[] xs) throws Exception {\n"
+        "  for (int x : xs) { this.sum += x; }\n}",
+        "public boolean eq(Object o) {\n"
+        "  return (o instanceof Point) && ((Point) o).x == x;\n}",
+    ]
+    for code in shapes:
+        cpg = parse_function(code)
+        assert cpg.cfg_nodes(), code
+
+
+def test_java_dataflow_match_sees_def_use():
+    from deepdfa_tpu.eval.codebleu import corpus_dataflow_match
+
+    assert corpus_dataflow_match([[JAVA_REF]], [JAVA_REF], lang="java") == 1.0
+    # alpha-renaming robustness (reference normalize_dataflow semantics);
+    # not exactly 1.0: per-node uses are emitted in sorted order, so a
+    # rename can permute triple order and shift the var_i numbering
+    renamed = JAVA_REF.replace("total", "acc").replace("xs", "arr")
+    assert corpus_dataflow_match([[JAVA_REF]], [renamed], lang="java") >= 0.9

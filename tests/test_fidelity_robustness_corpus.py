@@ -1,0 +1,56 @@
+"""Robustness floors on real third-party C (VERDICT r3 item 5).
+
+Live-harvests functions the builder did not write (BoringSSL crypto,
+CPython/Tcl build sources, /usr/include static inlines — see
+scripts/fidelity_robustness.py) and pushes them through the full
+frontend pipeline. The committed full-sweep evidence is
+docs/fidelity_robustness_report.json (520 functions); this test pins
+floors on a smaller live sample so regressions in the parser/solvers
+show up in the lane. Skips when none of the source trees exist."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _load_harness():
+    scripts = Path(__file__).parents[1] / "scripts"
+    sys.path.insert(0, str(scripts))
+    try:
+        import fidelity_robustness as fr
+    finally:
+        sys.path.remove(str(scripts))
+    return fr
+
+
+def test_third_party_corpus_floors():
+    fr = _load_harness()
+    funcs = fr.harvest(80)
+    if len(funcs) < 40:
+        pytest.skip(f"only {len(funcs)} third-party functions on this box")
+    audit = {
+        k: 0
+        for k in (
+            "n", "parse_crash", "invariant_violation", "solver_ok",
+            "solver_crash", "native_agree", "native_disagree", "absdf_ok",
+            "absdf_raise", "extract_ok", "extract_skip", "extract_crash",
+        )
+    }
+    audit["reach_sum"] = 0.0
+    audit["reach_n"] = 0
+    for _path, fn in funcs:
+        fr.check_one(fn, audit)
+    n = audit["n"]
+    # floors: parser survives real C (<=2% crash), invariants always hold,
+    # both solvers terminate and agree, the pipeline never crashes
+    # (skip-and-log is fine, reference getgraphs.py:57-59)
+    assert audit["parse_crash"] / n <= 0.02, audit
+    assert audit["invariant_violation"] == 0, audit
+    assert audit["solver_crash"] == 0, audit
+    assert audit["native_disagree"] == 0, audit
+    assert audit["extract_crash"] == 0, audit
+    if audit["reach_n"]:
+        assert audit["reach_sum"] / audit["reach_n"] >= 0.97, audit

@@ -66,3 +66,25 @@ def test_cxx_feature_semantics():
 
 def test_method_name_qualified():
     assert parse_function(CASES["qualified_method"]).method_name == "Foo::bar"
+
+
+def test_ctor_member_initializer_list_body_parses():
+    """`: x_(1), y_{v}` between ) and the body: the brace-init group must
+    not be mistaken for the function body (code-review r4 — previously
+    the body statements vanished from the CFG)."""
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    cpg = parse_function(
+        "Foo::Foo(int v) : x_(1), y_{v}, base::type{v, 2} {\n"
+        "  total = v;\n"
+        "  helper(total);\n"
+        "}\n"
+    )
+    codes = [n.code or "" for n in cpg.nodes]
+    assert any("total = v" in c for c in codes), codes
+    assert any("helper" in c for c in codes), codes
+    stmt_lines = {
+        n.line for n in (cpg.node(i) for i in cpg.cfg_nodes())
+        if n.label not in ("METHOD", "METHOD_RETURN")
+    }
+    assert {2, 3} <= stmt_lines, stmt_lines
