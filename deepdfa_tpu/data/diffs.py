@@ -9,13 +9,13 @@ freshly implemented: bidirectional middle-snake Myers (xdl_split
 semantics, so the CHOICE among equally minimal edit scripts matches
 git's) followed by change compaction — group sliding with merge,
 alignment to the other file's changes, and the indent-heuristic split
-scoring that is on by default in modern git (xdl_change_compact). Hunk
+scoring that is on by default in modern git (xdl_change_compact). Together with the
+xdl_cleanup_records pre-discard and the xdl_split cost heuristics, hunk
 boundaries — and therefore vuln-line labels — match `git diff
---no-index` byte-for-byte on 295/297 adversarial duplicate-line soups,
-296/297 indented soups, and 297/297 C-like edit scripts
-(scripts/fuzz_diffs_vs_git.py, docs/diff_fuzz_report.json; goldens in
-tests/goldens/diff_labels.json). The residual ~1% traces to
-xdl_cleanup_records' high-occurrence pre-discard, not replicated.
+--no-index` byte-for-byte on EVERY fuzz corpus: 297/297 adversarial
+duplicate-line soups, 297/297 indented soups, 297/297 C-like edit
+scripts, 29/29 thousand-line rewrites (scripts/fuzz_diffs_vs_git.py,
+docs/diff_fuzz_report.json; goldens in tests/goldens/diff_labels.json).
 """
 
 from __future__ import annotations
@@ -211,18 +211,95 @@ def _xdl_split(
         ec += 1
 
 
-def _xdl_diff(a: list[str], b: list[str]) -> tuple[list[bool], list[bool]]:
-    """git-identical diff: changed-line maps for (a, b).
+_KPDIS_RUN = 4  # XDL_KPDIS_RUN
+_MAX_EQLIMIT = 1024  # XDL_MAX_EQLIMIT
+_SIMSCAN_WINDOW = 100  # XDL_SIMSCAN_WINDOW
 
-    The divide-and-conquer of git's xdl_recs_cmp, with an explicit work
-    stack (Big-Vul functions can be thousands of lines; Python recursion
-    is not). Each box is first shrunk over its boundary snakes, then
-    split at the middle snake and both halves pushed. mxcost matches
-    git's xdl_do_diff: bogosqrt of the total diagonal count, floored at
-    _MAX_COST_MIN, computed once for the whole file pair."""
-    rchg1 = [False] * len(a)
-    rchg2 = [False] * len(b)
-    mxcost = max(_bogosqrt(len(a) + len(b) + 3), _MAX_COST_MIN)
+
+def _clean_mmatch(dis: dict[int, int], i: int, s: int, e: int) -> bool:
+    """git's xdl_clean_mmatch: discard a too-frequent line (dis[i]==2)
+    only when it sits inside a run of no-match (0) / multi-match (2)
+    lines with no-match lines on BOTH sides and the run is dominated by
+    no-match lines. s/e are inclusive window bounds."""
+    if i - s > _SIMSCAN_WINDOW:
+        s = i - _SIMSCAN_WINDOW
+    if e - i > _SIMSCAN_WINDOW:
+        e = i + _SIMSCAN_WINDOW
+    r, rdis0, rpdis0 = 1, 0, 1
+    while i - r >= s:
+        d = dis[i - r]
+        if d == 0:
+            rdis0 += 1
+        elif d == 2:
+            rpdis0 += 1
+        else:
+            break
+        r += 1
+    if rdis0 == 0:
+        return False
+    r, rdis1, rpdis1 = 1, 0, 1
+    while i + r <= e:
+        d = dis[i + r]
+        if d == 0:
+            rdis1 += 1
+        elif d == 2:
+            rpdis1 += 1
+        else:
+            break
+        r += 1
+    if rdis1 == 0:
+        return False
+    rdis0 += rdis1
+    rpdis0 += rpdis1
+    return rpdis0 * _KPDIS_RUN < rpdis0 + rdis0
+
+
+def _cleanup_records(
+    a: list[str], b: list[str], a0: int, a1: int, b0: int, b1: int
+) -> tuple[list[int], list[int]]:
+    """git's xdl_cleanup_records: within the trimmed windows, pre-discard
+    lines that have no match in the other file or appear there too often
+    (>= bogosqrt of the file size); discarded lines are marked changed
+    upfront and excluded from the Myers search. Occurrence counts span
+    the WHOLE other file (the classifier counts every record), while the
+    keep/discard scan runs over the trimmed window only. Returns the
+    surviving indices per side."""
+    from collections import Counter
+
+    count_in_b = Counter(b)
+    count_in_a = Counter(a)
+
+    def classify(lines, lo, hi, other_counts, mlim) -> dict[int, int]:
+        dis = {}
+        for i in range(lo, hi):
+            nm = other_counts.get(lines[i], 0)
+            dis[i] = 0 if nm == 0 else (2 if nm >= mlim else 1)
+        return dis
+
+    def keep(lines, lo, hi, dis) -> list[int]:
+        return [
+            i
+            for i in range(lo, hi)
+            if dis[i] == 1
+            or (dis[i] == 2 and not _clean_mmatch(dis, i, lo, hi - 1))
+        ]
+
+    mlim_a = min(_bogosqrt(len(a)), _MAX_EQLIMIT)
+    mlim_b = min(_bogosqrt(len(b)), _MAX_EQLIMIT)
+    dis_a = classify(a, a0, a1, count_in_b, mlim_a)
+    dis_b = classify(b, b0, b1, count_in_a, mlim_b)
+    return keep(a, a0, a1, dis_a), keep(b, b0, b1, dis_b)
+
+
+def _xdl_diff_core(
+    a: list[str], b: list[str], rchg1: list[bool], rchg2: list[bool],
+    mxcost: int,
+) -> None:
+    """xdl_recs_cmp divide-and-conquer over (a, b), marking rchg in
+    place; explicit work stack (Big-Vul functions can be thousands of
+    lines; Python recursion is not). Each box is first shrunk over its
+    boundary snakes, then split at the middle snake and both halves
+    pushed."""
     stack = [(0, len(a), 0, len(b), False)]
     while stack:
         off1, lim1, off2, lim2, need_min = stack.pop()
@@ -244,6 +321,48 @@ def _xdl_diff(a: list[str], b: list[str]) -> tuple[list[bool], list[bool]]:
             )
             stack.append((off1, i1, off2, i2, min_lo))
             stack.append((i1, lim1, i2, lim2, min_hi))
+
+
+def _xdl_diff(a: list[str], b: list[str]) -> tuple[list[bool], list[bool]]:
+    """git-identical diff: changed-line maps for (a, b).
+
+    Pipeline order matches xdl_optimize_ctxs + xdl_do_diff: trim common
+    head/tail (xdl_trim_ends), pre-discard no-match / too-frequent lines
+    (xdl_cleanup_records — they are marked changed and excluded from the
+    search), run the middle-snake divide-and-conquer over the surviving
+    subsequences, and map the changed flags back. mxcost is bogosqrt of
+    the SURVIVING diagonal count (xdl_do_diff uses nreff), floored at
+    _MAX_COST_MIN."""
+    rchg1 = [False] * len(a)
+    rchg2 = [False] * len(b)
+    a0, b0 = 0, 0
+    a1, b1 = len(a), len(b)
+    while a0 < a1 and b0 < b1 and a[a0] == b[b0]:
+        a0 += 1
+        b0 += 1
+    while a0 < a1 and b0 < b1 and a[a1 - 1] == b[b1 - 1]:
+        a1 -= 1
+        b1 -= 1
+    keep_a, keep_b = _cleanup_records(a, b, a0, a1, b0, b1)
+    kept_a, kept_b = set(keep_a), set(keep_b)
+    for i in range(a0, a1):
+        if i not in kept_a:
+            rchg1[i] = True
+    for j in range(b0, b1):
+        if j not in kept_b:
+            rchg2[j] = True
+    ra = [a[i] for i in keep_a]
+    rb = [b[j] for j in keep_b]
+    sub1 = [False] * len(ra)
+    sub2 = [False] * len(rb)
+    mxcost = max(_bogosqrt(len(ra) + len(rb) + 3), _MAX_COST_MIN)
+    _xdl_diff_core(ra, rb, sub1, sub2, mxcost)
+    for k, i in enumerate(keep_a):
+        if sub1[k]:
+            rchg1[i] = True
+    for k, j in enumerate(keep_b):
+        if sub2[k]:
+            rchg2[j] = True
     return rchg1, rchg2
 
 

@@ -1226,6 +1226,11 @@ class Parser:
             while not self.at_eof():
                 while self.peek().kind == "id" or self.at("::"):
                     self.eat()
+                if self.at("<"):  # templated base: `: Base<T>{v}`
+                    end = self._match_angle(0)
+                    if end is not None:
+                        for _ in range(end):
+                            self.eat()
                 if self.at("(") or self.at("{"):
                     open_t = self.peek().text
                     close_t = ")" if open_t == "(" else "}"

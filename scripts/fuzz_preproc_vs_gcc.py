@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Fuzz frontend/preproc.py against the real C preprocessor (gcc -E).
+
+Same spirit as scripts/fuzz_diffs_vs_git.py: the hermetic conditional
+evaluator (ISO C #if/#elif arithmetic, #ifdef/#define/#undef tables,
+block-comment awareness) claims real-preprocessor semantics; this
+harness generates random directive programs over marker declarations,
+runs both `gcc -E -P` and evaluate_conditionals, and compares WHICH
+markers survive. Expressions are drawn well-formed (gcc hard-errors on
+malformed ones, where the hermetic pass intentionally stays permissive),
+and macro names avoid gcc's built-in table.
+
+Writes docs/preproc_fuzz_report.json; floors in tests/test_preproc.py's
+slow section (added alongside this script).
+
+    python scripts/fuzz_preproc_vs_gcc.py [--n 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from deepdfa_tpu.frontend.preproc import evaluate_conditionals  # noqa: E402
+
+_MARKER_RE = re.compile(r"\bm(\d+)\b")
+MACROS = [f"MYFLAG_{c}" for c in "ABCDE"]
+
+
+def gen_expr(rng: random.Random, depth: int = 0) -> str:
+    if depth >= 3 or rng.random() < 0.35:
+        k = rng.randrange(4)
+        if k == 0:
+            return str(rng.randrange(0, 6))
+        if k == 1:
+            return rng.choice(MACROS)
+        if k == 2:
+            return f"defined({rng.choice(MACROS)})"
+        return f"defined {rng.choice(MACROS)}"
+    op = rng.choice(["+", "-", "*", "&&", "||", "<", "<=", "==", "!=", "<<"])
+    a = gen_expr(rng, depth + 1)
+    b = gen_expr(rng, depth + 1)
+    if op == "<<":
+        b = str(rng.randrange(0, 8))
+    if rng.random() < 0.2:
+        return f"!({a} {op} {b})"
+    if rng.random() < 0.15:
+        c = gen_expr(rng, depth + 1)
+        return f"(({a} {op} {b}) ? {c} : {gen_expr(rng, depth + 1)})"
+    return f"({a} {op} {b})"
+
+
+def gen_program(rng: random.Random) -> str:
+    """Random nest of conditionals over marker declarations."""
+    lines: list[str] = []
+    marker = 0
+    depth = 0
+
+    def emit_markers():
+        nonlocal marker
+        for _ in range(rng.randrange(1, 3)):
+            lines.append(f"int m{marker};")
+            marker += 1
+
+    for _ in range(rng.randrange(6, 18)):
+        r = rng.random()
+        if r < 0.22:
+            kind = rng.randrange(3)
+            if kind == 0:
+                lines.append(f"#if {gen_expr(rng)}")
+            elif kind == 1:
+                lines.append(f"#ifdef {rng.choice(MACROS)}")
+            else:
+                lines.append(f"#ifndef {rng.choice(MACROS)}")
+            depth += 1
+        elif r < 0.32 and depth:
+            lines.append(f"#elif {gen_expr(rng)}")
+        elif r < 0.42 and depth:
+            lines.append("#else")
+        elif r < 0.55 and depth:
+            lines.append("#endif")
+            depth -= 1
+        elif r < 0.65:
+            v = rng.choice(["", " 1", " 0", f" {rng.randrange(2, 9)}"])
+            lines.append(f"#define {rng.choice(MACROS)}{v}")
+        elif r < 0.72:
+            lines.append(f"#undef {rng.choice(MACROS)}")
+        elif r < 0.78:
+            lines.append(f"/* noise {rng.randrange(9)}")
+            lines.append("#if this is commented out")
+            lines.append("*/")
+        else:
+            emit_markers()
+    while depth:
+        lines.append("#endif")
+        depth -= 1
+    emit_markers()  # at least one unconditional tail marker
+    return "\n".join(lines) + "\n"
+
+
+def gcc_markers(program: str) -> set[int] | None:
+    res = subprocess.run(
+        ["gcc", "-E", "-P", "-xc", "-"],
+        input=program, capture_output=True, text=True,
+    )
+    if res.returncode != 0:
+        return None  # malformed for gcc; skip the case
+    return {int(m) for m in _MARKER_RE.findall(res.stdout)}
+
+
+def ours_markers(program: str) -> set[int]:
+    return {int(m) for m in _MARKER_RE.findall(evaluate_conditionals(program))}
+
+
+def run(n: int, seed: int, dump: int = 0) -> dict:
+    rng = random.Random(seed)
+    total = exact = skipped = 0
+    dumped = 0
+    while total < n:
+        prog = gen_program(rng)
+        want = gcc_markers(prog)
+        if want is None:
+            skipped += 1
+            if skipped > 5 * n:
+                break
+            continue
+        total += 1
+        got = ours_markers(prog)
+        if got == want:
+            exact += 1
+        elif dumped < dump:
+            dumped += 1
+            print("=== MISS ===")
+            print(prog)
+            print("gcc :", sorted(want))
+            print("ours:", sorted(got))
+    return {
+        "n": total,
+        "exact": exact,
+        "pct": round(100.0 * exact / max(total, 1), 1),
+        "gcc_rejected_skipped": skipped,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=300)
+    ap.add_argument("--seed", type=int, default=20260730)
+    ap.add_argument("--dump-misses", type=int, default=0)
+    args = ap.parse_args()
+    if shutil.which("gcc") is None:
+        print("no gcc on this box"); return
+    rec = run(args.n, args.seed, args.dump_misses)
+    import datetime
+
+    rec["_meta"] = {
+        "seed": args.seed,
+        "gcc": subprocess.run(["gcc", "--version"], capture_output=True,
+                              text=True).stdout.splitlines()[0],
+        "generated_at": datetime.datetime.now(
+            datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+    }
+    print(json.dumps({k: rec[k] for k in ("n", "exact", "pct")}))
+    out = REPO / "docs" / "preproc_fuzz_report.json"
+    out.write_text(json.dumps(rec, indent=1))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

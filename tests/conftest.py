@@ -26,6 +26,22 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def load_script_module(name: str):
+    """Import a module from the repo's scripts/ dir (the fuzz/robustness
+    harnesses live there as runnable scripts; their floor tests reuse the
+    corpus generators). Path hygiene in one place."""
+    import importlib
+    import pathlib
+    import sys
+
+    scripts = str(pathlib.Path(__file__).parents[1] / "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.remove(scripts)
+
+
 @pytest.fixture(scope="session")
 def devices():
     import jax

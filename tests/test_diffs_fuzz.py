@@ -2,9 +2,9 @@
 
 Runs scripts/fuzz_diffs_vs_git.py's corpora in-process at a reduced size
 (git subprocess per case; the full 297-case sweep lives in the script and
-its committed report docs/diff_fuzz_report.json). Floors are set below
-the measured 99.3/99.7/100% so seed drift can't flake the lane, but well
-above the pre-xdl 58.6% adversarial baseline.
+its committed report docs/diff_fuzz_report.json). With the full xdiff
+pipeline (split heuristics + cleanup_records + compaction) every corpus
+measures 100% exact; floors keep a hair of slack for git-version drift.
 """
 
 import shutil
@@ -13,23 +13,18 @@ import pytest
 
 pytestmark = pytest.mark.slow
 
-FLOORS = {"adversarial": 0.95, "indented": 0.95, "fuzzed": 1.0}
+FLOORS = {"adversarial": 0.98, "indented": 0.98, "fuzzed": 1.0}
 N = 60
 
 
 @pytest.mark.skipif(shutil.which("git") is None, reason="no git binary")
 @pytest.mark.parametrize("corpus", sorted(FLOORS))
 def test_fuzz_exactness_floor(corpus):
-    import sys
-    from pathlib import Path
-
-    scripts = Path(__file__).parents[1] / "scripts"
-    sys.path.insert(0, str(scripts))
-    try:
-        import fuzz_diffs_vs_git as fz
-    finally:
-        sys.path.remove(str(scripts))
     import random
+
+    from tests.conftest import load_script_module
+
+    fz = load_script_module("fuzz_diffs_vs_git")
 
     from deepdfa_tpu.data.diffs import diff_lines
 

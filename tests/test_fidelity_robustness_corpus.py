@@ -8,26 +8,15 @@ docs/fidelity_robustness_report.json (520 functions); this test pins
 floors on a smaller live sample so regressions in the parser/solvers
 show up in the lane. Skips when none of the source trees exist."""
 
-import sys
-from pathlib import Path
-
 import pytest
+
+from tests.conftest import load_script_module
 
 pytestmark = pytest.mark.slow
 
 
-def _load_harness():
-    scripts = Path(__file__).parents[1] / "scripts"
-    sys.path.insert(0, str(scripts))
-    try:
-        import fidelity_robustness as fr
-    finally:
-        sys.path.remove(str(scripts))
-    return fr
-
-
 def test_third_party_corpus_floors():
-    fr = _load_harness()
+    fr = load_script_module("fidelity_robustness")
     funcs = fr.harvest(80)
     if len(funcs) < 40:
         pytest.skip(f"only {len(funcs)} third-party functions on this box")

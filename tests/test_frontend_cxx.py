@@ -88,3 +88,19 @@ def test_ctor_member_initializer_list_body_parses():
         if n.label not in ("METHOD", "METHOD_RETURN")
     }
     assert {2, 3} <= stmt_lines, stmt_lines
+
+
+def test_ctor_templated_base_brace_init():
+    """`: Base<int>{v}` — template args inside the initializer list must
+    not break the body detection (code-review r4)."""
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    cpg = parse_function(
+        "Foo::Foo(int v) : base_type<int>{v}, m_(init<a, b>(v)) {\n"
+        "  total = v;\n"
+        "  helper(total);\n"
+        "}\n"
+    )
+    codes = [n.code or "" for n in cpg.nodes]
+    assert any("total = v" in c for c in codes), codes
+    assert any("helper" in c for c in codes), codes
