@@ -169,6 +169,12 @@ class TrainConfig:
     seed: int = 1
     pos_weight: float | None = None  # None = derived from train labels
     log_every_steps: int = 50
+    # feature-identity dropout (train-time augmentation, beyond the
+    # reference): with this probability per node, known abstract-dataflow
+    # buckets are mapped to UNKNOWN so decisions also learn to ride the
+    # graph structure — improves transfer to bug shapes whose defs hash
+    # outside the train vocabulary (train/loop.py:drop_known_feats)
+    feat_unknown_dropout: float = 0.0
     # sanitizer mode (reference runs Lightning detect_anomaly: true,
     # DDFA/configs/config_default.yaml:40): fail fast on NaN/inf in any
     # jitted computation + enable jax's internal invariant checks

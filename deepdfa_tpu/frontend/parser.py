@@ -1224,13 +1224,20 @@ class Parser:
         if self.at(":"):
             self.eat()
             while not self.at_eof():
-                while self.peek().kind == "id" or self.at("::"):
-                    self.eat()
-                if self.at("<"):  # templated base: `: Base<T>{v}`
-                    end = self._match_angle(0)
-                    if end is not None:
+                # qualified, possibly templated member/base name:
+                # `Base<T>::Nested`, `ns::m_` — angle groups may be
+                # followed by further :: segments, so keep scanning
+                while (
+                    self.peek().kind == "id" or self.at("::") or self.at("<")
+                ):
+                    if self.at("<"):
+                        end = self._match_angle(0)
+                        if end is None:
+                            break
                         for _ in range(end):
                             self.eat()
+                    else:
+                        self.eat()
                 if self.at("(") or self.at("{"):
                     open_t = self.peek().text
                     close_t = ")" if open_t == "(" else "}"

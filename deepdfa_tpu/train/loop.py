@@ -60,6 +60,25 @@ def _squeeze_batch(batch: GraphBatch) -> GraphBatch:
     return GraphBatch(**arrays, num_graphs=batch.num_graphs)
 
 
+def drop_known_feats(node_feats, key, rate: float):
+    """Feature-identity dropout: with probability `rate` per NODE, map
+    every known vocab bucket (index >= 2) down to UNKNOWN (1), keeping
+    the 0 (not-a-def-in-this-view) pattern intact.
+
+    Motivation (round 4): vocabularies are built from the train split
+    only, so an unseen bug family's definitions arrive as UNKNOWN at
+    test time — a model that keys on specific buckets transfers nothing
+    to them (the cross-template analog of the reference's cross-project
+    drop, paper Table 7). Training with some defs randomly anonymized
+    forces structure-based decisions (which defs REACH the use) to carry
+    weight alongside bucket identity. One jnp.where — free on TPU."""
+    import jax.numpy as jnp
+
+    drop = jax.random.bernoulli(key, rate, (node_feats.shape[0],))
+    mask = drop if node_feats.ndim == 1 else drop[:, None]
+    return jnp.where(mask, jnp.minimum(node_feats, 1), node_feats)
+
+
 class GraphTrainer:
     """Train/eval driver for models taking a GraphBatch and emitting logits."""
 
@@ -83,6 +102,9 @@ class GraphTrainer:
             "graph", "node", "dataflow_solution_in", "dataflow_solution_out"
         ):
             raise ValueError(f"unsupported label_style: {self.label_style}")
+        self.feat_dropout = float(
+            getattr(cfg.train, "feat_unknown_dropout", 0.0)
+        )
         self._build_steps()
 
     # -- construction -------------------------------------------------------
@@ -123,12 +145,26 @@ class GraphTrainer:
         @partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(), P(("dp",))),
+            in_specs=(P(), P(("dp",)), P()),
             out_specs=(P(), P()),
             check_vma=False,
         )
-        def _sharded_grads(params, batch):
+        def _sharded_grads(params, batch, step):
             local = _squeeze_batch(batch)
+            if self.feat_dropout > 0:
+                # deterministic per step (no RNG in TrainState, so
+                # checkpoints stay compatible); every dp shard applies
+                # the same positional mask to its local arrays —
+                # augmentation, not a numerics contract
+                key = jax.random.fold_in(
+                    jax.random.key(self.cfg.train.seed + 7919), step
+                )
+                local = dataclasses.replace(
+                    local,
+                    node_feats=drop_known_feats(
+                        local.node_feats, key, self.feat_dropout
+                    ),
+                )
 
             def loss_sum_fn(p):
                 s, c = self._local_loss_sum(p, local)
@@ -147,7 +183,7 @@ class GraphTrainer:
 
         @partial(jax.jit, donate_argnums=0)
         def train_step(state: TrainState, batch: GraphBatch):
-            loss, grads = _sharded_grads(state.params, batch)
+            loss, grads = _sharded_grads(state.params, batch, state.step)
             updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return (

@@ -57,6 +57,10 @@ def main() -> None:
     ap.add_argument("--holdout-family", default="index_clamp_order",
                     help="bug family excluded from train/val and reported "
                     "separately on test ('' disables)")
+    ap.add_argument("--feat-dropout", type=float, default=0.0,
+                    help="train.feat_unknown_dropout: anonymize this "
+                    "fraction of def buckets per step so decisions also "
+                    "ride graph structure (cross-template transfer)")
     ap.add_argument("--gtype", choices=("cfg", "cfg+dep", "pdg"),
                     default="cfg+dep",
                     help="graph relation set (the reference's gtype/rdg "
@@ -146,6 +150,7 @@ def main() -> None:
         f"model.n_etypes={GTYPE_ETYPES[args.gtype]}",
         f"data.gtype={args.gtype}",
         f"train.max_epochs={args.max_epochs}",
+        f"train.feat_unknown_dropout={args.feat_dropout}",
     ]
     if platform != "cpu":
         overrides.append("model.scan_steps=true")  # keep the TPU compile small
@@ -225,6 +230,7 @@ def main() -> None:
             f"label_noise={args.label_noise if args.corpus == 'v2' else 0} "
             f"(data/synthetic.py)",
             "gtype": args.gtype,
+            "feat_unknown_dropout": args.feat_dropout,
             "holdout_family": holdout or None,
             "reference": "config_default.yaml:43-47 + config_bigvul.yaml + config_ggnn.yaml",
         },

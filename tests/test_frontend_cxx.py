@@ -96,7 +96,8 @@ def test_ctor_templated_base_brace_init():
     from deepdfa_tpu.frontend.parser import parse_function
 
     cpg = parse_function(
-        "Foo::Foo(int v) : base_type<int>{v}, m_(init<a, b>(v)) {\n"
+        "Foo::Foo(int v) : base_type<int>{v}, Base<T>::Nested(v), "
+        "m_(init<a, b>(v)) {\n"
         "  total = v;\n"
         "  helper(total);\n"
         "}\n"
