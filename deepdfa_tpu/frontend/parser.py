@@ -1128,14 +1128,42 @@ class Parser:
             fname = self.eat().text
             # attribute-macro recovery: real-world signatures carry
             # unknown annotation macros (`IMATH_HOSTDEVICE inline T
-            # name(`, `static __always_inline __u32 name(`) that
-            # _parse_type consumed as the base type, leaving the TYPE in
-            # fname's slot. Everything up to the identifier directly
-            # before '(' is type/attribute soup; keep shifting — the
-            # same recovery CDT applies to unexpanded macros.
-            while self.peek().kind == "id" and not self.at("("):
-                base = fname if base in ("", "ANY") else base + " " + fname
-                fname = self.eat().text
+            # name(`, `static __inline__ __u8 *name(`) that _parse_type
+            # consumed as the base type, leaving the TYPE in fname's
+            # slot. Gather the id/*/& soup up to '('; the LAST
+            # identifier is the function name, the rest is type — the
+            # same recovery CDT applies to unexpanded macros. (operator
+            # overloads keep their op tokens for the handler below.)
+            def _soup_tok() -> bool:
+                t = self.peek()
+                return (
+                    t.kind == "id"
+                    or t.text in ("*", "&")
+                    # `__fortify_function __wur char *gets(`: keyword
+                    # type specifiers can FOLLOW the attribute macros
+                    # (qualifiers are a subset of TYPE_KEYWORDS)
+                    or (t.kind == "kw" and t.text in TYPE_KEYWORDS)
+                )
+
+            if fname != "operator" and _soup_tok():
+                soup = [fname]
+                while _soup_tok():
+                    tok = self.eat().text
+                    soup.append(tok)
+                    if tok == "operator":
+                        # `MYMACRO Vec operator*(`: the overload's op
+                        # token belongs to the handler below, not soup
+                        break
+                id_positions = [
+                    k for k, t in enumerate(soup) if t not in ("*", "&")
+                ]
+                fname = soup[id_positions[-1]]
+                extra = [
+                    t for k, t in enumerate(soup) if k != id_positions[-1]
+                ]
+                if extra:
+                    prefix = "" if base in ("", "ANY") else base + " "
+                    base = prefix + " ".join(extra)
             while self.at("::") and self.peek(1).kind in ("id", "op"):
                 self.eat()
                 if self.at("~"):  # destructor

@@ -4,7 +4,7 @@ Live-harvests functions the builder did not write (BoringSSL crypto,
 CPython/Tcl build sources, /usr/include static inlines — see
 scripts/fidelity_robustness.py) and pushes them through the full
 frontend pipeline. The committed full-sweep evidence is
-docs/fidelity_robustness_report.json (520 functions); this test pins
+docs/fidelity_robustness_report.json (1671 functions); this test pins
 floors on a smaller live sample so regressions in the parser/solvers
 show up in the lane. Skips when none of the source trees exist."""
 

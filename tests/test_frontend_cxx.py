@@ -105,3 +105,16 @@ def test_ctor_templated_base_brace_init():
     codes = [n.code or "" for n in cpg.nodes]
     assert any("total = v" in c for c in codes), codes
     assert any("helper" in c for c in codes), codes
+
+
+def test_operator_overload_after_attribute_macro():
+    """`MYMACRO Vec operator*(...)`: the soup recovery must leave the
+    overload's op token to the operator handler (code-review r4)."""
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    cpg = parse_function(
+        "MYMACRO Vec operator*(Vec a, Vec b) { return a; }"
+    )
+    m = cpg.node(cpg.method_id)
+    assert m.name == "operator*", m.name
+    assert "*" not in (m.type_full_name or ""), m.type_full_name
