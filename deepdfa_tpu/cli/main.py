@@ -81,7 +81,23 @@ def cmd_prepare(args) -> None:
         else:
             fmt = "bigvul"
     if fmt == "synthetic":
-        synth = synthetic.generate(args.n_examples, seed=cfg.data.seed)
+        if not args.synthetic_v2 and (
+            args.lookalike_rate != 0.5 or args.label_noise != 0.02
+        ):
+            raise SystemExit(
+                "--lookalike-rate/--label-noise only apply with "
+                "--synthetic-v2 (the v1 generator has neither knob)"
+            )
+        if args.synthetic_v2:
+            # the hardened corpus: order families + benign lookalikes +
+            # label noise (data/synthetic.py:generate_v2, round 4)
+            synth = synthetic.generate_v2(
+                args.n_examples, seed=cfg.data.seed,
+                lookalike_rate=args.lookalike_rate,
+                label_noise=args.label_noise,
+            )
+        else:
+            synth = synthetic.generate(args.n_examples, seed=cfg.data.seed)
         examples = synthetic.to_examples(synth)
     elif fmt == "devign":
         examples = readers.read_devign(args.source, sample=args.sample)
@@ -1290,6 +1306,12 @@ def main(argv=None) -> None:
                    help="expand line labels with data/control dependents")
     p.add_argument("--sample", type=int, default=None)
     p.add_argument("--n-examples", type=int, default=2000)
+    p.add_argument("--synthetic-v2", action="store_true",
+                   help="hardened synthetic corpus: order-sensitive bug "
+                   "families + benign lookalikes + label noise "
+                   "(docs/ROUND4_NOTES.md)")
+    p.add_argument("--lookalike-rate", type=float, default=0.5)
+    p.add_argument("--label-noise", type=float, default=0.02)
     p.add_argument("--format", default="auto",
                    choices=("auto", "bigvul", "devign", "dbgbench", "synthetic"),
                    help="source format (auto: by file extension)")

@@ -179,6 +179,7 @@ def main() -> None:
     epochs_log = []
     t_train0 = time.perf_counter()
     reached_at = None
+    best_val_f1, best_epoch, best_params = -1.0, -1, None
     for epoch in range(args.max_epochs):
         t0 = time.perf_counter()
         # fit() counts its own epochs from 0; bind THIS epoch's
@@ -197,11 +198,20 @@ def main() -> None:
         }
         epochs_log.append(rec)
         print(json.dumps(rec), flush=True)
+        if val_metrics["f1"] > best_val_f1:
+            # best-val checkpoint selection, the reference's protocol
+            # (best-F1 checkpointing linevul_main.py:225-251; post-fit
+            # best-ckpt selection main_cli.py:175-183) — test metrics
+            # come from THIS state, not the last epoch's
+            best_val_f1, best_epoch = val_metrics["f1"], epoch
+            best_params = jax.device_get(state.params)
         if val_metrics["f1"] >= args.target_f1 and reached_at is None:
             reached_at = epoch
             break
     train_seconds = time.perf_counter() - t_train0
 
+    if best_params is not None:
+        state = dataclasses.replace(state, params=jax.device_put(best_params))
     test_metrics, _ = trainer.evaluate(state, batches_for(by_split["test"]))
 
     # -- trivial-baseline control: logistic regression over subkey
@@ -245,6 +255,9 @@ def main() -> None:
         ),
         "reached_target_at_epoch": reached_at,
         "final_val_f1": epochs_log[-1]["val_f1"] if epochs_log else None,
+        "best_val_f1": round(best_val_f1, 4),
+        "best_val_epoch": best_epoch,
+        "test_protocol": "best-val-F1 checkpoint (reference protocol)",
         "test_f1": round(test_metrics["f1"], 4),
         "test_precision": round(test_metrics["precision"], 4),
         "test_recall": round(test_metrics["recall"], 4),
