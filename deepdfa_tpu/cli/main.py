@@ -1499,8 +1499,10 @@ def main(argv=None) -> None:
     p.add_argument("--refs", nargs="+", required=True,
                    help="reference files (one example per line)")
     p.add_argument("--hyp", required=True, help="hypothesis file")
+    from deepdfa_tpu.eval.codebleu import LANG_DIALECT
+
     p.add_argument("--lang", default="c",
-                   choices=["c", "cpp", "java", "python"])
+                   choices=sorted(set(LANG_DIALECT) | {"python"}))
     p.add_argument("--params", default="0.25,0.25,0.25,0.25",
                    help="alpha,beta,gamma,theta component weights")
     p.set_defaults(fn=cmd_codebleu)

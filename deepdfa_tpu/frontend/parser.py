@@ -37,6 +37,66 @@ BIN_PREC = {
     "*": 10, "/": 10, "%": 10,
 }
 
+# --- non-C dialect surface (CodeBLEU structural matching parses java/
+# c_sharp/js/go/php/ruby generation snippets through this same frontend;
+# everything here is gated on Parser.dialect so C/C++ behavior — the
+# fidelity-tested dataset path — is bit-identical to before) -----------
+
+#: extra punctuation binary operators per dialect (token must be lexed by
+#: tokens.DIALECT_OPERATORS)
+DIALECT_BIN_PREC: dict[str, dict[str, int]] = {
+    "java": {">>>": 8},
+    "cs": {"??": 1},
+    "js": {"===": 6, "!==": 6, ">>>": 8, "**": 11, "??": 1},
+    "go": {"&^": 5},
+    "php": {"===": 6, "!==": 6, "<=>": 6, ".": 9, "**": 11, "??": 1},
+    "ruby": {"===": 6, "<=>": 6, "**": 11, "=~": 6, "!~": 6},
+}
+
+#: identifier-spelled binary operators (`o instanceof Foo`, `o is Foo`)
+DIALECT_WORD_BINOPS: dict[str, dict[str, int]] = {
+    "java": {"instanceof": 7},
+    "cs": {"is": 7, "as": 10},
+    "php": {"instanceof": 7, "and": 1, "or": 1, "xor": 1},
+    "ruby": {"and": 1, "or": 1},
+}
+
+#: extra assignment operators per dialect; go's := IS a definition (its
+#: call name must stay <operator>.assignment so the reaching-defs solver
+#: and the abstract-dataflow extractor see the def)
+DIALECT_ASSIGN_OPS: dict[str, set[str]] = {
+    "cs": {"??="},
+    "js": {"**=", ">>>=", "??="},
+    "go": {":="},
+    "php": {".=", "**=", "??="},
+    "ruby": {"**="},
+}
+
+#: joern-style call names for operators OP_NAMES doesn't cover
+EXTRA_OP_NAMES = {
+    "instanceof": "<operator>.instanceOf",
+    "is": "<operator>.instanceOf",
+    "as": "<operator>.cast",
+    "??": "<operator>.nullCoalesce",
+    "===": "<operator>.identityEquals",
+    "!==": "<operator>.identityNotEquals",
+    ">>>": "<operator>.logicalShiftRight",
+    "**": "<operator>.exponentiation",
+    "&^": "<operator>.andNot",
+    ".": "<operator>.concat",
+    "<=>": "<operator>.spaceship",
+    "=~": "<operator>.match",
+    "!~": "<operator>.notMatch",
+    "and": "<operator>.logicalAnd",
+    "or": "<operator>.logicalOr",
+    "xor": "<operator>.logicalXor",
+    ":=": None,  # filled below: plain assignment (definition semantics)
+    "**=": "<operator>.assignmentExponentiation",
+    ">>>=": "<operator>.assignmentLogicalShiftRight",
+    ".=": "<operator>.assignmentConcat",
+    "??=": "<operator>.assignmentNullCoalesce",
+}
+
 
 class ParseError(ValueError):
     pass
@@ -149,16 +209,32 @@ class _RangeFor(_Stmt):
 
 
 class Parser:
-    def __init__(self, code: str):
-        from deepdfa_tpu.frontend.preproc import evaluate_conditionals
+    def __init__(self, code: str, dialect: str = "c"):
+        self.dialect = dialect
+        if dialect == "c":
+            from deepdfa_tpu.frontend.preproc import evaluate_conditionals
 
-        # resolve #if/#ifdef regions + expand file-local object macros
-        # BEFORE lexing (shared pre-pass, so the native and python lexers
-        # stay bit-identical); line structure is preserved
-        self.toks = tokenize(evaluate_conditionals(code))
+            # resolve #if/#ifdef regions + expand file-local object macros
+            # BEFORE lexing (shared pre-pass, so the native and python
+            # lexers stay bit-identical); line structure is preserved
+            self.toks = tokenize(evaluate_conditionals(code))
+        else:
+            # non-C dialects have no C preprocessor; the lexer handles
+            # their extra operators / sigils / newline semicolons
+            self.toks = tokenize(code, backend="python", dialect=dialect)
         self.i = 0
         self.cpg: C.Cpg | None = None
         self.scope = _Scope()
+        self._bin_prec = dict(BIN_PREC, **DIALECT_BIN_PREC.get(dialect, {}))
+        self._word_binops = DIALECT_WORD_BINOPS.get(dialect, {})
+        self._assign_ops = ASSIGN_OPS | DIALECT_ASSIGN_OPS.get(dialect, set())
+
+    @staticmethod
+    def _op_name(op: str) -> str:
+        if op in C.OP_NAMES:
+            return C.OP_NAMES[op]
+        name = EXTRA_OP_NAMES[op]
+        return name if name is not None else C.OP_NAMES["="]  # := defines
 
     # -- token helpers -------------------------------------------------------
 
@@ -200,6 +276,16 @@ class Parser:
                     k = k2
             while self.peek(k).text in ("*", "&"):
                 k += 1
+            if self.dialect in ("java", "cs"):
+                # array types: `String[] parts = ...`, `int[][] grid`
+                bracketed = False
+                while (
+                    self.peek(k).text == "[" and self.peek(k + 1).text == "]"
+                ):
+                    k += 2
+                    bracketed = True
+                if bracketed and self.peek(k).kind == "id":
+                    return True
             nxt = self.peek(k)
             if nxt.kind == "id" and k > 0:
                 after = self.peek(k + 1)
@@ -371,6 +457,14 @@ class Parser:
                 if t.kind == "eof":
                     break
             arrays += 1
+        if (
+            name is None
+            and arrays
+            and self.dialect in ("java", "cs")
+            and self.peek().kind == "id"
+        ):
+            # java/c# spell the brackets on the TYPE: `int[] x`
+            name = self.eat().text
         full = base + "*" * stars + "[]" * arrays
         return name, full
 
@@ -411,6 +505,15 @@ class Parser:
             if self.peek(k2).text != ")":
                 return False
             nxt = self.peek(k2 + 1)
+            if stars == 0 and self.dialect in ("java", "cs"):
+                # `(Foo)o` object casts are everywhere in java/c#; in C
+                # a star-less id cast stays ambiguous with `(expr)`, so
+                # this path is dialect-gated and requires an unambiguous
+                # expression starter after ')' (no + - * & which would
+                # misread `(a) + b`)
+                return nxt.kind in ("id", "num", "str", "char") or nxt.text in (
+                    "(", "!", "~",
+                )
             return stars > 0 and (
                 nxt.kind in ("id", "num", "str", "char")
                 or nxt.text in ("(", "*", "&", "!", "~", "-", "+", "++", "--")
@@ -437,12 +540,41 @@ class Parser:
     def _parse_assign(self) -> int:
         lhs = self._parse_conditional()
         t = self.peek()
-        if t.kind == "op" and t.text in ASSIGN_OPS:
+        if self.at("=>") and self.dialect in ("cs", "js", "php", "ruby"):
+            # c#/js lambda `x => body` / `(a, b) => { ... }`; php/ruby
+            # use the same token for key=>value pairs
+            self.eat()
+            line = self.cpg.nodes[lhs].line
+            if self.at("{"):
+                depth = 0
+                texts: list[str] = []
+                while not self.at_eof():
+                    tok = self.eat()
+                    texts.append(tok.text)
+                    if tok.text == "{":
+                        depth += 1
+                    elif tok.text == "}":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                body = self._node(
+                    "UNKNOWN", code=" ".join(texts), line=line
+                )
+            else:
+                body = self._parse_assign()
+            name = (
+                "<operator>.lambda"
+                if self.dialect in ("cs", "js")
+                else "<operator>.keyValue"
+            )
+            code = f"{self._code(lhs)} => {self._code(body)}"
+            return self._call(name, code, line, [lhs, body])
+        if t.kind == "op" and t.text in self._assign_ops:
             op = self.eat().text
             rhs = self._parse_assign()
             code = f"{self._code(lhs)} {op} {self._code(rhs)}"
             return self._call(
-                C.OP_NAMES[op], code, self.cpg.nodes[lhs].line, [lhs, rhs]
+                self._op_name(op), code, self.cpg.nodes[lhs].line, [lhs, rhs]
             )
         return lhs
 
@@ -463,14 +595,37 @@ class Parser:
         lhs = self._parse_unary()
         while True:
             t = self.peek()
-            prec = BIN_PREC.get(t.text) if t.kind == "op" else None
+            if t.kind == "op":
+                prec = self._bin_prec.get(t.text)
+            elif t.kind == "id":
+                # identifier-spelled operators (instanceof / is / as ...)
+                prec = self._word_binops.get(t.text)
+            else:
+                prec = None
             if prec is None or prec < min_prec:
                 return lhs
             op = self.eat().text
-            rhs = self._parse_binary(prec + 1)
+            if op in ("instanceof", "is", "as") and self.peek().kind in (
+                "id", "kw"
+            ):
+                # RHS is a TYPE, not an expression: `o instanceof Foo`,
+                # `x as List<T>`, `o is System.IDisposable` — consume a
+                # dot- or ::-qualified, possibly generic type name
+                if self.peek().kind == "id":
+                    ty = self._eat_qualified_name()
+                    while self.at(".") and self.peek(1).kind == "id":
+                        self.eat()
+                        ty += "." + self._eat_qualified_name()
+                else:
+                    ty = self.eat().text
+                rhs = self._node(
+                    "TYPE_REF", code=ty, line=t.line, type_full_name=ty
+                )
+            else:
+                rhs = self._parse_binary(prec + 1)
             code = f"{self._code(lhs)} {op} {self._code(rhs)}"
             lhs = self._call(
-                C.OP_NAMES[op], code, self.cpg.nodes[lhs].line, [lhs, rhs]
+                self._op_name(op), code, self.cpg.nodes[lhs].line, [lhs, rhs]
             )
 
     def _parse_unary(self) -> int:
@@ -513,7 +668,9 @@ class Parser:
             return self._parse_new_delete()
         if self._looks_like_cast():
             lp = self.eat("(")
-            base = self._parse_type()
+            # in_params mode: the type is followed by ')' (a declarator
+            # terminator), which statement mode refuses to consume
+            base = self._parse_type(in_params=True)
             stars = 0
             while self.at("*"):
                 self.eat()
@@ -593,6 +750,54 @@ class Parser:
             code = f"new {ty}[{self._code(size)}]"
         return self._call("<operator>.new", code, t.line, args)
 
+    def _parse_call_arg(self) -> int:
+        """One call argument; c# tolerates `out x` / `ref x` modifiers and
+        `out T x` inline declarations. An `out` argument is a WRITE: it
+        becomes a synthetic `name = *(out)` assignment call (like the
+        foreach desugaring) so reaching-defs sees the def; `ref` stays a
+        plain read (it is read-write, and the read is what dataflow
+        triples key on)."""
+        t = self.peek()
+        if (
+            self.dialect == "cs"
+            and t.kind == "id"
+            and t.text in ("out", "ref", "params")
+            and self.peek(1).kind in ("id", "kw")
+        ):
+            mod = self.eat().text
+            nxt = self.peek()
+            name = None
+            if nxt.kind == "kw" or (
+                nxt.kind == "id" and self.peek(1).kind == "id"
+            ):
+                # inline declaration: `out int n` / `out var n`
+                base = self._parse_type(in_params=True)
+                name, full = self._parse_declarator(base)
+                if name is None:
+                    return self._node("UNKNOWN", code=base, line=nxt.line)
+                self.scope.vars[name] = full
+                self._node(
+                    "LOCAL", name=name, code=f"{full} {name}",
+                    line=nxt.line, type_full_name=full,
+                )
+                ident = self._node(
+                    "IDENTIFIER", name=name, code=name, line=nxt.line,
+                    type_full_name=full,
+                )
+            else:
+                ident = self._parse_assign()
+                node = self.cpg.nodes[ident]
+                if node.label == "IDENTIFIER":
+                    name = node.name
+            if mod == "out" and name is not None:
+                src = self._node("UNKNOWN", code="out", line=nxt.line)
+                return self._call(
+                    C.OP_NAMES["="], f"{name} = *(out)", nxt.line,
+                    [ident, src],
+                )
+            return ident
+        return self._parse_assign()
+
     def _parse_postfix(self) -> int:
         node = self._parse_primary()
         while True:
@@ -602,10 +807,10 @@ class Parser:
                 self.eat("(")
                 args = []
                 if not self.at(")"):
-                    args.append(self._parse_assign())
+                    args.append(self._parse_call_arg())
                     while self.at(","):
                         self.eat()
-                        args.append(self._parse_assign())
+                        args.append(self._parse_call_arg())
                 self.eat(")")
                 callee = self.cpg.nodes[node]
                 fname = callee.name if callee.label == "IDENTIFIER" else self._code(node)
@@ -622,12 +827,21 @@ class Parser:
                 node = self._call(
                     C.INDEX_ACCESS, code, self.cpg.nodes[node].line, [node, idx]
                 )
-            elif self.at(".") or self.at("->"):
+            elif (
+                (self.at(".") and self.dialect != "php")  # php '.' = concat
+                or self.at("->")
+                or self.at("?.")   # c#/js null-conditional access
+                or self.at("?->")  # php nullsafe access
+            ):
                 op = self.eat().text
                 fld = self.eat()
                 fid = self._node("FIELD_IDENTIFIER", name=fld.text, code=fld.text, line=fld.line)
                 code = f"{self._code(node)}{op}{fld.text}"
-                name = C.FIELD_ACCESS if op == "." else C.INDIRECT_FIELD_ACCESS
+                name = (
+                    C.FIELD_ACCESS
+                    if op in (".", "?.")
+                    else C.INDIRECT_FIELD_ACCESS
+                )
                 node = self._call(name, code, self.cpg.nodes[node].line, [node, fid])
             elif t.kind == "op" and t.text in ("++", "--"):
                 self.eat()
@@ -677,6 +891,17 @@ class Parser:
         if t.kind == "kw" and t.text in ("true", "false"):
             self.eat()
             return self._node("LITERAL", code=t.text, line=t.line)
+        if (
+            t.kind == "kw"
+            and self.dialect in ("java", "cs", "js")
+            and self.peek(1).text == "."
+        ):
+            # type keywords as receivers: `int.TryParse`, `long.MaxValue`
+            self.eat()
+            return self._node(
+                "IDENTIFIER", name=t.text, code=t.text, line=t.line,
+                type_full_name="ANY",
+            )
         raise ParseError(f"unexpected token {t!r}")
 
     # -- statements ----------------------------------------------------------
@@ -766,8 +991,18 @@ class Parser:
                 )
                 return _Goto(label, node)
         # C++ statement keywords are plain identifiers to the C lexer
-        if t.kind == "id" and t.text == "try" and self.peek(1).text == "{":
+        if t.kind == "id" and t.text == "try" and (
+            self.peek(1).text == "{"
+            or (self.dialect in ("java", "cs") and self.peek(1).text == "(")
+        ):
             return self._parse_try()
+        # c#/php iteration + resource statements (dialect-gated: in C these
+        # spellings stay expression-statements, e.g. foreach() macros)
+        if t.kind == "id" and self.peek(1).text == "(":
+            if t.text == "foreach" and self.dialect in ("cs", "php"):
+                return self._parse_foreach()
+            if t.text in ("using", "lock", "fixed") and self.dialect == "cs":
+                return self._parse_resource_stmt()
         if t.kind == "id" and t.text == "throw":
             self.eat()
             if not self.at(";"):
@@ -803,9 +1038,27 @@ class Parser:
     def _parse_try(self) -> _Stmt:
         """`try { body } catch (param) { handler }...` — Joern keeps try/
         catch as CONTROL_STRUCTURE nodes; at line level the handlers are
-        alternative paths entered via a `catch` node at the clause line."""
+        alternative paths entered via a `catch` node at the clause line.
+        java/c# try-with-resources declarations become initializer
+        statements ahead of the body; a `finally` block continues after."""
         self.eat()  # 'try'
+        init: _Stmt | None = None
+        if self.at("(") and self.dialect in ("java", "cs"):
+            self.eat("(")
+            inits: list[_Stmt] = []
+            while not self.at(")") and not self.at_eof():
+                if self._at_type_start():
+                    inits.append(self._parse_declaration(expect_semicolon=False))
+                else:
+                    inits.append(_Expr(self.parse_expression()))
+                if self.at(";"):
+                    self.eat()
+            if self.at(")"):
+                self.eat(")")
+            init = _Seq(inits)
         body = self._parse_block()
+        if init is not None:
+            body = _Seq([init, body])
         handlers: list[tuple[int, _Stmt]] = []
         while self.peek().kind == "id" and self.peek().text == "catch":
             kw = self.eat()
@@ -830,7 +1083,15 @@ class Parser:
                 code=f"catch ({param_code})", line=kw.line,
             )
             handlers.append((node, self.parse_statement()))
-        return _Try(body, handlers)
+        tr: _Stmt = _Try(body, handlers)
+        if (
+            self.peek().kind == "id"
+            and self.peek().text == "finally"
+            and self.peek(1).text == "{"
+        ):
+            self.eat()
+            tr = _Seq([tr, self._parse_block()])
+        return tr
 
     def _parse_block(self) -> _Stmt:
         self.eat("{")
@@ -930,6 +1191,91 @@ class Parser:
         body = self.parse_statement()
         self.scope = self.scope.parent
         return _RangeFor(_Expr(call), body)
+
+    def _parse_foreach(self) -> _Stmt:
+        """c#: `foreach (T x in expr) body`; php: `foreach (expr as $v)` /
+        `foreach (expr as $k => $v) body`. Same desugaring as the C++
+        range-for: per-iteration assignment call(s) at the foreach line,
+        body looping back."""
+        start = self.eat()  # 'foreach'
+        self.eat("(")
+        self.scope = _Scope(self.scope)
+
+        def bind(name: str, full: str, rng: int) -> int:
+            self.scope.vars[name] = full
+            self._node(
+                "LOCAL", name=name, code=f"{full} {name}", line=start.line,
+                type_full_name=full,
+            )
+            ident = self._node(
+                "IDENTIFIER", name=name, code=name, line=start.line,
+                type_full_name=full,
+            )
+            return self._call(
+                C.OP_NAMES["="], f"{name} = *({self._code(rng)})",
+                start.line, [ident, rng],
+            )
+
+        if self.dialect == "php":
+            rng = self.parse_expression()
+            if not (self.peek().kind == "id" and self.peek().text == "as"):
+                raise ParseError("foreach without 'as'")
+            self.eat()
+            first = self.eat().text  # $k or $v
+            calls = []
+            if self.at("=>"):
+                self.eat()
+                value = self.eat().text
+                # the key var reads from its own node: one AST parent each
+                key_src = self._node(
+                    "UNKNOWN", code=self._code(rng), line=start.line
+                )
+                calls.append(bind(first, "ANY", key_src))
+                calls.append(bind(value, "ANY", rng))
+            else:
+                calls.append(bind(first, "ANY", rng))
+            top = (
+                calls[0]
+                if len(calls) == 1
+                else self._call(
+                    C.COMMA,
+                    ", ".join(self._code(x) for x in calls),
+                    start.line,
+                    calls,
+                )
+            )
+        else:
+            base = self._parse_type()
+            name, full = self._parse_declarator(base)
+            if name is None or not (
+                self.peek().kind == "id" and self.peek().text == "in"
+            ):
+                raise ParseError("foreach declarator")
+            self.eat()  # 'in'
+            rng = self.parse_expression()
+            top = bind(name, full, rng)
+        self.eat(")")
+        body = self.parse_statement()
+        self.scope = self.scope.parent
+        return _RangeFor(_Expr(top), body)
+
+    def _parse_resource_stmt(self) -> _Stmt:
+        """c# `using (decl|expr) body` / `lock (expr) body` /
+        `fixed (decl) body`: initializer then body (the resource
+        acquisition is the dataflow-relevant part; the release is
+        implicit and has no CFG seam at function granularity)."""
+        self.eat()  # using/lock/fixed
+        self.eat("(")
+        self.scope = _Scope(self.scope)
+        if self._at_type_start():
+            init = self._parse_declaration(expect_semicolon=False)
+        else:
+            init = _Expr(self.parse_expression())
+        if self.at(")"):
+            self.eat(")")
+        body = self.parse_statement()
+        self.scope = self.scope.parent
+        return _Seq([init, body])
 
     def _parse_for(self) -> _Stmt:
         self.eat("for")
@@ -1071,15 +1417,24 @@ class Parser:
         ("public", "private", "protected", "abstract", "synchronized",
          "native", "strictfp", "transient", "final")
     )
+    #: c# adds its own id-spelled modifier set (dialect-gated: in C these
+    #: could be attribute macros, which have their own recovery path)
+    _CS_MODIFIERS = _JAVA_MODIFIERS | frozenset(
+        ("virtual", "override", "sealed", "internal", "readonly",
+         "unsafe", "async", "partial", "new")
+    )
 
     def parse_function(self) -> C.Cpg:
         """Parse `ret_type name(params) { body }` — C, the common C++
         method shapes (template preamble, qualified Foo::bar names,
-        reference parameters), and Java method signatures (modifiers,
-        `<T>` type-parameter lists, `throws` clauses)."""
+        reference parameters), and Java/C# method signatures (modifiers,
+        `<T>` type-parameter lists, `throws`/`where` clauses)."""
+        modifiers = (
+            self._CS_MODIFIERS if self.dialect == "cs" else self._JAVA_MODIFIERS
+        )
         while (
             self.peek().kind == "id"
-            and self.peek().text in self._JAVA_MODIFIERS
+            and self.peek().text in modifiers
             and self.peek(1).kind in ("id", "kw")
         ):
             self.eat()
@@ -1184,6 +1539,12 @@ class Parser:
                 else:
                     while self.peek().kind == "op" and not self.at("("):
                         fname += self.eat().text
+        if (
+            self.dialect in ("java", "cs")
+            and self.at("<")
+            and self._match_angle(0) is not None
+        ):
+            self._eat_angle_args()  # generic method: `T Get<T>(...)`
         self.cpg = C.Cpg(fname)
         ret_type = base + "*" * stars
         method = self.cpg.add_node(
@@ -1583,6 +1944,12 @@ class _CfgBuilder:
             raise TypeError(f"unknown stmt {s!r}")
 
 
-def parse_function(code: str) -> C.Cpg:
-    """Public entry: parse one C function into a CPG-lite."""
-    return Parser(code).parse_function()
+def parse_function(code: str, dialect: str = "c") -> C.Cpg:
+    """Public entry: parse one function into a CPG-lite.
+
+    dialect "c" (default) covers C/C++ — the dataset path, whose behavior
+    is independent of every other dialect. "java"/"cs"/"js"/"go"/"php"
+    adapt the same recursive-descent core for CodeBLEU structural
+    matching of generation-task snippets (eval/codebleu.py; reference
+    grammar list: CodeT5/evaluator/CodeBLEU/parser/DFG.py)."""
+    return Parser(code, dialect=dialect).parse_function()

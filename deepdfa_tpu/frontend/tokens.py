@@ -32,6 +32,45 @@ OPERATORS = [
     "?", ":", ".", ",", ";", "(", ")", "[", "]", "{", "}",
 ]
 
+#: extra multi-char operators per non-C dialect (CodeBLEU structural
+#: matching parses java/c_sharp/js/go/php/ruby snippets through the same
+#: frontend — eval/codebleu.py). Non-C dialects always lex through the
+#: python path, so the native C++ lexer's bit-identical-on-C contract
+#: (tests/test_native.py) is untouched.
+DIALECT_OPERATORS: dict[str, list[str]] = {
+    "c": [],
+    "java": [">>>=", ">>>"],
+    "cs": ["??=", "?.", "??", "=>"],
+    "js": [">>>=", "===", "!==", ">>>", "??=", "**=", "?.", "??", "**", "=>"],
+    "go": [":=", "<-", "&^=", "&^"],
+    "php": ["===", "!==", "<=>", "?->", "??=", "**=", ".=", "??", "**", "=>"],
+    "ruby": ["<=>", "===", "**=", "**", "=~", "!~", "=>", "&."],
+}
+
+#: dialects whose grammar ends statements at line end (Go's automatic
+#: semicolon insertion; Ruby's newline termination). A ';' is inserted
+#: when a line's last token can end an expression — Go spec §Semicolons:
+#: after an identifier, literal, one of break/continue/fallthrough/
+#: return, ++/--, or a closing bracket. Trailing binary operators keep
+#: the statement open, exactly the rule both languages rely on.
+_ASI_DIALECTS = frozenset(("go", "ruby"))
+#: keywords that open a construct and therefore keep the line open
+#: (ruby `loop do` / `x = if cond`; C-keyword collisions like `do`)
+_ASI_CONTINUE_KW = frozenset(
+    ("do", "else", "if", "for", "while", "switch", "case", "default",
+     "goto", "struct", "union", "enum", "sizeof")
+)
+
+
+def _ends_statement(tok: Token) -> bool:
+    if tok.kind in ("num", "str", "char", "id"):
+        return True
+    if tok.kind == "kw":
+        # break/continue/return/`int` (go: `var x int`) end a line;
+        # construct-openers don't
+        return tok.text not in _ASI_CONTINUE_KW
+    return tok.text in (")", "]", "}", "++", "--")
+
 
 @dataclasses.dataclass(frozen=True)
 class Token:
@@ -77,15 +116,23 @@ def strip_comments(code: str) -> str:
     return "".join(out)
 
 
-def tokenize(code: str, backend: str = "auto") -> list[Token]:
-    """Tokenize C source.
+def tokenize(code: str, backend: str = "auto", dialect: str = "c") -> list[Token]:
+    """Tokenize C source (or a related-dialect snippet for CodeBLEU).
 
     backend "auto" routes pure-ASCII input through the native C++ lexer
     when built (bit-identical on ASCII, enforced by tests/test_native.py;
     native Tokens carry col=0). Non-ASCII input always takes the Python
     path, whose unicode identifier handling the native lexer does not
     replicate. "python" forces this implementation.
+
+    dialect selects extra multi-char operators (DIALECT_OPERATORS), php
+    `$identifiers`, js template literals, and go/ruby newline semicolon
+    insertion; any non-"c" dialect always lexes through the python path.
     """
+    if dialect != "c":
+        if dialect not in DIALECT_OPERATORS:
+            raise ValueError(f"unknown dialect {dialect!r}")
+        return _tokenize_python(code, dialect)
     if backend != "python":
         is_ascii = code.isascii()
         if backend == "native" and not is_ascii:
@@ -107,8 +154,14 @@ def tokenize(code: str, backend: str = "auto") -> list[Token]:
     return _tokenize_python(code)
 
 
-def _tokenize_python(code: str) -> list[Token]:
+def _tokenize_python(code: str, dialect: str = "c") -> list[Token]:
     code = strip_comments(code)
+    operators = (
+        sorted(DIALECT_OPERATORS[dialect] + OPERATORS, key=len, reverse=True)
+        if dialect != "c"
+        else OPERATORS
+    )
+    asi = dialect in _ASI_DIALECTS
     toks: list[Token] = []
     line, col = 1, 1
     i, n = 0, len(code)
@@ -119,6 +172,8 @@ def _tokenize_python(code: str) -> list[Token]:
     while i < n:
         c = code[i]
         if c == "\n":
+            if asi and toks and _ends_statement(toks[-1]):
+                emit("op", ";", line, col)
             line += 1
             col = 1
             i += 1
@@ -136,6 +191,34 @@ def _tokenize_python(code: str) -> list[Token]:
                     i += 1
             continue
         start_l, start_c = line, col
+        if (
+            c == "$"
+            and dialect == "php"
+            and i + 1 < n
+            and (code[i + 1].isalpha() or code[i + 1] == "_")
+        ):
+            # php variables: the sigil is part of the identifier
+            j = i + 1
+            while j < n and (code[j].isalnum() or code[j] == "_"):
+                j += 1
+            emit("id", code[i:j], start_l, start_c)
+            col += j - i
+            i = j
+            continue
+        if c == "`" and dialect in ("js", "go"):
+            # js template literal / go raw string: one opaque string token
+            j = i + 1
+            while j < n and code[j] != "`":
+                if dialect == "js" and code[j] == "\\":
+                    j += 1
+                if j < n and code[j] == "\n":
+                    line += 1
+                j += 1
+            j = min(j + 1, n)
+            emit("str", code[i:j], start_l, start_c)
+            col += j - i
+            i = j
+            continue
         if c.isalpha() or c == "_":
             j = i
             while j < n and (code[j].isalnum() or code[j] == "_"):
@@ -181,7 +264,7 @@ def _tokenize_python(code: str) -> list[Token]:
             col += j - i
             i = j
             continue
-        for op in OPERATORS:
+        for op in operators:
             if code.startswith(op, i):
                 emit("op", op, start_l, start_c)
                 i += len(op)
