@@ -19,14 +19,14 @@ Role parity with the reference evaluator
   node labels) found in the candidate AST (syntax_match.py:49-74). The
   reference uses tree-sitter grammars; here the AST comes from this
   repo's hermetic frontend in the matching dialect (LANG_DIALECT:
-  "c"/"cpp" via the C grammar, "java" and "c_sharp" via dialect-gated
-  extensions of it — CONCODE emits java methods, the translate task
-  java<->c_sharp methods, exactly these shapes) or the python stdlib
-  `ast` module (lang "python"). java+c_sharp is the complete RUNNABLE
-  surface of the reference evaluator (its keywords/ dir ships only
-  those two files; any other lang crashes at calc_code_bleu.py:39);
-  the remaining DFG.py languages (js/go/php/ruby) are descoped — no
-  tree-sitter grammars under zero egress (docs/PARITY.md).
+  "c"/"cpp" via the C grammar; "java"/"c_sharp"/"javascript"/"php"/
+  "go" via dialect-gated extensions of it) or the python stdlib `ast`
+  module (lang "python"). java+c_sharp alone already exceeds the
+  RUNNABLE surface of the reference evaluator (its keywords/ dir ships
+  only those two files; any other lang crashes at calc_code_bleu.py:39
+  opening the keywords list); javascript/php/go here go beyond what
+  the reference could execute. Of its DFG.py grammar set only ruby
+  remains descoped (docs/PARITY.md).
 - dataflow match: fraction of the reference's normalized def-use triples
   (var_i, relation, [var_j...]) found in the candidate
   (dataflow_match.py:28-66, variable names alpha-renamed in order of
@@ -99,6 +99,38 @@ KEYWORDS["c_sharp"] = frozenset(
     partial remove select set unmanaged value var when where yield""".split()
 )
 
+# ECMAScript reserved words + strict-mode/contextual additions
+# (standard-defined set; role of a keywords/javascript.txt the reference
+# does not ship — its evaluator cannot actually run js, see _check_lang)
+KEYWORDS["javascript"] = frozenset(
+    """await break case catch class const continue debugger default delete
+    do else enum export extends false finally for function if implements
+    import in instanceof interface let new null of package private
+    protected public return static super switch this throw true try
+    typeof var void while with yield async get set""".split()
+)
+
+# PHP reserved words + compile-time constants (standard-defined set;
+# role of the keywords/php.txt the reference does not ship)
+KEYWORDS["php"] = frozenset(
+    """abstract and array as break callable case catch class clone const
+    continue declare default die do echo else elseif empty enddeclare
+    endfor endforeach endif endswitch endwhile eval exit extends final
+    finally fn for foreach function global goto if implements include
+    include_once instanceof insteadof interface isset list match
+    namespace new or print private protected public readonly require
+    require_once return static switch throw trait try unset use var
+    while xor yield true false null""".split()
+)
+
+# Go spec keyword set + predeclared constants (standard-defined; role of
+# the keywords/go.txt the reference does not ship)
+KEYWORDS["go"] = frozenset(
+    """break case chan const continue default defer else fallthrough for
+    func go goto if import interface map package range return select
+    struct switch type var true false nil iota""".split()
+)
+
 #: CodeBLEU lang -> frontend parser dialect (frontend/parser.py); python
 #: goes through the stdlib-ast backend instead
 LANG_DIALECT: dict[str, str] = {
@@ -106,6 +138,16 @@ LANG_DIALECT: dict[str, str] = {
     "cpp": "c",
     "java": "java",
     "c_sharp": "cs",
+    "javascript": "js",
+    "php": "php",
+    "go": "go",
+}
+
+#: snippet wrapper per dialect for bare statement sequences
+_WRAPPERS = {
+    "js": "function __snippet__() {\n%s\n}",
+    "php": "function __snippet__() {\n%s\n}",
+    "go": "func __snippet__() {\n%s\n}",
 }
 
 
@@ -234,7 +276,7 @@ def _parse(code: str, dialect: str = "c"):
     """
     from deepdfa_tpu.frontend.parser import parse_function
 
-    wrapper = "void __snippet__() {\n" + code + "\n}"
+    wrapper = _WRAPPERS.get(dialect, "void __snippet__() {\n%s\n}") % code
     for candidate in (code, wrapper):
         try:
             return parse_function(candidate, dialect=dialect)
@@ -538,11 +580,10 @@ def _check_lang(lang: str) -> None:
         raise ValueError(
             f"lang={lang!r}: structural matches need a parser; supported "
             f"langs are {sorted(set(LANG_DIALECT) | {'python'})} (hermetic "
-            "frontend dialects + stdlib ast for python). java+c_sharp is "
-            "the reference evaluator's complete runnable surface (its "
-            "keywords/ dir ships only those two lists, "
-            "calc_code_bleu.py:39); remaining tree-sitter DFG languages "
-            "are descoped — see docs/PARITY.md."
+            "frontend dialects + stdlib ast for python) — already beyond "
+            "the reference evaluator's runnable surface (java+c_sharp, "
+            "the only keyword lists it ships, calc_code_bleu.py:39). "
+            "Anything else is descoped — see docs/PARITY.md."
         )
 
 
@@ -558,7 +599,7 @@ def get_codebleu(
     reference variants per hypothesis. Returns all four components plus
     the weighted composite under "codebleu".
     """
-    _check_lang(lang)  # before KEYWORDS[lang] can KeyError on e.g. "go"
+    _check_lang(lang)  # before KEYWORDS[lang] can KeyError on e.g. "swift"
     refs: list[list[str]] = [
         [r] if isinstance(r, str) else list(r) for r in references
     ]

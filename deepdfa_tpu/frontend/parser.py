@@ -48,7 +48,7 @@ DIALECT_BIN_PREC: dict[str, dict[str, int]] = {
     "java": {">>>": 8},
     "cs": {"??": 1},
     "js": {"===": 6, "!==": 6, ">>>": 8, "**": 11, "??": 1},
-    "go": {"&^": 5},
+    "go": {"&^": 5, "<-": 1},
     "php": {"===": 6, "!==": 6, "<=>": 6, ".": 9, "**": 11, "??": 1},
     "ruby": {"===": 6, "<=>": 6, "**": 11, "=~": 6, "!~": 6},
 }
@@ -65,9 +65,10 @@ DIALECT_WORD_BINOPS: dict[str, dict[str, int]] = {
 #: call name must stay <operator>.assignment so the reaching-defs solver
 #: and the abstract-dataflow extractor see the def)
 DIALECT_ASSIGN_OPS: dict[str, set[str]] = {
+    "java": {">>>="},
     "cs": {"??="},
     "js": {"**=", ">>>=", "??="},
-    "go": {":="},
+    "go": {":=", "&^="},
     "php": {".=", "**=", "??="},
     "ruby": {"**="},
 }
@@ -83,6 +84,7 @@ EXTRA_OP_NAMES = {
     ">>>": "<operator>.logicalShiftRight",
     "**": "<operator>.exponentiation",
     "&^": "<operator>.andNot",
+    "<-": "<operator>.channelSend",
     ".": "<operator>.concat",
     "<=>": "<operator>.spaceship",
     "=~": "<operator>.match",
@@ -95,6 +97,7 @@ EXTRA_OP_NAMES = {
     ">>>=": "<operator>.assignmentLogicalShiftRight",
     ".=": "<operator>.assignmentConcat",
     "??=": "<operator>.assignmentNullCoalesce",
+    "&^=": "<operator>.assignmentAndNot",
 }
 
 
@@ -419,7 +422,14 @@ class Parser:
                 # directly followed by a declarator terminator is the
                 # variable of an implicit-int decl (`static x = 1;`)
                 if not in_params and self.peek(1).text in ("=", ";", ",", ")", "["):
-                    break
+                    if not (
+                        self.dialect in ("java", "cs")
+                        and self.peek(1).text == "["
+                        and self.peek(2).text == "]"
+                    ):
+                        # java/c# `String[] x`: the brackets belong to
+                        # the TYPE, so the id IS the base — fall through
+                        break
                 parts.append(self._eat_qualified_name())
                 continue
             break
@@ -630,6 +640,13 @@ class Parser:
 
     def _parse_unary(self) -> int:
         t = self.peek()
+        if self.dialect == "go" and t.kind == "op" and t.text == "<-":
+            self.eat()
+            operand = self._parse_unary()
+            return self._call(
+                "<operator>.channelReceive", f"<-{self._code(operand)}",
+                t.line, [operand],
+            )
         if t.kind == "op" and t.text in ("++", "--"):
             self.eat()
             operand = self._parse_unary()
@@ -854,8 +871,107 @@ class Parser:
 
     _CXX_CASTS = ("static_cast", "dynamic_cast", "reinterpret_cast", "const_cast")
 
+    def _parse_array_literal(self, line: int | None) -> int:
+        """js/php/ruby `[e1, e2, ...]` -> arrayInitializer call."""
+        self.eat("[")
+        args: list[int] = []
+        while not self.at("]") and not self.at_eof():
+            if self.at("..."):
+                self.eat()
+            args.append(self._parse_assign())
+            if self.at(","):
+                self.eat()
+        if self.at("]"):
+            self.eat()
+        return self._call("<operator>.arrayInitializer", "[...]", line, args)
+
+    def _parse_object_literal(self, line: int | None) -> int:
+        """js `{k: v, m, ...}` / ruby `{k => v}` -> keyValue calls under
+        an objectInitializer call."""
+        self.eat("{")
+        pairs: list[int] = []
+        while not self.at("}") and not self.at_eof():
+            if self.at("..."):
+                self.eat()
+                pairs.append(self._parse_assign())
+            else:
+                key = self._parse_assign()
+                if self.at(":"):
+                    self.eat()
+                    val = self._parse_assign()
+                    pairs.append(
+                        self._call(
+                            "<operator>.keyValue",
+                            f"{self._code(key)}: {self._code(val)}",
+                            line, [key, val],
+                        )
+                    )
+                else:
+                    pairs.append(key)  # shorthand property / hash-rocket
+            if self.at(","):
+                self.eat()
+        if self.at("}"):
+            self.eat()
+        return self._call("<operator>.objectInitializer", "{...}", line, pairs)
+
+    #: identifier-spelled unary operators per dialect
+    _WORD_UNARY = {
+        "js": {"typeof": "<operator>.typeOf", "await": "<operator>.await"},
+        "cs": {"await": "<operator>.await"},
+        "ruby": {"not": "<operator>.logicalNot"},
+        "php": {"print": "print", "clone": "<operator>.clone"},
+        "go": {"defer": "defer", "go": "go"},
+    }
+
     def _parse_primary(self) -> int:
         t = self.peek()
+        if self.dialect in ("js", "php", "ruby") and self.at("["):
+            return self._parse_array_literal(t.line)
+        if self.dialect in ("js", "ruby") and self.at("{"):
+            return self._parse_object_literal(t.line)
+        word_unary = self._WORD_UNARY.get(self.dialect, {})
+        if (
+            t.kind == "id"
+            and t.text in word_unary
+            and (
+                self.peek(1).kind in ("id", "num", "str", "char")
+                or self.peek(1).text in ("(", "[", "!", "-", "+", "~")
+            )
+        ):
+            self.eat()
+            operand = self._parse_unary()
+            return self._call(
+                word_unary[t.text], f"{t.text} {self._code(operand)}",
+                t.line, [operand],
+            )
+        if (
+            t.kind == "id"
+            and (
+                (self.dialect in ("js", "php") and t.text == "function")
+                or (self.dialect == "go" and t.text == "func")
+            )
+            and self.peek(1).text in ("(", "*")
+        ):
+            # anonymous function expression: consume balanced params and
+            # body into one opaque node (nested functions are out of the
+            # per-function CPG's scope, like joern's nested-method stubs)
+            self.eat()
+            texts: list[str] = []
+            depth = 0
+            saw_body = False
+            while not self.at_eof():
+                tok = self.eat()
+                texts.append(tok.text)
+                if tok.text in ("(", "{"):
+                    depth += 1
+                    saw_body = saw_body or tok.text == "{"
+                elif tok.text in (")", "}"):
+                    depth -= 1
+                    if depth == 0 and saw_body:
+                        break
+            return self._node(
+                "UNKNOWN", code="function " + " ".join(texts), line=t.line
+            )
         if t.kind == "id":
             if t.text in self._CXX_CASTS and self._match_angle(1) is not None:
                 # static_cast<T>(expr) -> joern-style cast call
@@ -1003,6 +1119,23 @@ class Parser:
                 return self._parse_foreach()
             if t.text in ("using", "lock", "fixed") and self.dialect == "cs":
                 return self._parse_resource_stmt()
+        # php keyword statements taking a bare expression list (reference
+        # tree-sitter: echo_statement / global_declaration / ...)
+        if (
+            self.dialect == "php"
+            and t.kind == "id"
+            and t.text in ("echo", "global", "unset", "require",
+                           "require_once", "include", "include_once")
+            and not self.at(";", 1)
+        ):
+            self.eat()
+            expr = self.parse_expression()
+            node = self._call(
+                t.text, f"{t.text} {self._code(expr)}", t.line, [expr]
+            )
+            if self.at(";"):
+                self.eat()
+            return _Expr(node)
         if t.kind == "id" and t.text == "throw":
             self.eat()
             if not self.at(";"):
@@ -1027,6 +1160,14 @@ class Parser:
             self.eat()
             self.eat(":")
             return _Seq([_Label(t.text, t.line), self.parse_statement()])
+        if self.dialect == "go":
+            if t.kind == "id" and t.text == "var":
+                return self._parse_go_var()
+            ma = self._try_go_multi_assign()
+            if ma is not None:
+                if self.at(";"):
+                    self.eat()
+                return _Expr(ma)
         if self._at_type_start():
             return self._parse_declaration()
         # expression statement
@@ -1034,6 +1175,122 @@ class Parser:
         if self.at(";"):
             self.eat()
         return _Expr(expr)
+
+    def _try_go_multi_assign(self) -> int | None:
+        """go `a, b := f(x)` / `x, y = y, x`: every LHS name is a
+        definition. Returns the desugared call (or None when the
+        lookahead is not a multi-name assignment — single-name `a := 1`
+        already flows through _parse_assign)."""
+        k = 0
+        names: list[str] = []
+        while True:
+            if self.peek(k).kind != "id":
+                return None
+            names.append(self.peek(k).text)
+            nxt = self.peek(k + 1).text
+            if nxt == ",":
+                k += 2
+                continue
+            if nxt in (":=", "=") and len(names) >= 2:
+                op_k = k + 1
+                break
+            return None
+        line = self.peek().line
+        for _ in range(op_k):
+            self.eat()
+        op = self.eat().text
+        rhs = self.parse_expression()
+        calls: list[int] = []
+        for i, nm in enumerate(names):
+            if op == ":=":
+                self.scope.vars[nm] = "ANY"
+                self._node(
+                    "LOCAL", name=nm, code=nm, line=line,
+                    type_full_name="ANY",
+                )
+            ident = self._node(
+                "IDENTIFIER", name=nm, code=nm, line=line,
+                type_full_name=self.scope.lookup(nm) or "ANY",
+            )
+            # one AST parent per node: the first assignment owns the rhs
+            src = (
+                rhs
+                if i == 0
+                else self._node("UNKNOWN", code=self._code(rhs), line=line)
+            )
+            calls.append(
+                self._call(
+                    C.OP_NAMES["="], f"{nm} {op} {self._code(rhs)}",
+                    line, [ident, src],
+                )
+            )
+        if len(calls) == 1:
+            return calls[0]
+        return self._call(
+            C.COMMA, ", ".join(self._code(x) for x in calls), line, calls
+        )
+
+    def _parse_go_var(self) -> _Stmt:
+        """go `var x Type [= expr]` / `var x, y = a, b` — definitions with
+        postfix types."""
+        start = self.eat()  # 'var'
+        names: list[str] = []
+        while self.peek().kind == "id":
+            names.append(self.eat().text)
+            if self.at(","):
+                self.eat()
+            else:
+                break
+        # optional type tokens up to '=' / ';' at depth 0
+        ty_toks: list[str] = []
+        depth = 0
+        while not self.at_eof():
+            tt = self.peek()
+            if tt.text in ("(", "["):
+                depth += 1
+            elif tt.text in (")", "]"):
+                if depth == 0:
+                    break
+                depth -= 1
+            elif depth == 0 and (tt.text in ("=", ";", "{") or tt.kind == "eof"):
+                break
+            ty_toks.append(self.eat().text)
+        ty = self._join_type_tokens(ty_toks) or "ANY"
+        stmts: list[_Stmt] = []
+        rhs = None
+        if self.at("="):
+            self.eat()
+            rhs = self.parse_expression()  # `var x, y = a, b` comma list
+        for i, nm in enumerate(names):
+            self.scope.vars[nm] = ty
+            self._node(
+                "LOCAL", name=nm, code=f"{ty} {nm}", line=start.line,
+                type_full_name=ty,
+            )
+            if rhs is not None:
+                ident = self._node(
+                    "IDENTIFIER", name=nm, code=nm, line=start.line,
+                    type_full_name=ty,
+                )
+                src = (
+                    rhs
+                    if i == 0
+                    else self._node(
+                        "UNKNOWN", code=self._code(rhs), line=start.line
+                    )
+                )
+                stmts.append(
+                    _Expr(
+                        self._call(
+                            C.OP_NAMES["="],
+                            f"{nm} = {self._code(rhs)}",
+                            start.line, [ident, src],
+                        )
+                    )
+                )
+        if self.at(";"):
+            self.eat()
+        return _Seq(stmts)
 
     def _parse_try(self) -> _Stmt:
         """`try { body } catch (param) { handler }...` — Joern keeps try/
@@ -1112,6 +1369,27 @@ class Parser:
 
     def _parse_if(self) -> _Stmt:
         self.eat("if")
+        if self.dialect == "go" and not self.at("("):
+            # `if [init;] cond { }` — paren-less, optional init statement
+            init = self._try_go_multi_assign()
+            first = None if init is not None else self.parse_expression()
+            cond: _Expr
+            if self.at(";"):
+                self.eat()
+                if init is None:
+                    init = first
+                cond = _Expr(self.parse_expression())
+            else:
+                cond = _Expr(first) if first is not None else _Expr(None)
+            then = self.parse_statement()
+            els = None
+            if self.at("else"):
+                self.eat()
+                els = self.parse_statement()
+            node: _Stmt = _If(cond, then, els)
+            if init is not None:
+                node = _Seq([_Expr(init), node])
+            return node
         cond = self._parse_paren_expr()
         then = self.parse_statement()
         els = None
@@ -1163,6 +1441,26 @@ class Parser:
                     return True
             k += 1
 
+    def _bind_loop_var(
+        self, name: str, full: str, rng: int, line: int | None
+    ) -> int:
+        """LOCAL + per-iteration `name = *(range)` assignment call
+        (Joern's iterator desugaring) — the shared definition-site
+        desugar for range-for / foreach / js for-in."""
+        self.scope.vars[name] = full
+        self._node(
+            "LOCAL", name=name, code=f"{full} {name}", line=line,
+            type_full_name=full,
+        )
+        ident = self._node(
+            "IDENTIFIER", name=name, code=name, line=line,
+            type_full_name=full,
+        )
+        return self._call(
+            C.OP_NAMES["="], f"{name} = *({self._code(rng)})", line,
+            [ident, rng],
+        )
+
     def _parse_range_for(self) -> _Stmt:
         """`for (T x : expr) body` — per-iteration assignment at the for
         line (Joern's iterator desugaring yields an `<operator>.
@@ -1172,21 +1470,9 @@ class Parser:
         name, full = self._parse_declarator(base)
         if name is None:
             raise ParseError("range-for declarator")
-        self.scope.vars[name] = full
-        self._node(
-            "LOCAL", name=name, code=f"{full} {name}", line=start.line,
-            type_full_name=full,
-        )
-        ident = self._node(
-            "IDENTIFIER", name=name, code=name, line=start.line,
-            type_full_name=full,
-        )
         self.eat(":")
         rng = self.parse_expression()
-        call = self._call(
-            C.OP_NAMES["="], f"{name} = *({self._code(rng)})", start.line,
-            [ident, rng],
-        )
+        call = self._bind_loop_var(name, full, rng, start.line)
         self.eat(")")
         body = self.parse_statement()
         self.scope = self.scope.parent
@@ -1202,19 +1488,7 @@ class Parser:
         self.scope = _Scope(self.scope)
 
         def bind(name: str, full: str, rng: int) -> int:
-            self.scope.vars[name] = full
-            self._node(
-                "LOCAL", name=name, code=f"{full} {name}", line=start.line,
-                type_full_name=full,
-            )
-            ident = self._node(
-                "IDENTIFIER", name=name, code=name, line=start.line,
-                type_full_name=full,
-            )
-            return self._call(
-                C.OP_NAMES["="], f"{name} = *({self._code(rng)})",
-                start.line, [ident, rng],
-            )
+            return self._bind_loop_var(name, full, rng, start.line)
 
         if self.dialect == "php":
             rng = self.parse_expression()
@@ -1277,10 +1551,146 @@ class Parser:
         self.scope = self.scope.parent
         return _Seq([init, body])
 
+    def _at_js_for_in(self) -> bool:
+        """After `for (` — js `for (x of xs)` / `for (var k in obj)`:
+        an `of`/`in` identifier at depth 0 before the first ';'."""
+        depth = 0
+        k = 0
+        while True:
+            t = self.peek(k)
+            if t.kind == "eof" or t.text in (";", "{"):
+                return False
+            if t.text in ("(", "["):
+                depth += 1
+            elif t.text in (")", "]"):
+                if depth == 0:
+                    return False
+                depth -= 1
+            elif t.kind == "id" and t.text in ("of", "in") and depth == 0:
+                return True
+            k += 1
+
+    def _parse_js_for_in(self) -> _Stmt:
+        """`for ([var|let|const] x of|in expr) body` — same desugaring as
+        the range-for: per-iteration assignment at the for line."""
+        start = self.peek()
+        if self.peek().kind in ("id", "kw") and self.peek().text in (
+            "var", "let", "const",
+        ):
+            self.eat()
+        if self.peek().kind != "id":
+            raise ParseError("for-in declarator")
+        name = self.eat().text
+        self.eat()  # 'of' | 'in'
+        rng = self.parse_expression()
+        call = self._bind_loop_var(name, "ANY", rng, start.line)
+        self.eat(")")
+        body = self.parse_statement()
+        self.scope = self.scope.parent
+        return _RangeFor(_Expr(call), body)
+
+    def _parse_go_for(self) -> _Stmt:
+        """Paren-less go for: `for {}` / `for cond {}` /
+        `for init; cond; post {}` / `for [i[, v]] := range xs {}`."""
+        self.scope = _Scope(self.scope)
+        start = self.peek()
+        if self.at("{"):
+            body = self.parse_statement()
+            self.scope = self.scope.parent
+            return _For(None, None, None, body)
+        # range-scan: `range` id at depth 0 before '{'
+        has_range = False
+        has_semi = False
+        depth = 0
+        k = 0
+        while True:
+            t = self.peek(k)
+            if t.kind == "eof" or (t.text == "{" and depth == 0):
+                break
+            if t.text in ("(", "["):
+                depth += 1
+            elif t.text in (")", "]"):
+                depth -= 1
+            elif depth == 0 and t.kind == "id" and t.text == "range":
+                has_range = True
+            elif depth == 0 and t.text == ";":
+                has_semi = True
+            k += 1
+        if has_range:
+            names: list[str] = []
+            while self.peek().kind == "id" and self.peek().text != "range":
+                names.append(self.eat().text)
+                if self.at(","):
+                    self.eat()
+            if self.at(":=") or self.at("="):
+                self.eat()
+            if self.peek().text == "range":
+                self.eat()
+            rng = self.parse_expression()
+            calls: list[int] = []
+            for i, nm in enumerate(names):
+                if nm == "_":
+                    continue
+                src = (
+                    rng
+                    if not calls
+                    else self._node(
+                        "UNKNOWN", code=self._code(rng), line=start.line
+                    )
+                )
+                calls.append(
+                    self._bind_loop_var(nm, "ANY", src, start.line)
+                )
+            if calls:
+                top = (
+                    calls[0]
+                    if len(calls) == 1
+                    else self._call(
+                        C.COMMA,
+                        ", ".join(self._code(x) for x in calls),
+                        start.line, calls,
+                    )
+                )
+                expr = _Expr(top)
+            else:  # `for range xs` — the range expr still evaluates
+                expr = _Expr(rng)
+            body = self.parse_statement()
+            self.scope = self.scope.parent
+            return _RangeFor(expr, body)
+        if has_semi:
+            init: _Stmt | None = None
+            if not self.at(";"):
+                ma = self._try_go_multi_assign()
+                init = _Expr(ma if ma is not None else self.parse_expression())
+            if self.at(";"):
+                self.eat()
+            cond = None
+            if not self.at(";"):
+                cond = _Expr(self.parse_expression())
+            if self.at(";"):
+                self.eat()
+            update = None
+            if not self.at("{"):
+                ma = self._try_go_multi_assign()
+                update = _Expr(
+                    ma if ma is not None else self.parse_expression()
+                )
+            body = self.parse_statement()
+            self.scope = self.scope.parent
+            return _For(init, cond, update, body)
+        cond = _Expr(self.parse_expression())
+        body = self.parse_statement()
+        self.scope = self.scope.parent
+        return _While(cond, body)
+
     def _parse_for(self) -> _Stmt:
         self.eat("for")
+        if self.dialect == "go" and not self.at("("):
+            return self._parse_go_for()
         self.eat("(")
         self.scope = _Scope(self.scope)
+        if self.dialect == "js" and self._at_js_for_in():
+            return self._parse_js_for_in()
         if self._at_range_for():
             return self._parse_range_for()
         init: _Stmt | None = None
@@ -1306,7 +1716,20 @@ class Parser:
 
     def _parse_switch(self) -> _Stmt:
         self.eat("switch")
-        cond = self._parse_paren_expr()
+        if self.dialect == "go" and not self.at("("):
+            # `switch [init;] [tag] { ... }` — any clause optional
+            cond = _Expr(None)
+            if not self.at("{"):
+                ma = self._try_go_multi_assign()
+                first = ma if ma is not None else self.parse_expression()
+                if self.at(";"):
+                    self.eat()
+                    if not self.at("{"):
+                        cond = _Expr(self.parse_expression())
+                else:
+                    cond = _Expr(first)
+        else:
+            cond = self._parse_paren_expr()
         self.eat("{")
         cases: list[tuple[bool, str, int | None, _Stmt]] = []
         has_default = False
@@ -1371,7 +1794,11 @@ class Parser:
                 # assignment whose RHS is <operator>.arrayInitializer, so
                 # the declaration still yields a definition node
                 if self.at("{"):
-                    rhs = self._parse_brace_init(start.line)
+                    rhs = (
+                        self._parse_object_literal(start.line)
+                        if self.dialect in ("js", "ruby")
+                        else self._parse_brace_init(start.line)
+                    )
                 else:
                     rhs = self._parse_assign()
                 code = f"{name} = {self._code(rhs)}"
@@ -1429,6 +1856,22 @@ class Parser:
         method shapes (template preamble, qualified Foo::bar names,
         reference parameters), and Java/C# method signatures (modifiers,
         `<T>` type-parameter lists, `throws`/`where` clauses)."""
+        if self.dialect == "go" and self.peek().text == "func":
+            return self._parse_go_function()
+        if self.dialect in ("js", "php") and (
+            self.peek().text in ("function", "async")
+            or (self.peek().text in ("public", "private", "protected",
+                                     "static", "final", "abstract")
+                and self.dialect == "php")
+        ):
+            # php methods carry modifiers before `function`
+            while (
+                self.dialect == "php"
+                and self.peek().kind in ("id", "kw")
+                and self.peek().text != "function"
+            ):
+                self.eat()
+            return self._parse_script_function()
         modifiers = (
             self._CS_MODIFIERS if self.dialect == "cs" else self._JAVA_MODIFIERS
         )
@@ -1467,6 +1910,12 @@ class Parser:
             if self.at("*"):
                 stars += 1
             self.eat()
+        if self.dialect in ("java", "cs"):
+            # array return types: `public int[] toArray()`
+            while self.at("[") and self.peek(1).text == "]":
+                self.eat()
+                self.eat()
+                base += "[]"
         if self.at("(") and base not in ("", "ANY"):
             # constructor: `Foo::Foo(...)` — the "return type" IS the name
             fname = base
@@ -1646,8 +2095,16 @@ class Parser:
         while not self.at("{") and not self.at(";") and not self.at_eof():
             self.eat()
         body = self._parse_block() if self.at("{") else _Seq([])
+        return self._finish_function(sig_start.line, ret_type, body)
+
+    def _finish_function(
+        self, sig_line: int | None, ret_type: str, body: _Stmt
+    ) -> C.Cpg:
+        """Shared tail: METHOD_RETURN node, CFG wiring, and adoption of
+        parentless expression roots under the METHOD node."""
+        method = self.cpg.method_id
         mret = self.cpg.add_node(
-            "METHOD_RETURN", name="RET", code="RET", line=sig_start.line,
+            "METHOD_RETURN", name="RET", code="RET", line=sig_line,
             type_full_name=ret_type,
         )
         self.cpg.method_return_id = mret
@@ -1659,6 +2116,159 @@ class Parser:
             if n.id != method and n.id not in have_parent:
                 self.cpg.add_edge(method, n.id, C.AST)
         return self.cpg
+
+    def _parse_script_function(self) -> C.Cpg:
+        """js `function name(a, b = 1, ...rest) { body }` (optionally
+        `async`) and php `function name($a, &$b) { body }` — untyped
+        parameter lists, then the same statement grammar."""
+        if self.peek().text == "async":
+            self.eat()
+        if self.peek().text != "function":
+            # e.g. `async (a) => a + 1`, or php modifiers without a
+            # method: raise so _parse's wrapper fallback gets its turn
+            raise ParseError(f"expected 'function', got {self.peek()!r}")
+        self.eat()  # 'function'
+        if self.at("&"):  # php return-by-reference
+            self.eat()
+        sig = self.peek()
+        fname = self.eat().text if self.peek().kind == "id" else "__anon__"
+        self.cpg = C.Cpg(fname)
+        method = self.cpg.add_node(
+            "METHOD", name=fname, code=fname, line=sig.line,
+            type_full_name="ANY",
+        )
+        self.cpg.method_id = method
+        self.scope = _Scope()
+        order = 1
+        if self.at("("):
+            self.eat("(")
+            while not self.at(")") and not self.at_eof():
+                if self.at("..."):
+                    self.eat()
+                if self.at("&"):  # php by-reference parameter
+                    self.eat()
+                if self.peek().kind == "id":
+                    p = self.eat()
+                    self.scope.vars[p.text] = "ANY"
+                    pid = self.cpg.add_node(
+                        "METHOD_PARAMETER_IN", name=p.text, code=p.text,
+                        line=p.line, order=order, type_full_name="ANY",
+                    )
+                    self.cpg.add_edge(method, pid, C.AST)
+                    order += 1
+                    if self.at("="):  # default value
+                        self.eat()
+                        self._parse_assign()
+                elif not self.at(","):
+                    self.eat()  # skip destructuring braces etc.
+                if self.at(","):
+                    self.eat()
+            if self.at(")"):
+                self.eat(")")
+        # php closures: `use ($x, &$y)`; js: nothing between ) and {
+        while not self.at("{") and not self.at(";") and not self.at_eof():
+            self.eat()
+        body = self._parse_block() if self.at("{") else _Seq([])
+        return self._finish_function(sig.line, "ANY", body)
+
+    def _parse_go_param_group(self, method: int, order: int) -> int:
+        """One go parameter group `a, b Type` / `xs []int` /
+        `f func(int) int` — names first, then a postfix type shared by
+        the whole group. Returns the next parameter order."""
+        names: list[Token] = []
+        # `a, b int`: ids followed by ',' are names; a final id followed
+        # by anything but ','/')' heads its group's type — except that a
+        # LONE id before ')' is taken as an (untyped) name, the lenient
+        # reading that favors dataflow over go's type-only params
+        while self.peek().kind == "id" and self.peek(1).text == ",":
+            names.append(self.eat())
+            self.eat(",")
+        if self.peek().kind == "id":
+            names.append(self.eat())
+        # whatever remains before ',' or ')' at depth 0 is the type
+        ty_toks: list[str] = []
+        depth = 0
+        while not self.at_eof():
+            t = self.peek()
+            if t.text in ("(", "[", "{"):
+                depth += 1
+            elif t.text in (")", "]", "}"):
+                if depth == 0:
+                    break
+                depth -= 1
+            elif t.text == "," and depth == 0:
+                break
+            ty_toks.append(self.eat().text)
+        ty = self._join_type_tokens(ty_toks) or "ANY"
+        for p in names:
+            self.scope.vars[p.text] = ty
+            pid = self.cpg.add_node(
+                "METHOD_PARAMETER_IN", name=p.text, code=f"{ty} {p.text}",
+                line=p.line, order=order, type_full_name=ty,
+            )
+            self.cpg.add_edge(method, pid, C.AST)
+            order += 1
+        if self.at(","):
+            self.eat()
+        return order
+
+    def _parse_go_function(self) -> C.Cpg:
+        """go `func [(recv T)] name(params) [results] { body }` —
+        postfix types; parameter groups share one type (`a, b int`)."""
+        self.eat()  # 'func'
+        sig = self.peek()
+        recv: list[tuple[str, str]] = []
+        if self.at("("):
+            # method receiver: `(s *Server)`
+            self.eat("(")
+            if self.peek().kind == "id":
+                rname = self.eat().text
+                ty_toks = []
+                while not self.at(")") and not self.at_eof():
+                    ty_toks.append(self.eat().text)
+                recv.append((rname, self._join_type_tokens(ty_toks) or "ANY"))
+            else:
+                while not self.at(")") and not self.at_eof():
+                    self.eat()
+            if self.at(")"):
+                self.eat(")")
+        fname = self.eat().text if self.peek().kind == "id" else "__anon__"
+        self.cpg = C.Cpg(fname)
+        method = self.cpg.add_node(
+            "METHOD", name=fname, code=fname, line=sig.line,
+            type_full_name="ANY",
+        )
+        self.cpg.method_id = method
+        self.scope = _Scope()
+        order = 1
+        for rname, rty in recv:
+            self.scope.vars[rname] = rty
+            pid = self.cpg.add_node(
+                "METHOD_PARAMETER_IN", name=rname, code=f"{rty} {rname}",
+                line=sig.line, order=order, type_full_name=rty,
+            )
+            self.cpg.add_edge(method, pid, C.AST)
+            order += 1
+        if self.at("("):
+            self.eat("(")
+            while not self.at(")") and not self.at_eof():
+                order = self._parse_go_param_group(method, order)
+            if self.at(")"):
+                self.eat(")")
+        # result types: single, or parenthesized tuple — skip to '{'
+        depth = 0
+        while not self.at_eof():
+            if self.at("{") and depth == 0:
+                break
+            if self.at(";") and depth == 0:
+                break
+            t = self.eat()
+            if t.text in ("(", "["):
+                depth += 1
+            elif t.text in (")", "]"):
+                depth -= 1
+        body = self._parse_block() if self.at("{") else _Seq([])
+        return self._finish_function(sig.line, "ANY", body)
 
 
 # ---------------------------------------------------------------------------
