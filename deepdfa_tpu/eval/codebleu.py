@@ -20,13 +20,13 @@ Role parity with the reference evaluator
   reference uses tree-sitter grammars; here the AST comes from this
   repo's hermetic frontend in the matching dialect (LANG_DIALECT:
   "c"/"cpp" via the C grammar; "java"/"c_sharp"/"javascript"/"php"/
-  "go" via dialect-gated extensions of it) or the python stdlib `ast`
-  module (lang "python"). java+c_sharp alone already exceeds the
+  "go"/"ruby" via dialect-gated extensions of it) or the python stdlib
+  `ast` module (lang "python"). java+c_sharp alone already matches the
   RUNNABLE surface of the reference evaluator (its keywords/ dir ships
   only those two files; any other lang crashes at calc_code_bleu.py:39
-  opening the keywords list); javascript/php/go here go beyond what
-  the reference could execute. Of its DFG.py grammar set only ruby
-  remains descoped (docs/PARITY.md).
+  opening the keywords list); javascript/php/go/ruby here go beyond
+  what the reference could execute — every language in its DFG.py
+  grammar set is covered (docs/PARITY.md).
 - dataflow match: fraction of the reference's normalized def-use triples
   (var_i, relation, [var_j...]) found in the candidate
   (dataflow_match.py:28-66, variable names alpha-renamed in order of
@@ -130,6 +130,14 @@ KEYWORDS["go"] = frozenset(
     func go goto if import interface map package range return select
     struct switch type var true false nil iota""".split()
 )
+# Ruby keyword set (standard-defined; role of the keywords/ruby.txt the
+# reference does not ship)
+KEYWORDS["ruby"] = frozenset(
+    """BEGIN END alias and begin break case class def defined? do else
+    elsif end ensure false for if in module next nil not or redo rescue
+    retry return self super then true undef unless until when while
+    yield""".split()
+)
 
 #: CodeBLEU lang -> frontend parser dialect (frontend/parser.py); python
 #: goes through the stdlib-ast backend instead
@@ -141,6 +149,7 @@ LANG_DIALECT: dict[str, str] = {
     "javascript": "js",
     "php": "php",
     "go": "go",
+    "ruby": "ruby",
 }
 
 #: snippet wrapper per dialect for bare statement sequences
@@ -148,6 +157,7 @@ _WRAPPERS = {
     "js": "function __snippet__() {\n%s\n}",
     "php": "function __snippet__() {\n%s\n}",
     "go": "func __snippet__() {\n%s\n}",
+    "ruby": "def __snippet__\n%s\nend",
 }
 
 

@@ -50,7 +50,8 @@ DIALECT_BIN_PREC: dict[str, dict[str, int]] = {
     "js": {"===": 6, "!==": 6, ">>>": 8, "**": 11, "??": 1},
     "go": {"&^": 5, "<-": 1},
     "php": {"===": 6, "!==": 6, "<=>": 6, ".": 9, "**": 11, "??": 1},
-    "ruby": {"===": 6, "<=>": 6, "**": 11, "=~": 6, "!~": 6},
+    "ruby": {"===": 6, "<=>": 6, "**": 11, "=~": 6, "!~": 6, "..": 6,
+             "...": 6},
 }
 
 #: identifier-spelled binary operators (`o instanceof Foo`, `o is Foo`)
@@ -89,6 +90,8 @@ EXTRA_OP_NAMES = {
     "<=>": "<operator>.spaceship",
     "=~": "<operator>.match",
     "!~": "<operator>.notMatch",
+    "..": "<operator>.range",
+    "...": "<operator>.rangeExclusive",
     "and": "<operator>.logicalAnd",
     "or": "<operator>.logicalOr",
     "xor": "<operator>.logicalXor",
@@ -849,6 +852,7 @@ class Parser:
                 or self.at("->")
                 or self.at("?.")   # c#/js null-conditional access
                 or self.at("?->")  # php nullsafe access
+                or self.at("&.")   # ruby safe navigation
             ):
                 op = self.eat().text
                 fld = self.eat()
@@ -856,7 +860,7 @@ class Parser:
                 code = f"{self._code(node)}{op}{fld.text}"
                 name = (
                     C.FIELD_ACCESS
-                    if op in (".", "?.")
+                    if op in (".", "?.", "&.")
                     else C.INDIRECT_FIELD_ACCESS
                 )
                 node = self._call(name, code, self.cpg.nodes[node].line, [node, fid])
@@ -1008,6 +1012,15 @@ class Parser:
             self.eat()
             return self._node("LITERAL", code=t.text, line=t.line)
         if (
+            self.dialect == "ruby"
+            and self.at(":")
+            and self.peek(1).kind in ("id", "str")
+        ):
+            # ruby symbol literal `:name` / `:"quoted"`
+            self.eat()
+            sym = self.eat()
+            return self._node("LITERAL", code=f":{sym.text}", line=t.line)
+        if (
             t.kind == "kw"
             and self.dialect in ("java", "cs", "js")
             and self.peek(1).text == "."
@@ -1055,6 +1068,8 @@ class Parser:
         return stmt
 
     def _parse_statement_inner(self) -> _Stmt:
+        if self.dialect == "ruby":
+            return self._parse_ruby_statement()
         t = self.peek()
         if self.at(";"):
             self.eat()
@@ -1858,6 +1873,12 @@ class Parser:
         `<T>` type-parameter lists, `throws`/`where` clauses)."""
         if self.dialect == "go" and self.peek().text == "func":
             return self._parse_go_function()
+        if self.dialect == "ruby":
+            if self.peek().text != "def":
+                # bare statements: raise so the snippet wrapper (`def
+                # __snippet__ ... end`) gets its turn in eval/codebleu
+                raise ParseError(f"expected 'def', got {self.peek()!r}")
+            return self._parse_ruby_function()
         if self.dialect in ("js", "php") and (
             self.peek().text in ("function", "async")
             or (self.peek().text in ("public", "private", "protected",
@@ -2170,6 +2191,413 @@ class Parser:
             self.eat()
         body = self._parse_block() if self.at("{") else _Seq([])
         return self._finish_function(sig.line, "ANY", body)
+
+    # -- ruby ---------------------------------------------------------------
+    #
+    # ruby is end-delimited, newline-terminated (the lexer's ASI inserts
+    # ';'), and expression-oriented; the statement forms below cover the
+    # method shapes of generation corpora (reference grammar:
+    # CodeT5/evaluator/CodeBLEU/parser/DFG.py DFG_ruby). Everything is
+    # gated on dialect == "ruby".
+
+    def _parse_ruby_function(self) -> C.Cpg:
+        """`def [self.]name[(params)] ... end` (operator names and ?/!
+        suffixes included — the lexer merges adjacent ?/! into the id)."""
+        self.eat()  # 'def'
+        sig = self.peek()
+        if self.peek().kind == "id":
+            fname = self.eat().text
+            while self.at(".") and self.peek(1).kind == "id":
+                self.eat()
+                fname = self.eat().text  # `self.name`: the method name
+            if self.at("=") and self.peek(1).text == "(":
+                fname += self.eat().text  # setter: `def name=(value)`
+        elif self.peek().kind == "op":
+            fname = self.eat().text  # `def ==`, `def +`, `def []`...
+            if fname == "[":
+                if self.at("]"):
+                    fname += self.eat().text
+                if self.at("="):
+                    fname += self.eat().text  # `def []=(k, v)`
+        else:
+            fname = "__anon__"
+        self.cpg = C.Cpg(fname)
+        method = self.cpg.add_node(
+            "METHOD", name=fname, code=fname, line=sig.line,
+            type_full_name="ANY",
+        )
+        self.cpg.method_id = method
+        self.scope = _Scope()
+        order = 1
+
+        def add_param(tok: Token) -> None:
+            nonlocal order
+            self.scope.vars[tok.text] = "ANY"
+            pid = self.cpg.add_node(
+                "METHOD_PARAMETER_IN", name=tok.text, code=tok.text,
+                line=tok.line, order=order, type_full_name="ANY",
+            )
+            self.cpg.add_edge(method, pid, C.AST)
+            order += 1
+
+        if self.at("("):
+            self.eat("(")
+            while not self.at(")") and not self.at_eof():
+                if self.at("*") or self.at("&") or self.at("**"):
+                    self.eat()
+                if self.peek().kind == "id":
+                    p = self.eat()
+                    add_param(p)
+                    if self.at(":"):  # keyword arg `name: default`
+                        self.eat()
+                        if not self.at(",") and not self.at(")"):
+                            self._parse_assign()
+                    elif self.at("="):
+                        self.eat()
+                        self._parse_assign()
+                elif not self.at(","):
+                    self.eat()
+                if self.at(","):
+                    self.eat()
+            if self.at(")"):
+                self.eat(")")
+        elif self.peek().kind == "id" and not self.at(";", 0):
+            # paren-less params: `def add a, b` (same line only)
+            while self.peek().kind == "id":
+                add_param(self.eat())
+                if self.at(","):
+                    self.eat()
+                else:
+                    break
+        if self.at(";"):
+            self.eat()
+        body = self._parse_ruby_body(frozenset({"end"}))
+        if self.peek().text == "end":
+            self.eat()
+        return self._finish_function(sig.line, "ANY", body)
+
+    def _parse_ruby_body(self, stop: frozenset[str]) -> _Stmt:
+        """Statements until a terminator word/token (end/else/when/...).
+        Terminators are matched on token text — they are plain ids to
+        this lexer."""
+        out: list[_Stmt] = []
+        while not self.at_eof() and self.peek().text not in stop:
+            out.append(self.parse_statement())
+        return _Seq(out)
+
+    def _negate(self, cond_top: int, line: int | None) -> int:
+        """unless/until are negated if/while (the shared desugar)."""
+        return self._call(
+            C.UNARY_OP_NAMES["!"], f"!({self._code(cond_top)})", line,
+            [cond_top],
+        )
+
+    def _parse_ruby_if(self) -> _Stmt:
+        """`if|unless|elsif cond [then] ... [elsif ...|else ...] end` —
+        exactly one `end` closes the whole chain, eaten by the branch
+        that reaches it."""
+        kw = self.eat()
+        cond_top = self.parse_expression()
+        if kw.text == "unless":
+            cond_top = self._negate(cond_top, kw.line)
+        if self.peek().text == "then":
+            self.eat()
+        if self.at(";"):
+            self.eat()
+        then = self._parse_ruby_body(frozenset({"elsif", "else", "end"}))
+        if self.peek().text == "elsif":
+            els: _Stmt | None = self._parse_ruby_if()  # eats the shared end
+            return _If(_Expr(cond_top), then, els)
+        els = None
+        if self.peek().text == "else":
+            self.eat()
+            els = self._parse_ruby_body(frozenset({"end"}))
+        if self.peek().text == "end":
+            self.eat()
+        return _If(_Expr(cond_top), then, els)
+
+    def _parse_ruby_case(self) -> _Stmt:
+        kw = self.eat()  # 'case'
+        cond = _Expr(None)
+        if not self.at(";") and self.peek().text != "when":
+            cond = _Expr(self.parse_expression())
+        if self.at(";"):
+            self.eat()
+        cases: list[tuple[bool, str, int | None, _Stmt]] = []
+        has_default = False
+        while self.peek().text == "when":
+            wkw = self.eat()
+            label_toks: list[str] = []
+            while (
+                not self.at(";")
+                and self.peek().text not in ("then",)
+                and not self.at_eof()
+            ):
+                label_toks.append(self.eat().text)
+            if self.peek().text == "then" or self.at(";"):
+                self.eat()
+            body = self._parse_ruby_body(frozenset({"when", "else", "end"}))
+            # ruby when-clauses do not fall through: an implicit break
+            # jumps each body to the exit, unlike C cases
+            cases.append(
+                (False, "case " + " ".join(label_toks), wkw.line,
+                 _Seq([body, _Break(wkw.line)]))
+            )
+        if self.peek().text == "else":
+            ekw = self.eat()
+            body = self._parse_ruby_body(frozenset({"end"}))
+            cases.append(
+                (True, "default", ekw.line, _Seq([body, _Break(ekw.line)]))
+            )
+            has_default = True
+        if self.peek().text == "end":
+            self.eat()
+        return _Switch(cond, cases, has_default)
+
+    def _parse_ruby_begin(self) -> _Stmt:
+        """`begin ... rescue [E [=> e]] ... ensure ... end`."""
+        self.eat()  # 'begin'
+        body = self._parse_ruby_body(frozenset({"rescue", "ensure", "end"}))
+        handlers: list[tuple[int, _Stmt]] = []
+        while self.peek().text == "rescue":
+            kw = self.eat()
+            param_toks: list[str] = []
+            while not self.at(";") and self.peek().text not in (
+                "then",
+            ) and not self.at_eof():
+                tok = self.eat()
+                param_toks.append(tok.text)
+                if tok.text == "=>" and self.peek().kind == "id":
+                    evar = self.peek()
+                    self.scope.vars[evar.text] = "ANY"
+                    self._node(
+                        "LOCAL", name=evar.text, code=evar.text,
+                        line=evar.line, type_full_name="ANY",
+                    )
+            if self.peek().text == "then" or self.at(";"):
+                self.eat()
+            node = self._node(
+                "CONTROL_STRUCTURE", name="catch",
+                code=f"rescue {' '.join(param_toks)}".strip(), line=kw.line,
+            )
+            handlers.append(
+                (node,
+                 self._parse_ruby_body(
+                     frozenset({"rescue", "ensure", "else", "end"})
+                 ))
+            )
+        if self.peek().text == "else":
+            self.eat()
+            extra = self._parse_ruby_body(frozenset({"ensure", "end"}))
+            body = _Seq([body, extra])
+        tr: _Stmt = _Try(body, handlers)
+        if self.peek().text == "ensure":
+            self.eat()
+            fin = self._parse_ruby_body(frozenset({"end"}))
+            tr = _Seq([tr, fin])
+        if self.peek().text == "end":
+            self.eat()
+        return tr
+
+    def _parse_ruby_block_tail(self, recv: int) -> _Stmt:
+        """`expr do |params| ... end` / `expr { |params| ... }` — the
+        iterator-block reading: params are per-iteration definitions from
+        the receiver, body loops (the dataflow shape DFG_ruby extracts
+        from block parameters)."""
+        opener = self.eat()  # 'do' or '{'
+        closing = "end" if opener.text == "do" else "}"
+        names: list[Token] = []
+        if self.at("|"):
+            self.eat()
+            while self.peek().kind == "id":
+                names.append(self.eat())
+                if self.at(","):
+                    self.eat()
+                else:
+                    break
+            if self.at("|"):
+                self.eat()
+        calls: list[int] = []
+        for i, nm in enumerate(names):
+            src = (
+                recv
+                if i == 0
+                else self._node(
+                    "UNKNOWN", code=self._code(recv), line=opener.line
+                )
+            )
+            calls.append(
+                self._bind_loop_var(nm.text, "ANY", src, nm.line)
+            )
+        body = self._parse_ruby_body(frozenset({closing}))
+        if self.peek().text == closing:
+            self.eat()
+        if not calls:
+            return _RangeFor(_Expr(recv), body)
+        top = (
+            calls[0]
+            if len(calls) == 1
+            else self._call(
+                C.COMMA, ", ".join(self._code(x) for x in calls),
+                opener.line, calls,
+            )
+        )
+        return _RangeFor(_Expr(top), body)
+
+    #: tokens that can start a paren-less ruby command argument
+    _RUBY_ARG_START = frozenset(("id", "num", "str", "char"))
+
+    def _parse_ruby_statement(self) -> _Stmt:
+        t = self.peek()
+        if self.at(";"):
+            self.eat()
+            return _Expr(None)
+        if t.kind == "kw":
+            if t.text == "if":
+                return self._ruby_with_modifiers(self._parse_ruby_if())
+            if t.text == "while":
+                self.eat()
+                cond = _Expr(self.parse_expression())
+                if self.peek().text == "do" or self.at(";"):
+                    self.eat()
+                body = self._parse_ruby_body(frozenset({"end"}))
+                if self.peek().text == "end":
+                    self.eat()
+                return _While(cond, body)
+            if t.text == "for":
+                self.eat()
+                if self.peek().kind != "id":
+                    raise ParseError("ruby for declarator")
+                name = self.eat().text
+                if self.peek().text == "in":
+                    self.eat()
+                rng = self.parse_expression()
+                call = self._bind_loop_var(name, "ANY", rng, t.line)
+                if self.peek().text == "do" or self.at(";"):
+                    self.eat()
+                body = self._parse_ruby_body(frozenset({"end"}))
+                if self.peek().text == "end":
+                    self.eat()
+                return _RangeFor(_Expr(call), body)
+            if t.text == "case":
+                return self._parse_ruby_case()
+            if t.text == "return":
+                self.eat()
+                expr = None
+                if not self.at(";") and not self.at_eof() and (
+                    self.peek().text not in ("end", "if", "unless")
+                ):
+                    expr = _Expr(self.parse_expression())
+                node = self._node(
+                    "RETURN", name="return",
+                    code="return"
+                    + (
+                        f" {self._code(expr.top)}"
+                        if expr and expr.top is not None
+                        else ""
+                    ),
+                    line=t.line,
+                )
+                if expr and expr.top is not None:
+                    self.cpg.add_edge(node, expr.top, C.AST)
+                    self.cpg.add_edge(node, expr.top, C.ARGUMENT)
+                    self.cpg.nodes[expr.top].order = 1
+                return self._ruby_with_modifiers(_Return(expr, node))
+            if t.text == "break":
+                self.eat()
+                return self._ruby_with_modifiers(_Break(t.line))
+        if t.kind == "id":
+            if t.text in ("unless", "until"):
+                if t.text == "unless":
+                    return self._ruby_with_modifiers(self._parse_ruby_if())
+                self.eat()  # until = while-not
+                cond_top = self.parse_expression()
+                cond_top = self._negate(cond_top, t.line)
+                if self.peek().text == "do" or self.at(";"):
+                    self.eat()
+                body = self._parse_ruby_body(frozenset({"end"}))
+                if self.peek().text == "end":
+                    self.eat()
+                return _While(_Expr(cond_top), body)
+            if t.text == "next":
+                self.eat()
+                return self._ruby_with_modifiers(_Continue(t.line))
+            if t.text == "begin":
+                return self._parse_ruby_begin()
+            if t.text == "raise":
+                self.eat()
+                expr = None
+                if not self.at(";") and not self.at_eof():
+                    expr = self.parse_expression()
+                node = self._node(
+                    "CONTROL_STRUCTURE", name="throw",
+                    code="raise"
+                    + (f" {self._code(expr)}" if expr is not None else ""),
+                    line=t.line,
+                )
+                if expr is not None:
+                    self.cpg.add_edge(node, expr, C.AST)
+                    self.cpg.add_edge(node, expr, C.ARGUMENT)
+                    self.cpg.nodes[expr].order = 1
+                return self._ruby_with_modifiers(_Throw(node))
+            if (
+                self.peek(1).kind in self._RUBY_ARG_START
+                or (
+                    self.peek(1).text == ":"
+                    and self.peek(2).kind in ("id", "str")
+                )
+            ) and self.peek(1).text not in (
+                # statement operators/guards, not command arguments:
+                # `cleanup unless failed`, `save and notify`
+                "do", "unless", "until", "and", "or", "not", "if",
+                "while", "then", "rescue", "in", "end",
+            ):
+                # paren-less command call: `puts x`, `attr_reader :name`
+                name = self.eat().text
+                args = self.parse_expression()
+                call = self._call(
+                    name, f"{name} {self._code(args)}", t.line, [args]
+                )
+                return self._ruby_with_modifiers(_Expr(call))
+        expr = self.parse_expression()
+        return self._ruby_with_modifiers(_Expr(expr))
+
+    def _ruby_with_modifiers(self, stmt: _Stmt) -> _Stmt:
+        """Trailing modifiers and iterator blocks: `x += 1 if cond`,
+        `return nil unless ok`, `xs.each do |x| ... end`."""
+        while True:
+            t = self.peek()
+            if (
+                isinstance(stmt, _Expr)
+                and stmt.top is not None
+                and (
+                    (t.kind in ("id", "kw") and t.text == "do")
+                    or (t.kind == "op" and t.text == "{")
+                )
+            ):
+                stmt = self._parse_ruby_block_tail(stmt.top)
+                continue
+            if t.kind == "kw" and t.text == "if" or (
+                t.kind == "id" and t.text == "unless"
+            ):
+                self.eat()
+                cond_top = self.parse_expression()
+                if t.text == "unless":
+                    cond_top = self._negate(cond_top, t.line)
+                stmt = _If(_Expr(cond_top), stmt, None)
+                continue
+            if t.kind == "kw" and t.text == "while" or (
+                t.kind == "id" and t.text == "until"
+            ):
+                self.eat()
+                cond_top = self.parse_expression()
+                if t.text == "until":
+                    cond_top = self._negate(cond_top, t.line)
+                stmt = _While(_Expr(cond_top), stmt)
+                continue
+            if self.at(";"):
+                self.eat()
+            return stmt
 
     def _parse_go_param_group(self, method: int, order: int) -> int:
         """One go parameter group `a, b Type` / `xs []int` /

@@ -44,7 +44,7 @@ DIALECT_OPERATORS: dict[str, list[str]] = {
     "js": [">>>=", "===", "!==", ">>>", "??=", "**=", "?.", "??", "**", "=>"],
     "go": [":=", "<-", "&^=", "&^"],
     "php": ["===", "!==", "<=>", "?->", "??=", "**=", ".=", "??", "**", "=>"],
-    "ruby": ["<=>", "===", "**=", "**", "=~", "!~", "=>", "&."],
+    "ruby": ["<=>", "===", "**=", "**", "=~", "!~", "=>", "&.", ".."],
 }
 
 #: dialects whose grammar ends statements at line end (Go's automatic
@@ -192,13 +192,28 @@ def _tokenize_python(code: str, dialect: str = "c") -> list[Token]:
             continue
         start_l, start_c = line, col
         if (
-            c == "$"
-            and dialect == "php"
-            and i + 1 < n
-            and (code[i + 1].isalpha() or code[i + 1] == "_")
+            (
+                c == "$"
+                and dialect in ("php", "ruby")
+                and i + 1 < n
+                and (code[i + 1].isalpha() or code[i + 1] == "_")
+            )
+            or (
+                c == "@"
+                and dialect == "ruby"
+                and i + 1 < n
+                and (
+                    code[i + 1].isalpha()
+                    or code[i + 1] == "_"
+                    or code[i + 1] == "@"
+                )
+            )
         ):
-            # php variables: the sigil is part of the identifier
+            # php/ruby variables: the sigil ($ / @ / @@) is part of the
+            # identifier
             j = i + 1
+            if c == "@" and code[j] == "@":
+                j += 1
             while j < n and (code[j].isalnum() or code[j] == "_"):
                 j += 1
             emit("id", code[i:j], start_l, start_c)
@@ -235,7 +250,16 @@ def _tokenize_python(code: str, dialect: str = "c") -> list[Token]:
                 while j < n and (code[j].isdigit() or code[j] in "abcdefABCDEF"):
                     j += 1
             else:
-                while j < n and (code[j].isdigit() or code[j] == "."):
+                while j < n and (
+                    code[j].isdigit()
+                    or (
+                        code[j] == "."
+                        # ruby ranges: `1..9` is num op num, never `1..`
+                        and not (
+                            dialect == "ruby" and code[j : j + 2] == ".."
+                        )
+                    )
+                ):
                     j += 1
                 if j < n and code[j] in "eE":  # exponent
                     k = j + 1
@@ -266,7 +290,21 @@ def _tokenize_python(code: str, dialect: str = "c") -> list[Token]:
             continue
         for op in operators:
             if code.startswith(op, i):
-                emit("op", op, start_l, start_c)
+                if (
+                    dialect == "ruby"
+                    and op in ("?", "!")
+                    and toks
+                    and toks[-1].kind == "id"
+                    and toks[-1].line == start_l
+                    and toks[-1].col + len(toks[-1].text) == start_c
+                ):
+                    # ruby method-name suffixes: `empty?` / `save!` are
+                    # one identifier (a spaced `x ? y : z` stays ternary)
+                    toks[-1] = Token(
+                        "id", toks[-1].text + op, start_l, toks[-1].col
+                    )
+                else:
+                    emit("op", op, start_l, start_c)
                 i += len(op)
                 col += len(op)
                 break

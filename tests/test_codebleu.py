@@ -660,3 +660,122 @@ def test_go_dataflow_short_decl_is_def():
     rd.solve()
     defined = {d.var for defs in rd.gen_set.values() for d in defs}
     assert {"total", "x"} <= defined
+
+
+# --- ruby (the last DFG.py grammar: end-delimited, newline-terminated;
+# reference evaluator cannot run it — no keywords/ruby.txt)
+
+
+RUBY_REF = """def sum_positive(xs)
+  total = 0
+  xs.each do |x|
+    if x > 0
+      total += x
+    end
+  end
+  total
+end"""
+
+
+def test_ruby_identical_is_one_and_ranks():
+    from deepdfa_tpu.eval.codebleu import corpus_syntax_match, get_codebleu
+
+    assert corpus_syntax_match([[RUBY_REF]], [RUBY_REF], lang="ruby") == 1.0
+    assert get_codebleu([RUBY_REF], [RUBY_REF], lang="ruby")["codebleu"] == 1.0
+    far = corpus_syntax_match(
+        [[RUBY_REF]], ["def log(m)\n  puts m\nend"], lang="ruby"
+    )
+    assert 0.0 <= far < 1.0
+
+
+def test_ruby_shapes_parse_clean():
+    """Ruby method shapes: iterator blocks (do/end and braces), trailing
+    if/unless modifiers, case/when, begin/rescue/ensure, until, symbols,
+    @ivars, string interpolation, ?/! method names, ranges."""
+    from deepdfa_tpu.frontend.parser import parse_function
+
+    shapes = [
+        "def f(xs)\n  xs.map { |i| i * 2 }\nend",
+        "def f(v)\n  return nil if v.nil?\n  v\nend",
+        "def f(n)\n  case n\n  when 0 then :zero\n  when 1..9\n"
+        "    :small\n  else\n    :big\n  end\nend",
+        "def f\n  begin\n    risky!\n  rescue StandardError => e\n"
+        "    raise e\n  ensure\n    cleanup\n  end\nend",
+        "def f(items, limit)\n  for v in items\n    next if v > limit\n"
+        "    use v\n  end\nend",
+        "def f(s)\n  @msg = \"got #{s}\"\n  puts @msg unless s.empty?\n"
+        "  until done?\n    wait 1\n  end\nend",
+    ]
+    for code in shapes:
+        cpg = parse_function(code, dialect="ruby")
+        bad = [
+            n.code for n in cpg.nodes
+            if n.label == "UNKNOWN" and n.code == "<parse error>"
+        ]
+        assert not bad, (code, bad)
+
+
+def test_ruby_dataflow_block_param_is_def():
+    from deepdfa_tpu.eval.codebleu import corpus_dataflow_match
+    from deepdfa_tpu.frontend.parser import parse_function
+    from deepdfa_tpu.frontend.reaching import ReachingDefinitions
+
+    cpg = parse_function(RUBY_REF, dialect="ruby")
+    rd = ReachingDefinitions(cpg)
+    rd.solve()
+    defined = {d.var for defs in rd.gen_set.values() for d in defs}
+    assert {"total", "x"} <= defined
+    assert corpus_dataflow_match([[RUBY_REF]], [RUBY_REF], lang="ruby") == 1.0
+    renamed = RUBY_REF.replace("total", "acc").replace("xs", "arr")
+    assert corpus_dataflow_match([[RUBY_REF]], [renamed], lang="ruby") >= 0.9
+
+
+def test_every_reference_dfg_language_is_supported():
+    """parser/DFG.py ships extractors for python, java, ruby, go, php,
+    javascript, c_sharp — all must be scoreable here (the reference
+    itself could only run java + c_sharp, its only keyword files)."""
+    from deepdfa_tpu.eval.codebleu import LANG_DIALECT
+
+    reference_dfg_langs = {
+        "python", "java", "ruby", "go", "php", "javascript", "c_sharp",
+    }
+    assert reference_dfg_langs <= set(LANG_DIALECT) | {"python"}
+
+
+def test_ruby_review_regressions():
+    """Review-pass regressions: guard keywords never swallowed as
+    command args, numeric ranges lex as num op num, setter/operator
+    method names keep their parameters."""
+    from deepdfa_tpu.frontend.parser import parse_function
+    from deepdfa_tpu.frontend.reaching import ReachingDefinitions
+
+    shapes = [
+        "def f\n  cleanup unless failed\nend",
+        "def f\n  save and notify\nend",
+        "def f(n)\n  for i in 1..n\n    use i\n  end\nend",
+        "def name=(value)\n  @name = value\nend",
+        "def []=(k, v)\n  @h[k] = v\nend",
+    ]
+    for code in shapes:
+        cpg = parse_function(code, dialect="ruby")
+        bad = [
+            n.code for n in cpg.nodes
+            if n.label == "UNKNOWN" and n.code == "<parse error>"
+        ]
+        assert not bad, (code, bad)
+
+    cpg = parse_function(
+        "def f\n  cleanup unless failed\nend", dialect="ruby"
+    )
+    assert any(
+        n.code.startswith("!(") for n in cpg.nodes if n.label == "CALL"
+    )  # the unless guard survives as a negated condition
+    cpg = parse_function("def name=(value)\n  @name = value\nend",
+                         dialect="ruby")
+    assert [n.name for n in cpg.nodes
+            if n.label == "METHOD_PARAMETER_IN"] == ["value"]
+    cpg = parse_function("def f(n)\n  for i in 1..n\n    use i\n  end\nend",
+                         dialect="ruby")
+    rd = ReachingDefinitions(cpg)
+    rd.solve()
+    assert "i" in {d.var for defs in rd.gen_set.values() for d in defs}
