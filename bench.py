@@ -64,11 +64,19 @@ def _mfu_fields(flops_per_example: float, graphs_per_sec: float,
                 platform: str, dtype: str) -> dict:
     model_fps = flops_per_example * graphs_per_sec
     peak = _PEAK_FLOPS.get((platform, dtype))
-    return {
+    out = {
         "flops_per_example": round(flops_per_example, 1),
         "model_flops_per_sec": round(model_fps, 1),
         "mfu": round(model_fps / peak, 6) if peak else None,
     }
+    if platform == "tpu":
+        # spec-peak MFU misleads on a shared/tunneled chip: record the
+        # MEASURED dense-matmul ceiling next to it (eval/profiling.py;
+        # never raises — probe failures land in matmul_ceiling_error)
+        from deepdfa_tpu.eval.profiling import ceiling_fields
+
+        out.update(ceiling_fields(model_fps))
+    return out
 
 
 def _build_workload(n_examples: int):
@@ -153,8 +161,9 @@ def run_measurement(platform: str) -> dict:
         if float(np.abs(p32 - p16).max()) < 0.02:
             params, dtype = params_bf16, "bfloat16"
 
-    # warmup / compile
-    jax.block_until_ready(forward(params, batches[0]))
+    # warmup / compile — fetch-bounded so no warmup execution can bleed
+    # into the first timed window (same tunnel caveat as the windows)
+    np.asarray(forward(params, batches[0]))
 
     # steady-state: each rep is one timed pass over the whole batch
     # stream. The headline is the MEDIAN window — comparable to the
@@ -169,7 +178,11 @@ def run_measurement(platform: str) -> dict:
         out = None
         for b in batches:
             out = forward(params, b)
-        jax.block_until_ready(out)
+        # host FETCH, not block_until_ready: through the remote-TPU
+        # tunnel a buffer can be reported ready before the execution
+        # completes, silently inflating rates (observed as MFU > 1.0);
+        # a device->host copy of the result cannot lie
+        np.asarray(out)
         rates.append(n_per_pass / (time.perf_counter() - t0))
 
     value = float(np.median(rates))
@@ -246,8 +259,8 @@ def run_train_measurement(platform: str) -> dict:
     trainer = GraphTrainer(model, cfg)
     state = trainer.init_state(batches[0])
 
-    state, _ = trainer.train_step(state, batches[0])  # compile + warmup
-    jax.block_until_ready(state.params)
+    state, warm_loss = trainer.train_step(state, batches[0])  # compile+warmup
+    float(warm_loss)  # fetch-bounded (see inference warmup note)
 
     n_per_pass = sum(int(np.asarray(b.graph_mask).sum()) for b in batches)
     rates = []
@@ -256,7 +269,9 @@ def run_train_measurement(platform: str) -> dict:
         loss = None
         for b in batches:
             state, loss = trainer.train_step(state, b)
-        jax.block_until_ready(loss)
+        # host fetch (see inference note): the scalar's arrival on host
+        # transitively proves every chained train_step completed
+        float(loss)
         rates.append(n_per_pass / (time.perf_counter() - t0))
 
     value = float(np.median(rates))

@@ -133,3 +133,72 @@ def xprof_trace(log_dir: str | Path):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def measure_matmul_ceiling(
+    n: int = 4096, chain: int = 8, reps: int = 3, dtype=None
+) -> dict:
+    """Measured dense-matmul FLOP/s on the CURRENT device — the achievable
+    ceiling MFU should be read against.
+
+    Public chip specs (v5e: 197 TFLOP/s bf16) assume exclusive, unthrottled
+    access; a shared or tunneled chip delivers a fraction of that, AND the
+    fraction moves minute to minute (observed 1.6-7.5 TFLOP/s in adjacent
+    windows through the axon tunnel on 2026-07-31 — the chip is
+    time-shared). A chained [n,n]@[n,n] product with one host fetch at the
+    end is the densest work XLA can schedule, so its rate samples the
+    currently-achievable ceiling; treat it as a CONTEMPORANEOUS POINT
+    SAMPLE, not a bound — a workload timed in a faster window than the
+    probe can legitimately exceed it.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dtype = dtype or jnp.bfloat16
+    a = jnp.ones((n, n), dtype)
+    b = jnp.ones((n, n), dtype)
+    inv = 1.0 / n
+
+    @jax.jit
+    def chained(a, b):
+        x = a
+        for _ in range(chain):
+            x = (x @ b) * inv
+        return x
+
+    np.asarray(chained(a, b))  # compile + warmup
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(chained(a, b))
+        dt = time.perf_counter() - t0
+        best = max(best, chain * 2 * n**3 / dt)
+    return {
+        "matmul_tflops_measured": round(best / 1e12, 2),
+        "matmul_probe": f"{chain}x({n}x{n}@{n}x{n}) {jnp.dtype(dtype).name}",
+    }
+
+
+def ceiling_fields(model_flops_per_sec: float) -> dict:
+    """measure_matmul_ceiling + the ratio/caveat fields bench emitters
+    attach next to spec-peak MFU (one implementation for bench.py and
+    scripts/bench_combined.py; never raises — a probe failure is
+    isolated to its own error key)."""
+    try:
+        out = measure_matmul_ceiling()
+        meas = out["matmul_tflops_measured"] * 1e12
+        if meas > 0:
+            ratio = round(model_flops_per_sec / meas, 6)
+            out["mfu_vs_measured_ceiling"] = ratio
+            if ratio > 1.0:
+                out["ceiling_note"] = (
+                    "ratio>1: the probe sampled a slower tunnel window "
+                    "than the workload (chip is time-shared); treat the "
+                    "ceiling as indicative, not a bound"
+                )
+        return out
+    except Exception as e:
+        return {"matmul_ceiling_error": f"{type(e).__name__}: {e}"[:200]}
