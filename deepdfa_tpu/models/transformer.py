@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepdfa_tpu.parallel.megatron import region_end, region_start
+from deepdfa_tpu.nn.flash_attention import flash_attention
 from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
 
 
@@ -57,6 +58,11 @@ class TransformerConfig:
     remat: bool = True  # rematerialize layer activations in backward
     # (HBM is the bottleneck: without remat, a 12-layer/512-token/bs-32
     # backward stacks ~18GB of attention+FFN temps and exceeds one v5e)
+    # local-attention lowering: "auto" picks the fused Pallas flash
+    # kernel (nn/flash_attention.py) on TPU when the shape qualifies,
+    # else the XLA einsum path; "xla"/"flash" force one. Only the
+    # sp_axis=None branch is affected (ring/ulysses own the sp seam).
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -120,6 +126,40 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
     }
     pooler = {"w": norm(next(k), (D, D)), "b": zeros((D,))}
     return {"embeddings": emb, "layers": layers, "pooler": pooler}
+
+
+def _flash_interpret() -> bool:
+    """Test hook: DEEPDFA_TPU_FLASH_INTERPRET=1 runs the flash kernel in
+    Pallas TPU-interpret mode so the integration path is exercisable on
+    CPU (where `attn_impl="flash"` would otherwise fail to lower)."""
+    import os
+
+    return os.environ.get("DEEPDFA_TPU_FLASH_INTERPRET", "") == "1"
+
+
+def _flash_shape_ok(T: int, head_dim: int) -> bool:
+    # kernel blocks are min(512, T): any T <= 512 divides; larger T must
+    # tile evenly. head_dim is capped so q/k/v blocks stay VMEM-sized.
+    return (T <= 512 or T % 512 == 0) and head_dim <= 128
+
+
+def _resolve_attn_impl(cfg: "TransformerConfig", T: int, head_dim: int) -> str:
+    impl = getattr(cfg, "attn_impl", "auto")
+    if impl == "xla":
+        return "xla"
+    if impl == "flash":
+        if not _flash_shape_ok(T, head_dim):
+            raise ValueError(
+                f"attn_impl='flash' needs T<=512 or T%512==0 and "
+                f"head_dim<=128 (got T={T}, head_dim={head_dim})")
+        return "flash"
+    if impl != "auto":
+        raise ValueError(f"unknown attn_impl {impl!r}")
+    if not _flash_shape_ok(T, head_dim):
+        return "xla"
+    if _flash_interpret():
+        return "flash"
+    return "flash" if jax.default_backend() == "tpu" else "xla"
 
 
 def _layer_norm(x, scale, bias, eps):
@@ -210,6 +250,19 @@ def encoder_layer(
         ctx = ring_attention(
             q, k, v, attn_mask, axis_name=sp_axis,
             dropout_rate=cfg.dropout_rate, dropout_key=k3,
+        )
+    elif _resolve_attn_impl(cfg, q.shape[2], cfg.head_dim) == "flash":
+        rate = cfg.dropout_rate if k3 is not None else 0.0
+        seed = None
+        if rate > 0.0:
+            # int32 PRNG seed for the in-kernel dropout mask (unique per
+            # layer: k3 comes from the per-layer key split in encode())
+            seed = jax.lax.bitcast_convert_type(
+                jax.random.bits(k3, (1,), jnp.uint32), jnp.int32
+            )
+        ctx = flash_attention(
+            q, k, v, attn_mask, dropout_rate=rate, seed=seed,
+            interpret="tpu" if _flash_interpret() else False,
         )
     else:
         ctx = full_attention(

@@ -1,0 +1,436 @@
+"""Fused (flash) attention Pallas kernel for the TPU combined-model path.
+
+Why this exists: the reference fine-tunes its transformer encoders with
+attention-probs dropout (HF ``attention_probs_dropout_prob=0.1`` — the
+LineVul recipe, ``LineVul/linevul/linevul_main.py:150-162``). On the XLA
+path that training step materializes, per layer, a ``[B, H, T, T]`` score
+tensor AND an equally large Bernoulli dropout mask in HBM (at the
+flagship shape — B=64, H=12, T=512, bf16 — ~400 MB of probs plus the
+threefry bits per layer, several times per step with rematerialization).
+HBM bandwidth is the combined step's bottleneck, not MXU FLOPs
+(SURVEY.md §3.3: RoBERTa self-attention dominates the step).
+
+This kernel computes attention blockwise in VMEM with the streaming
+log-sum-exp softmax, so the ``T x T`` probabilities never leave the chip,
+and generates the dropout mask *inside* the kernel with the TPU PRNG
+(`pltpu.prng_seed` / `prng_random_bits`), so the mask is never
+materialized either. The backward pass (custom VJP, two more kernels)
+recomputes probabilities from the saved log-sum-exp and *regenerates the
+identical dropout bits* by reseeding per ``(batch, head, q-block,
+k-block)`` — the standard FlashAttention recipe, with dropout handled as
+in the repo's streaming formulation (`parallel/ring_attention.py
+_block_attn`: dropout scales the numerator only; the softmax denominator
+is the undropped sum, matching ``dropout(softmax(s)) @ v``).
+
+Semantics vs the XLA path (`parallel/ring_attention.py:full_attention`):
+identical math, different dropout RNG *stream* (TPU PRNG here, threefry
+there) — same Bernoulli(1-rate) distribution, which is what training
+semantics require (the reference's torch RNG differs from both anyway).
+
+Dropout convention: ``keep = bits < keep_prob * 2**32`` on uint32 bits.
+Chosen deliberately: Pallas interpret mode implements `prng_random_bits`
+as zeros, so on CPU the PRNG path degrades to keep-everything (a no-op
+dropout) instead of drop-everything. Exact dropout math is still fully
+testable on CPU by injecting explicit bits via ``debug_bits`` (the
+kernels then read bits from HBM instead of the PRNG — used by
+tests/test_flash_attention.py to pin fwd AND custom-vjp math against a
+pure-jnp oracle given the same mask).
+
+Kernel decision history: the GGNN scatter Pallas kernel measurably LOST
+to XLA's sorted-segment path and was deleted (docs/DESIGN.md §3). This
+kernel targets the opposite regime — not a gather/scatter but a fused
+softmax chain whose XLA lowering is HBM-traffic-bound — and its win is
+verified the same way, by A/B measurement on the real chip
+(scripts/bench_combined.py records both paths; docs/bench_history.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -1e30  # additive mask value; exp(_NEG_BIG - max) == 0 in f32
+
+
+@dataclasses.dataclass(frozen=True)
+class _Params:
+    """Static kernel parameters (hashable: the custom_vjp nondiff arg)."""
+
+    scale: float
+    dropout_rate: float
+    block_q: int
+    block_k: int
+    n_q: int
+    n_k: int
+    use_prng: bool  # False: bits come from the debug_bits input
+    interpret: str | bool  # False | "legacy" | "tpu"
+
+    @property
+    def interpret_arg(self):
+        # "tpu" = the TPU-semantics interpreter (implements prng_* —
+        # as zeros — so dropout degrades to keep-all on CPU); "legacy" =
+        # the generic interpreter (no prng lowering; fine for the
+        # debug_bits and no-dropout paths, and faster).
+        if self.interpret == "tpu":
+            return pltpu.InterpretParams()
+        return bool(self.interpret)
+
+    @property
+    def keep_prob(self) -> float:
+        return 1.0 - self.dropout_rate
+
+    @property
+    def keep_threshold(self) -> int:
+        # uint32 threshold: keep = bits < threshold, P(keep) = keep_prob
+        return min(int(round(self.keep_prob * 2.0**32)), 2**32 - 1)
+
+
+def _keep_mask(p: _Params, bits):
+    return pltpu.bitcast(bits, jnp.uint32) < jnp.uint32(p.keep_threshold)
+
+
+def _bits_for_block(p: _Params, seed_ref, bits_ref, b, h, qi, kj, qsl, ksl):
+    """uint32 bits for the (qi, kj) block — PRNG or the debug input.
+
+    The seed is (user seed, flat (b, h, qi, kj) index): any kernel that
+    reseeds with the same coordinates regenerates the identical mask,
+    which is what makes the fwd and the two bwd kernels agree without
+    storing it. Mosaic accepts at most 2 seed values, hence the flat
+    block coordinate rather than one value per axis.
+    """
+    if p.use_prng:
+        num_h = pl.num_programs(1)
+        flat = ((b * num_h + h) * p.n_q + qi) * p.n_k + kj
+        pltpu.prng_seed(seed_ref[0], flat)
+        return pltpu.prng_random_bits((p.block_q, p.block_k))
+    return bits_ref[0, 0, qsl, ksl]
+
+
+def _scores(q, k_blk, kv_ok, scale):
+    """Masked scaled scores for one block pair, f32. q:[bq,D] k:[bk,D]."""
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    return jnp.where(kv_ok, s, _NEG_BIG)
+
+
+def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
+                o_ref, lse_ref):
+    b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    q = q_ref[0, 0]  # [bq, D], input dtype
+    qsl = pl.ds(0, p.block_q)  # debug_bits rows: block-relative (see spec)
+
+    m_run = jnp.full((p.block_q, 1), _NEG_BIG, jnp.float32)
+    l_run = jnp.zeros((p.block_q, 1), jnp.float32)
+    acc = jnp.zeros((p.block_q, q.shape[-1]), jnp.float32)
+
+    for kj in range(p.n_k):
+        ksl = pl.ds(kj * p.block_k, p.block_k)
+        k_blk = k_ref[0, 0, ksl]  # [bk, D]
+        v_blk = v_ref[0, 0, ksl]
+        kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]  # [1, bk]
+        s = _scores(q, k_blk, kv_ok, p.scale)
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+        pr = jnp.where(kv_ok, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_run - m_new)
+        l_run = l_run * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+        pv = pr
+        if p.dropout_rate > 0.0:
+            keep = _keep_mask(
+                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                   qsl, ksl))
+            pv = jnp.where(keep, pr * (1.0 / p.keep_prob), 0.0)
+        acc = acc * alpha + jax.lax.dot_general(
+            pv.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_run = m_new
+
+    l_safe = jnp.maximum(l_run, jnp.finfo(jnp.float32).tiny)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = m_run + jnp.log(l_safe)  # [bq, 1]
+
+
+def _dq_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
+               delta_ref, do_ref, bits_ref, dq_ref):
+    b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # [bq, 1]
+    delta = delta_ref[0, 0]
+    qsl = pl.ds(0, p.block_q)
+    dq = jnp.zeros((p.block_q, q.shape[-1]), jnp.float32)
+
+    for kj in range(p.n_k):
+        ksl = pl.ds(kj * p.block_k, p.block_k)
+        k_blk = k_ref[0, 0, ksl]
+        v_blk = v_ref[0, 0, ksl]
+        kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]
+        s = _scores(q, k_blk, kv_ok, p.scale)
+        pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # true softmax probs
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if p.dropout_rate > 0.0:
+            keep = _keep_mask(
+                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                   qsl, ksl))
+            dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
+        ds = pr * (dp - delta)  # softmax vjp; delta = rowsum(do * o)
+        dq = dq + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    dq_ref[0, 0] = (dq * p.scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
+                delta_ref, do_ref, bits_ref, dk_ref, dv_ref):
+    b, h, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    k_blk = k_ref[0, 0]  # [bk, D] (this program's k/v block)
+    v_blk = v_ref[0, 0]
+    kv_ok = (m_ref[0, 0] != 0)[None, :]  # [1, bk]
+    ksl = pl.ds(0, p.block_k)  # debug_bits cols: block-relative (see spec)
+    dk = jnp.zeros((p.block_k, k_blk.shape[-1]), jnp.float32)
+    dv = jnp.zeros((p.block_k, v_blk.shape[-1]), jnp.float32)
+
+    for qi in range(p.n_q):
+        qsl = pl.ds(qi * p.block_q, p.block_q)
+        q = q_ref[0, 0, qsl]  # [bq, D]
+        do = do_ref[0, 0, qsl]
+        lse = lse_ref[0, 0, qsl]  # [bq, 1]
+        delta = delta_ref[0, 0, qsl]
+        s = _scores(q, k_blk, kv_ok, p.scale)
+        pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        pv = pr
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if p.dropout_rate > 0.0:
+            keep = _keep_mask(
+                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                   qsl, ksl))
+            inv = 1.0 / p.keep_prob
+            pv = jnp.where(keep, pr * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        dv = dv + jax.lax.dot_general(
+            pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        ds = pr * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    dk_ref[0, 0] = (dk * p.scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _bits_specs(p: _Params, T: int, for_dkv: bool):
+    """BlockSpec for the debug_bits input (dummy [1,1,1,1] when PRNG).
+
+    fwd/dq read a [bq, T] row-block (rows block-relative, cols global);
+    dkv reads a [T, bk] col-block (rows global, cols block-relative).
+    """
+    if p.use_prng:
+        return pl.BlockSpec((1, 1, 1, 1), lambda b, h, i: (0, 0, 0, 0),
+                            memory_space=pl.ANY)
+    if for_dkv:
+        return pl.BlockSpec((1, 1, T, p.block_k),
+                            lambda b, h, j: (b, h, 0, j),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, 1, p.block_q, T),
+                        lambda b, h, i: (b, h, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _dummy_bits():
+    return jnp.zeros((1, 1, 1, 1), jnp.uint32)
+
+
+def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits):
+    B, H, T, D = q.shape
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, p),
+        grid=(B, H, p.n_q),
+        in_specs=[
+            _smem_spec(),
+            pl.BlockSpec((1, 1, p.block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, T), lambda b, h, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            _bits_specs(p, T, for_dkv=False),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, p.block_q, D), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, p.block_q, 1), lambda b, h, i: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        interpret=p.interpret_arg,
+    )(seed, q, k, v, mask_i32, bits)
+    return out, lse
+
+
+def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, lse, delta, do):
+    B, H, T, D = q.shape
+    common = [
+        _smem_spec(),
+        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),  # q (full; dq re-blocks)
+        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),  # k
+        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),  # v
+        pl.BlockSpec((1, 1, T), lambda b, h, i: (b, 0, 0),
+                     memory_space=pltpu.VMEM),  # mask
+        pl.BlockSpec((1, 1, T, 1), lambda b, h, i: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),  # lse
+        pl.BlockSpec((1, 1, T, 1), lambda b, h, i: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),  # delta
+        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+                     memory_space=pltpu.VMEM),  # do
+    ]
+    dq_specs = list(common)
+    dq_specs[1] = pl.BlockSpec((1, 1, p.block_q, D),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM)
+    dq_specs[5] = pl.BlockSpec((1, 1, p.block_q, 1),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM)
+    dq_specs[6] = pl.BlockSpec((1, 1, p.block_q, 1),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM)
+    dq_specs[7] = pl.BlockSpec((1, 1, p.block_q, D),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, p),
+        grid=(B, H, p.n_q),
+        in_specs=dq_specs + [_bits_specs(p, T, for_dkv=False)],
+        out_specs=pl.BlockSpec((1, 1, p.block_q, D),
+                               lambda b, h, i: (b, h, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        interpret=p.interpret_arg,
+    )(seed, q, k, v, mask_i32, lse, delta, do, bits)
+
+    dkv_specs = list(common)
+    dkv_specs[2] = pl.BlockSpec((1, 1, p.block_k, D),
+                                lambda b, h, j: (b, h, j, 0),
+                                memory_space=pltpu.VMEM)
+    dkv_specs[3] = pl.BlockSpec((1, 1, p.block_k, D),
+                                lambda b, h, j: (b, h, j, 0),
+                                memory_space=pltpu.VMEM)
+    dkv_specs[4] = pl.BlockSpec((1, 1, p.block_k), lambda b, h, j: (b, 0, j),
+                                memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, p),
+        grid=(B, H, p.n_k),
+        in_specs=dkv_specs + [_bits_specs(p, T, for_dkv=True)],
+        out_specs=[
+            pl.BlockSpec((1, 1, p.block_k, D), lambda b, h, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, p.block_k, D), lambda b, h, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        ],
+        interpret=p.interpret_arg,
+    )(seed, q, k, v, mask_i32, lse, delta, do, bits)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(p: _Params, q, k, v, mask_i32, seed, bits):
+    out, _ = _fwd_call(p, q, k, v, mask_i32, seed, bits)
+    return out
+
+
+def _flash_fwd(p: _Params, q, k, v, mask_i32, seed, bits):
+    out, lse = _fwd_call(p, q, k, v, mask_i32, seed, bits)
+    return out, (q, k, v, mask_i32, seed, bits, out, lse)
+
+
+def _flash_bwd(p: _Params, res, do):
+    q, k, v, mask_i32, seed, bits, out, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq, dk, dv = _bwd_call(p, q, k, v, mask_i32, seed, bits, lse, delta, do)
+    return dq, dk, dv, None, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array,
+    *,
+    scale: float | None = None,
+    dropout_rate: float = 0.0,
+    seed: jax.Array | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    debug_bits: jax.Array | None = None,
+    interpret: bool | str = False,
+) -> jax.Array:
+    """Fused attention with in-kernel probs-dropout (drop-in for
+    `parallel/ring_attention.full_attention`).
+
+    q, k, v: [B, H, T, D]; kv_mask: [B, T] (False/0 = padding).
+    seed: int32 [1] array seeding the in-kernel PRNG (required when
+    dropout_rate > 0 and debug_bits is None). debug_bits: optional
+    uint32 [B, H, T, T] explicit dropout bits — testing hook; replaces
+    the PRNG so CPU (interpret) runs can pin the exact dropout math.
+    Differentiable in q, k, v (custom VJP, flash backward).
+    """
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"flash_attention: T={T} must divide by block_q={block_q} "
+            f"and block_k={block_k}")
+    if dropout_rate > 0.0 and seed is None and debug_bits is None:
+        raise ValueError("flash_attention: dropout needs a seed")
+    p = _Params(
+        scale=float(scale) if scale is not None else float(D) ** -0.5,
+        dropout_rate=float(dropout_rate),
+        block_q=block_q,
+        block_k=block_k,
+        n_q=T // block_q,
+        n_k=T // block_k,
+        use_prng=debug_bits is None,
+        interpret=interpret,
+    )
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    bits = _dummy_bits() if debug_bits is None else debug_bits
+    mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]  # [B,1,T]: TPU
+    # block specs need the (sub)lane dims of every operand to tile cleanly
+    return _flash(p, q, k, v, mask_i32, seed, bits)

@@ -1,0 +1,192 @@
+"""Flash-attention Pallas kernel: parity with the XLA attention path.
+
+Everything here runs on CPU via the Pallas interpreter:
+- no-dropout fwd + custom-vjp grads vs `full_attention` (the XLA oracle),
+  multi-block, ragged kv masks, f32 and bf16;
+- exact dropout math via the `debug_bits` hook: the kernels read the
+  injected bits instead of the TPU PRNG, so a pure-jnp oracle given the
+  same keep-mask pins fwd AND all three grads;
+- the encode() integration path (DEEPDFA_TPU_FLASH_INTERPRET) under
+  scan/jit/grad.
+
+What cannot run on CPU — the real `pltpu.prng_random_bits` stream (the
+interpreter returns zeros, which by the kernel's `keep = bits <
+threshold` convention means keep-all) — is exercised on the real chip by
+scripts/bench_combined.py's self-check (keep fraction, determinism)
+before the flash variant is benched; see docs/bench_history.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.nn.flash_attention import flash_attention
+from deepdfa_tpu.parallel.ring_attention import full_attention
+
+
+def _qkv(rng, B, H, T, D, dtype):
+    mk = lambda: jnp.asarray(rng.standard_normal((B, H, T, D)), dtype)
+    return mk(), mk(), mk()
+
+
+def _ragged_mask(T, lens):
+    return jnp.asarray(np.arange(T)[None, :] < np.asarray(lens)[:, None])
+
+
+@pytest.mark.parametrize("dtype,tol", [("float32", 2e-6), ("bfloat16", 2e-2)])
+def test_fwd_matches_full_attention(rng, dtype, tol):
+    B, H, T, D = 2, 3, 256, 64
+    q, k, v = _qkv(rng, B, H, T, D, jnp.dtype(dtype))
+    mask = _ragged_mask(T, [200, 77])
+    ref = full_attention(q, k, v, mask)
+    out = flash_attention(q, k, v, mask, block_q=128, block_k=128,
+                          interpret=True)
+    assert out.dtype == jnp.dtype(dtype)
+    # compare on valid q rows (padded rows are garbage on both paths and
+    # masked out downstream)
+    valid = mask[:, None, :, None]
+    err = jnp.abs(jnp.where(valid, out.astype(jnp.float32) - ref.astype(jnp.float32), 0.0))
+    assert float(err.max()) < tol
+
+
+def test_grads_match_full_attention(rng):
+    B, H, T, D = 2, 2, 256, 32
+    q, k, v = _qkv(rng, B, H, T, D, jnp.float32)
+    mask = _ragged_mask(T, [256, 130])
+    w = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            jnp.where(mask[:, None, :, None], fn(q, k, v), 0.0) * w)
+
+    g_ref = jax.grad(loss(lambda q, k, v: full_attention(q, k, v, mask)),
+                     (0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, mask, block_q=128, block_k=128, interpret=True)),
+        (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-6, rtol=1e-4)
+
+
+def test_dropout_exact_math_via_debug_bits(rng):
+    """Injected bits -> the jnp oracle with the same keep-mask must agree
+    with the kernel exactly (fwd and all three custom-vjp grads)."""
+    B, H, T, D = 2, 2, 256, 32
+    RATE = 0.1
+    q, k, v = _qkv(rng, B, H, T, D, jnp.float32)
+    mask = _ragged_mask(T, [230, 120])
+    bits = jnp.asarray(rng.integers(0, 2**32, (B, H, T, T), dtype=np.uint32))
+    keep_thresh = np.uint32(min(int(round((1 - RATE) * 2**32)), 2**32 - 1))
+    keep = jnp.asarray(np.asarray(bits) < keep_thresh)
+    assert 0.85 < float(keep.mean()) < 0.95  # bits really are uniform
+
+    def oracle(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        m = jnp.max(s, -1, keepdims=True)
+        p = jnp.where(mask[:, None, None, :], jnp.exp(s - m), 0.0)
+        denom = jnp.maximum(p.sum(-1, keepdims=True),
+                            np.finfo(np.float32).tiny)
+        # dropout(softmax): numerator dropped+rescaled, denom undropped
+        pd = jnp.where(keep, p / (1 - RATE), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", pd, v) / denom
+
+    def fl(q, k, v):
+        return flash_attention(q, k, v, mask, dropout_rate=RATE,
+                               debug_bits=bits, block_q=128, block_k=128,
+                               interpret=True)
+
+    np.testing.assert_allclose(np.asarray(fl(q, k, v)),
+                               np.asarray(oracle(q, k, v)), atol=2e-6)
+
+    w = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            jnp.where(mask[:, None, :, None], fn(q, k, v), 0.0) * w)
+
+    g_ref = jax.grad(loss(oracle), (0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(fl), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-6, rtol=1e-4)
+
+
+def test_dropout_needs_seed(rng):
+    q, k, v = _qkv(rng, 1, 1, 128, 16, jnp.float32)
+    with pytest.raises(ValueError, match="seed"):
+        flash_attention(q, k, v, jnp.ones((1, 128), bool),
+                        dropout_rate=0.1, interpret=True)
+
+
+def test_block_divisibility_enforced(rng):
+    q, k, v = _qkv(rng, 1, 1, 192, 16, jnp.float32)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k, v, jnp.ones((1, 192), bool),
+                        block_q=128, block_k=128, interpret=True)
+
+
+def _tiny_cfgs():
+    from deepdfa_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny(vocab_size=128,
+                                     max_position_embeddings=96)
+    return (dataclasses.replace(cfg, attn_impl="flash", remat=False),
+            dataclasses.replace(cfg, attn_impl="xla", remat=False))
+
+
+def test_encode_integration_interpret(rng, monkeypatch):
+    """encode() with attn_impl=flash under scan + jit + grad on CPU.
+
+    remat=False here: the Pallas TPU interpreter implements kernels via
+    io_callback, whose effect cannot be partial-eval'ed under
+    jax.checkpoint — a CPU-interpreter limitation only (the compiled TPU
+    kernel has no callback effect; the flagship recipe keeps remat on).
+    """
+    monkeypatch.setenv("DEEPDFA_TPU_FLASH_INTERPRET", "1")
+    from deepdfa_tpu.models import transformer as tfm
+
+    cfg_f, cfg_x = _tiny_cfgs()
+    params = tfm.init_params(cfg_f, jax.random.key(0))
+    ids = jnp.asarray(rng.integers(2, 128, (2, 64)), jnp.int32)
+    ids = ids.at[0, 40:].set(cfg_f.pad_token_id)
+
+    # eval mode: flash == xla on every position of every valid row
+    h_f = tfm.encode(cfg_f, params, ids)
+    h_x = tfm.encode(cfg_x, params, ids)
+    np.testing.assert_allclose(np.asarray(h_f), np.asarray(h_x), atol=1e-5)
+
+    # train mode traces, runs, differentiates; deterministic per key
+    def loss(p):
+        return jnp.sum(tfm.encode(cfg_f, p, ids,
+                                  dropout_key=jax.random.key(1)) ** 2)
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert bool(jnp.isfinite(g["layers"]["wq"]).all())
+    h1 = tfm.encode(cfg_f, params, ids, dropout_key=jax.random.key(1))
+    h2 = tfm.encode(cfg_f, params, ids, dropout_key=jax.random.key(1))
+    assert bool(jnp.all(h1 == h2))
+
+
+def test_auto_resolution_cpu_is_xla():
+    """attn_impl=auto must NOT pick the Pallas kernel on a CPU backend
+    (it would fail to lower); the env hook opts tests in explicitly."""
+    from deepdfa_tpu.models.transformer import _resolve_attn_impl
+
+    cfg_f, _ = _tiny_cfgs()
+    cfg_auto = dataclasses.replace(cfg_f, attn_impl="auto")
+    assert os.environ.get("DEEPDFA_TPU_FLASH_INTERPRET", "") != "1"
+    assert _resolve_attn_impl(cfg_auto, 512, 64) == (
+        "flash" if jax.default_backend() == "tpu" else "xla")
+    # ill-shaped sequences always fall back
+    assert _resolve_attn_impl(cfg_auto, 640, 64) == "xla"
+    with pytest.raises(ValueError):
+        _resolve_attn_impl(dataclasses.replace(cfg_auto, attn_impl="flash"),
+                           640, 64)
