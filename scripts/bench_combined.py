@@ -261,9 +261,17 @@ def main() -> None:
         try:
             variants.append(_measure(args, ec, impl))
         except Exception as e:
+            # keep the diagnostic lines (OOM totals, mosaic errors) that
+            # a blind prefix-truncation would drop — the variants list is
+            # the auditable record of WHY a configuration lost
+            detail = [ln.strip() for ln in str(e).splitlines()
+                      if any(w in ln.lower() for w in
+                             ("hbm", "memory", "oom", "exceed", "mosaic",
+                              "error:"))][:8]
             variants.append({
                 "attn_impl": impl, "remat": remat,
                 "error": f"{type(e).__name__}: {e}"[:300],
+                "error_detail": detail,
             })
 
     scored = [v for v in variants if "value" in v]
