@@ -549,16 +549,14 @@ def _combined_setup(args, cfg):
     sp_variant = getattr(args, "sp_variant", "ring")
     attn_impl = getattr(args, "attn_impl", "auto")
     if arch == "t5":
-        if attn_impl == "flash":
-            raise SystemExit(
-                "--attn-impl flash is roberta-only: t5 attention carries "
-                "relative-position bias, which the flash kernel does not "
-                "take (t5 always uses the xla lowering)")
         if args.encoder == "codet5-base":
-            enc_cfg = t5m.T5Config(dtype="bfloat16", sp_variant=sp_variant)
+            enc_cfg = t5m.T5Config(
+                dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl
+            )
         else:
             enc_cfg = t5m.T5Config.tiny(
-                vocab_size=tok.vocab_size, sp_variant=sp_variant
+                vocab_size=tok.vocab_size, sp_variant=sp_variant,
+                attn_impl=attn_impl,
             )
         mcfg = t5m.DefectConfig(
             encoder=enc_cfg,
@@ -1365,10 +1363,11 @@ def main(argv=None) -> None:
                         "ulysses all-to-all head sharding)")
     p.add_argument("--attn-impl", default="auto",
                    choices=["auto", "xla", "flash"],
-                   help="roberta local-attention lowering: auto picks "
-                        "the fused Pallas flash kernel on TPU (measured "
-                        "+22%% over xla, docs/DESIGN.md); t5 always uses "
-                        "xla (relative-position bias)")
+                   help="encoder local-attention lowering, both archs: "
+                        "auto picks the fused Pallas flash kernel on TPU "
+                        "(measured +22%% over xla on roberta, "
+                        "docs/DESIGN.md); t5 passes its relative-position "
+                        "bias as the kernel's additive-bias operand")
     p.add_argument("--no-graph", action="store_true")
     p.add_argument("--graph-checkpoint", default=None,
                    help="run name or checkpoints dir of a pretrained "

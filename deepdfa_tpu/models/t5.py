@@ -48,6 +48,11 @@ class T5Config:
     #: (k/v rotation, per-step relative-bias blocks) or "ulysses"
     #: (all-to-all head sharding, head-sliced global bias)
     sp_variant: str = "ring"
+    #: encoder local-attention lowering ("auto"/"xla"/"flash"): same
+    #: semantics as TransformerConfig.attn_impl; the flash kernel takes
+    #: the relative-position bias as an additive operand (dbias via its
+    #: batch-accumulating backward kernel)
+    attn_impl: str = "auto"
 
     @classmethod
     def tiny(cls, **kw) -> "T5Config":
@@ -243,7 +248,23 @@ def encoder_layer(
             bias_fn=bias_fn,
         )
     else:
-        ctx = _attention(q, k, v, attn_mask, bias)
+        from deepdfa_tpu.models.transformer import (
+            _flash_interpret,
+            _resolve_attn_impl,
+        )
+
+        if _resolve_attn_impl(cfg, q.shape[2], cfg.head_dim) == "flash":
+            from deepdfa_tpu.nn.flash_attention import flash_attention
+
+            # T5 semantics: no 1/sqrt(d) scaling, additive position
+            # bias, no attention-probs dropout (dropout acts on the
+            # residual branches below — HF t5 parity, _attention above)
+            ctx = flash_attention(
+                q, k, v, attn_mask, scale=1.0, bias=bias,
+                interpret="tpu" if _flash_interpret() else False,
+            )
+        else:
+            ctx = _attention(q, k, v, attn_mask, bias)
     out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
     if tp_axis is not None:
         out = region_end(out, tp_axis)

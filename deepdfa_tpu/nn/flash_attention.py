@@ -68,6 +68,7 @@ class _Params:
     n_q: int
     n_k: int
     use_prng: bool  # False: bits come from the debug_bits input
+    has_bias: bool  # additive [H, T, T] score bias (T5 relative pos)
     interpret: str | bool  # False | "legacy" | "tpu"
 
     @property
@@ -94,7 +95,8 @@ def _keep_mask(p: _Params, bits):
     return pltpu.bitcast(bits, jnp.uint32) < jnp.uint32(p.keep_threshold)
 
 
-def _bits_for_block(p: _Params, seed_ref, bits_ref, b, h, qi, kj, qsl, ksl):
+def _bits_for_block(p: _Params, seed_ref, bits_ref, b, h, qi, kj, qsl, ksl,
+                    num_h):
     """uint32 bits for the (qi, kj) block — PRNG or the debug input.
 
     The seed is (user seed, flat (b, h, qi, kj) index): any kernel that
@@ -104,24 +106,26 @@ def _bits_for_block(p: _Params, seed_ref, bits_ref, b, h, qi, kj, qsl, ksl):
     block coordinate rather than one value per axis.
     """
     if p.use_prng:
-        num_h = pl.num_programs(1)
         flat = ((b * num_h + h) * p.n_q + qi) * p.n_k + kj
         pltpu.prng_seed(seed_ref[0], flat)
         return pltpu.prng_random_bits((p.block_q, p.block_k))
     return bits_ref[0, 0, qsl, ksl]
 
 
-def _scores(q, k_blk, kv_ok, scale):
-    """Masked scaled scores for one block pair, f32. q:[bq,D] k:[bk,D]."""
+def _scores(q, k_blk, kv_ok, scale, bias_blk=None):
+    """Masked scaled scores for one block pair, f32. q:[bq,D] k:[bk,D];
+    bias_blk: optional additive [bq, bk] (added unscaled, T5 style)."""
     s = jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
     return jnp.where(kv_ok, s, _NEG_BIG)
 
 
 def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
-                o_ref, lse_ref):
+                bias_ref, o_ref, lse_ref):
     b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0]  # [bq, D], input dtype
     qsl = pl.ds(0, p.block_q)  # debug_bits rows: block-relative (see spec)
@@ -135,7 +139,8 @@ def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
         k_blk = k_ref[0, 0, ksl]  # [bk, D]
         v_blk = v_ref[0, 0, ksl]
         kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]  # [1, bk]
-        s = _scores(q, k_blk, kv_ok, p.scale)
+        bias_blk = bias_ref[0, :, ksl] if p.has_bias else None
+        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
         m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
         pr = jnp.where(kv_ok, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_run - m_new)
@@ -144,7 +149,7 @@ def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
         if p.dropout_rate > 0.0:
             keep = _keep_mask(
                 p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   qsl, ksl))
+                                   qsl, ksl, pl.num_programs(1)))
             pv = jnp.where(keep, pr * (1.0 / p.keep_prob), 0.0)
         acc = acc * alpha + jax.lax.dot_general(
             pv.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
@@ -158,7 +163,7 @@ def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
 
 
 def _dq_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
-               delta_ref, do_ref, bits_ref, dq_ref):
+               delta_ref, do_ref, bits_ref, bias_ref, dq_ref):
     b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
@@ -172,7 +177,8 @@ def _dq_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
         k_blk = k_ref[0, 0, ksl]
         v_blk = v_ref[0, 0, ksl]
         kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]
-        s = _scores(q, k_blk, kv_ok, p.scale)
+        bias_blk = bias_ref[0, :, ksl] if p.has_bias else None
+        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
         pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # true softmax probs
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -181,7 +187,7 @@ def _dq_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
         if p.dropout_rate > 0.0:
             keep = _keep_mask(
                 p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   qsl, ksl))
+                                   qsl, ksl, pl.num_programs(1)))
             dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
         ds = pr * (dp - delta)  # softmax vjp; delta = rowsum(do * o)
         dq = dq + jax.lax.dot_general(
@@ -192,7 +198,7 @@ def _dq_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
 
 
 def _dkv_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
-                delta_ref, do_ref, bits_ref, dk_ref, dv_ref):
+                delta_ref, do_ref, bits_ref, bias_ref, dk_ref, dv_ref):
     b, h, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     k_blk = k_ref[0, 0]  # [bk, D] (this program's k/v block)
     v_blk = v_ref[0, 0]
@@ -207,7 +213,8 @@ def _dkv_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
         do = do_ref[0, 0, qsl]
         lse = lse_ref[0, 0, qsl]  # [bq, 1]
         delta = delta_ref[0, 0, qsl]
-        s = _scores(q, k_blk, kv_ok, p.scale)
+        bias_blk = bias_ref[0, qsl, :] if p.has_bias else None
+        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
         pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # [bq, bk]
         pv = pr
         dp = jax.lax.dot_general(
@@ -217,7 +224,7 @@ def _dkv_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
         if p.dropout_rate > 0.0:
             keep = _keep_mask(
                 p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   qsl, ksl))
+                                   qsl, ksl, pl.num_programs(1)))
             inv = 1.0 / p.keep_prob
             pv = jnp.where(keep, pr * inv, 0.0)
             dp = jnp.where(keep, dp * inv, 0.0)
@@ -234,22 +241,69 @@ def _dkv_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
+def _dbias_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
+                  delta_ref, do_ref, bits_ref, bias_ref, dbias_ref):
+    """Accumulate dbias[h, qi-block] = sum over batch of ds.
+
+    Grid is (H, n_q, B) with batch INNERMOST so consecutive programs
+    revisit the same (h, qi) output block — the TPU grid is sequential,
+    which makes zero-init-at-b==0 + accumulate correct. The bias
+    cotangent is only [H, T, T] (batch-summed), so it is the one piece
+    of the backward that is cheap to hand to XLA afterwards (T5 buckets
+    it into the relative-position embedding via its own scatter).
+    """
+    h, qi, b = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    q = q_ref[0, 0]  # [bq, D]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # [bq, 1]
+    delta = delta_ref[0, 0]
+
+    @pl.when(b == 0)
+    def _():
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+
+    for kj in range(p.n_k):
+        ksl = pl.ds(kj * p.block_k, p.block_k)
+        k_blk = k_ref[0, 0, ksl]
+        v_blk = v_ref[0, 0, ksl]
+        kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]
+        bias_blk = bias_ref[0, :, ksl]
+        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
+        pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if p.dropout_rate > 0.0:
+            keep = _keep_mask(
+                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                   pl.ds(0, p.block_q), ksl,
+                                   pl.num_programs(0)))
+            dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
+        ds = pr * (dp - delta)
+        dbias_ref[0, :, ksl] += ds
+
+
 def _smem_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _bits_specs(p: _Params, T: int, for_dkv: bool):
+def _bits_specs(p: _Params, T: int, for_dkv: bool, grid: str = "bhi"):
     """BlockSpec for the debug_bits input (dummy [1,1,1,1] when PRNG).
 
     fwd/dq read a [bq, T] row-block (rows block-relative, cols global);
     dkv reads a [T, bk] col-block (rows global, cols block-relative).
     """
     if p.use_prng:
-        return pl.BlockSpec((1, 1, 1, 1), lambda b, h, i: (0, 0, 0, 0),
+        return pl.BlockSpec((1, 1, 1, 1), lambda *_: (0, 0, 0, 0),
                             memory_space=pl.ANY)
     if for_dkv:
         return pl.BlockSpec((1, 1, T, p.block_k),
                             lambda b, h, j: (b, h, 0, j),
+                            memory_space=pltpu.VMEM)
+    if grid == "hib":  # the dbias grid order (h, qi, b)
+        return pl.BlockSpec((1, 1, p.block_q, T),
+                            lambda h, i, b: (b, h, i, 0),
                             memory_space=pltpu.VMEM)
     return pl.BlockSpec((1, 1, p.block_q, T),
                         lambda b, h, i: (b, h, i, 0),
@@ -260,7 +314,31 @@ def _dummy_bits():
     return jnp.zeros((1, 1, 1, 1), jnp.uint32)
 
 
-def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits):
+def _bias_spec(p: _Params, T: int, layout: str):
+    """BlockSpec for the bias input (dummy [1,1,1] when absent).
+
+    layout "rows": [bq, T] block per (h, qi) — fwd/dq/dbias;
+    layout "cols": [T, bk] block per (h, kj) — dkv;
+    "rows_hib": same as rows but for the dbias grid order (h, qi, b).
+    """
+    if not p.has_bias:
+        return pl.BlockSpec((1, 1, 1), lambda *_: (0, 0, 0),
+                            memory_space=pl.ANY)
+    if layout == "cols":
+        return pl.BlockSpec((1, T, p.block_k), lambda b, h, j: (h, 0, j),
+                            memory_space=pltpu.VMEM)
+    if layout == "rows_hib":
+        return pl.BlockSpec((1, p.block_q, T), lambda h, i, b: (h, i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, p.block_q, T), lambda b, h, i: (h, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _dummy_bias():
+    return jnp.zeros((1, 1, 1), jnp.float32)
+
+
+def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias):
     B, H, T, D = q.shape
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, p),
@@ -276,6 +354,7 @@ def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits):
             pl.BlockSpec((1, 1, T), lambda b, h, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             _bits_specs(p, T, for_dkv=False),
+            _bias_spec(p, T, "rows"),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, p.block_q, D), lambda b, h, i: (b, h, i, 0),
@@ -288,11 +367,12 @@ def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits):
             jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
         ],
         interpret=p.interpret_arg,
-    )(seed, q, k, v, mask_i32, bits)
+    )(seed, q, k, v, mask_i32, bits, bias)
     return out, lse
 
 
-def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, lse, delta, do):
+def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
+              do):
     B, H, T, D = q.shape
     common = [
         _smem_spec(),
@@ -327,13 +407,14 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, lse, delta, do):
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, p),
         grid=(B, H, p.n_q),
-        in_specs=dq_specs + [_bits_specs(p, T, for_dkv=False)],
+        in_specs=dq_specs + [_bits_specs(p, T, for_dkv=False),
+                             _bias_spec(p, T, "rows")],
         out_specs=pl.BlockSpec((1, 1, p.block_q, D),
                                lambda b, h, i: (b, h, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         interpret=p.interpret_arg,
-    )(seed, q, k, v, mask_i32, lse, delta, do, bits)
+    )(seed, q, k, v, mask_i32, lse, delta, do, bits, bias)
 
     dkv_specs = list(common)
     dkv_specs[2] = pl.BlockSpec((1, 1, p.block_k, D),
@@ -347,7 +428,8 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, lse, delta, do):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, p),
         grid=(B, H, p.n_k),
-        in_specs=dkv_specs + [_bits_specs(p, T, for_dkv=True)],
+        in_specs=dkv_specs + [_bits_specs(p, T, for_dkv=True),
+                              _bias_spec(p, T, "cols")],
         out_specs=[
             pl.BlockSpec((1, 1, p.block_k, D), lambda b, h, j: (b, h, j, 0),
                          memory_space=pltpu.VMEM),
@@ -359,27 +441,67 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, lse, delta, do):
             jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         ],
         interpret=p.interpret_arg,
-    )(seed, q, k, v, mask_i32, lse, delta, do, bits)
-    return dq, dk, dv
+    )(seed, q, k, v, mask_i32, lse, delta, do, bits, bias)
+
+    dbias = None
+    if p.has_bias:
+        dbias_specs = [
+            _smem_spec(),
+            pl.BlockSpec((1, 1, p.block_q, D),
+                         lambda h, i, b: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),  # q
+            pl.BlockSpec((1, 1, T, D), lambda h, i, b: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),  # k
+            pl.BlockSpec((1, 1, T, D), lambda h, i, b: (b, h, 0, 0),
+                         memory_space=pltpu.VMEM),  # v
+            pl.BlockSpec((1, 1, T), lambda h, i, b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),  # mask
+            pl.BlockSpec((1, 1, p.block_q, 1),
+                         lambda h, i, b: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),  # lse
+            pl.BlockSpec((1, 1, p.block_q, 1),
+                         lambda h, i, b: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),  # delta
+            pl.BlockSpec((1, 1, p.block_q, D),
+                         lambda h, i, b: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),  # do
+            _bits_specs(p, T, for_dkv=False, grid="hib"),
+            _bias_spec(p, T, "rows_hib"),
+        ]
+        dbias = pl.pallas_call(
+            functools.partial(_dbias_kernel, p),
+            grid=(H, p.n_q, B),  # batch innermost: see kernel doc
+            in_specs=dbias_specs,
+            out_specs=pl.BlockSpec((1, p.block_q, T),
+                                   lambda h, i, b: (h, i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((H, T, T), jnp.float32),
+            interpret=p.interpret_arg,
+        )(seed, q, k, v, mask_i32, lse, delta, do, bits, bias)
+    return dq, dk, dv, dbias
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash(p: _Params, q, k, v, mask_i32, seed, bits):
-    out, _ = _fwd_call(p, q, k, v, mask_i32, seed, bits)
+def _flash(p: _Params, q, k, v, mask_i32, seed, bits, bias):
+    out, _ = _fwd_call(p, q, k, v, mask_i32, seed, bits, bias)
     return out
 
 
-def _flash_fwd(p: _Params, q, k, v, mask_i32, seed, bits):
-    out, lse = _fwd_call(p, q, k, v, mask_i32, seed, bits)
-    return out, (q, k, v, mask_i32, seed, bits, out, lse)
+def _flash_fwd(p: _Params, q, k, v, mask_i32, seed, bits, bias):
+    out, lse = _fwd_call(p, q, k, v, mask_i32, seed, bits, bias)
+    return out, (q, k, v, mask_i32, seed, bits, bias, out, lse)
 
 
 def _flash_bwd(p: _Params, res, do):
-    q, k, v, mask_i32, seed, bits, out, lse = res
+    q, k, v, mask_i32, seed, bits, bias, out, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    dq, dk, dv = _bwd_call(p, q, k, v, mask_i32, seed, bits, lse, delta, do)
-    return dq, dk, dv, None, None, None
+    dq, dk, dv, dbias = _bwd_call(
+        p, q, k, v, mask_i32, seed, bits, bias, lse, delta, do
+    )
+    if dbias is not None:
+        dbias = dbias.astype(bias.dtype)
+    return dq, dk, dv, None, None, None, dbias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -396,6 +518,7 @@ def flash_attention(
     seed: jax.Array | None = None,
     block_q: int = 512,
     block_k: int = 512,
+    bias: jax.Array | None = None,
     debug_bits: jax.Array | None = None,
     interpret: bool | str = False,
 ) -> jax.Array:
@@ -407,7 +530,10 @@ def flash_attention(
     dropout_rate > 0 and debug_bits is None). debug_bits: optional
     uint32 [B, H, T, T] explicit dropout bits — testing hook; replaces
     the PRNG so CPU (interpret) runs can pin the exact dropout math.
-    Differentiable in q, k, v (custom VJP, flash backward).
+    bias: optional additive [H, T, T] score bias, broadcast over batch
+    (T5's relative-position bias; added unscaled, like the reference's
+    ``scores + position_bias``). Differentiable in q, k, v, and bias
+    (custom VJP, flash backward; dbias via a batch-accumulating kernel).
     """
     B, H, T, D = q.shape
     block_q = min(block_q, T)
@@ -418,6 +544,10 @@ def flash_attention(
             f"and block_k={block_k}")
     if dropout_rate > 0.0 and seed is None and debug_bits is None:
         raise ValueError("flash_attention: dropout needs a seed")
+    if bias is not None and bias.shape != (H, T, T):
+        raise ValueError(
+            f"flash_attention: bias must be [H={H}, T={T}, T={T}] "
+            f"(batch-broadcast), got {bias.shape}")
     p = _Params(
         scale=float(scale) if scale is not None else float(D) ** -0.5,
         dropout_rate=float(dropout_rate),
@@ -426,11 +556,14 @@ def flash_attention(
         n_q=T // block_q,
         n_k=T // block_k,
         use_prng=debug_bits is None,
+        has_bias=bias is not None,
         interpret=interpret,
     )
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
     bits = _dummy_bits() if debug_bits is None else debug_bits
+    if bias is None:
+        bias = _dummy_bias()
     mask_i32 = kv_mask.astype(jnp.int32)[:, None, :]  # [B,1,T]: TPU
     # block specs need the (sub)lane dims of every operand to tile cleanly
-    return _flash(p, q, k, v, mask_i32, seed, bits)
+    return _flash(p, q, k, v, mask_i32, seed, bits, bias)

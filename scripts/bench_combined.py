@@ -94,11 +94,17 @@ def _measure(args, enc, label: str) -> dict:
     from deepdfa_tpu.data.text import collate_shards
     from deepdfa_tpu.data.tokenizer import HashTokenizer
     from deepdfa_tpu.eval.profiling import compiled_cost
-    from deepdfa_tpu.models import combined as cmb
     from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
     platform = jax.devices()[0].platform
-    mcfg = cmb.CombinedConfig(encoder=enc, graph_input_dim=1002)
+    if args.arch == "t5":
+        from deepdfa_tpu.models import t5 as t5m
+
+        mcfg = t5m.DefectConfig(encoder=enc, graph_input_dim=1002)
+    else:
+        from deepdfa_tpu.models import combined as cmb
+
+        mcfg = cmb.CombinedConfig(encoder=enc, graph_input_dim=1002)
     cfg = Config()
 
     n = args.rows
@@ -108,7 +114,8 @@ def _measure(args, enc, label: str) -> dict:
         limit_subkeys=1000,
     )
     by_id = {s.graph_id: s for s in specs}
-    tok = HashTokenizer(vocab_size=enc.vocab_size)
+    tok = HashTokenizer(vocab_size=enc.vocab_size,
+                        t5_frame=(args.arch == "t5"))
     token_ids = tok.batch_encode([s.before for s in synth], max_length=args.seq)
     batch = collate_shards(
         token_ids, [s.label for s in synth], list(range(n)), by_id,
@@ -172,6 +179,8 @@ def _measure(args, enc, label: str) -> dict:
             # (S, dP, dV, dK), plus a second fwd under remat. Recorded
             # so the adjustment is auditable.
             units = 9 + (2 if enc.remat else 0)
+            if args.arch == "t5":
+                units += 2  # dbias kernel: S and dP recomputes
             add = (enc.num_layers * enc.num_heads * units
                    * 2 * args.seq**2 * enc.head_dim)
             flops += add * n
@@ -205,6 +214,10 @@ def main() -> None:
                     choices=["auto", "xla", "flash"],
                     help="force one attention lowering instead of the "
                     "TPU A/B sweep")
+    ap.add_argument("--arch", default="roberta", choices=["roberta", "t5"],
+                    help="combined architecture: roberta (LineVul-style, "
+                    "codebert geometry) or t5 (CodeT5-style defect model, "
+                    "relative-bias flash operand)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -219,20 +232,26 @@ def main() -> None:
 
     import jax
 
-    from deepdfa_tpu.models.transformer import TransformerConfig
-
     platform = jax.devices()[0].platform
     dtype = args.dtype or ("bfloat16" if platform != "cpu" else "float32")
-    if args.tiny:
-        enc = TransformerConfig.tiny(
-            vocab_size=512, max_position_embeddings=args.seq + 4
-        )
+    if args.arch == "t5":
+        from deepdfa_tpu.models.t5 import T5Config
+
+        # codet5-base geometry (12 x 768, 12 heads, 64 head dim, 32k vocab)
+        enc = T5Config.tiny(vocab_size=512) if args.tiny else T5Config()
     else:
-        # codebert-base geometry (the reference's checkpoint):
-        # 12 x 768, 12 heads, 3072 FFN, 50k vocab -> ~125M params
-        enc = TransformerConfig(
-            vocab_size=50265, max_position_embeddings=args.seq + 2
-        )
+        from deepdfa_tpu.models.transformer import TransformerConfig
+
+        if args.tiny:
+            enc = TransformerConfig.tiny(
+                vocab_size=512, max_position_embeddings=args.seq + 4
+            )
+        else:
+            # codebert-base geometry (the reference's checkpoint):
+            # 12 x 768, 12 heads, 3072 FFN, 50k vocab -> ~125M params
+            enc = TransformerConfig(
+                vocab_size=50265, max_position_embeddings=args.seq + 2
+            )
     enc = dataclasses.replace(enc, dtype=dtype)
 
     # which lowerings to measure: explicit --attn wins; otherwise A/B on
@@ -288,7 +307,10 @@ def main() -> None:
         "platform": platform,
         "rows": args.rows,
         "seq": args.seq,
-        "encoder": "tiny" if args.tiny else "codebert-base(12x768)",
+        "arch": args.arch,
+        "encoder": ("tiny" if args.tiny else
+                    "codet5-base(12x768)" if args.arch == "t5" else
+                    "codebert-base(12x768)"),
         "dtype": dtype,
         **{k: v for k, v in best.items() if k != "remat"},
         "remat": best["remat"],

@@ -115,27 +115,43 @@ def run_bench_suite(platform: str) -> dict:
     except subprocess.TimeoutExpired:
         record["bench_error"] = "bench.py exceeded 2700s"
 
-    combined_out = os.path.join(REPO, "docs", "bench_combined_tpu.json")
-    try:
-        res = subprocess.run(
-            [
-                sys.executable,
-                os.path.join(REPO, "scripts", "bench_combined.py"),
-                "--out", combined_out,
-            ],
-            capture_output=True, text=True, timeout=2400, env=env, cwd=REPO,
+    # both combined architectures: roberta (LineVul-style headline) and
+    # t5 (CodeT5-style, exercises the flash kernel's bias operand)
+    for arch, key, budget in (
+        ("roberta", "bench_combined", 2400),
+        ("t5", "bench_combined_t5", 1800),
+    ):
+        combined_out = os.path.join(
+            REPO, "docs",
+            "bench_combined_tpu.json" if arch == "roberta"
+            else "bench_combined_t5_tpu.json",
         )
-        if res.returncode == 0 and os.path.exists(combined_out):
-            with open(combined_out) as f:
-                record["bench_combined"] = json.load(f)
-        else:
-            record["bench_combined_error"] = (res.stderr or res.stdout)[-500:]
-    except subprocess.TimeoutExpired:
-        record["bench_combined_error"] = "bench_combined.py exceeded 2400s"
+        try:
+            res = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "scripts", "bench_combined.py"),
+                    "--arch", arch, "--out", combined_out,
+                ],
+                capture_output=True, text=True, timeout=budget, env=env,
+                cwd=REPO,
+            )
+            if res.returncode == 0 and os.path.exists(combined_out):
+                with open(combined_out) as f:
+                    record[key] = json.load(f)
+            else:
+                record[f"{key}_error"] = (res.stderr or res.stdout)[-500:]
+        except subprocess.TimeoutExpired:
+            record[f"{key}_error"] = f"bench_combined.py {arch} exceeded {budget}s"
     return record
 
 
 def commit_artifacts(paths: list[str], message: str) -> None:
+    # a missing path (e.g. an arch bench that never produced its file)
+    # must not abort the git add for everything else
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
+        return
     try:
         subprocess.run(["git", "add", *paths], cwd=REPO, check=True)
         subprocess.run(
@@ -189,6 +205,7 @@ def main() -> None:
                     LOG_PATH,
                     os.path.join(REPO, "docs", "tpu_watchdog.out"),
                     os.path.join(REPO, "docs", "bench_combined_tpu.json"),
+                    os.path.join(REPO, "docs", "bench_combined_t5_tpu.json"),
                 ],
                 "Capture TPU bench from watchdog healthy-window "
                 f"({os.path.basename(out)})",
