@@ -226,6 +226,23 @@ def test_t5_encode_integration_interpret(rng, monkeypatch):
     assert float(jnp.abs(rb).max()) > 0.0
 
 
+def test_long_sequence_multiblock(rng):
+    """T=1024 (two 512-blocks per axis): the streaming-softmax tiling is
+    what makes long single-chip sequences feasible at all — the XLA path
+    materializes [B,H,T,T], which at 8k tokens is GBs per layer; the
+    kernel's working set stays O(block_q x block_k) VMEM regardless of
+    T. Parity vs the materializing oracle at a T the oracle can still
+    afford."""
+    B, H, T, D = 1, 1, 1024, 64
+    q, k, v = _qkv(rng, B, H, T, D, jnp.float32)
+    mask = _ragged_mask(T, [900])
+    ref = full_attention(q, k, v, mask)
+    out = flash_attention(q, k, v, mask, interpret=True)  # blocks 512x512
+    valid = mask[:, None, :, None]
+    err = jnp.abs(jnp.where(valid, out - ref, 0.0))
+    assert float(err.max()) < 2e-6
+
+
 def test_dropout_needs_seed(rng):
     q, k, v = _qkv(rng, 1, 1, 128, 16, jnp.float32)
     with pytest.raises(ValueError, match="seed"):
