@@ -221,11 +221,31 @@ def decode_train(
     x = _dropout(x, ecfg.dropout_rate, k_embed)
 
     T = dec_input_ids.shape[1]
+    S = enc_mask.shape[1]
     pos = jnp.arange(T)
     buckets = relative_position_buckets(
         pos, pos, ecfg.rel_buckets, ecfg.rel_max_distance, bidirectional=False
     )
     bias = dp["rel_bias"][buckets].astype(dt).transpose(2, 0, 1)
+    # flash lowering (teacher-forced training only; the incremental
+    # beam-search decode path keeps its KV-cached XLA attention): the
+    # kernel takes causal as a static mask and the cross attention as
+    # the rectangular Tq != Tk case
+    from deepdfa_tpu.models.transformer import (
+        _flash_interpret,
+        _flash_shape_ok,
+        _resolve_attn_impl,
+    )
+
+    use_flash = _resolve_attn_impl(ecfg, T, ecfg.head_dim) == "flash"
+    if use_flash and not _flash_shape_ok(S, ecfg.head_dim):
+        if ecfg.attn_impl == "flash":
+            raise ValueError(
+                f"attn_impl='flash' needs the encoder length to tile too "
+                f"(S={S}: need S<=512 or S%512==0)")
+        use_flash = False  # auto quietly falls back, as everywhere else
+    interp = "tpu" if _flash_interpret() else False
+    from deepdfa_tpu.nn.flash_attention import flash_attention
     causal = jnp.tril(jnp.ones((T, T), bool))
     self_mask = causal[None] & dec_mask[:, None, :].astype(bool)
     cross_mask = jnp.broadcast_to(
@@ -242,7 +262,13 @@ def decode_train(
         q = jnp.einsum("btd,dhk->bhtk", h, lp["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bhtk", h, lp["wk"].astype(dt))
         v = jnp.einsum("btd,dhk->bhtk", h, lp["wv"].astype(dt))
-        ctx = _attend(q, k, v, self_mask, bias)
+        if use_flash:
+            ctx = flash_attention(
+                q, k, v, dec_mask, scale=1.0, bias=bias, causal=True,
+                interpret=interp,
+            )
+        else:
+            ctx = _attend(q, k, v, self_mask, bias)
         out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
         x = x + _dropout(out, ecfg.dropout_rate, k1)
 
@@ -250,7 +276,12 @@ def decode_train(
         q = jnp.einsum("btd,dhk->bhtk", h, lp["cq"].astype(dt))
         k = jnp.einsum("bsd,dhk->bhsk", enc_h, lp["ck"].astype(dt))
         v = jnp.einsum("bsd,dhk->bhsk", enc_h, lp["cv"].astype(dt))
-        ctx = _attend(q, k, v, cross_mask, None)
+        if use_flash:
+            ctx = flash_attention(
+                q, k, v, enc_mask, scale=1.0, interpret=interp
+            )
+        else:
+            ctx = _attend(q, k, v, cross_mask, None)
         out = jnp.einsum("bhtk,hkd->btd", ctx, lp["co"].astype(dt))
         x = x + _dropout(out, ecfg.dropout_rate, k2)
 

@@ -68,7 +68,8 @@ class _Params:
     n_q: int
     n_k: int
     use_prng: bool  # False: bits come from the debug_bits input
-    has_bias: bool  # additive [H, T, T] score bias (T5 relative pos)
+    has_bias: bool  # additive [H, Tq, Tk] score bias (T5 relative pos)
+    causal: bool  # autoregressive mask (decoder self-attention)
     interpret: str | bool  # False | "legacy" | "tpu"
 
     @property
@@ -124,6 +125,29 @@ def _scores(q, k_blk, kv_ok, scale, bias_blk=None):
     return jnp.where(kv_ok, s, _NEG_BIG)
 
 
+def _block_dead(p: _Params, qi, kj):
+    """Causal: True when block (qi, kj) lies entirely above the diagonal
+    (every col > every row) — its probs are all zero, so the dots can be
+    skipped at runtime. qi/kj may be traced (grid ids)."""
+    return kj * p.block_k > qi * p.block_q + (p.block_q - 1)
+
+
+def _block_ok(p: _Params, kv_ok, qi, kj):
+    """Combine the kv padding mask with the causal block mask.
+
+    kv_ok: [1, bk]. Returns [1, bk] or (causal) [bq, bk] — every
+    consumer broadcasts. qi/kj are the global block coordinates (grid
+    ids or loop indices), so the iota comparison uses global positions.
+    """
+    if not p.causal:
+        return kv_ok
+    rows = jax.lax.broadcasted_iota(
+        jnp.int32, (p.block_q, p.block_k), 0) + qi * p.block_q
+    cols = jax.lax.broadcasted_iota(
+        jnp.int32, (p.block_q, p.block_k), 1) + kj * p.block_k
+    return kv_ok & (cols <= rows)
+
+
 def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
                 bias_ref, o_ref, lse_ref):
     b, h, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
@@ -136,26 +160,38 @@ def _fwd_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, bits_ref,
 
     for kj in range(p.n_k):
         ksl = pl.ds(kj * p.block_k, p.block_k)
-        k_blk = k_ref[0, 0, ksl]  # [bk, D]
-        v_blk = v_ref[0, 0, ksl]
-        kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]  # [1, bk]
-        bias_blk = bias_ref[0, :, ksl] if p.has_bias else None
-        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
-        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
-        pr = jnp.where(kv_ok, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_run - m_new)
-        l_run = l_run * alpha + jnp.sum(pr, axis=-1, keepdims=True)
-        pv = pr
-        if p.dropout_rate > 0.0:
-            keep = _keep_mask(
-                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   qsl, ksl, pl.num_programs(1)))
-            pv = jnp.where(keep, pr * (1.0 / p.keep_prob), 0.0)
-        acc = acc * alpha + jax.lax.dot_general(
-            pv.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_run = m_new
+
+        def live(carry, ksl=ksl, kj=kj):
+            m_run, l_run, acc = carry
+            k_blk = k_ref[0, 0, ksl]  # [bk, D]
+            v_blk = v_ref[0, 0, ksl]
+            kv_ok = _block_ok(p, (m_ref[0, 0, ksl] != 0)[None, :], qi, kj)
+            bias_blk = bias_ref[0, :, ksl] if p.has_bias else None
+            s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
+            m_new = jnp.maximum(m_run, jnp.max(s, axis=-1, keepdims=True))
+            pr = jnp.where(kv_ok, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m_run - m_new)
+            l_run = l_run * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+            pv = pr
+            if p.dropout_rate > 0.0:
+                keep = _keep_mask(
+                    p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                       qsl, ksl, pl.num_programs(1)))
+                pv = jnp.where(keep, pr * (1.0 / p.keep_prob), 0.0)
+            acc2 = acc * alpha + jax.lax.dot_general(
+                pv.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_run, acc2
+
+        carry = (m_run, l_run, acc)
+        if p.causal:
+            # skip above-diagonal blocks entirely at runtime (roughly
+            # half the block pairs) — they contribute zero probability
+            m_run, l_run, acc = jax.lax.cond(
+                _block_dead(p, qi, kj), lambda c: c, live, carry)
+        else:
+            m_run, l_run, acc = live(carry)
 
     l_safe = jnp.maximum(l_run, jnp.finfo(jnp.float32).tiny)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
@@ -174,26 +210,33 @@ def _dq_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
 
     for kj in range(p.n_k):
         ksl = pl.ds(kj * p.block_k, p.block_k)
-        k_blk = k_ref[0, 0, ksl]
-        v_blk = v_ref[0, 0, ksl]
-        kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]
-        bias_blk = bias_ref[0, :, ksl] if p.has_bias else None
-        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
-        pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # true softmax probs
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        if p.dropout_rate > 0.0:
-            keep = _keep_mask(
-                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   qsl, ksl, pl.num_programs(1)))
-            dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
-        ds = pr * (dp - delta)  # softmax vjp; delta = rowsum(do * o)
-        dq = dq + jax.lax.dot_general(
-            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+
+        def live(dq, ksl=ksl, kj=kj):
+            k_blk = k_ref[0, 0, ksl]
+            v_blk = v_ref[0, 0, ksl]
+            kv_ok = _block_ok(p, (m_ref[0, 0, ksl] != 0)[None, :], qi, kj)
+            bias_blk = bias_ref[0, :, ksl] if p.has_bias else None
+            s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
+            pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # softmax probs
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            if p.dropout_rate > 0.0:
+                keep = _keep_mask(
+                    p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                       qsl, ksl, pl.num_programs(1)))
+                dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
+            ds = pr * (dp - delta)  # softmax vjp; delta = rowsum(do * o)
+            return dq + jax.lax.dot_general(
+                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        if p.causal:
+            dq = jax.lax.cond(_block_dead(p, qi, kj), lambda d: d, live, dq)
+        else:
+            dq = live(dq)
     dq_ref[0, 0] = (dq * p.scale).astype(dq_ref.dtype)
 
 
@@ -202,41 +245,52 @@ def _dkv_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
     b, h, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     k_blk = k_ref[0, 0]  # [bk, D] (this program's k/v block)
     v_blk = v_ref[0, 0]
-    kv_ok = (m_ref[0, 0] != 0)[None, :]  # [1, bk]
+    kv_pad_ok = (m_ref[0, 0] != 0)[None, :]  # [1, bk]
     ksl = pl.ds(0, p.block_k)  # debug_bits cols: block-relative (see spec)
     dk = jnp.zeros((p.block_k, k_blk.shape[-1]), jnp.float32)
     dv = jnp.zeros((p.block_k, v_blk.shape[-1]), jnp.float32)
 
     for qi in range(p.n_q):
         qsl = pl.ds(qi * p.block_q, p.block_q)
-        q = q_ref[0, 0, qsl]  # [bq, D]
-        do = do_ref[0, 0, qsl]
-        lse = lse_ref[0, 0, qsl]  # [bq, 1]
-        delta = delta_ref[0, 0, qsl]
-        bias_blk = bias_ref[0, qsl, :] if p.has_bias else None
-        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
-        pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # [bq, bk]
-        pv = pr
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if p.dropout_rate > 0.0:
-            keep = _keep_mask(
-                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   qsl, ksl, pl.num_programs(1)))
-            inv = 1.0 / p.keep_prob
-            pv = jnp.where(keep, pr * inv, 0.0)
-            dp = jnp.where(keep, dp * inv, 0.0)
-        dv = dv + jax.lax.dot_general(
-            pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, D]
-        ds = pr * (dp - delta)
-        dk = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+
+        def live(carry, qsl=qsl, qi=qi):
+            dk, dv = carry
+            q = q_ref[0, 0, qsl]  # [bq, D]
+            do = do_ref[0, 0, qsl]
+            lse = lse_ref[0, 0, qsl]  # [bq, 1]
+            delta = delta_ref[0, 0, qsl]
+            kv_ok = _block_ok(p, kv_pad_ok, qi, kj)
+            bias_blk = bias_ref[0, qsl, :] if p.has_bias else None
+            s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
+            pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)  # [bq, bk]
+            pv = pr
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if p.dropout_rate > 0.0:
+                keep = _keep_mask(
+                    p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                       qsl, ksl, pl.num_programs(1)))
+                inv = 1.0 / p.keep_prob
+                pv = jnp.where(keep, pr * inv, 0.0)
+                dp = jnp.where(keep, dp * inv, 0.0)
+            dv2 = dv + jax.lax.dot_general(
+                pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bk, D]
+            ds = pr * (dp - delta)
+            dk2 = dk + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk2, dv2
+
+        if p.causal:
+            dk, dv = jax.lax.cond(
+                _block_dead(p, qi, kj), lambda c: c, live, (dk, dv))
+        else:
+            dk, dv = live((dk, dv))
     dk_ref[0, 0] = (dk * p.scale).astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
@@ -264,24 +318,32 @@ def _dbias_kernel(p: _Params, seed_ref, q_ref, k_ref, v_ref, m_ref, lse_ref,
 
     for kj in range(p.n_k):
         ksl = pl.ds(kj * p.block_k, p.block_k)
-        k_blk = k_ref[0, 0, ksl]
-        v_blk = v_ref[0, 0, ksl]
-        kv_ok = (m_ref[0, 0, ksl] != 0)[None, :]
-        bias_blk = bias_ref[0, :, ksl]
-        s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
-        pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if p.dropout_rate > 0.0:
-            keep = _keep_mask(
-                p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
-                                   pl.ds(0, p.block_q), ksl,
-                                   pl.num_programs(0)))
-            dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
-        ds = pr * (dp - delta)
-        dbias_ref[0, :, ksl] += ds
+
+        def live(ksl=ksl, kj=kj):
+            k_blk = k_ref[0, 0, ksl]
+            v_blk = v_ref[0, 0, ksl]
+            kv_ok = _block_ok(p, (m_ref[0, 0, ksl] != 0)[None, :], qi, kj)
+            bias_blk = bias_ref[0, :, ksl]
+            s = _scores(q, k_blk, kv_ok, p.scale, bias_blk)
+            pr = jnp.where(kv_ok, jnp.exp(s - lse), 0.0)
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if p.dropout_rate > 0.0:
+                keep = _keep_mask(
+                    p, _bits_for_block(p, seed_ref, bits_ref, b, h, qi, kj,
+                                       pl.ds(0, p.block_q), ksl,
+                                       pl.num_programs(0)))
+                dp = jnp.where(keep, dp * (1.0 / p.keep_prob), 0.0)
+            ds = pr * (dp - delta)
+            dbias_ref[0, :, ksl] += ds
+
+        if p.causal:
+            # above-diagonal blocks contribute zero ds: predicate out
+            pl.when(jnp.logical_not(_block_dead(p, qi, kj)))(live)
+        else:
+            live()
 
 
 def _smem_spec():
@@ -339,7 +401,8 @@ def _dummy_bias():
 
 
 def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias):
-    B, H, T, D = q.shape
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, p),
         grid=(B, H, p.n_q),
@@ -347,14 +410,14 @@ def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias):
             _smem_spec(),
             pl.BlockSpec((1, 1, p.block_q, D), lambda b, h, i: (b, h, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, T), lambda b, h, i: (b, 0, 0),
+            pl.BlockSpec((1, 1, Tk), lambda b, h, i: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            _bits_specs(p, T, for_dkv=False),
-            _bias_spec(p, T, "rows"),
+            _bits_specs(p, Tk, for_dkv=False),
+            _bias_spec(p, Tk, "rows"),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, p.block_q, D), lambda b, h, i: (b, h, i, 0),
@@ -363,8 +426,8 @@ def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
         ],
         interpret=p.interpret_arg,
     )(seed, q, k, v, mask_i32, bits, bias)
@@ -373,22 +436,23 @@ def _fwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias):
 
 def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
               do):
-    B, H, T, D = q.shape
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
     common = [
         _smem_spec(),
-        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+        pl.BlockSpec((1, 1, Tq, D), lambda b, h, i: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),  # q (full; dq re-blocks)
-        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+        pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),  # k
-        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+        pl.BlockSpec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),  # v
-        pl.BlockSpec((1, 1, T), lambda b, h, i: (b, 0, 0),
+        pl.BlockSpec((1, 1, Tk), lambda b, h, i: (b, 0, 0),
                      memory_space=pltpu.VMEM),  # mask
-        pl.BlockSpec((1, 1, T, 1), lambda b, h, i: (b, h, 0, 0),
+        pl.BlockSpec((1, 1, Tq, 1), lambda b, h, i: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),  # lse
-        pl.BlockSpec((1, 1, T, 1), lambda b, h, i: (b, h, 0, 0),
+        pl.BlockSpec((1, 1, Tq, 1), lambda b, h, i: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),  # delta
-        pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0),
+        pl.BlockSpec((1, 1, Tq, D), lambda b, h, i: (b, h, 0, 0),
                      memory_space=pltpu.VMEM),  # do
     ]
     dq_specs = list(common)
@@ -407,12 +471,12 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, p),
         grid=(B, H, p.n_q),
-        in_specs=dq_specs + [_bits_specs(p, T, for_dkv=False),
-                             _bias_spec(p, T, "rows")],
+        in_specs=dq_specs + [_bits_specs(p, Tk, for_dkv=False),
+                             _bias_spec(p, Tk, "rows")],
         out_specs=pl.BlockSpec((1, 1, p.block_q, D),
                                lambda b, h, i: (b, h, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
         interpret=p.interpret_arg,
     )(seed, q, k, v, mask_i32, lse, delta, do, bits, bias)
 
@@ -428,8 +492,8 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, p),
         grid=(B, H, p.n_k),
-        in_specs=dkv_specs + [_bits_specs(p, T, for_dkv=True),
-                              _bias_spec(p, T, "cols")],
+        in_specs=dkv_specs + [_bits_specs(p, Tq, for_dkv=True),
+                              _bias_spec(p, Tq, "cols")],
         out_specs=[
             pl.BlockSpec((1, 1, p.block_k, D), lambda b, h, j: (b, h, j, 0),
                          memory_space=pltpu.VMEM),
@@ -437,8 +501,8 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, D), q.dtype),
         ],
         interpret=p.interpret_arg,
     )(seed, q, k, v, mask_i32, lse, delta, do, bits, bias)
@@ -450,11 +514,11 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
             pl.BlockSpec((1, 1, p.block_q, D),
                          lambda h, i, b: (b, h, i, 0),
                          memory_space=pltpu.VMEM),  # q
-            pl.BlockSpec((1, 1, T, D), lambda h, i, b: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, Tk, D), lambda h, i, b: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),  # k
-            pl.BlockSpec((1, 1, T, D), lambda h, i, b: (b, h, 0, 0),
+            pl.BlockSpec((1, 1, Tk, D), lambda h, i, b: (b, h, 0, 0),
                          memory_space=pltpu.VMEM),  # v
-            pl.BlockSpec((1, 1, T), lambda h, i, b: (b, 0, 0),
+            pl.BlockSpec((1, 1, Tk), lambda h, i, b: (b, 0, 0),
                          memory_space=pltpu.VMEM),  # mask
             pl.BlockSpec((1, 1, p.block_q, 1),
                          lambda h, i, b: (b, h, i, 0),
@@ -465,17 +529,17 @@ def _bwd_call(p: _Params, q, k, v, mask_i32, seed, bits, bias, lse, delta,
             pl.BlockSpec((1, 1, p.block_q, D),
                          lambda h, i, b: (b, h, i, 0),
                          memory_space=pltpu.VMEM),  # do
-            _bits_specs(p, T, for_dkv=False, grid="hib"),
-            _bias_spec(p, T, "rows_hib"),
+            _bits_specs(p, Tk, for_dkv=False, grid="hib"),
+            _bias_spec(p, Tk, "rows_hib"),
         ]
         dbias = pl.pallas_call(
             functools.partial(_dbias_kernel, p),
             grid=(H, p.n_q, B),  # batch innermost: see kernel doc
             in_specs=dbias_specs,
-            out_specs=pl.BlockSpec((1, p.block_q, T),
+            out_specs=pl.BlockSpec((1, p.block_q, Tk),
                                    lambda h, i, b: (h, i, 0),
                                    memory_space=pltpu.VMEM),
-            out_shape=jax.ShapeDtypeStruct((H, T, T), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((H, Tq, Tk), jnp.float32),
             interpret=p.interpret_arg,
         )(seed, q, k, v, mask_i32, lse, delta, do, bits, bias)
     return dq, dk, dv, dbias
@@ -519,44 +583,52 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     bias: jax.Array | None = None,
+    causal: bool = False,
     debug_bits: jax.Array | None = None,
     interpret: bool | str = False,
 ) -> jax.Array:
     """Fused attention with in-kernel probs-dropout (drop-in for
     `parallel/ring_attention.full_attention`).
 
-    q, k, v: [B, H, T, D]; kv_mask: [B, T] (False/0 = padding).
+    q: [B, H, Tq, D]; k, v: [B, H, Tk, D] (Tq != Tk is the decoder
+    cross-attention case); kv_mask: [B, Tk] (False/0 = padding).
+    causal=True applies the autoregressive mask (requires Tq == Tk).
     seed: int32 [1] array seeding the in-kernel PRNG (required when
     dropout_rate > 0 and debug_bits is None). debug_bits: optional
-    uint32 [B, H, T, T] explicit dropout bits — testing hook; replaces
+    uint32 [B, H, Tq, Tk] explicit dropout bits — testing hook; replaces
     the PRNG so CPU (interpret) runs can pin the exact dropout math.
-    bias: optional additive [H, T, T] score bias, broadcast over batch
+    bias: optional additive [H, Tq, Tk] score bias, broadcast over batch
     (T5's relative-position bias; added unscaled, like the reference's
     ``scores + position_bias``). Differentiable in q, k, v, and bias
     (custom VJP, flash backward; dbias via a batch-accumulating kernel).
     """
-    B, H, T, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
         raise ValueError(
-            f"flash_attention: T={T} must divide by block_q={block_q} "
-            f"and block_k={block_k}")
+            f"flash_attention: Tq={Tq} must divide by block_q={block_q} "
+            f"and Tk={Tk} by block_k={block_k}")
+    if causal and Tq != Tk:
+        raise ValueError(
+            f"flash_attention: causal needs Tq == Tk (got {Tq} vs {Tk})")
     if dropout_rate > 0.0 and seed is None and debug_bits is None:
         raise ValueError("flash_attention: dropout needs a seed")
-    if bias is not None and bias.shape != (H, T, T):
+    if bias is not None and bias.shape != (H, Tq, Tk):
         raise ValueError(
-            f"flash_attention: bias must be [H={H}, T={T}, T={T}] "
+            f"flash_attention: bias must be [H={H}, Tq={Tq}, Tk={Tk}] "
             f"(batch-broadcast), got {bias.shape}")
     p = _Params(
         scale=float(scale) if scale is not None else float(D) ** -0.5,
         dropout_rate=float(dropout_rate),
         block_q=block_q,
         block_k=block_k,
-        n_q=T // block_q,
-        n_k=T // block_k,
+        n_q=Tq // block_q,
+        n_k=Tk // block_k,
         use_prng=debug_bits is None,
         has_bias=bias is not None,
+        causal=causal,
         interpret=interpret,
     )
     if seed is None:

@@ -226,6 +226,129 @@ def test_t5_encode_integration_interpret(rng, monkeypatch):
     assert float(jnp.abs(rb).max()) > 0.0
 
 
+def test_causal_with_bias_matches_decoder_oracle(rng):
+    """Decoder self-attention shape: causal mask + causal-bucketed
+    relative bias, T5 scaling. All four grads incl. dbias."""
+    B, H, T, D = 2, 2, 256, 32
+    q, k, v = _qkv(rng, B, H, T, D, jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((H, T, T)) * 0.4, jnp.float32)
+    mask = _ragged_mask(T, [256, 180])
+    full_mask = jnp.tril(jnp.ones((T, T), bool))[None] & mask[:, None, :]
+    m4 = mask[:, None, :, None]
+
+    def oracle(q, k, v, bias):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) + bias[None]
+        s = jnp.where(full_mask[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def fl(q, k, v, bias):
+        return flash_attention(q, k, v, mask, scale=1.0, bias=bias,
+                               causal=True, block_q=128, block_k=128,
+                               interpret=True)
+
+    err = jnp.abs(jnp.where(m4, oracle(q, k, v, bias) - fl(q, k, v, bias),
+                            0.0))
+    assert float(err.max()) < 1e-5
+    w = jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(jnp.where(m4, fn(*a), 0.0) * w)
+
+    g_r = jax.grad(loss(oracle), (0, 1, 2, 3))(q, k, v, bias)
+    g_f = jax.grad(loss(fl), (0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(g_r, g_f):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-4)
+
+
+def test_rectangular_cross_attention(rng):
+    """Cross-attention: Tq != Tk (decoder queries over encoder keys)."""
+    B, H, Tq, Tk, D = 2, 2, 128, 256, 32
+    q = jnp.asarray(rng.standard_normal((B, H, Tq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, Tk, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, Tk, D)), jnp.float32)
+    mask = _ragged_mask(Tk, [256, 140])
+
+    def oracle(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k)  # t5 cross: no scaling
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def fl(q, k, v):
+        return flash_attention(q, k, v, mask, scale=1.0,
+                               block_q=128, block_k=128, interpret=True)
+
+    np.testing.assert_allclose(np.asarray(fl(q, k, v)),
+                               np.asarray(oracle(q, k, v)), atol=5e-6)
+    g_r = jax.grad(lambda *a: jnp.sum(oracle(*a) ** 2), (0, 1, 2))(q, k, v)
+    g_f = jax.grad(lambda *a: jnp.sum(fl(*a) ** 2), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_r, g_f):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-4)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, mask, causal=True, interpret=True)
+
+
+def test_decode_train_integration_interpret(rng, monkeypatch):
+    """decode_train with flash: causal+bias self-attn and rectangular
+    cross-attn must reproduce the XLA lowering end to end."""
+    monkeypatch.setenv("DEEPDFA_TPU_FLASH_INTERPRET", "1")
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models import t5_gen as t5g
+
+    ecfg = dataclasses.replace(t5m.T5Config.tiny(), attn_impl="flash",
+                               remat=False)
+    gcfg = t5g.GenConfig(encoder=ecfg)
+    params = t5g.init_gen_params(gcfg, jax.random.key(0))
+    src_ids = jnp.asarray(rng.integers(3, 250, (2, 64)), jnp.int32)
+    tgt_ids = jnp.asarray(rng.integers(3, 250, (2, 32)), jnp.int32)
+    enc_hidden = t5m.encode(ecfg, params["encoder"], src_ids)
+    enc_mask = src_ids != ecfg.pad_token_id
+    dec_in = t5g.shift_right(ecfg, tgt_ids)
+    dec_mask = jnp.ones_like(dec_in, bool)
+
+    logits_f = t5g.decode_train(gcfg, params, dec_in, dec_mask,
+                                enc_hidden, enc_mask)
+    ecfg_x = dataclasses.replace(ecfg, attn_impl="xla")
+    gcfg_x = t5g.GenConfig(encoder=ecfg_x)
+    logits_x = t5g.decode_train(gcfg_x, params, dec_in, dec_mask,
+                                enc_hidden, enc_mask)
+    np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_x),
+                               atol=2e-4)
+
+    def loss(p):
+        return jnp.sum(
+            t5g.decode_train(gcfg, p, dec_in, dec_mask, enc_hidden,
+                             enc_mask) ** 2)
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert all(bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(g))
+    assert float(jnp.abs(g["decoder"]["rel_bias"]).max()) > 0.0
+
+
+def test_decode_train_forced_flash_rejects_untileable_encoder(rng,
+                                                              monkeypatch):
+    """A FORCED flash lowering must fail loudly when the encoder length
+    cannot tile (auto falls back quietly; forcing may not)."""
+    monkeypatch.setenv("DEEPDFA_TPU_FLASH_INTERPRET", "1")
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models import t5_gen as t5g
+
+    ecfg = dataclasses.replace(t5m.T5Config.tiny(), attn_impl="flash",
+                               remat=False)
+    gcfg = t5g.GenConfig(encoder=ecfg)
+    params = t5g.init_gen_params(gcfg, jax.random.key(0))
+    S = 640  # > 512 and not a multiple of 512
+    enc_hidden = jnp.zeros((1, S, ecfg.hidden_size), jnp.float32)
+    enc_mask = jnp.ones((1, S), bool)
+    dec_in = jnp.zeros((1, 32), jnp.int32)
+    with pytest.raises(ValueError, match="encoder length"):
+        t5g.decode_train(gcfg, params, dec_in, jnp.ones((1, 32), bool),
+                         enc_hidden, enc_mask)
+
+
 def test_long_sequence_multiblock(rng):
     """T=1024 (two 512-blocks per axis): the streaming-softmax tiling is
     what makes long single-chip sequences feasible at all — the XLA path
