@@ -246,11 +246,17 @@ def decode_train(
         use_flash = False  # auto quietly falls back, as everywhere else
     interp = "tpu" if _flash_interpret() else False
     from deepdfa_tpu.nn.flash_attention import flash_attention
-    causal = jnp.tril(jnp.ones((T, T), bool))
-    self_mask = causal[None] & dec_mask[:, None, :].astype(bool)
-    cross_mask = jnp.broadcast_to(
-        enc_mask[:, None, :].astype(bool), (x.shape[0], T, enc_mask.shape[1])
-    )
+
+    self_mask = cross_mask = None
+    if not use_flash:
+        # dense [B,T,T]/[B,T,S] masks exist only on the XLA path — the
+        # kernel takes kv masks + a static causal flag instead
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        self_mask = causal[None] & dec_mask[:, None, :].astype(bool)
+        cross_mask = jnp.broadcast_to(
+            enc_mask[:, None, :].astype(bool),
+            (x.shape[0], T, enc_mask.shape[1]),
+        )
     enc_h = enc_hidden.astype(dt)
 
     def layer(x, inputs):

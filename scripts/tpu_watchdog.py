@@ -102,6 +102,23 @@ def run_bench_suite(platform: str) -> dict:
         "DEEPDFA_BENCH_TOTAL_BUDGET", "2400"
     )
 
+    # cheap first: validate every flash-attention kernel path on the
+    # chip (scripts/flash_tpu_check.py) so a window that dies mid-bench
+    # still leaves the lowering/PRNG evidence
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "flash_tpu_check.py")],
+            capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+        )
+        fc = last_json_line(res.stdout)
+        if fc is not None:
+            record["flash_paths"] = fc
+        else:
+            record["flash_paths_error"] = (res.stderr or res.stdout)[-400:]
+    except subprocess.TimeoutExpired:
+        record["flash_paths_error"] = "flash_tpu_check.py exceeded 900s"
+
     try:
         res = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
