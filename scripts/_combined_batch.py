@@ -1,0 +1,48 @@
+"""Shared flagship-workload builder for the combined-model bench scripts.
+
+One definition of the synthetic corpus -> tokenized rows -> aligned
+graph batch -> CombinedTrainer sequence, so bench_combined.py and
+train_descent_ab.py measure the SAME recipe by construction (they
+previously each carried a copy; a budget or tokenizer-framing change in
+one silently diverged the other)."""
+
+from __future__ import annotations
+
+
+def build_trainer_and_batch(enc, arch: str, rows: int, seq: int,
+                            vuln_rate: float = 0.06):
+    """(trainer, state, batch) for one encoder config.
+
+    enc: TransformerConfig (arch 'roberta') or T5Config (arch 't5').
+    """
+    from deepdfa_tpu.core import Config
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.data.text import collate_shards
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+    if arch == "t5":
+        from deepdfa_tpu.models import t5 as t5m
+
+        mcfg = t5m.DefectConfig(encoder=enc, graph_input_dim=1002)
+    else:
+        from deepdfa_tpu.models import combined as cmb
+
+        mcfg = cmb.CombinedConfig(encoder=enc, graph_input_dim=1002)
+
+    synth = generate(rows, vuln_rate=vuln_rate, seed=7)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(rows), limit_all=1000,
+        limit_subkeys=1000,
+    )
+    by_id = {s.graph_id: s for s in specs}
+    tok = HashTokenizer(vocab_size=enc.vocab_size, t5_frame=(arch == "t5"))
+    token_ids = tok.batch_encode([s.before for s in synth], max_length=seq)
+    batch = collate_shards(
+        token_ids, [s.label for s in synth], list(range(rows)), by_id,
+        num_shards=1, rows_per_shard=rows, node_budget=4096,
+        edge_budget=16384,
+    )
+    trainer = CombinedTrainer(Config(), mcfg)
+    state = trainer.init_state(seed=0)
+    return trainer, state, batch

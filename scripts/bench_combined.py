@@ -89,41 +89,14 @@ def _measure(args, enc, label: str) -> dict:
     import jax
     import numpy as np
 
-    from deepdfa_tpu.core import Config
-    from deepdfa_tpu.data import build_dataset, generate, to_examples
-    from deepdfa_tpu.data.text import collate_shards
-    from deepdfa_tpu.data.tokenizer import HashTokenizer
     from deepdfa_tpu.eval.profiling import compiled_cost
-    from deepdfa_tpu.train.combined_loop import CombinedTrainer
 
     platform = jax.devices()[0].platform
-    if args.arch == "t5":
-        from deepdfa_tpu.models import t5 as t5m
-
-        mcfg = t5m.DefectConfig(encoder=enc, graph_input_dim=1002)
-    else:
-        from deepdfa_tpu.models import combined as cmb
-
-        mcfg = cmb.CombinedConfig(encoder=enc, graph_input_dim=1002)
-    cfg = Config()
-
     n = args.rows
-    synth = generate(n, vuln_rate=0.06, seed=7)
-    specs, _ = build_dataset(
-        to_examples(synth), train_ids=range(n), limit_all=1000,
-        limit_subkeys=1000,
-    )
-    by_id = {s.graph_id: s for s in specs}
-    tok = HashTokenizer(vocab_size=enc.vocab_size,
-                        t5_frame=(args.arch == "t5"))
-    token_ids = tok.batch_encode([s.before for s in synth], max_length=args.seq)
-    batch = collate_shards(
-        token_ids, [s.label for s in synth], list(range(n)), by_id,
-        num_shards=1, rows_per_shard=n, node_budget=4096, edge_budget=16384,
-    )
+    from _combined_batch import build_trainer_and_batch
 
-    trainer = CombinedTrainer(cfg, mcfg)
-    state = trainer.init_state(seed=0)
+    trainer, state, batch = build_trainer_and_batch(
+        enc, args.arch, n, args.seq)
     key = jax.random.key(0)
 
     t0 = time.perf_counter()
