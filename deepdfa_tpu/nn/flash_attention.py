@@ -36,6 +36,14 @@ kernels then read bits from HBM instead of the PRNG — used by
 tests/test_flash_attention.py to pin fwd AND custom-vjp math against a
 pure-jnp oracle given the same mask).
 
+VMEM envelope: per program the kernel holds q/out blocks, the full k/v
+strips ([Tk, D]), and (when biased) a [block_q, Tk] bias strip — fine
+through Tk ~4k in bf16; beyond that a biased call should fall back to
+the XLA path (the un-biased roberta path streams to ~32k tokens). The
+sp>1 paths (ring/ulysses) deliberately keep their XLA blockwise
+attention: ring is already streaming O(T_local^2) per step, and a
+Pallas call inside shard_map cannot be exercised on the CPU test mesh.
+
 Kernel decision history: the GGNN scatter Pallas kernel measurably LOST
 to XLA's sorted-segment path and was deleted (docs/DESIGN.md §3). This
 kernel targets the opposite regime — not a gather/scatter but a fused
