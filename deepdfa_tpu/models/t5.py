@@ -235,10 +235,13 @@ def encoder_layer(
     k = jnp.einsum("btd,dhk->bhtk", h_in, lp["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bhtk", h_in, lp["wv"].astype(dt))
     if sp_axis is not None and cfg.sp_variant == "ulysses":
+        from deepdfa_tpu.models.transformer import _flash_interpret
         from deepdfa_tpu.parallel.ulysses import ulysses_attention
 
         ctx = ulysses_attention(
-            q, k, v, attn_mask, axis_name=sp_axis, scale=1.0, bias=bias
+            q, k, v, attn_mask, axis_name=sp_axis, scale=1.0, bias=bias,
+            attn_impl=getattr(cfg, "attn_impl", "auto"),
+            flash_interpret=_flash_interpret(),
         )
     elif sp_axis is not None:
         from deepdfa_tpu.parallel.ring_attention import ring_attention
@@ -253,7 +256,8 @@ def encoder_layer(
             _resolve_attn_impl,
         )
 
-        if _resolve_attn_impl(cfg, q.shape[2], cfg.head_dim) == "flash":
+        if _resolve_attn_impl(cfg, q.shape[2], cfg.head_dim,
+                              biased=True) == "flash":
             from deepdfa_tpu.nn.flash_attention import flash_attention
 
             # T5 semantics: no 1/sqrt(d) scaling, additive position

@@ -237,7 +237,8 @@ def decode_train(
         _resolve_attn_impl,
     )
 
-    use_flash = _resolve_attn_impl(ecfg, T, ecfg.head_dim) == "flash"
+    use_flash = _resolve_attn_impl(ecfg, T, ecfg.head_dim,
+                                   biased=True) == "flash"
     if use_flash and not _flash_shape_ok(S, ecfg.head_dim):
         if ecfg.attn_impl == "flash":
             raise ValueError(

@@ -138,28 +138,22 @@ def _flash_interpret() -> bool:
 
 
 def _flash_shape_ok(T: int, head_dim: int) -> bool:
-    # kernel blocks are min(512, T): any T <= 512 divides; larger T must
-    # tile evenly. head_dim is capped so q/k/v blocks stay VMEM-sized.
-    return (T <= 512 or T % 512 == 0) and head_dim <= 128
+    from deepdfa_tpu.nn.flash_attention import flash_shape_ok
+
+    return flash_shape_ok(T, head_dim)
 
 
-def _resolve_attn_impl(cfg: "TransformerConfig", T: int, head_dim: int) -> str:
-    impl = getattr(cfg, "attn_impl", "auto")
-    if impl == "xla":
-        return "xla"
-    if impl == "flash":
-        if not _flash_shape_ok(T, head_dim):
-            raise ValueError(
-                f"attn_impl='flash' needs T<=512 or T%512==0 and "
-                f"head_dim<=128 (got T={T}, head_dim={head_dim})")
-        return "flash"
-    if impl != "auto":
-        raise ValueError(f"unknown attn_impl {impl!r}")
-    if not _flash_shape_ok(T, head_dim):
-        return "xla"
-    if _flash_interpret():
-        return "flash"
-    return "flash" if jax.default_backend() == "tpu" else "xla"
+def _resolve_attn_impl(cfg, T: int, head_dim: int, *, Tk: int | None = None,
+                       biased: bool = False) -> str:
+    """Concrete lowering for cfg.attn_impl at this problem shape (thin
+    wrapper over nn.flash_attention.resolve_impl — the single source of
+    truth for tileability, the biased VMEM cap, and forced-vs-auto
+    semantics — adding the CPU-interpreter test hook)."""
+    from deepdfa_tpu.nn.flash_attention import resolve_impl
+
+    return resolve_impl(
+        getattr(cfg, "attn_impl", "auto"), T, head_dim, Tk=Tk,
+        biased=biased, interpret_hint=_flash_interpret())
 
 
 def _layer_norm(x, scale, bias, eps):
@@ -245,6 +239,11 @@ def encoder_layer(
         ctx = ulysses_attention(
             q, k, v, attn_mask, axis_name=sp_axis,
             dropout_rate=cfg.dropout_rate, dropout_key=k3,
+            # raw attn_impl: ulysses resolves it at the FULL sequence
+            # length (the shape the kernel actually runs at, known only
+            # after its all-to-all)
+            attn_impl=getattr(cfg, "attn_impl", "auto"),
+            flash_interpret=_flash_interpret(),
         )
     elif sp_axis is not None:
         ctx = ring_attention(
@@ -257,9 +256,9 @@ def encoder_layer(
         if rate > 0.0:
             # int32 PRNG seed for the in-kernel dropout mask (unique per
             # layer: k3 comes from the per-layer key split in encode())
-            seed = jax.lax.bitcast_convert_type(
-                jax.random.bits(k3, (1,), jnp.uint32), jnp.int32
-            )
+            from deepdfa_tpu.nn.flash_attention import derive_seed
+
+            seed = derive_seed(k3)
         ctx = flash_attention(
             q, k, v, attn_mask, dropout_rate=rate, seed=seed,
             interpret="tpu" if _flash_interpret() else False,

@@ -39,10 +39,14 @@ pure-jnp oracle given the same mask).
 VMEM envelope: per program the kernel holds q/out blocks, the full k/v
 strips ([Tk, D]), and (when biased) a [block_q, Tk] bias strip — fine
 through Tk ~4k in bf16; beyond that a biased call should fall back to
-the XLA path (the un-biased roberta path streams to ~32k tokens). The
-sp>1 paths (ring/ulysses) deliberately keep their XLA blockwise
-attention: ring is already streaming O(T_local^2) per step, and a
-Pallas call inside shard_map cannot be exercised on the CPU test mesh.
+the XLA path (the un-biased roberta path streams to ~32k tokens).
+Ulysses sequence parallelism routes its post-all-to-all local attention
+through this kernel too (`parallel/ulysses.py` — the local problem is
+exactly the single-device one), CPU-tested inside shard_map via the
+interpreter. Ring keeps its XLA blockwise attention: each rotation step
+is already streaming O(T_local^2), and folding the kernel in would mean
+threading the ring's cross-step (m, l, acc) state through the kernel's
+lse — a redesign with nothing left to save.
 
 Kernel decision history: the GGNN scatter Pallas kernel measurably LOST
 to XLA's sorted-segment path and was deleted (docs/DESIGN.md §3). This
@@ -577,6 +581,57 @@ def _flash_bwd(p: _Params, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_shape_ok(Tq: int, head_dim: int, Tk: int | None = None,
+                   biased: bool = False) -> bool:
+    """Can the kernel tile this problem? Single source of truth for every
+    dispatch site (encoder/decoder/ulysses). Kernel blocks are
+    min(512, T) per axis: any T <= 512 divides, larger T must tile
+    evenly. head_dim is capped so q/k/v blocks stay VMEM-sized; biased
+    calls additionally cap the sequence (the per-program [block_q, Tk]
+    bias strip — see the VMEM envelope note in the module docstring)."""
+    def _axis_ok(T):
+        return T <= 512 or T % 512 == 0
+
+    if Tk is None:
+        Tk = Tq
+    if biased and max(Tq, Tk) > 4096:
+        return False
+    return _axis_ok(Tq) and _axis_ok(Tk) and head_dim <= 128
+
+
+def derive_seed(key: jax.Array) -> jax.Array:
+    """int32 [1] kernel seed from a jax PRNG key (the dropout key the
+    XLA path would have consumed)."""
+    return jax.lax.bitcast_convert_type(
+        jax.random.bits(key, (1,), "uint32"), "int32")
+
+
+def resolve_impl(attn_impl: str, Tq: int, head_dim: int, *,
+                 Tk: int | None = None, biased: bool = False,
+                 interpret_hint: bool = False) -> str:
+    """Resolve "auto"/"xla"/"flash" to a concrete lowering for a given
+    problem shape. Forced "flash" on an untileable shape raises; "auto"
+    falls back quietly. interpret_hint: the CPU-interpreter test hook is
+    active, so flash is eligible off-TPU."""
+    if attn_impl == "xla":
+        return "xla"
+    ok = flash_shape_ok(Tq, head_dim, Tk, biased)
+    if attn_impl == "flash":
+        if not ok:
+            raise ValueError(
+                f"attn_impl='flash' cannot tile Tq={Tq}, Tk={Tk or Tq}, "
+                f"head_dim={head_dim}, biased={biased} (each T needs "
+                f"<=512 or %512==0; biased caps T at 4096)")
+        return "flash"
+    if attn_impl != "auto":
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    if not ok:
+        return "xla"
+    if interpret_hint:
+        return "flash"
+    return "flash" if jax.default_backend() == "tpu" else "xla"
 
 
 def flash_attention(

@@ -40,6 +40,8 @@ def ulysses_attention(
     dropout_key: jax.Array | None = None,
     scale: float | None = None,
     bias: jax.Array | None = None,
+    attn_impl: str = "xla",
+    flash_interpret: bool = False,
 ) -> jax.Array:
     """Exact attention via two all-to-alls over `axis_name`.
 
@@ -76,11 +78,41 @@ def ulysses_attention(
         dropout_key = jax.random.fold_in(
             dropout_key, jax.lax.axis_index(axis_name)
         )
-    ctx = full_attention(
-        qg, kg, vg, mask_full,
-        dropout_rate=dropout_rate, dropout_key=dropout_key, scale=scale,
-        bias=bias,
+    # resolve the lowering HERE, at the full-sequence shape the kernel
+    # actually runs at (callers pass cfg.attn_impl raw — the local chunk
+    # length they see would gate the wrong shape): forced "flash" raises
+    # on untileable shapes, "auto" falls back quietly, and the biased
+    # form carries the kernel's VMEM sequence cap
+    from deepdfa_tpu.nn.flash_attention import (
+        derive_seed,
+        flash_attention,
+        resolve_impl,
     )
+
+    impl = resolve_impl(
+        attn_impl, qg.shape[2], qg.shape[3], biased=bias is not None,
+        interpret_hint=flash_interpret)
+    if impl == "flash":
+        # the local problem after the all-to-all is exactly the
+        # single-device one (full sequence, head slice), so the fused
+        # Pallas kernel applies unchanged: kv mask + optional head-slice
+        # bias + in-kernel probs-dropout (seed derived from the
+        # per-device folded key)
+        seed = None
+        if dropout_key is not None and dropout_rate > 0.0:
+            seed = derive_seed(dropout_key)
+        ctx = flash_attention(
+            qg, kg, vg, mask_full, scale=scale, dropout_rate=(
+                dropout_rate if dropout_key is not None else 0.0),
+            seed=seed, bias=bias,
+            interpret="tpu" if flash_interpret else False,
+        )
+    else:
+        ctx = full_attention(
+            qg, kg, vg, mask_full,
+            dropout_rate=dropout_rate, dropout_key=dropout_key, scale=scale,
+            bias=bias,
+        )
     # [B, H/P, S, D] -> [B, H, T_local, D]
     return jax.lax.all_to_all(
         ctx, axis_name, split_axis=2, concat_axis=1, tiled=True
