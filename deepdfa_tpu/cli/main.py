@@ -549,6 +549,10 @@ def _combined_setup(args, cfg):
     sp_variant = getattr(args, "sp_variant", "ring")
     attn_impl = getattr(args, "attn_impl", "auto")
     if arch == "t5":
+        if getattr(args, "remat_policy", "full") != "full":
+            raise SystemExit(
+                "--remat-policy attn_saved is roberta-only (the t5 "
+                "encoder has no selective-save knob yet)")
         if args.encoder == "codet5-base":
             enc_cfg = t5m.T5Config(
                 dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl
@@ -565,9 +569,11 @@ def _combined_setup(args, cfg):
             use_graph=use_graph,
         )
         return tok, enc_cfg, mcfg, t5m.params_from_hf_torch
+    remat_policy = getattr(args, "remat_policy", "full")
     if args.encoder == "codebert-base":
         enc_cfg = TransformerConfig(
-            dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl
+            dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl,
+            remat_policy=remat_policy,
         )
     else:
         enc_cfg = TransformerConfig.tiny(
@@ -575,6 +581,7 @@ def _combined_setup(args, cfg):
             max_position_embeddings=args.max_length + 4,
             sp_variant=sp_variant,
             attn_impl=attn_impl,
+            remat_policy=remat_policy,
         )
     mcfg = cmb.CombinedConfig(
         encoder=enc_cfg,
@@ -1368,6 +1375,16 @@ def main(argv=None) -> None:
                         "(measured +22%% over xla on roberta, "
                         "docs/DESIGN.md); t5 passes its relative-position "
                         "bias as the kernel's additive-bias operand")
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "attn_saved"],
+                   help="roberta remat granularity: full recomputes the "
+                        "whole layer in backward; attn_saved keeps each "
+                        "layer's attention output (+~[B,T,D] HBM/layer), "
+                        "which skips re-running attention in backward on "
+                        "the FLASH lowering (its custom-vjp outputs carry "
+                        "the saved names; the xla lowering still replays "
+                        "its softmax for dq/dk/dv, so there it mostly "
+                        "trades memory for little)")
     p.add_argument("--no-graph", action="store_true")
     p.add_argument("--graph-checkpoint", default=None,
                    help="run name or checkpoints dir of a pretrained "

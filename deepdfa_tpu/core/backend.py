@@ -207,10 +207,54 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     from deepdfa_tpu.core import paths
 
     try:
-        cache = path or str(paths.storage_root() / "compile_cache")
+        parent = paths.storage_root() / "compile_cache"
+        cache = path or str(parent / _host_fingerprint())
         os.makedirs(cache, exist_ok=True)
+        if path is None:
+            # one-time sweep: loose files directly under the legacy
+            # flat dir predate host-fingerprinting and may hold AOT
+            # executables for another host's ISA (see _host_fingerprint)
+            # — retire them so no older code path can load one
+            for name in os.listdir(parent):
+                f = parent / name
+                if f.is_file():
+                    try:
+                        f.unlink()
+                    except OSError:
+                        pass
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # unsupported jax version / read-only fs
         return None
     return cache
+
+
+def _host_fingerprint() -> str:
+    """Cache-dir discriminator for the host's CPU feature set.
+
+    XLA:CPU AOT executables bake in the compile host's ISA extensions,
+    and the cache key does NOT include them — an artifact cached on one
+    fleet machine and loaded on another logs 'Machine type used for
+    XLA:CPU compilation doesn't match ... could lead to execution errors
+    such as SIGILL' and can mis-execute (observed as a one-off wrong
+    beam-search score in the slow test lane). Scoping the cache per CPU
+    signature removes the cross-host reuse; TPU executables are
+    host-independent so the extra partitioning only costs re-compiles
+    after a container lands on new silicon.
+    """
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(flags.encode())\
+                        .hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha256(
+        f"{platform.machine()}-{platform.processor()}".encode()
+    ).hexdigest()[:16]

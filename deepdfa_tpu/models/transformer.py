@@ -63,6 +63,14 @@ class TransformerConfig:
     # else the XLA einsum path; "xla"/"flash" force one. Only the
     # sp_axis=None branch is affected (ring/ulysses own the sp seam).
     attn_impl: str = "auto"
+    # remat granularity when remat=True: "full" recomputes the whole
+    # layer in backward; "attn_saved" saves each layer's attention
+    # context by name (+~[B,T,D] HBM per layer). With the flash lowering
+    # the kernel's custom-vjp outputs (ctx + lse) carry the names, so
+    # backward skips re-running the attention kernel entirely; with the
+    # xla lowering only the downstream projection recompute is saved
+    # (its softmax still replays for dq/dk/dv).
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -268,6 +276,9 @@ def encoder_layer(
             q, k, v, attn_mask, dropout_rate=cfg.dropout_rate, dropout_key=k3
         )
 
+    from jax.ad_checkpoint import checkpoint_name
+
+    ctx = checkpoint_name(ctx, "attn_ctx")
     out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"])
     if tp_axis is not None:
         out = region_end(out, tp_axis)
@@ -325,7 +336,14 @@ def encode(
             )
 
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        if getattr(cfg, "remat_policy", "full") == "attn_saved":
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "attn_ctx", "attn_lse"),
+            )
+        else:
+            layer_fn = jax.checkpoint(layer_fn)
 
     xs = layers if dropout_key is None else (layers, jax.random.split(dropout_key, n_layers))
     x, _ = jax.lax.scan(lambda x, inp: (layer_fn(x, inp), None), x, xs)

@@ -565,6 +565,15 @@ def _flash(p: _Params, q, k, v, mask_i32, seed, bits, bias):
 
 def _flash_fwd(p: _Params, q, k, v, mask_i32, seed, bits, bias):
     out, lse = _fwd_call(p, q, k, v, mask_i32, seed, bits, bias)
+    # named for selective rematerialization: when the enclosing layer is
+    # checkpointed with save_only_these_names("attn_ctx", "attn_lse"),
+    # the backward replay reuses these instead of re-running the fwd
+    # kernel (the custom-vjp residuals below are then assembled from
+    # saved/cheap values only) — TransformerConfig.remat_policy
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_ctx")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, mask_i32, seed, bits, bias, out, lse)
 
 

@@ -130,6 +130,7 @@ def _measure(args, enc, label: str) -> dict:
     result = {
         "attn_impl": label,
         "remat": enc.remat,
+        "remat_policy": getattr(enc, "remat_policy", "full"),
         "value": round(value, 2),
         "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC, 2),
         "best_examples_per_sec": round(max(rates), 2),
@@ -151,7 +152,11 @@ def _measure(args, enc, label: str) -> dict:
             # fwd kernel 2 (QK^T, PV), dq 3 (S, dP, dS@K), dkv 4
             # (S, dP, dV, dK), plus a second fwd under remat. Recorded
             # so the adjustment is auditable.
-            units = 9 + (2 if enc.remat else 0)
+            # the second fwd-kernel run exists only under FULL-layer
+            # remat; the attn_saved policy reuses the named outputs
+            full_remat = (enc.remat
+                          and getattr(enc, "remat_policy", "full") == "full")
+            units = 9 + (2 if full_remat else 0)
             if args.arch == "t5":
                 units += 2  # dbias kernel: S and dP recomputes
             add = (enc.num_layers * enc.num_heads * units
@@ -187,6 +192,11 @@ def main() -> None:
                     choices=["auto", "xla", "flash"],
                     help="force one attention lowering instead of the "
                     "TPU A/B sweep")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "attn_saved"],
+                    help="remat granularity for a forced --attn run "
+                    "(the sweep covers both; this makes the winning "
+                    "variant reproducible in isolation)")
     ap.add_argument("--arch", default="roberta", choices=["roberta", "t5"],
                     help="combined architecture: roberta (LineVul-style, "
                     "codebert geometry) or t5 (CodeT5-style defect model, "
@@ -232,14 +242,19 @@ def main() -> None:
     # pallas kernel does not lower on CPU)
     selfcheck = None
     if args.attn in ("xla", "flash"):
-        plans = [(args.attn, enc.remat)]
+        plans = [(args.attn, enc.remat, args.remat_policy)]
     elif platform == "tpu" and not args.tiny:
-        plans = [("xla", True), ("flash", True), ("flash", False)]
+        plans = [("xla", True, "full"), ("flash", True, "full"),
+                 ("flash", True, "attn_saved"), ("flash", False, "full")]
     else:
-        plans = [("xla", enc.remat)]
+        plans = [("xla", enc.remat, "full")]
+    if args.arch == "t5":
+        # T5Config has no remat_policy knob (the selective-save names
+        # live on the roberta layer); keep its sweep to the full policy
+        plans = [p for p in plans if p[2] == "full"]
 
     variants = []
-    for impl, remat in plans:
+    for impl, remat, policy in plans:
         if impl == "flash":
             if selfcheck is None:
                 try:
@@ -250,6 +265,8 @@ def main() -> None:
             if not selfcheck["ok"]:
                 continue  # never bench a kernel whose RNG failed checks
         ec = dataclasses.replace(enc, attn_impl=impl, remat=remat)
+        if policy != "full":
+            ec = dataclasses.replace(ec, remat_policy=policy)
         try:
             variants.append(_measure(args, ec, impl))
         except Exception as e:
@@ -261,7 +278,7 @@ def main() -> None:
                              ("hbm", "memory", "oom", "exceed", "mosaic",
                               "error:"))][:8]
             variants.append({
-                "attn_impl": impl, "remat": remat,
+                "attn_impl": impl, "remat": remat, "remat_policy": policy,
                 "error": f"{type(e).__name__}: {e}"[:300],
                 "error_detail": detail,
             })
