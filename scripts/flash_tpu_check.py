@@ -160,10 +160,34 @@ def main() -> None:
         e = _err(out, ref)
         return {"fwd_err_vs_oracle": e, "ok": e < tol}
 
+    def remat_policies():
+        # attn_saved must be a pure what-is-saved change: grads through
+        # a checkpointed flash call are identical whether the backward
+        # replays the kernel (full) or reuses the named outputs
+        from jax.ad_checkpoint import checkpoint_name
+
+        def attn(x):
+            out = flash_attention(x, x, x, mask)
+            return checkpoint_name(out, "attn_ctx")
+
+        def loss(policy):
+            fn = jax.checkpoint(attn, policy=policy) if policy else \
+                jax.checkpoint(attn)
+            return jax.jit(jax.grad(
+                lambda x: jnp.sum(fn(x).astype(jnp.float32) ** 2)))
+
+        g_full = np.asarray(loss(None)(q), np.float32)
+        pol = jax.checkpoint_policies.save_only_these_names(
+            "attn_ctx", "attn_lse")
+        g_sel = np.asarray(loss(pol)(q), np.float32)
+        e = float(np.abs(g_full - g_sel).max())
+        return {"grad_diff_full_vs_attn_saved": e, "ok": e == 0.0}
+
     run("encoder", enc)
     run("t5_encoder", t5_enc)
     run("decoder_self_causal", dec_self)
     run("decoder_cross_rect", dec_cross)
+    run("remat_policy_equivalence", remat_policies)
     record["ok"] = all(
         c.get("ok") for c in record["checks"].values())
 
