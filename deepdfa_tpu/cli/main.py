@@ -548,19 +548,17 @@ def _combined_setup(args, cfg):
     use_graph = not getattr(args, "no_graph", False)
     sp_variant = getattr(args, "sp_variant", "ring")
     attn_impl = getattr(args, "attn_impl", "auto")
+    remat_policy = getattr(args, "remat_policy", "full")
     if arch == "t5":
-        if getattr(args, "remat_policy", "full") != "full":
-            raise SystemExit(
-                "--remat-policy attn_saved is roberta-only (the t5 "
-                "encoder has no selective-save knob yet)")
         if args.encoder == "codet5-base":
             enc_cfg = t5m.T5Config(
-                dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl
+                dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl,
+                remat_policy=remat_policy,
             )
         else:
             enc_cfg = t5m.T5Config.tiny(
                 vocab_size=tok.vocab_size, sp_variant=sp_variant,
-                attn_impl=attn_impl,
+                attn_impl=attn_impl, remat_policy=remat_policy,
             )
         mcfg = t5m.DefectConfig(
             encoder=enc_cfg,
@@ -569,7 +567,6 @@ def _combined_setup(args, cfg):
             use_graph=use_graph,
         )
         return tok, enc_cfg, mcfg, t5m.params_from_hf_torch
-    remat_policy = getattr(args, "remat_policy", "full")
     if args.encoder == "codebert-base":
         enc_cfg = TransformerConfig(
             dtype="bfloat16", sp_variant=sp_variant, attn_impl=attn_impl,
@@ -1377,7 +1374,7 @@ def main(argv=None) -> None:
                         "bias as the kernel's additive-bias operand")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "attn_saved"],
-                   help="roberta remat granularity: full recomputes the "
+                   help="remat granularity, both archs: full recomputes the "
                         "whole layer in backward; attn_saved keeps each "
                         "layer's attention output (+~[B,T,D] HBM/layer), "
                         "which skips re-running attention in backward on "

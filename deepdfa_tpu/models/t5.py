@@ -53,6 +53,10 @@ class T5Config:
     #: the relative-position bias as an additive operand (dbias via its
     #: batch-accumulating backward kernel)
     attn_impl: str = "auto"
+    #: remat granularity when remat=True — "full" | "attn_saved", same
+    #: semantics as TransformerConfig.remat_policy (the flash kernel's
+    #: named outputs make attn_saved skip its backward re-run)
+    remat_policy: str = "full"
 
     @classmethod
     def tiny(cls, **kw) -> "T5Config":
@@ -269,6 +273,9 @@ def encoder_layer(
             )
         else:
             ctx = _attention(q, k, v, attn_mask, bias)
+    from jax.ad_checkpoint import checkpoint_name
+
+    ctx = checkpoint_name(ctx, "attn_ctx")
     out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
     if tp_axis is not None:
         out = region_end(out, tp_axis)
@@ -332,7 +339,9 @@ def encode(
             tp_axis=tp_axis, sp_axis=sp_axis,
         )
 
-    fn = jax.checkpoint(layer) if cfg.remat else layer
+    from deepdfa_tpu.models.transformer import remat_wrap
+
+    fn = remat_wrap(cfg, layer)
     n_layers = params["layers"]["wq"].shape[0]
     keys = (
         jax.random.split(k_layers, n_layers) if k_layers is not None else None

@@ -276,6 +276,9 @@ def decode_train(
             )
         else:
             ctx = _attend(q, k, v, self_mask, bias)
+        from jax.ad_checkpoint import checkpoint_name
+
+        ctx = checkpoint_name(ctx, "attn_ctx")
         out = jnp.einsum("bhtk,hkd->btd", ctx, lp["wo"].astype(dt))
         x = x + _dropout(out, ecfg.dropout_rate, k1)
 
@@ -289,6 +292,7 @@ def decode_train(
             )
         else:
             ctx = _attend(q, k, v, cross_mask, None)
+        ctx = checkpoint_name(ctx, "attn_ctx")
         out = jnp.einsum("bhtk,hkd->btd", ctx, lp["co"].astype(dt))
         x = x + _dropout(out, ecfg.dropout_rate, k2)
 
@@ -297,7 +301,9 @@ def decode_train(
         h = jnp.einsum("btf,fd->btd", h, lp["wo_ffn"].astype(dt))
         return x + _dropout(h, ecfg.dropout_rate, k3)
 
-    fn = jax.checkpoint(layer) if ecfg.remat else layer
+    from deepdfa_tpu.models.transformer import remat_wrap
+
+    fn = remat_wrap(ecfg, layer)
     n_layers = dp["layers"]["wq"].shape[0]
     keys = jax.random.split(k_layers, n_layers) if k_layers is not None else None
     if keys is None:

@@ -164,6 +164,23 @@ def _resolve_attn_impl(cfg, T: int, head_dim: int, *, Tk: int | None = None,
         biased=biased, interpret_hint=_flash_interpret())
 
 
+def remat_wrap(cfg, layer_fn):
+    """Apply cfg.remat / cfg.remat_policy to a layer function — the one
+    definition of the selective-save policy (saved names: the flash
+    kernel's custom-vjp outputs + the xla lowerings' checkpoint_name'd
+    attention contexts). Shared by both encoder families and the GPipe
+    stage runner."""
+    if not getattr(cfg, "remat", True):
+        return layer_fn
+    if getattr(cfg, "remat_policy", "full") == "attn_saved":
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_ctx", "attn_lse"),
+        )
+    return jax.checkpoint(layer_fn)
+
+
 def _layer_norm(x, scale, bias, eps):
     """LayerNorm in float32 regardless of activation dtype (bf16-safe)."""
     dt = x.dtype
@@ -335,15 +352,7 @@ def encode(
                 cfg, lp, x, attn_mask, key, sp_axis=sp_axis, tp_axis=tp_axis
             )
 
-    if cfg.remat:
-        if getattr(cfg, "remat_policy", "full") == "attn_saved":
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "attn_ctx", "attn_lse"),
-            )
-        else:
-            layer_fn = jax.checkpoint(layer_fn)
+    layer_fn = remat_wrap(cfg, layer_fn)
 
     xs = layers if dropout_key is None else (layers, jax.random.split(dropout_key, n_layers))
     x, _ = jax.lax.scan(lambda x, inp: (layer_fn(x, inp), None), x, xs)

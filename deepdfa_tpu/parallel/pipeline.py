@@ -142,11 +142,12 @@ def _gpipe_schedule(
     return outputs.reshape(b_total, seq, -1)
 
 
-def _stage_block_fn(layers_local: dict, dropout_key, remat: bool, layer_call):
+def _stage_block_fn(layers_local: dict, dropout_key, cfg, layer_call):
     """The per-stage layer-block runner shared by both encoder families:
     microbatch/stage dropout-key decorrelation (each stage holds
     different global layers; an identical key would draw identical masks
-    on every stage), per-layer key split, optional remat, lax.scan over
+    on every stage), per-layer key split, cfg-driven remat (incl.
+    remat_policy — models/transformer.py remat_wrap), lax.scan over
     this stage's layer block. layer_call(lp, x, mask_m, key) -> x."""
     n_local = jax.tree.leaves(layers_local)[0].shape[0]
 
@@ -176,7 +177,9 @@ def _stage_block_fn(layers_local: dict, dropout_key, remat: bool, layer_call):
                 None,
             )
 
-        fn = jax.checkpoint(layer_fn) if remat else layer_fn
+        from deepdfa_tpu.models.transformer import remat_wrap
+
+        fn = remat_wrap(cfg, layer_fn)
         x, _ = jax.lax.scan(fn, x, (layers_local, keys))
         return x
 
@@ -226,7 +229,7 @@ def pipeline_stage_forward(
         return embed(cfg, rest_p, ids_t, position_offset, ekey)
 
     block_fn = _stage_block_fn(
-        layers_local, dropout_key, cfg.remat,
+        layers_local, dropout_key, cfg,
         lambda lp, h, mask_m, k: encoder_layer(
             cfg, lp, h, mask_m, k, sp_axis=sp_axis, tp_axis=tp_axis
         ),
@@ -283,7 +286,7 @@ def t5_pipeline_stage_forward(
         return _dropout(x, cfg.dropout_rate, ekey)
 
     block_fn = _stage_block_fn(
-        layers_local, dropout_key, cfg.remat,
+        layers_local, dropout_key, cfg,
         lambda lp, h, mask_m, k: t5m.encoder_layer(
             cfg, lp, h, mask_m, k, bias, bias_fn,
             tp_axis=tp_axis, sp_axis=sp_axis,

@@ -248,10 +248,6 @@ def main() -> None:
                  ("flash", True, "attn_saved"), ("flash", False, "full")]
     else:
         plans = [("xla", enc.remat, "full")]
-    if args.arch == "t5":
-        # T5Config has no remat_policy knob (the selective-save names
-        # live on the roberta layer); keep its sweep to the full policy
-        plans = [p for p in plans if p[2] == "full"]
 
     variants = []
     for impl, remat, policy in plans:

@@ -7,13 +7,15 @@ show: (a) the Mosaic lowering of each path actually compiles and runs
 (the first roberta attempt surfaced real lowering constraints the
 interpreter accepts — block tiling rules, the 2-value prng_seed cap),
 and (b) the hardware PRNG stream behaves (the interpreter stubs it to
-zeros). This script drives all four kernel configurations on the
+zeros). This script drives every kernel configuration on the
 default backend and writes one JSON record:
 
   encoder     : square, scaled, kv-masked, probs-dropout (roberta)
   t5-encoder  : square, unscaled, additive [H,T,T] bias (+dbias grad)
   decoder-self: causal + bias (+ the dead-block skip)
   decoder-cross: rectangular Tq != Tk
+  remat-policy: grads bit-identical between full-layer remat and the
+                attn_saved selective policy
 
 Each check compares fwd (and grads where cheap) against the XLA oracle
 on the chip itself. Invoked by scripts/tpu_watchdog.py in every healthy
