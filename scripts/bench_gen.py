@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Seq2seq (CodeT5 run_gen-style) training-step benchmark, xla vs flash.
+
+The defect-path benches (bench_combined.py) cover the encoders; this
+measures the teacher-forced encoder+decoder step the generation
+trainers run (train/gen_loop.py) — the workload the decoder extensions
+of the flash kernel (causal self-attention with dead-block skipping,
+rectangular cross-attention) exist for. codet5-base geometry, 256
+source / 128 target tokens (the CONCODE/summarize class of shapes).
+No reference baseline exists for this step in BASELINE.md (the paper
+reports defect-path costs only), so the record carries absolute ex/s
+plus the A/B delta rather than a vs_baseline field.
+
+    python scripts/bench_gen.py [--attn auto|xla|flash] [--out ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _measure(args, ecfg) -> dict:
+    """Time the REAL GenTrainer step (train/gen_loop.py) — the published
+    number must be the trainer users run, not a reconstruction."""
+    import jax
+    import numpy as np
+
+    from deepdfa_tpu.core import Config
+    from deepdfa_tpu.data import gen_data
+    from deepdfa_tpu.models import t5_gen as t5g
+    from deepdfa_tpu.train.gen_loop import GenTrainer
+
+    gcfg = t5g.GenConfig(encoder=ecfg, max_target_length=args.tgt)
+    rng = np.random.default_rng(0)
+    src = rng.integers(3, ecfg.vocab_size - 1, (args.rows, args.src))
+    tgt = rng.integers(3, ecfg.vocab_size - 1, (args.rows, args.tgt))
+    batch = gen_data.batches_of(
+        src.astype(np.int32), tgt.astype(np.int32),
+        num_shards=1, rows_per_shard=args.rows)[0]
+
+    trainer = GenTrainer(Config(), gcfg)
+    state = trainer.init_state(seed=0)
+    key = jax.random.key(0)
+
+    t0 = time.perf_counter()
+    state, loss = trainer.train_step(state, batch, key)
+    float(loss)  # fetch-bounded (tunnel: block_until_ready can lie)
+    compile_s = time.perf_counter() - t0
+
+    window = max(1, int(os.environ.get("DEEPDFA_BENCH_WINDOW", 4)))
+    rates = []
+    r = 0
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(window):
+            state, loss = trainer.train_step(
+                state, batch, jax.random.fold_in(key, r))
+            r += 1
+        float(loss)
+        rates.append(args.rows * window / (time.perf_counter() - t0))
+
+    return {
+        "attn_impl": ecfg.attn_impl,
+        "value": round(float(np.median(rates)), 2),
+        "best_examples_per_sec": round(max(rates), 2),
+        "compile_seconds": round(compile_s, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=32)
+    ap.add_argument("--src", type=int, default=256)
+    ap.add_argument("--tgt", type=int, default=128)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--attn", default=None, choices=["auto", "xla", "flash"])
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from deepdfa_tpu.core.backend import (
+        apply_platform_override,
+        enable_compile_cache,
+    )
+
+    apply_platform_override()
+    enable_compile_cache()
+    import dataclasses
+
+    import jax
+
+    from deepdfa_tpu.models.t5 import T5Config
+
+    platform = jax.devices()[0].platform
+    enc = T5Config.tiny(vocab_size=512) if args.tiny else T5Config()
+    enc = dataclasses.replace(
+        enc, dtype="bfloat16" if platform == "tpu" else "float32")
+
+    if args.attn:
+        plans = [args.attn]
+    elif platform == "tpu" and not args.tiny:
+        plans = ["xla", "flash"]
+    else:
+        plans = ["xla"]
+
+    variants = []
+    for impl in plans:
+        try:
+            variants.append(
+                _measure(args, dataclasses.replace(enc, attn_impl=impl)))
+        except Exception as e:
+            variants.append({"attn_impl": impl,
+                             "error": f"{type(e).__name__}: {e}"[:300]})
+
+    scored = [v for v in variants if "value" in v]
+    if not scored:
+        print(json.dumps({"metric": "gen_train_examples_per_sec",
+                          "error": "no variant completed",
+                          "variants": variants}), flush=True)
+        raise SystemExit(1)
+    best = max(scored, key=lambda v: v["value"])
+    result = {
+        "metric": "gen_train_examples_per_sec",
+        "unit": "examples/s",
+        "platform": platform,
+        "rows": args.rows,
+        "src": args.src,
+        "tgt": args.tgt,
+        "encoder": "tiny" if args.tiny else "codet5-base(12x768)",
+        "dtype": enc.dtype,
+        **best,
+    }
+    if len(variants) > 1:
+        result["variants"] = variants
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

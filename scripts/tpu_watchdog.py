@@ -161,6 +161,23 @@ def run_bench_suite(platform: str) -> dict:
         except subprocess.TimeoutExpired:
             record[f"{key}_error"] = f"bench_combined.py {arch} exceeded {budget}s"
 
+    # gen-path A/B (seq2seq encoder+decoder step — the decoder flash
+    # extensions' workload); bounded small since it has no baseline row
+    gen_out = os.path.join(REPO, "docs", "bench_gen_tpu.json")
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_gen.py"),
+             "--out", gen_out],
+            capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+        )
+        if res.returncode == 0 and os.path.exists(gen_out):
+            with open(gen_out) as f:
+                record["bench_gen"] = json.load(f)
+        else:
+            record["bench_gen_error"] = (res.stderr or res.stdout)[-400:]
+    except subprocess.TimeoutExpired:
+        record["bench_gen_error"] = "bench_gen.py exceeded 900s"
+
     # LAST (the recurring headline captures above take priority in a
     # volatile window): one-shot flash-vs-xla loss-descent A/B. Skip only
     # when a COMPLETE TPU record exists — a degraded/partial file (the
@@ -256,6 +273,7 @@ def main() -> None:
                     os.path.join(REPO, "docs", "tpu_watchdog.out"),
                     os.path.join(REPO, "docs", "bench_combined_tpu.json"),
                     os.path.join(REPO, "docs", "bench_combined_t5_tpu.json"),
+                    os.path.join(REPO, "docs", "bench_gen_tpu.json"),
                     os.path.join(REPO, "docs", "train_descent_ab.json"),
                 ],
                 "Capture TPU bench from watchdog healthy-window "
