@@ -530,6 +530,32 @@ def test_remat_policy_preserves_numerics(rng):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_resolve_impl_shapes_and_bias_cap():
+    """The single resolution source of truth: tileability per axis, the
+    biased VMEM sequence cap, forced-flash raising vs auto fallback."""
+    from deepdfa_tpu.nn.flash_attention import flash_shape_ok, resolve_impl
+
+    assert flash_shape_ok(512, 64)
+    assert flash_shape_ok(1024, 64)          # tiles: 1024 % 512 == 0
+    assert not flash_shape_ok(640, 64)       # does not tile
+    assert not flash_shape_ok(512, 256)      # head_dim over the cap
+    assert flash_shape_ok(128, 16, Tk=256)   # rectangular
+    assert not flash_shape_ok(128, 16, Tk=640)
+    # biased: the [block_q, Tk] bias strip caps the sequence at 4096
+    assert flash_shape_ok(4096, 64, biased=True)
+    assert not flash_shape_ok(8192, 64, biased=True)
+    assert flash_shape_ok(8192, 64, biased=False)  # unbiased streams on
+
+    # forced flash raises where auto falls back
+    assert resolve_impl("auto", 640, 64) == "xla"
+    assert resolve_impl("auto", 8192, 64, biased=True) == "xla"
+    with pytest.raises(ValueError, match="cannot tile"):
+        resolve_impl("flash", 8192, 64, biased=True)
+    assert resolve_impl("flash", 8192, 64, interpret_hint=True) == "flash"
+    with pytest.raises(ValueError, match="unknown attn_impl"):
+        resolve_impl("bogus", 512, 64)
+
+
 def test_auto_resolution_cpu_is_xla():
     """attn_impl=auto must NOT pick the Pallas kernel on a CPU backend
     (it would fail to lower); the env hook opts tests in explicitly."""
