@@ -20,8 +20,12 @@ jax.devices() blocks), and in round 2 a single 240s health probe timed
 out and the bench silently fell back to CPU. Hardened protocol (see
 main()): healthy probe -> measure TPU; failed probe -> measure CPU FIRST
 so a complete record is emitted within ~15 minutes, then spend remaining
-budget on one bounded TPU attempt anyway and emit an upgraded line if it
-lands. Every subprocess runs under a hard timeout against one total
+budget on probe-gated retries (short probes spaced across the window;
+a healthy one unlocks a full TPU measurement) and emit an upgraded line
+if one lands. A CPU-fallback record embeds the newest committed
+watchdog TPU capture under ``last_healthy_tpu`` so the driver artifact
+carries dated TPU evidence even when its own window loses the tunnel
+lottery. Every subprocess runs under a hard timeout against one total
 wall-clock deadline (DEEPDFA_BENCH_TOTAL_BUDGET, default 3300s); the
 compile-cache-enabled probe makes a once-successful probe a cache hit
 forever after; the train step is measured in a SEPARATE bounded child
@@ -46,7 +50,12 @@ BASELINE_GRAPHS_PER_SEC = 1000.0 / 4.6  # reference: 4.6 ms/example on RTX 3090
 BASELINE_TRAIN_GRAPHS_PER_SEC = 25 * 20_000 / 540.0
 _CHILD_TAG = "BENCHJSON:"
 
-PROBE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_PROBE_TIMEOUT", 300))
+#: 120s, not 300s: in a HEALTHY window the probe's tiny jit is a compile
+#: -cache hit and completes in <30s; 300s only bought longer waits on a
+#: wedged service (r1-r4 all burned the full budget exactly once). The
+#: saved time funds RETRIES spread across the driver window instead.
+PROBE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_PROBE_TIMEOUT", 120))
+PROBE_RETRIES = int(os.environ.get("DEEPDFA_BENCH_PROBE_RETRIES", 3))
 CHILD_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_CHILD_TIMEOUT", 1500))
 TRAIN_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_TRAIN_TIMEOUT", 1200))
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
@@ -61,7 +70,9 @@ _PEAK_FLOPS = {
 
 
 def _mfu_fields(flops_per_example: float, graphs_per_sec: float,
-                platform: str, dtype: str) -> dict:
+                platform: str, dtype: str,
+                bytes_per_example: float = 0.0,
+                roofline: bool = False) -> dict:
     model_fps = flops_per_example * graphs_per_sec
     peak = _PEAK_FLOPS.get((platform, dtype))
     out = {
@@ -69,6 +80,11 @@ def _mfu_fields(flops_per_example: float, graphs_per_sec: float,
         "model_flops_per_sec": round(model_fps, 1),
         "mfu": round(model_fps / peak, 6) if peak else None,
     }
+    if bytes_per_example > 0:
+        out["bytes_per_example"] = round(bytes_per_example, 1)
+        out["bytes_per_sec"] = round(bytes_per_example * graphs_per_sec, 1)
+        out["arithmetic_intensity_flops_per_byte"] = round(
+            flops_per_example / bytes_per_example, 3)
     if platform == "tpu":
         # spec-peak MFU misleads on a shared/tunneled chip: record the
         # MEASURED dense-matmul ceiling next to it (eval/profiling.py;
@@ -76,6 +92,14 @@ def _mfu_fields(flops_per_example: float, graphs_per_sec: float,
         from deepdfa_tpu.eval.profiling import ceiling_fields
 
         out.update(ceiling_fields(model_fps))
+        if roofline and bytes_per_example > 0:
+            # bandwidth side of the roofline (docs/roofline.md): the
+            # GGNN step is gather/scatter traffic, so achieved bytes/s
+            # vs the measured stream AND gather ceilings is the MFU
+            # defense the flops-side number cannot give
+            from deepdfa_tpu.eval.profiling import roofline_fields
+
+            out.update(roofline_fields(bytes_per_example * graphs_per_sec))
     return out
 
 
@@ -199,14 +223,18 @@ def run_measurement(platform: str) -> dict:
         "size_dist": "bigvul_lognormal(median=14,sigma=1.2,max=500)",
     }
     try:
-        flops = compiled_cost(
+        cost = compiled_cost(
             lambda p, b: jax.nn.sigmoid(model.apply(p, b)),
             params, batches[0],
-        )["flops"]
+        )
+        flops = cost["flops"]
         if flops <= 0:  # cost analysis unavailable != "MFU is zero"
             raise RuntimeError("XLA cost analysis returned no flops")
-        per_ex = flops / max(int(np.asarray(batches[0].graph_mask).sum()), 1)
-        result.update(_mfu_fields(per_ex, value, result["platform"], dtype))
+        n_b = max(int(np.asarray(batches[0].graph_mask).sum()), 1)
+        result.update(_mfu_fields(
+            flops / n_b, value, result["platform"], dtype,
+            bytes_per_example=cost.get("bytes_accessed", 0.0) / n_b,
+        ))
     except Exception as e:  # cost analysis must never cost the headline
         result["mfu_error"] = f"{type(e).__name__}: {e}"[:200]
     return result
@@ -284,15 +312,18 @@ def run_train_measurement(platform: str) -> dict:
         "train_n_examples": n_examples,
     }
     try:
-        flops = compiled_cost(
+        cost = compiled_cost(
             lambda s, b: trainer.train_step(s, b), state, batches[0]
-        )["flops"]
+        )
+        flops = cost["flops"]
         if flops <= 0:
             raise RuntimeError("XLA cost analysis returned no flops")
-        per_ex = flops / max(
-            int(np.asarray(batches[0].graph_mask).sum()), 1
+        n_b = max(int(np.asarray(batches[0].graph_mask).sum()), 1)
+        mfu = _mfu_fields(
+            flops / n_b, value, result["train_platform"], "float32",
+            bytes_per_example=cost.get("bytes_accessed", 0.0) / n_b,
+            roofline=True,  # the train MFU is the number under defense
         )
-        mfu = _mfu_fields(per_ex, value, result["train_platform"], "float32")
         result.update({f"train_{k}": v for k, v in mfu.items()})
     except Exception as e:
         result["train_mfu_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -346,6 +377,55 @@ def _measure_full(
     return result
 
 
+def _latest_watchdog_capture() -> dict | None:
+    """Most recent committed watchdog TPU capture (BENCH_TPU_*.json),
+    summarized for embedding in a CPU-fallback record.
+
+    The round-4 failure mode this closes: the driver's own window hit a
+    wedged tunnel four rounds running, so the official BENCH_r*.json
+    showed a CPU number while hours-fresher TPU evidence sat in sibling
+    artifacts. Embedding the newest TPU capture (with its timestamp)
+    under ``last_healthy_tpu`` makes the driver artifact self-contained
+    evidence either way.
+    """
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best: tuple[str, str, dict] | None = None
+    for path in glob.glob(os.path.join(here, "BENCH_TPU_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # files are hand-committable: tolerate any shape that isn't the
+        # expected dict-with-dict-bench (e.g. a null "bench" key) — this
+        # helper runs inside emit() and must never cost the record
+        if not isinstance(rec, dict) or not isinstance(rec.get("bench"), dict):
+            continue
+        if rec["bench"].get("platform") != "tpu":
+            continue
+        stamp = str(rec.get("captured_at", ""))
+        if best is None or stamp > best[0]:
+            best = (stamp, os.path.basename(path), rec)
+    if best is None:
+        return None
+    stamp, name, rec = best
+    out: dict = {"captured_at": stamp, "artifact": name,
+                 "bench": rec.get("bench")}
+    for key in ("bench_combined", "bench_combined_t5", "bench_gen",
+                "bench_localize"):
+        sub = rec.get(key)
+        if isinstance(sub, dict):
+            out[key] = {
+                k: sub[k]
+                for k in ("metric", "value", "unit", "vs_baseline",
+                          "platform", "rows", "mfu", "attn_impl")
+                if k in sub
+            }
+    return out
+
+
 def main() -> None:
     """Emission protocol: every completed measurement prints its own full
     JSON line, best-last — the driver records the LAST line, so a CPU
@@ -354,9 +434,11 @@ def main() -> None:
 
     Order: healthy probe -> measure TPU directly. Failed probe -> measure
     CPU FIRST (bounded, lands a record within ~15 min), then spend the
-    remaining budget on one bounded TPU attempt anyway (a wedge costs
-    time, not the already-emitted record) and print the upgraded line if
-    it succeeds.
+    remaining budget on PROBE-GATED retries: short (120s) probes spread
+    across the window, with the expensive measurement children launched
+    only after a probe succeeds — a wedge costs one cheap probe per
+    retry, never a 1500s child timeout. Any CPU-fallback record embeds
+    the newest committed watchdog TPU capture (``last_healthy_tpu``).
     """
     from deepdfa_tpu.core.backend import cpu_pinned, probe_default_backend
 
@@ -378,6 +460,13 @@ def main() -> None:
                 result["fallback_from"] = "; ".join(errors)
             else:
                 result["warnings"] = "; ".join(errors)
+        if result.get("platform") != "tpu" and not cpu_pinned():
+            try:
+                healthy = _latest_watchdog_capture()
+            except Exception:  # must never cost the record itself
+                healthy = None
+            if healthy is not None:
+                result["last_healthy_tpu"] = healthy
         print(json.dumps(result), flush=True)
 
     if cpu_pinned():
@@ -402,20 +491,46 @@ def main() -> None:
     else:
         errors.append("probe skipped: total budget too small")
 
-    # CPU fallback FIRST so a record exists early, then a bounded
-    # second-chance TPU attempt with whatever budget remains (a wedge
-    # costs time, not the already-emitted record)
+    # CPU fallback FIRST so a record exists early, then PROBE-GATED
+    # retries with whatever budget remains: each retry spends a cheap
+    # 120s probe, and only a HEALTHY probe unlocks the expensive
+    # measurement children (the r4 second-chance went straight to a
+    # full child and a wedge ate 1500s of window for nothing). Probes
+    # are spaced so they sample different moments of the driver window
+    # — the tunnel wedge clears on its own schedule.
     cpu_result = _measure_full("cpu", deadline, errors)
     emit(dict(cpu_result) if cpu_result is not None else error_record())
 
-    if not default_is_cpu and time.time() < deadline - 300:
-        retry_errors: list[str] = []
-        tpu_result = _measure_full("default", deadline, retry_errors)
-        if tpu_result is not None and tpu_result.get("platform") != "cpu":
-            tpu_result["second_chance"] = True
-            if errors:
-                tpu_result["warnings"] = "; ".join(errors)
-            print(json.dumps(tpu_result), flush=True)
+    retries = 0
+    while (
+        not default_is_cpu
+        and retries < PROBE_RETRIES
+        and time.time() < deadline - 300
+    ):
+        retries += 1
+        probe_budget = min(PROBE_TIMEOUT, deadline - 180 - time.time())
+        if probe_budget < 30:
+            break
+        ok, detail = probe_default_backend(probe_budget, use_cache=False)
+        if ok and detail != "cpu":
+            retry_errors: list[str] = []
+            tpu_result = _measure_full(detail, deadline, retry_errors)
+            if tpu_result is not None and tpu_result.get("platform") != "cpu":
+                tpu_result["second_chance"] = True
+                if errors:
+                    tpu_result["warnings"] = "; ".join(errors)
+                print(json.dumps(tpu_result), flush=True)
+                return
+            errors.extend(retry_errors)
+        elif ok:
+            break  # default resolves to CPU: nothing to retry for
+        else:
+            errors.append(f"probe retry {retries}: {detail}")
+            # space the remaining probes across the window rather than
+            # burning them back-to-back against the same wedge
+            remaining = deadline - 300 - time.time()
+            if retries < PROBE_RETRIES and remaining > 240:
+                time.sleep(max(0.0, min(180.0, remaining - PROBE_TIMEOUT)))
 
 
 if __name__ == "__main__":

@@ -210,18 +210,23 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         parent = paths.storage_root() / "compile_cache"
         cache = path or str(parent / _host_fingerprint())
         os.makedirs(cache, exist_ok=True)
-        if path is None:
-            # one-time sweep: loose files directly under the legacy
-            # flat dir predate host-fingerprinting and may hold AOT
-            # executables for another host's ISA (see _host_fingerprint)
-            # — retire them so no older code path can load one
+        marker = parent / ".migrated"
+        if path is None and not marker.exists():
+            # one-time sweep (marker-guarded: without it every startup
+            # re-unlinks loose files, racing concurrent older-version
+            # processes still reading/writing them): loose files under
+            # the legacy flat dir predate host-fingerprinting and may
+            # hold AOT executables for another host's ISA (see
+            # _host_fingerprint) — retire them so no older code path
+            # can load one
             for name in os.listdir(parent):
                 f = parent / name
-                if f.is_file():
+                if f.is_file() and name != ".migrated":
                     try:
                         f.unlink()
                     except OSError:
                         pass
+            marker.touch()
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # unsupported jax version / read-only fs

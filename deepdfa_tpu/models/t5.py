@@ -134,7 +134,13 @@ def relative_position_buckets(
 
 
 def _attention(q, k, v, mask, bias):
-    """T5 attention: NO 1/sqrt(d) scaling; additive position bias."""
+    """T5 attention: NO 1/sqrt(d) scaling; additive position bias.
+
+    Deliberate divergence from HF T5 (modeling_t5.py applies
+    nn.Dropout(dropout_rate) to the softmax probs in training): no
+    attention-probs dropout here — regularization lives on the residual
+    branches (encoder_layer/decoder_layer `_dropout` calls).
+    """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) + bias[None]
     neg = jnp.finfo(s.dtype).min
     s = jnp.where(mask[:, None, None, :], s, neg)
@@ -265,8 +271,11 @@ def encoder_layer(
             from deepdfa_tpu.nn.flash_attention import flash_attention
 
             # T5 semantics: no 1/sqrt(d) scaling, additive position
-            # bias, no attention-probs dropout (dropout acts on the
-            # residual branches below — HF t5 parity, _attention above)
+            # bias. Deliberate divergence from HF T5: HF applies
+            # dropout(p=dropout_rate) to the attention probs in
+            # training; this implementation regularizes only the
+            # residual branches below (both XLA and flash paths agree,
+            # so flash-vs-xla A/Bs stay apples-to-apples).
             ctx = flash_attention(
                 q, k, v, attn_mask, scale=1.0, bias=bias,
                 interpret="tpu" if _flash_interpret() else False,

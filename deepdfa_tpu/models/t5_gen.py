@@ -243,7 +243,8 @@ def decode_train(
         if ecfg.attn_impl == "flash":
             raise ValueError(
                 f"attn_impl='flash' needs the encoder length to tile too "
-                f"(S={S}: need S<=512 or S%512==0)")
+                f"(S={S}: need S%128==0 on hardware, and S<=512 or "
+                f"S%512==0)")
         use_flash = False  # auto quietly falls back, as everywhere else
     interp = "tpu" if _flash_interpret() else False
     from deepdfa_tpu.nn.flash_attention import flash_attention

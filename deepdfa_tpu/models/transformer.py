@@ -148,7 +148,7 @@ def _flash_interpret() -> bool:
 def _flash_shape_ok(T: int, head_dim: int) -> bool:
     from deepdfa_tpu.nn.flash_attention import flash_shape_ok
 
-    return flash_shape_ok(T, head_dim)
+    return flash_shape_ok(T, head_dim, lax_alignment=_flash_interpret())
 
 
 def _resolve_attn_impl(cfg, T: int, head_dim: int, *, Tk: int | None = None,

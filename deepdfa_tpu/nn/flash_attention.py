@@ -593,14 +593,26 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_shape_ok(Tq: int, head_dim: int, Tk: int | None = None,
-                   biased: bool = False) -> bool:
+                   biased: bool = False, *,
+                   lax_alignment: bool = False) -> bool:
     """Can the kernel tile this problem? Single source of truth for every
     dispatch site (encoder/decoder/ulysses). Kernel blocks are
-    min(512, T) per axis: any T <= 512 divides, larger T must tile
-    evenly. head_dim is capped so q/k/v blocks stay VMEM-sized; biased
-    calls additionally cap the sequence (the per-program [block_q, Tk]
-    bias strip — see the VMEM envelope note in the module docstring)."""
+    min(512, T) per axis: any 128-aligned T <= 512 divides, larger T
+    must tile evenly. On hardware T must be a multiple of 128 (the TPU
+    lane width): Mosaic's block-shape rules are only validated on-chip
+    at aligned lengths (scripts/flash_tpu_check.py runs T=512), so an
+    unaligned T that the interpreter happily accepts could be a
+    compile-time crash on hardware — "auto" must never select a tiling
+    the chip hasn't been proven to take. ``lax_alignment=True`` (the
+    interpreter test hook, resolve_impl's interpret_hint) drops the
+    128-alignment requirement only — the interpreter doesn't enforce
+    Mosaic's rules and CPU tests run tiny unaligned shapes. head_dim is
+    capped so q/k/v blocks stay VMEM-sized; biased calls additionally
+    cap the sequence (the per-program [block_q, Tk] bias strip — see
+    the VMEM envelope note in the module docstring)."""
     def _axis_ok(T):
+        if not lax_alignment and T % 128:
+            return False
         return T <= 512 or T % 512 == 0
 
     if Tk is None:
@@ -626,13 +638,15 @@ def resolve_impl(attn_impl: str, Tq: int, head_dim: int, *,
     active, so flash is eligible off-TPU."""
     if attn_impl == "xla":
         return "xla"
-    ok = flash_shape_ok(Tq, head_dim, Tk, biased)
+    ok = flash_shape_ok(Tq, head_dim, Tk, biased,
+                        lax_alignment=interpret_hint)
     if attn_impl == "flash":
         if not ok:
             raise ValueError(
                 f"attn_impl='flash' cannot tile Tq={Tq}, Tk={Tk or Tq}, "
                 f"head_dim={head_dim}, biased={biased} (each T needs "
-                f"<=512 or %512==0; biased caps T at 4096)")
+                f"%128==0 on hardware, and <=512 or %512==0; biased "
+                f"caps T at 4096)")
         return "flash"
     if attn_impl != "auto":
         raise ValueError(f"unknown attn_impl {attn_impl!r}")

@@ -11,12 +11,16 @@ FLOPs/example + model FLOP/s + MFU from XLA's compiled-HLO cost
 analysis — the utilization number VERDICT r2 asked for on the 125M
 model, not just the 25k-param GGNN.
 
-On TPU this is also the attention-lowering A/B: the XLA einsum path vs
-the fused Pallas flash kernel (nn/flash_attention.py), each measured on
-the identical recipe (bf16, attention-probs dropout 0.1, remat per
-variant), plus flash with remat off (the kernel removes the [B,H,T,T]
-HBM temps that forced remat on). The headline is the best faithful
-variant; every variant's number is recorded so the choice is auditable.
+On TPU this is also a (lowering x remat-policy x batch-rows) sweep: the
+XLA einsum path anchors at rows=64 (its rows=256 scaling was measured
+flat pre-flash, docs/bench_history.json "batch_scaling_note"), and the
+fused Pallas flash kernel (nn/flash_attention.py) — same recipe
+otherwise (bf16, attention-probs dropout 0.1) — gets the larger-rows
+slots its removal of the [B,H,T,T] HBM temps makes reachable. The
+headline is the best faithful variant; every variant's number records
+its own rows, so a cross-rows comparison is explicit in the artifact,
+and a like-for-like xla-vs-flash read should compare equal-rows
+variants (or the forced --attn runs).
 Before flash is benched, a PRNG self-check pins in-kernel dropout
 determinism and keep-fraction on the real chip (the CPU interpreter
 can't: its prng_random_bits returns zeros — tests/test_flash_attention.py
@@ -84,7 +88,7 @@ def _flash_selfcheck() -> dict:
     }
 
 
-def _measure(args, enc, label: str) -> dict:
+def _measure(args, enc, label: str, rows: int | None = None) -> dict:
     """Build the combined trainer for one encoder config and time it."""
     import jax
     import numpy as np
@@ -92,7 +96,7 @@ def _measure(args, enc, label: str) -> dict:
     from deepdfa_tpu.eval.profiling import compiled_cost
 
     platform = jax.devices()[0].platform
-    n = args.rows
+    n = rows or args.rows
     from _combined_batch import build_trainer_and_batch
 
     trainer, state, batch = build_trainer_and_batch(
@@ -131,6 +135,7 @@ def _measure(args, enc, label: str) -> dict:
         "attn_impl": label,
         "remat": enc.remat,
         "remat_policy": getattr(enc, "remat_policy", "full"),
+        "rows": n,
         "value": round(value, 2),
         "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC, 2),
         "best_examples_per_sec": round(max(rates), 2),
@@ -237,20 +242,36 @@ def main() -> None:
             )
     enc = dataclasses.replace(enc, dtype=dtype)
 
-    # which lowerings to measure: explicit --attn wins; otherwise A/B on
-    # TPU (xla, flash, flash+no-remat), single xla run elsewhere (the
-    # pallas kernel does not lower on CPU)
+    # which lowerings to measure: explicit --attn wins; otherwise a
+    # (lowering x remat-policy x ROWS) sweep on TPU, single xla run
+    # elsewhere (the pallas kernel does not lower on CPU). Rows is a
+    # real lever, not a nuisance dimension: at rows=64 the flash step
+    # is short enough that per-step overheads (optimizer, GGNN bridge,
+    # tunnel dispatch) eat the kernel's win, and the XLA path's own
+    # rows=256 scaling note ("same ex/s") predates flash — with the
+    # [B,H,T,T] HBM temps gone, larger batches amortize differently.
+    # flash+no-remat is known-OOM at rows>=64 w/ full activations
+    # (24G > 16G, docs/attn_ab_tpu.json) but attn_saved keeps only the
+    # kernel's named outputs, so it gets the big-rows slots.
     selfcheck = None
     if args.attn in ("xla", "flash"):
-        plans = [(args.attn, enc.remat, args.remat_policy)]
+        plans = [(args.attn, enc.remat, args.remat_policy, args.rows)]
     elif platform == "tpu" and not args.tiny:
-        plans = [("xla", True, "full"), ("flash", True, "full"),
-                 ("flash", True, "attn_saved"), ("flash", False, "full")]
+        plans = [("xla", True, "full", 64),
+                 ("flash", True, "full", 128),
+                 ("flash", True, "attn_saved", 128),
+                 ("flash", True, "attn_saved", 256),
+                 ("flash", True, "full", 256)]
+        if args.arch == "t5":
+            # the t5 capture runs under a tighter watchdog budget and
+            # has no baseline row of its own: keep the grid to the
+            # proven shapes so a timeout can't void the whole capture
+            plans = plans[:3]
     else:
-        plans = [("xla", enc.remat, "full")]
+        plans = [("xla", enc.remat, "full", args.rows)]
 
     variants = []
-    for impl, remat, policy in plans:
+    for impl, remat, policy, rows in plans:
         if impl == "flash":
             if selfcheck is None:
                 try:
@@ -264,8 +285,8 @@ def main() -> None:
         if policy != "full":
             ec = dataclasses.replace(ec, remat_policy=policy)
         try:
-            variants.append(_measure(args, ec, impl))
-        except Exception as e:
+            variants.append(_measure(args, ec, impl, rows))
+        except Exception as e:  # noqa: BLE001 — every variant must land
             # keep the diagnostic lines (OOM totals, mosaic errors) that
             # a blind prefix-truncation would drop — the variants list is
             # the auditable record of WHY a configuration lost
@@ -275,9 +296,19 @@ def main() -> None:
                               "error:"))][:8]
             variants.append({
                 "attn_impl": impl, "remat": remat, "remat_policy": policy,
+                "rows": rows,
                 "error": f"{type(e).__name__}: {e}"[:300],
                 "error_detail": detail,
             })
+        if args.out:
+            # incremental checkpoint: a watchdog-budget kill mid-sweep
+            # (the window can close at any moment) keeps every variant
+            # measured so far instead of voiding the capture
+            with open(args.out, "w") as f:
+                json.dump({"metric": "combined_train_examples_per_sec",
+                           "partial": True, "arch": args.arch,
+                           "platform": platform, "variants": variants}, f,
+                          indent=1)
 
     scored = [v for v in variants if "value" in v]
     if not scored:

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Combined-model INFERENCE + line-localization timing benchmark.
+
+The perf story so far covers training (bench_combined.py) and the GGNN
+(bench.py); this closes the remaining Table 5 row: DeepDFA+LineVul
+*inference* at 15.4 ms/example on the reference's RTX 3090
+(`/root/reference/paper.pdf` Table 5; BASELINE.md "Efficiency") =
+64.9 examples/s, measured there with CUDA events around the forward
+(reference `LineVul/linevul/linevul_main.py` eval loop +
+`code_gnn/models/base_module.py:238-291` profiling hooks).
+
+Here: the jitted combined RoBERTa(768x12)+GGNN forward over 512-token
+rows with aligned graph batches, bf16 on TPU, fetch-bounded windows
+(every timed window ends in a device->host copy — the tunnel can report
+buffers ready early, docs/bench_history.json "timing_audit").
+
+Alongside it, the localization methods (eval/localize.py — the
+reference's linevul_main.py --do_local_explanation path with its
+attention / Saliency / IG / LIG / DeepLift captum attributions) are
+timed per-example so the explanation cost is on the record too:
+attention (forward-only, encoder attention maps), saliency (one
+gradient), integrated_gradients (n_steps gradient evaluations).
+
+    python scripts/bench_localize.py                    # default backend
+    DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_localize.py --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# paper Table 5: DeepDFA+LineVul inference 15.4 ms/example on RTX 3090
+BASELINE_MS_PER_EXAMPLE = 15.4
+BASELINE_EXAMPLES_PER_SEC = 1000.0 / BASELINE_MS_PER_EXAMPLE
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--reps", type=int, default=6)
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny encoder (harness validation on CPU)")
+    ap.add_argument("--methods", default="attention,saliency,lig",
+                    help="comma list of localization methods to time")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from deepdfa_tpu.core.backend import (
+        apply_platform_override,
+        enable_compile_cache,
+    )
+
+    apply_platform_override()
+    enable_compile_cache()
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    platform = jax.devices()[0].platform
+    dtype = "bfloat16" if platform != "cpu" else "float32"
+    if args.tiny:
+        enc = TransformerConfig.tiny(
+            vocab_size=512, max_position_embeddings=args.seq + 4
+        )
+    else:
+        enc = TransformerConfig(
+            vocab_size=50265, max_position_embeddings=args.seq + 2
+        )
+    enc = dataclasses.replace(enc, dtype=dtype)
+
+    from _combined_batch import build_trainer_and_batch
+
+    trainer, state, batch = build_trainer_and_batch(
+        enc, "roberta", args.rows, args.seq)
+    mcfg = trainer.model_cfg
+    params = state.params
+    # drop the leading dp-shard axis (num_shards=1) for the plain forward
+    input_ids = batch.input_ids[0]
+    has_graph = batch.has_graph[0]
+    graphs = jax.tree.map(lambda x: x[0], batch.graphs)
+
+    from deepdfa_tpu.models import combined as cmb
+
+    @jax.jit
+    def infer(params, input_ids, graphs, has_graph):
+        return jax.nn.softmax(
+            cmb.forward(mcfg, params, input_ids, graphs, has_graph),
+            axis=-1,
+        )
+
+    np.asarray(infer(params, input_ids, graphs, has_graph))  # compile+warm
+
+    rates = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        out = infer(params, input_ids, graphs, has_graph)
+        np.asarray(out)  # fetch-bounded window
+        rates.append(args.rows / (time.perf_counter() - t0))
+    value = float(np.median(rates))
+
+    result = {
+        "metric": "combined_infer_examples_per_sec",
+        "value": round(value, 2),
+        "unit": "examples/s",
+        "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC, 2),
+        "baseline_ms_per_example": BASELINE_MS_PER_EXAMPLE,
+        "ms_per_example": round(1000.0 / value, 3),
+        "best_examples_per_sec": round(max(rates), 2),
+        "platform": platform,
+        "rows": args.rows,
+        "seq": args.seq,
+        "encoder": "tiny" if args.tiny else "codebert-base(12x768)",
+        "dtype": dtype,
+    }
+
+    # localization methods: time token_scores end-to-end (it returns
+    # numpy, so the fetch bound is built in). First call compiles; the
+    # timed calls replay the jit cache — matching how eval/localize.py
+    # is used over a dataset (one compile, thousands of rows).
+    from deepdfa_tpu.eval.localize import token_scores
+
+    loc = {}
+    for method in [m.strip() for m in args.methods.split(",") if m.strip()]:
+        try:
+            token_scores(method, "roberta", mcfg, params, input_ids,
+                         graphs, has_graph)  # compile+warm
+            t0 = time.perf_counter()
+            token_scores(method, "roberta", mcfg, params, input_ids,
+                         graphs, has_graph)
+            dt = time.perf_counter() - t0
+            loc[method] = {
+                "ms_per_example": round(1000.0 * dt / args.rows, 3),
+                "examples_per_sec": round(args.rows / dt, 2),
+            }
+        except Exception as e:  # one broken method must not void the rest
+            loc[method] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    result["localization"] = loc
+
+    if platform == "tpu":
+        from deepdfa_tpu.eval.profiling import ceiling_fields
+
+        result.update(ceiling_fields(0.0))
+        result.pop("mfu_vs_measured_ceiling", None)
+
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

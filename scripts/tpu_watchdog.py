@@ -144,6 +144,12 @@ def run_bench_suite(platform: str) -> dict:
             else "bench_combined_t5_tpu.json",
         )
         try:
+            # clear any prior window's file so _load_partial can only
+            # ever see what THIS child wrote
+            try:
+                os.remove(combined_out)
+            except OSError:
+                pass
             res = subprocess.run(
                 [
                     sys.executable,
@@ -158,8 +164,30 @@ def run_bench_suite(platform: str) -> dict:
                     record[key] = json.load(f)
             else:
                 record[f"{key}_error"] = (res.stderr or res.stdout)[-500:]
+                _load_partial(record, key, combined_out)
         except subprocess.TimeoutExpired:
             record[f"{key}_error"] = f"bench_combined.py {arch} exceeded {budget}s"
+            # the sweep checkpoints its out-file after every variant, so
+            # a budget kill mid-sweep still leaves measured variants
+            _load_partial(record, key, combined_out)
+
+    # inference + localization timings (the Table 5 15.4 ms/ex row and
+    # the explanation-path cost) — cheap, forward-dominated
+    loc_out = os.path.join(REPO, "docs", "bench_localize_tpu.json")
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "bench_localize.py"),
+             "--out", loc_out],
+            capture_output=True, text=True, timeout=1200, env=env, cwd=REPO,
+        )
+        if res.returncode == 0 and os.path.exists(loc_out):
+            with open(loc_out) as f:
+                record["bench_localize"] = json.load(f)
+        else:
+            record["bench_localize_error"] = (res.stderr or res.stdout)[-400:]
+    except subprocess.TimeoutExpired:
+        record["bench_localize_error"] = "bench_localize.py exceeded 1200s"
 
     # gen-path A/B (seq2seq encoder+decoder step — the decoder flash
     # extensions' workload); bounded small since it has no baseline row
@@ -200,6 +228,24 @@ def run_bench_suite(platform: str) -> dict:
         except subprocess.TimeoutExpired:
             record["train_descent_ab_error"] = "exceeded 1800s"
     return record
+
+
+def _load_partial(record: dict, key: str, path: str) -> None:
+    """Fold a partial (checkpointed) sweep out-file into the record.
+
+    Only a file the just-killed child actually wrote counts: the caller
+    removes the out-file before launching the child, and the 'partial'
+    flag distinguishes a checkpoint from a completed record — without
+    both guards a prior window's committed artifact could be resurrected
+    as this window's evidence."""
+    try:
+        with open(path) as f:
+            partial = json.load(f)
+        if isinstance(partial, dict) and partial.get("partial") \
+                and partial.get("variants"):
+            record[f"{key}_partial"] = partial
+    except (OSError, ValueError):
+        pass
 
 
 def _descent_record_complete(path: str) -> bool:
@@ -274,6 +320,7 @@ def main() -> None:
                     os.path.join(REPO, "docs", "bench_combined_tpu.json"),
                     os.path.join(REPO, "docs", "bench_combined_t5_tpu.json"),
                     os.path.join(REPO, "docs", "bench_gen_tpu.json"),
+                    os.path.join(REPO, "docs", "bench_localize_tpu.json"),
                     os.path.join(REPO, "docs", "train_descent_ab.json"),
                 ],
                 "Capture TPU bench from watchdog healthy-window "

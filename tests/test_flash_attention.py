@@ -546,8 +546,19 @@ def test_resolve_impl_shapes_and_bias_cap():
     assert not flash_shape_ok(8192, 64, biased=True)
     assert flash_shape_ok(8192, 64, biased=False)  # unbiased streams on
 
+    # hardware requires 128-aligned T (Mosaic tilings are only on-chip
+    # validated at aligned lengths); the interpreter hook relaxes it
+    assert not flash_shape_ok(200, 64)
+    assert not flash_shape_ok(512, 64, Tk=300)
+    assert flash_shape_ok(200, 64, lax_alignment=True)
+    assert flash_shape_ok(384, 64)           # aligned sub-512 still ok
+
     # forced flash raises where auto falls back
     assert resolve_impl("auto", 640, 64) == "xla"
+    assert resolve_impl("auto", 200, 64) == "xla"  # unaligned -> xla
+    with pytest.raises(ValueError, match="cannot tile"):
+        resolve_impl("flash", 200, 64)
+    assert resolve_impl("flash", 200, 64, interpret_hint=True) == "flash"
     assert resolve_impl("auto", 8192, 64, biased=True) == "xla"
     with pytest.raises(ValueError, match="cannot tile"):
         resolve_impl("flash", 8192, 64, biased=True)
