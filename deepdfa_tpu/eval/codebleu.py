@@ -30,11 +30,15 @@ Role parity with the reference evaluator
 - dataflow match: fraction of the reference's normalized def-use triples
   (var_i, relation, [var_j...]) found in the candidate
   (dataflow_match.py:28-66, variable names alpha-renamed in order of
-  appearance :132-148). Triples here derive from the frontend's
-  reaching-definitions solver rather than tree-sitter DFG functions —
-  same relation vocabulary ("comesFrom"/"computedFrom"), different
-  extractor; scores are comparable within this framework, not digit-exact
-  with the reference's tree-sitter extraction.
+  appearance :132-148). For java + c_sharp — the reference evaluator's
+  entire runnable surface — the triples come from eval/dfg_parity.py, a
+  faithful port of DFG_java/DFG_csharp + the dataflow_match.py pipeline
+  over a tree-sitter-shaped mini-AST: DIGIT-EXACT with the reference
+  (golden-pinned, tests/test_dfg_parity.py; the only caveat is the
+  reference's own str-hash-dependent merged-parent-list ordering). The
+  remaining languages keep the reaching-definitions approximation —
+  same relation vocabulary, different extractor, comparable within this
+  framework.
 
 Both structural scores degenerate to 0 with the reference's own warning
 semantics when nothing parses (dataflow_match.py:61-64).
@@ -544,6 +548,17 @@ def corpus_dataflow_match(
     lang: str = "c",
 ) -> float:
     _check_lang(lang)
+    if lang in ("java", "c_sharp"):
+        # digit-exact path: a faithful port of the reference's
+        # DFG_java/DFG_csharp recursion + dataflow_match.py pipeline
+        # over a tree-sitter-shaped mini-AST (eval/dfg_parity.py;
+        # golden-pinned in tests/test_dfg_parity.py). The remaining
+        # languages keep the reaching-defs approximation below.
+        from deepdfa_tpu.eval import dfg_parity
+
+        return dfg_parity.corpus_dataflow_match(
+            list_of_references, candidates, lang
+        )
     if lang == "python":
         parse, triples_fn = _parse_py, _py_dataflow_triples
     else:

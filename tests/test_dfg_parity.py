@@ -1,0 +1,460 @@
+"""Golden pins for the digit-exact java/c_sharp dataflow match.
+
+Every expected triple list below was hand-derived by executing the
+reference's DFG logic on paper — DFG_java (CodeT5/evaluator/CodeBLEU/
+parser/DFG.py:180-355), DFG_csharp (DFG.py:356-538), tree_to_variable_
+index (parser/utils.py:80-92) and the get_data_flow filter/merge +
+normalize pipeline (dataflow_match.py:70-150). tree-sitter itself is
+not installed in this image, so the goldens cite the branch of DFG.py
+each behavior traces to.
+
+Determinism note: the reference merges duplicate triples with
+`list(set(parent_codes))` (DFG.py:295-300, dataflow_match.py:104-107),
+so the ORDER of a merged multi-parent list is str-hash dependent in the
+reference itself (varies with PYTHONHASHSEED). The pins therefore
+canonicalize parent-code lists by sorting — content equality, which is
+the strongest property the reference's own output holds across runs.
+Parent-INDEX lists are sorted ints in both implementations and are
+pinned verbatim.
+"""
+
+import pytest
+
+from deepdfa_tpu.eval.dfg_parity import (
+    corpus_dataflow_match,
+    dfg_extract,
+    get_data_flow,
+    normalize_dataflow,
+    parse_snippet,
+    remove_comments,
+)
+
+
+def extract(code: str, lang: str):
+    dfg, states = dfg_extract(parse_snippet(code, lang), lang, {})
+    return [canon_t(t) for t in dfg], states
+
+
+def canon_t(t):
+    return (t[0], t[1], t[2], sorted(t[3]), sorted(t[4]))
+
+
+def canon_all(ts):
+    return [canon_t(t) for t in ts]
+
+
+# ---------------------------------------------------------------------------
+# java
+# ---------------------------------------------------------------------------
+
+
+def test_java_decl_no_value():
+    # DFG.py:203-209: bare declarator -> comesFrom [],[] and a def state
+    dfg, states = extract("int x;", "java")
+    assert dfg == [("x", 1, "comesFrom", [], [])]
+    assert states == {"x": [1]}
+    # filter (dataflow_match.py:85-95): no parents anywhere -> dropped
+    assert get_data_flow("int x;", "java") == []
+
+
+def test_java_decl_with_literal_value():
+    # DFG.py:211-222: declarator with value -> comesFrom pairs; the
+    # literal participates as a parent (tree_to_variable_index keeps
+    # named-literal leaves, utils.py:80-92)
+    dfg, states = extract("int x = 5;", "java")
+    assert dfg == [
+        ("x", 1, "comesFrom", ["5"], [3]),
+        ("5", 3, "comesFrom", [], []),
+    ]
+    assert states == {"x": [1]}
+
+
+def test_java_chained_decls_and_assignment():
+    dfg, states = extract("int x = 5;\nint y = x + 2;\nx = y;", "java")
+    assert dfg == [
+        ("x", 1, "comesFrom", ["5"], [3]),
+        ("5", 3, "comesFrom", [], []),
+        ("y", 6, "comesFrom", ["x"], [8]),
+        ("y", 6, "comesFrom", ["2"], [10]),
+        ("x", 8, "comesFrom", ["x"], [1]),   # use reached by def@1
+        ("2", 10, "comesFrom", [], []),
+        ("x", 12, "computedFrom", ["y"], [14]),  # DFG.py:224-238
+        ("y", 14, "comesFrom", ["y"], [6]),
+    ]
+    assert states == {"x": [12], "y": [6]}
+
+
+def test_java_compound_assignment_reads_rhs_only():
+    # `+=` is a plain assignment_expression to the DFG: the left side
+    # is written, never read (DFG.py:224-238 has no compound case)
+    dfg, states = extract("x += y;", "java")
+    assert dfg == [
+        ("x", 0, "computedFrom", ["y"], [2]),
+        ("y", 2, "comesFrom", [], []),
+    ]
+    assert states == {"x": [0], "y": [2]}
+
+
+def test_java_update_expression():
+    # DFG.py:239-247: i++ -> computedFrom itself
+    dfg, states = extract("i++;", "java")
+    assert dfg == [("i", 0, "computedFrom", ["i"], [0])]
+    assert states == {"i": [0]}
+
+
+def test_java_if_else_merges_branch_states():
+    # DFG.py:248-279: consequence runs on current_states, the else
+    # branch on the PRISTINE pre-if states; the merged state carries
+    # every branch's defs, so a later use comes from all three defs
+    code = "int a = b;\nif (c) { a = 1; } else { a = 2; }\nint d = a;"
+    dfg, states = extract(code, "java")
+    assert ("a", 25, "comesFrom", ["a"], [1, 10, 17]) in dfg
+    assert ("d", 23, "comesFrom", ["a"], [25]) in dfg
+    assert states["a"] == [1, 10, 17]
+
+
+def test_java_else_if_chain():
+    code = (
+        "if (c) { a = 1; } else if (d) { a = 2; } else { a = 3; }\n"
+        "int e = a;"
+    )
+    dfg, states = extract(code, "java")
+    # nested else-if: the alternative is itself an if_statement run on
+    # pristine states (DFG.py:267-270); the final use sees all 3 defs
+    assert ("a", 31, "comesFrom", ["a"], [5, 16, 23]) in dfg
+    assert states["a"] == [5, 16, 23]
+
+
+def test_java_for_loop_two_passes():
+    # DFG.py:280-302: pass 1 over all children, pass 2 over children
+    # AFTER the local_variable_declaration, then dedup-merge
+    dfg, states = extract(
+        "for (int i = 0; i < n; i++) { s = s + i; }", "java"
+    )
+    assert dfg == [
+        ("i", 3, "comesFrom", ["0"], [5]),
+        ("0", 5, "comesFrom", [], []),
+        # pass1 sees def@3, pass2 sees the i++ def@11 -> merged
+        ("i", 7, "comesFrom", ["i"], [3, 11]),
+        # n is fresh in pass 1 ([],[]) and a self-parent in pass 2
+        ("n", 9, "comesFrom", ["n"], [9]),
+        ("i", 11, "computedFrom", ["i"], [11]),
+        ("s", 15, "computedFrom", ["i", "s"], [17, 19]),
+        ("s", 17, "comesFrom", ["s"], [15]),
+        ("i", 19, "comesFrom", ["i"], [11]),
+    ]
+    assert states == {"i": [11], "n": [9], "s": [15]}
+
+
+def test_java_enhanced_for_two_rounds():
+    # DFG.py:303-326: name computedFrom value, two rounds, merged
+    dfg, states = extract("for (int v : xs) { t += v; }", "java")
+    assert dfg == [
+        ("v", 3, "computedFrom", ["xs"], [5]),
+        ("xs", 5, "comesFrom", ["xs"], [5]),  # round 2 self-parent
+        ("t", 8, "computedFrom", ["v"], [10]),
+        ("v", 10, "comesFrom", ["v"], [3]),
+    ]
+    assert states == {"v": [3], "xs": [5], "t": [8]}
+
+
+def test_java_while_two_passes():
+    # DFG.py:327-340: every child visited twice, then merged
+    dfg, states = extract("while (i < n) { i = i + 1; }", "java")
+    assert dfg == [
+        ("i", 2, "comesFrom", ["i"], [7]),  # pass2: body def reaches cond
+        ("n", 4, "comesFrom", ["n"], [4]),
+        ("i", 7, "computedFrom", ["1", "i"], [9, 11]),
+        ("i", 9, "comesFrom", ["i"], [2, 7]),
+        ("1", 11, "comesFrom", [], []),
+    ]
+    assert states == {"i": [7], "n": [4]}
+
+
+def test_java_do_while_is_generic_single_pass():
+    # do_statement is in NO special list (DFG.py:188) -> one generic
+    # pass; the body's first `i` use precedes any def, so it has no
+    # parents, and the condition sees only the body's def
+    dfg, states = extract("do { i = i + 1; } while (i < n);", "java")
+    assert dfg == [
+        ("i", 2, "computedFrom", ["i"], [4]),
+        ("i", 2, "computedFrom", ["1"], [6]),
+        ("i", 4, "comesFrom", [], []),
+        ("1", 6, "comesFrom", [], []),
+        ("i", 11, "comesFrom", ["i"], [2]),
+        ("n", 13, "comesFrom", [], []),
+    ]
+    assert states == {"i": [2], "n": [13]}
+
+
+def test_java_method_params_define():
+    # formal parameters are plain identifier leaves -> they def via the
+    # leaf rule (DFG.py:191-199); the method NAME is an identifier too
+    # and participates (tree-sitter treats it no differently)
+    dfg, states = extract("int add(int a, int b) { return a + b; }", "java")
+    assert dfg == [
+        ("add", 1, "comesFrom", [], []),
+        ("a", 4, "comesFrom", [], []),
+        ("b", 7, "comesFrom", [], []),
+        ("a", 11, "comesFrom", ["a"], [4]),
+        ("b", 13, "comesFrom", ["b"], [7]),
+    ]
+    assert states == {"add": [1], "a": [4], "b": [7]}
+
+
+def test_java_call_and_field_access_leaves_participate():
+    # method/field names are identifier leaves; assignment's RHS
+    # variable index list includes them (a faithful quirk)
+    dfg, _ = extract("y = o.f(x);", "java")
+    assert dfg == [
+        ("y", 0, "computedFrom", ["o"], [2]),
+        ("y", 0, "computedFrom", ["f"], [4]),
+        ("y", 0, "computedFrom", ["x"], [6]),
+        ("o", 2, "comesFrom", [], []),
+        ("f", 4, "comesFrom", [], []),
+        ("x", 6, "comesFrom", [], []),
+    ]
+
+
+def test_java_type_identifiers_participate_but_filter_out():
+    # `String` is an identifier leaf (not a keyword): it enters states
+    # and emits a parentless triple, which the get_data_flow filter
+    # then drops (dataflow_match.py:85-95) because nothing refers to it
+    dfg, states = extract('String s = "hi";', "java")
+    assert dfg == [
+        ("String", 0, "comesFrom", [], []),
+        ("s", 1, "comesFrom", ['"hi"'], [3]),
+        ('"hi"', 3, "comesFrom", [], []),
+    ]
+    assert states == {"String": [0], "s": [1]}
+    kept = canon_all(get_data_flow('String s = "hi";', "java"))
+    assert ("String", 0, "comesFrom", [], []) not in kept
+    assert ("s", 1, "comesFrom", ['"hi"'], [3]) in kept
+
+
+def test_java_null_is_named_true_false_are_not():
+    # null lifts to a null_literal token (type != text -> participates);
+    # true/false are anonymous in the grammar (type == text -> invisible)
+    dfg, _ = extract("Object o = null;", "java")
+    assert ("o", 1, "comesFrom", ["null"], [3]) in dfg
+    dfg2, states2 = extract("boolean b = true;", "java")
+    assert dfg2 == []  # no variable leaves at all on the RHS
+    # ...but the declarator still defs b (the states write sits outside
+    # the per-value loop, DFG.py:221): boolean(0) b(1) =(2) true(3)
+    assert states2 == {"b": [1]}
+
+
+def test_java_chained_assignment():
+    dfg, states = extract("x = y = z;", "java")
+    assert dfg == [
+        ("x", 0, "computedFrom", ["y"], [2]),
+        ("x", 0, "computedFrom", ["z"], [4]),
+        ("y", 2, "computedFrom", ["z"], [4]),
+        ("z", 4, "comesFrom", [], []),
+    ]
+    assert states == {"x": [0], "y": [2], "z": [4]}
+
+
+def test_java_cast_skips_type_keyword():
+    dfg, _ = extract("int y = (int) x;", "java")
+    assert dfg == [
+        ("y", 1, "comesFrom", ["x"], [6]),
+        ("x", 6, "comesFrom", [], []),
+    ]
+
+
+def test_java_array_assignment_left_indices_all_written():
+    # tree_to_variable_index(left) over `a[i]` yields BOTH a and i:
+    # both become computedFrom targets and neither is read (faithful)
+    dfg, states = extract("a[i] = b[j] + 1;", "java")
+    assert dfg == [
+        ("a", 0, "computedFrom", ["b"], [5]),
+        ("a", 0, "computedFrom", ["j"], [7]),
+        ("a", 0, "computedFrom", ["1"], [10]),
+        ("i", 2, "computedFrom", ["b"], [5]),
+        ("i", 2, "computedFrom", ["j"], [7]),
+        ("i", 2, "computedFrom", ["1"], [10]),
+        ("b", 5, "comesFrom", [], []),
+        ("j", 7, "comesFrom", [], []),
+        ("1", 10, "comesFrom", [], []),
+    ]
+    assert states == {"a": [0], "i": [2], "b": [5], "j": [7]}
+
+
+# ---------------------------------------------------------------------------
+# c_sharp
+# ---------------------------------------------------------------------------
+
+
+def test_csharp_decl_equals_value_clause_shape():
+    # DFG_csharp def branch (DFG.py:377-402): declarator children are
+    # [identifier, equals_value_clause]; same comesFrom output as java
+    dfg, states = extract("int x = 5;", "c_sharp")
+    assert dfg == [
+        ("x", 1, "comesFrom", ["5"], [3]),
+        ("5", 3, "comesFrom", [], []),
+    ]
+    assert states == {"x": [1]}
+
+
+def test_csharp_postfix_is_increment_prefix_is_not():
+    # DFG.py:359: increment_statement=['postfix_unary_expression'] —
+    # ++j is a prefix_unary_expression and falls through to the
+    # generic branch (just a leaf use)
+    dfg, states = extract("i++;\n++j;", "c_sharp")
+    assert dfg == [
+        ("i", 0, "computedFrom", ["i"], [0]),
+        ("j", 4, "comesFrom", [], []),
+    ]
+    assert states == {"i": [0], "j": [4]}
+    # and the parentless ++j use filters out downstream
+    assert canon_all(get_data_flow("i++;\n++j;", "c_sharp")) == [
+        ("i", 0, "computedFrom", ["i"], [0])
+    ]
+
+
+def test_csharp_for_loop_second_pass_never_fires():
+    # The c# grammar names the for initializer `variable_declaration`,
+    # but DFG_csharp's second-pass trigger checks for
+    # "local_variable_declaration" verbatim (DFG.py:470) — so unlike
+    # java, NO loop-back triples appear. Quirk replicated, not fixed.
+    dfg, states = extract(
+        "for (int i = 0; i < n; i++) { s += i; }", "c_sharp"
+    )
+    assert dfg == [
+        ("i", 3, "comesFrom", ["0"], [5]),
+        ("0", 5, "comesFrom", [], []),
+        ("i", 7, "comesFrom", ["i"], [3]),   # only the init def: 1 pass
+        ("n", 9, "comesFrom", [], []),       # never becomes self-parent
+        ("i", 11, "computedFrom", ["i"], [11]),
+        ("s", 15, "computedFrom", ["i"], [17]),
+        ("i", 17, "comesFrom", ["i"], [11]),
+    ]
+    assert states == {"i": [11], "n": [9], "s": [15]}
+
+
+def test_csharp_vs_java_for_loop_differ():
+    """The same source text scores differently between the two
+    languages — the divergence IS reference behavior."""
+    code = "for (int i = 0; i < n; i++) { s = s + i; }"
+    dj, _ = extract(code, "java")
+    dc, _ = extract(code, "c_sharp")
+    assert dj != dc
+    assert ("n", 9, "comesFrom", ["n"], [9]) in dj      # java pass 2
+    assert ("n", 9, "comesFrom", [], []) in dc          # c# single pass
+
+
+def test_csharp_foreach():
+    # DFG.py:481-508: left computedFrom right, two rounds, merged
+    dfg, states = extract("foreach (int v in xs) { t += v; }", "c_sharp")
+    assert dfg == [
+        ("v", 3, "computedFrom", ["xs"], [5]),
+        ("xs", 5, "comesFrom", ["xs"], [5]),
+        ("t", 8, "computedFrom", ["v"], [10]),
+        ("v", 10, "comesFrom", ["v"], [3]),
+    ]
+    assert states == {"v": [3], "xs": [5], "t": [8]}
+
+
+def test_csharp_while_two_passes():
+    dfg, states = extract("while (i < n) { i = i + 1; }", "c_sharp")
+    assert dfg == [
+        ("i", 2, "comesFrom", ["i"], [7]),
+        ("n", 4, "comesFrom", ["n"], [4]),
+        ("i", 7, "computedFrom", ["1", "i"], [9, 11]),
+        ("i", 9, "comesFrom", ["i"], [2, 7]),
+        ("1", 11, "comesFrom", [], []),
+    ]
+    assert states == {"i": [7], "n": [4]}
+
+
+def test_csharp_chained_assignment():
+    dfg, _ = extract("x = y = z;", "c_sharp")
+    assert dfg == [
+        ("x", 0, "computedFrom", ["y"], [2]),
+        ("x", 0, "computedFrom", ["z"], [4]),
+        ("y", 2, "computedFrom", ["z"], [4]),
+        ("z", 4, "comesFrom", [], []),
+    ]
+
+
+def test_csharp_true_invisible_string_participates():
+    dfg, states = extract('string s = "hi";\nbool b = true;', "c_sharp")
+    assert dfg == [
+        ("s", 1, "comesFrom", ['"hi"'], [3]),
+        ('"hi"', 3, "comesFrom", [], []),
+    ]
+    # b still defs (the states write is outside the value loop,
+    # DFG.py:399) even though `true` contributes no parents
+    assert states == {"s": [1], "b": [6]}
+
+
+# ---------------------------------------------------------------------------
+# pipeline: filter, merge, normalize, score
+# ---------------------------------------------------------------------------
+
+
+def test_get_data_flow_merges_by_index():
+    # dataflow_match.py:100-110: one entry per token index, parent
+    # code/idx sets unioned
+    kept = canon_all(
+        get_data_flow("int x = 5;\nint y = x + 2;\nx = y;", "java")
+    )
+    assert ("y", 6, "comesFrom", ["2", "x"], [8, 10]) in kept
+
+
+def test_normalize_sequential_renaming():
+    # dataflow_match.py:129-145: parents renamed before the target var,
+    # names assigned in first-appearance order
+    norm = normalize_dataflow(get_data_flow("x = y;\nz = x;", "java"))
+    # y@1 appears first as x's parent -> var_0; x -> var_1; z -> var_2
+    assert ("var_1", "computedFrom", ["var_0"]) in norm
+    assert ("var_2", "computedFrom", ["var_1"]) in norm
+
+
+def test_score_self_match_is_one():
+    code = "int a = b;\nfor (int i = 0; i < a; i++) { b += i; }"
+    assert corpus_dataflow_match([[code]], [code], "java") == 1.0
+
+
+def test_score_alpha_renaming_invariant():
+    ref = "int total = start;\ntotal += delta;"
+    cand = "int sum = s0;\nsum += d;"
+    assert corpus_dataflow_match([[ref]], [cand], "java") == 1.0
+
+
+def test_score_partial_match_fraction():
+    # ref has 4 surviving triples (x=5 pair + y=x pair);
+    # a candidate missing the second statement matches only x's pair
+    ref = "int x = 5;\nint y = x;"
+    cand = "int x = 5;"
+    score = corpus_dataflow_match([[ref]], [cand], "java")
+    ref_n = len(get_data_flow(ref, "java"))
+    match_n = len(get_data_flow(cand, "java"))
+    assert score == pytest.approx(match_n / ref_n)
+
+
+def test_score_degenerate_zero_when_ref_has_no_flows():
+    assert corpus_dataflow_match([["int x;"]], ["int x;"], "java") == 0.0
+
+
+def test_comment_stripping_matches_reference_regex():
+    # utils.py:50-66 'java' branch: comments -> one space, strings
+    # protected, blank lines dropped
+    src = 'int a = 1; // c\n/* multi\nline */\nString s = "// not";'
+    out = remove_comments(src)
+    assert "// c" not in out and "multi" not in out
+    assert '"// not"' in out
+    assert "" not in [ln for ln in out.split("\n")]
+    # a commented-out def must not produce triples
+    assert corpus_dataflow_match(
+        [["int x = y;\n// x = z;"]], ["int x = y;"], "java"
+    ) == 1.0
+
+
+def test_codebleu_integration_uses_parity_path():
+    from deepdfa_tpu.eval.codebleu import corpus_dataflow_match as cdm
+
+    code = "int x = a;\nx += b;"
+    assert cdm([[code]], [code], lang="java") == 1.0
+    assert cdm([[code]], [code], lang="c_sharp") == 1.0
