@@ -251,6 +251,7 @@ def cmd_extract(args) -> None:
         specs = encode_corpus(
             sel, vocabs, workers=args.workers,
             max_defs=cfg.data.feat.max_defs, gtype=cfg.data.gtype,
+            struct_feats=cfg.data.feat.struct_feats,
         )
         tag = f"shard{args.shard:04d}" if args.num_shards > 1 else None
         store.write(specs, tag=tag)
@@ -272,6 +273,7 @@ def cmd_extract(args) -> None:
         workers=args.workers,
         max_defs=cfg.data.feat.max_defs,
         gtype=cfg.data.gtype,
+        struct_feats=cfg.data.feat.struct_feats,
     )
     store.write(specs)
     _write_missing_ids(store.directory, examples, specs)

@@ -39,6 +39,9 @@ class FeatureSpec:
     #: attach reaching-definitions bit labels of this width at extraction
     #: (required for the dataflow_solution_{in,out} label styles)
     max_defs: int | None = None
+    #: append the family-invariant structural channels at extraction
+    #: (frontend/structfeat.py; consumed when model.struct_feats is on)
+    struct_feats: bool = False
 
     def __post_init__(self):
         # canonical order so equal artifact names imply equal specs
@@ -63,6 +66,8 @@ class FeatureSpec:
         # artifact names must distinguish bit-labeled stores from plain ones
         if self.max_defs is not None:
             base += f"_maxdefs_{self.max_defs}"
+        if self.struct_feats:
+            base += "_struct"
         return base
 
     @classmethod
@@ -83,6 +88,7 @@ class FeatureSpec:
             limit_all=_limit("limitall", 1000),
             limit_subkeys=_limit("limitsubkeys", 1000),
             max_defs=_limit("maxdefs", None),
+            struct_feats="_struct" in feat,
         )
 
 
@@ -101,6 +107,10 @@ class ModelConfig:
     scan_steps: bool = False
     num_output_layers: int = 3
     concat_all_absdf: bool = True
+    # family-invariant structural channels (frontend/structfeat.py):
+    # needs a corpus extracted with data.feat.struct_feats=true; widens
+    # the encoder by len(STRUCT_VOCAB) * hidden_dim
+    struct_feats: bool = False
     # graph | node | dataflow_solution_in | dataflow_solution_out
     # (dataflow styles need data.feat.max_defs set at extraction)
     label_style: str = "graph"

@@ -49,6 +49,9 @@ class ExtractedGraph:
     #: per-edge relation ids (gtype="cfg+dep": 0=cfg, 1=data-dependence,
     #: 2=control-dependence); None for single-type cfg graphs
     edge_type: np.ndarray | None = None
+    #: optional [n, NUM_STRUCT_FEATS] family-invariant structural channels
+    #: (frontend/structfeat.py) appended to node_feats by to_graph_spec
+    struct: np.ndarray | None = None
 
     @property
     def num_nodes(self) -> int:
@@ -62,6 +65,7 @@ def extract_graph(
     label: float | None = None,
     max_defs: int | None = None,
     gtype: str = "cfg",
+    struct_feats: bool = False,
 ) -> ExtractedGraph | None:
     """Parse one function and build its model graph. None on failure or
     empty CFG (reference behavior: failures are skipped and logged,
@@ -154,6 +158,11 @@ def extract_graph(
             if vuln_lines and any(int(l) in vuln_lines for l in node_lines)
             else 0.0
         )
+    struct = None
+    if struct_feats:
+        from deepdfa_tpu.frontend.structfeat import struct_features
+
+        struct = struct_features(cpg, keep)
     return ExtractedGraph(
         graph_id=graph_id,
         node_lines=node_lines,
@@ -163,6 +172,7 @@ def extract_graph(
         label=float(label),
         bits=bits,
         edge_type=edge_type,
+        struct=struct,
     )
 
 
@@ -176,6 +186,10 @@ def to_graph_spec(
 
     n = eg.num_nodes
     feats = encode_nodes(vocabs, eg.def_fields, range(n), SUBKEY_ORDER)
+    if eg.struct is not None:
+        # struct channels ride as extra columns; the embedding splits
+        # them back out by position (nn/embedding.py struct_vocab)
+        feats = np.concatenate([feats, eg.struct], axis=1)
     if vuln_lines:
         vuln = np.array(
             [1 if int(l) in vuln_lines else 0 for l in eg.node_lines], np.int32
@@ -215,12 +229,13 @@ class Example:
 
 
 def _extract_one(
-    ex: Example, max_defs: int | None = None, gtype: str = "cfg"
+    ex: Example, max_defs: int | None = None, gtype: str = "cfg",
+    struct_feats: bool = False,
 ) -> ExtractedGraph | None:
     try:
         return extract_graph(
             ex.code, ex.id, set(ex.vuln_lines) or None, label=ex.label,
-            max_defs=max_defs, gtype=gtype,
+            max_defs=max_defs, gtype=gtype, struct_feats=struct_feats,
         )
     except Exception:
         # corpus-scale resilience: one pathological function must never
@@ -239,10 +254,12 @@ def _extract_one(
 def extract_corpus(
     examples: Sequence[Example], workers: int = 0,
     max_defs: int | None = None, gtype: str = "cfg",
+    struct_feats: bool = False,
 ) -> list[ExtractedGraph]:
     """Stage getgraphs+absdf-stage-1 over a corpus (mp fan-out like the
     reference's dfmp, sastvd/__init__.py:198-244)."""
-    fn = partial(_extract_one, max_defs=max_defs, gtype=gtype)
+    fn = partial(_extract_one, max_defs=max_defs, gtype=gtype,
+                 struct_feats=struct_feats)
     if workers and workers > 1:
         with Pool(workers) as pool:
             out = pool.map(fn, examples, chunksize=64)
@@ -278,10 +295,12 @@ def encode_corpus(
     workers: int = 0,
     max_defs: int | None = None,
     gtype: str = "cfg",
+    struct_feats: bool = False,
 ) -> list[GraphSpec]:
     """Extract + encode a corpus slice against pre-built vocabularies."""
     graphs = extract_corpus(
-        examples, workers=workers, max_defs=max_defs, gtype=gtype
+        examples, workers=workers, max_defs=max_defs, gtype=gtype,
+        struct_feats=struct_feats,
     )
     by_id = {ex.id: ex for ex in examples}
     return [
@@ -298,13 +317,15 @@ def build_dataset(
     workers: int = 0,
     max_defs: int | None = None,
     gtype: str = "cfg",
+    struct_feats: bool = False,
 ) -> tuple[list[GraphSpec], dict[str, AbsDfVocab]]:
     """Full single-process pipeline: extract, build train-split vocabs,
     encode everything. `max_defs` attaches reaching-definitions bit labels
     of that width for the dataflow_solution_{in,out} label styles;
     `gtype` selects the edge-relation set (see extract_graph)."""
     graphs = extract_corpus(
-        examples, workers=workers, max_defs=max_defs, gtype=gtype
+        examples, workers=workers, max_defs=max_defs, gtype=gtype,
+        struct_feats=struct_feats,
     )
     train = set(train_ids)
     train_fields = [

@@ -145,6 +145,7 @@ def pack(
     add_self_loops: bool = True,
     bits: int | None = None,
     etypes: bool | None = None,
+    feat_width: int | None = None,
 ) -> GraphBatch:
     """Pack host graphs into one padded batch (numpy arrays).
 
@@ -181,7 +182,20 @@ def pack(
         if bits is not None
         else {f: None for f in _BIT_FIELDS}
     )
-    node_feats = np.zeros((node_budget, NUM_SUBKEY_FEATS), np.int32)
+    # feature width follows the specs (struct_feats extraction appends
+    # fixed-vocab structural columns after the 4 subkey columns); the
+    # explicit `feat_width` override exists so an EMPTY shard can match
+    # its non-empty siblings (same pattern as `bits`/`etypes` above)
+    if feat_width is None:
+        feat_width = (
+            graphs[0].node_feats.shape[1] if graphs else NUM_SUBKEY_FEATS
+        )
+    elif graphs and graphs[0].node_feats.shape[1] != feat_width:
+        raise ValueError(
+            f"feat_width={feat_width} does not match graphs' width "
+            f"{graphs[0].node_feats.shape[1]}"
+        )
+    node_feats = np.zeros((node_budget, feat_width), np.int32)
     node_vuln = np.zeros((node_budget,), np.int32)
     node_graph = np.full((node_budget,), num_graphs, np.int32)
     node_mask = np.zeros((node_budget,), bool)
@@ -266,10 +280,11 @@ def _stack_shards(
     flat = [g for sg in per_shard for g in sg]
     bits = bit_width(flat)
     etypes = edge_typed(flat) if flat else False
+    feat_width = flat[0].node_feats.shape[1] if flat else None
     shards = [
         pack(
             sg, num_graphs, node_budget, edge_budget, add_self_loops, bits,
-            etypes,
+            etypes, feat_width,
         )
         for sg in per_shard
     ]

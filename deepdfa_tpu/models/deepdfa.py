@@ -47,6 +47,9 @@ class DeepDFA(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     #: mesh axis for edge-sharded message passing (parallel/graph_shard.py)
     edge_axis: str | None = None
+    #: embed the family-invariant structural channels appended after the
+    #: 4 subkey columns (frontend/structfeat.py; VERDICT r4 #3)
+    struct_feats: bool = False
 
     @classmethod
     def from_config(cls, cfg: ModelConfig, input_dim: int, **overrides) -> "DeepDFA":
@@ -60,6 +63,7 @@ class DeepDFA(nn.Module):
             concat_all_absdf=cfg.concat_all_absdf,
             label_style=cfg.label_style,
             encoder_mode=cfg.encoder_mode,
+            struct_feats=getattr(cfg, "struct_feats", False),
             param_dtype=jnp.dtype(cfg.param_dtype),
         )
         kw.update(overrides)
@@ -69,15 +73,25 @@ class DeepDFA(nn.Module):
     def out_dim(self) -> int:
         """Width of the encoder embedding (reference ggnn.py:62-64)."""
         mult = 4 if self.concat_all_absdf else 1
+        if self.struct_feats:
+            from deepdfa_tpu.frontend.structfeat import STRUCT_VOCAB
+
+            mult += len(STRUCT_VOCAB)
         return 2 * self.hidden_dim * mult
 
     @nn.compact
     def __call__(self, batch: GraphBatch) -> jax.Array:
+        struct_vocab: tuple[int, ...] = ()
+        if self.struct_feats:
+            from deepdfa_tpu.frontend.structfeat import STRUCT_VOCAB
+
+            struct_vocab = STRUCT_VOCAB
         embed = AbstractDataflowEmbedding(
             input_dim=self.input_dim,
             embedding_dim=self.hidden_dim,
             concat_all=self.concat_all_absdf,
             param_dtype=self.param_dtype,
+            struct_vocab=struct_vocab,
             name="embedding",
         )
         feat_embed = embed(batch.node_feats)

@@ -24,14 +24,34 @@ class AbstractDataflowEmbedding(nn.Module):
     embedding_dim: int  # per-table width (reference hidden_dim = 32)
     concat_all: bool = True
     param_dtype: jnp.dtype = jnp.float32
+    #: fixed vocab sizes for the family-invariant structural channels
+    #: appended after the 4 subkey columns (frontend/structfeat.py);
+    #: () = flagship-parity behavior (4 columns only)
+    struct_vocab: tuple[int, ...] = ()
 
     @property
     def out_dim(self) -> int:
-        return self.embedding_dim * (len(SUBKEY_ORDER) if self.concat_all else 1)
+        base = self.embedding_dim * (
+            len(SUBKEY_ORDER) if self.concat_all else 1
+        )
+        return base + self.embedding_dim * len(self.struct_vocab)
 
     @nn.compact
     def __call__(self, node_feats: jax.Array) -> jax.Array:
-        """node_feats: [N, 4] int32 -> [N, out_dim] embeddings."""
+        """node_feats: [N, 4 (+S)] int32 -> [N, out_dim] embeddings."""
+        # extraction ALWAYS writes the 4 subkey columns before any
+        # struct columns (data/pipeline.py to_graph_spec), regardless of
+        # how many the model embeds — struct offsets are fixed
+        struct_off = len(SUBKEY_ORDER)
+        if self.struct_vocab:
+            want = struct_off + len(self.struct_vocab)
+            if node_feats.shape[1] < want:
+                raise ValueError(
+                    f"struct_vocab={self.struct_vocab} needs "
+                    f"{want} feature columns, batch has "
+                    f"{node_feats.shape[1]} — extract the corpus with "
+                    "struct_feats=True"
+                )
         if self.concat_all:
             outs = []
             for i, name in enumerate(SUBKEY_ORDER):
@@ -42,11 +62,22 @@ class AbstractDataflowEmbedding(nn.Module):
                     param_dtype=self.param_dtype,
                 )
                 outs.append(emb(node_feats[:, i]))
-            return jnp.concatenate(outs, axis=-1)
-        emb = nn.Embed(
-            self.input_dim,
-            self.embedding_dim,
-            name="embed",
-            param_dtype=self.param_dtype,
-        )
-        return emb(node_feats[:, 0])
+        else:
+            emb = nn.Embed(
+                self.input_dim,
+                self.embedding_dim,
+                name="embed",
+                param_dtype=self.param_dtype,
+            )
+            outs = [emb(node_feats[:, 0])]
+        for j, vocab in enumerate(self.struct_vocab):
+            emb = nn.Embed(
+                vocab,
+                self.embedding_dim,
+                name=f"embed_struct_{j}",
+                param_dtype=self.param_dtype,
+            )
+            outs.append(emb(node_feats[:, struct_off + j]))
+        if len(outs) == 1:
+            return outs[0]
+        return jnp.concatenate(outs, axis=-1)

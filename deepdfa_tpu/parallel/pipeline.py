@@ -93,16 +93,30 @@ def _gpipe_schedule(
     """
     b_total, seq = ids.shape
     m = microbatches
-    if b_total % m:
-        raise ValueError(f"batch {b_total} not divisible by {m} microbatches")
-    ids_m = ids.reshape(m, b_total // m, seq)
-    mask_m_all = mask.reshape(m, b_total // m, seq)
+    # uneven batches pad up to the next multiple of m by REPLICATING the
+    # last real row (ids and mask together, so the padded rows are
+    # numerically ordinary — no degenerate all-masked attention rows),
+    # then slice the pad back off after the schedule. All static-shape
+    # Python: XLA sees one fixed program. Backward is automatically
+    # right: the slice's VJP zero-fills the padded rows' cotangents, so
+    # they contribute nothing to parameter gradients.
+    b_pad = -(-b_total // m) * m
+    if b_pad != b_total:
+        n_pad = b_pad - b_total
+        ids = jnp.concatenate(
+            [ids, jnp.broadcast_to(ids[-1:], (n_pad, seq))], axis=0
+        )
+        mask = jnp.concatenate(
+            [mask, jnp.broadcast_to(mask[-1:], (n_pad, seq))], axis=0
+        )
+    ids_m = ids.reshape(m, b_pad // m, seq)
+    mask_m_all = mask.reshape(m, b_pad // m, seq)
 
     stage = jax.lax.axis_index(pp_axis)
     steps = m + n_stages - 1
     dt = jnp.dtype(dtype)
-    state0 = jnp.zeros((b_total // m, seq, hidden_size), dt)
-    out0 = jnp.zeros((m, b_total // m, seq, hidden_size), dt)
+    state0 = jnp.zeros((b_pad // m, seq, hidden_size), dt)
+    out0 = jnp.zeros((m, b_pad // m, seq, hidden_size), dt)
 
     def step(carry, t):
         state, outputs = carry
@@ -139,7 +153,7 @@ def _gpipe_schedule(
         outputs = region_end(outputs, pp_axis)
     else:
         raise ValueError(f"broadcast={broadcast!r}")
-    return outputs.reshape(b_total, seq, -1)
+    return outputs.reshape(b_pad, seq, -1)[:b_total]
 
 
 def _stage_block_fn(layers_local: dict, dropout_key, cfg, layer_call):
@@ -322,7 +336,8 @@ def pipeline_encode(
     Same contract as models.transformer.encode ([B, T] ids -> [B, T, D]),
     numerically identical to the single-device path (parity-tested).
     `params` is the standard (unstaged) param tree; staging happens here.
-    The batch must divide by `microbatches`.
+    Uneven batches are handled: the final microbatch is padded with
+    replicated rows inside the schedule and sliced off after.
     """
     from deepdfa_tpu.parallel.compat import shard_map
 

@@ -31,7 +31,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepdfa_tpu.core.config import Config
-from deepdfa_tpu.graphs.batch import GraphBatch
+from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS, GraphBatch
 from deepdfa_tpu.parallel.compat import shard_map
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.checkpoint import CheckpointManager
@@ -75,8 +75,21 @@ def drop_known_feats(node_feats, key, rate: float):
     import jax.numpy as jnp
 
     drop = jax.random.bernoulli(key, rate, (node_feats.shape[0],))
-    mask = drop if node_feats.ndim == 1 else drop[:, None]
-    return jnp.where(mask, jnp.minimum(node_feats, 1), node_feats)
+    if node_feats.ndim == 1:
+        return jnp.where(drop, jnp.minimum(node_feats, 1), node_feats)
+    dropped = jnp.where(
+        drop[:, None], jnp.minimum(node_feats, 1), node_feats
+    )
+    if node_feats.shape[1] > NUM_SUBKEY_FEATS:
+        # structural columns (frontend/structfeat.py) have no UNKNOWN
+        # semantics — they are family-invariant by construction and must
+        # never be anonymized (a struct value clamped to 1 would be a
+        # DIFFERENT valid bucket, not "unknown")
+        dropped = jnp.concatenate(
+            [dropped[:, :NUM_SUBKEY_FEATS],
+             node_feats[:, NUM_SUBKEY_FEATS:]], axis=1
+        )
+    return dropped
 
 
 class GraphTrainer:

@@ -143,13 +143,8 @@ def run_bench_suite(platform: str) -> dict:
             "bench_combined_tpu.json" if arch == "roberta"
             else "bench_combined_t5_tpu.json",
         )
+        launched_at = time.time()
         try:
-            # clear any prior window's file so _load_partial can only
-            # ever see what THIS child wrote
-            try:
-                os.remove(combined_out)
-            except OSError:
-                pass
             res = subprocess.run(
                 [
                     sys.executable,
@@ -164,12 +159,12 @@ def run_bench_suite(platform: str) -> dict:
                     record[key] = json.load(f)
             else:
                 record[f"{key}_error"] = (res.stderr or res.stdout)[-500:]
-                _load_partial(record, key, combined_out)
+                _load_partial(record, key, combined_out, launched_at)
         except subprocess.TimeoutExpired:
             record[f"{key}_error"] = f"bench_combined.py {arch} exceeded {budget}s"
             # the sweep checkpoints its out-file after every variant, so
             # a budget kill mid-sweep still leaves measured variants
-            _load_partial(record, key, combined_out)
+            _load_partial(record, key, combined_out, launched_at)
 
     # inference + localization timings (the Table 5 15.4 ms/ex row and
     # the explanation-path cost) — cheap, forward-dominated
@@ -230,15 +225,20 @@ def run_bench_suite(platform: str) -> dict:
     return record
 
 
-def _load_partial(record: dict, key: str, path: str) -> None:
+def _load_partial(
+    record: dict, key: str, path: str, launched_at: float
+) -> None:
     """Fold a partial (checkpointed) sweep out-file into the record.
 
-    Only a file the just-killed child actually wrote counts: the caller
-    removes the out-file before launching the child, and the 'partial'
-    flag distinguishes a checkpoint from a completed record — without
-    both guards a prior window's committed artifact could be resurrected
-    as this window's evidence."""
+    Only a file the just-killed child actually wrote counts: the mtime
+    must postdate the child's launch, and the 'partial' flag
+    distinguishes a checkpoint from a completed record — without both
+    guards a prior window's committed artifact could be resurrected as
+    this window's evidence (the prior artifact itself is left on disk
+    untouched)."""
     try:
+        if os.path.getmtime(path) < launched_at - 1.0:
+            return  # prior window's file: the child never wrote
         with open(path) as f:
             partial = json.load(f)
         if isinstance(partial, dict) and partial.get("partial") \

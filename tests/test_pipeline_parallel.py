@@ -90,11 +90,35 @@ def test_pipeline_gradients_match(setup):
         )
 
 
-def test_pipeline_batch_divisibility_checked(setup):
+def test_pipeline_uneven_final_microbatch(setup):
+    """B=8 with microbatches=3: the schedule pads the final microbatch
+    with replicated rows and slices them off — forward parity AND grad
+    parity must hold exactly as in the divisible case (the VERDICT-r4
+    uneven-microbatch gap)."""
     cfg, params, ids = setup
     mesh = make_mesh(MeshConfig(dp=1, pp=2), devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="not divisible"):
-        pipeline_encode(cfg, params, ids, mesh, microbatches=3)
+    want = np.asarray(encode(cfg, params, ids))
+    got = np.asarray(
+        jax.jit(
+            lambda p, i: pipeline_encode(cfg, p, i, mesh, microbatches=3)
+        )(params, ids)
+    )
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def loss_pp(p):
+        h = pipeline_encode(cfg, p, ids, mesh, microbatches=3)
+        return jnp.sum(cls_pool(cfg, p, h) ** 2)
+
+    def loss_1(p):
+        return jnp.sum(cls_pool(cfg, p, encode(cfg, p, ids)) ** 2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(params)
+    g_1 = jax.jit(jax.grad(loss_1))(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_1)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-3
+        )
 
 
 def test_pipeline_dropout_runs_and_differs_across_stages(setup):
