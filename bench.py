@@ -275,9 +275,11 @@ def run_train_measurement(platform: str) -> dict:
     scan = platform != "cpu" if scan_env == "auto" else scan_env == "1"
 
     specs = flagship_corpus(n_examples)
+    t_pack = time.perf_counter()
     batches = list(
         shard_bucket_batches(specs, 1, 256, 16384, 65536, oversized="raise")
     )
+    host_pack_seconds = time.perf_counter() - t_pack
 
     cfg = Config()
     cfg = dataclasses.replace(
@@ -287,20 +289,35 @@ def run_train_measurement(platform: str) -> dict:
     trainer = GraphTrainer(model, cfg)
     state = trainer.init_state(batches[0])
 
-    state, warm_loss = trainer.train_step(state, batches[0])  # compile+warmup
+    from deepdfa_tpu.data.prefetch import PipelineStats, device_placer, prefetch
+
+    placer = device_placer(trainer.mesh)
+    # warm up with the SAME committed sharding the timed loop's
+    # device_placer uses — a raw host batch here would leave the
+    # placer-committed signature uncompiled and the first timed rep
+    # would absorb a recompile (scripts/bench_prefetch.py:_warm_compile)
+    state, warm_loss = trainer.train_step(state, placer(batches[0]))
     float(warm_loss)  # fetch-bounded (see inference warmup note)
 
     n_per_pass = sum(int(np.asarray(b.graph_mask).sum()) for b in batches)
+    # batches ride the instrumented prefetch pipeline (pre-packed, so the
+    # source stage is ~free): input_wait_fraction isolates how much of the
+    # timed window the device sat waiting on host H2D — the host-vs-device
+    # attribution a CPU-fallback record otherwise cannot make
     rates = []
+    wait_fracs = []
     for _ in range(reps):
+        stats = PipelineStats()
         t0 = time.perf_counter()
         loss = None
-        for b in batches:
+        for b in prefetch(iter(batches), 2, placer, stats=stats):
             state, loss = trainer.train_step(state, b)
         # host fetch (see inference note): the scalar's arrival on host
         # transitively proves every chained train_step completed
         float(loss)
-        rates.append(n_per_pass / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        rates.append(n_per_pass / dt)
+        wait_fracs.append(stats.wait_fraction(dt))
 
     value = float(np.median(rates))
     result = {
@@ -310,6 +327,10 @@ def run_train_measurement(platform: str) -> dict:
         "train_platform": jax.devices()[0].platform,
         "train_scan_steps": scan,
         "train_n_examples": n_examples,
+        # host-side attribution (ISSUE 1 satellite): one-time packing cost
+        # of the workload + fraction of a timed pass spent input-blocked
+        "host_pack_seconds": round(host_pack_seconds, 3),
+        "input_wait_fraction": round(float(np.median(wait_fracs)), 4),
     }
     try:
         cost = compiled_cost(

@@ -309,45 +309,134 @@ def _load_graph_splits(cfg: Config):
     return out
 
 
-def _epoch_batches(cfg: Config, specs, mesh, shuffle_epoch=None, phase="train"):
+class _BatchStream:
+    """Single-use lazy batch stream whose `source_stage` tells the
+    prefetch pipeline where to book pull time (PipelineStats): "pack"
+    for live packing, "load" for warm cache replay — so per-epoch
+    records attribute host time to the stage that actually ran."""
+
+    def __init__(self, it, source_stage: str):
+        self._it = iter(it)
+        self.source_stage = source_stage
+
+    def __iter__(self):
+        return self._it
+
+
+def _epoch_batches(
+    cfg: Config, specs, mesh, shuffle_epoch=None, phase="train",
+    source_digest=None, packer=None, lazy=False,
+):
     """Budget-aware dp-sharded batches for one pass over `specs`.
 
     phase="train": over-budget graphs are dropped (and counted loudly);
     phase="eval": they get dedicated pow2-budget overflow batches so
     every example is scored (reference evaluates every graph by shrinking
     test batches, DDFA/sastvd/linevd/datamodule.py:135-141).
+
+    Host pipeline knobs (docs/input_pipeline.md): data.pack_workers > 1
+    packs on a spawn process pool — pass a long-lived `packer`
+    (MpPacker bound to `specs`) to reuse one pool across epochs instead
+    of paying spawn + corpus pickle every epoch; data.packed_cache (with
+    a `source_digest` of the split corpus) persists the packed stream
+    and replays it zero-copy when the content key matches — the
+    selection is deterministic in (epoch, seed), so the key covers it
+    exactly.
     """
     import numpy as np
 
     from deepdfa_tpu.graphs import shard_bucket_batches
     from deepdfa_tpu.train import undersample_epoch
 
+    if packer is not None and packer.graphs is not specs:
+        raise ValueError(
+            "packer must be bound to the same corpus as `specs` — its "
+            "plans index into the corpus it was constructed with"
+        )
     dp = mesh.shape.get("dp", 1)
     bcfg = cfg.data.batch
-    if shuffle_epoch is not None and cfg.data.undersample:
-        labels = np.array([s.label for s in specs])
-        idx = undersample_epoch(labels, shuffle_epoch, seed=cfg.data.seed)
-        sel = [specs[i] for i in idx]
-    else:
-        sel = list(specs)
-    stats: dict = {}
-    out = list(
-        shard_bucket_batches(
-            sel,
-            num_shards=dp,
-            num_graphs=max(1, bcfg.graphs_per_batch // dp),
-            node_budget=bcfg.node_budget,
-            edge_budget=bcfg.edge_budget,
-            oversized="drop" if phase == "train" else "singleton",
-            stats=stats,
-        )
+    batcher = dict(
+        num_shards=dp,
+        num_graphs=max(1, bcfg.graphs_per_batch // dp),
+        node_budget=bcfg.node_budget,
+        edge_budget=bcfg.edge_budget,
+        oversized="drop" if phase == "train" else "singleton",
     )
-    if stats.get("dropped"):
-        print(
-            f"[batch] dropped {stats['dropped']}/{len(sel)} over-budget "
-            f"graphs (training only; eval scores every example)"
+    # per-epoch undersampling is the only reason the stream varies across
+    # epochs; without it one cache entry serves every epoch and re-run
+    undersampling = bool(shuffle_epoch is not None and cfg.data.undersample)
+
+    def build():
+        # selection runs here, not up front: the key derives it from
+        # (epoch, seed, digest), so a warm cache hit skips it entirely
+        idx = None
+        if undersampling:
+            labels = np.array([s.label for s in specs])
+            idx = undersample_epoch(labels, shuffle_epoch, seed=cfg.data.seed)
+            sel = [specs[i] for i in idx]
+        else:
+            sel = list(specs)
+        stats: dict = {}
+        if packer is not None:
+            it = packer.shard_bucket_batches(
+                stats=stats, select=idx, **batcher
+            )
+        elif cfg.data.pack_workers > 1:
+            from deepdfa_tpu.data.mp_pack import mp_shard_bucket_batches
+
+            it = mp_shard_bucket_batches(
+                sel, stats=stats, workers=cfg.data.pack_workers, **batcher
+            )
+        else:
+            it = shard_bucket_batches(sel, stats=stats, **batcher)
+        yield from it
+        if stats.get("dropped"):
+            print(
+                f"[batch] dropped {stats['dropped']}/{len(sel)} over-budget "
+                f"graphs (training only; eval scores every example)"
+            )
+
+    if cfg.data.packed_cache and source_digest is not None:
+        from deepdfa_tpu.data.packed_cache import PackedBatchCache, cache_key
+
+        cache = PackedBatchCache(
+            paths.cache_dir(cfg.data.dataset) / "packed",
+            max_entries=cfg.data.packed_cache_max_entries,
         )
-    return out
+        key = cache_key(
+            dict(
+                batcher,
+                # every packing path here leaves add_self_loops at its
+                # default (True) except a packer bound with it off; it
+                # changes the packed bytes, so it must enter the key
+                add_self_loops=(
+                    packer.add_self_loops if packer is not None else True
+                ),
+                phase=phase,
+                # epoch only shapes the stream when undersampling
+                # resamples per epoch; keying it unconditionally would
+                # turn every epoch of a non-undersampled run into a cold
+                # miss that writes a duplicate entry
+                epoch=shuffle_epoch if undersampling else None,
+                undersample=undersampling,
+                data_seed=cfg.data.seed,
+            ),
+            source_digest,
+        )
+        # warmness decides the stage label up front; get_or_pack itself
+        # stays lazy so a `lazy` caller's prefetch pipeline times the
+        # pulls (an eager list would book the whole cost outside the
+        # instrumented window and report zeros). Known limit: the label
+        # is per-epoch, so if a shared entry is evicted by a concurrent
+        # run mid-replay, the rebuilt remainder is still booked as
+        # "load" for that epoch
+        stage = "load" if cache.has(key) else "pack"
+        stream = cache.get_or_pack(key, build)
+    else:
+        stage, stream = "pack", build()
+    if lazy:
+        return _BatchStream(stream, stage)
+    return list(stream)
 
 
 def cmd_train(args) -> None:
@@ -375,29 +464,72 @@ def cmd_train(args) -> None:
     pw = None
     if cfg.train.pos_weight is None and not cfg.data.undersample:
         pw = positive_weight(np.array([s.label for s in split_specs["train"]]))
-    # epoch-0 batches double as the warmup-schedule step estimate (the
-    # undersampled epoch size; warmup_frac needs total_steps at
-    # optimizer construction, train/state.py:make_optimizer)
-    batches0 = _epoch_batches(cfg, split_specs["train"], mesh, shuffle_epoch=0)
-    trainer = GraphTrainer(
-        model, cfg, mesh=mesh, pos_weight=pw,
-        total_steps=len(batches0) * max(1, cfg.train.max_epochs),
-    )
-    state = trainer.init_state(batches0[0])
-    ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
+    # content digests key the packed-batch cache (computed once per run;
+    # covers array bytes + ordering, so any re-extraction invalidates)
+    train_digest = val_digest = None
+    if cfg.data.packed_cache:
+        from deepdfa_tpu.data.packed_cache import corpus_digest
 
-    with RunLogger(run_dir) as run_log:
-        state = trainer.fit(
-            state,
-            lambda epoch: _epoch_batches(cfg, split_specs["train"], mesh, epoch),
-            val_batches=lambda: _epoch_batches(
-                cfg, split_specs["val"], mesh, phase="eval"
-            ),
-            checkpoints=ckpts,
-            log_fn=nni_bridge.intermediate_log_fn(
-                cfg.train.monitor, run_log.log
-            ),
+        train_digest = corpus_digest(split_specs["train"])
+        val_digest = corpus_digest(split_specs["val"])
+    # one spawn pool for the whole run (pool construction pickles the
+    # corpus to every worker — paying that per epoch can rival the
+    # packing it parallelizes); the pool itself is lazy, so a fully
+    # warm packed-cache run never spawns a worker
+    packer = val_packer = None
+    if cfg.data.pack_workers > 1:
+        from deepdfa_tpu.data.mp_pack import MpPacker
+
+        packer = MpPacker(
+            split_specs["train"], workers=cfg.data.pack_workers
         )
+        val_packer = MpPacker(
+            split_specs["val"], workers=cfg.data.pack_workers
+        )
+    try:
+        # epoch-0 batches double as the warmup-schedule step estimate (the
+        # undersampled epoch size; warmup_frac needs total_steps at
+        # optimizer construction, train/state.py:make_optimizer)
+        batches0 = _epoch_batches(
+            cfg, split_specs["train"], mesh, shuffle_epoch=0,
+            source_digest=train_digest, packer=packer,
+        )
+        trainer = GraphTrainer(
+            model, cfg, mesh=mesh, pos_weight=pw,
+            total_steps=len(batches0) * max(1, cfg.train.max_epochs),
+        )
+        state = trainer.init_state(batches0[0])
+        ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
+
+        def val_batches():
+            out = _epoch_batches(
+                cfg, split_specs["val"], mesh, phase="eval",
+                source_digest=val_digest, packer=val_packer,
+            )
+            if cfg.data.packed_cache and val_packer is not None:
+                # the eval entry (epoch-independent key) is cached now:
+                # release the idle pool's workers + corpus copy for the
+                # rest of the run; _get_pool respawns it if ever needed
+                val_packer.close()
+            return out
+
+        with RunLogger(run_dir) as run_log:
+            state = trainer.fit(
+                state,
+                lambda epoch: _epoch_batches(
+                    cfg, split_specs["train"], mesh, epoch,
+                    source_digest=train_digest, packer=packer, lazy=True,
+                ),
+                val_batches=val_batches,
+                checkpoints=ckpts,
+                log_fn=nni_bridge.intermediate_log_fn(
+                    cfg.train.monitor, run_log.log
+                ),
+            )
+    finally:
+        for p in (packer, val_packer):
+            if p is not None:
+                p.close()
     best = ckpts.best_metrics()
     if best and cfg.train.monitor in best:
         nni_bridge.report_final(best[cfg.train.monitor])

@@ -98,6 +98,23 @@ def set_platform(platform: str, n_devices: int | None = None):
     """
     import jax
 
+    has_count_opt = hasattr(jax.config, "jax_num_cpu_devices")
+    if n_devices is not None and not has_count_opt:
+        # jax < 0.5 has no jax_num_cpu_devices option; the device count
+        # can only come from XLA_FLAGS, and XLA parses those ONCE per
+        # process (C++ flag cache) — rewrite them now, before the first
+        # backend init below can trigger that parse
+        import re
+
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{int(n_devices)}"
+        ).strip()
     if jax.config.jax_platforms == platform:
         # already there: don't clear_backends (that would invalidate live
         # arrays and jit caches from earlier work in this process)
@@ -108,9 +125,19 @@ def set_platform(platform: str, n_devices: int | None = None):
 
     clear_backends()
     jax.config.update("jax_platforms", platform)
-    if n_devices is not None:
+    if n_devices is not None and has_count_opt:
         jax.config.update("jax_num_cpu_devices", int(n_devices))
-    return jax.devices()
+    devs = jax.devices()
+    if n_devices is not None and len(devs) != int(n_devices):
+        # a backend initialized earlier in this process pinned the XLA
+        # flag cache; a fresh process is the only way to change it
+        print(
+            f"[backend] wanted {n_devices} {platform} device(s) but the "
+            f"process is stuck with {len(devs)} (XLA flags are parsed "
+            "once); continuing with the existing devices",
+            file=sys.stderr,
+        )
+    return devs
 
 
 def force_cpu(n_devices: int | None = None):

@@ -143,6 +143,20 @@ class DataConfig:
     sample_mode: bool = False
     undersample: bool = True  # epoch-wise 1:1 undersampling of negatives
     batch: BatchConfig = field(default_factory=BatchConfig)
+    # host input pipeline (docs/input_pipeline.md):
+    # pack_workers > 1 packs first-epoch batches on a spawn process pool
+    # (data/mp_pack.py) — packing is GIL-bound, threads cannot scale it
+    pack_workers: int = 0
+    # persist fully-packed batch streams under cache/<dataset>/packed and
+    # replay them zero-copy (mmap) when the content key matches — epochs
+    # with identical selections and every re-run skip packing entirely
+    # (data/packed_cache.py)
+    packed_cache: bool = False
+    # entry cap for that cache: undersample selections are epoch-keyed
+    # (one entry per epoch), so finalizing a new entry evicts the
+    # least-recently-USED beyond this many (replay refreshes an entry's
+    # stamp — the eval split, replayed every epoch, never ages out)
+    packed_cache_max_entries: int = 64
 
 
 @dataclass(frozen=True)
@@ -190,11 +204,16 @@ class TrainConfig:
     # jitted computation + enable jax's internal invariant checks
     debug_nans: bool = False
     enable_checks: bool = False
-    # async input pipeline: batches assembled + device_put by a background
-    # thread this many steps ahead of the training step (the reference
+    # async input pipeline: batches assembled + device_put by background
+    # threads this many steps ahead of the training step (the reference
     # overlaps input work via DataLoader workers, datamodule.py:110-141);
     # 0 disables and iterates inline
     prefetch_batches: int = 2
+    # producer threads in the prefetch pipeline: source pulls stay
+    # serialized (ordering guarantee) but sharded device_put runs
+    # concurrently — raise when H2D placement is a visible slice of
+    # host_place_seconds in the epoch records
+    prefetch_producers: int = 1
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

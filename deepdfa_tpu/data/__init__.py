@@ -1,4 +1,10 @@
 from deepdfa_tpu.data.diffs import diff_lines, vulnerable_lines
+from deepdfa_tpu.data.mp_pack import MpPacker, mp_shard_bucket_batches
+from deepdfa_tpu.data.packed_cache import (
+    PackedBatchCache,
+    cache_key,
+    corpus_digest,
+)
 from deepdfa_tpu.data.pipeline import (
     Example,
     ExtractedGraph,
@@ -7,6 +13,7 @@ from deepdfa_tpu.data.pipeline import (
     extract_graph,
     to_graph_spec,
 )
+from deepdfa_tpu.data.prefetch import PipelineStats, device_placer, prefetch
 from deepdfa_tpu.data.synthetic import (
     SynthExample,
     bigvul_stmt_sizes,
@@ -19,6 +26,14 @@ from deepdfa_tpu.data.synthetic import (
 __all__ = [
     "diff_lines",
     "vulnerable_lines",
+    "MpPacker",
+    "mp_shard_bucket_batches",
+    "PackedBatchCache",
+    "cache_key",
+    "corpus_digest",
+    "PipelineStats",
+    "device_placer",
+    "prefetch",
     "Example",
     "ExtractedGraph",
     "build_dataset",

@@ -1,0 +1,388 @@
+"""Multiprocess packing producer: parallelize first-epoch batch packing
+across cores.
+
+Packing (graphs/batch.py:pack) is GIL-bound python/numpy slicing, so the
+prefetch THREAD cannot scale it — this module distributes it over a spawn
+process pool instead (the reference leans on DataLoader worker processes
+for the same reason, DDFA/sastvd/linevd/datamodule.py:110-141). The split
+mirrors the batcher's own structure: the parent runs the cheap sequential
+PLANNER (`plan_shard_bucket_batches`), workers run `pack_plan` on the
+numpy-heavy plans, and results come back through POSIX shared memory —
+one writer-side copy into the segment and one reader-side copy out, never
+a pickle of array bytes through a pipe. Order and content are
+
+bit-identical to the inline batcher (same plans, same pack function;
+pinned by tests/test_packed_cache.py).
+
+Spawn safety: workers receive the corpus once at pool construction (not
+per task), the worker entry points are module-level, and nothing here
+requires fork semantics — safe next to an initialized TPU/XLA runtime,
+which fork would corrupt.
+
+Scope note: this accelerates the COLD path (first epoch of a new cache
+key). Epochs >= 2 should replay the packed-batch cache
+(data/packed_cache.py), which skips packing entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import multiprocessing as mp
+import os
+from collections import deque
+from multiprocessing import shared_memory
+from pathlib import Path
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.graphs.batch import (
+    ARRAY_FIELDS as _ARRAY_FIELDS,
+    BatchPlan,
+    GraphBatch,
+    GraphSpec,
+    pack_plan,
+    plan_shard_bucket_batches,
+)
+
+# worker-process globals, set once by _init_worker (spawn ships them via
+# the initargs pickle exactly once per worker, not per task)
+_WORKER: dict = {}
+
+#: segments are NAMED "<_SHM_PREFIX>-<parent pid>-<packer token>-..." so
+#: the parent can sweep leftovers it never received: terminate() discards
+#: queued results and kills mid-pack workers, and with track=False nothing
+#: else ever unlinks those segments (close() sweeps its own prefix;
+#: _sweep_stale collects dead parents' leftovers from crashed runs)
+_SHM_PREFIX = "dfapack"
+_SHM_DIR = Path("/dev/shm")
+_PACKER_TOKENS = itertools.count()
+
+
+def _init_worker(
+    graphs: Sequence[GraphSpec],
+    add_self_loops: bool,
+    shm_prefix: str = "",
+) -> None:
+    _WORKER["graphs"] = graphs
+    _WORKER["add_self_loops"] = add_self_loops
+    _WORKER["shm_prefix"] = shm_prefix
+    _WORKER["seq"] = 0
+
+
+def _shm_create(size: int) -> shared_memory.SharedMemory:
+    name = None
+    if _WORKER.get("shm_prefix"):
+        _WORKER["seq"] += 1
+        name = f"{_WORKER['shm_prefix']}{os.getpid()}-{_WORKER['seq']}"
+    try:
+        # track=False (3.13+): the segment's lifetime is managed by the
+        # PARENT (attach -> copy out -> unlink); without it the worker's
+        # resource tracker would warn about / unlink segments it thinks
+        # leaked
+        return shared_memory.SharedMemory(
+            name=name, create=True, size=size, track=False
+        )
+    except TypeError:
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        return shm
+
+
+def _sweep_prefix(prefix: str) -> int:
+    """Unlink every segment under `prefix` (linux /dev/shm backing; a
+    no-op elsewhere — non-linux callers only leak on terminate, which the
+    pickle fallback already tolerates). Returns segments removed."""
+    if not _SHM_DIR.is_dir():
+        return 0
+    n = 0
+    for p in _SHM_DIR.glob(f"{prefix}*"):
+        try:
+            p.unlink()
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
+def _sweep_stale() -> int:
+    """Collect segments left by packer parents that are GONE (hard crash
+    / kill -9: no close(), no _drain). Own-pid and live-pid prefixes are
+    never touched — a sibling packer in this or another live process may
+    be mid-pack."""
+    if not _SHM_DIR.is_dir():
+        return 0
+    n = 0
+    for p in _SHM_DIR.glob(f"{_SHM_PREFIX}-*"):
+        try:
+            owner = int(p.name.split("-")[1])
+        except (IndexError, ValueError):
+            continue
+        if owner == os.getpid():
+            continue
+        try:
+            os.kill(owner, 0)
+            continue  # owner alive
+        except ProcessLookupError:
+            pass  # owner gone -> segment is garbage
+        except OSError:
+            continue  # e.g. EPERM: alive, different user
+        try:
+            p.unlink()
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
+def _pack_one(plan: BatchPlan):
+    """Worker entry: pack one plan, hand the arrays back via shared
+    memory. Returns ("shm", name, manifest, num_graphs) or, when a
+    segment cannot be created (e.g. /dev/shm exhausted),
+    ("pickle", batch) as a degraded-but-correct fallback."""
+    batch = pack_plan(
+        _WORKER["graphs"], plan, _WORKER["add_self_loops"]
+    )
+    leaves = [
+        (name, np.ascontiguousarray(getattr(batch, name)))
+        for name in _ARRAY_FIELDS
+        if getattr(batch, name) is not None
+    ]
+    total = sum(a.nbytes for _, a in leaves)
+    try:
+        shm = _shm_create(max(1, total))
+    except OSError:
+        return ("pickle", batch)
+    manifest = []
+    off = 0
+    for name, a in leaves:
+        dst = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf, offset=off)
+        dst[...] = a
+        manifest.append((name, str(a.dtype), a.shape, off))
+        off += a.nbytes
+    name = shm.name
+    shm.close()
+    return ("shm", name, manifest, int(batch.num_graphs))
+
+
+def _discard_shm(name: str) -> None:
+    """Unlink a segment whose contents will never be received (consumer
+    abandoned the stream) — only the parent may unlink (track=False)."""
+    try:
+        shm = shared_memory.SharedMemory(name=name)
+    except FileNotFoundError:
+        return
+    shm.close()
+    try:
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _receive(result) -> GraphBatch:
+    if result[0] == "pickle":
+        return result[1]
+    _, name, manifest, num_graphs = result
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        arrays = {}
+        for fname, dtype, shape, off in manifest:
+            view = np.ndarray(
+                tuple(shape), dtype=np.dtype(dtype), buffer=shm.buf,
+                offset=off,
+            )
+            # copy out so the segment can be unlinked immediately —
+            # holding mmap views hostage to consumer lifetime risks
+            # BufferError on close and /dev/shm leaks on crash; the copy
+            # is one memcpy and the batch is device_put right after
+            # anyway (zero-copy host replay is the cache's job,
+            # data/packed_cache.py)
+            arrays[fname] = view.copy()
+        return GraphBatch(
+            **{n: arrays.get(n) for n in _ARRAY_FIELDS},
+            num_graphs=num_graphs,
+        )
+    finally:
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class MpPacker:
+    """A reusable spawn-pool packer bound to one corpus.
+
+    Construction cost (spawn + corpus pickle + jax import per worker) is
+    paid once, lazily on the first `pack` that needs it — a caller can
+    hold a packer for a whole run and never spawn a worker if every
+    epoch replays the packed-batch cache. `shard_bucket_batches` can
+    then be called every epoch. Use as a context manager, or call
+    close().
+    """
+
+    def __init__(
+        self,
+        graphs: Iterable[GraphSpec],
+        workers: int | None = None,
+        add_self_loops: bool = True,
+    ):
+        self.graphs = (
+            graphs if isinstance(graphs, Sequence) else list(graphs)
+        )
+        self.workers = (
+            workers if workers is not None else (os.cpu_count() or 1)
+        )
+        self.add_self_loops = add_self_loops
+        self._pool = None
+        # per-packer shm namespace: close() may sweep it wholesale
+        # without touching a sibling packer's live segments (cmd_train
+        # holds one packer per split in the same process)
+        self._shm_prefix = (
+            f"{_SHM_PREFIX}-{os.getpid()}-{next(_PACKER_TOKENS)}-"
+        )
+
+    def _get_pool(self):
+        if self._pool is None and self.workers > 1:
+            _sweep_stale()
+            ctx = mp.get_context("spawn")
+            self._pool = ctx.Pool(
+                self.workers,
+                initializer=_init_worker,
+                initargs=(self.graphs, self.add_self_loops, self._shm_prefix),
+            )
+        return self._pool
+
+    def __enter__(self) -> "MpPacker":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+            # terminate() discarded queued ("shm", name, ...) results and
+            # killed mid-pack workers; their segments are unreachable now
+            # — sweep this packer's whole namespace
+            _sweep_prefix(self._shm_prefix)
+
+    def _drain(self, pending) -> None:
+        """Receive-and-unlink every outstanding shm result. Runs when the
+        consumer abandons `pack` mid-stream: terminate() would discard
+        the queued ("shm", name, ...) tuples, and with track=False
+        nothing else ever unlinks those segments — they would pile up in
+        /dev/shm across runs until packing silently degrades to the
+        pickle fallback."""
+        for r in pending:
+            try:
+                result = r.get()
+            except Exception:
+                continue
+            if result[0] == "shm":
+                _discard_shm(result[1])
+
+    def pack(self, plans: Iterable[BatchPlan]) -> Iterator[GraphBatch]:
+        """Pack plans across the pool, yielding in plan order.
+
+        Dispatch is windowed (2*workers outstanding): imap's task
+        handler would eagerly consume every plan and let the pool race
+        a whole epoch ahead of a training-paced consumer, pinning each
+        packed batch's bytes in /dev/shm (or, once that fills and
+        _shm_create degrades to the pickle fallback, in the parent's
+        result queue) until received. The window keeps every worker busy
+        while bounding in-flight results to a constant.
+        """
+        pool = self._get_pool()
+        if pool is None:
+            for plan in plans:
+                yield pack_plan(self.graphs, plan, self.add_self_loops)
+            return
+        window = 2 * self.workers
+        it = iter(plans)
+        pending: deque = deque()
+
+        def fill() -> None:
+            while len(pending) < window:
+                plan = next(it, None)
+                if plan is None:
+                    return
+                pending.append(pool.apply_async(_pack_one, (plan,)))
+
+        try:
+            fill()
+            while pending:
+                result = pending.popleft().get()
+                fill()  # keep workers fed while the consumer trains
+                yield _receive(result)
+        except BaseException:
+            self._drain(pending)
+            raise
+
+    def shard_bucket_batches(
+        self,
+        num_shards: int,
+        num_graphs: int,
+        node_budget: int,
+        edge_budget: int,
+        oversized: str = "drop",
+        stats: dict | None = None,
+        select: Sequence[int] | None = None,
+    ) -> Iterator[GraphBatch]:
+        """Drop-in parallel `graphs.shard_bucket_batches` over this
+        corpus: identical plans, identical batches, packed on the pool.
+
+        `select` restricts (and orders) the pass to a subset of the
+        bound corpus by index — e.g. a per-epoch undersample selection —
+        without re-pickling graphs to the workers: plans are built over
+        the selection, then remapped to corpus indices before shipping.
+        """
+        if select is None:
+            src = self.graphs
+        else:
+            select = [int(i) for i in select]
+            src = [self.graphs[i] for i in select]
+        plans = plan_shard_bucket_batches(
+            src, num_shards, num_graphs, node_budget, edge_budget,
+            self.add_self_loops, oversized, stats,
+        )
+        if select is not None:
+            plans = (
+                dataclasses.replace(
+                    p,
+                    shard_indices=tuple(
+                        tuple(select[i] for i in idxs)
+                        for idxs in p.shard_indices
+                    ),
+                )
+                for p in plans
+            )
+        yield from self.pack(plans)
+
+
+def mp_shard_bucket_batches(
+    graphs: Sequence[GraphSpec],
+    num_shards: int,
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    add_self_loops: bool = True,
+    oversized: str = "drop",
+    stats: dict | None = None,
+    workers: int | None = None,
+) -> Iterator[GraphBatch]:
+    """One-shot convenience: pool lifetime = one pass over the corpus.
+    Prefer a long-lived MpPacker when packing every epoch."""
+    with MpPacker(graphs, workers, add_self_loops) as packer:
+        yield from packer.shard_bucket_batches(
+            num_shards, num_graphs, node_budget, edge_budget, oversized,
+            stats,
+        )

@@ -3,104 +3,304 @@ with device compute.
 
 The reference overlaps input work with GPU compute via DataLoader worker
 processes (DDFA/sastvd/linevd/datamodule.py:110-141). The TPU-native
-equivalent is a bounded producer thread: batch ASSEMBLY (python/numpy
+equivalent is a bounded producer pool: batch ASSEMBLY (python/numpy
 bucketing, tokenization, feature attach) runs ahead of the training step,
 and — when a `place` function is given — `jax.device_put` runs in the
-producer too, so the H2D copy of batch k+1 rides under the device compute
+producers too, so the H2D copy of batch k+1 rides under the device compute
 of batch k. Python threads suffice: assembly is numpy-bound (releases the
-GIL) and device_put is an async dispatch.
+GIL) and device_put is an async dispatch; CPU-bound first-epoch packing
+goes to processes instead (data/mp_pack.py).
 
 Semantics guarantee: a pure reordering in time. The consumer sees exactly
 the same elements in exactly the same order as iterating the source
-directly, so step counts and numerics are unchanged (pinned by
-tests/test_prefetch.py).
+directly — with ANY number of producers — so step counts and numerics are
+unchanged (pinned by tests/test_prefetch.py).
+
+Stage instrumentation: pass a `PipelineStats` and every stage's wall time
+accumulates into it — `load`/`pack` (source pulls, attributed via
+`source_stage`), `place` (H2D), `wait` (consumer blocked on the queue).
+The train loops surface these per epoch so end-to-end regressions are
+attributable to host vs device (docs/input_pipeline.md).
 """
 
 from __future__ import annotations
 
-import queue
+import dataclasses
 import threading
+import time
 from typing import Callable, Iterable, Iterator, TypeVar
 
 T = TypeVar("T")
 
-_DONE = object()
+#: producer threads poll the stop flag at this period when blocked; the
+#: abandon path joins them with a small multiple of it
+_POLL = 0.1
+_JOIN_TIMEOUT = 2.0
 
 
-class _Failure:
-    def __init__(self, exc: BaseException):
-        self.exc = exc
+@dataclasses.dataclass
+class PipelineStats:
+    """Per-stage wall-time counters for the host input pipeline.
+
+    Counters are cumulative seconds of per-stage work (summed across
+    producer threads, so with overlap they can exceed wall-clock):
+
+    - ``load_seconds``: reading pre-packed batches (cache replay / store
+      reads) — source pulls when ``source_stage="load"``.
+    - ``pack_seconds``: live batch assembly (bucketing + padding) —
+      source pulls when ``source_stage="pack"`` (the default).
+    - ``place_seconds``: sharded ``jax.device_put`` (H2D copy dispatch).
+    - ``wait_seconds``: consumer blocked waiting for the next batch — the
+      number that indicts the host when it stays high.
+    """
+
+    load_seconds: float = 0.0
+    pack_seconds: float = 0.0
+    place_seconds: float = 0.0
+    wait_seconds: float = 0.0
+    produced: int = 0
+    consumed: int = 0
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+
+    def add(self, stage: str, seconds: float, produced: int = 0) -> None:
+        with self._lock:
+            setattr(
+                self, f"{stage}_seconds",
+                getattr(self, f"{stage}_seconds") + seconds,
+            )
+            self.produced += produced
+
+    def wait_fraction(self, total_seconds: float) -> float:
+        """Fraction of a consumer's wall-clock spent blocked on input."""
+        return self.wait_seconds / total_seconds if total_seconds > 0 else 0.0
+
+    def record(self) -> dict[str, float]:
+        return {
+            "load_seconds": round(self.load_seconds, 4),
+            "pack_seconds": round(self.pack_seconds, 4),
+            "place_seconds": round(self.place_seconds, 4),
+            "wait_seconds": round(self.wait_seconds, 4),
+            "produced": self.produced,
+            "consumed": self.consumed,
+        }
 
 
 def prefetch(
     source: Iterable[T],
     size: int = 2,
     place: Callable[[T], T] | None = None,
+    producers: int = 1,
+    stats: PipelineStats | None = None,
+    source_stage: str = "pack",
 ) -> Iterator[T]:
-    """Iterate `source` through a `size`-deep background queue.
+    """Iterate `source` through a `size`-deep background pipeline.
 
-    place: optional callable run in the producer thread on each element
+    place: optional callable run in a producer thread on each element
     (typically a sharded jax.device_put); its result is what the consumer
     receives. Exceptions from the source or from `place` re-raise at the
     consumer's next pull. `size <= 0` disables prefetching entirely and
     iterates inline (the knob's off position).
+
+    producers: worker threads. Source pulls are always serialized (one
+    iterator), but `place` — and anything the source itself hands off —
+    runs concurrently, so >1 helps when H2D placement is a significant
+    slice of the budget. Output order is the source order regardless.
+
+    stats/source_stage: optional `PipelineStats` instrumentation; source
+    pull time lands in `pack_seconds` ("pack", live assembly) or
+    `load_seconds` ("load", cache replay).
+
+    Abandoning the iterator (break / close) stops and JOINS the producer
+    threads, so no background thread outlives the consumer pinning
+    device-resident batches.
     """
+    if source_stage not in ("pack", "load"):
+        raise ValueError(f"source_stage={source_stage!r}")
+    if stats is None:
+        stats = PipelineStats()
     if size <= 0:
-        for item in source:
-            yield place(item) if place is not None else item
-        return
-
-    q: queue.Queue = queue.Queue(maxsize=size)
-    stop = threading.Event()
-
-    def put_or_stop(item) -> bool:
-        """Bounded put that gives up when the consumer abandoned the
-        iterator — every producer put (including the terminal sentinel /
-        failure) must respect `stop`, or an abandoned consumer leaks a
-        blocked thread pinning device-resident batches."""
-        while not stop.is_set():
+        it = iter(source)
+        while True:
+            t0 = time.perf_counter()
             try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+                item = next(it)
+            except StopIteration:
+                return
+            stats.add(source_stage, time.perf_counter() - t0, produced=1)
+            if place is not None:
+                t0 = time.perf_counter()
+                item = place(item)
+                stats.add("place", time.perf_counter() - t0)
+            stats.consumed += 1
+            yield item
+
+    producers = max(1, int(producers))
+    src_iter = iter(source)
+    src_lock = threading.Lock()
+    cond = threading.Condition()
+    buf: dict[int, T] = {}
+    state = {
+        "next_in": 0,  # next index a producer will pull (under src_lock)
+        "next_out": 0,  # next index the consumer yields (under cond)
+        "done_at": None,  # source length once exhausted
+        "error": None,  # first failure, re-raised in source order
+        "stop": False,
+    }
 
     def producer() -> None:
-        try:
-            for item in source:
-                if place is not None:
-                    item = place(item)
-                if not put_or_stop(item):
+        while True:
+            if state["stop"]:
+                return
+            # bounded run-ahead, gated at the CLAIM: a claimed item is
+            # pulled and placed (device_put) before it reaches buf, so
+            # gating only the insert would let every producer hold one
+            # extra device-resident batch beyond the `size` bound the
+            # prefetch knob promises (size + producers + 1 resident)
+            with cond:
+                while (
+                    not state["stop"]
+                    and state["done_at"] is None
+                    and state["error"] is None
+                    and state["next_in"] >= state["next_out"] + max(1, size)
+                ):
+                    cond.wait(_POLL)
+            with src_lock:
+                if state["stop"]:
                     return
-            put_or_stop(_DONE)
-        except BaseException as e:  # re-raised consumer-side
-            put_or_stop(_Failure(e))
+                if state["done_at"] is not None or state["error"] is not None:
+                    return
+                if state["next_in"] >= state["next_out"] + max(1, size):
+                    # another producer claimed the slot while this one
+                    # was between the gate and the lock — re-wait
+                    continue
+                idx = state["next_in"]
+                t0 = time.perf_counter()
+                try:
+                    item = next(src_iter)
+                except StopIteration:
+                    with cond:
+                        state["done_at"] = idx
+                        cond.notify_all()
+                    return
+                except BaseException as e:
+                    with cond:
+                        if state["error"] is None:
+                            state["error"] = (idx, e)
+                        cond.notify_all()
+                    return
+                state["next_in"] = idx + 1
+                stats.add(source_stage, time.perf_counter() - t0, produced=1)
+            if place is not None:
+                try:
+                    t0 = time.perf_counter()
+                    item = place(item)
+                    stats.add("place", time.perf_counter() - t0)
+                except BaseException as e:
+                    with cond:
+                        if (
+                            state["error"] is None
+                            or state["error"][0] > idx
+                        ):
+                            state["error"] = (idx, e)
+                        cond.notify_all()
+                    return
+            with cond:
+                # idx was claimed inside the run-ahead window and
+                # next_out only grows, so the insert never needs to wait
+                if state["stop"]:
+                    return
+                buf[idx] = item
+                cond.notify_all()
 
-    t = threading.Thread(target=producer, daemon=True, name="batch-prefetch")
-    t.start()
+    threads = [
+        threading.Thread(
+            target=producer, daemon=True, name=f"batch-prefetch-{i}"
+        )
+        for i in range(producers)
+    ]
+    for t in threads:
+        t.start()
+
     try:
         while True:
-            item = q.get()
-            if item is _DONE:
-                return
-            if isinstance(item, _Failure):
-                raise item.exc
+            with cond:
+                t0 = time.perf_counter()
+                while True:
+                    nxt = state["next_out"]
+                    if nxt in buf:
+                        item = buf.pop(nxt)
+                        state["next_out"] = nxt + 1
+                        cond.notify_all()
+                        break
+                    # nxt is not buffered here; if the failure hit nxt (or
+                    # earlier), no producer will ever deliver it — re-raise
+                    err = state["error"]
+                    if err is not None and err[0] <= nxt:
+                        stats.add("wait", time.perf_counter() - t0)
+                        raise err[1]
+                    if state["done_at"] is not None and nxt >= state["done_at"]:
+                        stats.add("wait", time.perf_counter() - t0)
+                        return
+                    cond.wait(_POLL)
+                stats.add("wait", time.perf_counter() - t0)
+            stats.consumed += 1
             yield item
     finally:
-        stop.set()
+        state["stop"] = True
+        with cond:
+            buf.clear()  # drop refs so device batches free promptly
+            cond.notify_all()
+        for t in threads:
+            # a producer can only be blocked in cond polls (bounded) or a
+            # source pull; join with a timeout so an abandoned consumer
+            # never hangs — a daemon thread stuck in the source dies with
+            # the process either way
+            t.join(timeout=_JOIN_TIMEOUT)
 
 
 def device_placer(mesh, spec=None) -> Callable[[T], T]:
     """A `place` fn that device_puts a batch pytree with a NamedSharding
     (leading axis over dp by default) — static pytree metadata fields are
-    untouched, so jit cache keys are unchanged."""
+    untouched, so jit cache keys are unchanged.
+
+    Batches whose leading axis is not divisible by the sharded mesh axes
+    raise a clear ValueError naming the offending leaf, instead of XLA's
+    opaque sharding failure deep inside device_put/jit.
+    """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sharding = NamedSharding(mesh, spec if spec is not None else P("dp"))
+    spec = spec if spec is not None else P("dp")
+    sharding = NamedSharding(mesh, spec)
+    first = spec[0] if len(spec) else None
+    axes = (
+        (first,) if isinstance(first, str)
+        else tuple(first) if isinstance(first, (tuple, list))
+        else ()
+    )
+    divisor = 1
+    for ax in axes:
+        divisor *= mesh.shape.get(ax, 1)
+
+    def _validate(batch) -> None:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(batch)[0]:
+            shape = getattr(leaf, "shape", None)
+            if not shape:
+                continue
+            if shape[0] % divisor:
+                name = jax.tree_util.keystr(path)
+                raise ValueError(
+                    f"batch leaf {name} has leading axis {shape[0]}, not "
+                    f"divisible by mesh axes {axes} (size {divisor}) — "
+                    f"pack with num_shards={divisor} (train CLI: check "
+                    f"train.mesh.dp vs the batcher's num_shards)"
+                )
 
     def place(batch):
+        if divisor > 1:
+            _validate(batch)
         return jax.device_put(batch, sharding)
 
     return place

@@ -1,25 +1,37 @@
 from deepdfa_tpu.graphs.batch import (
     NUM_SUBKEY_FEATS,
+    BatchPlan,
     BudgetExceeded,
     GraphBatch,
     GraphSpec,
     bucket_batches,
     pack,
+    pack_plan,
     pack_shards,
+    plan_shard_bucket_batches,
     shard_bucket_batches,
 )
-from deepdfa_tpu.graphs.store import GraphStore, load_shard, save_shard
+from deepdfa_tpu.graphs.store import (
+    GraphStore,
+    file_digest,
+    load_shard,
+    save_shard,
+)
 
 __all__ = [
     "NUM_SUBKEY_FEATS",
+    "BatchPlan",
     "BudgetExceeded",
     "GraphBatch",
     "GraphSpec",
     "bucket_batches",
     "pack",
+    "pack_plan",
     "pack_shards",
+    "plan_shard_bucket_batches",
     "shard_bucket_batches",
     "GraphStore",
+    "file_digest",
     "load_shard",
     "save_shard",
 ]

@@ -102,6 +102,14 @@ class GraphBatch:
         return self.edge_src.shape[0]
 
 
+#: GraphBatch's array leaves (everything but the static num_graphs) — the
+#: serialization order shared by the packed-batch cache and the
+#: shared-memory packer (data/packed_cache.py, data/mp_pack.py)
+ARRAY_FIELDS = tuple(
+    f.name for f in dataclasses.fields(GraphBatch) if f.name != "num_graphs"
+)
+
+
 class BudgetExceeded(ValueError):
     pass
 
@@ -333,6 +341,139 @@ def _pow2_ceil(x: int) -> int:
     return 1 << max(0, int(x) - 1).bit_length() if x > 1 else 1
 
 
+@dataclasses.dataclass(frozen=True)
+class BatchPlan:
+    """Packing recipe for one batch: per-shard indices into the source
+    graph sequence plus the static budgets.
+
+    Planning (this object's construction) is pure bookkeeping over
+    node/edge counts — cheap and inherently sequential. Packing (turning a
+    plan into padded numpy arrays) is the host-side hot loop and is
+    embarrassingly parallel across plans; `pack_plan` below is the single
+    packing entry point shared by the inline batcher, the process-pool
+    packer (data/mp_pack.py) and the packed-batch cache builder
+    (data/packed_cache.py), so every path is bit-identical by construction.
+    """
+
+    shard_indices: tuple[tuple[int, ...], ...]
+    num_graphs: int
+    node_budget: int
+    edge_budget: int
+
+
+def pack_plan(
+    graphs: Sequence[GraphSpec],
+    plan: BatchPlan,
+    add_self_loops: bool = True,
+) -> GraphBatch:
+    """Materialize one planned batch (the numpy-heavy packing step)."""
+    per_shard = [[graphs[i] for i in idxs] for idxs in plan.shard_indices]
+    return _stack_shards(
+        per_shard, plan.num_graphs, plan.node_budget, plan.edge_budget,
+        add_self_loops,
+    )
+
+
+def plan_shard_bucket_batches(
+    graphs: Sequence[GraphSpec],
+    num_shards: int,
+    num_graphs: int,
+    node_budget: int,
+    edge_budget: int,
+    add_self_loops: bool = True,
+    oversized: str = "drop",
+    stats: dict | None = None,
+) -> Iterable[BatchPlan]:
+    """Greedy budget-aware planning of dp-sharded fixed-budget batches.
+
+    Yields `BatchPlan`s; `shard_bucket_batches` packs them inline and
+    documents the placement/oversized semantics. Stats keys ("batches",
+    "dropped", "oversized", "overflow_signatures") fill as the generator
+    advances and are final once it is exhausted.
+    """
+    if oversized not in ("drop", "raise", "singleton"):
+        raise ValueError(f"oversized={oversized!r}")
+    if stats is None:
+        stats = {}
+    stats.update(batches=0, dropped=0, oversized=0, overflow_signatures=0)
+
+    overflow: dict[tuple[int, int], list[int]] = {}
+    per_shard: list[list[int]] = [[] for _ in range(num_shards)]
+    counts = np.zeros(num_shards, np.int64)
+    n_used = np.zeros(num_shards, np.int64)
+    e_used = np.zeros(num_shards, np.int64)
+
+    def flush():
+        nonlocal per_shard, counts, n_used, e_used
+        if counts.sum():
+            stats["batches"] += 1
+            plan = BatchPlan(
+                tuple(tuple(s) for s in per_shard),
+                num_graphs, node_budget, edge_budget,
+            )
+            per_shard = [[] for _ in range(num_shards)]
+            counts = np.zeros(num_shards, np.int64)
+            n_used = np.zeros(num_shards, np.int64)
+            e_used = np.zeros(num_shards, np.int64)
+            return plan
+        return None
+
+    for gi, g in enumerate(graphs):
+        e_need = g.num_edges + (g.num_nodes if add_self_loops else 0)
+        if g.num_nodes > node_budget or e_need > edge_budget:
+            stats["oversized"] += 1
+            if oversized == "raise":
+                raise BudgetExceeded(
+                    f"graph {g.graph_id}: {g.num_nodes} nodes / {e_need} "
+                    f"edges exceed budgets ({node_budget}/{edge_budget})"
+                )
+            if oversized == "drop":
+                stats["dropped"] += 1
+                continue
+            sig = (_pow2_ceil(g.num_nodes), _pow2_ceil(e_need))
+            overflow.setdefault(sig, []).append(gi)
+            continue
+        # least-loaded shard (by nodes) with room in every budget
+        order = np.argsort(n_used, kind="stable")
+        placed = False
+        for s in order:
+            s = int(s)
+            if (
+                counts[s] < num_graphs
+                and n_used[s] + g.num_nodes <= node_budget
+                and e_used[s] + e_need <= edge_budget
+            ):
+                per_shard[s].append(gi)
+                counts[s] += 1
+                n_used[s] += g.num_nodes
+                e_used[s] += e_need
+                placed = True
+                break
+        if not placed:
+            plan = flush()
+            if plan is not None:
+                yield plan
+            per_shard[0].append(gi)
+            counts[0] += 1
+            n_used[0] += g.num_nodes
+            e_used[0] += e_need
+    plan = flush()
+    if plan is not None:
+        yield plan
+
+    stats["overflow_signatures"] = len(overflow)
+    for (nb, eb), gis in sorted(overflow.items()):
+        for k in range(0, len(gis), num_shards):
+            stats["batches"] += 1
+            yield BatchPlan(
+                tuple(
+                    tuple(gis[k + s : k + s + 1])
+                    for s in range(num_shards)
+                ),
+                1, nb, eb,
+            )
+
+
 def shard_bucket_batches(
     graphs: Iterable[GraphSpec],
     num_shards: int,
@@ -363,88 +504,17 @@ def shard_bucket_batches(
 
     `stats` (optional dict) receives: "batches", "dropped" (only under
     "drop"), "oversized", "overflow_signatures".
+
+    Implementation: `plan_shard_bucket_batches` (sequential bookkeeping)
+    + `pack_plan` (numpy packing) — the same two stages the multiprocess
+    packer (data/mp_pack.py) distributes across cores.
     """
-    if oversized not in ("drop", "raise", "singleton"):
-        raise ValueError(f"oversized={oversized!r}")
-    if stats is None:
-        stats = {}
-    stats.update(batches=0, dropped=0, oversized=0, overflow_signatures=0)
-
-    overflow: dict[tuple[int, int], list[GraphSpec]] = {}
-    per_shard: list[list[GraphSpec]] = [[] for _ in range(num_shards)]
-    counts = np.zeros(num_shards, np.int64)
-    n_used = np.zeros(num_shards, np.int64)
-    e_used = np.zeros(num_shards, np.int64)
-
-    def flush():
-        nonlocal per_shard, counts, n_used, e_used
-        if counts.sum():
-            stats["batches"] += 1
-            batch = _stack_shards(
-                per_shard, num_graphs, node_budget, edge_budget,
-                add_self_loops,
-            )
-            per_shard = [[] for _ in range(num_shards)]
-            counts = np.zeros(num_shards, np.int64)
-            n_used = np.zeros(num_shards, np.int64)
-            e_used = np.zeros(num_shards, np.int64)
-            return batch
-        return None
-
-    for g in graphs:
-        e_need = g.num_edges + (g.num_nodes if add_self_loops else 0)
-        if g.num_nodes > node_budget or e_need > edge_budget:
-            stats["oversized"] += 1
-            if oversized == "raise":
-                raise BudgetExceeded(
-                    f"graph {g.graph_id}: {g.num_nodes} nodes / {e_need} "
-                    f"edges exceed budgets ({node_budget}/{edge_budget})"
-                )
-            if oversized == "drop":
-                stats["dropped"] += 1
-                continue
-            sig = (_pow2_ceil(g.num_nodes), _pow2_ceil(e_need))
-            overflow.setdefault(sig, []).append(g)
-            continue
-        # least-loaded shard (by nodes) with room in every budget
-        order = np.argsort(n_used, kind="stable")
-        placed = False
-        for s in order:
-            s = int(s)
-            if (
-                counts[s] < num_graphs
-                and n_used[s] + g.num_nodes <= node_budget
-                and e_used[s] + e_need <= edge_budget
-            ):
-                per_shard[s].append(g)
-                counts[s] += 1
-                n_used[s] += g.num_nodes
-                e_used[s] += e_need
-                placed = True
-                break
-        if not placed:
-            batch = flush()
-            if batch is not None:
-                yield batch
-            per_shard[0].append(g)
-            counts[0] += 1
-            n_used[0] += g.num_nodes
-            e_used[0] += e_need
-    batch = flush()
-    if batch is not None:
-        yield batch
-
-    stats["overflow_signatures"] = len(overflow)
-    for (nb, eb), gs in sorted(overflow.items()):
-        for k in range(0, len(gs), num_shards):
-            stats["batches"] += 1
-            yield _stack_shards(
-                [
-                    gs[k + s : k + s + 1] if k + s < len(gs) else []
-                    for s in range(num_shards)
-                ],
-                1, nb, eb, add_self_loops,
-            )
+    graphs = graphs if isinstance(graphs, Sequence) else list(graphs)
+    for plan in plan_shard_bucket_batches(
+        graphs, num_shards, num_graphs, node_budget, edge_budget,
+        add_self_loops, oversized, stats,
+    ):
+        yield pack_plan(graphs, plan, add_self_loops)
 
 
 def bucket_batches(

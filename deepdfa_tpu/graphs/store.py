@@ -10,6 +10,8 @@ preprocessing workers.
 
 from __future__ import annotations
 
+import hashlib
+import zipfile
 from pathlib import Path
 from typing import Iterator, Sequence
 
@@ -26,7 +28,13 @@ from deepdfa_tpu.graphs.batch import (
 _VERSION = 1
 
 
-def save_shard(path: str | Path, graphs: Sequence[GraphSpec]) -> None:
+def save_shard(
+    path: str | Path, graphs: Sequence[GraphSpec], compressed: bool = True
+) -> None:
+    """Write one shard. `compressed=False` stores the npz members raw
+    (zip STORED), which makes the shard memory-mappable via
+    `load_shard(..., mmap=True)` — larger on disk, but loads become
+    page-cache-speed views instead of per-epoch inflate+copy."""
     node_counts = np.array([g.num_nodes for g in graphs], np.int64)
     edge_counts = np.array([g.num_edges for g in graphs], np.int64)
     bits = bit_width(graphs)
@@ -40,7 +48,7 @@ def save_shard(path: str | Path, graphs: Sequence[GraphSpec]) -> None:
         bit_arrays["edge_type"] = np.concatenate(
             [g.edge_type for g in graphs]
         ).astype(np.int32)
-    np.savez_compressed(
+    (np.savez_compressed if compressed else np.savez)(
         path,
         version=np.int64(_VERSION),
         **bit_arrays,
@@ -71,39 +79,118 @@ def save_shard(path: str | Path, graphs: Sequence[GraphSpec]) -> None:
     )
 
 
-def load_shard(path: str | Path) -> list[GraphSpec]:
+def _mmap_npz(path: str | Path) -> dict[str, np.ndarray]:
+    """Memory-map every member of an UNCOMPRESSED .npz.
+
+    np.load silently ignores mmap_mode for zip archives, so this resolves
+    each stored member's absolute data offset (zip local header + npy
+    header) and hands it to np.memmap — the OS page cache then backs every
+    epoch's reads instead of a per-epoch inflate+copy. Raises ValueError on
+    deflated members (shards written with compressed=True)."""
+    out: dict[str, np.ndarray] = {}
+    with zipfile.ZipFile(path) as zf:
+        for info in zf.infolist():
+            name = info.filename
+            key = name[:-4] if name.endswith(".npy") else name
+            if info.compress_type != zipfile.ZIP_STORED:
+                raise ValueError(
+                    f"{path}: member {name} is deflated — mmap needs a "
+                    "shard written with save_shard(compressed=False)"
+                )
+            with zf.open(info) as fp:
+                version = np.lib.format.read_magic(fp)
+                if version == (1, 0):
+                    shape, fortran, dtype = (
+                        np.lib.format.read_array_header_1_0(fp)
+                    )
+                else:
+                    shape, fortran, dtype = (
+                        np.lib.format.read_array_header_2_0(fp)
+                    )
+                header_len = fp.tell()
+            if int(np.prod(shape)) == 0 or shape == ():
+                # np.memmap rejects zero-length maps; scalars aren't worth
+                # a page each — read those members eagerly
+                with zf.open(info) as fp:
+                    out[key] = np.lib.format.read_array(fp)
+                continue
+            with open(path, "rb") as f:
+                # zip local file header: 30 fixed bytes + name + extra
+                # (the central directory's lengths can differ, so read
+                # the local copy)
+                f.seek(info.header_offset)
+                local = f.read(30)
+                name_len = int.from_bytes(local[26:28], "little")
+                extra_len = int.from_bytes(local[28:30], "little")
+            data_start = info.header_offset + 30 + name_len + extra_len
+            out[key] = np.memmap(
+                path,
+                dtype=dtype,
+                mode="r",
+                offset=data_start + header_len,
+                shape=shape,
+                order="F" if fortran else "C",
+            )
+    return out
+
+
+def load_shard(path: str | Path, mmap: bool = False) -> list[GraphSpec]:
+    """Load one shard. `mmap=True` (uncompressed shards only) returns
+    GraphSpecs whose arrays are read-only views into the page-cache-backed
+    file mapping — zero-copy until a consumer writes or re-dtypes."""
+    if mmap:
+        return _specs_from_arrays(_mmap_npz(path), path)
     with np.load(path) as z:
-        if int(z["version"]) != _VERSION:
-            raise ValueError(f"unsupported shard version {z['version']} at {path}")
-        no, eo = z["node_offsets"], z["edge_offsets"]
-        has_bits = _BIT_FIELDS[0] in z
-        has_etypes = "edge_type" in z
-        out = []
-        for i in range(len(z["graph_ids"])):
-            bit_kw = (
-                {
-                    f: z[f][no[i] : no[i + 1]].astype(np.float32)
-                    for f in _BIT_FIELDS
-                }
-                if has_bits
-                else {}
+        return _specs_from_arrays({k: z[k] for k in z.files}, path)
+
+
+def _specs_from_arrays(z: dict[str, np.ndarray], path) -> list[GraphSpec]:
+    if int(z["version"]) != _VERSION:
+        raise ValueError(f"unsupported shard version {z['version']} at {path}")
+    no, eo = z["node_offsets"], z["edge_offsets"]
+    has_bits = _BIT_FIELDS[0] in z
+    has_etypes = "edge_type" in z
+
+    def _as(a: np.ndarray, dtype) -> np.ndarray:
+        # no-copy when the stored dtype already matches (the save path
+        # writes int32/float32 natively, so mmap views stay views)
+        return np.asarray(a, dtype)
+
+    out = []
+    for i in range(len(z["graph_ids"])):
+        bit_kw = (
+            {f: _as(z[f][no[i] : no[i + 1]], np.float32) for f in _BIT_FIELDS}
+            if has_bits
+            else {}
+        )
+        if has_etypes:
+            bit_kw["edge_type"] = _as(
+                z["edge_type"][eo[i] : eo[i + 1]], np.int32
             )
-            if has_etypes:
-                bit_kw["edge_type"] = z["edge_type"][eo[i] : eo[i + 1]].astype(
-                    np.int32
-                )
-            out.append(
-                GraphSpec(
-                    graph_id=int(z["graph_ids"][i]),
-                    node_feats=z["node_feats"][no[i] : no[i + 1]].astype(np.int32),
-                    node_vuln=z["node_vuln"][no[i] : no[i + 1]].astype(np.int32),
-                    edge_src=z["edge_src"][eo[i] : eo[i + 1]].astype(np.int32),
-                    edge_dst=z["edge_dst"][eo[i] : eo[i + 1]].astype(np.int32),
-                    label=float(z["labels"][i]),
-                    **bit_kw,
-                )
+        out.append(
+            GraphSpec(
+                graph_id=int(z["graph_ids"][i]),
+                node_feats=_as(z["node_feats"][no[i] : no[i + 1]], np.int32),
+                node_vuln=_as(z["node_vuln"][no[i] : no[i + 1]], np.int32),
+                edge_src=_as(z["edge_src"][eo[i] : eo[i + 1]], np.int32),
+                edge_dst=_as(z["edge_dst"][eo[i] : eo[i + 1]], np.int32),
+                label=float(z["labels"][i]),
+                **bit_kw,
             )
-        return out
+        )
+    return out
+
+
+def file_digest(path: str | Path, chunk: int = 1 << 20) -> str:
+    """sha256 of a file's bytes (packed-cache key component)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
 
 
 class GraphStore:
@@ -121,10 +208,12 @@ class GraphStore:
         graphs: Sequence[GraphSpec],
         shard_size: int = 4096,
         tag: str | None = None,
+        compressed: bool = True,
     ) -> int:
         """Write npz shards. Concurrent writer jobs MUST pass distinct
         `tag`s (e.g. the job-array shard id): untagged numbering counts
-        existing files at start time and would collide across processes."""
+        existing files at start time and would collide across processes.
+        `compressed=False` writes mmap-able shards (see save_shard)."""
         prefix = f"graphs-{tag}-" if tag else "graphs-"
         existing = len(list(self.directory.glob(f"{prefix}*.npz")))
         n = 0
@@ -132,13 +221,24 @@ class GraphStore:
             save_shard(
                 self.directory / f"{prefix}{existing + n:05d}.npz",
                 graphs[i : i + shard_size],
+                compressed=compressed,
             )
             n += 1
         return n
 
-    def iter_graphs(self) -> Iterator[GraphSpec]:
+    def iter_graphs(self, mmap: bool = False) -> Iterator[GraphSpec]:
         for p in self.shard_paths():
-            yield from load_shard(p)
+            yield from load_shard(p, mmap=mmap)
 
-    def load_all(self) -> dict[int, GraphSpec]:
-        return {g.graph_id: g for g in self.iter_graphs()}
+    def load_all(self, mmap: bool = False) -> dict[int, GraphSpec]:
+        return {g.graph_id: g for g in self.iter_graphs(mmap=mmap)}
+
+    def digest(self) -> str:
+        """Content hash over every shard (name + bytes) — the packed-batch
+        cache's source-invalidation key (data/packed_cache.py): any
+        re-extraction or added shard changes it."""
+        h = hashlib.sha256()
+        for p in self.shard_paths():
+            h.update(p.name.encode())
+            h.update(file_digest(p).encode())
+        return h.hexdigest()

@@ -2,9 +2,28 @@
 
 from __future__ import annotations
 
+import inspect
+
 try:
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+
+
+def shard_map(*args, **kwargs):
+    """jax.shard_map with the replication-check kwarg normalized.
+
+    The check was renamed check_rep -> check_vma across jax releases;
+    callers here use the new name, and this maps it back (or drops it)
+    for older installs so one call site works on every supported jax.
+    """
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        check = kwargs.pop("check_vma")
+        if "check_rep" in _PARAMS:
+            kwargs["check_rep"] = check
+    return _shard_map(*args, **kwargs)
+
 
 __all__ = ["shard_map"]

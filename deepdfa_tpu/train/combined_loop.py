@@ -469,8 +469,9 @@ class CombinedTrainer:
         max_epochs: int | None = None,
         log_fn: Callable[[dict], None] | None = None,
         seed: int = 0,
+        source_stage: str = "pack",
     ) -> TrainState:
-        from deepdfa_tpu.data.prefetch import prefetch
+        from deepdfa_tpu.data.prefetch import PipelineStats, prefetch
 
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
@@ -490,17 +491,31 @@ class CombinedTrainer:
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
             losses = []
+            stats = PipelineStats()
             for i, batch in enumerate(
-                prefetch(train_batches(epoch), tcfg.prefetch_batches, place)
+                prefetch(
+                    train_batches(epoch), tcfg.prefetch_batches, place,
+                    producers=tcfg.prefetch_producers,
+                    stats=stats, source_stage=source_stage,
+                )
             ):
                 key = jax.random.fold_in(root, step)
                 state, loss = self.train_step(state, batch, key)
                 losses.append(loss)
                 step += 1
+            epoch_seconds = time.perf_counter() - t0
             record = {
                 "epoch": epoch,
                 "train_loss": float(np.mean(jax.device_get(losses))) if losses else float("nan"),
-                "epoch_seconds": time.perf_counter() - t0,
+                "epoch_seconds": epoch_seconds,
+                # same stage attribution as GraphTrainer.fit
+                "host_load_seconds": round(stats.load_seconds, 3),
+                "host_pack_seconds": round(stats.pack_seconds, 3),
+                "host_place_seconds": round(stats.place_seconds, 3),
+                "input_wait_seconds": round(stats.wait_seconds, 3),
+                "input_wait_fraction": round(
+                    stats.wait_fraction(epoch_seconds), 4
+                ),
             }
             if val_batches is not None:
                 val_metrics, _ = self.evaluate(state, val_batches())

@@ -255,8 +255,13 @@ class GraphTrainer:
         checkpoints: CheckpointManager | None = None,
         max_epochs: int | None = None,
         log_fn: Callable[[dict], None] | None = None,
+        source_stage: str = "pack",
     ) -> TrainState:
-        from deepdfa_tpu.data.prefetch import device_placer, prefetch
+        from deepdfa_tpu.data.prefetch import (
+            PipelineStats,
+            device_placer,
+            prefetch,
+        )
 
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
@@ -265,8 +270,16 @@ class GraphTrainer:
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
             losses = []
+            stats = PipelineStats()
+            source = train_batches(epoch)
+            # a source may know better than the static default which
+            # stage its pulls are (cli _BatchStream: "load" on a warm
+            # cache epoch, "pack" on a cold one)
+            stage = getattr(source, "source_stage", source_stage)
             for batch in prefetch(
-                train_batches(epoch), tcfg.prefetch_batches, placer
+                source, tcfg.prefetch_batches, placer,
+                producers=tcfg.prefetch_producers,
+                stats=stats, source_stage=stage,
             ):
                 state, loss = self.train_step(state, batch)
                 losses.append(loss)
@@ -274,10 +287,21 @@ class GraphTrainer:
                 if log_fn is not None and step % max(1, tcfg.log_every_steps) == 0:
                     log_fn({"step": step, "loss": float(jax.device_get(loss))})
             train_loss = float(np.mean(jax.device_get(losses))) if losses else float("nan")
+            epoch_seconds = time.perf_counter() - t0
             record = {
                 "epoch": epoch,
                 "train_loss": train_loss,
-                "epoch_seconds": time.perf_counter() - t0,
+                "epoch_seconds": epoch_seconds,
+                # host-side stage attribution (docs/input_pipeline.md):
+                # pack/load = source assembly, place = H2D, wait = the
+                # fraction of the epoch the device sat input-starved
+                "host_load_seconds": round(stats.load_seconds, 3),
+                "host_pack_seconds": round(stats.pack_seconds, 3),
+                "host_place_seconds": round(stats.place_seconds, 3),
+                "input_wait_seconds": round(stats.wait_seconds, 3),
+                "input_wait_fraction": round(
+                    stats.wait_fraction(epoch_seconds), 4
+                ),
             }
             if val_batches is not None and (
                 (epoch + 1) % tcfg.eval_every_epochs == 0

@@ -1,18 +1,29 @@
 #!/usr/bin/env python
-"""Measure the async-input-pipeline overlap win (VERDICT r2 item 6).
+"""Host input-pipeline benchmark: prefetch overlap + packed-batch cache.
 
-Times GraphTrainer.fit epochs over the same pre-built GraphSpec corpus
-with train.prefetch_batches=0 (inline assembly) vs the default 2
-(background thread + sharded device_put), same seed — numerics are
-bit-identical either way (tests/test_prefetch.py), so the only delta is
-wall-clock. Batch ASSEMBLY (bucketing/padding) runs per epoch inside the
-train_batches callable, exactly as the CLI trainer does.
+Two measurements over the same flagship GraphSpec corpus (ISSUE 1):
 
-On the 1-core CPU build box, compute and assembly contend for the same
-core, so the measured win is a LOWER bound; on TPU the device computes
+1. prefetch_overlap_speedup — GraphTrainer.fit wall-clock with
+   train.prefetch_batches=0 (inline assembly) vs the default 2
+   (background producers + sharded device_put), same seed — numerics are
+   bit-identical either way (tests/test_prefetch.py), so the only delta
+   is wall-clock.
+
+2. cache_replay_speedup — end-to-end epoch throughput of the CURRENT
+   cold path (frontend extraction + per-epoch shard_bucket_batches
+   repack + train) vs a WARM packed-batch cache (data/packed_cache.py:
+   mmap replay + train). The cold path is what every re-run pays today;
+   the warm path is what it pays once the content-keyed cache exists.
+   Device compute is held small so the HOST pipeline — the thing this
+   script regression-tests — dominates the way it does on TPU, where a
+   step is ~ms and the host is the bound (BENCH_r05: 0.67% MFU).
+
+On the 1-core CPU build box compute and assembly contend for the same
+core, so the overlap win is a LOWER bound; on TPU the device computes
 while the host assembles, which is where the overlap pays.
 
     DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_prefetch.py
+    python scripts/bench_prefetch.py --smoke   # tier-1 regression mode
 """
 
 from __future__ import annotations
@@ -21,76 +32,201 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+BUDGETS = dict(
+    num_shards=1, num_graphs=256, node_budget=16384, edge_budget=65536
+)
+
+
+def _make_trainer(cfg_overrides, sample_batch):
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.train import GraphTrainer
+
+    cfg = config_mod.apply_overrides(Config(), cfg_overrides)
+    model = DeepDFA.from_config(cfg.model, input_dim=1002)
+    trainer = GraphTrainer(model, cfg)
+    state = trainer.init_state(sample_batch)
+    return trainer, state
+
+
+def _warm_compile(trainer, state, batch):
+    """One step outside every timed window, with the SAME committed
+    sharding the fit loop's device_placer uses — otherwise the first
+    timed step would recompile inside the window."""
+    import jax
+
+    from deepdfa_tpu.data.prefetch import device_placer
+
+    state, _ = trainer.train_step(state, device_placer(trainer.mesh)(batch))
+    jax.block_until_ready(state.params)
+    return state
+
+
+def bench_overlap(specs, epochs: int, model_overrides) -> dict:
+    """fit wall-clock, prefetch off vs on (per-epoch live repack both)."""
+    import jax
+
+    from deepdfa_tpu.graphs import shard_bucket_batches
+
+    def train_batches(_epoch):
+        # per-epoch assembly, as in the CLI trainer (this is the host
+        # work the prefetch producers overlap with device compute)
+        return shard_bucket_batches(specs, oversized="raise", **BUDGETS)
+
+    first = next(iter(train_batches(0)))
+    results = {}
+    for depth in (0, 2):
+        trainer, state = _make_trainer(
+            [
+                f"train.prefetch_batches={depth}",
+                f"train.max_epochs={epochs}",
+                *model_overrides,
+            ],
+            first,
+        )
+        state = _warm_compile(trainer, state, first)
+        t0 = time.perf_counter()
+        state = trainer.fit(state, train_batches)
+        jax.block_until_ready(state.params)
+        results[f"prefetch_{depth}"] = round(time.perf_counter() - t0, 2)
+    off, on = results["prefetch_0"], results["prefetch_2"]
+    return {
+        "metric": "prefetch_overlap_speedup",
+        "value": round(off / on, 3) if on else None,
+        "unit": "x (fit wall-clock, prefetch off/on)",
+        "seconds_prefetch_off": off,
+        "seconds_prefetch_on": on,
+    }
+
+
+def bench_cache(
+    specs, frontend_seconds: float, epochs: int, model_overrides
+) -> dict:
+    """End-to-end epoch throughput: cold (frontend + per-epoch repack +
+    train) vs warm packed-batch cache (mmap replay + train)."""
+    import jax
+
+    from deepdfa_tpu.data.packed_cache import (
+        PackedBatchCache,
+        cache_key,
+        corpus_digest,
+    )
+    from deepdfa_tpu.graphs import shard_bucket_batches
+
+    def repack(_epoch):
+        return shard_bucket_batches(specs, oversized="raise", **BUDGETS)
+
+    first = next(iter(repack(0)))
+    n_graphs = len(specs)
+    overrides = [f"train.max_epochs={epochs}", *model_overrides]
+
+    epoch_records: list[dict] = []
+
+    def log_fn(rec):
+        if "epoch" in rec:
+            epoch_records.append(rec)
+
+    # cold: what a fresh run pays today — frontend (already timed by the
+    # caller) + per-epoch repack + train
+    trainer, state = _make_trainer(overrides, first)
+    state = _warm_compile(trainer, state, first)
+    t0 = time.perf_counter()
+    state = trainer.fit(state, repack, log_fn=log_fn)
+    jax.block_until_ready(state.params)
+    cold_seconds = frontend_seconds + (time.perf_counter() - t0)
+    cold_pack = sum(r["host_pack_seconds"] for r in epoch_records)
+
+    # warm: same batches, same order (tests/test_packed_cache.py pins
+    # bit-identity), replayed zero-copy from the content-keyed cache
+    with tempfile.TemporaryDirectory() as d:
+        cache = PackedBatchCache(d)
+        key = cache_key(BUDGETS, corpus_digest(specs))
+        list(cache.get_or_pack(key, lambda: repack(0)))  # build, untimed
+        epoch_records.clear()
+        # train_step donates the state buffers, so the warm phase gets
+        # its own (identically configured) trainer and fresh state
+        trainer, state = _make_trainer(overrides, first)
+        state = _warm_compile(trainer, state, first)
+        t0 = time.perf_counter()
+        state = trainer.fit(
+            state, lambda e: cache.replay(key), log_fn=log_fn,
+            source_stage="load",
+        )
+        jax.block_until_ready(state.params)
+        warm_seconds = time.perf_counter() - t0
+    warm_load = sum(r["host_load_seconds"] for r in epoch_records)
+    warm_wait = sum(r["input_wait_seconds"] for r in epoch_records)
+
+    return {
+        "metric": "cache_replay_speedup",
+        "value": round(cold_seconds / warm_seconds, 3) if warm_seconds else None,
+        "unit": "x (epoch throughput, warm packed-batch cache vs cold "
+        "frontend+repack)",
+        "cold_seconds": round(cold_seconds, 2),
+        "warm_seconds": round(warm_seconds, 2),
+        "cold_frontend_seconds": round(frontend_seconds, 2),
+        "cold_pack_seconds": round(cold_pack, 3),
+        "warm_load_seconds": round(warm_load, 3),
+        "warm_input_wait_seconds": round(warm_wait, 3),
+        "cold_graphs_per_sec": round(epochs * n_graphs / cold_seconds, 1),
+        "warm_graphs_per_sec": round(epochs * n_graphs / warm_seconds, 1),
+    }
+
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--n-examples", type=int, default=2000)
-    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--n-examples", type=int, default=1000)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tier-1 regression mode: tiny corpus/model on CPU, exercises "
+        "every pipeline stage (frontend -> pack -> cache -> prefetch -> "
+        "place -> train) in well under a minute",
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+
+    if args.smoke:
+        os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+        args.n_examples = min(args.n_examples, 128)
+        args.epochs = min(args.epochs, 2)
+    # device compute held small so the host pipeline — the thing this
+    # script regression-tests — dominates the way it does on TPU
+    # (docstring); both modes use the same model so smoke tracks the
+    # full measurement
+    model_overrides = ["model.hidden_dim=16", "model.n_steps=2"]
 
     from deepdfa_tpu.core.backend import apply_platform_override
 
     apply_platform_override()
     import jax
 
-    from deepdfa_tpu.core import Config, config as config_mod
     from deepdfa_tpu.data import flagship_corpus
-    from deepdfa_tpu.data.prefetch import device_placer
-    from deepdfa_tpu.graphs import shard_bucket_batches
-    from deepdfa_tpu.models import DeepDFA
-    from deepdfa_tpu.train import GraphTrainer
 
-    n = args.n_examples
-    specs = flagship_corpus(n)
+    t0 = time.perf_counter()
+    specs = flagship_corpus(args.n_examples)
+    frontend_seconds = time.perf_counter() - t0
 
-    def train_batches(_epoch):
-        # per-epoch assembly, as in the CLI trainer (this is the host
-        # work the prefetch thread overlaps with device compute)
-        return shard_bucket_batches(
-            specs, 1, 256, 16384, 65536, oversized="raise"
-        )
+    overlap = bench_overlap(specs, args.epochs, model_overrides)
+    cache = bench_cache(specs, frontend_seconds, args.epochs, model_overrides)
 
-    results = {}
-    for depth in (0, 2):
-        cfg = config_mod.apply_overrides(
-            Config(),
-            [
-                f"train.prefetch_batches={depth}",
-                f"train.max_epochs={args.epochs}",
-            ],
-        )
-        model = DeepDFA.from_config(cfg.model, input_dim=1002)
-        trainer = GraphTrainer(model, cfg)
-        state = trainer.init_state(next(iter(train_batches(0))))
-        # compile outside the timed window — with the SAME committed
-        # sharding the fit loop's device_placer uses, or the first timed
-        # step would recompile inside both windows
-        warm = device_placer(trainer.mesh)(next(iter(train_batches(0))))
-        state, _ = trainer.train_step(state, warm)
-        jax.block_until_ready(state.params)
-        t0 = time.perf_counter()
-        state = trainer.fit(state, train_batches)
-        jax.block_until_ready(state.params)
-        results[f"prefetch_{depth}"] = round(time.perf_counter() - t0, 2)
-
-    off, on = results["prefetch_0"], results["prefetch_2"]
     record = {
-        "metric": "prefetch_overlap_speedup",
-        "value": round(off / on, 3) if on else None,
-        "unit": "x (fit wall-clock, prefetch off/on)",
-        "seconds_prefetch_off": off,
-        "seconds_prefetch_on": on,
+        **overlap,
+        "cache": cache,
+        "cache_replay_speedup": cache["value"],
         "platform": jax.devices()[0].platform,
-        "n_examples": n,
+        "n_examples": args.n_examples,
         "epochs": args.epochs,
-        "note": "1-core CPU hosts understate the win (assembly and "
-        "compute share the core); on TPU the host assembles while the "
-        "device computes",
+        "smoke": args.smoke,
+        "note": "1-core CPU hosts understate the overlap win (assembly "
+        "and compute share the core); on TPU the host assembles while "
+        "the device computes",
     }
     print(json.dumps(record), flush=True)
     if args.out:

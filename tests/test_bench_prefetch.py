@@ -1,0 +1,50 @@
+"""Tier-1 smoke for scripts/bench_prefetch.py --smoke: the whole input
+pipeline (frontend -> pack -> cache -> prefetch -> place -> train) must
+run end-to-end on CPU and emit the throughput record — so pipeline
+breakage fails tests instead of only showing in BENCH artifacts."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_prefetch_smoke(tmp_path):
+    out = tmp_path / "record.json"
+    env = dict(
+        os.environ,
+        DEEPDFA_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "bench_prefetch.py"),
+            "--smoke",
+            "--n-examples", "64",
+            "--epochs", "1",
+            "--out", str(out),
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(out.read_text())
+    assert record["smoke"] is True
+    assert record["platform"] == "cpu"
+    # both pipeline measurements ran and produced positive ratios
+    assert record["metric"] == "prefetch_overlap_speedup"
+    assert record["value"] > 0
+    cache = record["cache"]
+    assert cache["metric"] == "cache_replay_speedup"
+    assert cache["value"] > 0
+    assert cache["warm_graphs_per_sec"] > 0
+    # stage attribution present: cold path packed, warm path only loaded
+    assert cache["cold_pack_seconds"] > 0
+    assert cache["warm_load_seconds"] > 0

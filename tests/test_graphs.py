@@ -50,6 +50,18 @@ def test_pack_budget_errors(rng):
         pack(gs, num_graphs=1, node_budget=50, edge_budget=500)
     with pytest.raises(BudgetExceeded):
         pack(gs, num_graphs=1, node_budget=500, edge_budget=50)
+    # graph-count budget too, not just node/edge budgets
+    gs2 = [make_graph(rng, i, 4, 4) for i in range(3)]
+    with pytest.raises(BudgetExceeded):
+        pack(gs2, num_graphs=2, node_budget=500, edge_budget=500)
+    # edge budget accounts for the implied self loops
+    gs3 = [make_graph(rng, 0, 40, 30)]
+    with pytest.raises(BudgetExceeded):
+        pack(gs3, num_graphs=1, node_budget=64, edge_budget=60)
+    assert pack(
+        gs3, num_graphs=1, node_budget=64, edge_budget=60,
+        add_self_loops=False,
+    ).edge_mask.sum() == 30
 
 
 def test_bucket_batches_covers_all(rng):
@@ -138,6 +150,43 @@ def test_shard_bucket_batches_drop_and_raise(rng):
         )
 
 
+def test_shard_bucket_batches_rejects_unknown_oversized(rng):
+    from deepdfa_tpu.graphs import shard_bucket_batches
+
+    gs = [make_graph(rng, 0, 5, 4)]
+    with pytest.raises(ValueError, match="oversized"):
+        list(
+            shard_bucket_batches(
+                gs, num_shards=1, num_graphs=4, node_budget=64,
+                edge_budget=256, oversized="truncate",
+            )
+        )
+
+
+def test_plan_then_pack_matches_fused_batcher(rng):
+    """The plan/pack split (BatchPlan + pack_plan) is what the process
+    pool and the packed-batch cache distribute; replaying the plans
+    through pack_plan must reproduce shard_bucket_batches exactly."""
+    import jax
+
+    from deepdfa_tpu.graphs import (
+        pack_plan,
+        plan_shard_bucket_batches,
+        shard_bucket_batches,
+    )
+
+    gs = [make_graph(rng, i, int(rng.integers(3, 50)), 10) for i in range(30)]
+    gs.append(make_graph(rng, 30, 300, 10))  # singleton overflow
+    kw = dict(num_shards=2, num_graphs=4, node_budget=128, edge_budget=512)
+    fused = list(shard_bucket_batches(gs, oversized="singleton", **kw))
+    plans = list(plan_shard_bucket_batches(gs, oversized="singleton", **kw))
+    assert len(plans) == len(fused)
+    for plan, want in zip(plans, fused):
+        got = pack_plan(gs, plan)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_pack_shards_stacks_and_balances(rng):
     gs = [make_graph(rng, i, int(rng.integers(3, 30)), 8) for i in range(16)]
     b = pack_shards(gs, num_shards=4, num_graphs=8, node_budget=128, edge_budget=512)
@@ -161,6 +210,42 @@ def test_store_roundtrip(tmp_path, rng):
         np.testing.assert_array_equal(g.node_feats, g2.node_feats)
         np.testing.assert_array_equal(g.edge_src, g2.edge_src)
         assert g.label == g2.label
+
+
+def test_store_uncompressed_mmap_roundtrip(tmp_path, rng):
+    """compressed=False shards load as read-only page-cache-backed views
+    (mmap=True) with content identical to the compressed path."""
+    gs = [make_graph(rng, i, int(rng.integers(1, 20)), 6, float(i % 2)) for i in range(12)]
+    store = GraphStore(tmp_path / "raw")
+    store.write(gs, shard_size=5, compressed=False)
+    back = store.load_all(mmap=True)
+    assert set(back) == set(range(12))
+    for g in gs:
+        g2 = back[g.graph_id]
+        np.testing.assert_array_equal(g.node_feats, g2.node_feats)
+        np.testing.assert_array_equal(g.node_vuln, g2.node_vuln)
+        np.testing.assert_array_equal(g.edge_src, g2.edge_src)
+        np.testing.assert_array_equal(g.edge_dst, g2.edge_dst)
+        assert g.label == g2.label
+        assert not g2.node_feats.flags.writeable  # view, not a copy
+
+
+def test_store_mmap_rejects_compressed_shards(tmp_path, rng):
+    gs = [make_graph(rng, 0, 5, 4)]
+    store = GraphStore(tmp_path / "cmp")
+    store.write(gs, compressed=True)
+    with pytest.raises(ValueError, match="deflated"):
+        store.load_all(mmap=True)
+
+
+def test_store_digest_tracks_shards(tmp_path, rng):
+    gs = [make_graph(rng, i, 5, 4) for i in range(4)]
+    store = GraphStore(tmp_path / "d")
+    store.write(gs[:2], shard_size=2)
+    base = store.digest()
+    assert base == store.digest()  # stable across calls
+    store.write(gs[2:], shard_size=2, tag="extra")
+    assert store.digest() != base  # any added shard invalidates
 
 
 def test_batch_is_pytree(rng):

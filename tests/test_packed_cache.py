@@ -1,0 +1,532 @@
+"""Packed-batch cache (data/packed_cache.py) + multiprocess packer
+(data/mp_pack.py): replay and pool packing must be bit-identical to the
+inline batcher — same arrays, same order — and the cache key must change
+whenever anything that shapes the stream changes (ISSUE 1)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.data.mp_pack import MpPacker, mp_shard_bucket_batches
+from deepdfa_tpu.data.packed_cache import (
+    PackedBatchCache,
+    cache_key,
+    corpus_digest,
+)
+from deepdfa_tpu.data.prefetch import PipelineStats, prefetch
+from deepdfa_tpu.graphs import GraphBatch, shard_bucket_batches
+
+from tests.test_graphs import make_graph
+
+BUDGETS = dict(num_shards=2, num_graphs=4, node_budget=64, edge_budget=256)
+
+
+def _corpus(rng, n=12):
+    return [
+        make_graph(rng, i, int(rng.integers(3, 30)), 10, label=float(i % 2))
+        for i in range(n)
+    ]
+
+
+def assert_batches_identical(got, want):
+    got, want = list(got), list(want)
+    assert len(got) == len(want)
+    for b, w in zip(got, want):
+        assert b.num_graphs == w.num_graphs
+        for f in dataclasses.fields(GraphBatch):
+            if f.name == "num_graphs":
+                continue
+            bv, wv = getattr(b, f.name), getattr(w, f.name)
+            assert (bv is None) == (wv is None), f.name
+            if wv is None:
+                continue
+            bv, wv = np.asarray(bv), np.asarray(wv)
+            assert bv.dtype == wv.dtype, f.name
+            np.testing.assert_array_equal(bv, wv, err_msg=f.name)
+
+
+def test_write_through_then_replay_bit_identical(tmp_path, rng):
+    gs = _corpus(rng)
+    direct = list(shard_bucket_batches(gs, **BUDGETS))
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+
+    # cold pass: write-through yields the live stream unchanged
+    cold = list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+    assert_batches_identical(cold, direct)
+    assert cache.has(key)
+
+    # warm pass: replay (mmap) is the same stream, same order
+    assert_batches_identical(cache.replay(key), direct)
+    # and so is the eager-read mode
+    assert_batches_identical(cache.replay(key, mmap=False), direct)
+
+
+def test_get_or_pack_builds_once_then_replays(tmp_path, rng):
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    calls = []
+
+    def build():
+        calls.append(1)
+        return shard_bucket_batches(gs, **BUDGETS)
+
+    first = list(cache.get_or_pack(key, build))
+    second = list(cache.get_or_pack(key, build))
+    assert len(calls) == 1  # warm hit never re-packs
+    assert_batches_identical(second, first)
+
+
+def test_abandoned_write_leaves_no_entry(tmp_path, rng):
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    it = cache.write_through(key, shard_bucket_batches(gs, **BUDGETS))
+    next(it)
+    it.close()  # consumer abandons mid-stream
+    assert not cache.has(key)
+    # the partial spill is gone too — nothing for a later run to trip on
+    assert cache.keys() == []
+    assert list((tmp_path / "packed").iterdir()) == []
+
+
+def test_replay_rejects_foreign_schema(tmp_path, rng):
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+    mpath = cache.entry_dir(key) / "manifest.json"
+    m = json.loads(mpath.read_text())
+    m["schema"] = -1
+    mpath.write_text(json.dumps(m))
+    with pytest.raises(ValueError, match="schema"):
+        list(cache.replay(key))
+
+
+def test_cache_key_sensitivity(rng):
+    gs = _corpus(rng)
+    src = corpus_digest(gs)
+    base = cache_key(BUDGETS, src)
+    assert base == cache_key(dict(BUDGETS), src)  # deterministic
+    # insertion order is canonicalized away
+    assert base == cache_key(dict(reversed(list(BUDGETS.items()))), src)
+    assert base != cache_key(dict(BUDGETS, node_budget=128), src)
+    assert base != cache_key(BUDGETS, src, vocab_digest="v2")
+    assert base != cache_key(BUDGETS, corpus_digest(gs[:-1]))
+
+
+def test_corpus_digest_tracks_content(rng):
+    gs = _corpus(rng)
+    base = corpus_digest(gs)
+    assert base == corpus_digest(list(gs))
+    edited = list(gs)
+    feats = edited[3].node_feats.copy()
+    feats[0, 0] += 1
+    edited[3] = dataclasses.replace(edited[3], node_feats=feats)
+    assert base != corpus_digest(edited)
+    assert base != corpus_digest(gs[::-1])  # order matters: batches would
+
+
+def test_prune_keeps_named_entries(tmp_path, rng):
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    k1 = cache_key(BUDGETS, corpus_digest(gs))
+    k2 = cache_key(dict(BUDGETS, node_budget=128), corpus_digest(gs))
+    list(cache.write_through(k1, shard_bucket_batches(gs, **BUDGETS)))
+    list(
+        cache.write_through(
+            k2, shard_bucket_batches(gs, **dict(BUDGETS, node_budget=128))
+        )
+    )
+    assert cache.prune(keep=[k1]) == 1
+    assert cache.keys() == [k1]
+
+
+def test_prefetch_ordering_over_cached_replay(tmp_path, rng):
+    """The tests/test_prefetch.py ordering guarantee, extended to the
+    cached path: replaying through the multi-producer prefetch pipeline
+    yields the same batches in the same order as direct packing, and the
+    source time lands in load_seconds (not pack_seconds)."""
+    gs = _corpus(rng, n=20)
+    direct = list(shard_bucket_batches(gs, **BUDGETS))
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    list(cache.get_or_pack(key, lambda: shard_bucket_batches(gs, **BUDGETS)))
+
+    stats = PipelineStats()
+    out = list(
+        prefetch(
+            cache.replay(key), size=2, producers=3, stats=stats,
+            source_stage="load",
+        )
+    )
+    assert_batches_identical(out, direct)
+    assert stats.consumed == len(direct)
+    assert stats.produced == len(direct)
+    assert stats.load_seconds > 0
+    assert stats.pack_seconds == 0
+
+
+def test_max_entries_evicts_oldest(tmp_path, rng):
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed", max_entries=2)
+    keys = []
+    for nb in (64, 96, 128):
+        k = cache_key(dict(BUDGETS, node_budget=nb), corpus_digest(gs))
+        keys.append(k)
+        list(
+            cache.write_through(
+                k, shard_bucket_batches(gs, **dict(BUDGETS, node_budget=nb))
+            )
+        )
+    # oldest entry evicted, newest two kept, the just-written one always
+    assert sorted(cache.keys()) == sorted(keys[1:])
+
+
+def test_replay_refreshes_lru_so_hot_entry_survives_eviction(tmp_path, rng):
+    """Eviction is least-recently-USED: an entry replayed every epoch
+    (the eval split) must outlive a stream of train-epoch writes even
+    when it is the oldest by write time."""
+    import os
+    import time
+
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed", max_entries=2)
+    hot = cache_key(dict(BUDGETS, node_budget=64), corpus_digest(gs))
+    list(
+        cache.write_through(
+            hot, shard_bucket_batches(gs, **dict(BUDGETS, node_budget=64))
+        )
+    )
+    # age the hot manifest well below any later write, then replay it —
+    # the LRU stamp must beat the write-time ordering
+    old = time.time() - 3600
+    os.utime(cache.entry_dir(hot) / "manifest.json", (old, old))
+    for nb in (96, 128):
+        list(cache.replay(hot))
+        k = cache_key(dict(BUDGETS, node_budget=nb), corpus_digest(gs))
+        mid = time.time() - 1800  # newer than `old`, older than the replay
+        list(
+            cache.write_through(
+                k, shard_bucket_batches(gs, **dict(BUDGETS, node_budget=nb))
+            )
+        )
+        os.utime(cache.entry_dir(k) / "manifest.json", (mid, mid))
+        assert hot in cache.keys()
+
+
+def test_cli_epoch_batches_replays_from_cache(tmp_path, rng, monkeypatch):
+    """CLI wiring: with data.packed_cache=true, the second identical
+    _epoch_batches call replays from disk — the packer never runs —
+    and the batches are identical to the first (cold) pass."""
+    import jax
+
+    import deepdfa_tpu.graphs as graphs_mod
+    from deepdfa_tpu.cli.main import _epoch_batches
+    from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+    from deepdfa_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    cfg = config_mod.apply_overrides(
+        Config(),
+        [
+            "data.packed_cache=true",
+            "data.batch.graphs_per_batch=4",
+            "data.batch.node_budget=64",
+            "data.batch.edge_budget=256",
+        ],
+    )
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    gs = _corpus(rng)
+    digest = corpus_digest(gs)
+
+    calls = []
+    real = graphs_mod.shard_bucket_batches
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(graphs_mod, "shard_bucket_batches", counting)
+    cold = _epoch_batches(cfg, gs, mesh, phase="eval", source_digest=digest)
+    assert len(calls) == 1
+    warm = _epoch_batches(cfg, gs, mesh, phase="eval", source_digest=digest)
+    assert len(calls) == 1  # warm hit: the packer never ran
+    assert_batches_identical(warm, cold)
+    # a different batcher config is a different key -> repacks
+    cfg2 = config_mod.apply_overrides(cfg, ["data.batch.node_budget=128"])
+    _epoch_batches(cfg2, gs, mesh, phase="eval", source_digest=digest)
+    assert len(calls) == 2
+
+
+def test_mp_packer_workers1_matches_inline(rng):
+    gs = _corpus(rng)
+    direct = list(shard_bucket_batches(gs, **BUDGETS))
+    with MpPacker(gs, workers=1) as packer:
+        got = list(packer.shard_bucket_batches(**BUDGETS))
+    assert_batches_identical(got, direct)
+
+
+def test_mp_packer_pool_matches_inline(rng):
+    """Spawn-pool packing: same plans, same pack function, arrays round-
+    tripped through shared memory — bit-identical to the inline batcher,
+    including oversized singleton batches (ragged budgets)."""
+    gs = _corpus(rng, n=10)
+    gs.append(make_graph(rng, 100, 90, 10))  # > node_budget -> singleton
+    stats_a: dict = {}
+    stats_b: dict = {}
+    direct = list(
+        shard_bucket_batches(gs, oversized="singleton", stats=stats_a,
+                             **BUDGETS)
+    )
+    got = list(
+        mp_shard_bucket_batches(
+            gs, oversized="singleton", stats=stats_b, workers=2, **BUDGETS
+        )
+    )
+    assert_batches_identical(got, direct)
+    assert stats_b == stats_a
+
+
+def test_mp_packer_select_matches_inline(rng):
+    """select=: one bound pool serves per-epoch subset selections (the
+    undersample path) — plans are built over the selection and remapped
+    to corpus indices, bit-identical to inline packing of the same
+    selection."""
+    gs = _corpus(rng)
+    sel = [7, 2, 9, 0, 5]
+    direct = list(shard_bucket_batches([gs[i] for i in sel], **BUDGETS))
+    with MpPacker(gs, workers=2) as packer:
+        got = list(
+            packer.shard_bucket_batches(select=np.array(sel), **BUDGETS)
+        )
+    assert_batches_identical(got, direct)
+
+
+def test_mp_packer_windowed_dispatch_and_abandon_drain(rng):
+    """pack() must not race a whole epoch ahead of a training-paced
+    consumer: dispatch is bounded to 2*workers outstanding plans (imap's
+    task handler would eagerly consume every plan and pin every packed
+    batch in /dev/shm until received), and abandoning the stream must
+    drain + unlink the in-flight segments."""
+    from deepdfa_tpu.data import mp_pack
+    from deepdfa_tpu.graphs import plan_shard_bucket_batches
+
+    gs = _corpus(rng, n=48)
+    plans = list(
+        plan_shard_bucket_batches(gs, 1, 2, BUDGETS["node_budget"],
+                                  BUDGETS["edge_budget"])
+    )
+    pulled: list = []
+
+    def lazy_plans():
+        for p in plans:
+            pulled.append(p)
+            yield p
+
+    with MpPacker(gs, workers=2) as packer:
+        window = 2 * packer.workers
+        assert len(plans) > window + 2, "corpus too small to observe"
+        it = packer.pack(lazy_plans())
+        next(it)
+        # initial fill (window) + one refill after the first receive
+        assert len(pulled) <= window + 1
+        it.close()  # abandon mid-stream -> _drain
+        if mp_pack._SHM_DIR.is_dir():
+            left = list(mp_pack._SHM_DIR.glob(f"{packer._shm_prefix}*"))
+            assert not left, left
+
+
+def test_prune_spares_live_spill(tmp_path):
+    """prune() must not rmtree another process's in-progress write_through
+    spill: dot-dirs younger than SPILL_TTL_SECONDS are presumed live and
+    only stale ones are collected as abandoned."""
+    import os
+    import time
+
+    cache = PackedBatchCache(tmp_path / "packed")
+    live = cache.root / ".k-live"
+    live.mkdir()
+    stale = cache.root / ".k-stale"
+    stale.mkdir()
+    old = time.time() - PackedBatchCache.SPILL_TTL_SECONDS - 60
+    os.utime(stale, (old, old))
+    assert cache.prune() == 1
+    assert live.is_dir()
+    assert not stale.exists()
+
+
+def test_close_sweeps_own_shm_namespace():
+    """close() after terminate() must unlink segments the parent never
+    received (queued results / mid-pack workers) — they are named under
+    the packer's prefix precisely so this sweep can find them — while a
+    sibling packer's segments stay untouched."""
+    import os
+
+    from deepdfa_tpu.data import mp_pack
+
+    if not mp_pack._SHM_DIR.is_dir():
+        pytest.skip("no /dev/shm backing on this platform")
+    packer, sibling = MpPacker([], workers=2), MpPacker([], workers=2)
+    orphan = mp_pack._SHM_DIR / f"{packer._shm_prefix}{os.getpid()}-1"
+    alive = mp_pack._SHM_DIR / f"{sibling._shm_prefix}{os.getpid()}-1"
+    orphan.write_bytes(b"x")
+    alive.write_bytes(b"x")
+    try:
+        class _DeadPool:
+            def terminate(self):
+                pass
+
+            def join(self):
+                pass
+
+        packer._pool = _DeadPool()
+        packer.close()
+        assert not orphan.exists()
+        assert alive.exists()
+    finally:
+        for p in (orphan, alive):
+            p.unlink(missing_ok=True)
+
+
+def test_sweep_stale_collects_dead_owners_only():
+    """Pool construction garbage-collects segments whose parent pid is
+    gone (crashed run, no close()); segments of LIVE pids — this process
+    included — must survive."""
+    import os
+    import subprocess
+
+    from deepdfa_tpu.data import mp_pack
+
+    if not mp_pack._SHM_DIR.is_dir():
+        pytest.skip("no /dev/shm backing on this platform")
+    proc = subprocess.Popen(["true"])
+    proc.wait()  # reaped: a pid guaranteed dead
+    dead = mp_pack._SHM_DIR / f"{mp_pack._SHM_PREFIX}-{proc.pid}-0-1"
+    mine = mp_pack._SHM_DIR / f"{mp_pack._SHM_PREFIX}-{os.getpid()}-0-1"
+    dead.write_bytes(b"x")
+    mine.write_bytes(b"x")
+    try:
+        mp_pack._sweep_stale()
+        assert not dead.exists()
+        assert mine.exists()
+    finally:
+        for p in (dead, mine):
+            p.unlink(missing_ok=True)
+
+
+def test_cli_epoch_key_constant_without_undersample(
+    tmp_path, rng, monkeypatch
+):
+    """Without per-epoch undersampling the stream is epoch-invariant, so
+    epoch must NOT enter the cache key: epoch 1 (and any re-run) replays
+    epoch 0's entry instead of cold-packing a duplicate every epoch."""
+    import jax
+
+    import deepdfa_tpu.graphs as graphs_mod
+    from deepdfa_tpu.cli.main import _epoch_batches
+    from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+    from deepdfa_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    cfg = config_mod.apply_overrides(
+        Config(),
+        [
+            "data.packed_cache=true",
+            "data.undersample=false",
+            "data.batch.graphs_per_batch=4",
+            "data.batch.node_budget=64",
+            "data.batch.edge_budget=256",
+        ],
+    )
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    gs = _corpus(rng)
+    digest = corpus_digest(gs)
+
+    calls = []
+    real = graphs_mod.shard_bucket_batches
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(graphs_mod, "shard_bucket_batches", counting)
+    e0 = _epoch_batches(cfg, gs, mesh, shuffle_epoch=0, source_digest=digest)
+    assert len(calls) == 1
+    e1 = _epoch_batches(cfg, gs, mesh, shuffle_epoch=1, source_digest=digest)
+    assert len(calls) == 1  # warm hit: epoch is not part of the key
+    assert_batches_identical(e1, e0)
+    # with undersampling ON the selection IS epoch-dependent -> epoch keys
+    cfg_u = config_mod.apply_overrides(cfg, ["data.undersample=true"])
+    _epoch_batches(cfg_u, gs, mesh, shuffle_epoch=0, source_digest=digest)
+    _epoch_batches(cfg_u, gs, mesh, shuffle_epoch=1, source_digest=digest)
+    assert len(calls) == 3
+
+
+def test_get_or_pack_rebuilds_when_entry_vanishes_mid_replay(
+    tmp_path, rng
+):
+    """A concurrent run can evict/prune an entry between has() and the
+    last np.load; replay must fall back to the builder and resume after
+    the batches already yielded instead of crashing the run."""
+    import shutil
+
+    gs = _corpus(rng)
+    direct = list(shard_bucket_batches(gs, **BUDGETS))
+    assert len(direct) >= 2
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+
+    builds = []
+
+    def builder():
+        builds.append(1)
+        return shard_bucket_batches(gs, **BUDGETS)
+
+    it = cache.get_or_pack(key, builder)
+    got = [next(it)]
+    shutil.rmtree(cache.entry_dir(key))  # concurrent evict
+    got.extend(it)
+    assert builds == [1]
+    assert_batches_identical(got, direct)
+    assert cache.has(key)  # the rebuild re-persisted the entry
+
+
+def test_cli_lazy_stream_stage_labels(tmp_path, rng, monkeypatch):
+    """lazy=True returns a stream labeled with the stage that will run:
+    "pack" on a cold key, "load" on a warm one — what train/loop.py
+    feeds PipelineStats so epoch records attribute host time correctly."""
+    import jax
+
+    from deepdfa_tpu.cli.main import _epoch_batches
+    from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+    from deepdfa_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    cfg = config_mod.apply_overrides(
+        Config(),
+        [
+            "data.packed_cache=true",
+            "data.batch.graphs_per_batch=4",
+            "data.batch.node_budget=64",
+            "data.batch.edge_budget=256",
+        ],
+    )
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    gs = _corpus(rng)
+    digest = corpus_digest(gs)
+
+    cold = _epoch_batches(
+        cfg, gs, mesh, phase="eval", source_digest=digest, lazy=True
+    )
+    assert cold.source_stage == "pack"
+    cold_batches = list(cold)
+    warm = _epoch_batches(
+        cfg, gs, mesh, phase="eval", source_digest=digest, lazy=True
+    )
+    assert warm.source_stage == "load"
+    assert_batches_identical(warm, cold_batches)

@@ -78,6 +78,64 @@ def test_producer_runs_ahead():
     assert len(produced) >= 3
 
 
+def test_same_order_with_many_producers():
+    src = list(range(200))
+    for producers in (2, 5):
+        assert list(prefetch(iter(src), size=4, producers=producers)) == src
+
+
+def test_place_exception_order_with_many_producers():
+    # the failure is re-raised at its position in SOURCE order: every
+    # earlier element is still delivered, later ones never are
+    def bad(x):
+        if x == 7:
+            raise ValueError("bad place")
+        return x
+
+    got = []
+    with pytest.raises(ValueError, match="bad place"):
+        for x in prefetch(iter(range(20)), size=3, producers=4, place=bad):
+            got.append(x)
+    assert got == list(range(7))
+
+
+def test_stats_counters_accumulate():
+    from deepdfa_tpu.data.prefetch import PipelineStats
+
+    stats = PipelineStats()
+    out = list(
+        prefetch(
+            iter(range(10)), size=2, producers=2,
+            place=lambda x: x, stats=stats,
+        )
+    )
+    assert out == list(range(10))
+    assert stats.produced == 10 and stats.consumed == 10
+    assert stats.pack_seconds >= 0 and stats.load_seconds == 0
+    rec = stats.record()
+    assert set(rec) >= {"pack_seconds", "place_seconds", "wait_seconds"}
+    assert stats.wait_fraction(0.0) == 0.0
+
+
+def test_abandon_joins_producer_threads():
+    import threading
+
+    before = {
+        t.name for t in threading.enumerate()
+        if t.name.startswith("batch-prefetch")
+    }
+    it = prefetch(iter(range(10_000)), size=1, producers=3)
+    assert next(it) == 0
+    it.close()
+    alive = {
+        t.name for t in threading.enumerate()
+        if t.name.startswith("batch-prefetch")
+    }
+    # close() joined the producers (with timeout): none left beyond any
+    # that predate this test
+    assert alive <= before
+
+
 def test_abandoned_consumer_stops_producer():
     produced = []
 
@@ -121,6 +179,17 @@ def test_training_numerics_and_step_count_unchanged():
     assert steps_on == steps_off
     for a, b in zip(jax.tree.leaves(params_off), jax.tree.leaves(params_on)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_placer_rejects_indivisible_leading_axis():
+    """Satellite: a batch whose leading axis can't split over the dp mesh
+    axis raises a clear ValueError naming the leaf, not XLA's opaque
+    sharding failure."""
+    graphs = synthetic_dataset(np.random.default_rng(7), n_graphs=6)
+    mesh = make_mesh(MeshConfig(dp=4), devices=jax.devices()[:4])
+    batch = _batches(graphs, 3)[0]  # leading axis 3, mesh dp=4
+    with pytest.raises(ValueError, match="not divisible by mesh axes"):
+        device_placer(mesh)(batch)
 
 
 def test_device_placer_preserves_static_metadata():
