@@ -58,6 +58,9 @@ PROBE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_PROBE_TIMEOUT", 120))
 PROBE_RETRIES = int(os.environ.get("DEEPDFA_BENCH_PROBE_RETRIES", 3))
 CHILD_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_CHILD_TIMEOUT", 1500))
 TRAIN_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_TRAIN_TIMEOUT", 1200))
+COMBINED_TIMEOUT = float(
+    os.environ.get("DEEPDFA_BENCH_COMBINED_TIMEOUT", 600)
+)
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -351,6 +354,50 @@ def run_train_measurement(platform: str) -> dict:
     return result
 
 
+def run_combined_measurement(platform: str) -> dict:
+    """Combined (transformer+graph) text-path throughput with vs without
+    sequence-length bucketing (ISSUE 2); child, CPU-viable.
+
+    Delegates to scripts/bench_prefetch.py:bench_bucketed — the same
+    fixed-vs-bucketed measurement tier-1 smokes — and prefixes the
+    observables for the merged record: REAL-token throughput
+    (`combined_train_tokens_per_sec`) and padding-waste fraction are
+    shape-invariant, so the bucketing win is measurable on the CPU
+    fallback too.
+    """
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    from bench_prefetch import bench_bucketed
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_bucketed(
+        int(os.environ.get("DEEPDFA_BENCH_COMBINED_EXAMPLES",
+                           64 if smoke else 256)),
+        1 if smoke else 2,
+        smoke=smoke,
+    )
+    return {
+        "combined_train_tokens_per_sec": rec["value"],
+        "combined_train_examples_per_sec": rec["examples_per_sec_bucketed"],
+        "combined_tokens_per_sec_fixed": rec["tokens_per_sec_fixed"],
+        "combined_padding_waste_fixed": rec["padding_waste_fixed"],
+        "combined_padding_waste": rec["padding_waste_bucketed"],
+        "combined_bucketed_examples_speedup": rec["bucketed_examples_speedup"],
+        "combined_seq_buckets": rec["buckets"],
+        "combined_steady_state_recompiles": rec["steady_state_recompiles"],
+        "combined_platform": platform,
+    }
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -395,6 +442,20 @@ def _measure_full(
                 result["train_error"] = terr
         else:
             result["train_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_COMBINED", "1") == "1":
+        # combined text-path (bucketing) observables, own bounded child
+        # for the same wedge-isolation reason as the train child
+        cbudget = min(COMBINED_TIMEOUT, deadline - time.time())
+        if cbudget >= 120:
+            combined, cerr = _run_child(
+                "--child-combined", result.get("platform", platform), cbudget
+            )
+            if combined is not None:
+                result.update(combined)
+            else:
+                result["combined_error"] = cerr
+        else:
+            result["combined_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -441,7 +502,8 @@ def _latest_watchdog_capture() -> dict | None:
             out[key] = {
                 k: sub[k]
                 for k in ("metric", "value", "unit", "vs_baseline",
-                          "platform", "rows", "mfu", "attn_impl")
+                          "platform", "rows", "mfu", "attn_impl",
+                          "tokens_per_sec", "padding_waste")
                 if k in sub
             }
     return out
@@ -567,6 +629,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-train":
         print(
             _CHILD_TAG + json.dumps(run_train_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-combined":
+        print(
+            _CHILD_TAG + json.dumps(run_combined_measurement(sys.argv[2])),
             flush=True,
         )
     else:

@@ -694,6 +694,15 @@ def _combined_setup(args, cfg):
                 vocab_size=tok.vocab_size, sp_variant=sp_variant,
                 attn_impl=attn_impl, remat_policy=remat_policy,
             )
+        # T5's relative bias has no positional capacity of its own, so
+        # bound sequence length to the recipe's max_length: a
+        # misconfigured data.seq_buckets edge then fails loudly in
+        # encode() instead of silently training on lengths the recipe
+        # never meant to cover (the RoBERTa arch gets the same guard for
+        # free from its position-table bound, models/transformer.py)
+        import dataclasses as _dc
+
+        enc_cfg = _dc.replace(enc_cfg, max_sequence_length=args.max_length)
         mcfg = t5m.DefectConfig(
             encoder=enc_cfg,
             graph_hidden_dim=cfg.model.hidden_dim,
@@ -763,11 +772,10 @@ def cmd_train_combined(args) -> None:
 
     from deepdfa_tpu.graphs import GraphStore
 
-    graphs_by_id = (
-        {}
-        if args.no_graph
-        else GraphStore(out_dir / _graphs_dirname(cfg)).load_all()
+    store = None if args.no_graph else GraphStore(
+        out_dir / _graphs_dirname(cfg)
     )
+    graphs_by_id = {} if store is None else store.load_all()
 
     by_id = {e.id: e for e in examples}
     # only the splits that are actually batched get tokenized (BPE is the
@@ -787,6 +795,67 @@ def cmd_train_combined(args) -> None:
     dp = mesh.shape.get("dp", 1)
     rows_per_shard = max(1, 16 // dp)
     bs = dp * rows_per_shard
+    bcfg = cfg.data.batch
+
+    # sequence-length bucketing (docs/input_pipeline.md): rows pad to
+    # the smallest configured power-of-two edge >= their real length and
+    # batches are sized by data.token_budget instead of the fixed
+    # 16-row recipe; () keeps the legacy pad-to-max_length path
+    buckets = tuple(int(b) for b in cfg.data.seq_buckets)
+    lengths_by_id: dict[int, int] = {}
+    if buckets:
+        # the largest edge must be exactly max_length: smaller cannot
+        # plan a full-length row (the planner raises), larger is
+        # rejected by the encoder capacity guards this recipe configures
+        # (T5Config.max_sequence_length / max_position_embeddings are
+        # sized to max_length) — so warmup would crash on an edge the
+        # model can never run
+        if buckets[-1] != args.max_length:
+            raise SystemExit(
+                f"data.seq_buckets largest edge {buckets[-1]} != "
+                f"--max-length {args.max_length}: the largest bucket "
+                f"must equal the tokenizer frame (smaller edges cannot "
+                f"hold a full-length row; larger edges exceed the "
+                f"encoder's configured positional capacity)"
+            )
+        from deepdfa_tpu.data.text import (
+            bucketed_collate_batches,
+            lengths_for,
+            plan_bucketed_batches,
+        )
+
+        order = sorted(token_ids)
+        lengths_by_id = dict(
+            zip(order, lengths_for(token_ids, order, tok.pad_id))
+        )
+
+    # bucketed streams ride the same host-pipeline levers as the graph
+    # path: a spawn-pool collater (data.pack_workers) and the
+    # content-keyed packed-batch cache (data.packed_cache) with the
+    # bucket layout in the key
+    text_packer = None
+    if buckets and cfg.data.pack_workers > 1:
+        from deepdfa_tpu.data.mp_pack import TextMpPacker
+
+        text_packer = TextMpPacker(
+            token_ids, labels, graphs_by_id, pad_id=tok.pad_id,
+            workers=cfg.data.pack_workers,
+        )
+    text_cache = source_digest = None
+    if buckets and cfg.data.packed_cache:
+        from deepdfa_tpu.data.packed_cache import (
+            PackedBatchCache,
+            text_corpus_digest,
+        )
+
+        text_cache = PackedBatchCache(
+            paths.cache_dir(ds) / "packed-text",
+            max_entries=cfg.data.packed_cache_max_entries,
+        )
+        source_digest = (
+            text_corpus_digest(token_ids, labels)
+            + ":" + (store.digest() if store is not None else "")
+        )
 
     def split_ids_for(name):
         return [int(k) for k, v in splits.items() if v == name and int(k) in by_id]
@@ -798,18 +867,45 @@ def cmd_train_combined(args) -> None:
     train_ids = split_ids_for("train")
     train_labels = np.array([labels[i] for i in train_ids])
     if cfg.data.undersample and len(train_ids):
-        epoch_rows = len(
-            undersample_epoch(train_labels, 0, seed=cfg.data.seed)
-        )
+        idx0 = undersample_epoch(train_labels, 0, seed=cfg.data.seed)
+        epoch0_ids = [train_ids[i] for i in idx0]
     else:
-        epoch_rows = len(train_ids)
-    steps_per_epoch = max(1, -(-epoch_rows // bs))
+        epoch0_ids = list(train_ids)
+    n_epochs = max(1, cfg.train.max_epochs)
+    if buckets:
+        # bucketed batch count is data-dependent: run the (cheap,
+        # bookkeeping-only) planner over every epoch's actual selection
+        # — under per-epoch undersampling each resample buckets
+        # differently, so extrapolating epoch 0 would drift the LR
+        # schedule off the steps the run really takes
+        def plan_count(sel_ids):
+            return max(1, sum(
+                1 for _ in plan_bucketed_batches(
+                    [lengths_by_id[i] for i in sel_ids], sel_ids,
+                    buckets, cfg.data.token_budget, dp,
+                    bcfg.node_budget, bcfg.edge_budget,
+                )
+            ))
+
+        if cfg.data.undersample and len(train_ids):
+            total_steps = sum(
+                plan_count([
+                    train_ids[i] for i in undersample_epoch(
+                        train_labels, e, seed=cfg.data.seed
+                    )
+                ])
+                for e in range(n_epochs)
+            )
+        else:
+            total_steps = plan_count(epoch0_ids) * n_epochs
+    else:
+        total_steps = max(1, -(-len(epoch0_ids) // bs)) * n_epochs
     trainer = CombinedTrainer(
         cfg, mcfg, mesh=mesh, freeze_graph=args.freeze_graph,
-        total_steps=steps_per_epoch * max(1, cfg.train.max_epochs),
+        total_steps=total_steps,
     )
 
-    def batches(ids):
+    def fixed_batches(ids):
         out = []
         for k in range(0, len(ids), bs):
             sel = ids[k : k + bs]
@@ -821,12 +917,71 @@ def cmd_train_combined(args) -> None:
                     graphs_by_id,
                     num_shards=dp,
                     rows_per_shard=rows_per_shard,
-                    node_budget=cfg.data.batch.node_budget,
-                    edge_budget=cfg.data.batch.edge_budget,
+                    node_budget=bcfg.node_budget,
+                    edge_budget=bcfg.edge_budget,
                     pad_id=tok.pad_id,
                 )
             )
         return out
+
+    def bucketed_batches(ids, phase, epoch):
+        def build():
+            sel_lengths = [lengths_by_id[i] for i in ids]
+            if text_packer is not None:
+                return text_packer.bucketed_batches(
+                    ids, buckets, cfg.data.token_budget, dp,
+                    bcfg.node_budget, bcfg.edge_budget,
+                    lengths=sel_lengths,
+                )
+            return bucketed_collate_batches(
+                token_ids, labels, ids, graphs_by_id, buckets,
+                cfg.data.token_budget, dp, bcfg.node_budget,
+                bcfg.edge_budget, pad_id=tok.pad_id, lengths=sel_lengths,
+            )
+
+        # returned as a live iterator (like the graph path): the first
+        # cold epoch trains off the packer/write_through stream instead
+        # of materializing every batch in host RAM before step 1
+        if text_cache is None:
+            return build()
+        import hashlib
+
+        from deepdfa_tpu.data.packed_cache import cache_key
+
+        undersampling = bool(phase == "train" and cfg.data.undersample)
+        key = cache_key(
+            dict(
+                kind="text",
+                seq_buckets=list(buckets),
+                token_budget=cfg.data.token_budget,
+                num_shards=dp,
+                node_budget=bcfg.node_budget,
+                edge_budget=bcfg.edge_budget,
+                pad_id=tok.pad_id,
+                max_length=args.max_length,
+                phase=phase,
+                # the ORDERED selection itself: the source digest covers
+                # the train+val union, so a union-preserving repartition
+                # (train/val swap, k-fold rotation) or a reorder — the
+                # planner flushes buckets in arrival order — must miss,
+                # never replay the previous partition's batches
+                ids_digest=hashlib.sha256(
+                    np.asarray(ids, np.int64).tobytes()
+                ).hexdigest(),
+                # epoch only shapes the stream when undersampling
+                # resamples per epoch (same rule as the graph path)
+                epoch=epoch if undersampling else None,
+                undersample=undersampling,
+                data_seed=cfg.data.seed,
+            ),
+            source_digest,
+        )
+        return text_cache.get_or_pack(key, build)
+
+    def batches(ids, phase="train", epoch=None):
+        if buckets:
+            return bucketed_batches(list(ids), phase, epoch)
+        return fixed_batches(ids)
 
     def epoch_batches(epoch):
         if cfg.data.undersample:
@@ -834,7 +989,7 @@ def cmd_train_combined(args) -> None:
             ids = [train_ids[i] for i in idx]
         else:
             ids = train_ids
-        return batches(ids)
+        return batches(ids, phase="train", epoch=epoch)
 
     state = trainer.init_state()
     if args.graph_checkpoint:
@@ -870,12 +1025,16 @@ def cmd_train_combined(args) -> None:
         state = trainer.load_encoder(state, enc_import(enc_cfg, sd))
 
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
-    state = trainer.fit(
-        state,
-        epoch_batches,
-        val_batches=lambda: batches(split_ids_for("val")),
-        checkpoints=ckpts,
-    )
+    try:
+        state = trainer.fit(
+            state,
+            epoch_batches,
+            val_batches=lambda: batches(split_ids_for("val"), phase="val"),
+            checkpoints=ckpts,
+        )
+    finally:
+        if text_packer is not None:
+            text_packer.close()
     print("best:", ckpts.best_metrics())
 
 

@@ -22,6 +22,14 @@ from typing import Any
 
 ALL_SUBKEYS = ("api", "datatype", "literal", "operator")
 
+#: pad-token id per encoder family — the ONE convention shared by the
+#: text collaters (padding fill, data/text.py) and the encoders'
+#: attention-mask derivation (`input_ids != pad`, models/transformer.py
+#: and models/t5.py). RoBERTa-family vocabs put <pad> at 1, the T5 frame
+#: at 0. Both sides read this table so they cannot drift apart at two
+#: call sites that agree only by convention.
+PAD_ID_BY_FAMILY = {"roberta": 1, "t5": 0}
+
 
 @dataclass(frozen=True)
 class FeatureSpec:
@@ -157,6 +165,21 @@ class DataConfig:
     # least-recently-USED beyond this many (replay refreshes an entry's
     # stamp — the eval split, replayed every epoch, never ages out)
     packed_cache_max_entries: int = 64
+    # sequence-length bucketing for the combined/text path
+    # (docs/input_pipeline.md): each row pads to the smallest configured
+    # bucket edge >= its real token length instead of the tokenizer's
+    # fixed max_length, so transformer FLOPs follow the (lognormal)
+    # length distribution instead of the worst case. () disables —
+    # every batch pads to max_length as before. Edges must be ascending;
+    # the CLI requires the largest edge to EQUAL its --max-length
+    # (smaller cannot hold a full-length row, larger exceeds the
+    # positional capacity the recipe configures for the encoder).
+    seq_buckets: tuple[int, ...] = ()
+    # token budget per bucketed batch (rows x T <= budget, split over dp
+    # shards): short buckets run proportionally more rows at roughly
+    # constant activation memory. 8192 = the legacy 16-row x 512-token
+    # recipe's footprint.
+    token_budget: int = 8192
 
 
 @dataclass(frozen=True)
@@ -214,6 +237,12 @@ class TrainConfig:
     # concurrently — raise when H2D placement is a visible slice of
     # host_place_seconds in the epoch records
     prefetch_producers: int = 1
+    # bound on the combined trainer's compiled-step cache: one entry per
+    # (T, rows, num_graphs) batch signature (sequence bucketing makes
+    # several legal per run), evicted least-recently-used beyond this.
+    # Must be >= len(data.seq_buckets) or warmup'd signatures would
+    # evict each other (CombinedTrainer.warmup raises).
+    step_cache_entries: int = 8
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

@@ -37,6 +37,14 @@ from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
+from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
+from deepdfa_tpu.data.text import (
+    TEXT_ARRAY_FIELDS as _TEXT_FIELDS,
+    TextBatch,
+    TextBatchPlan,
+    collate_plan,
+    plan_bucketed_batches,
+)
 from deepdfa_tpu.graphs.batch import (
     ARRAY_FIELDS as _ARRAY_FIELDS,
     BatchPlan,
@@ -141,6 +149,24 @@ def _sweep_stale() -> int:
     return n
 
 
+def _write_shm(leaves) -> tuple[str, list]:
+    """Copy (name, array) leaves into one fresh segment; (shm name,
+    manifest). Raises OSError when no segment can be created (e.g.
+    /dev/shm exhausted) — callers fall back to pickling the batch."""
+    total = sum(a.nbytes for _, a in leaves)
+    shm = _shm_create(max(1, total))
+    manifest = []
+    off = 0
+    for name, a in leaves:
+        dst = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf, offset=off)
+        dst[...] = a
+        manifest.append((name, str(a.dtype), a.shape, off))
+        off += a.nbytes
+    name = shm.name
+    shm.close()
+    return name, manifest
+
+
 def _pack_one(plan: BatchPlan):
     """Worker entry: pack one plan, hand the arrays back via shared
     memory. Returns ("shm", name, manifest, num_graphs) or, when a
@@ -154,21 +180,54 @@ def _pack_one(plan: BatchPlan):
         for name in _ARRAY_FIELDS
         if getattr(batch, name) is not None
     ]
-    total = sum(a.nbytes for _, a in leaves)
     try:
-        shm = _shm_create(max(1, total))
+        name, manifest = _write_shm(leaves)
     except OSError:
         return ("pickle", batch)
-    manifest = []
-    off = 0
-    for name, a in leaves:
-        dst = np.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf, offset=off)
-        dst[...] = a
-        manifest.append((name, str(a.dtype), a.shape, off))
-        off += a.nbytes
-    name = shm.name
-    shm.close()
     return ("shm", name, manifest, int(batch.num_graphs))
+
+
+def _init_text_worker(
+    token_ids_by_id,
+    labels_by_id,
+    graphs_by_id,
+    pad_id: int,
+    shm_prefix: str = "",
+) -> None:
+    _WORKER["token_ids"] = token_ids_by_id
+    _WORKER["labels"] = labels_by_id
+    _WORKER["graphs_by_id"] = graphs_by_id
+    _WORKER["pad_id"] = pad_id
+    _WORKER["shm_prefix"] = shm_prefix
+    _WORKER["seq"] = 0
+
+
+def _collate_text_one(plan: TextBatchPlan):
+    """Worker entry for bucketed text plans: materialize `collate_plan`
+    and ship the TextBatch — its own leaves plus "graphs."-prefixed
+    nested GraphBatch leaves — through one segment."""
+    batch = collate_plan(
+        plan,
+        _WORKER["token_ids"],
+        _WORKER["labels"],
+        _WORKER["graphs_by_id"],
+        _WORKER["pad_id"],
+    )
+    leaves = [
+        (name, np.ascontiguousarray(np.asarray(getattr(batch, name))))
+        for name in _TEXT_FIELDS
+    ]
+    g = batch.graphs
+    leaves += [
+        (f"graphs.{name}", np.ascontiguousarray(np.asarray(v)))
+        for name in _ARRAY_FIELDS
+        if (v := getattr(g, name)) is not None
+    ]
+    try:
+        name, manifest = _write_shm(leaves)
+    except OSError:
+        return ("pickle", batch)
+    return ("shm", name, manifest, int(g.num_graphs))
 
 
 def _discard_shm(name: str) -> None:
@@ -185,29 +244,21 @@ def _discard_shm(name: str) -> None:
         pass
 
 
-def _receive(result) -> GraphBatch:
-    if result[0] == "pickle":
-        return result[1]
-    _, name, manifest, num_graphs = result
+def _read_shm_arrays(name: str, manifest) -> dict[str, np.ndarray]:
+    """Copy every manifest leaf out of a segment, then unlink it —
+    holding mmap views hostage to consumer lifetime risks BufferError on
+    close and /dev/shm leaks on crash; the copy is one memcpy and the
+    batch is device_put right after anyway (zero-copy host replay is the
+    cache's job, data/packed_cache.py)."""
     shm = shared_memory.SharedMemory(name=name)
     try:
-        arrays = {}
-        for fname, dtype, shape, off in manifest:
-            view = np.ndarray(
+        return {
+            fname: np.ndarray(
                 tuple(shape), dtype=np.dtype(dtype), buffer=shm.buf,
                 offset=off,
-            )
-            # copy out so the segment can be unlinked immediately —
-            # holding mmap views hostage to consumer lifetime risks
-            # BufferError on close and /dev/shm leaks on crash; the copy
-            # is one memcpy and the batch is device_put right after
-            # anyway (zero-copy host replay is the cache's job,
-            # data/packed_cache.py)
-            arrays[fname] = view.copy()
-        return GraphBatch(
-            **{n: arrays.get(n) for n in _ARRAY_FIELDS},
-            num_graphs=num_graphs,
-        )
+            ).copy()
+            for fname, dtype, shape, off in manifest
+        }
     finally:
         shm.close()
         try:
@@ -216,30 +267,57 @@ def _receive(result) -> GraphBatch:
             pass
 
 
-class MpPacker:
-    """A reusable spawn-pool packer bound to one corpus.
+def _receive(result) -> GraphBatch:
+    if result[0] == "pickle":
+        return result[1]
+    _, name, manifest, num_graphs = result
+    arrays = _read_shm_arrays(name, manifest)
+    return GraphBatch(
+        **{n: arrays.get(n) for n in _ARRAY_FIELDS},
+        num_graphs=num_graphs,
+    )
 
-    Construction cost (spawn + corpus pickle + jax import per worker) is
+
+def _receive_text(result) -> TextBatch:
+    if result[0] == "pickle":
+        return result[1]
+    _, name, manifest, num_graphs = result
+    arrays = _read_shm_arrays(name, manifest)
+    graphs = {
+        k[len("graphs."):]: v
+        for k, v in arrays.items()
+        if k.startswith("graphs.")
+    }
+    return TextBatch(
+        **{n: arrays.get(n) for n in _TEXT_FIELDS},
+        graphs=GraphBatch(
+            **{n: graphs.get(n) for n in _ARRAY_FIELDS},
+            num_graphs=num_graphs,
+        ),
+    )
+
+
+class _PoolPacker:
+    """Shared spawn-pool mechanics for the batch packers.
+
+    Construction cost (spawn + state pickle + jax import per worker) is
     paid once, lazily on the first `pack` that needs it — a caller can
     hold a packer for a whole run and never spawn a worker if every
-    epoch replays the packed-batch cache. `shard_bucket_batches` can
-    then be called every epoch. Use as a context manager, or call
-    close().
+    epoch replays the packed-batch cache. Use as a context manager, or
+    call close(). Subclasses bind the worker entry points:
+    `_init_fn`/`_init_args()` (pool initializer), `_task_fn` (one item
+    -> shm/pickle result), `_receive_fn` (result -> batch) and
+    `_pack_inline` (the workers<=1 fallback).
     """
 
-    def __init__(
-        self,
-        graphs: Iterable[GraphSpec],
-        workers: int | None = None,
-        add_self_loops: bool = True,
-    ):
-        self.graphs = (
-            graphs if isinstance(graphs, Sequence) else list(graphs)
-        )
+    _init_fn = None
+    _task_fn = None
+    _receive_fn = None
+
+    def __init__(self, workers: int | None = None):
         self.workers = (
             workers if workers is not None else (os.cpu_count() or 1)
         )
-        self.add_self_loops = add_self_loops
         self._pool = None
         # per-packer shm namespace: close() may sweep it wholesale
         # without touching a sibling packer's live segments (cmd_train
@@ -248,18 +326,24 @@ class MpPacker:
             f"{_SHM_PREFIX}-{os.getpid()}-{next(_PACKER_TOKENS)}-"
         )
 
+    def _init_args(self) -> tuple:
+        raise NotImplementedError
+
+    def _pack_inline(self, item):
+        raise NotImplementedError
+
     def _get_pool(self):
         if self._pool is None and self.workers > 1:
             _sweep_stale()
             ctx = mp.get_context("spawn")
             self._pool = ctx.Pool(
                 self.workers,
-                initializer=_init_worker,
-                initargs=(self.graphs, self.add_self_loops, self._shm_prefix),
+                initializer=type(self)._init_fn,
+                initargs=(*self._init_args(), self._shm_prefix),
             )
         return self._pool
 
-    def __enter__(self) -> "MpPacker":
+    def __enter__(self):
         return self
 
     def __exit__(self, *exc) -> None:
@@ -290,7 +374,7 @@ class MpPacker:
             if result[0] == "shm":
                 _discard_shm(result[1])
 
-    def pack(self, plans: Iterable[BatchPlan]) -> Iterator[GraphBatch]:
+    def pack(self, plans: Iterable) -> Iterator:
         """Pack plans across the pool, yielding in plan order.
 
         Dispatch is windowed (2*workers outstanding): imap's task
@@ -304,28 +388,57 @@ class MpPacker:
         pool = self._get_pool()
         if pool is None:
             for plan in plans:
-                yield pack_plan(self.graphs, plan, self.add_self_loops)
+                yield self._pack_inline(plan)
             return
         window = 2 * self.workers
         it = iter(plans)
         pending: deque = deque()
+        task = type(self)._task_fn
+        receive = type(self)._receive_fn
 
         def fill() -> None:
             while len(pending) < window:
                 plan = next(it, None)
                 if plan is None:
                     return
-                pending.append(pool.apply_async(_pack_one, (plan,)))
+                pending.append(pool.apply_async(task, (plan,)))
 
         try:
             fill()
             while pending:
                 result = pending.popleft().get()
                 fill()  # keep workers fed while the consumer trains
-                yield _receive(result)
+                yield receive(result)
         except BaseException:
             self._drain(pending)
             raise
+
+
+class MpPacker(_PoolPacker):
+    """A reusable spawn-pool packer bound to one GraphSpec corpus;
+    `shard_bucket_batches` can be called every epoch."""
+
+    _init_fn = staticmethod(_init_worker)
+    _task_fn = staticmethod(_pack_one)
+    _receive_fn = staticmethod(_receive)
+
+    def __init__(
+        self,
+        graphs: Iterable[GraphSpec],
+        workers: int | None = None,
+        add_self_loops: bool = True,
+    ):
+        super().__init__(workers)
+        self.graphs = (
+            graphs if isinstance(graphs, Sequence) else list(graphs)
+        )
+        self.add_self_loops = add_self_loops
+
+    def _init_args(self) -> tuple:
+        return (self.graphs, self.add_self_loops)
+
+    def _pack_inline(self, plan: BatchPlan) -> GraphBatch:
+        return pack_plan(self.graphs, plan, self.add_self_loops)
 
     def shard_bucket_batches(
         self,
@@ -386,3 +499,74 @@ def mp_shard_bucket_batches(
             num_shards, num_graphs, node_budget, edge_budget, oversized,
             stats,
         )
+
+
+class TextMpPacker(_PoolPacker):
+    """Spawn-pool collater for bucketed TextBatch streams — the text-path
+    analog of MpPacker (ISSUE 2). The parent runs the cheap sequential
+    planner (`data/text.py:plan_bucketed_batches`), workers materialize
+    `collate_plan` (numpy-heavy padding + aligned graph packing), and
+    batches return through the same shared-memory protocol: TextBatch
+    leaves plus "graphs."-prefixed nested GraphBatch leaves in one
+    segment. Order and content are bit-identical to inline collation
+    (same plans, same collater; pinned by
+    tests/test_text_bucketing.py:test_text_pool_and_cache_roundtrip).
+    """
+
+    _init_fn = staticmethod(_init_text_worker)
+    _task_fn = staticmethod(_collate_text_one)
+    _receive_fn = staticmethod(_receive_text)
+
+    def __init__(
+        self,
+        token_ids_by_id,
+        labels_by_id,
+        graphs_by_id,
+        pad_id: int = PAD_ID_BY_FAMILY["roberta"],
+        workers: int | None = None,
+    ):
+        super().__init__(workers)
+        self.token_ids_by_id = dict(token_ids_by_id)
+        self.labels_by_id = dict(labels_by_id)
+        self.graphs_by_id = dict(graphs_by_id)
+        self.pad_id = int(pad_id)
+
+    def _init_args(self) -> tuple:
+        return (
+            self.token_ids_by_id, self.labels_by_id, self.graphs_by_id,
+            self.pad_id,
+        )
+
+    def _pack_inline(self, plan: TextBatchPlan) -> TextBatch:
+        return collate_plan(
+            plan, self.token_ids_by_id, self.labels_by_id,
+            self.graphs_by_id, self.pad_id,
+        )
+
+    def bucketed_batches(
+        self,
+        example_ids: Sequence[int],
+        buckets: Sequence[int],
+        token_budget: int,
+        num_shards: int,
+        node_budget: int,
+        edge_budget: int,
+        lengths: Sequence[int] | None = None,
+        stats: dict | None = None,
+    ) -> Iterator[TextBatch]:
+        """Drop-in parallel `data.text.bucketed_collate_batches` over the
+        bound corpus: identical plans, identical batches, collated on
+        the pool. `example_ids` restricts (and orders) the pass — e.g. a
+        per-epoch undersample selection — without re-pickling the corpus
+        to the workers."""
+        if lengths is None:
+            from deepdfa_tpu.data.text import lengths_for
+
+            lengths = lengths_for(
+                self.token_ids_by_id, example_ids, self.pad_id
+            )
+        plans = plan_bucketed_batches(
+            lengths, example_ids, buckets, token_budget, num_shards,
+            node_budget, edge_budget, stats=stats,
+        )
+        yield from self.pack(plans)

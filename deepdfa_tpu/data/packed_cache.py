@@ -43,6 +43,10 @@ from typing import Callable, Iterable, Iterator, Mapping, Sequence
 
 import numpy as np
 
+from deepdfa_tpu.data.text import (
+    TEXT_ARRAY_FIELDS as _TEXT_FIELDS,
+    TextBatch,
+)
 from deepdfa_tpu.graphs.batch import (
     ARRAY_FIELDS as _ARRAY_FIELDS,
     GraphBatch,
@@ -101,6 +105,28 @@ def corpus_digest(specs: Sequence[GraphSpec]) -> str:
     return h.hexdigest()
 
 
+def text_corpus_digest(
+    token_ids_by_id: Mapping[int, np.ndarray],
+    labels_by_id: Mapping[int, int],
+) -> str:
+    """Content digest of a tokenized text corpus (cache-key source
+    component for bucketed TextBatch streams, keyed id order
+    canonicalized). Hashes every row's bytes + label, so any
+    re-tokenization (max_length, vocab, framing) or label edit
+    invalidates. Combine with the graph-side digest for combined-model
+    streams — both halves shape the packed bytes."""
+    h = hashlib.sha256()
+    h.update(len(token_ids_by_id).to_bytes(8, "little"))
+    for i in sorted(token_ids_by_id):
+        a = np.ascontiguousarray(np.asarray(token_ids_by_id[i]))
+        h.update(int(i).to_bytes(8, "little", signed=True))
+        h.update(int(labels_by_id[i]).to_bytes(8, "little", signed=True))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 class PackedBatchCache:
     """A directory of packed-batch streams addressable by cache key.
 
@@ -127,8 +153,8 @@ class PackedBatchCache:
     # -- write ---------------------------------------------------------------
 
     def write_through(
-        self, key: str, batches: Iterable[GraphBatch]
-    ) -> Iterator[GraphBatch]:
+        self, key: str, batches: Iterable[GraphBatch | TextBatch]
+    ) -> Iterator[GraphBatch | TextBatch]:
         """Yield `batches` unchanged while persisting them.
 
         The first epoch trains at full speed off the live packer; the
@@ -150,7 +176,33 @@ class PackedBatchCache:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
 
-    def _save_batch(self, d: Path, i: int, batch: GraphBatch) -> dict:
+    def _save_batch(
+        self, d: Path, i: int, batch: GraphBatch | TextBatch
+    ) -> dict:
+        if isinstance(batch, TextBatch):
+            # bucketed TextBatch: its own leaves plus the nested graph
+            # leaves under a "graphs." file infix; manifests tag the
+            # kind so replay rebuilds the right pytree (graph-only
+            # manifests predate the tag and default to "graph")
+            gfields = []
+            for name in _TEXT_FIELDS:
+                np.save(
+                    d / f"b{i:05d}.{name}.npy",
+                    np.asarray(getattr(batch, name)),
+                )
+            g = batch.graphs
+            for name in _ARRAY_FIELDS:
+                v = getattr(g, name)
+                if v is None:
+                    continue
+                gfields.append(name)
+                np.save(d / f"b{i:05d}.graphs.{name}.npy", np.asarray(v))
+            return {
+                "kind": "text",
+                "num_graphs": int(g.num_graphs),
+                "fields": list(_TEXT_FIELDS),
+                "graph_fields": gfields,
+            }
         fields = []
         for name in _ARRAY_FIELDS:
             v = getattr(batch, name)
@@ -199,9 +251,14 @@ class PackedBatchCache:
 
     # -- read ----------------------------------------------------------------
 
-    def replay(self, key: str, mmap: bool = True) -> Iterator[GraphBatch]:
+    def replay(
+        self, key: str, mmap: bool = True
+    ) -> Iterator[GraphBatch | TextBatch]:
         """Iterate a complete entry; arrays are read-only mmap views by
-        default (zero-copy until device_put)."""
+        default (zero-copy until device_put). Batch kind comes from the
+        manifest: "text" entries rebuild the TextBatch + nested
+        GraphBatch pytree; untagged entries are graph-only (they predate
+        the tag)."""
         d = self.entry_dir(key)
         manifest_path = d / "manifest.json"
         manifest = json.loads(manifest_path.read_text())
@@ -220,6 +277,21 @@ class PackedBatchCache:
                 name: np.load(d / f"b{i:05d}.{name}.npy", mmap_mode=mode)
                 for name in m["fields"]
             }
+            if m.get("kind") == "text":
+                garrays = {
+                    name: np.load(
+                        d / f"b{i:05d}.graphs.{name}.npy", mmap_mode=mode
+                    )
+                    for name in m["graph_fields"]
+                }
+                yield TextBatch(
+                    **{n: arrays.get(n) for n in _TEXT_FIELDS},
+                    graphs=GraphBatch(
+                        **{n: garrays.get(n) for n in _ARRAY_FIELDS},
+                        num_graphs=m["num_graphs"],
+                    ),
+                )
+                continue
             yield GraphBatch(
                 **{n: arrays.get(n) for n in _ARRAY_FIELDS},
                 num_graphs=m["num_graphs"],
@@ -228,9 +300,9 @@ class PackedBatchCache:
     def get_or_pack(
         self,
         key: str,
-        builder: Callable[[], Iterable[GraphBatch]],
+        builder: Callable[[], Iterable[GraphBatch | TextBatch]],
         mmap: bool = True,
-    ) -> Iterator[GraphBatch]:
+    ) -> Iterator[GraphBatch | TextBatch]:
         """Replay `key` when warm; otherwise build via `builder()` and
         persist write-through. Either way the consumer sees the exact
         stream `builder()` would produce."""

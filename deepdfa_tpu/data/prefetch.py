@@ -52,6 +52,12 @@ class PipelineStats:
     - ``place_seconds``: sharded ``jax.device_put`` (H2D copy dispatch).
     - ``wait_seconds``: consumer blocked waiting for the next batch — the
       number that indicts the host when it stays high.
+
+    Token counters (the sequence-bucketing observables,
+    docs/input_pipeline.md): text-batch consumers call ``add_tokens``
+    per batch so the epoch records can report real-token throughput and
+    ``padding_waste`` — the fraction of padded token slots the device
+    computes that carry no real token.
     """
 
     load_seconds: float = 0.0
@@ -60,6 +66,9 @@ class PipelineStats:
     wait_seconds: float = 0.0
     produced: int = 0
     consumed: int = 0
+    real_tokens: int = 0
+    padded_tokens: int = 0
+    rows: int = 0
 
     def __post_init__(self):
         self._lock = threading.Lock()
@@ -72,12 +81,28 @@ class PipelineStats:
             )
             self.produced += produced
 
+    def add_tokens(self, real: int, padded: int, rows: int = 0) -> None:
+        """Account one text batch: `real` non-pad tokens in valid rows,
+        `padded` total token slots (the full static shape — padding rows
+        are device compute too), `rows` valid rows."""
+        with self._lock:
+            self.real_tokens += int(real)
+            self.padded_tokens += int(padded)
+            self.rows += int(rows)
+
+    def padding_waste(self) -> float:
+        """1 - real/padded: the fraction of computed token slots that
+        hold padding (0.0 when no tokens were accounted)."""
+        if self.padded_tokens <= 0:
+            return 0.0
+        return 1.0 - self.real_tokens / self.padded_tokens
+
     def wait_fraction(self, total_seconds: float) -> float:
         """Fraction of a consumer's wall-clock spent blocked on input."""
         return self.wait_seconds / total_seconds if total_seconds > 0 else 0.0
 
     def record(self) -> dict[str, float]:
-        return {
+        out = {
             "load_seconds": round(self.load_seconds, 4),
             "pack_seconds": round(self.pack_seconds, 4),
             "place_seconds": round(self.place_seconds, 4),
@@ -85,6 +110,14 @@ class PipelineStats:
             "produced": self.produced,
             "consumed": self.consumed,
         }
+        if self.padded_tokens:
+            out.update(
+                real_tokens=self.real_tokens,
+                padded_tokens=self.padded_tokens,
+                rows=self.rows,
+                padding_waste=round(self.padding_waste(), 4),
+            )
+        return out
 
 
 def prefetch(

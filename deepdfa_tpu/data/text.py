@@ -6,16 +6,28 @@ DDFA/sastvd/linevd/dataset.py:63-76, linevul_main.py:194-197) with static
 shapes: text row i aligns with graph slot i; rows with no extracted graph
 get `has_graph=False` and a zeroed graph embedding instead of being
 dropped.
+
+Sequence-length bucketing (docs/input_pipeline.md): Big-Vul function
+lengths are lognormal (median ~14 statements) while the LineVul recipe
+pads every row to a fixed 512 tokens — most transformer FLOPs attend
+over padding. `plan_bucketed_batches` assigns each row to the smallest
+configured power-of-two bucket edge that fits its real length, and sizes
+each batch by a TOKEN budget (`rows x T <= budget`) so short buckets run
+proportionally more rows at roughly constant activation memory. Packing
+a plan goes through the same `collate_shards` as the fixed-length path,
+so per-row semantics (graph alignment, has_graph budget degrade) are
+identical by construction; only the pad target and row count change.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Mapping, Sequence
+from typing import Iterable, Iterator, Mapping, Sequence
 
 import jax
 import numpy as np
 
+from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
 from deepdfa_tpu.graphs.batch import GraphSpec, pack
 from deepdfa_tpu.graphs.batch import GraphBatch
 
@@ -28,6 +40,13 @@ class TextBatch:
     row_mask: jax.Array  # [B] bool (False = padding row)
     has_graph: jax.Array  # [B] bool
     graphs: GraphBatch  # num_graphs == B, graph i <-> text row i
+
+
+#: TextBatch's own array leaves (the nested GraphBatch leaves are
+#: graphs/batch.py:ARRAY_FIELDS) — the serialization order shared by the
+#: packed-batch cache and the shared-memory packer (data/packed_cache.py,
+#: data/mp_pack.py)
+TEXT_ARRAY_FIELDS = ("input_ids", "labels", "row_mask", "has_graph")
 
 
 _EMPTY = GraphSpec(
@@ -48,13 +67,14 @@ def collate(
     batch_rows: int,
     node_budget: int,
     edge_budget: int,
-    pad_id: int = 1,
+    pad_id: int = PAD_ID_BY_FAMILY["roberta"],
 ) -> TextBatch:
     """Build one static-shape TextBatch (n <= batch_rows).
 
-    pad_id must match the encoder's pad convention (RoBERTa family: 1,
-    T5 family: 0) — padding rows are filled with it and the encoders
-    derive their attention masks from it."""
+    pad_id must match the encoder's pad convention — padding rows are
+    filled with it and the encoders derive their attention masks from
+    it. Both sides default to the shared `PAD_ID_BY_FAMILY` table
+    (core/config.py) so they cannot drift apart."""
     n = len(labels)
     if n > batch_rows:
         raise ValueError(f"{n} rows > batch_rows {batch_rows}")
@@ -105,7 +125,7 @@ def collate_shards(
     rows_per_shard: int,
     node_budget: int,
     edge_budget: int,
-    pad_id: int = 1,
+    pad_id: int = PAD_ID_BY_FAMILY["roberta"],
 ) -> TextBatch:
     """Shard rows round-robin and stack shard batches on a leading dp axis."""
     n = len(labels)
@@ -130,3 +150,236 @@ def collate_shards(
         )
     stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *shards)
     return stacked
+
+
+# ---------------------------------------------------------------------------
+# sequence-length bucketing
+
+
+def token_lengths(token_ids: np.ndarray, pad_id: int) -> np.ndarray:
+    """[n] real (unpadded) length per row of a right-padded id matrix.
+
+    Rows are right-padded with `pad_id` (the tokenizer contract), so the
+    real length is the index of the last non-pad token + 1; an all-pad
+    row has length 0."""
+    ids = np.asarray(token_ids)
+    nonpad = ids != pad_id
+    tail = np.argmax(nonpad[:, ::-1], axis=1)
+    return np.where(
+        nonpad.any(axis=1), ids.shape[1] - tail, 0
+    ).astype(np.int64)
+
+
+def batch_token_counts(
+    input_ids: np.ndarray, row_mask: np.ndarray, pad_id: int
+) -> tuple[int, int, int]:
+    """(real, padded, rows) for one batch: non-pad tokens in VALID rows,
+    total token slots (the full static shape — padding rows are device
+    compute too), and valid rows. The train loops feed these into
+    `PipelineStats.add_tokens` so epoch records report real-token
+    throughput and padding waste."""
+    ids = np.asarray(input_ids)
+    mask = np.asarray(row_mask, bool)
+    real = int(((ids != pad_id) & mask[..., None]).sum())
+    return real, int(ids.size), int(mask.sum())
+
+
+def lengths_for(
+    token_ids_by_id: Mapping[int, np.ndarray],
+    example_ids: Sequence[int],
+    pad_id: int,
+) -> list[int]:
+    """Real token length per selected example, in selection order.
+
+    One vectorized `token_lengths` call over the stacked matrix when the
+    rows share a width (the tokenizer pads every row to max_length, so
+    they normally do) — a per-row loop over a Big-Vul-scale corpus pays
+    ~180k numpy dispatches per epoch start otherwise."""
+    if not len(example_ids):
+        return []
+    rows = [np.asarray(token_ids_by_id[i]) for i in example_ids]
+    if len({r.shape[0] for r in rows}) == 1:
+        return [int(n) for n in token_lengths(np.stack(rows), pad_id)]
+    return [int(token_lengths(r[None], pad_id)[0]) for r in rows]
+
+
+def rows_for_bucket(seq_len: int, token_budget: int, num_shards: int) -> int:
+    """Rows PER SHARD a `token_budget` allows at bucket edge `seq_len`
+    (`rows x T <= budget`, budget split over dp shards; at least 1 row
+    per shard so a tight budget degrades to small batches, never zero).
+
+    The ONE definition of the batch-sizing formula — the planner, the
+    trainer's warmup signatures, and the benches all call it, so a
+    change cannot desynchronize compile signatures from real batches."""
+    return max(1, int(token_budget) // (int(seq_len) * max(1, num_shards)))
+
+
+@dataclasses.dataclass(frozen=True)
+class TextBatchPlan:
+    """Collation recipe for one bucketed batch: which examples, padded to
+    which bucket edge, at which (token-budget-derived) row count.
+
+    Planning is cheap bookkeeping over row lengths; `collate_plan` is the
+    numpy-heavy materialization — the same plan/pack split as
+    graphs/batch.py:BatchPlan, shared by the inline collater, the
+    process-pool packer (data/mp_pack.py:TextMpPacker) and the
+    packed-batch cache builder, so every path is bit-identical by
+    construction."""
+
+    example_ids: tuple[int, ...]
+    seq_len: int
+    rows_per_shard: int
+    num_shards: int
+    node_budget: int
+    edge_budget: int
+
+
+def plan_bucketed_batches(
+    lengths: Sequence[int] | np.ndarray,
+    example_ids: Sequence[int],
+    buckets: Sequence[int],
+    token_budget: int,
+    num_shards: int,
+    node_budget: int,
+    edge_budget: int,
+    stats: dict | None = None,
+) -> Iterator[TextBatchPlan]:
+    """Assign rows to length buckets and emit token-budget-sized plans.
+
+    Each row goes to the smallest bucket edge >= its real length (order
+    within a bucket is arrival order; a bucket flushes when it reaches
+    its `rows_for_bucket` capacity, and partial buckets flush ascending
+    at the end — fully deterministic in the input order, which keeps the
+    stream cache-replayable). A row longer than the largest bucket is a
+    configuration error and raises loudly.
+
+    stats (optional dict) receives: "batches", "rows", "real_tokens",
+    "padded_tokens" (rows x bucket edge, summed) and "by_bucket"
+    ({edge: rows}) — final once the generator is exhausted.
+    """
+    buckets = tuple(int(b) for b in buckets)
+    if not buckets or list(buckets) != sorted(set(buckets)):
+        raise ValueError(
+            f"seq_buckets must be ascending unique edges, got {buckets}"
+        )
+    if buckets[0] < 2:
+        raise ValueError(f"bucket edge {buckets[0]} < 2 is meaningless")
+    lengths = np.asarray(lengths, np.int64)
+    if len(lengths) != len(example_ids):
+        raise ValueError(
+            f"{len(lengths)} lengths vs {len(example_ids)} example_ids"
+        )
+    if stats is None:
+        stats = {}
+    stats.update(
+        batches=0, rows=0, real_tokens=0, padded_tokens=0,
+        by_bucket={b: 0 for b in buckets},
+    )
+
+    capacity = {
+        b: rows_for_bucket(b, token_budget, num_shards) * num_shards
+        for b in buckets
+    }
+    pending: dict[int, list[int]] = {b: [] for b in buckets}
+
+    def emit(edge: int) -> TextBatchPlan:
+        ids = pending[edge]
+        pending[edge] = []
+        stats["batches"] += 1
+        stats["rows"] += len(ids)
+        stats["by_bucket"][edge] += len(ids)
+        # padded tokens count the FULL static shape (capacity x edge):
+        # padding rows are device compute too, and the waste fraction
+        # must indict them
+        stats["padded_tokens"] += capacity[edge] * edge
+        return TextBatchPlan(
+            tuple(ids), edge, capacity[edge] // num_shards, num_shards,
+            node_budget, edge_budget,
+        )
+
+    edges = np.asarray(buckets, np.int64)
+    for eid, ln in zip(example_ids, lengths):
+        ln = int(ln)
+        if ln > buckets[-1]:
+            raise ValueError(
+                f"example {eid}: real token length {ln} exceeds the "
+                f"largest bucket edge {buckets[-1]} — add a bucket >= "
+                f"the tokenizer max_length (data.seq_buckets)"
+            )
+        edge = int(edges[np.searchsorted(edges, max(ln, 1))])
+        pending[edge].append(int(eid))
+        stats["real_tokens"] += ln
+        if len(pending[edge]) == capacity[edge]:
+            yield emit(edge)
+    for edge in buckets:
+        if pending[edge]:
+            yield emit(edge)
+
+
+def _fit_width(row: np.ndarray, seq_len: int, pad_id: int) -> np.ndarray:
+    row = np.asarray(row, np.int32)
+    if row.shape[0] >= seq_len:
+        return row[:seq_len]
+    out = np.full((seq_len,), pad_id, np.int32)
+    out[: row.shape[0]] = row
+    return out
+
+
+def collate_plan(
+    plan: TextBatchPlan,
+    token_ids_by_id: Mapping[int, np.ndarray],
+    labels_by_id: Mapping[int, int],
+    graphs_by_id: Mapping[int, GraphSpec],
+    pad_id: int = PAD_ID_BY_FAMILY["roberta"],
+) -> TextBatch:
+    """Materialize one bucketed plan through the standard collater.
+
+    Rows slice to the bucket edge — the planner guarantees every real
+    token fits, so the slice only drops trailing padding and the
+    (example_id, label, unpadded-token) multiset is preserved exactly.
+    Graph alignment and has_graph budget degrade are `collate_shards`'s
+    own semantics, unchanged."""
+    ids = plan.example_ids
+    if ids:
+        tok = np.stack(
+            [_fit_width(token_ids_by_id[i], plan.seq_len, pad_id) for i in ids]
+        )
+    else:
+        tok = np.zeros((0, plan.seq_len), np.int32)
+    return collate_shards(
+        tok,
+        [int(labels_by_id[i]) for i in ids],
+        list(ids),
+        graphs_by_id,
+        num_shards=plan.num_shards,
+        rows_per_shard=plan.rows_per_shard,
+        node_budget=plan.node_budget,
+        edge_budget=plan.edge_budget,
+        pad_id=pad_id,
+    )
+
+
+def bucketed_collate_batches(
+    token_ids_by_id: Mapping[int, np.ndarray],
+    labels_by_id: Mapping[int, int],
+    example_ids: Sequence[int],
+    graphs_by_id: Mapping[int, GraphSpec],
+    buckets: Sequence[int],
+    token_budget: int,
+    num_shards: int,
+    node_budget: int,
+    edge_budget: int,
+    pad_id: int = PAD_ID_BY_FAMILY["roberta"],
+    lengths: Sequence[int] | None = None,
+    stats: dict | None = None,
+) -> Iterable[TextBatch]:
+    """Plan + collate in one pass (the inline, no-pool path)."""
+    if lengths is None:
+        lengths = lengths_for(token_ids_by_id, example_ids, pad_id)
+    for plan in plan_bucketed_batches(
+        lengths, example_ids, buckets, token_budget, num_shards,
+        node_budget, edge_budget, stats=stats,
+    ):
+        yield collate_plan(
+            plan, token_ids_by_id, labels_by_id, graphs_by_id, pad_id
+        )

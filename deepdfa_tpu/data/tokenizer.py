@@ -24,6 +24,7 @@ from pathlib import Path
 
 import numpy as np
 
+from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
 from deepdfa_tpu.data.diffs import split_lines
 
 
@@ -60,10 +61,15 @@ class HashTokenizer(Tokenizer):
     def __init__(self, vocab_size: int = 4096, t5_frame: bool = False):
         assert vocab_size > 8
         self.vocab_size = vocab_size
+        # pad ids come from the shared family table (core/config.py) so
+        # the collaters and the encoders' mask derivation agree with the
+        # frames produced here by construction
         if t5_frame:
-            self.pad_id, self.cls_id, self.sep_id, self.unk_id = 0, 1, 2, 3
+            self.pad_id = PAD_ID_BY_FAMILY["t5"]
+            self.cls_id, self.sep_id, self.unk_id = 1, 2, 3
         else:
-            self.cls_id, self.sep_id, self.pad_id, self.unk_id = 0, 2, 1, 3
+            self.pad_id = PAD_ID_BY_FAMILY["roberta"]
+            self.cls_id, self.sep_id, self.unk_id = 0, 2, 3
         self._first = 4
 
     def encode(self, text: str, max_length: int = 512) -> np.ndarray:

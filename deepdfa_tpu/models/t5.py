@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
 from deepdfa_tpu.parallel.megatron import region_end, region_start
 
 
@@ -41,7 +42,16 @@ class T5Config:
     layer_norm_eps: float = 1e-6
     dropout_rate: float = 0.1
     eos_token_id: int = 2
-    pad_token_id: int = 0
+    # the shared collater/encoder pad convention (core/config.py) — the
+    # attention mask derives from `input_ids != pad_token_id`
+    pad_token_id: int = PAD_ID_BY_FAMILY["t5"]
+    #: unlike the RoBERTa family there is NO hard positional capacity —
+    #: the relative-position bias log-buckets and clamps distances, so
+    #: any T is numerically safe. This optional bound exists so a
+    #: misconfigured bucket edge (data.seq_buckets) fails loudly against
+    #: the recipe's intended max_length instead of silently training on
+    #: sequences the recipe never meant to cover. None = unbounded.
+    max_sequence_length: int | None = None
     dtype: str = "float32"
     remat: bool = True
     #: sequence-parallel attention scheme under sp>1 meshes: "ring"
@@ -325,6 +335,19 @@ def encode(
     global bias (encoder_rel_bias)."""
     from deepdfa_tpu.models.transformer import _dropout
 
+    # capacity guard (see T5Config.max_sequence_length): local T under
+    # sp understates the global length, so this catches per-shard edges
+    # only — the combined CLI sets the bound to its max_length
+    if (
+        cfg.max_sequence_length is not None
+        and input_ids.shape[1] > cfg.max_sequence_length
+    ):
+        raise ValueError(
+            f"sequence length {input_ids.shape[1]} exceeds "
+            f"max_sequence_length={cfg.max_sequence_length} — lower the "
+            f"bucket edge (data.seq_buckets) / max_length or raise the "
+            f"configured bound"
+        )
     if attn_mask is None:
         attn_mask = input_ids != cfg.pad_token_id
     dt = jnp.dtype(cfg.dtype)

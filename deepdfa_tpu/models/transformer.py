@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
 from deepdfa_tpu.parallel.megatron import region_end, region_start
 from deepdfa_tpu.nn.flash_attention import flash_attention
 from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
@@ -47,7 +48,9 @@ class TransformerConfig:
     intermediate_size: int = 3072
     max_position_embeddings: int = 514
     type_vocab_size: int = 1
-    pad_token_id: int = 1
+    # the shared collater/encoder pad convention (core/config.py) — the
+    # attention mask derives from `input_ids != pad_token_id`
+    pad_token_id: int = PAD_ID_BY_FAMILY["roberta"]
     layer_norm_eps: float = 1e-5
     dropout_rate: float = 0.1
     dtype: str = "float32"  # activation dtype (bfloat16 for big runs)
@@ -207,6 +210,25 @@ def embed(
 ) -> jax.Array:
     """Token+position+type embeddings. `position_offset` is the number of
     tokens on earlier sp shards (sequence-parallel position ids)."""
+    # capacity guard: RoBERTa's pad-offset position ids run up to
+    # T + offset + pad_token_id, and a gather past the table's end would
+    # silently index OOB (XLA clamps) instead of failing — a
+    # misconfigured bucket edge (data.seq_buckets) must fail loudly
+    # here. Under sequence parallelism the offset is traced
+    # (axis_index * T_local), so only the static-offset case is
+    # checkable; the local-T check still catches edges past the table.
+    if isinstance(position_offset, int):
+        top = input_ids.shape[1] + position_offset + cfg.pad_token_id
+        if top > cfg.max_position_embeddings - 1:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} (+ position offset "
+                f"{position_offset}) needs position ids up to {top}, but "
+                f"the learned position table has only "
+                f"{cfg.max_position_embeddings} rows "
+                f"(max_position_embeddings) — lower the bucket edge / "
+                f"max_length or grow the table (RoBERTa ids run "
+                f"pad_token_id+1 .. pad_token_id+T)"
+            )
     e = params["embeddings"]
     # roberta position ids: pad_token_id + 1 + running index of non-pad...
     # HF actually uses cumulative non-pad positions; fine-tuning on fixed

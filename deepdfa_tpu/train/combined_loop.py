@@ -13,8 +13,9 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
+from collections import OrderedDict
 from functools import partial
-from typing import Callable, Iterable
+from typing import Any, Callable, Iterable
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,28 @@ from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
 from deepdfa_tpu.train.state import TrainState, make_optimizer
 
 logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _StepEntry:
+    """One compiled-step cache slot for a (T, rows, num_graphs) batch
+    signature. `train`/`eval` are what dispatch calls — the raw jit
+    functions until `warmup` swaps in an ahead-of-time Compiled for the
+    train step (jit's own `.lower().compile()` does NOT seed its call
+    cache, so the AOT executable must be stored and invoked directly).
+    `stats` is the signature's persistent counter dict
+    (`CombinedTrainer.signature_stats`) — it survives LRU eviction, so a
+    signature that cycles out and back records every recompile."""
+
+    train: Callable
+    eval: Callable
+    train_jit: Any  # underlying jit fns: their _cache_size() is the
+    eval_jit: Any  # ground-truth lowering count for jit_lowerings()
+    stats: dict
+    aot: bool = False
+    # lazy path steady state: latched after a call that added no new
+    # jit-cache entry (sharding-change recompiles keep it False)
+    train_compiled: bool = False
 
 
 def _graph_batch_struct(num_graphs: int):
@@ -126,6 +149,9 @@ class CombinedTrainer:
                 f"{model_cfg.moe_experts} experts not divisible by "
                 f"ep={self.ep_size}"
             )
+        self.step_cache_entries = max(
+            1, int(getattr(cfg.train, "step_cache_entries", 8))
+        )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         if freeze_graph:
             # reference --freeze_graph: the pretrained GGNN stays fixed
@@ -325,20 +351,189 @@ class CombinedTrainer:
         return loss, (m.sum(), logits)
 
     def _build_steps(self) -> None:
-        self._step_cache: dict[int, tuple] = {}
+        # compiled steps keyed by (T, rows, num_graphs) batch signature —
+        # sequence bucketing (data/text.py) makes several legal per run —
+        # in a bounded LRU (cfg.train.step_cache_entries). Counters in
+        # signature_stats persist across evictions; _evicted_lowerings
+        # keeps jit_lowerings() monotonic when an entry is dropped.
+        self._step_cache: OrderedDict[tuple, _StepEntry] = OrderedDict()
+        self.signature_stats: dict[str, dict] = {}
+        self._evicted_lowerings = 0
 
         def train_step(state, batch: TextBatch, key):
-            return self._steps_for(batch.graphs.num_graphs)[0](state, batch, key)
+            entry = self._entry_for(self._signature(batch))
+            if entry.aot or entry.train_compiled:
+                out = entry.train(state, batch, key)
+            else:
+                # a lazy (un-warmed) entry lowers+compiles inside a
+                # call: book that latency as the signature's compile
+                # cost so the counters attribute it. Checked per call —
+                # not once — because the jit re-lowers when the input
+                # state's shardings change (the first call's output
+                # state typically carries different shardings than the
+                # init state, so call 2 compiles AGAIN); the flag only
+                # latches after a call that added no cache entry.
+                n0 = entry.train_jit._cache_size()
+                t0 = time.perf_counter()
+                out = entry.train(state, batch, key)
+                if entry.train_jit._cache_size() > n0:
+                    entry.stats["compiles"] += 1
+                    entry.stats["compile_seconds"] += (
+                        time.perf_counter() - t0
+                    )
+                else:
+                    entry.train_compiled = True
+            entry.stats["train_steps"] += 1
+            return out
 
         def eval_step(params, batch: TextBatch):
-            return self._steps_for(batch.graphs.num_graphs)[1](params, batch)
+            entry = self._entry_for(self._signature(batch))
+            entry.stats["eval_steps"] += 1
+            return entry.eval(params, batch)
 
         self.train_step = train_step
         self.eval_step = eval_step
 
-    def _steps_for(self, num_graphs: int):
-        if num_graphs in self._step_cache:
-            return self._step_cache[num_graphs]
+    @staticmethod
+    def _signature(batch: TextBatch) -> tuple[int, int, int]:
+        """(T, rows_per_shard, num_graphs): the static shapes that key one
+        compiled step (input_ids is [num_shards, rows, T]; num_graphs is
+        static GraphBatch metadata)."""
+        ids = batch.input_ids
+        return (
+            int(ids.shape[-1]),
+            int(ids.shape[-2]),
+            int(batch.graphs.num_graphs),
+        )
+
+    @staticmethod
+    def _sig_label(sig: tuple[int, int, int]) -> str:
+        return f"T{sig[0]}xR{sig[1]}xG{sig[2]}"
+
+    def _entry_for(self, sig: tuple[int, int, int]) -> _StepEntry:
+        entry = self._step_cache.get(sig)
+        if entry is not None:
+            self._step_cache.move_to_end(sig)
+            return entry
+        stats = self.signature_stats.setdefault(
+            self._sig_label(sig),
+            {
+                "compiles": 0,
+                "compile_seconds": 0.0,
+                "train_steps": 0,
+                "eval_steps": 0,
+            },
+        )
+        entry = self._make_entry(sig[2], stats)
+        self._step_cache[sig] = entry
+        while len(self._step_cache) > self.step_cache_entries:
+            _, old = self._step_cache.popitem(last=False)
+            self._evicted_lowerings += self._entry_lowerings(old)
+        return entry
+
+    @staticmethod
+    def _entry_lowerings(entry: _StepEntry) -> int:
+        # the AOT executable is lowered outside the jit call cache, so
+        # it counts separately from any direct-call cache entries
+        return (
+            entry.train_jit._cache_size()
+            + (1 if entry.aot else 0)
+            + entry.eval_jit._cache_size()
+        )
+
+    def jit_lowerings(self) -> int:
+        """Monotonic count of step lowerings this trainer triggered (AOT
+        warmup compiles + jit call-cache entries, evicted entries
+        included) — the guard for the zero-steady-state-recompiles
+        invariant (tests/test_combined_bucketing.py)."""
+        return self._evicted_lowerings + sum(
+            self._entry_lowerings(e) for e in self._step_cache.values()
+        )
+
+    def place_batch(self, batch: TextBatch) -> TextBatch:
+        """Sharded H2D copy with the exact specs the shard_map consumes
+        (sp-sharded input_ids included)."""
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            self._batch_specs(batch.graphs.num_graphs),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        return jax.device_put(batch, shardings)
+
+    def warmup(
+        self,
+        state: TrainState,
+        buckets=None,
+        token_budget: int | None = None,
+        node_budget: int | None = None,
+        edge_budget: int | None = None,
+    ) -> dict[str, float]:
+        """Ahead-of-time compile the train step for every configured
+        bucket signature, before step 1 ever runs.
+
+        Shapes follow the ONE batch-sizing formula the planner uses
+        (`data/text.py:rows_for_bucket`), so the compiled signatures are
+        exactly the batches `plan_bucketed_batches` emits. jit's
+        ``.lower().compile()`` does NOT seed its call cache, so the
+        Compiled executables are stored in the step cache and invoked
+        directly — steady-state training then triggers zero new
+        lowerings. Returns {signature label: compile seconds}.
+
+        Defaults come from cfg.data (`seq_buckets`, `token_budget`,
+        `batch.node_budget`/`edge_budget`); pass explicit values when
+        batches are collated with different budgets, or the compiled
+        graph-leaf shapes will not match the real stream.
+        """
+        from deepdfa_tpu.data.text import collate_shards, rows_for_bucket
+
+        dcfg = self.cfg.data
+        buckets = tuple(
+            buckets if buckets is not None else getattr(dcfg, "seq_buckets", ())
+        )
+        if not buckets:
+            return {}
+        token_budget = int(
+            token_budget if token_budget is not None else dcfg.token_budget
+        )
+        node_budget = int(
+            node_budget if node_budget is not None else dcfg.batch.node_budget
+        )
+        edge_budget = int(
+            edge_budget if edge_budget is not None else dcfg.batch.edge_budget
+        )
+        if len(buckets) > self.step_cache_entries:
+            raise ValueError(
+                f"{len(buckets)} seq_buckets > train.step_cache_entries="
+                f"{self.step_cache_entries}: warmup'd signatures would "
+                f"evict each other — raise the cache bound"
+            )
+        dp = self.mesh.shape.get("dp", 1)
+        pad_id = int(getattr(self.model_cfg.encoder, "pad_token_id", 0))
+        key = jax.random.key(0)
+        report: dict[str, float] = {}
+        for T in buckets:
+            rows = rows_for_bucket(T, token_budget, dp)
+            dummy = collate_shards(
+                np.zeros((0, int(T)), np.int32), [], [], {},
+                num_shards=dp, rows_per_shard=rows,
+                node_budget=node_budget, edge_budget=edge_budget,
+                pad_id=pad_id,
+            )
+            batch = self.place_batch(dummy)
+            sig = self._signature(batch)
+            entry = self._entry_for(sig)
+            if entry.aot:
+                continue  # idempotent: re-warmup never recompiles
+            t0 = time.perf_counter()
+            entry.train = entry.train_jit.lower(state, batch, key).compile()
+            dt = time.perf_counter() - t0
+            entry.aot = True
+            entry.stats["compiles"] += 1
+            entry.stats["compile_seconds"] += dt
+            report[self._sig_label(sig)] = round(dt, 3)
+        return report
+
+    def _make_entry(self, num_graphs: int, sig_stats: dict) -> _StepEntry:
         mesh = self.mesh
         grad_axes = self._grad_axes
         pp = self.pp
@@ -442,8 +637,11 @@ class CombinedTrainer:
         def eval_step(params, batch: TextBatch):
             return _sharded_eval(params, batch)
 
-        self._step_cache[num_graphs] = (train_step, eval_step)
-        return self._step_cache[num_graphs]
+        return _StepEntry(
+            train=train_step, eval=eval_step,
+            train_jit=train_step, eval_jit=eval_step,
+            stats=sig_stats,
+        )
 
     def evaluate(self, state_or_params, batches: Iterable[TextBatch]):
         params = getattr(state_or_params, "params", state_or_params)
@@ -473,25 +671,43 @@ class CombinedTrainer:
     ) -> TrainState:
         from deepdfa_tpu.data.prefetch import PipelineStats, prefetch
 
+        from deepdfa_tpu.data.text import batch_token_counts
+
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         root = jax.random.key(seed)
         step = int(jax.device_get(state.step))
+        pad_id = int(getattr(self.model_cfg.encoder, "pad_token_id", 0))
 
-        def place(batch: TextBatch) -> TextBatch:
-            # sharded H2D copy in the producer thread, with the exact
-            # specs the shard_map consumes (sp-sharded input_ids included)
-            shardings = jax.tree.map(
-                lambda s: NamedSharding(self.mesh, s),
-                self._batch_specs(batch.graphs.num_graphs),
-                is_leaf=lambda x: isinstance(x, P),
-            )
-            return jax.device_put(batch, shardings)
+        # bucketed runs compile every configured signature BEFORE step 1
+        # (and outside any epoch's timing window); non-bucketed runs
+        # keep the lazy compile-on-first-batch behaviour
+        if getattr(self.cfg.data, "seq_buckets", ()):
+            warm = self.warmup(state)
+            if warm:
+                logger.info("warmup compiled %d bucket signatures: %s",
+                            len(warm), warm)
+                if log_fn is not None:
+                    log_fn({
+                        "warmup_signatures": len(warm),
+                        "warmup_compile_seconds": round(sum(warm.values()), 3),
+                    })
 
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
             losses = []
             stats = PipelineStats()
+
+            def place(batch: TextBatch) -> TextBatch:
+                # token accounting happens host-side, before the sharded
+                # H2D copy in the producer thread (place_batch uses the
+                # exact specs the shard_map consumes)
+                stats.add_tokens(
+                    *batch_token_counts(batch.input_ids, batch.row_mask,
+                                        pad_id)
+                )
+                return self.place_batch(batch)
+
             for i, batch in enumerate(
                 prefetch(
                     train_batches(epoch), tcfg.prefetch_batches, place,
@@ -517,6 +733,28 @@ class CombinedTrainer:
                     stats.wait_fraction(epoch_seconds), 4
                 ),
             }
+            if stats.padded_tokens:
+                # sequence-bucketing observables (docs/input_pipeline.md):
+                # REAL-token throughput is shape-invariant, so it compares
+                # across bucket layouts where examples/sec cannot
+                record.update(
+                    train_examples_per_sec=round(
+                        stats.rows / epoch_seconds, 2
+                    ) if epoch_seconds else None,
+                    train_tokens_per_sec=round(
+                        stats.real_tokens / epoch_seconds, 1
+                    ) if epoch_seconds else None,
+                    real_tokens=stats.real_tokens,
+                    padded_tokens=stats.padded_tokens,
+                    padding_waste=round(stats.padding_waste(), 4),
+                )
+            # cumulative per-signature compile/step attribution for the
+            # bounded step cache; RunLogger flattens the nested dict into
+            # `step_signatures/<sig>/<counter>` TensorBoard scalars
+            record["step_signatures"] = {
+                k: dict(v) for k, v in self.signature_stats.items()
+            }
+            record["jit_lowerings"] = self.jit_lowerings()
             if val_batches is not None:
                 val_metrics, _ = self.evaluate(state, val_batches())
                 record.update({f"val_{k}": v for k, v in val_metrics.items()})

@@ -14,6 +14,22 @@ import json
 from pathlib import Path
 
 
+def flatten_scalars(record: dict, prefix: str = "") -> dict[str, float]:
+    """Flatten nested dict records into slash-keyed scalar pairs.
+
+    The combined trainer emits per-signature compile/step counters as a
+    nested mapping (``step_signatures -> T64xR32xG32 -> compiles``);
+    jsonl keeps the structure, TensorBoard needs flat scalar tags — this
+    is the ONE place that mapping is defined."""
+    out: dict[str, float] = {}
+    for k, v in record.items():
+        if isinstance(v, dict):
+            out.update(flatten_scalars(v, f"{prefix}{k}/"))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[f"{prefix}{k}"] = float(v)
+    return out
+
+
 class RunLogger:
     def __init__(self, run_dir: str | Path, tensorboard: bool = True):
         self.run_dir = Path(run_dir)
@@ -37,9 +53,9 @@ class RunLogger:
             f.write(json.dumps(record) + "\n")
         if self._tb is not None:
             step = int(record.get("step", record.get("epoch", 0)))
-            for k, v in record.items():
-                if isinstance(v, (int, float)) and k not in ("step", "epoch"):
-                    self._tb.add_scalar(k, float(v), global_step=step)
+            for k, v in flatten_scalars(record).items():
+                if k not in ("step", "epoch"):
+                    self._tb.add_scalar(k, v, global_step=step)
 
     def close(self) -> None:
         if self._tb is not None:

@@ -131,6 +131,14 @@ def _measure(args, enc, label: str, rows: int | None = None) -> dict:
         rates.append(n * steps_per_window / (time.perf_counter() - t0))
     value = float(np.median(rates))
 
+    # real-token observables (ISSUE 2): tokens/sec counts only non-pad
+    # tokens in valid rows, so it stays comparable across pad targets;
+    # padding_waste is the fraction of computed token slots holding pad
+    from deepdfa_tpu.data.text import batch_token_counts
+
+    real, padded, _ = batch_token_counts(
+        batch.input_ids, batch.row_mask, enc.pad_token_id
+    )
     result = {
         "attn_impl": label,
         "remat": enc.remat,
@@ -139,6 +147,8 @@ def _measure(args, enc, label: str, rows: int | None = None) -> dict:
         "value": round(value, 2),
         "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC, 2),
         "best_examples_per_sec": round(max(rates), 2),
+        "tokens_per_sec": round(value * real / n, 1),
+        "padding_waste": round(1.0 - real / padded, 4) if padded else None,
         "compile_seconds": round(compile_s, 1),
         "n_params": int(
             sum(np.prod(x.shape) for x in jax.tree.leaves(state.params))

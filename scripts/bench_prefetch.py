@@ -1,7 +1,9 @@
 #!/usr/bin/env python
-"""Host input-pipeline benchmark: prefetch overlap + packed-batch cache.
+"""Host input-pipeline benchmark: prefetch overlap + packed-batch cache +
+sequence-length bucketing.
 
-Two measurements over the same flagship GraphSpec corpus (ISSUE 1):
+Two measurements over the same flagship GraphSpec corpus (ISSUE 1), plus
+one over a combined-model text workload (ISSUE 2):
 
 1. prefetch_overlap_speedup — GraphTrainer.fit wall-clock with
    train.prefetch_batches=0 (inline assembly) vs the default 2
@@ -17,6 +19,16 @@ Two measurements over the same flagship GraphSpec corpus (ISSUE 1):
    Device compute is held small so the HOST pipeline — the thing this
    script regression-tests — dominates the way it does on TPU, where a
    step is ~ms and the host is the bound (BENCH_r05: 0.67% MFU).
+
+3. combined_train_tokens_per_sec — the combined (transformer+graph)
+   text path with pad-to-max_length collation vs sequence-length
+   bucketing (data/text.py: pad-to-bucket + token-budget batch sizing +
+   the trainer's warmup'd signature cache). Reports REAL-token
+   throughput and padding-waste fraction alongside examples/sec — the
+   shape-invariant numbers that make the bucketing win measurable on the
+   CPU fallback too — and regression-checks bucket assignment (real
+   tokens conserved vs the fixed path), packed-cache replay
+   (bit-identical), and zero steady-state recompiles after warmup.
 
 On the 1-core CPU build box compute and assembly contend for the same
 core, so the overlap win is a LOWER bound; on TPU the device computes
@@ -178,9 +190,230 @@ def bench_cache(
     }
 
 
+def build_text_workload(n: int, seq: int, vocab: int = 512):
+    """Synthetic combined-model text workload (also used by bench.py's
+    --child-combined): corpus -> tokenized rows + aligned graphs.
+    Returns (token_ids_by_id, labels_by_id, graphs_by_id, lengths, tok).
+    """
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.data.text import token_lengths
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+
+    synth = generate(n, vuln_rate=0.3, seed=7)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(n), limit_all=100,
+        limit_subkeys=100,
+    )
+    tok = HashTokenizer(vocab_size=vocab)
+    mat = tok.batch_encode([s.before for s in synth], max_length=seq)
+    token_ids = {i: mat[i] for i in range(n)}
+    labels = {i: int(s.label) for i, s in enumerate(synth)}
+    by_id = {s.graph_id: s for s in specs}
+    return token_ids, labels, by_id, token_lengths(mat, tok.pad_id), tok
+
+
+def bench_bucketed(n_examples: int, epochs: int, smoke: bool = False) -> dict:
+    """Fixed pad-to-max_length vs bucketed token-budget collation on the
+    combined tiny model: examples/sec, REAL tokens/sec, padding waste."""
+    import numpy as np
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.data.packed_cache import (
+        PackedBatchCache,
+        cache_key,
+        text_corpus_digest,
+    )
+    from deepdfa_tpu.data.text import (
+        TEXT_ARRAY_FIELDS,
+        batch_token_counts,
+        bucketed_collate_batches,
+        collate_shards,
+    )
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+    # the LineVul recipe's 512-token frame: synthetic function lengths
+    # are lognormal-ish with median far below 512, which is exactly the
+    # distribution the fixed pad-to-max collation wastes FLOPs on
+    seq = 512
+    bucket_edges = (128, 256, 512)
+    # smoke bounds the corpus for tier-1; the full mode honors the
+    # caller's size exactly (no silent cap — the record's n_examples is
+    # what actually ran)
+    n = min(n_examples, 64) if smoke else int(n_examples)
+    epochs = max(1, epochs)
+    rows = 16  # the legacy fixed recipe's batch rows
+    token_budget = rows * seq  # same activation footprint per batch
+    node_budget, edge_budget = 2048, 8192
+    token_ids, labels, by_id, lengths, tok = build_text_workload(n, seq)
+
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(
+            vocab_size=tok.vocab_size, dropout_rate=0.0,
+            max_position_embeddings=seq + 4,
+        ),
+        graph_hidden_dim=8,
+        graph_input_dim=102,
+    )
+    cfg = config_mod.apply_overrides(
+        Config(), [f"train.max_epochs={epochs}"]
+    )
+    ids = list(range(n))
+    fixed = []
+    for k in range(0, n, rows):
+        sel = ids[k : k + rows]
+        fixed.append(
+            collate_shards(
+                np.stack([token_ids[i] for i in sel]),
+                [labels[i] for i in sel], sel, by_id,
+                num_shards=1, rows_per_shard=rows,
+                node_budget=node_budget, edge_budget=edge_budget,
+                pad_id=tok.pad_id,
+            )
+        )
+    bucketed = list(
+        bucketed_collate_batches(
+            token_ids, labels, ids, by_id, bucket_edges, token_budget,
+            1, node_budget, edge_budget, pad_id=tok.pad_id,
+            lengths=lengths,
+        )
+    )
+    # bucket-assignment regression check: the exact real-token mass must
+    # be conserved across layouts (the property test pins the multiset;
+    # this pins it end-to-end in the bench workload)
+    real_of = lambda bs: sum(  # noqa: E731
+        batch_token_counts(b.input_ids, b.row_mask, tok.pad_id)[0]
+        for b in bs
+    )
+    if real_of(bucketed) != real_of(fixed):
+        raise AssertionError(
+            f"bucketed collation lost tokens: {real_of(bucketed)} != "
+            f"{real_of(fixed)}"
+        )
+
+    def run(batches, warmup_buckets=None):
+        import jax
+
+        from deepdfa_tpu.parallel import make_mesh
+
+        # batches are collated num_shards=1, so the trainer must run a
+        # 1-device mesh — the default dp=-1 spans every chip and the
+        # device_put dp-divisibility check would (rightly) refuse
+        trainer = CombinedTrainer(
+            cfg, mcfg, mesh=make_mesh(devices=jax.devices()[:1]),
+            total_steps=len(batches) * epochs,
+        )
+        state = trainer.init_state(seed=0)
+        warm_s = 0.0
+        if warmup_buckets is not None:
+            t0 = time.perf_counter()
+            trainer.warmup(
+                state, warmup_buckets, token_budget, node_budget,
+                edge_budget,
+            )
+            warm_s = time.perf_counter() - t0
+        else:
+            # TWO warm steps: the first compiles against init_state's
+            # shardings, the second against the (different) jit-output
+            # state shardings the whole steady-state loop runs on —
+            # one warm step would leave a recompile inside the timed
+            # window. (The AOT warmup path is immune: the Compiled
+            # executable's output state feeds back compatibly.)
+            for _ in range(2):
+                state, warm_loss = trainer.train_step(
+                    state, trainer.place_batch(batches[0]), jax.random.key(0)
+                )
+                float(warm_loss)
+        records = []
+        state = trainer.fit(
+            state, lambda e: batches,
+            log_fn=lambda r: records.append(r) if "epoch" in r else None,
+        )
+        jax.block_until_ready(state.params)
+        secs = sum(r["epoch_seconds"] for r in records)
+        return {
+            "seconds": secs,
+            "examples_per_sec": round(epochs * n / secs, 2),
+            "tokens_per_sec": round(
+                sum(r["real_tokens"] for r in records) / secs, 1
+            ),
+            "padding_waste": records[-1]["padding_waste"],
+            "warmup_compile_seconds": round(warm_s, 2),
+            "lowerings": trainer.jit_lowerings(),
+        }
+
+    fixed_r = run(fixed)
+    bucket_r = run(bucketed, warmup_buckets=bucket_edges)
+
+    # replay regression: the bucketed stream must round-trip the
+    # content-keyed cache bit-identically (bucket layout is in the key)
+    with tempfile.TemporaryDirectory() as d:
+        cache = PackedBatchCache(d)
+        key = cache_key(
+            dict(kind="text", seq_buckets=list(bucket_edges),
+                 token_budget=token_budget, num_shards=1,
+                 node_budget=node_budget, edge_budget=edge_budget,
+                 pad_id=tok.pad_id),
+            text_corpus_digest(token_ids, labels),
+        )
+        list(cache.write_through(key, iter(bucketed)))
+        from deepdfa_tpu.graphs.batch import ARRAY_FIELDS
+
+        def leaves(b):
+            out = [np.asarray(getattr(b, f)) for f in TEXT_ARRAY_FIELDS]
+            out += [
+                np.asarray(v) for f in ARRAY_FIELDS
+                if (v := getattr(b.graphs, f)) is not None
+            ]
+            return out
+
+        replayed = list(cache.replay(key))
+        replay_ok = len(replayed) == len(bucketed) and all(
+            len(la) == len(lb) and all(map(np.array_equal, la, lb))
+            for a, b in zip(replayed, bucketed)
+            for la, lb in ((leaves(a), leaves(b)),)
+        )
+        if not replay_ok:
+            raise AssertionError("bucketed cache replay diverged")
+
+    return {
+        "metric": "combined_train_tokens_per_sec",
+        "value": bucket_r["tokens_per_sec"],
+        "unit": "real tokens/s (combined tiny model, fit epochs)",
+        "seq": seq,
+        "buckets": list(bucket_edges),
+        "token_budget": token_budget,
+        "n_examples": n,
+        "epochs": epochs,
+        "n_batches_fixed": len(fixed),
+        "n_batches_bucketed": len(bucketed),
+        "examples_per_sec_fixed": fixed_r["examples_per_sec"],
+        "examples_per_sec_bucketed": bucket_r["examples_per_sec"],
+        "tokens_per_sec_fixed": fixed_r["tokens_per_sec"],
+        "tokens_per_sec_bucketed": bucket_r["tokens_per_sec"],
+        "padding_waste_fixed": fixed_r["padding_waste"],
+        "padding_waste_bucketed": bucket_r["padding_waste"],
+        "bucketed_examples_speedup": round(
+            bucket_r["examples_per_sec"] / fixed_r["examples_per_sec"], 3
+        ) if fixed_r["examples_per_sec"] else None,
+        "warmup_compile_seconds": bucket_r["warmup_compile_seconds"],
+        # len(buckets) warmup lowerings and not one more: the epoch loop
+        # hit only warm signatures
+        "steady_state_recompiles": bucket_r["lowerings"] - len(bucket_edges),
+        "cache_replay_identical": replay_ok,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n-examples", type=int, default=1000)
+    ap.add_argument(
+        "--bucketed-examples", type=int, default=256,
+        help="corpus size for the bucketed (combined-model) measurement "
+        "— it trains a model per layout, so it runs a smaller corpus "
+        "than the pack/cache measurements by default",
+    )
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument(
         "--smoke",
@@ -215,11 +448,20 @@ def main() -> None:
 
     overlap = bench_overlap(specs, args.epochs, model_overrides)
     cache = bench_cache(specs, frontend_seconds, args.epochs, model_overrides)
+    bucketed = bench_bucketed(
+        args.bucketed_examples, args.epochs, smoke=args.smoke
+    )
 
     record = {
         **overlap,
         "cache": cache,
         "cache_replay_speedup": cache["value"],
+        "bucketed": bucketed,
+        "combined_train_tokens_per_sec": bucketed["value"],
+        "combined_train_examples_per_sec": bucketed[
+            "examples_per_sec_bucketed"
+        ],
+        "padding_waste": bucketed["padding_waste_bucketed"],
         "platform": jax.devices()[0].platform,
         "n_examples": args.n_examples,
         "epochs": args.epochs,

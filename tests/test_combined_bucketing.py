@@ -1,0 +1,191 @@
+"""CombinedTrainer signature-keyed step cache (ISSUE 2): bounded LRU,
+ahead-of-time warmup over the configured bucket signatures, and the
+zero-steady-state-recompiles invariant guarded by a jit-lowering
+counter."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data.text import (
+    bucketed_collate_batches,
+    collate_shards,
+    rows_for_bucket,
+    token_lengths,
+)
+from deepdfa_tpu.models import combined as cmb
+from deepdfa_tpu.models.transformer import TransformerConfig
+from deepdfa_tpu.parallel import make_mesh
+from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+from tests.test_text_bucketing import make_rows, make_spec
+
+# trainer compiles are heavy on CPU: excluded from the default fast lane
+# (as tests/test_combined.py); run via `pytest -m slow` or `pytest -m ""`
+pytestmark = pytest.mark.slow
+
+PAD = 1
+NODE_BUDGET, EDGE_BUDGET = 256, 1024
+
+
+def _model_cfg():
+    return cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(
+            dropout_rate=0.0, max_position_embeddings=72
+        ),
+        graph_hidden_dim=8,
+        graph_input_dim=6,
+    )
+
+
+def _trainer(overrides=(), dp=8, **cfg_kw):
+    cfg = config_mod.apply_overrides(Config(), list(overrides))
+    if cfg_kw:
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, **cfg_kw)
+        )
+    cfg = dataclasses.replace(
+        cfg,
+        data=dataclasses.replace(
+            cfg.data,
+            batch=dataclasses.replace(
+                cfg.data.batch,
+                node_budget=NODE_BUDGET,
+                edge_budget=EDGE_BUDGET,
+            ),
+        ),
+    )
+    mesh = make_mesh(MeshConfig(dp=dp))
+    trainer = CombinedTrainer(cfg, _model_cfg(), mesh=mesh, total_steps=8)
+    return trainer, trainer.init_state(seed=0)
+
+
+def _corpus(rng, n=48, max_t=64):
+    rows, lengths = make_rows(rng, n, max_t, PAD)
+    token_ids = {i: rows[i] for i in range(n)}
+    labels = {i: int(i % 2) for i in range(n)}
+    graphs = {i: make_spec(rng, i) for i in range(n) if i % 3}
+    return token_ids, labels, graphs, lengths
+
+
+def test_warmup_compiles_exactly_bucket_signatures(rng):
+    buckets, budget = (16, 32), 256
+    trainer, state = _trainer()
+    report = trainer.warmup(
+        state, buckets, budget, NODE_BUDGET, EDGE_BUDGET
+    )
+    assert len(report) == len(buckets)
+    assert trainer.jit_lowerings() == len(buckets)
+    assert len(trainer._step_cache) == len(buckets)
+    for T in buckets:
+        rows = rows_for_bucket(T, budget, 8)
+        sig = f"T{T}xR{rows}xG{rows}"
+        assert trainer.signature_stats[sig]["compiles"] == 1
+        assert trainer.signature_stats[sig]["compile_seconds"] > 0
+    # idempotent: a second warmup never recompiles
+    assert trainer.warmup(
+        state, buckets, budget, NODE_BUDGET, EDGE_BUDGET
+    ) == {}
+    assert trainer.jit_lowerings() == len(buckets)
+
+
+def test_warmup_rejects_overflowing_bucket_set(rng):
+    trainer, state = _trainer(["train.step_cache_entries=2"])
+    with pytest.raises(ValueError, match="step_cache_entries"):
+        trainer.warmup(state, (8, 16, 32), 256, NODE_BUDGET, EDGE_BUDGET)
+
+
+def test_zero_steady_state_recompiles_full_epoch(rng):
+    """Acceptance (ISSUE 2): with data.seq_buckets configured, fit()
+    warmups before step 1 and one full epoch over the synthetic corpus
+    triggers ZERO new jit lowerings."""
+    buckets, budget = (16, 32, 64), 512
+    trainer, state = _trainer(
+        ["train.max_epochs=1"], seq_buckets=buckets, token_budget=budget
+    )
+    token_ids, labels, graphs, lengths = _corpus(rng)
+    batches = list(
+        bucketed_collate_batches(
+            token_ids, labels, list(range(len(token_ids))), graphs,
+            buckets, budget, 8, NODE_BUDGET, EDGE_BUDGET, pad_id=PAD,
+            lengths=lengths,
+        )
+    )
+    assert len({b.input_ids.shape for b in batches}) > 1, (
+        "corpus must exercise several signatures"
+    )
+    records = []
+    state = trainer.fit(
+        state, lambda epoch: batches,
+        log_fn=lambda r: records.append(r) if "epoch" in r else None,
+    )
+    assert trainer.jit_lowerings() == len(buckets)
+    assert sum(
+        s["compiles"] for s in trainer.signature_stats.values()
+    ) == len(buckets)
+    # epoch record surfaces the bucketing observables
+    rec = records[-1]
+    assert rec["jit_lowerings"] == len(buckets)
+    assert rec["real_tokens"] == int(np.asarray(lengths).sum())
+    assert 0.0 <= rec["padding_waste"] < 1.0
+    assert rec["train_tokens_per_sec"] > 0
+    assert set(rec["step_signatures"]) == set(trainer.signature_stats)
+
+
+def test_step_cache_lru_eviction_and_recompile_counting(rng):
+    trainer, state = _trainer(["train.step_cache_entries=2"])
+    token_ids, labels, graphs, lengths = _corpus(rng, n=24, max_t=32)
+
+    import jax
+
+    def batch_at(T, rows):
+        ids = list(range(rows * 8))
+        mat = np.stack([token_ids[i][:T] for i in ids])
+        return collate_shards(
+            mat, [labels[i] for i in ids], ids, graphs, num_shards=8,
+            rows_per_shard=rows, node_budget=NODE_BUDGET,
+            edge_budget=EDGE_BUDGET, pad_id=PAD,
+        )
+
+    key = jax.random.key(0)
+    sigs = [(8, 1), (16, 1), (32, 1)]
+    for T, rows in sigs:
+        state, _ = trainer.train_step(
+            state, trainer.place_batch(batch_at(T, rows)), key
+        )
+    # bound of 2: the (8, 1, ...) entry — least recently used — evicted
+    assert len(trainer._step_cache) == 2
+    assert (8, 1, 1) not in trainer._step_cache
+    assert (32, 1, 1) in trainer._step_cache
+    lowerings = trainer.jit_lowerings()
+    assert trainer.signature_stats["T8xR1xG1"]["compiles"] == 1
+
+    # touching the evicted signature recompiles it (counted), and the
+    # monotonic lowering counter keeps the evicted entry's history
+    state, _ = trainer.train_step(
+        state, trainer.place_batch(batch_at(8, 1)), key
+    )
+    assert trainer.signature_stats["T8xR1xG1"]["compiles"] == 2
+    assert trainer.jit_lowerings() > lowerings
+    assert len(trainer._step_cache) == 2
+    # hit counters accumulate across the eviction
+    assert trainer.signature_stats["T8xR1xG1"]["train_steps"] == 2
+
+
+def test_evaluate_over_bucketed_batches(rng):
+    buckets, budget = (16, 32), 256
+    trainer, state = _trainer(seq_buckets=buckets, token_budget=budget)
+    token_ids, labels, graphs, lengths = _corpus(rng, n=24, max_t=32)
+    batches = list(
+        bucketed_collate_batches(
+            token_ids, labels, list(range(24)), graphs, buckets, budget,
+            8, NODE_BUDGET, EDGE_BUDGET, pad_id=PAD, lengths=lengths,
+        )
+    )
+    metrics, _ = trainer.evaluate(state, batches)
+    assert np.isfinite(metrics["loss"])
+    assert sum(
+        s["eval_steps"] for s in trainer.signature_stats.values()
+    ) == len(batches)

@@ -1,0 +1,445 @@
+"""Sequence-length bucketing for the combined/text path (ISSUE 2):
+pad-to-bucket planning + token-budget batch sizing must preserve the
+exact example multiset of the fixed pad-to-max collation, the shared
+pad-id table must keep collaters and encoders in agreement, and a
+misconfigured bucket edge must fail loudly at the encoder."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
+from deepdfa_tpu.data.text import (
+    TextBatchPlan,
+    batch_token_counts,
+    bucketed_collate_batches,
+    collate,
+    collate_plan,
+    collate_shards,
+    plan_bucketed_batches,
+    rows_for_bucket,
+    token_lengths,
+)
+from deepdfa_tpu.data.tokenizer import HashTokenizer
+from deepdfa_tpu.graphs.batch import GraphSpec
+
+
+def make_spec(rng, gid: int, n_nodes: int = 4, label: float = 0.0):
+    n_edges = max(1, n_nodes - 1)
+    return GraphSpec(
+        graph_id=gid,
+        node_feats=rng.integers(0, 5, (n_nodes, 4)).astype(np.int32),
+        node_vuln=np.zeros((n_nodes,), np.int32),
+        edge_src=rng.integers(0, n_nodes, (n_edges,)).astype(np.int32),
+        edge_dst=rng.integers(0, n_nodes, (n_edges,)).astype(np.int32),
+        label=label,
+    )
+
+
+def make_rows(rng, n: int, max_t: int, pad_id: int):
+    """Right-padded token rows with lognormal-ish real lengths >= 1."""
+    lengths = np.clip(
+        rng.lognormal(2.5, 1.0, n).astype(np.int64) + 1, 1, max_t
+    )
+    rows = np.full((n, max_t), pad_id, np.int32)
+    for i, ln in enumerate(lengths):
+        # real tokens are never pad_id, so token_lengths can recover ln
+        vals = rng.integers(4, 500, ln).astype(np.int32)
+        rows[i, :ln] = np.where(vals == pad_id, pad_id + 3, vals)
+    return rows, lengths
+
+
+# ---------------------------------------------------------------------------
+# primitives
+
+
+def test_token_lengths_roundtrip(rng):
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    rows, lengths = make_rows(rng, 40, 64, pad)
+    np.testing.assert_array_equal(token_lengths(rows, pad), lengths)
+
+
+def test_token_lengths_all_pad_row():
+    pad = 1
+    rows = np.full((3, 8), pad, np.int32)
+    rows[1, :2] = [5, 6]
+    np.testing.assert_array_equal(token_lengths(rows, pad), [0, 2, 0])
+
+
+def test_rows_for_bucket_formula():
+    # rows x T <= budget, split over shards, floor at 1
+    assert rows_for_bucket(64, 8192, 1) == 128
+    assert rows_for_bucket(512, 8192, 1) == 16
+    assert rows_for_bucket(512, 8192, 4) == 4
+    assert rows_for_bucket(512, 100, 8) == 1  # degrade, never zero
+
+
+def test_batch_token_counts(rng):
+    pad = 1
+    rows, lengths = make_rows(rng, 8, 32, pad)
+    mask = np.zeros((8,), bool)
+    mask[:5] = True
+    real, padded, n = batch_token_counts(rows, mask, pad)
+    assert real == int(lengths[:5].sum())
+    assert padded == rows.size
+    assert n == 5
+
+
+# ---------------------------------------------------------------------------
+# planner
+
+
+def test_planner_rejects_bad_buckets():
+    with pytest.raises(ValueError, match="ascending"):
+        list(plan_bucketed_batches([4], [0], (64, 32), 128, 1, 8, 8))
+    with pytest.raises(ValueError, match="ascending"):
+        list(plan_bucketed_batches([4], [0], (32, 32), 128, 1, 8, 8))
+    with pytest.raises(ValueError):
+        list(plan_bucketed_batches([4], [0], (), 128, 1, 8, 8))
+
+
+def test_planner_rejects_overlong_row():
+    with pytest.raises(ValueError, match="exceeds the"):
+        list(plan_bucketed_batches([65], [7], (32, 64), 128, 1, 8, 8))
+
+
+def test_planner_signature_and_capacity(rng):
+    pad = 1
+    _, lengths = make_rows(rng, 64, 64, pad)
+    buckets, budget, shards = (16, 32, 64), 128, 2
+    plans = list(
+        plan_bucketed_batches(
+            lengths, list(range(64)), buckets, budget, shards, 8, 8
+        )
+    )
+    assert plans, "planner emitted nothing"
+    seen = set()
+    for p in plans:
+        assert p.seq_len in buckets
+        # the ONE formula: rows per shard from the token budget
+        assert p.rows_per_shard == rows_for_bucket(p.seq_len, budget, shards)
+        assert len(p.example_ids) <= p.rows_per_shard * shards
+        # every row's real length fits its bucket edge
+        for eid in p.example_ids:
+            assert lengths[eid] <= p.seq_len
+            assert eid not in seen
+            seen.add(eid)
+    assert seen == set(range(64))  # exact partition, nothing dropped
+
+
+def test_planner_deterministic_and_stats(rng):
+    pad = 1
+    _, lengths = make_rows(rng, 50, 64, pad)
+    args = (lengths, list(range(50)), (16, 64), 256, 1, 8, 8)
+    s1: dict = {}
+    s2: dict = {}
+    p1 = list(plan_bucketed_batches(*args, stats=s1))
+    p2 = list(plan_bucketed_batches(*args, stats=s2))
+    assert p1 == p2  # cache-replayable: deterministic in input order
+    assert s1 == s2
+    assert s1["rows"] == 50
+    assert s1["batches"] == len(p1)
+    assert s1["real_tokens"] == int(np.asarray(lengths).sum())
+    # padded counts the FULL static shape (capacity x edge) per batch
+    assert s1["padded_tokens"] == sum(
+        rows_for_bucket(p.seq_len, 256, 1) * p.seq_len for p in p1
+    )
+    assert sum(s1["by_bucket"].values()) == 50
+
+
+# ---------------------------------------------------------------------------
+# bucketed collation vs fixed collation
+
+
+def _multiset(batch, pad_id):
+    """{(example_id-slot, label, unpadded-token-tuple)} for valid rows."""
+    out = []
+    ids = np.asarray(batch.input_ids).reshape(-1, batch.input_ids.shape[-1])
+    labels = np.asarray(batch.labels).reshape(-1)
+    mask = np.asarray(batch.row_mask).reshape(-1)
+    for i in range(len(mask)):
+        if not mask[i]:
+            continue
+        row = ids[i]
+        ln = int(token_lengths(row[None], pad_id)[0])
+        out.append((int(labels[i]), tuple(int(x) for x in row[:ln])))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("num_shards", [1, 2])
+def test_bucketed_collation_preserves_multiset(rng, num_shards):
+    """Property (ISSUE 2): bucketed collation preserves the exact
+    multiset of (label, unpadded token_ids) vs unbucketed collation, and
+    has_graph matches graph availability when budgets are ample."""
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    n, max_t = 60, 64
+    rows, lengths = make_rows(rng, n, max_t, pad)
+    token_ids = {i: rows[i] for i in range(n)}
+    labels = {i: int(i % 2) for i in range(n)}
+    # every third example has no extracted graph (has_graph degrade path)
+    graphs = {i: make_spec(rng, i) for i in range(n) if i % 3}
+
+    fixed = collate_shards(
+        rows, [labels[i] for i in range(n)], list(range(n)), graphs,
+        num_shards=num_shards, rows_per_shard=-(-n // num_shards),
+        node_budget=4096, edge_budget=16384, pad_id=pad,
+    )
+    stats: dict = {}
+    bucketed = list(
+        bucketed_collate_batches(
+            token_ids, labels, list(range(n)), graphs,
+            (16, 32, 64), 256, num_shards, 4096, 16384, pad_id=pad,
+            lengths=lengths, stats=stats,
+        )
+    )
+    got = sorted(sum((_multiset(b, pad) for b in bucketed), []))
+    want = _multiset(fixed, pad)
+    assert got == want
+
+    # has_graph tracks availability exactly (ample budgets: no degrade):
+    # the count of graph-carrying valid rows matches availability, and
+    # every carried slot holds an available graph's id
+    hg_count = 0
+    for b in bucketed:
+        ids = np.asarray(b.graphs.graph_ids).reshape(-1)
+        hg = np.asarray(b.has_graph).reshape(-1)
+        mask = np.asarray(b.row_mask).reshape(-1)
+        for i in range(len(mask)):
+            if mask[i] and hg[i]:
+                assert int(ids[i]) in graphs
+                hg_count += 1
+    assert hg_count == len(graphs)
+    assert hg_count == int(
+        np.asarray(fixed.has_graph).sum()
+    )  # degrade behaviour identical to the fixed path
+    total_real = sum(
+        batch_token_counts(b.input_ids, b.row_mask, pad)[0] for b in bucketed
+    )
+    assert total_real == int(np.asarray(lengths).sum())
+    assert stats["real_tokens"] == total_real
+
+
+def test_has_graph_availability_matches_fixed_path(rng):
+    """Row-degrade semantics are collate()'s own, unchanged: with ample
+    budgets has_graph == availability; with a tight budget the degrade
+    still happens per-batch (never a crash)."""
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    n = 24
+    rows, lengths = make_rows(rng, n, 32, pad)
+    token_ids = {i: rows[i] for i in range(n)}
+    labels = {i: 0 for i in range(n)}
+    graphs = {i: make_spec(rng, i, n_nodes=6) for i in range(n) if i % 2}
+
+    for b in bucketed_collate_batches(
+        token_ids, labels, list(range(n)), graphs, (32,), 128, 1,
+        4096, 16384, pad_id=pad, lengths=lengths,
+    ):
+        hg = np.asarray(b.has_graph).reshape(-1)
+        ids = np.asarray(b.graphs.graph_ids).reshape(-1)
+        mask = np.asarray(b.row_mask).reshape(-1)
+        for r in range(len(mask)):
+            if mask[r] and hg[r]:
+                assert int(ids[r]) in graphs
+
+    # tight node budget: some available graphs degrade to has_graph=False
+    tight = list(
+        bucketed_collate_batches(
+            token_ids, labels, list(range(n)), graphs, (32,), 128, 1,
+            8, 64, pad_id=pad, lengths=lengths,
+        )
+    )
+    degraded = sum(
+        int((~np.asarray(b.has_graph).reshape(-1)
+             & np.asarray(b.row_mask).reshape(-1)).sum())
+        for b in tight
+    )
+    assert degraded > n // 2  # budget 8 nodes cannot hold 6-node graphs
+
+
+def test_collate_plan_matches_collate_shards(rng):
+    """A plan materializes through the standard collater: same bytes as
+    calling collate_shards on the plan's rows directly."""
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    rows, lengths = make_rows(rng, 12, 32, pad)
+    token_ids = {i: rows[i] for i in range(12)}
+    labels = {i: int(i % 2) for i in range(12)}
+    graphs = {i: make_spec(rng, i) for i in range(12)}
+    plan = TextBatchPlan(
+        example_ids=tuple(range(10)), seq_len=32, rows_per_shard=5,
+        num_shards=2, node_budget=512, edge_budget=2048,
+    )
+    got = collate_plan(plan, token_ids, labels, graphs, pad)
+    want = collate_shards(
+        rows[:10], [labels[i] for i in range(10)], list(range(10)),
+        graphs, num_shards=2, rows_per_shard=5, node_budget=512,
+        edge_budget=2048, pad_id=pad,
+    )
+    np.testing.assert_array_equal(got.input_ids, want.input_ids)
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_array_equal(got.row_mask, want.row_mask)
+    np.testing.assert_array_equal(got.has_graph, want.has_graph)
+    np.testing.assert_array_equal(
+        got.graphs.node_feats, want.graphs.node_feats
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared pad-id table (satellite)
+
+
+def test_pad_id_table_matches_tokenizers_and_encoders():
+    from deepdfa_tpu.models.t5 import T5Config
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    assert HashTokenizer().pad_id == PAD_ID_BY_FAMILY["roberta"]
+    assert HashTokenizer(t5_frame=True).pad_id == PAD_ID_BY_FAMILY["t5"]
+    assert TransformerConfig().pad_token_id == PAD_ID_BY_FAMILY["roberta"]
+    assert T5Config().pad_token_id == PAD_ID_BY_FAMILY["t5"]
+
+
+def test_collate_default_pad_matches_roberta_family(rng):
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    rows, _ = make_rows(rng, 4, 8, pad)
+    b = collate(
+        rows, [0, 1, 0, 1], list(range(4)), {}, batch_rows=6,
+        node_budget=64, edge_budget=256,
+    )
+    # padding rows are filled with the family pad id
+    assert (np.asarray(b.input_ids)[4:] == pad).all()
+
+
+# ---------------------------------------------------------------------------
+# encoder capacity guards (satellite)
+
+
+def test_transformer_position_guard_raises():
+    import jax
+
+    from deepdfa_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny(max_position_embeddings=20)
+    params = tfm.init_params(cfg, jax.random.key(0))
+    ids = np.full((2, 32), 7, np.int32)  # 32 + pad_id 1 > 20 - 1
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        tfm.encode(cfg, params, ids)
+    # a fitting length passes
+    tfm.encode(cfg, params, np.full((2, 8), 7, np.int32))
+
+
+def test_t5_sequence_length_guard_raises():
+    import dataclasses
+
+    import jax
+
+    from deepdfa_tpu.models import t5 as t5m
+
+    cfg = dataclasses.replace(t5m.T5Config.tiny(), max_sequence_length=16)
+    params = t5m.init_params(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="max_sequence_length"):
+        t5m.encode(cfg, params, np.full((2, 32), 7, np.int32))
+    t5m.encode(cfg, params, np.full((2, 16), 7, np.int32))  # at the bound
+
+
+# ---------------------------------------------------------------------------
+# loss equivalence (acceptance): bucketed pad target vs 512-pad
+
+
+def test_bucketed_logits_match_512_pad(rng):
+    """Per-example logits from a bucket-edge-padded batch match the
+    unbucketed 512-pad batch within fp tolerance: attention masks out
+    pad, CLS pooling reads position 0, and RoBERTa position ids depend
+    only on the row index — so the pad target is numerically inert."""
+    import jax
+
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    n = 8
+    rows, lengths = make_rows(rng, n, 48, pad)
+    wide = np.full((n, 512), pad, np.int32)
+    wide[:, :48] = rows
+    graphs = {i: make_spec(rng, i) for i in range(n)}
+    labels = list(range(n))
+
+    cfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(
+            dropout_rate=0.0, max_position_embeddings=516
+        ),
+        graph_hidden_dim=8,
+        graph_input_dim=6,
+    )
+    params = cmb.init_params(cfg, jax.random.key(0))
+
+    def logits_of(token_mat):
+        b = collate(
+            token_mat, labels, list(range(n)), graphs, batch_rows=n,
+            node_budget=256, edge_budget=1024, pad_id=pad,
+        )
+        return np.asarray(
+            cmb.forward(cfg, params, b.input_ids, b.graphs, b.has_graph)
+        )
+
+    wide_logits = logits_of(wide)
+    narrow_logits = logits_of(rows[:, :64])  # bucket edge 64 >= max len
+    np.testing.assert_allclose(
+        narrow_logits, wide_logits, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_text_pool_and_cache_roundtrip(rng, tmp_path):
+    """The spawn-pool collater and the packed-batch cache's TextBatch
+    branch are bit-identical to inline collation — every leaf, nested
+    graph leaves included, and the full stream length."""
+    from deepdfa_tpu.data.mp_pack import TextMpPacker
+    from deepdfa_tpu.data.packed_cache import (
+        PackedBatchCache,
+        cache_key,
+        text_corpus_digest,
+    )
+    from deepdfa_tpu.data.text import TEXT_ARRAY_FIELDS
+    from deepdfa_tpu.graphs.batch import ARRAY_FIELDS
+
+    pad = PAD_ID_BY_FAMILY["roberta"]
+    n, max_t = 40, 64
+    rows, lengths = make_rows(rng, n, max_t, pad)
+    token_ids = {i: rows[i] for i in range(n)}
+    labels = {i: int(i % 2) for i in range(n)}
+    graphs = {i: make_spec(rng, i) for i in range(n) if i % 3}
+    args = ((16, 32, 64), 256, 2, 4096, 16384)
+
+    def leaves(b):
+        out = [np.asarray(getattr(b, f)) for f in TEXT_ARRAY_FIELDS]
+        for f in ARRAY_FIELDS:
+            v = getattr(b.graphs, f)
+            if v is not None:
+                out.append(np.asarray(v))
+        return out
+
+    def same(a, b):
+        la, lb = leaves(a), leaves(b)
+        return len(la) == len(lb) and all(map(np.array_equal, la, lb))
+
+    inline = list(
+        bucketed_collate_batches(
+            token_ids, labels, list(range(n)), graphs, *args, pad_id=pad
+        )
+    )
+    assert len(inline) > 1
+
+    with TextMpPacker(token_ids, labels, graphs, pad_id=pad, workers=2) as p:
+        pooled = list(p.bucketed_batches(list(range(n)), *args))
+    assert len(pooled) == len(inline)
+    assert all(same(a, b) for a, b in zip(pooled, inline))
+
+    cache = PackedBatchCache(tmp_path)
+    key = cache_key(
+        dict(kind="text", pad_id=pad), text_corpus_digest(token_ids, labels)
+    )
+    list(cache.write_through(key, iter(inline)))
+    replayed = list(cache.replay(key))
+    assert len(replayed) == len(inline)
+    assert all(same(a, b) for a, b in zip(replayed, inline))
+    assert all(
+        int(a.graphs.num_graphs) == int(b.graphs.num_graphs)
+        for a, b in zip(replayed, inline)
+    )
