@@ -399,9 +399,12 @@ def _epoch_batches(
     if cfg.data.packed_cache and source_digest is not None:
         from deepdfa_tpu.data.packed_cache import PackedBatchCache, cache_key
 
+        rcfg = cfg.train.resilience
         cache = PackedBatchCache(
             paths.cache_dir(cfg.data.dataset) / "packed",
             max_entries=cfg.data.packed_cache_max_entries,
+            io_retries=rcfg.io_retries,
+            io_backoff_s=rcfg.io_backoff_s,
         )
         key = cache_key(
             dict(
@@ -513,18 +516,35 @@ def cmd_train(args) -> None:
                 val_packer.close()
             return out
 
+        # resilience runtime (docs/resilience.md): step-granular
+        # checkpoint/resume, preemption handling, divergence guard,
+        # watchdog — all off unless train.resilience.enabled
+        from deepdfa_tpu.train.resilience import make_runner
+
+        res = make_runner(cfg, run_dir / "checkpoints-step")
+        # deterministic fault injection for the resilience tests/harness
+        # (scripts/fault_inject.py); armed only via DEEPDFA_FAULTS
+        from deepdfa_tpu.testing.faults import injector_from_env
+
+        injector = injector_from_env()
+
+        def train_stream(epoch):
+            s = _epoch_batches(
+                cfg, split_specs["train"], mesh, epoch,
+                source_digest=train_digest, packer=packer, lazy=True,
+            )
+            return injector.wrap(s) if injector is not None else s
+
         with RunLogger(run_dir) as run_log:
             state = trainer.fit(
                 state,
-                lambda epoch: _epoch_batches(
-                    cfg, split_specs["train"], mesh, epoch,
-                    source_digest=train_digest, packer=packer, lazy=True,
-                ),
+                train_stream,
                 val_batches=val_batches,
                 checkpoints=ckpts,
                 log_fn=nni_bridge.intermediate_log_fn(
                     cfg.train.monitor, run_log.log
                 ),
+                resilience=res,
             )
     finally:
         for p in (packer, val_packer):
@@ -851,6 +871,8 @@ def cmd_train_combined(args) -> None:
         text_cache = PackedBatchCache(
             paths.cache_dir(ds) / "packed-text",
             max_entries=cfg.data.packed_cache_max_entries,
+            io_retries=cfg.train.resilience.io_retries,
+            io_backoff_s=cfg.train.resilience.io_backoff_s,
         )
         source_digest = (
             text_corpus_digest(token_ids, labels)
@@ -1025,12 +1047,23 @@ def cmd_train_combined(args) -> None:
         state = trainer.load_encoder(state, enc_import(enc_cfg, sd))
 
     ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
+    from deepdfa_tpu.testing.faults import injector_from_env
+    from deepdfa_tpu.train.resilience import make_runner
+
+    res = make_runner(cfg, run_dir / "checkpoints-combined-step")
+    injector = injector_from_env()
+
+    def train_stream(epoch):
+        s = epoch_batches(epoch)
+        return injector.wrap(s) if injector is not None else s
+
     try:
         state = trainer.fit(
             state,
-            epoch_batches,
+            train_stream,
             val_batches=lambda: batches(split_ids_for("val"), phase="val"),
             checkpoints=ckpts,
+            resilience=res,
         )
     finally:
         if text_packer is not None:
@@ -1172,14 +1205,23 @@ def cmd_train_gen(args) -> None:
             if args.do_eval_bleu
             else None
         )
+        from deepdfa_tpu.testing.faults import injector_from_env
+        from deepdfa_tpu.train.resilience import make_runner
+
+        res = make_runner(cfg, run_dir / "checkpoints-gen-step")
+        injector = injector_from_env()
+        stream = train_batches
+        if injector is not None:
+            stream = lambda epoch: injector.wrap(train_batches(epoch))  # noqa: E731
         state = trainer.fit(
             state,
-            train_batches,
+            stream,
             val_batches=val_batches,
             val_decode=val_decode,
             checkpoints=ckpts,
             bleu_checkpoints=bleu_ckpts,
             patience=args.patience,
+            resilience=res,
         )
         print("best:", ckpts.best_metrics())
 
@@ -1833,7 +1875,20 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
-    args.fn(args)
+    try:
+        args.fn(args)
+    except Exception as e:
+        # a clean preemption exit (train/resilience.py): the in-flight
+        # step finished, the state + resume manifest are on disk, and
+        # re-running the same command resumes where this one stopped
+        from deepdfa_tpu.train.resilience import EXIT_PREEMPTED, Preempted
+
+        if not isinstance(e, Preempted):
+            raise
+        print(f"preempted: {e}")
+        if e.manifest is not None:
+            print(f"resume manifest: {e.manifest} (re-run to resume)")
+        raise SystemExit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

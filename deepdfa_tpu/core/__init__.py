@@ -7,6 +7,7 @@ from deepdfa_tpu.core.config import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    ResilienceConfig,
     TrainConfig,
 )
 
@@ -23,4 +24,5 @@ __all__ = [
     "MeshConfig",
     "BatchConfig",
     "FeatureSpec",
+    "ResilienceConfig",
 ]

@@ -196,6 +196,50 @@ class OptimConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Preemption-safe, self-healing training runtime knobs
+    (train/resilience.py, docs/resilience.md).
+
+    Everything hangs off the master `enabled` switch so the default
+    training path is byte-for-byte the historical one; the CLI train
+    commands build a ResilientRunner when it is on."""
+
+    enabled: bool = False
+    # step-granular checkpoint cadence (steps); 0 = checkpoint only on
+    # preemption. Each checkpoint captures the FULL TrainState (params +
+    # optimizer + schedule step) plus the data cursor (epoch, batch
+    # index), so a killed run resumes mid-epoch.
+    step_checkpoint_every: int = 50
+    # step checkpoints retained (the resume manifest always points at the
+    # newest complete one)
+    keep_last_k: int = 3
+    # resume automatically when a resume manifest exists in the run dir
+    auto_resume: bool = True
+    # on-device loss/grad-norm finiteness guard: a non-finite step is
+    # skipped inside jit (params/optimizer untouched) with no extra host
+    # sync on the happy path (the flag is fetched `guard_lag` steps late)
+    divergence_guard: bool = True
+    guard_lag: int = 1
+    # after this many CONSECUTIVE bad steps, roll back to the last-good
+    # step checkpoint and multiply the effective LR by lr_cooldown;
+    # rollback_budget bounds how many times before giving up loudly
+    max_consecutive_bad: int = 3
+    rollback_budget: int = 2
+    lr_cooldown: float = 0.5
+    # step watchdog: abort with a stage-attributed diagnostic when no
+    # train-loop heartbeat lands for this long (hung device step or
+    # stalled input pipeline); 0 = off
+    watchdog_timeout_s: float = 0.0
+    # stall threshold until the FIRST completed step — that step
+    # legitimately includes jit compilation (minutes on TPU), which the
+    # steady-state timeout would misread as a hang; 0 = 10x the timeout
+    watchdog_first_step_grace_s: float = 0.0
+    # transient host-I/O retry policy (packed-cache reads, manifests)
+    io_retries: int = 2
+    io_backoff_s: float = 0.05
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. Axis sizes of 1 collapse; -1 = all remaining."""
 
@@ -211,6 +255,9 @@ class TrainConfig:
     max_epochs: int = 25
     eval_every_epochs: int = 1
     checkpoint_every_epochs: int = 25
+    # keep only the newest k epoch checkpoints (the `best` copy is always
+    # kept); 0 = unbounded, the historical behaviour
+    checkpoint_keep_last: int = 0
     monitor: str = "val_loss"  # checkpoint-selection metric
     monitor_mode: str = "min"
     seed: int = 1
@@ -245,6 +292,7 @@ class TrainConfig:
     step_cache_entries: int = 8
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
 
 @dataclass(frozen=True)

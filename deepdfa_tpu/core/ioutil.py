@@ -1,0 +1,89 @@
+"""Durable small-file I/O + transient-failure retry, shared by the
+checkpoint manifests (train/checkpoint.py, train/resilience.py) and the
+packed-batch cache (data/packed_cache.py).
+
+The failure modes these helpers close (docs/resilience.md):
+
+- a crash mid-`write_text` leaves a truncated/empty json that poisons
+  every future read -> `atomic_write_text` stages to a tmp file, fsyncs
+  the data, and renames into place, so readers only ever see the old or
+  the new complete content;
+- a rename alone is not durable across power loss (the data pages and the
+  directory entry can land in either order) -> the tmp file AND the
+  containing directory are fsynced;
+- transient host I/O errors (network filesystems, overloaded disks)
+  fail a whole epoch for a blip -> `with_retries` re-runs the operation
+  with exponential backoff, bounded.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Callable, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def fsync_dir(directory: str | Path) -> None:
+    """fsync a directory so a rename inside it is durable (no-op on
+    platforms whose directory fds reject fsync)."""
+    try:
+        fd = os.open(str(directory), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str | Path, text: str) -> None:
+    """Crash-safe replacement for ``Path.write_text``: tmp + fsync +
+    rename. A reader concurrent with (or after) a crash sees either the
+    previous complete content or the new complete content, never a
+    truncation."""
+    path = Path(path)
+    tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+    with tmp.open("w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path.parent)
+
+
+def with_retries(
+    fn: Callable[[], T],
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    exceptions: tuple[type[BaseException], ...] = (OSError,),
+    no_retry: tuple[type[BaseException], ...] = (FileNotFoundError,),
+    what: str = "io operation",
+) -> T:
+    """Run ``fn`` with up to ``retries`` retries on ``exceptions``,
+    sleeping ``backoff_s * 2**attempt`` between attempts. The final
+    failure propagates unchanged. ``no_retry`` carves subclasses out of
+    ``exceptions`` that propagate immediately — by default
+    FileNotFoundError, which signals deterministic absence (e.g. a
+    concurrently evicted cache entry), not a transient blip."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions as e:
+            if isinstance(e, no_retry) or attempt >= retries:
+                raise
+            delay = backoff_s * (2**attempt)
+            logger.warning(
+                "%s failed (%s: %s); retry %d/%d in %.3fs",
+                what, type(e).__name__, e, attempt + 1, retries, delay,
+            )
+            time.sleep(delay)
+            attempt += 1

@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import logging
 import os
 import shutil
 import tempfile
@@ -43,6 +44,7 @@ from typing import Callable, Iterable, Iterator, Mapping, Sequence
 
 import numpy as np
 
+from deepdfa_tpu.core.ioutil import with_retries
 from deepdfa_tpu.data.text import (
     TEXT_ARRAY_FIELDS as _TEXT_FIELDS,
     TextBatch,
@@ -56,6 +58,32 @@ from deepdfa_tpu.graphs.batch import (
 #: bump on ANY change to pack()/plan semantics that alters the packed
 #: bytes for identical inputs — it is part of every cache key
 SCHEMA_VERSION = 1
+
+logger = logging.getLogger(__name__)
+
+#: entry dirs whose full content digests this process has already
+#: verified — later epochs replay with size checks only (docs/resilience.md)
+_VERIFIED: set[str] = set()
+
+
+class CacheCorruption(RuntimeError):
+    """A cache entry failed size/digest verification (truncated write-out
+    from a killed writer, bit rot, manual tampering). `get_or_pack`
+    quarantines the entry and falls through to cold packing."""
+
+
+def _file_digest(path: Path, chunk: int = 1 << 20) -> tuple[int, str]:
+    """(size, sha256) of a file's bytes, streamed."""
+    h = hashlib.sha256()
+    size = 0
+    with path.open("rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            size += len(b)
+            h.update(b)
+    return size, h.hexdigest()
 
 
 def cache_key(
@@ -138,10 +166,20 @@ class PackedBatchCache:
     train-epoch writes). None = unbounded.
     """
 
-    def __init__(self, root: str | Path, max_entries: int | None = None):
+    def __init__(
+        self,
+        root: str | Path,
+        max_entries: int | None = None,
+        io_retries: int = 2,
+        io_backoff_s: float = 0.05,
+    ):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.max_entries = max_entries
+        # transient host-I/O retry policy for replay reads
+        # (train.resilience.io_* config via the CLI)
+        self.io_retries = int(io_retries)
+        self.io_backoff_s = float(io_backoff_s)
 
     def entry_dir(self, key: str) -> Path:
         return self.root / key
@@ -213,6 +251,16 @@ class PackedBatchCache:
         return {"num_graphs": int(batch.num_graphs), "fields": fields}
 
     def _finalize(self, tmp: Path, key: str, meta: list[dict]) -> None:
+        # per-file size + content digest: replay verifies before serving
+        # mmap'd arrays, so a shard truncated by a killed writer (or a
+        # post-rename power loss — the npy data pages are not fsynced) is
+        # detected and quarantined instead of replayed as garbage. The
+        # files were just written, so this hashing pass reads from the
+        # page cache.
+        files = {
+            p.name: dict(zip(("size", "sha256"), _file_digest(p)))
+            for p in sorted(tmp.glob("*.npy"))
+        }
         (tmp / "manifest.json").write_text(
             json.dumps(
                 {
@@ -220,6 +268,7 @@ class PackedBatchCache:
                     "key": key,
                     "n_batches": len(meta),
                     "batches": meta,
+                    "files": files,
                 }
             )
         )
@@ -251,6 +300,39 @@ class PackedBatchCache:
 
     # -- read ----------------------------------------------------------------
 
+    def _verify(self, d: Path, manifest: Mapping) -> None:
+        """Check the entry's files against the manifest's recorded sizes
+        and content digests BEFORE any array is served.
+
+        Sizes are stat'd on every replay (cheap; catches the killed-writer
+        truncation). The full digest pass runs once per entry per process
+        — later epochs replay without re-hashing. Entries written before
+        digests existed carry no "files" block and skip verification.
+        """
+        files = manifest.get("files")
+        if files is None:
+            return
+        for name, rec in files.items():
+            try:
+                size = (d / name).stat().st_size
+            except OSError as e:
+                raise CacheCorruption(f"{name}: {e}") from e
+            if size != rec["size"]:
+                raise CacheCorruption(
+                    f"{name}: size {size} != recorded {rec['size']} "
+                    f"(truncated write-out?)"
+                )
+        if str(d) in _VERIFIED:
+            return
+        for name, rec in files.items():
+            _, digest = _file_digest(d / name)
+            if digest != rec["sha256"]:
+                raise CacheCorruption(
+                    f"{name}: content digest mismatch "
+                    f"({digest[:12]} != {rec['sha256'][:12]})"
+                )
+        _VERIFIED.add(str(d))
+
     def replay(
         self, key: str, mmap: bool = True
     ) -> Iterator[GraphBatch | TextBatch]:
@@ -258,30 +340,53 @@ class PackedBatchCache:
         default (zero-copy until device_put). Batch kind comes from the
         manifest: "text" entries rebuild the TextBatch + nested
         GraphBatch pytree; untagged entries are graph-only (they predate
-        the tag)."""
+        the tag). Sizes/digests are verified up front (CacheCorruption on
+        mismatch); transient read errors retry with backoff."""
         d = self.entry_dir(key)
         manifest_path = d / "manifest.json"
-        manifest = json.loads(manifest_path.read_text())
         try:
-            os.utime(manifest_path)  # LRU stamp read by _evict
-        except OSError:
-            pass  # read-only cache dir: eviction degrades to write order
+            manifest = with_retries(
+                lambda: json.loads(manifest_path.read_text()),
+                retries=self.io_retries, backoff_s=self.io_backoff_s,
+                what=f"cache manifest read {key}",
+            )
+        except FileNotFoundError:
+            raise
+        except (json.JSONDecodeError, OSError) as e:
+            raise CacheCorruption(f"manifest.json: {e}") from e
         if manifest.get("schema") != SCHEMA_VERSION:
             raise ValueError(
                 f"cache entry {key} has schema {manifest.get('schema')}, "
                 f"expected {SCHEMA_VERSION} — key derivation is broken"
             )
+        self._verify(d, manifest)
+        try:
+            os.utime(manifest_path)  # LRU stamp read by _evict
+        except OSError:
+            pass  # read-only cache dir: eviction degrades to write order
         mode = "r" if mmap else None
+
+        def load(path: Path):
+            try:
+                return with_retries(
+                    lambda: np.load(path, mmap_mode=mode),
+                    retries=self.io_retries, backoff_s=self.io_backoff_s,
+                    what=f"cache read {path.name}",
+                )
+            except FileNotFoundError:
+                raise  # concurrent eviction: handled by get_or_pack
+            except (ValueError, EOFError, OSError) as e:
+                # np.load's header/parse failures on a damaged file
+                raise CacheCorruption(f"{path.name}: {e}") from e
+
         for i, m in enumerate(manifest["batches"]):
             arrays = {
-                name: np.load(d / f"b{i:05d}.{name}.npy", mmap_mode=mode)
+                name: load(d / f"b{i:05d}.{name}.npy")
                 for name in m["fields"]
             }
             if m.get("kind") == "text":
                 garrays = {
-                    name: np.load(
-                        d / f"b{i:05d}.graphs.{name}.npy", mmap_mode=mode
-                    )
+                    name: load(d / f"b{i:05d}.graphs.{name}.npy")
                     for name in m["graph_fields"]
                 }
                 yield TextBatch(
@@ -316,12 +421,15 @@ class PackedBatchCache:
         builder: Callable[[], Iterable[GraphBatch]],
         mmap: bool,
     ) -> Iterator[GraphBatch]:
-        """Replay, falling back to a rebuild if the entry vanishes.
+        """Replay, falling back to a rebuild if the entry vanishes or
+        fails verification.
 
         A concurrent run sharing this root (e.g. NNI sweep trials) can
         evict/prune the entry between has() and the last np.load — already
         -yielded mmap views stay valid (the fd pins the pages), but the
-        next file open raises FileNotFoundError. The stream is a pure
+        next file open raises FileNotFoundError. A truncated/corrupt
+        entry (killed writer, bit rot) raises CacheCorruption and is
+        QUARANTINED for post-mortem. Either way the stream is a pure
         function of the key, so rebuild via `builder()` and resume after
         the batches already yielded instead of killing the training run.
         """
@@ -333,11 +441,53 @@ class PackedBatchCache:
             return
         except FileNotFoundError:
             pass
+        except CacheCorruption as e:
+            dest = self.quarantine(key)
+            logger.warning(
+                "packed cache entry %s corrupt (%s); quarantined to %s "
+                "and repacking cold", key, e, dest,
+            )
         for i, batch in enumerate(self.write_through(key, builder())):
             if i >= n:
                 yield batch
 
     # -- maintenance ---------------------------------------------------------
+
+    #: quarantined entries retained for post-mortem (newest first)
+    QUARANTINE_KEEP = 4
+
+    def quarantine(self, key: str) -> Path | None:
+        """Move a corrupt entry aside (bounded keep) so the next pack can
+        rebuild at the key's path while the damaged bytes stay available
+        for inspection. Returns the quarantine path (None when the entry
+        was already gone or could not be moved)."""
+        d = self.entry_dir(key)
+        _VERIFIED.discard(str(d))
+        if not d.exists():
+            return None
+        qroot = self.root / "quarantine"
+        qroot.mkdir(exist_ok=True)
+        dest = qroot / f"{key}-{os.getpid()}-{time.time_ns()}"
+        try:
+            os.replace(d, dest)
+        except OSError:
+            # cross-run race or odd filesystem: dropping it still unblocks
+            shutil.rmtree(d, ignore_errors=True)
+            return None
+        def quarantined_at(p: Path) -> int:
+            # os.replace preserves the entry's ORIGINAL mtime, so order
+            # by the quarantine timestamp embedded in the name — an old
+            # entry quarantined just now must be the newest, not the
+            # first evicted
+            try:
+                return int(p.name.rsplit("-", 1)[-1])
+            except ValueError:
+                return 0
+
+        old = sorted(qroot.iterdir(), key=quarantined_at)
+        for p in old[: -self.QUARANTINE_KEEP]:
+            shutil.rmtree(p, ignore_errors=True)
+        return dest
 
     def keys(self) -> list[str]:
         # dot-prefixed dirs are in-progress write spills; _finalize

@@ -98,18 +98,39 @@ class JoernTimeout(RuntimeError):
 
 class JoernSession:
     def __init__(
-        self, worker_id: int = 0, timeout: float = 300.0, binary: str = "joern"
+        self,
+        worker_id: int = 0,
+        timeout: float = 300.0,
+        binary: str = "joern",
+        max_restarts: int = 1,
     ):
         """timeout: per-command bound — a hung JVM raises JoernTimeout
         instead of blocking the worker forever (the reference's pexpect
         driver has the same per-expect timeout, joern_session.py:87-102).
         binary: override for tests (a marker-echoing stub stands in for
-        the real JVM to exercise the protocol)."""
+        the real JVM to exercise the protocol).
+        max_restarts: after a JoernTimeout the wedged JVM is killed and
+        the session is DEAD; up to this many times per session a fresh
+        JVM is spawned, the last importCode replayed, and the timed-out
+        command retried ONCE — so one hung JVM does not fail a whole
+        extraction batch. 0 restores the old fail-fast behaviour."""
         if binary == "joern" and not available():
             raise RuntimeError("joern binary not on PATH")
         self.timeout = timeout
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._binary = binary
+        self._last_import: str | None = None
         self.workspace = Path(tempfile.mkdtemp(prefix=f"joern-ws-{worker_id}-"))
-        argv = [binary, "--nocolors"] if binary == "joern" else [binary]
+        self._spawn()
+
+    def _spawn(self) -> None:
+        """Start (or restart) the JVM + reader thread and handshake."""
+        argv = (
+            [self._binary, "--nocolors"]
+            if self._binary == "joern"
+            else [self._binary]
+        )
         self.proc = subprocess.Popen(
             argv,
             stdin=subprocess.PIPE,
@@ -120,28 +141,31 @@ class JoernSession:
             bufsize=1,
         )
         # reader thread: readline on a pipe cannot be interrupted, so all
-        # reads flow through a queue that run_command polls with a deadline
+        # reads flow through a queue that _exchange polls with a deadline.
+        # Restart replaces the queue; an old reader drains into the old
+        # queue and exits at EOF of its killed process.
         self._lines: queue.Queue[str | None] = queue.Queue()
-        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader = threading.Thread(
+            target=self._pump, args=(self.proc, self._lines), daemon=True
+        )
         self._reader.start()
         self._drain_until_ready()
 
     # -- protocol ------------------------------------------------------------
 
-    def _pump(self) -> None:
-        assert self.proc.stdout is not None
-        for line in self.proc.stdout:
-            self._lines.put(line)
-        self._lines.put(None)  # EOF sentinel
+    @staticmethod
+    def _pump(proc, lines) -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)  # EOF sentinel
 
     def _drain_until_ready(self) -> None:
-        self.run_command("1 + 1")
+        self._exchange("1 + 1")
 
-    def run_command(self, cmd: str, timeout: float | None = None) -> str:
-        """Send one command; collect output up to the marker echo.
-
-        Raises JoernTimeout when the whole exchange exceeds the bound (the
-        session is killed — a wedged JVM is not reusable)."""
+    def _exchange(self, cmd: str, timeout: float | None = None) -> str:
+        """One command/marker round-trip on the CURRENT process; kills it
+        and raises JoernTimeout on deadline."""
         import time
 
         assert self.proc.stdin is not None
@@ -170,9 +194,46 @@ class JoernSession:
             lines.append(line)
         return "".join(lines)
 
+    def run_command(self, cmd: str, timeout: float | None = None) -> str:
+        """Send one command; collect output up to the marker echo.
+
+        On JoernTimeout the wedged JVM is killed; within the
+        `max_restarts` budget a fresh JVM is spawned, the last
+        importCode is replayed (project state dies with the JVM), and the
+        command is retried once — a second timeout propagates."""
+        import logging
+
+        try:
+            return self._exchange(cmd, timeout)
+        except JoernTimeout:
+            if self.restarts >= self.max_restarts:
+                raise
+            self.restarts += 1
+            logging.getLogger(__name__).warning(
+                "joern JVM hung; restart %d/%d and retrying %r",
+                self.restarts, self.max_restarts, cmd[:80],
+            )
+            self._spawn()
+            # replay the loaded project UNLESS the timed-out command was
+            # the importCode itself — replaying and then retrying it
+            # would import twice (and double the slowest operation's
+            # chance of hitting the same timeout again)
+            if self._last_import is not None and not cmd.startswith(
+                "importCode("
+            ):
+                # replay under the session's own budget, not the failed
+                # command's (possibly much shorter) per-command timeout —
+                # an import that took 60s must not be bounded by a 10s
+                # query timeout
+                self._exchange(f'importCode("{self._last_import}")')
+            return self._exchange(cmd, timeout)
+
     # -- operations ----------------------------------------------------------
 
     def import_code(self, path: str | Path) -> str:
+        # remembered so a post-timeout JVM restart can reload the project
+        # before retrying the command that timed out
+        self._last_import = str(path)
         return self.run_command(f'importCode("{path}")')
 
     def export_cpg_json(self, source_path: str | Path) -> tuple[Path, Path]:

@@ -1,0 +1,27 @@
+"""Deterministic fault injection + test doubles for the resilience
+runtime (train/resilience.py). Not imported by production code paths
+unless the DEEPDFA_FAULTS env hook is armed."""
+
+from deepdfa_tpu.testing.faults import (
+    ENV_VAR,
+    FaultInjector,
+    FaultPlan,
+    StalledSource,
+    corrupt_cache_file,
+    injector_from_env,
+    parse_plan,
+    poison_batch,
+    truncate_cache_file,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "FaultInjector",
+    "FaultPlan",
+    "StalledSource",
+    "corrupt_cache_file",
+    "injector_from_env",
+    "parse_plan",
+    "poison_batch",
+    "truncate_cache_file",
+]

@@ -1,6 +1,15 @@
 from deepdfa_tpu.train.checkpoint import CheckpointManager
 from deepdfa_tpu.train.logging import RunLogger
 from deepdfa_tpu.train.loop import GraphTrainer
+from deepdfa_tpu.train.resilience import (
+    DivergenceError,
+    Preempted,
+    ResilientRunner,
+    ResumeCursor,
+    StepCheckpointer,
+    Watchdog,
+    make_runner,
+)
 from deepdfa_tpu.train.transfer import (
     freeze_mask,
     frozen_optimizer,
@@ -22,6 +31,13 @@ __all__ = [
     "CheckpointManager",
     "RunLogger",
     "GraphTrainer",
+    "DivergenceError",
+    "Preempted",
+    "ResilientRunner",
+    "ResumeCursor",
+    "StepCheckpointer",
+    "Watchdog",
+    "make_runner",
     "freeze_mask",
     "frozen_optimizer",
     "graph_encoder_subset",

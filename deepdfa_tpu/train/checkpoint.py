@@ -5,34 +5,92 @@ Replaces Lightning's ModelCheckpoint/PeriodicModelCheckpoint
 and the manual torch.save best-F1 scheme (LineVul/linevul/linevul_main.py:
 225-251). Best selection is recorded in a json manifest instead of being
 parsed back out of filenames (reference main_cli.py:175-183).
+
+Durability (docs/resilience.md): the manifest is written atomically
+(tmp+fsync+rename, core/ioutil.py) so a crash mid-write can never leave a
+truncated json that poisons every future resume; a manifest corrupted by
+other means (partial page writes after power loss, manual edits) is
+tolerated by rebuilding the tag list from the checkpoint directories
+actually on disk. `keep_last` bounds how many tagged checkpoints a long
+run accumulates (the `best` copy is always kept).
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import shutil
 from pathlib import Path
 from typing import Any
 
 import orbax.checkpoint as ocp
 
+from deepdfa_tpu.core.ioutil import atomic_write_text
+
+logger = logging.getLogger(__name__)
+
 
 class CheckpointManager:
-    def __init__(self, directory: str | Path, monitor: str = "val_loss", mode: str = "min"):
+    def __init__(
+        self,
+        directory: str | Path,
+        monitor: str = "val_loss",
+        mode: str = "min",
+        keep_last: int | None = None,
+    ):
+        """keep_last: retain only the newest N tagged checkpoints (`best`
+        is exempt); None/0 = unbounded (the historical behaviour)."""
         self.directory = Path(directory).resolve()
         self.directory.mkdir(parents=True, exist_ok=True)
         self.monitor = monitor
         self.mode = mode
+        self.keep_last = int(keep_last) if keep_last else 0
         self._ckpt = ocp.StandardCheckpointer()
         self._manifest_path = self.directory / "manifest.json"
         self._manifest: dict[str, Any] = {"best": None, "last": None, "history": []}
         if self._manifest_path.exists():
-            self._manifest = json.loads(self._manifest_path.read_text())
+            try:
+                self._manifest = json.loads(self._manifest_path.read_text())
+            except (json.JSONDecodeError, OSError) as e:
+                logger.warning(
+                    "corrupt checkpoint manifest %s (%s: %s); rebuilding "
+                    "from on-disk checkpoint dirs",
+                    self._manifest_path, type(e).__name__, e,
+                )
+                self._manifest = self._rebuild_manifest()
+                atomic_write_text(
+                    self._manifest_path, json.dumps(self._manifest, indent=2)
+                )
+
+    def _rebuild_manifest(self) -> dict[str, Any]:
+        """Best-effort manifest from the checkpoint dirs on disk: tags in
+        name order, metrics unknown (empty). `best` keeps working when its
+        directory survived — with no recorded metric the next save wins
+        the comparison, which is the safe direction."""
+        tags = sorted(
+            p.name
+            for p in self.directory.iterdir()
+            if p.is_dir() and p.name != "best"
+        )
+        history = [{"tag": t, "step": -1, "metrics": {}} for t in tags]
+        best = (
+            {"tag": "best", "step": -1, "metrics": {}}
+            if (self.directory / "best").is_dir()
+            else None
+        )
+        return {
+            "best": best,
+            "last": history[-1] if history else None,
+            "history": history,
+        }
 
     def _is_better(self, value: float) -> bool:
         best = self._manifest["best"]
         if best is None:
             return True
-        prev = best["metrics"][self.monitor]
+        prev = best["metrics"].get(self.monitor)
+        if prev is None:  # rebuilt manifest: no recorded metric to beat
+            return True
         return value < prev if self.mode == "min" else value > prev
 
     def save(self, tag: str, state: Any, metrics: dict[str, float], step: int) -> bool:
@@ -51,8 +109,33 @@ class CheckpointManager:
             self._ckpt.save(best_path, state, force=True)
             self._ckpt.wait_until_finished()
             self._manifest["best"] = entry
-        self._manifest_path.write_text(json.dumps(self._manifest, indent=2))
+        self._retain()
+        atomic_write_text(
+            self._manifest_path, json.dumps(self._manifest, indent=2)
+        )
         return is_best
+
+    def _retain(self) -> None:
+        """keep-last-k: drop the oldest tagged checkpoint DIRS beyond the
+        bound (history entries are kept — they are the metric log; the
+        `best` dir is a separate copy, so the best weights always
+        survive). The `last` pointer's dir is never dropped."""
+        if not self.keep_last:
+            return
+        tags: list[str] = []
+        for e in self._manifest["history"]:
+            if e["tag"] not in tags:
+                tags.append(e["tag"])
+        keep = set(tags[-self.keep_last:])
+        last = self._manifest.get("last")
+        if last:
+            keep.add(last["tag"])
+        for tag in tags:
+            if tag in keep:
+                continue
+            path = self.directory / tag
+            if path.is_dir():
+                shutil.rmtree(path, ignore_errors=True)
 
     def restore(self, tag: str, target: Any) -> Any:
         """Restore into the structure of `target` (an abstract or concrete

@@ -152,6 +152,14 @@ class CombinedTrainer:
         self.step_cache_entries = max(
             1, int(getattr(cfg.train, "step_cache_entries", 8))
         )
+        # divergence guard (train/resilience.py): when on, every step
+        # entry is built in its guarded form — signature (state, batch,
+        # key, lr_scale) -> (state, loss, ok) — so the AOT warmup and the
+        # lazy compile accounting cover the exact step fit dispatches
+        rcfg = getattr(cfg.train, "resilience", None)
+        self.guard_active = bool(
+            rcfg is not None and rcfg.enabled and rcfg.divergence_guard
+        )
         self.tx = make_optimizer(cfg.train.optim, total_steps)
         if freeze_graph:
             # reference --freeze_graph: the pretrained GGNN stays fixed
@@ -169,6 +177,7 @@ class CombinedTrainer:
             directory,
             monitor=self.cfg.train.monitor,
             mode=self.cfg.train.monitor_mode,
+            keep_last=getattr(self.cfg.train, "checkpoint_keep_last", 0),
         )
 
     # -- sharding layout -----------------------------------------------------
@@ -360,10 +369,22 @@ class CombinedTrainer:
         self.signature_stats: dict[str, dict] = {}
         self._evicted_lowerings = 0
 
-        def train_step(state, batch: TextBatch, key):
+        def train_step(state, batch: TextBatch, key, lr_scale=1.0,
+                       with_ok=False):
+            # guarded entries (trainer built with the divergence guard
+            # on) take the runner's LR cool-down multiplier and compute
+            # the on-device ok flag — but the PUBLIC contract stays
+            # (state, loss) so external callers (bench scripts, A/B
+            # drivers) are unaffected; the fit loop opts into the flag
+            # with with_ok=True
+            args = (
+                (state, batch, key, lr_scale)
+                if self.guard_active
+                else (state, batch, key)
+            )
             entry = self._entry_for(self._signature(batch))
             if entry.aot or entry.train_compiled:
-                out = entry.train(state, batch, key)
+                out = entry.train(*args)
             else:
                 # a lazy (un-warmed) entry lowers+compiles inside a
                 # call: book that latency as the signature's compile
@@ -375,7 +396,7 @@ class CombinedTrainer:
                 # latches after a call that added no cache entry.
                 n0 = entry.train_jit._cache_size()
                 t0 = time.perf_counter()
-                out = entry.train(state, batch, key)
+                out = entry.train(*args)
                 if entry.train_jit._cache_size() > n0:
                     entry.stats["compiles"] += 1
                     entry.stats["compile_seconds"] += (
@@ -384,6 +405,8 @@ class CombinedTrainer:
                 else:
                     entry.train_compiled = True
             entry.stats["train_steps"] += 1
+            if self.guard_active and not with_ok:
+                out = out[:2]  # drop the flag: legacy (state, loss)
             return out
 
         def eval_step(params, batch: TextBatch):
@@ -525,7 +548,12 @@ class CombinedTrainer:
             if entry.aot:
                 continue  # idempotent: re-warmup never recompiles
             t0 = time.perf_counter()
-            entry.train = entry.train_jit.lower(state, batch, key).compile()
+            lower_args = (
+                (state, batch, key, 1.0)
+                if self.guard_active
+                else (state, batch, key)
+            )
+            entry.train = entry.train_jit.lower(*lower_args).compile()
             dt = time.perf_counter() - t0
             entry.aot = True
             entry.stats["compiles"] += 1
@@ -612,6 +640,15 @@ class CombinedTrainer:
                 loss,
             )
 
+        @partial(jax.jit, donate_argnums=0)
+        def train_step_guarded(state: TrainState, batch: TextBatch, key, lr_scale):
+            """Divergence-guarded step: the shared on-device skip/select
+            core lives in train/resilience.py:apply_guarded_update."""
+            from deepdfa_tpu.train.resilience import apply_guarded_update
+
+            loss, grads = _sharded_grads(state.params, batch, key)
+            return apply_guarded_update(self.tx, state, loss, grads, lr_scale)
+
         @partial(
             shard_map,
             mesh=mesh,
@@ -637,9 +674,10 @@ class CombinedTrainer:
         def eval_step(params, batch: TextBatch):
             return _sharded_eval(params, batch)
 
+        step_fn = train_step_guarded if self.guard_active else train_step
         return _StepEntry(
-            train=train_step, eval=eval_step,
-            train_jit=train_step, eval_jit=eval_step,
+            train=step_fn, eval=eval_step,
+            train_jit=step_fn, eval_jit=eval_step,
             stats=sig_stats,
         )
 
@@ -668,15 +706,41 @@ class CombinedTrainer:
         log_fn: Callable[[dict], None] | None = None,
         seed: int = 0,
         source_stage: str = "pack",
+        resilience=None,
     ) -> TrainState:
+        import contextlib
+
         from deepdfa_tpu.data.prefetch import PipelineStats, prefetch
 
         from deepdfa_tpu.data.text import batch_token_counts
+        from deepdfa_tpu.train.resilience import (
+            ResumeCursor,
+            finite_mean,
+            place_like,
+            skip_first,
+        )
 
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         root = jax.random.key(seed)
-        step = int(jax.device_get(state.step))
+        res = resilience
+        guard = res is not None and res.guard_active and self.guard_active
+        start_epoch = skip_batches = 0
+        cursor = None
+        if res is not None:
+            # resume BEFORE warmup so the AOT executables are lowered
+            # against the restored state's shardings (identical to a
+            # fresh init's by construction of place_like)
+            state, cursor = res.maybe_resume(state, place_like(state))
+            if cursor is not None:
+                start_epoch, skip_batches = cursor.epoch, cursor.batch_index
+        # on resume the loop step comes from the DATA cursor, not
+        # state.step: guard-skipped steps leave state.step behind the
+        # host count the cursor (and RNG folding) was aligned to
+        step = (
+            cursor.step if cursor is not None
+            else int(jax.device_get(state.step))
+        )
         pad_id = int(getattr(self.model_cfg.encoder, "pad_token_id", 0))
 
         # bucketed runs compile every configured signature BEFORE step 1
@@ -693,90 +757,149 @@ class CombinedTrainer:
                         "warmup_compile_seconds": round(sum(warm.values()), 3),
                     })
 
-        for epoch in range(max_epochs):
-            t0 = time.perf_counter()
-            losses = []
-            stats = PipelineStats()
+        cm = res if res is not None else contextlib.nullcontext()
+        with cm:
+            for epoch in range(start_epoch, max_epochs):
+                t0 = time.perf_counter()
+                losses = []
+                stats = PipelineStats()
+                if res is not None:
+                    res.attach_stats(stats)
 
-            def place(batch: TextBatch) -> TextBatch:
-                # token accounting happens host-side, before the sharded
-                # H2D copy in the producer thread (place_batch uses the
-                # exact specs the shard_map consumes)
-                stats.add_tokens(
-                    *batch_token_counts(batch.input_ids, batch.row_mask,
-                                        pad_id)
-                )
-                return self.place_batch(batch)
+                def place(batch: TextBatch) -> TextBatch:
+                    # token accounting happens host-side, before the sharded
+                    # H2D copy in the producer thread (place_batch uses the
+                    # exact specs the shard_map consumes)
+                    stats.add_tokens(
+                        *batch_token_counts(batch.input_ids, batch.row_mask,
+                                            pad_id)
+                    )
+                    return self.place_batch(batch)
 
-            for i, batch in enumerate(
-                prefetch(
-                    train_batches(epoch), tcfg.prefetch_batches, place,
+                source = train_batches(epoch)
+                batch_index = 0
+                if epoch == start_epoch and skip_batches:
+                    # deterministic fast-forward past the batches the
+                    # resumed checkpoint already consumed — BEFORE the
+                    # prefetch pipeline, so they are never device_put and
+                    # never pollute the epoch's token/row accounting
+                    source = skip_first(
+                        source, skip_batches,
+                        heartbeat=lambda: res.heartbeat(
+                            "input", epoch=epoch, step=step
+                        ),
+                    )
+                    batch_index = skip_batches
+                stream = prefetch(
+                    source, tcfg.prefetch_batches, place,
                     producers=tcfg.prefetch_producers,
                     stats=stats, source_stage=source_stage,
                 )
-            ):
-                key = jax.random.fold_in(root, step)
-                state, loss = self.train_step(state, batch, key)
-                losses.append(loss)
-                step += 1
-            epoch_seconds = time.perf_counter() - t0
-            record = {
-                "epoch": epoch,
-                "train_loss": float(np.mean(jax.device_get(losses))) if losses else float("nan"),
-                "epoch_seconds": epoch_seconds,
-                # same stage attribution as GraphTrainer.fit
-                "host_load_seconds": round(stats.load_seconds, 3),
-                "host_pack_seconds": round(stats.pack_seconds, 3),
-                "host_place_seconds": round(stats.place_seconds, 3),
-                "input_wait_seconds": round(stats.wait_seconds, 3),
-                "input_wait_fraction": round(
-                    stats.wait_fraction(epoch_seconds), 4
-                ),
-            }
-            if stats.padded_tokens:
-                # sequence-bucketing observables (docs/input_pipeline.md):
-                # REAL-token throughput is shape-invariant, so it compares
-                # across bucket layouts where examples/sec cannot
-                record.update(
-                    train_examples_per_sec=round(
-                        stats.rows / epoch_seconds, 2
-                    ) if epoch_seconds else None,
-                    train_tokens_per_sec=round(
-                        stats.real_tokens / epoch_seconds, 1
-                    ) if epoch_seconds else None,
-                    real_tokens=stats.real_tokens,
-                    padded_tokens=stats.padded_tokens,
-                    padding_waste=round(stats.padding_waste(), 4),
-                )
-            # cumulative per-signature compile/step attribution for the
-            # bounded step cache; RunLogger flattens the nested dict into
-            # `step_signatures/<sig>/<counter>` TensorBoard scalars
-            record["step_signatures"] = {
-                k: dict(v) for k, v in self.signature_stats.items()
-            }
-            record["jit_lowerings"] = self.jit_lowerings()
-            if val_batches is not None:
-                val_metrics, _ = self.evaluate(state, val_batches())
-                record.update({f"val_{k}": v for k, v in val_metrics.items()})
-            # mirror GraphTrainer.fit: without a val split, still persist on
-            # the periodic cadence and on the final epoch, so a val-less run
-            # never trains to completion and saves nothing
-            if checkpoints is not None and (
-                any(k.startswith("val_") for k in record)
-                or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
-                or epoch == max_epochs - 1
-            ):
-                checkpoints.save(
-                    f"epoch-{epoch:04d}",
-                    jax.device_get(state.params),
-                    {
-                        k: float(v)
-                        for k, v in record.items()
-                        if isinstance(v, (int, float)) and k != "epoch"
-                    },
-                    step=step,
-                )
-            logger.info("epoch %d: %s", epoch, record)
-            if log_fn is not None:
-                log_fn(record)
+                try:
+                    it = iter(stream)
+                    while True:
+                        if res is not None:
+                            res.heartbeat("input", epoch=epoch, step=step)
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            break
+                        if res is not None:
+                            res.heartbeat("device", epoch=epoch, step=step)
+                        key = jax.random.fold_in(root, step)
+                        if guard:
+                            state, loss, ok = self.train_step(
+                                state, batch, key, res.lr_scale(),
+                                with_ok=True,
+                            )
+                        else:
+                            state, loss = self.train_step(state, batch, key)
+                            ok = None
+                        losses.append(loss)
+                        step += 1
+                        batch_index += 1
+                        if res is not None:
+                            state = res.after_step(
+                                state, ok,
+                                ResumeCursor(epoch, batch_index, step),
+                            )
+                finally:
+                    stream.close()
+                epoch_seconds = time.perf_counter() - t0
+                record = {
+                    "epoch": epoch,
+                    # guarded runs exclude skipped steps' poisoned losses
+                    # from the epoch aggregate (see GraphTrainer.fit)
+                    "train_loss": (
+                        (finite_mean(jax.device_get(losses)) if guard
+                         else float(np.mean(jax.device_get(losses))))
+                        if losses else float("nan")
+                    ),
+                    "epoch_seconds": epoch_seconds,
+                    # same stage attribution as GraphTrainer.fit
+                    "host_load_seconds": round(stats.load_seconds, 3),
+                    "host_pack_seconds": round(stats.pack_seconds, 3),
+                    "host_place_seconds": round(stats.place_seconds, 3),
+                    "input_wait_seconds": round(stats.wait_seconds, 3),
+                    "input_wait_fraction": round(
+                        stats.wait_fraction(epoch_seconds), 4
+                    ),
+                }
+                if res is not None:
+                    # self-healing observables (docs/resilience.md)
+                    record.update(res.record())
+                if stats.padded_tokens:
+                    # sequence-bucketing observables (docs/input_pipeline.md):
+                    # REAL-token throughput is shape-invariant, so it compares
+                    # across bucket layouts where examples/sec cannot
+                    record.update(
+                        train_examples_per_sec=round(
+                            stats.rows / epoch_seconds, 2
+                        ) if epoch_seconds else None,
+                        train_tokens_per_sec=round(
+                            stats.real_tokens / epoch_seconds, 1
+                        ) if epoch_seconds else None,
+                        real_tokens=stats.real_tokens,
+                        padded_tokens=stats.padded_tokens,
+                        padding_waste=round(stats.padding_waste(), 4),
+                    )
+                # cumulative per-signature compile/step attribution for the
+                # bounded step cache; RunLogger flattens the nested dict into
+                # `step_signatures/<sig>/<counter>` TensorBoard scalars
+                record["step_signatures"] = {
+                    k: dict(v) for k, v in self.signature_stats.items()
+                }
+                record["jit_lowerings"] = self.jit_lowerings()
+                if val_batches is not None:
+                    if res is not None:
+                        # epoch-end stages run under the watchdog's grace
+                        # threshold, not the per-step timeout
+                        res.heartbeat("eval", epoch=epoch)
+                    val_metrics, _ = self.evaluate(state, val_batches())
+                    record.update({f"val_{k}": v for k, v in val_metrics.items()})
+                # mirror GraphTrainer.fit: without a val split, still persist on
+                # the periodic cadence and on the final epoch, so a val-less run
+                # never trains to completion and saves nothing
+                if checkpoints is not None and (
+                    any(k.startswith("val_") for k in record)
+                    or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
+                    or epoch == max_epochs - 1
+                ):
+                    if res is not None:
+                        res.heartbeat("checkpoint", epoch=epoch)
+                    checkpoints.save(
+                        f"epoch-{epoch:04d}",
+                        jax.device_get(state.params),
+                        {
+                            k: float(v)
+                            for k, v in record.items()
+                            if isinstance(v, (int, float)) and k != "epoch"
+                        },
+                        step=step,
+                    )
+                logger.info("epoch %d: %s", epoch, record)
+                if log_fn is not None:
+                    log_fn(record)
+            if res is not None:
+                state = res.finish(state, ResumeCursor(max_epochs, 0, step))
         return state

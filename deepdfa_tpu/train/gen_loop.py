@@ -58,7 +58,10 @@ class GenTrainer:
     def make_checkpoints(self, directory, monitor="val_ppl", mode="min"):
         from deepdfa_tpu.train.checkpoint import CheckpointManager
 
-        return CheckpointManager(directory, monitor=monitor, mode=mode)
+        return CheckpointManager(
+            directory, monitor=monitor, mode=mode,
+            keep_last=getattr(self.cfg.train, "checkpoint_keep_last", 0),
+        )
 
     def init_state(self, seed: int | None = None) -> TrainState:
         seed = self.cfg.train.seed if seed is None else seed
@@ -136,6 +139,15 @@ class GenTrainer:
                 loss,
             )
 
+        @partial(jax.jit, donate_argnums=0)
+        def train_step_guarded(state: TrainState, batch: GenBatch, key, lr_scale):
+            """Divergence-guarded step: the shared on-device skip/select
+            core lives in train/resilience.py:apply_guarded_update."""
+            from deepdfa_tpu.train.resilience import apply_guarded_update
+
+            loss, grads = _sharded_grads(state.params, batch, key)
+            return apply_guarded_update(self.tx, state, loss, grads, lr_scale)
+
         @partial(
             shard_map,
             mesh=mesh,
@@ -162,6 +174,7 @@ class GenTrainer:
             )
 
         self.train_step = train_step
+        self.train_step_guarded = train_step_guarded
         self.eval_step = eval_step
         self._decode_step = decode_step
 
@@ -252,82 +265,160 @@ class GenTrainer:
         patience: int | None = None,
         log_fn: Callable[[dict], None] | None = None,
         seed: int = 0,
+        resilience=None,
     ) -> TrainState:
         """val_decode: (source_ids, target token lists) for dev BLEU/EM.
 
         Early stopping mirrors run_gen.py:398-405: stop when the ppl
         no-decrease counter AND the bleu no-increase counter both exceed
         `patience` (bleu counter starts "infinite" when BLEU eval is off).
+
+        resilience: an optional train/resilience.py ResilientRunner —
+        step-granular checkpoint/resume, divergence guard, preemption
+        handling, watchdog. A mid-epoch resume restores the exact
+        TrainState and fast-forwards the (deterministically shuffled)
+        batch stream; the best-ppl/bleu early-stop counters restart at
+        the resumed epoch (they are derived, not part of the state).
         """
+        import contextlib
+
+        from deepdfa_tpu.train.resilience import (
+            ResumeCursor,
+            finite_mean,
+            place_like,
+            skip_first,
+        )
+
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         patience = patience if patience is not None else getattr(
             tcfg, "early_stop_patience", 0
         )
         root = jax.random.key(seed)
-        step = int(jax.device_get(state.step))
+        res = resilience
+        guard = res is not None and res.guard_active
+        start_epoch = skip_batches = 0
+        cursor = None
+        if res is not None:
+            state, cursor = res.maybe_resume(state, place_like(state))
+            if cursor is not None:
+                start_epoch, skip_batches = cursor.epoch, cursor.batch_index
+        # on resume the loop step comes from the DATA cursor, not
+        # state.step: guard-skipped steps leave state.step behind the
+        # host count the cursor (and RNG folding) was aligned to
+        step = (
+            cursor.step if cursor is not None
+            else int(jax.device_get(state.step))
+        )
         best_ppl, best_bleu_em = float("inf"), -1.0
         not_ppl_dec = 0
         not_bleu_inc = 0 if val_decode is not None else float("inf")
-        for epoch in range(max_epochs):
-            t0 = time.perf_counter()
-            losses = []
-            for batch in train_batches(epoch):
-                key = jax.random.fold_in(root, step)
-                state, loss = self.train_step(state, batch, key)
-                losses.append(loss)
-                step += 1
-            record = {
-                "epoch": epoch,
-                "train_loss": float(np.mean(jax.device_get(losses)))
-                if losses
-                else float("nan"),
-                "epoch_seconds": time.perf_counter() - t0,
-            }
-            if val_batches is not None:
-                ppl = self.eval_ppl(state, val_batches())
-                record["val_ppl"] = ppl
-                if ppl < best_ppl:
-                    best_ppl, not_ppl_dec = ppl, 0
-                    if checkpoints is not None:
-                        checkpoints.save(
-                            f"epoch-{epoch:04d}",
-                            jax.device_get(state.params),
-                            {"val_ppl": ppl},
-                            step=step,
+        cm = res if res is not None else contextlib.nullcontext()
+        with cm:
+            for epoch in range(start_epoch, max_epochs):
+                t0 = time.perf_counter()
+                losses = []
+                source = train_batches(epoch)
+                batch_index = 0
+                if epoch == start_epoch and skip_batches:
+                    # deterministic fast-forward (shuffle is seeded by
+                    # epoch) on the raw source, with a beat per skipped
+                    # pull — a cold fast-forward can outlast the
+                    # watchdog's first-step grace
+                    source = skip_first(
+                        source, skip_batches,
+                        heartbeat=lambda: res.heartbeat(
+                            "input", epoch=epoch, step=step
+                        ),
+                    )
+                    batch_index = skip_batches
+                it = iter(source)
+                while True:
+                    if res is not None:
+                        res.heartbeat("input", epoch=epoch, step=step)
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    if res is not None:
+                        res.heartbeat("device", epoch=epoch, step=step)
+                    key = jax.random.fold_in(root, step)
+                    if guard:
+                        state, loss, ok = self.train_step_guarded(
+                            state, batch, key, res.lr_scale()
                         )
-                else:
-                    not_ppl_dec += 1
-            elif checkpoints is not None and (
-                (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
-                or epoch == max_epochs - 1
-            ):
-                checkpoints.save(
-                    f"epoch-{epoch:04d}", jax.device_get(state.params), {},
-                    step=step,
-                )
-            if val_decode is not None:
-                src, refs = val_decode
-                bleu = self.eval_bleu_em(state, src, refs)
-                record.update({f"val_{k}": v for k, v in bleu.items()})
-                if bleu["bleu_em"] > best_bleu_em:
-                    best_bleu_em, not_bleu_inc = bleu["bleu_em"], 0
-                    if bleu_checkpoints is not None:
-                        bleu_checkpoints.save(
-                            f"epoch-{epoch:04d}",
-                            jax.device_get(state.params),
-                            {"val_bleu_em": bleu["bleu_em"]},
-                            step=step,
+                    else:
+                        state, loss = self.train_step(state, batch, key)
+                        ok = None
+                    losses.append(loss)
+                    step += 1
+                    batch_index += 1
+                    if res is not None:
+                        state = res.after_step(
+                            state, ok, ResumeCursor(epoch, batch_index, step)
                         )
-                else:
-                    not_bleu_inc += 1
-            logger.info("epoch %d: %s", epoch, record)
-            if log_fn is not None:
-                log_fn(record)
-            if patience and not_ppl_dec > patience and not_bleu_inc > patience:
-                logger.info(
-                    "early stop: ppl counter %d, bleu counter %s > patience %d",
-                    not_ppl_dec, not_bleu_inc, patience,
-                )
-                break
+                record = {
+                    "epoch": epoch,
+                    # guarded runs exclude skipped steps' poisoned losses
+                    # from the epoch aggregate (see GraphTrainer.fit)
+                    "train_loss": (
+                        (finite_mean(jax.device_get(losses)) if guard
+                         else float(np.mean(jax.device_get(losses))))
+                        if losses else float("nan")
+                    ),
+                    "epoch_seconds": time.perf_counter() - t0,
+                }
+                if res is not None:
+                    record.update(res.record())
+                    # epoch-end stages (ppl eval, BLEU decode, orbax
+                    # saves) run under the watchdog's grace threshold
+                    res.heartbeat("eval", epoch=epoch)
+                if val_batches is not None:
+                    ppl = self.eval_ppl(state, val_batches())
+                    record["val_ppl"] = ppl
+                    if ppl < best_ppl:
+                        best_ppl, not_ppl_dec = ppl, 0
+                        if checkpoints is not None:
+                            checkpoints.save(
+                                f"epoch-{epoch:04d}",
+                                jax.device_get(state.params),
+                                {"val_ppl": ppl},
+                                step=step,
+                            )
+                    else:
+                        not_ppl_dec += 1
+                elif checkpoints is not None and (
+                    (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
+                    or epoch == max_epochs - 1
+                ):
+                    checkpoints.save(
+                        f"epoch-{epoch:04d}", jax.device_get(state.params), {},
+                        step=step,
+                    )
+                if val_decode is not None:
+                    src, refs = val_decode
+                    bleu = self.eval_bleu_em(state, src, refs)
+                    record.update({f"val_{k}": v for k, v in bleu.items()})
+                    if bleu["bleu_em"] > best_bleu_em:
+                        best_bleu_em, not_bleu_inc = bleu["bleu_em"], 0
+                        if bleu_checkpoints is not None:
+                            bleu_checkpoints.save(
+                                f"epoch-{epoch:04d}",
+                                jax.device_get(state.params),
+                                {"val_bleu_em": bleu["bleu_em"]},
+                                step=step,
+                            )
+                    else:
+                        not_bleu_inc += 1
+                logger.info("epoch %d: %s", epoch, record)
+                if log_fn is not None:
+                    log_fn(record)
+                if patience and not_ppl_dec > patience and not_bleu_inc > patience:
+                    logger.info(
+                        "early stop: ppl counter %d, bleu counter %s > patience %d",
+                        not_ppl_dec, not_bleu_inc, patience,
+                    )
+                    break
+            if res is not None:
+                state = res.finish(state, ResumeCursor(max_epochs, 0, step))
         return state

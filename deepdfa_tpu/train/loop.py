@@ -135,6 +135,7 @@ class GraphTrainer:
             directory,
             monitor=self.cfg.train.monitor,
             mode=self.cfg.train.monitor_mode,
+            keep_last=getattr(self.cfg.train, "checkpoint_keep_last", 0),
         )
 
     def _labels_mask(self, batch: GraphBatch):
@@ -204,6 +205,15 @@ class GraphTrainer:
                 loss,
             )
 
+        @partial(jax.jit, donate_argnums=0)
+        def train_step_guarded(state: TrainState, batch: GraphBatch, lr_scale):
+            """Divergence-guarded step: the shared on-device skip/select
+            core lives in train/resilience.py:apply_guarded_update."""
+            from deepdfa_tpu.train.resilience import apply_guarded_update
+
+            loss, grads = _sharded_grads(state.params, batch, state.step)
+            return apply_guarded_update(self.tx, state, loss, grads, lr_scale)
+
         @partial(
             shard_map,
             mesh=mesh,
@@ -224,6 +234,7 @@ class GraphTrainer:
             return _sharded_eval(params, batch)
 
         self.train_step = train_step
+        self.train_step_guarded = train_step_guarded
         self.eval_step = eval_step
 
     # -- loops ---------------------------------------------------------------
@@ -256,75 +267,166 @@ class GraphTrainer:
         max_epochs: int | None = None,
         log_fn: Callable[[dict], None] | None = None,
         source_stage: str = "pack",
+        resilience=None,
     ) -> TrainState:
+        import contextlib
+
         from deepdfa_tpu.data.prefetch import (
             PipelineStats,
             device_placer,
             prefetch,
         )
+        from deepdfa_tpu.train.resilience import (
+            ResumeCursor,
+            finite_mean,
+            place_like,
+            skip_first,
+        )
 
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
-        step = int(jax.device_get(state.step))
+        res = resilience
+        guard = res is not None and res.guard_active
+        start_epoch = skip_batches = 0
+        cursor = None
+        if res is not None:
+            state, cursor = res.maybe_resume(state, place_like(state))
+            if cursor is not None:
+                start_epoch, skip_batches = cursor.epoch, cursor.batch_index
+        # on resume the loop step comes from the DATA cursor, not
+        # state.step: guard-skipped steps and rollbacks leave state.step
+        # behind the host count, and the cursor is what batch_index, RNG
+        # folding, and checkpoint tags were aligned to pre-kill
+        step = (
+            cursor.step if cursor is not None
+            else int(jax.device_get(state.step))
+        )
         placer = device_placer(self.mesh)
-        for epoch in range(max_epochs):
-            t0 = time.perf_counter()
-            losses = []
-            stats = PipelineStats()
-            source = train_batches(epoch)
-            # a source may know better than the static default which
-            # stage its pulls are (cli _BatchStream: "load" on a warm
-            # cache epoch, "pack" on a cold one)
-            stage = getattr(source, "source_stage", source_stage)
-            for batch in prefetch(
-                source, tcfg.prefetch_batches, placer,
-                producers=tcfg.prefetch_producers,
-                stats=stats, source_stage=stage,
-            ):
-                state, loss = self.train_step(state, batch)
-                losses.append(loss)
-                step += 1
-                if log_fn is not None and step % max(1, tcfg.log_every_steps) == 0:
-                    log_fn({"step": step, "loss": float(jax.device_get(loss))})
-            train_loss = float(np.mean(jax.device_get(losses))) if losses else float("nan")
-            epoch_seconds = time.perf_counter() - t0
-            record = {
-                "epoch": epoch,
-                "train_loss": train_loss,
-                "epoch_seconds": epoch_seconds,
-                # host-side stage attribution (docs/input_pipeline.md):
-                # pack/load = source assembly, place = H2D, wait = the
-                # fraction of the epoch the device sat input-starved
-                "host_load_seconds": round(stats.load_seconds, 3),
-                "host_pack_seconds": round(stats.pack_seconds, 3),
-                "host_place_seconds": round(stats.place_seconds, 3),
-                "input_wait_seconds": round(stats.wait_seconds, 3),
-                "input_wait_fraction": round(
-                    stats.wait_fraction(epoch_seconds), 4
-                ),
-            }
-            if val_batches is not None and (
-                (epoch + 1) % tcfg.eval_every_epochs == 0
-                or epoch == max_epochs - 1
-            ):
-                val_metrics, _ = self.evaluate(state, val_batches())
-                record.update({f"val_{k}": v for k, v in val_metrics.items()})
-            if checkpoints is not None and (
-                any(k.startswith("val_") for k in record)
-                or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
-                or epoch == max_epochs - 1
-            ):
-                checkpoints.save(
-                    f"epoch-{epoch:04d}",
-                    jax.device_get(state.params),
-                    {
-                        k: float(v)
-                        for k, v in record.items()
-                        if k != "epoch" and isinstance(v, (int, float))
-                    },
-                    step=step,
+        cm = res if res is not None else contextlib.nullcontext()
+        with cm:
+            for epoch in range(start_epoch, max_epochs):
+                t0 = time.perf_counter()
+                losses = []
+                stats = PipelineStats()
+                if res is not None:
+                    res.attach_stats(stats)
+                source = train_batches(epoch)
+                # a source may know better than the static default which
+                # stage its pulls are (cli _BatchStream: "load" on a warm
+                # cache epoch, "pack" on a cold one)
+                stage = getattr(source, "source_stage", source_stage)
+                batch_index = 0
+                if epoch == start_epoch and skip_batches:
+                    # deterministic fast-forward: the stream is a pure
+                    # function of (epoch, seed, digest), so dropping the
+                    # batches the resumed checkpoint already consumed —
+                    # BEFORE the prefetch pipeline, so they are never
+                    # device_put or stats-counted — re-aligns data with
+                    # the restored state
+                    source = skip_first(
+                        source, skip_batches,
+                        heartbeat=lambda: res.heartbeat(
+                            "input", epoch=epoch, step=step
+                        ),
+                    )
+                    batch_index = skip_batches
+                stream = prefetch(
+                    source, tcfg.prefetch_batches, placer,
+                    producers=tcfg.prefetch_producers,
+                    stats=stats, source_stage=stage,
                 )
-            logger.info("epoch %d: %s", epoch, record)
-            if log_fn is not None:
-                log_fn(record)
+                try:
+                    it = iter(stream)
+                    while True:
+                        if res is not None:
+                            res.heartbeat("input", epoch=epoch, step=step)
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            break
+                        if res is not None:
+                            res.heartbeat("device", epoch=epoch, step=step)
+                        if guard:
+                            state, loss, ok = self.train_step_guarded(
+                                state, batch, res.lr_scale()
+                            )
+                        else:
+                            state, loss = self.train_step(state, batch)
+                            ok = None
+                        losses.append(loss)
+                        step += 1
+                        batch_index += 1
+                        if log_fn is not None and step % max(1, tcfg.log_every_steps) == 0:
+                            log_fn({"step": step, "loss": float(jax.device_get(loss))})
+                        # after the step's own logging: a preemption here
+                        # raises, and the step it finished stays logged
+                        if res is not None:
+                            state = res.after_step(
+                                state, ok,
+                                ResumeCursor(epoch, batch_index, step),
+                            )
+                finally:
+                    stream.close()  # joins prefetch producers on any exit
+                # guarded runs: skipped steps carry the poisoned loss —
+                # exclude non-finite values so a survived epoch does not
+                # aggregate to NaN (skips stay visible via skipped_steps)
+                train_loss = (
+                    (finite_mean(jax.device_get(losses)) if guard
+                     else float(np.mean(jax.device_get(losses))))
+                    if losses else float("nan")
+                )
+                epoch_seconds = time.perf_counter() - t0
+                record = {
+                    "epoch": epoch,
+                    "train_loss": train_loss,
+                    "epoch_seconds": epoch_seconds,
+                    # host-side stage attribution (docs/input_pipeline.md):
+                    # pack/load = source assembly, place = H2D, wait = the
+                    # fraction of the epoch the device sat input-starved
+                    "host_load_seconds": round(stats.load_seconds, 3),
+                    "host_pack_seconds": round(stats.pack_seconds, 3),
+                    "host_place_seconds": round(stats.place_seconds, 3),
+                    "input_wait_seconds": round(stats.wait_seconds, 3),
+                    "input_wait_fraction": round(
+                        stats.wait_fraction(epoch_seconds), 4
+                    ),
+                }
+                if res is not None:
+                    # self-healing observables (docs/resilience.md):
+                    # resumed_from_step / skipped_steps / rollbacks
+                    record.update(res.record())
+                if val_batches is not None and (
+                    (epoch + 1) % tcfg.eval_every_epochs == 0
+                    or epoch == max_epochs - 1
+                ):
+                    if res is not None:
+                        # epoch-end stages run under the watchdog's grace
+                        # threshold, not the per-step timeout
+                        res.heartbeat("eval", epoch=epoch)
+                    val_metrics, _ = self.evaluate(state, val_batches())
+                    record.update({f"val_{k}": v for k, v in val_metrics.items()})
+                if checkpoints is not None and (
+                    any(k.startswith("val_") for k in record)
+                    or (epoch + 1) % max(1, tcfg.checkpoint_every_epochs) == 0
+                    or epoch == max_epochs - 1
+                ):
+                    if res is not None:
+                        res.heartbeat("checkpoint", epoch=epoch)
+                    checkpoints.save(
+                        f"epoch-{epoch:04d}",
+                        jax.device_get(state.params),
+                        {
+                            k: float(v)
+                            for k, v in record.items()
+                            if k != "epoch" and isinstance(v, (int, float))
+                        },
+                        step=step,
+                    )
+                logger.info("epoch %d: %s", epoch, record)
+                if log_fn is not None:
+                    log_fn(record)
+            if res is not None:
+                # drain lagged guard flags + leave a final resume point
+                # (a completed run re-invoked with auto-resume is a no-op)
+                state = res.finish(state, ResumeCursor(max_epochs, 0, step))
         return state

@@ -1,0 +1,767 @@
+"""Preemption-safe, self-healing training runtime.
+
+The reference leaned on PyTorch Lightning's checkpoint/resume machinery
+(DDFA/code_gnn/main_cli.py, periodic_checkpoint.py) and restarted from
+epoch boundaries; large-scale GNN trainers (Morphling, DGL — PAPERS.md)
+treat restartability and stall detection as first-class runtime
+requirements. This module is that runtime for all three train loops
+(train/loop.py, train/combined_loop.py, train/gen_loop.py):
+
+- **StepCheckpointer** — step-granular atomic checkpoints of the FULL
+  TrainState (params + optimizer + LR-schedule step) plus a resume
+  manifest carrying the data-pipeline cursor (epoch index, batch-plan
+  position, global step, seed). Manifests are written tmp+fsync+rename
+  (core/ioutil.py) and a sidecar cursor file per checkpoint lets a
+  corrupt manifest be rebuilt from what is actually on disk.
+- **PreemptionHandler** — SIGTERM/SIGINT set a flag; the loop finishes
+  the in-flight step, checkpoints, and raises `Preempted`, which the CLI
+  turns into a clean exit (EXIT_PREEMPTED) with the manifest printed.
+- **divergence guard** (host half; the device half lives in each loop's
+  `train_step_guarded`) — the jitted step computes loss/grad-norm
+  finiteness ON DEVICE and skips poisoned updates via a select, so
+  params and optimizer state never ingest a NaN; the host fetches the
+  per-step ok flag `guard_lag` steps late (no sync on the happy path),
+  counts skips, and after `max_consecutive_bad` consecutive bad steps
+  rolls back to the last-good step checkpoint with an LR cool-down,
+  bounded by `rollback_budget`.
+- **Watchdog** — a daemon thread fed by loop heartbeats; when no beat
+  lands for `watchdog_timeout_s`, it writes a stage-attributed
+  diagnostic (input vs device, plus a PipelineStats snapshot) and aborts
+  instead of hanging forever.
+
+Resume semantics: batch streams are pure functions of (epoch, seed, data
+digest) — the loops fast-forward the stream past the consumed batches,
+restore the exact TrainState, and the step-loss trajectory continues
+bit-identically with the uninterrupted run (tests/test_resilience.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Callable
+
+from deepdfa_tpu.core.config import ResilienceConfig
+from deepdfa_tpu.core.ioutil import atomic_write_text, with_retries
+
+logger = logging.getLogger(__name__)
+
+#: process exit codes: 128+SIGTERM for a clean preemption exit (what a
+#: scheduler that sent the signal expects), and a distinct code for a
+#: watchdog abort so wrappers can tell "hung" from "killed"
+EXIT_PREEMPTED = 143
+EXIT_WATCHDOG = 113
+
+
+class Preempted(RuntimeError):
+    """A preemption signal arrived; the in-flight step was finished and
+    (when a checkpointer is attached) the state + resume manifest were
+    written before this was raised."""
+
+    def __init__(self, message: str, manifest: Path | None = None):
+        super().__init__(message)
+        self.manifest = manifest
+
+
+class DivergenceError(RuntimeError):
+    """The divergence guard exhausted its rollback budget."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ResumeCursor:
+    """Data-pipeline position a checkpoint corresponds to: the batch
+    stream for `epoch` has had `batch_index` batches consumed, and the
+    optimizer has taken `step` global steps."""
+
+    epoch: int
+    batch_index: int
+    step: int
+
+
+# ---------------------------------------------------------------------------
+# preemption
+
+
+class PreemptionHandler:
+    """Installs SIGTERM/SIGINT handlers that set a flag (the loop polls
+    it after each step). A SECOND signal restores the previous handlers
+    and re-raises, so an operator's double Ctrl-C still kills a run whose
+    checkpoint write wedged. Signal handlers are process-global and only
+    installable from the main thread; elsewhere this degrades to a
+    flag that `trigger()` (the fault harness) can still set."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._previous: dict[int, Any] = {}
+        self._triggered = threading.Event()
+        self._installed = False
+
+    @property
+    def triggered(self) -> bool:
+        return self._triggered.is_set()
+
+    def trigger(self) -> None:
+        self._triggered.set()
+
+    def _handle(self, signum, frame) -> None:
+        if self._triggered.is_set():
+            # second signal: get out of the way and re-deliver
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        logger.warning(
+            "received %s: finishing the in-flight step, then "
+            "checkpointing and exiting cleanly",
+            signal.Signals(signum).name,
+        )
+        self._triggered.set()
+
+    def install(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "preemption handler not installed (not the main thread); "
+                "only injected triggers will be observed"
+            )
+            return self
+        for s in self._signals:
+            self._previous[s] = signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):  # not main thread / shutdown
+                pass
+        self._previous.clear()
+        self._installed = False
+
+
+# ---------------------------------------------------------------------------
+# step-granular checkpoints
+
+
+class StepCheckpointer:
+    """Atomic step-granular TrainState checkpoints + resume manifest.
+
+    Layout:
+
+        <directory>/step-00000042/          orbax pytree (full TrainState)
+        <directory>/step-00000042.cursor.json  sidecar written AFTER the
+                                               orbax save completes
+        <directory>/resume.json             newest complete checkpoint
+
+    The sidecar is the completeness marker: it is written atomically
+    after `wait_until_finished`, so a crash mid-save leaves a dir with no
+    sidecar, which `latest()`/retention treat as garbage. A corrupt
+    `resume.json` is rebuilt from the sidecars actually on disk.
+    """
+
+    def __init__(self, directory: str | Path, keep_last: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = Path(directory).resolve()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep_last = max(1, int(keep_last))
+        self._ckpt = ocp.StandardCheckpointer()
+
+    # -- write ---------------------------------------------------------------
+
+    @staticmethod
+    def _tag(step: int) -> str:
+        return f"step-{step:08d}"
+
+    def save(self, host_state: Any, cursor: ResumeCursor, seed: int = 0,
+             reason: str = "periodic", extra: dict | None = None) -> Path:
+        """Persist a host-side TrainState pytree at `cursor`. Returns the
+        resume-manifest path. Idempotent per step (force-overwrites).
+        `extra` rides along in the manifest (the runner stores its guard
+        state there so cool-downs/budgets survive a preemption)."""
+        tag = self._tag(cursor.step)
+        self._ckpt.save(self.directory / tag, host_state, force=True)
+        self._ckpt.wait_until_finished()
+        manifest = {
+            "tag": tag,
+            "step": int(cursor.step),
+            "epoch": int(cursor.epoch),
+            "batch_index": int(cursor.batch_index),
+            "seed": int(seed),
+            "reason": reason,
+            "wall_time": time.time(),
+            **(extra or {}),
+        }
+        payload = json.dumps(manifest, indent=2)
+        atomic_write_text(self.directory / f"{tag}.cursor.json", payload)
+        atomic_write_text(self.directory / "resume.json", payload)
+        self._retain()
+        return self.directory / "resume.json"
+
+    def _retain(self) -> None:
+        complete = sorted(
+            p.name[: -len(".cursor.json")]
+            for p in self.directory.glob("step-*.cursor.json")
+        )
+        for tag in complete[: -self.keep_last]:
+            shutil.rmtree(self.directory / tag, ignore_errors=True)
+            (self.directory / f"{tag}.cursor.json").unlink(missing_ok=True)
+        # a dir without a sidecar is an interrupted save: collect it
+        # unless it is the newest (a save may be in flight elsewhere)
+        dirs = sorted(p.name for p in self.directory.glob("step-*")
+                      if p.is_dir())
+        for tag in dirs[:-1]:
+            if not (self.directory / f"{tag}.cursor.json").exists():
+                shutil.rmtree(self.directory / tag, ignore_errors=True)
+
+    # -- read ----------------------------------------------------------------
+
+    def latest(self) -> dict | None:
+        """The newest complete checkpoint's manifest, or None. Tolerates
+        a corrupt/missing resume.json by rebuilding from the sidecars."""
+        path = self.directory / "resume.json"
+        if path.exists():
+            try:
+                m = json.loads(path.read_text())
+                if (self.directory / m["tag"]).is_dir():
+                    return m
+                logger.warning(
+                    "resume.json points at missing checkpoint %s; "
+                    "rebuilding from on-disk sidecars", m.get("tag"),
+                )
+            except (json.JSONDecodeError, KeyError, OSError) as e:
+                logger.warning(
+                    "corrupt resume.json (%s: %s); rebuilding from "
+                    "on-disk sidecars", type(e).__name__, e,
+                )
+        best = None
+        for sc in self.directory.glob("step-*.cursor.json"):
+            try:
+                m = json.loads(sc.read_text())
+            except (json.JSONDecodeError, OSError):
+                continue
+            if not (self.directory / m.get("tag", "")).is_dir():
+                continue
+            if best is None or m["step"] > best["step"]:
+                best = m
+        if best is not None:
+            atomic_write_text(
+                self.directory / "resume.json", json.dumps(best, indent=2)
+            )
+        return best
+
+    def restore(self, manifest: dict, target: Any) -> Any:
+        """Restore the checkpoint named by `manifest` into the structure
+        of `target` (a concrete host pytree, e.g. device_get of a
+        freshly initialized state)."""
+        return self._ckpt.restore(self.directory / manifest["tag"],
+                                  target=target)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+
+
+class Watchdog:
+    """Detects a silent train loop: the loop beats before every stage
+    transition (input pull, device step); when no beat lands within
+    `timeout_s`, the watchdog writes a stage-attributed diagnostic and
+    invokes `on_stall` (default: hard process abort — a hung device step
+    cannot be unwound from a thread)."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_stall: Callable[[dict], None] | None = None,
+        diagnostic_path: str | Path | None = None,
+        poll_s: float | None = None,
+        first_step_grace_s: float | None = None,
+    ):
+        """first_step_grace_s: stall threshold until the FIRST completed
+        step (`step_done()`): the first step legitimately includes jit
+        compilation — minutes on a TPU with a remote compile service —
+        which a steady-state timeout would misread as a device hang.
+        None/0 = 10x timeout_s."""
+        self.timeout_s = float(timeout_s)
+        self.first_step_grace_s = (
+            float(first_step_grace_s)
+            if first_step_grace_s
+            else 10.0 * self.timeout_s
+        )
+        self.on_stall = on_stall if on_stall is not None else self._abort
+        self.diagnostic_path = (
+            Path(diagnostic_path) if diagnostic_path else None
+        )
+        self.poll_s = poll_s if poll_s is not None else min(
+            1.0, max(0.05, self.timeout_s / 4)
+        )
+        self._lock = threading.Lock()
+        self._last = time.monotonic()
+        self._stage = "start"
+        self._ctx: dict = {}
+        self._stats = None  # optional PipelineStats for the diagnostic
+        self._stepped = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.fired = False
+
+    def beat(self, stage: str, **ctx) -> None:
+        with self._lock:
+            self._last = time.monotonic()
+            self._stage = stage
+            if ctx:
+                self._ctx = ctx
+
+    def step_done(self) -> None:
+        """A full train step completed: compiles are behind us, drop to
+        the steady-state stall threshold."""
+        self._stepped = True
+
+    #: stages the steady-state timeout applies to — the in-loop batch
+    #: pull and step dispatch. Anything else the loops announce (eval,
+    #: checkpoint, epoch-end work) is legitimately long and bounded by
+    #: the grace threshold instead, so a minutes-long BLEU decode or an
+    #: orbax commit is not misread as a stall.
+    STEADY_STAGES = frozenset({"input", "device"})
+
+    def attach_stats(self, stats) -> None:
+        self._stats = stats
+
+    def start(self) -> "Watchdog":
+        self.beat("start")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="train-watchdog"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                elapsed = time.monotonic() - self._last
+                stage, ctx = self._stage, dict(self._ctx)
+                threshold = (
+                    self.timeout_s
+                    if self._stepped and stage in self.STEADY_STAGES
+                    else self.first_step_grace_s
+                )
+            if elapsed <= threshold:
+                continue
+            self.fired = True
+            diag = self._diagnostic(stage, elapsed, ctx)
+            logger.critical("watchdog: %s", json.dumps(diag))
+            if self.diagnostic_path is not None:
+                try:
+                    atomic_write_text(
+                        self.diagnostic_path, json.dumps(diag, indent=2)
+                    )
+                except OSError:
+                    pass
+            self.on_stall(diag)
+            return
+
+    def _diagnostic(self, stage: str, elapsed: float, ctx: dict) -> dict:
+        # stage attribution: "input" = the consumer was pulling the next
+        # batch when it went silent (stalled producer / source), "device"
+        # = it was inside a train-step dispatch or a result fetch (hung
+        # device step or collective)
+        diag = {
+            "event": "train_stall",
+            "stalled_stage": stage,
+            "seconds_since_heartbeat": round(elapsed, 1),
+            "timeout_s": self.timeout_s,
+            **ctx,
+        }
+        stats = self._stats
+        if stats is not None:
+            try:
+                diag["pipeline"] = stats.record()
+            except Exception:  # diagnostics must never mask the stall
+                pass
+        return diag
+
+    @staticmethod
+    def _abort(diag: dict) -> None:
+        # flush what we can, then leave: a hung XLA call cannot be
+        # interrupted from a thread, so a hard exit is the only way to
+        # return the machine to the scheduler
+        print(f"FATAL train stall: {json.dumps(diag)}", flush=True)
+        os._exit(EXIT_WATCHDOG)
+
+
+# ---------------------------------------------------------------------------
+# the runner the loops talk to
+
+
+class ResilientRunner:
+    """One object the fit loops thread their steps through.
+
+    Lifecycle::
+
+        res = ResilientRunner(cfg.train.resilience, run_dir / "checkpoints-step")
+        with res:                                   # signals + watchdog
+            state, cursor = res.maybe_resume(state, place)
+            for epoch ...:
+                res.attach_stats(stats)
+                ...
+                res.heartbeat("input"); batch = next(it)
+                res.heartbeat("device")
+                state, loss, ok = train_step_guarded(state, batch, res.lr_scale())
+                state = res.after_step(state, ok, ResumeCursor(...))
+
+    `after_step` is where everything meets: guard bookkeeping (lagged ok
+    fetch, skip counting, rollback), the periodic step checkpoint, and
+    the preemption check (raises `Preempted` after saving).
+
+    The three fit loops implement this sequence by hand (their inner
+    loops differ: prefetch+placer, prefetch+place+token-accounting,
+    plain iterator) — when changing the protocol here, update all three
+    in lockstep (train/loop.py, train/combined_loop.py,
+    train/gen_loop.py).
+    """
+
+    def __init__(
+        self,
+        rcfg: ResilienceConfig,
+        directory: str | Path | None = None,
+        seed: int = 0,
+        on_stall: Callable[[dict], None] | None = None,
+    ):
+        self.rcfg = rcfg
+        self.seed = int(seed)
+        self.ckpt = (
+            StepCheckpointer(directory, keep_last=rcfg.keep_last_k)
+            if directory is not None
+            else None
+        )
+        self.guard_active = bool(rcfg.enabled and rcfg.divergence_guard)
+        self.handler = PreemptionHandler()
+        self.watchdog = (
+            Watchdog(
+                rcfg.watchdog_timeout_s,
+                on_stall=on_stall,
+                diagnostic_path=(
+                    Path(directory) / "watchdog_diagnostic.json"
+                    if directory is not None
+                    else None
+                ),
+                first_step_grace_s=getattr(
+                    rcfg, "watchdog_first_step_grace_s", 0.0
+                ),
+            )
+            if rcfg.watchdog_timeout_s > 0
+            else None
+        )
+        self._place: Callable[[Any], Any] | None = None
+        self._pending: deque[Any] = deque()  # lagged ok flags
+        self._consec_bad = 0
+        self._lr_scale = 1.0
+        # counters surfaced into epoch records / bench history
+        self.skipped_steps = 0
+        self.rollbacks = 0
+        self.resumed_from_step = 0
+
+    # -- context management ---------------------------------------------------
+
+    def __enter__(self) -> "ResilientRunner":
+        self.handler.install()
+        if self.watchdog is not None:
+            self.watchdog.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.handler.uninstall()
+
+    # -- loop surface ---------------------------------------------------------
+
+    def heartbeat(self, stage: str, **ctx) -> None:
+        if self.watchdog is not None:
+            self.watchdog.beat(stage, **ctx)
+
+    def attach_stats(self, stats) -> None:
+        if self.watchdog is not None:
+            self.watchdog.attach_stats(stats)
+
+    def lr_scale(self) -> float:
+        """Effective LR multiplier (cooled down after rollbacks)."""
+        return self._lr_scale
+
+    def maybe_resume(
+        self, state: Any, place: Callable[[Any], Any] | None = None
+    ) -> tuple[Any, ResumeCursor | None]:
+        """Restore the newest step checkpoint when auto_resume is on.
+
+        `place` re-commits a restored host pytree to devices (the loop
+        builds it from the live state's shardings); it is retained for
+        divergence rollbacks either way."""
+        import jax
+
+        self._place = place
+        if (
+            self.ckpt is None
+            or not self.rcfg.auto_resume
+            or not self.rcfg.enabled
+        ):
+            return state, None
+        manifest = self.ckpt.latest()
+        if manifest is None:
+            return state, None
+        if manifest.get("seed", self.seed) != self.seed:
+            logger.warning(
+                "resume manifest seed %s != run seed %s — refusing to "
+                "resume a different run's checkpoint",
+                manifest.get("seed"), self.seed,
+            )
+            return state, None
+        restored = self.ckpt.restore(manifest, jax.device_get(state))
+        if place is not None:
+            restored = place(restored)
+        cursor = ResumeCursor(
+            epoch=int(manifest["epoch"]),
+            batch_index=int(manifest["batch_index"]),
+            step=int(manifest["step"]),
+        )
+        self.resumed_from_step = cursor.step
+        # guard state survives the restart: a cooled-down LR stays
+        # cooled, and rollback_budget bounds rollbacks ACROSS restarts —
+        # otherwise a preempt/diverge cycle could repeat at full LR
+        # forever instead of failing loudly
+        guard = manifest.get("guard")
+        if guard:
+            self._lr_scale = float(guard.get("lr_scale", 1.0))
+            self.rollbacks = int(guard.get("rollbacks", 0))
+            self.skipped_steps = int(guard.get("skipped_steps", 0))
+        logger.info(
+            "resumed from %s at step %d (epoch %d, batch %d)",
+            manifest["tag"], cursor.step, cursor.epoch, cursor.batch_index,
+        )
+        return restored, cursor
+
+    def after_step(self, state: Any, ok: Any, cursor: ResumeCursor) -> Any:
+        """Guard bookkeeping + periodic checkpoint + preemption check.
+        Returns the (possibly rolled-back) state; raises `Preempted` after
+        a preemption checkpoint, `DivergenceError` past the budget."""
+        if self.watchdog is not None:
+            # a completed step means compiles are done: the watchdog can
+            # drop from the first-step grace to the steady-state timeout
+            self.watchdog.step_done()
+        if self.guard_active and ok is not None:
+            self._pending.append(ok)
+            if len(self._pending) > max(0, int(self.rcfg.guard_lag)):
+                state = self._consume_ok(self._pending.popleft(), state)
+        every = int(self.rcfg.step_checkpoint_every)
+        if (
+            self.ckpt is not None
+            and self.rcfg.enabled
+            and every > 0
+            and cursor.step % every == 0
+            and self._consec_bad == 0
+        ):
+            self._save(state, cursor, reason="periodic")
+        if self.handler.triggered:
+            manifest = None
+            if self.ckpt is not None:
+                # drain the lagged guard flags first so a poisoned
+                # trailing step is never enshrined as the resume point
+                while self._pending:
+                    state = self._consume_ok(self._pending.popleft(), state)
+                manifest = self._save(state, cursor, reason="preempt")
+            raise Preempted(
+                f"preempted at step {cursor.step} "
+                f"(epoch {cursor.epoch}, batch {cursor.batch_index})",
+                manifest=manifest,
+            )
+        return state
+
+    def finish(self, state: Any, cursor: ResumeCursor) -> Any:
+        """End-of-run hook: drain lagged guard flags (the last `guard_lag`
+        flags were still pending) and leave a final resume point."""
+        while self._pending:
+            state = self._consume_ok(self._pending.popleft(), state)
+        if self.ckpt is not None and self.rcfg.enabled:
+            self._save(state, cursor, reason="final")
+        return state
+
+    def record(self) -> dict:
+        """Self-healing counters for epoch records / bench history."""
+        return {
+            "resumed_from_step": self.resumed_from_step,
+            "skipped_steps": self.skipped_steps,
+            "rollbacks": self.rollbacks,
+        }
+
+    # -- internals ------------------------------------------------------------
+
+    def _save(self, state: Any, cursor: ResumeCursor, reason: str) -> Path:
+        import jax
+
+        # the save itself (device_get sync + orbax commit) can be long
+        # on big states/slow storage: announce it so the watchdog applies
+        # the grace threshold instead of the per-step timeout
+        self.heartbeat("checkpoint", step=cursor.step)
+        # device_get syncs: the in-flight step is finished before the
+        # bytes are captured (the preemption contract)
+        return self.ckpt.save(
+            jax.device_get(state), cursor, seed=self.seed, reason=reason,
+            extra={"guard": {
+                "lr_scale": self._lr_scale,
+                "rollbacks": self.rollbacks,
+                "skipped_steps": self.skipped_steps,
+            }},
+        )
+
+    def _consume_ok(self, ok: Any, state: Any) -> Any:
+        import jax
+
+        if bool(jax.device_get(ok)):
+            self._consec_bad = 0
+            return state
+        self.skipped_steps += 1
+        self._consec_bad += 1
+        logger.warning(
+            "divergence guard: non-finite loss/grad — step skipped "
+            "(%d consecutive)", self._consec_bad,
+        )
+        if self._consec_bad < int(self.rcfg.max_consecutive_bad):
+            return state
+        if self.rollbacks >= int(self.rcfg.rollback_budget):
+            raise DivergenceError(
+                f"divergence guard: {self._consec_bad} consecutive bad "
+                f"steps after {self.rollbacks} rollbacks — rollback "
+                f"budget exhausted"
+            )
+        self.rollbacks += 1
+        self._lr_scale *= float(self.rcfg.lr_cooldown)
+        self._consec_bad = 0
+        self._pending.clear()  # flags from the abandoned trajectory
+        manifest = self.ckpt.latest() if self.ckpt is not None else None
+        if manifest is None:
+            logger.warning(
+                "divergence guard: no step checkpoint to roll back to — "
+                "cooling LR to x%.3g and continuing from current params",
+                self._lr_scale,
+            )
+            return state
+        import jax
+
+        # restore can be long on big states: grace threshold, not the
+        # per-step timeout, while it runs
+        self.heartbeat("checkpoint", step=manifest["step"])
+        restored = self.ckpt.restore(manifest, jax.device_get(state))
+        if self._place is not None:
+            restored = self._place(restored)
+        logger.warning(
+            "divergence guard: rolled back to %s (step %d), LR cooled "
+            "to x%.3g (%d/%d rollbacks)",
+            manifest["tag"], manifest["step"], self._lr_scale,
+            self.rollbacks, int(self.rcfg.rollback_budget),
+        )
+        return restored
+
+
+def make_runner(
+    cfg, directory: str | Path | None
+) -> ResilientRunner | None:
+    """CLI helper: a runner when `cfg.train.resilience.enabled`, else
+    None (the loops then run the historical path untouched)."""
+    rcfg = cfg.train.resilience
+    if not rcfg.enabled:
+        return None
+    return ResilientRunner(rcfg, directory, seed=cfg.train.seed)
+
+
+def finite_mean(values) -> float:
+    """Mean over the FINITE entries only — guarded runs keep the poisoned
+    loss values of skipped steps in their per-step history (honest
+    per-step logs), but the epoch aggregate must not report NaN for an
+    epoch the runtime survived cleanly. NaN when nothing was finite."""
+    import numpy as np
+
+    a = np.asarray(values, np.float64)
+    m = np.isfinite(a)
+    return float(a[m].mean()) if m.any() else float("nan")
+
+
+def skip_first(source, n: int, heartbeat: Callable[[], None] | None = None):
+    """Drop the first `n` items of a batch source — the resume
+    fast-forward. Applied to the RAW source, before the prefetch
+    pipeline, so skipped batches are never device_put and never counted
+    in PipelineStats/token accounting; preserves the source's
+    `source_stage` hint. `heartbeat` is called once per skipped pull (a
+    cold fast-forward can outlast the watchdog's grace otherwise)."""
+
+    class _Skipped:
+        def __init__(self):
+            stage = getattr(source, "source_stage", None)
+            if stage is not None:
+                self.source_stage = stage
+
+        def __iter__(self):
+            it = iter(source)
+            for _ in range(n):
+                if heartbeat is not None:
+                    heartbeat()
+                if next(it, _SKIP_SENTINEL) is _SKIP_SENTINEL:
+                    return
+            yield from it
+
+    return _Skipped()
+
+
+_SKIP_SENTINEL = object()
+
+
+def apply_guarded_update(tx, state, loss, grads, lr_scale):
+    """Device-side core of every loop's `train_step_guarded` (traced
+    inside the loop's jit): check loss/grad-norm finiteness ON DEVICE and
+    skip a poisoned step via a select — params, optimizer state and the
+    step counter stay exactly as they were, with no host sync added on
+    the happy path (the runner fetches the returned `ok` flag lagged).
+    Grads are zeroed BEFORE tx.update so adam's mu/nu never ingest a NaN
+    even on the discarded branch; `lr_scale` is the runner's rollback
+    cool-down multiplier (a traced scalar — changing it never
+    recompiles). Returns (state, loss, ok)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepdfa_tpu.train.state import TrainState
+
+    ok = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+    safe = jax.tree.map(lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
+    updates, opt_state = tx.update(safe, state.opt_state, state.params)
+    updates = jax.tree.map(lambda u: u * lr_scale, updates)
+    params = optax.apply_updates(state.params, updates)
+    new = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+    return (
+        jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, state),
+        loss,
+        ok,
+    )
+
+
+def place_like(state):
+    """A `place` callable that re-commits a host pytree with the same
+    shardings as the live `state` (works for replicated and
+    tensor/pipeline-sharded states alike)."""
+    import jax
+
+    shardings = jax.tree.map(lambda x: x.sharding, state)
+    return lambda host: jax.device_put(host, shardings)

@@ -1,0 +1,474 @@
+#!/usr/bin/env python
+"""Fault-injection scenarios for the resilience runtime
+(train/resilience.py, docs/resilience.md).
+
+Two modes:
+
+``--smoke`` (tier-1, tests/test_fault_inject.py; well under a minute):
+one process drives the REAL runtime end-to-end — a real SIGTERM through
+the installed PreemptionHandler mid-train, checkpoint, resume, and a
+bit-identical merged loss trajectory vs an uninterrupted run; a
+packed-cache shard truncated the way a killed writer leaves it is
+detected by digest verification, quarantined, and transparently
+repacked; NaN-poisoned batches are skipped on device by the divergence
+guard with params staying finite.
+
+Default (full) mode: the same failure modes against the CLI in
+SUBPROCESSES — `python -m deepdfa_tpu.cli train` over a synthetic corpus
+in temp storage, asserting the process-level contracts: exit code 143
+(EXIT_PREEMPTED) + resume manifest on SIGTERM with auto-resume on
+re-run, survival of a truncated cache shard, skipped_steps in the epoch
+records, and the watchdog's exit 113 + stage-attributed diagnostic on a
+stalled producer. Each CLI subprocess pays ~40 s of interpreter+import
+start-up on this box, which is why the sub-minute lane is in-process.
+
+Prints one JSON verdict line; exit 0 iff every scenario passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+# ---------------------------------------------------------------------------
+# in-process scenarios (the --smoke lane)
+
+
+def _tiny_setup(n_examples: int):
+    """Tiny flagship-shaped trainer + deterministic batch stream."""
+    import jax
+
+    from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+    from deepdfa_tpu.data import flagship_corpus
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.parallel import make_mesh
+
+    specs = flagship_corpus(n_examples)
+    cfg = config_mod.apply_overrides(Config(), [
+        "model.hidden_dim=8",
+        "model.n_steps=2",
+        "train.max_epochs=2",
+        "train.prefetch_batches=0",  # exact fault step alignment
+        "train.log_every_steps=1",
+        'train.resilience={"enabled": true, "step_checkpoint_every": 2}',
+    ])
+    model = DeepDFA.from_config(cfg.model, input_dim=1002)
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+
+    def batches(_epoch):
+        return list(shard_bucket_batches(
+            specs, num_shards=1, num_graphs=4, node_budget=2048,
+            edge_budget=8192, oversized="drop",
+        ))
+
+    return cfg, model, mesh, specs, batches
+
+
+def _fit(cfg, model, mesh, batches, run_dir, injector=None):
+    """One fit through a fresh trainer + ResilientRunner; returns
+    (per-step (step, loss) list, runner, state-or-None, Preempted-or-None)."""
+    from deepdfa_tpu.models import DeepDFA  # noqa: F401  (keeps jit fresh)
+    from deepdfa_tpu.train import GraphTrainer, Preempted, ResilientRunner
+
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    state = trainer.init_state(batches(0)[0])
+    runner = ResilientRunner(
+        cfg.train.resilience, run_dir, seed=cfg.train.seed
+    )
+    steps: list[tuple[int, float]] = []
+    stream = (
+        (lambda e: injector.wrap(batches(e)))
+        if injector is not None
+        else batches
+    )
+    try:
+        state = trainer.fit(
+            state, stream,
+            log_fn=lambda r: steps.append((r["step"], r["loss"]))
+            if "loss" in r else None,
+            resilience=runner,
+        )
+        return steps, runner, state, None
+    except Preempted as p:
+        return steps, runner, None, p
+
+
+def inproc_sigterm(setup, tmp) -> dict:
+    """Real SIGTERM mid-train -> checkpoint; resume -> bit-identical
+    merged step-loss trajectory vs the uninterrupted reference."""
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    cfg, model, mesh, _, batches = setup
+    ref_dir = Path(tmp) / "ref-ckpt"
+    ref_steps, _, _, _ = _fit(cfg, model, mesh, batches, ref_dir)
+    assert len(ref_steps) >= 8, f"reference too short: {len(ref_steps)}"
+    kill_at = max(3, len(ref_steps) // 2)
+
+    run_dir = Path(tmp) / "faulted-ckpt"
+    injector = FaultInjector(FaultPlan(sigterm_at_step=kill_at))
+    first, _, _, preempted = _fit(
+        cfg, model, mesh, batches, run_dir, injector=injector
+    )
+    assert preempted is not None, "SIGTERM did not preempt the run"
+    assert (run_dir / "resume.json").exists(), "no resume manifest"
+
+    second, runner2, state, _ = _fit(cfg, model, mesh, batches, run_dir)
+    assert runner2.resumed_from_step == kill_at, (
+        runner2.resumed_from_step, kill_at,
+    )
+    merged = first + second
+    assert merged == ref_steps, (
+        f"trajectory diverged: merged[{len(merged)}] != ref[{len(ref_steps)}]"
+    )
+    return {
+        "killed_at_step": kill_at,
+        "resumed_from_step": runner2.resumed_from_step,
+        "steps_compared": len(merged),
+        "trajectory_identical": True,
+    }
+
+
+def inproc_corrupt_shard(setup, tmp) -> dict:
+    """Truncated cache shard -> digest verify -> quarantine -> repack,
+    with the recovered stream bit-identical to direct packing."""
+    import dataclasses
+
+    import numpy as np
+
+    from deepdfa_tpu.data.packed_cache import (
+        PackedBatchCache, cache_key, corpus_digest,
+    )
+    from deepdfa_tpu.testing.faults import truncate_cache_file
+
+    _, _, _, specs, batches = setup
+    root = Path(tmp) / "packed"
+    cache = PackedBatchCache(root)
+    key = cache_key({"harness": "fault-inject"}, corpus_digest(specs))
+    direct = batches(0)
+    list(cache.write_through(key, iter(direct)))
+    damaged = truncate_cache_file(root, key)
+
+    recovered = list(cache.get_or_pack(key, lambda: iter(batches(0))))
+    assert len(recovered) == len(direct)
+    for a, b in zip(recovered, direct):
+        for f in dataclasses.fields(a):
+            va, vb = getattr(a, f.name), getattr(b, f.name)
+            if f.name == "num_graphs" or va is None:
+                assert va == vb if f.name == "num_graphs" else vb is None
+                continue
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    quarantined = list((root / "quarantine").iterdir())
+    assert quarantined, "corrupt entry was not quarantined"
+    assert cache.has(key), "entry was not repacked"
+    return {
+        "damaged_file": damaged.name,
+        "quarantined_entries": len(quarantined),
+        "stream_identical_after_repack": True,
+    }
+
+
+def inproc_nan(setup, tmp) -> dict:
+    """NaN batches are skipped on device; params stay finite."""
+    import jax
+    import numpy as np
+
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    cfg, model, mesh, _, batches = setup
+    injector = FaultInjector(FaultPlan(nan_at_steps=frozenset({2, 3})))
+    _, runner, state, _ = _fit(
+        cfg, model, mesh, batches, Path(tmp) / "nan-ckpt", injector=injector
+    )
+    assert runner.skipped_steps == 2, runner.skipped_steps
+    leaves = jax.tree.leaves(jax.device_get(state.params))
+    assert all(np.isfinite(x).all() for x in leaves), "params poisoned"
+    return {"skipped_steps": runner.skipped_steps, "params_finite": True}
+
+
+def run_smoke(n_examples: int) -> dict:
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+    apply_platform_override()
+    record: dict = {"mode": "inproc", "scenarios": {}, "ok": True}
+    scenarios = {
+        "sigterm": inproc_sigterm,
+        "corrupt-shard": inproc_corrupt_shard,
+        "nan": inproc_nan,
+    }
+    with tempfile.TemporaryDirectory(prefix="fault-inject-") as tmp:
+        t0 = time.perf_counter()
+        setup = _tiny_setup(n_examples)
+        record["setup_seconds"] = round(time.perf_counter() - t0, 1)
+        for name, fn in scenarios.items():
+            t0 = time.perf_counter()
+            try:
+                out = fn(setup, tmp)
+                out["seconds"] = round(time.perf_counter() - t0, 1)
+                record["scenarios"][name] = out
+            except (AssertionError, RuntimeError) as e:
+                record["ok"] = False
+                record["scenarios"][name] = {
+                    "error": f"{type(e).__name__}: {e}"[:2000],
+                    "seconds": round(time.perf_counter() - t0, 1),
+                }
+    return record
+
+
+# ---------------------------------------------------------------------------
+# subprocess scenarios (full mode): process-level contracts
+
+#: tiny flagship-shaped config: 1-device CPU, inline input pipeline
+#: (prefetch 0 keeps fault step numbering exact), per-step logging,
+#: undersampling off (the ~6% positive rate of the synthetic corpus
+#: would shrink an undersampled epoch to a couple of batches), and the
+#: resilience runtime on with a tight checkpoint cadence
+BASE_OVERRIDES = [
+    "model.hidden_dim=8",
+    "model.n_steps=2",
+    "data.undersample=false",
+    "data.batch.graphs_per_batch=4",
+    "data.batch.node_budget=512",
+    "data.batch.edge_budget=2048",
+    "train.max_epochs=2",
+    "train.prefetch_batches=0",
+    "train.log_every_steps=1",
+    "train.eval_every_epochs=99",
+    'train.resilience={"enabled": true, "step_checkpoint_every": 2}',
+]
+
+
+def run_cli(storage, *argv, faults=None, timeout=300):
+    # deliberately NO shared XLA compile cache: a SIGTERM'd process can
+    # die mid-cache-write and this jax version will segfault
+    # deserializing the truncated entry — the harness must not inject
+    # faults into itself
+    env = dict(
+        os.environ,
+        DEEPDFA_TPU_STORAGE=str(storage),
+        DEEPDFA_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("DEEPDFA_FAULTS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    if faults:
+        env["DEEPDFA_FAULTS"] = faults
+    return subprocess.run(
+        [sys.executable, "-m", "deepdfa_tpu.cli", *argv],
+        capture_output=True, text=True, env=env, timeout=timeout,
+        cwd=str(REPO),
+    )
+
+
+def prepare_corpus(storage, n=48) -> None:
+    for argv in (
+        ("prepare", "--source", "synthetic", "--n-examples", str(n)),
+        ("extract",),
+    ):
+        res = run_cli(storage, *argv)
+        if res.returncode != 0:
+            raise RuntimeError(f"{argv[0]} failed:\n{res.stderr[-2000:]}")
+
+
+def train(storage, run_name, *extra, faults=None, timeout=300):
+    return run_cli(
+        storage, "train", *BASE_OVERRIDES, f"run_name={run_name}", *extra,
+        faults=faults, timeout=timeout,
+    )
+
+
+def read_log(storage, run_name):
+    path = Path(storage) / "runs" / run_name / "train_log.jsonl"
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def step_losses(records):
+    return [(r["step"], r["loss"]) for r in records if "loss" in r]
+
+
+def scenario_sigterm(storage) -> dict:
+    """Kill mid-epoch (exit 143 + manifest); the SAME command re-run
+    resumes and reproduces the reference trajectory bit-for-bit."""
+    from deepdfa_tpu.train.resilience import EXIT_PREEMPTED
+
+    ref = train(storage, "ref")
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = step_losses(read_log(storage, "ref"))
+    assert len(ref_losses) >= 10, f"reference too short: {len(ref_losses)}"
+    kill_at = max(3, len(ref_losses) // 2)
+
+    first = train(storage, "faulted", faults=f"sigterm@{kill_at}")
+    assert first.returncode == EXIT_PREEMPTED, (
+        f"expected exit {EXIT_PREEMPTED}, got {first.returncode}: "
+        f"{first.stderr[-2000:]}"
+    )
+    manifest = (
+        Path(storage) / "runs" / "faulted" / "checkpoints-step" / "resume.json"
+    )
+    assert manifest.exists(), "no resume manifest after preemption"
+    resumed_at = json.loads(manifest.read_text())["step"]
+
+    second = train(storage, "faulted")
+    assert second.returncode == 0, second.stderr[-2000:] or "(empty stderr)"
+    records = read_log(storage, "faulted")
+    merged = step_losses(records)
+    assert merged == ref_losses, (
+        f"trajectory diverged after resume: "
+        f"{merged[:4]}... != {ref_losses[:4]}..."
+    )
+    assert any(r.get("resumed_from_step") for r in records), (
+        "epoch records never reported resumed_from_step"
+    )
+    return {
+        "killed_at_step": kill_at,
+        "resumed_from_step": resumed_at,
+        "steps_compared": len(merged),
+        "trajectory_identical": True,
+    }
+
+
+def scenario_corrupt_shard(storage) -> dict:
+    """Truncate a warm cache entry; the next run must quarantine+repack."""
+    from deepdfa_tpu.data.packed_cache import PackedBatchCache
+    from deepdfa_tpu.testing.faults import truncate_cache_file
+
+    cache_overrides = (
+        "data.packed_cache=true",
+        "train.max_epochs=1",
+    )
+    warm = train(storage, "cache-a", *cache_overrides)
+    assert warm.returncode == 0, warm.stderr[-2000:]
+    cache_root = Path(storage) / "cache" / "bigvul" / "packed"
+    damaged = truncate_cache_file(cache_root)
+
+    rerun = train(storage, "cache-b", *cache_overrides)
+    assert rerun.returncode == 0, (
+        f"run died on the corrupt shard: {rerun.stderr[-2000:]}"
+    )
+    quarantine = cache_root / "quarantine"
+    quarantined = list(quarantine.iterdir()) if quarantine.exists() else []
+    assert quarantined, "corrupt entry was not quarantined"
+    assert PackedBatchCache(cache_root).keys(), "no rebuilt entry on disk"
+    return {
+        "damaged_file": damaged.name,
+        "quarantined_entries": len(quarantined),
+        "repacked_and_completed": True,
+    }
+
+
+def scenario_nan(storage) -> dict:
+    """Poisoned batches are skipped on device; the run self-reports."""
+    res = train(storage, "nan", faults="nan@2,nan@3")
+    assert res.returncode == 0, res.stderr[-2000:]
+    records = read_log(storage, "nan")
+    epochs = [r for r in records if "skipped_steps" in r]
+    assert epochs, "no epoch records with skipped_steps"
+    skipped = epochs[-1]["skipped_steps"]
+    assert skipped == 2, f"expected 2 skipped steps, saw {skipped}"
+    return {"skipped_steps": skipped, "completed": True}
+
+
+def scenario_stall(storage) -> dict:
+    """A stalled producer trips the watchdog's stage-attributed abort."""
+    from deepdfa_tpu.train.resilience import EXIT_WATCHDOG
+
+    res = train(
+        storage, "stall",
+        'train.resilience={"enabled": true, "watchdog_timeout_s": 3}',
+        faults="stall@3",
+        timeout=180,
+    )
+    assert res.returncode == EXIT_WATCHDOG, (
+        f"expected watchdog exit {EXIT_WATCHDOG}, got {res.returncode}"
+    )
+    diag_path = (
+        Path(storage) / "runs" / "stall" / "checkpoints-step"
+        / "watchdog_diagnostic.json"
+    )
+    assert diag_path.exists(), "no watchdog diagnostic written"
+    diag = json.loads(diag_path.read_text())
+    assert diag["stalled_stage"] == "input", diag
+    return {"stalled_stage": diag["stalled_stage"], "aborted": True}
+
+
+SCENARIOS = {
+    "sigterm": scenario_sigterm,
+    "corrupt-shard": scenario_corrupt_shard,
+    "nan": scenario_nan,
+    "stall": scenario_stall,
+}
+
+
+def run_full(names, n_examples: int) -> dict:
+    record: dict = {"mode": "subprocess", "scenarios": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="fault-inject-") as storage:
+        t0 = time.perf_counter()
+        prepare_corpus(storage, n=n_examples)
+        record["prepare_seconds"] = round(time.perf_counter() - t0, 1)
+
+        def run_one(name):
+            t0 = time.perf_counter()
+            try:
+                out = SCENARIOS[name](storage)
+                out["seconds"] = round(time.perf_counter() - t0, 1)
+                return name, out, True
+            except (AssertionError, RuntimeError, subprocess.TimeoutExpired) as e:
+                return name, {
+                    "error": f"{type(e).__name__}: {e}"[:2000],
+                    "seconds": round(time.perf_counter() - t0, 1),
+                }, False
+
+        # scenarios are independent chains of subprocesses over disjoint
+        # run names — run them concurrently over the shared corpus
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(2, len(names))) as pool:
+            for name, out, ok in pool.map(run_one, names):
+                record["scenarios"][name] = out
+                record["ok"] = record["ok"] and ok
+    return record
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 in-process mode: sigterm + corrupt-shard + nan "
+        "through the real runtime in one interpreter (<1 min)",
+    )
+    ap.add_argument(
+        "--scenario", action="append", default=None,
+        choices=sorted(SCENARIOS),
+        help="full mode: run only the named subprocess scenario(s)",
+    )
+    ap.add_argument("--n-examples", type=int, default=48)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.smoke:
+        record = run_smoke(args.n_examples)
+    else:
+        names = args.scenario if args.scenario else list(SCENARIOS)
+        record = run_full(names, args.n_examples)
+    record["smoke"] = args.smoke
+    print(json.dumps(record), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(record, indent=2))
+    sys.exit(0 if record["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -530,3 +530,91 @@ def test_cli_lazy_stream_stage_labels(tmp_path, rng, monkeypatch):
     )
     assert warm.source_stage == "load"
     assert_batches_identical(warm, cold_batches)
+
+
+# -- corruption detection + quarantine (ISSUE 3, docs/resilience.md) -------
+
+
+def test_replay_detects_truncated_shard_and_rebuilds(tmp_path, rng):
+    """A shard truncated the way a killed writer leaves it: direct
+    replay raises CacheCorruption; get_or_pack quarantines the entry and
+    transparently repacks, bit-identical to direct packing."""
+    from deepdfa_tpu.data.packed_cache import CacheCorruption
+    from deepdfa_tpu.testing.faults import truncate_cache_file
+
+    gs = _corpus(rng)
+    direct = list(shard_bucket_batches(gs, **BUDGETS))
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+    truncate_cache_file(tmp_path / "packed", key)
+
+    with pytest.raises(CacheCorruption, match="size"):
+        list(cache.replay(key))
+    got = list(
+        cache.get_or_pack(key, lambda: shard_bucket_batches(gs, **BUDGETS))
+    )
+    assert_batches_identical(got, direct)
+    quarantined = list((tmp_path / "packed" / "quarantine").iterdir())
+    assert len(quarantined) == 1
+    assert cache.has(key)  # rebuilt entry is complete at the key's path
+    # and the rebuilt entry replays cleanly
+    assert_batches_identical(cache.replay(key), direct)
+
+
+def test_replay_detects_same_size_bit_rot_via_digest(tmp_path, rng):
+    """Bytes flipped WITHOUT a size change — only the content digest can
+    catch this class of damage."""
+    from deepdfa_tpu.data.packed_cache import CacheCorruption
+    from deepdfa_tpu.testing.faults import corrupt_cache_file
+
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+    corrupt_cache_file(tmp_path / "packed", key)
+    with pytest.raises(CacheCorruption, match="digest"):
+        list(cache.replay(key))
+
+
+def test_unreadable_manifest_is_quarantined_and_rebuilt(tmp_path, rng):
+    gs = _corpus(rng)
+    direct = list(shard_bucket_batches(gs, **BUDGETS))
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+    (cache.entry_dir(key) / "manifest.json").write_text("{truncated")
+    got = list(
+        cache.get_or_pack(key, lambda: shard_bucket_batches(gs, **BUDGETS))
+    )
+    assert_batches_identical(got, direct)
+    assert cache.has(key)
+
+
+def test_quarantine_is_bounded(tmp_path, rng):
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    for _ in range(cache.QUARANTINE_KEEP + 2):
+        list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+        cache.quarantine(key)
+    q = tmp_path / "packed" / "quarantine"
+    assert len(list(q.iterdir())) == cache.QUARANTINE_KEEP
+
+
+def test_quarantine_retention_orders_by_quarantine_time(tmp_path, rng):
+    """os.replace preserves the entry's ORIGINAL mtime — retention must
+    order by quarantine time (embedded in the dest name), or an old
+    entry quarantined just now would be evicted immediately."""
+    import os
+
+    gs = _corpus(rng)
+    cache = PackedBatchCache(tmp_path / "packed")
+    key = cache_key(BUDGETS, corpus_digest(gs))
+    for _ in range(cache.QUARANTINE_KEEP):
+        list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+        cache.quarantine(key)
+    list(cache.write_through(key, shard_bucket_batches(gs, **BUDGETS)))
+    os.utime(cache.entry_dir(key), (0, 0))  # ancient original mtime
+    dest = cache.quarantine(key)
+    assert dest is not None and dest.exists()  # newest victim survives

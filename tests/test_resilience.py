@@ -1,0 +1,543 @@
+"""Resilience runtime (train/resilience.py): step-granular
+checkpoint/resume equivalence, divergence-guard skip/rollback counters,
+watchdog stall detection, preemption handling, and the hardened
+checkpoint manifest (ISSUE 3). All tier-1, CPU, in-process."""
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.core.config import ResilienceConfig
+from deepdfa_tpu.core.ioutil import atomic_write_text, with_retries
+from deepdfa_tpu.graphs import GraphSpec, shard_bucket_batches
+from deepdfa_tpu.train.resilience import (
+    DivergenceError,
+    Preempted,
+    ResilientRunner,
+    ResumeCursor,
+    StepCheckpointer,
+    Watchdog,
+)
+
+
+def _graphs(n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for gid in range(n):
+        m = int(rng.integers(4, 10))
+        feats = rng.integers(2, 20, (m, 4)).astype(np.int32)
+        vuln = np.zeros((m,), np.int32)
+        if gid % 2 == 0:
+            feats[0, 0] = 7
+            vuln[0] = 1
+        out.append(GraphSpec(
+            graph_id=gid, node_feats=feats, node_vuln=vuln,
+            edge_src=np.arange(m - 1, dtype=np.int32),
+            edge_dst=np.arange(1, m, dtype=np.int32),
+            label=float(vuln.max()),
+        ))
+    return out
+
+
+def _batches(specs):
+    return list(shard_bucket_batches(
+        specs, num_shards=1, num_graphs=4, node_budget=64, edge_budget=256,
+    ))
+
+
+RES_CFG = (
+    'train.resilience={"enabled": true, "step_checkpoint_every": 2, '
+    '"guard_lag": 1}'
+)
+
+
+def _cfg(*extra):
+    return config_mod.apply_overrides(Config(), [
+        "model.hidden_dim=8",
+        "train.max_epochs=3",
+        "train.prefetch_batches=0",
+        "train.log_every_steps=1",
+        RES_CFG,
+        *extra,
+    ])
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """(cfg, model, mesh, batches_fn) — one compile for the module."""
+    import jax
+
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.parallel import make_mesh
+
+    cfg = _cfg()
+    model = DeepDFA.from_config(cfg.model, input_dim=32)
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    specs = _graphs()
+    return cfg, model, mesh, lambda _e: _batches(specs)
+
+
+def _fit(tiny, ckpt_dir, injector=None, cfg=None, log=None):
+    from deepdfa_tpu.train import GraphTrainer
+
+    base_cfg, model, mesh, batches = tiny
+    cfg = cfg if cfg is not None else base_cfg
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    state = trainer.init_state(batches(0)[0])
+    runner = ResilientRunner(cfg.train.resilience, ckpt_dir, seed=cfg.train.seed)
+    stream = batches if injector is None else (
+        lambda e: injector.wrap(batches(e))
+    )
+    state = trainer.fit(state, stream, log_fn=log, resilience=runner)
+    return state, runner
+
+
+# -- crash/resume equivalence (the tentpole acceptance test) ----------------
+
+
+def test_sigterm_resume_reproduces_uninterrupted_trajectory(tiny, tmp_path):
+    """Kill mid-epoch via the fault harness, resume from the manifest,
+    and the merged per-step loss trajectory is BIT-IDENTICAL to an
+    uninterrupted run of the same config/seed."""
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    ref = []
+    _fit(tiny, tmp_path / "ref",
+         log=lambda r: ref.append((r["step"], r["loss"])) if "loss" in r else None)
+    assert len(ref) >= 10
+    kill_at = len(ref) // 2
+
+    run_dir = tmp_path / "faulted"
+    first = []
+    with pytest.raises(Preempted):
+        _fit(tiny, run_dir,
+             injector=FaultInjector(FaultPlan(sigterm_at_step=kill_at)),
+             log=lambda r: first.append((r["step"], r["loss"])) if "loss" in r else None)
+    manifest = json.loads((run_dir / "resume.json").read_text())
+    assert manifest["step"] == kill_at
+    assert manifest["reason"] == "preempt"
+
+    second = []
+    _, runner = _fit(tiny, run_dir,
+                     log=lambda r: second.append((r["step"], r["loss"])) if "loss" in r else None)
+    assert runner.resumed_from_step == kill_at
+    assert first + second == ref  # bit-exact float equality, on purpose
+
+
+def test_completed_run_resume_is_noop(tiny, tmp_path):
+    """finish() leaves a final cursor past the last epoch, so re-running
+    a COMPLETED run trains zero further steps (idempotent completion)."""
+    steps_a: list = []
+    _fit(tiny, tmp_path / "done",
+         log=lambda r: steps_a.append(r) if "loss" in r else None)
+    steps_b: list = []
+    _, runner = _fit(tiny, tmp_path / "done",
+                     log=lambda r: steps_b.append(r) if "loss" in r else None)
+    assert steps_a and not steps_b
+    assert runner.resumed_from_step == steps_a[-1]["step"]
+
+
+def test_resume_step_continuity_after_guard_skip(tiny, tmp_path):
+    """A guard-skipped step leaves state.step one behind the host/data
+    step; resume must continue from the DATA cursor (manifest step), or
+    RNG folding, checkpoint cadence, and tag ordering drift after every
+    skip."""
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    run_dir = tmp_path / "skip-resume"
+    with pytest.raises(Preempted):
+        _fit(tiny, run_dir, injector=FaultInjector(FaultPlan(
+            nan_at_steps=frozenset({3}), sigterm_at_step=6,
+        )))
+    man = json.loads((run_dir / "resume.json").read_text())
+    assert man["step"] == 6  # the data cursor, NOT state.step (== 5)
+
+    steps: list[int] = []
+    _fit(tiny, run_dir,
+         log=lambda r: steps.append(r["step"]) if "loss" in r else None)
+    assert steps and steps[0] == 7  # continues at the cursor, no rewind
+    final = json.loads((run_dir / "resume.json").read_text())
+    assert final["reason"] == "final"
+    assert final["step"] == steps[-1]
+
+
+def test_resume_refuses_foreign_seed(tiny, tmp_path):
+    cfg, model, mesh, batches = tiny
+    _fit(tiny, tmp_path / "seeded")
+    other = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, seed=cfg.train.seed + 1)
+    )
+    _, runner = _fit(tiny, tmp_path / "seeded", cfg=other)
+    # foreign manifest ignored: the run trained from scratch
+    assert runner.resumed_from_step == 0
+
+
+# -- divergence guard -------------------------------------------------------
+
+
+def test_guard_skips_nan_steps_and_keeps_params_finite(tiny, tmp_path):
+    import jax
+
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    records: list = []
+    state, runner = _fit(
+        tiny, tmp_path / "nan",
+        injector=FaultInjector(FaultPlan(nan_at_steps=frozenset({3, 4}))),
+        log=lambda r: records.append(r) if "train_loss" in r else None,
+    )
+    assert runner.skipped_steps == 2
+    assert runner.rollbacks == 0
+    leaves = jax.tree.leaves(jax.device_get(state.params))
+    assert all(np.isfinite(x).all() for x in leaves)
+    # the survived epoch's aggregate excludes the poisoned losses — a
+    # self-healed epoch must not report train_loss=NaN
+    assert records and all(np.isfinite(r["train_loss"]) for r in records)
+    assert records[0]["skipped_steps"] == 2
+
+
+def test_guard_rolls_back_after_k_consecutive_bad_steps(tiny, tmp_path):
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    cfg = _cfg(
+        'train.resilience={"enabled": true, "step_checkpoint_every": 2, '
+        '"guard_lag": 0, "max_consecutive_bad": 2, "rollback_budget": 3, '
+        '"lr_cooldown": 0.25}'
+    )
+    state, runner = _fit(
+        tiny, tmp_path / "rb", cfg=cfg,
+        injector=FaultInjector(
+            FaultPlan(nan_at_steps=frozenset({4, 5, 6}))
+        ),
+    )
+    assert runner.skipped_steps == 3
+    # 2 consecutive bad -> one rollback (counter resets), 3rd bad alone
+    # stays under the threshold
+    assert runner.rollbacks == 1
+    assert runner.lr_scale() == 0.25
+    assert runner.record()["rollbacks"] == 1
+
+
+def test_guard_rollback_budget_exhaustion_raises(tiny, tmp_path):
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    cfg = _cfg(
+        'train.resilience={"enabled": true, "step_checkpoint_every": 2, '
+        '"guard_lag": 0, "max_consecutive_bad": 1, "rollback_budget": 1}'
+    )
+    with pytest.raises(DivergenceError):
+        _fit(
+            tiny, tmp_path / "budget", cfg=cfg,
+            injector=FaultInjector(
+                FaultPlan(nan_at_steps=frozenset(range(2, 12)))
+            ),
+        )
+
+
+def test_guard_state_survives_preemption(tiny, tmp_path):
+    """A cooled-down LR and spent rollback budget ride the resume
+    manifest — a preempt/diverge cycle cannot restart at full LR with a
+    fresh budget forever."""
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    cfg = _cfg(
+        'train.resilience={"enabled": true, "step_checkpoint_every": 2, '
+        '"guard_lag": 0, "max_consecutive_bad": 1, "rollback_budget": 5, '
+        '"lr_cooldown": 0.5}'
+    )
+    run_dir = tmp_path / "guard-resume"
+    with pytest.raises(Preempted):
+        _fit(tiny, run_dir, cfg=cfg, injector=FaultInjector(FaultPlan(
+            nan_at_steps=frozenset({3}), sigterm_at_step=6,
+        )))
+    man = json.loads((run_dir / "resume.json").read_text())
+    assert man["guard"] == {
+        "lr_scale": 0.5, "rollbacks": 1, "skipped_steps": 1,
+    }
+    _, runner = _fit(tiny, run_dir, cfg=cfg)
+    assert runner.lr_scale() == 0.5
+    assert runner.rollbacks == 1 and runner.skipped_steps == 1
+
+
+def test_combined_train_step_public_contract_under_guard():
+    """With the guard built in, CombinedTrainer.train_step still returns
+    the legacy (state, loss) pair for external callers (bench scripts);
+    the fit loop opts into the ok flag with with_ok=True."""
+    import jax
+
+    from deepdfa_tpu.data.text import collate_shards
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+    cfg = _cfg()
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(
+            vocab_size=64, max_position_embeddings=20, num_layers=1,
+            hidden_size=16, num_heads=2,
+        ),
+        graph_hidden_dim=8, graph_input_dim=102, use_graph=False,
+    )
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    trainer = CombinedTrainer(cfg, mcfg, mesh=mesh, total_steps=2)
+    assert trainer.guard_active
+    rng = np.random.default_rng(0)
+    mat = rng.integers(5, 60, (4, 16)).astype(np.int32)
+    batch = collate_shards(
+        mat, [0, 1, 0, 1], [0, 1, 2, 3], {}, num_shards=1,
+        rows_per_shard=4, node_budget=32, edge_budget=64, pad_id=1,
+    )
+    out = trainer.train_step(
+        trainer.init_state(), trainer.place_batch(batch), jax.random.key(0)
+    )
+    assert len(out) == 2  # legacy contract preserved
+    out = trainer.train_step(
+        out[0], trainer.place_batch(batch), jax.random.key(1), 1.0,
+        with_ok=True,
+    )
+    assert len(out) == 3 and bool(jax.device_get(out[2]))
+
+
+# -- watchdog ---------------------------------------------------------------
+
+
+def test_watchdog_fires_on_silence_with_stage_attribution(tmp_path):
+    fired = []
+    wd = Watchdog(
+        timeout_s=0.2, on_stall=fired.append,
+        diagnostic_path=tmp_path / "diag.json",
+        first_step_grace_s=0.2,
+    )
+    wd.start()
+    try:
+        wd.beat("input", step=7)
+        time.sleep(1.0)
+    finally:
+        wd.stop()
+    assert len(fired) == 1
+    diag = fired[0]
+    assert diag["stalled_stage"] == "input"
+    assert diag["step"] == 7
+    on_disk = json.loads((tmp_path / "diag.json").read_text())
+    assert on_disk["stalled_stage"] == "input"
+
+
+def test_watchdog_first_step_grace_covers_compiles(tmp_path):
+    """Silence during the FIRST step (jit compile) is tolerated up to
+    the grace bound; after step_done() the steady-state timeout rules."""
+    fired = []
+    wd = Watchdog(
+        timeout_s=0.1, on_stall=fired.append, first_step_grace_s=5.0
+    )
+    wd.start()
+    try:
+        wd.beat("device")
+        time.sleep(0.5)  # past timeout_s, within the first-step grace
+        assert not fired
+        wd.step_done()
+        wd.beat("device")
+        time.sleep(0.5)
+    finally:
+        wd.stop()
+    assert len(fired) == 1 and fired[0]["stalled_stage"] == "device"
+
+
+def test_watchdog_stays_quiet_under_heartbeats(tmp_path):
+    fired = []
+    wd = Watchdog(
+        timeout_s=0.3, on_stall=fired.append, first_step_grace_s=0.3
+    )
+    wd.start()
+    try:
+        for _ in range(8):
+            wd.beat("device")
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert not fired
+
+
+def test_watchdog_detects_stalled_input_in_fit(tiny, tmp_path):
+    """A stalled source trips the watchdog with the input stage blamed
+    (injected on_stall; the default hard-aborts the process)."""
+    import jax
+
+    from deepdfa_tpu.data.prefetch import device_placer
+    from deepdfa_tpu.testing.faults import StalledSource
+    from deepdfa_tpu.train import GraphTrainer
+
+    cfg = _cfg(
+        "train.max_epochs=1",
+        'train.resilience={"enabled": true, "step_checkpoint_every": 0, '
+        '"watchdog_timeout_s": 0.5}',
+    )
+    _, model, mesh, batches = tiny
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    state = trainer.init_state(batches(0)[0])
+    # warm both guarded-step signatures (init sharding + post-step
+    # sharding) OUTSIDE the watchdog window: the first-step compile takes
+    # seconds and would trip a 0.5s watchdog as a device stall
+    placer = device_placer(mesh)
+    warm = trainer.init_state(batches(0)[0])
+    for _ in range(2):
+        warm, _loss, _ok = trainer.train_step_guarded(
+            warm, placer(batches(0)[0]), 1.0
+        )
+    jax.block_until_ready(warm.params)
+    stalled = StalledSource(batches(0), n_good=2)
+    fired = []
+
+    def on_stall(diag):
+        fired.append(diag)
+        stalled.release()  # un-wedge so the test finishes
+
+    runner = ResilientRunner(
+        cfg.train.resilience, tmp_path / "wd", seed=0, on_stall=on_stall
+    )
+    trainer.fit(state, lambda e: stalled, resilience=runner)
+    assert fired and fired[0]["stalled_stage"] == "input"
+    assert "pipeline" in fired[0]  # PipelineStats snapshot attached
+
+
+# -- step checkpointer ------------------------------------------------------
+
+
+def _dummy_state():
+    return {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+
+
+def test_step_checkpointer_retention_and_latest(tmp_path):
+    ck = StepCheckpointer(tmp_path, keep_last=2)
+    for s in (2, 4, 6):
+        ck.save(_dummy_state(), ResumeCursor(0, s, s), seed=1)
+    tags = sorted(p.name for p in tmp_path.glob("step-*") if p.is_dir())
+    assert tags == ["step-00000004", "step-00000006"]  # keep-last-2
+    latest = ck.latest()
+    assert latest["step"] == 6 and latest["seed"] == 1
+    restored = ck.restore(latest, _dummy_state())
+    np.testing.assert_array_equal(restored["w"], _dummy_state()["w"])
+
+
+def test_step_checkpointer_rebuilds_corrupt_resume_manifest(tmp_path):
+    ck = StepCheckpointer(tmp_path, keep_last=3)
+    ck.save(_dummy_state(), ResumeCursor(1, 3, 8), seed=0)
+    (tmp_path / "resume.json").write_text("{truncated")
+    latest = StepCheckpointer(tmp_path).latest()
+    assert latest is not None and latest["step"] == 8
+    # and the manifest was re-written durably
+    assert json.loads((tmp_path / "resume.json").read_text())["step"] == 8
+
+
+def test_step_checkpointer_ignores_save_without_sidecar(tmp_path):
+    ck = StepCheckpointer(tmp_path, keep_last=3)
+    ck.save(_dummy_state(), ResumeCursor(0, 1, 2), seed=0)
+    # a crash mid-save leaves a dir but no sidecar: never the resume point
+    (tmp_path / "step-00000009").mkdir()
+    (tmp_path / "resume.json").unlink()
+    assert StepCheckpointer(tmp_path).latest()["step"] == 2
+
+
+# -- hardened epoch CheckpointManager (satellite) ---------------------------
+
+
+def test_checkpoint_manifest_atomic_and_corruption_tolerant(tmp_path):
+    from deepdfa_tpu.train import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path, monitor="val_loss", mode="min")
+    params = _dummy_state()
+    assert mgr.save("epoch-0000", params, {"val_loss": 1.0}, step=1)
+    assert mgr.save("epoch-0001", params, {"val_loss": 0.5}, step=2)
+    # corrupt the manifest the way a crash mid-write used to
+    (tmp_path / "manifest.json").write_text('{"best": {"tag"')
+    rebuilt = CheckpointManager(tmp_path, monitor="val_loss", mode="min")
+    tags = [e["tag"] for e in rebuilt._manifest["history"]]
+    assert tags == ["epoch-0000", "epoch-0001"]
+    # best dir survived and is restorable even with metrics unknown
+    restored = rebuilt.restore("best", _dummy_state())
+    np.testing.assert_array_equal(restored["w"], params["w"])
+    # with no recorded metric, the next save wins best (safe direction)
+    assert rebuilt.save("epoch-0002", params, {"val_loss": 9.0}, step=3)
+
+
+def test_checkpoint_keep_last_retention(tmp_path):
+    from deepdfa_tpu.train import CheckpointManager
+
+    mgr = CheckpointManager(
+        tmp_path, monitor="val_loss", mode="min", keep_last=2
+    )
+    params = _dummy_state()
+    for i, v in enumerate([3.0, 2.0, 1.0, 4.0]):
+        mgr.save(f"epoch-{i:04d}", params, {"val_loss": v}, step=i)
+    on_disk = sorted(
+        p.name for p in tmp_path.iterdir()
+        if p.is_dir() and p.name != "best"
+    )
+    assert on_disk == ["epoch-0002", "epoch-0003"]
+    # best (epoch-0002's weights) survives retention via the best dir
+    assert mgr.best_metrics() == {"val_loss": 1.0}
+    mgr.restore("best", _dummy_state())
+
+
+# -- ioutil -----------------------------------------------------------------
+
+
+def test_atomic_write_text_replaces_and_leaves_no_tmp(tmp_path):
+    p = tmp_path / "m.json"
+    atomic_write_text(p, "one")
+    atomic_write_text(p, "two")
+    assert p.read_text() == "two"
+    assert [q.name for q in tmp_path.iterdir()] == ["m.json"]
+
+
+def test_with_retries_retries_then_succeeds_and_raises():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert with_retries(flaky, retries=3, backoff_s=0.001) == "ok"
+    assert len(calls) == 3
+    with pytest.raises(OSError):
+        with_retries(
+            lambda: (_ for _ in ()).throw(OSError("always")),
+            retries=1, backoff_s=0.001,
+        )
+
+
+# -- fault plan parsing -----------------------------------------------------
+
+
+def test_fault_plan_parsing_and_env():
+    from deepdfa_tpu.testing.faults import injector_from_env, parse_plan
+
+    plan = parse_plan("sigterm@12, nan@3,nan@4,stall@5")
+    assert plan.sigterm_at_step == 12
+    assert plan.nan_at_steps == frozenset({3, 4})
+    assert plan.stall_at_step == 5
+    with pytest.raises(ValueError):
+        parse_plan("explode@1")
+    assert injector_from_env(env={}) is None
+    inj = injector_from_env(env={"DEEPDFA_FAULTS": "nan@2"})
+    assert inj is not None and inj.plan.nan_at_steps == frozenset({2})
+
+
+def test_injected_stream_preserves_source_stage():
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    class S:
+        source_stage = "load"
+
+        def __iter__(self):
+            return iter(range(3))
+
+    wrapped = FaultInjector(FaultPlan()).wrap(S())
+    assert wrapped.source_stage == "load"
+    assert list(wrapped) == [0, 1, 2]
