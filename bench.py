@@ -346,8 +346,53 @@ def run_train_measurement(platform: str) -> dict:
         guard_rates.append(n_per_pass / (time.perf_counter() - t0))
         skipped += sum(1 for o in oks if not bool(np.asarray(o)))
 
+    # tracing-overhead measurement (ISSUE 4 acceptance): identical rep
+    # loops with the unified trace ENABLED vs disabled (the call sites
+    # are the same either way — a disabled span is a no-op), INTERLEAVED
+    # plain/traced because this box's throughput drifts ±40% minute to
+    # minute: two sequential blocks would measure the drift, not the
+    # tracer (the r1 guard-overhead measurement had the same hazard).
+    # The delta of the interleaved medians is the enabled tracer's cost
+    # — `obs_overhead_fraction`, bounded at <=2% on the smoke config.
+    import tempfile
+
+    from deepdfa_tpu.obs import trace as obs_trace
+
+    obs_plain: list[float] = []
+    obs_traced: list[float] = []
+    # an in-process caller (scripts/bench_train.py) may be running under
+    # an ambient tracing session (exported trace dir): snapshot it so
+    # the measurement's enable/disable cycles hand it back intact
+    ambient_dir = os.environ.get(obs_trace.ENV_TRACE_DIR)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            for i in range(2 * reps):
+                traced = i % 2 == 1
+                if traced:
+                    obs_trace.enable(td, process_name="bench-train")
+                try:
+                    t0 = time.perf_counter()
+                    loss = None
+                    for b in prefetch(iter(batches), 2, placer):
+                        with obs_trace.span("train_step", cat="train"):
+                            state, loss = trainer.train_step(state, b)
+                    float(loss)
+                    (obs_traced if traced else obs_plain).append(
+                        n_per_pass / (time.perf_counter() - t0)
+                    )
+                finally:
+                    if traced:
+                        obs_trace.disable()
+    finally:
+        if ambient_dir:
+            obs_trace.enable(
+                ambient_dir, process_name="bench-train", export_env=True
+            )
+
     value = float(np.median(rates))
     guard_value = float(np.median(guard_rates))
+    obs_value = float(np.median(obs_traced))
+    obs_baseline = float(np.median(obs_plain))
     result = {
         "train_graphs_per_sec": round(value, 1),
         "train_vs_baseline": round(value / BASELINE_TRAIN_GRAPHS_PER_SEC, 2),
@@ -369,6 +414,12 @@ def run_train_measurement(platform: str) -> dict:
         "resumed_from_step": 0,
         "skipped_steps": skipped,
         "rollbacks": 0,
+        # unified-telemetry tax (ISSUE 4, docs/observability.md): the
+        # interleaved traced-vs-plain medians; must stay <=2%
+        "obs_traced_graphs_per_sec": round(obs_value, 1),
+        "obs_overhead_fraction": round(
+            max(0.0, 1.0 - obs_value / obs_baseline), 4
+        ) if obs_baseline else None,
     }
     try:
         cost = compiled_cost(
@@ -573,6 +624,11 @@ def main() -> None:
         }
 
     def emit(result: dict) -> None:
+        # provenance stamp (ISSUE 4 satellite): schema_version + git sha
+        # + jax version make BENCH_*.json comparable across PRs
+        from deepdfa_tpu.obs import run_stamp
+
+        result.update(run_stamp())
         if errors and "error" not in result:
             if result.get("platform") == "cpu" and not cpu_pinned():
                 result["fallback_from"] = "; ".join(errors)
@@ -635,6 +691,9 @@ def main() -> None:
             retry_errors: list[str] = []
             tpu_result = _measure_full(detail, deadline, retry_errors)
             if tpu_result is not None and tpu_result.get("platform") != "cpu":
+                from deepdfa_tpu.obs import run_stamp
+
+                tpu_result.update(run_stamp())
                 tpu_result["second_chance"] = True
                 if errors:
                     tpu_result["warnings"] = "; ".join(errors)

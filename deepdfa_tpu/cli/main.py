@@ -9,6 +9,7 @@ Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
   test      evaluation with metrics report + optional profiling
   coverage  abstract-dataflow vocab coverage audit (--analyze_dataset)
   bench     the headline throughput benchmark
+  diag      render a run's telemetry (docs/observability.md)
 
 Config comes from --config (json) plus dotted key=value overrides, e.g.
   python -m deepdfa_tpu.cli train data.batch.graphs_per_batch=128
@@ -489,6 +490,13 @@ def cmd_train(args) -> None:
         val_packer = MpPacker(
             split_specs["val"], workers=cfg.data.pack_workers
         )
+    # unified telemetry (docs/observability.md): entered BEFORE the lazy
+    # packer pools spawn so workers inherit the exported trace dir; all
+    # knobs default off (the session is then a no-op)
+    from deepdfa_tpu import obs
+
+    obs_cm = obs.session(cfg, run_dir)
+    obs_cm.__enter__()
     try:
         # epoch-0 batches double as the warmup-schedule step estimate (the
         # undersampled epoch size; warmup_frac needs total_steps at
@@ -547,9 +555,16 @@ def cmd_train(args) -> None:
                 resilience=res,
             )
     finally:
-        for p in (packer, val_packer):
-            if p is not None:
-                p.close()
+        try:
+            for p in (packer, val_packer):
+                if p is not None:
+                    p.close()
+        finally:
+            # after the packers (their workers' trace files are complete
+            # by the time the session merges trace.json), but even if a
+            # pool close raises the session must still tear down —
+            # exported env, signal handler, tracer flush
+            obs_cm.__exit__(None, None, None)
     best = ckpts.best_metrics()
     if best and cfg.train.monitor in best:
         nni_bridge.report_final(best[cfg.train.monitor])
@@ -1057,6 +1072,12 @@ def cmd_train_combined(args) -> None:
         s = epoch_batches(epoch)
         return injector.wrap(s) if injector is not None else s
 
+    # telemetry session before fit: the lazy TextMpPacker pool spawns
+    # inside fit and must inherit the exported trace dir
+    from deepdfa_tpu import obs
+
+    obs_cm = obs.session(cfg, run_dir)
+    obs_cm.__enter__()
     try:
         state = trainer.fit(
             state,
@@ -1066,8 +1087,13 @@ def cmd_train_combined(args) -> None:
             resilience=res,
         )
     finally:
-        if text_packer is not None:
-            text_packer.close()
+        try:
+            if text_packer is not None:
+                text_packer.close()
+        finally:
+            # session teardown even if the pool close raises (exported
+            # env, signal handler, tracer flush + trace.json merge)
+            obs_cm.__exit__(None, None, None)
     print("best:", ckpts.best_metrics())
 
 
@@ -1213,16 +1239,19 @@ def cmd_train_gen(args) -> None:
         stream = train_batches
         if injector is not None:
             stream = lambda epoch: injector.wrap(train_batches(epoch))  # noqa: E731
-        state = trainer.fit(
-            state,
-            stream,
-            val_batches=val_batches,
-            val_decode=val_decode,
-            checkpoints=ckpts,
-            bleu_checkpoints=bleu_ckpts,
-            patience=args.patience,
-            resilience=res,
-        )
+        from deepdfa_tpu import obs
+
+        with obs.session(cfg, run_dir):
+            state = trainer.fit(
+                state,
+                stream,
+                val_batches=val_batches,
+                val_decode=val_decode,
+                checkpoints=ckpts,
+                bleu_checkpoints=bleu_ckpts,
+                patience=args.patience,
+                resilience=res,
+            )
         print("best:", ckpts.best_metrics())
 
     if args.test_file:
@@ -1622,6 +1651,24 @@ def cmd_ivdetect(args) -> None:
         print(dest)
 
 
+def cmd_diag(args) -> None:
+    """Render a run's telemetry (deepdfa_tpu/obs/diag.py): throughput
+    timeline, host/device stage attribution from records AND the trace
+    event stream, resilience event log."""
+    from deepdfa_tpu.obs import diag
+
+    argv = []
+    if args.run_dir:
+        argv.append(args.run_dir)
+    if args.json:
+        argv.append("--json")
+    if args.smoke:
+        argv.append("--smoke")
+    rc = diag.main(argv)
+    if rc:
+        raise SystemExit(rc)
+
+
 def cmd_bench(args) -> None:
     import bench
 
@@ -1869,6 +1916,19 @@ def main(argv=None) -> None:
     p.add_argument("--params", default="0.25,0.25,0.25,0.25",
                    help="alpha,beta,gamma,theta component weights")
     p.set_defaults(fn=cmd_codebleu)
+
+    p = sub.add_parser(
+        "diag",
+        help="render run telemetry: throughput timeline, stage "
+        "attribution, resilience events (docs/observability.md)",
+    )
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="run directory or run name under storage/runs")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--smoke", action="store_true",
+                   help="build + render a synthetic run dir (tier-1)")
+    p.set_defaults(fn=cmd_diag)
 
     p = sub.add_parser("bench")
     _add_common(p)

@@ -240,6 +240,33 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Unified run telemetry knobs (deepdfa_tpu/obs/,
+    docs/observability.md). Everything defaults OFF — the default
+    training path emits exactly the historical records and artifacts."""
+
+    # cross-process Chrome-trace span capture (obs/trace.py): per-process
+    # JSONL files under trace_dir (default <run_dir>/trace), merged into
+    # trace.json at run end; spawn-pool packer workers and CLI
+    # subprocesses join via an exported env var
+    trace: bool = False
+    trace_dir: str | None = None
+    # include the metrics-registry snapshot (obs/metrics.py), lagged
+    # step-time decomposition, and device memory stats in epoch records
+    # (flattened to obs/* TensorBoard tags)
+    metrics: bool = False
+    # jax.profiler capture of a step window (obs/xprof.py): start at this
+    # global step (-1 = off) for xprof_num_steps steps, under
+    # <run_dir>/xprof/ (TensorBoard profile plugin)
+    xprof_start_step: int = -1
+    xprof_num_steps: int = 5
+    # live-run capture triggers: SIGUSR2, or touching
+    # <run_dir>/xprof/TRIGGER, arms a capture of the next
+    # xprof_num_steps steps
+    xprof_trigger: bool = False
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. Axis sizes of 1 collapse; -1 = all remaining."""
 
@@ -301,6 +328,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,7 @@ from typing import Iterable, Iterator, Sequence
 import numpy as np
 
 from deepdfa_tpu.core.config import PAD_ID_BY_FAMILY
+from deepdfa_tpu.obs import trace as obs_trace
 from deepdfa_tpu.data.text import (
     TEXT_ARRAY_FIELDS as _TEXT_FIELDS,
     TextBatch,
@@ -171,10 +172,17 @@ def _pack_one(plan: BatchPlan):
     """Worker entry: pack one plan, hand the arrays back via shared
     memory. Returns ("shm", name, manifest, num_graphs) or, when a
     segment cannot be created (e.g. /dev/shm exhausted),
-    ("pickle", batch) as a degraded-but-correct fallback."""
-    batch = pack_plan(
-        _WORKER["graphs"], plan, _WORKER["add_self_loops"]
-    )
+    ("pickle", batch) as a degraded-but-correct fallback.
+
+    Spans: workers inherit the parent's exported trace dir (spawn ships
+    os.environ), so pack work lands in the merged timeline as
+    cat="pack_worker" events from the worker's own pid; the flush per
+    task matters because pool.terminate() would discard a buffer."""
+    with obs_trace.span("pack_plan", cat="pack_worker"):
+        batch = pack_plan(
+            _WORKER["graphs"], plan, _WORKER["add_self_loops"]
+        )
+    obs_trace.flush()
     leaves = [
         (name, np.ascontiguousarray(getattr(batch, name)))
         for name in _ARRAY_FIELDS
@@ -206,13 +214,15 @@ def _collate_text_one(plan: TextBatchPlan):
     """Worker entry for bucketed text plans: materialize `collate_plan`
     and ship the TextBatch — its own leaves plus "graphs."-prefixed
     nested GraphBatch leaves — through one segment."""
-    batch = collate_plan(
-        plan,
-        _WORKER["token_ids"],
-        _WORKER["labels"],
-        _WORKER["graphs_by_id"],
-        _WORKER["pad_id"],
-    )
+    with obs_trace.span("collate_plan", cat="pack_worker"):
+        batch = collate_plan(
+            plan,
+            _WORKER["token_ids"],
+            _WORKER["labels"],
+            _WORKER["graphs_by_id"],
+            _WORKER["pad_id"],
+        )
+    obs_trace.flush()
     leaves = [
         (name, np.ascontiguousarray(np.asarray(getattr(batch, name))))
         for name in _TEXT_FIELDS

@@ -20,7 +20,9 @@ Stage instrumentation: pass a `PipelineStats` and every stage's wall time
 accumulates into it — `load`/`pack` (source pulls, attributed via
 `source_stage`), `place` (H2D), `wait` (consumer blocked on the queue).
 The train loops surface these per epoch so end-to-end regressions are
-attributable to host vs device (docs/input_pipeline.md).
+attributable to host vs device (docs/input_pipeline.md). The same stages
+emit cat="input" spans into the unified trace (deepdfa_tpu/obs/trace.py,
+docs/observability.md) — no-ops unless tracing is enabled.
 """
 
 from __future__ import annotations
@@ -29,6 +31,8 @@ import dataclasses
 import threading
 import time
 from typing import Callable, Iterable, Iterator, TypeVar
+
+from deepdfa_tpu.obs import trace as obs_trace
 
 T = TypeVar("T")
 
@@ -157,14 +161,16 @@ def prefetch(
         it = iter(source)
         while True:
             t0 = time.perf_counter()
-            try:
-                item = next(it)
-            except StopIteration:
-                return
+            with obs_trace.span(source_stage, cat="input"):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
             stats.add(source_stage, time.perf_counter() - t0, produced=1)
             if place is not None:
                 t0 = time.perf_counter()
-                item = place(item)
+                with obs_trace.span("place", cat="input"):
+                    item = place(item)
                 stats.add("place", time.perf_counter() - t0)
             stats.consumed += 1
             yield item
@@ -211,7 +217,8 @@ def prefetch(
                 idx = state["next_in"]
                 t0 = time.perf_counter()
                 try:
-                    item = next(src_iter)
+                    with obs_trace.span(source_stage, cat="input"):
+                        item = next(src_iter)
                 except StopIteration:
                     with cond:
                         state["done_at"] = idx
@@ -228,7 +235,8 @@ def prefetch(
             if place is not None:
                 try:
                     t0 = time.perf_counter()
-                    item = place(item)
+                    with obs_trace.span("place", cat="input"):
+                        item = place(item)
                     stats.add("place", time.perf_counter() - t0)
                 except BaseException as e:
                     with cond:
@@ -258,7 +266,7 @@ def prefetch(
 
     try:
         while True:
-            with cond:
+            with obs_trace.span("wait", cat="input"), cond:
                 t0 = time.perf_counter()
                 while True:
                     nxt = state["next_out"]

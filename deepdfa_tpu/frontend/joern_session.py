@@ -24,6 +24,8 @@ import tempfile
 import threading
 from pathlib import Path
 
+from deepdfa_tpu.obs import trace as obs_trace
+
 _MARKER = "===DEEPDFA_DONE==="
 
 # scala snippet exporting nodes/edges json for the currently loaded cpg,
@@ -165,7 +167,13 @@ class JoernSession:
 
     def _exchange(self, cmd: str, timeout: float | None = None) -> str:
         """One command/marker round-trip on the CURRENT process; kills it
-        and raises JoernTimeout on deadline."""
+        and raises JoernTimeout on deadline. Each round-trip is a
+        cat="joern" span in the unified trace (docs/observability.md) —
+        JVM time is a first-class stage in the merged timeline."""
+        with obs_trace.span("joern_exchange", cat="joern", cmd=cmd[:80]):
+            return self._exchange_inner(cmd, timeout)
+
+    def _exchange_inner(self, cmd: str, timeout: float | None = None) -> str:
         import time
 
         assert self.proc.stdin is not None

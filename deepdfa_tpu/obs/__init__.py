@@ -1,0 +1,192 @@
+"""Unified run telemetry (docs/observability.md).
+
+One observability layer the whole stack reports into:
+
+- `obs.trace`   — cross-process Chrome-trace spans/events (JSONL).
+- `obs.metrics` — process-wide counter/gauge/histogram registry +
+  the declared run-log schema (scripts/check_obs_schema.py).
+- `obs.xprof`   — on-demand jax.profiler capture, device memory stats,
+  lagged-fetch step-time decomposition.
+- `obs.diag`    — the `deepdfa-tpu diag <run_dir>` renderer.
+
+The train loops talk to it through two seams that keep their signatures
+unchanged and the default path byte-identical:
+
+- `session(cfg, run_dir)` — CLI-side context manager that enables
+  tracing (exporting the trace dir to child processes) and installs the
+  xprof controller per `cfg.obs`; everything off by default.
+- `instruments(cfg)` — per-fit facade the loops call for step spans,
+  lagged step timing, and epoch-record enrichment; returns a shared
+  no-op when nothing is enabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+from deepdfa_tpu.obs import metrics, trace, xprof
+
+#: bump when the shape/meaning of emitted bench records changes —
+#: BENCH_*.json artifacts are compared across PRs (ISSUE 4 satellite)
+BENCH_SCHEMA_VERSION = 1
+
+
+class Instruments:
+    """Live per-fit instrumentation: step spans + xprof stepping +
+    lagged step timer + epoch-record enrichment."""
+
+    active = True
+
+    def __init__(self, metrics_on: bool):
+        self.metrics_on = bool(metrics_on)
+        self.timer = xprof.StepTimer() if self.metrics_on else None
+
+    def step_span(self, step: int):
+        """Wraps one train-step dispatch; also advances the xprof
+        controller (window/trigger capture boundaries)."""
+        xprof.controller_on_step(step)
+        return trace.span("train_step", cat="train", step=step)
+
+    def dispatched(self, loss_handle, dispatch_seconds=None) -> None:
+        if self.timer is not None:
+            self.timer.dispatched(loss_handle, dispatch_seconds)
+
+    def observe_pipeline(self, stats) -> None:
+        if self.metrics_on:
+            metrics.publish_pipeline_stats(stats)
+
+    def observe_signatures(self, signature_stats: dict) -> None:
+        if self.metrics_on:
+            metrics.publish_signature_stats(signature_stats)
+
+    def finish_epoch(self, record: dict) -> dict:
+        """Drain the lagged timer and (when metrics are on) attach the
+        registry snapshot + device memory stats to the epoch record —
+        the ONE hook that routes every absorbed counter into the
+        existing RunLogger jsonl/TensorBoard path."""
+        if self.timer is not None:
+            self.timer.drain()
+        if not self.metrics_on:
+            return record
+        snap = metrics.REGISTRY.snapshot()
+        obs_snap = {
+            k[len("obs/"):]: v for k, v in snap.items()
+            if k.startswith("obs/")
+        }
+        if obs_snap:
+            record["obs"] = obs_snap
+        mem = xprof.device_memory_stats()
+        if mem:
+            record["device_memory"] = mem
+        return record
+
+
+class _NullInstruments:
+    """Default-path stand-in: every call is a no-op; step_span returns
+    the tracer's shared null span (no allocation)."""
+
+    active = False
+    metrics_on = False
+    timer = None
+
+    def step_span(self, step: int):
+        return trace._NULL_SPAN
+
+    def dispatched(self, loss_handle, dispatch_seconds=None) -> None:
+        pass
+
+    def observe_pipeline(self, stats) -> None:
+        pass
+
+    def observe_signatures(self, signature_stats: dict) -> None:
+        pass
+
+    def finish_epoch(self, record: dict) -> dict:
+        return record
+
+
+NULL_INSTRUMENTS = _NullInstruments()
+
+
+def instruments(cfg) -> "Instruments | _NullInstruments":
+    """The loops' entry point. Anything to do? (cfg.obs.metrics on,
+    tracing enabled — by session() or directly/env — or an xprof
+    controller installed) -> live Instruments; else the shared no-op."""
+    ocfg = getattr(cfg, "obs", None)
+    metrics_on = bool(ocfg is not None and ocfg.metrics)
+    if metrics_on or trace.enabled() or xprof._controller is not None:
+        return Instruments(metrics_on)
+    return NULL_INSTRUMENTS
+
+
+@contextlib.contextmanager
+def session(cfg, run_dir):
+    """CLI-side telemetry lifecycle for one run (cmd_train,
+    cmd_train_combined, cmd_train_gen). All knobs default off; with
+    `obs.trace=true` the per-process JSONL files land under
+    `<run_dir>/trace/` (children join via the exported env var) and a
+    merged `trace.json` is written at exit."""
+    ocfg = getattr(cfg, "obs", None)
+    if ocfg is None:
+        yield
+        return
+    trace_dir = None
+    if ocfg.trace:
+        trace_dir = (
+            Path(ocfg.trace_dir) if ocfg.trace_dir
+            else Path(run_dir) / "trace"
+        )
+        trace.enable(trace_dir, process_name="main", export_env=True)
+    if ocfg.xprof_start_step >= 0 or ocfg.xprof_trigger:
+        xprof.install_controller(
+            Path(run_dir) / "xprof",
+            start_step=ocfg.xprof_start_step,
+            num_steps=ocfg.xprof_num_steps,
+            trigger=ocfg.xprof_trigger,
+        )
+    try:
+        yield
+    finally:
+        xprof.uninstall_controller()
+        if trace_dir is not None:
+            trace.disable()
+            try:
+                trace.write_chrome_trace(
+                    trace_dir, Path(trace_dir) / "trace.json"
+                )
+            except OSError:
+                pass
+
+
+_git_sha: str | None = None
+
+
+def run_stamp() -> dict:
+    """Provenance fields every emitted bench/JSON record carries so
+    BENCH_*.json files are comparable across PRs: record schema version,
+    the repo sha the numbers were measured at, and the jax that ran
+    them."""
+    global _git_sha
+    if _git_sha is None:
+        import subprocess
+
+        try:
+            _git_sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=Path(__file__).resolve().parents[2],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _git_sha = "unknown"
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:
+        jax_version = "unknown"
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "git_sha": _git_sha,
+        "jax_version": jax_version,
+    }

@@ -1,0 +1,412 @@
+"""`deepdfa-tpu diag <run_dir>` — render what a run did from its
+telemetry artifacts.
+
+Reads the three streams a run leaves behind (any subset may be absent):
+
+- `train_log.jsonl`      — epoch/step records (train/logging.py)
+- `trace/trace-*.jsonl`  — the merged-timeline event stream (obs/trace.py)
+- `checkpoints*-step/`   — resume manifests + watchdog diagnostics
+  (train/resilience.py)
+
+and renders: run summary, per-epoch throughput timeline, host/device
+stage attribution (from the epoch records AND recomputed independently
+from the trace spans — the cross-check that the event stream carries the
+run's attribution), and the resilience event log (stalls, skips,
+rollbacks, resume points). `--json` emits the same content as one
+machine-readable object; `--smoke` builds a synthetic run dir through
+the real emission APIs and renders it (the tier-1 regression surface).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from deepdfa_tpu.obs import trace
+
+#: trace span names that constitute host input-stage attribution
+_INPUT_STAGES = ("load", "pack", "place", "wait")
+
+
+def load_records(run_dir: Path) -> list[dict]:
+    path = run_dir / "train_log.jsonl"
+    if not path.exists():
+        return []
+    records = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return records
+
+
+def load_events(run_dir: Path) -> list[dict]:
+    tdir = run_dir / "trace"
+    return trace.merge(tdir) if tdir.is_dir() else []
+
+
+def stage_attribution_from_records(records: list[dict]) -> dict:
+    """Host-stage totals as the epoch records report them."""
+    keys = {
+        "load": "host_load_seconds", "pack": "host_pack_seconds",
+        "place": "host_place_seconds", "wait": "input_wait_seconds",
+    }
+    epochs = [r for r in records if "epoch_seconds" in r]
+    if not epochs:
+        return {}
+    out = {
+        stage: round(sum(float(r.get(k, 0.0)) for r in epochs), 3)
+        for stage, k in keys.items()
+    }
+    out["epoch_seconds"] = round(
+        sum(float(r["epoch_seconds"]) for r in epochs), 3
+    )
+    return out
+
+
+def stage_attribution_from_events(events: list[dict]) -> dict:
+    """The same attribution recomputed from trace spans alone (cat
+    "input"), plus packer-worker and train-dispatch totals and the
+    process census — the proof the event stream is self-sufficient."""
+    stages = {s: 0.0 for s in _INPUT_STAGES}
+    worker_seconds = 0.0
+    train_dispatch_seconds = 0.0
+    device_seconds = 0.0
+    pids: set[int] = set()
+    spans_by_pid: dict[int, int] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid", 0)
+        pids.add(pid)
+        spans_by_pid[pid] = spans_by_pid.get(pid, 0) + 1
+        dur_s = float(e.get("dur", 0.0)) / 1e6
+        cat, name = e.get("cat"), e.get("name")
+        if cat == "input" and name in stages:
+            stages[name] += dur_s
+        elif cat == "pack_worker":
+            worker_seconds += dur_s
+        elif cat == "train" and name == "train_step":
+            train_dispatch_seconds += dur_s
+        elif cat == "train" and name == "step_device":
+            device_seconds += dur_s
+    if not pids:
+        return {}
+    return {
+        **{s: round(v, 3) for s, v in stages.items()},
+        "pack_worker_seconds": round(worker_seconds, 3),
+        "train_dispatch_seconds": round(train_dispatch_seconds, 3),
+        "device_step_seconds": round(device_seconds, 3),
+        "processes": sorted(pids),
+        "spans_per_process": {
+            str(pid): n for pid, n in sorted(spans_by_pid.items())
+        },
+    }
+
+
+def throughput_timeline(records: list[dict]) -> list[dict]:
+    rows = []
+    for r in records:
+        if "epoch_seconds" not in r:
+            continue
+        secs = float(r["epoch_seconds"])
+        row = {
+            "epoch": r.get("epoch"),
+            "epoch_seconds": round(secs, 3),
+            "train_loss": r.get("train_loss"),
+            "input_wait_fraction": r.get("input_wait_fraction"),
+        }
+        for k in ("train_examples_per_sec", "train_tokens_per_sec"):
+            if k in r:
+                row[k] = r[k]
+        rows.append(row)
+    return rows
+
+
+def resilience_log(run_dir: Path, records, events) -> dict:
+    out: dict = {"events": [], "counters": {}, "watchdog": []}
+    for e in events:
+        if e.get("cat") == "resilience":
+            out["events"].append({
+                "name": e.get("name"), "ts_us": e.get("ts"),
+                **(e.get("args") or {}),
+            })
+    last = next(
+        (r for r in reversed(records) if "rollbacks" in r), None
+    )
+    if last is not None:
+        out["counters"] = {
+            k: last.get(k)
+            for k in ("resumed_from_step", "skipped_steps", "rollbacks")
+        }
+    for diag_path in sorted(run_dir.glob("**/watchdog_diagnostic.json")):
+        try:
+            out["watchdog"].append(json.loads(diag_path.read_text()))
+        except (json.JSONDecodeError, OSError):
+            continue
+    for manifest in sorted(run_dir.glob("checkpoints*-step/resume.json")):
+        try:
+            m = json.loads(manifest.read_text())
+            out.setdefault("resume_manifests", []).append({
+                "path": str(manifest.relative_to(run_dir)),
+                "step": m.get("step"), "epoch": m.get("epoch"),
+                "reason": m.get("reason"),
+            })
+        except (json.JSONDecodeError, OSError):
+            continue
+    return out
+
+
+def diagnose(run_dir: str | Path) -> dict:
+    """One machine-readable object with every section."""
+    run_dir = Path(run_dir)
+    records = load_records(run_dir)
+    events = load_events(run_dir)
+    epochs = [r for r in records if "epoch_seconds" in r]
+    summary = {
+        "run_dir": str(run_dir),
+        "records": len(records),
+        "epochs": len(epochs),
+        "trace_events": len(events),
+    }
+    if epochs:
+        summary["final_train_loss"] = epochs[-1].get("train_loss")
+        val_keys = sorted(
+            k for k in epochs[-1] if k.startswith("val_")
+        )
+        if val_keys:
+            summary["final_val"] = {k: epochs[-1][k] for k in val_keys}
+    return {
+        "summary": summary,
+        "timeline": throughput_timeline(records),
+        "stage_attribution": {
+            "from_records": stage_attribution_from_records(records),
+            "from_trace": stage_attribution_from_events(events),
+        },
+        "resilience": resilience_log(run_dir, records, events),
+    }
+
+
+# ---------------------------------------------------------------------------
+# text rendering
+
+
+def _bar(frac: float, width: int = 30) -> str:
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "." * (width - n)
+
+
+def render_text(report: dict, out=sys.stdout) -> None:
+    w = out.write
+    s = report["summary"]
+    w(f"run: {s['run_dir']}\n")
+    w(
+        f"  records={s['records']} epochs={s['epochs']} "
+        f"trace_events={s['trace_events']}\n"
+    )
+    if "final_train_loss" in s:
+        w(f"  final train_loss={s['final_train_loss']}\n")
+    for k, v in (s.get("final_val") or {}).items():
+        w(f"  final {k}={v}\n")
+
+    timeline = report["timeline"]
+    if timeline:
+        w("\nthroughput timeline (per epoch):\n")
+        max_secs = max(r["epoch_seconds"] for r in timeline) or 1.0
+        for r in timeline:
+            extras = "".join(
+                f" {k.split('train_')[-1]}={r[k]}"
+                for k in ("train_examples_per_sec", "train_tokens_per_sec")
+                if k in r
+            )
+            wait = r.get("input_wait_fraction")
+            wait_s = f" wait={wait:.1%}" if isinstance(wait, float) else ""
+            w(
+                f"  epoch {r['epoch']:>3}  "
+                f"{_bar(r['epoch_seconds'] / max_secs, 24)} "
+                f"{r['epoch_seconds']:8.2f}s loss={r['train_loss']}"
+                f"{wait_s}{extras}\n"
+            )
+
+    attr = report["stage_attribution"]
+    rec_attr, trc_attr = attr["from_records"], attr["from_trace"]
+    if rec_attr or trc_attr:
+        w("\nhost/device stage attribution (seconds):\n")
+        w(f"  {'stage':<14}{'records':>12}{'trace':>12}\n")
+        for stage in _INPUT_STAGES:
+            a = rec_attr.get(stage, "-")
+            b = trc_attr.get(stage, "-")
+            w(f"  {stage:<14}{a!s:>12}{b!s:>12}\n")
+        for k in (
+            "pack_worker_seconds", "train_dispatch_seconds",
+            "device_step_seconds",
+        ):
+            if trc_attr.get(k):
+                w(f"  {k:<26}{trc_attr[k]:>12}\n")
+        if trc_attr.get("processes"):
+            w(
+                f"  trace processes: {len(trc_attr['processes'])} "
+                f"(pids {trc_attr['processes']})\n"
+            )
+
+    res = report["resilience"]
+    if res["events"] or res["counters"] or res["watchdog"]:
+        w("\nresilience events:\n")
+        for c, v in (res["counters"] or {}).items():
+            w(f"  {c}={v}\n")
+        for e in res["events"]:
+            args = {
+                k: v for k, v in e.items() if k not in ("name", "ts_us")
+            }
+            w(f"  [{e.get('ts_us', 0):>14.1f}us] {e['name']} {args}\n")
+        for d in res["watchdog"]:
+            w(
+                f"  watchdog: stalled_stage={d.get('stalled_stage')} "
+                f"after {d.get('seconds_since_heartbeat')}s\n"
+            )
+        for m in res.get("resume_manifests", []):
+            w(
+                f"  resume manifest {m['path']}: step={m['step']} "
+                f"epoch={m['epoch']} reason={m['reason']}\n"
+            )
+
+
+# ---------------------------------------------------------------------------
+# smoke fixture: a synthetic run dir built through the REAL emitters
+
+
+def build_smoke_run(run_dir: Path) -> Path:
+    """Fabricate a run dir exercising every diag section: epoch records
+    via RunLogger, main-process + producer-thread spans via the real
+    tracer, a second (synthetic-pid) worker trace file, resilience
+    instants, and a watchdog diagnostic."""
+    import threading
+    import time
+
+    from deepdfa_tpu.train.logging import RunLogger
+
+    run_dir = Path(run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    with RunLogger(run_dir, tensorboard=False) as lg:
+        for epoch in range(3):
+            lg.log({
+                "epoch": epoch, "train_loss": 0.9 - 0.2 * epoch,
+                "epoch_seconds": 2.0 + 0.5 * epoch,
+                "host_load_seconds": 0.1, "host_pack_seconds": 0.6,
+                "host_place_seconds": 0.2, "input_wait_seconds": 0.3,
+                "input_wait_fraction": 0.15,
+                "val_loss": 0.8 - 0.1 * epoch, "val_f1": 0.5 + 0.1 * epoch,
+                "resumed_from_step": 4 if epoch else 0,
+                "skipped_steps": epoch, "rollbacks": 0,
+            })
+    tdir = run_dir / "trace"
+    trace.enable(tdir, process_name="main")
+    try:
+        # spans need non-zero wall time or attribution rounds to 0.0
+        for _ in range(4):
+            with trace.span("pack", cat="input"):
+                time.sleep(0.002)
+            with trace.span("place", cat="input"):
+                time.sleep(0.001)
+            with trace.span("wait", cat="input"):
+                time.sleep(0.001)
+            with trace.span("train_step", cat="train", step=0):
+                time.sleep(0.001)
+
+        def producer():
+            with trace.span("pack", cat="input"):
+                time.sleep(0.002)
+
+        t = threading.Thread(target=producer, name="batch-prefetch-0")
+        t.start()
+        t.join()
+        trace.instant("resumed", cat="resilience", step=4)
+        trace.instant("rollback", cat="resilience", step=9, lr_scale=0.5)
+    finally:
+        trace.disable()
+    # a packer-worker file as a spawn worker would leave it (synthetic
+    # pid: the smoke fixture is single-process by design)
+    worker = trace.Tracer(tdir, process_name="smoke-worker")
+    worker.pid = 999999
+    worker.path = tdir / "trace-999999.jsonl"
+    with trace._Span(worker, "pack_plan", "pack_worker", {}):
+        time.sleep(0.002)
+    worker.close()
+    ck = run_dir / "checkpoints-step"
+    ck.mkdir(exist_ok=True)
+    (ck / "watchdog_diagnostic.json").write_text(json.dumps({
+        "event": "train_stall", "stalled_stage": "input",
+        "seconds_since_heartbeat": 42.0, "timeout_s": 30.0,
+    }))
+    (ck / "resume.json").write_text(json.dumps({
+        "tag": "step-00000004", "step": 4, "epoch": 1,
+        "batch_index": 1, "reason": "preempt",
+    }))
+    return run_dir
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deepdfa-tpu diag", description=__doc__
+    )
+    ap.add_argument("run_dir", nargs="?", default=None,
+                    help="run directory (or a run name under storage/runs)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    ap.add_argument("--smoke", action="store_true",
+                    help="build + render a synthetic run dir (tier-1)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            run_dir = build_smoke_run(Path(d) / "run")
+            report = diagnose(run_dir)
+            if args.json:
+                print(json.dumps(report))
+            else:
+                render_text(report)
+            # the smoke contract: every section materialized from the
+            # synthetic artifacts through the real readers
+            attr = report["stage_attribution"]
+            ok = (
+                report["summary"]["epochs"] == 3
+                and report["summary"]["trace_events"] > 0
+                and attr["from_records"].get("pack", 0) > 0
+                and attr["from_trace"].get("pack", 0) > 0
+                and len(attr["from_trace"].get("processes", [])) >= 2
+                and report["resilience"]["events"]
+                and report["resilience"]["watchdog"]
+            )
+            print(f"diag smoke {'OK' if ok else 'FAILED'}")
+            return 0 if ok else 1
+
+    if args.run_dir is None:
+        ap.error("run_dir is required (or pass --smoke)")
+    run_dir = Path(args.run_dir)
+    if not run_dir.is_dir():
+        from deepdfa_tpu.core import paths
+
+        candidate = paths.runs_dir(args.run_dir)
+        if candidate.is_dir():
+            run_dir = candidate
+        else:
+            print(f"no such run dir: {args.run_dir}", file=sys.stderr)
+            return 2
+    report = diagnose(run_dir)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        render_text(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,230 @@
+"""Process-wide metrics registry: one place every counter in the stack
+reports to, snapshotted into the existing RunLogger jsonl/TensorBoard
+path.
+
+PRs 1-3 each grew ad-hoc counters — `PipelineStats` stage seconds,
+per-signature compile counts (`CombinedTrainer.signature_stats`),
+resilience rollback/skip counters — that reach the run log through
+loop-specific record plumbing. This registry absorbs them behind three
+primitives (counter / gauge / histogram) so any component can publish
+without threading state through the loops, and the loops emit ONE
+`record["obs"] = snapshot()` blob per epoch (flattened to `obs/<name>`
+TensorBoard tags by train/logging.py:flatten_scalars).
+
+Naming rules (docs/observability.md): slash-separated lowercase paths,
+`<subsystem>/<metric>[_<unit>]` — e.g. `input/load_seconds`,
+`resilience/rollbacks`, `step/seconds`. Every name emitted into a run
+log must match a declared pattern in `SCHEMA` below;
+scripts/check_obs_schema.py enforces that against a smoke run in tier-1,
+which is what catches jsonl/TensorBoard tag drift at PR time.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import math
+import threading
+
+
+class Counter:
+    """Monotonic accumulator (float to absorb seconds counters)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+
+class Gauge:
+    """Last-write-wins sample."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Streaming count/sum/min/max — enough for p50-free step-time
+    summaries without holding samples (snapshot adds a derived mean)."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if not math.isfinite(v):
+            return
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+
+
+class MetricsRegistry:
+    """Name -> metric instance; get-or-create, kind-checked."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat {name: value}; histograms expand to /count /mean /max
+        (min is rarely load-bearing and would double the tag count)."""
+        out: dict[str, float] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                if m.count:
+                    out[f"{m.name}/count"] = float(m.count)
+                    out[f"{m.name}/mean"] = m.sum / m.count
+                    out[f"{m.name}/max"] = m.max
+            else:
+                out[m.name] = float(m.value)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+#: the process-wide registry every component publishes to
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# the declared run-log schema
+
+
+#: fnmatch patterns for every scalar tag a train run may emit into
+#: train_log.jsonl (and therefore TensorBoard). Adding a new record key
+#: without declaring it here fails scripts/check_obs_schema.py in
+#: tier-1 — that is the point: the schema is reviewed, not accreted.
+SCHEMA: tuple[str, ...] = (
+    # core loop records
+    "epoch", "step", "loss", "train_loss", "epoch_seconds",
+    # host stage attribution (docs/input_pipeline.md)
+    "host_load_seconds", "host_pack_seconds", "host_place_seconds",
+    "input_wait_seconds", "input_wait_fraction",
+    # sequence-bucketing observables
+    "train_examples_per_sec", "train_tokens_per_sec",
+    "real_tokens", "padded_tokens", "padding_waste",
+    "warmup_signatures", "warmup_compile_seconds",
+    "step_signatures/*/compiles", "step_signatures/*/compile_seconds",
+    "step_signatures/*/train_steps", "step_signatures/*/eval_steps",
+    "jit_lowerings",
+    # validation metrics (metric set varies by task)
+    "val_*",
+    # self-healing observables (docs/resilience.md)
+    "resumed_from_step", "skipped_steps", "rollbacks",
+    # the obs registry snapshot (this module): input pipeline mirrors,
+    # resilience events, lagged step-time decomposition, logging guards
+    "obs/input/load_seconds", "obs/input/pack_seconds",
+    "obs/input/place_seconds", "obs/input/wait_seconds",
+    "obs/input/produced", "obs/input/consumed",
+    "obs/input/real_tokens", "obs/input/padded_tokens", "obs/input/rows",
+    "obs/resilience/skipped_steps", "obs/resilience/rollbacks",
+    "obs/resilience/preemptions", "obs/resilience/watchdog_stalls",
+    "obs/resilience/resumed_from_step",
+    "obs/step/seconds/count", "obs/step/seconds/mean",
+    "obs/step/seconds/max",
+    "obs/step/fetch_wait_seconds/count",
+    "obs/step/fetch_wait_seconds/mean", "obs/step/fetch_wait_seconds/max",
+    "obs/step/dispatch_seconds/count", "obs/step/dispatch_seconds/mean",
+    "obs/step/dispatch_seconds/max",
+    "obs/logging/nonfinite_dropped", "obs/logging/flatten_collisions",
+    "obs/compile/signatures/*",
+    # per-device memory stats (obs/xprof.py; TPU runtimes only)
+    "device_memory/bytes_in_use", "device_memory/peak_bytes_in_use",
+    "device_memory/bytes_limit", "device_memory/largest_alloc_size",
+    # xprof capture bookkeeping
+    "obs/xprof/captures",
+)
+
+
+def declared(name: str, schema: tuple[str, ...] = SCHEMA) -> bool:
+    """Is a flattened scalar tag covered by the declared schema?"""
+    return any(fnmatch.fnmatchcase(name, pat) for pat in schema)
+
+
+def undeclared_tags(records, schema: tuple[str, ...] = SCHEMA) -> list[str]:
+    """Flatten run-log records the exact way RunLogger does and return
+    every tag no schema pattern covers (sorted, deduped)."""
+    from deepdfa_tpu.train.logging import flatten_scalars
+
+    bad: set[str] = set()
+    for rec in records:
+        for tag in flatten_scalars(rec):
+            if not declared(tag, schema):
+                bad.add(tag)
+    return sorted(bad)
+
+
+def publish_pipeline_stats(stats, registry: MetricsRegistry = None) -> None:
+    """Absorb a PipelineStats epoch into the registry (cumulative across
+    epochs — counters, not gauges, so multi-epoch runs aggregate)."""
+    r = registry if registry is not None else REGISTRY
+    r.counter("obs/input/load_seconds").inc(stats.load_seconds)
+    r.counter("obs/input/pack_seconds").inc(stats.pack_seconds)
+    r.counter("obs/input/place_seconds").inc(stats.place_seconds)
+    r.counter("obs/input/wait_seconds").inc(stats.wait_seconds)
+    r.counter("obs/input/produced").inc(stats.produced)
+    r.counter("obs/input/consumed").inc(stats.consumed)
+    if stats.padded_tokens:
+        r.counter("obs/input/real_tokens").inc(stats.real_tokens)
+        r.counter("obs/input/padded_tokens").inc(stats.padded_tokens)
+        r.counter("obs/input/rows").inc(stats.rows)
+
+
+def publish_signature_stats(
+    signature_stats: dict, registry: MetricsRegistry = None
+) -> None:
+    """Absorb the combined trainer's per-signature compile counters
+    (gauges: the trainer's own dict is already cumulative)."""
+    r = registry if registry is not None else REGISTRY
+    for sig, stats in signature_stats.items():
+        r.gauge(f"obs/compile/signatures/{sig}").set(
+            stats.get("compiles", 0)
+        )

@@ -1,0 +1,245 @@
+"""On-demand XLA profiling + sync-free step-time decomposition.
+
+Three capabilities, all default-off (core/config.py:ObsConfig):
+
+- **XprofController** — `jax.profiler` trace capture of a configured
+  step window (`obs.xprof_start_step` + `obs.xprof_num_steps`), plus
+  LIVE-run triggers: SIGUSR2 or touching `<run_dir>/xprof/TRIGGER`
+  arms a capture of the next `xprof_num_steps` steps without restarting
+  the run. Captures land under `<run_dir>/xprof/` for TensorBoard's
+  profile plugin (the deep-dive layer under the host-side trace in
+  obs/trace.py — same division of labor as the reference's DeepSpeed
+  FlopsProfiler vs CUDA-event timing, eval/profiling.py).
+- **StepTimer** — per-step host/device decomposition with the
+  lagged-fetch pattern from train/resilience.py (`guard_lag`): step k's
+  loss handle is fetched only after step k+lag has been dispatched, so
+  the fetch blocks only when the device is genuinely behind — the happy
+  path stays sync-free. Emits `obs/step/*` histograms into the metrics
+  registry and, when tracing is on, `step_device` spans reconstructing
+  the device-paced timeline in the merged trace.
+- **device_memory_stats()** — per-epoch allocator stats
+  (bytes_in_use / peak) where the backend exposes them (TPU/GPU; CPU
+  returns {} and the record key is simply absent).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+from deepdfa_tpu.obs import metrics, trace
+
+#: polling a trigger file stat() every step would be measurable on ms
+#: steps; every N steps it is noise
+_TRIGGER_POLL_STEPS = 20
+
+_controller: "XprofController | None" = None
+
+
+class XprofController:
+    """Start/stop jax.profiler traces on step boundaries.
+
+    `on_step(step)` is called by the train loops once per step (before
+    dispatch); it is a few comparisons when idle. Window capture fires
+    once per run; triggers re-arm (each SIGUSR2 / TRIGGER touch captures
+    one window)."""
+
+    def __init__(
+        self,
+        log_dir: str | Path,
+        start_step: int = -1,
+        num_steps: int = 5,
+        trigger: bool = False,
+    ):
+        self.log_dir = Path(log_dir)
+        self.start_step = int(start_step)
+        self.num_steps = max(1, int(num_steps))
+        self.trigger_path = self.log_dir / "TRIGGER"
+        self._armed = threading.Event()
+        self._active_until: int | None = None
+        self._window_done = False
+        self._captures = 0
+        self._prev_handler = None
+        self._trigger = bool(trigger)
+        if self._trigger:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            if threading.current_thread() is threading.main_thread():
+                try:
+                    self._prev_handler = signal.signal(
+                        signal.SIGUSR2, self._on_signal
+                    )
+                except (ValueError, OSError):
+                    self._prev_handler = None
+
+    def _on_signal(self, signum, frame) -> None:
+        self._armed.set()
+
+    def _check_trigger(self, step: int) -> bool:
+        if self._armed.is_set():
+            self._armed.clear()
+            return True
+        if step % _TRIGGER_POLL_STEPS == 0 and self.trigger_path.exists():
+            try:
+                self.trigger_path.unlink()
+            except OSError:
+                pass
+            return True
+        return False
+
+    def _start(self, step: int, reason: str) -> None:
+        import jax
+
+        out = self.log_dir / f"step-{step:08d}"
+        out.mkdir(parents=True, exist_ok=True)
+        try:
+            jax.profiler.start_trace(str(out))
+        except Exception:  # a second start (external profiler) must not
+            return  # kill the training run
+        self._active_until = step + self.num_steps
+        self._captures += 1
+        metrics.REGISTRY.counter("obs/xprof/captures").inc()
+        trace.instant("xprof_capture_start", cat="train",
+                      step=step, reason=reason)
+
+    def _stop(self) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._active_until = None
+
+    def on_step(self, step: int) -> None:
+        if self._active_until is not None:
+            if step >= self._active_until:
+                self._stop()
+            return
+        if (
+            self.start_step >= 0
+            and not self._window_done
+            and step >= self.start_step
+        ):
+            self._window_done = True
+            self._start(step, "window")
+            return
+        if self._trigger and self._check_trigger(step):
+            self._start(step, "trigger")
+
+    def close(self) -> None:
+        if self._active_until is not None:
+            self._stop()
+        if self._prev_handler is not None:
+            try:
+                signal.signal(signal.SIGUSR2, self._prev_handler)
+            except (ValueError, OSError):
+                pass
+            self._prev_handler = None
+
+
+def install_controller(
+    log_dir: str | Path, start_step: int, num_steps: int, trigger: bool
+) -> XprofController:
+    """Module-global controller so the loops reach it without new fit()
+    parameters (obs.instruments routes on_step here)."""
+    global _controller
+    if _controller is not None:
+        _controller.close()
+    _controller = XprofController(
+        log_dir, start_step=start_step, num_steps=num_steps, trigger=trigger
+    )
+    return _controller
+
+
+def uninstall_controller() -> None:
+    global _controller
+    if _controller is not None:
+        _controller.close()
+        _controller = None
+
+
+def controller_on_step(step: int) -> None:
+    if _controller is not None:
+        _controller.on_step(step)
+
+
+class StepTimer:
+    """Lagged-fetch step-time decomposition (no happy-path sync).
+
+    Per step the loop calls `dispatched(loss_handle)` right after the
+    async train-step dispatch. The handle is queued; once more than
+    `lag` are pending, the oldest is fetched — by then the device has
+    normally finished it, so `jax.device_get` returns without blocking
+    and the inter-completion interval approximates the device-paced
+    step time. `fetch_wait` > 0 is the signal the device is the
+    bottleneck at the measured moment (the complement of
+    input_wait_fraction, which indicts the host)."""
+
+    def __init__(self, lag: int = 1, registry=None):
+        self.lag = max(0, int(lag))
+        self._r = registry if registry is not None else metrics.REGISTRY
+        self._pending: deque = deque()
+        self._last_done: float | None = None
+
+    def dispatched(self, handle, dispatch_seconds: float | None = None) -> None:
+        import jax
+
+        now = time.perf_counter()
+        if dispatch_seconds is not None:
+            self._r.histogram("obs/step/dispatch_seconds").observe(
+                dispatch_seconds
+            )
+        self._pending.append((now, handle))
+        if len(self._pending) <= self.lag:
+            return
+        t_disp, h = self._pending.popleft()
+        t0 = time.perf_counter()
+        jax.device_get(h)
+        done = time.perf_counter()
+        self._r.histogram("obs/step/fetch_wait_seconds").observe(done - t0)
+        if self._last_done is not None:
+            self._r.histogram("obs/step/seconds").observe(
+                done - self._last_done
+            )
+        self._last_done = done
+        if trace.enabled():
+            # reconstruct the device window in the merged timeline: from
+            # the step's dispatch to its (lagged) observed completion —
+            # on the dedicated device track so the backdated start is
+            # not rewritten by the per-thread monotonic nudge
+            now_us = trace.Tracer.now_us()
+            dur_us = (done - t_disp) * 1e6
+            trace.complete_event(
+                "step_device", now_us - dur_us, dur_us, cat="train",
+                tid=trace.DEVICE_TRACK_TID, track_name="device-steps",
+            )
+
+    def drain(self) -> None:
+        """Fetch everything still pending (epoch end)."""
+        import jax
+
+        while self._pending:
+            _, h = self._pending.popleft()
+            jax.device_get(h)
+        self._last_done = None
+
+
+def device_memory_stats() -> dict[str, float]:
+    """Allocator stats for device 0, {} where unsupported (CPU)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    keep = (
+        "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+        "largest_alloc_size",
+    )
+    return {k: float(stats[k]) for k in keep if k in stats}

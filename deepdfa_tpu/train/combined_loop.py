@@ -710,6 +710,7 @@ class CombinedTrainer:
     ) -> TrainState:
         import contextlib
 
+        from deepdfa_tpu import obs
         from deepdfa_tpu.data.prefetch import PipelineStats, prefetch
 
         from deepdfa_tpu.data.text import batch_token_counts
@@ -720,6 +721,8 @@ class CombinedTrainer:
             skip_first,
         )
 
+        # unified telemetry (docs/observability.md): no-op unless enabled
+        inst = obs.instruments(self.cfg)
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         root = jax.random.key(seed)
@@ -807,14 +810,18 @@ class CombinedTrainer:
                         if res is not None:
                             res.heartbeat("device", epoch=epoch, step=step)
                         key = jax.random.fold_in(root, step)
-                        if guard:
-                            state, loss, ok = self.train_step(
-                                state, batch, key, res.lr_scale(),
-                                with_ok=True,
-                            )
-                        else:
-                            state, loss = self.train_step(state, batch, key)
-                            ok = None
+                        with inst.step_span(step):
+                            if guard:
+                                state, loss, ok = self.train_step(
+                                    state, batch, key, res.lr_scale(),
+                                    with_ok=True,
+                                )
+                            else:
+                                state, loss = self.train_step(
+                                    state, batch, key
+                                )
+                                ok = None
+                        inst.dispatched(loss)
                         losses.append(loss)
                         step += 1
                         batch_index += 1
@@ -870,6 +877,12 @@ class CombinedTrainer:
                     k: dict(v) for k, v in self.signature_stats.items()
                 }
                 record["jit_lowerings"] = self.jit_lowerings()
+                # absorb pipeline + per-signature counters into the
+                # metrics registry; attach obs snapshot + device memory
+                # (identical record when telemetry is off)
+                inst.observe_pipeline(stats)
+                inst.observe_signatures(self.signature_stats)
+                inst.finish_epoch(record)
                 if val_batches is not None:
                     if res is not None:
                         # epoch-end stages run under the watchdog's grace

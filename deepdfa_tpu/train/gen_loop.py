@@ -282,6 +282,7 @@ class GenTrainer:
         """
         import contextlib
 
+        from deepdfa_tpu import obs
         from deepdfa_tpu.train.resilience import (
             ResumeCursor,
             finite_mean,
@@ -289,6 +290,8 @@ class GenTrainer:
             skip_first,
         )
 
+        # unified telemetry (docs/observability.md): no-op unless enabled
+        inst = obs.instruments(self.cfg)
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         patience = patience if patience is not None else getattr(
@@ -343,13 +346,15 @@ class GenTrainer:
                     if res is not None:
                         res.heartbeat("device", epoch=epoch, step=step)
                     key = jax.random.fold_in(root, step)
-                    if guard:
-                        state, loss, ok = self.train_step_guarded(
-                            state, batch, key, res.lr_scale()
-                        )
-                    else:
-                        state, loss = self.train_step(state, batch, key)
-                        ok = None
+                    with inst.step_span(step):
+                        if guard:
+                            state, loss, ok = self.train_step_guarded(
+                                state, batch, key, res.lr_scale()
+                            )
+                        else:
+                            state, loss = self.train_step(state, batch, key)
+                            ok = None
+                    inst.dispatched(loss)
                     losses.append(loss)
                     step += 1
                     batch_index += 1
@@ -373,6 +378,9 @@ class GenTrainer:
                     # epoch-end stages (ppl eval, BLEU decode, orbax
                     # saves) run under the watchdog's grace threshold
                     res.heartbeat("eval", epoch=epoch)
+                # attach the obs registry snapshot + device memory
+                # (identical record when telemetry is off)
+                inst.finish_epoch(record)
                 if val_batches is not None:
                     ppl = self.eval_ppl(state, val_batches())
                     record["val_ppl"] = ppl

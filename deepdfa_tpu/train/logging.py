@@ -6,12 +6,24 @@ logs hard-linked into the run dir (main_cli.py:123-165). Here every run
 writes `train_log.jsonl` unconditionally (machine-readable, append-only)
 and mirrors scalar records into TensorBoard event files when a writer
 implementation is importable (torch's is in the image).
+
+Durability/robustness contract (ISSUE 4 satellites): one append handle
+held for the logger's lifetime (not a reopen per record), flushed per
+record so a killed run keeps every line it logged; non-finite scalars
+are dropped-and-counted before the TensorBoard mirror instead of
+crashing (or poisoning) the writer — the jsonl keeps them verbatim, the
+honest record. Drop/collision counters are published to the obs metrics
+registry (`obs/logging/*`, docs/observability.md).
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import math
 from pathlib import Path
+
+logger = logging.getLogger(__name__)
 
 
 def flatten_scalars(record: dict, prefix: str = "") -> dict[str, float]:
@@ -20,14 +32,44 @@ def flatten_scalars(record: dict, prefix: str = "") -> dict[str, float]:
     The combined trainer emits per-signature compile/step counters as a
     nested mapping (``step_signatures -> T64xR32xG32 -> compiles``);
     jsonl keeps the structure, TensorBoard needs flat scalar tags — this
-    is the ONE place that mapping is defined."""
+    is the ONE place that mapping is defined.
+
+    Collision semantics: a literal ``"a/b"`` key and a nested
+    ``{"a": {"b": ...}}`` flatten to the same tag. Resolution is
+    deterministic last-write-wins in the record's insertion order, and
+    every collision is counted (``obs/logging/flatten_collisions``) and
+    warned once per distinct tag — silent shadowing is how a TensorBoard
+    tag drifts away from the jsonl value it claims to mirror."""
     out: dict[str, float] = {}
     for k, v in record.items():
         if isinstance(v, dict):
-            out.update(flatten_scalars(v, f"{prefix}{k}/"))
+            for fk, fv in flatten_scalars(v, f"{prefix}{k}/").items():
+                _put(out, fk, fv)
         elif isinstance(v, (int, float)) and not isinstance(v, bool):
-            out[f"{prefix}{k}"] = float(v)
+            _put(out, f"{prefix}{k}", float(v))
     return out
+
+
+_warned_collisions: set[str] = set()
+
+
+def _put(out: dict[str, float], key: str, value: float) -> None:
+    if key in out:
+        _count_collision(key, out[key], value)
+    out[key] = value
+
+
+def _count_collision(key: str, old: float, new: float) -> None:
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.REGISTRY.counter("obs/logging/flatten_collisions").inc()
+    if key not in _warned_collisions:
+        _warned_collisions.add(key)
+        logger.warning(
+            "flatten_scalars: tag %r emitted twice (%.6g shadowed by "
+            "%.6g) — a literal slash key collides with a nested dict; "
+            "last write wins", key, old, new,
+        )
 
 
 class RunLogger:
@@ -35,6 +77,15 @@ class RunLogger:
         self.run_dir = Path(run_dir)
         self.run_dir.mkdir(parents=True, exist_ok=True)
         self.jsonl_path = self.run_dir / "train_log.jsonl"
+        # one handle for the logger's lifetime: a reopen per record costs
+        # two syscalls + a page-cache round trip per step-log, which the
+        # high-frequency step records (log_every_steps) pay thousands of
+        # times per run; flush-per-record keeps the crash contract (a
+        # killed run's log ends at its last completed record)
+        self._file = self.jsonl_path.open("a")
+        #: non-finite scalars dropped from the TensorBoard mirror (the
+        #: jsonl keeps them; NaN losses are data, not crashes)
+        self.nonfinite_dropped = 0
         self._tb = None
         if tensorboard:
             try:
@@ -49,16 +100,38 @@ class RunLogger:
         return self._tb is not None
 
     def log(self, record: dict) -> None:
-        with self.jsonl_path.open("a") as f:
-            f.write(json.dumps(record) + "\n")
+        if self._file is None:  # log after close: reopen rather than die
+            self._file = self.jsonl_path.open("a")
+        self._file.write(json.dumps(record) + "\n")
+        self._file.flush()
         if self._tb is not None:
             step = int(record.get("step", record.get("epoch", 0)))
             for k, v in flatten_scalars(record).items():
-                if k not in ("step", "epoch"):
-                    self._tb.add_scalar(k, v, global_step=step)
+                if k in ("step", "epoch"):
+                    continue
+                if not math.isfinite(v):
+                    # drop-and-count instead of handing NaN/inf to the
+                    # event writer (some backends crash, all render junk)
+                    self.nonfinite_dropped += 1
+                    from deepdfa_tpu.obs import metrics as obs_metrics
+
+                    obs_metrics.REGISTRY.counter(
+                        "obs/logging/nonfinite_dropped"
+                    ).inc()
+                    continue
+                self._tb.add_scalar(k, v, global_step=step)
 
     def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
         if self._tb is not None:
+            if self.nonfinite_dropped:
+                logger.warning(
+                    "RunLogger: dropped %d non-finite scalar(s) from the "
+                    "TensorBoard mirror (train_log.jsonl keeps them)",
+                    self.nonfinite_dropped,
+                )
             self._tb.flush()
             self._tb.close()
 

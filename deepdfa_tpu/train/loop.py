@@ -271,6 +271,7 @@ class GraphTrainer:
     ) -> TrainState:
         import contextlib
 
+        from deepdfa_tpu import obs
         from deepdfa_tpu.data.prefetch import (
             PipelineStats,
             device_placer,
@@ -283,6 +284,10 @@ class GraphTrainer:
             skip_first,
         )
 
+        # unified telemetry (docs/observability.md): step spans, lagged
+        # step timing, epoch-record enrichment — a shared no-op unless
+        # cfg.obs enables something (or tracing is already on)
+        inst = obs.instruments(self.cfg)
         tcfg = self.cfg.train
         max_epochs = max_epochs if max_epochs is not None else tcfg.max_epochs
         res = resilience
@@ -346,13 +351,15 @@ class GraphTrainer:
                             break
                         if res is not None:
                             res.heartbeat("device", epoch=epoch, step=step)
-                        if guard:
-                            state, loss, ok = self.train_step_guarded(
-                                state, batch, res.lr_scale()
-                            )
-                        else:
-                            state, loss = self.train_step(state, batch)
-                            ok = None
+                        with inst.step_span(step):
+                            if guard:
+                                state, loss, ok = self.train_step_guarded(
+                                    state, batch, res.lr_scale()
+                                )
+                            else:
+                                state, loss = self.train_step(state, batch)
+                                ok = None
+                        inst.dispatched(loss)
                         losses.append(loss)
                         step += 1
                         batch_index += 1
@@ -395,6 +402,11 @@ class GraphTrainer:
                     # self-healing observables (docs/resilience.md):
                     # resumed_from_step / skipped_steps / rollbacks
                     record.update(res.record())
+                # absorb the epoch's pipeline counters into the metrics
+                # registry and attach the obs snapshot + device memory
+                # (no-ops / identical record when telemetry is off)
+                inst.observe_pipeline(stats)
+                inst.finish_epoch(record)
                 if val_batches is not None and (
                     (epoch + 1) % tcfg.eval_every_epochs == 0
                     or epoch == max_epochs - 1

@@ -33,6 +33,13 @@ Resume semantics: batch streams are pure functions of (epoch, seed, data
 digest) — the loops fast-forward the stream past the consumed batches,
 restore the exact TrainState, and the step-loss trajectory continues
 bit-identically with the uninterrupted run (tests/test_resilience.py).
+
+Observability: every self-healing event (stall, skip, rollback, resume,
+preemption) also lands in the unified telemetry stream — cat="resilience"
+instants in the cross-process trace plus `obs/resilience/*` registry
+counters (deepdfa_tpu/obs/, docs/observability.md) — so `deepdfa-tpu
+diag <run_dir>` reconstructs the run's failure history without parsing
+logs. No-ops when telemetry is off.
 """
 
 from __future__ import annotations
@@ -51,6 +58,7 @@ from typing import Any, Callable
 
 from deepdfa_tpu.core.config import ResilienceConfig
 from deepdfa_tpu.core.ioutil import atomic_write_text, with_retries
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
 
 logger = logging.getLogger(__name__)
 
@@ -364,6 +372,17 @@ class Watchdog:
                 continue
             self.fired = True
             diag = self._diagnostic(stage, elapsed, ctx)
+            # the stall joins the unified event stream (diag CLI renders
+            # it); flush because the default on_stall is os._exit, which
+            # skips the tracer's atexit hook
+            obs_metrics.REGISTRY.counter(
+                "obs/resilience/watchdog_stalls"
+            ).inc()
+            obs_trace.instant(
+                "train_stall", cat="resilience", stage=stage,
+                elapsed_s=round(elapsed, 1), **ctx,
+            )
+            obs_trace.flush()
             logger.critical("watchdog: %s", json.dumps(diag))
             if self.diagnostic_path is not None:
                 try:
@@ -539,6 +558,13 @@ class ResilientRunner:
             step=int(manifest["step"]),
         )
         self.resumed_from_step = cursor.step
+        obs_metrics.REGISTRY.gauge("obs/resilience/resumed_from_step").set(
+            cursor.step
+        )
+        obs_trace.instant(
+            "resumed", cat="resilience", step=cursor.step,
+            epoch=cursor.epoch, batch_index=cursor.batch_index,
+        )
         # guard state survives the restart: a cooled-down LR stays
         # cooled, and rollback_budget bounds rollbacks ACROSS restarts —
         # otherwise a preempt/diverge cycle could repeat at full LR
@@ -583,6 +609,12 @@ class ResilientRunner:
                 while self._pending:
                     state = self._consume_ok(self._pending.popleft(), state)
                 manifest = self._save(state, cursor, reason="preempt")
+            obs_metrics.REGISTRY.counter("obs/resilience/preemptions").inc()
+            obs_trace.instant(
+                "preempted", cat="resilience", step=cursor.step,
+                epoch=cursor.epoch,
+            )
+            obs_trace.flush()
             raise Preempted(
                 f"preempted at step {cursor.step} "
                 f"(epoch {cursor.epoch}, batch {cursor.batch_index})",
@@ -635,6 +667,10 @@ class ResilientRunner:
             return state
         self.skipped_steps += 1
         self._consec_bad += 1
+        obs_metrics.REGISTRY.counter("obs/resilience/skipped_steps").inc()
+        obs_trace.instant(
+            "step_skipped", cat="resilience", consecutive=self._consec_bad
+        )
         logger.warning(
             "divergence guard: non-finite loss/grad — step skipped "
             "(%d consecutive)", self._consec_bad,
@@ -649,6 +685,11 @@ class ResilientRunner:
             )
         self.rollbacks += 1
         self._lr_scale *= float(self.rcfg.lr_cooldown)
+        obs_metrics.REGISTRY.counter("obs/resilience/rollbacks").inc()
+        obs_trace.instant(
+            "rollback", cat="resilience", rollbacks=self.rollbacks,
+            lr_scale=self._lr_scale,
+        )
         self._consec_bad = 0
         self._pending.clear()  # flags from the abandoned trajectory
         manifest = self.ckpt.latest() if self.ckpt is not None else None

@@ -356,6 +356,9 @@ def main() -> None:
             ceiling_fields(result.get("model_flops_per_sec", 0.0))
         )
 
+    from deepdfa_tpu.obs import run_stamp
+
+    result.update(run_stamp())
     print(json.dumps(result), flush=True)
     if args.out:
         with open(args.out, "w") as f:

@@ -139,6 +139,9 @@ def main() -> None:
     }
     if len(variants) > 1:
         result["variants"] = variants
+    from deepdfa_tpu.obs import run_stamp
+
+    result.update(run_stamp())
     print(json.dumps(result), flush=True)
     if args.out:
         with open(args.out, "w") as f:

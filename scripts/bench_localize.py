@@ -153,6 +153,9 @@ def main() -> None:
         result.update(ceiling_fields(0.0))
         result.pop("mfu_vs_measured_ceiling", None)
 
+    from deepdfa_tpu.obs import run_stamp
+
+    result.update(run_stamp())
     print(json.dumps(result), flush=True)
     if args.out:
         with open(args.out, "w") as f:

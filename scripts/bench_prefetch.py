@@ -470,6 +470,10 @@ def main() -> None:
         "and compute share the core); on TPU the host assembles while "
         "the device computes",
     }
+    # provenance stamp (ISSUE 4 satellite): comparable across PRs
+    from deepdfa_tpu.obs import run_stamp
+
+    record.update(run_stamp())
     print(json.dumps(record), flush=True)
     if args.out:
         with open(args.out, "w") as f:

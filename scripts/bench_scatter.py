@@ -161,8 +161,12 @@ def main():
             )
         )
     if results:
+        from deepdfa_tpu.obs import run_stamp
+
         best = min(results, key=results.get)
-        print(json.dumps({"best": best, "ms": round(results[best], 3)}))
+        print(json.dumps({
+            "best": best, "ms": round(results[best], 3), **run_stamp(),
+        }))
 
 
 if __name__ == "__main__":

@@ -38,6 +38,8 @@ def main() -> None:
     result = bench.run_train_measurement(platform)
     # same fields the driver merges, without the train_ prefix for
     # standalone readability
+    from deepdfa_tpu.obs import run_stamp
+
     print(
         json.dumps(
             {
@@ -50,6 +52,7 @@ def main() -> None:
                     for k, v in result.items()
                     if k.startswith("train_")
                 },
+                **run_stamp(),
             }
         ),
         flush=True,

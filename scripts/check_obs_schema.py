@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Validate the run-log metric schema: every scalar tag a train run
+emits (jsonl, and therefore TensorBoard — the tags come from the same
+`flatten_scalars`) must match a declared pattern in
+`deepdfa_tpu/obs/metrics.py:SCHEMA`.
+
+This is the drift guard ISSUE 4 asks for: a new record key added in a
+loop without a schema declaration fails tier-1
+(tests/test_obs.py:test_check_obs_schema_smoke) instead of silently
+growing an undocumented TensorBoard tag.
+
+Modes:
+  --smoke        run a tiny in-process smoke train (synthetic corpus,
+                 obs.metrics on, val split, RunLogger) and validate the
+                 train_log.jsonl it produces  [tier-1 default]
+  --log <path>   validate an existing train_log.jsonl instead
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def smoke_records() -> list[dict]:
+    """One-epoch smoke train through the REAL loop + logger, metrics
+    and step logging on, so the produced record set covers the epoch
+    record, step records, val metrics, and the obs snapshot."""
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.train import GraphTrainer
+    from deepdfa_tpu.train.logging import RunLogger
+
+    synth = generate(12, seed=0)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(12), limit_all=50,
+        limit_subkeys=50,
+    )
+    cfg = config_mod.apply_overrides(Config(), [
+        "train.max_epochs=1", "train.log_every_steps=1",
+        "model.hidden_dim=8", "model.n_steps=2",
+        "obs.metrics=true",
+    ])
+    model = DeepDFA.from_config(cfg.model, input_dim=52)
+    trainer = GraphTrainer(model, cfg)
+
+    def batches(_e=0):
+        return shard_bucket_batches(
+            specs, 1, 4, 2048, 8192, oversized="raise"
+        )
+
+    state = trainer.init_state(next(iter(batches())))
+    with tempfile.TemporaryDirectory() as d:
+        with RunLogger(d, tensorboard=False) as lg:
+            trainer.fit(
+                state, batches, val_batches=batches, log_fn=lg.log
+            )
+        return [
+            json.loads(line)
+            for line in (Path(d) / "train_log.jsonl").read_text().splitlines()
+            if line.strip()
+        ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the in-process smoke train (default when "
+                    "no --log is given)")
+    ap.add_argument("--log", default=None,
+                    help="validate an existing train_log.jsonl")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    from deepdfa_tpu.obs import metrics
+
+    if args.log:
+        records = [
+            json.loads(line)
+            for line in Path(args.log).read_text().splitlines()
+            if line.strip()
+        ]
+    else:
+        from deepdfa_tpu.core.backend import apply_platform_override
+
+        os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+        apply_platform_override()
+        records = smoke_records()
+
+    from deepdfa_tpu.train.logging import flatten_scalars
+
+    tags = sorted({t for r in records for t in flatten_scalars(r)})
+    bad = metrics.undeclared_tags(records)
+    result = {
+        "ok": not bad,
+        "records": len(records),
+        "tags": len(tags),
+        "undeclared": bad,
+    }
+    print(json.dumps(result), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(result, indent=1))
+    if bad:
+        print(
+            "undeclared metric tags (declare them in "
+            "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the emitter):\n  "
+            + "\n  ".join(bad),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,52 @@
+"""Tier-1 wiring for scripts/check_obs_schema.py: a smoke train run's
+emitted metric tags must all be declared in the obs schema
+(deepdfa_tpu/obs/metrics.py:SCHEMA) — adding a record key without
+declaring it fails here instead of silently growing an undocumented
+TensorBoard tag (ISSUE 4 satellite)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(args, tmp_path, timeout=420):
+    env = dict(
+        os.environ,
+        DEEPDFA_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+        DEEPDFA_TPU_STORAGE=str(tmp_path),
+    )
+    return subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_schema.py"),
+         *args],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_check_obs_schema_smoke(tmp_path):
+    out = tmp_path / "schema.json"
+    proc = _run(["--smoke", "--out", str(out)], tmp_path)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    record = json.loads(out.read_text())
+    assert record["ok"] is True
+    assert record["undeclared"] == []
+    assert record["records"] >= 2  # step records + the epoch record
+    assert record["tags"] >= 10
+
+
+def test_check_obs_schema_flags_drift(tmp_path):
+    """An undeclared tag in an existing log is reported and fails."""
+    log = tmp_path / "train_log.jsonl"
+    log.write_text(
+        json.dumps({"epoch": 0, "train_loss": 0.5,
+                    "sneaky_new_metric": 1.0}) + "\n"
+    )
+    proc = _run(["--log", str(log)], tmp_path, timeout=120)
+    assert proc.returncode == 1
+    record = json.loads(proc.stdout.splitlines()[0])
+    assert record["ok"] is False
+    assert "sneaky_new_metric" in record["undeclared"]
