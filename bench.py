@@ -61,6 +61,7 @@ TRAIN_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_TRAIN_TIMEOUT", 1200))
 COMBINED_TIMEOUT = float(
     os.environ.get("DEEPDFA_BENCH_COMBINED_TIMEOUT", 600)
 )
+SERVE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_SERVE_TIMEOUT", 420))
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -484,6 +485,46 @@ def run_combined_measurement(platform: str) -> dict:
     }
 
 
+def run_serve_measurement(platform: str) -> dict:
+    """Online-serving observables (ISSUE 5); child, CPU-viable.
+
+    Delegates to scripts/bench_serve.py:bench_serve — the same dynamic-
+    batcher + AOT-bucket-executable drive tier-1 smokes — and prefixes
+    the fields for the merged record. The zero-steady-state-recompiles
+    invariant rides along as a measured field, so a serving-path
+    regression shows up in BENCH_*.json, not just in tests."""
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    from bench_serve import bench_serve
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_serve(
+        int(os.environ.get("DEEPDFA_BENCH_SERVE_EXAMPLES",
+                           48 if smoke else 256)),
+        smoke=smoke,
+    )
+    return {
+        "serve_requests_per_sec": rec["serve_requests_per_sec"],
+        "serve_cold_requests_per_sec": rec["serve_cold_requests_per_sec"],
+        "serve_latency_p50_ms": rec["serve_latency_p50_ms"],
+        "serve_latency_p99_ms": rec["serve_latency_p99_ms"],
+        "serve_batch_occupancy_mean": rec["serve_batch_occupancy_mean"],
+        "serve_steady_state_recompiles": (
+            rec["serve_steady_state_recompiles"]
+        ),
+        "serve_platform": platform,
+    }
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -542,6 +583,20 @@ def _measure_full(
                 result["combined_error"] = cerr
         else:
             result["combined_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_SERVE", "1") == "1":
+        # online-serving observables (ISSUE 5), own bounded child for
+        # the same wedge-isolation reason as the other children
+        sbudget = min(SERVE_TIMEOUT, deadline - time.time())
+        if sbudget >= 90:
+            serve, serr = _run_child(
+                "--child-serve", result.get("platform", platform), sbudget
+            )
+            if serve is not None:
+                result.update(serve)
+            else:
+                result["serve_error"] = serr
+        else:
+            result["serve_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -728,6 +783,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-combined":
         print(
             _CHILD_TAG + json.dumps(run_combined_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-serve":
+        print(
+            _CHILD_TAG + json.dumps(run_serve_measurement(sys.argv[2])),
             flush=True,
         )
     else:

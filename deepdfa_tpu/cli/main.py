@@ -10,6 +10,8 @@ Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
   coverage  abstract-dataflow vocab coverage audit (--analyze_dataset)
   bench     the headline throughput benchmark
   diag      render a run's telemetry (docs/observability.md)
+  score     offline batch scoring through the serving path (docs/serving.md)
+  serve     online HTTP scoring service (dynamic batcher + AOT executables)
 
 Config comes from --config (json) plus dotted key=value overrides, e.g.
   python -m deepdfa_tpu.cli train data.batch.graphs_per_batch=128
@@ -1669,6 +1671,69 @@ def cmd_diag(args) -> None:
         raise SystemExit(rc)
 
 
+def cmd_score(args) -> None:
+    """Offline batch scoring of C source files against a trained
+    checkpoint through the online serving path (docs/serving.md):
+    cached frontend -> dynamic batcher -> AOT bucket executables. The
+    summary asserts the serving contract (--smoke: zero steady-state
+    recompiles) and a per-file scores JSONL lands in the run dir."""
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.serve import driver
+
+    cfg = _load_run_config(args)
+    if args.smoke:
+        cfg, run_dir, sources_dir = driver.build_smoke_run()
+        sources = driver.collect_sources([str(sources_dir)])
+    else:
+        if not args.sources:
+            raise SystemExit("score needs source files/dirs (or --smoke)")
+        run_dir = paths.runs_dir(cfg.run_name)
+        sources = driver.collect_sources(args.sources)
+    with obs.session(cfg, run_dir):
+        summary = driver.run_score(
+            cfg, run_dir, sources, out_path=args.out, family=args.family
+        )
+    print(json.dumps(summary), flush=True)
+    if args.smoke and summary["serve_steady_state_recompiles"]:
+        raise SystemExit(
+            f"smoke contract violated: "
+            f"{summary['serve_steady_state_recompiles']} steady-state "
+            f"recompiles (expected 0)"
+        )
+
+
+def cmd_serve(args) -> None:
+    """Online scoring service (docs/serving.md): stdlib HTTP endpoint
+    (/score, /healthz, /stats) over the dynamic batcher. --smoke starts
+    on an ephemeral port, round-trips real HTTP requests, and exits."""
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.serve import driver
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService, serve_forever
+
+    if args.smoke:
+        report = driver.run_serve_smoke()
+        print(json.dumps(report), flush=True)
+        bad = (
+            report["steady_state_recompiles"]
+            or report["healthz_status"] != 200
+            or report["stats_status"] != 200
+            or any(s["status"] != 200 for s in report["scored"])
+        )
+        if bad:
+            raise SystemExit("serve smoke contract violated (see report)")
+        return
+    cfg = _load_run_config(args)
+    run_dir = paths.runs_dir(cfg.run_name)
+    registry = ModelRegistry(
+        run_dir, family=args.family, checkpoint=cfg.serve.checkpoint,
+        cfg=cfg,
+    )
+    service = ScoringService(registry, cfg)
+    with obs.session(cfg, run_dir):
+        serve_forever(service, args.host, args.port)
+
+
 def cmd_bench(args) -> None:
     import bench
 
@@ -1929,6 +1994,50 @@ def main(argv=None) -> None:
     p.add_argument("--smoke", action="store_true",
                    help="build + render a synthetic run dir (tier-1)")
     p.set_defaults(fn=cmd_diag)
+
+    p = sub.add_parser(
+        "score",
+        help="offline batch scoring of C sources through the serving "
+        "path (frontend cache -> dynamic batcher -> AOT executables)",
+    )
+    p.add_argument("--sources", nargs="*", default=[],
+                   help="C source files and/or directories (one function "
+                        "per file)")
+    p.add_argument("--out", default=None,
+                   help="scores jsonl path (default <run>/scores.jsonl)")
+    p.add_argument("--family", default="deepdfa",
+                   choices=["deepdfa"],
+                   help="model family to restore (combined/t5 serve "
+                        "through the library API for now; docs/serving.md)")
+    p.add_argument("--smoke", action="store_true",
+                   help="self-contained: train a tiny synthetic "
+                        "checkpoint, score its corpus, assert zero "
+                        "steady-state recompiles (tier-1)")
+    # no _add_common: positional overrides would be swallowed by the
+    # nargs='*' --sources flag (the run-exp precedent) — use --override
+    p.add_argument("--config", default=None, help="json config file")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_score)
+
+    p = sub.add_parser(
+        "serve",
+        help="online scoring service: HTTP /score /healthz /stats over "
+        "the dynamic batcher (docs/serving.md)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8471)
+    p.add_argument("--family", default="deepdfa", choices=["deepdfa"])
+    p.add_argument("--smoke", action="store_true",
+                   help="ephemeral-port smoke: real HTTP round trips "
+                        "against a just-trained tiny checkpoint (tier-1)")
+    # consistent override surface with `score` (no positionals)
+    p.add_argument("--config", default=None, help="json config file")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("bench")
     _add_common(p)

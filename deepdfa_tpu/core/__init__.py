@@ -8,6 +8,7 @@ from deepdfa_tpu.core.config import (
     ModelConfig,
     OptimConfig,
     ResilienceConfig,
+    ServeConfig,
     TrainConfig,
 )
 
@@ -25,4 +26,5 @@ __all__ = [
     "BatchConfig",
     "FeatureSpec",
     "ResilienceConfig",
+    "ServeConfig",
 ]

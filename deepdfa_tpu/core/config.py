@@ -267,6 +267,48 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online inference knobs (deepdfa_tpu/serve/, docs/serving.md).
+
+    Only the `serve`/`score` CLI commands read this section — the
+    training/eval paths never touch it, so the default path stays
+    byte-identical. SLO intuition: `queue_limit` bounds worst-case
+    memory and queueing delay (admission control — a full queue rejects
+    instead of growing latency unboundedly), `max_batch_delay_ms` bounds
+    the latency a lone request pays waiting for co-batching."""
+
+    # -- dynamic batcher (serve/batcher.py)
+    # bounded request queue; submissions beyond this are REJECTED
+    # (HTTP 429) instead of queued — backpressure, not buffering
+    queue_limit: int = 256
+    # flush timer: a partial batch executes once its oldest request has
+    # waited this long, so a lone request never waits for co-arrivals
+    max_batch_delay_ms: float = 25.0
+    # largest serve batch (graphs per executable); the batcher AOT-warms
+    # a power-of-two ladder 1, 2, ..., max_batch_graphs so partial
+    # flushes pad to the nearest bucket executable, never recompile
+    max_batch_graphs: int = 16
+    # packed-batch budgets for serving; 0 = inherit data.batch.*
+    node_budget: int = 0
+    edge_budget: int = 0
+    # -- model registry (serve/registry.py)
+    checkpoint: str = "best"
+    # between batches, poll the checkpoint manifest and hot-swap params
+    # when a newer checkpoint of the SAME config/vocab digest appears
+    hot_swap: bool = False
+    # -- request frontend (serve/frontend.py)
+    # content-keyed feature cache entries (repeat functions skip the
+    # frontend entirely); 0 disables
+    feature_cache_entries: int = 1024
+    # route extraction through a pooled Joern JVM (frontend/
+    # joern_session.py, bounded auto-restart) instead of the built-in
+    # parser; needs `joern` on PATH
+    use_joern: bool = False
+    joern_pool_size: int = 1
+    joern_timeout_s: float = 300.0
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. Axis sizes of 1 collapse; -1 = all remaining."""
 
@@ -329,6 +371,7 @@ class Config:
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
 
 # ---------------------------------------------------------------------------

@@ -11,6 +11,7 @@ from deepdfa_tpu.data.pipeline import (
     build_dataset,
     extract_corpus,
     extract_graph,
+    graph_from_cpg,
     to_graph_spec,
 )
 from deepdfa_tpu.data.prefetch import PipelineStats, device_placer, prefetch
@@ -39,6 +40,7 @@ __all__ = [
     "build_dataset",
     "extract_corpus",
     "extract_graph",
+    "graph_from_cpg",
     "to_graph_spec",
     "SynthExample",
     "bigvul_stmt_sizes",

@@ -81,12 +81,39 @@ def extract_graph(
     """
     from deepdfa_tpu.core.config import GTYPE_ETYPES
 
+    # validate BEFORE parsing: a bad gtype must fail fast on the first
+    # call, not only on the subset of a corpus that happens to parse
     if gtype not in GTYPE_ETYPES:
         raise ValueError(f"gtype={gtype!r}")
     try:
         cpg = cparser.parse_function(code)
     except ValueError:
         return None
+    return graph_from_cpg(
+        cpg, graph_id, vuln_lines, label=label, max_defs=max_defs,
+        gtype=gtype, struct_feats=struct_feats,
+    )
+
+
+def graph_from_cpg(
+    cpg: Cpg,
+    graph_id: int,
+    vuln_lines: set[int] | None = None,
+    label: float | None = None,
+    max_defs: int | None = None,
+    gtype: str = "cfg",
+    struct_feats: bool = False,
+) -> ExtractedGraph | None:
+    """Model graph + features from an already-built CPG.
+
+    The parser-independent half of `extract_graph`: the built-in parser
+    and the Joern-backed serving frontend (serve/frontend.py, via
+    frontend/joern_io.py:load_joern_cpg) both land here, so their
+    features are computed by the same code."""
+    from deepdfa_tpu.core.config import GTYPE_ETYPES
+
+    if gtype not in GTYPE_ETYPES:
+        raise ValueError(f"gtype={gtype!r}")
 
     keep = [
         nid

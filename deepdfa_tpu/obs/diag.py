@@ -163,6 +163,57 @@ def resilience_log(run_dir: Path, records, events) -> dict:
     return out
 
 
+def load_serve_records(run_dir: Path) -> list[dict]:
+    """serve_log.jsonl records (the serve/score CLI append one metrics
+    record per drive; docs/serving.md)."""
+    path = run_dir / "serve_log.jsonl"
+    if not path.exists():
+        return []
+    out = []
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+def serve_attribution(serve_records: list[dict]) -> dict:
+    """Serving latency attribution from the newest serve record: how
+    much of a scored request's time went to the frontend, the queue,
+    and the device (histogram count/mean from the serve registry
+    snapshot), plus the throughput/occupancy headline."""
+    if not serve_records:
+        return {}
+    rec = serve_records[-1]
+    snap = rec.get("serve", {})
+    out = {
+        k: rec[k]
+        for k in (
+            "serve_requests_per_sec", "serve_latency_p50_ms",
+            "serve_latency_p99_ms", "serve_batch_occupancy_mean",
+            "serve_steady_state_recompiles",
+        )
+        if k in rec
+    }
+    for stage, name in (
+        ("frontend", "frontend_seconds"),
+        ("queue", "queue_wait_seconds"),
+        ("device", "device_seconds"),
+    ):
+        mean = snap.get(f"{name}/mean")
+        if mean is not None:
+            out[f"{stage}_mean_ms"] = round(1e3 * mean, 3)
+    for k in ("requests", "rejected", "failed", "batches",
+              "cache_hits", "cache_misses", "hot_swaps"):
+        if k in snap:
+            out[k] = snap[k]
+    return out
+
+
 def diagnose(run_dir: str | Path) -> dict:
     """One machine-readable object with every section."""
     run_dir = Path(run_dir)
@@ -190,6 +241,7 @@ def diagnose(run_dir: str | Path) -> dict:
             "from_trace": stage_attribution_from_events(events),
         },
         "resilience": resilience_log(run_dir, records, events),
+        "serve": serve_attribution(load_serve_records(run_dir)),
     }
 
 
@@ -254,6 +306,36 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f"  trace processes: {len(trc_attr['processes'])} "
                 f"(pids {trc_attr['processes']})\n"
             )
+
+    serve = report.get("serve") or {}
+    if serve:
+        w("\nserving (newest serve_log.jsonl record):\n")
+        for k in (
+            "serve_requests_per_sec", "serve_latency_p50_ms",
+            "serve_latency_p99_ms", "serve_batch_occupancy_mean",
+            "serve_steady_state_recompiles",
+        ):
+            if k in serve:
+                w(f"  {k.removeprefix('serve_')}={serve[k]}\n")
+        stages = [
+            (s, serve[f"{s}_mean_ms"])
+            for s in ("frontend", "queue", "device")
+            if f"{s}_mean_ms" in serve
+        ]
+        if stages:
+            total = sum(v for _, v in stages) or 1.0
+            w("  per-request latency attribution (mean ms):\n")
+            for s, v in stages:
+                w(f"    {s:<10}{_bar(v / total, 20)} {v:8.3f}ms\n")
+        counters = {
+            k: serve[k]
+            for k in ("requests", "rejected", "failed", "batches",
+                      "cache_hits", "cache_misses", "hot_swaps")
+            if k in serve
+        }
+        if counters:
+            w("  " + " ".join(f"{k}={int(v)}" for k, v in counters.items())
+              + "\n")
 
     res = report["resilience"]
     if res["events"] or res["counters"] or res["watchdog"]:

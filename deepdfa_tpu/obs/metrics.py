@@ -181,6 +181,27 @@ SCHEMA: tuple[str, ...] = (
     "device_memory/bytes_limit", "device_memory/largest_alloc_size",
     # xprof capture bookkeeping
     "obs/xprof/captures",
+    # -- online inference (deepdfa_tpu/serve/, docs/serving.md) --
+    # serve_log.jsonl summary record (score/serve CLI, bench_serve)
+    "serve_scored", "serve_failed_requests", "serve_seconds",
+    "serve_requests_per_sec", "serve_latency_p50_ms",
+    "serve_latency_p99_ms", "serve_batch_occupancy_mean",
+    "serve_jit_lowerings", "serve_steady_state_recompiles",
+    # the serve registry snapshot (batcher/frontend/registry counters)
+    "serve/requests", "serve/rejected", "serve/failed", "serve/batches",
+    "serve/compiles", "serve/hot_swaps",
+    "serve/cache_hits", "serve/cache_misses",
+    "serve/queue_depth",
+    "serve/batch_occupancy/count", "serve/batch_occupancy/mean",
+    "serve/batch_occupancy/max",
+    "serve/latency_seconds/count", "serve/latency_seconds/mean",
+    "serve/latency_seconds/max",
+    "serve/queue_wait_seconds/count", "serve/queue_wait_seconds/mean",
+    "serve/queue_wait_seconds/max",
+    "serve/device_seconds/count", "serve/device_seconds/mean",
+    "serve/device_seconds/max",
+    "serve/frontend_seconds/count", "serve/frontend_seconds/mean",
+    "serve/frontend_seconds/max",
 )
 
 

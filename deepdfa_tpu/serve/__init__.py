@@ -1,0 +1,66 @@
+"""Online inference subsystem (docs/serving.md).
+
+- `serve.registry`  — checkpoint -> inference-only model handle
+  (params, no optimizer), config/vocab digest pinned, hot-swappable.
+- `serve.batcher`   — bounded-queue dynamic batcher over AOT-warmed
+  per-signature bucket executables (zero steady-state lowerings).
+- `serve.frontend`  — cached request preprocessing (built-in parser or
+  a pooled Joern session) into the training feature path.
+- `serve.server`    — stdlib HTTP endpoint (/score, /healthz, /stats)
+  + the offline batch scorer the `score` CLI drives.
+
+Everything is reachable only through `cfg.serve` and the `serve`/`score`
+CLI commands — training paths never import this package.
+"""
+
+from deepdfa_tpu.serve.batcher import (
+    CombinedExecutor,
+    DynamicBatcher,
+    GgnnExecutor,
+    QueueFull,
+    RequestTooLarge,
+    ScoreRequest,
+)
+from deepdfa_tpu.serve.frontend import (
+    FeatureCache,
+    FrontendError,
+    RequestPreprocessor,
+    SessionPool,
+)
+from deepdfa_tpu.serve.registry import (
+    ModelRegistry,
+    RegistryError,
+    config_digest,
+    load_vocabs,
+)
+from deepdfa_tpu.serve.server import (
+    BackgroundServer,
+    ScoringService,
+    make_server,
+    score_texts,
+    serve_forever,
+    write_serve_log,
+)
+
+__all__ = [
+    "CombinedExecutor",
+    "DynamicBatcher",
+    "GgnnExecutor",
+    "QueueFull",
+    "RequestTooLarge",
+    "ScoreRequest",
+    "FeatureCache",
+    "FrontendError",
+    "RequestPreprocessor",
+    "SessionPool",
+    "ModelRegistry",
+    "RegistryError",
+    "config_digest",
+    "load_vocabs",
+    "BackgroundServer",
+    "ScoringService",
+    "make_server",
+    "score_texts",
+    "serve_forever",
+    "write_serve_log",
+]

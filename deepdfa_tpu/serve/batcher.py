@@ -1,0 +1,654 @@
+"""Dynamic request batcher with AOT bucket executables (docs/serving.md).
+
+Morphling-style serving economics (PAPERS.md, arxiv 2512.01678): GNN
+serving throughput comes from executing signature-specialized compiled
+programs, never from tracing at request time. This batcher reuses the
+PR-2 machinery — a small ladder of static batch signatures, each
+ahead-of-time compiled before the first request (`warmup`), with
+`jit_lowerings()` as the zero-steady-state-recompiles guard — and adds
+the online half:
+
+  - a BOUNDED queue with admission control: a full queue rejects
+    (`QueueFull` -> HTTP 429) instead of buffering unbounded latency;
+  - grouping of pending requests by bucket signature (graphs group by
+    packed-budget fit; text rows group by their PR-2 sequence bucket
+    edge `(T, rows, num_graphs)`);
+  - a max-latency flush timer: a partial batch executes once its oldest
+    request has waited `max_batch_delay_ms`, so a lone request never
+    waits for co-arrivals.
+
+Correctness invariant (tests/test_serve.py property test): a request's
+score is BIT-IDENTICAL regardless of which other requests it was batched
+with — padding slots are masked out of every segment reduction and
+per-row compute is independent, so co-batching is purely a throughput
+decision, never a numerics one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Hashable, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+_req_ids = itertools.count()
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the bounded request queue is at queue_limit."""
+
+
+class RequestTooLarge(ValueError):
+    """The request alone exceeds the serving batch budgets."""
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One in-flight scoring request (a thread-safe future)."""
+
+    payload: Any
+    id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+    t_submit: float = dataclasses.field(default_factory=time.monotonic)
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    result: float | None = None
+    error: Exception | None = None
+    latency_s: float | None = None
+
+    def set_result(self, value: float) -> None:
+        self.result = value
+        self.latency_s = time.monotonic() - self.t_submit
+        self._done.set()
+
+    def set_error(self, exc: Exception) -> None:
+        self.error = exc
+        self.latency_s = time.monotonic() - self.t_submit
+        self._done.set()
+
+    def wait(self, timeout: float | None = None) -> float:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not scored in {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return float(self.result)
+
+
+def percentile(sorted_vals: Sequence[float], p: float) -> float | None:
+    """Upper-biased quantile over a PRE-SORTED sample; None when empty.
+    The one index rule `/stats`, the score summaries, and bench_serve
+    all share — three private copies would drift apart."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+
+def _pow2_sizes(max_size: int) -> tuple[int, ...]:
+    """The AOT bucket ladder: 1, 2, 4, ..., max (max included even when
+    not a power of two — it is the capacity the scheduler fills to)."""
+    sizes = []
+    s = 1
+    while s < max_size:
+        sizes.append(s)
+        s *= 2
+    sizes.append(max_size)
+    return tuple(sorted(set(sizes)))
+
+
+class GgnnExecutor:
+    """Per-signature AOT executables for the flagship GGNN scorer.
+
+    Payloads are `GraphSpec`s (the serve frontend's output). One grouping
+    key — every graph request is co-batchable — with capacity bounded by
+    `max_batch_graphs` AND the packed node/edge budgets; each executed
+    chunk pads to the smallest warmed ladder size >= its row count, so a
+    partial flush runs a smaller compiled program instead of paying the
+    full batch's padded compute.
+    """
+
+    def __init__(
+        self,
+        model,
+        params_fn: Callable[[], Any],
+        node_budget: int,
+        edge_budget: int,
+        max_batch_graphs: int = 16,
+        feat_width: int | None = None,
+        etypes: bool = False,
+    ):
+        import jax
+
+        self.model = model
+        self.params_fn = params_fn
+        self.node_budget = int(node_budget)
+        self.edge_budget = int(edge_budget)
+        self.sizes = _pow2_sizes(int(max_batch_graphs))
+        self.etypes = bool(etypes)
+        if feat_width is None:
+            from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
+
+            feat_width = NUM_SUBKEY_FEATS
+        self.feat_width = int(feat_width)
+
+        def score(params, batch):
+            return jax.nn.sigmoid(model.apply(params, batch))
+
+        self._score_jit = jax.jit(score)
+        self._compiled: dict[int, Any] = {}
+        self._lowerings = 0
+
+    # -- grouping ------------------------------------------------------------
+
+    def admit(self, spec) -> None:
+        """Reject requests that can never fit a serving batch alone."""
+        edges = spec.num_edges + spec.num_nodes  # + self loops
+        if spec.num_nodes > self.node_budget or edges > self.edge_budget:
+            raise RequestTooLarge(
+                f"graph has {spec.num_nodes} nodes / {edges} edges "
+                f"(incl. self loops); serving budgets are "
+                f"{self.node_budget}/{self.edge_budget} "
+                f"(raise serve.node_budget/serve.edge_budget)"
+            )
+
+    def bucket_key(self, spec) -> Hashable:
+        return "graph"
+
+    def capacity(self, key: Hashable) -> int:
+        return self.sizes[-1]
+
+    def fits(self, key: Hashable, chunk: Sequence, spec) -> bool:
+        """Would adding `spec` keep the chunk inside the pack budgets?"""
+        nodes = sum(s.num_nodes for s in chunk) + spec.num_nodes
+        edges = (
+            sum(s.num_edges + s.num_nodes for s in chunk)
+            + spec.num_edges + spec.num_nodes
+        )
+        return nodes <= self.node_budget and edges <= self.edge_budget
+
+    def _size_for(self, n: int) -> int:
+        for s in self.sizes:
+            if s >= n:
+                return s
+        return self.sizes[-1]
+
+    # -- compilation ---------------------------------------------------------
+
+    def _dummy_batch(self, size: int):
+        from deepdfa_tpu.graphs.batch import pack
+
+        return pack(
+            [], size, self.node_budget, self.edge_budget,
+            feat_width=self.feat_width, etypes=self.etypes,
+        )
+
+    def signatures(self) -> list[tuple]:
+        return [
+            (s, self.node_budget, self.edge_budget) for s in self.sizes
+        ]
+
+    def warmup(self) -> dict[str, float]:
+        """AOT-compile every ladder size; {signature label: seconds}.
+        Idempotent — re-warmup never recompiles."""
+        import jax
+
+        params = self.params_fn()
+        report: dict[str, float] = {}
+        for size in self.sizes:
+            if size in self._compiled:
+                continue
+            t0 = time.perf_counter()
+            batch = jax.device_put(self._dummy_batch(size))
+            self._compiled[size] = self._score_jit.lower(
+                params, batch
+            ).compile()
+            dt = time.perf_counter() - t0
+            self._lowerings += 1
+            obs_metrics.REGISTRY.counter("serve/compiles").inc()
+            report[f"G{size}"] = round(dt, 3)
+        return report
+
+    def jit_lowerings(self) -> int:
+        """AOT warmup compiles + any lazy jit call-cache entries — the
+        zero-steady-state-recompiles guard (same contract as
+        CombinedTrainer.jit_lowerings)."""
+        return self._lowerings + self._score_jit._cache_size()
+
+    # -- execution -----------------------------------------------------------
+
+    def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
+        """Pack + score one chunk; [len(chunk)] probabilities."""
+        import jax
+
+        from deepdfa_tpu.graphs.batch import pack
+
+        size = self._size_for(len(chunk))
+        batch = pack(
+            list(chunk), size, self.node_budget, self.edge_budget,
+            feat_width=self.feat_width, etypes=self.etypes,
+        )
+        batch = jax.device_put(batch)
+        fn = self._compiled.get(size, self._score_jit)
+        probs = fn(self.params_fn(), batch)
+        return np.asarray(jax.device_get(probs))[: len(chunk)]
+
+
+class CombinedExecutor:
+    """Per-signature AOT executables for the combined (text+graph)
+    families — requests group by their PR-2 sequence bucket edge and
+    each bucket's signature is `(T, rows, num_graphs)` with `rows` from
+    the ONE `rows_for_bucket` formula (data/text.py), exactly the
+    signatures combined training warms."""
+
+    def __init__(
+        self,
+        model_cfg,
+        params_fn: Callable[[], Any],
+        tokenizer,
+        seq_buckets: Sequence[int],
+        token_budget: int,
+        node_budget: int,
+        edge_budget: int,
+        is_t5: bool = False,
+    ):
+        import jax
+
+        from deepdfa_tpu.data.text import rows_for_bucket
+
+        self.model_cfg = model_cfg
+        self.params_fn = params_fn
+        self.tok = tokenizer
+        self.buckets = tuple(int(b) for b in seq_buckets)
+        if not self.buckets:
+            raise ValueError(
+                "CombinedExecutor needs data.seq_buckets (the serve "
+                "bucket signatures); () has no edges to compile"
+            )
+        self.token_budget = int(token_budget)
+        self.node_budget = int(node_budget)
+        self.edge_budget = int(edge_budget)
+        self.is_t5 = bool(is_t5)
+        self.pad_id = int(getattr(model_cfg.encoder, "pad_token_id", 0))
+        self._rows = {
+            T: rows_for_bucket(T, self.token_budget, 1) for T in self.buckets
+        }
+
+        def score(params, batch):
+            if self.is_t5:
+                from deepdfa_tpu.models import t5 as t5m
+
+                logits = t5m.defect_forward(
+                    model_cfg, params, batch.input_ids,
+                    graph_batch=batch.graphs, has_graph=batch.has_graph,
+                    dropout_key=None,
+                )
+            else:
+                from deepdfa_tpu.models import combined as cmb
+
+                logits = cmb.forward(
+                    model_cfg, params, batch.input_ids,
+                    graph_batch=batch.graphs, has_graph=batch.has_graph,
+                    dropout_key=None,
+                )
+            return jax.nn.softmax(logits)[:, 1]
+
+        self._score_jit = jax.jit(score)
+        self._compiled: dict[int, Any] = {}
+        self._lowerings = 0
+
+    # payload: (token_ids [T0] np.int32, GraphSpec | None)
+
+    def admit(self, payload) -> None:
+        # the request must fit its bucket's batch ALONE, against the
+        # same accounting collate() uses: every one of the bucket's
+        # `rows` slots holds at least the 1-node/1-self-loop _EMPTY
+        # placeholder (data/text.py) — a graph that only fits against a
+        # smaller baseline would be silently degraded to
+        # has_graph=False, scoring differently batched vs alone
+        key = self.bucket_key(payload)  # raises on over-long text
+        _, spec = payload
+        if spec is not None:
+            rows = self._rows[key]
+            n_used = rows + spec.num_nodes - 1
+            e_used = rows + spec.num_edges + spec.num_nodes - 1
+            if n_used > self.node_budget or e_used > self.edge_budget:
+                raise RequestTooLarge(
+                    f"graph has {spec.num_nodes} nodes / "
+                    f"{spec.num_edges + spec.num_nodes} edges (incl. self "
+                    f"loops); with the T={key} bucket's {rows} placeholder "
+                    f"rows that exceeds budgets "
+                    f"{self.node_budget}/{self.edge_budget}"
+                )
+
+    def bucket_key(self, payload) -> Hashable:
+        from deepdfa_tpu.data.text import token_lengths
+
+        ids, _ = payload
+        ln = int(token_lengths(np.asarray(ids)[None], self.pad_id)[0])
+        for T in self.buckets:
+            if ln <= T:
+                return T
+        raise RequestTooLarge(
+            f"token length {ln} exceeds the largest bucket edge "
+            f"{self.buckets[-1]}"
+        )
+
+    def capacity(self, key: Hashable) -> int:
+        return self._rows[key]
+
+    def fits(self, key: Hashable, chunk: Sequence, payload) -> bool:
+        """Mirror collate()'s budget accounting EXACTLY (data/text.py):
+        baseline = the bucket's full `rows` placeholder slots (1 node +
+        1 self loop each), each real graph costs its delta over one
+        placeholder. If this admits a chunk, collate degrades nothing —
+        which is what keeps batched scores bit-identical to singleton
+        scores (a degraded has_graph=False row would score text-only
+        batched but with its graph alone)."""
+        rows = self._rows[key]
+        n_used = rows
+        e_used = rows
+        for _, spec in list(chunk) + [payload]:
+            if spec is not None:
+                n_used += spec.num_nodes - 1
+                e_used += spec.num_edges + spec.num_nodes - 1
+        return n_used <= self.node_budget and e_used <= self.edge_budget
+
+    def signatures(self) -> list[tuple]:
+        return [(T, self._rows[T], self._rows[T]) for T in self.buckets]
+
+    def _collate(self, T: int, chunk: Sequence):
+        from deepdfa_tpu.data.text import _fit_width, collate
+
+        rows = self._rows[T]
+        if chunk:
+            tok = np.stack(
+                [_fit_width(ids, T, self.pad_id) for ids, _ in chunk]
+            )
+        else:
+            tok = np.zeros((0, T), np.int32)
+        graphs_by_id = {
+            i: spec
+            for i, (_, spec) in enumerate(chunk)
+            if spec is not None
+        }
+        return collate(
+            tok, [0] * len(chunk), list(range(len(chunk))), graphs_by_id,
+            batch_rows=rows, node_budget=self.node_budget,
+            edge_budget=self.edge_budget, pad_id=self.pad_id,
+        )
+
+    def warmup(self) -> dict[str, float]:
+        import jax
+
+        params = self.params_fn()
+        report: dict[str, float] = {}
+        for T in self.buckets:
+            if T in self._compiled:
+                continue
+            t0 = time.perf_counter()
+            batch = jax.device_put(self._collate(T, []))
+            self._compiled[T] = self._score_jit.lower(
+                params, batch
+            ).compile()
+            dt = time.perf_counter() - t0
+            self._lowerings += 1
+            obs_metrics.REGISTRY.counter("serve/compiles").inc()
+            report[f"T{T}xR{self._rows[T]}"] = round(dt, 3)
+        return report
+
+    def jit_lowerings(self) -> int:
+        return self._lowerings + self._score_jit._cache_size()
+
+    def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
+        import jax
+
+        batch = jax.device_put(self._collate(int(key), chunk))
+        fn = self._compiled.get(int(key), self._score_jit)
+        probs = fn(self.params_fn(), batch)
+        return np.asarray(jax.device_get(probs))[: len(chunk)]
+
+
+class DynamicBatcher:
+    """Bounded-queue scheduler over an executor's bucket signatures.
+
+    Two drive modes sharing the SAME grouping/flush/execute code path:
+      - `start()` spawns the scheduler thread (online serving) — batches
+        flush when a signature group is full or its oldest request aged
+        past `max_batch_delay_s`;
+      - `score_all(payloads)` drives synchronously (offline `score` CLI,
+        deterministic: full groups flush as they fill, the tail force-
+        flushes).
+    """
+
+    def __init__(
+        self,
+        executor,
+        queue_limit: int = 256,
+        max_batch_delay_s: float = 0.025,
+        on_batch: Callable[[], None] | None = None,
+    ):
+        self.executor = executor
+        self.queue_limit = int(queue_limit)
+        self.max_batch_delay_s = float(max_batch_delay_s)
+        self.on_batch = on_batch
+        self._lock = threading.Condition()
+        self._pending: "OrderedDict[Hashable, deque[ScoreRequest]]" = (
+            OrderedDict()
+        )
+        self._n_pending = 0
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        #: bounded recent-latency window for host-side quantiles
+        #: (/stats, bench_serve) — the registry histogram keeps only
+        #: count/mean/max
+        self.recent_latencies: deque[float] = deque(maxlen=4096)
+        self.batches_run = 0
+        r = obs_metrics.REGISTRY
+        self._m_requests = r.counter("serve/requests")
+        self._m_rejected = r.counter("serve/rejected")
+        self._m_batches = r.counter("serve/batches")
+        self._m_depth = r.gauge("serve/queue_depth")
+        self._m_occupancy = r.histogram("serve/batch_occupancy")
+        self._m_latency = r.histogram("serve/latency_seconds")
+        self._m_queue_wait = r.histogram("serve/queue_wait_seconds")
+        self._m_device = r.histogram("serve/device_seconds")
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, payload) -> ScoreRequest:
+        """Enqueue one request; raises QueueFull (admission control) or
+        RequestTooLarge (can never fit a batch)."""
+        self.executor.admit(payload)
+        key = self.executor.bucket_key(payload)
+        req = ScoreRequest(payload)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._n_pending >= self.queue_limit:
+                self._m_rejected.inc()
+                raise QueueFull(
+                    f"serve queue at limit ({self.queue_limit}); retry "
+                    f"later"
+                )
+            self._pending.setdefault(key, deque()).append(req)
+            self._n_pending += 1
+            self._m_requests.inc()
+            self._m_depth.set(self._n_pending)
+            self._lock.notify_all()
+        return req
+
+    def stats(self) -> dict:
+        with self._lock:
+            depth = self._n_pending
+        lat = sorted(self.recent_latencies)
+        return {
+            "queue_depth": depth,
+            "batches": self.batches_run,
+            "latency_p50_s": percentile(lat, 0.50),
+            "latency_p99_s": percentile(lat, 0.99),
+            "jit_lowerings": self.executor.jit_lowerings(),
+        }
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _pop_chunk(self, key: Hashable) -> list[ScoreRequest]:
+        """Pop the largest budget-respecting prefix of a group (holding
+        the lock). Arrival order within the group is preserved — that
+        plus deterministic packing is what makes the offline drive
+        replayable."""
+        q = self._pending[key]
+        cap = self.executor.capacity(key)
+        chunk: list[ScoreRequest] = []
+        payloads: list = []
+        while q and len(chunk) < cap:
+            nxt = q[0]
+            if payloads and not self.executor.fits(
+                key, payloads, nxt.payload
+            ):
+                break
+            chunk.append(q.popleft())
+            payloads.append(chunk[-1].payload)
+        if not q:
+            del self._pending[key]
+        self._n_pending -= len(chunk)
+        self._m_depth.set(self._n_pending)
+        return chunk
+
+    def _take_ready(self, force: bool = False):
+        """(key, chunk) of the next batch to run, or (None, wait_s).
+
+        Full groups flush immediately; otherwise the OLDEST pending
+        request's age decides — past the delay the scheduler flushes its
+        group partially (force skips the wait: offline drain)."""
+        now = time.monotonic()
+        oldest_key = None
+        oldest_t = None
+        for key, q in self._pending.items():
+            cap = self.executor.capacity(key)
+            if len(q) >= cap:
+                return key, None
+            t = q[0].t_submit
+            if oldest_t is None or t < oldest_t:
+                oldest_key, oldest_t = key, t
+        if oldest_key is None:
+            return None, None
+        if force or now - oldest_t >= self.max_batch_delay_s:
+            return oldest_key, None
+        return None, self.max_batch_delay_s - (now - oldest_t)
+
+    def _run_batch(self, key: Hashable, chunk: list[ScoreRequest]) -> None:
+        if self.on_batch is not None:
+            try:
+                self.on_batch()  # e.g. registry.maybe_reload (hot swap)
+            except Exception:
+                pass  # a failed poll must never fail the batch
+        t0 = time.monotonic()
+        for req in chunk:
+            self._m_queue_wait.observe(t0 - req.t_submit)
+        try:
+            probs = self.executor.execute(key, [r.payload for r in chunk])
+        except Exception as e:
+            for req in chunk:
+                req.set_error(e)
+            return
+        dt = time.monotonic() - t0
+        self.batches_run += 1
+        self._m_batches.inc()
+        self._m_device.observe(dt)
+        self._m_occupancy.observe(
+            len(chunk) / max(1, self.executor.capacity(key))
+        )
+        for req, p in zip(chunk, probs):
+            req.set_result(float(p))
+            self._m_latency.observe(req.latency_s)
+            self.recent_latencies.append(req.latency_s)
+
+    def _drain_once(self, force: bool = False) -> bool:
+        """Run at most one batch; True if one ran."""
+        with self._lock:
+            key, wait = self._take_ready(force=force)
+            if key is None:
+                return False
+            chunk = self._pop_chunk(key)
+        if chunk:
+            self._run_batch(key, chunk)
+        return bool(chunk)
+
+    def drain(self) -> None:
+        """Offline: run batches until the queue is empty (full groups
+        first, then force-flush the tails)."""
+        while True:
+            if not self._drain_once(force=True):
+                with self._lock:
+                    if self._n_pending == 0:
+                        return
+
+    def score_all(self, payloads: Sequence) -> list[ScoreRequest]:
+        """Synchronously score a payload sequence through the SAME
+        grouping/flush path the online scheduler uses. Submissions that
+        hit the queue limit drain in place instead of rejecting — the
+        offline caller wants completion, not backpressure."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "score_all is the offline drive; the scheduler thread "
+                "is running"
+            )
+        reqs: list[ScoreRequest] = []
+        for p in payloads:
+            while True:
+                try:
+                    reqs.append(self.submit(p))
+                    break
+                except QueueFull:
+                    self._drain_once(force=True)
+                except RequestTooLarge as e:
+                    # per-row fault isolation: one over-budget graph
+                    # becomes a failed row, never a crashed job
+                    req = ScoreRequest(p)
+                    req.set_error(e)
+                    reqs.append(req)
+                    break
+            # full groups execute as they fill (bounded memory)
+            while self._drain_once(force=False):
+                pass
+        self.drain()
+        return reqs
+
+    # -- online mode ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed and self._n_pending == 0:
+                    return
+                # on close, force-flush what is queued instead of letting
+                # submitted requests hang
+                key, wait = self._take_ready(force=self._closed)
+                chunk = self._pop_chunk(key) if key is not None else None
+                if chunk is None:
+                    self._lock.wait(
+                        timeout=wait if wait is not None else 0.25
+                    )
+                    continue
+            self._run_batch(key, chunk)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

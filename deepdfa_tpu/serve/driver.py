@@ -1,0 +1,232 @@
+"""Offline scoring drives + the self-contained serve smoke run.
+
+`run_score` is the `deepdfa-tpu score` implementation: restore a run's
+checkpoint through the registry, push a directory of C functions through
+the online path (frontend -> batcher -> AOT executables), write per-file
+scores JSONL + a serve metrics record, and report the summary the
+benches and tests assert on (throughput, latency quantiles, batch
+occupancy, steady-state recompiles).
+
+`build_smoke_run` trains a tiny GGNN on the synthetic corpus and lays
+down EXACTLY the artifacts a real run leaves (config.json, checkpoints/
+with a `best` tag, the feat-spec-named vocab json, a directory of source
+files) — so `score --smoke` / `serve --smoke` and the schema checker
+exercise the real restore path end to end, not a mock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+#: source extensions the `score` command collects from a directory
+SOURCE_SUFFIXES = (".c", ".cc", ".cpp", ".cxx", ".h", ".hpp")
+
+
+def collect_sources(paths_in: list[str]) -> list[tuple[str, str]]:
+    """(name, code) pairs from files and/or directories of C sources."""
+    out: list[tuple[str, str]] = []
+    for p in paths_in:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*")):
+                if f.suffix in SOURCE_SUFFIXES and f.is_file():
+                    out.append((str(f), f.read_text(errors="replace")))
+        elif p.is_file():
+            out.append((str(p), p.read_text(errors="replace")))
+        else:
+            raise SystemExit(f"no such source file/dir: {p}")
+    if not out:
+        raise SystemExit(
+            f"no source files found under {paths_in} "
+            f"(looked for {SOURCE_SUFFIXES})"
+        )
+    return out
+
+
+def build_smoke_run(
+    run_name: str = "serve-smoke",
+    dataset: str = "serve-smoke",
+    n_examples: int = 24,
+    max_epochs: int = 2,
+    seed: int = 0,
+):
+    """Train a tiny GGNN and leave real run artifacts behind.
+
+    Returns (cfg, run_dir, sources_dir). Must run on a 1-device CPU
+    platform (CLI subprocesses; see tests/conftest.py:run_cli)."""
+    import numpy as np
+
+    from deepdfa_tpu.core import Config, config as config_mod, paths
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.train import GraphTrainer
+
+    cfg = config_mod.apply_overrides(Config(), [
+        f"run_name={json.dumps(run_name)}",
+        f"data.dataset={json.dumps(dataset)}",
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        f"train.max_epochs={max_epochs}",
+        "model.hidden_dim=8", "model.n_steps=2",
+        # small serve batches keep the AOT ladder cheap to warm on CPU
+        "serve.max_batch_graphs=4",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+    ])
+    synth = generate(n_examples, seed=seed)
+    examples = to_examples(synth)
+    specs, vocabs = build_dataset(
+        examples, train_ids=range(n_examples),
+        limit_all=cfg.data.feat.limit_all,
+        limit_subkeys=cfg.data.feat.limit_subkeys,
+    )
+    out_dir = paths.processed_dir(dataset)
+    (out_dir / f"vocab{cfg.data.feat.name}.json").write_text(
+        json.dumps({k: v.to_json() for k, v in vocabs.items()})
+    )
+    run_dir = paths.runs_dir(run_name)
+    config_mod.to_json(cfg, run_dir / "config.json")
+
+    model = DeepDFA.from_config(
+        cfg.model, input_dim=cfg.data.feat.input_dim
+    )
+    trainer = GraphTrainer(model, cfg)
+
+    def batches(_e=0):
+        return shard_bucket_batches(
+            specs, 1, 8, 2048, 8192, oversized="raise"
+        )
+
+    state = trainer.init_state(next(iter(batches())))
+    ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
+    trainer.fit(
+        state, batches, val_batches=batches, checkpoints=ckpts,
+    )
+
+    sources_dir = run_dir / "smoke_src"
+    sources_dir.mkdir(parents=True, exist_ok=True)
+    for e in examples:
+        (sources_dir / f"fn_{e.id:04d}.c").write_text(e.code)
+    return cfg, run_dir, sources_dir
+
+
+def run_score(
+    cfg,
+    run_dir,
+    sources: list[tuple[str, str]],
+    out_path=None,
+    family: str = "deepdfa",
+) -> dict:
+    """Score (name, code) pairs against a run's checkpoint; returns the
+    summary record (also appended to <run_dir>/serve_log.jsonl)."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import (
+        ScoringService,
+        score_texts,
+        write_serve_log,
+    )
+
+    run_dir = Path(run_dir)
+    registry = ModelRegistry(
+        run_dir, family=family, checkpoint=cfg.serve.checkpoint, cfg=cfg
+    )
+    service = ScoringService(registry, cfg)
+    try:
+        t0 = time.perf_counter()
+        rows = score_texts(service, sources)
+        dt = time.perf_counter() - t0
+        out_path = (
+            Path(out_path) if out_path else run_dir / "scores.jsonl"
+        )
+        with out_path.open("w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        from deepdfa_tpu.serve.batcher import percentile
+
+        ok = sum(1 for r in rows if r.get("ok"))
+        lat = sorted(service.batcher.recent_latencies)
+
+        def pct_ms(p):
+            v = percentile(lat, p)
+            return None if v is None else round(1e3 * v, 3)
+
+        snap = obs_metrics.REGISTRY.snapshot()
+        summary = {
+            "serve_scored": ok,
+            "serve_failed_requests": len(rows) - ok,
+            "serve_seconds": round(dt, 3),
+            "serve_requests_per_sec": round(ok / dt, 2) if dt else None,
+            "serve_latency_p50_ms": pct_ms(0.50),
+            "serve_latency_p99_ms": pct_ms(0.99),
+            "serve_batch_occupancy_mean": round(
+                snap.get("serve/batch_occupancy/mean", 0.0), 4
+            ),
+            "serve_jit_lowerings": service.executor.jit_lowerings(),
+            "serve_steady_state_recompiles": (
+                service.steady_state_recompiles()
+            ),
+            "scores_path": str(out_path),
+        }
+        record = dict(summary)
+        record.update(service.serve_record())
+        write_serve_log(run_dir, [record])
+        return summary
+    finally:
+        service.close()
+
+
+def run_serve_smoke(**smoke_kw) -> dict:
+    """`serve --smoke`: smoke run + real HTTP round trips on an
+    ephemeral port (score, healthz, stats, a 422 reject), then teardown.
+    Returns the merged report."""
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import (
+        BackgroundServer,
+        ScoringService,
+        write_serve_log,
+    )
+
+    cfg, run_dir, sources_dir = build_smoke_run(**smoke_kw)
+    registry = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint, cfg=cfg
+    )
+    service = ScoringService(registry, cfg)
+    server = BackgroundServer(service)
+    try:
+        codes = [
+            f.read_text() for f in sorted(sources_dir.glob("*.c"))[:6]
+        ]
+        scored = []
+        for code in codes:
+            status, payload = server.request(
+                "POST", "/score", {"code": code}
+            )
+            scored.append((status, payload.get("prob")))
+        bad_status, _ = server.request(
+            "POST", "/score", {"code": "not a function @@@"}
+        )
+        h_status, health = server.request("GET", "/healthz")
+        s_status, stats = server.request("GET", "/stats")
+        record = dict(service.serve_record())
+        record["serve_steady_state_recompiles"] = (
+            service.steady_state_recompiles()
+        )
+        write_serve_log(run_dir, [record])
+        return {
+            "scored": [
+                {"status": st, "prob": p} for st, p in scored
+            ],
+            "reject_status": bad_status,
+            "healthz_status": h_status,
+            "healthz": health,
+            "stats_status": s_status,
+            "stats": stats,
+            "steady_state_recompiles": (
+                service.steady_state_recompiles()
+            ),
+            "run_dir": str(run_dir),
+        }
+    finally:
+        server.close()

@@ -1,0 +1,283 @@
+"""Request preprocessing for online inference (docs/serving.md).
+
+Raw C/C++ source -> model-ready `GraphSpec`, through exactly the
+training extraction path (`data/pipeline.py:graph_from_cpg` +
+`to_graph_spec` against the run's vocabularies), so a served function is
+featurized bit-identically to how the training corpus was.
+
+Two parser routes share that path:
+  - the built-in frontend parser (default — hermetic, no JVM);
+  - a POOLED Joern session (`serve.use_joern`): a bounded pool of
+    `frontend/joern_session.py` JVMs, each with its own PR-3 bounded
+    auto-restart, checked out per request and replaced when dead.
+
+A content-keyed feature cache (sha256 of source + the feature-spec /
+gtype identity) sits in front of both routes: repeat functions — the
+common case for heavy traffic scoring the same hot code — skip the
+frontend entirely. Failures are cached too (a function the parser
+cannot handle stays unparseable until its bytes change).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import tempfile
+import threading
+import time
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any, Callable
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+
+class FrontendError(ValueError):
+    """The function could not be turned into a model graph."""
+
+
+class FeatureCache:
+    """Bounded content-keyed LRU for extraction results (hits count in
+    the serve metrics; 0 entries disables)."""
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, Any]" = OrderedDict()
+        r = obs_metrics.REGISTRY
+        self._hits = r.counter("serve/cache_hits")
+        self._misses = r.counter("serve/cache_misses")
+
+    def get(self, key: str):
+        """(hit, value) — value may legitimately be None (cached failure)."""
+        if not self.max_entries:
+            self._misses.inc()
+            return False, None
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self._hits.inc()
+                return True, self._entries[key]
+        self._misses.inc()
+        return False, None
+
+    def put(self, key: str, value) -> None:
+        if not self.max_entries:
+            return
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class SessionPool:
+    """Bounded pool of frontend sessions (Joern JVMs in production;
+    anything with close() in tests).
+
+    Sessions are created lazily up to `size`, checked out exclusively,
+    and REPLACED (closed + recreated on next checkout) when the borrower
+    saw an exception — the session-internal auto-restart
+    (JoernSession.max_restarts) handles transient hangs; the pool
+    handles sessions that died for good."""
+
+    def __init__(self, factory: Callable[[int], Any], size: int = 1):
+        self.factory = factory
+        self.size = max(1, int(size))
+        # one condition guards both the free list and the creation
+        # budget: a discard frees CREATION capacity (not a queued
+        # session), so waiters must re-check both paths when notified —
+        # a bare Queue.get() would sleep through that forever
+        self._cond = threading.Condition()
+        self._free: list[Any] = []
+        self._created = 0
+        self._next_id = 0
+        self.replaced = 0
+        self._closed = False
+
+    def _checkout(self):
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise RuntimeError("session pool is closed")
+                if self._free:
+                    return self._free.pop()
+                if self._created < self.size:
+                    self._created += 1
+                    self._next_id += 1
+                    worker_id = self._next_id - 1
+                    break
+                self._cond.wait()
+        # construct OUTSIDE the lock (a Joern JVM spawn takes seconds)
+        try:
+            return self.factory(worker_id)
+        except Exception:
+            with self._cond:
+                self._created -= 1
+                self._cond.notify()
+            raise
+
+    def session(self):
+        """Context manager: checkout, yield, return — or discard on error."""
+        pool = self
+
+        class _Lease:
+            def __enter__(self):
+                self.s = pool._checkout()
+                return self.s
+
+            def __exit__(self, exc_type, exc, tb):
+                if exc_type is None:
+                    pool._return(self.s)
+                else:
+                    # the borrower's exception already propagates; the
+                    # dead session just quietly leaves the pool
+                    pool._discard(self.s)
+                return False
+
+        return _Lease()
+
+    def _return(self, s) -> None:
+        with self._cond:
+            self._free.append(s)
+            self._cond.notify()
+
+    def _discard(self, s) -> None:
+        try:
+            s.close()
+        except Exception:
+            pass
+        with self._cond:
+            self._created -= 1
+            self.replaced += 1
+            self._cond.notify()  # creation capacity freed: wake a waiter
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            free, self._free = self._free, []
+            self._cond.notify_all()
+        for s in free:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+class RequestPreprocessor:
+    """source text -> GraphSpec, cached, timed, parser-routed."""
+
+    def __init__(
+        self,
+        cfg,
+        vocabs,
+        use_joern: bool = False,
+        joern_pool: SessionPool | None = None,
+        cache_entries: int = 1024,
+    ):
+        self.cfg = cfg
+        self.vocabs = vocabs
+        self.gtype = cfg.data.gtype
+        self.struct_feats = bool(cfg.data.feat.struct_feats)
+        self.max_defs = cfg.data.feat.max_defs
+        self.cache = FeatureCache(cache_entries)
+        self.use_joern = bool(use_joern)
+        self.pool = joern_pool
+        if self.use_joern and self.pool is None:
+            from deepdfa_tpu.frontend import joern_session
+
+            if not joern_session.available():
+                raise FrontendError(
+                    "serve.use_joern=true but no `joern` binary on PATH"
+                )
+            scfg = cfg.serve
+            self.pool = SessionPool(
+                lambda i: joern_session.JoernSession(
+                    worker_id=i, timeout=scfg.joern_timeout_s
+                ),
+                size=scfg.joern_pool_size,
+            )
+        r = obs_metrics.REGISTRY
+        self._seconds = r.histogram("serve/frontend_seconds")
+        self._failed = r.counter("serve/failed")
+        # the cache key pins every knob that changes the extracted bytes
+        self._key_suffix = (
+            f"|{cfg.data.feat.name}|{self.gtype}|joern={self.use_joern}"
+        )
+
+    def content_key(self, code: str) -> str:
+        h = hashlib.sha256(code.encode("utf-8", "replace")).hexdigest()
+        return h + self._key_suffix
+
+    def features(self, code: str, request_id: int = -1):
+        """GraphSpec for one function; raises FrontendError on functions
+        the frontend cannot handle (cached either way)."""
+        key = self.content_key(code)
+        hit, cached = self.cache.get(key)
+        if hit:
+            if cached is None:
+                self._failed.inc()
+                raise FrontendError("unparseable function (cached)")
+            return cached
+        t0 = time.perf_counter()
+        try:
+            spec = self._extract(code, request_id)
+        finally:
+            self._seconds.observe(time.perf_counter() - t0)
+        self.cache.put(key, spec)
+        if spec is None:
+            self._failed.inc()
+            raise FrontendError(
+                "function could not be parsed into a CFG graph"
+            )
+        return spec
+
+    def _extract(self, code: str, request_id: int):
+        from deepdfa_tpu.data.pipeline import (
+            extract_graph,
+            graph_from_cpg,
+            to_graph_spec,
+        )
+
+        if self.use_joern:
+            cpg = self._joern_cpg(code)
+            eg = (
+                None if cpg is None else graph_from_cpg(
+                    cpg, request_id, max_defs=self.max_defs,
+                    gtype=self.gtype, struct_feats=self.struct_feats,
+                )
+            )
+        else:
+            eg = extract_graph(
+                code, request_id, max_defs=self.max_defs,
+                gtype=self.gtype, struct_feats=self.struct_feats,
+            )
+        if eg is None:
+            return None
+        return to_graph_spec(eg, self.vocabs)
+
+    def _joern_cpg(self, code: str):
+        """One pooled-JVM round trip: tmp file -> export -> Cpg."""
+        from deepdfa_tpu.frontend.joern_io import load_joern_cpg
+
+        with self.pool.session() as sess:
+            with tempfile.TemporaryDirectory(prefix="serve-joern-") as d:
+                src = Path(d) / "request.c"
+                src.write_text(code)
+                sess.import_code(src)
+                sess.export_cpg_json(src)  # writes <src>.{nodes,edges}.json
+                try:
+                    return load_joern_cpg(src)
+                except (OSError, ValueError) as e:
+                    logger.warning("joern export unreadable: %s", e)
+                    return None
+
+    def close(self) -> None:
+        if self.pool is not None:
+            self.pool.close()

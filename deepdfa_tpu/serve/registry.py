@@ -1,0 +1,369 @@
+"""Model registry for online inference (docs/serving.md).
+
+Turns a training run directory into an inference-ready handle: the run's
+saved `config.json` (the same manifest `cmd_test`/`cmd_localize` restore
+against), a params-only checkpoint restore
+(`train/checkpoint.py:restore_for_inference` — never the optimizer), and
+the abstract-dataflow vocabularies the run extracted with, digest-pinned
+so a checkpoint can never be silently served against features it was not
+trained on.
+
+Hot swap: `maybe_reload()` re-reads the checkpoint manifest between
+batches (serve/batcher.py calls it via the batcher's `on_batch` hook)
+and swaps the params pytree in place when the tracked tag advanced to a
+newer step. Param shapes are fixed by the config, so a swap never
+invalidates the AOT bucket executables — the next batch simply runs with
+the new weights, zero recompiles.
+
+Three model families restore through the same interface:
+  - "deepdfa"  — the flagship GGNN (checkpoints/, DeepDFA.from_config)
+  - "combined" — RoBERTa-family transformer+graph (checkpoints-combined/)
+  - "t5"       — the CodeT5-style defect head (checkpoints-combined/)
+The combined/t5 families need the tokenizer + encoder config the run was
+trained with; the CLI builds those exactly as `cmd_train_combined` does
+and passes them in (`model_cfg`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+from pathlib import Path
+from typing import Any, Callable
+
+from deepdfa_tpu.core import Config, config as config_mod
+
+logger = logging.getLogger(__name__)
+
+#: checkpoint subdirectory per model family (the training CLI's layout)
+CKPT_DIR_BY_FAMILY = {
+    "deepdfa": "checkpoints",
+    "combined": "checkpoints-combined",
+    "t5": "checkpoints-combined",
+}
+
+
+class RegistryError(RuntimeError):
+    """Registry-level restore failure with an operator-grade message."""
+
+
+def config_digest(cfg: Config) -> str:
+    """Digest of the config sections that determine parameter shapes and
+    feature semantics (model + data). Two runs with equal digests produce
+    checkpoints that are shape-compatible AND feature-compatible, which
+    is the hot-swap admission criterion."""
+    d = config_mod._to_dict(cfg)
+    payload = json.dumps(
+        {"model": d["model"], "data": d["data"]}, sort_keys=True
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def config_drift(saved: dict, current: dict, prefix: str = "") -> list[str]:
+    """Dotted keys (model./data. sections) whose values differ between a
+    run's saved config.json and the config being served with — the
+    'clear error naming the mismatched config keys' payload."""
+    out: list[str] = []
+    for section in ("model", "data"):
+        a, b = saved.get(section, {}), current.get(section, {})
+        out.extend(_dict_drift(a, b, f"{section}."))
+    return out
+
+
+def _dict_drift(a: Any, b: Any, prefix: str) -> list[str]:
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = []
+        for k in sorted(set(a) | set(b)):
+            out.extend(_dict_drift(a.get(k), b.get(k), f"{prefix}{k}."))
+        return out
+    # tuples round-trip to lists through json
+    na = list(a) if isinstance(a, (list, tuple)) else a
+    nb = list(b) if isinstance(b, (list, tuple)) else b
+    return [] if na == nb else [prefix.rstrip(".")]
+
+
+def load_run_config(run_dir: Path) -> Config:
+    """The run's saved config.json — the manifest checkpoint restores
+    must be built against (same contract as cli `_load_run_config`)."""
+    path = Path(run_dir) / "config.json"
+    if not path.exists():
+        raise RegistryError(
+            f"{path} not found — the run directory must hold the "
+            f"config.json the training CLI writes (is {run_dir} a run?)"
+        )
+    cfg = config_mod.load(path)
+    config_mod.validate(cfg)
+    return cfg
+
+
+def load_vocabs(cfg: Config) -> tuple[dict, str]:
+    """The run's abstract-dataflow vocabularies + their content digest.
+
+    The file name encodes the full FeatureSpec, so a feat-spec drift
+    between extraction and serving is a missing file here (named), and a
+    re-extraction under the same spec changes the digest — which the
+    hot-swap admission check and /healthz both surface."""
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.frontend.vocab import AbsDfVocab
+
+    vocab_path = (
+        paths.processed_dir(cfg.data.dataset)
+        / f"vocab{cfg.data.feat.name}.json"
+    )
+    if not vocab_path.exists():
+        raise RegistryError(
+            f"vocab file {vocab_path} not found — serving needs the "
+            f"vocabularies the checkpoint was trained with (run `extract` "
+            f"with the same data.feat.* settings, or fix data.feat.* to "
+            f"match the training run)"
+        )
+    raw = vocab_path.read_bytes()
+    vocabs = {
+        k: AbsDfVocab.from_json(v) for k, v in json.loads(raw).items()
+    }
+    want = cfg.data.feat.input_dim
+    for k, v in vocabs.items():
+        if v.input_dim > want:
+            raise RegistryError(
+                f"vocab subkey {k!r} input_dim {v.input_dim} exceeds "
+                f"data.feat.limit_all+2={want} — the vocab on disk was "
+                f"built with different data.feat.limit_all than this "
+                f"config declares"
+            )
+    return vocabs, hashlib.sha256(raw).hexdigest()[:16]
+
+
+class ModelRegistry:
+    """Restores and holds the serving state for one run.
+
+    Thread-safe params access: the batcher's device thread reads
+    `params()` per batch while `maybe_reload()` may swap underneath —
+    the swap is a single reference assignment under the lock, so a batch
+    sees either the old or the new weights, never a mix.
+    """
+
+    def __init__(
+        self,
+        run_dir: str | Path,
+        family: str = "deepdfa",
+        checkpoint: str = "best",
+        cfg: Config | None = None,
+        model_cfg: Any = None,
+    ):
+        if family not in CKPT_DIR_BY_FAMILY:
+            raise RegistryError(
+                f"unknown model family {family!r}; "
+                f"known: {sorted(CKPT_DIR_BY_FAMILY)}"
+            )
+        self.run_dir = Path(run_dir)
+        self.family = family
+        self.checkpoint = checkpoint
+        self.cfg = cfg if cfg is not None else load_run_config(self.run_dir)
+        self.model_cfg = model_cfg
+        if family in ("combined", "t5") and model_cfg is None:
+            raise RegistryError(
+                f"family {family!r} needs the encoder model_cfg the run "
+                f"was trained with (the CLI builds it from "
+                f"--arch/--encoder/--max-length, as train-combined did)"
+            )
+        if family == "deepdfa" and self.cfg.model.label_style != "graph":
+            raise RegistryError(
+                f"serving supports model.label_style='graph' only "
+                f"(got {self.cfg.model.label_style!r})"
+            )
+        self.config_digest = config_digest(self.cfg)
+        self.vocabs, self.vocab_digest = load_vocabs(self.cfg)
+        self._lock = threading.Lock()
+        self._params = None
+        self._loaded_step: int | None = None
+        self._loaded_manifest_sig: tuple | None = None
+        self._model = None
+        self._apply: Callable | None = None
+        self._mgr = None
+        self.reloads = 0
+        self._load_initial()
+
+    # -- construction --------------------------------------------------------
+
+    @property
+    def ckpt_dir(self) -> Path:
+        return self.run_dir / CKPT_DIR_BY_FAMILY[self.family]
+
+    def _abstract_params(self):
+        """A params pytree of the right structure/shapes to restore into
+        (concrete but throwaway — init at the serving dims)."""
+        import jax
+
+        if self.family == "deepdfa":
+            from deepdfa_tpu.graphs.batch import pack
+            from deepdfa_tpu.models import DeepDFA
+
+            model = DeepDFA.from_config(
+                self.cfg.model, input_dim=self.cfg.data.feat.input_dim
+            )
+            dummy = pack(
+                [], 1, 64, 256, feat_width=self._feat_width(),
+                etypes=self.cfg.model.n_etypes > 1,
+            )
+            params = model.init(jax.random.key(0), dummy)
+            self._model = model
+            return jax.device_get(params)
+        from deepdfa_tpu.models import combined as cmb
+        from deepdfa_tpu.models import t5 as t5m
+
+        init = (
+            t5m.init_defect_params if self.family == "t5" else cmb.init_params
+        )
+        return jax.device_get(init(self.model_cfg, jax.random.key(0)))
+
+    def _feat_width(self) -> int:
+        from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
+
+        width = NUM_SUBKEY_FEATS
+        if getattr(self.cfg.model, "struct_feats", False):
+            from deepdfa_tpu.frontend.structfeat import STRUCT_VOCAB
+
+            width += len(STRUCT_VOCAB)
+        return width
+
+    def _manifest_sig(self) -> tuple | None:
+        """(step, mtime_ns) of the tracked tag per the manifest — the
+        cheap change detector maybe_reload polls."""
+        path = self.ckpt_dir / "manifest.json"
+        try:
+            st = path.stat()
+            manifest = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            return None
+        if self.checkpoint == "best":
+            entry = manifest.get("best")
+        elif self.checkpoint == "last":
+            entry = manifest.get("last")
+        else:
+            entry = next(
+                (e for e in reversed(manifest.get("history", []))
+                 if e.get("tag") == self.checkpoint),
+                None,
+            )
+        step = entry.get("step", -1) if entry else -1
+        return (step, st.st_mtime_ns)
+
+    def _restore(self):
+        """One params restore with operator-grade errors."""
+        from deepdfa_tpu.train.checkpoint import (
+            CheckpointManager,
+            CheckpointMismatch,
+        )
+
+        if self._mgr is None:
+            if not self.ckpt_dir.is_dir():
+                raise RegistryError(
+                    f"no checkpoint directory {self.ckpt_dir} — family "
+                    f"{self.family!r} expects the "
+                    f"{CKPT_DIR_BY_FAMILY[self.family]}/ layout the "
+                    f"training CLI writes"
+                )
+            self._mgr = CheckpointManager(self.ckpt_dir)
+        target = self._abstract_params()
+        try:
+            return self._mgr.restore_for_inference(self.checkpoint, target)
+        except CheckpointMismatch as e:
+            # name the CONFIG keys when the saved run config can tell us
+            saved_path = self.run_dir / "config.json"
+            drift: list[str] = []
+            if saved_path.exists():
+                drift = config_drift(
+                    json.loads(saved_path.read_text()),
+                    config_mod._to_dict(self.cfg),
+                )
+            if drift:
+                raise RegistryError(
+                    f"checkpoint restore failed; config keys differ from "
+                    f"the run's saved config.json: {drift} — ({e})"
+                ) from e
+            raise RegistryError(str(e)) from e
+
+    def _load_initial(self) -> None:
+        import jax
+
+        sig = self._manifest_sig()
+        params = self._restore()
+        with self._lock:
+            self._params = jax.device_put(params)
+            self._loaded_manifest_sig = sig
+            self._loaded_step = sig[0] if sig else None
+
+    # -- serving surface -----------------------------------------------------
+
+    def params(self):
+        with self._lock:
+            return self._params
+
+    @property
+    def model(self):
+        """The flax module (deepdfa family only)."""
+        return self._model
+
+    def maybe_reload(self) -> bool:
+        """Poll the manifest; hot-swap params when the tracked tag moved.
+
+        Called between batches (never mid-batch). A checkpoint whose
+        config/vocab digest changed is REFUSED (logged, old params keep
+        serving) — shape-compatible-by-luck weights from a different
+        recipe must not slide in silently."""
+        sig = self._manifest_sig()
+        if sig is None or sig == self._loaded_manifest_sig:
+            return False
+        try:
+            new_cfg = load_run_config(self.run_dir)
+            if config_digest(new_cfg) != self.config_digest:
+                drift = config_drift(
+                    config_mod._to_dict(new_cfg),
+                    config_mod._to_dict(self.cfg),
+                )
+                logger.warning(
+                    "hot-swap refused: run config changed (%s); still "
+                    "serving step %s", drift, self._loaded_step,
+                )
+                self._loaded_manifest_sig = sig  # don't re-log every poll
+                return False
+            _, vocab_digest = load_vocabs(self.cfg)
+            if vocab_digest != self.vocab_digest:
+                logger.warning(
+                    "hot-swap refused: vocab digest changed (%s -> %s); "
+                    "still serving step %s",
+                    self.vocab_digest, vocab_digest, self._loaded_step,
+                )
+                self._loaded_manifest_sig = sig
+                return False
+            import jax
+
+            params = self._restore()
+            with self._lock:
+                self._params = jax.device_put(params)
+                self._loaded_manifest_sig = sig
+                self._loaded_step = sig[0]
+            self.reloads += 1
+            from deepdfa_tpu.obs import metrics as obs_metrics
+
+            obs_metrics.REGISTRY.counter("serve/hot_swaps").inc()
+            logger.info("hot-swapped to checkpoint step %s", sig[0])
+            return True
+        except (RegistryError, OSError) as e:
+            # a half-written checkpoint mid-poll must not kill serving
+            logger.warning("hot-swap attempt failed (%s); keeping params", e)
+            return False
+
+    def info(self) -> dict:
+        """/healthz payload: what is serving, from where, pinned how."""
+        return {
+            "family": self.family,
+            "run_dir": str(self.run_dir),
+            "checkpoint": self.checkpoint,
+            "checkpoint_step": self._loaded_step,
+            "config_digest": self.config_digest,
+            "vocab_digest": self.vocab_digest,
+            "hot_swaps": self.reloads,
+        }

@@ -1,0 +1,286 @@
+"""HTTP scoring endpoint + offline batch scorer (docs/serving.md).
+
+stdlib-only (`http.server.ThreadingHTTPServer`) — the serving tax we
+actually care about is device batching, not framework features:
+
+  POST /score   {"code": "<C function>"}   -> {"ok": true, "prob": p}
+  GET  /healthz                            -> model/checkpoint identity
+  GET  /stats                              -> queue/latency/cache stats
+
+Request lifecycle (see docs/serving.md for the diagram):
+  HTTP thread -> frontend (cached feature extraction) -> bounded queue
+  -> bucket scheduler (serve/batcher.py) -> AOT executable -> response.
+Admission control maps to status codes: a full queue is 429, an
+unparseable function 422, an over-budget graph 413 — the caller learns
+to back off or split, the device never sees the bad request.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+from deepdfa_tpu.serve.batcher import (
+    DynamicBatcher,
+    GgnnExecutor,
+    QueueFull,
+    RequestTooLarge,
+)
+from deepdfa_tpu.serve.frontend import FrontendError, RequestPreprocessor
+from deepdfa_tpu.serve.registry import ModelRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class ScoringService:
+    """Registry + frontend + batcher wired per the serve config — the
+    one object both the HTTP server and the offline `score` CLI drive."""
+
+    def __init__(self, registry: ModelRegistry, cfg=None):
+        cfg = cfg if cfg is not None else registry.cfg
+        self.cfg = cfg
+        scfg = cfg.serve
+        self.registry = registry
+        node_budget = scfg.node_budget or cfg.data.batch.node_budget
+        edge_budget = scfg.edge_budget or cfg.data.batch.edge_budget
+        if registry.family != "deepdfa":
+            raise NotImplementedError(
+                "ScoringService wires the flagship GGNN family; combined/"
+                "t5 serving drives CombinedExecutor directly (see "
+                "docs/serving.md)"
+            )
+        self.frontend = RequestPreprocessor(
+            cfg, registry.vocabs,
+            use_joern=scfg.use_joern,
+            cache_entries=scfg.feature_cache_entries,
+        )
+        self.executor = GgnnExecutor(
+            registry.model, registry.params,
+            node_budget=node_budget, edge_budget=edge_budget,
+            max_batch_graphs=scfg.max_batch_graphs,
+            feat_width=registry._feat_width(),
+            etypes=cfg.model.n_etypes > 1,
+        )
+        self.batcher = DynamicBatcher(
+            self.executor,
+            queue_limit=scfg.queue_limit,
+            max_batch_delay_s=scfg.max_batch_delay_ms / 1000.0,
+            on_batch=(registry.maybe_reload if scfg.hot_swap else None),
+        )
+        self.warmup_report = self.executor.warmup()
+        self.lowerings_after_warmup = self.executor.jit_lowerings()
+
+    def submit_code(self, code: str):
+        """frontend + enqueue; the caller waits on the returned request."""
+        spec = self.frontend.features(code)
+        return self.batcher.submit(spec)
+
+    def steady_state_recompiles(self) -> int:
+        return self.executor.jit_lowerings() - self.lowerings_after_warmup
+
+    def healthz(self) -> dict:
+        info = self.registry.info()
+        info.update(
+            warmed_signatures=[
+                list(s) for s in self.executor.signatures()
+            ],
+            jit_lowerings=self.executor.jit_lowerings(),
+            steady_state_recompiles=self.steady_state_recompiles(),
+        )
+        return info
+
+    def stats(self) -> dict:
+        out = self.batcher.stats()
+        out["feature_cache_entries"] = len(self.frontend.cache)
+        snap = obs_metrics.REGISTRY.snapshot()
+        out["serve"] = {
+            k[len("serve/"):]: v
+            for k, v in snap.items()
+            if k.startswith("serve/")
+        }
+        return out
+
+    def serve_record(self) -> dict:
+        """One run-log record of the serve metrics (flattened by
+        `flatten_scalars` into the `serve/*` tags SCHEMA declares)."""
+        snap = obs_metrics.REGISTRY.snapshot()
+        return {
+            "serve": {
+                k[len("serve/"):]: v
+                for k, v in snap.items()
+                if k.startswith("serve/")
+            }
+        }
+
+    def start(self) -> None:
+        self.batcher.start()
+
+    def close(self) -> None:
+        self.batcher.close()
+        self.frontend.close()
+
+
+def write_serve_log(run_dir, records) -> Path:
+    """Append serve records to <run_dir>/serve_log.jsonl — the log
+    scripts/check_obs_schema.py --serve-smoke validates against SCHEMA."""
+    path = Path(run_dir) / "serve_log.jsonl"
+    with path.open("a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def score_texts(
+    service: ScoringService, texts: list[tuple[str, str]],
+    timeout_s: float = 120.0,
+) -> list[dict]:
+    """Offline scoring of (name, code) pairs through the online path.
+
+    Frontend failures become per-row errors, never a crash; the batcher
+    groups whatever was admitted exactly as live traffic would."""
+    rows: list[dict] = []
+    payloads: list[tuple[dict, Any]] = []
+    for name, code in texts:
+        row = {"name": name}
+        rows.append(row)  # input order preserved
+        try:
+            payloads.append((row, service.frontend.features(code)))
+        except (FrontendError, RequestTooLarge) as e:
+            row.update(ok=False, error=str(e))
+    reqs = service.batcher.score_all([spec for _, spec in payloads])
+    for (row, _), req in zip(payloads, reqs):
+        try:
+            row.update(ok=True, prob=req.wait(timeout_s))
+        except Exception as e:  # noqa: BLE001 - per-row fault isolation
+            row.update(ok=False, error=str(e))
+    return rows
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: ScoringService = None  # set by make_server
+    request_timeout_s: float = 60.0
+
+    def _reply(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("http: " + fmt, *args)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            self._reply(200, self.service.healthz())
+        elif self.path == "/stats":
+            self._reply(200, self.service.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/score":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            code = payload["code"]
+        except (ValueError, KeyError) as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        t0 = time.monotonic()
+        try:
+            req = self.service.submit_code(code)
+            prob = req.wait(self.request_timeout_s)
+        except QueueFull as e:
+            self._reply(429, {"error": str(e)})
+            return
+        except RequestTooLarge as e:
+            self._reply(413, {"error": str(e)})
+            return
+        except FrontendError as e:
+            self._reply(422, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        self._reply(
+            200,
+            {
+                "ok": True,
+                "prob": prob,
+                "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            },
+        )
+
+
+def make_server(
+    service: ScoringService, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bound (not yet serving) HTTP server; port 0 picks an ephemeral
+    port (server.server_address[1] has the real one)."""
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_forever(service: ScoringService, host: str, port: int) -> None:
+    service.start()
+    httpd = make_server(service, host, port)
+    real_port = httpd.server_address[1]
+    print(
+        json.dumps({
+            "serving": True, "host": host, "port": real_port,
+            **service.healthz(),
+        }),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        service.close()
+
+
+class BackgroundServer:
+    """In-process server on an ephemeral port (smoke mode + tests)."""
+
+    def __init__(self, service: ScoringService, host: str = "127.0.0.1"):
+        self.service = service
+        service.start()
+        self.httpd = make_server(service, host, 0)
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def request(self, method: str, path: str, payload: dict | None = None):
+        import http.client
+
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
+        body = json.dumps(payload) if payload is not None else None
+        conn.request(
+            method, path, body=body,
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        resp = conn.getresponse()
+        data = json.loads(resp.read() or b"{}")
+        conn.close()
+        return resp.status, data
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=10)
+        self.service.close()

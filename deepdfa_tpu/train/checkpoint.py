@@ -30,6 +30,76 @@ from deepdfa_tpu.core.ioutil import atomic_write_text
 logger = logging.getLogger(__name__)
 
 
+class CheckpointMismatch(RuntimeError):
+    """A checkpoint's on-disk parameter tree does not match the model
+    being restored into — named key paths instead of orbax's opaque
+    pytree-structure error, so the operator can see WHICH config knob
+    (model dims, feature-vocab limits) drifted between train and serve.
+
+    `missing`: param paths the model expects but the checkpoint lacks;
+    `unexpected`: paths the checkpoint holds but the model lacks;
+    `shape_mismatches`: {path: (checkpoint_shape, model_shape)}."""
+
+    def __init__(self, directory, missing, unexpected, shape_mismatches):
+        self.directory = str(directory)
+        self.missing = tuple(missing)
+        self.unexpected = tuple(unexpected)
+        self.shape_mismatches = dict(shape_mismatches)
+        parts = [f"checkpoint {self.directory} does not match the model"]
+        if self.missing:
+            parts.append(
+                "missing from checkpoint: " + ", ".join(self.missing[:8])
+                + ("..." if len(self.missing) > 8 else "")
+            )
+        if self.unexpected:
+            parts.append(
+                "not in model: " + ", ".join(self.unexpected[:8])
+                + ("..." if len(self.unexpected) > 8 else "")
+            )
+        if self.shape_mismatches:
+            parts.append(
+                "shape mismatches: " + ", ".join(
+                    f"{k}: ckpt{tuple(a)} vs model{tuple(b)}"
+                    for k, (a, b) in list(self.shape_mismatches.items())[:8]
+                )
+            )
+        parts.append(
+            "(likely a model/data config drift between the training run "
+            "and this restore — e.g. model.hidden_dim, model.n_steps, "
+            "data.feat.limit_all, model.struct_feats)"
+        )
+        super().__init__("; ".join(parts))
+
+
+def jax_tree_zeros(meta_tree: Any) -> Any:
+    """Zero-filled numpy arrays shaped like an orbax metadata subtree —
+    placeholder restore targets for state we read but discard (the
+    optimizer half of a full-TrainState checkpoint)."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(
+        lambda m: np.zeros(
+            tuple(getattr(m, "shape", ()) or ()),
+            getattr(m, "dtype", np.float32),
+        ),
+        meta_tree,
+    )
+
+
+def _flat_paths(tree: Any) -> dict[str, Any]:
+    """Flatten a params pytree (or orbax metadata tree) to
+    {'a/b/c': leaf} — the shared coordinate system CheckpointMismatch
+    reports in."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in leaves:
+        out["/".join(str(getattr(k, "key", k)) for k in path)] = leaf
+    return out
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -141,6 +211,80 @@ class CheckpointManager:
         """Restore into the structure of `target` (an abstract or concrete
         pytree of the same shape)."""
         return self._ckpt.restore(self.directory / tag, target=target)
+
+    def available_tags(self) -> list[str]:
+        """Checkpoint directories actually on disk (manifest-independent)."""
+        if not self.directory.is_dir():
+            return []
+        return sorted(
+            p.name for p in self.directory.iterdir() if p.is_dir()
+        )
+
+    def restore_for_inference(self, tag: str, params_target: Any) -> Any:
+        """Params-only restore for serving (serve/registry.py).
+
+        Accepts both checkpoint layouts this repo writes: the epoch
+        checkpoints (bare params pytree, what `save` stores) and the
+        resilience step checkpoints (full TrainState dict) — for the
+        latter only the `params` subtree is returned, the optimizer
+        state is discarded (zero-filled placeholders satisfy orbax's
+        full-structure restore; it is never device_put).
+
+        Structure problems raise `CheckpointMismatch` naming the
+        missing/extra/mis-shaped parameter paths (and the config knobs
+        that usually cause them) instead of orbax's opaque pytree error.
+        """
+        import numpy as np
+
+        path = self.directory / tag
+        if not path.is_dir():
+            avail = self.available_tags()
+            raise FileNotFoundError(
+                f"no checkpoint tag {tag!r} under {self.directory}"
+                + (f"; available: {avail}" if avail else " (empty dir)")
+            )
+        try:
+            meta = self._ckpt.metadata(path)
+        except Exception as e:  # unreadable/corrupt checkpoint dir
+            raise CheckpointMismatch(
+                path, missing=(), unexpected=(f"<unreadable: {e}>",),
+                shape_mismatches={},
+            ) from e
+        # full-TrainState layout (resilience step checkpoints): restore
+        # params for real, everything else into throwaway zero buffers
+        # "opt_state" alongside "params" is unambiguous: no model's own
+        # param dict carries that sibling (flax trees nest under a single
+        # "params" key; combined trees use encoder/head/graph)
+        wrap = (
+            isinstance(meta, dict)
+            and "params" in meta
+            and "opt_state" in meta
+        )
+        saved_params_meta = meta["params"] if wrap else meta
+        want = _flat_paths(params_target)
+        have = _flat_paths(saved_params_meta)
+        missing = sorted(set(want) - set(have))
+        unexpected = sorted(set(have) - set(want))
+        shape_mismatches = {}
+        for k in set(want) & set(have):
+            ws = tuple(getattr(want[k], "shape", ()) or ())
+            hs = tuple(getattr(have[k], "shape", ()) or ())
+            if ws != hs:
+                shape_mismatches[k] = (hs, ws)
+        if missing or unexpected or shape_mismatches:
+            raise CheckpointMismatch(
+                path, missing, unexpected, shape_mismatches
+            )
+        if not wrap:
+            return self._ckpt.restore(path, target=params_target)
+        full_target = {
+            k: (
+                params_target if k == "params"
+                else jax_tree_zeros(v)
+            )
+            for k, v in meta.items()
+        }
+        return self._ckpt.restore(path, target=full_target)["params"]
 
     def best_metrics(self) -> dict[str, float] | None:
         best = self._manifest["best"]

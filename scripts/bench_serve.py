@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Serving throughput/latency benchmark (docs/serving.md).
+
+Drives the online scoring stack — cached frontend -> dynamic batcher ->
+AOT bucket executables (deepdfa_tpu/serve/) — over a synthetic corpus
+and reports:
+
+  serve_requests_per_sec      warm pass (feature-cache hits: the heavy-
+                              traffic repeat-function case the cache
+                              exists for)
+  serve_cold_requests_per_sec first pass (frontend extraction included)
+  serve_latency_p50_ms / serve_latency_p99_ms  (warm pass)
+  serve_batch_occupancy_mean  mean fill fraction of executed batches
+  serve_steady_state_recompiles  must be 0 after warmup
+
+Modes:
+    python scripts/bench_serve.py --smoke   # tier-1 regression mode
+    python scripts/bench_serve.py           # full mode (bigger corpus)
+
+No checkpoint round trip: the model is a freshly initialized GGNN (the
+benchmark measures the serving machinery, not the weights); the restore
+path has its own e2e coverage (`deepdfa-tpu score --smoke`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_serve(
+    n_examples: int = 256, smoke: bool = False, max_batch: int = 8
+) -> dict:
+    import jax
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.obs import metrics as obs_metrics
+    from deepdfa_tpu.serve.batcher import DynamicBatcher, GgnnExecutor
+    from deepdfa_tpu.serve.frontend import RequestPreprocessor
+
+    n = min(n_examples, 48) if smoke else int(n_examples)
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8" if smoke else "model.hidden_dim=32",
+        "model.n_steps=2" if smoke else "model.n_steps=5",
+        f"serve.max_batch_graphs={max_batch}",
+    ])
+    synth = generate(n, seed=0)
+    examples = to_examples(synth)
+    # vocabularies straight from the corpus (no disk round trip)
+    _, vocabs = build_dataset(
+        examples, train_ids=range(n),
+        limit_all=cfg.data.feat.limit_all,
+        limit_subkeys=cfg.data.feat.limit_subkeys,
+    )
+    model = DeepDFA.from_config(
+        cfg.model, input_dim=cfg.data.feat.input_dim
+    )
+    node_budget, edge_budget = 2048, 8192
+    pre = RequestPreprocessor(cfg, vocabs, cache_entries=4 * n)
+    from deepdfa_tpu.graphs.batch import pack
+
+    params = model.init(
+        jax.random.key(0),
+        pack([], 1, node_budget, edge_budget),
+    )
+    executor = GgnnExecutor(
+        model, lambda: params,
+        node_budget=node_budget, edge_budget=edge_budget,
+        max_batch_graphs=max_batch,
+    )
+    t0 = time.perf_counter()
+    warm_report = executor.warmup()
+    warmup_seconds = time.perf_counter() - t0
+    lowerings0 = executor.jit_lowerings()
+
+    def one_pass() -> tuple[float, int, list[float]]:
+        batcher = DynamicBatcher(
+            executor, queue_limit=max(64, n),
+            max_batch_delay_s=0.005,
+        )
+        payloads = []
+        for e in examples:
+            try:
+                payloads.append(pre.features(e.code, e.id))
+            except Exception:
+                pass
+        t0 = time.perf_counter()
+        reqs = batcher.score_all(payloads)
+        dt = time.perf_counter() - t0
+        latencies = sorted(batcher.recent_latencies)
+        batcher.close()
+        return dt, len(reqs), latencies
+
+    cold_dt, scored, _ = one_pass()  # frontend runs (cache cold)
+    warm_dt, _, lat = one_pass()  # cache hits: batching + device only
+
+    from deepdfa_tpu.serve.batcher import percentile
+
+    def pct_ms(p):
+        v = percentile(lat, p)
+        return None if v is None else round(1e3 * v, 3)
+
+    return {
+        "metric": "serve_requests_per_sec",
+        "value": round(scored / warm_dt, 2) if warm_dt else 0.0,
+        "unit": "requests/s",
+        "serve_requests_per_sec": (
+            round(scored / warm_dt, 2) if warm_dt else 0.0
+        ),
+        "serve_cold_requests_per_sec": (
+            round(scored / cold_dt, 2) if cold_dt else 0.0
+        ),
+        "serve_latency_p50_ms": pct_ms(0.50),
+        "serve_latency_p99_ms": pct_ms(0.99),
+        "serve_batch_occupancy_mean": round(
+            obs_metrics.REGISTRY.snapshot().get(
+                "serve/batch_occupancy/mean", 0.0
+            ), 4,
+        ),
+        "serve_scored": scored,
+        "serve_warmup_seconds": round(warmup_seconds, 3),
+        "serve_warmed_signatures": len(warm_report),
+        "serve_jit_lowerings": executor.jit_lowerings(),
+        "serve_steady_state_recompiles": (
+            executor.jit_lowerings() - lowerings0
+        ),
+        "n_examples": n,
+        "max_batch_graphs": max_batch,
+        "smoke": smoke,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--examples", type=int, default=256)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 regression mode: tiny corpus/model, asserts the "
+        "zero-recompile serving contract",
+    )
+    args = ap.parse_args()
+
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+    apply_platform_override()
+
+    record = bench_serve(
+        args.examples, smoke=args.smoke, max_batch=args.max_batch
+    )
+    from deepdfa_tpu.obs import run_stamp
+
+    record.update(run_stamp())
+    print(json.dumps(record), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(record, indent=1))
+    if args.smoke and record["serve_steady_state_recompiles"]:
+        raise SystemExit(
+            f"{record['serve_steady_state_recompiles']} steady-state "
+            f"recompiles in smoke mode (expected 0)"
+        )
+
+
+if __name__ == "__main__":
+    main()

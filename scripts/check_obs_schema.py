@@ -14,6 +14,11 @@ Modes:
                  obs.metrics on, val split, RunLogger) and validate the
                  train_log.jsonl it produces  [tier-1 default]
   --log <path>   validate an existing train_log.jsonl instead
+  --serve-smoke  run the serve smoke (train a tiny checkpoint, score it
+                 through the online path) and validate the
+                 serve_log.jsonl it produces — the `serve/*` tag half of
+                 the schema (docs/serving.md)
+  --serve-log <path>  validate an existing serve_log.jsonl
 """
 
 from __future__ import annotations
@@ -70,6 +75,25 @@ def smoke_records() -> list[dict]:
         ]
 
 
+def serve_smoke_records() -> list[dict]:
+    """Serve smoke end to end (train a tiny checkpoint, score its corpus
+    through the online batcher) and return the serve_log.jsonl records —
+    the `serve/*` half of the declared schema."""
+    from deepdfa_tpu.serve import driver
+
+    cfg, run_dir, sources_dir = driver.build_smoke_run(
+        run_name="schema-serve-smoke", dataset="schema-serve-smoke"
+    )
+    driver.run_score(
+        cfg, run_dir, driver.collect_sources([str(sources_dir)])
+    )
+    return [
+        json.loads(line)
+        for line in (run_dir / "serve_log.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -77,15 +101,21 @@ def main(argv=None) -> int:
                     "no --log is given)")
     ap.add_argument("--log", default=None,
                     help="validate an existing train_log.jsonl")
+    ap.add_argument("--serve-smoke", action="store_true",
+                    help="run the serve smoke and validate its "
+                    "serve_log.jsonl")
+    ap.add_argument("--serve-log", default=None,
+                    help="validate an existing serve_log.jsonl")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
     from deepdfa_tpu.obs import metrics
 
-    if args.log:
+    if args.log or args.serve_log:
         records = [
             json.loads(line)
-            for line in Path(args.log).read_text().splitlines()
+            for line in Path(args.log or args.serve_log)
+            .read_text().splitlines()
             if line.strip()
         ]
     else:
@@ -93,7 +123,9 @@ def main(argv=None) -> int:
 
         os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
         apply_platform_override()
-        records = smoke_records()
+        records = (
+            serve_smoke_records() if args.serve_smoke else smoke_records()
+        )
 
     from deepdfa_tpu.train.logging import flatten_scalars
 

@@ -1,0 +1,501 @@
+"""Online inference subsystem (deepdfa_tpu/serve/, docs/serving.md).
+
+The load-bearing invariants, in-process (the CLI surface is covered by
+tests/test_serve_cli.py subprocesses):
+
+- batching is a pure throughput decision: any interleaving of request
+  arrivals scores BIT-IDENTICALLY to scoring each request alone
+  (padding/bucketing must not leak across requests);
+- the flush timer bounds a lone request's wait;
+- admission control rejects at queue_limit (backpressure, not buffering);
+- AOT warmup means zero steady-state lowerings;
+- the registry restores params-only, names mismatches, and hot-swaps
+  between batches without recompiling.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.serve.batcher import (
+    DynamicBatcher,
+    GgnnExecutor,
+    QueueFull,
+    RequestTooLarge,
+)
+
+NODE_BUDGET, EDGE_BUDGET = 2048, 8192
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    synth = generate(16, seed=3)
+    examples = to_examples(synth)
+    specs, vocabs = build_dataset(
+        examples, train_ids=range(16), limit_all=50, limit_subkeys=50
+    )
+    return examples, specs, vocabs
+
+
+@pytest.fixture(scope="module")
+def served_model(corpus):
+    import jax
+
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+    ])
+    model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
+    params = model.init(
+        jax.random.key(0), pack([], 1, NODE_BUDGET, EDGE_BUDGET)
+    )
+    return cfg, model, params
+
+
+def make_executor(model, params, max_batch=4) -> GgnnExecutor:
+    return GgnnExecutor(
+        model, lambda: params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=max_batch,
+    )
+
+
+def test_batcher_bit_identical_any_interleaving(corpus, served_model):
+    """Property: for random arrival orders and batch compositions, every
+    request's score equals its singleton score EXACTLY."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=4)
+    executor.warmup()
+
+    # ground truth: each spec scored alone through the same machinery
+    alone = {}
+    for s in specs:
+        solo = DynamicBatcher(executor, queue_limit=8)
+        [req] = solo.score_all([s])
+        alone[s.graph_id] = req.result
+
+    rng = np.random.default_rng(0)
+    for round_ in range(4):
+        order = rng.permutation(len(specs))
+        batcher = DynamicBatcher(executor, queue_limit=64)
+        reqs = batcher.score_all([specs[i] for i in order])
+        for i, req in zip(order, reqs):
+            gid = specs[i].graph_id
+            assert req.result == alone[gid], (
+                f"round {round_}: graph {gid} scored {req.result} "
+                f"batched vs {alone[gid]} alone"
+            )
+
+
+def test_zero_steady_state_lowerings(corpus, served_model):
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=4)
+    executor.warmup()
+    n0 = executor.jit_lowerings()
+    assert n0 == len(executor.sizes)
+    assert executor.warmup() == {}  # idempotent
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        sel = rng.choice(len(specs), size=rng.integers(1, 9), replace=False)
+        batcher = DynamicBatcher(executor, queue_limit=64)
+        batcher.score_all([specs[i] for i in sel])
+    assert executor.jit_lowerings() == n0
+
+
+def test_flush_timer_lone_request(corpus, served_model):
+    """A lone request must flush after max_batch_delay, not wait for
+    co-arrivals."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=4)
+    executor.warmup()
+    batcher = DynamicBatcher(
+        executor, queue_limit=8, max_batch_delay_s=0.02
+    )
+    batcher.start()
+    try:
+        req = batcher.submit(specs[0])
+        prob = req.wait(timeout=10.0)  # >> delay; generous for CI
+        assert 0.0 <= prob <= 1.0
+        assert req.latency_s < 5.0
+    finally:
+        batcher.close()
+
+
+def test_backpressure_rejects_at_queue_limit(corpus, served_model):
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=4)
+    batcher = DynamicBatcher(executor, queue_limit=2)
+    rejected0 = obs_metrics.REGISTRY.counter("serve/rejected").value
+    batcher.submit(specs[0])
+    batcher.submit(specs[1])
+    with pytest.raises(QueueFull):
+        batcher.submit(specs[2])
+    assert (
+        obs_metrics.REGISTRY.counter("serve/rejected").value == rejected0 + 1
+    )
+    # draining frees capacity and admission recovers
+    batcher.drain()
+    batcher.submit(specs[2])
+    batcher.drain()
+
+
+def test_oversized_request_rejected(corpus, served_model):
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params)
+    big = dataclasses.replace(
+        specs[0],
+        node_feats=np.zeros((NODE_BUDGET + 1, 4), np.int32),
+        node_vuln=np.zeros((NODE_BUDGET + 1,), np.int32),
+    )
+    batcher = DynamicBatcher(executor, queue_limit=8)
+    with pytest.raises(RequestTooLarge):
+        batcher.submit(big)
+
+
+def test_oversized_request_isolated_in_offline_drive(corpus, served_model):
+    """score_all: one over-budget graph becomes a failed row; every
+    other request still scores (per-row fault isolation, never a
+    crashed job)."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params)
+    executor.warmup()
+    big = dataclasses.replace(
+        specs[0],
+        node_feats=np.zeros((NODE_BUDGET + 1, 4), np.int32),
+        node_vuln=np.zeros((NODE_BUDGET + 1,), np.int32),
+    )
+    batcher = DynamicBatcher(executor, queue_limit=8)
+    reqs = batcher.score_all([specs[0], big, specs[1]])
+    assert reqs[0].error is None and reqs[2].error is None
+    assert isinstance(reqs[1].error, RequestTooLarge)
+    with pytest.raises(RequestTooLarge):
+        reqs[1].wait(0.1)
+
+
+def test_feature_cache_and_frontend(corpus, served_model):
+    from deepdfa_tpu.obs import metrics as obs_metrics
+    from deepdfa_tpu.serve.frontend import (
+        FrontendError,
+        RequestPreprocessor,
+    )
+
+    examples, _, vocabs = corpus
+    cfg, _, _ = served_model
+    pre = RequestPreprocessor(cfg, vocabs, cache_entries=8)
+    hits = obs_metrics.REGISTRY.counter("serve/cache_hits")
+    h0 = hits.value
+    code = examples[0].code
+    s1 = pre.features(code)
+    s2 = pre.features(code)
+    assert hits.value == h0 + 1
+    assert s1 is s2  # the cache returns the SAME extraction
+    np.testing.assert_array_equal(s1.node_feats, s2.node_feats)
+    # failures are cached too
+    with pytest.raises(FrontendError):
+        pre.features("@@@ not C at all")
+    with pytest.raises(FrontendError, match="cached"):
+        pre.features("@@@ not C at all")
+    # bounded: the LRU never exceeds its configured entries
+    for e in examples:
+        try:
+            pre.features(e.code)
+        except FrontendError:
+            pass
+    assert len(pre.cache) <= 8
+
+
+def test_session_pool_replaces_dead_sessions():
+    from deepdfa_tpu.serve.frontend import SessionPool
+
+    created = []
+
+    class FakeSession:
+        def __init__(self, i):
+            self.i = i
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    pool = SessionPool(lambda i: created.append(FakeSession(i)) or created[-1],
+                       size=2)
+    with pool.session() as a:
+        pass
+    with pool.session() as b:
+        assert b is a  # healthy sessions are reused
+    with pytest.raises(RuntimeError):
+        with pool.session() as c:
+            raise RuntimeError("jvm died")
+    assert created[0].closed  # dead session left the pool
+    assert pool.replaced == 1
+    with pool.session() as d:
+        assert d is not created[0]
+    pool.close()
+    assert all(s.closed for s in created)
+
+
+def test_session_pool_discard_wakes_waiter():
+    """A waiter blocked on an exhausted pool must wake when a discard
+    frees CREATION capacity (not just when a session is returned)."""
+    import threading
+    import time
+
+    from deepdfa_tpu.serve.frontend import SessionPool
+
+    class FakeSession:
+        def close(self):
+            pass
+
+    pool = SessionPool(lambda i: FakeSession(), size=1)
+    lease = pool.session()
+    held = lease.__enter__()
+    got = []
+
+    def waiter():
+        with pool.session() as s:
+            got.append(s)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not got  # blocked: pool exhausted
+    lease.__exit__(RuntimeError, RuntimeError("jvm died"), None)
+    t.join(timeout=5)
+    assert got and got[0] is not held  # woken, served a FRESH session
+    pool.close()
+
+
+def _write_run(tmp_path, cfg, model, params, metrics, step=1):
+    """Real run-dir artifacts (config.json + checkpoints/best) without a
+    training loop."""
+    import jax
+
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    run_dir = tmp_path / "runs" / cfg.run_name
+    run_dir.mkdir(parents=True, exist_ok=True)
+    config_mod.to_json(cfg, run_dir / "config.json")
+    mgr = CheckpointManager(run_dir / "checkpoints", monitor="val_loss")
+    mgr.save(
+        f"epoch-{step:04d}", jax.device_get(params), metrics, step=step
+    )
+    return run_dir
+
+
+def test_registry_restore_and_hot_swap(tmp_path, monkeypatch, corpus,
+                                       served_model):
+    import jax
+
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.serve.registry import ModelRegistry
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    examples, specs, vocabs = corpus
+    cfg, model, params = served_model
+    cfg = config_mod.apply_overrides(
+        cfg, ['run_name="serve-reg"', 'data.dataset="serve-reg"']
+    )
+    (paths.processed_dir("serve-reg") / f"vocab{cfg.data.feat.name}.json"
+     ).write_text(json.dumps({k: v.to_json() for k, v in vocabs.items()}))
+    run_dir = _write_run(tmp_path, cfg, model, params, {"val_loss": 1.0})
+
+    registry = ModelRegistry(run_dir, family="deepdfa", cfg=cfg)
+    executor = GgnnExecutor(
+        registry.model, registry.params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=2,
+    )
+    executor.warmup()
+    n0 = executor.jit_lowerings()
+    batcher = DynamicBatcher(
+        executor, queue_limit=8, on_batch=registry.maybe_reload
+    )
+    [r1] = batcher.score_all([specs[0]])
+
+    # a newer, better checkpoint appears -> hot swap between batches
+    params2 = jax.tree.map(lambda a: a + 0.05, jax.device_get(params))
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    CheckpointManager(run_dir / "checkpoints", monitor="val_loss").save(
+        "epoch-0002", params2, {"val_loss": 0.5}, step=2
+    )
+    [r2] = batcher.score_all([specs[0]])
+    assert registry.reloads == 1
+    assert registry.info()["checkpoint_step"] == 2
+    assert r2.result != r1.result  # new weights actually serve
+    assert executor.jit_lowerings() == n0  # swap never recompiles
+
+
+def test_restore_for_inference_errors(tmp_path, served_model):
+    import jax
+
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.train.checkpoint import (
+        CheckpointManager,
+        CheckpointMismatch,
+    )
+
+    cfg, model, params = served_model
+    mgr = CheckpointManager(tmp_path / "ckpts", monitor="val_loss")
+    mgr.save("epoch-0001", jax.device_get(params), {"val_loss": 1.0}, step=1)
+
+    # happy path: params-only restore round-trips
+    got = mgr.restore_for_inference(
+        "epoch-0001", jax.device_get(params)
+    )
+    chk = jax.tree.leaves(got)[0]
+    np.testing.assert_array_equal(chk, jax.tree.leaves(jax.device_get(params))[0])
+
+    # a differently-sized model names the mismatched paths, not a pytree
+    # structure error
+    wide = DeepDFA.from_config(
+        config_mod.apply_overrides(cfg, ["model.hidden_dim=16"]).model,
+        input_dim=cfg.data.feat.input_dim,
+    )
+    wide_params = wide.init(
+        jax.random.key(0), pack([], 1, NODE_BUDGET, EDGE_BUDGET)
+    )
+    with pytest.raises(CheckpointMismatch) as ei:
+        mgr.restore_for_inference("epoch-0001", jax.device_get(wide_params))
+    assert ei.value.shape_mismatches
+    assert "hidden_dim" in str(ei.value)  # the config hint names knobs
+
+    # unknown tag: a clear listing, not an orbax stack trace
+    with pytest.raises(FileNotFoundError, match="epoch-0001"):
+        mgr.restore_for_inference("nope", jax.device_get(params))
+
+
+def test_restore_for_inference_skips_optimizer_state(tmp_path, served_model):
+    """A full-TrainState checkpoint (resilience layout) restores
+    params-only."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    _, _, params = served_model
+    host = jax.device_get(params)
+    full = {
+        "params": host,
+        "opt_state": {"mu": jax.tree.map(np.zeros_like, host)},
+        "step": np.zeros((), np.int32),
+    }
+    ckpt = ocp.StandardCheckpointer()
+    path = tmp_path / "ckpts" / "step-5"
+    ckpt.save(path, full, force=True)
+    ckpt.wait_until_finished()
+
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpts")
+    got = mgr.restore_for_inference("step-5", host)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(host)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_combined_executor_buckets(corpus):
+    """Text requests group by their PR-2 bucket edge and score through
+    AOT signature executables with zero steady-state lowerings."""
+    import jax
+
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.serve.batcher import CombinedExecutor
+
+    examples, specs, _ = corpus
+    tok = HashTokenizer(vocab_size=256)
+    enc = TransformerConfig.tiny(
+        vocab_size=tok.vocab_size, max_position_embeddings=68,
+        num_layers=1, num_heads=2, hidden_size=8, intermediate_size=16,
+    )
+    mcfg = cmb.CombinedConfig(
+        encoder=enc, graph_hidden_dim=8, graph_input_dim=52,
+        use_graph=False,
+    )
+    params = cmb.init_params(mcfg, jax.random.key(0))
+    executor = CombinedExecutor(
+        mcfg, lambda: params, tok, seq_buckets=(32, 64),
+        token_budget=256, node_budget=256, edge_budget=1024,
+    )
+    executor.warmup()
+    n0 = executor.jit_lowerings()
+    assert n0 == 2
+
+    payloads = [
+        (tok.encode(e.code, max_length=64), None) for e in examples[:6]
+    ]
+    keys = {executor.bucket_key(p) for p in payloads}
+    assert keys <= {32, 64}
+    batcher = DynamicBatcher(executor, queue_limit=16)
+    reqs = batcher.score_all(payloads)
+    assert all(0.0 <= r.result <= 1.0 for r in reqs)
+    # singleton equivalence on the text path (same bucket -> same padded
+    # shape -> identical row computation)
+    solo = DynamicBatcher(executor, queue_limit=4)
+    [alone] = solo.score_all([payloads[0]])
+    assert alone.result == reqs[0].result
+    assert executor.jit_lowerings() == n0
+
+
+def test_combined_executor_graphs_never_degrade(corpus):
+    """With graphs attached, the budget accounting must mirror collate()
+    exactly: an admitted chunk degrades NO row to has_graph=False, so
+    batched scores stay bit-identical to singleton scores (a degraded
+    row would score text-only batched but with its graph alone)."""
+    import jax
+
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.serve.batcher import CombinedExecutor
+
+    examples, specs, _ = corpus
+    tok = HashTokenizer(vocab_size=256)
+    enc = TransformerConfig.tiny(
+        vocab_size=tok.vocab_size, max_position_embeddings=68,
+        num_layers=1, num_heads=2, hidden_size=8, intermediate_size=16,
+    )
+    mcfg = cmb.CombinedConfig(
+        encoder=enc, graph_hidden_dim=8, graph_input_dim=52,
+        use_graph=True,
+    )
+    params = cmb.init_params(mcfg, jax.random.key(0))
+    # budgets tight enough that sloppy accounting would admit chunks
+    # collate() then degrades (specs here run ~3-40 nodes each)
+    executor = CombinedExecutor(
+        mcfg, lambda: params, tok, seq_buckets=(64,),
+        token_budget=512, node_budget=64, edge_budget=256,
+    )
+    executor.warmup()
+    by_id = {e.id: e for e in examples}
+    payloads = [
+        (tok.encode(by_id[s.graph_id].code, max_length=64), s)
+        for s in specs[:8]
+    ]
+    alone = {}
+    for p in payloads:
+        solo = DynamicBatcher(executor, queue_limit=4)
+        [req] = solo.score_all([p])
+        alone[id(p)] = req.result
+    batcher = DynamicBatcher(executor, queue_limit=32)
+    reqs = batcher.score_all(payloads)
+    for p, req in zip(payloads, reqs):
+        assert req.result == alone[id(p)], (
+            f"graph {p[1].graph_id} ({p[1].num_nodes} nodes): "
+            f"{req.result} batched vs {alone[id(p)]} alone"
+        )

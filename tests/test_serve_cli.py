@@ -1,0 +1,104 @@
+"""CLI `score`/`serve` smoke paths via real subprocesses (the argparse
+wiring can't rot silently), plus the serve halves of the schema checker
+and bench script — ISSUE 5 satellites.
+
+Subprocess-only by design (tests/conftest.py:run_cli): the CLI
+normalizes to a 1-device CPU platform, which must never leak into this
+8-virtual-device pytest process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.conftest import run_cli
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _last_json(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line in output: {stdout[-800:]}"
+    return json.loads(lines[-1])
+
+
+def test_score_smoke_end_to_end(tmp_path):
+    """`score --smoke`: train a tiny checkpoint, restore it through the
+    registry, score its corpus with ZERO steady-state recompiles, and
+    leave a schema-clean serve_log.jsonl behind."""
+    res = run_cli(tmp_path, "score", "--smoke", timeout=420)
+    summary = _last_json(res.stdout)
+    assert summary["serve_scored"] > 0
+    assert summary["serve_failed_requests"] == 0
+    assert summary["serve_steady_state_recompiles"] == 0
+    assert summary["serve_requests_per_sec"] > 0
+
+    run_dir = tmp_path / "runs" / "serve-smoke"
+    scores = [
+        json.loads(ln)
+        for ln in (run_dir / "scores.jsonl").read_text().splitlines()
+    ]
+    assert len(scores) == summary["serve_scored"]
+    assert all(0.0 <= s["prob"] <= 1.0 for s in scores if s["ok"])
+
+    # the serve metric tags are all declared in the obs SCHEMA
+    # (scripts/check_obs_schema.py --serve-log: the serve half of the
+    # schema drift guard, without a second smoke train)
+    serve_log = run_dir / "serve_log.jsonl"
+    assert serve_log.exists()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_schema.py"),
+         "--serve-log", str(serve_log)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu"),
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    record = json.loads(proc.stdout.splitlines()[0])
+    assert record["ok"] is True and record["undeclared"] == []
+
+
+def test_serve_smoke_http_round_trip(tmp_path):
+    """`serve --smoke`: real HTTP against an ephemeral port — scores
+    return 200, junk returns 422, /healthz and /stats answer, and the
+    device never recompiles after warmup."""
+    res = run_cli(tmp_path, "serve", "--smoke", timeout=420)
+    report = _last_json(res.stdout)
+    assert report["scored"] and all(
+        s["status"] == 200 and 0.0 <= s["prob"] <= 1.0
+        for s in report["scored"]
+    )
+    assert report["reject_status"] == 422
+    assert report["healthz_status"] == 200
+    assert report["healthz"]["warmed_signatures"]
+    assert report["healthz"]["checkpoint_step"] >= 0
+    assert report["stats_status"] == 200
+    assert report["stats"]["serve"]["batches"] >= 1
+    assert report["steady_state_recompiles"] == 0
+
+
+def test_bench_serve_smoke(tmp_path):
+    """scripts/bench_serve.py --smoke: stamped record with the serving
+    headline numbers (bench.py --child-serve consumes the same fn)."""
+    out = tmp_path / "serve_bench.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_serve.py"),
+         "--smoke", "--out", str(out)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu",
+                 DEEPDFA_TPU_STORAGE=str(tmp_path)),
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    record = json.loads(out.read_text())
+    assert record["metric"] == "serve_requests_per_sec"
+    assert record["value"] > 0
+    assert record["serve_latency_p50_ms"] is not None
+    assert record["serve_latency_p99_ms"] >= record["serve_latency_p50_ms"]
+    assert 0.0 < record["serve_batch_occupancy_mean"] <= 1.0
+    assert record["serve_steady_state_recompiles"] == 0
+    # provenance stamp, like every other bench record
+    for k in ("schema_version", "git_sha", "jax_version"):
+        assert k in record
