@@ -664,7 +664,14 @@ def main() -> None:
     retry, never a 1500s child timeout. Any CPU-fallback record embeds
     the newest committed watchdog TPU capture (``last_healthy_tpu``).
     """
-    from deepdfa_tpu.core.backend import cpu_pinned, probe_default_backend
+    from deepdfa_tpu.core.backend import cpu_pinned
+    from deepdfa_tpu.obs import health as obs_health
+
+    # probes route through obs/health so every attempt lands in the
+    # backend/* metrics (latency, retries, wedge detection) and the
+    # fallback record can embed a structured backend_health summary
+    # instead of only the concatenated fallback_from string (ISSUE 6)
+    probe_default_backend = obs_health.probe_backend
 
     deadline = time.time() + TOTAL_BUDGET
     errors: list[str] = []
@@ -687,6 +694,11 @@ def main() -> None:
         if errors and "error" not in result:
             if result.get("platform") == "cpu" and not cpu_pinned():
                 result["fallback_from"] = "; ".join(errors)
+                obs_health.record_fallback(result["fallback_from"])
+                # the structured twin of fallback_from: probe count,
+                # latencies, wedges — what scripts/bench_gate.py and
+                # the diag backend section read
+                result["backend_health"] = obs_health.summary()
             else:
                 result["warnings"] = "; ".join(errors)
         if result.get("platform") != "tpu" and not cpu_pinned():
@@ -707,7 +719,7 @@ def main() -> None:
     probe_budget = min(PROBE_TIMEOUT, deadline - 420.0 - time.time())
     default_is_cpu = False
     if probe_budget >= 30:
-        ok, detail = probe_default_backend(probe_budget, use_cache=False)
+        ok, detail = probe_default_backend(probe_budget)
         if ok and detail != "cpu":
             result = _measure_full(detail, deadline, errors)
             if result is not None:
@@ -741,7 +753,7 @@ def main() -> None:
         probe_budget = min(PROBE_TIMEOUT, deadline - 180 - time.time())
         if probe_budget < 30:
             break
-        ok, detail = probe_default_backend(probe_budget, use_cache=False)
+        ok, detail = probe_default_backend(probe_budget)
         if ok and detail != "cpu":
             retry_errors: list[str] = []
             tpu_result = _measure_full(detail, deadline, retry_errors)

@@ -1719,6 +1719,15 @@ def cmd_serve(args) -> None:
             or report["healthz_status"] != 200
             or report["stats_status"] != 200
             or any(s["status"] != 200 for s in report["scored"])
+            # ISSUE 6 additions: a scrapeable /metrics, a deep healthz
+            # with a backend verdict, and one request's spans flow-
+            # linked (s/t/f chain) under its request_id in the trace
+            or report["metrics_status"] != 200
+            or report["deep_healthz_status"] != 200
+            or report["trace_flow_phases"] != ["f", "s", "t"]
+            or "device_execute" not in report["trace_linked_spans"]
+            or "frontend" not in report["trace_linked_spans"]
+            or "queue_wait" not in report["trace_linked_spans"]
         )
         if bad:
             raise SystemExit("serve smoke contract violated (see report)")

@@ -306,6 +306,21 @@ class ServeConfig:
     use_joern: bool = False
     joern_pool_size: int = 1
     joern_timeout_s: float = 300.0
+    # -- operational observability (obs/slo.py, obs/health.py, docs/slo.md)
+    # append one {"request": {...}} entry per HTTP request (request_id,
+    # status, per-stage latency) to <run_dir>/serve_log.jsonl; off by
+    # default — the summary-record-only log is the historical behaviour
+    request_log: bool = False
+    # rolling SLO window lengths (seconds) the /metrics + /stats
+    # aggregator maintains (obs/slo.py; labels render as e.g. "60s")
+    slo_windows: tuple[int, ...] = (60, 300)
+    # newest samples retained per window/stage (exact percentiles over
+    # the retained sample set; older samples age out by time)
+    slo_window_samples: int = 2048
+    # GET /healthz?deep=1 backend probe budget: a bounded subprocess
+    # compile-and-execute against the DEFAULT backend (obs/health.py) —
+    # the wedged-compile-service detector, never run on the request path
+    health_probe_timeout_s: float = 60.0
 
 
 @dataclass(frozen=True)

@@ -29,6 +29,10 @@ def compiled_cost(fn, *args) -> dict:
     lowered = jax.jit(fn).lower(*args)
     compiled = lowered.compile()
     cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        # jax <= 0.4.x returns a one-entry list of per-executable dicts;
+        # newer jax returns the dict directly
+        cost = cost[0] if cost else {}
     return {
         "flops": float(cost.get("flops", 0.0)),
         "bytes_accessed": float(cost.get("bytes accessed", 0.0)),

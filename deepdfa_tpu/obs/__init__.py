@@ -2,11 +2,18 @@
 
 One observability layer the whole stack reports into:
 
-- `obs.trace`   — cross-process Chrome-trace spans/events (JSONL).
+- `obs.trace`   — cross-process Chrome-trace spans/events (JSONL),
+  including request-scoped flow events (docs/slo.md).
 - `obs.metrics` — process-wide counter/gauge/histogram registry +
   the declared run-log schema (scripts/check_obs_schema.py).
 - `obs.xprof`   — on-demand jax.profiler capture, device memory stats,
   lagged-fetch step-time decomposition.
+- `obs.slo`     — rolling-window SLO aggregation + Prometheus text
+  exposition for the serving stack (docs/slo.md).
+- `obs.health`  — bounded backend-health probes emitting `backend/*`
+  events (/healthz?deep=1, bench.py fallback path).
+- `obs.bench_gate` — the bench-trajectory regression gate
+  (scripts/bench_gate.py).
 - `obs.diag`    — the `deepdfa-tpu diag <run_dir>` renderer.
 
 The train loops talk to it through two seams that keep their signatures

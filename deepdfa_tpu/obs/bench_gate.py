@@ -1,0 +1,286 @@
+"""Bench-trajectory regression gate (scripts/bench_gate.py, docs/slo.md).
+
+The repo accumulates one BENCH_r<N>.json driver artifact per round plus
+hand-committed BENCH_TPU_*.json watchdog captures; until now nothing
+GATED on them — BENCH_r02..r05 all shipped with the bench silently
+running on CPU fallback (`fallback_from` buried in the record). This
+module turns the trajectory into a pass/fail verdict:
+
+- `load_trajectory()` parses every committed bench artifact, tolerating
+  the real-world shapes: a clean `parsed` record, a truncated `tail`
+  whose head was cut mid-JSON, an rc!=0 round with only a traceback.
+- `gate()` compares a candidate record against the newest healthy
+  SAME-PLATFORM reference with per-metric tolerances, and classifies
+  failures:
+    * `cpu_fallback` — the record ran on CPU because the accelerator
+      probe failed (`fallback_from` present). This is an EXPLICIT
+      failure class, not a soft warning: a fallback record's numbers
+      must never silently re-baseline the trajectory.
+    * `regression` — a gated metric fell below (or, for
+      lower-is-better metrics, rose above) tolerance vs the reference.
+    * `error` — the record itself is an error record.
+- `render_markdown()` emits the verdict table the PR/driver logs keep.
+
+Pure stdlib + json — importable without jax (the gate must run even
+when the backend is the thing that is broken).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+#: fail when `new < (1 - tol) * reference` (higher is better)
+DEFAULT_TOLERANCES: dict[str, float] = {
+    "value": 0.15,                    # headline infer graphs/s
+    "train_graphs_per_sec": 0.15,
+    "serve_requests_per_sec": 0.20,
+    "combined_train_tokens_per_sec": 0.20,
+    "mfu": 0.25,
+    "train_mfu": 0.25,
+}
+
+#: fail when `new > (1 + tol) * reference` (lower is better)
+LOWER_IS_BETTER: dict[str, float] = {
+    "serve_latency_p99_ms": 0.25,
+    "padding_waste": 0.10,
+}
+
+
+def _record_from_tail(tail: str) -> dict | None:
+    """Best-effort record recovery from a driver `tail` capture: the
+    last full JSON line wins; a tail whose head was truncated mid-record
+    (BENCH_r05) yields nothing rather than a wrong parse."""
+    best = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            best = rec
+    return best
+
+
+def load_trajectory(root: str | Path) -> list[dict]:
+    """Every committed bench artifact under `root`, oldest first:
+    [{"source", "round"|None, "captured_at"|None, "record"|None,
+    "note"|None}]. BENCH_r<N>.json are driver rounds (ordered by N);
+    BENCH_TPU_*.json watchdog captures interleave by timestamp after
+    them (they are fresher evidence by construction)."""
+    root = Path(root)
+    out: list[dict] = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)", path.name)
+        entry: dict = {
+            "source": path.name,
+            "round": int(m.group(1)) if m else None,
+        }
+        try:
+            artifact = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            entry["note"] = f"unreadable: {e}"
+            out.append(entry)
+            continue
+        rec = artifact.get("parsed")
+        if not isinstance(rec, dict):
+            rec = _record_from_tail(str(artifact.get("tail", "")))
+            if rec is not None:
+                entry["note"] = "recovered from tail"
+        if rec is None:
+            entry["note"] = (
+                f"no parseable record (driver rc={artifact.get('rc')})"
+            )
+        entry["record"] = rec
+        out.append(entry)
+    out.sort(key=lambda e: (e.get("round") or 0, e["source"]))
+    captures = []
+    for path in sorted(root.glob("BENCH_TPU_*.json")):
+        try:
+            artifact = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        rec = artifact.get("bench")
+        if isinstance(rec, dict):
+            captures.append({
+                "source": path.name,
+                "captured_at": artifact.get("captured_at"),
+                "record": rec,
+            })
+    captures.sort(key=lambda e: str(e.get("captured_at") or ""))
+    return out + captures
+
+
+def classify(record: dict) -> str:
+    """"healthy" | "cpu_fallback" | "error" for one bench record."""
+    if not isinstance(record, dict) or "error" in record:
+        return "error"
+    if record.get("fallback_from"):
+        return "cpu_fallback"
+    return "healthy"
+
+
+def reference_for(
+    trajectory: list[dict],
+    platform: str | None,
+    exclude_source: str | None = None,
+) -> dict | None:
+    """The newest healthy record on the same platform (fallback records
+    never become the baseline — that is the silent-rebaseline failure
+    this gate exists to stop). Also looks inside `last_healthy_tpu`
+    embeddings when the platform sought is tpu. `exclude_source` drops
+    one trajectory entry — the candidate itself, when it is already
+    committed: a record compared against itself passes vacuously."""
+    best = None
+    for entry in trajectory:
+        rec = entry.get("record")
+        if not isinstance(rec, dict):
+            continue
+        if exclude_source is not None and entry.get("source") == (
+            exclude_source
+        ):
+            continue
+        if classify(rec) == "healthy" and (
+            platform is None or rec.get("platform") == platform
+        ):
+            best = {"record": rec, "source": entry["source"]}
+        embedded = rec.get("last_healthy_tpu")
+        if (
+            platform == "tpu"
+            and isinstance(embedded, dict)
+            and isinstance(embedded.get("bench"), dict)
+        ):
+            best = {
+                "record": embedded["bench"],
+                "source": (
+                    f"{entry['source']}:last_healthy_tpu"
+                    f"[{embedded.get('artifact', '?')}]"
+                ),
+            }
+    return best
+
+
+def gate(
+    record: dict,
+    trajectory: list[dict],
+    tolerances: dict[str, float] | None = None,
+    expect_platform: str | None = None,
+    exclude_source: str | None = None,
+) -> dict:
+    """Verdict for one candidate record against the trajectory.
+
+    {"verdict": "pass"|"fail", "failure_classes": [...], "checks":
+    [{metric, new, reference, ref_source, tolerance, direction, ok,
+    ratio}], "notes": [...]}."""
+    tol = dict(DEFAULT_TOLERANCES)
+    lower = dict(LOWER_IS_BETTER)
+    for k, v in (tolerances or {}).items():
+        (lower if k in lower else tol)[k] = float(v)
+    failure_classes: list[str] = []
+    notes: list[str] = []
+    checks: list[dict] = []
+
+    cls = classify(record)
+    if cls == "error":
+        failure_classes.append("error")
+        notes.append(
+            f"record is an error record: {record.get('error', '?')!s:.200}"
+        )
+    elif cls == "cpu_fallback":
+        failure_classes.append("cpu_fallback")
+        notes.append(
+            "record ran on CPU FALLBACK (accelerator probe failed: "
+            f"{str(record.get('fallback_from'))[:200]}) — its numbers "
+            "do not gate the accelerator trajectory and must not "
+            "re-baseline it"
+        )
+    platform = record.get("platform")
+    if expect_platform and platform != expect_platform:
+        if "cpu_fallback" not in failure_classes:
+            failure_classes.append("cpu_fallback")
+        notes.append(
+            f"expected platform {expect_platform!r}, record ran on "
+            f"{platform!r}"
+        )
+
+    ref = reference_for(
+        trajectory, platform, exclude_source=exclude_source
+    )
+    if ref is None:
+        notes.append(
+            f"no healthy {platform or 'any'}-platform reference in the "
+            "trajectory — throughput checks skipped"
+        )
+    else:
+        for metric, frac in sorted({**tol, **lower}.items()):
+            new_v, ref_v = record.get(metric), ref["record"].get(metric)
+            if not isinstance(new_v, (int, float)) or not isinstance(
+                ref_v, (int, float)
+            ) or isinstance(new_v, bool) or isinstance(ref_v, bool):
+                continue
+            if ref_v == 0:
+                continue
+            is_lower = metric in lower
+            ratio = new_v / ref_v
+            ok = (
+                ratio <= 1 + frac if is_lower else ratio >= 1 - frac
+            )
+            checks.append({
+                "metric": metric,
+                "new": new_v,
+                "reference": ref_v,
+                "ref_source": ref["source"],
+                "tolerance": frac,
+                "direction": "lower" if is_lower else "higher",
+                "ratio": round(ratio, 4),
+                "ok": ok,
+            })
+            if not ok and "regression" not in failure_classes:
+                failure_classes.append("regression")
+    return {
+        "verdict": "fail" if failure_classes else "pass",
+        "failure_classes": failure_classes,
+        "platform": platform,
+        "checks": checks,
+        "notes": notes,
+    }
+
+
+def render_markdown(result: dict, record: dict | None = None) -> str:
+    """The human half of the verdict: a status line, the failure
+    classes, and the per-metric table."""
+    icon = "✅" if result["verdict"] == "pass" else "❌"
+    lines = [
+        f"## Bench gate: {icon} {result['verdict'].upper()}",
+        "",
+    ]
+    if record is not None:
+        lines.append(
+            f"- record: `{record.get('metric', '?')}` = "
+            f"{record.get('value', '?')} {record.get('unit', '')} on "
+            f"`{record.get('platform', '?')}` "
+            f"(git `{record.get('git_sha', '?')}`)"
+        )
+    for c in result["failure_classes"]:
+        lines.append(f"- failure class: **{c}**")
+    for n in result["notes"]:
+        lines.append(f"- {n}")
+    if result["checks"]:
+        lines += [
+            "",
+            "| metric | new | reference | ratio | tolerance | ok |",
+            "|---|---|---|---|---|---|",
+        ]
+        for c in result["checks"]:
+            arrow = "↓ok" if c["direction"] == "lower" else "↑ok"
+            lines.append(
+                f"| {c['metric']} | {c['new']:g} | {c['reference']:g} "
+                f"({c['ref_source']}) | {c['ratio']} | "
+                f"±{c['tolerance']} ({arrow}) | "
+                f"{'✅' if c['ok'] else '❌'} |"
+            )
+    return "\n".join(lines) + "\n"

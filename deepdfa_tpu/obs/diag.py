@@ -214,7 +214,126 @@ def serve_attribution(serve_records: list[dict]) -> dict:
     return out
 
 
-def diagnose(run_dir: str | Path) -> dict:
+def slo_section(serve_records: list[dict]) -> dict:
+    """The serving SLO section, rebuilt from serve_log.jsonl alone: the
+    per-request entries (`serve.request_log`) give exact percentiles and
+    status counts over ALL logged requests plus the trailing 60s/300s
+    windows (relative to the newest entry's wall clock), and the newest
+    summary record contributes the engine's own live snapshot — two
+    independently-derived views of the same SLO, like the
+    records-vs-trace stage attribution above."""
+    from deepdfa_tpu.obs.slo import percentile
+
+    entries = [
+        r["request"] for r in serve_records
+        if isinstance(r.get("request"), dict)
+    ]
+    out: dict = {}
+    engine = next(
+        (
+            rec["serve_slo"] for rec in reversed(serve_records)
+            if isinstance(rec.get("serve_slo"), dict)
+        ),
+        None,
+    )
+    # the windows the run was actually configured with (engine snapshot
+    # labels like "60s"), so the two views describe the SAME horizons;
+    # default to the stock 60s/300s when no summary record exists
+    horizons = sorted(
+        int(k[:-1]) for k in (engine or {})
+        if isinstance(k, str) and k.endswith("s") and k[:-1].isdigit()
+    ) or [60, 300]
+    if entries:
+        def view(rows: list[dict]) -> dict:
+            lat = sorted(
+                e["latency_ms"] for e in rows if "latency_ms" in e
+            )
+            v: dict = {"requests": len(rows)}
+            if lat:
+                v["latency_ms"] = {
+                    f"p{int(q * 100)}": round(percentile(lat, q), 3)
+                    for q in (0.50, 0.95, 0.99)
+                }
+            status: dict[str, int] = {}
+            for e in rows:
+                if "status" in e:
+                    s = str(int(e["status"]))
+                    status[s] = status.get(s, 0) + 1
+            if status:
+                v["status"] = dict(sorted(status.items()))
+                n = sum(status.values())
+                errs = sum(
+                    c for s, c in status.items()
+                    if not s.startswith("2")
+                )
+                v["error_rate"] = round(errs / n, 4)
+            for stage in ("frontend_ms", "queue_ms", "device_ms"):
+                vals = [e[stage] for e in rows if stage in e]
+                if vals:
+                    v[f"{stage}_mean"] = round(
+                        sum(vals) / len(vals), 3
+                    )
+            return v
+
+        out["all"] = view(entries)
+        newest = max(
+            (e.get("t_unix", 0.0) for e in entries), default=0.0
+        )
+        for horizon in horizons:
+            rows = [
+                e for e in entries
+                if e.get("t_unix", 0.0) >= newest - horizon
+            ]
+            if rows:
+                out[f"{horizon}s"] = view(rows)
+    if engine is not None:
+        out["engine"] = engine
+    return out
+
+
+def bench_section(root: str | Path | None = None) -> dict:
+    """The bench-trajectory section: every committed BENCH_r*/
+    BENCH_TPU_* record's headline numbers plus the regression-gate
+    verdict for the newest round (obs/bench_gate.py)."""
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    root = Path(root) if root else Path(__file__).resolve().parents[2]
+    trajectory = bg.load_trajectory(root)
+    rows = []
+    newest = None
+    newest_source = None
+    for entry in trajectory:
+        rec = entry.get("record")
+        row = {"source": entry["source"]}
+        if entry.get("round") is not None:
+            row["round"] = entry["round"]
+        if isinstance(rec, dict):
+            row.update({
+                k: rec[k]
+                for k in ("metric", "value", "unit", "platform",
+                          "train_graphs_per_sec",
+                          "serve_requests_per_sec", "mfu",
+                          "fallback_from")
+                if k in rec
+            })
+            row["class"] = bg.classify(rec)
+            if entry.get("round") is not None:
+                newest, newest_source = rec, entry["source"]
+        if entry.get("note"):
+            row["note"] = entry["note"]
+        rows.append(row)
+    out: dict = {"trajectory": rows}
+    if newest is not None:
+        # the newest round is part of the trajectory: exclude it from
+        # its own reference selection (a self-comparison passes
+        # vacuously)
+        out["gate"] = bg.gate(
+            newest, trajectory, exclude_source=newest_source
+        )
+    return out
+
+
+def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
     """One machine-readable object with every section."""
     run_dir = Path(run_dir)
     records = load_records(run_dir)
@@ -233,6 +352,7 @@ def diagnose(run_dir: str | Path) -> dict:
         )
         if val_keys:
             summary["final_val"] = {k: epochs[-1][k] for k in val_keys}
+    serve_records = load_serve_records(run_dir)
     return {
         "summary": summary,
         "timeline": throughput_timeline(records),
@@ -241,7 +361,9 @@ def diagnose(run_dir: str | Path) -> dict:
             "from_trace": stage_attribution_from_events(events),
         },
         "resilience": resilience_log(run_dir, records, events),
-        "serve": serve_attribution(load_serve_records(run_dir)),
+        "serve": serve_attribution(serve_records),
+        "slo": slo_section(serve_records),
+        "bench": bench_section(bench_root),
     }
 
 
@@ -337,6 +459,80 @@ def render_text(report: dict, out=sys.stdout) -> None:
             w("  " + " ".join(f"{k}={int(v)}" for k, v in counters.items())
               + "\n")
 
+    slo = report.get("slo") or {}
+    if slo:
+        w("\nserving SLO (from serve_log.jsonl):\n")
+        window_labels = sorted(
+            (k for k in slo if k.endswith("s") and k[:-1].isdigit()),
+            key=lambda k: int(k[:-1]),
+        )
+        for label in ["all", *window_labels]:
+            v = slo.get(label)
+            if not v:
+                continue
+            lat = v.get("latency_ms", {})
+            lat_s = " ".join(f"{k}={val}ms" for k, val in lat.items())
+            err = v.get("error_rate")
+            err_s = f" error_rate={err:.2%}" if err is not None else ""
+            w(
+                f"  [{label:>4}] requests={v['requests']} {lat_s}"
+                f"{err_s}\n"
+            )
+            status = v.get("status")
+            if status:
+                w("         status: " + " ".join(
+                    f"{k}={c}" for k, c in status.items()
+                ) + "\n")
+            stages = [
+                (s, v[f"{s}_ms_mean"])
+                for s in ("frontend", "queue", "device")
+                if f"{s}_ms_mean" in v
+            ]
+            if stages:
+                total = sum(x for _, x in stages) or 1.0
+                for s, x in stages:
+                    w(
+                        f"         {s:<10}{_bar(x / total, 20)} "
+                        f"{x:8.3f}ms\n"
+                    )
+        eng = slo.get("engine") or {}
+        if eng:
+            w(
+                f"  engine snapshot: queue_depth="
+                f"{eng.get('queue_depth')} hot_swaps="
+                f"{eng.get('hot_swaps')} requests_total="
+                f"{eng.get('requests_total')}\n"
+            )
+
+    bench = report.get("bench") or {}
+    if bench.get("trajectory"):
+        w("\nbench trajectory (committed BENCH_* artifacts):\n")
+        for row in bench["trajectory"]:
+            if "value" in row:
+                cls = row.get("class", "?")
+                mark = {"healthy": "+", "cpu_fallback": "!"}.get(cls, "?")
+                w(
+                    f"  [{mark}] {row['source']:<34} "
+                    f"{row.get('value', '?'):>10} "
+                    f"{row.get('unit', ''):<9} "
+                    f"{row.get('platform', '?'):<4} {cls}\n"
+                )
+            else:
+                w(
+                    f"  [x] {row['source']:<34} "
+                    f"{row.get('note', 'no record')}\n"
+                )
+        gate = bench.get("gate")
+        if gate:
+            w(
+                f"  gate verdict: {gate['verdict']}"
+                + (
+                    f" ({', '.join(gate['failure_classes'])})"
+                    if gate["failure_classes"] else ""
+                )
+                + "\n"
+            )
+
     res = report["resilience"]
     if res["events"] or res["counters"] or res["watchdog"]:
         w("\nresilience events:\n")
@@ -420,6 +616,30 @@ def build_smoke_run(run_dir: Path) -> Path:
     with trace._Span(worker, "pack_plan", "pack_worker", {}):
         time.sleep(0.002)
     worker.close()
+    # a serve_log.jsonl through the REAL emitters (server.RequestLog +
+    # the SLO engine) so the diag SLO section has both of its sources:
+    # per-request entries and an engine snapshot in a summary record
+    from deepdfa_tpu.obs.slo import SloEngine
+    from deepdfa_tpu.serve.server import RequestLog
+
+    rlog = RequestLog(run_dir / "serve_log.jsonl")
+    engine = SloEngine()
+    t_now = time.time()
+    for i in range(12):
+        status = 200 if i % 6 else 429
+        latency_ms = 5.0 + i
+        rlog.append({"request": {
+            "id": f"smoke-{i}", "status": status,
+            "latency_ms": latency_ms, "frontend_ms": 1.0,
+            "queue_ms": 2.0, "device_ms": 2.0,
+            "batch_size": 2, "t_unix": round(t_now - i, 3),
+        }})
+        engine.observe_request(
+            status, latency_ms / 1e3, frontend_s=1e-3, queue_s=2e-3,
+            device_s=2e-3,
+        )
+    rlog.append({"serve_slo": engine.snapshot()})
+    rlog.close()
     ck = run_dir / "checkpoints-step"
     ck.mkdir(exist_ok=True)
     (ck / "watchdog_diagnostic.json").write_text(json.dumps({
@@ -458,6 +678,7 @@ def main(argv=None) -> int:
             # the smoke contract: every section materialized from the
             # synthetic artifacts through the real readers
             attr = report["stage_attribution"]
+            slo = report.get("slo") or {}
             ok = (
                 report["summary"]["epochs"] == 3
                 and report["summary"]["trace_events"] > 0
@@ -466,6 +687,12 @@ def main(argv=None) -> int:
                 and len(attr["from_trace"].get("processes", [])) >= 2
                 and report["resilience"]["events"]
                 and report["resilience"]["watchdog"]
+                # ISSUE 6 sections: per-request SLO view + engine
+                # snapshot + the committed bench trajectory and verdict
+                and slo.get("all", {}).get("requests", 0) > 0
+                and "latency_ms" in slo.get("all", {})
+                and slo.get("engine")
+                and report.get("bench", {}).get("trajectory")
             )
             print(f"diag smoke {'OK' if ok else 'FAILED'}")
             return 0 if ok else 1

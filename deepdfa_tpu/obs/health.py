@@ -1,0 +1,188 @@
+"""Backend health observability (docs/slo.md).
+
+BENCH_r01..r05 all record the same production failure: the remote TPU
+compile service wedges, the bench's inline probe times out, and the run
+silently falls back to CPU with the evidence buried in a
+``fallback_from`` string nobody gates on. This module lifts that inline
+probe/retry logic into the runtime proper so backend health is an
+OBSERVED signal, not a bench-local branch:
+
+- `BackendHealth.probe()` — the bounded compile-and-execute probe
+  (`core/backend.py:probe_default_backend` in a subprocess, so a wedged
+  compile service can never hang the caller) with bounded retries,
+  emitting `backend/*` registry metrics and cat="backend" trace
+  instants for every attempt: probe latency, retries, wedge detected
+  (timeout => the compile service is hung, not dead), failures.
+- `BackendHealth.record_fallback()` — the moment a caller gives up on
+  the default backend and pins CPU, counted and traced.
+- `probe_backend()` / `record_fallback()` module-level wrappers over a
+  process-wide singleton — what bench.py's probe-gated retry loop calls
+  so its fallback path shows up in the same metrics the serving
+  `/healthz?deep=1` mode reads.
+
+The probe function is injectable (`probe_fn`) so tests can drive the
+timeout/wedge path without a real 60s subprocess hang.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+
+
+def _default_probe(timeout_s: float) -> tuple[bool, str]:
+    from deepdfa_tpu.core.backend import probe_default_backend
+
+    # use_cache=False: health checks sample NOW, not the process's first
+    # impression — a wedge that develops mid-run must be seen
+    return probe_default_backend(timeout_s, use_cache=False)
+
+
+def looks_wedged(detail: str) -> bool:
+    """A probe TIMEOUT means the compile service accepted the connection
+    and hung (the r1-r5 wedge signature); a nonzero-exit probe means the
+    backend errored fast (tunnel down, no accelerator) — different
+    failure, different operator action."""
+    return "timed out" in detail
+
+
+class BackendHealth:
+    """Probe runner + last-result cache for one process.
+
+    `/healthz?deep=1` calls `probe()` per request (bounded by the
+    configured timeout); `last()` serves the cached result to callers
+    that want the newest evidence without paying a probe."""
+
+    def __init__(
+        self,
+        probe_fn: Callable[[float], tuple[bool, str]] | None = None,
+        registry: obs_metrics.MetricsRegistry | None = None,
+    ):
+        self.probe_fn = probe_fn or _default_probe
+        r = registry if registry is not None else obs_metrics.REGISTRY
+        self._m_probes = r.counter("backend/probes")
+        self._m_failures = r.counter("backend/probe_failures")
+        self._m_retries = r.counter("backend/probe_retries")
+        self._m_wedges = r.counter("backend/wedges")
+        self._m_fallbacks = r.counter("backend/fallbacks")
+        self._m_seconds = r.histogram("backend/probe_seconds")
+        self._m_healthy = r.gauge("backend/healthy")
+        self._lock = threading.Lock()
+        self._last: dict | None = None
+
+    def probe(
+        self,
+        timeout_s: float = 60.0,
+        retries: int = 0,
+        retry_wait_s: float = 0.0,
+    ) -> dict:
+        """Run the bounded probe (plus up to `retries` retries) and
+        return the attempt report:
+
+        {"ok", "platform"|"error", "latency_s", "attempts", "wedged",
+         "timeout_s"} — also cached for `last()` and mirrored into the
+        `backend/*` metrics + trace stream."""
+        attempts = 0
+        report: dict = {"ok": False, "timeout_s": float(timeout_s)}
+        while True:
+            attempts += 1
+            self._m_probes.inc()
+            if attempts > 1:
+                self._m_retries.inc()
+            t0 = time.perf_counter()
+            ok, detail = self.probe_fn(timeout_s)
+            dt = time.perf_counter() - t0
+            self._m_seconds.observe(dt)
+            report.update(
+                ok=bool(ok), latency_s=round(dt, 3), attempts=attempts
+            )
+            if ok:
+                report["platform"] = detail
+                report.pop("error", None)
+                report["wedged"] = False
+                break
+            wedged = looks_wedged(detail)
+            report.update(error=detail, wedged=wedged)
+            self._m_failures.inc()
+            if wedged:
+                self._m_wedges.inc()
+            obs_trace.instant(
+                "backend_probe_failed", cat="backend",
+                error=detail[:200], wedged=wedged, attempt=attempts,
+            )
+            if attempts > retries:
+                break
+            if retry_wait_s:
+                time.sleep(retry_wait_s)
+        self._m_healthy.set(1.0 if report["ok"] else 0.0)
+        obs_trace.instant(
+            "backend_probe", cat="backend",
+            ok=report["ok"], latency_s=report["latency_s"],
+            attempts=attempts,
+        )
+        with self._lock:
+            self._last = dict(report)
+        return report
+
+    def record_fallback(self, reason: str) -> None:
+        """The caller is abandoning the default backend for CPU — the
+        event every BENCH_r* record buried in `fallback_from`."""
+        self._m_fallbacks.inc()
+        self._m_healthy.set(0.0)
+        obs_trace.instant(
+            "backend_fallback", cat="backend", reason=reason[:500]
+        )
+        with self._lock:
+            if self._last is not None:
+                self._last["fallback"] = True
+
+    def last(self) -> dict | None:
+        with self._lock:
+            return dict(self._last) if self._last else None
+
+
+_singleton: BackendHealth | None = None
+_singleton_lock = threading.Lock()
+
+
+def shared() -> BackendHealth:
+    """The process-wide BackendHealth (bench.py + CLI entry points)."""
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = BackendHealth()
+        return _singleton
+
+
+def probe_backend(timeout_s: float = 60.0) -> tuple[bool, str]:
+    """Drop-in for `core.backend.probe_default_backend(t, use_cache=False)`
+    that also lands the attempt in the `backend/*` metrics — bench.py's
+    probe-gated retry loop routes through here so every probe that
+    sampled the window is on the observable record, not only in a
+    concatenated error string."""
+    report = shared().probe(timeout_s)
+    if report["ok"]:
+        return True, report.get("platform", "unknown")
+    return False, report.get("error", "probe failed")
+
+
+def record_fallback(reason: str) -> None:
+    shared().record_fallback(reason)
+
+
+def summary() -> dict:
+    """Snapshot of the backend/* counters + the newest probe report —
+    what a CPU-fallback bench record embeds as `backend_health`."""
+    snap = obs_metrics.REGISTRY.snapshot()
+    out = {
+        k[len("backend/"):]: v
+        for k, v in snap.items()
+        if k.startswith("backend/")
+    }
+    last = shared().last()
+    if last is not None:
+        out["last_probe"] = last
+    return out

@@ -202,6 +202,23 @@ SCHEMA: tuple[str, ...] = (
     "serve/device_seconds/max",
     "serve/frontend_seconds/count", "serve/frontend_seconds/mean",
     "serve/frontend_seconds/max",
+    # rolling SLO windows (obs/slo.py, docs/slo.md): the summary record
+    # embeds the engine snapshot under "serve_slo" — window labels,
+    # stages, and observed status codes are data-dependent, so this is
+    # a reviewed wildcard (like obs/compile/signatures/*)
+    "serve_slo/*",
+    # per-request serve_log.jsonl entries (serve.request_log;
+    # server.py:RequestLog) — request_id and the string fields ride in
+    # the same entry but only scalars become tags
+    "request/status", "request/latency_ms", "request/frontend_ms",
+    "request/queue_ms", "request/device_ms", "request/batch_size",
+    "request/t_unix",
+    # backend health observability (obs/health.py): bounded
+    # compile-and-execute probes, wedge/fallback events
+    "backend/probes", "backend/probe_failures", "backend/probe_retries",
+    "backend/wedges", "backend/fallbacks", "backend/healthy",
+    "backend/probe_seconds/count", "backend/probe_seconds/mean",
+    "backend/probe_seconds/max",
 )
 
 

@@ -1,0 +1,429 @@
+"""Rolling-window SLO aggregation + Prometheus text exposition
+(docs/slo.md).
+
+The serving stack's operational half: `/stats` needs "what is p99 over
+the last minute", an alerting scrape needs "error rate over 5 minutes",
+and neither is answerable from the process-lifetime counters in
+`obs/metrics.py` (a histogram's lifetime mean buries a latency spike
+minutes after it happened). `SloEngine` keeps bounded, time-stamped
+sample windows per request stage and answers both on demand:
+
+- per-window (default 60s/300s) p50/p95/p99 latency for every stage a
+  request passes through (frontend, queue, device, total);
+- request/error counts by HTTP status code -> windowed error rate;
+- batch occupancy quantiles, live queue depth, hot-swap count.
+
+Percentile convention: `percentile()` below is THE repo-wide quantile
+rule (upper-biased index over a sorted sample) — serve/batcher.py,
+bench_serve, and this engine all import it from here so the p99 a bench
+record reports and the p99 `/metrics` exposes can never disagree on
+convention.
+
+`/metrics` exposition (`registry_exposition` + `SloEngine.exposition`)
+is Prometheus text format 0.0.4, stdlib-only. Every metric family
+carries a `# HELP <name> tag=<registry-tag>` line mapping it back to the
+declared schema in `obs/metrics.py:SCHEMA`; that mapping is what lets
+`scripts/check_obs_schema.py --metrics` validate a live scrape against
+the same reviewed registry the run logs are validated against.
+
+Everything here is serve-path only — the training default path never
+constructs an engine, so the PR-4 "default path byte-identical"
+contract is untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Sequence
+
+
+def percentile(sorted_vals: Sequence[float], p: float) -> float | None:
+    """Upper-biased quantile over a PRE-SORTED sample; None when empty.
+    The one index rule `/stats`, `/metrics`, the score summaries, and
+    bench_serve all share — private copies would drift apart."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+
+#: the quantile set every latency window exposes
+QUANTILES = (0.50, 0.95, 0.99)
+
+#: request stages a serve request is attributed across (docs/serving.md
+#: lifecycle: frontend extraction -> bounded queue -> device execution)
+STAGES = ("total", "frontend", "queue", "device")
+
+
+class WindowedSamples:
+    """Time-stamped sample ring for one (window, series) pair.
+
+    Samples older than `horizon_s` age out on read; at most
+    `max_samples` newest samples are retained (an overloaded window
+    degrades to "quantiles over the newest N", never to unbounded
+    memory). Thread-safe; `clock` is injectable so tests can drive
+    eviction deterministically."""
+
+    __slots__ = ("horizon_s", "_samples", "_lock")
+
+    def __init__(self, horizon_s: float, max_samples: int = 2048):
+        self.horizon_s = float(horizon_s)
+        self._samples: deque[tuple[float, float]] = deque(
+            maxlen=int(max_samples)
+        )
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, now: float) -> None:
+        with self._lock:
+            self._samples.append((now, float(value)))
+
+    def values(self, now: float) -> list[float]:
+        """Samples still inside the window at `now` (evicts the rest)."""
+        cutoff = now - self.horizon_s
+        with self._lock:
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+            return [v for _, v in self._samples]
+
+
+class WindowedCounts:
+    """Time-stamped event COUNTER for one (window, series) pair:
+    per-second buckets bounded by the horizon itself, so counts are
+    EXACT at any traffic rate (a sample-ring would truncate the busiest
+    status first and distort windowed error rates — status counts need
+    totals, not quantiles, so they get counter semantics)."""
+
+    __slots__ = ("horizon_s", "_buckets", "_lock")
+
+    def __init__(self, horizon_s: float):
+        self.horizon_s = float(horizon_s)
+        # [second-bucket, count]; at most horizon_s+1 entries ever live
+        self._buckets: deque[list[float]] = deque()
+        self._lock = threading.Lock()
+
+    def _evict_locked(self, now: float) -> None:
+        cutoff = now - self.horizon_s
+        while self._buckets and self._buckets[0][0] < cutoff:
+            self._buckets.popleft()
+
+    def observe(self, now: float) -> None:
+        sec = int(now)
+        with self._lock:
+            # evict on WRITE as well as read: a server nobody scrapes
+            # must not grow one bucket per active second forever
+            self._evict_locked(now)
+            if self._buckets and self._buckets[-1][0] == sec:
+                self._buckets[-1][1] += 1
+            else:
+                self._buckets.append([sec, 1])
+
+    def total(self, now: float) -> int:
+        with self._lock:
+            self._evict_locked(now)
+            return int(sum(c for _, c in self._buckets))
+
+
+class SloEngine:
+    """Rolling-window SLO state for one scoring service.
+
+    `observe_request` is the single ingest point (the HTTP handler and
+    the offline score drive both call it once per finished request);
+    `snapshot` renders every window for `/stats` and the serve_log
+    summary record; `exposition` renders the same content as Prometheus
+    gauges for `/metrics`."""
+
+    def __init__(
+        self,
+        windows: Sequence[float] = (60, 300),
+        max_samples: int = 2048,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not windows:
+            raise ValueError("SloEngine needs at least one window")
+        self.clock = clock
+        self.windows = tuple(float(w) for w in windows)
+        self.max_samples = int(max_samples)
+        self._lock = threading.Lock()
+        # {window -> {stage -> WindowedSamples}} latency seconds
+        self._latency = {
+            w: {s: WindowedSamples(w, max_samples) for s in STAGES}
+            for w in self.windows
+        }
+        # {window -> {status -> WindowedCounts}} exact per-second counts
+        self._status: dict[float, dict[int, WindowedCounts]] = {
+            w: {} for w in self.windows
+        }
+        self._occupancy = {
+            w: WindowedSamples(w, max_samples) for w in self.windows
+        }
+        self.queue_depth = 0.0
+        self.hot_swaps = 0.0
+        # lifetime totals (status -> count): the monotone half /metrics
+        # needs (windowed counts go up AND down as samples age out)
+        self._status_totals: dict[int, float] = {}
+        self.requests_total = 0.0
+
+    @staticmethod
+    def window_label(w: float) -> str:
+        return f"{int(w)}s"
+
+    # -- ingest --------------------------------------------------------------
+
+    def observe_request(
+        self,
+        status: int,
+        latency_s: float | None,
+        frontend_s: float | None = None,
+        queue_s: float | None = None,
+        device_s: float | None = None,
+        now: float | None = None,
+    ) -> None:
+        now = self.clock() if now is None else now
+        status = int(status)
+        stages = {
+            "total": latency_s, "frontend": frontend_s,
+            "queue": queue_s, "device": device_s,
+        }
+        for w in self.windows:
+            for stage, v in stages.items():
+                if v is not None:
+                    self._latency[w][stage].observe(v, now)
+            with self._lock:
+                ring = self._status[w].get(status)
+                if ring is None:
+                    ring = self._status[w][status] = WindowedCounts(w)
+            ring.observe(now)
+        with self._lock:
+            self.requests_total += 1
+            self._status_totals[status] = (
+                self._status_totals.get(status, 0.0) + 1
+            )
+
+    def observe_batch(self, occupancy: float, now: float | None = None):
+        now = self.clock() if now is None else now
+        for w in self.windows:
+            self._occupancy[w].observe(occupancy, now)
+
+    def set_queue_depth(self, depth: float) -> None:
+        self.queue_depth = float(depth)
+
+    def observe_hot_swap(self) -> None:
+        with self._lock:
+            self.hot_swaps += 1
+
+    # -- render --------------------------------------------------------------
+
+    def _window_view(self, w: float, now: float) -> dict:
+        out: dict = {}
+        for stage in STAGES:
+            vals = sorted(self._latency[w][stage].values(now))
+            if not vals:
+                continue
+            st = out.setdefault("latency_ms", {})[stage] = {}
+            for q in QUANTILES:
+                st[f"p{int(q * 100)}"] = round(1e3 * percentile(vals, q), 3)
+            st["count"] = len(vals)
+        with self._lock:
+            status_rings = dict(self._status[w])
+        counts = {
+            str(code): ring.total(now)
+            for code, ring in sorted(status_rings.items())
+        }
+        counts = {k: v for k, v in counts.items() if v}
+        n = sum(counts.values())
+        if counts:
+            out["status"] = counts
+            errors = sum(
+                v for k, v in counts.items() if not k.startswith("2")
+            )
+            out["error_rate"] = round(errors / n, 4)
+            out["requests_per_sec"] = round(n / w, 3)
+        occ = sorted(self._occupancy[w].values(now))
+        if occ:
+            out["batch_occupancy_p50"] = round(
+                percentile(occ, 0.50), 4
+            )
+        return out
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """Nested {window-label: view} + live gauges — the `/stats` SLO
+        section and (flattened to `serve_slo/*` tags) the serve_log
+        summary record."""
+        now = self.clock() if now is None else now
+        out: dict = {
+            self.window_label(w): self._window_view(w, now)
+            for w in self.windows
+        }
+        out["queue_depth"] = self.queue_depth
+        out["hot_swaps"] = self.hot_swaps
+        out["requests_total"] = self.requests_total
+        return out
+
+    # -- Prometheus ----------------------------------------------------------
+
+    def exposition(self, now: float | None = None) -> str:
+        """The SLO half of `/metrics` (Prometheus text format 0.0.4):
+        windowed quantiles/error rates as labeled gauges, lifetime
+        status counts as a labeled counter."""
+        now = self.clock() if now is None else now
+        # ONE view per window: each _window_view evicts/copies/sorts
+        # every ring it reads, so recomputing it per family would
+        # triple the scrape cost on the serving process
+        views = {
+            w: self._window_view(w, now) for w in self.windows
+        }
+        lines: list[str] = []
+
+        def family(name: str, tag: str, kind: str) -> None:
+            lines.append(f"# HELP {name} tag={tag}")
+            lines.append(f"# TYPE {name} {kind}")
+
+        name = "deepdfa_serve_slo_latency_ms"
+        family(name, "serve_slo/latency_ms", "gauge")
+        for w in self.windows:
+            lbl = self.window_label(w)
+            for stage, st in views[w].get("latency_ms", {}).items():
+                for q in QUANTILES:
+                    lines.append(
+                        f'{name}{{window="{lbl}",stage="{stage}",'
+                        f'quantile="{q}"}} '
+                        f"{st[f'p{int(q * 100)}']}"
+                    )
+        name = "deepdfa_serve_slo_error_rate"
+        family(name, "serve_slo/error_rate", "gauge")
+        for w in self.windows:
+            if "error_rate" in views[w]:
+                lines.append(
+                    f'{name}{{window="{self.window_label(w)}"}} '
+                    f"{views[w]['error_rate']}"
+                )
+        name = "deepdfa_serve_slo_requests_per_sec"
+        family(name, "serve_slo/requests_per_sec", "gauge")
+        for w in self.windows:
+            if "requests_per_sec" in views[w]:
+                lines.append(
+                    f'{name}{{window="{self.window_label(w)}"}} '
+                    f"{views[w]['requests_per_sec']}"
+                )
+        name = "deepdfa_serve_requests_by_status_total"
+        family(name, "serve_slo/status", "counter")
+        with self._lock:
+            totals = sorted(self._status_totals.items())
+        for code, count in totals:
+            lines.append(f'{name}{{status="{code}"}} {count:g}')
+        name = "deepdfa_serve_slo_queue_depth"
+        family(name, "serve_slo/queue_depth", "gauge")
+        lines.append(f"{name} {self.queue_depth:g}")
+        name = "deepdfa_serve_slo_hot_swaps_total"
+        family(name, "serve_slo/hot_swaps", "counter")
+        lines.append(f"{name} {self.hot_swaps:g}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition of the process-wide metrics registry
+
+
+def prom_name(tag: str) -> str:
+    """Registry tag -> Prometheus metric name (slashes/dots -> '_',
+    `deepdfa_` prefix). `serve/queue_depth` -> `deepdfa_serve_queue_depth`."""
+    safe = "".join(
+        c if c.isalnum() or c == "_" else "_" for c in tag
+    ).strip("_")
+    return f"deepdfa_{safe}"
+
+
+def registry_exposition(registry=None) -> str:
+    """Every counter/gauge/histogram in the metrics registry as
+    Prometheus text. Counters export as `<name>_total`; histograms (the
+    streaming count/sum/min/max kind) export `_count`/`_sum` counters
+    plus a `_max` gauge. Each family's HELP line carries the registry
+    tag so `check_obs_schema.py --metrics` can validate a scrape against
+    `obs/metrics.py:SCHEMA`."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    r = registry if registry is not None else obs_metrics.REGISTRY
+    with r._lock:
+        items = sorted(r._metrics.items())
+    lines: list[str] = []
+    for tag, m in items:
+        base = prom_name(tag)
+        if isinstance(m, obs_metrics.Counter):
+            lines.append(f"# HELP {base}_total tag={tag}")
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {m.value:g}")
+        elif isinstance(m, obs_metrics.Gauge):
+            lines.append(f"# HELP {base} tag={tag}")
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{base} {m.value:g}")
+        else:  # Histogram
+            lines.append(f"# HELP {base} tag={tag}")
+            lines.append(f"# TYPE {base} summary")
+            lines.append(f"{base}_count {m.count:g}")
+            lines.append(f"{base}_sum {m.sum:g}")
+            if m.count:
+                lines.append(f"# HELP {base}_max tag={tag}")
+                lines.append(f"# TYPE {base}_max gauge")
+                lines.append(f"{base}_max {m.max:g}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# scrape parsing (check_obs_schema --metrics, tests)
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse a Prometheus text scrape into
+    {metric-name: {"type": ..., "tag": ..., "samples": [(labels, value)]}}.
+    Raises ValueError on any line that is neither a comment nor a
+    well-formed sample — the format guard the tests and the schema
+    checker share."""
+    import re
+
+    families: dict[str, dict] = {}
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+"
+        r"([-+]?(?:[0-9.]+(?:[eE][-+]?[0-9]+)?|[Nn]a[Nn]|[-+Ii]nf\w*))$"
+    )
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):].split(None, 1)
+            fam = families.setdefault(
+                rest[0], {"type": None, "tag": None, "samples": []}
+            )
+            if len(rest) > 1 and rest[1].startswith("tag="):
+                fam["tag"] = rest[1][len("tag="):].strip()
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):].split()
+            fam = families.setdefault(
+                rest[0], {"type": None, "tag": None, "samples": []}
+            )
+            fam["type"] = rest[1] if len(rest) > 1 else None
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            raise ValueError(
+                f"unparseable exposition line {lineno}: {line!r}"
+            )
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        # bind to an EXACTLY-matching declared family first (a summary's
+        # sibling `<base>_max` gauge declares its own family and must
+        # not fold into `<base>`); only then fold _total/_count/_sum/
+        # _max samples into their base family
+        base = name
+        if base not in families:
+            for suffix in ("_total", "_count", "_sum", "_max"):
+                if base.endswith(suffix):
+                    base = base[: -len(suffix)]
+                    break
+        fam = families.setdefault(
+            base, {"type": None, "tag": None, "samples": []}
+        )
+        fam["samples"].append((labels, float(value)))
+    return families

@@ -47,6 +47,10 @@ from pathlib import Path
 
 ENV_TRACE_DIR = "DEEPDFA_OBS_TRACE_DIR"
 
+#: compact separators: measurably cheaper dumps on this box and smaller
+#: trace files; Chrome/Perfetto do not care about whitespace
+_SEP = (",", ":")
+
 
 class _NullSpan:
     """Shared no-op context manager returned by a disabled span()."""
@@ -70,11 +74,32 @@ _NULL_SPAN = _NullSpan()
 #: renders as its own "device-steps" lane in the viewer)
 DEVICE_TRACK_TID = 2**31 - 2
 
+#: synthetic tid for the serve batcher's BACKDATED queue-wait windows
+#: (ts = each request's submit time, observed at flush): on the
+#: scheduler thread's own track the per-thread nudge would clamp them
+#: forward into the device spans (same hazard StepTimer dodges above);
+#: a dedicated track keeps them at their true submit times — requests
+#: within a batch are popped FIFO, so their backdated timestamps arrive
+#: (near-)sorted and the nudge stays at tie-breaking magnitude
+QUEUE_TRACK_TID = 2**31 - 3
+
 _tracer: "Tracer | None" = None
 #: True once the env var has been consulted, so a disabled hot path
 #: never re-reads os.environ (and an explicit disable() stays disabled)
 _env_checked = False
 _init_lock = threading.Lock()
+
+_tls = threading.local()
+
+
+def _native_id() -> int:
+    """threading.get_native_id() cached per thread: on older kernels it
+    is an uncached gettid() syscall (~13us on this box — measured), which
+    at serve-request event rates would dominate the event cost itself."""
+    tid = getattr(_tls, "tid", None)
+    if tid is None:
+        tid = _tls.tid = threading.get_native_id()
+    return tid
 
 
 class Tracer:
@@ -115,7 +140,7 @@ class Tracer:
 
     def _emit_raw(self, event: dict) -> None:
         with self._lock:
-            self._buf.append(json.dumps(event, default=str))
+            self._buf.append(json.dumps(event, default=str, separators=_SEP))
             if len(self._buf) >= self.flush_every:
                 self._flush_locked()
 
@@ -124,7 +149,7 @@ class Tracer:
         by `track_name`); otherwise the emitting thread's tid is used."""
         tid = event.get("tid")
         if tid is None:
-            tid = threading.get_native_id()
+            tid = _native_id()
         event["pid"] = self.pid
         event["tid"] = tid
         with self._lock:
@@ -144,7 +169,7 @@ class Tracer:
             if event["ts"] <= last:
                 event["ts"] = last + 0.001
             self._last_ts[tid] = event["ts"]
-            self._buf.append(json.dumps(event, default=str))
+            self._buf.append(json.dumps(event, default=str, separators=_SEP))
             if len(self._buf) >= self.flush_every:
                 self._flush_locked()
 
@@ -298,10 +323,12 @@ def complete_event(
     cat: str = "app",
     tid: int | None = None,
     track_name: str | None = None,
+    args: dict | None = None,
 ) -> None:
     """Emit a complete ("X") event with an EXPLICIT (possibly backdated)
     timestamp, optionally on a synthetic track — how StepTimer places
-    reconstructed device windows at their true dispatch times."""
+    reconstructed device windows at their true dispatch times (and how
+    the serve batcher places queue-wait windows at submit time)."""
     t = _tracer or _lazy_init()
     if t is None:
         return
@@ -311,6 +338,44 @@ def complete_event(
     }
     if tid is not None:
         event["tid"] = tid
+    if args:
+        event["args"] = args
+    t.emit(event, track_name=track_name)
+
+
+def flow(
+    name: str,
+    flow_id: str,
+    phase: str,
+    cat: str = "app",
+    ts_us: float | None = None,
+    tid: int | None = None,
+    track_name: str | None = None,
+    **args,
+) -> None:
+    """One Chrome-trace flow event: phase "s" (start), "t" (step), or
+    "f" (end). Events sharing a `flow_id` render as one linked arrow
+    chain across threads and processes — how a serve request's
+    frontend/queue/device spans connect in the merged Perfetto timeline
+    (docs/slo.md). A flow event binds to the slice enclosing its
+    timestamp on the emitting thread, so emit it INSIDE (or with a
+    `ts_us` inside) the span it should attach to; no-op when tracing is
+    off, like every emitter here."""
+    t = _tracer or _lazy_init()
+    if t is None:
+        return
+    if phase not in ("s", "t", "f"):
+        raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+    event: dict = {
+        "name": name, "cat": cat, "ph": phase, "id": flow_id,
+        "ts": Tracer.now_us() if ts_us is None else ts_us,
+    }
+    if phase == "f":
+        event["bp"] = "e"  # bind to the enclosing slice, not the next
+    if tid is not None:
+        event["tid"] = tid
+    if args:
+        event["args"] = args
     t.emit(event, track_name=track_name)
 
 

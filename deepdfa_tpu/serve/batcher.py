@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -35,9 +36,20 @@ from typing import Any, Callable, Hashable, Sequence
 
 import numpy as np
 
-from deepdfa_tpu.obs import metrics as obs_metrics
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.obs.slo import percentile  # noqa: F401 - canonical rule,
+# re-exported here because serve callers historically import it from the
+# batcher (obs/slo.py owns it now so /metrics shares the convention)
 
 _req_ids = itertools.count()
+
+
+def new_request_id() -> str:
+    """Process-unique request id assigned at ingress ("<pid hex>-<seq
+    hex>") — the flow-event id that links one request's frontend, queue,
+    and device spans in the merged trace, and the `request_id` echoed in
+    `/score` responses and serve_log.jsonl entries (docs/slo.md)."""
+    return f"{os.getpid():x}-{next(_req_ids):x}"
 
 
 class QueueFull(RuntimeError):
@@ -50,10 +62,18 @@ class RequestTooLarge(ValueError):
 
 @dataclasses.dataclass
 class ScoreRequest:
-    """One in-flight scoring request (a thread-safe future)."""
+    """One in-flight scoring request (a thread-safe future).
+
+    Besides the score future, the request carries its own stage
+    attribution (filled in by the frontend caller and the batch runner):
+    `frontend_s` extraction time, `queue_wait_s` time between submit and
+    batch start, `device_s` the executed batch's device time,
+    `batch_size` how many requests shared that batch — the fields the
+    SLO engine ingests and the opt-in `/score` trace echo returns."""
 
     payload: Any
     id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+    request_id: str = dataclasses.field(default_factory=new_request_id)
     t_submit: float = dataclasses.field(default_factory=time.monotonic)
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event
@@ -61,6 +81,10 @@ class ScoreRequest:
     result: float | None = None
     error: Exception | None = None
     latency_s: float | None = None
+    frontend_s: float | None = None
+    queue_wait_s: float | None = None
+    device_s: float | None = None
+    batch_size: int | None = None
 
     def set_result(self, value: float) -> None:
         self.result = value
@@ -78,15 +102,6 @@ class ScoreRequest:
         if self.error is not None:
             raise self.error
         return float(self.result)
-
-
-def percentile(sorted_vals: Sequence[float], p: float) -> float | None:
-    """Upper-biased quantile over a PRE-SORTED sample; None when empty.
-    The one index rule `/stats`, the score summaries, and bench_serve
-    all share — three private copies would drift apart."""
-    if not sorted_vals:
-        return None
-    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
 
 
 def _pow2_sizes(max_size: int) -> tuple[int, ...]:
@@ -431,11 +446,16 @@ class DynamicBatcher:
         queue_limit: int = 256,
         max_batch_delay_s: float = 0.025,
         on_batch: Callable[[], None] | None = None,
+        slo=None,
     ):
         self.executor = executor
         self.queue_limit = int(queue_limit)
         self.max_batch_delay_s = float(max_batch_delay_s)
         self.on_batch = on_batch
+        #: optional obs/slo.py:SloEngine — queue depth + batch occupancy
+        #: feed the rolling windows (request latency is observed by the
+        #: server/driver once the final HTTP status is known)
+        self.slo = slo
         self._lock = threading.Condition()
         self._pending: "OrderedDict[Hashable, deque[ScoreRequest]]" = (
             OrderedDict()
@@ -460,12 +480,23 @@ class DynamicBatcher:
 
     # -- admission -----------------------------------------------------------
 
-    def submit(self, payload) -> ScoreRequest:
+    def submit(
+        self,
+        payload,
+        request_id: str | None = None,
+        frontend_s: float | None = None,
+    ) -> ScoreRequest:
         """Enqueue one request; raises QueueFull (admission control) or
-        RequestTooLarge (can never fit a batch)."""
+        RequestTooLarge (can never fit a batch). `request_id` is the
+        ingress-assigned id (a fresh one is minted for direct callers);
+        `frontend_s` carries the extraction time measured upstream so
+        the request's stage attribution stays on the request."""
         self.executor.admit(payload)
         key = self.executor.bucket_key(payload)
         req = ScoreRequest(payload)
+        if request_id is not None:
+            req.request_id = request_id
+        req.frontend_s = frontend_s
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -479,6 +510,8 @@ class DynamicBatcher:
             self._n_pending += 1
             self._m_requests.inc()
             self._m_depth.set(self._n_pending)
+            if self.slo is not None:
+                self.slo.set_queue_depth(self._n_pending)
             self._lock.notify_all()
         return req
 
@@ -517,6 +550,8 @@ class DynamicBatcher:
             del self._pending[key]
         self._n_pending -= len(chunk)
         self._m_depth.set(self._n_pending)
+        if self.slo is not None:
+            self.slo.set_queue_depth(self._n_pending)
         return chunk
 
     def _take_ready(self, force: bool = False):
@@ -548,10 +583,50 @@ class DynamicBatcher:
             except Exception:
                 pass  # a failed poll must never fail the batch
         t0 = time.monotonic()
+        tracing = obs_trace.enabled()
         for req in chunk:
-            self._m_queue_wait.observe(t0 - req.t_submit)
+            req.queue_wait_s = t0 - req.t_submit
+            req.batch_size = len(chunk)
+            self._m_queue_wait.observe(req.queue_wait_s)
+        if tracing:
+            # the queue-wait windows, placed at their TRUE submit times
+            # (monotonic seconds and trace us share CLOCK_MONOTONIC) on
+            # a dedicated synthetic track: on this thread's own track
+            # the per-thread increasing-ts nudge would clamp backdated
+            # windows forward (the StepTimer hazard). Windows first —
+            # they arrive FIFO-sorted — then the flow steps (each at a
+            # ts >= the last window start and <= t0, so every flow
+            # still lands inside its request's window even if nudged)
+            for req in chunk:
+                obs_trace.complete_event(
+                    "queue_wait", ts_us=req.t_submit * 1e6,
+                    dur_us=req.queue_wait_s * 1e6, cat="serve",
+                    tid=obs_trace.QUEUE_TRACK_TID,
+                    track_name="serve-queue",
+                    args={"request_id": req.request_id},
+                )
+            for req in chunk:
+                obs_trace.flow(
+                    "request", req.request_id, "t", cat="serve",
+                    ts_us=(req.t_submit + req.queue_wait_s / 2) * 1e6,
+                    tid=obs_trace.QUEUE_TRACK_TID,
+                    track_name="serve-queue",
+                )
         try:
-            probs = self.executor.execute(key, [r.payload for r in chunk])
+            with obs_trace.span(
+                "device_execute", cat="serve", signature=str(key),
+                batch_size=len(chunk),
+                request_ids=[r.request_id for r in chunk] if tracing
+                else None,
+            ):
+                if tracing:
+                    for req in chunk:
+                        obs_trace.flow(
+                            "request", req.request_id, "f", cat="serve"
+                        )
+                probs = self.executor.execute(
+                    key, [r.payload for r in chunk]
+                )
         except Exception as e:
             for req in chunk:
                 req.set_error(e)
@@ -560,10 +635,12 @@ class DynamicBatcher:
         self.batches_run += 1
         self._m_batches.inc()
         self._m_device.observe(dt)
-        self._m_occupancy.observe(
-            len(chunk) / max(1, self.executor.capacity(key))
-        )
+        occupancy = len(chunk) / max(1, self.executor.capacity(key))
+        self._m_occupancy.observe(occupancy)
+        if self.slo is not None:
+            self.slo.observe_batch(occupancy)
         for req, p in zip(chunk, probs):
+            req.device_s = dt
             req.set_result(float(p))
             self._m_latency.observe(req.latency_s)
             self.recent_latencies.append(req.latency_s)
@@ -588,21 +665,35 @@ class DynamicBatcher:
                     if self._n_pending == 0:
                         return
 
-    def score_all(self, payloads: Sequence) -> list[ScoreRequest]:
+    def score_all(
+        self,
+        payloads: Sequence,
+        request_ids: Sequence[str] | None = None,
+        frontend_seconds: Sequence[float] | None = None,
+    ) -> list[ScoreRequest]:
         """Synchronously score a payload sequence through the SAME
         grouping/flush path the online scheduler uses. Submissions that
         hit the queue limit drain in place instead of rejecting — the
-        offline caller wants completion, not backpressure."""
+        offline caller wants completion, not backpressure. Optional
+        per-payload `request_ids`/`frontend_seconds` carry the ingress
+        identity and frontend timing the offline driver measured."""
         if self._thread is not None:
             raise RuntimeError(
                 "score_all is the offline drive; the scheduler thread "
                 "is running"
             )
         reqs: list[ScoreRequest] = []
-        for p in payloads:
+        for i, p in enumerate(payloads):
+            rid = request_ids[i] if request_ids is not None else None
+            fs = (
+                frontend_seconds[i]
+                if frontend_seconds is not None else None
+            )
             while True:
                 try:
-                    reqs.append(self.submit(p))
+                    reqs.append(
+                        self.submit(p, request_id=rid, frontend_s=fs)
+                    )
                     break
                 except QueueFull:
                     self._drain_once(force=True)
@@ -610,6 +701,9 @@ class DynamicBatcher:
                     # per-row fault isolation: one over-budget graph
                     # becomes a failed row, never a crashed job
                     req = ScoreRequest(p)
+                    if rid is not None:
+                        req.request_id = rid
+                    req.frontend_s = fs
                     req.set_error(e)
                     reqs.append(req)
                     break

@@ -51,6 +51,7 @@ def build_smoke_run(
     n_examples: int = 24,
     max_epochs: int = 2,
     seed: int = 0,
+    extra_overrides: list[str] | None = None,
 ):
     """Train a tiny GGNN and leave real run artifacts behind.
 
@@ -73,6 +74,7 @@ def build_smoke_run(
         # small serve batches keep the AOT ladder cheap to warm on CPU
         "serve.max_batch_graphs=4",
         "serve.node_budget=2048", "serve.edge_budget=8192",
+        *(extra_overrides or []),
     ])
     synth = generate(n_examples, seed=seed)
     examples = to_examples(synth)
@@ -179,8 +181,16 @@ def run_score(
 
 def run_serve_smoke(**smoke_kw) -> dict:
     """`serve --smoke`: smoke run + real HTTP round trips on an
-    ephemeral port (score, healthz, stats, a 422 reject), then teardown.
-    Returns the merged report."""
+    ephemeral port, then teardown. Beyond the PR-5 contract (score 200s,
+    a 422 reject, healthz/stats, zero steady-state recompiles) the smoke
+    now exercises the full observability surface (ISSUE 6 acceptance):
+    request tracing is ON (the merged trace must flow-link one request's
+    frontend/queue/device spans under its request_id), `/metrics` is
+    scraped to <run_dir>/metrics.prom for schema validation, a deep
+    healthz probes the backend, and per-request entries land in
+    serve_log.jsonl for the diag SLO section."""
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.obs import trace as obs_trace
     from deepdfa_tpu.serve.registry import ModelRegistry
     from deepdfa_tpu.serve.server import (
         BackgroundServer,
@@ -188,45 +198,93 @@ def run_serve_smoke(**smoke_kw) -> dict:
         write_serve_log,
     )
 
-    cfg, run_dir, sources_dir = build_smoke_run(**smoke_kw)
-    registry = ModelRegistry(
-        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint, cfg=cfg
+    cfg, run_dir, sources_dir = build_smoke_run(
+        extra_overrides=[
+            "serve.request_log=true",
+            "obs.trace=true",
+        ],
+        **smoke_kw,
     )
-    service = ScoringService(registry, cfg)
-    server = BackgroundServer(service)
-    try:
-        codes = [
-            f.read_text() for f in sorted(sources_dir.glob("*.c"))[:6]
-        ]
-        scored = []
-        for code in codes:
-            status, payload = server.request(
-                "POST", "/score", {"code": code}
+    with obs.session(cfg, run_dir):
+        registry = ModelRegistry(
+            run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+            cfg=cfg,
+        )
+        service = ScoringService(registry, cfg)
+        server = BackgroundServer(service)
+        try:
+            codes = [
+                f.read_text() for f in sorted(sources_dir.glob("*.c"))[:6]
+            ]
+            scored = []
+            for i, code in enumerate(codes):
+                # the first request opts into the per-stage trace echo
+                payload = {"code": code, "trace": True} if i == 0 else {
+                    "code": code
+                }
+                status, resp = server.request("POST", "/score", payload)
+                scored.append(
+                    (status, resp.get("prob"), resp.get("request_id"),
+                     resp.get("stages"))
+                )
+            bad_status, _ = server.request(
+                "POST", "/score", {"code": "not a function @@@"}
             )
-            scored.append((status, payload.get("prob")))
-        bad_status, _ = server.request(
-            "POST", "/score", {"code": "not a function @@@"}
-        )
-        h_status, health = server.request("GET", "/healthz")
-        s_status, stats = server.request("GET", "/stats")
-        record = dict(service.serve_record())
-        record["serve_steady_state_recompiles"] = (
-            service.steady_state_recompiles()
-        )
-        write_serve_log(run_dir, [record])
-        return {
-            "scored": [
-                {"status": st, "prob": p} for st, p in scored
-            ],
-            "reject_status": bad_status,
-            "healthz_status": h_status,
-            "healthz": health,
-            "stats_status": s_status,
-            "stats": stats,
-            "steady_state_recompiles": (
+            h_status, health = server.request("GET", "/healthz")
+            dh_status, deep_health = server.request(
+                "GET", "/healthz?deep=1"
+            )
+            s_status, stats = server.request("GET", "/stats")
+            m_status, metrics_text = server.request_text(
+                "GET", "/metrics"
+            )
+            (run_dir / "metrics.prom").write_text(metrics_text)
+            record = dict(service.serve_record())
+            record["serve_steady_state_recompiles"] = (
                 service.steady_state_recompiles()
-            ),
-            "run_dir": str(run_dir),
-        }
-    finally:
-        server.close()
+            )
+            write_serve_log(run_dir, [record])
+        finally:
+            server.close()
+    # the session is closed: per-process trace files are flushed and the
+    # merged trace.json is written — verify one scored request's spans
+    # are flow-linked under its request_id (the acceptance criterion)
+    rid = next((r for _, _, r, _ in scored if r), None)
+    events = obs_trace.merge(run_dir / "trace")
+    flow_phases = sorted({
+        e["ph"] for e in events
+        if e.get("id") == rid and e.get("ph") in ("s", "t", "f")
+    })
+    linked_spans = set()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        if (
+            args.get("request_id") == rid
+            or rid in (args.get("request_ids") or [])
+        ):
+            linked_spans.add(e["name"])
+    linked_spans = sorted(linked_spans)
+    return {
+        "scored": [
+            {"status": st, "prob": p, "request_id": r,
+             **({"stages": stg} if stg else {})}
+            for st, p, r, stg in scored
+        ],
+        "reject_status": bad_status,
+        "healthz_status": h_status,
+        "healthz": health,
+        "deep_healthz_status": dh_status,
+        "deep_healthz_backend": deep_health.get("backend"),
+        "stats_status": s_status,
+        "stats": stats,
+        "metrics_status": m_status,
+        "metrics_path": str(run_dir / "metrics.prom"),
+        "trace_flow_phases": flow_phases,
+        "trace_linked_spans": linked_spans,
+        "steady_state_recompiles": (
+            service.steady_state_recompiles()
+        ),
+        "run_dir": str(run_dir),
+    }

@@ -1,11 +1,16 @@
-"""HTTP scoring endpoint + offline batch scorer (docs/serving.md).
+"""HTTP scoring endpoint + offline batch scorer (docs/serving.md,
+docs/slo.md).
 
 stdlib-only (`http.server.ThreadingHTTPServer`) — the serving tax we
 actually care about is device batching, not framework features:
 
-  POST /score   {"code": "<C function>"}   -> {"ok": true, "prob": p}
-  GET  /healthz                            -> model/checkpoint identity
-  GET  /stats                              -> queue/latency/cache stats
+  POST /score    {"code": "<C function>"}  -> {"ok": true, "prob": p,
+                 "request_id": ...}; {"trace": true} opts into a
+                 per-stage latency echo
+  GET  /healthz  model/checkpoint identity; ?deep=1 adds a bounded
+                 backend probe (obs/health.py — wedge detection)
+  GET  /stats    queue/latency/cache stats + rolling SLO windows
+  GET  /metrics  Prometheus text exposition (obs/slo.py)
 
 Request lifecycle (see docs/serving.md for the diagram):
   HTTP thread -> frontend (cached feature extraction) -> bounded queue
@@ -13,6 +18,12 @@ Request lifecycle (see docs/serving.md for the diagram):
 Admission control maps to status codes: a full queue is 429, an
 unparseable function 422, an over-budget graph 413 — the caller learns
 to back off or split, the device never sees the bad request.
+
+Observability (this PR's tentpole): every request gets an id at
+ingress; its frontend/queue/device spans are flow-linked in the merged
+Chrome trace; the final status + stage attribution feed the SLO engine
+and (with `serve.request_log`) one `{"request": {...}}` entry per
+request in serve_log.jsonl.
 """
 
 from __future__ import annotations
@@ -21,21 +32,52 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Any
 
-from deepdfa_tpu.obs import metrics as obs_metrics
+from deepdfa_tpu.obs import (
+    health as obs_health,
+    metrics as obs_metrics,
+    slo as obs_slo,
+    trace as obs_trace,
+)
 from deepdfa_tpu.serve.batcher import (
     DynamicBatcher,
     GgnnExecutor,
     QueueFull,
     RequestTooLarge,
+    ScoreRequest,
+    new_request_id,
 )
 from deepdfa_tpu.serve.frontend import FrontendError, RequestPreprocessor
 from deepdfa_tpu.serve.registry import ModelRegistry
 
 logger = logging.getLogger(__name__)
+
+
+class RequestLog:
+    """Thread-safe per-request appender to serve_log.jsonl
+    (`serve.request_log`). ONE handle held open, flushed per entry (the
+    RunLogger rule): a crash loses at most the in-flight line, and the
+    log stays tail-able while serving."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._file = self.path.open("a")
+
+    def append(self, entry: dict) -> None:
+        line = json.dumps(entry)
+        with self._lock:
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._file.closed:
+                self._file.close()
 
 
 class ScoringService:
@@ -67,24 +109,95 @@ class ScoringService:
             feat_width=registry._feat_width(),
             etypes=cfg.model.n_etypes > 1,
         )
+        self.slo = obs_slo.SloEngine(
+            windows=scfg.slo_windows, max_samples=scfg.slo_window_samples
+        )
+        self.health = obs_health.BackendHealth()
+        self.request_log: RequestLog | None = (
+            RequestLog(registry.run_dir / "serve_log.jsonl")
+            if scfg.request_log else None
+        )
         self.batcher = DynamicBatcher(
             self.executor,
             queue_limit=scfg.queue_limit,
             max_batch_delay_s=scfg.max_batch_delay_ms / 1000.0,
-            on_batch=(registry.maybe_reload if scfg.hot_swap else None),
+            on_batch=(self._poll_hot_swap if scfg.hot_swap else None),
+            slo=self.slo,
         )
         self.warmup_report = self.executor.warmup()
         self.lowerings_after_warmup = self.executor.jit_lowerings()
 
-    def submit_code(self, code: str):
-        """frontend + enqueue; the caller waits on the returned request."""
-        spec = self.frontend.features(code)
-        return self.batcher.submit(spec)
+    def _poll_hot_swap(self) -> None:
+        if self.registry.maybe_reload():
+            self.slo.observe_hot_swap()
+
+    def submit_code(self, code: str, request_id: str | None = None):
+        """frontend + enqueue; the caller waits on the returned request.
+
+        The id assigned here (or passed from the HTTP ingress) travels
+        with the request: the frontend span carries it, the queue-wait
+        and device spans flow-link to it, and `finish_request` logs it."""
+        rid = request_id or new_request_id()
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.span("frontend", cat="serve", request_id=rid):
+                obs_trace.flow("request", rid, "s", cat="serve")
+                spec = self.frontend.features(code)
+            return self.batcher.submit(
+                spec, request_id=rid,
+                frontend_s=time.perf_counter() - t0,
+            )
+        except Exception as e:
+            # a rejected request (422/413/429) still did frontend work;
+            # ride the measurement on the exception so the epilogue can
+            # ingest it — under overload the rejected population is
+            # exactly the one the stage windows must not exclude
+            e.frontend_s = time.perf_counter() - t0
+            raise
+
+    def finish_request(
+        self,
+        request_id: str,
+        status: int,
+        latency_s: float | None,
+        req: ScoreRequest | None = None,
+        frontend_s: float | None = None,
+    ) -> dict:
+        """The single request epilogue (HTTP handler AND offline drive):
+        feed the SLO windows, append the per-request serve_log entry,
+        and return the stage attribution (the opt-in `/score` echo)."""
+        stages = {
+            "frontend": (
+                req.frontend_s if req is not None else frontend_s
+            ),
+            "queue": req.queue_wait_s if req is not None else None,
+            "device": req.device_s if req is not None else None,
+        }
+        self.slo.observe_request(
+            status, latency_s,
+            frontend_s=stages["frontend"], queue_s=stages["queue"],
+            device_s=stages["device"],
+        )
+        ms = {
+            f"{k}_ms": round(1e3 * v, 3)
+            for k, v in stages.items() if v is not None
+        }
+        if self.request_log is not None:
+            entry = {
+                "id": request_id, "status": int(status),
+                "t_unix": round(time.time(), 3), **ms,
+            }
+            if latency_s is not None:
+                entry["latency_ms"] = round(1e3 * latency_s, 3)
+            if req is not None and req.batch_size is not None:
+                entry["batch_size"] = req.batch_size
+            self.request_log.append({"request": entry})
+        return ms
 
     def steady_state_recompiles(self) -> int:
         return self.executor.jit_lowerings() - self.lowerings_after_warmup
 
-    def healthz(self) -> dict:
+    def healthz(self, deep: bool = False) -> dict:
         info = self.registry.info()
         info.update(
             warmed_signatures=[
@@ -93,6 +206,16 @@ class ScoringService:
             jit_lowerings=self.executor.jit_lowerings(),
             steady_state_recompiles=self.steady_state_recompiles(),
         )
+        if deep:
+            # bounded subprocess compile-and-execute of the DEFAULT
+            # backend (obs/health.py) — the wedged-compile-service
+            # detector; never on the request path, only when an
+            # operator/prober asks for it
+            info["backend"] = self.health.probe(
+                timeout_s=self.cfg.serve.health_probe_timeout_s
+            )
+        elif self.health.last() is not None:
+            info["backend"] = self.health.last()
         return info
 
     def stats(self) -> dict:
@@ -104,19 +227,36 @@ class ScoringService:
             for k, v in snap.items()
             if k.startswith("serve/")
         }
+        out["slo"] = self.slo.snapshot()
         return out
+
+    def metrics_text(self) -> str:
+        """The `/metrics` body: the process-wide registry + the rolling
+        SLO windows, one Prometheus text exposition
+        (scripts/check_obs_schema.py --metrics validates it)."""
+        return obs_slo.registry_exposition() + self.slo.exposition()
 
     def serve_record(self) -> dict:
         """One run-log record of the serve metrics (flattened by
-        `flatten_scalars` into the `serve/*` tags SCHEMA declares)."""
+        `flatten_scalars` into the `serve/*` + `serve_slo/*` +
+        `backend/*` tags SCHEMA declares)."""
         snap = obs_metrics.REGISTRY.snapshot()
-        return {
+        record = {
             "serve": {
                 k[len("serve/"):]: v
                 for k, v in snap.items()
                 if k.startswith("serve/")
-            }
+            },
+            "serve_slo": self.slo.snapshot(),
         }
+        backend = {
+            k[len("backend/"):]: v
+            for k, v in snap.items()
+            if k.startswith("backend/")
+        }
+        if backend:
+            record["backend"] = backend
+        return record
 
     def start(self) -> None:
         self.batcher.start()
@@ -124,6 +264,8 @@ class ScoringService:
     def close(self) -> None:
         self.batcher.close()
         self.frontend.close()
+        if self.request_log is not None:
+            self.request_log.close()
 
 
 def write_serve_log(run_dir, records) -> Path:
@@ -143,22 +285,51 @@ def score_texts(
     """Offline scoring of (name, code) pairs through the online path.
 
     Frontend failures become per-row errors, never a crash; the batcher
-    groups whatever was admitted exactly as live traffic would."""
+    groups whatever was admitted exactly as live traffic would. Every
+    row passes through the same `finish_request` epilogue as HTTP
+    traffic (status-code analog per outcome), so the SLO windows and
+    the request log cover offline drives too."""
     rows: list[dict] = []
-    payloads: list[tuple[dict, Any]] = []
+    payloads: list[tuple[dict, Any, str, float]] = []
     for name, code in texts:
         row = {"name": name}
         rows.append(row)  # input order preserved
+        rid = new_request_id()
+        row["request_id"] = rid
+        t0 = time.perf_counter()
         try:
-            payloads.append((row, service.frontend.features(code)))
+            with obs_trace.span("frontend", cat="serve", request_id=rid):
+                obs_trace.flow("request", rid, "s", cat="serve")
+                spec = service.frontend.features(code)
+            payloads.append(
+                (row, spec, rid, time.perf_counter() - t0)
+            )
         except (FrontendError, RequestTooLarge) as e:
+            status = 422 if isinstance(e, FrontendError) else 413
             row.update(ok=False, error=str(e))
-    reqs = service.batcher.score_all([spec for _, spec in payloads])
-    for (row, _), req in zip(payloads, reqs):
+            service.finish_request(
+                rid, status, time.perf_counter() - t0,
+                frontend_s=time.perf_counter() - t0,
+            )
+    reqs = service.batcher.score_all(
+        [spec for _, spec, _, _ in payloads],
+        request_ids=[rid for _, _, rid, _ in payloads],
+        frontend_seconds=[fs for _, _, _, fs in payloads],
+    )
+    for (row, _, rid, _), req in zip(payloads, reqs):
         try:
             row.update(ok=True, prob=req.wait(timeout_s))
+            service.finish_request(rid, 200, req.latency_s, req=req)
         except Exception as e:  # noqa: BLE001 - per-row fault isolation
             row.update(ok=False, error=str(e))
+            # same status-code analog per outcome as the HTTP path
+            if isinstance(e, RequestTooLarge):
+                status = 413
+            elif isinstance(e, TimeoutError):
+                status = 504
+            else:
+                status = 500
+            service.finish_request(rid, status, req.latency_s, req=req)
     return rows
 
 
@@ -174,14 +345,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, status: int, text: str) -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug("http: " + fmt, *args)
 
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-        if self.path == "/healthz":
-            self._reply(200, self.service.healthz())
-        elif self.path == "/stats":
+        url = urllib.parse.urlsplit(self.path)
+        query = urllib.parse.parse_qs(url.query)
+        if url.path == "/healthz":
+            deep = query.get("deep", ["0"])[0] not in ("", "0", "false")
+            self._reply(200, self.service.healthz(deep=deep))
+        elif url.path == "/stats":
             self._reply(200, self.service.stats())
+        elif url.path == "/metrics":
+            self._reply_text(200, self.service.metrics_text())
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -189,37 +375,64 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/score":
             self._reply(404, {"error": f"no route {self.path}"})
             return
+        rid = new_request_id()
+        t0 = time.monotonic()
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    f"body must be a JSON object, got "
+                    f"{type(payload).__name__}"
+                )
             code = payload["code"]
         except (ValueError, KeyError) as e:
-            self._reply(400, {"error": f"bad request: {e}"})
+            self.service.finish_request(rid, 400, time.monotonic() - t0)
+            self._reply(
+                400, {"error": f"bad request: {e}", "request_id": rid}
+            )
             return
-        t0 = time.monotonic()
+        want_trace = bool(payload.get("trace"))
+        req = None
         try:
-            req = self.service.submit_code(code)
+            req = self.service.submit_code(code, request_id=rid)
             prob = req.wait(self.request_timeout_s)
         except QueueFull as e:
-            self._reply(429, {"error": str(e)})
-            return
+            status, err = 429, e
         except RequestTooLarge as e:
-            self._reply(413, {"error": str(e)})
-            return
+            status, err = 413, e
         except FrontendError as e:
-            self._reply(422, {"error": str(e)})
-            return
+            status, err = 422, e
         except TimeoutError as e:
-            self._reply(504, {"error": str(e)})
-            return
-        self._reply(
-            200,
-            {
+            status, err = 504, e
+        except Exception as e:  # noqa: BLE001 - the any-status contract:
+            # an executor failure (batcher does set_error(e), wait()
+            # re-raises) must still be SLO-ingested and request-logged
+            # as a 500, never escape as a dropped connection
+            logger.exception("request %s failed", rid)
+            status, err = 500, e
+        else:
+            stages = self.service.finish_request(
+                rid, 200, time.monotonic() - t0, req=req
+            )
+            out = {
                 "ok": True,
                 "prob": prob,
                 "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
-            },
+                "request_id": rid,
+            }
+            if want_trace:
+                # opt-in per-request stage echo (docs/slo.md): where
+                # this request's time went, straight off the request
+                out["stages"] = stages
+                out["batch_size"] = req.batch_size
+            self._reply(200, out)
+            return
+        self.service.finish_request(
+            rid, status, time.monotonic() - t0, req=req,
+            frontend_s=getattr(err, "frontend_s", None),
         )
+        self._reply(status, {"error": str(err), "request_id": rid})
 
 
 def make_server(
@@ -266,6 +479,13 @@ class BackgroundServer:
         self._thread.start()
 
     def request(self, method: str, path: str, payload: dict | None = None):
+        status, raw = self.request_text(method, path, payload)
+        return status, json.loads(raw or "{}")
+
+    def request_text(
+        self, method: str, path: str, payload: dict | None = None
+    ):
+        """(status, body-text) — for non-JSON routes like /metrics."""
         import http.client
 
         conn = http.client.HTTPConnection(self.host, self.port, timeout=60)
@@ -275,7 +495,7 @@ class BackgroundServer:
             headers={"Content-Type": "application/json"} if body else {},
         )
         resp = conn.getresponse()
-        data = json.loads(resp.read() or b"{}")
+        data = resp.read().decode("utf-8", "replace")
         conn.close()
         return resp.status, data
 

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare the newest bench record against the
+committed BENCH_r*/BENCH_TPU_* trajectory with per-metric tolerances
+and emit a pass/fail markdown verdict (deepdfa_tpu/obs/bench_gate.py,
+docs/slo.md).
+
+The failure classes the verdict distinguishes:
+  regression    a gated metric fell outside tolerance vs the newest
+                healthy same-platform reference
+  cpu_fallback  the record ran on CPU because the accelerator probe
+                failed — BENCH_r02..r05's silent failure mode, now an
+                explicit gate failure (exit 2) instead of a buried
+                "fallback_from" string
+  error         the record is an error record
+
+Modes:
+  python scripts/bench_gate.py --record out.json      # gate one record
+  python scripts/bench_gate.py                        # newest BENCH_r*
+  python scripts/bench_gate.py --smoke                # tier-1: verify
+        the classifier on synthetic pass/regression/fallback records
+
+Exit codes: 0 pass, 1 regression/error, 2 cpu_fallback (the class the
+driver should page on differently: the backend is sick, not the code).
+
+Stdlib-only on purpose — the gate must run when jax/the backend is the
+broken thing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def newest_record(root: Path):
+    from deepdfa_tpu.obs.bench_gate import load_trajectory
+
+    trajectory = load_trajectory(root)
+    rounds = [e for e in trajectory if e.get("round") is not None]
+    for entry in reversed(rounds):
+        if isinstance(entry.get("record"), dict):
+            return entry["record"], entry["source"], trajectory
+    raise SystemExit(
+        f"no parseable BENCH_r*.json record under {root}"
+    )
+
+
+def run_smoke() -> int:
+    """Tier-1 self-check: a synthetic trajectory plus three synthetic
+    candidates must classify as pass / regression / cpu_fallback."""
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    trajectory = [
+        {
+            "source": "BENCH_r01.json", "round": 1,
+            "record": {
+                "metric": "deepdfa_infer_graphs_per_sec",
+                "value": 4000.0, "platform": "tpu",
+                "train_graphs_per_sec": 3500.0, "mfu": 0.003,
+            },
+        },
+        {
+            "source": "BENCH_r02.json", "round": 2,
+            "record": {
+                "metric": "deepdfa_infer_graphs_per_sec",
+                "value": 4100.0, "platform": "tpu",
+                "train_graphs_per_sec": 3600.0, "mfu": 0.003,
+            },
+        },
+    ]
+    ok_rec = {
+        "metric": "deepdfa_infer_graphs_per_sec",
+        "value": 4050.0, "platform": "tpu",
+        "train_graphs_per_sec": 3590.0, "mfu": 0.0031,
+    }
+    slow_rec = dict(ok_rec, value=2000.0)
+    fallback_rec = {
+        "metric": "deepdfa_infer_graphs_per_sec",
+        "value": 370.0, "platform": "cpu",
+        "fallback_from": "probe: backend probe timed out after 120s "
+        "(compile service wedged?)",
+    }
+    results = {
+        "pass": bg.gate(ok_rec, trajectory),
+        "regression": bg.gate(slow_rec, trajectory),
+        "cpu_fallback": bg.gate(fallback_rec, trajectory),
+    }
+    checks = [
+        results["pass"]["verdict"] == "pass",
+        results["regression"]["verdict"] == "fail",
+        "regression" in results["regression"]["failure_classes"],
+        results["cpu_fallback"]["verdict"] == "fail",
+        "cpu_fallback" in results["cpu_fallback"]["failure_classes"],
+        # a fallback record must not be judged against the tpu baseline
+        not results["cpu_fallback"]["checks"],
+        # the real committed trajectory parses (r1 has no record — a
+        # failed round; r2..r4 parse; watchdog captures interleave)
+        any(
+            isinstance(e.get("record"), dict)
+            for e in bg.load_trajectory(REPO)
+        ),
+    ]
+    print(bg.render_markdown(results["regression"], slow_rec))
+    print(json.dumps({
+        "ok": all(checks),
+        "checks_passed": sum(checks),
+        "checks_total": len(checks),
+        "verdicts": {
+            k: {"verdict": v["verdict"], "classes": v["failure_classes"]}
+            for k, v in results.items()
+        },
+    }), flush=True)
+    print(f"bench_gate smoke {'OK' if all(checks) else 'FAILED'}")
+    return 0 if all(checks) else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--record", default=None,
+                    help="candidate record JSON path (default: newest "
+                    "parseable BENCH_r*.json round)")
+    ap.add_argument("--root", default=str(REPO),
+                    help="directory holding BENCH_r*/BENCH_TPU_* artifacts")
+    ap.add_argument("--expect-platform", default=None,
+                    help="fail as cpu_fallback unless the record ran "
+                    "on this platform (e.g. tpu)")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="override a per-metric tolerance fraction")
+    ap.add_argument("--out", default=None, help="write verdict JSON here")
+    ap.add_argument("--markdown-out", default=None,
+                    help="write the markdown verdict here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 classifier self-check on synthetic "
+                    "records")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke()
+
+    from deepdfa_tpu.obs.bench_gate import (
+        gate,
+        load_trajectory,
+        render_markdown,
+    )
+
+    root = Path(args.root)
+    exclude = None
+    if args.record:
+        record = json.loads(Path(args.record).read_text())
+        if isinstance(record, dict) and isinstance(
+            record.get("parsed"), dict
+        ):
+            record = record["parsed"]  # accept a raw driver artifact
+        trajectory = load_trajectory(root)
+        source = args.record
+        # a --record path naming a committed artifact is that artifact
+        if Path(args.record).resolve().parent == root.resolve():
+            exclude = Path(args.record).name
+    else:
+        record, source, trajectory = newest_record(root)
+        exclude = source  # never judge the newest round against itself
+
+    tolerances = {}
+    for spec in args.tolerance:
+        metric, _, frac = spec.partition("=")
+        tolerances[metric] = float(frac)
+    result = gate(
+        record, trajectory,
+        tolerances=tolerances or None,
+        expect_platform=args.expect_platform,
+        exclude_source=exclude,
+    )
+    result["record_source"] = source
+    md = render_markdown(result, record)
+    print(md)
+    print(json.dumps(result), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(result, indent=1))
+    if args.markdown_out:
+        Path(args.markdown_out).write_text(md)
+    if result["verdict"] == "pass":
+        return 0
+    return 2 if "cpu_fallback" in result["failure_classes"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,12 @@ and reports:
   serve_latency_p50_ms / serve_latency_p99_ms  (warm pass)
   serve_batch_occupancy_mean  mean fill fraction of executed batches
   serve_steady_state_recompiles  must be 0 after warmup
+  serve_obs_overhead_fraction    warm-path cost of the FULL request
+      observability stack (request tracing + flow events + SLO window
+      ingest), measured with the PR-4 interleaved-reps method — plain
+      and instrumented passes alternate so the box's minute-to-minute
+      throughput drift cancels out of the comparison; documented bound
+      <=2% (docs/slo.md)
 
 Modes:
     python scripts/bench_serve.py --smoke   # tier-1 regression mode
@@ -82,10 +88,10 @@ def bench_serve(
     warmup_seconds = time.perf_counter() - t0
     lowerings0 = executor.jit_lowerings()
 
-    def one_pass() -> tuple[float, int, list[float]]:
+    def one_pass(slo=None) -> tuple[float, int, list[float]]:
         batcher = DynamicBatcher(
             executor, queue_limit=max(64, n),
-            max_batch_delay_s=0.005,
+            max_batch_delay_s=0.005, slo=slo,
         )
         payloads = []
         for e in examples:
@@ -95,6 +101,13 @@ def bench_serve(
                 pass
         t0 = time.perf_counter()
         reqs = batcher.score_all(payloads)
+        if slo is not None:
+            # the server epilogue per request: status + stage ingest
+            for r in reqs:
+                slo.observe_request(
+                    200 if r.error is None else 500, r.latency_s,
+                    queue_s=r.queue_wait_s, device_s=r.device_s,
+                )
         dt = time.perf_counter() - t0
         latencies = sorted(batcher.recent_latencies)
         batcher.close()
@@ -102,6 +115,46 @@ def bench_serve(
 
     cold_dt, scored, _ = one_pass()  # frontend runs (cache cold)
     warm_dt, _, lat = one_pass()  # cache hits: batching + device only
+
+    # SLO + tracing tax on the warm path (ISSUE 6 satellite): plain vs
+    # fully-instrumented (request tracing with flow events + SLO window
+    # ingest) passes INTERLEAVED — this box's throughput drifts minute
+    # to minute, so two sequential blocks would measure the drift, not
+    # the instrumentation (the PR-4 obs_overhead_fraction method)
+    import statistics
+    import tempfile
+
+    from deepdfa_tpu.obs import slo as obs_slo, trace as obs_trace
+
+    reps = 3 if smoke else 5
+    plain_dts: list[float] = []
+    inst_dts: list[float] = []
+    ambient_dir = os.environ.get(obs_trace.ENV_TRACE_DIR)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            for i in range(2 * reps):
+                instrumented = i % 2 == 1
+                if instrumented:
+                    obs_trace.enable(td, process_name="bench-serve")
+                try:
+                    dt_i, _, _ = one_pass(
+                        slo=obs_slo.SloEngine() if instrumented
+                        else None
+                    )
+                    (inst_dts if instrumented else plain_dts).append(
+                        dt_i
+                    )
+                finally:
+                    if instrumented:
+                        obs_trace.disable()
+    finally:
+        if ambient_dir:
+            obs_trace.enable(
+                ambient_dir, process_name="bench-serve",
+                export_env=True,
+            )
+    plain_rps = scored / statistics.median(plain_dts)
+    inst_rps = scored / statistics.median(inst_dts)
 
     from deepdfa_tpu.serve.batcher import percentile
 
@@ -133,6 +186,11 @@ def bench_serve(
         "serve_steady_state_recompiles": (
             executor.jit_lowerings() - lowerings0
         ),
+        "serve_instrumented_requests_per_sec": round(inst_rps, 2),
+        "serve_obs_overhead_fraction": round(
+            max(0.0, 1.0 - inst_rps / plain_rps), 4
+        ) if plain_rps else None,
+        "serve_obs_overhead_reps": reps,
         "n_examples": n,
         "max_batch_graphs": max_batch,
         "smoke": smoke,

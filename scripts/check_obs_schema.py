@@ -19,6 +19,12 @@ Modes:
                  serve_log.jsonl it produces — the `serve/*` tag half of
                  the schema (docs/serving.md)
   --serve-log <path>  validate an existing serve_log.jsonl
+  --metrics <path>    validate a Prometheus `/metrics` scrape (saved
+                 text, e.g. <run_dir>/metrics.prom from `serve --smoke`)
+                 against the same registry: every line must parse as
+                 exposition format 0.0.4, every family must carry its
+                 `tag=` back-reference, and every tag must be declared
+                 in SCHEMA (docs/slo.md)
 """
 
 from __future__ import annotations
@@ -94,6 +100,39 @@ def serve_smoke_records() -> list[dict]:
     ]
 
 
+def check_metrics_scrape(text: str) -> dict:
+    """Validate one Prometheus scrape against the declared registry
+    schema. A histogram/summary family's tag maps to its `<tag>/count`
+    declaration (the flattened-record spelling of the same metric)."""
+    from deepdfa_tpu.obs import metrics
+    from deepdfa_tpu.obs.slo import parse_exposition
+
+    try:
+        families = parse_exposition(text)
+    except ValueError as e:
+        return {"ok": False, "error": str(e)}
+    undeclared: list[str] = []
+    untagged: list[str] = []
+    n_samples = 0
+    for name, fam in sorted(families.items()):
+        n_samples += len(fam["samples"])
+        tag = fam.get("tag")
+        if not tag:
+            untagged.append(name)
+            continue
+        if not (
+            metrics.declared(tag) or metrics.declared(f"{tag}/count")
+        ):
+            undeclared.append(f"{name} (tag={tag})")
+    return {
+        "ok": not undeclared and not untagged,
+        "families": len(families),
+        "samples": n_samples,
+        "undeclared": undeclared,
+        "untagged": untagged,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -106,10 +145,31 @@ def main(argv=None) -> int:
                     "serve_log.jsonl")
     ap.add_argument("--serve-log", default=None,
                     help="validate an existing serve_log.jsonl")
+    ap.add_argument("--metrics", default=None,
+                    help="validate a saved Prometheus /metrics scrape")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
     from deepdfa_tpu.obs import metrics
+
+    if args.metrics:
+        result = check_metrics_scrape(Path(args.metrics).read_text())
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "metrics scrape validation failed (declare the tags in "
+                "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the "
+                "exporter):\n  " + "\n  ".join(
+                    result.get("undeclared", [])
+                    + result.get("untagged", [])
+                    + ([result["error"]] if "error" in result else [])
+                ),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
 
     if args.log or args.serve_log:
         records = [

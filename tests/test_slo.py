@@ -1,0 +1,494 @@
+"""Operational observability for serving (ISSUE 6): rolling SLO
+windows, Prometheus exposition, backend-health probes, the bench
+regression gate, and request-scoped trace linkage.
+
+The heavyweight end-to-end halves (HTTP /metrics scrape validated by
+check_obs_schema, flow-linked spans in a real `serve --smoke`, diag SLO
+section) live in tests/test_serve_cli.py; these are the unit-level
+contracts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.obs import health as obs_health, slo as obs_slo, trace
+from deepdfa_tpu.obs.slo import SloEngine, WindowedSamples, percentile
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# rolling windows
+
+
+def test_windowed_percentiles_match_brute_force():
+    """Property: for random observation times/values and random query
+    times, the engine's windowed percentile equals a brute-force filter
+    over the full (time, value) history."""
+    rng = np.random.default_rng(7)
+    horizon = 60.0
+    ring = WindowedSamples(horizon, max_samples=10_000)
+    history: list[tuple[float, float]] = []
+    t = 1000.0
+    for _ in range(400):
+        t += float(rng.exponential(2.0))
+        v = float(rng.lognormal(0.0, 1.0))
+        ring.observe(v, t)
+        history.append((t, v))
+        if rng.random() < 0.25:
+            # query at the current clock: eviction is destructive, so
+            # (like a wall clock) query times never run backwards
+            now = t
+            got = sorted(ring.values(now))
+            want = sorted(
+                v for (tv, v) in history if tv >= now - horizon
+            )
+            assert got == want
+            for q in (0.5, 0.95, 0.99):
+                assert percentile(got, q) == percentile(want, q)
+
+
+def test_windowed_samples_bounded():
+    ring = WindowedSamples(1e9, max_samples=16)
+    for i in range(100):
+        ring.observe(float(i), now=float(i))
+    vals = ring.values(now=100.0)
+    assert len(vals) == 16
+    assert vals == [float(i) for i in range(84, 100)]  # newest survive
+
+
+def test_slo_engine_windows_and_error_rate():
+    clock = {"t": 1000.0}
+    eng = SloEngine(windows=(60, 300), clock=lambda: clock["t"])
+    for i in range(10):
+        eng.observe_request(
+            200, 0.010 * (i + 1), frontend_s=0.001, queue_s=0.002,
+            device_s=0.004,
+        )
+    eng.observe_request(429, None)
+    eng.observe_request(500, 0.5)
+    snap = eng.snapshot()
+    v60 = snap["60s"]
+    assert v60["status"] == {"200": 10, "429": 1, "500": 1}
+    assert v60["error_rate"] == pytest.approx(2 / 12, abs=1e-4)
+    # latency stages all present, p50 over the 11 finite totals
+    assert v60["latency_ms"]["total"]["count"] == 11
+    assert v60["latency_ms"]["frontend"]["p50"] == 1.0
+    # 4 minutes later the 60s window is empty, the 300s one is not
+    clock["t"] += 240
+    snap = eng.snapshot()
+    assert "latency_ms" not in snap["60s"]
+    assert snap["300s"]["latency_ms"]["total"]["count"] == 11
+    # lifetime totals never age out
+    assert snap["requests_total"] == 12
+
+
+def test_windowed_status_counts_exact_beyond_sample_cap():
+    """Status counts have COUNTER semantics: a busy status past the
+    latency-sample cap must not distort the windowed error rate (a
+    sample-ring would truncate the 200s first and overstate errors)."""
+    clock = {"t": 1000.0}
+    eng = SloEngine(windows=(60,), max_samples=4, clock=lambda: clock["t"])
+    for _ in range(40):
+        eng.observe_request(200, 0.01)
+    eng.observe_request(500, 0.01)
+    view = eng.snapshot()["60s"]
+    assert view["status"] == {"200": 40, "500": 1}
+    assert view["error_rate"] == pytest.approx(1 / 41, abs=1e-4)
+    # latency quantiles DO degrade to the newest max_samples — that cap
+    # is the documented memory bound
+    assert view["latency_ms"]["total"]["count"] == 4
+
+
+def test_windowed_counts_evict_on_write():
+    """A ring nobody reads must not grow a bucket per active second
+    forever — eviction happens on observe() too."""
+    ring = obs_slo.WindowedCounts(horizon_s=10.0)
+    for sec in range(1000):
+        ring.observe(float(sec))
+    assert len(ring._buckets) <= 11
+    assert ring.total(999.0) == 11  # seconds 989..999 inclusive
+
+
+def test_exposition_max_gauge_binds_to_own_family():
+    """A summary's sibling `<base>_max` gauge declares its own family;
+    its sample must not fold into the base summary's samples."""
+    from deepdfa_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("serve/latency_seconds")
+    h.observe(0.05)
+    h.observe(0.20)
+    fams = obs_slo.parse_exposition(obs_slo.registry_exposition(reg))
+    base = fams["deepdfa_serve_latency_seconds"]
+    assert len(base["samples"]) == 2  # _count + _sum only
+    mx = fams["deepdfa_serve_latency_seconds_max"]
+    assert mx["type"] == "gauge"
+    assert mx["samples"] == [("", 0.2)]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+def test_slo_exposition_parses_and_labels():
+    clock = {"t": 50.0}
+    eng = SloEngine(windows=(60,), clock=lambda: clock["t"])
+    eng.observe_request(200, 0.05, queue_s=0.01, device_s=0.03)
+    eng.observe_request(404, 0.01)
+    eng.set_queue_depth(3)
+    eng.observe_hot_swap()
+    families = obs_slo.parse_exposition(eng.exposition())
+    lat = families["deepdfa_serve_slo_latency_ms"]
+    assert lat["type"] == "gauge" and lat["tag"] == "serve_slo/latency_ms"
+    assert any('quantile="0.99"' in lbl for lbl, _ in lat["samples"])
+    status = families["deepdfa_serve_requests_by_status_total"]
+    assert status["type"] == "counter"
+    assert (
+        sorted(status["samples"])
+        == [('{status="200"}', 1.0), ('{status="404"}', 1.0)]
+    )
+    assert (
+        families["deepdfa_serve_slo_queue_depth"]["samples"][0][1] == 3.0
+    )
+    assert (
+        families["deepdfa_serve_slo_hot_swaps_total"]["samples"][0][1]
+        == 1.0
+    )
+
+
+def test_registry_exposition_counters_monotone_and_declared():
+    """Scrape twice with traffic in between: every counter sample is
+    non-decreasing, every family parses, and every family's tag is
+    schema-declared (the check_obs_schema --metrics contract)."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    import check_obs_schema
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("serve/requests").inc(3)
+    reg.gauge("serve/queue_depth").set(2)
+    reg.histogram("serve/latency_seconds").observe(0.05)
+
+    def counters(text):
+        out = {}
+        for name, fam in obs_slo.parse_exposition(text).items():
+            if fam["type"] == "counter":
+                for lbl, v in fam["samples"]:
+                    out[name + lbl] = v
+        return out
+
+    scrape1 = obs_slo.registry_exposition(reg)
+    reg.counter("serve/requests").inc(2)
+    reg.histogram("serve/latency_seconds").observe(0.07)
+    scrape2 = obs_slo.registry_exposition(reg)
+    c1, c2 = counters(scrape1), counters(scrape2)
+    assert c1 and all(c2[k] >= v for k, v in c1.items())
+
+    result = check_obs_schema.check_metrics_scrape(scrape2)
+    assert result["ok"], result
+    assert result["families"] >= 3
+
+    # an undeclared registry tag fails the scrape validation
+    reg.counter("totally/new_metric").inc()
+    bad = check_obs_schema.check_metrics_scrape(
+        obs_slo.registry_exposition(reg)
+    )
+    assert not bad["ok"]
+    assert any("totally/new_metric" in u for u in bad["undeclared"])
+
+    # malformed exposition text is a parse error, not a pass
+    assert not check_obs_schema.check_metrics_scrape(
+        "deepdfa_x{unclosed 1\n"
+    )["ok"]
+
+
+# ---------------------------------------------------------------------------
+# backend health
+
+
+def test_backend_health_probe_timeout_path():
+    """A probe that times out is a WEDGE (service hung), retried the
+    configured number of times, and lands in the backend/* metrics —
+    the /healthz?deep=1 failure path without a real 60s subprocess."""
+    from deepdfa_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    calls = []
+
+    def fake_probe(timeout_s):
+        calls.append(timeout_s)
+        return False, (
+            f"backend probe timed out after {timeout_s:.0f}s "
+            "(compile service wedged?)"
+        )
+
+    h = obs_health.BackendHealth(probe_fn=fake_probe, registry=reg)
+    report = h.probe(timeout_s=5.0, retries=2)
+    assert calls == [5.0, 5.0, 5.0]
+    assert report["ok"] is False
+    assert report["wedged"] is True
+    assert report["attempts"] == 3
+    snap = reg.snapshot()
+    assert snap["backend/probes"] == 3
+    assert snap["backend/probe_failures"] == 3
+    assert snap["backend/probe_retries"] == 2
+    assert snap["backend/wedges"] == 3
+    assert snap["backend/healthy"] == 0.0
+    assert snap["backend/probe_seconds/count"] == 3
+    assert h.last()["wedged"] is True
+
+    h.record_fallback("wedged; falling back to cpu")
+    assert reg.snapshot()["backend/fallbacks"] == 1
+    assert h.last()["fallback"] is True
+
+
+def test_backend_health_probe_recovery_and_fast_failure():
+    from deepdfa_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    outcomes = [(False, "backend probe rc=1: tunnel down"), (True, "tpu")]
+    h = obs_health.BackendHealth(
+        probe_fn=lambda t: outcomes.pop(0), registry=reg
+    )
+    report = h.probe(timeout_s=1.0, retries=3)
+    assert report["ok"] and report["platform"] == "tpu"
+    assert report["attempts"] == 2
+    snap = reg.snapshot()
+    # rc!=0 is a fast failure, NOT a wedge (different operator action)
+    assert snap["backend/wedges"] == 0
+    assert snap["backend/probe_failures"] == 1
+    assert snap["backend/healthy"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate
+
+
+def _trajectory():
+    return [{
+        "source": "BENCH_r01.json", "round": 1,
+        "record": {
+            "metric": "deepdfa_infer_graphs_per_sec", "value": 4000.0,
+            "platform": "tpu", "train_graphs_per_sec": 3000.0,
+            "serve_latency_p99_ms": 10.0,
+        },
+    }]
+
+
+def test_bench_gate_pass_regression_fallback():
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    traj = _trajectory()
+    ok = bg.gate(
+        {"value": 3900.0, "platform": "tpu",
+         "train_graphs_per_sec": 2950.0, "serve_latency_p99_ms": 11.0},
+        traj,
+    )
+    assert ok["verdict"] == "pass" and not ok["failure_classes"]
+    assert {c["metric"] for c in ok["checks"]} == {
+        "value", "train_graphs_per_sec", "serve_latency_p99_ms"
+    }
+
+    slow = bg.gate({"value": 3000.0, "platform": "tpu"}, traj)
+    assert slow["verdict"] == "fail"
+    assert slow["failure_classes"] == ["regression"]
+    bad = [c for c in slow["checks"] if not c["ok"]]
+    assert [c["metric"] for c in bad] == ["value"]
+
+    # lower-is-better metric regresses UPWARD
+    lat = bg.gate(
+        {"value": 4000.0, "platform": "tpu",
+         "serve_latency_p99_ms": 20.0}, traj,
+    )
+    assert "regression" in lat["failure_classes"]
+
+    fb = bg.gate(
+        {"value": 300.0, "platform": "cpu",
+         "fallback_from": "probe: backend probe timed out"},
+        traj,
+    )
+    assert fb["verdict"] == "fail"
+    assert fb["failure_classes"] == ["cpu_fallback"]
+    assert not fb["checks"]  # never judged against the tpu baseline
+
+    wrong = bg.gate(
+        {"value": 500.0, "platform": "cpu"}, traj,
+        expect_platform="tpu",
+    )
+    assert "cpu_fallback" in wrong["failure_classes"]
+
+    md = bg.render_markdown(slow, {"metric": "m", "value": 3000.0})
+    assert "FAIL" in md and "regression" in md and "| value |" in md
+
+
+def test_bench_gate_reference_skips_fallback_records():
+    """A fallback record in the trajectory must never become the
+    baseline (the silent-rebaseline bug class), and an embedded
+    last_healthy_tpu capture wins for tpu candidates."""
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    traj = _trajectory() + [{
+        "source": "BENCH_r02.json", "round": 2,
+        "record": {
+            "value": 100.0, "platform": "cpu",
+            "fallback_from": "wedged",
+            "last_healthy_tpu": {
+                "artifact": "BENCH_TPU_X.json",
+                "bench": {"value": 4500.0, "platform": "tpu"},
+            },
+        },
+    }]
+    ref = bg.reference_for(traj, "tpu")
+    assert ref["record"]["value"] == 4500.0
+    assert "last_healthy_tpu" in ref["source"]
+    assert bg.reference_for(traj, "cpu") is None  # fallback != baseline
+
+    # a committed candidate must not be judged against itself: with r01
+    # excluded there is no earlier tpu reference at all, and a regressed
+    # r01 re-gated WITHOUT exclusion would pass vacuously (ratio 1.0)
+    assert bg.reference_for(
+        _trajectory(), "tpu", exclude_source="BENCH_r01.json"
+    ) is None
+    self_cmp = bg.gate(
+        _trajectory()[0]["record"], _trajectory(),
+        exclude_source="BENCH_r01.json",
+    )
+    assert not self_cmp["checks"]
+    assert any("no healthy" in n for n in self_cmp["notes"])
+
+
+def test_bench_gate_loads_real_trajectory_and_smoke():
+    """The committed BENCH_r*/BENCH_TPU_* artifacts parse (r1's failed
+    round and r5's truncated tail degrade to notes, not crashes), and
+    the script smoke self-check passes — the tier-1 wiring."""
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    traj = bg.load_trajectory(REPO)
+    by_source = {e["source"]: e for e in traj}
+    assert by_source["BENCH_r01.json"]["record"] is None
+    assert by_source["BENCH_r02.json"]["record"]["platform"] == "cpu"
+    assert (
+        bg.classify(by_source["BENCH_r02.json"]["record"])
+        == "cpu_fallback"
+    )
+    assert any(e["source"].startswith("BENCH_TPU_") for e in traj)
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_gate.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    assert "bench_gate smoke OK" in proc.stdout
+
+
+def test_bench_gate_cli_fallback_exit_code(tmp_path):
+    """Gating a CPU-fallback record exits 2 — the class the driver
+    pages on differently (sick backend, not slow code)."""
+    rec = tmp_path / "rec.json"
+    rec.write_text(json.dumps({
+        "metric": "deepdfa_infer_graphs_per_sec", "value": 370.0,
+        "platform": "cpu", "fallback_from": "probe timed out",
+    }))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_gate.py"),
+         "--record", str(rec), "--out", str(tmp_path / "verdict.json")],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 2, (proc.stdout + proc.stderr)[-1500:]
+    verdict = json.loads((tmp_path / "verdict.json").read_text())
+    assert verdict["failure_classes"] == ["cpu_fallback"]
+
+
+# ---------------------------------------------------------------------------
+# request-scoped trace linkage (batcher level; the full HTTP path is
+# asserted by the serve --smoke CLI test)
+
+
+def test_request_flow_linkage_in_merged_trace(tmp_path):
+    """With tracing on, a scored request's queue-wait and device spans
+    in the merged trace both carry its request_id, and its flow chain
+    (s at the frontend span, t in the queue window, f in the device
+    span) shares that id — one request, one linked arrow chain."""
+    jax = pytest.importorskip("jax")
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.serve.batcher import DynamicBatcher, GgnnExecutor
+
+    synth = generate(6, seed=5)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(6), limit_all=50,
+        limit_subkeys=50,
+    )
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+    ])
+    model = DeepDFA.from_config(
+        cfg.model, input_dim=cfg.data.feat.input_dim
+    )
+    params = model.init(jax.random.key(0), pack([], 1, 2048, 8192))
+    executor = GgnnExecutor(
+        model, lambda: params, node_budget=2048, edge_budget=8192,
+        max_batch_graphs=4,
+    )
+    executor.warmup()
+
+    tdir = tmp_path / "trace"
+    rids = [f"test-{i}" for i in range(len(specs))]
+    trace.enable(tdir, process_name="test")
+    try:
+        for rid in rids:
+            # what ScoringService.submit_code emits around the frontend
+            with trace.span("frontend", cat="serve", request_id=rid):
+                trace.flow("request", rid, "s", cat="serve")
+        batcher = DynamicBatcher(executor, queue_limit=64)
+        reqs = batcher.score_all(specs, request_ids=rids)
+        assert all(r.error is None for r in reqs)
+        # stage attribution landed on every request
+        assert all(
+            r.queue_wait_s is not None and r.device_s is not None
+            and r.batch_size >= 1
+            for r in reqs
+        )
+    finally:
+        trace.disable()
+
+    events = trace.merge(tdir)
+    rid = rids[0]
+    frontend = [
+        e for e in events if e.get("ph") == "X"
+        and e.get("name") == "frontend"
+        and (e.get("args") or {}).get("request_id") == rid
+    ]
+    queue = [
+        e for e in events if e.get("ph") == "X"
+        and e.get("name") == "queue_wait"
+        and (e.get("args") or {}).get("request_id") == rid
+    ]
+    device = [
+        e for e in events if e.get("ph") == "X"
+        and e.get("name") == "device_execute"
+        and rid in ((e.get("args") or {}).get("request_ids") or [])
+    ]
+    assert frontend and queue and device
+    flows = {
+        e["ph"] for e in events
+        if e.get("id") == rid and e.get("ph") in ("s", "t", "f")
+    }
+    assert flows == {"s", "t", "f"}
+    # the device span records the batch signature it executed
+    assert device[0]["args"]["batch_size"] >= 1
+    assert "signature" in device[0]["args"]
