@@ -62,6 +62,7 @@ COMBINED_TIMEOUT = float(
     os.environ.get("DEEPDFA_BENCH_COMBINED_TIMEOUT", 600)
 )
 SERVE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_SERVE_TIMEOUT", 420))
+SCAN_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_SCAN_TIMEOUT", 420))
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -525,6 +526,54 @@ def run_serve_measurement(platform: str) -> dict:
     }
 
 
+def run_scan_measurement(platform: str) -> dict:
+    """Whole-repo scan observables (ISSUE 8); child, CPU-viable.
+
+    Delegates to scripts/bench_scan.py:bench_scan — the cold / warm-
+    cache / incremental-rescan drive tier-1 smokes — and prefixes the
+    fields for the merged record. The incremental-skip and zero-
+    recompile contracts ride along as measured fields."""
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    if "DEEPDFA_TPU_STORAGE" not in os.environ:
+        import tempfile
+
+        tmp = tempfile.TemporaryDirectory(prefix="bench-scan-")
+        os.environ["DEEPDFA_TPU_STORAGE"] = tmp.name
+    from bench_scan import bench_scan
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_scan(
+        int(os.environ.get("DEEPDFA_BENCH_SCAN_FUNCTIONS",
+                           24 if smoke else 96)),
+        smoke=smoke,
+    )
+    return {
+        "scan_functions_per_sec": rec["scan_functions_per_sec"],
+        "scan_warm_functions_per_sec": rec["scan_warm_functions_per_sec"],
+        "scan_incremental_functions_per_sec": (
+            rec["scan_incremental_functions_per_sec"]
+        ),
+        "scan_cache_hit_fraction": rec["scan_cache_hit_fraction"],
+        "scan_incremental_skip_fraction": (
+            rec["scan_incremental_skip_fraction"]
+        ),
+        "scan_steady_state_recompiles": (
+            rec["scan_steady_state_recompiles"]
+        ),
+        "scan_platform": platform,
+    }
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -597,6 +646,20 @@ def _measure_full(
                 result["serve_error"] = serr
         else:
             result["serve_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_SCAN", "1") == "1":
+        # whole-repo scan observables (ISSUE 8), own bounded child for
+        # the same wedge-isolation reason as the other children
+        scbudget = min(SCAN_TIMEOUT, deadline - time.time())
+        if scbudget >= 90:
+            scan, scerr = _run_child(
+                "--child-scan", result.get("platform", platform), scbudget
+            )
+            if scan is not None:
+                result.update(scan)
+            else:
+                result["scan_error"] = scerr
+        else:
+            result["scan_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -800,6 +863,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-serve":
         print(
             _CHILD_TAG + json.dumps(run_serve_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-scan":
+        print(
+            _CHILD_TAG + json.dumps(run_scan_measurement(sys.argv[2])),
             flush=True,
         )
     else:

@@ -321,6 +321,61 @@ class ServeConfig:
     # compile-and-execute against the DEFAULT backend (obs/health.py) —
     # the wedged-compile-service detector, never run on the request path
     health_probe_timeout_s: float = 60.0
+    # -- line-level attributions (serve/localize.py, docs/scanning.md)
+    # AOT-warm the per-node attribution executables next to the scoring
+    # ladder and accept {"lines": true} on POST /score; off by default —
+    # the extra warmup compiles are only paid when localization serves
+    lines: bool = False
+    # attribution method for the served line scores (eval/localize.py
+    # GGNN family: attention | saliency | input_x_gradient | deeplift |
+    # lig)
+    lines_method: str = "saliency"
+    # Riemann steps for the path methods (deeplift/lig); small by
+    # default — the serving tax is n_steps gradient evaluations
+    lines_steps: int = 8
+    # top-scoring lines echoed per request (0 = every tokenized line)
+    lines_top_k: int = 10
+
+
+@dataclass(frozen=True)
+class ScanConfig:
+    """Whole-repo incremental scanning knobs (deepdfa_tpu/scan/,
+    docs/scanning.md).
+
+    Only the `scan` CLI command reads this section. A scan walks a
+    repository, splits every C/C++ source into function definitions,
+    scores each through the serving stack (shared content-keyed
+    frontend cache + dynamic batcher + AOT executables), and streams
+    findings to JSONL and SARIF 2.1.0. The persistent manifest makes a
+    re-scan of an edited repo touch only the changed functions."""
+
+    # source suffixes the walker collects (serve/driver.py's set plus
+    # the C++ header spellings)
+    suffixes: tuple[str, ...] = (
+        ".c", ".cc", ".cpp", ".cxx", ".h", ".hpp", ".hh", ".hxx",
+    )
+    # directory names pruned anywhere in the tree (VCS metadata, build
+    # output, vendored code); hidden directories are pruned regardless
+    exclude_dirs: tuple[str, ...] = (
+        ".git", ".hg", ".svn", "build", "cmake-build-debug", "out",
+        "node_modules", "third_party", "vendor", "external",
+    )
+    # files larger than this are skipped (generated/amalgamated sources
+    # dominate scan time and drown the findings)
+    max_file_kb: int = 1024
+    # findings threshold: functions scoring >= this land in the SARIF
+    # results (every function still lands in the JSONL stream)
+    threshold: float = 0.5
+    # per-finding line attributions (serve/localize.py AOT executables;
+    # method/steps/top-k shared with the serve endpoint via serve.lines_*)
+    lines: bool = False
+    # re-use the persistent manifest: functions whose content key and
+    # model identity match the previous scan are not re-extracted or
+    # re-scored. false = always scan cold (the manifest is still written)
+    incremental: bool = True
+    # manifest path override; default
+    # <run_dir>/scan_state/<sha16 of repo abspath>.json
+    state: str | None = None
 
 
 @dataclass(frozen=True)
@@ -387,6 +442,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    scan: ScanConfig = field(default_factory=ScanConfig)
 
 
 # ---------------------------------------------------------------------------

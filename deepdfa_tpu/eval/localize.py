@@ -272,6 +272,199 @@ def combined_saliency_scores(
     return np.asarray(jnp.linalg.norm(grads * rows, axis=-1))
 
 
+# ---------------------------------------------------------------------------
+# GGNN node-level attribution (the flagship family's localization path)
+#
+# The transformer family above attributes the vuln logit to token
+# embedding rows; the GGNN analog attributes it to per-node embedding
+# rows of a packed `GraphBatch`, which map straight back to source lines
+# (every CFG node carries one). Both the offline eval below and the
+# served AOT executables (serve/localize.py) call `ggnn_score_fn`, so
+# the two paths cannot drift — tests pin them bit-identical.
+
+GGNN_METHODS = (
+    "attention",
+    "saliency",
+    "input_x_gradient",
+    "deeplift",
+    "lig",
+)
+
+
+def _ggnn_embedding(model):
+    """The model's own AbstractDataflowEmbedding, reconstructed with the
+    hyperparameters DeepDFA.__call__ uses."""
+    from deepdfa_tpu.nn import AbstractDataflowEmbedding
+
+    struct_vocab: tuple[int, ...] = ()
+    if model.struct_feats:
+        from deepdfa_tpu.frontend.structfeat import STRUCT_VOCAB
+
+        struct_vocab = STRUCT_VOCAB
+    return AbstractDataflowEmbedding(
+        input_dim=model.input_dim,
+        embedding_dim=model.hidden_dim,
+        concat_all=model.concat_all_absdf,
+        param_dtype=model.param_dtype,
+        struct_vocab=struct_vocab,
+    )
+
+
+def _unwrap(params):
+    return params["params"] if "params" in params else params
+
+
+def ggnn_forward(model, params, batch):
+    """(fn(rows) -> ([G] vuln logits, [N] pooling attention), rows) —
+    embedding-injected forward for the graph-level DeepDFA classifier
+    (models/deepdfa.py, label_style="graph").
+
+    Recomposed from the model's own submodules ("embedding"/"ggnn"/
+    "pooling"/"head" param subtrees) so jax.grad can reach the per-node
+    embedding rows; the pooling readout is inlined because
+    GlobalAttentionPooling returns only the pooled sum and the per-node
+    attention weights ARE the "GGNN node scores" method. Logit parity
+    with `model.apply` is pinned bit-identical in tests/test_scan.py —
+    the drift guard for this recomposition."""
+    from deepdfa_tpu.nn import GatedGraphConv, OutputHead
+    from deepdfa_tpu.nn.gnn import segment_softmax, segment_sum
+
+    if model.label_style != "graph":
+        raise ValueError(
+            f"GGNN localization attributes the graph-level logit; "
+            f"label_style={model.label_style!r} has no single logit to "
+            f"attribute"
+        )
+    p = _unwrap(params)
+    rows = _ggnn_embedding(model).apply(
+        {"params": p["embedding"]}, batch.node_feats
+    )
+
+    def fn(rows):
+        width = rows.shape[-1]
+        ggnn_out = GatedGraphConv(
+            out_features=width,
+            n_steps=model.n_steps,
+            n_etypes=model.n_etypes,
+            scan_steps=model.scan_steps,
+            param_dtype=model.param_dtype,
+        ).apply({"params": p["ggnn"]}, batch, rows)
+        out = jnp.concatenate([ggnn_out, rows], axis=-1)
+        gp = p["pooling"]["gate_nn"]
+        gate = out @ gp["kernel"] + gp["bias"]
+        g = batch.num_graphs
+        attn = segment_softmax(
+            gate[:, 0], batch.node_graph, batch.node_mask, g + 1,
+            indices_are_sorted=True,
+        )
+        pooled = segment_sum(
+            attn[:, None] * out, batch.node_graph, g + 1,
+            indices_are_sorted=True,
+        )[:g]
+        logits = OutputHead(
+            num_layers=model.num_output_layers,
+            param_dtype=model.param_dtype,
+        ).apply({"params": p["head"]}, pooled)
+        return logits[..., 0], attn
+
+    return fn, rows
+
+
+def _summarize_nodes(attr: jax.Array, batch) -> jax.Array:
+    """[N, D] node attributions -> [N] scores: sum over the embedding
+    dim, L2-normalized WITHIN each graph segment (the captum-tutorial
+    summarization of `_summarize`, per graph instead of per row);
+    padding slots are zeroed."""
+    from deepdfa_tpu.nn.gnn import segment_sum
+
+    s = attr.sum(axis=-1)
+    s = jnp.where(batch.node_mask, s, 0.0)
+    norm = jnp.sqrt(
+        segment_sum(
+            s * s, batch.node_graph, batch.num_graphs + 1,
+            indices_are_sorted=True,
+        )
+    )
+    return s / jnp.maximum(norm[batch.node_graph], 1e-12)
+
+
+def ggnn_score_fn(method: str, model, n_steps: int = 8) -> Callable:
+    """Pure jittable (params, batch) -> (probs [G], node_scores [N]).
+
+    One function serves both drives: the offline eval path jits it
+    directly; serve/localize.py AOT-lowers it per batch signature
+    (shared warmup ladder with the scoring executor). Methods mirror the
+    transformer family where they transfer:
+
+    - `attention`: the GlobalAttentionPooling gate weights — what the
+      trained readout already attends to, gradient-free;
+    - `saliency` / `input_x_gradient`: first-order grads of the vuln
+      logit wrt the node embedding rows;
+    - `deeplift`: n-step rescale against the zero baseline;
+    - `lig`: integrated gradients against the model's own "node is not
+      a definition" baseline (vocab index 0 in every subkey table — the
+      GGNN analog of the reference's pad-everywhere ref input).
+
+    Per-graph independence (masked segment ops, no cross-graph edges)
+    keeps node scores independent of co-batched neighbors up to float32
+    reduction order; at a FIXED batch signature the function is
+    deterministic, which is what pins served-vs-offline bit-identity
+    (tests/test_scan.py)."""
+    if method not in GGNN_METHODS:
+        raise ValueError(
+            f"unknown GGNN method {method!r} (choose from {GGNN_METHODS})"
+        )
+
+    def run(params, batch):
+        params = jax.tree.map(jnp.asarray, params)
+        fn, rows = ggnn_forward(model, params, batch)
+        logits, attn = fn(rows)
+        probs = jax.nn.sigmoid(logits)
+        if method == "attention":
+            return probs, jnp.where(batch.node_mask, attn, 0.0)
+        grad = jax.grad(lambda r: fn(r)[0].sum())
+        if method == "saliency":
+            attr = jnp.abs(grad(rows))
+        elif method == "input_x_gradient":
+            attr = grad(rows) * rows
+        elif method == "deeplift":
+            attr = _path_attribution(
+                grad, rows, jnp.zeros_like(rows), n_steps
+            )
+        else:  # lig
+            base = _ggnn_embedding(model).apply(
+                {"params": _unwrap(params)["embedding"]},
+                jnp.zeros_like(batch.node_feats),
+            )
+            attr = _path_attribution(grad, rows, base, n_steps)
+        return probs, _summarize_nodes(attr, batch)
+
+    return run
+
+
+def node_line_attributions(
+    node_scores, node_lines, top_k: int = 0
+) -> list[dict]:
+    """[n] per-node scores + [n] 1-based source lines (the function's
+    own coordinates) -> ranked [{"line", "score"}], max-reduced per line
+    (the `aggregate_line_scores` rule), truncated to `top_k` when > 0.
+
+    No rounding: the served payload must stay bit-identical to the
+    offline eval on the same checkpoint (tests/test_scan.py)."""
+    by_line: dict[int, float] = {}
+    for s, ln in zip(np.asarray(node_scores), np.asarray(node_lines)):
+        ln = int(ln)
+        if ln < 1:
+            continue
+        s = float(s)
+        if ln not in by_line or s > by_line[ln]:
+            by_line[ln] = s
+    ranked = sorted(by_line.items(), key=lambda kv: (-kv[1], kv[0]))
+    if top_k:
+        ranked = ranked[:top_k]
+    return [{"line": ln, "score": s} for ln, s in ranked]
+
+
 def aggregate_line_scores(
     token_scores: np.ndarray,
     token_lines: np.ndarray,

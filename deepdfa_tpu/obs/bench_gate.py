@@ -39,6 +39,9 @@ DEFAULT_TOLERANCES: dict[str, float] = {
     "combined_train_tokens_per_sec": 0.20,
     "mfu": 0.25,
     "train_mfu": 0.25,
+    # whole-repo scanning (ISSUE 8; gated once both records carry it)
+    "scan_functions_per_sec": 0.20,
+    "scan_incremental_functions_per_sec": 0.25,
 }
 
 #: fail when `new > (1 + tol) * reference` (lower is better)

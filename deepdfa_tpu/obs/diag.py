@@ -30,8 +30,9 @@ from deepdfa_tpu.obs import trace
 _INPUT_STAGES = ("load", "pack", "place", "wait")
 
 
-def load_records(run_dir: Path) -> list[dict]:
-    path = run_dir / "train_log.jsonl"
+def _read_jsonl(path: Path) -> list[dict]:
+    """Best-effort JSONL reader shared by every run-log stream: blank
+    and truncated lines (a crash mid-append) are skipped, never fatal."""
     if not path.exists():
         return []
     records = []
@@ -44,6 +45,10 @@ def load_records(run_dir: Path) -> list[dict]:
         except json.JSONDecodeError:
             continue
     return records
+
+
+def load_records(run_dir: Path) -> list[dict]:
+    return _read_jsonl(run_dir / "train_log.jsonl")
 
 
 def load_events(run_dir: Path) -> list[dict]:
@@ -166,19 +171,7 @@ def resilience_log(run_dir: Path, records, events) -> dict:
 def load_serve_records(run_dir: Path) -> list[dict]:
     """serve_log.jsonl records (the serve/score CLI append one metrics
     record per drive; docs/serving.md)."""
-    path = run_dir / "serve_log.jsonl"
-    if not path.exists():
-        return []
-    out = []
-    for line in path.read_text().splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            out.append(json.loads(line))
-        except json.JSONDecodeError:
-            continue
-    return out
+    return _read_jsonl(run_dir / "serve_log.jsonl")
 
 
 def serve_attribution(serve_records: list[dict]) -> dict:
@@ -291,6 +284,46 @@ def slo_section(serve_records: list[dict]) -> dict:
     return out
 
 
+def load_scan_records(run_dir: Path) -> list[dict]:
+    """scan_log.jsonl records (one summary per repo scan,
+    deepdfa_tpu/scan/scanner.py; docs/scanning.md)."""
+    return _read_jsonl(run_dir / "scan_log.jsonl")
+
+
+def scan_section(scan_records: list[dict]) -> dict:
+    """The repo-scan section, rebuilt from scan_log.jsonl alone: the
+    newest scan's throughput/coverage headline, the incremental skip
+    and frontend cache-hit rates, and the per-stage latency attribution
+    (walk/split/frontend/score/attribute/write seconds)."""
+    if not scan_records:
+        return {}
+    rec = scan_records[-1]
+    out = {
+        k: rec[k]
+        for k in (
+            "scan_files", "scan_functions", "scan_reused",
+            "scan_scored", "scan_functions_failed", "scan_findings",
+            "scan_seconds", "scan_functions_per_sec",
+            "scan_incremental_skip_fraction", "scan_cache_hit_fraction",
+            "scan_steady_state_recompiles",
+            "scan_lines_steady_state_recompiles", "repo",
+        )
+        if k in rec
+    }
+    stages = {}
+    for stage in ("walk", "split", "frontend", "score", "attribute",
+                  "write"):
+        v = rec.get(f"scan_{stage}_seconds")
+        if v is not None:
+            stages[stage] = v
+    if stages:
+        out["stage_seconds"] = stages
+    out["scans"] = sum(
+        1 for r in scan_records if "scan_functions" in r
+    )
+    return out
+
+
 def bench_section(root: str | Path | None = None) -> dict:
     """The bench-trajectory section: every committed BENCH_r*/
     BENCH_TPU_* record's headline numbers plus the regression-gate
@@ -363,6 +396,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "resilience": resilience_log(run_dir, records, events),
         "serve": serve_attribution(serve_records),
         "slo": slo_section(serve_records),
+        "scan": scan_section(load_scan_records(run_dir)),
         "bench": bench_section(bench_root),
     }
 
@@ -504,6 +538,41 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f"{eng.get('requests_total')}\n"
             )
 
+    scan = report.get("scan") or {}
+    if scan:
+        w("\nrepo scan (newest scan_log.jsonl record):\n")
+        w(
+            f"  files={scan.get('scan_files')} "
+            f"functions={scan.get('scan_functions')} "
+            f"findings={scan.get('scan_findings')} "
+            f"failed={scan.get('scan_functions_failed')} "
+            f"({scan.get('scans')} scan(s) logged)\n"
+        )
+        if "scan_functions_per_sec" in scan:
+            w(f"  functions/s={scan['scan_functions_per_sec']}\n")
+        skip = scan.get("scan_incremental_skip_fraction")
+        if isinstance(skip, (int, float)):
+            w(
+                f"  incremental skip {_bar(skip, 20)} {skip:7.1%}"
+                f"  (reused {scan.get('scan_reused')}/"
+                f"{scan.get('scan_functions')})\n"
+            )
+        hit = scan.get("scan_cache_hit_fraction")
+        if isinstance(hit, (int, float)):
+            w(f"  frontend cache  {_bar(hit, 20)} {hit:7.1%}\n")
+        stages = scan.get("stage_seconds") or {}
+        if stages:
+            total = sum(stages.values()) or 1.0
+            w("  stage latency attribution (seconds):\n")
+            for stage, v in stages.items():
+                w(f"    {stage:<10}{_bar(v / total, 20)} {v:8.3f}s\n")
+        rc = scan.get("scan_steady_state_recompiles")
+        if rc is not None:
+            w(
+                f"  steady-state recompiles: score={rc} lines="
+                f"{scan.get('scan_lines_steady_state_recompiles')}\n"
+            )
+
     bench = report.get("bench") or {}
     if bench.get("trajectory"):
         w("\nbench trajectory (committed BENCH_* artifacts):\n")
@@ -640,6 +709,36 @@ def build_smoke_run(run_dir: Path) -> Path:
         )
     rlog.append({"serve_slo": engine.snapshot()})
     rlog.close()
+    # a scan_log.jsonl through the REAL writer (scan/scanner.py) so the
+    # diag scan section renders from the same record shape a repo scan
+    # leaves: a cold scan followed by an incremental re-scan
+    from deepdfa_tpu.scan.scanner import write_scan_log
+
+    base = {
+        "scan_files": 4, "scan_files_reused": 0, "scan_functions": 12,
+        "scan_reused": 0, "scan_extracted": 12, "scan_scored": 11,
+        "scan_functions_failed": 1, "scan_findings": 3,
+        "scan_seconds": 2.4, "scan_functions_per_sec": 5.0,
+        "scan_incremental_skip_fraction": 0.0,
+        "scan_cache_hit_fraction": 0.0,
+        "scan_walk_seconds": 0.05, "scan_split_seconds": 0.1,
+        "scan_frontend_seconds": 1.2, "scan_score_seconds": 0.7,
+        "scan_attribute_seconds": 0.3, "scan_write_seconds": 0.05,
+        "scan_steady_state_recompiles": 0,
+        "scan_lines_steady_state_recompiles": 0,
+        "repo": "/tmp/smoke-repo",
+    }
+    write_scan_log(run_dir, [
+        base,
+        {
+            **base, "scan_files_reused": 3, "scan_reused": 11,
+            "scan_extracted": 1, "scan_scored": 1,
+            "scan_functions_failed": 0, "scan_seconds": 0.4,
+            "scan_functions_per_sec": 30.0,
+            "scan_incremental_skip_fraction": 0.9167,
+            "scan_cache_hit_fraction": 0.5,
+        },
+    ])
     ck = run_dir / "checkpoints-step"
     ck.mkdir(exist_ok=True)
     (ck / "watchdog_diagnostic.json").write_text(json.dumps({
@@ -679,6 +778,7 @@ def main(argv=None) -> int:
             # synthetic artifacts through the real readers
             attr = report["stage_attribution"]
             slo = report.get("slo") or {}
+            scan = report.get("scan") or {}
             ok = (
                 report["summary"]["epochs"] == 3
                 and report["summary"]["trace_events"] > 0
@@ -693,6 +793,13 @@ def main(argv=None) -> int:
                 and "latency_ms" in slo.get("all", {})
                 and slo.get("engine")
                 and report.get("bench", {}).get("trajectory")
+                # ISSUE 8 section: the scan view rebuilt from
+                # scan_log.jsonl — coverage, incremental skip rate,
+                # stage attribution
+                and scan.get("scan_functions", 0) > 0
+                and scan.get("scan_incremental_skip_fraction") is not None
+                and scan.get("stage_seconds")
+                and scan.get("scans") == 2
             )
             print(f"diag smoke {'OK' if ok else 'FAILED'}")
             return 0 if ok else 1

@@ -219,6 +219,36 @@ SCHEMA: tuple[str, ...] = (
     "backend/wedges", "backend/fallbacks", "backend/healthy",
     "backend/probe_seconds/count", "backend/probe_seconds/mean",
     "backend/probe_seconds/max",
+    # -- whole-repo scanning (deepdfa_tpu/scan/, docs/scanning.md) --
+    # scan_log.jsonl summary record (scan CLI, bench_scan)
+    "scan_files", "scan_files_reused", "scan_functions", "scan_reused",
+    "scan_extracted", "scan_scored", "scan_functions_failed",
+    "scan_findings", "scan_seconds", "scan_functions_per_sec",
+    "scan_incremental_skip_fraction", "scan_cache_hit_fraction",
+    "scan_walk_seconds", "scan_split_seconds", "scan_frontend_seconds",
+    "scan_score_seconds", "scan_attribute_seconds", "scan_write_seconds",
+    "scan_steady_state_recompiles", "scan_lines_steady_state_recompiles",
+    # the scan registry snapshot (scan/scanner.py counters + stage
+    # histograms)
+    "scan/runs", "scan/files", "scan/files_reused", "scan/files_skipped",
+    "scan/functions", "scan/functions_reused", "scan/functions_failed",
+    "scan/scored", "scan/findings",
+    "scan/walk_seconds/count", "scan/walk_seconds/mean",
+    "scan/walk_seconds/max",
+    "scan/split_seconds/count", "scan/split_seconds/mean",
+    "scan/split_seconds/max",
+    "scan/frontend_seconds/count", "scan/frontend_seconds/mean",
+    "scan/frontend_seconds/max",
+    "scan/score_seconds/count", "scan/score_seconds/mean",
+    "scan/score_seconds/max",
+    "scan/attribute_seconds/count", "scan/attribute_seconds/mean",
+    "scan/attribute_seconds/max",
+    "scan/write_seconds/count", "scan/write_seconds/mean",
+    "scan/write_seconds/max",
+    # served line-level localization (serve/localize.py AOT executables)
+    "localize/requests", "localize/batches", "localize/compiles",
+    "localize/seconds/count", "localize/seconds/mean",
+    "localize/seconds/max",
 )
 
 

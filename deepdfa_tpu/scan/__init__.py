@@ -1,0 +1,33 @@
+"""Whole-repo incremental scanning (docs/scanning.md).
+
+Turns the online scoring stack into a CI-shaped product surface:
+`deepdfa-tpu scan <repo>` walks a repository, splits every C/C++ source
+into function definitions (scan/walker.py), scores each through the
+serving frontend/batcher/AOT executables, optionally attributes per-line
+vulnerability scores (serve/localize.py), and streams findings to JSONL
+and SARIF 2.1.0 (scan/sarif.py). A persistent content-keyed manifest
+(scan/manifest.py) makes a re-scan of an edited repo touch only the
+changed functions.
+"""
+
+from deepdfa_tpu.scan.manifest import ScanManifest
+from deepdfa_tpu.scan.sarif import sarif_report, validate_sarif
+from deepdfa_tpu.scan.scanner import RepoScanner, run_scan_smoke
+from deepdfa_tpu.scan.walker import (
+    FunctionSpan,
+    SourceFile,
+    split_functions,
+    walk_repo,
+)
+
+__all__ = [
+    "FunctionSpan",
+    "RepoScanner",
+    "ScanManifest",
+    "SourceFile",
+    "run_scan_smoke",
+    "sarif_report",
+    "split_functions",
+    "validate_sarif",
+    "walk_repo",
+]

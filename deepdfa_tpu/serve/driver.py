@@ -202,6 +202,11 @@ def run_serve_smoke(**smoke_kw) -> dict:
         extra_overrides=[
             "serve.request_log=true",
             "obs.trace=true",
+            # line-level localization rides the smoke too (ISSUE 8):
+            # the attribution ladder AOT-warms next to the score ladder
+            # and one request opts into {"lines": true}
+            "serve.lines=true",
+            "serve.lines_steps=2",
         ],
         **smoke_kw,
     )
@@ -217,12 +222,18 @@ def run_serve_smoke(**smoke_kw) -> dict:
                 f.read_text() for f in sorted(sources_dir.glob("*.c"))[:6]
             ]
             scored = []
+            line_attrs = None
             for i, code in enumerate(codes):
-                # the first request opts into the per-stage trace echo
-                payload = {"code": code, "trace": True} if i == 0 else {
-                    "code": code
-                }
+                # the first request opts into the per-stage trace echo,
+                # the second into served line attributions
+                payload: dict = {"code": code}
+                if i == 0:
+                    payload["trace"] = True
+                elif i == 1:
+                    payload["lines"] = True
                 status, resp = server.request("POST", "/score", payload)
+                if i == 1:
+                    line_attrs = resp.get("lines")
                 scored.append(
                     (status, resp.get("prob"), resp.get("request_id"),
                      resp.get("stages"))
@@ -272,6 +283,7 @@ def run_serve_smoke(**smoke_kw) -> dict:
              **({"stages": stg} if stg else {})}
             for st, p, r, stg in scored
         ],
+        "line_attributions": line_attrs,
         "reject_status": bad_status,
         "healthz_status": h_status,
         "healthz": health,

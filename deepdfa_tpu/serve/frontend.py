@@ -20,7 +20,9 @@ cannot handle stays unparseable until its bytes change).
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
+import json
 import logging
 import tempfile
 import threading
@@ -29,6 +31,8 @@ from collections import OrderedDict
 from pathlib import Path
 from typing import Any, Callable
 
+import numpy as np
+
 from deepdfa_tpu.obs import metrics as obs_metrics
 
 logger = logging.getLogger(__name__)
@@ -36,6 +40,17 @@ logger = logging.getLogger(__name__)
 
 class FrontendError(ValueError):
     """The function could not be turned into a model graph."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Features:
+    """One cached extraction: the batchable GraphSpec plus the per-node
+    source lines (1-based, in the FUNCTION's own coordinates) the
+    line-attribution paths map node scores back through
+    (serve/localize.py, deepdfa_tpu/scan/)."""
+
+    spec: Any  # GraphSpec
+    node_lines: np.ndarray  # [n] int32
 
 
 class FeatureCache:
@@ -75,6 +90,28 @@ class FeatureCache:
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+
+#: the process-wide feature store `shared_cache` hands out — scan and
+#: serve both preprocess through it, so a repo scan warm-fills the cache
+#: online requests hit (and vice versa) instead of each keeping its own
+#: content-keyed store. Safe to share across configs: every key pins the
+#: feat-spec/gtype/parser identity (`RequestPreprocessor.content_key`).
+_SHARED_CACHE: FeatureCache | None = None
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_cache(max_entries: int = 1024) -> FeatureCache:
+    """The one process-wide FeatureCache. Created on first use; later
+    callers asking for more capacity grow it (never shrink — a smaller
+    config must not evict another subsystem's warm entries)."""
+    global _SHARED_CACHE
+    with _SHARED_LOCK:
+        if _SHARED_CACHE is None:
+            _SHARED_CACHE = FeatureCache(max_entries)
+        elif int(max_entries) > _SHARED_CACHE.max_entries:
+            _SHARED_CACHE.max_entries = int(max_entries)
+        return _SHARED_CACHE
 
 
 class SessionPool:
@@ -180,13 +217,19 @@ class RequestPreprocessor:
         use_joern: bool = False,
         joern_pool: SessionPool | None = None,
         cache_entries: int = 1024,
+        cache: FeatureCache | None = None,
     ):
         self.cfg = cfg
         self.vocabs = vocabs
         self.gtype = cfg.data.gtype
         self.struct_feats = bool(cfg.data.feat.struct_feats)
         self.max_defs = cfg.data.feat.max_defs
-        self.cache = FeatureCache(cache_entries)
+        # an explicit `cache` joins an existing store (ScoringService and
+        # the repo scanner both pass `shared_cache(...)` — satellite 6's
+        # one-namespace rule); None keeps a private store (tests, tools)
+        self.cache = cache if cache is not None else FeatureCache(
+            cache_entries
+        )
         self.use_joern = bool(use_joern)
         self.pool = joern_pool
         if self.use_joern and self.pool is None:
@@ -206,10 +249,21 @@ class RequestPreprocessor:
         r = obs_metrics.REGISTRY
         self._seconds = r.histogram("serve/frontend_seconds")
         self._failed = r.counter("serve/failed")
-        # the cache key pins every knob that changes the extracted bytes
+        # the cache key pins every knob that changes the extracted
+        # bytes INCLUDING the vocabulary content: with the process-wide
+        # shared store, two runs whose feat specs share a name but whose
+        # train splits built different vocabs must never trade entries
         self._key_suffix = (
             f"|{cfg.data.feat.name}|{self.gtype}|joern={self.use_joern}"
+            f"|vocab={self._vocab_digest()}"
         )
+
+    def _vocab_digest(self) -> str:
+        payload = json.dumps(
+            {k: v.to_json() for k, v in sorted(self.vocabs.items())},
+            sort_keys=True,
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
     def content_key(self, code: str) -> str:
         h = hashlib.sha256(code.encode("utf-8", "replace")).hexdigest()
@@ -218,6 +272,12 @@ class RequestPreprocessor:
     def features(self, code: str, request_id: int = -1):
         """GraphSpec for one function; raises FrontendError on functions
         the frontend cannot handle (cached either way)."""
+        return self.features_full(code, request_id).spec
+
+    def features_full(self, code: str, request_id: int = -1) -> Features:
+        """GraphSpec + per-node source lines — what the line-attribution
+        paths need; `features` is the spec-only view of the same cache
+        entry."""
         key = self.content_key(code)
         hit, cached = self.cache.get(key)
         if hit:
@@ -227,18 +287,18 @@ class RequestPreprocessor:
             return cached
         t0 = time.perf_counter()
         try:
-            spec = self._extract(code, request_id)
+            feats = self._extract(code, request_id)
         finally:
             self._seconds.observe(time.perf_counter() - t0)
-        self.cache.put(key, spec)
-        if spec is None:
+        self.cache.put(key, feats)
+        if feats is None:
             self._failed.inc()
             raise FrontendError(
                 "function could not be parsed into a CFG graph"
             )
-        return spec
+        return feats
 
-    def _extract(self, code: str, request_id: int):
+    def _extract(self, code: str, request_id: int) -> Features | None:
         from deepdfa_tpu.data.pipeline import (
             extract_graph,
             graph_from_cpg,
@@ -260,7 +320,9 @@ class RequestPreprocessor:
             )
         if eg is None:
             return None
-        return to_graph_spec(eg, self.vocabs)
+        return Features(
+            to_graph_spec(eg, self.vocabs), eg.node_lines.copy()
+        )
 
     def _joern_cpg(self, code: str):
         """One pooled-JVM round trip: tmp file -> export -> Cpg."""

@@ -1,0 +1,197 @@
+"""Served line-level localization: AOT per-node attribution executables
+for the flagship GGNN family (docs/scanning.md).
+
+`eval/localize.py:ggnn_score_fn` is the one attribution program — the
+offline eval jits it directly; this module lowers THE SAME function
+ahead of time for every size in the scoring executor's warmup ladder
+(serve/batcher.py `_pow2_sizes`), so the line path inherits the
+zero-steady-state-recompiles contract the score path already carries:
+after `warmup()`, no request mix ever triggers a lowering
+(`jit_lowerings()` is the guard, same convention as `GgnnExecutor`).
+
+Numerics contract (tests/test_scan.py): a function attributed alone
+through a warmed executable is BIT-IDENTICAL to the offline eval on the
+same checkpoint (same program, same shapes). Co-batching preserves the
+line RANKING and pins scores to float32 reduction tolerance — the
+backward pass reassociates reductions across padded shapes, so the
+forward score path's exact co-batching invariance does not extend to
+gradients (docs/scanning.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.serve.frontend import Features
+
+
+class GgnnLocalizer:
+    """Signature-keyed AOT executables computing (probs, node scores)
+    for padded graph batches, plus the host-side mapping from node
+    scores back to ranked source lines."""
+
+    def __init__(
+        self,
+        model,
+        params_fn: Callable[[], Any],
+        node_budget: int,
+        edge_budget: int,
+        sizes: Sequence[int],
+        method: str = "saliency",
+        n_steps: int = 8,
+        top_k: int = 10,
+        feat_width: int | None = None,
+        etypes: bool = False,
+    ):
+        import jax
+
+        from deepdfa_tpu.eval.localize import ggnn_score_fn
+
+        self.model = model
+        self.params_fn = params_fn
+        self.node_budget = int(node_budget)
+        self.edge_budget = int(edge_budget)
+        #: the scoring executor's ladder — shared so score and line
+        #: paths warm the same batch signatures
+        self.sizes = tuple(sorted(set(int(s) for s in sizes)))
+        self.method = method
+        self.n_steps = int(n_steps)
+        self.top_k = int(top_k)
+        self.etypes = bool(etypes)
+        if feat_width is None:
+            from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
+
+            feat_width = NUM_SUBKEY_FEATS
+        self.feat_width = int(feat_width)
+        self._fn_jit = jax.jit(ggnn_score_fn(method, model, n_steps))
+        self._compiled: dict[int, Any] = {}
+        self._lowerings = 0
+        r = obs_metrics.REGISTRY
+        self._m_requests = r.counter("localize/requests")
+        self._m_batches = r.counter("localize/batches")
+        self._m_seconds = r.histogram("localize/seconds")
+
+    # -- compilation (the GgnnExecutor warmup contract) -----------------------
+
+    def _dummy_batch(self, size: int):
+        from deepdfa_tpu.graphs.batch import pack
+
+        return pack(
+            [], size, self.node_budget, self.edge_budget,
+            feat_width=self.feat_width, etypes=self.etypes,
+        )
+
+    def signatures(self) -> list[tuple]:
+        return [
+            (s, self.node_budget, self.edge_budget) for s in self.sizes
+        ]
+
+    def warmup(self) -> dict[str, float]:
+        """AOT-compile the attribution program at every ladder size;
+        {signature label: seconds}. Idempotent."""
+        import jax
+
+        params = self.params_fn()
+        report: dict[str, float] = {}
+        for size in self.sizes:
+            if size in self._compiled:
+                continue
+            t0 = time.perf_counter()
+            batch = jax.device_put(self._dummy_batch(size))
+            self._compiled[size] = self._fn_jit.lower(
+                params, batch
+            ).compile()
+            dt = time.perf_counter() - t0
+            self._lowerings += 1
+            obs_metrics.REGISTRY.counter("localize/compiles").inc()
+            report[f"L{size}"] = round(dt, 3)
+        return report
+
+    def jit_lowerings(self) -> int:
+        return self._lowerings + self._fn_jit._cache_size()
+
+    # -- execution ------------------------------------------------------------
+
+    def _size_for(self, n: int) -> int:
+        for s in self.sizes:
+            if s >= n:
+                return s
+        return self.sizes[-1]
+
+    def fits(self, chunk: Sequence[Features], feats: Features) -> bool:
+        """Would adding `feats` keep the chunk inside the pack budgets
+        (same accounting as the scoring executor)?"""
+        if len(chunk) + 1 > self.sizes[-1]:
+            return False
+        nodes = sum(f.spec.num_nodes for f in chunk) + feats.spec.num_nodes
+        edges = (
+            sum(f.spec.num_edges + f.spec.num_nodes for f in chunk)
+            + feats.spec.num_edges + feats.spec.num_nodes
+        )
+        return nodes <= self.node_budget and edges <= self.edge_budget
+
+    def attribute(
+        self, feats_list: Sequence[Features]
+    ) -> list[tuple[float, list[dict]]]:
+        """One padded executable over the chunk -> per-function
+        (prob, ranked [{"line", "score"}]) in the function's OWN line
+        coordinates. The chunk must respect the pack budgets (`fits`)."""
+        import jax
+
+        from deepdfa_tpu.eval.localize import node_line_attributions
+        from deepdfa_tpu.graphs.batch import pack
+
+        if not feats_list:
+            return []
+        t0 = time.perf_counter()
+        size = self._size_for(len(feats_list))
+        batch = pack(
+            [f.spec for f in feats_list], size,
+            self.node_budget, self.edge_budget,
+            feat_width=self.feat_width, etypes=self.etypes,
+        )
+        batch = jax.device_put(batch)
+        fn = self._compiled.get(size, self._fn_jit)
+        with obs_trace.span(
+            "localize_execute", cat="serve", signature=str(size),
+            batch_size=len(feats_list),
+        ):
+            probs, node_scores = fn(self.params_fn(), batch)
+        probs = np.asarray(jax.device_get(probs))
+        node_scores = np.asarray(jax.device_get(node_scores))
+        out: list[tuple[float, list[dict]]] = []
+        off = 0
+        for i, f in enumerate(feats_list):
+            n = f.spec.num_nodes
+            out.append((
+                float(probs[i]),
+                node_line_attributions(
+                    node_scores[off:off + n], f.node_lines,
+                    top_k=self.top_k,
+                ),
+            ))
+            off += n
+        self._m_requests.inc(len(feats_list))
+        self._m_batches.inc()
+        self._m_seconds.observe(time.perf_counter() - t0)
+        return out
+
+    def attribute_all(
+        self, feats_list: Sequence[Features]
+    ) -> list[tuple[float, list[dict]]]:
+        """Greedy budget-respecting chunking over a function stream —
+        the scan drive. Order preserved."""
+        out: list[tuple[float, list[dict]]] = []
+        chunk: list[Features] = []
+        for f in feats_list:
+            if chunk and not self.fits(chunk, f):
+                out.extend(self.attribute(chunk))
+                chunk = []
+            chunk.append(f)
+        if chunk:
+            out.extend(self.attribute(chunk))
+        return out

@@ -51,6 +51,7 @@ from deepdfa_tpu.serve.batcher import (
     ScoreRequest,
     new_request_id,
 )
+from deepdfa_tpu.serve import frontend as serve_frontend
 from deepdfa_tpu.serve.frontend import FrontendError, RequestPreprocessor
 from deepdfa_tpu.serve.registry import ModelRegistry
 
@@ -97,10 +98,13 @@ class ScoringService:
                 "t5 serving drives CombinedExecutor directly (see "
                 "docs/serving.md)"
             )
+        # the ONE process-wide content-keyed feature store: a repo scan
+        # (deepdfa_tpu/scan/) warm-fills the cache online requests hit,
+        # and vice versa — never two sibling stores
         self.frontend = RequestPreprocessor(
             cfg, registry.vocabs,
             use_joern=scfg.use_joern,
-            cache_entries=scfg.feature_cache_entries,
+            cache=serve_frontend.shared_cache(scfg.feature_cache_entries),
         )
         self.executor = GgnnExecutor(
             registry.model, registry.params,
@@ -109,6 +113,22 @@ class ScoringService:
             feat_width=registry._feat_width(),
             etypes=cfg.model.n_etypes > 1,
         )
+        # line-level localization (serve.lines): the attribution program
+        # AOT-compiled over the SAME warmup ladder, so {"lines": true}
+        # requests never trigger a steady-state lowering either
+        self.localizer = None
+        if scfg.lines:
+            from deepdfa_tpu.serve.localize import GgnnLocalizer
+
+            self.localizer = GgnnLocalizer(
+                registry.model, registry.params,
+                node_budget=node_budget, edge_budget=edge_budget,
+                sizes=self.executor.sizes,
+                method=scfg.lines_method, n_steps=scfg.lines_steps,
+                top_k=scfg.lines_top_k,
+                feat_width=registry._feat_width(),
+                etypes=cfg.model.n_etypes > 1,
+            )
         self.slo = obs_slo.SloEngine(
             windows=scfg.slo_windows, max_samples=scfg.slo_window_samples
         )
@@ -125,28 +145,48 @@ class ScoringService:
             slo=self.slo,
         )
         self.warmup_report = self.executor.warmup()
-        self.lowerings_after_warmup = self.executor.jit_lowerings()
+        if self.localizer is not None:
+            self.warmup_report.update(self.localizer.warmup())
+        self.lowerings_after_warmup = self._jit_lowerings()
+
+    def _jit_lowerings(self) -> int:
+        """Lowerings across BOTH compiled surfaces (score + line
+        attribution) — the zero-steady-state-recompiles guard covers the
+        whole serving process, not just the score ladder."""
+        n = self.executor.jit_lowerings()
+        if self.localizer is not None:
+            n += self.localizer.jit_lowerings()
+        return n
 
     def _poll_hot_swap(self) -> None:
         if self.registry.maybe_reload():
             self.slo.observe_hot_swap()
 
-    def submit_code(self, code: str, request_id: str | None = None):
+    def submit_code(
+        self,
+        code: str,
+        request_id: str | None = None,
+        want_feats: bool = False,
+    ):
         """frontend + enqueue; the caller waits on the returned request.
 
         The id assigned here (or passed from the HTTP ingress) travels
         with the request: the frontend span carries it, the queue-wait
-        and device spans flow-link to it, and `finish_request` logs it."""
+        and device spans flow-link to it, and `finish_request` logs it.
+        `want_feats=True` additionally returns the cached extraction
+        (spec + node lines) so the lines path can attribute without a
+        second frontend trip."""
         rid = request_id or new_request_id()
         t0 = time.perf_counter()
         try:
             with obs_trace.span("frontend", cat="serve", request_id=rid):
                 obs_trace.flow("request", rid, "s", cat="serve")
-                spec = self.frontend.features(code)
-            return self.batcher.submit(
-                spec, request_id=rid,
+                feats = self.frontend.features_full(code)
+            req = self.batcher.submit(
+                feats.spec, request_id=rid,
                 frontend_s=time.perf_counter() - t0,
             )
+            return (req, feats) if want_feats else req
         except Exception as e:
             # a rejected request (422/413/429) still did frontend work;
             # ride the measurement on the exception so the epilogue can
@@ -194,8 +234,23 @@ class ScoringService:
             self.request_log.append({"request": entry})
         return ms
 
+    def attribute_lines(self, feats, request_id: str | None = None):
+        """Per-line attributions for ONE extracted function through the
+        AOT localizer (the `{"lines": true}` half of a request); raises
+        when localization is not enabled."""
+        if self.localizer is None:
+            raise FrontendError(
+                "line attributions are disabled; start the server with "
+                "serve.lines=true"
+            )
+        with obs_trace.span(
+            "localize", cat="serve", request_id=request_id
+        ):
+            [(_, lines)] = self.localizer.attribute([feats])
+        return lines
+
     def steady_state_recompiles(self) -> int:
-        return self.executor.jit_lowerings() - self.lowerings_after_warmup
+        return self._jit_lowerings() - self.lowerings_after_warmup
 
     def healthz(self, deep: bool = False) -> dict:
         info = self.registry.info()
@@ -203,9 +258,12 @@ class ScoringService:
             warmed_signatures=[
                 list(s) for s in self.executor.signatures()
             ],
-            jit_lowerings=self.executor.jit_lowerings(),
+            jit_lowerings=self._jit_lowerings(),
             steady_state_recompiles=self.steady_state_recompiles(),
+            lines=self.localizer is not None,
         )
+        if self.localizer is not None:
+            info["lines_method"] = self.localizer.method
         if deep:
             # bounded subprocess compile-and-execute of the DEFAULT
             # backend (obs/health.py) — the wedged-compile-service
@@ -393,10 +451,32 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         want_trace = bool(payload.get("trace"))
+        want_lines = bool(payload.get("lines"))
+        if want_lines and self.service.localizer is None:
+            # refused up front, before any device work: the contract is
+            # explicit opt-in at server start (serve.lines=true warms
+            # the attribution ladder), not a silent slow path
+            self.service.finish_request(rid, 400, time.monotonic() - t0)
+            self._reply(400, {
+                "error": "line attributions are disabled on this server "
+                         "(start it with serve.lines=true)",
+                "request_id": rid,
+            })
+            return
         req = None
+        feats = None
         try:
-            req = self.service.submit_code(code, request_id=rid)
+            if want_lines:
+                req, feats = self.service.submit_code(
+                    code, request_id=rid, want_feats=True
+                )
+            else:
+                req = self.service.submit_code(code, request_id=rid)
             prob = req.wait(self.request_timeout_s)
+            lines = (
+                self.service.attribute_lines(feats, request_id=rid)
+                if want_lines else None
+            )
         except QueueFull as e:
             status, err = 429, e
         except RequestTooLarge as e:
@@ -421,6 +501,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
                 "request_id": rid,
             }
+            if lines is not None:
+                out["lines"] = lines
             if want_trace:
                 # opt-in per-request stage echo (docs/slo.md): where
                 # this request's time went, straight off the request

@@ -19,6 +19,10 @@ Modes:
                  serve_log.jsonl it produces — the `serve/*` tag half of
                  the schema (docs/serving.md)
   --serve-log <path>  validate an existing serve_log.jsonl
+  --scan-log <path>   validate an existing scan_log.jsonl (the repo-
+                 scanner's summary records, deepdfa_tpu/scan/ — the
+                 `scan/*` + `localize/*` tag half of the schema,
+                 docs/scanning.md)
   --metrics <path>    validate a Prometheus `/metrics` scrape (saved
                  text, e.g. <run_dir>/metrics.prom from `serve --smoke`)
                  against the same registry: every line must parse as
@@ -145,6 +149,8 @@ def main(argv=None) -> int:
                     "serve_log.jsonl")
     ap.add_argument("--serve-log", default=None,
                     help="validate an existing serve_log.jsonl")
+    ap.add_argument("--scan-log", default=None,
+                    help="validate an existing scan_log.jsonl")
     ap.add_argument("--metrics", default=None,
                     help="validate a saved Prometheus /metrics scrape")
     ap.add_argument("--out", default=None)
@@ -171,10 +177,10 @@ def main(argv=None) -> int:
             return 1
         return 0
 
-    if args.log or args.serve_log:
+    if args.log or args.serve_log or args.scan_log:
         records = [
             json.loads(line)
-            for line in Path(args.log or args.serve_log)
+            for line in Path(args.log or args.serve_log or args.scan_log)
             .read_text().splitlines()
             if line.strip()
         ]

@@ -1,0 +1,545 @@
+"""Whole-repo scanning + line-level localization (deepdfa_tpu/scan/,
+serve/localize.py, docs/scanning.md).
+
+The load-bearing invariants, in-process (the CLI surface is covered by
+tests/test_scan_cli.py subprocesses):
+
+- the function splitter is lexing-robust: braces in comments/strings/
+  macros never corrupt spans, line ranges are exact;
+- the incremental property: after editing ONE function, a re-scan
+  re-extracts and re-scores exactly that function (moves/renames reuse
+  content-keyed results);
+- served line attributions are BIT-IDENTICAL to the offline
+  eval/localize.py path on the same checkpoint, and co-batching a
+  function changes nothing (the serve invariant, extended to grads);
+- the recomposed embedding-injected GGNN forward equals model.apply
+  exactly (the drift guard for every gradient method);
+- scan and serve share ONE frontend-cache namespace;
+- SARIF output is structurally valid and the scan_log record is
+  schema-declared.
+"""
+
+import dataclasses
+import json
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.scan.manifest import ScanManifest
+from deepdfa_tpu.scan.sarif import sarif_report, validate_sarif
+from deepdfa_tpu.scan.scanner import RepoScanner
+from deepdfa_tpu.scan.walker import (
+    split_functions,
+    walk_repo,
+)
+
+NODE_BUDGET, EDGE_BUDGET = 2048, 8192
+
+
+# ---------------------------------------------------------------------------
+# walker + splitter
+
+
+TRICKY = """/* file comment with { brace */
+#include <stdio.h>
+#define WRAP(x) { (x)++; }
+
+static const int table[] = { 1, 2, 3 };
+
+struct ops { int (*fn)(void); };
+
+int add(int a, int b) {
+  const char *s = "{ not a brace }";
+  // } also not a brace
+  return a + b;
+}
+
+static inline unsigned long
+get_value(struct ops *o)
+{
+  if (o->fn) {
+    return o->fn();
+  }
+  return 0;
+}
+
+int (*pick(void))(void) {
+  return 0;
+}
+
+namespace foo {
+extern "C" {
+int inner(int x) { return x * 2; }
+}
+}
+
+class Widget {
+  int method() { return 1; }
+};
+"""
+
+
+def test_split_functions_tricky_source():
+    spans = split_functions(TRICKY)
+    names = [s.name for s in spans]
+    # the table initializer, struct/class bodies and the in-class method
+    # are NOT functions; the namespace/extern block is transparent
+    assert names == ["add", "get_value", "pick", "inner"]
+    add = spans[0]
+    assert (add.start_line, add.end_line) == (9, 13)
+    assert add.code.splitlines()[0] == "int add(int a, int b) {"
+    assert add.code.splitlines()[-1] == "}"
+    gv = spans[1]
+    # multi-line header: the span starts at the return type line
+    assert gv.code.splitlines()[0] == "static inline unsigned long"
+    inner = spans[3]
+    assert inner.start_line == inner.end_line
+
+
+def test_split_functions_declarations_inside_transparent_blocks():
+    """Statement boundaries must reset INSIDE namespace / extern "C"
+    blocks too — a `= 0;` declaration before a function used to poison
+    its header and silently drop it (code-review regression)."""
+    src = (
+        'extern "C" {\n'
+        "int g_x = 0;\n"
+        "void api(void) { g_x++; }\n"
+        "}\n"
+        "namespace ns {\n"
+        "static int counter = 3;\n"
+        "int f(int a) { return a + counter; }\n"
+        "}\n"
+    )
+    assert [s.name for s in split_functions(src)] == ["api", "f"]
+
+
+def test_split_functions_line_coordinates_roundtrip():
+    text = TRICKY
+    lines = text.split("\n")
+    for s in split_functions(text):
+        assert s.code == "\n".join(lines[s.start_line - 1 : s.end_line])
+
+
+def test_walk_repo_rules(tmp_path):
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "a.c").write_text("int a(void) { return 0; }\n")
+    (tmp_path / "src" / "b.txt").write_text("not source")
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "decoy.c").write_text("int g(void) { return 0; }\n")
+    (tmp_path / "vendor").mkdir()
+    (tmp_path / "vendor" / "v.c").write_text("int v(void) { return 0; }\n")
+    (tmp_path / "big.c").write_text("int big;\n" * 10000)
+
+    stats = {}
+    files = walk_repo(
+        tmp_path, suffixes=(".c",), exclude_dirs=("vendor",),
+        max_file_bytes=1024, stats=stats,
+    )
+    assert [f.rel for f in files] == ["src/a.c"]
+    assert stats["files_too_large"] == 1
+
+
+# ---------------------------------------------------------------------------
+# shared model fixtures (the test_serve pattern)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    synth = generate(12, seed=5)
+    examples = to_examples(synth)
+    specs, vocabs = build_dataset(
+        examples, train_ids=range(12), limit_all=50, limit_subkeys=50
+    )
+    return examples, specs, vocabs
+
+
+@pytest.fixture(scope="module")
+def served_model(corpus):
+    import jax
+
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+        "serve.max_batch_graphs=4",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+    ])
+    model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
+    params = model.init(
+        jax.random.key(0), pack([], 1, NODE_BUDGET, EDGE_BUDGET)
+    )
+    return cfg, model, params
+
+
+# ---------------------------------------------------------------------------
+# GGNN attribution: recomposition parity + method contracts
+
+
+def test_ggnn_forward_matches_model_apply(corpus, served_model):
+    """The embedding-injected recomposed forward is bit-identical to
+    model.apply — the drift guard for every gradient method."""
+    import jax
+
+    from deepdfa_tpu.eval import localize as L
+    from deepdfa_tpu.graphs.batch import pack
+
+    _, specs, _ = corpus
+    _, model, params = served_model
+    batch = pack(specs[:4], 4, NODE_BUDGET, EDGE_BUDGET)
+    ref = np.asarray(model.apply(params, batch))
+    fn, rows = L.ggnn_forward(model, params, batch)
+    logits, attn = fn(rows)
+    assert np.array_equal(ref, np.asarray(logits))
+    # the pooling gate is a per-graph softmax over real nodes
+    sums = np.asarray(jax.ops.segment_sum(
+        attn, batch.node_graph, batch.num_graphs + 1
+    ))[:4]
+    np.testing.assert_allclose(sums, 1.0, atol=1e-5)
+
+
+def test_ggnn_methods_shapes_and_masking(corpus, served_model):
+    import jax
+
+    from deepdfa_tpu.eval import localize as L
+    from deepdfa_tpu.graphs.batch import pack
+
+    _, specs, _ = corpus
+    _, model, params = served_model
+    batch = pack(specs[:3], 4, NODE_BUDGET, EDGE_BUDGET)
+    mask = np.asarray(batch.node_mask)
+    for method in L.GGNN_METHODS:
+        probs, scores = jax.jit(L.ggnn_score_fn(method, model, n_steps=2))(
+            params, batch
+        )
+        probs, scores = np.asarray(probs), np.asarray(scores)
+        assert probs.shape == (4,)
+        assert scores.shape == (NODE_BUDGET,)
+        assert np.all(scores[~mask] == 0), method
+        assert np.isfinite(scores).all(), method
+        assert np.abs(scores[mask]).max() > 0, method
+
+
+def test_unknown_method_and_node_label_style_rejected(served_model):
+    from deepdfa_tpu.eval import localize as L
+
+    _, model, _ = served_model
+    with pytest.raises(ValueError, match="unknown GGNN method"):
+        L.ggnn_score_fn("nope", model)
+    node_model = dataclasses.replace(model, label_style="node")
+    with pytest.raises(ValueError, match="label_style"):
+        L.ggnn_forward(node_model, {"params": {}}, None)
+
+
+def _features(pre, examples, n):
+    out = []
+    for e in examples[:n]:
+        out.append(pre.features_full(e.code, e.id))
+    return out
+
+
+def test_served_lines_bit_identical_to_offline(corpus, served_model):
+    """The acceptance invariant: attributions served through the AOT
+    localizer equal the offline eval/localize.py path on the same
+    checkpoint EXACTLY — and co-batching changes nothing."""
+    import jax
+
+    from deepdfa_tpu.eval import localize as L
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.serve.frontend import RequestPreprocessor
+    from deepdfa_tpu.serve.localize import GgnnLocalizer
+
+    examples, _, vocabs = corpus
+    cfg, model, params = served_model
+    pre = RequestPreprocessor(cfg, vocabs, cache_entries=64)
+    feats = _features(pre, examples, 4)
+
+    localizer = GgnnLocalizer(
+        model, lambda: params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        sizes=(1, 2, 4), method="saliency", n_steps=2, top_k=0,
+    )
+    localizer.warmup()
+    n0 = localizer.jit_lowerings()
+    assert n0 == 3
+
+    # offline: the SAME attribution function, plain jit, singleton pack
+    offline = jax.jit(L.ggnn_score_fn("saliency", model, n_steps=2))
+    served_alone = {}
+    for f in feats:
+        batch = pack([f.spec], 1, NODE_BUDGET, EDGE_BUDGET)
+        probs, scores = offline(params, batch)
+        ref = L.node_line_attributions(
+            np.asarray(scores)[: f.spec.num_nodes], f.node_lines
+        )
+        [(prob, lines)] = localizer.attribute([f])
+        assert lines == ref, "served != offline (singleton)"
+        assert prob == float(np.asarray(probs)[0])
+        served_alone[f.spec.graph_id] = lines
+
+    # co-batched: same ranking, scores equal to float32 reduction
+    # tolerance (the BACKWARD pass reassociates reductions across pad
+    # shapes, unlike the forward score path — so the bit-identity
+    # contract is singleton-vs-offline, and co-batching is pinned to
+    # tolerance; docs/scanning.md)
+    batched = localizer.attribute(feats)
+    for f, (_, lines) in zip(feats, batched):
+        ref = served_alone[f.spec.graph_id]
+        assert [d["line"] for d in lines] == [d["line"] for d in ref]
+        np.testing.assert_allclose(
+            [d["score"] for d in lines], [d["score"] for d in ref],
+            rtol=1e-5, atol=1e-7,
+        )
+    # zero steady-state lowerings across all of the above
+    assert localizer.jit_lowerings() == n0
+
+
+def test_shared_frontend_cache_namespace(corpus, served_model):
+    """Satellite 6: two preprocessors handed the shared store hit each
+    other's entries (scan warm-fills serve, and vice versa)."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+    from deepdfa_tpu.serve import frontend as fe
+
+    examples, _, vocabs = corpus
+    cfg, _, _ = served_model
+    shared = fe.shared_cache(64)
+    a = fe.RequestPreprocessor(cfg, vocabs, cache=shared)
+    b = fe.RequestPreprocessor(cfg, vocabs, cache=shared)
+    assert a.cache is b.cache
+    code = examples[0].code
+    a.features(code)
+    hits = obs_metrics.REGISTRY.counter("serve/cache_hits")
+    before = hits.value
+    sb = b.features(code)
+    assert hits.value == before + 1
+    assert sb is a.features(code)
+    # growing never shrinks
+    assert fe.shared_cache(8).max_entries >= 64
+
+
+# ---------------------------------------------------------------------------
+# manifest
+
+
+def test_manifest_identity_invalidation(tmp_path):
+    path = tmp_path / "m.json"
+    m = ScanManifest(path, {"config_digest": "aaa", "lines": False})
+    m.record_file("a.c", "sha1", [{"key": "k1", "name": "f",
+                                   "start_line": 1, "end_line": 3}])
+    m.record_result("k1", {"ok": True, "prob": 0.5})
+    m.save()
+
+    same = ScanManifest.load(path, {"config_digest": "aaa",
+                                    "lines": False})
+    assert same.resumed and same.result("k1")["prob"] == 0.5
+    assert same.file_functions("a.c", "sha1")[0]["key"] == "k1"
+    assert same.file_functions("a.c", "CHANGED") is None
+
+    other = ScanManifest.load(path, {"config_digest": "bbb",
+                                     "lines": False})
+    assert not other.resumed and other.result("k1") is None
+
+    # a file entry whose function result is missing forces a re-split
+    same.functions.pop("k1")
+    assert same.file_functions("a.c", "sha1") is None
+
+
+def test_manifest_prune_and_atomicity(tmp_path):
+    path = tmp_path / "m.json"
+    m = ScanManifest(path, {"v": 1})
+    for i in range(3):
+        m.record_result(f"k{i}", {"ok": True, "prob": 0.1 * i})
+        m.record_file(f"f{i}.c", f"s{i}", [])
+    m.prune({"f0.c"}, {"k0"})
+    m.save()
+    back = ScanManifest.load(path, {"v": 1})
+    assert set(back.functions) == {"k0"} and set(back.files) == {"f0.c"}
+    # no stray tmp files (atomic_write_text renamed into place)
+    assert [p.name for p in tmp_path.iterdir()] == ["m.json"]
+
+
+# ---------------------------------------------------------------------------
+# sarif
+
+
+def _finding(prob=0.7, lines=None):
+    return {
+        "file": "src/a.c", "function": "f", "start_line": 3,
+        "end_line": 9, "ok": True, "prob": prob,
+        **({"lines": lines} if lines else {}),
+    }
+
+
+def test_sarif_report_valid_and_mapped(tmp_path):
+    doc = sarif_report(
+        [
+            _finding(0.95, lines=[{"line": 5, "score": 0.4}]),
+            _finding(0.6),
+            _finding(0.2),  # below threshold
+            {"file": "b.c", "function": "g", "start_line": 1,
+             "end_line": 2, "ok": False, "error": "unparseable"},
+        ],
+        tmp_path, threshold=0.5,
+    )
+    assert validate_sarif(doc) == []
+    results = doc["runs"][0]["results"]
+    assert len(results) == 2
+    assert results[0]["level"] == "error"  # >= 0.9
+    assert results[1]["level"] == "warning"
+    region = results[0]["locations"][0]["physicalLocation"]["region"]
+    assert (region["startLine"], region["endLine"]) == (3, 9)
+    rel = results[0]["relatedLocations"][0]
+    assert rel["physicalLocation"]["region"]["startLine"] == 5
+
+
+def test_sarif_validator_rejects_structural_damage(tmp_path):
+    doc = sarif_report([_finding()], tmp_path, threshold=0.5)
+    bad = json.loads(json.dumps(doc))
+    bad["version"] = "2.0.0"
+    bad["runs"][0]["results"][0]["locations"][0]["physicalLocation"][
+        "region"]["startLine"] = 0
+    problems = validate_sarif(bad)
+    assert any("version" in p for p in problems)
+    assert any("startLine" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# the incremental-rescan property, end to end in-process
+
+
+@pytest.fixture()
+def scan_service(corpus, served_model, tmp_path):
+    """A real scan engine over a stub registry — the pieces RepoScanner
+    touches, none of the checkpoint round trip (test_scan_cli covers
+    that in subprocesses)."""
+    from deepdfa_tpu.serve.batcher import DynamicBatcher, GgnnExecutor
+    from deepdfa_tpu.serve.frontend import RequestPreprocessor
+
+    examples, _, vocabs = corpus
+    cfg, model, params = served_model
+    cfg = config_mod.apply_overrides(cfg, [
+        "scan.lines=true", "serve.lines_steps=2", "scan.threshold=0.0",
+    ])
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    executor = GgnnExecutor(
+        model, lambda: params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=4,
+    )
+    executor.warmup()
+    registry = types.SimpleNamespace(
+        run_dir=run_dir, config_digest="cfg0", vocab_digest="voc0",
+        checkpoint="best", _loaded_step=0, model=model,
+        params=lambda: params,
+        _feat_width=lambda: 4,
+    )
+    service = types.SimpleNamespace(
+        cfg=cfg,
+        registry=registry,
+        frontend=RequestPreprocessor(cfg, vocabs, cache_entries=256),
+        executor=executor,
+        batcher=DynamicBatcher(executor, queue_limit=64),
+        localizer=None,
+    )
+    return service, cfg, examples
+
+
+def _write_repo(repo: Path, examples, per_file=2):
+    repo.mkdir(parents=True, exist_ok=True)
+    codes = [e.code for e in examples]
+    for i in range(0, len(codes), per_file):
+        (repo / f"mod_{i // per_file}.c").write_text(
+            "\n".join(codes[i : i + per_file]) + "\n"
+        )
+
+
+def test_incremental_rescan_property(scan_service, tmp_path):
+    service, cfg, examples = scan_service
+    scanner = RepoScanner(service, cfg)
+    repo = tmp_path / "repo"
+    _write_repo(repo, examples[:8], per_file=2)
+
+    cold = scanner.scan(repo)
+    assert cold["scan_functions"] == 8
+    assert cold["scan_extracted"] == 8 and cold["scan_reused"] == 0
+    assert cold["scan_steady_state_recompiles"] == 0
+    assert cold["scan_lines_steady_state_recompiles"] == 0
+
+    # no edit -> nothing re-extracts, every file split is reused
+    idle = scanner.scan(repo)
+    assert idle["scan_extracted"] == 0
+    assert idle["scan_reused"] == 8
+    assert idle["scan_files_reused"] == idle["scan_files"]
+
+    # edit ONE function (insert a statement) -> exactly one re-extract,
+    # and later functions in the same file (shifted lines, same bytes)
+    # are still reused
+    target = repo / "mod_0.c"
+    text = target.read_text()
+    spans = split_functions(text)
+    lines = text.split("\n")
+    lines.insert(spans[0].start_line, "  int edited_marker = 1;")
+    target.write_text("\n".join(lines))
+    incr = scanner.scan(repo)
+    assert incr["scan_extracted"] == 1
+    assert incr["scan_reused"] == incr["scan_functions"] - 1
+    assert incr["scan_steady_state_recompiles"] == 0
+    assert incr["scan_lines_steady_state_recompiles"] == 0
+
+    # findings reflect the shifted absolute lines of the UNCHANGED
+    # second function
+    findings = {
+        (f["file"], f["function"], i): f
+        for i, f in enumerate(
+            json.loads(ln)
+            for ln in Path(incr["scores_path"]).read_text().splitlines()
+        )
+    }
+    moved = [
+        f for f in findings.values()
+        if f["file"] == "mod_0.c"
+    ]
+    assert moved[1]["start_line"] == spans[1].start_line + 1
+
+    # a rename re-splits the file but reuses every content-keyed score
+    target.rename(repo / "renamed.c")
+    ren = scanner.scan(repo)
+    assert ren["scan_extracted"] == 0
+    assert ren["scan_reused"] == ren["scan_functions"]
+
+
+def test_scan_log_record_is_schema_declared(scan_service, tmp_path):
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    service, cfg, examples = scan_service
+    scanner = RepoScanner(service, cfg)
+    repo = tmp_path / "repo2"
+    _write_repo(repo, examples[:4])
+    scanner.scan(repo)
+    records = [
+        json.loads(ln)
+        for ln in (service.registry.run_dir / "scan_log.jsonl")
+        .read_text().splitlines()
+    ]
+    assert records
+    assert obs_metrics.undeclared_tags(records) == []
+
+
+def test_identity_drift_forces_cold_scan(scan_service, tmp_path):
+    """A new checkpoint step must never serve manifest-cached scores."""
+    service, cfg, examples = scan_service
+    scanner = RepoScanner(service, cfg)
+    repo = tmp_path / "repo3"
+    _write_repo(repo, examples[:4])
+    assert scanner.scan(repo)["scan_extracted"] == 4
+    service.registry._loaded_step = 7  # hot-swap advanced the tag
+    redo = scanner.scan(repo)
+    assert redo["scan_reused"] == 0  # NO manifest reuse
+    assert redo["scan_scored"] == 4  # every function re-scored...
+    assert redo["scan_cache_hit_fraction"] == 1.0  # ...off the warm cache

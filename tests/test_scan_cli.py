@@ -1,0 +1,115 @@
+"""CLI `scan` smoke path via real subprocesses (the argparse wiring
+can't rot silently), plus the scan halves of the schema checker, diag,
+and the bench script — ISSUE 8 satellites.
+
+Subprocess-only by design (tests/conftest.py:run_cli): the CLI
+normalizes to a 1-device CPU platform, which must never leak into this
+8-virtual-device pytest process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.conftest import run_cli
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _last_json(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line in output: {stdout[-800:]}"
+    return json.loads(lines[-1])
+
+
+def test_scan_smoke_end_to_end(tmp_path):
+    """`scan --smoke`: train a tiny checkpoint, scan a synthetic repo
+    cold (valid SARIF 2.1.0 + findings JSONL with line attributions),
+    edit one function, re-scan incrementally re-extracting ONLY it,
+    with zero steady-state recompiles on the score and line paths —
+    the ISSUE 8 acceptance drive. The produced scan_log validates
+    against the declared schema and diag renders a scan section from
+    it."""
+    res = run_cli(tmp_path, "scan", "--smoke", timeout=420)
+    report = _last_json(res.stdout)
+    cold, incr = report["cold"], report["incremental"]
+
+    # cold coverage: every function of every discovered file scored,
+    # the .git decoy and the oversized generated file were pruned
+    assert cold["scan_functions"] > 0
+    assert cold["scan_reused"] == 0
+    assert report["findings"] == cold["scan_functions"]
+    assert report["findings_ok"] == cold["scan_scored"]
+    assert report["findings_with_lines"] > 0
+    assert report["sarif_problems"] == []
+    assert report["sarif_results"] > 0
+
+    # the incremental contract
+    assert incr["scan_extracted"] == 1
+    assert incr["scan_reused"] == incr["scan_functions"] - 1
+    assert incr["scan_files_reused"] == incr["scan_files"] - 1
+
+    # zero steady-state recompiles, both paths, both scans
+    for s in (cold, incr):
+        assert s["scan_steady_state_recompiles"] == 0
+        assert s["scan_lines_steady_state_recompiles"] == 0
+
+    # SARIF document on disk parses and re-validates here
+    sarif = json.loads(Path(cold["sarif_path"]).read_text())
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"]
+
+    # scan_log.jsonl is schema-clean (check_obs_schema --scan-log)
+    scan_log = Path(report["scan_log"])
+    assert scan_log.exists()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_schema.py"),
+         "--scan-log", str(scan_log)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu"),
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    record = json.loads(proc.stdout.splitlines()[0])
+    assert record["ok"] is True and record["undeclared"] == []
+
+    # diag renders the scan section from the same log
+    diag = run_cli(
+        tmp_path, "diag", report["run_dir"], "--json", timeout=120
+    )
+    diag_report = _last_json(diag.stdout)
+    scan_sec = diag_report["scan"]
+    assert scan_sec["scan_functions"] == incr["scan_functions"]
+    assert scan_sec["scan_incremental_skip_fraction"] == (
+        incr["scan_incremental_skip_fraction"]
+    )
+    assert scan_sec["stage_seconds"]
+    assert scan_sec["scans"] == 2
+
+
+def test_bench_scan_smoke(tmp_path):
+    """scripts/bench_scan.py --smoke: stamped record with the scanning
+    headline numbers (bench.py --child-scan consumes the same fn)."""
+    out = tmp_path / "scan_bench.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_scan.py"),
+         "--smoke", "--out", str(out)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu",
+                 DEEPDFA_TPU_STORAGE=str(tmp_path)),
+        cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    record = json.loads(out.read_text())
+    assert record["metric"] == "scan_functions_per_sec"
+    assert record["value"] > 0
+    assert record["scan_warm_functions_per_sec"] > 0
+    assert record["scan_incremental_functions_per_sec"] > 0
+    assert record["scan_cache_hit_fraction"] == 1.0
+    assert record["scan_incremental_skip_fraction"] >= 0.9
+    assert record["scan_steady_state_recompiles"] == 0
+    # provenance stamp, like every other bench record
+    for k in ("schema_version", "git_sha", "jax_version"):
+        assert k in record
