@@ -63,6 +63,9 @@ COMBINED_TIMEOUT = float(
 )
 SERVE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_SERVE_TIMEOUT", 420))
 SCAN_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_SCAN_TIMEOUT", 420))
+SCATTER_TIMEOUT = float(
+    os.environ.get("DEEPDFA_BENCH_SCATTER_TIMEOUT", 420)
+)
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -574,6 +577,43 @@ def run_scan_measurement(platform: str) -> dict:
     }
 
 
+def run_scatter_measurement(platform: str) -> dict:
+    """Fused GGNN-step A/B observables (ISSUE 9); child, CPU-viable.
+
+    Delegates to scripts/bench_scatter.py:bench_ggnn_step — the lax-vs-
+    Pallas-kernel per-step time plus MFU against the same-window
+    measured matmul ceiling and gather-bandwidth roofline tier-1 smokes
+    — and prefixes nothing: the fields already carry the ggnn_* names
+    the bench gate reads (`ggnn_step_us` lower-is-better, `ggnn_mfu`),
+    so the MFU gap is a tracked number in BENCH_r*.json."""
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    from bench_scatter import bench_ggnn_step
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_ggnn_step(smoke=smoke)
+    out = {k: v for k, v in rec.items() if k.startswith("ggnn_")}
+    # the probe ceilings ride under a ggnn_ prefix: the train child's
+    # own matmul_*/gather_* window fields must survive the merged
+    # record un-overwritten (its mfu_vs_measured_ceiling is computed
+    # against THOSE, not this child's window)
+    for k in ("matmul_tflops_measured", "matmul_probe",
+              "gather_gbps_measured", "gather_probe"):
+        if k in rec:
+            out[f"ggnn_{k}"] = rec[k]
+    out["scatter_platform"] = platform
+    return out
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -660,6 +700,21 @@ def _measure_full(
                 result["scan_error"] = scerr
         else:
             result["scan_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_SCATTER", "1") == "1":
+        # fused GGNN-step A/B (ISSUE 9), own bounded child for the same
+        # wedge-isolation reason as the other children
+        stbudget = min(SCATTER_TIMEOUT, deadline - time.time())
+        if stbudget >= 90:
+            scat, sterr = _run_child(
+                "--child-scatter", result.get("platform", platform),
+                stbudget,
+            )
+            if scat is not None:
+                result.update(scat)
+            else:
+                result["scatter_error"] = sterr
+        else:
+            result["scatter_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -868,6 +923,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-scan":
         print(
             _CHILD_TAG + json.dumps(run_scan_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-scatter":
+        print(
+            _CHILD_TAG + json.dumps(run_scatter_measurement(sys.argv[2])),
             flush=True,
         )
     else:

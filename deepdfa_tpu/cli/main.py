@@ -1684,7 +1684,9 @@ def cmd_score(args) -> None:
 
     cfg = _load_run_config(args)
     if args.smoke:
-        cfg, run_dir, sources_dir = driver.build_smoke_run()
+        cfg, run_dir, sources_dir = driver.build_smoke_run(
+            extra_overrides=args.overrides
+        )
         sources = driver.collect_sources([str(sources_dir)])
     else:
         if not args.sources:
@@ -1714,7 +1716,7 @@ def cmd_serve(args) -> None:
     from deepdfa_tpu.serve.server import ScoringService, serve_forever
 
     if args.smoke:
-        report = driver.run_serve_smoke()
+        report = driver.run_serve_smoke(extra_overrides=args.overrides)
         print(json.dumps(report), flush=True)
         bad = (
             report["steady_state_recompiles"]
@@ -1760,7 +1762,7 @@ def cmd_scan(args) -> None:
     from deepdfa_tpu.scan import scanner as scan_mod
 
     if args.smoke:
-        report = scan_mod.run_scan_smoke()
+        report = scan_mod.run_scan_smoke(extra_overrides=args.overrides)
         print(json.dumps(report), flush=True)
         cold, incr = report["cold"], report["incremental"]
         bad = (

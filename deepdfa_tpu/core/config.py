@@ -126,6 +126,20 @@ class ModelConfig:
     # TPU-specific knobs (no reference equivalent):
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # bfloat16 for large models
+    # Pallas-fused GGNN message-passing step (nn/ggnn_kernel.py,
+    # docs/ggnn_kernel.md): gather + etype transform + dst-sorted
+    # segment scatter + GRU in one HBM-resident pass. Default off — the
+    # lax path stays byte-identical; the knob flows through
+    # GatedGraphConv so train, serve scoring, and localization all
+    # switch at the one call site.
+    ggnn_kernel: bool = False
+    # scatter mode: "auto" (mxu on TPU hardware, the bit-exact fold
+    # under the CPU interpreter), "fold", or "mxu"
+    ggnn_kernel_scatter: str = "auto"
+    # message-side dtype policy: "fp32" (bit-identical to lax) or
+    # "bf16" (halved gather traffic, f32 accumulation, f32 GRU state;
+    # tolerance pinned in tests/test_ggnn_kernel.py)
+    ggnn_kernel_accum: str = "fp32"
 
 
 @dataclass(frozen=True)

@@ -321,13 +321,17 @@ def ggnn_forward(model, params, batch):
 
     Recomposed from the model's own submodules ("embedding"/"ggnn"/
     "pooling"/"head" param subtrees) so jax.grad can reach the per-node
-    embedding rows; the pooling readout is inlined because
-    GlobalAttentionPooling returns only the pooled sum and the per-node
-    attention weights ARE the "GGNN node scores" method. Logit parity
-    with `model.apply` is pinned bit-identical in tests/test_scan.py —
-    the drift guard for this recomposition."""
+    embedding rows; the pooling readout calls the SAME
+    `nn/gnn.py:attention_pool` body `GlobalAttentionPooling` uses (it
+    additionally returns the per-node attention weights, which ARE the
+    "GGNN node scores" method — the shared helper is what keeps a
+    kernel swap or numerics change from diverging this path from the
+    model path). The GGNN conv inherits every kernel knob from the
+    model, so `model.ggnn_kernel` switches attribution too. Logit
+    parity with `model.apply` is pinned bit-identical in
+    tests/test_scan.py — the drift guard for this recomposition."""
     from deepdfa_tpu.nn import GatedGraphConv, OutputHead
-    from deepdfa_tpu.nn.gnn import segment_softmax, segment_sum
+    from deepdfa_tpu.nn.gnn import attention_pool
 
     if model.label_style != "graph":
         raise ValueError(
@@ -348,19 +352,17 @@ def ggnn_forward(model, params, batch):
             n_etypes=model.n_etypes,
             scan_steps=model.scan_steps,
             param_dtype=model.param_dtype,
+            use_kernel=getattr(model, "ggnn_kernel", False),
+            kernel_scatter=getattr(model, "ggnn_kernel_scatter", "auto"),
+            kernel_accum=getattr(model, "ggnn_kernel_accum", "fp32"),
         ).apply({"params": p["ggnn"]}, batch, rows)
         out = jnp.concatenate([ggnn_out, rows], axis=-1)
         gp = p["pooling"]["gate_nn"]
         gate = out @ gp["kernel"] + gp["bias"]
-        g = batch.num_graphs
-        attn = segment_softmax(
-            gate[:, 0], batch.node_graph, batch.node_mask, g + 1,
-            indices_are_sorted=True,
+        pooled, attn = attention_pool(
+            gate[:, 0], out, batch.node_graph, batch.node_mask,
+            batch.num_graphs,
         )
-        pooled = segment_sum(
-            attn[:, None] * out, batch.node_graph, g + 1,
-            indices_are_sorted=True,
-        )[:g]
         logits = OutputHead(
             num_layers=model.num_output_layers,
             param_dtype=model.param_dtype,

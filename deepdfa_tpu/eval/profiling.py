@@ -180,10 +180,23 @@ def measure_matmul_ceiling(
         np.asarray(chained(a, b))
         dt = time.perf_counter() - t0
         best = max(best, chain * 2 * n**3 / dt)
-    return {
+    return _roofline_gauge({
         "matmul_tflops_measured": round(best / 1e12, 2),
         "matmul_probe": f"{chain}x({n}x{n}@{n}x{n}) {jnp.dtype(dtype).name}",
-    }
+    })
+
+
+def _roofline_gauge(fields: dict) -> dict:
+    """Mirror a probe's scalar ceilings into the obs registry as
+    `roofline/<name>` gauges (declared in obs/metrics.py:SCHEMA) so
+    obs-enabled runs that measure a ceiling carry it in their run log
+    next to the throughput it defends — not only in bench stdout."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    for k, v in fields.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            obs_metrics.REGISTRY.gauge(f"roofline/{k}").set(v)
+    return fields
 
 
 def measure_hbm_bandwidth(
@@ -223,10 +236,10 @@ def measure_hbm_bandwidth(
         np.asarray(y[:8])  # tiny fetch still orders after the full chain
         dt = time.perf_counter() - t0
         best = max(best, chain * 2 * n * 4 / dt)
-    return {
+    return _roofline_gauge({
         "hbm_gbps_measured": round(best / 1e9, 1),
         "hbm_probe": f"{chain}x stream-rw {mb}MiB f32",
-    }
+    })
 
 
 def roofline_fields(model_bytes_per_sec: float) -> dict:
@@ -300,13 +313,13 @@ def measure_gather_bandwidth(
         np.asarray(y[:1])
         dt = time.perf_counter() - t0
         best = max(best, chain * link_bytes / dt)
-    return {
+    return _roofline_gauge({
         "gather_gbps_measured": round(best / 1e9, 1),
         "gather_probe": (
             f"{chain}x gather+sorted-segsum [{rows},{dim}]f32 "
             f"idx={idx_len}"
         ),
-    }
+    })
 
 
 def ceiling_fields(model_flops_per_sec: float) -> dict:

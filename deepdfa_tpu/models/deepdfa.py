@@ -50,6 +50,12 @@ class DeepDFA(nn.Module):
     #: embed the family-invariant structural channels appended after the
     #: 4 subkey columns (frontend/structfeat.py; VERDICT r4 #3)
     struct_feats: bool = False
+    #: Pallas-fused GGNN step (nn/ggnn_kernel.py, docs/ggnn_kernel.md);
+    #: wired through GatedGraphConv so train, serve scoring, and the
+    #: localization/scan paths all switch at the one call site
+    ggnn_kernel: bool = False
+    ggnn_kernel_scatter: str = "auto"
+    ggnn_kernel_accum: str = "fp32"
 
     @classmethod
     def from_config(cls, cfg: ModelConfig, input_dim: int, **overrides) -> "DeepDFA":
@@ -64,6 +70,9 @@ class DeepDFA(nn.Module):
             label_style=cfg.label_style,
             encoder_mode=cfg.encoder_mode,
             struct_feats=getattr(cfg, "struct_feats", False),
+            ggnn_kernel=getattr(cfg, "ggnn_kernel", False),
+            ggnn_kernel_scatter=getattr(cfg, "ggnn_kernel_scatter", "auto"),
+            ggnn_kernel_accum=getattr(cfg, "ggnn_kernel_accum", "fp32"),
             param_dtype=jnp.dtype(cfg.param_dtype),
         )
         kw.update(overrides)
@@ -104,6 +113,9 @@ class DeepDFA(nn.Module):
             scan_steps=self.scan_steps,
             param_dtype=self.param_dtype,
             axis_name=self.edge_axis,
+            use_kernel=self.ggnn_kernel,
+            kernel_scatter=self.ggnn_kernel_scatter,
+            kernel_accum=self.ggnn_kernel_accum,
             name="ggnn",
         )(batch, feat_embed)
 

@@ -3,6 +3,7 @@ from deepdfa_tpu.nn.gnn import (
     GatedGraphConv,
     GlobalAttentionPooling,
     GRUCell,
+    attention_pool,
     segment_softmax,
     segment_sum,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "GatedGraphConv",
     "GlobalAttentionPooling",
     "GRUCell",
+    "attention_pool",
     "segment_softmax",
     "segment_sum",
     "OutputHead",

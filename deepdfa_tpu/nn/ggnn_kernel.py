@@ -1,0 +1,704 @@
+"""Pallas-fused GGNN message-passing step for the TPU train/score/serve
+hot path (ROADMAP item 1, docs/ggnn_kernel.md).
+
+Why this exists: the lax GGNN step in `nn/gnn.py` is an XLA-scheduled
+chain of dense transform -> masked edge gather -> `segment_sum` scatter
+-> GRU, and on TPU the chain is memory-bound, not matmul-bound — train
+MFU ~0.007 / infer ~0.003 (BENCH_r05 `last_healthy_tpu`;
+docs/roofline.md models the byte traffic). This kernel fuses one FULL
+GGNN step — edge-source gather, per-edge-type message transform,
+dst-sorted segment-sum aggregation, and the GRU cell update — into one
+HBM-resident pass per node block: the message-side node table is staged
+into VMEM once, each output node block walks only the edge blocks whose
+(sorted) destination range overlaps it, and the aggregate never round-
+trips to HBM before the GRU consumes it. The design follows "Fast
+Training of Sparse GNNs on Dense Hardware" (PAPERS.md): the scatter is
+reformulated as a block-diagonal one-hot matmul so the MXU, not the
+scalar core, does the aggregation — the dst-sorted padded layout
+`graphs/batch.py` already produces is exactly the block-diagonal
+structure that makes the sweep skip ~all off-diagonal blocks.
+
+Two scatter modes (static kernel parameter, both compiled from the same
+body structure):
+
+- ``"mxu"`` — one-hot [Eb, Nb] x message [Eb, D] matmul per live
+  (node-block, edge-block) pair. The fast path for hardware; its f32
+  numerics differ from the lax path only by reduction-order
+  reassociation inside the dot (documented tolerance).
+- ``"fold"`` — a sequential left fold over the block's edges in edge
+  order, which is EXACTLY the accumulation order XLA's sorted
+  `segment_sum` scatter applies (verified empirically; pinned in
+  tests/test_ggnn_kernel.py). In fp32 this makes the kernel output
+  BIT-IDENTICAL to the lax path — the interpret-mode parity contract
+  tier-1 enforces across the whole serve warmup ladder.
+
+``scatter="auto"`` resolves to mxu on TPU hardware and fold elsewhere
+(interpret), so CPU tier-1 exercises the bit-exact mode and the chip
+gets the MXU mode.
+
+bf16 accumulation policy (``accum="bf16"``): the message-side node
+table and the per-etype transform weights are cast to bfloat16 — the
+gather traffic, the dominant HBM bytes of the step (docs/roofline.md),
+halves — while every dot accumulates in f32 (`preferred_element_type`)
+and the GRU state/update stays f32. Tolerance vs the f32 path is pinned
+in tests (the bound tracks bf16's ~3 decimal digits through one
+matmul + masked sum, NOT compounding across steps, because the GRU
+re-anchors the state in f32 each step).
+
+Backward (custom_vjp, per step): the transposed problem is a gather by
+dst (sorted — cheap) followed by a scatter by src (unsorted — the slow
+path XLA's autodiff would take through an unsorted scatter-add,
+measured 7.3x slower than sorted in scripts/bench_scatter.py). Instead:
+
+- `_gru_bwd_kernel` fuses the whole GRU backward per node block —
+  gates recomputed from the saved (h, a) residuals (the remat choice:
+  ~2 small matmuls instead of 3 x [N, 3D] of saved activations),
+  elementwise chain, `da`/`dh` products, and the four GRU param
+  cotangents accumulated across the sequential grid directly in the
+  output refs (the flash_attention `_dbias_kernel` pattern);
+- `_dmsg_kernel` fuses the dst-gather with the transposed message
+  transform, emitting per-edge `dh`-cotangent rows ALREADY PERMUTED
+  into src-sorted order (the permutation is composed into the index
+  arrays on the host side of the call, so the kernel's gather does the
+  reorder for free);
+- the final scatter-by-src then rides `segment_sum(...,
+  indices_are_sorted=True)` over the src-sorted layout — the same
+  sorted fast path the forward's dst scatter uses, i.e. the backward
+  pays sorted-scatter prices in both directions.
+
+The per-etype transform weight cotangents are two thin einsums over
+arrays the step already gathered; XLA handles them (25k-param model —
+they are noise next to the edge traffic).
+
+Like `nn/flash_attention.py`, every kernel takes an ``interpret`` mode
+("legacy" = the generic Pallas interpreter, the CPU tier-1 default;
+"tpu" = the TPU-semantics interpreter; False = compile via Mosaic) so
+the whole contract is executable and pinned on CPU. Hardware tiling
+constraints (D % 128, block divisibility) are checked by
+`kernel_shape_ok`; interpret mode relaxes them the way
+`flash_shape_ok(lax_alignment=True)` does.
+
+Zero-steady-state-recompile invariant: the kernel is traced inside the
+SAME jitted/AOT programs the lax path uses, keyed by the same
+`(num_graphs, node_budget, edge_budget)` signatures — it adds no new
+program signatures. Trace-time lowering counters per signature land in
+the obs registry (`ggnn_kernel/*`, declared in obs/metrics.py:SCHEMA)
+so epoch records and serve logs carry the compile census the same way
+the PR-2 step cache does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+@dataclasses.dataclass(frozen=True)
+class _Params:
+    """Static kernel parameters (hashable: the custom_vjp nondiff arg)."""
+
+    n: int  # node budget (divisible by block_n)
+    e: int  # edge budget (divisible by block_e)
+    d: int  # feature width (4*hidden at the flagship: 128)
+    block_n: int
+    block_e: int
+    n_etypes: int
+    accum: str  # "fp32" | "bf16" — message-side dtype policy
+    scatter: str  # "fold" (order-exact) | "mxu" (one-hot matmul)
+    interpret: str | bool  # False | "legacy" | "tpu"
+
+    @property
+    def n_nb(self) -> int:
+        return self.n // self.block_n
+
+    @property
+    def n_eb(self) -> int:
+        return self.e // self.block_e
+
+    @property
+    def msg_dtype(self):
+        return jnp.bfloat16 if self.accum == "bf16" else jnp.float32
+
+    @property
+    def interpret_arg(self):
+        if self.interpret == "tpu":
+            return pltpu.InterpretParams()
+        return bool(self.interpret)
+
+
+def _pick_block(total: int, target: int) -> int:
+    """Largest divisor of `total` that is <= target, preferring the
+    target itself (budgets are powers of two in every shipped config, so
+    this is almost always `target`). A budget whose only divisors near
+    the target are tiny (prime/odd budgets) falls back to `total` — ONE
+    block — rather than a degenerate 1-wide tiling (a 1-edge block would
+    mean `total` grid sweeps; one big block merely costs VMEM, which
+    interpret mode does not care about and hardware rejects loudly)."""
+    total = int(total)
+    if total <= target:
+        return max(total, 1)
+    for cand in range(target, max(target // 8, 1), -1):
+        if total % cand == 0:
+            return cand
+    return total
+
+
+def block_sizes(
+    node_budget: int, edge_budget: int,
+    block_nodes: int = 0, block_edges: int = 0,
+) -> tuple[int, int]:
+    """Block/tile sizing keyed off the fixed batch budgets: 256-node /
+    512-edge tiles at the flagship shape (VMEM: the full [16384, 128]
+    message table ~8 MB f32 / 4 MB bf16 + per-tile temporaries ~2 MB),
+    shrunk to the largest dividing block for small test budgets."""
+    bn = block_nodes or 256
+    be = block_edges or 512
+    return _pick_block(node_budget, bn), _pick_block(edge_budget, be)
+
+
+def kernel_shape_ok(
+    node_budget: int, edge_budget: int, d: int, *,
+    lax_alignment: bool = False,
+) -> bool:
+    """Can the kernel tile this problem on hardware? Single source of
+    truth for dispatch sites (mirrors `flash_shape_ok`). Mosaic needs
+    the lane dim (D) to be a multiple of 128; interpret mode
+    (``lax_alignment=True``) relaxes that — CPU tests run tiny widths."""
+    if d <= 0 or node_budget <= 0 or edge_budget <= 0:
+        return False
+    if not lax_alignment and d % 128:
+        return False
+    return True
+
+
+def resolve_scatter(scatter: str) -> str:
+    """"auto" -> "mxu" on TPU hardware (MXU aggregation), "fold"
+    elsewhere (the bit-exact interpret parity mode)."""
+    if scatter in ("fold", "mxu"):
+        return scatter
+    if scatter != "auto":
+        raise ValueError(f"unknown ggnn_kernel scatter {scatter!r}")
+    return "mxu" if jax.default_backend() == "tpu" else "fold"
+
+
+def resolve_interpret(interpret: str | bool) -> str | bool:
+    """"auto" -> compiled on TPU hardware, the (faster) generic
+    interpreter elsewhere; explicit values pass through."""
+    if interpret != "auto":
+        return interpret
+    return False if jax.default_backend() == "tpu" else "legacy"
+
+
+# ---------------------------------------------------------------------------
+# trace-time signature census (the PR-2 step-cache convention)
+
+_SIG_LOCK = threading.Lock()
+_SIGNATURES: dict[str, int] = {}
+
+
+def _note_lowering(p: _Params) -> None:
+    """Called once per trace of the fused step: counts kernel lowerings
+    per batch signature into the process-wide obs registry. Steady state
+    (AOT-warmed executors, signature-cached train steps) never re-traces,
+    so a growing census IS a recompile — the same guard semantics as
+    `jit_lowerings()` on the serve executors."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    sig = f"{p.n}x{p.e}x{p.d}"
+    with _SIG_LOCK:
+        _SIGNATURES[sig] = _SIGNATURES.get(sig, 0) + 1
+        count = _SIGNATURES[sig]
+    r = obs_metrics.REGISTRY
+    r.counter("ggnn_kernel/lowerings").inc()
+    r.gauge(f"ggnn_kernel/signatures/{sig}").set(count)
+
+
+def signature_stats() -> dict[str, int]:
+    """{signature: trace count} for every fused-step lowering this
+    process performed (a copy; safe to mutate)."""
+    with _SIG_LOCK:
+        return dict(_SIGNATURES)
+
+
+def reset_signature_stats() -> None:
+    with _SIG_LOCK:
+        _SIGNATURES.clear()
+
+
+def epoch_record(steps: int | None = None) -> dict:
+    """The epoch-record blob train loops embed when the kernel is
+    enabled (flattens to `ggnn_kernel/*` tags, declared in SCHEMA)."""
+    stats = signature_stats()
+    rec: dict = {"lowerings": float(sum(stats.values()))}
+    if steps is not None:
+        rec["device_steps"] = float(steps)
+    for sig, count in sorted(stats.items()):
+        rec[f"signatures/{sig}"] = float(count)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+
+
+def _aggregate(p: _Params, acc, msg, dst_local):
+    """Scatter one edge block's messages into the node-block accumulator.
+
+    msg: [block_e, d] f32 (already masked by the edge weight);
+    dst_local: [block_e] i32 destination indices relative to the block
+    (out-of-block values are outside [0, block_n) and contribute 0).
+    """
+    if p.scatter == "mxu":
+        # block-diagonal dense scatter: the one-hot rows select the
+        # in-block destinations, the MXU does the accumulation. f32
+        # one-hot x f32 msg with f32 accumulation — reassociation-only
+        # deviation from the sequential fold.
+        onehot = (
+            dst_local[:, None]
+            == jax.lax.broadcasted_iota(
+                jnp.int32, (p.block_e, p.block_n), 1
+            )
+        ).astype(jnp.float32)
+        return acc + jax.lax.dot_general(
+            onehot, msg, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # "fold": sequential left fold in edge order — bit-identical to the
+    # order XLA's sorted segment_sum scatter applies its updates in
+    # (the interpret-mode parity contract; see module docstring).
+    def body(k, acc):
+        idx = dst_local[k]
+        ok = (idx >= 0) & (idx < p.block_n)
+        idxc = jnp.clip(idx, 0, p.block_n - 1)
+        row = jax.lax.dynamic_slice(acc, (idxc, 0), (1, p.d))
+        row = row + jnp.where(ok, msg[k][None, :], 0.0)
+        return jax.lax.dynamic_update_slice(acc, row, (idxc, 0))
+
+    return jax.lax.fori_loop(0, p.block_e, body, acc)
+
+
+def _gru(p: _Params, a, h, wih, whh, bih, bhh):
+    """torch-convention GRU update, f32, same expression as
+    `nn/gnn.py:GRUCell.__call__` (row-blocked matmuls are bit-identical
+    to the full-table ones — pinned in tests)."""
+    gx = jax.lax.dot_general(
+        a, wih, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + bih
+    gh = jax.lax.dot_general(
+        h, whh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + bhh
+    xr, xz, xn = jnp.split(gx, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def _fwd_kernel(p: _Params, bounds_ref, hm_ref, hb_ref, src_ref, dst_ref,
+                w_ref, wm_ref, bm_ref, wih_ref, whh_ref, bih_ref, bhh_ref,
+                hout_ref, aout_ref):
+    i = pl.program_id(0)
+    n0 = i * p.block_n
+    hm = hm_ref[...]  # [n, d] message-side table (f32 or bf16)
+    acc = jnp.zeros((p.block_n, p.d), jnp.float32)
+
+    for t in range(p.n_etypes):
+        # per-type partial in its own accumulator, added once at the end
+        # — matches the lax path's `a = a + segment_sum(msg_t)` fold
+        # association exactly (bit-parity requirement)
+        acc_t = jnp.zeros((p.block_n, p.d), jnp.float32)
+        for j in range(p.n_eb):
+
+            def live(acc_t, t=t, j=j):
+                src = src_ref[j]  # [block_e]
+                dst_local = dst_ref[j] - n0
+                w = w_ref[t, j].astype(jnp.float32)  # [block_e]
+                hg = jnp.take(hm, src, axis=0)  # [block_e, d] gather
+                msg = jax.lax.dot_general(
+                    hg, wm_ref[t], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) + bm_ref[t].astype(jnp.float32)
+                msg = msg * w[:, None]
+                return _aggregate(p, acc_t, msg, dst_local)
+
+            # dst-sorted edges: skip blocks whose destination range
+            # misses this node block entirely (block-diagonal sweep)
+            acc_t = jax.lax.cond(
+                (bounds_ref[j, 1] >= n0)
+                & (bounds_ref[j, 0] < n0 + p.block_n),
+                live, lambda a: a, acc_t,
+            )
+        acc = acc + acc_t
+
+    h = hb_ref[...]  # [block_n, d] f32 GRU state
+    hout_ref[...] = _gru(
+        p, acc, h, wih_ref[...], whh_ref[...], bih_ref[...], bhh_ref[...]
+    )
+    aout_ref[...] = acc
+
+
+def _smem_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _full(shape_len: int):
+    """Constant-index full-array VMEM spec (staged once, revisited by
+    every sequential grid step)."""
+    zeros = (0,) * shape_len
+    return pl.BlockSpec(memory_space=pltpu.VMEM, index_map=lambda i: zeros)
+
+
+def _fwd_call(p: _Params, hm, h, src2, dst2, w2, bounds, wm, bm, wih, whh,
+              bih, bhh):
+    block = pl.BlockSpec(
+        (p.block_n, p.d), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    h_out, a_out = pl.pallas_call(
+        functools.partial(_fwd_kernel, p),
+        grid=(p.n_nb,),
+        in_specs=[
+            _smem_spec(),  # bounds [n_eb, 2]
+            pl.BlockSpec(
+                (p.n, p.d), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),  # hm (full message table)
+            block,  # h (GRU-state block)
+            _full(2),  # src [n_eb, block_e]
+            _full(2),  # dst
+            _full(3),  # w [T, n_eb, block_e]
+            _full(3),  # wm [T, d, d]
+            _full(2),  # bm [T, d]
+            _full(2),  # wih [d, 3d]
+            _full(2),  # whh
+            _full(2),  # bih [1, 3d]
+            _full(2),  # bhh
+        ],
+        out_specs=[block, block],
+        out_shape=[
+            jax.ShapeDtypeStruct((p.n, p.d), jnp.float32),
+            jax.ShapeDtypeStruct((p.n, p.d), jnp.float32),
+        ],
+        interpret=p.interpret_arg,
+    )(bounds, hm, h, src2, dst2, w2, wm, bm, wih, whh, bih, bhh)
+    return h_out, a_out
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+
+
+def _gru_bwd_kernel(p: _Params, h_ref, a_ref, wih_ref, whh_ref, bih_ref,
+                    bhh_ref, g_ref, da_ref, dh_ref, dwih_ref, dwhh_ref,
+                    dbih_ref, dbhh_ref):
+    """Fused GRU backward per node block; gates recomputed from the
+    (h, a) residuals (the remat choice — see module docstring). The four
+    param cotangents accumulate across the sequential grid directly in
+    their output refs (constant index maps; zero-init at program 0)."""
+    i = pl.program_id(0)
+    h = h_ref[...]
+    a = a_ref[...]
+    g = g_ref[...]
+    wih = wih_ref[...]
+    whh = whh_ref[...]
+
+    gx = jax.lax.dot_general(
+        a, wih, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + bih_ref[...]
+    gh = jax.lax.dot_general(
+        h, whh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + bhh_ref[...]
+    xr, xz, xn = jnp.split(gx, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+
+    dz = g * (h - n)
+    dn = g * (1.0 - z)
+    dt = dn * (1.0 - n * n)
+    dhn = dt * r
+    dr = dt * hn
+    dsr = dr * r * (1.0 - r)
+    dsz = dz * z * (1.0 - z)
+    dgx = jnp.concatenate([dsr, dsz, dt], axis=-1)  # [block_n, 3d]
+    dgh = jnp.concatenate([dsr, dsz, dhn], axis=-1)
+
+    da_ref[...] = jax.lax.dot_general(
+        dgx, wih, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dh_ref[...] = jax.lax.dot_general(
+        dgh, whh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + g * z
+
+    @pl.when(i == 0)
+    def _():
+        dwih_ref[...] = jnp.zeros_like(dwih_ref)
+        dwhh_ref[...] = jnp.zeros_like(dwhh_ref)
+        dbih_ref[...] = jnp.zeros_like(dbih_ref)
+        dbhh_ref[...] = jnp.zeros_like(dbhh_ref)
+
+    dwih_ref[...] += jax.lax.dot_general(
+        a, dgx, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dwhh_ref[...] += jax.lax.dot_general(
+        h, dgh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dbih_ref[...] += jnp.sum(dgx, axis=0, keepdims=True)
+    dbhh_ref[...] += jnp.sum(dgh, axis=0, keepdims=True)
+
+
+def _gru_bwd_call(p: _Params, h, a, wih, whh, bih, bhh, g):
+    block = pl.BlockSpec(
+        (p.block_n, p.d), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    const = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda i: (0,) * len(shape), memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        functools.partial(_gru_bwd_kernel, p),
+        grid=(p.n_nb,),
+        in_specs=[
+            block,  # h
+            block,  # a
+            _full(2), _full(2), _full(2), _full(2),  # gru params
+            block,  # g
+        ],
+        out_specs=[
+            block,  # da
+            block,  # dh_gru
+            const((p.d, 3 * p.d)),
+            const((p.d, 3 * p.d)),
+            const((1, 3 * p.d)),
+            const((1, 3 * p.d)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((p.n, p.d), jnp.float32),
+            jax.ShapeDtypeStruct((p.n, p.d), jnp.float32),
+            jax.ShapeDtypeStruct((p.d, 3 * p.d), jnp.float32),
+            jax.ShapeDtypeStruct((p.d, 3 * p.d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * p.d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * p.d), jnp.float32),
+        ],
+        interpret=p.interpret_arg,
+    )(h, a, wih, whh, bih, bhh, g)
+
+
+def _dmsg_kernel(p: _Params, da_ref, dstp_ref, wp_ref, wm_ref, dmsg_ref):
+    """Transposed gather: per src-sorted edge block, gather the upstream
+    aggregate cotangent by (permuted) destination and push it through
+    the transposed message transform — the per-edge `dh` cotangent rows,
+    emitted already in src-sorted order for the sorted final scatter."""
+    j = pl.program_id(0)
+    da = da_ref[...]  # [n, d]
+    dag = jnp.take(da, dstp_ref[j], axis=0)  # [block_e, d]
+    acc = jnp.zeros((p.block_e, p.d), jnp.float32)
+    for t in range(p.n_etypes):
+        w = wp_ref[t, j].astype(jnp.float32)
+        acc = acc + jax.lax.dot_general(
+            dag * w[:, None], wm_ref[t].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    dmsg_ref[...] = acc
+
+
+def _dmsg_call(p: _Params, da, dstp2, wp2, wm):
+    return pl.pallas_call(
+        functools.partial(_dmsg_kernel, p),
+        grid=(p.n_eb,),
+        in_specs=[
+            pl.BlockSpec(
+                (p.n, p.d), lambda j: (0, 0), memory_space=pltpu.VMEM
+            ),  # da table
+            _full(2),  # dstp [n_eb, block_e]
+            _full(3),  # wp [T, n_eb, block_e]
+            _full(3),  # wm [T, d, d]
+        ],
+        out_specs=pl.BlockSpec(
+            (p.block_e, p.d), lambda j: (j, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((p.e, p.d), jnp.float32),
+        interpret=p.interpret_arg,
+    )(da, dstp2, wp2, wm)
+
+
+# ---------------------------------------------------------------------------
+# the custom_vjp'd fused step
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _step(p: _Params, wm, bm, wih, whh, bih, bhh, h, src2, dst2, w2,
+          bounds, src_sorted, dstp2, wp2):
+    h_out, _ = _step_fwd_call(p, wm, bm, wih, whh, bih, bhh, h, src2,
+                              dst2, w2, bounds)
+    return h_out
+
+
+def _step_fwd_call(p, wm, bm, wih, whh, bih, bhh, h, src2, dst2, w2,
+                   bounds):
+    hm = h.astype(p.msg_dtype)
+    wm_msg = wm.astype(p.msg_dtype)
+    return _fwd_call(
+        p, hm, h, src2, dst2, w2, bounds, wm_msg, bm, wih, whh, bih, bhh
+    )
+
+
+def _step_fwd(p, wm, bm, wih, whh, bih, bhh, h, src2, dst2, w2, bounds,
+              src_sorted, dstp2, wp2):
+    h_out, a = _step_fwd_call(p, wm, bm, wih, whh, bih, bhh, h, src2,
+                              dst2, w2, bounds)
+    # residuals: (h, a) per step — gates are recomputed in the backward
+    # kernel (the remat choice), everything else is step-invariant
+    res = (wm, bm, wih, whh, bih, bhh, h, a, src2, dst2, w2, src_sorted,
+           dstp2, wp2)
+    return h_out, res
+
+
+def _step_bwd(p: _Params, res, g):
+    (wm, bm, wih, whh, bih, bhh, h, a, src2, dst2, w2, src_sorted, dstp2,
+     wp2) = res
+    da, dh_gru, dwih, dwhh, dbih, dbhh = _gru_bwd_call(
+        p, h, a, wih, whh, bih, bhh, g
+    )
+    # transposed gather (by dst, fused in-kernel, emitted src-sorted) ...
+    dmsg = _dmsg_call(p, da, dstp2, wp2, wm)
+    # ... then the transposed scatter (by src) on the SORTED fast path
+    dh_msg = jax.ops.segment_sum(
+        dmsg, src_sorted, num_segments=p.n, indices_are_sorted=True
+    )
+    dh = dh_gru + dh_msg
+
+    # message transform cotangents: thin einsums over arrays the step
+    # already indexes; original edge order (sums are order-free here)
+    src = src2.reshape(-1)
+    dst = dst2.reshape(-1)
+    hg = jnp.take(h, src, axis=0)  # [e, d] f32
+    dag = jnp.take(da, dst, axis=0)
+    w_flat = w2.reshape(p.n_etypes, -1)  # [T, e]
+    dwm = jnp.einsum("ed,te,ef->tdf", hg, w_flat, dag)
+    dbm = jnp.einsum("te,ef->tf", w_flat, dag)
+    return (dwm, dbm, dwih, dwhh, dbih, dbhh, dh,
+            None, None, None, None, None, None, None)
+
+
+_step.defvjp(_step_fwd, _step_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry point
+
+
+def ggnn_propagate(
+    wm: jax.Array,  # [T, d, d] per-etype message kernels
+    bm: jax.Array,  # [T, d] per-etype message biases
+    wih: jax.Array,  # [d, 3d] GRU input projection
+    whh: jax.Array,  # [d, 3d] GRU hidden projection
+    bih: jax.Array,  # [3d]
+    bhh: jax.Array,  # [3d]
+    feat: jax.Array,  # [N, d] f32 initial node state
+    edge_src: jax.Array,  # [E] i32
+    edge_dst: jax.Array,  # [E] i32, non-decreasing (GraphBatch invariant)
+    edge_mask: jax.Array,  # [E] bool
+    edge_type: jax.Array | None,  # [E] i32 or None
+    *,
+    n_steps: int,
+    n_etypes: int = 1,
+    scan_steps: bool = False,
+    scatter: str = "auto",
+    accum: str = "fp32",
+    block_nodes: int = 0,
+    block_edges: int = 0,
+    interpret: str | bool = "auto",
+) -> jax.Array:
+    """Run `n_steps` fused GGNN steps; drop-in for the lax step loop in
+    `GatedGraphConv.__call__` (same semantics, same [N, d] result).
+
+    The edge preprocessing — per-type masked weights, block reshapes,
+    per-edge-block destination bounds, and the src-sorted permutation
+    the backward's sorted scatter rides — is pure integer work traced
+    once per batch signature and shared by all steps AND by the
+    backward pass.
+    """
+    if accum not in ("fp32", "bf16"):
+        raise ValueError(f"unknown ggnn_kernel accum {accum!r}")
+    n, d = feat.shape
+    e = edge_src.shape[0]
+    block_n, block_e = block_sizes(n, e, block_nodes, block_edges)
+    interp = resolve_interpret(interpret)
+    if not interp and not kernel_shape_ok(n, e, d):
+        # fail with the documented guard, not an opaque Mosaic tiling
+        # error from deep inside the lowering (the flash_shape_ok
+        # dispatch convention)
+        raise ValueError(
+            f"ggnn_kernel cannot tile d={d} for hardware compilation "
+            f"(the lane dim must be a multiple of 128, i.e. "
+            f"hidden_dim % 32 == 0 with concat_all_absdf); interpret "
+            f"modes relax this — set model.ggnn_kernel=false or use a "
+            f"128-aligned feature width"
+        )
+    p = _Params(
+        n=n, e=e, d=d, block_n=block_n, block_e=block_e,
+        n_etypes=n_etypes, accum=accum,
+        scatter=resolve_scatter(scatter),
+        interpret=interp,
+    )
+    _note_lowering(p)
+
+    feat = feat.astype(jnp.float32)
+    w = edge_mask.astype(jnp.float32)
+    if n_etypes == 1:
+        w2 = w[None]
+    else:
+        w2 = jnp.stack(
+            [w * (edge_type == t).astype(jnp.float32)
+             for t in range(n_etypes)]
+        )
+    src2 = edge_src.reshape(p.n_eb, p.block_e)
+    dst2 = edge_dst.reshape(p.n_eb, p.block_e)
+    w2 = w2.reshape(p.n_etypes, p.n_eb, p.block_e)
+    # dst is sorted, so each block's range is (first, last) — exact ints
+    bounds = jnp.stack([dst2[:, 0], dst2[:, -1]], axis=1)
+    # src-sorted layout for the backward's sorted scatter (stable sort:
+    # deterministic; shared across steps and fwd/bwd)
+    perm = jnp.argsort(edge_src, stable=True)
+    src_sorted = jnp.take(edge_src, perm)
+    dstp2 = jnp.take(edge_dst, perm).reshape(p.n_eb, p.block_e)
+    wp2 = jnp.take(w2.reshape(p.n_etypes, -1), perm, axis=1).reshape(
+        p.n_etypes, p.n_eb, p.block_e
+    )
+
+    bih2 = bih.astype(jnp.float32)[None, :]
+    bhh2 = bhh.astype(jnp.float32)[None, :]
+    args = (wm.astype(jnp.float32), bm.astype(jnp.float32),
+            wih.astype(jnp.float32), whh.astype(jnp.float32), bih2, bhh2)
+
+    def step(h):
+        return _step(p, *args, h, src2, dst2, w2, bounds, src_sorted,
+                     dstp2, wp2)
+
+    if n_steps == 0:
+        return feat
+    h = step(feat)
+    if scan_steps and n_steps > 1:
+        h, _ = jax.lax.scan(
+            lambda c, _: (step(c), None), h, None, length=n_steps - 1
+        )
+    else:
+        for _ in range(n_steps - 1):
+            h = step(h)
+    return h

@@ -71,6 +71,82 @@ def segment_softmax(
     return ex / denom[segment_ids]
 
 
+def attention_pool(
+    gate: jax.Array,
+    feat: jax.Array,
+    node_graph: jax.Array,
+    node_mask: jax.Array,
+    num_graphs: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Gated-attention readout core: ([G, D] pooled, [N] attention).
+
+    The ONE implementation of the masked-segment-softmax pooling shared
+    by `GlobalAttentionPooling.__call__` (the model path) and
+    `eval/localize.py:ggnn_forward` (the attribution path, which needs
+    the per-node attention weights the module used to discard). A
+    single body means a kernel swap or numerics change in either
+    consumer cannot silently diverge the two — the bit-parity test in
+    tests/test_scan.py pins them equal.
+
+    `gate`: [N] pre-softmax gate scores; padding slots must map to
+    segment `num_graphs` (the batcher invariant) and are masked out.
+    """
+    attn = segment_softmax(
+        gate, node_graph, node_mask, num_graphs + 1,
+        indices_are_sorted=True,
+    )
+    pooled = segment_sum(
+        attn[:, None] * feat, node_graph, num_graphs + 1,
+        indices_are_sorted=True,
+    )
+    return pooled[:num_graphs], attn
+
+
+class _DenseParams(nn.Module):
+    """Parameter-only twin of `nn.Dense`: creates the identical
+    {kernel, bias} subtree (same shapes, same initializers, same
+    path-derived RNG folding) WITHOUT computing `x @ W + b` — the
+    fused-kernel path reads the raw arrays and does the math inside the
+    Pallas kernel. A checkpoint trained on either path restores into
+    the other bit-for-bit."""
+
+    features: int
+    in_features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self) -> tuple[jax.Array, jax.Array]:
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.in_features, self.features), self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(),
+            (self.features,), self.param_dtype,
+        )
+        return kernel, bias
+
+
+class _GRUParams(nn.Module):
+    """Parameter-only twin of `GRUCell` (input_proj/hidden_proj Dense
+    subtrees under the same names)."""
+
+    features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        wih, bih = _DenseParams(
+            3 * self.features, self.features, self.param_dtype,
+            name="input_proj",
+        )()
+        whh, bhh = _DenseParams(
+            3 * self.features, self.features, self.param_dtype,
+            name="hidden_proj",
+        )()
+        return wih, bih, whh, bhh
+
+
 class GRUCell(nn.Module):
     """torch.nn.GRUCell-compatible gated update (reset-before-candidate).
 
@@ -132,6 +208,17 @@ class GatedGraphConv(nn.Module):
     #: makes the aggregate exact — shards the O(E·D) gather/scatter work
     #: for graph batches whose edges exceed one chip. No param change.
     axis_name: str | None = None
+    #: Pallas-fused step (nn/ggnn_kernel.py): gather + transform +
+    #: dst-sorted scatter + GRU in one HBM-resident pass. Identical
+    #: param tree (parameter-only twin modules), so checkpoints move
+    #: freely between paths; fp32 + fold scatter is bit-identical to
+    #: the lax path under jit (docs/ggnn_kernel.md numerics contract).
+    use_kernel: bool = False
+    kernel_scatter: str = "auto"  # auto | fold | mxu
+    kernel_accum: str = "fp32"  # fp32 | bf16 message-side policy
+    kernel_block_nodes: int = 0  # 0 = auto from the node budget
+    kernel_block_edges: int = 0  # 0 = auto from the edge budget
+    kernel_interpret: str | bool = "auto"  # auto | False | legacy | tpu
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
@@ -157,6 +244,47 @@ class GatedGraphConv(nn.Module):
             )
         if feat.shape[-1] < self.out_features:
             feat = jnp.pad(feat, ((0, 0), (0, self.out_features - feat.shape[-1])))
+
+        if self.use_kernel:
+            if self.axis_name is not None:
+                raise ValueError(
+                    "ggnn_kernel does not compose with edge-sharded "
+                    "message passing (axis_name); run the kernel "
+                    "un-sharded or keep the lax path for graph_shard"
+                )
+            if self.n_steps == 0:
+                # the lax branch never calls its submodules for 0 steps
+                # (no params materialize); match that tree exactly
+                return feat
+            # parameter-only twins under the SAME names/paths as the
+            # lax branch below — identical init and checkpoint layout
+            etype_params = [
+                _DenseParams(
+                    self.out_features, self.out_features,
+                    self.param_dtype, name=f"etype_{i}",
+                )()
+                for i in range(self.n_etypes)
+            ]
+            wih, bih, whh, bhh = _GRUParams(
+                self.out_features, self.param_dtype, name="GRUCell_0"
+            )()
+            from deepdfa_tpu.nn import ggnn_kernel as _gk
+
+            return _gk.ggnn_propagate(
+                jnp.stack([k for k, _ in etype_params]),
+                jnp.stack([b for _, b in etype_params]),
+                wih, whh, bih, bhh, feat,
+                batch.edge_src, batch.edge_dst, batch.edge_mask,
+                batch.edge_type,
+                n_steps=self.n_steps,
+                n_etypes=self.n_etypes,
+                scan_steps=self.scan_steps,
+                scatter=self.kernel_scatter,
+                accum=self.kernel_accum,
+                block_nodes=self.kernel_block_nodes,
+                block_edges=self.kernel_block_edges,
+                interpret=self.kernel_interpret,
+            )
 
         # one message transform per edge type (CFG graphs use a single type)
         linears = [
@@ -219,15 +347,12 @@ class GlobalAttentionPooling(nn.Module):
 
     @nn.compact
     def __call__(self, batch: GraphBatch, feat: jax.Array) -> jax.Array:
-        g = batch.num_graphs
         gate = nn.Dense(1, name="gate_nn", param_dtype=self.param_dtype)(feat)
-        # node_graph is non-decreasing by the batcher's construction
-        attn = segment_softmax(
-            gate[:, 0], batch.node_graph, batch.node_mask, g + 1,
-            indices_are_sorted=True,
+        # node_graph is non-decreasing by the batcher's construction;
+        # the readout body is shared with the attribution path
+        # (eval/localize.py) via `attention_pool`
+        pooled, _ = attention_pool(
+            gate[:, 0], feat, batch.node_graph, batch.node_mask,
+            batch.num_graphs,
         )
-        pooled = segment_sum(
-            attn[:, None] * feat, batch.node_graph, g + 1,
-            indices_are_sorted=True,
-        )
-        return pooled[:g]
+        return pooled

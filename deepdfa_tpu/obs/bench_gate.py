@@ -42,12 +42,22 @@ DEFAULT_TOLERANCES: dict[str, float] = {
     # whole-repo scanning (ISSUE 8; gated once both records carry it)
     "scan_functions_per_sec": 0.20,
     "scan_incremental_functions_per_sec": 0.25,
+    # GGNN-step MFU against the same-window measured matmul ceiling
+    # (ISSUE 9, scripts/bench_scatter.py:bench_ggnn_step): ggnn_mfu is
+    # the production LAX chain's, ggnn_kernel_mfu the fused Pallas
+    # kernel's — both gated so a regression on either lowering is
+    # tracked
+    "ggnn_mfu": 0.25,
+    "ggnn_kernel_mfu": 0.25,
 }
 
 #: fail when `new > (1 + tol) * reference` (lower is better)
 LOWER_IS_BETTER: dict[str, float] = {
     "serve_latency_p99_ms": 0.25,
     "padding_waste": 0.10,
+    # fused GGNN per-step time (ISSUE 9; us/step, platform-resolved
+    # kernel scatter) — a rise past tolerance is a hot-path regression
+    "ggnn_step_us": 0.25,
 }
 
 

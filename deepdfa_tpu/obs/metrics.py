@@ -249,6 +249,18 @@ SCHEMA: tuple[str, ...] = (
     "localize/requests", "localize/batches", "localize/compiles",
     "localize/seconds/count", "localize/seconds/mean",
     "localize/seconds/max",
+    # Pallas-fused GGNN step (nn/ggnn_kernel.py, docs/ggnn_kernel.md):
+    # trace-time lowering census per batch signature — both the obs
+    # registry mirror and the epoch-record blob train loops embed when
+    # model.ggnn_kernel is on (signature labels are data-dependent, so
+    # this is a reviewed wildcard like obs/compile/signatures/*)
+    "ggnn_kernel/*", "obs/ggnn_kernel/*",
+    # measured roofline ceilings (eval/profiling.py probes — matmul
+    # TFLOP/s, stream + gather GB/s): every probe mirrors its scalar
+    # ceiling into a `roofline/<name>` gauge so obs-enabled runs carry
+    # the measured ceiling in the run log next to the throughput it
+    # defends (docs/roofline.md, docs/ggnn_kernel.md)
+    "roofline/*",
 )
 
 

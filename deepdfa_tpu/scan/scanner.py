@@ -411,7 +411,7 @@ def _edit_one_function(repo: Path) -> tuple[str, str]:
     return target.relative_to(repo).as_posix(), span.name
 
 
-def run_scan_smoke(**smoke_kw) -> dict:
+def run_scan_smoke(extra_overrides=None, **smoke_kw) -> dict:
     """Train a tiny checkpoint, scan a synthetic repo cold, edit one
     function, re-scan incrementally — the end-to-end acceptance drive
     (valid SARIF + JSONL, only the edited function re-extracts, zero
@@ -433,6 +433,9 @@ def run_scan_smoke(**smoke_kw) -> dict:
             "scan.threshold=0.0",
             "scan.max_file_kb=64",
             "obs.trace=true",
+            # caller overrides last so `scan --smoke --override ...`
+            # can flip any knob (e.g. model.ggnn_kernel) end to end
+            *(extra_overrides or []),
         ],
         **smoke_kw,
     )

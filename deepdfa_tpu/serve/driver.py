@@ -179,7 +179,7 @@ def run_score(
         service.close()
 
 
-def run_serve_smoke(**smoke_kw) -> dict:
+def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
     """`serve --smoke`: smoke run + real HTTP round trips on an
     ephemeral port, then teardown. Beyond the PR-5 contract (score 200s,
     a 422 reject, healthz/stats, zero steady-state recompiles) the smoke
@@ -207,6 +207,9 @@ def run_serve_smoke(**smoke_kw) -> dict:
             # and one request opts into {"lines": true}
             "serve.lines=true",
             "serve.lines_steps=2",
+            # caller overrides last so `serve --smoke --override ...`
+            # can flip any knob (e.g. model.ggnn_kernel) end to end
+            *(extra_overrides or []),
         ],
         **smoke_kw,
     )

@@ -262,6 +262,16 @@ class ScoringService:
             steady_state_recompiles=self.steady_state_recompiles(),
             lines=self.localizer is not None,
         )
+        # which message-passing lowering is serving (operators need to
+        # know before reading latency numbers): the Pallas-fused step's
+        # per-signature census, or the lax path when the knob is off
+        from deepdfa_tpu.nn import ggnn_kernel as _ggnn_kernel
+
+        info["ggnn_kernel"] = bool(
+            getattr(self.registry.cfg.model, "ggnn_kernel", False)
+        )
+        if info["ggnn_kernel"]:
+            info["ggnn_kernel_signatures"] = _ggnn_kernel.signature_stats()
         if self.localizer is not None:
             info["lines_method"] = self.localizer.method
         if deep:

@@ -398,6 +398,20 @@ class GraphTrainer:
                         stats.wait_fraction(epoch_seconds), 4
                     ),
                 }
+                if getattr(self.model, "ggnn_kernel", False):
+                    # fused-kernel compile/step census (the PR-2
+                    # step-cache convention): per-signature lowering
+                    # counts + device propagation steps this epoch;
+                    # flattens to ggnn_kernel/* tags (SCHEMA-declared).
+                    # A census that grows after epoch 1 is a steady-
+                    # state recompile — the same signal jit_lowerings()
+                    # guards on the serve executors.
+                    from deepdfa_tpu.nn import ggnn_kernel as ggnn_k
+
+                    record["ggnn_kernel"] = ggnn_k.epoch_record(
+                        steps=len(losses)
+                        * getattr(self.model, "n_steps", 0)
+                    )
                 if res is not None:
                     # self-healing observables (docs/resilience.md):
                     # resumed_from_step / skipped_steps / rollbacks

@@ -1,9 +1,11 @@
 #!/usr/bin/env python
-"""Decision benchmark for the GGNN message-passing scatter (SURVEY §2.4).
+"""Decision benchmark for the GGNN message-passing scatter (SURVEY §2.4)
+plus the FUSED-STEP A/B for the Pallas GGNN kernel (ROADMAP item 1,
+docs/ggnn_kernel.md).
 
-Measures every implementation strategy for `a[v] = sum_{(u,v)} (W h)[u]`
-at the flagship shape (node_budget 16384, edge_budget 65536, D=128) on
-the current jax platform and prints one JSON line per strategy:
+Part 1 — scatter strategies for `a[v] = sum_{(u,v)} (W h)[u]` at the
+flagship shape (node_budget 16384, edge_budget 65536, D=128), one JSON
+line per strategy:
 
 - xla_sorted:   gather + segment_sum(indices_are_sorted=True) — the
                 production path in nn/gnn.py
@@ -15,11 +17,21 @@ the current jax platform and prints one JSON line per strategy:
 Settled on a real v5e chip (2026-07-29): xla_sorted 40.9 ms,
 xla_unsorted 299.7 ms, xla_bf16 300.3 ms, cumsum 520.2 ms, and a fused
 Pallas VMEM gather+scatter kernel 517.7 ms. The sorted segment_sum path
-beats the Pallas kernel 12.6x (and every other strategy by >=7.3x), so
-the Pallas kernel was deleted (see docs/DESIGN.md
-section 3); this script remains for re-evaluation on new hardware.
+beats that round's scatter-only Pallas kernel 12.6x, so it was deleted
+(docs/DESIGN.md §3).
 
-    python scripts/bench_scatter.py            # default backend
+Part 2 (`bench_ggnn_step`) — the ISSUE-9 rematch at the right
+granularity: not scatter-vs-scatter but the WHOLE GGNN step (transform
++ gather + scatter + GRU) as one fused `nn/ggnn_kernel.py` pass vs the
+XLA-scheduled lax chain, per-step microseconds plus MFU measured
+against the SAME-WINDOW matmul ceiling and gather-bandwidth roofline
+(eval/profiling.py probes — spec peaks mislead on the time-shared
+tunnel chip; docs/roofline.md). `ggnn_step_us` (lower is better) and
+`ggnn_mfu` feed the bench-gate tolerance tables (obs/bench_gate.py), so
+the MFU gap is a TRACKED number across rounds, not a guess.
+
+    python scripts/bench_scatter.py            # default backend, full
+    python scripts/bench_scatter.py --smoke    # tier-1 regression mode
     DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_scatter.py
 """
 
@@ -106,11 +118,216 @@ def bench(fn, args, reps=20):
     return (time.perf_counter() - t0) / reps * 1e3, res
 
 
-def main():
+def _step_workload(n: int, e: int, d: int, seed: int = 0):
+    """A realistic padded GraphBatch + node features at the given
+    budgets (CFG-degree dst-sorted edges with a padding tail — the same
+    shape family `make_inputs` builds, wrapped as the batch the model
+    paths consume)."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.graphs.batch import GraphBatch
+
+    m, src, dst, mask = make_inputs(n=n, e=e, d=d, seed=seed)
+    ones_g = np.ones((1,), np.float32)
+    batch = GraphBatch(
+        node_feats=jnp.zeros((n, 4), jnp.int32),
+        node_vuln=jnp.zeros((n,), jnp.int32),
+        node_graph=jnp.zeros((n,), jnp.int32),
+        node_mask=jnp.ones((n,), bool),
+        edge_src=jnp.asarray(src),
+        edge_dst=jnp.asarray(dst),
+        edge_mask=jnp.asarray(mask),
+        graph_label=jnp.asarray(ones_g),
+        graph_mask=jnp.ones((1,), bool),
+        graph_ids=jnp.zeros((1,), jnp.int32),
+        num_graphs=1,
+    )
+    return batch, jnp.asarray(m)
+
+
+def bench_ggnn_step(
+    n: int = 16384,
+    e: int = 65536,
+    d: int = 128,
+    n_steps: int = 5,
+    reps: int = 10,
+    smoke: bool = False,
+) -> dict:
+    """Fused-kernel vs lax A/B over `n_steps` GGNN steps; one record.
+
+    Fields (the bench-gate contract): `ggnn_step_us` — per-step time of
+    the kernel with scatter resolved for THIS platform (`"auto"`: mxu
+    on TPU hardware, the bit-exact fold under the CPU interpreter) —
+    LOWER IS BETTER; `ggnn_lax_step_us` the production lax chain;
+    `ggnn_mfu` the lax path's achieved FLOP/s against the same-window
+    measured matmul ceiling (and `ggnn_kernel_mfu` the kernel's);
+    `ggnn_bytes_vs_gather_ceiling` the bandwidth side of the roofline.
+    Numerics are asserted, not assumed: fold must be BIT-IDENTICAL to
+    lax, mxu within f32 reassociation tolerance, bf16 within the
+    documented policy bound.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.eval.profiling import (
+        compiled_cost,
+        measure_gather_bandwidth,
+        measure_matmul_ceiling,
+    )
+    from deepdfa_tpu.nn import GatedGraphConv
+
+    if smoke:
+        n, e, d, n_steps, reps = 512, 2048, 32, 3, 3
+
+    platform = jax.devices()[0].platform
+    batch, feat = _step_workload(n, e, d)
+    lax_conv = GatedGraphConv(out_features=d, n_steps=n_steps)
+    params = lax_conv.init(jax.random.key(0), batch, feat)
+
+    def variant(**kw):
+        conv = GatedGraphConv(out_features=d, n_steps=n_steps, **kw)
+        return lambda f: conv.apply(params, batch, f)
+
+    runs = {
+        "lax": variant(),
+        "kernel": variant(use_kernel=True),  # platform-resolved scatter
+        "kernel_mxu": variant(use_kernel=True, kernel_scatter="mxu"),
+        "kernel_bf16": variant(
+            use_kernel=True, kernel_scatter="mxu", kernel_accum="bf16"
+        ),
+    }
+    want = None
+    rec: dict = {
+        "metric": "ggnn_step_us",
+        "unit": "us/step (fused kernel, platform-resolved scatter)",
+        "platform": platform,
+        "shape": f"n={n} e={e} d={d} steps={n_steps}",
+    }
+    for name, fn in runs.items():
+        try:
+            ms, out = bench(fn, (feat,), reps=reps)
+        except Exception as exc:  # noqa: BLE001 — e.g. a Mosaic
+            # lowering gap on new hardware must cost one variant's
+            # fields, never the record (the lax number still lands)
+            rec[f"ggnn_{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
+            continue
+        us = ms * 1e3 / n_steps
+        if name == "lax":
+            want = out
+            rec["ggnn_lax_step_us"] = round(us, 2)
+            continue
+        if want is None:  # the lax reference itself failed: no parity
+            rec["ggnn_step_us" if name == "kernel"
+                else f"ggnn_{name}_step_us"] = round(us, 2)
+            continue
+        err = float(np.abs(out - want).max() / (np.abs(want).max() + 1e-9))
+        # the numerics contract rides along with every measurement
+        # (docs/ggnn_kernel.md): fold is bit-identical, mxu is f32
+        # reassociation-only, bf16 is the documented policy bound
+        tol = {"kernel_bf16": 0.05, "kernel_mxu": 1e-5}.get(name, 1e-5)
+        ok = bool(err <= tol)
+        key = "ggnn_step_us" if name == "kernel" else f"ggnn_{name}_step_us"
+        rec[key] = round(us, 2)
+        rec[f"ggnn_{name}_rel_err"] = round(err, 8)
+        rec[f"ggnn_{name}_ok"] = ok
+    if rec.get("ggnn_step_us") and rec.get("ggnn_lax_step_us"):
+        rec["ggnn_kernel_speedup"] = round(
+            rec["ggnn_lax_step_us"] / rec["ggnn_step_us"], 3
+        )
+
+    # MFU against the MEASURED same-window ceiling (spec peaks mislead
+    # on a time-shared chip — eval/profiling.py; docs/roofline.md)
+    try:
+        cost = compiled_cost(runs["lax"], feat)
+        flops = cost["flops"]
+        if flops > 0:
+            rec["ggnn_flops_per_step"] = round(flops / n_steps, 1)
+            probe_n = 1024 if smoke or platform == "cpu" else 4096
+            ceiling = measure_matmul_ceiling(
+                n=probe_n, chain=2 if smoke else 8,
+                reps=1 if smoke else 3,
+                dtype=jnp.float32 if platform == "cpu" else None,
+            )
+            rec.update(ceiling)
+            meas = ceiling["matmul_tflops_measured"] * 1e12
+            for key, us_key in (
+                ("ggnn_mfu", "ggnn_lax_step_us"),
+                ("ggnn_kernel_mfu", "ggnn_step_us"),
+            ):
+                us = rec.get(us_key)
+                if us and meas > 0:
+                    rec[key] = round(
+                        (flops / n_steps) / (us * 1e-6) / meas, 6
+                    )
+        byts = cost.get("bytes_accessed", 0.0)
+        if byts > 0 and rec.get("ggnn_lax_step_us"):
+            rec["ggnn_bytes_per_step"] = round(byts / n_steps, 1)
+            gather = measure_gather_bandwidth(
+                rows=min(n, 4096) if smoke else n,
+                dim=d, idx_len=min(e, 16384) if smoke else e,
+                chain=2 if smoke else 8, reps=1 if smoke else 3,
+            )
+            rec.update(gather)
+            gbps = gather["gather_gbps_measured"] * 1e9
+            if gbps > 0:
+                rec["ggnn_bytes_vs_gather_ceiling"] = round(
+                    (byts / n_steps)
+                    / (rec["ggnn_lax_step_us"] * 1e-6) / gbps, 4
+                )
+    except Exception as exc:  # probes must never cost the A/B record
+        rec["ggnn_roofline_error"] = f"{type(exc).__name__}: {exc}"[:200]
+
+    from deepdfa_tpu.obs import run_stamp
+
+    rec.update(run_stamp())
+    rec["value"] = rec.get("ggnn_step_us")
+    return rec
+
+
+def run_smoke() -> dict:
+    """Tier-1 regression mode (the bench_prefetch/bench_scan
+    convention): a tiny fused-step A/B whose numerics contract is
+    ASSERTED — fold bit-identical to lax, mxu within f32 reassociation
+    tolerance, bf16 within the policy bound — plus the roofline fields
+    present. Raises on any violation; prints + returns one record."""
+    rec = bench_ggnn_step(smoke=True)
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        # "auto" resolves to the fold scatter off-TPU: bit-identity is
+        # the contract, not a tolerance
+        if rec.get("ggnn_kernel_rel_err") != 0.0:
+            raise AssertionError(
+                f"fold kernel not bit-identical to lax: rel_err="
+                f"{rec.get('ggnn_kernel_rel_err')}"
+            )
+    for name in ("kernel", "kernel_mxu", "kernel_bf16"):
+        if not rec.get(f"ggnn_{name}_ok"):
+            raise AssertionError(
+                f"{name} numerics outside tolerance: "
+                f"rel_err={rec.get(f'ggnn_{name}_rel_err')}"
+            )
+    if not rec.get("ggnn_step_us") or not rec.get("ggnn_lax_step_us"):
+        raise AssertionError(f"missing step timings: {rec}")
+    print(json.dumps(rec))
+    return rec
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    opts = ap.parse_args(argv)
+
     from deepdfa_tpu.core.backend import apply_platform_override
 
     apply_platform_override()
     import jax
+
+    if opts.smoke:
+        run_smoke()
+        return
 
     m, src, dst, mask = make_inputs()
     n = m.shape[0]
@@ -167,6 +384,12 @@ def main():
         print(json.dumps({
             "best": best, "ms": round(results[best], 3), **run_stamp(),
         }))
+
+    # the fused-step rematch at full shape (see module docstring part 2)
+    try:
+        print(json.dumps(bench_ggnn_step()))
+    except Exception as exc:  # noqa: BLE001 - report, don't die
+        print(json.dumps({"strategy": "ggnn_step", "error": str(exc)[:300]}))
 
 
 if __name__ == "__main__":

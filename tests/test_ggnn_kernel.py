@@ -1,0 +1,383 @@
+"""Pallas-fused GGNN kernel (nn/ggnn_kernel.py) — numerics contract,
+gradients, and the zero-steady-state-recompile invariant.
+
+The contract under test (docs/ggnn_kernel.md):
+- fp32 + fold scatter under the interpreter is BIT-IDENTICAL to the lax
+  path under jit, for the whole DeepDFA forward, across the serve
+  warmup ladder (including all-padding edge slots and single-node
+  graphs) and for multi-etype graphs;
+- the bf16 accumulation policy stays inside its documented bound;
+- the custom_vjp gradients match jax.grad of the lax path;
+- enabling the kernel adds no program signatures: train, serve scoring,
+  and localization stay at zero steady-state recompiles (the PR-2/PR-5
+  `jit_lowerings` guard plus the kernel's own trace census).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.graphs import GraphSpec, pack
+from deepdfa_tpu.nn import GatedGraphConv
+from deepdfa_tpu.nn import ggnn_kernel as gk
+
+
+def _random_graphs(rng, count=3, max_nodes=12):
+    graphs = []
+    for gid in range(count):
+        n = int(rng.integers(3, max_nodes))
+        e = int(rng.integers(2, 3 * n))
+        graphs.append(
+            GraphSpec(
+                graph_id=gid,
+                node_feats=rng.integers(0, 5, (n, 4)).astype(np.int32),
+                node_vuln=np.zeros((n,), np.int32),
+                edge_src=rng.integers(0, n, (e,)).astype(np.int32),
+                edge_dst=rng.integers(0, n, (e,)).astype(np.int32),
+                label=float(gid % 2),
+            )
+        )
+    return graphs
+
+
+def _single_node_graph(gid=0):
+    return GraphSpec(
+        graph_id=gid,
+        node_feats=np.zeros((1, 4), np.int32),
+        node_vuln=np.zeros((1,), np.int32),
+        edge_src=np.zeros((0,), np.int32),
+        edge_dst=np.zeros((0,), np.int32),
+        label=1.0,
+    )
+
+
+def _model(hidden=8, n_steps=2, **kw):
+    from deepdfa_tpu.models import DeepDFA
+
+    return DeepDFA(input_dim=52, hidden_dim=hidden, n_steps=n_steps, **kw)
+
+
+def _assert_bitwise(got, want, what):
+    got = np.asarray(got, np.float32)
+    want = np.asarray(want, np.float32)
+    assert np.array_equal(
+        got.view(np.uint32), want.view(np.uint32)
+    ), f"{what}: max abs diff {np.abs(got - want).max()}"
+
+
+def _warmup_ladder(rng):
+    """The serve executor's batch shapes: every ladder size, including
+    the all-padding batch every executor warms with and a single-node
+    graph."""
+    return {
+        1: [[_single_node_graph()]],
+        2: [_random_graphs(rng, 2), []],  # [] = all-padding warmup batch
+        4: [_random_graphs(rng, 4)],
+    }
+
+
+def test_conv_bit_identical_across_warmup_ladder(rng):
+    """The fused-step program is BIT-IDENTICAL to the jitted lax
+    GatedGraphConv across the serve warmup ladder — the fold scatter
+    reproduces sorted segment_sum's exact left fold, gather-then-
+    transform equals transform-then-gather row-wise, and row-blocked
+    GRU matmuls equal the full-table ones. This is the layer-program
+    contract docs/ggnn_kernel.md states; the whole-model comparison
+    below is last-ulp only (see its docstring for why)."""
+    import jax
+
+    node_budget, edge_budget = 512, 2048
+    d, n_steps = 32, 5  # flagship step count, 4*hidden width
+    conv = GatedGraphConv(out_features=d, n_steps=n_steps)
+    conv_k = GatedGraphConv(out_features=d, n_steps=n_steps, use_kernel=True)
+    init_batch = pack(_random_graphs(rng), 4, node_budget, edge_budget)
+    feat0 = rng.standard_normal((node_budget, d)).astype(np.float32)
+    params = conv.init(jax.random.key(0), init_batch, feat0)
+    params_k = conv_k.init(jax.random.key(0), init_batch, feat0)
+    # identical param trees by construction (parameter-only twins)
+    for a, b in zip(
+        jax.tree.leaves(params), jax.tree.leaves(params_k), strict=True
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    f_lax = jax.jit(lambda b, f: conv.apply(params, b, f))
+    f_k = jax.jit(lambda b, f: conv_k.apply(params, b, f))
+    for size, cases in _warmup_ladder(rng).items():
+        for graphs in cases:
+            batch = pack(graphs, size, node_budget, edge_budget)
+            feat = rng.standard_normal(
+                (node_budget, d)
+            ).astype(np.float32)
+            _assert_bitwise(
+                f_k(batch, feat), f_lax(batch, feat),
+                f"ladder size {size} ({len(graphs)} graphs)",
+            )
+
+
+def test_model_last_ulp_across_warmup_ladder(rng):
+    """Whole-model DeepDFA logits, kernel vs lax, across the ladder.
+
+    NOT asserted bitwise, deliberately: XLA CPU fuses each path's
+    surrounding ops context-dependently (FMA formation around the
+    embedding/pooling boundaries moves the last bits of BOTH paths —
+    verified by comparing each path standalone vs embedded), so
+    whole-program bit equality between two different HLO graphs is not
+    a property XLA offers. The layer program IS pinned bitwise above;
+    here the logits must agree to last-ulp float32."""
+    import jax
+
+    node_budget, edge_budget = 512, 2048
+    m_lax = _model(n_steps=3)
+    m_k = _model(n_steps=3, ggnn_kernel=True)
+    init_batch = pack(_random_graphs(rng), 4, node_budget, edge_budget)
+    params = m_lax.init(jax.random.key(0), init_batch)
+    f_lax = jax.jit(lambda p, b: m_lax.apply(p, b))
+    f_k = jax.jit(lambda p, b: m_k.apply(p, b))
+    for size, cases in _warmup_ladder(rng).items():
+        for graphs in cases:
+            batch = pack(graphs, size, node_budget, edge_budget)
+            np.testing.assert_allclose(
+                np.asarray(f_k(params, batch)),
+                np.asarray(f_lax(params, batch)),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"ladder size {size} ({len(graphs)} graphs)",
+            )
+
+
+def test_conv_multi_etype_bit_identical(rng):
+    import jax
+
+    d, n_steps, n, e = 8, 3, 10, 20
+    g = GraphSpec(
+        graph_id=0,
+        node_feats=rng.integers(0, 5, (n, 4)).astype(np.int32),
+        node_vuln=np.zeros((n,), np.int32),
+        edge_src=rng.integers(0, n, (e,)).astype(np.int32),
+        edge_dst=rng.integers(0, n, (e,)).astype(np.int32),
+        label=0.0,
+        edge_type=rng.integers(0, 3, (e,)).astype(np.int32),
+    )
+    batch = pack([g], 1, 16, 48)
+    feats = rng.standard_normal((16, d)).astype(np.float32)
+    conv = GatedGraphConv(out_features=d, n_steps=n_steps, n_etypes=3)
+    conv_k = GatedGraphConv(
+        out_features=d, n_steps=n_steps, n_etypes=3, use_kernel=True
+    )
+    params = conv.init(jax.random.key(7), batch, feats)
+    want = jax.jit(lambda f: conv.apply(params, batch, f))(feats)
+    got = jax.jit(lambda f: conv_k.apply(params, batch, f))(feats)
+    _assert_bitwise(got, want, "n_etypes=3")
+
+
+def test_bf16_policy_within_bound(rng):
+    """The bf16 message-side policy (halved gather traffic, f32
+    accumulation, f32 GRU state) stays inside the documented bound for
+    both scatter modes."""
+    import jax
+
+    batch = pack(_random_graphs(rng), 4, 512, 2048)
+    m_lax = _model()
+    params = m_lax.init(jax.random.key(0), batch)
+    want = np.asarray(jax.jit(lambda b: m_lax.apply(params, b))(batch))
+    scale = max(float(np.abs(want).max()), 1e-6)
+    for scatter in ("fold", "mxu"):
+        m_bf16 = _model(
+            ggnn_kernel=True, ggnn_kernel_scatter=scatter,
+            ggnn_kernel_accum="bf16",
+        )
+        got = np.asarray(
+            jax.jit(lambda b: m_bf16.apply(params, b))(batch)
+        )
+        rel = float(np.abs(got - want).max()) / scale
+        assert rel < 0.05, f"bf16/{scatter} rel err {rel}"
+        assert rel > 0.0  # the policy is actually engaged
+
+
+def test_grads_match_lax_path(rng):
+    """custom_vjp gradients vs jax.grad of the lax path, whole model
+    (embedding + fused steps + pooling + head), every param leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    batch = pack(_random_graphs(rng), 4, 512, 2048)
+    m_lax = _model()
+    m_k = _model(ggnn_kernel=True)
+    params = m_lax.init(jax.random.key(0), batch)
+    labels = jnp.asarray(batch.graph_label)
+
+    def loss(model, p):
+        logits = model.apply(p, batch)
+        return jnp.sum(
+            jnp.where(
+                jnp.asarray(batch.graph_mask),
+                (jax.nn.sigmoid(logits) - labels) ** 2, 0.0,
+            )
+        )
+
+    g_lax = jax.jit(jax.grad(lambda p: loss(m_lax, p)))(params)
+    g_k = jax.jit(jax.grad(lambda p: loss(m_k, p)))(params)
+    flat_lax = jax.tree_util.tree_leaves_with_path(g_lax)
+    flat_k = jax.tree.leaves(g_k)
+    assert len(flat_lax) == len(flat_k)
+    for (path, want), got in zip(flat_lax, flat_k, strict=True):
+        want = np.asarray(want)
+        got = np.asarray(got)
+        scale = max(float(np.abs(want).max()), 1e-8)
+        err = float(np.abs(got - want).max()) / scale
+        assert err < 1e-3, f"{jax.tree_util.keystr(path)}: rel err {err}"
+
+
+def test_kernel_rejects_edge_sharding():
+    import jax
+
+    conv = GatedGraphConv(
+        out_features=4, n_steps=1, use_kernel=True, axis_name="dp"
+    )
+    g = _single_node_graph()
+    batch = pack([g], 1, 8, 16)
+    feats = np.zeros((8, 4), np.float32)
+    with pytest.raises(ValueError, match="edge-sharded"):
+        conv.init(jax.random.key(0), batch, feats)
+
+
+def test_zero_steady_state_recompiles_train(rng, tmp_path):
+    """Two epochs at one batch signature with the kernel on: the
+    lowering census after epoch 1 never grows, and the epoch record
+    carries the per-signature compile/step counters."""
+    import jax  # noqa: F401
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.train import GraphTrainer
+
+    synth = generate(8, seed=0)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(8), limit_all=50,
+        limit_subkeys=50,
+    )
+    cfg = config_mod.apply_overrides(Config(), [
+        "train.max_epochs=2",
+        "model.hidden_dim=8", "model.n_steps=2",
+        "model.ggnn_kernel=true",
+    ])
+    from deepdfa_tpu.core.config import MeshConfig
+    from deepdfa_tpu.parallel import make_mesh
+
+    model = DeepDFA.from_config(cfg.model, input_dim=52)
+    trainer = GraphTrainer(
+        model, cfg,
+        mesh=make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1]),
+    )
+
+    def batches(_e=0):
+        return shard_bucket_batches(
+            specs, 1, 4, 1024, 4096, oversized="raise"
+        )
+
+    gk.reset_signature_stats()
+    state = trainer.init_state(next(iter(batches())))
+    records = []
+    trainer.fit(state, batches, log_fn=records.append)
+    epoch_recs = [r for r in records if "ggnn_kernel" in r]
+    assert len(epoch_recs) == 2
+    first, second = (r["ggnn_kernel"] for r in epoch_recs)
+    sig_keys = [k for k in first if k.startswith("signatures/")]
+    assert sig_keys, first
+    # epoch 2 re-traces nothing: the census is frozen after epoch 1
+    for k in sig_keys:
+        assert second[k] == first[k], (k, first, second)
+    assert second["lowerings"] == first["lowerings"]
+    assert second["device_steps"] == first["device_steps"] > 0
+
+
+def test_zero_steady_state_recompiles_serve_and_localize(rng):
+    """Warmed GgnnExecutor + GgnnLocalizer with the kernel enabled:
+    arbitrary request mixes trigger no lowering after warmup, on either
+    ladder (the PR-5/PR-7 invariant, now with the fused step inside)."""
+    import jax
+
+    from deepdfa_tpu.serve.batcher import GgnnExecutor
+    from deepdfa_tpu.serve.frontend import Features
+    from deepdfa_tpu.serve.localize import GgnnLocalizer
+
+    node_budget, edge_budget = 512, 2048
+    model = _model(ggnn_kernel=True)
+    init_batch = pack(_random_graphs(rng), 4, node_budget, edge_budget)
+    params = model.init(jax.random.key(0), init_batch)
+
+    ex = GgnnExecutor(
+        model, lambda: params, node_budget, edge_budget,
+        max_batch_graphs=4,
+    )
+    ex.warmup()
+    loc = GgnnLocalizer(
+        model, lambda: params, node_budget, edge_budget,
+        sizes=ex.sizes, method="saliency", n_steps=2,
+    )
+    loc.warmup()
+    warm_lowerings = (ex.jit_lowerings(), loc.jit_lowerings())
+    census = gk.signature_stats()
+    assert census  # the kernel actually traced during warmup
+
+    for count in (1, 3, 2, 4, 1):
+        graphs = _random_graphs(rng, count)
+        probs = ex.execute("graph", graphs)
+        assert probs.shape == (count,)
+        feats = [
+            Features(spec=g, node_lines=np.arange(1, g.num_nodes + 1))
+            for g in graphs
+        ]
+        out = loc.attribute(feats)
+        assert len(out) == count
+    assert (ex.jit_lowerings(), loc.jit_lowerings()) == warm_lowerings
+    assert gk.signature_stats() == census
+
+    # served-vs-offline parity rides the existing contract: the warmed
+    # executable IS ggnn_score_fn jitted — spot-check one singleton
+    from deepdfa_tpu.eval.localize import ggnn_score_fn
+
+    g = _random_graphs(rng, 1)[0]
+    offline = jax.jit(ggnn_score_fn("saliency", model, 2))(
+        params, pack([g], 1, node_budget, edge_budget)
+    )
+    prob, lines = loc.attribute(
+        [Features(spec=g, node_lines=np.arange(1, g.num_nodes + 1))]
+    )[0]
+    assert prob == float(np.asarray(offline[0])[0])
+
+
+def test_schema_declares_kernel_tags():
+    from deepdfa_tpu.obs.metrics import declared
+
+    for tag in (
+        "ggnn_kernel/lowerings",
+        "ggnn_kernel/device_steps",
+        "ggnn_kernel/signatures/512x2048x32",
+        "obs/ggnn_kernel/lowerings",
+        "roofline/gather_gbps_measured",
+    ):
+        assert declared(tag), tag
+
+
+def test_bench_scatter_smoke(rng):
+    """Tier-1 end-to-end (the bench_prefetch convention):
+    scripts/bench_scatter.py --smoke asserts the numerics contract and
+    emits the gate fields bench.py --child-scatter records."""
+    from tests.conftest import load_script_module
+
+    bench_scatter = load_script_module("bench_scatter")
+    rec = bench_scatter.run_smoke()
+    assert rec["ggnn_kernel_rel_err"] == 0.0
+    assert rec["ggnn_step_us"] > 0 and rec["ggnn_lax_step_us"] > 0
+    assert "ggnn_mfu" in rec or "ggnn_roofline_error" in rec
+    if "ggnn_mfu" in rec:
+        # the ceiling probes mirror their measurements into the
+        # declared roofline/* gauges (obs/metrics.py SCHEMA)
+        from deepdfa_tpu.obs import metrics as obs_metrics
+
+        snap = obs_metrics.REGISTRY.snapshot()
+        assert "roofline/matmul_tflops_measured" in snap
+        assert "roofline/gather_gbps_measured" in snap
