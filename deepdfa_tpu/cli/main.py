@@ -1658,7 +1658,8 @@ def cmd_ivdetect(args) -> None:
 def cmd_diag(args) -> None:
     """Render a run's telemetry (deepdfa_tpu/obs/diag.py): throughput
     timeline, host/device stage attribution from records AND the trace
-    event stream, resilience event log."""
+    event stream, efficiency ledger (per-signature MFU/compile bars,
+    HBM watermarks), postmortem forensics, resilience event log."""
     from deepdfa_tpu.obs import diag
 
     argv = []
@@ -1668,6 +1669,8 @@ def cmd_diag(args) -> None:
         argv.append("--json")
     if args.smoke:
         argv.append("--smoke")
+    if getattr(args, "postmortem", None):
+        argv += ["--postmortem", args.postmortem]
     rc = diag.main(argv)
     if rc:
         raise SystemExit(rc)
@@ -1735,6 +1738,11 @@ def cmd_serve(args) -> None:
             # ISSUE 8: the lines endpoint answered with ranked
             # attributions and compiled nothing after warmup
             or not report["line_attributions"]
+            # ISSUE 10: every warmup compile was cost-accounted by the
+            # efficiency ledger, and the flight recorder's dumped
+            # postmortem validated (docs/efficiency.md)
+            or not report["ledger_sites"]
+            or not report["postmortem"]["ok"]
         )
         if bad:
             raise SystemExit("serve smoke contract violated (see report)")
@@ -1784,6 +1792,8 @@ def cmd_scan(args) -> None:
                 for k in ("scan_steady_state_recompiles",
                           "scan_lines_steady_state_recompiles")
             )
+            # ISSUE 10: the scan smoke's dumped postmortem validated
+            or not report["postmortem"]["ok"]
         )
         if bad:
             raise SystemExit("scan smoke contract violated (see report)")
@@ -2073,6 +2083,10 @@ def main(argv=None) -> None:
                    help="machine-readable report")
     p.add_argument("--smoke", action="store_true",
                    help="build + render a synthetic run dir (tier-1)")
+    p.add_argument("--postmortem", default=None, metavar="PATH",
+                   help="render one postmortem.json (crash flight "
+                        "recorder dump, docs/efficiency.md) instead of "
+                        "a run dir")
     p.set_defaults(fn=cmd_diag)
 
     p = sub.add_parser(

@@ -278,6 +278,29 @@ class ObsConfig:
     # <run_dir>/xprof/TRIGGER, arms a capture of the next
     # xprof_num_steps steps
     xprof_trigger: bool = False
+    # device efficiency ledger (obs/ledger.py, docs/efficiency.md):
+    # per-executable cost-analysis flops/bytes, compile wall time, and
+    # executable live bytes at every AOT compile site, joined with the
+    # sync-free StepTimer device time into rolling per-signature MFU —
+    # into epoch records, /metrics `ledger/*` families, /stats, and the
+    # serve/scan logs. Host-side accounting only (zero new program
+    # signatures); with the ledger ON, GraphTrainer additionally AOT-
+    # compiles its already-jitted step once per signature to read the
+    # cost analysis (a warmup-time cost, never steady-state).
+    ledger: bool = False
+    # run the runtime measured-ceiling probes (small dense-matmul +
+    # gather probes, docs/roofline.md) once at session start so per-site
+    # MFU reads against the MEASURED ceiling instead of raw FLOP/s;
+    # costs ~a second of device time at enable
+    ledger_ceilings: bool = False
+    # crash flight recorder (obs/flight.py): a bounded in-memory ring of
+    # the last N step records + recent telemetry instants + the ledger
+    # snapshot, dumped atomically to <run_dir>/postmortem.json on
+    # watchdog abort (exit 113), SIGTERM preemption, NaN-guard rollback,
+    # backend WEDGE, or an unhandled exception (OOM classified)
+    flight: bool = False
+    flight_steps: int = 64
+    flight_events: int = 128
 
 
 @dataclass(frozen=True)

@@ -22,24 +22,30 @@ from pathlib import Path
 import numpy as np
 
 
-def compiled_cost(fn, *args) -> dict:
-    """Compile `fn(*args)` and return XLA cost analysis (flops, bytes)."""
+def compiled_cost(
+    fn, *args, ledger_tag: str | None = None,
+    ledger_signature: str | None = None,
+) -> dict:
+    """Compile `fn(*args)` and return XLA cost analysis (flops, bytes).
+
+    Thin client of the ONE cost-analysis reader
+    (obs/ledger.py:read_cost_analysis — the jax list-vs-dict shim lives
+    there now), so Table-5 profiling and the runtime efficiency ledger
+    cannot drift. With `ledger_tag` set and the ledger enabled, the
+    compile is also booked as a ledger site (flops/bytes/live-bytes +
+    this call's compile wall time)."""
     import jax
 
-    lowered = jax.jit(fn).lower(*args)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis() or {}
-    if isinstance(cost, (list, tuple)):
-        # jax <= 0.4.x returns a one-entry list of per-executable dicts;
-        # newer jax returns the dict directly
-        cost = cost[0] if cost else {}
-    return {
-        "flops": float(cost.get("flops", 0.0)),
-        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
-        "cost_analysis": {
-            k: v for k, v in cost.items() if isinstance(v, (int, float))
-        },
-    }
+    from deepdfa_tpu.obs import ledger as obs_ledger
+
+    t0 = time.perf_counter()
+    compiled = jax.jit(fn).lower(*args).compile()
+    dt = time.perf_counter() - t0
+    if ledger_tag is not None:
+        obs_ledger.record_compile(
+            ledger_tag, ledger_signature or "default", compiled, dt
+        )
+    return obs_ledger.read_cost_analysis(compiled)
 
 
 def time_fn(fn, *args, warmup: int = 3, iters: int = 20) -> dict:

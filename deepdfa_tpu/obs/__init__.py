@@ -14,6 +14,11 @@ One observability layer the whole stack reports into:
   events (/healthz?deep=1, bench.py fallback path).
 - `obs.bench_gate` — the bench-trajectory regression gate
   (scripts/bench_gate.py).
+- `obs.ledger`  — device efficiency ledger: per-executable cost-analysis
+  flops/bytes, compile time, HBM watermarks, rolling per-signature MFU
+  (docs/efficiency.md).
+- `obs.flight`  — crash flight recorder: bounded step/event rings dumped
+  as postmortem.json on terminal events (docs/efficiency.md).
 - `obs.diag`    — the `deepdfa-tpu diag <run_dir>` renderer.
 
 The train loops talk to it through two seams that keep their signatures
@@ -32,7 +37,13 @@ from __future__ import annotations
 import contextlib
 from pathlib import Path
 
-from deepdfa_tpu.obs import metrics, trace, xprof
+from deepdfa_tpu.obs import (
+    flight,
+    ledger,
+    metrics,
+    trace,
+    xprof,
+)
 
 #: bump when the shape/meaning of emitted bench records changes —
 #: BENCH_*.json artifacts are compared across PRs (ISSUE 4 satellite)
@@ -47,13 +58,63 @@ class Instruments:
 
     def __init__(self, metrics_on: bool):
         self.metrics_on = bool(metrics_on)
-        self.timer = xprof.StepTimer() if self.metrics_on else None
+        #: the efficiency ledger / flight recorder installed by
+        #: session() (or directly by tests/benches); None when off
+        self.ledger = ledger.get()
+        self.flight = flight.get()
+        # the StepTimer exists for metrics OR the ledger: the ledger's
+        # rolling per-signature MFU is the lagged device-time join
+        self.timer = (
+            xprof.StepTimer(
+                on_step_seconds=(
+                    ledger.observe_step_seconds
+                    if self.ledger is not None else None
+                )
+            )
+            if (self.metrics_on or self.ledger is not None)
+            else None
+        )
 
     def step_span(self, step: int):
         """Wraps one train-step dispatch; also advances the xprof
-        controller (window/trigger capture boundaries)."""
+        controller (window/trigger capture boundaries) and the flight
+        recorder's step ring."""
         xprof.controller_on_step(step)
+        if self.flight is not None:
+            self.flight.note_step(step)
         return trace.span("train_step", cat="train", step=step)
+
+    def observe_step_compile(self, tag: str, signature: str, fn_jit, args):
+        """First-signature hook from the train loops (ledger only).
+
+        Declares the active (tag, signature) step site for the
+        StepTimer join, and — once per signature — AOT lower+compiles
+        the loop's ALREADY-JITTED step to read XLA's cost analysis
+        (jit's call cache is not seeded by `.lower().compile()`, so this
+        is a second compile of the same program: an opt-in warmup cost,
+        zero new program signatures, never steady-state). Errors land in
+        the ledger's error list, never in the run."""
+        led = self.ledger
+        if led is None:
+            return
+        led.set_step_site(tag, signature)
+        if led.has_site(tag, signature):
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            compiled = fn_jit.lower(*args).compile()
+        except Exception as e:  # accounting must never cost the run
+            led._note_error(
+                f"step_compile[{tag}/{signature}]: "
+                f"{type(e).__name__}: {e}"
+            )
+            led.record_compile(tag, signature, None, 0.0)
+            return
+        led.record_compile(
+            tag, signature, compiled, _time.perf_counter() - t0
+        )
 
     def dispatched(self, loss_handle, dispatch_seconds=None) -> None:
         if self.timer is not None:
@@ -74,6 +135,11 @@ class Instruments:
         existing RunLogger jsonl/TensorBoard path."""
         if self.timer is not None:
             self.timer.drain()
+        if self.ledger is not None:
+            # per-phase HBM watermark + the efficiency snapshot ride the
+            # epoch record (flattened to SCHEMA-declared ledger/* tags)
+            self.ledger.record_memory("epoch")
+            record["ledger"] = self.ledger.snapshot()
         if not self.metrics_on:
             return record
         snap = metrics.REGISTRY.snapshot()
@@ -96,9 +162,14 @@ class _NullInstruments:
     active = False
     metrics_on = False
     timer = None
+    ledger = None
+    flight = None
 
     def step_span(self, step: int):
         return trace._NULL_SPAN
+
+    def observe_step_compile(self, tag, signature, fn_jit, args) -> None:
+        pass
 
     def dispatched(self, loss_handle, dispatch_seconds=None) -> None:
         pass
@@ -122,7 +193,13 @@ def instruments(cfg) -> "Instruments | _NullInstruments":
     controller installed) -> live Instruments; else the shared no-op."""
     ocfg = getattr(cfg, "obs", None)
     metrics_on = bool(ocfg is not None and ocfg.metrics)
-    if metrics_on or trace.enabled() or xprof._controller is not None:
+    if (
+        metrics_on
+        or trace.enabled()
+        or xprof._controller is not None
+        or ledger.enabled()
+        or flight.installed()
+    ):
         return Instruments(metrics_on)
     return NULL_INSTRUMENTS
 
@@ -152,10 +229,30 @@ def session(cfg, run_dir):
             num_steps=ocfg.xprof_num_steps,
             trigger=ocfg.xprof_trigger,
         )
+    # device efficiency ledger + crash flight recorder
+    # (docs/efficiency.md): installed for the session so every AOT
+    # compile site and terminal path in this process reports; the flight
+    # recorder goes in FIRST so an enable-time failure still dumps
+    ledger_on = bool(getattr(ocfg, "ledger", False))
+    flight_on = bool(getattr(ocfg, "flight", False))
+    if flight_on:
+        flight.install(
+            Path(run_dir) / "postmortem.json",
+            max_steps=getattr(ocfg, "flight_steps", 64),
+            max_events=getattr(ocfg, "flight_events", 128),
+        )
+    if ledger_on:
+        ledger.enable(
+            ceilings=bool(getattr(ocfg, "ledger_ceilings", False))
+        )
     try:
         yield
     finally:
         xprof.uninstall_controller()
+        if ledger_on:
+            ledger.disable()
+        if flight_on:
+            flight.uninstall()
         if trace_dir is not None:
             trace.disable()
             try:

@@ -58,6 +58,21 @@ LOWER_IS_BETTER: dict[str, float] = {
     # fused GGNN per-step time (ISSUE 9; us/step, platform-resolved
     # kernel scatter) — a rise past tolerance is a hot-path regression
     "ggnn_step_us": 0.25,
+    # efficiency-ledger compile accounting (ISSUE 10): total AOT
+    # compile wall time per bench child — a rise past tolerance means
+    # the compiled programs got slower to build (or a site started
+    # recompiling). Generous: compile time is the noisiest metric on a
+    # shared compile service.
+    "compile_seconds_total": 1.0,
+    "train_compile_seconds_total": 1.0,
+}
+
+#: ABSOLUTE upper bounds, checked whenever the candidate carries the
+#: metric — no reference needed (the <=2% overhead contracts the PR-4
+#: obs measurement established, now also covering the ledger's per-step
+#: join). Exceeding one is a `regression`.
+ABSOLUTE_UPPER_BOUNDS: dict[str, float] = {
+    "obs_ledger_overhead_fraction": 0.02,
 }
 
 
@@ -219,6 +234,24 @@ def gate(
             f"expected platform {expect_platform!r}, record ran on "
             f"{platform!r}"
         )
+
+    for metric, bound in sorted(ABSOLUTE_UPPER_BOUNDS.items()):
+        v = record.get(metric)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        ok = v <= bound
+        checks.append({
+            "metric": metric,
+            "new": v,
+            "reference": bound,
+            "ref_source": "absolute_bound",
+            "tolerance": 0.0,
+            "direction": "bound",
+            "ratio": round(v / bound, 4) if bound else None,
+            "ok": ok,
+        })
+        if not ok and "regression" not in failure_classes:
+            failure_classes.append("regression")
 
     ref = reference_for(
         trajectory, platform, exclude_source=exclude_source

@@ -324,6 +324,97 @@ def scan_section(scan_records: list[dict]) -> dict:
     return out
 
 
+def efficiency_section(run_dir: Path, records: list[dict]) -> dict:
+    """The device efficiency view (obs/ledger.py, docs/efficiency.md),
+    rebuilt from the run's own artifacts: the newest embedded ledger
+    snapshot (epoch records, serve_log, scan_log — whichever is
+    freshest), plus the per-epoch HBM watermark timeline."""
+    snaps: list[dict] = []
+    timeline: list[dict] = []
+    for rec in records:
+        led = rec.get("ledger")
+        if isinstance(led, dict):
+            snaps.append(led)
+            mem = led.get("memory") or {}
+            epoch_mem = mem.get("epoch") or next(
+                iter(mem.values()), {}
+            )
+            if "epoch" in rec and epoch_mem:
+                timeline.append({
+                    "epoch": rec.get("epoch"),
+                    **{
+                        k: epoch_mem[k]
+                        for k in ("bytes_in_use", "peak_bytes_in_use")
+                        if k in epoch_mem
+                    },
+                })
+    for log in ("serve_log.jsonl", "scan_log.jsonl"):
+        for rec in _read_jsonl(run_dir / log):
+            if isinstance(rec.get("ledger"), dict):
+                snaps.append(rec["ledger"])
+    if not snaps:
+        return {}
+    newest = snaps[-1]
+    out: dict = {
+        "sites": newest.get("sites") or {},
+        "compile_seconds_total": newest.get("compile_seconds_total"),
+    }
+    for key in ("ceilings", "memory", "params", "errors"):
+        if newest.get(key):
+            out[key] = newest[key]
+    if timeline:
+        out["hbm_timeline"] = timeline
+    return out
+
+
+def load_postmortem(run_dir: Path) -> dict:
+    """postmortem.json summary (crash flight recorder, obs/flight.py),
+    validation verdict included — {} when the run never crashed."""
+    return postmortem_summary(run_dir / "postmortem.json")
+
+
+def postmortem_summary(path: str | Path) -> dict:
+    path = Path(path)
+    if not path.exists():
+        return {}
+    from deepdfa_tpu.obs import flight as obs_flight
+
+    # parse once: the dump embeds full metrics/ledger snapshots, so the
+    # validator runs on the already-parsed document
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return {"path": str(path), "valid": False,
+                "problems": [f"unreadable: {e}"]}
+    verdict = obs_flight.validate_postmortem(doc)
+    pm = doc.get("postmortem") if isinstance(doc, dict) else None
+    pm = pm if isinstance(pm, dict) else {}
+    out = {
+        "path": str(path),
+        "valid": verdict.get("ok", False),
+        "trigger": pm.get("trigger"),
+        "t_unix": pm.get("t_unix"),
+        "steps": len(pm.get("steps") or []),
+        "events": len(pm.get("events") or []),
+    }
+    steps = pm.get("steps") or []
+    if steps:
+        out["last_step"] = steps[-1].get("step")
+    events = pm.get("events") or []
+    if events:
+        out["last_events"] = [
+            {"name": e.get("name"), "cat": e.get("cat")}
+            for e in events[-5:]
+        ]
+    if pm.get("ledger"):
+        out["ledger_sites"] = len(
+            (pm["ledger"].get("sites") or {})
+        )
+    if verdict.get("problems"):
+        out["problems"] = verdict["problems"]
+    return out
+
+
 def bench_section(root: str | Path | None = None) -> dict:
     """The bench-trajectory section: every committed BENCH_r*/
     BENCH_TPU_* record's headline numbers plus the regression-gate
@@ -397,6 +488,8 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "serve": serve_attribution(serve_records),
         "slo": slo_section(serve_records),
         "scan": scan_section(load_scan_records(run_dir)),
+        "efficiency": efficiency_section(run_dir, records),
+        "postmortem": load_postmortem(run_dir),
         "bench": bench_section(bench_root),
     }
 
@@ -573,6 +666,75 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f"{scan.get('scan_lines_steady_state_recompiles')}\n"
             )
 
+    eff = report.get("efficiency") or {}
+    if eff:
+        w("\ndevice efficiency ledger (docs/efficiency.md):\n")
+        sites = eff.get("sites") or {}
+        if sites:
+            max_cs = max(
+                (s.get("compile_seconds", 0.0) for s in sites.values()),
+                default=0.0,
+            ) or 1.0
+            w(
+                f"  {'site':<28}{'compile_s':>10}{'gflops':>9}"
+                f"{'execs':>7}{'mfu':>10}\n"
+            )
+            for label in sorted(sites):
+                s = sites[label]
+                mfu = s.get("mfu_vs_measured_ceiling")
+                fps = s.get("flops_per_sec")
+                mfu_s = (
+                    f"{mfu:.4f}" if isinstance(mfu, (int, float))
+                    else (f"{fps / 1e9:.2f}G/s"
+                          if isinstance(fps, (int, float)) else "-")
+                )
+                w(
+                    f"  {label:<28}"
+                    f"{s.get('compile_seconds', 0.0):>10.3f}"
+                    f"{s.get('flops', 0.0) / 1e9:>9.3f}"
+                    f"{s.get('executions', 0):>7}"
+                    f"{mfu_s:>10}  "
+                    f"{_bar(s.get('compile_seconds', 0.0) / max_cs, 16)}\n"
+                )
+        if eff.get("compile_seconds_total") is not None:
+            w(
+                f"  compile_seconds_total="
+                f"{eff['compile_seconds_total']}\n"
+            )
+        params = eff.get("params") or {}
+        for tag, b in sorted(params.items()):
+            w(f"  params[{tag}] = {b / 1e6:.2f} MB\n")
+        tl = eff.get("hbm_timeline") or []
+        if tl:
+            peak = max(
+                (r.get("peak_bytes_in_use", 0.0) for r in tl),
+                default=0.0,
+            ) or 1.0
+            w("  HBM watermark timeline (peak bytes in use):\n")
+            for r in tl:
+                v = r.get("peak_bytes_in_use", 0.0)
+                w(
+                    f"    epoch {r.get('epoch'):>3}  "
+                    f"{_bar(v / peak, 20)} {v / 1e6:10.1f} MB\n"
+                )
+
+    pm = report.get("postmortem") or {}
+    if pm:
+        w("\npostmortem (crash flight recorder):\n")
+        w(
+            f"  trigger={pm.get('trigger')} valid={pm.get('valid')} "
+            f"steps={pm.get('steps')} events={pm.get('events')}"
+            + (
+                f" last_step={pm['last_step']}"
+                if "last_step" in pm else ""
+            )
+            + "\n"
+        )
+        for e in pm.get("last_events") or []:
+            w(f"    [{e.get('cat')}] {e.get('name')}\n")
+        for p in pm.get("problems") or []:
+            w(f"    PROBLEM: {p}\n")
+
     bench = report.get("bench") or {}
     if bench.get("trajectory"):
         w("\nbench trajectory (committed BENCH_* artifacts):\n")
@@ -638,20 +800,58 @@ def build_smoke_run(run_dir: Path) -> Path:
 
     from deepdfa_tpu.train.logging import RunLogger
 
+    from deepdfa_tpu.obs import ledger as obs_ledger
+
     run_dir = Path(run_dir)
     run_dir.mkdir(parents=True, exist_ok=True)
-    with RunLogger(run_dir, tensorboard=False) as lg:
-        for epoch in range(3):
-            lg.log({
-                "epoch": epoch, "train_loss": 0.9 - 0.2 * epoch,
-                "epoch_seconds": 2.0 + 0.5 * epoch,
-                "host_load_seconds": 0.1, "host_pack_seconds": 0.6,
-                "host_place_seconds": 0.2, "input_wait_seconds": 0.3,
-                "input_wait_fraction": 0.15,
-                "val_loss": 0.8 - 0.1 * epoch, "val_f1": 0.5 + 0.1 * epoch,
-                "resumed_from_step": 4 if epoch else 0,
-                "skipped_steps": epoch, "rollbacks": 0,
-            })
+    # an efficiency ledger through the REAL emitters (obs/ledger.py):
+    # fixture cost fields + injected ceilings/memory stats (the smoke is
+    # CPU-only by design; a real run records these from XLA/the
+    # allocator), snapshotted into each epoch record like
+    # Instruments.finish_epoch does
+    led = obs_ledger.enable(
+        ceilings={"matmul_flops_per_sec": 1e12,
+                  "gather_bytes_per_sec": 1e10}
+    )
+    try:
+        led.record_compile(
+            "train_step", "G4xN2048xE8192", None, 1.25,
+            flops=2.5e9, bytes_accessed=4.0e8, live_bytes=1.5e8,
+        )
+        led.record_compile(
+            "serve_score", "G2", None, 0.4,
+            flops=1.1e9, bytes_accessed=2.0e8,
+        )
+        import numpy as _np
+
+        led.record_params(
+            "deepdfa:smoke:best",
+            {"w": _np.zeros((25_000,), _np.float32)},
+        )
+        with RunLogger(run_dir, tensorboard=False) as lg:
+            for epoch in range(3):
+                led.observe_execution(
+                    "train_step", "G4xN2048xE8192", 0.5 + 0.1 * epoch,
+                    n=10,
+                )
+                led.record_memory("epoch", stats={
+                    "bytes_in_use": 1.0e8 + 2e7 * epoch,
+                    "peak_bytes_in_use": 1.5e8 + 3e7 * epoch,
+                })
+                lg.log({
+                    "epoch": epoch, "train_loss": 0.9 - 0.2 * epoch,
+                    "epoch_seconds": 2.0 + 0.5 * epoch,
+                    "host_load_seconds": 0.1, "host_pack_seconds": 0.6,
+                    "host_place_seconds": 0.2, "input_wait_seconds": 0.3,
+                    "input_wait_fraction": 0.15,
+                    "val_loss": 0.8 - 0.1 * epoch,
+                    "val_f1": 0.5 + 0.1 * epoch,
+                    "resumed_from_step": 4 if epoch else 0,
+                    "skipped_steps": epoch, "rollbacks": 0,
+                    "ledger": led.snapshot(),
+                })
+    finally:
+        obs_ledger.disable()
     tdir = run_dir / "trace"
     trace.enable(tdir, process_name="main")
     try:
@@ -749,6 +949,22 @@ def build_smoke_run(run_dir: Path) -> Path:
         "tag": "step-00000004", "step": 4, "epoch": 1,
         "batch_index": 1, "reason": "preempt",
     }))
+    # a postmortem through the REAL flight recorder (obs/flight.py):
+    # step + instant rings filled via the real note paths, dumped by the
+    # real writer — what `diag --postmortem` and the postmortem section
+    # render
+    from deepdfa_tpu.obs import flight as obs_flight
+
+    obs_flight.install(run_dir / "postmortem.json", max_steps=8)
+    try:
+        for s in range(12):
+            obs_flight.note_step(s)
+        trace.instant("train_stall", cat="resilience", stage="input")
+        obs_flight.crash_dump("watchdog_abort", extra={
+            "stalled_stage": "input",
+        })
+    finally:
+        obs_flight.uninstall()
     return run_dir
 
 
@@ -762,7 +978,32 @@ def main(argv=None) -> int:
                     help="emit the report as one JSON object")
     ap.add_argument("--smoke", action="store_true",
                     help="build + render a synthetic run dir (tier-1)")
+    ap.add_argument("--postmortem", default=None, metavar="PATH",
+                    help="render ONE postmortem.json (crash flight "
+                    "recorder dump) instead of a run dir")
     args = ap.parse_args(argv)
+
+    if args.postmortem:
+        pm = postmortem_summary(args.postmortem)
+        if not pm:
+            print(
+                f"no such postmortem: {args.postmortem}", file=sys.stderr
+            )
+            return 2
+        if args.json:
+            print(json.dumps({"postmortem": pm}))
+        else:
+            render_text({
+                "summary": {"run_dir": str(Path(args.postmortem).parent),
+                            "records": 0, "epochs": 0, "trace_events": 0},
+                "timeline": [],
+                "stage_attribution": {"from_records": {},
+                                      "from_trace": {}},
+                "resilience": {"events": [], "counters": {},
+                               "watchdog": []},
+                "postmortem": pm,
+            })
+        return 0 if pm.get("valid") else 1
 
     if args.smoke:
         import tempfile
@@ -779,6 +1020,8 @@ def main(argv=None) -> int:
             attr = report["stage_attribution"]
             slo = report.get("slo") or {}
             scan = report.get("scan") or {}
+            eff = report.get("efficiency") or {}
+            pm = report.get("postmortem") or {}
             ok = (
                 report["summary"]["epochs"] == 3
                 and report["summary"]["trace_events"] > 0
@@ -800,6 +1043,17 @@ def main(argv=None) -> int:
                 and scan.get("scan_incremental_skip_fraction") is not None
                 and scan.get("stage_seconds")
                 and scan.get("scans") == 2
+                # ISSUE 10 sections: the efficiency ledger (per-site
+                # MFU + compile bars + HBM watermark timeline) and the
+                # postmortem view, both from the real emitters
+                and "train_step/G4xN2048xE8192" in eff.get("sites", {})
+                and eff["sites"]["train_step/G4xN2048xE8192"].get(
+                    "mfu_vs_measured_ceiling"
+                ) is not None
+                and eff.get("hbm_timeline")
+                and pm.get("valid") is True
+                and pm.get("trigger") == "watchdog_abort"
+                and pm.get("steps") == 8  # ring bounded at max_steps
             )
             print(f"diag smoke {'OK' if ok else 'FAILED'}")
             return 0 if ok else 1

@@ -109,6 +109,16 @@ class BackendHealth:
             self._m_failures.inc()
             if wedged:
                 self._m_wedges.inc()
+                # a WEDGE is the r1-r5 terminal signature: dump the
+                # flight recorder (no-op unless installed) so "bench
+                # silently fell back to CPU" leaves a machine-readable
+                # artifact, not a log-tail anecdote
+                from deepdfa_tpu.obs import flight as obs_flight
+
+                obs_flight.crash_dump("backend_wedge", extra={
+                    "error": detail[:500], "attempt": attempts,
+                    "timeout_s": float(timeout_s),
+                })
             obs_trace.instant(
                 "backend_probe_failed", cat="backend",
                 error=detail[:200], wedged=wedged, attempt=attempts,

@@ -1,0 +1,507 @@
+"""Device efficiency ledger (docs/efficiency.md).
+
+The paper's headline claim is *efficiency* (Table 5: GFLOPs and
+ms-per-example per model), and the obs stack so far sees host stages and
+serving SLOs but is blind on-device: nothing in the runtime answers
+"what did each compiled executable cost, how full is HBM, and how close
+to the measured ceiling is each signature running". That knowledge lived
+in one-shot scripts (eval/profiling.py, scripts/bench_scatter.py). This
+module is the runtime half:
+
+- **one cost-analysis reader** — `read_cost_analysis(compiled)` is THE
+  jax list-vs-dict `Compiled.cost_analysis()` shim (jax <= 0.4.x returns
+  a one-entry list; newer jax the dict). `eval/profiling.py:
+  compiled_cost` is a thin client, so Table-5 profiling and runtime
+  accounting cannot drift.
+- **per-signature efficiency sites** — every AOT `lower()->compile()`
+  in the stack (GraphTrainer/CombinedTrainer step caches, the
+  `GgnnExecutor`/`CombinedExecutor` warmup ladders, `GgnnLocalizer`)
+  reports `record_compile(tag, signature, compiled, seconds)`:
+  XLA-exact flops + bytes, compile wall time, and the executable's
+  memory-analysis live bytes. Executions report
+  `observe_execution(tag, signature, seconds)` (the serve batcher per
+  batch; the train loops via the PR-4 sync-free `StepTimer` join —
+  `set_step_site` + `observe_step_seconds`), so the snapshot derives a
+  ROLLING per-signature FLOP/s and, when measured ceilings are present,
+  the roofline position (`mfu_vs_measured_ceiling`,
+  `bytes_vs_gather_ceiling` — the docs/roofline.md method, generalized
+  from scripts/bench_scatter.py into the runtime).
+- **HBM memory ledger** — `record_memory(phase)` keeps per-phase
+  allocator watermarks (xprof.device_memory_stats), and
+  `record_params(tag, params)` the per-registry-entry parameter bytes
+  (the ROADMAP item-3/item-5 co-serving capacity signal).
+- **OOM forensics** — `is_oom(exc)` recognizes RESOURCE_EXHAUSTED, and
+  the flight recorder (obs/flight.py) dumps the ledger into
+  postmortem.json when one escapes.
+
+Everything is default OFF (`cfg.obs.ledger`): the module-level wrappers
+are one `is None` check when disabled, no call site pays anything, and
+no program signature is added — the ledger only *reads* executables the
+stack already compiles. The one exception is opt-in and documented:
+with the ledger ON, GraphTrainer AOT-compiles its (already jitted) step
+once per signature to read the cost analysis (jit's call cache is not
+seeded by `.lower().compile()`, so this is a second compile of the SAME
+program — warmup cost only, never steady-state).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+#: bump when the snapshot / postmortem "ledger" section shape changes
+LEDGER_VERSION = 1
+
+_ledger: "EfficiencyLedger | None" = None
+_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# the ONE cost-analysis reader (eval/profiling.compiled_cost is a client)
+
+
+def read_cost_analysis(compiled) -> dict:
+    """XLA cost analysis of a Compiled executable, normalized:
+    {"flops", "bytes_accessed", "cost_analysis": {numeric fields}}.
+
+    THE list-vs-dict shim: jax <= 0.4.x returns a one-entry list of
+    per-executable dicts from `Compiled.cost_analysis()`; newer jax
+    returns the dict directly. Every consumer (Table-5 profiling,
+    bench.py MFU fields, this ledger) reads through here."""
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "cost_analysis": {
+            k: v for k, v in cost.items() if isinstance(v, (int, float))
+        },
+    }
+
+
+def executable_memory(compiled) -> dict:
+    """Numeric fields of `Compiled.memory_analysis()` ({} where the
+    backend does not implement it), plus a derived `live_bytes` total
+    (arguments + outputs + temps + generated code, aliasing credited) —
+    the executable's device-memory footprint the HBM ledger tracks."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if mem is None:
+        return {}
+    out: dict = {}
+    for name in dir(mem):
+        if name.startswith("_"):
+            continue
+        try:
+            v = getattr(mem, name)
+        except Exception:
+            continue
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[name] = float(v)
+    live = 0.0
+    for k in (
+        "argument_size_in_bytes", "output_size_in_bytes",
+        "temp_size_in_bytes", "generated_code_size_in_bytes",
+    ):
+        live += out.get(k, 0.0)
+    live -= out.get("alias_size_in_bytes", 0.0)
+    if live > 0:
+        out["live_bytes"] = live
+    return out
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does an exception look like a device out-of-memory? XLA surfaces
+    OOM as RESOURCE_EXHAUSTED (XlaRuntimeError); the allocator's own
+    message spells it out. The flight recorder uses this to classify a
+    crash as trigger="oom" and dump the HBM ledger with it."""
+    text = f"{type(exc).__name__}: {exc}"
+    return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+
+
+def _new_site() -> dict:
+    return {
+        "flops": 0.0,
+        "bytes_accessed": 0.0,
+        "compile_seconds": 0.0,
+        "compiles": 0,
+        "live_bytes": 0.0,
+        "executions": 0,
+        "device_seconds": 0.0,
+    }
+
+
+class EfficiencyLedger:
+    """Per-(tag, signature) compile + execution accounting for one
+    process. Host-side only: it never traces, lowers, or compiles on its
+    own — call sites hand it executables they already built."""
+
+    def __init__(self, registry: obs_metrics.MetricsRegistry | None = None):
+        self._r = registry if registry is not None else obs_metrics.REGISTRY
+        self._lk = threading.Lock()
+        self._sites: dict[tuple[str, str], dict] = {}
+        self._memory: dict[str, dict[str, float]] = {}
+        self._params: dict[str, float] = {}
+        #: measured ceilings (matmul FLOP/s, gather bytes/s) the rolling
+        #: MFU/roofline fields are read against; {} = raw FLOP/s only
+        self.ceilings: dict[str, float] = {}
+        self.errors: list[str] = []
+        self.created_unix = time.time()
+
+    # -- compile side --------------------------------------------------------
+
+    def record_compile(
+        self,
+        tag: str,
+        signature: str,
+        compiled=None,
+        seconds: float = 0.0,
+        flops: float | None = None,
+        bytes_accessed: float | None = None,
+        live_bytes: float | None = None,
+    ) -> None:
+        """One lower()->compile() at an AOT site. `compiled` (when
+        given) supplies XLA-exact flops/bytes + live bytes through the
+        one reader above; the explicit kwargs exist for fixtures and for
+        lazy jit compiles where only the wall time is known."""
+        cost: dict = {}
+        mem: dict = {}
+        if compiled is not None:
+            try:
+                cost = read_cost_analysis(compiled)
+            except Exception as e:  # accounting must never cost the run
+                self._note_error(f"cost_analysis[{tag}/{signature}]: {e}")
+            mem = executable_memory(compiled)
+        with self._lk:
+            site = self._sites.setdefault((tag, signature), _new_site())
+            site["compiles"] += 1
+            site["compile_seconds"] += float(seconds)
+            f = flops if flops is not None else cost.get("flops", 0.0)
+            b = (
+                bytes_accessed if bytes_accessed is not None
+                else cost.get("bytes_accessed", 0.0)
+            )
+            lv = (
+                live_bytes if live_bytes is not None
+                else mem.get("live_bytes", 0.0)
+            )
+            if f:
+                site["flops"] = float(f)
+            if b:
+                site["bytes_accessed"] = float(b)
+            if lv:
+                site["live_bytes"] = float(lv)
+        base = f"ledger/{tag}/{signature}"
+        self._r.counter(f"{base}/compiles").inc()
+        self._r.counter(f"{base}/compile_seconds").inc(float(seconds))
+        self._r.counter("ledger/compile_seconds_total").inc(float(seconds))
+        if f:
+            self._r.gauge(f"{base}/flops").set(float(f))
+        if b:
+            self._r.gauge(f"{base}/bytes_accessed").set(float(b))
+        if lv:
+            self._r.gauge(f"{base}/live_bytes").set(float(lv))
+
+    def has_site(self, tag: str, signature: str) -> bool:
+        with self._lk:
+            return (tag, signature) in self._sites
+
+    # -- execution side ------------------------------------------------------
+
+    def observe_execution(
+        self, tag: str, signature: str, seconds: float, n: int = 1
+    ) -> None:
+        """`n` executions of a signature took `seconds` of measured
+        device(-paced) time — the join that turns static cost analysis
+        into rolling FLOP/s. Hot-path cost: one lock + three adds."""
+        if not (seconds > 0.0) or not math.isfinite(seconds):
+            return
+        with self._lk:
+            site = self._sites.setdefault((tag, signature), _new_site())
+            site["executions"] += int(n)
+            site["device_seconds"] += float(seconds)
+
+    #: the train loops run ONE signature at a time; the StepTimer join
+    #: routes its lagged step seconds to whatever site the loop declared
+    def set_step_site(self, tag: str, signature: str) -> None:
+        with self._lk:
+            self._step_site = (tag, signature)
+
+    _step_site: tuple[str, str] | None = None
+
+    def observe_step_seconds(self, seconds: float) -> None:
+        site = self._step_site
+        if site is not None:
+            self.observe_execution(site[0], site[1], seconds)
+
+    # -- HBM side ------------------------------------------------------------
+
+    def record_memory(self, phase: str, stats: dict | None = None) -> None:
+        """Fold the current allocator stats into the `phase` watermark
+        (max-merge, so the phase keeps its peak). CPU backends report no
+        stats and the phase is simply absent; `stats` is injectable for
+        tests and fixtures."""
+        if stats is None:
+            from deepdfa_tpu.obs import xprof
+
+            stats = xprof.device_memory_stats()
+        if not stats:
+            return
+        with self._lk:
+            mark = self._memory.setdefault(phase, {})
+            for k, v in stats.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    mark[k] = max(mark.get(k, -math.inf), float(v))
+        for k, v in stats.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self._r.gauge(f"ledger/memory/{phase}/{k}").set(float(v))
+
+    def record_params(self, tag: str, params) -> float:
+        """Parameter bytes of one registry entry / model — the
+        co-serving capacity signal (how many entries fit one chip's
+        HBM). Returns the byte count."""
+        import numpy as np
+
+        total = 0.0
+        try:
+            import jax
+
+            leaves = jax.tree.leaves(params)
+        except Exception:
+            leaves = []
+        for leaf in leaves:
+            try:
+                total += float(
+                    np.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+                )
+            except Exception:
+                continue
+        with self._lk:
+            self._params[tag] = total
+        self._r.gauge(f"ledger/params/{tag}/bytes").set(total)
+        return total
+
+    # -- derived views -------------------------------------------------------
+
+    def _site_view(self, site: dict) -> dict:
+        out = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in site.items()
+        }
+        secs = site["device_seconds"]
+        if secs > 0 and site["executions"]:
+            fps = site["flops"] * site["executions"] / secs
+            bps = site["bytes_accessed"] * site["executions"] / secs
+            if site["flops"]:
+                out["flops_per_sec"] = round(fps, 1)
+            if site["bytes_accessed"]:
+                out["bytes_per_sec"] = round(bps, 1)
+            ceil_f = self.ceilings.get("matmul_flops_per_sec", 0.0)
+            if site["flops"] and ceil_f > 0:
+                out["mfu_vs_measured_ceiling"] = round(fps / ceil_f, 6)
+            ceil_b = self.ceilings.get("gather_bytes_per_sec", 0.0)
+            if site["bytes_accessed"] and ceil_b > 0:
+                out["bytes_vs_gather_ceiling"] = round(bps / ceil_b, 6)
+        return out
+
+    def snapshot(self) -> dict:
+        """The whole ledger as one JSON-able dict — what epoch records,
+        /stats, serve/scan log records, and the postmortem embed
+        (flattens to SCHEMA-declared `ledger/*` tags)."""
+        with self._lk:
+            sites = {
+                f"{tag}/{sig}": dict(site)
+                for (tag, sig), site in self._sites.items()
+            }
+            memory = {p: dict(m) for p, m in self._memory.items()}
+            params = dict(self._params)
+        out: dict = {
+            "version": LEDGER_VERSION,
+            "sites": {
+                label: self._site_view(site)
+                for label, site in sites.items()
+            },
+            "compile_seconds_total": round(
+                sum(s["compile_seconds"] for s in sites.values()), 3
+            ),
+        }
+        if self.ceilings:
+            out["ceilings"] = {
+                k: v for k, v in self.ceilings.items()
+                if isinstance(v, (int, float))
+            }
+        if memory:
+            out["memory"] = memory
+        if params:
+            out["params"] = params
+        if self.errors:
+            out["errors"] = list(self.errors)
+        return out
+
+    def publish_gauges(self) -> None:
+        """Mirror the derived per-site MFU/throughput into `ledger/*`
+        gauges so a `/metrics` scrape carries the rolling roofline
+        position, not only the static compile-time fields."""
+        with self._lk:
+            sites = {
+                f"{tag}/{sig}": dict(site)
+                for (tag, sig), site in self._sites.items()
+            }
+        for label, site in sites.items():
+            view = self._site_view(site)
+            for k in (
+                "flops_per_sec", "bytes_per_sec",
+                "mfu_vs_measured_ceiling", "bytes_vs_gather_ceiling",
+                "device_seconds", "executions",
+            ):
+                if k in view and isinstance(view[k], (int, float)):
+                    self._r.gauge(f"ledger/{label}/{k}").set(
+                        float(view[k])
+                    )
+
+    def mfu_record(self) -> dict:
+        """Bench stamping view: {"ledger_mfu": {site: mfu-or-flops/s},
+        "compile_seconds_total": ...} — the fields BENCH_*.json records
+        carry (declared in obs/metrics.py:SCHEMA, gated in
+        obs/bench_gate.py)."""
+        snap = self.snapshot()
+        mfu: dict[str, float] = {}
+        for label, view in snap["sites"].items():
+            v = view.get("mfu_vs_measured_ceiling")
+            if v is None:
+                v = view.get("flops_per_sec")
+            if isinstance(v, (int, float)):
+                mfu[label] = v
+        out: dict = {"compile_seconds_total": snap["compile_seconds_total"]}
+        if mfu:
+            out["ledger_mfu"] = mfu
+        return out
+
+    def _note_error(self, msg: str) -> None:
+        with self._lk:
+            if len(self.errors) < 16:
+                self.errors.append(str(msg)[:200])
+
+
+# ---------------------------------------------------------------------------
+# measured runtime ceilings (docs/roofline.md, generalized into the runtime)
+
+
+def measure_runtime_ceilings() -> dict[str, float]:
+    """Small-size measured-ceiling probes for the RUNTIME ledger: the
+    same docs/roofline.md method bench_scatter uses (dense-matmul FLOP/s
+    + gather/segment-sum bytes/s on the CURRENT device, same window),
+    sized to cost ~a second so enabling the ledger on a training run is
+    cheap. Same contemporaneous-point-sample caveat as the bench probes:
+    on a time-shared chip the ceiling moves, so treat ratios > 1 as "the
+    probe sampled a slower window", not as broken accounting."""
+    from deepdfa_tpu.eval import profiling
+
+    out: dict[str, float] = {}
+    try:
+        m = profiling.measure_matmul_ceiling(n=1024, chain=2, reps=1)
+        out["matmul_flops_per_sec"] = m["matmul_tflops_measured"] * 1e12
+    except Exception:
+        pass
+    try:
+        g = profiling.measure_gather_bandwidth(
+            rows=2048, dim=64, idx_len=8192, chain=2, reps=1
+        )
+        out["gather_bytes_per_sec"] = g["gather_gbps_measured"] * 1e9
+    except Exception:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module surface (what every call site uses; no-ops when disabled)
+
+
+def enable(
+    ceilings: bool | dict = False,
+    registry: obs_metrics.MetricsRegistry | None = None,
+) -> EfficiencyLedger:
+    """Install the process ledger. `ceilings=True` runs the runtime
+    measured-ceiling probes once (so per-site MFU is vs the measured
+    ceiling, docs/roofline.md); a dict injects ceilings directly
+    (tests, fixtures)."""
+    global _ledger
+    with _lock:
+        led = EfficiencyLedger(registry=registry)
+        if isinstance(ceilings, dict):
+            led.ceilings = dict(ceilings)
+        _ledger = led
+    if ceilings is True:
+        led.ceilings = measure_runtime_ceilings()
+    return led
+
+
+def disable() -> None:
+    global _ledger
+    with _lock:
+        _ledger = None
+
+
+def get() -> EfficiencyLedger | None:
+    return _ledger
+
+
+def enabled() -> bool:
+    return _ledger is not None
+
+
+def record_compile(tag, signature, compiled=None, seconds=0.0, **kw) -> None:
+    led = _ledger
+    if led is not None:
+        led.record_compile(tag, signature, compiled, seconds, **kw)
+
+
+def observe_execution(tag, signature, seconds, n: int = 1) -> None:
+    led = _ledger
+    if led is not None:
+        led.observe_execution(tag, signature, seconds, n=n)
+
+
+def set_step_site(tag, signature) -> None:
+    led = _ledger
+    if led is not None:
+        led.set_step_site(tag, signature)
+
+
+def observe_step_seconds(seconds: float) -> None:
+    led = _ledger
+    if led is not None:
+        led.observe_step_seconds(seconds)
+
+
+def record_memory(phase: str, stats: dict | None = None) -> None:
+    led = _ledger
+    if led is not None:
+        led.record_memory(phase, stats=stats)
+
+
+def record_params(tag: str, params) -> None:
+    led = _ledger
+    if led is not None:
+        led.record_params(tag, params)
+
+
+def publish_gauges() -> None:
+    led = _ledger
+    if led is not None:
+        led.publish_gauges()
+
+
+def snapshot_or_none() -> dict | None:
+    led = _ledger
+    return led.snapshot() if led is not None else None

@@ -261,6 +261,25 @@ SCHEMA: tuple[str, ...] = (
     # the measured ceiling in the run log next to the throughput it
     # defends (docs/roofline.md, docs/ggnn_kernel.md)
     "roofline/*",
+    # device efficiency ledger (obs/ledger.py, docs/efficiency.md):
+    # per-(tag, signature) cost-analysis flops/bytes/live-bytes,
+    # compile counters, rolling MFU/roofline gauges, per-phase HBM
+    # watermarks, per-registry-entry param bytes — tag/signature labels
+    # are data-dependent, so this is a reviewed wildcard (like
+    # obs/compile/signatures/*); the embedded epoch/serve/scan record
+    # section flattens under the same prefix
+    "ledger/*",
+    # crash flight recorder (obs/flight.py): postmortem dump counters,
+    # keyed by trigger
+    "flight/*",
+    # bench-record ledger stamps (bench.py, gated in obs/bench_gate.py):
+    # per-site MFU-vs-measured-ceiling map, total AOT compile wall time
+    # (lower is better), and the interleaved-reps ledger overhead bound;
+    # the train child's stamps carry a train_ prefix so the merged
+    # record keeps both children's accounting
+    "ledger_mfu/*", "compile_seconds_total",
+    "train_ledger_mfu/*", "train_compile_seconds_total",
+    "obs_ledger_overhead_fraction",
 )
 
 

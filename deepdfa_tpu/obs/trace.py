@@ -89,6 +89,18 @@ _tracer: "Tracer | None" = None
 _env_checked = False
 _init_lock = threading.Lock()
 
+#: optional mirror for instant() events — the flight recorder
+#: (obs/flight.py) subscribes here so resilience/backend instants reach
+#: its bounded ring WHETHER OR NOT tracing is enabled. Instants are rare
+#: (stalls, rollbacks, probes), so the extra call costs nothing on the
+#: span hot path; when no mirror is set this is one module-global check.
+_instant_mirror = None
+
+
+def set_instant_mirror(fn) -> None:
+    global _instant_mirror
+    _instant_mirror = fn
+
 _tls = threading.local()
 
 
@@ -293,6 +305,11 @@ def span(name: str, cat: str = "app", **args):
 
 def instant(name: str, cat: str = "app", **args) -> None:
     """A point event (ph="i") — stalls, rollbacks, resume markers."""
+    if _instant_mirror is not None:
+        try:
+            _instant_mirror(name, cat, dict(args) if args else None)
+        except Exception:  # the mirror must never cost the event
+            pass
     t = _tracer or _lazy_init()
     if t is None:
         return

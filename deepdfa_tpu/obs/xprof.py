@@ -179,11 +179,15 @@ class StepTimer:
     bottleneck at the measured moment (the complement of
     input_wait_fraction, which indicts the host)."""
 
-    def __init__(self, lag: int = 1, registry=None):
+    def __init__(self, lag: int = 1, registry=None, on_step_seconds=None):
         self.lag = max(0, int(lag))
         self._r = registry if registry is not None else metrics.REGISTRY
         self._pending: deque = deque()
         self._last_done: float | None = None
+        #: optional consumer of each measured device-paced step second —
+        #: the efficiency ledger's per-signature MFU join
+        #: (obs/ledger.py:observe_step_seconds); None = metrics only
+        self._on_step_seconds = on_step_seconds
 
     def dispatched(self, handle, dispatch_seconds: float | None = None) -> None:
         import jax
@@ -202,9 +206,10 @@ class StepTimer:
         done = time.perf_counter()
         self._r.histogram("obs/step/fetch_wait_seconds").observe(done - t0)
         if self._last_done is not None:
-            self._r.histogram("obs/step/seconds").observe(
-                done - self._last_done
-            )
+            step_s = done - self._last_done
+            self._r.histogram("obs/step/seconds").observe(step_s)
+            if self._on_step_seconds is not None:
+                self._on_step_seconds(step_s)
         self._last_done = done
         if trace.enabled():
             # reconstruct the device window in the merged timeline: from

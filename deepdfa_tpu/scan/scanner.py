@@ -362,6 +362,13 @@ class RepoScanner:
             }
             if sub:
                 record[section] = sub
+        from deepdfa_tpu.obs import ledger as obs_ledger
+
+        led = obs_ledger.snapshot_or_none()
+        if led is not None:
+            # device efficiency view (docs/efficiency.md): the scan's
+            # executable costs + rolling MFU ride the scan log record
+            record["ledger"] = led
         write_scan_log(run_dir, [record])
         return summary
 
@@ -433,6 +440,10 @@ def run_scan_smoke(extra_overrides=None, **smoke_kw) -> dict:
             "scan.threshold=0.0",
             "scan.max_file_kb=64",
             "obs.trace=true",
+            # efficiency ledger + flight recorder (docs/efficiency.md):
+            # the scan smoke also proves the postmortem dump path
+            "obs.ledger=true",
+            "obs.flight=true",
             # caller overrides last so `scan --smoke --override ...`
             # can flip any knob (e.g. model.ggnn_kernel) end to end
             *(extra_overrides or []),
@@ -459,8 +470,20 @@ def run_scan_smoke(extra_overrides=None, **smoke_kw) -> dict:
             sarif_results = len(sarif_doc["runs"][0]["results"])
             edited_file, edited_fn = _edit_one_function(repo)
             incr = scanner.scan(repo)
+            from deepdfa_tpu.obs import flight as obs_flight
+
+            postmortem_path = obs_flight.crash_dump(
+                "smoke_test", extra={"reason": "scan-smoke validation"}
+            )
         finally:
             service.close()
+    from deepdfa_tpu.obs import flight as obs_flight
+
+    postmortem = (
+        obs_flight.validate_postmortem_file(postmortem_path)
+        if postmortem_path is not None
+        else {"ok": False, "problems": ["no postmortem dumped"]}
+    )
     with_lines = sum(1 for f in findings if f.get("lines"))
     return {
         "cold": cold,
@@ -472,6 +495,7 @@ def run_scan_smoke(extra_overrides=None, **smoke_kw) -> dict:
         "sarif_results": sarif_results,
         "edited_file": edited_file,
         "edited_function": edited_fn,
+        "postmortem": postmortem,
         "run_dir": str(run_dir),
         "repo": str(repo),
         "scan_log": str(run_dir / "scan_log.jsonl"),

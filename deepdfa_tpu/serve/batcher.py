@@ -36,7 +36,12 @@ from typing import Any, Callable, Hashable, Sequence
 
 import numpy as np
 
-from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.obs import (
+    flight as obs_flight,
+    ledger as obs_ledger,
+    metrics as obs_metrics,
+    trace as obs_trace,
+)
 from deepdfa_tpu.obs.slo import percentile  # noqa: F401 - canonical rule,
 # re-exported here because serve callers historically import it from the
 # batcher (obs/slo.py owns it now so /metrics shares the convention)
@@ -158,6 +163,9 @@ class GgnnExecutor:
         self._compiled: dict[int, Any] = {}
         self._lowerings = 0
 
+    #: efficiency-ledger site for this executor's compiles/executions
+    ledger_tag = "serve_score"
+
     # -- grouping ------------------------------------------------------------
 
     def admit(self, spec) -> None:
@@ -225,7 +233,11 @@ class GgnnExecutor:
             dt = time.perf_counter() - t0
             self._lowerings += 1
             obs_metrics.REGISTRY.counter("serve/compiles").inc()
+            obs_ledger.record_compile(
+                self.ledger_tag, f"G{size}", self._compiled[size], dt
+            )
             report[f"G{size}"] = round(dt, 3)
+        obs_ledger.record_memory("warmup")
         return report
 
     def jit_lowerings(self) -> int:
@@ -242,6 +254,7 @@ class GgnnExecutor:
 
         from deepdfa_tpu.graphs.batch import pack
 
+        t0 = time.perf_counter()
         size = self._size_for(len(chunk))
         batch = pack(
             list(chunk), size, self.node_budget, self.edge_budget,
@@ -250,7 +263,13 @@ class GgnnExecutor:
         batch = jax.device_put(batch)
         fn = self._compiled.get(size, self._score_jit)
         probs = fn(self.params_fn(), batch)
-        return np.asarray(jax.device_get(probs))[: len(chunk)]
+        out = np.asarray(jax.device_get(probs))[: len(chunk)]
+        # rolling-MFU join (obs/ledger.py): the fetch above synced, so
+        # this window is the executable's measured pack+H2D+execute time
+        obs_ledger.observe_execution(
+            self.ledger_tag, f"G{size}", time.perf_counter() - t0
+        )
+        return out
 
 
 class CombinedExecutor:
@@ -315,6 +334,12 @@ class CombinedExecutor:
         self._score_jit = jax.jit(score)
         self._compiled: dict[int, Any] = {}
         self._lowerings = 0
+
+    ledger_tag = "serve_combined"
+
+    def ledger_signature(self, key: Hashable, n: int) -> str:
+        T = int(key)
+        return f"T{T}xR{self._rows[T]}"
 
     # payload: (token_ids [T0] np.int32, GraphSpec | None)
 
@@ -413,7 +438,12 @@ class CombinedExecutor:
             dt = time.perf_counter() - t0
             self._lowerings += 1
             obs_metrics.REGISTRY.counter("serve/compiles").inc()
+            obs_ledger.record_compile(
+                self.ledger_tag, f"T{T}xR{self._rows[T]}",
+                self._compiled[T], dt,
+            )
             report[f"T{T}xR{self._rows[T]}"] = round(dt, 3)
+        obs_ledger.record_memory("warmup")
         return report
 
     def jit_lowerings(self) -> int:
@@ -422,10 +452,16 @@ class CombinedExecutor:
     def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
         import jax
 
+        t0 = time.perf_counter()
         batch = jax.device_put(self._collate(int(key), chunk))
         fn = self._compiled.get(int(key), self._score_jit)
         probs = fn(self.params_fn(), batch)
-        return np.asarray(jax.device_get(probs))[: len(chunk)]
+        out = np.asarray(jax.device_get(probs))[: len(chunk)]
+        obs_ledger.observe_execution(
+            self.ledger_tag, self.ledger_signature(key, len(chunk)),
+            time.perf_counter() - t0,
+        )
+        return out
 
 
 class DynamicBatcher:
@@ -628,6 +664,11 @@ class DynamicBatcher:
                     key, [r.payload for r in chunk]
                 )
         except Exception as e:
+            # a batch that died with RESOURCE_EXHAUSTED is exactly the
+            # moment the HBM ledger exists for: dump a postmortem (no-op
+            # unless the flight recorder is installed) before the error
+            # fans out to the requests
+            obs_flight.note_exception(e, where="serve_batch")
             for req in chunk:
                 req.set_error(e)
             return

@@ -190,7 +190,11 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
     healthz probes the backend, and per-request entries land in
     serve_log.jsonl for the diag SLO section."""
     from deepdfa_tpu import obs
-    from deepdfa_tpu.obs import trace as obs_trace
+    from deepdfa_tpu.obs import (
+        flight as obs_flight,
+        ledger as obs_ledger,
+        trace as obs_trace,
+    )
     from deepdfa_tpu.serve.registry import ModelRegistry
     from deepdfa_tpu.serve.server import (
         BackgroundServer,
@@ -202,6 +206,12 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
         extra_overrides=[
             "serve.request_log=true",
             "obs.trace=true",
+            # the efficiency ledger + flight recorder ride the smoke
+            # (docs/efficiency.md): every warmup compile is cost-
+            # accounted, /metrics carries ledger/* families, and a
+            # validation dump proves the postmortem path end to end
+            "obs.ledger=true",
+            "obs.flight=true",
             # line-level localization rides the smoke too (ISSUE 8):
             # the attribution ladder AOT-warms next to the score ladder
             # and one request opts into {"lines": true}
@@ -258,8 +268,21 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
                 service.steady_state_recompiles()
             )
             write_serve_log(run_dir, [record])
+            ledger_snap = obs_ledger.snapshot_or_none() or {}
+            # the flight-recorder validation dump: a real postmortem
+            # written by the serving process (with its warmup ledger
+            # and request history on board), validated below by the
+            # same checker `check_obs_schema.py --postmortem` runs
+            postmortem_path = obs_flight.crash_dump(
+                "smoke_test", extra={"reason": "serve-smoke validation"}
+            )
         finally:
             server.close()
+    postmortem = (
+        obs_flight.validate_postmortem_file(postmortem_path)
+        if postmortem_path is not None
+        else {"ok": False, "problems": ["no postmortem dumped"]}
+    )
     # the session is closed: per-process trace files are flushed and the
     # merged trace.json is written — verify one scored request's spans
     # are flow-linked under its request_id (the acceptance criterion)
@@ -298,6 +321,14 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
         "metrics_path": str(run_dir / "metrics.prom"),
         "trace_flow_phases": flow_phases,
         "trace_linked_spans": linked_spans,
+        # device efficiency + forensics (docs/efficiency.md): the smoke
+        # asserts warmup compiles were cost-accounted and the dumped
+        # postmortem is schema-valid
+        "ledger_sites": sorted((ledger_snap.get("sites") or {})),
+        "ledger_compile_seconds_total": ledger_snap.get(
+            "compile_seconds_total"
+        ),
+        "postmortem": postmortem,
         "steady_state_recompiles": (
             service.steady_state_recompiles()
         ),

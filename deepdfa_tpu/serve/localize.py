@@ -25,7 +25,11 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
-from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.obs import (
+    ledger as obs_ledger,
+    metrics as obs_metrics,
+    trace as obs_trace,
+)
 from deepdfa_tpu.serve.frontend import Features
 
 
@@ -108,7 +112,11 @@ class GgnnLocalizer:
             dt = time.perf_counter() - t0
             self._lowerings += 1
             obs_metrics.REGISTRY.counter("localize/compiles").inc()
+            obs_ledger.record_compile(
+                "localize", f"L{size}", self._compiled[size], dt
+            )
             report[f"L{size}"] = round(dt, 3)
+        obs_ledger.record_memory("warmup")
         return report
 
     def jit_lowerings(self) -> int:
@@ -177,7 +185,9 @@ class GgnnLocalizer:
             off += n
         self._m_requests.inc(len(feats_list))
         self._m_batches.inc()
-        self._m_seconds.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._m_seconds.observe(dt)
+        obs_ledger.observe_execution("localize", f"L{size}", dt)
         return out
 
     def attribute_all(

@@ -285,6 +285,19 @@ class ModelRegistry:
                 ) from e
             raise RegistryError(str(e)) from e
 
+    def _ledger_params(self) -> None:
+        """Per-registry-entry parameter bytes into the HBM ledger (the
+        co-serving capacity signal: how many entries fit one chip) —
+        no-op unless the efficiency ledger is on."""
+        from deepdfa_tpu.obs import ledger as obs_ledger
+
+        if obs_ledger.enabled():
+            obs_ledger.record_params(
+                f"{self.family}:{self.run_dir.name}:{self.checkpoint}",
+                self._params,
+            )
+            obs_ledger.record_memory("registry_load")
+
     def _load_initial(self) -> None:
         import jax
 
@@ -294,6 +307,7 @@ class ModelRegistry:
             self._params = jax.device_put(params)
             self._loaded_manifest_sig = sig
             self._loaded_step = sig[0] if sig else None
+        self._ledger_params()
 
     # -- serving surface -----------------------------------------------------
 
@@ -345,6 +359,7 @@ class ModelRegistry:
                 self._params = jax.device_put(params)
                 self._loaded_manifest_sig = sig
                 self._loaded_step = sig[0]
+            self._ledger_params()
             self.reloads += 1
             from deepdfa_tpu.obs import metrics as obs_metrics
 

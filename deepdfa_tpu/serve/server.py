@@ -39,6 +39,7 @@ from typing import Any
 
 from deepdfa_tpu.obs import (
     health as obs_health,
+    ledger as obs_ledger,
     metrics as obs_metrics,
     slo as obs_slo,
     trace as obs_trace,
@@ -296,12 +297,20 @@ class ScoringService:
             if k.startswith("serve/")
         }
         out["slo"] = self.slo.snapshot()
+        led = obs_ledger.snapshot_or_none()
+        if led is not None:
+            # the device efficiency view (docs/efficiency.md): per-
+            # signature compiled cost, rolling MFU, HBM watermarks
+            out["ledger"] = led
         return out
 
     def metrics_text(self) -> str:
         """The `/metrics` body: the process-wide registry + the rolling
         SLO windows, one Prometheus text exposition
-        (scripts/check_obs_schema.py --metrics validates it)."""
+        (scripts/check_obs_schema.py --metrics validates it). The
+        efficiency ledger refreshes its derived `ledger/*` gauges
+        (rolling MFU / roofline position) right before the scrape."""
+        obs_ledger.publish_gauges()
         return obs_slo.registry_exposition() + self.slo.exposition()
 
     def serve_record(self) -> dict:
@@ -324,6 +333,9 @@ class ScoringService:
         }
         if backend:
             record["backend"] = backend
+        led = obs_ledger.snapshot_or_none()
+        if led is not None:
+            record["ledger"] = led
         return record
 
     def start(self) -> None:

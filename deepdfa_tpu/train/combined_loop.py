@@ -398,9 +398,19 @@ class CombinedTrainer:
                 t0 = time.perf_counter()
                 out = entry.train(*args)
                 if entry.train_jit._cache_size() > n0:
+                    dt = time.perf_counter() - t0
                     entry.stats["compiles"] += 1
-                    entry.stats["compile_seconds"] += (
-                        time.perf_counter() - t0
+                    entry.stats["compile_seconds"] += dt
+                    # a lazy compile has no reachable Compiled object:
+                    # the ledger books the wall time under the signature
+                    # (cost fields arrive if the signature is ever
+                    # warmup'd)
+                    from deepdfa_tpu.obs import ledger as obs_ledger
+
+                    obs_ledger.record_compile(
+                        "combined_train",
+                        self._sig_label(self._signature(batch)),
+                        None, dt,
                     )
                 else:
                     entry.train_compiled = True
@@ -558,6 +568,13 @@ class CombinedTrainer:
             entry.aot = True
             entry.stats["compiles"] += 1
             entry.stats["compile_seconds"] += dt
+            # efficiency ledger (docs/efficiency.md): the warmup'd AOT
+            # executable's XLA-exact cost analysis + compile wall time
+            from deepdfa_tpu.obs import ledger as obs_ledger
+
+            obs_ledger.record_compile(
+                "combined_train", self._sig_label(sig), entry.train, dt
+            )
             report[self._sig_label(sig)] = round(dt, 3)
         return report
 

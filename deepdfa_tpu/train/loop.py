@@ -307,6 +307,11 @@ class GraphTrainer:
             else int(jax.device_get(state.step))
         )
         placer = device_placer(self.mesh)
+        # efficiency ledger (docs/efficiency.md): once per distinct
+        # batch signature, declare the StepTimer join site and AOT-read
+        # the compiled step's cost analysis; the local memo keeps the
+        # per-step cost at one string build + compare
+        ledger_sig: str | None = None
         cm = res if res is not None else contextlib.nullcontext()
         with cm:
             for epoch in range(start_epoch, max_epochs):
@@ -351,6 +356,21 @@ class GraphTrainer:
                             break
                         if res is not None:
                             res.heartbeat("device", epoch=epoch, step=step)
+                        if inst.ledger is not None:
+                            sig = (
+                                f"G{batch.num_graphs}"
+                                f"xN{batch.node_feats.shape[-2]}"
+                                f"xE{batch.edge_src.shape[-1]}"
+                            )
+                            if sig != ledger_sig:
+                                ledger_sig = sig
+                                inst.observe_step_compile(
+                                    "train_step", sig,
+                                    self.train_step_guarded if guard
+                                    else self.train_step,
+                                    (state, batch, res.lr_scale())
+                                    if guard else (state, batch),
+                                )
                         with inst.step_span(step):
                             if guard:
                                 state, loss, ok = self.train_step_guarded(

@@ -58,7 +58,11 @@ from typing import Any, Callable
 
 from deepdfa_tpu.core.config import ResilienceConfig
 from deepdfa_tpu.core.ioutil import atomic_write_text, with_retries
-from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.obs import (
+    flight as obs_flight,
+    metrics as obs_metrics,
+    trace as obs_trace,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -383,6 +387,11 @@ class Watchdog:
                 elapsed_s=round(elapsed, 1), **ctx,
             )
             obs_trace.flush()
+            # flight recorder (docs/efficiency.md): the postmortem is
+            # written BEFORE on_stall because the default on_stall is
+            # os._exit — the last N steps + recent instants + ledger
+            # must already be on disk when the process dies
+            obs_flight.crash_dump("watchdog_abort", extra=diag)
             logger.critical("watchdog: %s", json.dumps(diag))
             if self.diagnostic_path is not None:
                 try:
@@ -615,6 +624,11 @@ class ResilientRunner:
                 epoch=cursor.epoch,
             )
             obs_trace.flush()
+            obs_flight.crash_dump("sigterm", extra={
+                "step": cursor.step, "epoch": cursor.epoch,
+                "batch_index": cursor.batch_index,
+                "manifest": str(manifest) if manifest else None,
+            })
             raise Preempted(
                 f"preempted at step {cursor.step} "
                 f"(epoch {cursor.epoch}, batch {cursor.batch_index})",
@@ -690,6 +704,11 @@ class ResilientRunner:
             "rollback", cat="resilience", rollbacks=self.rollbacks,
             lr_scale=self._lr_scale,
         )
+        obs_flight.crash_dump("nan_rollback", extra={
+            "rollbacks": self.rollbacks,
+            "skipped_steps": self.skipped_steps,
+            "lr_scale": self._lr_scale,
+        })
         self._consec_bad = 0
         self._pending.clear()  # flags from the abandoned trajectory
         manifest = self.ckpt.latest() if self.ckpt is not None else None

@@ -29,6 +29,12 @@ Modes:
                  exposition format 0.0.4, every family must carry its
                  `tag=` back-reference, and every tag must be declared
                  in SCHEMA (docs/slo.md)
+  --postmortem <path> validate a crash flight-recorder dump
+                 (postmortem.json, obs/flight.py / docs/efficiency.md):
+                 format contract (version, declared trigger, bounded
+                 step/event rings, ledger shape) AND every embedded
+                 metrics tag declared in SCHEMA — wired into the
+                 serve/scan smoke paths and scripts/fault_inject.py
 """
 
 from __future__ import annotations
@@ -153,10 +159,29 @@ def main(argv=None) -> int:
                     help="validate an existing scan_log.jsonl")
     ap.add_argument("--metrics", default=None,
                     help="validate a saved Prometheus /metrics scrape")
+    ap.add_argument("--postmortem", default=None,
+                    help="validate a dumped postmortem.json (crash "
+                    "flight recorder, obs/flight.py)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
     from deepdfa_tpu.obs import metrics
+
+    if args.postmortem:
+        from deepdfa_tpu.obs.flight import validate_postmortem_file
+
+        result = validate_postmortem_file(args.postmortem)
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "postmortem validation failed:\n  "
+                + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
 
     if args.metrics:
         result = check_metrics_scrape(Path(args.metrics).read_text())

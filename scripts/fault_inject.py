@@ -196,6 +196,94 @@ def inproc_nan(setup, tmp) -> dict:
     return {"skipped_steps": runner.skipped_steps, "params_finite": True}
 
 
+def inproc_flight(setup, tmp) -> dict:
+    """Flight-recorder coverage through the DEEPDFA_FAULTS harness
+    (ISSUE 10): sigterm@N, nan@N (driven to a guard ROLLBACK), and
+    stall@N (watchdog fire) each leave a schema-valid postmortem.json
+    naming its trigger — validated by the same checker
+    `scripts/check_obs_schema.py --postmortem` runs."""
+    import dataclasses
+
+    from deepdfa_tpu.obs import flight as obs_flight
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+    from deepdfa_tpu.train import GraphTrainer, Preempted, ResilientRunner
+
+    cfg, model, mesh, _, batches = setup
+    out: dict = {}
+
+    def drive(name, rcfg_overrides, plan, expect_trigger, on_stall=None):
+        run_dir = Path(tmp) / f"flight-{name}"
+        pm_path = run_dir / "postmortem.json"
+        recorder = obs_flight.install(pm_path, max_steps=16, max_events=32)
+        try:
+            c = dataclasses.replace(
+                cfg,
+                train=dataclasses.replace(
+                    cfg.train,
+                    resilience=dataclasses.replace(
+                        cfg.train.resilience, **rcfg_overrides
+                    ),
+                ),
+            )
+            trainer = GraphTrainer(model, c, mesh=mesh)
+            state = trainer.init_state(batches(0)[0])
+            runner = ResilientRunner(
+                c.train.resilience, run_dir, seed=c.train.seed,
+                on_stall=on_stall,
+            )
+            injector = FaultInjector(plan)
+            try:
+                trainer.fit(
+                    state, lambda e: injector.wrap(batches(e)),
+                    resilience=runner,
+                )
+            except Preempted:
+                pass
+            assert pm_path.exists(), f"{name}: no postmortem dumped"
+            verdict = obs_flight.validate_postmortem_file(pm_path)
+            assert verdict["ok"], f"{name}: invalid postmortem: {verdict}"
+            assert verdict["trigger"] == expect_trigger, (
+                name, verdict["trigger"], expect_trigger,
+            )
+            assert verdict["steps"] > 0, f"{name}: empty step ring"
+            out[name] = {
+                "trigger": verdict["trigger"],
+                "steps": verdict["steps"],
+                "events": verdict["events"],
+                "valid": True,
+            }
+        finally:
+            obs_flight.uninstall()
+        return recorder
+
+    # sigterm@N -> preemption checkpoint -> postmortem trigger "sigterm"
+    drive(
+        "sigterm", {}, FaultPlan(sigterm_at_step=4), "sigterm",
+    )
+    # nan@N,N+1 with max_consecutive_bad=2 -> the second consecutive bad
+    # step forces a guard ROLLBACK -> trigger "nan_rollback" (guard_lag
+    # 0 so flags are consumed in step order, deterministic)
+    drive(
+        "nan",
+        {"max_consecutive_bad": 2, "guard_lag": 0,
+         "step_checkpoint_every": 2},
+        FaultPlan(nan_at_steps=frozenset({3, 4})),
+        "nan_rollback",
+    )
+    # stall@N (bounded) with a tight watchdog -> the watchdog fires,
+    # dumps "watchdog_abort", and a no-op on_stall lets the in-process
+    # run continue once the stall releases (the real default aborts the
+    # process with exit 113 AFTER the same dump)
+    drive(
+        "stall",
+        {"watchdog_timeout_s": 1.0, "watchdog_first_step_grace_s": 6.0},
+        FaultPlan(stall_at_step=3, stall_seconds=4.0),
+        "watchdog_abort",
+        on_stall=lambda diag: None,
+    )
+    return out
+
+
 def run_smoke(n_examples: int) -> dict:
     from deepdfa_tpu.core.backend import apply_platform_override
 
@@ -206,6 +294,7 @@ def run_smoke(n_examples: int) -> dict:
         "sigterm": inproc_sigterm,
         "corrupt-shard": inproc_corrupt_shard,
         "nan": inproc_nan,
+        "flight": inproc_flight,
     }
     with tempfile.TemporaryDirectory(prefix="fault-inject-") as tmp:
         t0 = time.perf_counter()

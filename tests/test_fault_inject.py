@@ -44,3 +44,12 @@ def test_fault_inject_smoke(tmp_path):
     assert scen["corrupt-shard"]["quarantined_entries"] >= 1
     assert scen["nan"]["skipped_steps"] == 2
     assert scen["nan"]["params_finite"] is True
+    # flight-recorder coverage (ISSUE 10): sigterm/nan/stall each leave
+    # a schema-valid postmortem.json naming its trigger
+    flight = scen["flight"]
+    assert flight["sigterm"]["trigger"] == "sigterm"
+    assert flight["nan"]["trigger"] == "nan_rollback"
+    assert flight["stall"]["trigger"] == "watchdog_abort"
+    for name in ("sigterm", "nan", "stall"):
+        assert flight[name]["valid"] is True
+        assert flight[name]["steps"] > 0

@@ -1,0 +1,397 @@
+"""Device efficiency ledger + crash flight recorder (ISSUE 10,
+deepdfa_tpu/obs/ledger.py + obs/flight.py, docs/efficiency.md).
+
+The load-bearing contracts, in-process:
+
+- the ONE cost-analysis reader (list-vs-dict shim) feeds both Table-5
+  profiling (eval/profiling.compiled_cost is a thin client) and the
+  runtime ledger;
+- per-signature sites accumulate compiles + executions into rolling
+  FLOP/s and a roofline position against injected/measured ceilings;
+- the HBM ledger max-merges per-phase watermarks and books per-entry
+  param bytes; OOM exceptions are recognized;
+- the flight recorder's rings are bounded, its postmortems are
+  schema-valid for every declared trigger, and validation rejects
+  malformed documents (the `check_obs_schema.py --postmortem` surface);
+- zero-steady-state-recompile census pinned WITH the ledger on: serve
+  executor lowerings and scores are unchanged vs ledger-off, and the
+  GraphTrainer epoch record's ledger section shows exactly one compile
+  per signature across epochs with a loss trajectory identical to a
+  ledger-off run (default path byte-identical).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.obs import flight as obs_flight, ledger as obs_ledger
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+
+NODE_BUDGET, EDGE_BUDGET = 2048, 8192
+
+
+@pytest.fixture(autouse=True)
+def _clean_singletons():
+    """Every test starts and ends without an installed ledger/recorder
+    (module singletons must not leak across the suite)."""
+    obs_ledger.disable()
+    obs_flight.uninstall()
+    yield
+    obs_ledger.disable()
+    obs_flight.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# the one cost-analysis reader
+
+
+def test_read_cost_analysis_and_thin_client():
+    import jax
+    import jax.numpy as jnp
+
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jnp.ones((32, 32), jnp.float32)
+    ).compile()
+    cost = obs_ledger.read_cost_analysis(compiled)
+    assert cost["flops"] > 0
+    assert "cost_analysis" in cost
+
+    # eval/profiling.compiled_cost reads through the SAME reader and,
+    # with a tag, books the compile as a ledger site
+    from deepdfa_tpu.eval.profiling import compiled_cost
+
+    led = obs_ledger.enable()
+    out = compiled_cost(
+        lambda x: x @ x, jnp.ones((32, 32), jnp.float32),
+        ledger_tag="profiling", ledger_signature="S32",
+    )
+    assert out["flops"] == cost["flops"]
+    site = led.snapshot()["sites"]["profiling/S32"]
+    assert site["flops"] == cost["flops"]
+    assert site["compiles"] == 1
+    assert site["compile_seconds"] > 0
+
+
+def test_site_rollup_mfu_and_gauges():
+    reg = obs_metrics.MetricsRegistry()
+    led = obs_ledger.enable(
+        ceilings={"matmul_flops_per_sec": 1e9,
+                  "gather_bytes_per_sec": 1e8},
+        registry=reg,
+    )
+    led.record_compile(
+        "train_step", "G4", None, 1.5,
+        flops=2e6, bytes_accessed=4e5, live_bytes=1e6,
+    )
+    led.observe_execution("train_step", "G4", 0.5, n=50)
+    view = led.snapshot()["sites"]["train_step/G4"]
+    # 2e6 flops x 50 execs / 0.5 s = 2e8 FLOP/s; ceiling 1e9 -> 0.2
+    assert view["flops_per_sec"] == pytest.approx(2e8)
+    assert view["mfu_vs_measured_ceiling"] == pytest.approx(0.2)
+    # 4e5 x 50 / 0.5 = 4e7 B/s; gather ceiling 1e8 -> 0.4
+    assert view["bytes_vs_gather_ceiling"] == pytest.approx(0.4)
+    assert led.snapshot()["compile_seconds_total"] == pytest.approx(1.5)
+
+    led.publish_gauges()
+    snap = reg.snapshot()
+    assert snap["ledger/train_step/G4/mfu_vs_measured_ceiling"] == (
+        pytest.approx(0.2)
+    )
+    # every emitted registry tag is covered by the declared schema
+    for tag in snap:
+        assert obs_metrics.declared(tag) or obs_metrics.declared(
+            f"{tag}/count"
+        ), tag
+    # the bench stamp view
+    stamp = led.mfu_record()
+    assert stamp["ledger_mfu"]["train_step/G4"] == pytest.approx(0.2)
+    assert stamp["compile_seconds_total"] == pytest.approx(1.5)
+
+
+def test_step_site_join_memory_params_and_oom():
+    reg = obs_metrics.MetricsRegistry()
+    led = obs_ledger.enable(registry=reg)
+    led.record_compile("train_step", "G2", None, 0.1, flops=1e6)
+    led.set_step_site("train_step", "G2")
+    obs_ledger.observe_step_seconds(0.25)  # the StepTimer join
+    obs_ledger.observe_step_seconds(0.25)
+    site = led.snapshot()["sites"]["train_step/G2"]
+    assert site["executions"] == 2
+    assert site["device_seconds"] == pytest.approx(0.5)
+
+    # per-phase watermark max-merges
+    led.record_memory("epoch", stats={"peak_bytes_in_use": 100.0})
+    led.record_memory("epoch", stats={"peak_bytes_in_use": 70.0})
+    assert led.snapshot()["memory"]["epoch"]["peak_bytes_in_use"] == 100.0
+
+    # per-entry param bytes: 1000 f32 + 10 int8 = 4010 bytes
+    n = led.record_params("deepdfa:run:best", {
+        "a": np.zeros((10, 100), np.float32),
+        "b": np.zeros((10,), np.int8),
+    })
+    assert n == 4010.0
+    assert led.snapshot()["params"]["deepdfa:run:best"] == 4010.0
+
+    class FakeOom(RuntimeError):
+        pass
+
+    assert obs_ledger.is_oom(FakeOom("RESOURCE_EXHAUSTED: out of memory"))
+    assert not obs_ledger.is_oom(ValueError("shape mismatch"))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_rings_bounded_and_postmortem_valid(tmp_path):
+    pm = tmp_path / "postmortem.json"
+    rec = obs_flight.install(pm, max_steps=4, max_events=3)
+    for i in range(10):
+        obs_flight.note_step(i)
+    # instants mirror into the ring with tracing OFF
+    for i in range(5):
+        obs_trace.instant("step_skipped", cat="resilience", consecutive=i)
+    path = obs_flight.crash_dump("manual", extra={"reason": "test"})
+    assert path == pm and pm.exists()
+    doc = json.loads(pm.read_text())
+    verdict = obs_flight.validate_postmortem(doc)
+    assert verdict["ok"], verdict
+    assert verdict["trigger"] == "manual"
+    assert verdict["steps"] == 4  # bounded at max_steps
+    assert verdict["events"] == 3  # bounded at max_events
+    assert doc["postmortem"]["steps"][-1]["step"] == 9  # newest kept
+    assert rec.dumps == 1 and rec.last_trigger == "manual"
+
+
+def test_flight_exception_classification(tmp_path):
+    obs_flight.install(tmp_path / "postmortem.json")
+    path = obs_flight.note_exception(
+        RuntimeError("RESOURCE_EXHAUSTED: failed to allocate 2.1G"),
+        where="serve_batch",
+    )
+    doc = json.loads(path.read_text())["postmortem"]
+    assert doc["trigger"] == "oom"
+    assert doc["extra"]["where"] == "serve_batch"
+    path = obs_flight.note_exception(ValueError("boom"))
+    assert json.loads(path.read_text())["postmortem"]["trigger"] == (
+        "exception"
+    )
+
+
+def test_flight_ledger_embedded_in_dump(tmp_path):
+    led = obs_ledger.enable(registry=obs_metrics.MetricsRegistry())
+    led.record_compile("serve_score", "G2", None, 0.2, flops=1e6)
+    led.record_memory("warmup", stats={"peak_bytes_in_use": 5e8})
+    obs_flight.install(tmp_path / "postmortem.json")
+    path = obs_flight.crash_dump("oom")
+    pm = json.loads(path.read_text())["postmortem"]
+    assert pm["ledger"]["sites"]["serve_score/G2"]["flops"] == 1e6
+    assert pm["ledger"]["memory"]["warmup"]["peak_bytes_in_use"] == 5e8
+    assert obs_flight.validate_postmortem({"postmortem": pm})["ok"]
+
+
+def test_validate_postmortem_rejects_malformed():
+    assert not obs_flight.validate_postmortem({})["ok"]
+    bad = {"postmortem": {
+        "version": obs_flight.POSTMORTEM_VERSION,
+        "trigger": "not-a-trigger",
+        "t_unix": 1.0, "pid": 1, "steps": [], "events": [],
+        "metrics": {"made/up/undeclared_tag": 1.0},
+    }}
+    verdict = obs_flight.validate_postmortem(bad)
+    assert not verdict["ok"]
+    text = " ".join(verdict["problems"])
+    assert "trigger" in text and "undeclared" in text
+
+    ok = {"postmortem": {
+        "version": obs_flight.POSTMORTEM_VERSION,
+        "trigger": "sigterm",
+        "t_unix": 1.0, "pid": 1, "steps": [], "events": [],
+        "metrics": {},
+    }}
+    assert obs_flight.validate_postmortem(ok)["ok"]
+
+
+def test_check_obs_schema_postmortem_cli(tmp_path):
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    obs_flight.install(tmp_path / "postmortem.json")
+    obs_flight.note_step(1)
+    obs_flight.crash_dump("smoke_test")
+    repo = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "check_obs_schema", repo / "scripts" / "check_obs_schema.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["check_obs_schema"] = mod
+    spec.loader.exec_module(mod)
+    assert mod.main(
+        ["--postmortem", str(tmp_path / "postmortem.json")]
+    ) == 0
+    (tmp_path / "bad.json").write_text('{"not": "a postmortem"}')
+    assert mod.main(["--postmortem", str(tmp_path / "bad.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# census pins: ledger on adds zero lowerings and changes zero bits
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    import jax
+
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+
+    synth = generate(8, seed=5)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(8), limit_all=50,
+        limit_subkeys=50,
+    )
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+    ])
+    model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
+    params = model.init(
+        jax.random.key(0), pack([], 1, NODE_BUDGET, EDGE_BUDGET)
+    )
+    return cfg, model, params, specs
+
+
+def _executor(model, params):
+    from deepdfa_tpu.serve.batcher import GgnnExecutor
+
+    return GgnnExecutor(
+        model, lambda: params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=4,
+    )
+
+
+def test_serve_executor_ledger_census_and_bit_parity(served_model):
+    _, model, params, specs = served_model
+
+    # reference: ledger OFF
+    ex_off = _executor(model, params)
+    ex_off.warmup()
+    low_off = ex_off.jit_lowerings()
+    scores_off = ex_off.execute("graph", specs[:3])
+    assert ex_off.jit_lowerings() == low_off  # steady state
+
+    # ledger ON: same lowerings, bit-identical scores, sites recorded
+    led = obs_ledger.enable(registry=obs_metrics.MetricsRegistry())
+    ex_on = _executor(model, params)
+    report = ex_on.warmup()
+    assert ex_on.jit_lowerings() == low_off
+    scores_on = ex_on.execute("graph", specs[:3])
+    assert ex_on.jit_lowerings() == low_off  # census pinned with ledger
+    np.testing.assert_array_equal(scores_on, scores_off)
+    sites = led.snapshot()["sites"]
+    assert set(sites) == {f"serve_score/G{s}" for s in (1, 2, 4)}
+    for label in report:
+        site = sites[f"serve_score/{label}"]
+        assert site["compiles"] == 1
+        assert site["flops"] > 0
+    # the executed signature accumulated device time
+    assert sites["serve_score/G4"]["executions"] == 1
+    assert sites["serve_score/G4"]["device_seconds"] > 0
+
+
+def test_graph_trainer_ledger_epoch_record(served_model):
+    import jax
+
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train import GraphTrainer
+
+    cfg, model, _, specs = served_model
+    cfg = config_mod.apply_overrides(cfg, [
+        "train.max_epochs=2", "train.prefetch_batches=0",
+        "train.log_every_steps=1000",
+    ])
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+
+    def batches(_e=0):
+        # 2 graphs/batch -> enough steps per epoch for the lagged
+        # StepTimer to observe inter-completion step seconds (lag 1)
+        return list(shard_bucket_batches(
+            specs, 1, 2, NODE_BUDGET, EDGE_BUDGET, oversized="drop"
+        ))
+
+    def fit(ledger_on):
+        if ledger_on:
+            obs_ledger.enable(registry=obs_metrics.MetricsRegistry())
+        else:
+            obs_ledger.disable()
+        trainer = GraphTrainer(model, cfg, mesh=mesh)
+        state = trainer.init_state(batches()[0])
+        records = []
+        trainer.fit(state, batches, log_fn=records.append)
+        return [r for r in records if "epoch" in r]
+
+    plain = fit(False)
+    ledgered = fit(True)
+    # default path byte-identical: the ledger adds accounting, never
+    # numerics — per-epoch losses match bit for bit
+    assert [r["train_loss"] for r in plain] == [
+        r["train_loss"] for r in ledgered
+    ]
+    assert all("ledger" not in r for r in plain)
+    sigs = [
+        k for k in ledgered[0]["ledger"]["sites"]
+        if k.startswith("train_step/")
+    ]
+    assert len(sigs) == 1  # one batch signature this run
+    for rec in ledgered:
+        site = rec["ledger"]["sites"][sigs[0]]
+        # exactly ONE compile, and it never grows across epochs — the
+        # zero-steady-state-recompile pin with the ledger on
+        assert site["compiles"] == 1
+        assert site["flops"] > 0
+        assert site["live_bytes"] > 0
+    # the StepTimer join fed device seconds for the epochs' steps
+    last = ledgered[-1]["ledger"]["sites"][sigs[0]]
+    assert last["executions"] > 0
+    assert last["device_seconds"] > 0
+    # every flattened ledger tag is schema-declared
+    from deepdfa_tpu.train.logging import flatten_scalars
+
+    for tag in flatten_scalars(ledgered[-1]):
+        assert obs_metrics.declared(tag), tag
+
+
+# ---------------------------------------------------------------------------
+# bench gate: the absolute ledger-overhead bound
+
+
+def test_bench_gate_ledger_bounds():
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    base = {
+        "metric": "deepdfa_infer_graphs_per_sec", "value": 100.0,
+        "unit": "graphs/s", "platform": "cpu",
+    }
+    ok = bg.gate({**base, "obs_ledger_overhead_fraction": 0.01}, [])
+    assert ok["verdict"] == "pass"
+    bad = bg.gate({**base, "obs_ledger_overhead_fraction": 0.05}, [])
+    assert bad["verdict"] == "fail"
+    assert "regression" in bad["failure_classes"]
+    check = next(
+        c for c in bad["checks"]
+        if c["metric"] == "obs_ledger_overhead_fraction"
+    )
+    assert check["direction"] == "bound" and not check["ok"]
+    # compile time gates lower-is-better against a reference
+    traj = [{"source": "BENCH_r98.json", "round": 98, "record": {
+        **base, "compile_seconds_total": 10.0,
+    }}]
+    slow = bg.gate({**base, "compile_seconds_total": 25.0}, traj)
+    assert slow["verdict"] == "fail"
+    fast = bg.gate({**base, "compile_seconds_total": 12.0}, traj)
+    assert fast["verdict"] == "pass"
