@@ -66,6 +66,7 @@ SCAN_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_SCAN_TIMEOUT", 420))
 SCATTER_TIMEOUT = float(
     os.environ.get("DEEPDFA_BENCH_SCATTER_TIMEOUT", 420)
 )
+FLEET_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_FLEET_TIMEOUT", 420))
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -694,6 +695,44 @@ def run_scatter_measurement(platform: str) -> dict:
     return out
 
 
+def run_fleet_measurement(platform: str) -> dict:
+    """Fleet-under-overload observables (ISSUE 11); child, CPU-viable.
+
+    Delegates to scripts/bench_load.py:bench_load — the open-loop
+    Poisson drive (heavy-tail size mix, tenant mix) against a real
+    router + admission stack over in-process replicas — and passes the
+    fields through: they already carry the fleet_* names the bench gate
+    reads (`fleet_p99_overload_ms` and `fleet_shed_rate`, both
+    lower-is-better)."""
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    if "DEEPDFA_TPU_STORAGE" not in os.environ:
+        import tempfile
+
+        tmp = tempfile.TemporaryDirectory(prefix="bench-fleet-")
+        os.environ["DEEPDFA_TPU_STORAGE"] = tmp.name
+    from bench_load import bench_load
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_load(
+        int(os.environ.get("DEEPDFA_BENCH_FLEET_REQUESTS",
+                           120 if smoke else 600)),
+        smoke=smoke,
+    )
+    out = {k: v for k, v in rec.items() if k.startswith("fleet_")}
+    out["fleet_platform"] = platform
+    return out
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -795,6 +834,22 @@ def _measure_full(
                 result["scatter_error"] = sterr
         else:
             result["scatter_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_FLEET", "0") == "1":
+        # fleet-under-overload observables (ISSUE 11), opt-in via
+        # DEEPDFA_BENCH_FLEET (the fleet layer is default-off), own
+        # bounded child for the same wedge-isolation reason
+        fbudget = min(FLEET_TIMEOUT, deadline - time.time())
+        if fbudget >= 90:
+            flt, ferr = _run_child(
+                "--child-fleet", result.get("platform", platform),
+                fbudget,
+            )
+            if flt is not None:
+                result.update(flt)
+            else:
+                result["fleet_error"] = ferr
+        else:
+            result["fleet_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -1008,6 +1063,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-scatter":
         print(
             _CHILD_TAG + json.dumps(run_scatter_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-fleet":
+        print(
+            _CHILD_TAG + json.dumps(run_fleet_measurement(sys.argv[2])),
             flush=True,
         )
     else:

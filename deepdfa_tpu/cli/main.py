@@ -14,6 +14,11 @@ Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
   serve     online HTTP scoring service (dynamic batcher + AOT executables)
   scan      whole-repo incremental scanning -> JSONL + SARIF findings
             with optional line attributions (docs/scanning.md)
+  fleet     multi-replica serving fleet: N replica workers behind a
+            health-gated router with tenant admission + deadline-aware
+            load shedding (docs/fleet.md)
+  fleet-replica  one fleet replica worker process (spawned by `fleet`;
+            heartbeats + graceful SIGTERM drain)
 
 Config comes from --config (json) plus dotted key=value overrides, e.g.
   python -m deepdfa_tpu.cli train data.batch.graphs_per_batch=128
@@ -1824,6 +1829,127 @@ def cmd_scan(args) -> None:
     print(json.dumps(summary), flush=True)
 
 
+def cmd_fleet(args) -> None:
+    """Multi-replica serving fleet (docs/fleet.md): spawn N
+    `fleet-replica` workers against one run dir, then front-door them
+    with the health-gated router (least-outstanding routing, tenant
+    admission, deadline-aware shedding). --smoke trains a tiny
+    checkpoint and drives a 2-replica fleet end to end: bit-parity vs
+    singleton serving, shed-before-device-time, kill-mid-stream
+    failover, graceful drain, schema-valid fleet log."""
+    from deepdfa_tpu.fleet import smoke as fleet_smoke
+
+    if args.smoke:
+        report = fleet_smoke.run_fleet_smoke(
+            extra_overrides=args.overrides
+        )
+        print(json.dumps(report), flush=True)
+        bad = fleet_smoke.smoke_verdict(report)
+        if bad:
+            raise SystemExit(
+                "fleet smoke contract violated:\n  " + "\n  ".join(bad)
+            )
+        return
+    import signal as signal_mod
+    import time as time_mod
+
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.fleet.replica import spawn_replicas, wait_for_ready
+    from deepdfa_tpu.fleet.router import (
+        make_router_server,
+        router_from_config,
+    )
+
+    cfg = _load_run_config(args)
+    run_dir = paths.runs_dir(cfg.run_name)
+    fleet_dir = Path(cfg.fleet.fleet_dir or run_dir / "fleet")
+    host = args.host if args.host is not None else cfg.fleet.host
+    port = args.port if args.port is not None else cfg.fleet.port
+    n = args.replicas if args.replicas is not None else cfg.fleet.replicas
+    procs = spawn_replicas(
+        run_dir, fleet_dir, n, overrides=args.overrides
+    )
+    # a scheduler stops the fleet with SIGTERM: convert it to the same
+    # unwind Ctrl-C takes so the finally-drain (SIGTERM the replicas,
+    # final summary record) actually runs
+    def _sigterm_to_interrupt(signum, frame):
+        raise KeyboardInterrupt
+
+    signal_mod.signal(signal_mod.SIGTERM, _sigterm_to_interrupt)
+    with obs.session(cfg, run_dir):
+        router = router_from_config(
+            cfg, fleet_dir, log_path=run_dir / "fleet_log.jsonl"
+        )
+        httpd = None
+        try:
+            wait_for_ready(
+                fleet_dir, [rid for rid, _ in procs],
+                timeout_s=args.ready_timeout, procs=procs,
+            )
+            router.start_polling()
+            httpd = make_router_server(router, host, port)
+            print(json.dumps({
+                "fleet": True,
+                "host": host,
+                "port": httpd.server_address[1],
+                "replicas": [rid for rid, _ in procs],
+                "fleet_dir": str(fleet_dir),
+                **router.topology(),
+            }), flush=True)
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            if httpd is not None:
+                httpd.server_close()
+            # drain the replicas the way a scheduler would: SIGTERM,
+            # then wait for the graceful exit
+            for _, proc in procs:
+                if proc.poll() is None:
+                    proc.send_signal(signal_mod.SIGTERM)
+            deadline = time_mod.time() + 60
+            for _, proc in procs:
+                try:
+                    proc.wait(
+                        timeout=max(1.0, deadline - time_mod.time())
+                    )
+                except Exception:
+                    proc.kill()
+            router.close()
+
+
+def cmd_fleet_replica(args) -> None:
+    """One fleet replica worker (docs/fleet.md): a full ScoringService
+    with its own AOT-warmed ladders, announced via heartbeat file;
+    SIGTERM drains gracefully (finish in-flight batches, final SLO
+    snapshot, flight-recorder postmortem)."""
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.core import config as _config_mod
+    from deepdfa_tpu.fleet.replica import ReplicaWorker
+    from deepdfa_tpu.serve.registry import load_run_config
+
+    run_dir = Path(args.run_dir)
+    if not run_dir.is_dir():
+        candidate = paths.runs_dir(args.run_dir)
+        if candidate.is_dir():
+            run_dir = candidate
+        else:
+            raise SystemExit(f"no such run dir: {args.run_dir}")
+    cfg = load_run_config(run_dir)
+    cfg = _config_mod.apply_overrides(cfg, args.overrides)
+    _config_mod.validate(cfg)
+    _config_mod.apply_sanitizers(cfg)
+    worker = ReplicaWorker(
+        cfg, run_dir, args.replica_id,
+        fleet_dir=args.fleet_dir, host=args.host, port=args.port,
+        family=args.family,
+    )
+    # per-replica obs home: traces + postmortem never collide across
+    # replicas sharing one run dir
+    with obs.session(cfg, worker.obs_dir):
+        raise SystemExit(worker.run())
+
+
 def cmd_bench(args) -> None:
     import bench
 
@@ -2165,6 +2291,53 @@ def main(argv=None) -> None:
                    dest="overrides",
                    help="dotted key=value config override (repeatable)")
     p.set_defaults(fn=cmd_scan)
+
+    p = sub.add_parser(
+        "fleet",
+        help="multi-replica serving fleet: N replica workers behind a "
+        "health-gated router with tenant admission + deadline-aware "
+        "shedding (docs/fleet.md)",
+    )
+    p.add_argument("--host", default=None,
+                   help="router bind address (default fleet.host)")
+    p.add_argument("--port", type=int, default=None,
+                   help="router port (default fleet.port)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="replica worker processes "
+                        "(default fleet.replicas)")
+    p.add_argument("--ready-timeout", type=float, default=600.0,
+                   help="seconds to wait for every replica heartbeat "
+                        "to reach 'ready'")
+    p.add_argument("--smoke", action="store_true",
+                   help="self-contained 2-replica acceptance drive: "
+                        "bit-parity vs singleton serving, shed-before-"
+                        "device-time, kill failover, graceful drain "
+                        "(tier-1)")
+    # consistent override surface with score/serve (no positionals)
+    p.add_argument("--config", default=None, help="json config file")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "fleet-replica",
+        help="one fleet replica worker (spawned by `fleet`): "
+        "ScoringService + heartbeat + graceful SIGTERM drain",
+    )
+    p.add_argument("--run-dir", required=True,
+                   help="run directory (or run name under storage/runs)")
+    p.add_argument("--replica-id", required=True)
+    p.add_argument("--fleet-dir", default=None,
+                   help="heartbeat/obs dir (default <run_dir>/fleet)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = ephemeral (published via heartbeat)")
+    p.add_argument("--family", default="deepdfa", choices=["deepdfa"])
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_fleet_replica)
 
     p = sub.add_parser("bench")
     _add_common(p)

@@ -416,6 +416,76 @@ class ScanConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Multi-replica serving fleet knobs (deepdfa_tpu/fleet/,
+    docs/fleet.md).
+
+    Only the `fleet`/`fleet-replica` CLI commands read this section —
+    the single-process `serve` path never touches it, so the default
+    serving path stays byte-identical. Topology: N shared-nothing
+    replica processes (each a full ScoringService with its own
+    AOT-warmed ladders) announce themselves via heartbeat files under
+    `<run_dir>/fleet/`; one router process front-doors them with
+    health-gated least-outstanding routing and per-tenant admission."""
+
+    # -- topology (fleet/replica.py, cli `fleet`)
+    # replica processes the `fleet` command spawns
+    replicas: int = 2
+    # router bind address (replicas always bind 127.0.0.1:ephemeral and
+    # publish their real port via heartbeat)
+    host: str = "127.0.0.1"
+    port: int = 8470
+    # heartbeat/obs directory override; default <run_dir>/fleet
+    fleet_dir: str | None = None
+    # -- heartbeats (fleet/heartbeat.py)
+    # how often a replica refreshes its heartbeat file
+    heartbeat_interval_s: float = 1.0
+    # a heartbeat older than this marks the replica GONE (removed from
+    # routing until a fresh one appears)
+    heartbeat_timeout_s: float = 10.0
+    # -- routing (fleet/router.py)
+    # router-side heartbeat re-scan + ejected-replica probe cadence
+    poll_interval_s: float = 0.5
+    # transport failures before a replica is ejected (1 = first failed
+    # forward ejects; the request is retried on a survivor either way)
+    eject_threshold: int = 1
+    # forward attempts per request beyond the first (each on a different
+    # replica) before the router answers 503
+    retries: int = 2
+    # per-forward timeout the router waits on a replica
+    request_timeout_s: float = 60.0
+    # -- admission (fleet/admission.py)
+    # JSON object {tenant: {"rate": r/s, "burst": b, "priority": p}};
+    # priority 0 = interactive (never overload-shed), 1 = batch,
+    # 2 = best-effort. Unlisted tenants get the default_* policy.
+    tenants: str = ""
+    default_rate: float = 100.0
+    default_burst: float = 200.0
+    default_priority: int = 1
+    # assumed per-replica concurrent capacity for the overload shed
+    # (outstanding > shed_fraction * healthy * replica_capacity sheds
+    # priority>0 requests 503 before any device time is spent)
+    replica_capacity: int = 64
+    shed_fraction: float = 1.0
+    # initial EWMA service-time estimate the deadline shed uses before
+    # real completions calibrate it
+    service_time_init_ms: float = 50.0
+    # -- drain (fleet/replica.py)
+    # lame-duck period: after announcing `draining` in the heartbeat, a
+    # replica keeps serving this long before tearing down, so the router
+    # (poll cadence poll_interval_s) deterministically observes the
+    # drain and stops routing to it
+    drain_announce_s: float = 0.5
+    # -- multi-model co-serving (fleet/admission.py:plan_coserving)
+    # extra registry entries one replica co-serves, "name=run_dir" or
+    # "name=run_dir:checkpoint"; requests pick one with {"model": name}
+    models: tuple[str, ...] = ()
+    # HBM budget (bytes) the per-entry param-bytes ledger arbitrates
+    # co-serving against; 0 = unbudgeted (every configured entry loads)
+    hbm_budget_bytes: float = 0.0
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. Axis sizes of 1 collapse; -1 = all remaining."""
 
@@ -480,6 +550,7 @@ class Config:
     obs: ObsConfig = field(default_factory=ObsConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     scan: ScanConfig = field(default_factory=ScanConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,33 @@
+"""Multi-replica serving fleet (docs/fleet.md).
+
+The layer above the single-process serving stack (deepdfa_tpu/serve/):
+N shared-nothing replica workers — each a full `ScoringService` with its
+own AOT-warmed bucket ladders — behind one stdlib-HTTP router with
+per-tenant admission control and deadline-aware load shedding.
+
+- `fleet.heartbeat` — the replica announcement protocol: one atomic
+  JSON file per replica under `<run_dir>/fleet/`, carrying the cached
+  `BackendHealth` report, the per-entry HBM param-bytes ledger snapshot
+  (the co-serving capacity signal), and the drain state.
+- `fleet.admission` — per-tenant token-bucket admission with priority
+  classes, deadline-aware shedding (requests whose deadline cannot be
+  met at the current queue depth are rejected BEFORE any frontend or
+  device time is spent), and the param-bytes co-serving planner.
+- `fleet.router` — the front door: health-gated least-outstanding
+  routing, replica eject/readmit on transport failure or probe success,
+  in-flight retry on a survivor (scores are bit-identical regardless of
+  replica, so a retry is always safe), request-id propagation so one
+  request's Perfetto flow chain spans router -> replica.
+- `fleet.replica` — the worker process: ScoringService + HTTP server +
+  heartbeat thread; SIGTERM drains (stop accepting, finish in-flight
+  batches, final SLO snapshot + flight-recorder postmortem) instead of
+  dropping work.
+- `fleet.smoke` — the `fleet --smoke` end-to-end drive (tier-1).
+
+Everything here is opt-in via the `fleet`/`fleet-replica` CLI commands;
+the default single-process `serve` path never imports this package.
+"""
+
+from __future__ import annotations
+
+__all__ = ["admission", "heartbeat", "replica", "router", "smoke"]

@@ -1,0 +1,317 @@
+"""Per-tenant admission control + deadline-aware load shedding
+(docs/fleet.md).
+
+The router calls `AdmissionController.decide()` once per ingress
+request, BEFORE any frontend or device time is spent — the whole point
+of shedding at the front door is that an over-deadline or over-budget
+request costs one dict lookup, not a feature extraction and a padded
+batch slot. Three independent mechanisms, checked in order:
+
+1. **capacity** — no routable replica => 503 `no_replicas`.
+2. **tenant token buckets** — each tenant owns a `rate`/`burst` bucket
+   (unlisted tenants share the default policy, each still getting their
+   OWN bucket so one noisy unlisted tenant cannot starve another).
+   An empty bucket => 429 `rate_limit` (the retry-later signal).
+3. **deadline + overload shed** — the controller keeps an EWMA of
+   observed service time; a request declaring `deadline_ms` that cannot
+   be met at the current fleet queue depth is shed 503 `deadline`.
+   Separately, past `shed_fraction` of estimated fleet capacity,
+   priority>0 (non-interactive) requests are shed 503 `overload` so
+   interactive traffic keeps its latency while batch traffic backs off.
+
+Every decision lands in `fleet/*` registry metrics (admitted and shed,
+by tenant and by priority class) so shed-rate is a first-class SLO
+observable, and the verdict carries enough to log (tenant, priority,
+reason, estimate) without re-deriving anything.
+
+`plan_coserving` is the PR-10 capacity arbiter for multi-model
+co-serving: given the per-entry param-bytes ledger signal and an HBM
+budget, which registry entries fit one host. Pure function — the
+replica uses it at load time, tests drive it directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+#: priority classes (lower = more important); the overload shed spares
+#: class 0 (interactive) and sheds the rest first
+INTERACTIVE, BATCH, BEST_EFFORT = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's admission contract."""
+
+    name: str
+    rate: float  # sustained tokens/second
+    burst: float  # bucket capacity (instantaneous burst allowance)
+    priority: int = BATCH
+
+    def __post_init__(self):
+        if self.rate < 0 or self.burst <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: rate must be >=0 and burst >0 "
+                f"(got rate={self.rate}, burst={self.burst})"
+            )
+        if self.priority < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: priority must be >=0"
+            )
+
+
+def parse_tenants(spec: str) -> dict[str, TenantPolicy]:
+    """cfg.fleet.tenants JSON -> {name: TenantPolicy}; '' -> {}."""
+    if not spec:
+        return {}
+    raw = json.loads(spec)
+    if not isinstance(raw, dict):
+        raise ValueError(
+            f"fleet.tenants must be a JSON object, got {type(raw).__name__}"
+        )
+    out: dict[str, TenantPolicy] = {}
+    for name, p in raw.items():
+        if not isinstance(p, dict):
+            raise ValueError(f"tenant {name!r} policy must be an object")
+        out[name] = TenantPolicy(
+            name=name,
+            rate=float(p.get("rate", 1.0)),
+            burst=float(p.get("burst", max(1.0, float(p.get("rate", 1.0))))),
+            priority=int(p.get("priority", BATCH)),
+        )
+    return out
+
+
+class TokenBucket:
+    """Classic token bucket: `burst` capacity refilled at `rate`/s.
+    Starts full (a tenant's first burst is the allowance, not a cold
+    penalty). Thread-safe."""
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._t = float(now)
+        self._lock = threading.Lock()
+
+    def try_take(self, now: float, n: float = 1.0) -> bool:
+        with self._lock:
+            dt = max(0.0, now - self._t)
+            self._t = now
+            self.tokens = min(self.burst, self.tokens + dt * self.rate)
+            if self.tokens >= n:
+                self.tokens -= n
+                return True
+            return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One admission verdict; `status` is the HTTP code a shed maps to
+    (429 back-off vs 503 overload/deadline — different caller action)."""
+
+    admit: bool
+    tenant: str
+    priority: int
+    status: int = 200
+    reason: str = "admitted"
+    estimated_wait_ms: float | None = None
+
+
+class AdmissionController:
+    """The router's front-door policy engine (one per router process).
+
+    `clock` is injectable so the bucket-refill and EWMA tests are
+    deterministic; production uses time.monotonic."""
+
+    #: cap on DISTINCT unlisted tenants tracked (own bucket + counters);
+    #: past it, new unlisted tenants collapse into one shared overflow
+    #: label — tenant names are client-controlled bytes, and unbounded
+    #: per-tenant state in the load-shedding component is a DoS vector
+    MAX_DYNAMIC_TENANTS = 1024
+    OVERFLOW_TENANT = "_other"
+
+    def __init__(
+        self,
+        tenants: dict[str, TenantPolicy] | None = None,
+        default_rate: float = 100.0,
+        default_burst: float = 200.0,
+        default_priority: int = BATCH,
+        replica_capacity: int = 64,
+        shed_fraction: float = 1.0,
+        service_time_init_ms: float = 50.0,
+        clock=time.monotonic,
+    ):
+        self.clock = clock
+        self.policies = dict(tenants or {})
+        self.default_rate = float(default_rate)
+        self.default_burst = float(default_burst)
+        self.default_priority = int(default_priority)
+        self.replica_capacity = int(replica_capacity)
+        self.shed_fraction = float(shed_fraction)
+        self._service_ewma_s = max(1e-6, service_time_init_ms / 1e3)
+        self._lock = threading.Lock()
+        self._buckets: dict[str, TokenBucket] = {}
+        r = obs_metrics.REGISTRY
+        self._m_admitted = r.counter("fleet/admitted")
+        self._m_shed = r.counter("fleet/shed")
+
+    # -- calibration ---------------------------------------------------------
+
+    def observe_service(self, seconds: float, alpha: float = 0.2) -> None:
+        """Fold one completed request's service time into the EWMA the
+        deadline shed estimates against (the router calls this on every
+        2xx completion)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._service_ewma_s = (
+                (1 - alpha) * self._service_ewma_s + alpha * float(seconds)
+            )
+
+    @property
+    def service_ewma_s(self) -> float:
+        with self._lock:
+            return self._service_ewma_s
+
+    def estimate_wait_s(self, outstanding: int, healthy: int) -> float:
+        """Expected completion time for a request admitted NOW: the
+        fleet's outstanding work divided across healthy replicas, plus
+        this request's own service time."""
+        if healthy <= 0:
+            return float("inf")
+        ewma = self.service_ewma_s
+        return (float(outstanding) / healthy + 1.0) * ewma
+
+    # -- policy --------------------------------------------------------------
+
+    def policy_for(self, tenant: str) -> TenantPolicy:
+        p = self.policies.get(tenant)
+        if p is not None:
+            return p
+        # unlisted tenants each get their own bucket (isolation) until
+        # the dynamic-tenant cap; beyond it they share the overflow
+        # label so a unique-tenant-per-request flood cannot grow state
+        with self._lock:
+            if (
+                tenant not in self._buckets
+                and len(self._buckets) >= self.MAX_DYNAMIC_TENANTS
+            ):
+                tenant = self.OVERFLOW_TENANT
+        return TenantPolicy(
+            name=tenant,
+            rate=self.default_rate,
+            burst=self.default_burst,
+            priority=self.default_priority,
+        )
+
+    def _bucket_for(self, policy: TenantPolicy, now: float) -> TokenBucket:
+        with self._lock:
+            b = self._buckets.get(policy.name)
+            if b is None:
+                b = self._buckets[policy.name] = TokenBucket(
+                    policy.rate, policy.burst, now
+                )
+            return b
+
+    def decide(
+        self,
+        tenant: str,
+        outstanding: int,
+        healthy: int,
+        deadline_ms: float | None = None,
+        priority: int | None = None,
+        now: float | None = None,
+    ) -> Decision:
+        """The one front-door verdict. A request may declare its own
+        `priority`, but only to DEMOTE itself below its tenant policy's
+        class — self-promotion to interactive would let any tenant
+        bypass the overload shed, the exact isolation it provides."""
+        now = self.clock() if now is None else now
+        policy = self.policy_for(tenant)
+        tenant = policy.name  # bounded label (dynamic-tenant overflow)
+        prio = policy.priority
+        if priority is not None:
+            prio = max(prio, int(priority))
+
+        def shed(status: int, reason: str, est_ms=None) -> Decision:
+            self._m_shed.inc()
+            r = obs_metrics.REGISTRY
+            r.counter(f"fleet/shed/{reason}").inc()
+            r.counter(f"fleet/tenant/{tenant}/shed").inc()
+            r.counter(f"fleet/priority/{min(prio, 9)}/shed").inc()
+            return Decision(
+                admit=False, tenant=tenant, priority=prio,
+                status=status, reason=reason, estimated_wait_ms=est_ms,
+            )
+
+        if healthy <= 0:
+            return shed(503, "no_replicas")
+        if not self._bucket_for(policy, now).try_take(now):
+            return shed(429, "rate_limit")
+        est_s = self.estimate_wait_s(outstanding, healthy)
+        est_ms = round(est_s * 1e3, 3)
+        if deadline_ms is not None and est_ms > float(deadline_ms):
+            return shed(503, "deadline", est_ms)
+        capacity = self.shed_fraction * healthy * self.replica_capacity
+        if prio > INTERACTIVE and outstanding >= capacity:
+            return shed(503, "overload", est_ms)
+        self._m_admitted.inc()
+        obs_metrics.REGISTRY.counter(f"fleet/tenant/{tenant}/admitted").inc()
+        return Decision(
+            admit=True, tenant=tenant, priority=prio,
+            estimated_wait_ms=est_ms,
+        )
+
+    def snapshot(self) -> dict:
+        """Live policy/bucket view for /stats and the fleet log."""
+        with self._lock:
+            buckets = {
+                name: round(b.tokens, 3) for name, b in self._buckets.items()
+            }
+            ewma_ms = round(self._service_ewma_s * 1e3, 3)
+        return {
+            "service_ewma_ms": ewma_ms,
+            "tokens": buckets,
+            "tenants": {
+                name: {
+                    "rate": p.rate, "burst": p.burst, "priority": p.priority,
+                }
+                for name, p in self.policies.items()
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# multi-model co-serving capacity arbitration (PR-10 ledger signal)
+
+
+def plan_coserving(
+    param_bytes: dict[str, float], hbm_budget_bytes: float
+) -> tuple[list[str], list[str]]:
+    """Which registry entries fit one host, per the per-entry param-bytes
+    ledger signal (obs/ledger.py:record_params — the co-serving capacity
+    signal PR 10 built). Greedy in declaration order: the operator lists
+    entries most-important-first, and an entry that would push the
+    running total past the budget is refused (loaded, refused).
+
+    budget <= 0 means unbudgeted: every entry fits (the single-model
+    default, and hosts whose HBM the operator hasn't characterized)."""
+    loaded: list[str] = []
+    refused: list[str] = []
+    if hbm_budget_bytes <= 0:
+        return list(param_bytes), refused
+    total = 0.0
+    for name, nbytes in param_bytes.items():
+        nbytes = float(nbytes)
+        if total + nbytes <= float(hbm_budget_bytes):
+            total += nbytes
+            loaded.append(name)
+        else:
+            refused.append(name)
+    return loaded, refused

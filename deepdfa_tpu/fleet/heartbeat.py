@@ -1,0 +1,107 @@
+"""Replica heartbeat files — the fleet's announcement protocol
+(docs/fleet.md).
+
+One JSON file per replica under the fleet dir, written atomically
+(core/ioutil.py) so the router never reads a torn document. The file is
+the replica's whole public record: where it listens, what it serves
+(checkpoint step + config/vocab digests, warmed signatures, recompile
+census), the cached `BackendHealth` report, the per-entry HBM
+param-bytes ledger snapshot (the co-serving capacity signal, PR 10),
+and its lifecycle state:
+
+    starting -> ready -> draining -> drained
+
+The router treats `ready` with a fresh timestamp as routable,
+`draining` as observe-but-don't-route (the replica is finishing its
+in-flight batches), and anything stale past `heartbeat_timeout_s` as
+gone. Files, not sockets, on purpose: a crashed replica leaves its last
+heartbeat behind as evidence, and the smoke/failure tests can inspect
+the fleet's state without a live process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+#: lifecycle states a heartbeat may declare
+STATES = ("starting", "ready", "draining", "drained")
+
+#: routable state — the only one the router forwards to
+READY = "ready"
+
+
+def heartbeat_path(fleet_dir: str | Path, replica_id: str) -> Path:
+    return Path(fleet_dir) / f"replica-{replica_id}.json"
+
+
+def write_heartbeat(
+    fleet_dir: str | Path,
+    replica_id: str,
+    host: str,
+    port: int,
+    state: str = READY,
+    info: dict | None = None,
+) -> Path:
+    """Atomically write one replica's heartbeat; returns the path.
+
+    `info` carries the replica's serving identity + capacity signals
+    (healthz-lite fields, backend report, ledger param bytes); the
+    envelope adds the routing essentials and the timestamp the router
+    ages against."""
+    if state not in STATES:
+        raise ValueError(f"unknown heartbeat state {state!r}; in {STATES}")
+    from deepdfa_tpu.core.ioutil import atomic_write_text
+
+    doc = {
+        "heartbeat": {
+            "replica_id": str(replica_id),
+            "pid": os.getpid(),
+            "host": str(host),
+            "port": int(port),
+            "state": state,
+            "t_unix": round(time.time(), 3),
+            **(info or {}),
+        }
+    }
+    path = heartbeat_path(fleet_dir, replica_id)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_text(path, json.dumps(doc))
+    return path
+
+
+def read_heartbeat(path: str | Path) -> dict | None:
+    """One parsed heartbeat document, or None when unreadable (a replica
+    mid-first-write, or a deleted file racing the scan)."""
+    try:
+        doc = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    hb = doc.get("heartbeat")
+    if not isinstance(hb, dict):
+        return None
+    required = ("replica_id", "host", "port", "state", "t_unix")
+    if any(k not in hb for k in required):
+        return None
+    return hb
+
+
+def scan_heartbeats(fleet_dir: str | Path) -> dict[str, dict]:
+    """{replica_id: heartbeat} for every readable heartbeat file."""
+    out: dict[str, dict] = {}
+    fleet_dir = Path(fleet_dir)
+    if not fleet_dir.is_dir():
+        return out
+    for path in sorted(fleet_dir.glob("replica-*.json")):
+        hb = read_heartbeat(path)
+        if hb is not None:
+            out[str(hb["replica_id"])] = hb
+    return out
+
+
+def is_fresh(hb: dict, timeout_s: float, now: float | None = None) -> bool:
+    """Has this heartbeat been refreshed inside the staleness window?"""
+    now = time.time() if now is None else now
+    return (now - float(hb.get("t_unix", 0.0))) <= float(timeout_s)

@@ -1,0 +1,512 @@
+"""Fleet replica worker: one shared-nothing serving process
+(docs/fleet.md).
+
+Each replica is a FULL single-process serving stack — ModelRegistry +
+RequestPreprocessor + DynamicBatcher with its own AOT-warmed bucket
+ladders (zero steady-state recompiles per replica, the Morphling
+invariant the fleet must preserve while scaling out) — plus the fleet
+half:
+
+- a heartbeat thread announcing the replica via an atomic JSON file
+  (fleet/heartbeat.py): address, checkpoint identity, recompile census,
+  the cached `BackendHealth` report, and the per-entry param-bytes
+  ledger snapshot (the PR-10 co-serving capacity signal);
+- multi-model co-serving: `fleet.models` entries each restore through
+  their own registry and score through their own batcher; requests pick
+  one with `{"model": name}`. How many entries actually load is
+  arbitrated by `plan_coserving` against `fleet.hbm_budget_bytes` using
+  measured param bytes — a refused entry is announced in the heartbeat,
+  never silently dropped;
+- graceful drain: SIGTERM/SIGINT (train/resilience.py's
+  PreemptionHandler, reused) flips the heartbeat to `draining`, stops
+  accepting, finishes every in-flight batch, appends a final SLO
+  snapshot record to the replica's serve log, dumps a flight-recorder
+  postmortem (obs/flight.py conventions), and exits 0 with the
+  heartbeat left at `drained` — the router observes every step.
+
+Per-replica obs home: `<fleet_dir>/<replica_id>/` holds the replica's
+serve_log.jsonl, trace files, and postmortem.json so N replicas sharing
+one run_dir never interleave writes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+from deepdfa_tpu.fleet import admission as fleet_admission, heartbeat
+from deepdfa_tpu.obs import (
+    flight as obs_flight,
+    ledger as obs_ledger,
+    metrics as obs_metrics,
+)
+from deepdfa_tpu.serve import server as serve_server
+from deepdfa_tpu.serve.server import (
+    RequestLog,
+    ScoringService,
+    UnknownModel,
+    write_serve_log,
+)
+
+logger = logging.getLogger(__name__)
+
+#: the primary model's entry name (requests without {"model": ...})
+PRIMARY = "default"
+
+
+def param_bytes(params) -> float:
+    """Total parameter bytes of one params pytree — the same accounting
+    obs/ledger.py:record_params uses, computed here so the heartbeat
+    carries the capacity signal whether or not the ledger is enabled."""
+    import numpy as np
+
+    total = 0.0
+    try:
+        import jax
+
+        leaves = jax.tree.leaves(params)
+    except Exception:
+        leaves = []
+    for leaf in leaves:
+        try:
+            total += float(
+                np.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+            )
+        except Exception:
+            continue
+    return total
+
+
+def parse_model_spec(spec: str) -> tuple[str, str, str]:
+    """One `fleet.models` entry: "name=run_dir" or
+    "name=run_dir:checkpoint" -> (name, run_dir, checkpoint)."""
+    name, sep, rest = spec.partition("=")
+    if not sep or not name or not rest:
+        raise ValueError(
+            f"fleet.models entry {spec!r} must be name=run_dir"
+            f"[:checkpoint]"
+        )
+    run_dir, sep, ckpt = rest.rpartition(":")
+    if not sep or "/" in ckpt or not run_dir:
+        run_dir, ckpt = rest, "best"
+    return name, run_dir, ckpt
+
+
+class _DrainingServer(ThreadingHTTPServer):
+    """Handler threads are joined on close so a drain never abandons an
+    in-flight response. They must be NON-daemon for that: socketserver
+    only tracks non-daemon handler threads for the block_on_close join
+    (a daemon thread is dropped from the list and never joined). The
+    threads are short-lived by construction — every wait in the handler
+    is bounded by request_timeout_s — so they cannot pin the process
+    open indefinitely."""
+
+    daemon_threads = False
+    block_on_close = True
+
+
+class ReplicaWorker:
+    """One replica process: services + HTTP server + heartbeat +
+    drain."""
+
+    def __init__(
+        self,
+        cfg,
+        run_dir: str | Path,
+        replica_id: str,
+        fleet_dir: str | Path | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        family: str = "deepdfa",
+    ):
+        self.cfg = cfg
+        self.run_dir = Path(run_dir)
+        self.replica_id = str(replica_id)
+        self.fleet_dir = Path(
+            fleet_dir if fleet_dir is not None
+            else (cfg.fleet.fleet_dir or self.run_dir / "fleet")
+        )
+        self.obs_dir = self.fleet_dir / self.replica_id
+        self.obs_dir.mkdir(parents=True, exist_ok=True)
+        self.host = host
+        self.port = int(port)
+        self.family = family
+        self.started_unix = time.time()
+        self.services: dict[str, ScoringService] = {}
+        self.coserve_refused: list[str] = []
+        self.httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._state = "starting"
+        self._state_lock = threading.Lock()
+
+    # -- construction --------------------------------------------------------
+
+    def _build_service(
+        self, run_dir: Path, checkpoint: str
+    ) -> tuple[ScoringService, float]:
+        """(service, measured param bytes) for one registry entry; the
+        restore happens first so co-serving admission decides on the
+        MEASURED capacity signal before the expensive AOT warmup."""
+        from deepdfa_tpu.serve.registry import ModelRegistry
+
+        cfg = self.cfg if run_dir == self.run_dir else None
+        registry = ModelRegistry(
+            run_dir, family=self.family, checkpoint=checkpoint, cfg=cfg
+        )
+        nbytes = param_bytes(registry.params())
+        service = ScoringService(registry, registry.cfg)
+        if service.request_log is not None:
+            # per-replica log home: N replicas must never interleave
+            # appends into the run dir's single serve_log.jsonl
+            service.request_log.close()
+            service.request_log = RequestLog(
+                self.obs_dir / "serve_log.jsonl"
+            )
+        return service, nbytes
+
+    def build(self) -> None:
+        """Restore + warm every co-served entry the HBM budget admits
+        (primary first — it is never refused; a budget too small for the
+        primary is an operator error worth failing loudly)."""
+        specs: list[tuple[str, Path, str]] = [
+            (PRIMARY, self.run_dir, self.cfg.serve.checkpoint)
+        ]
+        for spec in self.cfg.fleet.models:
+            name, run_dir, ckpt = parse_model_spec(spec)
+            if name == PRIMARY:
+                raise ValueError(
+                    f"fleet.models entry {spec!r} shadows the primary "
+                    f"entry name {PRIMARY!r}"
+                )
+            specs.append((name, Path(run_dir), ckpt))
+        budget = float(self.cfg.fleet.hbm_budget_bytes)
+        measured: dict[str, float] = {}
+        for name, run_dir, ckpt in specs:
+            service, nbytes = self._build_service(run_dir, ckpt)
+            measured[name] = nbytes
+            loaded, refused = fleet_admission.plan_coserving(
+                measured, budget
+            )
+            if name in refused:
+                if name == PRIMARY:
+                    raise RuntimeError(
+                        f"fleet.hbm_budget_bytes={budget:g} cannot fit "
+                        f"even the primary entry "
+                        f"({nbytes:g} param bytes)"
+                    )
+                # refused by the capacity arbiter: announced, not loaded
+                service.close()
+                measured.pop(name)
+                self.coserve_refused.append(name)
+                obs_metrics.REGISTRY.counter(
+                    "fleet/coserve_refused"
+                ).inc()
+                logger.warning(
+                    "co-serving entry %r refused: %g param bytes would "
+                    "exceed fleet.hbm_budget_bytes=%g",
+                    name, nbytes, budget,
+                )
+                continue
+            self.services[name] = service
+        self._measured_param_bytes = measured
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def state(self) -> str:
+        with self._state_lock:
+            return self._state
+
+    def set_state(self, state: str) -> None:
+        with self._state_lock:
+            self._state = state
+        self.write_heartbeat()
+
+    def heartbeat_info(self) -> dict:
+        primary = self.services.get(PRIMARY)
+        info: dict = {
+            "started_unix": round(self.started_unix, 3),
+            "models": sorted(self.services),
+            "coserve_refused": list(self.coserve_refused),
+            "hbm_budget_bytes": float(self.cfg.fleet.hbm_budget_bytes),
+        }
+        if primary is not None:
+            reg = primary.registry.info()
+            info.update(
+                checkpoint_step=reg.get("checkpoint_step"),
+                config_digest=reg.get("config_digest"),
+                vocab_digest=reg.get("vocab_digest"),
+                jit_lowerings=sum(
+                    s._jit_lowerings() for s in self.services.values()
+                ),
+                steady_state_recompiles=sum(
+                    s.steady_state_recompiles()
+                    for s in self.services.values()
+                ),
+                queue_depth=sum(
+                    s.batcher.stats()["queue_depth"]
+                    for s in self.services.values()
+                ),
+                backend=primary.health.last(),
+            )
+        # the co-serving capacity signal: measured per-entry param
+        # bytes, plus the efficiency ledger's own per-entry view when on
+        ledger_params = dict(
+            getattr(self, "_measured_param_bytes", {}) or {}
+        )
+        led = obs_ledger.snapshot_or_none()
+        if led is not None and isinstance(led.get("params"), dict):
+            ledger_params.update(led["params"])
+        info["ledger_params"] = ledger_params
+        return info
+
+    def write_heartbeat(self) -> None:
+        try:
+            heartbeat.write_heartbeat(
+                self.fleet_dir, self.replica_id, self.host, self.port,
+                state=self.state(), info=self.heartbeat_info(),
+            )
+        except OSError:
+            logger.exception("heartbeat write failed")
+
+    # -- serving surface -----------------------------------------------------
+
+    def healthz(self, deep: bool = False) -> dict:
+        primary = self.services[PRIMARY]
+        out = primary.healthz(deep=deep)
+        out.update(
+            replica_id=self.replica_id,
+            state=self.state(),
+            models={
+                name: {
+                    "jit_lowerings": svc._jit_lowerings(),
+                    "steady_state_recompiles": (
+                        svc.steady_state_recompiles()
+                    ),
+                }
+                for name, svc in self.services.items()
+            },
+            coserve_refused=list(self.coserve_refused),
+        )
+        return out
+
+    def stats(self) -> dict:
+        primary = self.services[PRIMARY]
+        out = primary.stats()
+        out["replica_id"] = self.replica_id
+        out["state"] = self.state()
+        if len(self.services) > 1:
+            out["models"] = {
+                name: svc.batcher.stats()
+                for name, svc in self.services.items()
+            }
+        return out
+
+    def _make_server(self) -> ThreadingHTTPServer:
+        worker = self
+
+        class _ReplicaHandler(serve_server._Handler):
+            service = self.services[PRIMARY]
+
+            def _service_for(self, payload):
+                name = payload.get("model")
+                if name is None:
+                    return worker.services[PRIMARY]
+                svc = worker.services.get(str(name))
+                if svc is None:
+                    raise UnknownModel(
+                        f"no co-served model {name!r} on this replica "
+                        f"(have {sorted(worker.services)})"
+                    )
+                return svc
+
+            def do_GET(self):  # noqa: N802
+                import urllib.parse
+
+                url = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(url.query)
+                if url.path == "/healthz":
+                    deep = query.get("deep", ["0"])[0] not in (
+                        "", "0", "false"
+                    )
+                    self._reply(200, worker.healthz(deep=deep))
+                elif url.path == "/stats":
+                    self._reply(200, worker.stats())
+                else:
+                    super().do_GET()
+
+        return _DrainingServer((self.host, self.port), _ReplicaHandler)
+
+    def start(self) -> None:
+        """Build, warm, bind, announce — returns with the replica
+        routable (heartbeat `ready`)."""
+        self.write_heartbeat()  # `starting`: visible while warming
+        self.build()
+        for svc in self.services.values():
+            svc.start()
+        self.httpd = self._make_server()
+        self.port = self.httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name=f"fleet-replica-{self.replica_id}", daemon=True,
+        )
+        self._http_thread.start()
+        self.set_state(heartbeat.READY)
+
+    def drain(self, trigger: str = "sigterm") -> None:
+        """The graceful exit: announce, stop accepting, finish in-flight
+        work, leave the final SLO snapshot + postmortem behind."""
+        self.set_state("draining")
+        # lame-duck period: keep serving while the router's poll cadence
+        # observes the drain and stops routing here
+        time.sleep(max(0.0, float(self.cfg.fleet.drain_announce_s)))
+        if self.httpd is not None:
+            # stop the accept loop first; in-flight handler threads keep
+            # running (the batcher scheduler is still alive to finish
+            # their batches) and server_close joins them
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=30)
+        final_slo: dict = {}
+        for name, svc in self.services.items():
+            svc.batcher.close()  # force-flushes everything still queued
+            record = dict(svc.serve_record())
+            record["serve_steady_state_recompiles"] = (
+                svc.steady_state_recompiles()
+            )
+            write_serve_log(self.obs_dir, [record])
+            final_slo[name] = svc.slo.snapshot()
+        # the drain postmortem (obs/flight.py conventions): a no-op
+        # unless the flight recorder is installed for this process
+        obs_flight.crash_dump(trigger, extra={
+            "replica_id": self.replica_id,
+            "drain": True,
+            "slo": final_slo,
+        })
+        for svc in self.services.values():
+            svc.close()
+        self.set_state("drained")
+
+    def run(self, ready_line: bool = True) -> int:
+        """The replica main loop: install the preemption handler, serve
+        + heartbeat until SIGTERM/SIGINT, then drain. Returns the
+        process exit code."""
+        from deepdfa_tpu.train.resilience import PreemptionHandler
+
+        handler = PreemptionHandler(
+            (signal.SIGTERM, signal.SIGINT)
+        ).install()
+        try:
+            self.start()
+            if ready_line:
+                print(json.dumps({
+                    "replica": self.replica_id,
+                    "host": self.host,
+                    "port": self.port,
+                    "models": sorted(self.services),
+                    "heartbeat": str(heartbeat.heartbeat_path(
+                        self.fleet_dir, self.replica_id
+                    )),
+                }), flush=True)
+            interval = float(self.cfg.fleet.heartbeat_interval_s)
+            next_beat = time.monotonic()
+            while not handler.triggered:
+                now = time.monotonic()
+                if now >= next_beat:
+                    self.write_heartbeat()
+                    next_beat = now + interval
+                # short sleeps so a drain signal is observed promptly
+                time.sleep(min(0.1, interval))
+            self.drain()
+            return 0
+        finally:
+            handler.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# replica process management (cli `fleet`, fleet/smoke.py)
+
+
+def replica_command(
+    run_dir: str | Path,
+    replica_id: str,
+    fleet_dir: str | Path,
+    overrides: list[str] | None = None,
+    host: str = "127.0.0.1",
+    port: int = 0,
+) -> list[str]:
+    """argv for one replica subprocess (the `fleet-replica` CLI)."""
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "deepdfa_tpu.cli", "fleet-replica",
+        "--run-dir", str(run_dir),
+        "--replica-id", str(replica_id),
+        "--fleet-dir", str(fleet_dir),
+        "--host", host, "--port", str(port),
+    ]
+    for ov in overrides or []:
+        cmd += ["--override", ov]
+    return cmd
+
+
+def spawn_replicas(
+    run_dir: str | Path,
+    fleet_dir: str | Path,
+    n: int,
+    overrides: list[str] | None = None,
+    host: str = "127.0.0.1",
+):
+    """Start N replica subprocesses; [(replica_id, Popen)]."""
+    import subprocess
+
+    procs = []
+    for i in range(int(n)):
+        rid = f"r{i}"
+        procs.append((rid, subprocess.Popen(
+            replica_command(
+                run_dir, rid, fleet_dir, overrides=overrides, host=host
+            ),
+        )))
+    return procs
+
+
+def wait_for_ready(
+    fleet_dir: str | Path,
+    replica_ids: list[str],
+    timeout_s: float = 300.0,
+    procs=None,
+) -> dict[str, dict]:
+    """Block until every listed replica's heartbeat says `ready`;
+    returns {replica_id: heartbeat}. Raises on timeout or on a replica
+    process that exited before becoming ready."""
+    deadline = time.time() + float(timeout_s)
+    want = set(map(str, replica_ids))
+    while True:
+        beats = heartbeat.scan_heartbeats(fleet_dir)
+        ready = {
+            rid: hb for rid, hb in beats.items()
+            if rid in want and hb.get("state") == heartbeat.READY
+        }
+        if set(ready) == want:
+            return ready
+        if procs is not None:
+            for rid, proc in procs:
+                if rid in want and proc.poll() is not None and (
+                    rid not in ready
+                ):
+                    raise RuntimeError(
+                        f"replica {rid} exited rc={proc.returncode} "
+                        f"before becoming ready"
+                    )
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"replicas not ready in {timeout_s}s: missing "
+                f"{sorted(want - set(ready))}"
+            )
+        time.sleep(0.1)

@@ -1,0 +1,783 @@
+"""Fleet front door: health-gated least-outstanding routing with
+eject/readmit and in-flight retry (docs/fleet.md).
+
+One stdlib-HTTP process in front of N replica workers:
+
+  POST /score    admission (fleet/admission.py) -> pick the routable
+                 replica with the fewest outstanding forwards -> proxy.
+                 A transport failure (connection refused/reset/timeout —
+                 the replica died or wedged mid-request) ejects the
+                 replica and retries the SAME request on a survivor:
+                 scores are bit-identical regardless of which replica
+                 batches them (tests/test_serve.py property), so a retry
+                 can never return a different answer, only a later one.
+  GET  /healthz  fleet topology: per-replica state/outstanding/eject
+                 status + the admission snapshot
+  GET  /stats    the same plus the router's rolling SLO windows
+  GET  /metrics  Prometheus text: fleet/* registry + SLO families
+
+Request identity: the router assigns the request id at ingress and
+propagates it via `X-Request-Id`; the replica's serving spans adopt it
+(serve/server.py), so one request's Perfetto flow chain spans
+router -> replica frontend -> queue -> device across process traces.
+
+Replica lifecycle, from heartbeats (fleet/heartbeat.py): `ready` +
+fresh => routable; `draining` => observed but not routed (the drain
+contract); stale or `drained` => gone. Ejected replicas are probed
+(`GET /healthz`, bounded) on the poll cadence and readmitted on success
++ a fresh heartbeat — a replica that recovered rejoins without operator
+action. Every eject/readmit/drain/gone transition is a `fleet_event`
+line in fleet_log.jsonl next to the per-request entries; the log is
+validated by `scripts/check_obs_schema.py --fleet-log`.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from deepdfa_tpu.fleet import admission as fleet_admission, heartbeat
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.obs.slo import SloEngine, registry_exposition
+from deepdfa_tpu.serve.batcher import new_request_id
+
+logger = logging.getLogger(__name__)
+
+#: the declared fleet_event vocabulary (validate_fleet_log enforces it)
+EVENTS = ("join", "eject", "readmit", "drain_observed", "gone")
+
+#: transport-level failures that mean "the replica, not the request"
+TRANSPORT_ERRORS = (
+    ConnectionError,
+    socket.timeout,
+    TimeoutError,
+    http.client.HTTPException,
+    OSError,
+)
+
+
+class FleetLog:
+    """Thread-safe appender to fleet_log.jsonl (the serve RequestLog
+    rule: one handle, flushed per entry, tail-able while serving)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._file = self.path.open("a")
+
+    def append(self, entry: dict) -> None:
+        line = json.dumps(entry)
+        with self._lock:
+            if not self._file.closed:
+                self._file.write(line + "\n")
+                self._file.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._file.closed:
+                self._file.close()
+
+
+class ReplicaView:
+    """Router-side state for one replica (heartbeat + routing health)."""
+
+    __slots__ = (
+        "id", "host", "port", "state", "t_heartbeat", "info",
+        "outstanding", "ejected", "consecutive_failures", "forwarded",
+        "drain_logged",
+    )
+
+    def __init__(self, hb: dict):
+        self.id = str(hb["replica_id"])
+        self.outstanding = 0
+        self.ejected = False
+        self.consecutive_failures = 0
+        self.forwarded = 0
+        self.drain_logged = False
+        self.update(hb)
+
+    def update(self, hb: dict) -> None:
+        self.host = str(hb["host"])
+        self.port = int(hb["port"])
+        self.state = str(hb["state"])
+        self.t_heartbeat = float(hb["t_unix"])
+        self.info = {
+            k: v for k, v in hb.items()
+            if k not in ("replica_id", "host", "port", "state", "t_unix")
+        }
+
+    def routable(self, timeout_s: float, now: float) -> bool:
+        return (
+            not self.ejected
+            and self.state == heartbeat.READY
+            and (now - self.t_heartbeat) <= timeout_s
+        )
+
+    def view(self, timeout_s: float, now: float) -> dict:
+        return {
+            "id": self.id,
+            "addr": f"{self.host}:{self.port}",
+            "state": self.state,
+            "outstanding": self.outstanding,
+            "forwarded": self.forwarded,
+            "ejected": self.ejected,
+            "routable": self.routable(timeout_s, now),
+            "heartbeat_age_s": round(now - self.t_heartbeat, 3),
+            "steady_state_recompiles": self.info.get(
+                "steady_state_recompiles"
+            ),
+            "ledger_params": self.info.get("ledger_params"),
+        }
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every routable replica failed (or none exists) for one request."""
+
+
+class Router:
+    """Routing + admission + fleet bookkeeping for one router process.
+
+    Transport-only retry policy: `forward()` tries up to 1 + `retries`
+    DISTINCT replicas; a replica that fails at the transport level is
+    ejected at `eject_threshold` consecutive failures and the request
+    moves on. HTTP responses (any status) pass through — a 4xx/5xx from
+    a live replica is the request's verdict, not the replica's."""
+
+    def __init__(
+        self,
+        fleet_dir: str | Path,
+        heartbeat_timeout_s: float = 10.0,
+        poll_interval_s: float = 0.5,
+        eject_threshold: int = 1,
+        retries: int = 2,
+        request_timeout_s: float = 60.0,
+        admission: fleet_admission.AdmissionController | None = None,
+        log: FleetLog | None = None,
+        slo: SloEngine | None = None,
+        probe_timeout_s: float = 5.0,
+    ):
+        self.fleet_dir = Path(fleet_dir)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.eject_threshold = max(1, int(eject_threshold))
+        self.retries = max(0, int(retries))
+        self.request_timeout_s = float(request_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.admission = admission or fleet_admission.AdmissionController()
+        self.log = log
+        self.slo = slo or SloEngine()
+        self._lock = threading.Lock()
+        self._replicas: dict[str, ReplicaView] = {}
+        self._last_poll = 0.0
+        self._closed = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+        r = obs_metrics.REGISTRY
+        self._m_requests = r.counter("fleet/requests")
+        self._m_forwarded = r.counter("fleet/forwarded")
+        self._m_retries = r.counter("fleet/retries")
+        self._m_ejects = r.counter("fleet/ejects")
+        self._m_readmits = r.counter("fleet/readmits")
+        self._m_unroutable = r.counter("fleet/unroutable")
+        self._m_healthy = r.gauge("fleet/replicas_routable")
+        self._m_known = r.gauge("fleet/replicas_known")
+        self.poll(force=True)
+
+    # -- fleet view ----------------------------------------------------------
+
+    def _event(self, name: str, **args) -> None:
+        if name not in EVENTS:
+            raise ValueError(f"unknown fleet event {name!r}; in {EVENTS}")
+        obs_trace.instant(f"fleet_{name}", cat="fleet", **args)
+        if self.log is not None:
+            self.log.append({"fleet_event": {
+                "name": name, "t_unix": round(time.time(), 3), **args,
+            }})
+
+    def poll(self, force: bool = False, now: float | None = None) -> None:
+        """Refresh the replica table from the heartbeat dir (rate-
+        limited to the poll cadence unless forced)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if not force and (now - self._last_poll) < self.poll_interval_s:
+                return
+            self._last_poll = now
+        beats = heartbeat.scan_heartbeats(self.fleet_dir)
+        with self._lock:
+            for rid, hb in beats.items():
+                rep = self._replicas.get(rid)
+                if rep is None:
+                    # a drained/stale heartbeat FILE lingers on disk by
+                    # design (crash evidence); it must not churn a
+                    # join+gone event pair every poll tick
+                    if hb["state"] == "drained" or not heartbeat.is_fresh(
+                        hb, self.heartbeat_timeout_s, now=now
+                    ):
+                        continue
+                    self._replicas[rid] = rep = ReplicaView(hb)
+                    self._event(
+                        "join", replica=rid,
+                        addr=f"{rep.host}:{rep.port}",
+                    )
+                else:
+                    # a fresh heartbeat alone never readmits an ejected
+                    # replica — the probe loop must also reach it
+                    # (probe_ejected)
+                    rep.update(hb)
+                if rep.state == "draining" and not rep.drain_logged:
+                    rep.drain_logged = True
+                    self._event("drain_observed", replica=rid)
+            gone = [
+                rid for rid, rep in self._replicas.items()
+                if rep.state == "drained"
+                or (now - rep.t_heartbeat) > self.heartbeat_timeout_s
+            ]
+            for rid in gone:
+                rep = self._replicas.pop(rid)
+                self._event(
+                    "gone", replica=rid, state=rep.state,
+                    heartbeat_age_s=round(now - rep.t_heartbeat, 3),
+                )
+            routable = sum(
+                1 for r in self._replicas.values()
+                if r.routable(self.heartbeat_timeout_s, now)
+            )
+            self._m_known.set(len(self._replicas))
+            self._m_healthy.set(routable)
+
+    def probe_ejected(self) -> None:
+        """Bounded GET /healthz against every ejected replica; success +
+        a fresh heartbeat readmits it (the recover-without-operator
+        path)."""
+        now = time.time()
+        with self._lock:
+            targets = [
+                (rep.id, rep.host, rep.port)
+                for rep in self._replicas.values()
+                if rep.ejected
+                and (now - rep.t_heartbeat) <= self.heartbeat_timeout_s
+            ]
+        for rid, host, port in targets:
+            try:
+                conn = http.client.HTTPConnection(
+                    host, port, timeout=self.probe_timeout_s
+                )
+                try:
+                    conn.request("GET", "/healthz")
+                    ok = conn.getresponse().status == 200
+                finally:
+                    conn.close()
+            except TRANSPORT_ERRORS:
+                continue
+            if ok:
+                with self._lock:
+                    rep = self._replicas.get(rid)
+                    if rep is not None and rep.ejected:
+                        rep.ejected = False
+                        rep.consecutive_failures = 0
+                        self._m_readmits.inc()
+                        self._event("readmit", replica=rid)
+
+    def start_polling(self) -> None:
+        if self._poll_thread is not None:
+            return
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="fleet-router-poll", daemon=True
+        )
+        self._poll_thread.start()
+
+    def _poll_loop(self) -> None:
+        while not self._closed.wait(self.poll_interval_s):
+            try:
+                self.poll(force=True)
+                self.probe_ejected()
+            except Exception:
+                logger.exception("fleet poll failed")
+
+    # -- routing -------------------------------------------------------------
+
+    def _pick(self, exclude: set[str], now: float) -> ReplicaView | None:
+        """Least-outstanding routable replica; ties break to the least
+        forwarded-so-far (sequential traffic round-robins instead of
+        pinning the first id), then stable id order (deterministic)."""
+        with self._lock:
+            candidates = [
+                rep for rid, rep in sorted(self._replicas.items())
+                if rid not in exclude
+                and rep.routable(self.heartbeat_timeout_s, now)
+            ]
+            if not candidates:
+                return None
+            rep = min(
+                candidates,
+                key=lambda r: (r.outstanding, r.forwarded, r.id),
+            )
+            rep.outstanding += 1
+            return rep
+
+    def _release(self, rep: ReplicaView, failed: bool) -> None:
+        with self._lock:
+            rep.outstanding = max(0, rep.outstanding - 1)
+            if failed:
+                rep.consecutive_failures += 1
+                if (
+                    not rep.ejected
+                    and rep.consecutive_failures >= self.eject_threshold
+                ):
+                    rep.ejected = True
+                    self._m_ejects.inc()
+                    self._event(
+                        "eject", replica=rep.id,
+                        failures=rep.consecutive_failures,
+                    )
+            else:
+                rep.consecutive_failures = 0
+                rep.forwarded += 1
+
+    def outstanding_total(self) -> int:
+        with self._lock:
+            return sum(r.outstanding for r in self._replicas.values())
+
+    def routable_count(self, now: float | None = None) -> int:
+        now = time.time() if now is None else now
+        with self._lock:
+            return sum(
+                1 for r in self._replicas.values()
+                if r.routable(self.heartbeat_timeout_s, now)
+            )
+
+    def forward(
+        self, body: bytes, request_id: str, path: str = "/score"
+    ) -> tuple[int, bytes, str, int]:
+        """Proxy one request; (status, body, replica_id, retries).
+
+        Raises NoReplicaAvailable when every attempt exhausted a
+        distinct replica (or none was routable to begin with)."""
+        tried: set[str] = set()
+        attempts = 0
+        last_error: Exception | None = None
+        while attempts <= self.retries:
+            self.poll()
+            rep = self._pick(tried, time.time())
+            if rep is None:
+                break
+            tried.add(rep.id)
+            attempts += 1
+            if attempts > 1:
+                self._m_retries.inc()
+            try:
+                with obs_trace.span(
+                    "router_forward", cat="fleet", request_id=request_id,
+                    replica=rep.id,
+                ):
+                    obs_trace.flow("request", request_id, "s", cat="fleet")
+                    conn = http.client.HTTPConnection(
+                        rep.host, rep.port, timeout=self.request_timeout_s
+                    )
+                    try:
+                        conn.request(
+                            "POST", path, body=body,
+                            headers={
+                                "Content-Type": "application/json",
+                                "X-Request-Id": request_id,
+                            },
+                        )
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        status = resp.status
+                    finally:
+                        conn.close()
+            except TRANSPORT_ERRORS as e:
+                # the replica, not the request: eject-count and retry on
+                # a survivor — this is the no-request-lost path
+                last_error = e
+                self._release(rep, failed=True)
+                obs_trace.instant(
+                    "fleet_forward_failed", cat="fleet",
+                    request_id=request_id, replica=rep.id,
+                    error=str(e)[:200],
+                )
+                continue
+            self._release(rep, failed=False)
+            self._m_forwarded.inc()
+            return status, data, rep.id, attempts - 1
+        self._m_unroutable.inc()
+        raise NoReplicaAvailable(
+            f"no routable replica for request {request_id} "
+            f"(tried {sorted(tried)}; last error: {last_error})"
+        )
+
+    # -- records -------------------------------------------------------------
+
+    def log_request(
+        self,
+        request_id: str,
+        status: int,
+        latency_s: float,
+        tenant: str,
+        priority: int,
+        replica: str | None = None,
+        retries: int = 0,
+        deadline_ms: float | None = None,
+        shed_reason: str | None = None,
+    ) -> None:
+        """The router's per-request epilogue: SLO ingest + one
+        {"request": {...}} fleet_log line (admitted AND shed — the shed
+        population is exactly the one overload analysis needs)."""
+        self._m_requests.inc()
+        self.slo.observe_request(status, latency_s)
+        if status == 200:
+            self.admission.observe_service(latency_s)
+        if self.log is None:
+            return
+        entry: dict = {
+            "id": request_id, "status": int(status),
+            "latency_ms": round(latency_s * 1e3, 3),
+            "t_unix": round(time.time(), 3),
+            "tenant": tenant, "priority": int(priority),
+            "retries": int(retries),
+            "shed": 0 if shed_reason is None else 1,
+        }
+        if replica is not None:
+            entry["replica"] = replica
+        if deadline_ms is not None:
+            entry["deadline_ms"] = float(deadline_ms)
+        if shed_reason is not None:
+            entry["reason"] = shed_reason
+        self.log.append({"request": entry})
+
+    def topology(self, now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        self.poll(now=now)
+        with self._lock:
+            replicas = [
+                rep.view(self.heartbeat_timeout_s, now)
+                for _, rep in sorted(self._replicas.items())
+            ]
+        return {
+            "fleet": True,
+            "fleet_dir": str(self.fleet_dir),
+            "replicas": replicas,
+            "routable": sum(1 for r in replicas if r["routable"]),
+            "admission": self.admission.snapshot(),
+        }
+
+    def summary_record(self) -> dict:
+        """One fleet_log summary record (the run-log shape the schema
+        checker validates): the fleet/* registry snapshot, the SLO
+        windows, and the topology scalars."""
+        snap = obs_metrics.REGISTRY.snapshot()
+        return {
+            "fleet": {
+                k[len("fleet/"):]: v
+                for k, v in snap.items() if k.startswith("fleet/")
+            },
+            "fleet_slo": self.slo.snapshot(),
+            "fleet_replicas": self.routable_count(),
+        }
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+            self._poll_thread = None
+        if self.log is not None:
+            self.log.append(self.summary_record())
+            self.log.close()
+
+
+def router_from_config(
+    cfg, fleet_dir: str | Path, log_path: str | Path | None = None
+) -> Router:
+    """One configured Router (admission policies, cadences, SLO windows,
+    fleet log) from a Config — the `fleet` CLI's and the smoke's shared
+    construction path."""
+    fcfg = cfg.fleet
+    admission = fleet_admission.AdmissionController(
+        tenants=fleet_admission.parse_tenants(fcfg.tenants),
+        default_rate=fcfg.default_rate,
+        default_burst=fcfg.default_burst,
+        default_priority=fcfg.default_priority,
+        replica_capacity=fcfg.replica_capacity,
+        shed_fraction=fcfg.shed_fraction,
+        service_time_init_ms=fcfg.service_time_init_ms,
+    )
+    return Router(
+        fleet_dir,
+        heartbeat_timeout_s=fcfg.heartbeat_timeout_s,
+        poll_interval_s=fcfg.poll_interval_s,
+        eject_threshold=fcfg.eject_threshold,
+        retries=fcfg.retries,
+        request_timeout_s=fcfg.request_timeout_s,
+        admission=admission,
+        log=FleetLog(log_path) if log_path is not None else None,
+        slo=SloEngine(
+            windows=cfg.serve.slo_windows,
+            max_samples=cfg.serve.slo_window_samples,
+        ),
+    )
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: Router = None  # bound by make_router_server
+
+    def log_message(self, fmt, *args):
+        logger.debug("router http: " + fmt, *args)
+
+    def _reply(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_raw(
+        self, status: int, body: bytes,
+        content_type: str = "application/json",
+    ) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        url = urllib.parse.urlsplit(self.path)
+        if url.path == "/healthz":
+            self._reply(200, self.router.topology())
+        elif url.path == "/stats":
+            out = self.router.topology()
+            out["slo"] = self.router.slo.snapshot()
+            snap = obs_metrics.REGISTRY.snapshot()
+            out["fleet"] = {
+                k[len("fleet/"):]: v
+                for k, v in snap.items() if k.startswith("fleet/")
+            }
+            self._reply(200, out)
+        elif url.path == "/metrics":
+            text = registry_exposition() + self.router.slo.exposition()
+            self._reply_raw(
+                200, text.encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/score":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        router = self.router
+        rid = self.headers.get("X-Request-Id") or new_request_id()
+        t0 = time.monotonic()
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) or b"{}"
+            payload = json.loads(body)
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, KeyError) as e:
+            router.log_request(
+                rid, 400, time.monotonic() - t0, tenant="unknown",
+                priority=fleet_admission.BATCH, shed_reason="bad_request",
+            )
+            self._reply(400, {
+                "error": f"bad request: {e}", "request_id": rid,
+            })
+            return
+        tenant = (
+            self.headers.get("X-Tenant")
+            or payload.get("tenant") or "default"
+        )
+        deadline_ms = self.headers.get("X-Deadline-Ms")
+        if deadline_ms is None:
+            deadline_ms = payload.get("deadline_ms")
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                deadline_ms = None
+        priority = payload.get("priority")
+        if priority is not None:
+            try:
+                priority = int(priority)
+            except (TypeError, ValueError):
+                priority = None
+        router.poll()
+        decision = router.admission.decide(
+            str(tenant),
+            outstanding=router.outstanding_total(),
+            healthy=router.routable_count(),
+            deadline_ms=deadline_ms,
+            priority=priority,
+        )
+        if not decision.admit:
+            # shed BEFORE any forward: no frontend or device time spent
+            router.log_request(
+                rid, decision.status, time.monotonic() - t0,
+                tenant=decision.tenant, priority=decision.priority,
+                deadline_ms=deadline_ms, shed_reason=decision.reason,
+            )
+            self._reply(decision.status, {
+                "error": f"shed: {decision.reason}",
+                "reason": decision.reason,
+                "request_id": rid,
+                "estimated_wait_ms": decision.estimated_wait_ms,
+            })
+            return
+        try:
+            status, data, replica, retries = router.forward(body, rid)
+        except NoReplicaAvailable as e:
+            router.log_request(
+                rid, 503, time.monotonic() - t0,
+                tenant=decision.tenant, priority=decision.priority,
+                deadline_ms=deadline_ms, shed_reason="no_replicas",
+            )
+            self._reply(503, {"error": str(e), "request_id": rid})
+            return
+        router.log_request(
+            rid, status, time.monotonic() - t0,
+            tenant=decision.tenant, priority=decision.priority,
+            replica=replica, retries=retries, deadline_ms=deadline_ms,
+        )
+        self._reply_raw(status, data)
+
+
+def make_router_server(
+    router: Router, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """Bound (not yet serving) router HTTP server; port 0 = ephemeral
+    (server.server_address[1] has the real one)."""
+    handler = type("BoundRouterHandler", (_RouterHandler,), {
+        "router": router,
+    })
+    return ThreadingHTTPServer((host, port), handler)
+
+
+class BackgroundRouter:
+    """In-process router on an ephemeral port (smoke mode + tests)."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1"):
+        self.router = router
+        router.start_polling()
+        self.httpd = make_router_server(router, host, 0)
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def request(
+        self, method: str, path: str, payload: dict | None = None,
+        headers: dict | None = None,
+    ):
+        status, raw = self.request_text(method, path, payload, headers)
+        return status, json.loads(raw or "{}")
+
+    def request_text(
+        self, method: str, path: str, payload: dict | None = None,
+        headers: dict | None = None,
+    ):
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=120)
+        body = json.dumps(payload) if payload is not None else None
+        hdrs = dict(headers or {})
+        if body:
+            hdrs.setdefault("Content-Type", "application/json")
+        conn.request(method, path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        data = resp.read().decode("utf-8", "replace")
+        conn.close()
+        return resp.status, data
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=10)
+        self.router.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet log validation (scripts/check_obs_schema.py --fleet-log)
+
+
+def validate_fleet_log(path: str | Path) -> dict:
+    """Structural + schema validation of a router fleet_log.jsonl.
+
+    Three legal line shapes: {"request": {...}} per-request entries
+    (id + status required), {"fleet_event": {...}} lifecycle events
+    (declared name + t_unix required), and summary records embedding
+    the fleet/* registry snapshot + fleet_slo windows. Every flattened
+    scalar tag must be declared in obs/metrics.py:SCHEMA — the same
+    drift guard the train/serve/scan logs get."""
+    path = Path(path)
+    problems: list[str] = []
+    records: list[dict] = []
+    try:
+        lines = path.read_text().splitlines()
+    except OSError as e:
+        return {"ok": False, "problems": [f"unreadable: {e}"]}
+    n_requests = n_events = n_summaries = 0
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            problems.append(f"line {lineno}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"line {lineno}: not an object")
+            continue
+        records.append(rec)
+        if "request" in rec:
+            n_requests += 1
+            req = rec["request"]
+            if not isinstance(req, dict) or not all(
+                k in req for k in ("id", "status")
+            ):
+                problems.append(
+                    f"line {lineno}: request entry missing id/status"
+                )
+        elif "fleet_event" in rec:
+            n_events += 1
+            ev = rec["fleet_event"]
+            if not isinstance(ev, dict):
+                problems.append(f"line {lineno}: fleet_event not an object")
+            elif ev.get("name") not in EVENTS:
+                problems.append(
+                    f"line {lineno}: fleet_event name {ev.get('name')!r} "
+                    f"not in declared set {EVENTS}"
+                )
+            elif "t_unix" not in ev:
+                problems.append(
+                    f"line {lineno}: fleet_event missing t_unix"
+                )
+        elif "fleet" in rec or "fleet_slo" in rec:
+            n_summaries += 1
+        else:
+            problems.append(
+                f"line {lineno}: unknown record shape "
+                f"(keys {sorted(rec)[:5]})"
+            )
+    undeclared = obs_metrics.undeclared_tags(records)
+    for tag in undeclared:
+        problems.append(f"undeclared metrics tag: {tag}")
+    return {
+        "ok": not problems,
+        "records": len(records),
+        "requests": n_requests,
+        "events": n_events,
+        "summaries": n_summaries,
+        "undeclared": undeclared,
+        "problems": problems,
+    }

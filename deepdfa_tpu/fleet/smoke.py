@@ -1,0 +1,364 @@
+"""`fleet --smoke`: a 2-replica fleet end to end on a just-trained tiny
+checkpoint (tier-1).
+
+The acceptance drive for the whole fleet layer, in five phases against
+REAL replica subprocesses (the same `fleet-replica` entry production
+uses) and a real in-process router:
+
+1. **parity** — scores through the router are BIT-IDENTICAL to
+   single-replica serving (the offline score path on the same
+   checkpoint), both replicas took traffic, and each replica's
+   `jit_lowerings()` census shows zero steady-state recompiles.
+2. **shedding** — an over-deadline burst is rejected 503 at the front
+   door with the replicas' request counters UNCHANGED (no frontend or
+   device time spent), and a token-bucket tenant gets 429 past its
+   burst.
+3. **failover** — one replica is SIGKILLed with requests in flight; the
+   router ejects it, retries on the survivor, and every request still
+   answers 200 with the bit-identical score (no request lost).
+4. **drain** — the survivor gets SIGTERM: the router observes the
+   `draining` heartbeat, the replica finishes in-flight work, leaves a
+   final SLO snapshot + a validated flight-recorder postmortem, and
+   exits 0 with its heartbeat at `drained`.
+5. **log** — the router's fleet_log.jsonl validates against the
+   declared obs schema (`scripts/check_obs_schema.py --fleet-log` runs
+   the same function).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+
+
+def _replica_stats(host: str, port: int) -> dict:
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", "/stats")
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def _replica_healthz(host: str, port: int) -> dict:
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("GET", "/healthz")
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
+    """Returns the machine-readable smoke report `cmd_fleet` asserts
+    on. Every phase's evidence is a field, not a print."""
+    from deepdfa_tpu.fleet import heartbeat
+    from deepdfa_tpu.fleet.replica import spawn_replicas, wait_for_ready
+    from deepdfa_tpu.fleet.router import (
+        BackgroundRouter,
+        router_from_config,
+        validate_fleet_log,
+    )
+    from deepdfa_tpu.obs import flight as obs_flight
+    from deepdfa_tpu.serve import driver
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService, score_texts
+
+    smoke_kw.setdefault("run_name", "fleet-smoke")
+    smoke_kw.setdefault("dataset", "fleet-smoke")
+    smoke_kw.setdefault("n_examples", 16)
+    smoke_kw.setdefault("max_epochs", 1)
+    cfg, run_dir, sources_dir = driver.build_smoke_run(
+        extra_overrides=[
+            "serve.request_log=true",
+            # ONE ladder size so every phase (baseline, sequential
+            # routing, concurrent failover) runs the IDENTICAL compiled
+            # executable: cross-ladder-size runs (G1 vs G4) can differ
+            # by ~1 ulp on XLA CPU (fusion/tiling vary with the segment
+            # count), and this smoke pins request-level bit parity
+            # across REPLICAS, not across batch shapes —
+            # tests/test_serve.py owns the co-batching property
+            "serve.max_batch_graphs=1",
+            # per-replica postmortems are the drain contract's evidence
+            "obs.flight=true",
+            # tight cadences so the smoke's observations are prompt
+            "fleet.heartbeat_interval_s=0.2",
+            "fleet.heartbeat_timeout_s=5.0",
+            "fleet.poll_interval_s=0.1",
+            "fleet.drain_announce_s=0.5",
+            # a deliberately tiny tenant for the 429 phase (the field
+            # is a JSON string, so the override is a JSON string
+            # literal)
+            "fleet.tenants=" + json.dumps(
+                '{"burst": {"rate": 0.001, "burst": 2, "priority": 1}}'
+            ),
+            *(extra_overrides or []),
+        ],
+        **smoke_kw,
+    )
+    fcfg = cfg.fleet
+    fleet_dir = Path(fcfg.fleet_dir or run_dir / "fleet")
+
+    # -- singleton baseline: the offline score path on the same
+    # checkpoint IS single-replica serving (same registry restore, same
+    # frontend, same AOT ladder) — the bit-parity reference
+    sources = driver.collect_sources([str(sources_dir)])[:8]
+    registry = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+        cfg=cfg,
+    )
+    baseline_service = ScoringService(registry, cfg)
+    try:
+        baseline_rows = score_texts(baseline_service, sources)
+    finally:
+        baseline_service.close()
+    baseline = {
+        Path(r["name"]).name: r["prob"]
+        for r in baseline_rows if r.get("ok")
+    }
+    codes = {
+        Path(name).name: code
+        for name, code in sources
+        if Path(name).name in baseline
+    }
+
+    report: dict = {"run_dir": str(run_dir)}
+    procs = spawn_replicas(run_dir, fleet_dir, 2)
+    router_server = None
+    try:
+        beats = wait_for_ready(
+            fleet_dir, [rid for rid, _ in procs],
+            timeout_s=240.0, procs=procs,
+        )
+        replica_addr = {
+            rid: (hb["host"], int(hb["port"]))
+            for rid, hb in beats.items()
+        }
+        router = router_from_config(
+            cfg, fleet_dir, log_path=run_dir / "fleet_log.jsonl"
+        )
+        router_server = BackgroundRouter(router)
+
+        # -- phase 1: routed scores == singleton scores, bit for bit
+        scored = []
+        for name, code in codes.items():
+            status, resp = router_server.request(
+                "POST", "/score", {"code": code}
+            )
+            scored.append({
+                "name": name, "status": status,
+                "prob": resp.get("prob"),
+                "request_id": resp.get("request_id"),
+                "bit_identical": resp.get("prob") == baseline[name],
+            })
+        report["scored"] = scored
+        report["bit_identical"] = all(
+            s["status"] == 200 and s["bit_identical"] for s in scored
+        )
+        topo = router.topology()
+        report["both_replicas_served"] = (
+            sorted(r["id"] for r in topo["replicas"] if r["forwarded"])
+            == sorted(replica_addr)
+        )
+        # zero-steady-state-recompile census, pinned PER REPLICA
+        census = {
+            rid: _replica_healthz(*addr)
+            for rid, addr in replica_addr.items()
+        }
+        report["replica_census"] = {
+            rid: {
+                "jit_lowerings": h.get("jit_lowerings"),
+                "steady_state_recompiles": h.get(
+                    "steady_state_recompiles"
+                ),
+            }
+            for rid, h in census.items()
+        }
+        report["zero_recompiles_per_replica"] = all(
+            h.get("steady_state_recompiles") == 0
+            for h in census.values()
+        )
+
+        # -- phase 2a: over-deadline burst shed BEFORE device time.
+        # Evidence: every reply is a 503 `deadline`, and the replicas'
+        # own request counters do not move.
+        before = {
+            rid: _replica_stats(*addr)["serve"].get("requests", 0)
+            for rid, addr in replica_addr.items()
+        }
+        shed_statuses = []
+        for code in list(codes.values())[:4]:
+            status, resp = router_server.request(
+                "POST", "/score",
+                {"code": code, "deadline_ms": 0.001},
+            )
+            shed_statuses.append((status, resp.get("reason")))
+        after = {
+            rid: _replica_stats(*addr)["serve"].get("requests", 0)
+            for rid, addr in replica_addr.items()
+        }
+        report["deadline_shed"] = {
+            "statuses": shed_statuses,
+            "replica_requests_before": before,
+            "replica_requests_after": after,
+            "no_device_time_spent": before == after,
+            "all_shed": all(
+                s == 503 and r == "deadline" for s, r in shed_statuses
+            ),
+        }
+        # -- phase 2b: the token-bucket tenant gets 429 past its burst
+        rate_statuses = []
+        for code in list(codes.values())[:3]:
+            status, _ = router_server.request(
+                "POST", "/score", {"code": code},
+                headers={"X-Tenant": "burst"},
+            )
+            rate_statuses.append(status)
+        report["rate_limit"] = {
+            "statuses": rate_statuses,
+            "ok": rate_statuses[:2] == [200, 200]
+            and rate_statuses[2] == 429,
+        }
+
+        # -- phase 3: SIGKILL r0 with requests genuinely in flight —
+        # the concurrent senders start FIRST, the kill lands while they
+        # run, so the router sees the whole failure spectrum (refused
+        # connections AND sockets reset mid-request) and must retry
+        # every one on the survivor
+        victim = procs[0]
+        survivor_id = procs[1][0]
+        results: list[dict] = []
+        lock = threading.Lock()
+
+        def one(name: str, code: str) -> None:
+            status, resp = router_server.request(
+                "POST", "/score", {"code": code}
+            )
+            with lock:
+                results.append({
+                    "name": name, "status": status,
+                    "prob": resp.get("prob"),
+                    "bit_identical": resp.get("prob") == baseline[name],
+                })
+
+        threads = [
+            threading.Thread(target=one, args=(n, c))
+            for n, c in codes.items()
+        ]
+        for t in threads:
+            t.start()
+        os.kill(victim[1].pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=120)
+        victim[1].wait(timeout=30)
+        topo = router.topology()
+        from deepdfa_tpu.obs import metrics as obs_metrics
+
+        snap = obs_metrics.REGISTRY.snapshot()
+        report["failover"] = {
+            "killed": victim[0],
+            "responses": len(results),
+            "all_ok": len(results) == len(codes) and all(
+                r["status"] == 200 and r["bit_identical"]
+                for r in results
+            ),
+            "ejects": snap.get("fleet/ejects", 0),
+            "retries": snap.get("fleet/retries", 0),
+            "survivor_routable": any(
+                r["id"] == survivor_id and r["routable"]
+                for r in topo["replicas"]
+            ),
+        }
+
+        # -- phase 4: graceful drain of the survivor
+        sproc = procs[1][1]
+        sproc.send_signal(signal.SIGTERM)
+        drain_seen = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with router._lock:
+                rep = router._replicas.get(survivor_id)
+                if rep is not None and rep.drain_logged:
+                    drain_seen = True
+                    break
+            if sproc.poll() is not None:
+                break
+            time.sleep(0.05)
+        rc = sproc.wait(timeout=60)
+        hb = heartbeat.read_heartbeat(
+            heartbeat.heartbeat_path(fleet_dir, survivor_id)
+        )
+        pm_path = fleet_dir / survivor_id / "postmortem.json"
+        pm = (
+            obs_flight.validate_postmortem_file(pm_path)
+            if pm_path.exists()
+            else {"ok": False, "problems": ["no postmortem dumped"]}
+        )
+        final_log = fleet_dir / survivor_id / "serve_log.jsonl"
+        report["drain"] = {
+            "replica": survivor_id,
+            "exit_code": rc,
+            "router_observed": drain_seen,
+            "final_heartbeat_state": hb.get("state") if hb else None,
+            "postmortem": pm,
+            "final_serve_log": final_log.exists(),
+        }
+
+        router_server.close()  # appends the summary record
+        router_server = None
+        report["fleet_log"] = validate_fleet_log(
+            run_dir / "fleet_log.jsonl"
+        )
+        report["fleet_log"]["path"] = str(run_dir / "fleet_log.jsonl")
+    finally:
+        if router_server is not None:
+            router_server.close()
+        for _, proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    return report
+
+
+def smoke_verdict(report: dict) -> list[str]:
+    """The failed acceptance criteria (empty = the smoke passed) — one
+    place `cmd_fleet` and the tests read the contract from."""
+    bad: list[str] = []
+    if not report.get("bit_identical"):
+        bad.append("router scores != singleton scores (bit parity)")
+    if not report.get("both_replicas_served"):
+        bad.append("traffic did not spread across both replicas")
+    if not report.get("zero_recompiles_per_replica"):
+        bad.append("steady-state recompiles on a replica")
+    ds = report.get("deadline_shed") or {}
+    if not (ds.get("all_shed") and ds.get("no_device_time_spent")):
+        bad.append("over-deadline burst not shed before device time")
+    if not (report.get("rate_limit") or {}).get("ok"):
+        bad.append("token-bucket tenant not rate-limited")
+    fo = report.get("failover") or {}
+    if not fo.get("all_ok"):
+        bad.append("failover lost or mis-scored a request")
+    if not fo.get("ejects"):
+        bad.append("killed replica was never ejected")
+    dr = report.get("drain") or {}
+    if dr.get("exit_code") != 0:
+        bad.append("drained replica exited nonzero")
+    if not dr.get("router_observed"):
+        bad.append("router never observed the drain state")
+    if dr.get("final_heartbeat_state") != "drained":
+        bad.append("final heartbeat state is not 'drained'")
+    if not (dr.get("postmortem") or {}).get("ok"):
+        bad.append("drain postmortem missing or invalid")
+    if not dr.get("final_serve_log"):
+        bad.append("no final SLO snapshot in the replica serve log")
+    if not (report.get("fleet_log") or {}).get("ok"):
+        bad.append("fleet_log.jsonl failed schema validation")
+    return bad

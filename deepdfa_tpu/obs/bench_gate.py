@@ -58,6 +58,14 @@ LOWER_IS_BETTER: dict[str, float] = {
     # fused GGNN per-step time (ISSUE 9; us/step, platform-resolved
     # kernel scatter) — a rise past tolerance is a hot-path regression
     "ggnn_step_us": 0.25,
+    # serving fleet under overload (ISSUE 11, scripts/bench_load.py via
+    # bench.py --child-fleet behind DEEPDFA_BENCH_FLEET): p99 latency of
+    # ADMITTED requests while the open-loop generator overloads the
+    # fleet, and the shed fraction at that fixed offered rate — both
+    # rising past tolerance means the router/admission path got slower
+    # or the fleet lost capacity
+    "fleet_p99_overload_ms": 0.25,
+    "fleet_shed_rate": 0.25,
     # efficiency-ledger compile accounting (ISSUE 10): total AOT
     # compile wall time per bench child — a rise past tolerance means
     # the compiled programs got slower to build (or a site started

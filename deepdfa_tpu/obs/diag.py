@@ -11,10 +11,13 @@ Reads the three streams a run leaves behind (any subset may be absent):
 and renders: run summary, per-epoch throughput timeline, host/device
 stage attribution (from the epoch records AND recomputed independently
 from the trace spans — the cross-check that the event stream carries the
-run's attribution), and the resilience event log (stalls, skips,
-rollbacks, resume points). `--json` emits the same content as one
-machine-readable object; `--smoke` builds a synthetic run dir through
-the real emission APIs and renders it (the tier-1 regression surface).
+run's attribution), the resilience event log (stalls, skips,
+rollbacks, resume points), and — when the run served — the SLO, scan,
+fleet (per-replica traffic/occupancy, shed by tenant/priority,
+eject/readmit log; docs/fleet.md), efficiency, and postmortem sections.
+`--json` emits the same content as one machine-readable object;
+`--smoke` builds a synthetic run dir through the real emission APIs and
+renders it (the tier-1 regression surface).
 """
 
 from __future__ import annotations
@@ -324,6 +327,121 @@ def scan_section(scan_records: list[dict]) -> dict:
     return out
 
 
+def load_fleet_records(run_dir: Path) -> list[dict]:
+    """fleet_log.jsonl entries (per-request + lifecycle events +
+    summary records, deepdfa_tpu/fleet/router.py; docs/fleet.md)."""
+    return _read_jsonl(run_dir / "fleet_log.jsonl")
+
+
+def fleet_section(run_dir: Path, fleet_records: list[dict]) -> dict:
+    """The serving-fleet section, rebuilt from the router's
+    fleet_log.jsonl (plus each replica's own serve log under
+    fleet/<id>/ when present): per-replica req/s and batch occupancy,
+    shed rate by tenant and priority class, and the eject/readmit/drain
+    event log — the operator view ISSUE 11 asks `diag` for."""
+    if not fleet_records:
+        return {}
+    requests = [
+        r["request"] for r in fleet_records
+        if isinstance(r.get("request"), dict)
+    ]
+    events = [
+        r["fleet_event"] for r in fleet_records
+        if isinstance(r.get("fleet_event"), dict)
+    ]
+    summaries = [
+        r for r in fleet_records if "fleet" in r or "fleet_slo" in r
+    ]
+    out: dict = {"requests": len(requests), "events": len(events)}
+    times = [r["t_unix"] for r in requests if "t_unix" in r]
+    span_s = (max(times) - min(times)) if len(times) > 1 else 0.0
+    # per-replica obs homes live under fleet.fleet_dir when the run
+    # configured one (cmd_fleet/ReplicaWorker honor it); default
+    # <run_dir>/fleet
+    fleet_dir = run_dir / "fleet"
+    cfg_path = run_dir / "config.json"
+    if cfg_path.exists():
+        try:
+            configured = (
+                json.loads(cfg_path.read_text())
+                .get("fleet", {}).get("fleet_dir")
+            )
+            if configured:
+                fleet_dir = Path(configured)
+        except (json.JSONDecodeError, OSError):
+            pass
+    # per-replica traffic + occupancy (occupancy from the replica's own
+    # serve log: the router never sees batch fill, the batcher does)
+    per_replica: dict[str, dict] = {}
+    for req in requests:
+        rid = req.get("replica")
+        if not rid:
+            continue
+        agg = per_replica.setdefault(rid, {"requests": 0})
+        agg["requests"] += 1
+    for rid, agg in per_replica.items():
+        if span_s > 0:
+            agg["requests_per_sec"] = round(agg["requests"] / span_s, 3)
+        for rec in reversed(
+            _read_jsonl(fleet_dir / rid / "serve_log.jsonl")
+        ):
+            occ = (rec.get("serve") or {}).get("batch_occupancy/mean")
+            if occ is not None:
+                agg["batch_occupancy_mean"] = round(occ, 4)
+                break
+    if per_replica:
+        out["replicas"] = dict(sorted(per_replica.items()))
+    # shed analysis: rate overall, then by tenant and priority class
+    shed = [r for r in requests if r.get("shed")]
+    if requests:
+        out["shed_rate"] = round(len(shed) / len(requests), 4)
+    by_tenant: dict[str, dict] = {}
+    by_priority: dict[str, dict] = {}
+    for req in requests:
+        tenant = str(req.get("tenant", "default"))
+        prio = str(req.get("priority", "?"))
+        for key, table in ((tenant, by_tenant), (prio, by_priority)):
+            agg = table.setdefault(key, {"requests": 0, "shed": 0})
+            agg["requests"] += 1
+            agg["shed"] += 1 if req.get("shed") else 0
+    for table in (by_tenant, by_priority):
+        for agg in table.values():
+            agg["shed_rate"] = round(agg["shed"] / agg["requests"], 4)
+    if by_tenant:
+        out["by_tenant"] = dict(sorted(by_tenant.items()))
+    if by_priority:
+        out["by_priority"] = dict(sorted(by_priority.items()))
+    shed_reasons: dict[str, int] = {}
+    for req in shed:
+        reason = str(req.get("reason", "?"))
+        shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
+    if shed_reasons:
+        out["shed_reasons"] = dict(sorted(shed_reasons.items()))
+    # lifecycle log: the eject/readmit/drain evidence, in order
+    out["event_log"] = [
+        {
+            k: ev[k]
+            for k in ("name", "replica", "t_unix", "failures", "state")
+            if k in ev
+        }
+        for ev in events
+    ]
+    if summaries:
+        last = summaries[-1]
+        fl = last.get("fleet") or {}
+        out["counters"] = {
+            k: fl[k]
+            for k in ("requests", "forwarded", "retries", "ejects",
+                      "readmits", "admitted", "shed",
+                      "replicas_routable")
+            if k in fl
+        }
+        slo = last.get("fleet_slo")
+        if slo:
+            out["slo"] = slo
+    return out
+
+
 def efficiency_section(run_dir: Path, records: list[dict]) -> dict:
     """The device efficiency view (obs/ledger.py, docs/efficiency.md),
     rebuilt from the run's own artifacts: the newest embedded ledger
@@ -488,6 +606,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "serve": serve_attribution(serve_records),
         "slo": slo_section(serve_records),
         "scan": scan_section(load_scan_records(run_dir)),
+        "fleet": fleet_section(run_dir, load_fleet_records(run_dir)),
         "efficiency": efficiency_section(run_dir, records),
         "postmortem": load_postmortem(run_dir),
         "bench": bench_section(bench_root),
@@ -665,6 +784,66 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f"  steady-state recompiles: score={rc} lines="
                 f"{scan.get('scan_lines_steady_state_recompiles')}\n"
             )
+
+    fleet = report.get("fleet") or {}
+    if fleet:
+        w("\nserving fleet (fleet_log.jsonl, docs/fleet.md):\n")
+        shed_rate = fleet.get("shed_rate")
+        shed_s = (
+            f" shed_rate={shed_rate:.1%}"
+            if isinstance(shed_rate, (int, float)) else ""
+        )
+        w(
+            f"  requests={fleet.get('requests')} "
+            f"events={fleet.get('events')}{shed_s}\n"
+        )
+        replicas = fleet.get("replicas") or {}
+        for rid, agg in replicas.items():
+            rps = agg.get("requests_per_sec")
+            rps_s = f" req/s={rps}" if rps is not None else ""
+            occ = agg.get("batch_occupancy_mean")
+            occ_s = (
+                f" occupancy={occ:.1%}"
+                if isinstance(occ, (int, float)) else ""
+            )
+            w(
+                f"  replica {rid:<6} requests={agg['requests']}"
+                f"{rps_s}{occ_s}\n"
+            )
+        for title, key in (
+            ("tenant", "by_tenant"), ("priority", "by_priority"),
+        ):
+            table = fleet.get(key) or {}
+            if table:
+                w(f"  shed by {title}:\n")
+                for name, agg in table.items():
+                    w(
+                        f"    {name:<12}{_bar(agg['shed_rate'], 20)} "
+                        f"{agg['shed_rate']:7.1%}  "
+                        f"({agg['shed']}/{agg['requests']})\n"
+                    )
+        reasons = fleet.get("shed_reasons") or {}
+        if reasons:
+            w("  shed reasons: " + " ".join(
+                f"{k}={v}" for k, v in reasons.items()
+            ) + "\n")
+        event_log = fleet.get("event_log") or []
+        if event_log:
+            w("  lifecycle events:\n")
+            for ev in event_log:
+                extra = "".join(
+                    f" {k}={ev[k]}"
+                    for k in ("failures", "state") if k in ev
+                )
+                w(
+                    f"    {ev.get('name', '?'):<16}"
+                    f"replica={ev.get('replica', '-')}{extra}\n"
+                )
+        counters = fleet.get("counters") or {}
+        if counters:
+            w("  " + " ".join(
+                f"{k}={int(v)}" for k, v in counters.items()
+            ) + "\n")
 
     eff = report.get("efficiency") or {}
     if eff:
@@ -889,7 +1068,7 @@ def build_smoke_run(run_dir: Path) -> Path:
     # the SLO engine) so the diag SLO section has both of its sources:
     # per-request entries and an engine snapshot in a summary record
     from deepdfa_tpu.obs.slo import SloEngine
-    from deepdfa_tpu.serve.server import RequestLog
+    from deepdfa_tpu.serve.server import RequestLog, write_serve_log
 
     rlog = RequestLog(run_dir / "serve_log.jsonl")
     engine = SloEngine()
@@ -939,6 +1118,62 @@ def build_smoke_run(run_dir: Path) -> Path:
             "scan_cache_hit_fraction": 0.5,
         },
     ])
+    # a fleet_log.jsonl through the REAL router emitters (fleet/
+    # router.py:FleetLog + Router.log_request shapes) so the diag fleet
+    # section renders the same record shapes a live router leaves:
+    # admitted traffic on two replicas, shed by tenant/priority, and an
+    # eject/readmit lifecycle
+    from deepdfa_tpu.fleet.router import FleetLog
+
+    flog = FleetLog(run_dir / "fleet_log.jsonl")
+    t_now = time.time()
+    for rid in ("r0", "r1"):
+        flog.append({"fleet_event": {
+            "name": "join", "replica": rid,
+            "t_unix": round(t_now - 20, 3),
+        }})
+    for i in range(12):
+        shed = i % 6 == 5
+        tenant = ["interactive", "batch"][i % 2]
+        entry = {
+            "id": f"fleet-smoke-{i}",
+            "status": 503 if shed else 200,
+            "latency_ms": 0.5 if shed else 4.0 + i,
+            "t_unix": round(t_now - 12 + i, 3),
+            "tenant": tenant, "priority": i % 2,
+            "retries": 1 if i == 7 else 0,
+            "shed": 1 if shed else 0,
+        }
+        if shed:
+            entry["reason"] = "deadline"
+            entry["deadline_ms"] = 1.0
+        else:
+            entry["replica"] = f"r{i % 2}"
+        flog.append({"request": entry})
+    flog.append({"fleet_event": {
+        "name": "eject", "replica": "r1", "failures": 1,
+        "t_unix": round(t_now - 4, 3),
+    }})
+    flog.append({"fleet_event": {
+        "name": "readmit", "replica": "r1",
+        "t_unix": round(t_now - 2, 3),
+    }})
+    flog.append({
+        "fleet": {
+            "requests": 12, "forwarded": 10, "retries": 1,
+            "ejects": 1, "readmits": 1, "admitted": 10, "shed": 2,
+            "replicas_routable": 2,
+        },
+        "fleet_slo": engine.snapshot(),
+        "fleet_replicas": 2,
+    })
+    flog.close()
+    # one replica's own serve log (per-replica obs home) so the fleet
+    # section picks up batch occupancy from the replica side
+    (run_dir / "fleet" / "r0").mkdir(parents=True, exist_ok=True)
+    write_serve_log(run_dir / "fleet" / "r0", [{
+        "serve": {"batch_occupancy/mean": 0.75, "requests": 6.0},
+    }])
     ck = run_dir / "checkpoints-step"
     ck.mkdir(exist_ok=True)
     (ck / "watchdog_diagnostic.json").write_text(json.dumps({
@@ -1020,8 +1255,12 @@ def main(argv=None) -> int:
             attr = report["stage_attribution"]
             slo = report.get("slo") or {}
             scan = report.get("scan") or {}
+            fleet = report.get("fleet") or {}
             eff = report.get("efficiency") or {}
             pm = report.get("postmortem") or {}
+            fleet_events = {
+                ev.get("name") for ev in fleet.get("event_log", [])
+            }
             ok = (
                 report["summary"]["epochs"] == 3
                 and report["summary"]["trace_events"] > 0
@@ -1043,6 +1282,18 @@ def main(argv=None) -> int:
                 and scan.get("scan_incremental_skip_fraction") is not None
                 and scan.get("stage_seconds")
                 and scan.get("scans") == 2
+                # ISSUE 11 section: the fleet view rebuilt from
+                # fleet_log.jsonl — per-replica traffic + occupancy,
+                # shed-rate by tenant/priority, lifecycle event log
+                and len(fleet.get("replicas") or {}) == 2
+                and fleet["replicas"]["r0"].get("batch_occupancy_mean")
+                == 0.75
+                and fleet.get("shed_rate") is not None
+                and set(fleet.get("by_tenant") or {})
+                == {"interactive", "batch"}
+                and (fleet.get("by_priority") or {})
+                and {"join", "eject", "readmit"} <= fleet_events
+                and fleet.get("counters", {}).get("ejects") == 1
                 # ISSUE 10 sections: the efficiency ledger (per-site
                 # MFU + compile bars + HBM watermark timeline) and the
                 # postmortem view, both from the real emitters

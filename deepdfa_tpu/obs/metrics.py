@@ -272,6 +272,33 @@ SCHEMA: tuple[str, ...] = (
     # crash flight recorder (obs/flight.py): postmortem dump counters,
     # keyed by trigger
     "flight/*",
+    # -- serving fleet (deepdfa_tpu/fleet/, docs/fleet.md) --
+    # router/admission registry counters + gauges (request/forward/
+    # retry/eject/readmit totals, shed counts by reason/tenant/priority,
+    # routable-replica gauges) — tenant labels are data-dependent, so
+    # this is a reviewed wildcard (like obs/compile/signatures/*); the
+    # fleet_log summary record embeds the same snapshot under "fleet"
+    "fleet/*",
+    # the router's rolling SLO windows (obs/slo.py engine snapshot in
+    # fleet_log summary records)
+    "fleet_slo/*",
+    # fleet_event lifecycle entries in fleet_log.jsonl (join/eject/
+    # readmit/drain_observed/gone; fleet/router.py:EVENTS): scalar
+    # fields like t_unix/failures/heartbeat_age_s
+    "fleet_event/*",
+    # per-request fleet_log entries (router request log; the admission
+    # fields beyond the serve request/* set)
+    "request/deadline_ms", "request/priority", "request/retries",
+    "request/shed",
+    # fleet_log summary + bench_load record fields (scripts/
+    # bench_load.py, bench.py --child-fleet; gated in obs/bench_gate.py)
+    "fleet_replicas", "fleet_requests_per_sec", "fleet_seconds",
+    "fleet_offered_rate_per_sec", "fleet_requests_total",
+    "fleet_admitted", "fleet_shed", "fleet_shed_rate",
+    "fleet_failed_other", "fleet_p99_overload_ms",
+    "fleet_latency_p50_ms", "fleet_warm_requests_per_sec",
+    "fleet_steady_state_recompiles", "overload_factor",
+    "shed_by_tenant/*",
     # bench-record ledger stamps (bench.py, gated in obs/bench_gate.py):
     # per-site MFU-vs-measured-ceiling map, total AOT compile wall time
     # (lower is better), and the interleaved-reps ledger overhead bound;

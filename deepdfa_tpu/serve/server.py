@@ -413,9 +413,20 @@ def score_texts(
     return rows
 
 
+class UnknownModel(ValueError):
+    """The request named a co-served model this process doesn't hold."""
+
+
 class _Handler(BaseHTTPRequestHandler):
     service: ScoringService = None  # set by make_server
     request_timeout_s: float = 60.0
+
+    def _service_for(self, payload: dict) -> "ScoringService":
+        """Which service scores this request. The single-process server
+        has exactly one; the fleet replica handler overrides this to
+        route by the payload's `model` tag (multi-model co-serving,
+        docs/fleet.md). Raises UnknownModel -> 400."""
+        return self.service
 
     def _reply(self, status: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
@@ -455,8 +466,11 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/score":
             self._reply(404, {"error": f"no route {self.path}"})
             return
-        rid = new_request_id()
+        # an upstream router (deepdfa_tpu/fleet/) propagates the ingress
+        # id so one request's flow chain spans router -> replica spans
+        rid = self.headers.get("X-Request-Id") or new_request_id()
         t0 = time.monotonic()
+        service = self.service
         try:
             n = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(n) or b"{}")
@@ -465,20 +479,21 @@ class _Handler(BaseHTTPRequestHandler):
                     f"body must be a JSON object, got "
                     f"{type(payload).__name__}"
                 )
+            service = self._service_for(payload)
             code = payload["code"]
         except (ValueError, KeyError) as e:
-            self.service.finish_request(rid, 400, time.monotonic() - t0)
+            service.finish_request(rid, 400, time.monotonic() - t0)
             self._reply(
                 400, {"error": f"bad request: {e}", "request_id": rid}
             )
             return
         want_trace = bool(payload.get("trace"))
         want_lines = bool(payload.get("lines"))
-        if want_lines and self.service.localizer is None:
+        if want_lines and service.localizer is None:
             # refused up front, before any device work: the contract is
             # explicit opt-in at server start (serve.lines=true warms
             # the attribution ladder), not a silent slow path
-            self.service.finish_request(rid, 400, time.monotonic() - t0)
+            service.finish_request(rid, 400, time.monotonic() - t0)
             self._reply(400, {
                 "error": "line attributions are disabled on this server "
                          "(start it with serve.lines=true)",
@@ -489,14 +504,14 @@ class _Handler(BaseHTTPRequestHandler):
         feats = None
         try:
             if want_lines:
-                req, feats = self.service.submit_code(
+                req, feats = service.submit_code(
                     code, request_id=rid, want_feats=True
                 )
             else:
-                req = self.service.submit_code(code, request_id=rid)
+                req = service.submit_code(code, request_id=rid)
             prob = req.wait(self.request_timeout_s)
             lines = (
-                self.service.attribute_lines(feats, request_id=rid)
+                service.attribute_lines(feats, request_id=rid)
                 if want_lines else None
             )
         except QueueFull as e:
@@ -514,7 +529,7 @@ class _Handler(BaseHTTPRequestHandler):
             logger.exception("request %s failed", rid)
             status, err = 500, e
         else:
-            stages = self.service.finish_request(
+            stages = service.finish_request(
                 rid, 200, time.monotonic() - t0, req=req
             )
             out = {
@@ -532,7 +547,7 @@ class _Handler(BaseHTTPRequestHandler):
                 out["batch_size"] = req.batch_size
             self._reply(200, out)
             return
-        self.service.finish_request(
+        service.finish_request(
             rid, status, time.monotonic() - t0, req=req,
             frontend_s=getattr(err, "frontend_s", None),
         )

@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""Open-loop fleet load generator (docs/fleet.md).
+
+Drives a real 2+-replica fleet — in-process replica servers (the same
+ScoringService + HTTP handler the `fleet-replica` worker runs, minus the
+checkpoint round trip) behind the real router + admission stack — with
+OPEN-LOOP traffic: arrival times are drawn from a Poisson process at a
+fixed offered rate and requests fire at those times whether or not
+earlier ones completed. That is the only way to measure overload
+honestly: a closed-loop client slows down with the server and never
+observes the queue the paper's "millions of users" traffic would build.
+
+The mix is deliberately hostile, per the ISSUE:
+  - heavy-tail function sizes (Pareto-sampled over the size-sorted
+    corpus: mostly small functions, a fat tail of big ones — the shape
+    real repos have);
+  - a tenant mix (interactive priority-0 with a tight deadline, batch
+    priority-1 with a loose one, best-effort priority-2 behind a tiny
+    token bucket);
+  - an offered rate a multiple of the measured warm capacity
+    (`--overload`, default 3x), so the fleet MUST shed.
+
+Reported (bench-gated in obs/bench_gate.py, both lower-is-better):
+  fleet_p99_overload_ms   p99 latency of ADMITTED (200) requests under
+                          overload
+  fleet_shed_rate         shed fraction at the fixed offered rate
+plus throughput/accounting fields and the zero-steady-state-recompiles
+census summed across replicas.
+
+Modes:
+    python scripts/bench_load.py --smoke   # tier-1 regression mode
+    python scripts/bench_load.py           # full mode (bigger drive)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: tenant mix: (name, traffic share, per-request deadline_ms)
+TENANT_MIX = (
+    ("interactive", 0.5, 250.0),
+    ("batch", 0.4, 2000.0),
+    ("besteffort", 0.1, None),
+)
+
+#: admission policies for the mix (fleet/admission.py JSON spec):
+#: best-effort sits behind a deliberately tiny bucket so rate-limit
+#: shedding is exercised at any offered rate
+TENANT_POLICIES = (
+    '{"interactive": {"rate": 10000, "burst": 10000, "priority": 0},'
+    ' "batch": {"rate": 10000, "burst": 10000, "priority": 1},'
+    ' "besteffort": {"rate": 1, "burst": 2, "priority": 2}}'
+)
+
+
+class _BenchRegistry:
+    """Registry-shaped stub over freshly initialized params: the load
+    bench measures the fleet machinery, not checkpoint IO (the restore
+    path has its own e2e coverage in `fleet --smoke`)."""
+
+    family = "deepdfa"
+    checkpoint = "init"
+
+    def __init__(self, cfg, model, params, vocabs, run_dir):
+        self.cfg = cfg
+        self._model = model
+        self._params = params
+        self.vocabs = vocabs
+        self.run_dir = Path(run_dir)
+
+    @property
+    def model(self):
+        return self._model
+
+    def params(self):
+        return self._params
+
+    def _feat_width(self) -> int:
+        from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
+
+        return NUM_SUBKEY_FEATS
+
+    def maybe_reload(self) -> bool:
+        return False
+
+    def info(self) -> dict:
+        return {
+            "family": self.family,
+            "run_dir": str(self.run_dir),
+            "checkpoint": self.checkpoint,
+            "checkpoint_step": 0,
+            "config_digest": "bench",
+            "vocab_digest": "bench",
+            "hot_swaps": 0,
+        }
+
+
+def bench_load(
+    n_requests: int = 600,
+    n_replicas: int = 2,
+    overload: float = 3.0,
+    smoke: bool = False,
+    seed: int = 0,
+) -> dict:
+    import numpy as np
+
+    import jax
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.fleet import heartbeat
+    from deepdfa_tpu.fleet.router import (
+        BackgroundRouter,
+        FleetLog,
+        router_from_config,
+    )
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.obs.slo import percentile
+    from deepdfa_tpu.serve.server import BackgroundServer, ScoringService
+
+    n_requests = min(n_requests, 120) if smoke else int(n_requests)
+    n_corpus = 32 if smoke else 128
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8" if smoke else "model.hidden_dim=32",
+        "model.n_steps=2" if smoke else "model.n_steps=5",
+        "serve.max_batch_graphs=8",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+        # the tenants field is a JSON string; the override value must be
+        # a JSON string literal (json.dumps of the spec)
+        f"fleet.tenants={json.dumps(TENANT_POLICIES)}",
+        # in-process replicas never refresh their heartbeat; a large
+        # timeout keeps them routable for the whole drive
+        "fleet.heartbeat_timeout_s=3600.0",
+        "fleet.poll_interval_s=0.2",
+    ])
+    synth = generate(n_corpus, seed=seed)
+    examples = to_examples(synth)
+    _, vocabs = build_dataset(
+        examples, train_ids=range(n_corpus),
+        limit_all=cfg.data.feat.limit_all,
+        limit_subkeys=cfg.data.feat.limit_subkeys,
+    )
+    model = DeepDFA.from_config(
+        cfg.model, input_dim=cfg.data.feat.input_dim
+    )
+    params = model.init(
+        jax.random.key(0), pack([], 1, 2048, 8192),
+    )
+    # heavy-tail size mix: Pareto index over the size-sorted corpus
+    # (drawn from the SAME generator as the tenant/arrival draws so the
+    # three are independent samples of one stream, not correlated
+    # replays of identically-seeded streams)
+    codes = sorted((e.code for e in examples), key=len)
+    rng = np.random.default_rng(seed)
+    pareto_idx = np.minimum(
+        (rng.pareto(1.5, n_requests) * 4).astype(int),
+        len(codes) - 1,
+    )
+    tenant_names = [t[0] for t in TENANT_MIX]
+    tenant_p = np.asarray([t[1] for t in TENANT_MIX])
+    tenant_deadline = {t[0]: t[2] for t in TENANT_MIX}
+    tenant_draw = rng.choice(len(TENANT_MIX), n_requests, p=tenant_p)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench-fleet-") as td:
+        fleet_dir = Path(td) / "fleet"
+        services: list[ScoringService] = []
+        servers: list[BackgroundServer] = []
+        try:
+            for i in range(int(n_replicas)):
+                registry = _BenchRegistry(
+                    cfg, model, params, vocabs, fleet_dir / f"r{i}"
+                )
+                service = ScoringService(registry, cfg)
+                services.append(service)
+                server = BackgroundServer(service)
+                servers.append(server)
+                heartbeat.write_heartbeat(
+                    fleet_dir, f"r{i}", server.host, server.port,
+                )
+            router = router_from_config(
+                cfg, fleet_dir, log_path=Path(td) / "fleet_log.jsonl"
+            )
+            router_server = BackgroundRouter(router)
+
+            def send(code: str, tenant: str, deadline_ms):
+                payload: dict = {"code": code, "tenant": tenant}
+                if deadline_ms is not None:
+                    payload["deadline_ms"] = float(deadline_ms)
+                t0 = time.monotonic()
+                status, _ = router_server.request(
+                    "POST", "/score", payload
+                )
+                return status, time.monotonic() - t0
+
+            # closed-loop warm pass: compile-cache warmth + the
+            # capacity measurement the offered rate is derived from
+            n_warm = 16 if smoke else 64
+            t0 = time.perf_counter()
+            for i in range(n_warm):
+                status, _ = send(codes[i % len(codes)], "batch", None)
+                assert status == 200, f"warm request failed: {status}"
+            warm_rps = n_warm / (time.perf_counter() - t0)
+
+            # open-loop overload drive: Poisson arrivals at
+            # overload x measured capacity, fired on schedule
+            offered_rate = max(1.0, overload * warm_rps)
+            gaps = rng.exponential(1.0 / offered_rate, n_requests)
+            arrivals = np.cumsum(gaps)
+            results: list[tuple[str, int, float]] = []
+            lock = threading.Lock()
+            threads: list[threading.Thread] = []
+
+            def fire(idx: int) -> None:
+                tenant = tenant_names[tenant_draw[idx]]
+                status, latency = send(
+                    codes[int(pareto_idx[idx])], tenant,
+                    tenant_deadline[tenant],
+                )
+                with lock:
+                    results.append((tenant, status, latency))
+
+            drive_t0 = time.monotonic()
+            for i in range(n_requests):
+                delay = arrivals[i] - (time.monotonic() - drive_t0)
+                if delay > 0:
+                    time.sleep(delay)
+                t = threading.Thread(target=fire, args=(i,), daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=300)
+            drive_s = time.monotonic() - drive_t0
+
+            ok_lat = sorted(
+                lat for _, st, lat in results if st == 200
+            )
+            shed = [r for r in results if r[1] in (429, 503)]
+            other = [
+                r for r in results if r[1] != 200 and r[1] not in (429, 503)
+            ]
+            recompiles = sum(
+                s.steady_state_recompiles() for s in services
+            )
+            shed_by_tenant = {}
+            for tenant, st, _ in results:
+                agg = shed_by_tenant.setdefault(
+                    tenant, {"requests": 0, "shed": 0}
+                )
+                agg["requests"] += 1
+                agg["shed"] += 1 if st in (429, 503) else 0
+            router_server.close()
+            p99 = percentile(ok_lat, 0.99)
+            p50 = percentile(ok_lat, 0.50)
+            return {
+                "metric": "fleet_p99_overload_ms",
+                "value": round(1e3 * p99, 3) if p99 else None,
+                "unit": "ms",
+                "fleet_p99_overload_ms": (
+                    round(1e3 * p99, 3) if p99 else None
+                ),
+                "fleet_latency_p50_ms": (
+                    round(1e3 * p50, 3) if p50 else None
+                ),
+                "fleet_shed_rate": round(len(shed) / len(results), 4),
+                "fleet_requests_total": len(results),
+                "fleet_admitted": len(ok_lat),
+                "fleet_shed": len(shed),
+                "fleet_failed_other": len(other),
+                "fleet_requests_per_sec": round(
+                    len(ok_lat) / drive_s, 2
+                ),
+                "fleet_offered_rate_per_sec": round(offered_rate, 2),
+                "fleet_warm_requests_per_sec": round(warm_rps, 2),
+                "fleet_replicas": int(n_replicas),
+                "fleet_seconds": round(drive_s, 3),
+                "fleet_steady_state_recompiles": recompiles,
+                "shed_by_tenant": shed_by_tenant,
+                "overload_factor": float(overload),
+            }
+        finally:
+            for server in servers:
+                try:
+                    server.close()
+                except Exception:
+                    pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 regression mode (~seconds)")
+    ap.add_argument("--requests", type=int, default=600)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--overload", type=float, default=3.0,
+                    help="offered rate as a multiple of measured warm "
+                    "capacity")
+    ap.add_argument("--out", default=None, help="write the record here")
+    args = ap.parse_args()
+
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+    apply_platform_override()
+
+    record = bench_load(
+        n_requests=args.requests,
+        n_replicas=args.replicas,
+        overload=args.overload,
+        smoke=args.smoke,
+    )
+    import jax
+
+    from deepdfa_tpu.obs import run_stamp
+
+    record["platform"] = jax.devices()[0].platform
+    record.update(run_stamp())
+    print(json.dumps(record), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(record, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,12 @@ Modes:
                  scanner's summary records, deepdfa_tpu/scan/ — the
                  `scan/*` + `localize/*` tag half of the schema,
                  docs/scanning.md)
+  --fleet-log <path>  validate a fleet router's fleet_log.jsonl
+                 (deepdfa_tpu/fleet/router.py, docs/fleet.md):
+                 structural checks (per-request entries carry id +
+                 status, lifecycle events carry a declared name +
+                 t_unix) AND every flattened scalar tag declared in
+                 SCHEMA — wired into `deepdfa-tpu fleet --smoke`
   --metrics <path>    validate a Prometheus `/metrics` scrape (saved
                  text, e.g. <run_dir>/metrics.prom from `serve --smoke`)
                  against the same registry: every line must parse as
@@ -157,6 +163,9 @@ def main(argv=None) -> int:
                     help="validate an existing serve_log.jsonl")
     ap.add_argument("--scan-log", default=None,
                     help="validate an existing scan_log.jsonl")
+    ap.add_argument("--fleet-log", default=None,
+                    help="validate a fleet router's fleet_log.jsonl "
+                    "(deepdfa_tpu/fleet/, docs/fleet.md)")
     ap.add_argument("--metrics", default=None,
                     help="validate a saved Prometheus /metrics scrape")
     ap.add_argument("--postmortem", default=None,
@@ -166,6 +175,23 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from deepdfa_tpu.obs import metrics
+
+    if args.fleet_log:
+        from deepdfa_tpu.fleet.router import validate_fleet_log
+
+        result = validate_fleet_log(args.fleet_log)
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "fleet log validation failed (declare the tags in "
+                "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the "
+                "router):\n  " + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
 
     if args.postmortem:
         from deepdfa_tpu.obs.flight import validate_postmortem_file

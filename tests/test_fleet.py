@@ -1,0 +1,517 @@
+"""Fleet layer unit tests (deepdfa_tpu/fleet/, docs/fleet.md) — the
+router/admission halves against STUB HTTP replicas, no model, no
+subprocess: failover retry, eject/readmit, drain observation, tenant
+token buckets, deadline shedding, co-serving arbitration, and fleet-log
+schema validation. The full-stack 2-replica drive (real checkpoints,
+SIGKILL, SIGTERM drain) lives in tests/test_fleet_cli.py via
+`fleet --smoke`."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepdfa_tpu.fleet import admission as fleet_admission, heartbeat
+from deepdfa_tpu.fleet.router import (
+    FleetLog,
+    NoReplicaAvailable,
+    Router,
+    validate_fleet_log,
+)
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+
+# ---------------------------------------------------------------------------
+# stub replica: a real HTTP server scoring with a deterministic function
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    replica_id = "stub"
+    delay_s = 0.0
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        body = json.dumps({"ok": True, "replica": self.replica_id}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n) or b"{}")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        # deterministic score: the same code gives the same prob on any
+        # replica (the bit-parity property the real fleet pins)
+        code = payload.get("code", "")
+        prob = (sum(map(ord, code)) % 1000) / 1000.0
+        body = json.dumps({
+            "ok": True,
+            "prob": prob,
+            "request_id": self.headers.get("X-Request-Id"),
+            "replica": self.replica_id,
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class StubReplica:
+    """One stub replica: HTTP server + its heartbeat file."""
+
+    def __init__(self, fleet_dir, replica_id: str, port: int = 0):
+        self.fleet_dir = fleet_dir
+        self.replica_id = replica_id
+        handler = type(
+            f"Stub_{replica_id}", (_StubHandler,),
+            {"replica_id": replica_id},
+        )
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self.beat()
+
+    def beat(self, state: str = heartbeat.READY, **info) -> None:
+        heartbeat.write_heartbeat(
+            self.fleet_dir, self.replica_id, "127.0.0.1", self.port,
+            state=state, info=info,
+        )
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def make_router(fleet_dir, log_path=None, **kw) -> Router:
+    kw.setdefault("heartbeat_timeout_s", 5.0)
+    kw.setdefault("poll_interval_s", 0.0)  # every poll() call rescans
+    kw.setdefault("retries", 2)
+    kw.setdefault("request_timeout_s", 10.0)
+    return Router(
+        fleet_dir,
+        log=FleetLog(log_path) if log_path else None,
+        **kw,
+    )
+
+
+def counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot().get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat protocol
+
+
+def test_heartbeat_round_trip_and_staleness(tmp_path):
+    path = heartbeat.write_heartbeat(
+        tmp_path, "r0", "127.0.0.1", 1234,
+        info={"checkpoint_step": 3, "ledger_params": {"m": 100.0}},
+    )
+    hb = heartbeat.read_heartbeat(path)
+    assert hb["replica_id"] == "r0" and hb["port"] == 1234
+    assert hb["state"] == "ready"
+    assert hb["ledger_params"] == {"m": 100.0}
+    assert heartbeat.is_fresh(hb, timeout_s=5.0)
+    assert not heartbeat.is_fresh(hb, 5.0, now=hb["t_unix"] + 6.0)
+    assert heartbeat.scan_heartbeats(tmp_path) == {"r0": hb}
+    with pytest.raises(ValueError):
+        heartbeat.write_heartbeat(tmp_path, "r0", "h", 1, state="zombie")
+    # a torn/garbage file is skipped, never fatal
+    (tmp_path / "replica-bad.json").write_text("{truncat")
+    assert set(heartbeat.scan_heartbeats(tmp_path)) == {"r0"}
+
+
+# ---------------------------------------------------------------------------
+# routing
+
+
+def test_router_spreads_and_propagates_request_id(tmp_path):
+    stubs = [StubReplica(tmp_path, f"r{i}") for i in range(2)]
+    log_path = tmp_path / "fleet_log.jsonl"
+    router = make_router(tmp_path, log_path)
+    try:
+        served = set()
+        for i in range(4):
+            rid = f"test-{i}"
+            status, data, replica, retries = router.forward(
+                json.dumps({"code": f"int f{i};"}).encode(), rid
+            )
+            assert status == 200 and retries == 0
+            resp = json.loads(data)
+            # the ingress id travelled to the replica and back
+            assert resp["request_id"] == rid
+            served.add(resp["replica"])
+            router.log_request(
+                rid, status, 0.01, tenant="default", priority=1,
+                replica=replica,
+            )
+        # least-outstanding with forwarded tie-break: sequential
+        # traffic round-robins across both replicas
+        assert served == {"r0", "r1"}
+    finally:
+        router.close()
+        for s in stubs:
+            s.stop()
+    result = validate_fleet_log(log_path)
+    assert result["ok"], result["problems"]
+    assert result["requests"] == 4
+    assert result["events"] >= 2  # two joins
+    assert result["summaries"] == 1  # appended by close()
+
+
+def test_router_failover_no_request_lost(tmp_path):
+    """Kill one stub replica; every request still answers 200 with the
+    same deterministic score, the dead replica is ejected, and the
+    retries counter shows the failover actually happened."""
+    stubs = [StubReplica(tmp_path, f"r{i}") for i in range(2)]
+    log_path = tmp_path / "fleet_log.jsonl"
+    router = make_router(tmp_path, log_path)
+    ejects0, retries0 = counter("fleet/ejects"), counter("fleet/retries")
+    try:
+        codes = [f"int g{i}(void);" for i in range(6)]
+        expect = {
+            c: (sum(map(ord, c)) % 1000) / 1000.0 for c in codes
+        }
+        # r0 dies; its heartbeat file stays fresh (the crash just
+        # happened) so the router WILL route to it and must recover
+        stubs[0].stop()
+        for i, code in enumerate(codes):
+            status, data, replica, _ = router.forward(
+                json.dumps({"code": code}).encode(), f"fo-{i}"
+            )
+            assert status == 200
+            resp = json.loads(data)
+            assert resp["replica"] == "r1"
+            assert resp["prob"] == expect[code]
+        assert counter("fleet/ejects") - ejects0 == 1
+        assert counter("fleet/retries") - retries0 >= 1
+        with router._lock:
+            assert router._replicas["r0"].ejected
+            assert not router._replicas["r1"].ejected
+    finally:
+        router.close()
+        stubs[1].stop()
+    result = validate_fleet_log(log_path)
+    assert result["ok"], result["problems"]
+    assert any(
+        json.loads(ln).get("fleet_event", {}).get("name") == "eject"
+        for ln in log_path.read_text().splitlines()
+    )
+
+
+def test_router_retries_request_reset_mid_response(tmp_path):
+    """The hard failover case: the replica READS the request, then the
+    connection dies before any response bytes (process killed
+    mid-batch). The router must classify it as a transport failure and
+    retry on the survivor — deterministically exercised here by a stub
+    that aborts every accepted connection after consuming the body."""
+
+    class _AbortHandler(_StubHandler):
+        replica_id = "dead"
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)  # the request was genuinely in flight
+            # abort without a status line: the router's getresponse()
+            # sees ConnectionReset/BadStatusLine, not an HTTP error
+            self.connection.close()
+
+    aborter = StubReplica.__new__(StubReplica)
+    aborter.fleet_dir = tmp_path
+    aborter.replica_id = "r0"
+    aborter.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _AbortHandler)
+    aborter.port = aborter.httpd.server_address[1]
+    aborter._thread = threading.Thread(
+        target=aborter.httpd.serve_forever, daemon=True
+    )
+    aborter._thread.start()
+    aborter.beat()
+    survivor = StubReplica(tmp_path, "r1")
+    router = make_router(tmp_path)
+    retries0 = counter("fleet/retries")
+    try:
+        # r0 wins the first pick (id order at equal load); every
+        # request that lands there dies mid-flight and must come back
+        # from r1 with the right score
+        for i in range(4):
+            code = f"int mid{i};"
+            status, data, _, _ = router.forward(
+                json.dumps({"code": code}).encode(), f"mid-{i}"
+            )
+            assert status == 200
+            resp = json.loads(data)
+            assert resp["replica"] == "r1"
+            assert resp["prob"] == (sum(map(ord, code)) % 1000) / 1000.0
+        assert counter("fleet/retries") - retries0 >= 1
+        with router._lock:
+            assert router._replicas["r0"].ejected
+    finally:
+        router.close()
+        aborter.stop()
+        survivor.stop()
+
+
+def test_router_readmits_recovered_replica(tmp_path):
+    stubs = [StubReplica(tmp_path, f"r{i}") for i in range(2)]
+    router = make_router(tmp_path)
+    readmits0 = counter("fleet/readmits")
+    try:
+        port0 = stubs[0].port
+        stubs[0].stop()
+        # fail onto r1 -> r0 ejected
+        router.forward(b'{"code": "x"}', "rid-0")
+        with router._lock:
+            assert router._replicas["r0"].ejected
+        # r0 comes back on the same port with a fresh heartbeat
+        stubs[0] = StubReplica(tmp_path, "r0", port=port0)
+        router.probe_ejected()
+        with router._lock:
+            assert not router._replicas["r0"].ejected
+        assert counter("fleet/readmits") - readmits0 == 1
+        # and it takes traffic again
+        served = set()
+        for i in range(4):
+            _, data, _, _ = router.forward(
+                b'{"code": "y"}', f"rid-{i + 1}"
+            )
+            served.add(json.loads(data)["replica"])
+        assert "r0" in served
+    finally:
+        router.close()
+        for s in stubs:
+            s.stop()
+
+
+def test_router_observes_drain_and_gone(tmp_path):
+    stubs = [StubReplica(tmp_path, f"r{i}") for i in range(2)]
+    log_path = tmp_path / "fleet_log.jsonl"
+    router = make_router(tmp_path, log_path)
+    try:
+        # r0 announces draining: still known, never routed
+        stubs[0].beat(state="draining")
+        router.poll(force=True)
+        for i in range(4):
+            _, data, _, _ = router.forward(b'{"code": "z"}', f"d-{i}")
+            assert json.loads(data)["replica"] == "r1"
+        # drained -> gone from the table entirely
+        stubs[0].beat(state="drained")
+        router.poll(force=True)
+        with router._lock:
+            assert "r0" not in router._replicas
+    finally:
+        router.close()
+        for s in stubs:
+            s.stop()
+    events = [
+        json.loads(ln)["fleet_event"]["name"]
+        for ln in log_path.read_text().splitlines()
+        if "fleet_event" in json.loads(ln)
+    ]
+    assert "drain_observed" in events and "gone" in events
+    result = validate_fleet_log(log_path)
+    assert result["ok"], result["problems"]
+
+
+def test_router_ignores_lingering_dead_heartbeats(tmp_path):
+    """A drained or stale heartbeat FILE stays on disk by design (crash
+    evidence) — it must not churn join+gone event pairs on every poll
+    of a router that never knew the replica."""
+    heartbeat.write_heartbeat(tmp_path, "r9", "127.0.0.1", 1, state="drained")
+    import json as _json
+
+    stale_path = heartbeat.heartbeat_path(tmp_path, "r8")
+    doc = {"heartbeat": {
+        "replica_id": "r8", "host": "127.0.0.1", "port": 2,
+        "state": "ready", "t_unix": time.time() - 3600,
+    }}
+    stale_path.write_text(_json.dumps(doc))
+    log_path = tmp_path / "fleet_log.jsonl"
+    router = make_router(tmp_path, log_path)
+    try:
+        for _ in range(3):
+            router.poll(force=True)
+        with router._lock:
+            assert router._replicas == {}
+    finally:
+        router.close()
+    events = [
+        _json.loads(ln)["fleet_event"]["name"]
+        for ln in log_path.read_text().splitlines()
+        if "fleet_event" in _json.loads(ln)
+    ]
+    assert events == []
+
+
+def test_router_no_replica_available(tmp_path):
+    router = make_router(tmp_path)
+    try:
+        with pytest.raises(NoReplicaAvailable):
+            router.forward(b"{}", "none-0")
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# admission
+
+
+def test_token_bucket_rate_and_burst():
+    b = fleet_admission.TokenBucket(rate=2.0, burst=3.0, now=0.0)
+    assert [b.try_take(0.0) for _ in range(4)] == [
+        True, True, True, False
+    ]  # burst capacity, then empty
+    assert b.try_take(0.5)  # refilled 1 token at rate 2/s
+    assert not b.try_take(0.5)
+    assert b.try_take(10.0) and b.try_take(10.0) and b.try_take(10.0)
+    assert not b.try_take(10.0)  # capped at burst, not rate*elapsed
+
+
+def test_admission_decisions():
+    clock = [100.0]
+    c = fleet_admission.AdmissionController(
+        tenants=fleet_admission.parse_tenants(
+            '{"vip": {"rate": 10, "burst": 10, "priority": 0},'
+            ' "tiny": {"rate": 0.001, "burst": 1, "priority": 2}}'
+        ),
+        default_rate=100.0, default_burst=100.0,
+        replica_capacity=4, shed_fraction=1.0,
+        service_time_init_ms=50.0, clock=lambda: clock[0],
+    )
+    # healthy path
+    d = c.decide("vip", outstanding=0, healthy=2)
+    assert d.admit and d.priority == 0
+    # no replicas
+    d = c.decide("vip", outstanding=0, healthy=0)
+    assert (d.status, d.reason) == (503, "no_replicas")
+    # per-tenant bucket: tiny gets one, then 429
+    assert c.decide("tiny", 0, 2).admit
+    d = c.decide("tiny", 0, 2)
+    assert (d.status, d.reason) == (429, "rate_limit")
+    # deadline shed: estimate (outstanding/healthy + 1) * 50ms = 150ms
+    d = c.decide("vip", outstanding=4, healthy=2, deadline_ms=100)
+    assert (d.status, d.reason) == (503, "deadline")
+    assert d.estimated_wait_ms == pytest.approx(150.0)
+    d = c.decide("vip", outstanding=4, healthy=2, deadline_ms=200)
+    assert d.admit
+    # overload shed spares interactive (priority 0), sheds batch
+    d = c.decide("default", outstanding=8, healthy=2)
+    assert (d.status, d.reason) == (503, "overload")
+    assert c.decide("vip", outstanding=8, healthy=2).admit
+    # EWMA calibration moves the estimate
+    for _ in range(50):
+        c.observe_service(0.01)
+    assert c.service_ewma_s == pytest.approx(0.01, rel=0.2)
+    assert c.decide("vip", outstanding=4, healthy=2, deadline_ms=100).admit
+
+
+def test_admission_fairness_between_equal_tenants():
+    """Two tenants with identical policies flooding together each get
+    their own bucket's worth — one noisy tenant cannot starve the
+    other."""
+    clock = [0.0]
+    c = fleet_admission.AdmissionController(
+        tenants=fleet_admission.parse_tenants(
+            '{"a": {"rate": 10, "burst": 10, "priority": 1},'
+            ' "b": {"rate": 10, "burst": 10, "priority": 1}}'
+        ),
+        replica_capacity=10_000, clock=lambda: clock[0],
+    )
+    admitted = {"a": 0, "b": 0}
+    # 10 seconds of interleaved flooding, 40 req/s/tenant offered
+    for step in range(400):
+        clock[0] = step * 0.025
+        for tenant in ("a", "b"):
+            if c.decide(tenant, outstanding=0, healthy=2).admit:
+                admitted[tenant] += 1
+    # each gets burst (10) + ~10/s * 10s = ~110; equal within 2%
+    assert admitted["a"] == admitted["b"]
+    assert 90 <= admitted["a"] <= 130
+
+
+def test_parse_tenants_rejects_bad_specs():
+    assert fleet_admission.parse_tenants("") == {}
+    with pytest.raises(ValueError):
+        fleet_admission.parse_tenants('["not", "an", "object"]')
+    with pytest.raises(ValueError):
+        fleet_admission.parse_tenants(
+            '{"t": {"rate": -1, "burst": 1}}'
+        )
+
+
+# ---------------------------------------------------------------------------
+# co-serving arbitration (the PR-10 param-bytes capacity signal)
+
+
+def test_parse_model_spec():
+    from deepdfa_tpu.fleet.replica import parse_model_spec
+
+    assert parse_model_spec("ggnn=/runs/a") == ("ggnn", "/runs/a", "best")
+    assert parse_model_spec("ggnn=/runs/a:last") == (
+        "ggnn", "/runs/a", "last"
+    )
+    # a path colon only splits when the tail looks like a checkpoint
+    # tag (no slash)
+    assert parse_model_spec("m=runs/x") == ("m", "runs/x", "best")
+    for bad in ("noequals", "=x", "name="):
+        with pytest.raises(ValueError):
+            parse_model_spec(bad)
+
+
+def test_plan_coserving():
+    plan = fleet_admission.plan_coserving
+    # unbudgeted: everything fits
+    assert plan({"a": 1e9, "b": 2e9}, 0) == (["a", "b"], [])
+    # greedy in declaration order, refusing what would overflow
+    assert plan({"a": 10.0, "b": 20.0, "c": 5.0}, 16.0) == (
+        ["a", "c"], ["b"]
+    )
+    # exact fit is a fit
+    assert plan({"a": 10.0, "b": 6.0}, 16.0) == (["a", "b"], [])
+    assert plan({}, 100.0) == ([], [])
+
+
+# ---------------------------------------------------------------------------
+# fleet log validation
+
+
+def test_validate_fleet_log_rejects_bad_shapes(tmp_path):
+    path = tmp_path / "fleet_log.jsonl"
+    path.write_text("\n".join([
+        json.dumps({"request": {"id": "a", "status": 200,
+                                "latency_ms": 1.0, "shed": 0,
+                                "priority": 1, "retries": 0}}),
+        json.dumps({"fleet_event": {"name": "eject", "t_unix": 1.0}}),
+        json.dumps({"fleet_event": {"name": "exploded", "t_unix": 1.0}}),
+        json.dumps({"request": {"status": 200}}),  # missing id
+        json.dumps({"mystery": 1}),
+        "not json at all",
+    ]) + "\n")
+    result = validate_fleet_log(path)
+    assert not result["ok"]
+    joined = "\n".join(result["problems"])
+    assert "exploded" in joined
+    assert "missing id/status" in joined
+    assert "unknown record shape" in joined
+    assert "not JSON" in joined
+
+
+def test_validate_fleet_log_catches_undeclared_tags(tmp_path):
+    path = tmp_path / "fleet_log.jsonl"
+    path.write_text(json.dumps({
+        "request": {"id": "a", "status": 200,
+                    "made_up_scalar_tag": 1.0},
+    }) + "\n")
+    result = validate_fleet_log(path)
+    assert not result["ok"]
+    assert any("made_up_scalar_tag" in p for p in result["problems"])

@@ -1,0 +1,140 @@
+"""CLI `fleet --smoke` end-to-end (real replica subprocesses, SIGKILL
+failover, SIGTERM drain) plus the fleet halves of the schema checker and
+the open-loop load bench — ISSUE 11 acceptance surface.
+
+Subprocess-only by design (tests/conftest.py:run_cli): the CLI
+normalizes to a 1-device CPU platform, which must never leak into this
+8-virtual-device pytest process."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+from tests.conftest import run_cli
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _last_json(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line in output: {stdout[-800:]}"
+    return json.loads(lines[-1])
+
+
+def test_fleet_smoke_end_to_end(tmp_path):
+    """`fleet --smoke`: a 2-replica fleet against a just-trained tiny
+    checkpoint — router scores bit-identical to singleton serving, both
+    replicas took traffic with a zero-recompile census each, an
+    over-deadline burst shed before any device time, a SIGKILLed
+    replica ejected with its in-flight work retried on the survivor (no
+    request lost), and the survivor drained gracefully on SIGTERM
+    leaving a postmortem + final SLO snapshot behind."""
+    res = run_cli(tmp_path, "fleet", "--smoke", timeout=420)
+    report = _last_json(res.stdout)
+
+    # -- bit parity vs singleton serving, spread across both replicas
+    assert report["bit_identical"] is True
+    assert len(report["scored"]) >= 6
+    assert all(
+        s["status"] == 200 and s["request_id"] for s in report["scored"]
+    )
+    assert report["both_replicas_served"] is True
+    # -- zero steady-state recompiles, pinned PER replica
+    assert report["zero_recompiles_per_replica"] is True
+    assert len(report["replica_census"]) == 2
+    for census in report["replica_census"].values():
+        assert census["steady_state_recompiles"] == 0
+        assert census["jit_lowerings"] >= 1
+
+    # -- deadline shed happened at the front door: 503s, replica
+    # request counters untouched
+    ds = report["deadline_shed"]
+    assert ds["all_shed"] is True
+    assert ds["no_device_time_spent"] is True
+    assert all(s == 503 for s, _ in ds["statuses"])
+    # -- token-bucket tenant: burst admitted, then 429
+    assert report["rate_limit"]["statuses"][-1] == 429
+
+    # -- failover: no request lost, scores still bit-identical
+    fo = report["failover"]
+    assert fo["all_ok"] is True
+    assert fo["ejects"] >= 1
+    assert fo["retries"] >= 1
+    assert fo["survivor_routable"] is True
+
+    # -- graceful drain: observed by the router, clean exit, postmortem
+    dr = report["drain"]
+    assert dr["exit_code"] == 0
+    assert dr["router_observed"] is True
+    assert dr["final_heartbeat_state"] == "drained"
+    assert dr["postmortem"]["ok"] is True
+    assert dr["postmortem"]["trigger"] == "sigterm"
+    assert dr["final_serve_log"] is True
+
+    # -- the router's log validates in-process AND through the script
+    assert report["fleet_log"]["ok"] is True
+    assert report["fleet_log"]["requests"] > 0
+    assert report["fleet_log"]["events"] > 0
+    log_path = Path(report["fleet_log"]["path"])
+    assert log_path.exists()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_schema.py"),
+         "--fleet-log", str(log_path)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu"),
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    result = json.loads(proc.stdout.splitlines()[0])
+    assert result["ok"] is True and result["undeclared"] == []
+
+    # -- diag renders the fleet section from the same log
+    run_dir = Path(report["run_dir"])
+    diag = run_cli(tmp_path, "diag", str(run_dir), "--json", timeout=120)
+    diag_report = _last_json(diag.stdout)
+    fleet = diag_report["fleet"]
+    assert fleet["requests"] == report["fleet_log"]["requests"]
+    assert len(fleet["replicas"]) == 2
+    assert fleet["shed_rate"] > 0
+    assert "deadline" in fleet["shed_reasons"]
+    event_names = {ev["name"] for ev in fleet["event_log"]}
+    assert {"join", "eject", "drain_observed"} <= event_names
+    assert fleet["counters"]["ejects"] >= 1
+
+
+def test_bench_load_smoke(tmp_path):
+    """scripts/bench_load.py --smoke: open-loop overload drive against
+    an in-process fleet; stamped record with the gated fleet headline
+    numbers (bench.py --child-fleet consumes the same fn)."""
+    out = tmp_path / "fleet_bench.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_load.py"),
+         "--smoke", "--out", str(out)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu",
+                 DEEPDFA_TPU_STORAGE=str(tmp_path)),
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    record = json.loads(out.read_text())
+    assert record["metric"] == "fleet_p99_overload_ms"
+    assert record["value"] > 0
+    assert record["fleet_p99_overload_ms"] >= record["fleet_latency_p50_ms"]
+    # the generator genuinely overloads: offered rate above measured
+    # warm capacity, and the admission layer shed something (the
+    # best-effort tenant's tiny bucket guarantees a nonzero floor)
+    assert record["fleet_offered_rate_per_sec"] > (
+        record["fleet_warm_requests_per_sec"]
+    )
+    assert 0.0 < record["fleet_shed_rate"] < 1.0
+    assert record["fleet_admitted"] + record["fleet_shed"] + (
+        record["fleet_failed_other"]
+    ) == record["fleet_requests_total"]
+    assert record["fleet_replicas"] == 2
+    # the Morphling invariant survives overload: nothing recompiled
+    assert record["fleet_steady_state_recompiles"] == 0
+    # provenance stamp, like every other bench record
+    for k in ("schema_version", "git_sha", "jax_version"):
+        assert k in record
