@@ -67,6 +67,9 @@ SCATTER_TIMEOUT = float(
     os.environ.get("DEEPDFA_BENCH_SCATTER_TIMEOUT", 420)
 )
 FLEET_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_FLEET_TIMEOUT", 420))
+CASCADE_TIMEOUT = float(
+    os.environ.get("DEEPDFA_BENCH_CASCADE_TIMEOUT", 420)
+)
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -733,6 +736,49 @@ def run_fleet_measurement(platform: str) -> dict:
     return out
 
 
+def run_cascade_measurement(platform: str) -> dict:
+    """Cascaded-inference frontier observables (ISSUE 12); child,
+    CPU-viable.
+
+    Delegates to scripts/bench_cascade.py:bench_cascade — combined-only
+    vs cascade throughput over one labeled synthetic dev set, the
+    fitted-band escalation rate, the one-sided AUC drift, and the
+    quantized stage-2 entry's param-bytes fraction — and passes the
+    fields through: they already carry the cascade_*/quant_* names the
+    bench gate reads (`cascade_score_drift` and
+    `quant_param_bytes_fraction` are absolute-bounded)."""
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    if "DEEPDFA_TPU_STORAGE" not in os.environ:
+        import tempfile
+
+        tmp = tempfile.TemporaryDirectory(prefix="bench-cascade-")
+        os.environ["DEEPDFA_TPU_STORAGE"] = tmp.name
+    from bench_cascade import bench_cascade
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_cascade(
+        int(os.environ.get("DEEPDFA_BENCH_CASCADE_EXAMPLES",
+                           48 if smoke else 128)),
+        smoke=smoke,
+    )
+    out = {
+        k: v for k, v in rec.items()
+        if k.startswith(("cascade_", "quant_"))
+    }
+    out["cascade_platform"] = platform
+    return out
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -850,6 +896,22 @@ def _measure_full(
                 result["fleet_error"] = ferr
         else:
             result["fleet_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_CASCADE", "0") == "1":
+        # cascaded-inference frontier (ISSUE 12), opt-in via
+        # DEEPDFA_BENCH_CASCADE (the cascade is default-off), own
+        # bounded child for the same wedge-isolation reason
+        cabudget = min(CASCADE_TIMEOUT, deadline - time.time())
+        if cabudget >= 90:
+            casc, caerr = _run_child(
+                "--child-cascade", result.get("platform", platform),
+                cabudget,
+            )
+            if casc is not None:
+                result.update(casc)
+            else:
+                result["cascade_error"] = caerr
+        else:
+            result["cascade_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -1068,6 +1130,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-fleet":
         print(
             _CHILD_TAG + json.dumps(run_fleet_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-cascade":
+        print(
+            _CHILD_TAG + json.dumps(run_cascade_measurement(sys.argv[2])),
             flush=True,
         )
     else:

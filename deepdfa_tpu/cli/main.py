@@ -814,6 +814,29 @@ def cmd_train_combined(args) -> None:
 
     tok, enc_cfg, mcfg, enc_import = _combined_setup(args, cfg)
 
+    # the run-dir model manifest (serve/cascade.py): serving, fleet
+    # co-serving, and the cascade's stage 2 rebuild the tokenizer +
+    # encoder config from this, never from re-supplied CLI args
+    from deepdfa_tpu.serve import cascade as _cascade_mod
+
+    arch = getattr(args, "arch", "roberta")
+    if args.tokenizer:
+        tok_dir = Path(args.tokenizer)
+        tok_desc = {
+            "kind": "bpe",
+            "vocab": str(next(tok_dir.glob("*vocab.json"))),
+            "merges": str(next(tok_dir.glob("*merges.txt"))),
+        }
+    else:
+        tok_desc = {
+            "kind": "hash", "vocab_size": tok.vocab_size,
+            "t5_frame": arch == "t5",
+        }
+    _cascade_mod.save_model_setup(
+        run_dir, "t5" if arch == "t5" else "combined", mcfg, tok_desc,
+        args.max_length,
+    )
+
     from deepdfa_tpu.graphs import GraphStore
 
     store = None if args.no_graph else GraphStore(
@@ -1681,6 +1704,43 @@ def cmd_diag(args) -> None:
         raise SystemExit(rc)
 
 
+def cmd_cascade_calibrate(args) -> None:
+    """Fit the cascade's temperature + uncertainty band from a labeled
+    dev set (docs/cascade.md calibration recipe): a JSONL of
+    {"prob": p, "label": 0|1} rows (e.g. `score` output joined with
+    labels) -> the serve.cascade_temperature / serve.cascade_band
+    overrides to serve with."""
+    from deepdfa_tpu.eval import calibrate as calibrate_mod
+
+    probs, labels = [], []
+    with open(args.scores) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            p, y = row.get(args.prob_key), row.get(args.label_key)
+            if p is None or y is None:
+                continue
+            probs.append(float(p))
+            labels.append(int(y))
+    if not probs:
+        raise SystemExit(
+            f"no rows in {args.scores} carry both {args.prob_key!r} "
+            f"and {args.label_key!r}"
+        )
+    result = calibrate_mod.calibrate(
+        probs, labels, target_escalation=args.target_escalation
+    )
+    result["overrides"] = [
+        f"serve.cascade_temperature={result['temperature']}",
+        f"serve.cascade_band={json.dumps(result['band'])}",
+    ]
+    print(json.dumps(result), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(result, indent=2))
+
+
 def cmd_score(args) -> None:
     """Offline batch scoring of C source files against a trained
     checkpoint through the online serving path (docs/serving.md):
@@ -1748,6 +1808,14 @@ def cmd_serve(args) -> None:
             # postmortem validated (docs/efficiency.md)
             or not report["ledger_sites"]
             or not report["postmortem"]["ok"]
+            # ISSUE 12: the cascade round trip — per-request stage
+            # verdicts, escalation accounting, per-stage SLO windows,
+            # zero recompiles on the stage-2 ladder, schema-valid
+            # cascade serve_log (None = cascade overridden off)
+            or (
+                report.get("cascade") is not None
+                and not report["cascade"]["ok"]
+            )
         )
         if bad:
             raise SystemExit("serve smoke contract violated (see report)")
@@ -2226,9 +2294,10 @@ def main(argv=None) -> None:
     p.add_argument("--out", default=None,
                    help="scores jsonl path (default <run>/scores.jsonl)")
     p.add_argument("--family", default="deepdfa",
-                   choices=["deepdfa"],
-                   help="model family to restore (combined/t5 serve "
-                        "through the library API for now; docs/serving.md)")
+                   choices=["deepdfa", "combined", "t5"],
+                   help="model family to restore; combined/t5 need the "
+                        "run's model_cfg.json manifest (train-combined "
+                        "writes it; docs/cascade.md)")
     p.add_argument("--smoke", action="store_true",
                    help="self-contained: train a tiny synthetic "
                         "checkpoint, score its corpus, assert zero "
@@ -2242,13 +2311,29 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser(
+        "cascade-calibrate",
+        help="fit the cascade temperature + uncertainty band from a "
+        "labeled dev-set scores jsonl (docs/cascade.md)",
+    )
+    p.add_argument("--scores", required=True,
+                   help="jsonl with per-row prob + label fields")
+    p.add_argument("--prob-key", default="prob")
+    p.add_argument("--label-key", default="label")
+    p.add_argument("--target-escalation", type=float, default=0.3,
+                   help="dev-set fraction the band should escalate")
+    p.add_argument("--out", default=None,
+                   help="also write the result json here")
+    p.set_defaults(fn=cmd_cascade_calibrate)
+
+    p = sub.add_parser(
         "serve",
         help="online scoring service: HTTP /score /healthz /stats over "
         "the dynamic batcher (docs/serving.md)",
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8471)
-    p.add_argument("--family", default="deepdfa", choices=["deepdfa"])
+    p.add_argument("--family", default="deepdfa",
+                   choices=["deepdfa", "combined", "t5"])
     p.add_argument("--smoke", action="store_true",
                    help="ephemeral-port smoke: real HTTP round trips "
                         "against a just-trained tiny checkpoint (tier-1)")

@@ -372,6 +372,45 @@ class ServeConfig:
     lines_steps: int = 8
     # top-scoring lines echoed per request (0 = every tokenized line)
     lines_top_k: int = 10
+    # -- quantized serving executables (serve/quant.py, docs/cascade.md)
+    # a checkpoint tag with the @int8 suffix (serve.checkpoint=best@int8,
+    # or a fleet co-serving entry) restores fp32 and serves per-channel
+    # symmetric int8 matmul weights + bf16 rest, dequantized inside the
+    # compiled program (f32 accumulation). Admission contract: the max
+    # calibration prob drift vs the fp32 params must stay within this
+    # bound or the registry REFUSES the entry loudly (offending param
+    # paths named) — mirrors the PR-8 bf16 message-policy bound
+    quant_drift_bound: float = 5e-2
+    # calibration batch rows per family (deterministic random inputs;
+    # the drift is measured over one packed batch of this many rows)
+    quant_calibration_samples: int = 8
+    # -- two-stage cascaded inference (serve/cascade.py, docs/cascade.md)
+    # /score runs the cheap GGNN on EVERY request and escalates only
+    # requests whose calibrated stage-1 probability falls inside the
+    # uncertainty band to the combined/t5 executor. Default OFF — the
+    # single-stage path stays byte-identical
+    cascade: bool = False
+    # the uncertainty band over CALIBRATED stage-1 probabilities:
+    # lo <= p < hi escalates (fit both edges with eval/calibrate.py
+    # from a labeled dev set; the default brackets maximum uncertainty)
+    cascade_band: tuple[float, float] = (0.25, 0.75)
+    # temperature for stage-1 probability calibration (1.0 = identity;
+    # fit with eval/calibrate.py:fit_temperature on a labeled dev set)
+    cascade_temperature: float = 1.0
+    # stage-2 model: run directory (None = the serving run's own dir —
+    # the smoke/test layout where checkpoints-combined/ sits next to
+    # checkpoints/), family, and checkpoint tag (@int8 composes)
+    cascade_run_dir: str | None = None
+    cascade_family: str = "combined"
+    cascade_checkpoint: str = "best"
+    # per-escalation wait on the stage-2 batcher
+    cascade_timeout_s: float = 60.0
+    # cascade-aware degradation (docs/cascade.md shed-order table):
+    # once the stage-2 queue holds this fraction of serve.queue_limit,
+    # new escalations are SHED (the request answers with its stage-1
+    # score, counted in serve/cascade_sheds) — under overload stage-2
+    # escalations degrade before any stage-1 screen is refused
+    cascade_shed_depth_fraction: float = 0.75
 
 
 @dataclass(frozen=True)
@@ -470,6 +509,12 @@ class FleetConfig:
     # initial EWMA service-time estimate the deadline shed uses before
     # real completions calibrate it
     service_time_init_ms: float = 50.0
+    # cascade-aware shedding (docs/cascade.md): requests marked
+    # {"cascade_stage": 2} (stage-2 escalations re-entering through the
+    # router) shed at this fraction of the overload capacity — BEFORE
+    # plain stage-1 traffic sheds at shed_fraction — so overload
+    # degrades the cascade to stage-1-only first
+    cascade_shed_fraction: float = 0.75
     # -- drain (fleet/replica.py)
     # lame-duck period: after announcing `draining` in the heartbeat, a
     # replica keeps serving this long before tearing down, so the router

@@ -18,6 +18,12 @@ batch slot. Three independent mechanisms, checked in order:
    Separately, past `shed_fraction` of estimated fleet capacity,
    priority>0 (non-interactive) requests are shed 503 `overload` so
    interactive traffic keeps its latency while batch traffic backs off.
+4. **cascade-aware shed** (docs/cascade.md) — a request marked
+   `cascade_stage=2` (a stage-2 escalation re-entering through the
+   router) sheds at `cascade_shed_fraction` of the overload capacity,
+   BEFORE plain traffic sheds: under overload the cascade degrades to
+   stage-1-only screening first — the natural degradation mode, since
+   every shed escalation still has its stage-1 answer.
 
 Every decision lands in `fleet/*` registry metrics (admitted and shed,
 by tenant and by priority class) so shed-rate is a first-class SLO
@@ -145,6 +151,7 @@ class AdmissionController:
         replica_capacity: int = 64,
         shed_fraction: float = 1.0,
         service_time_init_ms: float = 50.0,
+        cascade_shed_fraction: float = 0.75,
         clock=time.monotonic,
     ):
         self.clock = clock
@@ -154,6 +161,7 @@ class AdmissionController:
         self.default_priority = int(default_priority)
         self.replica_capacity = int(replica_capacity)
         self.shed_fraction = float(shed_fraction)
+        self.cascade_shed_fraction = float(cascade_shed_fraction)
         self._service_ewma_s = max(1e-6, service_time_init_ms / 1e3)
         self._lock = threading.Lock()
         self._buckets: dict[str, TokenBucket] = {}
@@ -226,12 +234,15 @@ class AdmissionController:
         healthy: int,
         deadline_ms: float | None = None,
         priority: int | None = None,
+        cascade_stage: int | None = None,
         now: float | None = None,
     ) -> Decision:
         """The one front-door verdict. A request may declare its own
         `priority`, but only to DEMOTE itself below its tenant policy's
         class — self-promotion to interactive would let any tenant
-        bypass the overload shed, the exact isolation it provides."""
+        bypass the overload shed, the exact isolation it provides.
+        `cascade_stage=2` marks a stage-2 escalation, shed earlier than
+        plain traffic under overload (docs/cascade.md shed order)."""
         now = self.clock() if now is None else now
         policy = self.policy_for(tenant)
         tenant = policy.name  # bounded label (dynamic-tenant overflow)
@@ -259,6 +270,14 @@ class AdmissionController:
         if deadline_ms is not None and est_ms > float(deadline_ms):
             return shed(503, "deadline", est_ms)
         capacity = self.shed_fraction * healthy * self.replica_capacity
+        # shed order under load (docs/cascade.md): stage-2 escalations
+        # first (they already hold a stage-1 answer), then priority>0
+        if (
+            cascade_stage is not None
+            and int(cascade_stage) >= 2
+            and outstanding >= self.cascade_shed_fraction * capacity
+        ):
+            return shed(503, "cascade_overload", est_ms)
         if prio > INTERACTIVE and outstanding >= capacity:
             return shed(503, "overload", est_ms)
         self._m_admitted.inc()

@@ -82,19 +82,34 @@ def param_bytes(params) -> float:
     return total
 
 
-def parse_model_spec(spec: str) -> tuple[str, str, str]:
-    """One `fleet.models` entry: "name=run_dir" or
-    "name=run_dir:checkpoint" -> (name, run_dir, checkpoint)."""
+def parse_model_spec(spec: str) -> tuple[str, str, str, str]:
+    """One `fleet.models` entry:
+    "name=[family:]run_dir[:checkpoint]" -> (name, family, run_dir,
+    checkpoint). The optional leading family (deepdfa | combined | t5,
+    serve/registry.py's table) lets one replica co-serve the combined/t5
+    transformer next to the GGNN — the cascade's fleet-wide layout; a
+    checkpoint with the @int8 suffix co-serves the quantized entry."""
+    from deepdfa_tpu.serve.registry import CKPT_DIR_BY_FAMILY
+
     name, sep, rest = spec.partition("=")
     if not sep or not name or not rest:
         raise ValueError(
-            f"fleet.models entry {spec!r} must be name=run_dir"
-            f"[:checkpoint]"
+            f"fleet.models entry {spec!r} must be "
+            f"name=[family:]run_dir[:checkpoint]"
         )
+    family = "deepdfa"
+    head, sep, tail = rest.partition(":")
+    if sep and head in CKPT_DIR_BY_FAMILY:
+        family, rest = head, tail
+        if not rest:
+            raise ValueError(
+                f"fleet.models entry {spec!r} names family {head!r} but "
+                f"no run_dir"
+            )
     run_dir, sep, ckpt = rest.rpartition(":")
     if not sep or "/" in ckpt or not run_dir:
         run_dir, ckpt = rest, "best"
-    return name, run_dir, ckpt
+    return name, family, run_dir, ckpt
 
 
 class _DrainingServer(ThreadingHTTPServer):
@@ -147,16 +162,25 @@ class ReplicaWorker:
     # -- construction --------------------------------------------------------
 
     def _build_service(
-        self, run_dir: Path, checkpoint: str
+        self, run_dir: Path, checkpoint: str, family: str | None = None
     ) -> tuple[ScoringService, float]:
         """(service, measured param bytes) for one registry entry; the
         restore happens first so co-serving admission decides on the
-        MEASURED capacity signal before the expensive AOT warmup."""
+        MEASURED capacity signal before the expensive AOT warmup.
+        Combined/t5 entries rebuild their tokenizer + encoder config
+        from the run's model_cfg.json manifest (serve/cascade.py), so a
+        replica restores ALL three families — the cascade's fleet-wide
+        layout — and a @int8 checkpoint restores the quantized entry."""
         from deepdfa_tpu.serve.registry import ModelRegistry
 
-        cfg = self.cfg if run_dir == self.run_dir else None
+        family = family or self.family
+        cfg = (
+            self.cfg
+            if run_dir == self.run_dir and family == self.family
+            else None
+        )
         registry = ModelRegistry(
-            run_dir, family=self.family, checkpoint=checkpoint, cfg=cfg
+            run_dir, family=family, checkpoint=checkpoint, cfg=cfg
         )
         nbytes = param_bytes(registry.params())
         service = ScoringService(registry, registry.cfg)
@@ -173,21 +197,24 @@ class ReplicaWorker:
         """Restore + warm every co-served entry the HBM budget admits
         (primary first — it is never refused; a budget too small for the
         primary is an operator error worth failing loudly)."""
-        specs: list[tuple[str, Path, str]] = [
-            (PRIMARY, self.run_dir, self.cfg.serve.checkpoint)
+        specs: list[tuple[str, Path, str, str]] = [
+            (PRIMARY, self.run_dir, self.cfg.serve.checkpoint,
+             self.family)
         ]
         for spec in self.cfg.fleet.models:
-            name, run_dir, ckpt = parse_model_spec(spec)
+            name, family, run_dir, ckpt = parse_model_spec(spec)
             if name == PRIMARY:
                 raise ValueError(
                     f"fleet.models entry {spec!r} shadows the primary "
                     f"entry name {PRIMARY!r}"
                 )
-            specs.append((name, Path(run_dir), ckpt))
+            specs.append((name, Path(run_dir), ckpt, family))
         budget = float(self.cfg.fleet.hbm_budget_bytes)
         measured: dict[str, float] = {}
-        for name, run_dir, ckpt in specs:
-            service, nbytes = self._build_service(run_dir, ckpt)
+        for name, run_dir, ckpt, family in specs:
+            service, nbytes = self._build_service(
+                run_dir, ckpt, family=family
+            )
             measured[name] = nbytes
             loaded, refused = fleet_admission.plan_coserving(
                 measured, budget

@@ -508,6 +508,7 @@ def router_from_config(
         replica_capacity=fcfg.replica_capacity,
         shed_fraction=fcfg.shed_fraction,
         service_time_init_ms=fcfg.service_time_init_ms,
+        cascade_shed_fraction=fcfg.cascade_shed_fraction,
     )
     return Router(
         fleet_dir,
@@ -611,6 +612,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 priority = int(priority)
             except (TypeError, ValueError):
                 priority = None
+        # stage-2 escalations mark themselves so the admission layer
+        # can shed them before stage-1 screens (docs/cascade.md)
+        cascade_stage = payload.get("cascade_stage")
+        if cascade_stage is not None:
+            try:
+                cascade_stage = int(cascade_stage)
+            except (TypeError, ValueError):
+                cascade_stage = None
         router.poll()
         decision = router.admission.decide(
             str(tenant),
@@ -618,6 +627,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             healthy=router.routable_count(),
             deadline_ms=deadline_ms,
             priority=priority,
+            cascade_stage=cascade_stage,
         )
         if not decision.admit:
             # shed BEFORE any forward: no frontend or device time spent

@@ -76,9 +76,23 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
     smoke_kw.setdefault("dataset", "fleet-smoke")
     smoke_kw.setdefault("n_examples", 16)
     smoke_kw.setdefault("max_epochs", 1)
+    # the co-served combined entry (ROADMAP item 2 -> done): replicas
+    # restore the transformer family NEXT TO the GGNN from the same run
+    # dir — the fleet-wide cascade layout. The run dir is deterministic
+    # from the run name, so the fleet.models override can name it
+    # before build_smoke_run creates it.
+    from deepdfa_tpu.core import paths
+
+    stage2_run_dir = paths.runs_dir(smoke_kw["run_name"])
     cfg, run_dir, sources_dir = driver.build_smoke_run(
         extra_overrides=[
             "serve.request_log=true",
+            "fleet.models=" + json.dumps(
+                [f"stage2=combined:{stage2_run_dir}:best"]
+            ),
+            # tiny stage-2 serve batches (rows_for_bucket(32, 128) = 4)
+            # keep the combined warmup ladder cheap on CPU
+            "data.token_budget=128",
             # ONE ladder size so every phase (baseline, sequential
             # routing, concurrent failover) runs the IDENTICAL compiled
             # executable: cross-ladder-size runs (G1 vs G4) can differ
@@ -106,6 +120,11 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
     )
     fcfg = cfg.fleet
     fleet_dir = Path(fcfg.fleet_dir or run_dir / "fleet")
+    # stage-2 artifacts (checkpoints-combined/ + model_cfg.json) must
+    # exist before any replica restores the co-served entry
+    from deepdfa_tpu.serve import cascade as cascade_mod
+
+    cascade_mod.build_stage2_smoke(run_dir, cfg, family="combined")
 
     # -- singleton baseline: the offline score path on the same
     # checkpoint IS single-replica serving (same registry restore, same
@@ -186,6 +205,47 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
             h.get("steady_state_recompiles") == 0
             for h in census.values()
         )
+
+        # -- phase 1.5: multi-family co-serving — requests picking the
+        # combined entry with {"model": "stage2"} answer 200 through
+        # the router, every replica restored it (ROADMAP item 2), and
+        # the per-entry census stays at zero recompiles
+        coserve_scored = []
+        for code in list(codes.values())[:2]:
+            status, resp = router_server.request(
+                "POST", "/score", {"code": code, "model": "stage2"}
+            )
+            prob = resp.get("prob")
+            coserve_scored.append({
+                "status": status,
+                "prob": prob,
+                "in_range": (
+                    prob is not None and 0.0 <= float(prob) <= 1.0
+                ),
+            })
+        # per-entry census AFTER the co-served traffic: the combined
+        # ladder must not have lowered anything post-warmup either
+        census2 = {
+            rid: _replica_healthz(*addr)
+            for rid, addr in replica_addr.items()
+        }
+        report["coserved_combined"] = {
+            "scored": coserve_scored,
+            "replicas_restored": all(
+                "stage2" in (h.get("models") or {})
+                for h in census2.values()
+            ),
+            "zero_recompiles": all(
+                (h.get("models") or {}).get("stage2", {}).get(
+                    "steady_state_recompiles"
+                ) == 0
+                for h in census2.values()
+            ),
+            "ok": all(
+                s["status"] == 200 and s["in_range"]
+                for s in coserve_scored
+            ),
+        }
 
         # -- phase 2a: over-deadline burst shed BEFORE device time.
         # Evidence: every reply is a 503 `deadline`, and the replicas'
@@ -338,6 +398,13 @@ def smoke_verdict(report: dict) -> list[str]:
         bad.append("traffic did not spread across both replicas")
     if not report.get("zero_recompiles_per_replica"):
         bad.append("steady-state recompiles on a replica")
+    co = report.get("coserved_combined") or {}
+    if not co.get("ok"):
+        bad.append("co-served combined entry did not answer 200")
+    if not co.get("replicas_restored"):
+        bad.append("a replica failed to restore the combined entry")
+    if not co.get("zero_recompiles"):
+        bad.append("steady-state recompiles on the combined entry")
     ds = report.get("deadline_shed") or {}
     if not (ds.get("all_shed") and ds.get("no_device_time_spent")):
         bad.append("over-deadline burst not shed before device time")

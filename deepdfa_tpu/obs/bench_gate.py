@@ -49,6 +49,16 @@ DEFAULT_TOLERANCES: dict[str, float] = {
     # tracked
     "ggnn_mfu": 0.25,
     "ggnn_kernel_mfu": 0.25,
+    # cascaded inference (ISSUE 12, scripts/bench_cascade.py via
+    # bench.py --child-cascade behind DEEPDFA_BENCH_CASCADE): end-to-end
+    # cascade req/s over the same dev set the combined-only baseline
+    # serves — the capacity multiplier the cascade exists for
+    "cascade_req_per_sec": 0.25,
+    # the frontier's other axis: the cascade's measured speedup over
+    # combined-only serving must stay a WIN (>1 means more requests per
+    # device-second; gated against the trajectory so the margin cannot
+    # silently erode)
+    "cascade_speedup": 0.20,
 }
 
 #: fail when `new > (1 + tol) * reference` (lower is better)
@@ -73,6 +83,14 @@ LOWER_IS_BETTER: dict[str, float] = {
     # shared compile service.
     "compile_seconds_total": 1.0,
     "train_compile_seconds_total": 1.0,
+    # cascaded inference (ISSUE 12): the escalation rate at the fitted
+    # band — creeping up means the calibration drifted or the band
+    # widened, eroding the FLOP savings (generous: it is a property of
+    # the fitted band on a synthetic dev set)
+    "cascade_escalation_rate": 0.5,
+    # the quantized entry's param-bytes fraction vs fp32 — rising means
+    # the quantizer stopped covering weights it used to cover
+    "quant_param_bytes_fraction": 0.10,
 }
 
 #: ABSOLUTE upper bounds, checked whenever the candidate carries the
@@ -81,6 +99,14 @@ LOWER_IS_BETTER: dict[str, float] = {
 #: join). Exceeding one is a `regression`.
 ABSOLUTE_UPPER_BOUNDS: dict[str, float] = {
     "obs_ledger_overhead_fraction": 0.02,
+    # the cascade's pinned accuracy contract (ISSUE 12, docs/cascade.md):
+    # dev-set AUC may trail combined-only serving by at most the drift
+    # bound (one-sided — a cascade that scores BETTER is not a
+    # regression); mirrors serve.quant_drift_bound's default
+    "cascade_score_drift": 0.05,
+    # int8 matmul weights + bf16 rest must keep the quantized entry
+    # under half the fp32 bytes or the quantizer is not doing its job
+    "quant_param_bytes_fraction": 0.5,
 }
 
 

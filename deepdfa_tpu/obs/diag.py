@@ -287,6 +287,67 @@ def slo_section(serve_records: list[dict]) -> dict:
     return out
 
 
+def cascade_section(serve_records: list[dict]) -> dict:
+    """The two-stage cascade section (serve/cascade.py, docs/cascade.md),
+    rebuilt from serve_log.jsonl: escalation accounting from the newest
+    summary's cascade section, the observed stage-1-vs-stage-2 latency
+    attribution from per-request entries, and the quantized-vs-fp32
+    per-entry param bytes from the embedded ledger snapshot."""
+    entries = [
+        r["request"] for r in serve_records
+        if isinstance(r.get("request"), dict)
+        and "stage" in r["request"]
+    ]
+    summary = next(
+        (
+            rec["cascade"] for rec in reversed(serve_records)
+            if isinstance(rec.get("cascade"), dict)
+        ),
+        None,
+    )
+    out: dict = {}
+    if summary is not None:
+        out["counters"] = summary
+    if entries:
+        esc = sum(1 for e in entries if int(e.get("stage", 1)) == 2)
+        out["requests"] = len(entries)
+        out["escalated"] = esc
+        out["escalation_rate_observed"] = round(esc / len(entries), 4)
+        out["sheds_observed"] = sum(
+            1 for e in entries if e.get("cascade_shed")
+        )
+        for stage in ("cascade_stage1_ms", "cascade_stage2_ms"):
+            vals = [e[stage] for e in entries if stage in e]
+            if vals:
+                out[f"{stage}_mean"] = round(sum(vals) / len(vals), 3)
+    # quantized entries next to their fp32 twins: the density win the
+    # per-entry param-bytes ledger measures (serve/quant.py)
+    led_params = next(
+        (
+            rec["ledger"]["params"] for rec in reversed(serve_records)
+            if isinstance(rec.get("ledger"), dict)
+            and isinstance(rec["ledger"].get("params"), dict)
+        ),
+        None,
+    )
+    if led_params:
+        quant_entries = {}
+        for tag, nbytes in sorted(led_params.items()):
+            if not tag.endswith("@int8"):
+                continue
+            twin = led_params.get(tag[: -len("@int8")])
+            quant_entries[tag] = {
+                "bytes": nbytes,
+                "fp32_bytes": twin,
+                "fraction": (
+                    round(nbytes / twin, 4) if twin else None
+                ),
+            }
+        if quant_entries:
+            out["quant_entries"] = quant_entries
+    return out
+
+
 def load_scan_records(run_dir: Path) -> list[dict]:
     """scan_log.jsonl records (one summary per repo scan,
     deepdfa_tpu/scan/scanner.py; docs/scanning.md)."""
@@ -605,6 +666,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "resilience": resilience_log(run_dir, records, events),
         "serve": serve_attribution(serve_records),
         "slo": slo_section(serve_records),
+        "cascade": cascade_section(serve_records),
         "scan": scan_section(load_scan_records(run_dir)),
         "fleet": fleet_section(run_dir, load_fleet_records(run_dir)),
         "efficiency": efficiency_section(run_dir, records),
@@ -749,6 +811,55 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f"{eng.get('hot_swaps')} requests_total="
                 f"{eng.get('requests_total')}\n"
             )
+
+    casc = report.get("cascade") or {}
+    if casc:
+        w("\ntwo-stage cascade (serve_log.jsonl, docs/cascade.md):\n")
+        counters = casc.get("counters") or {}
+        rate = counters.get(
+            "escalation_rate", casc.get("escalation_rate_observed")
+        )
+        if rate is not None:
+            w(
+                f"  escalation rate {_bar(float(rate), 20)} "
+                f"{float(rate):7.2%}"
+            )
+            w(
+                f"  (requests={int(counters.get('requests', casc.get('requests', 0)))} "
+                f"escalated={int(counters.get('escalations', casc.get('escalated', 0)))} "
+                f"sheds={int(counters.get('sheds', casc.get('sheds_observed', 0)))})\n"
+            )
+        stages = [
+            (s.removesuffix("_ms_mean"), casc[s])
+            for s in ("cascade_stage1_ms_mean", "cascade_stage2_ms_mean")
+            if s in casc
+        ]
+        if stages:
+            total = sum(v for _, v in stages) or 1.0
+            w("  per-stage latency attribution (mean ms):\n")
+            for s, v in stages:
+                w(f"    {s:<16}{_bar(v / total, 20)} {v:8.3f}ms\n")
+        if counters.get("stage2_steady_state_recompiles") is not None:
+            w(
+                f"  stage-2 steady-state recompiles: "
+                f"{int(counters['stage2_steady_state_recompiles'])}\n"
+            )
+        quant = casc.get("quant_entries") or {}
+        if quant:
+            w("  quantized registry entries (param bytes vs fp32):\n")
+            for tag, v in quant.items():
+                frac = v.get("fraction")
+                frac_s = (
+                    f" {_bar(frac, 16)} {frac:7.2%}"
+                    if isinstance(frac, float) else ""
+                )
+                w(
+                    f"    {tag}: {v['bytes']:.0f}B"
+                    + (
+                        f" vs {v['fp32_bytes']:.0f}B{frac_s}\n"
+                        if v.get("fp32_bytes") else "\n"
+                    )
+                )
 
     scan = report.get("scan") or {}
     if scan:
@@ -1029,6 +1140,7 @@ def build_smoke_run(run_dir: Path) -> Path:
                     "skipped_steps": epoch, "rollbacks": 0,
                     "ledger": led.snapshot(),
                 })
+        ledger_snapshot = led.snapshot()
     finally:
         obs_ledger.disable()
     tdir = run_dir / "trace"
@@ -1087,6 +1199,49 @@ def build_smoke_run(run_dir: Path) -> Path:
             device_s=2e-3,
         )
     rlog.append({"serve_slo": engine.snapshot()})
+    # cascade-mode entries through the SAME emitters (serve/cascade.py,
+    # docs/cascade.md): stage-tagged requests, a cascade summary
+    # section, and a quantized registry entry next to its fp32 twin in
+    # the embedded ledger params — what the diag cascade section reads
+    from deepdfa_tpu.obs.slo import CASCADE_STAGES, STAGES
+
+    casc_engine = SloEngine(stages=STAGES + CASCADE_STAGES)
+    for i in range(8):
+        escalated = i % 4 == 0
+        entry = {
+            "id": f"casc-{i}", "status": 200,
+            "latency_ms": 3.0 + i, "frontend_ms": 1.0,
+            "queue_ms": 0.5, "device_ms": 1.0,
+            "t_unix": round(t_now - i, 3),
+            "stage": 2 if escalated else 1,
+            "stage1_prob": 0.5, "calibrated_prob": 0.5,
+            "cascade_stage1_ms": 2.0,
+        }
+        if escalated:
+            entry["cascade_stage2_ms"] = 6.0
+        rlog.append({"request": entry})
+        casc_engine.observe_request(
+            200, entry["latency_ms"] / 1e3, frontend_s=1e-3,
+            extra={
+                "cascade_stage1": 2e-3,
+                "cascade_stage2": 6e-3 if escalated else None,
+            },
+        )
+    rlog.append({
+        "serve": {"requests": 8.0},
+        "serve_slo": casc_engine.snapshot(),
+        "cascade": {
+            "requests": 8.0, "escalations": 2.0, "sheds": 0.0,
+            "escalation_rate": 0.25,
+            "stage2_steady_state_recompiles": 0,
+        },
+        # the full ledger snapshot a real serve record embeds, with the
+        # quantized entry's param bytes next to its fp32 twin
+        "ledger": {**ledger_snapshot, "params": {
+            "combined:smoke:best": 4.0e6,
+            "combined:smoke:best@int8": 1.1e6,
+        }},
+    })
     rlog.close()
     # a scan_log.jsonl through the REAL writer (scan/scanner.py) so the
     # diag scan section renders from the same record shape a repo scan
@@ -1297,6 +1452,17 @@ def main(argv=None) -> int:
                 # ISSUE 10 sections: the efficiency ledger (per-site
                 # MFU + compile bars + HBM watermark timeline) and the
                 # postmortem view, both from the real emitters
+                # ISSUE 12 section: the cascade view — escalation
+                # accounting, per-stage attribution, quantized-entry
+                # density table next to its fp32 twin
+                and (report.get("cascade") or {}).get("escalated") == 2
+                and report["cascade"].get("cascade_stage2_ms_mean") == 6.0
+                and report["cascade"]["counters"].get(
+                    "escalation_rate"
+                ) == 0.25
+                and report["cascade"]["quant_entries"][
+                    "combined:smoke:best@int8"
+                ].get("fraction") == 0.275
                 and "train_step/G4xN2048xE8192" in eff.get("sites", {})
                 and eff["sites"]["train_step/G4xN2048xE8192"].get(
                     "mfu_vs_measured_ceiling"

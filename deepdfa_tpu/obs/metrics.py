@@ -249,6 +249,31 @@ SCHEMA: tuple[str, ...] = (
     "localize/requests", "localize/batches", "localize/compiles",
     "localize/seconds/count", "localize/seconds/mean",
     "localize/seconds/max",
+    # -- two-stage cascaded inference + quantized serving executables
+    # (serve/cascade.py, serve/quant.py, docs/cascade.md) --
+    # the cascade's registry counters/gauges (escalation accounting,
+    # stage-2 timing histogram)
+    "serve/cascade_requests", "serve/cascade_escalations",
+    "serve/cascade_sheds", "serve/cascade_failures",
+    "serve/cascade_escalation_rate",
+    "serve/cascade_stage2_seconds/count",
+    "serve/cascade_stage2_seconds/mean",
+    "serve/cascade_stage2_seconds/max",
+    # the serve_record "cascade" section (escalation accounting + the
+    # stage-2 recompile census) and the bench_cascade record fields
+    # (scripts/bench_cascade.py via bench.py --child-cascade; gated in
+    # obs/bench_gate.py) — both under reviewed wildcards because the
+    # frontier bench carries per-stage sub-records
+    "cascade/*", "cascade_*",
+    # quantized-entry observables: the per-entry density/drift stamps
+    # (registry info, bench records)
+    "quant/*", "quant_*",
+    # cascade fields on per-request serve_log entries (which stage
+    # decided, the screen's prob, the calibrated prob, shed/degrade
+    # markers, per-stage ms)
+    "request/stage", "request/stage1_prob", "request/calibrated_prob",
+    "request/cascade_shed", "request/cascade_failed",
+    "request/cascade_stage1_ms", "request/cascade_stage2_ms",
     # Pallas-fused GGNN step (nn/ggnn_kernel.py, docs/ggnn_kernel.md):
     # trace-time lowering census per batch signature — both the obs
     # registry mirror and the epoch-record blob train loops embed when

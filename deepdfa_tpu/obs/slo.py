@@ -55,6 +55,10 @@ QUANTILES = (0.50, 0.95, 0.99)
 #: lifecycle: frontend extraction -> bounded queue -> device execution)
 STAGES = ("total", "frontend", "queue", "device")
 
+#: the extra stages a cascade-mode service attributes (docs/cascade.md):
+#: the stage-1 GGNN screen and the (escalations-only) stage-2 pass
+CASCADE_STAGES = ("cascade_stage1", "cascade_stage2")
+
 
 class WindowedSamples:
     """Time-stamped sample ring for one (window, series) pair.
@@ -138,16 +142,21 @@ class SloEngine:
         windows: Sequence[float] = (60, 300),
         max_samples: int = 2048,
         clock: Callable[[], float] = time.monotonic,
+        stages: Sequence[str] = STAGES,
     ):
         if not windows:
             raise ValueError("SloEngine needs at least one window")
         self.clock = clock
         self.windows = tuple(float(w) for w in windows)
         self.max_samples = int(max_samples)
+        #: stage vocabulary this engine attributes across — a cascade
+        #: service extends the default set with CASCADE_STAGES; extras
+        #: arrive via observe_request(extra=...)
+        self.stages = tuple(stages)
         self._lock = threading.Lock()
         # {window -> {stage -> WindowedSamples}} latency seconds
         self._latency = {
-            w: {s: WindowedSamples(w, max_samples) for s in STAGES}
+            w: {s: WindowedSamples(w, max_samples) for s in self.stages}
             for w in self.windows
         }
         # {window -> {status -> WindowedCounts}} exact per-second counts
@@ -178,17 +187,26 @@ class SloEngine:
         queue_s: float | None = None,
         device_s: float | None = None,
         now: float | None = None,
+        extra: dict | None = None,
     ) -> None:
+        """`extra` carries stage seconds beyond the default four (e.g.
+        cascade_stage1/cascade_stage2); only stages this engine declared
+        at construction are ingested — an undeclared stage is a caller
+        bug surfaced by the snapshot's absence, never a KeyError on the
+        request path."""
         now = self.clock() if now is None else now
         status = int(status)
         stages = {
             "total": latency_s, "frontend": frontend_s,
             "queue": queue_s, "device": device_s,
         }
+        if extra:
+            stages.update(extra)
         for w in self.windows:
+            ring_by_stage = self._latency[w]
             for stage, v in stages.items():
-                if v is not None:
-                    self._latency[w][stage].observe(v, now)
+                if v is not None and stage in ring_by_stage:
+                    ring_by_stage[stage].observe(v, now)
             with self._lock:
                 ring = self._status[w].get(status)
                 if ring is None:
@@ -216,7 +234,7 @@ class SloEngine:
 
     def _window_view(self, w: float, now: float) -> dict:
         out: dict = {}
-        for stage in STAGES:
+        for stage in self.stages:
             vals = sorted(self._latency[w][stage].values(now))
             if not vals:
                 continue

@@ -8,6 +8,10 @@
   a pooled Joern session) into the training feature path.
 - `serve.server`    — stdlib HTTP endpoint (/score, /healthz, /stats)
   + the offline batch scorer the `score` CLI drives.
+- `serve.quant`     — post-training int8 serving executables
+  (`tag@int8` registry entries, pinned calibration drift bound).
+- `serve.cascade`   — two-stage cascaded inference (GGNN screen ->
+  combined/t5 escalation) + combined-family serving support.
 
 Everything is reachable only through `cfg.serve` and the `serve`/`score`
 CLI commands — training paths never import this package.
@@ -26,6 +30,16 @@ from deepdfa_tpu.serve.frontend import (
     FrontendError,
     RequestPreprocessor,
     SessionPool,
+)
+from deepdfa_tpu.serve.cascade import (
+    CascadeStage2,
+    CombinedFrontend,
+    validate_cascade_log,
+)
+from deepdfa_tpu.serve.quant import (
+    QuantizationError,
+    dequantize_params,
+    quantize_params,
 )
 from deepdfa_tpu.serve.registry import (
     ModelRegistry,
@@ -53,6 +67,12 @@ __all__ = [
     "FrontendError",
     "RequestPreprocessor",
     "SessionPool",
+    "CascadeStage2",
+    "CombinedFrontend",
+    "validate_cascade_log",
+    "QuantizationError",
+    "dequantize_params",
+    "quantize_params",
     "ModelRegistry",
     "RegistryError",
     "config_digest",

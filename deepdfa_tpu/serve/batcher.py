@@ -141,6 +141,7 @@ class GgnnExecutor:
         max_batch_graphs: int = 16,
         feat_width: int | None = None,
         etypes: bool = False,
+        params_transform: Callable[[Any], Any] | None = None,
     ):
         import jax
 
@@ -157,6 +158,11 @@ class GgnnExecutor:
         self.feat_width = int(feat_width)
 
         def score(params, batch):
+            # quantized entries (serve/quant.py): params arrive as the
+            # int8/bf16 HBM tree and dequantize INSIDE the compiled
+            # program (fused convert+scale, f32 accumulation)
+            if params_transform is not None:
+                params = params_transform(params)
             return jax.nn.sigmoid(model.apply(params, batch))
 
         self._score_jit = jax.jit(score)
@@ -289,6 +295,7 @@ class CombinedExecutor:
         node_budget: int,
         edge_budget: int,
         is_t5: bool = False,
+        params_transform: Callable[[Any], Any] | None = None,
     ):
         import jax
 
@@ -313,6 +320,9 @@ class CombinedExecutor:
         }
 
         def score(params, batch):
+            # quantized entries dequantize in-program (serve/quant.py)
+            if params_transform is not None:
+                params = params_transform(params)
             if self.is_t5:
                 from deepdfa_tpu.models import t5 as t5m
 

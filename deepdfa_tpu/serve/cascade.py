@@ -1,0 +1,732 @@
+"""Two-stage cascaded inference + combined/t5 family serving support
+(docs/cascade.md).
+
+The paper's economics, pushed to the serve path: the ~25k-param GGNN is
+cheap enough to score EVERY request, and the expensive combined/t5
+transformer is only worth running on requests the GGNN is *uncertain*
+about. With `serve.cascade=true`, `/score` becomes:
+
+    stage 1 (always)   GGNN executor -> prob p1
+    calibrate          p_cal = temperature_scale(p1, T)
+    in band?           lo <= p_cal < hi  (eval/calibrate.py fits both)
+    stage 2 (band only) combined/t5 executor -> the served prob
+
+One endpoint, per-stage SLO attribution (`cascade_stage1` /
+`cascade_stage2` in the rolling windows), an escalation-rate gauge, and
+a shed-before-screen degradation mode: when the stage-2 queue backs up
+past `serve.cascade_shed_depth_fraction`, new escalations answer with
+their stage-1 score instead of queueing device time the fleet doesn't
+have — the cascade degrades to the cheap screen first, mirroring the
+fleet admission layer's cascade-aware shed (fleet/admission.py).
+
+This module also owns the pieces that make the combined/t5 families
+first-class served families (they previously restored through the
+registry but had no service):
+
+- `model_cfg.json` (save/load_model_setup): a run-dir manifest holding
+  the tokenizer descriptor + encoder config a combined/t5 checkpoint
+  must be rebuilt with — written by `train-combined`, read by
+  ModelRegistry, so serving and fleet co-serving never need the
+  training CLI's --arch/--encoder/--max-length args re-supplied.
+- `CombinedFrontend`: code -> (token ids, optional GraphSpec), the
+  combined-family analog of RequestPreprocessor.
+- `build_combined_service_parts`: the frontend+executor pair
+  serve/server.py:ScoringService wires for a combined/t5 registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from deepdfa_tpu.core import config as config_mod
+from deepdfa_tpu.eval import calibrate as calibrate_mod
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+
+logger = logging.getLogger(__name__)
+
+#: the run-dir manifest that makes a combined/t5 run self-describing
+MODEL_CFG_MANIFEST = "model_cfg.json"
+
+
+# ---------------------------------------------------------------------------
+# model_cfg.json: save/load the tokenizer + encoder setup
+
+
+def save_model_setup(
+    run_dir: str | Path,
+    family: str,
+    model_cfg: Any,
+    tokenizer_desc: dict,
+    max_length: int,
+) -> Path:
+    """Write the manifest a combined/t5 run needs to be restorable
+    without CLI args. `tokenizer_desc` is {"kind": "hash", "vocab_size",
+    "t5_frame"} or {"kind": "bpe", "vocab": path, "merges": path}."""
+    d = dataclasses.asdict(model_cfg)
+    encoder = d.pop("encoder")
+    doc = {
+        "family": family,
+        "max_length": int(max_length),
+        "tokenizer": dict(tokenizer_desc),
+        "encoder": encoder,
+        "model": d,
+    }
+    path = Path(run_dir) / MODEL_CFG_MANIFEST
+    path.write_text(json.dumps(doc, indent=2))
+    return path
+
+
+def _build_tokenizer(desc: dict):
+    from deepdfa_tpu.data.tokenizer import BpeTokenizer, HashTokenizer
+
+    kind = desc.get("kind", "hash")
+    if kind == "hash":
+        return HashTokenizer(
+            vocab_size=int(desc.get("vocab_size", 4096)),
+            t5_frame=bool(desc.get("t5_frame", False)),
+        )
+    if kind == "bpe":
+        return BpeTokenizer(Path(desc["vocab"]), Path(desc["merges"]))
+    raise ValueError(f"unknown tokenizer kind {kind!r} in manifest")
+
+
+def load_model_setup(run_dir: str | Path, family: str):
+    """(tokenizer, model_cfg, max_length) from the run's manifest;
+    raises FileNotFoundError/ValueError with operator-grade messages."""
+    path = Path(run_dir) / MODEL_CFG_MANIFEST
+    doc = json.loads(path.read_text())
+    saved_family = doc.get("family")
+    if saved_family != family:
+        raise ValueError(
+            f"{path} describes family {saved_family!r}, not {family!r} "
+            f"— the run was trained with a different arch"
+        )
+    tok = _build_tokenizer(doc["tokenizer"])
+    if family == "t5":
+        from deepdfa_tpu.models import t5 as t5m
+
+        enc = t5m.T5Config(**doc["encoder"])
+        mcfg = t5m.DefectConfig(encoder=enc, **doc["model"])
+    else:
+        from deepdfa_tpu.models import combined as cmb
+        from deepdfa_tpu.models.transformer import TransformerConfig
+
+        enc = TransformerConfig(**doc["encoder"])
+        mcfg = cmb.CombinedConfig(encoder=enc, **doc["model"])
+    return tok, mcfg, int(doc["max_length"])
+
+
+def try_load_model_setup(run_dir: str | Path, family: str):
+    """load_model_setup, or None when no manifest exists (the caller
+    decides whether that is an error)."""
+    if not (Path(run_dir) / MODEL_CFG_MANIFEST).exists():
+        return None
+    return load_model_setup(run_dir, family)
+
+
+# ---------------------------------------------------------------------------
+# combined-family request frontend
+
+
+@dataclasses.dataclass(frozen=True)
+class TextFeatures:
+    """The combined-family analog of serve/frontend.py:Features: `spec`
+    is the CombinedExecutor payload (token ids, optional GraphSpec)."""
+
+    spec: tuple
+    node_lines: None = None
+
+
+class CombinedFrontend:
+    """code -> (token ids, GraphSpec | None), quacking like
+    RequestPreprocessor for ScoringService (`features_full` /
+    `features` / `cache` / `close`).
+
+    When the model was trained use_graph=True the graph half routes
+    through a real RequestPreprocessor (shared content-keyed cache); a
+    function the graph frontend cannot parse degrades to a text-only
+    row (has_graph=False) — deterministically, so batched and singleton
+    scores still agree."""
+
+    def __init__(self, tokenizer, max_length: int, graph_frontend=None):
+        self.tok = tokenizer
+        self.max_length = int(max_length)
+        self.graph_frontend = graph_frontend
+        self.cache = (
+            graph_frontend.cache if graph_frontend is not None else {}
+        )
+
+    def features_full(self, code: str, request_id: int = -1) -> TextFeatures:
+        ids = self.tok.encode(code, max_length=self.max_length)
+        spec = None
+        if self.graph_frontend is not None:
+            from deepdfa_tpu.serve.frontend import FrontendError
+
+            try:
+                spec = self.graph_frontend.features(code, request_id)
+            except FrontendError:
+                spec = None  # text-only row, consistently
+        return TextFeatures(spec=(np.asarray(ids, np.int32), spec))
+
+    def features(self, code: str, request_id: int = -1):
+        return self.features_full(code, request_id).spec
+
+    def close(self) -> None:
+        if self.graph_frontend is not None:
+            self.graph_frontend.close()
+
+
+def build_combined_service_parts(
+    registry, cfg, node_budget: int, edge_budget: int
+):
+    """(frontend, executor) for a combined/t5 registry — the
+    family-dispatch half of ScoringService.__init__."""
+    from deepdfa_tpu.serve import frontend as serve_frontend
+    from deepdfa_tpu.serve.batcher import CombinedExecutor
+    from deepdfa_tpu.serve.frontend import RequestPreprocessor
+
+    tok = registry.tokenizer
+    mcfg = registry.model_cfg
+    if tok is None:
+        from deepdfa_tpu.serve.registry import RegistryError
+
+        raise RegistryError(
+            f"serving family {registry.family!r} needs the run's "
+            f"tokenizer: save a {MODEL_CFG_MANIFEST} manifest "
+            f"(train-combined writes one) in {registry.run_dir}"
+        )
+    max_length = int(registry.serve_max_length or 0)
+    buckets = tuple(int(b) for b in cfg.data.seq_buckets) or (
+        (max_length,) if max_length else ()
+    )
+    graph_fe = None
+    if getattr(mcfg, "use_graph", False):
+        graph_fe = RequestPreprocessor(
+            cfg, registry.vocabs,
+            use_joern=cfg.serve.use_joern,
+            cache=serve_frontend.shared_cache(
+                cfg.serve.feature_cache_entries
+            ),
+        )
+    frontend = CombinedFrontend(
+        tok, max_length or buckets[-1], graph_frontend=graph_fe
+    )
+    executor = CombinedExecutor(
+        mcfg, registry.params, tok,
+        seq_buckets=buckets,
+        token_budget=cfg.data.token_budget,
+        node_budget=node_budget, edge_budget=edge_budget,
+        is_t5=(registry.family == "t5"),
+        params_transform=registry.params_transform,
+    )
+    return frontend, executor
+
+
+# ---------------------------------------------------------------------------
+# the cascade itself
+
+
+class CascadeStage2:
+    """The escalation half of a cascade-mode ScoringService: a full
+    stage-2 serving stack (registry + frontend + batcher, its own AOT
+    warmup ladder) plus the band/temperature/shed policy."""
+
+    def __init__(
+        self,
+        service,
+        band: tuple[float, float],
+        temperature: float = 1.0,
+        shed_depth_fraction: float = 0.75,
+        timeout_s: float = 60.0,
+    ):
+        self.service = service
+        self.band = (float(band[0]), float(band[1]))
+        self.temperature = float(temperature)
+        self.shed_depth_fraction = float(shed_depth_fraction)
+        self.timeout_s = float(timeout_s)
+        r = obs_metrics.REGISTRY
+        self._m_requests = r.counter("serve/cascade_requests")
+        self._m_escalations = r.counter("serve/cascade_escalations")
+        self._m_sheds = r.counter("serve/cascade_sheds")
+        self._m_failures = r.counter("serve/cascade_failures")
+        self._m_rate = r.gauge("serve/cascade_escalation_rate")
+        self._m_stage2_s = r.histogram("serve/cascade_stage2_seconds")
+
+    @classmethod
+    def from_config(cls, cfg, run_dir):
+        """Build the stage-2 stack per the primary serve config.
+        serve.cascade is forced OFF on the stage-2 config — the stage-2
+        service must never build a stage 3."""
+        from deepdfa_tpu.serve.registry import (
+            ModelRegistry,
+            load_run_config,
+        )
+        from deepdfa_tpu.serve.server import ScoringService
+
+        scfg = cfg.serve
+        stage2_dir = Path(scfg.cascade_run_dir or run_dir)
+        s2cfg = (
+            cfg if stage2_dir == Path(run_dir)
+            else load_run_config(stage2_dir)
+        )
+        s2cfg = config_mod.apply_overrides(s2cfg, [
+            "serve.cascade=false",
+            "serve.lines=false",
+            "serve.request_log=false",
+            "serve.hot_swap=false",
+        ])
+        registry = ModelRegistry(
+            stage2_dir,
+            family=scfg.cascade_family,
+            checkpoint=scfg.cascade_checkpoint,
+            cfg=s2cfg,
+        )
+        return cls(
+            ScoringService(registry, s2cfg),
+            band=tuple(scfg.cascade_band),
+            temperature=scfg.cascade_temperature,
+            shed_depth_fraction=scfg.cascade_shed_depth_fraction,
+            timeout_s=scfg.cascade_timeout_s,
+        )
+
+    # -- policy ---------------------------------------------------------------
+
+    def calibrated(self, prob: float) -> float:
+        return float(
+            calibrate_mod.temperature_scale([prob], self.temperature)[0]
+        )
+
+    def should_escalate(self, calibrated_prob: float) -> bool:
+        return calibrate_mod.in_band(calibrated_prob, self.band)
+
+    def overloaded(self) -> bool:
+        """The service-level cascade shed (docs/cascade.md shed order):
+        stage-2 queue past the depth fraction => new escalations answer
+        with their stage-1 score instead of queueing."""
+        depth = self.service.batcher.stats()["queue_depth"]
+        limit = self.service.cfg.serve.queue_limit
+        return depth >= self.shed_depth_fraction * limit
+
+    def _publish_rate(self) -> None:
+        n = self._m_requests.value
+        if n:
+            self._m_rate.set(self._m_escalations.value / n)
+
+    # -- the shared verdict + accounting (online AND offline drives) ----------
+
+    def screen(self, prob1: float) -> tuple[bool, dict]:
+        """The stage-1 verdict BOTH drive paths share: count the
+        request, calibrate, apply the band + the shed check. Returns
+        (escalate?, response/log fields) — the caller performs the
+        escalation and reports its outcome via note_escalated /
+        note_escalation_failed, so counter semantics cannot drift
+        between the HTTP handler and score_texts."""
+        self._m_requests.inc()
+        cal = self.calibrated(prob1)
+        fields: dict = {
+            "stage": 1,
+            "stage1_prob": float(prob1),
+            "calibrated_prob": round(cal, 6),
+        }
+        if self.should_escalate(cal):
+            if self.overloaded():
+                self._m_sheds.inc()
+                fields["cascade_shed"] = 1
+            else:
+                return True, fields
+        self._publish_rate()
+        return False, fields
+
+    def note_escalated(self, seconds: float) -> None:
+        """One SUCCESSFUL stage-2 pass (escalations count successes
+        only — a failed pass degrades to stage 1 and must not move the
+        escalation rate the serve smoke pins against stage verdicts)."""
+        self._m_escalations.inc()
+        self._m_stage2_s.observe(seconds)
+        self._publish_rate()
+
+    def note_escalation_failed(self) -> None:
+        self._m_failures.inc()
+        self._publish_rate()
+
+    # -- escalation -----------------------------------------------------------
+
+    def escalate(self, code: str, request_id: str | None = None):
+        """(stage-2 prob, seconds) for ONE request — the online path
+        (HTTP handler threads co-batch through the stage-2 batcher)."""
+        t0 = time.perf_counter()
+        req = self.service.submit_code(code, request_id=request_id)
+        prob = req.wait(self.timeout_s)
+        return float(prob), time.perf_counter() - t0
+
+    def decide(self, code: str, prob1: float, request_id: str | None = None):
+        """The per-request cascade verdict: (final prob, response
+        fields, extra SLO stage seconds). A stage-2 failure (timeout,
+        queue full, executor error) DEGRADES to the stage-1 score —
+        the screen already answered; losing the request to a stage-2
+        hiccup would invert the cascade's whole degradation story
+        (docs/cascade.md shed order; the offline drive does the same)."""
+        escalate, info = self.screen(prob1)
+        extra: dict = {}
+        if escalate:
+            try:
+                with obs_trace.span(
+                    "cascade_stage2", cat="serve", request_id=request_id
+                ):
+                    prob2, dt = self.escalate(code, request_id)
+            except Exception:  # noqa: BLE001 - degrade, never fail
+                logger.warning(
+                    "stage-2 escalation failed for %s; serving the "
+                    "stage-1 score", request_id, exc_info=True,
+                )
+                self.note_escalation_failed()
+                info["cascade_failed"] = 1
+            else:
+                self.note_escalated(dt)
+                info["stage"] = 2
+                extra["cascade_stage2"] = dt
+                return prob2, info, extra
+        return float(prob1), info, extra
+
+    def escalate_many(self, codes: list[str], request_ids=None):
+        """Offline escalation drive (score_texts): every escalated
+        request groups through the stage-2 batcher's deterministic
+        score_all path. [(prob | None, seconds)] aligned with codes;
+        None = a failed pass (counted via note_escalation_failed, the
+        caller degrades that row to its stage-1 score)."""
+        svc = self.service
+        payloads = [svc.frontend.features_full(c).spec for c in codes]
+        t0 = time.perf_counter()
+        reqs = svc.batcher.score_all(payloads, request_ids=request_ids)
+        out = []
+        for req in reqs:
+            try:
+                prob = req.wait(self.timeout_s)
+            except Exception:  # noqa: BLE001 - per-row fault isolation
+                self.note_escalation_failed()
+                out.append((None, req.latency_s or 0.0))
+                continue
+            dt = req.latency_s if req.latency_s is not None else (
+                time.perf_counter() - t0
+            )
+            self.note_escalated(dt)
+            out.append((float(prob), dt))
+        return out
+
+    # -- service plumbing -----------------------------------------------------
+
+    def counters(self) -> dict:
+        n = self._m_requests.value
+        return {
+            "requests": n,
+            "escalations": self._m_escalations.value,
+            "sheds": self._m_sheds.value,
+            "failures": self._m_failures.value,
+            "escalation_rate": (
+                round(self._m_escalations.value / n, 4) if n else 0.0
+            ),
+        }
+
+    def info(self) -> dict:
+        """The /healthz cascade section."""
+        reg = self.service.registry
+        return {
+            "band": list(self.band),
+            "temperature": self.temperature,
+            "shed_depth_fraction": self.shed_depth_fraction,
+            "stage2_family": reg.family,
+            "stage2_checkpoint": reg.checkpoint,
+            "stage2_quantized": reg.quant_mode,
+            "stage2_warmed_signatures": [
+                list(s) for s in self.service.executor.signatures()
+            ],
+            "stage2_steady_state_recompiles": (
+                self.service.steady_state_recompiles()
+            ),
+            **self.counters(),
+        }
+
+    def jit_lowerings(self) -> int:
+        return self.service._jit_lowerings()
+
+    def start(self) -> None:
+        self.service.start()
+
+    def close(self) -> None:
+        self.service.close()
+
+
+# ---------------------------------------------------------------------------
+# smoke/test fixtures: a real stage-2 checkpoint without a training loop
+
+
+def build_stage2_smoke(
+    run_dir: str | Path,
+    cfg,
+    family: str = "combined",
+    hidden: int = 8,
+    layers: int = 1,
+    heads: int = 2,
+    max_length: int = 32,
+    vocab_size: int = 256,
+    use_graph: bool = False,
+    seed: int = 0,
+):
+    """Lay down REAL stage-2 artifacts next to a (smoke) run's GGNN
+    checkpoint: checkpoints-combined/ with a `best` tag and the
+    model_cfg.json manifest — so cascade smokes and tests exercise the
+    real registry restore path, not a mock. Returns (tokenizer,
+    model_cfg)."""
+    import jax
+
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    run_dir = Path(run_dir)
+    tok = HashTokenizer(
+        vocab_size=vocab_size, t5_frame=(family == "t5")
+    )
+    if family == "t5":
+        from deepdfa_tpu.models import t5 as t5m
+
+        enc = t5m.T5Config.tiny(
+            vocab_size=tok.vocab_size, hidden_size=2 * hidden,
+            num_layers=layers, num_heads=heads, head_dim=hidden,
+            ffn_size=4 * hidden,
+        )
+        enc = dataclasses.replace(enc, max_sequence_length=max_length)
+        mcfg = t5m.DefectConfig(
+            encoder=enc,
+            graph_hidden_dim=cfg.model.hidden_dim,
+            graph_input_dim=cfg.data.feat.input_dim,
+            use_graph=use_graph,
+        )
+        params = t5m.init_defect_params(mcfg, jax.random.key(seed))
+    else:
+        from deepdfa_tpu.models import combined as cmb
+        from deepdfa_tpu.models.transformer import TransformerConfig
+
+        enc = TransformerConfig.tiny(
+            vocab_size=tok.vocab_size,
+            max_position_embeddings=max_length + 4,
+            num_layers=layers, num_heads=heads,
+            hidden_size=2 * hidden, intermediate_size=4 * hidden,
+        )
+        mcfg = cmb.CombinedConfig(
+            encoder=enc,
+            graph_hidden_dim=cfg.model.hidden_dim,
+            graph_input_dim=cfg.data.feat.input_dim,
+            use_graph=use_graph,
+        )
+        params = cmb.init_params(mcfg, jax.random.key(seed))
+    mgr = CheckpointManager(
+        run_dir / "checkpoints-combined", monitor="val_loss"
+    )
+    mgr.save(
+        "epoch-0001", jax.device_get(params), {"val_loss": 1.0}, step=1
+    )
+    save_model_setup(
+        run_dir, family, mcfg,
+        {"kind": "hash", "vocab_size": tok.vocab_size,
+         "t5_frame": family == "t5"},
+        max_length,
+    )
+    return tok, mcfg
+
+
+def train_stage2_smoke(
+    run_dir: str | Path,
+    cfg,
+    n_examples: int,
+    vuln_rate: float = 0.5,
+    seed: int = 0,
+    hidden: int = 48,
+    layers: int = 2,
+    heads: int = 4,
+    max_length: int = 128,
+    vocab_size: int = 512,
+    max_epochs: int = 8,
+    rows: int = 16,
+):
+    """A TRAINED tiny stage-2 combined checkpoint over the same
+    synthetic corpus a smoke run's GGNN trained on — what the cascade
+    bench needs (an untrained stage 2 makes the accuracy half of the
+    frontier meaningless). Text-only (use_graph=False), single-shard;
+    writes checkpoints-combined/best + model_cfg.json. Returns
+    (tokenizer, model_cfg)."""
+    import jax
+    import numpy as np_mod
+
+    from deepdfa_tpu.core import MeshConfig, config as core_config
+    from deepdfa_tpu.data import generate, to_examples
+    from deepdfa_tpu.data.text import collate_shards
+    from deepdfa_tpu.data.tokenizer import HashTokenizer
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models.transformer import TransformerConfig
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.train.combined_loop import CombinedTrainer
+
+    run_dir = Path(run_dir)
+    examples = to_examples(
+        generate(n_examples, vuln_rate=vuln_rate, seed=seed)
+    )
+    tok = HashTokenizer(vocab_size=vocab_size)
+    token_ids = np_mod.stack([
+        tok.encode(e.code, max_length=max_length) for e in examples
+    ])
+    labels = [int(e.label or 0) for e in examples]
+    enc = TransformerConfig.tiny(
+        vocab_size=tok.vocab_size,
+        max_position_embeddings=max_length + 4,
+        num_layers=layers, num_heads=heads,
+        hidden_size=2 * hidden, intermediate_size=4 * hidden,
+        dropout_rate=0.0,
+    )
+    mcfg = cmb.CombinedConfig(
+        encoder=enc,
+        graph_hidden_dim=cfg.model.hidden_dim,
+        graph_input_dim=cfg.data.feat.input_dim,
+        use_graph=False,
+    )
+    tcfg = core_config.apply_overrides(cfg, [
+        f"train.max_epochs={int(max_epochs)}",
+        "train.optim.learning_rate=0.001",
+        "train.optim.warmup_frac=0.1",
+        "train.optim.grad_clip_norm=1.0",
+    ])
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    steps_per_epoch = max(1, n_examples // rows)
+    trainer = CombinedTrainer(
+        tcfg, mcfg, mesh=mesh,
+        total_steps=steps_per_epoch * int(max_epochs),
+    )
+
+    def batches(_epoch=0):
+        out = []
+        for k in range(0, n_examples - n_examples % rows, rows):
+            sel = list(range(k, k + rows))
+            out.append(collate_shards(
+                token_ids[sel], [labels[i] for i in sel], sel, {},
+                num_shards=1, rows_per_shard=rows,
+                node_budget=512, edge_budget=2048, pad_id=tok.pad_id,
+            ))
+        return out
+
+    ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
+    state = trainer.init_state()
+    trainer.fit(state, batches, val_batches=batches, checkpoints=ckpts)
+    save_model_setup(
+        run_dir, "combined", mcfg,
+        {"kind": "hash", "vocab_size": tok.vocab_size,
+         "t5_frame": False},
+        max_length,
+    )
+    return tok, mcfg
+
+
+# ---------------------------------------------------------------------------
+# cascade-mode serve_log validation (scripts/check_obs_schema.py
+# --cascade-log)
+
+
+def validate_cascade_log(path: str | Path) -> dict:
+    """Structural + schema validation of a cascade-mode serve_log.jsonl:
+    the summary record carries the cascade section (escalation fields
+    present), per-request entries declare their deciding `stage` (and
+    escalated ones their cascade_stage2_ms), the SLO snapshot declares
+    the cascade stages, and every flattened scalar tag is declared in
+    obs/metrics.py:SCHEMA."""
+    path = Path(path)
+    problems: list[str] = []
+    try:
+        lines = path.read_text().splitlines()
+    except OSError as e:
+        return {"ok": False, "problems": [f"unreadable: {e}"]}
+    records: list[dict] = []
+    n_requests = n_escalated = n_summaries = 0
+    saw_cascade_section = saw_stage_windows = False
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            problems.append(f"line {lineno}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"line {lineno}: not an object")
+            continue
+        records.append(rec)
+        if "request" in rec:
+            req = rec["request"]
+            if not isinstance(req, dict):
+                problems.append(f"line {lineno}: request not an object")
+                continue
+            if int(req.get("status", 0)) != 200:
+                continue  # sheds/rejects carry no stage verdict
+            n_requests += 1
+            if "stage" not in req:
+                problems.append(
+                    f"line {lineno}: 200 request entry missing the "
+                    f"cascade `stage` field"
+                )
+            elif int(req["stage"]) == 2:
+                n_escalated += 1
+                if "cascade_stage2_ms" not in req:
+                    problems.append(
+                        f"line {lineno}: escalated request missing "
+                        f"cascade_stage2_ms"
+                    )
+        elif "serve" in rec or "serve_slo" in rec:
+            n_summaries += 1
+            casc = rec.get("cascade")
+            if isinstance(casc, dict):
+                missing = [
+                    k for k in ("requests", "escalations",
+                                "escalation_rate")
+                    if k not in casc
+                ]
+                if missing:
+                    problems.append(
+                        f"line {lineno}: cascade section missing "
+                        f"{missing}"
+                    )
+                else:
+                    saw_cascade_section = True
+            slo = rec.get("serve_slo")
+            if isinstance(slo, dict):
+                for view in slo.values():
+                    if isinstance(view, dict) and "cascade_stage1" in (
+                        view.get("latency_ms") or {}
+                    ):
+                        saw_stage_windows = True
+    if not saw_cascade_section:
+        problems.append(
+            "no summary record carries a complete cascade section "
+            "(was the log produced with serve.cascade=true?)"
+        )
+    if n_requests and not saw_stage_windows:
+        problems.append(
+            "no SLO window carries cascade_stage1 latency — the engine "
+            "was not built with the cascade stages"
+        )
+    undeclared = obs_metrics.undeclared_tags(records)
+    for tag in undeclared:
+        problems.append(f"undeclared metrics tag: {tag}")
+    return {
+        "ok": not problems,
+        "records": len(records),
+        "requests": n_requests,
+        "escalated": n_escalated,
+        "summaries": n_summaries,
+        "undeclared": undeclared,
+        "problems": problems,
+    }

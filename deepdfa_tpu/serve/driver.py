@@ -52,6 +52,7 @@ def build_smoke_run(
     max_epochs: int = 2,
     seed: int = 0,
     extra_overrides: list[str] | None = None,
+    vuln_rate: float = 0.06,
 ):
     """Train a tiny GGNN and leave real run artifacts behind.
 
@@ -76,7 +77,9 @@ def build_smoke_run(
         "serve.node_budget=2048", "serve.edge_budget=8192",
         *(extra_overrides or []),
     ])
-    synth = generate(n_examples, seed=seed)
+    # vuln_rate: the dataset's ~6% positive rate by default; the cascade
+    # bench asks for a balanced dev set (AUC over 3 positives is noise)
+    synth = generate(n_examples, vuln_rate=vuln_rate, seed=seed)
     examples = to_examples(synth)
     specs, vocabs = build_dataset(
         examples, train_ids=range(n_examples),
@@ -195,6 +198,7 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
         ledger as obs_ledger,
         trace as obs_trace,
     )
+    from deepdfa_tpu.serve import cascade as cascade_mod
     from deepdfa_tpu.serve.registry import ModelRegistry
     from deepdfa_tpu.serve.server import (
         BackgroundServer,
@@ -206,6 +210,13 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
         extra_overrides=[
             "serve.request_log=true",
             "obs.trace=true",
+            # the cascade rides the smoke (docs/cascade.md): stage-2
+            # combined artifacts are laid down below, /score escalates
+            # the uncertain band, and the smoke asserts per-stage SLO
+            # fields + zero recompiles across BOTH family ladders
+            "serve.cascade=true",
+            # tiny stage-2 serve batches (rows_for_bucket(32, 128) = 4)
+            "data.token_budget=128",
             # the efficiency ledger + flight recorder ride the smoke
             # (docs/efficiency.md): every warmup compile is cost-
             # accounted, /metrics carries ledger/* families, and a
@@ -223,6 +234,9 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
         ],
         **smoke_kw,
     )
+    # stage-2 artifacts (checkpoints-combined/ + model_cfg.json) before
+    # the cascade service restores them
+    cascade_mod.build_stage2_smoke(run_dir, cfg, family="combined")
     with obs.session(cfg, run_dir):
         registry = ModelRegistry(
             run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
@@ -249,7 +263,8 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
                     line_attrs = resp.get("lines")
                 scored.append(
                     (status, resp.get("prob"), resp.get("request_id"),
-                     resp.get("stages"))
+                     resp.get("stages"), resp.get("stage"),
+                     resp.get("stage1_prob"))
                 )
             bad_status, _ = server.request(
                 "POST", "/score", {"code": "not a function @@@"}
@@ -268,6 +283,36 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
                 service.steady_state_recompiles()
             )
             write_serve_log(run_dir, [record])
+            # cascade evidence (ISSUE 12): which stage decided each
+            # request, escalation accounting consistent with the
+            # responses, per-stage SLO windows populated, and the
+            # cascade-mode serve_log schema-valid
+            cascade_report = None
+            if service.cascade is not None:
+                counters = service.cascade.counters()
+                stages_seen = [s for _, _, _, _, s, _ in scored]
+                slo_snap = service.slo.snapshot()
+                stage1_windowed = any(
+                    "cascade_stage1" in (v.get("latency_ms") or {})
+                    for v in slo_snap.values() if isinstance(v, dict)
+                )
+                cascade_report = {
+                    "stages": stages_seen,
+                    "stage_fields_present": all(
+                        s in (1, 2) and p1 is not None
+                        for st, _, _, _, s, p1 in scored if st == 200
+                    ),
+                    "escalations_consistent": (
+                        counters["escalations"]
+                        == sum(1 for s in stages_seen if s == 2)
+                    ),
+                    "counters": counters,
+                    "band": list(service.cascade.band),
+                    "stage1_windowed": stage1_windowed,
+                    "stage2_steady_state_recompiles": (
+                        service.cascade.service.steady_state_recompiles()
+                    ),
+                }
             ledger_snap = obs_ledger.snapshot_or_none() or {}
             # the flight-recorder validation dump: a real postmortem
             # written by the serving process (with its warmup ledger
@@ -286,7 +331,7 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
     # the session is closed: per-process trace files are flushed and the
     # merged trace.json is written — verify one scored request's spans
     # are flow-linked under its request_id (the acceptance criterion)
-    rid = next((r for _, _, r, _ in scored if r), None)
+    rid = next((r for _, _, r, _, _, _ in scored if r), None)
     events = obs_trace.merge(run_dir / "trace")
     flow_phases = sorted({
         e["ph"] for e in events
@@ -303,12 +348,26 @@ def run_serve_smoke(extra_overrides=None, **smoke_kw) -> dict:
         ):
             linked_spans.add(e["name"])
     linked_spans = sorted(linked_spans)
+    if cascade_report is not None:
+        cascade_report["log"] = cascade_mod.validate_cascade_log(
+            run_dir / "serve_log.jsonl"
+        )
+        cascade_report["ok"] = bool(
+            cascade_report["stage_fields_present"]
+            and cascade_report["escalations_consistent"]
+            and cascade_report["stage1_windowed"]
+            and cascade_report["stage2_steady_state_recompiles"] == 0
+            and cascade_report["log"]["ok"]
+        )
     return {
         "scored": [
             {"status": st, "prob": p, "request_id": r,
-             **({"stages": stg} if stg else {})}
-            for st, p, r, stg in scored
+             **({"stages": stg} if stg else {}),
+             **({"stage": s} if s is not None else {}),
+             **({"stage1_prob": p1} if p1 is not None else {})}
+            for st, p, r, stg, s, p1 in scored
         ],
+        "cascade": cascade_report,
         "line_attributions": line_attrs,
         "reject_status": bad_status,
         "healthz_status": h_status,

@@ -50,6 +50,7 @@ class GgnnLocalizer:
         top_k: int = 10,
         feat_width: int | None = None,
         etypes: bool = False,
+        params_transform: Callable[[Any], Any] | None = None,
     ):
         import jax
 
@@ -71,7 +72,16 @@ class GgnnLocalizer:
 
             feat_width = NUM_SUBKEY_FEATS
         self.feat_width = int(feat_width)
-        self._fn_jit = jax.jit(ggnn_score_fn(method, model, n_steps))
+        score_fn = ggnn_score_fn(method, model, n_steps)
+        if params_transform is not None:
+            # quantized entries (serve/quant.py): dequantize in-program,
+            # same contract as the scoring executables
+            base_fn = score_fn
+
+            def score_fn(params, batch):  # noqa: F811 - deliberate wrap
+                return base_fn(params_transform(params), batch)
+
+        self._fn_jit = jax.jit(score_fn)
         self._compiled: dict[int, Any] = {}
         self._lowerings = 0
         r = obs_metrics.REGISTRY

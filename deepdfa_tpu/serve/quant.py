@@ -1,0 +1,343 @@
+"""Post-training int8 quantization for serving executables
+(docs/cascade.md).
+
+The paper's pitch is cheap inference; this module makes the *weights*
+cheap too. A registry checkpoint tag with the `@int8` suffix
+(`serve.checkpoint=best@int8`, or a fleet co-serving entry's checkpoint
+field) restores the fp32 params and rewrites the pytree:
+
+- **matmul/einsum weights** (float leaves with ndim >= 2 — kernels,
+  embeddings, attention projections) become per-channel SYMMETRIC int8:
+  one fp32 scale per output channel (last axis), values rounded into
+  [-127, 127]. Symmetric means dequant is a single multiply — no zero
+  point — which XLA fuses straight into the consumer matmul.
+- **everything else float** (biases, norms, GRU gate vectors) becomes
+  bfloat16 — the PR-8 message-policy precedent: cheap to store, f32 on
+  use.
+- non-float leaves (none today) pass through untouched.
+
+Execution stays f32-accumulated: the quantized tree is what lives in
+HBM and what the AOT executables take as their params argument (the
+HBM-density win the per-entry param-bytes ledger measures); the
+executors run `dequantize_params` INSIDE the jitted program, so the
+convert+scale is compile-time-fused and the math after it is the same
+fp32 graph the plain entry runs.
+
+The drift contract: quantization is admitted at registry load only if
+the max probability drift vs the fp32 params over a deterministic
+calibration batch set stays within `serve.quant_drift_bound` (default
+5e-2). An over-bound quantization is refused LOUDLY — the error names
+the param paths with the worst quantization error, CheckpointMismatch
+style — because silently serving a degraded model is the one failure
+mode a density optimization must never have.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+#: the registry tag suffix that requests quantized restore
+QUANT_SUFFIX = "@int8"
+
+#: quantized-leaf marker keys (a dict with exactly these keys is one
+#: quantized weight; anything else is an ordinary pytree node)
+_QKEYS = frozenset({"int8", "scale"})
+
+
+class QuantizationError(RuntimeError):
+    """Quantization refused: drift past the configured bound.
+
+    Carries the measured drift, the bound, and the offending param paths
+    (worst quantization error first) — the CheckpointMismatch-style loud
+    refusal serve/registry.py re-raises as a RegistryError."""
+
+    def __init__(self, drift: float, bound: float, worst_paths: list[str]):
+        self.drift = float(drift)
+        self.bound = float(bound)
+        self.worst_paths = list(worst_paths)
+        super().__init__(
+            f"int8 quantization refused: calibration prob drift "
+            f"{drift:.3e} exceeds serve.quant_drift_bound={bound:g}; "
+            f"worst-quantized params: {', '.join(worst_paths[:8])}"
+            + ("..." if len(worst_paths) > 8 else "")
+            + " (raise the bound, or serve the fp32 entry)"
+        )
+
+
+def split_checkpoint_tag(tag: str) -> tuple[str, str | None]:
+    """`"best@int8"` -> ("best", "int8"); plain tags -> (tag, None)."""
+    if tag.endswith(QUANT_SUFFIX):
+        return tag[: -len(QUANT_SUFFIX)], "int8"
+    return tag, None
+
+
+def _is_float(leaf) -> bool:
+    try:
+        return np.issubdtype(np.asarray(leaf).dtype, np.floating)
+    except Exception:
+        return False
+
+
+def is_quantized_leaf(node: Any) -> bool:
+    return isinstance(node, Mapping) and set(node.keys()) == set(_QKEYS)
+
+
+def quantize_leaf(w: np.ndarray) -> dict:
+    """One weight -> per-channel symmetric int8 over the LAST axis."""
+    w = np.asarray(w, dtype=np.float32)
+    absmax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    scale = (absmax / 127.0).astype(np.float32)
+    scale = np.where(scale > 0, scale, np.float32(1.0))
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return {"int8": q, "scale": scale}
+
+
+def quantize_params(params: Any) -> Any:
+    """fp32 params pytree -> the int8/bf16 serving tree.
+
+    Mappings are rebuilt as plain dicts (orbax restores produce them
+    anyway, and flax `apply` accepts them), so the quantized tree is a
+    uniform host-side structure `jax.device_put` ships as-is."""
+    import jax.numpy as jnp
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        if _is_float(node):
+            arr = np.asarray(node)
+            if arr.ndim >= 2:
+                return quantize_leaf(arr)
+            return jnp.asarray(arr, dtype=jnp.bfloat16)
+        return node
+
+    import jax
+
+    return walk(jax.device_get(params))
+
+
+def dequantize_params(qtree: Any) -> Any:
+    """The serving tree -> f32 params, jit-traceable.
+
+    Runs INSIDE the compiled program (the executors' `params_transform`
+    hook): int8 weights dequantize with one fused multiply, bf16 leaves
+    upcast, so accumulation stays f32 while HBM holds the small tree.
+    Leaves may be tracers, so dtypes are read off the leaf attribute,
+    never through numpy."""
+    import jax.numpy as jnp
+
+    def walk(node):
+        if is_quantized_leaf(node):
+            return node["int8"].astype(jnp.float32) * node["scale"]
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        dt = getattr(node, "dtype", None)
+        if (
+            dt is not None
+            and jnp.issubdtype(dt, jnp.floating)
+            and dt != jnp.float32
+        ):
+            return node.astype(jnp.float32)
+        return node
+
+    return walk(qtree)
+
+
+def tree_bytes(tree: Any) -> float:
+    """Total leaf bytes of a (possibly quantized) pytree — the same
+    accounting fleet/replica.py:param_bytes and the efficiency ledger
+    use, so the density win reads identically everywhere."""
+    import jax
+
+    total = 0.0
+    for leaf in jax.tree.leaves(tree):
+        try:
+            total += float(
+                np.prod(np.asarray(leaf).shape)
+                * np.asarray(leaf).dtype.itemsize
+            )
+        except Exception:
+            continue
+    return total
+
+
+def _flat_paths(tree: Any) -> dict[str, Any]:
+    """{'a/b/c': leaf} over an arbitrary nested structure (quantized
+    marker dicts count as ONE leaf at their path)."""
+    out: dict[str, Any] = {}
+
+    def walk(node, prefix):
+        if is_quantized_leaf(node):
+            out[prefix.rstrip("/")] = node
+        elif isinstance(node, Mapping):
+            for k, v in node.items():
+                walk(v, f"{prefix}{k}/")
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{prefix}{i}/")
+        else:
+            out[prefix.rstrip("/")] = node
+
+    walk(tree, "")
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantReport:
+    """What quantization did to one params tree (the /healthz + refusal
+    payload): byte totals and the per-path reconstruction error."""
+
+    bytes_fp32: float
+    bytes_quant: float
+    path_errors: dict[str, float]  # path -> max |w - dequant(w)|
+
+    @property
+    def bytes_fraction(self) -> float:
+        return self.bytes_quant / self.bytes_fp32 if self.bytes_fp32 else 1.0
+
+    def worst_paths(self) -> list[str]:
+        return [
+            p for p, _ in sorted(
+                self.path_errors.items(), key=lambda kv: -kv[1]
+            )
+        ]
+
+
+def quant_report(params: Any, qtree: Any) -> QuantReport:
+    import jax
+
+    params = jax.device_get(params)
+    want = _flat_paths(params)
+    have = _flat_paths(qtree)
+    errors: dict[str, float] = {}
+    for path, node in have.items():
+        if not is_quantized_leaf(node):
+            continue
+        w = np.asarray(want[path], dtype=np.float32)
+        deq = (
+            np.asarray(node["int8"], dtype=np.float32)
+            * np.asarray(node["scale"], dtype=np.float32)
+        )
+        errors[path] = float(np.max(np.abs(w - deq))) if w.size else 0.0
+    return QuantReport(
+        bytes_fp32=tree_bytes(params),
+        bytes_quant=tree_bytes(qtree),
+        path_errors=errors,
+    )
+
+
+# ---------------------------------------------------------------------------
+# calibration (the drift contract's measurement half)
+
+
+def calibration_graph_batch(
+    size: int,
+    node_budget: int,
+    edge_budget: int,
+    feat_width: int,
+    input_dim: int,
+    etypes: bool = False,
+    n_etypes: int = 1,
+    seed: int = 0,
+):
+    """A deterministic random-feature packed GraphBatch at one warmup
+    ladder signature — enough signal to expose weight-reconstruction
+    error in every layer (an all-padding dummy batch would only exercise
+    the bias paths)."""
+    from deepdfa_tpu.graphs.batch import GraphSpec, pack
+
+    rng = np.random.default_rng(seed)
+    specs = []
+    for g in range(size):
+        n = int(rng.integers(4, 12))
+        # a chain + a few random extra edges: connected, varied degrees
+        src = list(range(n - 1)) + list(rng.integers(0, n, size=3))
+        dst = list(range(1, n)) + list(rng.integers(0, n, size=3))
+        specs.append(GraphSpec(
+            graph_id=g,
+            node_feats=rng.integers(
+                0, input_dim, size=(n, feat_width)
+            ).astype(np.int32),
+            node_vuln=np.zeros(n, np.int32),
+            edge_src=np.asarray(src, np.int32),
+            edge_dst=np.asarray(dst, np.int32),
+            label=float(g % 2),
+            edge_type=(
+                rng.integers(0, n_etypes, size=len(src)).astype(np.int32)
+                if etypes else None
+            ),
+        ))
+    return pack(
+        specs, size, node_budget, edge_budget,
+        feat_width=feat_width, etypes=etypes,
+    )
+
+
+def calibration_text_batch(
+    rows: int,
+    seq_len: int,
+    vocab_size: int,
+    pad_id: int,
+    node_budget: int,
+    edge_budget: int,
+    seed: int = 0,
+):
+    """Deterministic random token rows collated with empty graph slots —
+    the combined/t5 families' calibration input."""
+    from deepdfa_tpu.data.text import collate
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(4, vocab_size, size=(rows, seq_len)).astype(np.int32)
+    # realistic ragged lengths: pad the tail of each row
+    for r in range(rows):
+        ln = int(rng.integers(max(4, seq_len // 4), seq_len + 1))
+        ids[r, ln:] = pad_id
+    return collate(
+        ids, [0] * rows, list(range(rows)), {},
+        batch_rows=rows, node_budget=node_budget,
+        edge_budget=edge_budget, pad_id=pad_id,
+    )
+
+
+def max_prob_drift(
+    score_fn: Callable[[Any, Any], np.ndarray],
+    params_fp32: Any,
+    qtree: Any,
+    batches: list,
+) -> float:
+    """max |P_quant - P_fp32| over the calibration batches. `score_fn`
+    takes (f32 params, batch) -> probs; the quantized side dequantizes
+    first, exactly as the serving executables do."""
+    import jax
+
+    drift = 0.0
+    for batch in batches:
+        p_ref = np.asarray(jax.device_get(score_fn(params_fp32, batch)))
+        p_q = np.asarray(jax.device_get(
+            score_fn(dequantize_params(qtree), batch)
+        ))
+        if p_ref.size:
+            drift = max(drift, float(np.max(np.abs(p_ref - p_q))))
+    return drift
+
+
+def check_drift(
+    score_fn: Callable[[Any, Any], np.ndarray],
+    params_fp32: Any,
+    qtree: Any,
+    batches: list,
+    bound: float,
+) -> float:
+    """The admission check: returns the measured drift, or raises
+    QuantizationError naming the worst-quantized param paths."""
+    drift = max_prob_drift(score_fn, params_fp32, qtree, batches)
+    if drift > float(bound):
+        report = quant_report(params_fp32, qtree)
+        raise QuantizationError(drift, bound, report.worst_paths())
+    return drift

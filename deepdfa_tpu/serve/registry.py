@@ -34,6 +34,7 @@ from pathlib import Path
 from typing import Any, Callable
 
 from deepdfa_tpu.core import Config, config as config_mod
+from deepdfa_tpu.serve import quant
 
 logger = logging.getLogger(__name__)
 
@@ -160,14 +161,33 @@ class ModelRegistry:
         self.run_dir = Path(run_dir)
         self.family = family
         self.checkpoint = checkpoint
+        # `tag@int8` = the quantized alternate entry for `tag`
+        # (serve/quant.py): same manifest pointer, int8/bf16 pytree
+        self.base_checkpoint, self.quant_mode = (
+            quant.split_checkpoint_tag(checkpoint)
+        )
+        self.quant_drift: float | None = None
+        self.quant_bytes_fraction: float | None = None
         self.cfg = cfg if cfg is not None else load_run_config(self.run_dir)
         self.model_cfg = model_cfg
+        self.tokenizer = None
+        self.serve_max_length: int | None = None
         if family in ("combined", "t5") and model_cfg is None:
-            raise RegistryError(
-                f"family {family!r} needs the encoder model_cfg the run "
-                f"was trained with (the CLI builds it from "
-                f"--arch/--encoder/--max-length, as train-combined did)"
-            )
+            # combined/t5 runs that saved a model_cfg.json manifest
+            # (train-combined writes one; serve/cascade.py owns the
+            # format) are self-describing — rebuild the tokenizer +
+            # encoder config from it instead of requiring CLI args
+            from deepdfa_tpu.serve import cascade as cascade_mod
+
+            setup = cascade_mod.try_load_model_setup(self.run_dir, family)
+            if setup is None:
+                raise RegistryError(
+                    f"family {family!r} needs the encoder model_cfg the "
+                    f"run was trained with: pass model_cfg, or train a "
+                    f"run that saved {cascade_mod.MODEL_CFG_MANIFEST} "
+                    f"(train-combined writes it)"
+                )
+            self.tokenizer, self.model_cfg, self.serve_max_length = setup
         if family == "deepdfa" and self.cfg.model.label_style != "graph":
             raise RegistryError(
                 f"serving supports model.label_style='graph' only "
@@ -237,14 +257,14 @@ class ModelRegistry:
             manifest = json.loads(path.read_text())
         except (OSError, json.JSONDecodeError):
             return None
-        if self.checkpoint == "best":
+        if self.base_checkpoint == "best":
             entry = manifest.get("best")
-        elif self.checkpoint == "last":
+        elif self.base_checkpoint == "last":
             entry = manifest.get("last")
         else:
             entry = next(
                 (e for e in reversed(manifest.get("history", []))
-                 if e.get("tag") == self.checkpoint),
+                 if e.get("tag") == self.base_checkpoint),
                 None,
             )
         step = entry.get("step", -1) if entry else -1
@@ -268,7 +288,9 @@ class ModelRegistry:
             self._mgr = CheckpointManager(self.ckpt_dir)
         target = self._abstract_params()
         try:
-            return self._mgr.restore_for_inference(self.checkpoint, target)
+            return self._mgr.restore_for_inference(
+                self.base_checkpoint, target
+            )
         except CheckpointMismatch as e:
             # name the CONFIG keys when the saved run config can tell us
             saved_path = self.run_dir / "config.json"
@@ -298,11 +320,109 @@ class ModelRegistry:
             )
             obs_ledger.record_memory("registry_load")
 
+    # -- quantized entries (serve/quant.py, docs/cascade.md) -----------------
+
+    def _score_fn(self):
+        """(f32 params, packed batch) -> probs, per family — the one
+        probability rule the serving executables compile, reused eagerly
+        by the quantization calibration pass."""
+        import jax
+
+        if self.family == "deepdfa":
+            model = self._model
+
+            def score(params, batch):
+                return jax.nn.sigmoid(model.apply(params, batch))
+
+            return score
+        mc = self.model_cfg
+        if self.family == "t5":
+            from deepdfa_tpu.models import t5 as t5m
+
+            def score(params, batch):
+                logits = t5m.defect_forward(
+                    mc, params, batch.input_ids,
+                    graph_batch=batch.graphs, has_graph=batch.has_graph,
+                    dropout_key=None,
+                )
+                return jax.nn.softmax(logits)[:, 1]
+
+            return score
+        from deepdfa_tpu.models import combined as cmb
+
+        def score(params, batch):
+            logits = cmb.forward(
+                mc, params, batch.input_ids,
+                graph_batch=batch.graphs, has_graph=batch.has_graph,
+                dropout_key=None,
+            )
+            return jax.nn.softmax(logits)[:, 1]
+
+        return score
+
+    def _calibration_batches(self) -> list:
+        """Deterministic random calibration inputs for the drift check —
+        one packed batch with real (non-padding) rows, so every weight
+        the quantizer touched contributes to the measured drift."""
+        n = max(1, int(self.cfg.serve.quant_calibration_samples))
+        if self.family == "deepdfa":
+            return [quant.calibration_graph_batch(
+                n, node_budget=1024, edge_budget=4096,
+                feat_width=self._feat_width(),
+                input_dim=self.cfg.data.feat.input_dim,
+                etypes=self.cfg.model.n_etypes > 1,
+                n_etypes=self.cfg.model.n_etypes,
+            )]
+        enc = self.model_cfg.encoder
+        cap = int(
+            getattr(enc, "max_sequence_length", 0)
+            or getattr(enc, "max_position_embeddings", 36) - 4
+        )
+        return [quant.calibration_text_batch(
+            rows=n, seq_len=max(8, min(32, cap)),
+            vocab_size=int(enc.vocab_size),
+            pad_id=int(getattr(enc, "pad_token_id", 0)),
+            node_budget=1024, edge_budget=4096,
+        )]
+
+    def _maybe_quantize(self, params):
+        """fp32 restore -> the serving tree. Plain entries pass through;
+        @int8 entries quantize, measure calibration drift against the
+        fp32 params, and REFUSE past the configured bound (the offending
+        param paths named, CheckpointMismatch style)."""
+        if not self.quant_mode:
+            return params
+        qtree = quant.quantize_params(params)
+        bound = float(self.cfg.serve.quant_drift_bound)
+        try:
+            drift = quant.check_drift(
+                self._score_fn(), params, qtree,
+                self._calibration_batches(), bound,
+            )
+        except quant.QuantizationError as e:
+            raise RegistryError(str(e)) from e
+        report = quant.quant_report(params, qtree)
+        self.quant_drift = drift
+        self.quant_bytes_fraction = round(report.bytes_fraction, 4)
+        logger.info(
+            "quantized %s: %.0f -> %.0f param bytes (%.1f%%), "
+            "calibration drift %.2e (bound %g)",
+            self.checkpoint, report.bytes_fp32, report.bytes_quant,
+            100 * report.bytes_fraction, drift, bound,
+        )
+        return qtree
+
+    @property
+    def params_transform(self):
+        """The in-jit dequantization hook the executors fold into their
+        compiled programs; None for plain fp32 entries."""
+        return quant.dequantize_params if self.quant_mode else None
+
     def _load_initial(self) -> None:
         import jax
 
         sig = self._manifest_sig()
-        params = self._restore()
+        params = self._maybe_quantize(self._restore())
         with self._lock:
             self._params = jax.device_put(params)
             self._loaded_manifest_sig = sig
@@ -354,7 +474,7 @@ class ModelRegistry:
                 return False
             import jax
 
-            params = self._restore()
+            params = self._maybe_quantize(self._restore())
             with self._lock:
                 self._params = jax.device_put(params)
                 self._loaded_manifest_sig = sig
@@ -373,7 +493,7 @@ class ModelRegistry:
 
     def info(self) -> dict:
         """/healthz payload: what is serving, from where, pinned how."""
-        return {
+        out = {
             "family": self.family,
             "run_dir": str(self.run_dir),
             "checkpoint": self.checkpoint,
@@ -382,3 +502,11 @@ class ModelRegistry:
             "vocab_digest": self.vocab_digest,
             "hot_swaps": self.reloads,
         }
+        if self.quant_mode:
+            out.update(
+                quantized=self.quant_mode,
+                quant_drift=self.quant_drift,
+                quant_drift_bound=self.cfg.serve.quant_drift_bound,
+                quant_param_bytes_fraction=self.quant_bytes_fraction,
+            )
+        return out

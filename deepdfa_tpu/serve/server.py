@@ -84,7 +84,14 @@ class RequestLog:
 
 class ScoringService:
     """Registry + frontend + batcher wired per the serve config — the
-    one object both the HTTP server and the offline `score` CLI drive."""
+    one object both the HTTP server and the offline `score` CLI drive.
+
+    Family dispatch: the flagship GGNN gets the graph frontend + GgnnExecutor
+    (+ optional line localizer); combined/t5 registries get the tokenizer
+    frontend + CombinedExecutor (serve/cascade.py owns those parts) — the
+    same service surface either way, which is what lets the fleet replica
+    co-serve all three families and the cascade run its stage 2 through
+    the identical machinery."""
 
     def __init__(self, registry: ModelRegistry, cfg=None):
         cfg = cfg if cfg is not None else registry.cfg
@@ -93,45 +100,71 @@ class ScoringService:
         self.registry = registry
         node_budget = scfg.node_budget or cfg.data.batch.node_budget
         edge_budget = scfg.edge_budget or cfg.data.batch.edge_budget
-        if registry.family != "deepdfa":
-            raise NotImplementedError(
-                "ScoringService wires the flagship GGNN family; combined/"
-                "t5 serving drives CombinedExecutor directly (see "
-                "docs/serving.md)"
-            )
-        # the ONE process-wide content-keyed feature store: a repo scan
-        # (deepdfa_tpu/scan/) warm-fills the cache online requests hit,
-        # and vice versa — never two sibling stores
-        self.frontend = RequestPreprocessor(
-            cfg, registry.vocabs,
-            use_joern=scfg.use_joern,
-            cache=serve_frontend.shared_cache(scfg.feature_cache_entries),
-        )
-        self.executor = GgnnExecutor(
-            registry.model, registry.params,
-            node_budget=node_budget, edge_budget=edge_budget,
-            max_batch_graphs=scfg.max_batch_graphs,
-            feat_width=registry._feat_width(),
-            etypes=cfg.model.n_etypes > 1,
-        )
-        # line-level localization (serve.lines): the attribution program
-        # AOT-compiled over the SAME warmup ladder, so {"lines": true}
-        # requests never trigger a steady-state lowering either
+        # the quantized-entry dequant hook (serve/quant.py); getattr so
+        # registry-shaped stubs (scripts/bench_load.py) keep working
+        params_transform = getattr(registry, "params_transform", None)
         self.localizer = None
-        if scfg.lines:
-            from deepdfa_tpu.serve.localize import GgnnLocalizer
-
-            self.localizer = GgnnLocalizer(
+        if registry.family == "deepdfa":
+            # the ONE process-wide content-keyed feature store: a repo
+            # scan (deepdfa_tpu/scan/) warm-fills the cache online
+            # requests hit, and vice versa — never two sibling stores
+            self.frontend = RequestPreprocessor(
+                cfg, registry.vocabs,
+                use_joern=scfg.use_joern,
+                cache=serve_frontend.shared_cache(
+                    scfg.feature_cache_entries
+                ),
+            )
+            self.executor = GgnnExecutor(
                 registry.model, registry.params,
                 node_budget=node_budget, edge_budget=edge_budget,
-                sizes=self.executor.sizes,
-                method=scfg.lines_method, n_steps=scfg.lines_steps,
-                top_k=scfg.lines_top_k,
+                max_batch_graphs=scfg.max_batch_graphs,
                 feat_width=registry._feat_width(),
                 etypes=cfg.model.n_etypes > 1,
+                params_transform=params_transform,
             )
+            # line-level localization (serve.lines): the attribution
+            # program AOT-compiled over the SAME warmup ladder, so
+            # {"lines": true} requests never trigger a steady-state
+            # lowering either
+            if scfg.lines:
+                from deepdfa_tpu.serve.localize import GgnnLocalizer
+
+                self.localizer = GgnnLocalizer(
+                    registry.model, registry.params,
+                    node_budget=node_budget, edge_budget=edge_budget,
+                    sizes=self.executor.sizes,
+                    method=scfg.lines_method, n_steps=scfg.lines_steps,
+                    top_k=scfg.lines_top_k,
+                    feat_width=registry._feat_width(),
+                    etypes=cfg.model.n_etypes > 1,
+                    params_transform=params_transform,
+                )
+        else:
+            from deepdfa_tpu.serve import cascade as cascade_mod
+
+            self.frontend, self.executor = (
+                cascade_mod.build_combined_service_parts(
+                    registry, cfg, node_budget, edge_budget
+                )
+            )
+        # cascade mode (serve.cascade, docs/cascade.md): the stage-2
+        # stack is its own full ScoringService (combined/t5 family) with
+        # its own AOT warmup ladder; built BEFORE the lowering census so
+        # zero-steady-state-recompiles covers both family ladders
+        self.cascade = None
+        stages = obs_slo.STAGES
+        if scfg.cascade and registry.family == "deepdfa":
+            from deepdfa_tpu.serve import cascade as cascade_mod
+
+            self.cascade = cascade_mod.CascadeStage2.from_config(
+                cfg, registry.run_dir
+            )
+            stages = obs_slo.STAGES + obs_slo.CASCADE_STAGES
         self.slo = obs_slo.SloEngine(
-            windows=scfg.slo_windows, max_samples=scfg.slo_window_samples
+            windows=scfg.slo_windows,
+            max_samples=scfg.slo_window_samples,
+            stages=stages,
         )
         self.health = obs_health.BackendHealth()
         self.request_log: RequestLog | None = (
@@ -151,12 +184,15 @@ class ScoringService:
         self.lowerings_after_warmup = self._jit_lowerings()
 
     def _jit_lowerings(self) -> int:
-        """Lowerings across BOTH compiled surfaces (score + line
-        attribution) — the zero-steady-state-recompiles guard covers the
-        whole serving process, not just the score ladder."""
+        """Lowerings across EVERY compiled surface this service owns
+        (score + line attribution + the cascade's stage-2 ladder) — the
+        zero-steady-state-recompiles guard covers the whole serving
+        process, not just the score ladder."""
         n = self.executor.jit_lowerings()
         if self.localizer is not None:
             n += self.localizer.jit_lowerings()
+        if self.cascade is not None:
+            n += self.cascade.jit_lowerings()
         return n
 
     def _poll_hot_swap(self) -> None:
@@ -203,10 +239,15 @@ class ScoringService:
         latency_s: float | None,
         req: ScoreRequest | None = None,
         frontend_s: float | None = None,
+        extra_stages: dict | None = None,
+        log_fields: dict | None = None,
     ) -> dict:
         """The single request epilogue (HTTP handler AND offline drive):
         feed the SLO windows, append the per-request serve_log entry,
-        and return the stage attribution (the opt-in `/score` echo)."""
+        and return the stage attribution (the opt-in `/score` echo).
+        `extra_stages` carries cascade stage seconds
+        (cascade_stage1/cascade_stage2); `log_fields` carries scalar
+        verdict fields for the log entry (stage, stage1_prob, ...)."""
         stages = {
             "frontend": (
                 req.frontend_s if req is not None else frontend_s
@@ -218,7 +259,10 @@ class ScoringService:
             status, latency_s,
             frontend_s=stages["frontend"], queue_s=stages["queue"],
             device_s=stages["device"],
+            extra=extra_stages,
         )
+        if extra_stages:
+            stages.update(extra_stages)
         ms = {
             f"{k}_ms": round(1e3 * v, 3)
             for k, v in stages.items() if v is not None
@@ -232,8 +276,28 @@ class ScoringService:
                 entry["latency_ms"] = round(1e3 * latency_s, 3)
             if req is not None and req.batch_size is not None:
                 entry["batch_size"] = req.batch_size
+            if log_fields:
+                entry.update(log_fields)
             self.request_log.append({"request": entry})
         return ms
+
+    def cascade_decide(
+        self,
+        code: str,
+        prob1: float,
+        request_id: str,
+        req: ScoreRequest | None = None,
+    ):
+        """The cascade verdict for one stage-1 score: (final prob,
+        response fields, extra SLO stage seconds). cascade_stage1 is the
+        stage-1 request's full latency (the screen's cost); stage 2 adds
+        cascade_stage2 when escalated."""
+        prob, info, extra = self.cascade.decide(
+            code, prob1, request_id=request_id
+        )
+        if req is not None and req.latency_s is not None:
+            extra = {"cascade_stage1": req.latency_s, **extra}
+        return prob, info, extra
 
     def attribute_lines(self, feats, request_id: str | None = None):
         """Per-line attributions for ONE extracted function through the
@@ -266,15 +330,20 @@ class ScoringService:
         # which message-passing lowering is serving (operators need to
         # know before reading latency numbers): the Pallas-fused step's
         # per-signature census, or the lax path when the knob is off
-        from deepdfa_tpu.nn import ggnn_kernel as _ggnn_kernel
+        if self.registry.family == "deepdfa":
+            from deepdfa_tpu.nn import ggnn_kernel as _ggnn_kernel
 
-        info["ggnn_kernel"] = bool(
-            getattr(self.registry.cfg.model, "ggnn_kernel", False)
-        )
-        if info["ggnn_kernel"]:
-            info["ggnn_kernel_signatures"] = _ggnn_kernel.signature_stats()
+            info["ggnn_kernel"] = bool(
+                getattr(self.registry.cfg.model, "ggnn_kernel", False)
+            )
+            if info["ggnn_kernel"]:
+                info["ggnn_kernel_signatures"] = (
+                    _ggnn_kernel.signature_stats()
+                )
         if self.localizer is not None:
             info["lines_method"] = self.localizer.method
+        if self.cascade is not None:
+            info["cascade"] = self.cascade.info()
         if deep:
             # bounded subprocess compile-and-execute of the DEFAULT
             # backend (obs/health.py) — the wedged-compile-service
@@ -297,6 +366,8 @@ class ScoringService:
             if k.startswith("serve/")
         }
         out["slo"] = self.slo.snapshot()
+        if self.cascade is not None:
+            out["cascade"] = self.cascade.counters()
         led = obs_ledger.snapshot_or_none()
         if led is not None:
             # the device efficiency view (docs/efficiency.md): per-
@@ -333,6 +404,15 @@ class ScoringService:
         }
         if backend:
             record["backend"] = backend
+        if self.cascade is not None:
+            # the cascade section validate_cascade_log requires:
+            # escalation accounting + the stage-2 recompile census
+            record["cascade"] = {
+                **self.cascade.counters(),
+                "stage2_steady_state_recompiles": (
+                    self.cascade.service.steady_state_recompiles()
+                ),
+            }
         led = obs_ledger.snapshot_or_none()
         if led is not None:
             record["ledger"] = led
@@ -340,10 +420,14 @@ class ScoringService:
 
     def start(self) -> None:
         self.batcher.start()
+        if self.cascade is not None:
+            self.cascade.start()
 
     def close(self) -> None:
         self.batcher.close()
         self.frontend.close()
+        if self.cascade is not None:
+            self.cascade.close()
         if self.request_log is not None:
             self.request_log.close()
 
@@ -370,7 +454,7 @@ def score_texts(
     traffic (status-code analog per outcome), so the SLO windows and
     the request log cover offline drives too."""
     rows: list[dict] = []
-    payloads: list[tuple[dict, Any, str, float]] = []
+    payloads: list[tuple[dict, Any, str, float, str]] = []
     for name, code in texts:
         row = {"name": name}
         rows.append(row)  # input order preserved
@@ -382,7 +466,7 @@ def score_texts(
                 obs_trace.flow("request", rid, "s", cat="serve")
                 spec = service.frontend.features(code)
             payloads.append(
-                (row, spec, rid, time.perf_counter() - t0)
+                (row, spec, rid, time.perf_counter() - t0, code)
             )
         except (FrontendError, RequestTooLarge) as e:
             status = 422 if isinstance(e, FrontendError) else 413
@@ -392,14 +476,18 @@ def score_texts(
                 frontend_s=time.perf_counter() - t0,
             )
     reqs = service.batcher.score_all(
-        [spec for _, spec, _, _ in payloads],
-        request_ids=[rid for _, _, rid, _ in payloads],
-        frontend_seconds=[fs for _, _, _, fs in payloads],
+        [spec for _, spec, _, _, _ in payloads],
+        request_ids=[rid for _, _, rid, _, _ in payloads],
+        frontend_seconds=[fs for _, _, _, fs, _ in payloads],
     )
-    for (row, _, rid, _), req in zip(payloads, reqs):
+    # cascade mode (docs/cascade.md): collect the stage-1 verdicts
+    # first, then escalate the whole uncertain band through the stage-2
+    # batcher's deterministic offline drive in one grouped pass
+    escalate: list[tuple[dict, ScoreRequest, str, str]] = []
+    done: list[tuple[dict, ScoreRequest, str, dict, dict]] = []
+    for (row, _, rid, _, code), req in zip(payloads, reqs):
         try:
-            row.update(ok=True, prob=req.wait(timeout_s))
-            service.finish_request(rid, 200, req.latency_s, req=req)
+            prob1 = req.wait(timeout_s)
         except Exception as e:  # noqa: BLE001 - per-row fault isolation
             row.update(ok=False, error=str(e))
             # same status-code analog per outcome as the HTTP path
@@ -410,6 +498,49 @@ def score_texts(
             else:
                 status = 500
             service.finish_request(rid, status, req.latency_s, req=req)
+            continue
+        casc = service.cascade
+        if casc is None:
+            row.update(ok=True, prob=prob1)
+            service.finish_request(rid, 200, req.latency_s, req=req)
+            continue
+        # the SAME screen verdict the HTTP handler uses (band + shed +
+        # counter semantics live in ONE place, CascadeStage2.screen)
+        should_escalate, fields = casc.screen(prob1)
+        extra = {"cascade_stage1": req.latency_s}
+        if should_escalate:
+            row.update(fields)
+            escalate.append((row, req, rid, code))
+        else:
+            row.update(ok=True, prob=prob1, **fields)
+            done.append((row, req, rid, fields, extra))
+    for row, req, rid, fields, extra in done:
+        service.finish_request(
+            rid, 200, req.latency_s, req=req,
+            extra_stages=extra, log_fields=fields,
+        )
+    if escalate:
+        results = service.cascade.escalate_many(
+            [code for _, _, _, code in escalate],
+        )
+        for (row, req, rid, _), (prob2, s2) in zip(escalate, results):
+            extra = {"cascade_stage1": req.latency_s}
+            if prob2 is None:
+                # a failed stage-2 pass degrades to the stage-1 score —
+                # never a failed request (the screen already answered)
+                row.update(ok=True, prob=row["stage1_prob"])
+                fields = {k: row[k] for k in (
+                    "stage", "stage1_prob", "calibrated_prob")}
+                fields["cascade_failed"] = 1
+            else:
+                row.update(ok=True, prob=prob2, stage=2)
+                fields = {k: row[k] for k in (
+                    "stage", "stage1_prob", "calibrated_prob")}
+                extra["cascade_stage2"] = s2
+            service.finish_request(
+                rid, 200, req.latency_s, req=req,
+                extra_stages=extra, log_fields=fields,
+            )
     return rows
 
 
@@ -502,6 +633,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         req = None
         feats = None
+        cascade_fields: dict = {}
+        cascade_extra: dict | None = None
         try:
             if want_lines:
                 req, feats = service.submit_code(
@@ -510,6 +643,13 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 req = service.submit_code(code, request_id=rid)
             prob = req.wait(self.request_timeout_s)
+            if service.cascade is not None:
+                # the cascade verdict (docs/cascade.md): screen on the
+                # stage-1 prob, escalate the uncertain band through the
+                # stage-2 batcher (handler threads co-batch there)
+                prob, cascade_fields, cascade_extra = (
+                    service.cascade_decide(code, prob, rid, req=req)
+                )
             lines = (
                 service.attribute_lines(feats, request_id=rid)
                 if want_lines else None
@@ -530,13 +670,16 @@ class _Handler(BaseHTTPRequestHandler):
             status, err = 500, e
         else:
             stages = service.finish_request(
-                rid, 200, time.monotonic() - t0, req=req
+                rid, 200, time.monotonic() - t0, req=req,
+                extra_stages=cascade_extra,
+                log_fields=cascade_fields or None,
             )
             out = {
                 "ok": True,
                 "prob": prob,
                 "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
                 "request_id": rid,
+                **cascade_fields,
             }
             if lines is not None:
                 out["lines"] = lines

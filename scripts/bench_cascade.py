@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Cascade accuracy-vs-device-time frontier benchmark (docs/cascade.md).
+
+The ISSUE-12 acceptance drive: on one synthetic labeled dev set, serve
+the SAME requests two ways and measure the frontier —
+
+  combined-only   every request through the combined transformer
+                  executor (the expensive family, fp32)
+  cascade         every request through the trained GGNN screen; only
+                  the calibrated uncertainty band escalates to the
+                  combined executor, restored as its QUANTIZED
+                  `best@int8` registry entry
+
+and report:
+
+  cascade_req_per_sec            end-to-end cascade throughput (warm)
+  cascade_combined_req_per_sec   combined-only throughput (warm)
+  cascade_speedup                ratio (the frontier headline: >1 means
+                                 the cascade serves more requests per
+                                 device-second)
+  cascade_escalation_rate        fraction escalated at the FITTED band
+                                 (eval/calibrate.py temperature + band
+                                 from the dev set itself — the
+                                 calibration recipe end to end)
+  cascade_score_drift            max(0, combined AUC - cascade AUC):
+                                 one-sided accuracy drift vs the
+                                 combined-only baseline (bounded
+                                 absolutely in obs/bench_gate.py)
+  quant_param_bytes_fraction     the @int8 stage-2 entry's param bytes
+                                 over its fp32 twin (the HBM ledger's
+                                 density win)
+  cascade_steady_state_recompiles  across BOTH family ladders
+
+Unlike bench_serve this trains the stage-1 GGNN (a few tiny epochs via
+the serve smoke builder) — the drift metric needs a screen that actually
+ranks, not random weights — and restores both stages through the REAL
+ModelRegistry, so the quantized-restore drift contract rides the bench.
+
+Modes:
+    python scripts/bench_cascade.py --smoke   # tier-1 regression mode
+    python scripts/bench_cascade.py           # bigger corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_cascade(n_examples: int = 48, smoke: bool = False) -> dict:
+    from deepdfa_tpu.core import config as config_mod
+    from deepdfa_tpu.data import generate, to_examples
+    from deepdfa_tpu.eval import calibrate as calibrate_mod
+    from deepdfa_tpu.serve import cascade as cascade_mod, driver
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService, score_texts
+
+    n = min(int(n_examples), 48) if smoke else int(n_examples)
+    cfg, run_dir, _sources = driver.build_smoke_run(
+        run_name="bench-cascade", dataset="bench-cascade",
+        n_examples=n, max_epochs=10, seed=0,
+        # balanced labels: AUC over the dataset's ~6% positive rate is
+        # noise at bench sizes
+        vuln_rate=0.5,
+        extra_overrides=[
+            # a screen worth trusting: big enough to rank the synthetic
+            # corpus (tiny next to stage 2 either way)
+            "model.hidden_dim=32",
+            "serve.max_batch_graphs=16",
+            # stage-2 batch rows: token_budget / max_length
+            "data.token_budget=2048",
+        ],
+    )
+    # the labeled dev set: same generator/seed the smoke builder wrote
+    # the source files from, so names join back to labels
+    examples = to_examples(generate(n, vuln_rate=0.5, seed=0))
+    labels = {f"fn_{e.id:04d}": int(e.label or 0) for e in examples}
+    texts = [(f"fn_{e.id:04d}", e.code) for e in examples]
+
+    # stage 2: a TRAINED combined transformer sized so escalation cost
+    # dominates the GGNN screen (the regime the cascade exists for)
+    cascade_mod.train_stage2_smoke(
+        run_dir, cfg, n_examples=n, vuln_rate=0.5, seed=0,
+        hidden=48 if smoke else 64, layers=3, heads=4,
+        max_length=128, vocab_size=512,
+        max_epochs=8 if smoke else 10,
+    )
+
+    def matched_auc(rows) -> float | None:
+        pairs = [
+            (r["prob"], labels[r["name"]])
+            for r in rows if r.get("ok") and r["name"] in labels
+        ]
+        return calibrate_mod.auc(
+            [p for p, _ in pairs], [y for _, y in pairs]
+        )
+
+    # -- calibration pass: stage-1 probs over the dev set fit the
+    # temperature + band (the docs/cascade.md recipe, end to end)
+    reg1 = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+        cfg=cfg,
+    )
+    svc1 = ScoringService(reg1, cfg)
+    try:
+        rows1 = score_texts(svc1, texts)  # also warms the feature cache
+    finally:
+        svc1.close()
+    cal_pairs = [
+        (r["prob"], labels[r["name"]]) for r in rows1 if r.get("ok")
+    ]
+    # ~0.27 target: the escalated band fills ONE stage-2 batch at the
+    # bench sizes — a second nearly-empty batch would pad to full rows
+    # and pay full device time (the collate contract), halving the win
+    calib = calibrate_mod.calibrate(
+        [p for p, _ in cal_pairs], [y for _, y in cal_pairs],
+        target_escalation=0.27,
+    )
+
+    # -- combined-only baseline (fp32 entry)
+    # timing convention: BEST of `reps` warm passes per mode — the
+    # deterministic per-pass cost survives, this box's transient stalls
+    # don't (the PR-10 overhead-bound lesson)
+    reps = 3 if smoke else 5
+
+    def best_pass(svc):
+        rows, best = None, None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            rows = score_texts(svc, texts)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return rows, best
+
+    regc = ModelRegistry(
+        run_dir, family="combined", checkpoint="best", cfg=cfg
+    )
+    svcc = ScoringService(regc, cfg)
+    try:
+        score_texts(svcc, texts)  # warm
+        rows_combined, combined_dt = best_pass(svcc)
+    finally:
+        svcc.close()
+    combined_ok = sum(1 for r in rows_combined if r.get("ok"))
+    combined_auc = matched_auc(rows_combined)
+
+    # -- the cascade: trained GGNN screen + QUANTIZED stage 2 at the
+    # fitted band/temperature
+    ccfg = config_mod.apply_overrides(cfg, [
+        "serve.cascade=true",
+        f"serve.cascade_temperature={calib['temperature']}",
+        "serve.cascade_band=" + json.dumps(calib["band"]),
+        'serve.cascade_checkpoint="best@int8"',
+    ])
+    regx = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+        cfg=ccfg,
+    )
+    svcx = ScoringService(regx, ccfg)
+    try:
+        score_texts(svcx, texts)  # warm
+        esc0 = svcx.cascade.counters()
+        rows_cascade, cascade_dt = best_pass(svcx)
+        esc1 = svcx.cascade.counters()
+        recompiles = svcx.steady_state_recompiles()
+        quant_fraction = (
+            svcx.cascade.service.registry.quant_bytes_fraction
+        )
+        quant_drift = svcx.cascade.service.registry.quant_drift
+    finally:
+        svcx.close()
+    cascade_ok = sum(1 for r in rows_cascade if r.get("ok"))
+    cascade_auc = matched_auc(rows_cascade)
+    timed_reqs = esc1["requests"] - esc0["requests"]
+    timed_escs = esc1["escalations"] - esc0["escalations"]
+    escalation_rate = timed_escs / timed_reqs if timed_reqs else None
+
+    combined_rps = combined_ok / combined_dt if combined_dt else 0.0
+    cascade_rps = cascade_ok / cascade_dt if cascade_dt else 0.0
+    drift = (
+        max(0.0, combined_auc - cascade_auc)
+        if combined_auc is not None and cascade_auc is not None
+        else None
+    )
+    return {
+        "metric": "cascade_req_per_sec",
+        "value": round(cascade_rps, 2),
+        "unit": "requests/s",
+        "cascade_req_per_sec": round(cascade_rps, 2),
+        "cascade_combined_req_per_sec": round(combined_rps, 2),
+        "cascade_speedup": (
+            round(cascade_rps / combined_rps, 3) if combined_rps else None
+        ),
+        "cascade_escalation_rate": (
+            round(escalation_rate, 4)
+            if escalation_rate is not None else None
+        ),
+        "cascade_auc": cascade_auc,
+        "cascade_combined_auc": combined_auc,
+        "cascade_stage1_auc": calib["dev_auc"],
+        "cascade_score_drift": drift,
+        "cascade_temperature": calib["temperature"],
+        "cascade_band": calib["band"],
+        "cascade_steady_state_recompiles": int(recompiles),
+        "quant_param_bytes_fraction": quant_fraction,
+        "quant_calibration_drift": quant_drift,
+        "cascade_scored": cascade_ok,
+        "n_examples": n,
+        "smoke": smoke,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--examples", type=int, default=128)
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tier-1 regression mode: tiny corpus/models, asserts the "
+        "frontier (cascade strictly faster, drift inside the bound, "
+        "zero recompiles)",
+    )
+    args = ap.parse_args()
+
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+    apply_platform_override()
+    if "DEEPDFA_TPU_STORAGE" not in os.environ:
+        import tempfile
+
+        tmp = tempfile.TemporaryDirectory(prefix="bench-cascade-")
+        os.environ["DEEPDFA_TPU_STORAGE"] = tmp.name
+
+    record = bench_cascade(args.examples, smoke=args.smoke)
+    from deepdfa_tpu.obs import run_stamp
+
+    record.update(run_stamp())
+    print(json.dumps(record), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(record, indent=1))
+    if args.smoke:
+        problems = []
+        if record["cascade_steady_state_recompiles"]:
+            problems.append(
+                f"{record['cascade_steady_state_recompiles']} steady-"
+                f"state recompiles (expected 0 across both ladders)"
+            )
+        if not (
+            record["cascade_speedup"]
+            and record["cascade_speedup"] > 1.0
+        ):
+            problems.append(
+                f"cascade_speedup={record['cascade_speedup']} — the "
+                f"cascade must strictly beat combined-only serving"
+            )
+        if record["cascade_score_drift"] is None or (
+            record["cascade_score_drift"] > 0.05
+        ):
+            problems.append(
+                f"cascade_score_drift={record['cascade_score_drift']} "
+                f"outside the pinned 0.05 bound"
+            )
+        if not (
+            record["quant_param_bytes_fraction"]
+            and record["quant_param_bytes_fraction"] < 0.5
+        ):
+            problems.append(
+                f"quant_param_bytes_fraction="
+                f"{record['quant_param_bytes_fraction']} not under 0.5"
+            )
+        if problems:
+            raise SystemExit(
+                "cascade smoke contract violated:\n  "
+                + "\n  ".join(problems)
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,13 @@ Modes:
                  scanner's summary records, deepdfa_tpu/scan/ — the
                  `scan/*` + `localize/*` tag half of the schema,
                  docs/scanning.md)
+  --cascade-log <path>  validate a cascade-mode serve_log.jsonl
+                 (serve/cascade.py, docs/cascade.md): escalation fields
+                 present in the summary's cascade section, per-request
+                 entries declare their deciding stage (escalated ones
+                 their cascade_stage2_ms), the SLO snapshot declares the
+                 cascade stages, AND every flattened scalar tag declared
+                 in SCHEMA — wired into `deepdfa-tpu serve --smoke`
   --fleet-log <path>  validate a fleet router's fleet_log.jsonl
                  (deepdfa_tpu/fleet/router.py, docs/fleet.md):
                  structural checks (per-request entries carry id +
@@ -166,6 +173,9 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-log", default=None,
                     help="validate a fleet router's fleet_log.jsonl "
                     "(deepdfa_tpu/fleet/, docs/fleet.md)")
+    ap.add_argument("--cascade-log", default=None,
+                    help="validate a cascade-mode serve_log.jsonl "
+                    "(deepdfa_tpu/serve/cascade.py, docs/cascade.md)")
     ap.add_argument("--metrics", default=None,
                     help="validate a saved Prometheus /metrics scrape")
     ap.add_argument("--postmortem", default=None,
@@ -188,6 +198,24 @@ def main(argv=None) -> int:
                 "fleet log validation failed (declare the tags in "
                 "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the "
                 "router):\n  " + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.cascade_log:
+        from deepdfa_tpu.serve.cascade import validate_cascade_log
+
+        result = validate_cascade_log(args.cascade_log)
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "cascade log validation failed (declare the tags in "
+                "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the cascade "
+                "emitters):\n  "
+                + "\n  ".join(result.get("problems", [])),
                 file=sys.stderr,
             )
             return 1

@@ -1,0 +1,413 @@
+"""Two-stage cascaded inference (serve/cascade.py, eval/calibrate.py,
+docs/cascade.md).
+
+In-process invariants (the CLI/e2e surface rides `serve --smoke`,
+`fleet --smoke`, and scripts/bench_cascade.py):
+
+- temperature scaling recovers a known miscalibration and the fitted
+  band hits its target escalation fraction;
+- the cascade service routes by the calibrated band: out-of-band
+  requests answer with the stage-1 score, in-band requests carry the
+  stage-2 score, both stages stay at zero steady-state lowerings;
+- the combined family serves through the SAME ScoringService surface
+  (model_cfg.json manifest round trip);
+- the admission layer sheds stage-2 escalations BEFORE stage-1 traffic
+  under overload (the docs/cascade.md shed order);
+- `fleet.models` entries parse the [family:] prefix.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, config as config_mod
+from deepdfa_tpu.eval import calibrate as cal
+
+
+# ---------------------------------------------------------------------------
+# calibration utility
+
+
+def test_temperature_recovers_miscalibration(rng):
+    """Probs sharpened by a known factor T*: the fitted temperature
+    approximately undoes it (NLL optimum near T*)."""
+    z = rng.normal(0.0, 1.5, size=4000)
+    y = (rng.random(4000) < 1 / (1 + np.exp(-z))).astype(int)
+    t_star = 2.5
+    over_sharp = 1 / (1 + np.exp(-z * t_star))
+    t = cal.fit_temperature(over_sharp, y)
+    assert 1.8 < t < 3.4, t
+    # scaling back by the fitted T improves NLL vs the raw probs
+    assert cal.nll(over_sharp, y, t) < cal.nll(over_sharp, y, 1.0)
+
+
+def test_fit_temperature_needs_both_classes():
+    with pytest.raises(ValueError):
+        cal.fit_temperature([0.2, 0.8], [1, 1])
+
+
+def test_band_hits_target_escalation(rng):
+    probs = rng.random(500)
+    labels = (rng.random(500) < probs).astype(int)
+    band = cal.fit_band(probs, labels, temperature=1.0,
+                        target_escalation=0.3)
+    frac = np.mean([cal.in_band(p, band) for p in probs])
+    assert abs(frac - 0.3) < 0.05
+    # empty band escalates nothing
+    assert cal.fit_band(probs, target_escalation=0.0) == (0.5, 0.5)
+    assert not cal.in_band(0.5, (0.5, 0.5))
+
+
+def test_auc_rank_with_ties():
+    assert cal.auc([0.1, 0.4, 0.35, 0.8], [0, 0, 1, 1]) == 0.75
+    assert cal.auc([0.5, 0.5], [0, 1]) == 0.5  # tie averaged
+    assert cal.auc([0.1, 0.2], [0, 0]) is None  # one class
+
+
+# ---------------------------------------------------------------------------
+# slo stages
+
+
+def test_slo_engine_cascade_stages():
+    from deepdfa_tpu.obs.slo import CASCADE_STAGES, STAGES, SloEngine
+
+    t = [0.0]
+    eng = SloEngine(windows=(60,), clock=lambda: t[0],
+                    stages=STAGES + CASCADE_STAGES)
+    eng.observe_request(
+        200, 0.010, frontend_s=0.001,
+        extra={"cascade_stage1": 0.004, "cascade_stage2": 0.005},
+    )
+    snap = eng.snapshot()
+    lat = snap["60s"]["latency_ms"]
+    assert lat["cascade_stage1"]["p50"] == 4.0
+    assert lat["cascade_stage2"]["p50"] == 5.0
+    # an undeclared extra stage is ignored, never a KeyError
+    eng.observe_request(200, 0.010, extra={"bogus_stage": 1.0})
+    assert "bogus_stage" not in eng.snapshot()["60s"]["latency_ms"]
+
+
+# ---------------------------------------------------------------------------
+# the cascade service, end to end in-process
+
+
+@pytest.fixture(scope="module")
+def cascade_run(tmp_path_factory):
+    """A tiny GGNN run dir + stage-2 combined artifacts, once per
+    module (real checkpoints, no training loop)."""
+    import jax
+
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.serve import cascade as cascade_mod
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    import os
+
+    tmp = tmp_path_factory.mktemp("cascade-run")
+    old = os.environ.get("DEEPDFA_TPU_STORAGE")
+    os.environ["DEEPDFA_TPU_STORAGE"] = str(tmp)
+    try:
+        synth = generate(16, seed=3)
+        examples = to_examples(synth)
+        _, vocabs = build_dataset(
+            examples, train_ids=range(16), limit_all=50, limit_subkeys=50
+        )
+        cfg = config_mod.apply_overrides(Config(), [
+            'run_name="casc-e2e"', 'data.dataset="casc-e2e"',
+            'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+            "model.hidden_dim=8", "model.n_steps=2",
+            "serve.max_batch_graphs=2",
+            "serve.node_budget=2048", "serve.edge_budget=8192",
+            "data.token_budget=128",
+        ])
+        (paths.processed_dir("casc-e2e")
+         / f"vocab{cfg.data.feat.name}.json").write_text(
+            json.dumps({k: v.to_json() for k, v in vocabs.items()})
+        )
+        model = DeepDFA.from_config(
+            cfg.model, input_dim=cfg.data.feat.input_dim
+        )
+        params = model.init(
+            jax.random.key(0), pack([], 1, 2048, 8192)
+        )
+        run_dir = paths.runs_dir("casc-e2e")
+        config_mod.to_json(cfg, run_dir / "config.json")
+        CheckpointManager(
+            run_dir / "checkpoints", monitor="val_loss"
+        ).save(
+            "epoch-0001", jax.device_get(params), {"val_loss": 1.0},
+            step=1,
+        )
+        tok, mcfg = cascade_mod.build_stage2_smoke(
+            run_dir, cfg, family="combined"
+        )
+        yield cfg, run_dir, examples, tok, mcfg
+    finally:
+        if old is None:
+            os.environ.pop("DEEPDFA_TPU_STORAGE", None)
+        else:
+            os.environ["DEEPDFA_TPU_STORAGE"] = old
+
+
+def test_model_setup_manifest_roundtrip(cascade_run):
+    from deepdfa_tpu.serve import cascade as cascade_mod
+
+    cfg, run_dir, _, tok, mcfg = cascade_run
+    tok2, mcfg2, max_length = cascade_mod.load_model_setup(
+        run_dir, "combined"
+    )
+    assert mcfg2 == mcfg  # dataclass equality: full config round trip
+    assert tok2.vocab_size == tok.vocab_size
+    assert tok2.pad_id == tok.pad_id
+    assert max_length == 32
+    with pytest.raises(ValueError):
+        cascade_mod.load_model_setup(run_dir, "t5")  # wrong family
+
+
+def test_combined_family_scoring_service(cascade_run):
+    """The combined family serves through the SAME ScoringService
+    surface (frontend tokenization + CombinedExecutor), registry
+    rebuilt from the manifest alone."""
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService, score_texts
+
+    cfg, run_dir, examples, _, _ = cascade_run
+    registry = ModelRegistry(
+        run_dir, family="combined", checkpoint="best", cfg=cfg
+    )
+    service = ScoringService(registry, cfg)
+    try:
+        rows = score_texts(
+            service, [(f"fn{e.id}", e.code) for e in examples[:4]]
+        )
+        assert all(r.get("ok") for r in rows)
+        assert all(0.0 <= r["prob"] <= 1.0 for r in rows)
+        assert service.steady_state_recompiles() == 0
+    finally:
+        service.close()
+
+
+def test_cascade_routes_by_band(cascade_run):
+    """Band (0,1) escalates everything; band (x,x) escalates nothing —
+    and the stage verdicts + counters + SLO stages agree, at zero
+    steady-state lowerings across both ladders."""
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService, score_texts
+
+    cfg, run_dir, examples, _, _ = cascade_run
+    texts = [(f"fn{e.id}", e.code) for e in examples[:4]]
+
+    def run_with_band(band):
+        ccfg = config_mod.apply_overrides(cfg, [
+            "serve.cascade=true",
+            "serve.cascade_band=" + json.dumps(band),
+        ])
+        registry = ModelRegistry(
+            run_dir, family="deepdfa",
+            checkpoint=cfg.serve.checkpoint, cfg=ccfg,
+        )
+        service = ScoringService(registry, ccfg)
+        try:
+            c0 = service.cascade.counters()
+            rows = score_texts(service, texts)
+            c1 = service.cascade.counters()
+            recompiles = service.steady_state_recompiles()
+            slo = service.slo.snapshot()
+        finally:
+            service.close()
+        return rows, {
+            k: c1[k] - c0[k]
+            for k in ("requests", "escalations", "sheds")
+        }, recompiles, slo
+
+    rows, counters, recompiles, slo = run_with_band([0.0, 1.0])
+    assert all(r["stage"] == 2 for r in rows)
+    assert all("stage1_prob" in r for r in rows)
+    # escalated scores come from stage 2: they differ from the screen's
+    assert all(r["prob"] != r["stage1_prob"] for r in rows)
+    assert counters == {"requests": 4, "escalations": 4, "sheds": 0}
+    assert recompiles == 0
+    lat = slo["60s"]["latency_ms"]
+    assert "cascade_stage1" in lat and "cascade_stage2" in lat
+
+    rows, counters, recompiles, _ = run_with_band([0.5, 0.5])
+    assert all(r["stage"] == 1 for r in rows)
+    assert all(r["prob"] == r["stage1_prob"] for r in rows)
+    assert counters == {"requests": 4, "escalations": 0, "sheds": 0}
+    assert recompiles == 0
+
+
+def test_cascade_log_validates(cascade_run, tmp_path):
+    """A cascade-mode serve_log validates; a log missing the cascade
+    section is rejected with a named problem."""
+    from deepdfa_tpu.serve import cascade as cascade_mod
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import (
+        ScoringService,
+        score_texts,
+        write_serve_log,
+    )
+
+    cfg, run_dir, examples, _, _ = cascade_run
+    log_path = run_dir / "serve_log.jsonl"
+    if log_path.exists():
+        log_path.unlink()
+    ccfg = config_mod.apply_overrides(cfg, [
+        "serve.cascade=true", "serve.request_log=true",
+        "serve.cascade_band=[0.0, 1.0]",
+    ])
+    registry = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+        cfg=ccfg,
+    )
+    service = ScoringService(registry, ccfg)
+    try:
+        score_texts(
+            service, [(f"fn{e.id}", e.code) for e in examples[:4]]
+        )
+        rec = service.serve_record()
+        write_serve_log(run_dir, [rec])
+    finally:
+        service.close()
+    res = cascade_mod.validate_cascade_log(log_path)
+    assert res["ok"], res["problems"]
+    assert res["escalated"] == 4
+
+    # a plain (non-cascade) log is rejected with a named problem
+    plain = tmp_path / "plain_log.jsonl"
+    plain.write_text(json.dumps({"serve": {"requests": 1.0}}) + "\n")
+    res = cascade_mod.validate_cascade_log(plain)
+    assert not res["ok"]
+    assert any("cascade section" in p for p in res["problems"])
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: spec parsing + cascade-aware shedding
+
+
+def test_parse_model_spec_family():
+    from deepdfa_tpu.fleet.replica import parse_model_spec
+
+    assert parse_model_spec("m=/runs/x") == (
+        "m", "deepdfa", "/runs/x", "best"
+    )
+    assert parse_model_spec("m=/runs/x:last") == (
+        "m", "deepdfa", "/runs/x", "last"
+    )
+    assert parse_model_spec("s2=combined:/runs/x:best@int8") == (
+        "s2", "combined", "/runs/x", "best@int8"
+    )
+    assert parse_model_spec("s2=t5:/runs/x") == (
+        "s2", "t5", "/runs/x", "best"
+    )
+    with pytest.raises(ValueError):
+        parse_model_spec("bad-spec")
+    with pytest.raises(ValueError):
+        parse_model_spec("m=combined:")
+
+
+def test_admission_sheds_stage2_before_stage1():
+    """The docs/cascade.md shed order: between the cascade threshold and
+    the overload threshold, stage-2 escalations shed 503
+    `cascade_overload` while plain stage-1 traffic still admits."""
+    from deepdfa_tpu.fleet.admission import AdmissionController
+
+    t = [0.0]
+    ctl = AdmissionController(
+        replica_capacity=10, shed_fraction=1.0,
+        cascade_shed_fraction=0.5, default_rate=1e9,
+        default_burst=1e9, clock=lambda: t[0],
+    )
+    # below both thresholds: everyone admits
+    d1 = ctl.decide("t", outstanding=2, healthy=1, cascade_stage=2)
+    assert d1.admit
+    # past 50% of capacity: stage-2 sheds, stage-1 still admits
+    d2 = ctl.decide("t", outstanding=6, healthy=1, cascade_stage=2)
+    assert not d2.admit and d2.reason == "cascade_overload"
+    assert d2.status == 503
+    d3 = ctl.decide("t", outstanding=6, healthy=1)
+    assert d3.admit
+    # past full capacity: batch-priority stage-1 sheds too
+    d4 = ctl.decide("t", outstanding=10, healthy=1)
+    assert not d4.admit and d4.reason == "overload"
+    # an INTERACTIVE-class tenant survives overload — but its stage-2
+    # escalations still shed first (the cascade threshold is not a
+    # priority carve-out: every escalation already holds a stage-1
+    # answer to degrade to)
+    ctl2 = AdmissionController(
+        replica_capacity=10, shed_fraction=1.0,
+        cascade_shed_fraction=0.5, default_rate=1e9,
+        default_burst=1e9, default_priority=0, clock=lambda: t[0],
+    )
+    d5 = ctl2.decide("t", outstanding=10, healthy=1)
+    assert d5.admit
+    d6 = ctl2.decide("t", outstanding=10, healthy=1, cascade_stage=2)
+    assert not d6.admit and d6.reason == "cascade_overload"
+
+
+def test_cascade_degrades_on_stage2_failure(cascade_run, monkeypatch):
+    """A stage-2 failure (timeout/queue-full/executor error) DEGRADES
+    to the stage-1 score on the online path — never a failed request —
+    counted as a failure, not an escalation."""
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService
+
+    cfg, run_dir, examples, _, _ = cascade_run
+    ccfg = config_mod.apply_overrides(cfg, [
+        "serve.cascade=true", "serve.cascade_band=[0.0, 1.0]",
+    ])
+    registry = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+        cfg=ccfg,
+    )
+    service = ScoringService(registry, ccfg)
+    try:
+        def boom(code, request_id=None):
+            raise TimeoutError("stage-2 wedged")
+
+        monkeypatch.setattr(service.cascade, "escalate", boom)
+        c0 = service.cascade.counters()
+        prob, info, extra = service.cascade.decide(
+            examples[0].code, 0.42
+        )
+        c1 = service.cascade.counters()
+        assert prob == 0.42  # the screen's answer survives
+        assert info["stage"] == 1 and info["cascade_failed"] == 1
+        assert "cascade_stage2" not in extra
+        assert c1["failures"] - c0["failures"] == 1
+        assert c1["escalations"] == c0["escalations"]
+    finally:
+        service.close()
+
+
+def test_cascade_service_shed_on_stage2_backlog(cascade_run):
+    """The service-level degradation: a saturated stage-2 queue makes
+    new escalations answer with their stage-1 score (cascade_shed),
+    never queue more device time."""
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.server import ScoringService
+
+    cfg, run_dir, examples, _, _ = cascade_run
+    ccfg = config_mod.apply_overrides(cfg, [
+        "serve.cascade=true",
+        "serve.cascade_band=[0.0, 1.0]",
+        "serve.cascade_shed_depth_fraction=0.0",  # always overloaded
+    ])
+    registry = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint=cfg.serve.checkpoint,
+        cfg=ccfg,
+    )
+    service = ScoringService(registry, ccfg)
+    try:
+        assert service.cascade.overloaded()
+        prob, info, extra = service.cascade.decide(
+            examples[0].code, 0.5
+        )
+        assert prob == 0.5  # the stage-1 answer
+        assert info["stage"] == 1 and info["cascade_shed"] == 1
+        assert "cascade_stage2" not in extra
+    finally:
+        service.close()

@@ -1,0 +1,138 @@
+"""CLI surface of the cascade/quant layer (docs/cascade.md): the
+calibration command, the cascade-log schema checker mode, and the
+accuracy-vs-device-time frontier bench (the ISSUE-12 acceptance
+drive)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).parents[1]
+
+from conftest import run_cli  # noqa: E402
+
+
+def test_cascade_calibrate_cli(tmp_path):
+    """`cascade-calibrate`: labeled scores jsonl -> temperature + band
+    overrides."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    z = rng.normal(0, 1.2, 200)
+    probs = 1 / (1 + np.exp(-z * 2.0))  # over-sharpened
+    labels = (rng.random(200) < 1 / (1 + np.exp(-z))).astype(int)
+    scores = tmp_path / "scores.jsonl"
+    with scores.open("w") as f:
+        for p, y in zip(probs, labels):
+            f.write(json.dumps({"prob": float(p), "label": int(y)}) + "\n")
+    out = tmp_path / "calib.json"
+    res = run_cli(
+        tmp_path, "cascade-calibrate", "--scores", str(scores),
+        "--target-escalation", "0.3", "--out", str(out), timeout=120,
+    )
+    rec = json.loads(out.read_text())
+    assert rec["temperature"] > 1.2  # softened back
+    lo, hi = rec["band"]
+    assert 0.0 <= lo < 0.5 < hi <= 1.0
+    assert abs(rec["dev_escalation_rate"] - 0.3) < 0.07
+    assert any(
+        ov.startswith("serve.cascade_band=") for ov in rec["overrides"]
+    )
+    assert res.returncode == 0
+
+
+def test_check_obs_schema_cascade_log(tmp_path):
+    """`check_obs_schema --cascade-log` accepts a well-formed cascade
+    serve_log and rejects one whose escalated entry lost its stage-2
+    attribution."""
+    from deepdfa_tpu.obs.slo import CASCADE_STAGES, STAGES, SloEngine
+
+    eng = SloEngine(stages=STAGES + CASCADE_STAGES)
+    eng.observe_request(
+        200, 0.01, extra={"cascade_stage1": 0.002, "cascade_stage2": 0.006}
+    )
+    good = tmp_path / "good.jsonl"
+    entries = [
+        {"request": {
+            "id": "r0", "status": 200, "latency_ms": 10.0,
+            "t_unix": 1.0, "stage": 2, "stage1_prob": 0.5,
+            "calibrated_prob": 0.5, "cascade_stage1_ms": 2.0,
+            "cascade_stage2_ms": 6.0,
+        }},
+        {"request": {
+            "id": "r1", "status": 200, "latency_ms": 3.0,
+            "t_unix": 1.5, "stage": 1, "stage1_prob": 0.9,
+            "calibrated_prob": 0.9, "cascade_stage1_ms": 2.0,
+        }},
+        {"serve": {"requests": 2.0},
+         "serve_slo": eng.snapshot(),
+         "cascade": {"requests": 2.0, "escalations": 1.0, "sheds": 0.0,
+                     "escalation_rate": 0.5,
+                     "stage2_steady_state_recompiles": 0}},
+    ]
+    good.write_text("".join(json.dumps(e) + "\n" for e in entries))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_schema.py"),
+         "--cascade-log", str(good)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+    bad_entries = [dict(entries[0]), entries[1], entries[2]]
+    bad_entries[0] = {"request": {
+        k: v for k, v in entries[0]["request"].items()
+        if k != "cascade_stage2_ms"
+    }}
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("".join(json.dumps(e) + "\n" for e in bad_entries))
+    res = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_obs_schema.py"),
+         "--cascade-log", str(bad)],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert res.returncode == 1
+    assert "cascade_stage2_ms" in res.stdout + res.stderr
+
+
+def test_bench_cascade_smoke(tmp_path):
+    """scripts/bench_cascade.py --smoke: the frontier acceptance drive —
+    cascade req/s strictly exceeds combined-only, AUC within the pinned
+    drift bound, quantized stage-2 under half the fp32 bytes, zero
+    steady-state recompiles across both family ladders (the script
+    itself raises on any violation; bench.py --child-cascade consumes
+    the same fn)."""
+    out = tmp_path / "cascade_bench.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_cascade.py"),
+         "--smoke", "--out", str(out)],
+        env=dict(os.environ, DEEPDFA_TPU_PLATFORM="cpu",
+                 JAX_PLATFORMS="cpu",
+                 DEEPDFA_TPU_STORAGE=str(tmp_path)),
+        cwd=REPO, capture_output=True, text=True, timeout=400,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-2000:]
+    record = json.loads(out.read_text())
+    assert record["metric"] == "cascade_req_per_sec"
+    assert record["cascade_speedup"] > 1.0
+    assert 0.0 < record["cascade_escalation_rate"] < 1.0
+    assert record["cascade_score_drift"] <= 0.05
+    assert record["quant_param_bytes_fraction"] < 0.5
+    assert record["quant_calibration_drift"] <= 0.05
+    assert record["cascade_steady_state_recompiles"] == 0
+    # the trained screen actually ranks (the drift metric's premise)
+    assert record["cascade_stage1_auc"] > 0.7
+    # gate round trip: the record passes the bench gate's new entries
+    from deepdfa_tpu.obs import bench_gate
+
+    verdict = bench_gate.gate(
+        {**record, "platform": "cpu"},
+        bench_gate.load_trajectory(REPO),
+    )
+    failed = [c for c in verdict["checks"] if not c["ok"]]
+    assert not [
+        c for c in failed
+        if c["metric"].startswith(("cascade_", "quant_"))
+    ], failed

@@ -456,13 +456,15 @@ def test_parse_tenants_rejects_bad_specs():
 def test_parse_model_spec():
     from deepdfa_tpu.fleet.replica import parse_model_spec
 
-    assert parse_model_spec("ggnn=/runs/a") == ("ggnn", "/runs/a", "best")
+    assert parse_model_spec("ggnn=/runs/a") == (
+        "ggnn", "deepdfa", "/runs/a", "best"
+    )
     assert parse_model_spec("ggnn=/runs/a:last") == (
-        "ggnn", "/runs/a", "last"
+        "ggnn", "deepdfa", "/runs/a", "last"
     )
     # a path colon only splits when the tail looks like a checkpoint
     # tag (no slash)
-    assert parse_model_spec("m=runs/x") == ("m", "runs/x", "best")
+    assert parse_model_spec("m=runs/x") == ("m", "deepdfa", "runs/x", "best")
     for bad in ("noequals", "=x", "name="):
         with pytest.raises(ValueError):
             parse_model_spec(bad)

@@ -1,0 +1,287 @@
+"""Quantized serving executables (serve/quant.py, docs/cascade.md).
+
+The load-bearing invariants:
+
+- per-channel symmetric int8 round-trips weights within the per-channel
+  scale's quantization step (one outlier channel cannot poison the
+  others);
+- a registry `tag@int8` entry restores REAL int8/bf16 params (the HBM
+  density win the per-entry param-bytes ledger measures), scores within
+  the drift bound of the fp32 entry through the SAME AOT machinery, and
+  never recompiles post-warmup;
+- an over-bound quantization is refused loudly with the offending param
+  paths named (CheckpointMismatch style), at load AND at hot swap.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.core import Config, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.serve import quant
+from deepdfa_tpu.serve.batcher import DynamicBatcher, GgnnExecutor
+
+NODE_BUDGET, EDGE_BUDGET = 2048, 8192
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    synth = generate(16, seed=3)
+    examples = to_examples(synth)
+    specs, vocabs = build_dataset(
+        examples, train_ids=range(16), limit_all=50, limit_subkeys=50
+    )
+    return examples, specs, vocabs
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    import jax
+
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+    ])
+    model = DeepDFA.from_config(
+        cfg.model, input_dim=cfg.data.feat.input_dim
+    )
+    params = model.init(
+        jax.random.key(0), pack([], 1, NODE_BUDGET, EDGE_BUDGET)
+    )
+    return cfg, model, params
+
+
+def _write_run(tmp_path, cfg, params, vocabs, dataset):
+    """Real run-dir artifacts (config.json + vocab + checkpoints/best)
+    without a training loop — the registry restore path's fixture."""
+    import jax
+
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    (paths.processed_dir(dataset) / f"vocab{cfg.data.feat.name}.json"
+     ).write_text(json.dumps({k: v.to_json() for k, v in vocabs.items()}))
+    run_dir = tmp_path / "runs" / cfg.run_name
+    run_dir.mkdir(parents=True, exist_ok=True)
+    config_mod.to_json(cfg, run_dir / "config.json")
+    CheckpointManager(run_dir / "checkpoints", monitor="val_loss").save(
+        "epoch-0001", jax.device_get(params), {"val_loss": 1.0}, step=1
+    )
+    return run_dir
+
+
+# ---------------------------------------------------------------------------
+# pure quantizer properties
+
+
+def test_per_channel_roundtrip_bounded_error(rng):
+    w = rng.normal(size=(16, 32)).astype(np.float32)
+    # one huge outlier CHANNEL: per-tensor scaling would flatten every
+    # other channel to ~zero; per-channel must keep them accurate
+    w[:, 7] *= 1000.0
+    q = quant.quantize_leaf(w)
+    assert q["int8"].dtype == np.int8
+    assert q["scale"].shape == (32,)
+    deq = q["int8"].astype(np.float32) * q["scale"]
+    per_channel_step = np.max(np.abs(w), axis=0) / 127.0
+    assert np.all(
+        np.max(np.abs(w - deq), axis=0) <= per_channel_step + 1e-7
+    )
+    # the non-outlier channels specifically stay tight
+    others = [j for j in range(32) if j != 7]
+    assert np.max(np.abs((w - deq)[:, others])) < 0.05
+
+
+def test_quantize_params_policy(rng):
+    """ndim>=2 floats -> int8 dicts; 1-d floats -> bf16; ints pass."""
+    import jax.numpy as jnp
+
+    params = {
+        "dense": {
+            "kernel": rng.normal(size=(8, 4)).astype(np.float32),
+            "bias": np.ones(4, np.float32),
+        },
+        "steps": np.int32(3),
+    }
+    qt = quant.quantize_params(params)
+    assert quant.is_quantized_leaf(qt["dense"]["kernel"])
+    assert qt["dense"]["bias"].dtype == jnp.bfloat16
+    assert qt["steps"] == 3
+    # bytes shrink: 8x4x4 + 4x4 = 144 fp32 -> 32 int8 + 16 scale + 8 bf16
+    assert quant.tree_bytes(qt) < 0.5 * quant.tree_bytes(params)
+    deq = quant.dequantize_params(qt)
+    assert deq["dense"]["bias"].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(deq["dense"]["kernel"]),
+        params["dense"]["kernel"], atol=0.05,
+    )
+
+
+def test_dequantize_inside_jit(rng):
+    """The serving contract: dequant runs under jit (tracer-safe) and
+    matches the eager dequant bit for bit."""
+    import jax
+
+    params = {"k": rng.normal(size=(6, 6)).astype(np.float32),
+              "b": rng.normal(size=(6,)).astype(np.float32)}
+    qt = quant.quantize_params(params)
+
+    def f(q):
+        d = quant.dequantize_params(q)
+        return d["k"] @ d["b"]
+
+    eager = np.asarray(f(qt))
+    jitted = np.asarray(jax.jit(f)(jax.device_put(qt)))
+    np.testing.assert_allclose(eager, jitted, rtol=1e-6)
+
+
+def test_check_drift_refuses_and_names_paths(rng):
+    params = {"layer": {"kernel": rng.normal(size=(8, 8)).astype(np.float32)}}
+    qt = quant.quantize_params(params)
+
+    def score(p, batch):
+        return 1 / (1 + np.exp(-(batch @ p["layer"]["kernel"]).sum(-1)))
+
+    batches = [rng.normal(size=(4, 8)).astype(np.float32)]
+    # generous bound passes and returns the measured drift
+    drift = quant.check_drift(score, params, qt, batches, bound=1.0)
+    assert 0.0 <= drift < 1.0
+    # impossible bound refuses, naming the quantized param path
+    with pytest.raises(quant.QuantizationError) as ei:
+        quant.check_drift(score, params, qt, batches, bound=1e-15)
+    assert "layer/kernel" in str(ei.value)
+    assert "quant_drift_bound" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# the registry @int8 entry, end to end
+
+
+def test_registry_int8_roundtrip_and_drift_bound(
+    tmp_path, monkeypatch, corpus, served_model
+):
+    import jax
+
+    from deepdfa_tpu.obs import ledger as obs_ledger
+    from deepdfa_tpu.serve.registry import ModelRegistry, RegistryError
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    _, specs, vocabs = corpus
+    cfg, model, params = served_model
+    cfg = config_mod.apply_overrides(
+        cfg, ['run_name="quant-reg"', 'data.dataset="quant-reg"']
+    )
+    run_dir = _write_run(tmp_path, cfg, params, vocabs, "quant-reg")
+
+    obs_ledger.enable()
+    try:
+        reg_fp = ModelRegistry(
+            run_dir, family="deepdfa", checkpoint="best", cfg=cfg
+        )
+        reg_q = ModelRegistry(
+            run_dir, family="deepdfa", checkpoint="best@int8", cfg=cfg
+        )
+        # the quantized tree actually serves int8 weights
+        leaves = jax.tree.leaves(reg_q.params())
+        assert any(
+            np.asarray(leaf).dtype == np.int8 for leaf in leaves
+        )
+        info = reg_q.info()
+        assert info["quantized"] == "int8"
+        assert info["quant_drift"] <= cfg.serve.quant_drift_bound
+        assert info["quant_param_bytes_fraction"] < 0.5
+        # the per-entry param-bytes ledger shows the density win,
+        # keyed by the @int8 alternate entry tag
+        led = obs_ledger.snapshot_or_none()
+        tags = led["params"]
+        fp_tag = "deepdfa:quant-reg:best"
+        q_tag = "deepdfa:quant-reg:best@int8"
+        assert tags[q_tag] < 0.5 * tags[fp_tag]
+    finally:
+        obs_ledger.disable()
+
+    # drift bound vs fp32 through the REAL AOT executables (not just
+    # the calibration pass), plus zero steady-state lowerings
+    ex_fp = GgnnExecutor(
+        reg_fp.model, reg_fp.params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=2,
+        params_transform=reg_fp.params_transform,
+    )
+    ex_q = GgnnExecutor(
+        reg_q.model, reg_q.params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=2,
+        params_transform=reg_q.params_transform,
+    )
+    ex_fp.warmup()
+    ex_q.warmup()
+    n0 = ex_q.jit_lowerings()
+    rows_fp = DynamicBatcher(ex_fp, queue_limit=32).score_all(specs[:8])
+    rows_q = DynamicBatcher(ex_q, queue_limit=32).score_all(specs[:8])
+    drift = max(
+        abs(a.result - b.result) for a, b in zip(rows_fp, rows_q)
+    )
+    assert drift <= cfg.serve.quant_drift_bound
+    assert ex_q.jit_lowerings() == n0
+
+    # an impossible bound is refused LOUDLY with the offending param
+    # paths named (CheckpointMismatch style)
+    tight = config_mod.apply_overrides(
+        cfg, ["serve.quant_drift_bound=1e-15"]
+    )
+    with pytest.raises(RegistryError) as ei:
+        ModelRegistry(
+            run_dir, family="deepdfa", checkpoint="best@int8", cfg=tight
+        )
+    msg = str(ei.value)
+    assert "quantization refused" in msg
+    assert "params/" in msg  # named param paths
+
+
+def test_registry_int8_hot_swap_keeps_quantizing(
+    tmp_path, monkeypatch, corpus, served_model
+):
+    """A hot swap on a quantized entry re-quantizes the NEW weights
+    (drift re-checked) without recompiling the executables."""
+    import jax
+
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    _, specs, vocabs = corpus
+    cfg, model, params = served_model
+    cfg = config_mod.apply_overrides(
+        cfg, ['run_name="quant-swap"', 'data.dataset="quant-swap"']
+    )
+    run_dir = _write_run(tmp_path, cfg, params, vocabs, "quant-swap")
+    reg = ModelRegistry(
+        run_dir, family="deepdfa", checkpoint="best@int8", cfg=cfg
+    )
+    executor = GgnnExecutor(
+        reg.model, reg.params,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        max_batch_graphs=2, params_transform=reg.params_transform,
+    )
+    executor.warmup()
+    n0 = executor.jit_lowerings()
+    batcher = DynamicBatcher(
+        executor, queue_limit=8, on_batch=reg.maybe_reload
+    )
+    [r1] = batcher.score_all([specs[0]])
+    params2 = jax.tree.map(lambda a: a + 0.05, jax.device_get(params))
+    CheckpointManager(run_dir / "checkpoints", monitor="val_loss").save(
+        "epoch-0002", params2, {"val_loss": 0.5}, step=2
+    )
+    [r2] = batcher.score_all([specs[0]])
+    assert reg.reloads == 1
+    assert r2.result != r1.result  # new (quantized) weights serve
+    leaves = jax.tree.leaves(reg.params())
+    assert any(np.asarray(leaf).dtype == np.int8 for leaf in leaves)
+    assert executor.jit_lowerings() == n0

@@ -101,6 +101,14 @@ def test_serve_smoke_http_round_trip(tmp_path):
     assert slo["requests_total"] >= len(report["scored"])
     assert "latency_ms" in slo["60s"]
 
+    # -- ISSUE 12: the cascade round trip rode the smoke — per-request
+    # stage verdicts, escalation accounting consistent, cascade stages
+    # windowed, zero stage-2 recompiles, schema-valid cascade log
+    casc = report["cascade"]
+    assert casc["ok"], casc
+    assert all(s.get("stage") in (1, 2) for s in report["scored"])
+    assert casc["log"]["ok"]
+
     # -- deep healthz ran the bounded backend probe
     assert report["deep_healthz_status"] == 200
     backend = report["deep_healthz_backend"]
