@@ -363,11 +363,16 @@ def _epoch_batches(
             "packer must be bound to the same corpus as `specs` — its "
             "plans index into the corpus it was constructed with"
         )
-    dp = mesh.shape.get("dp", 1)
+    # LOGICAL shards (parallel/sharding.py): the batch layout is keyed
+    # to train.mesh.num_shards (default: the dp size), so elastic
+    # topologies whose dp divides it consume identical batches
+    from deepdfa_tpu.parallel import sharding as sharding_mod
+
+    num_shards = sharding_mod.logical_shards(cfg.train.mesh, mesh)
     bcfg = cfg.data.batch
     batcher = dict(
-        num_shards=dp,
-        num_graphs=max(1, bcfg.graphs_per_batch // dp),
+        num_shards=num_shards,
+        num_graphs=max(1, bcfg.graphs_per_batch // num_shards),
         node_budget=bcfg.node_budget,
         edge_budget=bcfg.edge_budget,
         oversized="drop" if phase == "train" else "singleton",
@@ -456,8 +461,13 @@ def cmd_train(args) -> None:
     import jax
 
     from deepdfa_tpu.models import DeepDFA
-    from deepdfa_tpu.parallel import make_mesh
-    from deepdfa_tpu.train import GraphTrainer, RunLogger, positive_weight
+    from deepdfa_tpu.parallel import make_mesh, sharding as sharding_mod
+    from deepdfa_tpu.train import (
+        GraphTrainer,
+        NullRunLogger,
+        RunLogger,
+        positive_weight,
+    )
 
     cfg = _load_config(args)
     # under an NNI experiment, trial parameters override the config and
@@ -468,7 +478,14 @@ def cmd_train(args) -> None:
         cfg = config_mod.apply_overrides(cfg, nni_bridge.nni_overrides())
     split_specs = _load_graph_splits(cfg)
     run_dir = paths.runs_dir(cfg.run_name)
-    config_mod.to_json(cfg, run_dir / "config.json")
+    # multi-host bring-up (docs/sharding.md): jax.distributed init must
+    # precede the first device probe; single-writer artifacts (saved
+    # config, run log, checkpoints, step checkpoints) are owned by
+    # process 0 while every host runs the identical sharded steps
+    sharding_mod.init_runtime()
+    primary = sharding_mod.is_primary()
+    if primary:
+        config_mod.to_json(cfg, run_dir / "config.json")
 
     mesh = make_mesh(cfg.train.mesh)
     model = DeepDFA.from_config(cfg.model, input_dim=cfg.data.feat.input_dim)
@@ -519,7 +536,9 @@ def cmd_train(args) -> None:
             total_steps=len(batches0) * max(1, cfg.train.max_epochs),
         )
         state = trainer.init_state(batches0[0])
-        ckpts = trainer.make_checkpoints(run_dir / "checkpoints")
+        ckpts = sharding_mod.if_primary(
+            lambda: trainer.make_checkpoints(run_dir / "checkpoints")
+        )
 
         def val_batches():
             out = _epoch_batches(
@@ -538,7 +557,12 @@ def cmd_train(args) -> None:
         # watchdog — all off unless train.resilience.enabled
         from deepdfa_tpu.train.resilience import make_runner
 
-        res = make_runner(cfg, run_dir / "checkpoints-step")
+        # every host RESTORES from the shared step-checkpoint tree (a
+        # resume must re-align all hosts' state + cursor), but only
+        # process 0 writes it (docs/sharding.md)
+        res = make_runner(
+            cfg, run_dir / "checkpoints-step", read_only=not primary
+        )
         # deterministic fault injection for the resilience tests/harness
         # (scripts/fault_inject.py); armed only via DEEPDFA_FAULTS
         from deepdfa_tpu.testing.faults import injector_from_env
@@ -552,7 +576,7 @@ def cmd_train(args) -> None:
             )
             return injector.wrap(s) if injector is not None else s
 
-        with RunLogger(run_dir) as run_log:
+        with (RunLogger(run_dir) if primary else NullRunLogger()) as run_log:
             state = trainer.fit(
                 state,
                 train_stream,
@@ -574,7 +598,7 @@ def cmd_train(args) -> None:
             # pool close raises the session must still tear down —
             # exported env, signal handler, tracer flush
             obs_cm.__exit__(None, None, None)
-    best = ckpts.best_metrics()
+    best = ckpts.best_metrics() if ckpts is not None else None
     if best and cfg.train.monitor in best:
         nni_bridge.report_final(best[cfg.train.monitor])
     print("best:", best)
@@ -672,11 +696,9 @@ def cmd_test(args) -> None:
         def fwd(p, b):
             return model.apply(p, b)
 
-        import dataclasses as _dc
+        from deepdfa_tpu.parallel import sharding as sharding_mod
 
-        from deepdfa_tpu.train.loop import _squeeze_batch
-
-        local = _squeeze_batch(batches[0])
+        local = sharding_mod.split_logical(batches[0], 0)
         rec = profile_model(
             fwd,
             (params, local),
@@ -805,9 +827,15 @@ def cmd_train_combined(args) -> None:
     ds = cfg.data.dataset
     out_dir = paths.processed_dir(ds)
     run_dir = paths.runs_dir(cfg.run_name)
+    # multi-host bring-up + process-0 artifact ownership (docs/sharding.md)
+    from deepdfa_tpu.parallel import sharding as sharding_mod
+
+    sharding_mod.init_runtime()
+    primary = sharding_mod.is_primary()
     # run-config manifest, as cmd_train writes: localize/test restore
     # the checkpoint with the dims it was trained with (_load_run_config)
-    config_mod.to_json(cfg, run_dir / "config.json")
+    if primary:
+        config_mod.to_json(cfg, run_dir / "config.json")
     with (out_dir / "examples.pkl").open("rb") as f:
         examples = pickle.load(f)
     splits = json.loads((out_dir / "splits.json").read_text())
@@ -832,10 +860,11 @@ def cmd_train_combined(args) -> None:
             "kind": "hash", "vocab_size": tok.vocab_size,
             "t5_frame": arch == "t5",
         }
-    _cascade_mod.save_model_setup(
-        run_dir, "t5" if arch == "t5" else "combined", mcfg, tok_desc,
-        args.max_length,
-    )
+    if primary:
+        _cascade_mod.save_model_setup(
+            run_dir, "t5" if arch == "t5" else "combined", mcfg, tok_desc,
+            args.max_length,
+        )
 
     from deepdfa_tpu.graphs import GraphStore
 
@@ -1067,8 +1096,8 @@ def cmd_train_combined(args) -> None:
         import jax as _jax
 
         from deepdfa_tpu.models import DeepDFA
+        from deepdfa_tpu.parallel import sharding as sharding_mod
         from deepdfa_tpu.train import CheckpointManager
-        from deepdfa_tpu.train.loop import _squeeze_batch as _sq
         from deepdfa_tpu.graphs import pack_shards
 
         dd_model = DeepDFA.from_config(
@@ -1078,7 +1107,9 @@ def cmd_train_combined(args) -> None:
         # fits, whereas packing an arbitrary real graph raises BudgetExceeded
         # whenever it exceeds the tiny dummy budgets
         dummy = pack_shards([], 1, 1, 64, 256)
-        dd_params = dd_model.init(_jax.random.key(0), _sq(dummy))
+        dd_params = dd_model.init(
+            _jax.random.key(0), sharding_mod.split_logical(dummy, 0)
+        )
         ckpt_dir = Path(args.graph_checkpoint)
         if not ckpt_dir.exists():
             ckpt_dir = paths.runs_dir(args.graph_checkpoint) / "checkpoints"
@@ -1093,11 +1124,16 @@ def cmd_train_combined(args) -> None:
         sd = torch.load(args.pretrained, map_location="cpu")
         state = trainer.load_encoder(state, enc_import(enc_cfg, sd))
 
-    ckpts = trainer.make_checkpoints(run_dir / "checkpoints-combined")
+    ckpts = sharding_mod.if_primary(
+        lambda: trainer.make_checkpoints(run_dir / "checkpoints-combined")
+    )
     from deepdfa_tpu.testing.faults import injector_from_env
     from deepdfa_tpu.train.resilience import make_runner
 
-    res = make_runner(cfg, run_dir / "checkpoints-combined-step")
+    # every host restores the shared tree; process 0 writes it
+    res = make_runner(
+        cfg, run_dir / "checkpoints-combined-step", read_only=not primary
+    )
     injector = injector_from_env()
 
     def train_stream(epoch):
@@ -1126,7 +1162,7 @@ def cmd_train_combined(args) -> None:
             # session teardown even if the pool close raises (exported
             # env, signal handler, tracer flush + trace.json merge)
             obs_cm.__exit__(None, None, None)
-    print("best:", ckpts.best_metrics())
+    print("best:", ckpts.best_metrics() if ckpts is not None else None)
 
 
 def _gen_setup(args, cfg, total_steps=None):
@@ -1254,19 +1290,30 @@ def cmd_train_gen(args) -> None:
             if args.do_eval_bleu:
                 refs = genm.trim_at_eos(dev[2], tok.sep_id, tok.pad_id)
                 val_decode = (dev[1], refs)
-        ckpts = trainer.make_checkpoints(run_dir / "checkpoints-gen")
+        # process-0 artifact ownership (docs/sharding.md): every host
+        # trains the same sharded steps, one writes the checkpoints
+        from deepdfa_tpu.parallel import sharding as sharding_mod
+
+        sharding_mod.init_runtime()
+        primary = sharding_mod.is_primary()
+        ckpts = sharding_mod.if_primary(
+            lambda: trainer.make_checkpoints(run_dir / "checkpoints-gen")
+        )
         bleu_ckpts = (
             trainer.make_checkpoints(
                 run_dir / "checkpoints-gen-bleu",
                 monitor="val_bleu_em", mode="max",
             )
-            if args.do_eval_bleu
+            if args.do_eval_bleu and primary
             else None
         )
         from deepdfa_tpu.testing.faults import injector_from_env
         from deepdfa_tpu.train.resilience import make_runner
 
-        res = make_runner(cfg, run_dir / "checkpoints-gen-step")
+        # every host restores the shared tree; process 0 writes it
+        res = make_runner(
+            cfg, run_dir / "checkpoints-gen-step", read_only=not primary
+        )
         injector = injector_from_env()
         stream = train_batches
         if injector is not None:
@@ -1284,7 +1331,7 @@ def cmd_train_gen(args) -> None:
                 patience=args.patience,
                 resilience=res,
             )
-        print("best:", ckpts.best_metrics())
+        print("best:", ckpts.best_metrics() if ckpts is not None else None)
 
     if args.test_file:
         ex, test_src, test_tgt = load(args.test_file)
@@ -1822,9 +1869,11 @@ def cmd_serve(args) -> None:
         return
     cfg = _load_run_config(args)
     run_dir = paths.runs_dir(cfg.run_name)
+    from deepdfa_tpu.serve.registry import serve_mesh
+
     registry = ModelRegistry(
         run_dir, family=args.family, checkpoint=cfg.serve.checkpoint,
-        cfg=cfg,
+        cfg=cfg, mesh=serve_mesh(cfg),
     )
     service = ScoringService(registry, cfg)
     with obs.session(cfg, run_dir):
@@ -1879,12 +1928,12 @@ def cmd_scan(args) -> None:
     if args.no_incremental:
         cfg = config_mod.apply_overrides(cfg, ["scan.incremental=false"])
     run_dir = paths.runs_dir(cfg.run_name)
-    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.serve.registry import ModelRegistry, serve_mesh
     from deepdfa_tpu.serve.server import ScoringService
 
     registry = ModelRegistry(
         run_dir, family=args.family, checkpoint=cfg.serve.checkpoint,
-        cfg=cfg,
+        cfg=cfg, mesh=serve_mesh(cfg),
     )
     service = ScoringService(registry, cfg)
     try:

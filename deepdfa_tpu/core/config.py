@@ -411,6 +411,17 @@ class ServeConfig:
     # score, counted in serve/cascade_sheds) — under overload stage-2
     # escalations degrade before any stage-1 screen is refused
     cascade_shed_depth_fraction: float = 0.75
+    # -- unified sharding (parallel/sharding.py, docs/sharding.md)
+    # serve through a device mesh: params commit under the family's
+    # path-pattern sharding map (train.mesh.rules prepend) on a mesh of
+    # serve.mesh axes, batches replicate, and XLA/GSPMD partitions the
+    # AOT ladder programs — a sharded checkpoint serves without a
+    # reshape step. Default OFF: single-device placement, the serving
+    # path stays byte-identical
+    sharded: bool = False
+    mesh: MeshConfig = field(
+        default_factory=lambda: MeshConfig(dp=1)
+    )
 
 
 @dataclass(frozen=True)
@@ -532,13 +543,31 @@ class FleetConfig:
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Logical device mesh. Axis sizes of 1 collapse; -1 = all remaining."""
+    """Logical device mesh + the declarative sharding layer's knobs
+    (parallel/sharding.py, docs/sharding.md). Axis sizes of 1 collapse;
+    -1 = all remaining."""
 
     dp: int = -1  # data parallel (graph batches / example batches)
     tp: int = 1  # tensor parallel (transformer heads / mlp)
     sp: int = 1  # sequence parallel (ring attention)
     pp: int = 1  # pipeline parallel (encoder layer stages, GPipe schedule)
     ep: int = 1  # expert parallel (MoE experts, all_to_all dispatch)
+    # fsdp: weight-sharding axis for the path-pattern sharding maps
+    # (SNIPPETS-style `tp`/`fsdp` rules); consumed by the GSPMD serve
+    # path and any `rules` below — the shard_map train steps keep their
+    # documented per-axis layouts
+    fsdp: int = 1
+    # LOGICAL data shards: the fixed leading-axis layout of every packed
+    # batch. 0 = the mesh's dp size (the historical one-shard-per-device
+    # layout). Elastic runs pin this (e.g. 8) and pick dp from its
+    # divisors — every topology then consumes identical batches and the
+    # GGNN step-loss trajectory is bit-identical across dp
+    # (parallel/sharding.py, tests/test_sharding.py)
+    num_shards: int = 0
+    # extra sharding-map rules prepended to the family defaults:
+    # "pattern=axes" with `/`-joined param-path globs, e.g.
+    # "*/embedding=-,fsdp" (parallel/sharding.py:parse_rules)
+    rules: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)

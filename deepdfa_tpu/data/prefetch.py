@@ -302,8 +302,10 @@ def prefetch(
 
 
 def device_placer(mesh, spec=None) -> Callable[[T], T]:
-    """A `place` fn that device_puts a batch pytree with a NamedSharding
-    (leading axis over dp by default) — static pytree metadata fields are
+    """A `place` fn that device_puts a batch pytree through the unified
+    sharding layer (parallel/sharding.py:place_batch — leading axis over
+    dp by default, so a [num_shards, ...] batch spreads its logical
+    shards across the mesh) — static pytree metadata fields are
     untouched, so jit cache keys are unchanged.
 
     Batches whose leading axis is not divisible by the sharded mesh axes
@@ -313,8 +315,12 @@ def device_placer(mesh, spec=None) -> Callable[[T], T]:
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from deepdfa_tpu.parallel import sharding as sharding_mod
+
     spec = spec if spec is not None else P("dp")
-    sharding = NamedSharding(mesh, spec)
+    # built ONCE per placer: the hot path below does zero per-batch
+    # sharding construction (place_batch's single-sharding fast path)
+    named = NamedSharding(mesh, spec)
     first = spec[0] if len(spec) else None
     axes = (
         (first,) if isinstance(first, str)
@@ -335,13 +341,13 @@ def device_placer(mesh, spec=None) -> Callable[[T], T]:
                 raise ValueError(
                     f"batch leaf {name} has leading axis {shape[0]}, not "
                     f"divisible by mesh axes {axes} (size {divisor}) — "
-                    f"pack with num_shards={divisor} (train CLI: check "
-                    f"train.mesh.dp vs the batcher's num_shards)"
+                    f"pack with a num_shards this divides (train CLI: "
+                    f"check train.mesh.dp/num_shards vs the batcher)"
                 )
 
     def place(batch):
         if divisor > 1:
             _validate(batch)
-        return jax.device_put(batch, sharding)
+        return sharding_mod.place_batch(mesh, batch, named)
 
     return place

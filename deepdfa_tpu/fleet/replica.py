@@ -179,8 +179,14 @@ class ReplicaWorker:
             if run_dir == self.run_dir and family == self.family
             else None
         )
+        from deepdfa_tpu.serve.registry import serve_mesh
+
+        # the serve mesh follows THIS replica's config; co-served
+        # entries with cfg=None (registry loads the run's own config)
+        # inherit it too — one mesh per replica process
         registry = ModelRegistry(
-            run_dir, family=family, checkpoint=checkpoint, cfg=cfg
+            run_dir, family=family, checkpoint=checkpoint, cfg=cfg,
+            mesh=serve_mesh(self.cfg),
         )
         nbytes = param_bytes(registry.params())
         service = ScoringService(registry, registry.cfg)

@@ -215,6 +215,16 @@ def session(cfg, run_dir):
     if ocfg is None:
         yield
         return
+    # multi-host (parallel/sharding.py, docs/sharding.md): the trace
+    # dir, efficiency ledger, flight recorder, and xprof captures are
+    # single-writer resources — process 0 owns them, so an N-host run
+    # writes ONE telemetry tree instead of N racing copies (no-op gate
+    # in single-process runs)
+    from deepdfa_tpu.parallel import sharding as _sharding
+
+    if not _sharding.is_primary():
+        yield
+        return
     trace_dir = None
     if ocfg.trace:
         trace_dir = (

@@ -324,6 +324,15 @@ SCHEMA: tuple[str, ...] = (
     "fleet_latency_p50_ms", "fleet_warm_requests_per_sec",
     "fleet_steady_state_recompiles", "overload_factor",
     "shed_by_tenant/*",
+    # unified sharding layer (parallel/sharding.py, docs/sharding.md):
+    # mesh/* = the run's topology stamp (non-collapsed axis sizes,
+    # device/process counts, logical shards — publish_mesh gauges and
+    # the MULTICHIP record's per-mesh-shape sections); shard/* = the
+    # per-mesh-shape per-shard efficiency fields derived from the
+    # PR-10 ledger in dryrun_multichip (per-shard MFU vs ceiling, HBM
+    # watermarks, compile seconds) — axis/shape labels are
+    # data-dependent, so both are reviewed wildcards
+    "mesh/*", "shard/*",
     # bench-record ledger stamps (bench.py, gated in obs/bench_gate.py):
     # per-site MFU-vs-measured-ceiling map, total AOT compile wall time
     # (lower is better), and the interleaved-reps ledger overhead bound;

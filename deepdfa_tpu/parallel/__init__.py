@@ -23,6 +23,14 @@ from deepdfa_tpu.parallel.pipeline import (
     pipeline_encode,
     split_stages,
 )
+from deepdfa_tpu.parallel.sharding import (
+    Rule,
+    ShardingMap,
+    init_runtime,
+    is_primary,
+    parse_rules,
+    sharding_map_for,
+)
 from deepdfa_tpu.parallel.ring_attention import full_attention, ring_attention
 from deepdfa_tpu.parallel.ulysses import ulysses_attention
 
@@ -48,4 +56,10 @@ __all__ = [
     "merge_stages",
     "pipeline_encode",
     "split_stages",
+    "Rule",
+    "ShardingMap",
+    "init_runtime",
+    "is_primary",
+    "parse_rules",
+    "sharding_map_for",
 ]

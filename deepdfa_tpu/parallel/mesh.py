@@ -15,6 +15,9 @@ Axis conventions (any can be size 1 and collapse away):
        parallel/pipeline.py; activations ride ppermute between stages)
   ep — expert parallel: MoE experts (parallel/moe.py; experts shard over
        ep, tokens stay replicated, one psum assembles the outputs)
+  fsdp — weight sharding for the declarative per-param sharding maps
+       (parallel/sharding.py; `tp`/`fsdp` path-pattern rules, consumed
+       by the GSPMD serve path)
 """
 
 from __future__ import annotations
@@ -25,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepdfa_tpu.core.config import MeshConfig
 
-AXES = ("dp", "tp", "sp", "pp", "ep")
+AXES = ("dp", "tp", "sp", "pp", "ep", "fsdp")
 
 
 def maybe_init_distributed() -> bool:
@@ -73,6 +76,7 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
         sp=cfg.sp if cfg else 1,
         pp=getattr(cfg, "pp", 1) if cfg else 1,
         ep=getattr(cfg, "ep", 1) if cfg else 1,
+        fsdp=getattr(cfg, "fsdp", 1) if cfg else 1,
     )
     free = [ax for ax, s in sizes.items() if s == -1]
     fixed = int(np.prod([s for s in sizes.values() if s != -1]))

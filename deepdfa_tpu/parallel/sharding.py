@@ -1,0 +1,581 @@
+"""Unified declarative sharding layer: one mesh config drives pod-scale
+training AND serving for all three model families (docs/sharding.md).
+
+Before this module, the `NamedSharding`/`PartitionSpec`/`shard_map`
+plumbing lived as per-trainer copies (train/loop.py,
+train/combined_loop.py, train/gen_loop.py, train/clone_loop.py) and the
+serve executors placed params with a bare `device_put` — nothing in the
+stack could span a pod. This module centralizes:
+
+- **Path-pattern sharding maps** (`ShardingMap`): ordered glob rules
+  over `/`-joined parameter paths resolving to `PartitionSpec`s — the
+  `sharding_map` idiom of jetstream-style serving stacks (SNIPPETS [2]:
+  `tp`/`fsdp` axes keyed by param-path globs) — plus "stacked" rules
+  that shard a leading stacked-layer axis across `pp` (the GPipe stage
+  layout). `sharding_map_for(family, ...)` builds the family defaults;
+  `MeshConfig.rules` prepends operator overrides, so ONE config drives
+  every family in train and serve.
+
+- **Logical data shards** decoupled from the dp mesh size: a batch's
+  leading axis carries `num_shards` LOGICAL shards (a fixed data
+  layout); any mesh whose dp divides it consumes the same batches. Per
+  logical shard compute runs under `jax.vmap` inside the `shard_map`
+  block and reductions ride `gather_logical` — an ordered `all_gather`
+  to the fixed `[num_shards, ...]` layout followed by one fixed-shape
+  sum — so the loss/grad arithmetic has ONE reduction tree regardless
+  of dp. That is what makes the step-loss trajectory BIT-IDENTICAL
+  across dp topologies on the same device kind (pinned on the 8-virtual-
+  device CPU mesh, tests/test_sharding.py), which in turn makes elastic
+  resume exact: a `TrainState` checkpoint written at dp=8 restores onto
+  dp=4 or dp=1 and the merged trajectory is the uninterrupted one.
+  Cost: gradients transit as `[num_shards, ...]` (an `all_gather`
+  instead of a `psum`), i.e. num_shards x grad bytes of collective
+  traffic — negligible for the GGNN family this path serves; the
+  combined/t5 trainers keep their psum reductions (their tp/sp/pp grad
+  bookkeeping is documented in train/combined_loop.py).
+
+- **Multi-host bring-up**: `init_runtime()` (jax.distributed via
+  parallel/mesh.py:maybe_init_distributed) wired into the CLI train and
+  serve entry points, and `is_primary()` gating so obs/checkpoint
+  coordination (RunLogger, efficiency ledger, flight recorder, step
+  checkpoints) runs on process 0 only — N hosts write ONE run log, ONE
+  postmortem, ONE checkpoint tree.
+
+- **Elastic placement**: a `ShardingMap` resolves concrete
+  `NamedSharding`s for any mesh; `StepCheckpointer` resume re-places
+  restored host pytrees with the live trainer's shardings
+  (train/resilience.py:place_like), and `restore_for_inference` /
+  `ModelRegistry` commit restored params straight under the serving
+  map — a sharded checkpoint serves without a reshape step.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import dataclasses
+import logging
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepdfa_tpu.parallel.mesh import AXES, maybe_init_distributed
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ShardingMap",
+    "Rule",
+    "parse_rules",
+    "sharding_map_for",
+    "flat_path",
+    "param_paths",
+    "batch_shardings",
+    "place_batch",
+    "place_params",
+    "gather_logical",
+    "split_logical",
+    "check_logical_shards",
+    "logical_shards",
+    "init_runtime",
+    "is_primary",
+    "process_index",
+    "process_count",
+    "if_primary",
+    "mesh_record",
+    "publish_mesh",
+]
+
+
+# ---------------------------------------------------------------------------
+# path-pattern rules
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """`pattern` is an fnmatch glob over the `/`-joined parameter path
+    (`*` spans path separators, the SCHEMA convention); `spec` is the
+    PartitionSpec a matching leaf gets. First matching rule wins.
+    `final` rules (operator overrides from `MeshConfig.rules`) also
+    suppress any later `stacked` transform — a pinned path stays
+    pinned."""
+
+    pattern: str
+    spec: P
+    final: bool = False
+
+    def matches(self, path: str) -> bool:
+        return fnmatch.fnmatchcase(path, self.pattern)
+
+
+def flat_path(key_path) -> str:
+    """jax key path -> the `/`-joined coordinate the rules match (same
+    spelling as train/checkpoint.py CheckpointMismatch reports)."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in key_path)
+
+
+def param_paths(tree: Any) -> list[str]:
+    """Every leaf path of a params pytree in rule coordinates."""
+    return [
+        flat_path(kp)
+        for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+
+
+def _spec_axes(spec: P) -> list[str]:
+    out: list[str] = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.extend(str(e) for e in entry)
+        else:
+            out.append(str(entry))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingMap:
+    """Ordered path-pattern rules resolving a params pytree to
+    PartitionSpecs (and NamedShardings on a concrete mesh).
+
+    `stacked` rules fire AFTER the base spec resolves: a matching leaf's
+    leading dimension (the stacked-layer axis of the scan-stacked
+    encoder params) is resharded over `axis` — `P(axis, *spec[1:])` —
+    which is exactly the GPipe stage layout (train/combined_loop.py
+    class docstring)."""
+
+    rules: tuple[Rule, ...] = ()
+    default: P = P()
+    #: (pattern, axis): shard dim 0 of matching leaves over `axis`
+    stacked: tuple[tuple[str, str], ...] = ()
+
+    def spec_for(self, path: str) -> P:
+        spec = self.default
+        final = False
+        for rule in self.rules:
+            if rule.matches(path):
+                spec = rule.spec
+                final = rule.final
+                break
+        if final:
+            return spec
+        for pattern, axis in self.stacked:
+            if fnmatch.fnmatchcase(path, pattern):
+                spec = P(axis, *tuple(spec)[1:]) if len(spec) else P(axis)
+                break
+        return spec
+
+    def param_specs(self, tree: Any, mesh_shape: dict | None = None) -> Any:
+        """A pytree of PartitionSpecs matching `tree`'s structure.
+
+        With `mesh_shape` ({axis: size}), each resolved spec is FITTED
+        to its leaf: a dimension the rule shards but the leaf's size
+        does not divide falls back to replicated for that dim (and a
+        spec longer than the leaf's rank is trimmed) — glob rules like
+        `*/kernel` then shard every kernel that CAN shard instead of
+        dying on the one [64, 1] output head."""
+        if mesh_shape is None:
+            return jax.tree_util.tree_map_with_path(
+                lambda kp, _: self.spec_for(flat_path(kp)), tree
+            )
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: _fit_spec(
+                self.spec_for(flat_path(kp)),
+                tuple(getattr(leaf, "shape", ()) or ()),
+                mesh_shape,
+            ),
+            tree,
+        )
+
+    def shardings(self, mesh: Mesh, tree: Any) -> Any:
+        self.validate(mesh)
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            self.param_specs(tree, mesh_shape=dict(mesh.shape)),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def place(self, mesh: Mesh, tree: Any) -> Any:
+        """Commit a (host or device) params pytree under this map."""
+        return jax.device_put(tree, self.shardings(mesh, tree))
+
+    def validate(self, mesh: Mesh | None = None) -> None:
+        """Every referenced axis must be a declared mesh axis — a typo'd
+        rule fails at map build, not as an opaque XLA error mid-run."""
+        names = tuple(mesh.axis_names) if mesh is not None else AXES
+        for rule in self.rules:
+            for ax in _spec_axes(rule.spec):
+                if ax not in names:
+                    raise ValueError(
+                        f"sharding rule {rule.pattern!r}: unknown mesh "
+                        f"axis {ax!r} (axes: {names})"
+                    )
+        for pattern, axis in self.stacked:
+            if axis not in names:
+                raise ValueError(
+                    f"stacked rule {pattern!r}: unknown mesh axis "
+                    f"{axis!r} (axes: {names})"
+                )
+
+    def describe(self) -> dict:
+        """Loggable/healthz-able summary of the map."""
+        return {
+            "rules": [
+                {"pattern": r.pattern, "spec": str(r.spec)}
+                for r in self.rules
+            ],
+            "stacked": [
+                {"pattern": p, "axis": a} for p, a in self.stacked
+            ],
+            "default": str(self.default),
+        }
+
+
+def _fit_spec(spec: P, shape: tuple, mesh_shape: dict) -> P:
+    """Fit a rule spec to a concrete leaf: trim to rank, replicate any
+    dim whose size the spec's mesh-axis product does not divide."""
+    if not len(spec):
+        return spec
+    dims: list[Any] = []
+    for i, entry in enumerate(tuple(spec)[: len(shape)]):
+        if entry is None:
+            dims.append(None)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        size = 1
+        for ax in axes:
+            size *= int(mesh_shape.get(str(ax), 1))
+        dims.append(entry if size and shape[i] % size == 0 else None)
+    return P(*dims)
+
+
+def _parse_spec(text: str) -> P:
+    """`"tp,fsdp"` -> P("tp","fsdp"); `-` = None dim; `a+b` = a grouped
+    dim; empty -> replicated P()."""
+    text = text.strip()
+    if not text:
+        return P()
+    dims: list[Any] = []
+    for tok in text.split(","):
+        tok = tok.strip()
+        if tok in ("-", "None", ""):
+            dims.append(None)
+        elif "+" in tok:
+            dims.append(tuple(t.strip() for t in tok.split("+")))
+        else:
+            dims.append(tok)
+    return P(*dims)
+
+
+def parse_rules(rule_strings: Iterable[str]) -> tuple[Rule, ...]:
+    """The config spelling (`MeshConfig.rules`): each entry is
+    `pattern=spec` with spec per `_parse_spec` — e.g.
+    `encoder/embeddings/word/embedding=fsdp,-` or `*/kernel=-,tp`.
+    An empty spec (`pattern=`) pins a path replicated ahead of any
+    later rule."""
+    rules = []
+    for s in rule_strings:
+        if "=" not in s:
+            raise ValueError(
+                f"sharding rule must be 'pattern=axes', got {s!r}"
+            )
+        pattern, _, spec = s.partition("=")
+        rules.append(Rule(pattern.strip(), _parse_spec(spec)))
+    return tuple(rules)
+
+
+# ---------------------------------------------------------------------------
+# family defaults: the ONE map per model family
+
+
+def _flat_rules(prefix: str, spec_tree: Any) -> list[Rule]:
+    """Flatten a pytree of PartitionSpecs into exact-path rules."""
+    return [
+        Rule(f"{prefix}{flat_path(kp)}", spec)
+        for kp, spec in jax.tree_util.tree_flatten_with_path(
+            spec_tree, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    ]
+
+
+def sharding_map_for(
+    family: str,
+    model_cfg: Any = None,
+    mesh_shape: dict | None = None,
+    extra_rules: Sequence[str] = (),
+) -> ShardingMap:
+    """The family's default sharding map on a mesh of `mesh_shape`
+    ({axis: size}; size-1 axes collapse their rules away so a 1-device
+    mesh resolves everything replicated — single-chip and pod share one
+    code path).
+
+    - "deepdfa" / "gen" / "clone": replicated params (the GGNN/seq2seq
+      trees are small); with `fsdp` > 1 the embedding tables and dense
+      kernels shard their trailing dim over `fsdp` (the SNIPPETS [2]
+      layout) — consumed by the GSPMD serve path, where XLA inserts the
+      gathers (the shard_map train step keeps params replicated).
+    - "combined" / "t5": the Megatron layer table
+      (models/transformer.py:tp_layer_specs / models/t5.py) over `tp`,
+      the T5 rel_bias heads over `tp`, MoE experts over `ep`, and the
+      stacked encoder layer axis over `pp` via a stacked rule.
+
+    `extra_rules` (from `MeshConfig.rules`) PREPEND, so an operator
+    override beats any family default."""
+    shape = dict(mesh_shape or {})
+    tp = shape.get("tp", 1) > 1
+    pp = shape.get("pp", 1) > 1
+    ep = shape.get("ep", 1) > 1
+    fsdp = shape.get("fsdp", 1) > 1
+    # operator rules are FINAL: they beat family defaults AND the pp
+    # stacked transform, so `pattern=` genuinely pins a path
+    rules: list[Rule] = [
+        dataclasses.replace(r, final=True) for r in parse_rules(extra_rules)
+    ]
+    stacked: list[tuple[str, str]] = []
+    if family in ("deepdfa", "gen", "clone"):
+        if fsdp:
+            rules += [
+                Rule("*/embedding", P(None, "fsdp")),
+                Rule("*/kernel", P(None, "fsdp")),
+            ]
+    elif family in ("combined", "t5"):
+        if tp:
+            if family == "t5":
+                from deepdfa_tpu.models import t5 as t5m
+
+                rules += _flat_rules("encoder/layers/", t5m.tp_layer_specs())
+                rules.append(Rule("encoder/rel_bias", P(None, "tp")))
+            else:
+                from deepdfa_tpu.models import transformer as tfm
+
+                rules += _flat_rules("encoder/layers/", tfm.tp_layer_specs())
+        if ep:
+            from deepdfa_tpu.parallel.moe import moe_param_specs
+
+            rules += _flat_rules("moe/", moe_param_specs())
+        if pp:
+            stacked.append(("encoder/layers/*", "pp"))
+    else:
+        raise ValueError(
+            f"unknown model family {family!r}; known: deepdfa, gen, "
+            f"clone, combined, t5"
+        )
+    return ShardingMap(rules=tuple(rules), stacked=tuple(stacked))
+
+
+# ---------------------------------------------------------------------------
+# sharded H2D placement (the ONE device_put helper)
+
+
+def batch_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    """PartitionSpec pytree -> NamedSharding pytree on `mesh`."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def place_batch(mesh: Mesh, batch: Any, specs: Any = None) -> Any:
+    """Sharded H2D copy with the exact specs the step consumes — the one
+    helper behind CombinedTrainer.place_batch and the prefetch
+    pipeline's device_placer. `specs` is a single PartitionSpec /
+    NamedSharding applied to every leaf (the common hot path — built
+    ONCE by the caller, zero per-batch pytree work) or a per-leaf spec
+    pytree; default: leading axis over dp (the logical-shard layout).
+    Static pytree metadata is untouched so jit cache keys are stable."""
+    if specs is None:
+        specs = P(("dp",))
+    if isinstance(specs, P):
+        specs = NamedSharding(mesh, specs)
+    if isinstance(specs, NamedSharding):
+        return jax.device_put(batch, specs)
+    return jax.device_put(batch, batch_shardings(mesh, specs))
+
+
+def place_params(
+    mesh: Mesh, tree: Any, sharding_map: ShardingMap | None = None
+) -> Any:
+    """Commit a params pytree under a map's resolved shardings
+    (replicated default) — the registry/restore-time half of elastic
+    placement."""
+    smap = sharding_map if sharding_map is not None else ShardingMap()
+    return smap.place(mesh, tree)
+
+
+# ---------------------------------------------------------------------------
+# logical shards: a data layout fixed across dp topologies
+
+
+def check_logical_shards(num_shards: int, mesh: Mesh) -> int:
+    """Validate the [num_shards, ...] layout against the mesh's dp size;
+    returns shards-per-device. The clear error here replaces XLA's
+    opaque non-divisible-sharding failure."""
+    dp = mesh.shape.get("dp", 1)
+    if num_shards % dp:
+        raise ValueError(
+            f"{num_shards} logical shards not divisible by mesh dp={dp} "
+            f"— elastic topologies must keep num_shards fixed and pick "
+            f"dp from its divisors (docs/sharding.md)"
+        )
+    return num_shards // dp
+
+
+def logical_shards(mesh_cfg, mesh: Mesh) -> int:
+    """The run's logical shard count: `MeshConfig.num_shards`, or the
+    mesh's dp size when unset (the historical layout, one shard per
+    device). Elastic runs SET num_shards so every topology consumes
+    identical batches."""
+    n = int(getattr(mesh_cfg, "num_shards", 0) or 0)
+    return n if n > 0 else mesh.shape.get("dp", 1)
+
+
+def split_logical(batch: Any, index) -> Any:
+    """Leaf-wise select of one logical shard from a [k, ...] local
+    block (static pytree metadata untouched)."""
+    return jax.tree.map(lambda x: x[index], batch)
+
+
+def gather_logical(x, axis_name: str = "dp"):
+    """Ordered all_gather of per-logical-shard values to the FIXED
+    [num_shards, ...] layout — the same array regardless of how many
+    devices contributed, so the downstream sum has one reduction tree
+    on every topology (the bit-identity mechanism; module docstring)."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# multi-host bring-up + process-0 coordination
+
+
+def init_runtime() -> bool:
+    """Multi-host JAX init for the CLI entry points (train AND serve):
+    no-op single-process, `jax.distributed.initialize()` under a
+    multi-process runtime (parallel/mesh.py:maybe_init_distributed).
+    Must run before the first `jax.devices()` probe so the mesh spans
+    every host."""
+    return maybe_init_distributed()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """True on the obs/checkpoint coordinator (process 0). Everything
+    with a single-writer contract — RunLogger, checkpoint manifests,
+    step checkpoints, the efficiency ledger, the flight recorder,
+    heartbeat files — is gated on this, so an N-host run writes one of
+    each instead of N racing copies."""
+    return jax.process_index() == 0
+
+
+def if_primary(make: Callable[[], Any], fallback: Any = None) -> Any:
+    """Build a single-writer resource on process 0 only."""
+    return make() if is_primary() else fallback
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+
+def mesh_record(mesh: Mesh, num_shards: int | None = None) -> dict:
+    """The topology stamp manifests and MULTICHIP records carry:
+    non-collapsed axis sizes, device/process counts, logical shards."""
+    out = {
+        "axes": {
+            ax: int(size) for ax, size in mesh.shape.items() if size > 1
+        },
+        "devices": int(mesh.devices.size),
+        "processes": int(jax.process_count()),
+    }
+    if num_shards is not None:
+        out["num_shards"] = int(num_shards)
+    return out
+
+
+def validate_multichip(doc: dict) -> dict:
+    """Validate a MULTICHIP record (the `{"multichip": ...}` JSON line
+    `__graft_entry__.py:dryrun_multichip` prints — found either raw or
+    under a driver artifact's `parsed` field). The record is the
+    multi-chip BENCH gate's input, so its shape is contract-checked
+    like every other emitted artifact (`scripts/check_obs_schema.py
+    --multichip`): topology stamp per mesh shape, per-shard ledger
+    fields, the serve ladder's zero-recompile pin, and every flattened
+    scalar tag declared in obs/metrics.py:SCHEMA under `mesh/*` /
+    `shard/*`."""
+    problems: list[str] = []
+    rec = doc
+    if isinstance(rec, dict) and "parsed" in rec:
+        rec = rec.get("parsed") or {}
+    if isinstance(rec, dict) and "multichip" in rec:
+        rec = rec["multichip"]
+    if not isinstance(rec, dict):
+        return {"ok": False, "problems": ["no multichip record found"]}
+    for key, typ in (
+        ("n_devices", int), ("num_shards", int),
+        ("mesh_shapes", dict), ("shard", dict), ("hbm", dict),
+        ("compile_seconds_total", (int, float)),
+    ):
+        if not isinstance(rec.get(key), typ):
+            problems.append(f"missing/mistyped field: {key}")
+    shapes = rec.get("mesh_shapes") or {}
+    if isinstance(shapes, dict) and not shapes:
+        problems.append("mesh_shapes is empty")
+    for name, stamp in (shapes or {}).items():
+        for key in ("axes", "devices", "processes", "num_shards"):
+            if key not in (stamp or {}):
+                problems.append(f"mesh_shapes/{name} missing {key}")
+    shard = rec.get("shard") or {}
+    if isinstance(shard, dict) and not shard:
+        problems.append("shard section is empty (ledger off?)")
+    for label, site in (shard or {}).items():
+        for key in ("compile_seconds", "executions"):
+            if key not in (site or {}):
+                problems.append(f"shard/{label} missing {key}")
+    serve = rec.get("serve")
+    if not isinstance(serve, dict):
+        problems.append("missing serve section")
+    else:
+        if serve.get("steady_state_recompiles") != 0:
+            problems.append(
+                "serve.steady_state_recompiles != 0 — the warmed "
+                "sharded ladder recompiled"
+            )
+        if not serve.get("ladder"):
+            problems.append("serve.ladder is empty")
+    # every scalar tag the record would flatten to must be declared
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    undeclared = obs_metrics.undeclared_tags([{
+        "mesh": shapes,
+        "shard": {**(shard or {}), "hbm": rec.get("hbm") or {}},
+    }])
+    problems.extend(f"undeclared tag: {t}" for t in undeclared)
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "n_devices": rec.get("n_devices"),
+        "mesh_shapes": sorted(shapes or ()),
+        "shard_sites": len(shard or ()),
+    }
+
+
+def publish_mesh(mesh: Mesh, num_shards: int | None = None) -> None:
+    """Mirror the topology into `mesh/*` gauges (SCHEMA-declared) so
+    obs-enabled runs carry it in the run log."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    r = obs_metrics.REGISTRY
+    for ax, size in mesh.shape.items():
+        if size > 1:
+            r.gauge(f"mesh/{ax}").set(size)
+    r.gauge("mesh/devices").set(mesh.devices.size)
+    r.gauge("mesh/processes").set(jax.process_count())
+    if num_shards is not None:
+        r.gauge("mesh/num_shards").set(num_shards)

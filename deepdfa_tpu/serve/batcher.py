@@ -142,7 +142,14 @@ class GgnnExecutor:
         feat_width: int | None = None,
         etypes: bool = False,
         params_transform: Callable[[Any], Any] | None = None,
+        mesh=None,
     ):
+        """mesh: an optional serve mesh (parallel/sharding.py,
+        docs/sharding.md) — batches replicate over it and params arrive
+        from `params_fn` already committed under the registry's resolved
+        sharding map, so the AOT ladder compiles GSPMD-partitioned
+        programs with the same signatures (zero-recompile contract
+        unchanged). None = the historical single-device placement."""
         import jax
 
         self.model = model
@@ -151,6 +158,12 @@ class GgnnExecutor:
         self.edge_budget = int(edge_budget)
         self.sizes = _pow2_sizes(int(max_batch_graphs))
         self.etypes = bool(etypes)
+        self.mesh = mesh
+        self._batch_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._batch_sharding = NamedSharding(mesh, PartitionSpec())
         if feat_width is None:
             from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
 
@@ -171,6 +184,13 @@ class GgnnExecutor:
 
     #: efficiency-ledger site for this executor's compiles/executions
     ledger_tag = "serve_score"
+
+    def _place(self, batch):
+        import jax
+
+        if self._batch_sharding is not None:
+            return jax.device_put(batch, self._batch_sharding)
+        return jax.device_put(batch)
 
     # -- grouping ------------------------------------------------------------
 
@@ -232,7 +252,7 @@ class GgnnExecutor:
             if size in self._compiled:
                 continue
             t0 = time.perf_counter()
-            batch = jax.device_put(self._dummy_batch(size))
+            batch = self._place(self._dummy_batch(size))
             self._compiled[size] = self._score_jit.lower(
                 params, batch
             ).compile()
@@ -266,7 +286,7 @@ class GgnnExecutor:
             list(chunk), size, self.node_budget, self.edge_budget,
             feat_width=self.feat_width, etypes=self.etypes,
         )
-        batch = jax.device_put(batch)
+        batch = self._place(batch)
         fn = self._compiled.get(size, self._score_jit)
         probs = fn(self.params_fn(), batch)
         out = np.asarray(jax.device_get(probs))[: len(chunk)]
@@ -296,11 +316,18 @@ class CombinedExecutor:
         edge_budget: int,
         is_t5: bool = False,
         params_transform: Callable[[Any], Any] | None = None,
+        mesh=None,
     ):
         import jax
 
         from deepdfa_tpu.data.text import rows_for_bucket
 
+        self.mesh = mesh
+        self._batch_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._batch_sharding = NamedSharding(mesh, PartitionSpec())
         self.model_cfg = model_cfg
         self.params_fn = params_fn
         self.tok = tokenizer
@@ -346,6 +373,13 @@ class CombinedExecutor:
         self._lowerings = 0
 
     ledger_tag = "serve_combined"
+
+    def _place(self, batch):
+        import jax
+
+        if self._batch_sharding is not None:
+            return jax.device_put(batch, self._batch_sharding)
+        return jax.device_put(batch)
 
     def ledger_signature(self, key: Hashable, n: int) -> str:
         T = int(key)
@@ -441,7 +475,7 @@ class CombinedExecutor:
             if T in self._compiled:
                 continue
             t0 = time.perf_counter()
-            batch = jax.device_put(self._collate(T, []))
+            batch = self._place(self._collate(T, []))
             self._compiled[T] = self._score_jit.lower(
                 params, batch
             ).compile()
@@ -463,7 +497,7 @@ class CombinedExecutor:
         import jax
 
         t0 = time.perf_counter()
-        batch = jax.device_put(self._collate(int(key), chunk))
+        batch = self._place(self._collate(int(key), chunk))
         fn = self._compiled.get(int(key), self._score_jit)
         probs = fn(self.params_fn(), batch)
         out = np.asarray(jax.device_get(probs))[: len(chunk)]

@@ -225,6 +225,7 @@ def build_combined_service_parts(
         node_budget=node_budget, edge_budget=edge_budget,
         is_t5=(registry.family == "t5"),
         params_transform=registry.params_transform,
+        mesh=getattr(registry, "mesh", None),
     )
     return frontend, executor
 
@@ -282,11 +283,14 @@ class CascadeStage2:
             "serve.request_log=false",
             "serve.hot_swap=false",
         ])
+        from deepdfa_tpu.serve.registry import serve_mesh
+
         registry = ModelRegistry(
             stage2_dir,
             family=scfg.cascade_family,
             checkpoint=scfg.cascade_checkpoint,
             cfg=s2cfg,
+            mesh=serve_mesh(s2cfg),
         )
         return cls(
             ScoringService(registry, s2cfg),

@@ -134,8 +134,11 @@ def run_score(
     )
 
     run_dir = Path(run_dir)
+    from deepdfa_tpu.serve.registry import serve_mesh
+
     registry = ModelRegistry(
-        run_dir, family=family, checkpoint=cfg.serve.checkpoint, cfg=cfg
+        run_dir, family=family, checkpoint=cfg.serve.checkpoint, cfg=cfg,
+        mesh=serve_mesh(cfg),
     )
     service = ScoringService(registry, cfg)
     try:

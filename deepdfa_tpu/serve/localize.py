@@ -51,11 +51,21 @@ class GgnnLocalizer:
         feat_width: int | None = None,
         etypes: bool = False,
         params_transform: Callable[[Any], Any] | None = None,
+        mesh=None,
     ):
         import jax
 
         from deepdfa_tpu.eval.localize import ggnn_score_fn
 
+        # serve mesh (parallel/sharding.py): batches replicate, params
+        # arrive registry-committed under the sharding map — same
+        # contract as the scoring executor
+        self.mesh = mesh
+        self._batch_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._batch_sharding = NamedSharding(mesh, PartitionSpec())
         self.model = model
         self.params_fn = params_fn
         self.node_budget = int(node_budget)
@@ -91,6 +101,13 @@ class GgnnLocalizer:
 
     # -- compilation (the GgnnExecutor warmup contract) -----------------------
 
+    def _place(self, batch):
+        import jax
+
+        if self._batch_sharding is not None:
+            return jax.device_put(batch, self._batch_sharding)
+        return jax.device_put(batch)
+
     def _dummy_batch(self, size: int):
         from deepdfa_tpu.graphs.batch import pack
 
@@ -115,7 +132,7 @@ class GgnnLocalizer:
             if size in self._compiled:
                 continue
             t0 = time.perf_counter()
-            batch = jax.device_put(self._dummy_batch(size))
+            batch = self._place(self._dummy_batch(size))
             self._compiled[size] = self._fn_jit.lower(
                 params, batch
             ).compile()
@@ -172,7 +189,7 @@ class GgnnLocalizer:
             self.node_budget, self.edge_budget,
             feat_width=self.feat_width, etypes=self.etypes,
         )
-        batch = jax.device_put(batch)
+        batch = self._place(batch)
         fn = self._compiled.get(size, self._fn_jit)
         with obs_trace.span(
             "localize_execute", cat="serve", signature=str(size),

@@ -136,6 +136,24 @@ def load_vocabs(cfg: Config) -> tuple[dict, str]:
     return vocabs, hashlib.sha256(raw).hexdigest()[:16]
 
 
+def serve_mesh(cfg: Config):
+    """The serving mesh when `cfg.serve.sharded` (parallel/sharding.py,
+    docs/sharding.md): multi-host init + a mesh over `cfg.serve.mesh`
+    axes; None otherwise — the historical single-device placement, so
+    the default path is untouched. One helper so `score`/`serve`/scan/
+    cascade-stage-2/fleet-replica registries all build the mesh the
+    same way."""
+    if not getattr(cfg.serve, "sharded", False):
+        return None
+    from deepdfa_tpu.parallel import make_mesh
+    from deepdfa_tpu.parallel import sharding as sharding_mod
+
+    sharding_mod.init_runtime()
+    mesh = make_mesh(cfg.serve.mesh)
+    sharding_mod.publish_mesh(mesh)
+    return mesh
+
+
 class ModelRegistry:
     """Restores and holds the serving state for one run.
 
@@ -152,7 +170,15 @@ class ModelRegistry:
         checkpoint: str = "best",
         cfg: Config | None = None,
         model_cfg: Any = None,
+        mesh: Any = None,
     ):
+        """mesh: an optional serve mesh (cfg.serve.sharded +
+        cfg.serve.mesh, parallel/sharding.py) — restored params commit
+        under the family's resolved sharding map (train.mesh.rules
+        prepend), so a checkpoint written on ANY training topology
+        serves sharded without a reshape step; hot swaps re-place under
+        the same map (zero recompiles, the executables' input shardings
+        never change)."""
         if family not in CKPT_DIR_BY_FAMILY:
             raise RegistryError(
                 f"unknown model family {family!r}; "
@@ -195,6 +221,30 @@ class ModelRegistry:
             )
         self.config_digest = config_digest(self.cfg)
         self.vocabs, self.vocab_digest = load_vocabs(self.cfg)
+        self.mesh = mesh
+        self.sharding_map = None
+        if mesh is not None:
+            from deepdfa_tpu.parallel import sharding as sharding_mod
+
+            self.sharding_map = sharding_mod.sharding_map_for(
+                family,
+                model_cfg=self.model_cfg,
+                mesh_shape=dict(mesh.shape),
+                extra_rules=getattr(self.cfg.train.mesh, "rules", ()),
+            )
+            if self.quant_mode and self.sharding_map.rules:
+                # quantized trees replace weight leaves with
+                # {int8, scale} marker dicts, so path rules written for
+                # the fp32 layout ('*/kernel') never match them — the
+                # entry serves REPLICATED over the mesh. Loud, not
+                # silent: the operator asked for both and gets the
+                # unsupported-combination truth
+                logger.warning(
+                    "serve.sharded + %s: sharding-map rules do not "
+                    "match quantized leaf paths (…/kernel/int8); the "
+                    "quantized entry serves replicated over the mesh",
+                    self.checkpoint,
+                )
         self._lock = threading.Lock()
         self._params = None
         self._loaded_step: int | None = None
@@ -287,9 +337,16 @@ class ModelRegistry:
                 )
             self._mgr = CheckpointManager(self.ckpt_dir)
         target = self._abstract_params()
+        # elastic placement (docs/sharding.md): plain entries restore
+        # STRAIGHT onto the serving mesh's resolved shardings; @int8
+        # entries restore to host first — quantization rewrites the tree
+        # before placement (_maybe_quantize -> _place)
+        shardings = None
+        if self.sharding_map is not None and not self.quant_mode:
+            shardings = self.sharding_map.shardings(self.mesh, target)
         try:
             return self._mgr.restore_for_inference(
-                self.base_checkpoint, target
+                self.base_checkpoint, target, shardings=shardings
             )
         except CheckpointMismatch as e:
             # name the CONFIG keys when the saved run config can tell us
@@ -418,13 +475,20 @@ class ModelRegistry:
         compiled programs; None for plain fp32 entries."""
         return quant.dequantize_params if self.quant_mode else None
 
-    def _load_initial(self) -> None:
+    def _place(self, params):
+        """Commit restored params: under the resolved sharding map on a
+        serve mesh, or the historical single-device placement."""
         import jax
 
+        if self.sharding_map is not None:
+            return self.sharding_map.place(self.mesh, params)
+        return jax.device_put(params)
+
+    def _load_initial(self) -> None:
         sig = self._manifest_sig()
         params = self._maybe_quantize(self._restore())
         with self._lock:
-            self._params = jax.device_put(params)
+            self._params = self._place(params)
             self._loaded_manifest_sig = sig
             self._loaded_step = sig[0] if sig else None
         self._ledger_params()
@@ -472,11 +536,9 @@ class ModelRegistry:
                 )
                 self._loaded_manifest_sig = sig
                 return False
-            import jax
-
             params = self._maybe_quantize(self._restore())
             with self._lock:
-                self._params = jax.device_put(params)
+                self._params = self._place(params)
                 self._loaded_manifest_sig = sig
                 self._loaded_step = sig[0]
             self._ledger_params()
@@ -509,4 +571,10 @@ class ModelRegistry:
                 quant_drift_bound=self.cfg.serve.quant_drift_bound,
                 quant_param_bytes_fraction=self.quant_bytes_fraction,
             )
+        if self.mesh is not None:
+            from deepdfa_tpu.parallel import sharding as sharding_mod
+
+            out["sharded"] = True
+            out["mesh"] = sharding_mod.mesh_record(self.mesh)
+            out["sharding_map"] = self.sharding_map.describe()
         return out

@@ -103,6 +103,10 @@ class ScoringService:
         # the quantized-entry dequant hook (serve/quant.py); getattr so
         # registry-shaped stubs (scripts/bench_load.py) keep working
         params_transform = getattr(registry, "params_transform", None)
+        # the serve mesh (serve.sharded, parallel/sharding.py): params
+        # are registry-committed under the sharding map; the executors
+        # replicate batches over the same mesh
+        mesh = getattr(registry, "mesh", None)
         self.localizer = None
         if registry.family == "deepdfa":
             # the ONE process-wide content-keyed feature store: a repo
@@ -122,6 +126,7 @@ class ScoringService:
                 feat_width=registry._feat_width(),
                 etypes=cfg.model.n_etypes > 1,
                 params_transform=params_transform,
+                mesh=mesh,
             )
             # line-level localization (serve.lines): the attribution
             # program AOT-compiled over the SAME warmup ladder, so
@@ -139,6 +144,7 @@ class ScoringService:
                     feat_width=registry._feat_width(),
                     etypes=cfg.model.n_etypes > 1,
                     params_transform=params_transform,
+                    mesh=mesh,
                 )
         else:
             from deepdfa_tpu.serve import cascade as cascade_mod

@@ -1,5 +1,5 @@
 from deepdfa_tpu.train.checkpoint import CheckpointManager
-from deepdfa_tpu.train.logging import RunLogger
+from deepdfa_tpu.train.logging import NullRunLogger, RunLogger
 from deepdfa_tpu.train.loop import GraphTrainer
 from deepdfa_tpu.train.resilience import (
     DivergenceError,
@@ -29,6 +29,7 @@ from deepdfa_tpu.train.state import TrainState, make_optimizer
 
 __all__ = [
     "CheckpointManager",
+    "NullRunLogger",
     "RunLogger",
     "GraphTrainer",
     "DivergenceError",

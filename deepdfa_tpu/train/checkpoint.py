@@ -220,7 +220,9 @@ class CheckpointManager:
             p.name for p in self.directory.iterdir() if p.is_dir()
         )
 
-    def restore_for_inference(self, tag: str, params_target: Any) -> Any:
+    def restore_for_inference(
+        self, tag: str, params_target: Any, shardings: Any = None
+    ) -> Any:
         """Params-only restore for serving (serve/registry.py).
 
         Accepts both checkpoint layouts this repo writes: the epoch
@@ -229,6 +231,12 @@ class CheckpointManager:
         latter only the `params` subtree is returned, the optimizer
         state is discarded (zero-filled placeholders satisfy orbax's
         full-structure restore; it is never device_put).
+
+        `shardings`: an optional NamedSharding pytree (a resolved
+        sharding map, parallel/sharding.py) the restored params are
+        committed under — elastic placement: a checkpoint written on
+        ANY training topology restores sharded for the serving mesh
+        with no reshape step (the host tree is topology-free).
 
         Structure problems raise `CheckpointMismatch` naming the
         missing/extra/mis-shaped parameter paths (and the config knobs
@@ -276,15 +284,21 @@ class CheckpointManager:
                 path, missing, unexpected, shape_mismatches
             )
         if not wrap:
-            return self._ckpt.restore(path, target=params_target)
-        full_target = {
-            k: (
-                params_target if k == "params"
-                else jax_tree_zeros(v)
-            )
-            for k, v in meta.items()
-        }
-        return self._ckpt.restore(path, target=full_target)["params"]
+            restored = self._ckpt.restore(path, target=params_target)
+        else:
+            full_target = {
+                k: (
+                    params_target if k == "params"
+                    else jax_tree_zeros(v)
+                )
+                for k, v in meta.items()
+            }
+            restored = self._ckpt.restore(path, target=full_target)["params"]
+        if shardings is not None:
+            import jax
+
+            restored = jax.device_put(restored, shardings)
+        return restored
 
     def best_metrics(self) -> dict[str, float] | None:
         best = self._manifest["best"]

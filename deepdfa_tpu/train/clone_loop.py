@@ -18,12 +18,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from deepdfa_tpu.parallel.compat import shard_map
 
 from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.models import t5_gen as gen
+from deepdfa_tpu.parallel import sharding
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
 from deepdfa_tpu.train.state import TrainState, make_optimizer
@@ -104,8 +105,16 @@ class CloneTrainer:
         self.clone_cfg = clone_cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.train.mesh)
         self.tx = make_optimizer(cfg.train.optim, total_steps)
-        self._param_sharding = NamedSharding(self.mesh, P())
+        # unified sharding layer (parallel/sharding.py): replicated on a
+        # dp mesh; MeshConfig.rules can reshard declaratively
+        self.sharding_map = sharding.sharding_map_for(
+            "clone", mesh_shape=dict(self.mesh.shape),
+            extra_rules=getattr(cfg.train.mesh, "rules", ()),
+        )
         self._build_steps()
+
+    def _place_params(self, params):
+        return self.sharding_map.place(self.mesh, params)
 
     def make_checkpoints(self, directory, monitor="val_f1", mode="max"):
         from deepdfa_tpu.train.checkpoint import CheckpointManager
@@ -115,11 +124,11 @@ class CloneTrainer:
     def init_state(self, seed: int | None = None) -> TrainState:
         seed = self.cfg.train.seed if seed is None else seed
         params = gen.init_clone_params(self.clone_cfg, jax.random.key(seed))
-        params = jax.device_put(params, self._param_sharding)
+        params = self._place_params(params)
         return TrainState.create(params, self.tx)
 
     def load_params(self, state: TrainState, params) -> TrainState:
-        params = jax.device_put(jax.device_get(params), self._param_sharding)
+        params = self._place_params(jax.device_get(params))
         return TrainState(
             params=params, opt_state=self.tx.init(params), step=state.step
         )
@@ -133,7 +142,7 @@ class CloneTrainer:
         # the clone path never uses the LM head
         s2s["decoder"].pop("lm_head", None)
         params["seq2seq"] = s2s
-        params = jax.device_put(params, self._param_sharding)
+        params = self._place_params(params)
         return TrainState(
             params=params, opt_state=self.tx.init(params), step=state.step
         )

@@ -21,13 +21,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from deepdfa_tpu.parallel.compat import shard_map
 
 from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.data.text import TextBatch
 from deepdfa_tpu.models import combined as cmb
+from deepdfa_tpu.parallel import sharding
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
 from deepdfa_tpu.train.state import TrainState, make_optimizer
@@ -188,56 +189,27 @@ class CombinedTrainer:
         return t5m.init_defect_params if self.is_t5 else cmb.init_params
 
     def _build_specs(self) -> None:
-        from deepdfa_tpu.models import t5 as t5m
-
-        def rep(tree):
-            return jax.tree.map(lambda _: P(), tree)
-
         # structure only — eval_shape avoids materializing a throwaway init
         init_fn = self._init_params_fn()
         example = jax.eval_shape(
             lambda: init_fn(self.model_cfg, jax.random.key(0))
         )
-        def stage_shard(layer_specs):
-            # the stacked layer axis (leading) shards across pp stages
-            return jax.tree.map(
-                lambda s: P("pp", *tuple(s)[1:]) if len(s) else P("pp"),
-                layer_specs,
-                is_leaf=lambda x: isinstance(x, P),
-            )
-
-        if self.is_t5:
-            enc_specs = rep(example["encoder"])
-            if self.tp:
-                enc_specs["layers"] = t5m.tp_layer_specs()
-                enc_specs["rel_bias"] = P(None, "tp")
-            if self.pp:
-                enc_specs["layers"] = stage_shard(enc_specs["layers"])
-        else:
-            layer_specs = (
-                cmb.tfm.tp_layer_specs()
-                if self.tp
-                else rep(example["encoder"]["layers"])
-            )
-            if self.pp:
-                layer_specs = stage_shard(layer_specs)
-            enc_specs = {
-                "embeddings": rep(example["encoder"]["embeddings"]),
-                "layers": layer_specs,
-            }
-        specs = {"encoder": enc_specs, "head": rep(example["head"])}
-        if "graph" in example:
-            specs["graph"] = rep(example["graph"])
-        if "moe" in example:
-            from deepdfa_tpu.parallel.moe import moe_param_specs
-
-            specs["moe"] = (
-                moe_param_specs() if self.ep else rep(example["moe"])
-            )
-        self.param_specs = specs
-        self.param_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
+        # the declarative per-param sharding layer (parallel/sharding.py,
+        # docs/sharding.md): the family's path-pattern rules — Megatron
+        # layer table over tp, T5 rel_bias heads, MoE experts over ep,
+        # the stacked layer axis over pp — resolved against the example
+        # tree; MeshConfig.rules prepend operator overrides. The SAME
+        # map drives the serve executors (serve/registry.py), so a
+        # sharded checkpoint serves without a reshape step.
+        self.sharding_map = sharding.sharding_map_for(
+            "t5" if self.is_t5 else "combined",
+            model_cfg=self.model_cfg,
+            mesh_shape=dict(self.mesh.shape),
+            extra_rules=getattr(self.cfg.train.mesh, "rules", ()),
+        )
+        self.param_specs = self.sharding_map.param_specs(example)
+        self.param_shardings = sharding.batch_shardings(
+            self.mesh, self.param_specs
         )
         # grad reduction axes per top-level group (see class docstring);
         # under pp the encoder group is split inline in _steps_for
@@ -485,13 +457,12 @@ class CombinedTrainer:
 
     def place_batch(self, batch: TextBatch) -> TextBatch:
         """Sharded H2D copy with the exact specs the shard_map consumes
-        (sp-sharded input_ids included)."""
-        shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s),
-            self._batch_specs(batch.graphs.num_graphs),
-            is_leaf=lambda x: isinstance(x, P),
+        (sp-sharded input_ids included) — the shared helper
+        (parallel/sharding.py:place_batch, also behind the prefetch
+        pipeline's device_placer)."""
+        return sharding.place_batch(
+            self.mesh, batch, self._batch_specs(batch.graphs.num_graphs)
         )
-        return jax.device_put(batch, shardings)
 
     def warmup(
         self,

@@ -140,3 +140,26 @@ class RunLogger:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class NullRunLogger:
+    """Non-primary-process stand-in (multi-host runs,
+    parallel/sharding.py:is_primary): the run log is a single-writer
+    resource owned by process 0; every other host logs nowhere while
+    running the identical training steps. Same context-manager surface
+    as RunLogger, writes nothing, creates nothing."""
+
+    has_tensorboard = False
+    nonfinite_dropped = 0
+
+    def log(self, record: dict) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullRunLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass

@@ -5,13 +5,20 @@ Replaces the reference's Lightning trainer stack
 
 - one jit-compiled `train_step` (train state donated) per static batch
   signature; the bucketed batcher guarantees a single signature per run.
-- data parallelism is shard_map over the `dp` mesh axis: each device gets a
-  whole-graph shard (leading axis from `pack_shards`), computes local
-  masked loss *sums* and gradient-of-sum, and `psum`s sums and counts —
-  the global mean is exact even when shards carry unequal graph counts
-  (unlike mean-of-shard-means). With a 1-device mesh the same code path
-  compiles to no collectives, so single-chip and multi-chip share one
-  implementation.
+- data parallelism rides the unified sharding layer
+  (parallel/sharding.py, docs/sharding.md): every batch carries a fixed
+  number of LOGICAL shards on its leading axis (from `pack_shards`),
+  shard_map over the `dp` mesh axis hands each device its block, and
+  per-shard masked loss *sums* / gradients-of-sum are computed under
+  `jax.vmap` — so a shard's compute never depends on how many devices
+  share the batch. Reductions are `gather_logical` (ordered all_gather
+  to the fixed [num_shards, ...] layout) + one fixed-shape sum instead
+  of a per-topology psum tree: the global mean stays exact under
+  unequal shard graph counts AND the step-loss trajectory is
+  BIT-IDENTICAL across dp topologies that divide num_shards — the
+  elastic-resume contract (tests/test_sharding.py). With a 1-device
+  mesh the same code path compiles to no collectives, so single-chip
+  and pod share one implementation.
 - metrics stream into host-side accumulators; eval loss is computed on
   device from logits (identical semantics to the training objective) and
   accumulated as an exact masked mean across batches.
@@ -28,10 +35,11 @@ from typing import Callable, Iterable
 import jax
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from deepdfa_tpu.core.config import Config
 from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS, GraphBatch
+from deepdfa_tpu.parallel import sharding
 from deepdfa_tpu.parallel.compat import shard_map
 from deepdfa_tpu.parallel.mesh import make_mesh
 from deepdfa_tpu.train.checkpoint import CheckpointManager
@@ -46,18 +54,6 @@ from deepdfa_tpu.train.metrics import BinaryClassificationMetrics
 from deepdfa_tpu.train.state import TrainState, make_optimizer
 
 logger = logging.getLogger(__name__)
-
-_ALL_AXES = ("dp", "tp", "sp")
-
-
-def _squeeze_batch(batch: GraphBatch) -> GraphBatch:
-    """Drop the unit leading (shard) axis inside shard_map."""
-    arrays = {
-        f.name: (v[0] if (v := getattr(batch, f.name)) is not None else None)
-        for f in dataclasses.fields(batch)
-        if f.name != "num_graphs"
-    }
-    return GraphBatch(**arrays, num_graphs=batch.num_graphs)
 
 
 def drop_known_feats(node_feats, key, rate: float):
@@ -124,10 +120,10 @@ class GraphTrainer:
 
     def init_state(self, example_batch: GraphBatch, seed: int | None = None) -> TrainState:
         seed = self.cfg.train.seed if seed is None else seed
-        local = _squeeze_batch(example_batch)
+        local = sharding.split_logical(example_batch, 0)
         params = self.model.init(jax.random.key(seed), local)
         state = TrainState.create(params, self.tx)
-        return jax.device_put(state, NamedSharding(self.mesh, P()))
+        return sharding.place_params(self.mesh, state)
 
     def make_checkpoints(self, directory) -> CheckpointManager:
         """CheckpointManager wired to the configured monitor metric."""
@@ -156,19 +152,14 @@ class GraphTrainer:
     def _build_steps(self) -> None:
         mesh = self.mesh
 
-        @partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(P(), P(("dp",)), P()),
-            out_specs=(P(), P()),
-            check_vma=False,
-        )
-        def _sharded_grads(params, batch, step):
-            local = _squeeze_batch(batch)
+        def _shard_loss_grads(params, local: GraphBatch, step):
+            """(loss sum, count, grads) for ONE logical shard — vmapped
+            over the device's shard block, so the per-shard program is
+            identical on every dp topology (docs/sharding.md)."""
             if self.feat_dropout > 0:
                 # deterministic per step (no RNG in TrainState, so
-                # checkpoints stay compatible); every dp shard applies
-                # the same positional mask to its local arrays —
+                # checkpoints stay compatible); every logical shard
+                # applies the same positional mask to its local arrays —
                 # augmentation, not a numerics contract
                 key = jax.random.fold_in(
                     jax.random.key(self.cfg.train.seed + 7919), step
@@ -187,13 +178,33 @@ class GraphTrainer:
             (loss_sum, count), grads = jax.value_and_grad(
                 loss_sum_fn, has_aux=True
             )(params)
-            loss_sum = jax.lax.psum(loss_sum, _ALL_AXES)
-            count = jax.lax.psum(count, _ALL_AXES)
-            denom = jax.numpy.maximum(count, 1.0)
+            return loss_sum, count, grads
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(("dp",)), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        def _sharded_grads(params, batch, step):
+            # batch leaves arrive as this device's [num_shards/dp, ...]
+            # block of logical shards; per-shard sums/grads gather to the
+            # FIXED [num_shards, ...] layout and reduce in one
+            # fixed-shape sum — one reduction tree on every topology
+            # (bit-identity across dp; parallel/sharding.py). tp/sp mesh
+            # members compute replicated-true, so no reduction there.
+            sums, counts, grads = jax.vmap(
+                lambda shard: _shard_loss_grads(params, shard, step)
+            )(batch)
+            counts = sharding.gather_logical(counts)
+            denom = jax.numpy.maximum(counts.sum(), 1.0)
+            loss = sharding.gather_logical(sums).sum() / denom
             grads = jax.tree.map(
-                lambda g: jax.lax.psum(g, _ALL_AXES) / denom, grads
+                lambda g: sharding.gather_logical(g).sum(axis=0) / denom,
+                grads,
             )
-            return loss_sum / denom, grads
+            return loss, grads
 
         @partial(jax.jit, donate_argnums=0)
         def train_step(state: TrainState, batch: GraphBatch):
@@ -222,12 +233,15 @@ class GraphTrainer:
             check_vma=False,
         )
         def _sharded_eval(params, batch):
-            local = _squeeze_batch(batch)
-            logits = self.model.apply(params, local)
-            labels, mask = self._labels_mask(local)
-            per = bce_elements(logits, labels, self.pos_weight)
-            probs = jax.nn.sigmoid(logits)
-            return probs[None], labels[None], mask[None], per[None]
+            def one(local):
+                logits = self.model.apply(params, local)
+                labels, mask = self._labels_mask(local)
+                per = bce_elements(logits, labels, self.pos_weight)
+                return jax.nn.sigmoid(logits), labels, mask, per
+
+            # [num_shards/dp, ...] per leaf locally; the dp out_specs
+            # reassemble the full [num_shards, ...] logical layout
+            return jax.vmap(one)(batch)
 
         @jax.jit
         def eval_step(params, batch: GraphBatch):
@@ -295,6 +309,13 @@ class GraphTrainer:
         start_epoch = skip_batches = 0
         cursor = None
         if res is not None:
+            # topology stamp for the resume manifest: elastic resume may
+            # change dp (bit-identical when num_shards is unchanged);
+            # maybe_resume warns loudly on a num_shards drift
+            res.set_topology(sharding.mesh_record(
+                self.mesh,
+                sharding.logical_shards(self.cfg.train.mesh, self.mesh),
+            ))
             state, cursor = res.maybe_resume(state, place_like(state))
             if cursor is not None:
                 start_epoch, skip_batches = cursor.epoch, cursor.batch_index

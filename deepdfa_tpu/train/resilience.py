@@ -469,9 +469,16 @@ class ResilientRunner:
         directory: str | Path | None = None,
         seed: int = 0,
         on_stall: Callable[[dict], None] | None = None,
+        read_only: bool = False,
     ):
+        """read_only: multi-host non-primary mode (docs/sharding.md) —
+        the runner RESTORES from the shared checkpoint directory (every
+        host must resume the same state and fast-forward the same
+        cursor, or the collectives diverge) but never writes: process 0
+        owns the saves and the resume manifest."""
         self.rcfg = rcfg
         self.seed = int(seed)
+        self.read_only = bool(read_only)
         self.ckpt = (
             StepCheckpointer(directory, keep_last=rcfg.keep_last_k)
             if directory is not None
@@ -503,6 +510,12 @@ class ResilientRunner:
         self.skipped_steps = 0
         self.rollbacks = 0
         self.resumed_from_step = 0
+        # topology stamp (parallel/sharding.py:mesh_record) the loops
+        # set before maybe_resume: rides every manifest so an ELASTIC
+        # resume (same num_shards, different dp) is distinguishable from
+        # a layout drift (different num_shards -> trajectory alignment
+        # broken, warned loudly)
+        self.topology: dict | None = None
 
     # -- context management ---------------------------------------------------
 
@@ -526,6 +539,11 @@ class ResilientRunner:
     def attach_stats(self, stats) -> None:
         if self.watchdog is not None:
             self.watchdog.attach_stats(stats)
+
+    def set_topology(self, topology: dict) -> None:
+        """Record the run's mesh/logical-shard layout for the resume
+        manifests (the elastic-resume audit trail)."""
+        self.topology = dict(topology)
 
     def lr_scale(self) -> float:
         """Effective LR multiplier (cooled down after rollbacks)."""
@@ -583,6 +601,29 @@ class ResilientRunner:
             self._lr_scale = float(guard.get("lr_scale", 1.0))
             self.rollbacks = int(guard.get("rollbacks", 0))
             self.skipped_steps = int(guard.get("skipped_steps", 0))
+        # elastic resume (docs/sharding.md): a dp change with the SAME
+        # num_shards restores bit-exactly (the logical-shard layout
+        # fixes the batch stream and the reduction tree); a num_shards
+        # drift breaks batch alignment — resume proceeds, but the
+        # trajectory contract is void, so say it loudly
+        saved_topo = manifest.get("mesh")
+        if saved_topo and self.topology:
+            saved_s = saved_topo.get("num_shards")
+            cur_s = self.topology.get("num_shards")
+            if saved_s is not None and cur_s is not None and saved_s != cur_s:
+                logger.warning(
+                    "elastic resume with num_shards %s -> %s: the batch "
+                    "layout changed, so the resumed trajectory is NOT "
+                    "the uninterrupted one (keep train.mesh.num_shards "
+                    "fixed across topologies for bit-exact resume)",
+                    saved_s, cur_s,
+                )
+            elif saved_topo.get("axes") != self.topology.get("axes"):
+                logger.info(
+                    "elastic resume across mesh shapes %s -> %s "
+                    "(num_shards unchanged: trajectory preserved)",
+                    saved_topo.get("axes"), self.topology.get("axes"),
+                )
         logger.info(
             "resumed from %s at step %d (epoch %d, batch %d)",
             manifest["tag"], cursor.step, cursor.epoch, cursor.batch_index,
@@ -655,22 +696,29 @@ class ResilientRunner:
 
     # -- internals ------------------------------------------------------------
 
-    def _save(self, state: Any, cursor: ResumeCursor, reason: str) -> Path:
+    def _save(
+        self, state: Any, cursor: ResumeCursor, reason: str
+    ) -> Path | None:
         import jax
 
+        if self.read_only:
+            return None  # non-primary host: process 0 owns the saves
         # the save itself (device_get sync + orbax commit) can be long
         # on big states/slow storage: announce it so the watchdog applies
         # the grace threshold instead of the per-step timeout
         self.heartbeat("checkpoint", step=cursor.step)
         # device_get syncs: the in-flight step is finished before the
         # bytes are captured (the preemption contract)
+        extra: dict = {"guard": {
+            "lr_scale": self._lr_scale,
+            "rollbacks": self.rollbacks,
+            "skipped_steps": self.skipped_steps,
+        }}
+        if self.topology is not None:
+            extra["mesh"] = self.topology
         return self.ckpt.save(
             jax.device_get(state), cursor, seed=self.seed, reason=reason,
-            extra={"guard": {
-                "lr_scale": self._lr_scale,
-                "rollbacks": self.rollbacks,
-                "skipped_steps": self.skipped_steps,
-            }},
+            extra=extra,
         )
 
     def _consume_ok(self, ok: Any, state: Any) -> Any:
@@ -737,14 +785,18 @@ class ResilientRunner:
 
 
 def make_runner(
-    cfg, directory: str | Path | None
+    cfg, directory: str | Path | None, read_only: bool = False
 ) -> ResilientRunner | None:
     """CLI helper: a runner when `cfg.train.resilience.enabled`, else
-    None (the loops then run the historical path untouched)."""
+    None (the loops then run the historical path untouched).
+    `read_only` is the multi-host non-primary mode: restore from the
+    shared directory, never write (parallel/sharding.py:is_primary)."""
     rcfg = cfg.train.resilience
     if not rcfg.enabled:
         return None
-    return ResilientRunner(rcfg, directory, seed=cfg.train.seed)
+    return ResilientRunner(
+        rcfg, directory, seed=cfg.train.seed, read_only=read_only
+    )
 
 
 def finite_mean(values) -> float:

@@ -48,6 +48,14 @@ Modes:
                  step/event rings, ledger shape) AND every embedded
                  metrics tag declared in SCHEMA — wired into the
                  serve/scan smoke paths and scripts/fault_inject.py
+  --multichip <path>  validate a MULTICHIP record (the driver artifact
+                 MULTICHIP_r*.json, or the raw `{"multichip": ...}`
+                 line `__graft_entry__.py:dryrun_multichip` prints —
+                 parallel/sharding.py:validate_multichip,
+                 docs/sharding.md): per-mesh-shape topology stamps,
+                 per-shard ledger fields, the sharded serve ladder's
+                 zero-recompile pin, and every flattened tag declared
+                 under `mesh/*` / `shard/*` in SCHEMA
 """
 
 from __future__ import annotations
@@ -181,6 +189,10 @@ def main(argv=None) -> int:
     ap.add_argument("--postmortem", default=None,
                     help="validate a dumped postmortem.json (crash "
                     "flight recorder, obs/flight.py)")
+    ap.add_argument("--multichip", default=None,
+                    help="validate a MULTICHIP record (driver artifact "
+                    "or raw dryrun_multichip JSON line; "
+                    "parallel/sharding.py:validate_multichip)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -215,6 +227,26 @@ def main(argv=None) -> int:
                 "cascade log validation failed (declare the tags in "
                 "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the cascade "
                 "emitters):\n  "
+                + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.multichip:
+        from deepdfa_tpu.parallel.sharding import validate_multichip
+
+        result = validate_multichip(
+            json.loads(Path(args.multichip).read_text())
+        )
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "multichip record validation failed (declare the tags "
+                "in deepdfa_tpu/obs/metrics.py:SCHEMA or fix "
+                "dryrun_multichip):\n  "
                 + "\n  ".join(result.get("problems", [])),
                 file=sys.stderr,
             )

@@ -284,6 +284,124 @@ def inproc_flight(setup, tmp) -> dict:
     return out
 
 
+def inproc_mesh_sigterm(setup, tmp) -> dict:
+    """ISSUE 13 (docs/sharding.md): a SIGTERM mid-train on the 8-device
+    mesh still writes exactly ONE (process-0) postmortem + resume
+    manifest. The drill runs in a subprocess because the smoke's own
+    platform is pinned to one CPU device — the child opts into cpu:8
+    (the conftest-style 8-virtual-device mesh) and runs the REAL
+    runtime: dp=8 GraphTrainer over 8 logical shards, flight recorder
+    installed, sigterm fault -> Preempted -> postmortem validated; a
+    simulated non-primary process (jax.process_index=1) then proves the
+    obs.session gate installs NOTHING."""
+    out_dir = Path(tmp) / "mesh-postmortem"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    env = dict(
+        os.environ, DEEPDFA_TPU_PLATFORM="cpu:8", JAX_PLATFORMS="cpu",
+    )
+    env.pop("DEEPDFA_FAULTS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh-child",
+         str(out_dir)],
+        capture_output=True, text=True, env=env, timeout=280,
+        cwd=str(REPO),
+    )
+    assert res.returncode == 0, (res.stdout + res.stderr)[-2000:]
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["preempted"], out
+    assert out["verdict"]["ok"], out
+    assert out["verdict"]["trigger"] == "sigterm", out
+    assert out["postmortems"] == 1, out
+    assert out["secondary_install"] is False, out
+    return {
+        "mesh": out["mesh"],
+        "trigger": out["verdict"]["trigger"],
+        "postmortems": out["postmortems"],
+        "secondary_install": out["secondary_install"],
+        "valid": True,
+    }
+
+
+def mesh_child(out_dir: str) -> None:
+    """--mesh-child body (run under DEEPDFA_TPU_PLATFORM=cpu:8)."""
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu:8")
+    apply_platform_override()
+    import unittest.mock as mock
+
+    import jax
+
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.obs import flight as obs_flight
+    from deepdfa_tpu.parallel import make_mesh, sharding
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+    from deepdfa_tpu.train import GraphTrainer, Preempted, ResilientRunner
+
+    assert len(jax.devices()) == 8, jax.devices()
+    run_dir = Path(out_dir)
+    synth = generate(32, seed=3)
+    specs, _ = build_dataset(
+        to_examples(synth), train_ids=range(32), limit_all=50,
+        limit_subkeys=50,
+    )
+    cfg = config_mod.apply_overrides(Config(), [
+        "model.hidden_dim=8",
+        "model.n_steps=2",
+        "train.max_epochs=2",
+        "train.prefetch_batches=0",
+        'train.resilience={"enabled": true, "step_checkpoint_every": 2}',
+    ])
+    model = DeepDFA.from_config(cfg.model, input_dim=52)
+    mesh = make_mesh(MeshConfig(dp=8))
+
+    def batches(_epoch):
+        return list(shard_bucket_batches(
+            specs, num_shards=8, num_graphs=1, node_budget=1024,
+            edge_budget=4096, oversized="drop",
+        ))
+
+    pm_path = run_dir / "postmortem.json"
+    obs_flight.install(pm_path, max_steps=16, max_events=32)
+    preempted = False
+    try:
+        trainer = GraphTrainer(model, cfg, mesh=mesh)
+        state = trainer.init_state(batches(0)[0])
+        runner = ResilientRunner(
+            cfg.train.resilience, run_dir / "ckpt", seed=cfg.train.seed
+        )
+        injector = FaultInjector(FaultPlan(sigterm_at_step=3))
+        try:
+            trainer.fit(
+                state, lambda e: injector.wrap(batches(e)),
+                resilience=runner,
+            )
+        except Preempted:
+            preempted = True
+    finally:
+        obs_flight.uninstall()
+    verdict = obs_flight.validate_postmortem_file(pm_path)
+    # the process-0 contract: a non-primary host's obs.session installs
+    # no flight recorder (and so can never write a competing postmortem)
+    ocfg = config_mod.apply_overrides(cfg, ["obs.flight=true"])
+    with mock.patch.object(jax, "process_index", return_value=1):
+        with obs.session(ocfg, run_dir / "secondary"):
+            secondary_install = obs_flight.installed()
+    print(json.dumps({
+        "preempted": preempted,
+        "verdict": verdict,
+        "postmortems": len(list(run_dir.glob("postmortem*.json"))),
+        "resume_manifest": (run_dir / "ckpt" / "resume.json").exists(),
+        "secondary_install": secondary_install,
+        "mesh": sharding.mesh_record(mesh, 8),
+    }))
+
+
 def run_smoke(n_examples: int) -> dict:
     from deepdfa_tpu.core.backend import apply_platform_override
 
@@ -295,6 +413,7 @@ def run_smoke(n_examples: int) -> dict:
         "corrupt-shard": inproc_corrupt_shard,
         "nan": inproc_nan,
         "flight": inproc_flight,
+        "mesh-sigterm": inproc_mesh_sigterm,
     }
     with tempfile.TemporaryDirectory(prefix="fault-inject-") as tmp:
         t0 = time.perf_counter()
@@ -545,7 +664,16 @@ def main() -> None:
     )
     ap.add_argument("--n-examples", type=int, default=48)
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--mesh-child", default=None, metavar="DIR",
+        help="internal: the 8-device-mesh SIGTERM drill body "
+        "(inproc_mesh_sigterm runs it under cpu:8)",
+    )
     args = ap.parse_args()
+
+    if args.mesh_child:
+        mesh_child(args.mesh_child)
+        return
 
     if args.smoke:
         record = run_smoke(args.n_examples)

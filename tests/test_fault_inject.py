@@ -32,7 +32,7 @@ def test_fault_inject_smoke(tmp_path):
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=420,
+        timeout=560,
     )
     assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
     record = json.loads(out.read_text())
@@ -53,3 +53,12 @@ def test_fault_inject_smoke(tmp_path):
     for name in ("sigterm", "nan", "stall"):
         assert flight[name]["valid"] is True
         assert flight[name]["steps"] > 0
+    # unified sharding layer (ISSUE 13, docs/sharding.md): SIGTERM on
+    # the 8-device mesh still writes exactly ONE (process-0) postmortem,
+    # and a simulated non-primary host's obs.session installs nothing
+    mesh = scen["mesh-sigterm"]
+    assert mesh["valid"] is True
+    assert mesh["trigger"] == "sigterm"
+    assert mesh["postmortems"] == 1
+    assert mesh["secondary_install"] is False
+    assert mesh["mesh"]["axes"] == {"dp": 8}

@@ -1,0 +1,554 @@
+"""Unified sharding layer (parallel/sharding.py, docs/sharding.md).
+
+The load-bearing contracts:
+
+- path-pattern rules resolve a params pytree to PartitionSpecs (first
+  match wins, stacked pp rules, shape-aware fitting, loud unknown-axis
+  errors) and the family builders reproduce the documented layouts;
+- the logical-shard GraphTrainer step is BIT-IDENTICAL across dp
+  topologies that divide num_shards (the jit-vs-eager and
+  cross-ladder-size traps do not apply: every topology runs the same
+  vmapped per-shard program and ONE fixed-shape reduction);
+- elastic resume: a step checkpoint written at dp=8 restores at dp=4
+  and dp=1 and the merged step-loss trajectory equals the uninterrupted
+  dp=8 run exactly;
+- a sharded checkpoint serves through the warmed executor ladder with
+  zero steady-state recompiles and score parity;
+- process-0 gating: non-primary processes build no single-writer
+  resources and obs.session installs nothing;
+- the MULTICHIP record validator accepts the dryrun's shape and rejects
+  damage.
+"""
+
+import dataclasses
+import shutil
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepdfa_tpu.core import Config, MeshConfig, config as config_mod
+from deepdfa_tpu.data import build_dataset, generate, to_examples
+from deepdfa_tpu.graphs import pack_shards, shard_bucket_batches
+from deepdfa_tpu.models import DeepDFA
+from deepdfa_tpu.parallel import make_mesh, sharding
+
+NB, EB = 1024, 4096
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    synth = generate(32, vuln_rate=0.25, seed=0)
+    specs, vocabs = build_dataset(
+        to_examples(synth), train_ids=range(32), limit_all=30,
+        limit_subkeys=30,
+    )
+    return specs, vocabs
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = config_mod.apply_overrides(
+        Config(), ["model.hidden_dim=8", "model.n_steps=2"]
+    )
+    return cfg, DeepDFA.from_config(cfg.model, input_dim=32)
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+
+def test_rule_resolution_first_match_and_stacked():
+    rules = sharding.parse_rules([
+        "encoder/layers/wq=-,-,tp,-",
+        "head/*=",
+        "*/kernel=-,fsdp",
+    ])
+    smap = sharding.ShardingMap(rules=rules, stacked=(("encoder/*", "pp"),))
+    assert smap.spec_for("encoder/layers/wq") == P("pp", None, "tp", None)
+    assert smap.spec_for("graph/dense/kernel") == P(None, "fsdp")
+    # an earlier rule wins: head/* pins replicated ahead of */kernel
+    assert smap.spec_for("head/out/kernel") == P()
+    assert smap.spec_for("unmatched/bias") == P()
+
+
+def test_operator_rule_pins_through_stacked_pp():
+    """A `pattern=` operator pin survives the family map's pp stacked
+    transform (operator rules are FINAL — docs/sharding.md)."""
+    smap = sharding.sharding_map_for(
+        "t5", mesh_shape={"tp": 2, "pp": 2},
+        extra_rules=["encoder/layers/wq="],
+    )
+    assert smap.spec_for("encoder/layers/wq") == P()
+    # non-pinned siblings still stage-shard
+    assert smap.spec_for("encoder/layers/wk") == P("pp", None, "tp", None)
+
+
+def test_read_only_runner_restores_but_never_writes(
+    corpus, tiny_model, tmp_path
+):
+    """Multi-host non-primary mode: the runner restores the shared
+    step-checkpoint tree (state + cursor re-align on every host) but
+    writes nothing — process 0 owns the saves (docs/sharding.md)."""
+    import jax
+
+    from deepdfa_tpu.train import ResilientRunner, ResumeCursor
+
+    specs, _ = corpus
+    cfg, model = tiny_model
+    cfg = config_mod.apply_overrides(cfg, [
+        'train.resilience={"enabled": true, "step_checkpoint_every": 1}',
+    ])
+    mesh = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    from deepdfa_tpu.train import GraphTrainer
+
+    trainer = GraphTrainer(model, cfg, mesh=mesh)
+    batch = _batch8(specs)
+    state = trainer.init_state(batch, seed=0)
+    ckpt_dir = tmp_path / "shared"
+    writer = ResilientRunner(cfg.train.resilience, ckpt_dir, seed=1)
+    writer.after_step(state, None, ResumeCursor(0, 1, 1))
+    assert (ckpt_dir / "resume.json").exists()
+
+    reader = ResilientRunner(
+        cfg.train.resilience, ckpt_dir, seed=1, read_only=True
+    )
+    before = sorted(p.name for p in ckpt_dir.iterdir())
+    restored, cursor = reader.maybe_resume(state, lambda host: host)
+    assert cursor is not None and cursor.step == 1
+    # a full pass of after_step checkpoints writes NOTHING new
+    reader.after_step(restored, None, ResumeCursor(0, 2, 2))
+    reader.finish(restored, ResumeCursor(1, 0, 2))
+    assert sorted(p.name for p in ckpt_dir.iterdir()) == before
+
+
+def test_rule_parse_rejects_malformed_and_unknown_axis():
+    with pytest.raises(ValueError, match="pattern=axes"):
+        sharding.parse_rules(["no-equals-sign"])
+    smap = sharding.ShardingMap(
+        rules=sharding.parse_rules(["*/kernel=-,bogus"])
+    )
+    with pytest.raises(ValueError, match="unknown mesh axis 'bogus'"):
+        smap.validate()
+
+
+def test_spec_fitting_replicates_non_divisible_dims(devices):
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=8), devices=devices)
+    smap = sharding.ShardingMap(
+        rules=sharding.parse_rules(["*/kernel=-,fsdp"])
+    )
+    tree = {
+        "a": {"kernel": np.zeros((4, 16))},   # 16 % 8 == 0 -> sharded
+        "b": {"kernel": np.zeros((4, 1))},    # 1 % 8 != 0 -> replicated
+        "c": {"bias": np.zeros((3,))},
+    }
+    specs = smap.param_specs(tree, mesh_shape=dict(mesh.shape))
+    assert specs["a"]["kernel"] == P(None, "fsdp")
+    assert specs["b"]["kernel"] == P(None, None)
+    assert specs["c"]["bias"] == P()
+    placed = smap.place(mesh, tree)
+    assert placed["a"]["kernel"].sharding.spec == P(None, "fsdp")
+
+
+def test_family_map_combined_layouts():
+    from deepdfa_tpu.models import combined as cmb
+    from deepdfa_tpu.models import t5 as t5m
+    from deepdfa_tpu.models.transformer import TransformerConfig
+
+    import jax
+
+    mcfg = cmb.CombinedConfig(
+        encoder=TransformerConfig.tiny(
+            vocab_size=64, max_position_embeddings=40
+        ),
+        graph_hidden_dim=8, graph_input_dim=32,
+    )
+    example = jax.eval_shape(lambda: cmb.init_params(mcfg, jax.random.key(0)))
+    # tp + pp: the Megatron layer table with the stacked axis resharded
+    smap = sharding.sharding_map_for(
+        "combined", model_cfg=mcfg, mesh_shape={"tp": 2, "pp": 2}
+    )
+    specs = smap.param_specs(example)
+    assert specs["encoder"]["layers"]["wq"] == P("pp", None, "tp", None)
+    assert specs["encoder"]["layers"]["ln1_scale"] == P("pp", None)
+    assert specs["encoder"]["embeddings"]["word"] == P()
+    assert specs["head"]["dense_w"] == P()
+    # dp-only mesh: everything replicated (size-1 axes collapse)
+    flat = sharding.sharding_map_for(
+        "combined", model_cfg=mcfg, mesh_shape={"dp": 8}
+    ).param_specs(example)
+    import jax as _jax
+
+    assert all(
+        s == P() for s in _jax.tree.leaves(
+            flat, is_leaf=lambda x: isinstance(x, P)
+        )
+    )
+    # t5 tp: rel_bias heads shard
+    t5cfg = t5m.DefectConfig(
+        encoder=t5m.T5Config.tiny(vocab_size=64, remat=False),
+        graph_hidden_dim=8, graph_input_dim=32,
+    )
+    t5_example = jax.eval_shape(
+        lambda: t5m.init_defect_params(t5cfg, jax.random.key(0))
+    )
+    t5_specs = sharding.sharding_map_for(
+        "t5", model_cfg=t5cfg, mesh_shape={"tp": 2}
+    ).param_specs(t5_example)
+    assert t5_specs["encoder"]["rel_bias"] == P(None, "tp")
+    assert t5_specs["encoder"]["layers"]["wi"] == P(None, None, "tp")
+
+    with pytest.raises(ValueError, match="unknown model family"):
+        sharding.sharding_map_for("nope")
+
+
+# ---------------------------------------------------------------------------
+# the logical-shard step: bit-identity across dp topologies
+
+
+def _batch8(specs):
+    return pack_shards(specs, 8, num_graphs=4, node_budget=256,
+                       edge_budget=EB // 4)
+
+
+def _run_steps(model, cfg, batch, dp, n_steps=2):
+    import jax
+
+    from deepdfa_tpu.data.prefetch import device_placer
+    from deepdfa_tpu.train import GraphTrainer
+
+    mesh = make_mesh(MeshConfig(dp=dp), devices=jax.devices()[:dp])
+    t = GraphTrainer(model, cfg, mesh=mesh)
+    s = t.init_state(batch, seed=0)
+    b = device_placer(mesh)(batch)
+    losses = []
+    for _ in range(n_steps):
+        s, loss = t.train_step(s, b)
+        losses.append(np.asarray(jax.device_get(loss)).tobytes())
+    return losses, jax.device_get(s.params), t, b, s
+
+
+def test_dp_topology_bit_identity(corpus, tiny_model, devices):
+    """dp in {1, 4, 8} over the SAME 8-logical-shard batch: step-loss
+    trajectories AND updated params bitwise equal (adamw default)."""
+    import jax
+
+    specs, _ = corpus
+    cfg, model = tiny_model
+    batch = _batch8(specs)
+    ref_losses, ref_params, *_ = _run_steps(model, cfg, batch, dp=1)
+    for dp in (4, 8):
+        losses, params, *_ = _run_steps(model, cfg, batch, dp=dp)
+        assert losses == ref_losses, (dp, losses, ref_losses)
+        for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(params)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), dp
+
+
+def test_eval_step_matches_across_dp(corpus, tiny_model):
+    specs, _ = corpus
+    cfg, model = tiny_model
+    batch = _batch8(specs)
+    _, _, t1, b1, s1 = _run_steps(model, cfg, batch, dp=1, n_steps=1)
+    _, _, t8, b8, s8 = _run_steps(model, cfg, batch, dp=8, n_steps=1)
+    m1, _ = t1.evaluate(s1, [b1])
+    m8, _ = t8.evaluate(s8, [b8])
+    assert m1 == m8
+
+
+def test_logical_shard_validation(devices):
+    mesh = make_mesh(MeshConfig(dp=8), devices=devices)
+    assert sharding.check_logical_shards(16, mesh) == 2
+    with pytest.raises(ValueError, match="not divisible"):
+        sharding.check_logical_shards(6, mesh)
+    assert sharding.logical_shards(MeshConfig(num_shards=16), mesh) == 16
+    assert sharding.logical_shards(MeshConfig(), mesh) == 8
+
+
+# ---------------------------------------------------------------------------
+# elastic resume
+
+
+def _fit_logged(model, cfg, batches, dp, run_dir, injector=None):
+    import jax
+
+    from deepdfa_tpu.testing.faults import FaultInjector  # noqa: F401
+    from deepdfa_tpu.train import GraphTrainer, Preempted, ResilientRunner
+
+    mesh = make_mesh(MeshConfig(dp=dp), devices=jax.devices()[:dp])
+    t = GraphTrainer(model, cfg, mesh=mesh)
+    state = t.init_state(batches(0)[0], seed=0)
+    runner = ResilientRunner(
+        cfg.train.resilience, run_dir, seed=cfg.train.seed
+    )
+    steps = []
+    stream = (
+        (lambda e: injector.wrap(batches(e)))
+        if injector is not None else batches
+    )
+    try:
+        t.fit(
+            state, stream,
+            log_fn=lambda r: steps.append((r["step"], r["loss"]))
+            if "loss" in r else None,
+            resilience=runner,
+        )
+        return steps, runner, None
+    except Preempted as p:
+        return steps, runner, p
+
+
+def test_elastic_resume_bit_identical(corpus, tiny_model, tmp_path):
+    """Checkpoint at dp=8 (SIGTERM mid-run), restore at dp=4 AND dp=1:
+    each merged step-loss trajectory equals the uninterrupted dp=8 run
+    EXACTLY — elastic resume is bit-exact because the logical-shard
+    layout fixes both the batch stream and the reduction tree
+    (docs/sharding.md)."""
+    import json as _json
+
+    from deepdfa_tpu.testing.faults import FaultInjector, FaultPlan
+
+    specs, _ = corpus
+    cfg, model = tiny_model
+    cfg = config_mod.apply_overrides(cfg, [
+        "train.max_epochs=2",
+        "train.prefetch_batches=0",
+        "train.log_every_steps=1",
+        'train.resilience={"enabled": true, "step_checkpoint_every": 2}',
+    ])
+
+    def batches(_epoch):
+        return list(shard_bucket_batches(
+            specs, num_shards=8, num_graphs=2, node_budget=256,
+            edge_budget=EB // 4, oversized="drop",
+        ))
+
+    ref, _, _ = _fit_logged(model, cfg, batches, 8, tmp_path / "ref")
+    assert len(ref) >= 4, ref
+
+    kill_at = max(2, len(ref) // 2)
+    faulted_dir = tmp_path / "faulted"
+    injector = FaultInjector(FaultPlan(sigterm_at_step=kill_at))
+    first, _, preempted = _fit_logged(
+        model, cfg, batches, 8, faulted_dir, injector=injector
+    )
+    assert preempted is not None
+    manifest = _json.loads((faulted_dir / "resume.json").read_text())
+    # the manifest carries the topology stamp (elastic-resume audit)
+    assert manifest["mesh"]["num_shards"] == 8
+    assert manifest["mesh"]["axes"] == {"dp": 8}
+
+    for dp in (4, 1):
+        resume_dir = tmp_path / f"resume-dp{dp}"
+        shutil.copytree(faulted_dir, resume_dir)
+        second, runner, _ = _fit_logged(
+            model, cfg, batches, dp, resume_dir
+        )
+        assert runner.resumed_from_step == kill_at
+        merged = first + second
+        assert merged == ref, (
+            dp, merged[:3], ref[:3], len(merged), len(ref),
+        )
+
+
+# ---------------------------------------------------------------------------
+# serving through the sharded layer
+
+
+def test_serve_mesh_parity_and_census(corpus, tiny_model, devices, tmp_path):
+    """fsdp-sharded params serve through the warmed ladder: zero
+    steady-state recompiles, scores match single-device serving, and a
+    restore_for_inference(shardings=) checkpoint lands pre-sharded."""
+    import jax
+
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.serve.batcher import DynamicBatcher, GgnnExecutor
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    specs, _ = corpus
+    cfg, model = tiny_model
+    params = model.init(jax.random.key(0), pack([], 1, NB, EB))
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=8), devices=devices)
+    smap = sharding.sharding_map_for("deepdfa", mesh_shape=dict(mesh.shape))
+    # elastic placement at restore: the checkpoint commits straight to
+    # the serving mesh's resolved shardings
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    host = jax.device_get(params)
+    mgr.save("best", host, {"val_loss": 1.0}, step=0)
+    restored = mgr.restore_for_inference(
+        "best", host, shardings=smap.shardings(mesh, host)
+    )
+    emb = restored["params"]["embedding"]["embed_api"]["embedding"]
+    assert emb.sharding.spec == P(None, "fsdp")
+
+    ex_plain = GgnnExecutor(
+        model, lambda: jax.device_put(host),
+        node_budget=NB, edge_budget=EB, max_batch_graphs=4,
+    )
+    ex_mesh = GgnnExecutor(
+        model, lambda: restored,
+        node_budget=NB, edge_budget=EB, max_batch_graphs=4, mesh=mesh,
+    )
+    ex_plain.warmup()
+    ex_mesh.warmup()
+    low0 = ex_mesh.jit_lowerings()
+    batcher = DynamicBatcher(ex_mesh, queue_limit=64)
+    reqs = batcher.score_all(specs[:6])
+    got = np.array([r.result for r in reqs])
+    want = np.array([
+        ex_plain.execute("graph", [s])[0] for s in specs[:6]
+    ])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    assert ex_mesh.jit_lowerings() == low0  # zero steady-state lowerings
+
+    # BOTH serve ladders: the line-attribution executables warm over
+    # the same sizes and hold the census sharded too
+    from deepdfa_tpu.serve.frontend import Features
+    from deepdfa_tpu.serve.localize import GgnnLocalizer
+
+    def localizer(params_fn, mesh_=None):
+        return GgnnLocalizer(
+            model, params_fn, node_budget=NB, edge_budget=EB,
+            sizes=ex_mesh.sizes, method="saliency", top_k=5, mesh=mesh_,
+        )
+
+    loc_plain = localizer(lambda: jax.device_put(host))
+    loc_mesh = localizer(lambda: restored, mesh_=mesh)
+    loc_plain.warmup()
+    loc_mesh.warmup()
+    llow0 = loc_mesh.jit_lowerings()
+    feats = [
+        Features(spec=s, node_lines=np.arange(1, s.num_nodes + 1,
+                                              dtype=np.int32))
+        for s in specs[:3]
+    ]
+    out_plain = loc_plain.attribute_all(feats)
+    out_mesh = loc_mesh.attribute_all(feats)
+    assert loc_mesh.jit_lowerings() == llow0
+    for (pa, la), (pb, lb) in zip(out_plain, out_mesh):
+        np.testing.assert_allclose(pa, pb, atol=1e-6)
+        assert [d["line"] for d in la] == [d["line"] for d in lb]
+
+
+def test_serve_mesh_helper(devices):
+    from deepdfa_tpu.serve.registry import serve_mesh
+
+    cfg = Config()
+    assert serve_mesh(cfg) is None  # default path untouched
+    cfg = config_mod.apply_overrides(
+        Config(), ["serve.sharded=true", "serve.mesh.fsdp=8",
+                   "serve.mesh.dp=1"]
+    )
+    mesh = serve_mesh(cfg)
+    assert mesh is not None and mesh.shape["fsdp"] == 8
+
+
+# ---------------------------------------------------------------------------
+# multi-host coordination
+
+
+def test_primary_gating(monkeypatch, tmp_path):
+    import jax
+
+    from deepdfa_tpu import obs
+    from deepdfa_tpu.obs import flight as obs_flight
+    from deepdfa_tpu.train.logging import NullRunLogger
+
+    assert sharding.is_primary()  # single-process: always the primary
+    assert sharding.if_primary(lambda: "built") == "built"
+
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert not sharding.is_primary()
+    assert sharding.if_primary(lambda: "built", fallback=None) is None
+    # obs.session installs nothing off-primary (flight requested but
+    # never installed; no files created)
+    cfg = config_mod.apply_overrides(
+        Config(), ["obs.flight=true", "obs.metrics=true"]
+    )
+    with obs.session(cfg, tmp_path):
+        assert not obs_flight.installed()
+    assert not (tmp_path / "postmortem.json").exists()
+    with NullRunLogger() as lg:
+        lg.log({"step": 1, "loss": 0.5})
+    assert not (tmp_path / "train_log.jsonl").exists()
+
+
+def test_mesh_record_and_publish(devices):
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    mesh = make_mesh(MeshConfig(dp=4, tp=2), devices=devices)
+    rec = sharding.mesh_record(mesh, num_shards=8)
+    assert rec["axes"] == {"dp": 4, "tp": 2}
+    assert rec["devices"] == 8
+    assert rec["processes"] == 1
+    assert rec["num_shards"] == 8
+    sharding.publish_mesh(mesh, num_shards=8)
+    snap = obs_metrics.REGISTRY.snapshot()
+    assert snap["mesh/dp"] == 4.0
+    assert snap["mesh/num_shards"] == 8.0
+    assert obs_metrics.declared("mesh/dp")
+    assert obs_metrics.declared("shard/train_dp8/S8/flops")
+
+
+# ---------------------------------------------------------------------------
+# the MULTICHIP record contract
+
+
+def _multichip_record():
+    return {
+        "multichip": {
+            "n_devices": 8,
+            "num_shards": 8,
+            "mesh_shapes": {
+                "dp8": {"axes": {"dp": 8}, "devices": 8, "processes": 1,
+                        "num_shards": 8},
+            },
+            "serve": {"ladder": [1, 2, 4], "steady_state_recompiles": 0,
+                      "mesh": {"axes": {"fsdp": 8}}},
+            "shard": {
+                "train_dp8/S8": {
+                    "flops": 1.0, "compile_seconds": 0.5, "executions": 3,
+                    "device_seconds": 0.1, "flops_per_sec": 30.0,
+                },
+            },
+            "hbm": {},
+            "compile_seconds_total": 0.5,
+        }
+    }
+
+
+def test_validate_multichip_accepts_and_rejects():
+    ok = sharding.validate_multichip(_multichip_record())
+    assert ok["ok"], ok
+    # driver-artifact shape: the record under `parsed`
+    wrapped = {"n": 7, "rc": 0, "parsed": _multichip_record()}
+    assert sharding.validate_multichip(wrapped)["ok"]
+
+    damaged = _multichip_record()
+    del damaged["multichip"]["shard"]
+    out = sharding.validate_multichip(damaged)
+    assert not out["ok"] and any("shard" in p for p in out["problems"])
+
+    recompiled = _multichip_record()
+    recompiled["multichip"]["serve"]["steady_state_recompiles"] = 2
+    out = sharding.validate_multichip(recompiled)
+    assert not out["ok"]
+    assert any("recompiled" in p for p in out["problems"])
+
+    assert not sharding.validate_multichip({"parsed": None})["ok"]
+
+
+def test_meshconfig_roundtrip_and_fsdp_axis(devices):
+    cfg = config_mod.apply_overrides(Config(), [
+        "train.mesh.fsdp=2", "train.mesh.dp=4",
+        "train.mesh.num_shards=8",
+        'train.mesh.rules=["*/embedding=-,fsdp"]',
+    ])
+    d = config_mod.from_dict(
+        __import__("json").loads(config_mod.to_json(cfg))
+    )
+    assert d.train.mesh.fsdp == 2
+    assert d.train.mesh.num_shards == 8
+    assert d.train.mesh.rules == ("*/embedding=-,fsdp",)
+    mesh = make_mesh(d.train.mesh, devices=devices)
+    assert mesh.shape["dp"] == 4 and mesh.shape["fsdp"] == 2
