@@ -19,6 +19,10 @@ Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
             load shedding (docs/fleet.md)
   fleet-replica  one fleet replica worker process (spawned by `fleet`;
             heartbeats + graceful SIGTERM drain)
+  fleet-router   one HA router (active/standby via the router.json
+            rendezvous; standby takes over within the failover window)
+  fleet-rollout  zero-downtime checkpoint rollout across the fleet
+            (drift-gated, SLO-guarded, halt + rollback on breach)
 
 Config comes from --config (json) plus dotted key=value overrides, e.g.
   python -m deepdfa_tpu.cli train data.batch.graphs_per_batch=128
@@ -1967,14 +1971,19 @@ def cmd_fleet(args) -> None:
                 "fleet smoke contract violated:\n  " + "\n  ".join(bad)
             )
         return
+    import os as os_mod
     import signal as signal_mod
+    import subprocess as subprocess_mod
+    import sys as sys_mod
     import time as time_mod
 
     from deepdfa_tpu import obs
-    from deepdfa_tpu.fleet.replica import spawn_replicas, wait_for_ready
-    from deepdfa_tpu.fleet.router import (
-        make_router_server,
-        router_from_config,
+    from deepdfa_tpu.fleet import ha as fleet_ha
+    from deepdfa_tpu.fleet.admission import plan_replicas
+    from deepdfa_tpu.fleet.replica import (
+        estimate_entry_bytes,
+        spawn_replicas,
+        wait_for_ready,
     )
 
     cfg = _load_run_config(args)
@@ -1983,9 +1992,28 @@ def cmd_fleet(args) -> None:
     host = args.host if args.host is not None else cfg.fleet.host
     port = args.port if args.port is not None else cfg.fleet.port
     n = args.replicas if args.replicas is not None else cfg.fleet.replicas
+    if n is None or int(n) <= 0:
+        # fleet.replicas unset: size the fleet from the per-entry
+        # param-bytes ledger signal (ROADMAP item 2) — checkpoint bytes
+        # on disk arbitrated by plan_replicas against the HBM budget
+        entry_bytes = estimate_entry_bytes(cfg, run_dir)
+        n, plan = plan_replicas(
+            entry_bytes, cfg.fleet.hbm_budget_bytes
+        )
+        print(json.dumps({"fleet_replica_plan": plan}), flush=True)
+        import logging as logging_mod
+
+        logging_mod.getLogger(__name__).warning(
+            "fleet.replicas unset: running %d replica(s) per the "
+            "param-bytes plan (%s; per-replica working set %.0f bytes "
+            "vs budget %.0f)",
+            n, plan["reason"], plan["per_replica_bytes"],
+            plan["hbm_budget_bytes"],
+        )
     procs = spawn_replicas(
         run_dir, fleet_dir, n, overrides=args.overrides
     )
+    standby_proc = None
     # a scheduler stops the fleet with SIGTERM: convert it to the same
     # unwind Ctrl-C takes so the finally-drain (SIGTERM the replicas,
     # final summary record) actually runs
@@ -1994,36 +2022,59 @@ def cmd_fleet(args) -> None:
 
     signal_mod.signal(signal_mod.SIGTERM, _sigterm_to_interrupt)
     with obs.session(cfg, run_dir):
-        router = router_from_config(
-            cfg, fleet_dir, log_path=run_dir / "fleet_log.jsonl"
+        # the front door is an HA member even solo: it publishes the
+        # router.json rendezvous clients re-resolve from, and a standby
+        # (fleet.standby_router, or any `fleet-router` process pointed
+        # at the same fleet dir) takes over inside the failover window
+        ha_router = fleet_ha.HARouter(
+            cfg, fleet_dir, router_id=f"router-{os_mod.getpid()}",
+            host=host, port=port,
+            log_path=run_dir / "fleet_log.jsonl",
         )
-        httpd = None
         try:
             wait_for_ready(
                 fleet_dir, [rid for rid, _ in procs],
                 timeout_s=args.ready_timeout, procs=procs,
             )
-            router.start_polling()
-            httpd = make_router_server(router, host, port)
+            ha_router.start()
+            if not ha_router.wait_active(timeout_s=60.0):
+                raise SystemExit(
+                    "router did not become active (another active "
+                    f"router owns {fleet_ha.rendezvous_path(fleet_dir)}?)"
+                )
+            if cfg.fleet.standby_router:
+                standby_proc = subprocess_mod.Popen([
+                    sys_mod.executable, "-m", "deepdfa_tpu.cli",
+                    "fleet-router",
+                    "--run-dir", str(run_dir),
+                    "--fleet-dir", str(fleet_dir),
+                    "--host", host,
+                    *(["--config", args.config] if args.config else []),
+                    *sum((["--override", ov] for ov in args.overrides),
+                         []),
+                ])
             print(json.dumps({
                 "fleet": True,
-                "host": host,
-                "port": httpd.server_address[1],
+                "host": ha_router.host,
+                "port": ha_router.port,
                 "replicas": [rid for rid, _ in procs],
                 "fleet_dir": str(fleet_dir),
-                **router.topology(),
+                "rendezvous": str(fleet_ha.rendezvous_path(fleet_dir)),
+                "standby": standby_proc is not None,
+                **ha_router.router.topology(),
             }), flush=True)
-            httpd.serve_forever()
+            while True:
+                time_mod.sleep(1.0)
         except KeyboardInterrupt:
             pass
         finally:
-            if httpd is not None:
-                httpd.server_close()
             # drain the replicas the way a scheduler would: SIGTERM,
             # then wait for the graceful exit
             for _, proc in procs:
                 if proc.poll() is None:
                     proc.send_signal(signal_mod.SIGTERM)
+            if standby_proc is not None and standby_proc.poll() is None:
+                standby_proc.send_signal(signal_mod.SIGTERM)
             deadline = time_mod.time() + 60
             for _, proc in procs:
                 try:
@@ -2032,7 +2083,14 @@ def cmd_fleet(args) -> None:
                     )
                 except Exception:
                     proc.kill()
-            router.close()
+            if standby_proc is not None:
+                try:
+                    standby_proc.wait(
+                        timeout=max(1.0, deadline - time_mod.time())
+                    )
+                except Exception:
+                    standby_proc.kill()
+            ha_router.close()
 
 
 def cmd_fleet_replica(args) -> None:
@@ -2065,6 +2123,101 @@ def cmd_fleet_replica(args) -> None:
     # replicas sharing one run dir
     with obs.session(cfg, worker.obs_dir):
         raise SystemExit(worker.run())
+
+
+def _resolve_fleet_run(args):
+    """(cfg, run_dir, fleet_dir) for the fleet-router/fleet-rollout
+    commands (the fleet-replica run-dir resolution, shared)."""
+    from deepdfa_tpu.core import config as _config_mod
+    from deepdfa_tpu.serve.registry import load_run_config
+
+    run_dir = Path(args.run_dir)
+    if not run_dir.is_dir():
+        candidate = paths.runs_dir(args.run_dir)
+        if candidate.is_dir():
+            run_dir = candidate
+        else:
+            raise SystemExit(f"no such run dir: {args.run_dir}")
+    if getattr(args, "config", None):
+        # a fleet launched with an explicit --config runs on that file,
+        # not the run dir's saved config — the standby/rollout must
+        # resolve the SAME configuration (admission policy, failover
+        # cadences) or a takeover silently changes policy
+        cfg = _config_mod.load(Path(args.config))
+    else:
+        cfg = load_run_config(run_dir)
+    cfg = _config_mod.apply_overrides(cfg, args.overrides)
+    _config_mod.validate(cfg)
+    fleet_dir = Path(
+        args.fleet_dir or cfg.fleet.fleet_dir or run_dir / "fleet"
+    )
+    return cfg, run_dir, fleet_dir
+
+
+def cmd_fleet_router(args) -> None:
+    """One HA router process (docs/fleet.md): joins the active/standby
+    pair over the shared fleet dir. With a fresh (or stale) rendezvous
+    it becomes active and serves the front door; otherwise it stands by
+    — tailing the heartbeat dir, health-checking the active via
+    router.json — and takes over within the failover window, re-seeding
+    admission token buckets from the fleet_log's last summary record."""
+    import os as os_mod
+    import signal as signal_mod
+    import time as time_mod
+
+    from deepdfa_tpu.fleet import ha as fleet_ha
+
+    cfg, run_dir, fleet_dir = _resolve_fleet_run(args)
+    router_id = args.router_id or f"router-{os_mod.getpid()}"
+    host = args.host if args.host is not None else cfg.fleet.host
+    port = args.port if args.port is not None else cfg.fleet.port
+    ha_router = fleet_ha.HARouter(
+        cfg, fleet_dir, router_id=router_id, host=host, port=port,
+        log_path=run_dir / "fleet_log.jsonl",
+    )
+
+    def _sigterm_to_interrupt(signum, frame):
+        raise KeyboardInterrupt
+
+    signal_mod.signal(signal_mod.SIGTERM, _sigterm_to_interrupt)
+    try:
+        ha_router.start()
+        print(json.dumps({
+            "router_id": router_id,
+            "role": ha_router.role,
+            "host": ha_router.host,
+            "port": ha_router.port if ha_router.role == "active" else None,
+            "rendezvous": str(fleet_ha.rendezvous_path(fleet_dir)),
+            "failover_timeout_s": cfg.fleet.router_failover_timeout_s,
+        }), flush=True)
+        while True:
+            time_mod.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ha_router.close()
+
+
+def cmd_fleet_rollout(args) -> None:
+    """Zero-downtime rollout (docs/fleet.md): hot-swap a checkpoint tag
+    across every ready replica one at a time — drift-gated per replica,
+    SLO-guarded between swaps, halted + rolled back on a breach. Exit 0
+    only when every replica swapped with the census intact."""
+    from deepdfa_tpu.fleet import rollout as fleet_rollout
+
+    cfg, run_dir, fleet_dir = _resolve_fleet_run(args)
+    router_addr = None
+    if args.router:
+        host, _, port = args.router.rpartition(":")
+        router_addr = (host or "127.0.0.1", int(port))
+    report = fleet_rollout.run_rollout(
+        cfg, fleet_dir, args.checkpoint,
+        router_addr=router_addr,
+        log_path=run_dir / "fleet_log.jsonl",
+    )
+    print(json.dumps(report), flush=True)
+    if not report.get("ok") or not report.get("census_ok"):
+        raise SystemExit(1)
 
 
 def cmd_bench(args) -> None:
@@ -2472,6 +2625,62 @@ def main(argv=None) -> None:
                    dest="overrides",
                    help="dotted key=value config override (repeatable)")
     p.set_defaults(fn=cmd_fleet_replica)
+
+    p = sub.add_parser(
+        "fleet-router",
+        help="one HA router (active/standby negotiated via the "
+        "router.json rendezvous file): the standby tails the heartbeat "
+        "dir + fleet_log and takes over the front door within the "
+        "failover window when the active dies (docs/fleet.md)",
+    )
+    p.add_argument("--run-dir", required=True,
+                   help="run directory (or run name under storage/runs)")
+    p.add_argument("--router-id", default=None,
+                   help="router identity in the rendezvous/fleet_log "
+                        "(default: router-<pid>)")
+    p.add_argument("--fleet-dir", default=None,
+                   help="heartbeat/rendezvous dir (default "
+                        "<run_dir>/fleet)")
+    p.add_argument("--host", default=None,
+                   help="bind address when active (default fleet.host)")
+    p.add_argument("--port", type=int, default=None,
+                   help="preferred port when active; falls back to "
+                        "ephemeral, clients re-resolve from router.json "
+                        "(default fleet.port)")
+    p.add_argument("--config", default=None,
+                   help="json config file (default: the run dir's saved "
+                        "config.json); pass the SAME file the fleet was "
+                        "launched with so a takeover keeps its policy")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_fleet_router)
+
+    p = sub.add_parser(
+        "fleet-rollout",
+        help="zero-downtime checkpoint rollout: drain->swap->re-warm->"
+        "readmit one replica at a time under traffic, drift-gated and "
+        "halted + rolled back on an SLO breach (docs/fleet.md)",
+    )
+    p.add_argument("--run-dir", required=True,
+                   help="run directory (or run name under storage/runs)")
+    p.add_argument("--checkpoint", required=True,
+                   help="checkpoint tag to roll out (manifest tag; "
+                        "@int8 composes)")
+    p.add_argument("--fleet-dir", default=None,
+                   help="heartbeat/rendezvous dir (default "
+                        "<run_dir>/fleet)")
+    p.add_argument("--router", default=None, metavar="HOST:PORT",
+                   help="router address for the SLO guard (default: "
+                        "resolved from the router.json rendezvous)")
+    p.add_argument("--config", default=None,
+                   help="json config file (default: the run dir's saved "
+                        "config.json); pass the SAME file the fleet was "
+                        "launched with")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_fleet_rollout)
 
     p = sub.add_parser("bench")
     _add_common(p)

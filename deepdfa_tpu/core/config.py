@@ -479,8 +479,12 @@ class FleetConfig:
     health-gated least-outstanding routing and per-tenant admission."""
 
     # -- topology (fleet/replica.py, cli `fleet`)
-    # replica processes the `fleet` command spawns
-    replicas: int = 2
+    # replica processes the `fleet` command spawns; 0 = unset, derive
+    # the count from the per-entry param-bytes ledger signal via
+    # fleet/admission.py:plan_replicas (checkpoint bytes on disk vs
+    # hbm_budget_bytes; falls back to 2 when unbudgeted) — the computed
+    # plan is logged loudly
+    replicas: int = 0
     # router bind address (replicas always bind 127.0.0.1:ephemeral and
     # publish their real port via heartbeat)
     host: str = "127.0.0.1"
@@ -539,6 +543,45 @@ class FleetConfig:
     # HBM budget (bytes) the per-entry param-bytes ledger arbitrates
     # co-serving against; 0 = unbudgeted (every configured entry loads)
     hbm_budget_bytes: float = 0.0
+    # -- router HA (fleet/ha.py, docs/fleet.md)
+    # spawn a standby `fleet-router` subprocess next to the active: it
+    # tails the heartbeat dir + fleet_log, health-checks the active via
+    # the router.json rendezvous file, and takes over the front door
+    # within the documented failover window when the active dies
+    standby_router: bool = False
+    # active-router rendezvous refresh cadence (the router's own
+    # heartbeat; router.json under the fleet dir)
+    rendezvous_interval_s: float = 0.5
+    # a rendezvous older than this marks the active presumed-dead; the
+    # standby double-checks with a bounded /healthz probe, then takes
+    # over. Documented failover bound: router_failover_timeout_s +
+    # probe_timeout_s + one standby poll (rendezvous_interval_s)
+    router_failover_timeout_s: float = 3.0
+    # periodic fleet_log summary-record cadence — each summary embeds
+    # the admission snapshot (token-bucket levels + service EWMA), the
+    # re-seed source a restarted/failed-over router restores from;
+    # 0 = summaries only at close
+    summary_interval_s: float = 5.0
+    # -- zero-downtime rollout (fleet/rollout.py, cli `fleet-rollout`)
+    # max calibration score drift (|P_new - P_old| over deterministic
+    # calibration batches, the PR-12 machinery) a rollout checkpoint may
+    # show vs the serving params before the per-replica swap is REFUSED
+    # and the rollout halts + rolls back
+    rollout_drift_bound: float = 0.05
+    # SLO guard: halt + roll back the rollout when the router's
+    # smallest-window p99 (ms) or SERVER-error rate (5xx minus the 503
+    # shed statuses — designed 429/503 load shedding never halts a
+    # healthy deploy) breaches after any replica swap; 0 disables
+    # either arm
+    rollout_p99_ms: float = 0.0
+    rollout_error_rate: float = 0.25
+    # settle time after each replica swap before the SLO guard judges
+    rollout_settle_s: float = 1.0
+    # -- chaos drills (fleet/chaos.py, scripts/fault_inject.py)
+    # enable the replica's /admin/chaos fault endpoints (wedge the
+    # health probe, inject scoring latency) — the fleet chaos harness
+    # flips this; never on by default
+    chaos: bool = False
 
 
 @dataclass(frozen=True)

@@ -305,6 +305,45 @@ class AdmissionController:
             },
         }
 
+    def reseed(self, snapshot: dict, now: float | None = None) -> int:
+        """Restore token-bucket levels + the service-time EWMA from a
+        fleet_log summary record's admission snapshot — the router
+        restart / HA-takeover path (docs/fleet.md): a new router must
+        not hand every tenant a full burst the moment the old one dies,
+        or a failover doubles the admitted load exactly when the fleet
+        is most fragile. Returns the number of re-seeded buckets.
+
+        Tolerant by contract: a malformed snapshot re-seeds nothing
+        (fresh buckets are the safe default, never a crash), and levels
+        are clamped to each tenant's burst so a stale record cannot
+        grant more than the policy allows."""
+        if not isinstance(snapshot, dict):
+            return 0
+        now = self.clock() if now is None else now
+        n = 0
+        tokens = snapshot.get("tokens")
+        if isinstance(tokens, dict):
+            for tenant, level in tokens.items():
+                try:
+                    level = float(level)
+                except (TypeError, ValueError):
+                    continue
+                policy = self.policy_for(str(tenant))
+                bucket = self._bucket_for(policy, now)
+                with bucket._lock:
+                    bucket.tokens = max(
+                        0.0, min(policy.burst, level)
+                    )
+                    bucket._t = now
+                n += 1
+        ewma_ms = snapshot.get("service_ewma_ms")
+        if isinstance(ewma_ms, (int, float)) and ewma_ms > 0:
+            with self._lock:
+                self._service_ewma_s = float(ewma_ms) / 1e3
+        if n:
+            obs_metrics.REGISTRY.counter("fleet_ha/reseeded_buckets").inc(n)
+        return n
+
 
 # ---------------------------------------------------------------------------
 # multi-model co-serving capacity arbitration (PR-10 ledger signal)
@@ -334,3 +373,51 @@ def plan_coserving(
         else:
             refused.append(name)
     return loaded, refused
+
+
+#: working-set headroom over raw param bytes one replica needs: the AOT
+#: executable ladder, activation buffers at the padded batch budgets,
+#: and the restore-time double-residency window all live next to the
+#: params — the 4x factor matches the per-phase HBM watermarks the
+#: PR-10 ledger records for the serve smoke (docs/efficiency.md)
+REPLICA_HEADROOM = 4.0
+
+
+def plan_replicas(
+    entry_bytes: dict[str, float],
+    hbm_budget_bytes: float,
+    default: int = 2,
+    max_replicas: int = 16,
+    headroom: float = REPLICA_HEADROOM,
+) -> tuple[int, dict]:
+    """Default replica count from the per-entry param-bytes ledger
+    signal (ROADMAP item 2 remainder): when `fleet.replicas` is unset,
+    how many full serving stacks fit the host's HBM budget.
+
+    Rides `plan_coserving` for the entry arbitration (which entries one
+    replica holds), then divides the budget by the loaded set's working
+    set (param bytes x `headroom`). Unbudgeted hosts (budget <= 0) or
+    unmeasurable entries fall back to `default`. Returns (n, plan) where
+    the plan names every input — the caller logs it loudly, the count is
+    never silent."""
+    entry_bytes = {k: float(v) for k, v in (entry_bytes or {}).items()}
+    loaded, refused = plan_coserving(entry_bytes, hbm_budget_bytes)
+    per_replica = sum(entry_bytes[name] for name in loaded) * float(headroom)
+    plan = {
+        "entries": entry_bytes,
+        "loaded": loaded,
+        "refused": refused,
+        "hbm_budget_bytes": float(hbm_budget_bytes),
+        "headroom": float(headroom),
+        "per_replica_bytes": per_replica,
+    }
+    if hbm_budget_bytes <= 0 or per_replica <= 0:
+        plan["reason"] = (
+            "unbudgeted" if hbm_budget_bytes <= 0 else "unmeasured"
+        )
+        plan["replicas"] = int(default)
+        return int(default), plan
+    n = max(1, min(int(max_replicas), int(hbm_budget_bytes // per_replica)))
+    plan["reason"] = "ledger"
+    plan["replicas"] = n
+    return n, plan

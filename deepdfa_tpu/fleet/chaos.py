@@ -1,0 +1,438 @@
+"""Injectable fleet faults + the in-process chaos fixtures
+(docs/fleet.md failure matrix; scripts/fault_inject.py executes it).
+
+Three kinds of injectable failure, each landing at the exact layer the
+real failure would:
+
+  ChaosState        per-replica fault switchboard, driven by the
+                    replica's `/admin/chaos` endpoint (gated by
+                    `fleet.chaos`, never on by default): `wedge_s`
+                    flips the health probe to 503 and stalls /score
+                    past the router's forward timeout (the PR-6
+                    "backend wedge" class — process alive, work stuck);
+                    `latency_s` adds fixed scoring latency (the
+                    slow-replica scenario — deadline shedding must
+                    engage off the rising service-time EWMA).
+  Router.transport_fault  the partition fault: a callable installed on
+                    the router raising ConnectionError inside its HTTP
+                    client (fleet/router.py:_maybe_inject_fault) — the
+                    router->replica path drops while both processes
+                    stay healthy, forwards AND readmit probes fail.
+  corrupt heartbeat  no code needed: the harness writes a malformed
+                    announcement file and the router's quarantine path
+                    (fleet/heartbeat.py:scan_heartbeats_verbose) must
+                    absorb it.
+
+`StubRegistry` + `StubReplicaServer` are the in-process fleet: a
+registry-shaped stub over freshly-initialized params behind the REAL
+ScoringService + HTTP handler + heartbeat protocol — everything but the
+checkpoint round trip, which `fleet --smoke` and the subprocess chaos
+scenarios own. scripts/bench_load.py and the tier-1 chaos smoke both
+build their fleets from here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+
+class ChaosState:
+    """One replica's injected-fault switchboard (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wedge_until = 0.0
+        self._latency_s = 0.0
+        self._latency_until = 0.0
+
+    def apply(self, spec: dict, now: float | None = None) -> dict:
+        """One `/admin/chaos` document -> the new state. Accepts
+        {"wedge_s": x}, {"latency_s": x, "duration_s": d}, and
+        {"clear": true}; unknown keys are rejected loudly."""
+        now = time.monotonic() if now is None else now
+        known = {"wedge_s", "latency_s", "duration_s", "clear"}
+        unknown = sorted(set(spec) - known)
+        if unknown:
+            raise ValueError(f"unknown chaos keys {unknown}; in {sorted(known)}")
+        with self._lock:
+            if spec.get("clear"):
+                self._wedge_until = 0.0
+                self._latency_s = 0.0
+                self._latency_until = 0.0
+            if "wedge_s" in spec:
+                self._wedge_until = now + float(spec["wedge_s"])
+            if "latency_s" in spec:
+                self._latency_s = float(spec["latency_s"])
+                self._latency_until = now + float(
+                    spec.get("duration_s", 3600.0)
+                )
+            return self._view(now)
+
+    def _view(self, now: float) -> dict:
+        return {
+            "wedge_remaining_s": round(max(0.0, self._wedge_until - now), 3),
+            "latency_s": (
+                self._latency_s if now < self._latency_until else 0.0
+            ),
+            "latency_remaining_s": round(
+                max(0.0, self._latency_until - now), 3
+            ),
+        }
+
+    def view(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._view(now)
+
+    def wedged(self, now: float | None = None) -> float:
+        """Remaining wedge seconds (0 = healthy)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return max(0.0, self._wedge_until - now)
+
+    def delay(self) -> None:
+        """The /score-path injection point: stall for the wedge window
+        (the router's forward timeout fires first — exactly a wedged
+        backend), else sleep the injected latency."""
+        now = time.monotonic()
+        with self._lock:
+            wedge = max(0.0, self._wedge_until - now)
+            lat = self._latency_s if now < self._latency_until else 0.0
+        if wedge > 0:
+            time.sleep(wedge)
+        elif lat > 0:
+            time.sleep(lat)
+
+
+class StubRegistry:
+    """Registry-shaped stub over freshly initialized params: the chaos
+    drills and the load bench measure the fleet machinery, not
+    checkpoint IO (the restore path has its own e2e coverage in
+    `fleet --smoke` and the subprocess scenarios)."""
+
+    family = "deepdfa"
+    checkpoint = "init"
+
+    def __init__(self, cfg, model, params, vocabs, run_dir):
+        self.cfg = cfg
+        self._model = model
+        self._params = params
+        self.vocabs = vocabs
+        self.run_dir = Path(run_dir)
+
+    @property
+    def model(self):
+        return self._model
+
+    def params(self):
+        return self._params
+
+    def _feat_width(self) -> int:
+        from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
+
+        return NUM_SUBKEY_FEATS
+
+    def maybe_reload(self) -> bool:
+        return False
+
+    def info(self) -> dict:
+        return {
+            "family": self.family,
+            "run_dir": str(self.run_dir),
+            "checkpoint": self.checkpoint,
+            "checkpoint_step": 0,
+            "config_digest": "stub",
+            "vocab_digest": "stub",
+            "hot_swaps": 0,
+        }
+
+
+def stub_service(cfg, fleet_dir: Path, replica_id: str, model=None,
+                 params=None, vocabs=None):
+    """One real ScoringService over a StubRegistry (shared model/params
+    so N replicas warm N identical ladders without N model inits)."""
+    from deepdfa_tpu.serve.server import ScoringService
+
+    registry = StubRegistry(
+        cfg, model, params, vocabs, Path(fleet_dir) / replica_id
+    )
+    return ScoringService(registry, cfg)
+
+
+def build_stub_parts(cfg, n_corpus: int = 32, seed: int = 0):
+    """(model, params, vocabs, codes): the shared model-side parts of an
+    in-process fleet, plus a scoreable corpus."""
+    import jax
+
+    from deepdfa_tpu.data import build_dataset, generate, to_examples
+    from deepdfa_tpu.graphs.batch import pack
+    from deepdfa_tpu.models import DeepDFA
+
+    synth = generate(n_corpus, seed=seed)
+    examples = to_examples(synth)
+    _, vocabs = build_dataset(
+        examples, train_ids=range(n_corpus),
+        limit_all=cfg.data.feat.limit_all,
+        limit_subkeys=cfg.data.feat.limit_subkeys,
+    )
+    model = DeepDFA.from_config(
+        cfg.model, input_dim=cfg.data.feat.input_dim
+    )
+    params = model.init(jax.random.key(0), pack([], 1, 2048, 8192))
+    codes = [e.code for e in examples]
+    return model, params, vocabs, codes
+
+
+class StubReplicaServer:
+    """In-process replica: real ScoringService + the real serve HTTP
+    handler with the chaos injection points, announced via the real
+    heartbeat protocol — the tier-1 kill-router/wedge drills run against
+    these (no subprocess, no checkpoint; <60 s)."""
+
+    def __init__(self, cfg, fleet_dir, replica_id: str, service,
+                 host: str = "127.0.0.1"):
+        from http.server import ThreadingHTTPServer
+
+        from deepdfa_tpu.serve import server as serve_server
+
+        self.cfg = cfg
+        self.fleet_dir = Path(fleet_dir)
+        self.replica_id = str(replica_id)
+        self.service = service
+        self.chaos = ChaosState()
+        chaos = self.chaos
+
+        class _ChaosHandler(serve_server._Handler):
+            service = self.service
+
+            def do_GET(handler):  # noqa: N802, N805
+                if handler.path.startswith("/healthz") and chaos.wedged():
+                    handler._reply(503, {
+                        "error": "wedged (chaos)", "wedged": True,
+                    })
+                    return
+                serve_server._Handler.do_GET(handler)
+
+            def do_POST(handler):  # noqa: N802, N805
+                chaos.delay()
+                serve_server._Handler.do_POST(handler)
+
+        service.start()
+        self.httpd = ThreadingHTTPServer((host, 0), _ChaosHandler)
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name=f"stub-replica-{replica_id}", daemon=True,
+        )
+        self._thread.start()
+        self.beat()
+
+    def beat(self, state: str = "ready") -> None:
+        from deepdfa_tpu.fleet import heartbeat
+
+        heartbeat.write_heartbeat(
+            self.fleet_dir, self.replica_id, self.host, self.port,
+            state=state,
+            info={
+                "steady_state_recompiles": (
+                    self.service.steady_state_recompiles()
+                ),
+                "jit_lowerings": self.service._jit_lowerings(),
+            },
+        )
+
+    def corrupt_heartbeat(self, text: str = '{"heartbeat": {"state": "zombie"') -> Path:
+        """Overwrite this replica's announcement with damage (NON-atomic
+        on purpose — the failure being injected is a bad file, and the
+        next `beat()` heals it the way the real replica's refresh
+        would)."""
+        from deepdfa_tpu.fleet import heartbeat
+
+        path = heartbeat.heartbeat_path(self.fleet_dir, self.replica_id)
+        path.write_text(text)
+        return path
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=10)
+        self.service.close()
+
+
+class OpenLoopTraffic:
+    """Background open-loop Poisson traffic against a fleet router —
+    the load the rollout and router-failover drills run under
+    (scripts/fault_inject.py; the same arrival discipline as
+    scripts/bench_load.py's `bench_load`, packaged as a start/stop
+    driver next to the other shared chaos fixtures).
+
+    `resolve_addr` is called per attempt, so a request in flight on a
+    dead router follows the documented client contract: the send fails
+    at the transport level, the client RE-RESOLVES (the router.json
+    rendezvous, fleet/ha.py) and retries — waiting out the failover
+    window for the rendezvous to answer before giving up. An addr that
+    just failed is retried after `addr_cooldown_s` (not never): a
+    transient reset on a healthy router, and a takeover that re-binds
+    the SAME preferred port, must both land on retry. Results record
+    every outcome — status 0 means every attempt inside
+    `retry_window_s` failed at the transport level (a genuinely lost
+    request, which the drills assert never happens)."""
+
+    def __init__(
+        self,
+        resolve_addr,
+        codes: list[str],
+        rate_per_sec: float,
+        tenant: str = "drill",
+        deadline_ms: float | None = None,
+        seed: int = 0,
+        request_timeout_s: float = 60.0,
+        retry_window_s: float = 20.0,
+        addr_cooldown_s: float = 1.0,
+    ):
+        import random
+
+        self.resolve_addr = resolve_addr
+        self.codes = list(codes)
+        self.rate = float(rate_per_sec)
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+        self.request_timeout_s = float(request_timeout_s)
+        self.retry_window_s = float(retry_window_s)
+        self.addr_cooldown_s = float(addr_cooldown_s)
+        self.results: list[dict] = []
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._arrival: threading.Thread | None = None
+        self._senders: list[threading.Thread] = []
+
+    def _send(self, idx: int) -> None:
+        import http.client
+
+        payload: dict = {
+            "code": self.codes[idx % len(self.codes)],
+            "tenant": self.tenant,
+        }
+        if self.deadline_ms is not None:
+            payload["deadline_ms"] = float(self.deadline_ms)
+        body = json.dumps(payload)
+        t0 = time.monotonic()
+        deadline = t0 + self.retry_window_s
+        last_error = None
+        last_fail: dict = {}
+        retries = 0
+        while True:
+            addr = self.resolve_addr()
+            now = time.monotonic()
+            # a just-failed addr cools down before the next attempt
+            # (the rendezvous may move meanwhile — or the same front
+            # door may come back, which is equally a recovery)
+            if addr is not None and (
+                now - last_fail.get(addr, -1e9) >= self.addr_cooldown_s
+            ):
+                try:
+                    conn = http.client.HTTPConnection(
+                        addr[0], addr[1], timeout=self.request_timeout_s
+                    )
+                    try:
+                        conn.request(
+                            "POST", "/score", body=body,
+                            headers={
+                                "Content-Type": "application/json",
+                            },
+                        )
+                        resp = conn.getresponse()
+                        raw = resp.read()
+                        status = resp.status
+                    finally:
+                        conn.close()
+                except OSError as e:
+                    last_error = f"{type(e).__name__}: {e}"
+                    last_fail[addr] = time.monotonic()
+                    retries += 1
+                else:
+                    try:
+                        doc = json.loads(raw or b"{}")
+                    except json.JSONDecodeError:
+                        doc = {}
+                    with self._lock:
+                        self.results.append({
+                            "status": status,
+                            "latency_s": time.monotonic() - t0,
+                            "prob": doc.get("prob"),
+                            "reason": doc.get("reason"),
+                            "retried": retries,
+                        })
+                    return
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.25)
+        with self._lock:
+            self.results.append({
+                "status": 0,
+                "latency_s": time.monotonic() - t0,
+                "error": str(last_error)[:200],
+            })
+
+    def _arrivals(self) -> None:
+        idx = 0
+        while not self._stop.is_set():
+            gap = self._rng.expovariate(self.rate) if self.rate > 0 else 0.1
+            if self._stop.wait(gap):
+                break
+            t = threading.Thread(
+                target=self._send, args=(idx,), daemon=True,
+                name=f"open-loop-{idx}",
+            )
+            t.start()
+            self._senders.append(t)
+            idx += 1
+
+    def start(self) -> "OpenLoopTraffic":
+        self._arrival = threading.Thread(
+            target=self._arrivals, daemon=True, name="open-loop-arrivals"
+        )
+        self._arrival.start()
+        return self
+
+    def stop(self, timeout_s: float = 120.0) -> list[dict]:
+        """Stop arrivals, join every sender (bounded — the thread-audit
+        rule), return the recorded results."""
+        self._stop.set()
+        deadline = time.monotonic() + float(timeout_s)
+        if self._arrival is not None:
+            self._arrival.join(timeout=max(0.1, deadline - time.monotonic()))
+        for t in self._senders:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        with self._lock:
+            return list(self.results)
+
+
+def http_json(host: str, port: int, method: str, path: str,
+              payload: dict | None = None, headers: dict | None = None,
+              timeout: float = 60.0):
+    """One bounded HTTP round trip -> (status, parsed body). The chaos
+    harness's shared client; raises the usual transport errors so
+    callers can exercise the client's-retry contract themselves."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload) if payload is not None else None
+        hdrs = dict(headers or {})
+        if body:
+            hdrs.setdefault("Content-Type", "application/json")
+        conn.request(method, path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        raw = resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+    try:
+        return resp.status, json.loads(raw or "{}")
+    except json.JSONDecodeError:
+        return resp.status, {"raw": raw}

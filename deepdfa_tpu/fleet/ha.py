@@ -1,0 +1,382 @@
+"""Router high availability: an active/standby pair over one fleet dir
+(docs/fleet.md).
+
+The PR-11 router was the fleet's one unreplicated process. This module
+gives it the same treatment the replicas already get — announce, watch,
+fail over — built on the announcement directory both routers share:
+
+  router.json      the RENDEZVOUS file: the active router's own
+                   heartbeat (addr + monotone epoch + t_unix), written
+                   atomically on `fleet.rendezvous_interval_s`. Clients
+                   re-resolve the front door from it after a failover
+                   (`resolve_router`), the same way the fleet smoke's
+                   clients already re-read replica heartbeats.
+  fleet_log.jsonl  the shared log. The ACTIVE appends; periodic summary
+                   records carry the admission snapshot (token-bucket
+                   levels + service EWMA), which is exactly what the
+                   standby re-seeds from at takeover — a failover must
+                   not hand every tenant a fresh burst at the moment
+                   the fleet is most fragile.
+
+Roles, from the rendezvous file alone (no peer protocol):
+
+  standby   sees a fresh rendezvous owned by someone else. Keeps its
+            replica table warm by polling the heartbeat dir, serves no
+            traffic, appends nothing.
+  active    owns the rendezvous (highest epoch). Serves the front door,
+            refreshes the file, appends to the log.
+
+Failover: a rendezvous older than `fleet.router_failover_timeout_s`
+marks the active presumed-dead. The standby double-checks with one
+bounded `/healthz` probe (a stalled file write on a live router must
+not trigger a split brain), then takes over: re-seed admission from the
+log's last summary, bind its own front-door port, publish the
+rendezvous at epoch+1. The documented failover window is
+`router_failover_timeout_s + probe timeout + one standby poll`; past
+`2x router_failover_timeout_s` the probe is overridden (a router that
+answers healthz but cannot write its heartbeat is wedged, not healthy).
+
+Fencing: every active refresh first READS the file — a higher epoch
+means another router took over while this one was presumed dead, and
+the superseded active steps down (stops serving, detaches the log)
+instead of fighting. Epochs only grow, so exactly one router converges
+to active. In-flight requests on a dead router are the client's retry;
+no replica state is lost — replicas never see the failover at all.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+
+from deepdfa_tpu.fleet import router as router_mod
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+#: the rendezvous file name under the fleet dir
+ROUTER_FILE = "router.json"
+
+
+def rendezvous_path(fleet_dir: str | Path) -> Path:
+    return Path(fleet_dir) / ROUTER_FILE
+
+
+def write_rendezvous(
+    fleet_dir: str | Path,
+    router_id: str,
+    host: str,
+    port: int,
+    epoch: int,
+) -> Path:
+    """Atomically publish the active router's heartbeat."""
+    from deepdfa_tpu.core.ioutil import atomic_write_text
+
+    path = rendezvous_path(fleet_dir)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_text(path, json.dumps({"router": {
+        "router_id": str(router_id),
+        "host": str(host),
+        "port": int(port),
+        "epoch": int(epoch),
+        "t_unix": round(time.time(), 3),
+    }}))
+    return path
+
+
+def read_rendezvous(fleet_dir: str | Path) -> dict | None:
+    """The parsed rendezvous, or None when absent/unreadable."""
+    try:
+        doc = json.loads(rendezvous_path(fleet_dir).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    rv = doc.get("router") if isinstance(doc, dict) else None
+    if not isinstance(rv, dict):
+        return None
+    required = ("router_id", "host", "port", "epoch", "t_unix")
+    if any(k not in rv for k in required):
+        return None
+    return rv
+
+
+def resolve_router(
+    fleet_dir: str | Path, timeout_s: float = 0.0
+) -> tuple[str, int] | None:
+    """The client re-resolve helper: (host, port) of the current active
+    router per the rendezvous file, optionally waiting up to `timeout_s`
+    for one to appear (the post-failover window)."""
+    deadline = time.time() + float(timeout_s)
+    while True:
+        rv = read_rendezvous(fleet_dir)
+        if rv is not None:
+            return str(rv["host"]), int(rv["port"])
+        if time.time() >= deadline:
+            return None
+        time.sleep(0.05)
+
+
+class HARouter:
+    """One member of the active/standby router pair.
+
+    Wraps a fully-constructed `Router` (replica table, admission, SLO)
+    whose front-door HTTP server only exists while this member is
+    active. `start()` runs the role loop in a background thread (the
+    in-process form the chaos smoke drives); `run()` blocks (the
+    `fleet-router` CLI form)."""
+
+    def __init__(
+        self,
+        cfg,
+        fleet_dir: str | Path,
+        router_id: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        log_path: str | Path | None = None,
+    ):
+        self.cfg = cfg
+        self.fleet_dir = Path(fleet_dir)
+        self.router_id = str(router_id)
+        self.host = host
+        self.port = int(port)  # preferred; ephemeral fallback on takeover
+        self.log_path = (
+            Path(log_path) if log_path is not None
+            else self.fleet_dir / "fleet_log.jsonl"
+        )
+        fcfg = cfg.fleet
+        self.rendezvous_interval_s = float(fcfg.rendezvous_interval_s)
+        self.failover_timeout_s = float(fcfg.router_failover_timeout_s)
+        self.probe_timeout_s = min(2.0, self.failover_timeout_s)
+        # the standby's router carries NO log handle: only the active
+        # appends (attached at takeover, after the re-seed reads the
+        # previous active's last summary)
+        self.router = router_mod.router_from_config(
+            cfg, self.fleet_dir, log_path=None, reseed=False
+        )
+        self.role = "standby"
+        self.epoch = 0
+        self.httpd = None
+        self._serve_thread: threading.Thread | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._became_active = threading.Event()
+        r = obs_metrics.REGISTRY
+        self._m_takeovers = r.counter("fleet_ha/takeovers")
+        self._m_stepdowns = r.counter("fleet_ha/stepdowns")
+        self._m_role = r.gauge("fleet_ha/active")
+        self._m_failover = r.gauge("fleet_ha/failover_seconds")
+        self._m_role.set(0)
+
+    # -- role loop -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._loop_thread is not None:
+            return
+        self.step()  # one synchronous tick: a lone starter is active
+        self._loop_thread = threading.Thread(
+            target=self._loop, name=f"fleet-ha-{self.router_id}",
+            daemon=True,
+        )
+        self._loop_thread.start()
+
+    def run(self) -> None:
+        """Blocking form (`fleet-router` CLI); returns when closed."""
+        self.step()
+        while not self._closed.wait(self.rendezvous_interval_s):
+            try:
+                self.step()
+            except Exception:
+                logger.exception("ha router step failed")
+
+    def _loop(self) -> None:
+        while not self._closed.wait(self.rendezvous_interval_s):
+            try:
+                self.step()
+            except Exception:
+                logger.exception("ha router step failed")
+
+    def step(self, now: float | None = None) -> None:
+        """One role-loop tick: refresh-or-fence when active, watch-or-
+        takeover when standby."""
+        now = time.time() if now is None else now
+        rv = read_rendezvous(self.fleet_dir)
+        with self._lock:
+            role = self.role
+        if role == "active":
+            if rv is not None and rv["router_id"] != self.router_id and (
+                int(rv["epoch"]) > self.epoch
+                # equal-epoch tie (two standbys raced one takeover):
+                # deterministic id order picks the survivor — the pair
+                # converges in one tick instead of oscillating
+                or (int(rv["epoch"]) == self.epoch
+                    and str(rv["router_id"]) < self.router_id)
+            ):
+                # fenced: another router took over while this one was
+                # presumed dead (wedge, stall) — never fight the epoch
+                self.step_down(superseded_by=str(rv["router_id"]))
+                return
+            write_rendezvous(
+                self.fleet_dir, self.router_id, self.host, self.port,
+                self.epoch,
+            )
+            return
+        # standby: keep the replica table warm, watch the active
+        self.router.poll(force=True)
+        if rv is not None and rv["router_id"] == self.router_id:
+            # our own stale file (e.g. restarted in place): reclaim it
+            self.take_over(rv)
+            return
+        if rv is not None:
+            age = now - float(rv["t_unix"])
+            if age <= self.failover_timeout_s:
+                return
+            # presumed dead; one bounded probe guards against a live
+            # router whose file write stalled — but past twice the
+            # window a healthz-answering router that cannot write its
+            # heartbeat is wedged, and the fleet needs a front door
+            if age <= 2 * self.failover_timeout_s and self._probe(rv):
+                return
+        self.take_over(rv)
+
+    def _probe(self, rv: dict) -> bool:
+        try:
+            conn = http.client.HTTPConnection(
+                str(rv["host"]), int(rv["port"]),
+                timeout=self.probe_timeout_s,
+            )
+            try:
+                conn.request("GET", "/healthz")
+                return conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except router_mod.TRANSPORT_ERRORS:
+            return False
+
+    # -- transitions ---------------------------------------------------------
+
+    def take_over(self, rv: dict | None) -> None:
+        """standby -> active: re-seed admission from the log's last
+        summary, bind the front door, publish the rendezvous at
+        epoch+1."""
+        t0 = time.perf_counter()
+        stale_epoch = int(rv["epoch"]) if rv is not None else 0
+        reseeded = self.router.reseed_from_log(self.log_path)
+        self.router.log = router_mod.FleetLog(self.log_path)
+        try:
+            self.httpd = router_mod.make_router_server(
+                self.router, self.host, self.port
+            )
+        except OSError:
+            # the preferred port is still held (a wedged predecessor on
+            # this host): serve on an ephemeral one — clients re-resolve
+            # the new addr from the rendezvous either way
+            self.httpd = router_mod.make_router_server(
+                self.router, self.host, 0
+            )
+        self.port = self.httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name=f"fleet-ha-serve-{self.router_id}", daemon=True,
+        )
+        self._serve_thread.start()
+        self.router.start_polling()
+        with self._lock:
+            self.role = "active"
+            self.epoch = stale_epoch + 1
+        write_rendezvous(
+            self.fleet_dir, self.router_id, self.host, self.port,
+            self.epoch,
+        )
+        took = time.perf_counter() - t0
+        self._m_takeovers.inc()
+        self._m_role.set(1)
+        self._m_failover.set(round(took, 3))
+        self.router._event(
+            "takeover", router=self.router_id, epoch=self.epoch,
+            addr=f"{self.host}:{self.port}",
+            reseeded_buckets=reseeded,
+            takeover_seconds=round(took, 3),
+        )
+        self._became_active.set()
+        logger.warning(
+            "router %s took over (epoch %d) on %s:%d in %.3fs; "
+            "re-seeded %d admission bucket(s)",
+            self.router_id, self.epoch, self.host, self.port, took,
+            reseeded,
+        )
+
+    def step_down(self, superseded_by: str | None = None) -> None:
+        """active -> standby: stop serving, detach the log. The replica
+        table and admission state stay warm — a later takeover re-seeds
+        from the NEW active's summaries anyway."""
+        with self._lock:
+            if self.role != "active":
+                return
+            self.role = "standby"
+        self._m_stepdowns.inc()
+        self._m_role.set(0)
+        self.router._event(
+            "stepdown", router=self.router_id, epoch=self.epoch,
+            **({"superseded_by": superseded_by} if superseded_by else {}),
+        )
+        self._stop_serving()
+        if self.router.log is not None:
+            self.router.log.close()
+            self.router.log = None
+        self._became_active.clear()
+        logger.warning(
+            "router %s stepped down (superseded by %s)",
+            self.router_id, superseded_by,
+        )
+
+    def _stop_serving(self) -> None:
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd = None
+        if self._serve_thread is not None:
+            # bounded join (docs/fleet.md thread audit): a wedged serve
+            # thread must not hang the step-down/close path
+            self._serve_thread.join(timeout=10)
+            self._serve_thread = None
+
+    def wait_active(self, timeout_s: float = 30.0) -> bool:
+        return self._became_active.wait(timeout_s)
+
+    def kill(self) -> None:
+        """Abrupt-death test hook (the in-process kill-router drill):
+        drop the front door and every loop WITHOUT touching the
+        rendezvous — exactly what SIGKILL leaves behind. The wrapped
+        Router dies too (`Router.kill`): its poll loop and log handle
+        stop without the final summary record, so a 'dead' active
+        cannot keep appending frozen admission snapshots the next
+        takeover would wrongly re-seed from."""
+        self._closed.set()
+        if self.httpd is not None:
+            try:
+                self.httpd.shutdown()
+                self.httpd.server_close()
+            except Exception:
+                pass
+            self.httpd = None
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+            self._serve_thread = None
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+            self._loop_thread = None
+        self.router.kill()
+
+    def close(self) -> None:
+        """Graceful shutdown; every background thread joined with a
+        timeout (a wedged thread can delay close, never hang it)."""
+        self._closed.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+            self._loop_thread = None
+        self._stop_serving()
+        self.router.close()

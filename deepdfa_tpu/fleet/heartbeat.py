@@ -72,33 +72,81 @@ def write_heartbeat(
     return path
 
 
-def read_heartbeat(path: str | Path) -> dict | None:
-    """One parsed heartbeat document, or None when unreadable (a replica
-    mid-first-write, or a deleted file racing the scan)."""
-    try:
-        doc = json.loads(Path(path).read_text())
-    except (OSError, json.JSONDecodeError):
-        return None
+def validate_heartbeat(doc) -> tuple[dict | None, str | None]:
+    """(heartbeat, None) for a well-formed document, (None, reason) for
+    a malformed one. A heartbeat is malformed when the envelope is not
+    `{"heartbeat": {...}}`, a required field is missing, a field has an
+    un-coercible type, or the state is outside the declared lifecycle —
+    the router QUARANTINES the replica behind such a file instead of
+    crashing on it (docs/fleet.md failure matrix; the `corrupt-heartbeat`
+    chaos scenario executes this row)."""
+    if not isinstance(doc, dict):
+        return None, "not a JSON object"
     hb = doc.get("heartbeat")
     if not isinstance(hb, dict):
-        return None
+        return None, "no heartbeat object"
     required = ("replica_id", "host", "port", "state", "t_unix")
-    if any(k not in hb for k in required):
-        return None
+    missing = [k for k in required if k not in hb]
+    if missing:
+        return None, f"missing fields {missing}"
+    if hb["state"] not in STATES:
+        return None, f"unknown state {hb['state']!r}"
+    try:
+        port = int(hb["port"])
+        float(hb["t_unix"])
+    except (TypeError, ValueError):
+        return None, "port/t_unix not numeric"
+    if not (0 < port < 65536):
+        return None, f"port {port} out of range"
+    return hb, None
+
+
+def read_heartbeat(path: str | Path) -> dict | None:
+    """One parsed heartbeat document, or None when unreadable (a replica
+    mid-first-write, or a deleted file racing the scan) or malformed."""
+    hb, _ = read_heartbeat_verbose(path)
     return hb
+
+
+def read_heartbeat_verbose(
+    path: str | Path,
+) -> tuple[dict | None, str | None]:
+    """(heartbeat, None) | (None, reason) — the quarantine-aware read."""
+    try:
+        doc = json.loads(Path(path).read_text())
+    except OSError:
+        # a deleted file racing the scan is not evidence of anything
+        return None, None
+    except json.JSONDecodeError as e:
+        return None, f"not JSON ({e})"
+    return validate_heartbeat(doc)
 
 
 def scan_heartbeats(fleet_dir: str | Path) -> dict[str, dict]:
     """{replica_id: heartbeat} for every readable heartbeat file."""
+    beats, _ = scan_heartbeats_verbose(fleet_dir)
+    return beats
+
+
+def scan_heartbeats_verbose(
+    fleet_dir: str | Path,
+) -> tuple[dict[str, dict], dict[str, str]]:
+    """(beats, invalid): well-formed heartbeats by replica id, plus
+    {replica_id: reason} for every malformed announcement file — the
+    replica id derived from the `replica-<id>.json` filename so the
+    router can quarantine the SPECIFIC replica behind a corrupt file."""
     out: dict[str, dict] = {}
+    invalid: dict[str, str] = {}
     fleet_dir = Path(fleet_dir)
     if not fleet_dir.is_dir():
-        return out
+        return out, invalid
     for path in sorted(fleet_dir.glob("replica-*.json")):
-        hb = read_heartbeat(path)
+        hb, reason = read_heartbeat_verbose(path)
         if hb is not None:
             out[str(hb["replica_id"])] = hb
-    return out
+        elif reason is not None:
+            invalid[path.stem[len("replica-"):]] = reason
+    return out, invalid
 
 
 def is_fresh(hb: dict, timeout_s: float, now: float | None = None) -> bool:

@@ -39,7 +39,11 @@ import time
 from http.server import ThreadingHTTPServer
 from pathlib import Path
 
-from deepdfa_tpu.fleet import admission as fleet_admission, heartbeat
+from deepdfa_tpu.fleet import (
+    admission as fleet_admission,
+    chaos as fleet_chaos,
+    heartbeat,
+)
 from deepdfa_tpu.obs import (
     flight as obs_flight,
     ledger as obs_ledger,
@@ -114,15 +118,52 @@ def parse_model_spec(spec: str) -> tuple[str, str, str, str]:
 
 class _DrainingServer(ThreadingHTTPServer):
     """Handler threads are joined on close so a drain never abandons an
-    in-flight response. They must be NON-daemon for that: socketserver
-    only tracks non-daemon handler threads for the block_on_close join
-    (a daemon thread is dropped from the list and never joined). The
-    threads are short-lived by construction — every wait in the handler
-    is bounded by request_timeout_s — so they cannot pin the process
-    open indefinitely."""
+    in-flight response — but the join is BOUNDED. socketserver's own
+    block_on_close join is UNBOUNDED, so one wedged handler (a stuck
+    backend, an injected chaos stall) would hang the drain forever;
+    `server_close` here joins with a shared deadline instead: the drain
+    waits its bounded share for stragglers, logs what it abandoned, and
+    completes (docs/fleet.md thread audit). The threads are DAEMON —
+    tracked in our own list, not socketserver's — so an abandoned
+    wedged handler cannot re-block the process at interpreter exit
+    (threading._shutdown joins every non-daemon thread unbounded,
+    which would undo the bounded drain)."""
 
-    daemon_threads = False
-    block_on_close = True
+    daemon_threads = True
+    block_on_close = False  # socketserver's unbounded join stays off
+    #: total budget for joining in-flight handler threads at close
+    join_timeout_s = 30.0
+
+    def process_request(self, request, client_address):
+        # mirror ThreadingMixIn's tracking (daemon threads are dropped
+        # from socketserver's list) so the bounded join below has the
+        # thread list
+        t = threading.Thread(
+            target=self.process_request_thread,
+            args=(request, client_address),
+        )
+        t.daemon = True
+        if not hasattr(self, "_handler_threads"):
+            self._handler_threads = []
+        self._handler_threads = [
+            x for x in self._handler_threads if x.is_alive()
+        ]
+        self._handler_threads.append(t)
+        t.start()
+
+    def server_close(self):
+        super().server_close()
+        deadline = time.monotonic() + float(self.join_timeout_s)
+        wedged = []
+        for t in list(getattr(self, "_handler_threads", ())):
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                wedged.append(t.name)
+        if wedged:
+            logger.error(
+                "drain abandoned %d wedged handler thread(s) after "
+                "%.0fs: %s", len(wedged), self.join_timeout_s, wedged,
+            )
 
 
 class ReplicaWorker:
@@ -158,6 +199,12 @@ class ReplicaWorker:
         self._http_thread: threading.Thread | None = None
         self._state = "starting"
         self._state_lock = threading.Lock()
+        #: injected-fault switchboard (fleet/chaos.py), driven by
+        #: /admin/chaos when fleet.chaos is on — inert otherwise
+        self.chaos = fleet_chaos.ChaosState()
+        #: one swap at a time: a rollout controller retrying into a
+        #: replica mid-swap must queue, not interleave drains
+        self._swap_lock = threading.Lock()
 
     # -- construction --------------------------------------------------------
 
@@ -339,6 +386,86 @@ class ReplicaWorker:
             }
         return out
 
+    # -- rollout swap (fleet/rollout.py drives this via /admin/rollout) -----
+
+    def _wait_queue_drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until every co-served batcher's queue is empty (the
+        in-flight work the drain half of a swap must not abandon)."""
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            depth = sum(
+                s.batcher.stats()["queue_depth"]
+                for s in self.services.values()
+            )
+            if depth == 0:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def swap_primary(
+        self,
+        checkpoint: str | None,
+        drift_bound: float | None = None,
+        rollback: bool = False,
+    ) -> dict:
+        """The per-replica rollout step (docs/fleet.md): drain -> swap
+        -> re-warm -> readmit, with the replica back at `ready` whether
+        the swap landed or was refused (a refused swap leaves the OLD
+        weights serving — the replica never exits the fleet over it).
+
+        drain    heartbeat flips to `draining` (the router stops routing
+                 within its poll cadence), the lame-duck window passes,
+                 and the batcher queues empty out.
+        swap     registry.swap_checkpoint (drift-gated, rollback-capable)
+                 or registry.rollback().
+        re-warm  one execution through the smallest compiled ladder rung
+                 with the new params — proves the AOT executables still
+                 execute, and pins the zero-recompile census across the
+                 swap.
+        readmit  heartbeat back to `ready`; the router routes here again
+                 off the normal poll.
+        """
+        from deepdfa_tpu.serve.registry import RegistryError
+
+        svc = self.services[PRIMARY]
+        with self._swap_lock:
+            lowerings_before = svc._jit_lowerings()
+            self.set_state("draining")
+            try:
+                time.sleep(max(0.0, float(self.cfg.fleet.drain_announce_s)))
+                drained = self._wait_queue_drain()
+                if rollback:
+                    out = svc.registry.rollback()
+                    if out is None:
+                        raise RegistryError(
+                            "nothing to roll back to (no prior swap "
+                            "stashed on this replica)"
+                        )
+                else:
+                    out = svc.registry.swap_checkpoint(
+                        checkpoint, drift_bound=drift_bound
+                    )
+                # re-warm: run the smallest compiled rung once with the
+                # new params (empty padded batch — zero request cost;
+                # the GGNN executor's one bucket key is "graph")
+                if svc.registry.family == "deepdfa":
+                    svc.executor.execute("graph", [])
+                out.update(
+                    ok=True,
+                    drained=drained,
+                    recompiles=svc._jit_lowerings() - lowerings_before,
+                    steady_state_recompiles=(
+                        svc.steady_state_recompiles()
+                    ),
+                )
+                obs_metrics.REGISTRY.counter("rollout/swaps").inc()
+                return out
+            finally:
+                # readmit UNCONDITIONALLY: a refused swap still serves
+                # the old weights, and a replica stuck at `draining`
+                # would silently shrink the fleet
+                self.set_state(heartbeat.READY)
+
     def _make_server(self) -> ThreadingHTTPServer:
         worker = self
 
@@ -362,7 +489,15 @@ class ReplicaWorker:
 
                 url = urllib.parse.urlsplit(self.path)
                 query = urllib.parse.parse_qs(url.query)
-                if url.path == "/healthz":
+                if url.path == "/healthz" and worker.chaos.wedged():
+                    # the wedge-backend failure class (docs/fleet.md):
+                    # process alive, health probe flipped — the router
+                    # must eject and keep probing until recovery
+                    self._reply(503, {
+                        "error": "wedged (chaos)", "wedged": True,
+                        "replica_id": worker.replica_id,
+                    })
+                elif url.path == "/healthz":
                     deep = query.get("deep", ["0"])[0] not in (
                         "", "0", "false"
                     )
@@ -372,7 +507,88 @@ class ReplicaWorker:
                 else:
                     super().do_GET()
 
+            def do_POST(self):  # noqa: N802
+                if self.path.startswith("/admin/"):
+                    worker._handle_admin(self)
+                    return
+                # injected chaos (wedge stall / added latency) lands on
+                # the scoring path only — admin stays reachable so a
+                # drill can always clear its own fault
+                worker.chaos.delay()
+                super().do_POST()
+
         return _DrainingServer((self.host, self.port), _ReplicaHandler)
+
+    def _handle_admin(self, handler) -> None:
+        """POST /admin/rollout | /admin/chaos on this replica.
+
+        rollout: {"checkpoint": tag[, "drift_bound": b]} swaps the
+        primary entry (drain -> swap -> re-warm -> readmit);
+        {"rollback": true} undoes the last swap. A drift refusal answers
+        409 with the registry's message — the rollout controller's halt
+        signal. chaos: the fault switchboard, 403 unless fleet.chaos.
+        """
+        from deepdfa_tpu.serve.registry import RegistryError
+
+        try:
+            n = int(handler.headers.get("Content-Length", 0))
+            payload = json.loads(handler.rfile.read(n) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, KeyError) as e:
+            handler._reply(400, {"error": f"bad request: {e}"})
+            return
+        if handler.path == "/admin/rollout":
+            rollback = bool(payload.get("rollback"))
+            checkpoint = payload.get("checkpoint")
+            if not rollback and not checkpoint:
+                handler._reply(400, {
+                    "error": "rollout needs a checkpoint tag "
+                             "(or rollback: true)",
+                })
+                return
+            drift_bound = payload.get("drift_bound")
+            try:
+                out = self.swap_primary(
+                    checkpoint,
+                    drift_bound=(
+                        float(drift_bound) if drift_bound is not None
+                        else None
+                    ),
+                    rollback=rollback,
+                )
+            except RegistryError as e:
+                obs_metrics.REGISTRY.counter("rollout/refusals").inc()
+                handler._reply(409, {
+                    "ok": False, "refused": True, "error": str(e),
+                    "replica_id": self.replica_id,
+                })
+                return
+            except Exception as e:  # noqa: BLE001 - admin must answer
+                logger.exception("rollout swap failed")
+                handler._reply(500, {"ok": False, "error": str(e)})
+                return
+            out["replica_id"] = self.replica_id
+            handler._reply(200, out)
+        elif handler.path == "/admin/chaos":
+            if not getattr(self.cfg.fleet, "chaos", False):
+                handler._reply(403, {
+                    "error": "chaos endpoints disabled (set "
+                             "fleet.chaos=true to run drills)",
+                })
+                return
+            try:
+                state = self.chaos.apply(payload)
+            except ValueError as e:
+                handler._reply(400, {"error": str(e)})
+                return
+            handler._reply(200, {
+                "ok": True, "replica_id": self.replica_id, **state,
+            })
+        else:
+            handler._reply(404, {
+                "error": f"no admin route {handler.path}",
+            })
 
     def start(self) -> None:
         """Build, warm, bind, announce — returns with the replica
@@ -459,6 +675,60 @@ class ReplicaWorker:
             return 0
         finally:
             handler.uninstall()
+
+
+def estimate_param_bytes_on_disk(
+    run_dir: str | Path, family: str, checkpoint: str
+) -> float:
+    """One entry's checkpoint bytes on disk — the pre-spawn stand-in
+    for the measured param-bytes signal (the fleet parent must size the
+    fleet BEFORE any replica restores anything). Conservative for @int8
+    entries (the disk tree is fp32; the served tree is ~0.26x), honest
+    for everything else. 0.0 when unresolvable — the planner falls back
+    to the default count, never crashes the bring-up."""
+    from deepdfa_tpu.serve import quant
+    from deepdfa_tpu.serve.registry import CKPT_DIR_BY_FAMILY
+
+    base, _ = quant.split_checkpoint_tag(checkpoint)
+    ckpt_dir = Path(run_dir) / CKPT_DIR_BY_FAMILY.get(
+        family, "checkpoints"
+    )
+    tag = base
+    if tag == "last":
+        try:
+            manifest = json.loads(
+                (ckpt_dir / "manifest.json").read_text()
+            )
+            tag = (manifest.get("last") or {}).get("tag") or tag
+        except (OSError, json.JSONDecodeError):
+            pass
+    tag_dir = ckpt_dir / tag
+    if not tag_dir.is_dir():
+        return 0.0
+    try:
+        return float(sum(
+            p.stat().st_size for p in tag_dir.rglob("*") if p.is_file()
+        ))
+    except OSError:
+        return 0.0
+
+
+def estimate_entry_bytes(cfg, run_dir: str | Path) -> dict[str, float]:
+    """{entry name: on-disk checkpoint bytes} for the primary + every
+    `fleet.models` co-serving spec — the `plan_replicas` input when
+    `fleet.replicas` is unset (ROADMAP item 2 remainder)."""
+    out = {
+        PRIMARY: estimate_param_bytes_on_disk(
+            run_dir, "deepdfa", cfg.serve.checkpoint
+        ),
+    }
+    for spec in cfg.fleet.models:
+        try:
+            name, family, entry_dir, ckpt = parse_model_spec(spec)
+        except ValueError:
+            continue
+        out[name] = estimate_param_bytes_on_disk(entry_dir, family, ckpt)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,295 @@
+"""Zero-downtime checkpoint rollout across a serving fleet
+(docs/fleet.md; `deepdfa-tpu fleet-rollout`).
+
+Hot-swaps a new checkpoint tag across the fleet ONE replica at a time
+while the router keeps serving — the drill the failure matrix's
+"deploy" row executes under `bench_load.py` traffic:
+
+  per replica   drain -> swap -> re-warm -> readmit, all replica-side
+                (fleet/replica.py:swap_primary via POST /admin/rollout):
+                the heartbeat flips to `draining`, the router stops
+                routing there within its poll cadence, the swap is one
+                reference assignment against the same AOT executables
+                (zero recompiles), and `ready` readmits it.
+  drift gate    the replica refuses a swap whose calibration score
+                drift vs the serving params exceeds
+                `fleet.rollout_drift_bound` (the PR-12 machinery,
+                serve/registry.py:swap_checkpoint) — a bad checkpoint
+                halts the rollout at the FIRST replica, before it ever
+                serves a request.
+  SLO guard     between swaps the controller reads the router's
+                smallest SLO window; a windowed p99 past
+                `fleet.rollout_p99_ms` (when set) or a SERVER-error
+                rate (5xx minus 503 sheds) past
+                `fleet.rollout_error_rate` HALTS the rollout and rolls
+                every already-swapped replica back to the prior tag
+                (registry rollback stash — no disk round trip).
+
+Every step is a `{"rollout": {...}}` record in the shared
+fleet_log.jsonl (validate_fleet_log checks the vocabulary), and the
+report pins the zero-recompile census across the whole event.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+
+from deepdfa_tpu.fleet import chaos as fleet_chaos, ha, heartbeat
+from deepdfa_tpu.fleet.router import FleetLog, ROLLOUT_EVENTS
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+
+class SloGuard:
+    """The halt condition: windowed p99 / error rate off the router's
+    /stats SLO snapshot (smallest window — the one that reacts inside a
+    rollout's timescale).
+
+    The error-rate arm counts GENUINE server failures only — 5xx except
+    503 (i.e. 500/502/504). 429 rate-limit and 503 deadline/overload/
+    no_replicas responses are the fleet's DESIGNED shed behavior
+    (fleet/admission.py): a tenant at its token-bucket limit during a
+    rollout is load shedding working, not the new checkpoint failing,
+    and must not halt + roll back a healthy deploy. (A checkpoint that
+    wedges replicas still trips the p99 arm.) Either arm set to 0
+    disables it."""
+
+    def __init__(self, p99_ms: float = 0.0, error_rate: float = 0.25):
+        self.p99_ms = float(p99_ms)
+        self.error_rate = float(error_rate)
+
+    def read(self, host: str, port: int) -> dict:
+        status, stats = fleet_chaos.http_json(
+            host, port, "GET", "/stats", timeout=30.0
+        )
+        if status != 200:
+            return {"ok": False, "reason": f"router /stats -> {status}"}
+        slo = stats.get("slo") or {}
+        windows = sorted(
+            (k for k in slo if isinstance(slo.get(k), dict)
+             and k.endswith("s") and k[:-1].isdigit()),
+            key=lambda k: int(k[:-1]),
+        )
+        if not windows:
+            return {"ok": True, "reason": "no window data yet"}
+        view = slo[windows[0]]
+        p99 = (
+            ((view.get("latency_ms") or {}).get("total") or {}).get("p99")
+        )
+        # genuine failures only: 5xx minus the 503 shed statuses; the
+        # window's raw error_rate also counts designed 429/503 sheds
+        counts = view.get("status") or {}
+        n = sum(counts.values())
+        err = None
+        if n:
+            failures = sum(
+                v for k, v in counts.items()
+                if str(k).startswith("5") and str(k) != "503"
+            )
+            err = round(failures / n, 4)
+        out = {
+            "ok": True,
+            "window": windows[0],
+            "p99_ms": p99,
+            "error_rate": err,
+        }
+        if (
+            self.p99_ms > 0
+            and isinstance(p99, (int, float))
+            and p99 > self.p99_ms
+        ):
+            out.update(ok=False, reason=(
+                f"windowed p99 {p99:.1f}ms > guard {self.p99_ms:g}ms"
+            ))
+        elif (
+            self.error_rate > 0
+            and isinstance(err, (int, float))
+            and err > self.error_rate
+        ):
+            out.update(ok=False, reason=(
+                f"windowed server-error rate {err:.3f} > guard "
+                f"{self.error_rate:g}"
+            ))
+        return out
+
+
+def _record(log: FleetLog | None, event: str, checkpoint: str, **fields):
+    assert event in ROLLOUT_EVENTS, event
+    obs_metrics.REGISTRY.counter(f"rollout/{event}").inc()
+    if log is not None:
+        log.append({"rollout": {
+            "event": event,
+            "checkpoint": checkpoint,
+            "t_unix": round(time.time(), 3),
+            **fields,
+        }})
+
+
+def _ready_replicas(fleet_dir, timeout_s: float) -> dict[str, dict]:
+    beats = heartbeat.scan_heartbeats(fleet_dir)
+    return {
+        rid: hb for rid, hb in sorted(beats.items())
+        if hb.get("state") == heartbeat.READY
+        and heartbeat.is_fresh(hb, timeout_s)
+    }
+
+
+def run_rollout(
+    cfg,
+    fleet_dir: str | Path,
+    checkpoint: str,
+    router_addr: tuple[str, int] | None = None,
+    log_path: str | Path | None = None,
+    swap_timeout_s: float = 300.0,
+) -> dict:
+    """Roll `checkpoint` across every ready replica; the report the CLI
+    prints and the chaos drill asserts on. Never raises for a halted
+    rollout — the halt, its reason, and the rollback outcome ARE the
+    report."""
+    fleet_dir = Path(fleet_dir)
+    fcfg = cfg.fleet
+    if router_addr is None:
+        router_addr = ha.resolve_router(fleet_dir)
+    log = FleetLog(log_path) if log_path is not None else None
+    guard = SloGuard(fcfg.rollout_p99_ms, fcfg.rollout_error_rate)
+    replicas = _ready_replicas(fleet_dir, fcfg.heartbeat_timeout_s)
+    report: dict = {
+        "checkpoint": checkpoint,
+        "drift_bound": float(fcfg.rollout_drift_bound),
+        "replicas": [],
+        "halted": False,
+        "rolled_back": [],
+        "router": (
+            f"{router_addr[0]}:{router_addr[1]}" if router_addr else None
+        ),
+    }
+    if not replicas:
+        report.update(ok=False, error="no ready replicas to roll")
+        if log is not None:
+            log.close()
+        return report
+
+    swapped: list[tuple[str, dict]] = []
+
+    def halt(reason: str, **fields) -> None:
+        report["halted"] = True
+        report["halt_reason"] = reason
+        _record(log, "halt", checkpoint, reason=reason[:300], **fields)
+        # roll every already-swapped replica back, NEWEST first (the
+        # registry stash makes this a reference assignment per replica)
+        for rid, hb in reversed(swapped):
+            try:
+                status, resp = fleet_chaos.http_json(
+                    str(hb["host"]), int(hb["port"]),
+                    "POST", "/admin/rollout", {"rollback": True},
+                    timeout=swap_timeout_s,
+                )
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                status, resp = 0, {"error": str(e)}
+            _record(
+                log, "rollback", checkpoint, replica=rid,
+                status=status,
+            )
+            report["rolled_back"].append({
+                "replica": rid, "status": status,
+                "checkpoint": resp.get("checkpoint"),
+            })
+
+    _record(
+        log, "start", checkpoint, replicas=len(replicas),
+        drift_bound=float(fcfg.rollout_drift_bound),
+    )
+    try:
+        for rid, hb in replicas.items():
+            if router_addr is not None:
+                pre = guard.read(*router_addr)
+                if not pre.get("ok"):
+                    halt(f"SLO guard before {rid}: {pre.get('reason')}")
+                    break
+            try:
+                status, resp = fleet_chaos.http_json(
+                    str(hb["host"]), int(hb["port"]),
+                    "POST", "/admin/rollout",
+                    {
+                        "checkpoint": checkpoint,
+                        "drift_bound": float(fcfg.rollout_drift_bound),
+                    },
+                    timeout=swap_timeout_s,
+                )
+            except Exception as e:  # noqa: BLE001 - transport = halt
+                halt(f"replica {rid} unreachable mid-swap: {e}")
+                break
+            entry = {
+                "replica": rid, "status": status,
+                "drift": resp.get("drift"),
+                "checkpoint_step": resp.get("checkpoint_step"),
+                "recompiles": resp.get("recompiles"),
+                "steady_state_recompiles": resp.get(
+                    "steady_state_recompiles"
+                ),
+            }
+            report["replicas"].append(entry)
+            if status == 409:
+                _record(
+                    log, "refused", checkpoint, replica=rid,
+                    error=str(resp.get("error"))[:300],
+                )
+                halt(
+                    f"replica {rid} refused the swap (score drift past "
+                    f"bound): {resp.get('error')}"
+                )
+                break
+            if status != 200 or not resp.get("ok"):
+                halt(
+                    f"replica {rid} swap failed "
+                    f"(status {status}): {resp.get('error')}"
+                )
+                break
+            _record(
+                log, "swap", checkpoint, replica=rid,
+                drift=resp.get("drift"),
+                checkpoint_step=resp.get("checkpoint_step"),
+                recompiles=resp.get("recompiles"),
+            )
+            swapped.append((rid, hb))
+            # settle, then judge: the windowed guard needs post-swap
+            # traffic through the readmitted replica before it means
+            # anything
+            time.sleep(max(0.0, float(fcfg.rollout_settle_s)))
+            if router_addr is not None:
+                post = guard.read(*router_addr)
+                entry["guard"] = {
+                    k: post.get(k) for k in ("p99_ms", "error_rate")
+                }
+                if not post.get("ok"):
+                    halt(f"SLO guard after {rid}: {post.get('reason')}")
+                    break
+        else:
+            _record(
+                log, "complete", checkpoint, replicas=len(swapped),
+            )
+        report["swapped"] = [rid for rid, _ in swapped]
+        report["ok"] = not report["halted"] and len(swapped) == len(
+            replicas
+        )
+        # the zero-recompile census across the whole event, straight
+        # from the replicas' own lowering counters
+        census = {}
+        for rid, hb in replicas.items():
+            try:
+                _, h = fleet_chaos.http_json(
+                    str(hb["host"]), int(hb["port"]), "GET", "/healthz",
+                    timeout=30.0,
+                )
+                census[rid] = h.get("steady_state_recompiles")
+            except Exception:  # noqa: BLE001
+                census[rid] = None
+        report["census"] = census
+        report["census_ok"] = all(v == 0 for v in census.values())
+    finally:
+        if log is not None:
+            log.close()
+    return report

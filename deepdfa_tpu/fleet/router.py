@@ -50,8 +50,20 @@ from deepdfa_tpu.serve.batcher import new_request_id
 
 logger = logging.getLogger(__name__)
 
-#: the declared fleet_event vocabulary (validate_fleet_log enforces it)
-EVENTS = ("join", "eject", "readmit", "drain_observed", "gone")
+#: the declared fleet_event vocabulary (validate_fleet_log enforces it);
+#: quarantine = malformed announcement file (fleet/heartbeat.py), and
+#: takeover/stepdown are the router-HA transitions (fleet/ha.py)
+EVENTS = (
+    "join", "eject", "readmit", "drain_observed", "gone",
+    "quarantine", "takeover", "stepdown",
+)
+
+#: the declared rollout-record vocabulary (fleet/rollout.py appends
+#: {"rollout": {...}} lines to the same fleet_log; validate_fleet_log
+#: enforces the names here)
+ROLLOUT_EVENTS = (
+    "start", "swap", "refused", "halt", "rollback", "complete",
+)
 
 #: transport-level failures that mean "the replica, not the request"
 TRANSPORT_ERRORS = (
@@ -92,7 +104,7 @@ class ReplicaView:
     __slots__ = (
         "id", "host", "port", "state", "t_heartbeat", "info",
         "outstanding", "ejected", "consecutive_failures", "forwarded",
-        "drain_logged",
+        "drain_logged", "quarantined",
     )
 
     def __init__(self, hb: dict):
@@ -102,6 +114,7 @@ class ReplicaView:
         self.consecutive_failures = 0
         self.forwarded = 0
         self.drain_logged = False
+        self.quarantined = False
         self.update(hb)
 
     def update(self, hb: dict) -> None:
@@ -117,6 +130,7 @@ class ReplicaView:
     def routable(self, timeout_s: float, now: float) -> bool:
         return (
             not self.ejected
+            and not self.quarantined
             and self.state == heartbeat.READY
             and (now - self.t_heartbeat) <= timeout_s
         )
@@ -129,6 +143,7 @@ class ReplicaView:
             "outstanding": self.outstanding,
             "forwarded": self.forwarded,
             "ejected": self.ejected,
+            "quarantined": self.quarantined,
             "routable": self.routable(timeout_s, now),
             "heartbeat_age_s": round(now - self.t_heartbeat, 3),
             "steady_state_recompiles": self.info.get(
@@ -163,6 +178,7 @@ class Router:
         log: FleetLog | None = None,
         slo: SloEngine | None = None,
         probe_timeout_s: float = 5.0,
+        summary_interval_s: float = 0.0,
     ):
         self.fleet_dir = Path(fleet_dir)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
@@ -171,11 +187,23 @@ class Router:
         self.retries = max(0, int(retries))
         self.request_timeout_s = float(request_timeout_s)
         self.probe_timeout_s = float(probe_timeout_s)
+        self.summary_interval_s = float(summary_interval_s)
         self.admission = admission or fleet_admission.AdmissionController()
         self.log = log
         self.slo = slo or SloEngine()
         self._lock = threading.Lock()
         self._replicas: dict[str, ReplicaView] = {}
+        #: replica ids currently behind a malformed announcement file,
+        #: with the reason — quarantine is logged once per transition,
+        #: not once per poll tick
+        self._quarantine_reasons: dict[str, str] = {}
+        #: injectable transport fault in the router's HTTP client (the
+        #: `partition` chaos scenario, scripts/fault_inject.py): a
+        #: callable (replica_id) -> falsy (healthy) | reason string; a
+        #: faulted forward/probe raises ConnectionError exactly where a
+        #: dropped network path would
+        self.transport_fault = None
+        self._last_summary = time.monotonic()
         self._last_poll = 0.0
         self._closed = threading.Event()
         self._poll_thread: threading.Thread | None = None
@@ -186,6 +214,7 @@ class Router:
         self._m_ejects = r.counter("fleet/ejects")
         self._m_readmits = r.counter("fleet/readmits")
         self._m_unroutable = r.counter("fleet/unroutable")
+        self._m_quarantines = r.counter("fleet/quarantines")
         self._m_healthy = r.gauge("fleet/replicas_routable")
         self._m_known = r.gauge("fleet/replicas_known")
         self.poll(force=True)
@@ -209,7 +238,32 @@ class Router:
             if not force and (now - self._last_poll) < self.poll_interval_s:
                 return
             self._last_poll = now
-        beats = heartbeat.scan_heartbeats(self.fleet_dir)
+        beats, invalid = heartbeat.scan_heartbeats_verbose(self.fleet_dir)
+        # malformed announcement files QUARANTINE the replica behind
+        # them (docs/fleet.md failure matrix): the replica's state is
+        # unknowable, so it must not be routed — but a corrupt file is
+        # never allowed to crash the router or churn events every tick
+        quarantine_events: list[tuple[str, str]] = []
+        with self._lock:
+            for rid, reason in invalid.items():
+                if self._quarantine_reasons.get(rid) != reason:
+                    self._quarantine_reasons[rid] = reason
+                    quarantine_events.append((rid, reason))
+                rep = self._replicas.get(rid)
+                if rep is not None:
+                    rep.quarantined = True
+            for rid in list(self._quarantine_reasons):
+                if rid not in invalid and rid in beats:
+                    # the replica's own next atomic rewrite healed the
+                    # file: the quarantine lifts and the replica is
+                    # routable again off its fresh, valid heartbeat
+                    del self._quarantine_reasons[rid]
+                    rep = self._replicas.get(rid)
+                    if rep is not None:
+                        rep.quarantined = False
+        for rid, reason in quarantine_events:
+            self._m_quarantines.inc()
+            self._event("quarantine", replica=rid, reason=reason[:200])
         with self._lock:
             for rid, hb in beats.items():
                 rep = self._replicas.get(rid)
@@ -266,6 +320,7 @@ class Router:
             ]
         for rid, host, port in targets:
             try:
+                self._maybe_inject_fault(rid)
                 conn = http.client.HTTPConnection(
                     host, port, timeout=self.probe_timeout_s
                 )
@@ -298,8 +353,35 @@ class Router:
             try:
                 self.poll(force=True)
                 self.probe_ejected()
+                self._maybe_summarize()
             except Exception:
                 logger.exception("fleet poll failed")
+
+    def _maybe_summarize(self) -> None:
+        """Periodic fleet_log summary record (fleet.summary_interval_s):
+        each one embeds the admission snapshot, so a router that dies is
+        at most one cadence behind on the token-bucket levels its
+        successor re-seeds from (fleet/ha.py takeover, or a plain
+        restart)."""
+        if self.log is None or self.summary_interval_s <= 0:
+            return
+        now = time.monotonic()
+        if (now - self._last_summary) < self.summary_interval_s:
+            return
+        self._last_summary = now
+        self.log.append(self.summary_record())
+
+    def _maybe_inject_fault(self, replica_id: str) -> None:
+        """The injectable transport fault (the `partition` chaos
+        scenario): raise the same error class a dropped router->replica
+        network path produces, at the same point in the client."""
+        fault = self.transport_fault
+        if fault is not None:
+            reason = fault(replica_id)
+            if reason:
+                raise ConnectionError(
+                    f"injected transport fault to {replica_id}: {reason}"
+                )
 
     # -- routing -------------------------------------------------------------
 
@@ -378,6 +460,7 @@ class Router:
                     replica=rep.id,
                 ):
                     obs_trace.flow("request", request_id, "s", cat="fleet")
+                    self._maybe_inject_fault(rep.id)
                     conn = http.client.HTTPConnection(
                         rep.host, rep.port, timeout=self.request_timeout_s
                     )
@@ -472,7 +555,9 @@ class Router:
     def summary_record(self) -> dict:
         """One fleet_log summary record (the run-log shape the schema
         checker validates): the fleet/* registry snapshot, the SLO
-        windows, and the topology scalars."""
+        windows, the topology scalars, and the admission snapshot (the
+        token-bucket levels + service EWMA a restarted or failed-over
+        router re-seeds from — `reseed_from_log`)."""
         snap = obs_metrics.REGISTRY.snapshot()
         return {
             "fleet": {
@@ -481,7 +566,52 @@ class Router:
             },
             "fleet_slo": self.slo.snapshot(),
             "fleet_replicas": self.routable_count(),
+            "fleet_admission": self.admission.snapshot(),
         }
+
+    #: how much log tail the re-seed scans for the last summary record;
+    #: summaries land every summary_interval_s between request lines,
+    #: so a few hundred KB always covers several cadences — and the
+    #: read sits on the TAKEOVER critical path, where scanning a
+    #: multi-GB request log would blow the documented failover bound
+    RESEED_TAIL_BYTES = 4 << 20
+
+    def reseed_from_log(self, path: str | Path) -> int:
+        """Restore admission state from the LAST summary record in a
+        fleet_log.jsonl — the router-restart/HA-takeover half of the
+        no-lost-state contract (docs/fleet.md). An absent, empty, or
+        corrupt log re-seeds nothing: fresh buckets, never a crash.
+        Only a bounded tail is read (RESEED_TAIL_BYTES); the first
+        line after the seek may be torn mid-record, which the
+        per-line JSON parse below already skips. Returns the number
+        of re-seeded buckets."""
+        try:
+            with Path(path).open("rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - self.RESEED_TAIL_BYTES))
+                lines = f.read().decode("utf-8", "replace").splitlines()
+        except OSError:
+            return 0
+        for line in reversed(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and isinstance(
+                rec.get("fleet_admission"), dict
+            ):
+                n = self.admission.reseed(rec["fleet_admission"])
+                if n:
+                    logger.info(
+                        "re-seeded %d admission bucket(s) from the last "
+                        "summary record in %s", n, path,
+                    )
+                return n
+        return 0
 
     def close(self) -> None:
         self._closed.set()
@@ -491,14 +621,35 @@ class Router:
         if self.log is not None:
             self.log.append(self.summary_record())
             self.log.close()
+            self.log = None
+
+    def kill(self) -> None:
+        """Abrupt-death test hook (the in-process kill-router drill):
+        stop the poll loop and drop the log handle WITHOUT the final
+        summary record — a SIGKILLed router writes nothing more. Without
+        this, a 'dead' in-process active would keep appending summaries
+        (frozen admission snapshots) to the shared fleet_log, and a
+        later takeover could re-seed from the zombie's stale record."""
+        self._closed.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+            self._poll_thread = None
+        if self.log is not None:
+            self.log.close()
+            self.log = None
 
 
 def router_from_config(
-    cfg, fleet_dir: str | Path, log_path: str | Path | None = None
+    cfg,
+    fleet_dir: str | Path,
+    log_path: str | Path | None = None,
+    reseed: bool = True,
 ) -> Router:
     """One configured Router (admission policies, cadences, SLO windows,
     fleet log) from a Config — the `fleet` CLI's and the smoke's shared
-    construction path."""
+    construction path. `reseed` restores token-bucket levels from the
+    log's last summary record BEFORE the log handle is (re)opened for
+    append — a no-op on a fresh log, the restart contract otherwise."""
     fcfg = cfg.fleet
     admission = fleet_admission.AdmissionController(
         tenants=fleet_admission.parse_tenants(fcfg.tenants),
@@ -510,7 +661,7 @@ def router_from_config(
         service_time_init_ms=fcfg.service_time_init_ms,
         cascade_shed_fraction=fcfg.cascade_shed_fraction,
     )
-    return Router(
+    router = Router(
         fleet_dir,
         heartbeat_timeout_s=fcfg.heartbeat_timeout_s,
         poll_interval_s=fcfg.poll_interval_s,
@@ -523,7 +674,11 @@ def router_from_config(
             windows=cfg.serve.slo_windows,
             max_samples=cfg.serve.slo_window_samples,
         ),
+        summary_interval_s=fcfg.summary_interval_s,
     )
+    if reseed and log_path is not None:
+        router.reseed_from_log(log_path)
+    return router
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -722,10 +877,13 @@ class BackgroundRouter:
 def validate_fleet_log(path: str | Path) -> dict:
     """Structural + schema validation of a router fleet_log.jsonl.
 
-    Three legal line shapes: {"request": {...}} per-request entries
+    Four legal line shapes: {"request": {...}} per-request entries
     (id + status required), {"fleet_event": {...}} lifecycle events
-    (declared name + t_unix required), and summary records embedding
-    the fleet/* registry snapshot + fleet_slo windows. Every flattened
+    (declared name + t_unix required, incl. the HA takeover/stepdown and
+    quarantine transitions), {"rollout": {...}} rollout records
+    (fleet/rollout.py; declared event + t_unix + checkpoint required),
+    and summary records embedding the fleet/* registry snapshot +
+    fleet_slo windows + the admission re-seed snapshot. Every flattened
     scalar tag must be declared in obs/metrics.py:SCHEMA — the same
     drift guard the train/serve/scan logs get."""
     path = Path(path)
@@ -735,7 +893,7 @@ def validate_fleet_log(path: str | Path) -> dict:
         lines = path.read_text().splitlines()
     except OSError as e:
         return {"ok": False, "problems": [f"unreadable: {e}"]}
-    n_requests = n_events = n_summaries = 0
+    n_requests = n_events = n_summaries = n_rollouts = 0
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -772,6 +930,21 @@ def validate_fleet_log(path: str | Path) -> dict:
                 problems.append(
                     f"line {lineno}: fleet_event missing t_unix"
                 )
+        elif "rollout" in rec:
+            n_rollouts += 1
+            ro = rec["rollout"]
+            if not isinstance(ro, dict):
+                problems.append(f"line {lineno}: rollout not an object")
+            elif ro.get("event") not in ROLLOUT_EVENTS:
+                problems.append(
+                    f"line {lineno}: rollout event {ro.get('event')!r} "
+                    f"not in declared set {ROLLOUT_EVENTS}"
+                )
+            elif "t_unix" not in ro or "checkpoint" not in ro:
+                problems.append(
+                    f"line {lineno}: rollout record missing "
+                    f"t_unix/checkpoint"
+                )
         elif "fleet" in rec or "fleet_slo" in rec:
             n_summaries += 1
         else:
@@ -788,6 +961,7 @@ def validate_fleet_log(path: str | Path) -> dict:
         "requests": n_requests,
         "events": n_events,
         "summaries": n_summaries,
+        "rollouts": n_rollouts,
         "undeclared": undeclared,
         "problems": problems,
     }

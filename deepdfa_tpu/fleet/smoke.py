@@ -60,7 +60,7 @@ def _replica_healthz(host: str, port: int) -> dict:
 def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
     """Returns the machine-readable smoke report `cmd_fleet` asserts
     on. Every phase's evidence is a field, not a print."""
-    from deepdfa_tpu.fleet import heartbeat
+    from deepdfa_tpu.fleet import ha as fleet_ha, heartbeat
     from deepdfa_tpu.fleet.replica import spawn_replicas, wait_for_ready
     from deepdfa_tpu.fleet.router import (
         BackgroundRouter,
@@ -372,6 +372,35 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
             "final_serve_log": final_log.exists(),
         }
 
+        # -- phase: router HA restart (docs/fleet.md): the rendezvous
+        # file resolves to the live front door, and a RESTARTED router
+        # re-seeds its admission token-bucket levels from the log's
+        # last summary record instead of handing every tenant a fresh
+        # burst (the `kill-router` chaos scenario kills the process for
+        # real; this phase pins the restart half in the smoke)
+        fleet_ha.write_rendezvous(
+            fleet_dir, "router-smoke", router_server.host,
+            router_server.port, 1,
+        )
+        resolved = fleet_ha.resolve_router(fleet_dir)
+        levels_before = router.admission.snapshot()["tokens"]
+        router.log.append(router.summary_record())
+        restarted = router_from_config(
+            cfg, fleet_dir, log_path=run_dir / "fleet_log.jsonl"
+        )
+        levels_after = restarted.admission.snapshot()["tokens"]
+        restarted.close()
+        report["ha"] = {
+            "rendezvous_resolved": resolved == (
+                router_server.host, router_server.port
+            ),
+            "reseeded_levels_match": bool(levels_before) and all(
+                abs(levels_after.get(t, -1e9) - lv) <= 1.0
+                for t, lv in levels_before.items()
+            ),
+            "levels": levels_before,
+        }
+
         router_server.close()  # appends the summary record
         router_server = None
         report["fleet_log"] = validate_fleet_log(
@@ -428,4 +457,12 @@ def smoke_verdict(report: dict) -> list[str]:
         bad.append("no final SLO snapshot in the replica serve log")
     if not (report.get("fleet_log") or {}).get("ok"):
         bad.append("fleet_log.jsonl failed schema validation")
+    ha_phase = report.get("ha") or {}
+    if not ha_phase.get("rendezvous_resolved"):
+        bad.append("router.json rendezvous did not resolve")
+    if not ha_phase.get("reseeded_levels_match"):
+        bad.append(
+            "restarted router did not re-seed admission levels from "
+            "the last summary record"
+        )
     return bad

@@ -330,6 +330,252 @@ def gate(
     }
 
 
+# ---------------------------------------------------------------------------
+# MULTICHIP_r* round-over-round gating (ROADMAP item 1 remainder): the
+# dryrun_multichip artifact carries per-mesh-shape ledger sites (the
+# `shard` table: flops/s, compile seconds, MFU when probed) and the
+# serve ladder's zero-recompile pin — gate them against the newest
+# healthy same-scale round with the BENCH_r* reference-selection rules
+# (a failed/skipped round never re-baselines).
+
+#: per-site higher-is-better tolerances (fractions below reference)
+MULTICHIP_TOLERANCES: dict[str, float] = {
+    # per-mesh-shape sustained FLOP/s — the MFU numerator on boxes
+    # whose runtime ceiling was not probed; generous, this box drifts
+    "flops_per_sec": 0.40,
+    "per_shard_flops_per_sec": 0.40,
+    # the roofline position itself, gated whenever BOTH rounds probed
+    # the measured ceiling (docs/roofline.md method)
+    "mfu_vs_measured_ceiling": 0.30,
+}
+
+#: per-site lower-is-better tolerances (fractions above reference);
+#: compile time shares the bench gate's generous bound — a shared
+#: compile service is the noisiest thing this repo measures
+MULTICHIP_LOWER: dict[str, float] = {
+    "compile_seconds": 1.0,
+}
+
+
+def multichip_record(artifact: dict) -> dict | None:
+    """The {"multichip": ...} record inside one MULTICHIP_r* artifact:
+    `parsed` (r07+) wins, else recovered from the last parseable tail
+    line (the BENCH_r* tail-recovery rule)."""
+    if not isinstance(artifact, dict):
+        return None
+    parsed = artifact.get("parsed")
+    if isinstance(parsed, dict) and isinstance(
+        parsed.get("multichip"), dict
+    ):
+        return parsed["multichip"]
+    for line in reversed(str(artifact.get("tail", "")).splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and isinstance(
+            rec.get("multichip"), dict
+        ):
+            return rec["multichip"]
+    return None
+
+
+def load_multichip_trajectory(root: str | Path) -> list[dict]:
+    """Every committed MULTICHIP_r*.json, oldest round first:
+    [{"source", "round", "artifact"|None, "record"|None, "note"|None}].
+    Artifact keys: {n_devices, rc, ok, skipped, tail} (+ parsed since
+    r07); rounds without a parseable record carry a note instead."""
+    root = Path(root)
+    out: list[dict] = []
+    for path in sorted(root.glob("MULTICHIP_r*.json")):
+        m = re.search(r"MULTICHIP_r(\d+)", path.name)
+        entry: dict = {
+            "source": path.name,
+            "round": int(m.group(1)) if m else None,
+        }
+        try:
+            artifact = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            entry["note"] = f"unreadable: {e}"
+            out.append(entry)
+            continue
+        entry["artifact"] = artifact
+        rec = multichip_record(artifact)
+        if rec is None:
+            entry["note"] = (
+                f"no parseable multichip record "
+                f"(rc={artifact.get('rc')}, ok={artifact.get('ok')})"
+            )
+        entry["record"] = rec
+        out.append(entry)
+    out.sort(key=lambda e: (e.get("round") or 0, e["source"]))
+    return out
+
+
+def _multichip_healthy(entry: dict) -> bool:
+    art = entry.get("artifact") or {}
+    return (
+        isinstance(entry.get("record"), dict)
+        and art.get("rc") == 0
+        and bool(art.get("ok"))
+        and not art.get("skipped")
+    )
+
+
+def multichip_reference_for(
+    trajectory: list[dict],
+    n_devices: int | None,
+    exclude_source: str | None = None,
+) -> dict | None:
+    """The newest healthy same-scale round (n_devices must match — a
+    dp8 record gated against a dp4 baseline compares nothing): the
+    BENCH_r* rules, minus platform (the artifact doesn't carry one; the
+    device count is the comparable-scale key)."""
+    best = None
+    for entry in trajectory:
+        if exclude_source is not None and entry.get("source") == (
+            exclude_source
+        ):
+            continue
+        if not _multichip_healthy(entry):
+            continue
+        art = entry.get("artifact") or {}
+        if n_devices is not None and art.get("n_devices") != n_devices:
+            continue
+        best = {"record": entry["record"], "source": entry["source"]}
+    return best
+
+
+def gate_multichip(
+    artifact: dict,
+    trajectory: list[dict],
+    tolerances: dict[str, float] | None = None,
+    exclude_source: str | None = None,
+) -> dict:
+    """Verdict for one MULTICHIP artifact against the committed
+    trajectory — the same shape `gate()` returns. Checks: per-mesh-shape
+    ledger sites present in BOTH rounds (flops/s and MFU higher-better,
+    compile seconds lower-better), compile_seconds_total, and the serve
+    ladder's zero-steady-state-recompile pin as an absolute bound."""
+    tol = dict(MULTICHIP_TOLERANCES)
+    lower = dict(MULTICHIP_LOWER)
+    for k, v in (tolerances or {}).items():
+        (lower if k in lower else tol)[k] = float(v)
+    failure_classes: list[str] = []
+    notes: list[str] = []
+    checks: list[dict] = []
+    record = multichip_record(artifact)
+    if record is None or artifact.get("rc") != 0 or not artifact.get(
+        "ok", True
+    ):
+        failure_classes.append("error")
+        notes.append(
+            f"artifact is not a healthy multichip round "
+            f"(rc={artifact.get('rc')}, ok={artifact.get('ok')}, "
+            f"record={'present' if record else 'missing'})"
+        )
+        record = record or {}
+
+    # the Morphling pin, absolute: the sharded serve ladder must report
+    # zero steady-state recompiles in every gated round
+    recompiles = (record.get("serve") or {}).get(
+        "steady_state_recompiles"
+    )
+    if recompiles is not None:
+        ok = recompiles == 0
+        checks.append({
+            "metric": "serve/steady_state_recompiles",
+            "new": recompiles,
+            "reference": 0,
+            "ref_source": "absolute_bound",
+            "tolerance": 0.0,
+            "direction": "bound",
+            "ratio": None,
+            "ok": ok,
+        })
+        if not ok and "regression" not in failure_classes:
+            failure_classes.append("regression")
+
+    ref = multichip_reference_for(
+        trajectory, artifact.get("n_devices"),
+        exclude_source=exclude_source,
+    )
+    if ref is None:
+        notes.append(
+            f"no healthy {artifact.get('n_devices')}-device reference "
+            "round in the trajectory — per-site checks skipped"
+        )
+    else:
+        new_sites = record.get("shard") or {}
+        ref_sites = ref["record"].get("shard") or {}
+        shared = sorted(set(new_sites) & set(ref_sites))
+        skipped = sorted(
+            set(new_sites) ^ set(ref_sites)
+        )
+        if skipped:
+            notes.append(
+                "sites in only one round (mesh shapes moved), not "
+                f"gated: {skipped}"
+            )
+        for site in shared:
+            for field, frac in sorted({**tol, **lower}.items()):
+                new_v = new_sites[site].get(field)
+                ref_v = ref_sites[site].get(field)
+                if not isinstance(new_v, (int, float)) or not (
+                    isinstance(ref_v, (int, float))
+                ) or isinstance(new_v, bool) or isinstance(
+                    ref_v, bool
+                ) or ref_v == 0:
+                    continue
+                is_lower = field in lower
+                ratio = new_v / ref_v
+                ok = (
+                    ratio <= 1 + frac if is_lower else ratio >= 1 - frac
+                )
+                checks.append({
+                    "metric": f"{site}/{field}",
+                    "new": new_v,
+                    "reference": ref_v,
+                    "ref_source": ref["source"],
+                    "tolerance": frac,
+                    "direction": "lower" if is_lower else "higher",
+                    "ratio": round(ratio, 4),
+                    "ok": ok,
+                })
+                if not ok and "regression" not in failure_classes:
+                    failure_classes.append("regression")
+        new_total = record.get("compile_seconds_total")
+        ref_total = ref["record"].get("compile_seconds_total")
+        if isinstance(new_total, (int, float)) and isinstance(
+            ref_total, (int, float)
+        ) and ref_total:
+            frac = lower.get("compile_seconds", 1.0)
+            ratio = new_total / ref_total
+            ok = ratio <= 1 + frac
+            checks.append({
+                "metric": "compile_seconds_total",
+                "new": new_total,
+                "reference": ref_total,
+                "ref_source": ref["source"],
+                "tolerance": frac,
+                "direction": "lower",
+                "ratio": round(ratio, 4),
+                "ok": ok,
+            })
+            if not ok and "regression" not in failure_classes:
+                failure_classes.append("regression")
+    return {
+        "verdict": "fail" if failure_classes else "pass",
+        "failure_classes": failure_classes,
+        "n_devices": artifact.get("n_devices"),
+        "checks": checks,
+        "notes": notes,
+    }
+
+
 def render_markdown(result: dict, record: dict | None = None) -> str:
     """The human half of the verdict: a status line, the failure
     classes, and the per-metric table."""

@@ -315,6 +315,21 @@ SCHEMA: tuple[str, ...] = (
     # fields beyond the serve request/* set)
     "request/deadline_ms", "request/priority", "request/retries",
     "request/shed",
+    # router HA (fleet/ha.py, docs/fleet.md): takeover/stepdown
+    # counters, the active-role gauge, measured failover seconds, and
+    # the admission re-seed accounting — plus the scalar fields the
+    # takeover/stepdown fleet_event entries carry
+    "fleet_ha/*",
+    # the fleet_log summary record's admission snapshot (token-bucket
+    # levels per tenant + the service-time EWMA) — the re-seed source a
+    # restarted/failed-over router restores from; tenant labels are
+    # data-dependent, so a reviewed wildcard
+    "fleet_admission/*",
+    # zero-downtime rollout (fleet/rollout.py, docs/fleet.md): the
+    # controller's registry counters (swaps/refusals/halts/rollbacks by
+    # event name) and the {"rollout": {...}} fleet_log records' scalar
+    # fields (t_unix, drift, checkpoint_step, recompiles, guard stats)
+    "rollout/*",
     # fleet_log summary + bench_load record fields (scripts/
     # bench_load.py, bench.py --child-fleet; gated in obs/bench_gate.py)
     "fleet_replicas", "fleet_requests_per_sec", "fleet_seconds",

@@ -253,6 +253,17 @@ class ModelRegistry:
         self._apply: Callable | None = None
         self._mgr = None
         self.reloads = 0
+        self.swaps = 0
+        #: bumped (under _lock) by every operator swap/rollback so the
+        #: hot-swap poller's restore — which runs OUTSIDE the lock —
+        #: can detect a swap that landed mid-restore and discard its
+        #: now-stale params instead of clobbering the swap's
+        self._swap_generation = 0
+        #: the rollback stash (fleet rollout, docs/fleet.md): the
+        #: previously-serving (checkpoint, step, params) kept on device
+        #: after an operator swap so `rollback()` is one reference
+        #: assignment, no disk round trip
+        self._prev: tuple[str, int | None, Any] | None = None
         self._load_initial()
 
     # -- construction --------------------------------------------------------
@@ -298,30 +309,36 @@ class ModelRegistry:
             width += len(STRUCT_VOCAB)
         return width
 
-    def _manifest_sig(self) -> tuple | None:
-        """(step, mtime_ns) of the tracked tag per the manifest — the
-        cheap change detector maybe_reload polls."""
+    def _manifest_sig(self, base: str | None = None) -> tuple | None:
+        """(step, mtime_ns) of a tag per the manifest — the cheap
+        change detector maybe_reload polls. `base` defaults to the
+        tracked base checkpoint; a rollout swap passes its target so
+        the shared tracked-tag state is never touched (maybe_reload
+        may be polling it concurrently from the batcher thread)."""
+        base = self.base_checkpoint if base is None else base
         path = self.ckpt_dir / "manifest.json"
         try:
             st = path.stat()
             manifest = json.loads(path.read_text())
         except (OSError, json.JSONDecodeError):
             return None
-        if self.base_checkpoint == "best":
+        if base == "best":
             entry = manifest.get("best")
-        elif self.base_checkpoint == "last":
+        elif base == "last":
             entry = manifest.get("last")
         else:
             entry = next(
                 (e for e in reversed(manifest.get("history", []))
-                 if e.get("tag") == self.base_checkpoint),
+                 if e.get("tag") == base),
                 None,
             )
         step = entry.get("step", -1) if entry else -1
         return (step, st.st_mtime_ns)
 
-    def _restore(self):
-        """One params restore with operator-grade errors."""
+    def _restore(self, tag: str | None = None):
+        """One params restore with operator-grade errors; `tag` defaults
+        to the tracked base checkpoint (a rollout swap passes the new
+        tag through the same path)."""
         from deepdfa_tpu.train.checkpoint import (
             CheckpointManager,
             CheckpointMismatch,
@@ -346,7 +363,8 @@ class ModelRegistry:
             shardings = self.sharding_map.shardings(self.mesh, target)
         try:
             return self._mgr.restore_for_inference(
-                self.base_checkpoint, target, shardings=shardings
+                tag if tag is not None else self.base_checkpoint,
+                target, shardings=shardings,
             )
         except CheckpointMismatch as e:
             # name the CONFIG keys when the saved run config can tell us
@@ -514,6 +532,8 @@ class ModelRegistry:
         sig = self._manifest_sig()
         if sig is None or sig == self._loaded_manifest_sig:
             return False
+        with self._lock:
+            gen = self._swap_generation
         try:
             new_cfg = load_run_config(self.run_dir)
             if config_digest(new_cfg) != self.config_digest:
@@ -538,6 +558,17 @@ class ModelRegistry:
                 return False
             params = self._maybe_quantize(self._restore())
             with self._lock:
+                if self._swap_generation != gen:
+                    # an operator swap/rollback landed while this
+                    # poller was restoring outside the lock: its params
+                    # and identity win — committing ours would silently
+                    # revert the swap while /healthz reports it landed
+                    logger.warning(
+                        "hot-swap discarded: an operator checkpoint "
+                        "swap landed mid-reload; serving %r step %s",
+                        self.checkpoint, self._loaded_step,
+                    )
+                    return False
                 self._params = self._place(params)
                 self._loaded_manifest_sig = sig
                 self._loaded_step = sig[0]
@@ -553,6 +584,132 @@ class ModelRegistry:
             logger.warning("hot-swap attempt failed (%s); keeping params", e)
             return False
 
+    def _measure_swap_drift(self, old_params, new_params) -> float:
+        """Max |P_new - P_old| over the deterministic calibration
+        batches — the PR-12 drift machinery (serve/quant.py) pointed at
+        a rollout instead of a quantizer. Quantized trees dequantize
+        eagerly first, exactly as the serving executables do."""
+        score_fn = self._score_fn()
+        batches = self._calibration_batches()
+        old_f32 = quant.dequantize_params(old_params)
+        return quant.max_prob_drift(score_fn, old_f32, new_params, batches)
+
+    def swap_checkpoint(
+        self, checkpoint: str, drift_bound: float | None = None
+    ) -> dict:
+        """Operator-driven hot swap to a DIFFERENT checkpoint tag — the
+        zero-downtime rollout path (fleet/rollout.py, docs/fleet.md).
+
+        Rollback-capable: the previously-serving (tag, step, params) is
+        stashed on device, so `rollback()` restores it with one
+        reference assignment. Param shapes are fixed by the config, so
+        neither direction ever invalidates an AOT executable (zero
+        recompiles — the census the rollout drill pins).
+
+        `drift_bound` gates on calibration score drift: max
+        |P_new - P_old| over deterministic calibration batches past the
+        bound REFUSES the swap (RegistryError naming the drift; the old
+        params keep serving untouched) — a bad checkpoint halts a
+        rollout at the first replica instead of serving wrong scores.
+
+        Returns {checkpoint, checkpoint_step, previous, drift}."""
+        sig = self._manifest_sig_for(checkpoint)
+        base, quant_mode = quant.split_checkpoint_tag(checkpoint)
+        if quant_mode != self.quant_mode:
+            raise RegistryError(
+                f"swap cannot change quantization mode "
+                f"({self.checkpoint!r} -> {checkpoint!r}); start a "
+                f"replica with the target mode instead"
+            )
+        try:
+            restored = self._restore(base)
+        except FileNotFoundError as e:
+            raise RegistryError(str(e)) from e
+        new_params = self._maybe_quantize(restored)
+        with self._lock:
+            old_params = self._params
+        drift = None
+        if drift_bound is not None:
+            drift = self._measure_swap_drift(old_params, new_params)
+            if drift > float(drift_bound):
+                raise RegistryError(
+                    f"swap to {checkpoint!r} REFUSED: calibration score "
+                    f"drift {drift:.4g} exceeds the bound "
+                    f"{float(drift_bound):g} — the new checkpoint does "
+                    f"not score like the serving one (still serving "
+                    f"{self.checkpoint!r} step {self._loaded_step})"
+                )
+        placed = self._place(new_params)
+        with self._lock:
+            self._prev = (
+                self.checkpoint, self._loaded_step, old_params
+            )
+            previous = self.checkpoint
+            self._params = placed
+            self.checkpoint = checkpoint
+            self.base_checkpoint = base
+            self._loaded_manifest_sig = sig
+            self._loaded_step = sig[0] if sig else None
+            self._swap_generation += 1  # fences in-flight hot-reloads
+        self._ledger_params()
+        self.swaps += 1
+        from deepdfa_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.REGISTRY.counter("serve/hot_swaps").inc()
+        logger.info(
+            "swapped %s -> %s (step %s, drift %s)",
+            previous, checkpoint, self._loaded_step, drift,
+        )
+        return {
+            "checkpoint": checkpoint,
+            "checkpoint_step": self._loaded_step,
+            "previous": previous,
+            "drift": drift,
+        }
+
+    def rollback(self) -> dict | None:
+        """Undo the last `swap_checkpoint`: the stashed params resume
+        serving with one reference assignment (no disk, no recompiles).
+        Returns the restored identity, or None when there is nothing to
+        roll back to."""
+        with self._lock:
+            if self._prev is None:
+                return None
+            checkpoint, step, params = self._prev
+        sig = self._manifest_sig_for(checkpoint)
+        with self._lock:
+            if self._prev is None:
+                return None
+            rolled_from = self.checkpoint
+            self._prev = None
+            self._params = params
+            self.checkpoint = checkpoint
+            self.base_checkpoint, _ = quant.split_checkpoint_tag(
+                checkpoint
+            )
+            self._loaded_step = step
+            self._loaded_manifest_sig = sig
+            self._swap_generation += 1  # fences in-flight hot-reloads
+        from deepdfa_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.REGISTRY.counter("serve/hot_swaps").inc()
+        logger.warning(
+            "rolled back %s -> %s (step %s)",
+            rolled_from, checkpoint, step,
+        )
+        return {
+            "checkpoint": checkpoint,
+            "checkpoint_step": step,
+            "rolled_back_from": rolled_from,
+        }
+
+    def _manifest_sig_for(self, checkpoint: str) -> tuple | None:
+        """`_manifest_sig` for an arbitrary tag (the swap target) —
+        read-only: mutating the tracked tag here would race the
+        hot-swap poller on the batcher thread."""
+        base, _ = quant.split_checkpoint_tag(checkpoint)
+        return self._manifest_sig(base)
+
     def info(self) -> dict:
         """/healthz payload: what is serving, from where, pinned how."""
         out = {
@@ -564,6 +721,10 @@ class ModelRegistry:
             "vocab_digest": self.vocab_digest,
             "hot_swaps": self.reloads,
         }
+        if self._prev is not None:
+            # the rollback stash (fleet rollout): what one `rollback()`
+            # would resume serving
+            out["previous_checkpoint"] = self._prev[0]
         if self.quant_mode:
             out.update(
                 quantized=self.quant_mode,

@@ -16,6 +16,11 @@ The failure classes the verdict distinguishes:
 Modes:
   python scripts/bench_gate.py --record out.json      # gate one record
   python scripts/bench_gate.py                        # newest BENCH_r*
+  python scripts/bench_gate.py --multichip [PATH]     # gate a
+        MULTICHIP_r* artifact round-over-round (per-mesh-shape ledger
+        sites, compile seconds, the serve ladder's zero-recompile pin)
+        against the newest healthy same-device-count round; PATH
+        defaults to the newest committed MULTICHIP_r*.json
   python scripts/bench_gate.py --smoke                # tier-1: verify
         the classifier on synthetic pass/regression/fallback records
 
@@ -121,6 +126,56 @@ def run_smoke() -> int:
     return 0 if all(checks) else 1
 
 
+def run_multichip(args) -> int:
+    """`--multichip [PATH]`: gate one MULTICHIP artifact against the
+    committed MULTICHIP_r* trajectory (same exit-code contract as the
+    bench gate: 0 pass, 1 regression/error)."""
+    from deepdfa_tpu.obs.bench_gate import (
+        gate_multichip,
+        load_multichip_trajectory,
+        render_markdown,
+    )
+
+    root = Path(args.root)
+    trajectory = load_multichip_trajectory(root)
+    exclude = None
+    if args.multichip:
+        path = Path(args.multichip)
+        artifact = json.loads(path.read_text())
+        source = str(path)
+        if path.resolve().parent == root.resolve():
+            exclude = path.name
+    else:
+        candidates = [
+            e for e in trajectory if isinstance(e.get("artifact"), dict)
+        ]
+        if not candidates:
+            raise SystemExit(
+                f"no parseable MULTICHIP_r*.json under {root}"
+            )
+        artifact = candidates[-1]["artifact"]
+        source = exclude = candidates[-1]["source"]
+
+    tolerances = {}
+    for spec in args.tolerance:
+        metric, _, frac = spec.partition("=")
+        tolerances[metric] = float(frac)
+    result = gate_multichip(
+        artifact, trajectory,
+        tolerances=tolerances or None,
+        exclude_source=exclude,
+    )
+    result["record_source"] = source
+    md = render_markdown(result)
+    print(md)
+    print(json.dumps(result), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(result, indent=1))
+    if args.markdown_out:
+        Path(args.markdown_out).write_text(md)
+    return 0 if result["verdict"] == "pass" else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--record", default=None,
@@ -137,6 +192,13 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None, help="write verdict JSON here")
     ap.add_argument("--markdown-out", default=None,
                     help="write the markdown verdict here")
+    ap.add_argument("--multichip", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="gate a MULTICHIP_r* artifact round-over-round "
+                    "(per-mesh-shape ledger sites + the serve ladder's "
+                    "zero-recompile pin) against the newest healthy "
+                    "same-device-count round; default: the newest "
+                    "committed MULTICHIP_r*.json")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 classifier self-check on synthetic "
                     "records")
@@ -144,6 +206,9 @@ def main(argv=None) -> int:
 
     if args.smoke:
         return run_smoke()
+
+    if args.multichip is not None:
+        return run_multichip(args)
 
     from deepdfa_tpu.obs.bench_gate import (
         gate,

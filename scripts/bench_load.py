@@ -61,46 +61,21 @@ TENANT_POLICIES = (
 )
 
 
-class _BenchRegistry:
+def _bench_registry(cfg, model, params, vocabs, run_dir):
     """Registry-shaped stub over freshly initialized params: the load
     bench measures the fleet machinery, not checkpoint IO (the restore
-    path has its own e2e coverage in `fleet --smoke`)."""
+    path has its own e2e coverage in `fleet --smoke`). One
+    implementation, shared with the chaos drills
+    (fleet/chaos.py:StubRegistry)."""
+    from deepdfa_tpu.fleet.chaos import StubRegistry
 
-    family = "deepdfa"
-    checkpoint = "init"
+    return StubRegistry(cfg, model, params, vocabs, run_dir)
 
-    def __init__(self, cfg, model, params, vocabs, run_dir):
-        self.cfg = cfg
-        self._model = model
-        self._params = params
-        self.vocabs = vocabs
-        self.run_dir = Path(run_dir)
 
-    @property
-    def model(self):
-        return self._model
-
-    def params(self):
-        return self._params
-
-    def _feat_width(self) -> int:
-        from deepdfa_tpu.graphs.batch import NUM_SUBKEY_FEATS
-
-        return NUM_SUBKEY_FEATS
-
-    def maybe_reload(self) -> bool:
-        return False
-
-    def info(self) -> dict:
-        return {
-            "family": self.family,
-            "run_dir": str(self.run_dir),
-            "checkpoint": self.checkpoint,
-            "checkpoint_step": 0,
-            "config_digest": "bench",
-            "vocab_digest": "bench",
-            "hot_swaps": 0,
-        }
+#: re-export: the open-loop start/stop traffic driver lives with the
+#: other shared fleet-drill fixtures (deepdfa_tpu/fleet/chaos.py); the
+#: `bench_load` function below keeps its own inline arrival loop
+from deepdfa_tpu.fleet.chaos import OpenLoopTraffic  # noqa: F401,E402
 
 
 def bench_load(
@@ -179,7 +154,7 @@ def bench_load(
         servers: list[BackgroundServer] = []
         try:
             for i in range(int(n_replicas)):
-                registry = _BenchRegistry(
+                registry = _bench_registry(
                     cfg, model, params, vocabs, fleet_dir / f"r{i}"
                 )
                 service = ScoringService(registry, cfg)

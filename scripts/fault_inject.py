@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -650,17 +651,1073 @@ def run_full(names, n_examples: int) -> dict:
     return record
 
 
+# ---------------------------------------------------------------------------
+# fleet chaos scenarios (ISSUE 14, docs/fleet.md failure matrix): real
+# replica subprocesses + the real router/HA stack, each scenario
+# asserting its row's degradation contract AND the zero-recompile
+# census across the event. `--fleet` runs them; `--smoke --fleet` runs
+# the in-process tier-1 variants (kill-router + wedge-backend over
+# stub registries, <60 s).
+
+#: shared fleet config for the chaos drives: tight cadences so the
+#: scenarios observe transitions in seconds, ONE ladder size so scores
+#: are bit-comparable across replicas (the fleet-smoke rule), the
+#: chaos admin endpoints armed, and a 5 s SLO window the rollout guard
+#: can actually react inside
+FLEET_OVERRIDES = [
+    "serve.request_log=true",
+    "serve.max_batch_graphs=1",
+    "serve.slo_windows=[5, 60]",
+    "fleet.heartbeat_interval_s=0.2",
+    "fleet.heartbeat_timeout_s=5.0",
+    "fleet.poll_interval_s=0.1",
+    "fleet.drain_announce_s=0.3",
+    "fleet.request_timeout_s=3.0",
+    "fleet.rendezvous_interval_s=0.2",
+    "fleet.router_failover_timeout_s=1.5",
+    "fleet.summary_interval_s=0.5",
+    "fleet.rollout_settle_s=0.5",
+    "fleet.chaos=true",
+    'fleet.tenants="{\\"drill\\": {\\"rate\\": 0.001, \\"burst\\": 50,'
+    ' \\"priority\\": 1}}"',
+]
+
+
+def _documented_failover_bound(cfg) -> float:
+    """The failover window docs/fleet.md documents: staleness detection
+    + one bounded probe + one standby poll."""
+    return (
+        cfg.fleet.router_failover_timeout_s
+        + min(2.0, cfg.fleet.router_failover_timeout_s)
+        + cfg.fleet.rendezvous_interval_s
+    )
+
+
+class FleetHarness:
+    """One real 2-replica fleet (subprocess replicas, in-process HA
+    router) shared across the chaos scenarios — the same bring-up
+    `fleet --smoke` uses, plus a deliberately bad checkpoint tag for
+    the rollout-refusal arm."""
+
+    def __init__(self, tmp: str):
+        import jax
+        import numpy as np
+
+        from deepdfa_tpu.core import config as config_mod
+        from deepdfa_tpu.fleet import ha as fleet_ha
+        from deepdfa_tpu.fleet.replica import (
+            spawn_replicas,
+            wait_for_ready,
+        )
+        from deepdfa_tpu.serve import driver
+        from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+        self.tmp = Path(tmp)
+        self.cfg, self.run_dir, sources_dir = driver.build_smoke_run(
+            run_name="fleet-chaos", dataset="fleet-chaos",
+            n_examples=16, max_epochs=2,
+            extra_overrides=FLEET_OVERRIDES,
+        )
+        self.fleet_dir = Path(
+            self.cfg.fleet.fleet_dir or self.run_dir / "fleet"
+        )
+        self.codes = [
+            f.read_text() for f in sorted(sources_dir.glob("*.c"))[:8]
+        ]
+        # the injected BAD checkpoint: the best params wildly perturbed
+        # and saved under the "bad" tag — calibration drift is enormous
+        # by construction, so a drift-gated rollout must refuse it
+        from deepdfa_tpu.serve.registry import ModelRegistry
+
+        registry = ModelRegistry(
+            self.run_dir, family="deepdfa",
+            checkpoint=self.cfg.serve.checkpoint, cfg=self.cfg,
+        )
+        good = jax.device_get(registry.params())
+        bad = jax.tree.map(
+            lambda x: (
+                np.asarray(x) * -3.0 + 1.0
+                if np.issubdtype(np.asarray(x).dtype, np.floating)
+                else x
+            ),
+            good,
+        )
+        CheckpointManager(self.run_dir / "checkpoints").save(
+            "bad", bad, metrics={}, step=9999
+        )
+        self.available_tags = sorted(
+            p.name
+            for p in (self.run_dir / "checkpoints").iterdir()
+            if p.is_dir()
+        )
+        del registry
+
+        self.procs = spawn_replicas(self.run_dir, self.fleet_dir, 2)
+        self.rids = [rid for rid, _ in self.procs]
+        beats = wait_for_ready(
+            self.fleet_dir, self.rids, timeout_s=300.0,
+            procs=self.procs,
+        )
+        self.replica_addr = {
+            rid: (hb["host"], int(hb["port"]))
+            for rid, hb in beats.items()
+        }
+        self.log_path = self.run_dir / "fleet_log.jsonl"
+        self.ha = fleet_ha.HARouter(
+            self.cfg, self.fleet_dir, router_id="router-main",
+            log_path=self.log_path,
+        )
+        self.ha.start()
+        assert self.ha.wait_active(30.0), "in-process router not active"
+        # the bit-parity baseline every failover scenario compares
+        # against: one scored pass through the router
+        self.baseline: dict[int, float] = {}
+        for i, code in enumerate(self.codes):
+            status, resp = self.request({"code": code})
+            assert status == 200, (status, resp)
+            self.baseline[i] = resp["prob"]
+
+    # -- plumbing ------------------------------------------------------------
+
+    def router_addr(self):
+        return (self.ha.host, self.ha.port)
+
+    def request(self, payload, headers=None, timeout=60.0):
+        from deepdfa_tpu.fleet import chaos as fleet_chaos
+
+        host, port = self.router_addr()
+        return fleet_chaos.http_json(
+            host, port, "POST", "/score", payload, headers=headers,
+            timeout=timeout,
+        )
+
+    def admin(self, rid: str, path: str, payload, timeout=300.0):
+        from deepdfa_tpu.fleet import chaos as fleet_chaos
+
+        host, port = self.replica_addr[rid]
+        return fleet_chaos.http_json(
+            host, port, "POST", path, payload, timeout=timeout,
+        )
+
+    def replica_healthz(self, rid: str):
+        from deepdfa_tpu.fleet import chaos as fleet_chaos
+
+        host, port = self.replica_addr[rid]
+        return fleet_chaos.http_json(host, port, "GET", "/healthz")[1]
+
+    def census_ok(self) -> bool:
+        """Zero steady-state recompiles on every live replica — the
+        Morphling invariant every scenario must leave intact."""
+        for rid, proc in self.procs:
+            if proc.poll() is not None:
+                continue
+            h = self.replica_healthz(rid)
+            if h.get("steady_state_recompiles") != 0:
+                return False
+        return True
+
+    def wait_routable(self, rid: str, timeout_s: float = 30.0,
+                      want: bool = True) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            topo = self.ha.router.topology()
+            state = {
+                r["id"]: r["routable"] for r in topo["replicas"]
+            }
+            if state.get(rid, False) == want:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def log_events(self) -> list[str]:
+        names = []
+        for line in self.log_path.read_text().splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "fleet_event" in rec:
+                names.append(rec["fleet_event"].get("name"))
+        return names
+
+    def respawn(self, rid: str) -> None:
+        from deepdfa_tpu.fleet import heartbeat
+        from deepdfa_tpu.fleet.replica import replica_command
+
+        idx = self.rids.index(rid)
+        t_spawn = time.time()
+        proc = subprocess.Popen(replica_command(
+            self.run_dir, rid, self.fleet_dir
+        ))
+        self.procs[idx] = (rid, proc)
+        # the DEAD replica's heartbeat lingers by design (crash
+        # evidence), still saying `ready` at the old port — wait for
+        # the NEW process's own announcement (fresher than the spawn)
+        # before trusting the addr
+        deadline = time.time() + 300
+        hb = None
+        while time.time() < deadline:
+            assert proc.poll() is None, f"respawned {rid} died"
+            cand = heartbeat.read_heartbeat(
+                heartbeat.heartbeat_path(self.fleet_dir, rid)
+            )
+            if (
+                cand is not None
+                and cand.get("state") == heartbeat.READY
+                and float(cand["t_unix"]) >= t_spawn
+            ):
+                hb = cand
+                break
+            time.sleep(0.1)
+        assert hb is not None, f"{rid} never re-announced after respawn"
+        self.replica_addr[rid] = (hb["host"], int(hb["port"]))
+        assert self.wait_routable(rid, 30.0), f"{rid} not routable"
+
+    def close(self) -> None:
+        if self.ha is not None:
+            try:
+                self.ha.close()
+            except Exception:
+                pass
+        for _, proc in self.procs:
+            if proc.poll() is None:
+                proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except Exception:
+                    pass
+
+
+def fleet_corrupt_heartbeat(h: FleetHarness) -> dict:
+    """A malformed announcement file quarantines THAT replica — the
+    router keeps serving through the other one and never crashes; the
+    replica's own next atomic rewrite heals the file and lifts the
+    quarantine."""
+    from deepdfa_tpu.fleet import heartbeat
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    rid = h.rids[0]
+    q0 = obs_metrics.REGISTRY.snapshot().get("fleet/quarantines", 0)
+    # freeze the replica so its refresh cannot heal the file while the
+    # quarantine is being observed (SIGSTOP: process alive, no writes)
+    victim = dict(h.procs)[rid]
+    os.kill(victim.pid, signal.SIGSTOP)
+    try:
+        path = heartbeat.heartbeat_path(h.fleet_dir, rid)
+        path.write_text('{"heartbeat": {"replica_id": "%s", "state": '
+                        '"zombie"' % rid)  # torn AND undeclared state
+        deadline = time.time() + 15
+        quarantined = False
+        while time.time() < deadline:
+            snap = obs_metrics.REGISTRY.snapshot()
+            if snap.get("fleet/quarantines", 0) > q0:
+                quarantined = True
+                break
+            time.sleep(0.05)
+        assert quarantined, "router never quarantined the corrupt file"
+        assert not h.wait_routable(rid, 1.0, want=True), (
+            f"{rid} still routable behind a corrupt heartbeat"
+        )
+        # the router is alive and serving through the healthy replica
+        statuses = []
+        for i, code in enumerate(h.codes[:4]):
+            status, resp = h.request({"code": code})
+            statuses.append(status)
+            assert resp.get("prob") == h.baseline[i], "score drifted"
+        assert statuses == [200] * 4, statuses
+    finally:
+        os.kill(victim.pid, signal.SIGCONT)
+    # the replica's own refresh heals the file; quarantine lifts
+    assert h.wait_routable(rid, 20.0), "quarantine never lifted"
+    assert "quarantine" in h.log_events()
+    assert h.census_ok(), "recompiles across the event"
+    return {
+        "quarantined": True,
+        "served_through_survivor": True,
+        "healed_and_routable": True,
+    }
+
+
+def fleet_wedge_backend(h: FleetHarness) -> dict:
+    """A wedged backend (process alive, health probe 503, scoring
+    stalled) must be ejected off the forward timeout, kept out while
+    its probe fails, and readmitted on recovery — with every request
+    answered from the survivor meanwhile (no lost accepted request)."""
+    import threading as _threading
+
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    rid = h.rids[0]
+    snap0 = obs_metrics.REGISTRY.snapshot()
+    wedge_s = 8.0
+    status, resp = h.admin(rid, "/admin/chaos", {"wedge_s": wedge_s})
+    assert status == 200, (status, resp)
+    t_wedge = time.time()
+    results: list[dict] = []
+    lock = _threading.Lock()
+
+    def one(i: int, code: str) -> None:
+        status, resp = h.request({"code": code}, timeout=120.0)
+        with lock:
+            results.append({
+                "status": status,
+                "bit_identical": resp.get("prob") == h.baseline[i],
+            })
+
+    threads = [
+        _threading.Thread(target=one, args=(i, c))
+        for i, c in enumerate(h.codes[:4])
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert all(
+        r["status"] == 200 and r["bit_identical"] for r in results
+    ), f"lost/mis-scored a request across the wedge: {results}"
+    snap1 = obs_metrics.REGISTRY.snapshot()
+    assert snap1.get("fleet/ejects", 0) > snap0.get("fleet/ejects", 0), (
+        "wedged replica was never ejected"
+    )
+    # recovery: wedge expires -> healthz 200 + fresh heartbeat -> the
+    # bounded probe readmits without operator action
+    assert h.wait_routable(
+        rid, wedge_s + _documented_failover_bound(h.cfg) + 20.0
+    ), "wedged replica never readmitted after recovery"
+    snap2 = obs_metrics.REGISTRY.snapshot()
+    assert snap2.get("fleet/readmits", 0) > snap0.get(
+        "fleet/readmits", 0
+    ), "no readmit counted"
+    status, resp = h.request({"code": h.codes[0]})
+    assert status == 200
+    assert h.census_ok(), "recompiles across the event"
+    return {
+        "requests_during_wedge": len(results),
+        "all_ok": True,
+        "ejected": True,
+        "readmit_seconds": round(time.time() - t_wedge - wedge_s, 1),
+        "readmitted": True,
+    }
+
+
+def fleet_slow_replica(h: FleetHarness) -> dict:
+    """Injected scoring latency on every replica: the admission EWMA
+    rises with real completions, and deadline-declaring requests are
+    shed 503 `deadline` at the front door (no replica ever sees them);
+    recovery drains the EWMA and deadlines admit again."""
+    latency_s = 0.6
+    for rid in h.rids:
+        status, _ = h.admin(
+            rid, "/admin/chaos",
+            {"latency_s": latency_s, "duration_s": 60.0},
+        )
+        assert status == 200
+    # slow completions calibrate the EWMA up
+    for i in range(6):
+        status, _ = h.request(
+            {"code": h.codes[i % len(h.codes)]}, timeout=60.0
+        )
+        assert status == 200
+    # front-door shed: estimate (outstanding/healthy + 1) * EWMA is
+    # far past a 100 ms deadline now
+    shed = []
+    for i in range(4):
+        status, resp = h.request({
+            "code": h.codes[i % len(h.codes)], "deadline_ms": 100.0,
+        })
+        shed.append((status, resp.get("reason")))
+    assert all(s == 503 and r == "deadline" for s, r in shed), shed
+    # recovery: clear the fault, fast completions decay the EWMA
+    for rid in h.rids:
+        h.admin(rid, "/admin/chaos", {"clear": True})
+    admitted_again = False
+    for i in range(30):
+        status, _ = h.request({"code": h.codes[i % len(h.codes)]})
+        assert status == 200
+        status, resp = h.request({
+            "code": h.codes[i % len(h.codes)], "deadline_ms": 100.0,
+        })
+        if status == 200:
+            admitted_again = True
+            break
+    assert admitted_again, "deadline traffic never admitted again"
+    assert h.census_ok()
+    return {
+        "shed_while_slow": [s for s, _ in shed],
+        "deadline_shed_engaged": True,
+        "recovered": True,
+    }
+
+
+def fleet_partition(h: FleetHarness) -> dict:
+    """Router->replica connections dropped via the injectable transport
+    fault in the router's HTTP client: forwards fail over to the
+    reachable replica, readmit probes fail too (the partition covers
+    them), and healing the partition readmits the replica."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    rid = h.rids[0]
+    snap0 = obs_metrics.REGISTRY.snapshot()
+    h.ha.router.transport_fault = (
+        lambda r: "drill partition" if r == rid else None
+    )
+    try:
+        for i, code in enumerate(h.codes[:6]):
+            status, resp = h.request({"code": code})
+            assert status == 200, (status, resp)
+            assert resp.get("prob") == h.baseline[i]
+        snap1 = obs_metrics.REGISTRY.snapshot()
+        assert snap1.get("fleet/ejects", 0) > snap0.get(
+            "fleet/ejects", 0
+        ), "partitioned replica never ejected"
+        # the partition also blocks the readmit probe: the replica must
+        # STAY out while the fault holds (poll cadence is 0.1 s, so
+        # give the probe loop plenty of chances to get it wrong)
+        time.sleep(1.0)
+        assert not h.wait_routable(rid, 1.0, want=True), (
+            "replica readmitted THROUGH the partition"
+        )
+    finally:
+        h.ha.router.transport_fault = None
+    assert h.wait_routable(rid, 20.0), (
+        "replica never readmitted after the partition healed"
+    )
+    snap2 = obs_metrics.REGISTRY.snapshot()
+    assert snap2.get("fleet/readmits", 0) > snap0.get(
+        "fleet/readmits", 0
+    )
+    assert h.census_ok()
+    return {
+        "no_request_lost": True,
+        "ejected": True,
+        "held_out_while_partitioned": True,
+        "readmitted_after_heal": True,
+    }
+
+
+def fleet_kill_replica(h: FleetHarness) -> dict:
+    """The promoted kill-replica-midstream drill: SIGKILL one replica
+    with requests genuinely in flight; every request answers 200 with
+    the bit-identical score off the survivor, the dead replica is
+    ejected, and its last heartbeat stays behind as evidence."""
+    import threading as _threading
+
+    from deepdfa_tpu.fleet import heartbeat
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    rid = h.rids[0]
+    victim = dict(h.procs)[rid]
+    snap0 = obs_metrics.REGISTRY.snapshot()
+    # "midstream" must be deterministic, not a race the fleet can win:
+    # inject scoring latency on BOTH replicas — outstanding work piles
+    # up, so least-outstanding routing genuinely SPREADS the concurrent
+    # burst (idle-fleet ties all break toward one replica) and the
+    # victim holds requests mid-service when the SIGKILL lands (its
+    # injected state dies with the process; the survivor's is cleared
+    # below)
+    for r in h.rids:
+        status, resp = h.admin(
+            r, "/admin/chaos", {"latency_s": 1.0, "duration_s": 60.0}
+        )
+        assert status == 200, (status, resp)
+    results: list[dict] = []
+    lock = _threading.Lock()
+
+    def one(i: int) -> None:
+        i = i % len(h.codes)
+        status, resp = h.request({"code": h.codes[i]}, timeout=120.0)
+        with lock:
+            results.append({
+                "status": status,
+                "bit_identical": resp.get("prob") == h.baseline[i],
+            })
+
+    threads = []
+
+    def launch(i: int) -> None:
+        t = _threading.Thread(target=one, args=(i,))
+        t.start()
+        threads.append(t)
+
+    for i in range(len(h.codes)):
+        launch(i)
+    # kill only once the victim PROVABLY holds requests mid-service —
+    # never on a timer the fleet can win; top up traffic until the
+    # router's own view shows outstanding work there
+    deadline = time.time() + 30
+    n = len(h.codes)
+    while time.time() < deadline:
+        topo = h.ha.router.topology()
+        out = {r["id"]: r["outstanding"] for r in topo["replicas"]}
+        if out.get(rid, 0) > 0:
+            break
+        launch(n)
+        n += 1
+        time.sleep(0.2)
+    else:
+        raise AssertionError(
+            f"victim {rid} never held an in-flight request: "
+            f"{h.ha.router.topology()}"
+        )
+    os.kill(victim.pid, signal.SIGKILL)
+    for t in threads:
+        t.join(timeout=120)
+    victim.wait(timeout=30)
+    for r in h.rids:
+        if r != rid:
+            h.admin(r, "/admin/chaos", {"clear": True})
+    assert len(results) == len(threads)
+    assert all(
+        r["status"] == 200 and r["bit_identical"] for r in results
+    ), f"failover lost or mis-scored a request: {results}"
+    snap1 = obs_metrics.REGISTRY.snapshot()
+    recent = [
+        {k: r["request"].get(k) for k in ("replica", "retries", "status")}
+        for r in (
+            json.loads(line)
+            for line in h.log_path.read_text().splitlines()[-14:]
+            if line.strip()
+        )
+        if "request" in r
+    ]
+    assert snap1.get("fleet/ejects", 0) > snap0.get("fleet/ejects", 0), (
+        f"no eject: topology={h.ha.router.topology()} recent={recent}"
+    )
+    # the crash evidence contract: the last heartbeat file lingers
+    hb = heartbeat.read_heartbeat(
+        heartbeat.heartbeat_path(h.fleet_dir, rid)
+    )
+    assert hb is not None, "dead replica's heartbeat evidence missing"
+    assert h.census_ok()
+    # restore the 2-replica fleet for whatever runs next
+    h.respawn(rid)
+    return {
+        "killed": rid,
+        "responses": len(results),
+        "all_ok": True,
+        "heartbeat_evidence": True,
+        "respawned": True,
+    }
+
+
+def fleet_rollout(h: FleetHarness) -> dict:
+    """The zero-downtime rollout drill under open-loop bench_load
+    traffic: every replica swaps drain->swap->re-warm->readmit with the
+    SLO guard quiet and the zero-recompile census intact; rolling back
+    to the prior tag works the same way; and the injected bad
+    checkpoint (drift past bound) halts at the first replica with
+    everything still serving the prior tag."""
+    import dataclasses
+
+    from deepdfa_tpu.fleet.chaos import OpenLoopTraffic
+    from deepdfa_tpu.fleet import ha as fleet_ha, rollout as fleet_rollout_mod
+
+    # a real checkpoint tag that is not the serving one
+    target = next(
+        (t for t in h.available_tags if t.startswith("epoch-")), None
+    )
+    assert target, f"no epoch tag to roll to in {h.available_tags}"
+    prior_step = {
+        rid: h.replica_healthz(rid).get("checkpoint_step")
+        for rid in h.rids
+    }
+
+    def resolve():
+        return fleet_ha.resolve_router(h.fleet_dir)
+
+    traffic = OpenLoopTraffic(
+        resolve, h.codes, rate_per_sec=3.0, tenant="default",
+        request_timeout_s=60.0,
+    ).start()
+    # age out the previous scenario's deliberate sheds (the 503s the
+    # slow-replica drill just asserted on) from the guard's smallest
+    # SLO window, refilling it with this drill's 200s — the guard must
+    # judge THE ROLLOUT's traffic, not the last drill's residue
+    time.sleep(min(h.cfg.serve.slo_windows) + 1.5)
+    try:
+        # arm 1: a good rollout — inter-epoch drift on this tiny model
+        # is real but benign; the gate is sized for it here, and the
+        # refusal arm below proves the same gate fires when it must
+        cfg_ok = dataclasses.replace(
+            h.cfg, fleet=dataclasses.replace(
+                h.cfg.fleet, rollout_drift_bound=1.0,
+            ),
+        )
+        report = fleet_rollout_mod.run_rollout(
+            cfg_ok, h.fleet_dir, target,
+            router_addr=h.router_addr(), log_path=h.log_path,
+        )
+        assert report["ok"], report
+        assert sorted(report["swapped"]) == sorted(h.rids), report
+        assert report["census_ok"], report
+        assert not report["halted"], report
+        # arm 2: roll back to the prior tag the same way, still under
+        # traffic — the swap is symmetric
+        report_back = fleet_rollout_mod.run_rollout(
+            cfg_ok, h.fleet_dir, h.cfg.serve.checkpoint,
+            router_addr=h.router_addr(), log_path=h.log_path,
+        )
+        assert report_back["ok"], report_back
+        # arm 3: the injected bad checkpoint must be REFUSED at the
+        # first replica (calibration drift gate) and halt the rollout
+        # with every replica still on the prior tag
+        cfg_bad = dataclasses.replace(
+            h.cfg, fleet=dataclasses.replace(
+                h.cfg.fleet, rollout_drift_bound=0.02,
+            ),
+        )
+        report_bad = fleet_rollout_mod.run_rollout(
+            cfg_bad, h.fleet_dir, "bad",
+            router_addr=h.router_addr(), log_path=h.log_path,
+        )
+        assert report_bad["halted"], report_bad
+        assert "refused" in report_bad["halt_reason"] or "drift" in (
+            report_bad["halt_reason"]
+        ), report_bad
+        assert report_bad["swapped"] == [], report_bad
+        after_step = {
+            rid: h.replica_healthz(rid).get("checkpoint_step")
+            for rid in h.rids
+        }
+        assert after_step == prior_step, (
+            f"bad rollout moved a replica: {prior_step} -> {after_step}"
+        )
+        assert report_bad["census_ok"], report_bad
+    finally:
+        results = traffic.stop()
+    # the traffic verdict: nothing the router accepted was lost — no
+    # transport-dead requests, no 5xx beyond deliberate sheds
+    lost = [r for r in results if r["status"] == 0]
+    failed = [
+        r for r in results
+        if r["status"] not in (0, 200, 429) and r.get("reason") is None
+    ]
+    assert not lost, f"lost requests under rollout: {lost[:3]}"
+    assert not failed, f"failed requests under rollout: {failed[:3]}"
+    ok = [r for r in results if r["status"] == 200]
+    assert ok, "traffic never landed during the rollout"
+    return {
+        "target": target,
+        "rolled": True,
+        "rolled_back": True,
+        "bad_checkpoint_refused": True,
+        "traffic_total": len(results),
+        "traffic_ok": len(ok),
+        "traffic_lost": 0,
+    }
+
+
+def fleet_kill_router(h: FleetHarness) -> dict:
+    """Kill the ACTIVE router process under traffic: the standby
+    health-checks it via the rendezvous file, takes over the front
+    door within the documented bound, re-seeds admission token-bucket
+    levels from the last summary record, and no replica state is lost
+    — in-flight requests on the dead router are the client's retry
+    (OpenLoopTraffic re-resolves and retries once)."""
+    import sys as _sys
+
+    from deepdfa_tpu.fleet.chaos import OpenLoopTraffic
+    from deepdfa_tpu.fleet import chaos as fleet_chaos, ha as fleet_ha
+
+    replica_pids = {
+        rid: proc.pid for rid, proc in h.procs if proc.poll() is None
+    }
+    # hand the front door to a REAL router subprocess (the process the
+    # scenario kills), retiring the harness's in-process active
+    h.ha.close()
+    h.ha = None
+    env = dict(os.environ)
+    active = subprocess.Popen(
+        [_sys.executable, "-m", "deepdfa_tpu.cli", "fleet-router",
+         "--run-dir", str(h.run_dir),
+         "--fleet-dir", str(h.fleet_dir),
+         "--router-id", "router-sub"],
+        env=env, cwd=str(REPO),
+    )
+    try:
+        deadline = time.time() + 120
+        addr = None
+        while time.time() < deadline:
+            rv = fleet_ha.read_rendezvous(h.fleet_dir)
+            if rv is not None and rv["router_id"] == "router-sub":
+                try:
+                    status, _ = fleet_chaos.http_json(
+                        rv["host"], int(rv["port"]), "GET", "/healthz",
+                        timeout=5.0,
+                    )
+                    if status == 200:
+                        addr = (rv["host"], int(rv["port"]))
+                        break
+                except OSError:
+                    pass
+            time.sleep(0.1)
+        assert addr is not None, "subprocess router never took over"
+        epoch_before = fleet_ha.read_rendezvous(h.fleet_dir)["epoch"]
+        # drain the drill tenant's token bucket through the subprocess
+        # router so its summary records carry a level well under burst
+        # (rate 0.001/s: no meaningful refill inside the drill); the
+        # router is seconds old — transient transport errors while its
+        # accept loop settles are the client's retry, not a failure
+        sent = 0
+        drain_deadline = time.time() + 60
+        while sent < 10:
+            try:
+                status, _ = fleet_chaos.http_json(
+                    *addr, "POST", "/score",
+                    {"code": h.codes[sent % len(h.codes)],
+                     "tenant": "drill"},
+                )
+            except OSError as e:
+                assert time.time() < drain_deadline, (
+                    f"router at {addr} unreachable for 60s: {e}"
+                )
+                time.sleep(0.2)
+                continue
+            assert status == 200, status
+            sent += 1
+        # one summary cadence so the levels are on disk
+        time.sleep(2 * h.cfg.fleet.summary_interval_s + 0.5)
+        # the in-process STANDBY joins the pair
+        standby = fleet_ha.HARouter(
+            h.cfg, h.fleet_dir, router_id="router-standby",
+            log_path=h.log_path,
+        )
+        standby.start()
+        time.sleep(0.5)
+        assert standby.role == "standby", standby.role
+        traffic = OpenLoopTraffic(
+            lambda: fleet_ha.resolve_router(h.fleet_dir),
+            h.codes, rate_per_sec=3.0, tenant="default",
+            request_timeout_s=30.0,
+        ).start()
+        t_kill = time.monotonic()
+        active.kill()
+        took_over = standby.wait_active(timeout_s=60.0)
+        failover_s = time.monotonic() - t_kill
+        results = traffic.stop()
+        assert took_over, "standby never took over"
+        bound = _documented_failover_bound(h.cfg)
+        rv = fleet_ha.read_rendezvous(h.fleet_dir)
+        assert rv["router_id"] == "router-standby", rv
+        assert rv["epoch"] > epoch_before, rv
+        # bounded failover: the documented window plus generous slack
+        # for this 1-cpu box (the MEASURED number is in the record)
+        assert failover_s < bound + 10.0, (
+            f"failover took {failover_s:.1f}s (documented bound "
+            f"{bound:.1f}s)"
+        )
+        h.ha = standby  # the harness's router again, for teardown
+        # no replica state lost: same pids, all still ready + routable
+        for rid, pid in replica_pids.items():
+            assert dict(h.procs)[rid].poll() is None, f"{rid} died"
+            assert dict(h.procs)[rid].pid == pid
+            assert h.wait_routable(rid, 20.0), f"{rid} not routable"
+        # the new active answers, and its admission state was re-seeded
+        # from the dead router's last summary (drill bucket well under
+        # burst, not a fresh 50)
+        status, resp = h.request({"code": h.codes[0]})
+        assert status == 200, (status, resp)
+        snap = h.ha.router.admission.snapshot()
+        drill_level = snap["tokens"].get("drill")
+        assert drill_level is not None and drill_level <= 45.0, (
+            f"token bucket not re-seeded (drill level {drill_level})"
+        )
+        # client contract: post-failover, nothing stayed lost — every
+        # transport-dead first attempt re-resolved and landed
+        lost = [r for r in results if r["status"] == 0]
+        assert not lost, f"requests lost across failover: {lost[:3]}"
+        assert "takeover" in h.log_events()
+        assert h.census_ok()
+        return {
+            "failover_seconds": round(failover_s, 2),
+            "documented_bound_seconds": round(bound, 2),
+            "epoch": rv["epoch"],
+            "reseeded_drill_tokens": drill_level,
+            "replicas_undisturbed": True,
+            "traffic_total": len(results),
+            "traffic_lost": 0,
+        }
+    finally:
+        if active.poll() is None:
+            active.kill()
+            try:
+                active.wait(timeout=30)
+            except Exception:
+                pass
+
+
+FLEET_SCENARIOS = {
+    "corrupt-heartbeat": fleet_corrupt_heartbeat,
+    "wedge-backend": fleet_wedge_backend,
+    "slow-replica": fleet_slow_replica,
+    "partition": fleet_partition,
+    "rollout": fleet_rollout,
+    "kill-replica-midstream": fleet_kill_replica,
+    "kill-router": fleet_kill_router,
+}
+
+
+def run_fleet(names) -> dict:
+    """Full fleet chaos mode: one real bring-up, every scenario against
+    it in a safe order (recoverable faults first, process kills last)."""
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    apply_platform_override()
+    sys.path.insert(0, str(REPO / "scripts"))
+    record: dict = {"mode": "fleet", "scenarios": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="fleet-chaos-") as tmp:
+        os.environ["DEEPDFA_TPU_STORAGE"] = tmp
+        t0 = time.perf_counter()
+        h = FleetHarness(tmp)
+        record["setup_seconds"] = round(time.perf_counter() - t0, 1)
+        record["failover_bound_seconds"] = round(
+            _documented_failover_bound(h.cfg), 2
+        )
+        try:
+            for name in (
+                n for n in FLEET_SCENARIOS if n in names
+            ):
+                t0 = time.perf_counter()
+                try:
+                    out = FLEET_SCENARIOS[name](h)
+                    out["seconds"] = round(time.perf_counter() - t0, 1)
+                    record["scenarios"][name] = out
+                except (AssertionError, RuntimeError, OSError) as e:
+                    import traceback
+
+                    record["ok"] = False
+                    record["scenarios"][name] = {
+                        "error": f"{type(e).__name__}: {e}"[:2000],
+                        "trace": traceback.format_exc()[-1500:],
+                        "seconds": round(time.perf_counter() - t0, 1),
+                    }
+            # the shared log must validate with every new record shape
+            # (quarantine/takeover events, rollout records) on board
+            from deepdfa_tpu.fleet.router import validate_fleet_log
+
+            log_verdict = validate_fleet_log(h.log_path)
+            record["fleet_log"] = {
+                k: log_verdict[k]
+                for k in ("ok", "records", "events", "rollouts")
+                if k in log_verdict
+            }
+            if not log_verdict["ok"]:
+                record["ok"] = False
+                record["fleet_log"]["problems"] = log_verdict["problems"]
+        finally:
+            h.close()
+    return record
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet tier (tier-1: --smoke --fleet; stub registries, no
+# subprocess, <60 s): the kill-router + wedge-backend variants
+
+
+def smoke_fleet(tmp: str) -> dict:
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.fleet import chaos as fleet_chaos, ha as fleet_ha
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+        "serve.max_batch_graphs=1",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+        "serve.slo_windows=[5, 60]",
+        # in-process stubs never refresh heartbeats; a large timeout
+        # keeps them routable (the bench_load convention)
+        "fleet.heartbeat_timeout_s=3600.0",
+        "fleet.poll_interval_s=0.1",
+        "fleet.request_timeout_s=1.0",
+        "fleet.rendezvous_interval_s=0.1",
+        "fleet.router_failover_timeout_s=0.8",
+        "fleet.summary_interval_s=0.2",
+        'fleet.tenants="{\\"drill\\": {\\"rate\\": 0.001, '
+        '\\"burst\\": 50, \\"priority\\": 1}}"',
+    ])
+    model, params, vocabs, codes = fleet_chaos.build_stub_parts(cfg)
+    record: dict = {}
+
+    # -- wedge-backend, in-process: real ScoringServices + the real
+    # router; r0's probe flips and scoring stalls, the router must
+    # eject off the forward timeout and readmit on recovery
+    fleet_dir = Path(tmp) / "wedge"
+    replicas = [
+        fleet_chaos.StubReplicaServer(
+            cfg, fleet_dir, f"r{i}",
+            fleet_chaos.stub_service(
+                cfg, fleet_dir, f"r{i}", model, params, vocabs
+            ),
+        )
+        for i in range(2)
+    ]
+    ha_router = fleet_ha.HARouter(
+        cfg, fleet_dir, "router-a",
+        log_path=fleet_dir / "fleet_log.jsonl",
+    )
+    try:
+        ha_router.start()
+        assert ha_router.wait_active(20.0)
+        addr = (ha_router.host, ha_router.port)
+        baseline = {}
+        for i, code in enumerate(codes[:4]):
+            status, resp = fleet_chaos.http_json(
+                *addr, "POST", "/score", {"code": code}
+            )
+            assert status == 200, (status, resp)
+            baseline[i] = resp["prob"]
+        snap0 = obs_metrics.REGISTRY.snapshot()
+        replicas[0].chaos.apply({"wedge_s": 3.0})
+        wedge_results = []
+        for i, code in enumerate(codes[:4]):
+            status, resp = fleet_chaos.http_json(
+                *addr, "POST", "/score", {"code": code}, timeout=60.0
+            )
+            wedge_results.append(
+                status == 200 and resp.get("prob") == baseline[i]
+            )
+        assert all(wedge_results), wedge_results
+        snap1 = obs_metrics.REGISTRY.snapshot()
+        assert snap1.get("fleet/ejects", 0) > snap0.get(
+            "fleet/ejects", 0
+        ), "in-process wedge never ejected"
+        deadline = time.time() + 30
+        readmitted = False
+        while time.time() < deadline:
+            snap = obs_metrics.REGISTRY.snapshot()
+            if snap.get("fleet/readmits", 0) > snap0.get(
+                "fleet/readmits", 0
+            ):
+                readmitted = True
+                break
+            time.sleep(0.05)
+        assert readmitted, "in-process wedge never readmitted"
+        recompiles = sum(
+            r.service.steady_state_recompiles() for r in replicas
+        )
+        assert recompiles == 0, recompiles
+        record["wedge-backend"] = {
+            "requests_ok": len(wedge_results),
+            "ejected": True,
+            "readmitted": True,
+            "steady_state_recompiles": recompiles,
+        }
+    finally:
+        ha_router.close()
+
+    # -- kill-router, in-process: an active/standby pair over the same
+    # stub replicas; the active dies abruptly (kill(): no rendezvous
+    # handoff, exactly SIGKILL's residue), the standby takes over
+    # within the bound and re-seeds the drill tenant's bucket level
+    # from the last summary record
+    fleet_dir2 = Path(tmp) / "killrouter"
+    for r in replicas:
+        r.fleet_dir = fleet_dir2
+        r.beat()
+    log_path = fleet_dir2 / "fleet_log.jsonl"
+    active = fleet_ha.HARouter(cfg, fleet_dir2, "ra", log_path=log_path)
+    standby = fleet_ha.HARouter(cfg, fleet_dir2, "rb", log_path=log_path)
+    try:
+        active.start()
+        assert active.wait_active(20.0)
+        addr = (active.host, active.port)
+        for i in range(10):
+            status, _ = fleet_chaos.http_json(
+                *addr, "POST", "/score",
+                {"code": codes[i % len(codes)], "tenant": "drill"},
+            )
+            assert status == 200, status
+        # force a summary record so the bucket level is on disk
+        active.router._last_summary = 0.0
+        active.router._maybe_summarize()
+        standby.start()
+        time.sleep(0.3)
+        assert standby.role == "standby", standby.role
+        epoch0 = fleet_ha.read_rendezvous(fleet_dir2)["epoch"]
+        t0 = time.monotonic()
+        active.kill()
+        assert standby.wait_active(timeout_s=30.0), "no takeover"
+        failover_s = time.monotonic() - t0
+        bound = (
+            cfg.fleet.router_failover_timeout_s * 2
+            + cfg.fleet.rendezvous_interval_s
+        )
+        rv = fleet_ha.read_rendezvous(fleet_dir2)
+        assert rv["router_id"] == "rb" and rv["epoch"] > epoch0, rv
+        addr2 = fleet_ha.resolve_router(fleet_dir2)
+        status, resp = fleet_chaos.http_json(
+            *addr2, "POST", "/score", {"code": codes[0]}
+        )
+        assert status == 200, (status, resp)
+        drill = standby.router.admission.snapshot()["tokens"].get(
+            "drill"
+        )
+        assert drill is not None and drill <= 45.0, (
+            f"standby did not re-seed the drill bucket: {drill}"
+        )
+        record["kill-router"] = {
+            "failover_seconds": round(failover_s, 2),
+            "bound_seconds": round(bound + 5.0, 2),
+            "within_bound": failover_s < bound + 5.0,
+            "epoch": rv["epoch"],
+            "reseeded_drill_tokens": drill,
+        }
+        assert record["kill-router"]["within_bound"], record
+    finally:
+        active.kill()
+        standby.close()
+        for r in replicas:
+            r.close()
+    return record
+
+
+def run_smoke_fleet() -> dict:
+    """The tier-1 fleet lane (`--smoke --fleet`): kill-router +
+    wedge-backend against the in-process stub fleet, <60 s."""
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    os.environ.setdefault("DEEPDFA_TPU_PLATFORM", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    apply_platform_override()
+    record: dict = {"mode": "fleet-inproc", "scenarios": {}, "ok": True}
+    with tempfile.TemporaryDirectory(prefix="fleet-smoke-") as tmp:
+        t0 = time.perf_counter()
+        try:
+            record["scenarios"] = smoke_fleet(tmp)
+        except (AssertionError, RuntimeError, OSError) as e:
+            record["ok"] = False
+            record["error"] = f"{type(e).__name__}: {e}"[:2000]
+        record["seconds"] = round(time.perf_counter() - t0, 1)
+    return record
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--smoke", action="store_true",
         help="tier-1 in-process mode: sigterm + corrupt-shard + nan "
-        "through the real runtime in one interpreter (<1 min)",
+        "through the real runtime in one interpreter (<1 min); with "
+        "--fleet, the in-process kill-router + wedge-backend drills",
     )
     ap.add_argument(
         "--scenario", action="append", default=None,
         choices=sorted(SCENARIOS),
         help="full mode: run only the named subprocess scenario(s)",
+    )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="fleet chaos mode (docs/fleet.md failure matrix): real "
+        "replica subprocesses + the HA router stack; every scenario "
+        "asserts its degradation contract and the zero-recompile "
+        "census",
+    )
+    ap.add_argument(
+        "--fleet-scenario", action="append", default=None,
+        choices=sorted(FLEET_SCENARIOS),
+        help="fleet mode: run only the named fleet scenario(s)",
     )
     ap.add_argument("--n-examples", type=int, default=48)
     ap.add_argument("--out", default=None)
@@ -675,8 +1732,13 @@ def main() -> None:
         mesh_child(args.mesh_child)
         return
 
-    if args.smoke:
+    if args.smoke and args.fleet:
+        record = run_smoke_fleet()
+    elif args.smoke:
         record = run_smoke(args.n_examples)
+    elif args.fleet:
+        names = args.fleet_scenario or list(FLEET_SCENARIOS)
+        record = run_fleet(names)
     else:
         names = args.scenario if args.scenario else list(SCENARIOS)
         record = run_full(names, args.n_examples)

@@ -62,3 +62,46 @@ def test_fault_inject_smoke(tmp_path):
     assert mesh["postmortems"] == 1
     assert mesh["secondary_install"] is False
     assert mesh["mesh"]["axes"] == {"dp": 8}
+
+
+def test_fault_inject_fleet_smoke(tmp_path):
+    """The tier-1 fleet chaos tier (ISSUE 14, docs/fleet.md): the
+    in-process kill-router + wedge-backend drills — a wedged backend is
+    ejected off the forward timeout and readmitted on recovery with
+    every request answered bit-identically from the survivor, and an
+    abruptly-dead active router fails over to the standby within the
+    documented bound with admission token-bucket levels re-seeded from
+    the last fleet_log summary record."""
+    out = tmp_path / "record.json"
+    env = dict(
+        os.environ,
+        DEEPDFA_TPU_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "scripts" / "fault_inject.py"),
+            "--smoke", "--fleet",
+            "--out", str(out),
+        ],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    record = json.loads(out.read_text())
+    assert record["ok"] is True
+    scen = record["scenarios"]
+    wedge = scen["wedge-backend"]
+    assert wedge["ejected"] is True
+    assert wedge["readmitted"] is True
+    assert wedge["steady_state_recompiles"] == 0
+    kill = scen["kill-router"]
+    assert kill["within_bound"] is True
+    assert kill["epoch"] >= 2
+    # the failover must not hand the drill tenant a fresh burst: the
+    # re-seeded level reflects the 10 requests the dead active admitted
+    assert kill["reseeded_drill_tokens"] <= 45.0

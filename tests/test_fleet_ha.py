@@ -1,0 +1,902 @@
+"""Fleet HA / rollout / chaos unit tests (deepdfa_tpu/fleet/{ha,
+rollout,chaos}.py, docs/fleet.md) — the router-failover, admission
+re-seed, quarantine, rollout-controller, and bounded-join halves
+against stub HTTP endpoints: no model, no subprocess. The real-process
+drills live in scripts/fault_inject.py --fleet (and the tier-1
+in-process variants in `--smoke --fleet`, tests/test_fault_inject.py).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deepdfa_tpu.core import Config, config as config_mod
+from deepdfa_tpu.fleet import (
+    admission as fleet_admission,
+    chaos as fleet_chaos,
+    ha as fleet_ha,
+    heartbeat,
+)
+from deepdfa_tpu.fleet.router import (
+    FleetLog,
+    Router,
+    validate_fleet_log,
+)
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+
+def ha_cfg(**extra):
+    overrides = [
+        "fleet.port=0",  # never fight other processes for 8470
+        "fleet.rendezvous_interval_s=0.1",
+        "fleet.router_failover_timeout_s=0.5",
+        "fleet.summary_interval_s=0.2",
+        "fleet.poll_interval_s=0.0",
+        "fleet.heartbeat_timeout_s=5.0",
+    ] + [f"{k}={v}" for k, v in extra.items()]
+    return config_mod.apply_overrides(Config(), overrides)
+
+
+def counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot().get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous file protocol
+
+
+def test_rendezvous_round_trip_and_resolve(tmp_path):
+    assert fleet_ha.read_rendezvous(tmp_path) is None
+    assert fleet_ha.resolve_router(tmp_path) is None
+    fleet_ha.write_rendezvous(tmp_path, "ra", "127.0.0.1", 8123, 3)
+    rv = fleet_ha.read_rendezvous(tmp_path)
+    assert rv["router_id"] == "ra"
+    assert rv["epoch"] == 3
+    assert fleet_ha.resolve_router(tmp_path) == ("127.0.0.1", 8123)
+
+
+def test_rendezvous_malformed_reads_as_absent(tmp_path):
+    path = fleet_ha.rendezvous_path(tmp_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    for damage in (
+        "not json",
+        json.dumps({"router": "nope"}),
+        json.dumps({"router": {"router_id": "ra"}}),  # missing fields
+        json.dumps({"something": "else"}),
+    ):
+        path.write_text(damage)
+        assert fleet_ha.read_rendezvous(tmp_path) is None
+
+
+# ---------------------------------------------------------------------------
+# active/standby negotiation (fleet/ha.py)
+
+
+def test_ha_lone_starter_becomes_active_and_serves(tmp_path):
+    cfg = ha_cfg()
+    a = fleet_ha.HARouter(
+        cfg, tmp_path, "ra", log_path=tmp_path / "fleet_log.jsonl"
+    )
+    try:
+        a.start()
+        assert a.wait_active(10.0)
+        assert a.role == "active"
+        rv = fleet_ha.read_rendezvous(tmp_path)
+        assert rv["router_id"] == "ra"
+        assert int(rv["port"]) == a.port
+        # the front door answers (no replicas: healthz still 200s)
+        status, body = fleet_chaos.http_json(
+            a.host, a.port, "GET", "/healthz", timeout=5.0
+        )
+        assert status == 200, body
+    finally:
+        a.close()
+
+
+def test_ha_standby_takes_over_stale_rendezvous_and_fences_loser(
+    tmp_path,
+):
+    cfg = ha_cfg()
+    log_path = tmp_path / "fleet_log.jsonl"
+    a = fleet_ha.HARouter(cfg, tmp_path, "ra", log_path=log_path)
+    b = fleet_ha.HARouter(cfg, tmp_path, "rb", log_path=log_path)
+    try:
+        a.start()
+        assert a.wait_active(10.0)
+        epoch_a = a.epoch
+        b.step()
+        assert b.role == "standby"
+        # the active dies abruptly: loops dead, server down, rendezvous
+        # left behind exactly as SIGKILL leaves it
+        a.kill()
+        deadline = time.time() + 30
+        while time.time() < deadline and b.role != "active":
+            b.step()
+            time.sleep(0.1)
+        assert b.role == "active"
+        assert b.epoch > epoch_a
+        rv = fleet_ha.read_rendezvous(tmp_path)
+        assert rv["router_id"] == "rb"
+        # fencing: the presumed-dead active observes the higher epoch
+        # and steps down instead of fighting. A WEDGED (not killed)
+        # active that resumes still holds its log handle — kill()
+        # dropped ours (a real SIGKILL writes nothing more), so
+        # re-attach one to pin the stepdown event write path too.
+        a.router.log = FleetLog(log_path)
+        with a._lock:
+            a.role = "active"  # simulate it waking back up
+        a.step()
+        assert a.role == "standby"
+        assert a.router.log is None  # step_down detached it again
+        events = [
+            json.loads(line)["fleet_event"]["name"]
+            for line in log_path.read_text().splitlines()
+            if "fleet_event" in line
+        ]
+        assert "takeover" in events
+        assert "stepdown" in events
+        verdict = validate_fleet_log(log_path)
+        assert verdict["ok"], verdict["problems"]
+    finally:
+        a.kill()
+        b.close()
+
+
+def test_ha_standby_does_not_take_over_fresh_rendezvous(tmp_path):
+    cfg = ha_cfg()
+    a = fleet_ha.HARouter(cfg, tmp_path, "ra")
+    b = fleet_ha.HARouter(cfg, tmp_path, "rb")
+    try:
+        a.start()
+        assert a.wait_active(10.0)
+        for _ in range(5):
+            b.step()
+            time.sleep(0.05)
+        assert b.role == "standby"
+        assert fleet_ha.read_rendezvous(tmp_path)["router_id"] == "ra"
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# admission token-bucket re-seed (the router-restart half of HA)
+
+
+def drained_controller():
+    ctrl = fleet_admission.AdmissionController(
+        tenants=fleet_admission.parse_tenants(
+            json.dumps({"t0": {"rate": 0.001, "burst": 40.0}})
+        ),
+    )
+    for _ in range(25):
+        ctrl.decide("t0", outstanding=0, healthy=1)
+    return ctrl
+
+
+def test_admission_snapshot_reseed_round_trip():
+    ctrl = drained_controller()
+    snap = ctrl.snapshot()
+    level = snap["tokens"]["t0"]
+    assert level <= 15.5  # 40 - 25 admitted (+epsilon refill)
+    fresh = fleet_admission.AdmissionController(
+        tenants=fleet_admission.parse_tenants(
+            json.dumps({"t0": {"rate": 0.001, "burst": 40.0}})
+        ),
+    )
+    n = fresh.reseed(snap)
+    assert n >= 1
+    assert fresh.snapshot()["tokens"]["t0"] == pytest.approx(
+        level, abs=0.5
+    )
+
+
+def test_admission_reseed_clamps_to_burst_and_tolerates_garbage():
+    ctrl = fleet_admission.AdmissionController(
+        tenants=fleet_admission.parse_tenants(
+            json.dumps({"t0": {"rate": 0.001, "burst": 40.0}})
+        ),
+    )
+    # a stale record can never grant MORE than the policy's burst
+    n = ctrl.reseed({"tokens": {"t0": 9999.0, "junk": "NaNish"}})
+    assert n == 1
+    assert ctrl.snapshot()["tokens"]["t0"] <= 40.0
+    # malformed snapshots re-seed nothing, never crash
+    assert ctrl.reseed("not a dict") == 0
+    assert ctrl.reseed({"tokens": "nope"}) == 0
+    assert ctrl.reseed({}) == 0
+    # the service EWMA restores too
+    ctrl.reseed({"service_ewma_ms": 123.0})
+    assert ctrl.snapshot()["service_ewma_ms"] == pytest.approx(
+        123.0, rel=0.01
+    )
+
+
+def test_router_reseed_from_log_last_summary(tmp_path):
+    log_path = tmp_path / "fleet_log.jsonl"
+    ctrl = drained_controller()
+    router = Router(
+        tmp_path, poll_interval_s=0.0, admission=ctrl,
+        log=FleetLog(log_path),
+    )
+    level = ctrl.snapshot()["tokens"]["t0"]
+    router.log.append(router.summary_record())
+    router.close()
+    restarted = Router(
+        tmp_path, poll_interval_s=0.0,
+        admission=fleet_admission.AdmissionController(
+            tenants=fleet_admission.parse_tenants(
+                json.dumps({"t0": {"rate": 0.001, "burst": 40.0}})
+            ),
+        ),
+    )
+    try:
+        n = restarted.reseed_from_log(log_path)
+        assert n >= 1
+        assert restarted.admission.snapshot()["tokens"]["t0"] == (
+            pytest.approx(level, abs=0.5)
+        )
+    finally:
+        restarted.close()
+
+
+def test_router_kill_writes_no_final_summary(tmp_path):
+    """A 'SIGKILLed' in-process router (HARouter/Router.kill, the
+    kill-router drill) must write NOTHING more to the shared fleet_log:
+    no final summary record whose frozen admission snapshot a later
+    takeover would wrongly re-seed from. Graceful close() still does."""
+    log_path = tmp_path / "fleet_log.jsonl"
+    router = Router(
+        tmp_path, poll_interval_s=0.0, admission=drained_controller(),
+        log=FleetLog(log_path),
+    )
+    router.kill()
+    assert not log_path.exists() or log_path.read_text() == ""
+    router.close()  # idempotent after kill: still no summary
+    assert not log_path.exists() or log_path.read_text() == ""
+
+    graceful = Router(
+        tmp_path, poll_interval_s=0.0, admission=drained_controller(),
+        log=FleetLog(log_path),
+    )
+    graceful.close()
+    summaries = [
+        json.loads(line) for line in log_path.read_text().splitlines()
+        if line.strip()
+    ]
+    assert any("fleet_admission" in rec for rec in summaries)
+
+
+def test_router_reseed_from_missing_empty_corrupt_log(tmp_path):
+    router = Router(tmp_path, poll_interval_s=0.0)
+    try:
+        # absent
+        assert router.reseed_from_log(tmp_path / "nope.jsonl") == 0
+        # empty
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert router.reseed_from_log(empty) == 0
+        # corrupt lines + a summary record with a malformed snapshot:
+        # fresh buckets, no crash
+        corrupt = tmp_path / "corrupt.jsonl"
+        corrupt.write_text(
+            "{torn json\n"
+            + json.dumps({"fleet_admission": "not a dict",
+                          "fleet": {}}) + "\n"
+            + "also not json\n"
+        )
+        assert router.reseed_from_log(corrupt) == 0
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# ledger-driven replica planning (ROADMAP item 2 remainder)
+
+
+def test_plan_replicas_unbudgeted_falls_back_to_default():
+    n, plan = fleet_admission.plan_replicas({"default": 1e6}, 0.0)
+    assert n == 2
+    assert plan["reason"] == "unbudgeted"
+
+
+def test_plan_replicas_ledger_math_and_clamps():
+    # 1 MB params * 4x headroom = 4 MB working set; 10 MB budget -> 2
+    n, plan = fleet_admission.plan_replicas({"default": 1e6}, 10e6)
+    assert n == 2
+    assert plan["reason"] == "ledger"
+    assert plan["per_replica_bytes"] == pytest.approx(4e6)
+    # a huge budget clamps at max_replicas
+    n, _ = fleet_admission.plan_replicas(
+        {"default": 1e6}, 1e12, max_replicas=16
+    )
+    assert n == 16
+    # a budget below one working set still runs one replica
+    n, _ = fleet_admission.plan_replicas({"default": 1e6}, 1e6)
+    assert n == 1
+    # unmeasurable entries (0 bytes) fall back to the default
+    n, plan = fleet_admission.plan_replicas({"default": 0.0}, 10e6)
+    assert n == 2
+    assert plan["reason"] == "unmeasured"
+
+
+def test_plan_replicas_arbitrates_entries_against_budget():
+    # two entries, budget fits only the first's working set after
+    # plan_coserving refuses the second
+    entries = {"default": 1e6, "huge": 1e9}
+    n, plan = fleet_admission.plan_replicas(entries, 8e6)
+    assert plan["loaded"] == ["default"]
+    assert "huge" in plan["refused"]
+    assert n == 2  # 8 MB // 4 MB
+
+
+# ---------------------------------------------------------------------------
+# heartbeat validation + router quarantine
+
+
+def test_validate_heartbeat_reasons():
+    ok = {
+        "heartbeat": {
+            "replica_id": "r0", "host": "h", "port": 8000,
+            "state": "ready", "t_unix": 1.0,
+        },
+    }
+    hb, reason = heartbeat.validate_heartbeat(ok)
+    assert hb is not None and reason is None
+    cases = [
+        ("nope", "not a JSON object"),
+        ({}, "no heartbeat object"),
+        ({"heartbeat": {"replica_id": "r0"}}, "missing fields"),
+        ({"heartbeat": dict(ok["heartbeat"], state="zombie")},
+         "unknown state"),
+        ({"heartbeat": dict(ok["heartbeat"], port="eighty")},
+         "not numeric"),
+        ({"heartbeat": dict(ok["heartbeat"], port=0)}, "out of range"),
+    ]
+    for doc, expect in cases:
+        hb, reason = heartbeat.validate_heartbeat(doc)
+        assert hb is None
+        assert expect in reason, (reason, expect)
+
+
+def test_scan_heartbeats_verbose_reports_invalid_by_filename(tmp_path):
+    heartbeat.write_heartbeat(tmp_path, "good", "127.0.0.1", 8000)
+    (tmp_path / "replica-torn.json").write_text('{"heartbeat": {')
+    beats, invalid = heartbeat.scan_heartbeats_verbose(tmp_path)
+    assert set(beats) == {"good"}
+    assert set(invalid) == {"torn"}
+    assert "not JSON" in invalid["torn"]
+
+
+def test_router_quarantines_corrupt_heartbeat_and_heals(tmp_path):
+    log_path = tmp_path / "fleet_log.jsonl"
+    heartbeat.write_heartbeat(tmp_path, "r0", "127.0.0.1", 18000)
+    heartbeat.write_heartbeat(tmp_path, "r1", "127.0.0.1", 18001)
+    router = Router(
+        tmp_path, poll_interval_s=0.0, log=FleetLog(log_path),
+    )
+    try:
+        q0 = counter("fleet/quarantines")
+        assert {
+            r["id"] for r in router.topology()["replicas"]
+            if r["routable"]
+        } == {"r0", "r1"}
+        # damage r0's announcement
+        heartbeat.heartbeat_path(tmp_path, "r0").write_text(
+            '{"heartbeat": {"state": "zombie"'
+        )
+        router.poll(force=True)
+        router.poll(force=True)  # second poll must not re-log
+        assert counter("fleet/quarantines") == q0 + 1
+        topo = {
+            r["id"]: r for r in router.topology()["replicas"]
+        }
+        assert topo["r0"]["quarantined"] and not topo["r0"]["routable"]
+        assert topo["r1"]["routable"]
+        # the replica's own refresh heals the file; quarantine lifts
+        heartbeat.write_heartbeat(tmp_path, "r0", "127.0.0.1", 18000)
+        router.poll(force=True)
+        topo = {
+            r["id"]: r for r in router.topology()["replicas"]
+        }
+        assert not topo["r0"]["quarantined"] and topo["r0"]["routable"]
+        events = [
+            json.loads(line)["fleet_event"]["name"]
+            for line in log_path.read_text().splitlines()
+            if "fleet_event" in line
+        ]
+        assert events.count("quarantine") == 1
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet-log validation: the new record shapes
+
+
+def test_validate_fleet_log_accepts_ha_and_rollout_records(tmp_path):
+    path = tmp_path / "fleet_log.jsonl"
+    path.write_text("\n".join([
+        json.dumps({"fleet_event": {
+            "name": "takeover", "t_unix": 1.0, "router": "ra",
+            "epoch": 2, "reseeded_buckets": 1,
+            "takeover_seconds": 0.01,
+        }}),
+        json.dumps({"fleet_event": {
+            "name": "stepdown", "t_unix": 1.0, "router": "rb",
+            "epoch": 1,
+        }}),
+        json.dumps({"fleet_event": {
+            "name": "quarantine", "t_unix": 1.0, "replica": "r0",
+        }}),
+        json.dumps({"rollout": {
+            "event": "start", "checkpoint": "epoch-0001",
+            "t_unix": 1.0, "replicas": 2, "drift_bound": 0.05,
+        }}),
+        json.dumps({"rollout": {
+            "event": "swap", "checkpoint": "epoch-0001",
+            "t_unix": 1.0, "replica": "r0", "drift": 0.001,
+        }}),
+        json.dumps({"rollout": {
+            "event": "halt", "checkpoint": "bad", "t_unix": 1.0,
+        }}),
+    ]) + "\n")
+    result = validate_fleet_log(path)
+    assert result["ok"], result["problems"]
+    assert result["events"] == 3
+    assert result["rollouts"] == 3
+
+
+def test_validate_fleet_log_rejects_bad_rollout_records(tmp_path):
+    path = tmp_path / "fleet_log.jsonl"
+    path.write_text("\n".join([
+        json.dumps({"rollout": {"event": "explode", "t_unix": 1.0,
+                                "checkpoint": "x"}}),
+        json.dumps({"rollout": {"event": "swap"}}),  # missing fields
+    ]) + "\n")
+    result = validate_fleet_log(path)
+    assert not result["ok"]
+    joined = "\n".join(result["problems"])
+    assert "explode" in joined
+    assert "missing" in joined
+
+
+# ---------------------------------------------------------------------------
+# rollout controller against stub replicas (fleet/rollout.py)
+
+
+class _RolloutStubHandler(BaseHTTPRequestHandler):
+    """Stub replica admin surface: scripted /admin/rollout answers,
+    /healthz reports a zero-recompile census."""
+
+    replica_id = "stub"
+    swap_status = 200
+    calls: list  # class-level: (replica_id, payload) in arrival order
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, status, doc):
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        self._reply(200, {
+            "ok": True, "steady_state_recompiles": 0,
+            "checkpoint": "epoch-0000",
+        })
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n) or b"{}")
+        type(self).calls.append((self.replica_id, payload))
+        if payload.get("rollback"):
+            self._reply(200, {"ok": True, "checkpoint": "epoch-0000"})
+            return
+        if self.swap_status == 200:
+            self._reply(200, {
+                "ok": True, "checkpoint": payload.get("checkpoint"),
+                "drift": 0.001, "checkpoint_step": 7, "recompiles": 0,
+                "steady_state_recompiles": 0,
+            })
+        else:
+            self._reply(self.swap_status, {
+                "ok": False, "refused": True,
+                "error": "calibration score drift 0.9 exceeds bound",
+            })
+
+
+def _stub_rollout_fleet(tmp_path, swap_statuses):
+    """N stub replicas with scripted swap answers + their heartbeats."""
+    calls: list = []
+    servers = []
+    for i, status in enumerate(swap_statuses):
+        handler = type(
+            f"RolloutStub{i}", (_RolloutStubHandler,),
+            {"replica_id": f"r{i}", "swap_status": status,
+             "calls": calls},
+        )
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True
+        )
+        thread.start()
+        servers.append((httpd, thread))
+        heartbeat.write_heartbeat(
+            tmp_path, f"r{i}", "127.0.0.1", httpd.server_address[1]
+        )
+    return calls, servers
+
+
+def _stop_stub_fleet(servers):
+    for httpd, thread in servers:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)
+
+
+def test_rollout_controller_swaps_every_replica(tmp_path):
+    from deepdfa_tpu.fleet import rollout as fleet_rollout
+
+    cfg = ha_cfg(**{"fleet.rollout_settle_s": 0.0})
+    calls, servers = _stub_rollout_fleet(tmp_path, [200, 200])
+    try:
+        report = fleet_rollout.run_rollout(
+            cfg, tmp_path, "epoch-0001",
+            log_path=tmp_path / "fleet_log.jsonl",
+        )
+    finally:
+        _stop_stub_fleet(servers)
+    assert report["ok"], report
+    assert sorted(report["swapped"]) == ["r0", "r1"]
+    assert not report["halted"]
+    assert report["census_ok"]
+    # one swap POST per replica, in replica-id order
+    assert [c[0] for c in calls] == ["r0", "r1"]
+    verdict = validate_fleet_log(tmp_path / "fleet_log.jsonl")
+    assert verdict["ok"], verdict["problems"]
+    assert verdict["rollouts"] >= 3  # start + 2 swaps + complete
+
+
+def test_rollout_controller_halts_on_refusal_and_rolls_back(tmp_path):
+    from deepdfa_tpu.fleet import rollout as fleet_rollout
+
+    cfg = ha_cfg(**{"fleet.rollout_settle_s": 0.0})
+    # r0 accepts, r1 refuses (drift past bound) -> halt + r0 rollback
+    calls, servers = _stub_rollout_fleet(tmp_path, [200, 409])
+    try:
+        report = fleet_rollout.run_rollout(
+            cfg, tmp_path, "bad-tag",
+            log_path=tmp_path / "fleet_log.jsonl",
+        )
+    finally:
+        _stop_stub_fleet(servers)
+    assert report["halted"], report
+    assert not report["ok"]
+    assert "drift" in report["halt_reason"]
+    assert report["swapped"] == ["r0"]
+    assert [r["replica"] for r in report["rolled_back"]] == ["r0"]
+    rollback_calls = [c for c in calls if c[1].get("rollback")]
+    assert [c[0] for c in rollback_calls] == ["r0"]
+
+
+def test_rollout_controller_no_ready_replicas(tmp_path):
+    from deepdfa_tpu.fleet import rollout as fleet_rollout
+
+    report = fleet_rollout.run_rollout(ha_cfg(), tmp_path, "tag")
+    assert not report["ok"]
+    assert "no ready replicas" in report["error"]
+
+
+# ---------------------------------------------------------------------------
+# SLO guard (fleet/rollout.py:SloGuard) against a canned /stats
+
+
+class _StatsHandler(BaseHTTPRequestHandler):
+    slo: dict = {}
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        body = json.dumps({"slo": self.slo}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _with_stats(slo: dict):
+    handler = type("Stats", (_StatsHandler,), {"slo": slo})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread, httpd.server_address
+
+
+def test_slo_guard_reads_smallest_window_and_breaches():
+    from deepdfa_tpu.fleet.rollout import SloGuard
+
+    slo = {
+        "5s": {
+            "latency_ms": {"total": {"p99": 900.0}},
+            # 5 genuine 500s in 100: guard error rate 0.05; the 429/503
+            # sheds (designed admission behavior) must NOT count
+            "status": {"200": 75, "429": 10, "503": 10, "500": 5},
+            "error_rate": 0.25,
+        },
+        "60s": {
+            "latency_ms": {"total": {"p99": 50.0}},
+            "status": {"200": 100},
+            "error_rate": 0.0,
+        },
+        "queue_depth": 0,
+    }
+    httpd, thread, (host, port) = _with_stats(slo)
+    try:
+        # p99 arm disabled (0): server-error rate 0.05 under guard -> ok
+        # even though the window's RAW error_rate (0.25, sheds counted)
+        # would breach — sheds are load shedding working, not failures
+        out = SloGuard(0.0, 0.25).read(host, port)
+        assert out["ok"] and out["window"] == "5s"
+        assert out["p99_ms"] == 900.0
+        assert out["error_rate"] == 0.05
+        # p99 arm armed: the SMALLEST window's 900ms breaches, even
+        # though the 60s window looks fine
+        out = SloGuard(500.0, 0.25).read(host, port)
+        assert not out["ok"]
+        assert "p99" in out["reason"]
+        # error-rate arm: 0.05 genuine failures > 0.01 guard
+        out = SloGuard(0.0, 0.01).read(host, port)
+        assert not out["ok"]
+        assert "error rate" in out["reason"]
+        # error-rate arm disabled (0): even genuine failures pass
+        out = SloGuard(0.0, 0.0).read(host, port)
+        assert out["ok"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)
+
+
+def test_slo_guard_tolerates_empty_windows():
+    from deepdfa_tpu.fleet.rollout import SloGuard
+
+    httpd, thread, (host, port) = _with_stats({"queue_depth": 0})
+    try:
+        out = SloGuard(100.0, 0.1).read(host, port)
+        assert out["ok"]  # no window data yet is not a breach
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# chaos switchboard (fleet/chaos.py)
+
+
+def test_chaos_state_apply_view_and_rejection():
+    st = fleet_chaos.ChaosState()
+    assert st.view()["wedge_remaining_s"] == 0.0
+    out = st.apply({"wedge_s": 5.0}, now=100.0)
+    assert out["wedge_remaining_s"] == pytest.approx(5.0)
+    assert st.wedged(now=104.9) > 0
+    assert st.wedged(now=105.1) == 0.0
+    out = st.apply({"latency_s": 0.2, "duration_s": 10.0}, now=100.0)
+    assert out["latency_s"] == 0.2
+    assert st.view(now=110.1)["latency_s"] == 0.0  # expired
+    out = st.apply({"clear": True}, now=100.0)
+    assert out["wedge_remaining_s"] == 0.0
+    assert out["latency_s"] == 0.0
+    with pytest.raises(ValueError, match="unknown chaos keys"):
+        st.apply({"explode": 1})
+
+
+# ---------------------------------------------------------------------------
+# bounded handler-thread join (the docs/fleet.md thread audit)
+
+
+def test_draining_server_bounded_join_abandons_wedged_handler():
+    from deepdfa_tpu.fleet.replica import _DrainingServer
+
+    release = threading.Event()
+
+    class _Stuck(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            release.wait(30.0)  # wedged far past the join budget
+            self.send_response(200)
+            self.end_headers()
+
+    srv = _DrainingServer(("127.0.0.1", 0), _Stuck)
+    srv.join_timeout_s = 1.0
+    port = srv.server_address[1]
+    serve = threading.Thread(target=srv.serve_forever, daemon=True)
+    serve.start()
+
+    def fire():
+        import http.client
+
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=20
+            )
+            conn.request("GET", "/")
+            conn.getresponse()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    time.sleep(0.3)  # the handler is now inside release.wait
+    srv.shutdown()
+    t0 = time.monotonic()
+    srv.server_close()  # must NOT hang on the wedged handler
+    took = time.monotonic() - t0
+    assert took < 5.0, f"server_close blocked {took:.1f}s"
+    release.set()
+    serve.join(timeout=5)
+
+
+def test_ha_close_joins_with_timeout(tmp_path):
+    cfg = ha_cfg()
+    a = fleet_ha.HARouter(cfg, tmp_path, "ra")
+    a.start()
+    assert a.wait_active(10.0)
+    t0 = time.monotonic()
+    a.close()
+    assert time.monotonic() - t0 < 15.0
+    assert a._loop_thread is None
+    assert a._serve_thread is None
+
+
+# ---------------------------------------------------------------------------
+# MULTICHIP round-over-round gating (obs/bench_gate.py)
+
+
+def _mc_artifact(n=8, flops=1e9, compile_s=3.0, recompiles=0, rc=0,
+                 ok=True):
+    return {
+        "n_devices": n, "rc": rc, "ok": ok, "skipped": [],
+        "parsed": {"multichip": {
+            "n_devices": n,
+            "serve": {"steady_state_recompiles": recompiles},
+            "shard": {
+                "train_dp8/S8": {
+                    "flops_per_sec": flops,
+                    "per_shard_flops_per_sec": flops / 8,
+                    "compile_seconds": compile_s,
+                },
+                "serve_score/G1": {
+                    "flops_per_sec": flops / 10,
+                    "compile_seconds": compile_s / 2,
+                },
+            },
+            "compile_seconds_total": compile_s * 4,
+        }},
+    }
+
+
+def _mc_trajectory():
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    entries = []
+    for i, art in enumerate([
+        _mc_artifact(rc=124, ok=False),        # failed round
+        _mc_artifact(flops=1.2e9),             # healthy baseline
+    ], start=1):
+        entries.append({
+            "source": f"MULTICHIP_r{i:02d}.json", "round": i,
+            "artifact": art,
+            "record": bg.multichip_record(art),
+        })
+    return entries
+
+
+def test_multichip_gate_pass_and_regression():
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    traj = _mc_trajectory()
+    ok = bg.gate_multichip(_mc_artifact(flops=1.1e9), traj)
+    assert ok["verdict"] == "pass", ok
+    # the reference is the healthy round, never the failed one
+    assert all(
+        c["ref_source"] in ("MULTICHIP_r02.json", "absolute_bound")
+        for c in ok["checks"]
+    )
+    slow = bg.gate_multichip(_mc_artifact(flops=0.4e9), traj)
+    assert slow["verdict"] == "fail"
+    assert "regression" in slow["failure_classes"]
+    compile_blowup = bg.gate_multichip(
+        _mc_artifact(flops=1.2e9, compile_s=30.0), traj
+    )
+    assert compile_blowup["verdict"] == "fail"
+
+
+def test_multichip_gate_recompile_pin_and_error_class():
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    traj = _mc_trajectory()
+    recompiled = bg.gate_multichip(
+        _mc_artifact(flops=1.2e9, recompiles=2), traj
+    )
+    assert recompiled["verdict"] == "fail"
+    assert any(
+        c["metric"] == "serve/steady_state_recompiles" and not c["ok"]
+        for c in recompiled["checks"]
+    )
+    failed = bg.gate_multichip(_mc_artifact(rc=1, ok=False), traj)
+    assert "error" in failed["failure_classes"]
+
+
+def test_multichip_gate_scale_mismatch_skips_reference():
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    traj = _mc_trajectory()
+    other_scale = bg.gate_multichip(_mc_artifact(n=4), traj)
+    # no 4-device reference: only the absolute recompile pin runs
+    assert other_scale["verdict"] == "pass"
+    assert all(
+        c["ref_source"] == "absolute_bound"
+        for c in other_scale["checks"]
+    )
+    assert any("no healthy" in n for n in other_scale["notes"])
+
+
+def test_multichip_real_trajectory_loads_and_gates():
+    from pathlib import Path
+
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    repo = Path(__file__).resolve().parent.parent
+    traj = bg.load_multichip_trajectory(repo)
+    assert traj, "no committed MULTICHIP_r*.json found"
+    healthy = [e for e in traj if bg._multichip_healthy(e)]
+    assert healthy, "no healthy multichip round in the repo"
+    newest = healthy[-1]
+    verdict = bg.gate_multichip(
+        newest["artifact"], traj,
+    )
+    # gated against the trajectory INCLUDING itself: must pass (the
+    # CLI excludes the candidate; this pins record/parse integrity)
+    assert verdict["verdict"] == "pass", verdict
+
+
+# ---------------------------------------------------------------------------
+# on-disk param-bytes estimation (fleet/replica.py, the planner input)
+
+
+def test_estimate_param_bytes_on_disk(tmp_path):
+    from deepdfa_tpu.fleet.replica import estimate_param_bytes_on_disk
+
+    ckpt = tmp_path / "checkpoints" / "best"
+    ckpt.mkdir(parents=True)
+    (ckpt / "params.bin").write_bytes(b"x" * 1000)
+    (ckpt / "meta.json").write_bytes(b"y" * 24)
+    got = estimate_param_bytes_on_disk(tmp_path, "deepdfa", "best")
+    assert got == 1024.0
+    # @int8 strips to the base tag (served bytes differ; disk is fp32)
+    assert estimate_param_bytes_on_disk(
+        tmp_path, "deepdfa", "best@int8"
+    ) == 1024.0
+    # "last" resolves through the manifest
+    (tmp_path / "checkpoints" / "manifest.json").write_text(
+        json.dumps({"last": {"tag": "best"}})
+    )
+    assert estimate_param_bytes_on_disk(
+        tmp_path, "deepdfa", "last"
+    ) == 1024.0
+    # unresolvable -> 0.0, never a crash
+    assert estimate_param_bytes_on_disk(
+        tmp_path, "deepdfa", "missing-tag"
+    ) == 0.0

@@ -341,6 +341,55 @@ def test_registry_restore_and_hot_swap(tmp_path, monkeypatch, corpus,
     assert executor.jit_lowerings() == n0  # swap never recompiles
 
 
+def test_hot_reload_discarded_when_swap_lands_mid_restore(
+        tmp_path, monkeypatch, corpus, served_model):
+    """The swap-generation fence: maybe_reload restores OUTSIDE the
+    registry lock, so an operator swap_checkpoint/rollback landing in
+    that window must win — the poller discards its now-stale params
+    instead of silently reverting the swap (fleet rollout contract)."""
+    import jax
+
+    from deepdfa_tpu.core import paths
+    from deepdfa_tpu.serve.registry import ModelRegistry
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    monkeypatch.setenv("DEEPDFA_TPU_STORAGE", str(tmp_path))
+    examples, specs, vocabs = corpus
+    cfg, model, params = served_model
+    cfg = config_mod.apply_overrides(
+        cfg, ['run_name="serve-race"', 'data.dataset="serve-race"']
+    )
+    (paths.processed_dir("serve-race") / f"vocab{cfg.data.feat.name}.json"
+     ).write_text(json.dumps({k: v.to_json() for k, v in vocabs.items()}))
+    run_dir = _write_run(tmp_path, cfg, model, params, {"val_loss": 1.0})
+    registry = ModelRegistry(run_dir, family="deepdfa", cfg=cfg)
+    params2 = jax.tree.map(lambda a: a + 0.05, jax.device_get(params))
+    CheckpointManager(run_dir / "checkpoints", monitor="val_loss").save(
+        "epoch-0002", params2, {"val_loss": 0.5}, step=2
+    )
+
+    # the manifest moved, so a reload is due — but an operator swap
+    # lands while the poller's restore runs outside the lock
+    orig_restore = registry._restore
+
+    def racing_restore(base=None):
+        out = orig_restore(base)
+        with registry._lock:
+            registry._swap_generation += 1
+        return out
+
+    served_before = registry.params()
+    monkeypatch.setattr(registry, "_restore", racing_restore)
+    assert registry.maybe_reload() is False  # discarded, not committed
+    assert registry.params() is served_before  # swap's params untouched
+    assert registry.reloads == 0
+
+    # with no concurrent swap the same reload lands on the next poll
+    monkeypatch.setattr(registry, "_restore", orig_restore)
+    assert registry.maybe_reload() is True
+    assert registry.info()["checkpoint_step"] == 2
+
+
 def test_restore_for_inference_errors(tmp_path, served_model):
     import jax
 
