@@ -70,6 +70,7 @@ FLEET_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_FLEET_TIMEOUT", 420))
 CASCADE_TIMEOUT = float(
     os.environ.get("DEEPDFA_BENCH_CASCADE_TIMEOUT", 420)
 )
+TUNE_TIMEOUT = float(os.environ.get("DEEPDFA_BENCH_TUNE_TIMEOUT", 420))
 TOTAL_BUDGET = float(os.environ.get("DEEPDFA_BENCH_TOTAL_BUDGET", 3300))
 
 #: peak dense-matmul FLOP/s per chip, by (platform, dtype). v5e: 197
@@ -779,6 +780,43 @@ def run_cascade_measurement(platform: str) -> dict:
     return out
 
 
+def run_tune_measurement(platform: str) -> dict:
+    """Autotuner search observables (ISSUE 15); child, CPU-viable.
+
+    Delegates to scripts/bench_tune.py:bench_tune — one real reduced
+    search pass (kernel candidates compiled + timed under the numerics
+    contract, ladder + seq-bucket fits) — and passes the fields
+    through: they already carry the tuned_*/tune_* names the bench gate
+    reads (`tuned_ggnn_step_us` / `tuned_ladder_padding_waste`
+    lower-is-better, `tune_search_seconds` absolute-bounded)."""
+    from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
+
+    if platform == "cpu":
+        force_cpu()
+    enable_compile_cache()
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    if "DEEPDFA_TPU_STORAGE" not in os.environ:
+        import tempfile
+
+        tmp = tempfile.TemporaryDirectory(prefix="bench-tune-")
+        os.environ["DEEPDFA_TPU_STORAGE"] = tmp.name
+    from bench_tune import bench_tune
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    smoke = platform == "cpu"
+    rec = bench_tune(smoke=smoke)
+    out = {
+        k: v for k, v in rec.items()
+        if k.startswith(("tuned_", "tune_"))
+    }
+    out["tune_platform"] = platform
+    return out
+
+
 def _run_child(mode: str, platform: str, timeout: float) -> tuple[dict | None, str]:
     """Run one measurement in a watchdogged subprocess; (result, error)."""
     from deepdfa_tpu.core.backend import bounded_run
@@ -912,6 +950,22 @@ def _measure_full(
                 result["cascade_error"] = caerr
         else:
             result["cascade_error"] = "skipped: total budget exhausted"
+    if os.environ.get("DEEPDFA_BENCH_TUNE", "0") == "1":
+        # autotuner search observables (ISSUE 15), opt-in via
+        # DEEPDFA_BENCH_TUNE (the tune layer is default-off), own
+        # bounded child for the same wedge-isolation reason
+        tbudget = min(TUNE_TIMEOUT, deadline - time.time())
+        if tbudget >= 90:
+            tun, tunerr = _run_child(
+                "--child-tune", result.get("platform", platform),
+                tbudget,
+            )
+            if tun is not None:
+                result.update(tun)
+            else:
+                result["tune_error"] = tunerr
+        else:
+            result["tune_error"] = "skipped: total budget exhausted"
     return result
 
 
@@ -1135,6 +1189,11 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--child-cascade":
         print(
             _CHILD_TAG + json.dumps(run_cascade_measurement(sys.argv[2])),
+            flush=True,
+        )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--child-tune":
+        print(
+            _CHILD_TAG + json.dumps(run_tune_measurement(sys.argv[2])),
             flush=True,
         )
     else:

@@ -66,6 +66,30 @@ def _load_run_config(args) -> Config:
     return cfg
 
 
+def _apply_tuned(cfg: Config, serve_side: bool = False) -> Config:
+    """Fold the matching tuned.json layout into the config when
+    tune.enabled (deepdfa_tpu/tune/, docs/tuning.md) — a no-op (loud,
+    inside record_for_config) otherwise or on any hardware-key
+    mismatch. Train-side callers also take the fitted seq-bucket edges;
+    serve-side callers take only the kernel block layout (their ladder
+    + bucket edges flow through ScoringService so the registry's
+    hot-swap digest never sees a tuned data section) keyed at the
+    resolved SERVE budgets — the signature the score programs pack at."""
+    if not getattr(getattr(cfg, "tune", None), "enabled", False):
+        return cfg
+    from deepdfa_tpu.tune import cache as tune_cache
+
+    if serve_side:
+        cfg, _ = tune_cache.apply_to_config(
+            cfg, sections=("kernel",),
+            node_budget=cfg.serve.node_budget or cfg.data.batch.node_budget,
+            edge_budget=cfg.serve.edge_budget or cfg.data.batch.edge_budget,
+        )
+    else:
+        cfg, _ = tune_cache.apply_to_config(cfg)
+    return cfg
+
+
 def _graphs_dirname(cfg: Config) -> str:
     """Graph-store directory for the configured feat x gtype; the flagship
     cfg gtype keeps the historical name so existing artifacts stay valid."""
@@ -487,6 +511,9 @@ def cmd_train(args) -> None:
     # config, run log, checkpoints, step checkpoints) are owned by
     # process 0 while every host runs the identical sharded steps
     sharding_mod.init_runtime()
+    # tuned layout AFTER init_runtime: the hardware-key lookup probes
+    # jax.devices(), which must see the distributed topology
+    cfg = _apply_tuned(cfg)
     primary = sharding_mod.is_primary()
     if primary:
         config_mod.to_json(cfg, run_dir / "config.json")
@@ -835,6 +862,9 @@ def cmd_train_combined(args) -> None:
     from deepdfa_tpu.parallel import sharding as sharding_mod
 
     sharding_mod.init_runtime()
+    # tuned layout AFTER init_runtime: the hardware-key lookup probes
+    # jax.devices(), which must see the distributed topology
+    cfg = _apply_tuned(cfg)
     primary = sharding_mod.is_primary()
     # run-config manifest, as cmd_train writes: localize/test restore
     # the checkpoint with the dims it was trained with (_load_run_config)
@@ -1755,6 +1785,62 @@ def cmd_diag(args) -> None:
         raise SystemExit(rc)
 
 
+def cmd_tune(args) -> None:
+    """Offline measured-search autotuner (deepdfa_tpu/tune/,
+    docs/tuning.md): compile-and-time kernel tile candidates under the
+    PR-8 numerics contract, fit serve-ladder rungs + seq-bucket edges
+    to the observed size distribution, persist the winners in a
+    hardware-keyed tuned.json. --smoke is the tier-1 acceptance drive
+    (reduced candidate set, synthetic skewed distributions, asserted
+    fit-beats-pow2 + schema validity)."""
+    from deepdfa_tpu.tune import cache as tune_cache, driver as tune_driver
+
+    if args.smoke:
+        report = tune_driver.run_tune_smoke(out_path=args.out)
+        print(json.dumps(report), flush=True)
+        bad = (
+            not report["valid"]
+            # the smoke's headline contract: a REAL search completed
+            # (candidates timed, a winner chosen under the numerics
+            # contract) and the measured ladder fit STRICTLY beats the
+            # pow2 baseline on the skewed smoke distribution
+            or report["winner"] is None
+            or report["candidates_timed"] == 0
+            or not (
+                report["tuned_ladder_padding_waste"]
+                < report["pow2_ladder_padding_waste"]
+            )
+            or not (
+                report["seq_bucket_padding_waste"]
+                <= report["seq_bucket_pow2_padding_waste"]
+            )
+        )
+        if bad:
+            raise SystemExit("tune smoke contract violated (see report)")
+        return
+    cfg = _load_run_config(args)
+    report = tune_driver.run_tune(
+        cfg,
+        serve_logs=args.serve_log,
+        manifest=args.manifest,
+        out_path=args.out,
+        skip_kernel=args.skip_kernel,
+    )
+    if not report["valid"]:
+        raise SystemExit(
+            "tuned.json failed validation: "
+            + "; ".join(report["problems"])
+        )
+    # keep the trajectory contract visible: the committed TUNED_r*
+    # documents gate round-over-round via scripts/bench_gate.py --tuned
+    verdict = tune_cache.validate_tuned_file(report["tuned_path"])
+    if not verdict["ok"]:
+        raise SystemExit(
+            "written tuned.json failed re-validation: "
+            + "; ".join(verdict["problems"])
+        )
+
+
 def cmd_cascade_calibrate(args) -> None:
     """Fit the cascade's temperature + uncertainty band from a labeled
     dev set (docs/cascade.md calibration recipe): a JSONL of
@@ -1810,6 +1896,7 @@ def cmd_score(args) -> None:
     else:
         if not args.sources:
             raise SystemExit("score needs source files/dirs (or --smoke)")
+        cfg = _apply_tuned(cfg, serve_side=True)
         run_dir = paths.runs_dir(cfg.run_name)
         sources = driver.collect_sources(args.sources)
     with obs.session(cfg, run_dir):
@@ -1872,6 +1959,7 @@ def cmd_serve(args) -> None:
             raise SystemExit("serve smoke contract violated (see report)")
         return
     cfg = _load_run_config(args)
+    cfg = _apply_tuned(cfg, serve_side=True)
     run_dir = paths.runs_dir(cfg.run_name)
     from deepdfa_tpu.serve.registry import serve_mesh
 
@@ -1927,6 +2015,7 @@ def cmd_scan(args) -> None:
     if not args.repo:
         raise SystemExit("scan needs a repository path (or --smoke)")
     cfg = _load_run_config(args)
+    cfg = _apply_tuned(cfg, serve_side=True)
     if args.lines:
         cfg = config_mod.apply_overrides(cfg, ["scan.lines=true"])
     if args.no_incremental:
@@ -2114,6 +2203,7 @@ def cmd_fleet_replica(args) -> None:
     cfg = _config_mod.apply_overrides(cfg, args.overrides)
     _config_mod.validate(cfg)
     _config_mod.apply_sanitizers(cfg)
+    cfg = _apply_tuned(cfg, serve_side=True)
     worker = ReplicaWorker(
         cfg, run_dir, args.replica_id,
         fleet_dir=args.fleet_dir, host=args.host, port=args.port,
@@ -2511,6 +2601,40 @@ def main(argv=None) -> None:
                    dest="overrides",
                    help="dotted key=value config override (repeatable)")
     p.set_defaults(fn=cmd_score)
+
+    p = sub.add_parser(
+        "tune",
+        help="offline measured-search autotuner: kernel tiles + batch "
+        "ladders fitted to observed traffic, persisted per hardware "
+        "generation in tuned.json (docs/tuning.md)",
+    )
+    p.add_argument("--serve-log", action="append", default=[],
+                   metavar="PATH",
+                   help="serve_log.jsonl / fleet_log.jsonl to replay "
+                        "the observed batch-size distribution from "
+                        "(repeatable; needs serve.request_log=true "
+                        "entries)")
+    p.add_argument("--manifest", default=None, metavar="PATH",
+                   help="training manifest of real token lengths (JSON "
+                        "array, or JSONL with a length/tokens field) "
+                        "for the seq-bucket fit")
+    p.add_argument("--out", default=None,
+                   help="tuned.json path (default tune.path, else "
+                        "<storage>/tuned.json)")
+    p.add_argument("--skip-kernel", action="store_true",
+                   help="ladder fits only (skip the kernel candidate "
+                        "compile-and-time pass)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 acceptance drive: real search over a "
+                        "reduced candidate set + synthetic skewed "
+                        "distributions; asserts fit-beats-pow2 and a "
+                        "schema-valid tuned.json")
+    # consistent override surface with score/serve (no positionals)
+    p.add_argument("--config", default=None, help="json config file")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
         "cascade-calibrate",

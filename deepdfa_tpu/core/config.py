@@ -140,6 +140,13 @@ class ModelConfig:
     # "bf16" (halved gather traffic, f32 accumulation, f32 GRU state;
     # tolerance pinned in tests/test_ggnn_kernel.py)
     ggnn_kernel_accum: str = "fp32"
+    # kernel block/tile sizes (0 = the hand-picked defaults in
+    # nn/ggnn_kernel.py:block_sizes). LAYOUT-ONLY knobs: they change how
+    # the fused step tiles, never the param tree or numerics contract —
+    # excluded from the serve registry's config digest so a tuned layout
+    # (deepdfa_tpu/tune/, docs/tuning.md) never refuses a hot swap
+    ggnn_kernel_block_nodes: int = 0
+    ggnn_kernel_block_edges: int = 0
 
 
 @dataclass(frozen=True)
@@ -585,6 +592,33 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class TuneConfig:
+    """Ledger-driven autotuner knobs (deepdfa_tpu/tune/, docs/tuning.md).
+
+    `enabled` only controls whether consumers CONSULT tuned.json at
+    warmup — the search itself runs offline via `deepdfa-tpu tune`,
+    never in the request path. Default OFF: the default path stays
+    byte-identical and warms exactly the hand-picked layouts."""
+
+    # consult tuned.json at warmup: kernel block sizes, serve warmup
+    # ladder rungs, data.seq_buckets edges — each falls back to its
+    # hand-picked default LOUDLY when the hardware key doesn't match
+    enabled: bool = False
+    # tuned.json path; empty = <storage>/tuned.json
+    path: str | None = None
+    # ladder budgets: the rung/edge count cap (each rung is one AOT
+    # compile, so this IS the compile budget's structural half) ...
+    max_rungs: int = 6
+    max_seq_buckets: int = 6
+    # ... and the compile-seconds half: candidate compiles stop (and
+    # ladder lengths shrink) once the measured compile time spent
+    # crosses this; 0 = uncapped
+    compile_budget_s: float = 120.0
+    # interleaved timing reps per kernel candidate (best window kept)
+    reps: int = 3
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh + the declarative sharding layer's knobs
     (parallel/sharding.py, docs/sharding.md). Axis sizes of 1 collapse;
@@ -668,6 +702,7 @@ class Config:
     serve: ServeConfig = field(default_factory=ServeConfig)
     scan: ScanConfig = field(default_factory=ScanConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    tune: TuneConfig = field(default_factory=TuneConfig)
 
 
 # ---------------------------------------------------------------------------

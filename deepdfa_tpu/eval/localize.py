@@ -355,6 +355,12 @@ def ggnn_forward(model, params, batch):
             use_kernel=getattr(model, "ggnn_kernel", False),
             kernel_scatter=getattr(model, "ggnn_kernel_scatter", "auto"),
             kernel_accum=getattr(model, "ggnn_kernel_accum", "fp32"),
+            kernel_block_nodes=getattr(
+                model, "ggnn_kernel_block_nodes", 0
+            ),
+            kernel_block_edges=getattr(
+                model, "ggnn_kernel_block_edges", 0
+            ),
         ).apply({"params": p["ggnn"]}, batch, rows)
         out = jnp.concatenate([ggnn_out, rows], axis=-1)
         gp = p["pooling"]["gate_nn"]

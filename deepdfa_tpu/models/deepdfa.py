@@ -56,6 +56,10 @@ class DeepDFA(nn.Module):
     ggnn_kernel: bool = False
     ggnn_kernel_scatter: str = "auto"
     ggnn_kernel_accum: str = "fp32"
+    #: tuned block/tile sizes (deepdfa_tpu/tune/, docs/tuning.md);
+    #: 0 = the hand-picked defaults in nn/ggnn_kernel.py:block_sizes
+    ggnn_kernel_block_nodes: int = 0
+    ggnn_kernel_block_edges: int = 0
 
     @classmethod
     def from_config(cls, cfg: ModelConfig, input_dim: int, **overrides) -> "DeepDFA":
@@ -73,6 +77,12 @@ class DeepDFA(nn.Module):
             ggnn_kernel=getattr(cfg, "ggnn_kernel", False),
             ggnn_kernel_scatter=getattr(cfg, "ggnn_kernel_scatter", "auto"),
             ggnn_kernel_accum=getattr(cfg, "ggnn_kernel_accum", "fp32"),
+            ggnn_kernel_block_nodes=getattr(
+                cfg, "ggnn_kernel_block_nodes", 0
+            ),
+            ggnn_kernel_block_edges=getattr(
+                cfg, "ggnn_kernel_block_edges", 0
+            ),
             param_dtype=jnp.dtype(cfg.param_dtype),
         )
         kw.update(overrides)
@@ -116,6 +126,8 @@ class DeepDFA(nn.Module):
             use_kernel=self.ggnn_kernel,
             kernel_scatter=self.ggnn_kernel_scatter,
             kernel_accum=self.ggnn_kernel_accum,
+            kernel_block_nodes=self.ggnn_kernel_block_nodes,
+            kernel_block_edges=self.ggnn_kernel_block_edges,
             name="ggnn",
         )(batch, feat_embed)
 

@@ -91,7 +91,20 @@ LOWER_IS_BETTER: dict[str, float] = {
     # the quantized entry's param-bytes fraction vs fp32 — rising means
     # the quantizer stopped covering weights it used to cover
     "quant_param_bytes_fraction": 0.10,
+    # ledger-driven autotuner (ISSUE 15, bench.py --child-tune behind
+    # DEEPDFA_BENCH_TUNE): the winning kernel layout's measured per-step
+    # time on the smoke signature, and the fitted ladder's expected
+    # padded-compute fraction on the skewed smoke distribution — either
+    # rising past tolerance means the search started picking worse
+    # layouts (docs/tuning.md)
+    "tuned_ggnn_step_us": 0.25,
+    "tuned_ladder_padding_waste": 0.10,
 }
+
+#: lower-is-better metrics whose 0.0 reference is an EXACT-FIT claim,
+#: not a degenerate ratio: they keep gating (absolute epsilon floor)
+#: instead of being skipped when the reference round recorded 0.0
+ZERO_REFERENCE_STRICT = frozenset({"tuned_ladder_padding_waste"})
 
 #: ABSOLUTE upper bounds, checked whenever the candidate carries the
 #: metric — no reference needed (the <=2% overhead contracts the PR-4
@@ -107,6 +120,10 @@ ABSOLUTE_UPPER_BOUNDS: dict[str, float] = {
     # int8 matmul weights + bf16 rest must keep the quantized entry
     # under half the fp32 bytes or the quantizer is not doing its job
     "quant_param_bytes_fraction": 0.5,
+    # the autotuner's search must stay an offline bounded pass, never a
+    # creeping compile storm: an ABSOLUTE ceiling on the measured
+    # search wall time the bench child stamps (ISSUE 15)
+    "tune_search_seconds": 300.0,
 }
 
 
@@ -302,13 +319,27 @@ def gate(
                 ref_v, (int, float)
             ) or isinstance(new_v, bool) or isinstance(ref_v, bool):
                 continue
-            if ref_v == 0:
-                continue
             is_lower = metric in lower
-            ratio = new_v / ref_v
-            ok = (
-                ratio <= 1 + frac if is_lower else ratio >= 1 - frac
-            )
+            if ref_v == 0:
+                if metric not in ZERO_REFERENCE_STRICT:
+                    # ratios against 0 are meaningless for ordinary
+                    # throughput/rate metrics (a 0.0 shed-rate round
+                    # must not hard-fail the first round that sheds
+                    # one request) — skipped, as always
+                    continue
+                # ... but an exact-fit claim (padding waste 0.0) is a
+                # CONTRACT: skipping would blind the gate forever
+                # after the first perfect round, so those named
+                # metrics compare with an absolute epsilon floor
+                # (the gate_tuned rule)
+                ok = new_v <= 1e-6
+                ratio = None
+            else:
+                ratio = round(new_v / ref_v, 4)
+                ok = (
+                    new_v / ref_v <= 1 + frac if is_lower
+                    else new_v / ref_v >= 1 - frac
+                )
             checks.append({
                 "metric": metric,
                 "new": new_v,
@@ -316,7 +347,7 @@ def gate(
                 "ref_source": ref["source"],
                 "tolerance": frac,
                 "direction": "lower" if is_lower else "higher",
-                "ratio": round(ratio, 4),
+                "ratio": ratio,
                 "ok": ok,
             })
             if not ok and "regression" not in failure_classes:
@@ -571,6 +602,214 @@ def gate_multichip(
         "verdict": "fail" if failure_classes else "pass",
         "failure_classes": failure_classes,
         "n_devices": artifact.get("n_devices"),
+        "checks": checks,
+        "notes": notes,
+    }
+
+
+# ---------------------------------------------------------------------------
+# TUNED_r* round-over-round gating (ISSUE 15, docs/tuning.md): the
+# committed tuned.json trajectory joins the bench gate the way
+# BENCH_r*/MULTICHIP_r* did — a tuned layout that regresses against its
+# OWN record (winner step time up, fitted padding waste up, or the
+# fit losing to the pow2 baseline it exists to beat) fails CI.
+
+#: per-signature / per-ladder lower-is-better tolerances — derived from
+#: the bench-record entries above so the TUNED_r* gate and the
+#: BENCH-record gate can never enforce different bounds on the same
+#: quantities
+TUNED_TOLERANCES: dict[str, float] = {
+    "winner_step_us": LOWER_IS_BETTER["tuned_ggnn_step_us"],
+    "padding_waste": LOWER_IS_BETTER["tuned_ladder_padding_waste"],
+}
+
+#: absolute wall-time ceiling on one recorded search pass (the ONE
+#: declaration lives in ABSOLUTE_UPPER_BOUNDS)
+TUNED_SEARCH_SECONDS_BOUND = ABSOLUTE_UPPER_BOUNDS["tune_search_seconds"]
+
+
+def _tuned_doc(artifact: dict) -> dict | None:
+    if not isinstance(artifact, dict):
+        return None
+    doc = artifact.get("tuned") if "records" not in artifact else artifact
+    if isinstance(doc, dict) and isinstance(doc.get("records"), list):
+        return doc
+    return None
+
+
+def tuned_reference_for(
+    trajectory: list[dict],
+    hardware: dict,
+    exclude_source: str | None = None,
+) -> dict | None:
+    """The newest trajectory record whose hardware key matches exactly
+    (a v5e layout gated against a v4 baseline compares nothing) — the
+    BENCH_r* reference-selection rules with the hardware key as the
+    comparable-scale axis."""
+    from deepdfa_tpu.tune.cache import find_record
+
+    best = None
+    for entry in trajectory:
+        if exclude_source is not None and entry.get("source") == (
+            exclude_source
+        ):
+            continue
+        doc = entry.get("record")
+        if not isinstance(doc, dict):
+            continue
+        rec = find_record(doc, hardware)
+        if rec is not None:
+            best = {"record": rec, "source": entry["source"]}
+    return best
+
+
+def gate_tuned(
+    artifact: dict,
+    trajectory: list[dict],
+    tolerances: dict[str, float] | None = None,
+    exclude_source: str | None = None,
+) -> dict:
+    """Verdict for one tuned.json / TUNED_r* document against the
+    committed trajectory — the shape `gate()` returns. Checks, per
+    hardware-keyed record: schema validity (an invalid document is an
+    `error`), the search-seconds absolute bound, per-signature winner
+    step time vs the newest same-hardware reference, per-ladder fitted
+    padding waste vs the reference, and the fit-beats-pow2 invariant as
+    an absolute bound."""
+    from deepdfa_tpu.tune.cache import validate_tuned
+
+    tol = dict(TUNED_TOLERANCES)
+    for k, v in (tolerances or {}).items():
+        tol[k] = float(v)
+    failure_classes: list[str] = []
+    notes: list[str] = []
+    checks: list[dict] = []
+
+    doc = _tuned_doc(artifact)
+    verdict = validate_tuned(artifact)
+    if doc is None or not verdict["ok"]:
+        failure_classes.append("error")
+        notes.extend(
+            f"schema: {p}" for p in verdict.get("problems", [])[:8]
+        )
+        doc = doc or {"records": []}
+
+    def fail(cls: str = "regression") -> None:
+        if cls not in failure_classes:
+            failure_classes.append(cls)
+
+    for rec in doc.get("records", []):
+        if not isinstance(rec, dict):
+            continue
+        hw = rec.get("hardware") or {}
+        hw_label = (
+            f"{hw.get('device_kind')}@"
+            f"{hw.get('node_budget')}x{hw.get('edge_budget')}"
+        )
+        secs = rec.get("search_seconds")
+        if isinstance(secs, (int, float)) and not isinstance(secs, bool):
+            ok = secs <= TUNED_SEARCH_SECONDS_BOUND
+            checks.append({
+                "metric": f"{hw_label}/search_seconds",
+                "new": secs,
+                "reference": TUNED_SEARCH_SECONDS_BOUND,
+                "ref_source": "absolute_bound",
+                "tolerance": 0.0,
+                "direction": "bound",
+                "ratio": round(secs / TUNED_SEARCH_SECONDS_BOUND, 4),
+                "ok": ok,
+            })
+            if not ok:
+                fail()
+        # the fit must beat (or tie) its own recorded pow2 baseline —
+        # absolute, no reference round needed
+        for name, lr in (rec.get("ladders") or {}).items():
+            if not isinstance(lr, dict):
+                continue
+            w, p = lr.get("padding_waste"), lr.get("pow2_padding_waste")
+            if isinstance(w, (int, float)) and isinstance(
+                p, (int, float)
+            ) and not isinstance(w, bool) and not isinstance(p, bool):
+                ok = w <= p
+                checks.append({
+                    "metric": f"{hw_label}/ladders/{name}/fit_vs_pow2",
+                    "new": w,
+                    "reference": p,
+                    "ref_source": "absolute_bound",
+                    "tolerance": 0.0,
+                    "direction": "bound",
+                    "ratio": round(w / p, 4) if p else None,
+                    "ok": ok,
+                })
+                if not ok:
+                    fail()
+        ref = tuned_reference_for(
+            trajectory, hw, exclude_source=exclude_source
+        )
+        if ref is None:
+            notes.append(
+                f"no same-hardware reference for {hw_label} in the "
+                "trajectory — round-over-round checks skipped"
+            )
+            continue
+        rrec = ref["record"]
+        new_kernel = rec.get("kernel") or {}
+        ref_kernel = rrec.get("kernel") or {}
+        for sig in sorted(set(new_kernel) & set(ref_kernel)):
+            new_v = (new_kernel[sig] or {}).get("winner_step_us")
+            ref_v = (ref_kernel[sig] or {}).get("winner_step_us")
+            if not isinstance(new_v, (int, float)) or not isinstance(
+                ref_v, (int, float)
+            ) or isinstance(new_v, bool) or isinstance(
+                ref_v, bool
+            ) or not ref_v:
+                continue
+            frac = tol["winner_step_us"]
+            ratio = new_v / ref_v
+            ok = ratio <= 1 + frac
+            checks.append({
+                "metric": f"{hw_label}/kernel/{sig}/winner_step_us",
+                "new": new_v,
+                "reference": ref_v,
+                "ref_source": ref["source"],
+                "tolerance": frac,
+                "direction": "lower",
+                "ratio": round(ratio, 4),
+                "ok": ok,
+            })
+            if not ok:
+                fail()
+        new_ladders = rec.get("ladders") or {}
+        ref_ladders = rrec.get("ladders") or {}
+        for name in sorted(set(new_ladders) & set(ref_ladders)):
+            new_v = (new_ladders[name] or {}).get("padding_waste")
+            ref_v = (ref_ladders[name] or {}).get("padding_waste")
+            if not isinstance(new_v, (int, float)) or not isinstance(
+                ref_v, (int, float)
+            ) or isinstance(new_v, bool) or isinstance(ref_v, bool):
+                continue
+            frac = tol["padding_waste"]
+            # waste can legitimately be 0.0 (an exact fit): compare with
+            # an absolute epsilon floor so a 0-reference still gates
+            bound = ref_v * (1 + frac) + 1e-6
+            ok = new_v <= bound
+            checks.append({
+                "metric": f"{hw_label}/ladders/{name}/padding_waste",
+                "new": new_v,
+                "reference": ref_v,
+                "ref_source": ref["source"],
+                "tolerance": frac,
+                "direction": "lower",
+                "ratio": (
+                    round(new_v / ref_v, 4) if ref_v else None
+                ),
+                "ok": ok,
+            })
+            if not ok:
+                fail()
+    return {
+        "verdict": "fail" if failure_classes else "pass",
+        "failure_classes": failure_classes,
         "checks": checks,
         "notes": notes,
     }

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from pathlib import Path
 
@@ -207,6 +208,41 @@ def serve_attribution(serve_records: list[dict]) -> dict:
               "cache_hits", "cache_misses", "hot_swaps"):
         if k in snap:
             out[k] = snap[k]
+    # the ladder blind-spot view (deepdfa_tpu/tune/, docs/tuning.md):
+    # per-rung real vs padded rows from the executor counters, so a
+    # stream whose sizes all land just above a rung is visible here
+    # even with tuning off
+    rungs: dict[str, dict] = {}
+    for k, v in snap.items():
+        if not k.startswith("ladder/"):
+            continue
+        parts = k.split("/")
+        if len(parts) != 3:
+            continue
+        _, rung, field = parts
+        if field in ("real_rows", "padded_rows"):
+            rungs.setdefault(rung, {})[field] = v
+    if rungs:
+        for rung, agg in rungs.items():
+            real = agg.get("real_rows", 0.0)
+            padded = agg.get("padded_rows", 0.0)
+            total = real + padded
+            if total:
+                agg["waste"] = round(padded / total, 4)
+
+        def rung_order(label: str):
+            # numeric order, not lexicographic (G2 before G16); graph
+            # rungs (G*) before combined bucket labels (T*xR*)
+            m = re.match(r"([A-Za-z]+)(\d+)", label)
+            if m:
+                return (m.group(1), int(m.group(2)))
+            return (label, 0)
+
+        out["ladder"] = {
+            k: rungs[k] for k in sorted(rungs, key=rung_order)
+        }
+    if "ladder_waste" in snap:
+        out["ladder_waste"] = snap["ladder_waste"]
     return out
 
 
@@ -546,6 +582,97 @@ def efficiency_section(run_dir: Path, records: list[dict]) -> dict:
     return out
 
 
+def tuning_section(run_dir: Path) -> dict:
+    """The autotuner view (deepdfa_tpu/tune/, docs/tuning.md), rebuilt
+    from the persisted tuned.json: per-signature candidate timings +
+    numerics verdicts, the chosen layout, and the ladder fits' waste
+    before (pow2) vs after (fitted). Looks in the run dir first, then
+    the storage-wide default location."""
+    from deepdfa_tpu.tune import cache as tune_cache
+
+    # resolution order mirrors the server's (tune/cache.py:tuned_path):
+    # the config-pinned tune.path WINS — the layout /healthz reports
+    # must be the one this section renders; run_dir/tuned.json is the
+    # smoke/ad-hoc location, the storage default last
+    candidates = []
+    try:
+        saved = json.loads((run_dir / "config.json").read_text())
+        override = (saved.get("tune") or {}).get("path")
+        if override:
+            candidates.append(Path(override))
+    except (OSError, json.JSONDecodeError):
+        pass
+    candidates.append(run_dir / "tuned.json")
+    try:
+        from deepdfa_tpu.core import paths
+
+        candidates.append(paths.storage_root() / "tuned.json")
+    except Exception:
+        pass
+    doc = None
+    path = candidates[0]
+    for cand in candidates:
+        doc = tune_cache.load_tuned(cand)
+        if doc is not None:
+            path = cand
+            break
+    if doc is None:
+        return {}
+    verdict = tune_cache.validate_tuned(doc)
+    out: dict = {
+        "path": str(path),
+        "valid": verdict["ok"],
+        "records": [],
+    }
+    if verdict["problems"]:
+        out["problems"] = verdict["problems"]
+    for rec in doc.get("records", []):
+        if not isinstance(rec, dict):
+            continue
+        view: dict = {
+            "hardware": rec.get("hardware"),
+            "search_seconds": rec.get("search_seconds"),
+        }
+        kernel = {}
+        for sig, sr in (rec.get("kernel") or {}).items():
+            if not isinstance(sr, dict):
+                continue
+            kernel[sig] = {
+                "winner": sr.get("winner"),
+                "winner_step_us": sr.get("winner_step_us"),
+                "lax_step_us": sr.get("lax_step_us"),
+                "mfu_vs_measured_ceiling": sr.get(
+                    "winner_mfu_vs_measured_ceiling"
+                ),
+                "candidates": [
+                    {
+                        "candidate": row.get("candidate"),
+                        "step_us": row.get("step_us"),
+                        "ok": (row.get("numerics") or {}).get("ok"),
+                    }
+                    for row in (sr.get("candidates") or [])
+                    if isinstance(row, dict)
+                ],
+                "pruned": len(sr.get("pruned") or []),
+            }
+        if kernel:
+            view["kernel"] = kernel
+        ladders = {}
+        for name, lr in (rec.get("ladders") or {}).items():
+            if not isinstance(lr, dict):
+                continue
+            ladders[name] = {
+                "rungs": lr.get("rungs") or lr.get("edges"),
+                "padding_waste": lr.get("padding_waste"),
+                "pow2_padding_waste": lr.get("pow2_padding_waste"),
+                "samples": lr.get("samples"),
+            }
+        if ladders:
+            view["ladders"] = ladders
+        out["records"].append(view)
+    return out
+
+
 def load_postmortem(run_dir: Path) -> dict:
     """postmortem.json summary (crash flight recorder, obs/flight.py),
     validation verdict included — {} when the run never crashed."""
@@ -670,6 +797,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "scan": scan_section(load_scan_records(run_dir)),
         "fleet": fleet_section(run_dir, load_fleet_records(run_dir)),
         "efficiency": efficiency_section(run_dir, records),
+        "tuning": tuning_section(run_dir),
         "postmortem": load_postmortem(run_dir),
         "bench": bench_section(bench_root),
     }
@@ -766,6 +894,22 @@ def render_text(report: dict, out=sys.stdout) -> None:
         if counters:
             w("  " + " ".join(f"{k}={int(v)}" for k, v in counters.items())
               + "\n")
+        ladder = serve.get("ladder") or {}
+        if ladder:
+            lw = serve.get("ladder_waste")
+            lw_s = (
+                f" (overall waste {lw:.1%})"
+                if isinstance(lw, (int, float)) else ""
+            )
+            w(f"  ladder fill per rung (real vs padded rows){lw_s}:\n")
+            for rung, agg in ladder.items():
+                waste = agg.get("waste", 0.0)
+                w(
+                    f"    {rung:<12}{_bar(1.0 - waste, 20)} "
+                    f"real={int(agg.get('real_rows', 0))} "
+                    f"padded={int(agg.get('padded_rows', 0))} "
+                    f"waste={waste:.1%}\n"
+                )
 
     slo = report.get("slo") or {}
     if slo:
@@ -1008,6 +1152,61 @@ def render_text(report: dict, out=sys.stdout) -> None:
                     f"{_bar(v / peak, 20)} {v / 1e6:10.1f} MB\n"
                 )
 
+    tuning = report.get("tuning") or {}
+    if tuning:
+        w("\nautotuner (tuned.json, docs/tuning.md):\n")
+        w(
+            f"  {tuning.get('path')} valid={tuning.get('valid')}\n"
+        )
+        for rec in tuning.get("records") or []:
+            hw = rec.get("hardware") or {}
+            w(
+                f"  [{hw.get('device_kind')} x{hw.get('n_devices')} "
+                f"@ {hw.get('node_budget')}x{hw.get('edge_budget')} "
+                f"jax {hw.get('jax_version')}] search="
+                f"{rec.get('search_seconds')}s\n"
+            )
+            for sig, sr in (rec.get("kernel") or {}).items():
+                mfu = sr.get("mfu_vs_measured_ceiling")
+                mfu_s = (
+                    f" mfu={mfu}" if isinstance(mfu, (int, float))
+                    else ""
+                )
+                w(
+                    f"    kernel {sig}: winner {sr.get('winner')} "
+                    f"{sr.get('winner_step_us')}us (lax "
+                    f"{sr.get('lax_step_us')}us, "
+                    f"{sr.get('pruned')} pruned){mfu_s}\n"
+                )
+                cands = [
+                    c for c in sr.get("candidates") or []
+                    if isinstance(c.get("step_us"), (int, float))
+                ]
+                if cands:
+                    slowest = max(c["step_us"] for c in cands) or 1.0
+                    for c in sorted(
+                        cands, key=lambda c: c["step_us"]
+                    ):
+                        mark = "✗" if c.get("ok") is False else " "
+                        w(
+                            f"      {c['candidate']:<26}"
+                            f"{_bar(c['step_us'] / slowest, 20)} "
+                            f"{c['step_us']:9.2f}us{mark}\n"
+                        )
+            for name, lr in (rec.get("ladders") or {}).items():
+                # a damaged/hand-edited record may miss waste fields;
+                # the report must still render (next to valid=False)
+                before = lr.get("pow2_padding_waste")
+                after = lr.get("padding_waste")
+                fmt = lambda v: (  # noqa: E731
+                    f"{v:.1%}" if isinstance(v, (int, float)) else "?"
+                )
+                w(
+                    f"    ladder {name}: rungs={lr.get('rungs')} "
+                    f"waste {fmt(before)} (pow2) -> {fmt(after)} "
+                    f"(fitted) over {lr.get('samples')} samples\n"
+                )
+
     pm = report.get("postmortem") or {}
     if pm:
         w("\npostmortem (crash flight recorder):\n")
@@ -1198,6 +1397,20 @@ def build_smoke_run(run_dir: Path) -> Path:
             status, latency_ms / 1e3, frontend_s=1e-3, queue_s=2e-3,
             device_s=2e-3,
         )
+    # ladder-fill counters through the REAL executor emitter
+    # (serve/batcher.py:_observe_ladder_fill) — the pow2 blind spot the
+    # diag serving section renders: every chunk of 5 pads to the G8 rung
+    from deepdfa_tpu.obs import metrics as obs_metrics
+    from deepdfa_tpu.serve.batcher import _observe_ladder_fill
+
+    for _ in range(5):
+        _observe_ladder_fill("G8", 5, 8)
+    _observe_ladder_fill("G2", 2, 2)
+    ladder_snap = {
+        k[len("serve/"):]: v
+        for k, v in obs_metrics.REGISTRY.snapshot().items()
+        if k.startswith("serve/ladder")
+    }
     rlog.append({"serve_slo": engine.snapshot()})
     # cascade-mode entries through the SAME emitters (serve/cascade.py,
     # docs/cascade.md): stage-tagged requests, a cascade summary
@@ -1228,7 +1441,7 @@ def build_smoke_run(run_dir: Path) -> Path:
             },
         )
     rlog.append({
-        "serve": {"requests": 8.0},
+        "serve": {"requests": 8.0, **ladder_snap},
         "serve_slo": casc_engine.snapshot(),
         "cascade": {
             "requests": 8.0, "escalations": 2.0, "sheds": 0.0,
@@ -1339,6 +1552,22 @@ def build_smoke_run(run_dir: Path) -> Path:
         "tag": "step-00000004", "step": 4, "epoch": 1,
         "batch_index": 1, "reason": "preempt",
     }))
+    # a tuned.json through the REAL search emitters (deepdfa_tpu/tune/,
+    # docs/tuning.md): a minimal but genuine candidate search — two
+    # layouts compiled, timed, verdict-checked — plus the skewed-
+    # distribution ladder fits, persisted by the real cache writer;
+    # what the diag tuning section renders
+    from deepdfa_tpu.tune import driver as tune_driver
+    from deepdfa_tpu.tune import kernel as tune_kernel
+
+    tune_driver.run_tune_smoke(
+        out_path=run_dir / "tuned.json",
+        reps=1,
+        kernel_candidates=(
+            tune_kernel.Candidate(64, 128),
+            tune_kernel.Candidate(256, 512),
+        ),
+    )
     # a postmortem through the REAL flight recorder (obs/flight.py):
     # step + instant rings filled via the real note paths, dumped by the
     # real writer — what `diag --postmortem` and the postmortem section
@@ -1471,6 +1700,26 @@ def main(argv=None) -> int:
                 and pm.get("valid") is True
                 and pm.get("trigger") == "watchdog_abort"
                 and pm.get("steps") == 8  # ring bounded at max_steps
+                # ISSUE 15 sections: the serving ladder-fill view (the
+                # pow2 blind spot: 5-row chunks padding the G8 rung)
+                # and the autotuner view — real search, real winner,
+                # fitted ladder strictly beating pow2
+                and (report["serve"].get("ladder") or {}).get(
+                    "G8", {}
+                ).get("padded_rows") == 15.0
+                and report["serve"].get("ladder_waste") is not None
+                and (report.get("tuning") or {}).get("valid") is True
+                and any(
+                    sr.get("winner") and (
+                        ld.get("padding_waste")
+                        < ld.get("pow2_padding_waste")
+                    )
+                    for rec in report["tuning"]["records"]
+                    for sr in (rec.get("kernel") or {}).values()
+                    for ld in [
+                        (rec.get("ladders") or {}).get("serve") or {}
+                    ]
+                )
             )
             print(f"diag smoke {'OK' if ok else 'FAILED'}")
             return 0 if ok else 1

@@ -356,6 +356,17 @@ SCHEMA: tuple[str, ...] = (
     "ledger_mfu/*", "compile_seconds_total",
     "train_ledger_mfu/*", "train_compile_seconds_total",
     "obs_ledger_overhead_fraction",
+    # ledger-driven autotuner (deepdfa_tpu/tune/, docs/tuning.md):
+    # the serve executors' per-rung real/padded row counters + the
+    # process-wide waste gauge (the pow2 blind-spot made visible even
+    # with tuning off — rung labels are data-dependent, so a reviewed
+    # wildcard), and the bench child's stamps (bench.py --child-tune,
+    # gated in obs/bench_gate.py: tuned_ggnn_step_us +
+    # tuned_ladder_padding_waste lower-is-better, tune_search_seconds
+    # absolute-bounded)
+    "serve/ladder_waste", "serve/ladder_real_rows",
+    "serve/ladder_padded_rows", "serve/ladder/*",
+    "tune/*", "tune_*", "tuned_*",
 )
 
 

@@ -121,6 +121,42 @@ def _pow2_sizes(max_size: int) -> tuple[int, ...]:
     return tuple(sorted(set(sizes)))
 
 
+def _ladder_sizes(
+    ladder: Sequence[int] | None, capacity: int
+) -> tuple[int, ...]:
+    """Resolve an executor's warmup-ladder rungs: the tuned rung set
+    when one was provided (deepdfa_tpu/tune/, docs/tuning.md — clamped
+    to capacity, capacity always present so every legal chunk fits a
+    warmed rung), else the historical pow2 ladder."""
+    capacity = int(capacity)
+    if not ladder:
+        return _pow2_sizes(capacity)
+    rungs = sorted({int(s) for s in ladder if 1 <= int(s) <= capacity})
+    if not rungs or rungs[-1] != capacity:
+        rungs.append(capacity)
+    return tuple(rungs)
+
+
+def _observe_ladder_fill(label: str, used: int, capacity: int) -> None:
+    """The ladder blind-spot gauge (docs/tuning.md): per-rung real vs
+    padded row counters plus the process-wide `serve/ladder_waste`
+    fraction, emitted on EVERY executed batch so a request stream whose
+    sizes all land just above a rung (padding ~2x forever) is visible
+    even with tuning off."""
+    r = obs_metrics.REGISTRY
+    pad = max(0, int(capacity) - int(used))
+    r.counter(f"serve/ladder/{label}/real_rows").inc(used)
+    if pad:
+        r.counter(f"serve/ladder/{label}/padded_rows").inc(pad)
+    real_c = r.counter("serve/ladder_real_rows")
+    pad_c = r.counter("serve/ladder_padded_rows")
+    real_c.inc(used)
+    pad_c.inc(pad)
+    total = real_c.value + pad_c.value
+    if total:
+        r.gauge("serve/ladder_waste").set(pad_c.value / total)
+
+
 class GgnnExecutor:
     """Per-signature AOT executables for the flagship GGNN scorer.
 
@@ -143,20 +179,27 @@ class GgnnExecutor:
         etypes: bool = False,
         params_transform: Callable[[Any], Any] | None = None,
         mesh=None,
+        ladder: Sequence[int] | None = None,
     ):
         """mesh: an optional serve mesh (parallel/sharding.py,
         docs/sharding.md) — batches replicate over it and params arrive
         from `params_fn` already committed under the registry's resolved
         sharding map, so the AOT ladder compiles GSPMD-partitioned
         programs with the same signatures (zero-recompile contract
-        unchanged). None = the historical single-device placement."""
+        unchanged). None = the historical single-device placement.
+
+        ladder: explicit warmup rungs replacing the pow2 default — the
+        tuned layout (deepdfa_tpu/tune/, docs/tuning.md) fitted to the
+        observed chunk-size distribution; the zero-recompile contract
+        is unchanged (warmup compiles every rung, `_size_for` only ever
+        picks warmed ones)."""
         import jax
 
         self.model = model
         self.params_fn = params_fn
         self.node_budget = int(node_budget)
         self.edge_budget = int(edge_budget)
-        self.sizes = _pow2_sizes(int(max_batch_graphs))
+        self.sizes = _ladder_sizes(ladder, int(max_batch_graphs))
         self.etypes = bool(etypes)
         self.mesh = mesh
         self._batch_sharding = None
@@ -282,6 +325,7 @@ class GgnnExecutor:
 
         t0 = time.perf_counter()
         size = self._size_for(len(chunk))
+        _observe_ladder_fill(f"G{size}", len(chunk), size)
         batch = pack(
             list(chunk), size, self.node_budget, self.edge_budget,
             feat_width=self.feat_width, etypes=self.etypes,
@@ -497,6 +541,10 @@ class CombinedExecutor:
         import jax
 
         t0 = time.perf_counter()
+        _observe_ladder_fill(
+            self.ledger_signature(key, len(chunk)), len(chunk),
+            self._rows[int(key)],
+        )
         batch = self._place(self._collate(int(key), chunk))
         fn = self._compiled.get(int(key), self._score_jit)
         probs = fn(self.params_fn(), batch)

@@ -184,10 +184,16 @@ class CombinedFrontend:
 
 
 def build_combined_service_parts(
-    registry, cfg, node_budget: int, edge_budget: int
+    registry, cfg, node_budget: int, edge_budget: int,
+    seq_buckets=None,
 ):
     """(frontend, executor) for a combined/t5 registry — the
-    family-dispatch half of ScoringService.__init__."""
+    family-dispatch half of ScoringService.__init__.
+
+    seq_buckets: explicit bucket edges replacing cfg.data.seq_buckets —
+    the tuned layout (deepdfa_tpu/tune/, docs/tuning.md) fitted to the
+    observed token-length distribution; passed by ScoringService so the
+    registry's config digest (hot-swap admission) never sees it."""
     from deepdfa_tpu.serve import frontend as serve_frontend
     from deepdfa_tpu.serve.batcher import CombinedExecutor
     from deepdfa_tpu.serve.frontend import RequestPreprocessor
@@ -203,9 +209,21 @@ def build_combined_service_parts(
             f"(train-combined writes one) in {registry.run_dir}"
         )
     max_length = int(registry.serve_max_length or 0)
-    buckets = tuple(int(b) for b in cfg.data.seq_buckets) or (
-        (max_length,) if max_length else ()
-    )
+    if seq_buckets and max_length:
+        # a tuned edge set must fit THIS registry's encoder capacity:
+        # edges past max_length would warm programs beyond the
+        # positional table the checkpoint was trained at (the tuned
+        # record may have been fitted against a longer config), and the
+        # top edge must still hold a full-length row — drop the
+        # overflow and keep the capacity as the top edge (the
+        # data.seq_buckets CLI contract; tuned edges refine only the
+        # interior)
+        seq_buckets = tuple(
+            int(b) for b in seq_buckets if int(b) < max_length
+        ) + (max_length,)
+    buckets = tuple(
+        int(b) for b in (seq_buckets or cfg.data.seq_buckets)
+    ) or ((max_length,) if max_length else ())
     graph_fe = None
     if getattr(mcfg, "use_graph", False):
         graph_fe = RequestPreprocessor(

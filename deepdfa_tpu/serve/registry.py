@@ -50,14 +50,42 @@ class RegistryError(RuntimeError):
     """Registry-level restore failure with an operator-grade message."""
 
 
+#: model knobs excluded from the digest: pure compiled-program LOWERING
+#: choices (how the fused GGNN step tiles/scatters/accumulates), never
+#: parameter shapes or feature semantics — a tuned layout
+#: (deepdfa_tpu/tune/, docs/tuning.md) applied at serve time must not
+#: refuse hot swaps against the run's untuned saved config. scatter and
+#: accum move scores only within their documented numerics tolerances
+#: (docs/ggnn_kernel.md) — shape/feature compatibility, the digest's
+#: scope, is untouched.
+_LAYOUT_ONLY_MODEL_KEYS = (
+    "ggnn_kernel_block_nodes", "ggnn_kernel_block_edges",
+    "ggnn_kernel_scatter", "ggnn_kernel_accum",
+)
+
+#: data knobs equally excluded: sequence-bucket edges shape PADDING
+#: layout (which batch signatures compile), never tokenization or
+#: feature semantics — a re-train that picked up tuned interior edges
+#: must not refuse hot swaps against servers started on the old config
+_LAYOUT_ONLY_DATA_KEYS = ("seq_buckets",)
+
+
 def config_digest(cfg: Config) -> str:
     """Digest of the config sections that determine parameter shapes and
     feature semantics (model + data). Two runs with equal digests produce
     checkpoints that are shape-compatible AND feature-compatible, which
     is the hot-swap admission criterion."""
     d = config_mod._to_dict(cfg)
+    model = {
+        k: v for k, v in d["model"].items()
+        if k not in _LAYOUT_ONLY_MODEL_KEYS
+    }
+    data = {
+        k: v for k, v in d["data"].items()
+        if k not in _LAYOUT_ONLY_DATA_KEYS
+    }
     payload = json.dumps(
-        {"model": d["model"], "data": d["data"]}, sort_keys=True
+        {"model": model, "data": data}, sort_keys=True
     )
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 

@@ -107,6 +107,37 @@ class ScoringService:
         # are registry-committed under the sharding map; the executors
         # replicate batches over the same mesh
         mesh = getattr(registry, "mesh", None)
+        # tuned layouts (deepdfa_tpu/tune/, docs/tuning.md): with
+        # tune.enabled the executors consult tuned.json AT WARMUP —
+        # serve ladder rungs and seq-bucket edges fitted to the
+        # observed distribution replace the pow2 defaults when (and
+        # only when) a record matches this hardware generation; any
+        # mismatch falls back loudly inside record_for_config. Never
+        # touched on the request path.
+        self.tuned: dict | None = None
+        tuned_rungs = None
+        tuned_buckets = None
+        tcfg = getattr(cfg, "tune", None)
+        if tcfg is not None and getattr(tcfg, "enabled", False):
+            from deepdfa_tpu.tune import cache as tune_cache
+
+            rec = tune_cache.record_for_config(
+                cfg, node_budget, edge_budget
+            )
+            if rec is not None:
+                tuned_rungs = tune_cache.serve_rungs_from(
+                    rec, scfg.max_batch_graphs
+                )
+                tuned_buckets = tune_cache.seq_edges_from(rec)
+                self.tuned = {
+                    "hardware": rec.get("hardware"),
+                    "serve_rungs": (
+                        list(tuned_rungs) if tuned_rungs else None
+                    ),
+                    "seq_buckets": (
+                        list(tuned_buckets) if tuned_buckets else None
+                    ),
+                }
         self.localizer = None
         if registry.family == "deepdfa":
             # the ONE process-wide content-keyed feature store: a repo
@@ -127,6 +158,7 @@ class ScoringService:
                 etypes=cfg.model.n_etypes > 1,
                 params_transform=params_transform,
                 mesh=mesh,
+                ladder=tuned_rungs,
             )
             # line-level localization (serve.lines): the attribution
             # program AOT-compiled over the SAME warmup ladder, so
@@ -151,7 +183,8 @@ class ScoringService:
 
             self.frontend, self.executor = (
                 cascade_mod.build_combined_service_parts(
-                    registry, cfg, node_budget, edge_budget
+                    registry, cfg, node_budget, edge_budget,
+                    seq_buckets=tuned_buckets,
                 )
             )
         # cascade mode (serve.cascade, docs/cascade.md): the stage-2
@@ -348,6 +381,10 @@ class ScoringService:
                 )
         if self.localizer is not None:
             info["lines_method"] = self.localizer.method
+        if self.tuned is not None:
+            # which tuned layout is serving (docs/tuning.md): operators
+            # need to know before reading the ladder-waste gauge
+            info["tuned"] = self.tuned
         if self.cascade is not None:
             info["cascade"] = self.cascade.info()
         if deep:

@@ -1,0 +1,47 @@
+"""Ledger-driven autotuner (docs/tuning.md, ROADMAP item 3).
+
+The PR-10 efficiency ledger measures per-signature FLOP/s and roofline
+position but nothing consumed it: every tile size in `nn/ggnn_kernel.py`,
+every pow2 rung in the serve warmup ladders, and every
+`data.seq_buckets` edge was hand-picked. This package closes the loop
+with MEASURED search:
+
+- `tune.kernel`   — enumerate legal (block_n, block_e, scatter, accum)
+  kernel candidates per GGNN signature (divisibility + VMEM bound
+  pruned BEFORE compile), compile-and-time each through the existing
+  AOT path, assert the PR-8 numerics contract on every candidate, and
+  pick by measured step time.
+- `tune.ladder`   — fit serve warmup-ladder rungs and seq-bucket edges
+  to the OBSERVED size distribution (replayed from serve/fleet logs or
+  a training manifest), minimizing expected padded compute under a
+  rung-count / compile-seconds budget, instead of blind pow2.
+- `tune.cache`    — persist winning layouts in a schema-validated
+  `tuned.json` keyed by hardware generation; consumers fall back to
+  defaults LOUDLY on any mismatch.
+- `tune.driver`   — the `deepdfa-tpu tune` CLI orchestration + the
+  tier-1 `--smoke` acceptance drive.
+
+Everything is default OFF (`cfg.tune.enabled`): the default path is
+byte-identical and tuning only ever runs offline, never in the request
+path.
+"""
+
+from deepdfa_tpu.tune.cache import (  # noqa: F401
+    hardware_key,
+    load_tuned,
+    record_for_config,
+    save_tuned,
+    validate_tuned,
+)
+from deepdfa_tpu.tune.kernel import (  # noqa: F401
+    Candidate,
+    enumerate_candidates,
+    numerics_verdict,
+    search_kernel,
+)
+from deepdfa_tpu.tune.ladder import (  # noqa: F401
+    fit_rungs,
+    fit_serve_ladder,
+    fit_seq_buckets,
+    padding_waste,
+)

@@ -1,0 +1,552 @@
+"""tuned.json: persisted winning layouts, keyed by hardware generation
+(docs/tuning.md).
+
+One schema-validated document holds one record per hardware generation —
+the key is {device_kind, platform, n_devices, jax_version, node_budget,
+edge_budget}: a layout measured on a v5e at the flagship budgets says
+nothing about a v4 or about the smoke budgets, so a consumer only ever
+uses a record whose key matches its own hardware EXACTLY and falls back
+to the hand-picked defaults LOUDLY otherwise (a warning naming every
+mismatched field — never a silent wrong layout).
+
+Consumers (all behind `cfg.tune.enabled`, default OFF):
+  - `GatedGraphConv` block sizes via `model.ggnn_kernel_block_*`
+    (`apply_to_config` — the CLI entry points call it once at startup);
+  - the serve executors' warmup ladder (`serve_rungs_for` —
+    ScoringService consults it at construction, before warmup);
+  - `data.seq_buckets` for the text plan + CombinedExecutor
+    (`seq_edges_for` / `apply_to_config`).
+
+The committed TUNED_r*.json trajectory is the same document shape;
+`validate_tuned` is the one validator (`check_obs_schema.py --tuned`)
+and `obs/bench_gate.py:gate_tuned` gates a round against it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+#: bump when the document shape changes
+TUNED_VERSION = 1
+
+#: every field a record's hardware key must carry; exact equality on
+#: ALL of them is the match criterion
+REQUIRED_HW_FIELDS = (
+    "device_kind", "platform", "n_devices", "jax_version",
+    "node_budget", "edge_budget",
+)
+
+
+def hardware_key(node_budget: int, edge_budget: int) -> dict:
+    """The hardware-generation key for THIS process: device kind +
+    platform + topology (visible device count) + jax version + the
+    feature budgets the layouts were measured at."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "device_kind": str(getattr(dev, "device_kind", dev.platform)),
+        "platform": str(dev.platform),
+        "n_devices": int(jax.device_count()),
+        "jax_version": str(jax.__version__),
+        "node_budget": int(node_budget),
+        "edge_budget": int(edge_budget),
+    }
+
+
+def empty_doc() -> dict:
+    return {"version": TUNED_VERSION, "records": []}
+
+
+def load_tuned(path: str | Path) -> dict | None:
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning("tuned.json at %s unreadable (%s)", path, e)
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def save_tuned(path: str | Path, doc: dict) -> Path:
+    from deepdfa_tpu.core.ioutil import atomic_write_text
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    atomic_write_text(path, json.dumps(doc, indent=1))
+    return path
+
+
+def hw_mismatch(record_hw: dict, hw: dict) -> list[str]:
+    """Mismatched-field names between a record's hardware key and ours
+    ([] = exact match); missing fields count as mismatches."""
+    out = []
+    for f in REQUIRED_HW_FIELDS:
+        if record_hw.get(f) != hw.get(f):
+            out.append(
+                f"{f}: record={record_hw.get(f)!r} vs ours={hw.get(f)!r}"
+            )
+    return out
+
+
+def find_record(doc: dict, hw: dict) -> dict | None:
+    """The newest record whose hardware key matches exactly."""
+    best = None
+    for rec in doc.get("records", []):
+        if not isinstance(rec, dict):
+            continue
+        if not hw_mismatch(rec.get("hardware") or {}, hw):
+            best = rec
+    return best
+
+
+def upsert_record(doc: dict, record: dict) -> dict:
+    """Replace the record with the same hardware key (or append)."""
+    hw = record.get("hardware") or {}
+    records = [
+        r for r in doc.get("records", [])
+        if hw_mismatch((r.get("hardware") or {}), hw)
+    ]
+    records.append(record)
+    return {"version": TUNED_VERSION, "records": records}
+
+
+def make_record(
+    hardware: dict,
+    kernel: dict | None = None,
+    ladders: dict | None = None,
+    search_seconds: float = 0.0,
+) -> dict:
+    rec: dict = {
+        "hardware": dict(hardware),
+        "created_unix": round(time.time(), 3),
+        "search_seconds": round(float(search_seconds), 3),
+    }
+    if kernel:
+        rec["kernel"] = kernel
+    if ladders:
+        rec["ladders"] = ladders
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# validation (check_obs_schema.py --tuned; the TUNED_r* gate's precheck)
+
+
+def _ascending(xs) -> bool:
+    xs = list(xs)
+    return all(
+        isinstance(x, int) and not isinstance(x, bool) for x in xs
+    ) and xs == sorted(set(xs))
+
+
+def validate_tuned(doc: Any) -> dict:
+    """Structural validation of a tuned.json / TUNED_r*.json document:
+    hardware key complete, every candidate row carries its
+    numerics-contract verdict, a winner present per signature, ladder
+    records well-formed with their pow2 baseline on the record."""
+    problems: list[str] = []
+    n_signatures = 0
+    n_candidates = 0
+    if isinstance(doc, dict) and "tuned" in doc and "records" not in doc:
+        doc = doc["tuned"]  # tolerate a wrapped driver artifact
+    if not isinstance(doc, dict):
+        return {"ok": False, "problems": ["document is not an object"]}
+    if doc.get("version") != TUNED_VERSION:
+        problems.append(
+            f"version {doc.get('version')!r} != {TUNED_VERSION}"
+        )
+    records = doc.get("records")
+    if not isinstance(records, list) or not records:
+        problems.append("no records")
+        records = []
+    for ri, rec in enumerate(records):
+        where = f"records[{ri}]"
+        if not isinstance(rec, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        hw = rec.get("hardware")
+        if not isinstance(hw, dict):
+            problems.append(f"{where}: missing hardware key")
+        else:
+            for f in REQUIRED_HW_FIELDS:
+                if hw.get(f) in (None, ""):
+                    problems.append(
+                        f"{where}: hardware key incomplete — "
+                        f"missing {f}"
+                    )
+        if not isinstance(
+            rec.get("search_seconds"), (int, float)
+        ) or isinstance(rec.get("search_seconds"), bool):
+            problems.append(f"{where}: missing search_seconds")
+        kernel = rec.get("kernel")
+        if kernel is not None:
+            if not isinstance(kernel, dict):
+                problems.append(f"{where}: kernel is not an object")
+                kernel = {}
+            for sig, sr in kernel.items():
+                n_signatures += 1
+                sw = f"{where}.kernel[{sig}]"
+                if not isinstance(sr, dict):
+                    problems.append(f"{sw}: not an object")
+                    continue
+                cands = sr.get("candidates")
+                if not isinstance(cands, list) or not cands:
+                    problems.append(f"{sw}: no candidate rows")
+                    cands = []
+                labels = set()
+                for ci, row in enumerate(cands):
+                    if not isinstance(row, dict):
+                        problems.append(
+                            f"{sw}.candidates[{ci}]: not an object"
+                        )
+                        continue
+                    n_candidates += 1
+                    labels.add(row.get("candidate"))
+                    if "skipped" in row or "error" in row:
+                        continue  # never timed: no verdict to carry
+                    verdict = row.get("numerics")
+                    if not isinstance(verdict, dict) or not isinstance(
+                        verdict.get("ok"), bool
+                    ):
+                        problems.append(
+                            f"{sw}.candidates[{ci}]"
+                            f"[{row.get('candidate')}]: missing "
+                            f"numerics-contract verdict"
+                        )
+                winner = sr.get("winner")
+                if winner is None:
+                    problems.append(f"{sw}: no winner")
+                elif winner not in labels:
+                    problems.append(
+                        f"{sw}: winner {winner!r} is not a recorded "
+                        f"candidate"
+                    )
+        ladders = rec.get("ladders")
+        if ladders is not None:
+            if not isinstance(ladders, dict):
+                problems.append(f"{where}: ladders is not an object")
+                ladders = {}
+            for name, lr in ladders.items():
+                lw = f"{where}.ladders[{name}]"
+                if not isinstance(lr, dict):
+                    problems.append(f"{lw}: not an object")
+                    continue
+                rungs = lr.get("rungs") or lr.get("edges")
+                if not rungs or not _ascending(rungs):
+                    problems.append(
+                        f"{lw}: rungs/edges missing or not ascending "
+                        f"unique ints"
+                    )
+                for f in ("padding_waste", "pow2_padding_waste"):
+                    v = lr.get(f)
+                    if not isinstance(v, (int, float)) or isinstance(
+                        v, bool
+                    ):
+                        problems.append(f"{lw}: missing {f}")
+        if kernel is None and ladders is None:
+            problems.append(f"{where}: neither kernel nor ladders")
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "records": len(records),
+        "signatures": n_signatures,
+        "candidates": n_candidates,
+    }
+
+
+def validate_tuned_file(path: str | Path) -> dict:
+    try:
+        doc = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return {"ok": False, "problems": [f"unreadable: {e}"]}
+    out = validate_tuned(doc)
+    out["path"] = str(path)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# config-facing consumers (everything behind cfg.tune.enabled)
+
+
+def tuned_path(cfg) -> Path:
+    """Where tuned.json lives: cfg.tune.path, else
+    <storage>/tuned.json (next to runs/ and cache/)."""
+    p = getattr(getattr(cfg, "tune", None), "path", None)
+    if p:
+        return Path(p)
+    from deepdfa_tpu.core import paths
+
+    return paths.storage_root() / "tuned.json"
+
+
+#: memo for record_for_config, keyed by (path, file mtime, hardware
+#: key): serve-side startup resolves the record twice (the CLI's
+#: _apply_tuned for kernel blocks, then ScoringService for the ladder)
+#: — one read, one loud warning, not two of each
+_RECORD_MEMO: dict[tuple, dict | None] = {}
+
+
+def record_for_config(cfg, node_budget: int, edge_budget: int) -> dict | None:
+    """The matching tuned record for this process's hardware key, or
+    None — with the LOUD fallback the contract requires: a missing
+    file, unreadable document, or key mismatch each names itself."""
+    path = tuned_path(cfg)
+    try:
+        mtime = path.stat().st_mtime_ns
+    except OSError:
+        mtime = None
+    memo_key = (str(path), mtime, int(node_budget), int(edge_budget))
+    if memo_key in _RECORD_MEMO:
+        return _RECORD_MEMO[memo_key]
+    rec = _record_for_config_uncached(path, node_budget, edge_budget)
+    if len(_RECORD_MEMO) > 16:
+        _RECORD_MEMO.clear()
+    _RECORD_MEMO[memo_key] = rec
+    return rec
+
+
+def _record_for_config_uncached(
+    path: Path, node_budget: int, edge_budget: int
+) -> dict | None:
+    doc = load_tuned(path)
+    if doc is None:
+        logger.warning(
+            "tune.enabled but no usable tuned.json at %s — serving the "
+            "hand-picked default layouts (run `deepdfa-tpu tune`)", path,
+        )
+        return None
+    hw = hardware_key(node_budget, edge_budget)
+    rec = find_record(doc, hw)
+    if rec is None:
+        nearest = (doc.get("records") or [{}])[-1]
+        if not isinstance(nearest, dict):
+            # a hand-edited/corrupt records list must still fall back
+            # loudly, never crash the server at warmup
+            nearest = {}
+        logger.warning(
+            "tune.enabled but no tuned record matches this hardware "
+            "generation — falling back to default layouts. ours=%s; "
+            "nearest record mismatches: %s",
+            hw,
+            hw_mismatch((nearest.get("hardware") or {}), hw)
+            or ["<no records>"],
+        )
+    return rec
+
+
+def serve_rungs_from(record: dict | None, capacity: int) -> tuple[int, ...] | None:
+    """The tuned serve warmup-ladder rungs, normalized for the
+    configured capacity. ONE implementation of the clamp-and-force-
+    capacity invariant: `serve/batcher.py:_ladder_sizes` (the executor
+    applies it again idempotently on construction).
+
+    The fit is only meaningful AT the capacity it was measured for: a
+    ladder fitted at max_batch_graphs=32 clamped down to capacity 4
+    would lose the small rungs the pow2 default keeps (a lone request
+    padding 3-4x forever — strictly WORSE than no tuning). A capacity
+    drift therefore falls back to the default ladder, loudly."""
+    if not record:
+        return None
+    lr = (record.get("ladders") or {}).get("serve")
+    if not isinstance(lr, dict) or not lr.get("rungs"):
+        return None
+    fitted_cap = lr.get("capacity", max(int(r) for r in lr["rungs"]))
+    if int(fitted_cap) != int(capacity):
+        logger.warning(
+            "tuned serve ladder was fitted at capacity %s but "
+            "serve.max_batch_graphs=%s — falling back to the pow2 "
+            "default ladder (re-run `deepdfa-tpu tune` at this "
+            "capacity)", fitted_cap, capacity,
+        )
+        return None
+    from deepdfa_tpu.serve.batcher import _ladder_sizes
+
+    return _ladder_sizes(lr["rungs"], int(capacity))
+
+
+def seq_edges_from(record: dict | None) -> tuple[int, ...] | None:
+    """The tuned data.seq_buckets edges, if the record fit them."""
+    if not record:
+        return None
+    lr = (record.get("ladders") or {}).get("seq_buckets")
+    if not isinstance(lr, dict) or not lr.get("edges"):
+        return None
+    return tuple(int(e) for e in lr["edges"])
+
+
+def ggnn_feature_width(model_cfg) -> int:
+    """The GGNN feature width d the kernel signatures key on: the
+    embedded node-feature width `GatedGraphConv` actually tiles.
+
+    Derived from the ONE model-side width source (`DeepDFA.out_dim` =
+    the [ggnn_out, feat_embed] concat = exactly twice the embedding
+    width the conv sees) instead of re-implementing the multiplier
+    arithmetic — a future embedding-width change cannot desync the
+    signatures the tuner keys on from the shapes the model compiles."""
+    from deepdfa_tpu.models import DeepDFA
+
+    # input_dim only sizes the vocab tables, never the feature width
+    return DeepDFA.from_config(model_cfg, input_dim=1).out_dim // 2
+
+
+def kernel_layout_from(
+    record: dict | None, n: int, e: int, d: int
+) -> dict | None:
+    """The WHOLE winning layout for one signature — blocks AND
+    scatter/accum, or None (an absent signature is a defaults case).
+    The search timed the four axes jointly (Morphling-style variant
+    selection), so a consumer must apply all of them together: blocks
+    from a fold winner under an auto-resolved mxu scatter would be a
+    layout nobody ever measured."""
+    if not record:
+        return None
+    sr = (record.get("kernel") or {}).get(f"{n}x{e}x{d}")
+    if not isinstance(sr, dict) or not sr.get("winner"):
+        return None
+    bn, be = sr.get("winner_block_n"), sr.get("winner_block_e")
+    if not isinstance(bn, int) or not isinstance(be, int):
+        return None
+    out = {"block_n": int(bn), "block_e": int(be)}
+    if isinstance(sr.get("winner_scatter"), str):
+        out["scatter"] = sr["winner_scatter"]
+    if isinstance(sr.get("winner_accum"), str):
+        out["accum"] = sr["winner_accum"]
+    return out
+
+
+def apply_to_config(
+    cfg,
+    sections=("kernel", "seq_buckets"),
+    node_budget: int | None = None,
+    edge_budget: int | None = None,
+):
+    """(cfg', report): fold the matching tuned record's layout into a
+    config — the kernel block sizes (model.ggnn_kernel_block_*, a
+    layout-only knob excluded from the registry config digest) and,
+    when "seq_buckets" is in `sections`, the fitted data.seq_buckets
+    edges. Serve-side callers pass sections=("kernel",): their bucket
+    edges flow through ScoringService instead, so the registry's
+    config digest (hot-swap admission) never sees a tuned data
+    section. No-op (loudly, via `record_for_config`) when nothing
+    matches; callers gate on cfg.tune.enabled."""
+    from deepdfa_tpu.core import config as config_mod
+
+    if node_budget is None:
+        node_budget = cfg.data.batch.node_budget
+    if edge_budget is None:
+        edge_budget = cfg.data.batch.edge_budget
+    rec = record_for_config(cfg, node_budget, edge_budget)
+    report: dict = {"matched": rec is not None, "overrides": []}
+    if rec is None:
+        return cfg, report
+    overrides: list[str] = []
+    if "kernel" in sections:
+        d = ggnn_feature_width(cfg.model)
+        layout = kernel_layout_from(rec, node_budget, edge_budget, d)
+        if layout is not None:
+            overrides += [
+                f"model.ggnn_kernel_block_nodes={layout['block_n']}",
+                f"model.ggnn_kernel_block_edges={layout['block_e']}",
+            ]
+            # the winner was measured as a JOINT layout: its scatter
+            # and accum ride along (both digest-excluded lowering
+            # knobs; numerics stay within the per-mode tolerances the
+            # search asserted)
+            if "scatter" in layout:
+                overrides.append(
+                    "model.ggnn_kernel_scatter="
+                    + json.dumps(layout["scatter"])
+                )
+            if "accum" in layout:
+                overrides.append(
+                    "model.ggnn_kernel_accum="
+                    + json.dumps(layout["accum"])
+                )
+    if "seq_buckets" in sections:
+        edges = seq_edges_from(rec)
+        if edges is not None and cfg.data.seq_buckets:
+            # the max_length drift guard (the serve_rungs_from
+            # capacity rule's train-side twin): a fit anchored at a
+            # different top edge would silently truncate training
+            # sequences to the stale capacity
+            fit_max = (
+                (rec.get("ladders") or {}).get("seq_buckets") or {}
+            ).get("max_length", edges[-1])
+            want_max = int(cfg.data.seq_buckets[-1])
+            if int(fit_max) != want_max:
+                logger.warning(
+                    "tuned seq buckets were fitted at max_length %s "
+                    "but data.seq_buckets tops at %s — keeping the "
+                    "configured edges (re-run `deepdfa-tpu tune` with "
+                    "a manifest at this length)", fit_max, want_max,
+                )
+                edges = None
+        elif edges is not None:
+            # no configured buckets to anchor the top edge: applying a
+            # fitted set would silently flip bucketing on at a guessed
+            # capacity — defaults win, loudly
+            logger.warning(
+                "tuned seq buckets present but data.seq_buckets is "
+                "unset — not applying (set data.seq_buckets to anchor "
+                "the max edge)"
+            )
+            edges = None
+        if edges is not None:
+            overrides.append(
+                "data.seq_buckets="
+                + json.dumps([int(x) for x in edges])
+            )
+    if overrides:
+        cfg = config_mod.apply_overrides(cfg, overrides)
+        logger.info("tuned layout applied: %s", overrides)
+    report["overrides"] = overrides
+    return cfg, report
+
+
+# ---------------------------------------------------------------------------
+# the committed TUNED_r* trajectory
+
+
+def load_tuned_trajectory(root: str | Path) -> list[dict]:
+    """Every committed TUNED_r*.json, oldest round first — the same
+    entry shape the BENCH_r*/MULTICHIP_r* loaders return:
+    [{"source", "round", "record"|None, "note"|None}] where `record`
+    is the tuned document itself."""
+    import re
+
+    root = Path(root)
+    out: list[dict] = []
+    for path in sorted(root.glob("TUNED_r*.json")):
+        m = re.search(r"TUNED_r(\d+)", path.name)
+        entry: dict = {
+            "source": path.name,
+            "round": int(m.group(1)) if m else None,
+        }
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            entry["note"] = f"unreadable: {e}"
+            entry["record"] = None
+            out.append(entry)
+            continue
+        if isinstance(doc, dict) and "tuned" in doc and (
+            "records" not in doc
+        ):
+            doc = doc["tuned"]
+        if not isinstance(doc, dict) or not doc.get("records"):
+            entry["note"] = "no tuned records"
+            entry["record"] = None
+        else:
+            entry["record"] = doc
+        out.append(entry)
+    out.sort(key=lambda e: (e.get("round") or 0, e["source"]))
+    return out

@@ -1,0 +1,284 @@
+"""`deepdfa-tpu tune` orchestration: one offline search pass writes one
+hardware-keyed tuned.json record (docs/tuning.md).
+
+Never in the request path: tuning is an OFFLINE command — serving only
+ever reads the persisted record at warmup (cfg.tune.enabled), so a
+search can run on a scratch box against replayed logs while production
+keeps serving the previous layout.
+
+`run_tune_smoke` is the tier-1 acceptance drive (CPU, reduced candidate
+set, synthetic skewed distributions): a REAL search end to end — kernel
+candidates compiled and timed under the numerics contract, ladder +
+seq-bucket fits that must beat the pow2 baseline, a schema-valid
+tuned.json on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+
+import numpy as np
+
+from deepdfa_tpu.tune import cache as tune_cache
+from deepdfa_tpu.tune import kernel as tune_kernel
+from deepdfa_tpu.tune import ladder as tune_ladder
+
+logger = logging.getLogger(__name__)
+
+#: the smoke's reduced search space: tiny budgets (d=32 relaxes the
+#: lane rule under the interpreter), a handful of candidates bracketing
+#: the auto-picked blocks, fold + one mxu row so both scatter modes
+#: carry verdicts
+SMOKE_BUDGETS = (256, 512, 32)
+SMOKE_CANDIDATES = (
+    tune_kernel.Candidate(64, 128),
+    tune_kernel.Candidate(64, 512),
+    tune_kernel.Candidate(256, 128),
+    tune_kernel.Candidate(256, 512),
+    tune_kernel.Candidate(256, 512, "mxu"),
+)
+
+
+def _measure_ceiling_flops(smoke: bool) -> float:
+    """The measured matmul ceiling the winner's MFU is read against
+    (docs/roofline.md); 0.0 when the probe fails — MFU fields are then
+    simply absent, never wrong."""
+    try:
+        from deepdfa_tpu.eval.profiling import measure_matmul_ceiling
+
+        m = measure_matmul_ceiling(
+            n=256 if smoke else 1024, chain=2, reps=1
+        )
+        return float(m["matmul_tflops_measured"]) * 1e12
+    except Exception as e:  # the probe must never cost the search
+        logger.warning("matmul ceiling probe failed: %s", e)
+        return 0.0
+
+
+def skewed_smoke_sizes(seed: int = 0) -> list[int]:
+    """The pow2 blind-spot distribution: almost every observed chunk
+    lands just ABOVE a pow2 rung (5 over 4, 9 over 8, 3 over 2), so the
+    baseline ladder pads ~1.6x while a fitted ladder lands exact."""
+    sizes = [5] * 40 + [9] * 25 + [3] * 10 + [16] * 5
+    rng = np.random.default_rng(seed)
+    rng.shuffle(sizes)
+    return sizes
+
+
+def lognormal_smoke_lengths(
+    n: int = 400, max_length: int = 64, seed: int = 0
+) -> list[int]:
+    """Big-Vul-shaped token lengths (lognormal, docs/input_pipeline.md)
+    clipped to the smoke encoder capacity."""
+    rng = np.random.default_rng(seed)
+    draws = rng.lognormal(mean=2.8, sigma=0.6, size=n)
+    return [int(min(max(x, 2), max_length)) for x in draws]
+
+
+def run_tune_smoke(
+    out_path: str | Path | None = None,
+    reps: int = 2,
+    n_steps: int = 2,
+    kernel_candidates=SMOKE_CANDIDATES,
+    seed: int = 0,
+) -> dict:
+    """The tier-1 search: reduced candidates, synthetic distributions,
+    real compiles/timings/verdicts, schema-valid tuned.json out."""
+    from deepdfa_tpu.core import paths
+
+    t0 = time.perf_counter()
+    n, e, d = SMOKE_BUDGETS
+    ceiling = _measure_ceiling_flops(smoke=True)
+    kernel = tune_kernel.search_kernel(
+        [(n, e, d)],
+        n_steps=n_steps,
+        candidates=list(kernel_candidates),
+        reps=reps,
+        ceiling_flops_per_sec=ceiling,
+    )
+    serve_fit = tune_ladder.fit_serve_ladder(
+        skewed_smoke_sizes(seed), capacity=16, max_rungs=4
+    )
+    seq_fit = tune_ladder.fit_seq_buckets(
+        lognormal_smoke_lengths(seed=seed), max_length=64, max_edges=4
+    )
+    search_seconds = time.perf_counter() - t0
+    record = tune_cache.make_record(
+        tune_cache.hardware_key(n, e),
+        kernel=kernel,
+        ladders={"serve": serve_fit, "seq_buckets": seq_fit},
+        search_seconds=search_seconds,
+    )
+    path = (
+        Path(out_path) if out_path
+        else paths.storage_root() / "tuned.json"
+    )
+    doc = tune_cache.load_tuned(path) or tune_cache.empty_doc()
+    doc = tune_cache.upsert_record(doc, record)
+    tune_cache.save_tuned(path, doc)
+    # the smoke's verdict judges ITS OWN record (the run_tune rule: a
+    # damaged unrelated legacy record in the same file is not this
+    # search's failure)
+    verdict = tune_cache.validate_tuned(
+        {"version": tune_cache.TUNED_VERSION, "records": [record]}
+    )
+    sig = f"{n}x{e}x{d}"
+    srec = kernel[sig]
+    return {
+        "tuned_path": str(path),
+        "valid": verdict["ok"],
+        "problems": verdict["problems"],
+        "signature": sig,
+        "winner": srec.get("winner"),
+        "winner_blocks": [
+            srec.get("winner_block_n"), srec.get("winner_block_e"),
+        ],
+        "candidates_timed": sum(
+            1 for r in srec["candidates"] if "step_us" in r
+        ),
+        "candidates_rejected": sum(
+            1 for r in srec["candidates"]
+            if r.get("numerics", {}).get("ok") is False
+        ),
+        "tuned_ggnn_step_us": srec.get("winner_step_us"),
+        "lax_step_us": srec.get("lax_step_us"),
+        "serve_rungs": serve_fit["rungs"],
+        "tuned_ladder_padding_waste": serve_fit["padding_waste"],
+        "pow2_ladder_padding_waste": serve_fit["pow2_padding_waste"],
+        "seq_bucket_edges": seq_fit["edges"],
+        "seq_bucket_padding_waste": seq_fit["padding_waste"],
+        "seq_bucket_pow2_padding_waste": seq_fit["pow2_padding_waste"],
+        "tune_search_seconds": round(search_seconds, 3),
+    }
+
+
+def run_tune(
+    cfg,
+    serve_logs: list[str] | None = None,
+    manifest: str | None = None,
+    out_path: str | Path | None = None,
+    skip_kernel: bool = False,
+) -> dict:
+    """The full offline search at the configured budgets: kernel
+    candidates from the full legal grid, ladder fits replayed from the
+    given serve/fleet logs, seq-bucket fit from a training-manifest
+    length list. Sections without evidence are skipped with a note —
+    a tuned.json never carries a guessed layout."""
+    t0 = time.perf_counter()
+    scfg = cfg.serve
+    node_budget = scfg.node_budget or cfg.data.batch.node_budget
+    edge_budget = scfg.edge_budget or cfg.data.batch.edge_budget
+    d = tune_cache.ggnn_feature_width(cfg.model)
+    notes: list[str] = []
+    kernel = None
+    per_compile_s = 0.0
+    if skip_kernel:
+        notes.append("kernel search skipped (--skip-kernel)")
+    else:
+        ceiling = _measure_ceiling_flops(smoke=False)
+        kernel = tune_kernel.search_kernel(
+            [(node_budget, edge_budget, d)],
+            n_steps=cfg.model.n_steps,
+            n_etypes=cfg.model.n_etypes,
+            reps=cfg.tune.reps,
+            compile_budget_s=cfg.tune.compile_budget_s,
+            ceiling_flops_per_sec=ceiling,
+        )
+        sig = kernel.get(f"{node_budget}x{edge_budget}x{d}") or {}
+        per_compile_s = float(sig.get("lax_compile_seconds") or 0.0)
+    ladders: dict = {}
+    sizes: list[int] = []
+    for log in serve_logs or []:
+        sizes.extend(tune_ladder.batch_sizes_from_log(log))
+    if sizes:
+        ladders["serve"] = tune_ladder.fit_serve_ladder(
+            sizes,
+            capacity=scfg.max_batch_graphs,
+            max_rungs=cfg.tune.max_rungs,
+            compile_budget_s=cfg.tune.compile_budget_s,
+            per_compile_s=per_compile_s,
+        )
+    else:
+        notes.append(
+            "serve ladder fit skipped: no observed batch sizes "
+            "(pass --serve-log with a serve.request_log=true log)"
+        )
+    if manifest:
+        lengths = tune_ladder.lengths_from_manifest(manifest)
+        if lengths and cfg.data.seq_buckets:
+            # tune.max_seq_buckets is the structural compile cap
+            # (each edge is one AOT warmup compile) — it bounds the
+            # fit even below the configured edge count
+            ladders["seq_buckets"] = tune_ladder.fit_seq_buckets(
+                lengths,
+                max_length=int(cfg.data.seq_buckets[-1]),
+                max_edges=cfg.tune.max_seq_buckets,
+                compile_budget_s=cfg.tune.compile_budget_s,
+                per_compile_s=per_compile_s,
+            )
+        else:
+            notes.append(
+                "seq-bucket fit skipped: empty manifest or no "
+                "data.seq_buckets to anchor the max edge"
+            )
+    else:
+        notes.append("seq-bucket fit skipped: no --manifest")
+    search_seconds = time.perf_counter() - t0
+    record = tune_cache.make_record(
+        tune_cache.hardware_key(node_budget, edge_budget),
+        kernel=kernel,
+        ladders=ladders or None,
+        search_seconds=search_seconds,
+    )
+    path = Path(out_path) if out_path else tune_cache.tuned_path(cfg)
+    # validate the NEW record ALONE before it touches disk: a failed
+    # search (no evidence sections, no surviving winner) must never
+    # replace a previously-committed good record for this hardware key
+    # — and a damaged UNRELATED legacy record in the same file must
+    # never block persisting a good new one
+    verdict = tune_cache.validate_tuned(
+        {"version": tune_cache.TUNED_VERSION, "records": [record]}
+    )
+    if verdict["ok"]:
+        doc = tune_cache.upsert_record(
+            tune_cache.load_tuned(path) or tune_cache.empty_doc(),
+            record,
+        )
+        tune_cache.save_tuned(path, doc)
+    else:
+        notes.append(
+            "search produced an invalid record — tuned.json left "
+            "untouched (fix the inputs and re-run)"
+        )
+        logger.warning(
+            "not persisting invalid tuned record: %s",
+            verdict["problems"],
+        )
+    report = {
+        "tuned_path": str(path),
+        "valid": verdict["ok"],
+        "problems": verdict["problems"],
+        "hardware": record["hardware"],
+        "notes": notes,
+        "tune_search_seconds": round(search_seconds, 3),
+    }
+    if kernel:
+        sig_label = f"{node_budget}x{edge_budget}x{d}"
+        srec = kernel.get(sig_label) or {}
+        report["kernel"] = {
+            "signature": sig_label,
+            "winner": srec.get("winner"),
+            "winner_step_us": srec.get("winner_step_us"),
+            "lax_step_us": srec.get("lax_step_us"),
+            "candidates": len(srec.get("candidates") or []),
+            "pruned": len(srec.get("pruned") or []),
+        }
+    if "serve" in ladders:
+        report["serve_ladder"] = ladders["serve"]
+    if "seq_buckets" in ladders:
+        report["seq_buckets"] = ladders["seq_buckets"]
+    print(json.dumps(report), flush=True)
+    return report

@@ -1,0 +1,392 @@
+"""Measured kernel-layout search for the Pallas GGNN step
+(docs/tuning.md; the search half of ROADMAP item 3).
+
+`nn/ggnn_kernel.py` hand-pins 256-node/512-edge tiles at the flagship
+shape. This module replaces the hand-pin with measurement:
+
+1. **Enumerate** legal (block_n, block_e, scatter, accum) candidates per
+   GGNN batch signature. Legality is checked BEFORE any compile:
+   divisibility (the kernel's reshape contract), the TPU sublane
+   alignment (f32 tiles are 8 x 128, docs/ggnn_kernel.md), and a VMEM
+   working-set estimate against the ~16 MB/core budget — an illegal
+   layout costs a pruned-row entry, never a Mosaic error.
+2. **Compile-and-time** each survivor through the SAME AOT
+   lower()->compile() path the serve executors use, with interleaved
+   best-of-reps timing (candidates alternate within each rep round so a
+   drifting box biases nobody; the best window is kept — the PR-4/PR-10
+   overhead-measurement rule).
+3. **Assert the PR-8 numerics contract on every candidate** — fold/fp32
+   must be BIT-IDENTICAL to the jitted lax path, mxu within 1e-5, bf16
+   within 5e-2 — and record the verdict on the candidate row. A
+   candidate outside its tolerance can never win, no matter how fast.
+4. **Pick by measured step time**, with `mfu_vs_measured_ceiling`
+   recorded against the docs/roofline.md measured matmul ceiling so the
+   winner's roofline position rides in tuned.json next to its time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+import numpy as np
+
+#: VMEM per TPU core (~16 MB; /opt/skills guide + docs/ggnn_kernel.md);
+#: the estimate below prunes layouts whose working set cannot fit
+DEFAULT_VMEM_LIMIT_BYTES = 16 * 2**20
+
+#: the PR-8 numerics contract (docs/ggnn_kernel.md): max relative error
+#: vs the jitted lax path, keyed by (scatter, accum). fold/fp32 is
+#: bit-identical BY CONSTRUCTION (the sequential left fold is exactly
+#: XLA's sorted segment_sum update order), so its tolerance is zero.
+DEFAULT_TOLERANCES: dict[tuple[str, str], float] = {
+    ("fold", "fp32"): 0.0,
+    ("mxu", "fp32"): 1e-5,
+    ("fold", "bf16"): 5e-2,
+    ("mxu", "bf16"): 5e-2,
+}
+
+#: default block-size grids (multiples of the f32 sublane, bracketing
+#: the PR-8 hand-picked 256/512 tiles from both sides)
+DEFAULT_BLOCK_NODES = (64, 128, 256, 512)
+DEFAULT_BLOCK_EDGES = (128, 256, 512, 1024)
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One kernel layout under consideration (hashable, JSON-able)."""
+
+    block_n: int
+    block_e: int
+    scatter: str = "fold"  # fold | mxu
+    accum: str = "fp32"  # fp32 | bf16
+
+    @property
+    def label(self) -> str:
+        return (
+            f"bn{self.block_n}-be{self.block_e}-"
+            f"{self.scatter}-{self.accum}"
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "candidate": self.label,
+            "block_n": self.block_n,
+            "block_e": self.block_e,
+            "scatter": self.scatter,
+            "accum": self.accum,
+        }
+
+
+def estimate_vmem_bytes(
+    n: int, e: int, d: int, cand: Candidate, n_etypes: int = 1
+) -> int:
+    """Working-set estimate for one fused-step grid program, mirroring
+    the BlockSpecs in `nn/ggnn_kernel.py:_fwd_call`: the full message
+    table + edge index/weight arrays are staged whole, per-block state
+    and temporaries ride on top. Deliberately a slight over-estimate
+    (double-buffering headroom is the compiler's business, not ours)."""
+    msg_bytes = 2 if cand.accum == "bf16" else 4
+    total = n * d * msg_bytes  # hm message table (full)
+    total += 3 * cand.block_n * d * 4  # h block + hout + aout blocks
+    total += 2 * e * 4  # src2 + dst2 (full [n_eb, block_e])
+    total += n_etypes * e * 4  # per-type masked weights
+    total += n_etypes * d * d * msg_bytes + n_etypes * d * 4  # wm + bm
+    total += 2 * d * 3 * d * 4 + 2 * 3 * d * 4  # GRU weights + biases
+    total += 2 * cand.block_e * d * 4  # gather + message temporaries
+    if cand.scatter == "mxu":
+        total += cand.block_e * cand.block_n * 4  # the one-hot block
+    return int(total)
+
+
+def enumerate_candidates(
+    n: int,
+    e: int,
+    d: int,
+    n_etypes: int = 1,
+    block_nodes: Sequence[int] = DEFAULT_BLOCK_NODES,
+    block_edges: Sequence[int] = DEFAULT_BLOCK_EDGES,
+    scatters: Sequence[str] = ("fold", "mxu"),
+    accums: Sequence[str] = ("fp32",),
+    vmem_limit_bytes: int = DEFAULT_VMEM_LIMIT_BYTES,
+) -> tuple[list[Candidate], list[dict]]:
+    """(survivors, pruned) for one signature. Every pruned layout keeps
+    a row naming its reason, so the search record shows what was ruled
+    out and why — the divisibility + VMEM bound applied BEFORE compile."""
+    survivors: list[Candidate] = []
+    pruned: list[dict] = []
+    seen: set[Candidate] = set()
+    for bn in block_nodes:
+        for be in block_edges:
+            for scatter in scatters:
+                for accum in accums:
+                    cand = Candidate(int(bn), int(be), scatter, accum)
+                    if cand in seen:
+                        continue
+                    seen.add(cand)
+                    reason = None
+                    if n % cand.block_n:
+                        reason = (
+                            f"block_n {cand.block_n} does not divide "
+                            f"node budget {n}"
+                        )
+                    elif e % cand.block_e:
+                        reason = (
+                            f"block_e {cand.block_e} does not divide "
+                            f"edge budget {e}"
+                        )
+                    elif cand.block_n % 8 or cand.block_e % 8:
+                        # f32 sublane alignment (8 x 128 tiles)
+                        reason = (
+                            f"blocks ({cand.block_n}, {cand.block_e}) "
+                            f"not sublane-aligned (x8)"
+                        )
+                    else:
+                        vmem = estimate_vmem_bytes(
+                            n, e, d, cand, n_etypes
+                        )
+                        if vmem > vmem_limit_bytes:
+                            reason = (
+                                f"VMEM estimate {vmem} > limit "
+                                f"{vmem_limit_bytes}"
+                            )
+                    if reason is None:
+                        survivors.append(cand)
+                    else:
+                        pruned.append(
+                            {**cand.as_dict(), "reason": reason}
+                        )
+    return survivors, pruned
+
+
+def numerics_verdict(
+    got: np.ndarray,
+    ref: np.ndarray,
+    cand: Candidate,
+    tolerances: dict[tuple[str, str], float] | None = None,
+) -> dict:
+    """The per-candidate numerics-contract verdict persisted on every
+    tuned.json candidate row: relative max error vs the jitted lax
+    reference against the candidate's (scatter, accum) tolerance."""
+    tol_table = tolerances if tolerances is not None else DEFAULT_TOLERANCES
+    tol = tol_table.get((cand.scatter, cand.accum), 0.0)
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    rel = float(
+        np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    )
+    return {
+        "ok": bool(rel <= tol),
+        "rel_err": round(rel, 10),
+        "tolerance": tol,
+        "mode": f"{cand.scatter}/{cand.accum}",
+    }
+
+
+def _workload(n: int, e: int, d: int, seed: int = 0):
+    """A realistic padded single-graph batch at the given budgets
+    (CFG-degree dst-sorted edges with a padding tail — the
+    scripts/bench_scatter.py shape family)."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.graphs.batch import GraphBatch
+
+    rng = np.random.default_rng(seed)
+    n_real = int(min(e * 0.9, n * 2.0))
+    dst = np.sort(rng.integers(0, n - 1, n_real)).astype(np.int32)
+    src = rng.integers(0, n - 1, n_real).astype(np.int32)
+    edge_src = np.full((e,), n - 1, np.int32)
+    edge_dst = np.full((e,), n - 1, np.int32)
+    edge_src[:n_real] = src
+    edge_dst[:n_real] = dst
+    edge_mask = np.zeros((e,), bool)
+    edge_mask[:n_real] = True
+    feat = rng.standard_normal((n, d)).astype(np.float32)
+    batch = GraphBatch(
+        node_feats=jnp.zeros((n, 4), jnp.int32),
+        node_vuln=jnp.zeros((n,), jnp.int32),
+        node_graph=jnp.zeros((n,), jnp.int32),
+        node_mask=jnp.ones((n,), bool),
+        edge_src=jnp.asarray(edge_src),
+        edge_dst=jnp.asarray(edge_dst),
+        edge_mask=jnp.asarray(edge_mask),
+        graph_label=jnp.ones((1,), jnp.float32),
+        graph_mask=jnp.ones((1,), bool),
+        graph_ids=jnp.zeros((1,), jnp.int32),
+        num_graphs=1,
+    )
+    return batch, jnp.asarray(feat)
+
+
+def search_kernel(
+    signatures: Sequence[tuple[int, int, int]],
+    n_steps: int = 5,
+    n_etypes: int = 1,
+    candidates: Sequence[Candidate] | None = None,
+    reps: int = 3,
+    interpret: str | bool = "auto",
+    compile_budget_s: float = 0.0,
+    ceiling_flops_per_sec: float = 0.0,
+    tolerances: dict[tuple[str, str], float] | None = None,
+    **enumerate_kw,
+) -> dict:
+    """Measured search over kernel layouts; {"NxExD": record} per
+    signature. Each record carries the lax reference time, every
+    candidate row (compile seconds, best-of-reps step time, numerics
+    verdict, VMEM estimate), the pruned rows, and the winner."""
+    import jax
+
+    from deepdfa_tpu.nn import GatedGraphConv
+
+    out: dict[str, dict] = {}
+    budget_left = float(compile_budget_s) if compile_budget_s else None
+    for n, e, d in signatures:
+        sig = f"{n}x{e}x{d}"
+        batch, feat = _workload(n, e, d)
+        lax_conv = GatedGraphConv(
+            out_features=d, n_steps=n_steps, n_etypes=n_etypes
+        )
+        params = lax_conv.init(jax.random.key(0), batch, feat)
+        lax_jit = jax.jit(
+            lambda p, b, f, _c=lax_conv: _c.apply(p, b, f)
+        )
+        t0 = time.perf_counter()
+        lax_compiled = lax_jit.lower(params, batch, feat).compile()
+        lax_compile_s = time.perf_counter() - t0
+        ref = np.asarray(jax.device_get(lax_compiled(params, batch, feat)))
+        from deepdfa_tpu.obs.ledger import read_cost_analysis
+
+        try:
+            flops = read_cost_analysis(lax_compiled)["flops"]
+        except Exception:
+            flops = 0.0
+
+        if candidates is None:
+            cands, pruned = enumerate_candidates(
+                n, e, d, n_etypes=n_etypes, **enumerate_kw
+            )
+        else:
+            cands, pruned = list(candidates), []
+
+        rows: list[dict] = []
+        runnable: list[tuple[Candidate, object, dict]] = []
+        for cand in cands:
+            if budget_left is not None and budget_left <= 0:
+                rows.append({
+                    **cand.as_dict(),
+                    "skipped": "compile-seconds budget exhausted",
+                })
+                continue
+            conv = GatedGraphConv(
+                out_features=d, n_steps=n_steps, n_etypes=n_etypes,
+                use_kernel=True,
+                kernel_scatter=cand.scatter,
+                kernel_accum=cand.accum,
+                kernel_block_nodes=cand.block_n,
+                kernel_block_edges=cand.block_e,
+                kernel_interpret=interpret,
+            )
+            fn = jax.jit(lambda p, b, f, _c=conv: _c.apply(p, b, f))
+            row = {
+                **cand.as_dict(),
+                "vmem_bytes_est": estimate_vmem_bytes(
+                    n, e, d, cand, n_etypes
+                ),
+            }
+            t0 = time.perf_counter()
+            try:
+                compiled = fn.lower(params, batch, feat).compile()
+                got = np.asarray(
+                    jax.device_get(compiled(params, batch, feat))
+                )
+            except Exception as exc:  # a lowering gap costs one row,
+                # never the search (the bench_scatter isolation rule)
+                # — but its wall time still charges the compile budget
+                # (a slowly-FAILING candidate spends the same seconds)
+                if budget_left is not None:
+                    budget_left -= time.perf_counter() - t0
+                row["error"] = f"{type(exc).__name__}: {exc}"[:200]
+                rows.append(row)
+                continue
+            dt = time.perf_counter() - t0
+            if budget_left is not None:
+                budget_left -= dt
+            row["compile_seconds"] = round(dt, 3)
+            # module attribute on purpose: tests monkeypatch the verdict
+            # to prove a broken candidate can never win
+            row["numerics"] = numerics_verdict(
+                got, ref, cand, tolerances=tolerances
+            )
+            rows.append(row)
+            runnable.append((cand, compiled, row))
+
+        # interleaved best-of-reps: round-robin across candidates (+ the
+        # lax reference) per rep so box drift hits everyone equally; the
+        # MIN window survives (deterministic cost does, stalls don't)
+        best: dict[str, float] = {}
+        lax_best = None
+        for _ in range(max(1, int(reps))):
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(lax_compiled(params, batch, feat)))
+            dt = time.perf_counter() - t0
+            lax_best = dt if lax_best is None else min(lax_best, dt)
+            for cand, compiled, _row in runnable:
+                t0 = time.perf_counter()
+                np.asarray(jax.device_get(compiled(params, batch, feat)))
+                dt = time.perf_counter() - t0
+                prev = best.get(cand.label)
+                best[cand.label] = (
+                    dt if prev is None else min(prev, dt)
+                )
+
+        for cand, _compiled, row in runnable:
+            step_s = best[cand.label] / max(1, n_steps)
+            row["step_us"] = round(step_s * 1e6, 2)
+            if flops > 0 and ceiling_flops_per_sec > 0:
+                row["mfu_vs_measured_ceiling"] = round(
+                    (flops / max(1, n_steps)) / step_s
+                    / ceiling_flops_per_sec,
+                    6,
+                )
+
+        ok_rows = [
+            r for r in rows
+            if r.get("numerics", {}).get("ok") and "step_us" in r
+        ]
+        winner = (
+            min(ok_rows, key=lambda r: r["step_us"]) if ok_rows else None
+        )
+        rec: dict = {
+            "signature": sig,
+            "n_steps": int(n_steps),
+            "n_etypes": int(n_etypes),
+            "lax_step_us": (
+                round(lax_best / max(1, n_steps) * 1e6, 2)
+                if lax_best is not None else None
+            ),
+            "lax_compile_seconds": round(lax_compile_s, 3),
+            "flops_per_step": (
+                round(flops / max(1, n_steps), 1) if flops else None
+            ),
+            "candidates": rows,
+            "pruned": pruned,
+            "winner": winner["candidate"] if winner else None,
+        }
+        if winner:
+            rec["winner_step_us"] = winner["step_us"]
+            rec["winner_block_n"] = winner["block_n"]
+            rec["winner_block_e"] = winner["block_e"]
+            rec["winner_scatter"] = winner["scatter"]
+            rec["winner_accum"] = winner["accum"]
+            if "mfu_vs_measured_ceiling" in winner:
+                rec["winner_mfu_vs_measured_ceiling"] = winner[
+                    "mfu_vs_measured_ceiling"
+                ]
+        else:
+            rec["error"] = (
+                "no candidate passed the numerics contract — defaults "
+                "stay in force for this signature"
+            )
+        out[sig] = rec
+    return out

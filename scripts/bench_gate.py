@@ -21,6 +21,11 @@ Modes:
         sites, compile seconds, the serve ladder's zero-recompile pin)
         against the newest healthy same-device-count round; PATH
         defaults to the newest committed MULTICHIP_r*.json
+  python scripts/bench_gate.py --tuned [PATH]         # gate a
+        tuned.json / TUNED_r* document round-over-round (winner step
+        time, fitted ladder waste, fit-beats-pow2, search-seconds
+        bound) against the newest same-hardware-key round; PATH
+        defaults to the newest committed TUNED_r*.json
   python scripts/bench_gate.py --smoke                # tier-1: verify
         the classifier on synthetic pass/regression/fallback records
 
@@ -176,6 +181,51 @@ def run_multichip(args) -> int:
     return 0 if result["verdict"] == "pass" else 1
 
 
+def run_tuned(args) -> int:
+    """`--tuned [PATH]`: gate one tuned.json / TUNED_r* document against
+    the committed TUNED_r* trajectory (deepdfa_tpu/tune/, docs/tuning.md;
+    same exit-code contract: 0 pass, 1 regression/error)."""
+    from deepdfa_tpu.obs.bench_gate import gate_tuned, render_markdown
+    from deepdfa_tpu.tune.cache import load_tuned_trajectory
+
+    root = Path(args.root)
+    trajectory = load_tuned_trajectory(root)
+    exclude = None
+    if args.tuned:
+        path = Path(args.tuned)
+        artifact = json.loads(path.read_text())
+        source = str(path)
+        if path.resolve().parent == root.resolve():
+            exclude = path.name
+    else:
+        candidates = [
+            e for e in trajectory if isinstance(e.get("record"), dict)
+        ]
+        if not candidates:
+            raise SystemExit(f"no parseable TUNED_r*.json under {root}")
+        artifact = candidates[-1]["record"]
+        source = exclude = candidates[-1]["source"]
+
+    tolerances = {}
+    for spec in args.tolerance:
+        metric, _, frac = spec.partition("=")
+        tolerances[metric] = float(frac)
+    result = gate_tuned(
+        artifact, trajectory,
+        tolerances=tolerances or None,
+        exclude_source=exclude,
+    )
+    result["record_source"] = source
+    md = render_markdown(result)
+    print(md)
+    print(json.dumps(result), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(result, indent=1))
+    if args.markdown_out:
+        Path(args.markdown_out).write_text(md)
+    return 0 if result["verdict"] == "pass" else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--record", default=None,
@@ -199,6 +249,14 @@ def main(argv=None) -> int:
                     "zero-recompile pin) against the newest healthy "
                     "same-device-count round; default: the newest "
                     "committed MULTICHIP_r*.json")
+    ap.add_argument("--tuned", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="gate a tuned.json / TUNED_r* document "
+                    "round-over-round (winner step time, fitted ladder "
+                    "waste, fit-beats-pow2, search-seconds bound) "
+                    "against the newest same-hardware round; default: "
+                    "the newest committed TUNED_r*.json "
+                    "(deepdfa_tpu/tune/, docs/tuning.md)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 classifier self-check on synthetic "
                     "records")
@@ -209,6 +267,9 @@ def main(argv=None) -> int:
 
     if args.multichip is not None:
         return run_multichip(args)
+
+    if args.tuned is not None:
+        return run_tuned(args)
 
     from deepdfa_tpu.obs.bench_gate import (
         gate,

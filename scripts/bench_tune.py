@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Autotuner search benchmark (deepdfa_tpu/tune/, docs/tuning.md).
+
+Runs one REAL reduced search pass — kernel candidates compiled and
+timed under the PR-8 numerics contract, the skewed-distribution ladder
+fit, the lognormal seq-bucket fit — and stamps the fields the bench
+gate reads (obs/bench_gate.py):
+
+  tuned_ggnn_step_us          winner layout's measured per-step time
+                              (lower is better, tol 0.25)
+  tuned_ladder_padding_waste  fitted ladder's expected padded-compute
+                              fraction on the skewed smoke distribution
+                              (lower is better, tol 0.10)
+  tune_search_seconds         search wall time (ABSOLUTE bound — the
+                              search must stay a bounded offline pass)
+
+    python scripts/bench_tune.py --smoke     # tier-1 regression mode
+    DEEPDFA_TPU_PLATFORM=cpu python scripts/bench_tune.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_tune(smoke: bool = False) -> dict:
+    """One search pass into a scratch tuned.json; the bench record."""
+    from deepdfa_tpu.tune import cache as tune_cache
+    from deepdfa_tpu.tune import driver as tune_driver
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    with tempfile.TemporaryDirectory(prefix="bench-tune-") as d:
+        out = os.path.join(d, "tuned.json")
+        # the reduced search is the measured unit on every platform:
+        # the full-budget search is an operator action with its own
+        # compile budget, not a per-round bench
+        report = tune_driver.run_tune_smoke(
+            out_path=out, reps=2 if smoke else 3
+        )
+        verdict = tune_cache.validate_tuned_file(out)
+    rec = {
+        "metric": "tuned_ggnn_step_us",
+        "unit": "us/step (winning tuned layout, smoke signature)",
+        "value": report.get("tuned_ggnn_step_us"),
+        "platform": platform,
+        "tuned_ggnn_step_us": report.get("tuned_ggnn_step_us"),
+        "tuned_lax_step_us": report.get("lax_step_us"),
+        "tuned_winner": report.get("winner"),
+        "tuned_candidates_timed": report.get("candidates_timed"),
+        "tuned_candidates_rejected": report.get("candidates_rejected"),
+        "tuned_ladder_padding_waste": report.get(
+            "tuned_ladder_padding_waste"
+        ),
+        "tuned_pow2_ladder_padding_waste": report.get(
+            "pow2_ladder_padding_waste"
+        ),
+        "tuned_seq_bucket_padding_waste": report.get(
+            "seq_bucket_padding_waste"
+        ),
+        "tune_search_seconds": report.get("tune_search_seconds"),
+        "tuned_valid": bool(verdict.get("ok")),
+    }
+    from deepdfa_tpu.obs import run_stamp
+
+    rec.update(run_stamp())
+    return rec
+
+
+def run_smoke() -> dict:
+    """Tier-1 regression mode (the bench_scatter convention): the
+    search must complete, validate, pick a winner under the numerics
+    contract, and the fitted ladder must strictly beat pow2."""
+    rec = bench_tune(smoke=True)
+    if not rec["tuned_valid"]:
+        raise AssertionError(f"tuned.json failed validation: {rec}")
+    if not rec["tuned_winner"] or not rec["tuned_ggnn_step_us"]:
+        raise AssertionError(f"no measured winner: {rec}")
+    if not (
+        rec["tuned_ladder_padding_waste"]
+        < rec["tuned_pow2_ladder_padding_waste"]
+    ):
+        raise AssertionError(
+            f"ladder fit did not beat pow2: {rec}"
+        )
+    print(json.dumps(rec))
+    return rec
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    opts = ap.parse_args(argv)
+
+    from deepdfa_tpu.core.backend import apply_platform_override
+
+    apply_platform_override()
+    if opts.smoke:
+        run_smoke()
+        return
+    print(json.dumps(bench_tune()))
+
+
+if __name__ == "__main__":
+    main()

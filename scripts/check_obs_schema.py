@@ -48,6 +48,13 @@ Modes:
                  step/event rings, ledger shape) AND every embedded
                  metrics tag declared in SCHEMA — wired into the
                  serve/scan smoke paths and scripts/fault_inject.py
+  --tuned <path> validate a tuned.json / TUNED_r*.json record
+                 (deepdfa_tpu/tune/cache.py:validate_tuned,
+                 docs/tuning.md): hardware key complete, every
+                 candidate row carries its numerics-contract verdict,
+                 a winner present per signature, ladder fits carry
+                 their pow2 baseline — wired into
+                 `deepdfa-tpu tune --smoke`
   --multichip <path>  validate a MULTICHIP record (the driver artifact
                  MULTICHIP_r*.json, or the raw `{"multichip": ...}`
                  line `__graft_entry__.py:dryrun_multichip` prints —
@@ -193,6 +200,10 @@ def main(argv=None) -> int:
                     help="validate a MULTICHIP record (driver artifact "
                     "or raw dryrun_multichip JSON line; "
                     "parallel/sharding.py:validate_multichip)")
+    ap.add_argument("--tuned", default=None,
+                    help="validate a tuned.json / TUNED_r*.json record "
+                    "(deepdfa_tpu/tune/cache.py:validate_tuned, "
+                    "docs/tuning.md)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -227,6 +238,24 @@ def main(argv=None) -> int:
                 "cascade log validation failed (declare the tags in "
                 "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the cascade "
                 "emitters):\n  "
+                + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.tuned:
+        from deepdfa_tpu.tune.cache import validate_tuned_file
+
+        result = validate_tuned_file(args.tuned)
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "tuned record validation failed (fix the search "
+                "emitters in deepdfa_tpu/tune/ or re-run "
+                "`deepdfa-tpu tune`):\n  "
                 + "\n  ".join(result.get("problems", [])),
                 file=sys.stderr,
             )
