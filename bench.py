@@ -669,8 +669,10 @@ def run_scatter_measurement(platform: str) -> dict:
     Pallas-kernel per-step time plus MFU against the same-window
     measured matmul ceiling and gather-bandwidth roofline tier-1 smokes
     — and prefixes nothing: the fields already carry the ggnn_* names
-    the bench gate reads (`ggnn_step_us` lower-is-better, `ggnn_mfu`),
-    so the MFU gap is a tracked number in BENCH_r*.json."""
+    the bench gate reads (`ggnn_step_us` / `ggnn_unroll_step_us`
+    lower-is-better, `ggnn_mfu`, `ggnn_kernel_int8_rel_err` absolute-
+    bounded), so the MFU gap and the fused-unroll/int8 numbers are
+    tracked in BENCH_r*.json."""
     from deepdfa_tpu.core.backend import enable_compile_cache, force_cpu
 
     if platform == "cpu":

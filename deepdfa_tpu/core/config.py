@@ -136,10 +136,19 @@ class ModelConfig:
     # scatter mode: "auto" (mxu on TPU hardware, the bit-exact fold
     # under the CPU interpreter), "fold", or "mxu"
     ggnn_kernel_scatter: str = "auto"
-    # message-side dtype policy: "fp32" (bit-identical to lax) or
-    # "bf16" (halved gather traffic, f32 accumulation, f32 GRU state;
-    # tolerance pinned in tests/test_ggnn_kernel.py)
+    # message-side dtype policy: "fp32" (bit-identical to lax), "bf16"
+    # (halved gather traffic, f32 accumulation, f32 GRU state), or
+    # "int8" (per-channel symmetric quantization, int8 MXU matmuls with
+    # int32 accumulation, drift-bounded); tolerances pinned in
+    # tests/test_ggnn_kernel.py
     ggnn_kernel_accum: str = "fp32"
+    # step-loop placement: "per_step" (one pallas_call per GGNN step)
+    # or "fused" (the whole n_steps unroll in ONE kernel with the node
+    # state VMEM-resident; falls back to per_step loudly when the
+    # residency estimate overflows VMEM or under scan_steps). A
+    # LAYOUT-ONLY knob like the tile sizes: same numerics contract,
+    # same param tree — excluded from the serve registry's digest
+    ggnn_kernel_unroll: str = "per_step"
     # kernel block/tile sizes (0 = the hand-picked defaults in
     # nn/ggnn_kernel.py:block_sizes). LAYOUT-ONLY knobs: they change how
     # the fused step tiles, never the param tree or numerics contract —

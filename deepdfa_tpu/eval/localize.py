@@ -355,6 +355,9 @@ def ggnn_forward(model, params, batch):
             use_kernel=getattr(model, "ggnn_kernel", False),
             kernel_scatter=getattr(model, "ggnn_kernel_scatter", "auto"),
             kernel_accum=getattr(model, "ggnn_kernel_accum", "fp32"),
+            kernel_unroll=getattr(
+                model, "ggnn_kernel_unroll", "per_step"
+            ),
             kernel_block_nodes=getattr(
                 model, "ggnn_kernel_block_nodes", 0
             ),

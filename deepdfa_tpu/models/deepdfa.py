@@ -56,6 +56,7 @@ class DeepDFA(nn.Module):
     ggnn_kernel: bool = False
     ggnn_kernel_scatter: str = "auto"
     ggnn_kernel_accum: str = "fp32"
+    ggnn_kernel_unroll: str = "per_step"
     #: tuned block/tile sizes (deepdfa_tpu/tune/, docs/tuning.md);
     #: 0 = the hand-picked defaults in nn/ggnn_kernel.py:block_sizes
     ggnn_kernel_block_nodes: int = 0
@@ -77,6 +78,9 @@ class DeepDFA(nn.Module):
             ggnn_kernel=getattr(cfg, "ggnn_kernel", False),
             ggnn_kernel_scatter=getattr(cfg, "ggnn_kernel_scatter", "auto"),
             ggnn_kernel_accum=getattr(cfg, "ggnn_kernel_accum", "fp32"),
+            ggnn_kernel_unroll=getattr(
+                cfg, "ggnn_kernel_unroll", "per_step"
+            ),
             ggnn_kernel_block_nodes=getattr(
                 cfg, "ggnn_kernel_block_nodes", 0
             ),
@@ -126,6 +130,7 @@ class DeepDFA(nn.Module):
             use_kernel=self.ggnn_kernel,
             kernel_scatter=self.ggnn_kernel_scatter,
             kernel_accum=self.ggnn_kernel_accum,
+            kernel_unroll=self.ggnn_kernel_unroll,
             kernel_block_nodes=self.ggnn_kernel_block_nodes,
             kernel_block_edges=self.ggnn_kernel_block_edges,
             name="ggnn",

@@ -45,6 +45,39 @@ in tests (the bound tracks bf16's ~3 decimal digits through one
 matmul + masked sum, NOT compounding across steps, because the GRU
 re-anchors the state in f32 each step).
 
+int8 accumulation policy (``accum="int8"``): true int8 MXU operands —
+the message-side node table quantizes to per-ROW (per-node) symmetric
+int8 (`_quant_rows`) and the per-etype transform weights to
+per-OUTPUT-CHANNEL symmetric int8 (`_quant_wm`); the edge transform
+then runs int8 x int8 with int32 accumulation and dequantizes with the
+rank-1 outer product of the two scale vectors (exact — the row scale
+factors out of the contraction, the column scale out of the output
+channel). Under ``scatter="mxu"`` the one-hot scatter ALSO runs on
+int8: messages requantize per-COLUMN inside each edge block (the
+column scale factors out of the edge sum; the one-hot operand is exact
+0/1), int32 accumulation, dequant into the f32 node accumulator. The
+GRU state/update stays f32, so like bf16 the error does not compound
+across steps; the drift bound vs the f32 lax path is
+`INT8_DRIFT_BOUND` (asserted in tests, in `tune/kernel.py`'s
+per-candidate numerics verdict, and as an absolute bench-gate bound —
+the PR-12 admission-contract idiom).
+
+Whole-unroll fusion (``unroll="fused"``): one `pallas_call` runs ALL
+`n_steps` steps on a `(n_steps, n_node_blocks)` grid with the node
+state resident in VMEM across steps — a double-buffered `(2, N, D)`
+f32 scratch ping-pongs the inter-step GRU chain (TPU grid programs run
+sequentially, so every block's step-`s` write lands before step
+`s+1`'s gathers read it), and `h` is written back to HBM exactly once,
+from a constant-index full-table output buffer flushed at grid end.
+`resolve_unroll` admits the mode only when the resident working set
+(`fused_residency_bytes`) fits the per-core VMEM budget and the caller
+is not under `scan_steps` (whose point is a bounded trace the unrolled
+backward would defeat); both fallbacks are LOUD — a warning plus the
+`ggnn_kernel/fused_fallbacks` counter. The backward (custom_vjp on the
+whole unroll) saves only the step-input `h` chain — streamed to HBM by
+a chain-emitting forward variant — and recomputes each step's gates
+from it, sweeping the existing per-step backward kernels in reverse.
+
 Backward (custom_vjp, per step): the transposed problem is a gather by
 dst (sorted — cheap) followed by a scatter by src (unsorted — the slow
 path XLA's autodiff would take through an unsorted scatter-add,
@@ -91,12 +124,28 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import threading
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger(__name__)
+
+# relative-error admission bound for accum="int8" vs the f32 lax path —
+# analogous to bf16's 5e-2 rung on the PR-8 numerics ladder and to
+# serve.quant_drift_bound's default (the PR-12 admission contract).
+# Single declaration; tune/kernel.py keys its tolerance table off it and
+# obs/bench_gate.py mirrors it as an absolute bound (pinned equal in
+# tests).
+INT8_DRIFT_BOUND = 5e-2
+
+# per-core VMEM budget the fused-unroll residency check admits against
+# (mirrors tune/kernel.py:DEFAULT_VMEM_LIMIT_BYTES; pinned equal in
+# tests — declared here too so the nn layer never imports tune/)
+VMEM_LIMIT_BYTES = 16 * 2**20
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,9 +158,11 @@ class _Params:
     block_n: int
     block_e: int
     n_etypes: int
-    accum: str  # "fp32" | "bf16" — message-side dtype policy
+    accum: str  # "fp32" | "bf16" | "int8" — message-side dtype policy
     scatter: str  # "fold" (order-exact) | "mxu" (one-hot matmul)
     interpret: str | bool  # False | "legacy" | "tpu"
+    unroll: str = "per_step"  # "per_step" | "fused" (whole-unroll kernel)
+    n_steps: int = 1  # step count the fused kernel grids over
 
     @property
     def n_nb(self) -> int:
@@ -195,6 +246,101 @@ def resolve_interpret(interpret: str | bool) -> str | bool:
     return False if jax.default_backend() == "tpu" else "legacy"
 
 
+def fused_residency_bytes(
+    n: int, d: int, accum: str, n_steps: int = 1
+) -> int:
+    """VMEM the fused unroll keeps resident ON TOP of the per-step
+    kernel's staged inputs: the inter-step state chain plus the
+    full-table output buffer. The naive chain is ×n_steps node tables;
+    the ping-pong scratch caps the resident copies at
+    `min(n_steps + 1, 2)` (each step reads one parity and writes the
+    other), and the constant-index output buffer adds one more. int8
+    adds the quantized shadow table and its per-row scales,
+    re-quantized in-kernel each step."""
+    resident_states = min(int(n_steps) + 1, 2)
+    total = (resident_states + 1) * n * d * 4
+    if accum == "int8":
+        total += n * d + n * 4
+    return total
+
+
+def _note_fused_fallback(reason: str) -> None:
+    """The LOUD half of the fused-unroll fallback contract: a warning
+    naming the reason plus the `ggnn_kernel/fused_fallbacks` counter
+    (declared under the `ggnn_kernel/*` SCHEMA wildcard), so a config
+    that asks for `fused` and silently serves per-step is visible in
+    logs, epoch records, and serve diagnostics alike."""
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    logger.warning("ggnn_kernel: fused unroll unavailable — %s; "
+                   "falling back to the per-step kernel", reason)
+    obs_metrics.REGISTRY.counter("ggnn_kernel/fused_fallbacks").inc()
+
+
+def resolve_unroll(
+    unroll: str, *, n: int, d: int, n_steps: int, accum: str,
+    scan_steps: bool, vmem_limit_bytes: int | None = None,
+) -> tuple[str, str]:
+    """Admission check for ``unroll="fused"``: returns the effective
+    unroll mode and, when it downgrades, the reason (empty string
+    otherwise). Two downgrade rules, both documented in
+    docs/ggnn_kernel.md:
+
+    - ``scan_steps`` training asked for a bounded trace; the fused
+      backward unrolls n_steps per-step backward sweeps at trace time,
+      which is exactly what scan exists to avoid — per-step + lax.scan
+      is the honest lowering there.
+    - the resident working set must fit the per-core VMEM budget
+      (`fused_residency_bytes`); over budget falls back rather than
+      letting Mosaic (or silent VMEM spilling) decide.
+    """
+    if unroll not in ("per_step", "fused"):
+        raise ValueError(f"unknown ggnn_kernel unroll {unroll!r}")
+    if unroll != "fused":
+        return "per_step", ""
+    if vmem_limit_bytes is None:
+        # resolved at call time (not def time) so tests can shrink the
+        # module-level budget and watch the fallback fire end-to-end
+        vmem_limit_bytes = VMEM_LIMIT_BYTES
+    if scan_steps and n_steps > 1:
+        return ("per_step",
+                "scan_steps requested a bounded trace; the fused "
+                "unroll's backward re-unrolls every step")
+    need = fused_residency_bytes(n, d, accum, n_steps)
+    if need > vmem_limit_bytes:
+        return ("per_step",
+                f"fused unroll residency {need} B exceeds the VMEM "
+                f"budget {vmem_limit_bytes} B at {n}x{d}")
+    return "fused", ""
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization (per-channel symmetric; host- OR kernel-side)
+
+
+def _quant_rows(x):
+    """Per-row symmetric int8: scale = max|row|/127 (all-zero rows get
+    scale 1.0 so padding quantizes to exact zeros). Returns (q, s) with
+    q int8 [n, d] and s f32 [n, 1]; x ~= q * s."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                keepdims=True) / 127.0
+    s = jnp.where(s > 0.0, s, 1.0)
+    q = jnp.clip(jnp.round(x / s), -127.0, 127.0).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
+def _quant_wm(wm):
+    """Per-output-channel symmetric int8 for the [T, d, d] per-etype
+    message transforms (channel = the non-contracted output dim, so the
+    scale factors out of the int32 accumulation exactly). Returns
+    (q [T, d, d] int8, s [T, d] f32)."""
+    s = jnp.max(jnp.abs(wm.astype(jnp.float32)), axis=1,
+                keepdims=True) / 127.0  # [T, 1, d]
+    s = jnp.where(s > 0.0, s, 1.0)
+    q = jnp.clip(jnp.round(wm / s), -127.0, 127.0).astype(jnp.int8)
+    return q, s[:, 0, :].astype(jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # trace-time signature census (the PR-2 step-cache convention)
 
@@ -259,14 +405,31 @@ def _aggregate(p: _Params, acc, msg, dst_local):
         # in-block destinations, the MXU does the accumulation. f32
         # one-hot x f32 msg with f32 accumulation — reassociation-only
         # deviation from the sequential fold.
-        onehot = (
+        onehot_bool = (
             dst_local[:, None]
             == jax.lax.broadcasted_iota(
                 jnp.int32, (p.block_e, p.block_n), 1
             )
-        ).astype(jnp.float32)
+        )
+        if p.accum == "int8":
+            # int8 scatter on the MXU: requantize the block's messages
+            # per COLUMN (the column scale factors out of the edge sum;
+            # the one-hot operand is exact 0/1), accumulate in int32,
+            # dequantize into the f32 node accumulator.
+            ms = jnp.max(jnp.abs(msg), axis=0, keepdims=True) / 127.0
+            ms = jnp.where(ms > 0.0, ms, 1.0)
+            msg_q = jnp.clip(
+                jnp.round(msg / ms), -127.0, 127.0
+            ).astype(jnp.int8)
+            part = jax.lax.dot_general(
+                onehot_bool.astype(jnp.int8), msg_q,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return acc + part.astype(jnp.float32) * ms
         return acc + jax.lax.dot_general(
-            onehot, msg, (((0,), (0,)), ((), ())),
+            onehot_bool.astype(jnp.float32), msg,
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -282,6 +445,31 @@ def _aggregate(p: _Params, acc, msg, dst_local):
         return jax.lax.dynamic_update_slice(acc, row, (idxc, 0))
 
     return jax.lax.fori_loop(0, p.block_e, body, acc)
+
+
+def _edge_messages(p: _Params, hm, hs, src, w, wm_t, ws_t, bm_t):
+    """One edge block's masked messages [block_e, d] f32 for one etype —
+    the body shared verbatim by the per-step and fused kernels (the
+    bit-parity contract between them rides on this sharing).
+
+    hm: [n, d] message-side node table (f32, bf16, or int8);
+    hs: [n, 1] f32 per-row scales (int8 only; unused otherwise);
+    wm_t/ws_t/bm_t: this etype's transform (+ per-channel scales)."""
+    hg = jnp.take(hm, src, axis=0)  # [block_e, d] gather
+    if p.accum == "int8":
+        mm = jax.lax.dot_general(
+            hg, wm_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        sg = jnp.take(hs, src, axis=0)  # [block_e, 1]
+        msg = (mm.astype(jnp.float32) * sg * ws_t[None, :]
+               + bm_t.astype(jnp.float32))
+    else:
+        msg = jax.lax.dot_general(
+            hg, wm_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + bm_t.astype(jnp.float32)
+    return msg * w[:, None]
 
 
 def _gru(p: _Params, a, h, wih, whh, bih, bhh):
@@ -304,18 +492,15 @@ def _gru(p: _Params, a, h, wih, whh, bih, bhh):
     return (1.0 - z) * n + z * h
 
 
-def _fwd_kernel(p: _Params, bounds_ref, hm_ref, hb_ref, src_ref, dst_ref,
-                w_ref, wm_ref, bm_ref, wih_ref, whh_ref, bih_ref, bhh_ref,
-                hout_ref, aout_ref):
-    i = pl.program_id(0)
-    n0 = i * p.block_n
-    hm = hm_ref[...]  # [n, d] message-side table (f32 or bf16)
+def _block_aggregate(p: _Params, n0, hm, hs, bounds_ref, src_ref,
+                     dst_ref, w_ref, wm_ref, ws_ref, bm_ref):
+    """The full message/aggregate sweep for the node block at `n0`:
+    per-etype partials over the live edge blocks (block-diagonal skip
+    on the dst-sorted bounds), added once at the end — matches the lax
+    path's `a = a + segment_sum(msg_t)` fold association exactly (the
+    bit-parity requirement). Shared by the per-step and fused kernels."""
     acc = jnp.zeros((p.block_n, p.d), jnp.float32)
-
     for t in range(p.n_etypes):
-        # per-type partial in its own accumulator, added once at the end
-        # — matches the lax path's `a = a + segment_sum(msg_t)` fold
-        # association exactly (bit-parity requirement)
         acc_t = jnp.zeros((p.block_n, p.d), jnp.float32)
         for j in range(p.n_eb):
 
@@ -323,12 +508,9 @@ def _fwd_kernel(p: _Params, bounds_ref, hm_ref, hb_ref, src_ref, dst_ref,
                 src = src_ref[j]  # [block_e]
                 dst_local = dst_ref[j] - n0
                 w = w_ref[t, j].astype(jnp.float32)  # [block_e]
-                hg = jnp.take(hm, src, axis=0)  # [block_e, d] gather
-                msg = jax.lax.dot_general(
-                    hg, wm_ref[t], (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ) + bm_ref[t].astype(jnp.float32)
-                msg = msg * w[:, None]
+                msg = _edge_messages(
+                    p, hm, hs, src, w, wm_ref[t], ws_ref[t], bm_ref[t]
+                )
                 return _aggregate(p, acc_t, msg, dst_local)
 
             # dst-sorted edges: skip blocks whose destination range
@@ -339,6 +521,18 @@ def _fwd_kernel(p: _Params, bounds_ref, hm_ref, hb_ref, src_ref, dst_ref,
                 live, lambda a: a, acc_t,
             )
         acc = acc + acc_t
+    return acc
+
+
+def _fwd_kernel(p: _Params, bounds_ref, hm_ref, hs_ref, hb_ref, src_ref,
+                dst_ref, w_ref, wm_ref, ws_ref, bm_ref, wih_ref, whh_ref,
+                bih_ref, bhh_ref, hout_ref, aout_ref):
+    i = pl.program_id(0)
+    n0 = i * p.block_n
+    hm = hm_ref[...]  # [n, d] message-side table (f32, bf16, or int8)
+    hs = hs_ref[...]  # [n, 1] per-row scales (int8; ones otherwise)
+    acc = _block_aggregate(p, n0, hm, hs, bounds_ref, src_ref, dst_ref,
+                           w_ref, wm_ref, ws_ref, bm_ref)
 
     h = hb_ref[...]  # [block_n, d] f32 GRU state
     hout_ref[...] = _gru(
@@ -353,13 +547,13 @@ def _smem_spec():
 
 def _full(shape_len: int):
     """Constant-index full-array VMEM spec (staged once, revisited by
-    every sequential grid step)."""
+    every sequential grid step; grid-rank agnostic)."""
     zeros = (0,) * shape_len
-    return pl.BlockSpec(memory_space=pltpu.VMEM, index_map=lambda i: zeros)
+    return pl.BlockSpec(memory_space=pltpu.VMEM, index_map=lambda *_: zeros)
 
 
-def _fwd_call(p: _Params, hm, h, src2, dst2, w2, bounds, wm, bm, wih, whh,
-              bih, bhh):
+def _fwd_call(p: _Params, hm, hs, h, src2, dst2, w2, bounds, wm, ws, bm,
+              wih, whh, bih, bhh):
     block = pl.BlockSpec(
         (p.block_n, p.d), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
@@ -371,11 +565,13 @@ def _fwd_call(p: _Params, hm, h, src2, dst2, w2, bounds, wm, bm, wih, whh,
             pl.BlockSpec(
                 (p.n, p.d), lambda i: (0, 0), memory_space=pltpu.VMEM
             ),  # hm (full message table)
+            _full(2),  # hs [n, 1] per-row scales (int8; ones otherwise)
             block,  # h (GRU-state block)
             _full(2),  # src [n_eb, block_e]
             _full(2),  # dst
             _full(3),  # w [T, n_eb, block_e]
             _full(3),  # wm [T, d, d]
+            _full(2),  # ws [T, d] per-channel scales
             _full(2),  # bm [T, d]
             _full(2),  # wih [d, 3d]
             _full(2),  # whh
@@ -388,8 +584,199 @@ def _fwd_call(p: _Params, hm, h, src2, dst2, w2, bounds, wm, bm, wih, whh,
             jax.ShapeDtypeStruct((p.n, p.d), jnp.float32),
         ],
         interpret=p.interpret_arg,
-    )(bounds, hm, h, src2, dst2, w2, wm, bm, wih, whh, bih, bhh)
+    )(bounds, hm, hs, h, src2, dst2, w2, wm, ws, bm, wih, whh, bih, bhh)
     return h_out, a_out
+
+
+# ---------------------------------------------------------------------------
+# whole-unroll fused kernel (unroll="fused")
+
+
+def _fused_kernel(p: _Params, with_chain: bool, *refs):
+    """All `n_steps` GGNN steps in one kernel, grid = (step, node
+    block) with the step axis slowest: TPU grid programs run
+    sequentially, so every node block's step-`s` state write lands in
+    the ping-pong scratch before any step-`s+1` program gathers from
+    it. `h` reaches HBM once — the constant-index full-table output
+    buffer, flushed at grid end (`with_chain` additionally streams each
+    step's INPUT state out per block: the backward's only residual)."""
+    (bounds_ref, feat_ref, src_ref, dst_ref, w_ref, wm_ref, ws_ref,
+     bm_ref, wih_ref, whh_ref, bih_ref, bhh_ref) = refs[:12]
+    k = 12
+    hout_ref = refs[k]
+    k += 1
+    chain_ref = None
+    if with_chain:
+        chain_ref = refs[k]
+        k += 1
+    hbuf_ref = refs[k]  # VMEM (2, n, d) f32 ping-pong state chain
+    k += 1
+    hq_ref = hs_ref = None
+    if p.accum == "int8":
+        hq_ref, hs_ref = refs[k], refs[k + 1]
+
+    s = pl.program_id(0)  # step (slow axis)
+    i = pl.program_id(1)  # node block (fast axis)
+    n0 = i * p.block_n
+    rb = jax.lax.rem(s, 2)  # read parity; writes go to 1 - rb
+
+    @pl.when((s == 0) & (i == 0))
+    def _():
+        hbuf_ref[0] = feat_ref[...]
+
+    full = (pl.ds(rb, 1), pl.ds(0, p.n), pl.ds(0, p.d))
+    if p.accum == "int8":
+        # requantize the step's message table once per step (block 0's
+        # program; later blocks of the step reuse it — the sequential
+        # grid order makes the write-before-read exact)
+        @pl.when(i == 0)
+        def _():
+            q, sc = _quant_rows(pl.load(hbuf_ref, full)[0])
+            hq_ref[...] = q
+            hs_ref[...] = sc
+
+        hm = hq_ref[...]
+        hs = hs_ref[...]
+    else:
+        hm = pl.load(hbuf_ref, full)[0].astype(p.msg_dtype)
+        hs = None
+
+    acc = _block_aggregate(p, n0, hm, hs, bounds_ref, src_ref, dst_ref,
+                           w_ref, wm_ref, ws_ref, bm_ref)
+
+    blk = (pl.ds(rb, 1), pl.ds(n0, p.block_n), pl.ds(0, p.d))
+    h = pl.load(hbuf_ref, blk)[0]  # [block_n, d] f32 GRU state
+    if chain_ref is not None:
+        chain_ref[...] = h[None]
+    new_h = _gru(
+        p, acc, h, wih_ref[...], whh_ref[...], bih_ref[...], bhh_ref[...]
+    )
+    pl.store(
+        hbuf_ref,
+        (pl.ds(1 - rb, 1), pl.ds(n0, p.block_n), pl.ds(0, p.d)),
+        new_h[None],
+    )
+
+    @pl.when(s == p.n_steps - 1)
+    def _():
+        pl.store(hout_ref, (pl.ds(n0, p.block_n), pl.ds(0, p.d)), new_h)
+
+
+def _fused_kernel_interp(p: _Params, with_chain: bool, *refs):
+    """The fused unroll for emulation: ONE grid program per step
+    (grid = (n_steps,)), the node-block sweep unrolled statically
+    inside the body. Grid emulation copies every staged block on every
+    program, so riding the node blocks on a second grid axis would
+    re-copy the full input tables n_nb times per step; here they are
+    sliced once per step and the pre-step state table is read once.
+    Arithmetic per block is exactly `_fused_kernel`'s — the outputs are
+    bitwise equal, so the numerics contract is mode-independent.
+    Hardware keeps the 2-D grid (VMEM admission priced the per-block
+    layout, and the one-flush h_out needs the constant-index spec)."""
+    (bounds_ref, feat_ref, src_ref, dst_ref, w_ref, wm_ref, ws_ref,
+     bm_ref, wih_ref, whh_ref, bih_ref, bhh_ref) = refs[:12]
+    k = 12
+    hout_ref = refs[k]
+    k += 1
+    chain_ref = None
+    if with_chain:
+        chain_ref = refs[k]
+        k += 1
+    # no scratch: the state carries in hout_ref itself (emulation
+    # threads out blocks through the grid loop exactly like scratch,
+    # and the whole pre-step table is read as a VALUE before any
+    # write, so overwriting the carry in place is hazard-free)
+
+    s = pl.program_id(0)
+    h_tab = jax.lax.select(  # whole pre-step state, once
+        s == 0, feat_ref[...], hout_ref[...]
+    )
+    if chain_ref is not None:
+        chain_ref[...] = h_tab[None]  # the step's INPUT state plane
+    if p.accum == "int8":
+        hm, hs = _quant_rows(h_tab)
+    else:
+        hm = h_tab.astype(p.msg_dtype)
+        hs = None
+
+    new_blocks = []
+    for i in range(p.n_nb):  # static unroll: every node block
+        n0 = i * p.block_n
+        acc = _block_aggregate(p, n0, hm, hs, bounds_ref, src_ref,
+                               dst_ref, w_ref, wm_ref, ws_ref, bm_ref)
+        h = h_tab[n0:n0 + p.block_n]
+        new_blocks.append(_gru(
+            p, acc, h, wih_ref[...], whh_ref[...], bih_ref[...],
+            bhh_ref[...]
+        ))
+    new_tab = (new_blocks[0] if p.n_nb == 1
+               else jnp.concatenate(new_blocks, axis=0))
+    hout_ref[...] = new_tab  # carry; the final program's write IS h_out
+
+
+def _fused_call(p: _Params, feat, src2, dst2, w2, bounds, wm, ws, bm,
+                wih, whh, bih, bhh, *, with_chain: bool):
+    if p.interpret:
+        # emulation copies every staged block on every grid program —
+        # the interp body collapses the node-block axis into the step
+        # program (see _fused_kernel_interp), so specs lose the i axis
+        grid = (p.n_steps,)
+        kernel = functools.partial(_fused_kernel_interp, p, with_chain)
+        out_specs = [pl.BlockSpec(
+            (p.n, p.d), lambda s: (0, 0), memory_space=pltpu.VMEM
+        )]
+        chain_spec = pl.BlockSpec(
+            (1, p.n, p.d), lambda s: (s, 0, 0), memory_space=pltpu.VMEM
+        )
+    else:
+        grid = (p.n_steps, p.n_nb)
+        kernel = functools.partial(_fused_kernel, p, with_chain)
+        out_specs = [pl.BlockSpec(
+            (p.n, p.d), lambda *_: (0, 0), memory_space=pltpu.VMEM
+        )]  # h_out: full table, constant index -> one flush at grid end
+        chain_spec = pl.BlockSpec(
+            (1, p.block_n, p.d), lambda s, i: (s, i, 0),
+            memory_space=pltpu.VMEM,
+        )
+    out_shape = [jax.ShapeDtypeStruct((p.n, p.d), jnp.float32)]
+    if with_chain:
+        out_specs.append(chain_spec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((p.n_steps, p.n, p.d), jnp.float32)
+        )
+    if p.interpret:
+        # no scratch: state carries in h_out, quantization happens
+        # in-register (see _fused_kernel_interp)
+        scratch = []
+    else:
+        scratch = [pltpu.VMEM((2, p.n, p.d), jnp.float32)]
+        if p.accum == "int8":
+            scratch += [
+                pltpu.VMEM((p.n, p.d), jnp.int8),
+                pltpu.VMEM((p.n, 1), jnp.float32),
+            ]
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _smem_spec(),  # bounds [n_eb, 2]
+            _full(2),  # feat [n, d] f32 (staged once)
+            _full(2),  # src [n_eb, block_e]
+            _full(2),  # dst
+            _full(3),  # w [T, n_eb, block_e]
+            _full(3),  # wm [T, d, d]
+            _full(2),  # ws [T, d] per-channel scales
+            _full(2),  # bm [T, d]
+            _full(2),  # wih [d, 3d]
+            _full(2),  # whh
+            _full(2),  # bih [1, 3d]
+            _full(2),  # bhh
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=p.interpret_arg,
+    )(bounds, feat, src2, dst2, w2, wm, ws, bm, wih, whh, bih, bhh)
 
 
 # ---------------------------------------------------------------------------
@@ -549,12 +936,27 @@ def _step(p: _Params, wm, bm, wih, whh, bih, bhh, h, src2, dst2, w2,
     return h_out
 
 
+def _msg_weight_operands(p: _Params, wm):
+    """The message-transform operand pair the kernels consume: the
+    (possibly quantized) kernel plus its per-channel scales (exact ones
+    outside int8 — loaded but algebraically inert)."""
+    if p.accum == "int8":
+        return _quant_wm(wm)
+    return (wm.astype(p.msg_dtype),
+            jnp.ones((p.n_etypes, p.d), jnp.float32))
+
+
 def _step_fwd_call(p, wm, bm, wih, whh, bih, bhh, h, src2, dst2, w2,
                    bounds):
-    hm = h.astype(p.msg_dtype)
-    wm_msg = wm.astype(p.msg_dtype)
+    if p.accum == "int8":
+        hm, hs = _quant_rows(h)
+    else:
+        hm = h.astype(p.msg_dtype)
+        hs = jnp.ones((p.n, 1), jnp.float32)
+    wm_msg, ws = _msg_weight_operands(p, wm)
     return _fwd_call(
-        p, hm, h, src2, dst2, w2, bounds, wm_msg, bm, wih, whh, bih, bhh
+        p, hm, hs, h, src2, dst2, w2, bounds, wm_msg, ws, bm, wih, whh,
+        bih, bhh
     )
 
 
@@ -600,6 +1002,70 @@ _step.defvjp(_step_fwd, _step_bwd)
 
 
 # ---------------------------------------------------------------------------
+# the custom_vjp'd whole unroll (unroll="fused")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _unroll(p: _Params, wm, bm, wih, whh, bih, bhh, feat, src2, dst2,
+            w2, bounds, src_sorted, dstp2, wp2):
+    wm_msg, ws = _msg_weight_operands(p, wm)
+    (h_out,) = _fused_call(p, feat, src2, dst2, w2, bounds, wm_msg, ws,
+                           bm, wih, whh, bih, bhh, with_chain=False)
+    return h_out
+
+
+def _unroll_fwd(p, wm, bm, wih, whh, bih, bhh, feat, src2, dst2, w2,
+                bounds, src_sorted, dstp2, wp2):
+    wm_msg, ws = _msg_weight_operands(p, wm)
+    h_out, chain = _fused_call(p, feat, src2, dst2, w2, bounds, wm_msg,
+                               ws, bm, wih, whh, bih, bhh,
+                               with_chain=True)
+    # the SINGLE residual set of the whole unroll: each step's input
+    # state (chain[s]), streamed from the VMEM-resident ping-pong by
+    # the chain-emitting forward variant; gates and aggregates are
+    # recomputed per step in the backward (the per-step remat choice,
+    # applied across the unroll)
+    res = (wm, bm, wih, whh, bih, bhh, chain, src2, dst2, w2, bounds,
+           src_sorted, dstp2, wp2)
+    return h_out, res
+
+
+def _unroll_bwd(p: _Params, res, g):
+    (wm, bm, wih, whh, bih, bhh, chain, src2, dst2, w2, bounds,
+     src_sorted, dstp2, wp2) = res
+    dwm = jnp.zeros_like(wm)
+    dbm = jnp.zeros_like(bm)
+    dwih = jnp.zeros_like(wih)
+    dwhh = jnp.zeros_like(whh)
+    dbih = jnp.zeros_like(bih)
+    dbhh = jnp.zeros_like(bhh)
+    dh = g
+    # reverse sweep over the chain: recompute step s's aggregate with
+    # the per-step forward kernel (its GRU output is dead code), then
+    # ride the whole per-step backward — the param cotangents sum
+    # across steps, the state cotangent chains backwards
+    for s in reversed(range(p.n_steps)):
+        h_s = chain[s]
+        _, a_s = _step_fwd_call(p, wm, bm, wih, whh, bih, bhh, h_s,
+                                src2, dst2, w2, bounds)
+        res_s = (wm, bm, wih, whh, bih, bhh, h_s, a_s, src2, dst2, w2,
+                 src_sorted, dstp2, wp2)
+        grads = _step_bwd(p, res_s, dh)
+        dwm = dwm + grads[0]
+        dbm = dbm + grads[1]
+        dwih = dwih + grads[2]
+        dwhh = dwhh + grads[3]
+        dbih = dbih + grads[4]
+        dbhh = dbhh + grads[5]
+        dh = grads[6]
+    return (dwm, dbm, dwih, dwhh, dbih, dbhh, dh,
+            None, None, None, None, None, None, None)
+
+
+_unroll.defvjp(_unroll_fwd, _unroll_bwd)
+
+
+# ---------------------------------------------------------------------------
 # public entry point
 
 
@@ -621,6 +1087,7 @@ def ggnn_propagate(
     scan_steps: bool = False,
     scatter: str = "auto",
     accum: str = "fp32",
+    unroll: str = "per_step",
     block_nodes: int = 0,
     block_edges: int = 0,
     interpret: str | bool = "auto",
@@ -633,8 +1100,13 @@ def ggnn_propagate(
     the backward's sorted scatter rides — is pure integer work traced
     once per batch signature and shared by all steps AND by the
     backward pass.
+
+    ``unroll="fused"`` runs the whole step loop inside ONE kernel with
+    the state chain VMEM-resident (module docstring), admitted by
+    `resolve_unroll`'s residency/scan checks and falling back to
+    per-step LOUDLY otherwise.
     """
-    if accum not in ("fp32", "bf16"):
+    if accum not in ("fp32", "bf16", "int8"):
         raise ValueError(f"unknown ggnn_kernel accum {accum!r}")
     n, d = feat.shape
     e = edge_src.shape[0]
@@ -651,11 +1123,18 @@ def ggnn_propagate(
             f"modes relax this — set model.ggnn_kernel=false or use a "
             f"128-aligned feature width"
         )
+    unroll_eff, fallback_why = resolve_unroll(
+        unroll, n=n, d=d, n_steps=n_steps, accum=accum,
+        scan_steps=scan_steps,
+    )
+    if unroll == "fused" and unroll_eff != "fused":
+        _note_fused_fallback(fallback_why)
     p = _Params(
         n=n, e=e, d=d, block_n=block_n, block_e=block_e,
         n_etypes=n_etypes, accum=accum,
         scatter=resolve_scatter(scatter),
         interpret=interp,
+        unroll=unroll_eff, n_steps=n_steps,
     )
     _note_lowering(p)
 
@@ -693,6 +1172,9 @@ def ggnn_propagate(
 
     if n_steps == 0:
         return feat
+    if p.unroll == "fused":
+        return _unroll(p, *args, feat, src2, dst2, w2, bounds,
+                       src_sorted, dstp2, wp2)
     h = step(feat)
     if scan_steps and n_steps > 1:
         h, _ = jax.lax.scan(

@@ -215,7 +215,8 @@ class GatedGraphConv(nn.Module):
     #: the lax path under jit (docs/ggnn_kernel.md numerics contract).
     use_kernel: bool = False
     kernel_scatter: str = "auto"  # auto | fold | mxu
-    kernel_accum: str = "fp32"  # fp32 | bf16 message-side policy
+    kernel_accum: str = "fp32"  # fp32 | bf16 | int8 message-side policy
+    kernel_unroll: str = "per_step"  # per_step | fused (whole unroll)
     kernel_block_nodes: int = 0  # 0 = auto from the node budget
     kernel_block_edges: int = 0  # 0 = auto from the edge budget
     kernel_interpret: str | bool = "auto"  # auto | False | legacy | tpu
@@ -281,6 +282,7 @@ class GatedGraphConv(nn.Module):
                 scan_steps=self.scan_steps,
                 scatter=self.kernel_scatter,
                 accum=self.kernel_accum,
+                unroll=self.kernel_unroll,
                 block_nodes=self.kernel_block_nodes,
                 block_edges=self.kernel_block_edges,
                 interpret=self.kernel_interpret,

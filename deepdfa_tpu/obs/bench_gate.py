@@ -68,6 +68,11 @@ LOWER_IS_BETTER: dict[str, float] = {
     # fused GGNN per-step time (ISSUE 9; us/step, platform-resolved
     # kernel scatter) — a rise past tolerance is a hot-path regression
     "ggnn_step_us": 0.25,
+    # the whole-unroll fusion's per-step time (ISSUE 16: all n_steps
+    # inside ONE pallas_call, node state VMEM-resident) — gated
+    # separately from ggnn_step_us so the fusion's margin over the
+    # per-step kernel chain is a tracked number, not a one-off claim
+    "ggnn_unroll_step_us": 0.25,
     # serving fleet under overload (ISSUE 11, scripts/bench_load.py via
     # bench.py --child-fleet behind DEEPDFA_BENCH_FLEET): p99 latency of
     # ADMITTED requests while the open-loop generator overloads the
@@ -124,6 +129,12 @@ ABSOLUTE_UPPER_BOUNDS: dict[str, float] = {
     # creeping compile storm: an ABSOLUTE ceiling on the measured
     # search wall time the bench child stamps (ISSUE 15)
     "tune_search_seconds": 300.0,
+    # int8 MXU activations ride under a drift ADMISSION contract, not a
+    # trajectory tolerance: the bench child's measured rel-err vs the
+    # lax fp32 reference must stay inside the bound in every round
+    # (mirrors nn/ggnn_kernel.py:INT8_DRIFT_BOUND — this module must
+    # stay importable without jax; the pair is pinned equal in tests)
+    "ggnn_kernel_int8_rel_err": 0.05,
 }
 
 
@@ -756,6 +767,29 @@ def gate_tuned(
         new_kernel = rec.get("kernel") or {}
         ref_kernel = rrec.get("kernel") or {}
         for sig in sorted(set(new_kernel) & set(ref_kernel)):
+            # variant axes ride the winner row (winner_scatter since
+            # ISSUE 15; winner_accum/winner_unroll since ISSUE 16 —
+            # absent on older rounds, where per_step/fp32 was the only
+            # mode timed): a flip between rounds is WORTH A NOTE (the
+            # search changed its mind about the layout family) but
+            # never a failure — the step-time check below is the
+            # arbiter of whether the new winner is actually better
+            for axis, default in (
+                ("winner_scatter", None),
+                ("winner_accum", "fp32"),
+                ("winner_unroll", "per_step"),
+            ):
+                new_a = (new_kernel[sig] or {}).get(axis, default)
+                ref_a = (ref_kernel[sig] or {}).get(axis, default)
+                if (
+                    isinstance(new_a, str)
+                    and isinstance(ref_a, str)
+                    and new_a != ref_a
+                ):
+                    notes.append(
+                        f"{hw_label}/kernel/{sig}: {axis} flipped "
+                        f"{ref_a!r} -> {new_a!r} vs {ref['source']}"
+                    )
             new_v = (new_kernel[sig] or {}).get("winner_step_us")
             ref_v = (ref_kernel[sig] or {}).get("winner_step_us")
             if not isinstance(new_v, (int, float)) or not isinstance(

@@ -649,6 +649,12 @@ def tuning_section(run_dir: Path) -> dict:
                         "candidate": row.get("candidate"),
                         "step_us": row.get("step_us"),
                         "ok": (row.get("numerics") or {}).get("ok"),
+                        # the variant axes (scatter since ISSUE 15,
+                        # accum/unroll since ISSUE 16; absent on older
+                        # records → None, renderer omits the column)
+                        "scatter": row.get("scatter"),
+                        "accum": row.get("accum"),
+                        "unroll": row.get("unroll"),
                     }
                     for row in (sr.get("candidates") or [])
                     if isinstance(row, dict)
@@ -1188,10 +1194,18 @@ def render_text(report: dict, out=sys.stdout) -> None:
                         cands, key=lambda c: c["step_us"]
                     ):
                         mark = "✗" if c.get("ok") is False else " "
+                        # explicit axis columns next to the encoded
+                        # label (old records carry no axis fields —
+                        # the tail is simply empty then)
+                        axes = "".join(
+                            f" {k}={c[k]}"
+                            for k in ("scatter", "accum", "unroll")
+                            if isinstance(c.get(k), str)
+                        )
                         w(
-                            f"      {c['candidate']:<26}"
+                            f"      {c['candidate']:<32}"
                             f"{_bar(c['step_us'] / slowest, 20)} "
-                            f"{c['step_us']:9.2f}us{mark}\n"
+                            f"{c['step_us']:9.2f}us{mark}{axes}\n"
                         )
             for name, lr in (rec.get("ladders") or {}).items():
                 # a damaged/hand-edited record may miss waste fields;
@@ -1554,9 +1568,10 @@ def build_smoke_run(run_dir: Path) -> Path:
     }))
     # a tuned.json through the REAL search emitters (deepdfa_tpu/tune/,
     # docs/tuning.md): a minimal but genuine candidate search — two
-    # layouts compiled, timed, verdict-checked — plus the skewed-
-    # distribution ladder fits, persisted by the real cache writer;
-    # what the diag tuning section renders
+    # layouts compiled, timed, verdict-checked, one of them off the
+    # per-step/fp32 defaults so the unroll/accum axis columns render —
+    # plus the skewed-distribution ladder fits, persisted by the real
+    # cache writer; what the diag tuning section renders
     from deepdfa_tpu.tune import driver as tune_driver
     from deepdfa_tpu.tune import kernel as tune_kernel
 
@@ -1565,7 +1580,7 @@ def build_smoke_run(run_dir: Path) -> Path:
         reps=1,
         kernel_candidates=(
             tune_kernel.Candidate(64, 128),
-            tune_kernel.Candidate(256, 512),
+            tune_kernel.Candidate(256, 512, "fold", "fp32", "fused"),
         ),
     )
     # a postmortem through the REAL flight recorder (obs/flight.py):

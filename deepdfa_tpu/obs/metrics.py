@@ -278,7 +278,12 @@ SCHEMA: tuple[str, ...] = (
     # trace-time lowering census per batch signature — both the obs
     # registry mirror and the epoch-record blob train loops embed when
     # model.ggnn_kernel is on (signature labels are data-dependent, so
-    # this is a reviewed wildcard like obs/compile/signatures/*)
+    # this is a reviewed wildcard like obs/compile/signatures/*) —
+    # plus the whole-unroll fusion's admission counter
+    # (ggnn_kernel/fused_fallbacks: a fused request resolved to
+    # per_step because the VMEM residency check or the scan_steps
+    # gradient policy said no — the layout knob asked for something
+    # the kernel refused, which the counter makes loud)
     "ggnn_kernel/*", "obs/ggnn_kernel/*",
     # measured roofline ceilings (eval/profiling.py probes — matmul
     # TFLOP/s, stream + gather GB/s): every probe mirrors its scalar

@@ -60,7 +60,7 @@ class RegistryError(RuntimeError):
 #: scope, is untouched.
 _LAYOUT_ONLY_MODEL_KEYS = (
     "ggnn_kernel_block_nodes", "ggnn_kernel_block_edges",
-    "ggnn_kernel_scatter", "ggnn_kernel_accum",
+    "ggnn_kernel_scatter", "ggnn_kernel_accum", "ggnn_kernel_unroll",
 )
 
 #: data knobs equally excluded: sequence-bucket edges shape PADDING

@@ -379,6 +379,13 @@ class ScoringService:
                 info["ggnn_kernel_signatures"] = (
                     _ggnn_kernel.signature_stats()
                 )
+                # the serving unroll mode (per_step | fused) — a fused
+                # config that fell back reports its REQUEST here and
+                # the fallback in ggnn_kernel/fused_fallbacks
+                info["ggnn_kernel_unroll"] = getattr(
+                    self.registry.cfg.model, "ggnn_kernel_unroll",
+                    "per_step",
+                )
         if self.localizer is not None:
             info["lines_method"] = self.localizer.method
         if self.tuned is not None:

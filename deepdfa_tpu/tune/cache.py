@@ -211,6 +211,25 @@ def validate_tuned(doc: Any) -> dict:
                         continue
                     n_candidates += 1
                     labels.add(row.get("candidate"))
+                    # Axis values are optional (pre-PR-16 rows carry
+                    # neither accum nor unroll) but when present they
+                    # must name a mode this codebase can replay.
+                    if "accum" in row and row["accum"] not in (
+                        "fp32", "bf16", "int8",
+                    ):
+                        problems.append(
+                            f"{sw}.candidates[{ci}]"
+                            f"[{row.get('candidate')}]: unknown accum "
+                            f"{row['accum']!r}"
+                        )
+                    if "unroll" in row and row["unroll"] not in (
+                        "per_step", "fused",
+                    ):
+                        problems.append(
+                            f"{sw}.candidates[{ci}]"
+                            f"[{row.get('candidate')}]: unknown unroll "
+                            f"{row['unroll']!r}"
+                        )
                     if "skipped" in row or "error" in row:
                         continue  # never timed: no verdict to carry
                     verdict = row.get("numerics")
@@ -402,11 +421,13 @@ def kernel_layout_from(
     record: dict | None, n: int, e: int, d: int
 ) -> dict | None:
     """The WHOLE winning layout for one signature — blocks AND
-    scatter/accum, or None (an absent signature is a defaults case).
-    The search timed the four axes jointly (Morphling-style variant
-    selection), so a consumer must apply all of them together: blocks
-    from a fold winner under an auto-resolved mxu scatter would be a
-    layout nobody ever measured."""
+    scatter/accum/unroll, or None (an absent signature is a defaults
+    case). The search timed the five axes jointly (Morphling-style
+    variant selection), so a consumer must apply all of them together:
+    blocks from a fold winner under an auto-resolved mxu scatter would
+    be a layout nobody ever measured. Pre-PR-16 records carry no
+    `winner_unroll`; the key is simply absent then (per_step was the
+    only mode those searches timed)."""
     if not record:
         return None
     sr = (record.get("kernel") or {}).get(f"{n}x{e}x{d}")
@@ -420,6 +441,8 @@ def kernel_layout_from(
         out["scatter"] = sr["winner_scatter"]
     if isinstance(sr.get("winner_accum"), str):
         out["accum"] = sr["winner_accum"]
+    if isinstance(sr.get("winner_unroll"), str):
+        out["unroll"] = sr["winner_unroll"]
     return out
 
 
@@ -470,6 +493,11 @@ def apply_to_config(
                 overrides.append(
                     "model.ggnn_kernel_accum="
                     + json.dumps(layout["accum"])
+                )
+            if "unroll" in layout:
+                overrides.append(
+                    "model.ggnn_kernel_unroll="
+                    + json.dumps(layout["unroll"])
                 )
     if "seq_buckets" in sections:
         edges = seq_edges_from(rec)

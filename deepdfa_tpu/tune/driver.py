@@ -31,7 +31,8 @@ logger = logging.getLogger(__name__)
 #: the smoke's reduced search space: tiny budgets (d=32 relaxes the
 #: lane rule under the interpreter), a handful of candidates bracketing
 #: the auto-picked blocks, fold + one mxu row so both scatter modes
-#: carry verdicts
+#: carry verdicts, plus one fused-unroll and one int8 row per scatter
+#: so every search axis lands a measured, verdict-bearing smoke row
 SMOKE_BUDGETS = (256, 512, 32)
 SMOKE_CANDIDATES = (
     tune_kernel.Candidate(64, 128),
@@ -39,6 +40,9 @@ SMOKE_CANDIDATES = (
     tune_kernel.Candidate(256, 128),
     tune_kernel.Candidate(256, 512),
     tune_kernel.Candidate(256, 512, "mxu"),
+    tune_kernel.Candidate(256, 512, "fold", "fp32", "fused"),
+    tune_kernel.Candidate(256, 512, "fold", "int8"),
+    tune_kernel.Candidate(256, 512, "mxu", "int8"),
 )
 
 

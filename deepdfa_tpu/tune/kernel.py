@@ -4,21 +4,27 @@
 `nn/ggnn_kernel.py` hand-pins 256-node/512-edge tiles at the flagship
 shape. This module replaces the hand-pin with measurement:
 
-1. **Enumerate** legal (block_n, block_e, scatter, accum) candidates per
-   GGNN batch signature. Legality is checked BEFORE any compile:
-   divisibility (the kernel's reshape contract), the TPU sublane
-   alignment (f32 tiles are 8 x 128, docs/ggnn_kernel.md), and a VMEM
-   working-set estimate against the ~16 MB/core budget — an illegal
-   layout costs a pruned-row entry, never a Mosaic error.
+1. **Enumerate** legal (block_n, block_e, scatter, accum, unroll)
+   candidates per GGNN batch signature. Legality is checked BEFORE any
+   compile: divisibility (the kernel's reshape contract), the TPU
+   sublane alignment (f32 tiles are 8 x 128, docs/ggnn_kernel.md), and
+   a VMEM working-set estimate against the ~16 MB/core budget — for
+   `unroll="fused"` the estimate carries the x n_steps state-chain
+   residency term (ping-ponged to 2 resident tables + the full output
+   buffer; `nn/ggnn_kernel.py:fused_residency_bytes`). An illegal
+   layout costs a pruned-row entry naming its reason, never a Mosaic
+   error.
 2. **Compile-and-time** each survivor through the SAME AOT
    lower()->compile() path the serve executors use, with interleaved
    best-of-reps timing (candidates alternate within each rep round so a
    drifting box biases nobody; the best window is kept — the PR-4/PR-10
    overhead-measurement rule).
 3. **Assert the PR-8 numerics contract on every candidate** — fold/fp32
-   must be BIT-IDENTICAL to the jitted lax path, mxu within 1e-5, bf16
-   within 5e-2 — and record the verdict on the candidate row. A
-   candidate outside its tolerance can never win, no matter how fast.
+   must be BIT-IDENTICAL to the jitted lax path (per-step AND fused
+   unroll: same math, same order), mxu within 1e-5, bf16 within 5e-2,
+   int8 within its admission drift bound — and record the verdict on
+   the candidate row. A candidate outside its tolerance can never win,
+   no matter how fast.
 4. **Pick by measured step time**, with `mfu_vs_measured_ceiling`
    recorded against the docs/roofline.md measured matmul ceiling so the
    winner's roofline position rides in tuned.json next to its time.
@@ -40,17 +46,29 @@ DEFAULT_VMEM_LIMIT_BYTES = 16 * 2**20
 #: vs the jitted lax path, keyed by (scatter, accum). fold/fp32 is
 #: bit-identical BY CONSTRUCTION (the sequential left fold is exactly
 #: XLA's sorted segment_sum update order), so its tolerance is zero.
+#: accum="int8" rung: mirrors nn/ggnn_kernel.py:INT8_DRIFT_BOUND (the
+#: single declaration next to the kernel; pinned equal in tests so this
+#: numpy-light module never imports the jax-heavy nn layer)
+INT8_TOLERANCE = 5e-2
+
 DEFAULT_TOLERANCES: dict[tuple[str, str], float] = {
     ("fold", "fp32"): 0.0,
     ("mxu", "fp32"): 1e-5,
     ("fold", "bf16"): 5e-2,
     ("mxu", "bf16"): 5e-2,
+    ("fold", "int8"): INT8_TOLERANCE,
+    ("mxu", "int8"): INT8_TOLERANCE,
 }
 
 #: default block-size grids (multiples of the f32 sublane, bracketing
 #: the PR-8 hand-picked 256/512 tiles from both sides)
 DEFAULT_BLOCK_NODES = (64, 128, 256, 512)
 DEFAULT_BLOCK_EDGES = (128, 256, 512, 1024)
+
+#: the PR-16 candidate axes: message-side dtype policy and step-loop
+#: placement (docs/ggnn_kernel.md), enumerated jointly with the tiles
+DEFAULT_ACCUMS = ("fp32", "bf16", "int8")
+DEFAULT_UNROLLS = ("per_step", "fused")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,13 +78,18 @@ class Candidate:
     block_n: int
     block_e: int
     scatter: str = "fold"  # fold | mxu
-    accum: str = "fp32"  # fp32 | bf16
+    accum: str = "fp32"  # fp32 | bf16 | int8
+    unroll: str = "per_step"  # per_step | fused
 
     @property
     def label(self) -> str:
+        # the "-fused" suffix appears ONLY off the default so every
+        # pre-PR-16 label (committed TUNED_r* rows, gate references,
+        # diag renders) keeps meaning the layout it always named
+        suffix = "" if self.unroll == "per_step" else f"-{self.unroll}"
         return (
             f"bn{self.block_n}-be{self.block_e}-"
-            f"{self.scatter}-{self.accum}"
+            f"{self.scatter}-{self.accum}{suffix}"
         )
 
     def as_dict(self) -> dict:
@@ -76,18 +99,21 @@ class Candidate:
             "block_e": self.block_e,
             "scatter": self.scatter,
             "accum": self.accum,
+            "unroll": self.unroll,
         }
 
 
 def estimate_vmem_bytes(
-    n: int, e: int, d: int, cand: Candidate, n_etypes: int = 1
+    n: int, e: int, d: int, cand: Candidate, n_etypes: int = 1,
+    n_steps: int = 1,
 ) -> int:
     """Working-set estimate for one fused-step grid program, mirroring
-    the BlockSpecs in `nn/ggnn_kernel.py:_fwd_call`: the full message
-    table + edge index/weight arrays are staged whole, per-block state
-    and temporaries ride on top. Deliberately a slight over-estimate
-    (double-buffering headroom is the compiler's business, not ours)."""
-    msg_bytes = 2 if cand.accum == "bf16" else 4
+    the BlockSpecs in `nn/ggnn_kernel.py:_fwd_call` (and `_fused_call`
+    for ``unroll="fused"``): the full message table + edge index/weight
+    arrays are staged whole, per-block state and temporaries ride on
+    top. Deliberately a slight over-estimate (double-buffering headroom
+    is the compiler's business, not ours)."""
+    msg_bytes = {"bf16": 2, "int8": 1}.get(cand.accum, 4)
     total = n * d * msg_bytes  # hm message table (full)
     total += 3 * cand.block_n * d * 4  # h block + hout + aout blocks
     total += 2 * e * 4  # src2 + dst2 (full [n_eb, block_e])
@@ -95,8 +121,26 @@ def estimate_vmem_bytes(
     total += n_etypes * d * d * msg_bytes + n_etypes * d * 4  # wm + bm
     total += 2 * d * 3 * d * 4 + 2 * 3 * d * 4  # GRU weights + biases
     total += 2 * cand.block_e * d * 4  # gather + message temporaries
+    if cand.accum == "int8":
+        # dequant scale vectors (per-row + per-channel)
+        total += n * 4 + n_etypes * d * 4
     if cand.scatter == "mxu":
         total += cand.block_e * cand.block_n * 4  # the one-hot block
+    if getattr(cand, "unroll", "per_step") == "fused":
+        # the x n_steps residency term: the whole-unroll kernel keeps
+        # the inter-step state chain in VMEM. The per-step message
+        # table is NOT staged (messages read the resident chain); in
+        # its place sit feat (staged once, f32), the ping-pong chain
+        # (min(n_steps + 1, 2) resident tables — each step reads one
+        # parity and writes the other), and the constant-index full
+        # output buffer. int8 re-quantizes in-kernel into a shadow
+        # table (its scales are already counted above).
+        total -= n * d * msg_bytes
+        total += n * d * 4  # feat, staged once
+        resident_states = min(int(n_steps) + 1, 2)
+        total += (resident_states + 1) * n * d * 4
+        if cand.accum == "int8":
+            total += n * d  # quantized shadow of the resident table
     return int(total)
 
 
@@ -108,12 +152,17 @@ def enumerate_candidates(
     block_nodes: Sequence[int] = DEFAULT_BLOCK_NODES,
     block_edges: Sequence[int] = DEFAULT_BLOCK_EDGES,
     scatters: Sequence[str] = ("fold", "mxu"),
-    accums: Sequence[str] = ("fp32",),
+    accums: Sequence[str] = DEFAULT_ACCUMS,
+    unrolls: Sequence[str] = DEFAULT_UNROLLS,
+    n_steps: int = 1,
     vmem_limit_bytes: int = DEFAULT_VMEM_LIMIT_BYTES,
 ) -> tuple[list[Candidate], list[dict]]:
     """(survivors, pruned) for one signature. Every pruned layout keeps
     a row naming its reason, so the search record shows what was ruled
-    out and why — the divisibility + VMEM bound applied BEFORE compile."""
+    out and why — the divisibility + VMEM bound applied BEFORE compile.
+    `n_steps` feeds the fused unroll's state-chain residency term, so a
+    fused candidate that cannot keep the chain resident is pruned here
+    with the residency named, never compiled."""
     survivors: list[Candidate] = []
     pruned: list[dict] = []
     seen: set[Candidate] = set()
@@ -121,42 +170,53 @@ def enumerate_candidates(
         for be in block_edges:
             for scatter in scatters:
                 for accum in accums:
-                    cand = Candidate(int(bn), int(be), scatter, accum)
-                    if cand in seen:
-                        continue
-                    seen.add(cand)
-                    reason = None
-                    if n % cand.block_n:
-                        reason = (
-                            f"block_n {cand.block_n} does not divide "
-                            f"node budget {n}"
+                    for unroll in unrolls:
+                        cand = Candidate(
+                            int(bn), int(be), scatter, accum, unroll
                         )
-                    elif e % cand.block_e:
-                        reason = (
-                            f"block_e {cand.block_e} does not divide "
-                            f"edge budget {e}"
-                        )
-                    elif cand.block_n % 8 or cand.block_e % 8:
-                        # f32 sublane alignment (8 x 128 tiles)
-                        reason = (
-                            f"blocks ({cand.block_n}, {cand.block_e}) "
-                            f"not sublane-aligned (x8)"
-                        )
-                    else:
-                        vmem = estimate_vmem_bytes(
-                            n, e, d, cand, n_etypes
-                        )
-                        if vmem > vmem_limit_bytes:
+                        if cand in seen:
+                            continue
+                        seen.add(cand)
+                        reason = None
+                        if n % cand.block_n:
                             reason = (
-                                f"VMEM estimate {vmem} > limit "
-                                f"{vmem_limit_bytes}"
+                                f"block_n {cand.block_n} does not "
+                                f"divide node budget {n}"
                             )
-                    if reason is None:
-                        survivors.append(cand)
-                    else:
-                        pruned.append(
-                            {**cand.as_dict(), "reason": reason}
-                        )
+                        elif e % cand.block_e:
+                            reason = (
+                                f"block_e {cand.block_e} does not "
+                                f"divide edge budget {e}"
+                            )
+                        elif cand.block_n % 8 or cand.block_e % 8:
+                            # f32 sublane alignment (8 x 128 tiles)
+                            reason = (
+                                f"blocks ({cand.block_n}, "
+                                f"{cand.block_e}) not sublane-aligned "
+                                f"(x8)"
+                            )
+                        else:
+                            vmem = estimate_vmem_bytes(
+                                n, e, d, cand, n_etypes, n_steps
+                            )
+                            if vmem > vmem_limit_bytes:
+                                reason = (
+                                    f"VMEM estimate {vmem} > limit "
+                                    f"{vmem_limit_bytes}"
+                                )
+                                if cand.unroll == "fused":
+                                    reason = (
+                                        "fused unroll residency: "
+                                        + reason
+                                        + f" (state chain resident "
+                                        f"across {n_steps} steps)"
+                                    )
+                        if reason is None:
+                            survivors.append(cand)
+                        else:
+                            pruned.append(
+                                {**cand.as_dict(), "reason": reason}
+                            )
     return survivors, pruned
 
 
@@ -264,7 +324,8 @@ def search_kernel(
 
         if candidates is None:
             cands, pruned = enumerate_candidates(
-                n, e, d, n_etypes=n_etypes, **enumerate_kw
+                n, e, d, n_etypes=n_etypes, n_steps=n_steps,
+                **enumerate_kw
             )
         else:
             cands, pruned = list(candidates), []
@@ -283,6 +344,7 @@ def search_kernel(
                 use_kernel=True,
                 kernel_scatter=cand.scatter,
                 kernel_accum=cand.accum,
+                kernel_unroll=cand.unroll,
                 kernel_block_nodes=cand.block_n,
                 kernel_block_edges=cand.block_e,
                 kernel_interpret=interpret,
@@ -291,7 +353,7 @@ def search_kernel(
             row = {
                 **cand.as_dict(),
                 "vmem_bytes_est": estimate_vmem_bytes(
-                    n, e, d, cand, n_etypes
+                    n, e, d, cand, n_etypes, n_steps
                 ),
             }
             t0 = time.perf_counter()
@@ -379,6 +441,7 @@ def search_kernel(
             rec["winner_block_e"] = winner["block_e"]
             rec["winner_scatter"] = winner["scatter"]
             rec["winner_accum"] = winner["accum"]
+            rec["winner_unroll"] = winner.get("unroll", "per_step")
             if "mfu_vs_measured_ceiling" in winner:
                 rec["winner_mfu_vs_measured_ceiling"] = winner[
                     "mfu_vs_measured_ceiling"

@@ -161,10 +161,15 @@ def bench_ggnn_step(
     LOWER IS BETTER; `ggnn_lax_step_us` the production lax chain;
     `ggnn_mfu` the lax path's achieved FLOP/s against the same-window
     measured matmul ceiling (and `ggnn_kernel_mfu` the kernel's);
-    `ggnn_bytes_vs_gather_ceiling` the bandwidth side of the roofline.
-    Numerics are asserted, not assumed: fold must be BIT-IDENTICAL to
-    lax, mxu within f32 reassociation tolerance, bf16 within the
-    documented policy bound.
+    `ggnn_bytes_vs_gather_ceiling` the bandwidth side of the roofline;
+    `ggnn_unroll_step_us` the WHOLE-UNROLL fusion (all steps in one
+    pallas_call, h VMEM-resident) with `ggnn_unroll_speedup` vs the
+    per-step kernel chain; `ggnn_kernel_int8_step_us` the int8-MXU
+    variant. Numerics are asserted, not assumed: fold must be
+    BIT-IDENTICAL to lax (fused unroll included), mxu within f32
+    reassociation tolerance, bf16/int8 within the documented policy
+    bounds. Each variant fails in isolation (`ggnn_<name>_error`) —
+    a Mosaic gap in one never costs the record.
     """
     import jax
     import jax.numpy as jnp
@@ -195,6 +200,15 @@ def bench_ggnn_step(
         "kernel_bf16": variant(
             use_kernel=True, kernel_scatter="mxu", kernel_accum="bf16"
         ),
+        # the whole-unroll fusion: every step inside ONE pallas_call,
+        # h resident in VMEM — platform-resolved scatter so the fp32
+        # bit-identity contract is asserted off-TPU (fold)
+        "kernel_unroll": variant(use_kernel=True, kernel_unroll="fused"),
+        # int8 activations on the MXU path under the drift admission
+        # bound (nn/ggnn_kernel.py:INT8_DRIFT_BOUND)
+        "kernel_int8": variant(
+            use_kernel=True, kernel_scatter="mxu", kernel_accum="int8"
+        ),
     }
     want = None
     rec: dict = {
@@ -223,16 +237,30 @@ def bench_ggnn_step(
         err = float(np.abs(out - want).max() / (np.abs(want).max() + 1e-9))
         # the numerics contract rides along with every measurement
         # (docs/ggnn_kernel.md): fold is bit-identical, mxu is f32
-        # reassociation-only, bf16 is the documented policy bound
-        tol = {"kernel_bf16": 0.05, "kernel_mxu": 1e-5}.get(name, 1e-5)
+        # reassociation-only, bf16/int8 are the documented policy
+        # bounds (int8 mirrors nn/ggnn_kernel.py:INT8_DRIFT_BOUND,
+        # pinned in tests)
+        tol = {"kernel_bf16": 0.05, "kernel_int8": 0.05,
+               "kernel_mxu": 1e-5}.get(name, 1e-5)
         ok = bool(err <= tol)
-        key = "ggnn_step_us" if name == "kernel" else f"ggnn_{name}_step_us"
+        key = {
+            "kernel": "ggnn_step_us",
+            # the gate-tracked name for the fused unroll's per-step
+            # time (obs/bench_gate.py:LOWER_IS_BETTER)
+            "kernel_unroll": "ggnn_unroll_step_us",
+        }.get(name, f"ggnn_{name}_step_us")
         rec[key] = round(us, 2)
         rec[f"ggnn_{name}_rel_err"] = round(err, 8)
         rec[f"ggnn_{name}_ok"] = ok
     if rec.get("ggnn_step_us") and rec.get("ggnn_lax_step_us"):
         rec["ggnn_kernel_speedup"] = round(
             rec["ggnn_lax_step_us"] / rec["ggnn_step_us"], 3
+        )
+    if rec.get("ggnn_step_us") and rec.get("ggnn_unroll_step_us"):
+        # >1 means one fused pallas_call over all steps beats the
+        # per-step kernel chain it replaces
+        rec["ggnn_unroll_speedup"] = round(
+            rec["ggnn_step_us"] / rec["ggnn_unroll_step_us"], 3
         )
 
     # MFU against the MEASURED same-window ceiling (spec peaks mislead
@@ -295,13 +323,21 @@ def run_smoke() -> dict:
 
     if jax.devices()[0].platform != "tpu":
         # "auto" resolves to the fold scatter off-TPU: bit-identity is
-        # the contract, not a tolerance
-        if rec.get("ggnn_kernel_rel_err") != 0.0:
-            raise AssertionError(
-                f"fold kernel not bit-identical to lax: rel_err="
-                f"{rec.get('ggnn_kernel_rel_err')}"
-            )
-    for name in ("kernel", "kernel_mxu", "kernel_bf16"):
+        # the contract, not a tolerance — for the fused unroll too
+        # (fp32 fold fusion changes WHERE h lives, not one f32 op)
+        for name, label in (
+            ("kernel", "fold kernel"),
+            ("kernel_unroll", "fused-unroll fold kernel"),
+        ):
+            if rec.get(f"ggnn_{name}_rel_err") != 0.0:
+                raise AssertionError(
+                    f"{label} not bit-identical to lax: rel_err="
+                    f"{rec.get(f'ggnn_{name}_rel_err')}"
+                )
+    for name in (
+        "kernel", "kernel_mxu", "kernel_bf16", "kernel_unroll",
+        "kernel_int8",
+    ):
         if not rec.get(f"ggnn_{name}_ok"):
             raise AssertionError(
                 f"{name} numerics outside tolerance: "
@@ -309,6 +345,8 @@ def run_smoke() -> dict:
             )
     if not rec.get("ggnn_step_us") or not rec.get("ggnn_lax_step_us"):
         raise AssertionError(f"missing step timings: {rec}")
+    if not rec.get("ggnn_unroll_step_us"):
+        raise AssertionError(f"missing fused-unroll timing: {rec}")
     print(json.dumps(rec))
     return rec
 

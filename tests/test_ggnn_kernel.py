@@ -14,6 +14,8 @@ The contract under test (docs/ggnn_kernel.md):
 """
 
 import dataclasses
+import json
+import logging
 
 import numpy as np
 import pytest
@@ -170,6 +172,48 @@ def test_conv_multi_etype_bit_identical(rng):
     _assert_bitwise(got, want, "n_etypes=3")
 
 
+def test_fused_unroll_bit_identical_across_warmup_ladder(rng):
+    """The whole-unroll fused kernel (all n_steps inside ONE
+    pallas_call, state ping-ponged in VMEM) is BIT-IDENTICAL to the
+    per-step kernel — and therefore to the lax path under fold —
+    across the full serve warmup ladder, all-padding and single-node
+    batches included. Fusion moves WHERE h lives between steps, not
+    one arithmetic op."""
+    import jax
+
+    node_budget, edge_budget = 512, 2048
+    d, n_steps = 32, 5
+    conv = GatedGraphConv(out_features=d, n_steps=n_steps)
+    conv_step = GatedGraphConv(
+        out_features=d, n_steps=n_steps, use_kernel=True
+    )
+    conv_fused = GatedGraphConv(
+        out_features=d, n_steps=n_steps, use_kernel=True,
+        kernel_unroll="fused",
+    )
+    init_batch = pack(_random_graphs(rng), 4, node_budget, edge_budget)
+    feat0 = rng.standard_normal((node_budget, d)).astype(np.float32)
+    params = conv.init(jax.random.key(0), init_batch, feat0)
+    f_lax = jax.jit(lambda b, f: conv.apply(params, b, f))
+    f_step = jax.jit(lambda b, f: conv_step.apply(params, b, f))
+    f_fused = jax.jit(lambda b, f: conv_fused.apply(params, b, f))
+    for size, cases in _warmup_ladder(rng).items():
+        for graphs in cases:
+            batch = pack(graphs, size, node_budget, edge_budget)
+            feat = rng.standard_normal(
+                (node_budget, d)
+            ).astype(np.float32)
+            got = f_fused(batch, feat)
+            _assert_bitwise(
+                got, f_step(batch, feat),
+                f"fused vs per-step, ladder size {size}",
+            )
+            _assert_bitwise(
+                got, f_lax(batch, feat),
+                f"fused vs lax, ladder size {size}",
+            )
+
+
 def test_bf16_policy_within_bound(rng):
     """The bf16 message-side policy (halved gather traffic, f32
     accumulation, f32 GRU state) stays inside the documented bound for
@@ -192,6 +236,198 @@ def test_bf16_policy_within_bound(rng):
         rel = float(np.abs(got - want).max()) / scale
         assert rel < 0.05, f"bf16/{scatter} rel err {rel}"
         assert rel > 0.0  # the policy is actually engaged
+
+
+def test_int8_policy_within_bound(rng):
+    """True int8 MXU activations (per-row table scales, per-channel
+    weight scales, int32 accumulation) stay inside INT8_DRIFT_BOUND
+    for both scatter modes, per-step AND fused — and the bound is the
+    SAME constant the tuner and the bench gate enforce."""
+    import jax
+
+    batch = pack(_random_graphs(rng), 4, 512, 2048)
+    m_lax = _model()
+    params = m_lax.init(jax.random.key(0), batch)
+    want = np.asarray(jax.jit(lambda b: m_lax.apply(params, b))(batch))
+    scale = max(float(np.abs(want).max()), 1e-6)
+    for scatter in ("fold", "mxu"):
+        for unroll in ("per_step", "fused"):
+            m_int8 = _model(
+                ggnn_kernel=True, ggnn_kernel_scatter=scatter,
+                ggnn_kernel_accum="int8", ggnn_kernel_unroll=unroll,
+            )
+            got = np.asarray(
+                jax.jit(lambda b: m_int8.apply(params, b))(batch)
+            )
+            rel = float(np.abs(got - want).max()) / scale
+            assert rel < gk.INT8_DRIFT_BOUND, (
+                f"int8/{scatter}/{unroll} rel err {rel}"
+            )
+            assert rel > 0.0  # the quantizer is actually engaged
+
+
+def test_int8_and_vmem_constants_pinned():
+    """The mirroring idiom's enforcement: the admission bound and the
+    VMEM budget are each declared once next to the kernel and mirrored
+    into the jax-free tuner/gate modules — these pins are what lets
+    the mirrors exist without cross-layer imports."""
+    from deepdfa_tpu.obs import bench_gate as bg
+    from deepdfa_tpu.tune import kernel as tune_kernel
+
+    assert gk.INT8_DRIFT_BOUND == tune_kernel.INT8_TOLERANCE
+    assert gk.INT8_DRIFT_BOUND == bg.ABSOLUTE_UPPER_BOUNDS[
+        "ggnn_kernel_int8_rel_err"
+    ]
+    assert gk.VMEM_LIMIT_BYTES == tune_kernel.DEFAULT_VMEM_LIMIT_BYTES
+    # the tuner's fuller working-set estimate dominates the kernel's
+    # own residency term at any signature, so an enumerate survivor is
+    # always admitted by resolve_unroll — no mislabeled fused rows
+    for n, d, steps in ((512, 32, 5), (2048, 128, 5), (16384, 128, 5)):
+        cand = tune_kernel.Candidate(64, 128, "fold", "fp32", "fused")
+        assert tune_kernel.estimate_vmem_bytes(
+            n, 128, d, cand, n_steps=steps
+        ) >= gk.fused_residency_bytes(n, d, "fp32", steps)
+
+
+def test_resolve_unroll_admission():
+    """The fused-unroll admission contract: unknown mode raises,
+    per_step passes through, scan_steps and VMEM overflow both
+    downgrade with a reason naming the rule."""
+    common = dict(n=512, d=32, n_steps=5, accum="fp32")
+    with pytest.raises(ValueError, match="unknown ggnn_kernel unroll"):
+        gk.resolve_unroll("chunked", scan_steps=False, **common)
+    assert gk.resolve_unroll(
+        "per_step", scan_steps=False, **common
+    ) == ("per_step", "")
+    assert gk.resolve_unroll("fused", scan_steps=False, **common) == (
+        "fused", ""
+    )
+    mode, why = gk.resolve_unroll("fused", scan_steps=True, **common)
+    assert mode == "per_step" and "scan_steps" in why
+    # scan at a single step has nothing to unroll differently: admitted
+    assert gk.resolve_unroll(
+        "fused", n=512, d=32, n_steps=1, accum="fp32", scan_steps=True
+    ) == ("fused", "")
+    mode, why = gk.resolve_unroll(
+        "fused", scan_steps=False, vmem_limit_bytes=1024, **common
+    )
+    assert mode == "per_step" and "VMEM budget" in why
+    # int8 residency adds the quantized shadow + row scales
+    assert gk.fused_residency_bytes(512, 32, "int8", 5) > (
+        gk.fused_residency_bytes(512, 32, "fp32", 5)
+    )
+
+
+def test_fused_fallback_is_loud(rng, caplog, monkeypatch):
+    """A config that asks for the fused unroll but cannot have it
+    (VMEM overflow here) serves the per-step kernel with identical
+    numerics — and says so: a warning naming the reason plus the
+    ggnn_kernel/fused_fallbacks counter."""
+    import jax
+
+    from deepdfa_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setattr(gk, "VMEM_LIMIT_BYTES", 1024)
+    batch = pack(_random_graphs(rng), 4, 512, 2048)
+    m_lax = _model()
+    m_fused = _model(ggnn_kernel=True, ggnn_kernel_unroll="fused")
+    params = m_lax.init(jax.random.key(0), batch)
+    before = obs_metrics.REGISTRY.counter(
+        "ggnn_kernel/fused_fallbacks"
+    ).value
+    with caplog.at_level(
+        logging.WARNING, logger="deepdfa_tpu.nn.ggnn_kernel"
+    ):
+        got = jax.jit(lambda b: m_fused.apply(params, b))(batch)
+    assert any(
+        "fused unroll unavailable" in r.message and "VMEM" in r.message
+        for r in caplog.records
+    ), caplog.records
+    assert obs_metrics.REGISTRY.counter(
+        "ggnn_kernel/fused_fallbacks"
+    ).value > before
+    # the fallback resolves to the per-step kernel's exact program, so
+    # bitwise holds against it (vs the lax model whole-model logits are
+    # only last-ulp: XLA fuses surrounding ops context-dependently)
+    m_step = _model(ggnn_kernel=True)
+    _assert_bitwise(
+        got, jax.jit(lambda b: m_step.apply(params, b))(batch),
+        "fallback per-step output",
+    )
+
+
+def test_fused_scan_steps_falls_back_loudly(rng, caplog):
+    """scan_steps asked for a bounded trace; the fused backward
+    re-unrolls every step, so the combination downgrades to the
+    per-step kernel under lax.scan — loudly — and the scanned forward
+    stays bit-identical to the per-step-kernel twin (the exact program
+    the fallback resolves to)."""
+    import jax
+
+    node_budget, edge_budget, d = 512, 2048, 32
+    conv_step = GatedGraphConv(
+        out_features=d, n_steps=3, scan_steps=True, use_kernel=True
+    )
+    conv_both = GatedGraphConv(
+        out_features=d, n_steps=3, scan_steps=True, use_kernel=True,
+        kernel_unroll="fused",
+    )
+    batch = pack(_random_graphs(rng), 4, node_budget, edge_budget)
+    feat = rng.standard_normal((node_budget, d)).astype(np.float32)
+    # init through the unroll twin: the param tree is identical and
+    # flax cannot create the GRU's submodules inside lax.scan in
+    # mutable init mode (the test_nn_parity scan pattern)
+    conv_init = GatedGraphConv(out_features=d, n_steps=3)
+    params = conv_init.init(jax.random.key(0), batch, feat)
+    with caplog.at_level(
+        logging.WARNING, logger="deepdfa_tpu.nn.ggnn_kernel"
+    ):
+        got = jax.jit(lambda b, f: conv_both.apply(params, b, f))(
+            batch, feat
+        )
+    assert any(
+        "scan_steps" in r.message for r in caplog.records
+    ), caplog.records
+    _assert_bitwise(
+        got,
+        jax.jit(lambda b, f: conv_step.apply(params, b, f))(batch, feat),
+        "fused-under-scan fallback",
+    )
+
+
+def test_fused_grads_bit_identical_to_per_step(rng):
+    """The fused unroll's custom_vjp (chain residuals + per-step
+    backward sweeps in reverse) produces BIT-IDENTICAL cotangents to
+    the per-step kernel chain, whole model, every param leaf — and
+    therefore matches the lax path inside the per-step bound."""
+    import jax
+    import jax.numpy as jnp
+
+    batch = pack(_random_graphs(rng), 4, 512, 2048)
+    m_step = _model(n_steps=3, ggnn_kernel=True)
+    m_fused = _model(
+        n_steps=3, ggnn_kernel=True, ggnn_kernel_unroll="fused"
+    )
+    params = m_step.init(jax.random.key(0), batch)
+    labels = jnp.asarray(batch.graph_label)
+
+    def loss(model, p):
+        logits = model.apply(p, batch)
+        return jnp.sum(
+            jnp.where(
+                jnp.asarray(batch.graph_mask),
+                (jax.nn.sigmoid(logits) - labels) ** 2, 0.0,
+            )
+        )
+
+    g_step = jax.jit(jax.grad(lambda p: loss(m_step, p)))(params)
+    g_fused = jax.jit(jax.grad(lambda p: loss(m_fused, p)))(params)
+    flat_step = jax.tree_util.tree_leaves_with_path(g_step)
+    flat_fused = jax.tree.leaves(g_fused)
+    for (path, want), got in zip(flat_step, flat_fused, strict=True):
+        _assert_bitwise(
+            got, want, f"grad {jax.tree_util.keystr(path)}"
+        )
 
 
 def test_grads_match_lax_path(rng):
@@ -241,10 +477,12 @@ def test_kernel_rejects_edge_sharding():
         conv.init(jax.random.key(0), batch, feats)
 
 
-def test_zero_steady_state_recompiles_train(rng, tmp_path):
-    """Two epochs at one batch signature with the kernel on: the
-    lowering census after epoch 1 never grows, and the epoch record
-    carries the per-signature compile/step counters."""
+@pytest.mark.parametrize("unroll", ("per_step", "fused"))
+def test_zero_steady_state_recompiles_train(rng, tmp_path, unroll):
+    """Two epochs at one batch signature with the kernel on (both
+    unroll modes): the lowering census after epoch 1 never grows, and
+    the epoch record carries the per-signature compile/step
+    counters."""
     import jax  # noqa: F401
 
     from deepdfa_tpu.core import Config, config as config_mod
@@ -262,6 +500,7 @@ def test_zero_steady_state_recompiles_train(rng, tmp_path):
         "train.max_epochs=2",
         "model.hidden_dim=8", "model.n_steps=2",
         "model.ggnn_kernel=true",
+        f"model.ggnn_kernel_unroll={json.dumps(unroll)}",
     ])
     from deepdfa_tpu.core.config import MeshConfig
     from deepdfa_tpu.parallel import make_mesh
@@ -293,10 +532,12 @@ def test_zero_steady_state_recompiles_train(rng, tmp_path):
     assert second["device_steps"] == first["device_steps"] > 0
 
 
-def test_zero_steady_state_recompiles_serve_and_localize(rng):
-    """Warmed GgnnExecutor + GgnnLocalizer with the kernel enabled:
-    arbitrary request mixes trigger no lowering after warmup, on either
-    ladder (the PR-5/PR-7 invariant, now with the fused step inside)."""
+@pytest.mark.parametrize("unroll", ("per_step", "fused"))
+def test_zero_steady_state_recompiles_serve_and_localize(rng, unroll):
+    """Warmed GgnnExecutor + GgnnLocalizer with the kernel enabled
+    (both unroll modes): arbitrary request mixes trigger no lowering
+    after warmup, on either ladder (the PR-5/PR-7 invariant, now with
+    the fused step — or the whole fused unroll — inside)."""
     import jax
 
     from deepdfa_tpu.serve.batcher import GgnnExecutor
@@ -304,7 +545,7 @@ def test_zero_steady_state_recompiles_serve_and_localize(rng):
     from deepdfa_tpu.serve.localize import GgnnLocalizer
 
     node_budget, edge_budget = 512, 2048
-    model = _model(ggnn_kernel=True)
+    model = _model(ggnn_kernel=True, ggnn_kernel_unroll=unroll)
     init_batch = pack(_random_graphs(rng), 4, node_budget, edge_budget)
     params = model.init(jax.random.key(0), init_batch)
 
@@ -372,6 +613,14 @@ def test_bench_scatter_smoke(rng):
     rec = bench_scatter.run_smoke()
     assert rec["ggnn_kernel_rel_err"] == 0.0
     assert rec["ggnn_step_us"] > 0 and rec["ggnn_lax_step_us"] > 0
+    # the ISSUE-16 variants ride the same record: fused unroll timed
+    # (bit-identical off-TPU, asserted inside run_smoke) and the int8
+    # drift measured under the admission bound the gate enforces
+    assert rec["ggnn_unroll_step_us"] > 0
+    assert rec["ggnn_kernel_unroll_rel_err"] == 0.0
+    assert rec["ggnn_kernel_int8_ok"] is True
+    assert rec["ggnn_kernel_int8_rel_err"] <= gk.INT8_DRIFT_BOUND
+    assert rec["ggnn_unroll_speedup"] > 0
     assert "ggnn_mfu" in rec or "ggnn_roofline_error" in rec
     if "ggnn_mfu" in rec:
         # the ceiling probes mirror their measurements into the

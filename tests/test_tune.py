@@ -66,6 +66,83 @@ def test_enumerate_candidates_divisibility_and_vmem():
     assert labels  # sanity: non-empty survivor set exercised above
 
 
+def test_enumerate_unroll_axis_and_fused_residency_prune():
+    """The PR-16 axes: enumeration carries unroll (and int8) rows, and
+    a fused candidate whose state-chain residency cannot fit VMEM is
+    pruned BEFORE compile with the reason naming the residency term
+    and the step count — while its per-step twin survives."""
+    cands, pruned = tune_kernel.enumerate_candidates(
+        256, 512, 32, block_nodes=(256,), block_edges=(512,),
+        scatters=("fold",), accums=("fp32", "int8"),
+        unrolls=("per_step", "fused"), n_steps=5,
+    )
+    by_label = {c.label for c in cands}
+    assert "bn256-be512-fold-fp32" in by_label
+    assert "bn256-be512-fold-fp32-fused" in by_label
+    assert "bn256-be512-fold-int8" in by_label
+    # labels only grow a suffix off the per_step default: committed
+    # pre-PR-16 rows keep naming the layout they always named
+    for c in cands:
+        assert c.label.endswith("-fused") == (c.unroll == "fused")
+        assert c.as_dict()["unroll"] == c.unroll
+    # a budget that fits the per-step working set but not the fused
+    # n_steps residency prunes ONLY the fused rows, reason named
+    per_step_need = tune_kernel.estimate_vmem_bytes(
+        256, 512, 32, tune_kernel.Candidate(256, 512), n_steps=5
+    )
+    fused_need = tune_kernel.estimate_vmem_bytes(
+        256, 512, 32,
+        tune_kernel.Candidate(256, 512, "fold", "fp32", "fused"),
+        n_steps=5,
+    )
+    assert fused_need > per_step_need
+    tight = (per_step_need + fused_need) // 2
+    cands2, pruned2 = tune_kernel.enumerate_candidates(
+        256, 512, 32, block_nodes=(256,), block_edges=(512,),
+        scatters=("fold",), accums=("fp32",),
+        unrolls=("per_step", "fused"), n_steps=5,
+        vmem_limit_bytes=tight,
+    )
+    assert [c.unroll for c in cands2] == ["per_step"]
+    assert len(pruned2) == 1
+    assert "fused unroll residency" in pruned2[0]["reason"]
+    assert "VMEM estimate" in pruned2[0]["reason"]
+    assert "5 steps" in pruned2[0]["reason"]
+
+
+def test_search_kernel_carries_unroll_axis_and_verdicts():
+    """A real reduced search over the new axes: every row carries its
+    unroll value and numerics verdict, fused fp32 is bit-identical
+    (fold), int8 lands inside its bound, and the winner row names its
+    unroll mode for kernel_layout_from."""
+    out = tune_kernel.search_kernel(
+        [(128, 256, 8)], n_steps=2,
+        candidates=[
+            tune_kernel.Candidate(128, 256),
+            tune_kernel.Candidate(128, 256, "fold", "fp32", "fused"),
+            tune_kernel.Candidate(128, 256, "fold", "int8"),
+        ],
+        reps=1,
+    )
+    rec = out["128x256x8"]
+    rows = {r["candidate"]: r for r in rec["candidates"]}
+    assert set(rows) == {
+        "bn128-be256-fold-fp32",
+        "bn128-be256-fold-fp32-fused",
+        "bn128-be256-fold-int8",
+    }
+    for row in rows.values():
+        assert row["unroll"] in ("per_step", "fused")
+        assert isinstance(row["numerics"]["ok"], bool)
+    fused = rows["bn128-be256-fold-fp32-fused"]
+    assert fused["numerics"]["ok"] and fused["numerics"]["rel_err"] == 0.0
+    int8 = rows["bn128-be256-fold-int8"]
+    assert int8["numerics"]["ok"]
+    assert int8["numerics"]["rel_err"] <= tune_kernel.INT8_TOLERANCE
+    assert rec["winner_unroll"] in ("per_step", "fused")
+    assert rec["winner"] == rows[rec["winner"]]["candidate"]
+
+
 def test_sublane_alignment_pruned():
     _, pruned = tune_kernel.enumerate_candidates(
         # 4 divides both budgets but is below the f32 sublane tile
@@ -330,6 +407,25 @@ def test_validate_tuned_names_problems():
     ]
     v = tune_cache.validate_tuned(bad_ladder)
     assert not v["ok"]
+    # axis values are optional (the _fake_record rows above carry no
+    # unroll and validate — pre-PR-16 compat) but when present must
+    # name a replayable mode
+    bad_axis = json.loads(json.dumps(good))
+    bad_axis["records"][0]["kernel"]["2048x8192x32"]["candidates"][0][
+        "unroll"
+    ] = "chunked"
+    v = tune_cache.validate_tuned(bad_axis)
+    assert not v["ok"] and any(
+        "unknown unroll" in p for p in v["problems"]
+    )
+    bad_accum = json.loads(json.dumps(good))
+    bad_accum["records"][0]["kernel"]["2048x8192x32"]["candidates"][0][
+        "accum"
+    ] = "fp8"
+    v = tune_cache.validate_tuned(bad_accum)
+    assert not v["ok"] and any(
+        "unknown accum" in p for p in v["problems"]
+    )
 
 
 def test_failed_search_never_clobbers_good_record(tmp_path, caplog):
@@ -406,9 +502,12 @@ def test_apply_to_config_sections(tmp_path):
     assert tuned_cfg.model.ggnn_kernel_block_nodes == 256
     assert tuned_cfg.model.ggnn_kernel_block_edges == 512
     assert tuned_cfg.data.seq_buckets == (24, 64)
-    # the winner's scatter/accum ride along (the joint layout rule)
+    # the winner's scatter/accum ride along (the joint layout rule);
+    # a pre-PR-16 record carries no winner_unroll, so the knob keeps
+    # its per_step default — exactly the mode those searches timed
     assert tuned_cfg.model.ggnn_kernel_scatter == "fold"
     assert tuned_cfg.model.ggnn_kernel_accum == "fp32"
+    assert tuned_cfg.model.ggnn_kernel_unroll == "per_step"
     # max_length drift: a config whose buckets top elsewhere keeps its
     # own edges (the serve capacity-guard's train-side twin)
     drifted = config_mod.apply_overrides(cfg, [
@@ -437,6 +536,68 @@ def test_apply_to_config_sections(tmp_path):
     assert config_digest(tuned_cfg) == config_digest(cfg)
     feat_cfg = config_mod.apply_overrides(cfg, ["data.gtype=\"pdg\""])
     assert config_digest(feat_cfg) != config_digest(cfg)
+
+
+def test_winner_unroll_flows_to_config(tmp_path):
+    """A record whose winner carries the fused unroll writes
+    model.ggnn_kernel_unroll through kernel_layout_from +
+    apply_to_config — the fifth joint-layout axis."""
+    hw = tune_cache.hardware_key(NODE_BUDGET, EDGE_BUDGET)
+    rec = _fake_record(hw)
+    sig = f"{NODE_BUDGET}x{EDGE_BUDGET}x128"
+    rec["kernel"] = {sig: rec["kernel"].pop("2048x8192x32")}
+    rec["kernel"][sig]["winner"] = "bn256-be512-fold-fp32-fused"
+    rec["kernel"][sig]["winner_unroll"] = "fused"
+    rec["kernel"][sig]["candidates"][0]["candidate"] = (
+        "bn256-be512-fold-fp32-fused"
+    )
+    layout = tune_cache.kernel_layout_from(
+        rec, NODE_BUDGET, EDGE_BUDGET, 128
+    )
+    assert layout["unroll"] == "fused"
+    path = tmp_path / "tuned.json"
+    tune_cache.save_tuned(
+        path, tune_cache.upsert_record(tune_cache.empty_doc(), rec)
+    )
+    cfg = config_mod.apply_overrides(Config(), [
+        "tune.enabled=true", f"tune.path={json.dumps(str(path))}",
+        f'data.batch={{"node_budget": {NODE_BUDGET}, '
+        f'"edge_budget": {EDGE_BUDGET}}}',
+    ])
+    tuned_cfg, report = tune_cache.apply_to_config(cfg)
+    assert report["matched"]
+    assert tuned_cfg.model.ggnn_kernel_unroll == "fused"
+    # still a lowering-only knob: the hot-swap digest never moves
+    from deepdfa_tpu.serve.registry import config_digest
+
+    assert config_digest(tuned_cfg) == config_digest(cfg)
+
+
+def test_gate_tuned_notes_axis_flips():
+    """An unroll/accum/scatter flip between a round and its reference
+    is a NOTE (the layout family changed), never a failure — the
+    step-time check stays the arbiter."""
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    hw = tune_cache.hardware_key(NODE_BUDGET, EDGE_BUDGET)
+    base_doc = tune_cache.upsert_record(
+        tune_cache.empty_doc(), _fake_record(hw, step_us=100.0)
+    )
+    trajectory = [
+        {"source": "TUNED_r01.json", "round": 1, "record": base_doc}
+    ]
+    flipped = _fake_record(hw, step_us=95.0)
+    sr = flipped["kernel"]["2048x8192x32"]
+    sr["winner"] = "bn256-be512-fold-fp32-fused"
+    sr["winner_unroll"] = "fused"
+    sr["candidates"][0]["candidate"] = sr["winner"]
+    doc = tune_cache.upsert_record(tune_cache.empty_doc(), flipped)
+    res = bg.gate_tuned(doc, trajectory)
+    assert res["verdict"] == "pass", res
+    assert any(
+        "winner_unroll flipped 'per_step' -> 'fused'" in n
+        for n in res["notes"]
+    ), res["notes"]
 
 
 # ---------------------------------------------------------------------------
