@@ -1935,7 +1935,13 @@ def cmd_serve(args) -> None:
             or report["metrics_status"] != 200
             or report["deep_healthz_status"] != 200
             or report["trace_flow_phases"] != ["f", "s", "t"]
-            or "device_execute" not in report["trace_linked_spans"]
+            # the device half of the chain: one inline span (serial) or
+            # the dispatch+fetch pair (pipelined, ISSUE 17)
+            or not (
+                "device_execute" in report["trace_linked_spans"]
+                or {"dispatch", "fetch"}
+                <= set(report["trace_linked_spans"])
+            )
             or "frontend" not in report["trace_linked_spans"]
             or "queue_wait" not in report["trace_linked_spans"]
             # ISSUE 8: the lines endpoint answered with ranked

@@ -344,6 +344,11 @@ class ServeConfig:
     # packed-batch budgets for serving; 0 = inherit data.batch.*
     node_budget: int = 0
     edge_budget: int = 0
+    # bounded in-flight window for pipelined execution (docs/serving.md
+    # "Pipelined execution"): >0 overlaps host pack/dispatch with device
+    # execution, a FIFO fetch thread syncs at most this many dispatched
+    # batches behind; 0 (default) keeps the serial inline path
+    pipeline_depth: int = 0
     # -- model registry (serve/registry.py)
     checkpoint: str = "best"
     # between batches, poll the checkpoint manifest and hot-swap params

@@ -86,12 +86,18 @@ class _Params:
 
     @property
     def interpret_arg(self):
-        # "tpu" = the TPU-semantics interpreter (implements prng_* —
-        # as zeros — so dropout degrades to keep-all on CPU); "legacy" =
-        # the generic interpreter (no prng lowering; fine for the
-        # debug_bits and no-dropout paths, and faster).
+        # "tpu" = the TPU-semantics interpreter; "legacy" = the generic
+        # interpreter (faster). Either way the PRNG path degrades to
+        # keep-all on CPU: _bits_for_block short-circuits to zero bits
+        # under any interpreter (matching what the TPU-semantics
+        # interpreter's prng_random_bits-as-zeros would produce), so on
+        # jax builds without InterpretParams "tpu" falls back to the
+        # legacy interpreter with identical semantics.
         if self.interpret == "tpu":
-            return pltpu.InterpretParams()
+            ip = getattr(pltpu, "InterpretParams", None)
+            if ip is not None:
+                return ip()
+            return True
         return bool(self.interpret)
 
     @property
@@ -119,6 +125,12 @@ def _bits_for_block(p: _Params, seed_ref, bits_ref, b, h, qi, kj, qsl, ksl,
     block coordinate rather than one value per axis.
     """
     if p.use_prng:
+        if p.interpret:
+            # no CPU interpreter runs the real TPU PRNG: the TPU-
+            # semantics one implements prng_random_bits as zeros and
+            # the legacy one has no lowering at all — emit the zeros
+            # directly so both give the documented keep-all degrade
+            return jnp.zeros((p.block_q, p.block_k), jnp.uint32)
         flat = ((b * num_h + h) * p.n_q + qi) * p.n_k + kj
         pltpu.prng_seed(seed_ref[0], flat)
         return pltpu.prng_random_bits((p.block_q, p.block_k))

@@ -36,6 +36,10 @@ DEFAULT_TOLERANCES: dict[str, float] = {
     "value": 0.15,                    # headline infer graphs/s
     "train_graphs_per_sec": 0.15,
     "serve_requests_per_sec": 0.20,
+    # pipelined-drive warm throughput (ISSUE 17, bench_serve interleaved
+    # serial-vs-pipelined passes) — a drop past tolerance means the
+    # overlap stopped paying for its thread handoffs
+    "serve_pipeline_req_per_sec": 0.20,
     "combined_train_tokens_per_sec": 0.20,
     "mfu": 0.25,
     "train_mfu": 0.25,
@@ -64,6 +68,10 @@ DEFAULT_TOLERANCES: dict[str, float] = {
 #: fail when `new > (1 + tol) * reference` (lower is better)
 LOWER_IS_BETTER: dict[str, float] = {
     "serve_latency_p99_ms": 0.25,
+    # device-idle share of the pipelined serve drive (ISSUE 17,
+    # FIFO-union busy/idle windows, serve/batcher.py:DeviceWindow) —
+    # the fraction the pipeline exists to shrink
+    "serve_device_idle_fraction": 0.25,
     "padding_waste": 0.10,
     # fused GGNN per-step time (ISSUE 9; us/step, platform-resolved
     # kernel scatter) — a rise past tolerance is a hot-path regression

@@ -187,6 +187,13 @@ SCHEMA: tuple[str, ...] = (
     "serve_requests_per_sec", "serve_latency_p50_ms",
     "serve_latency_p99_ms", "serve_batch_occupancy_mean",
     "serve_jit_lowerings", "serve_steady_state_recompiles",
+    # pipelined execution (ISSUE 17, docs/serving.md "Pipelined
+    # execution"): the configured depth rides the summary record so
+    # check_obs_schema can demand pipeline evidence; bench_serve stamps
+    # the serial-vs-pipelined comparison + the device-idle fraction
+    "serve_pipeline_depth", "serve_device_idle_fraction",
+    "serve_serial_req_per_sec", "serve_pipeline_req_per_sec",
+    "serve_pipeline_speedup",
     # the serve registry snapshot (batcher/frontend/registry counters)
     "serve/requests", "serve/rejected", "serve/failed", "serve/batches",
     "serve/compiles", "serve/hot_swaps",
@@ -200,6 +207,11 @@ SCHEMA: tuple[str, ...] = (
     "serve/queue_wait_seconds/max",
     "serve/device_seconds/count", "serve/device_seconds/mean",
     "serve/device_seconds/max",
+    # pipelined execution stages (serve/batcher.py): in-flight depth +
+    # per-stage seconds histograms, FIFO-union device busy/idle
+    # counters, overlap seconds, idle-fraction gauge — a reviewed
+    # wildcard because histogram suffixes expand per field
+    "serve/pipeline/*",
     "serve/frontend_seconds/count", "serve/frontend_seconds/mean",
     "serve/frontend_seconds/max",
     # rolling SLO windows (obs/slo.py, docs/slo.md): the summary record

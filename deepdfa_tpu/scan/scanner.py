@@ -69,6 +69,7 @@ class RepoScanner:
                 top_k=scfg.lines_top_k,
                 feat_width=service.registry._feat_width(),
                 etypes=cfg.model.n_etypes > 1,
+                pipeline_depth=scfg.pipeline_depth,
             )
             self.localizer.warmup()
         self._next_id = 0

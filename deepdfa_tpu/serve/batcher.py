@@ -137,6 +137,57 @@ def _ladder_sizes(
     return tuple(rungs)
 
 
+def _donate_batch_argnums() -> tuple[int, ...]:
+    """Donation spec for the compiled ladder programs: the placed batch
+    argument is donated on accelerator backends, so the padded input
+    stops double-buffering in HBM (XLA may alias its buffers for
+    outputs/scratch — every batch is freshly packed and placed per
+    execution, never reused after the call). CPU skips donation:
+    jaxlib's CPU client cannot use these donated buffers and would warn
+    on every lowering."""
+    import jax
+
+    return (1,) if jax.default_backend() != "cpu" else ()
+
+
+class DeviceWindow:
+    """FIFO union attribution of device-busy time over submit->sync
+    windows that may OVERLAP under pipelining (docs/serving.md
+    "Pipelined execution").
+
+    With batches dispatched back to back, batch i's raw submit->sync
+    window includes time spent queued behind batch i-1 on the device;
+    summing raw windows would over-count device seconds (and therefore
+    rolling MFU). Because fetches sync in FIFO order, the device-busy
+    interval attributable to batch i is exactly
+    `[max(submit_i, sync_{i-1}), sync_i]` — the union decomposition.
+    At pipeline depth 0 (serial) `sync_{i-1} <= submit_i` always holds
+    and the busy window degenerates to the plain submit->sync time, so
+    ONE accounting serves both paths. The complementary gap
+    `max(0, submit_i - sync_{i-1})` is device-idle time — the overlap
+    gap the pipeline exists to close."""
+
+    def __init__(self):
+        self.last_sync: float | None = None
+        self.busy_s = 0.0
+        self.idle_s = 0.0
+
+    def observe(self, t_submit: float, t_sync: float) -> float:
+        """Fold one submit->sync window in; returns its busy share."""
+        last = self.last_sync
+        start = t_submit if last is None else max(t_submit, last)
+        busy = max(0.0, t_sync - start)
+        if last is not None:
+            self.idle_s += max(0.0, t_submit - last)
+        self.busy_s += busy
+        self.last_sync = max(t_sync, last or t_sync)
+        return busy
+
+    def idle_fraction(self) -> float | None:
+        total = self.busy_s + self.idle_s
+        return (self.idle_s / total) if total > 0.0 else None
+
+
 def _observe_ladder_fill(label: str, used: int, capacity: int) -> None:
     """The ladder blind-spot gauge (docs/tuning.md): per-rung real vs
     padded row counters plus the process-wide `serve/ladder_waste`
@@ -221,7 +272,9 @@ class GgnnExecutor:
                 params = params_transform(params)
             return jax.nn.sigmoid(model.apply(params, batch))
 
-        self._score_jit = jax.jit(score)
+        self._score_jit = jax.jit(
+            score, donate_argnums=_donate_batch_argnums()
+        )
         self._compiled: dict[int, Any] = {}
         self._lowerings = 0
 
@@ -315,29 +368,57 @@ class GgnnExecutor:
         CombinedTrainer.jit_lowerings)."""
         return self._lowerings + self._score_jit._cache_size()
 
-    # -- execution -----------------------------------------------------------
+    # -- execution (pack -> dispatch -> fetch stages) -------------------------
+    # The three stages are the pipeline contract every executor exports
+    # (docs/serving.md "Pipelined execution"): `pack_chunk` is pure host
+    # work, `dispatch` submits to the device WITHOUT syncing (JAX
+    # dispatch is async), `fetch` is the one sync point. `execute` is
+    # the serial composition for direct callers; the DynamicBatcher
+    # drives the stages itself so the same code path serves both
+    # pipeline_depth=0 and depth>0.
 
-    def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
-        """Pack + score one chunk; [len(chunk)] probabilities."""
-        import jax
-
+    def pack_chunk(self, key: Hashable, chunk: Sequence):
+        """Host pack into the padded ladder batch; (signature label,
+        packed). Host-only — its time belongs to the pack span, never
+        to the ledger's measured execution window."""
         from deepdfa_tpu.graphs.batch import pack
 
-        t0 = time.perf_counter()
         size = self._size_for(len(chunk))
         _observe_ladder_fill(f"G{size}", len(chunk), size)
         batch = pack(
             list(chunk), size, self.node_budget, self.edge_budget,
             feat_width=self.feat_width, etypes=self.etypes,
         )
+        return f"G{size}", (size, batch)
+
+    def dispatch(self, key: Hashable, packed):
+        """H2D + submit the compiled ladder program; returns the
+        un-synced device result (a future under async dispatch). The
+        placed batch is donated to the executable on accelerator
+        backends (`_donate_batch_argnums`)."""
+        size, batch = packed
         batch = self._place(batch)
         fn = self._compiled.get(size, self._score_jit)
-        probs = fn(self.params_fn(), batch)
-        out = np.asarray(jax.device_get(probs))[: len(chunk)]
-        # rolling-MFU join (obs/ledger.py): the fetch above synced, so
-        # this window is the executable's measured pack+H2D+execute time
+        return fn(self.params_fn(), batch)
+
+    def fetch(self, handle, n: int) -> np.ndarray:
+        """The sync point: block until the dispatched result is on
+        host; [n] probabilities."""
+        import jax
+
+        return np.asarray(jax.device_get(handle))[:n]
+
+    def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
+        """Pack + score one chunk; [len(chunk)] probabilities.
+
+        Ledger window semantics (docs/efficiency.md): the rolling-MFU
+        join measures dispatch->sync — host pack time is NOT counted as
+        device time (it reports under the batcher's pack span)."""
+        sig, packed = self.pack_chunk(key, chunk)
+        t0 = time.perf_counter()
+        out = self.fetch(self.dispatch(key, packed), len(chunk))
         obs_ledger.observe_execution(
-            self.ledger_tag, f"G{size}", time.perf_counter() - t0
+            self.ledger_tag, sig, time.perf_counter() - t0
         )
         return out
 
@@ -412,7 +493,9 @@ class CombinedExecutor:
                 )
             return jax.nn.softmax(logits)[:, 1]
 
-        self._score_jit = jax.jit(score)
+        self._score_jit = jax.jit(
+            score, donate_argnums=_donate_batch_argnums()
+        )
         self._compiled: dict[int, Any] = {}
         self._lowerings = 0
 
@@ -537,21 +620,33 @@ class CombinedExecutor:
     def jit_lowerings(self) -> int:
         return self._lowerings + self._score_jit._cache_size()
 
-    def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
+    # -- execution (the same pack/dispatch/fetch stage contract as
+    # GgnnExecutor; docs/serving.md "Pipelined execution") -------------------
+
+    def pack_chunk(self, key: Hashable, chunk: Sequence):
+        sig = self.ledger_signature(key, len(chunk))
+        _observe_ladder_fill(sig, len(chunk), self._rows[int(key)])
+        return sig, (int(key), self._collate(int(key), chunk))
+
+    def dispatch(self, key: Hashable, packed):
+        T, batch = packed
+        batch = self._place(batch)
+        fn = self._compiled.get(T, self._score_jit)
+        return fn(self.params_fn(), batch)
+
+    def fetch(self, handle, n: int) -> np.ndarray:
         import jax
 
+        return np.asarray(jax.device_get(handle))[:n]
+
+    def execute(self, key: Hashable, chunk: Sequence) -> np.ndarray:
+        # ledger window = dispatch->sync, host collate excluded (the
+        # same window-semantics contract as GgnnExecutor.execute)
+        sig, packed = self.pack_chunk(key, chunk)
         t0 = time.perf_counter()
-        _observe_ladder_fill(
-            self.ledger_signature(key, len(chunk)), len(chunk),
-            self._rows[int(key)],
-        )
-        batch = self._place(self._collate(int(key), chunk))
-        fn = self._compiled.get(int(key), self._score_jit)
-        probs = fn(self.params_fn(), batch)
-        out = np.asarray(jax.device_get(probs))[: len(chunk)]
+        out = self.fetch(self.dispatch(key, packed), len(chunk))
         obs_ledger.observe_execution(
-            self.ledger_tag, self.ledger_signature(key, len(chunk)),
-            time.perf_counter() - t0,
+            self.ledger_tag, sig, time.perf_counter() - t0
         )
         return out
 
@@ -566,6 +661,22 @@ class DynamicBatcher:
       - `score_all(payloads)` drives synchronously (offline `score` CLI,
         deterministic: full groups flush as they fill, the tail force-
         flushes).
+
+    Pipelined execution (docs/serving.md "Pipelined execution"):
+    `pipeline_depth > 0` splits every batch into the executor's
+    pack -> dispatch -> fetch stages. The drive side (scheduler thread
+    or offline drain) packs and submits WITHOUT syncing, keeping at
+    most `pipeline_depth` dispatched-but-unsynced batches in flight
+    (backpressure blocks the dispatcher, never deepens the window); the
+    FIFO fetch stage syncs results, resolves request futures, and owns
+    the per-request `device_s` attribution plus the ledger's
+    rolling-MFU join (FIFO-union windows, `DeviceWindow`). Online, the
+    fetch stage runs on its own thread next to the scheduler; offline
+    drives (`score_all`/`drain`) sync the oldest batch inline when the
+    window fills — same stages, no cross-thread handoff per batch.
+    Arrival order, grouping, and deterministic packing are unchanged —
+    scores stay bit-identical to the depth=0 serial path, which itself
+    is byte-identical to the historical inline execute.
     """
 
     def __init__(
@@ -575,10 +686,12 @@ class DynamicBatcher:
         max_batch_delay_s: float = 0.025,
         on_batch: Callable[[], None] | None = None,
         slo=None,
+        pipeline_depth: int = 0,
     ):
         self.executor = executor
         self.queue_limit = int(queue_limit)
         self.max_batch_delay_s = float(max_batch_delay_s)
+        self.pipeline_depth = max(0, int(pipeline_depth))
         self.on_batch = on_batch
         #: optional obs/slo.py:SloEngine — queue depth + batch occupancy
         #: feed the rolling windows (request latency is observed by the
@@ -605,6 +718,28 @@ class DynamicBatcher:
         self._m_latency = r.histogram("serve/latency_seconds")
         self._m_queue_wait = r.histogram("serve/queue_wait_seconds")
         self._m_device = r.histogram("serve/device_seconds")
+        # -- pipelined execution state (pipeline_depth > 0) ------------------
+        #: FIFO of dispatched-but-unsynced batches, synced in submission
+        #: order by the fetch thread; _n_inflight counts batches whose
+        #: fetch has not COMPLETED yet (popped-but-syncing still holds
+        #: its slot), both guarded by _fetch_cv
+        self._inflight: deque = deque()
+        self._n_inflight = 0
+        self._fetch_cv = threading.Condition()
+        self._fetch_thread: threading.Thread | None = None
+        self._fetch_stop = False
+        #: FIFO-union device-busy attribution shared by both depths —
+        #: at depth 0 it degenerates to plain submit->sync windows
+        self._window = DeviceWindow()
+        self._m_pipe_depth = r.histogram("serve/pipeline/depth")
+        self._m_pack = r.histogram("serve/pipeline/pack_seconds")
+        self._m_dispatch = r.histogram("serve/pipeline/dispatch_seconds")
+        self._m_fetch = r.histogram("serve/pipeline/fetch_seconds")
+        self._m_pipe_batches = r.counter("serve/pipeline/batches")
+        self._m_busy = r.counter("serve/pipeline/device_busy_seconds")
+        self._m_idle = r.counter("serve/pipeline/device_idle_seconds")
+        self._m_overlap = r.counter("serve/pipeline/overlap_seconds")
+        self._m_idle_frac = r.gauge("serve/pipeline/device_idle_fraction")
 
     # -- admission -----------------------------------------------------------
 
@@ -647,12 +782,16 @@ class DynamicBatcher:
         with self._lock:
             depth = self._n_pending
         lat = sorted(self.recent_latencies)
+        with self._fetch_cv:
+            in_flight = self._n_inflight
         return {
             "queue_depth": depth,
             "batches": self.batches_run,
             "latency_p50_s": percentile(lat, 0.50),
             "latency_p99_s": percentile(lat, 0.99),
             "jit_lowerings": self.executor.jit_lowerings(),
+            "pipeline_depth": self.pipeline_depth,
+            "pipeline_in_flight": in_flight,
         }
 
     # -- scheduling ----------------------------------------------------------
@@ -704,7 +843,12 @@ class DynamicBatcher:
             return oldest_key, None
         return None, self.max_batch_delay_s - (now - oldest_t)
 
-    def _run_batch(self, key: Hashable, chunk: list[ScoreRequest]) -> None:
+    def _begin_batch(
+        self, key: Hashable, chunk: list[ScoreRequest]
+    ) -> bool:
+        """Drive-side prologue shared by the serial and pipelined paths:
+        hot-swap poll, queue-wait attribution, and the backdated
+        queue-wait trace windows. Returns whether tracing is on."""
         if self.on_batch is not None:
             try:
                 self.on_batch()  # e.g. registry.maybe_reload (hot swap)
@@ -740,7 +884,67 @@ class DynamicBatcher:
                     tid=obs_trace.QUEUE_TRACK_TID,
                     track_name="serve-queue",
                 )
+        return tracing
+
+    def _complete_batch(
+        self,
+        key: Hashable,
+        sig: str,
+        chunk: list[ScoreRequest],
+        probs,
+        t_submit: float,
+        t_sync: float,
+    ) -> None:
+        """Fetch-side epilogue (drive thread at depth 0, fetch thread
+        otherwise): device-window attribution, the ledger's rolling-MFU
+        join, SLO/metrics bookkeeping, and future resolution.
+
+        Window semantics (docs/serving.md): the observed "device" window
+        is this batch's FIFO-union busy share of its dispatch->sync
+        interval — host pack time is excluded (it has its own span and
+        histogram), and under pipelining the part of the interval spent
+        waiting behind the previous batch is not double-counted. Rolling
+        MFU, `serve/device_seconds`, and per-request `device_s` all use
+        this busy share."""
+        idle0 = self._window.idle_s
+        busy = self._window.observe(t_submit, t_sync)
+        self._m_busy.inc(busy)
+        self._m_idle.inc(self._window.idle_s - idle0)
+        frac = self._window.idle_fraction()
+        if frac is not None:
+            self._m_idle_frac.set(frac)
+        tag = getattr(self.executor, "ledger_tag", None)
+        if tag is not None:
+            obs_ledger.observe_execution(tag, sig, busy)
+        self.batches_run += 1
+        self._m_batches.inc()
+        self._m_pipe_batches.inc()
+        self._m_device.observe(busy)
+        occupancy = len(chunk) / max(1, self.executor.capacity(key))
+        self._m_occupancy.observe(occupancy)
+        if self.slo is not None:
+            self.slo.observe_batch(occupancy)
+        for req, p in zip(chunk, probs):
+            req.device_s = busy
+            req.set_result(float(p))
+            self._m_latency.observe(req.latency_s)
+            self.recent_latencies.append(req.latency_s)
+
+    def _run_batch(self, key: Hashable, chunk: list[ScoreRequest]) -> None:
+        """Serial path (pipeline_depth == 0): pack -> dispatch -> fetch
+        inline on the drive thread. The stage split is the same one the
+        pipelined path uses; only the threading differs."""
+        tracing = self._begin_batch(key, chunk)
         try:
+            with obs_trace.span(
+                "pack", cat="serve", signature=str(key),
+                batch_size=len(chunk),
+            ):
+                tp = time.perf_counter()
+                sig, packed = self.executor.pack_chunk(
+                    key, [r.payload for r in chunk]
+                )
+                self._m_pack.observe(time.perf_counter() - tp)
             with obs_trace.span(
                 "device_execute", cat="serve", signature=str(key),
                 batch_size=len(chunk),
@@ -752,9 +956,13 @@ class DynamicBatcher:
                         obs_trace.flow(
                             "request", req.request_id, "f", cat="serve"
                         )
-                probs = self.executor.execute(
-                    key, [r.payload for r in chunk]
-                )
+                t_submit = time.perf_counter()
+                handle = self.executor.dispatch(key, packed)
+                td = time.perf_counter()
+                self._m_dispatch.observe(td - t_submit)
+                probs = self.executor.fetch(handle, len(chunk))
+                t_sync = time.perf_counter()
+                self._m_fetch.observe(t_sync - td)
         except Exception as e:
             # a batch that died with RESOURCE_EXHAUSTED is exactly the
             # moment the HBM ledger exists for: dump a postmortem (no-op
@@ -764,19 +972,196 @@ class DynamicBatcher:
             for req in chunk:
                 req.set_error(e)
             return
-        dt = time.monotonic() - t0
-        self.batches_run += 1
-        self._m_batches.inc()
-        self._m_device.observe(dt)
-        occupancy = len(chunk) / max(1, self.executor.capacity(key))
-        self._m_occupancy.observe(occupancy)
-        if self.slo is not None:
-            self.slo.observe_batch(occupancy)
-        for req, p in zip(chunk, probs):
-            req.device_s = dt
-            req.set_result(float(p))
-            self._m_latency.observe(req.latency_s)
-            self.recent_latencies.append(req.latency_s)
+        self._complete_batch(key, sig, chunk, probs, t_submit, t_sync)
+
+    # -- pipelined path (pipeline_depth > 0) ---------------------------------
+
+    def _dispatch_batch(
+        self, key: Hashable, chunk: list[ScoreRequest]
+    ) -> None:
+        """Pipelined drive side: pack + submit WITHOUT syncing. Blocks
+        while `pipeline_depth` batches are already in flight — the
+        bounded window IS the backpressure, so unsynced device work and
+        staged host batches both stay bounded."""
+        tracing = self._begin_batch(key, chunk)
+        try:
+            with obs_trace.span(
+                "pack", cat="serve", signature=str(key),
+                batch_size=len(chunk),
+            ):
+                tp = time.perf_counter()
+                sig, packed = self.executor.pack_chunk(
+                    key, [r.payload for r in chunk]
+                )
+                pack_s = time.perf_counter() - tp
+                self._m_pack.observe(pack_s)
+        except Exception as e:
+            obs_flight.note_exception(e, where="serve_batch")
+            for req in chunk:
+                req.set_error(e)
+            return
+        # acquire the in-flight slot BEFORE submitting: dispatched-but-
+        # unsynced batches never exceed pipeline_depth. Online, the
+        # FIFO fetch thread frees slots; offline (no scheduler thread)
+        # the drive syncs the oldest batch inline instead — single-
+        # threaded software pipelining, because a cross-thread handoff
+        # per batch costs more GIL ping-pong than the tiny offline
+        # epilogue it would offload
+        if self._fetch_thread is not None:
+            with self._fetch_cv:
+                while self._n_inflight >= self.pipeline_depth:
+                    self._fetch_cv.wait(0.25)
+                self._n_inflight += 1
+                overlapped = self._n_inflight > 1
+                self._m_pipe_depth.observe(self._n_inflight)
+        else:
+            while True:
+                with self._fetch_cv:
+                    if self._n_inflight < self.pipeline_depth:
+                        self._n_inflight += 1
+                        overlapped = self._n_inflight > 1
+                        self._m_pipe_depth.observe(self._n_inflight)
+                        break
+                self._sync_oldest()
+        try:
+            with obs_trace.span(
+                "dispatch", cat="serve", signature=str(key),
+                batch_size=len(chunk),
+                request_ids=[r.request_id for r in chunk] if tracing
+                else None,
+            ):
+                if tracing:
+                    for req in chunk:
+                        obs_trace.flow(
+                            "request", req.request_id, "t", cat="serve"
+                        )
+                t_submit = time.perf_counter()
+                handle = self.executor.dispatch(key, packed)
+                dispatch_s = time.perf_counter() - t_submit
+                self._m_dispatch.observe(dispatch_s)
+        except Exception as e:
+            obs_flight.note_exception(e, where="serve_batch")
+            for req in chunk:
+                req.set_error(e)
+            with self._fetch_cv:
+                self._n_inflight -= 1
+                self._fetch_cv.notify_all()
+            return
+        if overlapped:
+            # host stage seconds spent while the device already held an
+            # in-flight batch: the overlap the pipeline buys
+            self._m_overlap.inc(pack_s + dispatch_s)
+        with self._fetch_cv:
+            self._inflight.append((key, sig, chunk, handle, t_submit))
+            self._fetch_cv.notify_all()
+
+    def _sync_oldest(self) -> bool:
+        """Fetch + resolve the oldest in-flight batch on the CALLING
+        thread (the offline drive's fetch stage); False if none."""
+        with self._fetch_cv:
+            if not self._inflight:
+                return False
+            item = self._inflight.popleft()
+        try:
+            self._fetch_one(*item)
+        finally:
+            with self._fetch_cv:
+                self._n_inflight -= 1
+                self._fetch_cv.notify_all()
+        return True
+
+    def _fetch_loop(self) -> None:
+        """FIFO fetch stage: sync each dispatched batch in submission
+        order, resolve its futures, run the epilogue. Exits once stop
+        was requested AND the in-flight FIFO has drained."""
+        while True:
+            with self._fetch_cv:
+                while not self._inflight and not self._fetch_stop:
+                    self._fetch_cv.wait(0.25)
+                if not self._inflight:
+                    return
+                item = self._inflight.popleft()
+            try:
+                self._fetch_one(*item)
+            finally:
+                with self._fetch_cv:
+                    self._n_inflight -= 1
+                    self._fetch_cv.notify_all()
+
+    def _fetch_one(
+        self,
+        key: Hashable,
+        sig: str,
+        chunk: list[ScoreRequest],
+        handle,
+        t_submit: float,
+    ) -> None:
+        tracing = obs_trace.enabled()
+        try:
+            with obs_trace.span(
+                "fetch", cat="serve", signature=str(key),
+                batch_size=len(chunk),
+                request_ids=[r.request_id for r in chunk] if tracing
+                else None,
+            ):
+                if tracing:
+                    for req in chunk:
+                        obs_trace.flow(
+                            "request", req.request_id, "f", cat="serve"
+                        )
+                tf = time.perf_counter()
+                probs = self.executor.fetch(handle, len(chunk))
+                t_sync = time.perf_counter()
+                self._m_fetch.observe(t_sync - tf)
+        except Exception as e:
+            obs_flight.note_exception(e, where="serve_fetch")
+            for req in chunk:
+                req.set_error(e)
+            return
+        self._complete_batch(key, sig, chunk, probs, t_submit, t_sync)
+
+    def _ensure_fetch_thread(self) -> None:
+        if self._fetch_thread is None:
+            self._fetch_stop = False
+            self._fetch_thread = threading.Thread(
+                target=self._fetch_loop, name="serve-fetch", daemon=True
+            )
+            self._fetch_thread.start()
+
+    def _wait_inflight(self, timeout_s: float = 60.0) -> None:
+        """Block until every dispatched batch has been fetched and its
+        futures resolved (the pipelined half of drain); no-op at
+        depth 0."""
+        deadline = time.monotonic() + timeout_s
+        with self._fetch_cv:
+            while self._n_inflight > 0:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"{self._n_inflight} pipelined batches still in "
+                        f"flight after {timeout_s:.0f}s"
+                    )
+                self._fetch_cv.wait(0.25)
+
+    def _stop_fetch(self) -> None:
+        t = self._fetch_thread
+        if t is None:
+            return
+        with self._fetch_cv:
+            self._fetch_stop = True
+            self._fetch_cv.notify_all()
+        t.join(timeout=10)
+        self._fetch_thread = None
+
+    def pipeline_stats(self) -> dict:
+        """Device-window attribution snapshot — bench_serve/bench_load
+        stamp `serve_device_idle_fraction` from this. Valid at any
+        depth (the serial path feeds the same window)."""
+        return {
+            "depth": self.pipeline_depth,
+            "device_busy_s": self._window.busy_s,
+            "device_idle_s": self._window.idle_s,
+            "device_idle_fraction": self._window.idle_fraction(),
+        }
 
     def _drain_once(self, force: bool = False) -> bool:
         """Run at most one batch; True if one ran."""
@@ -786,17 +1171,26 @@ class DynamicBatcher:
                 return False
             chunk = self._pop_chunk(key)
         if chunk:
-            self._run_batch(key, chunk)
+            if self.pipeline_depth > 0:
+                self._dispatch_batch(key, chunk)
+            else:
+                self._run_batch(key, chunk)
         return bool(chunk)
 
     def drain(self) -> None:
         """Offline: run batches until the queue is empty (full groups
-        first, then force-flush the tails)."""
+        first, then force-flush the tails). Pipelined, additionally
+        waits for the in-flight window to empty so every future is
+        resolved on return."""
         while True:
             if not self._drain_once(force=True):
                 with self._lock:
                     if self._n_pending == 0:
-                        return
+                        break
+        if self._fetch_thread is None:
+            while self._sync_oldest():
+                pass
+        self._wait_inflight()
 
     def score_all(
         self,
@@ -851,6 +1245,10 @@ class DynamicBatcher:
     def start(self) -> None:
         if self._thread is not None:
             return
+        if self.pipeline_depth > 0:
+            # online mode pairs the scheduler with the dedicated FIFO
+            # fetch thread (offline drives sync inline instead)
+            self._ensure_fetch_thread()
         self._thread = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
         )
@@ -870,7 +1268,10 @@ class DynamicBatcher:
                         timeout=wait if wait is not None else 0.25
                     )
                     continue
-            self._run_batch(key, chunk)
+            if self.pipeline_depth > 0:
+                self._dispatch_batch(key, chunk)
+            else:
+                self._run_batch(key, chunk)
 
     def close(self) -> None:
         with self._lock:
@@ -879,3 +1280,8 @@ class DynamicBatcher:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._fetch_thread is not None:
+            # the scheduler force-flushed on close; let the fetch stage
+            # resolve what it dispatched, then retire the thread
+            self._wait_inflight()
+            self._stop_fetch()

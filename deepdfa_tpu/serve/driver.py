@@ -75,6 +75,9 @@ def build_smoke_run(
         # small serve batches keep the AOT ladder cheap to warm on CPU
         "serve.max_batch_graphs=4",
         "serve.node_budget=2048", "serve.edge_budget=8192",
+        # smokes exercise the pipelined path end-to-end (depth=2); the
+        # production default stays 0 = serial (core/config.py)
+        "serve.pipeline_depth=2",
         *(extra_overrides or []),
     ])
     # vuln_rate: the dataset's ~6% positive rate by default; the cascade

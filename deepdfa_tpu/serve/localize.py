@@ -21,6 +21,7 @@ gradients (docs/scanning.md).
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Callable, Sequence
 
 import numpy as np
@@ -30,6 +31,7 @@ from deepdfa_tpu.obs import (
     metrics as obs_metrics,
     trace as obs_trace,
 )
+from deepdfa_tpu.serve.batcher import DeviceWindow, _donate_batch_argnums
 from deepdfa_tpu.serve.frontend import Features
 
 
@@ -52,6 +54,7 @@ class GgnnLocalizer:
         etypes: bool = False,
         params_transform: Callable[[Any], Any] | None = None,
         mesh=None,
+        pipeline_depth: int = 0,
     ):
         import jax
 
@@ -91,9 +94,19 @@ class GgnnLocalizer:
             def score_fn(params, batch):  # noqa: F811 - deliberate wrap
                 return base_fn(params_transform(params), batch)
 
-        self._fn_jit = jax.jit(score_fn)
+        # the padded input batch is donated on accelerator backends —
+        # same HBM double-buffering fix as the scoring ladder
+        self._fn_jit = jax.jit(
+            score_fn, donate_argnums=_donate_batch_argnums()
+        )
         self._compiled: dict[int, Any] = {}
         self._lowerings = 0
+        #: bounded in-flight window for the software-pipelined
+        #: `attribute_all` drive; 0 = serial (docs/serving.md)
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        #: FIFO-union dispatch->sync attribution (serve/batcher.py) —
+        #: feeds the ledger's rolling-MFU join for the localize tag
+        self._window = DeviceWindow()
         r = obs_metrics.REGISTRY
         self._m_requests = r.counter("localize/requests")
         self._m_batches = r.counter("localize/batches")
@@ -169,35 +182,52 @@ class GgnnLocalizer:
         )
         return nodes <= self.node_budget and edges <= self.edge_budget
 
-    def attribute(
-        self, feats_list: Sequence[Features]
-    ) -> list[tuple[float, list[dict]]]:
-        """One padded executable over the chunk -> per-function
-        (prob, ranked [{"line", "score"}]) in the function's OWN line
-        coordinates. The chunk must respect the pack budgets (`fits`)."""
-        import jax
-
-        from deepdfa_tpu.eval.localize import node_line_attributions
+    def _pack_chunk(self, feats_list: Sequence[Features]):
+        """Host pack stage: (ladder size, padded batch)."""
         from deepdfa_tpu.graphs.batch import pack
 
-        if not feats_list:
-            return []
-        t0 = time.perf_counter()
         size = self._size_for(len(feats_list))
         batch = pack(
             [f.spec for f in feats_list], size,
             self.node_budget, self.edge_budget,
             feat_width=self.feat_width, etypes=self.etypes,
         )
+        return size, batch
+
+    def _dispatch(self, size: int, batch):
+        """Place + submit WITHOUT syncing; returns the un-synced device
+        (probs, node_scores) handle."""
         batch = self._place(batch)
         fn = self._compiled.get(size, self._fn_jit)
-        with obs_trace.span(
-            "localize_execute", cat="serve", signature=str(size),
-            batch_size=len(feats_list),
-        ):
-            probs, node_scores = fn(self.params_fn(), batch)
-        probs = np.asarray(jax.device_get(probs))
-        node_scores = np.asarray(jax.device_get(node_scores))
+        return fn(self.params_fn(), batch)
+
+    def _fetch(self, handle):
+        """Sync point: pull (probs, node_scores) to host."""
+        import jax
+
+        probs, node_scores = handle
+        return (
+            np.asarray(jax.device_get(probs)),
+            np.asarray(jax.device_get(node_scores)),
+        )
+
+    def _finish(
+        self,
+        feats_list: Sequence[Features],
+        size: int,
+        probs: np.ndarray,
+        node_scores: np.ndarray,
+        t_submit: float,
+        t_sync: float,
+    ) -> list[tuple[float, list[dict]]]:
+        """Fetch-side epilogue: the ledger's measured execution window
+        (FIFO-union dispatch->sync busy share — host pack and the line
+        mapping below are EXCLUDED, matching the serve batcher's window
+        semantics) plus the host node->line mapping."""
+        from deepdfa_tpu.eval.localize import node_line_attributions
+
+        busy = self._window.observe(t_submit, t_sync)
+        obs_ledger.observe_execution("localize", f"L{size}", busy)
         out: list[tuple[float, list[dict]]] = []
         off = 0
         for i, f in enumerate(feats_list):
@@ -212,23 +242,87 @@ class GgnnLocalizer:
             off += n
         self._m_requests.inc(len(feats_list))
         self._m_batches.inc()
-        dt = time.perf_counter() - t0
-        self._m_seconds.observe(dt)
-        obs_ledger.observe_execution("localize", f"L{size}", dt)
+        return out
+
+    def attribute(
+        self, feats_list: Sequence[Features]
+    ) -> list[tuple[float, list[dict]]]:
+        """One padded executable over the chunk -> per-function
+        (prob, ranked [{"line", "score"}]) in the function's OWN line
+        coordinates. The chunk must respect the pack budgets (`fits`)."""
+        if not feats_list:
+            return []
+        t0 = time.perf_counter()
+        size, batch = self._pack_chunk(feats_list)
+        with obs_trace.span(
+            "localize_execute", cat="serve", signature=str(size),
+            batch_size=len(feats_list),
+        ):
+            t_submit = time.perf_counter()
+            handle = self._dispatch(size, batch)
+            probs, node_scores = self._fetch(handle)
+            t_sync = time.perf_counter()
+        out = self._finish(
+            feats_list, size, probs, node_scores, t_submit, t_sync
+        )
+        self._m_seconds.observe(time.perf_counter() - t0)
         return out
 
     def attribute_all(
         self, feats_list: Sequence[Features]
     ) -> list[tuple[float, list[dict]]]:
         """Greedy budget-respecting chunking over a function stream —
-        the scan drive. Order preserved."""
-        out: list[tuple[float, list[dict]]] = []
+        the scan drive. Order preserved.
+
+        With `pipeline_depth > 0` the drive is software-pipelined
+        (docs/serving.md "Pipelined execution"): JAX dispatch is async,
+        so packing + submitting the next chunk overlaps the device
+        running the current one, with at most `pipeline_depth`
+        dispatched-but-unsynced chunks behind the FIFO fetch. Chunking
+        and per-chunk programs are identical to the serial drive, so the
+        outputs are bit-identical."""
+        chunks: list[list[Features]] = []
         chunk: list[Features] = []
         for f in feats_list:
             if chunk and not self.fits(chunk, f):
-                out.extend(self.attribute(chunk))
+                chunks.append(chunk)
                 chunk = []
             chunk.append(f)
         if chunk:
-            out.extend(self.attribute(chunk))
+            chunks.append(chunk)
+        if self.pipeline_depth <= 0:
+            out: list[tuple[float, list[dict]]] = []
+            for c in chunks:
+                out.extend(self.attribute(c))
+            return out
+        out = []
+        window: deque = deque()
+
+        def _sync_oldest() -> None:
+            c, size, handle, t_submit = window.popleft()
+            with obs_trace.span(
+                "localize_fetch", cat="serve", signature=str(size),
+                batch_size=len(c),
+            ):
+                probs, node_scores = self._fetch(handle)
+                t_sync = time.perf_counter()
+            out.extend(
+                self._finish(c, size, probs, node_scores, t_submit, t_sync)
+            )
+
+        for c in chunks:
+            while len(window) >= self.pipeline_depth:
+                _sync_oldest()
+            t0 = time.perf_counter()
+            size, batch = self._pack_chunk(c)
+            with obs_trace.span(
+                "localize_dispatch", cat="serve", signature=str(size),
+                batch_size=len(c),
+            ):
+                t_submit = time.perf_counter()
+                handle = self._dispatch(size, batch)
+            window.append((c, size, handle, t_submit))
+            self._m_seconds.observe(time.perf_counter() - t0)
+        while window:
+            _sync_oldest()
         return out

@@ -177,6 +177,7 @@ class ScoringService:
                     etypes=cfg.model.n_etypes > 1,
                     params_transform=params_transform,
                     mesh=mesh,
+                    pipeline_depth=scfg.pipeline_depth,
                 )
         else:
             from deepdfa_tpu.serve import cascade as cascade_mod
@@ -216,6 +217,7 @@ class ScoringService:
             max_batch_delay_s=scfg.max_batch_delay_ms / 1000.0,
             on_batch=(self._poll_hot_swap if scfg.hot_swap else None),
             slo=self.slo,
+            pipeline_depth=scfg.pipeline_depth,
         )
         self.warmup_report = self.executor.warmup()
         if self.localizer is not None:
@@ -446,6 +448,9 @@ class ScoringService:
                 if k.startswith("serve/")
             },
             "serve_slo": self.slo.snapshot(),
+            # pipelined serve_log evidence: check_obs_schema requires
+            # the serve/pipeline/* tags whenever this is > 0
+            "serve_pipeline_depth": self.batcher.pipeline_depth,
         }
         backend = {
             k[len("backend/"):]: v

@@ -110,6 +110,9 @@ def bench_load(
         "model.n_steps=2" if smoke else "model.n_steps=5",
         "serve.max_batch_graphs=8",
         "serve.node_budget=2048", "serve.edge_budget=8192",
+        # replicas serve through the pipelined executor path (ISSUE 17)
+        # — the fleet drive is the online-mode overlap measurement
+        "serve.pipeline_depth=2",
         # the tenants field is a JSON string; the override value must be
         # a JSON string literal (json.dumps of the spec)
         f"fleet.tenants={json.dumps(TENANT_POLICIES)}",
@@ -238,6 +241,19 @@ def bench_load(
             router_server.close()
             p99 = percentile(ok_lat, 0.99)
             p50 = percentile(ok_lat, 0.50)
+            # in-process replicas share one metrics registry, so the
+            # fleet-wide FIFO-union device busy/idle counters are the
+            # summed pipelined-drive attribution (serve/batcher.py:
+            # DeviceWindow)
+            from deepdfa_tpu.obs import metrics as obs_metrics
+
+            msnap = obs_metrics.REGISTRY.snapshot()
+            busy = msnap.get("serve/pipeline/device_busy_seconds", 0.0)
+            idle = msnap.get("serve/pipeline/device_idle_seconds", 0.0)
+            idle_frac = (
+                round(idle / (busy + idle), 4) if busy + idle > 0
+                else None
+            )
             return {
                 "metric": "fleet_p99_overload_ms",
                 "value": round(1e3 * p99, 3) if p99 else None,
@@ -261,6 +277,8 @@ def bench_load(
                 "fleet_replicas": int(n_replicas),
                 "fleet_seconds": round(drive_s, 3),
                 "fleet_steady_state_recompiles": recompiles,
+                "serve_pipeline_depth": cfg.serve.pipeline_depth,
+                "serve_device_idle_fraction": idle_frac,
                 "shed_by_tenant": shed_by_tenant,
                 "overload_factor": float(overload),
             }

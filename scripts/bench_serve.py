@@ -88,10 +88,13 @@ def bench_serve(
     warmup_seconds = time.perf_counter() - t0
     lowerings0 = executor.jit_lowerings()
 
-    def one_pass(slo=None) -> tuple[float, int, list[float]]:
+    def one_pass(slo=None, depth: int = 0):
+        """One scoring pass; returns (dt, probs, latencies, pipeline
+        stats). `depth` drives the pipelined executor path
+        (docs/serving.md "Pipelined execution"); 0 = serial."""
         batcher = DynamicBatcher(
             executor, queue_limit=max(64, n),
-            max_batch_delay_s=0.005, slo=slo,
+            max_batch_delay_s=0.005, slo=slo, pipeline_depth=depth,
         )
         payloads = []
         for e in examples:
@@ -110,11 +113,16 @@ def bench_serve(
                 )
         dt = time.perf_counter() - t0
         latencies = sorted(batcher.recent_latencies)
+        probs = [
+            None if r.error is not None else r.result for r in reqs
+        ]
+        pstats = batcher.pipeline_stats()
         batcher.close()
-        return dt, len(reqs), latencies
+        return dt, probs, latencies, pstats
 
-    cold_dt, scored, _ = one_pass()  # frontend runs (cache cold)
-    warm_dt, _, lat = one_pass()  # cache hits: batching + device only
+    cold_dt, probs0, _, _ = one_pass()  # frontend runs (cache cold)
+    warm_dt, _, lat, _ = one_pass()  # cache hits: batching + device only
+    scored = len(probs0)
 
     # SLO + tracing tax on the warm path (ISSUE 6 satellite): plain vs
     # fully-instrumented (request tracing with flow events + SLO window
@@ -137,7 +145,7 @@ def bench_serve(
                 if instrumented:
                     obs_trace.enable(td, process_name="bench-serve")
                 try:
-                    dt_i, _, _ = one_pass(
+                    dt_i, _, _, _ = one_pass(
                         slo=obs_slo.SloEngine() if instrumented
                         else None
                     )
@@ -155,6 +163,39 @@ def bench_serve(
             )
     plain_rps = scored / statistics.median(plain_dts)
     inst_rps = scored / statistics.median(inst_dts)
+
+    # pipelined-vs-serial comparison (ISSUE 17): same interleaved-reps
+    # method as the obs-overhead measurement — serial (depth=0) and
+    # pipelined (depth=2) warm passes alternate so throughput drift
+    # cancels. The pipelined pass must also be BIT-IDENTICAL: the
+    # packing, programs, and FIFO order are unchanged, only the sync
+    # point moves to the fetch thread.
+    pipeline_depth = 2
+    serial_dts: list[float] = []
+    pipe_dts: list[float] = []
+    idle_fracs: list[float] = []
+    serial_probs = pipe_probs = None
+    for i in range(2 * reps):
+        depth = pipeline_depth if i % 2 == 1 else 0
+        dt_i, probs_i, _, pstats = one_pass(depth=depth)
+        if depth:
+            pipe_dts.append(dt_i)
+            pipe_probs = probs_i
+            if pstats["device_idle_fraction"] is not None:
+                idle_fracs.append(pstats["device_idle_fraction"])
+        else:
+            serial_dts.append(dt_i)
+            serial_probs = probs_i
+    if serial_probs != pipe_probs:
+        raise SystemExit(
+            "pipelined scores diverged from the serial path "
+            "(bit-identity contract, docs/serving.md)"
+        )
+    serial_rps = scored / statistics.median(serial_dts)
+    pipe_rps = scored / statistics.median(pipe_dts)
+    idle_frac = (
+        round(statistics.median(idle_fracs), 4) if idle_fracs else None
+    )
 
     from deepdfa_tpu.serve.batcher import percentile
 
@@ -191,6 +232,13 @@ def bench_serve(
             max(0.0, 1.0 - inst_rps / plain_rps), 4
         ) if plain_rps else None,
         "serve_obs_overhead_reps": reps,
+        "serve_pipeline_depth": pipeline_depth,
+        "serve_serial_req_per_sec": round(serial_rps, 2),
+        "serve_pipeline_req_per_sec": round(pipe_rps, 2),
+        "serve_pipeline_speedup": (
+            round(pipe_rps / serial_rps, 4) if serial_rps else None
+        ),
+        "serve_device_idle_fraction": idle_frac,
         "n_examples": n,
         "max_batch_graphs": max_batch,
         "smoke": smoke,
@@ -228,6 +276,23 @@ def main() -> None:
             f"{record['serve_steady_state_recompiles']} steady-state "
             f"recompiles in smoke mode (expected 0)"
         )
+    if args.smoke and record["serve_pipeline_speedup"] is not None:
+        # accelerator platforms must show the overlap paying (device
+        # compute runs on separate silicon, so pipelined >= serial);
+        # on CPU host and "device" share the same cores — a single-core
+        # box physically cannot overlap, so the floor is a near-tie
+        # sanity bound there (full runs gate drift via bench_gate's
+        # serve_pipeline_req_per_sec tolerance row either way)
+        import jax
+
+        floor = 1.0 if jax.default_backend() != "cpu" else 0.8
+        if record["serve_pipeline_speedup"] < floor:
+            raise SystemExit(
+                f"pipelined drive at "
+                f"{record['serve_pipeline_speedup']:.2f}x serial "
+                f"req/s in smoke mode (floor {floor}x on "
+                f"{jax.default_backend()})"
+            )
 
 
 if __name__ == "__main__":

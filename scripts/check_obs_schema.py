@@ -18,7 +18,10 @@ Modes:
                  through the online path) and validate the
                  serve_log.jsonl it produces — the `serve/*` tag half of
                  the schema (docs/serving.md)
-  --serve-log <path>  validate an existing serve_log.jsonl
+  --serve-log <path>  validate an existing serve_log.jsonl; when the
+                 summary stamps serve_pipeline_depth > 0 the pipelined
+                 stage evidence (serve/pipeline/* counters) must be
+                 present too (docs/serving.md "Pipelined execution")
   --scan-log <path>   validate an existing scan_log.jsonl (the repo-
                  scanner's summary records, deepdfa_tpu/scan/ — the
                  `scan/*` + `localize/*` tag half of the schema,
@@ -337,11 +340,33 @@ def main(argv=None) -> int:
 
     tags = sorted({t for r in records for t in flatten_scalars(r)})
     bad = metrics.undeclared_tags(records)
+    problems: list[str] = []
+    if args.serve_log or args.serve_smoke:
+        # pipelined serve_log evidence (ISSUE 17, docs/serving.md): a
+        # summary record claiming `serve_pipeline_depth > 0` must carry
+        # the pipeline stage counters it implies — a depth stamp
+        # without them means the pipelined path silently fell back
+        pipelined = any(
+            isinstance(r.get("serve_pipeline_depth"), (int, float))
+            and r["serve_pipeline_depth"] > 0
+            for r in records
+        )
+        if pipelined:
+            required = (
+                "serve/pipeline/batches",
+                "serve/pipeline/device_busy_seconds",
+                "serve/pipeline/device_idle_fraction",
+            )
+            problems.extend(
+                f"pipelined serve_log missing evidence tag: {t}"
+                for t in required if t not in tags
+            )
     result = {
-        "ok": not bad,
+        "ok": not bad and not problems,
         "records": len(records),
         "tags": len(tags),
         "undeclared": bad,
+        **({"problems": problems} if problems else {}),
     }
     print(json.dumps(result), flush=True)
     if args.out:
@@ -351,6 +376,12 @@ def main(argv=None) -> int:
             "undeclared metric tags (declare them in "
             "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the emitter):\n  "
             + "\n  ".join(bad),
+            file=sys.stderr,
+        )
+        return 1
+    if problems:
+        print(
+            "serve log validation failed:\n  " + "\n  ".join(problems),
             file=sys.stderr,
         )
         return 1

@@ -197,6 +197,8 @@ def test_bias_composes_with_dropout_debug_bits(rng):
                                    atol=5e-5, rtol=1e-4)
 
 
+@pytest.mark.slow  # interpreter e2e (slow lane; the fast lane covers
+# the interpret fallback itself in test_interpret_tpu_mode_fallback)
 def test_t5_encode_integration_interpret(rng, monkeypatch):
     """T5 encoder with attn_impl=flash: bias threads through the kernel;
     eval output matches the XLA lowering; grads (incl. rel_bias) flow."""
@@ -291,6 +293,7 @@ def test_rectangular_cross_attention(rng):
         flash_attention(q, k, v, mask, causal=True, interpret=True)
 
 
+@pytest.mark.slow  # interpreter e2e (see note on the t5 twin above)
 def test_decode_train_integration_interpret(rng, monkeypatch):
     """decode_train with flash: causal+bias self-attn and rectangular
     cross-attn must reproduce the XLA lowering end to end."""
@@ -389,6 +392,23 @@ def _tiny_cfgs():
             dataclasses.replace(cfg, attn_impl="xla", remat=False))
 
 
+def test_interpret_tpu_mode_fallback(rng):
+    """interpret="tpu" must work on every supported jax: with
+    InterpretParams absent it falls back to the legacy interpreter, and
+    the PRNG dropout path degrades to the documented keep-all — so
+    flash-with-dropout == flash-without-dropout / keep_prob exactly."""
+    q, k, v = _qkv(rng, 1, 2, 128, 16, jnp.float32)
+    mask = _ragged_mask(128, [100])
+    base = flash_attention(q, k, v, mask, interpret="tpu")
+    drop = flash_attention(q, k, v, mask, dropout_rate=0.1,
+                           seed=jnp.zeros((1,), jnp.int32),
+                           interpret="tpu")
+    valid = mask[:, None, :, None]
+    err = jnp.abs(jnp.where(valid, drop - base / 0.9, 0.0))
+    assert float(err.max()) < 1e-6
+
+
+@pytest.mark.slow  # interpreter e2e (see the note on the t5 twin)
 def test_encode_integration_interpret(rng, monkeypatch):
     """encode() with attn_impl=flash under scan + jit + grad on CPU.
 
@@ -422,6 +442,7 @@ def test_encode_integration_interpret(rng, monkeypatch):
     assert bool(jnp.all(h1 == h2))
 
 
+@pytest.mark.slow  # 8-device interpreter mesh, the heaviest file member
 def test_ulysses_flash_matches_xla_on_mesh(rng, devices, monkeypatch):
     """Ulysses sp with the flash local kernel == Ulysses with XLA local
     attention, on the 8-device CPU mesh (interpret mode inside
@@ -430,6 +451,7 @@ def test_ulysses_flash_matches_xla_on_mesh(rng, devices, monkeypatch):
 
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from deepdfa_tpu.parallel.compat import shard_map
     from deepdfa_tpu.parallel.ulysses import ulysses_attention
 
     monkeypatch.setenv("DEEPDFA_TPU_FLASH_INTERPRET", "1")
@@ -441,7 +463,7 @@ def test_ulysses_flash_matches_xla_on_mesh(rng, devices, monkeypatch):
     bias = jnp.asarray(rng.standard_normal((H, T, T)) * 0.3, jnp.float32)
 
     def run(impl, bias_slice):
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(None, None, "sp", None),) * 3
                  + (P(None, "sp"),),
                  out_specs=P(None, None, "sp", None), check_vma=False)
@@ -469,7 +491,7 @@ def test_ulysses_flash_matches_xla_on_mesh(rng, devices, monkeypatch):
     # custom-VJP through shard_map + the two all-to-alls: dq cotangent
     # must survive the layout round-trip identically to XLA's
     def grad_run(impl):
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(None, None, "sp", None),) * 3
                  + (P(None, "sp"),),
                  out_specs=P(None, None, "sp", None), check_vma=False)
@@ -489,7 +511,7 @@ def test_ulysses_flash_matches_xla_on_mesh(rng, devices, monkeypatch):
     # flash-with-dropout == xla-without-dropout / 0.9 exactly
     # (exercises ulysses' derive_seed wiring; the real stream is
     # validated on-chip by scripts/flash_tpu_check.py)
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(P(None, None, "sp", None),) * 3 + (P(None, "sp"),),
              out_specs=P(None, None, "sp", None), check_vma=False)
     def f_drop(ql, kl, vl, ml):

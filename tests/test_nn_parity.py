@@ -155,8 +155,11 @@ def test_gated_graph_conv_scan_matches_unroll(rng):
     for ku, ks in zip(
         jax.tree.leaves(g_u), jax.tree.leaves(g_s), strict=True
     ):
+        # atol covers near-zero bias-grad elements: the scan body
+        # (raw-math over the param twins) fuses differently from the
+        # unrolled module calls, so reductions reassociate at f32
         np.testing.assert_allclose(
-            np.asarray(ku), np.asarray(ks), rtol=1e-4, atol=1e-6
+            np.asarray(ku), np.asarray(ks), rtol=1e-4, atol=1e-5
         )
 
 

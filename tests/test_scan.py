@@ -299,6 +299,43 @@ def test_served_lines_bit_identical_to_offline(corpus, served_model):
     assert localizer.jit_lowerings() == n0
 
 
+def test_localizer_pipelined_matches_serial(corpus, served_model):
+    """ISSUE 17: the software-pipelined attribute_all drive (bounded
+    dispatch window, sync-oldest) returns EXACTLY what the serial drive
+    returns — same chunking, same programs, only the sync point moves —
+    and lowers nothing new in steady state."""
+    from deepdfa_tpu.serve.frontend import RequestPreprocessor
+    from deepdfa_tpu.serve.localize import GgnnLocalizer
+
+    examples, _, vocabs = corpus
+    cfg, model, params = served_model
+    pre = RequestPreprocessor(cfg, vocabs, cache_entries=64)
+    feats = _features(pre, examples, 6)
+
+    piped = GgnnLocalizer(
+        model, lambda: params, pipeline_depth=2,
+        node_budget=NODE_BUDGET, edge_budget=EDGE_BUDGET,
+        sizes=(1, 2, 4), method="saliency", n_steps=2, top_k=0,
+    )
+    piped.warmup()
+    n0 = piped.jit_lowerings()
+
+    # serial reference: per-chunk attribute() IS the serial composition
+    # of the same stages (and the depth-0 attribute_all code path), over
+    # the same greedy chunking the pipelined drive uses
+    ref, chunk = [], []
+    for f in feats:
+        if chunk and not piped.fits(chunk, f):
+            ref.extend(piped.attribute(chunk))
+            chunk = []
+        chunk.append(f)
+    ref.extend(piped.attribute(chunk))
+
+    out = piped.attribute_all(feats)
+    assert out == ref, "pipelined attribute_all != serial"
+    assert piped.jit_lowerings() == n0
+
+
 def test_shared_frontend_cache_namespace(corpus, served_model):
     """Satellite 6: two preprocessors handed the shared store hit each
     other's entries (scan warm-fills serve, and vice versa)."""

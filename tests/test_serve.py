@@ -548,3 +548,159 @@ def test_combined_executor_graphs_never_degrade(corpus):
             f"graph {p[1].graph_id} ({p[1].num_nodes} nodes): "
             f"{req.result} batched vs {alone[id(p)]} alone"
         )
+
+
+# -- pipelined execution (ISSUE 17, docs/serving.md "Pipelined execution") --
+
+
+def test_pipelined_bit_identical_any_interleaving(corpus, served_model):
+    """Property: with pipeline_depth >= 2, every request's score equals
+    the serial path's AND the singleton score EXACTLY, under arbitrary
+    request mixes — pipelining moves the sync point, never the
+    numerics."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=4)
+    executor.warmup()
+
+    alone = {}
+    for s in specs:
+        solo = DynamicBatcher(executor, queue_limit=8)
+        [req] = solo.score_all([s])
+        alone[s.graph_id] = req.result
+
+    rng = np.random.default_rng(7)
+    for round_ in range(4):
+        order = rng.permutation(len(specs))
+        serial = DynamicBatcher(executor, queue_limit=64)
+        pipelined = DynamicBatcher(
+            executor, queue_limit=64, pipeline_depth=2
+        )
+        sreqs = serial.score_all([specs[i] for i in order])
+        preqs = pipelined.score_all([specs[i] for i in order])
+        pipelined.close()
+        for i, sr, pr in zip(order, sreqs, preqs):
+            gid = specs[i].graph_id
+            assert pr.result == sr.result == alone[gid], (
+                f"round {round_}: graph {gid} scored {pr.result} "
+                f"pipelined vs {sr.result} serial vs {alone[gid]} alone"
+            )
+            # fetch-side attribution landed on every request
+            assert pr.device_s is not None and pr.device_s >= 0.0
+            assert pr.queue_wait_s is not None
+
+
+class _InflightProbe:
+    """Executor wrapper counting concurrently dispatched-but-unsynced
+    batches (the in-flight window the depth bound promises)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.now = 0
+        self.peak = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def dispatch(self, key, packed):
+        self.now += 1
+        self.peak = max(self.peak, self.now)
+        return self._inner.dispatch(key, packed)
+
+    def fetch(self, handle, n):
+        import time as _time
+
+        # stretch the sync so the dispatcher has every chance to race
+        # past the bound if the window were leaky
+        _time.sleep(0.005)
+        out = self._inner.fetch(handle, n)
+        self.now -= 1
+        return out
+
+
+def test_pipelined_inflight_never_exceeds_depth(corpus, served_model):
+    """Backpressure: dispatched-but-unsynced batches never exceed
+    pipeline_depth in either drive mode, and the queue-depth accounting
+    stays truthful (drains to zero once resolved)."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=2)
+    executor.warmup()
+    depth = 2
+
+    # offline drive
+    probe = _InflightProbe(executor)
+    batcher = DynamicBatcher(probe, queue_limit=64, pipeline_depth=depth)
+    reqs = batcher.score_all(list(specs))
+    assert all(r.error is None for r in reqs)
+    assert probe.peak <= depth
+    assert probe.peak >= 2  # the window actually filled
+    assert batcher.stats()["queue_depth"] == 0
+    assert batcher.stats()["pipeline_in_flight"] == 0
+    batcher.close()
+
+    # online drive (scheduler + fetch thread)
+    probe = _InflightProbe(executor)
+    batcher = DynamicBatcher(
+        probe, queue_limit=64, max_batch_delay_s=0.002,
+        pipeline_depth=depth,
+    )
+    batcher.start()
+    try:
+        reqs = [batcher.submit(s) for s in specs]
+        probs = [r.wait(timeout=30.0) for r in reqs]
+        assert all(0.0 <= p <= 1.0 for p in probs)
+        assert probe.peak <= depth
+        assert batcher.stats()["queue_depth"] == 0
+    finally:
+        batcher.close()
+    assert batcher.stats()["pipeline_in_flight"] == 0
+
+
+def test_pipelined_zero_steady_state_lowerings(corpus, served_model):
+    """The pipelined path reuses the SAME warmed ladder executables —
+    no request mix may trigger a lowering after warmup."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=4)
+    executor.warmup()
+    n0 = executor.jit_lowerings()
+    rng = np.random.default_rng(11)
+    for _ in range(3):
+        sel = rng.choice(len(specs), size=rng.integers(1, 9), replace=False)
+        batcher = DynamicBatcher(
+            executor, queue_limit=64, pipeline_depth=2
+        )
+        batcher.score_all([specs[i] for i in sel])
+        batcher.close()
+    assert executor.jit_lowerings() == n0
+
+
+def test_pipelined_dispatch_error_isolated(corpus, served_model):
+    """A batch whose dispatch dies must fail ONLY its own requests,
+    release its in-flight slot, and leave the batcher serviceable."""
+    _, specs, _ = corpus
+    _, model, params = served_model
+    executor = make_executor(model, params, max_batch=2)
+    executor.warmup()
+
+    class _Bomb(_InflightProbe):
+        def __init__(self, inner):
+            super().__init__(inner)
+            self.armed = True
+
+        def dispatch(self, key, packed):
+            if self.armed:
+                self.armed = False
+                raise RuntimeError("boom")
+            return super().dispatch(key, packed)
+
+    probe = _Bomb(executor)
+    batcher = DynamicBatcher(probe, queue_limit=64, pipeline_depth=2)
+    reqs = batcher.score_all(list(specs[:4]))
+    failed = [r for r in reqs if r.error is not None]
+    ok = [r for r in reqs if r.error is None]
+    assert failed and ok  # first batch died, the rest scored
+    assert all(isinstance(r.error, RuntimeError) for r in failed)
+    assert batcher.stats()["pipeline_in_flight"] == 0
+    batcher.close()

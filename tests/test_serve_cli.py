@@ -90,9 +90,12 @@ def test_serve_smoke_http_round_trip(tmp_path):
     echoed = report["scored"][0]  # the first request opted into trace
     assert "stages" in echoed and "device_ms" in echoed["stages"]
     assert report["trace_flow_phases"] == ["f", "s", "t"]
-    assert set(report["trace_linked_spans"]) >= {
-        "frontend", "queue_wait", "device_execute"
-    }
+    linked = set(report["trace_linked_spans"])
+    assert linked >= {"frontend", "queue_wait"}
+    # the device half of the chain: the smoke pins pipeline_depth=2, so
+    # the request links through the dispatch+fetch pair (a serial run
+    # would link one inline device_execute span instead)
+    assert "device_execute" in linked or {"dispatch", "fetch"} <= linked
     run_dir = Path(report["run_dir"])
     assert (run_dir / "trace" / "trace.json").exists()
 
