@@ -2316,6 +2316,62 @@ def cmd_fleet_rollout(args) -> None:
         raise SystemExit(1)
 
 
+def cmd_fleet_drill(args) -> None:
+    """Scheduled chaos drills (docs/fleet.md): run failure-matrix
+    scenarios on a cadence and fold the measured failover/readmit/
+    reseed/rollback times into one DRILL record — the gated trajectory
+    `scripts/bench_gate.py --drill` regresses round over round, with
+    the documented 3.2 s failover bound as an absolute ceiling.
+    --smoke drills an in-process stub fleet (<60 s); full mode drives
+    `scripts/fault_inject.py --fleet` scenario subprocesses (real
+    replica processes, real SIGKILLs)."""
+    import tempfile as tempfile_mod
+
+    from deepdfa_tpu.core import config as _config_mod
+    from deepdfa_tpu.fleet import drill as fleet_drill
+
+    # cadence defaults come from config (fleet.drill_rounds /
+    # fleet.drill_interval_s) so a scheduler entry and the CLI agree
+    cfg = (
+        _config_mod.load(Path(args.config)) if args.config
+        else _config_mod.Config()
+    )
+    cfg = _config_mod.apply_overrides(cfg, args.overrides)
+    rounds = (
+        args.rounds if args.rounds is not None
+        else cfg.fleet.drill_rounds
+    )
+    interval_s = (
+        args.interval if args.interval is not None
+        else cfg.fleet.drill_interval_s
+    )
+    if args.smoke:
+        with tempfile_mod.TemporaryDirectory() as td:
+            record = fleet_drill.DrillScheduler(
+                runner=lambda i: fleet_drill.run_smoke_drill(
+                    Path(td) / f"round{i}"
+                ),
+                rounds=rounds, interval_s=interval_s,
+                scenarios=fleet_drill.SMOKE_SCENARIOS, mode="smoke",
+            ).run()
+    else:
+        scenarios = (
+            tuple(args.scenario) if args.scenario
+            else fleet_drill.FULL_SCENARIOS
+        )
+        record = fleet_drill.DrillScheduler(
+            runner=lambda i: fleet_drill.run_full_drill(scenarios),
+            rounds=rounds, interval_s=interval_s,
+            scenarios=scenarios, mode="full",
+        ).run()
+    if args.out:
+        path = fleet_drill.write_drill_record(record, args.out)
+        record["path"] = str(path)
+    print(json.dumps(record), flush=True)
+    if not record.get("ok"):
+        raise SystemExit(1)
+
+
 def cmd_bench(args) -> None:
     import bench
 
@@ -2811,6 +2867,36 @@ def main(argv=None) -> None:
                    dest="overrides",
                    help="dotted key=value config override (repeatable)")
     p.set_defaults(fn=cmd_fleet_rollout)
+
+    p = sub.add_parser(
+        "fleet-drill",
+        help="scheduled chaos drills: failure-matrix scenarios on a "
+        "cadence, measured recovery times folded into a gated "
+        "DRILL_r* record (scripts/bench_gate.py --drill; "
+        "docs/fleet.md)",
+    )
+    p.add_argument("--smoke", action="store_true",
+                   help="in-process stub-fleet drill (<60 s, tier-1 "
+                        "surface); default: full mode via "
+                        "fault_inject.py --fleet subprocesses")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="drill rounds to fold into one record "
+                        "(default fleet.drill_rounds)")
+    p.add_argument("--interval", type=float, default=None,
+                   help="seconds between round STARTS "
+                        "(default fleet.drill_interval_s)")
+    p.add_argument("--scenario", action="append", default=[],
+                   help="full-mode failure-matrix row (repeatable; "
+                        "default wedge-backend, rollout, kill-router)")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="write the record to the next DRILL_rNN.json "
+                        "slot under DIR (the repo root grows the "
+                        "committed trajectory)")
+    p.add_argument("--config", default=None, help="json config file")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_fleet_drill)
 
     p = sub.add_parser("bench")
     _add_common(p)

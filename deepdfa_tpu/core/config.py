@@ -603,6 +603,37 @@ class FleetConfig:
     # health probe, inject scoring latency) — the fleet chaos harness
     # flips this; never on by default
     chaos: bool = False
+    # -- coordination backend (fleet/coord.py)
+    # which CoordinationBackend the fleet's shared-state protocol
+    # (heartbeats, router.json rendezvous, fleet_log) rides: "local"
+    # (default; today's byte-identical atomic files under the fleet
+    # dir) or "faultable" (the same files behind the chaos fault-
+    # injection wrapper — drills only, never production)
+    coord_backend: str = "local"
+    # -- scheduled chaos drills (fleet/drill.py, cli `fleet-drill`)
+    # cadence between drill rounds; the smoke collapses it to ~0 so
+    # one scheduled round still exercises the scheduler
+    drill_interval_s: float = 3600.0
+    # failure-matrix rounds one `fleet-drill` invocation executes
+    drill_rounds: int = 1
+    # -- predictive autoscaling (fleet/autoscale.py; default OFF so
+    # the default fleet path stays byte-identical)
+    autoscale: bool = False
+    # how far ahead the arrival-process forecast looks
+    autoscale_horizon_s: float = 5.0
+    # arrival-rate bucket width for the fleet_log replay
+    autoscale_bucket_s: float = 1.0
+    # degradation ladder engages (and a replica is spawned) when the
+    # forecast crosses this fraction of measured fleet capacity —
+    # BEFORE the offered load itself crosses it
+    autoscale_up_fraction: float = 0.8
+    # scale back down only below this fraction (the hysteresis band
+    # between the two thresholds is where the controller holds)
+    autoscale_down_fraction: float = 0.3
+    # minimum seconds between replica-count changes (no flapping)
+    autoscale_cooldown_s: float = 10.0
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
 
 
 @dataclass(frozen=True)

@@ -22,12 +22,36 @@ per-tenant admission control and deadline-aware load shedding.
   heartbeat thread; SIGTERM drains (stop accepting, finish in-flight
   batches, final SLO snapshot + flight-recorder postmortem) instead of
   dropping work.
+- `fleet.coord` — the pluggable coordination backend every fleet
+  module reads/writes shared state through (heartbeats, the
+  `router.json` rendezvous with epoch fencing, the fleet log): the
+  default `LocalDirBackend` keeps today's shared-directory files
+  byte-identical; `FaultableBackend` wraps it with injectable latency,
+  stale reads, torn/lost writes, and partitions for the drills. Also
+  home of `poll_until`, the one bounded-backoff poll helper.
+- `fleet.drill` — scheduled chaos drills: recurring execution of the
+  failure-matrix scenarios with measured failover/readmit/rollback/
+  reseed times recorded into the gated `DRILL_r*.json` trajectory.
+- `fleet.autoscale` — predictive autoscaling: replay fleet-log arrival
+  rates, forecast near-term load, and walk the degradation ladder
+  (shed_stage2 → tighten_admission → scale_up) AHEAD of predicted
+  saturation, every decision a schema-valid fleet-log record.
 - `fleet.smoke` — the `fleet --smoke` end-to-end drive (tier-1).
 
-Everything here is opt-in via the `fleet`/`fleet-replica` CLI commands;
-the default single-process `serve` path never imports this package.
+Everything here is opt-in via the `fleet`/`fleet-replica`/`fleet-drill`
+CLI commands; the default single-process `serve` path never imports
+this package.
 """
 
 from __future__ import annotations
 
-__all__ = ["admission", "heartbeat", "replica", "router", "smoke"]
+__all__ = [
+    "admission",
+    "autoscale",
+    "coord",
+    "drill",
+    "heartbeat",
+    "replica",
+    "router",
+    "smoke",
+]

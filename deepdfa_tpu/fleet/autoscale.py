@@ -1,0 +1,530 @@
+"""Predictive fleet autoscaling (docs/fleet.md).
+
+The fleet already has every signal a scaler needs — the router's
+fleet_log records one `{"request": ...}` line per ingress arrival, the
+admission controller knows per-replica capacity, and `plan_replicas`
+knows how many serving stacks the host's HBM budget fits. This module
+closes the loop PREDICTIVELY: replay the log's arrival process into
+per-bucket offered rates (the `tune/ladder.py` replay idiom: parse
+lines, skip what does not parse), forecast the near-term rate by
+extrapolating the recent trend, and drive a hysteresis/cooldown
+controller whose degradation ladder acts AHEAD of the predicted load:
+
+  stage 1  shed_stage2        tighten `cascade_shed_fraction` — stage-2
+                              cascade escalations shed first (they
+                              already hold a stage-1 answer)
+  stage 2  tighten_admission  tighten `shed_fraction` — priority>0
+                              traffic sheds earlier
+  stage 3  scale_up           one more replica (cooldown-gated, capped
+                              by `fleet.autoscale_max_replicas` AND the
+                              `plan_replicas` HBM-budget cap)
+
+and symmetrically `relax` then `scale_down` when the forecast falls
+below the low-water fraction. Every decision — including holds — is a
+`{"autoscale": {...}}` record in the shared fleet_log, validated by
+`validate_fleet_log` against the declared action vocabulary
+(`fleet/router.py:AUTOSCALE_ACTIONS`).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from pathlib import Path
+
+from deepdfa_tpu.fleet import admission as fleet_admission
+from deepdfa_tpu.fleet.router import AUTOSCALE_ACTIONS
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+#: how much log tail the arrival replay scans (the reseed convention)
+REPLAY_TAIL_BYTES = 4 << 20
+
+
+# ---------------------------------------------------------------------------
+# arrival replay + forecast
+
+
+def arrival_rates_from_log(
+    path: str | Path,
+    bucket_s: float = 1.0,
+    backend=None,
+    max_bytes: int = REPLAY_TAIL_BYTES,
+) -> list[tuple[float, float]]:
+    """The log's offered-rate series: [(bucket_start_unix, req/s)],
+    bucketed over every `{"request": ...}` record's `t_unix`, gaps
+    filled with 0.0 (an idle minute is a real observation, not missing
+    data). Rides the backend's torn-tolerant tail — a truncated final
+    line costs one arrival, never the replay."""
+    from deepdfa_tpu.fleet import coord
+
+    bucket_s = max(1e-6, float(bucket_s))
+    try:
+        records = (backend or coord.LOCAL).tail_records(path, max_bytes)
+    except OSError:
+        return []
+    counts: dict[int, int] = {}
+    for rec in records:
+        req = rec.get("request")
+        if not isinstance(req, dict):
+            continue
+        t = req.get("t_unix")
+        if not isinstance(t, (int, float)):
+            continue
+        counts[int(math.floor(float(t) / bucket_s))] = counts.get(
+            int(math.floor(float(t) / bucket_s)), 0
+        ) + 1
+    if not counts:
+        return []
+    lo, hi = min(counts), max(counts)
+    return [
+        (k * bucket_s, counts.get(k, 0) / bucket_s)
+        for k in range(lo, hi + 1)
+    ]
+
+
+def forecast_rate(
+    history: list[tuple[float, float]],
+    horizon_s: float,
+    window: int = 8,
+) -> float:
+    """The offered rate `horizon_s` from the last observation: a
+    least-squares trend over the last `window` buckets, extrapolated
+    forward and clamped at zero. With one bucket (or a degenerate
+    window) the forecast IS the last rate — no trend, no extrapolation."""
+    if not history:
+        return 0.0
+    pts = history[-max(2, int(window)):]
+    t_last, r_last = pts[-1]
+    if len(pts) < 2:
+        return max(0.0, float(r_last))
+    ts = [t for t, _ in pts]
+    rs = [r for _, r in pts]
+    t_mean = sum(ts) / len(ts)
+    r_mean = sum(rs) / len(rs)
+    var = sum((t - t_mean) ** 2 for t in ts)
+    if var <= 0:
+        return max(0.0, float(r_last))
+    slope = sum(
+        (t - t_mean) * (r - r_mean) for t, r in zip(ts, rs)
+    ) / var
+    return max(0.0, float(r_last) + slope * float(horizon_s))
+
+
+# ---------------------------------------------------------------------------
+# the controller
+
+
+def max_replicas_from_ledger(
+    cfg_max: int,
+    entry_bytes: dict[str, float] | None,
+    hbm_budget_bytes: float,
+) -> tuple[int, dict]:
+    """The effective scale-up ceiling: the configured max, capped by how
+    many full serving stacks the HBM budget actually fits
+    (`plan_replicas` over the per-entry param-bytes ledger signal).
+    Unbudgeted or unmeasured hosts keep the configured max."""
+    n, plan = fleet_admission.plan_replicas(
+        entry_bytes or {}, hbm_budget_bytes, default=int(cfg_max)
+    )
+    return max(1, min(int(cfg_max), n)), plan
+
+
+class AutoscaleController:
+    """Hysteresis/cooldown controller over the forecast-to-capacity
+    ratio. One `decide()` per arrival bucket:
+
+      ratio >= up_fraction    escalate ONE rung per bucket —
+                              shed_stage2, then tighten_admission, then
+                              scale_up (cooldown-gated, bounded by
+                              max_replicas)
+      ratio <= down_fraction  de-escalate — relax the admission ladder
+                              first, then scale_down (cooldown-gated,
+                              bounded by min_replicas)
+      in between              hold (hysteresis: the band between the
+                              fractions is deliberately dead)
+
+    The one-rung-per-bucket ladder is the point: under a rising
+    forecast the fleet degrades REVERSIBLY (shed escalations, tighten
+    admission) before it pays for a replica, and the forecast's lead
+    time (`horizon_s` ahead) means the replica lands before the load
+    does. `clock` is injectable; the replay passes bucket timestamps so
+    cooldown behaves identically live and in tests."""
+
+    def __init__(
+        self,
+        capacity_rps: float,
+        up_fraction: float = 0.8,
+        down_fraction: float = 0.3,
+        cooldown_s: float = 10.0,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        horizon_s: float = 5.0,
+        bucket_s: float = 1.0,
+        clock=time.monotonic,
+    ):
+        if capacity_rps <= 0:
+            raise ValueError(f"capacity_rps must be >0, got {capacity_rps}")
+        if not 0.0 <= down_fraction < up_fraction:
+            raise ValueError(
+                f"need 0 <= down_fraction < up_fraction, got "
+                f"{down_fraction} / {up_fraction}"
+            )
+        self.capacity_rps = float(capacity_rps)
+        self.up_fraction = float(up_fraction)
+        self.down_fraction = float(down_fraction)
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.horizon_s = float(horizon_s)
+        self.bucket_s = float(bucket_s)
+        self.clock = clock
+        #: admission-ladder stage: 0 none, 1 shed_stage2 applied,
+        #: 2 tighten_admission applied
+        self.stage = 0
+        self._last_scale_t: float | None = None
+        self._orig: tuple[float, float] | None = None
+
+    @classmethod
+    def from_config(
+        cls,
+        cfg,
+        capacity_rps: float,
+        entry_bytes: dict[str, float] | None = None,
+        hbm_budget_bytes: float = 0.0,
+    ) -> "AutoscaleController":
+        fcfg = cfg.fleet
+        cap, _ = max_replicas_from_ledger(
+            fcfg.autoscale_max_replicas, entry_bytes, hbm_budget_bytes
+        )
+        return cls(
+            capacity_rps=capacity_rps,
+            up_fraction=fcfg.autoscale_up_fraction,
+            down_fraction=fcfg.autoscale_down_fraction,
+            cooldown_s=fcfg.autoscale_cooldown_s,
+            min_replicas=fcfg.autoscale_min_replicas,
+            max_replicas=cap,
+            horizon_s=fcfg.autoscale_horizon_s,
+            bucket_s=fcfg.autoscale_bucket_s,
+        )
+
+    def _cooldown_ok(self, now: float) -> bool:
+        return (
+            self._last_scale_t is None
+            or now - self._last_scale_t >= self.cooldown_s
+        )
+
+    def decide(
+        self, forecast_rps: float, replicas: int, now: float | None = None
+    ) -> dict:
+        """One ladder step against the forecast; returns the decision
+        the caller applies (`apply_to` for admission rungs, its own
+        spawn/retire for the scale rungs) and logs verbatim."""
+        now = self.clock() if now is None else float(now)
+        replicas = max(1, int(replicas))
+        capacity = self.capacity_rps * replicas
+        ratio = float(forecast_rps) / capacity
+        action, reason, target = "hold", "in_band", replicas
+        if ratio >= self.up_fraction:
+            if self.stage == 0:
+                action, reason = "shed_stage2", "ladder_stage_1"
+                self.stage = 1
+            elif self.stage == 1:
+                action, reason = "tighten_admission", "ladder_stage_2"
+                self.stage = 2
+            elif replicas >= self.max_replicas:
+                reason = "at_max_replicas"
+            elif not self._cooldown_ok(now):
+                reason = "cooldown"
+            else:
+                action, reason = "scale_up", "forecast_over_high_water"
+                target = replicas + 1
+                self._last_scale_t = now
+        elif ratio <= self.down_fraction:
+            if self.stage > 0:
+                action, reason = "relax", "ladder_unwind"
+                self.stage = 0
+            elif replicas <= self.min_replicas:
+                reason = "at_min_replicas"
+            elif not self._cooldown_ok(now):
+                reason = "cooldown"
+            else:
+                action, reason = "scale_down", "forecast_under_low_water"
+                target = replicas - 1
+                self._last_scale_t = now
+        assert action in AUTOSCALE_ACTIONS, action
+        obs_metrics.REGISTRY.counter("autoscale/decisions").inc()
+        obs_metrics.REGISTRY.counter(f"autoscale/{action}").inc()
+        return {
+            "action": action,
+            "reason": reason,
+            "t_unix": round(time.time(), 3),
+            "decided_at": round(now, 3),
+            "forecast_rps": round(float(forecast_rps), 3),
+            "capacity_rps": round(capacity, 3),
+            "ratio": round(ratio, 4),
+            "replicas": replicas,
+            "target_replicas": target,
+            "stage": self.stage,
+        }
+
+    def apply_to(self, admission, decision: dict) -> None:
+        """Apply an admission-ladder rung to a live
+        `AdmissionController` by mutating its shed fractions; `relax`
+        restores the values observed on first application. The scale
+        rungs are the CALLER's to execute (spawn/retire a replica) —
+        this method only ever touches admission policy."""
+        if self._orig is None:
+            self._orig = (
+                float(admission.shed_fraction),
+                float(admission.cascade_shed_fraction),
+            )
+        action = decision["action"]
+        if action == "shed_stage2":
+            admission.cascade_shed_fraction = min(
+                self._orig[1], 0.5 * self._orig[1]
+            )
+        elif action == "tighten_admission":
+            admission.shed_fraction = min(
+                self._orig[0], 0.8 * self._orig[0]
+            )
+        elif action == "relax":
+            admission.shed_fraction = self._orig[0]
+            admission.cascade_shed_fraction = self._orig[1]
+
+    @staticmethod
+    def log_record(decision: dict) -> dict:
+        """The fleet_log line for one decision (the shape
+        `validate_fleet_log`'s autoscale branch checks)."""
+        return {"autoscale": dict(decision)}
+
+
+def replay(
+    rates: list[tuple[float, float]],
+    controller: AutoscaleController,
+    replicas: int = 1,
+    on_decision=None,
+) -> list[dict]:
+    """Drive the controller over an offered-rate series (the
+    `arrival_rates_from_log` output): one forecast + one decision per
+    bucket, the replica count tracking the controller's own scale
+    decisions. `on_decision(decision)` fires for every bucket — the
+    smoke uses it to spawn the real second replica the moment the
+    controller asks, the CLI to append log records."""
+    decisions: list[dict] = []
+    history: list[tuple[float, float]] = []
+    for t, rate in rates:
+        history.append((float(t), float(rate)))
+        forecast = forecast_rate(history, controller.horizon_s)
+        decision = controller.decide(forecast, replicas, now=float(t))
+        decision["bucket_t"] = float(t)
+        decision["offered_rps"] = round(float(rate), 3)
+        if decision["action"] in ("scale_up", "scale_down"):
+            replicas = int(decision["target_replicas"])
+        if on_decision is not None:
+            on_decision(decision)
+        decisions.append(decision)
+    return decisions
+
+
+# ---------------------------------------------------------------------------
+# the smoke: scale 1 -> 2 AHEAD of a replayed ramp, zero requests lost
+
+
+def run_smoke_autoscale(tmp: str | Path, parts=None) -> dict:
+    """The `fleet --smoke` autoscale phase (<60 s, in-process):
+
+    1. bring up ONE stub replica behind a real router and MEASURE its
+       capacity from observed service latency;
+    2. synthesize a ramp fleet_log whose offered rate climbs from
+       0.2x to 1.3x that capacity;
+    3. replay it through the controller — the ladder must escalate
+       shed_stage2 -> tighten_admission -> scale_up, with the scale_up
+       landing while the offered rate is still BELOW capacity (the
+       forecast's lead time is the whole point);
+    4. spawn the second stub replica the moment the controller asks,
+       then drive a real burst through the router with ZERO requests
+       lost;
+    5. append every decision to the router's fleet_log and validate it.
+
+    `parts` is an optional pre-built `chaos.build_stub_parts` tuple so
+    a caller running several smoke phases pays for the stub model
+    once.
+    """
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.fleet import chaos as fleet_chaos, coord
+    from deepdfa_tpu.fleet.router import (
+        BackgroundRouter,
+        FleetLog,
+        router_from_config,
+        validate_fleet_log,
+    )
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+        "serve.max_batch_graphs=1",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+        "serve.slo_windows=[5, 60]",
+        "fleet.heartbeat_timeout_s=3600.0",
+        "fleet.poll_interval_s=0.1",
+        "fleet.request_timeout_s=5.0",
+        "fleet.summary_interval_s=0.2",
+        "fleet.autoscale=true",
+    ])
+    fcfg = cfg.fleet
+    model, params, vocabs, codes = (
+        parts if parts is not None else fleet_chaos.build_stub_parts(cfg)
+    )
+    fleet_dir = Path(tmp) / "autoscale"
+    log_path = fleet_dir / "fleet_log.jsonl"
+    replicas = [
+        fleet_chaos.StubReplicaServer(
+            cfg, fleet_dir, "r0",
+            fleet_chaos.stub_service(
+                cfg, fleet_dir, "r0", model, params, vocabs
+            ),
+        )
+    ]
+    router = router_from_config(cfg, fleet_dir, log_path=log_path)
+    server = BackgroundRouter(router)
+    out: dict = {}
+    try:
+        # -- measure per-replica capacity from real service latency
+        lat: list[float] = []
+        for code in (codes * 2)[:6]:
+            t0 = time.monotonic()
+            status, resp = server.request(
+                "POST", "/score", {"code": code}
+            )
+            assert status == 200, (status, resp)
+            lat.append(time.monotonic() - t0)
+        measured_rps = 1.0 / max(1e-3, sum(lat) / len(lat))
+        # clamp the ramp's capacity so the synthetic log stays small on
+        # fast hosts; the controller and the ramp share the SAME number,
+        # so "scaled ahead of capacity" means what it says
+        capacity_rps = min(50.0, measured_rps)
+        out["measured_rps"] = round(measured_rps, 2)
+        out["capacity_rps"] = round(capacity_rps, 2)
+
+        # -- synthesize the ramp: 0.2x -> 1.3x capacity, one bucket per
+        # step, timestamps safely in the past so the replay window is
+        # disjoint from live traffic
+        bucket_s = float(fcfg.autoscale_bucket_s)
+        fractions = [0.2 + 0.1 * i for i in range(12)]
+        base = math.floor(time.time() - 120.0)
+        ramp_path = fleet_dir / "ramp_log.jsonl"
+        ramp_log = FleetLog(ramp_path)
+        try:
+            for k, frac in enumerate(fractions):
+                n = max(1, round(frac * capacity_rps * bucket_s))
+                for j in range(n):
+                    ramp_log.append({"request": {
+                        "id": f"ramp-{k}-{j}", "status": 200,
+                        "latency_ms": round(1e3 / measured_rps, 3),
+                        "t_unix": round(
+                            base + k * bucket_s + j * bucket_s / n, 3
+                        ),
+                        "tenant": "ramp", "priority": 1,
+                        "retries": 0, "shed": 0,
+                    }})
+        finally:
+            ramp_log.close()
+        rates = arrival_rates_from_log(ramp_path, bucket_s)
+        assert len(rates) == len(fractions), (len(rates), len(fractions))
+
+        # -- replay through the controller; the second REAL replica
+        # spawns the moment the controller decides scale_up
+        cap_n, plan = max_replicas_from_ledger(
+            fcfg.autoscale_max_replicas,
+            {"deepdfa": 1.0}, 0.0,  # unbudgeted stub host: cfg max rules
+        )
+        controller = AutoscaleController(
+            capacity_rps=capacity_rps,
+            up_fraction=fcfg.autoscale_up_fraction,
+            down_fraction=fcfg.autoscale_down_fraction,
+            cooldown_s=fcfg.autoscale_cooldown_s,
+            min_replicas=fcfg.autoscale_min_replicas,
+            max_replicas=cap_n,
+            horizon_s=fcfg.autoscale_horizon_s,
+            bucket_s=bucket_s,
+        )
+        out["max_replicas"] = cap_n
+        out["plan_reason"] = plan.get("reason")
+
+        def _on_decision(decision: dict) -> None:
+            controller.apply_to(router.admission, decision)
+            router.log.append(AutoscaleController.log_record(decision))
+            if decision["action"] == "scale_up" and len(replicas) == 1:
+                replicas.append(fleet_chaos.StubReplicaServer(
+                    cfg, fleet_dir, "r1",
+                    fleet_chaos.stub_service(
+                        cfg, fleet_dir, "r1", model, params, vocabs
+                    ),
+                ))
+
+        decisions = replay(
+            rates, controller, replicas=1, on_decision=_on_decision
+        )
+        actions = [d["action"] for d in decisions]
+        out["actions"] = actions
+        scale_idx = actions.index("scale_up") if "scale_up" in actions else None
+        out["scaled"] = scale_idx is not None
+        if scale_idx is not None:
+            rate_at_scale = decisions[scale_idx]["offered_rps"]
+            peak = max(r for _, r in rates)
+            out["rate_at_scale_rps"] = rate_at_scale
+            out["peak_rps"] = round(peak, 2)
+            out["scaled_ahead"] = (
+                rate_at_scale < capacity_rps < peak
+            )
+            out["ladder_before_scale"] = [
+                a for a in actions[:scale_idx]
+                if a in ("shed_stage2", "tighten_admission")
+            ] == ["shed_stage2", "tighten_admission"]
+        else:
+            out["scaled_ahead"] = False
+            out["ladder_before_scale"] = False
+
+        # -- the scaled fleet serves a real burst, nothing lost
+        assert len(replicas) == 2, "second replica never spawned"
+        routable = coord.poll_until(
+            lambda: (router.topology()["routable"] >= 2) or None,
+            20.0, interval_s=0.1, max_interval_s=0.5,
+            what="autoscaled replica routable",
+        )
+        burst = []
+        for code in (codes * 4)[:20]:
+            status, _ = server.request("POST", "/score", {"code": code})
+            burst.append(status)
+        out["burst"] = {
+            "total": len(burst),
+            "lost": sum(1 for s in burst if s != 200),
+            "routable_replicas": router.topology()["routable"],
+            "second_replica_routable": bool(routable),
+        }
+
+        server.close()  # appends the final summary record
+        server = None
+        out["fleet_log"] = {
+            k: v for k, v in validate_fleet_log(log_path).items()
+            if k in ("ok", "records", "autoscale", "problems")
+        }
+        out["ramp_log_ok"] = validate_fleet_log(ramp_path)["ok"]
+        out["ok"] = bool(
+            out["scaled"]
+            and out["scaled_ahead"]
+            and out["ladder_before_scale"]
+            and out["burst"]["lost"] == 0
+            and out["fleet_log"]["ok"]
+            and out["fleet_log"].get("autoscale", 0) >= len(decisions)
+            and out["ramp_log_ok"]
+        )
+    finally:
+        if server is not None:
+            server.close()
+        for r in replicas:
+            r.close()
+    return out

@@ -1,0 +1,508 @@
+"""Pluggable fleet coordination backend (docs/fleet.md).
+
+Every piece of shared fleet state — replica heartbeats, the router
+rendezvous, the append-only fleet_log — used to reach the filesystem
+through ad-hoc `atomic_write_text` / `read_text` / `glob` calls inlined
+across `fleet/heartbeat.py`, `fleet/ha.py`, `fleet/router.py`, and
+`fleet/rollout.py`. This module extracts that protocol behind one
+interface so the HA pair, the chaos drills, and a future off-box
+control plane all speak the same contract:
+
+  CoordinationBackend   the interface: atomic document write/read +
+                        directory scan (heartbeats), rendezvous publish
+                        with EPOCH FENCING (router.json), append/tail
+                        (fleet_log). Fencing lives HERE, not in the
+                        caller: `publish_rendezvous` refuses a publish
+                        superseded by a higher epoch (or an equal-epoch
+                        lexically-smaller router id) and hands back the
+                        winning record, so the active/standby pair works
+                        unchanged over any backend that honors the
+                        contract.
+  LocalDirBackend       the default: today's byte-identical atomic-file
+                        protocol (core/ioutil.py tmp+fsync+rename), with
+                        every op behind the shared bounded retry.
+  FaultableBackend      a wrapper injecting per-path latency, stale
+                        reads, torn/lost writes, and partitions — the
+                        chaos drills' storage-level fault surface. The
+                        faults are observable ONLY through this wrapper;
+                        the inner backend's files stay whatever the
+                        surviving writes made them.
+
+`poll_until` is the one shared bounded poll/retry helper (deadline-
+aware, exponential backoff with jitter, logged + counted on
+exhaustion) replacing the ad-hoc `time.sleep` loops that used to live
+in `ha.resolve_router`, `replica.wait_for_ready`,
+`replica._wait_queue_drain`, and the smoke's drain wait.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+import random
+import threading
+import time
+from pathlib import Path
+
+from deepdfa_tpu.core import ioutil
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+#: the rendezvous document name under a fleet dir (fleet/ha.py re-exports)
+ROUTER_FILE = "router.json"
+
+
+# ---------------------------------------------------------------------------
+# the one shared bounded poll helper
+
+
+def poll_until(
+    predicate,
+    timeout_s: float,
+    *,
+    interval_s: float = 0.05,
+    max_interval_s: float = 0.5,
+    jitter: float = 0.25,
+    what: str = "condition",
+    clock=time.monotonic,
+    sleep=time.sleep,
+):
+    """Poll `predicate` until it returns a truthy value (returned) or
+    `timeout_s` elapses (returns None, logged + counted — exhaustion is
+    never silent).
+
+    The wait between attempts starts at `interval_s` and doubles up to
+    `max_interval_s`, each sleep randomized by ±`jitter` so N pollers
+    watching one file do not synchronize into a thundering herd. The
+    predicate always runs at least once (timeout_s=0 is "check now"),
+    and exceptions it raises propagate — a predicate that can tell the
+    waited-for thing DIED should raise rather than keep polling."""
+    deadline = clock() + max(0.0, float(timeout_s))
+    attempt = 0
+    while True:
+        value = predicate()
+        if value:
+            return value
+        now = clock()
+        if now >= deadline:
+            obs_metrics.REGISTRY.counter("coord/poll_exhausted").inc()
+            logger.warning(
+                "poll for %s exhausted after %.3fs (%d attempt(s))",
+                what, float(timeout_s), attempt + 1,
+            )
+            return None
+        delay = min(interval_s * (2 ** attempt), max_interval_s)
+        if jitter > 0:
+            delay *= 1.0 + random.uniform(-jitter, jitter)
+        sleep(max(0.0, min(delay, deadline - now)))
+        attempt += 1
+
+
+def _retry(fn, what: str):
+    """Every coordination op rides the one bounded retry (transient
+    host I/O blips must not look like a dead peer); deterministic
+    absence (FileNotFoundError) propagates immediately."""
+    return ioutil.with_retries(fn, retries=2, backoff_s=0.05, what=what)
+
+
+# ---------------------------------------------------------------------------
+# the backend contract
+
+
+class CoordinationBackend:
+    """Atomic write/read/scan for heartbeats, fenced rendezvous publish
+    for router.json, append/tail for the fleet_log. Subclasses provide
+    the storage primitives; the rendezvous protocol (including epoch
+    fencing) and torn-line-tolerant tailing are shared here so every
+    backend honors the same contract."""
+
+    # -- storage primitives (subclass responsibility) ------------------------
+
+    def write_doc(self, path: str | Path, text: str) -> None:
+        """Atomically replace `path` with `text` (readers see the old or
+        the new complete content, never a truncation)."""
+        raise NotImplementedError
+
+    def read_doc(self, path: str | Path) -> str:
+        """The document's current content; raises OSError when absent."""
+        raise NotImplementedError
+
+    def scan(self, directory: str | Path, pattern: str) -> list[Path]:
+        """Sorted paths under `directory` matching `pattern` ([] when
+        the directory does not exist)."""
+        raise NotImplementedError
+
+    def open_log(self, path: str | Path):
+        """An append handle (`write_line(text)`, `close()`, `.closed`)
+        for a line-oriented log; each written line is flushed so the
+        log is tail-able while being written."""
+        raise NotImplementedError
+
+    def tail(self, path: str | Path, max_bytes: int) -> list[str]:
+        """The last <= `max_bytes` of the log, split into lines; raises
+        OSError when absent. The first line may be torn by the seek and
+        the last by a concurrent append — `tail_records` absorbs both."""
+        raise NotImplementedError
+
+    # -- shared protocol -----------------------------------------------------
+
+    def tail_records(self, path: str | Path, max_bytes: int) -> list[dict]:
+        """Parsed JSON records from the log tail, in file order. Torn or
+        otherwise unparseable lines (the seek-split first line, a
+        truncated final line from a crashed writer) are skipped, never
+        fatal — a torn tail must cost one record, not the whole read."""
+        records: list[dict] = []
+        for line in self.tail(path, max_bytes):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+        return records
+
+    def read_rendezvous(self, path: str | Path) -> dict | None:
+        """The parsed rendezvous record, or None when absent, unreadable,
+        or malformed (a torn or foreign file is never a crash)."""
+        try:
+            doc = json.loads(self.read_doc(path))
+        except (OSError, json.JSONDecodeError):
+            return None
+        rv = doc.get("router") if isinstance(doc, dict) else None
+        if not isinstance(rv, dict):
+            return None
+        required = ("router_id", "host", "port", "epoch", "t_unix")
+        if any(k not in rv for k in required):
+            return None
+        return rv
+
+    def publish_rendezvous(
+        self,
+        path: str | Path,
+        router_id: str,
+        host: str,
+        port: int,
+        epoch: int,
+        force: bool = True,
+    ) -> dict | None:
+        """Publish the active router's rendezvous; returns None on
+        success or the FENCING record when refused.
+
+        The epoch-fence contract: with `force=False` (the active's
+        periodic refresh) the publish is refused when the current record
+        belongs to another router at a higher epoch, or at an equal
+        epoch with a lexically smaller router id (the deterministic
+        equal-epoch tie-break) — the superseded router must step down,
+        never fight. `force=True` (a takeover publishing epoch+1, or a
+        fresh bring-up) writes unconditionally; epochs only grow because
+        every takeover derives its epoch from the record it replaces."""
+        if not force:
+            rv = self.read_rendezvous(path)
+            if rv is not None and str(rv["router_id"]) != str(router_id) and (
+                int(rv["epoch"]) > int(epoch)
+                or (int(rv["epoch"]) == int(epoch)
+                    and str(rv["router_id"]) < str(router_id))
+            ):
+                obs_metrics.REGISTRY.counter("coord/fenced_publishes").inc()
+                return rv
+        self.write_doc(path, json.dumps({"router": {
+            "router_id": str(router_id),
+            "host": str(host),
+            "port": int(port),
+            "epoch": int(epoch),
+            "t_unix": round(time.time(), 3),
+        }}))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# default backend: today's atomic-file protocol, byte-identical
+
+
+class _LocalLogHandle:
+    """One append handle over a real file (the FleetLog rule: one
+    handle, flushed per line, tail-able while serving)."""
+
+    def __init__(self, path: Path):
+        path.parent.mkdir(parents=True, exist_ok=True)
+        self._file = path.open("a")
+
+    @property
+    def closed(self) -> bool:
+        return self._file.closed
+
+    def write_line(self, text: str) -> None:
+        self._file.write(text + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.close()
+
+
+class LocalDirBackend(CoordinationBackend):
+    """The default backend: the PR-11 atomic-file protocol over one
+    shared directory, unchanged — same tmp+fsync+rename writes
+    (core/ioutil.py), same glob scans, same append-and-flush log. The
+    default fleet path's file layout stays byte-identical."""
+
+    def write_doc(self, path: str | Path, text: str) -> None:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        _retry(
+            lambda: ioutil.atomic_write_text(path, text),
+            what=f"coord write {path.name}",
+        )
+
+    def read_doc(self, path: str | Path) -> str:
+        path = Path(path)
+        return _retry(path.read_text, what=f"coord read {path.name}")
+
+    def scan(self, directory: str | Path, pattern: str) -> list[Path]:
+        directory = Path(directory)
+        if not directory.is_dir():
+            return []
+        return sorted(directory.glob(pattern))
+
+    def open_log(self, path: str | Path):
+        return _retry(
+            lambda: _LocalLogHandle(Path(path)),
+            what=f"coord open log {Path(path).name}",
+        )
+
+    def tail(self, path: str | Path, max_bytes: int) -> list[str]:
+        def _read() -> list[str]:
+            with Path(path).open("rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - int(max_bytes)))
+                return f.read().decode("utf-8", "replace").splitlines()
+
+        return _retry(_read, what=f"coord tail {Path(path).name}")
+
+
+# ---------------------------------------------------------------------------
+# chaos wrapper: the drills' storage-level fault surface
+
+
+class _FaultableLogHandle:
+    def __init__(self, backend: "FaultableBackend", path: Path, inner):
+        self._backend = backend
+        self._path = path
+        self._inner = inner
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
+
+    def write_line(self, text: str) -> None:
+        fault = self._backend._check(self._path, "append")
+        if fault is not None:
+            if fault.take("lose_writes"):
+                self._backend._count("lost_write")
+                return
+            if fault.take("torn_writes"):
+                self._backend._count("torn_write")
+                # a torn append: the line's prefix lands without the
+                # newline — exactly a writer crashing mid-append. The
+                # tail-record contract (skip unparseable lines) is what
+                # keeps this survivable.
+                self._inner.write_line(text[: max(1, len(text) // 2)])
+                # the torn fragment has its newline from write_line; a
+                # truncated final line without one needs the raw file
+                return
+        self._inner.write_line(text)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class _Fault:
+    """One per-path-pattern fault spec with consumable counters."""
+
+    def __init__(
+        self,
+        pattern: str,
+        latency_s: float = 0.0,
+        stale_reads: int = 0,
+        lose_writes: int = 0,
+        torn_writes: int = 0,
+        partitioned: bool = False,
+    ):
+        self.pattern = str(pattern)
+        self.latency_s = float(latency_s)
+        self.stale_reads = int(stale_reads)
+        self.lose_writes = int(lose_writes)
+        self.torn_writes = int(torn_writes)
+        self.partitioned = bool(partitioned)
+        self._lock = threading.Lock()
+
+    def matches(self, path: Path) -> bool:
+        return fnmatch.fnmatch(path.name, self.pattern) or fnmatch.fnmatch(
+            str(path), self.pattern
+        )
+
+    def take(self, counter: str) -> bool:
+        """Consume one unit of a bounded fault (`stale_reads` etc.);
+        False once exhausted."""
+        with self._lock:
+            n = getattr(self, counter)
+            if n <= 0:
+                return False
+            setattr(self, counter, n - 1)
+            return True
+
+
+class FaultableBackend(CoordinationBackend):
+    """A CoordinationBackend wrapper injecting storage faults per path
+    pattern — the chaos drills' way of exercising the fleet against a
+    misbehaving coordination substrate without touching the protocol
+    code. Faults:
+
+      latency_s     every matching op sleeps first (a slow store)
+      stale_reads   the next N matching reads return the PREVIOUS
+                    version this backend overwrote (a lagging replica
+                    of the store)
+      lose_writes   the next N matching writes are silently dropped
+      torn_writes   the next N matching writes land NON-atomically
+                    truncated (what `atomic_write_text` exists to
+                    prevent — readers must survive it anyway)
+      partitioned   matching ops raise OSError until cleared
+
+    Every injection is counted under coord/* so a drill can assert the
+    fault actually fired; none of them is observable through the plain
+    LocalDirBackend."""
+
+    def __init__(self, inner: CoordinationBackend | None = None):
+        self.inner = inner or LocalDirBackend()
+        self._faults: list[_Fault] = []
+        self._prev: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # -- fault programming ---------------------------------------------------
+
+    def set_fault(self, pattern: str, **spec) -> _Fault:
+        """Install one fault for paths matching `pattern` (fnmatch on
+        the file name or the full path); later faults win ties."""
+        fault = _Fault(pattern, **spec)
+        with self._lock:
+            self._faults.insert(0, fault)
+        return fault
+
+    def clear_faults(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def _fault_for(self, path: Path) -> _Fault | None:
+        with self._lock:
+            for fault in self._faults:
+                if fault.matches(path):
+                    return fault
+        return None
+
+    def _count(self, kind: str) -> None:
+        obs_metrics.REGISTRY.counter(f"coord/faults/{kind}").inc()
+
+    def _check(self, path: Path, op: str) -> _Fault | None:
+        """Latency + partition (the faults every op shares); returns the
+        matched fault for op-specific injections."""
+        fault = self._fault_for(path)
+        if fault is None:
+            return None
+        if fault.latency_s > 0:
+            self._count("latency")
+            time.sleep(fault.latency_s)
+        if fault.partitioned:
+            self._count("partition")
+            raise OSError(
+                f"injected partition: {op} {path.name} unreachable"
+            )
+        return fault
+
+    # -- faulted primitives --------------------------------------------------
+
+    def write_doc(self, path: str | Path, text: str) -> None:
+        path = Path(path)
+        fault = self._check(path, "write")
+        if fault is not None and fault.take("lose_writes"):
+            self._count("lost_write")
+            return
+        # stash the version being replaced so a stale read can serve it
+        try:
+            with self._lock:
+                self._prev[str(path)] = self.inner.read_doc(path)
+        except OSError:
+            pass
+        if fault is not None and fault.take("torn_writes"):
+            self._count("torn_write")
+            # deliberately NON-atomic truncated write: the exact damage
+            # the atomic protocol exists to prevent, injected below it
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(text[: max(1, len(text) // 2)])
+            return
+        self.inner.write_doc(path, text)
+
+    def read_doc(self, path: str | Path) -> str:
+        path = Path(path)
+        fault = self._check(path, "read")
+        if fault is not None and fault.take("stale_reads"):
+            with self._lock:
+                prev = self._prev.get(str(path))
+            if prev is not None:
+                self._count("stale_read")
+                return prev
+        return self.inner.read_doc(path)
+
+    def scan(self, directory: str | Path, pattern: str) -> list[Path]:
+        self._check(Path(directory), "scan")
+        return self.inner.scan(directory, pattern)
+
+    def open_log(self, path: str | Path):
+        path = Path(path)
+        self._check(path, "open")
+        return _FaultableLogHandle(self, path, self.inner.open_log(path))
+
+    def tail(self, path: str | Path, max_bytes: int) -> list[str]:
+        self._check(Path(path), "tail")
+        return self.inner.tail(path, max_bytes)
+
+
+# ---------------------------------------------------------------------------
+# construction
+
+#: the process-wide default backend: the byte-identical local protocol
+LOCAL = LocalDirBackend()
+
+_BACKENDS = {
+    "local": LocalDirBackend,
+    "faultable": FaultableBackend,
+}
+
+
+def make_backend(name: str) -> CoordinationBackend:
+    """One CoordinationBackend by registry name (`fleet.coord_backend`).
+    Unknown names fail loudly — a typo must not silently fall back to a
+    different coordination substrate."""
+    try:
+        factory = _BACKENDS[str(name)]
+    except KeyError:
+        raise ValueError(
+            f"unknown fleet.coord_backend {name!r}; "
+            f"in {sorted(_BACKENDS)}"
+        ) from None
+    return factory()
+
+
+def backend_from_config(cfg) -> CoordinationBackend:
+    """The configured backend; `local` (the default, and the default
+    for configs predating the knob) returns the shared LOCAL instance
+    so the default path allocates nothing new."""
+    name = str(getattr(cfg.fleet, "coord_backend", "local"))
+    if name == "local":
+        return LOCAL
+    return make_backend(name)

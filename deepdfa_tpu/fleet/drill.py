@@ -1,0 +1,467 @@
+"""Scheduled chaos drills as a gated trajectory (docs/fleet.md;
+`deepdfa-tpu fleet-drill`).
+
+The failure matrix (docs/fleet.md) is only evidence while someone runs
+it. This module makes the running RECURRING and the evidence a
+trajectory: a scheduler executes drill rounds on a cadence — the
+in-process kill-router drill through a `coord.FaultableBackend` in
+smoke mode, the real `scripts/fault_inject.py --fleet` failure-matrix
+rows in full mode — and records the MEASURED recovery times (failover,
+admission reseed, readmit, rollback) into one `DRILL_r*.json` record
+per run. `obs/bench_gate.py:gate_drill` then holds the trajectory to a
+round-over-round tolerance on `drill_failover_s` plus the documented
+3.2 s failover bound as an ABSOLUTE ceiling — a regression in recovery
+time fails the gate exactly like a throughput regression would.
+
+The drill rounds ride the coordination backend deliberately: the smoke
+round injects storage latency on the rendezvous document and asserts
+the fault counters moved, proving the drill exercised the pluggable
+backend seam and not a shortcut around it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger(__name__)
+
+#: the documented router failover ceiling (docs/fleet.md: failover
+#: timeout 3.0 s + probe + one rendezvous poll) — the gate's ABSOLUTE
+#: bound on drill_failover_s, independent of any reference round
+DRILL_BOUND_S = 3.2
+
+#: file-name pattern of one drill round record in a run dir
+DRILL_GLOB = "DRILL_r*.json"
+
+#: what the smoke drill executes (in-process, <60 s)
+SMOKE_SCENARIOS = ("kill-router",)
+
+#: what the full drill executes by default (real subprocess fleet)
+FULL_SCENARIOS = ("wedge-backend", "rollout", "kill-router")
+
+_REPO = Path(__file__).resolve().parents[2]
+
+
+# ---------------------------------------------------------------------------
+# one smoke drill round: kill-router through the FaultableBackend
+
+
+def run_smoke_drill(tmp: str | Path, parts=None) -> dict:
+    """One in-process drill round: an active/standby HA pair over stub
+    replicas, ALL coordination through a FaultableBackend with latency
+    injected on the rendezvous document. Measures, in seconds:
+
+      readmit_s    wedge a replica -> router ejects -> recovery ->
+                   readmitted (the wedge-backend matrix row)
+      failover_s   kill the active router -> standby serves (the
+                   kill-router row; the 3.2 s bound applies HERE)
+      reseed_s     a fresh router re-seeds admission state from the
+                   shared fleet_log through the backend's torn-tolerant
+                   tail
+
+    rollback_s is None in smoke mode — a checkpoint rollback needs the
+    real replica subprocesses (`fleet-drill --full`).
+
+    `parts` is an optional pre-built `chaos.build_stub_parts` tuple so
+    a caller running several smoke phases pays for the stub model
+    once."""
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.fleet import chaos as fleet_chaos, coord, ha as fleet_ha
+    from deepdfa_tpu.fleet.router import router_from_config
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+        "serve.max_batch_graphs=1",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+        "serve.slo_windows=[5, 60]",
+        # in-process stubs never refresh heartbeats; a large timeout
+        # keeps them routable (the bench_load convention)
+        "fleet.heartbeat_timeout_s=3600.0",
+        "fleet.poll_interval_s=0.1",
+        "fleet.request_timeout_s=1.0",
+        "fleet.rendezvous_interval_s=0.1",
+        "fleet.router_failover_timeout_s=0.8",
+        "fleet.summary_interval_s=0.2",
+        'fleet.tenants="{\\"drill\\": {\\"rate\\": 0.001, '
+        '\\"burst\\": 50, \\"priority\\": 1}}"',
+    ])
+    backend = coord.FaultableBackend()
+    # a slow coordination store on the rendezvous path: small enough to
+    # stay inside the failover bound, large enough that the coord/faults
+    # counters PROVE the drill's coordination rode the wrapper
+    backend.set_fault(coord.ROUTER_FILE, latency_s=0.005)
+    snap_start = obs_metrics.REGISTRY.snapshot()
+
+    model, params, vocabs, codes = (
+        parts if parts is not None else fleet_chaos.build_stub_parts(cfg)
+    )
+    fleet_dir = Path(tmp) / "drill"
+    log_path = fleet_dir / "fleet_log.jsonl"
+    replicas = [
+        fleet_chaos.StubReplicaServer(
+            cfg, fleet_dir, f"r{i}",
+            fleet_chaos.stub_service(
+                cfg, fleet_dir, f"r{i}", model, params, vocabs
+            ),
+        )
+        for i in range(2)
+    ]
+    active = fleet_ha.HARouter(
+        cfg, fleet_dir, "ra", log_path=log_path, backend=backend
+    )
+    standby = fleet_ha.HARouter(
+        cfg, fleet_dir, "rb", log_path=log_path, backend=backend
+    )
+    out: dict = {"scenario": "kill-router", "rollback_s": None}
+    try:
+        active.start()
+        assert active.wait_active(20.0), "active router never came up"
+        addr = (active.host, active.port)
+        # traffic under the drill tenant so the summary record carries a
+        # partially-drained bucket level for the reseed leg to restore
+        for i in range(6):
+            status, _ = fleet_chaos.http_json(
+                *addr, "POST", "/score",
+                {"code": codes[i % len(codes)], "tenant": "drill"},
+            )
+            assert status == 200, status
+        active.router._last_summary = 0.0
+        active.router._maybe_summarize()
+
+        # -- readmit leg: wedge r0; the router must eject off the
+        # forward timeout, retry on the survivor, and readmit on
+        # recovery (the wedge-backend matrix row, timed)
+        # wedge must outlast request_timeout_s (1.0) so the forward
+        # genuinely times out and ejects; 1.6 s keeps that margin while
+        # holding the drill round inside the smoke budget
+        replicas[0].chaos.apply({"wedge_s": 1.6})
+        t0 = time.monotonic()
+        for code in codes[:2]:
+            status, resp = fleet_chaos.http_json(
+                *addr, "POST", "/score", {"code": code}, timeout=60.0
+            )
+            assert status == 200, (status, resp)
+        snap_w = obs_metrics.REGISTRY.snapshot()
+        assert snap_w.get("fleet/ejects", 0) > snap_start.get(
+            "fleet/ejects", 0
+        ), "wedged replica never ejected"
+        readmitted = coord.poll_until(
+            lambda: (
+                obs_metrics.REGISTRY.snapshot().get("fleet/readmits", 0)
+                > snap_start.get("fleet/readmits", 0)
+            ) or None,
+            30.0, interval_s=0.05, max_interval_s=0.25,
+            what="drill readmit",
+        )
+        assert readmitted, "wedged replica never readmitted"
+        out["readmit_s"] = round(time.monotonic() - t0, 3)
+
+        # -- failover leg: the active dies abruptly (SIGKILL residue:
+        # no rendezvous handoff); the standby must fence past the stale
+        # epoch and serve within the documented bound
+        standby.start()
+        time.sleep(0.3)
+        assert standby.role == "standby", standby.role
+        epoch0 = fleet_ha.read_rendezvous(fleet_dir, backend=backend)[
+            "epoch"
+        ]
+        t0 = time.monotonic()
+        active.kill()
+        assert standby.wait_active(timeout_s=30.0), "no takeover"
+        out["failover_s"] = round(time.monotonic() - t0, 3)
+        rv = fleet_ha.read_rendezvous(fleet_dir, backend=backend)
+        assert rv["router_id"] == "rb" and rv["epoch"] > epoch0, rv
+        out["epoch"] = rv["epoch"]
+        status, resp = fleet_chaos.http_json(
+            *fleet_ha.resolve_router(fleet_dir, backend=backend),
+            "POST", "/score", {"code": codes[0]},
+        )
+        assert status == 200, (status, resp)
+        drill_tokens = standby.router.admission.snapshot()["tokens"].get(
+            "drill"
+        )
+        assert drill_tokens is not None and drill_tokens <= 45.0, (
+            f"takeover did not re-seed the drill bucket: {drill_tokens}"
+        )
+
+        # -- reseed leg: a restarted router restores admission state
+        # from the log's last summary through the backend's
+        # torn-tolerant tail (timed separately from the takeover)
+        t0 = time.monotonic()
+        throwaway = router_from_config(
+            cfg, fleet_dir, log_path=log_path, backend=backend
+        )
+        out["reseed_s"] = round(time.monotonic() - t0, 3)
+        reseeded = throwaway.admission.snapshot()["tokens"].get("drill")
+        throwaway.close()
+        assert reseeded is not None and reseeded <= 45.0, reseeded
+
+        # the backend seam was genuinely exercised: the injected
+        # latency fault fired at least once
+        snap_end = obs_metrics.REGISTRY.snapshot()
+        out["coord_faults"] = {
+            k.rsplit("/", 1)[1]: snap_end[k] - snap_start.get(k, 0)
+            for k in snap_end
+            if k.startswith("coord/faults/")
+            and snap_end[k] > snap_start.get(k, 0)
+        }
+        assert out["coord_faults"].get("latency", 0) > 0, (
+            "drill coordination never rode the FaultableBackend"
+        )
+        out["ok"] = True
+    finally:
+        active.kill()
+        standby.close()
+        for r in replicas:
+            r.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# one full drill round: the real failure matrix via fault_inject.py
+
+
+def run_full_drill(
+    scenarios=FULL_SCENARIOS, timeout_s: float = 3600.0
+) -> dict:
+    """One full drill round: `scripts/fault_inject.py --fleet` with the
+    selected failure-matrix rows, in a subprocess (real replica
+    processes, real SIGKILLs). Timings come out of the scenario record:
+    kill-router's measured `failover_seconds` is the gated number;
+    wedge-backend / rollout wall times stand in for readmit / rollback
+    (the subprocess record does not time those legs individually)."""
+    cmd = [
+        sys.executable, str(_REPO / "scripts" / "fault_inject.py"),
+        "--fleet",
+    ]
+    for name in scenarios:
+        cmd += ["--fleet-scenario", str(name)]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout_s,
+        cwd=str(_REPO), check=False,
+    )
+    out: dict = {"scenario": "+".join(scenarios), "rollback_s": None}
+    try:
+        record = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        out["ok"] = False
+        out["error"] = (
+            f"fault_inject --fleet rc={proc.returncode}, unparseable "
+            f"output: {proc.stdout[-500:]!r} {proc.stderr[-500:]!r}"
+        )
+        return out
+    scen = record.get("scenarios") or {}
+    kr = scen.get("kill-router") or {}
+    if isinstance(kr.get("failover_seconds"), (int, float)):
+        out["failover_s"] = float(kr["failover_seconds"])
+    wb = scen.get("wedge-backend") or {}
+    if isinstance(wb.get("seconds"), (int, float)):
+        out["readmit_s"] = float(wb["seconds"])
+    ro = scen.get("rollout") or {}
+    if isinstance(ro.get("seconds"), (int, float)):
+        out["rollback_s"] = float(ro["seconds"])
+    out["ok"] = bool(record.get("ok")) and proc.returncode == 0
+    if not out["ok"]:
+        out["error"] = f"fault_inject --fleet rc={proc.returncode}"
+        out["record"] = {
+            k: v for k, v in scen.items() if "error" in (v or {})
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the scheduler: rounds on a cadence -> one DRILL record
+
+
+class DrillScheduler:
+    """Run `rounds` drill rounds on an `interval_s` cadence and fold the
+    measurements into one DRILL record. The runner is injected (the
+    smoke phase passes `run_smoke_drill` over a tempdir, the CLI's full
+    mode passes `run_full_drill`) so the schedule/aggregate/gate
+    machinery is identical in both modes — and trivially testable with
+    a stub runner and a fake clock."""
+
+    def __init__(
+        self,
+        runner,
+        rounds: int = 1,
+        interval_s: float = 0.0,
+        scenarios=SMOKE_SCENARIOS,
+        mode: str = "smoke",
+        sleep=time.sleep,
+        clock=time.monotonic,
+    ):
+        self.runner = runner
+        self.rounds = max(1, int(rounds))
+        self.interval_s = max(0.0, float(interval_s))
+        self.scenarios = tuple(str(s) for s in scenarios)
+        self.mode = str(mode)
+        self._sleep = sleep
+        self._clock = clock
+
+    def run(self) -> dict:
+        per_round: list[dict] = []
+        t_prev: float | None = None
+        for i in range(self.rounds):
+            if t_prev is not None and self.interval_s > 0:
+                # cadence between round STARTS; a slow round eats into
+                # its own gap, never delays the schedule further
+                elapsed = self._clock() - t_prev
+                self._sleep(max(0.0, self.interval_s - elapsed))
+            t_start = t_prev = self._clock()
+            obs_metrics.REGISTRY.counter("drill/rounds").inc()
+            try:
+                entry = dict(self.runner(i) or {})
+            except (AssertionError, RuntimeError, OSError) as e:
+                entry = {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}"[:2000],
+                }
+            entry.setdefault("ok", False)
+            entry["round"] = i
+            entry["seconds"] = round(self._clock() - t_start, 3)
+            if not entry["ok"]:
+                obs_metrics.REGISTRY.counter("drill/failures").inc()
+                logger.warning(
+                    "drill round %d failed: %s", i, entry.get("error")
+                )
+            per_round.append(entry)
+        return drill_record(
+            mode=self.mode,
+            cadence_s=self.interval_s,
+            scenarios=self.scenarios,
+            per_round=per_round,
+        )
+
+
+def drill_record(
+    mode: str, cadence_s: float, scenarios, per_round: list[dict]
+) -> dict:
+    """Fold per-round measurements into the gated DRILL record. Each
+    aggregate timing is the WORST round — the gate holds the trajectory
+    to worst-case recovery, not a flattering average."""
+
+    def _worst(key: str):
+        vals = [
+            r.get(key) for r in per_round
+            if isinstance(r.get(key), (int, float))
+        ]
+        return round(max(vals), 3) if vals else None
+
+    failover = _worst("failover_s")
+    ok = (
+        bool(per_round)
+        and all(r.get("ok") for r in per_round)
+        and failover is not None
+        and failover < DRILL_BOUND_S
+    )
+    return {
+        "mode": str(mode),
+        "t_unix": round(time.time(), 3),
+        "cadence_s": float(cadence_s),
+        "rounds": len(per_round),
+        "scenarios": sorted(set(map(str, scenarios))),
+        "drill_failover_s": failover,
+        "drill_reseed_s": _worst("reseed_s"),
+        "drill_readmit_s": _worst("readmit_s"),
+        "drill_rollback_s": _worst("rollback_s"),
+        "drill_bound_s": DRILL_BOUND_S,
+        "per_round": per_round,
+        "ok": ok,
+    }
+
+
+def next_drill_path(out_dir: str | Path) -> Path:
+    """The next DRILL_rNN.json slot under `out_dir` — the trajectory
+    grows by round number, mirroring the BENCH_r*/TUNED_r* convention
+    the gates' trajectory loaders share."""
+    out_dir = Path(out_dir)
+    taken = [
+        int(m.group(1))
+        for p in out_dir.glob(DRILL_GLOB)
+        if (m := re.search(r"r(\d+)", p.stem))
+    ]
+    return out_dir / f"DRILL_r{max(taken, default=0) + 1:02d}.json"
+
+
+def write_drill_record(record: dict, out_dir: str | Path) -> Path:
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = next_drill_path(out_dir)
+    path.write_text(json.dumps(record, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# validation (scripts/check_obs_schema.py --drill runs this function)
+
+
+def validate_drill_record(doc) -> list[str]:
+    """Every problem that makes a DRILL record unusable as gate input
+    (empty = valid)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["not a JSON object"]
+    if doc.get("mode") not in ("smoke", "full"):
+        problems.append(f"mode {doc.get('mode')!r} not smoke|full")
+    for key in ("t_unix", "cadence_s", "drill_bound_s"):
+        if not isinstance(doc.get(key), (int, float)):
+            problems.append(f"{key} missing or not numeric")
+    if not (isinstance(doc.get("rounds"), int) and doc["rounds"] >= 1):
+        problems.append("rounds missing or < 1")
+    scen = doc.get("scenarios")
+    if not (
+        isinstance(scen, list) and scen
+        and all(isinstance(s, str) for s in scen)
+    ):
+        problems.append("scenarios missing or not a list of names")
+    if not isinstance(doc.get("drill_failover_s"), (int, float)):
+        problems.append("drill_failover_s missing or not numeric")
+    for key in ("drill_reseed_s", "drill_readmit_s", "drill_rollback_s"):
+        if key in doc and doc[key] is not None and not isinstance(
+            doc[key], (int, float)
+        ):
+            problems.append(f"{key} not numeric or null")
+    rounds = doc.get("per_round")
+    if not isinstance(rounds, list) or not rounds:
+        problems.append("per_round missing or empty")
+    else:
+        if isinstance(doc.get("rounds"), int) and len(rounds) != doc[
+            "rounds"
+        ]:
+            problems.append(
+                f"per_round has {len(rounds)} entries, rounds says "
+                f"{doc['rounds']}"
+            )
+        for i, entry in enumerate(rounds):
+            if not isinstance(entry, dict):
+                problems.append(f"per_round[{i}] not an object")
+            elif "ok" not in entry:
+                problems.append(f"per_round[{i}] missing ok")
+    if not isinstance(doc.get("ok"), bool):
+        problems.append("ok missing or not a bool")
+    return problems
+
+
+def validate_drill_file(path: str | Path) -> dict:
+    """{"ok", "problems", "path"} for one DRILL_r*.json on disk."""
+    path = Path(path)
+    try:
+        doc = json.loads(path.read_text())
+    except OSError as e:
+        return {"ok": False, "problems": [f"unreadable: {e}"],
+                "path": str(path)}
+    except json.JSONDecodeError as e:
+        return {"ok": False, "problems": [f"not JSON: {e}"],
+                "path": str(path)}
+    problems = validate_drill_record(doc)
+    return {"ok": not problems, "problems": problems, "path": str(path)}

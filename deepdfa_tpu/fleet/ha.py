@@ -47,19 +47,18 @@ no replica state is lost — replicas never see the failover at all.
 from __future__ import annotations
 
 import http.client
-import json
 import logging
 import threading
 import time
 from pathlib import Path
 
-from deepdfa_tpu.fleet import router as router_mod
+from deepdfa_tpu.fleet import coord, router as router_mod
 from deepdfa_tpu.obs import metrics as obs_metrics
 
 logger = logging.getLogger(__name__)
 
-#: the rendezvous file name under the fleet dir
-ROUTER_FILE = "router.json"
+#: the rendezvous file name under the fleet dir (fleet/coord.py owns it)
+ROUTER_FILE = coord.ROUTER_FILE
 
 
 def rendezvous_path(fleet_dir: str | Path) -> Path:
@@ -72,51 +71,49 @@ def write_rendezvous(
     host: str,
     port: int,
     epoch: int,
+    backend: coord.CoordinationBackend | None = None,
 ) -> Path:
-    """Atomically publish the active router's heartbeat."""
-    from deepdfa_tpu.core.ioutil import atomic_write_text
-
+    """Atomically publish the active router's heartbeat (unfenced — the
+    bring-up/takeover form; the active's periodic refresh goes through
+    the backend's FENCED `publish_rendezvous` instead)."""
     path = rendezvous_path(fleet_dir)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    atomic_write_text(path, json.dumps({"router": {
-        "router_id": str(router_id),
-        "host": str(host),
-        "port": int(port),
-        "epoch": int(epoch),
-        "t_unix": round(time.time(), 3),
-    }}))
+    (backend or coord.LOCAL).publish_rendezvous(
+        path, router_id, host, port, epoch, force=True
+    )
     return path
 
 
-def read_rendezvous(fleet_dir: str | Path) -> dict | None:
+def read_rendezvous(
+    fleet_dir: str | Path,
+    backend: coord.CoordinationBackend | None = None,
+) -> dict | None:
     """The parsed rendezvous, or None when absent/unreadable."""
-    try:
-        doc = json.loads(rendezvous_path(fleet_dir).read_text())
-    except (OSError, json.JSONDecodeError):
-        return None
-    rv = doc.get("router") if isinstance(doc, dict) else None
-    if not isinstance(rv, dict):
-        return None
-    required = ("router_id", "host", "port", "epoch", "t_unix")
-    if any(k not in rv for k in required):
-        return None
-    return rv
+    return (backend or coord.LOCAL).read_rendezvous(
+        rendezvous_path(fleet_dir)
+    )
 
 
 def resolve_router(
-    fleet_dir: str | Path, timeout_s: float = 0.0
+    fleet_dir: str | Path,
+    timeout_s: float = 0.0,
+    backend: coord.CoordinationBackend | None = None,
 ) -> tuple[str, int] | None:
     """The client re-resolve helper: (host, port) of the current active
     router per the rendezvous file, optionally waiting up to `timeout_s`
-    for one to appear (the post-failover window)."""
-    deadline = time.time() + float(timeout_s)
-    while True:
-        rv = read_rendezvous(fleet_dir)
+    for one to appear (the post-failover window). Rides the shared
+    bounded poll helper (coord.poll_until) — jittered backoff, capped
+    so a waiting client still sees a fresh takeover promptly."""
+
+    def _lookup() -> tuple[str, int] | None:
+        rv = read_rendezvous(fleet_dir, backend=backend)
         if rv is not None:
             return str(rv["host"]), int(rv["port"])
-        if time.time() >= deadline:
-            return None
-        time.sleep(0.05)
+        return None
+
+    return coord.poll_until(
+        _lookup, timeout_s, interval_s=0.05, max_interval_s=0.25,
+        what="router rendezvous",
+    )
 
 
 class HARouter:
@@ -136,6 +133,7 @@ class HARouter:
         host: str = "127.0.0.1",
         port: int = 0,
         log_path: str | Path | None = None,
+        backend: coord.CoordinationBackend | None = None,
     ):
         self.cfg = cfg
         self.fleet_dir = Path(fleet_dir)
@@ -147,6 +145,7 @@ class HARouter:
             else self.fleet_dir / "fleet_log.jsonl"
         )
         fcfg = cfg.fleet
+        self.backend = backend or coord.backend_from_config(cfg)
         self.rendezvous_interval_s = float(fcfg.rendezvous_interval_s)
         self.failover_timeout_s = float(fcfg.router_failover_timeout_s)
         self.probe_timeout_s = min(2.0, self.failover_timeout_s)
@@ -154,7 +153,8 @@ class HARouter:
         # appends (attached at takeover, after the re-seed reads the
         # previous active's last summary)
         self.router = router_mod.router_from_config(
-            cfg, self.fleet_dir, log_path=None, reseed=False
+            cfg, self.fleet_dir, log_path=None, reseed=False,
+            backend=self.backend,
         )
         self.role = "standby"
         self.epoch = 0
@@ -203,26 +203,21 @@ class HARouter:
         """One role-loop tick: refresh-or-fence when active, watch-or-
         takeover when standby."""
         now = time.time() if now is None else now
-        rv = read_rendezvous(self.fleet_dir)
+        rv = read_rendezvous(self.fleet_dir, backend=self.backend)
         with self._lock:
             role = self.role
         if role == "active":
-            if rv is not None and rv["router_id"] != self.router_id and (
-                int(rv["epoch"]) > self.epoch
-                # equal-epoch tie (two standbys raced one takeover):
-                # deterministic id order picks the survivor — the pair
-                # converges in one tick instead of oscillating
-                or (int(rv["epoch"]) == self.epoch
-                    and str(rv["router_id"]) < self.router_id)
-            ):
-                # fenced: another router took over while this one was
-                # presumed dead (wedge, stall) — never fight the epoch
-                self.step_down(superseded_by=str(rv["router_id"]))
-                return
-            write_rendezvous(
-                self.fleet_dir, self.router_id, self.host, self.port,
-                self.epoch,
+            # the fenced refresh is the backend's epoch contract
+            # (coord.publish_rendezvous): a refusal means another
+            # router took over at a higher epoch (or won the equal-
+            # epoch tie) while this one was presumed dead (wedge,
+            # stall) — never fight the epoch
+            fencer = self.backend.publish_rendezvous(
+                rendezvous_path(self.fleet_dir), self.router_id,
+                self.host, self.port, self.epoch, force=False,
             )
+            if fencer is not None:
+                self.step_down(superseded_by=str(fencer["router_id"]))
             return
         # standby: keep the replica table warm, watch the active
         self.router.poll(force=True)
@@ -265,7 +260,9 @@ class HARouter:
         t0 = time.perf_counter()
         stale_epoch = int(rv["epoch"]) if rv is not None else 0
         reseeded = self.router.reseed_from_log(self.log_path)
-        self.router.log = router_mod.FleetLog(self.log_path)
+        self.router.log = router_mod.FleetLog(
+            self.log_path, backend=self.backend
+        )
         try:
             self.httpd = router_mod.make_router_server(
                 self.router, self.host, self.port
@@ -289,7 +286,7 @@ class HARouter:
             self.epoch = stale_epoch + 1
         write_rendezvous(
             self.fleet_dir, self.router_id, self.host, self.port,
-            self.epoch,
+            self.epoch, backend=self.backend,
         )
         took = time.perf_counter() - t0
         self._m_takeovers.inc()

@@ -44,16 +44,19 @@ def write_heartbeat(
     port: int,
     state: str = READY,
     info: dict | None = None,
+    backend=None,
 ) -> Path:
     """Atomically write one replica's heartbeat; returns the path.
 
     `info` carries the replica's serving identity + capacity signals
     (healthz-lite fields, backend report, ledger param bytes); the
     envelope adds the routing essentials and the timestamp the router
-    ages against."""
+    ages against. The write rides the coordination backend
+    (fleet/coord.py; the default LocalDirBackend is today's atomic
+    file, byte-identical)."""
     if state not in STATES:
         raise ValueError(f"unknown heartbeat state {state!r}; in {STATES}")
-    from deepdfa_tpu.core.ioutil import atomic_write_text
+    from deepdfa_tpu.fleet import coord
 
     doc = {
         "heartbeat": {
@@ -67,8 +70,7 @@ def write_heartbeat(
         }
     }
     path = heartbeat_path(fleet_dir, replica_id)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    atomic_write_text(path, json.dumps(doc))
+    (backend or coord.LOCAL).write_doc(path, json.dumps(doc))
     return path
 
 
@@ -101,19 +103,21 @@ def validate_heartbeat(doc) -> tuple[dict | None, str | None]:
     return hb, None
 
 
-def read_heartbeat(path: str | Path) -> dict | None:
+def read_heartbeat(path: str | Path, backend=None) -> dict | None:
     """One parsed heartbeat document, or None when unreadable (a replica
     mid-first-write, or a deleted file racing the scan) or malformed."""
-    hb, _ = read_heartbeat_verbose(path)
+    hb, _ = read_heartbeat_verbose(path, backend=backend)
     return hb
 
 
 def read_heartbeat_verbose(
-    path: str | Path,
+    path: str | Path, backend=None
 ) -> tuple[dict | None, str | None]:
     """(heartbeat, None) | (None, reason) — the quarantine-aware read."""
+    from deepdfa_tpu.fleet import coord
+
     try:
-        doc = json.loads(Path(path).read_text())
+        doc = json.loads((backend or coord.LOCAL).read_doc(path))
     except OSError:
         # a deleted file racing the scan is not evidence of anything
         return None, None
@@ -122,26 +126,26 @@ def read_heartbeat_verbose(
     return validate_heartbeat(doc)
 
 
-def scan_heartbeats(fleet_dir: str | Path) -> dict[str, dict]:
+def scan_heartbeats(fleet_dir: str | Path, backend=None) -> dict[str, dict]:
     """{replica_id: heartbeat} for every readable heartbeat file."""
-    beats, _ = scan_heartbeats_verbose(fleet_dir)
+    beats, _ = scan_heartbeats_verbose(fleet_dir, backend=backend)
     return beats
 
 
 def scan_heartbeats_verbose(
-    fleet_dir: str | Path,
+    fleet_dir: str | Path, backend=None
 ) -> tuple[dict[str, dict], dict[str, str]]:
     """(beats, invalid): well-formed heartbeats by replica id, plus
     {replica_id: reason} for every malformed announcement file — the
     replica id derived from the `replica-<id>.json` filename so the
     router can quarantine the SPECIFIC replica behind a corrupt file."""
+    from deepdfa_tpu.fleet import coord
+
+    backend = backend or coord.LOCAL
     out: dict[str, dict] = {}
     invalid: dict[str, str] = {}
-    fleet_dir = Path(fleet_dir)
-    if not fleet_dir.is_dir():
-        return out, invalid
-    for path in sorted(fleet_dir.glob("replica-*.json")):
-        hb, reason = read_heartbeat_verbose(path)
+    for path in backend.scan(Path(fleet_dir), "replica-*.json"):
+        hb, reason = read_heartbeat_verbose(path, backend=backend)
         if hb is not None:
             out[str(hb["replica_id"])] = hb
         elif reason is not None:

@@ -42,6 +42,7 @@ from pathlib import Path
 from deepdfa_tpu.fleet import (
     admission as fleet_admission,
     chaos as fleet_chaos,
+    coord,
     heartbeat,
 )
 from deepdfa_tpu.obs import (
@@ -390,17 +391,20 @@ class ReplicaWorker:
 
     def _wait_queue_drain(self, timeout_s: float = 30.0) -> bool:
         """Block until every co-served batcher's queue is empty (the
-        in-flight work the drain half of a swap must not abandon)."""
-        deadline = time.monotonic() + float(timeout_s)
-        while time.monotonic() < deadline:
-            depth = sum(
+        in-flight work the drain half of a swap must not abandon).
+        Rides the shared bounded poll helper (coord.poll_until) —
+        deadline-aware, jittered, logged on exhaustion."""
+
+        def _drained() -> bool:
+            return sum(
                 s.batcher.stats()["queue_depth"]
                 for s in self.services.values()
-            )
-            if depth == 0:
-                return True
-            time.sleep(0.05)
-        return False
+            ) == 0
+
+        return coord.poll_until(
+            _drained, timeout_s, interval_s=0.05, max_interval_s=0.25,
+            what=f"queue drain on replica {self.replica_id}",
+        ) is not None
 
     def swap_primary(
         self,
@@ -784,18 +788,25 @@ def wait_for_ready(
     replica_ids: list[str],
     timeout_s: float = 300.0,
     procs=None,
+    backend=None,
 ) -> dict[str, dict]:
     """Block until every listed replica's heartbeat says `ready`;
     returns {replica_id: heartbeat}. Raises on timeout or on a replica
-    process that exited before becoming ready."""
-    deadline = time.time() + float(timeout_s)
+    process that exited before becoming ready. The wait rides the
+    shared bounded poll helper (coord.poll_until): a dead replica
+    process raises out of the predicate immediately, exhaustion is
+    logged, and the retry cadence is jittered."""
     want = set(map(str, replica_ids))
-    while True:
-        beats = heartbeat.scan_heartbeats(fleet_dir)
+    seen: dict[str, dict] = {}
+
+    def _all_ready() -> dict[str, dict] | None:
+        beats = heartbeat.scan_heartbeats(fleet_dir, backend=backend)
         ready = {
             rid: hb for rid, hb in beats.items()
             if rid in want and hb.get("state") == heartbeat.READY
         }
+        seen.clear()
+        seen.update(ready)
         if set(ready) == want:
             return ready
         if procs is not None:
@@ -807,9 +818,15 @@ def wait_for_ready(
                         f"replica {rid} exited rc={proc.returncode} "
                         f"before becoming ready"
                     )
-        if time.time() > deadline:
-            raise TimeoutError(
-                f"replicas not ready in {timeout_s}s: missing "
-                f"{sorted(want - set(ready))}"
-            )
-        time.sleep(0.1)
+        return None
+
+    ready = coord.poll_until(
+        _all_ready, timeout_s, interval_s=0.1, max_interval_s=0.5,
+        what="replica readiness",
+    )
+    if ready is None:
+        raise TimeoutError(
+            f"replicas not ready in {timeout_s}s: missing "
+            f"{sorted(want - set(seen))}"
+        )
+    return ready

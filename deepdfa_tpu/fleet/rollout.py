@@ -36,7 +36,7 @@ import logging
 import time
 from pathlib import Path
 
-from deepdfa_tpu.fleet import chaos as fleet_chaos, ha, heartbeat
+from deepdfa_tpu.fleet import chaos as fleet_chaos, coord, ha, heartbeat
 from deepdfa_tpu.fleet.router import FleetLog, ROLLOUT_EVENTS
 from deepdfa_tpu.obs import metrics as obs_metrics
 
@@ -128,8 +128,10 @@ def _record(log: FleetLog | None, event: str, checkpoint: str, **fields):
         }})
 
 
-def _ready_replicas(fleet_dir, timeout_s: float) -> dict[str, dict]:
-    beats = heartbeat.scan_heartbeats(fleet_dir)
+def _ready_replicas(
+    fleet_dir, timeout_s: float, backend=None
+) -> dict[str, dict]:
+    beats = heartbeat.scan_heartbeats(fleet_dir, backend=backend)
     return {
         rid: hb for rid, hb in sorted(beats.items())
         if hb.get("state") == heartbeat.READY
@@ -151,11 +153,23 @@ def run_rollout(
     report."""
     fleet_dir = Path(fleet_dir)
     fcfg = cfg.fleet
+    backend = coord.backend_from_config(cfg)
     if router_addr is None:
-        router_addr = ha.resolve_router(fleet_dir)
-    log = FleetLog(log_path) if log_path is not None else None
+        # rides the shared bounded poll helper (coord.poll_until) —
+        # a rollout started inside the failover window waits the
+        # documented bound for the new front door, never ad hoc
+        router_addr = ha.resolve_router(
+            fleet_dir, timeout_s=fcfg.router_failover_timeout_s,
+            backend=backend,
+        )
+    log = (
+        FleetLog(log_path, backend=backend)
+        if log_path is not None else None
+    )
     guard = SloGuard(fcfg.rollout_p99_ms, fcfg.rollout_error_rate)
-    replicas = _ready_replicas(fleet_dir, fcfg.heartbeat_timeout_s)
+    replicas = _ready_replicas(
+        fleet_dir, fcfg.heartbeat_timeout_s, backend=backend
+    )
     report: dict = {
         "checkpoint": checkpoint,
         "drift_bound": float(fcfg.rollout_drift_bound),

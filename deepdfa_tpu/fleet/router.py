@@ -43,7 +43,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
-from deepdfa_tpu.fleet import admission as fleet_admission, heartbeat
+from deepdfa_tpu.fleet import admission as fleet_admission, coord, heartbeat
 from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
 from deepdfa_tpu.obs.slo import SloEngine, registry_exposition
 from deepdfa_tpu.serve.batcher import new_request_id
@@ -65,6 +65,15 @@ ROLLOUT_EVENTS = (
     "start", "swap", "refused", "halt", "rollback", "complete",
 )
 
+#: the declared autoscale-decision vocabulary (fleet/autoscale.py
+#: appends {"autoscale": {...}} lines to the same fleet_log; the
+#: degradation ladder escalates shed_stage2 -> tighten_admission ->
+#: scale_up, and `relax`/`scale_down` unwind it)
+AUTOSCALE_ACTIONS = (
+    "hold", "shed_stage2", "tighten_admission", "scale_up",
+    "scale_down", "relax",
+)
+
 #: transport-level failures that mean "the replica, not the request"
 TRANSPORT_ERRORS = (
     ConnectionError,
@@ -77,20 +86,25 @@ TRANSPORT_ERRORS = (
 
 class FleetLog:
     """Thread-safe appender to fleet_log.jsonl (the serve RequestLog
-    rule: one handle, flushed per entry, tail-able while serving)."""
+    rule: one handle, flushed per entry, tail-able while serving). The
+    handle comes from the coordination backend (fleet/coord.py); the
+    default LocalDirBackend's handle is today's append-and-flush file,
+    byte-identical."""
 
-    def __init__(self, path: str | Path):
+    def __init__(
+        self,
+        path: str | Path,
+        backend: coord.CoordinationBackend | None = None,
+    ):
         self.path = Path(path)
-        self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
-        self._file = self.path.open("a")
+        self._file = (backend or coord.LOCAL).open_log(self.path)
 
     def append(self, entry: dict) -> None:
         line = json.dumps(entry)
         with self._lock:
             if not self._file.closed:
-                self._file.write(line + "\n")
-                self._file.flush()
+                self._file.write_line(line)
 
     def close(self) -> None:
         with self._lock:
@@ -179,8 +193,10 @@ class Router:
         slo: SloEngine | None = None,
         probe_timeout_s: float = 5.0,
         summary_interval_s: float = 0.0,
+        backend: coord.CoordinationBackend | None = None,
     ):
         self.fleet_dir = Path(fleet_dir)
+        self.backend = backend or coord.LOCAL
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.poll_interval_s = float(poll_interval_s)
         self.eject_threshold = max(1, int(eject_threshold))
@@ -238,7 +254,9 @@ class Router:
             if not force and (now - self._last_poll) < self.poll_interval_s:
                 return
             self._last_poll = now
-        beats, invalid = heartbeat.scan_heartbeats_verbose(self.fleet_dir)
+        beats, invalid = heartbeat.scan_heartbeats_verbose(
+            self.fleet_dir, backend=self.backend
+        )
         # malformed announcement files QUARANTINE the replica behind
         # them (docs/fleet.md failure matrix): the replica's state is
         # unknowable, so it must not be routed — but a corrupt file is
@@ -581,29 +599,20 @@ class Router:
         fleet_log.jsonl — the router-restart/HA-takeover half of the
         no-lost-state contract (docs/fleet.md). An absent, empty, or
         corrupt log re-seeds nothing: fresh buckets, never a crash.
-        Only a bounded tail is read (RESEED_TAIL_BYTES); the first
-        line after the seek may be torn mid-record, which the
-        per-line JSON parse below already skips. Returns the number
+        The read is the backend's bounded `tail_records`
+        (RESEED_TAIL_BYTES): the first line may be torn by the seek
+        and the FINAL line by the previous active crashing mid-append
+        — both are skipped per the tail contract, so a torn tail
+        costs one record, never the whole re-seed. Returns the number
         of re-seeded buckets."""
         try:
-            with Path(path).open("rb") as f:
-                f.seek(0, 2)
-                size = f.tell()
-                f.seek(max(0, size - self.RESEED_TAIL_BYTES))
-                lines = f.read().decode("utf-8", "replace").splitlines()
+            records = self.backend.tail_records(
+                path, self.RESEED_TAIL_BYTES
+            )
         except OSError:
             return 0
-        for line in reversed(lines):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict) and isinstance(
-                rec.get("fleet_admission"), dict
-            ):
+        for rec in reversed(records):
+            if isinstance(rec.get("fleet_admission"), dict):
                 n = self.admission.reseed(rec["fleet_admission"])
                 if n:
                     logger.info(
@@ -644,13 +653,18 @@ def router_from_config(
     fleet_dir: str | Path,
     log_path: str | Path | None = None,
     reseed: bool = True,
+    backend: coord.CoordinationBackend | None = None,
 ) -> Router:
     """One configured Router (admission policies, cadences, SLO windows,
     fleet log) from a Config — the `fleet` CLI's and the smoke's shared
     construction path. `reseed` restores token-bucket levels from the
     log's last summary record BEFORE the log handle is (re)opened for
-    append — a no-op on a fresh log, the restart contract otherwise."""
+    append — a no-op on a fresh log, the restart contract otherwise.
+    Every coordination op (heartbeat scans, the log, the re-seed tail)
+    rides `fleet.coord_backend` unless a backend is passed in."""
     fcfg = cfg.fleet
+    if backend is None:
+        backend = coord.backend_from_config(cfg)
     admission = fleet_admission.AdmissionController(
         tenants=fleet_admission.parse_tenants(fcfg.tenants),
         default_rate=fcfg.default_rate,
@@ -669,12 +683,16 @@ def router_from_config(
         retries=fcfg.retries,
         request_timeout_s=fcfg.request_timeout_s,
         admission=admission,
-        log=FleetLog(log_path) if log_path is not None else None,
+        log=(
+            FleetLog(log_path, backend=backend)
+            if log_path is not None else None
+        ),
         slo=SloEngine(
             windows=cfg.serve.slo_windows,
             max_samples=cfg.serve.slo_window_samples,
         ),
         summary_interval_s=fcfg.summary_interval_s,
+        backend=backend,
     )
     if reseed and log_path is not None:
         router.reseed_from_log(log_path)
@@ -877,15 +895,17 @@ class BackgroundRouter:
 def validate_fleet_log(path: str | Path) -> dict:
     """Structural + schema validation of a router fleet_log.jsonl.
 
-    Four legal line shapes: {"request": {...}} per-request entries
+    Five legal line shapes: {"request": {...}} per-request entries
     (id + status required), {"fleet_event": {...}} lifecycle events
     (declared name + t_unix required, incl. the HA takeover/stepdown and
     quarantine transitions), {"rollout": {...}} rollout records
     (fleet/rollout.py; declared event + t_unix + checkpoint required),
-    and summary records embedding the fleet/* registry snapshot +
-    fleet_slo windows + the admission re-seed snapshot. Every flattened
-    scalar tag must be declared in obs/metrics.py:SCHEMA — the same
-    drift guard the train/serve/scan logs get."""
+    {"autoscale": {...}} autoscaling decisions (fleet/autoscale.py;
+    declared action + t_unix required), and summary records embedding
+    the fleet/* registry snapshot + fleet_slo windows + the admission
+    re-seed snapshot. Every flattened scalar tag must be declared in
+    obs/metrics.py:SCHEMA — the same drift guard the train/serve/scan
+    logs get."""
     path = Path(path)
     problems: list[str] = []
     records: list[dict] = []
@@ -893,7 +913,7 @@ def validate_fleet_log(path: str | Path) -> dict:
         lines = path.read_text().splitlines()
     except OSError as e:
         return {"ok": False, "problems": [f"unreadable: {e}"]}
-    n_requests = n_events = n_summaries = n_rollouts = 0
+    n_requests = n_events = n_summaries = n_rollouts = n_autoscale = 0
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -945,6 +965,20 @@ def validate_fleet_log(path: str | Path) -> dict:
                     f"line {lineno}: rollout record missing "
                     f"t_unix/checkpoint"
                 )
+        elif "autoscale" in rec:
+            n_autoscale += 1
+            a = rec["autoscale"]
+            if not isinstance(a, dict):
+                problems.append(f"line {lineno}: autoscale not an object")
+            elif a.get("action") not in AUTOSCALE_ACTIONS:
+                problems.append(
+                    f"line {lineno}: autoscale action {a.get('action')!r} "
+                    f"not in declared set {AUTOSCALE_ACTIONS}"
+                )
+            elif "t_unix" not in a:
+                problems.append(
+                    f"line {lineno}: autoscale record missing t_unix"
+                )
         elif "fleet" in rec or "fleet_slo" in rec:
             n_summaries += 1
         else:
@@ -962,6 +996,7 @@ def validate_fleet_log(path: str | Path) -> dict:
         "events": n_events,
         "summaries": n_summaries,
         "rollouts": n_rollouts,
+        "autoscale": n_autoscale,
         "undeclared": undeclared,
         "problems": problems,
     }

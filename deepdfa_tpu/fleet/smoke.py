@@ -23,6 +23,19 @@ uses) and a real in-process router:
 5. **log** — the router's fleet_log.jsonl validates against the
    declared obs schema (`scripts/check_obs_schema.py --fleet-log` runs
    the same function).
+
+Two further phases run AFTER the main fleet is torn down, on cheap
+stub fleets of their own (<60 s combined, docs/fleet.md):
+
+6. **drill** — one scheduled chaos-drill round through
+   `fleet/drill.py:DrillScheduler` (active/standby HA pair on a
+   FaultableBackend): measured failover must beat the documented
+   3.2 s bound, readmit and log-reseed must complete.
+7. **autoscale** — `fleet/autoscale.py:run_smoke_autoscale`: replayed
+   ramp arrivals force the degradation ladder (shed_stage2 ->
+   tighten_admission) and a scale_up BEFORE the offered rate crosses
+   measured capacity, with zero requests lost and every decision a
+   schema-valid `{"autoscale": ...}` fleet_log record.
 """
 
 from __future__ import annotations
@@ -31,7 +44,6 @@ import json
 import os
 import signal
 import threading
-import time
 from pathlib import Path
 
 
@@ -60,7 +72,7 @@ def _replica_healthz(host: str, port: int) -> dict:
 def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
     """Returns the machine-readable smoke report `cmd_fleet` asserts
     on. Every phase's evidence is a field, not a print."""
-    from deepdfa_tpu.fleet import ha as fleet_ha, heartbeat
+    from deepdfa_tpu.fleet import coord, ha as fleet_ha, heartbeat
     from deepdfa_tpu.fleet.replica import spawn_replicas, wait_for_ready
     from deepdfa_tpu.fleet.router import (
         BackgroundRouter,
@@ -341,17 +353,20 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
         # -- phase 4: graceful drain of the survivor
         sproc = procs[1][1]
         sproc.send_signal(signal.SIGTERM)
-        drain_seen = False
-        deadline = time.time() + 60
-        while time.time() < deadline:
+
+        def _drain_progress() -> str | None:
             with router._lock:
                 rep = router._replicas.get(survivor_id)
                 if rep is not None and rep.drain_logged:
-                    drain_seen = True
-                    break
+                    return "observed"
             if sproc.poll() is not None:
-                break
-            time.sleep(0.05)
+                return "exited"
+            return None
+
+        drain_seen = coord.poll_until(
+            _drain_progress, 60.0, interval_s=0.05, max_interval_s=0.25,
+            what=f"drain observation on {survivor_id}",
+        ) == "observed"
         rc = sproc.wait(timeout=60)
         hb = heartbeat.read_heartbeat(
             heartbeat.heartbeat_path(fleet_dir, survivor_id)
@@ -414,6 +429,43 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=30)
+
+    # -- phase 6: one scheduled chaos-drill round (stub fleet on a
+    # FaultableBackend; the same scheduler `deepdfa-tpu fleet-drill`
+    # runs on a cadence) — the DRILL record is the evidence
+    import tempfile
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.fleet import autoscale as autoscale_mod
+    from deepdfa_tpu.fleet import chaos as chaos_mod
+    from deepdfa_tpu.fleet import drill as drill_mod
+
+    # both phases run on the SAME tiny stub model (drill/autoscale use
+    # identical data.feat/model overrides) — build it once
+    stub_parts = chaos_mod.build_stub_parts(config_mod.apply_overrides(
+        Config(), [
+            'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+            "model.hidden_dim=8", "model.n_steps=2",
+        ],
+    ))
+
+    with tempfile.TemporaryDirectory() as td:
+        report["drill"] = drill_mod.DrillScheduler(
+            runner=lambda i: drill_mod.run_smoke_drill(
+                Path(td) / f"round{i}", parts=stub_parts
+            ),
+            rounds=1,
+            interval_s=0.0,
+            scenarios=drill_mod.SMOKE_SCENARIOS,
+            mode="smoke",
+        ).run()
+
+    # -- phase 7: predictive autoscaling on a replayed ramp (stub
+    # replica + real router; decisions land in its fleet_log)
+    with tempfile.TemporaryDirectory() as td:
+        report["autoscale"] = autoscale_mod.run_smoke_autoscale(
+            td, parts=stub_parts
+        )
     return report
 
 
@@ -465,4 +517,21 @@ def smoke_verdict(report: dict) -> list[str]:
             "restarted router did not re-seed admission levels from "
             "the last summary record"
         )
+    dd = report.get("drill") or {}
+    if not dd.get("ok"):
+        bad.append(
+            "drill round failed or failover missed the documented "
+            "3.2 s bound (fleet/drill.py)"
+        )
+    az = report.get("autoscale") or {}
+    if not (az.get("scaled") and az.get("scaled_ahead")):
+        bad.append("autoscale did not scale ahead of predicted load")
+    if az.get("ladder_before_scale") is not True:
+        bad.append("autoscale degradation ladder out of order")
+    if (az.get("burst") or {}).get("lost") != 0:
+        bad.append("autoscale ramp lost requests")
+    if not (
+        (az.get("fleet_log") or {}).get("ok") and az.get("ramp_log_ok")
+    ):
+        bad.append("autoscale decision records failed validation")
     return bad

@@ -857,6 +857,189 @@ def gate_tuned(
     }
 
 
+# ---------------------------------------------------------------------------
+# DRILL_r* round-over-round gating (docs/fleet.md "Scheduled drills"):
+# the scheduled chaos drills (fleet/drill.py) measure recovery times —
+# failover, admission reseed, readmit, rollback — and commit one
+# DRILL_r<N>.json per round. The trajectory joins the gate the way
+# BENCH_r*/MULTICHIP_r*/TUNED_r* did: a drill whose measured failover
+# regressed past tolerance vs the newest healthy same-mode round fails
+# CI, and the documented 3.2 s failover bound is an ABSOLUTE ceiling in
+# every round, reference or not.
+
+#: lower-is-better tolerances on the measured recovery times — generous
+#: (shared-CPU wall-clock timing is the noisiest thing the fleet
+#: measures; the absolute bound below is the hard line)
+DRILL_TOLERANCES: dict[str, float] = {
+    "drill_failover_s": 1.0,
+    "drill_reseed_s": 1.0,
+    "drill_readmit_s": 1.0,
+    "drill_rollback_s": 1.0,
+}
+
+#: ABSOLUTE ceiling on measured router failover, every round (mirrors
+#: fleet/drill.py:DRILL_BOUND_S — this module must stay importable
+#: without the fleet stack; the pair is pinned equal in tests)
+DRILL_FAILOVER_BOUND_S = 3.2
+
+
+def load_drill_trajectory(root: str | Path) -> list[dict]:
+    """Every committed DRILL_r*.json under `root`, oldest round first:
+    [{"source", "round", "record"|None, "note"|None}]. The drill record
+    IS the artifact (no driver tail wrapper to recover from); unreadable
+    files carry a note instead of a record."""
+    root = Path(root)
+    out: list[dict] = []
+    for path in sorted(root.glob("DRILL_r*.json")):
+        m = re.search(r"DRILL_r(\d+)", path.name)
+        entry: dict = {
+            "source": path.name,
+            "round": int(m.group(1)) if m else None,
+        }
+        try:
+            record = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            entry["note"] = f"unreadable: {e}"
+            entry["record"] = None
+            out.append(entry)
+            continue
+        entry["record"] = record if isinstance(record, dict) else None
+        if entry["record"] is None:
+            entry["note"] = "not a JSON object"
+        out.append(entry)
+    out.sort(key=lambda e: (e.get("round") or 0, e["source"]))
+    return out
+
+
+def _drill_healthy(record) -> bool:
+    return (
+        isinstance(record, dict)
+        and record.get("ok") is True
+        and isinstance(record.get("drill_failover_s"), (int, float))
+        and not isinstance(record.get("drill_failover_s"), bool)
+    )
+
+
+def drill_reference_for(
+    trajectory: list[dict],
+    mode: str | None,
+    exclude_source: str | None = None,
+) -> dict | None:
+    """The newest healthy SAME-MODE round (a smoke drill's in-process
+    stub timings gated against a full drill's subprocess timings compare
+    nothing) — the BENCH_r* reference rules with `mode` as the
+    comparable-scale key; a failed round never re-baselines."""
+    best = None
+    for entry in trajectory:
+        if exclude_source is not None and entry.get("source") == (
+            exclude_source
+        ):
+            continue
+        rec = entry.get("record")
+        if not _drill_healthy(rec):
+            continue
+        if mode is not None and rec.get("mode") != mode:
+            continue
+        best = {"record": rec, "source": entry["source"]}
+    return best
+
+
+def gate_drill(
+    record: dict,
+    trajectory: list[dict],
+    tolerances: dict[str, float] | None = None,
+    exclude_source: str | None = None,
+) -> dict:
+    """Verdict for one DRILL record against the committed trajectory —
+    the shape `gate()` returns. Checks: structural validity (an invalid
+    or failed record is an `error`), the 3.2 s failover bound as an
+    absolute ceiling, and each measured recovery time present in BOTH
+    rounds vs the newest healthy same-mode reference."""
+    from deepdfa_tpu.fleet.drill import validate_drill_record
+
+    tol = dict(DRILL_TOLERANCES)
+    for k, v in (tolerances or {}).items():
+        tol[k] = float(v)
+    failure_classes: list[str] = []
+    notes: list[str] = []
+    checks: list[dict] = []
+
+    problems = validate_drill_record(record)
+    if problems:
+        failure_classes.append("error")
+        notes.extend(f"schema: {p}" for p in problems[:8])
+        record = record if isinstance(record, dict) else {}
+    elif record.get("ok") is not True:
+        failure_classes.append("error")
+        failed = [
+            f"round {r.get('round')}: {r.get('error', 'failed')}"
+            for r in record.get("per_round", [])
+            if not r.get("ok")
+        ]
+        notes.append(
+            "drill record is not healthy (ok=false): "
+            + ("; ".join(failed)[:300] or "failover over bound")
+        )
+
+    failover = record.get("drill_failover_s")
+    if isinstance(failover, (int, float)) and not isinstance(
+        failover, bool
+    ):
+        ok = failover <= DRILL_FAILOVER_BOUND_S
+        checks.append({
+            "metric": "drill_failover_s",
+            "new": failover,
+            "reference": DRILL_FAILOVER_BOUND_S,
+            "ref_source": "absolute_bound",
+            "tolerance": 0.0,
+            "direction": "bound",
+            "ratio": round(failover / DRILL_FAILOVER_BOUND_S, 4),
+            "ok": ok,
+        })
+        if not ok and "regression" not in failure_classes:
+            failure_classes.append("regression")
+
+    ref = drill_reference_for(
+        trajectory, record.get("mode"), exclude_source=exclude_source
+    )
+    if ref is None:
+        notes.append(
+            f"no healthy {record.get('mode') or 'any'}-mode reference "
+            "round in the trajectory — round-over-round checks skipped"
+        )
+    else:
+        for metric, frac in sorted(tol.items()):
+            new_v = record.get(metric)
+            ref_v = ref["record"].get(metric)
+            if not isinstance(new_v, (int, float)) or not isinstance(
+                ref_v, (int, float)
+            ) or isinstance(new_v, bool) or isinstance(
+                ref_v, bool
+            ) or ref_v == 0:
+                continue
+            ratio = new_v / ref_v
+            ok = ratio <= 1 + frac
+            checks.append({
+                "metric": metric,
+                "new": new_v,
+                "reference": ref_v,
+                "ref_source": ref["source"],
+                "tolerance": frac,
+                "direction": "lower",
+                "ratio": round(ratio, 4),
+                "ok": ok,
+            })
+            if not ok and "regression" not in failure_classes:
+                failure_classes.append("regression")
+    return {
+        "verdict": "fail" if failure_classes else "pass",
+        "failure_classes": failure_classes,
+        "mode": record.get("mode"),
+        "checks": checks,
+        "notes": notes,
+    }
+
+
 def render_markdown(result: dict, record: dict | None = None) -> str:
     """The human half of the verdict: a status line, the failure
     classes, and the per-metric table."""

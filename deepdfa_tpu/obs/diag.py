@@ -539,6 +539,103 @@ def fleet_section(run_dir: Path, fleet_records: list[dict]) -> dict:
     return out
 
 
+def autoscale_section(fleet_records: list[dict]) -> dict:
+    """The predictive-autoscaling section, rebuilt from the router's
+    fleet_log.jsonl `{"autoscale": ...}` decision records
+    (fleet/autoscale.py; docs/fleet.md): action counts, the decision
+    timeline (forecast vs capacity ratio per bucket), and the first
+    scale_up — the record that must land BEFORE the offered rate
+    crosses capacity."""
+    decisions = [
+        r["autoscale"] for r in fleet_records
+        if isinstance(r.get("autoscale"), dict)
+    ]
+    if not decisions:
+        return {}
+    actions: dict[str, int] = {}
+    for d in decisions:
+        a = str(d.get("action", "?"))
+        actions[a] = actions.get(a, 0) + 1
+    out: dict = {
+        "decisions": len(decisions),
+        "actions": dict(sorted(actions.items())),
+        "timeline": [
+            {
+                k: d[k]
+                for k in ("action", "reason", "forecast_rps",
+                          "offered_rps", "capacity_rps", "ratio",
+                          "replicas", "target_replicas", "stage")
+                if k in d
+            }
+            for d in decisions
+        ],
+    }
+    first_up = next(
+        (d for d in decisions if d.get("action") == "scale_up"), None
+    )
+    if first_up is not None:
+        out["first_scale_up"] = {
+            k: first_up[k]
+            for k in ("forecast_rps", "offered_rps", "capacity_rps",
+                      "ratio", "replicas", "target_replicas")
+            if k in first_up
+        }
+    return out
+
+
+def drill_section(
+    run_dir: Path, root: str | Path | None = None
+) -> dict:
+    """The scheduled chaos-drill trajectory (DRILL_r*.json records,
+    fleet/drill.py; docs/fleet.md): every round's measured
+    failover/readmit/reseed/rollback times plus the regression-gate
+    verdict for the newest round (obs/bench_gate.py:gate_drill — the
+    3.2 s failover bound is an absolute ceiling). Looks in the run dir
+    first (the smoke fixture drops its record there), then the
+    committed repo-root trajectory."""
+    from deepdfa_tpu.fleet.drill import validate_drill_record
+    from deepdfa_tpu.obs import bench_gate as bg
+
+    trajectory = bg.load_drill_trajectory(Path(run_dir))
+    if not trajectory:
+        root = (
+            Path(root) if root
+            else Path(__file__).resolve().parents[2]
+        )
+        trajectory = bg.load_drill_trajectory(root)
+    if not trajectory:
+        return {}
+    rows = []
+    newest = None
+    newest_source = None
+    for entry in trajectory:
+        rec = entry.get("record")
+        row: dict = {"source": entry["source"]}
+        if entry.get("round") is not None:
+            row["round"] = entry["round"]
+        if isinstance(rec, dict):
+            row.update({
+                k: rec[k]
+                for k in ("mode", "rounds", "drill_failover_s",
+                          "drill_readmit_s", "drill_reseed_s",
+                          "drill_rollback_s", "drill_bound_s", "ok")
+                if k in rec
+            })
+            row["valid"] = not validate_drill_record(rec)
+            newest, newest_source = rec, entry["source"]
+        if entry.get("note"):
+            row["note"] = entry["note"]
+        rows.append(row)
+    out: dict = {"trajectory": rows}
+    if newest is not None:
+        # the newest round is part of the trajectory: exclude it from
+        # its own reference selection, like the bench gate does
+        out["gate"] = bg.gate_drill(
+            newest, trajectory, exclude_source=newest_source
+        )
+    return out
+
+
 def efficiency_section(run_dir: Path, records: list[dict]) -> dict:
     """The device efficiency view (obs/ledger.py, docs/efficiency.md),
     rebuilt from the run's own artifacts: the newest embedded ledger
@@ -789,6 +886,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         if val_keys:
             summary["final_val"] = {k: epochs[-1][k] for k in val_keys}
     serve_records = load_serve_records(run_dir)
+    fleet_records = load_fleet_records(run_dir)
     return {
         "summary": summary,
         "timeline": throughput_timeline(records),
@@ -801,7 +899,9 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "slo": slo_section(serve_records),
         "cascade": cascade_section(serve_records),
         "scan": scan_section(load_scan_records(run_dir)),
-        "fleet": fleet_section(run_dir, load_fleet_records(run_dir)),
+        "fleet": fleet_section(run_dir, fleet_records),
+        "autoscale": autoscale_section(fleet_records),
+        "drill": drill_section(run_dir, bench_root),
         "efficiency": efficiency_section(run_dir, records),
         "tuning": tuning_section(run_dir),
         "postmortem": load_postmortem(run_dir),
@@ -1105,6 +1205,68 @@ def render_text(report: dict, out=sys.stdout) -> None:
             w("  " + " ".join(
                 f"{k}={int(v)}" for k, v in counters.items()
             ) + "\n")
+
+    autoscale = report.get("autoscale") or {}
+    if autoscale:
+        w("\npredictive autoscaling (fleet_log.jsonl, docs/fleet.md):\n")
+        w(
+            f"  decisions={autoscale.get('decisions')}  "
+            + " ".join(
+                f"{k}={v}"
+                for k, v in (autoscale.get("actions") or {}).items()
+            )
+            + "\n"
+        )
+        fs = autoscale.get("first_scale_up")
+        if fs:
+            w(
+                f"  first scale_up: offered={fs.get('offered_rps')} "
+                f"forecast={fs.get('forecast_rps')} capacity="
+                f"{fs.get('capacity_rps')} -> replicas="
+                f"{fs.get('target_replicas')}\n"
+            )
+        for d in autoscale.get("timeline") or []:
+            ratio = d.get("ratio")
+            bar = (
+                _bar(min(1.0, float(ratio)), 20)
+                if isinstance(ratio, (int, float)) else " " * 20
+            )
+            w(
+                f"    {d.get('action', '?'):<18}{bar} "
+                f"ratio={ratio} replicas={d.get('replicas')} "
+                f"({d.get('reason')})\n"
+            )
+
+    drill = report.get("drill") or {}
+    if drill.get("trajectory"):
+        w("\nchaos drills (DRILL_r*.json, fleet/drill.py):\n")
+        for row in drill["trajectory"]:
+            if "drill_failover_s" in row:
+                mark = "+" if row.get("ok") else "x"
+                w(
+                    f"  [{mark}] {row['source']:<18} "
+                    f"mode={row.get('mode')} "
+                    f"rounds={row.get('rounds')} "
+                    f"failover={row.get('drill_failover_s')}s "
+                    f"readmit={row.get('drill_readmit_s')}s "
+                    f"reseed={row.get('drill_reseed_s')}s "
+                    f"(bound {row.get('drill_bound_s')}s)\n"
+                )
+            else:
+                w(
+                    f"  [x] {row['source']:<18} "
+                    f"{row.get('note', 'no record')}\n"
+                )
+        gate = drill.get("gate")
+        if gate:
+            w(
+                f"  gate verdict: {gate['verdict']}"
+                + (
+                    f" ({', '.join(gate['failure_classes'])})"
+                    if gate["failure_classes"] else ""
+                )
+                + "\n"
+            )
 
     eff = report.get("efficiency") or {}
     if eff:
@@ -1540,6 +1702,24 @@ def build_smoke_run(run_dir: Path) -> Path:
         "name": "readmit", "replica": "r1",
         "t_unix": round(t_now - 2, 3),
     }})
+    # autoscale decisions through the REAL controller + emitter
+    # (fleet/autoscale.py): a replayed ramp escalates the degradation
+    # ladder (shed_stage2 -> tighten_admission) and scales up, each
+    # decision appended as the same {"autoscale": ...} record shape the
+    # live fleet smoke leaves — what the diag autoscale section reads
+    from deepdfa_tpu.fleet import autoscale as fleet_autoscale
+
+    ctrl = fleet_autoscale.AutoscaleController(
+        capacity_rps=10.0, cooldown_s=0.0, min_replicas=1,
+        max_replicas=4, horizon_s=5.0, bucket_s=1.0,
+    )
+    ramp = [
+        (round(t_now - 12 + k, 3), 2.0 + 1.2 * k) for k in range(12)
+    ]
+    for decision in fleet_autoscale.replay(ramp, ctrl, replicas=1):
+        flog.append(fleet_autoscale.AutoscaleController.log_record(
+            decision
+        ))
     flog.append({
         "fleet": {
             "requests": 12, "forwarded": 10, "retries": 1,
@@ -1550,6 +1730,20 @@ def build_smoke_run(run_dir: Path) -> Path:
         "fleet_replicas": 2,
     })
     flog.close()
+    # a chaos-drill record through the REAL scheduler + recorder
+    # (fleet/drill.py): a stub runner with plausible measured timings,
+    # folded by DrillScheduler and written by write_drill_record — the
+    # diag drill section renders it and gates it like a committed round
+    from deepdfa_tpu.fleet import drill as fleet_drill
+
+    drill_rec = fleet_drill.DrillScheduler(
+        runner=lambda i: {
+            "ok": True, "failover_s": 0.4 + 0.1 * i,
+            "readmit_s": 1.2, "reseed_s": 0.05,
+        },
+        rounds=2, interval_s=0.0, mode="smoke",
+    ).run()
+    fleet_drill.write_drill_record(drill_rec, run_dir)
     # one replica's own serve log (per-replica obs home) so the fleet
     # section picks up batch occupancy from the replica side
     (run_dir / "fleet" / "r0").mkdir(parents=True, exist_ok=True)
@@ -1693,6 +1887,28 @@ def main(argv=None) -> int:
                 and (fleet.get("by_priority") or {})
                 and {"join", "eject", "readmit"} <= fleet_events
                 and fleet.get("counters", {}).get("ejects") == 1
+                # ISSUE 18 sections: the predictive-autoscale decision
+                # timeline (real controller over a replayed ramp — the
+                # ladder escalates before the scale_up) and the
+                # chaos-drill trajectory (real scheduler/recorder,
+                # gated under the 3.2 s failover ceiling)
+                and (report.get("autoscale") or {}).get(
+                    "actions", {}
+                ).get("scale_up", 0) >= 1
+                and report["autoscale"]["actions"].get(
+                    "shed_stage2", 0
+                ) >= 1
+                and report["autoscale"]["actions"].get(
+                    "tighten_admission", 0
+                ) >= 1
+                and report["autoscale"].get("first_scale_up")
+                and (report.get("drill") or {}).get(
+                    "gate", {}
+                ).get("verdict") == "pass"
+                and report["drill"]["trajectory"][-1].get(
+                    "drill_failover_s"
+                ) == 0.5  # worst of the two stub rounds (0.4, 0.5)
+                and report["drill"]["trajectory"][-1].get("valid")
                 # ISSUE 10 sections: the efficiency ledger (per-site
                 # MFU + compile bars + HBM watermark timeline) and the
                 # postmortem view, both from the real emitters

@@ -347,6 +347,19 @@ SCHEMA: tuple[str, ...] = (
     # event name) and the {"rollout": {...}} fleet_log records' scalar
     # fields (t_unix, drift, checkpoint_step, recompiles, guard stats)
     "rollout/*",
+    # pluggable coordination backend (fleet/coord.py): poll-exhaustion
+    # and fenced-publish counters, plus the FaultableBackend's injected
+    # fault counters (coord/faults/<kind>) the chaos drills assert on
+    "coord/*",
+    # scheduled chaos drills (fleet/drill.py; DRILL_r* records gated in
+    # obs/bench_gate.py:gate_drill): round/failure counters and the
+    # record's measured recovery-time fields (drill_failover_s,
+    # drill_reseed_s, drill_readmit_s, drill_rollback_s, drill_bound_s)
+    "drill/*", "drill_*",
+    # predictive autoscaling (fleet/autoscale.py): decision counters by
+    # action plus the {"autoscale": {...}} fleet_log records' scalar
+    # fields (forecast/capacity rates, ratio, replica counts, stage)
+    "autoscale/*", "autoscale_*",
     # fleet_log summary + bench_load record fields (scripts/
     # bench_load.py, bench.py --child-fleet; gated in obs/bench_gate.py)
     "fleet_replicas", "fleet_requests_per_sec", "fleet_seconds",

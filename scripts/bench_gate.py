@@ -26,6 +26,12 @@ Modes:
         time, fitted ladder waste, fit-beats-pow2, search-seconds
         bound) against the newest same-hardware-key round; PATH
         defaults to the newest committed TUNED_r*.json
+  python scripts/bench_gate.py --drill [PATH]         # gate a
+        DRILL_r* chaos-drill record round-over-round (measured
+        failover/reseed/readmit/rollback times vs the newest healthy
+        same-mode round, plus the documented 3.2 s failover bound as
+        an absolute ceiling); PATH defaults to the newest committed
+        DRILL_r*.json (fleet/drill.py, docs/fleet.md)
   python scripts/bench_gate.py --smoke                # tier-1: verify
         the classifier on synthetic pass/regression/fallback records
 
@@ -226,6 +232,54 @@ def run_tuned(args) -> int:
     return 0 if result["verdict"] == "pass" else 1
 
 
+def run_drill(args) -> int:
+    """`--drill [PATH]`: gate one DRILL record against the committed
+    DRILL_r* trajectory (fleet/drill.py, docs/fleet.md; same exit-code
+    contract: 0 pass, 1 regression/error)."""
+    from deepdfa_tpu.obs.bench_gate import (
+        gate_drill,
+        load_drill_trajectory,
+        render_markdown,
+    )
+
+    root = Path(args.root)
+    trajectory = load_drill_trajectory(root)
+    exclude = None
+    if args.drill:
+        path = Path(args.drill)
+        record = json.loads(path.read_text())
+        source = str(path)
+        if path.resolve().parent == root.resolve():
+            exclude = path.name
+    else:
+        candidates = [
+            e for e in trajectory if isinstance(e.get("record"), dict)
+        ]
+        if not candidates:
+            raise SystemExit(f"no parseable DRILL_r*.json under {root}")
+        record = candidates[-1]["record"]
+        source = exclude = candidates[-1]["source"]
+
+    tolerances = {}
+    for spec in args.tolerance:
+        metric, _, frac = spec.partition("=")
+        tolerances[metric] = float(frac)
+    result = gate_drill(
+        record, trajectory,
+        tolerances=tolerances or None,
+        exclude_source=exclude,
+    )
+    result["record_source"] = source
+    md = render_markdown(result)
+    print(md)
+    print(json.dumps(result), flush=True)
+    if args.out:
+        Path(args.out).write_text(json.dumps(result, indent=1))
+    if args.markdown_out:
+        Path(args.markdown_out).write_text(md)
+    return 0 if result["verdict"] == "pass" else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--record", default=None,
@@ -257,6 +311,14 @@ def main(argv=None) -> int:
                     "against the newest same-hardware round; default: "
                     "the newest committed TUNED_r*.json "
                     "(deepdfa_tpu/tune/, docs/tuning.md)")
+    ap.add_argument("--drill", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="gate a DRILL_r* chaos-drill record "
+                    "round-over-round (measured recovery times vs the "
+                    "newest healthy same-mode round + the 3.2 s "
+                    "failover bound as an absolute ceiling); default: "
+                    "the newest committed DRILL_r*.json "
+                    "(fleet/drill.py, docs/fleet.md)")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 classifier self-check on synthetic "
                     "records")
@@ -270,6 +332,9 @@ def main(argv=None) -> int:
 
     if args.tuned is not None:
         return run_tuned(args)
+
+    if args.drill is not None:
+        return run_drill(args)
 
     from deepdfa_tpu.obs.bench_gate import (
         gate,

@@ -58,6 +58,12 @@ Modes:
                  a winner present per signature, ladder fits carry
                  their pow2 baseline — wired into
                  `deepdfa-tpu tune --smoke`
+  --drill <path> validate a DRILL_r*.json chaos-drill record
+                 (deepdfa_tpu/fleet/drill.py:validate_drill_file,
+                 docs/fleet.md): mode + cadence stamps, per-round
+                 entries matching the declared round count, measured
+                 failover/reseed/readmit timings numeric, the 3.2 s
+                 bound recorded — wired into `deepdfa-tpu fleet-drill`
   --multichip <path>  validate a MULTICHIP record (the driver artifact
                  MULTICHIP_r*.json, or the raw `{"multichip": ...}`
                  line `__graft_entry__.py:dryrun_multichip` prints —
@@ -207,6 +213,10 @@ def main(argv=None) -> int:
                     help="validate a tuned.json / TUNED_r*.json record "
                     "(deepdfa_tpu/tune/cache.py:validate_tuned, "
                     "docs/tuning.md)")
+    ap.add_argument("--drill", default=None,
+                    help="validate a DRILL_r*.json chaos-drill record "
+                    "(deepdfa_tpu/fleet/drill.py:validate_drill_file, "
+                    "docs/fleet.md)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -259,6 +269,24 @@ def main(argv=None) -> int:
                 "tuned record validation failed (fix the search "
                 "emitters in deepdfa_tpu/tune/ or re-run "
                 "`deepdfa-tpu tune`):\n  "
+                + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.drill:
+        from deepdfa_tpu.fleet.drill import validate_drill_file
+
+        result = validate_drill_file(args.drill)
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "drill record validation failed (fix the drill "
+                "runner/recorder in deepdfa_tpu/fleet/drill.py or "
+                "re-run `deepdfa-tpu fleet-drill`):\n  "
                 + "\n  ".join(result.get("problems", [])),
                 file=sys.stderr,
             )

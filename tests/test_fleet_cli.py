@@ -73,6 +73,35 @@ def test_fleet_smoke_end_to_end(tmp_path):
     assert dr["postmortem"]["trigger"] == "sigterm"
     assert dr["final_serve_log"] is True
 
+    # -- scheduled drill phase (ISSUE 18): one round on the stub-fleet
+    # HA pair through a FaultableBackend, measured failover under the
+    # documented 3.2 s bound, readmit + log-reseed completed
+    dd = report["drill"]
+    assert dd["ok"] is True
+    assert dd["mode"] == "smoke"
+    assert dd["drill_failover_s"] < dd["drill_bound_s"] == 3.2
+    assert dd["drill_readmit_s"] > 0
+    assert dd["drill_reseed_s"] > 0
+    assert len(dd["per_round"]) == dd["rounds"] == 1
+    # the drill genuinely ran through the faultable seam
+    assert dd["per_round"][0]["coord_faults"].get("latency", 0) > 0
+
+    # -- predictive autoscale phase (ISSUE 18): the ladder escalated
+    # shed_stage2 -> tighten_admission, then scaled up BEFORE the
+    # offered rate crossed measured capacity; the scaled fleet lost
+    # nothing and every decision is a schema-valid fleet_log record
+    az = report["autoscale"]
+    assert az["ok"] is True
+    assert az["scaled"] is True
+    assert az["scaled_ahead"] is True
+    assert az["rate_at_scale_rps"] < az["capacity_rps"] < az["peak_rps"]
+    assert az["ladder_before_scale"] is True
+    assert az["burst"]["lost"] == 0
+    assert az["burst"]["routable_replicas"] == 2
+    assert az["fleet_log"]["ok"] is True
+    assert az["fleet_log"]["autoscale"] >= len(az["actions"])
+    assert az["ramp_log_ok"] is True
+
     # -- the router's log validates in-process AND through the script
     assert report["fleet_log"]["ok"] is True
     assert report["fleet_log"]["requests"] > 0

@@ -1,0 +1,665 @@
+"""Coordination-backend contract, chaos-drill scheduling + gating, and
+predictive-autoscale controller units (deepdfa_tpu/fleet/{coord,drill,
+autoscale}.py + the obs/bench_gate.py drill trajectory gate,
+docs/fleet.md) — ISSUE 18.
+
+The backend contract suite runs against BOTH backends: the default
+LocalDirBackend and the drills' FaultableBackend with no faults
+programmed must be indistinguishable; the injected faults are then
+pinned observable ONLY through the faultable wrapper."""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.fleet import autoscale, coord, drill
+from deepdfa_tpu.obs import bench_gate as bg
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+
+def counter(name: str) -> float:
+    return obs_metrics.REGISTRY.snapshot().get(name, 0.0)
+
+
+class FakeClock:
+    """A deterministic clock whose sleep advances it (poll/cadence
+    schedules become exact assertions, not wall-clock races)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += s
+
+
+# ---------------------------------------------------------------------------
+# poll_until: the one shared bounded poll helper
+
+
+def test_poll_until_returns_first_truthy_value():
+    calls = []
+
+    def pred():
+        calls.append(1)
+        return {"ready": True}
+
+    # timeout_s=0 still checks once ("check now")
+    out = coord.poll_until(pred, 0.0, sleep=lambda s: None)
+    assert out == {"ready": True}
+    assert len(calls) == 1
+
+
+def test_poll_until_exhaustion_backoff_and_counter():
+    clk = FakeClock()
+    sleeps: list[float] = []
+
+    def sleep(s):
+        sleeps.append(s)
+        clk.sleep(s)
+
+    before = counter("coord/poll_exhausted")
+    out = coord.poll_until(
+        lambda: None, 1.0, interval_s=0.1, max_interval_s=0.4,
+        jitter=0.0, clock=clk, sleep=sleep,
+    )
+    assert out is None
+    assert counter("coord/poll_exhausted") == before + 1
+    # exponential: 0.1, 0.2, then capped at 0.4; the final sleep is
+    # clamped to the deadline — total never overshoots timeout_s
+    assert sleeps[:3] == [pytest.approx(0.1), pytest.approx(0.2),
+                         pytest.approx(0.4)]
+    assert all(s <= 0.4 + 1e-9 for s in sleeps)
+    assert sum(sleeps) == pytest.approx(1.0)
+
+
+def test_poll_until_propagates_predicate_exceptions():
+    # a predicate that can tell the waited-for thing DIED raises; the
+    # helper must not swallow that into more polling
+    def pred():
+        raise RuntimeError("replica exited rc=1")
+
+    with pytest.raises(RuntimeError, match="exited"):
+        coord.poll_until(pred, 5.0, sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# the backend contract, against BOTH backends
+
+
+@pytest.fixture(params=["local", "faultable"])
+def backend(request):
+    if request.param == "local":
+        return coord.LocalDirBackend()
+    return coord.FaultableBackend()
+
+
+def test_backend_doc_round_trip_and_absent_raises(backend, tmp_path):
+    p = tmp_path / "hb" / "r0.json"
+    with pytest.raises(OSError):
+        backend.read_doc(p)
+    backend.write_doc(p, '{"state": "ready"}')
+    assert backend.read_doc(p) == '{"state": "ready"}'
+    backend.write_doc(p, '{"state": "drained"}')
+    assert backend.read_doc(p) == '{"state": "drained"}'
+
+
+def test_backend_scan_sorted_and_missing_dir(backend, tmp_path):
+    assert backend.scan(tmp_path / "nope", "*.json") == []
+    for name in ("b.json", "a.json", "c.txt"):
+        backend.write_doc(tmp_path / name, "{}")
+    assert [p.name for p in backend.scan(tmp_path, "*.json")] == [
+        "a.json", "b.json",
+    ]
+
+
+def test_backend_log_append_tail_and_torn_tolerance(backend, tmp_path):
+    log = tmp_path / "fleet_log.jsonl"
+    handle = backend.open_log(log)
+    handle.write_line(json.dumps({"request": {"id": "a"}}))
+    handle.write_line(json.dumps({"request": {"id": "b"}}))
+    handle.close()
+    assert handle.closed
+    # a crashed writer's torn final line: half a record, no newline
+    with log.open("a") as f:
+        f.write('{"request": {"id": "c"')
+    recs = backend.tail_records(log, 1 << 20)
+    assert [r["request"]["id"] for r in recs] == ["a", "b"]
+    # a byte-bounded tail also tears the FIRST line at the seek; torn
+    # lines cost one record each, never the read
+    small = backend.tail_records(log, 30)
+    assert all(
+        r["request"]["id"] in ("a", "b") for r in small
+    )
+    with pytest.raises(OSError):
+        backend.tail(tmp_path / "missing.jsonl", 1 << 20)
+
+
+def test_backend_rendezvous_epoch_fencing_contract(backend, tmp_path):
+    path = tmp_path / coord.ROUTER_FILE
+    assert backend.read_rendezvous(path) is None
+    assert backend.publish_rendezvous(
+        path, "ra", "127.0.0.1", 8123, 1
+    ) is None
+    rv = backend.read_rendezvous(path)
+    assert (rv["router_id"], rv["epoch"]) == ("ra", 1)
+
+    # a refresh at a STALE epoch is fenced: the winning record comes
+    # back, the file stays untouched
+    before = counter("coord/fenced_publishes")
+    fenced = backend.publish_rendezvous(
+        path, "rb", "127.0.0.1", 8200, 0, force=False
+    )
+    assert (fenced["router_id"], fenced["epoch"]) == ("ra", 1)
+    assert counter("coord/fenced_publishes") == before + 1
+    assert backend.read_rendezvous(path)["router_id"] == "ra"
+
+    # equal epoch: the lexically smaller id wins the tie-break — "rb"
+    # is refused by "ra", but "r0" supersedes it
+    assert backend.publish_rendezvous(
+        path, "rb", "127.0.0.1", 8200, 1, force=False
+    ) is not None
+    assert backend.publish_rendezvous(
+        path, "r0", "127.0.0.1", 8300, 1, force=False
+    ) is None
+    assert backend.read_rendezvous(path)["router_id"] == "r0"
+    # a router's own refresh of its own record always lands
+    assert backend.publish_rendezvous(
+        path, "r0", "127.0.0.1", 8301, 1, force=False
+    ) is None
+    # a takeover (force=True, epoch+1) publishes unconditionally, and
+    # the higher epoch now fences everyone below it
+    assert backend.publish_rendezvous(
+        path, "rz", "127.0.0.1", 8400, 2
+    ) is None
+    assert backend.read_rendezvous(path)["epoch"] == 2
+    assert backend.publish_rendezvous(
+        path, "ra", "127.0.0.1", 8123, 1, force=False
+    )["router_id"] == "rz"
+
+
+def test_backend_read_rendezvous_malformed_is_absent(backend, tmp_path):
+    path = tmp_path / coord.ROUTER_FILE
+    for damage in (
+        "not json",
+        json.dumps({"something": "else"}),
+        json.dumps({"router": {"router_id": "ra"}}),  # missing fields
+    ):
+        backend.write_doc(path, damage)
+        assert backend.read_rendezvous(path) is None
+
+
+def test_backend_registry_and_config_default():
+    assert isinstance(
+        coord.make_backend("local"), coord.LocalDirBackend
+    )
+    assert isinstance(
+        coord.make_backend("faultable"), coord.FaultableBackend
+    )
+    with pytest.raises(ValueError, match="zookeeper"):
+        coord.make_backend("zookeeper")
+
+    from deepdfa_tpu.core import Config, config as config_mod
+
+    # the default path allocates nothing new: the shared singleton
+    assert coord.backend_from_config(Config()) is coord.LOCAL
+    cfg = config_mod.apply_overrides(
+        Config(), ["fleet.coord_backend=faultable"]
+    )
+    faulted = coord.backend_from_config(cfg)
+    assert isinstance(faulted, coord.FaultableBackend)
+    assert faulted is not coord.LOCAL
+
+
+# ---------------------------------------------------------------------------
+# injected faults: observable ONLY through the FaultableBackend
+
+
+def test_faultable_latency_delays_and_counts(tmp_path):
+    fb = coord.FaultableBackend()
+    p = tmp_path / "slow.json"
+    fb.set_fault("slow.json", latency_s=0.02)
+    before = counter("coord/faults/latency")
+    t0 = time.monotonic()
+    fb.write_doc(p, "{}")
+    assert time.monotonic() - t0 >= 0.02
+    assert counter("coord/faults/latency") == before + 1
+
+
+def test_faultable_stale_lost_and_torn_writes(tmp_path):
+    fb = coord.FaultableBackend()
+    p = tmp_path / "doc.json"
+    fb.write_doc(p, "v1")
+    fb.write_doc(p, "v2")
+
+    # a lagging replica of the store serves the overwritten version —
+    # exactly once per budgeted stale read
+    fb.set_fault("doc.json", stale_reads=1)
+    before = counter("coord/faults/stale_read")
+    assert fb.read_doc(p) == "v1"
+    assert counter("coord/faults/stale_read") == before + 1
+    assert fb.read_doc(p) == "v2"
+    fb.clear_faults()
+
+    # a lost write is dropped silently; the inner file — what a plain
+    # LocalDirBackend sees — is untouched (the fault does not leak)
+    fb.set_fault("doc.json", lose_writes=1)
+    fb.write_doc(p, "v3")
+    assert fb.read_doc(p) == "v2"
+    assert coord.LocalDirBackend().read_doc(p) == "v2"
+    fb.clear_faults()
+
+    # a torn write lands NON-atomically truncated — the damage
+    # atomic_write_text exists to prevent; readers must see "absent",
+    # not crash
+    rv_doc = json.dumps({"router": {
+        "router_id": "ra", "host": "h", "port": 1, "epoch": 1,
+        "t_unix": 0.0,
+    }})
+    fb.set_fault("doc.json", torn_writes=1)
+    before = counter("coord/faults/torn_write")
+    fb.write_doc(p, rv_doc)
+    assert counter("coord/faults/torn_write") == before + 1
+    assert fb.read_rendezvous(p) is None
+
+
+def test_faultable_partition_blocks_until_cleared(tmp_path):
+    fb = coord.FaultableBackend()
+    p = tmp_path / "hb.json"
+    fb.write_doc(p, "{}")
+    fb.set_fault("hb.json", partitioned=True)
+    before = counter("coord/faults/partition")
+    with pytest.raises(OSError, match="injected partition"):
+        fb.read_doc(p)
+    with pytest.raises(OSError, match="injected partition"):
+        fb.write_doc(p, "{}")
+    assert counter("coord/faults/partition") == before + 2
+    fb.clear_faults()
+    assert fb.read_doc(p) == "{}"
+
+
+def test_faultable_log_lost_and_torn_appends(tmp_path):
+    fb = coord.FaultableBackend()
+    log = tmp_path / "fleet_log.jsonl"
+    handle = fb.open_log(log)
+    handle.write_line(json.dumps({"request": {"id": "a"}}))
+    fb.set_fault("fleet_log.jsonl", lose_writes=1, torn_writes=1)
+    handle.write_line(json.dumps({"request": {"id": "lost"}}))
+    handle.write_line(json.dumps({"request": {"id": "torn-entry"}}))
+    handle.write_line(json.dumps({"request": {"id": "b"}}))
+    handle.close()
+    # the lost line vanished, the torn line is unparseable — the
+    # torn-tolerant tail skips both and keeps the survivors
+    recs = fb.tail_records(log, 1 << 20)
+    assert [r["request"]["id"] for r in recs] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# autoscale: forecast + controller
+
+
+def test_forecast_rate_trend_and_degenerate_cases():
+    assert autoscale.forecast_rate([], 5.0) == 0.0
+    assert autoscale.forecast_rate([(0.0, 3.0)], 5.0) == 3.0
+    # an exact linear trend extrapolates exactly: slope 2/s over 5 s
+    rising = [(float(t), 2.0 * t) for t in range(8)]
+    assert autoscale.forecast_rate(rising, 5.0) == pytest.approx(24.0)
+    # a falling trend clamps at zero, never a negative rate
+    falling = [(float(t), 10.0 - 3.0 * t) for t in range(4)]
+    assert autoscale.forecast_rate(falling, 100.0) == 0.0
+
+
+def test_controller_ctor_validation():
+    with pytest.raises(ValueError, match="capacity_rps"):
+        autoscale.AutoscaleController(0.0)
+    with pytest.raises(ValueError, match="down_fraction"):
+        autoscale.AutoscaleController(
+            10.0, up_fraction=0.5, down_fraction=0.5
+        )
+
+
+def test_controller_ladder_escalates_one_rung_per_bucket():
+    c = autoscale.AutoscaleController(
+        10.0, cooldown_s=5.0, max_replicas=3
+    )
+    d1 = c.decide(9.0, 1, now=0.0)
+    assert (d1["action"], d1["stage"]) == ("shed_stage2", 1)
+    d2 = c.decide(9.0, 1, now=1.0)
+    assert (d2["action"], d2["stage"]) == ("tighten_admission", 2)
+    d3 = c.decide(9.0, 1, now=2.0)
+    assert d3["action"] == "scale_up" and d3["target_replicas"] == 2
+    # cooldown gates the next replica; the admission ladder stays on
+    d4 = c.decide(19.0, 2, now=3.0)
+    assert (d4["action"], d4["reason"]) == ("hold", "cooldown")
+    d5 = c.decide(29.0, 2, now=10.0)
+    assert d5["action"] == "scale_up" and d5["target_replicas"] == 3
+    d6 = c.decide(29.0, 3, now=30.0)
+    assert (d6["action"], d6["reason"]) == ("hold", "at_max_replicas")
+    for d in (d1, d2, d3, d4, d5, d6):
+        from deepdfa_tpu.fleet.router import AUTOSCALE_ACTIONS
+
+        assert d["action"] in AUTOSCALE_ACTIONS
+
+
+def test_controller_deescalates_relax_then_scale_down():
+    c = autoscale.AutoscaleController(10.0, cooldown_s=0.0)
+    c.decide(9.0, 1, now=0.0)  # ladder stage 1 applied
+    d = c.decide(1.0, 2, now=1.0)
+    assert (d["action"], d["stage"]) == ("relax", 0)
+    d2 = c.decide(1.0, 2, now=2.0)
+    assert d2["action"] == "scale_down" and d2["target_replicas"] == 1
+    d3 = c.decide(1.0, 1, now=3.0)
+    assert (d3["action"], d3["reason"]) == ("hold", "at_min_replicas")
+    # the band between the fractions is deliberately dead (hysteresis)
+    d4 = c.decide(5.0, 1, now=4.0)
+    assert (d4["action"], d4["reason"]) == ("hold", "in_band")
+
+
+class _Admission:
+    """The two attributes apply_to touches on the real controller."""
+
+    def __init__(self):
+        self.shed_fraction = 0.5
+        self.cascade_shed_fraction = 0.4
+
+
+def test_apply_to_mutates_admission_and_relax_restores():
+    c = autoscale.AutoscaleController(10.0)
+    adm = _Admission()
+    c.apply_to(adm, {"action": "shed_stage2"})
+    assert adm.cascade_shed_fraction == pytest.approx(0.2)
+    assert adm.shed_fraction == 0.5
+    c.apply_to(adm, {"action": "tighten_admission"})
+    assert adm.shed_fraction == pytest.approx(0.4)
+    # the scale rungs are the caller's; admission policy is untouched
+    c.apply_to(adm, {"action": "scale_up"})
+    assert adm.shed_fraction == pytest.approx(0.4)
+    assert adm.cascade_shed_fraction == pytest.approx(0.2)
+    c.apply_to(adm, {"action": "relax"})
+    assert adm.shed_fraction == 0.5
+    assert adm.cascade_shed_fraction == 0.4
+
+
+def test_replay_escalates_ahead_and_tracks_replicas():
+    c = autoscale.AutoscaleController(
+        10.0, cooldown_s=0.0, max_replicas=2
+    )
+    rates = [(float(t), 2.0 + 1.5 * t) for t in range(10)]
+    decisions = autoscale.replay(rates, c, replicas=1)
+    actions = [d["action"] for d in decisions]
+    assert actions.count("scale_up") == 1
+    i = actions.index("scale_up")
+    # the full ladder ran before the replica was paid for
+    assert "shed_stage2" in actions[:i]
+    assert "tighten_admission" in actions[:i]
+    # ...and the scale decision landed while offered < capacity: the
+    # forecast's lead time, not a reaction to saturation
+    assert decisions[i]["offered_rps"] < c.capacity_rps
+    assert decisions[i]["replicas"] == 1
+    assert decisions[i]["target_replicas"] == 2
+    assert all(d["replicas"] == 2 for d in decisions[i + 1:])
+    assert [d["offered_rps"] for d in decisions] == [
+        pytest.approx(r) for _, r in rates
+    ]
+
+
+def test_arrival_rates_from_log_buckets_gaps_and_torn_tail(tmp_path):
+    log = tmp_path / "fleet_log.jsonl"
+    lines = [
+        {"request": {"id": "a", "t_unix": 100.2}},
+        {"request": {"id": "b", "t_unix": 100.9}},
+        {"fleet_event": {"name": "join", "t_unix": 101.0}},
+        {"request": {"id": "c", "t_unix": 103.4}},
+    ]
+    log.write_text("\n".join(json.dumps(ln) for ln in lines) + "\n")
+    with log.open("a") as f:
+        f.write('{"request": {"id": "torn", "t_unix": 104')
+    rates = autoscale.arrival_rates_from_log(log, bucket_s=1.0)
+    # non-request lines and the torn final line cost nothing; the idle
+    # buckets are real 0.0 observations, not missing data
+    assert rates == [
+        (100.0, 2.0), (101.0, 0.0), (102.0, 0.0), (103.0, 1.0),
+    ]
+    assert autoscale.arrival_rates_from_log(
+        tmp_path / "missing.jsonl"
+    ) == []
+
+
+def test_max_replicas_from_ledger_caps_configured_max():
+    n, plan = autoscale.max_replicas_from_ledger(4, {}, 0.0)
+    assert n == 4 and plan["reason"] == "unbudgeted"
+    # 100 MB of params x4 headroom = 400 MB/replica; a 900 MB budget
+    # fits 2 stacks — the ledger cap beats the configured max
+    n2, plan2 = autoscale.max_replicas_from_ledger(
+        4, {"deepdfa": 100e6}, 900e6
+    )
+    assert n2 == 2 and plan2["reason"] == "ledger"
+
+
+def test_autoscale_decisions_are_schema_valid_fleet_log_records(tmp_path):
+    from deepdfa_tpu.fleet.router import validate_fleet_log
+
+    c = autoscale.AutoscaleController(10.0, cooldown_s=0.0)
+    decisions = autoscale.replay(
+        [(0.0, 2.0), (1.0, 9.0), (2.0, 9.5)], c, replicas=1
+    )
+    log = tmp_path / "fleet_log.jsonl"
+    with log.open("w") as f:
+        for d in decisions:
+            f.write(json.dumps(
+                autoscale.AutoscaleController.log_record(d)
+            ) + "\n")
+    result = validate_fleet_log(log)
+    assert result["ok"] is True, result["problems"]
+    assert result["autoscale"] == len(decisions)
+
+
+# ---------------------------------------------------------------------------
+# drill: scheduler, record, validation
+
+
+def test_drill_scheduler_cadence_aggregation_and_counters():
+    clk = FakeClock()
+    starts: list[float] = []
+
+    def runner(i):
+        starts.append(clk.t)
+        clk.t += 2.0  # each round takes 2 s of "wall" time
+        return {
+            "ok": True, "failover_s": 0.5 + 0.1 * i,
+            "readmit_s": 1.0, "reseed_s": 0.2,
+        }
+
+    before = counter("drill/rounds")
+    rec = drill.DrillScheduler(
+        runner, rounds=3, interval_s=10.0, mode="smoke",
+        sleep=clk.sleep, clock=clk,
+    ).run()
+    assert counter("drill/rounds") == before + 3
+    # cadence between round STARTS: a 2 s round eats into its own gap
+    assert starts == [0.0, 10.0, 20.0]
+    assert rec["rounds"] == 3 and rec["cadence_s"] == 10.0
+    # aggregates hold the trajectory to the WORST round
+    assert rec["drill_failover_s"] == pytest.approx(0.7)
+    assert rec["drill_readmit_s"] == 1.0
+    assert rec["drill_rollback_s"] is None
+    assert rec["ok"] is True
+    assert [r["round"] for r in rec["per_round"]] == [0, 1, 2]
+    assert all(r["seconds"] == 2.0 for r in rec["per_round"])
+    assert drill.validate_drill_record(rec) == []
+
+
+def test_drill_scheduler_folds_round_failure_into_the_record():
+    before = counter("drill/failures")
+
+    def runner(i):
+        if i == 1:
+            raise AssertionError("standby never took over")
+        return {"ok": True, "failover_s": 0.4}
+
+    rec = drill.DrillScheduler(
+        runner, rounds=2, interval_s=0.0, sleep=lambda s: None
+    ).run()
+    assert counter("drill/failures") == before + 1
+    assert rec["ok"] is False
+    bad = rec["per_round"][1]
+    assert bad["ok"] is False
+    assert "standby never took over" in bad["error"]
+    # the failed record still validates structurally — the gate (not
+    # the schema) is what rejects it
+    assert drill.validate_drill_record(rec) == []
+
+
+def test_drill_record_ok_requires_measured_failover_under_bound():
+    ok_round = {"ok": True, "failover_s": 3.19}
+    slow_round = {"ok": True, "failover_s": 3.3}
+    mk = lambda rounds: drill.drill_record(  # noqa: E731
+        "smoke", 0.0, ("kill-router",), rounds
+    )
+    assert drill.DRILL_BOUND_S == 3.2
+    assert mk([ok_round])["ok"] is True
+    assert mk([slow_round])["ok"] is False
+    assert mk([{"ok": True}])["ok"] is False  # unmeasured is not ok
+    assert mk([])["ok"] is False
+
+
+def _drill_rec(failover_s: float, mode: str = "smoke") -> dict:
+    return drill.drill_record(mode, 0.0, ("kill-router",), [{
+        "ok": True, "failover_s": failover_s, "readmit_s": 1.0,
+        "reseed_s": 0.1, "round": 0, "seconds": 2.0,
+    }])
+
+
+def test_drill_trajectory_write_next_slot_and_load(tmp_path):
+    p1 = drill.write_drill_record(_drill_rec(0.5), tmp_path)
+    p2 = drill.write_drill_record(_drill_rec(0.6), tmp_path)
+    assert (p1.name, p2.name) == ("DRILL_r01.json", "DRILL_r02.json")
+    assert drill.validate_drill_file(p1)["ok"] is True
+    traj = bg.load_drill_trajectory(tmp_path)
+    assert [e["source"] for e in traj] == [
+        "DRILL_r01.json", "DRILL_r02.json",
+    ]
+    assert traj[0]["round"] == 1
+    assert traj[0]["record"]["drill_failover_s"] == 0.5
+
+
+def test_validate_drill_record_problem_cases():
+    assert drill.validate_drill_record("nope") == ["not a JSON object"]
+    rec = _drill_rec(0.5)
+    assert drill.validate_drill_record(rec) == []
+    probs = drill.validate_drill_record(dict(rec, mode="chaos"))
+    assert any("mode" in p for p in probs)
+    probs = drill.validate_drill_record(dict(rec, rounds=2))
+    assert any("per_round has 1" in p for p in probs)
+    probs = drill.validate_drill_record(dict(rec, per_round=[{}]))
+    assert any("missing ok" in p for p in probs)
+    probs = drill.validate_drill_record(dict(rec, drill_failover_s=None))
+    assert any("drill_failover_s" in p for p in probs)
+    probs = drill.validate_drill_record(dict(rec, scenarios=[]))
+    assert any("scenarios" in p for p in probs)
+
+
+def test_validate_drill_file_unreadable_and_not_json(tmp_path):
+    missing = drill.validate_drill_file(tmp_path / "DRILL_r09.json")
+    assert missing["ok"] is False
+    assert "unreadable" in missing["problems"][0]
+    p = tmp_path / "DRILL_r01.json"
+    p.write_text("{torn")
+    broken = drill.validate_drill_file(p)
+    assert broken["ok"] is False
+    assert "not JSON" in broken["problems"][0]
+
+
+# ---------------------------------------------------------------------------
+# the drill trajectory gate (obs/bench_gate.py)
+
+
+def test_drill_gate_bound_pinned_to_the_drill_module():
+    # bench_gate must stay importable without the fleet stack, so the
+    # bound is mirrored, not imported — this pin is the contract
+    assert bg.DRILL_FAILOVER_BOUND_S == drill.DRILL_BOUND_S == 3.2
+
+
+def test_drill_gate_pass_then_regression_vs_reference(tmp_path):
+    drill.write_drill_record(_drill_rec(0.5), tmp_path)
+    traj = bg.load_drill_trajectory(tmp_path)
+    ok = bg.gate_drill(_drill_rec(0.9), traj)
+    assert ok["verdict"] == "pass" and ok["failure_classes"] == []
+    ref_checks = [
+        c for c in ok["checks"] if c["ref_source"] == "DRILL_r01.json"
+    ]
+    assert any(c["metric"] == "drill_failover_s" for c in ref_checks)
+    # 0.9 vs 0.5 sits inside the ±100% tolerance; 1.5 (3x) does not
+    slow = bg.gate_drill(_drill_rec(1.5), traj)
+    assert slow["verdict"] == "fail"
+    assert slow["failure_classes"] == ["regression"]
+    failing = [c for c in slow["checks"] if not c["ok"]]
+    assert failing and failing[0]["metric"] == "drill_failover_s"
+
+
+def test_drill_gate_absolute_bound_fails_without_any_reference():
+    rec = _drill_rec(3.5)
+    assert rec["ok"] is False  # the recorder already refuses the bound
+    res = bg.gate_drill(rec, [])
+    assert res["verdict"] == "fail"
+    assert "error" in res["failure_classes"]
+    assert "regression" in res["failure_classes"]
+    bound = [
+        c for c in res["checks"]
+        if c["ref_source"] == "absolute_bound"
+    ]
+    assert bound and bound[0]["ok"] is False
+    assert bound[0]["direction"] == "bound"
+    assert bound[0]["reference"] == 3.2
+
+
+def test_drill_gate_invalid_record_is_an_error():
+    res = bg.gate_drill({"mode": "smoke"}, [])
+    assert res["verdict"] == "fail"
+    assert "error" in res["failure_classes"]
+    assert any(n.startswith("schema:") for n in res["notes"])
+
+
+def test_drill_gate_mode_mismatch_skips_reference(tmp_path):
+    # a smoke drill's in-process stub timings gated against a full
+    # drill's subprocess timings compare nothing
+    drill.write_drill_record(_drill_rec(0.5, mode="full"), tmp_path)
+    traj = bg.load_drill_trajectory(tmp_path)
+    res = bg.gate_drill(_drill_rec(2.0, mode="smoke"), traj)
+    assert res["verdict"] == "pass"
+    assert any(
+        "no healthy smoke-mode reference" in n for n in res["notes"]
+    )
+
+
+def test_drill_gate_failed_round_never_rebaselines(tmp_path):
+    drill.write_drill_record(_drill_rec(0.5), tmp_path)  # healthy
+    drill.write_drill_record(_drill_rec(3.5), tmp_path)  # over bound
+    traj = bg.load_drill_trajectory(tmp_path)
+    res = bg.gate_drill(_drill_rec(0.9), traj)
+    refs = [
+        c for c in res["checks"]
+        if c["metric"] == "drill_failover_s"
+        and c["ref_source"] != "absolute_bound"
+    ]
+    assert refs and refs[0]["ref_source"] == "DRILL_r01.json"
+    assert refs[0]["reference"] == 0.5
+
+
+def test_committed_drill_trajectory_gates_green():
+    """The repo's own DRILL_r* trajectory must load and the newest
+    round must pass its gate — `scripts/bench_gate.py --drill` runs the
+    same functions in CI."""
+    root = Path(__file__).resolve().parents[1]
+    traj = bg.load_drill_trajectory(root)
+    assert traj, "no committed DRILL_r*.json at the repo root"
+    newest = traj[-1]
+    assert newest["record"] is not None, newest
+    res = bg.gate_drill(
+        newest["record"], traj, exclude_source=newest["source"]
+    )
+    assert res["verdict"] == "pass", res
