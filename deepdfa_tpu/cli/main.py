@@ -9,7 +9,9 @@ Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
   test      evaluation with metrics report + optional profiling
   coverage  abstract-dataflow vocab coverage audit (--analyze_dataset)
   bench     the headline throughput benchmark
-  diag      render a run's telemetry (docs/observability.md)
+  diag      render a run's telemetry (docs/observability.md); --fleet
+            stitches a fleet's shipped trace segments into one timeline
+  alerts    replay a fleet log through the alert engine (docs/alerts.md)
   score     offline batch scoring through the serving path (docs/serving.md)
   serve     online HTTP scoring service (dynamic batcher + AOT executables)
   scan      whole-repo incremental scanning -> JSONL + SARIF findings
@@ -1780,9 +1782,46 @@ def cmd_diag(args) -> None:
         argv.append("--smoke")
     if getattr(args, "postmortem", None):
         argv += ["--postmortem", args.postmortem]
+    if getattr(args, "fleet", None):
+        argv += ["--fleet", args.fleet]
     rc = diag.main(argv)
     if rc:
         raise SystemExit(rc)
+
+
+def cmd_alerts(args) -> None:
+    """Replay a fleet log through the alert engine
+    (deepdfa_tpu/obs/alerts.py; docs/alerts.md): re-evaluate the
+    burn-rate / drift / fault rules over the recorded request stream at
+    record time — what WOULD have fired, when, and did it resolve."""
+    import json as _json
+
+    from deepdfa_tpu.obs.alerts import (
+        default_rules, replay_fleet_log, rule_from_doc,
+    )
+
+    rules = None
+    if args.rules:
+        docs = _json.loads(Path(args.rules).read_text())
+        if not isinstance(docs, list):
+            raise SystemExit(f"{args.rules}: expected a JSON list of rules")
+        rules = [rule_from_doc(d) for d in docs]
+    out = replay_fleet_log(args.fleet_log, rules=rules)
+    if args.json:
+        print(_json.dumps(out))
+        return
+    print(
+        f"replayed {out['records']} record(s): "
+        f"{len(out['transitions'])} transition(s), "
+        f"fired=[{' '.join(out['fired']) or '-'}] "
+        f"resolved=[{' '.join(out['resolved']) or '-'}]"
+    )
+    names = [r.name for r in (rules or default_rules())]
+    print("rules: " + " ".join(names))
+    still = out.get("firing") or []
+    if still:
+        print("STILL FIRING at end of log: " + " ".join(still))
+        raise SystemExit(1)
 
 
 def cmd_tune(args) -> None:
@@ -2635,7 +2674,25 @@ def main(argv=None) -> None:
                    help="render one postmortem.json (crash flight "
                         "recorder dump, docs/efficiency.md) instead of "
                         "a run dir")
+    p.add_argument("--fleet", default=None, metavar="FLEET_DIR",
+                   help="fleet-wide mode: stitch shipped trace segments "
+                        "into one Perfetto timeline, summarize metrics "
+                        "snapshots + alert records (docs/alerts.md)")
     p.set_defaults(fn=cmd_diag)
+
+    p = sub.add_parser(
+        "alerts",
+        help="replay a fleet log through the alert engine: what would "
+        "have fired, when, and did it resolve (docs/alerts.md)",
+    )
+    p.add_argument("fleet_log",
+                   help="path to a fleet_log.jsonl to replay")
+    p.add_argument("--rules", default=None, metavar="PATH",
+                   help="JSON list of rule docs to use instead of the "
+                        "default catalog (docs/alerts.md)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable replay summary")
+    p.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser(
         "score",

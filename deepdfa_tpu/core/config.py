@@ -634,6 +634,22 @@ class FleetConfig:
     autoscale_cooldown_s: float = 10.0
     autoscale_min_replicas: int = 1
     autoscale_max_replicas: int = 4
+    # -- fleet telemetry plane (obs/aggregate.py; default OFF so the
+    # default fleet path stays byte-identical)
+    # replicas publish schema-validated metrics snapshots (+ trace
+    # segments when tracing is on) through the coord backend, and the
+    # router aggregates them into fleet-level /metrics and /stats
+    telemetry: bool = False
+    # snapshot publication cadence per replica
+    telemetry_interval_s: float = 2.0
+    # -- alert engine (obs/alerts.py; default OFF)
+    # the router evaluates the alert rule catalog on a cadence and
+    # appends every pending/firing/resolved transition to the fleet_log
+    alerts: bool = False
+    alert_interval_s: float = 1.0
+    # JSON list overlaying the default rule catalog (replace by name,
+    # {"disable": true} to remove, new names append) — docs/alerts.md
+    alert_rules: str = ""
 
 
 @dataclass(frozen=True)

@@ -174,10 +174,77 @@ def run_smoke_drill(tmp: str | Path, parts=None) -> dict:
         epoch0 = fleet_ha.read_rendezvous(fleet_dir, backend=backend)[
             "epoch"
         ]
+
+        # the alert engine rides along (obs/alerts.py): a fast
+        # router_failover burn-rate rule watches the front door during
+        # the kill window, so the drill measures DETECTION time (MTTD)
+        # next to recovery time, and the transitions land in the shared
+        # fleet_log as schema-valid {"alert": ...} records
+        from deepdfa_tpu.fleet.router import FleetLog
+        from deepdfa_tpu.obs import alerts as obs_alerts
+
+        alert_log = FleetLog(log_path, backend=backend)
+        engine = obs_alerts.AlertEngine(
+            [obs_alerts.AlertRule(
+                name="router_failover", kind="burn_rate",
+                threshold=1.0, for_s=0.0, windows=(0.4, 1.2),
+                params={"budget": 0.05, "min_count": 1},
+            )],
+            sink=alert_log.append,
+        )
+
+        def probe_front_door() -> bool:
+            addr = fleet_ha.resolve_router(fleet_dir, backend=backend)
+            if addr is None:
+                return False
+            try:
+                status, _ = fleet_chaos.http_json(
+                    *addr, "GET", "/healthz", timeout=0.25
+                )
+            except Exception:
+                # a dying front door shows up as several error classes
+                # (refused, timeout, a torn mid-response close) — all of
+                # them are the same alert-worthy fact
+                return False
+            return status == 200
+
+        def feed(ok: bool) -> None:
+            engine.observe_request(200 if ok else 503)
+            for rec in engine.evaluate({}):
+                state = rec["alert"]["state"]
+                if state == "firing" and out.get("alert_mttd_s") is None:
+                    out["alert_mttd_s"] = round(time.monotonic() - t0, 3)
+                    out["alert_fired"] = True
+                elif state == "resolved":
+                    out["alert_resolved"] = True
+
+        out["alert_mttd_s"] = None
         t0 = time.monotonic()
         active.kill()
-        assert standby.wait_active(timeout_s=30.0), "no takeover"
+        took_over = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if standby.wait_active(timeout_s=0.02):
+                took_over = True
+                break
+            feed(probe_front_door())
+        assert took_over, "no takeover"
         out["failover_s"] = round(time.monotonic() - t0, 3)
+        # keep probing the (now healthy) front door until the error
+        # windows drain and the alert resolves
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not out.get(
+            "alert_resolved"
+        ):
+            feed(probe_front_door())
+            time.sleep(0.05)
+        alert_log.close()
+        assert out.get("alert_fired"), (
+            "router_failover alert never fired during the kill window"
+        )
+        assert out.get("alert_resolved"), (
+            "router_failover alert never resolved after takeover"
+        )
         rv = fleet_ha.read_rendezvous(fleet_dir, backend=backend)
         assert rv["router_id"] == "rb" and rv["epoch"] > epoch0, rv
         out["epoch"] = rv["epoch"]
@@ -374,6 +441,7 @@ def drill_record(
         "drill_reseed_s": _worst("reseed_s"),
         "drill_readmit_s": _worst("readmit_s"),
         "drill_rollback_s": _worst("rollback_s"),
+        "drill_alert_mttd_s": _worst("alert_mttd_s"),
         "drill_bound_s": DRILL_BOUND_S,
         "per_round": per_round,
         "ok": ok,
@@ -426,7 +494,10 @@ def validate_drill_record(doc) -> list[str]:
         problems.append("scenarios missing or not a list of names")
     if not isinstance(doc.get("drill_failover_s"), (int, float)):
         problems.append("drill_failover_s missing or not numeric")
-    for key in ("drill_reseed_s", "drill_readmit_s", "drill_rollback_s"):
+    for key in (
+        "drill_reseed_s", "drill_readmit_s", "drill_rollback_s",
+        "drill_alert_mttd_s",
+    ):
         if key in doc and doc[key] is not None and not isinstance(
             doc[key], (int, float)
         ):

@@ -206,6 +206,11 @@ class ReplicaWorker:
         #: one swap at a time: a rollout controller retrying into a
         #: replica mid-swap must queue, not interleave drains
         self._swap_lock = threading.Lock()
+        #: fleet telemetry plane (obs/aggregate.py), wired in start()
+        #: when fleet.telemetry is on — None keeps the default path
+        #: byte-identical
+        self.telemetry_publisher = None
+        self.trace_shipper = None
 
     # -- construction --------------------------------------------------------
 
@@ -609,6 +614,43 @@ class ReplicaWorker:
         )
         self._http_thread.start()
         self.set_state(heartbeat.READY)
+        if self.cfg.fleet.telemetry:
+            # publish this replica's metrics snapshots (and ship its
+            # trace segments when tracing is on) through the coord
+            # backend so the router's fleet /metrics and `diag --fleet`
+            # see it without reading this process's disk. Lazy import:
+            # the default (telemetry off) path never loads the plane.
+            from deepdfa_tpu.fleet import coord
+            from deepdfa_tpu.obs import (
+                aggregate as obs_agg, trace as obs_trace,
+            )
+
+            backend = coord.backend_from_config(self.cfg)
+            self.telemetry_publisher = obs_agg.SnapshotPublisher(
+                self.fleet_dir, self.replica_id,
+                slo_engines=lambda: {
+                    name: svc.slo
+                    for name, svc in self.services.items()
+                },
+                backend=backend,
+                interval_s=self.cfg.fleet.telemetry_interval_s,
+            )
+            if obs_trace.enabled():
+                self.trace_shipper = obs_agg.TraceShipper(
+                    self.fleet_dir, self.replica_id, backend=backend,
+                    interval_s=self.cfg.fleet.telemetry_interval_s,
+                )
+
+    def _tick_telemetry(self) -> None:
+        """Cadenced snapshot publication + trace shipping from the main
+        loop — telemetry failures log and count, never kill serving."""
+        try:
+            if self.telemetry_publisher is not None:
+                self.telemetry_publisher.maybe_publish()
+            if self.trace_shipper is not None:
+                self.trace_shipper.maybe_ship()
+        except Exception:
+            logger.exception("telemetry tick failed")
 
     def drain(self, trigger: str = "sigterm") -> None:
         """The graceful exit: announce, stop accepting, finish in-flight
@@ -641,6 +683,16 @@ class ReplicaWorker:
             "drain": True,
             "slo": final_slo,
         })
+        # the last snapshot + trace segment make it off-host before the
+        # process goes away — a drained replica's final SLO windows stay
+        # visible to the fleet scrape until they age into staleness
+        try:
+            if self.telemetry_publisher is not None:
+                self.telemetry_publisher.publish()
+            if self.trace_shipper is not None:
+                self.trace_shipper.close()
+        except Exception:
+            logger.exception("final telemetry publish failed")
         for svc in self.services.values():
             svc.close()
         self.set_state("drained")
@@ -673,6 +725,7 @@ class ReplicaWorker:
                 if now >= next_beat:
                     self.write_heartbeat()
                     next_beat = now + interval
+                self._tick_telemetry()
                 # short sleeps so a drain signal is observed promptly
                 time.sleep(min(0.1, interval))
             self.drain()

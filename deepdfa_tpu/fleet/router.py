@@ -74,6 +74,12 @@ AUTOSCALE_ACTIONS = (
     "scale_down", "relax",
 )
 
+#: nominal in-flight forwards one routable replica absorbs before the
+#: router's queue_ratio alert signal reads saturated (replicas don't
+#: advertise a queue bound in their heartbeat, so the saturation gauge
+#: is outstanding / (routable * this))
+REPLICA_INFLIGHT_BUDGET = 8
+
 #: transport-level failures that mean "the replica, not the request"
 TRANSPORT_ERRORS = (
     ConnectionError,
@@ -219,6 +225,16 @@ class Router:
         #: faulted forward/probe raises ConnectionError exactly where a
         #: dropped network path would
         self.transport_fault = None
+        #: fleet telemetry plane (obs/aggregate.py) — wired on by
+        #: router_from_config when fleet.telemetry is set; None keeps
+        #: the default path byte-identical
+        self.aggregator = None
+        self.publisher = None
+        self.trace_shipper = None
+        #: alert engine (obs/alerts.py) — wired when fleet.alerts is on
+        self.alerts = None
+        self.alert_interval_s = 1.0
+        self._last_alert = 0.0
         self._last_summary = time.monotonic()
         self._last_poll = 0.0
         self._closed = threading.Event()
@@ -372,6 +388,8 @@ class Router:
                 self.poll(force=True)
                 self.probe_ejected()
                 self._maybe_summarize()
+                self._maybe_telemetry()
+                self._maybe_alert()
             except Exception:
                 logger.exception("fleet poll failed")
 
@@ -388,6 +406,57 @@ class Router:
             return
         self._last_summary = now
         self.log.append(self.summary_record())
+
+    def _maybe_telemetry(self) -> None:
+        """Telemetry-plane housekeeping on the poll cadence: publish the
+        router's OWN snapshot (so the fleet scrape includes the front
+        door) and ship its trace segments when tracing is on."""
+        if self.publisher is not None:
+            self.publisher.maybe_publish()
+        if self.trace_shipper is not None:
+            self.trace_shipper.maybe_ship()
+
+    def _alert_signals(self) -> dict:
+        """The snapshot-level signals the alert engine evaluates against
+        (request-level signals flow in via log_request)."""
+        counters = obs_metrics.REGISTRY.snapshot()
+        now = time.time()
+        with self._lock:
+            routable = sum(
+                1 for r in self._replicas.values()
+                if r.routable(self.heartbeat_timeout_s, now)
+            )
+            outstanding = sum(
+                r.outstanding for r in self._replicas.values()
+            )
+        # replicas don't advertise a queue bound in their heartbeat, so
+        # saturation is outstanding forwards per routable replica
+        # against a nominal in-flight budget — the same shape the
+        # serve_queue_saturated starter rule watches
+        capacity = routable * REPLICA_INFLIGHT_BUDGET
+        gauges = {
+            "replicas_routable": float(routable),
+            "queue_ratio": (
+                outstanding / capacity if capacity else 0.0
+            ),
+        }
+        return {
+            "slo": self.slo.snapshot(),
+            "counters": counters,
+            "gauges": gauges,
+        }
+
+    def _maybe_alert(self) -> None:
+        """Evaluate the alert rule catalog on its own cadence; every
+        transition lands in the fleet_log as an {"alert": ...} record
+        (the engine's sink is wired to self.log at construction)."""
+        if self.alerts is None:
+            return
+        now = time.monotonic()
+        if (now - self._last_alert) < self.alert_interval_s:
+            return
+        self._last_alert = now
+        self.alerts.evaluate(self._alert_signals())
 
     def _maybe_inject_fault(self, replica_id: str) -> None:
         """The injectable transport fault (the `partition` chaos
@@ -528,14 +597,20 @@ class Router:
         retries: int = 0,
         deadline_ms: float | None = None,
         shed_reason: str | None = None,
+        prob: float | None = None,
     ) -> None:
         """The router's per-request epilogue: SLO ingest + one
         {"request": {...}} fleet_log line (admitted AND shed — the shed
-        population is exactly the one overload analysis needs)."""
+        population is exactly the one overload analysis needs). `prob`
+        is the replica's calibrated score, present only when the alert
+        engine is on — it feeds the per-tenant drift watch live and is
+        echoed into the log so `deepdfa-tpu alerts` can replay it."""
         self._m_requests.inc()
         self.slo.observe_request(status, latency_s)
         if status == 200:
             self.admission.observe_service(latency_s)
+        if self.alerts is not None:
+            self.alerts.observe_request(status, tenant=tenant, prob=prob)
         if self.log is None:
             return
         entry: dict = {
@@ -552,6 +627,8 @@ class Router:
             entry["deadline_ms"] = float(deadline_ms)
         if shed_reason is not None:
             entry["reason"] = shed_reason
+        if prob is not None:
+            entry["prob"] = round(float(prob), 6)
         self.log.append({"request": entry})
 
     def topology(self, now: float | None = None) -> dict:
@@ -627,6 +704,12 @@ class Router:
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=5)
             self._poll_thread = None
+        if self.trace_shipper is not None:
+            try:
+                self.trace_shipper.close()
+            except Exception:
+                logger.exception("trace shipper close failed")
+            self.trace_shipper = None
         if self.log is not None:
             self.log.append(self.summary_record())
             self.log.close()
@@ -696,6 +779,38 @@ def router_from_config(
     )
     if reseed and log_path is not None:
         router.reseed_from_log(log_path)
+    if fcfg.telemetry:
+        # the fleet telemetry plane (obs/aggregate.py): aggregate the
+        # replicas' published snapshots for /metrics + /stats, publish
+        # the router's OWN snapshot, and ship its trace segments when
+        # tracing is on — all rides the same coord backend. Imported
+        # lazily so the default (telemetry off) path never loads it.
+        from deepdfa_tpu.obs import aggregate as obs_agg
+
+        router.aggregator = obs_agg.FleetAggregator(
+            fleet_dir, backend=backend,
+            stale_after_s=fcfg.heartbeat_timeout_s,
+        )
+        router.publisher = obs_agg.SnapshotPublisher(
+            fleet_dir, "router",
+            slo_engines=lambda: {"router": router.slo},
+            backend=backend,
+            interval_s=fcfg.telemetry_interval_s,
+        )
+        if obs_trace.enabled():
+            router.trace_shipper = obs_agg.TraceShipper(
+                fleet_dir, "router", backend=backend,
+                interval_s=fcfg.telemetry_interval_s,
+            )
+    if fcfg.alerts:
+        from deepdfa_tpu.obs import alerts as obs_alerts
+
+        router.alert_interval_s = float(fcfg.alert_interval_s)
+        engine = obs_alerts.AlertEngine(
+            obs_alerts.rules_from_config(cfg),
+            sink=(router.log.append if router.log is not None else None),
+        )
+        router.alerts = engine
     return router
 
 
@@ -735,9 +850,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 k[len("fleet/"):]: v
                 for k, v in snap.items() if k.startswith("fleet/")
             }
+            if self.router.aggregator is not None:
+                out["fleet_telemetry"] = (
+                    self.router.aggregator.stats_section()
+                )
+            if self.router.alerts is not None:
+                out["alerts"] = self.router.alerts.snapshot()
             self._reply(200, out)
         elif url.path == "/metrics":
             text = registry_exposition() + self.router.slo.exposition()
+            if self.router.aggregator is not None:
+                # the fleet half: per-replica families labeled
+                # replica="<id>" plus the exactly-merged replica="fleet"
+                # series from the published snapshots
+                text += self.router.aggregator.exposition()
             self._reply_raw(
                 200, text.encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
@@ -826,10 +952,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
             )
             self._reply(503, {"error": str(e), "request_id": rid})
             return
+        prob = None
+        if router.alerts is not None and status == 200:
+            # the drift watch needs the replica's calibrated score; the
+            # parse is gated on the engine so the default path never
+            # decodes response bodies it would otherwise just relay
+            try:
+                scored = json.loads(data)
+                if isinstance(scored, dict):
+                    p = scored.get("calibrated_prob", scored.get("prob"))
+                    if isinstance(p, (int, float)):
+                        prob = float(p)
+            except (ValueError, UnicodeDecodeError):
+                pass
         router.log_request(
             rid, status, time.monotonic() - t0,
             tenant=decision.tenant, priority=decision.priority,
             replica=replica, retries=retries, deadline_ms=deadline_ms,
+            prob=prob,
         )
         self._reply_raw(status, data)
 
@@ -914,6 +1054,7 @@ def validate_fleet_log(path: str | Path) -> dict:
     except OSError as e:
         return {"ok": False, "problems": [f"unreadable: {e}"]}
     n_requests = n_events = n_summaries = n_rollouts = n_autoscale = 0
+    n_alerts = 0
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -979,6 +1120,12 @@ def validate_fleet_log(path: str | Path) -> dict:
                 problems.append(
                     f"line {lineno}: autoscale record missing t_unix"
                 )
+        elif "alert" in rec:
+            n_alerts += 1
+            from deepdfa_tpu.obs.alerts import validate_alert_record
+
+            for p in validate_alert_record(rec):
+                problems.append(f"line {lineno}: {p}")
         elif "fleet" in rec or "fleet_slo" in rec:
             n_summaries += 1
         else:
@@ -997,6 +1144,7 @@ def validate_fleet_log(path: str | Path) -> dict:
         "summaries": n_summaries,
         "rollouts": n_rollouts,
         "autoscale": n_autoscale,
+        "alerts": n_alerts,
         "undeclared": undeclared,
         "problems": problems,
     }

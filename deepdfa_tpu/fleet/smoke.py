@@ -466,7 +466,283 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
         report["autoscale"] = autoscale_mod.run_smoke_autoscale(
             td, parts=stub_parts
         )
+
+    # -- phase 8: the fleet telemetry plane (obs/aggregate.py +
+    # obs/alerts.py) end-to-end on a FaultableBackend: exact merged
+    # percentiles through a live /metrics, cross-host trace stitching
+    # with an unbroken flow chain under a torn write, and burn-rate +
+    # per-tenant drift alerts firing and resolving as schema-valid
+    # fleet_log records
+    with tempfile.TemporaryDirectory() as td:
+        report["telemetry"] = run_telemetry_smoke(td)
     return report
+
+
+def run_telemetry_smoke(tmp: str | Path) -> dict:
+    """The telemetry smoke phase (also runnable standalone from the
+    tests): two simulated replicas publish snapshots through a
+    FaultableBackend (one slot write torn), a REAL router with
+    `fleet.telemetry`/`fleet.alerts` on serves the aggregated /metrics,
+    and the asserted facts are the ISSUE's acceptance criteria — the
+    merged p99 EQUALS the brute-force percentile over the union of the
+    replicas' (grid-quantized) samples, the stitched trace carries the
+    router->replica flow chain unbroken past a torn segment line, and a
+    burn-rate + a per-tenant drift alert fire and resolve as
+    schema-valid {"alert": ...} fleet_log records."""
+    import random
+
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.fleet import coord
+    from deepdfa_tpu.fleet.router import (
+        BackgroundRouter, router_from_config, validate_fleet_log,
+    )
+    from deepdfa_tpu.obs import (
+        aggregate as obs_agg, alerts as obs_alerts,
+        metrics as obs_metrics, trace as obs_trace,
+    )
+    from deepdfa_tpu.obs.slo import (
+        SloEngine, parse_exposition, percentile,
+    )
+
+    out: dict = {}
+    tmp = Path(tmp)
+    fleet_dir = tmp / "fleet"
+    fleet_dir.mkdir(parents=True, exist_ok=True)
+    log_path = fleet_dir / "fleet_log.jsonl"
+    backend = coord.FaultableBackend()
+    rng = random.Random(19)
+
+    # two simulated replicas with real SLO engines behind publishers
+    engines: dict[str, SloEngine] = {}
+    pubs: dict[str, object] = {}
+    for rid in ("r0", "r1"):
+        eng = SloEngine(windows=(60.0,))
+        for _ in range(150):
+            eng.observe_request(200, rng.lognormvariate(-3.0, 1.0))
+        engines[rid] = eng
+        pubs[rid] = obs_agg.SnapshotPublisher(
+            fleet_dir, rid,
+            slo_engines=lambda eng=eng: {"primary": eng},
+            backend=backend,
+        )
+        pubs[rid].publish()
+
+    # torn-write fault on r0's NEXT snapshot write: the two-slot scheme
+    # must keep r0 visible (from the surviving slot), flagged not lost
+    backend.set_fault("metrics-r0-*.json", torn_writes=1)
+    for _ in range(10):
+        engines["r0"].observe_request(
+            200, rng.lognormvariate(-3.0, 1.0)
+        )
+    pubs["r0"].publish()  # lands torn
+    probe = obs_agg.FleetAggregator(
+        fleet_dir, backend=backend, stale_after_s=3600.0
+    )
+    col = probe.collect()
+    out["torn_slot_survived"] = (
+        "r0" in col["replicas"]
+        and bool(col["problems"])
+        and col["replicas"]["r0"]["snapshot"]["seq"] == 0
+    )
+    # heal (the torn fault is consumed): final clean snapshots
+    for rid in ("r0", "r1"):
+        pubs[rid].publish()
+
+    # the live router, telemetry + alerts on, same faultable backend
+    cfg = config_mod.apply_overrides(Config(), [
+        "fleet.telemetry=true",
+        "fleet.alerts=true",
+        "fleet.telemetry_interval_s=0.2",
+        "fleet.alert_interval_s=0.05",
+        "fleet.heartbeat_timeout_s=3600.0",
+        "fleet.poll_interval_s=0.05",
+        "serve.slo_windows=[60]",
+    ])
+    router = router_from_config(
+        cfg, fleet_dir, log_path=log_path, backend=backend
+    )
+    # the smoke's alert rules: fast burn-rate windows + a per-tenant
+    # drift watch, swapped in over the default catalog so firing and
+    # resolution both land inside the phase budget
+    router.alerts = obs_alerts.AlertEngine(
+        [
+            obs_alerts.AlertRule(
+                name="serve_high_error_rate", kind="burn_rate",
+                threshold=1.0, for_s=0.0, windows=(0.5, 1.5),
+                params={"budget": 0.05, "min_count": 3},
+            ),
+            obs_alerts.AlertRule(
+                name="acme_drift", kind="drift",
+                threshold=0.2, for_s=0.0, windows=(20.0,),
+                params={
+                    "tenant": "acme", "temperature": 1.0,
+                    "band": (0.4, 0.6), "target": 0.1,
+                    "min_samples": 10,
+                },
+            ),
+        ],
+        sink=router.log.append,
+    )
+    server = BackgroundRouter(router)
+    try:
+        # -- exact merged percentiles through the live scrape
+        status, text = server.request_text("GET", "/metrics")
+        assert status == 200, status
+        fams = parse_exposition(text)
+        lat = fams.get("deepdfa_fleet_agg_latency_ms") or {"samples": []}
+        got = [
+            v for labels, v in lat["samples"]
+            if 'replica="fleet"' in labels
+            and 'stage="total"' in labels
+            and 'quantile="0.99"' in labels
+        ]
+        union: list[float] = []
+        for eng in engines.values():
+            h = obs_agg.FixedBucketHistogram()
+            h.observe_all(eng.latency_samples()["60s"]["total"])
+            union.extend(h.expand())
+        want = percentile(sorted(union), 0.99) * 1e3
+        out["merged_p99_ms"] = got[0] if got else None
+        out["merged_p99_exact"] = got == [want]
+        out["fleet_scrape"] = obs_agg.validate_fleet_scrape(text)
+        status, stats = server.request("GET", "/stats")
+        tele = stats.get("fleet_telemetry") or {}
+        out["stats_fleet_section"] = {"r0", "r1"} <= set(
+            tele.get("replicas") or {}
+        )
+
+        # -- cross-host trace stitching under a torn segment write
+        tr_router = obs_trace.Tracer(
+            tmp / "tr_router", process_name="router"
+        )
+        tr_replica = obs_trace.Tracer(
+            tmp / "tr_replica", process_name="replica-r0"
+        )
+        flow_id = "req-telemetry-1"
+        t_us = obs_trace.Tracer.now_us()
+        tr_router.emit({
+            "name": "router_forward", "cat": "fleet", "ph": "X",
+            "ts": t_us, "dur": 500.0,
+            "args": {"request_id": flow_id},
+        })
+        tr_router.emit({
+            "name": "request", "cat": "fleet", "ph": "s",
+            "id": flow_id, "ts": t_us + 10.0,
+        })
+        ship_router = obs_agg.TraceShipper(
+            fleet_dir, "router", backend=backend, tracer=tr_router
+        )
+        ship_replica = obs_agg.TraceShipper(
+            fleet_dir, "r0-trace", backend=backend, tracer=tr_replica
+        )
+        t2 = obs_trace.Tracer.now_us()
+        tr_replica.emit({
+            "name": "request", "cat": "fleet", "ph": "t",
+            "id": flow_id, "ts": t2,
+        })
+        ship_router.ship()
+        ship_replica.ship()  # anchor + the flow arrival, clean
+        # the torn fault hits the replica's NEXT shipped line (the
+        # pack span), never the anchor — the stitch must drop exactly
+        # that line and keep the chain
+        snap0 = obs_metrics.REGISTRY.snapshot()
+        backend.set_fault("trace-seg-r0-trace.jsonl", torn_writes=1)
+        for i, name in enumerate(("pack", "dispatch", "fetch")):
+            tr_replica.emit({
+                "name": name, "cat": "serve", "ph": "X",
+                "ts": t2 + 10.0 * (i + 1), "dur": 8.0,
+            })
+        tr_replica.emit({
+            "name": "request", "cat": "fleet", "ph": "f",
+            "id": flow_id, "ts": t2 + 50.0,
+        })
+        ship_replica.ship()
+        stitch = obs_agg.stitch_fleet_trace(
+            fleet_dir, tmp / "fleet_trace.json", backend=backend
+        )
+        snap1 = obs_metrics.REGISTRY.snapshot()
+        out["trace"] = {
+            "unbroken_flow": flow_id in stitch["unbroken_flows"],
+            "events": stitch["events"],
+            "sources": sorted(stitch["sources"]),
+            "torn_write_injected": (
+                snap1.get("coord/faults/torn_write", 0)
+                > snap0.get("coord/faults/torn_write", 0)
+            ),
+        }
+
+        # -- alerts: error burst + calibrated-prob drift through the
+        # router's own request epilogue; the poll loop evaluates and
+        # sinks transitions into the fleet_log
+        for i in range(40):
+            router.log_request(
+                f"ok-{i}", 200, 0.01, tenant="acme", priority=0,
+                prob=0.9,
+            )
+        for i in range(40):
+            router.log_request(
+                f"err-{i}", 500, 0.01, tenant="acme", priority=0,
+                prob=0.5,
+            )
+        fired = coord.poll_until(
+            lambda: (
+                {"serve_high_error_rate", "acme_drift"}
+                <= set(router.alerts.firing())
+            ) or None,
+            10.0, interval_s=0.05, what="smoke alerts firing",
+        )
+        # recovery traffic until both resolve (the burn windows drain
+        # in <= 1.5 s of clean traffic)
+        def _resolved():
+            for i in range(10):
+                router.log_request(
+                    f"heal-{i}", 200, 0.01, tenant="acme",
+                    priority=0, prob=0.9,
+                )
+            return (not router.alerts.firing()) or None
+
+        resolved = coord.poll_until(
+            _resolved, 15.0, interval_s=0.1,
+            what="smoke alerts resolving",
+        )
+        out["alerts"] = {
+            "fired": bool(fired),
+            "resolved": bool(resolved),
+        }
+    finally:
+        server.close()
+
+    log_report = validate_fleet_log(log_path)
+    states: dict[str, set] = {}
+    for rec in backend.tail_records(log_path, 16 << 20):
+        if "alert" in rec:
+            states.setdefault(rec["alert"]["rule"], set()).add(
+                rec["alert"]["state"]
+            )
+    out["alerts"]["burn_fired_resolved"] = {
+        "firing", "resolved"
+    } <= states.get("serve_high_error_rate", set())
+    out["alerts"]["drift_fired_resolved"] = {
+        "firing", "resolved"
+    } <= states.get("acme_drift", set())
+    out["alerts"]["records_valid"] = log_report["ok"]
+    out["fleet_log"] = {
+        "ok": log_report["ok"],
+        "alerts": log_report["alerts"],
+        "problems": log_report["problems"][:5],
+    }
+    out["ok"] = bool(
+        out["torn_slot_survived"]
+        and out["merged_p99_exact"]
+        and out["fleet_scrape"]["ok"]
+        and out["stats_fleet_section"]
+        and out["trace"]["unbroken_flow"]
+        and out["trace"]["torn_write_injected"]
+        and out["alerts"]["burn_fired_resolved"]
+        and out["alerts"]["drift_fired_resolved"]
+        and out["alerts"]["records_valid"]
+    )
+    return out
 
 
 def smoke_verdict(report: dict) -> list[str]:
@@ -534,4 +810,23 @@ def smoke_verdict(report: dict) -> list[str]:
         (az.get("fleet_log") or {}).get("ok") and az.get("ramp_log_ok")
     ):
         bad.append("autoscale decision records failed validation")
+    tm = report.get("telemetry") or {}
+    if not tm.get("merged_p99_exact"):
+        bad.append(
+            "federated p99 != brute-force percentile over the union of "
+            "replica samples (histogram merge must be exact)"
+        )
+    if not tm.get("torn_slot_survived"):
+        bad.append("aggregator dropped a replica on a torn snapshot write")
+    if not (tm.get("fleet_scrape") or {}).get("ok"):
+        bad.append("fleet /metrics scrape failed schema validation")
+    if not (tm.get("trace") or {}).get("unbroken_flow"):
+        bad.append(
+            "cross-host request flow chain broke in the stitched trace"
+        )
+    al = tm.get("alerts") or {}
+    if not (al.get("burn_fired_resolved") and al.get("drift_fired_resolved")):
+        bad.append("burn-rate or drift alert did not fire and resolve")
+    if not al.get("records_valid"):
+        bad.append("an alert record failed schema validation")
     return bad

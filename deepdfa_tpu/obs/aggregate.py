@@ -1,0 +1,1024 @@
+"""Fleet-wide metrics federation + cross-host trace stitching
+(docs/observability.md, docs/fleet.md).
+
+Every observability surface before this one was per-process: the SLO
+engine answers for ONE router or replica, the trace merge reads ONE
+shared directory, diag rebuilds percentiles from locally-readable
+serve logs. This module makes the fleet observable when the processes
+never share a disk, by carrying everything over the coordination
+backend (fleet/coord.py):
+
+  metrics federation   each replica periodically publishes a schema-
+                       validated snapshot (SnapshotPublisher) as a coord
+                       doc: registry counters/gauges, SLO window views,
+                       and the windowed latency SAMPLES re-encoded as
+                       fixed-bucket mergeable histograms. The router's
+                       FleetAggregator collects the snapshots and serves
+                       a fleet-level /metrics with `replica=` labels
+                       plus merged families.
+  exact merge          all histograms share ONE fixed log-spaced bucket
+                       grid, so merging is count addition and the merged
+                       percentile EQUALS the percentile of the union of
+                       the published sample multisets — zero merge error,
+                       unlike averaging per-replica percentiles (which
+                       has no defensible semantics) or sketches (which
+                       approximate). Bucket resolution (~3.1% relative)
+                       is the only quantization, applied once at encode.
+  staleness            a replica whose newest snapshot ages past the
+                       heartbeat window is MARKED stale (its own gauge +
+                       the stats section) and still merged — never
+                       silently dropped, so an operator sees "r1 went
+                       quiet" instead of a fleet p99 that silently lost
+                       a replica.
+  torn-write safety    snapshots alternate between two doc slots by
+                       sequence parity; a torn write (FaultableBackend,
+                       or a real crash mid-write) corrupts at most one
+                       slot and the reader falls back to the other —
+                       plus an in-process cache of the last good
+                       snapshot per source, which also rides out
+                       backend partitions (aging into staleness rather
+                       than vanishing).
+  trace stitching      TraceShipper appends this process's Chrome-trace
+                       events (plus one wall-clock anchor) to a bounded
+                       coord log; stitch_fleet_trace folds every
+                       source's segments into one Perfetto timeline —
+                       pids remapped per source so same-pid processes on
+                       different hosts cannot collide, timestamps
+                       shifted onto the shared wall clock via each
+                       source's anchor, torn lines skipped per the tail
+                       contract. The X-Request-Id flow chain
+                       (router_forward "s" -> replica "t"/"f",
+                       docs/slo.md) survives the hop because flow events
+                       are keyed by request id, not by pid or clock.
+
+Everything defaults OFF (`fleet.telemetry`); the default fleet path
+never constructs a publisher or aggregator.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from pathlib import Path
+
+from deepdfa_tpu.fleet import coord
+from deepdfa_tpu.obs import metrics as obs_metrics, trace as obs_trace
+from deepdfa_tpu.obs.slo import QUANTILES, percentile
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# fixed-bucket mergeable histogram
+
+#: the ONE latency grid every publisher and the aggregator share —
+#: log-spaced from 0.1 ms to 600 s. 512 buckets give ~3.1% relative
+#: resolution (exp(ln(6e6)/512) - 1), applied once at encode time;
+#: merging is exact by construction because the grid is fixed.
+HIST_LO = 1e-4
+HIST_HI = 600.0
+HIST_BUCKETS = 512
+
+_EDGES_CACHE: dict[tuple[float, float, int], tuple[float, ...]] = {}
+
+
+def bucket_edges(lo: float, hi: float, n: int) -> tuple[float, ...]:
+    """Deterministic log-spaced lower edges for an (lo, hi, n) grid —
+    recomputed identically on every host, so a snapshot doc only needs
+    to carry the three grid parameters, never the edges."""
+    key = (float(lo), float(hi), int(n))
+    edges = _EDGES_CACHE.get(key)
+    if edges is None:
+        llo, lhi = math.log(key[0]), math.log(key[1])
+        step = (lhi - llo) / key[2]
+        edges = tuple(math.exp(llo + step * i) for i in range(key[2]))
+        _EDGES_CACHE[key] = edges
+    return edges
+
+
+class FixedBucketHistogram:
+    """Mergeable latency histogram on the shared fixed grid.
+
+    `observe` quantizes a value to its bucket's lower edge; `merged`
+    adds counts bucket-by-bucket (grids must match — mismatches raise,
+    they are a deploy-skew bug, not data). `percentile` applies THE
+    repo-wide quantile rule (obs/slo.py:percentile) to the cumulative
+    counts, so it equals `percentile(sorted(expanded samples), p)`
+    exactly — the property tests/test_fleet_obs.py pins against brute
+    force."""
+
+    __slots__ = ("lo", "hi", "n", "_llo", "_step", "counts")
+
+    def __init__(
+        self,
+        lo: float = HIST_LO,
+        hi: float = HIST_HI,
+        n: int = HIST_BUCKETS,
+    ):
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.n = int(n)
+        if not (self.lo > 0 and self.hi > self.lo and self.n > 0):
+            raise ValueError(
+                f"bad histogram grid lo={lo} hi={hi} n={n}"
+            )
+        self._llo = math.log(self.lo)
+        self._step = (math.log(self.hi) - self._llo) / self.n
+        #: sparse {bucket index: count} — snapshots stay small even on
+        #: a 512-bucket grid because a window only touches a few dozen
+        self.counts: dict[int, int] = {}
+
+    def grid(self) -> tuple[float, float, int]:
+        return (self.lo, self.hi, self.n)
+
+    def bucket_index(self, value: float) -> int:
+        v = float(value)
+        if not v > self.lo:  # <= lo, zero, negative, NaN -> first bucket
+            return 0
+        if v >= self.hi:
+            return self.n - 1
+        i = int((math.log(v) - self._llo) / self._step)
+        return min(max(i, 0), self.n - 1)
+
+    def bucket_value(self, index: int) -> float:
+        """The bucket's representative (its lower edge) — what a sample
+        becomes once encoded."""
+        return bucket_edges(self.lo, self.hi, self.n)[index]
+
+    def quantize(self, value: float) -> float:
+        return self.bucket_value(self.bucket_index(value))
+
+    def observe(self, value: float) -> None:
+        i = self.bucket_index(value)
+        self.counts[i] = self.counts.get(i, 0) + 1
+
+    def observe_all(self, values) -> None:
+        for v in values:
+            self.observe(v)
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def expand(self) -> list[float]:
+        """The encoded sample multiset, sorted — the brute-force
+        reference the merge property is checked against."""
+        edges = bucket_edges(self.lo, self.hi, self.n)
+        out: list[float] = []
+        for i in sorted(self.counts):
+            out.extend([edges[i]] * self.counts[i])
+        return out
+
+    def percentile(self, p: float) -> float | None:
+        """== slo.percentile(self.expand(), p), computed from cumulative
+        counts without expanding."""
+        total = self.total()
+        if total == 0:
+            return None
+        target = min(total - 1, int(float(p) * total))
+        cum = 0
+        edges = bucket_edges(self.lo, self.hi, self.n)
+        for i in sorted(self.counts):
+            cum += self.counts[i]
+            if cum > target:
+                return edges[i]
+        return edges[max(self.counts)]  # unreachable; defensive
+
+    @classmethod
+    def merged(cls, hists) -> "FixedBucketHistogram":
+        hists = list(hists)
+        if not hists:
+            return cls()
+        out = cls(*hists[0].grid())
+        for h in hists:
+            if h.grid() != out.grid():
+                raise ValueError(
+                    f"cannot merge histograms on different grids: "
+                    f"{h.grid()} vs {out.grid()}"
+                )
+            for i, c in h.counts.items():
+                out.counts[i] = out.counts.get(i, 0) + int(c)
+        return out
+
+    def to_doc(self) -> dict:
+        return {
+            "lo": self.lo, "hi": self.hi, "n": self.n,
+            "counts": {str(i): c for i, c in sorted(self.counts.items())},
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FixedBucketHistogram":
+        h = cls(doc["lo"], doc["hi"], doc["n"])
+        for k, c in (doc.get("counts") or {}).items():
+            i = int(k)
+            if not 0 <= i < h.n:
+                raise ValueError(f"bucket index {i} outside grid n={h.n}")
+            h.counts[i] = int(c)
+        return h
+
+
+# ---------------------------------------------------------------------------
+# snapshot publication (replica side)
+
+#: snapshot doc name: metrics-<source>-<slot>.json; two slots alternated
+#: by sequence parity so a torn write never destroys the only copy
+SNAPSHOT_PREFIX = "metrics-"
+SNAPSHOT_SLOTS = ("a", "b")
+
+
+def snapshot_path(fleet_dir: str | Path, source: str, slot: str) -> Path:
+    return Path(fleet_dir) / f"{SNAPSHOT_PREFIX}{source}-{slot}.json"
+
+
+def build_snapshot(
+    source: str,
+    slo_engines: dict,
+    seq: int,
+    registry=None,
+    now_unix: float | None = None,
+) -> dict:
+    """One publishable snapshot doc: the registry snapshot, every
+    engine's window views, and the windowed latency samples re-encoded
+    on the shared histogram grid (merged across co-served engines —
+    the fleet latency view is per replica, not per model entry)."""
+    r = registry if registry is not None else obs_metrics.REGISTRY
+    now_unix = time.time() if now_unix is None else now_unix
+    hist: dict[str, dict[str, FixedBucketHistogram]] = {}
+    slo_views: dict[str, dict] = {}
+    requests_total = 0.0
+    for name, engine in sorted(slo_engines.items()):
+        slo_views[name] = engine.snapshot()
+        requests_total += float(engine.requests_total)
+        for wlabel, by_stage in engine.latency_samples().items():
+            stages = hist.setdefault(wlabel, {})
+            for stage, samples in by_stage.items():
+                if not samples:
+                    continue
+                h = stages.setdefault(stage, FixedBucketHistogram())
+                h.observe_all(samples)
+    return {"fleet_snapshot": {
+        "source": str(source),
+        "seq": int(seq),
+        "t_unix": round(now_unix, 3),
+        # the cross-host clock anchor: unix wall time and the monotonic
+        # trace clock sampled back to back, so stitched trace segments
+        # from this process can be shifted onto the shared wall axis
+        "anchor_unix_us": now_unix * 1e6,
+        "anchor_mono_us": obs_trace.Tracer.now_us(),
+        "metrics": r.snapshot(),
+        "slo": slo_views,
+        "requests_total": requests_total,
+        "hist": {
+            w: {s: h.to_doc() for s, h in sorted(stages.items())}
+            for w, stages in sorted(hist.items())
+        },
+    }}
+
+
+def validate_snapshot(doc: dict) -> list[str]:
+    """Structural + schema problems with one snapshot doc (empty = ok).
+    Every registry tag it carries must be SCHEMA-declared — the same
+    drift guard the run logs get — and every histogram must parse on a
+    sane grid."""
+    problems: list[str] = []
+    snap = doc.get("fleet_snapshot") if isinstance(doc, dict) else None
+    if not isinstance(snap, dict):
+        return ["not a fleet_snapshot doc"]
+    if not snap.get("source"):
+        problems.append("missing source")
+    for key in ("t_unix", "seq"):
+        if not isinstance(snap.get(key), (int, float)):
+            problems.append(f"missing/non-numeric {key}")
+    metrics = snap.get("metrics")
+    if not isinstance(metrics, dict):
+        problems.append("missing metrics dict")
+    else:
+        for tag, value in metrics.items():
+            if not obs_metrics.declared(tag):
+                problems.append(f"undeclared metrics tag: {tag}")
+            if not isinstance(value, (int, float)):
+                problems.append(f"non-numeric metric {tag!r}")
+    hist = snap.get("hist") or {}
+    if not isinstance(hist, dict):
+        problems.append("hist is not a dict")
+        hist = {}
+    for wlabel, stages in hist.items():
+        if not isinstance(stages, dict):
+            problems.append(f"hist[{wlabel}] is not a dict")
+            continue
+        for stage, hdoc in stages.items():
+            try:
+                FixedBucketHistogram.from_doc(hdoc)
+            except (KeyError, TypeError, ValueError) as e:
+                problems.append(f"bad histogram {wlabel}/{stage}: {e}")
+    return problems
+
+
+class SnapshotPublisher:
+    """Periodic snapshot publication for one replica (or router).
+
+    `slo_engines` is a zero-arg callable returning {name: SloEngine} so
+    the publisher follows hot swaps / co-serving changes without being
+    rebuilt. Publication failures count (`agg/publish_failures`) and
+    log — they never take down the serving loop."""
+
+    def __init__(
+        self,
+        fleet_dir: str | Path,
+        source: str,
+        slo_engines,
+        backend: coord.CoordinationBackend | None = None,
+        interval_s: float = 2.0,
+        registry=None,
+        clock=time.time,
+    ):
+        self.fleet_dir = Path(fleet_dir)
+        self.source = str(source)
+        self.backend = backend or coord.LOCAL
+        self.interval_s = float(interval_s)
+        self.registry = registry
+        self.clock = clock
+        self._slo_engines = (
+            slo_engines if callable(slo_engines) else (lambda: slo_engines)
+        )
+        self.seq = 0
+        self._next = 0.0
+        r = obs_metrics.REGISTRY
+        self._m_published = r.counter("agg/snapshots_published")
+        self._m_failed = r.counter("agg/publish_failures")
+
+    def publish(self, now: float | None = None) -> Path | None:
+        now = self.clock() if now is None else now
+        doc = build_snapshot(
+            self.source, self._slo_engines(), self.seq,
+            registry=self.registry, now_unix=now,
+        )
+        problems = validate_snapshot(doc)
+        if problems:
+            # a snapshot that fails its own schema is a bug, not load —
+            # loud, counted, and never published half-valid
+            self._m_failed.inc()
+            logger.error(
+                "refusing to publish invalid snapshot for %s: %s",
+                self.source, problems[:5],
+            )
+            return None
+        slot = SNAPSHOT_SLOTS[self.seq % len(SNAPSHOT_SLOTS)]
+        path = snapshot_path(self.fleet_dir, self.source, slot)
+        try:
+            self.backend.write_doc(path, json.dumps(doc))
+        except OSError:
+            self._m_failed.inc()
+            logger.exception("snapshot publish failed for %s", self.source)
+            return None
+        self.seq += 1
+        self._m_published.inc()
+        return path
+
+    def maybe_publish(self, now: float | None = None) -> bool:
+        now = self.clock() if now is None else now
+        if now < self._next:
+            return False
+        self._next = now + self.interval_s
+        return self.publish(now=now) is not None
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation (router side)
+
+
+def _fmt(v: float) -> str:
+    """Exposition float that round-trips exactly through float() — the
+    merged-percentile exactness contract must survive the scrape."""
+    return repr(float(v))
+
+
+class FleetAggregator:
+    """Collect + merge the published snapshots for the fleet /metrics
+    and /stats surfaces.
+
+    Per source, the newest parseable+valid slot wins; a source whose
+    both slots are torn/unreadable falls back to the in-process cache
+    of its last good snapshot (so a torn write or a backend partition
+    ages a replica into staleness instead of vanishing it). Staleness =
+    snapshot age past `stale_after_s` (the heartbeat window by
+    default): marked, counted, still merged."""
+
+    def __init__(
+        self,
+        fleet_dir: str | Path,
+        backend: coord.CoordinationBackend | None = None,
+        stale_after_s: float = 10.0,
+        clock=time.time,
+    ):
+        self.fleet_dir = Path(fleet_dir)
+        self.backend = backend or coord.LOCAL
+        self.stale_after_s = float(stale_after_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._cache: dict[str, dict] = {}  # source -> last good snapshot
+        r = obs_metrics.REGISTRY
+        self._m_collects = r.counter("agg/collects")
+        self._m_failures = r.counter("agg/collect_failures")
+        self._m_stale = r.gauge("agg/stale_replicas")
+        self._m_replicas = r.gauge("agg/replicas")
+
+    def _read_slots(self) -> tuple[dict[str, dict], list[str]]:
+        """{source: best snapshot body} + problems, newest valid slot
+        per source (torn or invalid slots are skipped with a note)."""
+        best: dict[str, dict] = {}
+        problems: list[str] = []
+        try:
+            paths = self.backend.scan(
+                self.fleet_dir, f"{SNAPSHOT_PREFIX}*.json"
+            )
+        except OSError as e:
+            self._m_failures.inc()
+            return {}, [f"snapshot scan failed: {e}"]
+        for path in paths:
+            stem = Path(path).name[len(SNAPSHOT_PREFIX):-len(".json")]
+            source = stem.rsplit("-", 1)[0] if "-" in stem else stem
+            try:
+                doc = json.loads(self.backend.read_doc(path))
+            except (OSError, json.JSONDecodeError) as e:
+                # a torn slot: the OTHER slot (or the cache) covers it
+                problems.append(f"unreadable slot {Path(path).name}: {e}")
+                continue
+            if validate_snapshot(doc):
+                problems.append(f"invalid snapshot in {Path(path).name}")
+                continue
+            snap = doc["fleet_snapshot"]
+            prev = best.get(source)
+            if prev is None or (
+                (snap["t_unix"], snap["seq"])
+                > (prev["t_unix"], prev["seq"])
+            ):
+                best[source] = snap
+        return best, problems
+
+    def collect(self, now: float | None = None) -> dict:
+        """The aggregated fleet view: per-source snapshot + age + stale
+        flag, merged histograms per (window, stage), and the problems
+        the read surfaced (never raising past a fault)."""
+        now = self.clock() if now is None else now
+        self._m_collects.inc()
+        fresh, problems = self._read_slots()
+        with self._lock:
+            self._cache.update(fresh)
+            snapshots = dict(self._cache)
+        replicas: dict[str, dict] = {}
+        merged: dict[str, dict[str, FixedBucketHistogram]] = {}
+        for source, snap in sorted(snapshots.items()):
+            age = max(0.0, now - float(snap["t_unix"]))
+            stale = age > self.stale_after_s
+            replicas[source] = {
+                "snapshot": snap,
+                "age_s": round(age, 3),
+                "stale": stale,
+                "cached": source not in fresh,
+            }
+            for wlabel, stages in (snap.get("hist") or {}).items():
+                out_stages = merged.setdefault(wlabel, {})
+                for stage, hdoc in stages.items():
+                    h = FixedBucketHistogram.from_doc(hdoc)
+                    cur = out_stages.get(stage)
+                    out_stages[stage] = (
+                        h if cur is None
+                        else FixedBucketHistogram.merged([cur, h])
+                    )
+        n_stale = sum(1 for r in replicas.values() if r["stale"])
+        self._m_replicas.set(len(replicas))
+        self._m_stale.set(n_stale)
+        return {
+            "replicas": replicas,
+            "merged_hist": merged,
+            "stale": sorted(
+                s for s, r in replicas.items() if r["stale"]
+            ),
+            "problems": problems,
+        }
+
+    # -- render --------------------------------------------------------------
+
+    @staticmethod
+    def _status_totals(snap: dict) -> dict[str, dict[str, int]]:
+        """{window: {status: count}} summed across the snapshot's
+        engines."""
+        out: dict[str, dict[str, int]] = {}
+        for view in (snap.get("slo") or {}).values():
+            for wlabel, wview in view.items():
+                if not isinstance(wview, dict):
+                    continue
+                counts = wview.get("status") or {}
+                agg = out.setdefault(wlabel, {})
+                for code, c in counts.items():
+                    agg[code] = agg.get(code, 0) + int(c)
+        return out
+
+    def exposition(
+        self, collected: dict | None = None, now: float | None = None
+    ) -> str:
+        """The fleet half of the router's /metrics: per-replica families
+        labeled `replica="<id>"` plus exact merged families labeled
+        `replica="fleet"`, staleness gauges included. Values are printed
+        via repr so the merged percentiles survive the scrape parse
+        bit-exactly."""
+        collected = self.collect(now=now) if collected is None else collected
+        replicas = collected["replicas"]
+        lines: list[str] = []
+
+        def family(name: str, tag: str, kind: str) -> None:
+            lines.append(f"# HELP {name} tag={tag}")
+            lines.append(f"# TYPE {name} {kind}")
+
+        name = "deepdfa_fleet_agg_latency_ms"
+        family(name, "agg/latency_ms", "gauge")
+
+        def latency_lines(rid: str, hists: dict) -> None:
+            for wlabel, stages in sorted(hists.items()):
+                for stage, h in sorted(stages.items()):
+                    for q in QUANTILES:
+                        v = h.percentile(q)
+                        if v is None:
+                            continue
+                        lines.append(
+                            f'{name}{{replica="{rid}",window="{wlabel}",'
+                            f'stage="{stage}",quantile="{q}"}} '
+                            f"{_fmt(v * 1e3)}"
+                        )
+
+        latency_lines("fleet", collected["merged_hist"])
+        for rid, rep in sorted(replicas.items()):
+            latency_lines(rid, {
+                w: {
+                    s: FixedBucketHistogram.from_doc(d)
+                    for s, d in stages.items()
+                }
+                for w, stages in (
+                    rep["snapshot"].get("hist") or {}
+                ).items()
+            })
+
+        name = "deepdfa_fleet_agg_requests_total"
+        family(name, "agg/requests", "counter")
+        fleet_requests = 0.0
+        for rid, rep in sorted(replicas.items()):
+            v = float(rep["snapshot"].get("requests_total") or 0.0)
+            fleet_requests += v
+            lines.append(f'{name}{{replica="{rid}"}} {v:g}')
+        lines.append(f'{name}{{replica="fleet"}} {fleet_requests:g}')
+
+        name = "deepdfa_fleet_agg_error_rate"
+        family(name, "agg/error_rate", "gauge")
+        fleet_counts: dict[str, dict[str, int]] = {}
+        for rid, rep in sorted(replicas.items()):
+            by_window = self._status_totals(rep["snapshot"])
+            for wlabel, counts in sorted(by_window.items()):
+                total = sum(counts.values())
+                if not total:
+                    continue
+                errors = sum(
+                    c for code, c in counts.items()
+                    if not code.startswith("2")
+                )
+                lines.append(
+                    f'{name}{{replica="{rid}",window="{wlabel}"}} '
+                    f"{_fmt(errors / total)}"
+                )
+                agg = fleet_counts.setdefault(wlabel, {})
+                for code, c in counts.items():
+                    agg[code] = agg.get(code, 0) + c
+        for wlabel, counts in sorted(fleet_counts.items()):
+            total = sum(counts.values())
+            errors = sum(
+                c for code, c in counts.items()
+                if not code.startswith("2")
+            )
+            lines.append(
+                f'{name}{{replica="fleet",window="{wlabel}"}} '
+                f"{_fmt(errors / total)}"
+            )
+
+        name = "deepdfa_fleet_replica_stale"
+        family(name, "agg/stale", "gauge")
+        for rid, rep in sorted(replicas.items()):
+            lines.append(
+                f'{name}{{replica="{rid}"}} {1 if rep["stale"] else 0}'
+            )
+        name = "deepdfa_fleet_snapshot_age_s"
+        family(name, "agg/snapshot_age_s", "gauge")
+        for rid, rep in sorted(replicas.items()):
+            lines.append(f'{name}{{replica="{rid}"}} {rep["age_s"]:g}')
+        name = "deepdfa_fleet_agg_replicas"
+        family(name, "agg/replicas", "gauge")
+        lines.append(f"{name} {len(replicas)}")
+        name = "deepdfa_fleet_agg_stale_replicas"
+        family(name, "agg/stale_replicas", "gauge")
+        lines.append(f"{name} {len(collected['stale'])}")
+        return "\n".join(lines) + "\n"
+
+    def stats_section(
+        self, collected: dict | None = None, now: float | None = None
+    ) -> dict:
+        """The /stats `fleet_telemetry` section: per-replica snapshot
+        metadata + the merged window quantiles (JSON keeps full float
+        precision, so this carries the same exact merged percentiles the
+        scrape does)."""
+        collected = self.collect(now=now) if collected is None else collected
+        merged = {
+            wlabel: {
+                stage: {
+                    f"p{int(q * 100)}_ms": (
+                        None if h.percentile(q) is None
+                        else h.percentile(q) * 1e3
+                    )
+                    for q in QUANTILES
+                } | {"count": h.total()}
+                for stage, h in sorted(stages.items())
+            }
+            for wlabel, stages in sorted(collected["merged_hist"].items())
+        }
+        return {
+            "replicas": {
+                rid: {
+                    "t_unix": rep["snapshot"]["t_unix"],
+                    "seq": rep["snapshot"]["seq"],
+                    "age_s": rep["age_s"],
+                    "stale": rep["stale"],
+                    "cached": rep["cached"],
+                    "requests_total": rep["snapshot"].get(
+                        "requests_total"
+                    ),
+                }
+                for rid, rep in sorted(collected["replicas"].items())
+            },
+            "merged_latency": merged,
+            "stale": collected["stale"],
+            "problems": collected["problems"],
+        }
+
+
+def validate_fleet_scrape(text: str) -> dict:
+    """`check_obs_schema --fleet-metrics`: every family SCHEMA-declared,
+    the merged-histogram family present with a replica="fleet" series,
+    per-replica labels on every per-replica family, staleness markers
+    present for every replica the scrape names."""
+    from deepdfa_tpu.obs.slo import parse_exposition
+
+    problems: list[str] = []
+    try:
+        families = parse_exposition(text)
+    except ValueError as e:
+        return {"ok": False, "problems": [str(e)], "families": 0}
+    replicas: set[str] = set()
+    import re
+
+    replica_re = re.compile(r'replica="([^"]+)"')
+    for fam_name, fam in sorted(families.items()):
+        tag = fam.get("tag")
+        if not tag:
+            problems.append(f"{fam_name}: no tag= HELP annotation")
+        elif not (
+            obs_metrics.declared(tag)
+            or obs_metrics.declared(f"{tag}/count")
+        ):
+            problems.append(f"{fam_name}: tag {tag!r} not in SCHEMA")
+        if fam_name.startswith("deepdfa_fleet_agg_") and fam_name not in (
+            "deepdfa_fleet_agg_replicas",
+            "deepdfa_fleet_agg_stale_replicas",
+        ):
+            for labels, _ in fam["samples"]:
+                m = replica_re.search(labels)
+                if m is None:
+                    problems.append(
+                        f"{fam_name}: sample without replica= label"
+                    )
+                elif m.group(1) != "fleet":
+                    replicas.add(m.group(1))
+    lat = families.get("deepdfa_fleet_agg_latency_ms")
+    if lat is None:
+        problems.append("no deepdfa_fleet_agg_latency_ms family")
+    elif not any(
+        'replica="fleet"' in labels for labels, _ in lat["samples"]
+    ):
+        problems.append("no merged (replica=\"fleet\") latency series")
+    stale = families.get("deepdfa_fleet_replica_stale")
+    stale_replicas = set()
+    if stale is not None:
+        for labels, _ in stale["samples"]:
+            m = replica_re.search(labels)
+            if m is not None:
+                stale_replicas.add(m.group(1))
+    missing = sorted(replicas - stale_replicas)
+    if replicas and stale is None:
+        problems.append("no deepdfa_fleet_replica_stale family")
+    elif missing:
+        problems.append(
+            f"replicas without staleness markers: {missing}"
+        )
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "families": len(families),
+        "replicas": sorted(replicas),
+    }
+
+
+# ---------------------------------------------------------------------------
+# cross-host trace shipping + stitching
+
+#: trace segment log name per source (an append-only coord log; the
+#: backend's torn-tolerant tail is the read side)
+TRACE_SEG_PREFIX = "trace-seg-"
+
+
+def trace_segment_path(fleet_dir: str | Path, source: str) -> Path:
+    return Path(fleet_dir) / f"{TRACE_SEG_PREFIX}{source}.jsonl"
+
+
+class TraceShipper:
+    """Ship this process's Chrome-trace events through the backend.
+
+    Reads the (already flushed) local trace file incrementally and
+    appends complete lines to the source's coord log, preceded by ONE
+    wall-clock anchor record ({unix_us, mono_us} sampled back to back)
+    so the stitcher can place the events on the shared wall axis. The
+    ship volume is bounded (`max_segment_bytes`); past the bound the
+    shipper stops and counts the truncation — fleet telemetry must
+    never become an unbounded trace mirror."""
+
+    def __init__(
+        self,
+        fleet_dir: str | Path,
+        source: str,
+        backend: coord.CoordinationBackend | None = None,
+        tracer: obs_trace.Tracer | None = None,
+        interval_s: float = 2.0,
+        max_segment_bytes: int = 4 << 20,
+    ):
+        self.fleet_dir = Path(fleet_dir)
+        self.source = str(source)
+        self.backend = backend or coord.LOCAL
+        self.tracer = tracer  # None -> the module-level tracer
+        self.interval_s = float(interval_s)
+        self.max_segment_bytes = int(max_segment_bytes)
+        self._offset = 0
+        self._shipped_bytes = 0
+        self._handle = None
+        self._anchored = False
+        self._next = 0.0
+        r = obs_metrics.REGISTRY
+        self._m_events = r.counter("agg/trace_events_shipped")
+        self._m_truncated = r.counter("agg/trace_ship_truncated")
+
+    def _trace_path(self) -> Path | None:
+        if self.tracer is not None:
+            self.tracer.flush()
+            return self.tracer.path
+        return obs_trace.current_trace_path()
+
+    def ship(self) -> int:
+        """Append every new complete trace line; returns events shipped.
+        OSErrors count and log (the backend may be partitioned) — never
+        raised into the serving loop."""
+        path = self._trace_path()
+        if path is None:
+            return 0
+        if self._shipped_bytes >= self.max_segment_bytes:
+            return 0
+        try:
+            with path.open("rb") as f:
+                f.seek(self._offset)
+                chunk = f.read(
+                    self.max_segment_bytes - self._shipped_bytes
+                )
+        except OSError:
+            return 0
+        if not chunk:
+            return 0
+        # only complete lines ship; a partial tail stays for next time
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return 0
+        chunk = chunk[: end + 1]
+        try:
+            if self._handle is None:
+                self._handle = self.backend.open_log(
+                    trace_segment_path(self.fleet_dir, self.source)
+                )
+            if not self._anchored:
+                now_unix = time.time()
+                self._handle.write_line(json.dumps({"trace_anchor": {
+                    "source": self.source,
+                    "pid": os.getpid(),
+                    "unix_us": now_unix * 1e6,
+                    "mono_us": obs_trace.Tracer.now_us(),
+                }}))
+                self._anchored = True
+            shipped = 0
+            for raw in chunk.split(b"\n"):
+                if not raw.strip():
+                    continue
+                self._handle.write_line(raw.decode("utf-8", "replace"))
+                shipped += 1
+        except OSError:
+            logger.exception("trace ship failed for %s", self.source)
+            return 0
+        self._offset += len(chunk)
+        self._shipped_bytes += len(chunk)
+        if self._shipped_bytes >= self.max_segment_bytes:
+            self._m_truncated.inc()
+            logger.warning(
+                "trace shipping for %s hit the %d-byte bound; further "
+                "events stay local only", self.source,
+                self.max_segment_bytes,
+            )
+        self._m_events.inc(shipped)
+        return shipped
+
+    def maybe_ship(self, now: float | None = None) -> int:
+        now = time.monotonic() if now is None else now
+        if now < self._next:
+            return 0
+        self._next = now + self.interval_s
+        return self.ship()
+
+    def close(self) -> None:
+        try:
+            self.ship()
+        finally:
+            if self._handle is not None and not self._handle.closed:
+                self._handle.close()
+                self._handle = None
+
+
+def read_trace_segments(
+    fleet_dir: str | Path,
+    backend: coord.CoordinationBackend | None = None,
+    max_bytes_per_source: int = 8 << 20,
+) -> dict[str, dict]:
+    """{source: {"anchor": {...} | None, "events": [...]}} from every
+    shipped segment log — bounded tail per source, torn/unparseable
+    lines skipped (the FaultableBackend torn-write contract)."""
+    backend = backend or coord.LOCAL
+    fleet_dir = Path(fleet_dir)
+    out: dict[str, dict] = {}
+    try:
+        paths = backend.scan(fleet_dir, f"{TRACE_SEG_PREFIX}*.jsonl")
+    except OSError:
+        return out
+    for path in paths:
+        source = Path(path).name[
+            len(TRACE_SEG_PREFIX):-len(".jsonl")
+        ]
+        try:
+            lines = backend.tail(path, max_bytes_per_source)
+        except OSError:
+            continue
+        anchor = None
+        events: list[dict] = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn line: skip per the tail contract
+            if not isinstance(rec, dict):
+                continue
+            if "trace_anchor" in rec:
+                anchor = rec["trace_anchor"]
+            elif "ph" in rec:
+                events.append(rec)
+        out[source] = {"anchor": anchor, "events": events}
+    return out
+
+
+def stitch_events(segments: dict[str, dict]) -> tuple[list[dict], dict]:
+    """Fold per-source segments into one event list on a shared
+    timeline: pids remapped per (source, original pid) so same-pid
+    processes from different hosts cannot collide, timestamps shifted
+    by each source's anchor (unix_us - mono_us) onto the wall clock,
+    process_name metadata prefixed with the source id. Sources without
+    an anchor stay on their own clock and are flagged."""
+    events: list[dict] = []
+    summary: dict = {"sources": {}, "unanchored": []}
+    pid_map: dict[tuple[str, int], int] = {}
+    named_pids: set[int] = set()
+    next_pid = 1
+
+    def synth_pid(source: str, pid: int) -> int:
+        nonlocal next_pid
+        key = (source, int(pid))
+        p = pid_map.get(key)
+        if p is None:
+            p = pid_map[key] = next_pid
+            next_pid += 1
+        return p
+
+    for source, seg in sorted(segments.items()):
+        anchor = seg.get("anchor")
+        shift = 0.0
+        if anchor is not None:
+            shift = float(anchor["unix_us"]) - float(anchor["mono_us"])
+        else:
+            summary["unanchored"].append(source)
+        n = 0
+        for ev in seg.get("events", ()):
+            ev = dict(ev)
+            pid = synth_pid(source, ev.get("pid", 0))
+            ev["pid"] = pid
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    args = dict(ev.get("args") or {})
+                    args["name"] = f"{source}:{args.get('name', '?')}"
+                    ev["args"] = args
+                    named_pids.add(pid)
+            else:
+                ev["ts"] = float(ev.get("ts", 0.0)) + shift
+            events.append(ev)
+            n += 1
+        summary["sources"][source] = n
+    # a segment whose process_name metadata was torn away still labels
+    for (source, _), pid in sorted(pid_map.items()):
+        if pid not in named_pids:
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "tid": 0, "ts": 0, "args": {"name": source},
+            })
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return events, summary
+
+
+def flow_chains(events) -> dict[str, dict]:
+    """{flow id: {"phases": [...], "pids": [...], "unbroken": bool}} for
+    every flow event chain in a stitched event list. Unbroken = the
+    chain starts ("s") and arrives ("t" or "f") with the arrival on a
+    DIFFERENT process than the start — the router->replica hop the
+    X-Request-Id contract promises."""
+    chains: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") not in ("s", "t", "f"):
+            continue
+        fid = str(ev.get("id"))
+        c = chains.setdefault(fid, {"phases": [], "pids": []})
+        c["phases"].append(ev["ph"])
+        pid = ev.get("pid")
+        if pid not in c["pids"]:
+            c["pids"].append(pid)
+    for c in chains.values():
+        c["unbroken"] = (
+            "s" in c["phases"]
+            and any(p in c["phases"] for p in ("t", "f"))
+            and len(c["pids"]) >= 2
+        )
+    return chains
+
+
+def stitch_fleet_trace(
+    fleet_dir: str | Path,
+    out_path: str | Path,
+    backend: coord.CoordinationBackend | None = None,
+    local_trace_dirs=(),
+    max_bytes_per_source: int = 8 << 20,
+) -> dict:
+    """One Perfetto-loadable timeline from every shipped segment (plus
+    optional locally-readable trace dirs, kept on their own clock and
+    flagged unanchored). Returns the stitch summary incl. the flow-chain
+    census `diag --fleet` reports."""
+    segments = read_trace_segments(
+        fleet_dir, backend=backend,
+        max_bytes_per_source=max_bytes_per_source,
+    )
+    for d in local_trace_dirs:
+        d = Path(d)
+        if not d.is_dir():
+            continue
+        segments[f"local:{d.name}"] = {
+            "anchor": None,
+            "events": obs_trace.merge(d),
+        }
+    events, summary = stitch_events(segments)
+    chains = flow_chains(events)
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(
+        {"traceEvents": events, "displayTimeUnit": "ms"}
+    ))
+    summary.update(
+        out=str(out_path),
+        events=len(events),
+        flows={
+            fid: c for fid, c in sorted(chains.items())
+        },
+        unbroken_flows=sorted(
+            fid for fid, c in chains.items() if c["unbroken"]
+        ),
+        broken_flows=sorted(
+            fid for fid, c in chains.items() if not c["unbroken"]
+        ),
+    )
+    return summary

@@ -1,0 +1,556 @@
+"""Declarative alert engine over the fleet's SLO + registry signals
+(docs/alerts.md).
+
+docs/slo.md shipped starter alert RULES as prose; this module makes
+them executable. An `AlertEngine` holds a rule catalog and is driven on
+a cadence (the router's poll loop when `fleet.alerts` is on, or the
+standalone `deepdfa-tpu alerts` CLI replaying a fleet_log). Every state
+transition (pending -> firing -> resolved) is emitted as a schema-valid
+`{"alert": ...}` fleet_log record carrying the rule, window, observed
+value, and threshold — alerts are evidence, not just paging.
+
+Rule kinds:
+
+  burn_rate       multi-window burn rate on an SLO error budget
+                  (Google SRE workbook shape): the engine keeps its own
+                  windowed error/total counts per configured window and
+                  the condition holds only when EVERY window's
+                  error_rate/budget exceeds the threshold — the fast
+                  window gives detection speed, the slow window keeps a
+                  brief blip from paging.
+  slo_p99         a window's p99 latency (from the SLO snapshot signal)
+                  above a millisecond threshold.
+  gauge_above     any registry gauge/counter value above a threshold
+                  (queue saturation, autoscale at max).
+  counter_rate    windowed INCREASE of a (fnmatch pattern of) counter(s)
+                  above a threshold — coord faults, poll exhaustion.
+  drift           per-tenant calibration drift, reusing PR 12's
+                  temperature/band machinery (ROADMAP 4a): calibrated
+                  in-band fraction drifting away from the fitted target
+                  escalation by more than the threshold.
+  escalation_rate per-tenant in-band (escalate-to-expensive-model)
+                  fraction above a threshold.
+
+The engine is clock-injectable and purely synchronous — evaluation
+happens only inside `evaluate()`, so tests and log replay drive it
+deterministically.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+
+from deepdfa_tpu.obs import metrics as obs_metrics
+from deepdfa_tpu.obs.slo import WindowedCounts, WindowedSamples
+
+logger = logging.getLogger(__name__)
+
+ALERT_STATES = ("pending", "firing", "resolved")
+
+#: tenant label used when a request carries none
+DEFAULT_TENANT = "default"
+
+
+@dataclass
+class AlertRule:
+    """One declarative rule. `windows` are seconds; `for_s` is how long
+    the condition must hold before pending promotes to firing (0 =
+    immediately). `params` carries kind-specific knobs (budget, key,
+    pattern, tenant, temperature, band, target, min_samples)."""
+
+    name: str
+    kind: str
+    threshold: float
+    for_s: float = 0.0
+    windows: tuple = (60.0, 300.0)
+    params: dict = field(default_factory=dict)
+
+    def window_label(self) -> str:
+        return "+".join(f"{int(w)}s" for w in self.windows)
+
+
+class _ExactCounts:
+    """Exact per-event counter for SHORT alert windows. WindowedCounts
+    buckets per second and evicts a bucket once its INTEGER second
+    falls behind the horizon — correct for the SLO engine's 60 s+
+    windows, but a sub-5 s burn window would evict its own live second
+    partway through. Event-timestamp storage is exact at any horizon;
+    fine here because short windows hold few events by construction."""
+
+    __slots__ = ("horizon_s", "_t")
+
+    def __init__(self, horizon_s: float):
+        self.horizon_s = float(horizon_s)
+        self._t: deque[float] = deque()
+
+    def observe(self, now: float) -> None:
+        self._t.append(now)
+
+    def total(self, now: float) -> int:
+        cutoff = now - self.horizon_s
+        while self._t and self._t[0] < cutoff:
+            self._t.popleft()
+        return len(self._t)
+
+
+def _window_counts(horizon_s: float):
+    return (
+        _ExactCounts(horizon_s) if horizon_s < 5.0
+        else WindowedCounts(horizon_s)
+    )
+
+
+class _WindowSum:
+    """Windowed sum of observed increments (for counter_rate rules)."""
+
+    def __init__(self, horizon_s: float):
+        self.horizon_s = float(horizon_s)
+        self._events: list[tuple[float, float]] = []
+
+    def observe(self, amount: float, now: float) -> None:
+        self._events.append((now, float(amount)))
+
+    def total(self, now: float) -> float:
+        cutoff = now - self.horizon_s
+        self._events = [e for e in self._events if e[0] >= cutoff]
+        return sum(a for _, a in self._events)
+
+
+class _RuleState:
+    __slots__ = (
+        "rule", "state", "pending_since", "err", "tot", "probs",
+        "last_counter", "window_sum",
+    )
+
+    def __init__(self, rule: AlertRule):
+        self.rule = rule
+        self.state = "inactive"
+        self.pending_since: float | None = None
+        # burn_rate: own windowed error/total counts per window
+        self.err = {w: _window_counts(w) for w in rule.windows}
+        self.tot = {w: _window_counts(w) for w in rule.windows}
+        # drift / escalation_rate: windowed per-tenant probs
+        self.probs = WindowedSamples(
+            max(rule.windows), max_samples=4096
+        )
+        # counter_rate: last seen absolute value + windowed increments
+        self.last_counter: float | None = None
+        self.window_sum = _WindowSum(max(rule.windows))
+
+
+def _calibrated_in_band_fraction(
+    probs, temperature: float, band
+) -> float | None:
+    """Fraction of (temperature-scaled) probs inside the escalation
+    band — PR 12's machinery, imported lazily so the engine stays
+    numpy-free until a drift rule actually evaluates."""
+    if not probs:
+        return None
+    import numpy as np
+
+    from deepdfa_tpu.eval.calibrate import in_band, temperature_scale
+
+    arr = np.asarray(list(probs), dtype=np.float64)
+    scaled = temperature_scale(arr, float(temperature))
+    lo, hi = band
+    return float(np.mean([in_band(float(p), (lo, hi)) for p in scaled]))
+
+
+class AlertEngine:
+    """Evaluate a rule catalog against fed signals; emit transition
+    records.
+
+    Request-level signals arrive via `observe_request` (status, tenant,
+    calibrated prob); snapshot-level signals (SLO windows, registry
+    counters/gauges) arrive as the `signals` dict at `evaluate` time:
+
+        {"slo": <SloEngine.snapshot()>, "counters": {...},
+         "gauges": {...}}
+
+    `sink` (optional) is a callable receiving each transition record —
+    the router passes its FleetLog.append."""
+
+    def __init__(self, rules, clock=None, sink=None):
+        import time
+
+        self.clock = clock if clock is not None else time.time
+        self.sink = sink
+        self._states = {r.name: _RuleState(r) for r in rules}
+        r = obs_metrics.REGISTRY
+        self._m_evals = r.counter("alert/evaluations")
+        self._m_transitions = r.counter("alert/transitions")
+        self._m_firing = r.gauge("alert/firing")
+
+    @property
+    def rules(self) -> list[AlertRule]:
+        return [s.rule for s in self._states.values()]
+
+    # -- signal feed ---------------------------------------------------------
+
+    def observe_request(
+        self,
+        status: int,
+        tenant: str | None = None,
+        prob: float | None = None,
+        now: float | None = None,
+    ) -> None:
+        now = self.clock() if now is None else now
+        tenant = tenant or DEFAULT_TENANT
+        err = not (200 <= int(status) < 300)
+        for st in self._states.values():
+            rule = st.rule
+            if rule.kind == "burn_rate":
+                for w in rule.windows:
+                    st.tot[w].observe(now)
+                    if err:
+                        st.err[w].observe(now)
+            elif rule.kind in ("drift", "escalation_rate"):
+                if (
+                    prob is not None
+                    and rule.params.get("tenant", tenant) == tenant
+                ):
+                    st.probs.observe(float(prob), now)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _condition(
+        self, st: _RuleState, signals: dict, now: float
+    ) -> tuple[bool, float | None]:
+        """(holds, observed value) for one rule against the signals."""
+        rule = st.rule
+        if rule.kind == "burn_rate":
+            budget = float(rule.params.get("budget", 0.01))
+            burns = []
+            for w in rule.windows:
+                total = st.tot[w].total(now)
+                min_count = int(rule.params.get("min_count", 1))
+                if total < min_count:
+                    return False, None
+                burns.append(
+                    (st.err[w].total(now) / total) / max(budget, 1e-12)
+                )
+            observed = min(burns)  # the binding (slowest) window
+            return observed > rule.threshold, observed
+        if rule.kind == "slo_p99":
+            slo = signals.get("slo") or {}
+            wlabel = rule.params.get(
+                "window", f"{int(rule.windows[0])}s"
+            )
+            stage = rule.params.get("stage", "total")
+            view = slo.get(wlabel)
+            if not isinstance(view, dict):
+                return False, None
+            lat = (view.get("latency_ms") or {}).get(stage) or {}
+            p99 = lat.get("p99")
+            if p99 is None:
+                return False, None
+            return float(p99) > rule.threshold, float(p99)
+        if rule.kind == "gauge_above":
+            key = rule.params.get("key", "")
+            gauges = signals.get("gauges") or {}
+            counters = signals.get("counters") or {}
+            v = gauges.get(key, counters.get(key))
+            if v is None:
+                return False, None
+            return float(v) > rule.threshold, float(v)
+        if rule.kind == "counter_rate":
+            pattern = rule.params.get("pattern", "")
+            counters = signals.get("counters") or {}
+            current = sum(
+                float(v) for k, v in counters.items()
+                if fnmatch.fnmatch(k, pattern)
+            )
+            if st.last_counter is None:
+                st.last_counter = current
+                return False, None
+            delta = current - st.last_counter
+            st.last_counter = current
+            if delta > 0:
+                st.window_sum.observe(delta, now)
+            observed = st.window_sum.total(now)
+            return observed > rule.threshold, observed
+        if rule.kind in ("drift", "escalation_rate"):
+            min_samples = int(rule.params.get("min_samples", 20))
+            probs = st.probs.values(now)
+            if len(probs) < min_samples:
+                return False, None
+            frac = _calibrated_in_band_fraction(
+                probs,
+                rule.params.get("temperature", 1.0),
+                tuple(rule.params.get("band", (0.35, 0.65))),
+            )
+            if frac is None:
+                return False, None
+            if rule.kind == "escalation_rate":
+                return frac > rule.threshold, frac
+            target = float(rule.params.get("target", 0.1))
+            observed = abs(frac - target)
+            return observed > rule.threshold, observed
+        raise ValueError(f"unknown alert rule kind: {rule.kind!r}")
+
+    def _record(
+        self, st: _RuleState, state: str, observed, now: float
+    ) -> dict:
+        rule = st.rule
+        body = {
+            "rule": rule.name,
+            "state": state,
+            "kind": rule.kind,
+            "window": rule.window_label(),
+            "observed": (
+                None if observed is None else round(float(observed), 6)
+            ),
+            "threshold": float(rule.threshold),
+            "for_s": float(rule.for_s),
+            "t_unix": round(now, 3),
+        }
+        tenant = rule.params.get("tenant")
+        if tenant is not None:
+            body["tenant"] = tenant
+        return {"alert": body}
+
+    def evaluate(
+        self, signals: dict | None = None, now: float | None = None
+    ) -> list[dict]:
+        """Run every rule's state machine once; returns (and sinks) the
+        transition records. pending -> inactive is silent (a blip that
+        never held for `for_s` is not worth a log line); every other
+        transition is a record."""
+        now = self.clock() if now is None else now
+        signals = signals or {}
+        self._m_evals.inc()
+        out: list[dict] = []
+        for st in self._states.values():
+            try:
+                holds, observed = self._condition(st, signals, now)
+            except Exception:
+                logger.exception(
+                    "alert rule %s evaluation failed", st.rule.name
+                )
+                continue
+            if st.state in ("inactive", "resolved"):
+                if holds:
+                    st.state = "pending"
+                    st.pending_since = now
+                    out.append(self._record(st, "pending", observed, now))
+                else:
+                    st.state = "inactive"
+            if st.state == "pending":
+                if not holds:
+                    st.state = "inactive"
+                    st.pending_since = None
+                elif now - st.pending_since >= st.rule.for_s:
+                    st.state = "firing"
+                    out.append(self._record(st, "firing", observed, now))
+            elif st.state == "firing" and not holds:
+                st.state = "resolved"
+                st.pending_since = None
+                out.append(self._record(st, "resolved", observed, now))
+        if out:
+            self._m_transitions.inc(len(out))
+            if self.sink is not None:
+                for rec in out:
+                    self.sink(rec)
+        self._m_firing.set(
+            sum(1 for s in self._states.values() if s.state == "firing")
+        )
+        return out
+
+    def firing(self) -> list[str]:
+        return sorted(
+            name for name, s in self._states.items()
+            if s.state == "firing"
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            "rules": {
+                name: {
+                    "state": s.state,
+                    "kind": s.rule.kind,
+                    "threshold": s.rule.threshold,
+                    "window": s.rule.window_label(),
+                }
+                for name, s in sorted(self._states.items())
+            },
+            "firing": self.firing(),
+        }
+
+
+def validate_alert_record(rec: dict) -> list[str]:
+    """Problems with one {"alert": ...} record (empty = valid) — the
+    shape check_obs_schema --fleet-log enforces."""
+    problems: list[str] = []
+    body = rec.get("alert") if isinstance(rec, dict) else None
+    if not isinstance(body, dict):
+        return ["not an alert record"]
+    if not body.get("rule") or not isinstance(body.get("rule"), str):
+        problems.append("alert missing rule name")
+    if body.get("state") not in ALERT_STATES:
+        problems.append(f"bad alert state: {body.get('state')!r}")
+    for key in ("threshold", "t_unix", "for_s"):
+        if not isinstance(body.get(key), (int, float)):
+            problems.append(f"alert missing/non-numeric {key}")
+    if body.get("observed") is not None and not isinstance(
+        body.get("observed"), (int, float)
+    ):
+        problems.append("alert observed is non-numeric")
+    if not body.get("window"):
+        problems.append("alert missing window")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# rule catalog
+
+def default_rules() -> list[AlertRule]:
+    """docs/slo.md's starter rules, executable, plus the coord/autoscale
+    watches the fleet grew since. Per-tenant drift/escalation rules are
+    deployment-specific (they need a fitted temperature + band) and are
+    added via `fleet.alert_rules` JSON — see docs/alerts.md."""
+    return [
+        # error budget 5% (docs/slo.md availability target 99.9% is the
+        # aspiration; the starter rule pages at 5% error rate) — fast
+        # window for detection, slow window to ride out blips
+        AlertRule(
+            name="serve_high_error_rate", kind="burn_rate",
+            threshold=1.0, for_s=0.0, windows=(60.0, 300.0),
+            params={"budget": 0.05, "min_count": 5},
+        ),
+        AlertRule(
+            name="serve_p99_degraded", kind="slo_p99",
+            threshold=250.0, for_s=60.0, windows=(300.0,),
+            params={"window": "300s", "stage": "total"},
+        ),
+        AlertRule(
+            name="serve_queue_saturated", kind="gauge_above",
+            threshold=0.8, for_s=10.0, windows=(60.0,),
+            params={"key": "queue_ratio"},
+        ),
+        AlertRule(
+            name="coord_backend_faults", kind="counter_rate",
+            threshold=0.0, for_s=0.0, windows=(60.0,),
+            params={"pattern": "coord/faults/*"},
+        ),
+        AlertRule(
+            name="coord_poll_exhausted", kind="counter_rate",
+            threshold=0.0, for_s=0.0, windows=(300.0,),
+            params={"pattern": "coord/poll_exhausted"},
+        ),
+        AlertRule(
+            name="autoscale_saturated", kind="gauge_above",
+            threshold=0.0, for_s=30.0, windows=(60.0,),
+            params={"key": "autoscale/at_max"},
+        ),
+    ]
+
+
+def rule_from_doc(doc: dict) -> AlertRule:
+    return AlertRule(
+        name=str(doc["name"]),
+        kind=str(doc["kind"]),
+        threshold=float(doc["threshold"]),
+        for_s=float(doc.get("for_s", 0.0)),
+        windows=tuple(
+            float(w) for w in doc.get("windows", (60.0, 300.0))
+        ),
+        params=dict(doc.get("params") or {}),
+    )
+
+
+def rules_from_config(cfg) -> list[AlertRule]:
+    """Default catalog, overlaid with `cfg.fleet.alert_rules` (a JSON
+    list). An entry with a known name REPLACES the default; an entry
+    {"name": ..., "disable": true} removes it; new names append — this
+    is how a deployment adds its per-tenant drift rules."""
+    rules = {r.name: r for r in default_rules()}
+    raw = getattr(cfg.fleet, "alert_rules", "") or ""
+    if raw.strip():
+        docs = json.loads(raw)
+        if not isinstance(docs, list):
+            raise ValueError("fleet.alert_rules must be a JSON list")
+        for doc in docs:
+            name = str(doc.get("name", ""))
+            if not name:
+                raise ValueError(f"alert rule without a name: {doc}")
+            if doc.get("disable"):
+                rules.pop(name, None)
+            else:
+                rules[name] = rule_from_doc(doc)
+    return list(rules.values())
+
+
+# ---------------------------------------------------------------------------
+# standalone replay (the `deepdfa-tpu alerts` CLI)
+
+def replay_fleet_log(
+    path,
+    rules=None,
+    backend=None,
+    interval_s: float = 1.0,
+    max_bytes: int = 64 << 20,
+) -> dict:
+    """Drive an AlertEngine over an existing fleet_log as if the rules
+    had been live: request records feed observe_request (status,
+    tenant, calibrated prob when the router recorded one), summary
+    records provide the SLO/counter signals, and the engine is
+    evaluated every `interval_s` of RECORD time (the log's own t_unix
+    cursor — replay is deterministic, wall clock never enters)."""
+    from deepdfa_tpu.fleet import coord
+
+    backend = backend or coord.LOCAL
+    engine_rules = rules if rules is not None else default_rules()
+    transitions: list[dict] = []
+    # the clock is the log's time cursor, advanced by records
+    cursor = {"t": 0.0}
+    engine = AlertEngine(engine_rules, clock=lambda: cursor["t"])
+    signals: dict = {}
+    next_eval = 0.0
+    n_records = 0
+    for rec in backend.tail_records(path, max_bytes=max_bytes):
+        n_records += 1
+        if "request" in rec:
+            req = rec["request"]
+            t = float(req.get("t_unix") or cursor["t"])
+            cursor["t"] = max(cursor["t"], t)
+            engine.observe_request(
+                int(req.get("status", 0)),
+                tenant=req.get("tenant"),
+                prob=req.get("prob"),
+                now=cursor["t"],
+            )
+        elif "fleet" in rec or "fleet_slo" in rec:
+            signals = {
+                "slo": rec.get("fleet_slo") or signals.get("slo") or {},
+                "counters": rec.get("fleet") or signals.get(
+                    "counters"
+                ) or {},
+                "gauges": rec.get("fleet") or {},
+            }
+        elif "alert" in rec:
+            continue  # don't re-alert on alerts
+        if next_eval == 0.0:
+            next_eval = cursor["t"] + float(interval_s)
+        while cursor["t"] >= next_eval:
+            transitions.extend(
+                engine.evaluate(signals, now=next_eval)
+            )
+            next_eval += float(interval_s)
+    transitions.extend(engine.evaluate(signals, now=cursor["t"]))
+    return {
+        "records": n_records,
+        "transitions": transitions,
+        "fired": sorted({
+            t["alert"]["rule"] for t in transitions
+            if t["alert"]["state"] == "firing"
+        }),
+        "resolved": sorted({
+            t["alert"]["rule"] for t in transitions
+            if t["alert"]["state"] == "resolved"
+        }),
+        "firing": engine.firing(),
+    }

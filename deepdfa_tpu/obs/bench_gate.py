@@ -89,6 +89,11 @@ LOWER_IS_BETTER: dict[str, float] = {
     # or the fleet lost capacity
     "fleet_p99_overload_ms": 0.25,
     "fleet_shed_rate": 0.25,
+    # alert time-to-detect (ISSUE 19, scripts/bench_load.py): wall-clock
+    # from an injected error burst to the burn-rate rule's firing
+    # transition (obs/alerts.py). Generous: the episode is short and the
+    # cadence granularity dominates.
+    "alert_mttd_s": 0.5,
     # efficiency-ledger compile accounting (ISSUE 10): total AOT
     # compile wall time per bench child — a rise past tolerance means
     # the compiled programs got slower to build (or a site started
@@ -125,6 +130,11 @@ ZERO_REFERENCE_STRICT = frozenset({"tuned_ladder_padding_waste"})
 #: join). Exceeding one is a `regression`.
 ABSOLUTE_UPPER_BOUNDS: dict[str, float] = {
     "obs_ledger_overhead_fraction": 0.02,
+    # the fleet telemetry plane (ISSUE 19, obs/aggregate.py +
+    # obs/alerts.py): snapshot publication + alert evaluation riding the
+    # serving path must cost <= 2% of closed-loop throughput, measured
+    # by scripts/bench_load.py's interleaved on/off reps
+    "obs_fleet_overhead_fraction": 0.02,
     # the cascade's pinned accuracy contract (ISSUE 12, docs/cascade.md):
     # dev-set AUC may trail combined-only serving by at most the drift
     # bound (one-sided — a cascade that scores BETTER is not a

@@ -467,8 +467,29 @@ def fleet_section(run_dir: Path, fleet_records: list[dict]) -> dict:
                 fleet_dir = Path(configured)
         except (json.JSONDecodeError, OSError):
             pass
-    # per-replica traffic + occupancy (occupancy from the replica's own
-    # serve log: the router never sees batch fill, the batcher does)
+    # federated telemetry snapshots (obs/aggregate.py) are the
+    # PREFERRED source for per-replica health: schema-validated,
+    # torn-write-safe, and they carry the exactly-merged latency
+    # histograms. Replica serve logs are the fallback.
+    snap_replicas: dict[str, dict] = {}
+    telemetry: dict = {}
+    try:
+        if list(Path(fleet_dir).glob("metrics-*.json")):
+            from deepdfa_tpu.obs.aggregate import FleetAggregator
+            aggregator = FleetAggregator(fleet_dir)
+            telemetry = aggregator.stats_section()
+            collected_replicas = aggregator.collect().get("replicas") or {}
+            snap_replicas = {
+                rid: rep["snapshot"]
+                for rid, rep in collected_replicas.items()
+            }
+    except Exception as e:  # diag reports, it never crashes on bad input
+        telemetry = {"problems": [f"snapshot aggregation failed: {e}"]}
+    if telemetry:
+        out["telemetry"] = telemetry
+    # per-replica traffic + occupancy (occupancy from the replica's
+    # published snapshot when the telemetry plane is on — else from its
+    # own serve log: the router never sees batch fill, the batcher does)
     per_replica: dict[str, dict] = {}
     for req in requests:
         rid = req.get("replica")
@@ -479,6 +500,22 @@ def fleet_section(run_dir: Path, fleet_records: list[dict]) -> dict:
     for rid, agg in per_replica.items():
         if span_s > 0:
             agg["requests_per_sec"] = round(agg["requests"] / span_s, 3)
+        snap = snap_replicas.get(rid)
+        occ = (
+            (snap.get("metrics") or {}).get("serve/batch_occupancy/mean")
+            if snap else None
+        )
+        if occ is not None:
+            agg["batch_occupancy_mean"] = round(occ, 4)
+            agg["telemetry_source"] = "snapshots"
+            continue
+        if snap_replicas:
+            # the fleet published snapshots but this replica's carries no
+            # occupancy (or none at all) — say so out loud before we go
+            # scrape its serve log
+            agg["telemetry_source"] = (
+                "serve_log (FALLBACK: no usable snapshot for this replica)"
+            )
         for rec in reversed(
             _read_jsonl(fleet_dir / rid / "serve_log.jsonl")
         ):
@@ -581,6 +618,46 @@ def autoscale_section(fleet_records: list[dict]) -> dict:
             if k in first_up
         }
     return out
+
+
+def alerts_section(fleet_records: list[dict]) -> dict:
+    """The alert-engine section, rebuilt from the router's fleet_log
+    `{"alert": ...}` transition records (obs/alerts.py; docs/alerts.md):
+    per-rule transition counts, time-to-detect (first firing after the
+    preceding resolved/inactive stretch), and whatever is STILL firing
+    at the end of the log — the on-call summary."""
+    transitions = [
+        r["alert"] for r in fleet_records
+        if isinstance(r.get("alert"), dict)
+    ]
+    if not transitions:
+        return {}
+    rules: dict[str, dict] = {}
+    for tr in transitions:
+        name = str(tr.get("rule", "?"))
+        row = rules.setdefault(name, {
+            "kind": tr.get("kind"), "transitions": 0,
+            "fired": 0, "resolved": 0, "last_state": None,
+        })
+        row["transitions"] += 1
+        state = tr.get("state")
+        if state == "firing":
+            row["fired"] += 1
+        elif state == "resolved":
+            row["resolved"] += 1
+        row["last_state"] = state
+        if "observed" in tr and tr["observed"] is not None:
+            row["last_observed"] = tr["observed"]
+        if "tenant" in tr:
+            row["tenant"] = tr["tenant"]
+    return {
+        "transitions": len(transitions),
+        "rules": dict(sorted(rules.items())),
+        "still_firing": sorted(
+            name for name, row in rules.items()
+            if row["last_state"] in ("firing", "pending")
+        ),
+    }
 
 
 def drill_section(
@@ -901,6 +978,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "scan": scan_section(load_scan_records(run_dir)),
         "fleet": fleet_section(run_dir, fleet_records),
         "autoscale": autoscale_section(fleet_records),
+        "alerts": alerts_section(fleet_records),
         "drill": drill_section(run_dir, bench_root),
         "efficiency": efficiency_section(run_dir, records),
         "tuning": tuning_section(run_dir),
@@ -1167,10 +1245,36 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f" occupancy={occ:.1%}"
                 if isinstance(occ, (int, float)) else ""
             )
+            src = agg.get("telemetry_source")
+            src_s = f" [{src}]" if src else ""
             w(
                 f"  replica {rid:<6} requests={agg['requests']}"
-                f"{rps_s}{occ_s}\n"
+                f"{rps_s}{occ_s}{src_s}\n"
             )
+        telem = fleet.get("telemetry") or {}
+        if telem:
+            w("  federated telemetry (obs/aggregate.py snapshots):\n")
+            for rid, row in (telem.get("replicas") or {}).items():
+                stale_s = " STALE" if row.get("stale") else ""
+                cached_s = " cached" if row.get("cached") else ""
+                w(
+                    f"    {rid:<8} seq={row.get('seq')} "
+                    f"age={row.get('age_s')}s "
+                    f"requests={row.get('requests_total')}"
+                    f"{stale_s}{cached_s}\n"
+                )
+            merged = telem.get("merged_latency") or {}
+            for wlabel, stages in merged.items():
+                tot = (stages.get("total") or {})
+                p99 = tot.get("p99_ms")
+                if p99 is not None:
+                    w(
+                        f"    merged[{wlabel}] total "
+                        f"p50={tot.get('p50_ms'):.3f}ms "
+                        f"p99={p99:.3f}ms n={tot.get('count')}\n"
+                    )
+            for prob in telem.get("problems") or []:
+                w(f"    problem: {prob}\n")
         for title, key in (
             ("tenant", "by_tenant"), ("priority", "by_priority"),
         ):
@@ -1205,6 +1309,26 @@ def render_text(report: dict, out=sys.stdout) -> None:
             w("  " + " ".join(
                 f"{k}={int(v)}" for k, v in counters.items()
             ) + "\n")
+
+    alerts = report.get("alerts") or {}
+    if alerts:
+        w("\nalerts (fleet_log.jsonl, docs/alerts.md):\n")
+        for name, row in (alerts.get("rules") or {}).items():
+            obs_s = (
+                f" observed={row['last_observed']}"
+                if "last_observed" in row else ""
+            )
+            tenant_s = (
+                f" tenant={row['tenant']}" if "tenant" in row else ""
+            )
+            w(
+                f"  {name:<28}{row.get('kind', '?'):<16}"
+                f"fired={row['fired']} resolved={row['resolved']} "
+                f"last={row['last_state']}{obs_s}{tenant_s}\n"
+            )
+        still = alerts.get("still_firing") or []
+        if still:
+            w("  STILL FIRING: " + " ".join(still) + "\n")
 
     autoscale = report.get("autoscale") or {}
     if autoscale:
@@ -1809,7 +1933,74 @@ def main(argv=None) -> int:
     ap.add_argument("--postmortem", default=None, metavar="PATH",
                     help="render ONE postmortem.json (crash flight "
                     "recorder dump) instead of a run dir")
+    ap.add_argument("--fleet", default=None, metavar="FLEET_DIR",
+                    help="fleet-wide mode: stitch every replica's "
+                    "shipped trace segments into ONE Perfetto timeline "
+                    "(fleet_trace.json), summarize the federated "
+                    "metrics snapshots, and replay the fleet log's "
+                    "alert records (docs/alerts.md)")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        fleet_dir = Path(args.fleet)
+        if not fleet_dir.is_dir():
+            print(f"no such fleet dir: {args.fleet}", file=sys.stderr)
+            return 2
+        from deepdfa_tpu.obs.aggregate import (
+            FleetAggregator, stitch_fleet_trace,
+        )
+        out_path = fleet_dir / "fleet_trace.json"
+        stitched = stitch_fleet_trace(fleet_dir, out_path)
+        telemetry = {}
+        if list(fleet_dir.glob("metrics-*.json")):
+            telemetry = FleetAggregator(fleet_dir).stats_section()
+        fleet_records = _read_jsonl(fleet_dir / "fleet_log.jsonl")
+        report = {
+            "fleet_dir": str(fleet_dir),
+            "trace": stitched,
+            "telemetry": telemetry,
+            "alerts": alerts_section(fleet_records),
+        }
+        if args.json:
+            print(json.dumps(report))
+            return 0
+        print(f"fleet: {fleet_dir}")
+        print(
+            f"  stitched trace: {stitched.get('out')} "
+            f"({stitched.get('events')} events from "
+            f"{len(stitched.get('sources') or [])} source(s))"
+        )
+        print(
+            f"  request flows: {len(stitched.get('flows') or {})} total, "
+            f"{len(stitched.get('unbroken_flows') or [])} unbroken, "
+            f"{len(stitched.get('broken_flows') or [])} broken"
+        )
+        for fid in stitched.get("broken_flows") or []:
+            print(f"  BROKEN flow chain: {fid}")
+        for src in stitched.get("unanchored") or []:
+            print(f"  WARNING: no clock anchor from {src} — its events "
+                  "keep their local monotonic timebase")
+        if telemetry:
+            for rid, row in (telemetry.get("replicas") or {}).items():
+                stale_s = " STALE" if row.get("stale") else ""
+                print(
+                    f"  snapshot {rid:<8} seq={row.get('seq')} "
+                    f"age={row.get('age_s')}s{stale_s}"
+                )
+            for prob in telemetry.get("problems") or []:
+                print(f"  problem: {prob}")
+        else:
+            print("  no metrics snapshots published "
+                  "(set fleet.telemetry=true)")
+        al = alerts_section(fleet_records)
+        for name, row in (al.get("rules") or {}).items():
+            print(
+                f"  alert {name:<28} fired={row['fired']} "
+                f"resolved={row['resolved']} last={row['last_state']}"
+            )
+        if al.get("still_firing"):
+            print("  STILL FIRING: " + " ".join(al["still_firing"]))
+        return 0
 
     if args.postmortem:
         pm = postmortem_summary(args.postmortem)

@@ -329,9 +329,11 @@ SCHEMA: tuple[str, ...] = (
     # fields like t_unix/failures/heartbeat_age_s
     "fleet_event/*",
     # per-request fleet_log entries (router request log; the admission
-    # fields beyond the serve request/* set)
+    # fields beyond the serve request/* set). `request/prob` is the
+    # replica's calibrated score echoed into the router's log when the
+    # alert engine is on — the drift watch's replay signal
     "request/deadline_ms", "request/priority", "request/retries",
-    "request/shed",
+    "request/shed", "request/prob",
     # router HA (fleet/ha.py, docs/fleet.md): takeover/stepdown
     # counters, the active-role gauge, measured failover seconds, and
     # the admission re-seed accounting — plus the scalar fields the
@@ -360,6 +362,23 @@ SCHEMA: tuple[str, ...] = (
     # action plus the {"autoscale": {...}} fleet_log records' scalar
     # fields (forecast/capacity rates, ratio, replica counts, stage)
     "autoscale/*", "autoscale_*",
+    # fleet telemetry plane (obs/aggregate.py, docs/observability.md):
+    # snapshot publish/collect counters, staleness gauges, and trace-
+    # shipping accounting — plus the aggregated /metrics families'
+    # tags (agg/latency_ms, agg/requests, agg/error_rate, agg/stale,
+    # agg/snapshot_age_s) the fleet scrape validator checks
+    "agg/*",
+    # alert engine (obs/alerts.py, docs/alerts.md): evaluation/
+    # transition counters, the firing gauge, and the {"alert": {...}}
+    # fleet_log records' scalar fields (observed, threshold, for_s,
+    # t_unix); fleet_alert_* covers bench/drill alert stamps
+    # (alert_mttd_s rides bench records; drill records carry
+    # drill_alert_mttd_s under drill_*)
+    "alert/*", "fleet_alert_*", "alert_mttd_s",
+    # federation + alert-evaluation overhead bound (scripts/
+    # bench_load.py interleaved reps; ≤2% ABSOLUTE_UPPER_BOUNDS in
+    # obs/bench_gate.py)
+    "obs_fleet_overhead_fraction",
     # fleet_log summary + bench_load record fields (scripts/
     # bench_load.py, bench.py --child-fleet; gated in obs/bench_gate.py)
     "fleet_replicas", "fleet_requests_per_sec", "fleet_seconds",

@@ -278,6 +278,20 @@ class SloEngine:
         out["requests_total"] = self.requests_total
         return out
 
+    def latency_samples(self, now: float | None = None) -> dict:
+        """The raw windowed latency sample lists, {window-label:
+        {stage: [seconds, ...]}} — what fleet federation re-encodes
+        onto the shared histogram grid (obs/aggregate.py) so merged
+        fleet percentiles stay exact."""
+        now = self.clock() if now is None else now
+        return {
+            self.window_label(w): {
+                stage: self._latency[w][stage].values(now)
+                for stage in self.stages
+            }
+            for w in self.windows
+        }
+
     # -- Prometheus ----------------------------------------------------------
 
     def exposition(self, now: float | None = None) -> str:

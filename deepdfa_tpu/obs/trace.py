@@ -294,6 +294,16 @@ def enabled() -> bool:
     return (_tracer or _lazy_init()) is not None
 
 
+def current_trace_path() -> "Path | None":
+    """The active tracer's (flushed) on-disk file, or None when tracing
+    is off — what the fleet TraceShipper tails incrementally."""
+    t = _tracer or _lazy_init()
+    if t is None:
+        return None
+    t.flush()
+    return t.path
+
+
 def span(name: str, cat: str = "app", **args):
     """Context manager timing a block; no-op (shared singleton, no
     allocation) when tracing is off."""

@@ -27,6 +27,18 @@ Reported (bench-gated in obs/bench_gate.py, both lower-is-better):
 plus throughput/accounting fields and the zero-steady-state-recompiles
 census summed across replicas.
 
+ISSUE 19 stamps (the fleet telemetry plane, docs/alerts.md):
+  obs_fleet_overhead_fraction  closed-loop throughput cost of the
+                          telemetry duty cycle (per-request alert
+                          observation + cadenced snapshot publish +
+                          rule evaluation), measured as INTERLEAVED
+                          off/on reps so machine-load drift cancels —
+                          absolute-bounded at 2% in bench_gate
+  alert_mttd_s            wall-clock from an injected error burst to
+                          the burn-rate rule's firing transition at the
+                          production evaluation cadence (lower-is-better
+                          gated)
+
 Modes:
     python scripts/bench_load.py --smoke   # tier-1 regression mode
     python scripts/bench_load.py           # full mode (bigger drive)
@@ -59,6 +71,35 @@ TENANT_POLICIES = (
     ' "batch": {"rate": 10000, "burst": 10000, "priority": 1},'
     ' "besteffort": {"rate": 1, "burst": 2, "priority": 2}}'
 )
+
+
+def _measure_alert_mttd(
+    cadence_s: float = 0.05, timeout_s: float = 5.0
+) -> float | None:
+    """One wall-clock detection episode for the burn-rate rule
+    (obs/alerts.py): a healthy request stream, an error burst at t0,
+    the engine evaluated on its cadence — the stamp is the firing
+    transition's delay past the burst. Cadence granularity dominates,
+    which is the point: the stamp tracks the real time-to-page."""
+    import time as _time
+
+    from deepdfa_tpu.obs.alerts import AlertEngine, AlertRule
+
+    engine = AlertEngine([AlertRule(
+        name="bench_burn", kind="burn_rate", threshold=1.0,
+        windows=(0.5, 1.5), params={"budget": 0.05, "min_count": 3},
+    )])
+    for _ in range(50):
+        engine.observe_request(200)
+    t0 = _time.monotonic()
+    for _ in range(50):
+        engine.observe_request(500)
+    while _time.monotonic() - t0 < timeout_s:
+        _time.sleep(cadence_s)
+        engine.evaluate()
+        if "bench_burn" in engine.firing():
+            return _time.monotonic() - t0
+    return None
 
 
 def _bench_registry(cfg, model, params, vocabs, run_dir):
@@ -191,6 +232,60 @@ def bench_load(
                 assert status == 200, f"warm request failed: {status}"
             warm_rps = n_warm / (time.perf_counter() - t0)
 
+            # ISSUE 19: cost of the fleet telemetry plane, measured as
+            # INTERLEAVED off/on closed-loop reps so machine-load drift
+            # cancels instead of biasing one arm. The "on" arm runs the
+            # production duty cycle — the router's alert engine
+            # observing every request, plus the cadenced snapshot
+            # publish and rule evaluation (obs/aggregate.py,
+            # obs/alerts.py); the 2% ceiling lives in
+            # bench_gate.ABSOLUTE_UPPER_BOUNDS.
+            from deepdfa_tpu.obs.aggregate import SnapshotPublisher
+            from deepdfa_tpu.obs.alerts import AlertEngine, default_rules
+
+            publisher = SnapshotPublisher(
+                fleet_dir, "bench-router",
+                slo_engines=lambda: {"router": router.slo},
+                interval_s=cfg.fleet.telemetry_interval_s,
+            )
+            alert_engine = AlertEngine(default_rules())
+            obs_reps = 3 if smoke else 5
+            obs_burst = 8 if smoke else 24
+
+            def _obs_rep(telemetry_on: bool) -> float:
+                t0 = time.perf_counter()
+                for i in range(obs_burst):
+                    status, _ = send(codes[i % len(codes)], "batch", None)
+                    assert status == 200, f"overhead rep failed: {status}"
+                    if telemetry_on:
+                        publisher.maybe_publish()
+                        router._maybe_alert()
+                return obs_burst / (time.perf_counter() - t0)
+
+            # one throwaway pair so neither arm pays first-touch costs
+            # (publisher slot files, alert-state allocation), then
+            # order-ALTERNATING pairs and the median of per-pair ratios:
+            # a single slow rep (GC pause, scheduler hiccup) shifts one
+            # ratio, not the estimate
+            ratios: list[float] = []
+            for pair in range(obs_reps + 1):
+                on_first = pair % 2 == 1
+                pair_rps = {}
+                for arm in ((True, False) if on_first else (False, True)):
+                    if arm:
+                        router.alerts = alert_engine
+                    try:
+                        pair_rps[arm] = _obs_rep(arm)
+                    finally:
+                        router.alerts = None
+                if pair > 0:  # pair 0 is the throwaway
+                    ratios.append(pair_rps[True] / pair_rps[False])
+            ratios.sort()
+            obs_overhead = max(
+                0.0, 1.0 - ratios[len(ratios) // 2]
+            )
+            alert_mttd = _measure_alert_mttd()
+
             # open-loop overload drive: Poisson arrivals at
             # overload x measured capacity, fired on schedule
             offered_rate = max(1.0, overload * warm_rps)
@@ -277,6 +372,11 @@ def bench_load(
                 "fleet_replicas": int(n_replicas),
                 "fleet_seconds": round(drive_s, 3),
                 "fleet_steady_state_recompiles": recompiles,
+                "obs_fleet_overhead_fraction": round(obs_overhead, 4),
+                "alert_mttd_s": (
+                    round(alert_mttd, 4) if alert_mttd is not None
+                    else None
+                ),
                 "serve_pipeline_depth": cfg.serve.pipeline_depth,
                 "serve_device_idle_fraction": idle_frac,
                 "shed_by_tenant": shed_by_tenant,

@@ -45,6 +45,14 @@ Modes:
                  exposition format 0.0.4, every family must carry its
                  `tag=` back-reference, and every tag must be declared
                  in SCHEMA (docs/slo.md)
+  --fleet-metrics <path>  validate an AGGREGATED fleet scrape (the
+                 router's /metrics when fleet.telemetry is on, saved to
+                 a file, or `-` for stdin —
+                 obs/aggregate.py:validate_fleet_scrape,
+                 docs/alerts.md): per-replica families must carry
+                 replica= labels, the merged latency family must
+                 include the replica="fleet" series, and every replica
+                 the scrape names must carry its staleness marker
   --postmortem <path> validate a crash flight-recorder dump
                  (postmortem.json, obs/flight.py / docs/efficiency.md):
                  format contract (version, declared trigger, bounded
@@ -202,6 +210,10 @@ def main(argv=None) -> int:
                     "(deepdfa_tpu/serve/cascade.py, docs/cascade.md)")
     ap.add_argument("--metrics", default=None,
                     help="validate a saved Prometheus /metrics scrape")
+    ap.add_argument("--fleet-metrics", default=None,
+                    help="validate an aggregated fleet /metrics scrape "
+                    "(path or `-` for stdin; "
+                    "obs/aggregate.py:validate_fleet_scrape)")
     ap.add_argument("--postmortem", default=None,
                     help="validate a dumped postmortem.json (crash "
                     "flight recorder, obs/flight.py)")
@@ -323,6 +335,28 @@ def main(argv=None) -> int:
         if not result["ok"]:
             print(
                 "postmortem validation failed:\n  "
+                + "\n  ".join(result.get("problems", [])),
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    if args.fleet_metrics:
+        from deepdfa_tpu.obs.aggregate import validate_fleet_scrape
+
+        text = (
+            sys.stdin.read() if args.fleet_metrics == "-"
+            else Path(args.fleet_metrics).read_text()
+        )
+        result = validate_fleet_scrape(text)
+        print(json.dumps(result), flush=True)
+        if args.out:
+            Path(args.out).write_text(json.dumps(result, indent=1))
+        if not result["ok"]:
+            print(
+                "fleet scrape validation failed (declare the tags in "
+                "deepdfa_tpu/obs/metrics.py:SCHEMA or fix the "
+                "aggregator in deepdfa_tpu/obs/aggregate.py):\n  "
                 + "\n  ".join(result.get("problems", [])),
                 file=sys.stderr,
             )

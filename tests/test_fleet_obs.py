@@ -1,0 +1,544 @@
+"""Fleet telemetry plane tests (ISSUE 19: deepdfa_tpu/obs/aggregate.py
++ deepdfa_tpu/obs/alerts.py; docs/alerts.md) — exact mergeable
+histograms, snapshot federation under coordination faults, cross-host
+trace stitching, and the burn-rate/drift alert engine. Pure-python over
+synthetic clocks and the FaultableBackend; the live-router end-to-end
+phase rides in via fleet/smoke.py:run_telemetry_smoke."""
+
+import json
+import random
+import time
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.fleet.coord import FaultableBackend
+from deepdfa_tpu.obs import trace as obs_trace
+from deepdfa_tpu.obs.aggregate import (
+    FixedBucketHistogram,
+    FleetAggregator,
+    SnapshotPublisher,
+    TraceShipper,
+    build_snapshot,
+    flow_chains,
+    read_trace_segments,
+    stitch_events,
+    stitch_fleet_trace,
+    validate_fleet_scrape,
+    validate_snapshot,
+)
+from deepdfa_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rules,
+    replay_fleet_log,
+    validate_alert_record,
+)
+from deepdfa_tpu.obs.slo import QUANTILES, SloEngine, percentile
+
+
+# ---------------------------------------------------------------------------
+# exact mergeable histograms
+
+
+def _sample_engines(n_engines=3, n_samples=200, seed=7):
+    rng = random.Random(seed)
+    return [
+        [rng.lognormvariate(-3.0, 1.2) for _ in range(n_samples)]
+        for _ in range(n_engines)
+    ]
+
+
+def test_histogram_merge_percentiles_exact_vs_brute_force():
+    """THE acceptance property: merging per-replica fixed-bucket
+    histograms then taking p50/p95/p99 equals (float-equal, not close)
+    the repo percentile rule applied to the union of the quantized
+    per-replica multisets."""
+    per_replica = _sample_engines()
+    hists = []
+    union: list[float] = []
+    for samples in per_replica:
+        h = FixedBucketHistogram()
+        h.observe_all(samples)
+        hists.append(h)
+        union.extend(h.expand())
+    merged = FixedBucketHistogram.merged(hists)
+    union.sort()
+    for q in QUANTILES:
+        assert merged.percentile(q) == percentile(union, q)
+    assert merged.total() == len(union)
+
+
+def test_histogram_quantization_is_bounded():
+    """The grid's representative (lower edge) never overstates a sample
+    and understates it by at most one bucket's relative width."""
+    h = FixedBucketHistogram()
+    samples = [3.7e-3, 0.25, 1.0, 599.0]
+    h.observe_all(samples)
+    expanded = sorted(h.expand())
+    assert len(expanded) == len(samples)
+    for s, e in zip(sorted(samples), expanded):
+        assert e <= s * (1 + 1e-9), "representative must not overstate"
+        # one log-bucket width: exp(ln(hi/lo)/n) ~ 3.2% relative
+        assert e >= s * 0.96, "representative within one bucket width"
+    # out-of-range samples clamp to the edge buckets, still counted
+    h2 = FixedBucketHistogram()
+    h2.observe_all([1e-9, 1e6])
+    assert h2.total() == 2
+    # in-range samples keep ~the grid's relative resolution
+    mid = 0.25
+    h2 = FixedBucketHistogram()
+    h2.observe(mid)
+    (e2,) = h2.expand()
+    assert abs(e2 - mid) / mid < 0.033
+
+
+def test_histogram_merge_rejects_grid_mismatch():
+    a = FixedBucketHistogram()
+    b = FixedBucketHistogram(n=64)
+    with pytest.raises(ValueError):
+        FixedBucketHistogram.merged([a, b])
+
+
+def test_histogram_doc_roundtrip():
+    h = FixedBucketHistogram()
+    h.observe_all([0.001, 0.01, 0.1, 1.0])
+    doc = h.to_doc()
+    json.loads(json.dumps(doc))  # JSON-safe
+    h2 = FixedBucketHistogram.from_doc(doc)
+    assert h2.expand() == h.expand()
+
+
+# ---------------------------------------------------------------------------
+# snapshot federation
+
+
+def _engine_with(n=50, seed=3):
+    rng = random.Random(seed)
+    eng = SloEngine(windows=(60.0,))
+    for _ in range(n):
+        eng.observe_request(200, rng.lognormvariate(-3.0, 1.0))
+    return eng
+
+
+def test_snapshot_builds_and_validates(tmp_path):
+    eng = _engine_with()
+    doc = build_snapshot("r0", {"primary": eng}, seq=0)
+    assert validate_snapshot(doc) == []
+    snap = doc["fleet_snapshot"]
+    assert snap["source"] == "r0"
+    assert snap["requests_total"] == 50
+    assert "anchor_unix_us" in snap and "anchor_mono_us" in snap
+
+
+def test_staleness_marked_never_dropped(tmp_path):
+    """A replica that stops publishing ages into `stale` but keeps its
+    last snapshot in the fleet view — marked, not dropped."""
+    clock = {"t": 1000.0}
+    eng = _engine_with()
+    pub = SnapshotPublisher(
+        tmp_path, "r0", slo_engines=lambda: {"primary": eng},
+        clock=lambda: clock["t"],
+    )
+    pub.publish()
+    agg = FleetAggregator(
+        tmp_path, stale_after_s=10.0, clock=lambda: clock["t"]
+    )
+    col = agg.collect()
+    assert col["replicas"]["r0"]["stale"] is False
+    clock["t"] += 60.0  # r0 goes quiet for a minute
+    col = agg.collect()
+    assert "r0" in col["replicas"], "stale replica must stay visible"
+    assert col["replicas"]["r0"]["stale"] is True
+    assert col["stale"] == ["r0"]
+    # and the scrape carries the staleness marker
+    text = agg.exposition()
+    assert 'deepdfa_fleet_replica_stale{replica="r0"' in text
+
+
+def test_torn_snapshot_write_survives_via_other_slot(tmp_path):
+    backend = FaultableBackend()
+    eng = _engine_with()
+    pub = SnapshotPublisher(
+        tmp_path, "r0", slo_engines=lambda: {"primary": eng},
+        backend=backend,
+    )
+    pub.publish()  # seq 0, slot a, clean
+    backend.set_fault("metrics-r0-*.json", torn_writes=1)
+    eng.observe_request(200, 0.5)
+    pub.publish()  # seq 1, slot b, torn
+    col = FleetAggregator(tmp_path, backend=backend).collect()
+    assert "r0" in col["replicas"]
+    assert col["replicas"]["r0"]["snapshot"]["seq"] == 0
+    assert col["problems"], "the torn slot must be reported, not hidden"
+
+
+def test_partition_served_from_cache_then_heals(tmp_path):
+    backend = FaultableBackend()
+    eng = _engine_with()
+    pub = SnapshotPublisher(
+        tmp_path, "r0", slo_engines=lambda: {"primary": eng},
+        backend=backend,
+    )
+    pub.publish()
+    agg = FleetAggregator(tmp_path, backend=backend)
+    assert "r0" in agg.collect()["replicas"]
+    backend.set_fault("metrics-*", partitioned=True)
+    col = agg.collect()
+    assert "r0" in col["replicas"], "partition must not erase the view"
+    assert col["replicas"]["r0"]["cached"] is True
+    backend.clear_faults()
+    col = agg.collect()
+    assert col["replicas"]["r0"]["cached"] is False
+
+
+def test_fleet_scrape_validates(tmp_path):
+    for rid, seed in (("r0", 1), ("r1", 2)):
+        eng = _engine_with(seed=seed)
+        SnapshotPublisher(
+            tmp_path, rid, slo_engines=lambda eng=eng: {"primary": eng}
+        ).publish()
+    agg = FleetAggregator(tmp_path)
+    text = agg.exposition()
+    report = validate_fleet_scrape(text)
+    assert report["ok"], report["problems"]
+    assert report["replicas"] == ["r0", "r1"]
+    # mutating a family name out of schema must fail the check
+    broken = text.replace("deepdfa_fleet_agg_latency_ms", "made_up_fam")
+    assert not validate_fleet_scrape(broken)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# cross-host trace stitching
+
+
+def _emit_flow(tmp_path, backend, torn=False):
+    """Router + replica tracers shipping one X-Request-Id flow chain;
+    optionally a torn write on the replica's second shipped segment."""
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir(exist_ok=True)
+    tr_router = obs_trace.Tracer(tmp_path / "tr_r", process_name="router")
+    tr_replica = obs_trace.Tracer(
+        tmp_path / "tr_p", process_name="replica-r0"
+    )
+    fid = "req-1"
+    t0 = obs_trace.Tracer.now_us()
+    tr_router.emit({
+        "name": "request", "cat": "fleet", "ph": "s", "id": fid,
+        "ts": t0,
+    })
+    t1 = obs_trace.Tracer.now_us()
+    tr_replica.emit({
+        "name": "request", "cat": "fleet", "ph": "t", "id": fid,
+        "ts": t1,
+    })
+    ship_r = TraceShipper(
+        fleet_dir, "router", backend=backend, tracer=tr_router
+    )
+    ship_p = TraceShipper(
+        fleet_dir, "r0", backend=backend, tracer=tr_replica
+    )
+    ship_r.ship()
+    ship_p.ship()  # anchor + arrival, clean
+    if torn:
+        backend.set_fault("trace-seg-r0.jsonl", torn_writes=1)
+    for i, name in enumerate(("pack", "dispatch", "fetch")):
+        tr_replica.emit({
+            "name": name, "cat": "serve", "ph": "X",
+            "ts": t1 + 10.0 * (i + 1), "dur": 8.0,
+        })
+    tr_replica.emit({
+        "name": "request", "cat": "fleet", "ph": "f", "id": fid,
+        "ts": t1 + 50.0,
+    })
+    ship_p.ship()
+    return fleet_dir, fid
+
+
+def test_stitched_flow_chain_unbroken(tmp_path):
+    backend = FaultableBackend()
+    fleet_dir, fid = _emit_flow(tmp_path, backend)
+    out = stitch_fleet_trace(
+        fleet_dir, tmp_path / "trace.json", backend=backend
+    )
+    assert fid in out["unbroken_flows"]
+    assert out["broken_flows"] == []
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = doc["traceEvents"]
+    # the two processes land on DISTINCT synthetic pids with
+    # source-prefixed names, and every non-metadata ts is on the
+    # stitched unix timebase (same clock, so ordering holds)
+    names = {
+        ev["args"]["name"] for ev in events
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    assert names == {"router:router", "r0:replica-r0"}
+    pids = {ev["pid"] for ev in events}
+    assert len(pids) == 2
+
+
+def test_stitched_flow_survives_torn_segment_write(tmp_path):
+    backend = FaultableBackend()
+    fleet_dir, fid = _emit_flow(tmp_path, backend, torn=True)
+    out = stitch_fleet_trace(
+        fleet_dir, tmp_path / "trace.json", backend=backend
+    )
+    assert fid in out["unbroken_flows"], (
+        "a torn span line must cost that span, never the flow chain"
+    )
+    segs = read_trace_segments(fleet_dir, backend=backend)
+    replica_names = [e.get("name") for e in segs["r0"]["events"]]
+    assert "pack" not in replica_names, "the torn line must be dropped"
+    assert "dispatch" in replica_names and "fetch" in replica_names
+
+
+def test_unanchored_source_flagged(tmp_path):
+    """A segment whose anchor line was lost keeps its events but is
+    reported unanchored (its clock cannot be stitched)."""
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    (fleet_dir / "trace-seg-rx.jsonl").write_text(
+        json.dumps({
+            "name": "pack", "cat": "serve", "ph": "X", "ts": 10.0,
+            "dur": 5.0, "pid": 1, "tid": 1,
+        }) + "\n"
+    )
+    segments = read_trace_segments(fleet_dir)
+    events, summary = stitch_events(segments)
+    assert summary["unanchored"] == ["rx"]
+    assert any(ev.get("name") == "pack" for ev in events)
+
+
+def test_flow_chains_census():
+    events = [
+        {"ph": "s", "id": "a", "pid": 1, "ts": 0},
+        {"ph": "t", "id": "a", "pid": 2, "ts": 1},
+        {"ph": "f", "id": "a", "pid": 2, "ts": 2},
+        {"ph": "s", "id": "b", "pid": 1, "ts": 0},  # never arrives
+    ]
+    chains = flow_chains(events)
+    assert chains["a"]["unbroken"] is True
+    assert chains["b"]["unbroken"] is False
+
+
+# ---------------------------------------------------------------------------
+# alert engine
+
+
+def test_burn_rate_fires_on_burst_and_resolves():
+    """Multi-window burn rate with an explicit clock: both windows must
+    burn for the rule to fire, and clean traffic drains the fast window
+    back under budget."""
+    rule = AlertRule(
+        name="burn", kind="burn_rate", threshold=1.0,
+        windows=(60.0, 300.0), params={"budget": 0.01, "min_count": 5},
+    )
+    eng = AlertEngine([rule])
+    t = 1000.0
+    for _ in range(100):
+        eng.observe_request(200, now=t)
+    assert eng.evaluate(now=t) == []  # healthy
+    for _ in range(50):
+        eng.observe_request(500, now=t + 10.0)
+    recs = eng.evaluate(now=t + 11.0)
+    states = [r["alert"]["state"] for r in recs]
+    assert states == ["pending", "firing"]  # for_s=0: same tick
+    for r in recs:
+        assert validate_alert_record(r) == []
+    # 400s later the slow window still "remembers" nothing (evicted) —
+    # and either way the min-of-windows observed burn is below threshold
+    recs = eng.evaluate(now=t + 411.0)
+    assert [r["alert"]["state"] for r in recs] == ["resolved"]
+    assert eng.firing() == []
+
+
+def test_burn_rate_sub_second_windows_hold_their_counts():
+    """Regression: sub-second windows must count exactly (the SLO
+    engine's per-second bucketing would evict the live second partway
+    through — obs/alerts.py keeps exact event timestamps below 5 s)."""
+    rule = AlertRule(
+        name="fast", kind="burn_rate", threshold=1.0,
+        windows=(0.5, 1.5), params={"budget": 0.05, "min_count": 3},
+    )
+    eng = AlertEngine([rule])
+    t = 123.9  # fractional part past the horizon: the old failure mode
+    for _ in range(10):
+        eng.observe_request(500, now=t)
+    recs = eng.evaluate(now=t + 0.05)
+    assert [r["alert"]["state"] for r in recs] == ["pending", "firing"]
+
+
+def test_burn_rate_slow_window_guards_stale_blip():
+    """An error burst that already aged out of the fast window must not
+    fire, even while the slow window still contains it: min-of-windows
+    is what distinguishes an incident from a memory."""
+    rule = AlertRule(
+        name="burn", kind="burn_rate", threshold=1.0,
+        windows=(60.0, 300.0), params={"budget": 0.01, "min_count": 5},
+    )
+    eng = AlertEngine([rule])
+    t = 1000.0
+    for _ in range(50):
+        eng.observe_request(500, now=t)
+    for _ in range(50):
+        eng.observe_request(200, now=t + 100.0)
+    assert eng.evaluate(now=t + 100.0) == []
+    assert eng.firing() == []
+
+
+def test_drift_alert_on_injected_calibration_shift():
+    """The PR-12 reuse: per-tenant calibrated in-band fraction drifts
+    past target -> firing; the shift healing -> resolved. Probabilities
+    go through the same temperature_scale/in_band helpers calibrate.py
+    serves with."""
+    pytest.importorskip("numpy")
+    rule = AlertRule(
+        name="acme_drift", kind="drift", threshold=0.2,
+        windows=(30.0,),
+        params={
+            "tenant": "acme", "temperature": 1.0,
+            "band": (0.4, 0.6), "target": 0.1, "min_samples": 10,
+        },
+    )
+    eng = AlertEngine([rule])
+    t = 500.0
+    # healthy: ~10% of probs in the uncertainty band
+    for i in range(40):
+        prob = 0.5 if i % 10 == 0 else 0.9
+        eng.observe_request(200, tenant="acme", prob=prob, now=t)
+    assert eng.evaluate(now=t + 1.0) == []
+    # injected shift: everything collapses into the band
+    for _ in range(40):
+        eng.observe_request(200, tenant="acme", prob=0.5, now=t + 2.0)
+    recs = eng.evaluate(now=t + 3.0)
+    assert [r["alert"]["state"] for r in recs] == ["pending", "firing"]
+    assert recs[-1]["alert"]["tenant"] == "acme"
+    for r in recs:
+        assert validate_alert_record(r) == []
+    # the window forgets the shift -> healthy mix again -> resolved
+    t2 = t + 40.0
+    for i in range(40):
+        prob = 0.5 if i % 10 == 0 else 0.9
+        eng.observe_request(200, tenant="acme", prob=prob, now=t2)
+    recs = eng.evaluate(now=t2 + 1.0)
+    assert [r["alert"]["state"] for r in recs] == ["resolved"]
+
+
+def test_other_tenant_probs_do_not_feed_drift():
+    rule = AlertRule(
+        name="acme_drift", kind="drift", threshold=0.2,
+        windows=(30.0,),
+        params={
+            "tenant": "acme", "temperature": 1.0,
+            "band": (0.4, 0.6), "target": 0.1, "min_samples": 10,
+        },
+    )
+    eng = AlertEngine([rule])
+    for _ in range(40):
+        eng.observe_request(200, tenant="other", prob=0.5, now=100.0)
+    assert eng.evaluate(now=101.0) == []
+
+
+def test_for_s_requires_sustained_condition():
+    rule = AlertRule(
+        name="burn", kind="burn_rate", threshold=1.0, for_s=5.0,
+        windows=(60.0,), params={"budget": 0.01, "min_count": 1},
+    )
+    eng = AlertEngine([rule])
+    t = 0.0
+    eng.observe_request(500, now=t)
+    recs = eng.evaluate(now=t + 1.0)
+    assert [r["alert"]["state"] for r in recs] == ["pending"]
+    recs = eng.evaluate(now=t + 3.0)
+    assert recs == []  # still pending, not yet for_s
+    recs = eng.evaluate(now=t + 7.0)
+    assert [r["alert"]["state"] for r in recs] == ["firing"]
+
+
+def test_default_rules_cover_issue_catalog():
+    kinds = {r.kind for r in default_rules()}
+    names = {r.name for r in default_rules()}
+    assert "burn_rate" in kinds
+    assert {"coord_backend_faults", "coord_poll_exhausted",
+            "autoscale_saturated"} <= names
+
+
+def test_alert_records_schema_valid_and_fleet_log_grows(tmp_path):
+    from deepdfa_tpu.fleet.router import FleetLog, validate_fleet_log
+
+    log_path = tmp_path / "fleet_log.jsonl"
+    log = FleetLog(log_path)
+    rule = AlertRule(
+        name="burn", kind="burn_rate", threshold=1.0,
+        windows=(60.0,), params={"budget": 0.01, "min_count": 1},
+    )
+    eng = AlertEngine([rule], sink=log.append)
+    eng.observe_request(500, now=10.0)
+    eng.evaluate(now=11.0)
+    log.close()
+    report = validate_fleet_log(log_path)
+    assert report["ok"], report["problems"]
+    assert report["alerts"] == 2  # pending + firing
+    # a malformed alert record must fail validation
+    with log_path.open("a") as f:
+        f.write(json.dumps({"alert": {"rule": "x"}}) + "\n")
+    report = validate_fleet_log(log_path)
+    assert not report["ok"]
+
+
+def test_replay_fleet_log_detects_recorded_burst(tmp_path):
+    from deepdfa_tpu.fleet.router import FleetLog
+
+    log_path = tmp_path / "fleet_log.jsonl"
+    log = FleetLog(log_path)
+    t = 1000.0
+    for i in range(30):
+        log.append({
+            "request": {
+                "id": f"ok-{i}", "status": 200, "latency_ms": 5.0,
+                "t_unix": t + i * 0.01,
+            }
+        })
+    for i in range(30):
+        log.append({
+            "request": {
+                "id": f"err-{i}", "status": 500, "latency_ms": 5.0,
+                "t_unix": t + 1.0 + i * 0.01,
+            }
+        })
+    log.close()
+    out = replay_fleet_log(log_path, rules=[AlertRule(
+        name="burn", kind="burn_rate", threshold=1.0,
+        windows=(60.0,), params={"budget": 0.01, "min_count": 5},
+    )])
+    assert out["records"] == 60
+    assert out["fired"] == ["burn"]
+    for rec in out["transitions"]:
+        assert validate_alert_record(rec) == []
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end phase (live router, real scrape, real fleet log)
+
+
+def test_telemetry_smoke_phase(tmp_path):
+    from deepdfa_tpu.fleet.smoke import run_telemetry_smoke
+
+    t0 = time.monotonic()
+    out = run_telemetry_smoke(tmp_path)
+    wall = time.monotonic() - t0
+    assert out["ok"], out
+    assert out["merged_p99_exact"], out
+    assert out["trace"]["unbroken_flow"], out
+    assert out["alerts"]["burn_fired_resolved"], out
+    assert out["alerts"]["drift_fired_resolved"], out
+    assert wall < 60.0, f"telemetry phase took {wall:.1f}s"
+
+
+def test_smoke_verdict_flags_telemetry_failures():
+    from deepdfa_tpu.fleet.smoke import smoke_verdict
+
+    bad = smoke_verdict({})
+    assert any("histogram merge must be exact" in b for b in bad)
+    assert any("flow chain" in b for b in bad)
+    assert any("burn-rate or drift" in b for b in bad)
