@@ -25,6 +25,10 @@ Reference entry points consolidated here (DDFA/scripts/*.sh -> LightningCLI
             rendezvous; standby takes over within the failover window)
   fleet-rollout  zero-downtime checkpoint rollout across the fleet
             (drift-gated, SLO-guarded, halt + rollback on breach)
+  flywheel  data-flywheel controller: watch a candidate's shadow-ride
+            comparison records and auto-promote it through the
+            fleet-rollout gates (or demote it); --retrain builds the
+            candidate from the traffic log (docs/flywheel.md)
 
 Config comes from --config (json) plus dotted key=value overrides, e.g.
   python -m deepdfa_tpu.cli train data.batch.graphs_per_batch=128
@@ -2252,7 +2256,7 @@ def cmd_fleet_replica(args) -> None:
     worker = ReplicaWorker(
         cfg, run_dir, args.replica_id,
         fleet_dir=args.fleet_dir, host=args.host, port=args.port,
-        family=args.family,
+        family=args.family, shadow=getattr(args, "shadow", False),
     )
     # per-replica obs home: traces + postmortem never collide across
     # replicas sharing one run dir
@@ -2352,6 +2356,55 @@ def cmd_fleet_rollout(args) -> None:
     )
     print(json.dumps(report), flush=True)
     if not report.get("ok") or not report.get("census_ok"):
+        raise SystemExit(1)
+
+
+def cmd_flywheel(args) -> None:
+    """Data-flywheel controller (docs/flywheel.md): watch the shadow
+    comparison records a candidate's ride leaves in fleet_log.jsonl
+    and act on the verdict — a candidate beating the incumbent past
+    the configured margin for long enough is promoted through the
+    SAME drift-gated, SLO-guarded `fleet-rollout` path a human would
+    run; a trailing or drifting one is demoted with a schema-valid
+    record. `--retrain` instead replays the serve log into a
+    traffic-weighted calibration set and builds the candidate run dir
+    the shadow replica serves. Exit 0 only when the decided action
+    completed cleanly (a promote whose rollout halted exits 1)."""
+    from deepdfa_tpu.flywheel import promote as flywheel_promote
+
+    cfg, run_dir, fleet_dir = _resolve_fleet_run(args)
+    log_path = run_dir / "fleet_log.jsonl"
+    if args.retrain:
+        from deepdfa_tpu.flywheel import retrain as flywheel_retrain
+
+        out_dir = Path(args.out) if args.out else run_dir / "candidate"
+        report = flywheel_retrain.build_candidate(
+            cfg, run_dir, out_dir,
+            Path(args.log) if args.log else log_path,
+            steps=args.steps, max_examples=args.max_examples,
+        )
+        print(json.dumps(report), flush=True)
+        return
+    if not args.candidate:
+        raise SystemExit("--candidate is required (the checkpoint tag "
+                         "riding shadow) unless --retrain")
+    router_addr = None
+    if args.router:
+        host, _, port = args.router.rpartition(":")
+        router_addr = (host or "127.0.0.1", int(port))
+    if args.watch:
+        report = flywheel_promote.watch(
+            cfg, fleet_dir, args.candidate, log_path,
+            interval_s=args.interval, timeout_s=args.timeout,
+            router_addr=router_addr,
+        )
+    else:
+        report = flywheel_promote.run_promotion(
+            cfg, fleet_dir, args.candidate, log_path,
+            router_addr=router_addr,
+        )
+    print(json.dumps(report), flush=True)
+    if report.get("reason") == "rollout_halted":
         raise SystemExit(1)
 
 
@@ -2864,6 +2917,12 @@ def main(argv=None) -> None:
     p.add_argument("--port", type=int, default=0,
                    help="0 = ephemeral (published via heartbeat)")
     p.add_argument("--family", default="deepdfa", choices=["deepdfa"])
+    p.add_argument("--shadow", action="store_true",
+                   help="flywheel shadow role (docs/flywheel.md): "
+                        "heartbeat carries shadow=true so the router "
+                        "never routes live traffic here and rollouts "
+                        "skip it; /score still answers for the mirror "
+                        "stream")
     p.add_argument("--override", action="append", default=[],
                    dest="overrides",
                    help="dotted key=value config override (repeatable)")
@@ -2924,6 +2983,58 @@ def main(argv=None) -> None:
                    dest="overrides",
                    help="dotted key=value config override (repeatable)")
     p.set_defaults(fn=cmd_fleet_rollout)
+
+    p = sub.add_parser(
+        "flywheel",
+        help="data-flywheel controller: watch a candidate's shadow-ride "
+        "records in fleet_log.jsonl and promote it through the "
+        "drift-gated fleet-rollout path when it beats the incumbent "
+        "past fleet.flywheel_promote_margin (demote when trailing or "
+        "drifting); --retrain builds the candidate run dir from the "
+        "traffic-weighted serve log (docs/flywheel.md)",
+    )
+    p.add_argument("--run-dir", required=True,
+                   help="run directory (or run name under storage/runs)")
+    p.add_argument("--candidate", default=None,
+                   help="checkpoint tag riding shadow (the tag "
+                        "fleet-rollout swaps to on promotion)")
+    p.add_argument("--watch", action="store_true",
+                   help="poll until the verdict leaves 'hold' (or "
+                        "--timeout expires, which demotes); default: "
+                        "decide once and exit")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch poll cadence, seconds")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="--watch deadline: a candidate still on 'hold' "
+                        "is demoted (insufficient evidence is a no)")
+    p.add_argument("--router", default=None, metavar="HOST:PORT",
+                   help="router address for the rollout's SLO guard "
+                        "(default: resolved from router.json)")
+    p.add_argument("--retrain", action="store_true",
+                   help="build the candidate instead: replay the fleet "
+                        "log into a traffic-weighted calibration set "
+                        "and write a servable candidate run dir")
+    p.add_argument("--log", default=None,
+                   help="--retrain: fleet/serve log to weight from "
+                        "(default <run_dir>/fleet_log.jsonl)")
+    p.add_argument("--out", default=None,
+                   help="--retrain: candidate run dir to write "
+                        "(default <run_dir>/candidate)")
+    p.add_argument("--steps", type=int, default=0,
+                   help="--retrain: fine-tune steps on the weighted "
+                        "set (0 = calibration-only warm start)")
+    p.add_argument("--max-examples", type=int, default=512,
+                   help="--retrain: weighted-selection budget")
+    p.add_argument("--fleet-dir", default=None,
+                   help="heartbeat/rendezvous dir (default "
+                        "<run_dir>/fleet)")
+    p.add_argument("--config", default=None,
+                   help="json config file (default: the run dir's saved "
+                        "config.json)")
+    p.add_argument("--override", action="append", default=[],
+                   dest="overrides",
+                   help="dotted key=value config override (repeatable)")
+    p.set_defaults(fn=cmd_flywheel)
 
     p = sub.add_parser(
         "fleet-drill",

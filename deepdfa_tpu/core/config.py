@@ -650,6 +650,34 @@ class FleetConfig:
     # JSON list overlaying the default rule catalog (replace by name,
     # {"disable": true} to remove, new names append) — docs/alerts.md
     alert_rules: str = ""
+    # -- data flywheel (deepdfa_tpu/flywheel/, docs/flywheel.md;
+    # default OFF so the default fleet path stays byte-identical)
+    # master switch: the router mirrors a bounded sample of admitted
+    # requests through the coord backend for a shadow candidate to score
+    flywheel: bool = False
+    # fraction of admitted 200s mirrored to the shadow (deterministic
+    # every-kth sampling, k = round(1/rate) — no per-request RNG on the
+    # serving path)
+    flywheel_sample_rate: float = 0.25
+    # unscored mirrored samples the sampler tolerates before it DROPS
+    # new ones (counted under shadow/dropped) — backpressure, never a
+    # queue that grows while the shadow falls behind
+    flywheel_max_inflight: int = 64
+    # scored comparisons required before promote/demote may trigger
+    flywheel_min_samples: int = 50
+    # rolling comparison window the {"shadow": ...} records summarize
+    flywheel_window: int = 64
+    # the promotion bound: candidate AUC (over labeled samples) must
+    # beat the incumbent's by at least this margin
+    flywheel_promote_margin: float = 0.02
+    # the demotion bound: a candidate trailing the incumbent by this
+    # margin (or drifting past flywheel_drift_bound) is demoted with a
+    # {"demotion": ...} record instead of ever touching traffic
+    flywheel_demote_margin: float = 0.05
+    # max mean |P_candidate - P_incumbent| over the shadow window before
+    # the ride is judged calibration-drifted (pre-promotion gate; the
+    # rollout's own rollout_drift_bound still applies at swap time)
+    flywheel_drift_bound: float = 0.25
 
 
 @dataclass(frozen=True)

@@ -114,14 +114,23 @@ class StubRegistry:
     `fleet --smoke` and the subprocess scenarios)."""
 
     family = "deepdfa"
-    checkpoint = "init"
 
-    def __init__(self, cfg, model, params, vocabs, run_dir):
+    def __init__(self, cfg, model, params, vocabs, run_dir,
+                 checkpoints=None, flywheel_tag: str = "incumbent"):
         self.cfg = cfg
         self._model = model
         self._params = params
         self.vocabs = vocabs
         self.run_dir = Path(run_dir)
+        self.checkpoint = "init"
+        #: swappable named param sets for the rollout/flywheel smokes:
+        #: {name: (params, injected_drift)} — the injected drift is
+        #: what swap_checkpoint reports, so a "bad candidate" stub
+        #: trips the real drift gate without a real calibration stream
+        self.checkpoints: dict = dict(checkpoints or {})
+        self.flywheel_tag = str(flywheel_tag)
+        self.hot_swaps = 0
+        self._prev: tuple[str, object] | None = None
 
     @property
     def model(self):
@@ -138,26 +147,74 @@ class StubRegistry:
     def maybe_reload(self) -> bool:
         return False
 
-    def info(self) -> dict:
+    def swap_checkpoint(self, checkpoint: str, drift_bound=None) -> dict:
+        """The ModelRegistry swap contract over the stub's named param
+        sets (same refusal semantics: RegistryError on unknown tag or
+        drift past bound, prior params stashed for rollback) — so
+        run_rollout drives the stub fleet through the identical
+        drain/swap/refuse/rollback protocol it drives production
+        through."""
+        from deepdfa_tpu.serve.registry import RegistryError
+
+        if checkpoint not in self.checkpoints:
+            raise RegistryError(
+                f"unknown stub checkpoint {checkpoint!r}; "
+                f"known: {sorted(self.checkpoints)}"
+            )
+        params, drift = self.checkpoints[checkpoint]
+        if drift_bound is not None and drift > float(drift_bound):
+            raise RegistryError(
+                f"calibration drift {drift:.3f} exceeds bound "
+                f"{float(drift_bound):.3f}; swap refused"
+            )
+        self._prev = (self.checkpoint, self._params)
+        self.checkpoint = str(checkpoint)
+        self._params = params
+        self.hot_swaps += 1
         return {
+            "checkpoint": self.checkpoint,
+            "checkpoint_step": self.hot_swaps,
+            "previous": self._prev[0],
+            "drift": float(drift),
+        }
+
+    def rollback(self) -> dict | None:
+        if self._prev is None:
+            return None
+        rolled_from = self.checkpoint
+        self.checkpoint, self._params = self._prev
+        self._prev = None
+        return {
+            "checkpoint": self.checkpoint,
+            "checkpoint_step": self.hot_swaps,
+            "rolled_back_from": rolled_from,
+        }
+
+    def info(self) -> dict:
+        out = {
             "family": self.family,
             "run_dir": str(self.run_dir),
             "checkpoint": self.checkpoint,
-            "checkpoint_step": 0,
+            "checkpoint_step": self.hot_swaps,
             "config_digest": "stub",
             "vocab_digest": "stub",
-            "hot_swaps": 0,
+            "hot_swaps": self.hot_swaps,
         }
+        if self.flywheel_tag != "incumbent":
+            out["flywheel_tag"] = self.flywheel_tag
+        return out
 
 
 def stub_service(cfg, fleet_dir: Path, replica_id: str, model=None,
-                 params=None, vocabs=None):
+                 params=None, vocabs=None, checkpoints=None,
+                 flywheel_tag: str = "incumbent"):
     """One real ScoringService over a StubRegistry (shared model/params
     so N replicas warm N identical ladders without N model inits)."""
     from deepdfa_tpu.serve.server import ScoringService
 
     registry = StubRegistry(
-        cfg, model, params, vocabs, Path(fleet_dir) / replica_id
+        cfg, model, params, vocabs, Path(fleet_dir) / replica_id,
+        checkpoints=checkpoints, flywheel_tag=flywheel_tag,
     )
     return ScoringService(registry, cfg)
 
@@ -193,7 +250,7 @@ class StubReplicaServer:
     these (no subprocess, no checkpoint; <60 s)."""
 
     def __init__(self, cfg, fleet_dir, replica_id: str, service,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", shadow: bool = False):
         from http.server import ThreadingHTTPServer
 
         from deepdfa_tpu.serve import server as serve_server
@@ -202,8 +259,13 @@ class StubReplicaServer:
         self.fleet_dir = Path(fleet_dir)
         self.replica_id = str(replica_id)
         self.service = service
+        #: flywheel shadow role — mirrored into the heartbeat info so
+        #: the router's routable() and run_rollout's replica selection
+        #: exclude this stub exactly as they would a real shadow
+        self.shadow = bool(shadow)
         self.chaos = ChaosState()
         chaos = self.chaos
+        server = self
 
         class _ChaosHandler(serve_server._Handler):
             service = self.service
@@ -217,6 +279,9 @@ class StubReplicaServer:
                 serve_server._Handler.do_GET(handler)
 
             def do_POST(handler):  # noqa: N802, N805
+                if handler.path == "/admin/rollout":
+                    server._handle_rollout(handler)
+                    return
                 chaos.delay()
                 serve_server._Handler.do_POST(handler)
 
@@ -234,16 +299,78 @@ class StubReplicaServer:
     def beat(self, state: str = "ready") -> None:
         from deepdfa_tpu.fleet import heartbeat
 
+        info = {
+            "steady_state_recompiles": (
+                self.service.steady_state_recompiles()
+            ),
+            "jit_lowerings": self.service._jit_lowerings(),
+        }
+        if self.shadow:
+            info["shadow"] = True
         heartbeat.write_heartbeat(
             self.fleet_dir, self.replica_id, self.host, self.port,
-            state=state,
-            info={
-                "steady_state_recompiles": (
-                    self.service.steady_state_recompiles()
-                ),
-                "jit_lowerings": self.service._jit_lowerings(),
-            },
+            state=state, info=info,
         )
+
+    def _handle_rollout(self, handler) -> None:
+        """POST /admin/rollout against the stub: the real replica's
+        response contract (200 swap report / 409 refusal / rollback)
+        over StubRegistry.swap_checkpoint, with the heartbeat riding
+        through draining -> ready — enough for run_rollout to drive a
+        stub fleet through its full gate sequence in tier-1."""
+        import json as _json
+
+        from deepdfa_tpu.serve.registry import RegistryError
+
+        try:
+            n = int(handler.headers.get("Content-Length", 0))
+            payload = _json.loads(handler.rfile.read(n) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            handler._reply(400, {"error": f"bad request: {e}"})
+            return
+        registry = self.service.registry
+        self.beat("draining")
+        try:
+            if payload.get("rollback"):
+                out = registry.rollback()
+                if out is None:
+                    raise RegistryError(
+                        "nothing to roll back to on this stub"
+                    )
+            else:
+                checkpoint = payload.get("checkpoint")
+                if not checkpoint:
+                    handler._reply(400, {
+                        "error": "rollout needs a checkpoint tag "
+                                 "(or rollback: true)",
+                    })
+                    return
+                drift_bound = payload.get("drift_bound")
+                out = registry.swap_checkpoint(
+                    checkpoint,
+                    drift_bound=(
+                        float(drift_bound) if drift_bound is not None
+                        else None
+                    ),
+                )
+        except RegistryError as e:
+            handler._reply(409, {
+                "ok": False, "refused": True, "error": str(e),
+                "replica_id": self.replica_id,
+            })
+            return
+        finally:
+            self.beat("ready")
+        out.update(
+            ok=True, drained=True, recompiles=0,
+            steady_state_recompiles=(
+                self.service.steady_state_recompiles()
+            ),
+            replica_id=self.replica_id,
+        )
+        handler._reply(200, out)
 
     def corrupt_heartbeat(self, text: str = '{"heartbeat": {"state": "zombie"') -> Path:
         """Overwrite this replica's announcement with damage (NON-atomic

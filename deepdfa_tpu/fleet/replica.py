@@ -180,8 +180,15 @@ class ReplicaWorker:
         host: str = "127.0.0.1",
         port: int = 0,
         family: str = "deepdfa",
+        shadow: bool = False,
     ):
         self.cfg = cfg
+        #: flywheel shadow role (docs/flywheel.md): advertised as an
+        #: info field on every heartbeat — NOT a lifecycle state — so
+        #: the router's ReplicaView excludes this replica from routing
+        #: and run_rollout never swaps it, while /score still answers
+        #: for the shadow scorer's mirrored sample stream
+        self.shadow = bool(shadow)
         self.run_dir = Path(run_dir)
         self.replica_id = str(replica_id)
         self.fleet_dir = Path(
@@ -240,6 +247,10 @@ class ReplicaWorker:
         registry = ModelRegistry(
             run_dir, family=family, checkpoint=checkpoint, cfg=cfg,
             mesh=serve_mesh(self.cfg),
+            # the role tag rides /healthz (registry.info) so operators
+            # and the diag flywheel section can tell which process is
+            # the candidate without cross-referencing heartbeats
+            flywheel_tag="candidate" if self.shadow else "incumbent",
         )
         nbytes = param_bytes(registry.params())
         service = ScoringService(registry, registry.cfg)
@@ -320,6 +331,10 @@ class ReplicaWorker:
             "coserve_refused": list(self.coserve_refused),
             "hbm_budget_bytes": float(self.cfg.fleet.hbm_budget_bytes),
         }
+        if self.shadow:
+            # only present on shadow rides — absent from every default
+            # heartbeat so the flywheel-off envelope is byte-identical
+            info["shadow"] = True
         if primary is not None:
             reg = primary.registry.info()
             info.update(
@@ -799,6 +814,7 @@ def replica_command(
     overrides: list[str] | None = None,
     host: str = "127.0.0.1",
     port: int = 0,
+    shadow: bool = False,
 ) -> list[str]:
     """argv for one replica subprocess (the `fleet-replica` CLI)."""
     import sys
@@ -810,6 +826,8 @@ def replica_command(
         "--fleet-dir", str(fleet_dir),
         "--host", host, "--port", str(port),
     ]
+    if shadow:
+        cmd.append("--shadow")
     for ov in overrides or []:
         cmd += ["--override", ov]
     return cmd

@@ -136,6 +136,10 @@ def _ready_replicas(
         rid: hb for rid, hb in sorted(beats.items())
         if hb.get("state") == heartbeat.READY
         and heartbeat.is_fresh(hb, timeout_s)
+        # a shadow replica (flywheel ride, docs/flywheel.md) already IS
+        # the candidate — swapping it would score the comparison stream
+        # against itself and defeat the ride
+        and not hb.get("shadow")
     }
 
 

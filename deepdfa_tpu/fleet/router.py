@@ -74,6 +74,19 @@ AUTOSCALE_ACTIONS = (
     "scale_down", "relax",
 )
 
+#: the declared shadow-ride vocabulary (deepdfa_tpu/flywheel/shadow.py
+#: appends {"shadow": {...}} windowed candidate-vs-incumbent comparison
+#: records to the same fleet_log; docs/flywheel.md)
+SHADOW_EVENTS = ("ride_start", "window", "ride_end")
+
+#: the declared demotion-reason vocabulary ({"demotion": {...}} records,
+#: deepdfa_tpu/flywheel/promote.py): a losing or drifting candidate is
+#: demoted on the record, never promoted to traffic
+DEMOTION_REASONS = (
+    "trailing", "drift", "alert", "unlabeled", "insufficient_samples",
+    "rollout_halted", "manual",
+)
+
 #: nominal in-flight forwards one routable replica absorbs before the
 #: router's queue_ratio alert signal reads saturated (replicas don't
 #: advertise a queue bound in their heartbeat, so the saturation gauge
@@ -124,7 +137,7 @@ class ReplicaView:
     __slots__ = (
         "id", "host", "port", "state", "t_heartbeat", "info",
         "outstanding", "ejected", "consecutive_failures", "forwarded",
-        "drain_logged", "quarantined",
+        "drain_logged", "quarantined", "shadow",
     )
 
     def __init__(self, hb: dict):
@@ -146,11 +159,16 @@ class ReplicaView:
             k: v for k, v in hb.items()
             if k not in ("replica_id", "host", "port", "state", "t_unix")
         }
+        # a shadow-role replica (docs/flywheel.md) announces itself via
+        # the `shadow` heartbeat info field — not a new lifecycle state,
+        # so every pre-flywheel reader keeps validating the heartbeat
+        self.shadow = bool(self.info.get("shadow"))
 
     def routable(self, timeout_s: float, now: float) -> bool:
         return (
             not self.ejected
             and not self.quarantined
+            and not self.shadow
             and self.state == heartbeat.READY
             and (now - self.t_heartbeat) <= timeout_s
         )
@@ -165,6 +183,7 @@ class ReplicaView:
             "ejected": self.ejected,
             "quarantined": self.quarantined,
             "routable": self.routable(timeout_s, now),
+            "shadow": self.shadow,
             "heartbeat_age_s": round(now - self.t_heartbeat, 3),
             "steady_state_recompiles": self.info.get(
                 "steady_state_recompiles"
@@ -233,6 +252,10 @@ class Router:
         self.trace_shipper = None
         #: alert engine (obs/alerts.py) — wired when fleet.alerts is on
         self.alerts = None
+        #: shadow-ride sampler (flywheel/shadow.py:ShadowSampler) —
+        #: wired on by router_from_config when fleet.flywheel is set;
+        #: None keeps the default path byte-identical
+        self.flywheel = None
         self.alert_interval_s = 1.0
         self._last_alert = 0.0
         self._last_summary = time.monotonic()
@@ -704,6 +727,12 @@ class Router:
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=5)
             self._poll_thread = None
+        if self.flywheel is not None:
+            try:
+                self.flywheel.close()
+            except Exception:
+                logger.exception("shadow sampler close failed")
+            self.flywheel = None
         if self.trace_shipper is not None:
             try:
                 self.trace_shipper.close()
@@ -811,6 +840,20 @@ def router_from_config(
             sink=(router.log.append if router.log is not None else None),
         )
         router.alerts = engine
+    if fcfg.flywheel:
+        # the data flywheel's shadow sampler (flywheel/shadow.py,
+        # docs/flywheel.md): mirror a bounded sample of admitted
+        # requests through the coord backend for the shadow candidate.
+        # Imported lazily so the default (flywheel off) path never
+        # loads the subsystem.
+        from deepdfa_tpu.flywheel import shadow as flywheel_shadow
+
+        router.flywheel = flywheel_shadow.ShadowSampler(
+            fleet_dir,
+            sample_rate=fcfg.flywheel_sample_rate,
+            max_inflight=fcfg.flywheel_max_inflight,
+            backend=backend,
+        )
     return router
 
 
@@ -953,10 +996,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(503, {"error": str(e), "request_id": rid})
             return
         prob = None
-        if router.alerts is not None and status == 200:
-            # the drift watch needs the replica's calibrated score; the
-            # parse is gated on the engine so the default path never
-            # decodes response bodies it would otherwise just relay
+        if (
+            router.alerts is not None or router.flywheel is not None
+        ) and status == 200:
+            # the drift watch and the shadow sampler need the replica's
+            # calibrated score; the parse is gated on both consumers so
+            # the default path never decodes response bodies it would
+            # otherwise just relay
             try:
                 scored = json.loads(data)
                 if isinstance(scored, dict):
@@ -971,6 +1017,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
             replica=replica, retries=retries, deadline_ms=deadline_ms,
             prob=prob,
         )
+        if router.flywheel is not None and status == 200:
+            # mirror-sample the request for the shadow candidate
+            # (flywheel/shadow.py): deterministic every-kth, bounded by
+            # the scorer's acknowledged progress — never blocks, never
+            # changes the reply
+            router.flywheel.observe(
+                rid, payload, prob, tenant=decision.tenant,
+            )
         self._reply_raw(status, data)
 
 
@@ -1035,17 +1089,22 @@ class BackgroundRouter:
 def validate_fleet_log(path: str | Path) -> dict:
     """Structural + schema validation of a router fleet_log.jsonl.
 
-    Five legal line shapes: {"request": {...}} per-request entries
+    The legal line shapes: {"request": {...}} per-request entries
     (id + status required), {"fleet_event": {...}} lifecycle events
     (declared name + t_unix required, incl. the HA takeover/stepdown and
     quarantine transitions), {"rollout": {...}} rollout records
     (fleet/rollout.py; declared event + t_unix + checkpoint required),
     {"autoscale": {...}} autoscaling decisions (fleet/autoscale.py;
-    declared action + t_unix required), and summary records embedding
-    the fleet/* registry snapshot + fleet_slo windows + the admission
-    re-seed snapshot. Every flattened scalar tag must be declared in
-    obs/metrics.py:SCHEMA — the same drift guard the train/serve/scan
-    logs get."""
+    declared action + t_unix required), the data-flywheel records
+    (docs/flywheel.md): {"shadow": {...}} windowed candidate-vs-
+    incumbent comparisons (declared event + t_unix + candidate
+    required), {"promotion": {...}} auto-promotions (candidate +
+    t_unix required), {"demotion": {...}} refused candidates (declared
+    reason + candidate + t_unix required), and summary records
+    embedding the fleet/* registry snapshot + fleet_slo windows + the
+    admission re-seed snapshot. Every flattened scalar tag must be
+    declared in obs/metrics.py:SCHEMA — the same drift guard the
+    train/serve/scan logs get."""
     path = Path(path)
     problems: list[str] = []
     records: list[dict] = []
@@ -1054,7 +1113,7 @@ def validate_fleet_log(path: str | Path) -> dict:
     except OSError as e:
         return {"ok": False, "problems": [f"unreadable: {e}"]}
     n_requests = n_events = n_summaries = n_rollouts = n_autoscale = 0
-    n_alerts = 0
+    n_alerts = n_shadow = n_promotions = n_demotions = 0
     for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
@@ -1126,6 +1185,46 @@ def validate_fleet_log(path: str | Path) -> dict:
 
             for p in validate_alert_record(rec):
                 problems.append(f"line {lineno}: {p}")
+        elif "shadow" in rec:
+            n_shadow += 1
+            sh = rec["shadow"]
+            if not isinstance(sh, dict):
+                problems.append(f"line {lineno}: shadow not an object")
+            elif sh.get("event") not in SHADOW_EVENTS:
+                problems.append(
+                    f"line {lineno}: shadow event {sh.get('event')!r} "
+                    f"not in declared set {SHADOW_EVENTS}"
+                )
+            elif "t_unix" not in sh or "candidate" not in sh:
+                problems.append(
+                    f"line {lineno}: shadow record missing "
+                    f"t_unix/candidate"
+                )
+        elif "promotion" in rec:
+            n_promotions += 1
+            pr = rec["promotion"]
+            if not isinstance(pr, dict):
+                problems.append(f"line {lineno}: promotion not an object")
+            elif "t_unix" not in pr or "candidate" not in pr:
+                problems.append(
+                    f"line {lineno}: promotion record missing "
+                    f"t_unix/candidate"
+                )
+        elif "demotion" in rec:
+            n_demotions += 1
+            dm = rec["demotion"]
+            if not isinstance(dm, dict):
+                problems.append(f"line {lineno}: demotion not an object")
+            elif dm.get("reason") not in DEMOTION_REASONS:
+                problems.append(
+                    f"line {lineno}: demotion reason {dm.get('reason')!r} "
+                    f"not in declared set {DEMOTION_REASONS}"
+                )
+            elif "t_unix" not in dm or "candidate" not in dm:
+                problems.append(
+                    f"line {lineno}: demotion record missing "
+                    f"t_unix/candidate"
+                )
         elif "fleet" in rec or "fleet_slo" in rec:
             n_summaries += 1
         else:
@@ -1145,6 +1244,9 @@ def validate_fleet_log(path: str | Path) -> dict:
         "rollouts": n_rollouts,
         "autoscale": n_autoscale,
         "alerts": n_alerts,
+        "shadow": n_shadow,
+        "promotions": n_promotions,
+        "demotions": n_demotions,
         "undeclared": undeclared,
         "problems": problems,
     }

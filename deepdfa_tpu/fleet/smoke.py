@@ -36,6 +36,14 @@ stub fleets of their own (<60 s combined, docs/fleet.md):
    tighten_admission) and a scale_up BEFORE the offered rate crosses
    measured capacity, with zero requests lost and every decision a
    schema-valid `{"autoscale": ...}` fleet_log record.
+8. **telemetry** — `run_telemetry_smoke`: exact federated percentiles,
+   cross-host trace stitching past a torn write, and burn-rate + drift
+   alerts firing/resolving as schema-valid records.
+9. **flywheel** — `run_flywheel_smoke` (docs/flywheel.md): a candidate
+   shadow ride end to end — losing candidate demoted without a swap,
+   drifting candidate halted + rolled back by the real rollout gates,
+   winning candidate auto-promoted through `run_rollout` with zero
+   lost open-loop requests.
 """
 
 from __future__ import annotations
@@ -44,6 +52,7 @@ import json
 import os
 import signal
 import threading
+import time
 from pathlib import Path
 
 
@@ -475,6 +484,16 @@ def run_fleet_smoke(extra_overrides=None, **smoke_kw) -> dict:
     # fleet_log records
     with tempfile.TemporaryDirectory() as td:
         report["telemetry"] = run_telemetry_smoke(td)
+
+    # -- phase 9: the data flywheel (deepdfa_tpu/flywheel/,
+    # docs/flywheel.md): a candidate rides a stub fleet as a shadow,
+    # comparison windows land as schema-valid records, a losing
+    # candidate is demoted without touching traffic, a drifting one is
+    # halted + rolled back BY the real rollout gates, and the winning
+    # one auto-promotes through run_rollout with zero lost open-loop
+    # requests
+    with tempfile.TemporaryDirectory() as td:
+        report["flywheel"] = run_flywheel_smoke(td, parts=stub_parts)
     return report
 
 
@@ -745,6 +764,304 @@ def run_telemetry_smoke(tmp: str | Path) -> dict:
     return out
 
 
+def run_flywheel_smoke(tmp: str | Path, parts=None) -> dict:
+    """The `fleet --smoke` flywheel phase (<60 s, in-process): the full
+    closed loop from ISSUE 20's acceptance criteria, against a stub
+    fleet whose replicas speak the REAL /admin/rollout protocol.
+
+    1. two incumbent replicas + one shadow replica (candidate params,
+       `shadow: true` heartbeat) behind a real router with
+       `fleet.flywheel` on — the router's sampler mirrors every scored
+       request into the sample stream, and the shadow must never be
+       routed live traffic;
+    2. a LOSING ride: labels adversarial to the candidate -> the
+       window verdict demotes it ("trailing") with zero swaps;
+    3. a DRIFTING ride: the window verdict promotes, but the stub
+       checkpoint carries injected calibration drift on r1 -> the
+       real run_rollout swaps r0, gets the 409 refusal from r1, halts,
+       and rolls r0 back — the PR-14 gates covering an automated
+       promotion, recorded as promotion(rollout_ok=false) +
+       demotion("rollout_halted");
+    4. a WINNING ride: the candidate auto-promotes through run_rollout
+       (drift gate + armed SLO guard) onto both incumbents while
+       open-loop traffic runs — zero lost requests, zero
+       steady-state recompiles;
+    5. the fleet_log validates with shadow/promotion/demotion counts.
+
+    Labels ride the request bodies (the /score contract ignores
+    unknown keys); they are constructed from the two models' rank
+    DISAGREEMENT — positives where the candidate ranks a code higher
+    than the incumbent does — so "candidate beats incumbent" is true
+    by construction for the winning ride and false for the inverted
+    losing ride, deterministically.
+    """
+    from deepdfa_tpu.core import Config, config as config_mod
+    from deepdfa_tpu.fleet import chaos as fleet_chaos, coord
+    from deepdfa_tpu.fleet.router import (
+        BackgroundRouter, router_from_config, validate_fleet_log,
+    )
+    from deepdfa_tpu.flywheel import promote as promote_mod
+    from deepdfa_tpu.flywheel import shadow as shadow_mod
+
+    cfg = config_mod.apply_overrides(Config(), [
+        'data.feat={"limit_all": 50, "limit_subkeys": 50}',
+        "model.hidden_dim=8", "model.n_steps=2",
+        "serve.max_batch_graphs=1",
+        "serve.node_budget=2048", "serve.edge_budget=8192",
+        "serve.slo_windows=[5, 60]",
+        "fleet.heartbeat_timeout_s=3600.0",
+        "fleet.poll_interval_s=0.05",
+        "fleet.request_timeout_s=10.0",
+        "fleet.drain_announce_s=0.0",
+        "fleet.rollout_settle_s=0.0",
+        # armed SLO guard: a real p99 bound the stub traffic respects
+        "fleet.rollout_p99_ms=30000.0",
+        # the flywheel knobs, tightened to smoke scale: every request
+        # sampled, one 12-sample window per ride decides
+        "fleet.flywheel=true",
+        "fleet.flywheel_sample_rate=1.0",
+        "fleet.flywheel_max_inflight=256",
+        "fleet.flywheel_min_samples=12",
+        "fleet.flywheel_window=12",
+        "fleet.flywheel_promote_margin=0.01",
+        "fleet.flywheel_demote_margin=0.02",
+        # the in-window drift gate stays open: the SWAP-TIME drift
+        # gate (fleet.rollout_drift_bound) is the one this phase pins
+        "fleet.flywheel_drift_bound=1.0",
+    ])
+    fcfg = cfg.fleet
+    import jax
+
+    from deepdfa_tpu.graphs.batch import pack
+
+    model, params, vocabs, codes = (
+        parts if parts is not None else fleet_chaos.build_stub_parts(cfg)
+    )
+    # the candidate: same architecture, decorrelated init — a genuinely
+    # different scoring function for the comparison stream
+    cand_params = model.init(jax.random.key(1), pack([], 1, 2048, 8192))
+
+    fleet_dir = Path(tmp) / "flywheel"
+    log_path = fleet_dir / "fleet_log.jsonl"
+    out: dict = {}
+
+    def ckpts(drift_r1: float) -> dict:
+        return {
+            "cand-good": (cand_params, 0.0),
+            "cand-bad": (cand_params, 0.0),
+            "cand-drift": (cand_params, drift_r1),
+        }
+
+    replicas = {
+        rid: fleet_chaos.StubReplicaServer(
+            cfg, fleet_dir, rid,
+            fleet_chaos.stub_service(
+                cfg, fleet_dir, rid, model, params, vocabs,
+                # the injected-drift axis: r1's view of "cand-drift"
+                # is past fleet.rollout_drift_bound, r0's is clean —
+                # so the halt fires mid-rollout, after one real swap
+                checkpoints=ckpts(0.9 if rid == "r1" else 0.0),
+            ),
+        )
+        for rid in ("r0", "r1")
+    }
+    shadow_server = fleet_chaos.StubReplicaServer(
+        cfg, fleet_dir, "rs",
+        fleet_chaos.stub_service(
+            cfg, fleet_dir, "rs", model, cand_params, vocabs,
+            flywheel_tag="candidate",
+        ),
+        shadow=True,
+    )
+    router = router_from_config(cfg, fleet_dir, log_path=log_path)
+    server = BackgroundRouter(router)
+    traffic = None
+    try:
+        coord.poll_until(
+            lambda: (
+                router.routable_count() >= 2
+                and "rs" in router._replicas
+            ) or None,
+            30.0, interval_s=0.05, what="flywheel stub fleet routable",
+        )
+        rs_view = router._replicas["rs"]
+        out["shadow_not_routable"] = not rs_view.routable(
+            fcfg.heartbeat_timeout_s, time.time()
+        )
+
+        # -- probe both scoring functions to build the rank-diff labels
+        probe = codes[:16]
+        inc_probs, cand_probs = [], []
+        for code in probe:
+            status, resp = server.request("POST", "/score", {"code": code})
+            assert status == 200, (status, resp)
+            inc_probs.append(float(resp["prob"]))
+            status, resp = fleet_chaos.http_json(
+                shadow_server.host, shadow_server.port,
+                "POST", "/score", {"code": code},
+            )
+            assert status == 200, (status, resp)
+            cand_probs.append(float(resp.get("calibrated_prob",
+                                             resp.get("prob"))))
+        out["shadow_answers_mirror"] = True
+
+        def ranks(xs):
+            order = sorted(range(len(xs)), key=lambda i: xs[i])
+            r = [0] * len(xs)
+            for pos, i in enumerate(order):
+                r[i] = pos
+            return r
+        diff = [c - i for c, i in zip(ranks(cand_probs), ranks(inc_probs))]
+        by_diff = sorted(range(len(probe)), key=lambda i: diff[i])
+        ride_codes = [probe[i] for i in by_diff[-6:] + by_diff[:6]]
+        win_labels = [1] * 6 + [0] * 6
+
+        score_fn = shadow_mod.http_score_fn(
+            shadow_server.host, shadow_server.port
+        )
+
+        def ride(name: str, labels, last_seq: int):
+            scorer = shadow_mod.ShadowScorer(
+                fleet_dir, name, "init", score_fn, log=router.log,
+                window=fcfg.flywheel_window,
+                min_samples=fcfg.flywheel_min_samples,
+                promote_margin=fcfg.flywheel_promote_margin,
+                demote_margin=fcfg.flywheel_demote_margin,
+                drift_bound=fcfg.flywheel_drift_bound,
+            )
+            scorer.last_seq = last_seq
+            scorer.ride_start()
+            for code, y in zip(ride_codes, labels):
+                status, resp = server.request(
+                    "POST", "/score", {"code": code, "label": y}
+                )
+                assert status == 200, (status, resp)
+
+            def _scored() -> bool | None:
+                scorer.poll()
+                return (
+                    scorer.comparator.total >= len(ride_codes)
+                ) or None
+
+            coord.poll_until(
+                _scored, 30.0, interval_s=0.05,
+                what=f"shadow scoring for {name}",
+            )
+            scorer.ride_end()
+            return scorer
+
+        # -- losing ride: inverted labels -> demote("trailing"), no
+        # swap (each scorer starts past the samples the previous phase
+        # produced, so one ride = exactly one decided window)
+        seq0 = router.flywheel._seq  # the warmup probes, sampled too
+        scorer = ride("cand-bad", [1 - y for y in win_labels], seq0)
+        rep = promote_mod.run_promotion(
+            cfg, fleet_dir, "cand-bad", log_path,
+            router_addr=(server.host, server.port),
+        )
+        out["losing"] = {
+            "action": rep["action"], "reason": rep["reason"],
+            "swaps": sum(
+                r.service.registry.hot_swaps for r in replicas.values()
+            ),
+        }
+
+        # -- drifting ride: promote verdict, but r1's 409 halts the
+        # rollout and r0 is rolled back by the real halt path
+        scorer = ride("cand-drift", win_labels, scorer.last_seq)
+        rep = promote_mod.run_promotion(
+            cfg, fleet_dir, "cand-drift", log_path,
+            router_addr=(server.host, server.port),
+        )
+        ro = rep.get("rollout") or {}
+        out["drift_halt"] = {
+            "action": rep["action"], "reason": rep["reason"],
+            "halted": bool(ro.get("halted")),
+            "swapped": ro.get("swapped"),
+            "rolled_back": [
+                e.get("replica") for e in ro.get("rolled_back") or []
+            ],
+            "r0_restored": (
+                replicas["r0"].service.registry.checkpoint == "init"
+            ),
+            "r1_refused": (
+                replicas["r1"].service.registry.checkpoint == "init"
+            ),
+        }
+
+        # -- winning ride: auto-promotion through the real rollout path
+        # under open-loop traffic (zero lost requests is the bar)
+        scorer = ride("cand-good", win_labels, scorer.last_seq)
+        traffic = fleet_chaos.OpenLoopTraffic(
+            lambda: (server.host, server.port), codes[:4],
+            rate_per_sec=25.0, tenant="flywheel", seed=7,
+        ).start()
+        # let arrivals straddle the whole swap sequence — "zero lost"
+        # must be a claim about requests that actually flew
+        time.sleep(0.4)
+        rep = promote_mod.run_promotion(
+            cfg, fleet_dir, "cand-good", log_path,
+            router_addr=(server.host, server.port),
+        )
+        time.sleep(0.2)
+        results = traffic.stop()
+        traffic = None
+        ro = rep.get("rollout") or {}
+        out["winning"] = {
+            "action": rep["action"], "reason": rep["reason"],
+            "rollout_ok": bool(ro.get("ok")),
+            "swapped": ro.get("swapped"),
+            "census_ok": bool(ro.get("census_ok")),
+            "promoted_everywhere": all(
+                r.service.registry.checkpoint == "cand-good"
+                for r in replicas.values()
+            ),
+            "lost": sum(1 for r in results if r.get("status") == 0),
+            "requests": len(results),
+        }
+        out["shadow_never_routed"] = router._replicas["rs"].forwarded == 0
+        out["zero_recompiles"] = all(
+            r.service.steady_state_recompiles() == 0
+            for r in replicas.values()
+        )
+        out["sampler_sampled"] = router.flywheel._seq > seq0
+    finally:
+        if traffic is not None:
+            traffic.stop()
+        server.close()
+        for r in replicas.values():
+            r.close()
+        shadow_server.close()
+
+    log_report = validate_fleet_log(log_path)
+    out["fleet_log"] = {
+        "ok": log_report["ok"],
+        "shadow": log_report["shadow"],
+        "promotions": log_report["promotions"],
+        "demotions": log_report["demotions"],
+        "problems": log_report["problems"][:5],
+    }
+    out["ok"] = bool(
+        out.get("shadow_not_routable")
+        and out.get("shadow_never_routed")
+        and out.get("sampler_sampled")
+        and (out.get("losing") or {}).get("action") == "demote"
+        and (out.get("losing") or {}).get("swaps") == 0
+        and (out.get("drift_halt") or {}).get("halted")
+        and (out.get("drift_halt") or {}).get("r0_restored")
+        and (out.get("winning") or {}).get("rollout_ok")
+        and (out.get("winning") or {}).get("promoted_everywhere")
+        and (out.get("winning") or {}).get("lost") == 0
+        and (out.get("winning") or {}).get("requests", 0) > 0
+        and out.get("zero_recompiles")
+        and out["fleet_log"]["ok"]
+        and out["fleet_log"]["shadow"] >= 3
+        and out["fleet_log"]["promotions"] >= 1
+        and out["fleet_log"]["demotions"] >= 2
+    )
+    return out
+
+
 def smoke_verdict(report: dict) -> list[str]:
     """The failed acceptance criteria (empty = the smoke passed) — one
     place `cmd_fleet` and the tests read the contract from."""
@@ -829,4 +1146,29 @@ def smoke_verdict(report: dict) -> list[str]:
         bad.append("burn-rate or drift alert did not fire and resolve")
     if not al.get("records_valid"):
         bad.append("an alert record failed schema validation")
+    fw = report.get("flywheel") or {}
+    if not (fw.get("shadow_not_routable") and fw.get("shadow_never_routed")):
+        bad.append("router routed (or would route) live traffic to the "
+                   "shadow replica")
+    if (fw.get("losing") or {}).get("action") != "demote" or (
+        fw.get("losing") or {}
+    ).get("swaps") != 0:
+        bad.append("losing candidate was not refused without a swap")
+    dh = fw.get("drift_halt") or {}
+    if not (dh.get("halted") and dh.get("r0_restored")):
+        bad.append("injected bad candidate did not halt + roll back "
+                   "through the real rollout gates")
+    wn = fw.get("winning") or {}
+    if not (wn.get("rollout_ok") and wn.get("promoted_everywhere")):
+        bad.append("winning candidate did not auto-promote via the "
+                   "rollout path")
+    if wn.get("lost") != 0 or not wn.get("requests"):
+        bad.append("flywheel promotion lost open-loop requests (or none "
+                   "flew during the swap window)")
+    if not fw.get("zero_recompiles"):
+        bad.append("steady-state recompiles on an incumbent during the "
+                   "flywheel phase")
+    if not (fw.get("fleet_log") or {}).get("ok"):
+        bad.append("a shadow/promotion/demotion record failed schema "
+                   "validation")
     return bad

@@ -1,0 +1,29 @@
+"""Data flywheel: shadow serving, log-driven retraining, auto-promotion.
+
+The subsystem that closes ROADMAP item 4's loop (docs/flywheel.md):
+
+- `shadow`  — the router-side sampler that mirrors a bounded stream of
+  live requests to a candidate replica, plus the scorer/comparator that
+  turns both models' scores into windowed `{"shadow": ...}` fleet_log
+  records.
+- `retrain` — replays serve/fleet logs through the tune/ladder manifest
+  idiom to assemble a traffic-weighted fine-tune set and produce a
+  servable candidate run dir with the existing trainers.
+- `promote` — watches the shadow record and, when the candidate clears
+  the configured bound, drives the *existing* `fleet-rollout` path so
+  the PR-14 drift gate, SLO guard, and rollback cover automated
+  promotions; losing/drifting candidates are demoted with a
+  schema-valid `{"demotion": ...}` record instead of touching traffic.
+
+Everything here is gated on `fleet.flywheel` (default off); with the
+flag off no module in this package is imported on the serving path and
+the default fleet path is byte-identical.
+"""
+
+from deepdfa_tpu.flywheel.shadow import (  # noqa: F401
+    ShadowComparator,
+    ShadowSampler,
+    ShadowScorer,
+    judge,
+    rank_auc,
+)

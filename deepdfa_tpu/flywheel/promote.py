@@ -1,0 +1,209 @@
+"""Auto-promotion: turn shadow verdicts into (gated) rollouts.
+
+The controller half of the flywheel decision. It never swaps a model
+itself — a "promote" verdict is executed by calling the *existing*
+`fleet/rollout.py:run_rollout`, so every automated promotion passes
+the exact gates a human-initiated `deepdfa-tpu fleet-rollout` does:
+the per-replica drift refusal, the SLO guard between swaps, rollback
+on halt, and the steady-state-recompile census. A halted promotion is
+recorded as both a `{"promotion": ...}` (rollout_ok=false) and a
+`{"demotion": {"reason": "rollout_halted"}}` so the log tells the
+whole story; a losing or drifting candidate is demoted without ever
+touching live traffic.
+
+Decisions are derived from the fleet_log itself (`decide_from_log`),
+not from controller-private state: the latest `{"shadow": {"event":
+"window"}}` record for the candidate carries the exact stats
+`shadow.judge()` consumes, and an unresolved `shadow_regression` alert
+(obs/alerts.py) vetoes promotion with a `"alert"` demotion. That makes
+the decision replayable — `deepdfa-tpu flywheel --once` on a copied
+log reaches the same verdict the live watcher did.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+from deepdfa_tpu.fleet import coord, rollout
+from deepdfa_tpu.fleet.router import FleetLog
+from deepdfa_tpu.flywheel import shadow as shadow_mod
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+
+def tail_flywheel_records(
+    log_path: str | Path,
+    backend: coord.CoordinationBackend | None = None,
+    max_bytes: int = 1 << 20,
+) -> dict:
+    """One pass over the fleet_log tail → the flywheel-relevant slice:
+    shadow records (in order), promotions, demotions, and the set of
+    alert rules currently firing (latest state per rule wins)."""
+    backend = backend or coord.LOCAL
+    records = backend.tail_records(log_path, max_bytes=max_bytes)
+    out: dict = {"shadow": [], "promotions": [], "demotions": []}
+    alert_state: dict[str, str] = {}
+    for rec in records:
+        if "shadow" in rec:
+            out["shadow"].append(rec["shadow"])
+        elif "promotion" in rec:
+            out["promotions"].append(rec["promotion"])
+        elif "demotion" in rec:
+            out["demotions"].append(rec["demotion"])
+        elif "alert" in rec:
+            alert = rec["alert"]
+            name = alert.get("rule")
+            if name:
+                alert_state[name] = alert.get("state") or ""
+    out["firing_alerts"] = sorted(
+        name for name, state in alert_state.items() if state == "firing"
+    )
+    return out
+
+
+def decide_from_log(
+    log_path: str | Path,
+    candidate: str,
+    *,
+    min_samples: int,
+    promote_margin: float,
+    demote_margin: float,
+    drift_bound: float,
+    backend: coord.CoordinationBackend | None = None,
+) -> tuple[str, str, dict]:
+    """(action, reason, stats) for `candidate`, from the log alone.
+
+    A firing `shadow_regression` alert is an unconditional veto (the
+    alert engine saw a mid-ride degradation the current window may
+    have already rotated past); otherwise the newest window record for
+    the candidate is judged with the same bounds the live scorer used.
+    """
+    tail = tail_flywheel_records(log_path, backend=backend)
+    if "shadow_regression" in tail["firing_alerts"]:
+        return "demote", "alert", {}
+    windows = [
+        s for s in tail["shadow"]
+        if s.get("event") == "window" and s.get("candidate") == candidate
+    ]
+    if not windows:
+        return "hold", "insufficient_samples", {}
+    stats = windows[-1]
+    return (*shadow_mod.judge(
+        stats,
+        min_samples=min_samples,
+        promote_margin=promote_margin,
+        demote_margin=demote_margin,
+        drift_bound=drift_bound,
+    ), stats)
+
+
+def run_promotion(
+    cfg,
+    fleet_dir: str | Path,
+    candidate: str,
+    log_path: str | Path,
+    router_addr: tuple[str, int] | None = None,
+    incumbent: str = "incumbent",
+) -> dict:
+    """Decide once and execute. Returns a report dict with `action`,
+    `reason`, and (when the action was promote) the full run_rollout
+    report under `rollout` — the caller prints it verbatim so an
+    automated promotion reads exactly like a manual fleet-rollout."""
+    fcfg = cfg.fleet
+    backend = coord.backend_from_config(cfg)
+    action, reason, stats = decide_from_log(
+        log_path, candidate,
+        min_samples=fcfg.flywheel_min_samples,
+        promote_margin=fcfg.flywheel_promote_margin,
+        demote_margin=fcfg.flywheel_demote_margin,
+        drift_bound=fcfg.flywheel_drift_bound,
+        backend=backend,
+    )
+    obs_metrics.REGISTRY.counter(f"flywheel/{action}").inc()
+    report: dict = {
+        "action": action, "reason": reason, "candidate": candidate,
+        "stats": stats, "t_unix": round(time.time(), 3),
+    }
+    # the promotion controller opens its own append handle to the
+    # shared fleet_log — same precedent as run_rollout, whose records
+    # interleave with the router's
+    log = FleetLog(log_path, backend=backend)
+    try:
+        if action == "promote":
+            rollout_report = rollout.run_rollout(
+                cfg, fleet_dir, candidate,
+                router_addr=router_addr, log_path=log_path,
+            )
+            report["rollout"] = rollout_report
+            ok = bool(rollout_report.get("ok"))
+            shadow_mod.record_promotion(
+                log, candidate, incumbent=incumbent, rollout_ok=ok,
+                swapped=len(rollout_report.get("swapped") or ()),
+                reason=reason, **_stat_fields(stats),
+            )
+            if not ok:
+                # the PR-14 gates refused it: drift refusal, SLO guard
+                # breach, or census failure — the rollback already ran
+                # inside run_rollout, so the only flywheel-side duty is
+                # the demotion record that ends the ride
+                shadow_mod.record_demotion(
+                    log, candidate, "rollout_halted",
+                    halt_reason=rollout_report.get("halt_reason"),
+                    incumbent=incumbent,
+                )
+                report["action"] = "demote"
+                report["reason"] = "rollout_halted"
+        elif action == "demote":
+            shadow_mod.record_demotion(
+                log, candidate, reason, incumbent=incumbent,
+                **_stat_fields(stats),
+            )
+    finally:
+        log.close()
+    return report
+
+
+def _stat_fields(stats: dict) -> dict:
+    """The comparison scalars worth echoing into promotion/demotion
+    records (full window stats stay on the window record)."""
+    keep = ("samples", "labeled", "agreement", "prob_drift",
+            "auc_candidate", "auc_incumbent")
+    return {k: stats[k] for k in keep if k in stats}
+
+
+def watch(
+    cfg,
+    fleet_dir: str | Path,
+    candidate: str,
+    log_path: str | Path,
+    *,
+    interval_s: float = 2.0,
+    timeout_s: float = 300.0,
+    router_addr: tuple[str, int] | None = None,
+) -> dict:
+    """Poll the log until the verdict stops being "hold" (or the bound
+    expires — which ends the ride with an insufficient_samples/
+    unlabeled demotion so a stuck candidate can't squat the shadow
+    slot forever). Returns the final run_promotion report."""
+    deadline = time.monotonic() + float(timeout_s)
+    report: dict = {"action": "hold", "reason": "insufficient_samples"}
+    while time.monotonic() < deadline:
+        report = run_promotion(
+            cfg, fleet_dir, candidate, log_path, router_addr=router_addr,
+        )
+        if report["action"] != "hold":
+            return report
+        time.sleep(max(0.05, float(interval_s)))
+    reason = report.get("reason") or "insufficient_samples"
+    if reason not in ("insufficient_samples", "unlabeled"):
+        reason = "insufficient_samples"
+    backend = coord.backend_from_config(cfg)
+    log = FleetLog(log_path, backend=backend)
+    try:
+        shadow_mod.record_demotion(log, candidate, reason, timed_out=True)
+    finally:
+        log.close()
+    report["action"] = "demote"
+    report["reason"] = reason
+    report["timed_out"] = True
+    return report

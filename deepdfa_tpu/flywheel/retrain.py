@@ -1,0 +1,213 @@
+"""Log-driven candidate training: traffic-weighted fine-tune sets.
+
+The flywheel's learning half. Serve and fleet logs already record
+every scored request (`{"request": ...}` lines, the same stream
+tune/ladder.py replays to fit the serving ladder); this module replays
+them once more — this time to decide *what the candidate should train
+on*. The mapping from traffic to training weight goes through the
+incumbent's probability distribution: requests concentrate in some
+probability bands (most real streams are mostly-benign with a hard
+tail near the boundary), so training examples whose incumbent score
+falls in traffic-heavy bands are oversampled. That is a calibration
+set in the literal sense — the candidate is tuned hardest exactly
+where the live decision boundary carries the most traffic.
+
+`build_candidate` then does what `deepdfa-tpu train` does, in
+miniature: warm-start from the incumbent checkpoint
+(train/checkpoint.py:restore_candidate_params), a bounded number of
+GraphTrainer.train_step calls over the weighted selection, and a
+servable run dir (config.json + checkpoints/ manifest) that
+`fleet-rollout` / the shadow replica can load unchanged. steps=0 is
+legal and useful: it produces a candidate run dir that is the
+incumbent re-saved — the smoke's "identical candidate" control.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+#: probability-band resolution for traffic weighting; deciles are
+#: coarse enough that a modest log populates every hot band and fine
+#: enough to separate the boundary from the bulk
+N_BANDS = 10
+
+
+def traffic_weights_from_log(path: str | Path) -> dict:
+    """Replay `{"request": ...}` lines (fleet_log or serve request-log
+    shape — tune/ladder.py:batch_sizes_from_log precedent) into the
+    traffic profile retraining weights derive from: total volume, the
+    tenant mix, and a probability-band histogram over the incumbent's
+    logged scores. Torn or foreign lines are skipped, not fatal."""
+    tenants: Counter = Counter()
+    bands = [0] * N_BANDS
+    n = 0
+    n_prob = 0
+    path = Path(path)
+    if not path.exists():
+        return {"requests": 0, "scored": 0, "tenants": {},
+                "prob_bands": bands}
+    with path.open() as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            req = rec.get("request") if isinstance(rec, dict) else None
+            if not isinstance(req, dict):
+                continue
+            n += 1
+            tenants[str(req.get("tenant") or "default")] += 1
+            prob = req.get("prob")
+            if isinstance(prob, (int, float)):
+                bands[band_of(float(prob))] += 1
+                n_prob += 1
+    return {
+        "requests": n, "scored": n_prob,
+        "tenants": dict(tenants.most_common()), "prob_bands": bands,
+    }
+
+
+def band_of(prob: float) -> int:
+    return min(N_BANDS - 1, max(0, int(float(prob) * N_BANDS)))
+
+
+def example_weights(probs, prob_bands) -> list[float]:
+    """Per-example sampling weight = traffic mass of the band the
+    incumbent scores that example into, floored at one notional
+    request so zero-traffic bands stay representable (an empty band
+    must not erase a class from the fine-tune set)."""
+    total = float(sum(prob_bands)) or 1.0
+    return [
+        max(1.0, float(prob_bands[band_of(p)])) / total for p in probs
+    ]
+
+
+def select_weighted(weights, k: int, seed: int = 0) -> list[int]:
+    """Deterministic weighted selection (with replacement) of k
+    indices — systematic resampling over the cumulative weights, the
+    same draw every run for a given (weights, k, seed) so candidate
+    builds are reproducible from the log alone."""
+    import random
+
+    if not weights or k <= 0:
+        return []
+    total = float(sum(weights))
+    if total <= 0:
+        return list(range(min(k, len(weights))))
+    rng = random.Random(int(seed))
+    start = rng.random() / k
+    points = [start + i / k for i in range(k)]
+    out = []
+    cum = 0.0
+    i = 0
+    for p in points:
+        target = p * total
+        while cum + weights[i] < target and i < len(weights) - 1:
+            cum += weights[i]
+            i += 1
+        out.append(i)
+    return out
+
+
+def build_candidate(
+    cfg,
+    incumbent_run: str | Path,
+    out_dir: str | Path,
+    log_path: str | Path,
+    *,
+    steps: int = 0,
+    max_examples: int = 512,
+    seed: int = 0,
+) -> dict:
+    """Assemble the traffic-weighted set and produce a servable
+    candidate run dir. Heavy imports stay inside the function — the
+    router process imports this module's pure helpers for nothing and
+    must not pay for JAX."""
+    import numpy as np
+
+    from deepdfa_tpu.core import config as config_mod
+    from deepdfa_tpu.graphs import shard_bucket_batches
+    from deepdfa_tpu.models import DeepDFA
+    from deepdfa_tpu.serve.registry import (
+        CKPT_DIR_BY_FAMILY,
+        load_run_config,
+    )
+    from deepdfa_tpu.train.checkpoint import restore_candidate_params
+    from deepdfa_tpu.train.loop import GraphTrainer
+
+    incumbent_run = Path(incumbent_run)
+    out_dir = Path(out_dir)
+    run_cfg = load_run_config(incumbent_run)
+    profile = traffic_weights_from_log(log_path)
+
+    # the candidate trains on the same corpus the incumbent did — the
+    # log contributes *weights*, not examples (raw request code is
+    # sampled for shadow scoring, never persisted into training data)
+    from deepdfa_tpu.cli.main import _load_graph_splits
+
+    splits = _load_graph_splits(run_cfg)
+    specs = splits["train"][: int(max_examples)]
+    if not specs:
+        raise ValueError("no training graphs — run `extract` first")
+
+    model = DeepDFA.from_config(
+        run_cfg.model, input_dim=run_cfg.data.feat.input_dim
+    )
+    trainer = GraphTrainer(model, run_cfg)
+
+    pool_batches = list(shard_bucket_batches(
+        specs, num_shards=1,
+        num_graphs=max(1, run_cfg.data.batch.graphs_per_batch),
+        node_budget=run_cfg.data.batch.node_budget,
+        edge_budget=run_cfg.data.batch.edge_budget,
+        oversized="singleton",
+    ))
+    state = trainer.init_state(pool_batches[0], seed=seed)
+    params = restore_candidate_params(
+        incumbent_run / CKPT_DIR_BY_FAMILY["deepdfa"], state.params
+    )
+    state = state.replace(params=params)
+
+    # score the pool with the incumbent to place each example in a
+    # traffic band, then systematic-resample the fine-tune selection
+    probs = []
+    for batch in pool_batches:
+        p, _labels, mask, _per = trainer.eval_step(params, batch)
+        flat = np.asarray(p).reshape(-1)
+        for j, keep in enumerate(np.asarray(mask).reshape(-1)):
+            if keep:
+                probs.append(float(flat[j]))
+    weights = example_weights(probs, profile["prob_bands"])
+    chosen = select_weighted(weights, k=min(len(specs), int(max_examples)),
+                             seed=seed)
+    selection = [specs[i % len(specs)] for i in chosen] or list(specs)
+
+    losses = []
+    if steps > 0:
+        train_batches = list(shard_bucket_batches(
+            selection, num_shards=1,
+            num_graphs=max(1, run_cfg.data.batch.graphs_per_batch),
+            node_budget=run_cfg.data.batch.node_budget,
+            edge_budget=run_cfg.data.batch.edge_budget,
+            oversized="drop",
+        ))
+        for step in range(int(steps)):
+            batch = train_batches[step % len(train_batches)]
+            state, loss = trainer.train_step(state, batch)
+            losses.append(float(loss))
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    config_mod.to_json(run_cfg, out_dir / "config.json")
+    ckpts = trainer.make_checkpoints(
+        out_dir / CKPT_DIR_BY_FAMILY["deepdfa"]
+    )
+    ckpts.save("candidate", state,
+               {"val_loss": losses[-1] if losses else 0.0},
+               step=int(state.step))
+    return {
+        "out_dir": str(out_dir), "steps": int(steps),
+        "examples": len(selection), "pool": len(specs),
+        "losses": losses, "traffic": profile,
+    }

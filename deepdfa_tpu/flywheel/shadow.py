@@ -1,0 +1,475 @@
+"""Shadow mode: mirror sampled live traffic onto a candidate model.
+
+Three cooperating pieces, joined only by the coordination backend
+(fleet/coord.py) so they work across processes and hosts exactly like
+the rest of the fleet plane:
+
+- ShadowSampler lives *inside the router process* (router_from_config
+  attaches it when `fleet.flywheel` is on). After a 200 response is
+  already on its way back to the client it appends a deterministic
+  every-kth subsample of requests — code, the incumbent's probability,
+  an optional rider label — to `shadow_samples.jsonl` under the fleet
+  dir. It never blocks the reply path: one flushed append per sampled
+  request, and a progress-doc backpressure check that *drops* samples
+  (counted, never queued) when the scorer falls more than
+  `max_inflight` behind.
+
+- ShadowScorer runs in the flywheel controller process (`deepdfa-tpu
+  flywheel`). It tails the sample stream, scores each code with the
+  candidate (normally an HTTP POST to the shadow replica's /score —
+  the replica whose heartbeat carries `shadow: true` so the router
+  never routes live traffic to it), feeds a ShadowComparator, and
+  every `window` samples lands one `{"shadow": {"event": "window",
+  ...}}` record in fleet_log.
+
+- ShadowComparator is pure state: rolling windows of (incumbent prob,
+  candidate prob, label, lag) reduced to agreement / calibration-drift
+  / rank-AUC stats, and `judge()` — the single decision function both
+  the comparator and flywheel/promote.py apply, so the smoke, the CLI
+  watcher, and the unit tests cannot disagree about what "beats the
+  incumbent" means.
+
+Record shapes are validated by fleet/router.py:validate_fleet_log and
+documented in docs/flywheel.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+from deepdfa_tpu.fleet import coord
+from deepdfa_tpu.fleet.router import DEMOTION_REASONS, SHADOW_EVENTS
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+#: sampled-request stream (sampler appends, scorer tails) — lives under
+#: the fleet dir next to heartbeats/ and fleet_log.jsonl
+SAMPLES_FILE = "shadow_samples.jsonl"
+#: scorer -> sampler acknowledgement doc {"scored": <seq>}; the sampler
+#: reads it (rate-limited) to bound how far the mirror stream can run
+#: ahead of the shadow replica
+PROGRESS_FILE = "shadow_progress.json"
+
+
+def record_shadow(log, event: str, candidate: str, **fields) -> dict:
+    """Append one `{"shadow": ...}` record; the schema gate lives here
+    so every emitter (scorer, smoke, diag --smoke) fails loudly on a
+    bad event instead of producing a line validate_fleet_log rejects."""
+    if event not in SHADOW_EVENTS:
+        raise ValueError(f"unknown shadow event {event!r} (not in "
+                         f"{SHADOW_EVENTS})")
+    entry = {
+        "event": event, "candidate": str(candidate),
+        "t_unix": round(time.time(), 3), **fields,
+    }
+    if log is not None:
+        log.append({"shadow": entry})
+    return entry
+
+
+def record_promotion(log, candidate: str, **fields) -> dict:
+    entry = {
+        "candidate": str(candidate), "t_unix": round(time.time(), 3),
+        **fields,
+    }
+    if log is not None:
+        log.append({"promotion": entry})
+    return entry
+
+
+def record_demotion(log, candidate: str, reason: str, **fields) -> dict:
+    if reason not in DEMOTION_REASONS:
+        raise ValueError(f"unknown demotion reason {reason!r} (not in "
+                         f"{DEMOTION_REASONS})")
+    entry = {
+        "candidate": str(candidate), "reason": reason,
+        "t_unix": round(time.time(), 3), **fields,
+    }
+    if log is not None:
+        log.append({"demotion": entry})
+    return entry
+
+
+def rank_auc(labels, scores) -> float | None:
+    """Mann-Whitney rank AUC with tie-splitting; None unless both
+    classes are present (an AUC over one class is undefined, and
+    returning 0.5 there would let an all-negative window promote)."""
+    pos = [s for y, s in zip(labels, scores) if y]
+    neg = [s for y, s in zip(labels, scores) if not y]
+    if not pos or not neg:
+        return None
+    wins = 0.0
+    for p in pos:
+        for n in neg:
+            if p > n:
+                wins += 1.0
+            elif p == n:
+                wins += 0.5
+    return wins / (len(pos) * len(neg))
+
+
+def judge(
+    stats: dict,
+    *,
+    min_samples: int,
+    promote_margin: float,
+    demote_margin: float,
+    drift_bound: float,
+) -> tuple[str, str]:
+    """The promotion decision, as one pure function of window stats.
+
+    Returns (action, reason) with action in {"promote", "demote",
+    "hold"}. Demote reasons come from router.DEMOTION_REASONS so the
+    resulting record is schema-valid by construction. Ordering is
+    deliberate: sample floor first (nothing is decidable on noise),
+    then the drift gate (a candidate whose probabilities have walked
+    away from the incumbent is demoted even if its AUC looks good —
+    mirroring the PR-14 swap-time drift refusal, but cheaper and
+    earlier), then the labeled AUC comparison, then the unlabeled
+    agreement fallback. Without labels we never auto-promote: agreement
+    only tells us the candidate is *the same*, not *better*.
+    """
+    n = int(stats.get("samples") or 0)
+    if n < int(min_samples):
+        return "hold", "insufficient_samples"
+    drift = stats.get("prob_drift")
+    if drift is not None and drift > drift_bound:
+        return "demote", "drift"
+    auc_c = stats.get("auc_candidate")
+    auc_i = stats.get("auc_incumbent")
+    if auc_c is not None and auc_i is not None:
+        delta = auc_c - auc_i
+        if delta >= promote_margin:
+            return "promote", "auc_margin"
+        if delta <= -float(demote_margin):
+            return "demote", "trailing"
+        return "hold", "within_margin"
+    agreement = stats.get("agreement")
+    if agreement is not None and agreement < 1.0 - float(demote_margin):
+        # disagreeing hard with the incumbent on unlabeled traffic is
+        # the unlabeled analogue of trailing — without labels the
+        # incumbent is the only reference we have
+        return "demote", "trailing"
+    return "hold", "unlabeled"
+
+
+class ShadowSampler:
+    """Router-side mirror tap. Thread-safe (router handlers run on a
+    ThreadingHTTPServer); every public method is wrapped in one lock,
+    and the only I/O per sampled request is a single flushed append
+    through the coordination backend — the same budget as the
+    fleet_log request line the router already writes."""
+
+    def __init__(
+        self,
+        fleet_dir: str | Path,
+        sample_rate: float = 0.25,
+        max_inflight: int = 64,
+        backend: coord.CoordinationBackend | None = None,
+        progress_refresh_s: float = 0.5,
+    ):
+        self.fleet_dir = Path(fleet_dir)
+        self.backend = backend or coord.LOCAL
+        # deterministic every-kth sampling: a period, not a coin flip,
+        # so the smoke and the bench measure a reproducible stream
+        rate = float(sample_rate)
+        self.period = max(1, round(1.0 / rate)) if rate > 0 else 0
+        self.max_inflight = int(max_inflight)
+        self.progress_refresh_s = float(progress_refresh_s)
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._seq = 0
+        self._scored = 0
+        self._progress_read_t = 0.0
+        self._handle = self.backend.open_log(self.fleet_dir / SAMPLES_FILE)
+        self._m_samples = obs_metrics.REGISTRY.counter("shadow/samples")
+        self._m_dropped = obs_metrics.REGISTRY.counter("shadow/dropped")
+
+    def _inflight(self) -> int:
+        """seq written minus scorer-acknowledged; the progress doc read
+        is rate-limited so backpressure costs one small read per
+        refresh window, not per request."""
+        now = time.monotonic()
+        if now - self._progress_read_t >= self.progress_refresh_s:
+            self._progress_read_t = now
+            try:
+                text = self.backend.read_doc(self.fleet_dir / PROGRESS_FILE)
+                if text:
+                    self._scored = int(json.loads(text).get("scored") or 0)
+            except (OSError, ValueError):
+                pass
+        return self._seq - self._scored
+
+    def observe(
+        self,
+        request_id: str,
+        payload: dict,
+        prob: float | None,
+        tenant: str = "default",
+    ) -> bool:
+        """Called by the router's POST epilogue after the 200 reply is
+        already written. Returns True iff a sample was appended."""
+        if self.period <= 0 or prob is None:
+            return False
+        code = payload.get("code") if isinstance(payload, dict) else None
+        if not isinstance(code, str):
+            return False
+        with self._lock:
+            self._seen += 1
+            if self._seen % self.period != 0:
+                return False
+            if self._inflight() >= self.max_inflight:
+                # drop, never queue: the mirror stream must not grow an
+                # unbounded buffer inside the router when the shadow
+                # replica is slow or dead
+                self._m_dropped.inc()
+                return False
+            self._seq += 1
+            sample = {
+                "seq": self._seq, "id": str(request_id),
+                "t_unix": round(time.time(), 3),
+                "prob": round(float(prob), 6), "tenant": str(tenant),
+                "code": code,
+            }
+            label = payload.get("label")
+            if isinstance(label, (bool, int, float)) and float(label) in (
+                0.0, 1.0,
+            ):
+                # labels ride the request body when the caller has
+                # ground truth (the smoke does; scan pipelines can) —
+                # /score ignores unknown keys so this is free
+                sample["label"] = int(label)
+            if not self._handle.closed:
+                self._handle.write_line(json.dumps({"shadow_sample": sample}))
+            self._m_samples.inc()
+            return True
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._handle.closed:
+                self._handle.close()
+
+
+class ShadowComparator:
+    """Pure rolling comparison of candidate vs incumbent. No I/O, no
+    clock: everything observable is a function of the (p_inc, p_cand,
+    label, lag) tuples added so far, which is what makes the promotion
+    logic unit-testable without a fleet."""
+
+    def __init__(self, window: int = 64):
+        self.window = max(1, int(window))
+        self._rows: list[tuple[float, float, int | None, float]] = []
+        self.total = 0
+
+    def add(
+        self,
+        p_incumbent: float,
+        p_candidate: float,
+        label: int | None = None,
+        lag_s: float = 0.0,
+    ) -> None:
+        self.total += 1
+        self._rows.append(
+            (float(p_incumbent), float(p_candidate),
+             None if label is None else int(label), float(lag_s))
+        )
+        if len(self._rows) > self.window:
+            del self._rows[: len(self._rows) - self.window]
+
+    def stats(self) -> dict:
+        """Windowed stats in the exact key vocabulary `judge()` and the
+        `{"shadow": ...}` record use (docs/flywheel.md)."""
+        rows = self._rows
+        n = len(rows)
+        out: dict = {"samples": n, "total": self.total}
+        if not n:
+            return out
+        agree = sum(
+            1 for pi, pc, _, _ in rows if (pi >= 0.5) == (pc >= 0.5)
+        )
+        out["agreement"] = round(agree / n, 4)
+        out["prob_drift"] = round(
+            sum(abs(pi - pc) for pi, pc, _, _ in rows) / n, 4
+        )
+        out["lag_s"] = round(max(lag for _, _, _, lag in rows), 3)
+        labeled = [(y, pi, pc) for pi, pc, y, _ in rows if y is not None]
+        out["labeled"] = len(labeled)
+        if labeled:
+            ys = [y for y, _, _ in labeled]
+            auc_i = rank_auc(ys, [pi for _, pi, _ in labeled])
+            auc_c = rank_auc(ys, [pc for _, _, pc in labeled])
+            if auc_i is not None:
+                out["auc_incumbent"] = round(auc_i, 4)
+            if auc_c is not None:
+                out["auc_candidate"] = round(auc_c, 4)
+        return out
+
+
+class ShadowScorer:
+    """Controller-side half of the ride: tail the sample stream, score
+    with the candidate, compare, emit windowed records.
+
+    `score_fn(code) -> float | None` abstracts *where* the candidate
+    runs: `http_score_fn` posts to the shadow replica over the wire
+    (the production shape — the candidate's compiled programs live in
+    its own process, so the incumbent census can't change), while tests
+    pass an in-process callable. None means the score failed; the
+    sample is counted under shadow/score_errors and skipped.
+    """
+
+    def __init__(
+        self,
+        fleet_dir: str | Path,
+        candidate: str,
+        incumbent: str,
+        score_fn,
+        log=None,
+        *,
+        window: int = 64,
+        min_samples: int = 50,
+        promote_margin: float = 0.02,
+        demote_margin: float = 0.05,
+        drift_bound: float = 0.25,
+        backend: coord.CoordinationBackend | None = None,
+    ):
+        self.fleet_dir = Path(fleet_dir)
+        self.candidate = str(candidate)
+        self.incumbent = str(incumbent)
+        self.score_fn = score_fn
+        self.log = log
+        self.backend = backend or coord.LOCAL
+        self.window = max(1, int(window))
+        self.bounds = dict(
+            min_samples=int(min_samples),
+            promote_margin=float(promote_margin),
+            demote_margin=float(demote_margin),
+            drift_bound=float(drift_bound),
+        )
+        self.comparator = ShadowComparator(window=self.window)
+        self.last_seq = 0
+        self.windows = 0
+        self.last_window_stats: dict = {}
+        reg = obs_metrics.REGISTRY
+        self._m_scored = reg.counter("shadow/scored")
+        self._m_errors = reg.counter("shadow/score_errors")
+        self._m_windows = reg.counter("shadow/windows")
+        self._m_regressions = reg.counter("shadow/regressions")
+        self._g_agreement = reg.gauge("shadow/agreement")
+        self._g_drift = reg.gauge("shadow/prob_drift")
+        self._g_lag = reg.gauge("shadow/lag_s")
+
+    def ride_start(self, **fields) -> dict:
+        return record_shadow(
+            self.log, "ride_start", self.candidate,
+            incumbent=self.incumbent, **fields,
+        )
+
+    def ride_end(self, **fields) -> dict:
+        stats = self.comparator.stats()
+        return record_shadow(
+            self.log, "ride_end", self.candidate,
+            incumbent=self.incumbent, windows=self.windows, **stats,
+            **fields,
+        )
+
+    def _ack(self) -> None:
+        self.backend.write_doc(
+            self.fleet_dir / PROGRESS_FILE,
+            json.dumps({"scored": self.last_seq,
+                        "t_unix": round(time.time(), 3)}),
+        )
+
+    def _emit_window(self) -> dict:
+        stats = self.comparator.stats()
+        self.windows += 1
+        self.last_window_stats = stats
+        self._m_windows.inc()
+        if "agreement" in stats:
+            self._g_agreement.set(stats["agreement"])
+        if "prob_drift" in stats:
+            self._g_drift.set(stats["prob_drift"])
+        if "lag_s" in stats:
+            self._g_lag.set(stats["lag_s"])
+        action, reason = judge(stats, **self.bounds)
+        if action == "demote":
+            # the alert catalog's shadow_regression rule fires off this
+            # counter (obs/alerts.py) — a degrading candidate alerts
+            # mid-ride, before promotion could ever trigger
+            self._m_regressions.inc()
+        record_shadow(
+            self.log, "window", self.candidate,
+            incumbent=self.incumbent, verdict=action,
+            verdict_reason=reason, **stats,
+        )
+        return stats
+
+    def poll(self, max_bytes: int = 1 << 20) -> int:
+        """Score every unseen sample in the stream tail; returns how
+        many were scored. Torn trailing lines are tolerated by
+        tail_records and picked up next poll."""
+        records = self.backend.tail_records(
+            self.fleet_dir / SAMPLES_FILE, max_bytes=max_bytes
+        )
+        scored = 0
+        for rec in records:
+            sample = rec.get("shadow_sample")
+            if not isinstance(sample, dict):
+                continue
+            seq = int(sample.get("seq") or 0)
+            if seq <= self.last_seq:
+                continue
+            self.last_seq = seq
+            prob = self.score_fn(sample.get("code"))
+            if prob is None:
+                self._m_errors.inc()
+                continue
+            lag = max(0.0, time.time() - float(sample.get("t_unix") or 0.0))
+            self.comparator.add(
+                float(sample.get("prob") or 0.0), float(prob),
+                label=sample.get("label"), lag_s=lag,
+            )
+            self._m_scored.inc()
+            scored += 1
+            if self.comparator.total % self.window == 0:
+                self._emit_window()
+        if scored:
+            self._ack()
+        return scored
+
+    def decide(self) -> tuple[str, str]:
+        """Apply `judge()` to the current window — the same stats the
+        last emitted record carries, so log watchers and the live
+        scorer always agree."""
+        return judge(self.comparator.stats(), **self.bounds)
+
+
+def http_score_fn(host: str, port: int, timeout_s: float = 10.0):
+    """score_fn that POSTs to the shadow replica's /score and returns
+    its calibrated probability (the same field the router logs for the
+    incumbent, so the comparison is like-for-like)."""
+    import http.client
+
+    def score(code):
+        if not isinstance(code, str):
+            return None
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+            try:
+                conn.request(
+                    "POST", "/score", json.dumps({"code": code}),
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                body = json.loads(resp.read().decode() or "{}")
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        if resp.status != 200:
+            return None
+        prob = body.get("calibrated_prob", body.get("prob"))
+        return float(prob) if prob is not None else None
+
+    return score

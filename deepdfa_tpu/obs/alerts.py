@@ -446,6 +446,17 @@ def default_rules() -> list[AlertRule]:
             threshold=0.0, for_s=30.0, windows=(60.0,),
             params={"key": "autoscale/at_max"},
         ),
+        # a flywheel candidate degrading mid-ride: the shadow scorer
+        # bumps shadow/regressions whenever a comparison window judges
+        # demote-worthy (flywheel/shadow.py), so this fires BEFORE the
+        # promotion controller could ever act on stale good windows —
+        # and flywheel/promote.py treats the firing rule as an
+        # unconditional promotion veto (demotion reason "alert")
+        AlertRule(
+            name="shadow_regression", kind="counter_rate",
+            threshold=0.0, for_s=0.0, windows=(300.0,),
+            params={"pattern": "shadow/regressions"},
+        ),
     ]
 
 

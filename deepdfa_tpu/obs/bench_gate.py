@@ -63,6 +63,13 @@ DEFAULT_TOLERANCES: dict[str, float] = {
     # device-second; gated against the trajectory so the margin cannot
     # silently erode)
     "cascade_speedup": 0.20,
+    # shadow-ride agreement between the mirror candidate and the
+    # incumbent over the bench's mini ride (ISSUE 20,
+    # scripts/bench_load.py behind DEEPDFA_BENCH_FLEET): here the
+    # candidate IS the incumbent's checkpoint, so agreement falling is
+    # a comparison-plumbing regression (sampler/scorer pairing drift),
+    # not a model difference
+    "shadow_agreement": 0.10,
 }
 
 #: fail when `new > (1 + tol) * reference` (lower is better)
@@ -117,6 +124,12 @@ LOWER_IS_BETTER: dict[str, float] = {
     # layouts (docs/tuning.md)
     "tuned_ggnn_step_us": 0.25,
     "tuned_ladder_padding_waste": 0.10,
+    # shadow sample lag (ISSUE 20): seconds from a sampled request
+    # landing in shadow_samples.jsonl to the scorer consuming it during
+    # the bench's mini ride — rising past tolerance means the mirror
+    # stream is falling behind the traffic it shadows (generous: the
+    # mini ride is short and poll cadence dominates)
+    "shadow_sample_lag_s": 0.5,
 }
 
 #: lower-is-better metrics whose 0.0 reference is an EXACT-FIT claim,
@@ -135,6 +148,11 @@ ABSOLUTE_UPPER_BOUNDS: dict[str, float] = {
     # serving path must cost <= 2% of closed-loop throughput, measured
     # by scripts/bench_load.py's interleaved on/off reps
     "obs_fleet_overhead_fraction": 0.02,
+    # shadow mirror sampling on the router's reply path (ISSUE 20,
+    # flywheel/shadow.py:ShadowSampler): the every-kth sample append +
+    # backpressure check must cost <= 2% of closed-loop router
+    # throughput, measured by the same interleaved on/off reps
+    "shadow_overhead_fraction": 0.02,
     # the cascade's pinned accuracy contract (ISSUE 12, docs/cascade.md):
     # dev-set AUC may trail combined-only serving by at most the drift
     # bound (one-sided — a cascade that scores BETTER is not a

@@ -660,6 +660,69 @@ def alerts_section(fleet_records: list[dict]) -> dict:
     }
 
 
+def flywheel_section(fleet_records: list[dict]) -> dict:
+    """The data-flywheel section, rebuilt from the fleet_log's
+    `{"shadow"|"promotion"|"demotion": ...}` records
+    (deepdfa_tpu/flywheel/; docs/flywheel.md): per-candidate ride
+    summaries with the shadow-vs-incumbent comparison timeline
+    (windowed agreement / calibration drift / AUC pair), and the
+    promotion/demotion history — the audit trail of every time the
+    fleet changed (or refused to change) its own model."""
+    shadows = [
+        r["shadow"] for r in fleet_records
+        if isinstance(r.get("shadow"), dict)
+    ]
+    promotions = [
+        r["promotion"] for r in fleet_records
+        if isinstance(r.get("promotion"), dict)
+    ]
+    demotions = [
+        r["demotion"] for r in fleet_records
+        if isinstance(r.get("demotion"), dict)
+    ]
+    if not (shadows or promotions or demotions):
+        return {}
+    rides: dict[str, dict] = {}
+    for s in shadows:
+        cand = str(s.get("candidate", "?"))
+        ride = rides.setdefault(cand, {
+            "incumbent": s.get("incumbent"), "windows": 0,
+            "timeline": [],
+        })
+        event = s.get("event")
+        if event == "window":
+            ride["windows"] += 1
+            ride["timeline"].append({
+                k: s[k]
+                for k in ("samples", "labeled", "agreement",
+                          "prob_drift", "lag_s", "auc_candidate",
+                          "auc_incumbent", "verdict", "verdict_reason")
+                if k in s
+            })
+        elif event == "ride_end":
+            ride["ended"] = True
+    history = sorted(
+        [{"kind": "promotion", **p} for p in promotions]
+        + [{"kind": "demotion", **d} for d in demotions],
+        key=lambda e: e.get("t_unix") or 0.0,
+    )
+    return {
+        "rides": dict(sorted(rides.items())),
+        "promotions": len(promotions),
+        "demotions": len(demotions),
+        "history": [
+            {
+                k: e[k]
+                for k in ("kind", "candidate", "reason", "rollout_ok",
+                          "swapped", "halt_reason", "auc_candidate",
+                          "auc_incumbent", "t_unix")
+                if k in e
+            }
+            for e in history
+        ],
+    }
+
+
 def drill_section(
     run_dir: Path, root: str | Path | None = None
 ) -> dict:
@@ -979,6 +1042,7 @@ def diagnose(run_dir: str | Path, bench_root: str | Path | None = None) -> dict:
         "fleet": fleet_section(run_dir, fleet_records),
         "autoscale": autoscale_section(fleet_records),
         "alerts": alerts_section(fleet_records),
+        "flywheel": flywheel_section(fleet_records),
         "drill": drill_section(run_dir, bench_root),
         "efficiency": efficiency_section(run_dir, records),
         "tuning": tuning_section(run_dir),
@@ -1359,6 +1423,41 @@ def render_text(report: dict, out=sys.stdout) -> None:
                 f"    {d.get('action', '?'):<18}{bar} "
                 f"ratio={ratio} replicas={d.get('replicas')} "
                 f"({d.get('reason')})\n"
+            )
+
+    flywheel = report.get("flywheel") or {}
+    if flywheel:
+        w("\ndata flywheel (fleet_log.jsonl, docs/flywheel.md):\n")
+        for cand, ride in (flywheel.get("rides") or {}).items():
+            w(
+                f"  shadow ride: {cand} vs {ride.get('incumbent')} "
+                f"({ride['windows']} windows"
+                f"{', ended' if ride.get('ended') else ''})\n"
+            )
+            for t in ride.get("timeline") or []:
+                agree = t.get("agreement")
+                bar = (
+                    _bar(float(agree), 20)
+                    if isinstance(agree, (int, float)) else " " * 20
+                )
+                auc = (
+                    f" auc {t['auc_candidate']} vs {t['auc_incumbent']}"
+                    if "auc_candidate" in t and "auc_incumbent" in t
+                    else ""
+                )
+                w(
+                    f"    {t.get('verdict', '?'):<8}{bar} "
+                    f"agree={agree} drift={t.get('prob_drift')}"
+                    f"{auc} n={t.get('samples')}\n"
+                )
+        for e in flywheel.get("history") or []:
+            mark = "+" if e.get("rollout_ok") else (
+                "x" if e["kind"] == "demotion" else "~"
+            )
+            reason = e.get("reason") or e.get("halt_reason") or ""
+            w(
+                f"  [{mark}] {e['kind']:<10}{e.get('candidate'):<14}"
+                f"{reason}\n"
             )
 
     drill = report.get("drill") or {}
@@ -1844,6 +1943,51 @@ def build_smoke_run(run_dir: Path) -> Path:
         flog.append(fleet_autoscale.AutoscaleController.log_record(
             decision
         ))
+    # flywheel shadow ride through the REAL record emitters
+    # (flywheel/shadow.py): a candidate rides, improves across two
+    # comparison windows, gets promoted; an earlier candidate is
+    # demoted for trailing — the diag flywheel section renders both
+    from deepdfa_tpu.flywheel import shadow as flywheel_shadow
+
+    flywheel_shadow.record_shadow(
+        flog, "ride_start", "cand-a", incumbent="incumbent",
+        t_unix=round(t_now - 11, 3),
+    )
+    flywheel_shadow.record_shadow(
+        flog, "window", "cand-a", samples=64, labeled=20,
+        agreement=0.86, prob_drift=0.04, lag_s=0.2,
+        auc_candidate=0.64, auc_incumbent=0.71,
+        verdict="demote", verdict_reason="trailing",
+        t_unix=round(t_now - 10, 3),
+    )
+    flywheel_shadow.record_shadow(
+        flog, "ride_end", "cand-a", t_unix=round(t_now - 9.5, 3),
+    )
+    flywheel_shadow.record_demotion(
+        flog, "cand-a", "trailing", auc_candidate=0.64,
+        auc_incumbent=0.71, t_unix=round(t_now - 9, 3),
+    )
+    flywheel_shadow.record_shadow(
+        flog, "ride_start", "cand-b", incumbent="incumbent",
+        t_unix=round(t_now - 8, 3),
+    )
+    for k, (agree, auc_c) in enumerate([(0.91, 0.74), (0.94, 0.79)]):
+        flywheel_shadow.record_shadow(
+            flog, "window", "cand-b", samples=64 * (k + 1),
+            labeled=24 * (k + 1), agreement=agree, prob_drift=0.02,
+            lag_s=0.15, auc_candidate=auc_c, auc_incumbent=0.71,
+            verdict="hold" if k == 0 else "promote",
+            verdict_reason="within_margin" if k == 0 else "auc_margin",
+            t_unix=round(t_now - 7 + 2 * k, 3),
+        )
+    flywheel_shadow.record_shadow(
+        flog, "ride_end", "cand-b", t_unix=round(t_now - 4.5, 3),
+    )
+    flywheel_shadow.record_promotion(
+        flog, "cand-b", rollout_ok=True, swapped=2,
+        reason="auc_margin", auc_candidate=0.79, auc_incumbent=0.71,
+        t_unix=round(t_now - 4, 3),
+    )
     flog.append({
         "fleet": {
             "requests": 12, "forwarded": 10, "retries": 1,
@@ -2100,6 +2244,24 @@ def main(argv=None) -> int:
                     "drill_failover_s"
                 ) == 0.5  # worst of the two stub rounds (0.4, 0.5)
                 and report["drill"]["trajectory"][-1].get("valid")
+                # ISSUE 20 section: the flywheel view — two shadow
+                # rides rebuilt from the real record emitters
+                # (flywheel/shadow.py), one demoted for trailing, one
+                # promoted on AUC margin, plus the promotion history
+                and set(
+                    (report.get("flywheel") or {}).get("rides") or {}
+                ) == {"cand-a", "cand-b"}
+                and len(
+                    report["flywheel"]["rides"]["cand-b"]["timeline"]
+                ) == 2
+                and report["flywheel"]["rides"]["cand-b"]["timeline"][
+                    -1
+                ].get("verdict") == "promote"
+                and [
+                    h.get("kind")
+                    for h in report["flywheel"].get("history") or []
+                ] == ["demotion", "promotion"]
+                and report["flywheel"]["history"][-1].get("swapped") == 2
                 # ISSUE 10 sections: the efficiency ledger (per-site
                 # MFU + compile bars + HBM watermark timeline) and the
                 # postmortem view, both from the real emitters

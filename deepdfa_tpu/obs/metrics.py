@@ -375,6 +375,17 @@ SCHEMA: tuple[str, ...] = (
     # (alert_mttd_s rides bench records; drill records carry
     # drill_alert_mttd_s under drill_*)
     "alert/*", "fleet_alert_*", "alert_mttd_s",
+    # data flywheel (deepdfa_tpu/flywheel/, docs/flywheel.md):
+    # shadow/* = sampler/scorer counters-gauges (samples, dropped,
+    # windows, regressions, agreement, prob_drift, lag_s) AND the
+    # {"shadow": {...}} fleet_log records' scalar fields (t_unix,
+    # samples, agreement, auc_candidate/auc_incumbent, lag_s);
+    # shadow_* = the bench_load stamps (shadow_agreement,
+    # shadow_sample_lag_s, shadow_overhead_fraction — gated in
+    # obs/bench_gate.py); flywheel/* = the promotion controller's
+    # counters (decisions by outcome); promotion/* and demotion/* =
+    # the {"promotion"/"demotion": {...}} records' scalar fields
+    "shadow/*", "shadow_*", "flywheel/*", "promotion/*", "demotion/*",
     # federation + alert-evaluation overhead bound (scripts/
     # bench_load.py interleaved reps; ≤2% ABSOLUTE_UPPER_BOUNDS in
     # obs/bench_gate.py)

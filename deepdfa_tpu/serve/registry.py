@@ -199,6 +199,7 @@ class ModelRegistry:
         cfg: Config | None = None,
         model_cfg: Any = None,
         mesh: Any = None,
+        flywheel_tag: str = "incumbent",
     ):
         """mesh: an optional serve mesh (cfg.serve.sharded +
         cfg.serve.mesh, parallel/sharding.py) — restored params commit
@@ -215,6 +216,11 @@ class ModelRegistry:
         self.run_dir = Path(run_dir)
         self.family = family
         self.checkpoint = checkpoint
+        #: flywheel role tag (docs/flywheel.md): "incumbent" for the
+        #: serving fleet, "candidate" for a shadow-ride registry — the
+        #: tag rides /healthz + heartbeats so diag and the promotion
+        #: controller can tell the two apart on the record
+        self.flywheel_tag = str(flywheel_tag)
         # `tag@int8` = the quantized alternate entry for `tag`
         # (serve/quant.py): same manifest pointer, int8/bf16 pytree
         self.base_checkpoint, self.quant_mode = (
@@ -749,6 +755,10 @@ class ModelRegistry:
             "vocab_digest": self.vocab_digest,
             "hot_swaps": self.reloads,
         }
+        if self.flywheel_tag != "incumbent":
+            # non-default role only: the incumbent /healthz payload
+            # stays byte-identical with the flywheel off
+            out["flywheel_tag"] = self.flywheel_tag
         if self._prev is not None:
             # the rollback stash (fleet rollout): what one `rollback()`
             # would resume serving

@@ -303,3 +303,33 @@ class CheckpointManager:
     def best_metrics(self) -> dict[str, float] | None:
         best = self._manifest["best"]
         return None if best is None else dict(best["metrics"])
+
+
+def restore_candidate_params(
+    ckpt_dir, params_target: Any, tag: str | None = None
+) -> Any:
+    """Warm-start restore for a flywheel candidate fine-tune
+    (deepdfa_tpu/flywheel/retrain.py, docs/flywheel.md).
+
+    Resolves the tag the way serving would pick it — manifest "best",
+    falling back to "last", falling back to the newest dir on disk —
+    and restores params-only through `restore_for_inference`, so both
+    checkpoint layouts (bare params and full TrainState) warm-start a
+    candidate identically to how they'd serve. Keeping the resolution
+    here (not in flywheel/) means the retrainer can never diverge from
+    the registry about which params "the incumbent" means.
+    """
+    mgr = CheckpointManager(ckpt_dir)
+    if tag is None:
+        for entry in (mgr._manifest.get("best"), mgr._manifest.get("last")):
+            if entry and entry.get("tag"):
+                tag = entry["tag"]
+                break
+    if tag is None:
+        tags = mgr.available_tags()
+        if not tags:
+            raise FileNotFoundError(
+                f"no checkpoints under {mgr.directory} to warm-start from"
+            )
+        tag = tags[-1]
+    return mgr.restore_for_inference(tag, params_target)

@@ -39,6 +39,19 @@ ISSUE 19 stamps (the fleet telemetry plane, docs/alerts.md):
                           production evaluation cadence (lower-is-better
                           gated)
 
+ISSUE 20 stamps (the data flywheel, docs/flywheel.md):
+  shadow_overhead_fraction  closed-loop throughput cost of shadow
+                          mirror sampling on the router's reply path
+                          (flywheel/shadow.py:ShadowSampler at
+                          sample_rate=1.0 — worst case), interleaved
+                          on/off reps; absolute-bounded at 2%
+  shadow_agreement        agreement over a mini in-process shadow ride
+                          where the candidate IS the incumbent's
+                          checkpoint — a fall is comparison-plumbing
+                          drift, not a model difference
+  shadow_sample_lag_s     sampler-append to scorer-consume latency over
+                          that ride (lower-is-better gated)
+
 Modes:
     python scripts/bench_load.py --smoke   # tier-1 regression mode
     python scripts/bench_load.py           # full mode (bigger drive)
@@ -286,6 +299,66 @@ def bench_load(
             )
             alert_mttd = _measure_alert_mttd()
 
+            # ISSUE 20: cost of shadow mirror sampling on the same
+            # reply path, by the same interleaved on/off method. The
+            # "on" arm attaches a ShadowSampler at sample_rate=1.0 —
+            # worst case: EVERY 200 response pays the sample append +
+            # backpressure check — against the 2% absolute ceiling in
+            # bench_gate.ABSOLUTE_UPPER_BOUNDS.
+            from deepdfa_tpu.flywheel.shadow import (
+                ShadowSampler,
+                ShadowScorer,
+                http_score_fn,
+            )
+
+            shadow_sampler = ShadowSampler(
+                fleet_dir, sample_rate=1.0, max_inflight=4096,
+            )
+
+            def _shadow_rep() -> float:
+                t0 = time.perf_counter()
+                for i in range(obs_burst):
+                    status, _ = send(codes[i % len(codes)], "batch", None)
+                    assert status == 200, f"shadow rep failed: {status}"
+                return obs_burst / (time.perf_counter() - t0)
+
+            ratios = []
+            for pair in range(obs_reps + 1):
+                on_first = pair % 2 == 1
+                pair_rps = {}
+                for arm in ((True, False) if on_first else (False, True)):
+                    router.flywheel = shadow_sampler if arm else None
+                    try:
+                        pair_rps[arm] = _shadow_rep()
+                    finally:
+                        router.flywheel = None
+                if pair > 0:  # pair 0 is the throwaway
+                    ratios.append(pair_rps[True] / pair_rps[False])
+            ratios.sort()
+            shadow_overhead = max(0.0, 1.0 - ratios[len(ratios) // 2])
+
+            # mini in-process shadow ride: the scorer tails the sample
+            # stream and scores with replica r0 — the candidate IS the
+            # incumbent's checkpoint, so agreement is a plumbing
+            # invariant (sampled prob paired with the right scored
+            # prob) and lag is the mirror stream's consumption latency
+            n_ride = 12 if smoke else 32
+            shadow_scorer = ShadowScorer(
+                fleet_dir, "bench-candidate", "incumbent",
+                http_score_fn(servers[0].host, servers[0].port),
+                window=n_ride, min_samples=n_ride,
+            )
+            shadow_scorer.last_seq = shadow_sampler._seq
+            router.flywheel = shadow_sampler
+            try:
+                for i in range(n_ride):
+                    status, _ = send(codes[i % len(codes)], "batch", None)
+                    assert status == 200, f"ride request failed: {status}"
+            finally:
+                router.flywheel = None
+            shadow_scorer.poll()
+            shadow_stats = shadow_scorer.comparator.stats()
+
             # open-loop overload drive: Poisson arrivals at
             # overload x measured capacity, fired on schedule
             offered_rate = max(1.0, overload * warm_rps)
@@ -377,6 +450,16 @@ def bench_load(
                     round(alert_mttd, 4) if alert_mttd is not None
                     else None
                 ),
+                "shadow_overhead_fraction": round(shadow_overhead, 4),
+                "shadow_agreement": (
+                    round(shadow_stats["agreement"], 4)
+                    if "agreement" in shadow_stats else None
+                ),
+                "shadow_sample_lag_s": (
+                    round(shadow_stats["lag_s"], 4)
+                    if "lag_s" in shadow_stats else None
+                ),
+                "shadow_ride_samples": shadow_stats.get("samples", 0),
                 "serve_pipeline_depth": cfg.serve.pipeline_depth,
                 "serve_device_idle_fraction": idle_frac,
                 "shed_by_tenant": shed_by_tenant,

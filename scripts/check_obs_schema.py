@@ -37,8 +37,12 @@ Modes:
                  (deepdfa_tpu/fleet/router.py, docs/fleet.md):
                  structural checks (per-request entries carry id +
                  status, lifecycle events carry a declared name +
-                 t_unix) AND every flattened scalar tag declared in
-                 SCHEMA — wired into `deepdfa-tpu fleet --smoke`
+                 t_unix, flywheel records — `shadow` entries carry a
+                 declared event + candidate, `promotion`/`demotion`
+                 entries a candidate + t_unix and demotions a declared
+                 reason; docs/flywheel.md) AND every flattened scalar
+                 tag declared in SCHEMA — wired into `deepdfa-tpu
+                 fleet --smoke`
   --metrics <path>    validate a Prometheus `/metrics` scrape (saved
                  text, e.g. <run_dir>/metrics.prom from `serve --smoke`)
                  against the same registry: every line must parse as

@@ -102,6 +102,34 @@ def test_fleet_smoke_end_to_end(tmp_path):
     assert az["fleet_log"]["autoscale"] >= len(az["actions"])
     assert az["ramp_log_ok"] is True
 
+    # -- data-flywheel phase (ISSUE 20): a shadow candidate rode the
+    # stub fleet without ever taking live traffic; the losing ride was
+    # refused with zero swaps, the drifting ride was halted mid-rollout
+    # by the real drift gate and rolled back, and the winning ride
+    # auto-promoted through the real rollout path under open-loop
+    # traffic with zero lost requests and the census intact
+    fw = report["flywheel"]
+    assert fw["ok"] is True
+    assert fw["shadow_not_routable"] is True
+    assert fw["shadow_never_routed"] is True
+    assert fw["losing"]["action"] == "demote"
+    assert fw["losing"]["reason"] == "trailing"
+    assert fw["losing"]["swaps"] == 0
+    assert fw["drift_halt"]["reason"] == "rollout_halted"
+    assert fw["drift_halt"]["halted"] is True
+    assert fw["drift_halt"]["r0_restored"] is True
+    assert fw["drift_halt"]["r1_refused"] is True
+    assert fw["winning"]["rollout_ok"] is True
+    assert fw["winning"]["promoted_everywhere"] is True
+    assert fw["winning"]["census_ok"] is True
+    assert fw["winning"]["lost"] == 0
+    assert fw["winning"]["requests"] > 0
+    assert fw["zero_recompiles"] is True
+    assert fw["fleet_log"]["ok"] is True
+    assert fw["fleet_log"]["shadow"] >= 3
+    assert fw["fleet_log"]["promotions"] >= 1
+    assert fw["fleet_log"]["demotions"] >= 2
+
     # -- the router's log validates in-process AND through the script
     assert report["fleet_log"]["ok"] is True
     assert report["fleet_log"]["requests"] > 0

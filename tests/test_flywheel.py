@@ -1,0 +1,503 @@
+"""Data-flywheel unit tests (deepdfa_tpu/flywheel/, docs/flywheel.md)
+— the pure halves without a fleet or a model: the promotion judge and
+rank-AUC, the comparator's windowed stats, the router-side sampler's
+deterministic period + backpressure drop, the fleet-log record shapes,
+the log-driven promotion decision, the traffic-weighted retraining
+helpers, and the default-off contract (flywheel off leaves the router
+and the heartbeat envelope byte-identical). The full loop — shadow
+ride, auto-promotion through the real rollout gates, rollback on an
+injected bad candidate — lives in `fleet --smoke`
+(fleet/smoke.py:run_flywheel_smoke, tests/test_fleet_cli.py)."""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.core import config as config_mod
+from deepdfa_tpu.fleet.router import (
+    DEMOTION_REASONS,
+    FleetLog,
+    ReplicaView,
+    SHADOW_EVENTS,
+    router_from_config,
+    validate_fleet_log,
+)
+from deepdfa_tpu.flywheel import promote as promote_mod, shadow as shadow_mod
+from deepdfa_tpu.flywheel.retrain import (
+    band_of,
+    example_weights,
+    select_weighted,
+    traffic_weights_from_log,
+)
+from deepdfa_tpu.obs import metrics as obs_metrics
+
+
+# ---------------------------------------------------------------------------
+# judge + rank_auc: the one decision function everything shares
+
+
+def test_rank_auc_orders_and_ties():
+    # perfect separation -> 1.0; inverted -> 0.0; ties split
+    assert shadow_mod.rank_auc([1, 1, 0, 0], [0.9, 0.8, 0.2, 0.1]) == 1.0
+    assert shadow_mod.rank_auc([1, 1, 0, 0], [0.1, 0.2, 0.8, 0.9]) == 0.0
+    assert shadow_mod.rank_auc([1, 0], [0.5, 0.5]) == 0.5
+
+
+def test_rank_auc_one_class_is_undefined():
+    # an all-negative (or all-positive) window must NOT read as 0.5 —
+    # judge() falls back to agreement instead of promoting on noise
+    assert shadow_mod.rank_auc([0, 0], [0.1, 0.9]) is None
+    assert shadow_mod.rank_auc([1, 1], [0.1, 0.9]) is None
+
+
+BOUNDS = dict(
+    min_samples=10, promote_margin=0.02, demote_margin=0.05,
+    drift_bound=0.25,
+)
+
+
+def test_judge_sample_floor_first():
+    # even a drifting, trailing candidate holds below the floor:
+    # nothing is decidable on noise
+    action, reason = shadow_mod.judge(
+        {"samples": 9, "prob_drift": 0.9, "auc_candidate": 0.1,
+         "auc_incumbent": 0.9}, **BOUNDS,
+    )
+    assert (action, reason) == ("hold", "insufficient_samples")
+
+
+def test_judge_drift_gate_beats_auc():
+    # mirrors the PR-14 swap-time refusal: a walked-away candidate is
+    # demoted even when its AUC looks better
+    action, reason = shadow_mod.judge(
+        {"samples": 64, "prob_drift": 0.3, "auc_candidate": 0.9,
+         "auc_incumbent": 0.6}, **BOUNDS,
+    )
+    assert (action, reason) == ("demote", "drift")
+
+
+@pytest.mark.parametrize("auc_c,auc_i,expect", [
+    (0.75, 0.70, ("promote", "auc_margin")),
+    (0.60, 0.70, ("demote", "trailing")),
+    (0.71, 0.70, ("hold", "within_margin")),
+])
+def test_judge_auc_margins(auc_c, auc_i, expect):
+    assert shadow_mod.judge(
+        {"samples": 64, "prob_drift": 0.01, "auc_candidate": auc_c,
+         "auc_incumbent": auc_i}, **BOUNDS,
+    ) == expect
+
+
+def test_judge_unlabeled_never_promotes():
+    # agreement only says "the same", not "better"
+    assert shadow_mod.judge(
+        {"samples": 64, "prob_drift": 0.01, "agreement": 1.0}, **BOUNDS,
+    ) == ("hold", "unlabeled")
+    assert shadow_mod.judge(
+        {"samples": 64, "prob_drift": 0.01, "agreement": 0.5}, **BOUNDS,
+    ) == ("demote", "trailing")
+
+
+def test_judge_reasons_are_schema_valid():
+    # every demote reason judge() can emit must be a declared demotion
+    # reason, or record_demotion would raise on the verdict
+    for stats in (
+        {"samples": 64, "prob_drift": 0.9},
+        {"samples": 64, "auc_candidate": 0.1, "auc_incumbent": 0.9},
+        {"samples": 64, "agreement": 0.0},
+    ):
+        action, reason = shadow_mod.judge(stats, **BOUNDS)
+        if action == "demote":
+            assert reason in DEMOTION_REASONS
+
+
+# ---------------------------------------------------------------------------
+# ShadowComparator: windowed stats
+
+
+def test_comparator_window_and_stats():
+    comp = shadow_mod.ShadowComparator(window=4)
+    for i in range(8):
+        # last 4 rows: agree on 2 of 4, labels present
+        p = 0.9 if i % 2 else 0.1
+        comp.add(p, 1.0 - p if i >= 6 else p, label=i % 2, lag_s=0.1 * i)
+    stats = comp.stats()
+    assert stats["total"] == 8 and stats["samples"] == 4
+    assert stats["agreement"] == 0.5
+    assert stats["labeled"] == 4
+    assert stats["lag_s"] == pytest.approx(0.7)
+    assert "auc_candidate" in stats and "auc_incumbent" in stats
+
+
+def test_comparator_empty_stats():
+    assert shadow_mod.ShadowComparator().stats() == {
+        "samples": 0, "total": 0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# record emitters: schema-valid by construction, loud otherwise
+
+
+def test_record_helpers_raise_on_bad_vocabulary(tmp_path):
+    log = FleetLog(tmp_path / "fleet_log.jsonl")
+    try:
+        with pytest.raises(ValueError):
+            shadow_mod.record_shadow(log, "liftoff", "cand")
+        with pytest.raises(ValueError):
+            shadow_mod.record_demotion(log, "cand", "vibes")
+        shadow_mod.record_shadow(log, "ride_start", "cand")
+        shadow_mod.record_promotion(log, "cand", rollout_ok=True)
+        shadow_mod.record_demotion(log, "cand", "trailing")
+    finally:
+        log.close()
+    result = validate_fleet_log(tmp_path / "fleet_log.jsonl")
+    assert result["ok"], result["problems"]
+    assert result["shadow"] == 1
+    assert result["promotions"] == 1
+    assert result["demotions"] == 1
+
+
+def test_validate_fleet_log_rejects_bad_flywheel_records(tmp_path):
+    path = tmp_path / "fleet_log.jsonl"
+    path.write_text(
+        json.dumps({"shadow": {"event": "liftoff", "candidate": "c",
+                               "t_unix": 1.0}}) + "\n"
+        + json.dumps({"demotion": {"candidate": "c", "reason": "vibes",
+                                   "t_unix": 1.0}}) + "\n"
+        + json.dumps({"promotion": {"t_unix": 1.0}}) + "\n"
+    )
+    result = validate_fleet_log(path)
+    assert not result["ok"]
+    assert len(result["problems"]) == 3
+
+
+def test_shadow_events_and_reasons_vocabulary():
+    assert SHADOW_EVENTS == ("ride_start", "window", "ride_end")
+    assert "rollout_halted" in DEMOTION_REASONS
+    assert "insufficient_samples" in DEMOTION_REASONS
+
+
+# ---------------------------------------------------------------------------
+# ShadowSampler: deterministic period, label capture, bounded inflight
+
+
+def test_sampler_every_kth_and_labels(tmp_path):
+    sampler = shadow_mod.ShadowSampler(tmp_path, sample_rate=0.5)
+    try:
+        for i in range(6):
+            sampler.observe(f"r{i}", {"code": f"int f{i}();",
+                                      "label": i % 2}, 0.5, tenant="t")
+    finally:
+        sampler.close()
+    lines = [
+        json.loads(ln)["shadow_sample"]
+        for ln in (tmp_path / shadow_mod.SAMPLES_FILE).read_text()
+        .splitlines()
+    ]
+    # period 2: the 2nd, 4th, 6th observed requests are sampled
+    assert [s["id"] for s in lines] == ["r1", "r3", "r5"]
+    assert [s["seq"] for s in lines] == [1, 2, 3]
+    assert all(s["label"] == 1 for s in lines)
+
+
+def test_sampler_skips_unscorable(tmp_path):
+    sampler = shadow_mod.ShadowSampler(tmp_path, sample_rate=1.0)
+    try:
+        assert not sampler.observe("a", {"code": None}, 0.5)
+        assert not sampler.observe("b", {"code": "int f();"}, None)
+        assert not sampler.observe("c", "not a dict", 0.5)
+        assert sampler.observe("d", {"code": "int f();"}, 0.5)
+    finally:
+        sampler.close()
+
+
+def test_sampler_zero_rate_samples_nothing(tmp_path):
+    sampler = shadow_mod.ShadowSampler(tmp_path, sample_rate=0.0)
+    try:
+        assert not sampler.observe("a", {"code": "int f();"}, 0.5)
+    finally:
+        sampler.close()
+    assert (tmp_path / shadow_mod.SAMPLES_FILE).read_text() == ""
+
+
+def test_sampler_drops_past_max_inflight(tmp_path):
+    # delta, not REGISTRY.reset(): reset orphans Counter objects other
+    # subsystems captured at construction (e.g. the shared FeatureCache)
+    dropped = obs_metrics.REGISTRY.counter("shadow/dropped")
+    before = dropped.value
+    # scorer acknowledged nothing: after max_inflight appends the
+    # sampler DROPS (counted) instead of growing an unbounded mirror
+    # buffer inside the router
+    (tmp_path / shadow_mod.PROGRESS_FILE).write_text(
+        json.dumps({"scored": 0})
+    )
+    sampler = shadow_mod.ShadowSampler(
+        tmp_path, sample_rate=1.0, max_inflight=2,
+        progress_refresh_s=0.0,
+    )
+    try:
+        appended = sum(
+            sampler.observe(f"r{i}", {"code": "int f();"}, 0.5)
+            for i in range(5)
+        )
+    finally:
+        sampler.close()
+    assert appended == 2
+    assert dropped.value == before + 3.0
+
+
+def test_scorer_consumes_stream_and_emits_window(tmp_path):
+    sampler = shadow_mod.ShadowSampler(tmp_path, sample_rate=1.0)
+    log = FleetLog(tmp_path / "fleet_log.jsonl")
+    # candidate = incumbent + 0.2: separable labels -> candidate AUC
+    # equals incumbent AUC, agreement dented by the shift
+    scorer = shadow_mod.ShadowScorer(
+        tmp_path, "cand", "incumbent",
+        lambda code: 0.2 + 0.05 * len(code), log=log,
+        window=4, min_samples=4, promote_margin=0.01,
+        demote_margin=0.05, drift_bound=1.0,
+    )
+    try:
+        for i in range(4):
+            sampler.observe(
+                f"r{i}", {"code": "x" * (i + 1), "label": int(i >= 2)},
+                0.05 * (i + 1),
+            )
+        assert scorer.poll() == 4
+        assert scorer.windows == 1
+        assert scorer.comparator.stats()["labeled"] == 4
+        # the ack doc moved: the sampler's backpressure window advanced
+        progress = json.loads(
+            (tmp_path / shadow_mod.PROGRESS_FILE).read_text()
+        )
+        assert progress["scored"] == 4
+    finally:
+        scorer_stats = scorer.comparator.stats()
+        log.close()
+        sampler.close()
+    assert scorer_stats["samples"] == 4
+    result = validate_fleet_log(tmp_path / "fleet_log.jsonl")
+    assert result["ok"], result["problems"]
+    assert result["shadow"] == 1  # exactly one window record
+
+
+# ---------------------------------------------------------------------------
+# promotion decision from the log (the CLI/watcher path, no fleet)
+
+
+def _ride_log(tmp_path, verdict_stats):
+    log = FleetLog(tmp_path / "fleet_log.jsonl")
+    try:
+        shadow_mod.record_shadow(log, "ride_start", "cand")
+        shadow_mod.record_shadow(log, "window", "cand", **verdict_stats)
+    finally:
+        log.close()
+    return tmp_path / "fleet_log.jsonl"
+
+
+def test_decide_from_log_promotes_on_margin(tmp_path):
+    path = _ride_log(tmp_path, {
+        "samples": 64, "prob_drift": 0.01,
+        "auc_candidate": 0.8, "auc_incumbent": 0.7,
+    })
+    action, reason, stats = promote_mod.decide_from_log(
+        path, "cand", **BOUNDS,
+    )
+    assert (action, reason) == ("promote", "auc_margin")
+    assert stats["samples"] == 64
+
+
+def test_decide_from_log_unknown_candidate_holds(tmp_path):
+    path = _ride_log(tmp_path, {"samples": 64})
+    action, reason, _ = promote_mod.decide_from_log(
+        path, "somebody-else", **BOUNDS,
+    )
+    assert (action, reason) == ("hold", "insufficient_samples")
+
+
+def test_decide_from_log_firing_alert_vetoes(tmp_path):
+    # a firing shadow_regression alert (obs/alerts.py default rule)
+    # demotes regardless of the window stats: mid-ride degradation
+    # outranks a stale good comparison
+    path = _ride_log(tmp_path, {
+        "samples": 64, "auc_candidate": 0.9, "auc_incumbent": 0.5,
+    })
+    log = FleetLog(path)
+    try:
+        # the AlertEngine's transition-record shape (obs/alerts.py
+        # `_record`): the rule name rides under "rule"
+        log.append({"alert": {
+            "rule": "shadow_regression", "state": "firing",
+            "kind": "counter_rate", "window": "300s", "observed": 1.0,
+            "threshold": 0.0, "for_s": 0.0,
+            "t_unix": round(time.time(), 3),
+        }})
+    finally:
+        log.close()
+    action, reason, _ = promote_mod.decide_from_log(path, "cand", **BOUNDS)
+    assert (action, reason) == ("demote", "alert")
+
+
+def test_shadow_regression_rule_in_default_catalog():
+    from deepdfa_tpu.obs.alerts import default_rules
+
+    names = [r.name for r in default_rules()]
+    assert "shadow_regression" in names
+
+
+# ---------------------------------------------------------------------------
+# default-off contract: flywheel off leaves the fleet path untouched
+
+
+def test_router_flywheel_off_by_default(tmp_path):
+    cfg = config_mod.Config()
+    assert cfg.fleet.flywheel is False
+    router = router_from_config(cfg, tmp_path / "fleet")
+    try:
+        assert router.flywheel is None
+    finally:
+        router.close()
+
+
+def test_router_flywheel_wired_when_on(tmp_path):
+    cfg = config_mod.apply_overrides(config_mod.Config(), [
+        "fleet.flywheel=true", "fleet.flywheel_sample_rate=1.0",
+    ])
+    router = router_from_config(cfg, tmp_path / "fleet")
+    try:
+        assert router.flywheel is not None
+        assert router.flywheel.period == 1
+    finally:
+        router.close()
+    # close() tore the sampler down with the router
+    assert router.flywheel is None
+
+
+def test_shadow_replica_never_routable(tmp_path):
+    now = time.time()
+    hb = {"replica_id": "r0", "host": "h", "port": 1, "state": "ready",
+          "t_unix": now}
+    assert ReplicaView(dict(hb)).routable(10.0, now)
+    view = ReplicaView({**hb, "shadow": True})
+    assert view.shadow and not view.routable(10.0, now)
+    # and the rollout controller skips it too: swapping the shadow
+    # would score the comparison stream against itself
+    from deepdfa_tpu.fleet import heartbeat
+    from deepdfa_tpu.fleet.rollout import _ready_replicas
+
+    heartbeat.write_heartbeat(tmp_path, "r0", "h", 1)
+    heartbeat.write_heartbeat(tmp_path, "rs", "h", 2,
+                              info={"shadow": True})
+    assert sorted(_ready_replicas(tmp_path, 10.0)) == ["r0"]
+
+
+def test_heartbeat_envelope_unchanged_by_default(tmp_path):
+    # a non-shadow ReplicaWorker heartbeat carries no `shadow` key at
+    # all — the default envelope is byte-identical to pre-flywheel
+    view = ReplicaView({"replica_id": "r0", "host": "h", "port": 1,
+                        "state": "ready", "t_unix": time.time()})
+    assert "shadow" not in view.info
+
+
+def test_schema_declares_flywheel_tags():
+    for tag in ("shadow/samples", "shadow/dropped", "shadow/scored",
+                "shadow/score_errors", "shadow/windows",
+                "shadow/regressions", "shadow/agreement",
+                "shadow/prob_drift", "shadow/lag_s",
+                "shadow_agreement", "shadow_sample_lag_s",
+                "flywheel/promote", "flywheel/demote", "flywheel/hold",
+                "promotion/count", "demotion/count"):
+        assert obs_metrics.declared(tag), tag
+
+
+def test_bench_gate_bounds_shadow_metrics():
+    from deepdfa_tpu.obs import bench_gate
+
+    assert bench_gate.ABSOLUTE_UPPER_BOUNDS[
+        "shadow_overhead_fraction"
+    ] == 0.02
+    assert "shadow_agreement" in bench_gate.DEFAULT_TOLERANCES
+    assert "shadow_sample_lag_s" in bench_gate.LOWER_IS_BETTER
+
+
+# ---------------------------------------------------------------------------
+# retraining helpers: traffic profile -> weights -> selection
+
+
+def test_traffic_weights_from_log(tmp_path):
+    path = tmp_path / "fleet_log.jsonl"
+    lines = [
+        json.dumps({"request": {"id": f"q{i}", "status": 200,
+                                "tenant": "interactive",
+                                "prob": 0.05 + 0.1 * (i % 3)}})
+        for i in range(6)
+    ]
+    lines.append(json.dumps({"request": {"id": "shed", "status": 503}}))
+    lines.append("{torn")
+    path.write_text("\n".join(lines) + "\n")
+    profile = traffic_weights_from_log(path)
+    assert profile["requests"] == 7
+    assert profile["scored"] == 6
+    assert profile["tenants"]["interactive"] == 6
+    assert profile["tenants"]["default"] == 1  # the tenant-less shed
+    assert sum(profile["prob_bands"]) == 6
+    assert profile["prob_bands"][0] == 2  # the 0.05 scores
+
+
+def test_band_of_clamps():
+    assert band_of(-0.5) == 0
+    assert band_of(0.05) == 0
+    assert band_of(0.95) == 9
+    assert band_of(1.5) == 9
+
+
+def test_example_weights_floor_keeps_empty_bands():
+    bands = [0] * 10
+    bands[9] = 90
+    w = example_weights([0.95, 0.05], bands)
+    # hot band carries the traffic mass; empty band floored, not erased
+    assert w[0] == 1.0
+    assert 0 < w[1] < w[0]
+
+
+def test_select_weighted_deterministic_and_proportional():
+    weights = [1.0, 0.0, 3.0]
+    picks = select_weighted(weights, 8, seed=3)
+    assert picks == select_weighted(weights, 8, seed=3)
+    assert len(picks) == 8
+    assert 1 not in picks  # zero-weight index never drawn
+    assert picks.count(2) > picks.count(0)
+
+
+# ---------------------------------------------------------------------------
+# diag section: the ride timeline + history rebuilt from records
+
+
+def test_flywheel_section_from_records():
+    from deepdfa_tpu.obs.diag import flywheel_section
+
+    records = [
+        {"shadow": {"event": "ride_start", "candidate": "c",
+                    "incumbent": "incumbent", "t_unix": 1.0}},
+        {"shadow": {"event": "window", "candidate": "c", "samples": 32,
+                    "agreement": 0.9, "verdict": "promote",
+                    "t_unix": 2.0}},
+        {"shadow": {"event": "ride_end", "candidate": "c",
+                    "t_unix": 3.0}},
+        {"demotion": {"candidate": "old", "reason": "trailing",
+                      "t_unix": 0.5}},
+        {"promotion": {"candidate": "c", "rollout_ok": True,
+                       "swapped": 2, "t_unix": 4.0}},
+    ]
+    section = flywheel_section(records)
+    ride = section["rides"]["c"]
+    assert ride["incumbent"] == "incumbent"
+    assert ride["windows"] == 1 and ride["ended"]
+    assert ride["timeline"][0]["verdict"] == "promote"
+    assert [h["kind"] for h in section["history"]] == [
+        "demotion", "promotion",
+    ]
+    assert flywheel_section([]) == {}
